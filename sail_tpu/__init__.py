@@ -1,0 +1,28 @@
+"""sail-tpu: a TPU-native distributed compute framework with the
+capabilities of Sail (lakehq/sail) — Spark SQL / DataFrame plans executed on
+a columnar engine built on jax/XLA/Pallas, with distributed shuffle as ICI
+collectives over a jax.sharding.Mesh.
+
+Layering (mirrors SURVEY.md §1, re-designed TPU-first):
+
+    session / DataFrame API / SQL          (front-ends)
+      → spec IR                            (sail_tpu.spec)
+      → resolver → logical plan            (sail_tpu.plan)
+      → optimizer → physical plan          (sail_tpu.plan)
+      → executor: jitted columnar ops      (sail_tpu.ops on sail_tpu.columnar)
+      → distributed: mesh + collectives    (sail_tpu.parallel, sail_tpu.exec)
+      → io / formats / catalog             (sail_tpu.io, sail_tpu.catalog)
+"""
+
+__version__ = "0.1.0"
+
+import os as _os
+
+import jax as _jax
+
+# SQL semantics require 64-bit integers/floats on device (bigint, double,
+# epoch-microsecond timestamps, scaled-int64 decimals).
+if _os.environ.get("SAIL_TPU_DISABLE_X64") != "1":
+    _jax.config.update("jax_enable_x64", True)
+
+from .session import SparkSession  # noqa: F401

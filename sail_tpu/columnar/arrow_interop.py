@@ -1,0 +1,286 @@
+"""Arrow ⇄ device-columnar conversion.
+
+Host boundary of the engine: pyarrow Tables (from Parquet/CSV/JSON scans or
+client LocalRelations) become padded DeviceBatches, and query results come
+back as Arrow for the protocol layer. Mirrors the role of the reference's
+use of arrow-rs as the in-memory format (SURVEY.md §2.1 sail-common /
+§2.6 sail-data-source), re-shaped for HBM residency:
+
+- fixed-width types upload as padded device arrays
+- decimal128(p≤18) uploads as the *unscaled* int64 (exact arithmetic on
+  device; the low 64 bits of the two's-complement decimal128 value equal
+  the int64 value whenever it fits)
+- strings/binary dictionary-encode; codes upload, dictionary stays host-side
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..spec import data_type as dt
+from .batch import Column, DeviceBatch, HostBatch, make_batch, round_capacity
+
+
+def arrow_type_to_spec(t: pa.DataType) -> dt.DataType:
+    if pa.types.is_boolean(t):
+        return dt.BooleanType()
+    if pa.types.is_int8(t):
+        return dt.ByteType()
+    if pa.types.is_int16(t):
+        return dt.ShortType()
+    if pa.types.is_int32(t):
+        return dt.IntegerType()
+    if pa.types.is_int64(t):
+        return dt.LongType()
+    if pa.types.is_uint8(t):
+        return dt.ShortType()
+    if pa.types.is_uint16(t):
+        return dt.IntegerType()
+    if pa.types.is_uint32(t) or pa.types.is_uint64(t):
+        return dt.LongType()
+    if pa.types.is_float32(t):
+        return dt.FloatType()
+    if pa.types.is_float64(t):
+        return dt.DoubleType()
+    if pa.types.is_decimal(t):
+        return dt.DecimalType(t.precision, t.scale)
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return dt.StringType()
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return dt.BinaryType()
+    if pa.types.is_date32(t):
+        return dt.DateType()
+    if pa.types.is_date64(t):
+        return dt.DateType()
+    if pa.types.is_timestamp(t):
+        return dt.TimestampType(t.tz)
+    if pa.types.is_duration(t):
+        return dt.DayTimeIntervalType()
+    if pa.types.is_dictionary(t):
+        return arrow_type_to_spec(t.value_type)
+    if pa.types.is_null(t):
+        return dt.NullType()
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return dt.ArrayType(arrow_type_to_spec(t.value_type))
+    if pa.types.is_struct(t):
+        return dt.StructType(tuple(
+            dt.StructField(f.name, arrow_type_to_spec(f.type), f.nullable)
+            for f in t))
+    if pa.types.is_map(t):
+        return dt.MapType(arrow_type_to_spec(t.key_type), arrow_type_to_spec(t.item_type))
+    raise TypeError(f"unsupported arrow type {t}")
+
+
+def spec_type_to_arrow(d: dt.DataType) -> pa.DataType:
+    if isinstance(d, dt.BooleanType):
+        return pa.bool_()
+    if isinstance(d, dt.ByteType):
+        return pa.int8()
+    if isinstance(d, dt.ShortType):
+        return pa.int16()
+    if isinstance(d, dt.IntegerType):
+        return pa.int32()
+    if isinstance(d, dt.LongType):
+        return pa.int64()
+    if isinstance(d, dt.FloatType):
+        return pa.float32()
+    if isinstance(d, dt.DoubleType):
+        return pa.float64()
+    if isinstance(d, dt.DecimalType):
+        return pa.decimal128(d.precision, d.scale)
+    if isinstance(d, dt.StringType):
+        return pa.string()
+    if isinstance(d, dt.BinaryType):
+        return pa.binary()
+    if isinstance(d, dt.DateType):
+        return pa.date32()
+    if isinstance(d, dt.TimestampType):
+        return pa.timestamp("us", tz=d.timezone)
+    if isinstance(d, dt.DayTimeIntervalType):
+        return pa.duration("us")
+    if isinstance(d, dt.NullType):
+        return pa.null()
+    if isinstance(d, dt.ArrayType):
+        return pa.list_(spec_type_to_arrow(d.element_type))
+    if isinstance(d, dt.StructType):
+        return pa.struct([pa.field(f.name, spec_type_to_arrow(f.data_type), f.nullable)
+                          for f in d.fields])
+    if isinstance(d, dt.MapType):
+        return pa.map_(spec_type_to_arrow(d.key_type), spec_type_to_arrow(d.value_type))
+    raise TypeError(f"unsupported spec type {d}")
+
+
+def _decimal_to_unscaled_int64(arr: pa.Array) -> np.ndarray:
+    """Unscaled int64 values of a decimal128(p<=18) array (zero-copy-ish)."""
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    buf = arr.buffers()[1]
+    raw = np.frombuffer(buf, dtype=np.int64)
+    # decimal128 is 16 bytes LE; low word at even indices (plus array offset)
+    lo = raw[2 * arr.offset::2][: len(arr)]
+    return lo.copy()
+
+
+def _unscaled_int64_to_decimal(vals: np.ndarray, validity: Optional[np.ndarray],
+                               d: dt.DecimalType) -> pa.Array:
+    """Vectorized decimal128 construction from unscaled int64 values:
+    low word = the value, high word = its sign extension."""
+    n = len(vals)
+    words = np.empty((n, 2), dtype=np.int64)
+    words[:, 0] = vals
+    words[:, 1] = vals >> 63  # arithmetic shift: 0 or -1
+    data_buf = pa.py_buffer(words.tobytes())
+    if validity is not None:
+        null_buf = pa.py_buffer(np.packbits(validity.astype(np.uint8), bitorder="little").tobytes())
+    else:
+        null_buf = None
+    return pa.Array.from_buffers(pa.decimal128(d.precision, d.scale), n,
+                                 [null_buf, data_buf])
+
+
+def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> HostBatch:
+    """Convert a pyarrow Table to a HostBatch (uploads to default device)."""
+    n = table.num_rows
+    cap = capacity if capacity is not None else round_capacity(n)
+    columns: Dict[str, Tuple[np.ndarray, Optional[np.ndarray], dt.DataType]] = {}
+    dicts: Dict[str, pa.Array] = {}
+    for name, col in zip(table.column_names, table.columns):
+        spec_t = arrow_type_to_spec(col.type)
+        arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+        validity = None
+        if arr.null_count > 0:
+            validity = np.asarray(pc.is_valid(arr))
+        if pa.types.is_uint64(arr.type):
+            mx = pc.max(arr).as_py()
+            if mx is not None and mx >= 2**63:
+                raise TypeError(
+                    f"column {name!r}: uint64 values >= 2^63 cannot be represented "
+                    f"on device (int64); cast to decimal or string first")
+        if isinstance(spec_t, (dt.StringType, dt.BinaryType)):
+            if pa.types.is_dictionary(arr.type):
+                denc = arr
+            else:
+                denc = pc.dictionary_encode(arr)
+            if isinstance(denc, pa.ChunkedArray):
+                denc = denc.combine_chunks()
+            codes = np.asarray(denc.indices.fill_null(0)).astype(np.int32)
+            dicts[name] = denc.dictionary
+            columns[name] = (codes, validity, spec_t)
+        elif isinstance(spec_t, dt.DecimalType) and spec_t.physical_dtype == "int64":
+            if pa.types.is_decimal256(arr.type):
+                arr = arr.cast(pa.decimal128(spec_t.precision, spec_t.scale))
+            vals = _decimal_to_unscaled_int64(arr)
+            columns[name] = (vals, validity, spec_t)
+        elif isinstance(spec_t, dt.DecimalType):
+            vals = np.asarray(arr.cast(pa.float64()).fill_null(0.0))
+            columns[name] = (vals, validity, spec_t)
+        elif isinstance(spec_t, dt.NullType):
+            columns[name] = (np.zeros(n, dtype=np.int8), np.zeros(n, dtype=bool), spec_t)
+        elif isinstance(spec_t, (dt.ArrayType, dt.StructType, dt.MapType)):
+            # Nested types stay host-side in v0: dictionary-encode the whole
+            # value so the device carries an opaque int32 handle.
+            import pickle
+            py = arr.to_pylist()
+            uniq: Dict[bytes, int] = {}
+            codes = np.empty(n, dtype=np.int32)
+            values = []
+            for i, v in enumerate(py):
+                k = pickle.dumps(v)
+                if k not in uniq:
+                    uniq[k] = len(values)
+                    values.append(v)
+                codes[i] = uniq[k]
+            dicts[name] = pa.array(values, type=arr.type)
+            columns[name] = (codes, validity, spec_t)
+        else:
+            # Temporal types upload as their epoch integers.
+            if isinstance(spec_t, dt.DateType):
+                if pa.types.is_date64(arr.type):
+                    arr = arr.cast(pa.date32())
+                arr = arr.view(pa.int32())
+            elif isinstance(spec_t, dt.TimestampType):
+                arr = arr.cast(pa.timestamp("us", tz=arr.type.tz)).view(pa.int64())
+            elif isinstance(spec_t, dt.DayTimeIntervalType):
+                arr = arr.cast(pa.duration("us")).view(pa.int64())
+            fill = False if pa.types.is_boolean(arr.type) else 0
+            np_vals = np.asarray(arr.fill_null(fill) if arr.null_count else arr)
+            columns[name] = (np_vals, validity, spec_t)
+    device = make_batch(columns, n, cap)
+    return HostBatch(device, dicts)
+
+
+def to_arrow(batch: HostBatch) -> pa.Table:
+    """Download a HostBatch to a pyarrow Table (live rows only, in order)."""
+    dev = batch.device
+    sel = np.asarray(dev.sel)
+    idx = np.nonzero(sel)[0]
+    arrays = []
+    fields = []
+    for name, col in dev.columns.items():
+        data = np.asarray(col.data)[idx]
+        validity = None if col.validity is None else np.asarray(col.validity)[idx]
+        d = col.dtype
+        if isinstance(d, (dt.StringType, dt.BinaryType)) and name in batch.dicts:
+            dictionary = batch.dicts[name]
+            codes = pa.array(data.astype(np.int32),
+                             mask=None if validity is None else ~validity)
+            arr = pa.DictionaryArray.from_arrays(codes, dictionary).cast(
+                pa.string() if isinstance(d, dt.StringType) else pa.binary())
+        elif isinstance(d, (dt.ArrayType, dt.StructType, dt.MapType)) and name in batch.dicts:
+            dictionary = batch.dicts[name]
+            codes = pa.array(data.astype(np.int32),
+                             mask=None if validity is None else ~validity)
+            arr = pa.DictionaryArray.from_arrays(codes, dictionary).cast(dictionary.type)
+        elif isinstance(d, dt.DecimalType) and d.physical_dtype == "int64":
+            arr = _unscaled_int64_to_decimal(data, validity, d)
+        elif isinstance(d, dt.DecimalType):
+            arr = pa.array(data, mask=None if validity is None else ~validity)
+            arr = arr.cast(pa.decimal128(d.precision, d.scale), safe=False)
+        elif isinstance(d, dt.NullType):
+            arr = pa.nulls(len(data))
+        else:
+            at = spec_type_to_arrow(d)
+            if isinstance(d, dt.TimestampType):
+                arr = pa.array(data.astype("datetime64[us]"),
+                               mask=None if validity is None else ~validity).cast(at)
+            elif isinstance(d, dt.DateType):
+                arr = pa.array(data.astype(np.int32),
+                               mask=None if validity is None else ~validity).cast(at)
+            elif isinstance(d, dt.DayTimeIntervalType):
+                arr = pa.array(data.astype("timedelta64[us]"),
+                               mask=None if validity is None else ~validity)
+            else:
+                arr = pa.array(data, mask=None if validity is None else ~validity)
+                if arr.type != at:
+                    arr = arr.cast(at, safe=False)
+        arrays.append(arr)
+        fields.append(pa.field(name, arrays[-1].type, nullable=True))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def unify_dictionaries(dict_a: pa.Array, dict_b: pa.Array) -> Tuple[pa.Array, np.ndarray, np.ndarray]:
+    """Merge two dictionaries; returns (merged, remap_a, remap_b) where
+    remap_x maps old codes → merged codes. Used before joins/unions on
+    string columns so device-side code comparison is exact."""
+    merged_tbl = pa.concat_arrays([dict_a.cast(pa.string()), dict_b.cast(pa.string())])
+    enc = pc.dictionary_encode(merged_tbl)
+    if isinstance(enc, pa.ChunkedArray):
+        enc = enc.combine_chunks()
+    codes = np.asarray(enc.indices)
+    remap_a = codes[: len(dict_a)].astype(np.int32)
+    remap_b = codes[len(dict_a):].astype(np.int32)
+    return enc.dictionary, remap_a, remap_b
+
+
+def dictionary_ranks(dictionary: pa.Array) -> np.ndarray:
+    """Order-preserving rank per dictionary code (for ORDER BY / range
+    comparisons on dictionary-encoded strings)."""
+    order = pc.sort_indices(dictionary)
+    ranks = np.empty(len(dictionary), dtype=np.int32)
+    ranks[np.asarray(order)] = np.arange(len(dictionary), dtype=np.int32)
+    return ranks

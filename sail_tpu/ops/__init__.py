@@ -1,0 +1,8 @@
+"""Device-side relational kernels (jit-compiled, static-shape).
+
+The TPU-native counterpart of DataFusion's physical operators + arrow-rs
+compute kernels (SURVEY.md §2.4-2.6): sort/compact/limit, sort-based
+grouped aggregation, sort-probe equi-joins, key hashing/packing.
+"""
+
+from . import aggregate, hash, join, sort  # noqa: F401

@@ -1,0 +1,220 @@
+"""Grouped aggregation kernels (sort + segmented reduction).
+
+TPU-first design for GROUP BY: instead of a scatter-probe hash table (the
+DataFusion approach — SURVEY.md §2.4; serializes on TPU), rows are sorted
+by their group key and reduced with ``jax.ops.segment_*`` primitives, which
+XLA lowers to parallel scans. The number of output group slots is a static
+capacity; the live group count is dynamic and exported via the output
+selection mask.
+
+NULL semantics follow Spark: null group keys form their own group; null
+values are skipped by aggregates; COUNT(*) counts rows, COUNT(x) counts
+non-null x; SUM over an all-null group is NULL; MIN/MAX ignore nulls.
+
+Planner-level rewrites decompose compound aggregates before reaching this
+kernel: AVG → SUM/COUNT, VAR/STD → SUM/SUM2/COUNT, COUNT(DISTINCT) →
+two-level group-by.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import Column, DeviceBatch
+from ..spec import data_type as dt
+from .hash import can_pack, pack_keys
+from .sort import order_bits
+
+
+def _group_sort_perm(key_cols: Sequence[Column], sel) -> jnp.ndarray:
+    """Sort permutation grouping equal keys together, dead rows last."""
+    n = sel.shape[0]
+    types = [c.dtype for c in key_cols]
+    if can_pack(types, reserve_bits=len(key_cols) + 1):
+        # Fast path: one argsort over a packed key with null flags folded in.
+        datas = []
+        for c in key_cols:
+            datas.append(jnp.where(c.validity, c.data, jnp.zeros_like(c.data))
+                         if c.validity is not None else c.data)
+        packed = pack_keys(datas, types)
+        shift = 64 - (len(key_cols) + 1)
+        packed = packed & jnp.uint64((1 << shift) - 1)
+        for i, c in enumerate(key_cols):
+            if c.validity is not None:
+                packed = packed | (jnp.where(c.validity, jnp.uint64(0), jnp.uint64(1))
+                                   << jnp.uint64(shift + i))
+        packed = jnp.where(sel, packed, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        return jnp.argsort(packed, stable=True).astype(jnp.int32)
+    perm = jnp.arange(n, dtype=jnp.int32)
+    for c in reversed(list(key_cols)):
+        bits = order_bits(c.data, c.dtype)
+        perm = perm[jnp.argsort(bits[perm], stable=True)]
+        if c.validity is not None:
+            perm = perm[jnp.argsort(c.validity[perm].astype(jnp.uint8), stable=True)]
+    dead = (~sel).astype(jnp.uint8)
+    return perm[jnp.argsort(dead[perm], stable=True)].astype(jnp.int32)
+
+
+def _keys_equal_adjacent(sorted_keys: Sequence[Column]) -> jnp.ndarray:
+    """eq[i] = row i has the same group key as row i-1 (eq[0] = False)."""
+    n = sorted_keys[0].data.shape[0]
+    eq = jnp.ones(n, dtype=jnp.bool_)
+    for c in sorted_keys:
+        prev = jnp.roll(c.data, 1)
+        same_val = c.data == prev
+        if jnp.issubdtype(c.data.dtype, jnp.floating):
+            # Spark groups all NaNs together (and -0.0 with 0.0; == covers it)
+            same_val = same_val | (jnp.isnan(c.data) & jnp.isnan(prev))
+        if c.validity is not None:
+            prev_v = jnp.roll(c.validity, 1)
+            same = (same_val & c.validity & prev_v) | (~c.validity & ~prev_v)
+        else:
+            same = same_val
+        eq = eq & same
+    return eq.at[0].set(False)
+
+
+class GroupContext:
+    """Sorted input + segment ids, shared by all aggregate columns."""
+
+    def __init__(self, perm, seg_ids, alive_sorted, num_groups, max_groups):
+        self.perm = perm
+        self.seg_ids = seg_ids            # int32[n], dead rows → max_groups
+        self.alive_sorted = alive_sorted  # bool[n]
+        self.num_groups = num_groups      # dynamic scalar
+        self.max_groups = max_groups      # static
+
+
+def group_rows(key_cols: Sequence[Column], sel, max_groups: int) -> Tuple[GroupContext, List[Column]]:
+    """Sort rows by group key; return context + sorted key columns."""
+    if not key_cols:
+        n = sel.shape[0]
+        perm = jnp.arange(n, dtype=jnp.int32)
+        seg = jnp.where(sel, 0, max_groups).astype(jnp.int32)
+        return GroupContext(perm, seg, sel, jnp.int32(1), max_groups), []
+    perm = _group_sort_perm(key_cols, sel)
+    sorted_keys = [Column(c.data[perm],
+                          None if c.validity is None else c.validity[perm],
+                          c.dtype) for c in key_cols]
+    alive = sel[perm]
+    eq = _keys_equal_adjacent(sorted_keys)
+    new_group = alive & ~eq
+    seg = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    seg = jnp.where(alive, jnp.clip(seg, 0, max_groups), max_groups).astype(jnp.int32)
+    num_groups = jnp.sum(new_group.astype(jnp.int32))
+    return GroupContext(perm, seg, alive, num_groups, max_groups), sorted_keys
+
+
+def group_key_output(ctx: GroupContext, sorted_keys: Sequence[Column]) -> List[Column]:
+    """Representative key values per group (first row of each segment)."""
+    n = ctx.seg_ids.shape[0]
+    first_idx = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), ctx.seg_ids,
+                                    num_segments=ctx.max_groups + 1)[: ctx.max_groups]
+    first_idx = jnp.clip(first_idx, 0, n - 1)
+    out = []
+    for c in sorted_keys:
+        data = c.data[first_idx]
+        validity = None if c.validity is None else c.validity[first_idx]
+        out.append(Column(data, validity, c.dtype))
+    return out
+
+
+def group_sel(ctx: GroupContext) -> jnp.ndarray:
+    return jnp.arange(ctx.max_groups, dtype=jnp.int32) < ctx.num_groups
+
+
+def group_overflow(ctx: GroupContext) -> jnp.ndarray:
+    """Device scalar: the input had more distinct groups than max_groups and
+    the output is truncated. The executor must host-check this whenever it
+    chose max_groups smaller than the input capacity, and re-run with a
+    larger capacity."""
+    return ctx.num_groups > ctx.max_groups
+
+
+def _masked(vals, mask, fill):
+    return jnp.where(mask, vals, jnp.full_like(vals, fill))
+
+
+def agg_count(ctx: GroupContext, value: Optional[Column]) -> Column:
+    """COUNT(*) when value is None, else COUNT(value)."""
+    mask = ctx.alive_sorted
+    if value is not None and value.validity is not None:
+        mask = mask & value.validity[ctx.perm]
+    ones = mask.astype(jnp.int64)
+    out = jax.ops.segment_sum(ones, ctx.seg_ids, num_segments=ctx.max_groups + 1)
+    return Column(out[: ctx.max_groups], None, dt.LongType())
+
+
+def agg_sum(ctx: GroupContext, value: Column, out_type: dt.DataType) -> Column:
+    vals = value.data[ctx.perm]
+    mask = ctx.alive_sorted
+    if value.validity is not None:
+        mask = mask & value.validity[ctx.perm]
+    odt = jnp.dtype(out_type.physical_dtype)
+    vals = _masked(vals.astype(odt), mask, 0)
+    out = jax.ops.segment_sum(vals, ctx.seg_ids, num_segments=ctx.max_groups + 1)
+    cnt = jax.ops.segment_sum(mask.astype(jnp.int32), ctx.seg_ids,
+                              num_segments=ctx.max_groups + 1)
+    return Column(out[: ctx.max_groups], cnt[: ctx.max_groups] > 0, out_type)
+
+
+def _extreme_for(dtype_np, is_min: bool):
+    if jnp.issubdtype(dtype_np, jnp.floating):
+        return jnp.inf if is_min else -jnp.inf
+    info = jnp.iinfo(dtype_np)
+    return info.max if is_min else info.min
+
+
+def agg_min_max(ctx: GroupContext, value: Column, is_min: bool) -> Column:
+    vals = value.data[ctx.perm]
+    mask = ctx.alive_sorted
+    if value.validity is not None:
+        mask = mask & value.validity[ctx.perm]
+    if vals.dtype == jnp.bool_:
+        vals = vals.astype(jnp.int8)
+    fill = _extreme_for(vals.dtype, is_min)
+    vals = _masked(vals, mask, fill)
+    fn = jax.ops.segment_min if is_min else jax.ops.segment_max
+    out = fn(vals, ctx.seg_ids, num_segments=ctx.max_groups + 1)[: ctx.max_groups]
+    cnt = jax.ops.segment_sum(mask.astype(jnp.int32), ctx.seg_ids,
+                              num_segments=ctx.max_groups + 1)[: ctx.max_groups]
+    if value.data.dtype == jnp.bool_:
+        out = out.astype(jnp.bool_)
+    return Column(out, cnt > 0, value.dtype)
+
+
+def agg_first_last(ctx: GroupContext, value: Column, is_first: bool,
+                   ignore_nulls: bool = True) -> Column:
+    n = ctx.seg_ids.shape[0]
+    mask = ctx.alive_sorted
+    if ignore_nulls and value.validity is not None:
+        mask = mask & value.validity[ctx.perm]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sentinel = n if is_first else -1
+    idx_m = _masked(idx, mask, sentinel)
+    fn = jax.ops.segment_min if is_first else jax.ops.segment_max
+    pos = fn(idx_m, ctx.seg_ids, num_segments=ctx.max_groups + 1)[: ctx.max_groups]
+    has = (pos < n) if is_first else (pos >= 0)
+    pos = jnp.clip(pos, 0, n - 1)
+    vals = value.data[ctx.perm][pos]
+    validity = has
+    if value.validity is not None:
+        validity = validity & value.validity[ctx.perm][pos]
+    return Column(vals, validity, value.dtype)
+
+
+def agg_bool(ctx: GroupContext, value: Column, is_any: bool) -> Column:
+    vals = value.data[ctx.perm].astype(jnp.int8)
+    mask = ctx.alive_sorted
+    if value.validity is not None:
+        mask = mask & value.validity[ctx.perm]
+    fill = 0 if is_any else 1
+    vals = _masked(vals, mask, fill)
+    fn = jax.ops.segment_max if is_any else jax.ops.segment_min
+    out = fn(vals, ctx.seg_ids, num_segments=ctx.max_groups + 1)[: ctx.max_groups]
+    cnt = jax.ops.segment_sum(mask.astype(jnp.int32), ctx.seg_ids,
+                              num_segments=ctx.max_groups + 1)[: ctx.max_groups]
+    return Column(out.astype(jnp.bool_), cnt > 0, dt.BooleanType())

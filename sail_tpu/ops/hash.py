@@ -1,0 +1,101 @@
+"""Key hashing / packing kernels.
+
+Used for shuffle partitioning, hash joins, and group-by keys. On TPU the
+VPU has no native 64-bit multiply-heavy hash, so the mixers below stick to
+shifts/xors/adds plus 32-bit multiplies, which lower cleanly. When a set of
+key columns fits losslessly in 64 bits they are *packed* instead of hashed,
+making sort-based joins and aggregations exact (no collision handling).
+
+Reference role: hash repartitioning in shuffle_write (InputMode::Shuffle /
+OutputDistribution::Hash, crates/sail-execution/src/plan/shuffle_write.rs)
+and DataFusion's hash join/aggregate — here re-designed as sort/pack
+kernels, which map better to XLA than scatter-probe hash tables.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..spec import data_type as dt
+
+# Bit width of each physical dtype when used as a join/group key.
+_KEY_BITS = {
+    "bool": 1,
+    "int8": 8,
+    "int16": 16,
+    "int32": 32,
+    "int64": 64,
+    "float32": 32,
+    "float64": 64,
+}
+
+
+def key_bits(d: dt.DataType) -> int:
+    return _KEY_BITS[d.physical_dtype]
+
+
+def can_pack(types: Sequence[dt.DataType], reserve_bits: int = 1) -> bool:
+    """True if the key columns (plus ``reserve_bits`` for null/sel flags)
+    fit losslessly in a single int64 sort key."""
+    try:
+        total = sum(key_bits(t) for t in types)
+    except KeyError:
+        return False
+    return total + reserve_bits <= 64
+
+
+def _normalize_float(data):
+    """Spark key semantics: -0.0 keys equal 0.0, and all NaNs are one value."""
+    data = data + jnp.zeros_like(data)  # -0.0 + 0.0 == +0.0
+    return jnp.where(jnp.isnan(data), jnp.full_like(data, jnp.nan), data)
+
+
+def _to_bits(data, d: dt.DataType):
+    """Map a column to unsigned key bits preserving equality."""
+    pd = d.physical_dtype
+    if pd == "bool":
+        return data.astype(jnp.uint64) & jnp.uint64(1)
+    if pd in ("int8", "int16", "int32", "int64"):
+        bits = _KEY_BITS[pd]
+        u = data.astype(jnp.int64).astype(jnp.uint64)
+        if bits < 64:
+            u = u & jnp.uint64((1 << bits) - 1)
+        return u
+    if pd == "float32":
+        return jax.lax.bitcast_convert_type(
+            _normalize_float(data.astype(jnp.float32)), jnp.uint32).astype(jnp.uint64)
+    if pd == "float64":
+        return jax.lax.bitcast_convert_type(_normalize_float(data.astype(jnp.float64)), jnp.uint64)
+    raise TypeError(pd)
+
+
+def pack_keys(columns, types: Sequence[dt.DataType]) -> jnp.ndarray:
+    """Pack key columns into one uint64. Null/dead rows are NOT encoded here;
+    callers combine with validity separately. Requires can_pack(types)."""
+    acc = jnp.zeros(columns[0].shape[0], dtype=jnp.uint64)
+    for data, d in zip(columns, types):
+        bits = key_bits(d)
+        acc = (acc << jnp.uint64(bits)) | _to_bits(data, d)
+    return acc
+
+
+def hash64(columns, types: Sequence[dt.DataType], seed: int = 0) -> jnp.ndarray:
+    """64-bit mixing hash over key columns (splitmix64-style finalizer)."""
+    acc = jnp.full(columns[0].shape[0], jnp.uint64(0x9E3779B97F4A7C15 ^ seed), dtype=jnp.uint64)
+    for data, d in zip(columns, types):
+        x = _to_bits(data, d)
+        x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> jnp.uint64(31))
+        acc = (acc ^ x) * jnp.uint64(0x9E3779B97F4A7C15)
+        acc = acc ^ (acc >> jnp.uint64(29))
+    return acc
+
+
+def hash_partition_ids(columns, types: Sequence[dt.DataType], num_partitions: int) -> jnp.ndarray:
+    """Partition id per row for hash shuffle (int32 in [0, num_partitions))."""
+    h = hash64(columns, types)
+    return (h % jnp.uint64(num_partitions)).astype(jnp.int32)

@@ -1,0 +1,14 @@
+"""Session entry point (placeholder; filled in by the planner/executor layer).
+
+Mirrors the role of the reference's SessionManager + SparkSession surface
+(crates/sail-session, crates/sail-spark-connect/src/session.rs).
+"""
+
+from __future__ import annotations
+
+
+class SparkSession:
+    """Will be replaced by the full session implementation."""
+
+    def __init__(self):
+        raise NotImplementedError("session layer lands with the planner")

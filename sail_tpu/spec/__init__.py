@@ -1,0 +1,33 @@
+"""The spec IR: the protocol-independent intermediate representation that all
+front-ends (SQL, DataFrame API, Spark Connect) lower into, and that the plan
+resolver consumes (reference role: crates/sail-common/src/spec/)."""
+
+from .data_type import (  # noqa: F401
+    ArrayType,
+    BinaryType,
+    BooleanType,
+    ByteType,
+    CalendarIntervalType,
+    DataType,
+    DateType,
+    DayTimeIntervalType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    MapType,
+    NullType,
+    Schema,
+    ShortType,
+    StringType,
+    StructField,
+    StructType,
+    TimestampType,
+    YearMonthIntervalType,
+    common_type,
+)
+from .literal import Literal as LiteralValue  # noqa: F401
+from . import expression  # noqa: F401
+from . import plan  # noqa: F401
+from .expression import col, lit  # noqa: F401

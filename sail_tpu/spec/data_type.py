@@ -1,0 +1,365 @@
+"""Logical data types for the sail-tpu spec IR.
+
+Mirrors the role of the reference's ``sail-common`` spec data types
+(reference: crates/sail-common/src/spec/data_type.rs), re-designed for a
+TPU-native engine: every logical type declares its *device representation*
+(``physical_dtype``) — the fixed-width JAX dtype its values occupy in HBM —
+or ``None`` when values stay host-side (variable-width data is
+dictionary-encoded to int32 codes on device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class DataType:
+    """Base class for all logical types."""
+
+    def simple_string(self) -> str:
+        return type(self).__name__.lower()
+
+    @property
+    def is_numeric(self) -> bool:
+        return False
+
+    @property
+    def is_integer(self) -> bool:
+        return False
+
+    @property
+    def is_floating(self) -> bool:
+        return False
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        """JAX dtype name of the on-device representation, or None if host-only."""
+        return None
+
+
+@dataclass(frozen=True)
+class NullType(DataType):
+    def simple_string(self) -> str:
+        return "void"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int8"
+
+
+@dataclass(frozen=True)
+class BooleanType(DataType):
+    def simple_string(self) -> str:
+        return "boolean"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "bool"
+
+
+@dataclass(frozen=True)
+class _IntegerType(DataType):
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    @property
+    def is_integer(self) -> bool:
+        return True
+
+
+@dataclass(frozen=True)
+class ByteType(_IntegerType):
+    def simple_string(self) -> str:
+        return "tinyint"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int8"
+
+
+@dataclass(frozen=True)
+class ShortType(_IntegerType):
+    def simple_string(self) -> str:
+        return "smallint"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int16"
+
+
+@dataclass(frozen=True)
+class IntegerType(_IntegerType):
+    def simple_string(self) -> str:
+        return "int"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int32"
+
+
+@dataclass(frozen=True)
+class LongType(_IntegerType):
+    def simple_string(self) -> str:
+        return "bigint"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int64"
+
+
+@dataclass(frozen=True)
+class FloatType(DataType):
+    def simple_string(self) -> str:
+        return "float"
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    @property
+    def is_floating(self) -> bool:
+        return True
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "float32"
+
+
+@dataclass(frozen=True)
+class DoubleType(DataType):
+    def simple_string(self) -> str:
+        return "double"
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    @property
+    def is_floating(self) -> bool:
+        return True
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "float64"
+
+
+@dataclass(frozen=True)
+class DecimalType(DataType):
+    """Fixed-point decimal.
+
+    Device representation: scaled int64 (decimal128 narrowed; precision > 18
+    falls back to float64 on device in v0 — tracked limitation).
+    """
+
+    precision: int = 10
+    scale: int = 0
+
+    def simple_string(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int64" if self.precision <= 18 else "float64"
+
+
+@dataclass(frozen=True)
+class StringType(DataType):
+    """UTF-8 string. Device representation: int32 dictionary codes; the
+    dictionary itself (Arrow StringArray) stays on host."""
+
+    def simple_string(self) -> str:
+        return "string"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int32"
+
+
+@dataclass(frozen=True)
+class BinaryType(DataType):
+    def simple_string(self) -> str:
+        return "binary"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int32"
+
+
+@dataclass(frozen=True)
+class DateType(DataType):
+    """Days since UNIX epoch (Arrow date32)."""
+
+    def simple_string(self) -> str:
+        return "date"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int32"
+
+
+@dataclass(frozen=True)
+class TimestampType(DataType):
+    """Microseconds since UNIX epoch; ``timezone=None`` means timestamp_ntz."""
+
+    timezone: Optional[str] = "UTC"
+
+    def simple_string(self) -> str:
+        return "timestamp" if self.timezone else "timestamp_ntz"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int64"
+
+
+@dataclass(frozen=True)
+class DayTimeIntervalType(DataType):
+    """Microsecond-resolution interval (Spark DayTimeIntervalType)."""
+
+    start_field: int = 0  # DAY
+    end_field: int = 3  # SECOND
+
+    def simple_string(self) -> str:
+        return "interval day to second"
+
+    @property
+    def is_numeric(self) -> bool:
+        return False
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int64"
+
+
+@dataclass(frozen=True)
+class YearMonthIntervalType(DataType):
+    start_field: int = 0  # YEAR
+    end_field: int = 1  # MONTH
+
+    def simple_string(self) -> str:
+        return "interval year to month"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int32"
+
+
+@dataclass(frozen=True)
+class CalendarIntervalType(DataType):
+    def simple_string(self) -> str:
+        return "interval"
+
+
+@dataclass(frozen=True)
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+    metadata: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass(frozen=True)
+class StructType(DataType):
+    fields: Tuple[StructField, ...] = ()
+
+    def simple_string(self) -> str:
+        inner = ",".join(f"{f.name}:{f.data_type.simple_string()}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def field_names(self) -> Tuple[str, ...]:
+        return tuple(f.name for f in self.fields)
+
+    def field(self, name: str) -> StructField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+
+@dataclass(frozen=True)
+class ArrayType(DataType):
+    element_type: DataType = field(default_factory=lambda: NullType())
+    contains_null: bool = True
+
+    def simple_string(self) -> str:
+        return f"array<{self.element_type.simple_string()}>"
+
+
+@dataclass(frozen=True)
+class MapType(DataType):
+    key_type: DataType = field(default_factory=lambda: NullType())
+    value_type: DataType = field(default_factory=lambda: NullType())
+    value_contains_null: bool = True
+
+    def simple_string(self) -> str:
+        return f"map<{self.key_type.simple_string()},{self.value_type.simple_string()}>"
+
+
+# Schema is just a struct at top level, as in Spark.
+Schema = StructType
+
+
+# ---------------------------------------------------------------------------
+# Type lattice helpers (Spark's implicit cast / common-type rules, simplified)
+# ---------------------------------------------------------------------------
+
+_NUMERIC_ORDER = {
+    "ByteType": 0,
+    "ShortType": 1,
+    "IntegerType": 2,
+    "LongType": 3,
+    "DecimalType": 4,
+    "FloatType": 5,
+    "DoubleType": 6,
+}
+
+
+def common_type(a: DataType, b: DataType) -> DataType:
+    """Least common type for binary expressions (simplified Spark coercion)."""
+    if a == b:
+        return a
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
+    an, bn = type(a).__name__, type(b).__name__
+    if an in _NUMERIC_ORDER and bn in _NUMERIC_ORDER:
+        # Decimal + float → double; otherwise wider wins.
+        if {an, bn} & {"FloatType", "DoubleType"} and "DecimalType" in {an, bn}:
+            return DoubleType()
+        if an == "DecimalType" and bn == "DecimalType":
+            assert isinstance(a, DecimalType) and isinstance(b, DecimalType)
+            int_digits = max(a.precision - a.scale, b.precision - b.scale)
+            scale = max(a.scale, b.scale)
+            return DecimalType(min(int_digits + scale, 38), scale)
+        if an == "DecimalType":
+            assert isinstance(a, DecimalType)
+            return a if _NUMERIC_ORDER[bn] < _NUMERIC_ORDER["DecimalType"] else b
+        if bn == "DecimalType":
+            assert isinstance(b, DecimalType)
+            return b if _NUMERIC_ORDER[an] < _NUMERIC_ORDER["DecimalType"] else a
+        return a if _NUMERIC_ORDER[an] >= _NUMERIC_ORDER[bn] else b
+    if isinstance(a, StringType) and b.is_numeric:
+        return DoubleType()
+    if isinstance(b, StringType) and a.is_numeric:
+        return DoubleType()
+    if isinstance(a, DateType) and isinstance(b, TimestampType):
+        return b
+    if isinstance(a, TimestampType) and isinstance(b, DateType):
+        return a
+    if isinstance(a, StringType) and isinstance(b, (DateType, TimestampType)):
+        return b
+    if isinstance(b, StringType) and isinstance(a, (DateType, TimestampType)):
+        return a
+    raise TypeError(f"no common type for {a.simple_string()} and {b.simple_string()}")
+
+
+def replace(dt, **kwargs):
+    return dataclasses.replace(dt, **kwargs)

@@ -1,0 +1,194 @@
+"""Unresolved expression IR.
+
+Mirrors the role of the reference's expression spec
+(reference: crates/sail-common/src/spec/expression.rs). Operators are
+represented as ``Function`` nodes (e.g. ``+`` → ``Function("+", [l, r])``),
+matching Spark Connect's unresolved-function convention; the resolver binds
+them against the function registry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from .data_type import DataType
+from .literal import Literal as LiteralValue
+
+
+@dataclass(frozen=True)
+class Expr:
+    """Base class for unresolved expressions."""
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: LiteralValue
+
+
+@dataclass(frozen=True)
+class Attribute(Expr):
+    """Unresolved column reference; ``name`` may be multi-part (a.b.c)."""
+
+    name: Tuple[str, ...]
+    plan_id: Optional[int] = None
+
+    def last(self) -> str:
+        return self.name[-1]
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    """``*`` or ``qualifier.*``"""
+
+    target: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Function(Expr):
+    name: str
+    args: Tuple[Expr, ...] = ()
+    is_distinct: bool = False
+    filter: Optional[Expr] = None  # FILTER (WHERE ...) clause on aggregates
+    ignore_nulls: Optional[bool] = None
+
+
+@dataclass(frozen=True)
+class Alias(Expr):
+    child: Expr
+    name: Tuple[str, ...]
+    metadata: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    child: Expr
+    data_type: DataType
+    try_: bool = False
+
+
+@dataclass(frozen=True)
+class SortOrder(Expr):
+    child: Expr
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None → Spark default (first if asc)
+
+
+@dataclass(frozen=True)
+class CaseWhen(Expr):
+    branches: Tuple[Tuple[Expr, Expr], ...]  # (condition, value)
+    else_value: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    child: Expr
+    values: Tuple[Expr, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    child: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Like(Expr):
+    child: Expr
+    pattern: Expr
+    negated: bool = False
+    case_insensitive: bool = False
+    escape: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Exists(Expr):
+    """EXISTS (subquery); ``plan`` is a spec QueryPlan (forward ref)."""
+
+    plan: object
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    plan: object
+
+
+@dataclass(frozen=True)
+class InSubquery(Expr):
+    child: Expr
+    plan: object
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class WindowFrame:
+    """Window frame boundaries. ``None`` bound means UNBOUNDED."""
+
+    frame_type: str = "rows"  # "rows" | "range"
+    lower: Optional[int] = None  # negative = preceding
+    upper: Optional[int] = 0  # 0 = current row
+
+
+@dataclass(frozen=True)
+class Window(Expr):
+    function: Expr
+    partition_by: Tuple[Expr, ...] = ()
+    order_by: Tuple[SortOrder, ...] = ()
+    frame: Optional[WindowFrame] = None
+
+
+@dataclass(frozen=True)
+class LambdaFunction(Expr):
+    body: Expr
+    arguments: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class LambdaVariable(Expr):
+    name: str
+
+
+@dataclass(frozen=True)
+class Extract(Expr):
+    """EXTRACT(field FROM source)."""
+
+    field_name: str
+    child: Expr
+
+
+# -- convenience builders ---------------------------------------------------
+
+def col(*parts: str) -> Attribute:
+    return Attribute(tuple(parts))
+
+
+def lit(v) -> Literal:
+    import datetime
+    import decimal as _dec
+
+    if isinstance(v, LiteralValue):
+        return Literal(v)
+    if v is None:
+        return Literal(LiteralValue.null())
+    if isinstance(v, bool):
+        return Literal(LiteralValue.boolean(v))
+    if isinstance(v, int):
+        return Literal(LiteralValue.int32(v) if -(2**31) <= v < 2**31 else LiteralValue.int64(v))
+    if isinstance(v, float):
+        return Literal(LiteralValue.float64(v))
+    if isinstance(v, str):
+        return Literal(LiteralValue.string(v))
+    if isinstance(v, _dec.Decimal):
+        sign, digits, exp = v.as_tuple()
+        scale = max(0, -int(exp))
+        precision = max(len(digits) + max(0, int(exp)), scale + 1)
+        return Literal(LiteralValue.decimal(v, precision, scale))
+    if isinstance(v, datetime.datetime):
+        return Literal(LiteralValue.timestamp(v))
+    if isinstance(v, datetime.date):
+        return Literal(LiteralValue.date(v))
+    raise TypeError(f"cannot convert {type(v)} to literal")

@@ -1,0 +1,99 @@
+"""Literal values for the spec IR.
+
+Mirrors the role of the reference's literal spec
+(reference: crates/sail-common/src/spec/literal.rs), as a single tagged
+dataclass instead of 30+ variants: the logical type carries the tag.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .data_type import (
+    BooleanType,
+    DataType,
+    DateType,
+    DayTimeIntervalType,
+    DecimalType,
+    DoubleType,
+    IntegerType,
+    LongType,
+    NullType,
+    StringType,
+    TimestampType,
+)
+
+
+@dataclass(frozen=True)
+class Literal:
+    data_type: DataType
+    value: Any  # None means NULL of data_type
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def null(dt: Optional[DataType] = None) -> "Literal":
+        return Literal(dt or NullType(), None)
+
+    @staticmethod
+    def boolean(v: bool) -> "Literal":
+        return Literal(BooleanType(), bool(v))
+
+    @staticmethod
+    def int32(v: int) -> "Literal":
+        return Literal(IntegerType(), int(v))
+
+    @staticmethod
+    def int64(v: int) -> "Literal":
+        return Literal(LongType(), int(v))
+
+    @staticmethod
+    def float64(v: float) -> "Literal":
+        return Literal(DoubleType(), float(v))
+
+    @staticmethod
+    def string(v: str) -> "Literal":
+        return Literal(StringType(), str(v))
+
+    @staticmethod
+    def decimal(v: decimal.Decimal, precision: int, scale: int) -> "Literal":
+        return Literal(DecimalType(precision, scale), v)
+
+    @staticmethod
+    def date(v: datetime.date) -> "Literal":
+        return Literal(DateType(), v)
+
+    @staticmethod
+    def timestamp(v: datetime.datetime, tz: Optional[str] = "UTC") -> "Literal":
+        return Literal(TimestampType(tz), v)
+
+    @staticmethod
+    def interval_microseconds(us: int) -> "Literal":
+        return Literal(DayTimeIntervalType(), int(us))
+
+    # -- device value -------------------------------------------------------
+    def physical_value(self):
+        """The value as stored on device (epoch days/us, scaled decimal int)."""
+        if self.value is None:
+            return None
+        if isinstance(self.data_type, DateType):
+            return (self.value - datetime.date(1970, 1, 1)).days
+        if isinstance(self.data_type, TimestampType):
+            v = self.value
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=datetime.timezone.utc)
+            return int(v.timestamp() * 1_000_000)
+        if isinstance(self.data_type, DecimalType):
+            if self.data_type.physical_dtype == "int64":
+                return int(
+                    decimal.Decimal(self.value).scaleb(self.data_type.scale)
+                    .to_integral_value(rounding=decimal.ROUND_HALF_UP)
+                )
+            return float(self.value)
+        return self.value

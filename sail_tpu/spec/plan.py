@@ -1,0 +1,404 @@
+"""Unresolved plan IR (queries and commands).
+
+Mirrors the role of the reference's plan spec — 55 query-node and 67
+command-node variants (reference: crates/sail-common/src/spec/plan.rs:75-553).
+This v0 covers the relational core plus common commands; the inventory grows
+with each subsystem (streaming, lakehouse DML, catalog commands).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from .data_type import DataType, Schema
+from .expression import Expr, SortOrder
+
+
+@dataclass(frozen=True)
+class Plan:
+    """Top-level plan: either a query or a command."""
+
+
+@dataclass(frozen=True)
+class QueryPlan(Plan):
+    """Base for relational query nodes."""
+
+
+# ---------------------------------------------------------------------------
+# Leaf nodes
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ReadNamedTable(QueryPlan):
+    name: Tuple[str, ...]
+    temporal: Optional[str] = None  # time-travel spec
+    options: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass(frozen=True)
+class ReadDataSource(QueryPlan):
+    format: str
+    paths: Tuple[str, ...] = ()
+    schema: Optional[Schema] = None
+    options: Tuple[Tuple[str, str], ...] = ()
+    predicates: Tuple[Expr, ...] = ()
+
+
+@dataclass(frozen=True)
+class ReadUdtf(QueryPlan):
+    name: str
+    args: Tuple[Expr, ...] = ()
+
+
+@dataclass(frozen=True)
+class LocalRelation(QueryPlan):
+    """In-memory data; ``data`` is Arrow IPC bytes or a host table handle."""
+
+    data: object = None
+    schema: Optional[Schema] = None
+
+
+@dataclass(frozen=True)
+class Range(QueryPlan):
+    start: int = 0
+    end: int = 0
+    step: int = 1
+    num_partitions: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class Values(QueryPlan):
+    rows: Tuple[Tuple[Expr, ...], ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# Unary nodes
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Project(QueryPlan):
+    input: Optional[QueryPlan]
+    expressions: Tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class Filter(QueryPlan):
+    input: QueryPlan
+    condition: Expr
+
+
+@dataclass(frozen=True)
+class Sort(QueryPlan):
+    input: QueryPlan
+    order: Tuple[SortOrder, ...]
+    is_global: bool = True
+
+
+@dataclass(frozen=True)
+class Limit(QueryPlan):
+    input: QueryPlan
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+@dataclass(frozen=True)
+class Tail(QueryPlan):
+    input: QueryPlan
+    limit: int = 0
+
+
+@dataclass(frozen=True)
+class Aggregate(QueryPlan):
+    input: QueryPlan
+    group: Tuple[Expr, ...] = ()
+    aggregate: Tuple[Expr, ...] = ()
+    having: Optional[Expr] = None
+    grouping_sets: Optional[Tuple[Tuple[Expr, ...], ...]] = None
+    rollup: bool = False
+    cube: bool = False
+
+
+@dataclass(frozen=True)
+class Deduplicate(QueryPlan):
+    input: QueryPlan
+    columns: Tuple[str, ...] = ()  # empty → all columns
+    within_watermark: bool = False
+
+
+@dataclass(frozen=True)
+class Sample(QueryPlan):
+    input: QueryPlan
+    lower_bound: float = 0.0
+    upper_bound: float = 1.0
+    with_replacement: bool = False
+    seed: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class Offset(QueryPlan):
+    input: QueryPlan
+    offset: int = 0
+
+
+@dataclass(frozen=True)
+class SubqueryAlias(QueryPlan):
+    input: QueryPlan
+    alias: str
+    qualifier: Tuple[str, ...] = ()
+    columns: Tuple[str, ...] = ()  # optional column renames
+
+
+@dataclass(frozen=True)
+class Repartition(QueryPlan):
+    input: QueryPlan
+    num_partitions: Optional[int] = None
+    expressions: Tuple[Expr, ...] = ()  # empty → round-robin
+
+
+@dataclass(frozen=True)
+class WithColumns(QueryPlan):
+    input: QueryPlan
+    aliases: Tuple[Expr, ...] = ()  # Alias exprs
+
+
+@dataclass(frozen=True)
+class WithColumnsRenamed(QueryPlan):
+    input: QueryPlan
+    renames: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass(frozen=True)
+class Drop(QueryPlan):
+    input: QueryPlan
+    columns: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class ToSchema(QueryPlan):
+    input: QueryPlan
+    schema: Schema = None
+
+
+@dataclass(frozen=True)
+class WithCtes(QueryPlan):
+    input: QueryPlan
+    ctes: Tuple[Tuple[str, QueryPlan], ...] = ()
+    recursive: bool = False
+
+
+@dataclass(frozen=True)
+class Pivot(QueryPlan):
+    input: QueryPlan
+    group: Tuple[Expr, ...] = ()
+    aggregate: Tuple[Expr, ...] = ()
+    pivot_column: Optional[Expr] = None
+    pivot_values: Tuple[Expr, ...] = ()
+
+
+@dataclass(frozen=True)
+class Unpivot(QueryPlan):
+    input: QueryPlan
+    ids: Tuple[Expr, ...] = ()
+    values: Tuple[Expr, ...] = ()
+    variable_column_name: str = "variable"
+    value_column_name: str = "value"
+
+
+@dataclass(frozen=True)
+class LateralView(QueryPlan):
+    input: QueryPlan
+    generator: Expr = None
+    table_alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
+    outer: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Binary / n-ary nodes
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Join(QueryPlan):
+    left: QueryPlan
+    right: QueryPlan
+    join_type: str = "inner"  # inner|left|right|full|semi|anti|cross
+    condition: Optional[Expr] = None
+    using: Tuple[str, ...] = ()
+    is_lateral: bool = False
+
+
+@dataclass(frozen=True)
+class SetOperation(QueryPlan):
+    left: QueryPlan
+    right: QueryPlan
+    op: str = "union"  # union|intersect|except
+    all: bool = False
+    by_name: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Commands
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CommandPlan(Plan):
+    """Base for commands (DDL/DML/session)."""
+
+
+@dataclass(frozen=True)
+class CreateTable(CommandPlan):
+    name: Tuple[str, ...]
+    schema: Optional[Schema] = None
+    format: Optional[str] = None
+    location: Optional[str] = None
+    query: Optional[QueryPlan] = None  # CTAS
+    if_not_exists: bool = False
+    replace: bool = False
+    partition_by: Tuple[str, ...] = ()
+    options: Tuple[Tuple[str, str], ...] = ()
+    comment: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class CreateView(CommandPlan):
+    name: Tuple[str, ...]
+    query: QueryPlan = None
+    temporary: bool = True
+    replace: bool = False
+    columns: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class DropTable(CommandPlan):
+    name: Tuple[str, ...]
+    if_exists: bool = False
+    purge: bool = False
+    is_view: bool = False
+
+
+@dataclass(frozen=True)
+class InsertInto(CommandPlan):
+    table: Tuple[str, ...]
+    query: QueryPlan = None
+    overwrite: bool = False
+    columns: Tuple[str, ...] = ()
+    partition_spec: Tuple[Tuple[str, Optional[str]], ...] = ()
+
+
+@dataclass(frozen=True)
+class WriteDataSource(CommandPlan):
+    query: QueryPlan
+    format: str = "parquet"
+    path: Optional[str] = None
+    mode: str = "error"  # append|overwrite|error|ignore
+    partition_by: Tuple[str, ...] = ()
+    options: Tuple[Tuple[str, str], ...] = ()
+    table: Optional[Tuple[str, ...]] = None
+
+
+@dataclass(frozen=True)
+class Explain(CommandPlan):
+    query: QueryPlan
+    mode: str = "simple"  # simple|extended|codegen|cost|formatted
+
+
+@dataclass(frozen=True)
+class SetVariable(CommandPlan):
+    name: str = ""
+    value: Optional[str] = None  # None → show
+
+
+@dataclass(frozen=True)
+class ResetVariable(CommandPlan):
+    name: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class ShowTables(CommandPlan):
+    database: Optional[Tuple[str, ...]] = None
+    pattern: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class ShowDatabases(CommandPlan):
+    pattern: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class ShowColumns(CommandPlan):
+    table: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class ShowFunctions(CommandPlan):
+    pattern: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class DescribeTable(CommandPlan):
+    table: Tuple[str, ...] = ()
+    extended: bool = False
+
+
+@dataclass(frozen=True)
+class CreateDatabase(CommandPlan):
+    name: Tuple[str, ...] = ()
+    if_not_exists: bool = False
+    comment: Optional[str] = None
+    location: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class DropDatabase(CommandPlan):
+    name: Tuple[str, ...] = ()
+    if_exists: bool = False
+    cascade: bool = False
+
+
+@dataclass(frozen=True)
+class UseDatabase(CommandPlan):
+    name: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class CacheTable(CommandPlan):
+    name: Tuple[str, ...] = ()
+    query: Optional[QueryPlan] = None
+    lazy: bool = False
+
+
+@dataclass(frozen=True)
+class UncacheTable(CommandPlan):
+    name: Tuple[str, ...] = ()
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Delete(CommandPlan):
+    table: Tuple[str, ...] = ()
+    condition: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class Update(CommandPlan):
+    table: Tuple[str, ...] = ()
+    assignments: Tuple[Tuple[Tuple[str, ...], Expr], ...] = ()
+    condition: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class MergeAction:
+    action: str = "update"  # update|delete|insert
+    condition: Optional[Expr] = None
+    assignments: Tuple[Tuple[Tuple[str, ...], Expr], ...] = ()
+
+
+@dataclass(frozen=True)
+class MergeInto(CommandPlan):
+    target: Tuple[str, ...] = ()
+    source: QueryPlan = None
+    condition: Expr = None
+    matched_actions: Tuple[MergeAction, ...] = ()
+    not_matched_actions: Tuple[MergeAction, ...] = ()
+    not_matched_by_source_actions: Tuple[MergeAction, ...] = ()

@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-device virtual CPU mesh so distributed
+(sharding/collective) paths run without TPU hardware, mirroring the
+reference's local-cluster-mode test vehicle (SURVEY.md §4 tier 3).
+
+Note: the environment registers a remote-TPU ("axon") jax backend in every
+interpreter and rewrites ``jax_platforms`` at registration time, so the
+JAX_PLATFORMS env var alone is not enough — we must also reset the config
+before the first backend initialization.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

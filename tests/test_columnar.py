@@ -1,0 +1,79 @@
+"""Arrow ⇄ device round-trip and batch invariants."""
+
+import datetime
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sail_tpu.columnar import arrow_interop as ai
+from sail_tpu.columnar.batch import round_capacity
+
+
+def test_round_capacity_buckets():
+    assert round_capacity(0) == 8
+    assert round_capacity(8) == 8
+    assert round_capacity(9) >= 9
+    # bucketing: nearby sizes share a capacity (jit cache friendliness)
+    caps = {round_capacity(n) for n in range(1000, 1100)}
+    assert len(caps) <= 2
+
+
+def test_arrow_roundtrip_fixed_width():
+    t = pa.table({
+        "i32": pa.array([1, 2, None, 4], type=pa.int32()),
+        "i64": pa.array([10, None, 30, 40], type=pa.int64()),
+        "f64": pa.array([1.5, 2.5, 3.5, None], type=pa.float64()),
+        "b": pa.array([True, False, None, True]),
+    })
+    batch = ai.from_arrow(t)
+    assert batch.capacity >= 4
+    out = ai.to_arrow(batch)
+    assert out.num_rows == 4
+    assert out.column("i32").to_pylist() == [1, 2, None, 4]
+    assert out.column("i64").to_pylist() == [10, None, 30, 40]
+    assert out.column("f64").to_pylist() == [1.5, 2.5, 3.5, None]
+    assert out.column("b").to_pylist() == [True, False, None, True]
+
+
+def test_arrow_roundtrip_strings_dates_decimals():
+    t = pa.table({
+        "s": pa.array(["foo", "bar", None, "foo"]),
+        "d": pa.array([datetime.date(2024, 1, 1), None,
+                       datetime.date(1969, 12, 31), datetime.date(1970, 1, 2)]),
+        "ts": pa.array([datetime.datetime(2024, 1, 1, 12, 0, 0), None,
+                        datetime.datetime(1970, 1, 1), None],
+                       type=pa.timestamp("us")),
+        "dec": pa.array([decimal.Decimal("1.23"), decimal.Decimal("-4.50"),
+                         None, decimal.Decimal("0.01")],
+                        type=pa.decimal128(10, 2)),
+    })
+    batch = ai.from_arrow(t)
+    # decimals upload as unscaled int64
+    dec_col = batch.device.columns["dec"]
+    np.testing.assert_array_equal(np.asarray(dec_col.data)[:2], [123, -450])
+    out = ai.to_arrow(batch)
+    assert out.column("s").to_pylist() == ["foo", "bar", None, "foo"]
+    assert out.column("d").to_pylist() == [datetime.date(2024, 1, 1), None,
+                                           datetime.date(1969, 12, 31),
+                                           datetime.date(1970, 1, 2)]
+    assert out.column("dec").to_pylist() == [decimal.Decimal("1.23"),
+                                             decimal.Decimal("-4.50"), None,
+                                             decimal.Decimal("0.01")]
+    ts = out.column("ts").to_pylist()
+    assert ts[0] == datetime.datetime(2024, 1, 1, 12, 0, 0)
+    assert ts[1] is None
+
+
+def test_dictionary_unify_and_ranks():
+    a = pa.array(["b", "a"]).dictionary_encode().dictionary
+    b = pa.array(["c", "a"]).dictionary_encode().dictionary
+    merged, ra, rb = ai.unify_dictionaries(a, b)
+    vals = merged.to_pylist()
+    assert vals[ra[0]] == "b" and vals[ra[1]] == "a"
+    assert vals[rb[0]] == "c" and vals[rb[1]] == "a"
+    ranks = ai.dictionary_ranks(merged)
+    ordered = sorted(vals)
+    for code, v in enumerate(vals):
+        assert ordered[ranks[code]] == v

@@ -1,0 +1,344 @@
+"""Device kernel tests: sort, aggregate, join — checked against numpy/pandas."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sail_tpu.columnar import arrow_interop as ai
+from sail_tpu.columnar.batch import Column, DeviceBatch
+from sail_tpu.ops import aggregate as agg
+from sail_tpu.ops import join as joinops
+from sail_tpu.ops import sort as sortops
+from sail_tpu.spec import data_type as dt
+
+import jax.numpy as jnp
+
+
+def make_batch(table: pa.Table):
+    return ai.from_arrow(table).device
+
+
+def live_rows(batch: DeviceBatch, names=None):
+    sel = np.asarray(batch.sel)
+    names = names or batch.names
+    out = {}
+    for n in names:
+        c = batch.columns[n]
+        data = np.asarray(c.data)[sel]
+        if c.validity is not None:
+            v = np.asarray(c.validity)[sel]
+            data = [None if not vi else di for di, vi in zip(data.tolist(), v.tolist())]
+        else:
+            data = data.tolist()
+        out[n] = data
+    return out
+
+
+class TestSort:
+    def test_multi_key_with_nulls(self):
+        t = pa.table({
+            "a": pa.array([3, 1, None, 1, 2], type=pa.int64()),
+            "b": pa.array([1.0, 2.0, 3.0, None, 5.0], type=pa.float64()),
+        })
+        b = make_batch(t)
+        keys = [
+            (b.columns["a"].data, b.columns["a"].validity, dt.LongType(), True, None),
+            (b.columns["b"].data, b.columns["b"].validity, dt.DoubleType(), False, None),
+        ]
+        perm = sortops.lexsort_perm(keys, b.sel)
+        out = sortops.take_batch(b, perm)
+        rows = live_rows(out)
+        # asc nulls first on a; desc nulls last on b
+        assert rows["a"] == [None, 1, 1, 2, 3]
+        assert rows["b"] == [3.0, 2.0, None, 5.0, 1.0]
+
+    def test_limit_offset(self):
+        t = pa.table({"x": pa.array(range(10), type=pa.int64())})
+        b = make_batch(t)
+        out = sortops.limit(b, 3, offset=2)
+        assert live_rows(out)["x"] == [2, 3, 4]
+
+    def test_dead_rows_sort_last(self):
+        t = pa.table({"x": pa.array([5, 1, 3, 2], type=pa.int64())})
+        b = make_batch(t)
+        b = b.with_sel(b.sel & jnp.asarray(np.array([True, False, True, True] + [False] * (b.capacity - 4))))
+        perm = sortops.lexsort_perm(
+            [(b.columns["x"].data, None, dt.LongType(), True, None)], b.sel)
+        out = sortops.take_batch(b, perm)
+        assert live_rows(out)["x"] == [2, 3, 5]
+
+
+class TestAggregate:
+    def test_grouped_sum_count_min_max(self):
+        rng = np.random.default_rng(0)
+        n = 500
+        keys = rng.integers(0, 7, n)
+        vals = rng.normal(size=n)
+        null_mask = rng.random(n) < 0.2
+        t = pa.table({
+            "k": pa.array(keys, type=pa.int64()),
+            "v": pa.array([None if m else float(v) for v, m in zip(vals, null_mask)],
+                          type=pa.float64()),
+        })
+        b = make_batch(t)
+        ctx, skeys = agg.group_rows([b.columns["k"]], b.sel, max_groups=16)
+        kout = agg.group_key_output(ctx, skeys)[0]
+        gsel = agg.group_sel(ctx)
+        s = agg.agg_sum(ctx, b.columns["v"], dt.DoubleType())
+        c_star = agg.agg_count(ctx, None)
+        c_v = agg.agg_count(ctx, b.columns["v"])
+        mn = agg.agg_min_max(ctx, b.columns["v"], is_min=True)
+        mx = agg.agg_min_max(ctx, b.columns["v"], is_min=False)
+
+        df = pd.DataFrame({"k": keys, "v": np.where(null_mask, np.nan, vals)})
+        expected = df.groupby("k").agg(
+            s=("v", lambda x: x.sum(min_count=1)),
+            c_star=("v", "size"), c_v=("v", "count"),
+            mn=("v", "min"), mx=("v", "max"))
+        got = pd.DataFrame({
+            "k": np.asarray(kout.data)[np.asarray(gsel)],
+            "s": np.asarray(s.data)[np.asarray(gsel)],
+            "c_star": np.asarray(c_star.data)[np.asarray(gsel)],
+            "c_v": np.asarray(c_v.data)[np.asarray(gsel)],
+            "mn": np.asarray(mn.data)[np.asarray(gsel)],
+            "mx": np.asarray(mx.data)[np.asarray(gsel)],
+        }).set_index("k").sort_index()
+        assert got.index.tolist() == expected.index.tolist()
+        np.testing.assert_allclose(got["s"], expected["s"], rtol=1e-12)
+        np.testing.assert_array_equal(got["c_star"], expected["c_star"])
+        np.testing.assert_array_equal(got["c_v"], expected["c_v"])
+        np.testing.assert_allclose(got["mn"], expected["mn"])
+        np.testing.assert_allclose(got["mx"], expected["mx"])
+
+    def test_null_keys_form_a_group(self):
+        t = pa.table({
+            "k": pa.array([1, None, 1, None], type=pa.int64()),
+            "v": pa.array([1, 2, 3, 4], type=pa.int64()),
+        })
+        b = make_batch(t)
+        ctx, skeys = agg.group_rows([b.columns["k"]], b.sel, max_groups=8)
+        gsel = np.asarray(agg.group_sel(ctx))
+        assert gsel.sum() == 2
+        s = agg.agg_sum(ctx, b.columns["v"], dt.LongType())
+        sums = sorted(np.asarray(s.data)[gsel].tolist())
+        assert sums == [4, 6]
+
+    def test_global_aggregate_no_keys(self):
+        t = pa.table({"v": pa.array([1, 2, None, 4], type=pa.int64())})
+        b = make_batch(t)
+        ctx, _ = agg.group_rows([], b.sel, max_groups=1)
+        s = agg.agg_sum(ctx, b.columns["v"], dt.LongType())
+        c = agg.agg_count(ctx, b.columns["v"])
+        assert int(np.asarray(s.data)[0]) == 7
+        assert int(np.asarray(c.data)[0]) == 3
+
+    def test_multi_key_packed_and_unpacked(self):
+        rng = np.random.default_rng(1)
+        n = 300
+        k1 = rng.integers(0, 5, n).astype(np.int32)
+        k2 = rng.integers(0, 3, n).astype(np.int32)
+        v = rng.integers(0, 100, n)
+        t = pa.table({"k1": pa.array(k1), "k2": pa.array(k2),
+                      "v": pa.array(v, type=pa.int64())})
+        b = make_batch(t)
+        ctx, skeys = agg.group_rows([b.columns["k1"], b.columns["k2"]], b.sel, max_groups=32)
+        gsel = np.asarray(agg.group_sel(ctx))
+        s = agg.agg_sum(ctx, b.columns["v"], dt.LongType())
+        kk1 = np.asarray(agg.group_key_output(ctx, skeys)[0].data)[gsel]
+        kk2 = np.asarray(agg.group_key_output(ctx, skeys)[1].data)[gsel]
+        ss = np.asarray(s.data)[gsel]
+        expected = pd.DataFrame({"k1": k1, "k2": k2, "v": v}).groupby(["k1", "k2"])["v"].sum()
+        got = pd.Series(ss, index=pd.MultiIndex.from_arrays([kk1, kk2])).sort_index()
+        np.testing.assert_array_equal(got.values, expected.values)
+
+
+class TestJoin:
+    def _join_df(self, left, right, on, how):
+        return left.merge(right, on=on, how=how)
+
+    def test_unique_inner_left(self):
+        probe = pa.table({
+            "k": pa.array([1, 2, 3, 99, None], type=pa.int64()),
+            "p": pa.array([10, 20, 30, 40, 50], type=pa.int64()),
+        })
+        build = pa.table({
+            "k2": pa.array([1, 2, 3, 4], type=pa.int64()),
+            "b": pa.array(["a", "b", None, "d"]),
+        })
+        pb, bb = make_batch(probe), ai.from_arrow(build)
+        bt = joinops.build_side([bb.device.columns["k2"]], bb.device.sel)
+        ranges = joinops.probe_ranges(bt, [pb.columns["k"]], pb.sel)
+        out = joinops.join_unique(bt, ranges, pb, bb.device, "inner", ["b"])
+        rows = live_rows(out, ["k", "p", "b"])
+        assert rows["k"] == [1, 2, 3]
+        assert rows["b"] == [0, 1, None]  # dictionary codes
+        out_l = joinops.join_unique(bt, ranges, pb, bb.device, "left", ["b"])
+        rows_l = live_rows(out_l, ["k", "b"])
+        assert rows_l["k"] == [1, 2, 3, 99, None]
+        assert rows_l["b"] == [0, 1, None, None, None]
+
+    def test_semi_anti(self):
+        probe = pa.table({"k": pa.array([1, 2, 5], type=pa.int64())})
+        build = pa.table({"k2": pa.array([2, 5, 7], type=pa.int64())})
+        pb, bb = make_batch(probe), make_batch(build)
+        bt = joinops.build_side([bb.columns["k2"]], bb.sel)
+        r = joinops.probe_ranges(bt, [pb.columns["k"]], pb.sel)
+        semi = joinops.join_unique(bt, r, pb, bb, "semi", [])
+        anti = joinops.join_unique(bt, r, pb, bb, "anti", [])
+        assert live_rows(semi)["k"] == [2, 5]
+        assert live_rows(anti)["k"] == [1]
+
+    def test_expand_many_to_many(self):
+        probe = pa.table({
+            "k": pa.array([1, 2, 3, None], type=pa.int64()),
+            "p": pa.array([10, 20, 30, 40], type=pa.int64()),
+        })
+        build = pa.table({
+            "k2": pa.array([1, 1, 2, 4, None], type=pa.int64()),
+            "b": pa.array([100, 101, 200, 400, 500], type=pa.int64()),
+        })
+        pb, bb = make_batch(probe), make_batch(build)
+        bt = joinops.build_side([bb.columns["k2"]], bb.sel)
+        r = joinops.probe_ranges(bt, [pb.columns["k"]], pb.sel)
+        assert bool(joinops.has_duplicate_build_keys(bt))
+        total = int(joinops.join_output_count(r, pb.sel, "inner"))
+        assert total == 3  # k=1 matches twice, k=2 once
+        out = joinops.join_expand(bt, r, pb, bb, "inner", ["b"], out_capacity=8)
+        rows = live_rows(out, ["k", "b"])
+        assert sorted(zip(rows["k"], rows["b"])) == [(1, 100), (1, 101), (2, 200)]
+        # left join: unmatched probe rows appear with null build cols
+        total_l = int(joinops.join_output_count(r, pb.sel, "left"))
+        assert total_l == 5
+        out_l = joinops.join_expand(bt, r, pb, bb, "left", ["b"], out_capacity=8)
+        rows_l = live_rows(out_l, ["k", "b"])
+        assert sorted(zip([(-1 if k is None else k) for k in rows_l["k"]],
+                          [(-1 if b is None else b) for b in rows_l["b"]])) == \
+            [(-1, -1), (1, 100), (1, 101), (2, 200), (3, -1)]
+
+    def test_build_matched_mask(self):
+        probe = pa.table({"k": pa.array([1, 2], type=pa.int64())})
+        build = pa.table({"k2": pa.array([1, 3, 2, 1], type=pa.int64())})
+        pb, bb = make_batch(probe), make_batch(build)
+        bt = joinops.build_side([bb.columns["k2"]], bb.sel)
+        r = joinops.probe_ranges(bt, [pb.columns["k"]], pb.sel)
+        matched = np.asarray(joinops.build_matched_mask(bt, r, pb.sel))
+        np.testing.assert_array_equal(matched[:4], [True, False, True, True])
+
+
+class TestReviewRegressions:
+    """Regressions for the round-1 code-review findings."""
+
+    def test_join_on_minus_one_key(self):
+        # -1 as int64 key packs to the KEY_MAX bit pattern; must still match.
+        probe = pa.table({"k": pa.array([-1, 2], type=pa.int64())})
+        build = pa.table({"k2": pa.array([-1, 2], type=pa.int64()),
+                          "b": pa.array([7, 8], type=pa.int64())})
+        pb, bb = make_batch(probe), make_batch(build)
+        bt = joinops.build_side([bb.columns["k2"]], bb.sel)
+        r = joinops.probe_ranges(bt, [pb.columns["k"]], pb.sel)
+        out = joinops.join_unique(bt, r, pb, bb, "inner", ["b"])
+        rows = live_rows(out, ["k", "b"])
+        assert sorted(zip(rows["k"], rows["b"])) == [(-1, 7), (2, 8)]
+        assert not bool(joinops.has_duplicate_build_keys(bt))
+
+    def test_join_duplicate_minus_one_detected(self):
+        build = pa.table({"k2": pa.array([-1, -1], type=pa.int64())})
+        bb = make_batch(build)
+        bt = joinops.build_side([bb.columns["k2"]], bb.sel)
+        assert bool(joinops.has_duplicate_build_keys(bt))
+
+    def test_float_zero_sign_group_and_join(self):
+        t = pa.table({"k": pa.array([0.0, -0.0, 1.0], type=pa.float64()),
+                      "v": pa.array([1, 2, 4], type=pa.int64())})
+        b = make_batch(t)
+        ctx, skeys = agg.group_rows([b.columns["k"]], b.sel, max_groups=8)
+        gsel = np.asarray(agg.group_sel(ctx))
+        assert gsel.sum() == 2  # 0.0 and -0.0 merge
+        s = agg.agg_sum(ctx, b.columns["v"], dt.LongType())
+        assert sorted(np.asarray(s.data)[gsel].tolist()) == [3, 4]
+        # join: -0.0 probe matches 0.0 build
+        probe = make_batch(pa.table({"k": pa.array([-0.0], type=pa.float64())}))
+        build = make_batch(pa.table({"k2": pa.array([0.0], type=pa.float64()),
+                                     "b": pa.array([9], type=pa.int64())}))
+        bt = joinops.build_side([build.columns["k2"]], build.sel)
+        r = joinops.probe_ranges(bt, [probe.columns["k"]], probe.sel)
+        out = joinops.join_unique(bt, r, probe, build, "inner", ["b"])
+        assert live_rows(out, ["b"])["b"] == [9]
+
+    def test_nan_groups_together(self):
+        t = pa.table({"k": pa.array([float("nan"), float("nan"), 1.0], type=pa.float64()),
+                      "v": pa.array([1, 2, 3], type=pa.int64())})
+        b = make_batch(t)
+        ctx, _ = agg.group_rows([b.columns["k"]], b.sel, max_groups=8)
+        assert int(np.asarray(ctx.num_groups)) == 2
+
+    def test_group_overflow_detected(self):
+        t = pa.table({"k": pa.array(list(range(40)), type=pa.int64()),
+                      "v": pa.array([1] * 40, type=pa.int64())})
+        b = make_batch(t)
+        ctx, _ = agg.group_rows([b.columns["k"]], b.sel, max_groups=32)
+        assert bool(agg.group_overflow(ctx))
+
+    def test_hashed_multi_key_join(self):
+        # three int64 keys -> not packable -> hashed path with verification
+        rng = np.random.default_rng(3)
+        bn = 50
+        bk = [rng.integers(0, 10, bn).astype(np.int64) for _ in range(3)]
+        probe_rows = 80
+        pk = [rng.integers(0, 12, probe_rows).astype(np.int64) for _ in range(3)]
+        build = pa.table({"a": pa.array(bk[0]), "b": pa.array(bk[1]),
+                          "c": pa.array(bk[2]),
+                          "val": pa.array(np.arange(bn), type=pa.int64())})
+        probe = pa.table({"a": pa.array(pk[0]), "b": pa.array(pk[1]), "c": pa.array(pk[2])})
+        pb, bb = make_batch(probe), make_batch(build)
+        bkc = [bb.columns[n] for n in ("a", "b", "c")]
+        pkc = [pb.columns[n] for n in ("a", "b", "c")]
+        bt = joinops.build_side(bkc, bb.sel)
+        assert not bt.exact
+        assert not bool(joinops.hash_ambiguous(bt, bkc))
+        r = joinops.probe_ranges(bt, pkc, pb.sel, build_key_cols=bkc)
+        total = int(joinops.join_output_count(r, pb.sel, "inner"))
+        out = joinops.join_expand(bt, r, pb, bb, "inner", ["val"],
+                                  out_capacity=max(8, total))
+        got = live_rows(out, ["a", "b", "c", "val"])
+        exp = pd.DataFrame({"a": pk[0], "b": pk[1], "c": pk[2]}).merge(
+            pd.DataFrame({"a": bk[0], "b": bk[1], "c": bk[2], "val": np.arange(bn)}),
+            on=["a", "b", "c"], how="inner")
+        assert total == len(exp)
+        assert sorted(zip(got["a"], got["b"], got["c"], got["val"])) == \
+            sorted(zip(exp["a"], exp["b"], exp["c"], exp["val"]))
+
+    def test_nan_keys_hashed_join_and_no_livelock(self):
+        nan = float("nan")
+        build = pa.table({"a": pa.array([nan, 2.0], type=pa.float64()),
+                          "b": pa.array([1.0, 2.0], type=pa.float64()),
+                          "c": pa.array([1.0, 2.0], type=pa.float64()),
+                          "val": pa.array([7, 8], type=pa.int64())})
+        probe = pa.table({"a": pa.array([nan, 2.0], type=pa.float64()),
+                          "b": pa.array([1.0, 2.0], type=pa.float64()),
+                          "c": pa.array([1.0, 2.0], type=pa.float64())})
+        pb, bb = make_batch(probe), make_batch(build)
+        bkc = [bb.columns[n] for n in ("a", "b", "c")]
+        pkc = [pb.columns[n] for n in ("a", "b", "c")]
+        bt = joinops.build_side(bkc, bb.sel)
+        assert not bt.exact
+        # two equal-NaN rows are duplicates, not ambiguity -> no seed livelock
+        assert not bool(joinops.hash_ambiguous(bt, bkc))
+        r = joinops.probe_ranges(bt, pkc, pb.sel, build_key_cols=bkc)
+        assert int(joinops.join_output_count(r, pb.sel, "inner")) == 2
+
+    def test_decimal_literal_precision(self):
+        import decimal as _dec
+        from sail_tpu.spec.expression import lit
+        l = lit(_dec.Decimal("1E+2"))
+        assert l.value.data_type.precision >= 3
+
+    def test_decimal_download_roundtrip_large(self):
+        import decimal as _dec
+        n = 1000
+        vals = [_dec.Decimal(i).scaleb(-2) for i in range(-500, 500)]
+        t = pa.table({"d": pa.array(vals, type=pa.decimal128(12, 2))})
+        hb = ai.from_arrow(t)
+        out = ai.to_arrow(hb)
+        assert out.column("d").to_pylist() == vals
