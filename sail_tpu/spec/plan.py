@@ -72,6 +72,11 @@ class Values(QueryPlan):
     rows: Tuple[Tuple[Expr, ...], ...] = ()
 
 
+@dataclass(frozen=True)
+class OneRow(QueryPlan):
+    """A single anonymous row — the relation behind FROM-less SELECTs."""
+
+
 # ---------------------------------------------------------------------------
 # Unary nodes
 # ---------------------------------------------------------------------------
@@ -226,6 +231,7 @@ class Join(QueryPlan):
     condition: Optional[Expr] = None
     using: Tuple[str, ...] = ()
     is_lateral: bool = False
+    is_natural: bool = False  # resolver expands to USING over common columns
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,5 @@
+"""Spark SQL front-end: lexer + recursive-descent parser lowering to the
+spec IR (reference role: sail-sql-parser + sail-sql-analyzer)."""
+
+from .parser import parse_data_type, parse_expression, parse_one, parse_sql  # noqa: F401
+from .lexer import SqlSyntaxError  # noqa: F401

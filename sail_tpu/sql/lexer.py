@@ -1,0 +1,139 @@
+"""Spark SQL lexer.
+
+From-scratch tokenizer for the Spark SQL dialect (reference role:
+crates/sail-sql-parser/src/lexer — chumsky combinators there; a direct
+scanning lexer here). Handles: identifiers (plain + backquoted), string
+literals ('..' and ".." with '' escapes and \\ escapes), numeric literals
+(int/decimal/scientific + typed suffixes L/S/Y/BD/D/F), operators,
+comments (-- and /* */), and parameter markers.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+
+class SqlSyntaxError(ValueError):
+    def __init__(self, message: str, text: str = "", pos: int = 0):
+        line = text.count("\n", 0, pos) + 1
+        col = pos - (text.rfind("\n", 0, pos) + 1) + 1
+        super().__init__(f"{message} (line {line}, col {col})")
+        self.pos = pos
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # ident | quoted_ident | string | number | op | param | eof
+    value: str
+    pos: int
+
+    @property
+    def upper(self) -> str:
+        return self.value.upper()
+
+
+_OPERATORS = [
+    "<=>", "<<", ">>", "||", "->", "=>", "::", "<=", ">=", "<>", "!=", "==",
+    "(", ")", "[", "]", ",", ".", ";", "+", "-", "*", "/", "%", "=", "<",
+    ">", "!", "~", "&", "|", "^", "?", ":", "@",
+]
+
+_NUMBER_RE = re.compile(
+    r"(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?(?:BD|bd|[LlSsYyDdFf])?")
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+_WS_RE = re.compile(r"\s+")
+
+
+def tokenize(text: str) -> List[Token]:
+    tokens: List[Token] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        m = _WS_RE.match(text, i)
+        if m:
+            i = m.end()
+            continue
+        if text.startswith("--", i):
+            j = text.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            if j < 0:
+                raise SqlSyntaxError("unterminated block comment", text, i)
+            i = j + 2
+            continue
+        if c in "'\"":
+            val, i2 = _scan_string(text, i, c)
+            tokens.append(Token("string", val, i))
+            i = i2
+            continue
+        if c == "`":
+            j = i + 1
+            buf = []
+            while j < n:
+                if text[j] == "`":
+                    if j + 1 < n and text[j + 1] == "`":
+                        buf.append("`")
+                        j += 2
+                        continue
+                    break
+                buf.append(text[j])
+                j += 1
+            else:
+                raise SqlSyntaxError("unterminated quoted identifier", text, i)
+            tokens.append(Token("quoted_ident", "".join(buf), i))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and text[i + 1].isdigit()):
+            # '.' only starts a number when not directly after an identifier
+            # or ')' (qualified names like a.b vs literals like .5)
+            prev = tokens[-1] if tokens else None
+            if not (c == "." and prev is not None
+                    and (prev.kind in ("ident", "quoted_ident")
+                         or prev.value == ")") and prev.pos + len(prev.value) == i):
+                m = _NUMBER_RE.match(text, i)
+                if m:
+                    tokens.append(Token("number", m.group(0), i))
+                    i = m.end()
+                    continue
+        m = _IDENT_RE.match(text, i)
+        if m:
+            tokens.append(Token("ident", m.group(0), i))
+            i = m.end()
+            continue
+        for op in _OPERATORS:
+            if text.startswith(op, i):
+                tokens.append(Token("op", op, i))
+                i += len(op)
+                break
+        else:
+            raise SqlSyntaxError(f"unexpected character {c!r}", text, i)
+    tokens.append(Token("eof", "", n))
+    return tokens
+
+
+def _scan_string(text: str, i: int, quote: str):
+    j = i + 1
+    buf = []
+    n = len(text)
+    while j < n:
+        c = text[j]
+        if c == "\\" and j + 1 < n:
+            esc = text[j + 1]
+            mapping = {"n": "\n", "t": "\t", "r": "\r", "0": "\0", "b": "\b",
+                       "'": "'", '"': '"', "\\": "\\", "%": "\\%", "_": "\\_"}
+            buf.append(mapping.get(esc, esc))
+            j += 2
+            continue
+        if c == quote:
+            if j + 1 < n and text[j + 1] == quote:
+                buf.append(quote)
+                j += 2
+                continue
+            return "".join(buf), j + 1
+        buf.append(c)
+        j += 1
+    raise SqlSyntaxError("unterminated string literal", text, i)

@@ -1,0 +1,270 @@
+"""SQL parser tests: expression precedence, literals, query shapes, TPC-H."""
+
+import datetime
+import decimal
+
+import pytest
+
+from sail_tpu.spec import data_type as dt
+from sail_tpu.spec import expression as ex
+from sail_tpu.spec import plan as pl
+from sail_tpu.sql import parse_data_type, parse_expression, parse_one, parse_sql
+from sail_tpu.sql.lexer import SqlSyntaxError
+
+
+class TestExpressions:
+    def test_precedence(self):
+        e = parse_expression("1 + 2 * 3")
+        assert isinstance(e, ex.Function) and e.name == "+"
+        assert isinstance(e.args[1], ex.Function) and e.args[1].name == "*"
+
+        e = parse_expression("a OR b AND NOT c = d")
+        assert e.name == "or"
+        rhs = e.args[1]
+        assert rhs.name == "and"
+        assert rhs.args[1].name == "not"
+        assert rhs.args[1].args[0].name == "=="
+
+    def test_comparison_chain_and_between(self):
+        e = parse_expression("x BETWEEN 1 AND 10")
+        assert isinstance(e, ex.Between) and not e.negated
+        e = parse_expression("x NOT BETWEEN 1 AND 10")
+        assert isinstance(e, ex.Between) and e.negated
+
+    def test_in_list_and_subquery(self):
+        e = parse_expression("x IN (1, 2, 3)")
+        assert isinstance(e, ex.InList) and len(e.values) == 3
+        e = parse_expression("x IN (SELECT y FROM t)")
+        assert isinstance(e, ex.InSubquery)
+
+    def test_like_escape(self):
+        e = parse_expression("name LIKE '%foo%'")
+        assert isinstance(e, ex.Like)
+        e = parse_expression("name NOT LIKE 'a\\_b' ESCAPE '\\\\'")
+        assert isinstance(e, ex.Like) and e.negated
+
+    def test_is_null(self):
+        e = parse_expression("x IS NOT NULL")
+        assert e.name == "not" and e.args[0].name == "isnull"
+
+    def test_case_when(self):
+        e = parse_expression("CASE WHEN a > 1 THEN 'x' ELSE 'y' END")
+        assert isinstance(e, ex.CaseWhen) and len(e.branches) == 1
+        e = parse_expression("CASE a WHEN 1 THEN 'x' WHEN 2 THEN 'y' END")
+        assert isinstance(e, ex.CaseWhen) and len(e.branches) == 2
+        assert e.branches[0][0].name == "=="
+
+    def test_cast_forms(self):
+        e = parse_expression("CAST(x AS DECIMAL(12,2))")
+        assert isinstance(e, ex.Cast) and e.data_type == dt.DecimalType(12, 2)
+        e = parse_expression("x :: bigint")
+        assert isinstance(e, ex.Cast) and e.data_type == dt.LongType()
+
+    def test_typed_literals(self):
+        e = parse_expression("DATE '1994-01-01'")
+        assert e.value.value == datetime.date(1994, 1, 1)
+        e = parse_expression("TIMESTAMP '2020-01-01 12:30:00'")
+        assert e.value.value.hour == 12
+        e = parse_expression("INTERVAL '3' MONTH")
+        assert e.value.data_type == dt.YearMonthIntervalType()
+        assert e.value.value == 3
+        e = parse_expression("INTERVAL '90' DAY")
+        assert e.value.data_type == dt.DayTimeIntervalType()
+        assert e.value.value == 90 * 86_400_000_000
+        e = parse_expression("INTERVAL '1-6' YEAR TO MONTH")
+        assert e.value.value == 18
+        e = parse_expression("INTERVAL '1 2:30:00' DAY TO SECOND")
+        assert e.value.value == 86_400_000_000 + 2 * 3_600_000_000 + 30 * 60_000_000
+
+    def test_number_suffixes(self):
+        assert parse_expression("5L").value.data_type == dt.LongType()
+        assert parse_expression("5S").value.data_type == dt.ShortType()
+        assert parse_expression("5Y").value.data_type == dt.ByteType()
+        assert parse_expression("5.0D").value.data_type == dt.DoubleType()
+        assert parse_expression("1.5").value.data_type == dt.DecimalType(2, 1)
+        assert parse_expression("1.5BD").value.data_type == dt.DecimalType(2, 1)
+        assert parse_expression("1e2").value.data_type == dt.DoubleType()
+        assert parse_expression("-6").value.value == -6
+
+    def test_function_distinct_filter_window(self):
+        e = parse_expression("count(DISTINCT x)")
+        assert e.is_distinct
+        e = parse_expression("sum(x) FILTER (WHERE y > 0)")
+        assert e.filter is not None
+        e = parse_expression("row_number() OVER (PARTITION BY a ORDER BY b DESC)")
+        assert isinstance(e, ex.Window)
+        assert len(e.partition_by) == 1 and not e.order_by[0].ascending
+        e = parse_expression(
+            "sum(x) OVER (ORDER BY y ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)")
+        assert e.frame == ex.WindowFrame("rows", None, 0)
+
+    def test_extract_substring(self):
+        e = parse_expression("EXTRACT(YEAR FROM o_orderdate)")
+        assert isinstance(e, ex.Extract) and e.field_name == "year"
+        e = parse_expression("SUBSTRING(s FROM 1 FOR 2)")
+        assert e.name == "substring" and len(e.args) == 3
+        e = parse_expression("substring(s, 1, 2)")
+        assert e.name == "substring" and len(e.args) == 3
+
+    def test_lambda(self):
+        e = parse_expression("transform(arr, x -> x + 1)")
+        assert isinstance(e.args[1], ex.LambdaFunction)
+        e = parse_expression("aggregate(arr, 0, (acc, x) -> acc + x)")
+        assert e.args[2].arguments == ("acc", "x")
+
+    def test_qualified_and_quoted(self):
+        e = parse_expression("a.b.c")
+        assert isinstance(e, ex.Attribute) and e.name == ("a", "b", "c")
+        e = parse_expression("`select`.`weird col`")
+        assert e.name == ("select", "weird col")
+
+    def test_string_escapes_and_concat(self):
+        assert parse_expression("'it''s'").value.value == "it's"
+        assert parse_expression("'a' 'b'").value.value == "ab"
+        assert parse_expression("'a\\nb'").value.value == "a\nb"
+
+
+class TestDataTypes:
+    def test_nested(self):
+        t = parse_data_type("array<struct<a:int,b:string>>")
+        assert isinstance(t, dt.ArrayType)
+        assert t.element_type.fields[0].name == "a"
+        t = parse_data_type("map<string, array<double>>")
+        assert isinstance(t, dt.MapType)
+
+
+class TestQueries:
+    def test_select_shape(self):
+        q = parse_one("SELECT a, b + 1 AS c FROM t WHERE a > 0 ORDER BY a LIMIT 10")
+        assert isinstance(q, pl.Limit)
+        assert isinstance(q.input, pl.Sort)
+        proj = q.input.input
+        assert isinstance(proj, pl.Project)
+        assert isinstance(proj.input, pl.Filter)
+        assert isinstance(proj.input.input, pl.ReadNamedTable)
+
+    def test_group_by_having(self):
+        q = parse_one("SELECT k, sum(v) FROM t GROUP BY k HAVING sum(v) > 5")
+        assert isinstance(q, pl.Aggregate)
+        assert q.having is not None
+
+    def test_joins(self):
+        q = parse_one("""SELECT * FROM a JOIN b ON a.x = b.x
+                         LEFT JOIN c USING (y) CROSS JOIN d""")
+        j = q.input
+        assert isinstance(j, pl.Join) and j.join_type == "cross"
+        assert j.left.join_type == "left" and j.left.using == ("y",)
+        assert j.left.left.join_type == "inner"
+
+    def test_implicit_cross_join(self):
+        q = parse_one("SELECT * FROM a, b, c WHERE a.x = b.x")
+        f = q.input
+        assert isinstance(f, pl.Filter)
+        assert isinstance(f.input, pl.Join) and f.input.join_type == "cross"
+
+    def test_set_ops(self):
+        q = parse_one("SELECT a FROM t UNION ALL SELECT a FROM u INTERSECT SELECT a FROM v")
+        assert isinstance(q, pl.SetOperation) and q.op == "union" and q.all
+        assert isinstance(q.right, pl.SetOperation) and q.right.op == "intersect"
+
+    def test_cte(self):
+        q = parse_one("WITH x AS (SELECT 1 AS a), y AS (SELECT a FROM x) SELECT * FROM y")
+        assert isinstance(q, pl.WithCtes) and len(q.ctes) == 2
+
+    def test_subqueries(self):
+        q = parse_one("""SELECT * FROM t WHERE EXISTS (SELECT 1 FROM u WHERE u.k = t.k)
+                         AND t.v > (SELECT avg(v) FROM t)""")
+        assert isinstance(q.input, pl.Filter)
+
+    def test_values(self):
+        q = parse_one("VALUES (1, 'a'), (2, 'b') AS t(x, y)")
+        assert isinstance(q, pl.SubqueryAlias)
+        assert isinstance(q.input, pl.Values) and len(q.input.rows) == 2
+
+    def test_distinct(self):
+        q = parse_one("SELECT DISTINCT a FROM t")
+        assert isinstance(q, pl.Deduplicate)
+
+    def test_grouping_analytics(self):
+        q = parse_one("SELECT a, b, sum(c) FROM t GROUP BY ROLLUP (a, b)")
+        assert isinstance(q, pl.Aggregate) and q.rollup
+        q = parse_one("SELECT a, b, sum(c) FROM t GROUP BY GROUPING SETS ((a), (a, b), ())")
+        assert q.grouping_sets == ((ex.col("a"),), (ex.col("a"), ex.col("b")), ())
+
+    def test_lateral_view(self):
+        q = parse_one("SELECT * FROM t LATERAL VIEW explode(arr) e AS item")
+        assert isinstance(q.input, pl.LateralView)
+        assert q.input.column_aliases == ("item",)
+
+    def test_time_travel(self):
+        q = parse_one("SELECT * FROM t VERSION AS OF 3")
+        assert q.input.temporal == "version:3"
+
+
+class TestCommands:
+    def test_create_table(self):
+        c = parse_one("""CREATE TABLE IF NOT EXISTS db.t (a INT NOT NULL, b STRING)
+                         USING parquet PARTITIONED BY (b) LOCATION '/tmp/t'""")
+        assert isinstance(c, pl.CreateTable)
+        assert c.if_not_exists and c.format == "parquet"
+        assert c.schema.fields[0].nullable is False
+        assert c.partition_by == ("b",)
+
+    def test_ctas_and_view(self):
+        c = parse_one("CREATE OR REPLACE TEMP VIEW v AS SELECT 1 AS x")
+        assert isinstance(c, pl.CreateView) and c.temporary and c.replace
+        c = parse_one("CREATE TABLE t USING delta AS SELECT * FROM s")
+        assert isinstance(c, pl.CreateTable) and c.query is not None
+
+    def test_insert(self):
+        c = parse_one("INSERT INTO t PARTITION (p = '1') (a, b) SELECT 1, 2")
+        assert isinstance(c, pl.InsertInto)
+        assert c.partition_spec == (("p", "1"),)
+        assert c.columns == ("a", "b")
+        c = parse_one("INSERT OVERWRITE TABLE t SELECT * FROM s")
+        assert c.overwrite
+
+    def test_misc_commands(self):
+        assert isinstance(parse_one("SHOW TABLES IN db LIKE 'x*'"), pl.ShowTables)
+        assert isinstance(parse_one("DESCRIBE EXTENDED t"), pl.DescribeTable)
+        assert isinstance(parse_one("USE mydb"), pl.UseDatabase)
+        assert isinstance(parse_one("DROP VIEW IF EXISTS v"), pl.DropTable)
+        c = parse_one("SET spark.sql.shuffle.partitions = 8")
+        assert isinstance(c, pl.SetVariable)
+        assert c.name == "spark.sql.shuffle.partitions" and c.value == "8"
+        assert isinstance(parse_one("EXPLAIN EXTENDED SELECT 1"), pl.Explain)
+
+    def test_merge(self):
+        c = parse_one("""MERGE INTO tgt USING src ON tgt.id = src.id
+                         WHEN MATCHED AND src.del THEN DELETE
+                         WHEN MATCHED THEN UPDATE SET v = src.v
+                         WHEN NOT MATCHED THEN INSERT (id, v) VALUES (src.id, src.v)""")
+        assert isinstance(c, pl.MergeInto)
+        assert len(c.matched_actions) == 2
+        assert c.matched_actions[0].action == "delete"
+        assert len(c.not_matched_actions) == 1
+
+    def test_update_delete(self):
+        c = parse_one("UPDATE t SET a = 1, b = b + 1 WHERE c > 0")
+        assert isinstance(c, pl.Update) and len(c.assignments) == 2
+        c = parse_one("DELETE FROM t WHERE x IS NULL")
+        assert isinstance(c, pl.Delete)
+
+    def test_multiple_statements(self):
+        stmts = parse_sql("SELECT 1; SELECT 2;")
+        assert len(stmts) == 2
+
+    def test_syntax_errors(self):
+        with pytest.raises(SqlSyntaxError):
+            parse_one("SELECT FROM WHERE")
+        with pytest.raises(SqlSyntaxError):
+            parse_one("SELECT 1 +")
+
+
+class TestTpchParse:
+    def test_all_22_queries_parse(self):
+        from sail_tpu.benchmarks.tpch_queries import QUERIES
+        for i in range(1, 23):
+            stmts = parse_sql(QUERIES[i])
+            assert len(stmts) == 1, f"Q{i}"
+            assert isinstance(stmts[0], pl.QueryPlan), f"Q{i}"
