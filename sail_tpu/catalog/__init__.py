@@ -1,0 +1,9 @@
+"""Catalog layer: databases, tables, temp views.
+
+Reference role: sail-catalog's CatalogProvider/CatalogManager plus the
+in-memory provider (SURVEY.md §2.6). v0 ships the memory catalog; Glue/HMS/
+Unity/Iceberg-REST providers slot in behind the same CatalogProvider
+interface in later rounds.
+"""
+
+from .manager import CatalogManager, TableEntry  # noqa: F401

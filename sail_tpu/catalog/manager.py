@@ -1,0 +1,113 @@
+"""In-memory catalog manager.
+
+Reference role: crates/sail-catalog/src/manager/ (multi-catalog resolution,
+current database, temp views) + crates/sail-catalog-memory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..spec import data_type as dt
+from ..spec import plan as sp
+
+
+@dataclasses.dataclass
+class TableEntry:
+    name: Tuple[str, ...]
+    schema: dt.StructType = None
+    data: object = None                    # pa.Table for in-memory tables
+    paths: Tuple[str, ...] = ()
+    format: str = "memory"
+    view_plan: Optional[sp.QueryPlan] = None
+    options: Tuple[Tuple[str, str], ...] = ()
+    partition_by: Tuple[str, ...] = ()
+    comment: Optional[str] = None
+
+
+class CatalogManager:
+    def __init__(self):
+        self.current_catalog = "spark_catalog"
+        self.current_database = "default"
+        self.databases: Dict[str, dict] = {"default": {}}
+        self.tables: Dict[Tuple[str, str], TableEntry] = {}
+        self.temp_views: Dict[str, TableEntry] = {}
+
+    # -- resolution ------------------------------------------------------
+    def _db_and_name(self, name: Tuple[str, ...]) -> Tuple[str, str]:
+        parts = [p for p in name]
+        if len(parts) == 1:
+            return self.current_database, parts[0].lower()
+        if len(parts) == 2:
+            return parts[0].lower(), parts[1].lower()
+        # catalog.db.table — single catalog in v0
+        return parts[-2].lower(), parts[-1].lower()
+
+    def lookup_table(self, name: Tuple[str, ...]) -> Optional[TableEntry]:
+        if len(name) == 1 and name[0].lower() in self.temp_views:
+            return self.temp_views[name[0].lower()]
+        db, tbl = self._db_and_name(name)
+        return self.tables.get((db, tbl))
+
+    # -- mutation ---------------------------------------------------------
+    def create_database(self, name: str, if_not_exists: bool = False,
+                        comment: Optional[str] = None,
+                        location: Optional[str] = None):
+        key = name.lower()
+        if key in self.databases:
+            if if_not_exists:
+                return
+            raise ValueError(f"database {name!r} already exists")
+        self.databases[key] = {"comment": comment, "location": location}
+
+    def drop_database(self, name: str, if_exists: bool, cascade: bool):
+        key = name.lower()
+        if key not in self.databases:
+            if if_exists:
+                return
+            raise ValueError(f"database {name!r} not found")
+        tables = [k for k in self.tables if k[0] == key]
+        if tables and not cascade:
+            raise ValueError(f"database {name!r} is not empty")
+        for k in tables:
+            del self.tables[k]
+        del self.databases[key]
+
+    def register_table(self, entry: TableEntry, replace: bool = False,
+                       if_not_exists: bool = False):
+        db, tbl = self._db_and_name(entry.name)
+        if db not in self.databases:
+            raise ValueError(f"database {db!r} not found")
+        if (db, tbl) in self.tables and not replace:
+            if if_not_exists:
+                return
+            raise ValueError(f"table {'.'.join(entry.name)!r} already exists")
+        self.tables[(db, tbl)] = entry
+
+    def register_temp_view(self, name: str, plan: sp.QueryPlan, replace: bool = True):
+        key = name.lower()
+        if key in self.temp_views and not replace:
+            raise ValueError(f"temp view {name!r} already exists")
+        self.temp_views[key] = TableEntry((name,), view_plan=plan)
+
+    def drop_table(self, name: Tuple[str, ...], if_exists: bool = False,
+                   is_view: bool = False):
+        if len(name) == 1 and name[0].lower() in self.temp_views:
+            del self.temp_views[name[0].lower()]
+            return
+        db, tbl = self._db_and_name(name)
+        if (db, tbl) not in self.tables:
+            if if_exists:
+                return
+            raise ValueError(f"table {'.'.join(name)!r} not found")
+        del self.tables[(db, tbl)]
+
+    def list_tables(self, database: Optional[str] = None) -> List[TableEntry]:
+        db = (database or self.current_database).lower()
+        out = [e for (d, _), e in self.tables.items() if d == db]
+        out.extend(self.temp_views.values())
+        return out
+
+    def list_databases(self) -> List[str]:
+        return sorted(self.databases)

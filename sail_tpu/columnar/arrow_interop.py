@@ -116,13 +116,25 @@ def spec_type_to_arrow(d: dt.DataType) -> pa.DataType:
     raise TypeError(f"unsupported spec type {d}")
 
 
-def _decimal_to_unscaled_int64(arr: pa.Array) -> np.ndarray:
-    """Unscaled int64 values of a decimal128(p<=18) array (zero-copy-ish)."""
+def _decimal_to_unscaled_int64(arr: pa.Array, validity=None) -> np.ndarray:
+    """Unscaled int64 values of a decimal128 array (zero-copy-ish).
+
+    Validates that every value fits in int64 (high word must be the sign
+    extension of the low word) — wide-decimal overflow is a loud error, not
+    silent corruption."""
     arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
     buf = arr.buffers()[1]
     raw = np.frombuffer(buf, dtype=np.int64)
     # decimal128 is 16 bytes LE; low word at even indices (plus array offset)
     lo = raw[2 * arr.offset::2][: len(arr)]
+    hi = raw[2 * arr.offset + 1::2][: len(arr)]
+    ok = hi == (lo >> 63)
+    if validity is not None:
+        ok = ok | ~validity
+    if len(lo) and not ok.all():
+        raise TypeError(
+            f"decimal values exceed the engine's int64 unscaled range "
+            f"(type {arr.type}); reduce precision or cast to double")
     return lo.copy()
 
 
@@ -174,7 +186,7 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> HostBatch:
         elif isinstance(spec_t, dt.DecimalType) and spec_t.physical_dtype == "int64":
             if pa.types.is_decimal256(arr.type):
                 arr = arr.cast(pa.decimal128(spec_t.precision, spec_t.scale))
-            vals = _decimal_to_unscaled_int64(arr)
+            vals = _decimal_to_unscaled_int64(arr, validity)
             columns[name] = (vals, validity, spec_t)
         elif isinstance(spec_t, dt.DecimalType):
             vals = np.asarray(arr.cast(pa.float64()).fill_null(0.0))

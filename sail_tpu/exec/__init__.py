@@ -1,0 +1,2 @@
+"""Execution: local executor now; distributed driver/worker/shuffle layers
+on top (reference role: sail-execution)."""

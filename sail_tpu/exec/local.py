@@ -1,0 +1,616 @@
+"""Local (single-process) executor.
+
+Reference role: LocalJobRunner + DataFusion's operator execution
+(crates/sail-execution/src/job_runner.rs:47-66) — here the operators are
+interpreted on the host while all bulk compute runs as jnp/XLA ops over
+DeviceBatches. Batches use positional column names (c0, c1, …) internally;
+plan-schema names are applied only at the Arrow boundary (duplicate output
+names are legal in SQL).
+
+Host↔device sync points (kept deliberately few):
+- aggregate output shrink (live group count → smaller padded capacity)
+- join build-duplicate check + expand-capacity computation
+- scalar subquery evaluation
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from ..columnar import arrow_interop as ai
+from ..columnar.batch import (Column, DeviceBatch, HostBatch, empty_batch,
+                              physical_jnp_dtype, round_capacity)
+from ..ops import aggregate as aggk
+from ..ops import join as joink
+from ..ops import sort as sortk
+from ..plan import nodes as pn
+from ..plan import rex as rx
+from ..plan.compiler import Compiled, ExprCompiler, HostFallback
+from ..spec import data_type as dt
+from ..spec.literal import Literal as LV
+
+
+class ExecutionError(RuntimeError):
+    pass
+
+
+def _col_name(i: int) -> str:
+    return f"c{i}"
+
+
+class LocalExecutor:
+    def __init__(self, config: Optional[dict] = None):
+        self.config = config or {}
+        self._subquery_cache: Dict[int, LV] = {}
+
+    # ------------------------------------------------------------------
+    def execute(self, plan: pn.PlanNode) -> pa.Table:
+        """Run a plan to an Arrow table with the plan's output names."""
+        self._pre_eval_subqueries(plan)
+        batch = self.run(plan)
+        table = ai.to_arrow(batch)
+        names = [f.name for f in plan.schema]
+        return table.rename_columns(names)
+
+    def run(self, plan: pn.PlanNode) -> HostBatch:
+        method = getattr(self, "_exec_" + type(plan).__name__, None)
+        if method is None:
+            raise ExecutionError(f"no executor for {type(plan).__name__}")
+        return method(plan)
+
+    # ------------------------------------------------------------------
+    # scalar subqueries
+    # ------------------------------------------------------------------
+    def _pre_eval_subqueries(self, plan: pn.PlanNode):
+        for node in pn.walk_plan(plan):
+            for r in _node_rex(node):
+                for sub in rx.walk(r):
+                    if isinstance(sub, rx.RScalarSubquery) and \
+                            id(sub) not in self._subquery_cache:
+                        self._subquery_cache[id(sub)] = self._eval_scalar(sub)
+
+    def _eval_scalar(self, sub: rx.RScalarSubquery) -> LV:
+        inner = LocalExecutor(self.config)
+        inner._subquery_cache = self._subquery_cache
+        table = inner.execute(sub.plan)
+        if table.num_rows == 0:
+            return LV(sub.dtype, None)
+        if table.num_rows > 1:
+            raise ExecutionError("scalar subquery returned more than one row")
+        v = table.column(0)[0].as_py()
+        return LV(sub.dtype, v)
+
+    # ------------------------------------------------------------------
+    # expression plumbing
+    # ------------------------------------------------------------------
+    def _compiler(self, batch: HostBatch, schema: pn.Schema) -> ExprCompiler:
+        types = [f.dtype for f in schema]
+        dicts = {}
+        for i in range(len(schema)):
+            name = _col_name(i)
+            if name in batch.dicts:
+                dicts[i] = batch.dicts[name]
+        return ExprCompiler(types, dicts, self._subquery_cache)
+
+    @staticmethod
+    def _cols(batch: HostBatch) -> List:
+        dev = batch.device
+        return [(dev.columns[_col_name(i)].data, dev.columns[_col_name(i)].validity)
+                for i in range(len(dev.columns))]
+
+    def _eval(self, compiled: Compiled, batch: HostBatch):
+        return compiled.fn(self._cols(batch))
+
+    # ------------------------------------------------------------------
+    # leaves
+    # ------------------------------------------------------------------
+    def _exec_ScanExec(self, p: pn.ScanExec) -> HostBatch:
+        from ..io.formats import read_table
+        if p.source is not None:
+            table = p.source
+            if p.projection is not None:
+                table = table.select(list(p.projection))
+        else:
+            table = read_table(p.format, p.paths, dict(p.options),
+                               columns=p.projection)
+            table = self._apply_declared_schema(table, p.schema)
+        hb = ai.from_arrow(table)
+        return _positional(hb)
+
+    @staticmethod
+    def _apply_declared_schema(table: pa.Table, schema: pn.Schema) -> pa.Table:
+        """Reorder/cast file data to the plan's declared schema (a user-set
+        read schema may differ from the file's natural order and types)."""
+        arrays = []
+        names = []
+        for f in schema:
+            at = ai.spec_type_to_arrow(f.dtype)
+            if f.name in table.column_names:
+                col = table.column(f.name)
+                if col.type != at:
+                    col = col.cast(at, safe=False)
+            else:
+                col = pa.nulls(table.num_rows, type=at)
+            arrays.append(col)
+            names.append(f.name)
+        return pa.table(dict(zip(names, arrays)))
+
+    def _exec_OneRowExec(self, p: pn.OneRowExec) -> HostBatch:
+        sel = np.zeros(8, dtype=bool)
+        sel[0] = True
+        return HostBatch(DeviceBatch({}, jnp.asarray(sel)), {})
+
+    def _exec_ValuesExec(self, p: pn.ValuesExec) -> HostBatch:
+        arrays = []
+        for j, f in enumerate(p.out_schema):
+            vals = [row[j] for row in p.rows]
+            at = ai.spec_type_to_arrow(f.dtype)
+            arrays.append(pa.array([v.value for v in vals], type=at))
+        table = pa.table(dict(zip([_col_name(j) for j in range(len(arrays))], arrays)))
+        return ai.from_arrow(table)
+
+    def _exec_RangeExec(self, p: pn.RangeExec) -> HostBatch:
+        n = max(0, -(-(p.end - p.start) // p.step)) if p.step else 0
+        vals = np.arange(p.start, p.end, p.step, dtype=np.int64)
+        table = pa.table({"c0": pa.array(vals, type=pa.int64())})
+        return ai.from_arrow(table)
+
+    # ------------------------------------------------------------------
+    # unary operators
+    # ------------------------------------------------------------------
+    def _exec_ProjectExec(self, p: pn.ProjectExec) -> HostBatch:
+        child = self.run(p.input)
+        comp = self._compiler(child, p.input.schema)
+        dev = child.device
+        out_cols: Dict[str, Column] = {}
+        out_dicts: Dict[str, pa.Array] = {}
+        for i, (name, e) in enumerate(p.exprs):
+            c = comp.compile(e)
+            data, validity = self._eval(c, child)
+            key = _col_name(i)
+            odt = rx.rex_type(e)
+            jdt = physical_jnp_dtype(odt)
+            if data.dtype != jnp.dtype(jdt):
+                data = data.astype(jdt)
+            out_cols[key] = Column(data, validity, odt)
+            if c.dictionary is not None:
+                out_dicts[key] = c.dictionary
+        if not out_cols:  # SELECT of zero columns
+            return HostBatch(DeviceBatch({}, dev.sel), {})
+        return HostBatch(DeviceBatch(out_cols, dev.sel), out_dicts)
+
+    def _exec_FilterExec(self, p: pn.FilterExec) -> HostBatch:
+        child = self.run(p.input)
+        comp = self._compiler(child, p.input.schema)
+        c = comp.compile(p.condition)
+        data, validity = self._eval(c, child)
+        keep = data.astype(jnp.bool_)
+        if validity is not None:
+            keep = keep & validity
+        dev = child.device
+        return HostBatch(dev.with_sel(dev.sel & keep), child.dicts)
+
+    def _exec_LimitExec(self, p: pn.LimitExec) -> HostBatch:
+        child = self.run(p.input)
+        dev = child.device
+        if p.offset == -1:  # tail
+            n = int(dev.num_rows())
+            off = max(0, n - (p.limit or 0))
+            out = sortk.limit(dev, p.limit or 0, off)
+        else:
+            out = sortk.limit(dev, p.limit if p.limit is not None else dev.capacity,
+                              p.offset)
+        return HostBatch(out, child.dicts)
+
+    def _exec_SortExec(self, p: pn.SortExec) -> HostBatch:
+        child = self.run(p.input)
+        comp = self._compiler(child, p.input.schema)
+        keys = []
+        for k in p.keys:
+            c = comp.compile(k.expr)
+            data, validity = self._eval(c, child)
+            kdt = rx.rex_type(k.expr)
+            if c.dictionary is not None:
+                ranks = ai.dictionary_ranks(c.dictionary)
+                data = jnp.asarray(ranks)[data]
+                kdt = dt.IntegerType()
+            keys.append((data, validity, kdt, k.ascending, k.nulls_first))
+        perm = sortk.lexsort_perm(keys, child.device.sel)
+        out = sortk.take_batch(child.device, perm)
+        if p.limit is not None:
+            out = sortk.limit(out, p.limit)
+            out = _shrink(out, p.limit)
+        return HostBatch(out, child.dicts)
+
+    def _exec_AggregateExec(self, p: pn.AggregateExec) -> HostBatch:
+        child = self.run(p.input)
+        dev = child.device
+        key_cols = [dev.columns[_col_name(i)] for i in p.group_indices]
+        if p.group_indices:
+            max_groups = p.max_groups_hint or dev.capacity
+        else:
+            max_groups = 1
+        ctx, sorted_keys = aggk.group_rows(key_cols, dev.sel, max_groups)
+        if p.max_groups_hint and bool(aggk.group_overflow(ctx)):
+            ctx, sorted_keys = aggk.group_rows(key_cols, dev.sel, dev.capacity)
+        out_cols: Dict[str, Column] = {}
+        out_dicts: Dict[str, pa.Array] = {}
+        gsel = aggk.group_sel(ctx)
+        gkeys = aggk.group_key_output(ctx, sorted_keys)
+        for j, gi in enumerate(p.group_indices):
+            key = _col_name(j)
+            out_cols[key] = gkeys[j]
+            src = _col_name(gi)
+            if src in child.dicts:
+                out_dicts[key] = child.dicts[src]
+        ng = len(p.group_indices)
+        for j, a in enumerate(p.aggs):
+            key = _col_name(ng + j)
+            arg = None if a.arg is None else dev.columns[_col_name(a.arg)]
+            col = self._run_agg(ctx, a, arg)
+            out_cols[key] = col
+            if a.arg is not None and a.fn in ("min", "max", "first", "last"):
+                src = _col_name(a.arg)
+                if src in child.dicts:
+                    out_dicts[key] = child.dicts[src]
+        out = DeviceBatch(out_cols, gsel) if out_cols else \
+            DeviceBatch({}, gsel)
+        # shrink to the live group count (host sync)
+        n_groups = int(ctx.num_groups)
+        out = _shrink(out, n_groups)
+        return HostBatch(out, out_dicts)
+
+    def _run_agg(self, ctx, a: pn.AggSpec, arg: Optional[Column]) -> Column:
+        if a.fn == "count":
+            return aggk.agg_count(ctx, arg)
+        if a.fn == "sum":
+            return aggk.agg_sum(ctx, arg, a.out_dtype)
+        if a.fn == "min":
+            return aggk.agg_min_max(ctx, arg, is_min=True)
+        if a.fn == "max":
+            return aggk.agg_min_max(ctx, arg, is_min=False)
+        if a.fn == "first":
+            return aggk.agg_first_last(ctx, arg, is_first=True,
+                                       ignore_nulls=a.ignore_nulls)
+        if a.fn == "last":
+            return aggk.agg_first_last(ctx, arg, is_first=False,
+                                       ignore_nulls=a.ignore_nulls)
+        if a.fn == "bool_and":
+            return aggk.agg_bool(ctx, arg, is_any=False)
+        if a.fn == "bool_or":
+            return aggk.agg_bool(ctx, arg, is_any=True)
+        raise ExecutionError(f"aggregate {a.fn!r} not implemented")
+
+    # ------------------------------------------------------------------
+    # joins
+    # ------------------------------------------------------------------
+    def _exec_JoinExec(self, p: pn.JoinExec) -> HostBatch:
+        left = self.run(p.left)
+        right = self.run(p.right)
+        jt = p.join_type
+        if jt == "cross" and not p.left_keys:
+            out = self._cross_join(p, left, right)
+            if p.residual is not None:
+                comb_schema = tuple(p.left.schema) + tuple(p.right.schema)
+                comp = ExprCompiler(
+                    [f.dtype for f in comb_schema],
+                    {i: out.dicts[_col_name(i)] for i in range(len(comb_schema))
+                     if _col_name(i) in out.dicts},
+                    self._subquery_cache)
+                c = comp.compile(p.residual)
+                data, validity = self._eval(c, out)
+                keep = data.astype(jnp.bool_)
+                if validity is not None:
+                    keep = keep & validity
+                out = HostBatch(out.device.with_sel(out.device.sel & keep),
+                                out.dicts)
+            return out
+        if jt == "right":
+            flipped = pn.JoinExec(p.right, p.left, "left", p.right_keys,
+                                  p.left_keys,
+                                  _flip_residual(p.residual, len(p.left.schema),
+                                                 len(p.right.schema)))
+            out = self._join(flipped, right, left)
+            return _reorder_right(out, len(p.right.schema), len(p.left.schema))
+        return self._join(p, left, right)
+
+    def _join(self, p: pn.JoinExec, left: HostBatch, right: HostBatch) -> HostBatch:
+        jt = p.join_type
+        lcomp = self._compiler(left, p.left.schema)
+        rcomp = self._compiler(right, p.right.schema)
+        lkeys, rkeys, lkey_dicts = [], [], []
+        for lk, rk in zip(p.left_keys, p.right_keys):
+            lc = lcomp.compile(lk)
+            rc = rcomp.compile(rk)
+            ld, lv = self._eval(lc, left)
+            rd, rv = self._eval(rc, right)
+            ktype = rx.rex_type(lk)
+            if lc.dictionary is not None or rc.dictionary is not None:
+                merged, ra, rb = ai.unify_dictionaries(lc.dictionary, rc.dictionary)
+                ld = jnp.asarray(ra)[ld]
+                rd = jnp.asarray(rb)[rd]
+                ktype = dt.IntegerType()
+            lkeys.append(Column(ld, lv, ktype))
+            rkeys.append(Column(rd, rv, ktype))
+        # build on the right side
+        for seed in range(4):
+            bt = joink.build_side(rkeys, right.device.sel, seed)
+            if bt.exact or not bool(joink.hash_ambiguous(bt, rkeys)):
+                break
+        else:
+            raise ExecutionError("could not build unambiguous hash join")
+        ranges = joink.probe_ranges(bt, lkeys, left.device.sel,
+                                    build_key_cols=rkeys if not bt.exact else None)
+        merged_dicts = dict(left.dicts)
+        right_names = {}
+        n_left = len(p.left.schema)
+        # rename right columns to combined positions
+        r_dev_cols = {}
+        for i in range(len(p.right.schema)):
+            r_dev_cols[_col_name(n_left + i)] = right.device.columns[_col_name(i)]
+            if _col_name(i) in right.dicts:
+                merged_dicts[_col_name(n_left + i)] = right.dicts[_col_name(i)]
+        build_payload = DeviceBatch(r_dev_cols, right.device.sel)
+        build_names = list(r_dev_cols.keys()) if jt not in ("semi", "anti") else []
+
+        has_dup = bool(joink.has_duplicate_build_keys(bt))
+        if not has_dup and p.residual is None:
+            out_dev = joink.join_unique(bt, ranges, left.device, build_payload,
+                                        jt, build_names)
+            out_dicts = merged_dicts if jt not in ("semi", "anti") else left.dicts
+            return HostBatch(out_dev, out_dicts)
+        return self._join_expand(p, left, right, bt, ranges, build_payload,
+                                 build_names, merged_dicts)
+
+    def _join_expand(self, p: pn.JoinExec, left: HostBatch, right: HostBatch,
+                     bt, ranges, build_payload, build_names, merged_dicts) -> HostBatch:
+        jt = p.join_type
+        n_left = len(p.left.schema)
+        total = int(joink.join_output_count(ranges, left.device.sel, "inner"))
+        cap = round_capacity(max(total, 1))
+        res = joink.join_expand(bt, ranges, left.device, build_payload,
+                                "inner", list(build_payload.columns.keys()),
+                                cap)
+        exp_batch, pi, is_match = res.batch, res.probe_index, res.is_match
+        ok = exp_batch.sel
+        if p.residual is not None:
+            comb_schema = tuple(p.left.schema) + tuple(p.right.schema)
+            comp = ExprCompiler([f.dtype for f in comb_schema],
+                                {i: merged_dicts[_col_name(i)]
+                                 for i in range(len(comb_schema))
+                                 if _col_name(i) in merged_dicts},
+                                self._subquery_cache)
+            c = comp.compile(p.residual)
+            cols = [(exp_batch.columns[_col_name(i)].data,
+                     exp_batch.columns[_col_name(i)].validity)
+                    for i in range(len(comb_schema))]
+            rdat, rval = c.fn(cols)
+            res_ok = rdat.astype(jnp.bool_)
+            if rval is not None:
+                res_ok = res_ok & rval
+            ok = ok & res_ok
+        if jt == "inner":
+            return HostBatch(exp_batch.with_sel(ok), merged_dicts)
+        # probe rows with >= 1 surviving match
+        probe_cap = left.device.capacity
+        matched_probe = jnp.zeros(probe_cap, dtype=jnp.bool_).at[pi].max(
+            ok, mode="drop")
+        if jt == "semi":
+            return HostBatch(left.device.with_sel(left.device.sel & matched_probe),
+                             left.dicts)
+        if jt == "anti":
+            return HostBatch(left.device.with_sel(left.device.sel & ~matched_probe),
+                             left.dicts)
+        if jt in ("left", "full"):
+            # surviving inner rows + unmatched probe rows with null build cols
+            unmatched = left.device.sel & ~matched_probe
+            out_cap = cap + probe_cap
+            cols = {}
+            for i in range(n_left):
+                key = _col_name(i)
+                ec = exp_batch.columns[key]
+                lc = left.device.columns[key]
+                data = jnp.concatenate([ec.data, lc.data])
+                validity = None
+                if ec.validity is not None or lc.validity is not None:
+                    ev = ec.validity if ec.validity is not None else \
+                        jnp.ones(cap, dtype=jnp.bool_)
+                    lv = lc.validity if lc.validity is not None else \
+                        jnp.ones(probe_cap, dtype=jnp.bool_)
+                    validity = jnp.concatenate([ev, lv])
+                cols[key] = Column(data, validity, ec.dtype)
+            for key in build_payload.columns:
+                ec = exp_batch.columns[key]
+                pad_v = jnp.zeros(probe_cap, dtype=jnp.bool_)
+                ev = ec.validity if ec.validity is not None else \
+                    jnp.ones(cap, dtype=jnp.bool_)
+                cols[key] = Column(
+                    jnp.concatenate([ec.data, jnp.zeros(probe_cap, dtype=ec.data.dtype)]),
+                    jnp.concatenate([ev, pad_v]), ec.dtype)
+            sel = jnp.concatenate([ok, unmatched])
+            out = DeviceBatch(cols, sel)
+            if jt == "full":
+                out = self._append_unmatched_build(out, p, bt, ranges, left,
+                                                   build_payload, ok, pi)
+            return HostBatch(out, merged_dicts)
+        raise ExecutionError(f"join type {jt!r} not implemented")
+
+    def _append_unmatched_build(self, out: DeviceBatch, p, bt, ranges, left,
+                                build_payload, ok, pi) -> DeviceBatch:
+        # NOTE: residual-filtered matches are conservatively treated as
+        # matches for the build side in v0 full outer joins.
+        matched_build = joink.build_matched_mask(bt, ranges, left.device.sel)
+        unmatched = build_payload.sel & ~matched_build
+        n_left = len(p.left.schema)
+        bcap = matched_build.shape[0]
+        cols = {}
+        for i in range(n_left):
+            key = _col_name(i)
+            c = out.columns[key]
+            cols[key] = Column(
+                jnp.concatenate([c.data, jnp.zeros(bcap, dtype=c.data.dtype)]),
+                jnp.concatenate([c.validity if c.validity is not None
+                                 else jnp.ones(c.data.shape[0], dtype=jnp.bool_),
+                                 jnp.zeros(bcap, dtype=jnp.bool_)]), c.dtype)
+        for key, c in build_payload.columns.items():
+            oc = out.columns[key]
+            v = c.validity if c.validity is not None else jnp.ones(bcap, dtype=jnp.bool_)
+            cols[key] = Column(
+                jnp.concatenate([oc.data, c.data]),
+                jnp.concatenate([oc.validity if oc.validity is not None
+                                 else jnp.ones(oc.data.shape[0], dtype=jnp.bool_), v]),
+                c.dtype)
+        sel = jnp.concatenate([out.sel, unmatched])
+        return DeviceBatch(cols, sel)
+
+    def _cross_join(self, p: pn.JoinExec, left: HostBatch, right: HostBatch) -> HostBatch:
+        n_left_rows = int(left.device.num_rows())
+        n_right_rows = int(right.device.num_rows())
+        total = n_left_rows * n_right_rows
+        cap = round_capacity(max(total, 1))
+        lcomp = sortk.compact(left.device)
+        rcomp_d = sortk.compact(right.device)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        li = jnp.clip(idx // max(n_right_rows, 1), 0, left.device.capacity - 1)
+        ri = jnp.clip(idx % max(n_right_rows, 1), 0, right.device.capacity - 1)
+        sel = idx < total
+        cols = {}
+        n_left = len(p.left.schema)
+        for i in range(n_left):
+            c = lcomp.columns[_col_name(i)]
+            cols[_col_name(i)] = Column(c.data[li],
+                                        None if c.validity is None else c.validity[li],
+                                        c.dtype)
+        dicts = dict(left.dicts)
+        for i in range(len(p.right.schema)):
+            c = rcomp_d.columns[_col_name(i)]
+            cols[_col_name(n_left + i)] = Column(
+                c.data[ri], None if c.validity is None else c.validity[ri], c.dtype)
+            if _col_name(i) in right.dicts:
+                dicts[_col_name(n_left + i)] = right.dicts[_col_name(i)]
+        return HostBatch(DeviceBatch(cols, sel), dicts)
+
+    # ------------------------------------------------------------------
+    def _exec_UnionExec(self, p: pn.UnionExec) -> HostBatch:
+        parts = [self.run(c) for c in p.inputs]
+        ncols = len(p.schema)
+        total_cap = sum(b.device.capacity for b in parts)
+        cols = {}
+        dicts = {}
+        for i in range(ncols):
+            key = _col_name(i)
+            f = p.schema[i]
+            str_col = any(key in b.dicts for b in parts)
+            if str_col:
+                from ..plan.compiler import _merge_dicts
+                merged, remaps = _merge_dicts([b.dicts[key] for b in parts])
+                datas = [jnp.asarray(rm)[b.device.columns[key].data]
+                         for rm, b in zip(remaps, parts)]
+                dicts[key] = merged
+            else:
+                jdt = physical_jnp_dtype(f.dtype)
+                datas = [b.device.columns[key].data.astype(jdt) for b in parts]
+            data = jnp.concatenate(datas)
+            validities = []
+            has_v = any(b.device.columns[key].validity is not None for b in parts)
+            if has_v:
+                for b in parts:
+                    v = b.device.columns[key].validity
+                    validities.append(v if v is not None else
+                                      jnp.ones(b.device.capacity, dtype=jnp.bool_))
+                validity = jnp.concatenate(validities)
+            else:
+                validity = None
+            cols[key] = Column(data, validity, f.dtype)
+        sel = jnp.concatenate([b.device.sel for b in parts])
+        return HostBatch(DeviceBatch(cols, sel), dicts)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _positional(hb: HostBatch) -> HostBatch:
+    """Rename columns to positional keys c0..cn."""
+    dev = hb.device
+    cols = {}
+    dicts = {}
+    for i, (name, col) in enumerate(dev.columns.items()):
+        cols[_col_name(i)] = col
+        if name in hb.dicts:
+            dicts[_col_name(i)] = hb.dicts[name]
+    return HostBatch(DeviceBatch(cols, dev.sel), dicts)
+
+
+def _shrink(dev: DeviceBatch, n_live: int) -> DeviceBatch:
+    """Slice a front-compacted batch down to a smaller padded capacity."""
+    cap = round_capacity(max(n_live, 1))
+    if cap >= dev.capacity:
+        return dev
+    cols = {n: Column(c.data[:cap],
+                      None if c.validity is None else c.validity[:cap], c.dtype)
+            for n, c in dev.columns.items()}
+    return DeviceBatch(cols, dev.sel[:cap])
+
+
+def _flip_residual(r: Optional[rx.Rex], n_left: int, n_right: int) -> Optional[rx.Rex]:
+    if r is None:
+        return None
+
+    def flip(x: rx.Rex) -> rx.Rex:
+        if isinstance(x, rx.BoundRef):
+            if x.index < n_left:
+                return dataclasses.replace(x, index=x.index + n_right)
+            return dataclasses.replace(x, index=x.index - n_left)
+        if isinstance(x, rx.RCall):
+            return dataclasses.replace(x, args=tuple(flip(a) for a in x.args))
+        if isinstance(x, rx.RCast):
+            return dataclasses.replace(x, child=flip(x.child))
+        if isinstance(x, rx.RCase):
+            return dataclasses.replace(
+                x, branches=tuple((flip(c), flip(v)) for c, v in x.branches),
+                else_value=None if x.else_value is None else flip(x.else_value))
+        return x
+
+    return flip(r)
+
+
+def _reorder_right(hb: HostBatch, n_right: int, n_left: int) -> HostBatch:
+    """After executing a flipped right join (as left join with sides swapped),
+    restore the original column order: right-output cols [0..n_right) move
+    after the left cols."""
+    dev = hb.device
+    cols = {}
+    dicts = {}
+    for i in range(n_left):
+        src = _col_name(n_right + i)
+        cols[_col_name(i)] = dev.columns[src]
+        if src in hb.dicts:
+            dicts[_col_name(i)] = hb.dicts[src]
+    for i in range(n_right):
+        src = _col_name(i)
+        cols[_col_name(n_left + i)] = dev.columns[src]
+        if src in hb.dicts:
+            dicts[_col_name(n_left + i)] = hb.dicts[src]
+    return HostBatch(DeviceBatch(cols, dev.sel), dicts)
+
+
+def _node_rex(p: pn.PlanNode):
+    if isinstance(p, pn.FilterExec):
+        yield p.condition
+    elif isinstance(p, pn.ProjectExec):
+        for _, e in p.exprs:
+            yield e
+    elif isinstance(p, pn.JoinExec):
+        yield from p.left_keys
+        yield from p.right_keys
+        if p.residual is not None:
+            yield p.residual
+    elif isinstance(p, pn.SortExec):
+        for k in p.keys:
+            yield k.expr

@@ -1,0 +1,2 @@
+"""Function library: registry (type rules) + device kernels
+(reference role: sail-function + sail-plan function registry)."""

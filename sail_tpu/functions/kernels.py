@@ -1,0 +1,213 @@
+"""Elementwise device kernels with Spark null semantics.
+
+Reference role: the scalar portion of sail-function's Spark-semantics Arrow
+kernels (crates/sail-function/src/scalar/) — here as jnp closures that XLA
+fuses into surrounding operators. A column value is ``CV = (data, validity)``
+where validity is None for non-nullable.
+
+Most kernels are "strict" (null in → null out); AND/OR implement Kleene
+logic; null-handling functions (coalesce, nullif, …) are explicit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CV = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+
+
+def merge_validity(*vs) -> Optional[jnp.ndarray]:
+    out = None
+    for v in vs:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
+def strict(fn: Callable[..., jnp.ndarray]) -> Callable[..., CV]:
+    def wrapped(*args: CV) -> CV:
+        data = fn(*[a[0] for a in args])
+        return data, merge_validity(*[a[1] for a in args])
+    return wrapped
+
+
+# -- arithmetic --------------------------------------------------------------
+
+def kleene_and(a: CV, b: CV) -> CV:
+    av, bv = a[1], b[1]
+    ad, bd = a[0].astype(jnp.bool_), b[0].astype(jnp.bool_)
+    a_false = ad == False if av is None else (av & ~ad)  # noqa: E712
+    b_false = bd == False if bv is None else (bv & ~bd)  # noqa: E712
+    data = ad & bd
+    if av is None and bv is None:
+        return data, None
+    valid = a_false | b_false | (
+        (jnp.ones_like(ad) if av is None else av)
+        & (jnp.ones_like(bd) if bv is None else bv))
+    return data & ~a_false & ~b_false | jnp.zeros_like(data), valid
+
+
+def kleene_or(a: CV, b: CV) -> CV:
+    av, bv = a[1], b[1]
+    ad, bd = a[0].astype(jnp.bool_), b[0].astype(jnp.bool_)
+    a_true = ad if av is None else (av & ad)
+    b_true = bd if bv is None else (bv & bd)
+    data = ad | bd
+    if av is None and bv is None:
+        return data, None
+    valid = a_true | b_true | (
+        (jnp.ones_like(ad) if av is None else av)
+        & (jnp.ones_like(bd) if bv is None else bv))
+    return a_true | b_true, valid
+
+
+def not_(a: CV) -> CV:
+    return ~a[0].astype(jnp.bool_), a[1]
+
+
+def isnull(a: CV) -> CV:
+    if a[1] is None:
+        return jnp.zeros(a[0].shape[0], dtype=jnp.bool_), None
+    return ~a[1], None
+
+
+def isnotnull(a: CV) -> CV:
+    if a[1] is None:
+        return jnp.ones(a[0].shape[0], dtype=jnp.bool_), None
+    return a[1], None
+
+
+def coalesce(*args: CV) -> CV:
+    data = args[-1][0]
+    validity = args[-1][1]
+    for d, v in reversed(args[:-1]):
+        if v is None:
+            data, validity = d, None
+        else:
+            data = jnp.where(v, d.astype(data.dtype), data)
+            validity = v if validity is None else (v | validity)
+    return data, validity
+
+
+def nullif(a: CV, b: CV) -> CV:
+    eq = a[0] == b[0]
+    eq_valid = merge_validity(a[1], b[1])
+    make_null = eq if eq_valid is None else (eq & eq_valid)
+    validity = jnp.ones_like(make_null) if a[1] is None else a[1]
+    return a[0], validity & ~make_null
+
+
+def if_(cond: CV, t: CV, f: CV) -> CV:
+    c = cond[0].astype(jnp.bool_)
+    if cond[1] is not None:
+        c = c & cond[1]
+    data = jnp.where(c, t[0].astype(f[0].dtype), f[0])
+    tv = t[1] if t[1] is not None else jnp.ones_like(c)
+    fv = f[1] if f[1] is not None else jnp.ones_like(c)
+    validity = jnp.where(c, tv, fv)
+    if t[1] is None and f[1] is None:
+        return data, None
+    return data, validity
+
+
+def eq_null_safe(a: CV, b: CV) -> CV:
+    """<=> : null <=> null is true, null <=> x is false."""
+    eq = _nan_eq(a[0], b[0])
+    av = a[1] if a[1] is not None else jnp.ones(a[0].shape[0], dtype=jnp.bool_)
+    bv = b[1] if b[1] is not None else jnp.ones(b[0].shape[0], dtype=jnp.bool_)
+    return (av & bv & eq) | (~av & ~bv), None
+
+
+def _nan_eq(x, y):
+    eq = x == y
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        eq = eq | (jnp.isnan(x) & jnp.isnan(y))
+    return eq
+
+
+def div(a: CV, b: CV) -> CV:
+    """Spark division: x/0 → NULL (non-ANSI)."""
+    bd = b[0]
+    zero = bd == 0
+    safe = jnp.where(zero, jnp.ones_like(bd), bd)
+    data = a[0] / safe
+    validity = merge_validity(a[1], b[1])
+    nz = ~zero
+    validity = nz if validity is None else (validity & nz)
+    return data, validity
+
+
+def int_div(a: CV, b: CV) -> CV:
+    bd = b[0]
+    zero = bd == 0
+    safe = jnp.where(zero, jnp.ones_like(bd), bd)
+    data = (a[0] / safe).astype(jnp.int64) if jnp.issubdtype(a[0].dtype, jnp.floating) \
+        else jax.lax.div(a[0], safe.astype(a[0].dtype))
+    validity = merge_validity(a[1], b[1])
+    nz = ~zero
+    return data, nz if validity is None else (validity & nz)
+
+
+def mod(a: CV, b: CV) -> CV:
+    bd = b[0]
+    zero = bd == 0
+    safe = jnp.where(zero, jnp.ones_like(bd), bd)
+    data = jax.lax.rem(a[0], safe.astype(a[0].dtype))
+    validity = merge_validity(a[1], b[1])
+    nz = ~zero
+    return data, nz if validity is None else (validity & nz)
+
+
+def pmod(a: CV, b: CV) -> CV:
+    d, v = mod(a, b)
+    fixed = jnp.where((d != 0) & ((d < 0) != (b[0] < 0)), d + b[0].astype(d.dtype), d)
+    return fixed, v
+
+
+def round_half_up(a: CV, digits: int = 0) -> CV:
+    x = a[0]
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return a
+    scale = 10.0 ** digits
+    y = x * scale
+    r = jnp.sign(y) * jnp.floor(jnp.abs(y) + 0.5) / scale
+    return r, a[1]
+
+
+def greatest(*args: CV) -> CV:
+    """Spark greatest: skips nulls, null only if all null."""
+    return _extreme(args, is_max=True)
+
+
+def least(*args: CV) -> CV:
+    return _extreme(args, is_max=False)
+
+
+def _extreme(args: Sequence[CV], is_max: bool) -> CV:
+    any_valid = None
+    acc_d = None
+    for d, v in args:
+        if acc_d is None:
+            acc_d = d
+            acc_v = v
+            any_valid = v
+            continue
+        both = merge_validity(acc_v, v)
+        pick_new = (d > acc_d) if is_max else (d < acc_d)
+        if v is not None:
+            use_new = v & (pick_new if acc_v is None else (~acc_v | pick_new))
+        else:
+            use_new = pick_new if acc_v is None else (~acc_v | pick_new)
+        acc_d = jnp.where(use_new, d.astype(acc_d.dtype), acc_d)
+        if acc_v is None and v is None:
+            acc_v = None
+        else:
+            av = acc_v if acc_v is not None else jnp.ones_like(use_new)
+            vv = v if v is not None else jnp.ones_like(use_new)
+            acc_v = av | vv
+    return acc_d, acc_v

@@ -1,0 +1,1 @@
+"""Data-source formats (reference role: sail-data-source)."""

@@ -170,9 +170,15 @@ def join_output_count(ranges: MatchRanges, probe_sel, join_type: str) -> jnp.nda
     return jnp.sum(cnt.astype(jnp.int64))
 
 
+class ExpandResult(NamedTuple):
+    batch: DeviceBatch
+    probe_index: jnp.ndarray  # int32[out_capacity] originating probe row
+    is_match: jnp.ndarray     # bool[out_capacity] row is a key match
+
+
 def join_expand(bt: BuildTable, ranges: MatchRanges, probe: DeviceBatch,
                 build_payload: DeviceBatch, join_type: str,
-                build_names: Sequence[str], out_capacity: int) -> DeviceBatch:
+                build_names: Sequence[str], out_capacity: int) -> ExpandResult:
     """Materialize a many-to-many join into a batch of static capacity.
 
     join_type ∈ {inner, left}. (right/full are planned as swapped/left+anti
@@ -205,7 +211,7 @@ def join_expand(bt: BuildTable, ranges: MatchRanges, probe: DeviceBatch,
         data = c.data[bidx]
         validity = is_match if c.validity is None else is_match & c.validity[bidx]
         cols[name] = Column(data, validity, c.dtype)
-    return DeviceBatch(cols, out_sel)
+    return ExpandResult(DeviceBatch(cols, out_sel), pi, is_match)
 
 
 def build_matched_mask(bt: BuildTable, ranges: MatchRanges, probe_sel) -> jnp.ndarray:

@@ -1,0 +1,2 @@
+"""Planning: resolved expressions (rex), plan nodes, resolver, optimizer,
+expression compiler (reference role: sail-plan + sail-*-optimizer)."""

@@ -1,0 +1,1023 @@
+"""Expression compiler: resolved expressions → device closures.
+
+Binds a Rex tree against a concrete batch schema (+ its host-side string
+dictionaries) and produces a closure over column arrays that jit traces into
+fused XLA. The central TPU-first idea for strings: **string kernels never
+run on device**. A string function is applied to the (small) dictionary on
+host at bind time, producing either a transformed dictionary (codes pass
+through) or a lookup table the device gathers through. Cross-column string
+comparisons unify dictionaries at bind time and compare remapped codes.
+
+Reference role: DataFusion PhysicalExpr evaluation + sail-function string
+kernels (SURVEY.md §2.6), re-architected for dictionary/HBM execution.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..columnar.batch import DeviceBatch, physical_jnp_dtype
+from ..functions import kernels as K
+from ..spec import data_type as dt
+from ..spec.literal import Literal as LV
+from . import rex as rx
+
+CV = K.CV
+
+
+@dataclass
+class Compiled:
+    """A bind-time-compiled expression."""
+
+    fn: Callable[[List[CV]], CV]  # cols by position → value
+    dtype: dt.DataType
+    dictionary: Optional[pa.Array] = None  # for string/binary outputs
+
+
+def _is_str(d: dt.DataType) -> bool:
+    return isinstance(d, (dt.StringType, dt.BinaryType))
+
+
+def _dict_strings(dictionary: pa.Array) -> List[Optional[str]]:
+    return dictionary.cast(pa.string()).to_pylist()
+
+
+def like_pattern_to_regex(pattern: str, escape: Optional[str] = None) -> str:
+    esc = escape or "\\"
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == esc and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "".join(out)
+
+
+class ExprCompiler:
+    """Compiles Rex against (schema types, dictionaries)."""
+
+    def __init__(self, column_types: Sequence[dt.DataType],
+                 dictionaries: Dict[int, pa.Array],
+                 subquery_values: Optional[Dict[int, LV]] = None):
+        self.column_types = list(column_types)
+        self.dicts = dictionaries  # column index → dictionary
+        self.subquery_values = subquery_values or {}
+
+    # -- public ---------------------------------------------------------
+    def compile(self, r: rx.Rex) -> Compiled:
+        if isinstance(r, rx.BoundRef):
+            idx = r.index
+            return Compiled(lambda cols, i=idx: cols[i], r.dtype,
+                            self.dicts.get(idx))
+        if isinstance(r, rx.RLit):
+            return self._compile_literal(r.value)
+        if isinstance(r, rx.RScalarSubquery):
+            key = id(r)
+            if key not in self.subquery_values:
+                raise RuntimeError("scalar subquery not pre-evaluated")
+            return self._compile_literal(self.subquery_values[key])
+        if isinstance(r, rx.RCast):
+            return self._compile_cast(r)
+        if isinstance(r, rx.RCase):
+            return self._compile_case(r)
+        if isinstance(r, rx.RCall):
+            return self._compile_call(r)
+        raise TypeError(f"cannot compile {type(r).__name__}")
+
+    # -- literals ---------------------------------------------------------
+    def _compile_literal(self, v: LV) -> Compiled:
+        d = v.data_type
+        if v.is_null:
+            jdt = physical_jnp_dtype(d if d.physical_dtype else dt.NullType())
+
+            def null_fn(cols, jdt=jdt):
+                n = cols[0][0].shape[0] if cols else 1
+                return (jnp.zeros(n, dtype=jdt), jnp.zeros(n, dtype=jnp.bool_))
+
+            return Compiled(null_fn, d)
+        if _is_str(d):
+            dictionary = pa.array([v.value])
+
+            def str_fn(cols):
+                n = cols[0][0].shape[0] if cols else 1
+                return jnp.zeros(n, dtype=jnp.int32), None
+
+            return Compiled(str_fn, d, dictionary)
+        pv = v.physical_value()
+        jdt = physical_jnp_dtype(d)
+
+        def lit_fn(cols, pv=pv, jdt=jdt):
+            n = cols[0][0].shape[0] if cols else 1
+            return jnp.full(n, pv, dtype=jdt), None
+
+        return Compiled(lit_fn, d)
+
+    # -- casts -----------------------------------------------------------
+    def _compile_cast(self, r: rx.RCast) -> Compiled:
+        child = self.compile(r.child)
+        src, dst = child.dtype, r.dtype
+        if src == dst:
+            return child
+        if _is_str(src):
+            return self._cast_from_string(child, dst, r.try_)
+        if _is_str(dst):
+            return self._cast_to_string(child, dst)
+        jdt = physical_jnp_dtype(dst)
+
+        def is_dec(d):
+            return isinstance(d, dt.DecimalType) and d.physical_dtype == "int64"
+
+        src_scale = src.scale if is_dec(src) else 0
+        dst_scale = dst.scale if is_dec(dst) else 0
+
+        def fn(cols):
+            data, validity = child.fn(cols)
+            x = data
+            if is_dec(src) and not is_dec(dst):
+                x = x.astype(jnp.float64) / (10.0 ** src_scale)
+            if is_dec(dst):
+                if is_dec(src):
+                    if dst_scale >= src_scale:
+                        x = x * (10 ** (dst_scale - src_scale))
+                    else:
+                        # round-half-up rescale
+                        f = 10 ** (src_scale - dst_scale)
+                        x = jnp.sign(x) * ((jnp.abs(x) + f // 2) // f)
+                elif jnp.issubdtype(x.dtype, jnp.floating):
+                    y = x * (10.0 ** dst_scale)
+                    x = (jnp.sign(y) * jnp.floor(jnp.abs(y) + 0.5)).astype(jnp.int64)
+                else:
+                    x = x.astype(jnp.int64) * (10 ** dst_scale)
+            elif isinstance(dst, dt.BooleanType):
+                x = x != 0
+            elif jnp.issubdtype(jnp.dtype(jdt), jnp.integer) and \
+                    jnp.issubdtype(x.dtype, jnp.floating):
+                x = jnp.trunc(x)
+            return x.astype(jdt), validity
+
+        return Compiled(fn, dst)
+
+    def _cast_from_string(self, child: Compiled, dst: dt.DataType, try_: bool) -> Compiled:
+        values = _dict_strings(child.dictionary)
+        out_vals = []
+        ok = []
+        for s in values:
+            v, good = _parse_string_value(s, dst)
+            out_vals.append(v)
+            ok.append(good)
+        jdt = physical_jnp_dtype(dst)
+        lut = np.asarray(out_vals, dtype=jdt)
+        ok_lut = np.asarray(ok, dtype=bool)
+
+        def fn(cols, lut=lut, ok_lut=ok_lut):
+            data, validity = child.fn(cols)
+            vals = jnp.asarray(lut)[data]
+            good = jnp.asarray(ok_lut)[data]
+            v = good if validity is None else (validity & good)
+            return vals, v
+
+        return Compiled(fn, dst)
+
+    def _cast_to_string(self, child: Compiled, dst: dt.DataType) -> Compiled:
+        if _is_str(child.dtype):
+            return Compiled(child.fn, dst, child.dictionary)
+        # Non-string → string requires materializing distinct values; round-1
+        # supports the common cases via host formatting of a value LUT only
+        # when the child is itself dictionary-backed. General path: the
+        # executor falls back to host evaluation (to_arrow → pc.cast).
+        raise HostFallback("cast to string on a non-dictionary column")
+
+    # -- case ------------------------------------------------------------
+    def _compile_case(self, r: rx.RCase) -> Compiled:
+        branches = [(self.compile(c), self.compile(v)) for c, v in r.branches]
+        else_c = self.compile(r.else_value) if r.else_value is not None else None
+        if _is_str(r.dtype):
+            return self._compile_case_string(r, branches, else_c)
+        jdt = physical_jnp_dtype(r.dtype)
+
+        def fn(cols):
+            n = None
+            if else_c is not None:
+                acc, accv = else_c.fn(cols)
+                acc = acc.astype(jdt)
+            else:
+                acc = None
+                accv = None
+            for cond_c, val_c in reversed(branches):
+                cd, cv = cond_c.fn(cols)
+                cd = cd.astype(jnp.bool_)
+                if cv is not None:
+                    cd = cd & cv
+                vd, vv = val_c.fn(cols)
+                vd = vd.astype(jdt)
+                if acc is None:
+                    acc = jnp.zeros_like(vd)
+                    accv = jnp.zeros(vd.shape[0], dtype=jnp.bool_)
+                acc = jnp.where(cd, vd, acc)
+                new_v = vv if vv is not None else jnp.ones(vd.shape[0], dtype=jnp.bool_)
+                accv = jnp.where(cd, new_v,
+                                 accv if accv is not None else jnp.ones_like(new_v))
+            return acc, accv
+
+        nullable = r.else_value is None or any(True for _ in ())
+        return Compiled(fn, r.dtype)
+
+    def _compile_case_string(self, r, branches, else_c) -> Compiled:
+        # Merge all branch dictionaries into one, remap codes.
+        dicts = [v.dictionary for _, v in branches]
+        if else_c is not None:
+            dicts.append(else_c.dictionary)
+        merged, remaps = _merge_dicts(dicts)
+
+        def fn(cols):
+            if else_c is not None:
+                acc, accv = else_c.fn(cols)
+                acc = jnp.asarray(remaps[-1])[acc]
+            else:
+                acc = None
+                accv = None
+            for i, (cond_c, val_c) in reversed(list(enumerate(branches))):
+                cd, cv = cond_c.fn(cols)
+                cd = cd.astype(jnp.bool_)
+                if cv is not None:
+                    cd = cd & cv
+                vd, vv = val_c.fn(cols)
+                vd = jnp.asarray(remaps[i])[vd]
+                if acc is None:
+                    acc = jnp.zeros_like(vd)
+                    accv = jnp.zeros(vd.shape[0], dtype=jnp.bool_)
+                acc = jnp.where(cd, vd, acc)
+                new_v = vv if vv is not None else jnp.ones(vd.shape[0], dtype=jnp.bool_)
+                accv = jnp.where(cd, new_v,
+                                 accv if accv is not None else jnp.ones_like(new_v))
+            return acc, accv
+
+        return Compiled(fn, r.dtype, merged)
+
+    # -- calls -----------------------------------------------------------
+    def _compile_call(self, r: rx.RCall) -> Compiled:
+        args = [self.compile(a) for a in r.args]
+        name = r.fn
+        opts = dict(r.options)
+        str_args = [a for a in args if _is_str(a.dtype)]
+        if str_args:
+            out = self._compile_string_call(name, r, args, opts)
+            if out is not None:
+                return out
+        builder = _NUMERIC_BUILDERS.get(name)
+        if builder is None:
+            raise HostFallback(f"no device kernel for function {name!r}")
+        fn = builder(args, r, opts)
+        return Compiled(fn, r.dtype)
+
+    # -- string calls ------------------------------------------------------
+    def _compile_string_call(self, name, r, args, opts) -> Optional[Compiled]:
+        jdtype = r.dtype
+
+        def dict_of(a: Compiled) -> pa.Array:
+            if a.dictionary is None:
+                raise HostFallback(f"string arg without dictionary in {name}")
+            return a.dictionary
+
+        if name in ("==", "!=", "<", "<=", ">", ">=", "<=>"):
+            a, b = args
+            if _is_str(a.dtype) and _is_str(b.dtype):
+                da, db = dict_of(a), dict_of(b)
+                from ..columnar.arrow_interop import unify_dictionaries, dictionary_ranks
+                merged, ra, rb = unify_dictionaries(da, db)
+                if name in ("==", "!=", "<=>"):
+                    lut_a, lut_b = ra, rb
+                else:
+                    ranks = dictionary_ranks(merged)
+                    lut_a, lut_b = ranks[ra], ranks[rb]
+
+                def fn(cols, lut_a=lut_a, lut_b=lut_b):
+                    ad, av = a.fn(cols)
+                    bd, bv = b.fn(cols)
+                    x = jnp.asarray(lut_a)[ad]
+                    y = jnp.asarray(lut_b)[bd]
+                    res = _CMP_OPS[name](x, y)
+                    if name == "<=>":
+                        return K.eq_null_safe((x, av), (y, bv))
+                    return res, K.merge_validity(av, bv)
+
+                return Compiled(fn, dt.BooleanType())
+            # string vs non-string comparison: cast string side via LUT
+            sa = a if _is_str(a.dtype) else b
+            other = b if _is_str(a.dtype) else a
+            casted = self._cast_from_string(sa, other.dtype, try_=True)
+            new_args = (casted, other) if _is_str(a.dtype) else (other, casted)
+
+            def fn2(cols):
+                x = new_args[0].fn(cols)
+                y = new_args[1].fn(cols)
+                return _CMP_OPS[name](x[0], y[0]), K.merge_validity(x[1], y[1])
+
+            return Compiled(fn2, dt.BooleanType())
+
+        if name in ("like", "ilike", "rlike"):
+            child, pat = args
+            pat_dict = _dict_strings(dict_of(pat))
+            if len(pat_dict) != 1:
+                raise HostFallback("non-literal LIKE pattern")
+            pattern = pat_dict[0]
+            if name == "rlike":
+                rxp = re.compile(pattern)
+                match = rxp.search
+            else:
+                flags = re.IGNORECASE if name == "ilike" else 0
+                rxp = re.compile(like_pattern_to_regex(pattern, opts.get("escape")), flags)
+                match = rxp.fullmatch
+            vals = _dict_strings(dict_of(child))
+            lut = np.asarray([bool(v is not None and match(v)) for v in vals])
+
+            def fn3(cols, lut=lut):
+                dta, v = child.fn(cols)
+                return jnp.asarray(lut)[dta], v
+
+            return Compiled(fn3, dt.BooleanType())
+
+        if name == "in":
+            child = args[0]
+            if not _is_str(child.dtype):
+                return None
+            items = set()
+            for a in args[1:]:
+                items.update(x for x in _dict_strings(dict_of(a)))
+            vals = _dict_strings(dict_of(child))
+            lut = np.asarray([v in items for v in vals])
+
+            def fn4(cols, lut=lut):
+                dta, v = child.fn(cols)
+                return jnp.asarray(lut)[dta], v
+
+            return Compiled(fn4, dt.BooleanType())
+
+        # dictionary-transform functions: apply to dict values, codes pass through
+        transform = _STRING_TRANSFORMS.get(name)
+        if transform is not None:
+            child = args[0]
+            extra = []
+            for a in args[1:]:
+                if _is_str(a.dtype):
+                    ds = _dict_strings(dict_of(a))
+                    if len(ds) != 1:
+                        raise HostFallback(f"non-literal string argument to {name}")
+                    extra.append(ds[0])
+                else:
+                    lit = _extract_literal(a)
+                    if lit is None:
+                        raise HostFallback(f"non-literal argument to {name}")
+                    extra.append(lit)
+            vals = _dict_strings(dict_of(child))
+            out_vals = [None if v is None else transform(v, *extra) for v in vals]
+            if isinstance(r.dtype, (dt.StringType, dt.BinaryType)):
+                # canonicalize: transforms can map distinct inputs to equal
+                # outputs (substring!), and equality/grouping runs on codes —
+                # re-encode and remap so equal strings share one code.
+                new_dict, remap, null_out = _canonical_dict(out_vals)
+
+                def fn5(cols, remap=remap, null_out=null_out):
+                    d, v = child.fn(cols)
+                    mapped = jnp.asarray(remap)[d]
+                    if null_out is not None:
+                        good = jnp.asarray(null_out)[d]
+                        v = good if v is None else (v & good)
+                    return mapped, v
+
+                return Compiled(fn5, r.dtype, new_dict)
+            jdt = physical_jnp_dtype(r.dtype)
+            lut = np.asarray([0 if v is None else v for v in out_vals], dtype=jdt)
+            ok = np.asarray([v is not None for v in out_vals])
+
+            def fn6(cols, lut=lut, ok=ok):
+                dta, v = child.fn(cols)
+                data = jnp.asarray(lut)[dta]
+                good = jnp.asarray(ok)[dta]
+                return data, good if v is None else (v & good)
+
+            return Compiled(fn6, r.dtype)
+
+        if name == "concat":
+            # all-literal or col+literals: transform dict; col+col: host fallback
+            str_cols = [a for a in args if a.dictionary is not None
+                        and len(a.dictionary) > 1]
+            if len(str_cols) > 1:
+                raise HostFallback("concat of multiple string columns")
+            parts = []
+            col = None
+            col_pos = -1
+            for i, a in enumerate(args):
+                vals = _dict_strings(dict_of(a))
+                if len(vals) == 1 and not isinstance(a, Compiled):
+                    parts.append(vals[0])
+                if len(vals) == 1:
+                    parts.append(("lit", vals[0]))
+                else:
+                    col = a
+                    col_pos = i
+                    parts.append(("col", None))
+            if col is None:
+                text = "".join(p[1] or "" for p in parts)
+                return self._compile_literal(LV.string(text))
+            vals = _dict_strings(col.dictionary)
+            out_vals = []
+            for v in vals:
+                if v is None:
+                    out_vals.append(None)
+                else:
+                    out_vals.append("".join(v if p[0] == "col" else (p[1] or "")
+                                            for p in parts))
+            new_dict = pa.array(out_vals, type=pa.string())
+
+            def fn7(cols):
+                ds = [a.fn(cols) for a in args]
+                d0, v0 = col.fn(cols)
+                validity = K.merge_validity(*[x[1] for x in ds])
+                return d0, validity
+
+            return Compiled(fn7, r.dtype, new_dict)
+
+        return None
+
+
+class HostFallback(Exception):
+    """Raised when an expression needs host (pyarrow) evaluation; the
+    executor catches it and routes the expression through to_arrow/compute."""
+
+
+def _extract_literal(c: Compiled):
+    """Best-effort extraction of a literal scalar from a compiled arg."""
+    try:
+        d, v = c.fn([(jnp.zeros(1, dtype=jnp.int64), None)])
+        if v is not None and not bool(v[0]):
+            return None
+        val = d[0].item()
+        if isinstance(c.dtype, dt.DecimalType) and c.dtype.physical_dtype == "int64":
+            return val / (10 ** c.dtype.scale)
+        return val
+    except Exception:
+        return None
+
+
+def _canonical_dict(values: List[Optional[str]]):
+    """Deduplicate transformed dictionary values.
+
+    Returns (dictionary, remap[int32], null_lut|None): codes map through
+    ``remap``; positions whose transformed value is None are flagged via
+    ``null_lut`` (False = null)."""
+    uniq: Dict[str, int] = {}
+    remap = np.empty(len(values), dtype=np.int32)
+    has_null = False
+    for i, v in enumerate(values):
+        if v is None:
+            has_null = True
+            remap[i] = 0
+            continue
+        j = uniq.setdefault(v, len(uniq))
+        remap[i] = j
+    dictionary = pa.array(list(uniq.keys()), type=pa.string())
+    if len(dictionary) == 0:
+        dictionary = pa.array([""], type=pa.string())
+    null_lut = None
+    if has_null:
+        null_lut = np.asarray([v is not None for v in values])
+    return dictionary, remap, null_lut
+
+
+def _merge_dicts(dicts: List[pa.Array]):
+    all_vals: List[str] = []
+    offsets = []
+    for d in dicts:
+        offsets.append(len(all_vals))
+        all_vals.extend(_dict_strings(d))
+    enc = pc.dictionary_encode(pa.array(all_vals, type=pa.string()))
+    codes = np.asarray(enc.indices)
+    remaps = []
+    for off, d in zip(offsets, dicts):
+        remaps.append(codes[off: off + len(d)].astype(np.int32))
+    return enc.dictionary, remaps
+
+
+def _parse_string_value(s: Optional[str], target: dt.DataType):
+    if s is None:
+        return 0, False
+    s = s.strip()
+    try:
+        if isinstance(target, (dt.ByteType, dt.ShortType, dt.IntegerType, dt.LongType)):
+            return int(s), True
+        if isinstance(target, (dt.FloatType, dt.DoubleType)):
+            return float(s), True
+        if isinstance(target, dt.DecimalType):
+            import decimal
+            v = decimal.Decimal(s).scaleb(target.scale)
+            if target.physical_dtype == "int64":
+                return int(v.to_integral_value(rounding=decimal.ROUND_HALF_UP)), True
+            return float(s), True
+        if isinstance(target, dt.BooleanType):
+            if s.lower() in ("true", "t", "yes", "y", "1"):
+                return True, True
+            if s.lower() in ("false", "f", "no", "n", "0"):
+                return False, True
+            return False, False
+        if isinstance(target, dt.DateType):
+            return (datetime.date.fromisoformat(s[:10])
+                    - datetime.date(1970, 1, 1)).days, True
+        if isinstance(target, dt.TimestampType):
+            v = datetime.datetime.fromisoformat(s)
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=datetime.timezone.utc)
+            return int(v.timestamp() * 1_000_000), True
+    except (ValueError, ArithmeticError):
+        return 0, False
+    return 0, False
+
+
+# ---------------------------------------------------------------------------
+# temporal helpers (proleptic Gregorian; days since 1970-01-01)
+# ---------------------------------------------------------------------------
+
+def civil_from_days(z):
+    """days → (year, month, day) — vectorized Hinnant algorithm."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _to_days(data, d: dt.DataType):
+    if isinstance(d, dt.TimestampType):
+        # floor-div towards -inf for pre-epoch correctness
+        return jnp.floor_divide(data, 86_400_000_000)
+    return data.astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# numeric kernel builders: name → builder(args, rcall, opts) → device fn
+# ---------------------------------------------------------------------------
+
+_CMP_OPS = {
+    "==": lambda x, y: x == y,
+    "!=": lambda x, y: x != y,
+    "<": lambda x, y: x < y,
+    "<=": lambda x, y: x <= y,
+    ">": lambda x, y: x > y,
+    ">=": lambda x, y: x >= y,
+    "<=>": lambda x, y: x == y,
+}
+
+
+def _decimal_scale(d: dt.DataType) -> Optional[int]:
+    if isinstance(d, dt.DecimalType) and d.physical_dtype == "int64":
+        return d.scale
+    return None
+
+
+def _binary_numeric(op: str):
+    def build(args, r, opts):
+        a, b = args
+        sa, sb = _decimal_scale(a.dtype), _decimal_scale(b.dtype)
+        so = _decimal_scale(r.dtype)
+        jdt = physical_jnp_dtype(r.dtype)
+
+        def fn(cols):
+            (xd, xv), (yd, yv) = a.fn(cols), b.fn(cols)
+            x, y = xd, yd
+            if op in ("+", "-", "<", "<=", ">", ">=", "==", "!="):
+                # align decimal scales
+                if sa is not None or sb is not None:
+                    s = max(sa or 0, sb or 0)
+                    if sa is not None:
+                        x = x * (10 ** (s - sa))
+                    else:
+                        x = (x * (10 ** s)).astype(jnp.int64) if not jnp.issubdtype(x.dtype, jnp.floating) else x * (10 ** s)
+                    if sb is not None:
+                        y = y * (10 ** (s - sb))
+                    else:
+                        y = (y * (10 ** s)).astype(jnp.int64) if not jnp.issubdtype(y.dtype, jnp.floating) else y * (10 ** s)
+                    if jnp.issubdtype(x.dtype, jnp.floating) or jnp.issubdtype(y.dtype, jnp.floating):
+                        x = x.astype(jnp.float64) / (10.0 ** s)
+                        y = y.astype(jnp.float64) / (10.0 ** s)
+            if op in _CMP_OPS:
+                return _CMP_OPS[op](x, y), K.merge_validity(xv, yv)
+            if op == "+":
+                res = x + y
+            elif op == "-":
+                res = x - y
+            elif op == "*":
+                res = x * y
+                if sa is not None and sb is not None and so is not None:
+                    extra = sa + sb - so
+                    if extra > 0:
+                        res = jnp.sign(res) * ((jnp.abs(res) + (10 ** extra) // 2) // (10 ** extra))
+                elif so is not None and (sa is None) != (sb is None):
+                    s_have = (sa or 0) + (sb or 0)
+                    extra = s_have - so
+                    if extra > 0:
+                        res = jnp.sign(res) * ((jnp.abs(res) + (10 ** extra) // 2) // (10 ** extra))
+            else:
+                raise AssertionError(op)
+            return res.astype(jdt), K.merge_validity(xv, yv)
+
+        return fn
+
+    return build
+
+
+def _div_builder(args, r, opts):
+    a, b = args
+    sa, sb = _decimal_scale(a.dtype), _decimal_scale(b.dtype)
+
+    def fn(cols):
+        (xd, xv), (yd, yv) = a.fn(cols), b.fn(cols)
+        x = xd.astype(jnp.float64) / (10.0 ** sa) if sa is not None else xd.astype(jnp.float64)
+        y = yd.astype(jnp.float64) / (10.0 ** sb) if sb is not None else yd.astype(jnp.float64)
+        return K.div((x, xv), (y, yv))
+
+    return fn
+
+
+def _unary_math(jfn, out_float=True):
+    def build(args, r, opts):
+        a = args[0]
+        s = _decimal_scale(a.dtype)
+
+        def fn(cols):
+            xd, xv = a.fn(cols)
+            x = xd.astype(jnp.float64) / (10.0 ** s) if s is not None else xd
+            if out_float:
+                x = x.astype(jnp.float64)
+            return jfn(x), xv
+
+        return fn
+
+    return build
+
+
+def _strict_builder(jfn):
+    def build(args, r, opts):
+        cs = args
+
+        def fn(cols):
+            vals = [c.fn(cols) for c in cs]
+            return jfn(*[v[0] for v in vals]), K.merge_validity(*[v[1] for v in vals])
+
+        return fn
+
+    return build
+
+
+def _temporal_field(which: str):
+    def build(args, r, opts):
+        a = args[0]
+
+        def fn(cols):
+            xd, xv = a.fn(cols)
+            days = _to_days(xd, a.dtype)
+            y, m, d = civil_from_days(days)
+            if which == "year":
+                out = y
+            elif which == "month":
+                out = m
+            elif which == "day":
+                out = d
+            elif which == "quarter":
+                out = (m - 1) // 3 + 1
+            elif which == "dayofweek":  # Sunday=1
+                out = jnp.floor_divide(days + 4, 1) % 7 + 1
+                out = (days + 4) % 7 + 1
+            elif which == "weekday":  # Monday=0
+                out = (days + 3) % 7
+            elif which == "dayofyear":
+                jan1 = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+                out = (days - jan1 + 1)
+            else:
+                raise AssertionError(which)
+            return out.astype(jnp.int32), xv
+
+        return fn
+
+    return build
+
+
+def _time_field(which: str):
+    def build(args, r, opts):
+        a = args[0]
+
+        def fn(cols):
+            xd, xv = a.fn(cols)
+            us = xd.astype(jnp.int64)
+            sec_of_day = jnp.floor_divide(us, 1_000_000) % 86_400
+            if which == "hour":
+                out = sec_of_day // 3600
+            elif which == "minute":
+                out = (sec_of_day // 60) % 60
+            else:
+                out = sec_of_day % 60
+            return out.astype(jnp.int32), xv
+
+        return fn
+
+    return build
+
+
+def _date_arith(op: str):
+    """date/timestamp ± interval; date ± int days."""
+    def build(args, r, opts):
+        a, b = args
+        sign = 1 if op == "+" else -1
+
+        def fn(cols):
+            (xd, xv), (yd, yv) = a.fn(cols), b.fn(cols)
+            val = xd
+            amt = yd
+            av, bv = xv, yv
+            # canonical order: temporal on the left
+            if isinstance(b.dtype, (dt.DateType, dt.TimestampType)):
+                val, amt = yd, xd
+                t_dtype, o_dtype = b.dtype, a.dtype
+            else:
+                t_dtype, o_dtype = a.dtype, b.dtype
+            if isinstance(o_dtype, dt.YearMonthIntervalType):
+                days = _to_days(val, t_dtype)
+                y, m, d = civil_from_days(days)
+                months = y * 12 + (m - 1) + sign * amt.astype(jnp.int64)
+                ny, nm = months // 12, months % 12 + 1
+                # clamp day to month end
+                ml = _month_len(ny, nm)
+                nd = jnp.minimum(d, ml)
+                out_days = days_from_civil(ny, nm, nd)
+                if isinstance(t_dtype, dt.TimestampType):
+                    tod = val - days * 86_400_000_000
+                    return out_days * 86_400_000_000 + tod, K.merge_validity(av, bv)
+                return out_days.astype(jnp.int32), K.merge_validity(av, bv)
+            if isinstance(o_dtype, dt.DayTimeIntervalType):
+                if isinstance(t_dtype, dt.TimestampType):
+                    return val + sign * amt, K.merge_validity(av, bv)
+                us = val.astype(jnp.int64) * 86_400_000_000 + sign * amt
+                return jnp.floor_divide(us, 86_400_000_000).astype(jnp.int32), \
+                    K.merge_validity(av, bv)
+            # date ± integer days
+            return (val + sign * amt.astype(val.dtype)).astype(val.dtype), \
+                K.merge_validity(av, bv)
+
+        return fn
+
+    return build
+
+
+def _month_len(y, m):
+    lengths = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                          dtype=jnp.int64)
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    ml = lengths[m - 1]
+    return jnp.where((m == 2) & leap, 29, ml)
+
+
+def _in_builder(args, r, opts):
+    child = args[0]
+    items = args[1:]
+    s = _decimal_scale(child.dtype)
+
+    def fn(cols):
+        xd, xv = child.fn(cols)
+        hit = jnp.zeros(xd.shape[0], dtype=jnp.bool_)
+        for it in items:
+            yd, yv = it.fn(cols)
+            si = _decimal_scale(it.dtype)
+            x, y = xd, yd
+            if s is not None or si is not None:
+                sc = max(s or 0, si or 0)
+                if s is not None:
+                    x = x * (10 ** (sc - s))
+                if si is not None:
+                    y = y * (10 ** (sc - si))
+            eq = x == y.astype(x.dtype)
+            if yv is not None:
+                eq = eq & yv
+            hit = hit | eq
+        return hit, xv
+
+    return fn
+
+
+_NUMERIC_BUILDERS: Dict[str, Callable] = {
+    "+": _binary_numeric("+"),
+    "-": _binary_numeric("-"),
+    "*": _binary_numeric("*"),
+    "==": _binary_numeric("=="),
+    "!=": _binary_numeric("!="),
+    "<": _binary_numeric("<"),
+    "<=": _binary_numeric("<="),
+    ">": _binary_numeric(">"),
+    ">=": _binary_numeric(">="),
+    "/": _div_builder,
+    "div": lambda a, r, o: K.int_div and _strict2(K.int_div, a),
+    "%": lambda a, r, o: _strict2(K.mod, a),
+    "pmod": lambda a, r, o: _strict2(K.pmod, a),
+    "and": lambda a, r, o: _strict2(K.kleene_and, a),
+    "or": lambda a, r, o: _strict2(K.kleene_or, a),
+    "not": lambda a, r, o: _strict1(K.not_, a),
+    "isnull": lambda a, r, o: _strict1(K.isnull, a),
+    "isnotnull": lambda a, r, o: _strict1(K.isnotnull, a),
+    "coalesce": lambda a, r, o: _variadic(K.coalesce, a),
+    "nullif": lambda a, r, o: _strict2(K.nullif, a),
+    "if": lambda a, r, o: _variadic(K.if_, a),
+    "greatest": lambda a, r, o: _variadic(K.greatest, a),
+    "least": lambda a, r, o: _variadic(K.least, a),
+    "<=>": lambda a, r, o: _strict2(K.eq_null_safe, a),
+    "in": _in_builder,
+    "negative": _unary_math(lambda x: -x, out_float=False),
+    "abs": _unary_math(jnp.abs, out_float=False),
+    "sqrt": _unary_math(jnp.sqrt),
+    "exp": _unary_math(jnp.exp),
+    "ln": _unary_math(jnp.log),
+    "log10": _unary_math(jnp.log10),
+    "log2": _unary_math(jnp.log2),
+    "sin": _unary_math(jnp.sin),
+    "cos": _unary_math(jnp.cos),
+    "tan": _unary_math(jnp.tan),
+    "asin": _unary_math(jnp.arcsin),
+    "acos": _unary_math(jnp.arccos),
+    "atan": _unary_math(jnp.arctan),
+    "sinh": _unary_math(jnp.sinh),
+    "cosh": _unary_math(jnp.cosh),
+    "tanh": _unary_math(jnp.tanh),
+    "degrees": _unary_math(jnp.degrees),
+    "radians": _unary_math(jnp.radians),
+    "sign": _unary_math(jnp.sign, out_float=False),
+    "floor": _unary_math(lambda x: jnp.floor(x).astype(jnp.int64), out_float=True),
+    "ceil": _unary_math(lambda x: jnp.ceil(x).astype(jnp.int64), out_float=True),
+    "atan2": _strict_builder(jnp.arctan2),
+    "power": _strict_builder(lambda x, y: x.astype(jnp.float64) ** y),
+    "shiftleft": _strict_builder(lambda x, y: x << y),
+    "shiftright": _strict_builder(lambda x, y: x >> y),
+    "&": _strict_builder(lambda x, y: x & y),
+    "|": _strict_builder(lambda x, y: x | y),
+    "^": _strict_builder(lambda x, y: x ^ y),
+    "~": _strict_builder(lambda x: ~x),
+    "year": _temporal_field("year"),
+    "month": _temporal_field("month"),
+    "day": _temporal_field("day"),
+    "dayofmonth": _temporal_field("day"),
+    "quarter": _temporal_field("quarter"),
+    "dayofweek": _temporal_field("dayofweek"),
+    "weekday": _temporal_field("weekday"),
+    "dayofyear": _temporal_field("dayofyear"),
+    "hour": _time_field("hour"),
+    "minute": _time_field("minute"),
+    "second": _time_field("second"),
+    "date+interval": _date_arith("+"),
+    "date-interval": _date_arith("-"),
+    "datediff": _strict_builder(lambda x, y: (x - y).astype(jnp.int32)),
+    "date_add": _strict_builder(lambda x, y: (x + y).astype(jnp.int32)),
+    "date_sub": _strict_builder(lambda x, y: (x - y).astype(jnp.int32)),
+}
+
+
+def _round_builder(args, r, opts):
+    a = args[0]
+    digits = 0
+    if len(args) > 1:
+        digits = int(_extract_literal(args[1]) or 0)
+    s = _decimal_scale(a.dtype)
+    so = _decimal_scale(r.dtype)
+
+    def fn(cols):
+        xd, xv = a.fn(cols)
+        if s is not None:
+            # decimal: rescale with half-up rounding in integer space
+            drop = s - max(0, min(digits, s))
+            if drop > 0:
+                f = 10 ** drop
+                xd = jnp.sign(xd) * ((jnp.abs(xd) + f // 2) // f)
+            if so is not None:
+                have = s - drop
+                if so > have:
+                    xd = xd * (10 ** (so - have))
+            return xd, xv
+        return K.round_half_up((xd, xv), digits)
+
+    return fn
+
+
+_NUMERIC_BUILDERS["round"] = _round_builder
+
+
+def _strict1(k, args):
+    a = args[0]
+
+    def fn(cols):
+        return k(a.fn(cols))
+
+    return fn
+
+
+def _strict2(k, args):
+    a, b = args
+
+    def fn(cols):
+        return k(a.fn(cols), b.fn(cols))
+
+    return fn
+
+
+def _variadic(k, args):
+    def fn(cols):
+        return k(*[a.fn(cols) for a in args])
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# string dictionary transforms: name → fn(value, *extra) → value
+# ---------------------------------------------------------------------------
+
+def _substring(v: str, start: int, length: Optional[int] = None) -> str:
+    start = int(start)
+    if start > 0:
+        i = start - 1
+    elif start == 0:
+        i = 0
+    else:
+        i = max(len(v) + start, 0)
+    if length is None:
+        return v[i:]
+    return v[i: i + int(length)]
+
+
+_STRING_TRANSFORMS: Dict[str, Callable] = {
+    "upper": lambda v: v.upper(),
+    "ucase": lambda v: v.upper(),
+    "lower": lambda v: v.lower(),
+    "lcase": lambda v: v.lower(),
+    "length": lambda v: len(v),
+    "char_length": lambda v: len(v),
+    "character_length": lambda v: len(v),
+    "trim": lambda v, chars=None: v.strip(chars),
+    "ltrim": lambda v, chars=None: v.lstrip(chars),
+    "rtrim": lambda v, chars=None: v.rstrip(chars),
+    "substring": _substring,
+    "substr": _substring,
+    "left": lambda v, n: v[: int(n)] if n >= 0 else "",
+    "right": lambda v, n: v[-int(n):] if n > 0 else "",
+    "replace": lambda v, search, rep="": v.replace(search, rep),
+    "reverse": lambda v: v[::-1],
+    "initcap": lambda v: v.title(),
+    "ascii": lambda v: ord(v[0]) if v else 0,
+    "lpad": lambda v, n, pad=" ": v.rjust(int(n), pad[0] if pad else " ")[: int(n)],
+    "rpad": lambda v, n, pad=" ": v.ljust(int(n), pad[0] if pad else " ")[: int(n)],
+    "repeat": lambda v, n: v * int(n),
+    "startswith": lambda v, p: v.startswith(p),
+    "endswith": lambda v, p: v.endswith(p),
+    "contains": lambda v, p: p in v,
+    "instr": lambda v, sub: v.find(sub) + 1,
+    "position": lambda sub, v: 0,  # handled specially (arg order)
+    "locate": lambda sub, v, pos=1: 0,  # handled specially
+    "regexp_extract": lambda v, pat, idx=1: (
+        (re.search(pat, v).group(int(idx)) if re.search(pat, v) else "")),
+    "regexp_replace": lambda v, pat, rep: re.sub(pat, rep, v),
+    "translate": lambda v, frm, to: v.translate(str.maketrans(frm[: len(to)], to[: len(frm)])),
+    "soundex": lambda v: v,  # placeholder
+    "md5": lambda v: __import__("hashlib").md5(v.encode()).hexdigest(),
+    "sha1": lambda v: __import__("hashlib").sha1(v.encode()).hexdigest(),
+    "sha2": lambda v, bits=256: __import__("hashlib").new(f"sha{int(bits) or 256}", v.encode()).hexdigest(),
+    "bit_length": lambda v: len(v.encode()) * 8,
+    "octet_length": lambda v: len(v.encode()),
+    "space_trimmed_length": lambda v: len(v.rstrip()),
+}

@@ -1,0 +1,330 @@
+"""Physical plan nodes.
+
+Single-layer resolved plan IR (logical and physical merged for v0 — the
+optimizer rewrites these nodes directly; a split mirroring the reference's
+logical/physical layering can be reintroduced when extension planning needs
+it). Reference role: sail-logical-plan + sail-physical-plan extension nodes
+and DataFusion's ExecutionPlan (SURVEY.md §2.4).
+
+Every node carries its output schema: a list of Field(name, dtype,
+nullable). Expressions inside nodes are resolved Rex trees bound to the
+child's schema by position.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from ..spec import data_type as dt
+from . import rex as rx
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: dt.DataType
+    nullable: bool = True
+
+
+Schema = Tuple[Field, ...]
+
+
+@dataclass(frozen=True)
+class PlanNode:
+    """Base physical plan node."""
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def children(self) -> Tuple["PlanNode", ...]:
+        return ()
+
+
+@dataclass(frozen=True)
+class ScanExec(PlanNode):
+    """Reads a table: either an in-memory pyarrow table handle or files."""
+
+    out_schema: Schema
+    source: object = None           # pa.Table | None
+    paths: Tuple[str, ...] = ()
+    format: str = "memory"          # memory|parquet|csv|json|arrow
+    options: Tuple[Tuple[str, str], ...] = ()
+    projection: Optional[Tuple[str, ...]] = None
+    table_name: str = ""
+
+    @property
+    def schema(self) -> Schema:
+        if self.projection is None:
+            return self.out_schema
+        by_name = {f.name: f for f in self.out_schema}
+        return tuple(by_name[n] for n in self.projection)
+
+
+@dataclass(frozen=True)
+class OneRowExec(PlanNode):
+    @property
+    def schema(self) -> Schema:
+        return ()
+
+
+@dataclass(frozen=True)
+class ValuesExec(PlanNode):
+    out_schema: Schema = ()
+    rows: Tuple[Tuple[object, ...], ...] = ()  # rows of LV literals
+
+    @property
+    def schema(self) -> Schema:
+        return self.out_schema
+
+
+@dataclass(frozen=True)
+class RangeExec(PlanNode):
+    """id column from start to end (mirrors sail-logical-plan RangeNode)."""
+
+    start: int = 0
+    end: int = 0
+    step: int = 1
+    num_partitions: int = 1
+
+    @property
+    def schema(self) -> Schema:
+        return (Field("id", dt.LongType(), False),)
+
+
+@dataclass(frozen=True)
+class ProjectExec(PlanNode):
+    input: PlanNode = None
+    exprs: Tuple[Tuple[str, rx.Rex], ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        return tuple(Field(n, rx.rex_type(e), rx.rex_nullable(e))
+                     for n, e in self.exprs)
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclass(frozen=True)
+class FilterExec(PlanNode):
+    input: PlanNode = None
+    condition: rx.Rex = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.input.schema
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    fn: str                     # sum|count|min|max|first|last|any|every
+    arg: Optional[int] = None   # input column index (None = count(*))
+    distinct: bool = False
+    out_dtype: dt.DataType = field(default_factory=dt.LongType)
+    filter: Optional[rx.Rex] = None
+    ignore_nulls: bool = True
+
+
+@dataclass(frozen=True)
+class AggregateExec(PlanNode):
+    """Grouped aggregation over materialized key/arg columns.
+
+    The resolver arranges inputs so group keys and agg args are plain
+    columns (via a pre-projection). Output schema = group key columns
+    then one column per AggSpec.
+    """
+
+    input: PlanNode = None
+    group_indices: Tuple[int, ...] = ()
+    aggs: Tuple[AggSpec, ...] = ()
+    out_names: Tuple[str, ...] = ()
+    max_groups_hint: Optional[int] = None
+
+    @property
+    def schema(self) -> Schema:
+        in_schema = self.input.schema
+        fields = []
+        for i, gi in enumerate(self.group_indices):
+            f = in_schema[gi]
+            fields.append(Field(self.out_names[i], f.dtype, f.nullable))
+        for j, a in enumerate(self.aggs):
+            name = self.out_names[len(self.group_indices) + j]
+            nullable = a.fn not in ("count",)
+            fields.append(Field(name, a.out_dtype, nullable))
+        return tuple(fields)
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclass(frozen=True)
+class SortKey:
+    expr: rx.Rex
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclass(frozen=True)
+class SortExec(PlanNode):
+    input: PlanNode = None
+    keys: Tuple[SortKey, ...] = ()
+    limit: Optional[int] = None  # top-k fusion
+
+    @property
+    def schema(self) -> Schema:
+        return self.input.schema
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclass(frozen=True)
+class LimitExec(PlanNode):
+    input: PlanNode = None
+    limit: Optional[int] = None
+    offset: int = 0
+
+    @property
+    def schema(self) -> Schema:
+        return self.input.schema
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclass(frozen=True)
+class JoinExec(PlanNode):
+    """Equi-join with optional residual condition.
+
+    join_type ∈ {inner, left, right, full, semi, anti, cross}.
+    Key expressions are bound to each side's schema. The residual condition
+    is bound to the combined (left ++ right) schema and participates in
+    match semantics (not post-filtering) for outer joins.
+    """
+
+    left: PlanNode = None
+    right: PlanNode = None
+    join_type: str = "inner"
+    left_keys: Tuple[rx.Rex, ...] = ()
+    right_keys: Tuple[rx.Rex, ...] = ()
+    residual: Optional[rx.Rex] = None
+
+    @property
+    def schema(self) -> Schema:
+        if self.join_type in ("semi", "anti"):
+            return self.left.schema
+        right_nullable = self.join_type in ("left", "full")
+        left_nullable = self.join_type in ("right", "full")
+        fields = [Field(f.name, f.dtype, f.nullable or left_nullable)
+                  for f in self.left.schema]
+        fields += [Field(f.name, f.dtype, f.nullable or right_nullable)
+                   for f in self.right.schema]
+        return tuple(fields)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclass(frozen=True)
+class UnionExec(PlanNode):
+    inputs: Tuple[PlanNode, ...] = ()
+    all: bool = True
+
+    @property
+    def schema(self) -> Schema:
+        return self.inputs[0].schema
+
+    @property
+    def children(self):
+        return self.inputs
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    function: str
+    arg: Optional[int] = None
+    partition_indices: Tuple[int, ...] = ()
+    order_keys: Tuple[SortKey, ...] = ()
+    frame_type: str = "rows"
+    frame_lower: Optional[int] = None
+    frame_upper: Optional[int] = 0
+    out_dtype: dt.DataType = field(default_factory=dt.LongType)
+
+
+@dataclass(frozen=True)
+class WindowExec(PlanNode):
+    input: PlanNode = None
+    windows: Tuple[WindowSpec, ...] = ()
+    out_names: Tuple[str, ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        extra = tuple(Field(n, w.out_dtype, True)
+                      for n, w in zip(self.out_names, self.windows))
+        return tuple(self.input.schema) + extra
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+def walk_plan(p: PlanNode):
+    yield p
+    for c in p.children:
+        yield from walk_plan(c)
+
+
+def explain(p: PlanNode, indent: int = 0) -> str:
+    pad = "  " * indent
+    name = type(p).__name__
+    detail = ""
+    if isinstance(p, ScanExec):
+        detail = f" table={p.table_name or p.paths} cols={[f.name for f in p.schema]}"
+    elif isinstance(p, FilterExec):
+        detail = f" cond={_rex_str(p.condition)}"
+    elif isinstance(p, ProjectExec):
+        detail = f" exprs={[n for n, _ in p.exprs]}"
+    elif isinstance(p, AggregateExec):
+        detail = (f" groups={list(p.group_indices)} "
+                  f"aggs={[(a.fn, a.arg) for a in p.aggs]}")
+    elif isinstance(p, JoinExec):
+        detail = (f" type={p.join_type} on="
+                  f"{[(_rex_str(l), _rex_str(r)) for l, r in zip(p.left_keys, p.right_keys)]}"
+                  + (f" residual={_rex_str(p.residual)}" if p.residual is not None else ""))
+    elif isinstance(p, SortExec):
+        detail = f" keys={[(_rex_str(k.expr), k.ascending) for k in p.keys]}" + \
+            (f" limit={p.limit}" if p.limit is not None else "")
+    elif isinstance(p, LimitExec):
+        detail = f" limit={p.limit} offset={p.offset}"
+    lines = [f"{pad}{name}{detail}"]
+    for c in p.children:
+        lines.append(explain(c, indent + 1))
+    return "\n".join(lines)
+
+
+def _rex_str(r: rx.Rex) -> str:
+    if isinstance(r, rx.BoundRef):
+        return f"#{r.index}:{r.name}"
+    if isinstance(r, rx.RLit):
+        return repr(r.value.value)
+    if isinstance(r, rx.RCall):
+        return f"{r.fn}({', '.join(_rex_str(a) for a in r.args)})"
+    if isinstance(r, rx.RCast):
+        return f"cast({_rex_str(r.child)} as {r.dtype.simple_string()})"
+    if isinstance(r, rx.RCase):
+        return "case(...)"
+    if isinstance(r, rx.RScalarSubquery):
+        return "scalar_subquery(...)"
+    return type(r).__name__

@@ -1,0 +1,345 @@
+"""Plan optimizer.
+
+Reference role: sail-logical-optimizer + sail-physical-optimizer
+(SURVEY.md §2.4), reduced to the rules that matter most for a sort/
+searchsorted engine on padded batches:
+
+1. filter pushdown      — through projects and into join sides
+2. cross-join → join    — lift equi predicates from filters above cross
+                          joins into join keys (TPC-H's implicit joins)
+3. join input ordering  — greedy left-deep chain over connected tables
+                          (via rule 2's construction)
+4. column pruning       — push required columns into ScanExec (less IO,
+                          less HBM)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..spec import data_type as dt
+from . import nodes as pn
+from . import rex as rx
+
+
+def optimize(plan: pn.PlanNode) -> pn.PlanNode:
+    plan = push_filters(plan)
+    plan = prune_columns(plan)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# filter pushdown + cross-join elimination
+# ---------------------------------------------------------------------------
+
+def push_filters(p: pn.PlanNode) -> pn.PlanNode:
+    if isinstance(p, pn.FilterExec):
+        child = push_filters(p.input)
+        conjuncts = _split(p.condition)
+        return _push_conjuncts_into(child, conjuncts)
+    if isinstance(p, pn.JoinExec):
+        return dataclasses.replace(p, left=push_filters(p.left),
+                                   right=push_filters(p.right))
+    if isinstance(p, pn.UnionExec):
+        return dataclasses.replace(
+            p, inputs=tuple(push_filters(c) for c in p.inputs))
+    if hasattr(p, "input") and p.input is not None:
+        return dataclasses.replace(p, input=push_filters(p.input))
+    return p
+
+
+def _split(r: rx.Rex) -> List[rx.Rex]:
+    if isinstance(r, rx.RCall) and r.fn == "and":
+        return _split(r.args[0]) + _split(r.args[1])
+    return [r]
+
+
+def _and(parts: Sequence[rx.Rex]) -> rx.Rex:
+    out = parts[0]
+    for x in parts[1:]:
+        out = rx.RCall("and", (out, x), dt.BooleanType(), True)
+    return out
+
+
+def _push_conjuncts_into(p: pn.PlanNode, conjuncts: List[rx.Rex]) -> pn.PlanNode:
+    """Push filter conjuncts as deep as possible into ``p``."""
+    if not conjuncts:
+        return p
+    if isinstance(p, pn.ProjectExec):
+        # remap through simple column projections
+        pushable, blocked = [], []
+        for c in conjuncts:
+            mapped = _remap_through_project(c, p.exprs)
+            if mapped is not None:
+                pushable.append(mapped)
+            else:
+                blocked.append(c)
+        new_input = _push_conjuncts_into(push_filters(p.input), pushable) \
+            if pushable else push_filters(p.input)
+        node: pn.PlanNode = dataclasses.replace(p, input=new_input)
+        if blocked:
+            node = pn.FilterExec(node, _and(blocked))
+        return node
+    if isinstance(p, pn.FilterExec):
+        return _push_conjuncts_into(push_filters(p.input),
+                                    conjuncts + _split(p.condition))
+    if isinstance(p, pn.JoinExec):
+        return _push_into_join(p, conjuncts)
+    if isinstance(p, pn.LimitExec) or isinstance(p, pn.SortExec):
+        # cannot push a filter through LIMIT (changes semantics)
+        inner = push_filters(p)
+        return pn.FilterExec(inner, _and(conjuncts))
+    if isinstance(p, pn.UnionExec):
+        new_inputs = tuple(_push_conjuncts_into(push_filters(c), list(conjuncts))
+                           for c in p.inputs)
+        return dataclasses.replace(p, inputs=new_inputs)
+    inner = push_filters(p) if p.children else p
+    return pn.FilterExec(inner, _and(conjuncts))
+
+
+def _remap_through_project(r: rx.Rex, exprs) -> Optional[rx.Rex]:
+    if isinstance(r, rx.BoundRef):
+        src = exprs[r.index][1]
+        if isinstance(src, (rx.BoundRef, rx.RLit)):
+            return src
+        # inline arbitrary expressions only if deterministic & cheap: allow
+        # calls/casts of column refs (may duplicate compute, XLA dedups)
+        return src
+    if isinstance(r, rx.RLit):
+        return r
+    if isinstance(r, rx.RScalarSubquery):
+        return r
+    if isinstance(r, rx.RCall):
+        args = []
+        for a in r.args:
+            m = _remap_through_project(a, exprs)
+            if m is None:
+                return None
+            args.append(m)
+        return dataclasses.replace(r, args=tuple(args))
+    if isinstance(r, rx.RCast):
+        m = _remap_through_project(r.child, exprs)
+        return None if m is None else dataclasses.replace(r, child=m)
+    if isinstance(r, rx.RCase):
+        branches = []
+        for c, v in r.branches:
+            mc = _remap_through_project(c, exprs)
+            mv = _remap_through_project(v, exprs)
+            if mc is None or mv is None:
+                return None
+            branches.append((mc, mv))
+        e = None
+        if r.else_value is not None:
+            e = _remap_through_project(r.else_value, exprs)
+            if e is None:
+                return None
+        return dataclasses.replace(r, branches=tuple(branches), else_value=e)
+    return None
+
+
+def _push_into_join(j: pn.JoinExec, conjuncts: List[rx.Rex]) -> pn.PlanNode:
+    n_left = len(j.left.schema)
+    n_total = len(j.schema)
+    left_only, right_only, both, kept = [], [], [], []
+    new_lk, new_rk = list(j.left_keys), list(j.right_keys)
+    can_push_left = j.join_type in ("inner", "left", "semi", "anti", "cross")
+    can_push_right = j.join_type in ("inner", "right", "cross")
+    for c in conjuncts:
+        refs = rx.references(c)
+        if all(i < n_left for i in refs):
+            (left_only if can_push_left else kept).append(c)
+        elif all(i >= n_left for i in refs):
+            shifted = rx.shift_refs(c, -n_left)
+            (right_only if can_push_right else kept).append(
+                shifted if can_push_right else c)
+        else:
+            # mixed: try to convert to an equi key pair on inner/cross joins
+            pair = _equi_pair(c, n_left)
+            if pair is not None and j.join_type in ("inner", "cross"):
+                new_lk.append(pair[0])
+                new_rk.append(rx.shift_refs(pair[1], -n_left))
+            else:
+                both.append(c)
+    new_left = _push_conjuncts_into(push_filters(j.left), left_only)
+    new_right = _push_conjuncts_into(push_filters(j.right), right_only)
+    join_type = j.join_type
+    if join_type == "cross" and new_lk:
+        join_type = "inner"
+    residual = j.residual
+    if both and join_type in ("inner", "cross"):
+        # non-equi mixed predicates on inner joins can live in the residual
+        parts = ([residual] if residual is not None else []) + both
+        residual = _and(parts)
+        both = []
+    node: pn.PlanNode = pn.JoinExec(new_left, new_right, join_type,
+                                    tuple(new_lk), tuple(new_rk), residual)
+    remaining = kept + both
+    if remaining:
+        node = pn.FilterExec(node, _and(remaining))
+    return node
+
+
+def _equi_pair(c: rx.Rex, n_left: int):
+    if isinstance(c, rx.RCall) and c.fn == "==" and len(c.args) == 2:
+        a, b = c.args
+        ra, rb = rx.references(a), rx.references(b)
+        if ra and rb:
+            if all(i < n_left for i in ra) and all(i >= n_left for i in rb):
+                return (a, b)
+            if all(i < n_left for i in rb) and all(i >= n_left for i in ra):
+                return (b, a)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# column pruning
+# ---------------------------------------------------------------------------
+
+def prune_columns(p: pn.PlanNode) -> pn.PlanNode:
+    node, _ = _prune(p, set(range(len(p.schema))))
+    return node
+
+
+def _prune(p: pn.PlanNode, required: Set[int]):
+    """Prune unused columns bottom-up.
+
+    ``required``: output column indices the parent needs. Returns
+    (new_node, remap) where remap maps old output index → new output index
+    (only for indices in ``required``).
+    """
+    identity = {i: i for i in range(len(p.schema))}
+    if isinstance(p, pn.ScanExec):
+        if p.format in ("parquet", "csv", "arrow", "ipc", "memory") and \
+                len(required) < len(p.schema):
+            names = [f.name for f in p.schema]
+            keep = sorted(required)
+            if not keep:
+                keep = [0] if names else []
+            proj = tuple(names[i] for i in keep)
+            return dataclasses.replace(p, projection=proj), \
+                {old: new for new, old in enumerate(keep)}
+        return p, identity
+    if isinstance(p, pn.ProjectExec):
+        keep = sorted(required)
+        exprs = [p.exprs[i] for i in keep]
+        child_req: Set[int] = set()
+        for _, e in exprs:
+            child_req.update(rx.references(e))
+        child, remap = _prune(p.input, child_req)
+        exprs = [(n, _remap_indices(e, remap)) for n, e in exprs]
+        return pn.ProjectExec(child, tuple(exprs)), \
+            {old: new for new, old in enumerate(keep)}
+    if isinstance(p, pn.FilterExec):
+        child_req = required | set(rx.references(p.condition))
+        child, remap = _prune(p.input, child_req)
+        cond = _remap_indices(p.condition, remap)
+        return pn.FilterExec(child, cond), remap
+    if isinstance(p, pn.AggregateExec):
+        ng = len(p.group_indices)
+        keep_aggs = sorted(i - ng for i in required if i >= ng)
+        aggs = [p.aggs[i] for i in keep_aggs]
+        child_req = set(p.group_indices)
+        for a in aggs:
+            if a.arg is not None:
+                child_req.add(a.arg)
+        child, remap = _prune(p.input, child_req)
+        new_groups = tuple(remap[g] for g in p.group_indices)
+        new_aggs = tuple(
+            dataclasses.replace(a, arg=None if a.arg is None else remap[a.arg])
+            for a in aggs)
+        names = list(p.out_names[:ng]) + [p.out_names[ng + i] for i in keep_aggs]
+        node = pn.AggregateExec(child, new_groups, new_aggs, tuple(names),
+                                p.max_groups_hint)
+        out_remap = {}
+        for i in range(ng):
+            out_remap[i] = i
+        for new_j, old_j in enumerate(keep_aggs):
+            out_remap[ng + old_j] = ng + new_j
+        return node, out_remap
+    if isinstance(p, pn.JoinExec):
+        n_left = len(p.left.schema)
+        left_req: Set[int] = set()
+        right_req: Set[int] = set()
+        for i in required:
+            if i < n_left:
+                left_req.add(i)
+            else:
+                right_req.add(i - n_left)
+        for k in p.left_keys:
+            left_req.update(rx.references(k))
+        for k in p.right_keys:
+            right_req.update(rx.references(k))
+        if p.residual is not None:
+            for i in rx.references(p.residual):
+                if i < n_left:
+                    left_req.add(i)
+                else:
+                    right_req.add(i - n_left)
+        left, lremap = _prune(p.left, left_req)
+        right, rremap = _prune(p.right, right_req)
+        lk = tuple(_remap_indices(k, lremap) for k in p.left_keys)
+        rk = tuple(_remap_indices(k, rremap) for k in p.right_keys)
+        residual = p.residual
+        if residual is not None:
+            comb = dict(lremap)
+            for old, new in rremap.items():
+                comb[old + n_left] = new + len(left.schema)
+            residual = _remap_indices(residual, comb)
+        node = pn.JoinExec(left, right, p.join_type, lk, rk, residual)
+        out_remap = dict(lremap)
+        if p.join_type not in ("semi", "anti"):
+            for old, new in rremap.items():
+                out_remap[old + n_left] = new + len(left.schema)
+        return node, out_remap
+    if isinstance(p, pn.SortExec):
+        child_req = set(required)
+        for k in p.keys:
+            child_req.update(rx.references(k.expr))
+        child, remap = _prune(p.input, child_req)
+        keys = tuple(dataclasses.replace(k, expr=_remap_indices(k.expr, remap))
+                     for k in p.keys)
+        return dataclasses.replace(p, input=child, keys=keys), remap
+    if isinstance(p, pn.LimitExec):
+        child, remap = _prune(p.input, required)
+        return dataclasses.replace(p, input=child), remap
+    if isinstance(p, pn.UnionExec):
+        keep = sorted(required) if len(required) < len(p.schema) \
+            else list(range(len(p.schema)))
+        new_inputs = []
+        remap0 = None
+        for c in p.inputs:
+            child, remap = _prune(c, set(keep))
+            # normalize: all children must produce the kept columns in order
+            exprs = tuple((c.schema[i].name,
+                           rx.BoundRef(remap[i], c.schema[i].name,
+                                       c.schema[i].dtype, c.schema[i].nullable))
+                          for i in keep)
+            if [remap[i] for i in keep] != list(range(len(keep))) or \
+                    len(child.schema) != len(keep):
+                child = pn.ProjectExec(child, exprs)
+            new_inputs.append(child)
+            remap0 = {old: new for new, old in enumerate(keep)}
+        return dataclasses.replace(p, inputs=tuple(new_inputs)), remap0
+    if isinstance(p, pn.WindowExec):
+        child, _ = _prune(p.input, set(range(len(p.input.schema))))
+        return dataclasses.replace(p, input=child), identity
+    return p, identity
+
+
+def _remap_indices(r: rx.Rex, remap: Dict[int, int]) -> rx.Rex:
+    if isinstance(r, rx.BoundRef):
+        return dataclasses.replace(r, index=remap[r.index])
+    if isinstance(r, rx.RCall):
+        return dataclasses.replace(
+            r, args=tuple(_remap_indices(a, remap) for a in r.args))
+    if isinstance(r, rx.RCast):
+        return dataclasses.replace(r, child=_remap_indices(r.child, remap))
+    if isinstance(r, rx.RCase):
+        return dataclasses.replace(
+            r,
+            branches=tuple((_remap_indices(c, remap), _remap_indices(v, remap))
+                           for c, v in r.branches),
+            else_value=None if r.else_value is None
+            else _remap_indices(r.else_value, remap))
+    return r

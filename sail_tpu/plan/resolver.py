@@ -1,0 +1,1478 @@
+"""Name/type resolution: spec IR → physical plan nodes.
+
+Reference role: sail-plan's PlanResolver (crates/sail-plan/src/resolver/),
+the single choke point from unresolved plans to executable ones. Includes
+the subquery handling TPC-H requires:
+
+- EXISTS / NOT EXISTS           → semi / anti join (correlated conjuncts
+                                  become join keys; non-equi ones residual)
+- [NOT] IN (subquery)           → semi / anti join on the output column
+- uncorrelated scalar subquery  → RScalarSubquery (executor pre-evaluates)
+- correlated scalar aggregate   → grouped subplan + left outer join
+                                  (the classic decorrelation rewrite)
+
+Aggregation resolution decomposes compound aggregates (avg → sum/count,
+variance family → sum/sum²/count) and rewrites DISTINCT aggregates into
+two-level grouping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..functions import registry as freg
+from ..spec import data_type as dt
+from ..spec import expression as ex
+from ..spec import plan as sp
+from ..spec.literal import Literal as LV
+from . import nodes as pn
+from . import rex as rx
+
+
+class ResolutionError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ROuterRef(rx.Rex):
+    """Reference to a column of the enclosing query (correlation marker)."""
+
+    index: int
+    name: str = ""
+    dtype: dt.DataType = dataclasses.field(default_factory=dt.NullType)
+    nullable: bool = True
+
+
+@dataclasses.dataclass
+class ScopeField:
+    name: str
+    qualifiers: Tuple[str, ...]
+    dtype: dt.DataType
+    nullable: bool
+
+
+class Scope:
+    def __init__(self, fields: List[ScopeField], parent: Optional["Scope"] = None,
+                 ctes: Optional[Dict[str, sp.QueryPlan]] = None):
+        self.fields = fields
+        self.parent = parent
+        self.ctes = dict(ctes or {})
+        self.used_outer = False
+        # (input_scope) of the projection that produced this scope — lets
+        # ORDER BY reach columns that were projected away (SQL allows it)
+        self.below: Optional["Scope"] = None
+
+    def find(self, name: Tuple[str, ...]) -> Optional[int]:
+        col = name[-1].lower()
+        quals = tuple(q.lower() for q in name[:-1])
+        matches = []
+        for i, f in enumerate(self.fields):
+            if f.name.lower() != col:
+                continue
+            fq = tuple(q.lower() for q in f.qualifiers)
+            if quals and not _qual_suffix_match(fq, quals):
+                continue
+            matches.append(i)
+        if len(matches) > 1:
+            # identical duplicate columns (e.g. USING) resolve to the first
+            raise ResolutionError(f"ambiguous column reference {'.'.join(name)!r}")
+        return matches[0] if matches else None
+
+
+def _qual_suffix_match(field_quals: Tuple[str, ...], ref_quals: Tuple[str, ...]) -> bool:
+    if len(ref_quals) > len(field_quals):
+        return False
+    return field_quals[len(field_quals) - len(ref_quals):] == ref_quals
+
+
+_FRESH = itertools.count()
+
+
+def _fresh(prefix: str) -> str:
+    return f"__{prefix}{next(_FRESH)}"
+
+
+class Resolver:
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    # ------------------------------------------------------------------
+    def resolve(self, plan: sp.QueryPlan) -> pn.PlanNode:
+        node, _ = self.resolve_query(plan, None)
+        return node
+
+    # ------------------------------------------------------------------
+    def resolve_query(self, plan: sp.QueryPlan, scope: Optional[Scope],
+                      outer: Optional[Scope] = None) -> Tuple[pn.PlanNode, Scope]:
+        """Resolve a query node. ``scope`` carries CTEs in effect; ``outer``
+        is the enclosing query's scope for correlation."""
+        ctes = scope.ctes if scope is not None else {}
+        if isinstance(plan, sp.ReadNamedTable):
+            return self._resolve_read(plan, ctes, outer)
+        if isinstance(plan, sp.ReadDataSource):
+            return self._resolve_read_source(plan, outer)
+        if isinstance(plan, sp.LocalRelation):
+            return self._resolve_local(plan, outer)
+        if isinstance(plan, sp.OneRow):
+            return pn.OneRowExec(), Scope([], outer, ctes)
+        if isinstance(plan, sp.Range):
+            node = pn.RangeExec(plan.start, plan.end, plan.step,
+                                plan.num_partitions or 1)
+            return node, self._scope_of(node, None, outer, ctes)
+        if isinstance(plan, sp.Values):
+            return self._resolve_values(plan, outer, ctes)
+        if isinstance(plan, sp.WithCtes):
+            new_ctes = dict(ctes)
+            for name, q in plan.ctes:
+                new_ctes[name.lower()] = _InlinedCte(q, dict(new_ctes))
+            inner_scope = Scope([], outer, new_ctes)
+            return self.resolve_query(plan.input, inner_scope, outer)
+        if isinstance(plan, sp.SubqueryAlias):
+            child, cscope = self.resolve_query(plan.input, scope, outer)
+            fields = [dataclasses.replace(f, qualifiers=(plan.alias,))
+                      for f in cscope.fields]
+            if plan.columns:
+                if len(plan.columns) != len(fields):
+                    raise ResolutionError(
+                        f"alias {plan.alias} has {len(plan.columns)} columns, "
+                        f"input has {len(fields)}")
+                fields = [dataclasses.replace(f, name=n)
+                          for f, n in zip(fields, plan.columns)]
+                child = pn.ProjectExec(child, tuple(
+                    (n, rx.BoundRef(i, child.schema[i].name,
+                                    child.schema[i].dtype, child.schema[i].nullable))
+                    for i, n in enumerate(plan.columns)))
+            return child, Scope(fields, outer, ctes)
+        if isinstance(plan, sp.Filter):
+            return self._resolve_filter(plan, scope, outer)
+        if isinstance(plan, sp.Project):
+            return self._resolve_project(plan, scope, outer)
+        if isinstance(plan, sp.Aggregate):
+            return self._resolve_aggregate(plan, scope, outer)
+        if isinstance(plan, sp.Join):
+            return self._resolve_join(plan, scope, outer)
+        if isinstance(plan, sp.Sort):
+            child, cscope = self.resolve_query(plan.input, scope, outer)
+            keys = []
+            hidden: List[rx.Rex] = []
+            for so in plan.order:
+                try:
+                    e = self._ordinal_or_expr(so.child, cscope, child)
+                except ResolutionError:
+                    if cscope.below is None or not isinstance(child, pn.ProjectExec):
+                        raise
+                    inner = self._resolve_expr(so.child, cscope.below)
+                    e = rx.BoundRef(len(child.exprs) + len(hidden),
+                                    _fresh("sort"), rx.rex_type(inner),
+                                    rx.rex_nullable(inner))
+                    hidden.append(inner)
+                keys.append(pn.SortKey(e, so.ascending, so.nulls_first))
+            if hidden:
+                ext = pn.ProjectExec(child.input, tuple(
+                    list(child.exprs)
+                    + [(_fresh("sk"), h) for h in hidden]))
+                sorted_node = pn.SortExec(ext, tuple(keys))
+                trim = pn.ProjectExec(sorted_node, tuple(
+                    (n, rx.BoundRef(i, n, rx.rex_type(e2), rx.rex_nullable(e2)))
+                    for i, (n, e2) in enumerate(child.exprs)))
+                return trim, cscope
+            return pn.SortExec(child, tuple(keys)), cscope
+        if isinstance(plan, sp.Limit):
+            child, cscope = self.resolve_query(plan.input, scope, outer)
+            if isinstance(child, pn.SortExec) and plan.offset == 0 and plan.limit is not None:
+                return dataclasses.replace(child, limit=plan.limit), cscope
+            return pn.LimitExec(child, plan.limit, plan.offset), cscope
+        if isinstance(plan, sp.Offset):
+            child, cscope = self.resolve_query(plan.input, scope, outer)
+            return pn.LimitExec(child, None, plan.offset), cscope
+        if isinstance(plan, sp.Deduplicate):
+            return self._resolve_dedup(plan, scope, outer)
+        if isinstance(plan, sp.SetOperation):
+            return self._resolve_setop(plan, scope, outer)
+        if isinstance(plan, sp.WithColumns):
+            return self._resolve_with_columns(plan, scope, outer)
+        if isinstance(plan, sp.WithColumnsRenamed):
+            child, cscope = self.resolve_query(plan.input, scope, outer)
+            renames = dict(plan.renames)
+            exprs = []
+            fields = []
+            for i, f in enumerate(child.schema):
+                new_name = renames.get(f.name, f.name)
+                exprs.append((new_name, rx.BoundRef(i, f.name, f.dtype, f.nullable)))
+                fields.append(ScopeField(new_name, (), f.dtype, f.nullable))
+            node = pn.ProjectExec(child, tuple(exprs))
+            return node, Scope(fields, outer, ctes)
+        if isinstance(plan, sp.Drop):
+            child, cscope = self.resolve_query(plan.input, scope, outer)
+            dropped = {c.lower() for c in plan.columns}
+            exprs = []
+            fields = []
+            for i, f in enumerate(child.schema):
+                if f.name.lower() in dropped:
+                    continue
+                exprs.append((f.name, rx.BoundRef(i, f.name, f.dtype, f.nullable)))
+                fields.append(cscope.fields[i])
+            return pn.ProjectExec(child, tuple(exprs)), Scope(fields, outer, ctes)
+        if isinstance(plan, sp.Repartition):
+            # single-process executor: repartitioning is a no-op placeholder;
+            # the distributed planner lowers it to a shuffle exchange.
+            child, cscope = self.resolve_query(plan.input, scope, outer)
+            return child, cscope
+        if isinstance(plan, sp.Sample):
+            return self._resolve_sample(plan, scope, outer)
+        if isinstance(plan, sp.Tail):
+            child, cscope = self.resolve_query(plan.input, scope, outer)
+            return pn.LimitExec(child, plan.limit, -1), cscope
+        raise ResolutionError(f"unsupported query node {type(plan).__name__}")
+
+    # ------------------------------------------------------------------
+    # leaves
+    # ------------------------------------------------------------------
+    def _resolve_read(self, plan: sp.ReadNamedTable, ctes, outer):
+        key = plan.name[-1].lower()
+        if len(plan.name) == 1 and key in ctes:
+            cte = ctes[key]
+            node, cscope = self.resolve_query(
+                cte.plan, Scope([], outer, cte.ctes), outer)
+            fields = [dataclasses.replace(f, qualifiers=(plan.name[-1],))
+                      for f in cscope.fields]
+            return node, Scope(fields, outer, ctes)
+        entry = self.catalog.lookup_table(plan.name)
+        if entry is None:
+            raise ResolutionError(f"table not found: {'.'.join(plan.name)}")
+        if entry.view_plan is not None:
+            node, cscope = self.resolve_query(entry.view_plan, Scope([], None, {}), None)
+            fields = [dataclasses.replace(f, qualifiers=(plan.name[-1],))
+                      for f in cscope.fields]
+            return node, Scope(fields, outer, ctes)
+        schema = tuple(pn.Field(f.name, f.data_type, f.nullable)
+                       for f in entry.schema.fields)
+        node = pn.ScanExec(schema, entry.data, tuple(entry.paths), entry.format,
+                           tuple(plan.options), None, ".".join(plan.name))
+        qual = plan.name[-1]
+        fields = [ScopeField(f.name, (qual,), f.dtype, f.nullable) for f in schema]
+        return node, Scope(fields, outer, ctes)
+
+    def _resolve_read_source(self, plan: sp.ReadDataSource, outer):
+        from ..io.formats import infer_schema
+        schema = plan.schema or infer_schema(plan.format, plan.paths, dict(plan.options))
+        out = tuple(pn.Field(f.name, f.data_type, f.nullable) for f in schema.fields)
+        node = pn.ScanExec(out, None, tuple(plan.paths), plan.format,
+                           tuple(plan.options))
+        fields = [ScopeField(f.name, (), f.dtype, f.nullable) for f in out]
+        return node, Scope(fields, outer, {})
+
+    def _resolve_local(self, plan: sp.LocalRelation, outer):
+        import pyarrow as pa
+        from ..columnar.arrow_interop import arrow_type_to_spec
+        table = plan.data
+        assert isinstance(table, pa.Table)
+        out = tuple(pn.Field(n, arrow_type_to_spec(t), True)
+                    for n, t in zip(table.column_names, [c.type for c in table.columns]))
+        node = pn.ScanExec(out, table, (), "memory")
+        fields = [ScopeField(f.name, (), f.dtype, f.nullable) for f in out]
+        return node, Scope(fields, outer, {})
+
+    def _resolve_values(self, plan: sp.Values, outer, ctes):
+        rows = []
+        types: List[dt.DataType] = []
+        for row in plan.rows:
+            vals = []
+            for j, e in enumerate(row):
+                r = self._resolve_expr(e, Scope([], None, {}))
+                if not isinstance(r, rx.RLit):
+                    raise ResolutionError("VALUES rows must be literals in v0")
+                vals.append(r.value)
+                t = r.value.data_type
+                if j >= len(types):
+                    types.append(t)
+                elif not isinstance(t, dt.NullType):
+                    types[j] = t if isinstance(types[j], dt.NullType) \
+                        else dt.common_type(types[j], t)
+            rows.append(tuple(vals))
+        schema = tuple(pn.Field(f"col{j + 1}", t, True) for j, t in enumerate(types))
+        node = pn.ValuesExec(schema, tuple(rows))
+        fields = [ScopeField(f.name, (), f.dtype, f.nullable) for f in schema]
+        return node, Scope(fields, outer, ctes)
+
+    def _scope_of(self, node: pn.PlanNode, qual, outer, ctes) -> Scope:
+        quals = (qual,) if qual else ()
+        return Scope([ScopeField(f.name, quals, f.dtype, f.nullable)
+                      for f in node.schema], outer, ctes)
+
+    # ------------------------------------------------------------------
+    # filter + subquery rewrites
+    # ------------------------------------------------------------------
+    def _resolve_filter(self, plan: sp.Filter, scope, outer):
+        child, cscope = self.resolve_query(plan.input, scope, outer)
+        conjuncts = _split_conjuncts(plan.condition)
+        plain: List[ex.Expr] = []
+        for c in conjuncts:
+            rewritten = self._try_subquery_conjunct(c, child, cscope)
+            if rewritten is not None:
+                child, cscope = rewritten
+            else:
+                plain.append(c)
+        if plain:
+            cond = self._resolve_predicate(_and_all(plain), cscope)
+            child = pn.FilterExec(child, cond)
+        return child, cscope
+
+    def _try_subquery_conjunct(self, c: ex.Expr, child: pn.PlanNode,
+                               cscope: Scope):
+        """Rewrite EXISTS/IN/correlated-scalar conjuncts into joins.
+        Returns (new_child, new_scope) or None if not a subquery conjunct."""
+        if isinstance(c, ex.Exists):
+            return self._rewrite_exists(c.plan, c.negated, None, child, cscope)
+        if isinstance(c, ex.Function) and c.name == "not" and \
+                isinstance(c.args[0], ex.Exists):
+            inner = c.args[0]
+            return self._rewrite_exists(inner.plan, not inner.negated, None,
+                                        child, cscope)
+        if isinstance(c, ex.InSubquery):
+            return self._rewrite_exists(c.plan, c.negated, c.child, child, cscope)
+        if isinstance(c, ex.Function) and c.name == "not" and \
+                isinstance(c.args[0], ex.InSubquery):
+            inner = c.args[0]
+            return self._rewrite_exists(inner.plan, not inner.negated,
+                                        inner.child, child, cscope)
+        # correlated scalar comparison: cmp(expr, subquery) / cmp(subquery, expr)
+        if isinstance(c, ex.Function) and len(c.args) == 2:
+            for i in (0, 1):
+                if isinstance(c.args[i], ex.ScalarSubquery):
+                    sub = c.args[i]
+                    if self._is_correlated(sub.plan, cscope):
+                        return self._rewrite_correlated_scalar(
+                            c, i, sub.plan, child, cscope)
+        return None
+
+    def _is_correlated(self, sub_plan: sp.QueryPlan, outer_scope: Scope) -> bool:
+        try:
+            probe = Scope([], None, dict(outer_scope.ctes))
+            node, sscope = self.resolve_query(sub_plan, probe, outer_scope)
+            return _plan_has_outer_refs(node)
+        except ResolutionError:
+            return True  # resolution failed standalone → assume correlated
+
+    def _rewrite_exists(self, sub_plan: sp.QueryPlan, negated: bool,
+                        in_child: Optional[ex.Expr], child: pn.PlanNode,
+                        cscope: Scope):
+        sub_node, sub_scope = self.resolve_query(
+            sub_plan, Scope([], None, dict(cscope.ctes)), cscope)
+        sub_node, left_keys, right_keys, residual = _decorrelate(sub_node)
+        if in_child is not None:
+            # IN: add equality on the subquery's (single) output column
+            probe = self._resolve_expr(in_child, cscope)
+            if len(sub_node.schema) < 1:
+                raise ResolutionError("IN subquery must output one column")
+            left_keys = left_keys + [probe]
+            f0 = sub_node.schema[0]
+            right_keys = right_keys + [rx.BoundRef(0, f0.name, f0.dtype, f0.nullable)]
+        join_type = "anti" if negated else "semi"
+        node = pn.JoinExec(child, sub_node, join_type,
+                           tuple(left_keys), tuple(right_keys),
+                           _combine_residual(residual, len(child.schema)))
+        return node, cscope
+
+    def _rewrite_correlated_scalar(self, cmp: ex.Function, sub_pos: int,
+                                   sub_plan: sp.QueryPlan, child: pn.PlanNode,
+                                   cscope: Scope):
+        sub_node, sub_scope = self.resolve_query(
+            sub_plan, Scope([], None, dict(cscope.ctes)), cscope)
+        # sub_node must be an aggregation producing one value. Strip the
+        # correlated conjuncts from the filter chain under the aggregate's
+        # pre-projection, then group by those correlation keys.
+        if not (isinstance(sub_node, pn.ProjectExec)
+                and isinstance(sub_node.input, pn.AggregateExec)):
+            raise ResolutionError("correlated scalar subquery must be a "
+                                  "single aggregate query")
+        agg = sub_node.input
+        pre = agg.input
+        assert isinstance(pre, pn.ProjectExec)
+        new_src, left_keys, right_keys, residual = _strip_correlated_filters(pre.input)
+        if residual:
+            raise ResolutionError(
+                "correlated scalar subquery with non-equality correlation")
+        if not left_keys:
+            raise ResolutionError("scalar subquery classified correlated but "
+                                  "no correlation keys found")
+        new_pre = dataclasses.replace(pre, input=new_src)
+        sub_node = dataclasses.replace(
+            sub_node, input=dataclasses.replace(agg, input=new_pre))
+        grouped, val_index, key_indices = _group_scalar_subplan(sub_node, right_keys)
+        n_left = len(child.schema)
+        joined = pn.JoinExec(child, grouped, "left", tuple(left_keys),
+                             tuple(rx.BoundRef(i, grouped.schema[i].name,
+                                               grouped.schema[i].dtype, True)
+                                   for i in key_indices), None)
+        # rebuild comparison with the value column substituted
+        vf = grouped.schema[val_index]
+        val_ref = rx.BoundRef(n_left + val_index, vf.name, vf.dtype, True)
+        other = self._resolve_expr(cmp.args[1 - sub_pos], cscope)
+        args = (other, val_ref) if sub_pos == 1 else (val_ref, other)
+        cond = self._make_call(cmp.name, list(args))
+        filtered = pn.FilterExec(joined, cond)
+        # project back to the outer columns only
+        exprs = tuple((f.name, rx.BoundRef(i, f.name, f.dtype, f.nullable))
+                      for i, f in enumerate(child.schema))
+        node = pn.ProjectExec(filtered, exprs)
+        return node, cscope
+
+    # ------------------------------------------------------------------
+    # project / aggregate
+    # ------------------------------------------------------------------
+    def _expand_star(self, items: Sequence[ex.Expr], cscope: Scope) -> List[ex.Expr]:
+        out: List[ex.Expr] = []
+        for item in items:
+            target = None
+            if isinstance(item, ex.Star):
+                target = item.target
+            elif isinstance(item, ex.Function) and item.name == "count" and \
+                    len(item.args) == 1 and isinstance(item.args[0], ex.Star):
+                out.append(item)
+                continue
+            if target is None:
+                out.append(item)
+                continue
+            quals = tuple(q.lower() for q in target)
+            for f in cscope.fields:
+                fq = tuple(q.lower() for q in f.qualifiers)
+                if not quals or _qual_suffix_match(fq, quals):
+                    parts = f.qualifiers[-1:] + (f.name,) if f.qualifiers else (f.name,)
+                    out.append(ex.Attribute(parts))
+        return out
+
+    def _output_name(self, e: ex.Expr) -> str:
+        if isinstance(e, ex.Alias):
+            return e.name[-1]
+        if isinstance(e, ex.Attribute):
+            return e.name[-1]
+        if isinstance(e, ex.Function):
+            return f"{e.name}({', '.join(self._output_name(a) for a in e.args)})"
+        if isinstance(e, ex.Literal):
+            return str(e.value.value)
+        if isinstance(e, ex.Cast):
+            return self._output_name(e.child)
+        if isinstance(e, ex.CaseWhen):
+            return "CASE"
+        if isinstance(e, ex.Extract):
+            return e.field_name
+        if isinstance(e, ex.Star):
+            return "*"
+        return type(e).__name__.lower()
+
+    def _resolve_project(self, plan: sp.Project, scope, outer):
+        child, cscope = self.resolve_query(plan.input, scope, outer) \
+            if plan.input is not None else (pn.OneRowExec(), Scope([], outer, {}))
+        items = self._expand_star(plan.expressions, cscope)
+        # implicit global aggregate: SELECT sum(x) FROM t
+        if any(_has_aggregate(e) for e in items):
+            agg = sp.Aggregate(plan.input if plan.input is not None else sp.OneRow(),
+                               (), tuple(items))
+            return self._resolve_aggregate(agg, scope, outer,
+                                           pre_resolved=(child, cscope))
+        exprs = []
+        fields = []
+        for item in items:
+            name = self._output_name(item)
+            r = self._resolve_expr(_unalias(item), cscope)
+            exprs.append((name, r))
+            fields.append(ScopeField(name, (), rx.rex_type(r), rx.rex_nullable(r)))
+        node = pn.ProjectExec(child, tuple(exprs))
+        out_scope = Scope(fields, outer, cscope.ctes)
+        out_scope.below = cscope
+        return node, out_scope
+
+    def _resolve_aggregate(self, plan: sp.Aggregate, scope, outer,
+                           pre_resolved=None):
+        if plan.grouping_sets is not None or plan.rollup or plan.cube:
+            return self._resolve_grouping_sets(plan, scope, outer)
+        if pre_resolved is not None:
+            child, cscope = pre_resolved
+        else:
+            child, cscope = self.resolve_query(plan.input, scope, outer)
+        items = self._expand_star(plan.aggregate, cscope)
+        # group expressions (support ordinals and output aliases)
+        group_exprs: List[ex.Expr] = []
+        for g in plan.group:
+            if isinstance(g, ex.Literal) and g.value.data_type.is_integer:
+                idx = int(g.value.value) - 1
+                if not (0 <= idx < len(items)):
+                    raise ResolutionError(f"GROUP BY ordinal {idx + 1} out of range")
+                group_exprs.append(_unalias(items[idx]))
+            else:
+                group_exprs.append(_unalias(self._subst_alias(g, items)))
+        group_rex = [self._resolve_expr(g, cscope) for g in group_exprs]
+
+        collector = _AggCollector(self, cscope, group_exprs, group_rex)
+        out_items: List[Tuple[str, ex.Expr]] = []
+        for item in items:
+            out_items.append((self._output_name(item), _unalias(item)))
+        post_exprs = [(n, collector.rewrite(e)) for n, e in out_items]
+        having_rex = None
+        if plan.having is not None:
+            having_rex = collector.rewrite(self._subst_alias(plan.having, items))
+
+        if collector.has_distinct and any(not a.spec.distinct for a in collector.aggs):
+            raise ResolutionError("mixing DISTINCT and non-DISTINCT aggregates "
+                                  "is not supported yet")
+
+        # pre-projection: group keys then agg args
+        pre = [( _fresh("g"), g) for g in group_rex]
+        for a_rex in collector.arg_rex:
+            pre.append((_fresh("a"), a_rex))
+        pre_node = pn.ProjectExec(child, tuple(pre))
+        ngroup = len(group_rex)
+
+        if collector.has_distinct:
+            # two-level: group by keys + distinct args, then aggregate
+            inner = pn.AggregateExec(
+                pre_node,
+                tuple(range(len(pre))),
+                (),
+                tuple(n for n, _ in pre))
+            specs = []
+            for a in collector.aggs:
+                arg = None if a.arg is None else ngroup + a.arg
+                specs.append(dataclasses.replace(a.spec, arg=arg))
+            agg_node = pn.AggregateExec(
+                inner, tuple(range(ngroup)), tuple(specs),
+                tuple(n for n, _ in pre[:ngroup])
+                + tuple(_fresh("agg") for _ in specs))
+        else:
+            specs = []
+            for a in collector.aggs:
+                arg = None if a.arg is None else ngroup + a.arg
+                specs.append(dataclasses.replace(a.spec, arg=arg))
+            agg_node = pn.AggregateExec(
+                pre_node, tuple(range(ngroup)), tuple(specs),
+                tuple(n for n, _ in pre[:ngroup])
+                + tuple(_fresh("agg") for _ in specs))
+
+        post = pn.ProjectExec(agg_node, tuple(post_exprs))
+        if having_rex is not None:
+            # filter on an extended projection, then trim
+            ext = pn.ProjectExec(agg_node, tuple(post_exprs) + (("__having", having_rex),))
+            filt = pn.FilterExec(ext, rx.BoundRef(len(post_exprs), "__having",
+                                                  dt.BooleanType(), True))
+            post = pn.ProjectExec(filt, tuple(
+                (n, rx.BoundRef(i, n, rx.rex_type(e), rx.rex_nullable(e)))
+                for i, (n, e) in enumerate(post_exprs)))
+        fields = [ScopeField(n, (), rx.rex_type(e), rx.rex_nullable(e))
+                  for n, e in post_exprs]
+        return post, Scope(fields, outer, cscope.ctes)
+
+    def _resolve_grouping_sets(self, plan: sp.Aggregate, scope, outer):
+        sets: List[Tuple[ex.Expr, ...]]
+        if plan.rollup:
+            base = list(plan.group)
+            sets = [tuple(base[:i]) for i in range(len(base), -1, -1)]
+        elif plan.cube:
+            base = list(plan.group)
+            sets = []
+            for mask in range(1 << len(base), -1, -1):
+                if mask == 1 << len(base):
+                    continue
+                sets.append(tuple(b for i, b in enumerate(base) if mask & (1 << i)))
+        else:
+            sets = list(plan.grouping_sets)
+        branches = []
+        all_group = list(plan.group) if (plan.rollup or plan.cube) else \
+            list({g for s in sets for g in s})
+        for s in sets:
+            # per grouping set: group by present keys; absent keys → NULL
+            items = []
+            for it in plan.aggregate:
+                items.append(self._null_out_absent(it, set(s), set(all_group)))
+            branches.append(sp.Aggregate(plan.input, tuple(s), tuple(items),
+                                         plan.having))
+        union: sp.QueryPlan = branches[0]
+        for b in branches[1:]:
+            union = sp.SetOperation(union, b, "union", all=True)
+        return self.resolve_query(union, scope, outer)
+
+    def _null_out_absent(self, item: ex.Expr, present: Set[ex.Expr],
+                         all_group: Set[ex.Expr]) -> ex.Expr:
+        name = self._output_name(item)
+        base = _unalias(item)
+        if base in all_group and base not in present:
+            return ex.Alias(ex.Cast(ex.Literal(LV.null()), dt.NullType()), (name,))
+        return ex.Alias(base, (name,)) if not isinstance(item, ex.Alias) else item
+
+    def _subst_alias(self, e: ex.Expr, items: Sequence[ex.Expr]) -> ex.Expr:
+        """Replace references to select-list aliases (HAVING/GROUP BY)."""
+        if isinstance(e, ex.Attribute) and len(e.name) == 1:
+            for it in items:
+                if isinstance(it, ex.Alias) and it.name[-1].lower() == e.name[0].lower():
+                    return it.child
+        if isinstance(e, ex.Function):
+            return dataclasses.replace(
+                e, args=tuple(self._subst_alias(a, items) for a in e.args))
+        return e
+
+    def _resolve_dedup(self, plan: sp.Deduplicate, scope, outer):
+        child, cscope = self.resolve_query(plan.input, scope, outer)
+        n = len(child.schema)
+        if plan.columns:
+            keys = [cscope.find((c,)) for c in plan.columns]
+            key_idx = [k for k in keys if k is not None]
+        else:
+            key_idx = list(range(n))
+        aggs = []
+        out_names = [child.schema[i].name for i in key_idx]
+        for i, f in enumerate(child.schema):
+            if i in key_idx:
+                continue
+            aggs.append(pn.AggSpec("first", i, False, f.dtype))
+            out_names.append(f.name)
+        node = pn.AggregateExec(child, tuple(key_idx), tuple(aggs), tuple(out_names))
+        # restore original column order
+        order = []
+        for f in child.schema:
+            order.append(node.schema[[s.name for s in node.schema].index(f.name)])
+        exprs = tuple((f.name, rx.BoundRef([s.name for s in node.schema].index(f.name),
+                                           f.name, f.dtype, f.nullable))
+                      for f in child.schema)
+        proj = pn.ProjectExec(node, exprs)
+        fields = [ScopeField(f.name, (), f.dtype, f.nullable) for f in child.schema]
+        return proj, Scope(fields, outer, cscope.ctes)
+
+    def _resolve_setop(self, plan: sp.SetOperation, scope, outer):
+        left, lscope = self.resolve_query(plan.left, scope, outer)
+        right, rscope = self.resolve_query(plan.right, scope, outer)
+        if len(left.schema) != len(right.schema):
+            raise ResolutionError("set operation inputs have different arity")
+        # coerce right columns to common types
+        right = _coerce_to(right, left.schema)
+        left = _coerce_to(left, right.schema) if False else left
+        if plan.op == "union":
+            node: pn.PlanNode = pn.UnionExec((left, right), True)
+            out_scope = Scope([ScopeField(f.name, (), f.dtype, True)
+                               for f in left.schema], outer, lscope.ctes)
+            if not plan.all:
+                dedup = sp.Deduplicate(_PreResolved(node, out_scope))
+                return self._resolve_dedup_pre(node, out_scope, outer)
+            return node, out_scope
+        # intersect/except via semi/anti join on all columns
+        join_type = "semi" if plan.op == "intersect" else "anti"
+        lk = tuple(rx.BoundRef(i, f.name, f.dtype, f.nullable)
+                   for i, f in enumerate(left.schema))
+        rk = tuple(rx.BoundRef(i, f.name, f.dtype, f.nullable)
+                   for i, f in enumerate(right.schema))
+        node = pn.JoinExec(left, right, join_type, lk, rk, None)
+        out_scope = Scope([ScopeField(f.name, (), f.dtype, f.nullable)
+                           for f in left.schema], outer, lscope.ctes)
+        if not plan.all:
+            return self._resolve_dedup_pre(node, out_scope, outer)
+        return node, out_scope
+
+    def _resolve_dedup_pre(self, node: pn.PlanNode, nscope: Scope, outer):
+        n = len(node.schema)
+        agg = pn.AggregateExec(node, tuple(range(n)), (),
+                               tuple(f.name for f in node.schema))
+        return agg, nscope
+
+    def _resolve_with_columns(self, plan: sp.WithColumns, scope, outer):
+        child, cscope = self.resolve_query(plan.input, scope, outer)
+        new_cols = {}
+        for a in plan.aliases:
+            assert isinstance(a, ex.Alias)
+            new_cols[a.name[-1].lower()] = self._resolve_expr(a.child, cscope)
+        exprs = []
+        fields = []
+        seen = set()
+        for i, f in enumerate(child.schema):
+            key = f.name.lower()
+            if key in new_cols:
+                r = new_cols.pop(key)
+                exprs.append((f.name, r))
+                fields.append(ScopeField(f.name, (), rx.rex_type(r), True))
+            else:
+                exprs.append((f.name, rx.BoundRef(i, f.name, f.dtype, f.nullable)))
+                fields.append(cscope.fields[i])
+        for name, r in new_cols.items():
+            exprs.append((name, r))
+            fields.append(ScopeField(name, (), rx.rex_type(r), True))
+        return pn.ProjectExec(child, tuple(exprs)), Scope(fields, outer, cscope.ctes)
+
+    def _resolve_sample(self, plan: sp.Sample, scope, outer):
+        child, cscope = self.resolve_query(plan.input, scope, outer)
+        frac = plan.upper_bound - plan.lower_bound
+        cond = rx.RCall("sample_mask", (rx.RLit(LV.float64(frac)),
+                                        rx.RLit(LV.int64(plan.seed or 42))),
+                        dt.BooleanType(), False)
+        return pn.FilterExec(child, cond), cscope
+
+    # ------------------------------------------------------------------
+    # joins
+    # ------------------------------------------------------------------
+    def _resolve_join(self, plan: sp.Join, scope, outer):
+        left, lscope = self.resolve_query(plan.left, scope, outer)
+        right, rscope = self.resolve_query(plan.right, scope, outer)
+        nleft = len(left.schema)
+        combined = Scope(lscope.fields + rscope.fields, outer,
+                         {**lscope.ctes, **rscope.ctes})
+        jt = plan.join_type
+        using = list(plan.using)
+        if plan.is_natural:
+            lnames = {f.name.lower() for f in left.schema}
+            using = [f.name for f in right.schema if f.name.lower() in lnames]
+        left_keys: List[rx.Rex] = []
+        right_keys: List[rx.Rex] = []
+        residual: Optional[rx.Rex] = None
+        if using:
+            for u in using:
+                li = lscope.find((u,))
+                ri = rscope.find((u,))
+                if li is None or ri is None:
+                    raise ResolutionError(f"USING column {u!r} not on both sides")
+                lf, rf = left.schema[li], right.schema[ri]
+                left_keys.append(rx.BoundRef(li, lf.name, lf.dtype, lf.nullable))
+                right_keys.append(rx.BoundRef(ri, rf.name, rf.dtype, rf.nullable))
+        elif plan.condition is not None:
+            conjuncts = _split_conjuncts(plan.condition)
+            residual_parts = []
+            for c in conjuncts:
+                pair = self._try_equi_pair(c, lscope, rscope)
+                if pair is not None:
+                    left_keys.append(pair[0])
+                    right_keys.append(pair[1])
+                else:
+                    residual_parts.append(self._resolve_predicate(c, combined))
+            if residual_parts:
+                residual = _and_rex(residual_parts)
+        if jt == "cross" and (left_keys or residual):
+            jt = "inner"
+        node = pn.JoinExec(left, right, jt, tuple(left_keys), tuple(right_keys),
+                           residual)
+        if jt in ("semi", "anti"):
+            out_fields = list(lscope.fields)
+        else:
+            out_fields = lscope.fields + rscope.fields
+            if using:
+                # drop right-side USING columns from the visible scope
+                drop = {u.lower() for u in using}
+                proj_exprs = []
+                new_fields = []
+                for i, f in enumerate(node.schema):
+                    if i >= nleft and f.name.lower() in drop:
+                        continue
+                    proj_exprs.append((f.name, rx.BoundRef(i, f.name, f.dtype,
+                                                           f.nullable)))
+                    new_fields.append(out_fields[i])
+                node = pn.ProjectExec(node, tuple(proj_exprs))
+                out_fields = new_fields
+        return node, Scope(out_fields, outer, {**lscope.ctes, **rscope.ctes})
+
+    def _try_equi_pair(self, c: ex.Expr, lscope: Scope, rscope: Scope):
+        if not (isinstance(c, ex.Function) and c.name in ("==", "=") and len(c.args) == 2):
+            return None
+        a, b = c.args
+        for first, second, swap in ((a, b, False), (b, a, True)):
+            try:
+                lr = self._resolve_expr(first, Scope(lscope.fields, None, {}))
+                rr = self._resolve_expr(second, Scope(rscope.fields, None, {}))
+                lt, rt2 = rx.rex_type(lr), rx.rex_type(rr)
+                if lt != rt2:
+                    common = dt.common_type(lt, rt2)
+                    if lt != common:
+                        lr = rx.RCast(lr, common)
+                    if rt2 != common:
+                        rr = rx.RCast(rr, common)
+                return (lr, rr)
+            except ResolutionError:
+                continue
+        return None
+
+    # ------------------------------------------------------------------
+    # expressions
+    # ------------------------------------------------------------------
+    def _ordinal_or_expr(self, e: ex.Expr, cscope: Scope, child: pn.PlanNode) -> rx.Rex:
+        if isinstance(e, ex.Literal) and e.value.data_type.is_integer:
+            idx = int(e.value.value) - 1
+            if 0 <= idx < len(child.schema):
+                f = child.schema[idx]
+                return rx.BoundRef(idx, f.name, f.dtype, f.nullable)
+        return self._resolve_expr(e, cscope)
+
+    def _resolve_predicate(self, e: ex.Expr, scope: Scope) -> rx.Rex:
+        r = self._resolve_expr(e, scope)
+        if not isinstance(rx.rex_type(r), dt.BooleanType):
+            r = rx.RCast(r, dt.BooleanType())
+        return r
+
+    def _resolve_expr(self, e: ex.Expr, scope: Scope) -> rx.Rex:
+        if isinstance(e, ex.Literal):
+            return rx.RLit(e.value)
+        if isinstance(e, ex.Alias):
+            return self._resolve_expr(e.child, scope)
+        if isinstance(e, ex.Attribute):
+            return self._resolve_attribute(e, scope)
+        if isinstance(e, ex.Cast):
+            child = self._resolve_expr(e.child, scope)
+            return rx.RCast(child, e.data_type, e.try_, rx.rex_nullable(child) or e.try_)
+        if isinstance(e, ex.Between):
+            child = self._resolve_expr(e.child, scope)
+            low = self._resolve_expr(e.low, scope)
+            high = self._resolve_expr(e.high, scope)
+            ge = self._make_call(">=", [child, low])
+            le = self._make_call("<=", [child, high])
+            r = self._make_call("and", [ge, le])
+            return self._make_call("not", [r]) if e.negated else r
+        if isinstance(e, ex.InList):
+            child = self._resolve_expr(e.child, scope)
+            vals = [self._resolve_expr(v, scope) for v in e.values]
+            r = rx.RCall("in", tuple([child] + vals), dt.BooleanType(), True)
+            return self._make_call("not", [r]) if e.negated else r
+        if isinstance(e, ex.Like):
+            child = self._resolve_expr(e.child, scope)
+            pattern = self._resolve_expr(e.pattern, scope)
+            fn = "ilike" if e.case_insensitive else "like"
+            opts = (("escape", e.escape),) if e.escape else ()
+            r = rx.RCall(fn, (child, pattern), dt.BooleanType(), True, opts)
+            return self._make_call("not", [r]) if e.negated else r
+        if isinstance(e, ex.CaseWhen):
+            branches = []
+            vtypes = []
+            for c, v in e.branches:
+                rc = self._resolve_predicate(c, scope)
+                rv = self._resolve_expr(v, scope)
+                branches.append((rc, rv))
+                vtypes.append(rx.rex_type(rv))
+            relse = self._resolve_expr(e.else_value, scope) \
+                if e.else_value is not None else None
+            if relse is not None:
+                vtypes.append(rx.rex_type(relse))
+            out_t = vtypes[0]
+            for t in vtypes[1:]:
+                if not isinstance(t, dt.NullType):
+                    out_t = t if isinstance(out_t, dt.NullType) else dt.common_type(out_t, t)
+            branches = [(c, self._coerce(v, out_t)) for c, v in branches]
+            if relse is not None:
+                relse = self._coerce(relse, out_t)
+            return rx.RCase(tuple(branches), relse, out_t, True)
+        if isinstance(e, ex.Extract):
+            child = self._resolve_expr(e.child, scope)
+            fname = {"year": "year", "yearofweek": "year", "quarter": "quarter",
+                     "month": "month", "day": "day", "dayofmonth": "day",
+                     "week": "weekofyear", "dow": "dayofweek", "doy": "dayofyear",
+                     "hour": "hour", "minute": "minute", "second": "second"}.get(
+                         e.field_name, e.field_name)
+            return self._make_call(fname, [child])
+        if isinstance(e, ex.ScalarSubquery):
+            node, _ = self.resolve_query(e.plan, Scope([], None, dict(scope.ctes)),
+                                         scope)
+            if _plan_has_outer_refs(node):
+                raise ResolutionError(
+                    "correlated scalar subquery in unsupported position")
+            if len(node.schema) != 1:
+                raise ResolutionError("scalar subquery must return one column")
+            f = node.schema[0]
+            return rx.RScalarSubquery(node, f.dtype, True)
+        if isinstance(e, ex.Exists) or isinstance(e, ex.InSubquery):
+            raise ResolutionError(
+                f"{type(e).__name__} is only supported in WHERE/HAVING conjuncts")
+        if isinstance(e, ex.Window):
+            raise ResolutionError("window expressions are resolved by the "
+                                  "window planner (not yet reachable here)")
+        if isinstance(e, ex.Function):
+            return self._resolve_function(e, scope)
+        raise ResolutionError(f"unsupported expression {type(e).__name__}")
+
+    def _resolve_attribute(self, e: ex.Attribute, scope: Scope) -> rx.Rex:
+        idx = scope.find(e.name)
+        if idx is not None:
+            f = scope.fields[idx]
+            return rx.BoundRef(idx, f.name, f.dtype, f.nullable)
+        if scope.parent is not None:
+            pidx = scope.parent.find(e.name)
+            if pidx is not None:
+                pf = scope.parent.fields[pidx]
+                scope.used_outer = True
+                return ROuterRef(pidx, pf.name, pf.dtype, pf.nullable)
+        raise ResolutionError(f"column not found: {'.'.join(e.name)}")
+
+    def _coerce(self, r: rx.Rex, target: dt.DataType) -> rx.Rex:
+        if rx.rex_type(r) == target or isinstance(target, dt.NullType):
+            return r
+        return rx.RCast(r, target, False, rx.rex_nullable(r))
+
+    def _make_call(self, name: str, args: List[rx.Rex]) -> rx.Rex:
+        name = name.lower()
+        if name == "=":
+            name = "=="
+        arg_types = [rx.rex_type(a) for a in args]
+        # numeric/comparison coercion
+        if name in ("+", "-", "*", "/", "%", "div", "==", "!=", "<", "<=",
+                    ">", ">=", "<=>", "pmod") and len(args) == 2:
+            a, b = arg_types
+            temporal = (dt.DateType, dt.TimestampType)
+            interval = (dt.DayTimeIntervalType, dt.YearMonthIntervalType)
+            if name in ("+", "-") and (isinstance(a, temporal) or isinstance(b, temporal)):
+                if isinstance(a, interval) or isinstance(b, interval):
+                    out = a if isinstance(a, temporal) else b
+                    return rx.RCall(f"date{name}interval", tuple(args), out,
+                                    any(rx.rex_nullable(x) for x in args))
+                if name == "-" and isinstance(a, dt.DateType) and isinstance(b, dt.DateType):
+                    return rx.RCall("datediff", tuple(args), dt.IntegerType(),
+                                    any(rx.rex_nullable(x) for x in args))
+                if isinstance(a, dt.DateType) and b.is_integer:
+                    return rx.RCall("date_add" if name == "+" else "date_sub",
+                                    tuple(args), dt.DateType(),
+                                    any(rx.rex_nullable(x) for x in args))
+            if not (isinstance(a, (dt.StringType, dt.BinaryType))
+                    or isinstance(b, (dt.StringType, dt.BinaryType))):
+                try:
+                    common = dt.common_type(a, b)
+                except TypeError:
+                    common = None
+                if common is not None and name not in ("/",):
+                    args = [self._coerce(args[0], common), self._coerce(args[1], common)]
+                    arg_types = [common, common]
+        out_t = freg.infer_function_type(name, arg_types)
+        nullable = any(rx.rex_nullable(a) for a in args) or \
+            name in ("/", "div", "%", "nullif")
+        return rx.RCall(name, tuple(args), out_t, nullable)
+
+    def _resolve_function(self, e: ex.Function, scope: Scope) -> rx.Rex:
+        name = e.name.lower()
+        if freg.is_aggregate(name):
+            raise ResolutionError(
+                f"aggregate function {name}() used outside aggregation context")
+        args = [self._resolve_expr(a, scope) for a in e.args]
+        # rewrites
+        if name in ("nvl", "ifnull"):
+            name = "coalesce"
+        if name == "substr":
+            name = "substring"
+        if name in ("position", "locate") and len(args) >= 2:
+            # position(sub, str) → instr(str, sub)
+            args = [args[1], args[0]] + args[2:]
+            name = "instr"
+        if name in ("date_format",):
+            raise ResolutionError("date_format not yet supported")
+        return self._make_call(name, args)
+
+
+@dataclasses.dataclass
+class _InlinedCte:
+    plan: sp.QueryPlan
+    ctes: Dict[str, "_InlinedCte"]
+
+
+class _PreResolved(sp.QueryPlan):
+    def __init__(self, node, scope):
+        self.node = node
+        self.scope = scope
+
+
+@dataclasses.dataclass
+class _CollectedAgg:
+    spec: pn.AggSpec
+    arg: Optional[int]          # index into collector.arg_rex
+
+
+class _AggCollector:
+    """Walks select/having expressions, extracting aggregate calls and
+    group-key matches, producing post-aggregation expressions."""
+
+    def __init__(self, resolver: Resolver, scope: Scope,
+                 group_exprs: Sequence[ex.Expr], group_rex: Sequence[rx.Rex]):
+        self.resolver = resolver
+        self.scope = scope
+        self.group_exprs = list(group_exprs)
+        self.group_rex = list(group_rex)
+        self.aggs: List[_CollectedAgg] = []
+        self.arg_rex: List[rx.Rex] = []
+        self.has_distinct = False
+
+    def _arg_index(self, r: rx.Rex) -> int:
+        for i, existing in enumerate(self.arg_rex):
+            if existing == r:
+                return i
+        self.arg_rex.append(r)
+        return len(self.arg_rex) - 1
+
+    def _add_agg(self, fn: str, arg: Optional[rx.Rex], distinct: bool,
+                 out_dtype: dt.DataType, ignore_nulls: bool = True) -> rx.Rex:
+        ai = None if arg is None else self._arg_index(arg)
+        spec = pn.AggSpec(fn, ai, distinct, out_dtype, None, ignore_nulls)
+        for j, existing in enumerate(self.aggs):
+            if existing.spec == spec:
+                return self._post_ref(j)
+        self.aggs.append(_CollectedAgg(spec, ai))
+        return self._post_ref(len(self.aggs) - 1)
+
+    def _post_ref(self, agg_index: int) -> rx.Rex:
+        idx = len(self.group_rex) + agg_index
+        spec = self.aggs[agg_index].spec
+        return rx.BoundRef(idx, f"__agg{agg_index}", spec.out_dtype,
+                           spec.fn != "count")
+
+    def _group_ref(self, i: int) -> rx.Rex:
+        g = self.group_rex[i]
+        return rx.BoundRef(i, f"__g{i}", rx.rex_type(g), rx.rex_nullable(g))
+
+    def rewrite(self, e: ex.Expr) -> rx.Rex:
+        # group-key syntactic match first
+        for i, g in enumerate(self.group_exprs):
+            if _unalias(e) == g:
+                return self._group_ref(i)
+        if isinstance(e, ex.Function) and freg.is_aggregate(e.name):
+            return self._rewrite_agg(e)
+        if isinstance(e, ex.Alias):
+            return self.rewrite(e.child)
+        if isinstance(e, ex.Literal):
+            return rx.RLit(e.value)
+        if isinstance(e, ex.Cast):
+            child = self.rewrite(e.child)
+            return rx.RCast(child, e.data_type, e.try_)
+        if isinstance(e, ex.CaseWhen):
+            branches = tuple((self.rewrite(c), self.rewrite(v))
+                             for c, v in e.branches)
+            relse = self.rewrite(e.else_value) if e.else_value is not None else None
+            vt = [rx.rex_type(v) for _, v in branches]
+            if relse is not None:
+                vt.append(rx.rex_type(relse))
+            out_t = vt[0]
+            for t in vt[1:]:
+                if not isinstance(t, dt.NullType):
+                    out_t = t if isinstance(out_t, dt.NullType) else dt.common_type(out_t, t)
+            return rx.RCase(branches, relse, out_t, True)
+        if isinstance(e, ex.Function):
+            args = [self.rewrite(a) for a in e.args]
+            return self.resolver._make_call(e.name, args)
+        if isinstance(e, ex.Between):
+            child = self.rewrite(e.child)
+            low = self.rewrite(e.low)
+            high = self.rewrite(e.high)
+            r = self.resolver._make_call(
+                "and", [self.resolver._make_call(">=", [child, low]),
+                        self.resolver._make_call("<=", [child, high])])
+            return self.resolver._make_call("not", [r]) if e.negated else r
+        if isinstance(e, ex.ScalarSubquery):
+            return self.resolver._resolve_expr(e, self.scope)
+        if isinstance(e, ex.Attribute):
+            # must be a group key (or alias of one)
+            raise ResolutionError(
+                f"column {'.'.join(e.name)!r} must appear in GROUP BY or inside "
+                f"an aggregate function")
+        raise ResolutionError(f"unsupported expression in aggregation: "
+                              f"{type(e).__name__}")
+
+    def _rewrite_agg(self, e: ex.Function) -> rx.Rex:
+        fn = e.name.lower()
+        distinct = e.is_distinct
+        if distinct:
+            self.has_distinct = True
+        if fn in ("mean",):
+            fn = "avg"
+        if fn in ("first_value",):
+            fn = "first"
+        if fn in ("last_value",):
+            fn = "last"
+        if fn == "count" and (not e.args or isinstance(e.args[0], ex.Star)):
+            return self._add_agg("count", None, distinct, dt.LongType())
+        if fn == "count_if":
+            arg = self.resolver._resolve_expr(e.args[0], self.scope)
+            arg = rx.RCall("if", (arg, rx.RLit(LV.int32(1)),
+                                  rx.RLit(LV(dt.IntegerType(), None))),
+                           dt.IntegerType(), True)
+            return self._add_agg("count", arg, False, dt.LongType())
+        args = [self.resolver._resolve_expr(a, self.scope) for a in e.args]
+        if not args:
+            raise ResolutionError(f"{fn}() requires an argument")
+        arg = args[0]
+        at = rx.rex_type(arg)
+        if fn == "sum" or fn == "try_sum":
+            return self._add_agg("sum", arg, distinct, freg.sum_result_type(at))
+        if fn == "count":
+            return self._add_agg("count", arg, distinct, dt.LongType())
+        if fn in ("avg", "try_avg"):
+            s = self._add_agg("sum", arg, distinct, freg.sum_result_type(at))
+            c = self._add_agg("count", arg, distinct, dt.LongType())
+            return self.resolver._make_call("/", [s, c])
+        if fn in ("min", "max", "first", "last", "any_value"):
+            k = {"any_value": "first"}.get(fn, fn)
+            ignore = e.ignore_nulls if e.ignore_nulls is not None else True
+            return self._add_agg(k, arg, False, at, ignore)
+        if fn in ("bool_and", "every"):
+            return self._add_agg("bool_and", arg, False, dt.BooleanType())
+        if fn in ("bool_or", "any", "some"):
+            return self._add_agg("bool_or", arg, False, dt.BooleanType())
+        if fn in ("stddev", "stddev_samp", "stddev_pop", "variance",
+                  "var_samp", "var_pop"):
+            xf = arg if isinstance(at, dt.DoubleType) else rx.RCast(arg, dt.DoubleType())
+            s1 = self._add_agg("sum", xf, False, dt.DoubleType())
+            x2 = self.resolver._make_call("*", [xf, xf])
+            s2 = self._add_agg("sum", x2, False, dt.DoubleType())
+            c = self._add_agg("count", xf, False, dt.LongType())
+            mk = self.resolver._make_call
+            mean = mk("/", [s1, c])
+            num = mk("-", [s2, mk("*", [mk("*", [mean, mean]),
+                                        rx.RCast(c, dt.DoubleType())])])
+            denom_c = c if fn.endswith("_pop") else mk("-", [c, rx.RLit(LV.int64(1))])
+            var = mk("/", [num, denom_c])
+            if fn.startswith("var"):
+                return var
+            return mk("sqrt", [var])
+        if fn == "approx_count_distinct":
+            return self._add_agg("count", arg, True, dt.LongType())
+        raise ResolutionError(f"aggregate {fn!r} not supported yet")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _unalias(e: ex.Expr) -> ex.Expr:
+    while isinstance(e, ex.Alias):
+        e = e.child
+    return e
+
+
+def _split_conjuncts(e: ex.Expr) -> List[ex.Expr]:
+    if isinstance(e, ex.Function) and e.name == "and":
+        return _split_conjuncts(e.args[0]) + _split_conjuncts(e.args[1])
+    return [e]
+
+
+def _and_all(parts: List[ex.Expr]) -> ex.Expr:
+    out = parts[0]
+    for p in parts[1:]:
+        out = ex.Function("and", (out, p))
+    return out
+
+
+def _and_rex(parts: List[rx.Rex]) -> rx.Rex:
+    out = parts[0]
+    for p in parts[1:]:
+        out = rx.RCall("and", (out, p), dt.BooleanType(), True)
+    return out
+
+
+def _has_aggregate(e: ex.Expr) -> bool:
+    if isinstance(e, ex.Function):
+        if freg.is_aggregate(e.name):
+            return True
+        return any(_has_aggregate(a) for a in e.args)
+    if isinstance(e, ex.Alias):
+        return _has_aggregate(e.child)
+    if isinstance(e, ex.Cast):
+        return _has_aggregate(e.child)
+    if isinstance(e, ex.CaseWhen):
+        return any(_has_aggregate(c) or _has_aggregate(v) for c, v in e.branches) \
+            or (e.else_value is not None and _has_aggregate(e.else_value))
+    if isinstance(e, ex.Between):
+        return _has_aggregate(e.child) or _has_aggregate(e.low) or _has_aggregate(e.high)
+    return False
+
+
+def _rex_has_outer(r: rx.Rex) -> bool:
+    if isinstance(r, ROuterRef):
+        return True
+    if isinstance(r, rx.RCall):
+        return any(_rex_has_outer(a) for a in r.args)
+    if isinstance(r, rx.RCast):
+        return _rex_has_outer(r.child)
+    if isinstance(r, rx.RCase):
+        return any(_rex_has_outer(c) or _rex_has_outer(v) for c, v in r.branches) \
+            or (r.else_value is not None and _rex_has_outer(r.else_value))
+    return False
+
+
+def _plan_has_outer_refs(node: pn.PlanNode) -> bool:
+    for p in pn.walk_plan(node):
+        for r in _node_rex(p):
+            if _rex_has_outer(r):
+                return True
+    return False
+
+
+def _node_rex(p: pn.PlanNode):
+    if isinstance(p, pn.FilterExec):
+        yield p.condition
+    elif isinstance(p, pn.ProjectExec):
+        for _, e in p.exprs:
+            yield e
+    elif isinstance(p, pn.JoinExec):
+        yield from p.left_keys
+        yield from p.right_keys
+        if p.residual is not None:
+            yield p.residual
+    elif isinstance(p, pn.SortExec):
+        for k in p.keys:
+            yield k.expr
+
+
+def _strip_correlated_filters(node: pn.PlanNode):
+    """Strip correlated conjuncts from the FilterExec chain at the top of
+    ``node`` (the aggregate source of a correlated scalar subquery).
+    Returns (new_node, left_keys(outer), right_keys(bound to node schema),
+    residuals)."""
+    left_keys: List[rx.Rex] = []
+    right_keys: List[rx.Rex] = []
+    residuals: List[rx.Rex] = []
+    while isinstance(node, pn.FilterExec):
+        keep = []
+        for c in _split_rex_conjuncts(node.condition):
+            if not _rex_has_outer(c):
+                keep.append(c)
+                continue
+            pair = _outer_eq_pair(c)
+            if pair is None:
+                residuals.append(c)
+                continue
+            outer_r, inner_r = pair
+            left_keys.append(outer_r)
+            right_keys.append(inner_r)
+        child = node.input
+        if keep:
+            node = pn.FilterExec(child, _and_rex(keep))
+            break
+        node = child
+    return node, left_keys, right_keys, residuals
+
+
+def _decorrelate(node: pn.PlanNode):
+    """Strip outer-ref conjuncts from FilterExec nodes inside ``node``.
+
+    Returns (new_node, left_keys, right_keys, residuals). left_keys are Rex
+    bound to the OUTER schema; right_keys to ``node``'s output schema.
+    Correlated predicates are supported in filters whose columns pass through
+    to the subquery output (v0: filters directly under the root, or under the
+    root project whose exprs are simple column refs).
+    """
+    left_keys: List[rx.Rex] = []
+    right_keys: List[rx.Rex] = []
+    residuals: List[rx.Rex] = []
+
+    def extract(p: pn.PlanNode, col_map) -> pn.PlanNode:
+        """col_map: maps a BoundRef index at this level → output index of
+        the subquery root (or None if not exposed)."""
+        if isinstance(p, pn.FilterExec):
+            conjuncts = _split_rex_conjuncts(p.condition)
+            keep = []
+            for c in conjuncts:
+                if not _rex_has_outer(c):
+                    keep.append(c)
+                    continue
+                pair = _outer_eq_pair(c)
+                if pair is not None:
+                    outer_r, inner_r = pair
+                    mapped = _map_rex(inner_r, col_map)
+                    if mapped is not None:
+                        left_keys.append(outer_r)
+                        right_keys.append(mapped)
+                        continue
+                mapped_res = _map_outer_residual(c, col_map)
+                if mapped_res is None:
+                    raise ResolutionError(
+                        "unsupported correlated predicate (column not exposed "
+                        "by subquery output)")
+                residuals.append(mapped_res)
+            child = extract(p.input, col_map)
+            if not keep:
+                return child
+            return pn.FilterExec(child, _and_rex(keep))
+        if isinstance(p, pn.ProjectExec):
+            # build child col_map: child index → root output index
+            child_map = {}
+            for out_i, (_, e) in enumerate(p.exprs):
+                if isinstance(e, rx.BoundRef) and col_map.get(out_i) is not None:
+                    child_map[e.index] = col_map[out_i]
+            new_child = extract(p.input, child_map)
+            return dataclasses.replace(p, input=new_child)
+        if isinstance(p, pn.JoinExec):
+            return p  # do not descend into joins in v0
+        if isinstance(p, (pn.ScanExec, pn.OneRowExec, pn.ValuesExec, pn.RangeExec)):
+            return p
+        if isinstance(p, pn.LimitExec) or isinstance(p, pn.SortExec):
+            new_child = extract(p.input, col_map)
+            return dataclasses.replace(p, input=new_child)
+        return p
+
+    root_map = {i: i for i in range(len(node.schema))}
+    # For a root Filter (select * shape), every input column is exposed 1:1.
+    new_node = extract(node, root_map)
+    return new_node, left_keys, right_keys, residuals
+
+
+def _split_rex_conjuncts(r: rx.Rex) -> List[rx.Rex]:
+    if isinstance(r, rx.RCall) and r.fn == "and":
+        return _split_rex_conjuncts(r.args[0]) + _split_rex_conjuncts(r.args[1])
+    return [r]
+
+
+def _outer_eq_pair(r: rx.Rex):
+    if isinstance(r, rx.RCall) and r.fn == "==" and len(r.args) == 2:
+        a, b = r.args
+        a_outer, b_outer = _rex_has_outer(a), _rex_has_outer(b)
+        if a_outer and not b_outer:
+            return _outer_to_bound(a), b
+        if b_outer and not a_outer:
+            return _outer_to_bound(b), a
+    return None
+
+
+def _outer_to_bound(r: rx.Rex) -> rx.Rex:
+    if isinstance(r, ROuterRef):
+        return rx.BoundRef(r.index, r.name, r.dtype, r.nullable)
+    if isinstance(r, rx.RCall):
+        return dataclasses.replace(r, args=tuple(_outer_to_bound(a) for a in r.args))
+    if isinstance(r, rx.RCast):
+        return dataclasses.replace(r, child=_outer_to_bound(r.child))
+    return r
+
+
+def _map_rex(r: rx.Rex, col_map) -> Optional[rx.Rex]:
+    """Rebind a Rex from a nested level to the subquery's output columns."""
+    if isinstance(r, rx.BoundRef):
+        m = col_map.get(r.index)
+        if m is None:
+            return None
+        return dataclasses.replace(r, index=m)
+    if isinstance(r, rx.RCall):
+        new_args = []
+        for a in r.args:
+            m = _map_rex(a, col_map)
+            if m is None:
+                return None
+            new_args.append(m)
+        return dataclasses.replace(r, args=tuple(new_args))
+    if isinstance(r, rx.RCast):
+        m = _map_rex(r.child, col_map)
+        return None if m is None else dataclasses.replace(r, child=m)
+    if isinstance(r, rx.RLit):
+        return r
+    return None
+
+
+def _map_outer_residual(r: rx.Rex, col_map) -> Optional[rx.Rex]:
+    """Map a mixed outer/inner predicate to the combined join schema.
+
+    Outer refs stay as ROuterRef markers; the join planner rebases them: the
+    executor evaluates residuals over (probe ++ build) columns, with outer
+    refs → probe side, inner refs → build side offset by len(left schema).
+    We keep inner BoundRefs unmapped here and mark them via options at the
+    JoinExec level; v0 encodes: ROuterRef(i) → probe col i, BoundRef(j) →
+    build output col (must be exposed via col_map).
+    """
+    if isinstance(r, ROuterRef):
+        return r
+    if isinstance(r, rx.BoundRef):
+        m = col_map.get(r.index)
+        if m is None:
+            return None
+        return dataclasses.replace(r, index=m)
+    if isinstance(r, rx.RLit):
+        return r
+    if isinstance(r, rx.RCall):
+        new_args = []
+        for a in r.args:
+            m = _map_outer_residual(a, col_map)
+            if m is None:
+                return None
+            new_args.append(m)
+        return dataclasses.replace(r, args=tuple(new_args))
+    if isinstance(r, rx.RCast):
+        m = _map_outer_residual(r.child, col_map)
+        return None if m is None else dataclasses.replace(r, child=m)
+    return None
+
+
+def _combine_residual(residuals: List[rx.Rex], n_left: int) -> Optional[rx.Rex]:
+    """Residuals from decorrelation reference ROuterRef (outer/probe side)
+    and BoundRef (subquery output). Rebase onto the combined left++right
+    schema: outer i → i; inner j → n_left + j."""
+    if not residuals:
+        return None
+
+    def rebase(r: rx.Rex) -> rx.Rex:
+        if isinstance(r, ROuterRef):
+            return rx.BoundRef(r.index, r.name, r.dtype, r.nullable)
+        if isinstance(r, rx.BoundRef):
+            return dataclasses.replace(r, index=r.index + n_left)
+        if isinstance(r, rx.RCall):
+            return dataclasses.replace(r, args=tuple(rebase(a) for a in r.args))
+        if isinstance(r, rx.RCast):
+            return dataclasses.replace(r, child=rebase(r.child))
+        return r
+
+    return _and_rex([rebase(r) for r in residuals])
+
+
+def _group_scalar_subplan(node: pn.PlanNode, right_keys: List[rx.Rex]):
+    """Convert a decorrelated global-aggregate subplan into a grouped one.
+
+    ``node`` is the resolved subquery (after filter extraction): expected
+    shape ProjectExec(AggregateExec(ProjectExec(child))) produced by the
+    implicit-aggregate path, with exactly one output column. ``right_keys``
+    are bound to the PRE-decorrelation subquery *source* columns, i.e. the
+    aggregate's input child. Returns (grouped_plan, value_index, key_indices)
+    where grouped_plan outputs [keys..., value].
+    """
+    if not (isinstance(node, pn.ProjectExec)
+            and isinstance(node.input, pn.AggregateExec)):
+        raise ResolutionError("correlated scalar subquery must be a single "
+                              "aggregate query")
+    post = node
+    agg: pn.AggregateExec = node.input
+    if agg.group_indices:
+        raise ResolutionError("correlated scalar subquery already grouped")
+    pre = agg.input
+    assert isinstance(pre, pn.ProjectExec)
+    # append key columns to the pre-projection
+    key_names = [_fresh("k") for _ in right_keys]
+    new_pre = pn.ProjectExec(pre.input, tuple(
+        [(n, e) for n, e in pre.exprs]
+        + list(zip(key_names, right_keys))))
+    n_args = len(pre.exprs)
+    new_agg = pn.AggregateExec(
+        new_pre,
+        tuple(range(n_args, n_args + len(right_keys))),
+        tuple(dataclasses.replace(a, arg=None if a.arg is None else a.arg)
+              for a in agg.aggs),
+        tuple(key_names) + tuple(agg.out_names))
+    # post-projection: keys first, then the original output expression with
+    # refs shifted (agg outputs moved right by len(keys))
+    nk = len(right_keys)
+
+    def shift(r: rx.Rex) -> rx.Rex:
+        if isinstance(r, rx.BoundRef):
+            return dataclasses.replace(r, index=r.index + nk)
+        if isinstance(r, rx.RCall):
+            return dataclasses.replace(r, args=tuple(shift(a) for a in r.args))
+        if isinstance(r, rx.RCast):
+            return dataclasses.replace(r, child=shift(r.child))
+        if isinstance(r, rx.RCase):
+            return dataclasses.replace(
+                r, branches=tuple((shift(c), shift(v)) for c, v in r.branches),
+                else_value=None if r.else_value is None else shift(r.else_value))
+        return r
+
+    exprs = [(kn, rx.BoundRef(i, kn, new_agg.schema[i].dtype, True))
+             for i, kn in enumerate(key_names)]
+    name, val = post.exprs[0]
+    exprs.append((name, shift(val)))
+    out = pn.ProjectExec(new_agg, tuple(exprs))
+    return out, nk, list(range(nk))
+
+
+def _coerce_to(node: pn.PlanNode, target: Sequence[pn.Field]) -> pn.PlanNode:
+    needs = False
+    exprs = []
+    for i, (f, t) in enumerate(zip(node.schema, target)):
+        r: rx.Rex = rx.BoundRef(i, f.name, f.dtype, f.nullable)
+        if f.dtype != t.dtype and not isinstance(t.dtype, dt.NullType) \
+                and not isinstance(f.dtype, dt.NullType):
+            common = dt.common_type(f.dtype, t.dtype)
+            if f.dtype != common:
+                r = rx.RCast(r, common)
+                needs = True
+        exprs.append((f.name, r))
+    if not needs:
+        return node
+    return pn.ProjectExec(node, tuple(exprs))

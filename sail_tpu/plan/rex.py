@@ -1,0 +1,121 @@
+"""Resolved (typed, bound) expressions — the output of name resolution.
+
+Reference role: DataFusion's PhysicalExpr tree as used by sail-plan's
+resolver (crates/sail-plan/src/resolver/expression/). Every node carries its
+output type and nullability; column references are bound by position into
+the child operator's schema.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from ..spec import data_type as dt
+from ..spec.literal import Literal as LV
+
+
+@dataclass(frozen=True)
+class Rex:
+    """Base resolved expression."""
+
+
+@dataclass(frozen=True)
+class BoundRef(Rex):
+    index: int
+    name: str          # column name in the physical batch
+    dtype: dt.DataType = field(default_factory=dt.NullType)
+    nullable: bool = True
+
+
+@dataclass(frozen=True)
+class RLit(Rex):
+    value: LV
+
+    @property
+    def dtype(self):
+        return self.value.data_type
+
+    @property
+    def nullable(self):
+        return self.value.is_null
+
+
+@dataclass(frozen=True)
+class RCall(Rex):
+    fn: str                       # kernel registry key
+    args: Tuple[Rex, ...]
+    dtype: dt.DataType = field(default_factory=dt.NullType)
+    nullable: bool = True
+    options: Tuple[Tuple[str, object], ...] = ()  # kernel-specific statics
+
+
+@dataclass(frozen=True)
+class RCast(Rex):
+    child: Rex
+    dtype: dt.DataType = field(default_factory=dt.NullType)
+    try_: bool = False
+    nullable: bool = True
+
+
+@dataclass(frozen=True)
+class RCase(Rex):
+    branches: Tuple[Tuple[Rex, Rex], ...]
+    else_value: Optional[Rex]
+    dtype: dt.DataType = field(default_factory=dt.NullType)
+    nullable: bool = True
+
+
+@dataclass(frozen=True)
+class RScalarSubquery(Rex):
+    """Uncorrelated scalar subquery; the executor runs ``plan`` (a physical
+    plan) once and substitutes the single value."""
+
+    plan: object
+    dtype: dt.DataType = field(default_factory=dt.NullType)
+    nullable: bool = True
+
+
+def rex_type(r: Rex) -> dt.DataType:
+    return r.dtype  # type: ignore[attr-defined]
+
+
+def rex_nullable(r: Rex) -> bool:
+    return getattr(r, "nullable", True)
+
+
+def walk(r: Rex):
+    yield r
+    if isinstance(r, RCall):
+        for a in r.args:
+            yield from walk(a)
+    elif isinstance(r, RCast):
+        yield from walk(r.child)
+    elif isinstance(r, RCase):
+        for c, v in r.branches:
+            yield from walk(c)
+            yield from walk(v)
+        if r.else_value is not None:
+            yield from walk(r.else_value)
+
+
+def references(r: Rex) -> Tuple[int, ...]:
+    return tuple(sorted({n.index for n in walk(r) if isinstance(n, BoundRef)}))
+
+
+def shift_refs(r: Rex, delta: int) -> Rex:
+    """Rebase BoundRef indices (used when splicing schemas, e.g. joins)."""
+    import dataclasses
+    if isinstance(r, BoundRef):
+        return dataclasses.replace(r, index=r.index + delta)
+    if isinstance(r, RCall):
+        return dataclasses.replace(r, args=tuple(shift_refs(a, delta) for a in r.args))
+    if isinstance(r, RCast):
+        return dataclasses.replace(r, child=shift_refs(r.child, delta))
+    if isinstance(r, RCase):
+        return dataclasses.replace(
+            r,
+            branches=tuple((shift_refs(c, delta), shift_refs(v, delta))
+                           for c, v in r.branches),
+            else_value=None if r.else_value is None else shift_refs(r.else_value, delta))
+    return r

@@ -1,14 +1,905 @@
-"""Session entry point (placeholder; filled in by the planner/executor layer).
+"""SparkSession-compatible entry point and DataFrame API.
 
-Mirrors the role of the reference's SessionManager + SparkSession surface
-(crates/sail-session, crates/sail-spark-connect/src/session.rs).
+Reference role: sail-session (SessionManager/session factory) plus the
+PySpark-facing DataFrame surface that Spark Connect clients drive
+(SURVEY.md §2.2). In-process v0: sql()/read/createDataFrame build spec
+plans; actions resolve → optimize → execute on the local executor. The
+protocol servers (Spark Connect gRPC, Flight SQL) layer on top of this
+same session object.
 """
 
 from __future__ import annotations
 
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import pyarrow as pa
+
+from .catalog import CatalogManager, TableEntry
+from .spec import data_type as dt
+from .spec import expression as ex
+from .spec import plan as sp
+from .spec.literal import Literal as LV
+
 
 class SparkSession:
-    """Will be replaced by the full session implementation."""
+    _active: Optional["SparkSession"] = None
+    _lock = threading.Lock()
 
-    def __init__(self):
-        raise NotImplementedError("session layer lands with the planner")
+    class Builder:
+        def __init__(self):
+            self._conf: Dict[str, str] = {}
+
+        def appName(self, name: str) -> "SparkSession.Builder":
+            self._conf["spark.app.name"] = name
+            return self
+
+        def master(self, _: str) -> "SparkSession.Builder":
+            return self
+
+        def config(self, key: str, value=None) -> "SparkSession.Builder":
+            self._conf[key] = str(value)
+            return self
+
+        def getOrCreate(self) -> "SparkSession":
+            with SparkSession._lock:
+                if SparkSession._active is None:
+                    SparkSession._active = SparkSession(self._conf)
+                return SparkSession._active
+
+    builder = None  # replaced below by a property-like descriptor
+
+    def __init__(self, conf: Optional[Dict[str, str]] = None):
+        self.conf = SessionConf(conf or {})
+        self.catalog_manager = CatalogManager()
+        from .exec.local import LocalExecutor
+        self._executor_cls = LocalExecutor
+        self.catalog = Catalog(self)
+
+    # -- plan execution ----------------------------------------------------
+    def _resolve(self, plan: sp.QueryPlan):
+        from .plan.optimizer import optimize
+        from .plan.resolver import Resolver
+        node = Resolver(self.catalog_manager).resolve(plan)
+        return optimize(node)
+
+    def _execute_query(self, plan: sp.QueryPlan) -> pa.Table:
+        node = self._resolve(plan)
+        return self._executor_cls(dict(self.conf.items())).execute(node)
+
+    # -- entry points -------------------------------------------------------
+    def sql(self, query: str) -> "DataFrame":
+        from .sql import parse_one
+        plan = parse_one(query)
+        if isinstance(plan, sp.CommandPlan):
+            table = self._execute_command(plan)
+            return DataFrame(sp.LocalRelation(table), self)
+        return DataFrame(plan, self)
+
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+    def createDataFrame(self, data, schema=None) -> "DataFrame":
+        if isinstance(data, pa.Table):
+            table = data
+        elif type(data).__name__ == "DataFrame" and hasattr(data, "to_records"):
+            import pandas as pd
+            assert isinstance(data, pd.DataFrame)
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        else:
+            columns = list(schema) if isinstance(schema, (list, tuple)) else None
+            rows = [tuple(r.values()) if isinstance(r, dict) else tuple(r)
+                    for r in data]
+            if columns is None:
+                columns = [f"_{i + 1}" for i in range(len(rows[0]))] if rows else []
+            arrays = [pa.array([r[i] for r in rows]) for i in range(len(columns))]
+            table = pa.Table.from_arrays(arrays, names=columns)
+        if isinstance(schema, dt.StructType):
+            from .columnar.arrow_interop import spec_type_to_arrow
+            target = pa.schema([(f.name, spec_type_to_arrow(f.data_type))
+                                for f in schema.fields])
+            table = table.rename_columns([f.name for f in schema.fields]).cast(target)
+        return DataFrame(sp.LocalRelation(table), self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              numPartitions: Optional[int] = None) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return DataFrame(sp.Range(start, end, step, numPartitions), self)
+
+    def table(self, name: str) -> "DataFrame":
+        return DataFrame(sp.ReadNamedTable(tuple(name.split("."))), self)
+
+    def stop(self):
+        with SparkSession._lock:
+            if SparkSession._active is self:
+                SparkSession._active = None
+
+    @property
+    def version(self) -> str:
+        return "4.0.0-sail-tpu"
+
+    # -- commands ------------------------------------------------------------
+    def _execute_command(self, cmd: sp.CommandPlan) -> pa.Table:
+        cm = self.catalog_manager
+        empty = pa.table({})
+        if isinstance(cmd, sp.CreateView):
+            cm.register_temp_view(cmd.name[-1], cmd.query, replace=cmd.replace)
+            return empty
+        if isinstance(cmd, sp.CreateTable):
+            if cmd.query is not None:  # CTAS
+                table = self._execute_query(cmd.query)
+                if cmd.location:
+                    from .io.formats import write_table
+                    write_table(table, cmd.format or "parquet", cmd.location,
+                                mode="overwrite" if cmd.replace else "error",
+                                partition_by=cmd.partition_by)
+                    entry = self._file_table_entry(cmd)
+                else:
+                    entry = TableEntry(cmd.name, _schema_of(table), table,
+                                       (), "memory")
+                cm.register_table(entry, cmd.replace, cmd.if_not_exists)
+                return empty
+            if cmd.location:
+                entry = self._file_table_entry(cmd)
+            else:
+                schema = cmd.schema or dt.StructType(())
+                empty_tbl = _empty_table(schema)
+                entry = TableEntry(cmd.name, schema, empty_tbl, (), "memory")
+            cm.register_table(entry, cmd.replace, cmd.if_not_exists)
+            return empty
+        if isinstance(cmd, sp.DropTable):
+            cm.drop_table(cmd.name, cmd.if_exists, cmd.is_view)
+            return empty
+        if isinstance(cmd, sp.CreateDatabase):
+            cm.create_database(cmd.name[-1], cmd.if_not_exists, cmd.comment,
+                               cmd.location)
+            return empty
+        if isinstance(cmd, sp.DropDatabase):
+            cm.drop_database(cmd.name[-1], cmd.if_exists, cmd.cascade)
+            return empty
+        if isinstance(cmd, sp.UseDatabase):
+            if cmd.name[-1].lower() not in cm.databases:
+                raise ValueError(f"database {cmd.name[-1]!r} not found")
+            cm.current_database = cmd.name[-1].lower()
+            return empty
+        if isinstance(cmd, sp.InsertInto):
+            return self._insert_into(cmd)
+        if isinstance(cmd, sp.ShowTables):
+            entries = cm.list_tables(cmd.database[-1] if cmd.database else None)
+            names = [e.name[-1] for e in entries]
+            return pa.table({
+                "namespace": pa.array([cm.current_database] * len(names)),
+                "tableName": pa.array(names),
+                "isTemporary": pa.array([e.view_plan is not None for e in entries]),
+            })
+        if isinstance(cmd, sp.ShowDatabases):
+            return pa.table({"namespace": pa.array(cm.list_databases())})
+        if isinstance(cmd, sp.ShowColumns):
+            entry = cm.lookup_table(cmd.table)
+            if entry is None:
+                raise ValueError(f"table not found: {'.'.join(cmd.table)}")
+            if entry.view_plan is not None:
+                node = self._resolve(entry.view_plan)
+                cols = [f.name for f in node.schema]
+            else:
+                cols = [f.name for f in entry.schema.fields]
+            return pa.table({"col_name": pa.array(cols)})
+        if isinstance(cmd, sp.DescribeTable):
+            entry = cm.lookup_table(cmd.table)
+            if entry is None:
+                raise ValueError(f"table not found: {'.'.join(cmd.table)}")
+            if entry.view_plan is not None:
+                node = self._resolve(entry.view_plan)
+                pairs = [(f.name, f.dtype.simple_string()) for f in node.schema]
+            else:
+                pairs = [(f.name, f.data_type.simple_string())
+                         for f in entry.schema.fields]
+            return pa.table({
+                "col_name": pa.array([p[0] for p in pairs]),
+                "data_type": pa.array([p[1] for p in pairs]),
+                "comment": pa.array([None] * len(pairs), type=pa.string()),
+            })
+        if isinstance(cmd, sp.ShowFunctions):
+            from .functions.registry import AGGREGATE_FUNCTIONS
+            from .plan.compiler import _NUMERIC_BUILDERS, _STRING_TRANSFORMS
+            names = sorted(set(_NUMERIC_BUILDERS) | set(_STRING_TRANSFORMS)
+                           | AGGREGATE_FUNCTIONS)
+            return pa.table({"function": pa.array(names)})
+        if isinstance(cmd, sp.SetVariable):
+            if cmd.name and cmd.value is not None:
+                self.conf.set(cmd.name, cmd.value)
+                return pa.table({"key": pa.array([cmd.name]),
+                                 "value": pa.array([cmd.value])})
+            if cmd.name:
+                v = self.conf.get(cmd.name)
+                return pa.table({"key": pa.array([cmd.name]),
+                                 "value": pa.array([v])})
+            items = sorted(self.conf.items())
+            return pa.table({"key": pa.array([k for k, _ in items]),
+                             "value": pa.array([v for _, v in items])})
+        if isinstance(cmd, sp.ResetVariable):
+            self.conf.reset(cmd.name)
+            return empty
+        if isinstance(cmd, sp.Explain):
+            from .plan.nodes import explain
+            node = self._resolve(cmd.query)
+            return pa.table({"plan": pa.array([explain(node)])})
+        if isinstance(cmd, sp.CacheTable):
+            if cmd.query is not None:
+                cm.register_temp_view(cmd.name[-1], cmd.query)
+            return empty
+        if isinstance(cmd, sp.UncacheTable):
+            return empty
+        raise NotImplementedError(f"command {type(cmd).__name__} not supported yet")
+
+    def _file_table_entry(self, cmd: sp.CreateTable) -> TableEntry:
+        from .io.formats import infer_schema
+        fmt = cmd.format or "parquet"
+        schema = cmd.schema or infer_schema(fmt, (cmd.location,), dict(cmd.options))
+        return TableEntry(cmd.name, schema, None, (cmd.location,), fmt,
+                          None, cmd.options, cmd.partition_by)
+
+    def _insert_into(self, cmd: sp.InsertInto) -> pa.Table:
+        cm = self.catalog_manager
+        entry = cm.lookup_table(cmd.table)
+        if entry is None:
+            raise ValueError(f"table not found: {'.'.join(cmd.table)}")
+        new_data = self._execute_query(cmd.query)
+        if entry.format == "memory":
+            existing = entry.data
+            if cmd.overwrite or existing is None or existing.num_rows == 0:
+                merged = new_data
+            else:
+                new_data = new_data.rename_columns(existing.column_names)
+                merged = pa.concat_tables([existing, new_data],
+                                          promote_options="permissive")
+            entry.data = merged
+            entry.schema = _schema_of(merged)
+        else:
+            from .io.formats import write_table
+            write_table(new_data, entry.format, entry.paths[0],
+                        mode="overwrite" if cmd.overwrite else "append",
+                        partition_by=entry.partition_by)
+        return pa.table({})
+
+
+class _BuilderDescriptor:
+    def __get__(self, obj, objtype=None):
+        return SparkSession.Builder()
+
+
+SparkSession.builder = _BuilderDescriptor()
+
+
+class SessionConf:
+    _DEFAULTS = {
+        "spark.sql.session.timeZone": "UTC",
+        "spark.sql.shuffle.partitions": "8",
+        "sail.execution.batch_capacity": "16777216",
+    }
+
+    def __init__(self, conf: Dict[str, str]):
+        self._conf = dict(conf)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._conf.get(key, self._DEFAULTS.get(key, default))
+
+    def set(self, key: str, value: str):
+        self._conf[key] = str(value)
+
+    def reset(self, key: Optional[str] = None):
+        if key is None:
+            self._conf.clear()
+        else:
+            self._conf.pop(key, None)
+
+    def items(self):
+        merged = dict(self._DEFAULTS)
+        merged.update(self._conf)
+        return merged.items()
+
+
+class Catalog:
+    """spark.catalog surface (subset)."""
+
+    def __init__(self, session: SparkSession):
+        self._session = session
+
+    def listTables(self, dbName: Optional[str] = None):
+        return self._session.catalog_manager.list_tables(dbName)
+
+    def listDatabases(self):
+        return self._session.catalog_manager.list_databases()
+
+    def currentDatabase(self) -> str:
+        return self._session.catalog_manager.current_database
+
+    def setCurrentDatabase(self, name: str):
+        self._session.catalog_manager.current_database = name.lower()
+
+    def tableExists(self, name: str) -> bool:
+        return self._session.catalog_manager.lookup_table(tuple(name.split("."))) is not None
+
+    def dropTempView(self, name: str) -> bool:
+        cm = self._session.catalog_manager
+        if name.lower() in cm.temp_views:
+            del cm.temp_views[name.lower()]
+            return True
+        return False
+
+
+class Column:
+    """Expression wrapper for the DataFrame API."""
+
+    def __init__(self, expr: ex.Expr):
+        self._expr = expr
+
+    # arithmetic / comparison operators
+    def _bin(self, other, op) -> "Column":
+        return Column(ex.Function(op, (self._expr, _to_expr(other))))
+
+    def __add__(self, o):
+        return self._bin(o, "+")
+
+    def __sub__(self, o):
+        return self._bin(o, "-")
+
+    def __mul__(self, o):
+        return self._bin(o, "*")
+
+    def __truediv__(self, o):
+        return self._bin(o, "/")
+
+    def __mod__(self, o):
+        return self._bin(o, "%")
+
+    def __radd__(self, o):
+        return Column(ex.Function("+", (_to_expr(o), self._expr)))
+
+    def __rsub__(self, o):
+        return Column(ex.Function("-", (_to_expr(o), self._expr)))
+
+    def __rmul__(self, o):
+        return Column(ex.Function("*", (_to_expr(o), self._expr)))
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin(o, "==")
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._bin(o, "!=")
+
+    def __lt__(self, o):
+        return self._bin(o, "<")
+
+    def __le__(self, o):
+        return self._bin(o, "<=")
+
+    def __gt__(self, o):
+        return self._bin(o, ">")
+
+    def __ge__(self, o):
+        return self._bin(o, ">=")
+
+    def __and__(self, o):
+        return self._bin(o, "and")
+
+    def __or__(self, o):
+        return self._bin(o, "or")
+
+    def __invert__(self):
+        return Column(ex.Function("not", (self._expr,)))
+
+    def __neg__(self):
+        return Column(ex.Function("negative", (self._expr,)))
+
+    def alias(self, name: str) -> "Column":
+        return Column(ex.Alias(self._expr, (name,)))
+
+    name = alias
+
+    def cast(self, to) -> "Column":
+        target = to if isinstance(to, dt.DataType) else _parse_type(to)
+        return Column(ex.Cast(self._expr, target))
+
+    def asc(self) -> "Column":
+        return Column(ex.SortOrder(self._expr, True))
+
+    def desc(self) -> "Column":
+        return Column(ex.SortOrder(self._expr, False))
+
+    def isNull(self) -> "Column":
+        return Column(ex.Function("isnull", (self._expr,)))
+
+    def isNotNull(self) -> "Column":
+        return Column(ex.Function("isnotnull", (self._expr,)))
+
+    def isin(self, *values) -> "Column":
+        vals = values[0] if len(values) == 1 and isinstance(values[0], (list, tuple)) \
+            else values
+        return Column(ex.InList(self._expr, tuple(_to_expr(v) for v in vals)))
+
+    def between(self, low, high) -> "Column":
+        return Column(ex.Between(self._expr, _to_expr(low), _to_expr(high)))
+
+    def like(self, pattern: str) -> "Column":
+        return Column(ex.Like(self._expr, ex.lit(pattern)))
+
+    def startswith(self, s) -> "Column":
+        return Column(ex.Function("startswith", (self._expr, _to_expr(s))))
+
+    def endswith(self, s) -> "Column":
+        return Column(ex.Function("endswith", (self._expr, _to_expr(s))))
+
+    def contains(self, s) -> "Column":
+        return Column(ex.Function("contains", (self._expr, _to_expr(s))))
+
+    def substr(self, start, length) -> "Column":
+        return Column(ex.Function("substring",
+                                  (self._expr, _to_expr(start), _to_expr(length))))
+
+    def __hash__(self):
+        return hash(self._expr)
+
+
+def _to_expr(v) -> ex.Expr:
+    if isinstance(v, Column):
+        return v._expr
+    if isinstance(v, ex.Expr):
+        return v
+    return ex.lit(v)
+
+
+def _parse_type(s: str) -> dt.DataType:
+    from .sql import parse_data_type
+    return parse_data_type(s)
+
+
+def _parse_ddl_schema(ddl: str) -> dt.StructType:
+    """Parse 'a INT, b DECIMAL(10,2), c STRUCT<x: INT>' (comma split at
+    depth 0 only, honoring () and <> nesting)."""
+    from .sql import parse_data_type
+    parts = []
+    depth = 0
+    start = 0
+    for i, ch in enumerate(ddl):
+        if ch in "(<":
+            depth += 1
+        elif ch in ")>":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(ddl[start:i])
+            start = i + 1
+    parts.append(ddl[start:])
+    fields = []
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        name, _, typ = part.partition(" ")
+        if not typ and ":" in part:
+            name, _, typ = part.partition(":")
+        fields.append(dt.StructField(name.strip(), _parse_type(typ.strip())))
+    return dt.StructType(tuple(fields))
+
+
+def col(name: str) -> Column:
+    return Column(ex.Attribute(tuple(name.split("."))) if name != "*" else ex.Star())
+
+
+def lit(v) -> Column:
+    return Column(ex.lit(v))
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", group_cols: Sequence[Column]):
+        self._df = df
+        self._group = tuple(_to_expr(c) for c in group_cols)
+
+    def agg(self, *exprs) -> "DataFrame":
+        items = tuple(self._group) + tuple(_to_expr(e) for e in exprs)
+        plan = sp.Aggregate(self._df._plan, self._group, items)
+        return DataFrame(plan, self._df._session)
+
+    def _simple(self, fn: str, *cols) -> "DataFrame":
+        targets = list(cols)
+        if not targets:
+            # PySpark default: aggregate every numeric non-group column
+            group_names = {a.name[-1].lower() for a in self._group
+                           if isinstance(a, ex.Attribute)}
+            targets = [f.name for f in self._df.schema.fields
+                       if f.data_type.is_numeric
+                       and f.name.lower() not in group_names]
+        aggs = [Column(ex.Alias(ex.Function(fn, (ex.Attribute((c,)),)),
+                                (f"{fn}({c})",))) for c in targets]
+        return self.agg(*aggs)
+
+    def count(self) -> "DataFrame":
+        return self.agg(Column(ex.Alias(ex.Function("count", (ex.Star(),)), ("count",))))
+
+    def sum(self, *cols) -> "DataFrame":
+        return self._simple("sum", *cols)
+
+    def avg(self, *cols) -> "DataFrame":
+        return self._simple("avg", *cols)
+
+    def min(self, *cols) -> "DataFrame":
+        return self._simple("min", *cols)
+
+    def max(self, *cols) -> "DataFrame":
+        return self._simple("max", *cols)
+
+
+class DataFrame:
+    def __init__(self, plan: sp.QueryPlan, session: SparkSession):
+        self._plan = plan
+        self._session = session
+
+    # -- transformations -------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        exprs = tuple(_to_expr(c) if not isinstance(c, str)
+                      else (ex.Star() if c == "*" else ex.Attribute(tuple(c.split("."))))
+                      for c in cols)
+        return DataFrame(sp.Project(self._plan, exprs), self._session)
+
+    def selectExpr(self, *exprs: str) -> "DataFrame":
+        from .sql.parser import Parser
+        items = []
+        for s in exprs:
+            p = Parser(s)
+            items.append(p.parse_select_item())
+        return DataFrame(sp.Project(self._plan, tuple(items)), self._session)
+
+    def filter(self, condition) -> "DataFrame":
+        if isinstance(condition, str):
+            from .sql import parse_expression
+            cond = parse_expression(condition)
+        else:
+            cond = _to_expr(condition)
+        return DataFrame(sp.Filter(self._plan, cond), self._session)
+
+    where = filter
+
+    def withColumn(self, name: str, c: Column) -> "DataFrame":
+        alias = ex.Alias(_to_expr(c), (name,))
+        return DataFrame(sp.WithColumns(self._plan, (alias,)), self._session)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        return DataFrame(sp.WithColumnsRenamed(self._plan, ((old, new),)),
+                         self._session)
+
+    def drop(self, *cols: str) -> "DataFrame":
+        return DataFrame(sp.Drop(self._plan, tuple(cols)), self._session)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner") -> "DataFrame":
+        how = {"outer": "full", "leftouter": "left", "rightouter": "right",
+               "left_outer": "left", "right_outer": "right", "fullouter": "full",
+               "leftsemi": "semi", "left_semi": "semi", "leftanti": "anti",
+               "left_anti": "anti"}.get(how.lower(), how.lower())
+        using: Tuple[str, ...] = ()
+        condition = None
+        if isinstance(on, str):
+            using = (on,)
+        elif isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            using = tuple(on)
+        elif on is not None:
+            condition = _to_expr(on)
+        return DataFrame(sp.Join(self._plan, other._plan, how, condition, using),
+                         self._session)
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(sp.Join(self._plan, other._plan, "cross"), self._session)
+
+    def groupBy(self, *cols) -> GroupedData:
+        gcols = [col(c) if isinstance(c, str) else c for c in cols]
+        return GroupedData(self, gcols)
+
+    groupby = groupBy
+
+    def agg(self, *exprs) -> "DataFrame":
+        return GroupedData(self, []).agg(*exprs)
+
+    def orderBy(self, *cols) -> "DataFrame":
+        keys = []
+        for c in cols:
+            e = _to_expr(col(c) if isinstance(c, str) else c)
+            if not isinstance(e, ex.SortOrder):
+                e = ex.SortOrder(e, True)
+            keys.append(e)
+        return DataFrame(sp.Sort(self._plan, tuple(keys)), self._session)
+
+    sort = orderBy
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(sp.Limit(self._plan, n), self._session)
+
+    def offset(self, n: int) -> "DataFrame":
+        return DataFrame(sp.Offset(self._plan, n), self._session)
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(sp.Deduplicate(self._plan), self._session)
+
+    def dropDuplicates(self, subset: Optional[Sequence[str]] = None) -> "DataFrame":
+        return DataFrame(sp.Deduplicate(self._plan, tuple(subset or ())),
+                         self._session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(sp.SetOperation(self._plan, other._plan, "union", True),
+                         self._session)
+
+    unionAll = union
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(sp.SetOperation(self._plan, other._plan, "intersect", False),
+                         self._session)
+
+    def exceptAll(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(sp.SetOperation(self._plan, other._plan, "except", True),
+                         self._session)
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(sp.SetOperation(self._plan, other._plan, "except", False),
+                         self._session)
+
+    def alias(self, name: str) -> "DataFrame":
+        return DataFrame(sp.SubqueryAlias(self._plan, name), self._session)
+
+    def repartition(self, n: int, *cols) -> "DataFrame":
+        exprs = tuple(_to_expr(col(c) if isinstance(c, str) else c) for c in cols)
+        return DataFrame(sp.Repartition(self._plan, n, exprs), self._session)
+
+    def sample(self, withReplacement=None, fraction=None, seed=None) -> "DataFrame":
+        # PySpark signature juggling: sample(fraction), sample(fraction, seed),
+        # sample(withReplacement, fraction[, seed])
+        if isinstance(withReplacement, float):
+            withReplacement, fraction, seed = False, withReplacement, fraction
+        if fraction is None:
+            raise ValueError("sample() requires a fraction")
+        return DataFrame(sp.Sample(self._plan, 0.0, float(fraction),
+                                   bool(withReplacement), seed), self._session)
+
+    def __getitem__(self, name: str) -> Column:
+        return col(name)
+
+    def __getattr__(self, name: str) -> Column:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return col(name)
+
+    # -- actions ------------------------------------------------------------
+    def toArrow(self) -> pa.Table:
+        return self._session._execute_query(self._plan)
+
+    def toPandas(self):
+        return self.toArrow().to_pandas()
+
+    def collect(self) -> List[tuple]:
+        table = self.toArrow()
+        cols = [c.to_pylist() for c in table.columns]
+        return [Row(zip(table.column_names, vals)) for vals in zip(*cols)] \
+            if cols else []
+
+    def count(self) -> int:
+        plan = sp.Aggregate(self._plan, (),
+                            (ex.Alias(ex.Function("count", (ex.Star(),)), ("count",)),))
+        table = self._session._execute_query(plan)
+        return int(table.column(0)[0].as_py())
+
+    def first(self):
+        rows = self.limit(1).collect()
+        return rows[0] if rows else None
+
+    def head(self, n: int = 1):
+        rows = self.limit(n).collect()
+        return rows[0] if n == 1 and rows else rows
+
+    def take(self, n: int):
+        return self.limit(n).collect()
+
+    def show(self, n: int = 20, truncate: bool = True):
+        print(self._show_string(n, truncate))
+
+    def _show_string(self, n: int = 20, truncate: bool = True) -> str:
+        table = self.limit(n).toArrow()
+        names = table.column_names
+        rows = [[_fmt_cell(v, truncate) for v in col.to_pylist()]
+                for col in table.columns]
+        widths = [max([len(nm)] + [len(r) for r in rs]) for nm, rs in zip(names, rows)]
+        sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        out = [sep, "|" + "|".join(f" {nm:<{w}} " for nm, w in zip(names, widths)) + "|", sep]
+        for i in range(table.num_rows):
+            out.append("|" + "|".join(
+                f" {rows[j][i]:<{widths[j]}} " for j in range(len(names))) + "|")
+        out.append(sep)
+        return "\n".join(out)
+
+    @property
+    def schema(self) -> dt.StructType:
+        node = self._session._resolve(self._plan)
+        return dt.StructType(tuple(dt.StructField(f.name, f.dtype, f.nullable)
+                                   for f in node.schema))
+
+    @property
+    def columns(self) -> List[str]:
+        return [f.name for f in self.schema.fields]
+
+    @property
+    def dtypes(self) -> List[Tuple[str, str]]:
+        return [(f.name, f.data_type.simple_string()) for f in self.schema.fields]
+
+    def explain(self, extended: bool = False):
+        from .plan.nodes import explain
+        print(explain(self._session._resolve(self._plan)))
+
+    def createOrReplaceTempView(self, name: str):
+        self._session.catalog_manager.register_temp_view(name, self._plan)
+
+    def createTempView(self, name: str):
+        self._session.catalog_manager.register_temp_view(name, self._plan,
+                                                         replace=False)
+
+    def cache(self) -> "DataFrame":
+        return self
+
+    def persist(self, *_) -> "DataFrame":
+        return self
+
+    def unpersist(self) -> "DataFrame":
+        return self
+
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+    @property
+    def sparkSession(self) -> SparkSession:
+        return self._session
+
+
+class Row(dict):
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return list(self.values())[key]
+        return super().__getitem__(key)
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v!r}" for k, v in self.items())
+        return f"Row({inner})"
+
+
+def _fmt_cell(v, truncate: bool) -> str:
+    if v is None:
+        return "NULL"
+    s = str(v)
+    if truncate and len(s) > 20:
+        s = s[:17] + "..."
+    return s
+
+
+class DataFrameReader:
+    def __init__(self, session: SparkSession):
+        self._session = session
+        self._format = "parquet"
+        self._options: Dict[str, str] = {}
+        self._schema: Optional[dt.StructType] = None
+
+    def format(self, fmt: str) -> "DataFrameReader":
+        self._format = fmt.lower()
+        return self
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key.lower()] = str(value)
+        return self
+
+    def options(self, **opts) -> "DataFrameReader":
+        for k, v in opts.items():
+            self.option(k, v)
+        return self
+
+    def schema(self, schema) -> "DataFrameReader":
+        if isinstance(schema, str):
+            self._schema = _parse_ddl_schema(schema)
+        else:
+            self._schema = schema
+        return self
+
+    def load(self, path: Optional[Union[str, List[str]]] = None) -> DataFrame:
+        paths = (path,) if isinstance(path, str) else tuple(path or ())
+        plan = sp.ReadDataSource(self._format, paths, self._schema,
+                                 tuple(self._options.items()))
+        return DataFrame(plan, self._session)
+
+    def parquet(self, *paths: str) -> DataFrame:
+        return self.format("parquet").load(list(paths))
+
+    def csv(self, path, header=None, sep=None, inferSchema=None, **kw) -> DataFrame:
+        if header is not None:
+            self.option("header", str(header).lower())
+        if sep is not None:
+            self.option("sep", sep)
+        return self.format("csv").load(path)
+
+    def json(self, path) -> DataFrame:
+        return self.format("json").load(path)
+
+    def table(self, name: str) -> DataFrame:
+        return self._session.table(name)
+
+
+class DataFrameWriter:
+    def __init__(self, df: DataFrame):
+        self._df = df
+        self._format = "parquet"
+        self._mode = "error"
+        self._options: Dict[str, str] = {}
+        self._partition_by: Tuple[str, ...] = ()
+
+    def format(self, fmt: str) -> "DataFrameWriter":
+        self._format = fmt.lower()
+        return self
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = {"errorifexists": "error"}.get(m.lower(), m.lower())
+        return self
+
+    def option(self, key: str, value) -> "DataFrameWriter":
+        self._options[key.lower()] = str(value)
+        return self
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = tuple(cols)
+        return self
+
+    def save(self, path: str):
+        from .io.formats import write_table
+        table = self._df.toArrow()
+        write_table(table, self._format, path, self._mode, self._options,
+                    self._partition_by)
+
+    def parquet(self, path: str):
+        self.format("parquet").save(path)
+
+    def csv(self, path: str, header=None):
+        if header is not None:
+            self.option("header", str(header).lower())
+        self.format("csv").save(path)
+
+    def json(self, path: str):
+        self.format("json").save(path)
+
+    def saveAsTable(self, name: str):
+        session = self._df._session
+        table = self._df.toArrow()
+        from .spec.data_type import StructType
+        entry = TableEntry(tuple(name.split(".")), _schema_of(table), table,
+                           (), "memory")
+        session.catalog_manager.register_table(
+            entry, replace=(self._mode == "overwrite"),
+            if_not_exists=(self._mode == "ignore"))
+
+    def insertInto(self, name: str, overwrite: bool = False):
+        session = self._df._session
+        cmd = sp.InsertInto(tuple(name.split(".")), self._df._plan,
+                            overwrite or self._mode == "overwrite")
+        session._execute_command(cmd)
+
+
+def _schema_of(table: pa.Table) -> dt.StructType:
+    from .columnar.arrow_interop import arrow_type_to_spec
+    return dt.StructType(tuple(
+        dt.StructField(n, arrow_type_to_spec(c.type), True)
+        for n, c in zip(table.column_names, table.columns)))
+
+
+def _empty_table(schema: dt.StructType) -> pa.Table:
+    from .columnar.arrow_interop import spec_type_to_arrow
+    arrays = [pa.array([], type=spec_type_to_arrow(f.data_type))
+              for f in schema.fields]
+    return pa.Table.from_arrays(arrays, names=[f.name for f in schema.fields])

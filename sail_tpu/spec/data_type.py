@@ -151,8 +151,13 @@ class DoubleType(DataType):
 class DecimalType(DataType):
     """Fixed-point decimal.
 
-    Device representation: scaled int64 (decimal128 narrowed; precision > 18
-    falls back to float64 on device in v0 — tracked limitation).
+    Device representation: the *unscaled* int64 whenever the scale is small
+    (exact arithmetic; values beyond ±2^63 unscaled are a v0 limitation —
+    the Arrow boundary validates ingested values). KNOWN LIMITATION:
+    device-side arithmetic (multiply, sum) on wide low-scale decimals can
+    overflow int64 silently when true magnitudes approach 2^63/10^scale;
+    int128 emulation (hi/lo int64 pairs, a Pallas kernel candidate) is the
+    planned exact wide path. High-scale (>6) decimals degrade to float64.
     """
 
     precision: int = 10
@@ -167,7 +172,7 @@ class DecimalType(DataType):
 
     @property
     def physical_dtype(self) -> Optional[str]:
-        return "int64" if self.precision <= 18 else "float64"
+        return "int64" if self.scale <= 6 else "float64"
 
 
 @dataclass(frozen=True)

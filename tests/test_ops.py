@@ -204,13 +204,13 @@ class TestJoin:
         assert bool(joinops.has_duplicate_build_keys(bt))
         total = int(joinops.join_output_count(r, pb.sel, "inner"))
         assert total == 3  # k=1 matches twice, k=2 once
-        out = joinops.join_expand(bt, r, pb, bb, "inner", ["b"], out_capacity=8)
+        out = joinops.join_expand(bt, r, pb, bb, "inner", ["b"], out_capacity=8).batch
         rows = live_rows(out, ["k", "b"])
         assert sorted(zip(rows["k"], rows["b"])) == [(1, 100), (1, 101), (2, 200)]
         # left join: unmatched probe rows appear with null build cols
         total_l = int(joinops.join_output_count(r, pb.sel, "left"))
         assert total_l == 5
-        out_l = joinops.join_expand(bt, r, pb, bb, "left", ["b"], out_capacity=8)
+        out_l = joinops.join_expand(bt, r, pb, bb, "left", ["b"], out_capacity=8).batch
         rows_l = live_rows(out_l, ["k", "b"])
         assert sorted(zip([(-1 if k is None else k) for k in rows_l["k"]],
                           [(-1 if b is None else b) for b in rows_l["b"]])) == \
@@ -300,7 +300,7 @@ class TestReviewRegressions:
         r = joinops.probe_ranges(bt, pkc, pb.sel, build_key_cols=bkc)
         total = int(joinops.join_output_count(r, pb.sel, "inner"))
         out = joinops.join_expand(bt, r, pb, bb, "inner", ["val"],
-                                  out_capacity=max(8, total))
+                                  out_capacity=max(8, total)).batch
         got = live_rows(out, ["a", "b", "c", "val"])
         exp = pd.DataFrame({"a": pk[0], "b": pk[1], "c": pk[2]}).merge(
             pd.DataFrame({"a": bk[0], "b": bk[1], "c": bk[2], "val": np.arange(bn)}),
