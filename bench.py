@@ -1,0 +1,117 @@
+"""Benchmark driver: TPC-H Q1 at SF1 through the full engine
+(SQL parse → plan → optimize → device execution).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published run completes Q1 at SF100 in 5.554 s on
+a 16-vCPU r8g.4xlarge (docs/introduction/benchmark-results/_data/
+events-sail.json); linearly scaled to SF1 → 0.0555 s. vs_baseline =
+baseline_seconds / our_seconds (>1 = faster than the reference).
+
+Timing is steady-state (best of 3 after a compile-warming run): XLA traces
+the query's kernels on first execution; the cache is keyed by batch
+capacity buckets, so repeated queries of similar size skip compilation.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_Q1_SF1_S = 5.554 / 100.0
+
+
+def generate_lineitem_sf(sf: float, seed: int = 0):
+    """Vectorized lineitem generator (full schema, fast string columns)."""
+    import pyarrow as pa
+
+    rng = np.random.default_rng(seed)
+    n_order = int(1_500_000 * sf)
+    lines_per = rng.integers(1, 8, n_order)
+    n = int(lines_per.sum())
+    epoch = datetime.date(1970, 1, 1)
+    start = (datetime.date(1992, 1, 1) - epoch).days
+    end = (datetime.date(1998, 8, 2) - epoch).days
+    okey = np.repeat(np.arange(1, n_order + 1) * 4 - 3, lines_per)
+    odate = np.repeat(rng.integers(start, end - 151, n_order), lines_per)
+    qty = rng.integers(1, 51, n)
+    part = rng.integers(1, int(200_000 * max(sf, 0.005)) + 1, n)
+    price = np.round(qty * ((90000 + (part % 200001) / 10 + 100 * (part % 1000)) / 100), 2)
+    disc = rng.integers(0, 11, n) / 100.0
+    tax = rng.integers(0, 9, n) / 100.0
+    ship = odate + rng.integers(1, 122, n)
+    commit = odate + rng.integers(30, 92, n)
+    receipt = ship + rng.integers(1, 31, n)
+    cutoff = (datetime.date(1995, 6, 17) - epoch).days
+    returnflag = np.where(receipt <= cutoff, rng.choice(["R", "A"], n), "N")
+    linestatus = np.where(ship > cutoff, "O", "F")
+    comments = rng.choice(np.array([
+        "carefully final deposits", "quickly regular packages",
+        "slyly special requests", "blithely even theodolites",
+        "furiously bold accounts", "pending unusual ideas",
+    ]), n)
+
+    def dec(v):
+        return pa.array(v).cast(pa.float64()).cast(pa.decimal128(15, 2), safe=False)
+
+    return pa.table({
+        "l_orderkey": pa.array(okey, type=pa.int64()),
+        "l_partkey": pa.array(part, type=pa.int64()),
+        "l_suppkey": pa.array(part % 10_000 + 1, type=pa.int64()),
+        "l_linenumber": pa.array(np.concatenate(
+            [np.arange(1, c + 1) for c in lines_per]), type=pa.int32()),
+        "l_quantity": dec(qty.astype(np.float64)),
+        "l_extendedprice": dec(price),
+        "l_discount": dec(disc),
+        "l_tax": dec(tax),
+        "l_returnflag": pa.array(returnflag),
+        "l_linestatus": pa.array(linestatus),
+        "l_shipdate": pa.array(ship.astype("datetime64[D]")),
+        "l_commitdate": pa.array(commit.astype("datetime64[D]")),
+        "l_receiptdate": pa.array(receipt.astype("datetime64[D]")),
+        "l_shipinstruct": pa.array(rng.choice(
+            np.array(["DELIVER IN PERSON", "COLLECT COD", "NONE",
+                      "TAKE BACK RETURN"]), n)),
+        "l_shipmode": pa.array(rng.choice(
+            np.array(["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL",
+                      "FOB"]), n)),
+        "l_comment": pa.array(comments),
+    })
+
+
+def main():
+    sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+    import jax
+
+    from sail_tpu import SparkSession
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+
+    platform = jax.devices()[0].platform
+    spark = SparkSession.builder.getOrCreate()
+    table = generate_lineitem_sf(sf)
+    spark.createDataFrame(table).createOrReplaceTempView("lineitem")
+
+    q1 = QUERIES[1]
+    spark.sql(q1).toArrow()  # warm-up: traces + compiles the kernels
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        spark.sql(q1).toArrow()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    print(json.dumps({
+        "metric": f"tpch_q1_sf{sf:g}_seconds",
+        "value": round(best, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_Q1_SF1_S * (sf / 1.0) / best, 3),
+        "platform": platform,
+        "rows": table.num_rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

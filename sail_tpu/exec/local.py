@@ -43,6 +43,46 @@ def _col_name(i: int) -> str:
     return f"c{i}"
 
 
+class _OpCache:
+    """Compiled-operator cache.
+
+    Keyed by (plan-node structural key, input-dictionary identity). The
+    bind-time closures bake host lookup tables derived from dictionaries, so
+    a cached entry is valid exactly while the same dictionary objects flow
+    in — the entry holds strong references and verifies identity on hit.
+    Combined with the scan cache (stable dictionaries per table), repeated
+    queries of the same shape skip both tracing and XLA compilation.
+    """
+
+    def __init__(self, max_entries: int = 512):
+        from collections import OrderedDict
+        self.entries = OrderedDict()
+        self.max_entries = max_entries
+
+    def get(self, key, dict_objs: Tuple, builder):
+        ident = tuple(id(d) for d in dict_objs)
+        hit = self.entries.get((key, ident))
+        if hit is not None:
+            stored, value = hit
+            if all(s is d for s, d in zip(stored, dict_objs)):
+                self.entries.move_to_end((key, ident))
+                return value
+        value = builder()
+        while len(self.entries) >= self.max_entries:
+            self.entries.popitem(last=False)  # LRU eviction
+        self.entries[(key, ident)] = (tuple(dict_objs), value)
+        return value
+
+
+_OP_CACHE = _OpCache()
+_SCAN_CACHE: Dict = {}
+
+
+def clear_caches():
+    _OP_CACHE.entries.clear()
+    _SCAN_CACHE.clear()
+
+
 class LocalExecutor:
     def __init__(self, config: Optional[dict] = None):
         self.config = config or {}
@@ -106,11 +146,67 @@ class LocalExecutor:
     def _eval(self, compiled: Compiled, batch: HostBatch):
         return compiled.fn(self._cols(batch))
 
+    def _dict_objs(self, batch: HostBatch) -> Tuple:
+        return tuple(batch.dicts[k] for k in sorted(batch.dicts))
+
+    def _op_key(self, *parts):
+        """Structural cache key, or None when unhashable (e.g. embedded
+        scalar-subquery plans holding memory tables).
+
+        Scalar-subquery values are baked into compiled closures, so the key
+        appends each referenced subquery's value in rex-walk order (stable
+        across executions of structurally-equal plans)."""
+        sub_vals = []
+        for part in parts:
+            for r in _walk_part_rex(part):
+                for node in rx.walk(r):
+                    if isinstance(node, rx.RScalarSubquery):
+                        v = self._subquery_cache.get(id(node))
+                        sub_vals.append(repr(None if v is None else v.value))
+        key = parts + (tuple(sub_vals),)
+        try:
+            hash(key)
+            return key
+        except TypeError:
+            return None
+
+    def _jitted(self, key, dict_objs: Tuple, builder):
+        """Returns (fn, aux) where fn is jit-compiled and cached when the
+        key is hashable, else built fresh and run eagerly."""
+        import jax
+
+        if key is None:
+            fn, aux = builder()
+            return fn, aux
+
+        def build():
+            fn, aux = builder()
+            return jax.jit(fn), aux
+
+        return _OP_CACHE.get(key, dict_objs, build)
+
     # ------------------------------------------------------------------
     # leaves
     # ------------------------------------------------------------------
     def _exec_ScanExec(self, p: pn.ScanExec) -> HostBatch:
-        from ..io.formats import read_table
+        from ..io.formats import expand_paths, read_table
+        import os
+        if p.source is not None:
+            cache_key = ("mem", id(p.source), p.projection)
+        else:
+            try:
+                files = tuple(expand_paths(p.paths))
+                mtimes = tuple(int(os.path.getmtime(f) * 1e6) for f in files)
+            except OSError:
+                files, mtimes = p.paths, ()
+            cache_key = ("file", files, mtimes, p.projection,
+                         tuple(sorted(dict(p.options).items())),
+                         tuple((f.name, f.dtype) for f in p.schema))
+        hit = _SCAN_CACHE.get(cache_key)
+        if hit is not None:
+            src_ref, hb = hit
+            if p.source is None or src_ref is p.source:
+                return hb
         if p.source is not None:
             table = p.source
             if p.projection is not None:
@@ -119,8 +215,11 @@ class LocalExecutor:
             table = read_table(p.format, p.paths, dict(p.options),
                                columns=p.projection)
             table = self._apply_declared_schema(table, p.schema)
-        hb = ai.from_arrow(table)
-        return _positional(hb)
+        hb = _positional(ai.from_arrow(table))
+        while len(_SCAN_CACHE) > 64:
+            _SCAN_CACHE.pop(next(iter(_SCAN_CACHE)))  # drop oldest
+        _SCAN_CACHE[cache_key] = (p.source, hb)
+        return hb
 
     @staticmethod
     def _apply_declared_schema(table: pa.Table, schema: pn.Schema) -> pa.Table:
@@ -165,35 +264,59 @@ class LocalExecutor:
     # ------------------------------------------------------------------
     def _exec_ProjectExec(self, p: pn.ProjectExec) -> HostBatch:
         child = self.run(p.input)
-        comp = self._compiler(child, p.input.schema)
         dev = child.device
-        out_cols: Dict[str, Column] = {}
-        out_dicts: Dict[str, pa.Array] = {}
-        for i, (name, e) in enumerate(p.exprs):
-            c = comp.compile(e)
-            data, validity = self._eval(c, child)
-            key = _col_name(i)
-            odt = rx.rex_type(e)
-            jdt = physical_jnp_dtype(odt)
-            if data.dtype != jnp.dtype(jdt):
-                data = data.astype(jdt)
-            out_cols[key] = Column(data, validity, odt)
-            if c.dictionary is not None:
-                out_dicts[key] = c.dictionary
-        if not out_cols:  # SELECT of zero columns
+        if not p.exprs:  # SELECT of zero columns
             return HostBatch(DeviceBatch({}, dev.sel), {})
+
+        def builder():
+            comp = self._compiler(child, p.input.schema)
+            compiled = [comp.compile(e) for _, e in p.exprs]
+            types = [rx.rex_type(e) for _, e in p.exprs]
+            jdts = [physical_jnp_dtype(t) for t in types]
+
+            def fn(cols):
+                out = []
+                for c, jdt in zip(compiled, jdts):
+                    data, validity = c.fn(cols)
+                    if data.dtype != jnp.dtype(jdt):
+                        data = data.astype(jdt)
+                    out.append((data, validity))
+                return tuple(out)
+
+            dicts = {_col_name(i): c.dictionary
+                     for i, c in enumerate(compiled) if c.dictionary is not None}
+            return fn, dicts
+
+        key = self._op_key("project", p.exprs,
+                           tuple((f.name, f.dtype) for f in p.input.schema))
+        fn, out_dicts = self._jitted(key, self._dict_objs(child), builder)
+        results = fn(self._cols(child))
+        out_cols = {_col_name(i): Column(d, v, rx.rex_type(e))
+                    for i, ((d, v), (_, e)) in enumerate(zip(results, p.exprs))}
         return HostBatch(DeviceBatch(out_cols, dev.sel), out_dicts)
 
     def _exec_FilterExec(self, p: pn.FilterExec) -> HostBatch:
         child = self.run(p.input)
-        comp = self._compiler(child, p.input.schema)
-        c = comp.compile(p.condition)
-        data, validity = self._eval(c, child)
-        keep = data.astype(jnp.bool_)
-        if validity is not None:
-            keep = keep & validity
         dev = child.device
-        return HostBatch(dev.with_sel(dev.sel & keep), child.dicts)
+
+        def builder():
+            comp = self._compiler(child, p.input.schema)
+            c = comp.compile(p.condition)
+
+            def fn(cols, sel):
+                data, validity = c.fn(cols)
+                keep = data.astype(jnp.bool_)
+                if validity is not None:
+                    keep = keep & validity
+                return sel & keep
+
+            return fn, None
+
+        key = self._op_key("filter", p.condition,
+                           tuple((f.name, f.dtype) for f in p.input.schema))
+        fn, _ = self._jitted(key, self._dict_objs(child), builder)
+        return HostBatch(dev.with_sel(fn(self._cols(child), dev.sel)),
+                         child.dicts)
 
     def _exec_LimitExec(self, p: pn.LimitExec) -> HostBatch:
         child = self.run(p.input)
@@ -209,60 +332,131 @@ class LocalExecutor:
 
     def _exec_SortExec(self, p: pn.SortExec) -> HostBatch:
         child = self.run(p.input)
-        comp = self._compiler(child, p.input.schema)
-        keys = []
-        for k in p.keys:
-            c = comp.compile(k.expr)
-            data, validity = self._eval(c, child)
-            kdt = rx.rex_type(k.expr)
-            if c.dictionary is not None:
-                ranks = ai.dictionary_ranks(c.dictionary)
-                data = jnp.asarray(ranks)[data]
-                kdt = dt.IntegerType()
-            keys.append((data, validity, kdt, k.ascending, k.nulls_first))
-        perm = sortk.lexsort_perm(keys, child.device.sel)
-        out = sortk.take_batch(child.device, perm)
+
+        def builder():
+            comp = self._compiler(child, p.input.schema)
+            compiled = [(comp.compile(k.expr), k) for k in p.keys]
+            rank_luts = []
+            for c, k in compiled:
+                rank_luts.append(jnp.asarray(ai.dictionary_ranks(c.dictionary))
+                                 if c.dictionary is not None else None)
+
+            def fn(cols, sel, datas, validities):
+                keys = []
+                for (c, k), lut in zip(compiled, rank_luts):
+                    data, validity = c.fn(cols)
+                    kdt = rx.rex_type(k.expr)
+                    if lut is not None:
+                        data = lut[data]
+                        kdt = dt.IntegerType()
+                    keys.append((data, validity, kdt, k.ascending, k.nulls_first))
+                perm = sortk.lexsort_perm(keys, sel)
+                out_d = [d[perm] for d in datas]
+                out_v = [None if v is None else v[perm] for v in validities]
+                out_sel = sel[perm]
+                if p.limit is not None:
+                    idx = jnp.arange(out_sel.shape[0], dtype=jnp.int32)
+                    out_sel = out_sel & (idx < p.limit)
+                return out_d, out_v, out_sel
+
+            return fn, None
+
+        key = self._op_key("sort", p.keys, p.limit,
+                           tuple((f.name, f.dtype) for f in p.input.schema))
+        fn, _ = self._jitted(key, self._dict_objs(child), builder)
+        dev = child.device
+        names = [_col_name(i) for i in range(len(dev.columns))]
+        datas = [dev.columns[n].data for n in names]
+        validities = [dev.columns[n].validity for n in names]
+        out_d, out_v, out_sel = fn(self._cols(child), dev.sel, datas, validities)
+        cols = {n: Column(d, v, dev.columns[n].dtype)
+                for n, d, v in zip(names, out_d, out_v)}
+        out = DeviceBatch(cols, out_sel)
         if p.limit is not None:
-            out = sortk.limit(out, p.limit)
             out = _shrink(out, p.limit)
         return HostBatch(out, child.dicts)
 
     def _exec_AggregateExec(self, p: pn.AggregateExec) -> HostBatch:
         child = self.run(p.input)
         dev = child.device
-        key_cols = [dev.columns[_col_name(i)] for i in p.group_indices]
         if p.group_indices:
             max_groups = p.max_groups_hint or dev.capacity
         else:
             max_groups = 1
-        ctx, sorted_keys = aggk.group_rows(key_cols, dev.sel, max_groups)
-        if p.max_groups_hint and bool(aggk.group_overflow(ctx)):
-            ctx, sorted_keys = aggk.group_rows(key_cols, dev.sel, dev.capacity)
+
+        # direct binning when every group key has a known small domain
+        # (dictionary codes / booleans) — no sort needed
+        domains = []
+        for gi in p.group_indices:
+            f = p.input.schema[gi]
+            name = _col_name(gi)
+            if name in child.dicts:
+                domains.append(len(child.dicts[name]))
+            elif isinstance(f.dtype, dt.BooleanType):
+                domains.append(2)
+            else:
+                domains.append(None)
+        direct_total = 1
+        for d in domains:
+            direct_total = direct_total * (d + 1) if d is not None else None
+            if direct_total is None:
+                break
+        use_direct = (p.group_indices and direct_total is not None
+                      and direct_total <= 4096)
+
+        def make_builder(mg):
+            def builder():
+                def fn(cols, sel):
+                    key_cols = [Column(cols[i][0], cols[i][1],
+                                       p.input.schema[i].dtype)
+                                for i in p.group_indices]
+                    if use_direct:
+                        ctx, sorted_keys = aggk.group_rows_direct(
+                            key_cols, domains, sel)
+                    else:
+                        ctx, sorted_keys = aggk.group_rows(key_cols, sel, mg)
+                    gkeys = aggk.group_key_output(ctx, sorted_keys)
+                    outs = []
+                    for a in p.aggs:
+                        arg = None if a.arg is None else \
+                            Column(cols[a.arg][0], cols[a.arg][1],
+                                   p.input.schema[a.arg].dtype)
+                        col = self._run_agg(ctx, a, arg)
+                        outs.append((col.data, col.validity))
+                    return ([(g.data, g.validity) for g in gkeys], outs,
+                            aggk.group_sel(ctx), ctx.num_groups,
+                            aggk.group_overflow(ctx))
+                return fn, None
+            return builder
+
+        key = self._op_key("agg", p.group_indices, p.aggs, max_groups,
+                           tuple((f.name, f.dtype) for f in p.input.schema))
+        fn, _ = self._jitted(key, self._dict_objs(child), make_builder(max_groups))
+        gk, aggs_out, gsel, n_groups, overflow = fn(self._cols(child), dev.sel)
+        if p.max_groups_hint and bool(overflow):
+            key2 = self._op_key("agg", p.group_indices, p.aggs, dev.capacity,
+                               tuple((f.name, f.dtype) for f in p.input.schema))
+            fn2, _ = self._jitted(key2, self._dict_objs(child),
+                                  make_builder(dev.capacity))
+            gk, aggs_out, gsel, n_groups, overflow = fn2(self._cols(child), dev.sel)
         out_cols: Dict[str, Column] = {}
         out_dicts: Dict[str, pa.Array] = {}
-        gsel = aggk.group_sel(ctx)
-        gkeys = aggk.group_key_output(ctx, sorted_keys)
         for j, gi in enumerate(p.group_indices):
-            key = _col_name(j)
-            out_cols[key] = gkeys[j]
+            k = _col_name(j)
+            out_cols[k] = Column(gk[j][0], gk[j][1], p.input.schema[gi].dtype)
             src = _col_name(gi)
             if src in child.dicts:
-                out_dicts[key] = child.dicts[src]
+                out_dicts[k] = child.dicts[src]
         ng = len(p.group_indices)
         for j, a in enumerate(p.aggs):
-            key = _col_name(ng + j)
-            arg = None if a.arg is None else dev.columns[_col_name(a.arg)]
-            col = self._run_agg(ctx, a, arg)
-            out_cols[key] = col
+            k = _col_name(ng + j)
+            out_cols[k] = Column(aggs_out[j][0], aggs_out[j][1], a.out_dtype)
             if a.arg is not None and a.fn in ("min", "max", "first", "last"):
                 src = _col_name(a.arg)
                 if src in child.dicts:
-                    out_dicts[key] = child.dicts[src]
-        out = DeviceBatch(out_cols, gsel) if out_cols else \
-            DeviceBatch({}, gsel)
-        # shrink to the live group count (host sync)
-        n_groups = int(ctx.num_groups)
-        out = _shrink(out, n_groups)
+                    out_dicts[k] = child.dicts[src]
+        out = DeviceBatch(out_cols, gsel)
+        out = _shrink(out, int(n_groups))
         return HostBatch(out, out_dicts)
 
     def _run_agg(self, ctx, a: pn.AggSpec, arg: Optional[Column]) -> Column:
@@ -319,33 +513,69 @@ class LocalExecutor:
             return _reorder_right(out, len(p.right.schema), len(p.left.schema))
         return self._join(p, left, right)
 
+    def _compile_join_keys(self, p: pn.JoinExec, left: HostBatch, right: HostBatch,
+                           seed: int):
+        """Builder for the jitted build+probe phase of an equi-join."""
+        def builder():
+            lcomp = self._compiler(left, p.left.schema)
+            rcomp = self._compiler(right, p.right.schema)
+            pairs = []
+            for lk, rk in zip(p.left_keys, p.right_keys):
+                lc = lcomp.compile(lk)
+                rc = rcomp.compile(rk)
+                ktype = rx.rex_type(lk)
+                luts = None
+                if lc.dictionary is not None or rc.dictionary is not None:
+                    merged, ra, rb = ai.unify_dictionaries(lc.dictionary,
+                                                           rc.dictionary)
+                    luts = (jnp.asarray(ra), jnp.asarray(rb))
+                    ktype = dt.IntegerType()
+                pairs.append((lc, rc, ktype, luts))
+
+            def fn(lcols, lsel, rcols, rsel):
+                lkeys, rkeys = [], []
+                for lc, rc, ktype, luts in pairs:
+                    ld, lv = lc.fn(lcols)
+                    rd, rv = rc.fn(rcols)
+                    if luts is not None:
+                        ld = luts[0][ld]
+                        rd = luts[1][rd]
+                    lkeys.append(Column(ld, lv, ktype))
+                    rkeys.append(Column(rd, rv, ktype))
+                bt = joink.build_side(rkeys, rsel, seed)
+                ambiguous = joink.hash_ambiguous(bt, rkeys) if not bt.exact \
+                    else jnp.asarray(False)
+                ranges = joink.probe_ranges(
+                    bt, lkeys, lsel, build_key_cols=rkeys if not bt.exact else None)
+                has_dup = joink.has_duplicate_build_keys(bt)
+                inner_total = joink.join_output_count(ranges, lsel, "inner")
+                return (bt.perm, bt.sorted_keys, bt.num_valid,
+                        ranges.lo, ranges.cnt, ranges.usable,
+                        has_dup, ambiguous, inner_total, bt.exact)
+
+            return fn, None
+        return builder
+
     def _join(self, p: pn.JoinExec, left: HostBatch, right: HostBatch) -> HostBatch:
         jt = p.join_type
-        lcomp = self._compiler(left, p.left.schema)
-        rcomp = self._compiler(right, p.right.schema)
-        lkeys, rkeys, lkey_dicts = [], [], []
-        for lk, rk in zip(p.left_keys, p.right_keys):
-            lc = lcomp.compile(lk)
-            rc = rcomp.compile(rk)
-            ld, lv = self._eval(lc, left)
-            rd, rv = self._eval(rc, right)
-            ktype = rx.rex_type(lk)
-            if lc.dictionary is not None or rc.dictionary is not None:
-                merged, ra, rb = ai.unify_dictionaries(lc.dictionary, rc.dictionary)
-                ld = jnp.asarray(ra)[ld]
-                rd = jnp.asarray(rb)[rd]
-                ktype = dt.IntegerType()
-            lkeys.append(Column(ld, lv, ktype))
-            rkeys.append(Column(rd, rv, ktype))
-        # build on the right side
+        schema_key = (tuple((f.name, f.dtype) for f in p.left.schema),
+                      tuple((f.name, f.dtype) for f in p.right.schema))
+        dict_objs = self._dict_objs(left) + self._dict_objs(right)
+        lcols, lsel = self._cols(left), left.device.sel
+        rcols, rsel = self._cols(right), right.device.sel
         for seed in range(4):
-            bt = joink.build_side(rkeys, right.device.sel, seed)
-            if bt.exact or not bool(joink.hash_ambiguous(bt, rkeys)):
+            key = self._op_key("join_phase", p.left_keys, p.right_keys, seed,
+                               schema_key)
+            fn, _ = self._jitted(key, dict_objs,
+                                 self._compile_join_keys(p, left, right, seed))
+            (perm, sorted_keys, num_valid, lo, cnt, usable,
+             has_dup_a, ambiguous, inner_total, exact) = fn(lcols, lsel, rcols, rsel)
+            if exact or not bool(ambiguous):
                 break
         else:
             raise ExecutionError("could not build unambiguous hash join")
-        ranges = joink.probe_ranges(bt, lkeys, left.device.sel,
-                                    build_key_cols=rkeys if not bt.exact else None)
+        bt = joink.BuildTable(perm, sorted_keys, bool(exact), num_valid, seed)
+        ranges = joink.MatchRanges(lo, cnt, usable)
         merged_dicts = dict(left.dicts)
         right_names = {}
         n_left = len(p.left.schema)
@@ -358,20 +588,37 @@ class LocalExecutor:
         build_payload = DeviceBatch(r_dev_cols, right.device.sel)
         build_names = list(r_dev_cols.keys()) if jt not in ("semi", "anti") else []
 
-        has_dup = bool(joink.has_duplicate_build_keys(bt))
+        has_dup = bool(has_dup_a)
         if not has_dup and p.residual is None:
-            out_dev = joink.join_unique(bt, ranges, left.device, build_payload,
-                                        jt, build_names)
+            ukey = self._op_key("join_unique", jt, len(build_names), schema_key)
+
+            def ubuilder():
+                def ufn(bt_arrays, ranges_arrays, ldev, bpayload):
+                    b_perm, b_keys, b_nvalid = bt_arrays
+                    bt_l = joink.BuildTable(perm=b_perm, sorted_keys=b_keys,
+                                            exact=bool(exact),
+                                            num_valid=b_nvalid, seed=seed)
+                    rg = joink.MatchRanges(*ranges_arrays)
+                    return joink.join_unique(bt_l, rg, ldev, bpayload, jt,
+                                             build_names)
+                return ufn, None
+
+            ufn, _ = self._jitted(ukey, dict_objs, ubuilder)
+            out_dev = ufn((perm, sorted_keys, num_valid), (lo, cnt, usable),
+                          left.device, build_payload)
             out_dicts = merged_dicts if jt not in ("semi", "anti") else left.dicts
             return HostBatch(out_dev, out_dicts)
         return self._join_expand(p, left, right, bt, ranges, build_payload,
-                                 build_names, merged_dicts)
+                                 build_names, merged_dicts,
+                                 inner_total=int(inner_total))
 
     def _join_expand(self, p: pn.JoinExec, left: HostBatch, right: HostBatch,
-                     bt, ranges, build_payload, build_names, merged_dicts) -> HostBatch:
+                     bt, ranges, build_payload, build_names, merged_dicts,
+                     inner_total=None) -> HostBatch:
         jt = p.join_type
         n_left = len(p.left.schema)
-        total = int(joink.join_output_count(ranges, left.device.sel, "inner"))
+        total = int(joink.join_output_count(ranges, left.device.sel, "inner")) \
+            if inner_total is None else inner_total
         cap = round_capacity(max(total, 1))
         res = joink.join_expand(bt, ranges, left.device, build_payload,
                                 "inner", list(build_payload.columns.keys()),
@@ -614,3 +861,15 @@ def _node_rex(p: pn.PlanNode):
     elif isinstance(p, pn.SortExec):
         for k in p.keys:
             yield k.expr
+
+
+def _walk_part_rex(part):
+    """Yield Rex nodes reachable inside an _op_key part (tuples of exprs,
+    SortKeys, bare Rex, …)."""
+    if isinstance(part, rx.Rex):
+        yield part
+    elif isinstance(part, pn.SortKey):
+        yield part.expr
+    elif isinstance(part, tuple):
+        for item in part:
+            yield from _walk_part_rex(item)

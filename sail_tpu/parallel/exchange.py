@@ -1,0 +1,159 @@
+"""Data exchange between partitions: the engine's "shuffle" as XLA
+collectives.
+
+Reference role: the five InputModes — Forward, Merge, Shuffle, Broadcast,
+Rescale — that form the reference's complete exchange vocabulary
+(crates/sail-execution/src/job_graph/mod.rs:134-151), plus the shuffle
+write/read data plane (src/plan/shuffle_write.rs, Arrow Flight streams).
+TPU-native redesign: partitioned batches live as [P, capacity] arrays
+sharded over a mesh axis; exchanges are `shard_map`-wrapped collectives —
+hash shuffle = local bucket sort + `all_to_all` over ICI, broadcast =
+`all_gather` — instead of TCP streams.
+
+Static-shape contract: each (source→target) bucket has a fixed capacity;
+overload is detected (per-bucket counts exported) and the host re-runs
+with a larger bucket factor. Uniform hash keys need factor ≈ 1+ε; the
+default doubles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.hash import hash64
+from ..spec import data_type as dt
+from .mesh import DATA_AXIS
+
+
+def bucket_by_partition(part_id, sel, num_partitions: int, bucket_cap: int):
+    """Scatter local rows into per-target buckets.
+
+    Returns (perm int32[num_partitions * bucket_cap], valid mask, overflow
+    scalar): ``perm[t * bucket_cap + k]`` = local row index of the k-th row
+    destined for target t. Rows beyond a bucket's capacity are dropped and
+    counted in ``overflow``.
+    """
+    n = part_id.shape[0]
+    pid = jnp.where(sel, part_id, num_partitions)  # dead rows to a trash bucket
+    order = jnp.argsort(pid, stable=True).astype(jnp.int32)
+    sorted_pid = pid[order]
+    # rank within bucket = position - first position of the bucket
+    first = jnp.searchsorted(sorted_pid, jnp.arange(num_partitions + 1,
+                                                    dtype=sorted_pid.dtype),
+                             side="left").astype(jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    rank = pos - first[jnp.clip(sorted_pid, 0, num_partitions)]
+    counts = first[1:] - first[:-1]  # rows per real bucket
+    overflow = jnp.sum(jnp.maximum(counts[:num_partitions] - bucket_cap, 0))
+    slot = jnp.clip(sorted_pid, 0, num_partitions - 1) * bucket_cap + \
+        jnp.clip(rank, 0, bucket_cap - 1)
+    ok = (sorted_pid < num_partitions) & (rank < bucket_cap)
+    total = num_partitions * bucket_cap
+    target = jnp.where(ok, slot, total)  # out-of-range → dropped by scatter
+    perm = jnp.zeros(total, dtype=jnp.int32).at[target].set(order, mode="drop")
+    valid = jnp.zeros(total, dtype=jnp.bool_).at[target].set(True, mode="drop")
+    return perm, valid, overflow
+
+
+def shuffle_local(arrays: Sequence[jnp.ndarray], sel, part_id,
+                  num_partitions: int, bucket_cap: int):
+    """Local side of the hash shuffle (inside shard_map, one partition).
+
+    ``arrays``: per-column data [n]; returns per-column [num_partitions,
+    bucket_cap] send buffers + valid mask + overflow count.
+    """
+    perm, valid, overflow = bucket_by_partition(part_id, sel, num_partitions,
+                                                bucket_cap)
+    out = [a[perm].reshape(num_partitions, bucket_cap) for a in arrays]
+    return out, valid.reshape(num_partitions, bucket_cap), overflow
+
+
+def make_shuffle(mesh: Mesh, num_cols: int, has_validity: Sequence[bool],
+                 bucket_cap: int):
+    """Build a jitted all-to-all hash shuffle over the mesh.
+
+    Input:  columns as [P, n] sharded arrays (+ validity where present),
+            sel [P, n], part_id [P, n].
+    Output: columns as [P, P*bucket_cap] sharded arrays, sel, overflow [P].
+    """
+    num_partitions = mesh.shape[DATA_AXIS]
+
+    def local_fn(cols, validities, sel, part_id):
+        arrays = list(cols) + [v for v in validities if v is not None]
+        bufs, valid, overflow = shuffle_local(arrays, sel, part_id,
+                                              num_partitions, bucket_cap)
+        # all_to_all: axis 0 is the target-partition dim
+        exchanged = [jax.lax.all_to_all(b, DATA_AXIS, 0, 0, tiled=True)
+                     for b in bufs]
+        valid_x = jax.lax.all_to_all(valid, DATA_AXIS, 0, 0, tiled=True)
+        ncols = len(cols)
+        out_cols = [e.reshape(-1) for e in exchanged[:ncols]]
+        out_vals = []
+        vi = ncols
+        for hv in has_validity:
+            if hv:
+                out_vals.append(exchanged[vi].reshape(-1))
+                vi += 1
+            else:
+                out_vals.append(None)
+        return out_cols, out_vals, valid_x.reshape(-1), overflow
+
+    spec = P(DATA_AXIS)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, spec))
+    def shuffled(cols, validities, sel, part_id):
+        # inside: leading dim is the local shard (size 1 after sharding [P, n])
+        cols_l = [c[0] for c in cols]
+        vals_l = [None if v is None else v[0] for v in validities]
+        sel_l = sel[0]
+        pid_l = part_id[0]
+        out_cols, out_vals, out_sel, overflow = local_fn(cols_l, vals_l, sel_l, pid_l)
+        return (tuple(c[None] for c in out_cols),
+                tuple(None if v is None else v[None] for v in out_vals),
+                out_sel[None], overflow[None])
+
+    return shuffled
+
+
+# ---------------------------------------------------------------------------
+# The five exchange modes (SPMD formulations)
+# ---------------------------------------------------------------------------
+
+def exchange_forward(arrays):
+    """Forward: partition i feeds consumer i unchanged."""
+    return arrays
+
+
+def exchange_broadcast(mesh: Mesh, array, axis: str = DATA_AXIS):
+    """Broadcast: every partition receives all rows (build side of
+    broadcast hash joins). [P, n] → [P, P*n] replicated content."""
+    spec = P(axis)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,),
+                       out_specs=spec)
+    def bc(a):
+        gathered = jax.lax.all_gather(a[0], axis, tiled=True)
+        return gathered[None]
+
+    return bc(array)
+
+
+def exchange_merge(mesh: Mesh, array, axis: str = DATA_AXIS):
+    """Merge: all partitions concatenate into every shard (the driver/root
+    reads shard 0). Same collective as broadcast; semantic difference is
+    that downstream runs single-partition."""
+    return exchange_broadcast(mesh, array, axis)
+
+
+def hash_partition_ids(key_datas, key_types: Sequence[dt.DataType],
+                       num_partitions: int):
+    h = hash64(list(key_datas), list(key_types))
+    return (h % jnp.uint64(num_partitions)).astype(jnp.int32)

@@ -1,0 +1,42 @@
+"""Device mesh management.
+
+Reference role: the cluster topology side of sail-execution's worker pool
+(SURVEY.md §2.5/§2.8) — but TPU-native: parallelism is expressed as a
+jax.sharding.Mesh over chips, with XLA collectives riding ICI. The default
+layout is a 1-D "data" axis (partition parallelism — every relational
+operator is data-parallel over row partitions); a second "expert"/pipeline
+axis slots in for multi-stage scheduling in later rounds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (DATA_AXIS,))
+
+
+def partitioned_spec() -> P:
+    """Rows sharded over the data axis (leading partition dim)."""
+    return P(DATA_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def shard_batch_arrays(mesh: Mesh, arrays):
+    """Place [P, ...] arrays with the partition dim sharded over the mesh."""
+    sharding = NamedSharding(mesh, partitioned_spec())
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), arrays)

@@ -36,9 +36,17 @@ CV = K.CV
 
 @dataclass
 class Compiled:
-    """A bind-time-compiled expression."""
+    """A bind-time-compiled expression.
 
-    fn: Callable[[List[CV]], CV]  # cols by position → value
+    ``fn(cols)``: cols = column (data, validity) pairs by position. Host
+    lookup tables derived from dictionaries (and scalar-subquery values)
+    are baked into the closure as constants; a compiled closure is
+    therefore only valid while the SAME dictionary objects flow in — the
+    executor's _OpCache enforces this by keying on (plan structure,
+    dictionary identity, subquery values) and holding strong references.
+    """
+
+    fn: Callable[[List[CV]], CV]
     dtype: dt.DataType
     dictionary: Optional[pa.Array] = None  # for string/binary outputs
 

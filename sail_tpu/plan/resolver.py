@@ -100,6 +100,11 @@ class Resolver:
 
     # ------------------------------------------------------------------
     def resolve(self, plan: sp.QueryPlan) -> pn.PlanNode:
+        # Deterministic generated names: identical queries resolve to
+        # structurally-equal plans, which keys the executor's compiled-
+        # operator cache.
+        global _FRESH
+        _FRESH = itertools.count()
         node, _ = self.resolve_query(plan, None)
         return node
 
