@@ -83,8 +83,26 @@ def generate_lineitem_sf(sf: float, seed: int = 0):
     })
 
 
+def _probe_backend(timeout_s: float = 150.0) -> bool:
+    """Check in a subprocess that the default jax backend initializes —
+    a wedged remote-TPU tunnel would otherwise hang this process forever."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+    if not _probe_backend():
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     import jax
 
     from sail_tpu import SparkSession

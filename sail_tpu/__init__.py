@@ -26,3 +26,6 @@ if _os.environ.get("SAIL_TPU_DISABLE_X64") != "1":
     _jax.config.update("jax_enable_x64", True)
 
 from .session import SparkSession  # noqa: F401
+
+from .functions.udf import pandas_udf, udf  # noqa: F401,E402
+from .session import Column, DataFrame, col, lit  # noqa: F401,E402

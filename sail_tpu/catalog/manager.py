@@ -28,11 +28,13 @@ class TableEntry:
 
 class CatalogManager:
     def __init__(self):
+        from ..functions.udf import UDFRegistry
         self.current_catalog = "spark_catalog"
         self.current_database = "default"
         self.databases: Dict[str, dict] = {"default": {}}
         self.tables: Dict[Tuple[str, str], TableEntry] = {}
         self.temp_views: Dict[str, TableEntry] = {}
+        self.udfs = UDFRegistry()
 
     # -- resolution ------------------------------------------------------
     def _db_and_name(self, name: Tuple[str, ...]) -> Tuple[str, str]:

@@ -289,11 +289,94 @@ class LocalExecutor:
 
         key = self._op_key("project", p.exprs,
                            tuple((f.name, f.dtype) for f in p.input.schema))
-        fn, out_dicts = self._jitted(key, self._dict_objs(child), builder)
+        try:
+            fn, out_dicts = self._jitted(key, self._dict_objs(child), builder)
+        except HostFallback:
+            return self._project_host_path(p, child)
         results = fn(self._cols(child))
         out_cols = {_col_name(i): Column(d, v, rx.rex_type(e))
                     for i, ((d, v), (_, e)) in enumerate(zip(results, p.exprs))}
         return HostBatch(DeviceBatch(out_cols, dev.sel), out_dicts)
+
+    def _project_host_path(self, p: pn.ProjectExec, child: HostBatch) -> HostBatch:
+        """Per-expression evaluation with host fallback for expressions the
+        device compiler can't lower (string-returning Python UDFs, …)."""
+        comp = self._compiler(child, p.input.schema)
+        dev = child.device
+        out_cols: Dict[str, Column] = {}
+        out_dicts: Dict[str, pa.Array] = {}
+        for i, (name, e) in enumerate(p.exprs):
+            keyn = _col_name(i)
+            try:
+                c = comp.compile(e)
+                data, validity = self._eval(c, child)
+                if c.dictionary is not None:
+                    out_dicts[keyn] = c.dictionary
+            except HostFallback:
+                data, validity, dictionary = self._host_eval(e, comp, child)
+                if dictionary is not None:
+                    out_dicts[keyn] = dictionary
+            odt = rx.rex_type(e)
+            jdt = physical_jnp_dtype(odt)
+            if data.dtype != jnp.dtype(jdt):
+                data = data.astype(jdt)
+            out_cols[keyn] = Column(data, validity, odt)
+        return HostBatch(DeviceBatch(out_cols, dev.sel), out_dicts)
+
+    def _host_eval(self, e: rx.Rex, comp: ExprCompiler, child: HostBatch):
+        """Host evaluation of a __pyudf call (incl. string returns): args
+        evaluate on device, rows run through the Python function, string
+        results dictionary-encode."""
+        if not (isinstance(e, rx.RCall) and e.fn == "__pyudf"):
+            raise ExecutionError(
+                f"expression requires host evaluation but no host path exists: "
+                f"{pn._rex_str(e)}")
+        u = dict(e.options)["udf"]
+        arg_vals = []
+        for a in e.args:
+            ac = comp.compile(a)
+            data, validity = self._eval(ac, child)
+            arg_vals.append((np.asarray(data),
+                             None if validity is None else np.asarray(validity),
+                             rx.rex_type(a), ac.dictionary))
+        n = child.capacity
+        cols_py = []
+        for data, validity, adt, dictionary in arg_vals:
+            if dictionary is not None:
+                vals_list = dictionary.cast(pa.string()).to_pylist()
+                col = [vals_list[int(c)] if (validity is None or validity[i])
+                       else None for i, c in enumerate(data)]
+            elif isinstance(adt, dt.DecimalType) and adt.physical_dtype == "int64":
+                col = [float(x) / (10 ** adt.scale)
+                       if (validity is None or validity[i]) else None
+                       for i, x in enumerate(data)]
+            else:
+                col = [data[i].item() if (validity is None or validity[i])
+                       else None for i in range(n)]
+            cols_py.append(col)
+        if u.eval_type == "pandas":
+            import pandas as pd
+            res = list(u.func(*[pd.Series(c) for c in cols_py]))
+        else:
+            res = [u.func(*vals) for vals in zip(*cols_py)] if cols_py else \
+                [u.func() for _ in range(n)]
+        out_t = u.return_type
+        if isinstance(out_t, (dt.StringType, dt.BinaryType)):
+            arr = pa.array([None if v is None else str(v) for v in res],
+                           type=pa.string())
+            enc = arr.dictionary_encode()
+            codes = np.asarray(enc.indices.fill_null(0)).astype(np.int32)
+            import pyarrow.compute as _pc
+            validity = jnp.asarray(np.asarray(_pc.is_valid(arr)))
+            return jnp.asarray(codes), validity, enc.dictionary
+        jdt = physical_jnp_dtype(out_t)
+        out = np.zeros(n, dtype=jdt)
+        mask = np.zeros(n, dtype=bool)
+        for i, v in enumerate(res):
+            if v is not None and v == v:
+                out[i] = v
+                mask[i] = True
+        return jnp.asarray(out), jnp.asarray(mask), None
 
     def _exec_FilterExec(self, p: pn.FilterExec) -> HostBatch:
         child = self.run(p.input)
@@ -743,6 +826,131 @@ class LocalExecutor:
         return HostBatch(DeviceBatch(cols, sel), dicts)
 
     # ------------------------------------------------------------------
+    def _exec_WindowExec(self, p: pn.WindowExec) -> HostBatch:
+        from ..ops import window as wink
+        from ..ops.sort import order_bits
+        child = self.run(p.input)
+        dev = child.device
+        in_schema = p.input.schema
+
+        def builder():
+            # precompute rank LUTs for dictionary-encoded order keys
+            order_luts: Dict[int, jnp.ndarray] = {}
+            for s in p.windows:
+                for k in s.order_keys:
+                    i = k.expr.index
+                    name = _col_name(i)
+                    if name in child.dicts and i not in order_luts:
+                        order_luts[i] = jnp.asarray(
+                            ai.dictionary_ranks(child.dicts[name]))
+
+            def fn(cols, sel):
+                ctx_cache = {}
+                outs = []
+                for s in p.windows:
+                    pkey = tuple(s.partition_indices)
+                    okey = tuple((k.expr.index, k.ascending, k.nulls_first)
+                                 for k in s.order_keys)
+                    ck = (pkey, okey)
+                    if ck not in ctx_cache:
+                        part_cols = [Column(cols[i][0], cols[i][1],
+                                            in_schema[i].dtype)
+                                     for i in s.partition_indices]
+                        order_keys = []
+                        for k in s.order_keys:
+                            i = k.expr.index
+                            d, v = cols[i]
+                            kdt = in_schema[i].dtype
+                            if i in order_luts:
+                                d = order_luts[i][d]
+                                kdt = dt.IntegerType()
+                            order_keys.append((d, v, kdt, k.ascending,
+                                               k.nulls_first))
+                        ctx = wink.build_window_context(part_cols, order_keys,
+                                                        sel)
+                        okbits = [order_bits(d[ctx.perm], kdt, asc)
+                                  for (d, v, kdt, asc, nf) in order_keys]
+                        ctx_cache[ck] = (ctx, okbits)
+                    ctx, okbits = ctx_cache[ck]
+                    opts = dict(s.options)
+                    fnname = s.function
+                    if fnname == "row_number":
+                        outs.append((wink.row_number(ctx), None))
+                    elif fnname == "rank":
+                        outs.append((wink.rank(ctx, okbits), None))
+                    elif fnname == "dense_rank":
+                        outs.append((wink.dense_rank(ctx, okbits), None))
+                    elif fnname == "percent_rank":
+                        outs.append((wink.percent_rank(ctx, okbits), None))
+                    elif fnname == "cume_dist":
+                        outs.append((wink.cume_dist(ctx, okbits), None))
+                    elif fnname == "ntile":
+                        outs.append((wink.ntile(ctx, int(opts["n"])), None))
+                    elif fnname in ("lag", "lead"):
+                        arg = Column(cols[s.arg][0], cols[s.arg][1],
+                                     in_schema[s.arg].dtype)
+                        d, v = wink.shift(ctx, arg, int(opts["offset"]),
+                                          opts.get("default"))
+                        outs.append((d, v))
+                    else:
+                        fnk = s.function
+                        arg = None
+                        inv_lut = None
+                        if s.arg is not None:
+                            adata, avalid = cols[s.arg]
+                            adt = in_schema[s.arg].dtype
+                            name = _col_name(s.arg)
+                            if name in child.dicts and fnk in ("min", "max"):
+                                # compare string codes in rank order, then
+                                # map the winning rank back to a code
+                                ranks = ai.dictionary_ranks(child.dicts[name])
+                                inv = np.empty_like(ranks)
+                                inv[ranks] = np.arange(len(ranks), dtype=ranks.dtype)
+                                adata = jnp.asarray(ranks)[adata]
+                                adt = dt.IntegerType()
+                                inv_lut = jnp.asarray(inv)
+                            arg = Column(adata, avalid, adt)
+                        peer = None
+                        if s.frame_type == "range":
+                            if s.frame_lower is None and s.frame_upper == 0:
+                                peer = wink.peer_group_end(ctx, okbits)
+                            elif not (s.frame_lower is None and s.frame_upper is None):
+                                raise ExecutionError(
+                                    "RANGE frames with value offsets are not "
+                                    "supported yet")
+                        d, v = wink.framed_agg(ctx, arg, fnk,
+                                               s.frame_lower, s.frame_upper,
+                                               peer)
+                        if inv_lut is not None:
+                            d = inv_lut[jnp.clip(d, 0, inv_lut.shape[0] - 1)]
+                        if fnk == "avg" and s.arg is not None and \
+                                isinstance(in_schema[s.arg].dtype, dt.DecimalType):
+                            d = d / (10.0 ** in_schema[s.arg].dtype.scale)
+                        outs.append((d, v))
+                return tuple(outs)
+
+            return fn, None
+
+        key = self._op_key("window", p.windows,
+                           tuple((f.name, f.dtype) for f in in_schema))
+        fn, _ = self._jitted(key, self._dict_objs(child), builder)
+        results = fn(self._cols(child), dev.sel)
+        cols = dict(dev.columns)
+        out_dicts = dict(child.dicts)
+        n_in = len(in_schema)
+        for j, (s, (d, v)) in enumerate(zip(p.windows, results)):
+            keyn = _col_name(n_in + j)
+            jdt = physical_jnp_dtype(s.out_dtype)
+            if d.dtype != jnp.dtype(jdt):
+                d = d.astype(jdt)
+            cols[keyn] = Column(d, v, s.out_dtype)
+            if s.arg is not None and s.function in ("lag", "lead", "min",
+                                                    "max", "first", "last"):
+                src = _col_name(s.arg)
+                if src in child.dicts:
+                    out_dicts[keyn] = child.dicts[src]
+        return HostBatch(DeviceBatch(cols, dev.sel), out_dicts)
+
     def _exec_UnionExec(self, p: pn.UnionExec) -> HostBatch:
         parts = [self.run(c) for c in p.inputs]
         ncols = len(p.schema)

@@ -1,0 +1,91 @@
+"""User-defined functions.
+
+Reference role: sail-python-udf (crates/sail-python-udf — PySpark UDF
+execution via an embedded interpreter with Arrow FFI; SURVEY.md §2.5).
+Being Python-native, this engine inverts the design:
+
+- ``pandas_udf``/arrow-batch UDFs are first **traced with jax**: if the
+  function body is expressible in numpy-compatible ops it compiles straight
+  into the surrounding XLA pipeline and runs ON DEVICE (the reference's
+  UDFs always pay a host round-trip).
+- Untraceable functions run through ``jax.pure_callback`` — the host
+  executes the Python function on numpy/pandas batches while the
+  surrounding query stays jitted; string arguments are decoded through the
+  bind-time dictionary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..spec import data_type as dt
+
+
+@dataclass(frozen=True)
+class UserDefinedFunction:
+    func: Callable
+    return_type: dt.DataType
+    eval_type: str = "batch"  # "batch" (row-at-a-time) | "pandas" | "arrow"
+    name: str = "<lambda>"
+    deterministic: bool = True
+
+    def __call__(self, *cols):
+        from ..session import Column, _to_expr
+        args = tuple(_to_expr(c) for c in cols)
+        return Column(UdfExpr(self, args))
+
+
+# Expression node carrying the UDF handle (kept out of spec.expression's
+# core set; the resolver special-cases it).
+from ..spec import expression as _ex  # noqa: E402
+
+
+@dataclass(frozen=True)
+class UdfExpr(_ex.Expr):
+    udf: UserDefinedFunction = None
+    args: tuple = ()
+
+
+def udf(f=None, returnType=None):
+    """F.udf(lambda, T) or @F.udf(returnType=T) decorator."""
+    rt = _parse_rt(returnType) if returnType is not None else dt.StringType()
+    if f is None:
+        return lambda fn: UserDefinedFunction(fn, rt, "batch",
+                                              getattr(fn, "__name__", "udf"))
+    return UserDefinedFunction(f, rt, "batch", getattr(f, "__name__", "udf"))
+
+
+def pandas_udf(f=None, returnType=None, functionType=None):
+    rt = _parse_rt(returnType) if returnType is not None else dt.DoubleType()
+    if f is None:
+        return lambda fn: UserDefinedFunction(fn, rt, "pandas",
+                                              getattr(fn, "__name__", "udf"))
+    return UserDefinedFunction(f, rt, "pandas", getattr(f, "__name__", "udf"))
+
+
+def _parse_rt(t) -> dt.DataType:
+    if isinstance(t, dt.DataType):
+        return t
+    from ..sql import parse_data_type
+    return parse_data_type(str(t))
+
+
+class UDFRegistry:
+    """session.udf — named UDF registration for SQL."""
+
+    def __init__(self):
+        self._udfs = {}
+
+    def register(self, name: str, f, returnType=None) -> UserDefinedFunction:
+        if isinstance(f, UserDefinedFunction):
+            u = f
+        else:
+            u = UserDefinedFunction(f, _parse_rt(returnType)
+                                    if returnType is not None else dt.StringType(),
+                                    "batch", name)
+        self._udfs[name.lower()] = u
+        return u
+
+    def get(self, name: str) -> Optional[UserDefinedFunction]:
+        return self._udfs.get(name.lower())

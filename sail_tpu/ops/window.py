@@ -1,0 +1,281 @@
+"""Window function kernels (sort + segmented prefix scans).
+
+Reference role: sail-function's window functions + DataFusion's
+WindowAggExec (SURVEY.md §2.6). TPU-first design: one sort by
+(partition keys, order keys), then every window function is a segmented
+scan/gather over the sorted order — cumulative sums with segment-start
+subtraction for running aggregates, rank arithmetic from segment offsets —
+followed by an inverse-permutation gather to restore row order. No
+per-partition loops; everything is O(n log n) sort + O(n) scans.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import Column
+from ..spec import data_type as dt
+from .sort import lexsort_perm, order_bits
+
+
+class WindowContext:
+    """Sorted row order + partition segmentation, shared by all windows
+    with the same (partition_by, order_by)."""
+
+    def __init__(self, perm, inv_perm, seg_start, seg_len, pos_in_seg,
+                 alive_sorted):
+        self.perm = perm                  # sorted order (alive rows first)
+        self.inv_perm = inv_perm          # original position ← sorted position
+        self.seg_start = seg_start        # int32[n] start index of row's segment
+        self.seg_len = seg_len            # int32[n]
+        self.pos = pos_in_seg             # int32[n] 0-based position in segment
+        self.alive = alive_sorted
+
+
+def build_window_context(partition_cols: Sequence[Column],
+                         order_keys: Sequence[Tuple], sel) -> WindowContext:
+    """order_keys: (data, validity, dtype, ascending, nulls_first) tuples."""
+    n = sel.shape[0]
+    keys = []
+    for c in partition_cols:
+        keys.append((c.data, c.validity, c.dtype, True, None))
+    keys.extend(order_keys)
+    perm = lexsort_perm(keys, sel) if keys else jnp.arange(n, dtype=jnp.int32)
+    if keys == [] and sel is not None:
+        from .sort import compact_perm
+        perm = compact_perm(sel)
+    alive = sel[perm]
+    # new segment when any partition key changes (among alive rows)
+    new_seg = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
+    for c in partition_cols:
+        d = c.data[perm]
+        prev = jnp.roll(d, 1)
+        diff = d != prev
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            diff = diff & ~(jnp.isnan(d) & jnp.isnan(prev))
+        if c.validity is not None:
+            v = c.validity[perm]
+            pv = jnp.roll(v, 1)
+            diff = diff | (v != pv)
+        new_seg = new_seg | diff
+    new_seg = new_seg.at[0].set(True)
+    # dead rows sort last; give them their own segment start
+    seg_id = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
+    idx = jnp.arange(n, dtype=jnp.int32)
+    seg_start = jax.ops.segment_min(idx, seg_id, num_segments=n)
+    seg_start = seg_start[seg_id]
+    seg_end = jax.ops.segment_max(idx, seg_id, num_segments=n)
+    seg_end = seg_end[seg_id]
+    # clip segment to alive prefix
+    alive_count = jnp.sum(alive.astype(jnp.int32))
+    seg_end = jnp.minimum(seg_end, alive_count - 1)
+    seg_len = jnp.maximum(seg_end - seg_start + 1, 0)
+    pos = idx - seg_start
+    inv_perm = jnp.zeros(n, dtype=jnp.int32).at[perm].set(idx)
+    return WindowContext(perm, inv_perm, seg_start, seg_len, pos, alive)
+
+
+def _unsort(ctx: WindowContext, sorted_vals):
+    return sorted_vals[ctx.inv_perm]
+
+
+def _peer_group_start(ctx: WindowContext, order_key_bits) -> jnp.ndarray:
+    """First position of each row's peer group (equal order keys)."""
+    n = ctx.pos.shape[0]
+    if not order_key_bits:
+        return ctx.seg_start
+    change = jnp.zeros(n, dtype=jnp.bool_)
+    for bits in order_key_bits:
+        change = change | (bits != jnp.roll(bits, 1))
+    change = change | (ctx.pos == 0)
+    change = change.at[0].set(True)
+    grp = jnp.cumsum(change.astype(jnp.int32)) - 1
+    idx = jnp.arange(n, dtype=jnp.int32)
+    start = jax.ops.segment_min(idx, grp, num_segments=n)
+    return start[grp]
+
+
+def row_number(ctx: WindowContext) -> jnp.ndarray:
+    return _unsort(ctx, ctx.pos.astype(jnp.int64) + 1)
+
+
+def rank(ctx: WindowContext, order_key_bits) -> jnp.ndarray:
+    start = _peer_group_start(ctx, order_key_bits)
+    return _unsort(ctx, (start - ctx.seg_start).astype(jnp.int64) + 1)
+
+
+def dense_rank(ctx: WindowContext, order_key_bits) -> jnp.ndarray:
+    n = ctx.pos.shape[0]
+    start = _peer_group_start(ctx, order_key_bits)
+    # count distinct peer groups up to and including this row's, per segment
+    firsts = (jnp.arange(n, dtype=jnp.int32) == start).astype(jnp.int64)
+    cum = jnp.cumsum(firsts)
+    seg_first_cum = cum[ctx.seg_start] - firsts[ctx.seg_start]
+    return _unsort(ctx, cum - seg_first_cum)
+
+
+def peer_group_end(ctx: WindowContext, order_key_bits) -> jnp.ndarray:
+    """Last position of each row's peer group (for RANGE frames)."""
+    n = ctx.pos.shape[0]
+    start = _peer_group_start(ctx, order_key_bits)
+    grp_change = jnp.arange(n, dtype=jnp.int32) == start
+    grp = jnp.cumsum(grp_change.astype(jnp.int32)) - 1
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return jax.ops.segment_max(idx, grp, num_segments=n)[grp]
+
+
+def percent_rank(ctx: WindowContext, order_key_bits) -> jnp.ndarray:
+    start = _peer_group_start(ctx, order_key_bits)
+    r = (start - ctx.seg_start).astype(jnp.float64)
+    denom = jnp.maximum(ctx.seg_len - 1, 1).astype(jnp.float64)
+    return _unsort(ctx, jnp.where(ctx.seg_len > 1, r / denom, 0.0))
+
+
+def cume_dist(ctx: WindowContext, order_key_bits) -> jnp.ndarray:
+    # peers share the HIGHEST position of the peer group
+    n = ctx.pos.shape[0]
+    start = _peer_group_start(ctx, order_key_bits)
+    grp_change = jnp.arange(n, dtype=jnp.int32) == start
+    grp = jnp.cumsum(grp_change.astype(jnp.int32)) - 1
+    idx = jnp.arange(n, dtype=jnp.int32)
+    grp_end = jax.ops.segment_max(idx, grp, num_segments=n)[grp]
+    return _unsort(ctx, (grp_end - ctx.seg_start + 1).astype(jnp.float64)
+                   / jnp.maximum(ctx.seg_len, 1).astype(jnp.float64))
+
+
+def ntile(ctx: WindowContext, n_tiles: int) -> jnp.ndarray:
+    sl = jnp.maximum(ctx.seg_len, 1).astype(jnp.int64)
+    pos = ctx.pos.astype(jnp.int64)
+    base = sl // n_tiles
+    rem = sl % n_tiles
+    # first `rem` tiles have base+1 rows
+    big = rem * (base + 1)
+    tile = jnp.where(pos < big,
+                     pos // jnp.maximum(base + 1, 1),
+                     rem + (pos - big) // jnp.maximum(base, 1))
+    return _unsort(ctx, jnp.clip(tile, 0, n_tiles - 1) + 1)
+
+
+def shift(ctx: WindowContext, value: Column, offset: int, default=None):
+    """lag (offset>0 looks back) / lead (negative looks forward)."""
+    n = ctx.pos.shape[0]
+    sorted_d = value.data[ctx.perm]
+    sorted_v = value.validity[ctx.perm] if value.validity is not None else None
+    idx = jnp.arange(n, dtype=jnp.int32)
+    src = idx - offset
+    in_seg = (src >= ctx.seg_start) & (src < ctx.seg_start + ctx.seg_len)
+    src_c = jnp.clip(src, 0, n - 1)
+    data = sorted_d[src_c]
+    validity = in_seg
+    if sorted_v is not None:
+        validity = validity & sorted_v[src_c]
+    if default is not None:
+        data = jnp.where(in_seg, data, jnp.full_like(data, default))
+        validity = validity | ~in_seg
+    return _unsort(ctx, data), _unsort(ctx, validity)
+
+
+def framed_agg(ctx: WindowContext, value: Optional[Column], fn: str,
+               lower: Optional[int], upper: Optional[int],
+               peer_end=None):
+    """Aggregate over a frame [lower, upper] relative to the current row
+    (None = unbounded). ROWS semantics by default; passing ``peer_end``
+    (from peer_group_end) gives RANGE semantics for the
+    unbounded-preceding..current-row frame — the frame extends to the last
+    peer. Prefix-scan differences for sum/count/avg; segmented doubling
+    scans for unbounded-start min/max.
+    """
+    n = ctx.pos.shape[0]
+    if value is not None:
+        sorted_d = value.data[ctx.perm]
+        sorted_v = value.validity[ctx.perm] if value.validity is not None \
+            else None
+        valid = ctx.alive if sorted_v is None else (ctx.alive & sorted_v)
+    else:
+        sorted_d = jnp.ones(n, dtype=jnp.int64)
+        sorted_v = None
+        valid = ctx.alive
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    seg_end = ctx.seg_start + ctx.seg_len - 1
+    lo = ctx.seg_start if lower is None else jnp.maximum(idx + lower, ctx.seg_start)
+    if peer_end is not None and upper == 0:
+        hi = jnp.minimum(peer_end, seg_end)
+    else:
+        hi = seg_end if upper is None else jnp.minimum(idx + upper, seg_end)
+    empty = hi < lo
+
+    if fn in ("sum", "count", "avg"):
+        vals = jnp.where(valid, sorted_d, 0).astype(
+            jnp.float64 if jnp.issubdtype(sorted_d.dtype, jnp.floating)
+            else jnp.int64)
+        csum = jnp.cumsum(vals)
+        ccnt = jnp.cumsum(valid.astype(jnp.int64))
+
+        def range_sum(c):
+            hi_c = jnp.clip(hi, 0, n - 1)
+            lo_c = jnp.clip(lo, 0, n - 1)
+            return c[hi_c] - jnp.where(lo_c > 0, c[lo_c - 1], 0)
+
+        s = range_sum(csum)
+        cnt = range_sum(ccnt)
+        if fn == "count":
+            return _unsort(ctx, jnp.where(empty, 0, cnt)), None
+        valid_out = (cnt > 0) & ~empty
+        if fn == "avg":
+            out = s.astype(jnp.float64) / jnp.maximum(cnt, 1)
+            return _unsort(ctx, out), _unsort(ctx, valid_out)
+        return _unsort(ctx, s), _unsort(ctx, valid_out)
+
+    if fn in ("min", "max"):
+        is_min = fn == "min"
+        if jnp.issubdtype(sorted_d.dtype, jnp.floating):
+            fill = jnp.inf if is_min else -jnp.inf
+        else:
+            info = jnp.iinfo(sorted_d.dtype)
+            fill = info.max if is_min else info.min
+        masked = jnp.where(valid, sorted_d, fill)
+        if lower is None and (upper is None or upper == 0):
+            # running extreme from segment start: segmented cummin/cummax
+            run = _segmented_scan(masked, ctx.seg_start, is_min)
+            # value at the frame end (segment end / peer end / current row)
+            out = run[jnp.clip(hi, 0, n - 1)]
+            cnt = _segment_count(valid, ctx, lo, hi, n)
+            return _unsort(ctx, out), _unsort(ctx, (cnt > 0) & ~empty)
+        raise NotImplementedError("bounded min/max window frames")
+
+    if fn in ("first", "last"):
+        pos_idx = lo if fn == "first" else hi
+        pos_c = jnp.clip(pos_idx, 0, n - 1)
+        data = sorted_d[pos_c]
+        v = ~empty
+        if value is not None and sorted_v is not None:
+            v = v & sorted_v[pos_c]
+        return _unsort(ctx, data), _unsort(ctx, v)
+
+    raise NotImplementedError(f"window aggregate {fn!r}")
+
+
+def _segment_count(valid, ctx, lo, hi, n):
+    ccnt = jnp.cumsum(valid.astype(jnp.int64))
+    hi_c = jnp.clip(hi, 0, n - 1)
+    lo_c = jnp.clip(lo, 0, n - 1)
+    return ccnt[hi_c] - jnp.where(lo_c > 0, ccnt[lo_c - 1], 0)
+
+
+def _segmented_scan(vals, seg_start, is_min: bool):
+    """Segmented running min/max: out[i] = extreme(vals[seg_start[i]..i]).
+    Hillis–Steele doubling scan (log2(n) vector steps) with segment-boundary
+    masking — maps to pure VPU element-wise ops on TPU."""
+    n = vals.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    out = vals
+    step = 1
+    while step < n:
+        prev = jnp.where(idx - step >= seg_start, jnp.roll(out, step), out)
+        out = jnp.minimum(out, prev) if is_min else jnp.maximum(out, prev)
+        step *= 2
+    return out

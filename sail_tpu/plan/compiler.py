@@ -110,6 +110,9 @@ class ExprCompiler:
             return self._compile_call(r)
         raise TypeError(f"cannot compile {type(r).__name__}")
 
+    def _compile_udf(self, r: rx.RCall, args: List[Compiled], udf) -> Compiled:
+        return _udf_compile(self, r, args, udf)
+
     # -- literals ---------------------------------------------------------
     def _compile_literal(self, v: LV) -> Compiled:
         d = v.data_type
@@ -285,6 +288,8 @@ class ExprCompiler:
         args = [self.compile(a) for a in r.args]
         name = r.fn
         opts = dict(r.options)
+        if name == "__pyudf":
+            return self._compile_udf(r, args, opts["udf"])
         str_args = [a for a in args if _is_str(a.dtype)]
         if str_args:
             out = self._compile_string_call(name, r, args, opts)
@@ -465,6 +470,120 @@ class ExprCompiler:
             return Compiled(fn7, r.dtype, new_dict)
 
         return None
+
+
+def _udf_compile(compiler: "ExprCompiler", r: rx.RCall, args: List[Compiled],
+                 udf) -> Compiled:
+    """Compile a Python UDF call.
+
+    1. pandas/arrow kinds are traced with jax first: numpy-expressible
+       bodies fuse into the device pipeline (zero host round-trips).
+    2. Otherwise the call lowers to jax.pure_callback: the host runs the
+       Python function on numpy batches (row loop for classic udfs, Series
+       for pandas udfs) while the rest of the query stays jitted. String
+       arguments are decoded through the bind-time dictionary.
+    """
+    out_t = udf.return_type
+    if _is_str(out_t):
+        raise HostFallback("string-returning Python UDFs need host projection")
+    out_jdt = physical_jnp_dtype(out_t)
+
+    def descale(a: Compiled, d):
+        if isinstance(a.dtype, dt.DecimalType) and a.dtype.physical_dtype == "int64":
+            return d.astype(jnp.float64) / (10.0 ** a.dtype.scale)
+        return d
+
+    if udf.eval_type in ("pandas", "arrow"):
+        def dev_fn(cols):
+            vals = []
+            validity = None
+            for a in args:
+                d, v = a.fn(cols)
+                vals.append(descale(a, d))
+                validity = K.merge_validity(validity, v)
+            out = udf.func(*vals)
+            out = jnp.asarray(out)
+            return out.astype(out_jdt), validity
+
+        try:
+            n = 8
+            dummy = [(jnp.zeros(n, dtype=physical_jnp_dtype(a.dtype)
+                                if a.dtype.physical_dtype else jnp.int32),
+                      None) for a in args]
+            shape = jax.eval_shape(lambda: dev_fn(dummy)[0])
+            if tuple(shape.shape) == (n,):
+                return Compiled(dev_fn, out_t)
+        except Exception:
+            pass
+
+    # host callback path
+    arg_decoders = []
+    for a in args:
+        if _is_str(a.dtype):
+            arg_decoders.append(("str", _dict_strings(a.dictionary)))
+        elif isinstance(a.dtype, dt.DecimalType) and a.dtype.physical_dtype == "int64":
+            arg_decoders.append(("dec", a.dtype.scale))
+        elif isinstance(a.dtype, dt.DateType):
+            arg_decoders.append(("date", None))
+        elif isinstance(a.dtype, dt.TimestampType):
+            arg_decoders.append(("ts", None))
+        else:
+            arg_decoders.append(("num", None))
+    out_np = np.dtype(out_jdt)
+
+    def host_cb(*flat):
+        k = len(args)
+        datas, valids = flat[:k], flat[k:]
+        cols_py = []
+        for (kind, aux), d, v in zip(arg_decoders, datas, valids):
+            if kind == "str":
+                vals = [aux[int(c)] if ok else None for c, ok in zip(d, v)]
+            elif kind == "dec":
+                vals = [float(x) / (10 ** aux) if ok else None
+                        for x, ok in zip(d, v)]
+            elif kind == "date":
+                vals = [datetime.date(1970, 1, 1) + datetime.timedelta(days=int(x))
+                        if ok else None for x, ok in zip(d, v)]
+            elif kind == "ts":
+                vals = [datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=int(x))
+                        if ok else None for x, ok in zip(d, v)]
+            else:
+                vals = [d[i].item() if v[i] else None for i in range(len(d))]
+            cols_py.append(vals)
+        n = len(datas[0]) if datas else 0
+        if udf.eval_type == "pandas":
+            import pandas as pd
+            series = [pd.Series(c) for c in cols_py]
+            res = udf.func(*series)
+            res_list = list(res)
+        else:
+            res_list = [udf.func(*vals) for vals in zip(*cols_py)] if cols_py \
+                else [udf.func() for _ in range(n)]
+        out = np.zeros(n, dtype=out_np)
+        mask = np.zeros(n, dtype=bool)
+        for i, v in enumerate(res_list):
+            if v is not None and v == v:  # skip None/NaN-as-null
+                out[i] = v
+                mask[i] = True
+        return out, mask
+
+    def fn(cols):
+        datas = []
+        valids = []
+        for a in args:
+            d, v = a.fn(cols)
+            datas.append(d)
+            valids.append(v if v is not None
+                          else jnp.ones(d.shape[0], dtype=jnp.bool_))
+        n = datas[0].shape[0] if datas else (cols[0][0].shape[0] if cols else 1)
+        out, mask = jax.pure_callback(
+            host_cb,
+            (jax.ShapeDtypeStruct((n,), out_jdt),
+             jax.ShapeDtypeStruct((n,), jnp.bool_)),
+            *datas, *valids)
+        return out, mask
+
+    return Compiled(fn, out_t)
 
 
 class HostFallback(Exception):

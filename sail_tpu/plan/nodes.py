@@ -261,6 +261,7 @@ class WindowSpec:
     frame_lower: Optional[int] = None
     frame_upper: Optional[int] = 0
     out_dtype: dt.DataType = field(default_factory=dt.LongType)
+    options: Tuple[Tuple[str, object], ...] = ()  # lag/lead offset, ntile n, …
 
 
 @dataclass(frozen=True)

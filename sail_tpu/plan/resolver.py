@@ -472,6 +472,8 @@ class Resolver:
         child, cscope = self.resolve_query(plan.input, scope, outer) \
             if plan.input is not None else (pn.OneRowExec(), Scope([], outer, {}))
         items = self._expand_star(plan.expressions, cscope)
+        if any(_has_window(e) for e in items):
+            return self._resolve_window_project(items, child, cscope, outer)
         # implicit global aggregate: SELECT sum(x) FROM t
         if any(_has_aggregate(e) for e in items):
             agg = sp.Aggregate(plan.input if plan.input is not None else sp.OneRow(),
@@ -486,6 +488,189 @@ class Resolver:
             exprs.append((name, r))
             fields.append(ScopeField(name, (), rx.rex_type(r), rx.rex_nullable(r)))
         node = pn.ProjectExec(child, tuple(exprs))
+        out_scope = Scope(fields, outer, cscope.ctes)
+        out_scope.below = cscope
+        return node, out_scope
+
+    def _resolve_window_project(self, items, child: pn.PlanNode, cscope: Scope,
+                                outer):
+        """SELECT items containing window expressions: pre-project the
+        partition/order/arg columns, run WindowExec, post-project."""
+        n_child = len(child.schema)
+        pre_exprs: List[Tuple[str, rx.Rex]] = [
+            (f.name, rx.BoundRef(i, f.name, f.dtype, f.nullable))
+            for i, f in enumerate(child.schema)]
+
+        def add_pre(r: rx.Rex) -> int:
+            for i, (_, e) in enumerate(pre_exprs):
+                if e == r:
+                    return i
+            pre_exprs.append((_fresh("w"), r))
+            return len(pre_exprs) - 1
+
+        specs: List[pn.WindowSpec] = []
+        spec_index: Dict[ex.Window, int] = {}
+
+        def make_spec(w: ex.Window) -> int:
+            if w in spec_index:
+                return spec_index[w]
+            part_idx = tuple(add_pre(self._resolve_expr(p, cscope))
+                             for p in w.partition_by)
+            order_keys = []
+            for so in w.order_by:
+                r = self._resolve_expr(so.child, cscope)
+                order_keys.append(pn.SortKey(
+                    rx.BoundRef(add_pre(r), "", rx.rex_type(r), rx.rex_nullable(r)),
+                    so.ascending, so.nulls_first))
+            f = w.function
+            assert isinstance(f, ex.Function)
+            fname = f.name.lower()
+            arg_i = None
+            options: List[Tuple[str, object]] = []
+            out_t: dt.DataType
+            if fname in ("row_number", "rank", "dense_rank"):
+                out_t = dt.LongType()
+            elif fname in ("percent_rank", "cume_dist"):
+                out_t = dt.DoubleType()
+            elif fname == "ntile":
+                out_t = dt.LongType()
+                nt = f.args[0]
+                if not isinstance(nt, ex.Literal):
+                    raise ResolutionError("ntile() requires a literal bucket count")
+                n_tiles = int(nt.value.value)
+                if n_tiles <= 0:
+                    raise ResolutionError(
+                        f"ntile() bucket count must be positive, got {n_tiles}")
+                options.append(("n", n_tiles))
+            elif fname in ("lag", "lead"):
+                arg = self._resolve_expr(f.args[0], cscope)
+                arg_i = add_pre(arg)
+                out_t = rx.rex_type(arg)
+                offset = 1
+                if len(f.args) > 1:
+                    if not isinstance(f.args[1], ex.Literal):
+                        raise ResolutionError(
+                            f"{fname}() offset must be a literal")
+                    offset = int(f.args[1].value.value)
+                default = None
+                if len(f.args) > 2:
+                    if not isinstance(f.args[2], ex.Literal):
+                        raise ResolutionError(
+                            f"{fname}() default must be a literal")
+                    default = f.args[2].value.value
+                options.append(("offset", offset if fname == "lag" else -offset))
+                options.append(("default", default))
+            elif fname in ("sum", "count", "min", "max", "avg", "mean",
+                           "first", "last", "first_value", "last_value"):
+                canon = {"mean": "avg", "first_value": "first",
+                         "last_value": "last"}.get(fname, fname)
+                fname = canon
+                if f.args and not isinstance(f.args[0], ex.Star):
+                    arg = self._resolve_expr(f.args[0], cscope)
+                    arg_i = add_pre(arg)
+                    at = rx.rex_type(arg)
+                else:
+                    at = dt.LongType()
+                out_t = freg.aggregate_result_type(
+                    "avg" if canon == "avg" else canon, at)
+            else:
+                raise ResolutionError(f"window function {fname!r} not supported")
+            frame_type = "rows"
+            lower: Optional[int] = None
+            upper: Optional[int] = 0
+            if w.frame is not None:
+                frame_type = w.frame.frame_type
+                lower, upper = w.frame.lower, w.frame.upper
+            elif fname in ("sum", "count", "min", "max", "avg", "first",
+                           "last"):
+                if not w.order_by:
+                    upper = None  # whole partition when no ORDER BY
+                else:
+                    frame_type = "range"  # Spark default frame is RANGE
+            specs.append(pn.WindowSpec(fname, arg_i, part_idx,
+                                       tuple(order_keys), frame_type, lower,
+                                       upper, out_t, tuple(options)))
+            spec_index[w] = len(specs) - 1
+            return len(specs) - 1
+
+        # first pass: allocate all specs
+        def scan(e: ex.Expr):
+            if isinstance(e, ex.Window):
+                make_spec(e)
+            elif isinstance(e, ex.Alias):
+                scan(e.child)
+            elif isinstance(e, ex.Function):
+                for a in e.args:
+                    scan(a)
+            elif isinstance(e, ex.Cast):
+                scan(e.child)
+            elif isinstance(e, ex.CaseWhen):
+                for c, v in e.branches:
+                    scan(c)
+                    scan(v)
+                if e.else_value is not None:
+                    scan(e.else_value)
+
+        for it in items:
+            scan(it)
+        pre_node = pn.ProjectExec(child, tuple(pre_exprs))
+        win_node = pn.WindowExec(pre_node, tuple(specs),
+                                 tuple(_fresh("wout") for _ in specs))
+        n_pre = len(pre_exprs)
+
+        # second pass: resolve items with Window → BoundRef substitution
+        win_scope = Scope(list(cscope.fields), outer, cscope.ctes)
+
+        def resolve_with_windows(e: ex.Expr) -> rx.Rex:
+            if isinstance(e, ex.Window):
+                i = spec_index[e]
+                s = specs[i]
+                return rx.BoundRef(n_pre + i, win_node.out_names[i],
+                                   s.out_dtype, True)
+            if isinstance(e, ex.Alias):
+                return resolve_with_windows(e.child)
+            if isinstance(e, ex.Function) and not freg.is_aggregate(e.name):
+                args = [resolve_with_windows(a) for a in e.args]
+                return self._finish_function(e.name, args)
+            if isinstance(e, ex.Cast):
+                return rx.RCast(resolve_with_windows(e.child), e.data_type, e.try_)
+            if isinstance(e, ex.CaseWhen):
+                branches = tuple((resolve_with_windows(c), resolve_with_windows(v))
+                                 for c, v in e.branches)
+                relse = resolve_with_windows(e.else_value) \
+                    if e.else_value is not None else None
+                vt = [rx.rex_type(v) for _, v in branches]
+                if relse is not None:
+                    vt.append(rx.rex_type(relse))
+                out_t = vt[0]
+                for t in vt[1:]:
+                    if not isinstance(t, dt.NullType):
+                        out_t = t if isinstance(out_t, dt.NullType) \
+                            else dt.common_type(out_t, t)
+                return rx.RCase(branches, relse, out_t, True)
+            if isinstance(e, ex.Between):
+                child_r = resolve_with_windows(e.child)
+                low = resolve_with_windows(e.low)
+                high = resolve_with_windows(e.high)
+                r = self._make_call("and",
+                                    [self._make_call(">=", [child_r, low]),
+                                     self._make_call("<=", [child_r, high])])
+                return self._make_call("not", [r]) if e.negated else r
+            if isinstance(e, ex.InList):
+                child_r = resolve_with_windows(e.child)
+                vals = [resolve_with_windows(v) for v in e.values]
+                r = rx.RCall("in", tuple([child_r] + vals), dt.BooleanType(), True)
+                return self._make_call("not", [r]) if e.negated else r
+            return self._resolve_expr(e, cscope)
+
+        post = []
+        fields = []
+        for it in items:
+            name = self._output_name(it)
+            r = resolve_with_windows(_unalias(it))
+            post.append((name, r))
+            fields.append(ScopeField(name, (), rx.rex_type(r), rx.rex_nullable(r)))
+        node = pn.ProjectExec(win_node, tuple(post))
         out_scope = Scope(fields, outer, cscope.ctes)
         out_scope.below = cscope
         return node, out_scope
@@ -884,6 +1069,11 @@ class Resolver:
                                   "window planner (not yet reachable here)")
         if isinstance(e, ex.Function):
             return self._resolve_function(e, scope)
+        from ..functions.udf import UdfExpr
+        if isinstance(e, UdfExpr):
+            args = tuple(self._resolve_expr(a, scope) for a in e.args)
+            return rx.RCall("__pyudf", args, e.udf.return_type, True,
+                            (("udf", e.udf),))
         raise ResolutionError(f"unsupported expression {type(e).__name__}")
 
     def _resolve_attribute(self, e: ex.Attribute, scope: Scope) -> rx.Rex:
@@ -947,7 +1137,12 @@ class Resolver:
             raise ResolutionError(
                 f"aggregate function {name}() used outside aggregation context")
         args = [self._resolve_expr(a, scope) for a in e.args]
-        # rewrites
+        return self._finish_function(name, args)
+
+    def _finish_function(self, name: str, args: List[rx.Rex]) -> rx.Rex:
+        """Name rewrites + UDF lookup + typed call construction (shared by
+        the plain and window-aware expression resolvers)."""
+        name = name.lower()
         if name in ("nvl", "ifnull"):
             name = "coalesce"
         if name == "substr":
@@ -958,6 +1153,13 @@ class Resolver:
             name = "instr"
         if name in ("date_format",):
             raise ResolutionError("date_format not yet supported")
+        # named SQL UDFs
+        u = getattr(self.catalog, "udfs", None)
+        if u is not None:
+            found = u.get(name)
+            if found is not None:
+                return rx.RCall("__pyudf", tuple(args), found.return_type, True,
+                                (("udf", found),))
         return self._make_call(name, args)
 
 
@@ -1156,6 +1358,21 @@ def _and_rex(parts: List[rx.Rex]) -> rx.Rex:
     for p in parts[1:]:
         out = rx.RCall("and", (out, p), dt.BooleanType(), True)
     return out
+
+
+def _has_window(e: ex.Expr) -> bool:
+    if isinstance(e, ex.Window):
+        return True
+    if isinstance(e, ex.Alias):
+        return _has_window(e.child)
+    if isinstance(e, ex.Cast):
+        return _has_window(e.child)
+    if isinstance(e, ex.Function):
+        return any(_has_window(a) for a in e.args)
+    if isinstance(e, ex.CaseWhen):
+        return any(_has_window(c) or _has_window(v) for c, v in e.branches) \
+            or (e.else_value is not None and _has_window(e.else_value))
+    return False
 
 
 def _has_aggregate(e: ex.Expr) -> bool:

@@ -55,6 +55,7 @@ class SparkSession:
         from .exec.local import LocalExecutor
         self._executor_cls = LocalExecutor
         self.catalog = Catalog(self)
+        self.udf = self.catalog_manager.udfs
 
     # -- plan execution ----------------------------------------------------
     def _resolve(self, plan: sp.QueryPlan):

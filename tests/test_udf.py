@@ -1,0 +1,80 @@
+"""Python UDF tests: traced-on-device pandas_udfs, callback classic udfs."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession, col, pandas_udf, udf
+from sail_tpu.spec import data_type as dt
+
+
+@pytest.fixture(scope="module")
+def spark():
+    s = SparkSession({})
+    df = pd.DataFrame({"x": np.arange(50, dtype=np.int64),
+                       "y": np.linspace(0, 1, 50),
+                       "s": [f"v{i%5}" for i in range(50)]})
+    s.createDataFrame(df).createOrReplaceTempView("t")
+    return s
+
+
+def test_pandas_udf_traced_on_device(spark):
+    @pandas_udf(returnType=dt.DoubleType())
+    def sigmoid(x):
+        return 1.0 / (1.0 + np.exp(-x))
+
+    d = spark.table("t").select(sigmoid(col("y")).alias("sg"), col("y"))
+    out = d.toPandas()
+    np.testing.assert_allclose(out.sg, 1.0 / (1.0 + np.exp(-out.y)), rtol=1e-12)
+
+
+def test_classic_udf_callback(spark):
+    @udf(returnType=dt.LongType())
+    def weird(x, s):
+        if s == "v0":
+            return None
+        return x * len(s)
+
+    out = spark.table("t").select(col("x"), col("s"),
+                                  weird(col("x"), col("s")).alias("w")).toPandas()
+    exp = [None if s == "v0" else x * len(s) for x, s in zip(out.x, out.s)]
+    assert [None if pd.isna(v) else int(v) for v in out.w] == exp
+
+
+def test_sql_registered_udf(spark):
+    spark.udf.register("plus_one", lambda x: x + 1, dt.LongType())
+    out = spark.sql("SELECT plus_one(x) AS p FROM t ORDER BY x LIMIT 3").toPandas()
+    assert out.p.tolist() == [1, 2, 3]
+
+
+def test_pandas_udf_fallback_to_callback(spark):
+    @pandas_udf(returnType=dt.DoubleType())
+    def uses_pandas_api(y):
+        return y.rolling(1).mean()  # pandas-only API -> not traceable
+
+    out = spark.table("t").select(uses_pandas_api(col("y")).alias("m"),
+                                  col("y")).toPandas()
+    np.testing.assert_allclose(out.m, out.y)
+
+
+def test_pandas_udf_logistic_regression_step(spark):
+    # the BASELINE.json config: a jax-traceable model step as a pandas_udf
+    w, b = 2.5, -1.0
+
+    @pandas_udf(returnType=dt.DoubleType())
+    def predict(x):
+        return 1.0 / (1.0 + np.exp(-(w * x + b)))
+
+    out = spark.sql("SELECT y FROM t").sparkSession.table("t") \
+        .select(predict(col("y")).alias("p"), col("y")).toPandas()
+    np.testing.assert_allclose(out.p, 1 / (1 + np.exp(-(w * out.y + b))), rtol=1e-12)
+
+
+def test_string_returning_udf_host_path(spark):
+    @udf(returnType=dt.StringType())
+    def label(x):
+        return None if x % 10 == 3 else f"n{x % 4}"
+
+    out = spark.table("t").select(col("x"), label(col("x")).alias("l")).toPandas()
+    exp = [None if x % 10 == 3 else f"n{x % 4}" for x in out.x]
+    assert [None if pd.isna(v) else v for v in out.l] == exp

@@ -1,0 +1,163 @@
+"""Window function tests vs pandas."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession
+
+
+@pytest.fixture(scope="module")
+def spark():
+    s = SparkSession({})
+    rng = np.random.default_rng(5)
+    n = 400
+    df = pd.DataFrame({
+        "g": rng.choice(["a", "b", "c"], n),
+        "o": rng.permutation(n),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+        "f": np.where(rng.random(n) < 0.1, np.nan, rng.normal(size=n)),
+    })
+    s.createDataFrame(df).createOrReplaceTempView("t")
+    return s, df
+
+
+def test_row_number_rank(spark):
+    s, df = spark
+    got = s.sql("""SELECT g, o, row_number() OVER (PARTITION BY g ORDER BY o) AS rn,
+                          rank() OVER (PARTITION BY g ORDER BY v) AS rk,
+                          dense_rank() OVER (PARTITION BY g ORDER BY v) AS dr
+                   FROM t ORDER BY g, o""").toPandas()
+    exp = df.copy()
+    exp["rn"] = exp.groupby("g")["o"].rank(method="first").astype(np.int64)
+    exp["rk"] = exp.groupby("g")["v"].rank(method="min").astype(np.int64)
+    exp["dr"] = exp.groupby("g")["v"].rank(method="dense").astype(np.int64)
+    exp = exp.sort_values(["g", "o"]).reset_index(drop=True)
+    np.testing.assert_array_equal(got.rn, exp.rn)
+    np.testing.assert_array_equal(got.rk, exp.rk)
+    np.testing.assert_array_equal(got.dr, exp.dr)
+
+
+def test_running_and_partition_aggregates(spark):
+    s, df = spark
+    got = s.sql("""SELECT g, o,
+                          sum(v) OVER (PARTITION BY g ORDER BY o) AS rsum,
+                          sum(v) OVER (PARTITION BY g) AS psum,
+                          count(*) OVER (PARTITION BY g ORDER BY o) AS rcnt,
+                          avg(v) OVER (PARTITION BY g ORDER BY o) AS ravg,
+                          min(v) OVER (PARTITION BY g ORDER BY o) AS rmin,
+                          max(v) OVER (PARTITION BY g ORDER BY o) AS rmax
+                   FROM t ORDER BY g, o""").toPandas()
+    exp = df.sort_values(["g", "o"]).reset_index(drop=True)
+    grp = exp.groupby("g")["v"]
+    np.testing.assert_array_equal(got.rsum, grp.cumsum())
+    np.testing.assert_array_equal(got.psum, grp.transform("sum"))
+    np.testing.assert_array_equal(got.rcnt, grp.cumcount() + 1)
+    np.testing.assert_allclose(got.ravg, grp.cumsum() / (grp.cumcount() + 1))
+    np.testing.assert_array_equal(got.rmin, grp.cummin())
+    np.testing.assert_array_equal(got.rmax, grp.cummax())
+
+
+def test_lag_lead(spark):
+    s, df = spark
+    got = s.sql("""SELECT g, o, lag(v) OVER (PARTITION BY g ORDER BY o) AS lg,
+                          lead(v, 2) OVER (PARTITION BY g ORDER BY o) AS ld,
+                          lag(v, 1, -1) OVER (PARTITION BY g ORDER BY o) AS lgd
+                   FROM t ORDER BY g, o""").toPandas()
+    exp = df.sort_values(["g", "o"]).reset_index(drop=True)
+    np.testing.assert_array_equal(got.lg.fillna(-999),
+                                  exp.groupby("g")["v"].shift(1).fillna(-999))
+    np.testing.assert_array_equal(got.ld.fillna(-999),
+                                  exp.groupby("g")["v"].shift(-2).fillna(-999))
+    np.testing.assert_array_equal(got.lgd,
+                                  exp.groupby("g")["v"].shift(1).fillna(-1))
+
+
+def test_rows_between_frame(spark):
+    s, df = spark
+    got = s.sql("""SELECT g, o,
+                     sum(v) OVER (PARTITION BY g ORDER BY o
+                                  ROWS BETWEEN 2 PRECEDING AND 1 FOLLOWING) AS ws
+                   FROM t ORDER BY g, o""").toPandas()
+    exp = df.sort_values(["g", "o"]).reset_index(drop=True)
+    exp["ws"] = exp.groupby("g")["v"].transform(
+        lambda x: x.rolling(4, min_periods=1).sum().shift(-1).combine_first(
+            x.rolling(3, min_periods=1).sum()))
+    # simpler oracle: explicit loop
+    out = []
+    for _, grp in exp.groupby("g", sort=False):
+        vals = grp["v"].tolist()
+        for i in range(len(vals)):
+            out.append(sum(vals[max(0, i - 2): i + 2]))
+    exp["ws2"] = out
+    np.testing.assert_array_equal(got.ws, exp.ws2)
+
+
+def test_ntile_percent_rank(spark):
+    s, df = spark
+    got = s.sql("""SELECT g, o, ntile(4) OVER (PARTITION BY g ORDER BY o) AS nt,
+                          percent_rank() OVER (PARTITION BY g ORDER BY o) AS pr,
+                          cume_dist() OVER (PARTITION BY g ORDER BY o) AS cd
+                   FROM t ORDER BY g, o""").toPandas()
+    exp = df.sort_values(["g", "o"]).reset_index(drop=True)
+    for _, grp in exp.groupby("g"):
+        n = len(grp)
+        idx = got.set_index(["g", "o"]).loc[
+            list(zip(grp.g, grp.o))]
+        ranks = np.arange(n)
+        np.testing.assert_allclose(idx.pr.values, ranks / (n - 1))
+        np.testing.assert_allclose(idx.cd.values, (ranks + 1) / n)
+        sizes = np.bincount(idx.nt.values - 1, minlength=4)
+        assert sizes.max() - sizes.min() <= 1
+
+
+def test_window_expression_arithmetic(spark):
+    s, df = spark
+    got = s.sql("""SELECT g, v, v - avg(v) OVER (PARTITION BY g) AS dev
+                   FROM t ORDER BY g, o""").toPandas()
+    exp = df.sort_values(["g", "o"]).reset_index(drop=True)
+    np.testing.assert_allclose(
+        got.dev, exp.v - exp.groupby("g")["v"].transform("mean"), rtol=1e-12)
+
+
+def test_range_default_frame_with_ties(spark):
+    s, _ = spark
+    import pandas as pd
+    s.createDataFrame(pd.DataFrame({"g": ["x"]*4, "o": [1, 1, 2, 2],
+                                    "v": [10, 20, 30, 40]})) \
+        .createOrReplaceTempView("ties")
+    got = s.sql("""SELECT o, sum(v) OVER (PARTITION BY g ORDER BY o) rs
+                   FROM ties ORDER BY o, rs""").toPandas()
+    # Spark default frame is RANGE: peers share the running sum
+    assert got.rs.tolist() == [30, 30, 100, 100]
+
+
+def test_last_value_whole_partition(spark):
+    s, _ = spark
+    import pandas as pd
+    s.createDataFrame(pd.DataFrame({"g": ["a", "a", "b"], "v": [1, 2, 9]})) \
+        .createOrReplaceTempView("lv")
+    got = s.sql("SELECT g, last(v) OVER (PARTITION BY g) lv FROM lv ORDER BY g, v").toPandas()
+    assert got.lv.tolist() == [2, 2, 9]
+
+
+def test_string_min_max_window(spark):
+    s, _ = spark
+    import pandas as pd
+    s.createDataFrame(pd.DataFrame({"g": [1, 1, 2], "n": ["zebra", "apple", "kiwi"]})) \
+        .createOrReplaceTempView("sm")
+    got = s.sql("SELECT g, min(n) OVER (PARTITION BY g) mn, "
+                "max(n) OVER (PARTITION BY g) mx FROM sm ORDER BY g, n").toPandas()
+    assert got.mn.tolist() == ["apple", "apple", "kiwi"]
+    assert got.mx.tolist() == ["zebra", "zebra", "kiwi"]
+
+
+def test_window_in_case_and_with_udf(spark):
+    s, _ = spark
+    from sail_tpu.spec import data_type as dtt
+    s.udf.register("half", lambda x: x // 2, dtt.LongType())
+    got = s.sql("""SELECT half(v) h,
+                          CASE WHEN row_number() OVER (ORDER BY o, g) = 1
+                               THEN 'first' ELSE 'rest' END tag
+                   FROM t ORDER BY o, g LIMIT 2""").toPandas()
+    assert got.tag.tolist()[0] == "first"
