@@ -1,0 +1,96 @@
+"""Minimal actor framework.
+
+Reference role: sail-server's Actor trait + single-threaded message loop
+(crates/sail-server/src/actor.rs:14-99) — the concurrency model for the
+driver and workers: all mutable state lives inside an actor and is touched
+only by its own loop thread; everything else communicates via messages.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Optional
+
+
+class Actor:
+    """Subclass and implement receive(message); spawn with ActorSystem."""
+
+    def __init__(self):
+        self._mailbox: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self.handle = ActorHandle(self)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, name: str = "actor"):
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._thread.start()
+        return self.handle
+
+    def stop(self, join: bool = True):
+        self._stopped.set()
+        self._mailbox.put(_Stop)
+        if join and self._thread is not None and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=10)
+
+    # -- override points -------------------------------------------------
+    def receive(self, message: Any) -> None:
+        raise NotImplementedError
+
+    def on_start(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+    # -- internals -------------------------------------------------------
+    def _loop(self):
+        try:
+            self.on_start()
+        except Exception:
+            traceback.print_exc()
+        while not self._stopped.is_set():
+            msg = self._mailbox.get()
+            if msg is _Stop:
+                break
+            try:
+                self.receive(msg)
+            except Exception:
+                traceback.print_exc()
+        try:
+            self.on_stop()
+        except Exception:
+            traceback.print_exc()
+
+
+class _Stop:
+    pass
+
+
+class ActorHandle:
+    def __init__(self, actor: Actor):
+        self._actor = actor
+
+    def send(self, message: Any) -> None:
+        self._actor._mailbox.put(message)
+
+    def ask(self, make_message: Callable[["_Reply"], Any], timeout: float = 30.0):
+        """Request/response over the mailbox: make_message receives a Reply
+        sink to pass inside the message."""
+        reply = _Reply()
+        self._actor._mailbox.put(make_message(reply))
+        return reply.get(timeout)
+
+
+class _Reply:
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def set(self, value):
+        self._q.put(value)
+
+    def get(self, timeout: float):
+        return self._q.get(timeout=timeout)

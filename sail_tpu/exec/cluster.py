@@ -1,0 +1,379 @@
+"""Driver/worker cluster runtime over gRPC.
+
+Reference role: sail-execution's DriverActor/WorkerActor, worker pool with
+heartbeats, task scheduler with retry, and the RPC services
+(crates/sail-execution/src/driver/, src/worker/ — SURVEY.md §2.5/§3.3).
+v0 shape:
+
+- DriverActor owns the worker registry (heartbeat timestamps, lost-worker
+  probing), the job table, and task scheduling (round-robin over live
+  workers, per-task attempts with retry on worker failure).
+- WorkerActor runs task fragments on its local executor; results return in
+  ReportTaskStatus as Arrow IPC (a Flight-style peer-to-peer stream data
+  plane replaces this for shuffle stages in a later round).
+- Local-cluster mode (the reference's test vehicle) runs driver + workers
+  in threads speaking REAL gRPC over localhost.
+
+Transport: grpc generic handlers over protoc-generated messages
+(sail_tpu/exec/proto/control_plane.proto).
+"""
+
+from __future__ import annotations
+
+import sys
+import os
+import threading
+import time
+import uuid
+from concurrent import futures
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "proto"))
+import control_plane_pb2 as pb  # noqa: E402
+
+from .actor import Actor  # noqa: E402
+from . import job_graph as jg  # noqa: E402
+
+_DRIVER_SERVICE = "sail_tpu.control.DriverService"
+_WORKER_SERVICE = "sail_tpu.control.WorkerService"
+
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString())
+
+
+# ---------------------------------------------------------------------------
+# Worker
+# ---------------------------------------------------------------------------
+
+class WorkerActor(Actor):
+    def __init__(self, worker_id: str, driver_addr: str, task_slots: int = 2):
+        super().__init__()
+        self.worker_id = worker_id
+        self.driver_addr = driver_addr
+        self.task_slots = task_slots
+        self.port = 0
+        self._server: Optional[grpc.Server] = None
+        self._driver_channel: Optional[grpc.Channel] = None
+        self._running: Dict[Tuple[str, int, int], threading.Thread] = {}
+        self._pool = futures.ThreadPoolExecutor(max_workers=task_slots)
+        self._hb_stop = threading.Event()
+
+    # -- rpc service -----------------------------------------------------
+    def _service(self):
+        def run_task(request: pb.RunTaskRequest, context):
+            self.handle.send(("run_task", request.task))
+            return pb.RunTaskResponse(accepted=True)
+
+        def stop_task(request: pb.StopTaskRequest, context):
+            self.handle.send(("stop_task", request))
+            return pb.StopTaskResponse()
+
+        return grpc.method_handlers_generic_handler(_WORKER_SERVICE, {
+            "RunTask": _unary(run_task, pb.RunTaskRequest),
+            "StopTask": _unary(stop_task, pb.StopTaskRequest),
+        })
+
+    def on_start(self):
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((self._service(),))
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.start()
+        self._driver_channel = grpc.insecure_channel(self.driver_addr)
+        resp = self._call_driver("RegisterWorker", pb.RegisterWorkerRequest(
+            worker_id=self.worker_id, host="127.0.0.1", port=self.port,
+            task_slots=self.task_slots), pb.RegisterWorkerResponse)
+        if not resp.accepted:
+            raise RuntimeError("driver rejected worker registration")
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+
+    def on_stop(self):
+        self._hb_stop.set()
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+
+    def _call_driver(self, method: str, msg, resp_cls):
+        rpc = self._driver_channel.unary_unary(
+            f"/{_DRIVER_SERVICE}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString)
+        return rpc(msg, timeout=30)
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(1.0):
+            try:
+                self._call_driver("Heartbeat", pb.HeartbeatRequest(
+                    worker_id=self.worker_id,
+                    running_tasks=len(self._running)), pb.HeartbeatResponse)
+            except grpc.RpcError:
+                pass
+
+    # -- actor -----------------------------------------------------------
+    def receive(self, message):
+        kind, payload = message
+        if kind == "run_task":
+            task: pb.TaskDefinition = payload
+            self._pool.submit(self._run_task, task)
+        elif kind == "stop_task":
+            pass  # cooperative cancel lands with the streaming runtime
+
+    def _run_task(self, task: pb.TaskDefinition):
+        import pyarrow as pa
+        from .local import LocalExecutor
+        try:
+            self._report(task, "running", b"")
+            plan = jg.decode_fragment(task.plan, task.scan_table or None,
+                                      task.partition,
+                                      max(task.num_partitions, 1))
+            table = LocalExecutor().execute(plan)
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, table.schema) as w:
+                w.write_table(table)
+            self._report(task, "succeeded", sink.getvalue().to_pybytes())
+        except Exception as e:  # noqa: BLE001 — full cause goes to the driver
+            self._report(task, "failed", b"", str(e))
+
+    def _report(self, task: pb.TaskDefinition, state: str, result: bytes,
+                error: str = ""):
+        try:
+            self._call_driver("ReportTaskStatus", pb.ReportTaskStatusRequest(
+                worker_id=self.worker_id, job_id=task.job_id,
+                stage=task.stage, partition=task.partition,
+                attempt=task.attempt, state=state, error=error,
+                result=result), pb.ReportTaskStatusResponse)
+        except grpc.RpcError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+class _Job:
+    def __init__(self, job_id: str, graph: jg.JobGraph):
+        self.job_id = job_id
+        self.graph = graph
+        self.results: Dict[int, bytes] = {}
+        self.failed: Optional[str] = None
+        self.attempts: Dict[int, int] = {}
+        self.done = threading.Event()
+
+
+class DriverActor(Actor):
+    HEARTBEAT_TIMEOUT_S = 10.0
+    MAX_TASK_ATTEMPTS = 3
+
+    def __init__(self):
+        super().__init__()
+        self.driver_id = uuid.uuid4().hex[:8]
+        self.workers: Dict[str, dict] = {}
+        self.jobs: Dict[str, _Job] = {}
+        self._server: Optional[grpc.Server] = None
+        self.port = 0
+        self._rr = 0
+
+    # -- rpc service -----------------------------------------------------
+    def _service(self):
+        def register(request: pb.RegisterWorkerRequest, context):
+            self.handle.send(("register", request))
+            return pb.RegisterWorkerResponse(accepted=True,
+                                             driver_id=self.driver_id)
+
+        def heartbeat(request: pb.HeartbeatRequest, context):
+            self.handle.send(("heartbeat", request))
+            return pb.HeartbeatResponse(known=True)
+
+        def report(request: pb.ReportTaskStatusRequest, context):
+            self.handle.send(("task_status", request))
+            return pb.ReportTaskStatusResponse()
+
+        return grpc.method_handlers_generic_handler(_DRIVER_SERVICE, {
+            "RegisterWorker": _unary(register, pb.RegisterWorkerRequest),
+            "Heartbeat": _unary(heartbeat, pb.HeartbeatRequest),
+            "ReportTaskStatus": _unary(report, pb.ReportTaskStatusRequest),
+        })
+
+    def on_start(self):
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((self._service(),))
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.start()
+        threading.Thread(target=self._probe_loop, daemon=True).start()
+
+    def on_stop(self):
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+
+    def _probe_loop(self):
+        while True:
+            time.sleep(2.0)
+            self.handle.send(("probe", None))
+
+    # -- actor -----------------------------------------------------------
+    def receive(self, message):
+        kind, payload = message
+        if kind == "register":
+            r: pb.RegisterWorkerRequest = payload
+            self.workers[r.worker_id] = {
+                "addr": f"{r.host}:{r.port}", "slots": r.task_slots,
+                "last_seen": time.time(),
+                "channel": grpc.insecure_channel(f"{r.host}:{r.port}"),
+                "tasks": set(),
+            }
+        elif kind == "heartbeat":
+            w = self.workers.get(payload.worker_id)
+            if w is not None:
+                w["last_seen"] = time.time()
+        elif kind == "probe":
+            self._probe_workers()
+        elif kind == "submit":
+            job, reply = payload
+            self.jobs[job.job_id] = job
+            self._schedule_leaf_tasks(job)
+            if reply is not None:
+                reply.set(job)
+        elif kind == "task_status":
+            self._on_task_status(payload)
+
+    def _probe_workers(self):
+        now = time.time()
+        lost = [wid for wid, w in self.workers.items()
+                if now - w["last_seen"] > self.HEARTBEAT_TIMEOUT_S]
+        for wid in lost:
+            w = self.workers.pop(wid)
+            # reschedule that worker's running tasks
+            for (job_id, stage, partition) in list(w["tasks"]):
+                job = self.jobs.get(job_id)
+                if job is not None and not job.done.is_set():
+                    self._launch_task(job, partition,
+                                      job.attempts.get(partition, 0) + 1)
+
+    def _schedule_leaf_tasks(self, job: _Job):
+        leaf = job.graph.stages[0]
+        for partition in range(leaf.num_partitions):
+            self._launch_task(job, partition, 0)
+
+    def _launch_task(self, job: _Job, partition: int, attempt: int):
+        if attempt >= self.MAX_TASK_ATTEMPTS:
+            job.failed = f"task {partition} exceeded max attempts"
+            job.done.set()
+            return
+        live = list(self.workers.items())
+        if not live:
+            job.failed = "no live workers"
+            job.done.set()
+            return
+        self._rr = (self._rr + 1) % len(live)
+        wid, w = live[self._rr]
+        job.attempts[partition] = attempt
+        leaf = job.graph.stages[0]
+        plan_bytes, table_ipc = jg.encode_fragment(leaf.plan)
+        task = pb.TaskDefinition(job_id=job.job_id, stage=0,
+                                 partition=partition, attempt=attempt,
+                                 plan=plan_bytes,
+                                 scan_table=table_ipc or b"",
+                                 num_partitions=job.graph.stages[0].num_partitions)
+        w["tasks"].add((job.job_id, 0, partition))
+        rpc = w["channel"].unary_unary(
+            f"/{_WORKER_SERVICE}/RunTask",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.RunTaskResponse.FromString)
+        try:
+            rpc(pb.RunTaskRequest(task=task), timeout=30)
+        except grpc.RpcError:
+            # dispatch failure = dead worker: evict immediately and redo the
+            # SAME attempt elsewhere (a launch failure is not a task failure)
+            self.workers.pop(wid, None)
+            self._launch_task(job, partition, attempt)
+
+    def _on_task_status(self, r: pb.ReportTaskStatusRequest):
+        job = self.jobs.get(r.job_id)
+        if job is None or job.done.is_set():
+            return
+        w = self.workers.get(r.worker_id)
+        if r.state in ("succeeded", "failed", "canceled") and w is not None:
+            w["tasks"].discard((r.job_id, r.stage, r.partition))
+        if r.state == "succeeded":
+            if r.attempt == job.attempts.get(r.partition, 0):
+                job.results[r.partition] = r.result
+                leaf = job.graph.stages[0]
+                if len(job.results) == leaf.num_partitions:
+                    job.done.set()
+        elif r.state == "failed":
+            self._launch_task(job, r.partition, r.attempt + 1)
+
+
+# ---------------------------------------------------------------------------
+# Local-cluster runner (the reference's local-cluster mode / test vehicle)
+# ---------------------------------------------------------------------------
+
+class LocalCluster:
+    def __init__(self, num_workers: int = 2, task_slots: int = 2):
+        self.driver = DriverActor()
+        self.driver.start("driver")
+        # wait for the driver's server port
+        deadline = time.time() + 10
+        while self.driver.port == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        self.workers: List[WorkerActor] = []
+        for i in range(num_workers):
+            w = WorkerActor(f"worker-{i}", f"127.0.0.1:{self.driver.port}",
+                            task_slots)
+            w.start(f"worker-{i}")
+            self.workers.append(w)
+        deadline = time.time() + 10
+        while len(self.driver.workers) < num_workers and time.time() < deadline:
+            time.sleep(0.02)
+
+    def run_job(self, plan, num_partitions: Optional[int] = None, timeout=120):
+        """Distribute a plan; returns the result pyarrow Table."""
+        import pyarrow as pa
+        from ..columnar import arrow_interop as ai
+        from .local import LocalExecutor
+
+        nparts = num_partitions or max(1, len(self.workers))
+        graph = jg.split_job(plan, nparts)
+        if graph is None:
+            return LocalExecutor().execute(plan)
+        job = _Job(uuid.uuid4().hex[:12], graph)
+        self.driver.handle.ask(lambda reply: ("submit", (job, reply)))
+        if not job.done.wait(timeout):
+            raise TimeoutError("cluster job timed out")
+        if job.failed:
+            raise RuntimeError(f"cluster job failed: {job.failed}")
+        parts = []
+        for i in range(nparts):
+            buf = job.results[i]
+            parts.append(pa.ipc.open_stream(buf).read_all())
+        merged = pa.concat_tables(parts, promote_options="permissive")
+        # run the root stage locally over the merged leaf output
+        root = graph.root
+        root_plan = _attach_stage_input(root.plan, merged)
+        return LocalExecutor().execute(root_plan)
+
+    def stop(self):
+        for w in self.workers:
+            w.stop()
+        self.driver.stop()
+
+
+def _attach_stage_input(plan, table):
+    import dataclasses as dc
+    from ..plan import nodes as pn
+
+    def replace(p):
+        if isinstance(p, jg._StageInput):
+            return pn.ScanExec(tuple(p.schema), table, (), "memory")
+        if isinstance(p, pn.JoinExec):
+            return dc.replace(p, left=replace(p.left), right=replace(p.right))
+        if isinstance(p, pn.UnionExec):
+            return dc.replace(p, inputs=tuple(replace(c) for c in p.inputs))
+        if hasattr(p, "input") and p.input is not None:
+            return dc.replace(p, input=replace(p.input))
+        return p
+
+    return replace(plan)

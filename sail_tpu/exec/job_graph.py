@@ -1,0 +1,182 @@
+"""Job graph: splitting a physical plan into distributable stages.
+
+Reference role: JobGraph::try_new and the five-InputMode exchange vocabulary
+(crates/sail-execution/src/job_graph/ — SURVEY.md §2.5). v0 splits at the
+materialization operators (aggregate/join/sort/limit): everything below the
+first such boundary over a partitionable scan becomes a per-partition leaf
+stage (Forward input), and the remainder runs as the root stage over the
+merged leaf outputs (Merge input). Hash-shuffled intermediate stages
+(InputMode::Shuffle riding the all_to_all collectives in parallel/) plug in
+at the same seam in a later round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import pickle
+from typing import List, Optional, Tuple
+
+from ..plan import nodes as pn
+from ..plan import rex as rx
+
+
+class InputMode(enum.Enum):
+    FORWARD = "forward"
+    MERGE = "merge"
+    SHUFFLE = "shuffle"
+    BROADCAST = "broadcast"
+    RESCALE = "rescale"
+
+
+@dataclasses.dataclass
+class Stage:
+    stage_id: int
+    plan: pn.PlanNode             # fragment; leaf stages scan a partition slice
+    input_mode: InputMode
+    inputs: Tuple[int, ...] = ()
+    num_partitions: int = 1
+
+
+@dataclasses.dataclass
+class JobGraph:
+    stages: List[Stage]
+
+    @property
+    def root(self) -> Stage:
+        return self.stages[-1]
+
+
+class _StageInput(pn.PlanNode):
+    """Placeholder leaf standing for a stage's merged upstream output."""
+
+    def __init__(self, stage_id: int, schema):
+        object.__setattr__(self, "stage_id", stage_id)
+        object.__setattr__(self, "_schema", schema)
+
+    @property
+    def schema(self):
+        return self._schema
+
+
+def _is_pipeline_op(p: pn.PlanNode) -> bool:
+    return isinstance(p, (pn.FilterExec, pn.ProjectExec))
+
+
+def _pipeline_over_scan(p: pn.PlanNode) -> bool:
+    """True if ``p`` is a chain of Filter/Project ops ending at a scan."""
+    seen_pipeline = False
+    while _is_pipeline_op(p):
+        seen_pipeline = True
+        p = p.input
+    return seen_pipeline and isinstance(p, pn.ScanExec)
+
+
+def _find_leaf_pipeline(p: pn.PlanNode) -> Optional[pn.PlanNode]:
+    """Topmost subtree that is a pipeline chain over a scan."""
+    if _pipeline_over_scan(p):
+        return p
+    for c in p.children:
+        r = _find_leaf_pipeline(c)
+        if r is not None:
+            return r
+    return None
+
+
+def split_job(plan: pn.PlanNode, num_partitions: int) -> Optional[JobGraph]:
+    """Split into (leaf pipeline stage over scan partitions, root stage).
+    Returns None when the plan has no distributable pipeline subtree (the
+    local executor should run it directly)."""
+    target = _find_leaf_pipeline(plan)
+    if target is None or target is plan and not _is_pipeline_op(plan):
+        return None
+    leaf = Stage(0, target, InputMode.FORWARD, (), num_partitions)
+    root_input = _StageInput(0, target.schema)
+    root_plan = _replace_subtree(plan, target, root_input)
+    root = Stage(1, root_plan, InputMode.MERGE, (0,), 1)
+    return JobGraph([leaf, root])
+
+
+def _replace_subtree(plan: pn.PlanNode, target: pn.PlanNode,
+                     replacement: pn.PlanNode) -> pn.PlanNode:
+    if plan is target:
+        return replacement
+    if isinstance(plan, pn.JoinExec):
+        return dataclasses.replace(
+            plan,
+            left=_replace_subtree(plan.left, target, replacement),
+            right=_replace_subtree(plan.right, target, replacement))
+    if isinstance(plan, pn.UnionExec):
+        return dataclasses.replace(plan, inputs=tuple(
+            _replace_subtree(c, target, replacement) for c in plan.inputs))
+    if hasattr(plan, "input") and plan.input is not None:
+        return dataclasses.replace(
+            plan, input=_replace_subtree(plan.input, target, replacement))
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# fragment codec (reference role: RemoteExecutionCodec, src/proto/codec.rs)
+# ---------------------------------------------------------------------------
+
+def encode_fragment(plan: pn.PlanNode) -> Tuple[bytes, Optional[bytes]]:
+    """Serialize a plan fragment for shipping to a worker.
+
+    Memory-table scans carry their data as Arrow IPC alongside the plan
+    (v0; file scans ship only paths). Returns (plan_bytes, table_ipc|None).
+    """
+    import pyarrow as pa
+
+    table_ipc = None
+
+    def strip(p: pn.PlanNode) -> pn.PlanNode:
+        nonlocal table_ipc
+        if isinstance(p, pn.ScanExec) and p.source is not None:
+            sink = pa.BufferOutputStream()
+            src = p.source
+            if p.projection is not None:
+                src = src.select(list(p.projection))
+            with pa.ipc.new_stream(sink, src.schema) as w:
+                w.write_table(src)
+            table_ipc = sink.getvalue().to_pybytes()
+            return dataclasses.replace(p, source=None, format="__shipped__",
+                                       projection=None)
+        if isinstance(p, pn.JoinExec):
+            return dataclasses.replace(p, left=strip(p.left), right=strip(p.right))
+        if isinstance(p, pn.UnionExec):
+            return dataclasses.replace(p, inputs=tuple(strip(c) for c in p.inputs))
+        if hasattr(p, "input") and p.input is not None:
+            return dataclasses.replace(p, input=strip(p.input))
+        return p
+
+    stripped = strip(plan)
+    return pickle.dumps(stripped), table_ipc
+
+
+def decode_fragment(plan_bytes: bytes, table_ipc: Optional[bytes],
+                    partition: int, num_partitions: int) -> pn.PlanNode:
+    """Deserialize a fragment, re-attaching shipped data sliced to this
+    task's partition."""
+    import pyarrow as pa
+
+    plan = pickle.loads(plan_bytes)
+
+    def attach(p: pn.PlanNode) -> pn.PlanNode:
+        if isinstance(p, pn.ScanExec) and p.format == "__shipped__":
+            table = pa.ipc.open_stream(table_ipc).read_all()
+            n = table.num_rows
+            per = -(-n // num_partitions)
+            part = table.slice(partition * per, per)
+            return dataclasses.replace(p, source=part, format="memory")
+        if isinstance(p, pn.ScanExec) and p.paths:
+            files = list(p.paths)
+            mine = tuple(f for i, f in enumerate(sorted(files))
+                         if i % num_partitions == partition)
+            return dataclasses.replace(p, paths=mine or (files[0],))
+        if isinstance(p, pn.JoinExec):
+            return dataclasses.replace(p, left=attach(p.left), right=attach(p.right))
+        if hasattr(p, "input") and p.input is not None:
+            return dataclasses.replace(p, input=attach(p.input))
+        return p
+
+    return attach(plan)

@@ -331,35 +331,19 @@ class LocalExecutor:
             raise ExecutionError(
                 f"expression requires host evaluation but no host path exists: "
                 f"{pn._rex_str(e)}")
+        from ..plan.compiler import (udf_arg_decoder, udf_decode_column,
+                                     udf_encode_numeric, udf_invoke)
         u = dict(e.options)["udf"]
-        arg_vals = []
+        n = child.capacity
+        cols_py = []
         for a in e.args:
             ac = comp.compile(a)
             data, validity = self._eval(ac, child)
-            arg_vals.append((np.asarray(data),
-                             None if validity is None else np.asarray(validity),
-                             rx.rex_type(a), ac.dictionary))
-        n = child.capacity
-        cols_py = []
-        for data, validity, adt, dictionary in arg_vals:
-            if dictionary is not None:
-                vals_list = dictionary.cast(pa.string()).to_pylist()
-                col = [vals_list[int(c)] if (validity is None or validity[i])
-                       else None for i, c in enumerate(data)]
-            elif isinstance(adt, dt.DecimalType) and adt.physical_dtype == "int64":
-                col = [float(x) / (10 ** adt.scale)
-                       if (validity is None or validity[i]) else None
-                       for i, x in enumerate(data)]
-            else:
-                col = [data[i].item() if (validity is None or validity[i])
-                       else None for i in range(n)]
-            cols_py.append(col)
-        if u.eval_type == "pandas":
-            import pandas as pd
-            res = list(u.func(*[pd.Series(c) for c in cols_py]))
-        else:
-            res = [u.func(*vals) for vals in zip(*cols_py)] if cols_py else \
-                [u.func() for _ in range(n)]
+            dec = udf_arg_decoder(rx.rex_type(a), ac.dictionary)
+            cols_py.append(udf_decode_column(
+                dec, np.asarray(data),
+                None if validity is None else np.asarray(validity)))
+        res = udf_invoke(u, cols_py, n)
         out_t = u.return_type
         if isinstance(out_t, (dt.StringType, dt.BinaryType)):
             arr = pa.array([None if v is None else str(v) for v in res],
@@ -370,12 +354,7 @@ class LocalExecutor:
             validity = jnp.asarray(np.asarray(_pc.is_valid(arr)))
             return jnp.asarray(codes), validity, enc.dictionary
         jdt = physical_jnp_dtype(out_t)
-        out = np.zeros(n, dtype=jdt)
-        mask = np.zeros(n, dtype=bool)
-        for i, v in enumerate(res):
-            if v is not None and v == v:
-                out[i] = v
-                mask[i] = True
+        out, mask = udf_encode_numeric(res, n, np.dtype(jdt))
         return jnp.asarray(out), jnp.asarray(mask), None
 
     def _exec_FilterExec(self, p: pn.FilterExec) -> HostBatch:
@@ -843,11 +822,32 @@ class LocalExecutor:
                     if name in child.dicts and i not in order_luts:
                         order_luts[i] = jnp.asarray(
                             ai.dictionary_ranks(child.dicts[name]))
+            # translate string lag/lead defaults to dictionary codes,
+            # extending the dictionary when the default is unseen
+            lag_defaults: Dict[int, object] = {}
+            extended_dicts: Dict[int, pa.Array] = {}
+            for j, s in enumerate(p.windows):
+                opts = dict(s.options)
+                default = opts.get("default")
+                if s.function in ("lag", "lead") and isinstance(default, str):
+                    src = _col_name(s.arg)
+                    if src not in child.dicts:
+                        raise ExecutionError(
+                            f"{s.function}() string default over a "
+                            f"non-string column")
+                    vals = child.dicts[src].cast(pa.string()).to_pylist()
+                    if default in vals:
+                        lag_defaults[j] = vals.index(default)
+                    else:
+                        extended_dicts[j] = pa.array(vals + [default])
+                        lag_defaults[j] = len(vals)
+                elif s.function in ("lag", "lead"):
+                    lag_defaults[j] = default
 
             def fn(cols, sel):
                 ctx_cache = {}
                 outs = []
-                for s in p.windows:
+                for j, s in enumerate(p.windows):
                     pkey = tuple(s.partition_indices)
                     okey = tuple((k.expr.index, k.ascending, k.nulls_first)
                                  for k in s.order_keys)
@@ -868,7 +868,8 @@ class LocalExecutor:
                                                k.nulls_first))
                         ctx = wink.build_window_context(part_cols, order_keys,
                                                         sel)
-                        okbits = [order_bits(d[ctx.perm], kdt, asc)
+                        okbits = [(order_bits(d[ctx.perm], kdt, asc),
+                                   None if v is None else v[ctx.perm])
                                   for (d, v, kdt, asc, nf) in order_keys]
                         ctx_cache[ck] = (ctx, okbits)
                     ctx, okbits = ctx_cache[ck]
@@ -890,7 +891,7 @@ class LocalExecutor:
                         arg = Column(cols[s.arg][0], cols[s.arg][1],
                                      in_schema[s.arg].dtype)
                         d, v = wink.shift(ctx, arg, int(opts["offset"]),
-                                          opts.get("default"))
+                                          lag_defaults.get(j))
                         outs.append((d, v))
                     else:
                         fnk = s.function
@@ -929,11 +930,11 @@ class LocalExecutor:
                         outs.append((d, v))
                 return tuple(outs)
 
-            return fn, None
+            return fn, extended_dicts
 
         key = self._op_key("window", p.windows,
                            tuple((f.name, f.dtype) for f in in_schema))
-        fn, _ = self._jitted(key, self._dict_objs(child), builder)
+        fn, extended_dicts = self._jitted(key, self._dict_objs(child), builder)
         results = fn(self._cols(child), dev.sel)
         cols = dict(dev.columns)
         out_dicts = dict(child.dicts)
@@ -947,7 +948,9 @@ class LocalExecutor:
             if s.arg is not None and s.function in ("lag", "lead", "min",
                                                     "max", "first", "last"):
                 src = _col_name(s.arg)
-                if src in child.dicts:
+                if extended_dicts and j in extended_dicts:
+                    out_dicts[keyn] = extended_dicts[j]
+                elif src in child.dicts:
                     out_dicts[keyn] = child.dicts[src]
         return HostBatch(DeviceBatch(cols, dev.sel), out_dicts)
 

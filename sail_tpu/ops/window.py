@@ -83,13 +83,19 @@ def _unsort(ctx: WindowContext, sorted_vals):
 
 
 def _peer_group_start(ctx: WindowContext, order_key_bits) -> jnp.ndarray:
-    """First position of each row's peer group (equal order keys)."""
+    """First position of each row's peer group (equal order keys).
+
+    ``order_key_bits``: list of (bits, validity|None) in SORTED order — a
+    NULL order key is never a peer of a non-NULL row even when the stored
+    fill value collides."""
     n = ctx.pos.shape[0]
     if not order_key_bits:
         return ctx.seg_start
     change = jnp.zeros(n, dtype=jnp.bool_)
-    for bits in order_key_bits:
+    for bits, valid in order_key_bits:
         change = change | (bits != jnp.roll(bits, 1))
+        if valid is not None:
+            change = change | (valid != jnp.roll(valid, 1))
     change = change | (ctx.pos == 0)
     change = change.at[0].set(True)
     grp = jnp.cumsum(change.astype(jnp.int32)) - 1

@@ -493,7 +493,15 @@ def _udf_compile(compiler: "ExprCompiler", r: rx.RCall, args: List[Compiled],
             return d.astype(jnp.float64) / (10.0 ** a.dtype.scale)
         return d
 
-    if udf.eval_type in ("pandas", "arrow"):
+    # the traced fast path sees raw device values — only numerics/bools are
+    # safe (strings are dictionary codes, dates/timestamps are epoch ints)
+    traceable_args = all(
+        not _is_str(a.dtype)
+        and not isinstance(a.dtype, (dt.DateType, dt.TimestampType,
+                                     dt.DayTimeIntervalType,
+                                     dt.YearMonthIntervalType))
+        for a in args)
+    if udf.eval_type in ("pandas", "arrow") and traceable_args:
         def dev_fn(cols):
             vals = []
             validity = None
@@ -517,55 +525,17 @@ def _udf_compile(compiler: "ExprCompiler", r: rx.RCall, args: List[Compiled],
             pass
 
     # host callback path
-    arg_decoders = []
-    for a in args:
-        if _is_str(a.dtype):
-            arg_decoders.append(("str", _dict_strings(a.dictionary)))
-        elif isinstance(a.dtype, dt.DecimalType) and a.dtype.physical_dtype == "int64":
-            arg_decoders.append(("dec", a.dtype.scale))
-        elif isinstance(a.dtype, dt.DateType):
-            arg_decoders.append(("date", None))
-        elif isinstance(a.dtype, dt.TimestampType):
-            arg_decoders.append(("ts", None))
-        else:
-            arg_decoders.append(("num", None))
+    arg_decoders = [udf_arg_decoder(a.dtype, a.dictionary) for a in args]
     out_np = np.dtype(out_jdt)
 
     def host_cb(*flat):
         k = len(args)
         datas, valids = flat[:k], flat[k:]
-        cols_py = []
-        for (kind, aux), d, v in zip(arg_decoders, datas, valids):
-            if kind == "str":
-                vals = [aux[int(c)] if ok else None for c, ok in zip(d, v)]
-            elif kind == "dec":
-                vals = [float(x) / (10 ** aux) if ok else None
-                        for x, ok in zip(d, v)]
-            elif kind == "date":
-                vals = [datetime.date(1970, 1, 1) + datetime.timedelta(days=int(x))
-                        if ok else None for x, ok in zip(d, v)]
-            elif kind == "ts":
-                vals = [datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=int(x))
-                        if ok else None for x, ok in zip(d, v)]
-            else:
-                vals = [d[i].item() if v[i] else None for i in range(len(d))]
-            cols_py.append(vals)
+        cols_py = [udf_decode_column(dec, d, v)
+                   for dec, d, v in zip(arg_decoders, datas, valids)]
         n = len(datas[0]) if datas else 0
-        if udf.eval_type == "pandas":
-            import pandas as pd
-            series = [pd.Series(c) for c in cols_py]
-            res = udf.func(*series)
-            res_list = list(res)
-        else:
-            res_list = [udf.func(*vals) for vals in zip(*cols_py)] if cols_py \
-                else [udf.func() for _ in range(n)]
-        out = np.zeros(n, dtype=out_np)
-        mask = np.zeros(n, dtype=bool)
-        for i, v in enumerate(res_list):
-            if v is not None and v == v:  # skip None/NaN-as-null
-                out[i] = v
-                mask[i] = True
-        return out, mask
+        res_list = udf_invoke(udf, cols_py, n)
+        return udf_encode_numeric(res_list, n, out_np)
 
     def fn(cols):
         datas = []
@@ -584,6 +554,58 @@ def _udf_compile(compiler: "ExprCompiler", r: rx.RCall, args: List[Compiled],
         return out, mask
 
     return Compiled(fn, out_t)
+
+
+# -- shared UDF argument decode / result encode (used by the jit callback
+#    path above AND the executor's host projection path) --------------------
+
+def udf_arg_decoder(adt: dt.DataType, dictionary):
+    if _is_str(adt):
+        return ("str", _dict_strings(dictionary) if dictionary is not None else [])
+    if isinstance(adt, dt.DecimalType) and adt.physical_dtype == "int64":
+        return ("dec", adt.scale)
+    if isinstance(adt, dt.DateType):
+        return ("date", None)
+    if isinstance(adt, dt.TimestampType):
+        return ("ts", None)
+    return ("num", None)
+
+
+def udf_decode_column(decoder, d, v):
+    kind, aux = decoder
+    if v is None:
+        v = np.ones(len(d), dtype=bool)
+    if kind == "str":
+        return [aux[int(c)] if ok else None for c, ok in zip(d, v)]
+    if kind == "dec":
+        return [float(x) / (10 ** aux) if ok else None for x, ok in zip(d, v)]
+    if kind == "date":
+        return [datetime.date(1970, 1, 1) + datetime.timedelta(days=int(x))
+                if ok else None for x, ok in zip(d, v)]
+    if kind == "ts":
+        return [datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+                + datetime.timedelta(microseconds=int(x))
+                if ok else None for x, ok in zip(d, v)]
+    return [d[i].item() if v[i] else None for i in range(len(d))]
+
+
+def udf_invoke(udf, cols_py, n):
+    if udf.eval_type == "pandas":
+        import pandas as pd
+        return list(udf.func(*[pd.Series(c) for c in cols_py]))
+    if cols_py:
+        return [udf.func(*vals) for vals in zip(*cols_py)]
+    return [udf.func() for _ in range(n)]
+
+
+def udf_encode_numeric(res_list, n, out_np):
+    out = np.zeros(n, dtype=out_np)
+    mask = np.zeros(n, dtype=bool)
+    for i, v in enumerate(res_list):
+        if v is not None and v == v:  # None / NaN → NULL
+            out[i] = v
+            mask[i] = True
+    return out, mask
 
 
 class HostFallback(Exception):

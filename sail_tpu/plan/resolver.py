@@ -597,19 +597,9 @@ class Resolver:
         def scan(e: ex.Expr):
             if isinstance(e, ex.Window):
                 make_spec(e)
-            elif isinstance(e, ex.Alias):
-                scan(e.child)
-            elif isinstance(e, ex.Function):
-                for a in e.args:
-                    scan(a)
-            elif isinstance(e, ex.Cast):
-                scan(e.child)
-            elif isinstance(e, ex.CaseWhen):
-                for c, v in e.branches:
-                    scan(c)
-                    scan(v)
-                if e.else_value is not None:
-                    scan(e.else_value)
+                return
+            for c in _expr_children(e):
+                scan(c)
 
         for it in items:
             scan(it)
@@ -661,6 +651,16 @@ class Resolver:
                 vals = [resolve_with_windows(v) for v in e.values]
                 r = rx.RCall("in", tuple([child_r] + vals), dt.BooleanType(), True)
                 return self._make_call("not", [r]) if e.negated else r
+            if isinstance(e, ex.Like):
+                child_r = resolve_with_windows(e.child)
+                pattern = resolve_with_windows(e.pattern)
+                fn = "ilike" if e.case_insensitive else "like"
+                opts = (("escape", e.escape),) if e.escape else ()
+                r = rx.RCall(fn, (child_r, pattern), dt.BooleanType(), True, opts)
+                return self._make_call("not", [r]) if e.negated else r
+            if isinstance(e, ex.Extract):
+                return self._resolve_expr(e, cscope) if not _has_window(e) else \
+                    self._finish_function(e.field_name, [resolve_with_windows(e.child)])
             return self._resolve_expr(e, cscope)
 
         post = []
@@ -1360,19 +1360,34 @@ def _and_rex(parts: List[rx.Rex]) -> rx.Rex:
     return out
 
 
+def _expr_children(e: ex.Expr):
+    """Immediate sub-expressions of a spec expression (for generic walks)."""
+    if isinstance(e, (ex.Alias, ex.Cast)):
+        return (e.child,)
+    if isinstance(e, ex.Function):
+        return e.args
+    if isinstance(e, ex.CaseWhen):
+        out = [x for pair in e.branches for x in pair]
+        if e.else_value is not None:
+            out.append(e.else_value)
+        return tuple(out)
+    if isinstance(e, ex.Between):
+        return (e.child, e.low, e.high)
+    if isinstance(e, ex.InList):
+        return (e.child,) + tuple(e.values)
+    if isinstance(e, ex.Like):
+        return (e.child, e.pattern)
+    if isinstance(e, ex.Extract):
+        return (e.child,)
+    if isinstance(e, ex.SortOrder):
+        return (e.child,)
+    return ()
+
+
 def _has_window(e: ex.Expr) -> bool:
     if isinstance(e, ex.Window):
         return True
-    if isinstance(e, ex.Alias):
-        return _has_window(e.child)
-    if isinstance(e, ex.Cast):
-        return _has_window(e.child)
-    if isinstance(e, ex.Function):
-        return any(_has_window(a) for a in e.args)
-    if isinstance(e, ex.CaseWhen):
-        return any(_has_window(c) or _has_window(v) for c, v in e.branches) \
-            or (e.else_value is not None and _has_window(e.else_value))
-    return False
+    return any(_has_window(c) for c in _expr_children(e))
 
 
 def _has_aggregate(e: ex.Expr) -> bool:

@@ -81,6 +81,11 @@ class SparkSession:
     def read(self) -> "DataFrameReader":
         return DataFrameReader(self)
 
+    @property
+    def readStream(self):
+        from .streaming import DataStreamReader
+        return DataStreamReader(self)
+
     def createDataFrame(self, data, schema=None) -> "DataFrame":
         if isinstance(data, pa.Table):
             table = data
@@ -752,6 +757,16 @@ class DataFrame:
     @property
     def write(self) -> "DataFrameWriter":
         return DataFrameWriter(self)
+
+    @property
+    def writeStream(self):
+        from .streaming import DataStreamWriter
+        return DataStreamWriter(self)
+
+    @property
+    def isStreaming(self) -> bool:
+        from .streaming import _find_stream_read
+        return _find_stream_read(self._plan) is not None
 
     @property
     def sparkSession(self) -> SparkSession:

@@ -1,0 +1,305 @@
+"""Streaming execution (micro-batch).
+
+Reference role: the streaming subsystem — rate/socket sources, flow-event
+markers, streaming query lifecycle (SURVEY.md §3.5; sail-common-datafusion
+streaming events, sail-data-source rate format). Design note: the reference
+streams Chandy–Lamport-style markers through a continuous dataflow; this
+engine uses Spark's own micro-batch model instead — each trigger snapshots
+the source offsets, runs a normal (fully jitted) batch query over the new
+slice, and commits. Markers survive as the offset/epoch bookkeeping.
+
+v0 sources: rate (rowsPerSecond), memory-append; sinks: memory (queryable
+as a temp view), console, foreachBatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+import pyarrow as pa
+
+from .spec import plan as sp
+
+
+class StreamSource:
+    def next_batch(self) -> Optional[pa.Table]:
+        raise NotImplementedError
+
+    @property
+    def schema(self) -> pa.Schema:
+        raise NotImplementedError
+
+
+class RateSource(StreamSource):
+    """value/timestamp rows at rowsPerSecond (reference: formats/rate)."""
+
+    def __init__(self, rows_per_second: int = 1):
+        self.rows_per_second = rows_per_second
+        self._start = time.time()
+        self._emitted = 0
+
+    @property
+    def schema(self) -> pa.Schema:
+        return pa.schema([("timestamp", pa.timestamp("us", tz="UTC")),
+                          ("value", pa.int64())])
+
+    def next_batch(self) -> Optional[pa.Table]:
+        now = time.time()
+        target = int((now - self._start) * self.rows_per_second)
+        if target <= self._emitted:
+            return None
+        values = list(range(self._emitted, target))
+        base_us = int(self._start * 1_000_000)
+        ts = [base_us + int(v * 1_000_000 / self.rows_per_second)
+              for v in values]
+        self._emitted = target
+        return pa.table({
+            "timestamp": pa.array(ts, type=pa.int64()).cast(
+                pa.timestamp("us", tz="UTC")),
+            "value": pa.array(values, type=pa.int64()),
+        })
+
+
+class MemoryStreamSource(StreamSource):
+    """Programmatic append source (for tests / foreachBatch pipelines)."""
+
+    def __init__(self, schema: pa.Schema):
+        self._schema = schema
+        self._pending: List[pa.Table] = []
+        self._lock = threading.Lock()
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def add(self, table: pa.Table):
+        with self._lock:
+            self._pending.append(table)
+
+    def next_batch(self) -> Optional[pa.Table]:
+        with self._lock:
+            if not self._pending:
+                return None
+            out = pa.concat_tables(self._pending)
+            self._pending.clear()
+            return out
+
+
+class StreamingQuery:
+    """A running micro-batch query (reference: streaming query lifecycle,
+    plan_executor.rs handle_execute_streaming_query_command)."""
+
+    def __init__(self, session, plan: sp.QueryPlan, source_name: str,
+                 source: StreamSource, sink: Callable[[int, pa.Table], None],
+                 interval_s: float = 0.1, query_name: Optional[str] = None):
+        self.id = uuid.uuid4().hex
+        self.name = query_name
+        self._session = session
+        self._plan = plan
+        self._source_name = source_name
+        self._source = source
+        self._sink = sink
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._batch_id = 0
+        self.exception: Optional[Exception] = None
+        self.recent_progress: List[dict] = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def isActive(self) -> bool:
+        return self._thread.is_alive()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+    def awaitTermination(self, timeout: Optional[float] = None) -> bool:
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def processAllAvailable(self):
+        """Block until the source has no pending data (test helper)."""
+        while True:
+            batch = self._source.next_batch()
+            if batch is None or batch.num_rows == 0:
+                return
+            self._process(batch)
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                batch = self._source.next_batch()
+                if batch is not None and batch.num_rows:
+                    self._process(batch)
+            except Exception as e:  # noqa: BLE001 — surfaced via .exception
+                self.exception = e
+                return
+
+    def _process(self, batch: pa.Table):
+        t0 = time.time()
+        view_plan = sp.LocalRelation(batch)
+        bound = _substitute_source(self._plan, self._source_name, view_plan)
+        result = self._session._execute_query(bound)
+        self._sink(self._batch_id, result)
+        self.recent_progress.append({
+            "batchId": self._batch_id,
+            "numInputRows": batch.num_rows,
+            "durationMs": int((time.time() - t0) * 1000),
+        })
+        del self.recent_progress[:-32]
+        self._batch_id += 1
+
+
+def _substitute_source(plan: sp.QueryPlan, name: str,
+                       replacement: sp.QueryPlan) -> sp.QueryPlan:
+    import dataclasses
+
+    if isinstance(plan, sp.ReadNamedTable) and plan.name[-1].lower() == name:
+        return replacement
+    if isinstance(plan, _StreamRead) and plan.source_name == name:
+        return replacement
+    for f in dataclasses.fields(plan) if dataclasses.is_dataclass(plan) else []:
+        v = getattr(plan, f.name)
+        if isinstance(v, sp.QueryPlan):
+            plan = dataclasses.replace(
+                plan, **{f.name: _substitute_source(v, name, replacement)})
+    return plan
+
+
+class _StreamRead(sp.QueryPlan):
+    """Marker leaf for readStream plans (pre-bind)."""
+
+    def __init__(self, source_name: str, source: StreamSource):
+        object.__setattr__(self, "source_name", source_name)
+        object.__setattr__(self, "source", source)
+
+
+class DataStreamReader:
+    def __init__(self, session):
+        self._session = session
+        self._format = "rate"
+        self._options: Dict[str, str] = {}
+
+    def format(self, fmt: str) -> "DataStreamReader":
+        self._format = fmt.lower()
+        return self
+
+    def option(self, key, value) -> "DataStreamReader":
+        self._options[str(key).lower()] = str(value)
+        return self
+
+    def load(self):
+        from .session import DataFrame
+        if self._format == "rate":
+            src: StreamSource = RateSource(
+                int(self._options.get("rowspersecond", 1)))
+        else:
+            raise ValueError(f"unsupported stream source {self._format!r}")
+        name = f"__stream_{uuid.uuid4().hex[:8]}"
+        plan = _StreamRead(name, src)
+        df = DataFrame(plan, self._session)
+        return df
+
+
+class DataStreamWriter:
+    def __init__(self, df):
+        self._df = df
+        self._format = "memory"
+        self._query_name: Optional[str] = None
+        self._options: Dict[str, str] = {}
+        self._foreach_batch: Optional[Callable] = None
+        self._output_mode = "append"
+
+    def format(self, fmt: str) -> "DataStreamWriter":
+        self._format = fmt.lower()
+        return self
+
+    def queryName(self, name: str) -> "DataStreamWriter":
+        self._query_name = name
+        return self
+
+    def outputMode(self, mode: str) -> "DataStreamWriter":
+        self._output_mode = mode.lower()
+        return self
+
+    def option(self, key, value) -> "DataStreamWriter":
+        self._options[str(key).lower()] = str(value)
+        return self
+
+    def trigger(self, processingTime: Optional[str] = None, **_) -> "DataStreamWriter":
+        if processingTime:
+            num = float(processingTime.split()[0])
+            unit = processingTime.split()[1] if " " in processingTime else "seconds"
+            self._options["interval_s"] = str(
+                num * (0.001 if unit.startswith("milli") else 1.0))
+        return self
+
+    def foreachBatch(self, fn: Callable) -> "DataStreamWriter":
+        self._foreach_batch = fn
+        return self
+
+    def start(self) -> StreamingQuery:
+        session = self._df._session
+        plan = self._df._plan
+        src_node = _find_stream_read(plan)
+        if src_node is None:
+            raise ValueError("writeStream requires a readStream source")
+        sink = self._make_sink(session)
+        q = StreamingQuery(session, plan, src_node.source_name,
+                           src_node.source, sink,
+                           float(self._options.get("interval_s", 0.1)),
+                           self._query_name)
+        return q
+
+    def _make_sink(self, session):
+        if self._foreach_batch is not None:
+            fb = self._foreach_batch
+
+            def sink(batch_id, table):
+                fb(_as_df(session, table), batch_id)
+
+            return sink
+        if self._format == "console":
+            def sink(batch_id, table):
+                print(f"-------- Batch {batch_id} --------")
+                print(table.to_pandas().to_string(index=False))
+
+            return sink
+        if self._format == "memory":
+            name = self._query_name or "stream"
+            state = {"tables": []}
+
+            def sink(batch_id, table):
+                state["tables"].append(table)
+                merged = pa.concat_tables(state["tables"],
+                                          promote_options="permissive")
+                session.createDataFrame(merged).createOrReplaceTempView(name)
+
+            return sink
+        if self._format == "noop":
+            return lambda batch_id, table: None
+        raise ValueError(f"unsupported stream sink {self._format!r}")
+
+
+def _as_df(session, table: pa.Table):
+    return session.createDataFrame(table)
+
+
+def _find_stream_read(plan) -> Optional[_StreamRead]:
+    import dataclasses
+
+    if isinstance(plan, _StreamRead):
+        return plan
+    if dataclasses.is_dataclass(plan):
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            if isinstance(v, sp.QueryPlan):
+                r = _find_stream_read(v)
+                if r is not None:
+                    return r
+    return None

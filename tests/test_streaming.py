@@ -1,0 +1,71 @@
+"""Streaming (micro-batch) tests: rate source, memory/foreachBatch sinks."""
+
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession
+from sail_tpu.streaming import MemoryStreamSource, StreamingQuery, _StreamRead
+from sail_tpu.session import DataFrame
+from sail_tpu.spec import plan as sp
+
+
+@pytest.fixture()
+def spark():
+    return SparkSession({})
+
+
+def test_rate_source_to_memory_sink(spark):
+    df = spark.readStream.format("rate").option("rowsPerSecond", 200).load()
+    assert df.isStreaming
+    q = df.filter("value % 2 = 0").writeStream.format("memory") \
+        .queryName("evens").trigger(processingTime="50 milliseconds").start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if spark.catalog.tableExists("evens"):
+                n = spark.sql("SELECT count(*) c FROM evens").toPandas().c[0]
+                if n >= 10:
+                    break
+            time.sleep(0.1)
+        assert q.exception is None
+        vals = spark.sql("SELECT value FROM evens ORDER BY value").toPandas().value
+        assert len(vals) >= 10
+        assert all(v % 2 == 0 for v in vals)
+        assert q.recent_progress, "progress should be recorded"
+    finally:
+        q.stop()
+    assert not q.isActive
+
+
+def test_memory_source_foreach_batch(spark):
+    schema = pa.schema([("k", pa.string()), ("v", pa.int64())])
+    src = MemoryStreamSource(schema)
+    plan = _StreamRead("src0", src)
+    df = DataFrame(sp.Aggregate(
+        sp.Filter(plan, __import__("sail_tpu.sql", fromlist=["parse_expression"])
+                  .parse_expression("v > 0")),
+        (__import__("sail_tpu.spec", fromlist=["expression"]).expression.col("k"),),
+        (__import__("sail_tpu.spec", fromlist=["expression"]).expression.col("k"),
+         __import__("sail_tpu.spec", fromlist=["expression"]).expression.Alias(
+             __import__("sail_tpu.spec", fromlist=["expression"]).expression.Function(
+                 "sum", (__import__("sail_tpu.spec", fromlist=["expression"]).expression.col("v"),)),
+             ("s",)))), spark)
+    seen = []
+    q = df.writeStream.foreachBatch(
+        lambda bdf, bid: seen.append((bid, bdf.toPandas()))).start()
+    try:
+        src.add(pa.table({"k": ["a", "b", "a"], "v": [1, -5, 2]}))
+        deadline = time.time() + 15
+        while time.time() < deadline and len(seen) < 1:
+            time.sleep(0.05)
+        assert q.exception is None, q.exception
+        assert len(seen) >= 1
+        bid, out = seen[0]
+        out = out.sort_values("k").reset_index(drop=True)
+        assert out.k.tolist() == ["a"] and out.s.tolist() == [3]
+    finally:
+        q.stop()

@@ -78,3 +78,30 @@ def test_string_returning_udf_host_path(spark):
     out = spark.table("t").select(col("x"), label(col("x")).alias("l")).toPandas()
     exp = [None if x % 10 == 3 else f"n{x % 4}" for x in out.x]
     assert [None if pd.isna(v) else v for v in out.l] == exp
+
+
+def test_pandas_udf_on_string_column_uses_host_path(spark):
+    # traceable body over STRING input must NOT see dictionary codes
+    @pandas_udf(returnType=dt.DoubleType())
+    def to_num(v):
+        return v.astype(float) * 2
+
+    s2 = SparkSession({})
+    s2.createDataFrame(pd.DataFrame({"v": ["10", "20", "30"]})) \
+        .createOrReplaceTempView("sv")
+    out = s2.table("sv").select(to_num(col("v")).alias("n")).toPandas()
+    assert out.n.tolist() == [20.0, 40.0, 60.0]
+
+
+def test_string_returning_udf_on_date_args(spark):
+    import datetime
+    @udf(returnType=dt.StringType())
+    def year_str(d):
+        return str(d.year)
+
+    s2 = SparkSession({})
+    s2.createDataFrame(pd.DataFrame({
+        "d": [datetime.date(2020, 1, 1), datetime.date(2021, 6, 2)]})) \
+        .createOrReplaceTempView("dd")
+    out = s2.table("dd").select(year_str(col("d")).alias("y")).toPandas()
+    assert out.y.tolist() == ["2020", "2021"]

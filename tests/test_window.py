@@ -161,3 +161,32 @@ def test_window_in_case_and_with_udf(spark):
                                THEN 'first' ELSE 'rest' END tag
                    FROM t ORDER BY o, g LIMIT 2""").toPandas()
     assert got.tag.tolist()[0] == "first"
+
+
+def test_null_order_keys_not_peers_of_zero(spark):
+    s, _ = spark
+    import pandas as pd
+    s.createDataFrame(pd.DataFrame({"x": [None, 0, 0, 5]}).astype({"x": "Int64"})) \
+        .createOrReplaceTempView("nz")
+    got = s.sql("SELECT x, rank() OVER (ORDER BY x) r, "
+                "sum(x) OVER (ORDER BY x) rs FROM nz ORDER BY r, x").toPandas()
+    assert got.r.tolist() == [1, 2, 2, 4]
+    # null row's frame contains only itself (sum over no valid values = null)
+    assert pd.isna(got.rs.iloc[0])
+    assert got.rs.tolist()[1:] == [0, 0, 5]
+
+
+def test_lag_string_default(spark):
+    s, _ = spark
+    import pandas as pd
+    s.createDataFrame(pd.DataFrame({"i": [1, 2], "s": ["a", "b"]})) \
+        .createOrReplaceTempView("ls")
+    got = s.sql("SELECT lag(s, 1, 'zz') OVER (ORDER BY i) p FROM ls ORDER BY i").toPandas()
+    assert got.p.tolist() == ["zz", "a"]
+
+
+def test_window_inside_between(spark):
+    s, _ = spark
+    got = s.sql("""SELECT o, row_number() OVER (ORDER BY o, g) BETWEEN 1 AND 2 AS top2
+                   FROM t ORDER BY o, g LIMIT 3""").toPandas()
+    assert got.top2.tolist() == [True, True, False]
