@@ -101,7 +101,18 @@ class LocalExecutor:
         method = getattr(self, "_exec_" + type(plan).__name__, None)
         if method is None:
             raise ExecutionError(f"no executor for {type(plan).__name__}")
-        return method(plan)
+        from .. import telemetry as tel
+        if tel.current_collector() is None:
+            return method(plan)
+        detail = ""
+        if isinstance(plan, pn.ScanExec):
+            detail = plan.table_name or ",".join(plan.paths)
+        with tel.operator_span(type(plan).__name__, detail) as m:
+            out = method(plan)
+            # rows/capacity force a device sync — only under EXPLAIN ANALYZE
+            m.output_rows = int(out.device.num_rows())
+            m.capacity = out.capacity
+            return out
 
     # ------------------------------------------------------------------
     # scalar subqueries

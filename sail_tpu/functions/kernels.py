@@ -83,14 +83,18 @@ def isnotnull(a: CV) -> CV:
 
 
 def coalesce(*args: CV) -> CV:
+    """First non-null argument; NULL only when every argument is NULL."""
     data = args[-1][0]
-    validity = args[-1][1]
     for d, v in reversed(args[:-1]):
         if v is None:
-            data, validity = d, None
+            data = d.astype(data.dtype)
         else:
             data = jnp.where(v, d.astype(data.dtype), data)
-            validity = v if validity is None else (v | validity)
+    if any(v is None for _, v in args):
+        return data, None
+    validity = args[0][1]
+    for _, v in args[1:]:
+        validity = validity | v
     return data, validity
 
 

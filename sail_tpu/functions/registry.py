@@ -64,12 +64,13 @@ _NUMERIC_BIN = {"+", "-", "*", "/", "%", "div", "pmod", "power", "atan2"}
 _CMP = {"==", "!=", "<", "<=", ">", ">=", "<=>"}
 _BOOL_FNS = {"and", "or", "not", "isnull", "isnotnull", "like", "ilike",
              "rlike", "in", "startswith", "endswith", "contains"}
-_FLOAT_FNS = {"sqrt", "exp", "ln", "log10", "log2", "sin", "cos", "tan",
-              "asin", "acos", "atan", "sinh", "cosh", "tanh", "degrees",
-              "radians", "cbrt", "log1p", "expm1"}
+_FLOAT_FNS = {"sqrt", "exp", "ln", "log10", "log2", "log", "sin", "cos",
+              "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+              "degrees", "radians", "cbrt", "log1p", "expm1", "rint",
+              "hypot"}
 _INT_FIELD_FNS = {"year", "month", "day", "dayofmonth", "quarter",
                   "dayofweek", "weekday", "dayofyear", "hour", "minute",
-                  "second", "weekofyear", "length", "char_length",
+                  "second", "weekofyear", "week", "length", "char_length",
                   "character_length", "ascii", "instr", "bit_length",
                   "octet_length", "position", "locate"}
 _STRING_FNS = {"upper", "ucase", "lower", "lcase", "trim", "ltrim", "rtrim",
@@ -142,6 +143,12 @@ def infer_function_type(name: str, arg_types: Sequence[dt.DataType]) -> dt.DataT
         return arg_types[0]
     if name == "sign" or name == "signum":
         return dt.DoubleType()
+    if name == "isnan":
+        return dt.BooleanType()
+    if name == "nanvl":
+        return arg_types[0]
+    if name == "nvl2":
+        return dt.common_type(arg_types[1], arg_types[2])
     if name in ("coalesce", "nullif", "nvl", "ifnull", "greatest", "least"):
         out = arg_types[0]
         for t in arg_types[1:]:

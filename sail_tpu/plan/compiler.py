@@ -123,7 +123,8 @@ class ExprCompiler:
                 n = cols[0][0].shape[0] if cols else 1
                 return (jnp.zeros(n, dtype=jdt), jnp.zeros(n, dtype=jnp.bool_))
 
-            return Compiled(null_fn, d)
+            dictionary = pa.array([""]) if _is_str(d) else None
+            return Compiled(null_fn, d, dictionary)
         if _is_str(d):
             dictionary = pa.array([v.value])
 
@@ -147,6 +148,8 @@ class ExprCompiler:
         src, dst = child.dtype, r.dtype
         if src == dst:
             return child
+        if isinstance(src, dt.NullType):
+            return self._compile_literal(LV(dst, None))
         if _is_str(src):
             return self._cast_from_string(child, dst, r.try_)
         if _is_str(dst):
@@ -383,6 +386,25 @@ class ExprCompiler:
                 return jnp.asarray(lut)[dta], v
 
             return Compiled(fn4, dt.BooleanType())
+
+        # choice functions over strings: merge dictionaries, remap codes,
+        # then run the ordinary positional-choice kernel on the codes
+        if name in ("coalesce", "if", "nvl2", "nullif") and _is_str(r.dtype):
+            str_pos = [i for i, a in enumerate(args) if _is_str(a.dtype)]
+            merged, remaps = _merge_dicts([dict_of(args[i]) for i in str_pos])
+            new_args = list(args)
+            for i, rm in zip(str_pos, remaps):
+                old = args[i]
+
+                def make(old=old, rm=rm):
+                    def f2(cols):
+                        d, v = old.fn(cols)
+                        return jnp.asarray(rm)[d], v
+                    return f2
+
+                new_args[i] = Compiled(make(), old.dtype, merged)
+            built = _NUMERIC_BUILDERS[name](new_args, r, opts)
+            return Compiled(built, r.dtype, merged)
 
         # dictionary-transform functions: apply to dict values, codes pass through
         transform = _STRING_TRANSFORMS.get(name)
@@ -1059,6 +1081,127 @@ _NUMERIC_BUILDERS: Dict[str, Callable] = {
 }
 
 
+def _weekofyear_builder(args, r, opts):
+    a = args[0]
+
+    def fn(cols):
+        xd, xv = a.fn(cols)
+        days = _to_days(xd, a.dtype)
+        # ISO week: week of the Thursday of this date's week
+        dow_mon0 = (days + 3) % 7  # Monday=0
+        thursday = days - dow_mon0 + 3
+        y, m, d = civil_from_days(thursday)
+        jan1 = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(m))
+        return ((thursday - jan1) // 7 + 1).astype(jnp.int32), xv
+
+    return fn
+
+
+def _last_day_builder(args, r, opts):
+    a = args[0]
+
+    def fn(cols):
+        xd, xv = a.fn(cols)
+        days = _to_days(xd, a.dtype)
+        y, m, d = civil_from_days(days)
+        ml = _month_len(y, m)
+        return days_from_civil(y, m, ml).astype(jnp.int32), xv
+
+    return fn
+
+
+def _add_months_builder(args, r, opts):
+    a, b = args
+
+    def fn(cols):
+        (xd, xv), (yd, yv) = a.fn(cols), b.fn(cols)
+        days = _to_days(xd, a.dtype)
+        y, m, d = civil_from_days(days)
+        months = y * 12 + (m - 1) + yd.astype(jnp.int64)
+        ny, nm = months // 12, months % 12 + 1
+        nd = jnp.minimum(d, _month_len(ny, nm))
+        return days_from_civil(ny, nm, nd).astype(jnp.int32), \
+            K.merge_validity(xv, yv)
+
+    return fn
+
+
+def _months_between_builder(args, r, opts):
+    a, b = args
+
+    def day_frac(xd, d):
+        if isinstance(d, dt.TimestampType):
+            us = xd.astype(jnp.int64)
+            days = jnp.floor_divide(us, 86_400_000_000)
+            secs = (us - days * 86_400_000_000).astype(jnp.float64) / 1e6
+            return days, secs / 86_400.0
+        return xd.astype(jnp.int64), jnp.zeros(xd.shape[0], dtype=jnp.float64)
+
+    def fn(cols):
+        (xd, xv), (yd, yv) = a.fn(cols), b.fn(cols)
+        d1, f1 = day_frac(xd, a.dtype)
+        d2, f2 = day_frac(yd, b.dtype)
+        y1, m1, dd1 = civil_from_days(d1)
+        y2, m2, dd2 = civil_from_days(d2)
+        both_last = (dd1 == _month_len(y1, m1)) & (dd2 == _month_len(y2, m2)) \
+            & (f1 == 0) & (f2 == 0)
+        months = (y1 - y2) * 12 + (m1 - m2)
+        frac = ((dd1 - dd2).astype(jnp.float64) + f1 - f2) / 31.0
+        out = months.astype(jnp.float64) + jnp.where(both_last, 0.0, frac)
+        out = jnp.round(out * 1e8) / 1e8  # Spark rounds to 8 places
+        return out, K.merge_validity(xv, yv)
+
+    return fn
+
+
+_DATE_TRUNC_FMTS = {"year", "yyyy", "yy", "quarter", "month", "mon", "mm",
+                    "week", "day", "dd"}
+_TIME_TRUNC_US = {"hour": 3_600_000_000, "minute": 60_000_000,
+                  "second": 1_000_000, "millisecond": 1_000, "microsecond": 1}
+
+
+def _trunc_builder(args, r, opts):
+    """trunc(date, fmt) / date_trunc(fmt, ts); fmt must be a literal and is
+    validated at bind time."""
+    def build_fn(date_arg, fmt_arg, out_is_ts):
+        fmt_vals = _dict_strings(fmt_arg.dictionary) if fmt_arg.dictionary is not None else []
+        if len(fmt_vals) != 1 or fmt_vals[0] is None:
+            raise HostFallback("trunc format must be a literal")
+        fmt = fmt_vals[0].lower()
+        if fmt not in _DATE_TRUNC_FMTS and not (out_is_ts and fmt in _TIME_TRUNC_US):
+            raise HostFallback(f"unsupported trunc format {fmt!r}")
+
+        def fn(cols):
+            xd, xv = date_arg.fn(cols)
+            if out_is_ts and fmt in _TIME_TRUNC_US:
+                unit = _TIME_TRUNC_US[fmt]
+                us = xd.astype(jnp.int64)
+                return jnp.floor_divide(us, unit) * unit, xv
+            days = _to_days(xd, date_arg.dtype)
+            y, m, d = civil_from_days(days)
+            if fmt in ("year", "yyyy", "yy"):
+                out_days = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+            elif fmt == "quarter":
+                qm = ((m - 1) // 3) * 3 + 1
+                out_days = days_from_civil(y, qm, jnp.ones_like(d))
+            elif fmt in ("month", "mon", "mm"):
+                out_days = days_from_civil(y, m, jnp.ones_like(d))
+            elif fmt == "week":
+                out_days = days - (days + 3) % 7
+            else:  # day / dd
+                out_days = days
+            if out_is_ts:
+                return out_days * 86_400_000_000, xv
+            return out_days.astype(jnp.int32), xv
+
+        return fn
+
+    if isinstance(args[0].dtype, dt.TimestampType) or _is_str(args[0].dtype):
+        # date_trunc(fmt, ts) — fmt first
+        return build_fn(args[1], args[0], out_is_ts=True)
+    return build_fn(args[0], args[1], out_is_ts=False)
+
+
 def _round_builder(args, r, opts):
     a = args[0]
     digits = 0
@@ -1085,7 +1228,122 @@ def _round_builder(args, r, opts):
     return fn
 
 
+def _bround_builder(args, r, opts):
+    """HALF_EVEN (banker's) rounding — Spark's bround."""
+    a = args[0]
+    digits = 0
+    if len(args) > 1:
+        digits = int(_extract_literal(args[1]) or 0)
+    s = _decimal_scale(a.dtype)
+
+    def fn(cols):
+        xd, xv = a.fn(cols)
+        if s is not None:
+            drop = s - max(0, min(digits, s))
+            if drop > 0:
+                f = 10 ** drop
+                q, rem = jnp.divmod(xd, f)
+                half = f // 2
+                round_up = (rem > half) | ((rem == half) & (q % 2 != 0))
+                xd = q + round_up.astype(q.dtype)
+                so = _decimal_scale(r.dtype)
+                if so is not None and so > s - drop:
+                    xd = xd * (10 ** (so - (s - drop)))
+            return xd, xv
+        scale = 10.0 ** digits
+        return jnp.round(xd * scale) / scale, xv  # jnp.round is half-even
+
+    return fn
+
+
 _NUMERIC_BUILDERS["round"] = _round_builder
+_NUMERIC_BUILDERS["bround"] = _bround_builder
+_NUMERIC_BUILDERS["weekofyear"] = _weekofyear_builder
+_NUMERIC_BUILDERS["week"] = _weekofyear_builder
+_NUMERIC_BUILDERS["last_day"] = _last_day_builder
+_NUMERIC_BUILDERS["add_months"] = _add_months_builder
+_NUMERIC_BUILDERS["months_between"] = _months_between_builder
+_NUMERIC_BUILDERS["trunc"] = _trunc_builder
+_NUMERIC_BUILDERS["date_trunc"] = _trunc_builder
+
+
+def _sample_mask_builder(args, r, opts):
+    frac_c, seed_c = args
+
+    def fn(cols):
+        n = cols[0][0].shape[0] if cols else 8
+        frac, _ = frac_c.fn(cols)
+        seed, _ = seed_c.fn(cols)
+        idx = jnp.arange(n, dtype=jnp.uint64)
+        x = idx + seed.astype(jnp.uint64)
+        x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> jnp.uint64(31))
+        u = (x >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
+        return u < frac, None
+
+    return fn
+
+
+_NUMERIC_BUILDERS["sample_mask"] = _sample_mask_builder
+def _isnan_builder(args, r, opts):
+    a = args[0]
+
+    def fn(cols):
+        xd, xv = a.fn(cols)
+        out = jnp.isnan(xd) if jnp.issubdtype(xd.dtype, jnp.floating) \
+            else jnp.zeros(xd.shape[0], dtype=jnp.bool_)
+        if xv is not None:
+            out = out & xv  # Spark: isnan(NULL) = false, never NULL
+        return out, None
+
+    return fn
+
+
+def _nanvl_builder(args, r, opts):
+    a, b = args
+
+    def fn(cols):
+        (xd, xv), (yd, yv) = a.fn(cols), b.fn(cols)
+        is_nan = jnp.isnan(xd) if jnp.issubdtype(xd.dtype, jnp.floating) \
+            else jnp.zeros(xd.shape[0], dtype=jnp.bool_)
+        data = jnp.where(is_nan, yd.astype(xd.dtype), xd)
+        # the replacement's validity only matters where x IS NaN
+        if xv is None and yv is None:
+            return data, None
+        ones = jnp.ones(xd.shape[0], dtype=jnp.bool_)
+        validity = jnp.where(is_nan, yv if yv is not None else ones,
+                             xv if xv is not None else ones)
+        return data, validity
+
+    return fn
+
+
+_NUMERIC_BUILDERS["isnan"] = _isnan_builder
+_NUMERIC_BUILDERS["nanvl"] = _nanvl_builder
+_NUMERIC_BUILDERS["cbrt"] = _unary_math(jnp.cbrt)
+_NUMERIC_BUILDERS["log1p"] = _unary_math(jnp.log1p)
+_NUMERIC_BUILDERS["expm1"] = _unary_math(jnp.expm1)
+_NUMERIC_BUILDERS["rint"] = _unary_math(jnp.rint)
+_NUMERIC_BUILDERS["hypot"] = _strict_builder(
+    lambda x, y: jnp.hypot(x.astype(jnp.float64), y.astype(jnp.float64)))
+_NUMERIC_BUILDERS["signum"] = _NUMERIC_BUILDERS["sign"]
+_NUMERIC_BUILDERS["ceiling"] = _NUMERIC_BUILDERS["ceil"]
+_NUMERIC_BUILDERS["log"] = _strict_builder(
+    lambda *xs: jnp.log(xs[0].astype(jnp.float64)) if len(xs) == 1
+    else jnp.log(xs[1].astype(jnp.float64)) / jnp.log(xs[0].astype(jnp.float64)))
+_NUMERIC_BUILDERS["nvl2"] = lambda a, r, o: _nvl2(a)
+
+
+def _nvl2(args):
+    cond, t, f = args
+
+    def fn(cols):
+        cd, cv = cond.fn(cols)
+        not_null = jnp.ones(cd.shape[0], dtype=jnp.bool_) if cv is None else cv
+        return K.if_((not_null, None), t.fn(cols), f.fn(cols))
+
+    return fn
 
 
 def _strict1(k, args):

@@ -1127,6 +1127,12 @@ class Resolver:
                     args = [self._coerce(args[0], common), self._coerce(args[1], common)]
                     arg_types = [common, common]
         out_t = freg.infer_function_type(name, arg_types)
+        # variadic/choice functions: coerce every argument to the result type
+        if name in ("coalesce", "greatest", "least", "nvl2", "nanvl") or \
+                (name == "if" and len(args) == 3):
+            # 'if' and 'nvl2' test their first argument — never cast it
+            skip = 1 if name in ("if", "nvl2") else 0
+            args = args[:skip] + [self._coerce(a, out_t) for a in args[skip:]]
         nullable = any(rx.rex_nullable(a) for a in args) or \
             name in ("/", "div", "%", "nullif")
         return rx.RCall(name, tuple(args), out_t, nullable)

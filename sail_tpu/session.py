@@ -231,6 +231,16 @@ class SparkSession:
         if isinstance(cmd, sp.Explain):
             from .plan.nodes import explain
             node = self._resolve(cmd.query)
+            if cmd.mode == "analyze":
+                import time as _t
+                from . import telemetry as tel
+                t0 = _t.perf_counter()
+                with tel.collect_metrics() as collector:
+                    self._executor_cls(dict(self.conf.items())).execute(node)
+                total_ms = (_t.perf_counter() - t0) * 1000
+                text = f"total: {total_ms:.1f}ms\n" + \
+                    "\n".join(m.render() for m in collector)
+                return pa.table({"plan": pa.array([text])})
             return pa.table({"plan": pa.array([explain(node)])})
         if isinstance(cmd, sp.CacheTable):
             if cmd.query is not None:

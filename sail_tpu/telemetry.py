@@ -1,0 +1,85 @@
+"""Telemetry: spans, per-operator execution metrics, EXPLAIN ANALYZE.
+
+Reference role: sail-telemetry — fastrace spans around actors/RPC plus
+DataFusion operator metrics harvested into OTel gauges per {job, stage,
+partition, operator} (SURVEY.md §5). Here the executor wraps every operator
+with a metrics recorder (rows out, batch capacity, wall time) and exports
+through the opentelemetry-api when a provider is configured; without one,
+metrics stay queryable in-process via EXPLAIN ANALYZE.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+try:  # the api package is always importable; an SDK may or may not be wired
+    from opentelemetry import trace as _otel_trace
+    _TRACER = _otel_trace.get_tracer("sail_tpu")
+except Exception:  # pragma: no cover - otel not installed
+    _TRACER = None
+
+
+@dataclass
+class OperatorMetrics:
+    operator: str
+    detail: str = ""
+    output_rows: int = 0
+    capacity: int = 0
+    elapsed_ms: float = 0.0
+    children: List["OperatorMetrics"] = field(default_factory=list)
+
+    def render(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        line = (f"{pad}{self.operator}{' ' + self.detail if self.detail else ''}"
+                f"  [rows={self.output_rows} cap={self.capacity} "
+                f"time={self.elapsed_ms:.1f}ms]")
+        return "\n".join([line] + [c.render(indent + 1) for c in self.children])
+
+
+_local = threading.local()
+
+
+def current_collector() -> Optional[List]:
+    return getattr(_local, "collector", None)
+
+
+@contextmanager
+def collect_metrics():
+    """Enable metrics collection on this thread for one query."""
+    prev = getattr(_local, "collector", None)
+    _local.collector = []
+    try:
+        yield _local.collector
+    finally:
+        _local.collector = prev
+
+
+@contextmanager
+def operator_span(name: str, detail: str = ""):
+    """Wrap one operator execution; nests into the thread's collector."""
+    collector = current_collector()
+    if collector is None:
+        yield None
+        return
+    m = OperatorMetrics(name, detail)
+    # children recorded during this span land in a fresh list
+    parent = collector
+    own: List[OperatorMetrics] = []
+    _local.collector = own
+    t0 = time.perf_counter()
+    span_cm = _TRACER.start_as_current_span(f"op:{name}") if _TRACER else None
+    if span_cm is not None:
+        span_cm.__enter__()
+    try:
+        yield m
+    finally:
+        if span_cm is not None:
+            span_cm.__exit__(None, None, None)
+        m.elapsed_ms = (time.perf_counter() - t0) * 1000
+        m.children = own
+        parent.append(m)
+        _local.collector = parent

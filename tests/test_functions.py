@@ -1,0 +1,146 @@
+"""Scalar function behavior tests against Python/pandas oracles
+(mirrors the reference's gold-data function tests, SURVEY.md §4 tier 2)."""
+
+import datetime
+import math
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession
+
+
+@pytest.fixture(scope="module")
+def spark():
+    s = SparkSession({})
+    s.createDataFrame(pd.DataFrame({
+        "d": pd.to_datetime(["2024-01-31", "2023-02-28", "2020-12-15",
+                             "1999-06-01"]).date,
+        "x": [1.5, -2.25, 0.0, 100.0],
+        "i": [3, -7, 0, 42],
+        "s": ["Hello World", "  pad  ", "", "a,b,c"],
+    })) .createOrReplaceTempView("f")
+    return s
+
+
+def one(spark, expr):
+    return spark.sql(f"SELECT {expr} AS r FROM f LIMIT 1").toPandas().r[0]
+
+
+def col_vals(spark, expr):
+    return spark.sql(f"SELECT {expr} AS r FROM f").toPandas().r.tolist()
+
+
+class TestDatetime:
+    def test_fields(self, spark):
+        assert col_vals(spark, "year(d)") == [2024, 2023, 2020, 1999]
+        assert col_vals(spark, "month(d)") == [1, 2, 12, 6]
+        assert col_vals(spark, "day(d)") == [31, 28, 15, 1]
+        assert col_vals(spark, "quarter(d)") == [1, 1, 4, 2]
+        assert col_vals(spark, "dayofweek(d)") == [4, 3, 3, 3]  # Sun=1
+        exp_doy = [pd.Timestamp(v).dayofyear for v in
+                   ["2024-01-31", "2023-02-28", "2020-12-15", "1999-06-01"]]
+        assert col_vals(spark, "dayofyear(d)") == exp_doy
+        exp_woy = [pd.Timestamp(v).week for v in
+                   ["2024-01-31", "2023-02-28", "2020-12-15", "1999-06-01"]]
+        assert col_vals(spark, "weekofyear(d)") == exp_woy
+
+    def test_last_day_add_months(self, spark):
+        assert col_vals(spark, "last_day(d)") == [
+            datetime.date(2024, 1, 31), datetime.date(2023, 2, 28),
+            datetime.date(2020, 12, 31), datetime.date(1999, 6, 30)]
+        assert col_vals(spark, "add_months(d, 1)") == [
+            datetime.date(2024, 2, 29), datetime.date(2023, 3, 28),
+            datetime.date(2021, 1, 15), datetime.date(1999, 7, 1)]
+        assert col_vals(spark, "add_months(d, -12)") == [
+            datetime.date(2023, 1, 31), datetime.date(2022, 2, 28),
+            datetime.date(2019, 12, 15), datetime.date(1998, 6, 1)]
+
+    def test_trunc(self, spark):
+        assert col_vals(spark, "trunc(d, 'year')") == [
+            datetime.date(2024, 1, 1), datetime.date(2023, 1, 1),
+            datetime.date(2020, 1, 1), datetime.date(1999, 1, 1)]
+        assert col_vals(spark, "trunc(d, 'mm')") == [
+            datetime.date(2024, 1, 1), datetime.date(2023, 2, 1),
+            datetime.date(2020, 12, 1), datetime.date(1999, 6, 1)]
+
+    def test_datediff_and_arith(self, spark):
+        assert one(spark, "datediff(date '2024-02-01', date '2024-01-01')") == 31
+        assert one(spark, "date '2024-01-31' + interval '1' month") == \
+            datetime.date(2024, 2, 29)
+        assert one(spark, "date_add(date '2024-01-01', 60)") == \
+            datetime.date(2024, 3, 1)
+        assert one(spark, "months_between(date '2024-03-31', date '2024-02-29')") \
+            == pytest.approx(1.0)
+
+
+class TestMath:
+    def test_basics(self, spark):
+        assert col_vals(spark, "abs(i)") == [3, 7, 0, 42]
+        assert one(spark, "round(2.5)") == 3
+        assert one(spark, "round(-2.5)") == -3
+        assert float(one(spark, "round(2.34567, 2)")) == pytest.approx(2.35)
+        assert one(spark, "floor(1.7)") == 1
+        assert one(spark, "ceil(1.2)") == 2
+        assert one(spark, "power(2, 10)") == 1024
+        assert one(spark, "pmod(-7, 3)") == 2
+        assert one(spark, "7 % 3") == 1
+        assert one(spark, "7 div 2") == 3
+        assert one(spark, "log(2, 8)") == pytest.approx(3.0)
+        assert one(spark, "hypot(3, 4)") == pytest.approx(5.0)
+        assert pd.isna(one(spark, "1 / 0"))  # non-ANSI: null
+        assert bool(one(spark, "isnan(cast('nan' as double))")) is True
+
+    def test_greatest_least_null_handling(self, spark):
+        assert one(spark, "greatest(1, 5, 3)") == 5
+        assert one(spark, "least(1, 5, 3)") == 1
+        assert one(spark, "greatest(1, NULL, 3)") == 3
+        assert one(spark, "coalesce(NULL, NULL, 7)") == 7
+        assert pd.isna(one(spark, "nullif(3, 3)"))
+        assert one(spark, "nvl2(NULL, 'a', 'b')") == "b"
+
+
+class TestStrings:
+    def test_transforms(self, spark):
+        assert col_vals(spark, "upper(s)")[0] == "HELLO WORLD"
+        assert col_vals(spark, "length(s)") == [11, 7, 0, 5]
+        assert col_vals(spark, "trim(s)")[1] == "pad"
+        assert col_vals(spark, "substring(s, 1, 5)")[0] == "Hello"
+        assert col_vals(spark, "replace(s, 'l', 'L')")[0] == "HeLLo WorLd"
+        assert col_vals(spark, "reverse(s)")[0] == "dlroW olleH"
+        assert col_vals(spark, "lpad(s, 3, '*')")[2] == "***"
+        assert one(spark, "instr(s, 'World')") == 7
+        assert one(spark, "concat(s, '!')") == "Hello World!"
+        assert bool(one(spark, "s LIKE 'Hello%'")) is True
+        assert bool(one(spark, "s RLIKE 'W.rld'")) is True
+        assert bool(one(spark, "startswith(s, 'Hello')")) is True
+        assert one(spark, "md5('abc')") == "900150983cd24fb0d6963f7d28e17f72"
+
+
+class TestReviewRegressions2:
+    def test_nvl2_does_not_cast_test_arg(self, spark):
+        assert one(spark, "nvl2('abc', 1, 0)") == 1
+        assert one(spark, "nvl2(d, 1, 0)") == 1
+
+    def test_date_trunc_time_units(self, spark):
+        import datetime
+        v = one(spark, "date_trunc('hour', timestamp '2024-03-05 13:47:21')")
+        assert v.hour == 13 and v.minute == 0 and v.second == 0
+        v = one(spark, "date_trunc('minute', timestamp '2024-03-05 13:47:21')")
+        assert v.minute == 47 and v.second == 0
+
+    def test_bround_half_even(self, spark):
+        assert float(one(spark, "bround(2.5)")) == 2
+        assert float(one(spark, "bround(3.5)")) == 4
+        assert float(one(spark, "bround(2.45, 1)")) == 2.4
+
+    def test_months_between_timestamps(self, spark):
+        v = one(spark, "months_between(timestamp '1997-02-28 10:30:00', "
+                       "timestamp '1996-10-30 00:00:00')")
+        assert v == pytest.approx(3.94959677, abs=1e-8)
+
+    def test_isnan_nanvl_null_semantics(self, spark):
+        assert bool(one(spark, "isnan(cast(NULL as double))")) is False
+        assert one(spark, "nanvl(1.0, cast(NULL as double))") == 1.0
+        assert pd.isna(one(spark, "nanvl(cast('nan' as double), cast(NULL as double))"))

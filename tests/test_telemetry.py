@@ -1,0 +1,26 @@
+"""Operator metrics / EXPLAIN ANALYZE."""
+
+import pandas as pd
+
+from sail_tpu import SparkSession
+
+
+def test_explain_analyze_reports_operator_metrics():
+    spark = SparkSession({})
+    spark.createDataFrame(pd.DataFrame({"g": [1, 2, 1, 2, 3], "v": range(5)})) \
+        .createOrReplaceTempView("t")
+    out = spark.sql("EXPLAIN ANALYZE SELECT g, sum(v) s FROM t WHERE v > 0 "
+                    "GROUP BY g ORDER BY g").toPandas()
+    text = out.plan[0]
+    assert "total:" in text
+    for op in ("ScanExec", "FilterExec", "AggregateExec", "SortExec"):
+        assert op in text, text
+    assert "rows=" in text and "time=" in text
+    # filter output rows must be 4 (v>0)
+    filter_line = [l for l in text.splitlines() if "FilterExec" in l][0]
+    assert "rows=4" in filter_line, filter_line
+
+
+def test_metrics_off_by_default():
+    from sail_tpu.telemetry import current_collector
+    assert current_collector() is None
