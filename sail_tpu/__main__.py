@@ -1,0 +1,105 @@
+"""CLI entry point: ``python -m sail_tpu <command>``.
+
+Reference role: sail-cli (crates/sail-cli/src/runner.rs — spark server /
+shell / worker subcommands).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _ensure_backend(timeout_s: float = 150.0):
+    """Fall back to CPU when the default jax backend can't initialize
+    (e.g. a wedged remote-TPU tunnel) instead of hanging forever."""
+    import subprocess
+    try:
+        r = subprocess.run([sys.executable, "-c", "import jax; jax.devices()"],
+                           timeout=timeout_s, capture_output=True)
+        ok = r.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="sail_tpu",
+                                     description="TPU-native Spark-capable engine")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_server = sub.add_parser("server", help="run the SQL gRPC server")
+    p_server.add_argument("--host", default="127.0.0.1")
+    p_server.add_argument("--port", type=int, default=50051)
+
+    p_shell = sub.add_parser("shell", help="interactive SQL shell")
+    p_shell.add_argument("--remote", default=None,
+                         help="host:port of a running server (default: in-process)")
+
+    p_bench = sub.add_parser("bench", help="run the benchmark")
+    p_bench.add_argument("sf", nargs="?", type=float, default=1.0)
+
+    args = parser.parse_args(argv)
+    if args.command in ("server", "shell"):
+        _ensure_backend()
+
+    if args.command == "server":
+        from .server import SqlServer
+        server = SqlServer(args.host, args.port).start()
+        print(f"sail-tpu SQL server listening on {args.host}:{server.port}")
+        try:
+            server.wait()
+        except KeyboardInterrupt:
+            server.stop()
+        return 0
+
+    if args.command == "shell":
+        return _shell(args.remote)
+
+    if args.command == "bench":
+        import os
+        import subprocess
+        bench = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py")
+        return subprocess.call([sys.executable, bench, str(args.sf)])
+
+    return 1
+
+
+def _shell(remote):
+    if remote:
+        from .server import SqlClient
+        client = SqlClient(remote)
+        run = client.sql
+    else:
+        from . import SparkSession
+        spark = SparkSession.builder.getOrCreate()
+        run = lambda q: spark.sql(q).toArrow()  # noqa: E731
+    print("sail-tpu SQL shell — ';' to run, 'exit' to quit")
+    buf = []
+    while True:
+        try:
+            prompt = "sql> " if not buf else "...> "
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if line.strip().lower() in ("exit", "quit"):
+            return 0
+        buf.append(line)
+        if line.rstrip().endswith(";"):
+            query = "\n".join(buf).rstrip().rstrip(";")
+            buf = []
+            try:
+                table = run(query)
+                print(table.to_pandas().to_string(index=False, max_rows=50))
+            except Exception as e:  # noqa: BLE001 — REPL surfaces all errors
+                print(f"error: {e}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,10 +30,9 @@ from typing import Dict, List, Optional, Tuple
 
 import grpc
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "proto"))
-import control_plane_pb2 as pb  # noqa: E402
+from .proto import control_plane_pb2 as pb
 
-from .actor import Actor  # noqa: E402
+from .actor import Actor
 from . import job_graph as jg  # noqa: E402
 
 _DRIVER_SERVICE = "sail_tpu.control.DriverService"

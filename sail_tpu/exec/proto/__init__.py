@@ -1,0 +1,23 @@
+"""Generated protocol modules, loaded under namespaced names (the protoc
+output uses flat imports; loading via importlib avoids polluting sys.path
+and top-level module names)."""
+
+import importlib.util
+import os
+import sys
+
+
+def _load(name: str):
+    mod_name = f"sail_tpu.exec.proto.{name}"
+    if mod_name in sys.modules:
+        return sys.modules[mod_name]
+    path = os.path.join(os.path.dirname(__file__), f"{name}.py")
+    spec = importlib.util.spec_from_file_location(mod_name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[mod_name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+control_plane_pb2 = _load("control_plane_pb2")
+sql_service_pb2 = _load("sql_service_pb2")

@@ -1143,11 +1143,13 @@ def _months_between_builder(args, r, opts):
         d2, f2 = day_frac(yd, b.dtype)
         y1, m1, dd1 = civil_from_days(d1)
         y2, m2, dd2 = civil_from_days(d2)
-        both_last = (dd1 == _month_len(y1, m1)) & (dd2 == _month_len(y2, m2)) \
-            & (f1 == 0) & (f2 == 0)
+        # Spark: whole months when same day-of-month OR both last days of
+        # their months — time of day is ignored in those cases
+        whole = (dd1 == dd2) | ((dd1 == _month_len(y1, m1))
+                                & (dd2 == _month_len(y2, m2)))
         months = (y1 - y2) * 12 + (m1 - m2)
         frac = ((dd1 - dd2).astype(jnp.float64) + f1 - f2) / 31.0
-        out = months.astype(jnp.float64) + jnp.where(both_last, 0.0, frac)
+        out = months.astype(jnp.float64) + jnp.where(whole, 0.0, frac)
         out = jnp.round(out * 1e8) / 1e8  # Spark rounds to 8 places
         return out, K.merge_validity(xv, yv)
 
@@ -1307,6 +1309,8 @@ def _nanvl_builder(args, r, opts):
         (xd, xv), (yd, yv) = a.fn(cols), b.fn(cols)
         is_nan = jnp.isnan(xd) if jnp.issubdtype(xd.dtype, jnp.floating) \
             else jnp.zeros(xd.shape[0], dtype=jnp.bool_)
+        if xv is not None:
+            is_nan = is_nan & xv  # NULL slots may hold garbage NaN data
         data = jnp.where(is_nan, yd.astype(xd.dtype), xd)
         # the replacement's validity only matters where x IS NaN
         if xv is None and yv is None:

@@ -128,6 +128,8 @@ class Resolver:
             return node, self._scope_of(node, None, outer, ctes)
         if isinstance(plan, sp.Values):
             return self._resolve_values(plan, outer, ctes)
+        if isinstance(plan, sp.ReadUdtf):
+            return self._resolve_udtf(plan, outer, ctes)
         if isinstance(plan, sp.WithCtes):
             new_ctes = dict(ctes)
             for name, q in plan.ctes:
@@ -301,6 +303,28 @@ class Resolver:
         node = pn.ValuesExec(schema, tuple(rows))
         fields = [ScopeField(f.name, (), f.dtype, f.nullable) for f in schema]
         return node, Scope(fields, outer, ctes)
+
+    def _resolve_udtf(self, plan: sp.ReadUdtf, outer, ctes):
+        if plan.name == "range":
+            if not 1 <= len(plan.args) <= 4:
+                raise ResolutionError(
+                    f"range() takes 1-4 arguments, got {len(plan.args)}")
+            vals = []
+            for a in plan.args:
+                r = self._resolve_expr(a, Scope([], None, {}))
+                if not isinstance(r, rx.RLit):
+                    raise ResolutionError("range() arguments must be literals")
+                vals.append(int(r.value.value))
+            if len(vals) == 1:
+                start, end, step = 0, vals[0], 1
+            else:
+                start, end = vals[0], vals[1]
+                step = vals[2] if len(vals) > 2 else 1
+            if step == 0:
+                raise ResolutionError("range() step must not be zero")
+            node = pn.RangeExec(start, end, step, 1)
+            return node, self._scope_of(node, "range", outer, ctes)
+        raise ResolutionError(f"unknown table function {plan.name!r}")
 
     def _scope_of(self, node: pn.PlanNode, qual, outer, ctes) -> Scope:
         quals = (qual,) if qual else ()

@@ -1,0 +1,159 @@
+"""SQL protocol server + session manager.
+
+Reference role: sail-spark-connect's service layer + sail-session's
+SessionManager (session map keyed by id with timeout eviction —
+crates/sail-session/src/session_manager/mod.rs). The wire contract is the
+engine's own protobuf service (sql_service.proto) pending vendored Spark
+Connect protos; results stream to the client as Arrow IPC chunks exactly
+as Spark Connect's ExecutePlanResponse does.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import uuid
+from concurrent import futures
+from typing import Dict, Optional, Tuple
+
+import grpc
+
+from .exec.proto import sql_service_pb2 as spb
+
+_SQL_SERVICE = "sail_tpu.sql.SqlService"
+
+
+class SessionManager:
+    """Sessions keyed by id, evicted after ``timeout_s`` of inactivity."""
+
+    def __init__(self, timeout_s: float = 3600.0):
+        from .session import SparkSession
+        self._factory = lambda conf: SparkSession(conf)
+        self._sessions: Dict[str, Tuple[object, float]] = {}
+        self._lock = threading.Lock()
+        self.timeout_s = timeout_s
+
+    def get_or_create(self, session_id: str, conf: Optional[dict] = None):
+        now = time.time()
+        with self._lock:
+            self._evict(now)
+            if session_id in self._sessions:
+                session, _ = self._sessions[session_id]
+                self._sessions[session_id] = (session, now)
+                return session
+            session = self._factory(dict(conf or {}))
+            self._sessions[session_id] = (session, now)
+            return session
+
+    def release(self, session_id: str):
+        with self._lock:
+            self._sessions.pop(session_id, None)
+
+    def _evict(self, now: float):
+        dead = [sid for sid, (_, t) in self._sessions.items()
+                if now - t > self.timeout_s]
+        for sid in dead:
+            del self._sessions[sid]
+
+    def __len__(self):
+        return len(self._sessions)
+
+
+class SqlServer:
+    """gRPC server speaking the engine's SQL protocol."""
+
+    CHUNK_ROWS = 65536
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 session_timeout_s: float = 3600.0):
+        self.sessions = SessionManager(session_timeout_s)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+        self._server.add_generic_rpc_handlers((self._service(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    def start(self):
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 1.0):
+        self._server.stop(grace=grace)
+
+    def wait(self):
+        self._server.wait_for_termination()
+
+    # -- service ---------------------------------------------------------
+    def _service(self):
+        def execute_sql(request: spb.ExecuteSqlRequest, context):
+            import pyarrow as pa
+            sid = request.session_id or uuid.uuid4().hex
+            try:
+                session = self.sessions.get_or_create(sid, dict(request.conf))
+                table = session.sql(request.sql).toArrow()
+                for chunk_start in range(0, max(table.num_rows, 1),
+                                         self.CHUNK_ROWS):
+                    chunk = table.slice(chunk_start, self.CHUNK_ROWS)
+                    sink = pa.BufferOutputStream()
+                    with pa.ipc.new_stream(sink, table.schema) as w:
+                        w.write_table(chunk)
+                    last = chunk_start + self.CHUNK_ROWS >= table.num_rows
+                    yield spb.ExecuteSqlResponse(
+                        arrow_ipc=sink.getvalue().to_pybytes(), last=last)
+            except Exception as e:  # noqa: BLE001 — errors cross the wire
+                yield spb.ExecuteSqlResponse(error=f"{type(e).__name__}: {e}",
+                                             last=True)
+
+        def new_session(request: spb.SessionRequest, context):
+            sid = request.session_id or uuid.uuid4().hex
+            self.sessions.get_or_create(sid)
+            return spb.SessionResponse(session_id=sid)
+
+        def release_session(request: spb.SessionRequest, context):
+            self.sessions.release(request.session_id)
+            return spb.SessionResponse(session_id=request.session_id)
+
+        return grpc.method_handlers_generic_handler(_SQL_SERVICE, {
+            "ExecuteSql": grpc.unary_stream_rpc_method_handler(
+                execute_sql,
+                request_deserializer=spb.ExecuteSqlRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+            "NewSession": grpc.unary_unary_rpc_method_handler(
+                new_session,
+                request_deserializer=spb.SessionRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+            "ReleaseSession": grpc.unary_unary_rpc_method_handler(
+                release_session,
+                request_deserializer=spb.SessionRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+        })
+
+
+class SqlClient:
+    """Thin client for the SQL protocol (used by the shell and tests)."""
+
+    def __init__(self, address: str, session_id: Optional[str] = None):
+        self._channel = grpc.insecure_channel(address)
+        self.session_id = session_id or uuid.uuid4().hex
+
+    def sql(self, query: str, conf: Optional[dict] = None):
+        import pyarrow as pa
+        rpc = self._channel.unary_stream(
+            f"/{_SQL_SERVICE}/ExecuteSql",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=spb.ExecuteSqlResponse.FromString)
+        chunks = []
+        for resp in rpc(spb.ExecuteSqlRequest(session_id=self.session_id,
+                                              sql=query,
+                                              conf=dict(conf or {}))):
+            if resp.error:
+                raise RuntimeError(resp.error)
+            if resp.arrow_ipc:
+                chunks.append(pa.ipc.open_stream(resp.arrow_ipc).read_all())
+        if not chunks:
+            return pa.table({})
+        return pa.concat_tables(chunks)
+
+    def close(self):
+        self._channel.close()

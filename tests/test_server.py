@@ -1,0 +1,50 @@
+"""SQL protocol server: real gRPC round-trips, session isolation, eviction."""
+
+import pandas as pd
+import pytest
+
+from sail_tpu.server import SessionManager, SqlClient, SqlServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = SqlServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def test_sql_over_grpc(server):
+    client = SqlClient(f"127.0.0.1:{server.port}")
+    out = client.sql("SELECT 1 AS a, 'x' AS b").to_pandas()
+    assert out.a.tolist() == [1] and out.b.tolist() == ["x"]
+
+
+def test_session_state_persists_and_isolates(server):
+    c1 = SqlClient(f"127.0.0.1:{server.port}")
+    c2 = SqlClient(f"127.0.0.1:{server.port}")
+    c1.sql("CREATE TEMP VIEW v AS SELECT 42 AS x")
+    assert c1.sql("SELECT x FROM v").to_pandas().x.tolist() == [42]
+    with pytest.raises(RuntimeError, match="table not found"):
+        c2.sql("SELECT x FROM v")
+
+
+def test_error_crosses_wire(server):
+    client = SqlClient(f"127.0.0.1:{server.port}")
+    with pytest.raises(RuntimeError, match="SqlSyntaxError"):
+        client.sql("SELEC nope")
+
+
+def test_large_result_chunks(server):
+    client = SqlClient(f"127.0.0.1:{server.port}")
+    n = 200_000
+    out = client.sql(f"SELECT id FROM range(0, {n})")
+    assert out.num_rows == n
+
+
+def test_session_eviction():
+    m = SessionManager(timeout_s=0.0)
+    m.get_or_create("a")
+    import time
+    time.sleep(0.01)
+    m.get_or_create("b")
+    assert len(m) == 1  # "a" evicted on the next access
