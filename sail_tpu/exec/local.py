@@ -449,39 +449,131 @@ class LocalExecutor:
             out = _shrink(out, p.limit)
         return HostBatch(out, child.dicts)
 
+    def _pipeline_chain(self, p: pn.PlanNode):
+        """Collect the Filter/Project chain under ``p`` (top-down) and the
+        batch below it — the chain fuses into the consumer's jit so XLA
+        sees one program (no intermediate HBM materialization)."""
+        chain = []
+        node = p
+        while isinstance(node, (pn.FilterExec, pn.ProjectExec)):
+            chain.append(node)
+            node = node.input
+        child = self.run(node)
+        return chain, child, node
+
+    def _compile_chain(self, chain, bottom: HostBatch, bottom_node: pn.PlanNode):
+        """Returns (chain_fn, out_dicts, out_schema): chain_fn maps the
+        bottom batch's (cols, sel) to the top of the chain's (cols, sel).
+        Must be called at bind time (host): dictionaries propagate level by
+        level."""
+        levels = list(reversed(chain))  # bottom-up
+        cur_batch = bottom
+        cur_schema = bottom_node.schema
+        steps = []
+        for node in levels:
+            comp = self._compiler(cur_batch, cur_schema)
+            if isinstance(node, pn.FilterExec):
+                c = comp.compile(node.condition)
+                steps.append(("filter", c))
+                # dicts/schema unchanged
+            else:
+                compiled = [comp.compile(e) for _, e in node.exprs]
+                steps.append(("project", compiled,
+                              [rx.rex_type(e) for _, e in node.exprs]))
+                new_dicts = {_col_name(i): c.dictionary
+                             for i, c in enumerate(compiled)
+                             if c.dictionary is not None}
+                # fabricate a dict-only HostBatch view for the next level's
+                # compiler (only .dicts is consulted at bind time)
+                cur_batch = HostBatch(cur_batch.device, new_dicts)
+                cur_schema = node.schema
+        out_dicts = dict(cur_batch.dicts)
+
+        def chain_fn(cols, sel):
+            for step in steps:
+                if step[0] == "filter":
+                    d, v = step[1].fn(cols)
+                    keep = d.astype(jnp.bool_)
+                    if v is not None:
+                        keep = keep & v
+                    sel = sel & keep
+                else:
+                    _, compiled, types = step
+                    new_cols = []
+                    for c, t in zip(compiled, types):
+                        d, v = c.fn(cols)
+                        jdt = physical_jnp_dtype(t)
+                        if d.dtype != jnp.dtype(jdt):
+                            d = d.astype(jdt)
+                        new_cols.append((d, v))
+                    cols = new_cols
+            return cols, sel
+
+        return chain_fn, out_dicts, cur_schema
+
     def _exec_AggregateExec(self, p: pn.AggregateExec) -> HostBatch:
-        child = self.run(p.input)
+        # Fuse the Filter/Project chain under the aggregate into ONE jitted
+        # program: no intermediate batch materializes in HBM (the TPC-H Q1
+        # hot path — filter, derived-expression projection, aggregation —
+        # compiles to a single XLA executable). Under EXPLAIN ANALYZE run
+        # unfused so every operator reports its own rows/time.
+        from .. import telemetry as tel
+        if tel.current_collector() is not None:
+            chain, child, bottom_node = [], self.run(p.input), p.input
+        else:
+            chain, child, bottom_node = self._pipeline_chain(p.input)
+        try:
+            return self._agg_with_chain(p, chain, child, bottom_node)
+        except HostFallback:
+            # chains needing host evaluation (string UDFs, host-only casts)
+            # cannot fuse — run the chain operators unfused instead
+            if chain:
+                child = self.run(chain[0])
+            return self._agg_with_chain(p, [], child, p.input)
+
+    def _agg_with_chain(self, p: pn.AggregateExec, chain, child: HostBatch,
+                        bottom_node: pn.PlanNode) -> HostBatch:
         dev = child.device
+        in_schema = p.input.schema
         if p.group_indices:
             max_groups = p.max_groups_hint or dev.capacity
         else:
             max_groups = 1
 
-        # direct binning when every group key has a known small domain
-        # (dictionary codes / booleans) — no sort needed
-        domains = []
-        for gi in p.group_indices:
-            f = p.input.schema[gi]
-            name = _col_name(gi)
-            if name in child.dicts:
-                domains.append(len(child.dicts[name]))
-            elif isinstance(f.dtype, dt.BooleanType):
-                domains.append(2)
-            else:
-                domains.append(None)
-        direct_total = 1
-        for d in domains:
-            direct_total = direct_total * (d + 1) if d is not None else None
-            if direct_total is None:
-                break
-        use_direct = (p.group_indices and direct_total is not None
-                      and direct_total <= 4096)
+        chain_key = tuple((type(n).__name__,
+                           n.condition if isinstance(n, pn.FilterExec) else n.exprs)
+                          for n in chain)
 
         def make_builder(mg):
             def builder():
+                chain_fn, top_dicts, _ = self._compile_chain(chain, child,
+                                                             bottom_node)
+                # direct binning when every group key has a known small
+                # domain (dictionary codes / booleans) — no sort needed.
+                # Decided at bind time; the cache key's dictionary identity
+                # pins the decision's inputs.
+                domains = []
+                for gi in p.group_indices:
+                    f = in_schema[gi]
+                    name = _col_name(gi)
+                    if name in top_dicts:
+                        domains.append(len(top_dicts[name]))
+                    elif isinstance(f.dtype, dt.BooleanType):
+                        domains.append(2)
+                    else:
+                        domains.append(None)
+                direct_total = 1
+                for d in domains:
+                    direct_total = direct_total * (d + 1) if d is not None else None
+                    if direct_total is None:
+                        break
+                use_direct = (p.group_indices and direct_total is not None
+                              and direct_total <= 4096)
+
                 def fn(cols, sel):
+                    cols, sel = chain_fn(cols, sel)
                     key_cols = [Column(cols[i][0], cols[i][1],
-                                       p.input.schema[i].dtype)
+                                       in_schema[i].dtype)
                                 for i in p.group_indices]
                     if use_direct:
                         ctx, sorted_keys = aggk.group_rows_direct(
@@ -493,41 +585,43 @@ class LocalExecutor:
                     for a in p.aggs:
                         arg = None if a.arg is None else \
                             Column(cols[a.arg][0], cols[a.arg][1],
-                                   p.input.schema[a.arg].dtype)
+                                   in_schema[a.arg].dtype)
                         col = self._run_agg(ctx, a, arg)
                         outs.append((col.data, col.validity))
                     return ([(g.data, g.validity) for g in gkeys], outs,
                             aggk.group_sel(ctx), ctx.num_groups,
                             aggk.group_overflow(ctx))
-                return fn, None
+                return fn, top_dicts
             return builder
 
-        key = self._op_key("agg", p.group_indices, p.aggs, max_groups,
-                           tuple((f.name, f.dtype) for f in p.input.schema))
-        fn, _ = self._jitted(key, self._dict_objs(child), make_builder(max_groups))
+        key = self._op_key("agg", chain_key, p.group_indices, p.aggs, max_groups,
+                           tuple((f.name, f.dtype) for f in bottom_node.schema))
+        fn, top_dicts = self._jitted(key, self._dict_objs(child),
+                                     make_builder(max_groups))
         gk, aggs_out, gsel, n_groups, overflow = fn(self._cols(child), dev.sel)
         if p.max_groups_hint and bool(overflow):
-            key2 = self._op_key("agg", p.group_indices, p.aggs, dev.capacity,
-                               tuple((f.name, f.dtype) for f in p.input.schema))
-            fn2, _ = self._jitted(key2, self._dict_objs(child),
-                                  make_builder(dev.capacity))
+            key2 = self._op_key("agg2", chain_key, p.group_indices, p.aggs,
+                                dev.capacity,
+                                tuple((f.name, f.dtype) for f in bottom_node.schema))
+            fn2, top_dicts = self._jitted(key2, self._dict_objs(child),
+                                          make_builder(dev.capacity))
             gk, aggs_out, gsel, n_groups, overflow = fn2(self._cols(child), dev.sel)
         out_cols: Dict[str, Column] = {}
         out_dicts: Dict[str, pa.Array] = {}
         for j, gi in enumerate(p.group_indices):
             k = _col_name(j)
-            out_cols[k] = Column(gk[j][0], gk[j][1], p.input.schema[gi].dtype)
+            out_cols[k] = Column(gk[j][0], gk[j][1], in_schema[gi].dtype)
             src = _col_name(gi)
-            if src in child.dicts:
-                out_dicts[k] = child.dicts[src]
+            if src in top_dicts:
+                out_dicts[k] = top_dicts[src]
         ng = len(p.group_indices)
         for j, a in enumerate(p.aggs):
             k = _col_name(ng + j)
             out_cols[k] = Column(aggs_out[j][0], aggs_out[j][1], a.out_dtype)
             if a.arg is not None and a.fn in ("min", "max", "first", "last"):
                 src = _col_name(a.arg)
-                if src in child.dicts:
-                    out_dicts[k] = child.dicts[src]
+                if src in top_dicts:
+                    out_dicts[k] = top_dicts[src]
         out = DeviceBatch(out_cols, gsel)
         out = _shrink(out, int(n_groups))
         return HostBatch(out, out_dicts)

@@ -314,7 +314,11 @@ class Resolver:
                 r = self._resolve_expr(a, Scope([], None, {}))
                 if not isinstance(r, rx.RLit):
                     raise ResolutionError("range() arguments must be literals")
-                vals.append(int(r.value.value))
+                try:
+                    vals.append(int(r.value.value))
+                except (TypeError, ValueError) as e:
+                    raise ResolutionError(
+                        f"range() arguments must be integers: {e}") from e
             if len(vals) == 1:
                 start, end, step = 0, vals[0], 1
             else:

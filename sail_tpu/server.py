@@ -88,9 +88,14 @@ class SqlServer:
     def _service(self):
         def execute_sql(request: spb.ExecuteSqlRequest, context):
             import pyarrow as pa
-            sid = request.session_id or uuid.uuid4().hex
             try:
-                session = self.sessions.get_or_create(sid, dict(request.conf))
+                if request.session_id:
+                    session = self.sessions.get_or_create(
+                        request.session_id, dict(request.conf))
+                else:
+                    # anonymous one-shot: never registered, dies with the RPC
+                    from .session import SparkSession
+                    session = SparkSession(dict(request.conf))
                 table = session.sql(request.sql).toArrow()
                 for chunk_start in range(0, max(table.num_rows, 1),
                                          self.CHUNK_ROWS):

@@ -105,3 +105,16 @@ def test_string_returning_udf_on_date_args(spark):
         .createOrReplaceTempView("dd")
     out = s2.table("dd").select(year_str(col("d")).alias("y")).toPandas()
     assert out.y.tolist() == ["2020", "2021"]
+
+
+def test_string_udf_under_aggregate_falls_back_unfused(spark):
+    @udf(returnType=dt.StringType())
+    def tag(x):
+        return f"t{x % 3}"
+
+    s2 = SparkSession({})
+    s2.createDataFrame(pd.DataFrame({"x": range(30)})).createOrReplaceTempView("au")
+    s2.udf.register("tag", tag)
+    out = s2.sql("SELECT tag(x) t, count(*) c FROM au GROUP BY t ORDER BY t").toPandas()
+    assert out.t.tolist() == ["t0", "t1", "t2"]
+    assert out.c.tolist() == [10, 10, 10]
