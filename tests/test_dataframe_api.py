@@ -1,0 +1,68 @@
+"""PySpark-surface DataFrame API integration (joins, groupBy, writer)."""
+
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession, col
+
+
+@pytest.fixture()
+def spark():
+    return SparkSession({})
+
+
+def test_join_groupby_chain(spark):
+    orders = spark.createDataFrame(pd.DataFrame({
+        "cust": [1, 2, 1, 3, 2, 1], "amount": [10.0, 20.0, 30.0, 5.0, 15.0, 25.0]}))
+    custs = spark.createDataFrame(pd.DataFrame({
+        "cust": [1, 2, 3], "name": ["ann", "bob", "cat"], "vip": [True, False, True]}))
+    doubled = (orders.join(custs, on="cust", how="inner")
+               .filter(col("amount") > 8)
+               .withColumn("amount2", col("amount") * 2)
+               .groupBy("name").max("amount2")
+               .orderBy("name").toPandas())
+    assert doubled["max(amount2)"].tolist() == [60.0, 40.0]
+    df = (orders.join(custs, on="cust")
+          .filter(col("amount") > 8)
+          .groupBy("name")
+          .sum("amount")
+          .orderBy("name")
+          .toPandas())
+    assert df.name.tolist() == ["ann", "bob"]
+    assert df["sum(amount)"].tolist() == [65.0, 35.0]
+
+
+def test_semi_anti_api(spark):
+    a = spark.createDataFrame(pd.DataFrame({"k": [1, 2, 3, 4]}))
+    b = spark.createDataFrame(pd.DataFrame({"k": [2, 4]}))
+    semi = a.join(b, on="k", how="left_semi").toPandas()
+    anti = a.join(b, on="k", how="left_anti").toPandas()
+    assert sorted(semi.k) == [2, 4] and sorted(anti.k) == [1, 3]
+
+
+def test_union_distinct_sort(spark):
+    a = spark.createDataFrame(pd.DataFrame({"x": [1, 2, 2]}))
+    b = spark.createDataFrame(pd.DataFrame({"x": [2, 3]}))
+    out = a.union(b).distinct().orderBy(col("x").desc()).toPandas()
+    assert out.x.tolist() == [3, 2, 1]
+
+
+def test_writer_roundtrip_modes(spark, tmp_path):
+    df = spark.createDataFrame(pd.DataFrame({"x": range(10)}))
+    path = str(tmp_path / "t")
+    df.write.parquet(path)
+    with pytest.raises(FileExistsError):
+        df.write.parquet(path)
+    df.write.mode("ignore").parquet(path)  # no-op
+    df.write.mode("overwrite").parquet(path)
+    back = spark.read.parquet(path).toPandas()
+    assert sorted(back.x) == list(range(10))
+
+
+def test_collect_rows_and_schema(spark):
+    df = spark.createDataFrame(pd.DataFrame({"a": [1], "b": ["z"]}))
+    rows = df.collect()
+    assert rows[0].a == 1 and rows[0]["b"] == "z" and rows[0][1] == "z"
+    assert df.columns == ["a", "b"]
+    assert dict(df.dtypes)["a"] == "bigint"
+    assert df.count() == 1
