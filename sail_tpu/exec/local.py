@@ -756,7 +756,9 @@ class LocalExecutor:
         build_names = list(r_dev_cols.keys()) if jt not in ("semi", "anti") else []
 
         has_dup = bool(has_dup_a)
-        if not has_dup and p.residual is None:
+        # full outer always takes the expanding path (it appends unmatched
+        # build rows, which the unique fast path cannot express)
+        if not has_dup and p.residual is None and jt != "full":
             ukey = self._op_key("join_unique", jt, len(build_names), schema_key)
 
             def ubuilder():

@@ -66,3 +66,26 @@ def test_collect_rows_and_schema(spark):
     assert df.columns == ["a", "b"]
     assert dict(df.dtypes)["a"] == "bigint"
     assert df.count() == 1
+
+
+def test_outer_joins_null_keys_and_duplicates(spark):
+    a = pd.DataFrame({"k": [1, 2, 3, 3, None], "va": [10, 20, 30, 31, 40]})
+    b = pd.DataFrame({"k": [2, 3, 4, None], "vb": [200, 300, 400, 500]})
+    spark.createDataFrame(a.astype({"k": "Int64"})).createOrReplaceTempView("ja")
+    spark.createDataFrame(b.astype({"k": "Int64"})).createOrReplaceTempView("jb")
+    expected_rows = {
+        # SQL: NULL keys never match
+        "inner": 3,           # (2), (3,30), (3,31)
+        "left": 5,            # + unmatched (1), (None)
+        "right": 5,           # + unmatched (4), (None)
+        "full": 7,
+    }
+    for how, sqlhow in [("inner", "JOIN"), ("left", "LEFT JOIN"),
+                        ("right", "RIGHT JOIN"), ("full", "FULL OUTER JOIN")]:
+        got = spark.sql(
+            f"SELECT ja.k AS ak, va, jb.k AS bk, vb "
+            f"FROM ja {sqlhow} jb ON ja.k = jb.k").toPandas()
+        assert len(got) == expected_rows[how], (how, got)
+        matched = got.dropna(subset=["ak", "bk"])
+        assert sorted(zip(matched.ak, matched.va, matched.vb)) == \
+            [(2, 20, 200), (3, 30, 300), (3, 31, 300)], how
