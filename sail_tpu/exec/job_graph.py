@@ -172,7 +172,20 @@ def decode_fragment(plan_bytes: bytes, table_ipc: Optional[bytes],
             files = list(p.paths)
             mine = tuple(f for i, f in enumerate(sorted(files))
                          if i % num_partitions == partition)
-            return dataclasses.replace(p, paths=mine or (files[0],))
+            if not mine:
+                # More partitions than files: this task reads nothing. An
+                # empty memory table (projected schema) keeps the plan
+                # executable without re-reading files[0] (which would
+                # duplicate its rows in the job result).
+                from ..columnar.arrow_interop import spec_type_to_arrow
+                empty = pa.Table.from_arrays(
+                    [pa.array([], type=spec_type_to_arrow(f.dtype))
+                     for f in p.schema],
+                    names=[f.name for f in p.schema])
+                return dataclasses.replace(p, out_schema=p.schema,
+                                           source=empty, paths=(),
+                                           format="memory", projection=None)
+            return dataclasses.replace(p, paths=mine)
         if isinstance(p, pn.JoinExec):
             return dataclasses.replace(p, left=attach(p.left), right=attach(p.right))
         if hasattr(p, "input") and p.input is not None:

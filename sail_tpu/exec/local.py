@@ -654,6 +654,8 @@ class LocalExecutor:
         left = self.run(p.left)
         right = self.run(p.right)
         jt = p.join_type
+        if jt == "anti" and p.null_aware:
+            return self._null_aware_anti(p, left, right)
         if jt == "cross" and not p.left_keys:
             out = self._cross_join(p, left, right)
             if p.residual is not None:
@@ -679,6 +681,42 @@ class LocalExecutor:
             out = self._join(flipped, right, left)
             return _reorder_right(out, len(p.right.schema), len(p.left.schema))
         return self._join(p, left, right)
+
+    def _null_aware_anti(self, p: pn.JoinExec, left: HostBatch,
+                         right: HostBatch) -> HostBatch:
+        """NOT IN (subquery) anti join (reference role:
+        crates/sail-plan null-aware anti join selection).
+
+        The IN key is the last key pair; earlier pairs are correlation
+        keys. NOT IN over an empty set is TRUE; any NULL build key makes
+        every membership test unknown (no rows); NULL probe keys are
+        excluded while the build side is non-empty.
+        """
+        rcomp = self._compiler(right, p.right.schema)
+        rdata, rval = self._eval(rcomp.compile(p.right_keys[-1]), right)
+        rsel = right.device.sel
+        if int(jnp.sum(rsel)) == 0:
+            return left
+        # Residual conjuncts are per-row correlation too: the membership set
+        # differs per probe row, so the global NULL shortcuts don't apply.
+        correlated = len(p.left_keys) > 1 or p.residual is not None
+        if rval is not None and bool(jnp.any(rsel & ~rval)):
+            if correlated:
+                raise ExecutionError(
+                    "correlated NOT IN with NULL subquery keys not supported")
+            return HostBatch(
+                left.device.with_sel(jnp.zeros_like(left.device.sel)),
+                left.dicts)
+        out = self._join(p, left, right)
+        lcomp = self._compiler(left, p.left.schema)
+        ldata, lval = self._eval(lcomp.compile(p.left_keys[-1]), left)
+        if lval is not None and bool(jnp.any(left.device.sel & ~lval)):
+            if correlated:
+                raise ExecutionError(
+                    "correlated NOT IN with NULL probe keys not supported")
+            out = HostBatch(out.device.with_sel(out.device.sel & lval),
+                            out.dicts)
+        return out
 
     def _compile_join_keys(self, p: pn.JoinExec, left: HostBatch, right: HostBatch,
                            seed: int):
@@ -793,6 +831,7 @@ class LocalExecutor:
                                 "inner", list(build_payload.columns.keys()),
                                 cap)
         exp_batch, pi, is_match = res.batch, res.probe_index, res.is_match
+        bix = res.build_index
         ok = exp_batch.sel
         if p.residual is not None:
             comb_schema = tuple(p.left.schema) + tuple(p.right.schema)
@@ -851,16 +890,24 @@ class LocalExecutor:
             sel = jnp.concatenate([ok, unmatched])
             out = DeviceBatch(cols, sel)
             if jt == "full":
-                out = self._append_unmatched_build(out, p, bt, ranges, left,
-                                                   build_payload, ok, pi)
+                out = self._append_unmatched_build(
+                    out, p, bt, ranges, left, build_payload, ok, bix,
+                    has_residual=p.residual is not None)
             return HostBatch(out, merged_dicts)
         raise ExecutionError(f"join type {jt!r} not implemented")
 
     def _append_unmatched_build(self, out: DeviceBatch, p, bt, ranges, left,
-                                build_payload, ok, pi) -> DeviceBatch:
-        # NOTE: residual-filtered matches are conservatively treated as
-        # matches for the build side in v0 full outer joins.
-        matched_build = joink.build_matched_mask(bt, ranges, left.device.sel)
+                                build_payload, ok, bix,
+                                has_residual=False) -> DeviceBatch:
+        if has_residual:
+            # A build row counts as matched only if at least one of its
+            # expanded rows survived the residual filter; scatter the
+            # surviving flags back to build positions.
+            bcap0 = build_payload.sel.shape[0]
+            matched_build = jnp.zeros(bcap0, dtype=jnp.bool_).at[bix].max(
+                ok, mode="drop")
+        else:
+            matched_build = joink.build_matched_mask(bt, ranges, left.device.sel)
         unmatched = build_payload.sel & ~matched_build
         n_left = len(p.left.schema)
         bcap = matched_build.shape[0]

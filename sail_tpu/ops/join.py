@@ -174,6 +174,7 @@ class ExpandResult(NamedTuple):
     batch: DeviceBatch
     probe_index: jnp.ndarray  # int32[out_capacity] originating probe row
     is_match: jnp.ndarray     # bool[out_capacity] row is a key match
+    build_index: jnp.ndarray  # int32[out_capacity] originating build row
 
 
 def join_expand(bt: BuildTable, ranges: MatchRanges, probe: DeviceBatch,
@@ -211,7 +212,7 @@ def join_expand(bt: BuildTable, ranges: MatchRanges, probe: DeviceBatch,
         data = c.data[bidx]
         validity = is_match if c.validity is None else is_match & c.validity[bidx]
         cols[name] = Column(data, validity, c.dtype)
-    return ExpandResult(DeviceBatch(cols, out_sel), pi, is_match)
+    return ExpandResult(DeviceBatch(cols, out_sel), pi, is_match, bidx)
 
 
 def build_matched_mask(bt: BuildTable, ranges: MatchRanges, probe_sel) -> jnp.ndarray:

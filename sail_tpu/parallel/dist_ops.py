@@ -58,7 +58,8 @@ def _local_partial_agg(key_data, key_type: dt.DataType, vals, sel, max_groups):
     sums = [aggk.agg_sum(ctx, Column(v, None, dt.DoubleType()), dt.DoubleType()).data
             for v in vals]
     cnt = aggk.agg_count(ctx, None).data
-    return gkey.data, sums, cnt, aggk.group_sel(ctx)
+    return (gkey.data, sums, cnt, aggk.group_sel(ctx),
+            aggk.group_overflow(ctx))
 
 
 def make_distributed_agg(mesh: Mesh, key_type: dt.DataType, n_vals: int,
@@ -68,18 +69,26 @@ def make_distributed_agg(mesh: Mesh, key_type: dt.DataType, n_vals: int,
       local partial agg → hash all_to_all of partial rows → final agg
 
     Inputs (sharded [P, n]): key, vals..., sel.
-    Outputs (sharded [P, local_groups]): key, sums..., count, group_sel.
+    Outputs (sharded [P, local_groups]): key, sums..., count, group_sel,
+    plus a per-shard overflow count [P] covering BOTH loss modes: partial
+    groups dropped because a target bucket exceeded ``bucket_cap``, and
+    group-table truncation when a shard saw more than ``local_groups``
+    distinct keys (locally or after the exchange). Callers MUST host-check
+    ``overflow.max() == 0`` and re-run with larger capacities otherwise
+    (same detect-and-rerun contract as ``make_shuffle``).
     """
     nparts = mesh.shape[DATA_AXIS]
     spec = P(DATA_AXIS)
 
     def step(key, vals, sel):
         k, v, s = key[0], [x[0] for x in vals], sel[0]
-        gkey, sums, cnt, gsel = _local_partial_agg(k, key_type, v, s, local_groups)
+        gkey, sums, cnt, gsel, l_over = _local_partial_agg(
+            k, key_type, v, s, local_groups)
         # shuffle partial groups by key hash so equal keys co-locate
         pid = (hash64([gkey], [key_type]) % jnp.uint64(nparts)).astype(jnp.int32)
         arrays = [gkey] + sums + [cnt]
-        perm, valid, _ = bucket_by_partition(pid, gsel, nparts, bucket_cap)
+        perm, valid, overflow = bucket_by_partition(pid, gsel, nparts,
+                                                    bucket_cap)
         bufs = [a[perm].reshape(nparts, bucket_cap) for a in arrays]
         valid2 = valid.reshape(nparts, bucket_cap)
         exch = [jax.lax.all_to_all(b, DATA_AXIS, 0, 0, tiled=True) for b in bufs]
@@ -97,11 +106,14 @@ def make_distributed_agg(mesh: Mesh, key_type: dt.DataType, n_vals: int,
         fcnt = aggk.agg_sum(ctx, Column(rcnt, None, dt.LongType()),
                             dt.LongType()).data
         fsel = aggk.group_sel(ctx)
+        total_overflow = (overflow.astype(jnp.int32)
+                          + l_over.astype(jnp.int32)
+                          + aggk.group_overflow(ctx).astype(jnp.int32))
         return (fkey[None], tuple(f[None] for f in fsums), fcnt[None],
-                fsel[None])
+                fsel[None], total_overflow[None])
 
     wrapped = jax.shard_map(step, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=(spec, spec, spec, spec))
+                            out_specs=(spec, spec, spec, spec, spec))
     return jax.jit(wrapped)
 
 

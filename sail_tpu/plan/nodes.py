@@ -219,6 +219,10 @@ class JoinExec(PlanNode):
     left_keys: Tuple[rx.Rex, ...] = ()
     right_keys: Tuple[rx.Rex, ...] = ()
     residual: Optional[rx.Rex] = None
+    # NOT IN (subquery) anti joins: NULL keys mean "unknown", so a NULL in
+    # the build keys removes every probe row and NULL probe keys are
+    # excluded when the build side is non-empty.
+    null_aware: bool = False
 
     @property
     def schema(self) -> Schema:

@@ -173,7 +173,8 @@ def _push_into_join(j: pn.JoinExec, conjuncts: List[rx.Rex]) -> pn.PlanNode:
         residual = _and(parts)
         both = []
     node: pn.PlanNode = pn.JoinExec(new_left, new_right, join_type,
-                                    tuple(new_lk), tuple(new_rk), residual)
+                                    tuple(new_lk), tuple(new_rk), residual,
+                                    null_aware=j.null_aware)
     remaining = kept + both
     if remaining:
         node = pn.FilterExec(node, _and(remaining))
@@ -286,7 +287,8 @@ def _prune(p: pn.PlanNode, required: Set[int]):
             for old, new in rremap.items():
                 comb[old + n_left] = new + len(left.schema)
             residual = _remap_indices(residual, comb)
-        node = pn.JoinExec(left, right, p.join_type, lk, rk, residual)
+        node = pn.JoinExec(left, right, p.join_type, lk, rk, residual,
+                           null_aware=p.null_aware)
         out_remap = dict(lremap)
         if p.join_type not in ("semi", "anti"):
             for old, new in rremap.items():

@@ -396,17 +396,27 @@ class Resolver:
             sub_plan, Scope([], None, dict(cscope.ctes)), cscope)
         sub_node, left_keys, right_keys, residual = _decorrelate(sub_node)
         if in_child is not None:
-            # IN: add equality on the subquery's (single) output column
+            # IN: add equality on the subquery's (single) output column.
+            # Both sides are cast to the common key type — the join kernel
+            # packs keys at the probe key's width, so an uncast wider build
+            # key would alias (e.g. int32 IN (SELECT bigint)).
             probe = self._resolve_expr(in_child, cscope)
             if len(sub_node.schema) < 1:
                 raise ResolutionError("IN subquery must output one column")
-            left_keys = left_keys + [probe]
             f0 = sub_node.schema[0]
-            right_keys = right_keys + [rx.BoundRef(0, f0.name, f0.dtype, f0.nullable)]
+            build: rx.Rex = rx.BoundRef(0, f0.name, f0.dtype, f0.nullable)
+            ktype = dt.common_type(rx.rex_type(probe), f0.dtype)
+            if rx.rex_type(probe) != ktype:
+                probe = rx.RCast(probe, ktype)
+            if f0.dtype != ktype:
+                build = rx.RCast(build, ktype)
+            left_keys = left_keys + [probe]
+            right_keys = right_keys + [build]
         join_type = "anti" if negated else "semi"
         node = pn.JoinExec(child, sub_node, join_type,
                            tuple(left_keys), tuple(right_keys),
-                           _combine_residual(residual, len(child.schema)))
+                           _combine_residual(residual, len(child.schema)),
+                           null_aware=negated and in_child is not None)
         return node, cscope
 
     def _rewrite_correlated_scalar(self, cmp: ex.Function, sub_pos: int,
@@ -862,9 +872,17 @@ class Resolver:
         right, rscope = self.resolve_query(plan.right, scope, outer)
         if len(left.schema) != len(right.schema):
             raise ResolutionError("set operation inputs have different arity")
-        # coerce right columns to common types
-        right = _coerce_to(right, left.schema)
-        left = _coerce_to(left, right.schema) if False else left
+        # Widen BOTH inputs to the per-column common type (Spark set-op
+        # coercion); the union output schema is then the common schema.
+        common = []
+        for lf, rf in zip(left.schema, right.schema):
+            if isinstance(lf.dtype, dt.NullType) or isinstance(rf.dtype, dt.NullType):
+                cdt = rf.dtype if isinstance(lf.dtype, dt.NullType) else lf.dtype
+            else:
+                cdt = dt.common_type(lf.dtype, rf.dtype)
+            common.append(pn.Field(lf.name, cdt, lf.nullable or rf.nullable))
+        right = _coerce_to(right, common)
+        left = _coerce_to(left, common)
         if plan.op == "union":
             node: pn.PlanNode = pn.UnionExec((left, right), True)
             out_scope = Scope([ScopeField(f.name, (), f.dtype, True)

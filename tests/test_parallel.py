@@ -30,7 +30,8 @@ class TestDistributedAgg:
             mesh, (karr, v1arr, v2arr, sel))
         fn = dist_ops.make_distributed_agg(mesh, dt.LongType(), 2,
                                            local_groups=64, bucket_cap=64)
-        fkey, (s1, s2), cnt, gsel = fn(karr, (v1arr, v2arr), sel)
+        fkey, (s1, s2), cnt, gsel, overflow = fn(karr, (v1arr, v2arr), sel)
+        assert int(np.asarray(overflow).max()) == 0
         fkey, s1, s2, cnt, gsel = map(np.asarray, (fkey, s1, s2, cnt, gsel))
         m = gsel.reshape(-1)
         got = pd.DataFrame({
