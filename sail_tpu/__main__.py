@@ -32,9 +32,13 @@ def main(argv=None):
                                      description="TPU-native Spark-capable engine")
     sub = parser.add_subparsers(dest="command", required=True)
 
-    p_server = sub.add_parser("server", help="run the SQL gRPC server")
+    p_server = sub.add_parser(
+        "server", help="run the Spark Connect server (+ native SQL protocol)")
     p_server.add_argument("--host", default="127.0.0.1")
-    p_server.add_argument("--port", type=int, default=50051)
+    p_server.add_argument("--port", type=int, default=50051,
+                          help="Spark Connect port (15002 is Spark's default)")
+    p_server.add_argument("--sql-port", type=int, default=0,
+                          help="also serve the native SQL protocol here")
 
     p_shell = sub.add_parser("shell", help="interactive SQL shell")
     p_shell.add_argument("--remote", default=None,
@@ -48,13 +52,24 @@ def main(argv=None):
         _ensure_backend()
 
     if args.command == "server":
-        from .server import SqlServer
-        server = SqlServer(args.host, args.port).start()
-        print(f"sail-tpu SQL server listening on {args.host}:{server.port}")
+        from .spark_connect import SparkConnectServer
+        server = SparkConnectServer(args.host, args.port).start()
+        print(f"sail-tpu Spark Connect server listening on "
+              f"sc://{args.host}:{server.port}")
+        sql_server = None
         try:
+            if args.sql_port:
+                from .server import SqlServer
+                sql_server = SqlServer(args.host, args.sql_port).start()
+                print(f"sail-tpu native SQL server listening on "
+                      f"{args.host}:{sql_server.port}")
             server.wait()
         except KeyboardInterrupt:
+            pass
+        finally:
             server.stop()
+            if sql_server is not None:
+                sql_server.stop()
         return 0
 
     if args.command == "shell":
@@ -72,8 +87,9 @@ def main(argv=None):
 
 def _shell(remote):
     if remote:
-        from .server import SqlClient
-        client = SqlClient(remote)
+        # the server speaks Spark Connect; the shell does too
+        from .spark_connect.client import SparkConnectClient
+        client = SparkConnectClient(remote)
         run = client.sql
     else:
         from . import SparkSession

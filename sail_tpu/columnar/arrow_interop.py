@@ -226,6 +226,12 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> HostBatch:
     return HostBatch(device, dicts)
 
 
+def column_values_to_arrow(data, validity, d, dictionary=None) -> pa.Array:
+    """Convert host numpy column data (physical encoding) to a pa.Array."""
+    name_in_dicts = dictionary is not None
+    return _column_to_arrow(data, validity, d, dictionary, name_in_dicts)
+
+
 def to_arrow(batch: HostBatch) -> pa.Table:
     """Download a HostBatch to a pyarrow Table (live rows only, in order)."""
     dev = batch.device
@@ -236,43 +242,46 @@ def to_arrow(batch: HostBatch) -> pa.Table:
     for name, col in dev.columns.items():
         data = np.asarray(col.data)[idx]
         validity = None if col.validity is None else np.asarray(col.validity)[idx]
-        d = col.dtype
-        if isinstance(d, (dt.StringType, dt.BinaryType)) and name in batch.dicts:
-            dictionary = batch.dicts[name]
-            codes = pa.array(data.astype(np.int32),
-                             mask=None if validity is None else ~validity)
-            arr = pa.DictionaryArray.from_arrays(codes, dictionary).cast(
-                pa.string() if isinstance(d, dt.StringType) else pa.binary())
-        elif isinstance(d, (dt.ArrayType, dt.StructType, dt.MapType)) and name in batch.dicts:
-            dictionary = batch.dicts[name]
-            codes = pa.array(data.astype(np.int32),
-                             mask=None if validity is None else ~validity)
-            arr = pa.DictionaryArray.from_arrays(codes, dictionary).cast(dictionary.type)
-        elif isinstance(d, dt.DecimalType) and d.physical_dtype == "int64":
-            arr = _unscaled_int64_to_decimal(data, validity, d)
-        elif isinstance(d, dt.DecimalType):
-            arr = pa.array(data, mask=None if validity is None else ~validity)
-            arr = arr.cast(pa.decimal128(d.precision, d.scale), safe=False)
-        elif isinstance(d, dt.NullType):
-            arr = pa.nulls(len(data))
-        else:
-            at = spec_type_to_arrow(d)
-            if isinstance(d, dt.TimestampType):
-                arr = pa.array(data.astype("datetime64[us]"),
-                               mask=None if validity is None else ~validity).cast(at)
-            elif isinstance(d, dt.DateType):
-                arr = pa.array(data.astype(np.int32),
-                               mask=None if validity is None else ~validity).cast(at)
-            elif isinstance(d, dt.DayTimeIntervalType):
-                arr = pa.array(data.astype("timedelta64[us]"),
-                               mask=None if validity is None else ~validity)
-            else:
-                arr = pa.array(data, mask=None if validity is None else ~validity)
-                if arr.type != at:
-                    arr = arr.cast(at, safe=False)
+        arr = _column_to_arrow(data, validity, col.dtype,
+                               batch.dicts.get(name), name in batch.dicts)
         arrays.append(arr)
-        fields.append(pa.field(name, arrays[-1].type, nullable=True))
+        fields.append(pa.field(name, arr.type, nullable=True))
     return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def _column_to_arrow(data, validity, d, dictionary, has_dict) -> pa.Array:
+    if isinstance(d, (dt.StringType, dt.BinaryType)) and has_dict:
+        codes = pa.array(data.astype(np.int32),
+                         mask=None if validity is None else ~validity)
+        arr = pa.DictionaryArray.from_arrays(codes, dictionary).cast(
+            pa.string() if isinstance(d, dt.StringType) else pa.binary())
+    elif isinstance(d, (dt.ArrayType, dt.StructType, dt.MapType)) and has_dict:
+        codes = pa.array(data.astype(np.int32),
+                         mask=None if validity is None else ~validity)
+        arr = pa.DictionaryArray.from_arrays(codes, dictionary).cast(dictionary.type)
+    elif isinstance(d, dt.DecimalType) and d.physical_dtype == "int64":
+        arr = _unscaled_int64_to_decimal(data, validity, d)
+    elif isinstance(d, dt.DecimalType):
+        arr = pa.array(data, mask=None if validity is None else ~validity)
+        arr = arr.cast(pa.decimal128(d.precision, d.scale), safe=False)
+    elif isinstance(d, dt.NullType):
+        arr = pa.nulls(len(data))
+    else:
+        at = spec_type_to_arrow(d)
+        if isinstance(d, dt.TimestampType):
+            arr = pa.array(data.astype("datetime64[us]"),
+                           mask=None if validity is None else ~validity).cast(at)
+        elif isinstance(d, dt.DateType):
+            arr = pa.array(data.astype(np.int32),
+                           mask=None if validity is None else ~validity).cast(at)
+        elif isinstance(d, dt.DayTimeIntervalType):
+            arr = pa.array(data.astype("timedelta64[us]"),
+                           mask=None if validity is None else ~validity)
+        else:
+            arr = pa.array(data, mask=None if validity is None else ~validity)
+            if arr.type != at:
+                arr = arr.cast(at, safe=False)
+    return arr
 
 
 def unify_dictionaries(dict_a: pa.Array, dict_b: pa.Array) -> Tuple[pa.Array, np.ndarray, np.ndarray]:

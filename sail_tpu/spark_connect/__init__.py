@@ -1,0 +1,16 @@
+"""Spark Connect protocol front-end.
+
+Reference role: crates/sail-spark-connect — the gRPC service speaking the
+real `spark.connect` protocol (vendored Apache Spark protos, see
+proto/PROVENANCE.md) so stock Spark Connect clients can attach. The
+proto→spec converters mirror crates/sail-spark-connect/src/proto/plan.rs.
+"""
+
+import os
+import sys
+
+_GEN = os.path.join(os.path.dirname(__file__), "gen")
+if _GEN not in sys.path:
+    sys.path.insert(0, _GEN)
+
+from .service import SparkConnectServer  # noqa: E402,F401

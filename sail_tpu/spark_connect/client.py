@@ -1,0 +1,136 @@
+"""Minimal Spark Connect wire client.
+
+Speaks the exact protocol a stock PySpark ``SparkSession.builder.remote``
+client uses (same protos, same RPC names), so tests exercise true wire
+compatibility even though this image has no pyspark installed.
+Reference role: the client side of crates/sail-spark-connect tests.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, Iterator, List, Optional
+
+import grpc
+
+from . import convert  # noqa: F401  (gen/ path setup)
+
+from spark.connect import base_pb2 as bpb
+from spark.connect import commands_pb2 as cpb
+from spark.connect import relations_pb2 as rpb
+
+_SERVICE = "spark.connect.SparkConnectService"
+
+
+class SparkConnectClient:
+    def __init__(self, address: str, session_id: Optional[str] = None):
+        self._channel = grpc.insecure_channel(address)
+        self.session_id = session_id or str(uuid.uuid4())
+
+        self._execute_plan = self._channel.unary_stream(
+            f"/{_SERVICE}/ExecutePlan",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=bpb.ExecutePlanResponse.FromString)
+        self._analyze_plan = self._channel.unary_unary(
+            f"/{_SERVICE}/AnalyzePlan",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=bpb.AnalyzePlanResponse.FromString)
+        self._config_rpc = self._channel.unary_unary(
+            f"/{_SERVICE}/Config",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=bpb.ConfigResponse.FromString)
+        self._reattach = self._channel.unary_stream(
+            f"/{_SERVICE}/ReattachExecute",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=bpb.ExecutePlanResponse.FromString)
+        self._release_session_rpc = self._channel.unary_unary(
+            f"/{_SERVICE}/ReleaseSession",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=bpb.ReleaseSessionResponse.FromString)
+
+    # -- plan execution ----------------------------------------------------
+    def execute_plan(self, plan: bpb.Plan,
+                     reattachable: bool = False,
+                     operation_id: Optional[str] = None
+                     ) -> Iterator[bpb.ExecutePlanResponse]:
+        req = bpb.ExecutePlanRequest(session_id=self.session_id, plan=plan)
+        if operation_id:
+            req.operation_id = operation_id
+        if reattachable:
+            opt = req.request_options.add()
+            opt.reattach_options.reattachable = True
+        return self._execute_plan(req)
+
+    def _collect_stream(self, responses) -> "pyarrow.Table":  # noqa: F821
+        import pyarrow as pa
+
+        chunks: List[pa.Table] = []
+        sql_result_rel = None
+        for resp in responses:
+            kind = resp.WhichOneof("response_type")
+            if kind == "arrow_batch":
+                chunks.append(
+                    pa.ipc.open_stream(resp.arrow_batch.data).read_all())
+            elif kind == "sql_command_result":
+                sql_result_rel = resp.sql_command_result.relation
+        if sql_result_rel is not None:
+            # lazy result: execute the returned relation
+            return self.execute_relation(sql_result_rel)
+        if not chunks:
+            return pa.table({})
+        return pa.concat_tables(chunks)
+
+    def execute_relation(self, rel: rpb.Relation) -> "pyarrow.Table":  # noqa: F821
+        plan = bpb.Plan()
+        plan.root.CopyFrom(rel)
+        return self._collect_stream(self.execute_plan(plan))
+
+    def sql(self, query: str) -> "pyarrow.Table":  # noqa: F821
+        """spark.sql(): SqlCommand via ExecutePlan, as PySpark does."""
+        plan = bpb.Plan()
+        plan.command.sql_command.input.sql.query = query
+        return self._collect_stream(self.execute_plan(plan))
+
+    # -- analysis ----------------------------------------------------------
+    def schema(self, rel: rpb.Relation):
+        req = bpb.AnalyzePlanRequest(session_id=self.session_id)
+        req.schema.plan.root.CopyFrom(rel)
+        return self._analyze_plan(req).schema.schema
+
+    def explain(self, rel: rpb.Relation) -> str:
+        req = bpb.AnalyzePlanRequest(session_id=self.session_id)
+        req.explain.plan.root.CopyFrom(rel)
+        req.explain.explain_mode = \
+            bpb.AnalyzePlanRequest.Explain.EXPLAIN_MODE_SIMPLE
+        return self._analyze_plan(req).explain.explain_string
+
+    def spark_version(self) -> str:
+        req = bpb.AnalyzePlanRequest(session_id=self.session_id)
+        req.spark_version.SetInParent()
+        return self._analyze_plan(req).spark_version.version
+
+    def ddl_parse(self, ddl: str):
+        req = bpb.AnalyzePlanRequest(session_id=self.session_id)
+        req.ddl_parse.ddl_string = ddl
+        return self._analyze_plan(req).ddl_parse.parsed
+
+    # -- config ------------------------------------------------------------
+    def config_set(self, pairs: Dict[str, str]):
+        req = bpb.ConfigRequest(session_id=self.session_id)
+        for k, v in pairs.items():
+            req.operation.set.pairs.add(key=k, value=v)
+        return self._config_rpc(req)
+
+    def config_get(self, *keys: str) -> Dict[str, str]:
+        req = bpb.ConfigRequest(session_id=self.session_id)
+        req.operation.get.keys.extend(keys)
+        resp = self._config_rpc(req)
+        return {p.key: p.value for p in resp.pairs}
+
+    # -- lifecycle ---------------------------------------------------------
+    def release_session(self):
+        return self._release_session_rpc(
+            bpb.ReleaseSessionRequest(session_id=self.session_id))
+
+    def close(self):
+        self._channel.close()

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Regenerate the Spark Connect protobuf modules.
+# The gRPC service is served via grpc generic handlers (no grpc_tools needed).
+set -e
+cd "$(dirname "$0")"
+mkdir -p gen
+protoc -I proto --python_out=gen \
+  proto/spark/connect/*.proto
+touch gen/__init__.py gen/spark/__init__.py gen/spark/connect/__init__.py

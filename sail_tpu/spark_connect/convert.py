@@ -1,0 +1,546 @@
+"""spark.connect proto → spec IR converters.
+
+Reference role: crates/sail-spark-connect/src/proto/{plan,expression,
+literal,data_type}.rs — the TryFrom impls mapping the Spark Connect
+protocol onto the engine's unresolved spec IR.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from typing import Optional, Tuple
+
+from spark.connect import expressions_pb2 as epb
+from spark.connect import relations_pb2 as rpb
+from spark.connect import types_pb2 as tpb
+
+from ..spec import data_type as dt
+from ..spec import expression as ex
+from ..spec import plan as sp
+from ..spec.literal import Literal as LV
+
+
+class ConvertError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Data types
+# ---------------------------------------------------------------------------
+
+def data_type_from_proto(t: tpb.DataType) -> dt.DataType:
+    kind = t.WhichOneof("kind")
+    if kind is None or kind == "null":
+        return dt.NullType()
+    if kind == "binary":
+        return dt.BinaryType()
+    if kind == "boolean":
+        return dt.BooleanType()
+    if kind == "byte":
+        return dt.ByteType()
+    if kind == "short":
+        return dt.ShortType()
+    if kind == "integer":
+        return dt.IntegerType()
+    if kind == "long":
+        return dt.LongType()
+    if kind == "float":
+        return dt.FloatType()
+    if kind == "double":
+        return dt.DoubleType()
+    if kind == "decimal":
+        d = t.decimal
+        return dt.DecimalType(d.precision if d.HasField("precision") else 10,
+                              d.scale if d.HasField("scale") else 0)
+    if kind in ("string", "char", "var_char"):
+        return dt.StringType()
+    if kind == "date":
+        return dt.DateType()
+    if kind == "timestamp":
+        return dt.TimestampType("UTC")
+    if kind == "timestamp_ntz":
+        return dt.TimestampType(None)
+    if kind == "calendar_interval":
+        return dt.CalendarIntervalType()
+    if kind == "year_month_interval":
+        return dt.YearMonthIntervalType()
+    if kind == "day_time_interval":
+        return dt.DayTimeIntervalType()
+    if kind == "array":
+        return dt.ArrayType(data_type_from_proto(t.array.element_type),
+                            t.array.contains_null)
+    if kind == "map":
+        return dt.MapType(data_type_from_proto(t.map.key_type),
+                          data_type_from_proto(t.map.value_type),
+                          t.map.value_contains_null)
+    if kind == "struct":
+        return dt.StructType(tuple(
+            dt.StructField(f.name, data_type_from_proto(f.data_type),
+                           f.nullable)
+            for f in t.struct.fields))
+    if kind == "unparsed":
+        from ..sql.parser import parse_data_type
+        return parse_data_type(t.unparsed.data_type_string)
+    raise ConvertError(f"unsupported data type kind: {kind}")
+
+
+def data_type_to_proto(d: dt.DataType) -> tpb.DataType:
+    t = tpb.DataType()
+    if isinstance(d, dt.NullType):
+        t.null.SetInParent()
+    elif isinstance(d, dt.BinaryType):
+        t.binary.SetInParent()
+    elif isinstance(d, dt.BooleanType):
+        t.boolean.SetInParent()
+    elif isinstance(d, dt.ByteType):
+        t.byte.SetInParent()
+    elif isinstance(d, dt.ShortType):
+        t.short.SetInParent()
+    elif isinstance(d, dt.IntegerType):
+        t.integer.SetInParent()
+    elif isinstance(d, dt.LongType):
+        t.long.SetInParent()
+    elif isinstance(d, dt.FloatType):
+        t.float.SetInParent()
+    elif isinstance(d, dt.DoubleType):
+        t.double.SetInParent()
+    elif isinstance(d, dt.DecimalType):
+        t.decimal.precision = d.precision
+        t.decimal.scale = d.scale
+    elif isinstance(d, dt.StringType):
+        t.string.SetInParent()
+    elif isinstance(d, dt.DateType):
+        t.date.SetInParent()
+    elif isinstance(d, dt.TimestampType):
+        if d.timezone is None:
+            t.timestamp_ntz.SetInParent()
+        else:
+            t.timestamp.SetInParent()
+    elif isinstance(d, dt.CalendarIntervalType):
+        t.calendar_interval.SetInParent()
+    elif isinstance(d, dt.YearMonthIntervalType):
+        t.year_month_interval.SetInParent()
+    elif isinstance(d, dt.DayTimeIntervalType):
+        t.day_time_interval.SetInParent()
+    elif isinstance(d, dt.ArrayType):
+        t.array.element_type.CopyFrom(data_type_to_proto(d.element_type))
+        t.array.contains_null = d.contains_null
+    elif isinstance(d, dt.MapType):
+        t.map.key_type.CopyFrom(data_type_to_proto(d.key_type))
+        t.map.value_type.CopyFrom(data_type_to_proto(d.value_type))
+        t.map.value_contains_null = d.value_contains_null
+    elif isinstance(d, dt.StructType):
+        for f in d.fields:
+            pf = t.struct.fields.add()
+            pf.name = f.name
+            pf.data_type.CopyFrom(data_type_to_proto(f.data_type))
+            pf.nullable = f.nullable
+    else:
+        raise ConvertError(f"cannot encode data type {d!r}")
+    return t
+
+
+def schema_from_string(s: str) -> dt.StructType:
+    """DDL-formatted ("a INT, b STRING") or type-string schema."""
+    from ..sql.parser import parse_data_type
+    text = s.strip()
+    parsed = None
+    try:
+        parsed = parse_data_type(text if text.lower().startswith("struct")
+                                 else f"struct<{text}>")
+    except Exception:
+        parsed = parse_data_type(text)
+    if not isinstance(parsed, dt.StructType):
+        raise ConvertError(f"schema string is not a struct: {s!r}")
+    return parsed
+
+
+# ---------------------------------------------------------------------------
+# Literals
+# ---------------------------------------------------------------------------
+
+_EPOCH_D = datetime.date(1970, 1, 1)
+_EPOCH_TS = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+
+
+def literal_value_from_proto(l: epb.Expression.Literal) -> LV:
+    kind = l.WhichOneof("literal_type")
+    if kind is None or kind == "null":
+        d = data_type_from_proto(l.null) if l.HasField("null") else dt.NullType()
+        return LV.null(d)
+    if kind == "boolean":
+        return LV.boolean(l.boolean)
+    if kind == "byte":
+        return LV(dt.ByteType(), int(l.byte))
+    if kind == "short":
+        return LV(dt.ShortType(), int(l.short))
+    if kind == "integer":
+        return LV.int32(l.integer)
+    if kind == "long":
+        return LV.int64(l.long)
+    if kind == "float":
+        return LV(dt.FloatType(), float(l.float))
+    if kind == "double":
+        return LV.float64(l.double)
+    if kind == "decimal":
+        v = decimal.Decimal(l.decimal.value)
+        precision = l.decimal.precision if l.decimal.HasField("precision") \
+            else max(1, len(v.as_tuple().digits))
+        scale = l.decimal.scale if l.decimal.HasField("scale") \
+            else max(0, -v.as_tuple().exponent)
+        return LV.decimal(v, precision, scale)
+    if kind == "string":
+        return LV.string(l.string)
+    if kind == "binary":
+        return LV(dt.BinaryType(), bytes(l.binary))
+    if kind == "date":
+        return LV.date(_EPOCH_D + datetime.timedelta(days=l.date))
+    if kind == "timestamp":
+        return LV.timestamp(
+            _EPOCH_TS + datetime.timedelta(microseconds=l.timestamp))
+    if kind == "timestamp_ntz":
+        v = (_EPOCH_TS + datetime.timedelta(microseconds=l.timestamp_ntz))
+        return LV(dt.TimestampType(None), v.replace(tzinfo=None))
+    if kind == "day_time_interval":
+        return LV.interval_microseconds(l.day_time_interval)
+    if kind == "year_month_interval":
+        return LV(dt.YearMonthIntervalType(), int(l.year_month_interval))
+    if kind == "array":
+        elems = [literal_value_from_proto(e) for e in l.array.elements]
+        et = data_type_from_proto(l.array.element_type) if \
+            l.array.HasField("element_type") else (
+                elems[0].data_type if elems else dt.NullType())
+        return LV(dt.ArrayType(et), tuple(e.value for e in elems))
+    if kind == "struct":
+        vals = [literal_value_from_proto(e) for e in l.struct.elements]
+        st = data_type_from_proto(l.struct.struct_type) if \
+            l.struct.HasField("struct_type") else dt.StructType(tuple(
+                dt.StructField(f"_{i+1}", v.data_type)
+                for i, v in enumerate(vals)))
+        return LV(st, tuple(v.value for v in vals))
+    raise ConvertError(f"unsupported literal kind: {kind}")
+
+
+# ---------------------------------------------------------------------------
+# Expressions
+# ---------------------------------------------------------------------------
+
+def sort_order_from_proto(s: epb.Expression.SortOrder) -> ex.SortOrder:
+    asc = s.direction != epb.Expression.SortOrder.SORT_DIRECTION_DESCENDING
+    if s.null_ordering == epb.Expression.SortOrder.SORT_NULLS_FIRST:
+        nf: Optional[bool] = True
+    elif s.null_ordering == epb.Expression.SortOrder.SORT_NULLS_LAST:
+        nf = False
+    else:
+        nf = None
+    return ex.SortOrder(expr_from_proto(s.child), asc, nf)
+
+
+def _window_frame_bound(b) -> Optional[int]:
+    which = b.WhichOneof("boundary")
+    if which == "current_row":
+        return 0
+    if which == "unbounded":
+        return None
+    lit = b.value.literal
+    k = lit.WhichOneof("literal_type")
+    if k in ("integer", "long", "byte", "short"):
+        return int(getattr(lit, k))
+    raise ConvertError("window frame boundary must be an integer literal")
+
+
+def expr_from_proto(e: epb.Expression) -> ex.Expr:
+    kind = e.WhichOneof("expr_type")
+    if kind == "literal":
+        return ex.Literal(literal_value_from_proto(e.literal))
+    if kind == "unresolved_attribute":
+        ua = e.unresolved_attribute
+        parts = tuple(_split_attribute(ua.unparsed_identifier))
+        plan_id = ua.plan_id if ua.HasField("plan_id") else None
+        return ex.Attribute(parts, plan_id)
+    if kind == "unresolved_function":
+        f = e.unresolved_function
+        args = tuple(expr_from_proto(a) for a in f.arguments)
+        name = f.function_name.lower()
+        if name == "when":
+            # CASE WHEN: args alternate cond, value [, else]
+            branches = []
+            i = 0
+            while i + 1 < len(args):
+                branches.append((args[i], args[i + 1]))
+                i += 2
+            else_v = args[i] if i < len(args) else None
+            return ex.CaseWhen(tuple(branches), else_v)
+        if name == "in":
+            return ex.InList(args[0], args[1:])
+        return ex.Function(name, args, f.is_distinct)
+    if kind == "expression_string":
+        from ..sql.parser import parse_expression
+        return parse_expression(e.expression_string.expression)
+    if kind == "unresolved_star":
+        us = e.unresolved_star
+        target = ()
+        if us.HasField("unparsed_target") and us.unparsed_target:
+            t = us.unparsed_target
+            target = tuple(_split_attribute(t[:-2] if t.endswith(".*") else t))
+        return ex.Star(target)
+    if kind == "alias":
+        a = e.alias
+        return ex.Alias(expr_from_proto(a.expr), tuple(a.name))
+    if kind == "cast":
+        c = e.cast
+        if c.WhichOneof("cast_to_type") == "type":
+            target = data_type_from_proto(c.type)
+        else:
+            from ..sql.parser import parse_data_type
+            target = parse_data_type(c.type_str)
+        try_ = (c.eval_mode == epb.Expression.Cast.EVAL_MODE_TRY)
+        return ex.Cast(expr_from_proto(c.expr), target, try_)
+    if kind == "sort_order":
+        return sort_order_from_proto(e.sort_order)
+    if kind == "lambda_function":
+        lf = e.lambda_function
+        return ex.LambdaFunction(
+            expr_from_proto(lf.function),
+            tuple(v.name_parts[0] for v in lf.arguments))
+    if kind == "unresolved_named_lambda_variable":
+        return ex.LambdaVariable(e.unresolved_named_lambda_variable.name_parts[0])
+    if kind == "window":
+        w = e.window
+        frame = None
+        if w.HasField("frame_spec"):
+            fs = w.frame_spec
+            ft = "range" if fs.frame_type == \
+                epb.Expression.Window.WindowFrame.FRAME_TYPE_RANGE else "rows"
+            frame = ex.WindowFrame(ft, _window_frame_bound(fs.lower),
+                                   _window_frame_bound(fs.upper))
+        return ex.Window(
+            expr_from_proto(w.window_function),
+            tuple(expr_from_proto(p) for p in w.partition_spec),
+            tuple(sort_order_from_proto(o) for o in w.order_spec),
+            frame)
+    if kind == "unresolved_extract_value":
+        uev = e.unresolved_extract_value
+        child = expr_from_proto(uev.child)
+        extraction = expr_from_proto(uev.extraction)
+        return ex.Function("element_at", (child, extraction))
+    if kind == "call_function":
+        cf = e.call_function
+        return ex.Function(cf.function_name.lower(),
+                           tuple(expr_from_proto(a) for a in cf.arguments))
+    raise ConvertError(f"unsupported expression kind: {kind}")
+
+
+def _split_attribute(name: str) -> Tuple[str, ...]:
+    """Split a (possibly backquoted) dotted identifier."""
+    parts = []
+    cur = []
+    in_bq = False
+    i = 0
+    while i < len(name):
+        ch = name[i]
+        if ch == "`":
+            if in_bq and i + 1 < len(name) and name[i + 1] == "`":
+                cur.append("`")
+                i += 2
+                continue
+            in_bq = not in_bq
+        elif ch == "." and not in_bq:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    parts.append("".join(cur))
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# Relations
+# ---------------------------------------------------------------------------
+
+_JOIN_TYPES = {
+    rpb.Join.JOIN_TYPE_INNER: "inner",
+    rpb.Join.JOIN_TYPE_FULL_OUTER: "full",
+    rpb.Join.JOIN_TYPE_LEFT_OUTER: "left",
+    rpb.Join.JOIN_TYPE_RIGHT_OUTER: "right",
+    rpb.Join.JOIN_TYPE_LEFT_ANTI: "anti",
+    rpb.Join.JOIN_TYPE_LEFT_SEMI: "semi",
+    rpb.Join.JOIN_TYPE_CROSS: "cross",
+}
+
+_SET_OPS = {
+    rpb.SetOperation.SET_OP_TYPE_UNION: "union",
+    rpb.SetOperation.SET_OP_TYPE_INTERSECT: "intersect",
+    rpb.SetOperation.SET_OP_TYPE_EXCEPT: "except",
+}
+
+
+def relation_from_proto(r: rpb.Relation) -> sp.QueryPlan:
+    kind = r.WhichOneof("rel_type")
+    if kind == "sql":
+        from ..sql import parse_one
+        plan = parse_one(r.sql.query)
+        if not isinstance(plan, sp.QueryPlan):
+            raise ConvertError("SQL relation must be a query (commands go "
+                               "through SqlCommand)")
+        return plan
+    if kind == "read":
+        rd = r.read
+        which = rd.WhichOneof("read_type")
+        if which == "named_table":
+            name = _split_attribute(rd.named_table.unparsed_identifier)
+            return sp.ReadNamedTable(
+                name, None, tuple(sorted(rd.named_table.options.items())))
+        ds = rd.data_source
+        schema = None
+        if ds.HasField("schema") and ds.schema:
+            schema = schema_from_string(ds.schema)
+        return sp.ReadDataSource(
+            ds.format if ds.HasField("format") else "parquet",
+            tuple(ds.paths), schema, tuple(sorted(ds.options.items())))
+    if kind == "project":
+        p = r.project
+        child = relation_from_proto(p.input) if p.HasField("input") \
+            else sp.OneRow()
+        return sp.Project(child,
+                          tuple(expr_from_proto(x) for x in p.expressions))
+    if kind == "filter":
+        return sp.Filter(relation_from_proto(r.filter.input),
+                         expr_from_proto(r.filter.condition))
+    if kind == "join":
+        j = r.join
+        jt = _JOIN_TYPES.get(j.join_type, "inner")
+        cond = expr_from_proto(j.join_condition) \
+            if j.HasField("join_condition") else None
+        return sp.Join(relation_from_proto(j.left),
+                       relation_from_proto(j.right), jt, cond,
+                       tuple(j.using_columns))
+    if kind == "set_op":
+        s = r.set_op
+        return sp.SetOperation(relation_from_proto(s.left_input),
+                               relation_from_proto(s.right_input),
+                               _SET_OPS.get(s.set_op_type, "union"),
+                               bool(s.is_all), bool(s.by_name))
+    if kind == "sort":
+        s = r.sort
+        return sp.Sort(relation_from_proto(s.input),
+                       tuple(sort_order_from_proto(o) for o in s.order),
+                       bool(s.is_global) if s.HasField("is_global") else True)
+    if kind == "limit":
+        return sp.Limit(relation_from_proto(r.limit.input), r.limit.limit)
+    if kind == "offset":
+        return sp.Offset(relation_from_proto(r.offset.input), r.offset.offset)
+    if kind == "tail":
+        return sp.Tail(relation_from_proto(r.tail.input), r.tail.limit)
+    if kind == "aggregate":
+        a = r.aggregate
+        child = relation_from_proto(a.input)
+        group = tuple(expr_from_proto(g) for g in a.grouping_expressions)
+        aggs = tuple(expr_from_proto(x) for x in a.aggregate_expressions)
+        if a.group_type == rpb.Aggregate.GROUP_TYPE_PIVOT:
+            return sp.Pivot(child, group, aggs,
+                            expr_from_proto(a.pivot.col),
+                            tuple(ex.Literal(literal_value_from_proto(v))
+                                  for v in a.pivot.values))
+        rollup = a.group_type == rpb.Aggregate.GROUP_TYPE_ROLLUP
+        cube = a.group_type == rpb.Aggregate.GROUP_TYPE_CUBE
+        gsets = None
+        if a.group_type == rpb.Aggregate.GROUP_TYPE_GROUPING_SETS:
+            gsets = tuple(tuple(expr_from_proto(g) for g in s.grouping_set)
+                          for s in a.grouping_sets)
+        # Spark's aggregate output = grouping exprs ++ aggregate exprs
+        return sp.Aggregate(child, group, group + aggs, None, gsets,
+                            rollup, cube)
+    if kind == "local_relation":
+        lr = r.local_relation
+        table = None
+        schema = None
+        if lr.HasField("data"):
+            import pyarrow as pa
+            table = pa.ipc.open_stream(lr.data).read_all()
+        if lr.HasField("schema") and lr.schema:
+            schema = schema_from_string(lr.schema)
+        return sp.LocalRelation(table, schema)
+    if kind == "range":
+        rg = r.range
+        return sp.Range(rg.start, rg.end, rg.step,
+                        rg.num_partitions if rg.HasField("num_partitions")
+                        else None)
+    if kind == "sample":
+        s = r.sample
+        return sp.Sample(relation_from_proto(s.input), s.lower_bound,
+                         s.upper_bound, bool(s.with_replacement),
+                         s.seed if s.HasField("seed") else None)
+    if kind == "deduplicate":
+        d = r.deduplicate
+        cols = () if d.all_columns_as_keys else tuple(d.column_names)
+        return sp.Deduplicate(relation_from_proto(d.input), cols,
+                              bool(d.within_watermark))
+    if kind == "subquery_alias":
+        sa = r.subquery_alias
+        return sp.SubqueryAlias(relation_from_proto(sa.input), sa.alias,
+                                tuple(sa.qualifier))
+    if kind == "repartition":
+        rp = r.repartition
+        return sp.Repartition(relation_from_proto(rp.input),
+                              rp.num_partitions)
+    if kind == "repartition_by_expression":
+        rp = r.repartition_by_expression
+        return sp.Repartition(
+            relation_from_proto(rp.input),
+            rp.num_partitions if rp.HasField("num_partitions") else None,
+            tuple(expr_from_proto(x) for x in rp.partition_exprs))
+    if kind == "to_df":
+        td = r.to_df
+        return _rename_positional(relation_from_proto(td.input),
+                                  tuple(td.column_names))
+    if kind == "to_schema":
+        ts = r.to_schema
+        return sp.ToSchema(relation_from_proto(ts.input),
+                           data_type_from_proto(ts.schema))
+    if kind == "with_columns":
+        wc = r.with_columns
+        return sp.WithColumns(relation_from_proto(wc.input),
+                              tuple(expr_from_proto(a) for a in wc.aliases))
+    if kind == "with_columns_renamed":
+        wcr = r.with_columns_renamed
+        renames = tuple((k, v)
+                        for k, v in sorted(wcr.rename_columns_map.items()))
+        if not renames and wcr.renames:
+            renames = tuple((rn.col_name, rn.new_col_name)
+                            for rn in wcr.renames)
+        return sp.WithColumnsRenamed(relation_from_proto(wcr.input), renames)
+    if kind == "drop":
+        d = r.drop
+        names = tuple(d.column_names)
+        if not names:
+            names = tuple(
+                c.unresolved_attribute.unparsed_identifier for c in d.columns)
+        return sp.Drop(relation_from_proto(d.input), names)
+    if kind == "show_string":
+        # executed eagerly by the service; represent as the child
+        return relation_from_proto(r.show_string.input)
+    if kind == "hint":
+        return relation_from_proto(r.hint.input)  # hints are advisory
+    if kind == "unpivot":
+        u = r.unpivot
+        values = tuple(expr_from_proto(v) for v in u.values.values) \
+            if u.HasField("values") else ()
+        return sp.Unpivot(relation_from_proto(u.input),
+                          tuple(expr_from_proto(i) for i in u.ids),
+                          values, u.variable_column_name,
+                          u.value_column_name)
+    raise ConvertError(f"unsupported relation kind: {kind}")
+
+
+def _rename_positional(child: sp.QueryPlan,
+                       names: Tuple[str, ...]) -> sp.QueryPlan:
+    """toDF(*names): positional rename via ToSchema-style projection.
+
+    Without input schema knowledge at conversion time, emit a
+    WithColumnsRenamed marker the resolver understands positionally —
+    represented as SubqueryAlias with column renames.
+    """
+    return sp.SubqueryAlias(child, "__to_df__", (), names)

@@ -1,0 +1,75 @@
+"""Spark JSON schema ⇄ spec types.
+
+Reference role: the schema (de)serialization used by Spark Connect's
+json_to_ddl and the Delta metaData.schemaString field
+(crates/sail-delta-lake/src/spec/, sail-spark-connect plan_analyzer).
+"""
+
+from __future__ import annotations
+
+from . import data_type as dt
+
+
+def schema_from_json(obj) -> dt.StructType:
+    out = type_from_json(obj)
+    if not isinstance(out, dt.StructType):
+        raise ValueError("json schema must be a struct")
+    return out
+
+
+def type_from_json(t) -> dt.DataType:
+    if isinstance(t, dict):
+        kind = t.get("type")
+        if kind == "struct":
+            return dt.StructType(tuple(
+                dt.StructField(f["name"], type_from_json(f["type"]),
+                               bool(f.get("nullable", True)))
+            for f in t.get("fields", ())))
+        if kind == "array":
+            return dt.ArrayType(type_from_json(t["elementType"]),
+                                bool(t.get("containsNull", True)))
+        if kind == "map":
+            return dt.MapType(type_from_json(t["keyType"]),
+                              type_from_json(t["valueType"]),
+                              bool(t.get("valueContainsNull", True)))
+        raise ValueError(f"unknown json type {t}")
+    from ..sql.parser import parse_data_type
+    return parse_data_type(str(t))
+
+
+_SIMPLE_NAMES = {
+    dt.NullType: "void",
+    dt.BooleanType: "boolean",
+    dt.ByteType: "byte",
+    dt.ShortType: "short",
+    dt.IntegerType: "integer",
+    dt.LongType: "long",
+    dt.FloatType: "float",
+    dt.DoubleType: "double",
+    dt.StringType: "string",
+    dt.BinaryType: "binary",
+    dt.DateType: "date",
+}
+
+
+def type_to_json(d: dt.DataType):
+    if isinstance(d, dt.StructType):
+        return {"type": "struct", "fields": [
+            {"name": f.name, "type": type_to_json(f.data_type),
+             "nullable": f.nullable, "metadata": {}}
+            for f in d.fields]}
+    if isinstance(d, dt.ArrayType):
+        return {"type": "array", "elementType": type_to_json(d.element_type),
+                "containsNull": d.contains_null}
+    if isinstance(d, dt.MapType):
+        return {"type": "map", "keyType": type_to_json(d.key_type),
+                "valueType": type_to_json(d.value_type),
+                "valueContainsNull": d.value_contains_null}
+    if isinstance(d, dt.DecimalType):
+        return f"decimal({d.precision},{d.scale})"
+    if isinstance(d, dt.TimestampType):
+        return "timestamp" if d.timezone is not None else "timestamp_ntz"
+    for cls, name in _SIMPLE_NAMES.items():
+        if isinstance(d, cls):
+            return name
+    raise ValueError(f"cannot serialize type {d!r} to Spark JSON")
