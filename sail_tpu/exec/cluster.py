@@ -1,39 +1,38 @@
-"""Driver/worker cluster runtime over gRPC.
+"""Driver/worker cluster runtime over gRPC with a peer stream data plane.
 
 Reference role: sail-execution's DriverActor/WorkerActor, worker pool with
-heartbeats, task scheduler with retry, and the RPC services
-(crates/sail-execution/src/driver/, src/worker/ — SURVEY.md §2.5/§3.3).
-v0 shape:
+heartbeats, stage scheduler with retry, the WorkerService/DriverService
+RPCs, and the task-stream data plane
+(crates/sail-execution/src/driver/, src/worker/, src/stream_service/ —
+SURVEY.md §2.5/§3.3). Shape:
 
-- DriverActor owns the worker registry (heartbeat timestamps, lost-worker
-  probing), the job table, and task scheduling (round-robin over live
-  workers, per-task attempts with retry on worker failure).
-- WorkerActor runs task fragments on its local executor; results return in
-  ReportTaskStatus as Arrow IPC (a Flight-style peer-to-peer stream data
-  plane replaces this for shuffle stages in a later round).
-- Local-cluster mode (the reference's test vehicle) runs driver + workers
-  in threads speaking REAL gRPC over localhost.
-
-Transport: grpc generic handlers over protoc-generated messages
-(sail_tpu/exec/proto/control_plane.proto).
+- the driver schedules stages in dependency order; tasks are assigned to
+  the least-loaded live workers; per-task attempts with retry; heartbeat
+  timeout eviction reschedules a lost worker's tasks.
+- workers execute plan fragments on the local (jax) executor, hash-route
+  shuffle outputs into channels, and serve them to PEERS over a
+  FetchStream RPC (Arrow IPC) — results no longer ride task reports.
+- memory-table scans are served by the DRIVER's stream service and sliced
+  per task, so a stage ships the table at most once per consuming task's
+  slice (not whole-table × partitions).
+- local-cluster mode (the reference's test vehicle) runs driver + workers
+  as threads speaking real gRPC over localhost.
 """
 
 from __future__ import annotations
 
-import sys
-import os
 import threading
 import time
 import uuid
 from concurrent import futures
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import grpc
 
 from .proto import control_plane_pb2 as pb
 
 from .actor import Actor
-from . import job_graph as jg  # noqa: E402
+from . import job_graph as jg
 
 _DRIVER_SERVICE = "sail_tpu.control.DriverService"
 _WORKER_SERVICE = "sail_tpu.control.WorkerService"
@@ -43,6 +42,86 @@ def _unary(fn, req_cls):
     return grpc.unary_unary_rpc_method_handler(
         fn, request_deserializer=req_cls.FromString,
         response_serializer=lambda m: m.SerializeToString())
+
+
+def _table_to_ipc(table) -> bytes:
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def _ipc_to_table(buf: bytes):
+    import pyarrow as pa
+    return pa.ipc.open_stream(buf).read_all()
+
+
+class _StreamStore:
+    """In-memory task output channels, served over FetchStream.
+    Reference role: the stream storage behind TaskStreamFlightServer
+    (src/stream_manager/)."""
+
+    def __init__(self):
+        self._streams: Dict[Tuple[str, int, int], Dict[int, bytes]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, job_id: str, stage: int, partition: int,
+            channels: Dict[int, bytes]):
+        with self._lock:
+            self._streams[(job_id, stage, partition)] = channels
+
+    def get(self, job_id: str, stage: int, partition: int,
+            channel: int) -> Optional[bytes]:
+        with self._lock:
+            chans = self._streams.get((job_id, stage, partition))
+            if chans is None:
+                return None
+            return chans.get(channel)
+
+    def clean_job(self, job_id: str):
+        with self._lock:
+            for key in [k for k in self._streams if k[0] == job_id]:
+                del self._streams[key]
+
+
+def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
+    def fetch(request: pb.FetchStreamRequest, context):
+        if request.scan_id:
+            tables = scan_tables() if scan_tables is not None else {}
+            entry = tables.get((request.job_id, request.scan_id))
+            if entry is None:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"unknown scan {request.scan_id}")
+            n = entry.num_rows
+            nparts = max(request.num_partitions, 1)
+            per = -(-n // nparts) if n else 0
+            part = entry.slice(request.partition * per, per) if per \
+                else entry.slice(0, 0)
+            return pb.FetchStreamResponse(data=_table_to_ipc(part))
+        buf = store.get(request.job_id, request.stage, request.partition,
+                        request.channel)
+        if buf is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"no stream for job={request.job_id} stage={request.stage} "
+                f"partition={request.partition} channel={request.channel}")
+        return pb.FetchStreamResponse(data=buf)
+
+    return fetch
+
+
+def _fetch_from(addr: str, req: pb.FetchStreamRequest, service: str,
+                timeout: float = 60.0) -> bytes:
+    channel = grpc.insecure_channel(addr)
+    try:
+        rpc = channel.unary_unary(
+            f"/{service}/FetchStream",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.FetchStreamResponse.FromString)
+        return rpc(req, timeout=timeout).data
+    finally:
+        channel.close()
 
 
 # ---------------------------------------------------------------------------
@@ -58,9 +137,10 @@ class WorkerActor(Actor):
         self.port = 0
         self._server: Optional[grpc.Server] = None
         self._driver_channel: Optional[grpc.Channel] = None
-        self._running: Dict[Tuple[str, int, int], threading.Thread] = {}
+        self._running: Dict[Tuple[str, int, int], threading.Event] = {}
         self._pool = futures.ThreadPoolExecutor(max_workers=task_slots)
         self._hb_stop = threading.Event()
+        self.streams = _StreamStore()
 
     # -- rpc service -----------------------------------------------------
     def _service(self):
@@ -69,12 +149,25 @@ class WorkerActor(Actor):
             return pb.RunTaskResponse(accepted=True)
 
         def stop_task(request: pb.StopTaskRequest, context):
-            self.handle.send(("stop_task", request))
-            return pb.StopTaskResponse()
+            key = (request.job_id, request.stage, request.partition)
+            ev = self._running.get(key)
+            if ev is not None:
+                ev.set()  # cooperative cancel: checked between pipeline steps
+            return pb.StopTaskResponse(stopped=ev is not None)
+
+        def clean_up_job(request: pb.CleanUpJobRequest, context):
+            self.streams.clean_job(request.job_id)
+            for key in [k for k in self._running
+                        if k[0] == request.job_id]:
+                self._running[key].set()
+            return pb.CleanUpJobResponse()
 
         return grpc.method_handlers_generic_handler(_WORKER_SERVICE, {
             "RunTask": _unary(run_task, pb.RunTaskRequest),
             "StopTask": _unary(stop_task, pb.StopTaskRequest),
+            "CleanUpJob": _unary(clean_up_job, pb.CleanUpJobRequest),
+            "FetchStream": _unary(_fetch_stream_handler(self.streams),
+                                  pb.FetchStreamRequest),
         })
 
     def on_start(self):
@@ -116,36 +209,138 @@ class WorkerActor(Actor):
         kind, payload = message
         if kind == "run_task":
             task: pb.TaskDefinition = payload
+            key = (task.job_id, task.stage, task.partition)
+            self._running[key] = threading.Event()
             self._pool.submit(self._run_task, task)
-        elif kind == "stop_task":
-            pass  # cooperative cancel lands with the streaming runtime
+
+    # -- task execution --------------------------------------------------
+    def _fetch_inputs(self, task: pb.TaskDefinition):
+        """Pull upstream stage outputs over the peer data plane."""
+        import pyarrow as pa
+
+        tables: Dict[int, object] = {}
+        for inp in task.inputs:
+            parts = []
+            addrs = list(inp.worker_addrs)
+            if inp.mode == "shuffle":
+                wanted = [(i, task.partition) for i in range(len(addrs))]
+            elif inp.mode == "forward":
+                wanted = [(task.partition, -1)]
+                addrs = [addrs[task.partition]]
+            else:  # merge | broadcast: everything from every producer
+                wanted = [(i, -1) for i in range(len(addrs))]
+            for (up_part, chan), addr in zip(wanted, addrs):
+                try:
+                    buf = _fetch_from(addr, pb.FetchStreamRequest(
+                        job_id=task.job_id, stage=inp.stage_id,
+                        partition=up_part, channel=chan), _WORKER_SERVICE)
+                except grpc.RpcError as e:
+                    raise _FetchFailed(inp.stage_id, up_part) from e
+                parts.append(_ipc_to_table(buf))
+            tables[inp.stage_id] = pa.concat_tables(
+                parts, promote_options="permissive") if len(parts) > 1 \
+                else parts[0]
+        return tables
 
     def _run_task(self, task: pb.TaskDefinition):
-        import pyarrow as pa
         from .local import LocalExecutor
+        key = (task.job_id, task.stage, task.partition)
         try:
-            self._report(task, "running", b"")
-            plan = jg.decode_fragment(task.plan, task.scan_table or None,
-                                      task.partition,
+            self._report(task, "running")
+            plan = jg.decode_fragment(task.plan, task.partition,
                                       max(task.num_partitions, 1))
+            plan = _resolve_driver_scans(plan, task)
+            if task.inputs:
+                plan = jg.attach_stage_inputs(plan, self._fetch_inputs(task))
+            if self._running.get(key, threading.Event()).is_set():
+                self._report(task, "canceled")
+                return
             table = LocalExecutor().execute(plan)
-            sink = pa.BufferOutputStream()
-            with pa.ipc.new_stream(sink, table.schema) as w:
-                w.write_table(table)
-            self._report(task, "succeeded", sink.getvalue().to_pybytes())
+            if task.HasField("shuffle_write") and \
+                    task.shuffle_write.num_channels > 1:
+                # shuffle consumers only ever fetch hash channels — do not
+                # retain a second full copy of the output
+                sw = task.shuffle_write
+                parts = jg.hash_partition_table(
+                    table, list(sw.key_columns), sw.num_channels)
+                channels: Dict[int, bytes] = {
+                    c: _table_to_ipc(part) for c, part in enumerate(parts)}
+            else:
+                channels = {-1: _table_to_ipc(table)}
+            self.streams.put(task.job_id, task.stage, task.partition,
+                             channels)
+            self._report(task, "succeeded", rows=table.num_rows)
+        except _FetchFailed as e:
+            # a producer's streams are gone (dead peer): the driver re-runs
+            # the producer and re-schedules this task, not as our failure
+            self._report(task, "failed",
+                         error=f"FETCH_FAILED:{e.stage_id}:{e.partition}")
         except Exception as e:  # noqa: BLE001 — full cause goes to the driver
-            self._report(task, "failed", b"", str(e))
+            self._report(task, "failed", error=f"{type(e).__name__}: {e}")
+        finally:
+            self._running.pop(key, None)
 
-    def _report(self, task: pb.TaskDefinition, state: str, result: bytes,
-                error: str = ""):
+    def _report(self, task: pb.TaskDefinition, state: str, error: str = "",
+                rows: int = 0):
         try:
             self._call_driver("ReportTaskStatus", pb.ReportTaskStatusRequest(
                 worker_id=self.worker_id, job_id=task.job_id,
                 stage=task.stage, partition=task.partition,
                 attempt=task.attempt, state=state, error=error,
-                result=result), pb.ReportTaskStatusResponse)
+                rows_out=rows), pb.ReportTaskStatusResponse)
         except grpc.RpcError:
             pass
+
+
+def _reattach_local_scans(plan, scan_tables):
+    import dataclasses as dc
+    from ..plan import nodes as pn
+
+    def repl(p):
+        if isinstance(p, pn.ScanExec) and p.format == "__driver__":
+            return dc.replace(p, source=scan_tables[p.table_name],
+                              format="memory", table_name="")
+        if isinstance(p, pn.JoinExec):
+            return dc.replace(p, left=repl(p.left), right=repl(p.right))
+        if isinstance(p, pn.UnionExec):
+            return dc.replace(p, inputs=tuple(repl(c) for c in p.inputs))
+        if hasattr(p, "input") and p.input is not None:
+            return dc.replace(p, input=repl(p.input))
+        return p
+
+    return repl(plan)
+
+
+class _FetchFailed(Exception):
+    def __init__(self, stage_id: int, partition: int):
+        super().__init__(f"stage {stage_id} partition {partition}")
+        self.stage_id = stage_id
+        self.partition = partition
+
+
+def _resolve_driver_scans(plan, task: pb.TaskDefinition):
+    """Fetch this task's slice of driver-hosted memory tables."""
+    import dataclasses as dc
+    from ..plan import nodes as pn
+
+    def repl(p):
+        if isinstance(p, pn.ScanExec) and p.format == "__driver__":
+            buf = _fetch_from(task.driver_addr, pb.FetchStreamRequest(
+                job_id=task.job_id, scan_id=p.table_name,
+                partition=task.partition,
+                num_partitions=max(task.num_partitions, 1)),
+                _DRIVER_SERVICE)
+            return dc.replace(p, source=_ipc_to_table(buf), format="memory",
+                              table_name="")
+        if isinstance(p, pn.JoinExec):
+            return dc.replace(p, left=repl(p.left), right=repl(p.right))
+        if isinstance(p, pn.UnionExec):
+            return dc.replace(p, inputs=tuple(repl(c) for c in p.inputs))
+        if hasattr(p, "input") and p.input is not None:
+            return dc.replace(p, input=repl(p.input))
+        return p
+
+    return repl(plan)
 
 
 # ---------------------------------------------------------------------------
@@ -156,10 +351,18 @@ class _Job:
     def __init__(self, job_id: str, graph: jg.JobGraph):
         self.job_id = job_id
         self.graph = graph
-        self.results: Dict[int, bytes] = {}
         self.failed: Optional[str] = None
-        self.attempts: Dict[int, int] = {}
         self.done = threading.Event()
+        # per stage: partition → worker addr (set on success)
+        self.locations: Dict[int, Dict[int, str]] = {
+            s.stage_id: {} for s in graph.stages}
+        self.attempts: Dict[Tuple[int, int], int] = {}
+        self.last_error: str = ""
+        self.scheduled: Set[int] = set()
+        # consumer tasks waiting for a producer re-run after a fetch failure
+        self.pending: Set[Tuple[int, int]] = set()
+        self.stage_rows: Dict[int, int] = {}
+        self.result_addr: Optional[str] = None
 
 
 class DriverActor(Actor):
@@ -173,9 +376,22 @@ class DriverActor(Actor):
         self.jobs: Dict[str, _Job] = {}
         self._server: Optional[grpc.Server] = None
         self.port = 0
-        self._rr = 0
+        self._probe_stop = threading.Event()
+        self.streams = _StreamStore()  # (unused for now; driver-run roots)
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
 
     # -- rpc service -----------------------------------------------------
+    def _scan_tables_view(self):
+        out = {}
+        # snapshot: gRPC handler threads race the actor thread on self.jobs
+        for job in list(self.jobs.values()):
+            for sid, table in job.graph.scan_tables.items():
+                out[(job.job_id, sid)] = table
+        return out
+
     def _service(self):
         def register(request: pb.RegisterWorkerRequest, context):
             self.handle.send(("register", request))
@@ -194,6 +410,9 @@ class DriverActor(Actor):
             "RegisterWorker": _unary(register, pb.RegisterWorkerRequest),
             "Heartbeat": _unary(heartbeat, pb.HeartbeatRequest),
             "ReportTaskStatus": _unary(report, pb.ReportTaskStatusRequest),
+            "FetchStream": _unary(
+                _fetch_stream_handler(self.streams, self._scan_tables_view),
+                pb.FetchStreamRequest),
         })
 
     def on_start(self):
@@ -204,13 +423,16 @@ class DriverActor(Actor):
         threading.Thread(target=self._probe_loop, daemon=True).start()
 
     def on_stop(self):
+        self._probe_stop.set()
         if self._server is not None:
             self._server.stop(grace=0.5)
 
     def _probe_loop(self):
-        while True:
-            time.sleep(2.0)
-            self.handle.send(("probe", None))
+        while not self._probe_stop.wait(2.0):
+            try:
+                self.handle.send(("probe", None))
+            except Exception:  # noqa: BLE001 — actor stopped
+                return
 
     # -- actor -----------------------------------------------------------
     def receive(self, message):
@@ -232,11 +454,13 @@ class DriverActor(Actor):
         elif kind == "submit":
             job, reply = payload
             self.jobs[job.job_id] = job
-            self._schedule_leaf_tasks(job)
+            self._schedule_ready_stages(job)
             if reply is not None:
                 reply.set(job)
         elif kind == "task_status":
             self._on_task_status(payload)
+        elif kind == "cleanup":
+            self._cleanup_job(payload)
 
     def _probe_workers(self):
         now = time.time()
@@ -244,39 +468,82 @@ class DriverActor(Actor):
                 if now - w["last_seen"] > self.HEARTBEAT_TIMEOUT_S]
         for wid in lost:
             w = self.workers.pop(wid)
-            # reschedule that worker's running tasks
+            # re-run the lost worker's RUNNING tasks
             for (job_id, stage, partition) in list(w["tasks"]):
                 job = self.jobs.get(job_id)
                 if job is not None and not job.done.is_set():
-                    self._launch_task(job, partition,
-                                      job.attempts.get(partition, 0) + 1)
+                    att = self.attempt_of(job, stage, partition) + 1
+                    self._launch_task(job, stage, partition, att)
+            # its COMPLETED stream outputs are gone too: invalidate their
+            # locations and re-run those producer partitions
+            for job in list(self.jobs.values()):
+                if job.done.is_set():
+                    continue
+                for stage_id, locs in job.locations.items():
+                    dead = [p for p, a in locs.items() if a == w["addr"]]
+                    for p in dead:
+                        del locs[p]
+                        if stage_id in job.scheduled:
+                            att = self.attempt_of(job, stage_id, p) + 1
+                            self._launch_task(job, stage_id, p, att)
 
-    def _schedule_leaf_tasks(self, job: _Job):
-        leaf = job.graph.stages[0]
-        for partition in range(leaf.num_partitions):
-            self._launch_task(job, partition, 0)
+    @staticmethod
+    def attempt_of(job: _Job, stage: int, partition: int) -> int:
+        return job.attempts.get((stage, partition), 0)
 
-    def _launch_task(self, job: _Job, partition: int, attempt: int):
+    # -- scheduling ------------------------------------------------------
+    def _stage_complete(self, job: _Job, stage_id: int) -> bool:
+        stage = job.graph.stages[stage_id]
+        return len(job.locations[stage_id]) >= stage.num_partitions
+
+    def _schedule_ready_stages(self, job: _Job):
+        for stage in job.graph.stages:
+            if stage.stage_id in job.scheduled or stage.on_driver:
+                continue
+            if all(self._stage_complete(job, i.stage_id)
+                   for i in stage.inputs):
+                job.scheduled.add(stage.stage_id)
+                for partition in range(stage.num_partitions):
+                    self._launch_task(job, stage.stage_id, partition, 0)
+        root = job.graph.root
+        if root.on_driver and not job.done.is_set() and \
+                all(self._stage_complete(job, i.stage_id)
+                    for i in root.inputs):
+            job.done.set()
+
+    def _launch_task(self, job: _Job, stage_id: int, partition: int,
+                     attempt: int):
         if attempt >= self.MAX_TASK_ATTEMPTS:
-            job.failed = f"task {partition} exceeded max attempts"
+            job.failed = (f"stage {stage_id} task {partition} exceeded "
+                          f"max attempts: {job.last_error}")
             job.done.set()
             return
-        live = list(self.workers.items())
+        live = sorted(self.workers.items(),
+                      key=lambda kv: len(kv[1]["tasks"]))
         if not live:
             job.failed = "no live workers"
             job.done.set()
             return
-        self._rr = (self._rr + 1) % len(live)
-        wid, w = live[self._rr]
-        job.attempts[partition] = attempt
-        leaf = job.graph.stages[0]
-        plan_bytes, table_ipc = jg.encode_fragment(leaf.plan)
-        task = pb.TaskDefinition(job_id=job.job_id, stage=0,
-                                 partition=partition, attempt=attempt,
-                                 plan=plan_bytes,
-                                 scan_table=table_ipc or b"",
-                                 num_partitions=job.graph.stages[0].num_partitions)
-        w["tasks"].add((job.job_id, 0, partition))
+        wid, w = live[0]
+        stage = job.graph.stages[stage_id]
+        job.attempts[(stage_id, partition)] = attempt
+        inputs = []
+        for i in stage.inputs:
+            up = job.graph.stages[i.stage_id]
+            addrs = [job.locations[i.stage_id][p]
+                     for p in range(up.num_partitions)]
+            inputs.append(pb.StageInputLocations(
+                stage_id=i.stage_id, mode=i.mode.value, worker_addrs=addrs))
+        task = pb.TaskDefinition(
+            job_id=job.job_id, stage=stage_id, partition=partition,
+            attempt=attempt, plan=encode_cached(job, stage),
+            num_partitions=stage.num_partitions, inputs=inputs,
+            driver_addr=self.addr)
+        if stage.shuffle_keys is not None and stage.num_channels > 1:
+            task.shuffle_write.CopyFrom(pb.ShuffleWriteSpec(
+                key_columns=list(stage.shuffle_keys),
+                num_channels=stage.num_channels))
+        w["tasks"].add((job.job_id, stage_id, partition))
         rpc = w["channel"].unary_unary(
             f"/{_WORKER_SERVICE}/RunTask",
             request_serializer=lambda m: m.SerializeToString(),
@@ -287,7 +554,7 @@ class DriverActor(Actor):
             # dispatch failure = dead worker: evict immediately and redo the
             # SAME attempt elsewhere (a launch failure is not a task failure)
             self.workers.pop(wid, None)
-            self._launch_task(job, partition, attempt)
+            self._launch_task(job, stage_id, partition, attempt)
 
     def _on_task_status(self, r: pb.ReportTaskStatusRequest):
         job = self.jobs.get(r.job_id)
@@ -297,13 +564,73 @@ class DriverActor(Actor):
         if r.state in ("succeeded", "failed", "canceled") and w is not None:
             w["tasks"].discard((r.job_id, r.stage, r.partition))
         if r.state == "succeeded":
-            if r.attempt == job.attempts.get(r.partition, 0):
-                job.results[r.partition] = r.result
-                leaf = job.graph.stages[0]
-                if len(job.results) == leaf.num_partitions:
-                    job.done.set()
+            if w is None:
+                # the worker was evicted before its success report arrived;
+                # its streams died with it — run the task again elsewhere
+                self._launch_task(job, r.stage, r.partition,
+                                  self.attempt_of(job, r.stage,
+                                                  r.partition) + 1)
+                return
+            if r.attempt == self.attempt_of(job, r.stage, r.partition):
+                job.locations[r.stage][r.partition] = w["addr"]
+                job.stage_rows[r.stage] = \
+                    job.stage_rows.get(r.stage, 0) + int(r.rows_out)
+                self._fire_pending(job)
+                self._schedule_ready_stages(job)
         elif r.state == "failed":
-            self._launch_task(job, r.partition, r.attempt + 1)
+            if r.error.startswith("FETCH_FAILED:"):
+                _, s, p = r.error.split(":")
+                up_stage, up_part = int(s), int(p)
+                job.locations[up_stage].pop(up_part, None)
+                if self.attempt_of(job, up_stage, up_part) + 1 < \
+                        self.MAX_TASK_ATTEMPTS:
+                    # not the consumer's fault: park it (same attempt) and
+                    # re-run the producer partition
+                    job.pending.add((r.stage, r.partition))
+                    self._launch_task(job, up_stage, up_part,
+                                      self.attempt_of(job, up_stage,
+                                                      up_part) + 1)
+                    return
+            job.last_error = r.error
+            self._launch_task(job, r.stage, r.partition, r.attempt + 1)
+
+    def _fire_pending(self, job: _Job):
+        ready = []
+        for (stage_id, partition) in list(job.pending):
+            stage = job.graph.stages[stage_id]
+            if all(self._stage_complete(job, i.stage_id)
+                   for i in stage.inputs):
+                ready.append((stage_id, partition))
+        for stage_id, partition in ready:
+            job.pending.discard((stage_id, partition))
+            self._launch_task(job, stage_id, partition,
+                              self.attempt_of(job, stage_id, partition))
+
+    def _cleanup_job(self, job_id: str):
+        self.jobs.pop(job_id, None)
+        for w in self.workers.values():
+            rpc = w["channel"].unary_unary(
+                f"/{_WORKER_SERVICE}/CleanUpJob",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.CleanUpJobResponse.FromString)
+            try:
+                rpc(pb.CleanUpJobRequest(job_id=job_id), timeout=10)
+            except grpc.RpcError:
+                pass
+
+
+_FRAGMENT_CACHE: Dict[Tuple[str, int], bytes] = {}
+
+
+def encode_cached(job: _Job, stage: jg.Stage) -> bytes:
+    key = (job.job_id, stage.stage_id)
+    blob = _FRAGMENT_CACHE.get(key)
+    if blob is None:
+        blob = jg.encode_fragment(stage.plan)
+        _FRAGMENT_CACHE[key] = blob
+        while len(_FRAGMENT_CACHE) > 256:
+            _FRAGMENT_CACHE.pop(next(iter(_FRAGMENT_CACHE)))
+    return blob
 
 
 # ---------------------------------------------------------------------------
@@ -314,7 +641,6 @@ class LocalCluster:
     def __init__(self, num_workers: int = 2, task_slots: int = 2):
         self.driver = DriverActor()
         self.driver.start("driver")
-        # wait for the driver's server port
         deadline = time.time() + 10
         while self.driver.port == 0 and time.time() < deadline:
             time.sleep(0.01)
@@ -327,11 +653,11 @@ class LocalCluster:
         deadline = time.time() + 10
         while len(self.driver.workers) < num_workers and time.time() < deadline:
             time.sleep(0.02)
+        self.last_job: Optional[_Job] = None
 
     def run_job(self, plan, num_partitions: Optional[int] = None, timeout=120):
         """Distribute a plan; returns the result pyarrow Table."""
         import pyarrow as pa
-        from ..columnar import arrow_interop as ai
         from .local import LocalExecutor
 
         nparts = num_partitions or max(1, len(self.workers))
@@ -339,40 +665,41 @@ class LocalCluster:
         if graph is None:
             return LocalExecutor().execute(plan)
         job = _Job(uuid.uuid4().hex[:12], graph)
+        self.last_job = job
         self.driver.handle.ask(lambda reply: ("submit", (job, reply)))
-        if not job.done.wait(timeout):
-            raise TimeoutError("cluster job timed out")
-        if job.failed:
-            raise RuntimeError(f"cluster job failed: {job.failed}")
-        parts = []
-        for i in range(nparts):
-            buf = job.results[i]
-            parts.append(pa.ipc.open_stream(buf).read_all())
-        merged = pa.concat_tables(parts, promote_options="permissive")
-        # run the root stage locally over the merged leaf output
-        root = graph.root
-        root_plan = _attach_stage_input(root.plan, merged)
-        return LocalExecutor().execute(root_plan)
+        try:
+            if not job.done.wait(timeout):
+                raise TimeoutError("cluster job timed out")
+            if job.failed:
+                raise RuntimeError(f"cluster job failed: {job.failed}")
+            # the root stage runs on the driver over MERGE input fetched
+            # from the workers via the data plane
+            root = graph.root
+            tables = {}
+            for i in root.inputs:
+                up = graph.stages[i.stage_id]
+                parts = []
+                for p in range(up.num_partitions):
+                    addr = job.locations[i.stage_id][p]
+                    buf = _fetch_from(addr, pb.FetchStreamRequest(
+                        job_id=job.job_id, stage=i.stage_id, partition=p,
+                        channel=-1), _WORKER_SERVICE)
+                    parts.append(_ipc_to_table(buf))
+                tables[i.stage_id] = pa.concat_tables(
+                    parts, promote_options="permissive")
+            root_plan = jg.attach_stage_inputs(root.plan, tables)
+            # memory scans that stayed in the driver-run root plan read the
+            # driver's own table map directly
+            root_plan = _reattach_local_scans(root_plan, graph.scan_tables)
+            return LocalExecutor().execute(root_plan)
+        finally:
+            self.driver.handle.send(("cleanup", job.job_id))
+
+    def stage_rows(self) -> Dict[int, int]:
+        """Rows produced per stage of the last job (operator metrics)."""
+        return dict(self.last_job.stage_rows) if self.last_job else {}
 
     def stop(self):
         for w in self.workers:
             w.stop()
         self.driver.stop()
-
-
-def _attach_stage_input(plan, table):
-    import dataclasses as dc
-    from ..plan import nodes as pn
-
-    def replace(p):
-        if isinstance(p, jg._StageInput):
-            return pn.ScanExec(tuple(p.schema), table, (), "memory")
-        if isinstance(p, pn.JoinExec):
-            return dc.replace(p, left=replace(p.left), right=replace(p.right))
-        if isinstance(p, pn.UnionExec):
-            return dc.replace(p, inputs=tuple(replace(c) for c in p.inputs))
-        if hasattr(p, "input") and p.input is not None:
-            return dc.replace(p, input=replace(p.input))
-        return p
-
-    return replace(plan)

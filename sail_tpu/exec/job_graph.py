@@ -1,24 +1,41 @@
 """Job graph: splitting a physical plan into distributable stages.
 
 Reference role: JobGraph::try_new and the five-InputMode exchange vocabulary
-(crates/sail-execution/src/job_graph/ — SURVEY.md §2.5). v0 splits at the
-materialization operators (aggregate/join/sort/limit): everything below the
-first such boundary over a partitionable scan becomes a per-partition leaf
-stage (Forward input), and the remainder runs as the root stage over the
-merged leaf outputs (Merge input). Hash-shuffled intermediate stages
-(InputMode::Shuffle riding the all_to_all collectives in parallel/) plug in
-at the same seam in a later round.
+(crates/sail-execution/src/job_graph/mod.rs:134-151, planner.rs:42-61 —
+SURVEY.md §2.5), plus the RemoteExecutionCodec (src/proto/codec.rs)
+re-designed as a whitelist dataclass codec (no pickle: no arbitrary-code
+deserialization, stable across engine versions).
+
+The splitter builds a real multi-stage graph:
+
+- pipeline-over-scan subtrees become FORWARD leaf stages, one task per
+  scan partition;
+- equi-joins of stage outputs become SHUFFLE stages: both producers
+  hash-partition their output on the join keys into R channels, the join
+  stage's task r fetches channel r from every producer partition;
+- a small build side becomes a BROADCAST stage (single task, whole output
+  fetched by every consumer);
+- aggregations split into a partial aggregate FUSED into the producer
+  stage (pre-shuffle reduction — the TPU-friendly two-phase plan) and a
+  final merge aggregate in a SHUFFLE stage keyed on the group columns;
+- whatever remains (sorts, limits, windows, …) runs in the root stage on
+  the driver over MERGE input.
 """
 
 from __future__ import annotations
 
+import base64
 import dataclasses
+import datetime
+import decimal
 import enum
-import pickle
-from typing import List, Optional, Tuple
+import json
+from typing import Dict, List, Optional, Tuple
 
 from ..plan import nodes as pn
 from ..plan import rex as rx
+from ..spec import data_type as dt
+from ..spec.literal import Literal as LV
 
 
 class InputMode(enum.Enum):
@@ -30,71 +47,408 @@ class InputMode(enum.Enum):
 
 
 @dataclasses.dataclass
+class StageInput:
+    stage_id: int
+    mode: InputMode
+
+
+@dataclasses.dataclass
 class Stage:
     stage_id: int
-    plan: pn.PlanNode             # fragment; leaf stages scan a partition slice
-    input_mode: InputMode
-    inputs: Tuple[int, ...] = ()
+    plan: pn.PlanNode
+    inputs: Tuple[StageInput, ...] = ()
     num_partitions: int = 1
+    # hash-route this stage's output into channels on these column indices
+    shuffle_keys: Optional[Tuple[int, ...]] = None
+    num_channels: int = 1
+    on_driver: bool = False
 
 
 @dataclasses.dataclass
 class JobGraph:
     stages: List[Stage]
+    # memory tables stripped out of scan nodes, served by the driver
+    scan_tables: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     @property
     def root(self) -> Stage:
         return self.stages[-1]
 
 
-class _StageInput(pn.PlanNode):
-    """Placeholder leaf standing for a stage's merged upstream output."""
+@dataclasses.dataclass(frozen=True)
+class StageInputExec(pn.PlanNode):
+    """Leaf standing for an upstream stage's exchanged output."""
 
-    def __init__(self, stage_id: int, schema):
-        object.__setattr__(self, "stage_id", stage_id)
-        object.__setattr__(self, "_schema", schema)
+    out_schema: Tuple[pn.Field, ...] = ()
+    stage_id: int = -1
 
     @property
     def schema(self):
-        return self._schema
+        return self.out_schema
+
+    @property
+    def children(self):
+        return ()
+
+
+# ---------------------------------------------------------------------------
+# Fragment codec (reference role: RemoteExecutionCodec, src/proto/codec.rs).
+# Whitelist-tagged JSON: only registered dataclasses decode, so a hostile
+# plan blob cannot execute code on a worker the way pickle would.
+# ---------------------------------------------------------------------------
+
+_CODEC_TYPES: Dict[str, type] = {}
+
+
+def _register_codec_types():
+    import sys
+    if _CODEC_TYPES:
+        return
+    for mod in (pn, rx, dt):
+        for name in dir(mod):
+            obj = getattr(mod, name)
+            if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+                _CODEC_TYPES[f"{mod.__name__.split('.')[-1]}.{name}"] = obj
+    _CODEC_TYPES["literal.Literal"] = LV
+    _CODEC_TYPES["job_graph.StageInputExec"] = StageInputExec
+
+
+def _tag_of(obj) -> str:
+    mod = type(obj).__module__.split(".")[-1]
+    return f"{mod}.{type(obj).__name__}"
+
+
+def _enc(obj):
+    import pyarrow as pa
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        return ["!b", base64.b64encode(obj).decode()]
+    if isinstance(obj, tuple):
+        return ["!t", [_enc(x) for x in obj]]
+    if isinstance(obj, list):
+        return ["!l", [_enc(x) for x in obj]]
+    if isinstance(obj, decimal.Decimal):
+        return ["!D", str(obj)]
+    if isinstance(obj, datetime.datetime):
+        return ["!ts", obj.isoformat()]
+    if isinstance(obj, datetime.date):
+        return ["!d", obj.isoformat()]
+    if isinstance(obj, datetime.timedelta):
+        return ["!td", obj.total_seconds()]
+    if isinstance(obj, pa.Table):
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, obj.schema) as w:
+            w.write_table(obj)
+        return ["!table", base64.b64encode(
+            sink.getvalue().to_pybytes()).decode()]
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        tag = _tag_of(obj)
+        if tag not in _CODEC_TYPES:
+            raise TypeError(f"type not registered with the plan codec: {tag}")
+        fields = {f.name: _enc(getattr(obj, f.name))
+                  for f in dataclasses.fields(obj)}
+        return ["!o", tag, fields]
+    if isinstance(obj, enum.Enum):
+        return ["!e", _tag_of(obj), obj.value]
+    raise TypeError(f"cannot encode {type(obj)!r} in a plan fragment")
+
+
+def _dec(v):
+    import pyarrow as pa
+
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    tag = v[0]
+    if tag == "!b":
+        return base64.b64decode(v[1])
+    if tag == "!t":
+        return tuple(_dec(x) for x in v[1])
+    if tag == "!l":
+        return [_dec(x) for x in v[1]]
+    if tag == "!D":
+        return decimal.Decimal(v[1])
+    if tag == "!ts":
+        return datetime.datetime.fromisoformat(v[1])
+    if tag == "!d":
+        return datetime.date.fromisoformat(v[1])
+    if tag == "!td":
+        return datetime.timedelta(seconds=v[1])
+    if tag == "!table":
+        return pa.ipc.open_stream(base64.b64decode(v[1])).read_all()
+    if tag == "!o":
+        cls = _CODEC_TYPES.get(v[1])
+        if cls is None:
+            raise ValueError(f"unknown plan codec type: {v[1]}")
+        kwargs = {k: _dec(x) for k, x in v[2].items()}
+        return cls(**kwargs)
+    raise ValueError(f"bad plan codec tag: {tag!r}")
+
+
+def encode_fragment(plan: pn.PlanNode) -> bytes:
+    _register_codec_types()
+    return json.dumps(_enc(plan)).encode()
+
+
+def decode_fragment(plan_bytes: bytes, partition: int,
+                    num_partitions: int) -> pn.PlanNode:
+    """Deserialize a fragment, assigning this task its partition of every
+    partitionable scan (files round-robin; memory tables row-sliced)."""
+    import pyarrow as pa
+
+    _register_codec_types()
+    plan = _dec(json.loads(plan_bytes.decode()))
+
+    def attach(p: pn.PlanNode) -> pn.PlanNode:
+        if isinstance(p, pn.ScanExec) and p.source is not None \
+                and num_partitions > 1:
+            table = p.source
+            n = table.num_rows
+            per = -(-n // num_partitions)
+            part = table.slice(partition * per, per)
+            return dataclasses.replace(p, source=part)
+        if isinstance(p, pn.ScanExec) and p.paths:
+            files = list(p.paths)
+            mine = tuple(f for i, f in enumerate(sorted(files))
+                         if i % num_partitions == partition)
+            if not mine:
+                # More partitions than files: this task reads nothing. An
+                # empty memory table (projected schema) keeps the plan
+                # executable without re-reading files[0] (which would
+                # duplicate its rows in the job result).
+                from ..columnar.arrow_interop import spec_type_to_arrow
+                empty = pa.Table.from_arrays(
+                    [pa.array([], type=spec_type_to_arrow(f.dtype))
+                     for f in p.schema],
+                    names=[f.name for f in p.schema])
+                return dataclasses.replace(p, out_schema=p.schema,
+                                           source=empty, paths=(),
+                                           format="memory", projection=None)
+            return dataclasses.replace(p, paths=mine)
+        if isinstance(p, (StageInputExec,)):
+            return p
+        if isinstance(p, pn.JoinExec):
+            return dataclasses.replace(p, left=attach(p.left),
+                                       right=attach(p.right))
+        if isinstance(p, pn.UnionExec):
+            return dataclasses.replace(
+                p, inputs=tuple(attach(c) for c in p.inputs))
+        if hasattr(p, "input") and p.input is not None:
+            return dataclasses.replace(p, input=attach(p.input))
+        return p
+
+    return attach(plan)
+
+
+# ---------------------------------------------------------------------------
+# Stage building
+# ---------------------------------------------------------------------------
+
+_MERGEABLE_AGGS = {"sum": "sum", "count": "sum", "min": "min", "max": "max",
+                   "first": "first", "last": "last",
+                   "bool_and": "bool_and", "bool_or": "bool_or"}
+
+# memory tables smaller than this broadcast instead of shuffling
+BROADCAST_ROW_LIMIT = 100_000
 
 
 def _is_pipeline_op(p: pn.PlanNode) -> bool:
     return isinstance(p, (pn.FilterExec, pn.ProjectExec))
 
 
-def _pipeline_over_scan(p: pn.PlanNode) -> bool:
-    """True if ``p`` is a chain of Filter/Project ops ending at a scan."""
-    seen_pipeline = False
-    while _is_pipeline_op(p):
-        seen_pipeline = True
-        p = p.input
-    return seen_pipeline and isinstance(p, pn.ScanExec)
+class _Builder:
+    def __init__(self, num_partitions: int):
+        self.stages: List[Stage] = []
+        self.scan_tables: Dict[str, object] = {}
+        self.nparts = num_partitions
 
+    def _add(self, stage: Stage) -> Stage:
+        self.stages.append(stage)
+        return stage
 
-def _find_leaf_pipeline(p: pn.PlanNode) -> Optional[pn.PlanNode]:
-    """Topmost subtree that is a pipeline chain over a scan."""
-    if _pipeline_over_scan(p):
+    def _strip_tables(self, p: pn.PlanNode) -> pn.PlanNode:
+        """Move memory tables out of scan nodes into the driver-served
+        table map, so tasks fetch only their slice over the data plane
+        (instead of every task shipping the whole table)."""
+        if isinstance(p, pn.ScanExec) and p.source is not None:
+            src = p.source
+            if p.projection is not None:
+                src = src.select(list(p.projection))
+            scan_id = f"scan{len(self.scan_tables)}"
+            self.scan_tables[scan_id] = src
+            return dataclasses.replace(p, out_schema=p.schema, source=None,
+                                       format="__driver__", projection=None,
+                                       table_name=scan_id)
+        if isinstance(p, pn.JoinExec):
+            return dataclasses.replace(p, left=self._strip_tables(p.left),
+                                       right=self._strip_tables(p.right))
+        if isinstance(p, pn.UnionExec):
+            return dataclasses.replace(p, inputs=tuple(
+                self._strip_tables(c) for c in p.inputs))
+        if hasattr(p, "input") and p.input is not None:
+            return dataclasses.replace(
+                p, input=self._strip_tables(p.input))
         return p
-    for c in p.children:
-        r = _find_leaf_pipeline(c)
-        if r is not None:
-            return r
-    return None
+
+    # -- recursive stage construction -----------------------------------
+    def build(self, p: pn.PlanNode) -> Optional[Stage]:
+        """Try to turn ``p`` into a distributed stage; None → not
+        distributable (stays in the consumer's plan)."""
+        if _is_pipeline_op(p):
+            child = self.build(p.input)
+            if child is None:
+                return None
+            # absorb the pipeline op into the producing stage
+            child.plan = dataclasses.replace(p, input=child.plan) \
+                if hasattr(p, "input") else p
+            return child
+        if isinstance(p, pn.ScanExec):
+            return self._add(Stage(len(self.stages), p, (),
+                                   self.nparts))
+        if isinstance(p, pn.JoinExec):
+            return self._build_join(p)
+        if isinstance(p, pn.AggregateExec):
+            return self._build_aggregate(p)
+        return None
+
+    def _estimated_small(self, stage: Stage) -> bool:
+        p = stage.plan
+        while _is_pipeline_op(p):
+            p = p.input
+        if isinstance(p, pn.ScanExec) and p.format == "__driver__":
+            table = self.scan_tables.get(p.table_name)
+            return table is not None and table.num_rows <= BROADCAST_ROW_LIMIT
+        return False
+
+    def _build_join(self, p: pn.JoinExec) -> Optional[Stage]:
+        if p.join_type == "cross" or not p.left_keys or p.null_aware:
+            return None
+        lkeys = _plain_key_indices(p.left_keys)
+        rkeys = _plain_key_indices(p.right_keys)
+        if lkeys is None or rkeys is None:
+            return None
+        n_before = len(self.stages)
+        left = self.build(p.left)
+        if left is None:
+            del self.stages[n_before:]
+            return None
+        right = self.build(p.right)
+        if right is None:
+            del self.stages[n_before:]
+            return None
+        l_in = StageInputExec(tuple(p.left.schema), left.stage_id)
+        r_in = StageInputExec(tuple(p.right.schema), right.stage_id)
+        join_plan = dataclasses.replace(p, left=l_in, right=r_in)
+        if self._estimated_small(right) and p.join_type in (
+                "inner", "left", "semi", "anti"):
+            # broadcast build side: one producer task, every probe task
+            # fetches the whole build output
+            right.num_partitions = 1
+            return self._add(Stage(
+                len(self.stages), join_plan,
+                (StageInput(left.stage_id, InputMode.FORWARD),
+                 StageInput(right.stage_id, InputMode.BROADCAST)),
+                left.num_partitions))
+        if left.shuffle_keys is not None or right.shuffle_keys is not None:
+            # a producer can only shuffle-write once; re-sharding an
+            # already-shuffled stage needs an extra identity stage
+            del self.stages[n_before:]
+            return None
+        left.shuffle_keys = lkeys
+        left.num_channels = self.nparts
+        right.shuffle_keys = rkeys
+        right.num_channels = self.nparts
+        return self._add(Stage(
+            len(self.stages), join_plan,
+            (StageInput(left.stage_id, InputMode.SHUFFLE),
+             StageInput(right.stage_id, InputMode.SHUFFLE)),
+            self.nparts))
+
+    def _build_aggregate(self, p: pn.AggregateExec) -> Optional[Stage]:
+        if any(a.distinct for a in p.aggs):
+            return None
+        if any(a.fn not in _MERGEABLE_AGGS for a in p.aggs):
+            return None
+        child = self.build(p.input)
+        if child is None:
+            return None
+        if child.shuffle_keys is not None:
+            return None  # producer already routes a join shuffle
+        nk = len(p.group_indices)
+        # partial aggregate fused into the producer stage (pre-shuffle
+        # reduction: the TPU two-phase aggregation plan)
+        partial = dataclasses.replace(p, input=child.plan)
+        child.plan = partial
+        child.shuffle_keys = tuple(range(nk))
+        child.num_channels = self.nparts
+        # final merge aggregate over the shuffled partials
+        f_in = StageInputExec(tuple(partial.schema), child.stage_id)
+        final_aggs = []
+        for j, a in enumerate(p.aggs):
+            out_f = partial.schema[nk + j]
+            final_aggs.append(pn.AggSpec(
+                _MERGEABLE_AGGS[a.fn], nk + j, False, out_f.dtype,
+                None, a.ignore_nulls))
+        final = pn.AggregateExec(f_in, tuple(range(nk)), tuple(final_aggs),
+                                 tuple(p.out_names), p.max_groups_hint)
+        return self._add(Stage(
+            len(self.stages), final,
+            (StageInput(child.stage_id, InputMode.SHUFFLE),),
+            self.nparts))
+
+
+def _plain_key_indices(keys) -> Optional[Tuple[int, ...]]:
+    out = []
+    for k in keys:
+        if isinstance(k, rx.BoundRef):
+            out.append(k.index)
+        else:
+            return None
+    return tuple(out)
 
 
 def split_job(plan: pn.PlanNode, num_partitions: int) -> Optional[JobGraph]:
-    """Split into (leaf pipeline stage over scan partitions, root stage).
-    Returns None when the plan has no distributable pipeline subtree (the
-    local executor should run it directly)."""
-    target = _find_leaf_pipeline(plan)
-    if target is None or target is plan and not _is_pipeline_op(plan):
+    """Split into a multi-stage graph; None → run locally."""
+    b = _Builder(num_partitions)
+    plan = b._strip_tables(plan)
+    top = b.build(plan)
+    if top is None:
+        # try the largest distributable subtree instead
+        sub = _find_distributable_subtree(b, plan)
+        if sub is None:
+            return None
+        top, target = sub
+        root_plan = _replace_subtree(
+            plan, target, StageInputExec(tuple(target.schema), top.stage_id))
+    else:
+        root_plan = StageInputExec(tuple(plan.schema), top.stage_id)
+    if not b.stages:
         return None
-    leaf = Stage(0, target, InputMode.FORWARD, (), num_partitions)
-    root_input = _StageInput(0, target.schema)
-    root_plan = _replace_subtree(plan, target, root_input)
-    root = Stage(1, root_plan, InputMode.MERGE, (0,), 1)
-    return JobGraph([leaf, root])
+    root = Stage(len(b.stages), root_plan,
+                 (StageInput(top.stage_id, InputMode.MERGE),), 1,
+                 on_driver=True)
+    b.stages.append(root)
+    return JobGraph(b.stages, b.scan_tables)
+
+
+def _find_distributable_subtree(b: "_Builder", plan: pn.PlanNode):
+    """DFS for the topmost subtree the builder can distribute."""
+    for node in _topdown(plan):
+        if node is plan:
+            continue
+        n_before = len(b.stages)
+        got = b.build(node)
+        if got is not None:
+            return got, node
+        del b.stages[n_before:]
+    return None
+
+
+def _topdown(p: pn.PlanNode):
+    yield p
+    for c in p.children:
+        yield from _topdown(c)
 
 
 def _replace_subtree(plan: pn.PlanNode, target: pn.PlanNode,
@@ -116,80 +470,45 @@ def _replace_subtree(plan: pn.PlanNode, target: pn.PlanNode,
 
 
 # ---------------------------------------------------------------------------
-# fragment codec (reference role: RemoteExecutionCodec, src/proto/codec.rs)
+# Worker-side exchange helpers
 # ---------------------------------------------------------------------------
 
-def encode_fragment(plan: pn.PlanNode) -> Tuple[bytes, Optional[bytes]]:
-    """Serialize a plan fragment for shipping to a worker.
+def hash_partition_table(table, key_columns, num_channels: int):
+    """Split an arrow table into hash channels on the key columns.
 
-    Memory-table scans carry their data as Arrow IPC alongside the plan
-    (v0; file scans ship only paths). Returns (plan_bytes, table_ipc|None).
-    """
-    import pyarrow as pa
+    Value-based (dictionary-safe) deterministic hashing so producers on
+    different workers route equal keys to the same channel."""
+    import numpy as np
+    import pandas as pd
 
-    table_ipc = None
+    if table.num_rows == 0 or num_channels <= 1:
+        return [table] + [table.slice(0, 0)] * (num_channels - 1)
+    keys = table.select(list(key_columns)).to_pandas()
+    h = pd.util.hash_pandas_object(keys, index=False).values
+    ch = (h % np.uint64(num_channels)).astype(np.int64)
+    order = np.argsort(ch, kind="stable")
+    taken = table.take(order)
+    bounds = np.searchsorted(ch[order], np.arange(num_channels + 1))
+    return [taken.slice(int(bounds[i]), int(bounds[i + 1] - bounds[i]))
+            for i in range(num_channels)]
 
-    def strip(p: pn.PlanNode) -> pn.PlanNode:
-        nonlocal table_ipc
-        if isinstance(p, pn.ScanExec) and p.source is not None:
-            sink = pa.BufferOutputStream()
-            src = p.source
-            if p.projection is not None:
-                src = src.select(list(p.projection))
-            with pa.ipc.new_stream(sink, src.schema) as w:
-                w.write_table(src)
-            table_ipc = sink.getvalue().to_pybytes()
-            return dataclasses.replace(p, source=None, format="__shipped__",
-                                       projection=None)
+
+def attach_stage_inputs(plan: pn.PlanNode, tables: Dict[int, object]
+                        ) -> pn.PlanNode:
+    """Replace StageInputExec leaves with memory scans of fetched tables."""
+
+    def repl(p):
+        if isinstance(p, StageInputExec):
+            return pn.ScanExec(tuple(p.schema), tables[p.stage_id], (),
+                               "memory")
         if isinstance(p, pn.JoinExec):
-            return dataclasses.replace(p, left=strip(p.left), right=strip(p.right))
+            return dataclasses.replace(p, left=repl(p.left),
+                                       right=repl(p.right))
         if isinstance(p, pn.UnionExec):
-            return dataclasses.replace(p, inputs=tuple(strip(c) for c in p.inputs))
+            return dataclasses.replace(p, inputs=tuple(repl(c)
+                                                       for c in p.inputs))
         if hasattr(p, "input") and p.input is not None:
-            return dataclasses.replace(p, input=strip(p.input))
+            return dataclasses.replace(p, input=repl(p.input))
         return p
 
-    stripped = strip(plan)
-    return pickle.dumps(stripped), table_ipc
-
-
-def decode_fragment(plan_bytes: bytes, table_ipc: Optional[bytes],
-                    partition: int, num_partitions: int) -> pn.PlanNode:
-    """Deserialize a fragment, re-attaching shipped data sliced to this
-    task's partition."""
-    import pyarrow as pa
-
-    plan = pickle.loads(plan_bytes)
-
-    def attach(p: pn.PlanNode) -> pn.PlanNode:
-        if isinstance(p, pn.ScanExec) and p.format == "__shipped__":
-            table = pa.ipc.open_stream(table_ipc).read_all()
-            n = table.num_rows
-            per = -(-n // num_partitions)
-            part = table.slice(partition * per, per)
-            return dataclasses.replace(p, source=part, format="memory")
-        if isinstance(p, pn.ScanExec) and p.paths:
-            files = list(p.paths)
-            mine = tuple(f for i, f in enumerate(sorted(files))
-                         if i % num_partitions == partition)
-            if not mine:
-                # More partitions than files: this task reads nothing. An
-                # empty memory table (projected schema) keeps the plan
-                # executable without re-reading files[0] (which would
-                # duplicate its rows in the job result).
-                from ..columnar.arrow_interop import spec_type_to_arrow
-                empty = pa.Table.from_arrays(
-                    [pa.array([], type=spec_type_to_arrow(f.dtype))
-                     for f in p.schema],
-                    names=[f.name for f in p.schema])
-                return dataclasses.replace(p, out_schema=p.schema,
-                                           source=empty, paths=(),
-                                           format="memory", projection=None)
-            return dataclasses.replace(p, paths=mine)
-        if isinstance(p, pn.JoinExec):
-            return dataclasses.replace(p, left=attach(p.left), right=attach(p.right))
-        if hasattr(p, "input") and p.input is not None:
-            return dataclasses.replace(p, input=attach(p.input))
-        return p
-
-    return attach(plan)
+    return repl(plan)

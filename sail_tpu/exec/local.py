@@ -39,6 +39,22 @@ class ExecutionError(RuntimeError):
     pass
 
 
+def _fit_capacity(data, validity, cap: int):
+    """Broadcast constant (scalar / 1-element) expression results to the
+    batch capacity, so literal projections over OneRow line up with the
+    selection mask (UNIONs of FROM-less SELECTs concatenate per-column)."""
+    if data.ndim == 0:
+        data = jnp.broadcast_to(data[None], (cap,))
+    elif data.shape[0] != cap and data.shape[0] == 1:
+        data = jnp.broadcast_to(data, (cap,))
+    if validity is not None:
+        if validity.ndim == 0:
+            validity = jnp.broadcast_to(validity[None], (cap,))
+        elif validity.shape[0] != cap and validity.shape[0] == 1:
+            validity = jnp.broadcast_to(validity, (cap,))
+    return data, validity
+
+
 def _col_name(i: int) -> str:
     return f"c{i}"
 
@@ -204,6 +220,13 @@ class LocalExecutor:
         import os
         if p.source is not None:
             cache_key = ("mem", id(p.source), p.projection)
+        elif p.format == "delta":
+            from ..lakehouse.delta import DeltaLog
+            files = p.paths
+            mtimes = (DeltaLog(p.paths[0]).latest_version(),
+                      tuple(sorted(dict(p.options).items())))
+            cache_key = ("delta", files, mtimes, p.projection,
+                         tuple((f.name, f.dtype) for f in p.schema))
         else:
             try:
                 files = tuple(expand_paths(p.paths))
@@ -305,8 +328,11 @@ class LocalExecutor:
         except HostFallback:
             return self._project_host_path(p, child)
         results = fn(self._cols(child))
-        out_cols = {_col_name(i): Column(d, v, rx.rex_type(e))
-                    for i, ((d, v), (_, e)) in enumerate(zip(results, p.exprs))}
+        cap = dev.sel.shape[0]
+        out_cols = {}
+        for i, ((d, v), (_, e)) in enumerate(zip(results, p.exprs)):
+            d, v = _fit_capacity(d, v, cap)
+            out_cols[_col_name(i)] = Column(d, v, rx.rex_type(e))
         return HostBatch(DeviceBatch(out_cols, dev.sel), out_dicts)
 
     def _project_host_path(self, p: pn.ProjectExec, child: HostBatch) -> HostBatch:
@@ -338,6 +364,8 @@ class LocalExecutor:
         """Host evaluation of a __pyudf call (incl. string returns): args
         evaluate on device, rows run through the Python function, string
         results dictionary-encode."""
+        if isinstance(e, rx.RCast) and isinstance(e.dtype, dt.StringType):
+            return self._host_cast_to_string(e, comp, child)
         if not (isinstance(e, rx.RCall) and e.fn == "__pyudf"):
             raise ExecutionError(
                 f"expression requires host evaluation but no host path exists: "
@@ -367,6 +395,47 @@ class LocalExecutor:
         jdt = physical_jnp_dtype(out_t)
         out, mask = udf_encode_numeric(res, n, np.dtype(jdt))
         return jnp.asarray(out), jnp.asarray(mask), None
+
+    def _host_cast_to_string(self, e: rx.RCast, comp: ExprCompiler,
+                             child: HostBatch):
+        """CAST(x AS STRING) for non-dictionary columns: evaluate the child
+        on device, format values on host with Spark's text forms, and
+        dictionary-encode the result."""
+        import datetime as _dtm
+        import decimal as _dec
+
+        ac = comp.compile(e.child)
+        data, validity = self._eval(ac, child)
+        src_t = rx.rex_type(e.child)
+        arr = ai.column_values_to_arrow(np.asarray(data),
+                                        None if validity is None
+                                        else np.asarray(validity),
+                                        src_t, ac.dictionary)
+
+        def fmt(v):
+            if v is None:
+                return None
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if isinstance(v, float):
+                return repr(v)
+            if isinstance(v, _dtm.datetime):
+                s = v.strftime("%Y-%m-%d %H:%M:%S")
+                if v.microsecond:
+                    s += f".{v.microsecond:06d}".rstrip("0")
+                return s
+            if isinstance(v, _dtm.date):
+                return v.isoformat()
+            if isinstance(v, _dec.Decimal):
+                return format(v, "f")
+            return str(v)
+
+        sarr = pa.array([fmt(v) for v in arr.to_pylist()], type=pa.string())
+        enc = sarr.dictionary_encode()
+        codes = np.asarray(enc.indices.fill_null(0)).astype(np.int32)
+        import pyarrow.compute as _pc
+        out_validity = jnp.asarray(np.asarray(_pc.is_valid(sarr)))
+        return jnp.asarray(codes), out_validity, enc.dictionary
 
     def _exec_FilterExec(self, p: pn.FilterExec) -> HostBatch:
         child = self.run(p.input)
@@ -656,7 +725,7 @@ class LocalExecutor:
         jt = p.join_type
         if jt == "anti" and p.null_aware:
             return self._null_aware_anti(p, left, right)
-        if jt == "cross" and not p.left_keys:
+        if jt in ("cross", "inner") and not p.left_keys:
             out = self._cross_join(p, left, right)
             if p.residual is not None:
                 comb_schema = tuple(p.left.schema) + tuple(p.right.schema)
@@ -693,7 +762,7 @@ class LocalExecutor:
         excluded while the build side is non-empty.
         """
         rcomp = self._compiler(right, p.right.schema)
-        rdata, rval = self._eval(rcomp.compile(p.right_keys[-1]), right)
+        _, rval = self._eval(rcomp.compile(p.right_keys[-1]), right)
         rsel = right.device.sel
         if int(jnp.sum(rsel)) == 0:
             return left
@@ -709,7 +778,7 @@ class LocalExecutor:
                 left.dicts)
         out = self._join(p, left, right)
         lcomp = self._compiler(left, p.left.schema)
-        ldata, lval = self._eval(lcomp.compile(p.left_keys[-1]), left)
+        _, lval = self._eval(lcomp.compile(p.left_keys[-1]), left)
         if lval is not None and bool(jnp.any(left.device.sel & ~lval)):
             if correlated:
                 raise ExecutionError(

@@ -876,11 +876,8 @@ class Resolver:
         # coercion); the union output schema is then the common schema.
         common = []
         for lf, rf in zip(left.schema, right.schema):
-            if isinstance(lf.dtype, dt.NullType) or isinstance(rf.dtype, dt.NullType):
-                cdt = rf.dtype if isinstance(lf.dtype, dt.NullType) else lf.dtype
-            else:
-                cdt = dt.common_type(lf.dtype, rf.dtype)
-            common.append(pn.Field(lf.name, cdt, lf.nullable or rf.nullable))
+            common.append(pn.Field(lf.name, _setop_common(lf.dtype, rf.dtype),
+                                   lf.nullable or rf.nullable))
         right = _coerce_to(right, common)
         left = _coerce_to(left, common)
         if plan.op == "union":
@@ -1750,17 +1747,29 @@ def _group_scalar_subplan(node: pn.PlanNode, right_keys: List[rx.Rex]):
     return out, nk, list(range(nk))
 
 
+def _setop_common(a: dt.DataType, b: dt.DataType) -> dt.DataType:
+    """Set-operation column widening: like common_type, except string with
+    a non-string side widens to STRING (Spark's findWiderTypeForTwo), not
+    to the arithmetic double coercion."""
+    if isinstance(a, dt.NullType):
+        return b
+    if isinstance(b, dt.NullType):
+        return a
+    if isinstance(a, dt.StringType) != isinstance(b, dt.StringType):
+        return dt.StringType()
+    return dt.common_type(a, b)
+
+
 def _coerce_to(node: pn.PlanNode, target: Sequence[pn.Field]) -> pn.PlanNode:
     needs = False
     exprs = []
     for i, (f, t) in enumerate(zip(node.schema, target)):
         r: rx.Rex = rx.BoundRef(i, f.name, f.dtype, f.nullable)
-        if f.dtype != t.dtype and not isinstance(t.dtype, dt.NullType) \
-                and not isinstance(f.dtype, dt.NullType):
-            common = dt.common_type(f.dtype, t.dtype)
-            if f.dtype != common:
-                r = rx.RCast(r, common)
-                needs = True
+        if f.dtype != t.dtype and not isinstance(t.dtype, dt.NullType):
+            # cast straight to the caller-computed target type (a NullType
+            # source lowers to a typed null literal in the compiler)
+            r = rx.RCast(r, t.dtype)
+            needs = True
         exprs.append((f.name, r))
     if not needs:
         return node

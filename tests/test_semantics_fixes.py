@@ -38,6 +38,18 @@ def test_union_decimal_double(spark):
     assert sorted(got.iloc[:, 0].tolist()) == [0.5, 1.0, 2.0]
 
 
+def test_union_null_column_keeps_typed_values(spark):
+    got = _sql(spark, "SELECT NULL AS a UNION ALL SELECT 1 AS a")
+    vals = got.iloc[:, 0].tolist()
+    assert sorted(v for v in vals if not pd.isna(v)) == [1]
+    assert sum(1 for v in vals if pd.isna(v)) == 1
+
+
+def test_union_string_numeric_widens_to_string(spark):
+    got = _sql(spark, "SELECT 'x' AS a UNION ALL SELECT 1 AS a")
+    assert sorted(got.iloc[:, 0].tolist()) == ["1", "x"]
+
+
 def test_in_subquery_width_no_aliasing(spark):
     # int32 probe vs int64 build whose value aliases 1 mod 2^32
     spark.createDataFrame(pd.DataFrame({
@@ -135,10 +147,10 @@ def test_scan_partition_no_duplication(tmp_path):
     scan = pn.ScanExec(schema, None,
                        (str(tmp_path / "a.parquet"), str(tmp_path / "b.parquet")),
                        "parquet")
-    blob, ipc = encode_fragment(scan)
+    blob = encode_fragment(scan)
     rows = []
     for part in range(4):  # more partitions than files
-        frag = decode_fragment(blob, ipc, part, 4)
+        frag = decode_fragment(blob, part, 4)
         out = LocalExecutor({}).execute(frag)
         rows.extend(out.column("x").to_pylist())
     assert sorted(rows) == [1, 2, 3, 4, 5]
