@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import datetime
 import json
+import os
 import sys
 import time
 
@@ -83,23 +84,35 @@ def generate_lineitem_sf(sf: float, seed: int = 0):
     })
 
 
-def _probe_backend(timeout_s: float = 150.0) -> bool:
-    """Check in a subprocess that the default jax backend initializes —
-    a wedged remote-TPU tunnel would otherwise hang this process forever."""
+def _probe_backend(timeout_s: float, attempts: int = 3) -> bool:
+    """Check in a subprocess that the default jax backend initializes — a
+    wedged remote-TPU tunnel would otherwise hang this process forever.
+    Failures are RETRIED and LOGGED to stderr (never silently swallowed):
+    a missing TPU number must be attributable to a concrete tunnel error."""
     import subprocess
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    for attempt in range(1, attempts + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s, capture_output=True, text=True)
+            if r.returncode == 0:
+                return True
+            tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
+            print(f"bench: TPU probe attempt {attempt}/{attempts} failed "
+                  f"(rc={r.returncode}): " + " | ".join(tail),
+                  file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"bench: TPU probe attempt {attempt}/{attempts} timed out "
+                  f"after {timeout_s:.0f}s (tunnel hung)", file=sys.stderr)
+    print("bench: all TPU probes failed — falling back to CPU "
+          "(platform field will say so)", file=sys.stderr)
+    return False
 
 
 def main():
     sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
-    if not _probe_backend():
-        import os
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150"))
+    if not _probe_backend(probe_timeout):
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
         jax.config.update("jax_platforms", "cpu")

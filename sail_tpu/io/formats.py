@@ -38,6 +38,10 @@ def expand_paths(paths: Sequence[str]) -> List[str]:
 
 
 def infer_schema(fmt: str, paths: Sequence[str], options: Dict[str, str]) -> dt.StructType:
+    if fmt.lower() == "delta":
+        from ..lakehouse.delta import DeltaTable
+        return DeltaTable(paths[0]).snapshot(
+            *_delta_travel(options)).schema
     files = expand_paths(paths)
     if not files:
         raise FileNotFoundError(f"no files found for {paths}")
@@ -47,11 +51,30 @@ def infer_schema(fmt: str, paths: Sequence[str], options: Dict[str, str]) -> dt.
         for n, c in zip(table.column_names, table.columns)))
 
 
+def _delta_travel(options: Dict[str, str]):
+    opts = {k.lower(): v for k, v in options.items()}
+    version = opts.get("versionasof")
+    ts = opts.get("timestampasof")
+    ts_ms = None
+    if ts is not None:
+        import datetime
+        dtv = datetime.datetime.fromisoformat(ts)
+        if dtv.tzinfo is None:
+            dtv = dtv.replace(tzinfo=datetime.timezone.utc)
+        ts_ms = int(dtv.timestamp() * 1000)
+    return (int(version) if version is not None else None), ts_ms
+
+
 def read_table(fmt: str, paths: Sequence[str], options: Dict[str, str],
                columns: Optional[Sequence[str]] = None,
                limit: Optional[int] = None) -> pa.Table:
-    files = expand_paths(paths)
     fmt = fmt.lower()
+    if fmt == "delta":
+        from ..lakehouse.delta import DeltaTable
+        version, ts_ms = _delta_travel(options)
+        return DeltaTable(paths[0]).to_arrow(version, ts_ms,
+                                             columns=columns)
+    files = expand_paths(paths)
     if fmt == "parquet":
         tables = [pq.read_table(f, columns=list(columns) if columns else None)
                   for f in files]
@@ -104,6 +127,21 @@ def write_table(table: pa.Table, fmt: str, path: str, mode: str = "error",
                 partition_by: Sequence[str] = ()):
     options = options or {}
     fmt = fmt.lower()
+    if fmt == "delta":
+        from ..lakehouse.delta import DeltaTable
+        t = DeltaTable(path)
+        if not DeltaTable.exists(path):
+            t.create(table, partition_by)
+            return
+        if mode == "error":
+            raise FileExistsError(f"Delta table already exists: {path}")
+        if mode == "ignore":
+            return
+        if mode == "append":
+            t.append(table)
+        else:
+            t.overwrite(table)
+        return
     exists = os.path.exists(path) and (os.listdir(path) if os.path.isdir(path) else True)
     if mode == "error" and exists:
         raise FileExistsError(f"path already exists: {path}")

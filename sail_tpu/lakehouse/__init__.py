@@ -1,0 +1,5 @@
+"""Lakehouse table formats (Delta Lake, Iceberg) — from scratch.
+
+Reference role: crates/sail-delta-lake, crates/sail-iceberg (both built
+from scratch in the reference too; SURVEY.md §2.6).
+"""

@@ -1,0 +1,3 @@
+from .table import DeltaTable  # noqa: F401
+from .log import DeltaLog, Snapshot  # noqa: F401
+from .transaction import CommitConflict, Transaction  # noqa: F401

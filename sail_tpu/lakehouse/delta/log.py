@@ -1,0 +1,318 @@
+"""Delta transaction log: commit files, checkpoints, snapshot replay.
+
+Reference role: crates/sail-delta-lake/src/delta_log/ (log listing,
+segment replay, checkpoints) and src/spec/ (actions). From scratch against
+the public Delta protocol: a table is a directory with `_delta_log/`
+containing ordered JSON commits `%020d.json`, optional parquet checkpoints
+`%020d.checkpoint.parquet`, and a `_last_checkpoint` pointer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+LOG_DIR = "_delta_log"
+CHECKPOINT_INTERVAL = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class AddFile:
+    path: str
+    size: int = 0
+    partition_values: Tuple[Tuple[str, str], ...] = ()
+    modification_time: int = 0
+    data_change: bool = True
+    stats: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return {"add": {
+            "path": self.path, "size": self.size,
+            "partitionValues": dict(self.partition_values),
+            "modificationTime": self.modification_time,
+            "dataChange": self.data_change,
+            **({"stats": self.stats} if self.stats else {}),
+        }}
+
+
+@dataclasses.dataclass(frozen=True)
+class RemoveFile:
+    path: str
+    deletion_timestamp: int = 0
+    data_change: bool = True
+
+    def to_json(self) -> dict:
+        return {"remove": {
+            "path": self.path, "deletionTimestamp": self.deletion_timestamp,
+            "dataChange": self.data_change,
+        }}
+
+
+@dataclasses.dataclass(frozen=True)
+class Metadata:
+    schema_string: str
+    partition_columns: Tuple[str, ...] = ()
+    table_id: str = ""
+    name: Optional[str] = None
+    configuration: Tuple[Tuple[str, str], ...] = ()
+    created_time: int = 0
+
+    def to_json(self) -> dict:
+        return {"metaData": {
+            "id": self.table_id or str(uuid.uuid4()),
+            "name": self.name,
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": self.schema_string,
+            "partitionColumns": list(self.partition_columns),
+            "configuration": dict(self.configuration),
+            "createdTime": self.created_time or int(time.time() * 1000),
+        }}
+
+
+@dataclasses.dataclass(frozen=True)
+class Protocol:
+    min_reader_version: int = 1
+    min_writer_version: int = 2
+
+    def to_json(self) -> dict:
+        return {"protocol": {
+            "minReaderVersion": self.min_reader_version,
+            "minWriterVersion": self.min_writer_version,
+        }}
+
+
+@dataclasses.dataclass
+class Snapshot:
+    version: int
+    metadata: Optional[Metadata]
+    protocol: Optional[Protocol]
+    files: Dict[str, AddFile]
+    timestamp_ms: int = 0
+
+    @property
+    def schema(self):
+        from ...spec.schema_json import schema_from_json
+        return schema_from_json(json.loads(self.metadata.schema_string))
+
+
+_MAP_FIELDS = ("partitionValues", "configuration", "options")
+
+
+def _maps_to_dicts(v):
+    """pyarrow map columns come back as lists of (k, v) pairs; convert the
+    known Delta map fields back to dicts."""
+    if isinstance(v, dict):
+        out = {}
+        for k, x in v.items():
+            if k in _MAP_FIELDS and isinstance(x, list):
+                out[k] = dict(x)
+            else:
+                out[k] = _maps_to_dicts(x)
+        return out
+    return v
+
+
+def _commit_path(log_dir: str, version: int) -> str:
+    return os.path.join(log_dir, f"{version:020d}.json")
+
+
+def _checkpoint_path(log_dir: str, version: int) -> str:
+    return os.path.join(log_dir, f"{version:020d}.checkpoint.parquet")
+
+
+class DeltaLog:
+    def __init__(self, table_path: str):
+        self.table_path = table_path
+        self.log_dir = os.path.join(table_path, LOG_DIR)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.log_dir) and bool(self.versions())
+
+    def versions(self) -> List[int]:
+        if not os.path.isdir(self.log_dir):
+            return []
+        out = []
+        for name in os.listdir(self.log_dir):
+            if name.endswith(".json") and len(name) == 25:
+                try:
+                    out.append(int(name[:20]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_version(self) -> Optional[int]:
+        vs = self.versions()
+        return vs[-1] if vs else None
+
+    # -- action IO -------------------------------------------------------
+    def read_commit(self, version: int) -> List[dict]:
+        path = _commit_path(self.log_dir, version)
+        with open(path, "r", encoding="utf-8") as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def write_commit_atomic(self, version: int, actions: List[dict]):
+        """Atomically create the commit file for ``version``; raises
+        FileExistsError when another writer got there first (the optimistic
+        concurrency primitive)."""
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = _commit_path(self.log_dir, version)
+        data = "\n".join(json.dumps(a, separators=(",", ":"))
+                         for a in actions) + "\n"
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+        try:
+            os.write(fd, data.encode())
+        finally:
+            os.close(fd)
+
+    # -- checkpoints -----------------------------------------------------
+    def last_checkpoint(self) -> Optional[int]:
+        p = os.path.join(self.log_dir, "_last_checkpoint")
+        if not os.path.exists(p):
+            return None
+        with open(p, "r", encoding="utf-8") as f:
+            return int(json.load(f)["version"])
+
+    # Classic Delta checkpoint layout: one row per action, one nullable
+    # struct column per action type (protocol / metaData / add), so
+    # standard Delta readers can load the checkpoint.
+    _CP_SCHEMA = None
+
+    @staticmethod
+    def _checkpoint_schema():
+        import pyarrow as pa
+
+        if DeltaLog._CP_SCHEMA is None:
+            str_map = pa.map_(pa.string(), pa.string())
+            DeltaLog._CP_SCHEMA = pa.schema([
+                ("protocol", pa.struct([
+                    ("minReaderVersion", pa.int32()),
+                    ("minWriterVersion", pa.int32())])),
+                ("metaData", pa.struct([
+                    ("id", pa.string()), ("name", pa.string()),
+                    ("description", pa.string()),
+                    ("format", pa.struct([("provider", pa.string()),
+                                          ("options", str_map)])),
+                    ("schemaString", pa.string()),
+                    ("partitionColumns", pa.list_(pa.string())),
+                    ("configuration", str_map),
+                    ("createdTime", pa.int64())])),
+                ("add", pa.struct([
+                    ("path", pa.string()),
+                    ("partitionValues", str_map),
+                    ("size", pa.int64()),
+                    ("modificationTime", pa.int64()),
+                    ("dataChange", pa.bool_()),
+                    ("stats", pa.string())])),
+            ])
+        return DeltaLog._CP_SCHEMA
+
+    def write_checkpoint(self, snapshot: Snapshot):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        rows = []
+        if snapshot.protocol is not None:
+            rows.append({"protocol": snapshot.protocol.to_json()["protocol"]})
+        if snapshot.metadata is not None:
+            m = snapshot.metadata.to_json()["metaData"]
+            m["format"]["options"] = list(m["format"]["options"].items())
+            m["configuration"] = list(m["configuration"].items())
+            rows.append({"metaData": m})
+        for add in snapshot.files.values():
+            a = add.to_json()["add"]
+            a["partitionValues"] = list(a["partitionValues"].items())
+            a.setdefault("stats", None)
+            rows.append({"add": a})
+        schema = self._checkpoint_schema()
+        cols = {name: [r.get(name) for r in rows] for name in schema.names}
+        table = pa.table({n: pa.array(cols[n], type=schema.field(n).type)
+                          for n in schema.names})
+        pq.write_table(table, _checkpoint_path(self.log_dir,
+                                               snapshot.version))
+        tmp = os.path.join(self.log_dir, "_last_checkpoint.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": snapshot.version, "size": len(rows)}, f)
+        os.replace(tmp, os.path.join(self.log_dir, "_last_checkpoint"))
+
+    def read_checkpoint(self, version: int) -> List[dict]:
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(_checkpoint_path(self.log_dir, version))
+        out: List[dict] = []
+        for row in table.to_pylist():
+            for kind in ("protocol", "metaData", "add", "remove", "txn"):
+                v = row.get(kind)
+                if v is None:
+                    continue
+                v = _maps_to_dicts(v)
+                out.append({kind: v})
+        return out
+
+    # -- replay ----------------------------------------------------------
+    def snapshot(self, version: Optional[int] = None,
+                 timestamp_ms: Optional[int] = None) -> Snapshot:
+        versions = self.versions()
+        if not versions:
+            raise FileNotFoundError(
+                f"not a Delta table (no {LOG_DIR}): {self.table_path}")
+        if timestamp_ms is not None and version is None:
+            version = self._version_at_timestamp(versions, timestamp_ms)
+        if version is None:
+            version = versions[-1]
+        if version not in versions:
+            raise ValueError(f"version {version} not in Delta log "
+                             f"(have {versions[0]}..{versions[-1]})")
+        start = 0
+        snap = Snapshot(version, None, None, {})
+        cp = self.last_checkpoint()
+        if cp is not None and cp <= version:
+            for action in self.read_checkpoint(cp):
+                self._apply(snap, action)
+            start = cp + 1
+        for v in versions:
+            if start <= v <= version:
+                for action in self.read_commit(v):
+                    self._apply(snap, action)
+        snap.version = version
+        snap.timestamp_ms = int(os.path.getmtime(
+            _commit_path(self.log_dir, version)) * 1000)
+        return snap
+
+    def _version_at_timestamp(self, versions: List[int], ts_ms: int) -> int:
+        best = None
+        for v in versions:
+            mtime = os.path.getmtime(_commit_path(self.log_dir, v)) * 1000
+            if mtime <= ts_ms:
+                best = v
+        if best is None:
+            raise ValueError(f"no Delta version at or before timestamp "
+                             f"{ts_ms}")
+        return best
+
+    @staticmethod
+    def _apply(snap: Snapshot, action: dict):
+        if "metaData" in action:
+            m = action["metaData"]
+            snap.metadata = Metadata(
+                m["schemaString"], tuple(m.get("partitionColumns", ())),
+                m.get("id", ""), m.get("name"),
+                tuple(sorted((m.get("configuration") or {}).items())),
+                m.get("createdTime", 0))
+        elif "protocol" in action:
+            p = action["protocol"]
+            snap.protocol = Protocol(p.get("minReaderVersion", 1),
+                                     p.get("minWriterVersion", 2))
+        elif "add" in action:
+            a = action["add"]
+            snap.files[a["path"]] = AddFile(
+                a["path"], a.get("size", 0),
+                tuple(sorted((a.get("partitionValues") or {}).items())),
+                a.get("modificationTime", 0), a.get("dataChange", True),
+                a.get("stats"))
+        elif "remove" in action:
+            snap.files.pop(action["remove"]["path"], None)
+        # commitInfo / txn are informational for replay

@@ -1,0 +1,261 @@
+"""DeltaTable: open/read/time-travel/append/overwrite/delete.
+
+Reference role: crates/sail-delta-lake/src/table/mod.rs:80-272 (open/
+load/time travel) and the write pipelines
+(src/physical_plan/planner/op_{write,delete}.rs) collapsed to arrow-level
+operations: data files are parquet written via pyarrow, partitioned
+Hive-style by the metadata's partitionColumns.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .log import AddFile, DeltaLog, Metadata, Protocol, RemoveFile, Snapshot
+from .transaction import Transaction
+
+
+def _stats_for(table) -> str:
+    import pyarrow.compute as pc
+
+    stats: Dict[str, object] = {"numRecords": table.num_rows}
+    min_v: Dict[str, object] = {}
+    max_v: Dict[str, object] = {}
+    null_c: Dict[str, object] = {}
+    for name in table.column_names:
+        col = table.column(name)
+        try:
+            null_c[name] = col.null_count
+            if table.num_rows and col.null_count < table.num_rows and \
+                    not str(col.type).startswith(("struct", "list", "map",
+                                                  "binary")):
+                mn = pc.min(col).as_py()
+                mx = pc.max(col).as_py()
+                for d, v in ((min_v, mn), (max_v, mx)):
+                    if hasattr(v, "isoformat"):
+                        v = v.isoformat()
+                    elif type(v).__name__ == "Decimal":
+                        v = float(v)
+                    d[name] = v
+        except Exception:  # noqa: BLE001 — stats are best-effort
+            continue
+    stats["minValues"] = min_v
+    stats["maxValues"] = max_v
+    stats["nullCount"] = null_c
+    return json.dumps(stats)
+
+
+class DeltaTable:
+    def __init__(self, path: str):
+        self.path = path
+        self.log = DeltaLog(path)
+
+    # -- open / read -----------------------------------------------------
+    @staticmethod
+    def exists(path: str) -> bool:
+        return DeltaLog(path).exists()
+
+    def snapshot(self, version: Optional[int] = None,
+                 timestamp_ms: Optional[int] = None) -> Snapshot:
+        return self.log.snapshot(version, timestamp_ms)
+
+    def to_arrow(self, version: Optional[int] = None,
+                 timestamp_ms: Optional[int] = None,
+                 columns: Optional[Sequence[str]] = None):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from ...columnar.arrow_interop import spec_type_to_arrow
+
+        snap = self.snapshot(version, timestamp_ms)
+        schema = snap.schema
+        part_cols = list(snap.metadata.partition_columns)
+        tables = []
+        for add in snap.files.values():
+            fpath = os.path.join(self.path, add.path)
+            want = None
+            if columns is not None:
+                want = [c for c in columns if c not in part_cols]
+            t = pq.read_table(fpath, columns=want)
+            pv = dict(add.partition_values)
+            for c in part_cols:
+                if columns is not None and c not in columns:
+                    continue
+                f = schema.field(c)
+                at = spec_type_to_arrow(f.data_type)
+                raw = pv.get(c)
+                val = None if raw is None else _parse_partition_value(raw, at)
+                t = t.append_column(
+                    c, pa.array([val] * t.num_rows, type=at))
+            tables.append(t)
+        if not tables:
+            fields = [(f.name, spec_type_to_arrow(f.data_type))
+                      for f in schema.fields
+                      if columns is None or f.name in columns]
+            return pa.table({n: pa.array([], type=t) for n, t in fields})
+        out = pa.concat_tables(tables, promote_options="permissive")
+        if columns is not None:
+            out = out.select([c for c in columns if c in out.column_names])
+        return out
+
+    def history(self) -> List[dict]:
+        out = []
+        for v in reversed(self.log.versions()):
+            info = {"version": v}
+            for a in self.log.read_commit(v):
+                if "commitInfo" in a:
+                    info.update(a["commitInfo"])
+            out.append(info)
+        return out
+
+    # -- writes ----------------------------------------------------------
+    def _write_data_files(self, table, partition_by: Sequence[str]
+                          ) -> List[AddFile]:
+        import pyarrow.parquet as pq
+
+        adds: List[AddFile] = []
+        now = int(time.time() * 1000)
+        if not partition_by:
+            name = f"part-{uuid.uuid4().hex}.snappy.parquet"
+            fpath = os.path.join(self.path, name)
+            os.makedirs(self.path, exist_ok=True)
+            pq.write_table(table, fpath)
+            adds.append(AddFile(name, os.path.getsize(fpath), (), now, True,
+                                _stats_for(table)))
+            return adds
+        import pyarrow.compute as pc
+
+        keys = table.select(list(partition_by))
+        combos = keys.group_by(list(partition_by)).aggregate([]).to_pylist()
+        for combo in combos:
+            mask = None
+            for c, v in combo.items():
+                m = pc.is_null(table.column(c)) if v is None else \
+                    pc.equal(table.column(c), v)
+                mask = m if mask is None else pc.and_(mask, m)
+            part = table.filter(mask).drop_columns(list(partition_by))
+            reldir = "/".join(
+                f"{c}={_format_partition_value(combo[c])}"
+                for c in partition_by)
+            os.makedirs(os.path.join(self.path, reldir), exist_ok=True)
+            name = f"{reldir}/part-{uuid.uuid4().hex}.snappy.parquet"
+            fpath = os.path.join(self.path, name)
+            pq.write_table(part, fpath)
+            adds.append(AddFile(
+                name, os.path.getsize(fpath),
+                tuple(sorted((c, _format_partition_value(combo[c]))
+                             for c in partition_by)),
+                now, True, _stats_for(part)))
+        return adds
+
+    def _metadata_for(self, table, partition_by: Sequence[str]) -> Metadata:
+        from ...spec.schema_json import type_to_json
+        from ...columnar.arrow_interop import arrow_type_to_spec
+        from ...spec import data_type as dt
+
+        st = dt.StructType(tuple(
+            dt.StructField(n, arrow_type_to_spec(c.type), True)
+            for n, c in zip(table.column_names, table.columns)))
+        return Metadata(json.dumps(type_to_json(st)), tuple(partition_by))
+
+    def create(self, table, partition_by: Sequence[str] = ()) -> int:
+        tx = Transaction(self.log, None, "CREATE TABLE AS SELECT")
+        tx.set_protocol(Protocol())
+        tx.set_metadata(self._metadata_for(table, partition_by))
+        for add in self._write_data_files(table, partition_by):
+            tx.add_file(add)
+        return tx.commit()
+
+    def append(self, table) -> int:
+        snap = self.snapshot()
+        tx = Transaction(self.log, snap.version, "WRITE")
+        for add in self._write_data_files(
+                table, snap.metadata.partition_columns):
+            tx.add_file(add)
+        return tx.commit()
+
+    def overwrite(self, table) -> int:
+        snap = self.snapshot()
+        tx = Transaction(self.log, snap.version, "WRITE")
+        tx.read_whole_table = True
+        now = int(time.time() * 1000)
+        for path in snap.files:
+            tx.remove_file(RemoveFile(path, now))
+        for add in self._write_data_files(
+                table, snap.metadata.partition_columns):
+            tx.add_file(add)
+        return tx.commit()
+
+    def delete_where(self, mask_fn) -> Tuple[int, int]:
+        """Copy-on-write DELETE: ``mask_fn(table) -> bool mask of rows to
+        KEEP``. Returns (version, deleted_rows)."""
+        import pyarrow.parquet as pq
+
+        snap = self.snapshot()
+        tx = Transaction(self.log, snap.version, "DELETE")
+        now = int(time.time() * 1000)
+        deleted = 0
+        part_cols = list(snap.metadata.partition_columns)
+        for add in list(snap.files.values()):
+            t = pq.read_table(os.path.join(self.path, add.path))
+            full = t
+            if part_cols:
+                import pyarrow as pa
+                from ...columnar.arrow_interop import spec_type_to_arrow
+                pv = dict(add.partition_values)
+                for c in part_cols:
+                    f = snap.schema.field(c)
+                    at = spec_type_to_arrow(f.data_type)
+                    val = _parse_partition_value(pv.get(c), at)
+                    full = full.append_column(
+                        c, pa.array([val] * full.num_rows, type=at))
+            keep = mask_fn(full)
+            kept = full.filter(keep)
+            if kept.num_rows == full.num_rows:
+                continue  # file untouched
+            tx.read_files.add(add.path)
+            tx.remove_file(RemoveFile(add.path, now))
+            deleted += full.num_rows - kept.num_rows
+            if kept.num_rows:
+                for new_add in self._write_data_files(
+                        kept, snap.metadata.partition_columns):
+                    tx.add_file(new_add)
+        if deleted == 0:
+            return snap.version, 0
+        return tx.commit(), deleted
+
+
+def _format_partition_value(v) -> str:
+    if v is None:
+        return "__HIVE_DEFAULT_PARTITION__"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if hasattr(v, "isoformat"):
+        return v.isoformat(sep=" ") if hasattr(v, "hour") else v.isoformat()
+    return str(v)
+
+
+def _parse_partition_value(raw: Optional[str], at):
+    import pyarrow as pa
+
+    if raw is None or raw == "__HIVE_DEFAULT_PARTITION__":
+        return None
+    if pa.types.is_boolean(at):
+        return raw == "true"
+    if pa.types.is_integer(at):
+        return int(raw)
+    if pa.types.is_floating(at):
+        return float(raw)
+    if pa.types.is_date(at):
+        import datetime
+        return datetime.date.fromisoformat(raw)
+    if pa.types.is_timestamp(at):
+        import datetime
+        return datetime.datetime.fromisoformat(raw)
+    if pa.types.is_decimal(at):
+        import decimal
+        return decimal.Decimal(raw)
+    return raw

@@ -1,0 +1,128 @@
+"""Optimistic-concurrency transactions with a conflict checker.
+
+Reference role: crates/sail-delta-lake/src/transaction/ (commit protocol)
+and src/transaction/conflict_checker.rs:321-480 (the winner-vs-loser
+commit compatibility rules). The commit primitive is atomic
+create-if-absent of the next `%020d.json`; on a lost race, the
+transaction replays the winners' actions and decides whether its own
+operation still commutes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from .log import AddFile, DeltaLog, Metadata, Protocol, RemoveFile
+
+
+class CommitConflict(Exception):
+    """The transaction cannot be re-applied on top of the winning commits."""
+
+
+class Transaction:
+    def __init__(self, log: DeltaLog, read_version: Optional[int],
+                 operation: str = "WRITE"):
+        self.log = log
+        self.read_version = read_version
+        self.operation = operation
+        self.actions: List[dict] = []
+        self._adds: List[AddFile] = []
+        self._removes: List[RemoveFile] = []
+        self._metadata: Optional[Metadata] = None
+        self._protocol: Optional[Protocol] = None
+        # what this transaction read, for conflict detection
+        self.read_whole_table = False
+        self.read_files: set = set()
+
+    # -- staging ---------------------------------------------------------
+    def set_protocol(self, protocol: Protocol):
+        self._protocol = protocol
+
+    def set_metadata(self, metadata: Metadata):
+        self._metadata = metadata
+
+    def add_file(self, add: AddFile):
+        self._adds.append(add)
+
+    def remove_file(self, remove: RemoveFile):
+        self._removes.append(remove)
+
+    # -- commit ----------------------------------------------------------
+    def _assemble(self) -> List[dict]:
+        actions: List[dict] = [{"commitInfo": {
+            "timestamp": int(time.time() * 1000),
+            "operation": self.operation,
+            "engineInfo": "sail-tpu",
+        }}]
+        if self._protocol is not None:
+            actions.append(self._protocol.to_json())
+        if self._metadata is not None:
+            actions.append(self._metadata.to_json())
+        actions.extend(r.to_json() for r in self._removes)
+        actions.extend(a.to_json() for a in self._adds)
+        return actions
+
+    def commit(self, max_retries: int = 15) -> int:
+        """Returns the committed version."""
+        attempt_version = (self.read_version + 1
+                           if self.read_version is not None else 0)
+        for _ in range(max_retries):
+            try:
+                self.log.write_commit_atomic(attempt_version,
+                                             self._assemble())
+            except FileExistsError:
+                self._check_conflicts(attempt_version)
+                attempt_version += 1
+                continue
+            self._maybe_checkpoint(attempt_version)
+            return attempt_version
+        raise CommitConflict(
+            f"gave up after {max_retries} commit attempts")
+
+    def _check_conflicts(self, winner_version: int):
+        """Replay the winning commit and decide whether this transaction's
+        operation still applies (reference: conflict_checker.rs rules)."""
+        winner_actions = self.log.read_commit(winner_version)
+        winner_removed = set()
+        winner_added = set()
+        winner_metadata = False
+        winner_protocol = False
+        for a in winner_actions:
+            if "remove" in a:
+                winner_removed.add(a["remove"]["path"])
+            elif "add" in a:
+                winner_added.add(a["add"]["path"])
+            elif "metaData" in a:
+                winner_metadata = True
+            elif "protocol" in a:
+                winner_protocol = True
+        if winner_protocol or (self._protocol is not None):
+            raise CommitConflict("concurrent protocol change")
+        if winner_metadata or (self._metadata is not None
+                               and self.read_version is not None):
+            raise CommitConflict("concurrent metadata change")
+        # files we intend to remove must still exist
+        my_removes = {r.path for r in self._removes}
+        if my_removes & winner_removed:
+            raise CommitConflict(
+                "concurrent delete of the same files "
+                f"({sorted(my_removes & winner_removed)[:3]})")
+        # if we read the whole table (overwrite/delete/merge), any winner
+        # data change invalidates the read
+        if self.read_whole_table and (winner_added or winner_removed):
+            raise CommitConflict(
+                "concurrent update while rewriting the table")
+        # files we read must not have been removed under us
+        if self.read_files & winner_removed:
+            raise CommitConflict("concurrent delete of files read by this "
+                                 "transaction")
+        # blind appends commute — retry at the next version
+
+    def _maybe_checkpoint(self, version: int):
+        from .log import CHECKPOINT_INTERVAL
+        if version > 0 and version % CHECKPOINT_INTERVAL == 0:
+            try:
+                self.log.write_checkpoint(self.log.snapshot(version))
+            except Exception:  # noqa: BLE001 — checkpoint is best-effort
+                pass

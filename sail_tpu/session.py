@@ -10,6 +10,7 @@ same session object.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -172,6 +173,43 @@ class SparkSession:
             return empty
         if isinstance(cmd, sp.InsertInto):
             return self._insert_into(cmd)
+        if isinstance(cmd, sp.WriteDataSource):
+            if cmd.table and not cmd.path:
+                if cmd.format == "delta":
+                    # managed Delta table under the warehouse directory —
+                    # a memory TableEntry would silently lose durability
+                    from .io.formats import write_table
+                    wh = self.conf.get("spark.sql.warehouse.dir") or \
+                        os.path.join(os.getcwd(), "spark-warehouse")
+                    location = os.path.join(wh, *cmd.table)
+                    table = self._execute_query(cmd.query)
+                    write_table(table, "delta", location, cmd.mode,
+                                dict(cmd.options), cmd.partition_by)
+                    entry = TableEntry(cmd.table, _schema_of(table), None,
+                                       (location,), "delta", None,
+                                       cmd.options, cmd.partition_by)
+                    cm.register_table(entry, replace=True,
+                                      if_not_exists=False)
+                    return empty
+                existing = cm.lookup_table(cmd.table)
+                if existing is not None and cmd.mode == "append":
+                    return self._insert_into(sp.InsertInto(cmd.table,
+                                                           cmd.query))
+                if existing is not None and cmd.mode == "ignore":
+                    return empty
+                table = self._execute_query(cmd.query)
+                entry = TableEntry(cmd.table, _schema_of(table), table,
+                                   (), "memory")
+                cm.register_table(entry, replace=(cmd.mode == "overwrite"),
+                                  if_not_exists=False)
+                return empty
+            if cmd.path:
+                from .io.formats import write_table
+                table = self._execute_query(cmd.query)
+                write_table(table, cmd.format, cmd.path, cmd.mode,
+                            dict(cmd.options), cmd.partition_by)
+                return empty
+            raise ValueError("write requires a path or table name")
         if isinstance(cmd, sp.ShowTables):
             entries = cm.list_tables(cmd.database[-1] if cmd.database else None)
             names = [e.name[-1] for e in entries]
@@ -228,6 +266,10 @@ class SparkSession:
         if isinstance(cmd, sp.ResetVariable):
             self.conf.reset(cmd.name)
             return empty
+        if isinstance(cmd, sp.Delete):
+            return self._delta_delete(cmd)
+        if isinstance(cmd, sp.Update):
+            return self._delta_update(cmd)
         if isinstance(cmd, sp.Explain):
             from .plan.nodes import explain
             node = self._resolve(cmd.query)
@@ -249,6 +291,104 @@ class SparkSession:
         if isinstance(cmd, sp.UncacheTable):
             return empty
         raise NotImplementedError(f"command {type(cmd).__name__} not supported yet")
+
+    def _delta_entry(self, table_name):
+        entry = self.catalog_manager.lookup_table(table_name)
+        if entry is None:
+            raise ValueError(f"table not found: {'.'.join(table_name)}")
+        if entry.format != "delta" or not entry.paths:
+            raise NotImplementedError(
+                "DELETE/UPDATE/MERGE are supported on Delta tables "
+                f"(table {'.'.join(table_name)} has format "
+                f"{entry.format!r})")
+        from .lakehouse.delta import DeltaTable
+        return entry, DeltaTable(entry.paths[0])
+
+    def _eval_predicate(self, table: pa.Table, cond: sp.Expr) -> pa.Table:
+        """Evaluate a predicate over an arrow table → bool column."""
+        import sail_tpu.spec.expression as ex
+        plan = sp.Project(sp.LocalRelation(table),
+                          (ex.Alias(cond, ("__pred__",)),))
+        return self._execute_query(plan)
+
+    def _delta_delete(self, cmd: sp.Delete) -> pa.Table:
+        import numpy as np
+        entry, dt_table = self._delta_entry(cmd.table)
+        if cmd.condition is None:
+            version, deleted = dt_table.delete_where(
+                lambda tb: pa.array([False] * tb.num_rows))
+        else:
+            def keep_mask(tb):
+                pred = self._eval_predicate(tb, cmd.condition).column(0)
+                hit = np.asarray(pred.fill_null(False).to_pylist(),
+                                 dtype=bool) if tb.num_rows else \
+                    np.zeros(0, dtype=bool)
+                return pa.array(~hit)
+            version, deleted = dt_table.delete_where(keep_mask)
+        return pa.table({"num_affected_rows":
+                         pa.array([deleted], type=pa.int64())})
+
+    def _delta_update(self, cmd: sp.Update) -> pa.Table:
+        import pyarrow.parquet as pq
+        import sail_tpu.spec.expression as ex
+        from .lakehouse.delta.log import RemoveFile
+        from .lakehouse.delta.transaction import Transaction
+        import time as _t
+
+        entry, dt_table = self._delta_entry(cmd.table)
+        snap = dt_table.snapshot()
+        schema = snap.schema
+        assigns = {path[-1].lower(): expr
+                   for path, expr in cmd.assignments}
+        cond = cmd.condition
+        tx = Transaction(dt_table.log, snap.version, "UPDATE")
+        now = int(_t.time() * 1000)
+        updated = 0
+        part_cols = list(snap.metadata.partition_columns)
+        for add in list(snap.files.values()):
+            t = pq.read_table(os.path.join(dt_table.path, add.path))
+            if part_cols:
+                from .lakehouse.delta.table import _parse_partition_value
+                from .columnar.arrow_interop import spec_type_to_arrow
+                pv = dict(add.partition_values)
+                for c in part_cols:
+                    f = schema.field(c)
+                    at = spec_type_to_arrow(f.data_type)
+                    val = _parse_partition_value(pv.get(c), at)
+                    t = t.append_column(c, pa.array([val] * t.num_rows,
+                                                    type=at))
+            if cond is not None:
+                pred = self._eval_predicate(t, cond).column(0)
+                nhit = pred.fill_null(False).to_pandas().sum()
+                if not nhit:
+                    continue
+            # rewrite the file with CASE WHEN cond THEN expr ELSE col END
+            exprs = []
+            for f in schema.fields:
+                col = ex.Attribute((f.name,))
+                if f.name.lower() in assigns:
+                    new = assigns[f.name.lower()]
+                    val = new if cond is None else \
+                        ex.CaseWhen(((cond, new),), col)
+                    exprs.append(ex.Alias(ex.Cast(val, f.data_type),
+                                          (f.name,)))
+                else:
+                    exprs.append(ex.Alias(col, (f.name,)))
+            rewritten = self._execute_query(
+                sp.Project(sp.LocalRelation(t), tuple(exprs)))
+            tx.read_files.add(add.path)
+            tx.remove_file(RemoveFile(add.path, now))
+            for new_add in dt_table._write_data_files(
+                    rewritten, snap.metadata.partition_columns):
+                tx.add_file(new_add)
+            if cond is not None:
+                updated += int(nhit)
+            else:
+                updated += t.num_rows
+        if updated:
+            tx.commit()
+        return pa.table({"num_affected_rows":
+                         pa.array([updated], type=pa.int64())})
 
     def _file_table_entry(self, cmd: sp.CreateTable) -> TableEntry:
         from .io.formats import infer_schema
