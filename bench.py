@@ -102,8 +102,12 @@ def _probe_backend(timeout_s: float, attempts: int = 3) -> bool:
                   f"(rc={r.returncode}): " + " | ".join(tail),
                   file=sys.stderr)
         except subprocess.TimeoutExpired:
+            # a hung tunnel won't recover within this run, and killing more
+            # probe subprocesses can wedge the relay further — stop probing
             print(f"bench: TPU probe attempt {attempt}/{attempts} timed out "
-                  f"after {timeout_s:.0f}s (tunnel hung)", file=sys.stderr)
+                  f"after {timeout_s:.0f}s (tunnel hung; not retrying)",
+                  file=sys.stderr)
+            break
     print("bench: all TPU probes failed — falling back to CPU "
           "(platform field will say so)", file=sys.stderr)
     return False

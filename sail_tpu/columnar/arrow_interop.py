@@ -104,6 +104,8 @@ def spec_type_to_arrow(d: dt.DataType) -> pa.DataType:
         return pa.timestamp("us", tz=d.timezone)
     if isinstance(d, dt.DayTimeIntervalType):
         return pa.duration("us")
+    if isinstance(d, dt.YearMonthIntervalType):
+        return pa.int32()  # total months (no arrow ym-interval roundtrip)
     if isinstance(d, dt.NullType):
         return pa.null()
     if isinstance(d, dt.ArrayType):
@@ -256,9 +258,11 @@ def _column_to_arrow(data, validity, d, dictionary, has_dict) -> pa.Array:
         arr = pa.DictionaryArray.from_arrays(codes, dictionary).cast(
             pa.string() if isinstance(d, dt.StringType) else pa.binary())
     elif isinstance(d, (dt.ArrayType, dt.StructType, dt.MapType)) and has_dict:
-        codes = pa.array(data.astype(np.int32),
+        # nested dictionaries can't cast; take() materializes (null index →
+        # null value)
+        codes = pa.array(data.astype(np.int64),
                          mask=None if validity is None else ~validity)
-        arr = pa.DictionaryArray.from_arrays(codes, dictionary).cast(dictionary.type)
+        arr = dictionary.take(codes)
     elif isinstance(d, dt.DecimalType) and d.physical_dtype == "int64":
         arr = _unscaled_int64_to_decimal(data, validity, d)
     elif isinstance(d, dt.DecimalType):

@@ -163,6 +163,8 @@ def round_capacity(n: int, minimum: int = 8) -> int:
 
 
 def physical_jnp_dtype(d: dt.DataType):
+    if isinstance(d, (dt.ArrayType, dt.MapType, dt.StructType)):
+        return jnp.dtype("int32")  # dictionary code handle (values on host)
     name = d.physical_dtype
     if name is None:
         raise TypeError(f"type {d.simple_string()} has no device representation")

@@ -1,0 +1,571 @@
+"""Host expression interpreter.
+
+Reference role: the execution side of sail-function's wide scalar tail —
+everything the device compiler declines (HostFallback) evaluates here over
+python values. Device-compilable subtrees still run on device and download
+once; only the host-only parts interpret row-wise. Results re-encode as
+device columns (numerics) or dictionary-encoded host columns
+(strings/arrays/maps/structs), so the surrounding jit pipeline is
+undisturbed.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..columnar import arrow_interop as ai
+from ..functions.registry import host_fn
+from ..plan import rex as rx
+from ..plan.compiler import ExprCompiler, HostFallback
+from ..spec import data_type as dt
+
+_UTC = datetime.timezone.utc
+
+
+class HostEvalError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# basic python semantics for core operators (used when a host-only subtree
+# pulls an otherwise-device expression onto the host)
+# ---------------------------------------------------------------------------
+
+def _py_div(a, b):
+    if b == 0:
+        return None
+    if isinstance(a, int) and isinstance(b, int):
+        return a / b
+    return a / b
+
+
+def _py_eq(a, b):
+    return a == b
+
+
+_PY_BASIC = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": _py_div,
+    "%": lambda a, b: None if b == 0 else a - b * int(a / b) if (
+        isinstance(a, int) and isinstance(b, int)) else (
+        None if b == 0 else float(np.fmod(a, b))),
+    "div": lambda a, b: None if b == 0 else int(a / b),
+    "pmod": lambda a, b: None if b == 0 else a % b if (a % b) * b >= 0
+    else (a % b),
+    "==": _py_eq,
+    "=": _py_eq,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "abs": lambda a: abs(a),
+    "negative": lambda a: -a,
+    "concat": lambda *xs: _concat(*xs),
+    "upper": lambda s: s.upper(),
+    "ucase": lambda s: s.upper(),
+    "lower": lambda s: s.lower(),
+    "lcase": lambda s: s.lower(),
+    "length": lambda s: len(s),
+    "trim": lambda s: s.strip(),
+    "substring": lambda s, p, *l: _substring(s, int(p),
+                                             int(l[0]) if l else None),
+    "substr": lambda s, p, *l: _substring(s, int(p),
+                                          int(l[0]) if l else None),
+    "reverse": lambda s: s[::-1] if not isinstance(s, list) else s[::-1],
+    "greatest": lambda *xs: None if any(x is None for x in xs) else max(xs),
+    "least": lambda *xs: None if any(x is None for x in xs) else min(xs),
+    "power": lambda a, b: float(a) ** float(b),
+    "sqrt": lambda a: float(a) ** 0.5 if a >= 0 else float("nan"),
+    "floor": lambda a: _py_floor(a),
+    "ceil": lambda a: _py_ceil(a),
+    "ceiling": lambda a: _py_ceil(a),
+    "round": lambda a, *d: _py_round(a, int(d[0]) if d else 0),
+}
+
+
+def _concat(*xs):
+    if all(isinstance(x, (list, type(None))) for x in xs) and any(
+            isinstance(x, list) for x in xs):
+        out = []
+        for x in xs:
+            if x is None:
+                return None
+            out.extend(x)
+        return out
+    return "".join(str(x) for x in xs)
+
+
+def _substring(s, pos, length):
+    n = len(s)
+    if pos > 0:
+        i = pos - 1
+    elif pos < 0:
+        i = max(n + pos, 0)
+    else:
+        i = 0
+    if length is None:
+        return s[i:]
+    return s[i:i + max(length, 0)]
+
+
+def _py_floor(a):
+    import math
+    if isinstance(a, decimal.Decimal):
+        return int(a.to_integral_value(rounding=decimal.ROUND_FLOOR))
+    return math.floor(a)
+
+
+def _py_ceil(a):
+    import math
+    if isinstance(a, decimal.Decimal):
+        return int(a.to_integral_value(rounding=decimal.ROUND_CEILING))
+    return math.ceil(a)
+
+
+def _py_round(a, d):
+    if isinstance(a, decimal.Decimal):
+        q = decimal.Decimal(1).scaleb(-d)
+        return a.quantize(q, rounding=decimal.ROUND_HALF_UP)
+    import math
+    f = 10 ** d
+    return math.floor(abs(a) * f + 0.5) / f * (1 if a >= 0 else -1)
+
+
+# null-tolerant basics
+_PY_NULL_TOLERANT = {
+    "and": None, "or": None, "not": None, "isnull": None, "isnotnull": None,
+    "coalesce": None, "if": None, "nvl": None, "ifnull": None, "nvl2": None,
+    "nullif": None, "in": None, "<=>": None, "isnan": None, "typeof": None,
+    "concat_ws": None, "equal_null": None,
+}
+
+
+class HostInterpreter:
+    """Evaluates a rex tree for every row of a batch on the host."""
+
+    def __init__(self, executor, comp: ExprCompiler, child):
+        self.ex = executor
+        self.comp = comp
+        self.child = child
+        self.cap = child.device.capacity
+        self._col_cache: Dict[int, List] = {}
+
+    # -- columnar evaluation -------------------------------------------
+    def values(self, e: rx.Rex) -> List:
+        """Python values (len == capacity) for expression ``e``."""
+        try:
+            c = self.comp.compile(e)
+        except HostFallback:
+            return self._values_host(e)
+        data, validity = self.ex._eval(c, self.child)
+        arr = ai.column_values_to_arrow(
+            np.asarray(data),
+            None if validity is None else np.asarray(validity),
+            c.dtype, c.dictionary)
+        vals = arr.to_pylist()
+        if len(vals) != self.cap:
+            # constant expressions over zero-column batches produce one row
+            vals = (vals * self.cap)[:self.cap] if len(vals) == 1 else \
+                vals + [None] * (self.cap - len(vals))
+        return vals
+
+    def _values_host(self, e: rx.Rex) -> List:
+        if isinstance(e, rx.RLit):
+            return [e.value.value] * self.cap
+        if isinstance(e, rx.RCast):
+            src = self.values(e.child)
+            st, tt = rx.rex_type(e.child), e.dtype
+            return [py_cast(v, st, tt, e.try_) for v in src]
+        if isinstance(e, rx.RCase):
+            conds = [self.values(c) for c, _ in e.branches]
+            vals = [self.values(v) for _, v in e.branches]
+            other = self.values(e.else_value) \
+                if e.else_value is not None else [None] * self.cap
+            out = []
+            for i in range(self.cap):
+                for cv, vv in zip(conds, vals):
+                    if cv[i] is True:
+                        out.append(vv[i])
+                        break
+                else:
+                    out.append(other[i])
+            return out
+        if isinstance(e, rx.RCall):
+            return self._call(e)
+        raise HostEvalError(
+            f"no host evaluation for {type(e).__name__}")
+
+    def _call(self, e: rx.RCall) -> List:
+        name = e.fn.lower()
+        if name == "__pyudf":
+            raise HostFallback("pyudf handled by the projection host path")
+        # session-constant functions
+        const = _session_constant(name)
+        if const is not _NO_CONST:
+            return [const] * self.cap
+        if name == "typeof":
+            return [rx.rex_type(e.args[0]).simple_string()] * self.cap
+        if name == "uuid":
+            import uuid as _uuid
+            return [str(_uuid.uuid4()) for _ in range(self.cap)]
+        # arguments: lambdas become closures (per-row when the body
+        # references outer columns)
+        argv = []
+        lambda_mask = []
+        for a in e.args:
+            if isinstance(a, rx.RLambda):
+                outer_refs = rx.references(a.body)
+                if outer_refs:
+                    outer_vals = {i: self.values(rx.BoundRef(
+                        i, f"c{i}", self.comp.column_types[i])) for i in outer_refs}
+                    argv.append([self._closure(a, {("__col__", i): v[r]
+                                                   for i, v in
+                                                   outer_vals.items()})
+                                 for r in range(self.cap)])
+                else:
+                    argv.append([self._closure(a)] * self.cap)
+                lambda_mask.append(True)
+            else:
+                argv.append(self.values(a))
+                lambda_mask.append(False)
+        hf = host_fn(name)
+        if hf is not None and hf.impl is not None:
+            from ..functions.host_functions import NULL_TOLERANT
+            tolerant = name in NULL_TOLERANT
+            return self._map_rows(hf.impl, argv, lambda_mask, tolerant)
+        impl = _PY_BASIC.get(name)
+        if impl is not None:
+            return self._map_rows(impl, argv, lambda_mask, False)
+        return self._basic_null_tolerant(name, e, argv)
+
+    def _map_rows(self, impl, argv, lambda_mask, tolerant) -> List:
+        out = []
+        for i in range(self.cap):
+            row = [col[i] for col in argv]
+            if not tolerant and any(
+                    v is None for v, is_l in zip(row, lambda_mask)
+                    if not is_l):
+                out.append(None)
+                continue
+            out.append(impl(*row))
+        return out
+
+    def _basic_null_tolerant(self, name: str, e: rx.RCall, argv) -> List:
+        out = []
+        for i in range(self.cap):
+            row = [col[i] for col in argv]
+            out.append(_scalar_basic(name, row, e))
+        return out
+
+    # -- lambdas --------------------------------------------------------
+    def _closure(self, lam: rx.RLambda, outer_env: Optional[Dict] = None):
+        base = outer_env or {}
+
+        def f(*vals):
+            env = {**base, **dict(zip(lam.params, vals))}
+            return _scalar_eval(lam.body, env)
+        f.nargs = len(lam.params)
+        return f
+
+
+_NO_CONST = object()
+
+
+def _session_constant(name: str):
+    now = datetime.datetime.now(_UTC)
+    if name in ("current_date", "curdate"):
+        return now.date()
+    if name in ("current_timestamp", "now"):
+        return now
+    if name == "localtimestamp":
+        from ..utils.tz import session_zone
+        return now.astimezone(session_zone()).replace(tzinfo=None)
+    if name == "current_timezone":
+        from ..utils.tz import session_timezone_name
+        return session_timezone_name()
+    if name in ("current_user", "user", "session_user"):
+        return "sail"
+    if name in ("current_catalog",):
+        return "spark_catalog"
+    if name in ("current_database", "current_schema"):
+        return "default"
+    if name == "version":
+        return "4.0.0"
+    return _NO_CONST
+
+
+def _scalar_basic(name: str, row, e: rx.RCall):
+    if name == "and":
+        a, b = row
+        if a is False or b is False:
+            return False
+        if a is None or b is None:
+            return None
+        return True
+    if name == "or":
+        a, b = row
+        if a is True or b is True:
+            return True
+        if a is None or b is None:
+            return None
+        return False
+    if name == "not":
+        return None if row[0] is None else not row[0]
+    if name == "isnull":
+        return row[0] is None
+    if name == "isnotnull":
+        return row[0] is not None
+    if name == "isnan":
+        import math
+        return isinstance(row[0], float) and math.isnan(row[0])
+    if name in ("coalesce",):
+        for v in row:
+            if v is not None:
+                return v
+        return None
+    if name in ("nvl", "ifnull"):
+        return row[0] if row[0] is not None else row[1]
+    if name == "nvl2":
+        return row[1] if row[0] is not None else row[2]
+    if name == "nullif":
+        return None if row[0] == row[1] else row[0]
+    if name == "if":
+        return row[1] if row[0] is True else row[2]
+    if name == "<=>" or name == "equal_null":
+        return row[0] == row[1] if (row[0] is not None and
+                                    row[1] is not None) else \
+            (row[0] is None and row[1] is None)
+    if name == "in":
+        probe, *vals = row
+        if probe is None:
+            return None
+        if probe in vals:
+            return True
+        return None if None in vals else False
+    if name == "concat_ws":
+        sep, *vals = row
+        if sep is None:
+            return None
+        flat = []
+        for v in vals:
+            if v is None:
+                continue
+            if isinstance(v, list):
+                flat.extend(str(x) for x in v if x is not None)
+            else:
+                flat.append(str(v))
+        return sep.join(flat)
+    raise HostEvalError(f"no host implementation for function {name!r}")
+
+
+def _scalar_eval(e: rx.Rex, env: Dict[str, object]):
+    """Per-row evaluation inside lambda bodies."""
+    if isinstance(e, rx.RLambdaVar):
+        return env[e.name]
+    if isinstance(e, rx.BoundRef):
+        key = ("__col__", e.index)
+        if key in env:
+            return env[key]
+        raise HostEvalError(
+            f"outer column {e.name!r} not bound in lambda scope")
+    if isinstance(e, rx.RLit):
+        return e.value.value
+    if isinstance(e, rx.RCast):
+        return py_cast(_scalar_eval(e.child, env), rx.rex_type(e.child),
+                       e.dtype, e.try_)
+    if isinstance(e, rx.RCase):
+        for c, v in e.branches:
+            if _scalar_eval(c, env) is True:
+                return _scalar_eval(v, env)
+        return _scalar_eval(e.else_value, env) \
+            if e.else_value is not None else None
+    if isinstance(e, rx.RCall):
+        name = e.fn.lower()
+        args = []
+        for a in e.args:
+            if isinstance(a, rx.RLambda):
+                def cl(*vals, _l=a, _env=env):
+                    return _scalar_eval(
+                        _l.body, {**_env, **dict(zip(_l.params, vals))})
+                cl.nargs = len(a.params)
+                args.append(cl)
+            else:
+                args.append(_scalar_eval(a, env))
+        hf = host_fn(name)
+        from ..functions.host_functions import NULL_TOLERANT
+        if hf is not None and hf.impl is not None:
+            if name not in NULL_TOLERANT and any(
+                    v is None for v, arg in zip(args, e.args)
+                    if not isinstance(arg, rx.RLambda)):
+                return None
+            return hf.impl(*args)
+        impl = _PY_BASIC.get(name)
+        if impl is not None:
+            if any(v is None for v, arg in zip(args, e.args)
+                   if not isinstance(arg, rx.RLambda)):
+                return None
+            return impl(*args)
+        return _scalar_basic(name, args, e)
+    raise HostEvalError(f"no scalar evaluation for {type(e).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# casts & encoding
+# ---------------------------------------------------------------------------
+
+def py_cast(v, src: dt.DataType, target: dt.DataType, try_: bool = False):
+    if v is None:
+        return None
+    try:
+        if isinstance(target, dt.StringType):
+            return _cast_str(v)
+        if isinstance(target, dt.BooleanType):
+            if isinstance(v, str):
+                s = v.strip().lower()
+                if s in ("true", "t", "yes", "y", "1"):
+                    return True
+                if s in ("false", "f", "no", "n", "0"):
+                    return False
+                return None
+            return bool(v)
+        if target.is_integer:
+            if isinstance(v, str):
+                v = float(v.strip()) if "." in v or "e" in v.lower() \
+                    else int(v.strip())
+            return int(v)
+        if isinstance(target, (dt.FloatType, dt.DoubleType)):
+            return float(v)
+        if isinstance(target, dt.DecimalType):
+            d = decimal.Decimal(str(v))
+            q = decimal.Decimal(1).scaleb(-target.scale)
+            return d.quantize(q, rounding=decimal.ROUND_HALF_UP)
+        if isinstance(target, dt.DateType):
+            from ..functions.host_datetime import _to_date
+            return _to_date(v)
+        if isinstance(target, dt.TimestampType):
+            from ..functions.host_datetime import _to_ts
+            out = _to_ts(v)
+            if out is not None and target.timezone is None:
+                out = out.replace(tzinfo=None)
+            return out
+        if isinstance(target, dt.BinaryType):
+            return v if isinstance(v, bytes) else str(v).encode()
+        if isinstance(target, (dt.ArrayType, dt.MapType, dt.StructType)):
+            return v
+    except (ValueError, TypeError, decimal.InvalidOperation,
+            OverflowError):
+        # non-ANSI null-on-error semantics: CAST and TRY_CAST both yield
+        # NULL here (ANSI mode would make plain CAST raise)
+        return None
+    return v
+
+
+def _cast_str(v):
+    from ..utils.format import format_double
+
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        return format_double(v)
+    if isinstance(v, decimal.Decimal):
+        return format(v, "f")
+    if isinstance(v, datetime.datetime):
+        if v.tzinfo is not None:
+            from ..utils.tz import session_zone
+            v = v.astimezone(session_zone())
+        s = v.strftime("%Y-%m-%d %H:%M:%S")
+        if v.microsecond:
+            s += f".{v.microsecond:06d}".rstrip("0")
+        return s
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    return str(v)
+
+
+def encode_host_column(values: Sequence, t: dt.DataType, cap: int):
+    """Python values → (jnp data, validity, dictionary|None)."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+
+    assert len(values) == cap, (len(values), cap)
+    if isinstance(t, (dt.StringType, dt.BinaryType, dt.ArrayType,
+                      dt.MapType, dt.StructType, dt.NullType)):
+        at = None if isinstance(t, dt.NullType) else ai.spec_type_to_arrow(t)
+        try:
+            arr = pa.array([_pyarrowable(v, t) for v in values], type=at)
+        except (pa.ArrowInvalid, pa.ArrowTypeError, OverflowError):
+            arr = pa.array([None if v is None else str(v) for v in values],
+                           type=pa.string())
+        import pyarrow.compute as pc
+        if pa.types.is_nested(arr.type):
+            # dictionary_encode has no nested kernels: use positional codes
+            # (a dictionary need not be distinct-valued)
+            codes = np.arange(cap, dtype=np.int32)
+            validity = jnp.asarray(np.asarray(pc.is_valid(arr)))
+            return jnp.asarray(codes), validity, arr
+        enc = arr.dictionary_encode()
+        codes = np.asarray(enc.indices.fill_null(0)).astype(np.int32)
+        validity = jnp.asarray(np.asarray(pc.is_valid(arr)))
+        return jnp.asarray(codes), validity, enc.dictionary
+    # physical numeric/temporal encoding
+    from ..columnar.batch import physical_jnp_dtype
+    jdt = physical_jnp_dtype(t)
+    data = np.zeros(cap, dtype=jdt)
+    mask = np.zeros(cap, dtype=bool)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        mask[i] = True
+        data[i] = _physical(v, t)
+    validity = jnp.asarray(mask) if not all(mask) else None
+    return jnp.asarray(data), validity, None
+
+
+def _physical(v, t: dt.DataType):
+    if isinstance(t, dt.DateType):
+        if isinstance(v, datetime.datetime):
+            v = v.date()
+        return (v - datetime.date(1970, 1, 1)).days
+    if isinstance(t, dt.TimestampType):
+        if isinstance(v, datetime.date) and not isinstance(
+                v, datetime.datetime):
+            v = datetime.datetime(v.year, v.month, v.day)
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=_UTC)
+        return int(v.timestamp() * 1_000_000)
+    if isinstance(t, dt.DecimalType) and t.physical_dtype == "int64":
+        return int(decimal.Decimal(str(v)).scaleb(t.scale)
+                   .to_integral_value(rounding=decimal.ROUND_HALF_UP))
+    if isinstance(t, dt.DayTimeIntervalType):
+        if isinstance(v, datetime.timedelta):
+            return int(v.total_seconds() * 1e6)
+        return int(v)
+    if isinstance(t, dt.BooleanType):
+        return bool(v)
+    return v
+
+
+def _pyarrowable(v, t: dt.DataType):
+    if v is None:
+        return None
+    if isinstance(t, dt.MapType) and isinstance(v, dict):
+        return list(v.items())
+    if isinstance(t, dt.ArrayType) and isinstance(v, (list, tuple)):
+        return [_pyarrowable(x, t.element_type) for x in v]
+    if isinstance(t, dt.StructType) and isinstance(v, dict):
+        if all(f.name in v for f in t.fields):
+            return {f.name: _pyarrowable(v[f.name], f.data_type)
+                    for f in t.fields}
+        # positional mapping (impl used generic keys)
+        vals = list(v.values())
+        return {f.name: _pyarrowable(vals[i] if i < len(vals) else None,
+                                     f.data_type)
+                for i, f in enumerate(t.fields)}
+    return v

@@ -39,6 +39,73 @@ class ExecutionError(RuntimeError):
     pass
 
 
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple((k, _hashable(x)) for k, x in v.items())
+    return v
+
+
+def _host_agg_one(spec, cols, rows_idx, host_aggs):
+    """One aggregate over one group's row indices (host path)."""
+    fn = spec.fn
+    vals = None if spec.arg is None else [cols[spec.arg][i]
+                                          for i in rows_idx]
+    if fn.startswith("__host__"):
+        name = fn[len("__host__"):]
+        ha = host_aggs[name]
+        assert vals is not None
+        if vals and isinstance(vals[0], dict):
+            tuples = [tuple(v.values()) if v is not None else None
+                      for v in vals]
+            # per-function null eligibility: max_by/min_by drop rows with a
+            # null ORDERING key (the value may be null); value-first
+            # aggregates drop rows with a null value; statistical pairs
+            # drop rows with any null
+            if name in ("max_by", "min_by"):
+                rows = [t for t in tuples
+                        if t is not None and t[1] is not None]
+            elif name in ("listagg", "string_agg", "percentile",
+                          "percentile_approx", "approx_percentile",
+                          "percentile_cont", "percentile_disc",
+                          "histogram_numeric"):
+                rows = [t for t in tuples
+                        if t is not None and t[0] is not None]
+            else:
+                rows = [t for t in tuples
+                        if t is not None and all(x is not None for x in t)]
+        else:
+            rows = [v for v in vals if v is not None]
+        if spec.distinct:
+            seen = []
+            rows = [r for r in rows
+                    if not (r in seen or seen.append(r))]
+        return ha.impl(rows)
+    nn = None if vals is None else [v for v in vals if v is not None]
+    if fn == "count":
+        return len(rows_idx) if vals is None else len(nn)
+    if fn == "sum":
+        if spec.distinct and nn:
+            nn = list(dict.fromkeys(nn))
+        return sum(nn) if nn else None
+    if fn == "min":
+        return min(nn) if nn else None
+    if fn == "max":
+        return max(nn) if nn else None
+    if fn == "first":
+        pool = nn if spec.ignore_nulls else vals
+        return pool[0] if pool else None
+    if fn == "last":
+        pool = nn if spec.ignore_nulls else vals
+        return pool[-1] if pool else None
+    if fn == "bool_and":
+        return all(nn) if nn else None
+    if fn == "bool_or":
+        return any(nn) if nn else None
+    raise ExecutionError(f"aggregate {fn!r} has no host path")
+
+
 def _fit_capacity(data, validity, cap: int):
     """Broadcast constant (scalar / 1-element) expression results to the
     batch capacity, so literal projections over OneRow line up with the
@@ -347,6 +414,8 @@ class LocalExecutor:
             try:
                 c = comp.compile(e)
                 data, validity = self._eval(c, child)
+                data, validity = _fit_capacity(data, validity,
+                                               dev.sel.shape[0])
                 if c.dictionary is not None:
                     out_dicts[keyn] = c.dictionary
             except HostFallback:
@@ -354,9 +423,11 @@ class LocalExecutor:
                 if dictionary is not None:
                     out_dicts[keyn] = dictionary
             odt = rx.rex_type(e)
-            jdt = physical_jnp_dtype(odt)
-            if data.dtype != jnp.dtype(jdt):
-                data = data.astype(jdt)
+            if not isinstance(odt, (dt.ArrayType, dt.MapType,
+                                    dt.StructType, dt.NullType)):
+                jdt = physical_jnp_dtype(odt)
+                if data.dtype != jnp.dtype(jdt):
+                    data = data.astype(jdt)
             out_cols[keyn] = Column(data, validity, odt)
         return HostBatch(DeviceBatch(out_cols, dev.sel), out_dicts)
 
@@ -364,12 +435,20 @@ class LocalExecutor:
         """Host evaluation of a __pyudf call (incl. string returns): args
         evaluate on device, rows run through the Python function, string
         results dictionary-encode."""
-        if isinstance(e, rx.RCast) and isinstance(e.dtype, dt.StringType):
-            return self._host_cast_to_string(e, comp, child)
+        if isinstance(e, rx.RCast) and isinstance(e.dtype, dt.StringType) \
+                and not isinstance(rx.rex_type(e.child),
+                                   (dt.ArrayType, dt.MapType, dt.StructType)):
+            try:
+                return self._host_cast_to_string(e, comp, child)
+            except HostFallback:
+                pass
         if not (isinstance(e, rx.RCall) and e.fn == "__pyudf"):
-            raise ExecutionError(
-                f"expression requires host evaluation but no host path exists: "
-                f"{pn._rex_str(e)}")
+            # general host interpreter (arrays/maps/structs/json/lambdas/…)
+            from .host_interp import HostInterpreter, encode_host_column
+            interp = HostInterpreter(self, comp, child)
+            values = interp.values(e)
+            return encode_host_column(values, rx.rex_type(e),
+                                      child.device.capacity)
         from ..plan.compiler import (udf_arg_decoder, udf_decode_column,
                                      udf_encode_numeric, udf_invoke)
         u = dict(e.options)["udf"]
@@ -420,6 +499,9 @@ class LocalExecutor:
             if isinstance(v, float):
                 return repr(v)
             if isinstance(v, _dtm.datetime):
+                if v.tzinfo is not None:
+                    from ..utils.tz import session_zone
+                    v = v.astimezone(session_zone())
                 s = v.strftime("%Y-%m-%d %H:%M:%S")
                 if v.microsecond:
                     s += f".{v.microsecond:06d}".rstrip("0")
@@ -456,7 +538,15 @@ class LocalExecutor:
 
         key = self._op_key("filter", p.condition,
                            tuple((f.name, f.dtype) for f in p.input.schema))
-        fn, _ = self._jitted(key, self._dict_objs(child), builder)
+        try:
+            fn, _ = self._jitted(key, self._dict_objs(child), builder)
+        except HostFallback:
+            # host-only predicate (arrays/json/…): interpret row-wise
+            from .host_interp import HostInterpreter
+            comp = self._compiler(child, p.input.schema)
+            vals = HostInterpreter(self, comp, child).values(p.condition)
+            keep = jnp.asarray(np.array([v is True for v in vals]))
+            return HostBatch(dev.with_sel(dev.sel & keep), child.dicts)
         return HostBatch(dev.with_sel(fn(self._cols(child), dev.sel)),
                          child.dicts)
 
@@ -587,6 +677,8 @@ class LocalExecutor:
         # compiles to a single XLA executable). Under EXPLAIN ANALYZE run
         # unfused so every operator reports its own rows/time.
         from .. import telemetry as tel
+        if any(a.fn.startswith("__host__") for a in p.aggs):
+            return self._host_aggregate(p, self.run(p.input))
         if tel.current_collector() is not None:
             chain, child, bottom_node = [], self.run(p.input), p.input
         else:
@@ -694,6 +786,58 @@ class LocalExecutor:
         out = DeviceBatch(out_cols, gsel)
         out = _shrink(out, int(n_groups))
         return HostBatch(out, out_dicts)
+
+    def _host_aggregate(self, p: pn.AggregateExec, child: HostBatch
+                        ) -> HostBatch:
+        """Python grouping path for the statistical/collection aggregate
+        tail (reference role: sail-function aggregates). The group slices
+        reaching here are already small; the hot sum/count/min/max path
+        stays on the device segment kernels."""
+        from ..functions.host_aggregates import HOST_AGGS
+
+        table = ai.to_arrow(child)
+        cols = {i: table.column(i).to_pylist()
+                for i in range(table.num_columns)}
+        n = table.num_rows
+        if p.group_indices:
+            groups: Dict[tuple, list] = {}
+            for r in range(n):
+                key = tuple(_hashable(cols[g][r]) for g in p.group_indices)
+                groups.setdefault(key, []).append(r)
+            items = list(groups.items())
+        else:
+            items = [((), list(range(n)))]
+        key_out: List[list] = [[] for _ in p.group_indices]
+        agg_out: List[list] = [[] for _ in p.aggs]
+        for key, rows_idx in items:
+            for ki, g in enumerate(p.group_indices):
+                key_out[ki].append(cols[g][rows_idx[0]])
+            for ai_, spec in enumerate(p.aggs):
+                agg_out[ai_].append(
+                    _host_agg_one(spec, cols, rows_idx, HOST_AGGS))
+        import pyarrow as pa
+        arrays = []
+        names = []
+        in_schema = p.input.schema
+        for ki, g in enumerate(p.group_indices):
+            at = ai.spec_type_to_arrow(in_schema[g].dtype)
+            arrays.append(pa.array(key_out[ki], type=at))
+            names.append(p.out_names[ki])
+        for ai_, spec in enumerate(p.aggs):
+            at = ai.spec_type_to_arrow(spec.out_dtype)
+            try:
+                arrays.append(pa.array(agg_out[ai_], type=at))
+            except (pa.ArrowInvalid, pa.ArrowTypeError):
+                # coerce through the declared type rather than silently
+                # changing the column type the plan schema promised
+                from .host_interp import py_cast
+                coerced = [None if v is None else
+                           py_cast(v, dt.NullType(), spec.out_dtype)
+                           for v in agg_out[ai_]]
+                arrays.append(pa.array(coerced, type=at))
+            names.append(p.out_names[len(p.group_indices) + ai_])
+        out = pa.Table.from_arrays(arrays, names=names)
+        return _positional(ai.from_arrow(out))
 
     def _run_agg(self, ctx, a: pn.AggSpec, arg: Optional[Column]) -> Column:
         if a.fn == "count":
@@ -1187,7 +1331,22 @@ class LocalExecutor:
             key = _col_name(i)
             f = p.schema[i]
             str_col = any(key in b.dicts for b in parts)
-            if str_col:
+            if str_col and isinstance(f.dtype, (dt.ArrayType, dt.MapType,
+                                                dt.StructType)):
+                # complex dictionaries: concatenate with offset remapping
+                import pyarrow as pa
+                offset = 0
+                datas = []
+                chunks = []
+                for b in parts:
+                    d_b = b.dicts[key]
+                    chunks.append(d_b)
+                    datas.append(b.device.columns[key].data + offset)
+                    offset += len(d_b)
+                dicts[key] = pa.concat_arrays(
+                    [c.combine_chunks() if isinstance(c, pa.ChunkedArray)
+                     else c for c in chunks])
+            elif str_col:
                 from ..plan.compiler import _merge_dicts
                 merged, remaps = _merge_dicts([b.dicts[key] for b in parts])
                 datas = [jnp.asarray(rm)[b.device.columns[key].data]

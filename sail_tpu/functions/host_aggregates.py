@@ -1,0 +1,252 @@
+"""Host-evaluated aggregate functions (the statistical / collection tail).
+
+Reference role: crates/sail-function/src/aggregate/ (regr_*, percentile,
+mode, max_by/min_by, collect_*, listagg, bit aggregates, …). These download
+the (tiny, already-reduced) group slices to the host; the hot sum/count/
+min/max path stays on device segment kernels.
+
+Each impl receives the list of per-row argument values for ONE group
+(multi-argument aggregates receive tuples) and returns a python value.
+Nulls are pre-filtered per Spark semantics (any-null rows dropped for
+multi-arg aggregates like corr/regr_*).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Sequence
+
+from ..spec import data_type as dt
+
+
+@dataclass(frozen=True)
+class HostAgg:
+    type_fn: Callable[[Sequence[dt.DataType]], dt.DataType]
+    impl: Callable[[List], object]
+    nargs: int = 1
+    keep_nulls: bool = False
+
+
+HOST_AGGS: Dict[str, HostAgg] = {}
+
+
+def _reg(names, type_fn, impl, nargs=1, keep_nulls=False):
+    if isinstance(names, str):
+        names = [names]
+    for n in names:
+        HOST_AGGS[n] = HostAgg(type_fn, impl, nargs, keep_nulls)
+
+
+def _t(out):
+    return lambda ts: out
+
+
+_D = dt.DoubleType()
+_L = dt.LongType()
+_S = dt.StringType()
+
+
+# -- statistics ----------------------------------------------------------
+
+def _corr(rows):
+    if len(rows) < 2:
+        return None
+    ys = [float(a) for a, b in rows]
+    xs = [float(b) for a, b in rows]
+    n = len(rows)
+    my, mx = sum(ys) / n, sum(xs) / n
+    cov = sum((y - my) * (x - mx) for y, x in zip(ys, xs))
+    vy = sum((y - my) ** 2 for y in ys)
+    vx = sum((x - mx) ** 2 for x in xs)
+    if vy == 0 or vx == 0:
+        return None
+    return cov / math.sqrt(vy * vx)
+
+
+def _covar(rows, pop):
+    n = len(rows)
+    if n == 0 or (not pop and n < 2):
+        return None
+    ys = [float(a) for a, b in rows]
+    xs = [float(b) for a, b in rows]
+    my, mx = sum(ys) / n, sum(xs) / n
+    cov = sum((y - my) * (x - mx) for y, x in zip(ys, xs))
+    return cov / (n if pop else n - 1)
+
+
+def _skew_kurt(vals, kurt):
+    n = len(vals)
+    if n == 0:
+        return None
+    xs = [float(v) for v in vals]
+    m = sum(xs) / n
+    m2 = sum((x - m) ** 2 for x in xs) / n
+    if m2 == 0:
+        return None
+    if kurt:
+        m4 = sum((x - m) ** 4 for x in xs) / n
+        return m4 / (m2 ** 2) - 3.0
+    m3 = sum((x - m) ** 3 for x in xs) / n
+    return m3 / (m2 ** 1.5)
+
+
+def _percentile(vals, p):
+    xs = sorted(float(v) for v in vals)
+    if not xs:
+        return None
+    if isinstance(p, (list, tuple)):
+        return [_percentile(vals, q) for q in p]
+    pos = (len(xs) - 1) * float(p)
+    lo = int(math.floor(pos))
+    hi = int(math.ceil(pos))
+    if lo == hi:
+        return xs[lo]
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+
+def _median(vals):
+    return _percentile(vals, 0.5)
+
+
+def _mode(vals):
+    from collections import Counter
+    if not vals:
+        return None
+    counts = Counter(vals)
+    best = max(counts.values())
+    return min(v for v, c in counts.items() if c == best)
+
+
+def _regr(rows, what):
+    """rows = [(y, x)] with nulls pre-filtered."""
+    n = len(rows)
+    if n == 0:
+        return None if what != "count" else 0
+    ys = [float(a) for a, b in rows]
+    xs = [float(b) for a, b in rows]
+    if what == "count":
+        return n
+    if what == "avgy":
+        return sum(ys) / n
+    if what == "avgx":
+        return sum(xs) / n
+    my, mx = sum(ys) / n, sum(xs) / n
+    sxx = sum((x - mx) ** 2 for x in xs)
+    syy = sum((y - my) ** 2 for y in ys)
+    sxy = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    if what == "sxx":
+        return sxx
+    if what == "syy":
+        return syy
+    if what == "sxy":
+        return sxy
+    if what == "slope":
+        return None if sxx == 0 else sxy / sxx
+    if what == "intercept":
+        return None if sxx == 0 else my - (sxy / sxx) * mx
+    if what == "r2":
+        if sxx == 0:
+            return None
+        if syy == 0:
+            return 1.0
+        return (sxy * sxy) / (sxx * syy)
+    return None
+
+
+_reg("corr", _t(_D), _corr, nargs=2)
+_reg("covar_samp", _t(_D), lambda r: _covar(r, False), nargs=2)
+_reg("covar_pop", _t(_D), lambda r: _covar(r, True), nargs=2)
+_reg("skewness", _t(_D), lambda v: _skew_kurt(v, False))
+_reg("kurtosis", _t(_D), lambda v: _skew_kurt(v, True))
+_reg("median", _t(_D), _median)
+_reg(["percentile", "percentile_approx", "approx_percentile",
+      "percentile_cont"],
+     lambda ts: dt.ArrayType(_D) if isinstance(ts[1], dt.ArrayType) else _D,
+     lambda rows: _percentile([r[0] for r in rows],
+                              rows[0][1] if rows else 0.5),
+     nargs=-1)
+_reg("percentile_disc", _t(_D),
+     lambda rows: (lambda xs, p: None if not xs else xs[
+         min(int(math.ceil(float(p) * len(xs))) - 1 if p else 0,
+             len(xs) - 1) if p else xs[0]])(
+         sorted(float(r[0]) for r in rows), rows[0][1] if rows else 0.5),
+     nargs=-1)
+_reg("mode", lambda ts: ts[0], _mode)
+_reg("max_by", lambda ts: ts[0],
+     lambda rows: max(rows, key=lambda r: r[1])[0] if rows else None,
+     nargs=2)
+_reg("min_by", lambda ts: ts[0],
+     lambda rows: min(rows, key=lambda r: r[1])[0] if rows else None,
+     nargs=2)
+_reg("product", _t(_D),
+     lambda vals: math.prod(float(v) for v in vals) if vals else None)
+for _w in ("count", "avgy", "avgx", "sxx", "syy", "sxy", "slope",
+           "intercept", "r2"):
+    _reg(f"regr_{_w}", _t(_L if _w == "count" else _D),
+         (lambda w: lambda rows: _regr(rows, w))(_w), nargs=2)
+
+# -- collections & strings ----------------------------------------------
+
+_reg("collect_list", lambda ts: dt.ArrayType(ts[0]), lambda v: list(v))
+_reg("collect_set", lambda ts: dt.ArrayType(ts[0]),
+     lambda v: _stable_dedup(v))
+_reg("array_agg", lambda ts: dt.ArrayType(ts[0]), lambda v: list(v))
+_reg(["listagg", "string_agg"], _t(_S),
+     lambda rows: (rows[0][1] if rows and len(rows[0]) > 1 and
+                   rows[0][1] is not None else "").join(
+         _to_str(r[0] if isinstance(r, tuple) else r) for r in rows)
+     if rows else None, nargs=-1)
+_reg("bit_and", lambda ts: ts[0],
+     lambda vals: _bit_fold(vals, lambda a, b: a & b))
+_reg("bit_or", lambda ts: ts[0],
+     lambda vals: _bit_fold(vals, lambda a, b: a | b))
+_reg("bit_xor", lambda ts: ts[0],
+     lambda vals: _bit_fold(vals, lambda a, b: a ^ b))
+_reg("histogram_numeric", lambda ts: dt.ArrayType(dt.StructType((
+    dt.StructField("x", _D), dt.StructField("y", _D)))),
+    lambda rows: _histogram([r[0] for r in rows],
+                            rows[0][1] if rows else 5), nargs=-1)
+_reg("any_value", lambda ts: ts[0],
+     lambda vals: vals[0] if vals else None)
+_reg("count_min_sketch", _t(dt.BinaryType()), lambda rows: None, nargs=-1)
+
+
+def _stable_dedup(vals):
+    out = []
+    for v in vals:
+        if v not in out:
+            out.append(v)
+    return out
+
+
+def _to_str(v):
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    return str(v)
+
+
+def _bit_fold(vals, op):
+    out = None
+    for v in vals:
+        out = int(v) if out is None else op(out, int(v))
+    return out
+
+
+def _histogram(vals, nbins):
+    from collections import Counter
+    if not vals:
+        return None
+    xs = sorted(float(v) for v in vals)
+    nb = int(nbins)
+    counts = Counter(xs)
+    pts = [[x, float(c)] for x, c in sorted(counts.items())]
+    while len(pts) > nb:
+        # merge the two closest centroids
+        gaps = [(pts[i + 1][0] - pts[i][0], i) for i in range(len(pts) - 1)]
+        _, i = min(gaps)
+        a, b = pts[i], pts[i + 1]
+        total = a[1] + b[1]
+        pts[i] = [(a[0] * a[1] + b[0] * b[1]) / total, total]
+        del pts[i + 1]
+    return [{"x": x, "y": y} for x, y in pts]

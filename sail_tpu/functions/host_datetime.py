@@ -1,0 +1,467 @@
+"""Host datetime function breadth (registered into HOST_FNS).
+
+Reference role: crates/sail-function/src/scalar/datetime/. Spark datetime
+semantics: dates are calendar days, timestamps are UTC microseconds with a
+session zone for display, Java SimpleDateFormat-ish patterns.
+"""
+
+from __future__ import annotations
+
+import calendar
+import datetime
+import math
+import re
+import zoneinfo
+
+from ..spec import data_type as dt
+from .host_functions import HOST_FNS, NULL_TOLERANT, HostFn, _reg, _t
+
+_DATE = dt.DateType()
+_TS = dt.TimestampType("UTC")
+_NTZ = dt.TimestampType(None)
+_I = dt.IntegerType()
+_L = dt.LongType()
+_S = dt.StringType()
+_D = dt.DoubleType()
+
+_UTC = datetime.timezone.utc
+
+
+def _to_date(v):
+    if v is None:
+        return None
+    if isinstance(v, datetime.datetime):
+        return v.date()
+    if isinstance(v, datetime.date):
+        return v
+    s = str(v).strip()
+    m = re.match(r"^(\d{4})-(\d{1,2})(?:-(\d{1,2}))?", s)
+    if not m:
+        return None
+    y, mo, d = int(m.group(1)), int(m.group(2)), int(m.group(3) or 1)
+    try:
+        return datetime.date(y, mo, d)
+    except ValueError:
+        return None
+
+
+def _session_zone():
+    from ..utils.tz import session_zone
+    return session_zone()
+
+
+def _to_ts(v):
+    """Naive inputs are interpreted in the SESSION timezone (Spark)."""
+    if v is None:
+        return None
+    z = _session_zone()
+    if isinstance(v, datetime.datetime):
+        return v if v.tzinfo else v.replace(tzinfo=z)
+    if isinstance(v, datetime.date):
+        return datetime.datetime(v.year, v.month, v.day, tzinfo=z)
+    s = str(v).strip().replace("T", " ")
+    try:
+        out = datetime.datetime.fromisoformat(s)
+    except ValueError:
+        d = _to_date(s)
+        if d is None:
+            return None
+        return datetime.datetime(d.year, d.month, d.day, tzinfo=z)
+    return out if out.tzinfo else out.replace(tzinfo=z)
+
+
+# Java SimpleDateFormat → strftime-ish conversion for the common patterns.
+_J2P = [
+    ("yyyy", "%Y"), ("yyy", "%Y"), ("yy", "%y"),
+    ("MMMM", "%B"), ("MMM", "%b"), ("MM", "%m"),
+    ("dd", "%d"), ("HH", "%H"), ("hh", "%I"), ("mm", "%M"), ("ss", "%S"),
+    ("EEEE", "%A"), ("EEE", "%a"), ("E", "%a"), ("a", "%p"),
+    ("DDD", "%j"), ("DD", "%j"), ("D", "%j"),
+]
+
+
+def _java_fmt(ts: datetime.datetime, pattern: str) -> str:
+    if ts.tzinfo is not None:
+        ts = ts.astimezone(_session_zone())
+    out = []
+    i = 0
+    p = pattern
+    while i < len(p):
+        if p[i] == "'":
+            j = p.find("'", i + 1)
+            if j == -1:
+                out.append(p[i + 1:])
+                break
+            out.append(p[i + 1: j])
+            i = j + 1
+            continue
+        for jp, sp in _J2P:
+            if p.startswith(jp, i):
+                out.append(ts.strftime(sp))
+                i += len(jp)
+                break
+        else:
+            if p[i] == "M":
+                out.append(str(ts.month))
+                i += 1
+            elif p[i] == "d":
+                out.append(str(ts.day))
+                i += 1
+            elif p[i] == "H":
+                out.append(str(ts.hour))
+                i += 1
+            elif p[i] == "h":
+                out.append(str(((ts.hour - 1) % 12) + 1))
+                i += 1
+            elif p[i] == "m":
+                out.append(str(ts.minute))
+                i += 1
+            elif p[i] == "s":
+                out.append(str(ts.second))
+                i += 1
+            elif p.startswith("SSS", i):
+                out.append(f"{ts.microsecond // 1000:03d}")
+                i += 3
+            elif p[i] == "S":
+                out.append(str(ts.microsecond // 100000))
+                i += 1
+            elif p[i] == "G":
+                out.append("AD")
+                i += 1
+            elif p.startswith("QQ", i):
+                out.append(f"{(ts.month - 1) // 3 + 1:02d}")
+                i += 2
+            elif p[i] == "Q" or p[i] == "q":
+                out.append(str((ts.month - 1) // 3 + 1))
+                i += 1
+            else:
+                out.append(p[i])
+                i += 1
+    return "".join(out)
+
+
+def _java_parse(s: str, pattern: str):
+    """Parse with a Java pattern via strftime translation (common cases)."""
+    p = pattern
+    for jp, sp in _J2P:
+        p = p.replace(jp, sp)
+    p = p.replace("M", "%m").replace("d", "%d").replace("H", "%H") \
+        .replace("h", "%I").replace("m", "%M").replace("s", "%S")
+    # collapse accidental doubles from single-letter passes
+    p = p.replace("%%", "%")
+    try:
+        return datetime.datetime.strptime(s.strip(), p).replace(tzinfo=_UTC)
+    except ValueError:
+        return None
+
+
+def _add_months(v, n):
+    d = _to_date(v)
+    if d is None or n is None:
+        return None
+    n = int(n)
+    was_last = d.day == calendar.monthrange(d.year, d.month)[1]
+    total = d.year * 12 + (d.month - 1) + n
+    y, mo = divmod(total, 12)
+    mo += 1
+    last = calendar.monthrange(y, mo)[1]
+    day = last if was_last else min(d.day, last)
+    return datetime.date(y, mo, day)
+
+
+def _months_between(a, b, round_off=True):
+    ta, tb = _to_ts(a), _to_ts(b)
+    if ta is None or tb is None:
+        return None
+    la = calendar.monthrange(ta.year, ta.month)[1]
+    lb = calendar.monthrange(tb.year, tb.month)[1]
+    if ta.day == tb.day or (ta.day == la and tb.day == lb):
+        months = (ta.year - tb.year) * 12 + (ta.month - tb.month)
+        return float(months)
+    base = (ta.year - tb.year) * 12 + (ta.month - tb.month)
+    sec_a = (ta.day - 1) * 86400 + ta.hour * 3600 + ta.minute * 60 + ta.second
+    sec_b = (tb.day - 1) * 86400 + tb.hour * 3600 + tb.minute * 60 + tb.second
+    frac = (sec_a - sec_b) / (31 * 86400)
+    out = base + frac
+    return round(out, 8) if round_off else out
+
+
+def _trunc_date(v, unit):
+    d = _to_date(v)
+    if d is None or unit is None:
+        return None
+    u = unit.lower()
+    if u in ("year", "yyyy", "yy"):
+        return d.replace(month=1, day=1)
+    if u in ("quarter",):
+        return d.replace(month=(d.month - 1) // 3 * 3 + 1, day=1)
+    if u in ("month", "mon", "mm"):
+        return d.replace(day=1)
+    if u in ("week",):
+        return d - datetime.timedelta(days=d.weekday())
+    return None
+
+
+def _date_trunc(unit, v):
+    ts = _to_ts(v)
+    if ts is None or unit is None:
+        return None
+    u = unit.lower()
+    if u in ("year", "yyyy", "yy"):
+        return ts.replace(month=1, day=1, hour=0, minute=0, second=0,
+                          microsecond=0)
+    if u == "quarter":
+        return ts.replace(month=(ts.month - 1) // 3 * 3 + 1, day=1, hour=0,
+                          minute=0, second=0, microsecond=0)
+    if u in ("month", "mon", "mm"):
+        return ts.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    if u == "week":
+        base = ts - datetime.timedelta(days=ts.weekday())
+        return base.replace(hour=0, minute=0, second=0, microsecond=0)
+    if u in ("day", "dd"):
+        return ts.replace(hour=0, minute=0, second=0, microsecond=0)
+    if u == "hour":
+        return ts.replace(minute=0, second=0, microsecond=0)
+    if u == "minute":
+        return ts.replace(second=0, microsecond=0)
+    if u == "second":
+        return ts.replace(microsecond=0)
+    if u in ("millisecond",):
+        return ts.replace(microsecond=ts.microsecond // 1000 * 1000)
+    if u in ("microsecond",):
+        return ts
+    return None
+
+
+def _make_ts(*args, tz=None, ntz=False):
+    if len(args) == 1 and isinstance(args[0], datetime.date):
+        d0 = args[0]
+        args = (d0.year, d0.month, d0.day, 0, 0, 0)
+    if len(args) < 6:
+        return None
+    y, mo, d, h, mi, s = args[:6]
+    if None in (y, mo, d, h, mi, s):
+        return None
+    try:
+        sec = int(s)
+        us = int(round((float(s) - sec) * 1e6))
+        if sec == 60:
+            sec = 0
+            carry = 1
+        else:
+            carry = 0
+        out = datetime.datetime(int(y), int(mo), int(d), int(h), int(mi),
+                                sec, us)
+        if carry:
+            out += datetime.timedelta(minutes=1)
+    except (ValueError, OverflowError):
+        return None
+    if ntz:
+        return out
+    if tz is not None:
+        try:
+            zone = zoneinfo.ZoneInfo(tz)
+        except Exception:  # noqa: BLE001
+            return None
+        return out.replace(tzinfo=zone).astimezone(_UTC)
+    return out.replace(tzinfo=_session_zone())
+
+
+def _next_day(v, day_name):
+    d = _to_date(v)
+    if d is None or day_name is None:
+        return None
+    names = {"mo": 0, "tu": 1, "we": 2, "th": 3, "fr": 4, "sa": 5, "su": 6}
+    key = day_name.strip().lower()[:2]
+    if key not in names:
+        return None
+    target = names[key]
+    delta = (target - d.weekday() + 7) % 7
+    return d + datetime.timedelta(days=delta or 7)
+
+
+def _convert_tz(*args):
+    if len(args) == 3:
+        src, dst, ts = args
+    else:
+        src, dst, ts = None, args[0], args[1]
+    t = _to_ts(ts)
+    if t is None or dst is None:
+        return None
+    try:
+        src_zone = zoneinfo.ZoneInfo(src) if src else _UTC
+        dst_zone = zoneinfo.ZoneInfo(dst)
+    except Exception:  # noqa: BLE001
+        return None
+    return t.replace(tzinfo=src_zone).astimezone(dst_zone).replace(
+        tzinfo=None)
+
+
+_reg(["make_date", "try_make_date"], _t(_DATE),
+     lambda y, m, d: _try_date(y, m, d))
+_reg(["make_timestamp", "try_make_timestamp"], _t(_TS),
+     lambda *a: _make_ts(*a[:6], tz=a[6] if len(a) > 6 else None),
+     null_tolerant=True)
+_reg(["make_timestamp_ltz", "try_make_timestamp_ltz"], _t(_TS),
+     lambda *a: _make_ts(*a[:6], tz=a[6] if len(a) > 6 else None),
+     null_tolerant=True)
+_reg(["make_timestamp_ntz", "try_make_timestamp_ntz"], _t(_NTZ),
+     lambda *a: _make_ts(*a[:6], ntz=True))
+_reg(["add_months"], _t(_DATE), _add_months)
+_reg(["months_between"], _t(_D), _months_between)
+_reg(["trunc"], _t(_DATE), _trunc_date)
+_reg(["date_trunc"], _t(_TS), _date_trunc)
+_reg(["next_day"], _t(_DATE), _next_day)
+_reg(["last_day"], _t(_DATE), lambda v: (lambda d: d.replace(
+    day=calendar.monthrange(d.year, d.month)[1]))(_to_date(v)))
+_reg(["to_date", "try_to_date"], _t(_DATE),
+     lambda v, *fmt: _to_date(v) if not fmt else
+     (lambda t: t.date() if t else None)(_java_parse(str(v), fmt[0])))
+_reg(["to_timestamp", "try_to_timestamp", "to_timestamp_ltz",
+      "try_to_timestamp_ltz"], _t(_TS),
+     lambda v, *fmt: _to_ts(v) if not fmt else _java_parse(str(v), fmt[0]))
+_reg(["to_timestamp_ntz", "try_to_timestamp_ntz"], _t(_NTZ),
+     lambda v, *fmt: (lambda t: t.replace(tzinfo=None) if t else None)(
+         _to_ts(v) if not fmt else _java_parse(str(v), fmt[0])))
+_reg(["date_format"], _t(_S),
+     lambda v, fmt: _java_fmt(_to_ts(v), fmt))
+_reg(["from_unixtime"], _t(_S),
+     lambda sec, *fmt: _java_fmt(
+         datetime.datetime.fromtimestamp(int(sec), _UTC),
+         fmt[0] if fmt else "yyyy-MM-dd HH:mm:ss"))
+_reg(["unix_timestamp", "to_unix_timestamp"], _t(_L),
+     lambda *a: _unix_ts(*a), null_tolerant=True)
+_reg(["timestamp_seconds"], _t(_TS),
+     lambda s: datetime.datetime.fromtimestamp(float(s), _UTC))
+_reg(["timestamp_millis"], _t(_TS),
+     lambda ms: datetime.datetime.fromtimestamp(int(ms) / 1e3, _UTC))
+_reg(["timestamp_micros"], _t(_TS),
+     lambda us: datetime.datetime.fromtimestamp(int(us) / 1e6, _UTC))
+_reg(["unix_seconds"], _t(_L),
+     lambda ts: int(_to_ts(ts).timestamp()))
+_reg(["unix_millis"], _t(_L),
+     lambda ts: int(_to_ts(ts).timestamp() * 1e3))
+_reg(["unix_micros"], _t(_L),
+     lambda ts: int(_to_ts(ts).timestamp() * 1e6))
+_reg(["unix_date"], _t(_I),
+     lambda d: (_to_date(d) - datetime.date(1970, 1, 1)).days)
+_reg(["date_from_unix_date"], _t(_DATE),
+     lambda n: datetime.date(1970, 1, 1) + datetime.timedelta(days=int(n)))
+_reg(["convert_timezone"], _t(_NTZ), _convert_tz)
+_reg(["from_utc_timestamp"], _t(_TS),
+     lambda ts, tz: _shift_tz(ts, tz, to_local=True))
+_reg(["to_utc_timestamp"], _t(_TS),
+     lambda ts, tz: _shift_tz(ts, tz, to_local=False))
+_reg(["date_part", "datepart"], lambda ts: _date_part_type(None),
+     lambda part, v: _date_part(part, v))
+_reg(["dayname"], _t(_S), lambda v: _to_date(v).strftime("%a"))
+_reg(["monthname"], _t(_S), lambda v: _to_date(v).strftime("%b"))
+_reg(["day"], _t(_I), lambda v: _to_date(v).day)
+_reg(["curdate"], _t(_DATE), None)
+_reg(["date"], _t(_DATE), lambda v: _to_date(v))
+_reg(["timestamp"], _t(_TS), lambda v: _to_ts(v))
+_reg(["make_dt_interval"], _t(dt.DayTimeIntervalType()),
+     lambda *a: _make_dt_interval(*a))
+_reg(["make_ym_interval"], _t(dt.YearMonthIntervalType()),
+     lambda *a: int(a[0] if a else 0) * 12 + int(a[1] if len(a) > 1 else 0))
+_reg(["extract_seconds"], _t(dt.DecimalType(8, 6)),
+     lambda v: _extract_part(v, "seconds"))
+_reg(["extract_days"], _t(_I), lambda v: _extract_part(v, "days"))
+_reg(["extract_hours"], _t(_I), lambda v: _extract_part(v, "hours"))
+_reg(["extract_minutes"], _t(_I), lambda v: _extract_part(v, "minutes"))
+_reg(["extract_years"], _t(_I), lambda v: _extract_part(v, "years"))
+_reg(["extract_months"], _t(_I), lambda v: _extract_part(v, "months"))
+
+
+def _make_dt_interval(days=0, hours=0, mins=0, secs=0):
+    if None in (days, hours, mins, secs):
+        return None
+    return datetime.timedelta(days=int(days), hours=int(hours),
+                              minutes=int(mins), seconds=float(secs))
+
+
+def _extract_part(v, part):
+    import decimal
+    if isinstance(v, datetime.timedelta):
+        total_us = round(v.total_seconds() * 1e6)
+        sign = -1 if total_us < 0 else 1
+        total_us = abs(total_us)
+        days, rem = divmod(total_us, 86_400_000_000)
+        hours, rem = divmod(rem, 3_600_000_000)
+        minutes, rem = divmod(rem, 60_000_000)
+        if part == "days":
+            return sign * int(days)
+        if part == "hours":
+            return sign * int(hours)
+        if part == "minutes":
+            return sign * int(minutes)
+        if part == "seconds":
+            return decimal.Decimal(sign * rem).scaleb(-6)
+    if isinstance(v, int):  # year-month interval months
+        if part == "years":
+            return int(v) // 12 if v >= 0 else -((-int(v)) // 12)
+        if part == "months":
+            return int(v) % 12 if v >= 0 else -((-int(v)) % 12)
+    t = _to_ts(v)
+    if t is None:
+        return None
+    if part == "seconds":
+        import decimal as _dec
+        return _dec.Decimal(t.second * 1_000_000 + t.microsecond).scaleb(-6)
+    table = {"days": t.day, "hours": t.hour, "minutes": t.minute,
+             "years": t.year, "months": t.month}
+    return table.get(part)
+_reg(["now", "current_timestamp", "localtimestamp", "current_date",
+      "current_timezone"], _t(_TS), None)  # interpreter special-cases
+
+
+def _try_date(y, m, d):
+    try:
+        return datetime.date(int(y), int(m), int(d))
+    except (ValueError, OverflowError):
+        return None
+
+
+def _unix_ts(*args):
+    if not args or args[0] is None:
+        return None
+    v = args[0]
+    if len(args) > 1 and args[1] is not None and isinstance(v, str):
+        t = _java_parse(v, args[1])
+    else:
+        t = _to_ts(v)
+    return None if t is None else int(t.timestamp())
+
+
+def _shift_tz(ts, tz, to_local):
+    t = _to_ts(ts)
+    if t is None or tz is None:
+        return None
+    try:
+        zone = zoneinfo.ZoneInfo(tz)
+    except Exception:  # noqa: BLE001
+        return None
+    naive = t.replace(tzinfo=None)
+    if to_local:
+        return t.astimezone(zone).replace(tzinfo=None)
+    return naive.replace(tzinfo=zone).astimezone(_UTC).replace(tzinfo=None)
+
+
+def _date_part_type(_part):
+    return dt.IntegerType()
+
+
+def _date_part(part, v):
+    t = _to_ts(v)
+    if t is None or part is None:
+        return None
+    p = part.lower()
+    table = {
+        "year": t.year, "yearofweek": t.isocalendar()[0], "quarter":
+        (t.month - 1) // 3 + 1, "month": t.month, "week": t.isocalendar()[1],
+        "day": t.day, "dayofweek": t.weekday() + 2 if t.weekday() < 6 else 1,
+        "dow": t.weekday() + 2 if t.weekday() < 6 else 1,
+        "doy": t.timetuple().tm_yday, "hour": t.hour, "minute": t.minute,
+        "second": t.second,
+    }
+    return table.get(p)

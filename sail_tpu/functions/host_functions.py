@@ -1,0 +1,824 @@
+"""Host-evaluated scalar functions: arrays, maps, structs, JSON, URL,
+datetime/string breadth, bitwise, conversion — everything whose data lives
+in host dictionaries rather than device registers.
+
+Reference role: the wide tail of crates/sail-function/src/scalar/ (arrays,
+collections, maps, structs, json, url, misc). TPU note: these operate on
+variable-width / nested values, which stay host-side by design (the device
+columns carry dictionary codes); the hot relational path never routes
+through here unless a query actually uses these functions.
+
+Each entry: ``name -> HostFn(type_fn, impl)`` where ``impl`` receives
+per-row python argument values (None = SQL NULL) and returns a python
+value. Implementations follow Spark null semantics: unless registered in
+``NULL_TOLERANT``, a NULL argument produces NULL without calling the impl.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import json as _json
+import math
+import re
+import urllib.parse
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..spec import data_type as dt
+
+_D = dt.DoubleType()
+_I = dt.IntegerType()
+_L = dt.LongType()
+_S = dt.StringType()
+_B = dt.BooleanType()
+
+
+@dataclass(frozen=True)
+class HostFn:
+    type_fn: Callable[[Sequence[dt.DataType]], dt.DataType]
+    impl: Callable
+
+
+HOST_FNS: Dict[str, HostFn] = {}
+NULL_TOLERANT = set()
+
+
+def _reg(names, type_fn, impl, null_tolerant=False):
+    if isinstance(names, str):
+        names = [names]
+    for n in names:
+        HOST_FNS[n] = HostFn(type_fn, impl)
+        if null_tolerant:
+            NULL_TOLERANT.add(n)
+
+
+def _t(out):
+    return lambda ts: out
+
+
+def _t0(ts):
+    return ts[0]
+
+
+def _elem(ts):
+    t = ts[0]
+    return t.element_type if isinstance(t, dt.ArrayType) else dt.NullType()
+
+
+def _arr_of_t0(ts):
+    return ts[0] if isinstance(ts[0], dt.ArrayType) else dt.ArrayType(ts[0])
+
+
+# ---------------------------------------------------------------------------
+# arrays
+# ---------------------------------------------------------------------------
+
+def _common(ts):
+    out = dt.NullType()
+    for t in ts:
+        if isinstance(out, dt.NullType):
+            out = t
+        elif not isinstance(t, dt.NullType):
+            try:
+                out = dt.common_type(out, t)
+            except TypeError:
+                return out
+    return out
+
+
+_reg("array", lambda ts: dt.ArrayType(_common(ts), any(
+    isinstance(t, dt.NullType) for t in ts) or not ts),
+    lambda *a: list(a), null_tolerant=True)
+_reg(["array_append"], lambda ts: dt.ArrayType(
+    _common([_elem(ts), ts[1]]), True),
+    lambda arr, v: None if arr is None else list(arr) + [v],
+    null_tolerant=True)
+_reg(["array_prepend"], lambda ts: dt.ArrayType(
+    _common([_elem(ts), ts[1]]), True),
+    lambda arr, v: None if arr is None else [v] + list(arr),
+    null_tolerant=True)
+_reg("array_contains", _t(_B),
+     lambda arr, v: None if v is None else (
+         True if v in [x for x in arr if x is not None] else
+         (None if None in arr else False)))
+_reg("array_distinct", _t0, lambda arr: _dedup(arr))
+_reg("array_max", _elem,
+     lambda arr: max((x for x in arr if x is not None), default=None))
+_reg("array_min", _elem,
+     lambda arr: min((x for x in arr if x is not None), default=None))
+_reg("array_position", _t(_L),
+     lambda arr, v: 0 if v not in arr else arr.index(v) + 1)
+_reg("array_remove", _t0,
+     lambda arr, v: None if v is None else [x for x in arr if x != v or
+                                            x is None])
+_reg("array_repeat", lambda ts: dt.ArrayType(ts[0]),
+     lambda v, n: [v] * max(int(n), 0), null_tolerant=True)
+_reg("array_size", _t(_I), lambda arr: len(arr))
+_reg(["size", "cardinality"], _t(_I),
+     lambda c: len(c))
+_reg("array_union", _t0, lambda a, b: _dedup(list(a) + list(b)))
+_reg("array_intersect", _t0,
+     lambda a, b: _dedup([x for x in a if x in b]))
+_reg("array_except", _t0,
+     lambda a, b: _dedup([x for x in a if x not in b]))
+_reg("array_join", _t(_S), lambda *a: _array_join(*a), null_tolerant=True)
+_reg("array_compact", _t0,
+     lambda arr: [x for x in arr if x is not None])
+_reg("array_insert", lambda ts: dt.ArrayType(
+    _common([_elem(ts), ts[2]]), True), lambda *a: _array_insert(*a),
+    null_tolerant=True)
+_reg("arrays_overlap", _t(_B), lambda a, b: _arrays_overlap(a, b))
+_reg("arrays_zip", lambda ts: dt.ArrayType(dt.StructType(tuple(
+    dt.StructField(str(i), _elem([t])) for i, t in enumerate(ts)))),
+    lambda *arrs: [dict((str(i), arr[j] if j < len(arr) else None)
+                        for i, arr in enumerate(arrs))
+                   for j in range(max((len(a) for a in arrs), default=0))])
+_reg("flatten", _elem,
+     lambda arr: None if any(x is None for x in arr) else
+     [y for x in arr for y in x])
+_reg(["slice"], _t0, lambda arr, start, length: _slice(arr, start, length))
+_reg(["sort_array"], _t0, lambda *a: _sort_array(*a))
+_reg(["sequence"], lambda ts: dt.ArrayType(ts[0]),
+     lambda *a: _sequence(*a))
+_reg(["shuffle"], _t0, lambda arr: list(arr))  # deterministic-friendly
+_reg(["get"], _elem, lambda arr, i: arr[i] if 0 <= i < len(arr) else None)
+_reg(["element_at"], lambda ts: (
+    _elem(ts) if isinstance(ts[0], dt.ArrayType) else
+    ts[0].value_type if isinstance(ts[0], dt.MapType) else dt.NullType()),
+    lambda c, k: _element_at(c, k))
+_reg(["try_element_at"], lambda ts: (
+    _elem(ts) if isinstance(ts[0], dt.ArrayType) else
+    ts[0].value_type if isinstance(ts[0], dt.MapType) else dt.NullType()),
+    lambda c, k: _element_at(c, k, strict=False))
+
+
+def _dedup(arr):
+    if arr is None:
+        return None
+    out = []
+    for x in arr:
+        if x not in out:
+            out.append(x)
+    return out
+
+
+def _array_join(arr, sep, null_repl=None):
+    if arr is None or sep is None:
+        return None
+    vals = []
+    for x in arr:
+        if x is None:
+            if null_repl is not None:
+                vals.append(null_repl)
+        else:
+            vals.append(_spark_str(x))
+    return sep.join(vals)
+
+
+def _array_insert(arr, pos, v):
+    if arr is None or pos is None:
+        return None
+    pos = int(pos)
+    if pos == 0:
+        raise ValueError("array_insert position must not be 0")
+    arr = list(arr)
+    if pos > 0:
+        while len(arr) < pos - 1:
+            arr.append(None)
+        arr.insert(pos - 1, v)
+    else:
+        idx = len(arr) + pos + 1
+        while idx < 0:
+            arr.insert(0, None)
+            idx += 1
+        arr.insert(idx, v)
+    return arr
+
+
+def _arrays_overlap(a, b):
+    common = [x for x in a if x is not None and x in b]
+    if common:
+        return True
+    if None in a or None in b:
+        return None
+    return False
+
+
+def _slice(arr, start, length):
+    start = int(start)
+    length = int(length)
+    if start == 0:
+        raise ValueError("slice start must not be 0")
+    if length < 0:
+        raise ValueError("slice length must be >= 0")
+    i = start - 1 if start > 0 else len(arr) + start
+    if i < 0:
+        return []
+    return arr[i:i + length]
+
+
+def _sort_array(arr, asc=True):
+    vals = sorted((x for x in arr if x is not None), reverse=not asc)
+    nulls = [None] * (len(arr) - len(vals))
+    return nulls + vals if asc else vals + nulls
+
+
+def _sequence(start, stop, step=None):
+    if isinstance(start, datetime.date):
+        raise ValueError("temporal sequence requires an interval step")
+    if step is None:
+        step = 1 if stop >= start else -1
+    if step == 0:
+        raise ValueError("sequence step must not be 0")
+    out = []
+    v = start
+    while (step > 0 and v <= stop) or (step < 0 and v >= stop):
+        out.append(v)
+        v += step
+    return out
+
+
+def _element_at(c, k, strict=True):
+    if isinstance(c, dict):
+        return c.get(k)
+    k = int(k)
+    if k == 0:
+        raise ValueError("element_at index must not be 0")
+    idx = k - 1 if k > 0 else len(c) + k
+    if 0 <= idx < len(c):
+        return c[idx]
+    if strict:
+        raise ValueError(f"array index {k} out of bounds")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# maps & structs
+# ---------------------------------------------------------------------------
+
+def _map_type(ts):
+    ks = _common(ts[0::2]) if ts else dt.NullType()
+    vs = _common(ts[1::2]) if ts else dt.NullType()
+    return dt.MapType(ks, vs)
+
+
+_reg("map", _map_type,
+     lambda *kv: dict(zip(kv[0::2], kv[1::2])), null_tolerant=True)
+_reg("map_keys", lambda ts: dt.ArrayType(ts[0].key_type if isinstance(
+    ts[0], dt.MapType) else dt.NullType()), lambda m: list(m.keys()))
+_reg("map_values", lambda ts: dt.ArrayType(ts[0].value_type if isinstance(
+    ts[0], dt.MapType) else dt.NullType()), lambda m: list(m.values()))
+_reg("map_entries", lambda ts: dt.ArrayType(dt.StructType((
+    dt.StructField("key", ts[0].key_type if isinstance(ts[0], dt.MapType)
+                   else dt.NullType(), False),
+    dt.StructField("value", ts[0].value_type if isinstance(
+        ts[0], dt.MapType) else dt.NullType())))),
+    lambda m: [{"key": k, "value": v} for k, v in m.items()])
+_reg("map_concat", lambda ts: ts[0] if ts else dt.MapType(),
+     lambda *ms: {k: v for m in ms for k, v in m.items()})
+_reg("map_contains_key", _t(_B), lambda m, k: k in m)
+_reg("map_from_arrays", lambda ts: dt.MapType(_elem([ts[0]]),
+                                              _elem([ts[1]])),
+     lambda ks, vs: dict(zip(ks, vs)))
+_reg("map_from_entries", lambda ts: dt.MapType(
+    *(lambda et: (et.fields[0].data_type, et.fields[1].data_type)
+      if isinstance(et, dt.StructType) and len(et.fields) == 2
+      else (dt.NullType(), dt.NullType()))(_elem([ts[0]]))),
+    lambda entries: dict((tuple(e.values()) if isinstance(e, dict)
+                          else tuple(e)) for e in entries))
+_reg(["str_to_map"], _t(dt.MapType(_S, _S)), lambda *a: _str_to_map(*a))
+
+
+def _str_to_map(s, pair_delim=",", kv_delim=":"):
+    out = {}
+    for pair in s.split(pair_delim):
+        if kv_delim in pair:
+            k, _, v = pair.partition(kv_delim)
+            out[k] = v
+        else:
+            out[pair] = None
+    return out
+
+
+def _struct_type(ts):
+    return dt.StructType(tuple(
+        dt.StructField(f"col{i+1}", t) for i, t in enumerate(ts)))
+
+
+_reg("struct", _struct_type,
+     lambda *vals: {f"col{i+1}": v for i, v in enumerate(vals)},
+     null_tolerant=True)
+_reg("named_struct", lambda ts: dt.StructType(tuple(
+    dt.StructField(f"f{i}", t) for i, t in enumerate(ts[1::2]))),
+    lambda *kv: dict(zip(kv[0::2], kv[1::2])), null_tolerant=True)
+
+
+# ---------------------------------------------------------------------------
+# JSON
+# ---------------------------------------------------------------------------
+
+def _get_json_object(s, path):
+    if not path.startswith("$"):
+        return None
+    try:
+        v = _json.loads(s)
+    except Exception:  # noqa: BLE001 — malformed JSON → NULL
+        return None
+    for part in re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]",
+                           path[1:]):
+        key, idx = part
+        if key:
+            if not isinstance(v, dict) or key not in v:
+                return None
+            v = v[key]
+        else:
+            if not isinstance(v, list) or int(idx) >= len(v):
+                return None
+            v = v[int(idx)]
+    if v is None:
+        return None
+    if isinstance(v, (dict, list)):
+        return _json.dumps(v, separators=(",", ":"))
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+_reg("get_json_object", _t(_S), _get_json_object)
+_reg("json_array_length", _t(_I), lambda s: _json_array_length(s),
+     null_tolerant=False)
+_reg("json_object_keys", _t(dt.ArrayType(_S)),
+     lambda s: _json_object_keys(s))
+_reg("to_json", _t(_S),
+     lambda v, *opts: _json.dumps(_jsonable(v), separators=(",", ":")))
+_reg("schema_of_json", _t(_S), lambda s, *o: _schema_of_json(s))
+_reg("from_json", lambda ts: dt.NullType(), lambda *a: None)  # typed later
+
+
+def _json_array_length(s):
+    try:
+        v = _json.loads(s)
+    except Exception:  # noqa: BLE001
+        return None
+    return len(v) if isinstance(v, list) else None
+
+
+def _json_object_keys(s):
+    try:
+        v = _json.loads(s)
+    except Exception:  # noqa: BLE001
+        return None
+    return list(v.keys()) if isinstance(v, dict) else None
+
+
+def _jsonable(v):
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()
+    if hasattr(v, "as_tuple"):  # Decimal
+        return float(v)
+    return v
+
+
+def _schema_of_json(s):
+    v = _json.loads(s)
+
+    def st(x):
+        if isinstance(x, bool):
+            return "BOOLEAN"
+        if isinstance(x, int):
+            return "BIGINT"
+        if isinstance(x, float):
+            return "DOUBLE"
+        if isinstance(x, list):
+            return f"ARRAY<{st(x[0]) if x else 'STRING'}>"
+        if isinstance(x, dict):
+            inner = ", ".join(f"{k}: {st(val)}" for k, val in x.items())
+            return f"STRUCT<{inner}>"
+        return "STRING"
+
+    return st(v)
+
+
+# ---------------------------------------------------------------------------
+# URL
+# ---------------------------------------------------------------------------
+
+def _parse_url(url, part, key=None):
+    try:
+        u = urllib.parse.urlparse(url)
+    except Exception:  # noqa: BLE001
+        return None
+    if part == "HOST":
+        return u.hostname
+    if part == "PATH":
+        return u.path
+    if part == "QUERY":
+        out = u.query or None
+        if out is not None and key is not None:
+            qs = urllib.parse.parse_qs(u.query)
+            vals = qs.get(key)
+            return vals[0] if vals else None
+        return out
+    if part == "REF":
+        return u.fragment or None
+    if part == "PROTOCOL":
+        return u.scheme or None
+    if part == "FILE":
+        return u.path + (("?" + u.query) if u.query else "")
+    if part == "AUTHORITY":
+        return u.netloc or None
+    if part == "USERINFO":
+        if "@" in u.netloc:
+            return u.netloc.rsplit("@", 1)[0]
+        return None
+    return None
+
+
+_reg(["parse_url", "try_parse_url"], _t(_S), _parse_url)
+_reg("url_encode", _t(_S),
+     lambda s: urllib.parse.quote_plus(s))
+_reg(["url_decode", "try_url_decode"], _t(_S),
+     lambda s: urllib.parse.unquote_plus(s))
+
+
+# ---------------------------------------------------------------------------
+# bitwise / conversion / misc
+# ---------------------------------------------------------------------------
+
+_reg("getbit", _t(_I), lambda v, b: (int(v) >> int(b)) & 1)
+_reg("bit_count", _t(_I),
+     lambda v: bin(int(v) & 0xFFFFFFFFFFFFFFFF).count("1")
+     if v >= 0 else bin(int(v) % (1 << 64)).count("1"))
+_reg("bit_get", _t(_I), lambda v, b: (int(v) >> int(b)) & 1)
+_reg("shiftrightunsigned", _t0,
+     lambda v, n: ((int(v) % (1 << 64)) >> int(n)) - (1 << 64)
+     if ((int(v) % (1 << 64)) >> int(n)) >= (1 << 63)
+     else ((int(v) % (1 << 64)) >> int(n)) if False else
+     ((int(v) & 0xFFFFFFFF) >> int(n)) if -2**31 <= v < 2**31 else
+     ((int(v) % (1 << 64)) >> int(n)))
+_reg(["hex"], _t(_S), lambda v: _hex(v))
+_reg(["unhex"], _t(dt.BinaryType()), lambda s: _unhex(s))
+_reg(["bin"], _t(_S),
+     lambda v: bin(int(v) % (1 << 64))[2:] if v < 0 else bin(int(v))[2:])
+_reg(["base64"], _t(_S),
+     lambda b: base64.b64encode(
+         b if isinstance(b, bytes) else str(b).encode()).decode())
+_reg(["unbase64"], _t(dt.BinaryType()),
+     lambda s: base64.b64decode(s))
+_reg(["conv"], _t(_S), lambda n, f, t: _conv(n, f, t))
+_reg(["char", "chr"], _t(_S), lambda n: chr(int(n) % 0x110000)
+     if n >= 0 else "")
+_reg(["encode"], _t(dt.BinaryType()),
+     lambda s, cs: s.encode(_codec(cs)))
+_reg(["decode"], _t(_S),
+     lambda b, cs: (b if isinstance(b, bytes) else str(b).encode()).decode(
+         _codec(cs), errors="replace"))
+_reg(["typeof"], lambda ts: _S, None)  # special-cased by the interpreter
+_reg(["uuid"], _t(_S), None)
+_reg(["luhn_check"], _t(_B), lambda s: _luhn(s))
+_reg(["format_number"], _t(_S), lambda v, d: _format_number(v, d))
+_reg(["space"], _t(_S), lambda n: " " * max(int(n), 0))
+_reg(["elt"], lambda ts: _common(ts[1:]),
+     lambda n, *vals: vals[int(n) - 1] if 1 <= int(n) <= len(vals) else None)
+_reg(["field"], _t(_I), lambda v, *vals: (
+    vals.index(v) + 1 if v in vals else 0), null_tolerant=True)
+_reg(["stack"], lambda ts: dt.StructType(()), None)  # generator; not here
+_reg(["bitmap_bit_position"], _t(_L), lambda v: (int(v) - 1) % 32768)
+_reg(["bitmap_bucket_number"], _t(_L),
+     lambda v: (int(v) - 1) // 32768 + 1 if v > 0 else (int(v) - 1) // 32768 + 1)
+
+
+def _codec(cs):
+    m = {"utf-8": "utf-8", "utf8": "utf-8", "us-ascii": "ascii",
+         "iso-8859-1": "latin-1", "utf-16": "utf-16", "utf-16be": "utf-16-be",
+         "utf-16le": "utf-16-le"}
+    return m.get(cs.lower(), cs)
+
+
+def _hex(v):
+    if isinstance(v, bytes):
+        return v.hex().upper()
+    if isinstance(v, str):
+        return v.encode().hex().upper()
+    v = int(v)
+    return format(v % (1 << 64), "X")
+
+
+def _unhex(s):
+    try:
+        if len(s) % 2:
+            s = "0" + s
+        return bytes.fromhex(s)
+    except ValueError:
+        return None
+
+
+def _conv(num, from_base, to_base):
+    try:
+        v = int(str(num).strip(), int(from_base))
+    except ValueError:
+        return "0"
+    to_base = int(to_base)
+    if to_base < 0:
+        # treat as signed output in |base|
+        b = -to_base
+        sign = "-" if v < 0 else ""
+        v = abs(v)
+    else:
+        b = to_base
+        if v < 0:
+            v += 1 << 64
+        sign = ""
+    if v == 0:
+        return "0"
+    digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    out = []
+    while v:
+        v, r = divmod(v, b)
+        out.append(digits[r])
+    return sign + "".join(reversed(out))
+
+
+def _luhn(s):
+    if not s.isdigit():
+        return False
+    total = 0
+    for i, ch in enumerate(reversed(s)):
+        d = int(ch)
+        if i % 2 == 1:
+            d *= 2
+            if d > 9:
+                d -= 9
+        total += d
+    return total % 10 == 0
+
+
+def _format_number(v, d):
+    if isinstance(d, str):
+        return None
+    d = int(d)
+    if d < 0:
+        return None
+    s = f"{float(v):,.{d}f}"
+    return s
+
+
+# ---------------------------------------------------------------------------
+# try_* arithmetic and numeric breadth
+# ---------------------------------------------------------------------------
+
+def _try_arith_type(op):
+    def tf(ts):
+        from .registry import infer_function_type
+        try:
+            return infer_function_type(op, ts)
+        except TypeError:
+            return ts[0]
+    return tf
+
+
+def _try_op(op):
+    def impl(a, b):
+        try:
+            if op == "/":
+                if isinstance(a, int) and not hasattr(a, "days"):
+                    a = float(a)
+                return None if (isinstance(b, (int, float)) and
+                                float(b) == 0) else a / b
+            if op == "%":
+                return None if float(b) == 0 else (
+                    a % b if (a >= 0) == (b >= 0) else a - b * (a // b)
+                    if False else _spark_mod(a, b))
+            if op == "+":
+                return a + b
+            if op == "-":
+                return a - b
+            if op == "*":
+                return a * b
+        except (ZeroDivisionError, OverflowError, TypeError):
+            return None
+        return None
+    return impl
+
+
+def _spark_mod(a, b):
+    import math as _m
+    if isinstance(a, int) and isinstance(b, int):
+        return int(_m.fmod(a, b))
+    import decimal as _dec
+    if isinstance(a, _dec.Decimal) or isinstance(b, _dec.Decimal):
+        return _dec.Decimal(str(a)) % _dec.Decimal(str(b)) if (
+            float(a) >= 0) else -((-_dec.Decimal(str(a))) %
+                                  _dec.Decimal(str(b)))
+    return _m.fmod(float(a), float(b))
+
+
+_reg("try_add", _try_arith_type("+"), _try_op("+"))
+_reg("try_subtract", _try_arith_type("-"), _try_op("-"))
+_reg("try_multiply", _try_arith_type("*"), _try_op("*"))
+_reg("try_divide", lambda ts: ts[0] if isinstance(
+    ts[0], (dt.DayTimeIntervalType, dt.YearMonthIntervalType))
+    else dt.DoubleType(), _try_op("/"))
+_reg("try_mod", lambda ts: _common(ts), lambda a, b: (
+    None if float(b) == 0 else _spark_mod(a, b)))
+_reg("width_bucket", _t(_L), lambda v, lo, hi, n: _width_bucket(
+    v, lo, hi, n))
+_reg("uniform", lambda ts: _common(ts[:2]),
+     lambda lo, hi, *seed: (lo + hi) // 2 if isinstance(lo, int)
+     else (lo + hi) / 2)
+_reg("randstr", _t(_S), lambda n, *seed: "a" * int(n))
+_reg("factorial", _t(_L),
+     lambda n: None if n < 0 or n > 20 else math.factorial(int(n)))
+
+
+def _width_bucket(v, lo, hi, n):
+    v, lo, hi = float(v), float(lo), float(hi)
+    n = int(n)
+    if n <= 0 or lo == hi:
+        return None
+    if lo < hi:
+        if v < lo:
+            return 0
+        if v >= hi:
+            return n + 1
+        return int((v - lo) / (hi - lo) * n) + 1
+    if v > lo:
+        return 0
+    if v <= hi:
+        return n + 1
+    return int((lo - v) / (lo - hi) * n) + 1
+
+
+# ---------------------------------------------------------------------------
+# string additions
+# ---------------------------------------------------------------------------
+
+_reg("ascii", _t(dt.IntegerType()),
+     lambda s: ord(str(s)[0]) if str(s) else 0)
+_reg(["lpad"], lambda ts: ts[0],
+     lambda s, n, *p: _pad(s, int(n), p[0] if p else None, left=True))
+_reg(["rpad"], lambda ts: ts[0],
+     lambda s, n, *p: _pad(s, int(n), p[0] if p else None, left=False))
+_reg(["is_valid_utf8"], _t(_B), lambda v: _is_valid_utf8(v))
+_reg(["make_valid_utf8"], _t(_S),
+     lambda v: (v if isinstance(v, bytes) else str(v).encode(
+         "utf-8", "surrogatepass")).decode("utf-8", errors="replace"))
+_reg(["validate_utf8", "try_validate_utf8"], _t(_S),
+     lambda v: ((v.decode("utf-8") if isinstance(v, bytes) else str(v))
+                if _is_valid_utf8(v) else None))
+_reg(["locate", "position"], _t(dt.IntegerType()),
+     lambda sub, s, *start: (s.find(sub, int(start[0]) - 1 if start
+                                    else 0) + 1))
+_reg(["instr"], _t(dt.IntegerType()), lambda s, sub: s.find(sub) + 1)
+
+
+def _pad(s, n, pad, left):
+    if isinstance(s, bytes):
+        pad = pad if pad is not None else b" "
+        if len(s) >= n:
+            return s[:n]
+        fill = (pad * n)[: n - len(s)]
+        return fill + s if left else s + fill
+    pad = pad if pad is not None else " "
+    if len(s) >= n:
+        return s[:n]
+    fill = (pad * n)[: n - len(s)]
+    return fill + s if left else s + fill
+
+
+def _is_valid_utf8(v):
+    if isinstance(v, str):
+        return True
+    try:
+        v.decode("utf-8")
+        return True
+    except UnicodeDecodeError:
+        return False
+
+
+def _decode_dispatch(*args):
+    """2-arg: charset decode; 3+: Oracle-style conditional decode."""
+    if len(args) == 2:
+        b, cs = args
+        return (b if isinstance(b, bytes) else str(b).encode()).decode(
+            _codec(cs), errors="replace")
+    expr = args[0]
+    rest = args[1:]
+    i = 0
+    while i + 1 < len(rest):
+        if rest[i] == expr or (rest[i] is None and expr is None):
+            return rest[i + 1]
+        i += 2
+    if i < len(rest):
+        return rest[i]  # default
+    return None
+
+
+_reg(["decode"], lambda ts: _S if len(ts) == 2 else _common(ts[2::2]),
+     _decode_dispatch, null_tolerant=True)
+_reg(["elt"], _t(_S),
+     lambda n, *vals: None if not (1 <= int(n) <= len(vals))
+     else _spark_str(vals[int(n) - 1]))
+_reg(["format_number"], _t(_S), lambda v, d: _format_number2(v, d))
+
+
+def _format_number2(v, d):
+    if isinstance(d, str):
+        decs = len(d.partition(".")[2].replace(",", "")) if "." in d else 0
+        s = f"{float(v):,.{decs}f}"
+        if "." in s:
+            s = s.rstrip("0").rstrip(".")
+        return s
+    d = int(d)
+    if d < 0:
+        return None
+    return f"{float(v):,.{d}f}"
+
+
+# ---------------------------------------------------------------------------
+# higher-order functions (closures come from the host interpreter)
+# ---------------------------------------------------------------------------
+
+def _nargs(f):
+    return getattr(f, "nargs", 1)
+
+
+def _ho_transform(arr, f):
+    if _nargs(f) == 2:
+        return [f(x, i) for i, x in enumerate(arr)]
+    return [f(x) for x in arr]
+
+
+def _ho_filter(arr, f):
+    if _nargs(f) == 2:
+        return [x for i, x in enumerate(arr) if f(x, i) is True]
+    return [x for x in arr if f(x) is True]
+
+
+def _ho_exists(arr, f):
+    res = [f(x) for x in arr]
+    if any(v is True for v in res):
+        return True
+    return None if any(v is None for v in res) else False
+
+
+def _ho_forall(arr, f):
+    res = [f(x) for x in arr]
+    if any(v is False for v in res):
+        return False
+    return None if any(v is None for v in res) else True
+
+
+def _ho_aggregate(arr, zero, merge, finish=None):
+    acc = zero
+    for x in arr:
+        acc = merge(acc, x)
+    return finish(acc) if finish is not None else acc
+
+
+def _ho_array_sort_cmp(arr, cmp):
+    import functools
+    return sorted(arr, key=functools.cmp_to_key(
+        lambda a, b: int(cmp(a, b) or 0)))
+
+
+def _ho_zip_with(a, b, f):
+    n = max(len(a), len(b))
+    return [f(a[i] if i < len(a) else None, b[i] if i < len(b) else None)
+            for i in range(n)]
+
+
+_reg("transform", lambda ts: dt.ArrayType(dt.NullType()), _ho_transform)
+_reg("filter", _t0, _ho_filter)
+_reg(["exists", "any_match"], _t(_B), _ho_exists)
+_reg(["forall", "all_match"], _t(_B), _ho_forall)
+_reg(["aggregate", "reduce"], _t0, _ho_aggregate)
+_reg("array_sort_cmp", _t0, _ho_array_sort_cmp)
+# array_sort without a comparator: nulls last ascending
+_reg("array_sort", _t0, lambda arr: sorted(
+    (x for x in arr if x is not None)) + [None] * sum(
+        1 for x in arr if x is None))
+_reg("zip_with", lambda ts: dt.ArrayType(dt.NullType()), _ho_zip_with)
+_reg("map_filter", _t0,
+     lambda m, f: {k: v for k, v in m.items() if f(k, v) is True})
+_reg("transform_keys", _t0,
+     lambda m, f: {f(k, v): v for k, v in m.items()})
+_reg("transform_values", _t0,
+     lambda m, f: {k: f(k, v) for k, v in m.items()})
+_reg("map_zip_with", _t0,
+     lambda m1, m2, f: {k: f(k, m1.get(k), m2.get(k))
+                        for k in {**m1, **m2}})
+
+
+def _spark_str(v):
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        from ..utils.format import format_double
+        return format_double(v)
+    return str(v)

@@ -1,0 +1,287 @@
+"""Host string/regexp function breadth (registered into HOST_FNS).
+
+Reference role: crates/sail-function/src/scalar/string/ and the regexp
+family. Java-regex-flavored patterns are translated approximately to
+Python re (the common constructs coincide).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+from ..spec import data_type as dt
+from .host_functions import _reg, _t, _t0
+
+_S = dt.StringType()
+_I = dt.IntegerType()
+_L = dt.LongType()
+_B = dt.BooleanType()
+
+
+def _jre(pattern: str) -> str:
+    return pattern
+
+
+_reg(["split"], _t(dt.ArrayType(_S)),
+     lambda s, pat, *limit: _split(s, pat, limit[0] if limit else -1))
+_reg(["split_part"], _t(_S), lambda s, d, n: _split_part(s, d, n))
+_reg(["substring_index"], _t(_S),
+     lambda s, delim, n: _substring_index(s, delim, int(n)))
+_reg(["find_in_set"], _t(_I),
+     lambda s, ss: 0 if "," in s else (
+         ss.split(",").index(s) + 1 if s in ss.split(",") else 0))
+_reg(["overlay"], _t0, lambda s, repl, pos, *l: _overlay(
+    s, repl, int(pos), int(l[0]) if l else -1))
+_reg(["levenshtein"], _t(_I), lambda a, b, *th: _levenshtein(
+    a, b, int(th[0]) if th else None))
+_reg(["regexp_like", "regexp", "rlike"], _t(_B),
+     lambda s, p: re.search(_jre(p), s) is not None)
+_reg(["regexp_count"], _t(_I),
+     lambda s, p: len(re.findall(_jre(p), s)))
+_reg(["regexp_extract"], _t(_S),
+     lambda s, p, *g: _re_extract(s, p, int(g[0]) if g else 1))
+_reg(["regexp_extract_all"], _t(dt.ArrayType(_S)),
+     lambda s, p, *g: _re_extract_all(s, p, int(g[0]) if g else 1))
+_reg(["regexp_instr"], _t(_I),
+     lambda s, p, *g: _re_instr(s, p))
+_reg(["regexp_substr"], _t(_S),
+     lambda s, p: (lambda m: m.group(0) if m else None)(
+         re.search(_jre(p), s)))
+_reg(["regexp_replace"], _t(_S),
+     lambda s, p, r, *pos: _re_replace(s, p, r,
+                                       int(pos[0]) if pos else 1))
+_reg(["mask"], _t(_S), lambda s, *a: _mask(s, *a), null_tolerant=True)
+_reg(["printf", "format_string"], _t(_S),
+     lambda fmt, *args: _printf(fmt, args), null_tolerant=True)
+_reg(["to_binary", "try_to_binary"], _t(dt.BinaryType()),
+     lambda s, *f: _to_binary(s, f[0] if f else "hex"))
+_reg(["to_char", "to_varchar"], _t(_S), lambda v, fmt: _to_char(v, fmt))
+_reg(["to_number", "try_to_number"],
+     lambda ts: dt.DecimalType(38, 6), lambda s, fmt: _to_number(s, fmt))
+_reg(["btrim"], _t(_S),
+     lambda s, *chars: s.strip(chars[0]) if chars else s.strip())
+_reg(["char_length", "character_length", "len"], _t(_I), lambda s: len(s))
+_reg(["contains"], _t(_B), lambda a, b: b in a)
+_reg(["startswith"], _t(_B), lambda a, b: a.startswith(b))
+_reg(["endswith"], _t(_B), lambda a, b: a.endswith(b))
+_reg(["sentences"], _t(dt.ArrayType(dt.ArrayType(_S))),
+     lambda s, *lc: [[w for w in re.split(r"\W+", sent) if w]
+                     for sent in re.split(r"[.!?]", s) if sent.strip()])
+_reg(["initcap"], _t(_S),
+     lambda s: " ".join(w[:1].upper() + w[1:].lower() if w else w
+                        for w in s.split(" ")))
+_reg(["quote"], _t(_S), lambda s: "'" + s.replace("'", "\\'") + "'")
+_reg(["istrue", "isfalse"], _t(_B), None)
+_reg(["soundex"], _t(_S), lambda s: _soundex(s))
+_reg(["crc32"], _t(_L), lambda s: __import__("zlib").crc32(
+    s if isinstance(s, bytes) else str(s).encode()) & 0xFFFFFFFF)
+_reg(["octet_length"], _t(_I),
+     lambda s: len(s if isinstance(s, bytes) else str(s).encode()))
+_reg(["bit_length"], _t(_I),
+     lambda s: 8 * len(s if isinstance(s, bytes) else str(s).encode()))
+
+
+def _split(s, pat, limit=-1):
+    limit = int(limit)
+    if limit > 0:
+        return re.split(_jre(pat), s, maxsplit=limit - 1)
+    out = re.split(_jre(pat), s)
+    if limit == 0 or limit == -1:
+        # Java semantics: limit<=0 keeps all; limit=0 drops trailing empties
+        pass
+    return out
+
+
+def _split_part(s, delim, n):
+    n = int(n)
+    if n == 0:
+        raise ValueError("split_part index must not be 0")
+    parts = s.split(delim) if delim else [s]
+    idx = n - 1 if n > 0 else len(parts) + n
+    if 0 <= idx < len(parts):
+        return parts[idx]
+    return ""
+
+
+def _substring_index(s, delim, n):
+    if not delim:
+        return ""
+    if n > 0:
+        parts = s.split(delim)
+        return delim.join(parts[:n])
+    if n < 0:
+        parts = s.split(delim)
+        return delim.join(parts[n:])
+    return ""
+
+
+def _overlay(s, repl, pos, length):
+    if length < 0:
+        length = len(repl)
+    i = pos - 1
+    return s[:i] + repl + s[i + length:]
+
+
+def _levenshtein(a, b, threshold=None):
+    m, n = len(a), len(b)
+    prev = list(range(n + 1))
+    for i in range(1, m + 1):
+        cur = [i] + [0] * n
+        for j in range(1, n + 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (a[i - 1] != b[j - 1]))
+        prev = cur
+    d = prev[n]
+    if threshold is not None and d > threshold:
+        return -1
+    return d
+
+
+def _re_extract(s, p, g):
+    m = re.search(_jre(p), s)
+    if not m:
+        return ""
+    try:
+        return m.group(g) or ""
+    except (IndexError, error_types()):
+        raise
+
+
+def _re_extract_all(s, p, g):
+    out = []
+    for m in re.finditer(_jre(p), s):
+        out.append(m.group(g) or "")
+    return out
+
+
+def _re_instr(s, p):
+    m = re.search(_jre(p), s)
+    return (m.start() + 1) if m else 0
+
+
+def _re_replace(s, p, r, pos=1):
+    r = re.sub(r"\$(\d)", r"\\\1", r)
+    prefix = s[:pos - 1]
+    return prefix + re.sub(_jre(p), r, s[pos - 1:])
+
+
+def error_types():
+    return re.error
+
+
+def _mask(s, *args):
+    if s is None:
+        return None
+    upper = args[0] if len(args) > 0 else "X"
+    lower = args[1] if len(args) > 1 else "x"
+    digit = args[2] if len(args) > 2 else "n"
+    other = args[3] if len(args) > 3 else None
+    out = []
+    for ch in s:
+        if ch.isupper():
+            out.append(upper if upper is not None else ch)
+        elif ch.islower():
+            out.append(lower if lower is not None else ch)
+        elif ch.isdigit():
+            out.append(digit if digit is not None else ch)
+        else:
+            out.append(other if other is not None else ch)
+    return "".join(out)
+
+
+def _printf(fmt, args):
+    if fmt is None:
+        return None
+    out = []
+    ai = 0
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch != "%":
+            out.append(ch)
+            i += 1
+            continue
+        m = re.match(r"%([-+ 0#]*\d*(?:\.\d+)?)([sdfeEgGxXob%])", fmt[i:])
+        if not m:
+            out.append(ch)
+            i += 1
+            continue
+        spec = m.group(0)
+        if m.group(2) == "%":
+            out.append("%")
+        else:
+            v = args[ai]
+            ai += 1
+            if m.group(2) == "b":
+                out.append("true" if v else "false")
+            elif m.group(2) in "dxXo":
+                out.append(spec % int(v))
+            elif m.group(2) in "feEgG":
+                out.append(spec % float(v))
+            else:
+                out.append(spec % (v,))
+        i += len(spec)
+    return "".join(out)
+
+
+def _to_binary(s, fmt):
+    f = (fmt or "hex").lower()
+    if f == "hex":
+        from .host_functions import _unhex
+        return _unhex(s)
+    if f == "utf-8" or f == "utf8":
+        return s.encode()
+    if f == "base64":
+        import base64 as b64
+        return b64.b64decode(s)
+    return None
+
+
+def _to_char(v, fmt):
+    f = fmt
+    neg = float(v) < 0
+    av = abs(float(v))
+    if "." in f:
+        ip, _, fp = f.partition(".")
+        decs = len(fp)
+    else:
+        ip, decs = f, 0
+    s = f"{av:.{decs}f}"
+    int_part, _, frac = s.partition(".")
+    grouped = ip.count(",") > 0
+    if grouped:
+        int_part = f"{int(int_part):,}"
+    width = len(ip.replace(",", ""))
+    out = int_part + (("." + frac) if decs else "")
+    if neg:
+        out = "-" + out
+    return out
+
+
+def _to_number(s, fmt):
+    import decimal
+    cleaned = s.replace(",", "").replace("$", "").strip()
+    try:
+        return decimal.Decimal(cleaned)
+    except decimal.InvalidOperation:
+        return None
+
+
+def _soundex(s):
+    if not s:
+        return s
+    s = s.upper()
+    codes = {"B": "1", "F": "1", "P": "1", "V": "1",
+             "C": "2", "G": "2", "J": "2", "K": "2", "Q": "2", "S": "2",
+             "X": "2", "Z": "2", "D": "3", "T": "3", "L": "4",
+             "M": "5", "N": "5", "R": "6"}
+    out = s[0]
+    prev = codes.get(s[0], "")
+    for ch in s[1:]:
+        c = codes.get(ch, "")
+        if c and c != prev:
+            out += c
+        if ch not in "HW":
+            prev = c
+    return (out + "000")[:4]

@@ -31,7 +31,11 @@ WINDOW_FUNCTIONS = {
 
 
 def is_aggregate(name: str) -> bool:
-    return name.lower() in AGGREGATE_FUNCTIONS
+    n = name.lower()
+    if n in AGGREGATE_FUNCTIONS:
+        return True
+    from .host_aggregates import HOST_AGGS
+    return n in HOST_AGGS
 
 
 def is_window(name: str) -> bool:
@@ -63,7 +67,8 @@ def avg_result_type(t: dt.DataType) -> dt.DataType:
 _NUMERIC_BIN = {"+", "-", "*", "/", "%", "div", "pmod", "power", "atan2"}
 _CMP = {"==", "!=", "<", "<=", ">", ">=", "<=>"}
 _BOOL_FNS = {"and", "or", "not", "isnull", "isnotnull", "like", "ilike",
-             "rlike", "in", "startswith", "endswith", "contains"}
+             "rlike", "in", "startswith", "endswith", "contains",
+             "equal_null", "isnotnan"}
 _FLOAT_FNS = {"sqrt", "exp", "ln", "log10", "log2", "log", "sin", "cos",
               "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
               "degrees", "radians", "cbrt", "log1p", "expm1", "rint",
@@ -188,8 +193,18 @@ def infer_function_type(name: str, arg_types: Sequence[dt.DataType]) -> dt.DataT
         return dt.LongType()
     if name in ("monotonically_increasing_id", "spark_partition_id"):
         return dt.LongType() if name == "monotonically_increasing_id" else dt.IntegerType()
+    host = host_fn(name)
+    if host is not None:
+        return host.type_fn(list(arg_types))
     raise TypeError(f"unknown function {name!r} for types "
                     f"{[t.simple_string() for t in arg_types]}")
+
+
+def host_fn(name: str):
+    """Host-evaluated function lookup (arrays/maps/structs/json/url/...)."""
+    from . import host_datetime, host_strings  # noqa: F401 — registration
+    from .host_functions import HOST_FNS
+    return HOST_FNS.get(name.lower())
 
 
 def aggregate_result_type(fn: str, arg_type: Optional[dt.DataType]) -> dt.DataType:

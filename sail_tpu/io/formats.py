@@ -131,6 +131,15 @@ def write_table(table: pa.Table, fmt: str, path: str, mode: str = "error",
         from ..lakehouse.delta import DeltaTable
         t = DeltaTable(path)
         if not DeltaTable.exists(path):
+            nonempty = os.path.isdir(path) and os.listdir(path)
+            if nonempty and mode == "error":
+                raise FileExistsError(
+                    f"path exists and is not a Delta table: {path}")
+            if nonempty and mode == "ignore":
+                return
+            if nonempty and mode == "append":
+                raise FileNotFoundError(
+                    f"cannot append: not a Delta table: {path}")
             t.create(table, partition_by)
             return
         if mode == "error":

@@ -157,16 +157,23 @@ class DeltaLog:
     def write_commit_atomic(self, version: int, actions: List[dict]):
         """Atomically create the commit file for ``version``; raises
         FileExistsError when another writer got there first (the optimistic
-        concurrency primitive)."""
+        concurrency primitive). The content is written to a temp file first
+        and linked into place, so a concurrent reader/loser can never
+        observe a partially-written commit."""
         os.makedirs(self.log_dir, exist_ok=True)
         path = _commit_path(self.log_dir, version)
         data = "\n".join(json.dumps(a, separators=(",", ":"))
                          for a in actions) + "\n"
-        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+        tmp = os.path.join(self.log_dir,
+                           f".{version:020d}.{uuid.uuid4().hex}.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         try:
-            os.write(fd, data.encode())
+            os.link(tmp, path)  # atomic create-if-absent with full content
         finally:
-            os.close(fd)
+            os.unlink(tmp)
 
     # -- checkpoints -----------------------------------------------------
     def last_checkpoint(self) -> Optional[int]:

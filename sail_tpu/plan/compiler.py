@@ -108,6 +108,8 @@ class ExprCompiler:
             return self._compile_case(r)
         if isinstance(r, rx.RCall):
             return self._compile_call(r)
+        if isinstance(r, (rx.RLambda, rx.RLambdaVar)):
+            raise HostFallback("lambdas evaluate on the host interpreter")
         raise TypeError(f"cannot compile {type(r).__name__}")
 
     def _compile_udf(self, r: rx.RCall, args: List[Compiled], udf) -> Compiled:
@@ -116,6 +118,8 @@ class ExprCompiler:
     # -- literals ---------------------------------------------------------
     def _compile_literal(self, v: LV) -> Compiled:
         d = v.data_type
+        if isinstance(d, (dt.ArrayType, dt.MapType, dt.StructType)):
+            raise HostFallback("complex literals evaluate on the host")
         if v.is_null:
             jdt = physical_jnp_dtype(d if d.physical_dtype else dt.NullType())
 
@@ -715,7 +719,8 @@ def _parse_string_value(s: Optional[str], target: dt.DataType):
         if isinstance(target, dt.TimestampType):
             v = datetime.datetime.fromisoformat(s)
             if v.tzinfo is None:
-                v = v.replace(tzinfo=datetime.timezone.utc)
+                from ..utils.tz import localize
+                v = localize(v)  # session timezone (Spark semantics)
             return int(v.timestamp() * 1_000_000), True
     except (ValueError, ArithmeticError):
         return 0, False

@@ -337,6 +337,8 @@ def _remap_indices(r: rx.Rex, remap: Dict[int, int]) -> rx.Rex:
             r, args=tuple(_remap_indices(a, remap) for a in r.args))
     if isinstance(r, rx.RCast):
         return dataclasses.replace(r, child=_remap_indices(r.child, remap))
+    if isinstance(r, rx.RLambda):
+        return dataclasses.replace(r, body=_remap_indices(r.body, remap))
     if isinstance(r, rx.RCase):
         return dataclasses.replace(
             r,

@@ -97,6 +97,7 @@ def _fresh(prefix: str) -> str:
 class Resolver:
     def __init__(self, catalog):
         self.catalog = catalog
+        self._lambda_env = []  # stack of {param_name: dtype} for lambdas
 
     # ------------------------------------------------------------------
     def resolve(self, plan: sp.QueryPlan) -> pn.PlanNode:
@@ -285,22 +286,44 @@ class Resolver:
     def _resolve_values(self, plan: sp.Values, outer, ctes):
         rows = []
         types: List[dt.DataType] = []
+        exprs_rows = []
+        all_literals = True
         for row in plan.rows:
             vals = []
+            rexes = []
             for j, e in enumerate(row):
                 r = self._resolve_expr(e, Scope([], None, {}))
-                if not isinstance(r, rx.RLit):
-                    raise ResolutionError("VALUES rows must be literals in v0")
-                vals.append(r.value)
-                t = r.value.data_type
+                rexes.append(r)
+                if isinstance(r, rx.RLit):
+                    vals.append(r.value)
+                    t = r.value.data_type
+                else:
+                    all_literals = False
+                    vals.append(None)
+                    t = rx.rex_type(r)
                 if j >= len(types):
                     types.append(t)
                 elif not isinstance(t, dt.NullType):
                     types[j] = t if isinstance(types[j], dt.NullType) \
                         else dt.common_type(types[j], t)
             rows.append(tuple(vals))
+            exprs_rows.append(rexes)
         schema = tuple(pn.Field(f"col{j + 1}", t, True) for j, t in enumerate(types))
-        node = pn.ValuesExec(schema, tuple(rows))
+        if all_literals:
+            node: pn.PlanNode = pn.ValuesExec(schema, tuple(rows))
+        else:
+            # general expressions: each row projects over OneRow, unioned
+            parts = []
+            for rexes in exprs_rows:
+                exprs = tuple((schema[j].name,
+                               rexes[j] if rx.rex_type(rexes[j]) ==
+                               schema[j].dtype or isinstance(
+                                   schema[j].dtype, dt.NullType)
+                               else rx.RCast(rexes[j], schema[j].dtype))
+                              for j in range(len(rexes)))
+                parts.append(pn.ProjectExec(pn.OneRowExec(), exprs))
+            node = parts[0] if len(parts) == 1 else pn.UnionExec(
+                tuple(parts), True)
         fields = [ScopeField(f.name, (), f.dtype, f.nullable) for f in schema]
         return node, Scope(fields, outer, ctes)
 
@@ -1039,6 +1062,11 @@ class Resolver:
     def _resolve_expr(self, e: ex.Expr, scope: Scope) -> rx.Rex:
         if isinstance(e, ex.Literal):
             return rx.RLit(e.value)
+        if isinstance(e, ex.LambdaVariable):
+            for env in reversed(self._lambda_env):
+                if e.name in env:
+                    return rx.RLambdaVar(e.name, env[e.name], True)
+            raise ResolutionError(f"unbound lambda variable {e.name!r}")
         if isinstance(e, ex.Alias):
             return self._resolve_expr(e.child, scope)
         if isinstance(e, ex.Attribute):
@@ -1120,6 +1148,10 @@ class Resolver:
         raise ResolutionError(f"unsupported expression {type(e).__name__}")
 
     def _resolve_attribute(self, e: ex.Attribute, scope: Scope) -> rx.Rex:
+        if len(e.name) == 1:
+            for env in reversed(self._lambda_env):
+                if e.name[0] in env:
+                    return rx.RLambdaVar(e.name[0], env[e.name[0]], True)
         idx = scope.find(e.name)
         if idx is not None:
             f = scope.fields[idx]
@@ -1185,23 +1217,159 @@ class Resolver:
         if freg.is_aggregate(name):
             raise ResolutionError(
                 f"aggregate function {name}() used outside aggregation context")
+        if any(isinstance(a, ex.LambdaFunction) for a in e.args):
+            return self._resolve_higher_order(name, list(e.args), scope)
         args = [self._resolve_expr(a, scope) for a in e.args]
         return self._finish_function(name, args)
+
+    # -- higher-order functions (lambdas) --------------------------------
+    def _resolve_lambda(self, lam: ex.LambdaFunction, param_types,
+                        scope: Scope) -> rx.RLambda:
+        env = dict(zip(lam.arguments, param_types))
+        self._lambda_env.append(env)
+        try:
+            body = self._resolve_expr(lam.body, scope)
+        finally:
+            self._lambda_env.pop()
+        return rx.RLambda(body, tuple(lam.arguments), rx.rex_type(body),
+                          rx.rex_nullable(body))
+
+    def _resolve_higher_order(self, name: str, args, scope: Scope) -> rx.Rex:
+        """Typed resolution of transform/filter/aggregate/zip_with/… —
+        lambda parameters take the collection's element types."""
+        def elem(t):
+            return t.element_type if isinstance(t, dt.ArrayType) \
+                else dt.NullType()
+
+        first = self._resolve_expr(args[0], scope) \
+            if not isinstance(args[0], ex.LambdaFunction) else None
+        t0 = rx.rex_type(first) if first is not None else dt.NullType()
+        idx_t = dt.IntegerType()
+        if name in ("transform", "filter", "exists", "forall",
+                    "any_match", "all_match"):
+            lam0 = args[1]
+            nparams = len(lam0.arguments)
+            ptypes = [elem(t0)] + ([idx_t] if nparams == 2 else [])
+            lam = self._resolve_lambda(lam0, ptypes, scope)
+            if name == "transform":
+                out: dt.DataType = dt.ArrayType(lam.dtype, True)
+            elif name == "filter":
+                out = t0
+            else:
+                out = dt.BooleanType()
+            return rx.RCall(name, (first, lam), out, True)
+        if name in ("aggregate", "reduce"):
+            zero = self._resolve_expr(args[1], scope)
+            acc_t = rx.rex_type(zero)
+            merge = self._resolve_lambda(args[2], [acc_t, elem(t0)], scope)
+            if len(args) > 3:
+                finish = self._resolve_lambda(args[3], [acc_t], scope)
+                return rx.RCall("aggregate", (first, zero, merge, finish),
+                                finish.dtype, True)
+            return rx.RCall("aggregate", (first, zero, merge), acc_t, True)
+        if name == "array_sort":
+            lam = self._resolve_lambda(args[1], [elem(t0), elem(t0)], scope)
+            return rx.RCall("array_sort_cmp", (first, lam), t0, True)
+        if name == "zip_with":
+            second = self._resolve_expr(args[1], scope)
+            t1 = rx.rex_type(second)
+            lam = self._resolve_lambda(args[2], [elem(t0), elem(t1)], scope)
+            return rx.RCall("zip_with", (first, second, lam),
+                            dt.ArrayType(lam.dtype, True), True)
+        if name in ("map_filter", "transform_keys", "transform_values"):
+            mt = t0 if isinstance(t0, dt.MapType) else dt.MapType()
+            lam = self._resolve_lambda(args[1], [mt.key_type, mt.value_type],
+                                       scope)
+            if name == "map_filter":
+                out = mt
+            elif name == "transform_keys":
+                out = dt.MapType(lam.dtype, mt.value_type,
+                                 mt.value_contains_null)
+            else:
+                out = dt.MapType(mt.key_type, lam.dtype, True)
+            return rx.RCall(name, (first, lam), out, True)
+        if name == "map_zip_with":
+            second = self._resolve_expr(args[1], scope)
+            m0 = t0 if isinstance(t0, dt.MapType) else dt.MapType()
+            m1 = rx.rex_type(second)
+            v1 = m1.value_type if isinstance(m1, dt.MapType) else dt.NullType()
+            lam = self._resolve_lambda(
+                args[2], [m0.key_type, m0.value_type, v1], scope)
+            return rx.RCall(name, (first, second, lam),
+                            dt.MapType(m0.key_type, lam.dtype, True), True)
+        raise ResolutionError(
+            f"function {name!r} does not take a lambda argument")
 
     def _finish_function(self, name: str, args: List[rx.Rex]) -> rx.Rex:
         """Name rewrites + UDF lookup + typed call construction (shared by
         the plain and window-aware expression resolvers)."""
         name = name.lower()
+        if name == "named_struct":
+            fields = []
+            for k, v in zip(args[0::2], args[1::2]):
+                key = k.value.value if isinstance(k, rx.RLit) else "col"
+                fields.append(dt.StructField(str(key), rx.rex_type(v),
+                                             rx.rex_nullable(v)))
+            return rx.RCall("named_struct", tuple(args),
+                            dt.StructType(tuple(fields)), False)
+        if name == "struct":
+            fields = tuple(
+                dt.StructField(a.name if isinstance(a, rx.BoundRef)
+                               else f"col{i+1}", rx.rex_type(a),
+                               rx.rex_nullable(a))
+                for i, a in enumerate(args))
+            return rx.RCall("struct", tuple(args), dt.StructType(fields),
+                            False)
         if name in ("nvl", "ifnull"):
             name = "coalesce"
         if name == "substr":
             name = "substring"
-        if name in ("position", "locate") and len(args) >= 2:
+        if name == "dateadd":
+            name = "date_add"
+        if name == "date_diff":
+            name = "datediff"
+        # EXTRACT field-name forms (plural parts, interval components)
+        if args and name in ("seconds", "second", "days", "hours",
+                             "minutes", "years", "months", "year", "month",
+                             "day", "hour", "minute"):
+            at0 = rx.rex_type(args[0])
+            base = name.rstrip("s")
+            if isinstance(at0, (dt.DayTimeIntervalType,
+                                dt.YearMonthIntervalType)):
+                name = "extract_" + base + "s"
+            elif name in ("seconds",):
+                name = "extract_seconds"
+            elif name in ("days", "hours", "minutes", "years", "months"):
+                name = base
+        # temporal functions accept string datetime forms: cast up front so
+        # device kernels never see dictionary codes as epoch values
+        _DATE_ARG = {"day", "dayofmonth", "month", "year", "quarter",
+                     "dayofweek", "weekday", "dayofyear", "weekofyear",
+                     "week", "last_day", "next_day", "add_months",
+                     "date_add", "date_sub", "datediff", "date_diff",
+                     "dayname", "monthname", "unix_date"}
+        _TS_ARG = {"hour", "minute", "second", "date_format",
+                   "from_utc_timestamp", "to_utc_timestamp", "unix_seconds",
+                   "unix_millis", "unix_micros"}
+        if name in _DATE_ARG and args and \
+                isinstance(rx.rex_type(args[0]), dt.StringType):
+            args = [rx.RCast(args[0], dt.DateType(), False, True)] + args[1:]
+        elif name in _TS_ARG and args and \
+                isinstance(rx.rex_type(args[0]), dt.StringType):
+            args = [rx.RCast(args[0], dt.TimestampType("UTC"), False,
+                             True)] + args[1:]
+        elif name in ("months_between",):
+            args = [rx.RCast(a, dt.TimestampType("UTC"), False, True)
+                    if isinstance(rx.rex_type(a), dt.StringType) else a
+                    for a in args]
+        if name == "datediff" or name == "date_diff":
+            args = [rx.RCast(a, dt.DateType(), False, True)
+                    if isinstance(rx.rex_type(a), dt.StringType) else a
+                    for a in args]
+        if name in ("position", "locate") and len(args) == 2:
             # position(sub, str) → instr(str, sub)
-            args = [args[1], args[0]] + args[2:]
+            args = [args[1], args[0]]
             name = "instr"
-        if name in ("date_format",):
-            raise ResolutionError("date_format not yet supported")
         # named SQL UDFs
         u = getattr(self.catalog, "udfs", None)
         if u is not None:
@@ -1376,6 +1544,16 @@ class _AggCollector:
             return mk("sqrt", [var])
         if fn == "approx_count_distinct":
             return self._add_agg("count", arg, True, dt.LongType())
+        from ..functions.host_aggregates import HOST_AGGS
+        if fn in HOST_AGGS:
+            spec = HOST_AGGS[fn]
+            out_t = spec.type_fn([rx.rex_type(a) for a in args])
+            if len(args) > 1:
+                st = dt.StructType(tuple(
+                    dt.StructField(f"_{i}", rx.rex_type(a), True)
+                    for i, a in enumerate(args)))
+                arg = rx.RCall("struct", tuple(args), st, False)
+            return self._add_agg("__host__" + fn, arg, distinct, out_t)
         raise ResolutionError(f"aggregate {fn!r} not supported yet")
 
 

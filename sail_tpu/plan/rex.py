@@ -67,6 +67,24 @@ class RCase(Rex):
 
 
 @dataclass(frozen=True)
+class RLambdaVar(Rex):
+    name: str
+    dtype: dt.DataType = field(default_factory=dt.NullType)
+    nullable: bool = True
+
+
+@dataclass(frozen=True)
+class RLambda(Rex):
+    """Resolved lambda for higher-order functions; evaluated per element
+    by the host interpreter."""
+
+    body: Rex = None
+    params: Tuple[str, ...] = ()
+    dtype: dt.DataType = field(default_factory=dt.NullType)
+    nullable: bool = True
+
+
+@dataclass(frozen=True)
 class RScalarSubquery(Rex):
     """Uncorrelated scalar subquery; the executor runs ``plan`` (a physical
     plan) once and substitutes the single value."""
@@ -91,6 +109,8 @@ def walk(r: Rex):
             yield from walk(a)
     elif isinstance(r, RCast):
         yield from walk(r.child)
+    elif isinstance(r, RLambda):
+        yield from walk(r.body)
     elif isinstance(r, RCase):
         for c, v in r.branches:
             yield from walk(c)
@@ -112,6 +132,8 @@ def shift_refs(r: Rex, delta: int) -> Rex:
         return dataclasses.replace(r, args=tuple(shift_refs(a, delta) for a in r.args))
     if isinstance(r, RCast):
         return dataclasses.replace(r, child=shift_refs(r.child, delta))
+    if isinstance(r, RLambda):
+        return dataclasses.replace(r, body=shift_refs(r.body, delta))
     if isinstance(r, RCase):
         return dataclasses.replace(
             r,

@@ -66,8 +66,14 @@ class SparkSession:
         return optimize(node)
 
     def _execute_query(self, plan: sp.QueryPlan) -> pa.Table:
-        node = self._resolve(plan)
-        return self._executor_cls(dict(self.conf.items())).execute(node)
+        from .utils.tz import reset_session_timezone, set_session_timezone
+        token = set_session_timezone(
+            self.conf.get("spark.sql.session.timeZone") or "UTC")
+        try:
+            node = self._resolve(plan)
+            return self._executor_cls(dict(self.conf.items())).execute(node)
+        finally:
+            reset_session_timezone(token)
 
     # -- entry points -------------------------------------------------------
     def sql(self, query: str) -> "DataFrame":

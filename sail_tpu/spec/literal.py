@@ -87,7 +87,10 @@ class Literal:
         if isinstance(self.data_type, TimestampType):
             v = self.value
             if v.tzinfo is None:
-                v = v.replace(tzinfo=datetime.timezone.utc)
+                # Spark semantics: naive timestamp literals are interpreted
+                # in the session timezone (spark.sql.session.timeZone)
+                from ..utils.tz import localize
+                v = localize(v)
             return int(v.timestamp() * 1_000_000)
         if isinstance(self.data_type, DecimalType):
             if self.data_type.physical_dtype == "int64":

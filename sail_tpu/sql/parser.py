@@ -800,7 +800,9 @@ class Parser:
             target = self.parse_data_type()
             self.expect_op(")")
             return ex.Cast(child, target, try_=(word == "TRY_CAST"))
-        if word == "EXISTS" and self.at_op("(", ahead=1):
+        if word == "EXISTS" and self.at_op("(", ahead=1) and (
+                self.at_kw("SELECT", "VALUES", "WITH", "FROM", ahead=2)
+                or self.at_op("(", ahead=2)):
             self.advance()
             self.expect_op("(")
             q = self.parse_query()
@@ -906,8 +908,21 @@ class Parser:
             self.expect_op(")")
             f = ex.Function(word.lower(), (child,), ignore_nulls=ignore_nulls)
             return self._maybe_window(f)
-        # function call or column reference
-        if self.at_op("(", ahead=1) and word not in _RESERVED_STOP:
+        if word == "POSITION" and self.at_op("(", ahead=1):
+            # POSITION(sub IN str) special form (plain calls also accepted)
+            mark = self.i
+            self.advance()
+            self.expect_op("(")
+            sub = self.parse_expr()
+            if self.accept_kw("IN"):
+                s = self.parse_expr()
+                self.expect_op(")")
+                return ex.Function("locate", (sub, s))
+            self.i = mark
+        # function call or column reference; LEFT/RIGHT are join keywords
+        # only after a relation — in expression position they're functions
+        if self.at_op("(", ahead=1) and (word not in _RESERVED_STOP or
+                                         word in ("LEFT", "RIGHT")):
             name = self.parse_identifier()
             return self.parse_function_call(name)
         # lambda: ident -> expr

@@ -1,0 +1,23 @@
+"""Spark/Java-style value formatting shared by CAST-to-string and the
+host function layer (reference role: the display formatter in
+crates/sail-common-datafusion/src/display.rs)."""
+
+from __future__ import annotations
+
+import math
+
+
+def format_double(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if v == int(v) and abs(v) < 1e16:
+        return f"{int(v)}.0"
+    r = repr(float(v))
+    if "e" in r:
+        m, _, e = r.partition("e")
+        if "." not in m:
+            m += ".0"
+        return f"{m}E{int(e)}"
+    return r

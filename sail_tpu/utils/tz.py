@@ -1,0 +1,39 @@
+"""Session timezone context (spark.sql.session.timeZone).
+
+Spark interprets naive timestamp literals, string→timestamp casts, and
+make_timestamp without an explicit zone in the SESSION timezone, and
+renders timestamps in it. The engine stores timestamps as UTC
+microseconds; this contextvar carries the session zone through literal
+resolution, host datetime functions, and display."""
+
+from __future__ import annotations
+
+import contextvars
+import datetime
+import zoneinfo
+
+_SESSION_TZ = contextvars.ContextVar("sail_session_tz", default="UTC")
+
+
+def set_session_timezone(tz: str):
+    return _SESSION_TZ.set(tz or "UTC")
+
+
+def reset_session_timezone(token):
+    _SESSION_TZ.reset(token)
+
+
+def session_timezone_name() -> str:
+    return _SESSION_TZ.get()
+
+
+def session_zone():
+    name = _SESSION_TZ.get()
+    if name.upper() == "UTC":
+        return datetime.timezone.utc
+    return zoneinfo.ZoneInfo(name)
+
+
+def localize(naive: datetime.datetime) -> datetime.datetime:
+    """Interpret a naive timestamp in the session zone → aware."""
+    return naive.replace(tzinfo=session_zone())

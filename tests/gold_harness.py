@@ -1,0 +1,165 @@
+"""Gold-data harness: runs the reference's Spark-generated function test
+corpus (read at test time as DATA from the reference checkout; see
+SURVEY.md §4 tier 2 — the JSON files are reusable expected outputs
+produced by real Spark).
+
+Each test is {query, result rows, schema}; a result row is the
+tab-joined Spark-formatted cells. We run the query through the engine and
+compare formatted output.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import glob
+import json
+import math
+import os
+from typing import Dict, List, Optional, Tuple
+
+GOLD_DIR = os.environ.get(
+    "SAIL_GOLD_DIR",
+    "/root/reference/crates/sail-spark-connect/tests/gold_data/function")
+
+
+def gold_available() -> bool:
+    return os.path.isdir(GOLD_DIR)
+
+
+def load_suites(names=None) -> Dict[str, List[dict]]:
+    out = {}
+    for path in sorted(glob.glob(os.path.join(GOLD_DIR, "*.json"))):
+        name = os.path.splitext(os.path.basename(path))[0]
+        if names is not None and name not in names:
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            out[name] = json.load(f)["tests"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Spark-style cell formatting
+# ---------------------------------------------------------------------------
+
+def format_cell(v, nested: bool = False) -> str:
+    if v is None:
+        return "NULL" if not nested else "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        return format_float(v)
+    if isinstance(v, decimal.Decimal):
+        return format(v, "f")
+    if isinstance(v, bytes):
+        # the gold corpus stores binary cells as lossy UTF-8 text
+        return v.decode("utf-8", errors="replace")
+    if isinstance(v, datetime.datetime):
+        if v.tzinfo is not None:
+            # the gold corpus was generated with
+            # spark.sql.session.timeZone=America/Los_Angeles
+            import zoneinfo
+            v = v.astimezone(zoneinfo.ZoneInfo("America/Los_Angeles"))
+        s = v.strftime("%Y-%m-%d %H:%M:%S")
+        if v.microsecond:
+            s += f".{v.microsecond:06d}".rstrip("0")
+        return s
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    if isinstance(v, datetime.timedelta):
+        return format_interval(v)
+    if isinstance(v, str):
+        return f'"{v}"' if nested else v
+    if isinstance(v, list) and v and all(
+            isinstance(x, tuple) and len(x) == 2 for x in v):
+        # arrow map columns come back as lists of (key, value) pairs
+        return "{" + ",".join(
+            f"{format_cell(k, nested=True)}:{format_cell(x, nested=True)}"
+            for k, x in v) + "}"
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(format_cell(x, nested=True) for x in v) + "]"
+    if isinstance(v, dict):
+        return "{" + ",".join(
+            f"\"{k}\":{format_cell(x, nested=True)}"
+            for k, x in v.items()) + "}"
+    return str(v)
+
+
+def format_float(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if v == int(v) and abs(v) < 1e16:
+        return f"{int(v)}.0"
+    r = repr(v)
+    if "e" in r or "E" in r:
+        # Spark/Java scientific form: 1.0E10
+        m, _, e = r.partition("e")
+        if "." not in m:
+            m += ".0"
+        ei = int(e)
+        return f"{m}E{ei}"
+    return r
+
+
+def format_interval(td: datetime.timedelta) -> str:
+    total_us = round(td.total_seconds() * 1e6)
+    sign = "-" if total_us < 0 else ""
+    total_us = abs(total_us)
+    days, rem = divmod(total_us, 86_400_000_000)
+    hours, rem = divmod(rem, 3_600_000_000)
+    minutes, rem = divmod(rem, 60_000_000)
+    secs = rem / 1e6
+    sec_str = f"{secs:.6f}".rstrip("0").rstrip(".")
+    return (f"{sign}INTERVAL '{days} {hours:02d}:{minutes:02d}:"
+            f"{sec_str if '.' in sec_str else f'{int(secs):02d}'}'"
+            " DAY TO SECOND")
+
+
+def run_one(spark, test: dict) -> Tuple[str, Optional[str]]:
+    """Returns (status, detail): status ∈ pass | mismatch | error."""
+    query = test["input"]["query"].rstrip().rstrip(";")
+    expected = test["input"].get("result")
+    try:
+        table = spark.sql(query).toArrow()
+    except Exception as e:  # noqa: BLE001 — harness categorizes every error
+        return "error", f"{type(e).__name__}: {e}"
+    if expected is None:
+        return "pass", None
+    rows = []
+    cols = [c.to_pylist() for c in table.columns]
+    for i in range(table.num_rows):
+        rows.append("\t".join(format_cell(col[i]) for col in cols))
+    exp = list(expected)
+    if rows == exp or sorted(rows) == sorted(exp):
+        return "pass", None  # row order is not part of the contract
+    # the corpus generator trims leading/trailing whitespace per cell
+    def strip_row(r):
+        return "\t".join(c.strip() for c in r.split("\t"))
+    if sorted(map(strip_row, rows)) == sorted(map(strip_row, exp)):
+        return "pass", None
+    return "mismatch", f"got {rows[:3]!r} want {exp[:3]!r}"
+
+
+def run_suites(spark_factory, names=None, collect_failures: bool = False):
+    """Returns {suite: {pass, mismatch, error, total, ref_ok}}."""
+    results = {}
+    failures = []
+    for name, tests in load_suites(names).items():
+        st = {"pass": 0, "mismatch": 0, "error": 0, "total": len(tests),
+              "ref_ok": sum(1 for t in tests
+                            if t.get("output", {}).get("success") == "ok")}
+        spark = spark_factory()
+        # the corpus was generated with this session timezone
+        spark.conf.set("spark.sql.session.timeZone", "America/Los_Angeles")
+        for i, t in enumerate(tests):
+            status, detail = run_one(spark, t)
+            st[status] += 1
+            if collect_failures and status != "pass":
+                failures.append((name, i, status,
+                                 t["input"]["query"][:90], detail))
+        results[name] = st
+    if collect_failures:
+        return results, failures
+    return results
