@@ -1,0 +1,128 @@
+"""`system` catalog: engine introspection as SQL-queryable tables.
+
+Reference role: crates/sail-catalog-system/src/service.rs:37-124 —
+system.session.sessions, system.execution.{jobs,stages,tasks},
+system.cluster.workers, fed from live runtime state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class SystemRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sessions: Dict[str, dict] = {}
+        self.jobs: Dict[str, dict] = {}
+        self.tasks: List[dict] = []
+        self.workers: Dict[str, dict] = {}
+
+    # -- recorders (called by session manager / driver) ------------------
+    def record_session(self, session_id: str):
+        with self._lock:
+            now = time.time()
+            s = self.sessions.setdefault(
+                session_id, {"session_id": session_id, "start_time": now,
+                             "queries": 0})
+            s["last_access"] = now
+            s["queries"] += 1
+
+    def end_session(self, session_id: str):
+        with self._lock:
+            self.sessions.pop(session_id, None)
+
+    def record_job(self, job_id: str, stages: int, status: str,
+                   rows_by_stage: Optional[Dict[int, int]] = None):
+        with self._lock:
+            self.jobs[job_id] = {
+                "job_id": job_id, "stages": stages, "status": status,
+                "updated": time.time(),
+                "rows_by_stage": dict(rows_by_stage or {})}
+
+    def record_task(self, job_id: str, stage: int, partition: int,
+                    attempt: int, status: str, worker_id: str,
+                    rows_out: int = 0):
+        with self._lock:
+            self.tasks.append({
+                "job_id": job_id, "stage": stage, "partition": partition,
+                "attempt": attempt, "status": status,
+                "worker_id": worker_id, "rows_out": rows_out,
+                "time": time.time()})
+            del self.tasks[:-10_000]
+
+    def record_worker(self, worker_id: str, addr: str, slots: int,
+                      status: str):
+        with self._lock:
+            self.workers[worker_id] = {
+                "worker_id": worker_id, "addr": addr, "slots": slots,
+                "status": status, "updated": time.time()}
+
+    # -- table surface ---------------------------------------------------
+    def table(self, database: str, name: str):
+        import pyarrow as pa
+
+        with self._lock:
+            if (database, name) == ("session", "sessions"):
+                rows = list(self.sessions.values())
+                return pa.table({
+                    "session_id": pa.array([r["session_id"] for r in rows]),
+                    "start_time": pa.array(
+                        [r["start_time"] for r in rows], pa.float64()),
+                    "last_access": pa.array(
+                        [r.get("last_access") for r in rows], pa.float64()),
+                    "queries": pa.array(
+                        [r["queries"] for r in rows], pa.int64()),
+                })
+            if (database, name) == ("execution", "jobs"):
+                rows = list(self.jobs.values())
+                return pa.table({
+                    "job_id": pa.array([r["job_id"] for r in rows]),
+                    "stages": pa.array([r["stages"] for r in rows],
+                                       pa.int32()),
+                    "status": pa.array([r["status"] for r in rows]),
+                    "updated": pa.array([r["updated"] for r in rows],
+                                        pa.float64()),
+                })
+            if (database, name) == ("execution", "stages"):
+                rows = []
+                for j in self.jobs.values():
+                    for sid, n in j.get("rows_by_stage", {}).items():
+                        rows.append((j["job_id"], int(sid), int(n)))
+                return pa.table({
+                    "job_id": pa.array([r[0] for r in rows]),
+                    "stage_id": pa.array([r[1] for r in rows], pa.int32()),
+                    "rows_out": pa.array([r[2] for r in rows], pa.int64()),
+                })
+            if (database, name) == ("execution", "tasks"):
+                rows = list(self.tasks)
+                return pa.table({
+                    "job_id": pa.array([r["job_id"] for r in rows]),
+                    "stage": pa.array([r["stage"] for r in rows],
+                                      pa.int32()),
+                    "partition": pa.array([r["partition"] for r in rows],
+                                          pa.int32()),
+                    "attempt": pa.array([r["attempt"] for r in rows],
+                                        pa.int32()),
+                    "status": pa.array([r["status"] for r in rows]),
+                    "worker_id": pa.array([r["worker_id"] for r in rows]),
+                    "rows_out": pa.array([r["rows_out"] for r in rows],
+                                         pa.int64()),
+                })
+            if (database, name) == ("cluster", "workers"):
+                rows = list(self.workers.values())
+                return pa.table({
+                    "worker_id": pa.array([r["worker_id"] for r in rows]),
+                    "addr": pa.array([r["addr"] for r in rows]),
+                    "slots": pa.array([r["slots"] for r in rows],
+                                      pa.int32()),
+                    "status": pa.array([r["status"] for r in rows]),
+                    "updated": pa.array([r["updated"] for r in rows],
+                                        pa.float64()),
+                })
+        raise KeyError(f"unknown system table system.{database}.{name}")
+
+
+SYSTEM = SystemRegistry()

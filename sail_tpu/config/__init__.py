@@ -1,0 +1,52 @@
+"""Application config: YAML defaults ⊕ SAIL_* environment layering.
+
+Reference role: crates/sail-common/src/config/ (AppConfig from
+application.yaml via figment, env layering with SAIL_ prefix and __
+nesting — e.g. SAIL_CLUSTER__DRIVER_LISTEN_PORT).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+
+_DEFAULTS: Optional[Dict[str, Any]] = None
+
+
+def _load_defaults() -> Dict[str, Any]:
+    global _DEFAULTS
+    if _DEFAULTS is None:
+        import yaml
+        path = os.path.join(os.path.dirname(__file__), "application.yaml")
+        with open(path, "r", encoding="utf-8") as f:
+            _DEFAULTS = yaml.safe_load(f) or {}
+    return _DEFAULTS
+
+
+def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def app_config() -> Dict[str, Any]:
+    """Flattened config: YAML defaults overridden by SAIL_* env vars
+    (double underscore nests: SAIL_CLUSTER__TASK_MAX_ATTEMPTS=5 →
+    cluster.task_max_attempts)."""
+    conf = _flatten(_load_defaults())
+    for name, value in os.environ.items():
+        if not name.startswith("SAIL_"):
+            continue
+        key = name[len("SAIL_"):].lower().replace("__", ".")
+        conf[key] = value
+    return conf
+
+
+def get(key: str, default: Any = None) -> Any:
+    return app_config().get(key, default)

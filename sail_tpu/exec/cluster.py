@@ -439,6 +439,9 @@ class DriverActor(Actor):
         kind, payload = message
         if kind == "register":
             r: pb.RegisterWorkerRequest = payload
+            from ..catalog.system import SYSTEM
+            SYSTEM.record_worker(r.worker_id, f"{r.host}:{r.port}",
+                                 r.task_slots, "alive")
             self.workers[r.worker_id] = {
                 "addr": f"{r.host}:{r.port}", "slots": r.task_slots,
                 "last_seen": time.time(),
@@ -454,6 +457,8 @@ class DriverActor(Actor):
         elif kind == "submit":
             job, reply = payload
             self.jobs[job.job_id] = job
+            from ..catalog.system import SYSTEM
+            SYSTEM.record_job(job.job_id, len(job.graph.stages), "running")
             self._schedule_ready_stages(job)
             if reply is not None:
                 reply.set(job)
@@ -557,6 +562,9 @@ class DriverActor(Actor):
             self._launch_task(job, stage_id, partition, attempt)
 
     def _on_task_status(self, r: pb.ReportTaskStatusRequest):
+        from ..catalog.system import SYSTEM
+        SYSTEM.record_task(r.job_id, r.stage, r.partition, r.attempt,
+                           r.state, r.worker_id, int(r.rows_out))
         job = self.jobs.get(r.job_id)
         if job is None or job.done.is_set():
             return
@@ -607,6 +615,12 @@ class DriverActor(Actor):
                               self.attempt_of(job, stage_id, partition))
 
     def _cleanup_job(self, job_id: str):
+        job = self.jobs.get(job_id)
+        if job is not None:
+            from ..catalog.system import SYSTEM
+            SYSTEM.record_job(job_id, len(job.graph.stages),
+                              "failed" if job.failed else "finished",
+                              job.stage_rows)
         self.jobs.pop(job_id, None)
         for w in self.workers.values():
             rpc = w["channel"].unary_unary(

@@ -16,6 +16,7 @@ Host↔device sync points (kept deliberately few):
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -37,6 +38,29 @@ from ..spec.literal import Literal as LV
 
 class ExecutionError(RuntimeError):
     pass
+
+
+def _replace_node(plan: pn.PlanNode, target: pn.PlanNode,
+                  replacement: pn.PlanNode) -> pn.PlanNode:
+    if plan is target:
+        return replacement
+    if isinstance(plan, pn.JoinExec):
+        return dataclasses.replace(
+            plan, left=_replace_node(plan.left, target, replacement),
+            right=_replace_node(plan.right, target, replacement))
+    if isinstance(plan, pn.UnionExec):
+        return dataclasses.replace(plan, inputs=tuple(
+            _replace_node(c, target, replacement) for c in plan.inputs))
+    if hasattr(plan, "input") and plan.input is not None:
+        return dataclasses.replace(
+            plan, input=_replace_node(plan.input, target, replacement))
+    return plan
+
+
+def _empty_arrow(schema) -> "pa.Table":
+    return pa.Table.from_arrays(
+        [pa.array([], type=ai.spec_type_to_arrow(f.dtype)) for f in schema],
+        names=[f.name for f in schema])
 
 
 def _hashable(v):
@@ -300,7 +324,7 @@ class LocalExecutor:
                 mtimes = tuple(int(os.path.getmtime(f) * 1e6) for f in files)
             except OSError:
                 files, mtimes = p.paths, ()
-            cache_key = ("file", files, mtimes, p.projection,
+            cache_key = ("file", files, mtimes, p.projection, p.predicates,
                          tuple(sorted(dict(p.options).items())),
                          tuple((f.name, f.dtype) for f in p.schema))
         hit = _SCAN_CACHE.get(cache_key)
@@ -313,8 +337,13 @@ class LocalExecutor:
             if p.projection is not None:
                 table = table.select(list(p.projection))
         else:
+            filter_expr = None
+            if p.predicates and p.format == "parquet":
+                from ..io.formats import rex_predicates_to_arrow
+                filter_expr = rex_predicates_to_arrow(p.predicates, p.schema)
             table = read_table(p.format, p.paths, dict(p.options),
-                               columns=p.projection)
+                               columns=p.projection,
+                               filter_expr=filter_expr)
             table = self._apply_declared_schema(table, p.schema)
         hb = _positional(ai.from_arrow(table))
         while len(_SCAN_CACHE) > 64:
@@ -497,7 +526,8 @@ class LocalExecutor:
             if isinstance(v, bool):
                 return "true" if v else "false"
             if isinstance(v, float):
-                return repr(v)
+                from ..utils.format import format_double
+                return format_double(v)
             if isinstance(v, _dtm.datetime):
                 if v.tzinfo is not None:
                     from ..utils.tz import session_zone
@@ -679,6 +709,9 @@ class LocalExecutor:
         from .. import telemetry as tel
         if any(a.fn.startswith("__host__") for a in p.aggs):
             return self._host_aggregate(p, self.run(p.input))
+        chunked = self._try_chunked_aggregate(p)
+        if chunked is not None:
+            return chunked
         if tel.current_collector() is not None:
             chain, child, bottom_node = [], self.run(p.input), p.input
         else:
@@ -786,6 +819,81 @@ class LocalExecutor:
         out = DeviceBatch(out_cols, gsel)
         out = _shrink(out, int(n_groups))
         return HostBatch(out, out_dicts)
+
+    # out-of-core: aggregates over big parquet scans stream chunk-wise
+    # through the fused partial-agg program, so a table never needs to fit
+    # in HBM whole (reference role: DataFusion memory pools + morsel scan;
+    # TPU shape: fixed-capacity chunks re-use ONE compiled XLA program)
+    _CHUNK_MERGE = {"sum": "sum", "count": "sum", "min": "min",
+                    "max": "max", "first": "first", "last": "last",
+                    "bool_and": "bool_and", "bool_or": "bool_or"}
+
+    def _try_chunked_aggregate(self, p: pn.AggregateExec
+                               ) -> Optional[HostBatch]:
+        import pyarrow.dataset as pads
+        from ..io.formats import expand_paths, rex_predicates_to_arrow
+
+        if any(a.distinct or a.fn not in self._CHUNK_MERGE or
+               a.filter is not None for a in p.aggs):
+            return None
+        # find the chain bottom scan
+        node = p.input
+        while isinstance(node, (pn.FilterExec, pn.ProjectExec)):
+            node = node.input
+        if not (isinstance(node, pn.ScanExec) and node.paths
+                and node.format == "parquet"):
+            return None
+        chunk_rows = int(self.config.get("spark.sail.scan.chunkRows", 0) or 0)
+        try:
+            files = expand_paths(node.paths)
+            total_bytes = sum(os.path.getsize(f) for f in files)
+        except OSError:
+            return None
+        if chunk_rows <= 0:
+            if total_bytes < 1 << 30:
+                return None  # small scans take the resident path
+            chunk_rows = 8_000_000
+        filter_expr = None
+        if node.predicates:
+            filter_expr = rex_predicates_to_arrow(node.predicates,
+                                                  node.schema)
+        ds = pads.dataset(files, format="parquet")
+        scanner = ds.scanner(
+            columns=list(node.projection) if node.projection else None,
+            filter=filter_expr, batch_size=chunk_rows)
+        partials = []
+        chunk_cap = None
+        for batch in scanner.to_batches():
+            if batch.num_rows == 0:
+                continue
+            table = pa.Table.from_batches([batch])
+            table = self._apply_declared_schema(table, node.schema)
+            chunk_scan = pn.ScanExec(node.out_schema, table, (), "memory",
+                                     projection=node.projection)
+            chunk_plan = _replace_node(p, node, chunk_scan)
+            partials.append(ai.to_arrow(self.run(chunk_plan)))
+            # drop the scan cache entry so chunks don't accumulate in HBM
+            for key in [k for k in _SCAN_CACHE
+                        if k[0] == "mem" and k[1] == id(table)]:
+                _SCAN_CACHE.pop(key, None)
+        nk = len(p.group_indices)
+        if not partials:
+            empty_scan = pn.ScanExec(node.out_schema,
+                                     _empty_arrow(node.schema), (),
+                                     "memory", projection=node.projection)
+            return self.run(_replace_node(p, node, empty_scan))
+        merged = pa.concat_tables(partials, promote_options="permissive")
+        final_aggs = tuple(
+            pn.AggSpec(self._CHUNK_MERGE[a.fn], nk + j, False, a.out_dtype,
+                       None, a.ignore_nulls)
+            for j, a in enumerate(p.aggs))
+        part_schema = tuple(
+            pn.Field(f"p{i}", f.dtype, True)
+            for i, f in enumerate(p.schema))
+        final = pn.AggregateExec(
+            pn.ScanExec(part_schema, merged, (), "memory"),
+            tuple(range(nk)), final_aggs, p.out_names, p.max_groups_hint)
+        return self.run(final)
 
     def _host_aggregate(self, p: pn.AggregateExec, child: HostBatch
                         ) -> HostBatch:

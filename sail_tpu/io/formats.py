@@ -25,6 +25,10 @@ from ..spec import data_type as dt
 def expand_paths(paths: Sequence[str]) -> List[str]:
     out: List[str] = []
     for p in paths:
+        from .object_store import has_remote_scheme
+        if has_remote_scheme(p):
+            out.append(p)  # remote stores list lazily via their filesystem
+            continue
         if any(ch in p for ch in "*?["):
             out.extend(sorted(globmod.glob(p)))
         elif os.path.isdir(p):
@@ -42,6 +46,9 @@ def infer_schema(fmt: str, paths: Sequence[str], options: Dict[str, str]) -> dt.
         from ..lakehouse.delta import DeltaTable
         return DeltaTable(paths[0]).snapshot(
             *_delta_travel(options)).schema
+    if fmt.lower() == "iceberg":
+        from ..lakehouse.iceberg import IcebergTable
+        return IcebergTable(paths[0]).schema()
     files = expand_paths(paths)
     if not files:
         raise FileNotFoundError(f"no files found for {paths}")
@@ -65,21 +72,89 @@ def _delta_travel(options: Dict[str, str]):
     return (int(version) if version is not None else None), ts_ms
 
 
+def rex_predicates_to_arrow(predicates, schema) -> Optional["pads.Expression"]:
+    """Scan predicates (col-vs-literal conjuncts) → a pyarrow dataset
+    filter for parquet row-group/fragment pruning. Returns None when any
+    conjunct fails to convert (pruning is best-effort; the exact filter
+    runs above the scan)."""
+    from ..plan import rex as rx
+
+    def field(r):
+        return pads.field(schema[r.index].name)
+
+    def lit(r):
+        return r.value.value
+
+    out = None
+    for c in predicates:
+        try:
+            if c.fn in ("==", "!=", "<", "<=", ">", ">="):
+                a, b = c.args
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                op = c.fn
+                if isinstance(a, rx.RLit):
+                    a, b = b, a
+                    op = flip.get(op, op)
+                fa, vb = field(a), lit(b)
+                expr = {"==": fa == vb, "!=": fa != vb, "<": fa < vb,
+                        "<=": fa <= vb, ">": fa > vb, ">=": fa >= vb}[op]
+            elif c.fn == "isnull":
+                expr = field(c.args[0]).is_null()
+            elif c.fn == "isnotnull":
+                expr = ~field(c.args[0]).is_null()
+            elif c.fn == "in":
+                expr = field(c.args[0]).isin([lit(a) for a in c.args[1:]])
+            else:
+                return None
+        except Exception:  # noqa: BLE001 — pruning is best-effort
+            return None
+        out = expr if out is None else out & expr
+    return out
+
+
 def read_table(fmt: str, paths: Sequence[str], options: Dict[str, str],
                columns: Optional[Sequence[str]] = None,
-               limit: Optional[int] = None) -> pa.Table:
+               limit: Optional[int] = None,
+               filter_expr=None) -> pa.Table:
     fmt = fmt.lower()
     if fmt == "delta":
         from ..lakehouse.delta import DeltaTable
         version, ts_ms = _delta_travel(options)
         return DeltaTable(paths[0]).to_arrow(version, ts_ms,
                                              columns=columns)
+    if fmt == "iceberg":
+        from ..lakehouse.iceberg import IcebergTable
+        opts = {k.lower(): v for k, v in options.items()}
+        sid = opts.get("snapshot-id", opts.get("snapshotid"))
+        ts = opts.get("as-of-timestamp", opts.get("asoftimestamp"))
+        return IcebergTable(paths[0]).to_arrow(
+            int(sid) if sid is not None else None,
+            int(ts) if ts is not None else None, columns=columns)
     files = expand_paths(paths)
+    from .object_store import has_remote_scheme, resolve_filesystem
+    if fmt == "parquet" and files and has_remote_scheme(files[0]):
+        fsys, rel = resolve_filesystem(files[0], options)
+        rels = [resolve_filesystem(f, options)[1] for f in files]
+        ds = pads.dataset(rels, format="parquet", filesystem=fsys)
+        table = ds.to_table(columns=list(columns) if columns else None,
+                            filter=filter_expr)
+        if limit is not None:
+            table = table.slice(0, limit)
+        return table
     if fmt == "parquet":
-        tables = [pq.read_table(f, columns=list(columns) if columns else None)
-                  for f in files]
-        table = pa.concat_tables(tables, promote_options="permissive") \
-            if len(tables) > 1 else tables[0]
+        if filter_expr is not None:
+            # dataset scan: parquet row-group + fragment pruning on
+            # statistics before any decode
+            ds = pads.dataset(files, format="parquet")
+            table = ds.to_table(columns=list(columns) if columns else None,
+                                filter=filter_expr)
+        else:
+            tables = [pq.read_table(f,
+                                    columns=list(columns) if columns
+                                    else None)
+                      for f in files]
+            table = pa.concat_tables(tables, promote_options="permissive") \
+                if len(tables) > 1 else tables[0]
     elif fmt == "csv":
         header = options.get("header", "false").lower() in ("true", "1")
         delim = options.get("sep", options.get("delimiter", ","))
@@ -127,6 +202,30 @@ def write_table(table: pa.Table, fmt: str, path: str, mode: str = "error",
                 partition_by: Sequence[str] = ()):
     options = options or {}
     fmt = fmt.lower()
+    if fmt == "iceberg":
+        from ..lakehouse.iceberg import IcebergTable
+        t = IcebergTable(path)
+        if not IcebergTable.exists(path):
+            nonempty = os.path.isdir(path) and os.listdir(path)
+            if nonempty and mode == "error":
+                raise FileExistsError(
+                    f"path exists and is not an Iceberg table: {path}")
+            if nonempty and mode == "ignore":
+                return
+            if nonempty and mode == "append":
+                raise FileNotFoundError(
+                    f"cannot append: not an Iceberg table: {path}")
+            t.create(table, partition_by)
+            return
+        if mode == "error":
+            raise FileExistsError(f"Iceberg table already exists: {path}")
+        if mode == "ignore":
+            return
+        if mode == "append":
+            t.append(table)
+        else:
+            t.overwrite(table)
+        return
     if fmt == "delta":
         from ..lakehouse.delta import DeltaTable
         t = DeltaTable(path)

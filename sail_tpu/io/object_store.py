@@ -1,0 +1,101 @@
+"""Object store registry: scheme → pyarrow filesystem.
+
+Reference role: crates/sail-object-store/src/registry.rs:24-50 — a
+dynamic registry creating stores per (scheme, authority, session
+credentials). Credentials come from session/read options using the
+Spark/Hadoop key names (fs.s3a.access.key, …).
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from typing import Dict, Optional, Tuple
+
+
+def split_uri(path: str) -> Tuple[str, str, str]:
+    """→ (scheme, authority, path). Plain paths have scheme ''."""
+    if "://" not in path:
+        return "", "", path
+    u = urllib.parse.urlparse(path)
+    return u.scheme.lower(), u.netloc, u.path.lstrip("/")
+
+
+def has_remote_scheme(path: str) -> bool:
+    scheme = split_uri(path)[0]
+    return scheme not in ("", "file")
+
+
+_FS_CACHE: Dict[tuple, object] = {}
+
+
+def resolve_filesystem(path: str, options: Optional[Dict[str, str]] = None):
+    """→ (pyarrow FileSystem, fs-relative path). Local paths pass through
+    with filesystem None (the plain os/pq fast path)."""
+    from pyarrow import fs as pafs
+
+    options = {k.lower(): v for k, v in (options or {}).items()}
+    scheme, authority, rel = split_uri(path)
+    if scheme in ("", "file"):
+        return None, path if scheme == "" else "/" + rel
+
+    def opt(*names, default=None):
+        for n in names:
+            v = options.get(n.lower())
+            if v is not None:
+                return v
+        return default
+
+    cache_key = (scheme, authority,
+                 tuple(sorted((k, v) for k, v in options.items()
+                              if k.startswith(("fs.", "gcs.", "azure.")))))
+    fsys = _FS_CACHE.get(cache_key)
+    if fsys is None:
+        if scheme in ("s3", "s3a", "s3n"):
+            kwargs = {}
+            ak = opt("fs.s3a.access.key", "spark.hadoop.fs.s3a.access.key")
+            sk = opt("fs.s3a.secret.key", "spark.hadoop.fs.s3a.secret.key")
+            endpoint = opt("fs.s3a.endpoint",
+                           "spark.hadoop.fs.s3a.endpoint")
+            region = opt("fs.s3a.region", "spark.hadoop.fs.s3a.region")
+            if ak:
+                kwargs["access_key"] = ak
+            if sk:
+                kwargs["secret_key"] = sk
+            if endpoint:
+                kwargs["endpoint_override"] = endpoint
+            if region:
+                kwargs["region"] = region
+            if opt("fs.s3a.anonymous") == "true":
+                kwargs["anonymous"] = True
+            fsys = pafs.S3FileSystem(**kwargs)
+        elif scheme in ("gs", "gcs"):
+            kwargs = {}
+            if opt("gcs.anonymous") == "true":
+                kwargs["anonymous"] = True
+            fsys = pafs.GcsFileSystem(**kwargs)
+        elif scheme in ("abfs", "abfss", "wasb", "wasbs"):
+            fsys = pafs.AzureFileSystem(
+                account_name=opt("azure.account.name") or
+                authority.split("@")[-1].split(".")[0])
+        elif scheme == "hdfs":
+            fsys = pafs.HadoopFileSystem.from_uri(path)
+        elif scheme == "mock":
+            # in-process filesystem for tests
+            fsys = _mock_fs()
+        else:
+            raise ValueError(f"unsupported filesystem scheme {scheme!r}")
+        _FS_CACHE[cache_key] = fsys
+    if scheme == "hdfs":
+        return fsys, rel
+    return fsys, f"{authority}/{rel}" if authority else rel
+
+
+_MOCK = None
+
+
+def _mock_fs():
+    global _MOCK
+    if _MOCK is None:
+        from pyarrow import fs as pafs
+        _MOCK = pafs._MockFileSystem()
+    return _MOCK

@@ -1,0 +1,1 @@
+from .table import IcebergTable  # noqa: F401

@@ -1,0 +1,259 @@
+"""Minimal Avro object-container-file codec.
+
+Reference role: crates/sail-iceberg/src/io/ (Avro manifest IO, written
+from scratch there too — no avro library ships in this environment). This
+implements the Avro 1.x binary encoding subset Iceberg manifests use:
+records, nullable unions ["null", T], string/bytes/int/long/boolean/
+double, arrays, and maps; null codec (no compression).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+from typing import Any, Dict, List, Optional
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# primitive codecs
+# ---------------------------------------------------------------------------
+
+def _zigzag_encode(n: int) -> bytes:
+    n = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _zigzag_decode(buf: io.BytesIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        v = b[0]
+        acc |= (v & 0x7F) << shift
+        if not (v & 0x80):
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)
+
+
+def _write_bytes(out: bytearray, b: bytes):
+    out += _zigzag_encode(len(b))
+    out += b
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _zigzag_decode(buf)
+    return buf.read(n)
+
+
+# ---------------------------------------------------------------------------
+# schema-driven encode/decode
+# ---------------------------------------------------------------------------
+
+def _branch_index(schema_union: List, value) -> int:
+    for i, br in enumerate(schema_union):
+        t = br["type"] if isinstance(br, dict) and "type" in br and \
+            not isinstance(br.get("type"), dict) else br
+        if value is None and t == "null":
+            return i
+        if value is not None and t != "null":
+            return i
+    return 0
+
+
+def encode_value(out: bytearray, schema, value):
+    if isinstance(schema, list):  # union
+        idx = _branch_index(schema, value)
+        out += _zigzag_encode(idx)
+        encode_value(out, schema[idx], value)
+        return
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                encode_value(out, f["type"], value.get(f["name"])
+                             if value else None)
+            return
+        if t == "array":
+            items = value or []
+            if items:
+                out += _zigzag_encode(len(items))
+                for it in items:
+                    encode_value(out, schema["items"], it)
+            out += _zigzag_encode(0)
+            return
+        if t == "map":
+            entries = value or {}
+            if entries:
+                out += _zigzag_encode(len(entries))
+                for k, v in entries.items():
+                    _write_bytes(out, str(k).encode())
+                    encode_value(out, schema["values"], v)
+            out += _zigzag_encode(0)
+            return
+        if t == "fixed":
+            out += value
+            return
+        encode_value(out, t, value)
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out.append(1 if value else 0)
+        return
+    if schema in ("int", "long"):
+        out += _zigzag_encode(int(value))
+        return
+    if schema == "float":
+        out += struct.pack("<f", float(value))
+        return
+    if schema == "double":
+        out += struct.pack("<d", float(value))
+        return
+    if schema == "string":
+        _write_bytes(out, str(value).encode())
+        return
+    if schema == "bytes":
+        _write_bytes(out, bytes(value))
+        return
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+def decode_value(buf: io.BytesIO, schema):
+    if isinstance(schema, list):
+        idx = _zigzag_decode(buf)
+        return decode_value(buf, schema[idx])
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            return {f["name"]: decode_value(buf, f["type"])
+                    for f in schema["fields"]}
+        if t == "array":
+            out = []
+            while True:
+                n = _zigzag_decode(buf)
+                if n == 0:
+                    break
+                if n < 0:
+                    _zigzag_decode(buf)  # block byte size
+                    n = -n
+                for _ in range(n):
+                    out.append(decode_value(buf, schema["items"]))
+            return out
+        if t == "map":
+            out = {}
+            while True:
+                n = _zigzag_decode(buf)
+                if n == 0:
+                    break
+                if n < 0:
+                    _zigzag_decode(buf)
+                    n = -n
+                for _ in range(n):
+                    k = _read_bytes(buf).decode()
+                    out[k] = decode_value(buf, schema["values"])
+            return out
+        if t == "fixed":
+            return buf.read(schema["size"])
+        return decode_value(buf, t)
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return buf.read(1) == b"\x01"
+    if schema in ("int", "long"):
+        return _zigzag_decode(buf)
+    if schema == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if schema == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if schema == "string":
+        return _read_bytes(buf).decode()
+    if schema == "bytes":
+        return _read_bytes(buf)
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+# ---------------------------------------------------------------------------
+# object container files
+# ---------------------------------------------------------------------------
+
+def write_container(path: str, schema: dict, records: List[dict],
+                    metadata: Optional[Dict[str, bytes]] = None):
+    sync = os.urandom(16)
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": b"null"}
+    for k, v in (metadata or {}).items():
+        meta[k] = v if isinstance(v, bytes) else str(v).encode()
+    out = bytearray()
+    out += MAGIC
+    out += _zigzag_encode(len(meta))
+    for k, v in meta.items():
+        _write_bytes(out, k.encode())
+        _write_bytes(out, v)
+    out += _zigzag_encode(0)
+    out += sync
+    block = bytearray()
+    for r in records:
+        encode_value(block, schema, r)
+    out += _zigzag_encode(len(records))
+    out += _zigzag_encode(len(block))
+    out += block
+    out += sync
+    with open(path, "wb") as f:
+        f.write(out)
+
+
+def read_container(path: str):
+    """Returns (records, metadata)."""
+    with open(path, "rb") as f:
+        buf = io.BytesIO(f.read())
+    if buf.read(4) != MAGIC:
+        raise ValueError(f"not an avro container file: {path}")
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = _zigzag_decode(buf)
+        if n == 0:
+            break
+        if n < 0:
+            _zigzag_decode(buf)
+            n = -n
+        for _ in range(n):
+            k = _read_bytes(buf).decode()
+            meta[k] = _read_bytes(buf)
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null")
+    sync = buf.read(16)
+    records = []
+    while True:
+        head = buf.read(1)
+        if not head:
+            break
+        buf.seek(-1, 1)
+        count = _zigzag_decode(buf)
+        size = _zigzag_decode(buf)
+        blob = buf.read(size)
+        if codec == b"deflate":
+            import zlib
+            blob = zlib.decompress(blob, -15)
+        elif codec not in (b"null", b""):
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        bbuf = io.BytesIO(blob)
+        for _ in range(count):
+            records.append(decode_value(bbuf, schema))
+        if buf.read(16) != sync:
+            raise ValueError("avro sync marker mismatch")
+    return records, meta

@@ -1,0 +1,364 @@
+"""Apache Iceberg tables — metadata, manifests, snapshots, from scratch.
+
+Reference role: crates/sail-iceberg (src/spec table metadata/manifests/
+snapshots, src/operations append/overwrite, src/table_format.rs), built
+against the public Iceberg table spec v2 with the Hadoop-style file
+layout: `metadata/vN.metadata.json` + `version-hint.text`, Avro manifest
+lists and manifests (see avro_io), parquet data files. Commits use atomic
+create-if-absent of the next metadata version (optimistic concurrency,
+like the Delta implementation).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import avro_io
+
+_MANIFEST_ENTRY_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"], "default": None},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "partition", "type": {
+                    "type": "map", "values": ["null", "string"]}},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+            ]}},
+    ]}
+
+_MANIFEST_FILE_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+        {"name": "content", "type": "int"},
+        {"name": "sequence_number", "type": "long"},
+        {"name": "added_snapshot_id", "type": "long"},
+        {"name": "added_files_count", "type": "int"},
+        {"name": "existing_files_count", "type": "int"},
+        {"name": "deleted_files_count", "type": "int"},
+        {"name": "added_rows_count", "type": "long"},
+    ]}
+
+
+class IcebergConflict(Exception):
+    pass
+
+
+def _spec_to_iceberg_schema(st) -> dict:
+    from ...spec import data_type as dt
+
+    next_id = [0]
+
+    def fid():
+        next_id[0] += 1
+        return next_id[0]
+
+    def conv(t):
+        if isinstance(t, dt.StructType):
+            return {"type": "struct", "fields": [
+                {"id": fid(), "name": f.name, "required": not f.nullable,
+                 "type": conv(f.data_type)} for f in t.fields]}
+        if isinstance(t, dt.ArrayType):
+            return {"type": "list", "element-id": fid(),
+                    "element": conv(t.element_type),
+                    "element-required": not t.contains_null}
+        if isinstance(t, dt.MapType):
+            return {"type": "map", "key-id": fid(), "key": conv(t.key_type),
+                    "value-id": fid(), "value": conv(t.value_type),
+                    "value-required": not t.value_contains_null}
+        m = {dt.BooleanType: "boolean", dt.IntegerType: "int",
+             dt.ByteType: "int", dt.ShortType: "int", dt.LongType: "long",
+             dt.FloatType: "float", dt.DoubleType: "double",
+             dt.StringType: "string", dt.BinaryType: "binary",
+             dt.DateType: "date"}
+        for cls, name in m.items():
+            if isinstance(t, cls):
+                return name
+        if isinstance(t, dt.DecimalType):
+            return f"decimal({t.precision}, {t.scale})"
+        if isinstance(t, dt.TimestampType):
+            return "timestamptz" if t.timezone is not None else "timestamp"
+        raise ValueError(f"cannot map type {t!r} to iceberg")
+
+    out = conv(st)
+    out["schema-id"] = 0
+    return out
+
+
+def _iceberg_type_to_spec(t):
+    from ...spec import data_type as dt
+
+    if isinstance(t, dict):
+        if t["type"] == "struct":
+            return dt.StructType(tuple(
+                dt.StructField(f["name"], _iceberg_type_to_spec(f["type"]),
+                               not f.get("required", False))
+                for f in t["fields"]))
+        if t["type"] == "list":
+            return dt.ArrayType(_iceberg_type_to_spec(t["element"]),
+                                not t.get("element-required", False))
+        if t["type"] == "map":
+            return dt.MapType(_iceberg_type_to_spec(t["key"]),
+                              _iceberg_type_to_spec(t["value"]),
+                              not t.get("value-required", False))
+        raise ValueError(f"unknown iceberg type {t}")
+    m = {"boolean": dt.BooleanType(), "int": dt.IntegerType(),
+         "long": dt.LongType(), "float": dt.FloatType(),
+         "double": dt.DoubleType(), "string": dt.StringType(),
+         "binary": dt.BinaryType(), "date": dt.DateType(),
+         "timestamp": dt.TimestampType(None),
+         "timestamptz": dt.TimestampType("UTC"), "uuid": dt.StringType()}
+    if t in m:
+        return m[t]
+    if t.startswith("decimal"):
+        p, s = t[t.index("(") + 1:t.index(")")].split(",")
+        return dt.DecimalType(int(p), int(s))
+    raise ValueError(f"unknown iceberg type {t!r}")
+
+
+class IcebergTable:
+    def __init__(self, path: str):
+        self.path = path
+        self.metadata_dir = os.path.join(path, "metadata")
+
+    # -- metadata --------------------------------------------------------
+    @staticmethod
+    def exists(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "metadata",
+                                           "version-hint.text"))
+
+    def _current_version(self) -> Optional[int]:
+        hint = os.path.join(self.metadata_dir, "version-hint.text")
+        if not os.path.exists(hint):
+            return None
+        with open(hint) as f:
+            return int(f.read().strip())
+
+    def _metadata_path(self, version: int) -> str:
+        return os.path.join(self.metadata_dir, f"v{version}.metadata.json")
+
+    def metadata(self, version: Optional[int] = None) -> dict:
+        v = version if version is not None else self._current_version()
+        if v is None:
+            raise FileNotFoundError(f"not an Iceberg table: {self.path}")
+        with open(self._metadata_path(v)) as f:
+            return json.load(f)
+
+    def schema(self, version: Optional[int] = None):
+        md = self.metadata(version)
+        sid = md.get("current-schema-id", 0)
+        for s in md.get("schemas", []):
+            if s.get("schema-id") == sid:
+                return _iceberg_type_to_spec(s)
+        return _iceberg_type_to_spec(md["schemas"][0])
+
+    # -- snapshots -------------------------------------------------------
+    def snapshot(self, snapshot_id: Optional[int] = None,
+                 timestamp_ms: Optional[int] = None) -> Optional[dict]:
+        md = self.metadata()
+        snaps = md.get("snapshots", [])
+        if not snaps:
+            return None
+        if snapshot_id is None and timestamp_ms is not None:
+            eligible = [s for s in snaps
+                        if s["timestamp-ms"] <= timestamp_ms]
+            if not eligible:
+                raise ValueError("no snapshot at or before timestamp")
+            return max(eligible, key=lambda s: s["timestamp-ms"])
+        if snapshot_id is None:
+            snapshot_id = md.get("current-snapshot-id")
+            if snapshot_id in (None, -1):
+                return None
+        for s in snaps:
+            if s["snapshot-id"] == snapshot_id:
+                return s
+        raise ValueError(f"snapshot {snapshot_id} not found")
+
+    def data_files(self, snapshot: Optional[dict]) -> List[dict]:
+        if snapshot is None:
+            return []
+        mlist_path = snapshot["manifest-list"]
+        manifests, _ = avro_io.read_container(
+            os.path.join(self.path, mlist_path)
+            if not os.path.isabs(mlist_path) else mlist_path)
+        out = []
+        for m in manifests:
+            entries, _ = avro_io.read_container(
+                os.path.join(self.path, m["manifest_path"])
+                if not os.path.isabs(m["manifest_path"])
+                else m["manifest_path"])
+            for e in entries:
+                if e["status"] in (0, 1):  # existing | added
+                    out.append(e["data_file"])
+        return out
+
+    def to_arrow(self, snapshot_id: Optional[int] = None,
+                 timestamp_ms: Optional[int] = None,
+                 columns: Optional[Sequence[str]] = None):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from ...columnar.arrow_interop import spec_type_to_arrow
+
+        snap = self.snapshot(snapshot_id, timestamp_ms)
+        files = self.data_files(snap)
+        tables = []
+        for df in files:
+            fp = df["file_path"]
+            if not os.path.isabs(fp):
+                fp = os.path.join(self.path, fp)
+            tables.append(pq.read_table(
+                fp, columns=list(columns) if columns else None))
+        if not tables:
+            st = self.schema()
+            fields = [(f.name, spec_type_to_arrow(f.data_type))
+                      for f in st.fields
+                      if columns is None or f.name in columns]
+            return pa.table({n: pa.array([], type=t) for n, t in fields})
+        return pa.concat_tables(tables, promote_options="permissive")
+
+    def history(self) -> List[dict]:
+        md = self.metadata()
+        return sorted(md.get("snapshots", []),
+                      key=lambda s: s["timestamp-ms"], reverse=True)
+
+    # -- writes ----------------------------------------------------------
+    def create(self, table, partition_by: Sequence[str] = ()) -> int:
+        from ...columnar.arrow_interop import arrow_type_to_spec
+        from ...spec import data_type as dt
+
+        os.makedirs(self.metadata_dir, exist_ok=True)
+        st = dt.StructType(tuple(
+            dt.StructField(n, arrow_type_to_spec(c.type), True)
+            for n, c in zip(table.column_names, table.columns)))
+        md = {
+            "format-version": 2,
+            "table-uuid": str(uuid.uuid4()),
+            "location": self.path,
+            "last-sequence-number": 0,
+            "last-updated-ms": int(time.time() * 1000),
+            "last-column-id": len(st.fields),
+            "current-schema-id": 0,
+            "schemas": [_spec_to_iceberg_schema(st)],
+            "default-spec-id": 0,
+            "partition-specs": [{"spec-id": 0, "fields": [
+                {"name": c, "transform": "identity",
+                 "source-id": [f.name for f in st.fields].index(c) + 1,
+                 "field-id": 1000 + i}
+                for i, c in enumerate(partition_by)]}],
+            "last-partition-id": 1000 + len(partition_by) - 1,
+            "default-sort-order-id": 0,
+            "sort-orders": [{"order-id": 0, "fields": []}],
+            "properties": {},
+            "current-snapshot-id": -1,
+            "snapshots": [],
+            "snapshot-log": [],
+            "metadata-log": [],
+        }
+        self._write_metadata_version(1, md)
+        if table.num_rows:
+            return self.append(table)
+        return 1
+
+    def _write_metadata_version(self, version: int, md: dict):
+        path = self._metadata_path(version)
+        tmp = path + f".{uuid.uuid4().hex}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(md, f)
+        try:
+            os.link(tmp, path)  # atomic create-if-absent
+        except FileExistsError:
+            raise IcebergConflict(
+                f"concurrent commit of metadata v{version}")
+        finally:
+            os.unlink(tmp)
+        hint_tmp = os.path.join(self.metadata_dir,
+                                f".hint.{uuid.uuid4().hex}.tmp")
+        with open(hint_tmp, "w") as f:
+            f.write(str(version))
+        os.replace(hint_tmp, os.path.join(self.metadata_dir,
+                                          "version-hint.text"))
+
+    def _write_data_files(self, table) -> List[dict]:
+        import pyarrow.parquet as pq
+
+        data_dir = os.path.join(self.path, "data")
+        os.makedirs(data_dir, exist_ok=True)
+        name = f"data/{uuid.uuid4().hex}.parquet"
+        fp = os.path.join(self.path, name)
+        pq.write_table(table, fp)
+        return [{"content": 0, "file_path": name, "file_format": "PARQUET",
+                 "partition": {}, "record_count": table.num_rows,
+                 "file_size_in_bytes": os.path.getsize(fp)}]
+
+    def _commit_snapshot(self, new_entries: List[dict],
+                         carry_forward: bool, operation: str,
+                         max_retries: int = 10) -> int:
+        for _ in range(max_retries):
+            version = self._current_version()
+            md = self.metadata(version)
+            seq = md["last-sequence-number"] + 1
+            snap_id = int(uuid.uuid4().int % (1 << 62))
+            manifest_name = f"metadata/{uuid.uuid4().hex}-m0.avro"
+            entries = [{"status": 1, "snapshot_id": snap_id,
+                        "data_file": df} for df in new_entries]
+            if carry_forward:
+                prev = self.snapshot()
+                for df in self.data_files(prev):
+                    entries.append({"status": 0, "snapshot_id": snap_id,
+                                    "data_file": df})
+            avro_io.write_container(
+                os.path.join(self.path, manifest_name),
+                _MANIFEST_ENTRY_SCHEMA, entries)
+            mlist_name = f"metadata/snap-{snap_id}.avro"
+            avro_io.write_container(
+                os.path.join(self.path, mlist_name), _MANIFEST_FILE_SCHEMA,
+                [{"manifest_path": manifest_name,
+                  "manifest_length": os.path.getsize(
+                      os.path.join(self.path, manifest_name)),
+                  "partition_spec_id": 0, "content": 0,
+                  "sequence_number": seq, "added_snapshot_id": snap_id,
+                  "added_files_count": len(new_entries),
+                  "existing_files_count": len(entries) - len(new_entries),
+                  "deleted_files_count": 0,
+                  "added_rows_count": sum(df["record_count"]
+                                          for df in new_entries)}])
+            snapshot = {
+                "snapshot-id": snap_id,
+                "sequence-number": seq,
+                "timestamp-ms": int(time.time() * 1000),
+                "manifest-list": mlist_name,
+                "summary": {"operation": operation},
+                "schema-id": md.get("current-schema-id", 0),
+            }
+            md["snapshots"] = md.get("snapshots", []) + [snapshot]
+            md["current-snapshot-id"] = snap_id
+            md["last-sequence-number"] = seq
+            md["last-updated-ms"] = snapshot["timestamp-ms"]
+            md.setdefault("snapshot-log", []).append(
+                {"snapshot-id": snap_id,
+                 "timestamp-ms": snapshot["timestamp-ms"]})
+            try:
+                self._write_metadata_version(version + 1, md)
+                return snap_id
+            except IcebergConflict:
+                continue  # re-read the new base metadata and retry
+        raise IcebergConflict("gave up after repeated commit races")
+
+    def append(self, table) -> int:
+        return self._commit_snapshot(self._write_data_files(table),
+                                     carry_forward=True, operation="append")
+
+    def overwrite(self, table) -> int:
+        return self._commit_snapshot(self._write_data_files(table),
+                                     carry_forward=False,
+                                     operation="overwrite")

@@ -11,6 +11,7 @@ per-partition loops; everything is O(n log n) sort + O(n) scans.
 
 from __future__ import annotations
 
+import math
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -251,7 +252,12 @@ def framed_agg(ctx: WindowContext, value: Optional[Column], fn: str,
             out = run[jnp.clip(hi, 0, n - 1)]
             cnt = _segment_count(valid, ctx, lo, hi, n)
             return _unsort(ctx, out), _unsort(ctx, (cnt > 0) & ~empty)
-        raise NotImplementedError("bounded min/max window frames")
+        # bounded frames: sparse-table range extremes — log2(n) doubling
+        # levels of pairwise extremes, then a two-gather query per row
+        # (static shapes, pure gathers/elementwise: TPU-friendly)
+        out = _range_extreme(masked, lo, hi, n, is_min, fill)
+        cnt = _segment_count(valid, ctx, lo, hi, n)
+        return _unsort(ctx, out), _unsort(ctx, (cnt > 0) & ~empty)
 
     if fn in ("first", "last"):
         pos_idx = lo if fn == "first" else hi
@@ -263,6 +269,34 @@ def framed_agg(ctx: WindowContext, value: Optional[Column], fn: str,
         return _unsort(ctx, data), _unsort(ctx, v)
 
     raise NotImplementedError(f"window aggregate {fn!r}")
+
+
+def _range_extreme(vals, lo, hi, n: int, is_min: bool, fill):
+    """Per-row extreme of vals[lo[i]..hi[i]] via a sparse table.
+
+    st[k, i] = extreme(vals[i : i + 2^k]); a query of length m uses the
+    two overlapping power-of-two blocks at lo and hi - 2^k + 1."""
+    ex = jnp.minimum if is_min else jnp.maximum
+    levels = max(1, int(math.ceil(math.log2(max(n, 2)))) + 1)
+    tables = [vals]
+    for k in range(1, levels):
+        half = 1 << (k - 1)
+        prev = tables[-1]
+        shifted = jnp.concatenate(
+            [prev[half:], jnp.full((half,), fill, dtype=prev.dtype)])
+        tables.append(ex(prev, shifted))
+    st = jnp.stack(tables)  # [levels, n]
+    lo_c = jnp.clip(lo, 0, n - 1)
+    hi_c = jnp.clip(hi, 0, n - 1)
+    length = jnp.maximum(hi_c - lo_c + 1, 1)
+    # floor(log2(length)) in integer arithmetic (length <= n < 2^31)
+    k = (jnp.ceil(jnp.log2(length.astype(jnp.float64) + 0.5)) - 1) \
+        .astype(jnp.int32)
+    k = jnp.clip(k, 0, levels - 1)
+    block = (jnp.int32(1) << k)
+    a = st[k, lo_c]
+    b = st[k, jnp.clip(hi_c - block + 1, 0, n - 1)]
+    return ex(a, b)
 
 
 def _segment_count(valid, ctx, lo, hi, n):

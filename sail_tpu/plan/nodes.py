@@ -54,6 +54,10 @@ class ScanExec(PlanNode):
     options: Tuple[Tuple[str, str], ...] = ()
     projection: Optional[Tuple[str, ...]] = None
     table_name: str = ""
+    # advisory scan-level predicates (conjuncts referencing only scan
+    # columns) for parquet row-group pruning; the exact Filter above the
+    # scan is retained, so these only need to be sound, not complete
+    predicates: Tuple[rx.Rex, ...] = ()
 
     @property
     def schema(self) -> Schema:

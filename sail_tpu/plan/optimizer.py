@@ -94,8 +94,31 @@ def _push_conjuncts_into(p: pn.PlanNode, conjuncts: List[rx.Rex]) -> pn.PlanNode
         new_inputs = tuple(_push_conjuncts_into(push_filters(c), list(conjuncts))
                            for c in p.inputs)
         return dataclasses.replace(p, inputs=new_inputs)
+    if isinstance(p, pn.ScanExec) and p.paths and p.format == "parquet":
+        # attach prunable conjuncts to the scan (row-group pruning); the
+        # exact filter stays above
+        prunable = tuple(c for c in conjuncts if _is_prunable(c))
+        if prunable:
+            p = dataclasses.replace(p, predicates=p.predicates + prunable)
+        return pn.FilterExec(p, _and(conjuncts))
     inner = push_filters(p) if p.children else p
     return pn.FilterExec(inner, _and(conjuncts))
+
+
+def _is_prunable(c: rx.Rex) -> bool:
+    """col <cmp> literal / isnull / isnotnull / in(col, literals)."""
+    if isinstance(c, rx.RCall):
+        if c.fn in ("==", "!=", "<", "<=", ">", ">=") and len(c.args) == 2:
+            a, b = c.args
+            return (isinstance(a, rx.BoundRef) and isinstance(b, rx.RLit)) \
+                or (isinstance(b, rx.BoundRef) and isinstance(a, rx.RLit))
+        if c.fn in ("isnull", "isnotnull") and \
+                isinstance(c.args[0], rx.BoundRef):
+            return True
+        if c.fn == "in" and isinstance(c.args[0], rx.BoundRef) and all(
+                isinstance(a, rx.RLit) for a in c.args[1:]):
+            return True
+    return False
 
 
 def _remap_through_project(r: rx.Rex, exprs) -> Optional[rx.Rex]:
@@ -218,8 +241,11 @@ def _prune(p: pn.PlanNode, required: Set[int]):
             if not keep:
                 keep = [0] if names else []
             proj = tuple(names[i] for i in keep)
-            return dataclasses.replace(p, projection=proj), \
-                {old: new for new, old in enumerate(keep)}
+            remap = {old: new for new, old in enumerate(keep)}
+            preds = tuple(_remap_indices(c, remap) for c in p.predicates
+                          if all(i in remap for i in rx.references(c)))
+            return dataclasses.replace(p, projection=proj,
+                                       predicates=preds), remap
         return p, identity
     if isinstance(p, pn.ProjectExec):
         keep = sorted(required)

@@ -115,6 +115,8 @@ class Resolver:
         """Resolve a query node. ``scope`` carries CTEs in effect; ``outer``
         is the enclosing query's scope for correlation."""
         ctes = scope.ctes if scope is not None else {}
+        if isinstance(plan, sp.WithWatermark):
+            return self.resolve_query(plan.input, scope, outer)
         if isinstance(plan, sp.ReadNamedTable):
             return self._resolve_read(plan, ctes, outer)
         if isinstance(plan, sp.ReadDataSource):
@@ -246,6 +248,22 @@ class Resolver:
                 cte.plan, Scope([], outer, cte.ctes), outer)
             fields = [dataclasses.replace(f, qualifiers=(plan.name[-1],))
                       for f in cscope.fields]
+            return node, Scope(fields, outer, ctes)
+        if len(plan.name) == 3 and plan.name[0].lower() == "system":
+            from ..catalog.system import SYSTEM
+            from ..columnar.arrow_interop import arrow_type_to_spec
+            try:
+                table = SYSTEM.table(plan.name[1].lower(),
+                                     plan.name[2].lower())
+            except KeyError as e:
+                raise ResolutionError(str(e))
+            schema = tuple(pn.Field(n, arrow_type_to_spec(c.type), True)
+                           for n, c in zip(table.column_names,
+                                           table.columns))
+            node = pn.ScanExec(schema, table, (), "memory")
+            qual = plan.name[-1]
+            fields = [ScopeField(f.name, (qual,), f.dtype, f.nullable)
+                      for f in schema]
             return node, Scope(fields, outer, ctes)
         entry = self.catalog.lookup_table(plan.name)
         if entry is None:
@@ -1167,6 +1185,11 @@ class Resolver:
     def _coerce(self, r: rx.Rex, target: dt.DataType) -> rx.Rex:
         if rx.rex_type(r) == target or isinstance(target, dt.NullType):
             return r
+        if isinstance(r, rx.RLit) and not r.value.is_null and \
+                r.value.data_type.is_integer and target.is_integer:
+            # constant-fold integer widening so literals stay literals
+            # (keeps comparisons scan-prunable)
+            return rx.RLit(LV(target, r.value.value))
         return rx.RCast(r, target, False, rx.rex_nullable(r))
 
     def _make_call(self, name: str, args: List[rx.Rex]) -> rx.Rex:

@@ -39,6 +39,8 @@ class SessionManager:
         now = time.time()
         with self._lock:
             self._evict(now)
+            from .catalog.system import SYSTEM
+            SYSTEM.record_session(session_id)
             if session_id in self._sessions:
                 session, _ = self._sessions[session_id]
                 self._sessions[session_id] = (session, now)
@@ -48,6 +50,8 @@ class SessionManager:
             return session
 
     def release(self, session_id: str):
+        from .catalog.system import SYSTEM
+        SYSTEM.end_session(session_id)
         with self._lock:
             self._sessions.pop(session_id, None)
 

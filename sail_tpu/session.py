@@ -443,6 +443,21 @@ class SessionConf:
     }
 
     def __init__(self, conf: Dict[str, str]):
+        # layering (low → high): class defaults, YAML session.timezone,
+        # YAML/env spark.* keys, then the per-session conf dict
+        from .config import app_config
+        app = app_config()
+        base = dict(self._DEFAULTS)
+        tz = app.get("session.timezone")
+        if tz:
+            base["spark.sql.session.timeZone"] = str(tz)
+        for key, value in app.items():
+            if key.startswith("spark."):
+                base[key] = str(value)
+        chunk = app.get("execution.scan_chunk_rows")
+        if chunk:
+            base["spark.sail.scan.chunkRows"] = str(chunk)
+        self._DEFAULTS = base
         self._conf = dict(conf)
 
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
@@ -909,6 +924,13 @@ class DataFrame:
 
     def unpersist(self) -> "DataFrame":
         return self
+
+    def withWatermark(self, eventTime: str,
+                      delayThreshold: str) -> "DataFrame":
+        from .streaming import parse_delay
+        return DataFrame(sp.WithWatermark(self._plan, eventTime,
+                                          parse_delay(delayThreshold)),
+                         self._session)
 
     @property
     def write(self) -> "DataFrameWriter":

@@ -211,6 +211,15 @@ class Unpivot(QueryPlan):
 
 
 @dataclass(frozen=True)
+class WithWatermark(QueryPlan):
+    """Streaming watermark marker (event-time column + delay)."""
+
+    input: QueryPlan = None
+    column: str = ""
+    delay_seconds: float = 0.0
+
+
+@dataclass(frozen=True)
 class LateralView(QueryPlan):
     input: QueryPlan
     generator: Expr = None

@@ -28,6 +28,13 @@ class StreamSource:
     def next_batch(self) -> Optional[pa.Table]:
         raise NotImplementedError
 
+    # durable-checkpoint support: serializable position + restore
+    def offset(self):
+        return None
+
+    def seek(self, offset):
+        pass
+
     @property
     def schema(self) -> pa.Schema:
         raise NotImplementedError
@@ -35,6 +42,12 @@ class StreamSource:
 
 class RateSource(StreamSource):
     """value/timestamp rows at rowsPerSecond (reference: formats/rate)."""
+
+    def offset(self):
+        return self._emitted
+
+    def seek(self, offset):
+        self._emitted = int(offset or 0)
 
     def __init__(self, rows_per_second: int = 1):
         self.rows_per_second = rows_per_second
@@ -88,13 +101,65 @@ class MemoryStreamSource(StreamSource):
             return out
 
 
+class FileStreamSource(StreamSource):
+    """Watches a directory; each new file is a micro-batch slice
+    (reference role: the file listing streaming source)."""
+
+    def __init__(self, fmt: str, path: str, options: Dict[str, str],
+                 declared_schema=None):
+        self._fmt = fmt
+        self._path = path
+        self._options = options
+        self._seen: set = set()
+        self._declared = declared_schema  # spec StructType | None
+        self._schema: Optional[pa.Schema] = None
+
+    def schema(self) -> pa.Schema:
+        if self._schema is None:
+            if self._declared is not None:
+                from .columnar.arrow_interop import spec_type_to_arrow
+                self._schema = pa.schema(
+                    [(f.name, spec_type_to_arrow(f.data_type))
+                     for f in self._declared.fields])
+            else:
+                from .io.formats import read_table
+                t = read_table(self._fmt, (self._path,), self._options,
+                               limit=1)
+                self._schema = t.schema
+        return self._schema
+
+    def offset(self):
+        return sorted(self._seen)
+
+    def seek(self, offset):
+        self._seen = set(offset or [])
+
+    def next_batch(self) -> Optional[pa.Table]:
+        import os as _os
+        from .io.formats import expand_paths, read_table
+        files = [f for f in expand_paths((self._path,))
+                 if f not in self._seen]
+        if not files:
+            return None
+        self._seen.update(files)
+        out = read_table(self._fmt, files, self._options)
+        if self._declared is not None:
+            target = self.schema()
+            out = out.rename_columns(
+                [f.name for f in target]).cast(target, safe=False)
+        return out
+
+
 class StreamingQuery:
     """A running micro-batch query (reference: streaming query lifecycle,
     plan_executor.rs handle_execute_streaming_query_command)."""
 
     def __init__(self, session, plan: sp.QueryPlan, source_name: str,
                  source: StreamSource, sink: Callable[[int, pa.Table], None],
-                 interval_s: float = 0.1, query_name: Optional[str] = None):
+                 interval_s: float = 0.1, query_name: Optional[str] = None,
+                 output_mode: str = "append",
+                 watermark: Optional[tuple] = None,
+                 checkpoint_dir: Optional[str] = None):
         self.id = uuid.uuid4().hex
         self.name = query_name
         self._session = session
@@ -107,6 +172,18 @@ class StreamingQuery:
         self._batch_id = 0
         self.exception: Optional[Exception] = None
         self.recent_progress: List[dict] = []
+        # stateful aggregation: buffer rows within the watermark horizon
+        # and re-aggregate per micro-batch (Spark's complete/update modes)
+        self._stateful = _has_aggregate(plan)
+        self._mode = output_mode
+        self._watermark = watermark  # (column, delay_seconds)
+        self._watermark_ts: Optional[float] = None
+        self._buffer: Optional[pa.Table] = None
+        self._prev_result: Optional[pa.Table] = None
+        self._checkpoint_dir = checkpoint_dir
+        self._proc_lock = threading.Lock()
+        if checkpoint_dir:
+            self._restore_checkpoint()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -123,36 +200,122 @@ class StreamingQuery:
         return not self._thread.is_alive()
 
     def processAllAvailable(self):
-        """Block until the source has no pending data (test helper)."""
+        """Block until the source has no pending data AND any in-flight
+        trigger finished (test helper)."""
         while True:
-            batch = self._source.next_batch()
-            if batch is None or batch.num_rows == 0:
-                return
-            self._process(batch)
+            with self._proc_lock:
+                batch = self._source.next_batch()
+                if batch is None or batch.num_rows == 0:
+                    return
+                self._process(batch)
 
     def _loop(self):
         while not self._stop.wait(self._interval):
             try:
-                batch = self._source.next_batch()
-                if batch is not None and batch.num_rows:
-                    self._process(batch)
+                with self._proc_lock:
+                    batch = self._source.next_batch()
+                    if batch is not None and batch.num_rows:
+                        self._process(batch)
             except Exception as e:  # noqa: BLE001 — surfaced via .exception
                 self.exception = e
                 return
 
     def _process(self, batch: pa.Table):
         t0 = time.time()
-        view_plan = sp.LocalRelation(batch)
-        bound = _substitute_source(self._plan, self._source_name, view_plan)
-        result = self._session._execute_query(bound)
-        self._sink(self._batch_id, result)
+        if self._stateful:
+            result = self._process_stateful(batch)
+        else:
+            bound = _substitute_source(self._plan, self._source_name,
+                                       sp.LocalRelation(batch))
+            result = self._session._execute_query(bound)
+        if result is not None:
+            self._sink(self._batch_id, result)
+        if self._checkpoint_dir:
+            self._write_checkpoint()
         self.recent_progress.append({
             "batchId": self._batch_id,
             "numInputRows": batch.num_rows,
             "durationMs": int((time.time() - t0) * 1000),
+            "watermark": self._watermark_ts,
         })
         del self.recent_progress[:-32]
         self._batch_id += 1
+
+    # -- stateful micro-batch aggregation -------------------------------
+    def _process_stateful(self, batch: pa.Table) -> Optional[pa.Table]:
+        self._buffer = batch if self._buffer is None else pa.concat_tables(
+            [self._buffer, batch], promote_options="permissive")
+        if self._watermark is not None:
+            col, delay_s = self._watermark
+            if col in self._buffer.column_names:
+                import pyarrow.compute as pc
+                mx = pc.max(self._buffer.column(col)).as_py()
+                if mx is not None:
+                    ts = mx.timestamp() if hasattr(mx, "timestamp")                         else float(mx)
+                    self._watermark_ts = ts - delay_s
+                    # evict rows the watermark has passed (bounded state)
+                    keep = pc.greater_equal(
+                        _col_as_seconds(self._buffer.column(col)),
+                        self._watermark_ts)
+                    self._buffer = self._buffer.filter(keep)
+        bound = _substitute_source(self._plan, self._source_name,
+                                   sp.LocalRelation(self._buffer))
+        result = self._session._execute_query(bound)
+        if self._mode == "complete":
+            self._prev_result = result
+            return result
+        # update mode: only rows that changed since the last trigger
+        prev = self._prev_result
+        self._prev_result = result
+        if prev is None or prev.num_rows == 0:
+            return result
+        prev_rows = {tuple(r.values()) for r in prev.to_pylist()}
+        changed = [r for r in result.to_pylist()
+                   if tuple(r.values()) not in prev_rows]
+        if not changed:
+            return result.slice(0, 0)
+        import pyarrow as _pa
+        return _pa.Table.from_pylist(changed, schema=result.schema)
+
+    # -- durable checkpoints --------------------------------------------
+    def _write_checkpoint(self):
+        import json
+        import os as _os
+        _os.makedirs(self._checkpoint_dir, exist_ok=True)
+        state = {"batch_id": self._batch_id + 1,
+                 "offset": self._source.offset(),
+                 "watermark": self._watermark_ts}
+        if self._buffer is not None:
+            sink_buf = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink_buf, self._buffer.schema) as w:
+                w.write_table(self._buffer)
+            with open(_os.path.join(self._checkpoint_dir, "state.arrow.tmp"),
+                      "wb") as f:
+                f.write(sink_buf.getvalue().to_pybytes())
+            _os.replace(_os.path.join(self._checkpoint_dir,
+                                      "state.arrow.tmp"),
+                        _os.path.join(self._checkpoint_dir, "state.arrow"))
+        tmp = _os.path.join(self._checkpoint_dir, "offsets.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        _os.replace(tmp, _os.path.join(self._checkpoint_dir,
+                                       "offsets.json"))
+
+    def _restore_checkpoint(self):
+        import json
+        import os as _os
+        path = _os.path.join(self._checkpoint_dir, "offsets.json")
+        if not _os.path.exists(path):
+            return
+        with open(path) as f:
+            state = json.load(f)
+        self._batch_id = int(state.get("batch_id", 0))
+        self._watermark_ts = state.get("watermark")
+        self._source.seek(state.get("offset"))
+        spath = _os.path.join(self._checkpoint_dir, "state.arrow")
+        if _os.path.exists(spath):
+            with open(spath, "rb") as f:
+                self._buffer = pa.ipc.open_stream(f.read()).read_all()
 
 
 def _substitute_source(plan: sp.QueryPlan, name: str,
@@ -184,6 +347,7 @@ class DataStreamReader:
         self._session = session
         self._format = "rate"
         self._options: Dict[str, str] = {}
+        self._declared_schema = None
 
     def format(self, fmt: str) -> "DataStreamReader":
         self._format = fmt.lower()
@@ -193,11 +357,25 @@ class DataStreamReader:
         self._options[str(key).lower()] = str(value)
         return self
 
-    def load(self):
+    def schema(self, schema) -> "DataStreamReader":
+        if isinstance(schema, str):
+            from .session import _parse_ddl_schema
+            self._declared_schema = _parse_ddl_schema(schema)
+        else:
+            self._declared_schema = schema
+        return self
+
+    def load(self, path: Optional[str] = None):
         from .session import DataFrame
         if self._format == "rate":
             src: StreamSource = RateSource(
                 int(self._options.get("rowspersecond", 1)))
+        elif self._format in ("parquet", "csv", "json", "text"):
+            p = path or self._options.get("path")
+            if not p:
+                raise ValueError("file stream source requires a path")
+            src = FileStreamSource(self._format, p, dict(self._options),
+                                   declared_schema=self._declared_schema)
         else:
             raise ValueError(f"unsupported stream source {self._format!r}")
         name = f"__stream_{uuid.uuid4().hex[:8]}"
@@ -250,10 +428,15 @@ class DataStreamWriter:
         if src_node is None:
             raise ValueError("writeStream requires a readStream source")
         sink = self._make_sink(session)
+        watermark = _find_watermark(plan)
         q = StreamingQuery(session, plan, src_node.source_name,
                            src_node.source, sink,
                            float(self._options.get("interval_s", 0.1)),
-                           self._query_name)
+                           self._query_name,
+                           output_mode=self._output_mode,
+                           watermark=watermark,
+                           checkpoint_dir=self._options.get(
+                               "checkpointlocation"))
         return q
 
     def _make_sink(self, session):
@@ -284,6 +467,65 @@ class DataStreamWriter:
         if self._format == "noop":
             return lambda batch_id, table: None
         raise ValueError(f"unsupported stream sink {self._format!r}")
+
+
+def _find_watermark(plan):
+    import dataclasses
+    if isinstance(plan, sp.WithWatermark):
+        return (plan.column, plan.delay_seconds)
+    if dataclasses.is_dataclass(plan):
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            if isinstance(v, sp.QueryPlan):
+                r = _find_watermark(v)
+                if r is not None:
+                    return r
+    return None
+
+
+def _has_aggregate(plan) -> bool:
+    import dataclasses
+    if isinstance(plan, (sp.Aggregate, sp.Deduplicate)):
+        return True
+    if dataclasses.is_dataclass(plan):
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            if isinstance(v, sp.QueryPlan) and _has_aggregate(v):
+                return True
+    return False
+
+
+def _col_as_seconds(col):
+    import pyarrow as _pa
+    import pyarrow.compute as pc
+    if _pa.types.is_timestamp(col.type):
+        # normalize to microseconds regardless of the column's unit;
+        # tz-naive columns are interpreted as UTC (matching _event_seconds)
+        us = pc.cast(col, _pa.timestamp("us", tz=col.type.tz))
+        return pc.divide(pc.cast(us, _pa.int64()), 1_000_000)
+    return pc.cast(col, _pa.float64())
+
+
+def _event_seconds(v) -> float:
+    """Max event-time value → epoch seconds; naive datetimes are UTC."""
+    import datetime as _dt
+    if hasattr(v, "timestamp"):
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=_dt.timezone.utc)
+        return v.timestamp()
+    return float(v)
+
+
+def parse_delay(text: str) -> float:
+    parts = text.strip().split()
+    num = float(parts[0])
+    unit = parts[1].lower() if len(parts) > 1 else "seconds"
+    mult = {"millisecond": 0.001, "second": 1.0, "minute": 60.0,
+            "hour": 3600.0, "day": 86400.0}
+    for k, m in mult.items():
+        if unit.startswith(k) or unit.rstrip("s").startswith(k):
+            return num * m
+    return num
 
 
 def _as_df(session, table: pa.Table):

@@ -1,0 +1,115 @@
+"""Iceberg: metadata/snapshots/manifests (from-scratch Avro IO),
+append/overwrite commits, time travel.
+Reference role parity: crates/sail-iceberg."""
+
+import os
+import threading
+
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession
+from sail_tpu.lakehouse.iceberg import IcebergTable
+from sail_tpu.lakehouse.iceberg import avro_io
+
+
+@pytest.fixture()
+def spark():
+    return SparkSession({})
+
+
+def _t(vals):
+    return pa.table({"k": list(range(len(vals))), "v": vals})
+
+
+def test_avro_container_roundtrip(tmp_path):
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "s", "type": "string"},
+        {"name": "n", "type": "long"},
+        {"name": "opt", "type": ["null", "string"], "default": None},
+        {"name": "m", "type": {"type": "map", "values": "long"}},
+        {"name": "a", "type": {"type": "array", "items": "int"}},
+    ]}
+    recs = [{"s": "x", "n": 42, "opt": None, "m": {"a": 1}, "a": [1, 2]},
+            {"s": "y", "n": -7, "opt": "set", "m": {}, "a": []}]
+    path = str(tmp_path / "t.avro")
+    avro_io.write_container(path, schema, recs)
+    back, meta = avro_io.read_container(path)
+    assert back == recs
+    assert "avro.schema" in meta
+
+
+def test_create_append_read(tmp_path):
+    path = str(tmp_path / "ice1")
+    t = IcebergTable(path)
+    t.create(_t([1.0, 2.0]))
+    t.append(_t([3.0]))
+    out = t.to_arrow()
+    assert sorted(out.column("v").to_pylist()) == [1.0, 2.0, 3.0]
+    # real iceberg layout on disk
+    assert os.path.exists(os.path.join(path, "metadata",
+                                       "version-hint.text"))
+    md = t.metadata()
+    assert md["format-version"] == 2
+    assert len(md["snapshots"]) == 2
+    # manifests are avro container files
+    snap = t.snapshot()
+    manifests, _ = avro_io.read_container(
+        os.path.join(path, snap["manifest-list"]))
+    assert manifests[0]["added_files_count"] == 1
+
+
+def test_overwrite_and_time_travel(tmp_path):
+    path = str(tmp_path / "ice2")
+    t = IcebergTable(path)
+    t.create(_t([1.0]))
+    first = t.snapshot()["snapshot-id"]
+    t.append(_t([2.0]))
+    t.overwrite(_t([9.0]))
+    assert t.to_arrow().column("v").to_pylist() == [9.0]
+    old = t.to_arrow(snapshot_id=first)
+    assert old.column("v").to_pylist() == [1.0]
+    hist = t.history()
+    assert [h["summary"]["operation"] for h in hist] == [
+        "overwrite", "append", "append"]
+
+
+def test_concurrent_appends_serialize(tmp_path):
+    path = str(tmp_path / "ice3")
+    IcebergTable(path).create(_t([0.0]))
+    errs = []
+
+    def worker(i):
+        try:
+            IcebergTable(path).append(_t([float(i)]))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(5)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    out = IcebergTable(path).to_arrow()
+    assert out.num_rows == 6
+    assert len(IcebergTable(path).metadata()["snapshots"]) == 6
+
+
+def test_session_read_write_iceberg(tmp_path, spark):
+    path = str(tmp_path / "ice4")
+    df = spark.createDataFrame(pd.DataFrame(
+        {"a": [1, 2, 3], "s": ["x", "y", "z"]}))
+    df.write.format("iceberg").save(path)
+    df.write.format("iceberg").mode("append").save(path)
+    out = spark.read.format("iceberg").load(path).toPandas()
+    assert len(out) == 6
+    spark.sql(f"CREATE TABLE itab USING iceberg LOCATION '{path}'")
+    got = spark.sql("SELECT count(*) c, sum(a) s FROM itab").toPandas()
+    assert got.c[0] == 6 and got.s[0] == 12
+    # snapshot time travel via read option
+    first = IcebergTable(path).history()[-1]["snapshot-id"]
+    old = spark.read.format("iceberg").option("snapshot-id", first) \
+        .load(path).toPandas()
+    assert len(old) == 3

@@ -1,0 +1,69 @@
+"""System catalog tables + YAML/env config layering."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession
+
+
+def test_yaml_defaults_and_env_layering(monkeypatch):
+    from sail_tpu.config import app_config
+    conf = app_config()
+    assert conf["cluster.task_max_attempts"] == 3
+    assert conf["session.timezone"] == "UTC"
+    monkeypatch.setenv("SAIL_CLUSTER__TASK_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("SAIL_SPARK__SQL.ANSI.ENABLED", "true")
+    conf = app_config()
+    assert conf["cluster.task_max_attempts"] == "7"
+
+
+def test_session_conf_sees_yaml_defaults():
+    spark = SparkSession({})
+    assert spark.conf.get("spark.sql.shuffle.partitions") == "8"
+    assert spark.conf.get("spark.sql.session.timeZone") == "UTC"
+
+
+def test_system_tables_reflect_cluster_state():
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.sql import parse_one
+
+    spark = SparkSession({})
+    cluster = LocalCluster(num_workers=2)
+    try:
+        df = pd.DataFrame({"g": np.arange(100) % 4, "v": np.arange(100)})
+        spark.createDataFrame(df).createOrReplaceTempView("t")
+        plan = spark._resolve(parse_one(
+            "SELECT g, sum(v) FROM t GROUP BY g"))
+        cluster.run_job(plan, num_partitions=2)
+
+        workers = spark.sql(
+            "SELECT * FROM system.cluster.workers").toPandas()
+        assert len(workers) >= 2
+        jobs = spark.sql(
+            "SELECT status, count(*) c FROM system.execution.jobs "
+            "GROUP BY status").toPandas()
+        assert jobs.c.sum() >= 1
+        tasks = spark.sql(
+            "SELECT count(*) c FROM system.execution.tasks "
+            "WHERE status = 'succeeded'").toPandas()
+        assert tasks.c[0] >= 2
+    finally:
+        cluster.stop()
+
+
+def test_system_sessions_via_server():
+    from sail_tpu.server import SessionManager
+
+    mgr = SessionManager()
+    mgr.get_or_create("sess-sys-1")
+    spark = SparkSession({})
+    out = spark.sql("SELECT session_id FROM system.session.sessions "
+                    "WHERE session_id = 'sess-sys-1'").toPandas()
+    assert out.session_id.tolist() == ["sess-sys-1"]
+    mgr.release("sess-sys-1")
+    out = spark.sql("SELECT count(*) c FROM system.session.sessions "
+                    "WHERE session_id = 'sess-sys-1'").toPandas()
+    assert out.c[0] == 0

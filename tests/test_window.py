@@ -190,3 +190,27 @@ def test_window_inside_between(spark):
     got = s.sql("""SELECT o, row_number() OVER (ORDER BY o, g) BETWEEN 1 AND 2 AS top2
                    FROM t ORDER BY o, g LIMIT 3""").toPandas()
     assert got.top2.tolist() == [True, True, False]
+
+
+def test_bounded_min_max_frames(spark):
+    spark, _ = spark
+    rng = np.random.default_rng(9)
+    df = pd.DataFrame({"g": rng.integers(0, 3, 150), "o": np.arange(150),
+                       "v": rng.normal(size=150).round(3)})
+    spark.createDataFrame(df).createOrReplaceTempView("bf")
+    got = spark.sql(
+        "SELECT g, o, "
+        "min(v) OVER (PARTITION BY g ORDER BY o "
+        "             ROWS BETWEEN 3 PRECEDING AND 1 FOLLOWING) mn, "
+        "max(v) OVER (PARTITION BY g ORDER BY o "
+        "             ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) mx "
+        "FROM bf ORDER BY g, o").toPandas()
+    mn_exp, mx_exp = [], []
+    for _, sub in df.sort_values(["g", "o"]).groupby("g"):
+        vals = sub.v.tolist()
+        for i in range(len(vals)):
+            mn_exp.append(min(vals[max(0, i - 3):
+                               min(len(vals), i + 2)]))
+            mx_exp.append(max(vals[max(0, i - 2):i + 1]))
+    np.testing.assert_allclose(got.mn, mn_exp, rtol=1e-9)
+    np.testing.assert_allclose(got.mx, mx_exp, rtol=1e-9)
