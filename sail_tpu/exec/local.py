@@ -40,6 +40,46 @@ class ExecutionError(RuntimeError):
     pass
 
 
+def _generate_rows(kind: str, args: List, n_cols: int) -> List[tuple]:
+    if kind == "explode":
+        c = args[0]
+        if c is None:
+            return []
+        if isinstance(c, dict):
+            return [(k, v) for k, v in c.items()]
+        return [(x,) for x in c]
+    if kind == "posexplode":
+        c = args[0]
+        if c is None:
+            return []
+        if isinstance(c, dict):
+            return [(i, k, v) for i, (k, v) in enumerate(c.items())]
+        return [(i, x) for i, x in enumerate(c)]
+    if kind == "inline":
+        c = args[0]
+        if c is None:
+            return []
+        out = []
+        for st in c:
+            if st is None:
+                out.append(tuple([None] * n_cols))
+            else:
+                vals = list(st.values())
+                out.append(tuple(vals[:n_cols] +
+                                 [None] * (n_cols - len(vals))))
+        return out
+    if kind == "stack":
+        n_rows = int(args[0])
+        vals = args[1:]
+        per = -(-len(vals) // n_rows) if n_rows else 0
+        out = []
+        for r in range(n_rows):
+            row = vals[r * per:(r + 1) * per]
+            out.append(tuple(list(row) + [None] * (per - len(row))))
+        return out
+    raise ExecutionError(f"unknown generator {kind!r}")
+
+
 def _replace_node(plan: pn.PlanNode, target: pn.PlanNode,
                   replacement: pn.PlanNode) -> pn.PlanNode:
     if plan is target:
@@ -549,6 +589,46 @@ class LocalExecutor:
         out_validity = jnp.asarray(np.asarray(_pc.is_valid(sarr)))
         return jnp.asarray(codes), out_validity, enc.dictionary
 
+    def _exec_GenerateExec(self, p: pn.GenerateExec) -> HostBatch:
+        """Host row expansion for explode/posexplode/inline/stack."""
+        from .host_interp import HostInterpreter
+
+        child = self.run(p.input)
+        comp = self._compiler(child, p.input.schema)
+        interp = HostInterpreter(self, comp, child)
+        sel = np.asarray(child.device.sel)
+        live = np.nonzero(sel)[0]
+        def live_vals(r):
+            vals = interp.values(r)
+            return [vals[i] for i in live]
+
+        pt_vals = [(n, live_vals(r)) for n, r in p.passthrough]
+        arg_vals = [live_vals(a) for a in p.args]
+        out_rows: List[tuple] = []
+        for row_i in range(len(live)):
+            pt = tuple(vals[row_i] for _, vals in pt_vals)
+            gen_rows = _generate_rows(
+                p.generator, [col[row_i] for col in arg_vals],
+                len(p.gen_schema))
+            if not gen_rows and p.outer:
+                gen_rows = [tuple([None] * len(p.gen_schema))]
+            for g in gen_rows:
+                out_rows.append(pt + g)
+        names = [n for n, _ in p.passthrough] + \
+            [f.name for f in p.gen_schema]
+        types = [rx.rex_type(r) for _, r in p.passthrough] + \
+            [f.dtype for f in p.gen_schema]
+        arrays = []
+        for ci, (n, t) in enumerate(zip(names, types)):
+            at = ai.spec_type_to_arrow(t)
+            vals = [r[ci] for r in out_rows]
+            from .host_interp import _pyarrowable
+            arrays.append(pa.array([_pyarrowable(v, t) for v in vals],
+                                   type=at))
+        table = pa.Table.from_arrays(arrays, names=[f"c{i}" for i in
+                                                    range(len(names))])
+        return ai.from_arrow(table)
+
     def _exec_FilterExec(self, p: pn.FilterExec) -> HostBatch:
         child = self.run(p.input)
         dev = child.device
@@ -712,10 +792,21 @@ class LocalExecutor:
         chunked = self._try_chunked_aggregate(p)
         if chunked is not None:
             return chunked
-        if tel.current_collector() is not None:
-            chain, child, bottom_node = [], self.run(p.input), p.input
-        else:
-            chain, child, bottom_node = self._pipeline_chain(p.input)
+        # Under EXPLAIN ANALYZE keep the PRODUCTION (fused) program and
+        # report the pipeline as one fused operator — profiling must
+        # measure the program that actually runs, not an unfused variant.
+        chain, child, bottom_node = self._pipeline_chain(p.input)
+        if tel.current_collector() is not None and chain:
+            ops = "+".join(type(c).__name__ for c in chain)
+            with tel.operator_span("FusedAggregate", ops) as m:
+                out = self._agg_with_chain_or_unfused(p, chain, child,
+                                                      bottom_node)
+                m.output_rows = int(out.device.num_rows())
+                m.capacity = out.capacity
+                return out
+        return self._agg_with_chain_or_unfused(p, chain, child, bottom_node)
+
+    def _agg_with_chain_or_unfused(self, p, chain, child, bottom_node):
         try:
             return self._agg_with_chain(p, chain, child, bottom_node)
         except HostFallback:

@@ -246,6 +246,31 @@ class JoinExec(PlanNode):
 
 
 @dataclass(frozen=True)
+class GenerateExec(PlanNode):
+    """Row generator (explode/posexplode/inline/stack) over an input.
+
+    Reference role: sail-function generators + Spark's Generate node.
+    Host-evaluated: collection values live in host dictionaries."""
+
+    input: PlanNode = None
+    generator: str = "explode"       # explode|posexplode|inline|stack
+    args: Tuple[rx.Rex, ...] = ()
+    outer: bool = False
+    passthrough: Tuple[Tuple[str, rx.Rex], ...] = ()
+    gen_schema: Tuple[Field, ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        pt = tuple(Field(n, rx.rex_type(r), True)
+                   for n, r in self.passthrough)
+        return pt + tuple(self.gen_schema)
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclass(frozen=True)
 class UnionExec(PlanNode):
     inputs: Tuple[PlanNode, ...] = ()
     all: bool = True

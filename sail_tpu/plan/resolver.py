@@ -551,6 +551,8 @@ class Resolver:
         child, cscope = self.resolve_query(plan.input, scope, outer) \
             if plan.input is not None else (pn.OneRowExec(), Scope([], outer, {}))
         items = self._expand_star(plan.expressions, cscope)
+        if any(_is_generator(_unalias(e)) for e in items):
+            return self._resolve_generate(items, child, cscope, outer)
         if any(_has_window(e) for e in items):
             return self._resolve_window_project(items, child, cscope, outer)
         # implicit global aggregate: SELECT sum(x) FROM t
@@ -570,6 +572,107 @@ class Resolver:
         out_scope = Scope(fields, outer, cscope.ctes)
         out_scope.below = cscope
         return node, out_scope
+
+    # -- generators (explode / posexplode / inline / stack) ---------------
+    def _resolve_generate(self, items, child: pn.PlanNode, cscope: Scope,
+                          outer):
+        """SELECT-list generators become a GenerateExec over the child
+        (reference role: generator functions + Spark's Generate node)."""
+        gen_idx = [i for i, it in enumerate(items)
+                   if _is_generator(_unalias(it))]
+        if len(gen_idx) != 1:
+            raise ResolutionError(
+                "exactly one generator function per SELECT list")
+        gi = gen_idx[0]
+        gen = _unalias(items[gi])
+        name = gen.name.lower()
+        outer_gen = name.endswith("_outer")
+        base = name[:-6] if outer_gen else name
+        args = [self._resolve_expr(a, cscope) for a in gen.args]
+        aliases = tuple(items[gi].name) if isinstance(items[gi], ex.Alias) \
+            else ()
+        # passthrough items (plain columns only, before/after the generator)
+        passthrough = []
+        for i, it in enumerate(items):
+            if i == gi:
+                continue
+            r = self._resolve_expr(_unalias(it), cscope)
+            passthrough.append((self._output_name(it), r))
+        at = rx.rex_type(args[0]) if args else dt.NullType()
+        if base == "explode":
+            if isinstance(at, dt.MapType):
+                gcols = [("key", at.key_type), ("value", at.value_type)]
+            else:
+                et = at.element_type if isinstance(at, dt.ArrayType) \
+                    else dt.NullType()
+                gcols = [("col", et)]
+        elif base == "posexplode":
+            if isinstance(at, dt.MapType):
+                gcols = [("pos", dt.IntegerType()), ("key", at.key_type),
+                         ("value", at.value_type)]
+            else:
+                et = at.element_type if isinstance(at, dt.ArrayType) \
+                    else dt.NullType()
+                gcols = [("pos", dt.IntegerType()), ("col", et)]
+        elif base == "inline":
+            et = at.element_type if isinstance(at, dt.ArrayType) \
+                else dt.NullType()
+            if not isinstance(et, dt.StructType):
+                raise ResolutionError("inline requires array<struct>")
+            gcols = [(f.name, f.data_type) for f in et.fields]
+        elif base == "stack":
+            if not args or not isinstance(args[0], rx.RLit):
+                raise ResolutionError("stack requires a literal row count")
+            n_rows = int(args[0].value.value)
+            if n_rows <= 0:
+                raise ResolutionError("stack row count must be positive")
+            vals = args[1:]
+            per = -(-len(vals) // n_rows)
+            gcols = []
+            for c in range(per):
+                col_ts = [rx.rex_type(vals[r * per + c])
+                          for r in range(n_rows) if r * per + c < len(vals)]
+                ct = col_ts[0] if col_ts else dt.NullType()
+                for t in col_ts[1:]:
+                    if not isinstance(t, dt.NullType):
+                        ct = t if isinstance(ct, dt.NullType) \
+                            else dt.common_type(ct, t)
+                gcols.append((f"col{c}", ct))
+        else:
+            raise ResolutionError(f"unknown generator {name!r}")
+        if aliases:
+            if len(aliases) == len(gcols):
+                gcols = [(a, t) for a, (_, t) in zip(aliases, gcols)]
+            elif len(aliases) == 1 and len(gcols) == 1:
+                gcols = [(aliases[0], gcols[0][1])]
+            else:
+                raise ResolutionError(
+                    f"generator produces {len(gcols)} columns but "
+                    f"{len(aliases)} aliases were given")
+        node: pn.PlanNode = pn.GenerateExec(
+            child, base, tuple(args), outer_gen, tuple(passthrough),
+            tuple(pn.Field(n, t, True) for n, t in gcols))
+        # GenerateExec lays out passthrough then generator columns;
+        # restore the declared SELECT order when they differ
+        pt_names = [n for n, _ in passthrough]
+        declared = []
+        pt_i = 0
+        for i, it in enumerate(items):
+            if i == gi:
+                declared.extend(n for n, _ in gcols)
+            else:
+                declared.append(pt_names[pt_i])
+                pt_i += 1
+        layout = pt_names + [n for n, _ in gcols]
+        if declared != layout:
+            by_name = {f.name: (j, f) for j, f in enumerate(node.schema)}
+            node = pn.ProjectExec(node, tuple(
+                (n, rx.BoundRef(by_name[n][0], n, by_name[n][1].dtype,
+                                by_name[n][1].nullable))
+                for n in declared))
+        fields = [ScopeField(f.name, (), f.dtype, f.nullable)
+                  for f in node.schema]
+        return node, Scope(fields, outer, cscope.ctes)
 
     def _resolve_window_project(self, items, child: pn.PlanNode, cscope: Scope,
                                 outer):
@@ -1583,6 +1686,14 @@ class _AggCollector:
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+_GENERATORS = {"explode", "explode_outer", "posexplode",
+               "posexplode_outer", "inline", "inline_outer", "stack"}
+
+
+def _is_generator(e: ex.Expr) -> bool:
+    return isinstance(e, ex.Function) and e.name.lower() in _GENERATORS
+
 
 def _unalias(e: ex.Expr) -> ex.Expr:
     while isinstance(e, ex.Alias):

@@ -351,6 +351,20 @@ def common_type(a: DataType, b: DataType) -> DataType:
             assert isinstance(b, DecimalType)
             return b if _NUMERIC_ORDER[an] < _NUMERIC_ORDER["DecimalType"] else a
         return a if _NUMERIC_ORDER[an] >= _NUMERIC_ORDER[bn] else b
+    if isinstance(a, ArrayType) and isinstance(b, ArrayType):
+        return ArrayType(common_type(a.element_type, b.element_type),
+                         a.contains_null or b.contains_null)
+    if isinstance(a, MapType) and isinstance(b, MapType):
+        return MapType(common_type(a.key_type, b.key_type),
+                       common_type(a.value_type, b.value_type),
+                       a.value_contains_null or b.value_contains_null)
+    if isinstance(a, StructType) and isinstance(b, StructType) and \
+            len(a.fields) == len(b.fields):
+        return StructType(tuple(
+            StructField(fa.name,
+                        common_type(fa.data_type, fb.data_type),
+                        fa.nullable or fb.nullable)
+            for fa, fb in zip(a.fields, b.fields)))
     if isinstance(a, StringType) and b.is_numeric:
         return DoubleType()
     if isinstance(b, StringType) and a.is_numeric:

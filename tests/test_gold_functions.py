@@ -11,7 +11,7 @@ from gold_harness import gold_available, load_suites, run_suites
 MIN_PASS = {
     "agg": 125, "array": 40, "bitwise": 14, "collection": 10,
     "conditional": 11, "conversion": 2, "csv": 0, "datetime": 85,
-    "generator": 0, "hash": 4, "json": 14, "lambda": 28, "map": 11,
+    "generator": 7, "hash": 4, "json": 14, "lambda": 28, "map": 11,
     "math": 75, "misc": 9, "predicate": 60, "st": 0, "string": 150,
     "struct": 2, "url": 9, "variant": 0, "window": 8, "xml": 0,
 }
@@ -42,4 +42,4 @@ def test_gold_total_report(results):
     tr = sum(s["ref_ok"] for s in results.values())
     print(f"\ngold functions: {tp}/{tt} = {100*tp/tt:.1f}% "
           f"(reference: {tr}/{tt} = {100*tr/tt:.1f}%)")
-    assert tp >= 650  # total floor; ratchet up with coverage
+    assert tp >= 660  # total floor; ratchet up with coverage

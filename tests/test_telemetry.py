@@ -13,12 +13,14 @@ def test_explain_analyze_reports_operator_metrics():
                     "GROUP BY g ORDER BY g").toPandas()
     text = out.plan[0]
     assert "total:" in text
-    for op in ("ScanExec", "FilterExec", "AggregateExec", "SortExec"):
+    # the profile measures the PRODUCTION program: the fused
+    # filter+project+aggregate pipeline reports as ONE operator
+    for op in ("ScanExec", "FusedAggregate", "SortExec"):
         assert op in text, text
+    assert "FilterExec" in text  # named inside the fused chain detail
     assert "rows=" in text and "time=" in text
-    # filter output rows must be 4 (v>0)
-    filter_line = [l for l in text.splitlines() if "FilterExec" in l][0]
-    assert "rows=4" in filter_line, filter_line
+    fused_line = [l for l in text.splitlines() if "FusedAggregate" in l][0]
+    assert "rows=3" in fused_line, fused_line  # 3 groups out
 
 
 def test_metrics_off_by_default():
