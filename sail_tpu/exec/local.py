@@ -40,7 +40,9 @@ class ExecutionError(RuntimeError):
     pass
 
 
-def _generate_rows(kind: str, args: List, n_cols: int) -> List[tuple]:
+def _generate_rows(kind: str, args: List, col_names: List[str]
+                   ) -> List[tuple]:
+    n_cols = len(col_names)
     if kind == "explode":
         c = args[0]
         if c is None:
@@ -63,6 +65,10 @@ def _generate_rows(kind: str, args: List, n_cols: int) -> List[tuple]:
         for st in c:
             if st is None:
                 out.append(tuple([None] * n_cols))
+            elif all(n in st for n in col_names):
+                # match struct fields by NAME (dict insertion order may
+                # differ between elements)
+                out.append(tuple(st[n] for n in col_names))
             else:
                 vals = list(st.values())
                 out.append(tuple(vals[:n_cols] +
@@ -147,11 +153,11 @@ def _host_agg_one(spec, cols, rows_idx, host_aggs):
                     if not (r in seen or seen.append(r))]
         return ha.impl(rows)
     nn = None if vals is None else [v for v in vals if v is not None]
+    if spec.distinct and nn:
+        nn = list(dict.fromkeys(_hashable(v) for v in nn))
     if fn == "count":
         return len(rows_idx) if vals is None else len(nn)
     if fn == "sum":
-        if spec.distinct and nn:
-            nn = list(dict.fromkeys(nn))
         return sum(nn) if nn else None
     if fn == "min":
         return min(nn) if nn else None
@@ -609,7 +615,7 @@ class LocalExecutor:
             pt = tuple(vals[row_i] for _, vals in pt_vals)
             gen_rows = _generate_rows(
                 p.generator, [col[row_i] for col in arg_vals],
-                len(p.gen_schema))
+                [f.name for f in p.gen_schema])
             if not gen_rows and p.outer:
                 gen_rows = [tuple([None] * len(p.gen_schema))]
             for g in gen_rows:
@@ -787,7 +793,8 @@ class LocalExecutor:
         # compiles to a single XLA executable). Under EXPLAIN ANALYZE run
         # unfused so every operator reports its own rows/time.
         from .. import telemetry as tel
-        if any(a.fn.startswith("__host__") for a in p.aggs):
+        if any(a.fn.startswith("__host__") for a in p.aggs) or \
+                any(a.distinct for a in p.aggs):
             return self._host_aggregate(p, self.run(p.input))
         chunked = self._try_chunked_aggregate(p)
         if chunked is not None:
@@ -798,12 +805,22 @@ class LocalExecutor:
         chain, child, bottom_node = self._pipeline_chain(p.input)
         if tel.current_collector() is not None and chain:
             ops = "+".join(type(c).__name__ for c in chain)
-            with tel.operator_span("FusedAggregate", ops) as m:
-                out = self._agg_with_chain_or_unfused(p, chain, child,
-                                                      bottom_node)
-                m.output_rows = int(out.device.num_rows())
-                m.capacity = out.capacity
-                return out
+            try:
+                with tel.operator_span("FusedAggregate", ops) as m:
+                    out = self._agg_with_chain(p, chain, child, bottom_node)
+                    m.output_rows = int(out.device.num_rows())
+                    m.capacity = out.capacity
+                    return out
+            except HostFallback:
+                # the fused attempt aborted (span discarded): run and
+                # profile the actual unfused program instead
+                child = self.run(chain[0])
+                with tel.operator_span("AggregateExec",
+                                       "unfused (host fallback)") as m:
+                    out = self._agg_with_chain(p, [], child, p.input)
+                    m.output_rows = int(out.device.num_rows())
+                    m.capacity = out.capacity
+                    return out
         return self._agg_with_chain_or_unfused(p, chain, child, bottom_node)
 
     def _agg_with_chain_or_unfused(self, p, chain, child, bottom_node):

@@ -599,6 +599,11 @@ class Resolver:
             r = self._resolve_expr(_unalias(it), cscope)
             passthrough.append((self._output_name(it), r))
         at = rx.rex_type(args[0]) if args else dt.NullType()
+        if base in ("explode", "posexplode") and not isinstance(
+                at, (dt.ArrayType, dt.MapType, dt.NullType)):
+            raise ResolutionError(
+                f"{base}() requires an array or map argument, got "
+                f"{at.simple_string()}")
         if base == "explode":
             if isinstance(at, dt.MapType):
                 gcols = [("key", at.key_type), ("value", at.value_type)]
@@ -653,23 +658,24 @@ class Resolver:
             child, base, tuple(args), outer_gen, tuple(passthrough),
             tuple(pn.Field(n, t, True) for n, t in gcols))
         # GenerateExec lays out passthrough then generator columns;
-        # restore the declared SELECT order when they differ
-        pt_names = [n for n, _ in passthrough]
-        declared = []
+        # restore the declared SELECT order POSITIONALLY (names may
+        # collide between passthrough and generator outputs)
+        n_pt = len(passthrough)
+        declared_pos = []
         pt_i = 0
-        for i, it in enumerate(items):
+        for i, _ in enumerate(items):
             if i == gi:
-                declared.extend(n for n, _ in gcols)
+                declared_pos.extend(n_pt + j for j in range(len(gcols)))
             else:
-                declared.append(pt_names[pt_i])
+                declared_pos.append(pt_i)
                 pt_i += 1
-        layout = pt_names + [n for n, _ in gcols]
-        if declared != layout:
-            by_name = {f.name: (j, f) for j, f in enumerate(node.schema)}
+        if declared_pos != list(range(len(node.schema))):
+            gschema = node.schema
             node = pn.ProjectExec(node, tuple(
-                (n, rx.BoundRef(by_name[n][0], n, by_name[n][1].dtype,
-                                by_name[n][1].nullable))
-                for n in declared))
+                (gschema[j].name, rx.BoundRef(j, gschema[j].name,
+                                              gschema[j].dtype,
+                                              gschema[j].nullable))
+                for j in declared_pos))
         fields = [ScopeField(f.name, (), f.dtype, f.nullable)
                   for f in node.schema]
         return node, Scope(fields, outer, cscope.ctes)
@@ -887,9 +893,8 @@ class Resolver:
         if plan.having is not None:
             having_rex = collector.rewrite(self._subst_alias(plan.having, items))
 
-        if collector.has_distinct and any(not a.spec.distinct for a in collector.aggs):
-            raise ResolutionError("mixing DISTINCT and non-DISTINCT aggregates "
-                                  "is not supported yet")
+        mixed_distinct = collector.has_distinct and any(
+            not a.spec.distinct for a in collector.aggs)
 
         # pre-projection: group keys then agg args
         pre = [( _fresh("g"), g) for g in group_rex]
@@ -898,7 +903,7 @@ class Resolver:
         pre_node = pn.ProjectExec(child, tuple(pre))
         ngroup = len(group_rex)
 
-        if collector.has_distinct:
+        if collector.has_distinct and not mixed_distinct:
             # two-level: group by keys + distinct args, then aggregate
             inner = pn.AggregateExec(
                 pre_node,
@@ -908,12 +913,16 @@ class Resolver:
             specs = []
             for a in collector.aggs:
                 arg = None if a.arg is None else ngroup + a.arg
-                specs.append(dataclasses.replace(a.spec, arg=arg))
+                # the inner dedup already realized DISTINCT
+                specs.append(dataclasses.replace(a.spec, arg=arg,
+                                                 distinct=False))
             agg_node = pn.AggregateExec(
                 inner, tuple(range(ngroup)), tuple(specs),
                 tuple(n for n, _ in pre[:ngroup])
                 + tuple(_fresh("agg") for _ in specs))
         else:
+            # mixed DISTINCT/non-DISTINCT: specs keep their distinct flags
+            # and the executor's host aggregation applies them per spec
             specs = []
             for a in collector.aggs:
                 arg = None if a.arg is None else ngroup + a.arg

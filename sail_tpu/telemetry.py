@@ -76,7 +76,13 @@ def operator_span(name: str, detail: str = ""):
         span_cm.__enter__()
     try:
         yield m
-    finally:
+    except BaseException:
+        # aborted spans (e.g. a fused attempt that fell back) don't record
+        if span_cm is not None:
+            span_cm.__exit__(None, None, None)
+        _local.collector = parent
+        raise
+    else:
         if span_cm is not None:
             span_cm.__exit__(None, None, None)
         m.elapsed_ms = (time.perf_counter() - t0) * 1000
