@@ -276,6 +276,8 @@ class SparkSession:
             return self._delta_delete(cmd)
         if isinstance(cmd, sp.Update):
             return self._delta_update(cmd)
+        if isinstance(cmd, sp.MergeInto):
+            return self._delta_merge(cmd)
         if isinstance(cmd, sp.Explain):
             from .plan.nodes import explain
             node = self._resolve(cmd.query)
@@ -395,6 +397,187 @@ class SparkSession:
             tx.commit()
         return pa.table({"num_affected_rows":
                          pa.array([updated], type=pa.int64())})
+
+    def _delta_merge(self, cmd: sp.MergeInto) -> pa.Table:
+        """MERGE INTO on a Delta table (reference role:
+        crates/sail-delta-lake/src/physical_plan/planner/op_merge.rs —
+        copy-on-write variant). The match sets and per-clause values are
+        computed by the ENGINE over the target⋈source join; the final
+        table commits as one MERGE transaction."""
+        import numpy as np
+
+        entry, dt_table = self._delta_entry(cmd.target)
+        snap = dt_table.snapshot()
+        schema = snap.schema
+        col_names = [f.name for f in schema.fields]
+        t_arrow = dt_table.to_arrow(version=snap.version)
+        t_arrow = t_arrow.append_column(
+            "__rid__", pa.array(np.arange(t_arrow.num_rows), pa.int64()))
+        t_alias = (cmd.target_alias or cmd.target[-1])
+        target_plan = sp.SubqueryAlias(sp.LocalRelation(t_arrow), t_alias)
+
+        def run(plan):
+            return self._execute_query(plan)
+
+        # materialize the source ONCE with row ids, so not-matched clauses
+        # can claim rows first-clause-wins; keep (or synthesize) its alias
+        s_alias = cmd.source.alias \
+            if isinstance(cmd.source, sp.SubqueryAlias) else "__src__"
+        s_arrow = run(cmd.source)
+        s_cols = list(s_arrow.column_names)
+        s_arrow = s_arrow.append_column(
+            "__srid__", pa.array(np.arange(s_arrow.num_rows), pa.int64()))
+        source_plan = sp.SubqueryAlias(sp.LocalRelation(s_arrow), s_alias)
+        join = sp.Join(target_plan, source_plan, "inner", cmd.condition)
+
+        if cmd.matched_actions:
+            # a target row may be updated/deleted by at most one source row
+            dup = run(sp.Filter(
+                sp.Aggregate(join, (ex.col("__rid__"),),
+                             (ex.col("__rid__"),
+                              ex.Alias(ex.Function("count", ()), ("c",)))),
+                ex.Function(">", (ex.col("c"), ex.lit(1)))))
+            if dup.num_rows:
+                raise ValueError(
+                    "MERGE cardinality violation: a target row matched "
+                    "multiple source rows")
+
+        claimed: set = set()
+        updates: Dict[int, dict] = {}
+        deletes: set = set()
+        for action in cmd.matched_actions:
+            base: sp.QueryPlan = join
+            if action.condition is not None:
+                base = sp.Filter(join, action.condition)
+            if action.action == "delete":
+                rids = run(sp.Project(base, (ex.col("__rid__"),)))
+                for r in rids.column(0).to_pylist():
+                    if r not in claimed:
+                        claimed.add(r)
+                        deletes.add(r)
+            elif action.action in ("update", "update_star"):
+                exprs = [ex.Alias(ex.col("__rid__"), ("__rid__",))]
+                if action.action == "update_star":
+                    assigns = {c.lower(): ex.Attribute((s_alias, c))
+                               for c in s_cols}
+                else:
+                    assigns = {path[-1].lower(): e
+                               for path, e in action.assignments}
+                for c, f in zip(col_names, schema.fields):
+                    e = assigns.get(c.lower())
+                    e = ex.Attribute((t_alias, c)) if e is None else \
+                        ex.Cast(e, f.data_type)
+                    exprs.append(ex.Alias(e, (c,)))
+                rows = run(sp.Project(base, tuple(exprs))).to_pylist()
+                for row in rows:
+                    rid = row.pop("__rid__")
+                    if rid not in claimed:
+                        claimed.add(rid)
+                        updates[rid] = row
+            else:
+                raise ValueError(
+                    f"unsupported matched action {action.action!r}")
+        # not-matched source rows → inserts (first satisfied clause wins)
+        inserts = []
+        claimed_src: set = set()
+        anti = sp.Join(source_plan, target_plan, "anti", cmd.condition)
+        for action in cmd.not_matched_actions:
+            base = anti
+            if action.condition is not None:
+                base = sp.Filter(anti, action.condition)
+            if action.action == "insert_star":
+                src_low = {c.lower(): c for c in s_cols}
+                assigns = {c.lower(): ex.Attribute(
+                    (s_alias, src_low[c.lower()]))
+                    for c in col_names if c.lower() in src_low}
+            elif action.action == "insert":
+                assigns = {path[-1].lower(): e
+                           for path, e in action.assignments}
+            else:
+                raise ValueError(
+                    f"unsupported not-matched action {action.action!r}")
+            exprs = [ex.Alias(ex.Attribute((s_alias, "__srid__")),
+                              ("__srid__",))]
+            for c, f in zip(col_names, schema.fields):
+                e = assigns.get(c.lower())
+                e = ex.lit(None) if e is None else ex.Cast(e, f.data_type)
+                exprs.append(ex.Alias(e, (c,)))
+            for row in run(sp.Project(base, tuple(exprs))).to_pylist():
+                srid = row.pop("__srid__")
+                if srid not in claimed_src:
+                    claimed_src.add(srid)
+                    inserts.append(row)
+        # not matched by source → update/delete target rows without a match
+        if cmd.not_matched_by_source_actions:
+            t_anti = sp.Join(target_plan, source_plan, "anti",
+                             cmd.condition)
+            for action in cmd.not_matched_by_source_actions:
+                base = t_anti
+                if action.condition is not None:
+                    base = sp.Filter(t_anti, action.condition)
+                if action.action == "delete":
+                    for r in run(sp.Project(
+                            base, (ex.col("__rid__"),))).column(0).to_pylist():
+                        if r not in claimed:
+                            claimed.add(r)
+                            deletes.add(r)
+                elif action.action == "update":
+                    assigns = {path[-1].lower(): e
+                               for path, e in action.assignments}
+                    exprs = [ex.Alias(ex.col("__rid__"), ("__rid__",))]
+                    for c in col_names:
+                        exprs.append(ex.Alias(
+                            assigns.get(c.lower(), ex.Attribute((c,))),
+                            (c,)))
+                    for row in run(sp.Project(base,
+                                              tuple(exprs))).to_pylist():
+                        rid = row.pop("__rid__")
+                        if rid not in claimed:
+                            claimed.add(rid)
+                            updates[rid] = row
+        if not (updates or deletes or inserts):
+            return pa.table({
+                "num_affected_rows": pa.array([0], type=pa.int64()),
+                "num_updated_rows": pa.array([0], type=pa.int64()),
+                "num_deleted_rows": pa.array([0], type=pa.int64()),
+                "num_inserted_rows": pa.array([0], type=pa.int64()),
+            })
+        # assemble the copy-on-write result and commit as MERGE
+        base_rows = t_arrow.drop_columns(["__rid__"]).to_pylist()
+        out_rows = []
+        for rid, row in enumerate(base_rows):
+            if rid in deletes:
+                continue
+            out_rows.append(updates.get(rid, row))
+        out_rows.extend(inserts)
+        from .columnar.arrow_interop import spec_type_to_arrow
+        target_schema = pa.schema(
+            [(f.name, spec_type_to_arrow(f.data_type))
+             for f in schema.fields])
+        final = pa.Table.from_pylist(out_rows, schema=target_schema) \
+            if out_rows else pa.Table.from_arrays(
+                [pa.array([], type=f.type) for f in target_schema],
+                schema=target_schema)
+        from .lakehouse.delta.log import RemoveFile
+        from .lakehouse.delta.transaction import Transaction
+        import time as _t
+        tx = Transaction(dt_table.log, snap.version, "MERGE")
+        tx.read_whole_table = True
+        now = int(_t.time() * 1000)
+        for path in snap.files:
+            tx.remove_file(RemoveFile(path, now))
+        for add in dt_table._write_data_files(
+                final, snap.metadata.partition_columns):
+            tx.add_file(add)
+        tx.commit()
+        return pa.table({
+            "num_affected_rows": pa.array(
+                [len(updates) + len(deletes) + len(inserts)],
+                type=pa.int64()),
+            "num_updated_rows": pa.array([len(updates)], type=pa.int64()),
+            "num_deleted_rows": pa.array([len(deletes)], type=pa.int64()),
+            "num_inserted_rows": pa.array([len(inserts)], type=pa.int64()),
+        })
 
     def _file_table_entry(self, cmd: sp.CreateTable) -> TableEntry:
         from .io.formats import infer_schema

@@ -412,6 +412,7 @@ class MergeAction:
 @dataclass(frozen=True)
 class MergeInto(CommandPlan):
     target: Tuple[str, ...] = ()
+    target_alias: Optional[str] = None
     source: QueryPlan = None
     condition: Expr = None
     matched_actions: Tuple[MergeAction, ...] = ()

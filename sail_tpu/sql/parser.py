@@ -1428,7 +1428,7 @@ class Parser:
         self.expect_kw("MERGE")
         self.expect_kw("INTO")
         target = self.parse_qualified_name()
-        self.parse_optional_alias()
+        target_alias = self.parse_optional_alias()
         self.expect_kw("USING")
         source = self.parse_relation_primary()
         self.expect_kw("ON")
@@ -1491,8 +1491,9 @@ class Parser:
                 not_matched.append(action)
             else:
                 matched.append(action)
-        return pl.MergeInto(target, source, condition, tuple(matched),
-                            tuple(not_matched), tuple(not_matched_by_source))
+        return pl.MergeInto(target, target_alias, source, condition,
+                            tuple(matched), tuple(not_matched),
+                            tuple(not_matched_by_source))
 
 
 def _number_literal(raw: str) -> ex.Literal:

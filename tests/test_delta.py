@@ -206,3 +206,86 @@ def test_threaded_appends_serialize(tmp_path):
     out = DeltaTable(path).to_arrow()
     assert out.num_rows == 7
     assert DeltaTable(path).snapshot().version == 6
+
+
+def test_merge_into_full_clause_set(tmp_path, spark):
+    path = str(tmp_path / "tm")
+    spark.createDataFrame(pd.DataFrame(
+        {"id": [1, 2, 3, 5], "v": [10.0, 20.0, 30.0, 50.0]})) \
+        .write.format("delta").save(path)
+    spark.sql(f"CREATE TABLE tm USING delta LOCATION '{path}'")
+    spark.createDataFrame(pd.DataFrame(
+        {"id": [2, 3, 4], "nv": [200.0, -1.0, 400.0]})) \
+        .createOrReplaceTempView("src_m")
+    out = spark.sql("""
+        MERGE INTO tm t USING src_m s ON t.id = s.id
+        WHEN MATCHED AND s.nv < 0 THEN DELETE
+        WHEN MATCHED THEN UPDATE SET v = s.nv
+        WHEN NOT MATCHED THEN INSERT (id, v) VALUES (s.id, s.nv)
+        WHEN NOT MATCHED BY SOURCE AND t.id = 5 THEN DELETE
+    """).toPandas()
+    assert out.num_updated_rows[0] == 1
+    assert out.num_deleted_rows[0] == 2   # id=3 (matched) + id=5 (by source)
+    assert out.num_inserted_rows[0] == 1
+    got = spark.sql("SELECT id, v FROM tm ORDER BY id").toPandas()
+    assert got.values.tolist() == [[1, 10.0], [2, 200.0], [4, 400.0]]
+    assert DeltaTable(path).history()[0]["operation"] == "MERGE"
+    # time travel still sees the pre-merge table
+    pre = spark.read.format("delta").option(
+        "versionAsOf", 0).load(path).toPandas()
+    assert sorted(pre.id) == [1, 2, 3, 5]
+
+
+def test_merge_cardinality_violation(tmp_path, spark):
+    path = str(tmp_path / "tm2")
+    spark.createDataFrame(pd.DataFrame(
+        {"id": [1], "v": [1.0]})).write.format("delta").save(path)
+    spark.sql(f"CREATE TABLE tm2 USING delta LOCATION '{path}'")
+    spark.createDataFrame(pd.DataFrame(
+        {"id": [1, 1], "nv": [2.0, 3.0]})).createOrReplaceTempView("src_d")
+    with pytest.raises(Exception, match="cardinality"):
+        spark.sql("MERGE INTO tm2 t USING src_d s ON t.id = s.id "
+                  "WHEN MATCHED THEN UPDATE SET v = s.nv")
+
+
+def test_merge_insert_first_clause_wins_and_no_noop_commit(tmp_path, spark):
+    path = str(tmp_path / "tm3")
+    spark.createDataFrame(pd.DataFrame(
+        {"id": [1], "v": [1.0]})).write.format("delta").save(path)
+    spark.sql(f"CREATE TABLE tm3 USING delta LOCATION '{path}'")
+    spark.createDataFrame(pd.DataFrame(
+        {"id": [7], "nv": [70.0]})).createOrReplaceTempView("src_f")
+    out = spark.sql("""
+        MERGE INTO tm3 t USING src_f s ON t.id = s.id
+        WHEN NOT MATCHED AND s.nv > 0 THEN INSERT (id, v) VALUES (s.id, s.nv)
+        WHEN NOT MATCHED THEN INSERT (id, v) VALUES (s.id, 0.0)
+    """).toPandas()
+    assert out.num_inserted_rows[0] == 1  # first clause claimed the row
+    got = spark.sql("SELECT id, v FROM tm3 ORDER BY id").toPandas()
+    assert got.values.tolist() == [[1, 1.0], [7, 70.0]]
+    v_before = DeltaTable(path).snapshot().version
+    # a merge that changes nothing must not commit a new version
+    spark.createDataFrame(pd.DataFrame(
+        {"id": [1], "nv": [0.0]})).createOrReplaceTempView("src_g")
+    out = spark.sql("MERGE INTO tm3 t USING src_g s ON t.id = s.id "
+                    "WHEN MATCHED AND s.nv > 5 THEN UPDATE SET v = s.nv"
+                    ).toPandas()
+    assert out.num_affected_rows[0] == 0
+    assert DeltaTable(path).snapshot().version == v_before
+
+
+def test_merge_insert_only_allows_duplicate_matches(tmp_path, spark):
+    path = str(tmp_path / "tm4")
+    spark.createDataFrame(pd.DataFrame(
+        {"id": [1], "v": [1.0]})).write.format("delta").save(path)
+    spark.sql(f"CREATE TABLE tm4 USING delta LOCATION '{path}'")
+    spark.createDataFrame(pd.DataFrame(
+        {"id": [1, 1, 9], "nv": [2.0, 3.0, 9.0]})) \
+        .createOrReplaceTempView("src_h")
+    # insert-only merge: duplicate matches on id=1 are fine
+    out = spark.sql("MERGE INTO tm4 t USING src_h s ON t.id = s.id "
+                    "WHEN NOT MATCHED THEN INSERT (id, v) VALUES (s.id, s.nv)"
+                    ).toPandas()
+    assert out.num_inserted_rows[0] == 1
+    got = spark.sql("SELECT id FROM tm4 ORDER BY id").toPandas()
+    assert got.id.tolist() == [1, 9]
