@@ -117,6 +117,32 @@ def _hashable(v):
     return v
 
 
+def _sort_key(v):
+    """Total-order sort key for nested values: nulls first at every
+    nesting level (Spark ordering), arrays/structs lexicographic."""
+    if v is None:
+        return (0,)
+    if isinstance(v, (list, tuple)):
+        return (1, tuple(_sort_key(x) for x in v))
+    if isinstance(v, dict):
+        return (1, tuple((k, _sort_key(x)) for k, x in v.items()))
+    return (1, v)
+
+
+def _dict_order_ranks(dictionary: pa.Array) -> np.ndarray:
+    """Order-preserving rank per dictionary code. Arrow sort covers
+    string/binary dictionaries; array/struct dictionaries (which Arrow
+    cannot sort) fall back to a host lexicographic sort."""
+    try:
+        return ai.dictionary_ranks(dictionary)
+    except (pa.ArrowNotImplementedError, pa.ArrowInvalid):
+        vals = dictionary.to_pylist()
+        order = sorted(range(len(vals)), key=lambda i: _sort_key(vals[i]))
+        ranks = np.empty(len(vals), dtype=np.int32)
+        ranks[order] = np.arange(len(vals), dtype=np.int32)
+        return ranks
+
+
 def _host_agg_one(spec, cols, rows_idx, host_aggs):
     """One aggregate over one group's row indices (host path)."""
     fn = spec.fn
@@ -154,15 +180,22 @@ def _host_agg_one(spec, cols, rows_idx, host_aggs):
         return ha.impl(rows)
     nn = None if vals is None else [v for v in vals if v is not None]
     if spec.distinct and nn:
-        nn = list(dict.fromkeys(_hashable(v) for v in nn))
+        # dedup on the hashable key but keep the ORIGINAL values, so
+        # min/max/first over array/struct columns return lists/dicts
+        seen: dict = {}
+        for v in nn:
+            seen.setdefault(_hashable(v), v)
+        nn = list(seen.values())
     if fn == "count":
         return len(rows_idx) if vals is None else len(nn)
     if fn == "sum":
         return sum(nn) if nn else None
     if fn == "min":
-        return min(nn) if nn else None
+        # compare via the sort key so array/struct values (incl. nested
+        # nulls) order per Spark but the ORIGINAL value returns
+        return min(nn, key=_sort_key) if nn else None
     if fn == "max":
-        return max(nn) if nn else None
+        return max(nn, key=_sort_key) if nn else None
     if fn == "first":
         pool = nn if spec.ignore_nulls else vals
         return pool[0] if pool else None
@@ -872,6 +905,21 @@ class LocalExecutor:
                 use_direct = (p.group_indices and direct_total is not None
                               and direct_total <= 4096)
 
+                # min/max over a dictionary-encoded column must order by
+                # VALUE, not code: remap codes through an order-preserving
+                # rank LUT before the segment reduce and back after
+                minmax_luts = {}
+                for j, a in enumerate(p.aggs):
+                    if a.fn in ("min", "max") and a.arg is not None:
+                        name = _col_name(a.arg)
+                        if name in top_dicts and len(top_dicts[name]) > 1:
+                            ranks = _dict_order_ranks(top_dicts[name])
+                            inv = np.empty_like(ranks)
+                            inv[ranks] = np.arange(len(ranks),
+                                                   dtype=ranks.dtype)
+                            minmax_luts[j] = (jnp.asarray(ranks),
+                                              jnp.asarray(inv))
+
                 def fn(cols, sel):
                     cols, sel = chain_fn(cols, sel)
                     key_cols = [Column(cols[i][0], cols[i][1],
@@ -884,11 +932,24 @@ class LocalExecutor:
                         ctx, sorted_keys = aggk.group_rows(key_cols, sel, mg)
                     gkeys = aggk.group_key_output(ctx, sorted_keys)
                     outs = []
-                    for a in p.aggs:
+                    for j, a in enumerate(p.aggs):
                         arg = None if a.arg is None else \
                             Column(cols[a.arg][0], cols[a.arg][1],
                                    in_schema[a.arg].dtype)
-                        col = self._run_agg(ctx, a, arg)
+                        lut = minmax_luts.get(j)
+                        if lut is not None:
+                            ranks_lut, inv_lut = lut
+                            codes = jnp.clip(arg.data, 0,
+                                             ranks_lut.shape[0] - 1)
+                            arg = Column(ranks_lut[codes], arg.validity,
+                                         arg.dtype)
+                            col = self._run_agg(ctx, a, arg)
+                            col = Column(
+                                inv_lut[jnp.clip(col.data, 0,
+                                                 inv_lut.shape[0] - 1)],
+                                col.validity, col.dtype)
+                        else:
+                            col = self._run_agg(ctx, a, arg)
                         outs.append((col.data, col.validity))
                     return ([(g.data, g.validity) for g in gkeys], outs,
                             aggk.group_sel(ctx), ctx.num_groups,
@@ -1554,14 +1615,17 @@ class LocalExecutor:
                 offset = 0
                 datas = []
                 chunks = []
+                at = ai.spec_type_to_arrow(f.dtype)
                 for b in parts:
                     d_b = b.dicts[key]
                     chunks.append(d_b)
                     datas.append(b.device.columns[key].data + offset)
                     offset += len(d_b)
+                # unify branch nullability (e.g. struct<x not null> vs
+                # struct<x>) to the union output type before concatenating
                 dicts[key] = pa.concat_arrays(
-                    [c.combine_chunks() if isinstance(c, pa.ChunkedArray)
-                     else c for c in chunks])
+                    [(c.combine_chunks() if isinstance(c, pa.ChunkedArray)
+                      else c).cast(at) for c in chunks])
             elif str_col:
                 from ..plan.compiler import _merge_dicts
                 merged, remaps = _merge_dicts([b.dicts[key] for b in parts])

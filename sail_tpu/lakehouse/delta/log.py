@@ -92,6 +92,10 @@ class Snapshot:
     protocol: Optional[Protocol]
     files: Dict[str, AddFile]
     timestamp_ms: int = 0
+    # Unexpired remove tombstones (path -> RemoveFile). External readers
+    # (VACUUM, retention) need these preserved across checkpoints.
+    tombstones: Dict[str, "RemoveFile"] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def schema(self):
@@ -114,6 +118,32 @@ def _maps_to_dicts(v):
                 out[k] = _maps_to_dicts(x)
         return out
     return v
+
+
+_DEFAULT_RETENTION_MS = 7 * 24 * 3600 * 1000  # delta default: 1 week
+
+_INTERVAL_UNITS_MS = {
+    "millisecond": 1, "second": 1000, "minute": 60_000, "hour": 3_600_000,
+    "day": 86_400_000, "week": 7 * 86_400_000,
+}
+
+
+def _retention_ms(snapshot: "Snapshot") -> int:
+    """deletedFileRetentionDuration from table config ("interval N unit")."""
+    if snapshot.metadata is None:
+        return _DEFAULT_RETENTION_MS
+    conf = dict(snapshot.metadata.configuration)
+    raw = conf.get("delta.deletedFileRetentionDuration", "")
+    parts = raw.lower().split()
+    if len(parts) == 3 and parts[0] == "interval":
+        try:
+            n = int(parts[1])
+            unit = parts[2].rstrip("s")
+            if unit in _INTERVAL_UNITS_MS:
+                return n * _INTERVAL_UNITS_MS[unit]
+        except ValueError:
+            pass
+    return _DEFAULT_RETENTION_MS
 
 
 def _commit_path(log_dir: str, version: int) -> str:
@@ -214,6 +244,10 @@ class DeltaLog:
                     ("modificationTime", pa.int64()),
                     ("dataChange", pa.bool_()),
                     ("stats", pa.string())])),
+                ("remove", pa.struct([
+                    ("path", pa.string()),
+                    ("deletionTimestamp", pa.int64()),
+                    ("dataChange", pa.bool_())])),
             ])
         return DeltaLog._CP_SCHEMA
 
@@ -234,6 +268,12 @@ class DeltaLog:
             a["partitionValues"] = list(a["partitionValues"].items())
             a.setdefault("stats", None)
             rows.append({"add": a})
+        cutoff = int(time.time() * 1000) - _retention_ms(snapshot)
+        for rm in snapshot.tombstones.values():
+            # expire tombstones past the retention window (Delta protocol:
+            # checkpoints only carry unexpired removes)
+            if rm.deletion_timestamp >= cutoff:
+                rows.append({"remove": rm.to_json()["remove"]})
         schema = self._checkpoint_schema()
         cols = {name: [r.get(name) for r in rows] for name in schema.names}
         table = pa.table({n: pa.array(cols[n], type=schema.field(n).type)
@@ -315,11 +355,16 @@ class DeltaLog:
                                      p.get("minWriterVersion", 2))
         elif "add" in action:
             a = action["add"]
+            snap.tombstones.pop(a["path"], None)
             snap.files[a["path"]] = AddFile(
                 a["path"], a.get("size", 0),
                 tuple(sorted((a.get("partitionValues") or {}).items())),
                 a.get("modificationTime", 0), a.get("dataChange", True),
                 a.get("stats"))
         elif "remove" in action:
-            snap.files.pop(action["remove"]["path"], None)
+            r = action["remove"]
+            snap.files.pop(r["path"], None)
+            snap.tombstones[r["path"]] = RemoveFile(
+                r["path"], r.get("deletionTimestamp", 0),
+                r.get("dataChange", True))
         # commitInfo / txn are informational for replay

@@ -54,7 +54,14 @@ class IcebergConflict(Exception):
     pass
 
 
-def _spec_to_iceberg_schema(st) -> dict:
+def _spec_to_iceberg_schema(st) -> Tuple[dict, int]:
+    """Convert a spec StructType to an Iceberg schema dict. Returns the
+    schema plus the final field-id counter value: nested list/map/struct
+    types consume ids beyond the top-level field count, and the Iceberg
+    invariant requires last-column-id >= the max assigned field id.
+    Top-level field ids are recoverable from the returned schema's
+    ``fields`` list (partition-spec source-ids must use THOSE ids, not
+    positional indexes)."""
     from ...spec import data_type as dt
 
     next_id = [0]
@@ -92,7 +99,7 @@ def _spec_to_iceberg_schema(st) -> dict:
 
     out = conv(st)
     out["schema-id"] = 0
-    return out
+    return out, next_id[0]
 
 
 def _iceberg_type_to_spec(t):
@@ -240,19 +247,21 @@ class IcebergTable:
         st = dt.StructType(tuple(
             dt.StructField(n, arrow_type_to_spec(c.type), True)
             for n, c in zip(table.column_names, table.columns)))
+        schema_json, last_column_id = _spec_to_iceberg_schema(st)
         md = {
             "format-version": 2,
             "table-uuid": str(uuid.uuid4()),
             "location": self.path,
             "last-sequence-number": 0,
             "last-updated-ms": int(time.time() * 1000),
-            "last-column-id": len(st.fields),
+            "last-column-id": last_column_id,
             "current-schema-id": 0,
-            "schemas": [_spec_to_iceberg_schema(st)],
+            "schemas": [schema_json],
             "default-spec-id": 0,
             "partition-specs": [{"spec-id": 0, "fields": [
                 {"name": c, "transform": "identity",
-                 "source-id": [f.name for f in st.fields].index(c) + 1,
+                 "source-id": next(f["id"] for f in schema_json["fields"]
+                                   if f["name"] == c),
                  "field-id": 1000 + i}
                 for i, c in enumerate(partition_by)]}],
             "last-partition-id": 1000 + len(partition_by) - 1,
@@ -288,17 +297,44 @@ class IcebergTable:
         os.replace(hint_tmp, os.path.join(self.metadata_dir,
                                           "version-hint.text"))
 
+    def _partition_columns(self) -> List[str]:
+        """Identity-transform column names of the default partition spec."""
+        md = self.metadata()
+        spec_id = md.get("default-spec-id", 0)
+        for spec in md.get("partition-specs", []):
+            if spec.get("spec-id") == spec_id:
+                return [f["name"] for f in spec.get("fields", [])
+                        if f.get("transform") == "identity"]
+        return []
+
     def _write_data_files(self, table) -> List[dict]:
         import pyarrow.parquet as pq
 
         data_dir = os.path.join(self.path, "data")
         os.makedirs(data_dir, exist_ok=True)
-        name = f"data/{uuid.uuid4().hex}.parquet"
-        fp = os.path.join(self.path, name)
-        pq.write_table(table, fp)
-        return [{"content": 0, "file_path": name, "file_format": "PARQUET",
-                 "partition": {}, "record_count": table.num_rows,
-                 "file_size_in_bytes": os.path.getsize(fp)}]
+        part_cols = [c for c in self._partition_columns()
+                     if c in table.column_names]
+        if part_cols and table.num_rows:
+            groups: Dict[tuple, List[int]] = {}
+            rows = table.select(part_cols).to_pylist()
+            for i, row in enumerate(rows):
+                groups.setdefault(
+                    tuple(row[c] for c in part_cols), []).append(i)
+            splits = [({c: (None if v is None else str(v))
+                        for c, v in zip(part_cols, key)}, table.take(idxs))
+                      for key, idxs in groups.items()]
+        else:
+            splits = [({}, table)]
+        out = []
+        for partition, chunk in splits:
+            name = f"data/{uuid.uuid4().hex}.parquet"
+            fp = os.path.join(self.path, name)
+            pq.write_table(chunk, fp)
+            out.append({"content": 0, "file_path": name,
+                        "file_format": "PARQUET", "partition": partition,
+                        "record_count": chunk.num_rows,
+                        "file_size_in_bytes": os.path.getsize(fp)})
+        return out
 
     def _commit_snapshot(self, new_entries: List[dict],
                          carry_forward: bool, operation: str,

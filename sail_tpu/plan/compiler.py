@@ -51,6 +51,20 @@ class Compiled:
     dictionary: Optional[pa.Array] = None  # for string/binary outputs
 
 
+def _strip_nullability(d: dt.DataType) -> dt.DataType:
+    """Structural type with all nested nullability flags normalized."""
+    if isinstance(d, dt.ArrayType):
+        return dt.ArrayType(_strip_nullability(d.element_type), True)
+    if isinstance(d, dt.MapType):
+        return dt.MapType(_strip_nullability(d.key_type),
+                          _strip_nullability(d.value_type), True)
+    if isinstance(d, dt.StructType):
+        return dt.StructType(tuple(
+            dt.StructField(f.name, _strip_nullability(f.data_type), True)
+            for f in d.fields))
+    return d
+
+
 def _is_str(d: dt.DataType) -> bool:
     return isinstance(d, (dt.StringType, dt.BinaryType))
 
@@ -154,6 +168,14 @@ class ExprCompiler:
             return child
         if isinstance(src, dt.NullType):
             return self._compile_literal(LV(dst, None))
+        if isinstance(src, (dt.ArrayType, dt.MapType, dt.StructType)) or \
+                isinstance(dst, (dt.ArrayType, dt.MapType, dt.StructType)):
+            # nullability-widening casts (union type unification) are
+            # identity on the dictionary-coded representation; anything
+            # structural goes to the host interpreter
+            if _strip_nullability(src) == _strip_nullability(dst):
+                return Compiled(child.fn, dst, child.dictionary)
+            raise HostFallback("structural complex cast on the host")
         if _is_str(src):
             return self._cast_from_string(child, dst, r.try_)
         if _is_str(dst):

@@ -421,8 +421,14 @@ class SparkSession:
 
         # materialize the source ONCE with row ids, so not-matched clauses
         # can claim rows first-clause-wins; keep (or synthesize) its alias
-        s_alias = cmd.source.alias \
-            if isinstance(cmd.source, sp.SubqueryAlias) else "__src__"
+        # Spark exposes a plain named source table under its (unqualified)
+        # table name, so clause conditions like `src.flag` resolve
+        if isinstance(cmd.source, sp.SubqueryAlias):
+            s_alias = cmd.source.alias
+        elif isinstance(cmd.source, sp.ReadNamedTable):
+            s_alias = cmd.source.name[-1]
+        else:
+            s_alias = "__src__"
         s_arrow = run(cmd.source)
         s_cols = list(s_arrow.column_names)
         s_arrow = s_arrow.append_column(
@@ -431,9 +437,18 @@ class SparkSession:
         join = sp.Join(target_plan, source_plan, "inner", cmd.condition)
 
         if cmd.matched_actions:
-            # a target row may be updated/deleted by at most one source row
+            # a target row may be updated/deleted by at most one source row;
+            # like Delta, only matches that could actually modify a row count
+            # (a duplicate satisfying no matched-clause condition is fine)
+            card_base: sp.QueryPlan = join
+            conds = [a.condition for a in cmd.matched_actions]
+            if all(c is not None for c in conds):
+                disj = conds[0]
+                for c in conds[1:]:
+                    disj = ex.Function("or", (disj, c))
+                card_base = sp.Filter(join, disj)
             dup = run(sp.Filter(
-                sp.Aggregate(join, (ex.col("__rid__"),),
+                sp.Aggregate(card_base, (ex.col("__rid__"),),
                              (ex.col("__rid__"),
                               ex.Alias(ex.Function("count", ()), ("c",)))),
                 ex.Function(">", (ex.col("c"), ex.lit(1)))))
@@ -525,10 +540,11 @@ class SparkSession:
                     assigns = {path[-1].lower(): e
                                for path, e in action.assignments}
                     exprs = [ex.Alias(ex.col("__rid__"), ("__rid__",))]
-                    for c in col_names:
-                        exprs.append(ex.Alias(
-                            assigns.get(c.lower(), ex.Attribute((c,))),
-                            (c,)))
+                    for c, f in zip(col_names, schema.fields):
+                        e = assigns.get(c.lower())
+                        e = ex.Attribute((c,)) if e is None \
+                            else ex.Cast(e, f.data_type)
+                        exprs.append(ex.Alias(e, (c,)))
                     for row in run(sp.Project(base,
                                               tuple(exprs))).to_pylist():
                         rid = row.pop("__rid__")

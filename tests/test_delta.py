@@ -289,3 +289,32 @@ def test_merge_insert_only_allows_duplicate_matches(tmp_path, spark):
     assert out.num_inserted_rows[0] == 1
     got = spark.sql("SELECT id FROM tm4 ORDER BY id").toPandas()
     assert got.id.tolist() == [1, 9]
+
+
+def test_checkpoint_carries_remove_tombstones(tmp_path):
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "t_cp_rm")
+    t = DeltaTable(path)
+    t.create(_df([0.0]))
+    t.append(_df([100.0]))
+    version, deleted = t.delete_where(
+        lambda tb: pc.not_equal(tb.column("v"), 100.0))
+    assert deleted == 1
+    for i in range(1, 12):
+        t.append(_df([float(i)]))
+    log = DeltaLog(path)
+    cp = log.last_checkpoint()
+    assert cp is not None
+    table = pq.read_table(os.path.join(
+        path, "_delta_log", f"{cp:020d}.checkpoint.parquet"))
+    assert "remove" in table.column_names
+    removes = [r for r in table.column("remove").to_pylist()
+               if r is not None]
+    assert len(removes) == 1 and removes[0]["path"]
+    # replay through the checkpoint reconstructs the tombstone set
+    snap = log.snapshot()
+    assert len(snap.tombstones) == 1
+    vals = sorted(t.to_arrow().column("v").to_pylist())
+    assert vals == [float(i) for i in range(12)]

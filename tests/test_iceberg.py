@@ -113,3 +113,38 @@ def test_session_read_write_iceberg(tmp_path, spark):
     old = spark.read.format("iceberg").option("snapshot-id", first) \
         .load(path).toPandas()
     assert len(old) == 3
+
+
+def test_partitioned_write_populates_partition_map(tmp_path):
+    path = str(tmp_path / "ice_part")
+    t = IcebergTable(path)
+    table = pa.table({"p": ["a", "a", "b"], "v": [1.0, 2.0, 3.0]})
+    t.create(table, partition_by=["p"])
+    files = t.data_files(t.snapshot())
+    # one data file per distinct partition value, each with the identity
+    # partition map populated per the declared spec
+    assert len(files) == 2
+    parts = sorted(df["partition"]["p"] for df in files)
+    assert parts == ["a", "b"]
+    out = t.to_arrow()
+    assert sorted(out.column("v").to_pylist()) == [1.0, 2.0, 3.0]
+    md = t.metadata()
+    # nested types would push last-column-id past the top-level count;
+    # here it equals the field count
+    assert md["last-column-id"] == 2
+
+
+def test_last_column_id_counts_nested_fields(tmp_path):
+    path = str(tmp_path / "ice_nested")
+    t = IcebergTable(path)
+    table = pa.table({
+        "a": pa.array([[1, 2]], type=pa.list_(pa.int64())),
+        "b": pa.array([{"x": 1, "y": "s"}],
+                      type=pa.struct([("x", pa.int64()),
+                                      ("y", pa.string())])),
+    })
+    t.create(table)
+    md = t.metadata()
+    # ids: a=1, b=2, a.element=3, b.x=4, b.y=5 (order may vary, but the
+    # counter must cover all five)
+    assert md["last-column-id"] == 5
