@@ -235,15 +235,29 @@ def column_values_to_arrow(data, validity, d, dictionary=None) -> pa.Array:
 
 
 def to_arrow(batch: HostBatch) -> pa.Table:
-    """Download a HostBatch to a pyarrow Table (live rows only, in order)."""
+    """Download a HostBatch to a pyarrow Table (live rows only, in order).
+
+    All device arrays (sel + every column's data/validity) are fetched in
+    ONE ``jax.device_get`` call: on a remote accelerator each blocking
+    fetch pays a full round trip, so per-column ``np.asarray`` loops are
+    O(columns) round trips while a batched get overlaps the transfers."""
+    import jax
+
     dev = batch.device
-    sel = np.asarray(dev.sel)
+    fetch = {"sel": dev.sel}
+    for name, col in dev.columns.items():
+        fetch[f"d:{name}"] = col.data
+        if col.validity is not None:
+            fetch[f"v:{name}"] = col.validity
+    host = jax.device_get(fetch)
+    sel = np.asarray(host["sel"])
     idx = np.nonzero(sel)[0]
     arrays = []
     fields = []
     for name, col in dev.columns.items():
-        data = np.asarray(col.data)[idx]
-        validity = None if col.validity is None else np.asarray(col.validity)[idx]
+        data = np.asarray(host[f"d:{name}"])[idx]
+        validity = (np.asarray(host[f"v:{name}"])[idx]
+                    if col.validity is not None else None)
         arr = _column_to_arrow(data, validity, col.dtype,
                                batch.dicts.get(name), name in batch.dicts)
         arrays.append(arr)

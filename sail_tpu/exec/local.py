@@ -152,6 +152,15 @@ def _host_agg_one(spec, cols, rows_idx, host_aggs):
         name = fn[len("__host__"):]
         ha = host_aggs[name]
         assert vals is not None
+        if name.startswith("__udaf_"):
+            # wire UDAFs see the FULL group including nulls (PySpark hands
+            # the grouped-agg pandas UDF the whole Series, NaN for NULL)
+            if vals and isinstance(vals[0], dict):
+                rows = [tuple(v.values()) if v is not None else None
+                        for v in vals]
+            else:
+                rows = list(vals)
+            return ha.impl(rows)
         if vals and isinstance(vals[0], dict):
             tuples = [tuple(v.values()) if v is not None else None
                       for v in vals]
@@ -572,8 +581,15 @@ class LocalExecutor:
         res = udf_invoke(u, cols_py, n)
         out_t = u.return_type
         if isinstance(out_t, (dt.StringType, dt.BinaryType)):
-            arr = pa.array([None if v is None else str(v) for v in res],
-                           type=pa.string())
+            def _null_like(v):
+                if v is None:
+                    return True
+                try:
+                    return bool(v != v)  # NaN
+                except (TypeError, ValueError):
+                    return True  # pd.NA: truth value is ambiguous → NULL
+            arr = pa.array([None if _null_like(v) else str(v)
+                            for v in res], type=pa.string())
             enc = arr.dictionary_encode()
             codes = np.asarray(enc.indices.fill_null(0)).astype(np.int32)
             import pyarrow.compute as _pc
@@ -957,11 +973,16 @@ class LocalExecutor:
                 return fn, top_dicts
             return builder
 
+        import jax
+
         key = self._op_key("agg", chain_key, p.group_indices, p.aggs, max_groups,
                            tuple((f.name, f.dtype) for f in bottom_node.schema))
         fn, top_dicts = self._jitted(key, self._dict_objs(child),
                                      make_builder(max_groups))
         gk, aggs_out, gsel, n_groups, overflow = fn(self._cols(child), dev.sel)
+        # one batched fetch: each blocking scalar read is a full round trip
+        # on a remote accelerator
+        n_groups, overflow = jax.device_get((n_groups, overflow))
         if p.max_groups_hint and bool(overflow):
             key2 = self._op_key("agg2", chain_key, p.group_indices, p.aggs,
                                 dev.capacity,
@@ -969,6 +990,7 @@ class LocalExecutor:
             fn2, top_dicts = self._jitted(key2, self._dict_objs(child),
                                           make_builder(dev.capacity))
             gk, aggs_out, gsel, n_groups, overflow = fn2(self._cols(child), dev.sel)
+            n_groups = jax.device_get(n_groups)
         out_cols: Dict[str, Column] = {}
         out_dicts: Dict[str, pa.Array] = {}
         for j, gi in enumerate(p.group_indices):
@@ -1258,6 +1280,8 @@ class LocalExecutor:
         dict_objs = self._dict_objs(left) + self._dict_objs(right)
         lcols, lsel = self._cols(left), left.device.sel
         rcols, rsel = self._cols(right), right.device.sel
+        import jax
+
         for seed in range(4):
             key = self._op_key("join_phase", p.left_keys, p.right_keys, seed,
                                schema_key)
@@ -1265,6 +1289,10 @@ class LocalExecutor:
                                  self._compile_join_keys(p, left, right, seed))
             (perm, sorted_keys, num_valid, lo, cnt, usable,
              has_dup_a, ambiguous, inner_total, exact) = fn(lcols, lsel, rcols, rsel)
+            # one batched fetch for every host decision scalar (each
+            # separate blocking read is a device round trip)
+            has_dup_a, ambiguous, inner_total, exact = jax.device_get(
+                (has_dup_a, ambiguous, inner_total, exact))
             if exact or not bool(ambiguous):
                 break
         else:
@@ -1422,8 +1450,10 @@ class LocalExecutor:
         return DeviceBatch(cols, sel)
 
     def _cross_join(self, p: pn.JoinExec, left: HostBatch, right: HostBatch) -> HostBatch:
-        n_left_rows = int(left.device.num_rows())
-        n_right_rows = int(right.device.num_rows())
+        import jax
+        n_left_rows, n_right_rows = (
+            int(x) for x in jax.device_get((left.device.num_rows(),
+                                            right.device.num_rows())))
         total = n_left_rows * n_right_rows
         cap = round_capacity(max(total, 1))
         lcomp = sortk.compact(left.device)

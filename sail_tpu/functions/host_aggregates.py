@@ -250,3 +250,74 @@ def _histogram(vals, nbins):
         pts[i] = [(a[0] * a[1] + b[0] * b[1]) / total, total]
         del pts[i + 1]
     return [{"x": x, "y": y} for x, y in pts]
+
+
+# -- wire UDAFs (pandas grouped-agg UDFs from Spark Connect clients) -----
+# Reference role: crates/sail-python-udf/src/udf/pyspark_udaf.rs — a
+# cloudpickled function receiving the group's values as pandas Series and
+# returning one scalar. Registered dynamically under a unique name so the
+# engine's AggSpec (a plain serializable dataclass) can reference it.
+
+_WIRE_UDAF_SEQ = [0]
+# (udf name, code fingerprint) → HOST_AGGS key. Re-resolving the same plan
+# (or the same wire payload decoded per-request) reuses one entry instead
+# of growing HOST_AGGS forever; capped LRU as a backstop.
+_WIRE_UDAF_CACHE: "OrderedDict[tuple, str]" = None  # type: ignore[assignment]
+_WIRE_UDAF_MAX = 512
+
+
+def _udaf_fingerprint(udf):
+    """Identity of a wire UDAF for reuse: code AND captured state — a
+    re-registered same-named lambda with different closure values or
+    defaults must NOT hit the cache."""
+    code = getattr(udf.func, "__code__", None)
+    if code is None:
+        return (udf.name, id(udf.func))
+    closure = tuple(
+        repr(getattr(c, "cell_contents", "<empty>"))
+        for c in (getattr(udf.func, "__closure__", None) or ()))
+    defaults = repr(getattr(udf.func, "__defaults__", None))
+    try:
+        return (udf.name, hash((code.co_code, code.co_consts, closure,
+                                defaults, repr(udf.return_type))))
+    except TypeError:
+        return (udf.name, hash((code.co_code, closure, defaults)))
+
+
+def register_wire_udaf(udf) -> str:
+    """Register a grouped-agg UDF; returns the HOST_AGGS key."""
+    import pandas as pd
+    from collections import OrderedDict
+
+    global _WIRE_UDAF_CACHE
+    if _WIRE_UDAF_CACHE is None:
+        _WIRE_UDAF_CACHE = OrderedDict()
+    fp = _udaf_fingerprint(udf)
+    hit = _WIRE_UDAF_CACHE.get(fp)
+    if hit is not None:
+        _WIRE_UDAF_CACHE.move_to_end(fp)
+        return hit
+    _WIRE_UDAF_SEQ[0] += 1
+    name = f"__udaf_{udf.name}_{_WIRE_UDAF_SEQ[0]}"
+
+    def impl(rows):
+        if not rows:
+            return None
+        first = next((r for r in rows if isinstance(r, tuple)), None)
+        if first is not None:
+            width = len(first)
+            filled = [r if isinstance(r, tuple) else (None,) * width
+                      for r in rows]
+            series = [pd.Series([r[i] for r in filled])
+                      for i in range(width)]
+        else:
+            series = [pd.Series(rows)]
+        return udf.func(*series)
+
+    HOST_AGGS[name] = HostAgg(_t(udf.return_type), impl, nargs=1,
+                              keep_nulls=False)
+    _WIRE_UDAF_CACHE[fp] = name
+    while len(_WIRE_UDAF_CACHE) > _WIRE_UDAF_MAX:
+        _, evicted = _WIRE_UDAF_CACHE.popitem(last=False)
+        HOST_AGGS.pop(evicted, None)
+    return name

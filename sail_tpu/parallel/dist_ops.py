@@ -32,20 +32,13 @@ from .exchange import bucket_by_partition
 
 def partition_arrays(arrays: Sequence[np.ndarray], n: int, num_partitions: int,
                      cap_per_part: Optional[int] = None):
-    """Host-side: split n rows round-robin-contiguously into [P, cap]."""
+    """Host-side: split n rows contiguously into [P, cap] (shared layout
+    helper: parallel.mesh.partition_rows)."""
+    from .mesh import partition_rows
     per = -(-n // num_partitions)
     cap = cap_per_part or max(8, per)
-    out = []
-    for a in arrays:
-        buf = np.zeros((num_partitions, cap), dtype=a.dtype)
-        for p in range(num_partitions):
-            chunk = a[p * per: (p + 1) * per]
-            buf[p, : len(chunk)] = chunk
-        out.append(buf)
-    sel = np.zeros((num_partitions, cap), dtype=bool)
-    for p in range(num_partitions):
-        cnt = max(0, min(per, n - p * per))
-        sel[p, :cnt] = True
+    out = [partition_rows(a, num_partitions, cap) for a in arrays]
+    sel = partition_rows(np.ones(n, dtype=bool), num_partitions, cap)
     return out, sel
 
 

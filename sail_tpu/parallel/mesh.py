@@ -40,3 +40,16 @@ def shard_batch_arrays(mesh: Mesh, arrays):
     """Place [P, ...] arrays with the partition dim sharded over the mesh."""
     sharding = NamedSharding(mesh, partitioned_spec())
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), arrays)
+
+
+def partition_rows(arr: np.ndarray, n_parts: int, cap: int) -> np.ndarray:
+    """Split [n] rows contiguously into [n_parts, cap] (zero-padded) —
+    the host-side layout contract for sharded batches (live rows are a
+    per-partition prefix)."""
+    n = arr.shape[0]
+    per = -(-n // n_parts) if n else 0
+    out = np.zeros((n_parts, cap), dtype=arr.dtype)
+    for p in range(n_parts):
+        chunk = arr[p * per: (p + 1) * per]
+        out[p, : len(chunk)] = chunk
+    return out

@@ -1,0 +1,749 @@
+"""SPMD mesh executor: a whole multi-stage job graph as ONE jitted program.
+
+Reference role: the distributed execution path — ShuffleWriteExec hash
+repartitioning + the Arrow Flight stream data plane + per-stage task
+execution (crates/sail-execution/src/plan/shuffle_write.rs:42-114,
+src/stream_service/server.rs:22-70, SURVEY.md §2.5/§2.8). TPU-native
+redesign: when every stage of a job graph is co-resident on one
+jax.sharding.Mesh, the stages and their exchanges compile into a single
+shard_map program — SHUFFLE edges lower to local bucket scatter +
+``jax.lax.all_to_all`` and BROADCAST edges to ``jax.lax.all_gather``, both
+riding ICI instead of a host TCP data plane. The gRPC cluster runtime
+(exec/cluster.py) remains the elastic fallback for graphs that cannot
+co-reside (dynamic worker sets, host-only operators).
+
+Static-shape contract: every stage output has a bind-time capacity; hash
+buckets and group tables export overflow counters, and the host re-runs
+the program with scaled capacities when any overflow fires (the same
+detect-and-rerun protocol as parallel/exchange.py). Build-side duplicate
+keys in a join make the unique-probe plan invalid — that is a *fatal* flag
+and the query falls back to the local/cluster path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from ..columnar import arrow_interop as ai
+from ..columnar.batch import Column, DeviceBatch, HostBatch, round_capacity
+from ..ops import aggregate as aggk
+from ..ops import join as joink
+from ..ops.hash import hash64
+from ..plan import nodes as pn
+from ..plan import rex as rx
+from ..plan.compiler import ExprCompiler, HostFallback
+from ..spec import data_type as dt
+from ..exec import job_graph as jg
+from .exchange import bucket_by_partition
+from .mesh import DATA_AXIS, make_mesh, partition_rows
+
+_MESH_AGGS = {"count", "sum", "min", "max", "first", "last",
+              "bool_and", "bool_or"}
+_DEFAULT_GROUPS = 4096
+
+
+class MeshUnsupported(Exception):
+    """Plan shape the SPMD compiler cannot express; caller falls back."""
+
+
+# Cols are positional lists of (data, validity-or-None); a fragment maps an
+# environment of stage outputs to its own (cols, sel, retry_flags,
+# fatal_flags).
+Cols = List[Tuple[jnp.ndarray, Optional[jnp.ndarray]]]
+
+
+@dataclasses.dataclass
+class _Frag:
+    fn: Callable  # env -> (cols, sel, retry, fatal)
+    types: List[dt.DataType]
+    dicts: Dict[int, pa.Array]
+    cap: int  # per-shard output capacity
+
+
+@dataclasses.dataclass
+class _LeafData:
+    """Host-partitioned scan data for one leaf stage."""
+    datas: List[np.ndarray]          # [P, cap] per column
+    validities: List[Optional[np.ndarray]]
+    sel: np.ndarray                  # [P, cap]
+    types: List[dt.DataType]
+    dicts: Dict[int, pa.Array]
+    cap: int
+
+
+def _positional_name(i: int) -> str:
+    return f"c{i}"
+
+
+# Compiled SPMD programs, keyed by (structural graph key, leaf-dictionary
+# identity) — same contract as the local executor's _OpCache: entries hold
+# strong references to the dictionaries baked into their closures.
+_PROGRAM_CACHE: Dict = {}
+_PROGRAM_CACHE_MAX = 64
+
+
+def _leaf_layout(leaves: Dict[int, "_LeafData"]):
+    """Static input layout: [(leaf_id, (has_validity per column, ...))]."""
+    return [(lid, tuple(v is not None for v in leaves[lid].validities))
+            for lid in sorted(leaves)]
+
+
+def _make_rebuild(layout):
+    """Flat shard_map args → {leaf_id: (cols, sel)}. Closes over the
+    static layout only (not the leaf buffers), so cached programs don't
+    retain host data."""
+
+    def rebuild(args):
+        env: Dict = {}
+        it = iter(args)
+        for lid, has_validity in layout:
+            cols: Cols = []
+            for hv in has_validity:
+                d = next(it)[0]
+                val = next(it)[0] if hv else None
+                cols.append((d, val))
+            sel = next(it)[0]
+            env[lid] = (cols, sel)
+        return env
+
+    return rebuild
+
+
+class MeshExecutor:
+    """Compiles a JobGraph into one shard_map program over a device mesh."""
+
+    def __init__(self, mesh=None, config: Optional[dict] = None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.config = config or {}
+        self.last_exchanges = 0       # collective edges in the last program
+        self.last_hlo: Optional[str] = None
+        self._group_cap = int(self.config.get(
+            "spark.sail.mesh.maxGroups", _DEFAULT_GROUPS))
+
+    @property
+    def nparts(self) -> int:
+        return int(self.mesh.shape[DATA_AXIS])
+
+    # ------------------------------------------------------------------
+    # public entry
+    # ------------------------------------------------------------------
+    def execute(self, plan: pn.PlanNode) -> Optional[pa.Table]:
+        """Run ``plan`` distributed over the mesh; None → not supported
+        (caller should run the local / gRPC-cluster path)."""
+        if self.nparts < 2:
+            return None
+        graph = jg.split_job(plan, self.nparts)
+        if graph is None:
+            return None
+        try:
+            return self._run_graph(graph)
+        except MeshUnsupported:
+            return None
+
+    # ------------------------------------------------------------------
+    # graph orchestration
+    # ------------------------------------------------------------------
+    def _consumer_modes(self, graph: jg.JobGraph) -> Dict[int, jg.InputMode]:
+        modes: Dict[int, jg.InputMode] = {}
+        for stage in graph.stages:
+            for si in stage.inputs:
+                if si.stage_id in modes and modes[si.stage_id] != si.mode:
+                    raise MeshUnsupported("stage consumed in two modes")
+                modes[si.stage_id] = si.mode
+        return modes
+
+    def _run_graph(self, graph: jg.JobGraph) -> pa.Table:
+        from ..exec.local import LocalExecutor
+
+        P = self.nparts
+        modes = self._consumer_modes(graph)
+        worker_stages = [s for s in graph.stages if not s.on_driver]
+        root = graph.root
+        if not root.on_driver or len(root.inputs) != 1:
+            raise MeshUnsupported("root stage shape")
+        top_id = root.inputs[0].stage_id
+
+        # host-side leaf data (shared across retries)
+        leaves: Dict[int, _LeafData] = {}
+        for stage in worker_stages:
+            scan = _bottom_scan(stage.plan)
+            if scan is not None:
+                leaves[stage.stage_id] = self._prepare_leaf(scan, graph, P)
+
+        attempts = [(1, 1), (4, 2), (16, 4)]
+        for groups_mult, bucket_mult in attempts:
+            result = self._compile_and_run(
+                graph, worker_stages, modes, leaves, top_id,
+                groups_mult, bucket_mult)
+            if result is None:
+                continue  # retryable overflow: scale capacities and redo
+            out_cols, out_sel, frag = result
+            table = self._assemble(out_cols, out_sel, frag)
+            root_plan = jg.attach_stage_inputs(root.plan, {top_id: table})
+            root_plan = _reattach_scans(root_plan, graph.scan_tables)
+            return LocalExecutor(self.config).execute(root_plan)
+        raise MeshUnsupported("capacity overflow after retries")
+
+    def _program_cache_key(self, worker_stages, leaves, groups_mult,
+                           bucket_mult):
+        """Structural cache key + the dictionary objects baked into the
+        compiled closures (same identity contract as local._OpCache)."""
+        plans = tuple(jg.encode_fragment(s.plan) for s in worker_stages)
+        shapes = tuple((s.stage_id, s.shuffle_keys, s.num_partitions)
+                       for s in worker_stages)
+        leaf_sig = tuple(
+            (lid, ld.cap, tuple(repr(t) for t in ld.types),
+             tuple(sorted(ld.dicts)))
+            for lid, ld in sorted(leaves.items()))
+        dict_objs = tuple(d for _, ld in sorted(leaves.items())
+                          for _, d in sorted(ld.dicts.items(), key=lambda kv: kv[0]))
+        key = (plans, shapes, leaf_sig, self.nparts, groups_mult,
+               bucket_mult, tuple(str(d) for d in self.mesh.devices.flat))
+        return key, dict_objs
+
+    def _compile_and_run(self, graph, worker_stages, modes, leaves, top_id,
+                         groups_mult, bucket_mult):
+        cache_key, dict_objs = self._program_cache_key(
+            worker_stages, leaves, groups_mult, bucket_mult)
+        ident = tuple(id(d) for d in dict_objs)
+        hit = _PROGRAM_CACHE.get((cache_key, ident))
+        if hit is not None and all(s is d for s, d in
+                                   zip(hit[0], dict_objs)):
+            _, jitted, stage_out, n_exchanges, hlo = hit
+            self.last_exchanges = n_exchanges
+            self.last_hlo = hlo
+            return self._run_program(jitted, leaves, stage_out, top_id)
+        return self._compile_fresh(cache_key, ident, dict_objs,
+                                   worker_stages, modes, leaves, top_id,
+                                   groups_mult, bucket_mult)
+
+    def _compile_fresh(self, cache_key, ident, dict_objs, worker_stages,
+                       modes, leaves, top_id, groups_mult, bucket_mult):
+        P = self.nparts
+        mesh = self.mesh
+
+        # ---- bind-time fragment compilation (host) --------------------
+        stage_frags: Dict[int, _Frag] = {}   # pre-exchange fragment
+        stage_out: Dict[int, _Frag] = {}     # post-exchange (consumable)
+        exchanges: List[Tuple[int, str, object]] = []
+        for stage in worker_stages:
+            frag = self._compile_node(
+                stage.plan, stage_out, leaves.get(stage.stage_id),
+                stage.stage_id, groups_mult)
+            stage_frags[stage.stage_id] = frag
+            mode = modes.get(stage.stage_id)
+            if mode == jg.InputMode.SHUFFLE:
+                if stage.shuffle_keys is None:
+                    raise MeshUnsupported("shuffle stage without keys")
+                bucket_cap = round_capacity(
+                    max(8, -(-frag.cap * 2 * bucket_mult // P)))
+                ex = self._bind_shuffle(frag, stage.shuffle_keys, P,
+                                        bucket_cap)
+                exchanges.append((stage.stage_id, "shuffle", ex))
+                stage_out[stage.stage_id] = dataclasses.replace(
+                    frag, cap=P * bucket_cap)
+            elif mode == jg.InputMode.BROADCAST:
+                exchanges.append((stage.stage_id, "broadcast", None))
+                stage_out[stage.stage_id] = dataclasses.replace(
+                    frag, cap=P * frag.cap)
+            else:  # FORWARD / MERGE / None
+                stage_out[stage.stage_id] = frag
+
+        # ---- assemble the single SPMD program -------------------------
+        exchange_of = {sid: (kind, ex) for sid, kind, ex in exchanges}
+        layout = _leaf_layout(leaves)
+        rebuild = _make_rebuild(layout)
+        n_flat = sum(len(hvs) + sum(hvs) + 1 for _, hvs in layout)
+
+        def program(*flat):
+            env: Dict = {("leaf", lid): v
+                         for lid, v in rebuild(flat).items()}
+            retry: List[jnp.ndarray] = []
+            fatal: List[jnp.ndarray] = []
+            for stage in worker_stages:
+                cols, sel, r, f = stage_frags[stage.stage_id].fn(env)
+                retry.extend(r)
+                fatal.extend(f)
+                kind_ex = exchange_of.get(stage.stage_id)
+                if kind_ex is not None:
+                    kind, ex = kind_ex
+                    if kind == "shuffle":
+                        cols, sel, over = ex(cols, sel)
+                        retry.append(over)
+                    else:  # broadcast
+                        cols = [(jax.lax.all_gather(d, DATA_AXIS, tiled=True),
+                                 None if v is None else
+                                 jax.lax.all_gather(v, DATA_AXIS, tiled=True))
+                                for d, v in cols]
+                        sel = jax.lax.all_gather(sel, DATA_AXIS, tiled=True)
+                env[stage.stage_id] = (cols, sel)
+            out_cols, out_sel = env[top_id]
+            retry_total = sum((jnp.asarray(r).astype(jnp.int32).sum()
+                               for r in retry), start=jnp.int32(0))
+            fatal_total = sum((jnp.asarray(f).astype(jnp.int32).sum()
+                               for f in fatal), start=jnp.int32(0))
+            flat_out = []
+            for d, v in out_cols:
+                flat_out.append(d[None])
+                flat_out.append(jnp.ones_like(out_sel)[None] if v is None
+                                else v[None])
+            return (tuple(flat_out), out_sel[None], retry_total[None],
+                    fatal_total[None])
+
+        from jax.sharding import PartitionSpec as Pspec
+        spec = Pspec(DATA_AXIS)
+        wrapped = jax.shard_map(
+            program, mesh=mesh,
+            in_specs=tuple(spec for _ in range(n_flat)),
+            out_specs=(spec, spec, spec, spec))
+        jitted = jax.jit(wrapped)
+        self.last_exchanges = len(exchanges)
+        self.last_hlo = None
+        if self.config.get("spark.sail.mesh.captureHlo") == "true":
+            flat_probe = self._flatten_leaf_arrays(leaves)
+            self.last_hlo = jitted.lower(*flat_probe).as_text()
+        _PROGRAM_CACHE[(cache_key, ident)] = (
+            dict_objs, jitted, dict(stage_out), len(exchanges),
+            self.last_hlo)
+        while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+        return self._run_program(jitted, leaves, stage_out, top_id)
+
+    def _run_program(self, jitted, leaves, stage_out, top_id):
+        flat_in = self._flatten_leaf_arrays(leaves)
+        flat_out, out_sel, retry_tot, fatal_tot = jitted(*flat_in)
+        retry_tot, fatal_tot = jax.device_get(
+            (np.asarray(retry_tot), np.asarray(fatal_tot)))
+        if int(np.max(fatal_tot)) > 0:
+            raise MeshUnsupported("duplicate build keys in mesh join")
+        if int(np.max(retry_tot)) > 0:
+            return None
+        top = stage_out[top_id]
+        cols = []
+        for i in range(len(top.types)):
+            cols.append((flat_out[2 * i], flat_out[2 * i + 1]))
+        return cols, out_sel, top
+
+    # ------------------------------------------------------------------
+    # leaf preparation
+    # ------------------------------------------------------------------
+    def _prepare_leaf(self, scan: pn.ScanExec, graph: jg.JobGraph,
+                      P: int) -> _LeafData:
+        from ..exec.local import LocalExecutor, _positional
+
+        if scan.format == "__driver__":
+            table = graph.scan_tables[scan.table_name]
+            hb = _positional(ai.from_arrow(table))
+        else:
+            hb = LocalExecutor(self.config)._exec_ScanExec(scan)
+        dev = hb.device
+        host = jax.device_get(
+            {"sel": dev.sel,
+             **{f"d{i}": dev.columns[_positional_name(i)].data
+                for i in range(len(dev.columns))},
+             **{f"v{i}": dev.columns[_positional_name(i)].validity
+                for i in range(len(dev.columns))
+                if dev.columns[_positional_name(i)].validity is not None}})
+        sel = np.asarray(host["sel"])
+        n = int(sel.sum())  # from_arrow keeps live rows as a prefix
+        cap = round_capacity(max(8, -(-n // P)))
+        types: List[dt.DataType] = []
+        datas: List[np.ndarray] = []
+        validities: List[Optional[np.ndarray]] = []
+        for i in range(len(dev.columns)):
+            col = dev.columns[_positional_name(i)]
+            types.append(col.dtype)
+            datas.append(partition_rows(np.asarray(host[f"d{i}"])[:n], P, cap))
+            if col.validity is not None:
+                validities.append(
+                    partition_rows(np.asarray(host[f"v{i}"])[:n], P, cap))
+            else:
+                validities.append(None)
+        psel = partition_rows(np.ones(n, dtype=bool), P, cap)
+        dicts = {i: hb.dicts[_positional_name(i)]
+                 for i in range(len(dev.columns))
+                 if _positional_name(i) in hb.dicts}
+        return _LeafData(datas, validities, psel, types, dicts, cap)
+
+    def _flatten_leaf_arrays(self, leaves: Dict[int, _LeafData]) -> List:
+        from jax.sharding import NamedSharding, PartitionSpec as Pspec
+        sharding = NamedSharding(self.mesh, Pspec(DATA_AXIS))
+        flat: List = []
+        for lid in sorted(leaves):
+            ld = leaves[lid]
+            for d, v in zip(ld.datas, ld.validities):
+                flat.append(jax.device_put(d, sharding))
+                if v is not None:
+                    flat.append(jax.device_put(v, sharding))
+            flat.append(jax.device_put(ld.sel, sharding))
+        return flat
+
+    # ------------------------------------------------------------------
+    # fragment compilation
+    # ------------------------------------------------------------------
+    def _compile_node(self, node: pn.PlanNode, producers: Dict[int, _Frag],
+                      leaf: Optional[_LeafData], stage_id: int,
+                      groups_mult: int) -> _Frag:
+        if isinstance(node, pn.ScanExec):
+            if leaf is None:
+                raise MeshUnsupported("scan without prepared leaf data")
+
+            def fn(env, _lid=stage_id):
+                cols, sel = env[("leaf", _lid)]
+                return cols, sel, [], []
+
+            return _Frag(fn, leaf.types, dict(leaf.dicts), leaf.cap)
+        if isinstance(node, jg.StageInputExec):
+            prod = producers.get(node.stage_id)
+            if prod is None:
+                raise MeshUnsupported("stage input before producer")
+
+            def fn(env, _sid=node.stage_id):
+                cols, sel = env[_sid]
+                return cols, sel, [], []
+
+            return _Frag(fn, prod.types, dict(prod.dicts), prod.cap)
+        if isinstance(node, pn.FilterExec):
+            return self._compile_filter(node, producers, leaf, stage_id,
+                                        groups_mult)
+        if isinstance(node, pn.ProjectExec):
+            return self._compile_project(node, producers, leaf, stage_id,
+                                         groups_mult)
+        if isinstance(node, pn.AggregateExec):
+            return self._compile_agg(node, producers, leaf, stage_id,
+                                     groups_mult)
+        if isinstance(node, pn.JoinExec):
+            return self._compile_join(node, producers, leaf, stage_id,
+                                      groups_mult)
+        raise MeshUnsupported(f"mesh fragment op {type(node).__name__}")
+
+    def _expr_compiler(self, frag: _Frag) -> ExprCompiler:
+        return ExprCompiler(frag.types, frag.dicts)
+
+    def _compile_rex(self, comp: ExprCompiler, r: rx.Rex):
+        try:
+            return comp.compile(r)
+        except HostFallback as e:
+            raise MeshUnsupported(f"host-only expression: {e}") from e
+
+    def _compile_filter(self, node, producers, leaf, stage_id, gm) -> _Frag:
+        child = self._compile_node(node.input, producers, leaf, stage_id, gm)
+        c = self._compile_rex(self._expr_compiler(child), node.condition)
+
+        def fn(env):
+            cols, sel, r, f = child.fn(env)
+            data, validity = c.fn(cols)
+            keep = data.astype(jnp.bool_)
+            if validity is not None:
+                keep = keep & validity
+            return cols, sel & keep, r, f
+
+        return _Frag(fn, child.types, child.dicts, child.cap)
+
+    def _compile_project(self, node, producers, leaf, stage_id, gm) -> _Frag:
+        from ..columnar.batch import physical_jnp_dtype
+
+        child = self._compile_node(node.input, producers, leaf, stage_id, gm)
+        comp = self._expr_compiler(child)
+        compiled = [self._compile_rex(comp, e) for _, e in node.exprs]
+        types = [rx.rex_type(e) for _, e in node.exprs]
+        jdts = [physical_jnp_dtype(t) for t in types]
+        dicts = {i: c.dictionary for i, c in enumerate(compiled)
+                 if c.dictionary is not None}
+
+        def fn(env):
+            cols, sel, r, f = child.fn(env)
+            out: Cols = []
+            for c, jdt in zip(compiled, jdts):
+                data, validity = c.fn(cols)
+                if data.ndim == 0:
+                    data = jnp.broadcast_to(data[None], (sel.shape[0],))
+                if data.dtype != jnp.dtype(jdt):
+                    data = data.astype(jdt)
+                if validity is not None and validity.ndim == 0:
+                    validity = jnp.broadcast_to(validity[None],
+                                                (sel.shape[0],))
+                out.append((data, validity))
+            return out, sel, r, f
+
+        return _Frag(fn, types, dicts, child.cap)
+
+    def _compile_agg(self, node: pn.AggregateExec, producers, leaf,
+                     stage_id, gm) -> _Frag:
+        from ..exec.local import _dict_order_ranks
+
+        if any(a.distinct or a.filter is not None or
+               a.fn not in _MESH_AGGS for a in node.aggs):
+            raise MeshUnsupported("non-mergeable aggregate in mesh stage")
+        child = self._compile_node(node.input, producers, leaf, stage_id, gm)
+        in_types = child.types
+        max_groups = min(child.cap,
+                         round_capacity(self._group_cap * gm))
+        # min/max over dictionary codes must order by VALUE: remap through
+        # order-preserving ranks and back (same design as the local engine)
+        luts = {}
+        for j, a in enumerate(node.aggs):
+            if a.fn in ("min", "max") and a.arg is not None and \
+                    a.arg in child.dicts and len(child.dicts[a.arg]) > 1:
+                ranks = _dict_order_ranks(child.dicts[a.arg])
+                inv = np.empty_like(ranks)
+                inv[ranks] = np.arange(len(ranks), dtype=ranks.dtype)
+                luts[j] = (jnp.asarray(ranks), jnp.asarray(inv))
+
+        def run_one(ctx, a: pn.AggSpec, arg: Optional[Column]) -> Column:
+            if a.fn == "count":
+                return aggk.agg_count(ctx, arg)
+            if a.fn == "sum":
+                return aggk.agg_sum(ctx, arg, a.out_dtype)
+            if a.fn in ("min", "max"):
+                return aggk.agg_min_max(ctx, arg, is_min=a.fn == "min")
+            if a.fn in ("first", "last"):
+                return aggk.agg_first_last(ctx, arg,
+                                           is_first=a.fn == "first",
+                                           ignore_nulls=a.ignore_nulls)
+            return aggk.agg_bool(ctx, arg, is_any=a.fn == "bool_or")
+
+        def fn(env):
+            cols, sel, r, f = child.fn(env)
+            key_cols = [Column(cols[i][0], cols[i][1], in_types[i])
+                        for i in node.group_indices]
+            ctx, skeys = aggk.group_rows(key_cols, sel, max_groups)
+            gkeys = aggk.group_key_output(ctx, skeys)
+            out: Cols = [(g.data, g.validity) for g in gkeys]
+            for j, a in enumerate(node.aggs):
+                arg = None if a.arg is None else \
+                    Column(cols[a.arg][0], cols[a.arg][1], in_types[a.arg])
+                lut = luts.get(j)
+                if lut is not None:
+                    ranks_lut, inv_lut = lut
+                    codes = jnp.clip(arg.data, 0, ranks_lut.shape[0] - 1)
+                    col = run_one(ctx, a, Column(ranks_lut[codes],
+                                                 arg.validity, arg.dtype))
+                    col = Column(inv_lut[jnp.clip(col.data, 0,
+                                                  inv_lut.shape[0] - 1)],
+                                 col.validity, col.dtype)
+                else:
+                    col = run_one(ctx, a, arg)
+                out.append((col.data, col.validity))
+            r = r + [aggk.group_overflow(ctx)]
+            return out, aggk.group_sel(ctx), r, f
+
+        nk = len(node.group_indices)
+        types = [in_types[i] for i in node.group_indices] + \
+            [a.out_dtype for a in node.aggs]
+        dicts: Dict[int, pa.Array] = {}
+        for j, gi in enumerate(node.group_indices):
+            if gi in child.dicts:
+                dicts[j] = child.dicts[gi]
+        for j, a in enumerate(node.aggs):
+            if a.arg is not None and a.fn in ("min", "max", "first", "last") \
+                    and a.arg in child.dicts:
+                dicts[nk + j] = child.dicts[a.arg]
+        return _Frag(fn, types, dicts, max_groups)
+
+    def _compile_join(self, node: pn.JoinExec, producers, leaf, stage_id,
+                      gm) -> _Frag:
+        jt = node.join_type
+        if jt not in ("inner", "left", "semi", "anti") or not node.left_keys:
+            raise MeshUnsupported(f"mesh join type {jt}")
+        if node.null_aware:
+            raise MeshUnsupported("null-aware join in mesh stage")
+        if node.residual is not None and jt != "inner":
+            raise MeshUnsupported("join residual on non-inner join")
+        left = self._compile_node(node.left, producers, leaf, stage_id, gm)
+        right = self._compile_node(node.right, producers, leaf, stage_id, gm)
+        lcomp = self._expr_compiler(left)
+        rcomp = self._expr_compiler(right)
+        pairs = []
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            lc = self._compile_rex(lcomp, lk)
+            rc = self._compile_rex(rcomp, rk)
+            ktype = rx.rex_type(lk)
+            luts = None
+            if lc.dictionary is not None or rc.dictionary is not None:
+                merged, ra, rb = ai.unify_dictionaries(lc.dictionary,
+                                                       rc.dictionary)
+                luts = (jnp.asarray(ra), jnp.asarray(rb))
+                ktype = dt.IntegerType()
+            pairs.append((lc, rc, ktype, luts))
+        n_left = len(left.types)
+        residual_c = None
+        if node.residual is not None:
+            comb = ExprCompiler(
+                left.types + right.types,
+                {**left.dicts,
+                 **{n_left + i: d for i, d in right.dicts.items()}})
+            residual_c = self._compile_rex(comb, node.residual)
+
+        def fn(env):
+            lcols, lsel, lr, lf = left.fn(env)
+            rcols, rsel, rr, rf = right.fn(env)
+            retry = lr + rr
+            fatal = lf + rf
+            lkeys, rkeys = [], []
+            for lc, rc, ktype, luts in pairs:
+                ld, lv = lc.fn(lcols)
+                rd, rv = rc.fn(rcols)
+                if luts is not None:
+                    ld = luts[0][ld]
+                    rd = luts[1][rd]
+                lkeys.append(Column(ld, lv, ktype))
+                rkeys.append(Column(rd, rv, ktype))
+            bt = joink.build_side(rkeys, rsel)
+            fatal = fatal + [joink.has_duplicate_build_keys(bt)]
+            if not bt.exact:
+                retry = retry + [joink.hash_ambiguous(bt, rkeys)]
+            ranges = joink.probe_ranges(
+                bt, lkeys, lsel,
+                build_key_cols=rkeys if not bt.exact else None)
+            probe = DeviceBatch(
+                {_positional_name(i): Column(d, v, left.types[i])
+                 for i, (d, v) in enumerate(lcols)}, lsel)
+            payload = DeviceBatch(
+                {_positional_name(n_left + i): Column(d, v, right.types[i])
+                 for i, (d, v) in enumerate(rcols)}, rsel)
+            names = [_positional_name(n_left + i)
+                     for i in range(len(right.types))] \
+                if jt not in ("semi", "anti") else []
+            out = joink.join_unique(bt, ranges, probe, payload, jt, names)
+            ncols = n_left if jt in ("semi", "anti") else \
+                n_left + len(right.types)
+            cols: Cols = [(out.columns[_positional_name(i)].data,
+                           out.columns[_positional_name(i)].validity)
+                          for i in range(ncols)]
+            sel = out.sel
+            if residual_c is not None:
+                data, validity = residual_c.fn(cols)
+                keep = data.astype(jnp.bool_)
+                if validity is not None:
+                    keep = keep & validity
+                sel = sel & keep
+            return cols, sel, retry, fatal
+
+        if jt in ("semi", "anti"):
+            types, dicts = list(left.types), dict(left.dicts)
+        else:
+            types = list(left.types) + list(right.types)
+            dicts = {**left.dicts,
+                     **{n_left + i: d for i, d in right.dicts.items()}}
+        return _Frag(fn, types, dicts, left.cap)
+
+    # ------------------------------------------------------------------
+    # exchanges
+    # ------------------------------------------------------------------
+    def _bind_shuffle(self, frag: _Frag, keys: Tuple[int, ...], P: int,
+                      bucket_cap: int):
+        # Dictionary-encoded keys must hash by VALUE, not code: the two
+        # sides of a shuffle join carry independent per-leaf dictionaries,
+        # so equal strings can have different codes. A bind-time LUT maps
+        # each code to a deterministic hash of its string value — equal
+        # values hash identically on every producer stage.
+        key_types: List[dt.DataType] = []
+        value_luts: Dict[int, jnp.ndarray] = {}
+        for i in keys:
+            if i in frag.dicts:
+                value_luts[i] = jnp.asarray(
+                    _dict_value_hashes(frag.dicts[i]))
+                key_types.append(dt.LongType())
+            else:
+                key_types.append(frag.types[i])
+
+        def exchange(cols: Cols, sel):
+            # normalize NULL slots to 0 before hashing: the backing data of
+            # an invalid slot is arbitrary (e.g. join_unique gathers from a
+            # clipped build row), and equal keys — including NULL ≡ NULL —
+            # must land on the same partition
+            kd = []
+            for i in keys:
+                d, v = cols[i]
+                lut = value_luts.get(i)
+                if lut is not None:
+                    d = lut[jnp.clip(d, 0, lut.shape[0] - 1)]
+                if v is not None:
+                    d = jnp.where(v, d, jnp.zeros_like(d))
+                kd.append(d)
+            pid = (hash64(kd, key_types) % jnp.uint64(P)).astype(jnp.int32)
+            perm, valid, overflow = bucket_by_partition(pid, sel, P,
+                                                        bucket_cap)
+
+            def xchg(a):
+                buf = a[perm].reshape(P, bucket_cap)
+                return jax.lax.all_to_all(buf, DATA_AXIS, 0, 0,
+                                          tiled=True).reshape(-1)
+
+            out: Cols = []
+            for d, v in cols:
+                out.append((xchg(d), None if v is None else xchg(v)))
+            out_sel = jax.lax.all_to_all(
+                valid.reshape(P, bucket_cap), DATA_AXIS, 0, 0,
+                tiled=True).reshape(-1)
+            return out, out_sel, overflow
+
+        return exchange
+
+    # ------------------------------------------------------------------
+    # output assembly
+    # ------------------------------------------------------------------
+    def _assemble(self, out_cols, out_sel, frag: _Frag) -> pa.Table:
+        """One batched device fetch, then build arrow directly from the
+        host buffers (no device re-upload)."""
+        host = jax.device_get({"sel": out_sel,
+                               **{f"d{i}": d for i, (d, v)
+                                  in enumerate(out_cols)},
+                               **{f"v{i}": v for i, (d, v)
+                                  in enumerate(out_cols)}})
+        idx = np.nonzero(np.asarray(host["sel"]).reshape(-1))[0]
+        arrays = []
+        names = []
+        for i, t in enumerate(frag.types):
+            data = np.asarray(host[f"d{i}"]).reshape(-1)[idx]
+            validity = np.asarray(host[f"v{i}"]).reshape(-1)[idx]
+            arrays.append(ai.column_values_to_arrow(
+                data, validity, t, frag.dicts.get(i)))
+            names.append(_positional_name(i))
+        return pa.Table.from_arrays(arrays, names=names)
+
+
+def _dict_value_hashes(dictionary: pa.Array) -> np.ndarray:
+    """Deterministic int64 hash per dictionary VALUE (side-independent —
+    both producers of a shuffle join compute the same hash for the same
+    string regardless of code assignment)."""
+    import pandas as pd
+
+    vals = dictionary.cast(pa.string()).to_pylist()
+    arr = np.array(["\0NULL" if v is None else v for v in vals],
+                   dtype=object)
+    return pd.util.hash_array(arr).view(np.int64)
+
+
+def _bottom_scan(plan: pn.PlanNode) -> Optional[pn.ScanExec]:
+    """The unique ScanExec leaf of a stage plan (joins reference upstream
+    stages via StageInputExec, so ≤1 scan per stage in supported shapes)."""
+    scans = [n for n in pn.walk_plan(plan) if isinstance(n, pn.ScanExec)]
+    if len(scans) > 1:
+        raise MeshUnsupported("multiple scans in one stage")
+    return scans[0] if scans else None
+
+
+def _reattach_scans(plan: pn.PlanNode, scan_tables) -> pn.PlanNode:
+    import dataclasses as dc
+
+    def repl(p):
+        if isinstance(p, pn.ScanExec) and p.format == "__driver__":
+            return dc.replace(p, source=scan_tables[p.table_name],
+                              format="memory", table_name="")
+        if isinstance(p, pn.JoinExec):
+            return dc.replace(p, left=repl(p.left), right=repl(p.right))
+        if isinstance(p, pn.UnionExec):
+            return dc.replace(p, inputs=tuple(repl(c) for c in p.inputs))
+        if hasattr(p, "input") and p.input is not None:
+            return dc.replace(p, input=repl(p.input))
+        return p
+
+    return repl(plan)

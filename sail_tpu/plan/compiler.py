@@ -641,6 +641,19 @@ def udf_invoke(udf, cols_py, n):
     if udf.eval_type == "pandas":
         import pandas as pd
         return list(udf.func(*[pd.Series(c) for c in cols_py]))
+    if udf.eval_type == "pandas_iter":
+        # scalar-iter UDF: Iterator[Series | (Series, ...)] → Iterator[Series]
+        import pandas as pd
+        series = [pd.Series(c) for c in cols_py]
+        arg = series[0] if len(series) == 1 else tuple(series)
+        out: list = []
+        for chunk in udf.func(iter([arg])):
+            out.extend(list(chunk))
+        return out
+    if udf.eval_type == "arrow":
+        import pyarrow as _pa
+        res = udf.func(*[_pa.array(c) for c in cols_py])
+        return res.to_pylist() if hasattr(res, "to_pylist") else list(res)
     if cols_py:
         return [udf.func(*vals) for vals in zip(*cols_py)]
     return [udf.func() for _ in range(n)]

@@ -1581,6 +1581,13 @@ class _AggCollector:
                 return self._group_ref(i)
         if isinstance(e, ex.Function) and freg.is_aggregate(e.name):
             return self._rewrite_agg(e)
+        from ..functions.udf import UdfExpr
+        if isinstance(e, UdfExpr):
+            if e.udf.eval_type == "grouped_agg":
+                return self._rewrite_udaf(e)
+            args = tuple(self.rewrite(a) for a in e.args)
+            return rx.RCall("__pyudf", args, e.udf.return_type, True,
+                            (("udf", e.udf),))
         if isinstance(e, ex.Alias):
             return self.rewrite(e.child)
         if isinstance(e, ex.Literal):
@@ -1601,6 +1608,12 @@ class _AggCollector:
                     out_t = t if isinstance(out_t, dt.NullType) else dt.common_type(out_t, t)
             return rx.RCase(branches, relse, out_t, True)
         if isinstance(e, ex.Function):
+            # a registered wire UDAF invoked by name in SQL
+            reg = getattr(self.resolver.catalog, "udfs", None)
+            named = reg.get(e.name) if reg is not None else None
+            if named is not None and named.eval_type == "grouped_agg":
+                from ..functions.udf import UdfExpr
+                return self._rewrite_udaf(UdfExpr(named, tuple(e.args)))
             args = [self.rewrite(a) for a in e.args]
             return self.resolver._make_call(e.name, args)
         if isinstance(e, ex.Between):
@@ -1620,6 +1633,24 @@ class _AggCollector:
                 f"an aggregate function")
         raise ResolutionError(f"unsupported expression in aggregation: "
                               f"{type(e).__name__}")
+
+    def _rewrite_udaf(self, e) -> rx.Rex:
+        """Wire UDAF (pandas grouped-agg UDF): registered as a dynamic
+        host aggregate so AggSpec stays a plain serializable dataclass.
+        Reference: crates/sail-python-udf/src/udf/pyspark_udaf.rs."""
+        from ..functions.host_aggregates import register_wire_udaf
+        args = [self.resolver._resolve_expr(a, self.scope) for a in e.args]
+        if not args:
+            raise ResolutionError("UDAF requires at least one argument")
+        name = register_wire_udaf(e.udf)
+        arg = args[0]
+        if len(args) > 1:
+            st = dt.StructType(tuple(
+                dt.StructField(f"_{i}", rx.rex_type(a), True)
+                for i, a in enumerate(args)))
+            arg = rx.RCall("struct", tuple(args), st, False)
+        return self._add_agg("__host__" + name, arg, False,
+                             e.udf.return_type)
 
     def _rewrite_agg(self, e: ex.Function) -> rx.Rex:
         fn = e.name.lower()
@@ -1761,6 +1792,11 @@ def _has_window(e: ex.Expr) -> bool:
 
 
 def _has_aggregate(e: ex.Expr) -> bool:
+    from ..functions.udf import UdfExpr
+    if isinstance(e, UdfExpr):
+        if e.udf.eval_type == "grouped_agg":
+            return True
+        return any(_has_aggregate(a) for a in e.args)
     if isinstance(e, ex.Function):
         if freg.is_aggregate(e.name):
             return True

@@ -71,9 +71,35 @@ class SparkSession:
             self.conf.get("spark.sql.session.timeZone") or "UTC")
         try:
             node = self._resolve(plan)
+            mesh_table = self._try_mesh_execute(node)
+            if mesh_table is not None:
+                return mesh_table
             return self._executor_cls(dict(self.conf.items())).execute(node)
         finally:
             reset_session_timezone(token)
+
+    def _try_mesh_execute(self, node) -> Optional[pa.Table]:
+        """SPMD path: when the plan splits into co-resident stages and the
+        session mesh has >1 device, the whole job graph compiles into one
+        shard_map program whose exchanges are XLA collectives (see
+        parallel/mesh_exec.py). mode: off | auto (default) | force."""
+        from .config import get as config_get
+        mode = (self.conf.get("spark.sail.execution.mesh")
+                or str(config_get("execution.mesh", "auto")))
+        if mode == "off":
+            return None
+        import jax
+        if len(jax.devices()) < 2 and mode != "force":
+            return None
+        try:
+            from .parallel.mesh_exec import MeshExecutor
+            ex = MeshExecutor(config=dict(self.conf.items()))
+            self._last_mesh_executor = ex
+            return ex.execute(node)
+        except Exception:
+            if mode == "force":
+                raise
+            return None
 
     # -- entry points -------------------------------------------------------
     def sql(self, query: str) -> "DataFrame":

@@ -329,6 +329,9 @@ def expr_from_proto(e: epb.Expression) -> ex.Expr:
         cf = e.call_function
         return ex.Function(cf.function_name.lower(),
                            tuple(expr_from_proto(a) for a in cf.arguments))
+    if kind == "common_inline_user_defined_function":
+        from .wire_udf import udf_expr_from_proto
+        return udf_expr_from_proto(e.common_inline_user_defined_function)
     raise ConvertError(f"unsupported expression kind: {kind}")
 
 

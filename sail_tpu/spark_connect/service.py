@@ -214,6 +214,13 @@ class SparkConnectServer:
             w2 = command.write_operation_v2
             self._write_v2(session, w2)
             return
+        if which == "register_function":
+            # cloudpickled UDF registration for SQL use (reference:
+            # plan_executor.rs handle_register_user_defined_function)
+            from .wire_udf import udf_from_proto
+            cif = command.register_function
+            session.udf.register(cif.function_name, udf_from_proto(cif))
+            return
         raise NotImplementedError(f"command {which} not supported yet")
 
     _SAVE_MODES = {

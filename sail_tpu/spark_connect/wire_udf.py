@@ -1,0 +1,234 @@
+"""Wire-level PySpark UDF decoding.
+
+Reference role: crates/sail-python-udf/src/udf/pyspark_udf.rs:19-27 and
+src/cereal/ — decoding ``CommonInlineUserDefinedFunction`` payloads
+(cloudpickled function + return type) sent by Spark Connect clients, and
+binding them into the engine's trace-first UDF machinery
+(sail_tpu/functions/udf.py): traceable pandas/arrow UDFs fuse into the
+surrounding XLA program; untraceable ones run via ``jax.pure_callback``.
+
+The image has no PySpark, so payloads referencing ``pyspark.sql.types``
+unpickle against a minimal shim module installed on demand; payloads made
+with plain cloudpickle (our own test client, third-party clients) decode
+directly.
+"""
+
+from __future__ import annotations
+
+import sys
+import types as _pytypes
+from typing import Optional, Tuple
+
+from ..functions.udf import UdfExpr, UserDefinedFunction
+from ..spec import data_type as dt
+
+# PySpark PythonEvalType values (python/pyspark/util.py in Spark) → the
+# engine's UDF kinds.
+EVAL_TYPES = {
+    100: "batch",          # SQL_BATCHED_UDF
+    101: "arrow",          # SQL_ARROW_BATCHED_UDF
+    200: "pandas",         # SQL_SCALAR_PANDAS_UDF
+    201: "grouped_map",    # SQL_GROUPED_MAP_PANDAS_UDF
+    202: "grouped_agg",    # SQL_GROUPED_AGG_PANDAS_UDF
+    203: "window_agg",     # SQL_WINDOW_AGG_PANDAS_UDF
+    204: "pandas_iter",    # SQL_SCALAR_PANDAS_ITER_UDF
+    205: "map_pandas",     # SQL_MAP_PANDAS_ITER_UDF
+    206: "cogrouped_map",  # SQL_COGROUPED_MAP_PANDAS_UDF
+    207: "map_arrow",      # SQL_MAP_ARROW_ITER_UDF
+    300: "udtf",           # SQL_TABLE_UDF
+    301: "arrow_udtf",
+}
+
+
+class WireUdfError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# pyspark.sql.types shim — just enough for pickled DataType instances to
+# unpickle by reference without PySpark installed
+# ---------------------------------------------------------------------------
+
+_ATOMIC_SHIM_TYPES = [
+    "DataType", "NullType", "StringType", "CharType", "VarcharType",
+    "BinaryType", "BooleanType", "DateType", "TimestampType",
+    "TimestampNTZType", "DoubleType", "FloatType", "ByteType", "ShortType",
+    "IntegerType", "LongType", "DayTimeIntervalType", "YearMonthIntervalType",
+]
+
+
+def _install_pyspark_shim():
+    if "pyspark.sql.types" in sys.modules:
+        return
+    import importlib.util
+    try:
+        if importlib.util.find_spec("pyspark.sql.types") is not None:
+            return  # real PySpark available: never shadow it
+    except (ImportError, ModuleNotFoundError, ValueError):
+        pass
+    pyspark = sys.modules.get("pyspark") or _pytypes.ModuleType("pyspark")
+    sql = _pytypes.ModuleType("pyspark.sql")
+    tmod = _pytypes.ModuleType("pyspark.sql.types")
+
+    def make_atomic(name):
+        def __init__(self, *args, **kwargs):
+            self.args = args
+            self.kwargs = kwargs
+        return type(name, (object,), {"__init__": __init__,
+                                      "__module__": "pyspark.sql.types"})
+
+    for name in _ATOMIC_SHIM_TYPES:
+        setattr(tmod, name, make_atomic(name))
+
+    class DecimalType:
+        def __init__(self, precision=10, scale=0):
+            self.precision = precision
+            self.scale = scale
+
+    class ArrayType:
+        def __init__(self, elementType=None, containsNull=True):
+            self.elementType = elementType
+            self.containsNull = containsNull
+
+    class MapType:
+        def __init__(self, keyType=None, valueType=None,
+                     valueContainsNull=True):
+            self.keyType = keyType
+            self.valueType = valueType
+            self.valueContainsNull = valueContainsNull
+
+    class StructField:
+        def __init__(self, name=None, dataType=None, nullable=True,
+                     metadata=None):
+            self.name = name
+            self.dataType = dataType
+            self.nullable = nullable
+            self.metadata = metadata
+
+    class StructType:
+        def __init__(self, fields=None):
+            self.fields = fields or []
+
+    for cls in (DecimalType, ArrayType, MapType, StructField, StructType):
+        cls.__module__ = "pyspark.sql.types"
+        setattr(tmod, cls.__name__, cls)
+
+    pyspark.sql = sql
+    sql.types = tmod
+    sys.modules.setdefault("pyspark", pyspark)
+    sys.modules["pyspark.sql"] = sql
+    sys.modules["pyspark.sql.types"] = tmod
+
+
+def _shim_type_to_spec(t) -> Optional[dt.DataType]:
+    """Best-effort conversion of a (shimmed or real) pyspark DataType."""
+    name = type(t).__name__
+    simple = {
+        "NullType": dt.NullType, "StringType": dt.StringType,
+        "BinaryType": dt.BinaryType, "BooleanType": dt.BooleanType,
+        "DateType": dt.DateType, "TimestampType": dt.TimestampType,
+        "DoubleType": dt.DoubleType, "FloatType": dt.FloatType,
+        "ByteType": dt.ByteType, "ShortType": dt.ShortType,
+        "IntegerType": dt.IntegerType, "LongType": dt.LongType,
+    }
+    if name in simple:
+        return simple[name]()
+    if name == "TimestampNTZType":
+        return dt.TimestampType(False)
+    if name == "DecimalType":
+        return dt.DecimalType(getattr(t, "precision", 10),
+                              getattr(t, "scale", 0))
+    if name == "ArrayType":
+        el = _shim_type_to_spec(getattr(t, "elementType", None))
+        return dt.ArrayType(el or dt.StringType(), True)
+    if name == "MapType":
+        k = _shim_type_to_spec(getattr(t, "keyType", None))
+        v = _shim_type_to_spec(getattr(t, "valueType", None))
+        return dt.MapType(k or dt.StringType(), v or dt.StringType(), True)
+    if name == "StructType":
+        fields = []
+        for f in getattr(t, "fields", []):
+            ft = _shim_type_to_spec(getattr(f, "dataType", None))
+            fields.append(dt.StructField(getattr(f, "name", "col"),
+                                         ft or dt.StringType(), True))
+        return dt.StructType(tuple(fields))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# command decoding
+# ---------------------------------------------------------------------------
+
+def decode_command(command: bytes) -> Tuple[object, Optional[dt.DataType]]:
+    """cloudpickle payload → (callable, optional return type).
+
+    Accepted layouts (newest PySpark first):
+    - ``(func, returnType)`` — the Spark Connect PythonUDF contract
+    - ``func`` alone
+    - any tuple whose first callable element is the function
+    """
+    import cloudpickle
+
+    _install_pyspark_shim()
+    try:
+        obj = cloudpickle.loads(command)
+    except Exception as e:  # noqa: BLE001 — surfaced as a client error
+        raise WireUdfError(f"cannot deserialize UDF payload: {e}") from e
+    if callable(obj):
+        return obj, None
+    if isinstance(obj, tuple):
+        func = next((x for x in obj if callable(x)), None)
+        if func is None:
+            raise WireUdfError("UDF payload tuple contains no callable")
+        rt = None
+        for x in obj:
+            if x is func:
+                continue
+            if isinstance(x, dt.DataType):
+                rt = x
+                break
+            conv = _shim_type_to_spec(x) if x is not None else None
+            if conv is not None:
+                rt = conv
+                break
+        return func, rt
+    raise WireUdfError(f"unsupported UDF payload type {type(obj)!r}")
+
+
+def udf_from_proto(cif) -> UserDefinedFunction:
+    """CommonInlineUserDefinedFunction → engine UDF handle."""
+    from .convert import ConvertError, data_type_from_proto
+
+    which = cif.WhichOneof("function")
+    if which != "python_udf":
+        raise ConvertError(f"unsupported UDF flavor: {which}")
+    p = cif.python_udf
+    kind = EVAL_TYPES.get(p.eval_type)
+    if kind is None:
+        raise ConvertError(f"unsupported Python UDF eval type {p.eval_type}")
+    func, pickled_rt = decode_command(p.command)
+    out_t = None
+    if p.HasField("output_type"):
+        out_t = data_type_from_proto(p.output_type)
+    if out_t is None:
+        out_t = pickled_rt
+    if out_t is None:
+        raise ConvertError("UDF without an output type")
+    engine_kind = {"batch": "batch", "arrow": "arrow", "pandas": "pandas",
+                   "pandas_iter": "pandas_iter",
+                   "grouped_agg": "grouped_agg"}.get(kind)
+    if engine_kind is None:
+        raise ConvertError(
+            f"UDF kind {kind!r} is not valid as a scalar expression")
+    return UserDefinedFunction(func, out_t, engine_kind,
+                               cif.function_name or "udf",
+                               cif.deterministic)
+
+
+def udf_expr_from_proto(cif):
+    """Expression-position CommonInlineUserDefinedFunction → UdfExpr."""
+    from .convert import expr_from_proto
+
+    udf = udf_from_proto(cif)
+    args = tuple(expr_from_proto(a) for a in cif.arguments)
+    return UdfExpr(udf, args)

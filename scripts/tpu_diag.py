@@ -1,0 +1,83 @@
+"""Corrected tunnel diagnostics (v2): pre-jitted scalar sync, fresh-array
+D2H, size-swept H2D, and compile-cost isolation."""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def timeit(fn, n=10, warmup=2):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), float(np.median(ts))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    out = {"platform": dev.platform}
+
+    # scalar device->host sync with a PRE-JITTED fn (the engine's
+    # int(n_groups) pattern)
+    f = jax.jit(lambda a: jnp.sum(a))
+    x = jnp.ones((1024,))
+    f(x).block_until_ready()
+    best, med = timeit(lambda: int(f(x)), n=20)
+    out["scalar_sync_ms"] = {"best": round(best * 1e3, 3),
+                             "median": round(med * 1e3, 3)}
+
+    # H2D size sweep: latency floor vs bandwidth
+    for sz, label in [(1 << 10, "1KB"), (1 << 20, "1MB"), (16 << 20, "16MB"),
+                      (128 << 20, "128MB")]:
+        host = np.random.default_rng(0).random((sz // 4,), np.float32)
+        def h2d():
+            jax.device_put(host).block_until_ready()
+        best, med = timeit(h2d, n=5, warmup=1)
+        out[f"h2d_{label}_ms"] = {"best": round(best * 1e3, 2),
+                                  "GBps": round(host.nbytes / best / 1e9, 2)}
+
+    # D2H: fresh result each time (no host cache) — add+sum makes a new array
+    g = jax.jit(lambda a, b: a + b)
+    for sz, label in [(1 << 20, "1MB"), (32 << 20, "32MB")]:
+        a = jax.device_put(np.random.default_rng(0).random((sz // 4,), np.float32))
+        b = jax.device_put(np.random.default_rng(1).random((sz // 4,), np.float32))
+        y = g(a, b); y.block_until_ready()
+        def d2h():
+            r = g(a, b)
+            np.asarray(r)
+        best, med = timeit(d2h, n=5, warmup=1)
+        out[f"d2h_{label}_ms"] = {"best": round(best * 1e3, 2),
+                                  "GBps": round(a.nbytes / best / 1e9, 2)}
+
+    # big-op wall floor: same reduce at multiple sizes — if all ~70ms the
+    # tunnel adds a fixed per-block sync cost, not bandwidth
+    r = jax.jit(lambda a: jnp.sum(a * 1.0000001))
+    for sz, label in [(1 << 20, "1MB"), (64 << 20, "64MB"), (256 << 20, "256MB")]:
+        a = jax.device_put(np.random.default_rng(0).random((sz // 4,), np.float32))
+        r(a).block_until_ready()
+        best, med = timeit(lambda: r(a).block_until_ready(), n=8)
+        out[f"reduce_{label}_ms"] = {"best": round(best * 1e3, 2),
+                                     "GBps": round(a.nbytes / best / 1e9, 1)}
+
+    # compile cost of a trivial new program (tunnel round trips in tracing?)
+    def compile_once():
+        h = jax.jit(lambda a: a * 2 + 1)
+        h(x).block_until_ready()
+    best, med = timeit(compile_once, n=3, warmup=0)
+    out["tiny_compile_ms"] = {"best": round(best * 1e3, 1),
+                              "median": round(med * 1e3, 1)}
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,270 @@
+"""Wire-level Python UDFs over the Spark Connect protocol: cloudpickled
+CommonInlineUserDefinedFunction payloads, built exactly as a PySpark
+client does (command = cloudpickle of (func, returnType)).
+
+Reference role: crates/sail-python-udf/src/udf/pyspark_udf.rs:19-27 and
+src/cereal/ — the payload decode + engine binding."""
+
+import cloudpickle
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sail_tpu.spark_connect import SparkConnectServer
+from sail_tpu.spark_connect.client import SparkConnectClient
+
+from spark.connect import base_pb2 as bpb
+from spark.connect import commands_pb2 as cpb
+from spark.connect import expressions_pb2 as epb
+from spark.connect import relations_pb2 as rpb
+
+# PythonEvalType constants as defined by PySpark (python/pyspark/util.py)
+SQL_BATCHED_UDF = 100
+SQL_ARROW_BATCHED_UDF = 101
+SQL_SCALAR_PANDAS_UDF = 200
+SQL_GROUPED_AGG_PANDAS_UDF = 202
+SQL_SCALAR_PANDAS_ITER_UDF = 204
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = SparkConnectServer(port=0).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = SparkConnectClient(f"127.0.0.1:{server.port}")
+    yield c
+    c.release_session()
+    c.close()
+
+
+def _local_rel(table: pa.Table) -> rpb.Relation:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    rel = rpb.Relation()
+    rel.local_relation.data = sink.getvalue().to_pybytes()
+    return rel
+
+
+def _udf_expr(func, eval_type: int, ddl_type: str, *arg_names: str,
+              name: str = "f") -> epb.Expression:
+    """Build the expression the way pyspark's connect client does:
+    command = cloudpickle.dumps((func, returnType))."""
+    e = epb.Expression()
+    u = e.common_inline_user_defined_function
+    u.function_name = name
+    u.deterministic = True
+    for a in arg_names:
+        arg = u.arguments.add()
+        arg.unresolved_attribute.unparsed_identifier = a
+    u.python_udf.eval_type = eval_type
+    u.python_udf.command = cloudpickle.dumps((func, None))
+    u.python_udf.python_ver = "3.12"
+    u.python_udf.output_type.CopyFrom(_ddl_to_proto(ddl_type))
+    return e
+
+
+def _ddl_to_proto(ddl: str):
+    from spark.connect import types_pb2 as tpb
+    t = tpb.DataType()
+    if ddl == "bigint":
+        t.long.SetInParent()
+    elif ddl == "double":
+        t.double.SetInParent()
+    elif ddl == "string":
+        t.string.SetInParent()
+    else:
+        raise ValueError(ddl)
+    return t
+
+
+def _project(rel: rpb.Relation, exprs) -> rpb.Relation:
+    out = rpb.Relation()
+    out.project.input.CopyFrom(rel)
+    for e in exprs:
+        out.project.expressions.add().CopyFrom(e)
+    return out
+
+
+def _col(name: str) -> epb.Expression:
+    e = epb.Expression()
+    e.unresolved_attribute.unparsed_identifier = name
+    return e
+
+
+def test_wire_batch_udf(client):
+    t = pa.table({"x": pa.array([1, 2, 3, 4], type=pa.int64())})
+    expr = _udf_expr(lambda v: v * 10 + 1, SQL_BATCHED_UDF, "bigint", "x")
+    out = client.execute_relation(_project(_local_rel(t), [expr]))
+    assert out.column(0).to_pylist() == [11, 21, 31, 41]
+
+
+def test_wire_pandas_udf_traces_on_device(client):
+    t = pa.table({"a": pa.array([1.0, 2.0, 3.0]),
+                  "b": pa.array([10.0, 20.0, 30.0])})
+
+    def mult(a, b):
+        return a * b + 0.5
+
+    expr = _udf_expr(mult, SQL_SCALAR_PANDAS_UDF, "double", "a", "b")
+    out = client.execute_relation(_project(_local_rel(t), [expr]))
+    assert out.column(0).to_pylist() == [10.5, 40.5, 90.5]
+
+
+def test_wire_pandas_udf_host_fallback_strings(client):
+    t = pa.table({"s": pa.array(["ab", "cd", None, "ef"])})
+
+    def upper(s: pd.Series) -> pd.Series:
+        return s.str.upper()
+
+    expr = _udf_expr(upper, SQL_SCALAR_PANDAS_UDF, "string", "s")
+    out = client.execute_relation(_project(_local_rel(t), [expr]))
+    assert out.column(0).to_pylist() == ["AB", "CD", None, "EF"]
+
+
+def test_wire_arrow_udf(client):
+    t = pa.table({"x": pa.array([5, 6, 7], type=pa.int64())})
+
+    def arrow_fn(arr):
+        import pyarrow.compute as pc
+        return pc.add(arr, 100)
+
+    expr = _udf_expr(arrow_fn, SQL_ARROW_BATCHED_UDF, "bigint", "x")
+    out = client.execute_relation(_project(_local_rel(t), [expr]))
+    assert out.column(0).to_pylist() == [105, 106, 107]
+
+
+def test_wire_pandas_iter_udf(client):
+    t = pa.table({"x": pa.array([1.0, 2.0, 3.0])})
+
+    def iter_fn(it):
+        for s in it:
+            yield s + 1.0
+
+    expr = _udf_expr(iter_fn, SQL_SCALAR_PANDAS_ITER_UDF, "double", "x")
+    out = client.execute_relation(_project(_local_rel(t), [expr]))
+    assert out.column(0).to_pylist() == [2.0, 3.0, 4.0]
+
+
+def test_wire_udaf_grouped_agg(client):
+    t = pa.table({"g": pa.array([1, 1, 2, 2, 2], type=pa.int64()),
+                  "v": pa.array([1.0, 3.0, 10.0, 20.0, 30.0])})
+
+    def weighted(v: pd.Series) -> float:
+        return float(v.max() - v.min())
+
+    agg = rpb.Relation()
+    agg.aggregate.input.CopyFrom(_local_rel(t))
+    agg.aggregate.group_type = rpb.Aggregate.GROUP_TYPE_GROUPBY
+    agg.aggregate.grouping_expressions.add().CopyFrom(_col("g"))
+    agg.aggregate.aggregate_expressions.add().CopyFrom(
+        _udf_expr(weighted, SQL_GROUPED_AGG_PANDAS_UDF, "double", "v",
+                  name="spread"))
+    out = client.execute_relation(agg)
+    df = out.to_pandas().sort_values(out.column_names[0])
+    assert df.iloc[:, 1].tolist() == [2.0, 20.0]
+
+
+def test_wire_register_function_for_sql(client):
+    cmd = cpb.Command()
+    u = cmd.register_function
+    u.function_name = "triple"
+    u.deterministic = True
+    u.python_udf.eval_type = SQL_BATCHED_UDF
+    u.python_udf.command = cloudpickle.dumps((lambda x: x * 3, None))
+    u.python_udf.python_ver = "3.12"
+    u.python_udf.output_type.CopyFrom(_ddl_to_proto("bigint"))
+    plan = bpb.Plan()
+    plan.command.CopyFrom(cmd)
+    list(client.execute_plan(plan))  # drain the response stream
+    out = client.sql("SELECT triple(7) AS t")
+    assert out.column("t").to_pylist() == [21]
+
+
+def test_wire_udf_pyspark_shim_types(client):
+    """A payload whose returnType references pyspark.sql.types unpickles
+    against the shim (no PySpark in the image)."""
+    from sail_tpu.spark_connect.wire_udf import _install_pyspark_shim
+    _install_pyspark_shim()
+    import sys
+    LongType = sys.modules["pyspark.sql.types"].LongType
+
+    t = pa.table({"x": pa.array([2, 4], type=pa.int64())})
+    e = epb.Expression()
+    u = e.common_inline_user_defined_function
+    u.function_name = "f"
+    u.arguments.add().unresolved_attribute.unparsed_identifier = "x"
+    u.python_udf.eval_type = SQL_BATCHED_UDF
+    # no output_type field set: decoder must fall back to the pickled type
+    u.python_udf.command = cloudpickle.dumps((lambda v: v + 1, LongType()))
+    u.python_udf.python_ver = "3.12"
+    out = client.execute_relation(_project(_local_rel(t), [e]))
+    assert out.column(0).to_pylist() == [3, 5]
+
+
+def test_wire_udaf_sees_nulls(client):
+    """Grouped-agg pandas UDFs receive the FULL group Series including
+    nulls (as NaN), matching PySpark semantics."""
+    t = pa.table({"g": pa.array([1, 1, 1, 2], type=pa.int64()),
+                  "v": pa.array([1.0, None, 3.0, 5.0])})
+
+    def count_all(v: pd.Series) -> float:
+        return float(len(v))
+
+    agg = rpb.Relation()
+    agg.aggregate.input.CopyFrom(_local_rel(t))
+    agg.aggregate.group_type = rpb.Aggregate.GROUP_TYPE_GROUPBY
+    agg.aggregate.grouping_expressions.add().CopyFrom(_col("g"))
+    agg.aggregate.aggregate_expressions.add().CopyFrom(
+        _udf_expr(count_all, SQL_GROUPED_AGG_PANDAS_UDF, "double", "v",
+                  name="count_all"))
+    out = client.execute_relation(agg)
+    df = out.to_pandas().sort_values(out.column_names[0])
+    assert df.iloc[:, 1].tolist() == [3.0, 1.0]
+
+
+def test_wire_udaf_closure_change_not_cached(client):
+    """Re-registering a same-shaped UDAF with different captured state
+    must not reuse the stale implementation."""
+    from spark.connect import base_pb2 as _bpb
+
+    def reg(k):
+        def scaled(v: pd.Series, _k=k) -> float:
+            return float(v.sum() * _k)
+        cmd = cpb.Command()
+        u = cmd.register_function
+        u.function_name = "scaled"
+        u.python_udf.eval_type = SQL_GROUPED_AGG_PANDAS_UDF
+        u.python_udf.command = cloudpickle.dumps((scaled, None))
+        u.python_udf.output_type.double.SetInParent()
+        plan = _bpb.Plan()
+        plan.command.CopyFrom(cmd)
+        list(client.execute_plan(plan))
+
+    t = pa.table({"g": pa.array([1, 1], type=pa.int64()),
+                  "v": pa.array([2.0, 3.0])})
+    sink = pa.BufferOutputStream()
+    import pyarrow as _pa
+    with _pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+
+    def run():
+        agg = rpb.Relation()
+        agg.aggregate.input.CopyFrom(_local_rel(t))
+        agg.aggregate.group_type = rpb.Aggregate.GROUP_TYPE_GROUPBY
+        agg.aggregate.grouping_expressions.add().CopyFrom(_col("g"))
+        fe = epb.Expression()
+        fe.unresolved_function.function_name = "scaled"
+        fe.unresolved_function.arguments.add().CopyFrom(_col("v"))
+        agg.aggregate.aggregate_expressions.add().CopyFrom(fe)
+        return client.execute_relation(agg).to_pandas().iloc[0, 1]
+
+    reg(2)
+    assert run() == 10.0
+    reg(3)
+    assert run() == 15.0
