@@ -151,6 +151,7 @@ def _run_suite(spark, sf: float):
             out[q] = round(time.perf_counter() - t0, 4)
         except Exception as e:  # noqa: BLE001 — a failed query is data
             out[q] = f"error: {type(e).__name__}"
+        print(f"bench: q{q} = {out[q]}", file=sys.stderr, flush=True)
     return out
 
 
@@ -158,8 +159,8 @@ def main():
     # Headline: TPC-H Q1 at SF10 — large enough that the remote-TPU
     # tunnel's ~70 ms per-round-trip floor amortizes and the number
     # reflects device pipeline throughput. BENCH_SF / argv override.
-    sf = float(sys.argv[1]) if len(sys.argv) > 1 else \
-        float(os.environ.get("BENCH_SF", "10"))
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    sf = float(args[0]) if args else float(os.environ.get("BENCH_SF", "10"))
     suite = "--suite" in sys.argv
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150"))
     if not _probe_backend(probe_timeout):

@@ -13,6 +13,14 @@ import sys
 def _ensure_backend(timeout_s: float = 150.0):
     """Fall back to CPU when the default jax backend can't initialize
     (e.g. a wedged remote-TPU tunnel) instead of hanging forever."""
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # explicit CPU request (e.g. spawned cluster workers): skip the
+        # accelerator probe entirely — the image's sitecustomize overrides
+        # the env var at interpreter start, so pin the config too
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return
     import subprocess
     try:
         r = subprocess.run([sys.executable, "-c", "import jax; jax.devices()"],
@@ -47,8 +55,22 @@ def main(argv=None):
     p_bench = sub.add_parser("bench", help="run the benchmark")
     p_bench.add_argument("sf", nargs="?", type=float, default=1.0)
 
+    p_flight = sub.add_parser(
+        "flight", help="run the Arrow Flight SQL server")
+    p_flight.add_argument("--host", default="127.0.0.1")
+    p_flight.add_argument("--port", type=int, default=32010)
+
+    p_worker = sub.add_parser(
+        "worker", help="run a standalone cluster worker process")
+    p_worker.add_argument("--driver", required=True,
+                          help="host:port of the driver control plane")
+    p_worker.add_argument("--host", default="127.0.0.1",
+                          help="address to bind / advertise")
+    p_worker.add_argument("--task-slots", type=int, default=2)
+    p_worker.add_argument("--worker-id", default=None)
+
     args = parser.parse_args(argv)
-    if args.command in ("server", "shell"):
+    if args.command in ("server", "shell", "flight", "worker"):
         _ensure_backend()
 
     if args.command == "server":
@@ -81,6 +103,37 @@ def main(argv=None):
         bench = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "bench.py")
         return subprocess.call([sys.executable, bench, str(args.sf)])
+
+    if args.command == "flight":
+        from .flight_sql import FlightSqlServer
+        server = FlightSqlServer(args.host, args.port)
+        print(f"sail-tpu Flight SQL server listening on "
+              f"grpc://{args.host}:{server.port}")
+        try:
+            server.serve()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.shutdown()
+        return 0
+
+    if args.command == "worker":
+        import uuid as _uuid
+        from .exec.cluster import WorkerActor
+        worker_id = args.worker_id or f"worker-{_uuid.uuid4().hex[:8]}"
+        w = WorkerActor(worker_id, args.driver, args.task_slots,
+                        host=args.host)
+        w.start(worker_id)
+        print(f"sail-tpu worker {worker_id} registered with {args.driver}")
+        try:
+            import time as _time
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            w.stop()
+        return 0
 
     return 1
 

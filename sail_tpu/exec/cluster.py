@@ -21,6 +21,7 @@ SURVEY.md §2.5/§3.3). Shape:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -33,6 +34,7 @@ from .proto import control_plane_pb2 as pb
 
 from .actor import Actor
 from . import job_graph as jg
+from .. import tracing as tr
 
 _DRIVER_SERVICE = "sail_tpu.control.DriverService"
 _WORKER_SERVICE = "sail_tpu.control.WorkerService"
@@ -58,34 +60,96 @@ def _ipc_to_table(buf: bytes):
 
 
 class _StreamStore:
-    """In-memory task output channels, served over FetchStream.
-    Reference role: the stream storage behind TaskStreamFlightServer
-    (src/stream_manager/)."""
+    """Task output channels served over FetchStream, with disk spill.
 
-    def __init__(self):
-        self._streams: Dict[Tuple[str, int, int], Dict[int, bytes]] = {}
+    Reference role: the stream storage behind TaskStreamFlightServer
+    (src/stream_manager/) + TaskWriteLocation::Local{Memory|Disk}
+    (src/stream/writer.rs:11-29): channels stay in memory up to a cap;
+    beyond it they spill to a per-store temp directory and are served
+    from disk."""
+
+    def __init__(self, memory_cap_bytes: Optional[int] = None):
+        from ..config import get as config_get
+        if memory_cap_bytes is None:
+            memory_cap_bytes = int(config_get(
+                "cluster.shuffle_memory_cap_mb", 256)) << 20
+        self._cap = memory_cap_bytes
+        self._mem_bytes = 0
+        self._streams: Dict[Tuple[str, int, int], Dict[int, object]] = {}
         self._lock = threading.Lock()
+        self._spill_dir: Optional[str] = None
+        self.spill_count = 0
+
+    def _spill_path(self, job_id: str, stage: int, partition: int,
+                    channel: int) -> str:
+        import tempfile
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(prefix="sail_shuffle_")
+        return os.path.join(
+            self._spill_dir, f"{job_id}_{stage}_{partition}_{channel}.ipc")
 
     def put(self, job_id: str, stage: int, partition: int,
             channels: Dict[int, bytes]):
         with self._lock:
-            self._streams[(job_id, stage, partition)] = channels
+            # a task retry can overwrite a previous attempt's entry:
+            # release its memory/disk accounting first
+            prev = self._streams.pop((job_id, stage, partition), None)
+            if prev is not None:
+                for entry in prev.values():
+                    if isinstance(entry, tuple):
+                        try:
+                            os.unlink(entry[1])
+                        except OSError:
+                            pass
+                    else:
+                        self._mem_bytes -= len(entry)
+            stored: Dict[int, object] = {}
+            for c, buf in channels.items():
+                if self._mem_bytes + len(buf) > self._cap:
+                    path = self._spill_path(job_id, stage, partition, c)
+                    with open(path, "wb") as f:
+                        f.write(buf)
+                    stored[c] = ("disk", path)
+                    self.spill_count += 1
+                else:
+                    self._mem_bytes += len(buf)
+                    stored[c] = buf
+            self._streams[(job_id, stage, partition)] = stored
 
     def get(self, job_id: str, stage: int, partition: int,
             channel: int) -> Optional[bytes]:
         with self._lock:
             chans = self._streams.get((job_id, stage, partition))
-            if chans is None:
-                return None
-            return chans.get(channel)
+            entry = None if chans is None else chans.get(channel)
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            with open(entry[1], "rb") as f:
+                return f.read()
+        return entry
 
     def clean_job(self, job_id: str):
         with self._lock:
             for key in [k for k in self._streams if k[0] == job_id]:
+                for entry in self._streams[key].values():
+                    if isinstance(entry, tuple):
+                        try:
+                            os.unlink(entry[1])
+                        except OSError:
+                            pass
+                    else:
+                        self._mem_bytes -= len(entry)
                 del self._streams[key]
 
 
+_FETCH_CHUNK_BYTES = 1 << 20
+
+
 def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
+    """Server-streaming fetch: the channel's IPC bytes stream as bounded
+    chunks — no gRPC message-size cap, no full-buffer single message on
+    the wire (reference: stream_service/server.rs record-batch streams)."""
+
     def fetch(request: pb.FetchStreamRequest, context):
         if request.scan_id:
             tables = scan_tables() if scan_tables is not None else {}
@@ -98,28 +162,36 @@ def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
             per = -(-n // nparts) if n else 0
             part = entry.slice(request.partition * per, per) if per \
                 else entry.slice(0, 0)
-            return pb.FetchStreamResponse(data=_table_to_ipc(part))
-        buf = store.get(request.job_id, request.stage, request.partition,
-                        request.channel)
-        if buf is None:
-            context.abort(
-                grpc.StatusCode.NOT_FOUND,
-                f"no stream for job={request.job_id} stage={request.stage} "
-                f"partition={request.partition} channel={request.channel}")
-        return pb.FetchStreamResponse(data=buf)
+            buf = _table_to_ipc(part)
+        else:
+            buf = store.get(request.job_id, request.stage,
+                            request.partition, request.channel)
+            if buf is None:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"no stream for job={request.job_id} "
+                    f"stage={request.stage} "
+                    f"partition={request.partition} "
+                    f"channel={request.channel}")
+        for off in range(0, max(len(buf), 1), _FETCH_CHUNK_BYTES):
+            chunk = buf[off:off + _FETCH_CHUNK_BYTES]
+            yield pb.FetchChunk(data=chunk,
+                                last=off + _FETCH_CHUNK_BYTES >= len(buf))
 
     return fetch
 
 
 def _fetch_from(addr: str, req: pb.FetchStreamRequest, service: str,
-                timeout: float = 60.0) -> bytes:
+                timeout: float = 120.0) -> bytes:
     channel = grpc.insecure_channel(addr)
     try:
-        rpc = channel.unary_unary(
+        rpc = channel.unary_stream(
             f"/{service}/FetchStream",
             request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=pb.FetchStreamResponse.FromString)
-        return rpc(req, timeout=timeout).data
+            response_deserializer=pb.FetchChunk.FromString)
+        parts = [chunk.data for chunk in
+                 rpc(req, timeout=timeout, metadata=tr.inject_context())]
+        return b"".join(parts)
     finally:
         channel.close()
 
@@ -129,11 +201,13 @@ def _fetch_from(addr: str, req: pb.FetchStreamRequest, service: str,
 # ---------------------------------------------------------------------------
 
 class WorkerActor(Actor):
-    def __init__(self, worker_id: str, driver_addr: str, task_slots: int = 2):
+    def __init__(self, worker_id: str, driver_addr: str, task_slots: int = 2,
+                 host: str = "127.0.0.1"):
         super().__init__()
         self.worker_id = worker_id
         self.driver_addr = driver_addr
         self.task_slots = task_slots
+        self.host = host
         self.port = 0
         self._server: Optional[grpc.Server] = None
         self._driver_channel: Optional[grpc.Channel] = None
@@ -145,7 +219,8 @@ class WorkerActor(Actor):
     # -- rpc service -----------------------------------------------------
     def _service(self):
         def run_task(request: pb.RunTaskRequest, context):
-            self.handle.send(("run_task", request.task))
+            parent = tr.extract_context(context.invocation_metadata())
+            self.handle.send(("run_task", (request.task, parent)))
             return pb.RunTaskResponse(accepted=True)
 
         def stop_task(request: pb.StopTaskRequest, context):
@@ -166,18 +241,20 @@ class WorkerActor(Actor):
             "RunTask": _unary(run_task, pb.RunTaskRequest),
             "StopTask": _unary(stop_task, pb.StopTaskRequest),
             "CleanUpJob": _unary(clean_up_job, pb.CleanUpJobRequest),
-            "FetchStream": _unary(_fetch_stream_handler(self.streams),
-                                  pb.FetchStreamRequest),
+            "FetchStream": grpc.unary_stream_rpc_method_handler(
+                _fetch_stream_handler(self.streams),
+                request_deserializer=pb.FetchStreamRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
         })
 
     def on_start(self):
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
         self._server.add_generic_rpc_handlers((self._service(),))
-        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self.port = self._server.add_insecure_port(f"{self.host}:0")
         self._server.start()
         self._driver_channel = grpc.insecure_channel(self.driver_addr)
         resp = self._call_driver("RegisterWorker", pb.RegisterWorkerRequest(
-            worker_id=self.worker_id, host="127.0.0.1", port=self.port,
+            worker_id=self.worker_id, host=self.host, port=self.port,
             task_slots=self.task_slots), pb.RegisterWorkerResponse)
         if not resp.accepted:
             raise RuntimeError("driver rejected worker registration")
@@ -193,7 +270,7 @@ class WorkerActor(Actor):
             f"/{_DRIVER_SERVICE}/{method}",
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=resp_cls.FromString)
-        return rpc(msg, timeout=30)
+        return rpc(msg, timeout=30, metadata=tr.inject_context())
 
     def _heartbeat_loop(self):
         while not self._hb_stop.wait(1.0):
@@ -208,10 +285,10 @@ class WorkerActor(Actor):
     def receive(self, message):
         kind, payload = message
         if kind == "run_task":
-            task: pb.TaskDefinition = payload
+            task, parent = payload
             key = (task.job_id, task.stage, task.partition)
             self._running[key] = threading.Event()
-            self._pool.submit(self._run_task, task)
+            self._pool.submit(self._run_task, task, parent)
 
     # -- task execution --------------------------------------------------
     def _fetch_inputs(self, task: pb.TaskDefinition):
@@ -242,9 +319,17 @@ class WorkerActor(Actor):
                 else parts[0]
         return tables
 
-    def _run_task(self, task: pb.TaskDefinition):
+    def _run_task(self, task: pb.TaskDefinition, parent=None):
         from .local import LocalExecutor
         key = (task.job_id, task.stage, task.partition)
+        with tr.span(f"worker:task s{task.stage}p{task.partition}",
+                     {"job_id": task.job_id, "stage": task.stage,
+                      "partition": task.partition,
+                      "worker": self.worker_id}, parent=parent):
+            self._run_task_inner(task, key)
+
+    def _run_task_inner(self, task: pb.TaskDefinition, key):
+        from .local import LocalExecutor
         try:
             self._report(task, "running")
             plan = jg.decode_fragment(task.plan, task.partition,
@@ -348,9 +433,11 @@ def _resolve_driver_scans(plan, task: pb.TaskDefinition):
 # ---------------------------------------------------------------------------
 
 class _Job:
-    def __init__(self, job_id: str, graph: jg.JobGraph):
+    def __init__(self, job_id: str, graph: jg.JobGraph,
+                 trace_ctx=None):
         self.job_id = job_id
         self.graph = graph
+        self.trace_ctx = trace_ctx
         self.failed: Optional[str] = None
         self.done = threading.Event()
         # per stage: partition → worker addr (set on success)
@@ -369,8 +456,9 @@ class DriverActor(Actor):
     HEARTBEAT_TIMEOUT_S = 10.0
     MAX_TASK_ATTEMPTS = 3
 
-    def __init__(self):
+    def __init__(self, host: str = "127.0.0.1"):
         super().__init__()
+        self.host = host
         self.driver_id = uuid.uuid4().hex[:8]
         self.workers: Dict[str, dict] = {}
         self.jobs: Dict[str, _Job] = {}
@@ -381,7 +469,7 @@ class DriverActor(Actor):
 
     @property
     def addr(self) -> str:
-        return f"127.0.0.1:{self.port}"
+        return f"{self.host}:{self.port}"
 
     # -- rpc service -----------------------------------------------------
     def _scan_tables_view(self):
@@ -410,15 +498,16 @@ class DriverActor(Actor):
             "RegisterWorker": _unary(register, pb.RegisterWorkerRequest),
             "Heartbeat": _unary(heartbeat, pb.HeartbeatRequest),
             "ReportTaskStatus": _unary(report, pb.ReportTaskStatusRequest),
-            "FetchStream": _unary(
+            "FetchStream": grpc.unary_stream_rpc_method_handler(
                 _fetch_stream_handler(self.streams, self._scan_tables_view),
-                pb.FetchStreamRequest),
+                request_deserializer=pb.FetchStreamRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
         })
 
     def on_start(self):
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
         self._server.add_generic_rpc_handlers((self._service(),))
-        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self.port = self._server.add_insecure_port(f"{self.host}:0")
         self._server.start()
         threading.Thread(target=self._probe_loop, daemon=True).start()
 
@@ -554,7 +643,12 @@ class DriverActor(Actor):
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=pb.RunTaskResponse.FromString)
         try:
-            rpc(pb.RunTaskRequest(task=task), timeout=30)
+            with tr.span(f"driver:launch s{stage_id}p{partition}",
+                         {"job_id": job.job_id, "worker": wid},
+                         parent=job.trace_ctx) as ls:
+                rpc(pb.RunTaskRequest(task=task), timeout=30,
+                    metadata=[("traceparent",
+                               f"00-{ls.trace_id}-{ls.span_id}-01")])
         except grpc.RpcError:
             # dispatch failure = dead worker: evict immediately and redo the
             # SAME attempt elsewhere (a launch failure is not a task failure)
@@ -660,7 +754,7 @@ class LocalCluster:
             time.sleep(0.01)
         self.workers: List[WorkerActor] = []
         for i in range(num_workers):
-            w = WorkerActor(f"worker-{i}", f"127.0.0.1:{self.driver.port}",
+            w = WorkerActor(f"worker-{i}", self.driver.addr,
                             task_slots)
             w.start(f"worker-{i}")
             self.workers.append(w)
@@ -678,7 +772,17 @@ class LocalCluster:
         graph = jg.split_job(plan, nparts)
         if graph is None:
             return LocalExecutor().execute(plan)
-        job = _Job(uuid.uuid4().hex[:12], graph)
+        with tr.span("cluster:job") as root_span:
+            job = _Job(uuid.uuid4().hex[:12], graph,
+                       trace_ctx=tr.SpanContext(root_span.trace_id,
+                                                root_span.span_id))
+            return self._run_submitted(job, timeout)
+
+    def _run_submitted(self, job, timeout):
+        import pyarrow as pa
+        from .local import LocalExecutor
+
+        graph = job.graph
         self.last_job = job
         self.driver.handle.ask(lambda reply: ("submit", (job, reply)))
         try:

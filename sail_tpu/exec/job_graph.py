@@ -321,6 +321,18 @@ class _Builder:
             return table is not None and table.num_rows <= BROADCAST_ROW_LIMIT
         return False
 
+    def _reshard(self, producer: Stage) -> Stage:
+        """Identity re-shard stage: consumes an already-shuffled producer
+        (a producer shuffle-writes at most once) and re-routes its rows
+        under this stage's own shuffle keys. Reference role: the extra
+        exchange DataFusion's EnforceDistribution inserts between
+        incompatible hash distributions (job_graph/planner.rs:42-61)."""
+        inp = StageInputExec(tuple(producer.plan.schema), producer.stage_id)
+        return self._add(Stage(
+            len(self.stages), inp,
+            (StageInput(producer.stage_id, InputMode.SHUFFLE),),
+            self.nparts))
+
     def _build_join(self, p: pn.JoinExec) -> Optional[Stage]:
         if p.join_type == "cross" or not p.left_keys or p.null_aware:
             return None
@@ -337,24 +349,27 @@ class _Builder:
         if right is None:
             del self.stages[n_before:]
             return None
-        l_in = StageInputExec(tuple(p.left.schema), left.stage_id)
-        r_in = StageInputExec(tuple(p.right.schema), right.stage_id)
-        join_plan = dataclasses.replace(p, left=l_in, right=r_in)
         if self._estimated_small(right) and p.join_type in (
-                "inner", "left", "semi", "anti"):
+                "inner", "left", "semi", "anti") and \
+                right.shuffle_keys is None:
             # broadcast build side: one producer task, every probe task
             # fetches the whole build output
+            l_in = StageInputExec(tuple(p.left.schema), left.stage_id)
+            r_in = StageInputExec(tuple(p.right.schema), right.stage_id)
+            join_plan = dataclasses.replace(p, left=l_in, right=r_in)
             right.num_partitions = 1
             return self._add(Stage(
                 len(self.stages), join_plan,
                 (StageInput(left.stage_id, InputMode.FORWARD),
                  StageInput(right.stage_id, InputMode.BROADCAST)),
                 left.num_partitions))
-        if left.shuffle_keys is not None or right.shuffle_keys is not None:
-            # a producer can only shuffle-write once; re-sharding an
-            # already-shuffled stage needs an extra identity stage
-            del self.stages[n_before:]
-            return None
+        if left.shuffle_keys is not None:
+            left = self._reshard(left)
+        if right.shuffle_keys is not None:
+            right = self._reshard(right)
+        l_in = StageInputExec(tuple(p.left.schema), left.stage_id)
+        r_in = StageInputExec(tuple(p.right.schema), right.stage_id)
+        join_plan = dataclasses.replace(p, left=l_in, right=r_in)
         left.shuffle_keys = lkeys
         left.num_channels = self.nparts
         right.shuffle_keys = rkeys
@@ -367,19 +382,27 @@ class _Builder:
 
     def _build_aggregate(self, p: pn.AggregateExec) -> Optional[Stage]:
         if any(a.distinct for a in p.aggs):
-            return None
+            return self._build_distinct_aggregate(p)
         if any(a.fn not in _MERGEABLE_AGGS for a in p.aggs):
             return None
         child = self.build(p.input)
         if child is None:
             return None
-        if child.shuffle_keys is not None:
-            return None  # producer already routes a join shuffle
         nk = len(p.group_indices)
-        # partial aggregate fused into the producer stage (pre-shuffle
-        # reduction: the TPU two-phase aggregation plan)
-        partial = dataclasses.replace(p, input=child.plan)
-        child.plan = partial
+        if child.shuffle_keys is not None:
+            # producer already routes a join shuffle: the partial
+            # aggregate becomes its OWN stage consuming that shuffle
+            inp = StageInputExec(tuple(child.plan.schema), child.stage_id)
+            partial = dataclasses.replace(p, input=inp)
+            child = self._add(Stage(
+                len(self.stages), partial,
+                (StageInput(child.stage_id, InputMode.SHUFFLE),),
+                self.nparts))
+        else:
+            # partial aggregate fused into the producer stage (pre-shuffle
+            # reduction: the TPU two-phase aggregation plan)
+            partial = dataclasses.replace(p, input=child.plan)
+            child.plan = partial
         child.shuffle_keys = tuple(range(nk))
         child.num_channels = self.nparts
         # final merge aggregate over the shuffled partials
@@ -391,6 +414,41 @@ class _Builder:
                 _MERGEABLE_AGGS[a.fn], nk + j, False, out_f.dtype,
                 None, a.ignore_nulls))
         final = pn.AggregateExec(f_in, tuple(range(nk)), tuple(final_aggs),
+                                 tuple(p.out_names), p.max_groups_hint)
+        return self._add(Stage(
+            len(self.stages), final,
+            (StageInput(child.stage_id, InputMode.SHUFFLE),),
+            self.nparts))
+
+    def _build_distinct_aggregate(self, p: pn.AggregateExec
+                                  ) -> Optional[Stage]:
+        """Distributed DISTINCT via two-level dedup: partial GROUP BY
+        (group keys, arg) per partition prunes duplicates, a shuffle on
+        the group keys co-locates each group, and the original distinct
+        aggregate runs exactly on each co-located group."""
+        args = {a.arg for a in p.aggs if a.distinct}
+        if len(args) != 1 or None in args or \
+                not all(a.distinct for a in p.aggs) or \
+                any(a.filter is not None for a in p.aggs):
+            return None  # mixed / multi-argument DISTINCT stays local
+        arg = args.pop()
+        child = self.build(p.input)
+        if child is None:
+            return None
+        if child.shuffle_keys is not None:
+            child = self._reshard(child)
+        nk = len(p.group_indices)
+        dedup_indices = tuple(p.group_indices) + (arg,)
+        dedup_names = tuple(f"d{i}" for i in range(len(dedup_indices)))
+        partial = pn.AggregateExec(child.plan, dedup_indices, (),
+                                   dedup_names, p.max_groups_hint)
+        child.plan = partial
+        child.shuffle_keys = tuple(range(nk))
+        child.num_channels = self.nparts
+        f_in = StageInputExec(tuple(partial.schema), child.stage_id)
+        final_aggs = tuple(
+            dataclasses.replace(a, arg=nk) for a in p.aggs)
+        final = pn.AggregateExec(f_in, tuple(range(nk)), final_aggs,
                                  tuple(p.out_names), p.max_groups_hint)
         return self._add(Stage(
             len(self.stages), final,

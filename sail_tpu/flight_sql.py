@@ -1,0 +1,179 @@
+"""Arrow Flight SQL front-end.
+
+Reference role: crates/sail-flight/src/service.rs:70-207 — the minimal
+Flight SQL surface: handshake, ``get_flight_info`` for a statement (plan
+the SQL, return a ticket + schema), ``do_get`` (execute through the same
+session/plan stack and stream record batches).
+
+Protocol notes: Flight SQL wraps commands as ``google.protobuf.Any`` over
+``arrow.flight.protocol.sql.CommandStatementQuery``. Those two messages
+are tiny, so they are decoded with hand-rolled protobuf wire parsing
+instead of vendored codegen; plain UTF-8 SQL bytes in the descriptor are
+accepted too (handy for generic ``pyarrow.flight`` clients).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+import pyarrow as pa
+import pyarrow.flight as fl
+
+_ANY_PREFIX = b"type.googleapis.com/arrow.flight.protocol.sql."
+
+
+def _read_varint(buf: bytes, pos: int):
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        out |= (b & 0x7F) << shift
+        pos += 1
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _proto_fields(buf: bytes) -> Dict[int, list]:
+    """Minimal protobuf wire decoder: field number → list of raw values
+    (bytes for length-delimited, int for varint)."""
+    fields: Dict[int, list] = {}
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            v, pos = _read_varint(buf, pos)
+        elif wire == 2:  # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:  # fixed32
+            v = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:  # fixed64
+            v = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(v)
+    return fields
+
+
+def _write_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _proto_field(field: int, value: bytes) -> bytes:
+    return _write_varint((field << 3) | 2) + _write_varint(len(value)) + value
+
+
+def pack_statement_query(sql: str) -> bytes:
+    """Build an Any-wrapped CommandStatementQuery (what a Flight SQL
+    client puts in the FlightDescriptor command)."""
+    cmd = _proto_field(1, sql.encode())  # CommandStatementQuery.query = 1
+    any_msg = _proto_field(1, _ANY_PREFIX + b"CommandStatementQuery") + \
+        _proto_field(2, cmd)
+    return any_msg
+
+
+def decode_statement_command(command: bytes) -> Optional[str]:
+    """FlightDescriptor.command → SQL text (Any-wrapped Flight SQL
+    CommandStatementQuery / TicketStatementQuery, or raw UTF-8 SQL)."""
+    if not command:
+        return None
+    try:
+        fields = _proto_fields(command)
+        type_url = fields.get(1, [b""])[0]
+        if isinstance(type_url, bytes) and type_url.startswith(_ANY_PREFIX):
+            inner = _proto_fields(fields[2][0])
+            val = inner.get(1, [b""])[0]
+            return val.decode() if isinstance(val, bytes) else None
+    except (ValueError, IndexError, KeyError, UnicodeDecodeError):
+        pass
+    try:
+        return command.decode()
+    except UnicodeDecodeError:
+        return None
+
+
+class FlightSqlServer(fl.FlightServerBase):
+    """Flight SQL server over the engine's session/plan stack."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 session_timeout_s: float = 3600.0):
+        location = f"grpc://{host}:{port}"
+        super().__init__(location)
+        self._host = host
+        self._lock = threading.Lock()
+        # one engine session per Flight client identity is overkill for the
+        # minimal surface; a single shared session mirrors the reference's
+        # default-session behavior (service.rs:70)
+        from .session import SparkSession
+        self._spark = SparkSession()
+        self._tickets: Dict[bytes, tuple] = {}  # ticket -> (sql, born_ts)
+        self._ticket_ttl_s = 600.0
+
+    @property
+    def session(self):
+        return self._spark
+
+    def _plan_schema(self, sql: str) -> pa.Schema:
+        from .columnar.arrow_interop import spec_type_to_arrow
+        node = self._spark._resolve(self._spark.sql(sql)._plan)
+        return pa.schema([(f.name, spec_type_to_arrow(f.dtype))
+                          for f in node.schema])
+
+    # -- FlightServerBase ------------------------------------------------
+    def get_flight_info(self, context, descriptor):
+        sql = decode_statement_command(descriptor.command)
+        if sql is None:
+            raise fl.FlightServerError("descriptor carries no SQL statement")
+        schema = self._plan_schema(sql)
+        ticket_bytes = uuid.uuid4().hex.encode()
+        now = time.time()
+        with self._lock:
+            # prune tickets never redeemed (planning-only clients)
+            expired = [t for t, (_, born) in self._tickets.items()
+                       if now - born > self._ticket_ttl_s]
+            for t in expired:
+                del self._tickets[t]
+            self._tickets[ticket_bytes] = (sql, now)
+        endpoint = fl.FlightEndpoint(
+            ticket_bytes, [f"grpc://{self._host}:{self.port}"])
+        return fl.FlightInfo(schema, descriptor, [endpoint], -1, -1)
+
+    def do_get(self, context, ticket):
+        with self._lock:
+            entry = self._tickets.pop(ticket.ticket, None)
+            sql = entry[0] if entry else None
+        if sql is None:
+            # direct-ticket mode: ticket IS the statement (Flight SQL
+            # TicketStatementQuery or raw SQL)
+            sql = decode_statement_command(ticket.ticket)
+        if sql is None:
+            raise fl.FlightServerError("unknown ticket")
+        table = self._spark.sql(sql).toArrow()
+        return fl.RecordBatchStream(table)
+
+    def get_schema(self, context, descriptor):
+        sql = decode_statement_command(descriptor.command)
+        if sql is None:
+            raise fl.FlightServerError("descriptor carries no SQL statement")
+        return fl.SchemaResult(self._plan_schema(sql))
+
+    def do_action(self, context, action):
+        if action.type == "health":
+            return iter([fl.Result(b"ok")])
+        raise fl.FlightServerError(f"unsupported action {action.type!r}")

@@ -28,6 +28,14 @@ class AddFile:
     modification_time: int = 0
     data_change: bool = True
     stats: Optional[str] = None
+    # inline deletion-vector descriptor tuple (sorted key/value pairs), or
+    # None — kept hashable for the frozen dataclass
+    deletion_vector: Optional[Tuple[Tuple[str, object], ...]] = None
+
+    def dv(self):
+        from .deletion_vector import DeletionVector
+        return DeletionVector.from_json(
+            dict(self.deletion_vector) if self.deletion_vector else None)
 
     def to_json(self) -> dict:
         return {"add": {
@@ -36,6 +44,8 @@ class AddFile:
             "modificationTime": self.modification_time,
             "dataChange": self.data_change,
             **({"stats": self.stats} if self.stats else {}),
+            **({"deletionVector": dict(self.deletion_vector)}
+               if self.deletion_vector else {}),
         }}
 
 
@@ -243,7 +253,13 @@ class DeltaLog:
                     ("size", pa.int64()),
                     ("modificationTime", pa.int64()),
                     ("dataChange", pa.bool_()),
-                    ("stats", pa.string())])),
+                    ("stats", pa.string()),
+                    ("deletionVector", pa.struct([
+                        ("storageType", pa.string()),
+                        ("pathOrInlineDv", pa.string()),
+                        ("offset", pa.int32()),
+                        ("sizeInBytes", pa.int32()),
+                        ("cardinality", pa.int64())]))])),
                 ("remove", pa.struct([
                     ("path", pa.string()),
                     ("deletionTimestamp", pa.int64()),
@@ -267,6 +283,7 @@ class DeltaLog:
             a = add.to_json()["add"]
             a["partitionValues"] = list(a["partitionValues"].items())
             a.setdefault("stats", None)
+            a.setdefault("deletionVector", None)
             rows.append({"add": a})
         cutoff = int(time.time() * 1000) - _retention_ms(snapshot)
         for rm in snapshot.tombstones.values():
@@ -356,11 +373,13 @@ class DeltaLog:
         elif "add" in action:
             a = action["add"]
             snap.tombstones.pop(a["path"], None)
+            dv = a.get("deletionVector")
             snap.files[a["path"]] = AddFile(
                 a["path"], a.get("size", 0),
                 tuple(sorted((a.get("partitionValues") or {}).items())),
                 a.get("modificationTime", 0), a.get("dataChange", True),
-                a.get("stats"))
+                a.get("stats"),
+                tuple(sorted(dv.items())) if dv else None)
         elif "remove" in action:
             r = action["remove"]
             snap.files.pop(r["path"], None)

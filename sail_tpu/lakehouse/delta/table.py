@@ -80,6 +80,13 @@ class DeltaTable:
             if columns is not None:
                 want = [c for c in columns if c not in part_cols]
             t = pq.read_table(fpath, columns=want)
+            dv = add.dv()
+            if dv is not None and dv.cardinality:
+                import numpy as np
+                deleted = dv.row_indices()
+                keep = np.ones(t.num_rows, dtype=bool)
+                keep[deleted[deleted < t.num_rows].astype(np.int64)] = False
+                t = t.filter(pa.array(keep))
             pv = dict(add.partition_values)
             for c in part_cols:
                 if columns is not None and c not in columns:
@@ -189,9 +196,15 @@ class DeltaTable:
             tx.add_file(add)
         return tx.commit()
 
-    def delete_where(self, mask_fn) -> Tuple[int, int]:
-        """Copy-on-write DELETE: ``mask_fn(table) -> bool mask of rows to
-        KEEP``. Returns (version, deleted_rows)."""
+    def delete_where(self, mask_fn, mode: str = "cow") -> Tuple[int, int]:
+        """Row-level DELETE: ``mask_fn(table) -> bool mask of rows to
+        KEEP``. Returns (version, deleted_rows).
+
+        mode="cow" rewrites touched files (copy-on-write); mode="dv"
+        writes a deletion vector on each touched file instead — the
+        merge-on-read plan of the reference's build_merge_plan_mor
+        (crates/sail-delta-lake/src/physical_plan/planner/op_merge.rs)."""
+        import numpy as np
         import pyarrow.parquet as pq
 
         snap = self.snapshot()
@@ -212,13 +225,37 @@ class DeltaTable:
                     val = _parse_partition_value(pv.get(c), at)
                     full = full.append_column(
                         c, pa.array([val] * full.num_rows, type=at))
-            keep = mask_fn(full)
-            kept = full.filter(keep)
-            if kept.num_rows == full.num_rows:
+            existing_dv = add.dv()
+            prior = existing_dv.row_indices() if existing_dv is not None \
+                else np.empty(0, dtype=np.uint64)
+            keep = np.asarray(mask_fn(full))
+            # rows already deleted by a DV stay deleted regardless of mask
+            if prior.size:
+                keep = keep.copy()
+                keep[prior[prior < len(keep)].astype(np.int64)] = True
+                live_mask = np.ones(full.num_rows, dtype=bool)
+                live_mask[prior[prior < full.num_rows].astype(np.int64)] = \
+                    False
+            else:
+                live_mask = np.ones(full.num_rows, dtype=bool)
+            newly = (~keep) & live_mask
+            n_new = int(newly.sum())
+            if n_new == 0:
                 continue  # file untouched
             tx.read_files.add(add.path)
+            deleted += n_new
+            if mode == "dv":
+                from .deletion_vector import DeletionVector
+                all_deleted = np.union1d(prior,
+                                         np.nonzero(newly)[0]
+                                         .astype(np.uint64))
+                dv = DeletionVector.from_row_indices(all_deleted)
+                tx.add_file(AddFile(
+                    add.path, add.size, add.partition_values, now, True,
+                    add.stats, tuple(sorted(dv.to_json().items()))))
+                continue
             tx.remove_file(RemoveFile(add.path, now))
-            deleted += full.num_rows - kept.num_rows
+            kept = full.filter(pa_array_bool(keep & live_mask))
             if kept.num_rows:
                 for new_add in self._write_data_files(
                         kept, snap.metadata.partition_columns):
@@ -226,6 +263,12 @@ class DeltaTable:
         if deleted == 0:
             return snap.version, 0
         return tx.commit(), deleted
+
+
+def pa_array_bool(mask):
+    import pyarrow as pa
+    return pa.array(mask.tolist() if hasattr(mask, "tolist") else mask,
+                    type=pa.bool_())
 
 
 def _format_partition_value(v) -> str:

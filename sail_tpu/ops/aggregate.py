@@ -190,12 +190,23 @@ def group_overflow(ctx: GroupContext) -> jnp.ndarray:
 # broadcast-reduction runs as G vectorized passes that XLA fuses (the
 # [G, n] compare/select fuses into the row reduction — nothing
 # materializes), ~100x faster. Above the threshold the compute cost of
-# G*n element ops exceeds the scatter cost and we fall back.
+# G*n element ops exceeds the scatter cost and we fall back. On CPU the
+# scatter lowering is already fast, and the masked form is a slowdown —
+# so the masked path is TPU(-like)-only.
 _MASKED_SEGMENTS_MAX = 128
+_MASKED_BACKENDS = ("tpu",)
+
+
+def _masked_max_segments() -> int:
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover — backend init failure
+        backend = "cpu"
+    return _MASKED_SEGMENTS_MAX if backend in _MASKED_BACKENDS else 0
 
 
 def _seg_reduce(vals, seg_ids, num_segments: int, kind: str, identity):
-    if num_segments <= _MASKED_SEGMENTS_MAX:
+    if num_segments <= _masked_max_segments():
         gids = jnp.arange(num_segments, dtype=seg_ids.dtype)[:, None]
         hit = seg_ids[None, :] == gids
         body = jnp.where(hit, vals[None, :],

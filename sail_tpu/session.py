@@ -346,280 +346,20 @@ class SparkSession:
         return self._execute_query(plan)
 
     def _delta_delete(self, cmd: sp.Delete) -> pa.Table:
-        import numpy as np
-        entry, dt_table = self._delta_entry(cmd.table)
-        if cmd.condition is None:
-            version, deleted = dt_table.delete_where(
-                lambda tb: pa.array([False] * tb.num_rows))
-        else:
-            def keep_mask(tb):
-                pred = self._eval_predicate(tb, cmd.condition).column(0)
-                hit = np.asarray(pred.fill_null(False).to_pylist(),
-                                 dtype=bool) if tb.num_rows else \
-                    np.zeros(0, dtype=bool)
-                return pa.array(~hit)
-            version, deleted = dt_table.delete_where(keep_mask)
-        return pa.table({"num_affected_rows":
-                         pa.array([deleted], type=pa.int64())})
+        from .lakehouse.delta.dml import DeltaDml
+        return DeltaDml(self, cmd.table).delete(cmd.condition)
 
     def _delta_update(self, cmd: sp.Update) -> pa.Table:
-        import pyarrow.parquet as pq
-        import sail_tpu.spec.expression as ex
-        from .lakehouse.delta.log import RemoveFile
-        from .lakehouse.delta.transaction import Transaction
-        import time as _t
-
-        entry, dt_table = self._delta_entry(cmd.table)
-        snap = dt_table.snapshot()
-        schema = snap.schema
-        assigns = {path[-1].lower(): expr
-                   for path, expr in cmd.assignments}
-        cond = cmd.condition
-        tx = Transaction(dt_table.log, snap.version, "UPDATE")
-        now = int(_t.time() * 1000)
-        updated = 0
-        part_cols = list(snap.metadata.partition_columns)
-        for add in list(snap.files.values()):
-            t = pq.read_table(os.path.join(dt_table.path, add.path))
-            if part_cols:
-                from .lakehouse.delta.table import _parse_partition_value
-                from .columnar.arrow_interop import spec_type_to_arrow
-                pv = dict(add.partition_values)
-                for c in part_cols:
-                    f = schema.field(c)
-                    at = spec_type_to_arrow(f.data_type)
-                    val = _parse_partition_value(pv.get(c), at)
-                    t = t.append_column(c, pa.array([val] * t.num_rows,
-                                                    type=at))
-            if cond is not None:
-                pred = self._eval_predicate(t, cond).column(0)
-                nhit = pred.fill_null(False).to_pandas().sum()
-                if not nhit:
-                    continue
-            # rewrite the file with CASE WHEN cond THEN expr ELSE col END
-            exprs = []
-            for f in schema.fields:
-                col = ex.Attribute((f.name,))
-                if f.name.lower() in assigns:
-                    new = assigns[f.name.lower()]
-                    val = new if cond is None else \
-                        ex.CaseWhen(((cond, new),), col)
-                    exprs.append(ex.Alias(ex.Cast(val, f.data_type),
-                                          (f.name,)))
-                else:
-                    exprs.append(ex.Alias(col, (f.name,)))
-            rewritten = self._execute_query(
-                sp.Project(sp.LocalRelation(t), tuple(exprs)))
-            tx.read_files.add(add.path)
-            tx.remove_file(RemoveFile(add.path, now))
-            for new_add in dt_table._write_data_files(
-                    rewritten, snap.metadata.partition_columns):
-                tx.add_file(new_add)
-            if cond is not None:
-                updated += int(nhit)
-            else:
-                updated += t.num_rows
-        if updated:
-            tx.commit()
-        return pa.table({"num_affected_rows":
-                         pa.array([updated], type=pa.int64())})
+        from .lakehouse.delta.dml import DeltaDml
+        return DeltaDml(self, cmd.table).update(cmd)
 
     def _delta_merge(self, cmd: sp.MergeInto) -> pa.Table:
-        """MERGE INTO on a Delta table (reference role:
-        crates/sail-delta-lake/src/physical_plan/planner/op_merge.rs —
-        copy-on-write variant). The match sets and per-clause values are
-        computed by the ENGINE over the target⋈source join; the final
-        table commits as one MERGE transaction."""
-        import numpy as np
-
-        entry, dt_table = self._delta_entry(cmd.target)
-        snap = dt_table.snapshot()
-        schema = snap.schema
-        col_names = [f.name for f in schema.fields]
-        t_arrow = dt_table.to_arrow(version=snap.version)
-        t_arrow = t_arrow.append_column(
-            "__rid__", pa.array(np.arange(t_arrow.num_rows), pa.int64()))
-        t_alias = (cmd.target_alias or cmd.target[-1])
-        target_plan = sp.SubqueryAlias(sp.LocalRelation(t_arrow), t_alias)
-
-        def run(plan):
-            return self._execute_query(plan)
-
-        # materialize the source ONCE with row ids, so not-matched clauses
-        # can claim rows first-clause-wins; keep (or synthesize) its alias
-        # Spark exposes a plain named source table under its (unqualified)
-        # table name, so clause conditions like `src.flag` resolve
-        if isinstance(cmd.source, sp.SubqueryAlias):
-            s_alias = cmd.source.alias
-        elif isinstance(cmd.source, sp.ReadNamedTable):
-            s_alias = cmd.source.name[-1]
-        else:
-            s_alias = "__src__"
-        s_arrow = run(cmd.source)
-        s_cols = list(s_arrow.column_names)
-        s_arrow = s_arrow.append_column(
-            "__srid__", pa.array(np.arange(s_arrow.num_rows), pa.int64()))
-        source_plan = sp.SubqueryAlias(sp.LocalRelation(s_arrow), s_alias)
-        join = sp.Join(target_plan, source_plan, "inner", cmd.condition)
-
-        if cmd.matched_actions:
-            # a target row may be updated/deleted by at most one source row;
-            # like Delta, only matches that could actually modify a row count
-            # (a duplicate satisfying no matched-clause condition is fine)
-            card_base: sp.QueryPlan = join
-            conds = [a.condition for a in cmd.matched_actions]
-            if all(c is not None for c in conds):
-                disj = conds[0]
-                for c in conds[1:]:
-                    disj = ex.Function("or", (disj, c))
-                card_base = sp.Filter(join, disj)
-            dup = run(sp.Filter(
-                sp.Aggregate(card_base, (ex.col("__rid__"),),
-                             (ex.col("__rid__"),
-                              ex.Alias(ex.Function("count", ()), ("c",)))),
-                ex.Function(">", (ex.col("c"), ex.lit(1)))))
-            if dup.num_rows:
-                raise ValueError(
-                    "MERGE cardinality violation: a target row matched "
-                    "multiple source rows")
-
-        claimed: set = set()
-        updates: Dict[int, dict] = {}
-        deletes: set = set()
-        for action in cmd.matched_actions:
-            base: sp.QueryPlan = join
-            if action.condition is not None:
-                base = sp.Filter(join, action.condition)
-            if action.action == "delete":
-                rids = run(sp.Project(base, (ex.col("__rid__"),)))
-                for r in rids.column(0).to_pylist():
-                    if r not in claimed:
-                        claimed.add(r)
-                        deletes.add(r)
-            elif action.action in ("update", "update_star"):
-                exprs = [ex.Alias(ex.col("__rid__"), ("__rid__",))]
-                if action.action == "update_star":
-                    assigns = {c.lower(): ex.Attribute((s_alias, c))
-                               for c in s_cols}
-                else:
-                    assigns = {path[-1].lower(): e
-                               for path, e in action.assignments}
-                for c, f in zip(col_names, schema.fields):
-                    e = assigns.get(c.lower())
-                    e = ex.Attribute((t_alias, c)) if e is None else \
-                        ex.Cast(e, f.data_type)
-                    exprs.append(ex.Alias(e, (c,)))
-                rows = run(sp.Project(base, tuple(exprs))).to_pylist()
-                for row in rows:
-                    rid = row.pop("__rid__")
-                    if rid not in claimed:
-                        claimed.add(rid)
-                        updates[rid] = row
-            else:
-                raise ValueError(
-                    f"unsupported matched action {action.action!r}")
-        # not-matched source rows → inserts (first satisfied clause wins)
-        inserts = []
-        claimed_src: set = set()
-        anti = sp.Join(source_plan, target_plan, "anti", cmd.condition)
-        for action in cmd.not_matched_actions:
-            base = anti
-            if action.condition is not None:
-                base = sp.Filter(anti, action.condition)
-            if action.action == "insert_star":
-                src_low = {c.lower(): c for c in s_cols}
-                assigns = {c.lower(): ex.Attribute(
-                    (s_alias, src_low[c.lower()]))
-                    for c in col_names if c.lower() in src_low}
-            elif action.action == "insert":
-                assigns = {path[-1].lower(): e
-                           for path, e in action.assignments}
-            else:
-                raise ValueError(
-                    f"unsupported not-matched action {action.action!r}")
-            exprs = [ex.Alias(ex.Attribute((s_alias, "__srid__")),
-                              ("__srid__",))]
-            for c, f in zip(col_names, schema.fields):
-                e = assigns.get(c.lower())
-                e = ex.lit(None) if e is None else ex.Cast(e, f.data_type)
-                exprs.append(ex.Alias(e, (c,)))
-            for row in run(sp.Project(base, tuple(exprs))).to_pylist():
-                srid = row.pop("__srid__")
-                if srid not in claimed_src:
-                    claimed_src.add(srid)
-                    inserts.append(row)
-        # not matched by source → update/delete target rows without a match
-        if cmd.not_matched_by_source_actions:
-            t_anti = sp.Join(target_plan, source_plan, "anti",
-                             cmd.condition)
-            for action in cmd.not_matched_by_source_actions:
-                base = t_anti
-                if action.condition is not None:
-                    base = sp.Filter(t_anti, action.condition)
-                if action.action == "delete":
-                    for r in run(sp.Project(
-                            base, (ex.col("__rid__"),))).column(0).to_pylist():
-                        if r not in claimed:
-                            claimed.add(r)
-                            deletes.add(r)
-                elif action.action == "update":
-                    assigns = {path[-1].lower(): e
-                               for path, e in action.assignments}
-                    exprs = [ex.Alias(ex.col("__rid__"), ("__rid__",))]
-                    for c, f in zip(col_names, schema.fields):
-                        e = assigns.get(c.lower())
-                        e = ex.Attribute((c,)) if e is None \
-                            else ex.Cast(e, f.data_type)
-                        exprs.append(ex.Alias(e, (c,)))
-                    for row in run(sp.Project(base,
-                                              tuple(exprs))).to_pylist():
-                        rid = row.pop("__rid__")
-                        if rid not in claimed:
-                            claimed.add(rid)
-                            updates[rid] = row
-        if not (updates or deletes or inserts):
-            return pa.table({
-                "num_affected_rows": pa.array([0], type=pa.int64()),
-                "num_updated_rows": pa.array([0], type=pa.int64()),
-                "num_deleted_rows": pa.array([0], type=pa.int64()),
-                "num_inserted_rows": pa.array([0], type=pa.int64()),
-            })
-        # assemble the copy-on-write result and commit as MERGE
-        base_rows = t_arrow.drop_columns(["__rid__"]).to_pylist()
-        out_rows = []
-        for rid, row in enumerate(base_rows):
-            if rid in deletes:
-                continue
-            out_rows.append(updates.get(rid, row))
-        out_rows.extend(inserts)
-        from .columnar.arrow_interop import spec_type_to_arrow
-        target_schema = pa.schema(
-            [(f.name, spec_type_to_arrow(f.data_type))
-             for f in schema.fields])
-        final = pa.Table.from_pylist(out_rows, schema=target_schema) \
-            if out_rows else pa.Table.from_arrays(
-                [pa.array([], type=f.type) for f in target_schema],
-                schema=target_schema)
-        from .lakehouse.delta.log import RemoveFile
-        from .lakehouse.delta.transaction import Transaction
-        import time as _t
-        tx = Transaction(dt_table.log, snap.version, "MERGE")
-        tx.read_whole_table = True
-        now = int(_t.time() * 1000)
-        for path in snap.files:
-            tx.remove_file(RemoveFile(path, now))
-        for add in dt_table._write_data_files(
-                final, snap.metadata.partition_columns):
-            tx.add_file(add)
-        tx.commit()
-        return pa.table({
-            "num_affected_rows": pa.array(
-                [len(updates) + len(deletes) + len(inserts)],
-                type=pa.int64()),
-            "num_updated_rows": pa.array([len(updates)], type=pa.int64()),
-            "num_deleted_rows": pa.array([len(deletes)], type=pa.int64()),
-            "num_inserted_rows": pa.array([len(inserts)], type=pa.int64()),
-        })
+        """MERGE INTO on a Delta table — planned and executed by the
+        engine DML pipeline with targeted file rewrites
+        (lakehouse/delta/dml.py; reference:
+        crates/sail-delta-lake/src/physical_plan/planner/op_merge.rs)."""
+        from .lakehouse.delta.dml import DeltaDml
+        return DeltaDml(self, cmd.target).merge(cmd)
 
     def _file_table_entry(self, cmd: sp.CreateTable) -> TableEntry:
         from .io.formats import infer_schema

@@ -111,6 +111,13 @@ class SparkConnectServer:
     # ExecutePlan
     # ------------------------------------------------------------------
     def _execute_plan(self, request: bpb.ExecutePlanRequest, context):
+        from .. import tracing as tr
+        parent = tr.extract_context(context.invocation_metadata())
+        with tr.span("spark_connect:execute_plan",
+                     {"session_id": request.session_id}, parent=parent):
+            yield from self._execute_plan_traced(request, context)
+
+    def _execute_plan_traced(self, request: bpb.ExecutePlanRequest, context):
         session = self._session(request.session_id)
         op_id = request.operation_id or str(uuid.uuid4())
         reattachable = any(

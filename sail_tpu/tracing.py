@@ -1,0 +1,235 @@
+"""Distributed tracing: spans, W3C traceparent propagation, OTLP export.
+
+Reference role: sail-telemetry's fastrace spans with client/server tower
+layers propagating trace context across RPCs and the OTLP pipeline
+(crates/sail-telemetry/src/layers/{client,server}.rs, src/telemetry.rs:
+47-120). The image ships only ``opentelemetry-api`` (no SDK, no exporter),
+so this is a from-scratch implementation:
+
+- ``span(name)``: thread-local span stack; ids follow the W3C trace
+  context format.
+- ``inject_context()`` / ``extract_context()``: ``traceparent`` metadata
+  for gRPC calls — one cluster query yields ONE connected trace across
+  driver and workers.
+- ``OtlpHttpExporter``: background-batched POST of OTLP/HTTP **JSON**
+  (``/v1/traces``) — the encoding every OTLP collector accepts alongside
+  protobuf. Configured via ``telemetry.otlp_endpoint``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+_local = threading.local()
+_lock = threading.Lock()
+
+
+@dataclass
+class Span:
+    trace_id: str          # 32 hex chars
+    span_id: str           # 16 hex chars
+    parent_id: Optional[str]
+    name: str
+    start_ns: int
+    end_ns: int = 0
+    attributes: Dict[str, object] = field(default_factory=dict)
+    status_ok: bool = True
+
+
+@dataclass
+class SpanContext:
+    trace_id: str
+    span_id: str
+
+
+def _current() -> Optional[SpanContext]:
+    stack = getattr(_local, "span_stack", None)
+    return stack[-1] if stack else None
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _current()
+    return ctx.trace_id if ctx else None
+
+
+@contextmanager
+def span(name: str, attributes: Optional[Dict] = None,
+         parent: Optional[SpanContext] = None):
+    """Open a span; nests under the thread's current span (or an explicit
+    remote ``parent`` extracted from RPC metadata)."""
+    stack = getattr(_local, "span_stack", None)
+    if stack is None:
+        stack = _local.span_stack = []
+    if parent is None:
+        parent = stack[-1] if stack else None
+    trace_id = parent.trace_id if parent else secrets.token_hex(16)
+    s = Span(trace_id=trace_id, span_id=secrets.token_hex(8),
+             parent_id=parent.span_id if parent else None,
+             name=name, start_ns=time.time_ns(),
+             attributes=dict(attributes or {}))
+    ctx = SpanContext(trace_id, s.span_id)
+    stack.append(ctx)
+    try:
+        yield s
+    except BaseException:
+        s.status_ok = False
+        raise
+    finally:
+        stack.pop()
+        s.end_ns = time.time_ns()
+        exporter = _exporter()
+        if exporter is not None:
+            exporter.add(s)
+
+
+# ---------------------------------------------------------------------------
+# W3C trace context over gRPC metadata
+# ---------------------------------------------------------------------------
+
+def inject_context() -> List[Tuple[str, str]]:
+    """Metadata to attach to an outgoing RPC (client layer)."""
+    ctx = _current()
+    if ctx is None:
+        return []
+    return [("traceparent", f"00-{ctx.trace_id}-{ctx.span_id}-01")]
+
+
+def extract_context(metadata) -> Optional[SpanContext]:
+    """Parse ``traceparent`` from incoming RPC metadata (server layer)."""
+    if metadata is None:
+        return None
+    for key, value in metadata:
+        if key.lower() == "traceparent":
+            parts = value.split("-")
+            if len(parts) == 4 and len(parts[1]) == 32 and len(parts[2]) == 16:
+                return SpanContext(parts[1], parts[2])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# OTLP/HTTP JSON export
+# ---------------------------------------------------------------------------
+
+class OtlpHttpExporter:
+    """Batched OTLP/HTTP JSON span exporter (POST {endpoint}/v1/traces)."""
+
+    def __init__(self, endpoint: str, service_name: str = "sail-tpu",
+                 flush_interval_s: float = 1.0, max_batch: int = 512):
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self.max_batch = max_batch
+        self._buf: List[Span] = []
+        self._buf_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, args=(flush_interval_s,), daemon=True)
+        self._thread.start()
+
+    def add(self, s: Span):
+        """Enqueue only — span exit must never do network I/O on the hot
+        path; the background flush thread posts. Bounded buffer drops the
+        oldest spans under sustained collector outage."""
+        with self._buf_lock:
+            self._buf.append(s)
+            if len(self._buf) > 16 * self.max_batch:
+                del self._buf[: 8 * self.max_batch]
+
+    def _loop(self, interval: float):
+        while not self._stop.wait(interval):
+            self.flush()
+
+    def flush(self):
+        with self._buf_lock:
+            batch, self._buf = self._buf, []
+        if batch:
+            self._post(batch)
+
+    def shutdown(self):
+        self._stop.set()
+        self.flush()
+
+    @staticmethod
+    def _attr(k: str, v) -> dict:
+        if isinstance(v, bool):
+            value = {"boolValue": v}
+        elif isinstance(v, int):
+            value = {"intValue": str(v)}
+        elif isinstance(v, float):
+            value = {"doubleValue": v}
+        else:
+            value = {"stringValue": str(v)}
+        return {"key": k, "value": value}
+
+    def _post(self, batch: List[Span]):
+        import urllib.request
+
+        payload = {
+            "resourceSpans": [{
+                "resource": {"attributes": [
+                    self._attr("service.name", self.service_name)]},
+                "scopeSpans": [{
+                    "scope": {"name": "sail_tpu"},
+                    "spans": [{
+                        "traceId": s.trace_id,
+                        "spanId": s.span_id,
+                        **({"parentSpanId": s.parent_id}
+                           if s.parent_id else {}),
+                        "name": s.name,
+                        "kind": 1,
+                        "startTimeUnixNano": str(s.start_ns),
+                        "endTimeUnixNano": str(s.end_ns),
+                        "attributes": [self._attr(k, v)
+                                       for k, v in s.attributes.items()],
+                        "status": {"code": 1 if s.status_ok else 2},
+                    } for s in batch],
+                }],
+            }],
+        }
+        req = urllib.request.Request(
+            self.endpoint + "/v1/traces",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10).read()
+        except Exception:  # noqa: BLE001 — telemetry must never break queries
+            pass
+
+
+_EXPORTER: Optional[OtlpHttpExporter] = None
+_EXPORTER_INIT = False
+
+
+def _exporter() -> Optional[OtlpHttpExporter]:
+    global _EXPORTER, _EXPORTER_INIT
+    if not _EXPORTER_INIT:
+        with _lock:
+            if not _EXPORTER_INIT:
+                from .config import get as config_get
+                endpoint = os.environ.get("SAIL_TELEMETRY__OTLP_ENDPOINT") \
+                    or str(config_get("telemetry.otlp_endpoint", "") or "")
+                if endpoint:
+                    _EXPORTER = OtlpHttpExporter(endpoint)
+                _EXPORTER_INIT = True
+    return _EXPORTER
+
+
+def configure_exporter(endpoint: Optional[str]):
+    """Explicit (re)configuration — used by tests and the CLI."""
+    global _EXPORTER, _EXPORTER_INIT
+    with _lock:
+        if _EXPORTER is not None:
+            _EXPORTER.shutdown()
+        _EXPORTER = OtlpHttpExporter(endpoint) if endpoint else None
+        _EXPORTER_INIT = True
+
+
+def flush():
+    if _EXPORTER is not None:
+        _EXPORTER.flush()

@@ -1,0 +1,159 @@
+"""Delta DML engine pipeline: targeted file rewrites, deletion vectors,
+merge-on-read reads (reference:
+crates/sail-delta-lake/src/physical_plan/planner/op_merge.rs:105-330,
+src/deletion_vector/)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession
+from sail_tpu.lakehouse.delta import DeltaTable
+from sail_tpu.lakehouse.delta.deletion_vector import (DeletionVector,
+                                                      deserialize_dv,
+                                                      serialize_dv)
+
+
+@pytest.fixture()
+def spark():
+    s = SparkSession({})
+    yield s
+    s.stop()
+
+
+def _make_delta(spark, path, table, name, partition_by=()):
+    dt = DeltaTable(str(path))
+    dt.create(table, partition_by=partition_by)
+    spark.sql(f"CREATE TABLE {name} USING delta LOCATION '{path}'")
+    return dt
+
+
+def test_dv_bitmap_formats():
+    for rows in ([0], [1, 2, 3], list(range(5000)),
+                 [7, 2**20, 2**33 + 1]):
+        assert deserialize_dv(serialize_dv(rows)).tolist() == \
+            sorted(set(rows))
+    dv = DeletionVector.from_row_indices([10, 20, 10])
+    assert dv.storage_type == "i" and dv.cardinality == 2
+    assert sorted(dv.row_indices().tolist()) == [10, 20]
+    # descriptor JSON roundtrip
+    back = DeletionVector.from_json(dv.to_json())
+    assert back.row_indices().tolist() == dv.row_indices().tolist()
+
+
+def test_delete_with_deletion_vectors(tmp_path, spark):
+    t = pa.table({"id": pa.array(range(100), pa.int64()),
+                  "v": pa.array([i * 1.0 for i in range(100)])})
+    dt = DeltaTable(str(tmp_path / "t"))
+    dt.create(t)
+    # enable DVs via table property
+    import json
+    from sail_tpu.lakehouse.delta.log import Metadata
+    from sail_tpu.lakehouse.delta.transaction import Transaction
+    snap = dt.snapshot()
+    md = snap.metadata
+    tx = Transaction(dt.log, snap.version, "SET TBLPROPERTIES")
+    tx.set_metadata(Metadata(md.schema_string, md.partition_columns,
+                             md.table_id, md.name,
+                             (("delta.enableDeletionVectors", "true"),),
+                             md.created_time))
+    tx.commit()
+    spark.sql(f"CREATE TABLE dvt USING delta LOCATION '{tmp_path / 't'}'")
+    spark.sql("DELETE FROM dvt WHERE id < 10")
+    snap2 = dt.snapshot()
+    # merge-on-read: the data file was NOT rewritten — it gained a DV
+    adds = list(snap2.files.values())
+    assert len(adds) == 1
+    assert adds[0].deletion_vector is not None
+    assert adds[0].dv().cardinality == 10
+    out = spark.sql("SELECT COUNT(*) AS c, MIN(id) AS m FROM dvt").toArrow()
+    assert out.column("c").to_pylist() == [90]
+    assert out.column("m").to_pylist() == [10]
+    # second delete merges into the existing DV
+    spark.sql("DELETE FROM dvt WHERE id >= 95")
+    snap3 = dt.snapshot()
+    assert list(snap3.files.values())[0].dv().cardinality == 15
+    assert spark.sql("SELECT COUNT(*) AS c FROM dvt").toArrow() \
+        .column("c").to_pylist() == [85]
+
+
+def test_merge_rewrites_only_touched_files(tmp_path, spark):
+    """A MERGE touching rows in one file must leave other files'
+    AddFile entries (paths) untouched in the new snapshot."""
+    dt = DeltaTable(str(tmp_path / "m"))
+    # two separate files via create + append
+    dt.create(pa.table({"k": pa.array([1, 2, 3], pa.int64()),
+                        "x": pa.array([10.0, 20.0, 30.0])}))
+    dt.append(pa.table({"k": pa.array([100, 200], pa.int64()),
+                        "x": pa.array([1.0, 2.0])}))
+    before = set(dt.snapshot().files.keys())
+    assert len(before) == 2
+    spark.sql(f"CREATE TABLE mt USING delta LOCATION '{tmp_path / 'm'}'")
+    spark.createDataFrame(pa.table({
+        "k": pa.array([2, 999], pa.int64()),
+        "x": pa.array([222.0, 999.0])})).createOrReplaceTempView("src")
+    res = spark.sql(
+        "MERGE INTO mt t USING src s ON t.k = s.k "
+        "WHEN MATCHED THEN UPDATE SET x = s.x "
+        "WHEN NOT MATCHED THEN INSERT (k, x) VALUES (s.k, s.x)").toArrow()
+    assert res.column("num_updated_rows").to_pylist() == [1]
+    assert res.column("num_inserted_rows").to_pylist() == [1]
+    after = set(dt.snapshot().files.keys())
+    # the file holding k=100/200 was untouched: its path must survive
+    untouched = before & after
+    assert len(untouched) == 1, (before, after)
+    out = spark.sql("SELECT k, x FROM mt").toArrow().to_pandas() \
+        .sort_values("k").reset_index(drop=True)
+    assert out["k"].tolist() == [1, 2, 3, 100, 200, 999]
+    assert out["x"].tolist() == [10.0, 222.0, 30.0, 1.0, 2.0, 999.0]
+
+
+def test_merge_partitioned_targeted(tmp_path, spark):
+    """MERGE on a multi-file partitioned table rewrites only partitions
+    with matches (the VERDICT acceptance shape)."""
+    t = pa.table({"p": pa.array(["a"] * 3 + ["b"] * 3 + ["c"] * 3),
+                  "id": pa.array(range(9), pa.int64()),
+                  "v": pa.array([float(i) for i in range(9)])})
+    dt = DeltaTable(str(tmp_path / "pm"))
+    dt.create(t, partition_by=["p"])
+    before = set(dt.snapshot().files.keys())
+    assert len(before) == 3
+    spark.sql(f"CREATE TABLE pmt USING delta LOCATION '{tmp_path / 'pm'}'")
+    spark.createDataFrame(pa.table({
+        "id2": pa.array([4], pa.int64()),
+        "nv": pa.array([44.0])})).createOrReplaceTempView("psrc")
+    spark.sql("MERGE INTO pmt t USING psrc s ON t.id = s.id2 "
+              "WHEN MATCHED THEN UPDATE SET v = s.nv")
+    after = set(dt.snapshot().files.keys())
+    # only partition b (ids 3-5) was rewritten; a and c files survive
+    assert len(before & after) == 2
+    got = spark.sql("SELECT v FROM pmt WHERE id = 4").toArrow()
+    assert got.column("v").to_pylist() == [44.0]
+
+
+def test_checkpoint_preserves_deletion_vector(tmp_path, spark):
+    t = pa.table({"id": pa.array(range(20), pa.int64())})
+    dt = DeltaTable(str(tmp_path / "cp"))
+    dt.create(t)
+    import json as _json
+    from sail_tpu.lakehouse.delta.log import Metadata
+    from sail_tpu.lakehouse.delta.transaction import Transaction
+    snap = dt.snapshot()
+    md = snap.metadata
+    tx = Transaction(dt.log, snap.version, "SET TBLPROPERTIES")
+    tx.set_metadata(Metadata(md.schema_string, md.partition_columns,
+                             md.table_id, md.name,
+                             (("delta.enableDeletionVectors", "true"),),
+                             md.created_time))
+    tx.commit()
+    spark.sql(f"CREATE TABLE cpt USING delta LOCATION '{tmp_path / 'cp'}'")
+    spark.sql("DELETE FROM cpt WHERE id < 5")
+    dt.log.write_checkpoint(dt.snapshot())
+    # replay from the checkpoint: DV must survive
+    snap2 = dt.snapshot()
+    add = list(snap2.files.values())[0]
+    assert add.dv() is not None and add.dv().cardinality == 5
+    assert spark.sql("SELECT COUNT(*) AS c FROM cpt").toArrow() \
+        .column("c").to_pylist() == [15]

@@ -1,0 +1,132 @@
+"""Distributed tracing: OTLP export + RPC trace propagation — one cluster
+query yields ONE connected trace across driver and workers.
+
+Reference: crates/sail-telemetry/src/layers/{client,server}.rs,
+src/telemetry.rs:47-120 (OTLP pipeline)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sail_tpu import tracing as tr
+
+
+class _Collector:
+    """Minimal OTLP/HTTP test collector."""
+
+    def __init__(self):
+        self.spans = []
+        collector = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                ln = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(ln))
+                for rs in body.get("resourceSpans", []):
+                    for ss in rs.get("scopeSpans", []):
+                        collector.spans.extend(ss.get("spans", []))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_port
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.server.shutdown()
+
+
+@pytest.fixture()
+def collector():
+    c = _Collector()
+    tr.configure_exporter(c.endpoint)
+    yield c
+    tr.configure_exporter(None)
+    c.stop()
+
+
+def test_span_nesting_and_export(collector):
+    with tr.span("outer", {"k": 1}):
+        with tr.span("inner"):
+            pass
+    tr.flush()
+    time.sleep(0.2)
+    by_name = {s["name"]: s for s in collector.spans}
+    assert set(by_name) >= {"outer", "inner"}
+    assert by_name["inner"]["traceId"] == by_name["outer"]["traceId"]
+    assert by_name["inner"]["parentSpanId"] == by_name["outer"]["spanId"]
+    assert by_name["outer"].get("parentSpanId") is None
+
+
+def test_traceparent_roundtrip():
+    with tr.span("root"):
+        md = tr.inject_context()
+        assert md and md[0][0] == "traceparent"
+        ctx = tr.extract_context(md)
+        assert ctx.trace_id == tr.current_trace_id()
+
+
+def test_cluster_query_single_connected_trace(collector):
+    """Driver + worker spans of one distributed job share one trace id and
+    link into a single tree."""
+    from sail_tpu import SparkSession
+    from sail_tpu.exec.cluster import LocalCluster
+
+    spark = SparkSession.builder.getOrCreate()
+    rng = np.random.default_rng(0)
+    t = pa.table({"k": rng.integers(0, 5, 1000), "v": rng.normal(size=1000)})
+    spark.createDataFrame(t).createOrReplaceTempView("trace_t")
+    node = spark._resolve(
+        spark.sql("SELECT k, SUM(v) AS s FROM trace_t GROUP BY k")._plan)
+    cluster = LocalCluster(num_workers=2)
+    try:
+        cluster.run_job(node)
+    finally:
+        cluster.stop()
+        spark.stop()
+    tr.flush()
+    time.sleep(0.3)
+    job_spans = [s for s in collector.spans
+                 if s["name"].startswith(("cluster:job", "driver:launch",
+                                          "worker:task"))]
+    assert any(s["name"].startswith("driver:launch") for s in job_spans)
+    assert any(s["name"].startswith("worker:task") for s in job_spans)
+    trace_ids = {s["traceId"] for s in job_spans}
+    assert len(trace_ids) == 1, f"disconnected traces: {trace_ids}"
+    # every worker task span's parent is a driver launch span
+    launches = {s["spanId"] for s in job_spans
+                if s["name"].startswith("driver:launch")}
+    workers = [s for s in job_spans if s["name"].startswith("worker:task")]
+    assert workers and all(s.get("parentSpanId") in launches
+                           for s in workers)
+
+
+def test_spark_connect_span_exported(collector):
+    from sail_tpu.spark_connect import SparkConnectServer
+    from sail_tpu.spark_connect.client import SparkConnectClient
+
+    srv = SparkConnectServer(port=0).start()
+    cl = SparkConnectClient(f"127.0.0.1:{srv.port}")
+    try:
+        cl.sql("SELECT 1 AS one")
+    finally:
+        cl.release_session()
+        cl.close()
+        srv.stop()
+    tr.flush()
+    time.sleep(0.2)
+    assert any(s["name"] == "spark_connect:execute_plan"
+               for s in collector.spans)
