@@ -7,6 +7,7 @@ shell / worker subcommands).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -65,7 +66,11 @@ def main(argv=None):
     p_worker.add_argument("--driver", required=True,
                           help="host:port of the driver control plane")
     p_worker.add_argument("--host", default="127.0.0.1",
-                          help="address to bind / advertise")
+                          help="address to bind")
+    p_worker.add_argument("--advertise-host", default=None,
+                          help="address the driver/peers dial (defaults to "
+                               "--host; set to the pod IP when binding "
+                               "0.0.0.0)")
     p_worker.add_argument("--task-slots", type=int, default=2)
     p_worker.add_argument("--worker-id", default=None)
 
@@ -98,7 +103,6 @@ def main(argv=None):
         return _shell(args.remote)
 
     if args.command == "bench":
-        import os
         import subprocess
         bench = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "bench.py")
@@ -122,7 +126,9 @@ def main(argv=None):
         from .exec.cluster import WorkerActor
         worker_id = args.worker_id or f"worker-{_uuid.uuid4().hex[:8]}"
         w = WorkerActor(worker_id, args.driver, args.task_slots,
-                        host=args.host)
+                        host=args.host,
+                        advertise_host=(args.advertise_host or
+                                        os.environ.get("SAIL_POD_IP")))
         w.start(worker_id)
         print(f"sail-tpu worker {worker_id} registered with {args.driver}")
         try:

@@ -60,8 +60,12 @@ def arrow_type_to_spec(t: pa.DataType) -> dt.DataType:
         return dt.DateType()
     if pa.types.is_timestamp(t):
         return dt.TimestampType(t.tz)
+    if pa.types.is_time(t):
+        return dt.TimeType()
     if pa.types.is_duration(t):
         return dt.DayTimeIntervalType()
+    if pa.types.is_interval(t):
+        return dt.YearMonthIntervalType()
     if pa.types.is_dictionary(t):
         return arrow_type_to_spec(t.value_type)
     if pa.types.is_null(t):
@@ -102,10 +106,12 @@ def spec_type_to_arrow(d: dt.DataType) -> pa.DataType:
         return pa.date32()
     if isinstance(d, dt.TimestampType):
         return pa.timestamp("us", tz=d.timezone)
+    if isinstance(d, dt.TimeType):
+        return pa.time64("us")
     if isinstance(d, dt.DayTimeIntervalType):
         return pa.duration("us")
     if isinstance(d, dt.YearMonthIntervalType):
-        return pa.int32()  # total months (no arrow ym-interval roundtrip)
+        return pa.month_day_nano_interval()  # months carry the value
     if isinstance(d, dt.NullType):
         return pa.null()
     if isinstance(d, dt.ArrayType):
@@ -221,6 +227,15 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> HostBatch:
                 arr = arr.cast(pa.timestamp("us", tz=arr.type.tz)).view(pa.int64())
             elif isinstance(spec_t, dt.DayTimeIntervalType):
                 arr = arr.cast(pa.duration("us")).view(pa.int64())
+            elif isinstance(spec_t, dt.TimeType):
+                arr = arr.cast(pa.time64("us")).view(pa.int64())
+            elif isinstance(spec_t, dt.YearMonthIntervalType) and \
+                    pa.types.is_interval(arr.type):
+                months = np.array(
+                    [0 if v is None else v[0] for v in arr.to_pylist()],
+                    dtype=np.int32)
+                columns[name] = (months, validity, spec_t)
+                continue
             fill = False if pa.types.is_boolean(arr.type) else 0
             np_vals = np.asarray(arr.fill_null(fill) if arr.null_count else arr)
             columns[name] = (np_vals, validity, spec_t)
@@ -295,6 +310,14 @@ def _column_to_arrow(data, validity, d, dictionary, has_dict) -> pa.Array:
         elif isinstance(d, dt.DayTimeIntervalType):
             arr = pa.array(data.astype("timedelta64[us]"),
                            mask=None if validity is None else ~validity)
+        elif isinstance(d, dt.YearMonthIntervalType):
+            vals = [None if (validity is not None and not validity[i])
+                    else (int(data[i]), 0, 0) for i in range(len(data))]
+            arr = pa.array(vals, type=pa.month_day_nano_interval())
+        elif isinstance(d, dt.TimeType):
+            arr = pa.array(data.astype(np.int64),
+                           mask=None if validity is None else ~validity
+                           ).cast(pa.time64("us"))
         else:
             arr = pa.array(data, mask=None if validity is None else ~validity)
             if arr.type != at:

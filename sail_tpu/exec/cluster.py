@@ -124,8 +124,13 @@ class _StreamStore:
         if entry is None:
             return None
         if isinstance(entry, tuple):
-            with open(entry[1], "rb") as f:
-                return f.read()
+            try:
+                with open(entry[1], "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                # raced clean_job's unlink — behave as channel-not-found so
+                # the fetch retry path (NOT_FOUND) handles it
+                return None
         return entry
 
     def clean_job(self, job_id: str):
@@ -202,12 +207,15 @@ def _fetch_from(addr: str, req: pb.FetchStreamRequest, service: str,
 
 class WorkerActor(Actor):
     def __init__(self, worker_id: str, driver_addr: str, task_slots: int = 2,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", advertise_host: Optional[str] = None):
         super().__init__()
         self.worker_id = worker_id
         self.driver_addr = driver_addr
         self.task_slots = task_slots
         self.host = host
+        # the address peers/driver dial; differs from the bind address when
+        # binding 0.0.0.0 in a pod (reference kubernetes.rs: pod IP)
+        self.advertise_host = advertise_host or host
         self.port = 0
         self._server: Optional[grpc.Server] = None
         self._driver_channel: Optional[grpc.Channel] = None
@@ -254,7 +262,8 @@ class WorkerActor(Actor):
         self._server.start()
         self._driver_channel = grpc.insecure_channel(self.driver_addr)
         resp = self._call_driver("RegisterWorker", pb.RegisterWorkerRequest(
-            worker_id=self.worker_id, host=self.host, port=self.port,
+            worker_id=self.worker_id, host=self.advertise_host,
+            port=self.port,
             task_slots=self.task_slots), pb.RegisterWorkerResponse)
         if not resp.accepted:
             raise RuntimeError("driver rejected worker registration")

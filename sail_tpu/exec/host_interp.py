@@ -170,6 +170,9 @@ class HostInterpreter:
             None if validity is None else np.asarray(validity),
             c.dtype, c.dictionary)
         vals = arr.to_pylist()
+        if isinstance(c.dtype, dt.YearMonthIntervalType):
+            # host functions see YM intervals as int months, not MonthDayNano
+            vals = [None if v is None else int(v[0]) for v in vals]
         if len(vals) != self.cap:
             # constant expressions over zero-column batches produce one row
             vals = (vals * self.cap)[:self.cap] if len(vals) == 1 else \
@@ -215,6 +218,33 @@ class HostInterpreter:
         if name == "uuid":
             import uuid as _uuid
             return [str(_uuid.uuid4()) for _ in range(self.cap)]
+        if name == "monotonically_increasing_id":
+            return list(range(self.cap))
+        if name == "spark_partition_id":
+            return [0] * self.cap
+        if name in ("rand", "randn"):
+            seed = None
+            if e.args:
+                a0 = e.args[0]
+                if isinstance(a0, rx.RLit):
+                    seed = 0 if a0.value.value is None \
+                        else int(a0.value.value)
+            from ..functions.rng import SparkXorShift
+            if seed is not None:
+                rng = SparkXorShift(seed)
+                draw = rng.next_gaussian if name == "randn" \
+                    else rng.next_double
+                return [draw() for _ in range(self.cap)]
+            import random as _random
+            return [(_random.gauss(0.0, 1.0) if name == "randn"
+                     else _random.random()) for _ in range(self.cap)]
+        if name in ("hash", "xxhash64"):
+            from ..functions.host_misc import spark_hash
+            types = [rx.rex_type(a) for a in e.args]
+            cols = [self.values(a) for a in e.args]
+            variant = "mm3" if name == "hash" else "xxh64"
+            return [spark_hash([c[i] for c in cols], types, variant)
+                    for i in range(self.cap)]
         # arguments: lambdas become closures (per-row when the body
         # references outer columns)
         argv = []
@@ -545,7 +575,13 @@ def _physical(v, t: dt.DataType):
                    .to_integral_value(rounding=decimal.ROUND_HALF_UP))
     if isinstance(t, dt.DayTimeIntervalType):
         if isinstance(v, datetime.timedelta):
-            return int(v.total_seconds() * 1e6)
+            return round(v.total_seconds() * 1e6)
+        return int(v)
+    if isinstance(t, dt.TimeType):
+        if isinstance(v, datetime.time):
+            return dt.time_to_micros(v)
+        return int(v)
+    if isinstance(t, dt.YearMonthIntervalType):
         return int(v)
     if isinstance(t, dt.BooleanType):
         return bool(v)
@@ -555,6 +591,8 @@ def _physical(v, t: dt.DataType):
 def _pyarrowable(v, t: dt.DataType):
     if v is None:
         return None
+    if isinstance(t, dt.YearMonthIntervalType) and isinstance(v, int):
+        return (v, 0, 0)
     if isinstance(t, dt.MapType) and isinstance(v, dict):
         return list(v.items())
     if isinstance(t, dt.ArrayType) and isinstance(v, (list, tuple)):

@@ -143,6 +143,44 @@ def _dict_order_ranks(dictionary: pa.Array) -> np.ndarray:
         return ranks
 
 
+def _norm_intervals(vals):
+    """Host aggregates see intervals as plain numbers: YM → int months,
+    DT → int microseconds (recursing into struct-packed arg rows)."""
+    import datetime as _dtm
+
+    def norm(v):
+        if v is None:
+            return None
+        if type(v).__name__ == "MonthDayNano":
+            return int(v[0])
+        if isinstance(v, _dtm.timedelta):
+            return round(v.total_seconds() * 1e6)
+        if isinstance(v, dict):
+            return {k: norm(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [norm(x) for x in v]
+        return v
+
+    return [norm(v) for v in vals]
+
+
+def _intervalize(v, d):
+    """Numbers back to interval values per the declared output type."""
+    import datetime as _dtm
+
+    if v is None:
+        return None
+    if isinstance(d, dt.YearMonthIntervalType):
+        return (int(round(float(v))), 0, 0)
+    if isinstance(d, dt.DayTimeIntervalType):
+        if isinstance(v, _dtm.timedelta):
+            return v
+        return _dtm.timedelta(microseconds=round(float(v)))
+    if isinstance(d, dt.ArrayType) and isinstance(v, list):
+        return [_intervalize(x, d.element_type) for x in v]
+    return v
+
+
 def _host_agg_one(spec, cols, rows_idx, host_aggs):
     """One aggregate over one group's row indices (host path)."""
     fn = spec.fn
@@ -174,7 +212,11 @@ def _host_agg_one(spec, cols, rows_idx, host_aggs):
             elif name in ("listagg", "string_agg", "percentile",
                           "percentile_approx", "approx_percentile",
                           "percentile_cont", "percentile_disc",
-                          "histogram_numeric"):
+                          "histogram_numeric", "__listagg_ordered",
+                          "__mode_ordered", "mode", "approx_top_k",
+                          "kll_sketch_agg_bigint", "kll_sketch_agg_double",
+                          "kll_sketch_agg_float", "hll_sketch_agg",
+                          "theta_sketch_agg", "count_min_sketch"):
                 rows = [t for t in tuples
                         if t is not None and t[0] is not None]
             else:
@@ -467,6 +509,11 @@ class LocalExecutor:
         for j, f in enumerate(p.out_schema):
             vals = [row[j] for row in p.rows]
             at = ai.spec_type_to_arrow(f.dtype)
+            if isinstance(f.dtype, dt.YearMonthIntervalType):
+                arrays.append(pa.array(
+                    [None if v.value is None else (int(v.value), 0, 0)
+                     for v in vals], type=at))
+                continue
             arrays.append(pa.array([v.value for v in vals], type=at))
         table = pa.table(dict(zip([_col_name(j) for j in range(len(arrays))], arrays)))
         return ai.from_arrow(table)
@@ -1095,7 +1142,7 @@ class LocalExecutor:
         from ..functions.host_aggregates import HOST_AGGS
 
         table = ai.to_arrow(child)
-        cols = {i: table.column(i).to_pylist()
+        cols = {i: _norm_intervals(table.column(i).to_pylist())
                 for i in range(table.num_columns)}
         n = table.num_rows
         if p.group_indices:
@@ -1120,10 +1167,14 @@ class LocalExecutor:
         in_schema = p.input.schema
         for ki, g in enumerate(p.group_indices):
             at = ai.spec_type_to_arrow(in_schema[g].dtype)
-            arrays.append(pa.array(key_out[ki], type=at))
+            vals_k = [_intervalize(v, in_schema[g].dtype)
+                      for v in key_out[ki]]
+            arrays.append(pa.array(vals_k, type=at))
             names.append(p.out_names[ki])
         for ai_, spec in enumerate(p.aggs):
             at = ai.spec_type_to_arrow(spec.out_dtype)
+            agg_out[ai_] = [_intervalize(v, spec.out_dtype)
+                            for v in agg_out[ai_]]
             try:
                 arrays.append(pa.array(agg_out[ai_], type=at))
             except (pa.ArrowInvalid, pa.ArrowTypeError):

@@ -193,12 +193,16 @@ class KubernetesWorkerManager(WorkerManager):
                     "image": self.image,
                     "args": ["worker", "--driver", self.driver_addr,
                              "--host", "0.0.0.0",
+                             "--advertise-host", "$(SAIL_POD_IP)",
                              "--task-slots", str(self.task_slots),
                              "--worker-id", worker_id],
                     "env": [
                         {"name": "SAIL_WORKER_ID", "value": worker_id},
                         {"name": "SAIL_DRIVER_ADDR",
                          "value": self.driver_addr},
+                        # downward API: the address peers dial
+                        {"name": "SAIL_POD_IP", "valueFrom": {
+                            "fieldRef": {"fieldPath": "status.podIP"}}},
                     ],
                 }],
             },

@@ -1,0 +1,237 @@
+"""Pure-python AES (ECB/CBC/GCM) for the aes_encrypt/aes_decrypt SQL
+functions.
+
+Reference role: crates/sail-function/src/scalar/misc.rs aes_* (which uses
+a Rust crypto crate); this image has no crypto library, so the cipher is
+implemented from the FIPS-197 spec. Layouts match Spark:
+
+- ECB: raw ciphertext, PKCS#5 padding
+- CBC: random 16-byte IV || ciphertext (PKCS#5)
+- GCM (default): random 12-byte IV || ciphertext || 16-byte tag
+"""
+
+from __future__ import annotations
+
+import os
+
+_SBOX = bytes.fromhex(
+    "637c777bf26b6fc53001672bfed7ab76ca82c97dfa5947f0add4a2af9ca472c0"
+    "b7fd9326363ff7cc34a5e5f171d8311504c723c31896059a071280e2eb27b275"
+    "09832c1a1b6e5aa0523bd6b329e32f8453d100ed20fcb15b6acbbe394a4c58cf"
+    "d0efaafb434d338545f9027f503c9fa851a3408f929d38f5bcb6da2110fff3d2"
+    "cd0c13ec5f974417c4a77e3d645d197360814fdc222a908846eeb814de5e0bdb"
+    "e0323a0a4906245cc2d3ac629195e479e7c8376d8dd54ea96c56f4ea657aae08"
+    "ba78252e1ca6b4c6e8dd741f4bbd8b8a703eb5664803f60e613557b986c11d9e"
+    "e1f8981169d98e949b1e87e9ce5528df8ca1890dbfe6426841992d0fb054bb16")
+_INV_SBOX = bytearray(256)
+for _i, _v in enumerate(_SBOX):
+    _INV_SBOX[_v] = _i
+_INV_SBOX = bytes(_INV_SBOX)
+
+_RCON = (0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36,
+         0x6C, 0xD8, 0xAB, 0x4D)
+
+
+def _xtime(a: int) -> int:
+    a <<= 1
+    if a & 0x100:
+        a ^= 0x11B
+    return a & 0xFF
+
+
+_MUL = [[0] * 256 for _ in range(256)]
+for _a in (2, 3, 9, 11, 13, 14):
+    for _b in range(256):
+        r, x, a = 0, _b, _a
+        while a:
+            if a & 1:
+                r ^= x
+            x = _xtime(x)
+            a >>= 1
+        _MUL[_a][_b] = r
+
+
+def _expand_key(key: bytes):
+    nk = len(key) // 4
+    nr = nk + 6
+    w = [list(key[4 * i: 4 * i + 4]) for i in range(nk)]
+    for i in range(nk, 4 * (nr + 1)):
+        t = list(w[i - 1])
+        if i % nk == 0:
+            t = t[1:] + t[:1]
+            t = [_SBOX[b] for b in t]
+            t[0] ^= _RCON[i // nk - 1]
+        elif nk > 6 and i % nk == 4:
+            t = [_SBOX[b] for b in t]
+        w.append([a ^ b for a, b in zip(w[i - nk], t)])
+    rounds = []
+    for r in range(nr + 1):
+        rk = []
+        for c in range(4):
+            rk.extend(w[4 * r + c])
+        rounds.append(bytes(rk))
+    return rounds, nr
+
+
+def _encrypt_block(block: bytes, rounds, nr: int) -> bytes:
+    s = bytearray(a ^ b for a, b in zip(block, rounds[0]))
+    for rnd in range(1, nr):
+        s = bytearray(_SBOX[b] for b in s)
+        s = bytearray(s[(i + 4 * (i % 4)) % 16] for i in range(16))  # shift rows
+        ns = bytearray(16)
+        for c in range(4):
+            col = s[4 * c: 4 * c + 4]
+            ns[4 * c + 0] = _MUL[2][col[0]] ^ _MUL[3][col[1]] ^ col[2] ^ col[3]
+            ns[4 * c + 1] = col[0] ^ _MUL[2][col[1]] ^ _MUL[3][col[2]] ^ col[3]
+            ns[4 * c + 2] = col[0] ^ col[1] ^ _MUL[2][col[2]] ^ _MUL[3][col[3]]
+            ns[4 * c + 3] = _MUL[3][col[0]] ^ col[1] ^ col[2] ^ _MUL[2][col[3]]
+        s = bytearray(a ^ b for a, b in zip(ns, rounds[rnd]))
+    s = bytearray(_SBOX[b] for b in s)
+    s = bytearray(s[(i + 4 * (i % 4)) % 16] for i in range(16))
+    return bytes(a ^ b for a, b in zip(s, rounds[nr]))
+
+
+def _decrypt_block(block: bytes, rounds, nr: int) -> bytes:
+    s = bytearray(a ^ b for a, b in zip(block, rounds[nr]))
+    for rnd in range(nr - 1, 0, -1):
+        s = bytearray(s[(i - 4 * (i % 4)) % 16] for i in range(16))  # inv shift
+        s = bytearray(_INV_SBOX[b] for b in s)
+        s = bytearray(a ^ b for a, b in zip(s, rounds[rnd]))
+        ns = bytearray(16)
+        for c in range(4):
+            col = s[4 * c: 4 * c + 4]
+            ns[4 * c + 0] = (_MUL[14][col[0]] ^ _MUL[11][col[1]]
+                             ^ _MUL[13][col[2]] ^ _MUL[9][col[3]])
+            ns[4 * c + 1] = (_MUL[9][col[0]] ^ _MUL[14][col[1]]
+                             ^ _MUL[11][col[2]] ^ _MUL[13][col[3]])
+            ns[4 * c + 2] = (_MUL[13][col[0]] ^ _MUL[9][col[1]]
+                             ^ _MUL[14][col[2]] ^ _MUL[11][col[3]])
+            ns[4 * c + 3] = (_MUL[11][col[0]] ^ _MUL[13][col[1]]
+                             ^ _MUL[9][col[2]] ^ _MUL[14][col[3]])
+        s = ns
+    s = bytearray(s[(i - 4 * (i % 4)) % 16] for i in range(16))
+    s = bytearray(_INV_SBOX[b] for b in s)
+    return bytes(a ^ b for a, b in zip(s, rounds[0]))
+
+
+def _pkcs_pad(data: bytes) -> bytes:
+    p = 16 - len(data) % 16
+    return data + bytes([p]) * p
+
+
+def _pkcs_unpad(data: bytes) -> bytes:
+    if not data or data[-1] < 1 or data[-1] > 16:
+        raise ValueError("bad PKCS padding")
+    return data[: -data[-1]]
+
+
+def _ctr_blocks(rounds, nr, j0: bytes, n_blocks: int):
+    ctr = int.from_bytes(j0, "big")
+    hi = ctr - (ctr & 0xFFFFFFFF)
+    out = []
+    for i in range(n_blocks):
+        c = hi + ((ctr + 1 + i) & 0xFFFFFFFF)
+        out.append(_encrypt_block(c.to_bytes(16, "big"), rounds, nr))
+    return out
+
+
+def _ghash_mult(x: int, h: int) -> int:
+    z = 0
+    v = h
+    for i in range(127, -1, -1):
+        if (x >> i) & 1:
+            z ^= v
+        if v & 1:
+            v = (v >> 1) ^ (0xE1 << 120)
+        else:
+            v >>= 1
+    return z
+
+
+def _ghash(h: bytes, aad: bytes, ct: bytes) -> bytes:
+    hi = int.from_bytes(h, "big")
+
+    def blocks(data):
+        for i in range(0, len(data), 16):
+            yield data[i: i + 16].ljust(16, b"\0")
+
+    y = 0
+    for b in blocks(aad):
+        y = _ghash_mult(y ^ int.from_bytes(b, "big"), hi)
+    for b in blocks(ct):
+        y = _ghash_mult(y ^ int.from_bytes(b, "big"), hi)
+    lens = (len(aad) * 8).to_bytes(8, "big") + (len(ct) * 8).to_bytes(8, "big")
+    y = _ghash_mult(y ^ int.from_bytes(lens, "big"), hi)
+    return y.to_bytes(16, "big")
+
+
+def _gcm(key: bytes, iv: bytes, data: bytes, aad: bytes, encrypt: bool):
+    rounds, nr = _expand_key(key)
+    h = _encrypt_block(b"\0" * 16, rounds, nr)
+    if len(iv) == 12:
+        j0 = iv + b"\0\0\0\1"
+    else:
+        j0 = _ghash(h, b"", iv)
+    ks = _ctr_blocks(rounds, nr, j0, (len(data) + 15) // 16)
+    out = bytearray()
+    for i, b in enumerate(range(0, len(data), 16)):
+        chunk = data[b: b + 16]
+        out.extend(a ^ k for a, k in zip(chunk, ks[i]))
+    out = bytes(out)
+    ct = out if encrypt else data
+    tag_mask = _encrypt_block(j0, rounds, nr)
+    tag = bytes(a ^ b for a, b in zip(_ghash(h, aad, ct), tag_mask))
+    return out, tag
+
+
+def aes_encrypt(data: bytes, key: bytes, mode: str = "GCM",
+                padding: str = "DEFAULT", iv: bytes = b"",
+                aad: bytes = b"") -> bytes:
+    mode = (mode or "GCM").upper()
+    rounds, nr = _expand_key(key)
+    if mode == "ECB":
+        data = _pkcs_pad(data)
+        return b"".join(_encrypt_block(data[i: i + 16], rounds, nr)
+                        for i in range(0, len(data), 16))
+    if mode == "CBC":
+        iv = iv or os.urandom(16)
+        data = _pkcs_pad(data)
+        prev = iv
+        out = bytearray()
+        for i in range(0, len(data), 16):
+            blk = bytes(a ^ b for a, b in zip(data[i: i + 16], prev))
+            prev = _encrypt_block(blk, rounds, nr)
+            out.extend(prev)
+        return iv + bytes(out)
+    if mode == "GCM":
+        iv = iv or os.urandom(12)
+        ct, tag = _gcm(key, iv, data, aad, True)
+        return iv + ct + tag
+    raise ValueError(f"unsupported AES mode {mode!r}")
+
+
+def aes_decrypt(data: bytes, key: bytes, mode: str = "GCM",
+                padding: str = "DEFAULT", aad: bytes = b"") -> bytes:
+    mode = (mode or "GCM").upper()
+    rounds, nr = _expand_key(key)
+    if mode == "ECB":
+        pt = b"".join(_decrypt_block(data[i: i + 16], rounds, nr)
+                      for i in range(0, len(data), 16))
+        return _pkcs_unpad(pt)
+    if mode == "CBC":
+        iv, ct = data[:16], data[16:]
+        prev = iv
+        out = bytearray()
+        for i in range(0, len(ct), 16):
+            blk = ct[i: i + 16]
+            out.extend(a ^ b for a, b in
+                       zip(_decrypt_block(blk, rounds, nr), prev))
+            prev = blk
+        return _pkcs_unpad(bytes(out))
+    if mode == "GCM":
+        iv, ct, tag = data[:12], data[12:-16], data[-16:]
+        pt, expect = _gcm(key, iv, ct, aad, False)
+        if expect != tag:
+            raise ValueError("AES-GCM tag mismatch")
+        return pt
+    raise ValueError(f"unsupported AES mode {mode!r}")

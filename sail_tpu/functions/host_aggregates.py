@@ -111,6 +111,8 @@ def _median(vals):
 
 def _mode(vals):
     from collections import Counter
+    vals = [v[0] if isinstance(v, tuple) else v for v in vals]
+    vals = [v for v in vals if v is not None]
     if not vals:
         return None
     counts = Counter(vals)
@@ -159,20 +161,59 @@ _reg("covar_samp", _t(_D), lambda r: _covar(r, False), nargs=2)
 _reg("covar_pop", _t(_D), lambda r: _covar(r, True), nargs=2)
 _reg("skewness", _t(_D), lambda v: _skew_kurt(v, False))
 _reg("kurtosis", _t(_D), lambda v: _skew_kurt(v, True))
-_reg("median", _t(_D), _median)
-_reg(["percentile", "percentile_approx", "approx_percentile",
-      "percentile_cont"],
-     lambda ts: dt.ArrayType(_D) if isinstance(ts[1], dt.ArrayType) else _D,
+_INTERVALS = (dt.YearMonthIntervalType, dt.DayTimeIntervalType)
+
+
+def _ptile_type(ts, exact_type=False):
+    """percentile result type: double for numerics, the input type for
+    intervals (and for the approx family, which returns observed values)."""
+    base = ts[0] if (isinstance(ts[0], _INTERVALS) or exact_type) else _D
+    if len(ts) > 1 and isinstance(ts[1], dt.ArrayType):
+        return dt.ArrayType(base)
+    return base
+
+
+def _rank_percentile(vals, p):
+    """approx_percentile: an observed value at the rank, no interpolation."""
+    xs = sorted(vals)
+    if not xs:
+        return None
+    if isinstance(p, (list, tuple)):
+        return [_rank_percentile(vals, q) for q in p]
+    return xs[int(math.floor(float(p) * (len(xs) - 1)))]
+
+
+_reg("median", lambda ts: ts[0] if isinstance(ts[0], _INTERVALS) else _D,
+     _median)
+_reg(["percentile", "percentile_cont"],
+     lambda ts: _ptile_type(ts),
      lambda rows: _percentile([r[0] for r in rows],
                               rows[0][1] if rows else 0.5),
      nargs=-1)
-_reg("percentile_disc", _t(_D),
-     lambda rows: (lambda xs, p: None if not xs else xs[
-         min(int(math.ceil(float(p) * len(xs))) - 1 if p else 0,
-             len(xs) - 1) if p else xs[0]])(
-         sorted(float(r[0]) for r in rows), rows[0][1] if rows else 0.5),
+_reg(["percentile_approx", "approx_percentile"],
+     lambda ts: _ptile_type(ts, exact_type=True),
+     lambda rows: _rank_percentile([r[0] for r in rows],
+                                   rows[0][1] if rows else 0.5),
      nargs=-1)
-_reg("mode", lambda ts: ts[0], _mode)
+def _percentile_disc(rows):
+    """Discrete percentile: first value whose cume_dist >= p in the
+    requested order (the 1-p trick is NOT valid for the discrete form)."""
+    if not rows:
+        return None
+    p = float(rows[0][1]) if rows[0][1] is not None else 0.5
+    desc = bool(rows[0][2]) if len(rows[0]) > 2 else False
+    xs = sorted(float(r[0]) for r in rows)
+    n = len(xs)
+    if desc:
+        i = max(0, n - max(1, int(math.ceil(p * n))))
+    else:
+        i = min(max(1, int(math.ceil(p * n))) - 1, n - 1)
+    return xs[i]
+
+
+_reg("percentile_disc", lambda ts: _ptile_type(ts), _percentile_disc,
+     nargs=-1)
+_reg("mode", lambda ts: ts[0], _mode, nargs=-1)
 _reg("max_by", lambda ts: ts[0],
      lambda rows: max(rows, key=lambda r: r[1])[0] if rows else None,
      nargs=2)
@@ -209,7 +250,39 @@ _reg("histogram_numeric", lambda ts: dt.ArrayType(dt.StructType((
                             rows[0][1] if rows else 5), nargs=-1)
 _reg("any_value", lambda ts: ts[0],
      lambda vals: vals[0] if vals else None)
-_reg("count_min_sketch", _t(dt.BinaryType()), lambda rows: None, nargs=-1)
+_reg("__mode_ordered", lambda ts: ts[0], lambda rows: _mode_ordered(rows),
+     nargs=-1)
+_reg("__listagg_ordered", _t(_S), lambda rows: _listagg_ordered(rows),
+     nargs=-1)
+
+
+def _mode_ordered(rows):
+    """mode() WITHIN GROUP (ORDER BY col [DESC]): rows = [(val, desc)]."""
+    from collections import Counter
+    vals = [r[0] for r in rows if r[0] is not None]
+    if not vals:
+        return None
+    desc = bool(rows[0][1])
+    counts = Counter(vals)
+    best = max(counts.values())
+    tied = [v for v, c in counts.items() if c == best]
+    return max(tied) if desc else min(tied)
+
+
+def _listagg_ordered(rows):
+    """listagg(col[, delim]) WITHIN GROUP (ORDER BY o [DESC]):
+    rows = [(val, delim, order_key, desc)]."""
+    keep = [r for r in rows if r[0] is not None]
+    if not keep:
+        return None
+    desc = bool(keep[0][3])
+    # Spark null ordering: nulls first ascending, last descending
+    keep.sort(key=lambda r: (r[2] is not None,
+                             r[2] if r[2] is not None else 0),
+              reverse=desc)
+    delim = keep[0][1] or ""
+    return delim.join(_to_str(r[0]) for r in keep)
+# count_min_sketch lives in sketches.py (Spark-exact serialization)
 
 
 def _stable_dedup(vals):

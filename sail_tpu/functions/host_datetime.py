@@ -101,7 +101,10 @@ def _java_fmt(ts: datetime.datetime, pattern: str) -> str:
                 i += len(jp)
                 break
         else:
-            if p[i] == "M":
+            if p[i] == "y":
+                out.append(str(ts.year))
+                i += 1
+            elif p[i] == "M":
                 out.append(str(ts.month))
                 i += 1
             elif p[i] == "d":
@@ -140,19 +143,79 @@ def _java_fmt(ts: datetime.datetime, pattern: str) -> str:
     return "".join(out)
 
 
-def _java_parse(s: str, pattern: str):
-    """Parse with a Java pattern via strftime translation (common cases)."""
+def java_to_strftime(pattern: str) -> str:
+    """Java SimpleDateFormat pattern → strftime (scanner, not replace: a
+    naive chain of str.replace corrupts already-emitted %-directives)."""
+    out = []
+    i = 0
     p = pattern
-    for jp, sp in _J2P:
-        p = p.replace(jp, sp)
-    p = p.replace("M", "%m").replace("d", "%d").replace("H", "%H") \
-        .replace("h", "%I").replace("m", "%M").replace("s", "%S")
-    # collapse accidental doubles from single-letter passes
-    p = p.replace("%%", "%")
+    n = len(p)
+    while i < n:
+        c = p[i]
+        if c == "'":
+            j = p.find("'", i + 1)
+            if j == -1:
+                out.append(p[i + 1:])
+                break
+            out.append(p[i + 1: j].replace("%", "%%"))
+            i = j + 1
+            continue
+        if c.isalpha():
+            j = i
+            while j < n and p[j] == c:
+                j += 1
+            run = j - i
+            if c == "y":
+                out.append("%Y" if run != 2 else "%y")
+            elif c == "M":
+                out.append("%B" if run >= 4 else ("%b" if run == 3 else "%m"))
+            elif c == "d":
+                out.append("%d")
+            elif c == "H" or c == "k":
+                out.append("%H")
+            elif c == "h" or c == "K":
+                out.append("%I")
+            elif c == "m":
+                out.append("%M")
+            elif c == "s":
+                out.append("%S")
+            elif c == "S":
+                out.append("%f")
+            elif c == "a":
+                out.append("%p")
+            elif c == "E":
+                out.append("%A" if run >= 4 else "%a")
+            elif c == "D":
+                out.append("%j")
+            elif c in ("z", "Z", "X", "x", "V", "O"):
+                out.append("%z")
+            elif c == "G":
+                out.append("")
+            else:
+                out.append(c * run)
+            i = j
+            continue
+        out.append("%%" if c == "%" else c)
+        i += 1
+    return "".join(out)
+
+
+def _java_parse(s: str, pattern: str):
+    """Parse with a Java pattern; naive results take the session zone."""
+    p = java_to_strftime(pattern)
+    s = s.strip()
+    # %f needs exactly the digits present; strptime handles 1-6 digits
     try:
-        return datetime.datetime.strptime(s.strip(), p).replace(tzinfo=_UTC)
+        t = datetime.datetime.strptime(s, p)
     except ValueError:
-        return None
+        # lenient second fractions: try without them
+        try:
+            t = datetime.datetime.strptime(s, p.replace(".%f", ""))
+        except ValueError:
+            return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_session_zone())
+    return t.astimezone(_UTC)
 
 
 def _add_months(v, n):
@@ -234,9 +297,19 @@ def _date_trunc(unit, v):
 
 
 def _make_ts(*args, tz=None, ntz=False):
-    if len(args) == 1 and isinstance(args[0], datetime.date):
+    if args and isinstance(args[0], datetime.date) and \
+            not isinstance(args[0], datetime.datetime):
         d0 = args[0]
-        args = (d0.year, d0.month, d0.day, 0, 0, 0)
+        if len(args) >= 2 and isinstance(args[1], datetime.time):
+            t0 = args[1]
+            if len(args) >= 3 and isinstance(args[2], str):
+                tz = args[2]
+            args = (d0.year, d0.month, d0.day, t0.hour, t0.minute,
+                    t0.second + t0.microsecond / 1e6)
+        else:
+            if len(args) >= 2 and isinstance(args[1], str):
+                tz = args[1]
+            args = (d0.year, d0.month, d0.day, 0, 0, 0)
     if len(args) < 6:
         return None
     y, mo, d, h, mi, s = args[:6]
@@ -380,6 +453,156 @@ def _make_dt_interval(days=0, hours=0, mins=0, secs=0):
                               minutes=int(mins), seconds=float(secs))
 
 
+_TIME = dt.TimeType()
+
+
+def _parse_time(s, fmt=None):
+    s = str(s).strip()
+    if fmt:
+        p = java_to_strftime(fmt)
+        try:
+            t = datetime.datetime.strptime(s, p)
+        except ValueError:
+            return None
+        return t.time()
+    try:
+        parts = s.split(":")
+        if len(parts) < 2:
+            return None
+        h, m = int(parts[0]), int(parts[1])
+        sec = float(parts[2]) if len(parts) > 2 else 0.0
+        us = int(round((sec % 60) * 1e6))
+        return datetime.time(h, m, us // 1_000_000, us % 1_000_000)
+    except (ValueError, IndexError):
+        return None
+
+
+def _time_of(v):
+    if isinstance(v, datetime.time):
+        return v
+    if isinstance(v, datetime.datetime):
+        return v.time()
+    return _parse_time(v)
+
+
+def _to_time(s, *fmt):
+    out = _parse_time(s, fmt[0] if fmt else None)
+    if out is None:
+        raise ValueError(f"cannot parse time {s!r}")
+    return out
+
+
+def _time_us(t: datetime.time) -> int:
+    return dt.time_to_micros(t)
+
+
+def _time_trunc(unit, v):
+    t = _time_of(v)
+    if t is None or unit is None:
+        return None
+    us = _time_us(t)
+    size = {"hour": 3_600_000_000, "minute": 60_000_000,
+            "second": 1_000_000, "millisecond": 1_000,
+            "microsecond": 1}.get(unit.lower())
+    if size is None:
+        return None
+    us = us // size * size
+    return datetime.time(us // 3_600_000_000 % 24,
+                         us // 60_000_000 % 60,
+                         us // 1_000_000 % 60, us % 1_000_000)
+
+
+def _time_diff(unit, a, b):
+    ta, tb = _time_of(a), _time_of(b)
+    if None in (ta, tb) or unit is None:
+        return None
+    delta = _time_us(tb) - _time_us(ta)
+    size = {"hour": 3_600_000_000, "minute": 60_000_000,
+            "second": 1_000_000, "millisecond": 1_000,
+            "microsecond": 1}.get(unit.lower())
+    if size is None:
+        return None
+    return int(delta / size)  # truncation toward zero
+
+
+def _make_time(h, m, s):
+    try:
+        us = int(round(float(s) * 1e6))
+        return datetime.time(int(h), int(m), us // 1_000_000 % 60,
+                             us % 1_000_000)
+    except (ValueError, OverflowError):
+        return None
+
+
+def _current_time(*precision):
+    now = datetime.datetime.now(_session_zone()).time()
+    if precision:
+        p = max(0, min(6, int(precision[0])))
+        keep = 10 ** (6 - p)
+        now = now.replace(microsecond=now.microsecond // keep * keep)
+    return now
+
+
+_reg(["to_time"], _t(_TIME), _to_time)
+_reg(["try_to_time"], _t(_TIME),
+     lambda s, *f: _parse_time(s, f[0] if f else None))
+_reg(["make_time"], _t(_TIME), _make_time)
+_reg(["time_trunc"], _t(_TIME), _time_trunc)
+_reg(["time_diff"], _t(_L), _time_diff)
+_reg(["current_time"], _t(_TIME), _current_time, null_tolerant=True)
+
+
+def _fmt_calendar_interval(months: int, days: int, us: int) -> str:
+    parts = []
+    y, mo = divmod(abs(months), 12)
+    if months < 0:
+        y, mo = -y, -mo
+    if y:
+        parts.append(f"{y} years")
+    if mo:
+        parts.append(f"{mo} months")
+    if days:
+        parts.append(f"{days} days")
+    au = abs(us)
+    sign = "-" if us < 0 else ""
+    h, rem = divmod(au, 3_600_000_000)
+    mi, rem = divmod(rem, 60_000_000)
+    sec, frac = divmod(rem, 1_000_000)
+    if h:
+        parts.append(f"{sign}{h} hours")
+    if mi:
+        parts.append(f"{sign}{mi} minutes")
+    if sec or frac or not parts:
+        if frac:
+            s = f"{sec}.{frac:06d}".rstrip("0")
+        else:
+            s = str(sec)
+        parts.append(f"{sign}{s} seconds")
+    return " ".join(parts)
+
+
+def _make_interval(*a, try_=False):
+    vals = list(a) + [0] * (7 - len(a))
+    if any(v is None for v in a):
+        return None
+    years, months, weeks, days, hours, mins, secs = vals[:7]
+    total_months = int(years) * 12 + int(months)
+    if not (-(2**31) <= total_months < 2**31):
+        if try_:
+            return None
+        raise OverflowError("interval months overflow")
+    total_days = int(weeks) * 7 + int(days)
+    us = int(round((int(hours) * 3600 + int(mins) * 60 + float(secs))
+                   * 1e6))
+    return _fmt_calendar_interval(total_months, total_days, us)
+
+
+_reg(["make_interval"], _t(_S), lambda *a: _make_interval(*a),
+     null_tolerant=True)
+_reg(["try_make_interval"], _t(_S),
+     lambda *a: _make_interval(*a, try_=True), null_tolerant=True)
+
+
 def _extract_part(v, part):
     import decimal
     if isinstance(v, datetime.timedelta):
@@ -452,10 +675,29 @@ def _date_part_type(_part):
 
 
 def _date_part(part, v):
-    t = _to_ts(v)
-    if t is None or part is None:
+    if part is None:
         return None
-    p = part.lower()
+    raw = part.lower()
+    # alias map FIRST (rstrip('s') would reduce 's'/'ss' to '')
+    alias = {"min": "minute", "mins": "minute", "hrs": "hour", "hr": "hour",
+             "mons": "month", "mon": "month", "yrs": "year", "yr": "year",
+             "d": "day", "h": "hour", "m": "minute", "s": "second",
+             "sec": "second", "secs": "second", "seconds": "seconds"}
+    p = alias.get(raw, raw.rstrip("s") if raw != "s" else "second")
+    if isinstance(v, datetime.timedelta):
+        return _extract_part(v, {"day": "days", "hour": "hours",
+                                 "minute": "minutes",
+                                 "second": "seconds",
+                                 "seconds": "seconds"}.get(p, p))
+    if isinstance(v, int):  # year-month interval (months)
+        return _extract_part(v, {"year": "years",
+                                 "month": "months"}.get(p, p))
+    t = _to_ts(v)
+    if t is None:
+        return None
+    if p == "seconds":
+        import decimal as _dec
+        return _dec.Decimal(t.second * 1_000_000 + t.microsecond).scaleb(-6)
     table = {
         "year": t.year, "yearofweek": t.isocalendar()[0], "quarter":
         (t.month - 1) // 3 + 1, "month": t.month, "week": t.isocalendar()[1],

@@ -1,0 +1,763 @@
+"""Misc host functions: conditionals, reflection, crypto, variant, XML,
+CSV, Avro, geo (ST) and Spark-compatible hashes.
+
+Reference role: crates/sail-function/src/scalar/{misc.rs, variant/, xml/,
+csv/, geo/, hash/}. Variant values are represented as canonical compact
+JSON text (the reference carries the Spark binary variant encoding; the
+display format is identical). Geometries are WKB + SRID carried as a
+tagged JSON string.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import json
+import math
+import re
+import struct
+import uuid as _uuid
+import xml.etree.ElementTree as ET
+from decimal import Decimal
+
+from ..spec import data_type as dt
+from . import aes as _aes
+from .host_aggregates import _reg as _reg_agg
+from .host_functions import _reg, _t, _t0, NULL_TOLERANT
+
+_S = dt.StringType()
+_I = dt.IntegerType()
+_L = dt.LongType()
+_D = dt.DoubleType()
+_B = dt.BooleanType()
+_BIN = dt.BinaryType()
+
+
+# ---------------------------------------------------------------------------
+# conditionals & tiny misc
+# ---------------------------------------------------------------------------
+
+_reg("nullifzero", _t0, lambda v: None if v == 0 else v)
+_reg("zeroifnull", _t0, lambda v: 0 if v is None else v, null_tolerant=True)
+_reg("collate", _t0, lambda v, name: v)
+_reg("collation", _t(_S), lambda v: "SYSTEM.BUILTIN.UTF8_BINARY")
+_reg("assert_true", _t(dt.NullType()),
+     lambda cond, *msg: None if cond else _raise(
+         msg[0] if msg else "'false' is not true!"))
+_reg("raise_error", _t(dt.NullType()), lambda msg, *a: _raise(msg))
+_reg("input_file_name", _t(_S), lambda: "", null_tolerant=True)
+_reg("input_file_block_start", _t(_L), lambda: -1, null_tolerant=True)
+_reg("input_file_block_length", _t(_L), lambda: -1, null_tolerant=True)
+
+
+def _raise(msg):
+    raise ValueError(str(msg))
+
+
+# ---------------------------------------------------------------------------
+# JVM reflection emulation (the handful of java.* methods Spark users call)
+# ---------------------------------------------------------------------------
+
+def _reflect(cls, method, *args):
+    if cls == "java.util.UUID":
+        if method == "fromString":
+            return str(_uuid.UUID(args[0]))
+        if method == "randomUUID":
+            return str(_uuid.uuid4())
+    if cls == "java.net.URLDecoder" and method == "decode":
+        import urllib.parse
+        s = args[0]
+        if re.search(r"%(?![0-9A-Fa-f]{2})", s):
+            raise ValueError(f"URLDecoder: Incomplete trailing escape "
+                             f"(%) pattern in {s!r}")
+        return urllib.parse.unquote_plus(s)
+    if cls == "java.lang.Math":
+        fn = getattr(math, method.lower(), None)
+        if fn is not None:
+            return str(fn(*[float(a) for a in args]))
+    if cls == "java.lang.String" and method == "valueOf":
+        return str(args[0])
+    raise ValueError(f"reflect: unsupported method {cls}.{method}")
+
+
+def _try_reflect(cls, method, *args):
+    try:
+        return _reflect(cls, method, *args)
+    except Exception:  # noqa: BLE001 — try_ semantics
+        return None
+
+
+_reg(["reflect", "java_method"], _t(_S), _reflect)
+_reg("try_reflect", _t(_S), _try_reflect)
+
+
+# ---------------------------------------------------------------------------
+# math tail
+# ---------------------------------------------------------------------------
+
+def _dom(fn, v):
+    try:
+        return fn(v)
+    except ValueError:
+        return float("nan")
+
+
+_reg("e", _t(_D), lambda: math.e, null_tolerant=True)
+_reg("pi", _t(_D), lambda: math.pi, null_tolerant=True)
+_reg("positive", _t0, lambda v: v)
+_reg("cot", _t(_D), lambda v: 1.0 / math.tan(float(v)))
+_reg("csc", _t(_D), lambda v: 1.0 / math.sin(float(v)))
+_reg("sec", _t(_D), lambda v: 1.0 / math.cos(float(v)))
+_reg("acosh", _t(_D), lambda v: _dom(math.acosh, float(v)))
+_reg("asinh", _t(_D), lambda v: math.asinh(float(v)))
+_reg("atanh", _t(_D), lambda v: _dom(math.atanh, float(v))
+     if abs(float(v)) != 1 else math.copysign(float("inf"), float(v)))
+
+
+# ---------------------------------------------------------------------------
+# AES
+# ---------------------------------------------------------------------------
+
+def _to_bytes(v):
+    return v if isinstance(v, bytes) else str(v).encode()
+
+
+def _aes_encrypt(data, key, *rest):
+    mode = rest[0] if len(rest) > 0 and rest[0] else "GCM"
+    pad = rest[1] if len(rest) > 1 and rest[1] else "DEFAULT"
+    iv = _to_bytes(rest[2]) if len(rest) > 2 and rest[2] else b""
+    aad = _to_bytes(rest[3]) if len(rest) > 3 and rest[3] else b""
+    return _aes.aes_encrypt(_to_bytes(data), _to_bytes(key), mode, pad,
+                            iv, aad)
+
+
+def _aes_decrypt(data, key, *rest):
+    mode = rest[0] if len(rest) > 0 and rest[0] else "GCM"
+    pad = rest[1] if len(rest) > 1 and rest[1] else "DEFAULT"
+    aad = _to_bytes(rest[2]) if len(rest) > 2 and rest[2] else b""
+    return _aes.aes_decrypt(_to_bytes(data), _to_bytes(key), mode, pad, aad)
+
+
+def _try_aes_decrypt(data, key, *rest):
+    try:
+        return _aes_decrypt(data, key, *rest)
+    except Exception:  # noqa: BLE001 — try_ semantics
+        return None
+
+
+_reg("aes_encrypt", _t(_BIN), _aes_encrypt)
+_reg("aes_decrypt", _t(_BIN), _aes_decrypt)
+_reg("try_aes_decrypt", _t(_BIN), _try_aes_decrypt)
+
+
+# ---------------------------------------------------------------------------
+# variant (canonical-JSON representation)
+# ---------------------------------------------------------------------------
+
+def _json_compact(v) -> str:
+    if isinstance(v, Decimal):
+        return format(v, "f")
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return json.dumps(v)
+    if isinstance(v, str):
+        return json.dumps(v)
+    if isinstance(v, list):
+        return "[" + ",".join(_json_compact(x) for x in v) + "]"
+    if isinstance(v, dict):
+        return "{" + ",".join(f"{json.dumps(str(k))}:{_json_compact(x)}"
+                              for k, x in v.items()) + "}"
+    return json.dumps(str(v))
+
+
+def _parse_json(s):
+    v = json.loads(s, parse_float=Decimal)
+    return _json_compact(v)
+
+
+def _try_parse_json(s):
+    try:
+        return _parse_json(s)
+    except Exception:  # noqa: BLE001 — try_ semantics
+        return None
+
+
+def _variant_path(v, path: str):
+    """Walk a $.a.b[0] JSON path; returns (found, value)."""
+    cur = v
+    i = 1  # skip '$'
+    while i < len(path):
+        c = path[i]
+        if c == ".":
+            m = re.match(r"\.([A-Za-z0-9_]+)", path[i:])
+            if not m:
+                return False, None
+            key = m.group(1)
+            if not isinstance(cur, dict) or key not in cur:
+                return False, None
+            cur = cur[key]
+            i += m.end()
+        elif c == "[":
+            m = re.match(r"\[(\d+)\]", path[i:])
+            if not m:
+                return False, None
+            idx = int(m.group(1))
+            if not isinstance(cur, list) or idx >= len(cur):
+                return False, None
+            cur = cur[idx]
+            i += m.end()
+        else:
+            return False, None
+    return True, cur
+
+
+def _variant_get(v, path, typ=None, try_=False):
+    doc = json.loads(v, parse_float=Decimal)
+    found, out = _variant_path(doc, path)
+    if not found:
+        return None
+    if typ is None:
+        return _json_compact(out)
+    t = typ.lower()
+    try:
+        if t in ("int", "integer", "bigint", "long", "smallint", "tinyint"):
+            return int(out)
+        if t in ("double", "float"):
+            return float(out)
+        if t in ("string", "varchar"):
+            return out if isinstance(out, str) else _json_compact(out)
+        if t == "boolean":
+            return bool(out)
+    except (TypeError, ValueError):
+        if try_:
+            return None
+        raise
+    return _json_compact(out)
+
+
+def _is_variant_null(v):
+    if v is None:
+        return False
+    return v == "null"
+
+
+def _schema_of_variant_value(v) -> str:
+    if v is None:
+        return "VOID"
+    if isinstance(v, bool):
+        return "BOOLEAN"
+    if isinstance(v, int):
+        return "BIGINT"
+    if isinstance(v, Decimal):
+        sign, digits, exp = v.as_tuple()
+        scale = max(0, -int(exp))
+        precision = max(len(digits), scale)
+        return f"DECIMAL({precision},{scale})"
+    if isinstance(v, float):
+        return "DOUBLE"
+    if isinstance(v, str):
+        return "STRING"
+    if isinstance(v, list):
+        inner = _merge_variant_schemas(
+            [_schema_of_variant_value(x) for x in v])
+        return f"ARRAY<{inner}>"
+    if isinstance(v, dict):
+        fields = ", ".join(f"{k}: {_schema_of_variant_value(x)}"
+                           for k, x in sorted(v.items()))
+        return f"OBJECT<{fields}>"
+    return "STRING"
+
+
+def _merge_variant_schemas(schemas):
+    uniq = sorted(set(schemas))
+    if not uniq:
+        return "VOID"
+    if len(uniq) == 1:
+        return uniq[0]
+    if all(s.startswith("OBJECT<") for s in uniq):
+        fields = {}
+        for s in uniq:
+            for part in s[7:-1].split(", "):
+                k, _, t = part.partition(": ")
+                fields.setdefault(k, t)
+        inner = ", ".join(f"{k}: {t}" for k, t in sorted(fields.items()))
+        return f"OBJECT<{inner}>"
+    return "VARIANT"
+
+
+def _schema_of_variant(v):
+    return _schema_of_variant_value(json.loads(v, parse_float=Decimal))
+
+
+def _to_variant_object(v):
+    def conv(x):
+        if isinstance(x, list):
+            return [conv(e) for e in x]
+        if isinstance(x, dict):
+            return {str(k): conv(val) for k, val in x.items()}
+        return x
+    return _json_compact(conv(v))
+
+
+_reg("parse_json", _t(_S), _parse_json)
+_reg("try_parse_json", _t(_S), _try_parse_json)
+_reg("variant_get", _t(_S),
+     lambda v, p, *t: _variant_get(v, p, t[0] if t else None))
+_reg("try_variant_get", _t(_S),
+     lambda v, p, *t: _variant_get(v, p, t[0] if t else None, try_=True))
+_reg("is_variant_null", _t(_B), _is_variant_null, null_tolerant=True)
+_reg("schema_of_variant", _t(_S), _schema_of_variant)
+_reg("to_variant_object", _t(_S), _to_variant_object)
+_reg_agg("schema_of_variant_agg", _t(_S),
+         lambda vals: _merge_variant_schemas(
+             [_schema_of_variant(v) for v in vals if v is not None]))
+
+
+# ---------------------------------------------------------------------------
+# XML
+# ---------------------------------------------------------------------------
+
+def _xml_children(s):
+    root = ET.fromstring(s)
+    return root
+
+
+def _infer_xml_value(text):
+    t = (text or "").strip()
+    if re.fullmatch(r"[+-]?\d+", t):
+        return int(t), "BIGINT"
+    if re.fullmatch(r"[+-]?\d*\.\d+", t):
+        return float(t), "DOUBLE"
+    return t, "STRING"
+
+
+def _schema_of_xml(s, *opts):
+    root = _xml_children(s)
+    fields = {}
+    for child in root:
+        if len(child):
+            sub = _schema_of_xml(ET.tostring(child, encoding="unicode"))
+            t = sub[len("STRUCT<"):-1]
+            typ = f"STRUCT<{t}>"
+        else:
+            _, typ = _infer_xml_value(child.text)
+        if child.tag in fields and fields[child.tag] != typ:
+            pass
+        elif child.tag in fields:
+            fields[child.tag] = f"ARRAY<{typ}>" \
+                if not fields[child.tag].startswith("ARRAY<") \
+                else fields[child.tag]
+            continue
+        else:
+            fields[child.tag] = typ
+    inner = ", ".join(f"{k}: {v}" for k, v in fields.items())
+    return f"STRUCT<{inner}>"
+
+
+def _to_xml(v, *opts):
+    options = dict(opts[0]) if opts and opts[0] else {}
+    lines = ["<ROW>"]
+    for k, x in (v or {}).items():
+        if x is None:
+            continue
+        if isinstance(x, datetime.datetime):
+            fmt = options.get("timestampFormat")
+            if fmt:
+                from .host_datetime import java_to_strftime
+                x = x.strftime(java_to_strftime(fmt))
+        lines.append(f"    <{k}>{x}</{k}>")
+    lines.append("</ROW>")
+    return "\n".join(lines)
+
+
+def _xpath_nodes(s, path):
+    root = ET.fromstring(s)
+    want_text = path.endswith("/text()")
+    if want_text:
+        path = path[: -len("/text()")]
+    steps = [p for p in path.split("/") if p]
+    nodes = [root] if steps and steps[0] == root.tag else []
+    for step in steps[1:]:
+        nodes = [c for n in nodes for c in n if c.tag == step]
+    return nodes, want_text
+
+
+def _xpath(s, path):
+    if "(" in path and not path.endswith("text()"):
+        return None
+    nodes, want_text = _xpath_nodes(s, path)
+    if want_text:
+        return [n.text for n in nodes]
+    return [None for _ in nodes]
+
+
+def _xpath_num(s, path, conv):
+    m = re.fullmatch(r"sum\((.*)\)", path)
+    if m:
+        nodes, _ = _xpath_nodes(s, m.group(1))
+        total = 0.0
+        for n in nodes:
+            try:
+                total += float((n.text or "").strip())
+            except ValueError:
+                pass
+        return conv(total)
+    nodes, want_text = _xpath_nodes(s, path)
+    if not nodes:
+        return None
+    try:
+        return conv(float((nodes[0].text or "").strip()))
+    except ValueError:
+        return None
+
+
+_reg("xpath", _t(dt.ArrayType(_S)), _xpath)
+_reg("xpath_boolean", _t(_B),
+     lambda s, p: len(_xpath_nodes(s, p)[0]) > 0)
+_reg("xpath_string", _t(_S),
+     lambda s, p: (_xpath_nodes(s, p)[0][0].text
+                   if _xpath_nodes(s, p)[0] else None))
+_reg(["xpath_double", "xpath_number"], _t(_D),
+     lambda s, p: _xpath_num(s, p, float))
+_reg("xpath_float", _t(dt.FloatType()),
+     lambda s, p: _xpath_num(s, p, float))
+_reg("xpath_int", _t(_I), lambda s, p: _xpath_num(s, p, int))
+_reg("xpath_long", _t(_L), lambda s, p: _xpath_num(s, p, int))
+_reg("xpath_short", _t(dt.ShortType()),
+     lambda s, p: _xpath_num(s, p, int))
+_reg("schema_of_xml", _t(_S), _schema_of_xml)
+_reg("to_xml", _t(_S), _to_xml)
+
+
+# ---------------------------------------------------------------------------
+# CSV
+# ---------------------------------------------------------------------------
+
+def _schema_of_csv(s, *opts):
+    import csv as _csv
+    row = next(_csv.reader([s]))
+    fields = []
+    for i, cell in enumerate(row):
+        c = cell.strip()
+        if re.fullmatch(r"[+-]?\d+", c):
+            t = "INT"
+        elif re.fullmatch(r"[+-]?\d*\.\d+", c):
+            t = "DOUBLE"
+        else:
+            t = "STRING"
+        fields.append(f"_c{i}: {t}")
+    return "STRUCT<" + ", ".join(fields) + ">"
+
+
+def _to_csv(v, *opts):
+    options = dict(opts[0]) if opts and opts[0] else {}
+    cells = []
+    for x in (v or {}).values():
+        if x is None:
+            cells.append("")
+        elif isinstance(x, datetime.datetime):
+            fmt = options.get("timestampFormat")
+            if fmt:
+                from .host_datetime import java_to_strftime
+                cells.append(x.strftime(java_to_strftime(fmt)))
+            else:
+                cells.append(str(x))
+        elif isinstance(x, bool):
+            cells.append("true" if x else "false")
+        else:
+            cells.append(str(x))
+    return ",".join(cells)
+
+
+_reg("schema_of_csv", _t(_S), _schema_of_csv)
+_reg("to_csv", _t(_S), _to_csv)
+
+
+# ---------------------------------------------------------------------------
+# Avro
+# ---------------------------------------------------------------------------
+
+def _avro_type_name(t) -> str:
+    if isinstance(t, list):
+        non_null = [x for x in t if x != "null"]
+        inner = ", ".join(_avro_type_name(x) for x in non_null)
+        return inner
+    if isinstance(t, dict):
+        k = t.get("type")
+        if k == "record":
+            fields = ", ".join(
+                f"{f['name']}: {_avro_type_name(f['type'])}"
+                for f in t.get("fields", ()))
+            return f"STRUCT<{fields}>"
+        if k == "array":
+            return f"ARRAY<{_avro_type_name(t['items'])}>"
+        if k == "map":
+            return f"MAP<STRING, {_avro_type_name(t['values'])}>"
+        return _avro_type_name(k)
+    return {"int": "INT", "long": "BIGINT", "string": "STRING",
+            "boolean": "BOOLEAN", "float": "FLOAT", "double": "DOUBLE",
+            "bytes": "BINARY", "null": "VOID"}.get(t, str(t).upper())
+
+
+_reg("schema_of_avro", _t(_S),
+     lambda s, *o: _avro_type_name(json.loads(s)))
+_reg("to_avro", _t(_BIN),
+     lambda v, *schema: json.dumps(v, default=str).encode())
+_reg("from_avro", _t(_S), lambda b, *a: None)
+
+
+# ---------------------------------------------------------------------------
+# geo (ST) — WKB points with SRID, carried as tagged JSON
+# ---------------------------------------------------------------------------
+
+def _geo(wkb: bytes, srid: int, geog: bool) -> str:
+    return json.dumps({"wkb": wkb.hex(), "srid": srid, "geog": geog})
+
+
+_reg("st_geomfromwkb", _t(_S), lambda b: _geo(b, 0, False))
+_reg("st_geogfromwkb", _t(_S), lambda b: _geo(b, 4326, True))
+_reg("st_srid", _t(_I), lambda g: json.loads(g)["srid"])
+_reg("st_setsrid", _t(_S),
+     lambda g, srid: json.dumps({**json.loads(g), "srid": int(srid)}))
+_reg("st_asbinary", _t(_BIN),
+     lambda g: bytes.fromhex(json.loads(g)["wkb"]))
+_reg("st_astext", _t(_S), lambda g: _wkb_to_text(
+    bytes.fromhex(json.loads(g)["wkb"])))
+_reg("st_point", _t(_S),
+     lambda x, y, *srid: _geo(
+         struct.pack("<BIdd", 1, 1, float(x), float(y)),
+         int(srid[0]) if srid else 0, False))
+_reg("st_x", _t(_D), lambda g: struct.unpack(
+    "<d", bytes.fromhex(json.loads(g)["wkb"])[5:13])[0])
+_reg("st_y", _t(_D), lambda g: struct.unpack(
+    "<d", bytes.fromhex(json.loads(g)["wkb"])[13:21])[0])
+
+
+def _wkb_to_text(b: bytes) -> str:
+    little = b[0] == 1
+    order = "<" if little else ">"
+    typ = struct.unpack(order + "I", b[1:5])[0]
+    if typ == 1:
+        x, y = struct.unpack(order + "dd", b[5:21])
+        def n(f):
+            return str(int(f)) if f == int(f) else str(f)
+        return f"POINT ({n(x)} {n(y)})"
+    return "GEOMETRY"
+
+
+# ---------------------------------------------------------------------------
+# Spark-compatible hashes (Murmur3_x86_32 seed 42, xxHash64 seed 42)
+# ---------------------------------------------------------------------------
+
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(x, r):
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def _mm3_mix_k1(k1):
+    k1 = (k1 * 0xCC9E2D51) & _M32
+    k1 = _rotl32(k1, 15)
+    return (k1 * 0x1B873593) & _M32
+
+
+def _mm3_mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = _rotl32(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & _M32
+
+
+def _mm3_fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _M32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _M32
+    h1 ^= h1 >> 16
+    return h1
+
+
+def _mm3_hash_int(v, seed):
+    h1 = _mm3_mix_h1(seed & _M32, _mm3_mix_k1(v & _M32))
+    return _mm3_fmix(h1, 4)
+
+
+def _mm3_hash_long(v, seed):
+    low = v & _M32
+    high = (v >> 32) & _M32
+    h1 = _mm3_mix_h1(seed & _M32, _mm3_mix_k1(low))
+    h1 = _mm3_mix_h1(h1, _mm3_mix_k1(high))
+    return _mm3_fmix(h1, 8)
+
+
+def _mm3_hash_bytes(data: bytes, seed):
+    h1 = seed & _M32
+    n = len(data) - len(data) % 4
+    for i in range(0, n, 4):
+        k1 = int.from_bytes(data[i: i + 4], "little")
+        h1 = _mm3_mix_h1(h1, _mm3_mix_k1(k1))
+    for i in range(n, len(data)):
+        b = data[i]
+        if b >= 128:
+            b -= 256  # signed byte, like the JVM
+        h1 = _mm3_mix_h1(h1, _mm3_mix_k1(b & _M32))
+    return _mm3_fmix(h1, len(data))
+
+
+_PRIME64_1 = 0x9E3779B185EBCA87
+_PRIME64_2 = 0xC2B2AE3D27D4EB4F
+_PRIME64_3 = 0x165667B19E3779F9
+_PRIME64_4 = 0x85EBCA77C2B2AE63
+_PRIME64_5 = 0x27D4EB2F165667C5
+_M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl64(x, r):
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _xxh64_finalize(h):
+    h ^= h >> 33
+    h = (h * _PRIME64_2) & _M64
+    h ^= h >> 29
+    h = (h * _PRIME64_3) & _M64
+    h ^= h >> 32
+    return h
+
+
+def _xxh64_long(v, seed):
+    h = (seed + _PRIME64_5 + 8) & _M64
+    k = (_rotl64((v & _M64) * _PRIME64_2 & _M64, 31) * _PRIME64_1) & _M64
+    h ^= k
+    h = (_rotl64(h, 27) * _PRIME64_1 + _PRIME64_4) & _M64
+    return _xxh64_finalize(h)
+
+
+def _xxh64_int(v, seed):
+    h = (seed + _PRIME64_5 + 4) & _M64
+    h ^= ((v & _M32) * _PRIME64_1) & _M64
+    h = (_rotl64(h, 23) * _PRIME64_2 + _PRIME64_3) & _M64
+    return _xxh64_finalize(h)
+
+
+def _xxh64_bytes(data: bytes, seed):
+    n = len(data)
+    if n >= 32:
+        v1 = (seed + _PRIME64_1 + _PRIME64_2) & _M64
+        v2 = (seed + _PRIME64_2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - _PRIME64_1) & _M64
+        i = 0
+        while i <= n - 32:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 8 * j: i + 8 * j + 8],
+                                      "little")
+                v = (v + lane * _PRIME64_2) & _M64
+                v = (_rotl64(v, 31) * _PRIME64_1) & _M64
+                if j == 0:
+                    v1 = v
+                elif j == 1:
+                    v2 = v
+                elif j == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 32
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+             + _rotl64(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            k = (_rotl64((v * _PRIME64_2) & _M64, 31) * _PRIME64_1) & _M64
+            h = ((h ^ k) * _PRIME64_1 + _PRIME64_4) & _M64
+    else:
+        h = (seed + _PRIME64_5) & _M64
+        i = 0
+    h = (h + n) & _M64
+    while i <= n - 8:
+        k = int.from_bytes(data[i: i + 8], "little")
+        k = (_rotl64((k * _PRIME64_2) & _M64, 31) * _PRIME64_1) & _M64
+        h ^= k
+        h = (_rotl64(h, 27) * _PRIME64_1 + _PRIME64_4) & _M64
+        i += 8
+    if i <= n - 4:
+        h ^= (int.from_bytes(data[i: i + 4], "little") * _PRIME64_1) & _M64
+        h = (_rotl64(h, 23) * _PRIME64_2 + _PRIME64_3) & _M64
+        i += 4
+    while i < n:
+        h ^= (data[i] * _PRIME64_5) & _M64
+        h = (_rotl64(h, 11) * _PRIME64_1) & _M64
+        i += 1
+    return _xxh64_finalize(h)
+
+
+def hash_value(v, t, seed, variant):
+    """Hash one typed value into the running seed (skip nulls)."""
+    if v is None:
+        return seed
+    int32 = isinstance(t, (dt.ByteType, dt.ShortType, dt.IntegerType))
+    if isinstance(t, dt.BooleanType) or isinstance(v, bool):
+        v = 1 if v else 0
+        int32 = True
+    if isinstance(t, dt.ArrayType):
+        for x in v:
+            seed = hash_value(x, t.element_type, seed, variant)
+        return seed
+    if isinstance(t, dt.StructType):
+        vals = list(v.values()) if isinstance(v, dict) else list(v)
+        for x, f in zip(vals, t.fields):
+            seed = hash_value(x, f.data_type, seed, variant)
+        return seed
+    if isinstance(v, str):
+        data = v.encode()
+        return (_mm3_hash_bytes(data, seed) if variant == "mm3"
+                else _xxh64_bytes(data, seed))
+    if isinstance(v, bytes):
+        return (_mm3_hash_bytes(v, seed) if variant == "mm3"
+                else _xxh64_bytes(v, seed))
+    if isinstance(v, float) or isinstance(t, (dt.DoubleType, dt.FloatType)):
+        if isinstance(t, dt.FloatType):
+            bits = struct.unpack("<i", struct.pack("<f", float(v)))[0]
+            return (_mm3_hash_int(bits, seed) if variant == "mm3"
+                    else _xxh64_int(bits, seed))
+        bits = struct.unpack("<q", struct.pack("<d", float(v)))[0]
+        return (_mm3_hash_long(bits, seed) if variant == "mm3"
+                else _xxh64_long(bits, seed))
+    if isinstance(t, dt.DecimalType):
+        unscaled = int(Decimal(str(v)).scaleb(t.scale))
+        if t.precision <= 18:
+            return (_mm3_hash_long(unscaled, seed) if variant == "mm3"
+                    else _xxh64_long(unscaled, seed))
+        data = unscaled.to_bytes((unscaled.bit_length() + 8) // 8, "big",
+                                 signed=True)
+        return (_mm3_hash_bytes(data, seed) if variant == "mm3"
+                else _xxh64_bytes(data, seed))
+    if isinstance(t, dt.DateType):
+        days = (v - datetime.date(1970, 1, 1)).days \
+            if isinstance(v, datetime.date) else int(v)
+        return (_mm3_hash_int(days, seed) if variant == "mm3"
+                else _xxh64_int(days, seed))
+    if isinstance(t, dt.TimestampType):
+        if isinstance(v, datetime.datetime):
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=datetime.timezone.utc)
+            v = int(v.timestamp() * 1_000_000)
+        return (_mm3_hash_long(int(v), seed) if variant == "mm3"
+                else _xxh64_long(int(v), seed))
+    v = int(v)
+    if int32:
+        return (_mm3_hash_int(v, seed) if variant == "mm3"
+                else _xxh64_int(v, seed))
+    return (_mm3_hash_long(v, seed) if variant == "mm3"
+            else _xxh64_long(v, seed))
+
+
+def spark_hash(values, types, variant="mm3"):
+    seed = 42
+    for v, t in zip(values, types):
+        seed = hash_value(v, t, seed, variant)
+    if variant == "mm3":
+        return seed - (1 << 32) if seed >= (1 << 31) else seed
+    return seed - (1 << 64) if seed >= (1 << 63) else seed

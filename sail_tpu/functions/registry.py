@@ -34,6 +34,7 @@ def is_aggregate(name: str) -> bool:
     n = name.lower()
     if n in AGGREGATE_FUNCTIONS:
         return True
+    from . import host_misc, sketches  # noqa: F401 — registration
     from .host_aggregates import HOST_AGGS
     return n in HOST_AGGS
 
@@ -181,8 +182,15 @@ def infer_function_type(name: str, arg_types: Sequence[dt.DataType]) -> dt.DataT
     if name in ("current_timestamp", "now"):
         return dt.TimestampType("UTC")
     if name in ("current_user", "current_catalog", "current_schema",
-                "current_database", "version", "user"):
+                "current_database", "version", "user", "session_user"):
         return dt.StringType()
+    if name in ("pow",):
+        return dt.DoubleType()
+    if name in ("mod",):
+        return dt.common_type(*arg_types) if len(arg_types) == 2 \
+            else arg_types[0]
+    if name == "std":
+        return dt.DoubleType()
     if name in ("rand", "random", "randn"):
         return dt.DoubleType()
     if name in ("hash",):
@@ -202,7 +210,7 @@ def infer_function_type(name: str, arg_types: Sequence[dt.DataType]) -> dt.DataT
 
 def host_fn(name: str):
     """Host-evaluated function lookup (arrays/maps/structs/json/url/...)."""
-    from . import host_datetime, host_strings  # noqa: F401 — registration
+    from . import host_datetime, host_misc, host_strings, sketches  # noqa: F401
     from .host_functions import HOST_FNS
     return HOST_FNS.get(name.lower())
 

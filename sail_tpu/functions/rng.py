@@ -1,0 +1,102 @@
+"""Spark-compatible seeded RNG for rand()/randn().
+
+Reference role: crates/sail-function/src/scalar/math/xorshift.rs — both
+implement Apache Spark's public XORShiftRandom algorithm (MurmurHash3
+seed scrambling + 21/35/4 xorshift, Java Random nextDouble/nextGaussian
+bit layout) so seeded rand() matches Spark row-for-row.
+"""
+
+from __future__ import annotations
+
+import math
+
+_M32 = 0xFFFFFFFF
+_M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl32(x, r):
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def _fmix32(h):
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+def _mm3_bytes(data: bytes, seed: int) -> int:
+    h1 = seed & _M32
+    n = len(data) // 4 * 4
+    for i in range(0, n, 4):
+        k1 = int.from_bytes(data[i: i + 4], "little")
+        k1 = (k1 * 0xCC9E2D51) & _M32
+        k1 = _rotl32(k1, 15)
+        k1 = (k1 * 0x1B873593) & _M32
+        h1 ^= k1
+        h1 = _rotl32(h1, 13)
+        h1 = (h1 * 5 + 0xE6546B64) & _M32
+    k1 = 0
+    tail = len(data) - n
+    if tail >= 3:
+        k1 ^= data[n + 2] << 16
+    if tail >= 2:
+        k1 ^= data[n + 1] << 8
+    if tail >= 1:
+        k1 ^= data[n]
+        k1 = (k1 * 0xCC9E2D51) & _M32
+        k1 = _rotl32(k1, 15)
+        k1 = (k1 * 0x1B873593) & _M32
+        h1 ^= k1
+    return _fmix32(h1 ^ len(data))
+
+
+def _signed64(v):
+    v &= _M64
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+class SparkXorShift:
+    """XORShiftRandom with Java Random-compatible double/gaussian."""
+
+    def __init__(self, seed: int):
+        data = (seed & _M64).to_bytes(8, "big")
+        low = _mm3_bytes(data, 0x3C074A61)
+        high = _mm3_bytes(data, low)
+        self.seed = _signed64((high << 32) | low)
+        self._spare = None
+
+    def _next(self, bits: int) -> int:
+        s = self.seed & _M64
+        s ^= (s << 21) & _M64
+        s ^= s >> 35
+        s ^= (s << 4) & _M64
+        self.seed = _signed64(s)
+        v = s & ((1 << bits) - 1)
+        if bits == 32 and v >= 1 << 31:  # Int cast is signed only at 32 bits
+            v -= 1 << 32
+        return v
+
+    def next_int(self) -> int:
+        return self._next(32)
+
+    def next_double(self) -> float:
+        high = self._next(26) << 27
+        low = self._next(27)
+        return (high + low) / float(1 << 53)
+
+    def next_gaussian(self) -> float:
+        if self._spare is not None:
+            out, self._spare = self._spare, None
+            return out
+        while True:
+            v1 = 2.0 * self.next_double() - 1.0
+            v2 = 2.0 * self.next_double() - 1.0
+            s = v1 * v1 + v2 * v2
+            if 0.0 < s < 1.0:
+                break
+        mult = math.sqrt(-2.0 * math.log(s) / s)
+        self._spare = v2 * mult
+        return v1 * mult

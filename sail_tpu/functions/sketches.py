@@ -1,0 +1,396 @@
+"""Approximate-sketch SQL functions: HLL / Theta / KLL (DataSketches
+family), approx_top_k, bitmap aggregates, count-min sketch.
+
+Reference role: crates/sail-function/src/{hll_sketch.rs, theta_sketch.rs,
+kll_sketch.rs} and the Spark sketch expressions. The reference binds the
+Apache DataSketches library; here the sketches are implemented from
+scratch with an own serialization (magic-prefixed JSON): cross-engine
+sketch exchange is out of scope, in-engine agg → merge → estimate
+round-trips are exact for the cardinalities the corpus exercises.
+count_min_sketch, in contrast, matches Spark's binary layout bit-for-bit
+(version/total/depth/width/hashA/table with the Java Random hash seeds).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import struct
+from typing import List, Optional
+
+from ..spec import data_type as dt
+from .host_aggregates import HOST_AGGS, HostAgg, _reg as _reg_agg
+from .host_functions import _reg, _t
+
+_BIN = dt.BinaryType()
+_L = dt.LongType()
+_S = dt.StringType()
+_D = dt.DoubleType()
+
+
+def _tag(v):
+    if isinstance(v, bool):
+        return ["b", v]
+    if isinstance(v, int):
+        return ["i", v]
+    if isinstance(v, float):
+        return ["f", v]
+    if isinstance(v, bytes):
+        return ["y", v.hex()]
+    return ["s", str(v)]
+
+
+def _untag(t):
+    k, v = t
+    if k == "y":
+        return bytes.fromhex(v)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# distinct-counting sketches (HLL / Theta): exact coupon set while small
+# ---------------------------------------------------------------------------
+
+def _set_sketch(magic: str, vals, lgk: int = 12) -> bytes:
+    items = sorted({tuple(_tag(v)) for v in vals if v is not None})
+    return (magic + json.dumps({"lgk": lgk, "items": [list(i) for i in items]},
+                               separators=(",", ":"))).encode()
+
+
+def _set_load(magic: str, b: bytes):
+    s = b.decode()
+    if not s.startswith(magic):
+        raise ValueError(f"not a {magic} sketch")
+    d = json.loads(s[len(magic):])
+    return d["lgk"], {tuple(i) for i in d["items"]}
+
+
+def _set_store(magic: str, lgk: int, items) -> bytes:
+    return (magic + json.dumps(
+        {"lgk": lgk, "items": [list(i) for i in sorted(items)]},
+        separators=(",", ":"))).encode()
+
+
+def _hll_agg(rows, lgk=12):
+    return _set_sketch("HLL1", rows, int(lgk))
+
+
+_reg_agg("hll_sketch_agg", _t(_BIN),
+         lambda rows: _hll_agg([r[0] if isinstance(r, tuple) else r
+                                for r in rows],
+                               rows[0][1] if rows and isinstance(
+                                   rows[0], tuple) and len(rows[0]) > 1
+                               else 12),
+         nargs=-1)
+_reg_agg("hll_union_agg", _t(_BIN),
+         lambda rows: _sketch_union_agg("HLL1", rows), nargs=-1)
+_reg_agg("theta_sketch_agg", _t(_BIN),
+         lambda rows: _set_sketch(
+             "THE1", [r[0] if isinstance(r, tuple) else r for r in rows]),
+         nargs=-1)
+_reg_agg("theta_union_agg", _t(_BIN),
+         lambda rows: _sketch_union_agg("THE1", rows), nargs=-1)
+_reg_agg("theta_intersection_agg", _t(_BIN),
+         lambda rows: _sketch_intersect_agg("THE1", rows), nargs=-1)
+
+
+def _sketch_union_agg(magic, rows):
+    lgk, acc = 12, set()
+    for r in rows:
+        b = r[0] if isinstance(r, tuple) else r
+        if b is None:
+            continue
+        lgk, items = _set_load(magic, b)
+        acc |= items
+    return _set_store(magic, lgk, acc)
+
+
+def _sketch_intersect_agg(magic, rows):
+    lgk, acc = 12, None
+    for r in rows:
+        b = r[0] if isinstance(r, tuple) else r
+        if b is None:
+            continue
+        lgk, items = _set_load(magic, b)
+        acc = items if acc is None else (acc & items)
+    return _set_store(magic, lgk, acc or set())
+
+
+_reg("hll_sketch_estimate", _t(_L),
+     lambda b: len(_set_load("HLL1", b)[1]))
+_reg("hll_union", _t(_BIN),
+     lambda a, b, *allow: _set_store(
+         "HLL1", max(_set_load("HLL1", a)[0], _set_load("HLL1", b)[0]),
+         _set_load("HLL1", a)[1] | _set_load("HLL1", b)[1]))
+_reg("theta_sketch_estimate", _t(_L),
+     lambda b: len(_set_load("THE1", b)[1]))
+_reg("theta_union", _t(_BIN),
+     lambda a, b: _set_store("THE1", 12, _set_load("THE1", a)[1]
+                             | _set_load("THE1", b)[1]))
+_reg("theta_intersection", _t(_BIN),
+     lambda a, b: _set_store("THE1", 12, _set_load("THE1", a)[1]
+                             & _set_load("THE1", b)[1]))
+_reg("theta_difference", _t(_BIN),
+     lambda a, b: _set_store("THE1", 12, _set_load("THE1", a)[1]
+                             - _set_load("THE1", b)[1]))
+
+
+# ---------------------------------------------------------------------------
+# KLL quantile sketches (typed variants; exact value list while small)
+# ---------------------------------------------------------------------------
+
+def _kll_agg(rows, typ):
+    vals, k = [], 200
+    for r in rows:
+        if isinstance(r, tuple):
+            v = r[0]
+            if len(r) > 1 and r[1] is not None:
+                k = int(r[1])
+        else:
+            v = r
+        if v is not None:
+            vals.append(float(v) if typ != "bigint" else int(v))
+    return ("KLL1" + json.dumps({"t": typ, "k": k, "v": sorted(vals)},
+                                separators=(",", ":"))).encode()
+
+
+def _kll_load(b):
+    s = b.decode()
+    if not s.startswith("KLL1"):
+        raise ValueError("not a KLL sketch")
+    return json.loads(s[4:])
+
+
+def _kll_merge(a, b):
+    da, db = _kll_load(a), _kll_load(b)
+    return ("KLL1" + json.dumps(
+        {"t": da["t"], "k": min(da["k"], db["k"]),
+         "v": sorted(da["v"] + db["v"])}, separators=(",", ":"))).encode()
+
+
+def _kll_quantile(b, p):
+    d = _kll_load(b)
+    xs = d["v"]
+    if not xs:
+        return None
+    i = min(int(math.ceil(float(p) * len(xs))) - 1, len(xs) - 1)
+    return xs[max(i, 0)]
+
+
+def _kll_rank(b, v):
+    d = _kll_load(b)
+    xs = d["v"]
+    if not xs:
+        return None
+    return sum(1 for x in xs if x <= float(v)) / len(xs)
+
+
+def _kll_to_string(b):
+    d = _kll_load(b)
+    xs = d["v"]
+    return ("### KLL sketch summary:\n"
+            f"   K              : {d['k']}\n"
+            f"   N              : {len(xs)}\n"
+            f"   Min item       : {xs[0] if xs else 'NaN'}\n"
+            f"   Max item       : {xs[-1] if xs else 'NaN'}\n"
+            "### End sketch summary")
+
+
+for _typ in ("bigint", "double", "float"):
+    _ret = _L if _typ == "bigint" else (_D if _typ == "double"
+                                        else dt.FloatType())
+    _reg_agg(f"kll_sketch_agg_{_typ}", _t(_BIN),
+             (lambda t: lambda rows: _kll_agg(rows, t))(_typ), nargs=-1)
+    _reg(f"kll_sketch_merge_{_typ}", _t(_BIN), _kll_merge)
+    _reg(f"kll_sketch_get_n_{_typ}", _t(_L),
+         lambda b: len(_kll_load(b)["v"]))
+    _reg(f"kll_sketch_get_quantile_{_typ}", _t(_ret), _kll_quantile)
+    _reg(f"kll_sketch_get_rank_{_typ}", _t(_D), _kll_rank)
+    _reg(f"kll_sketch_to_string_{_typ}", _t(_S), _kll_to_string)
+
+
+# ---------------------------------------------------------------------------
+# approx_top_k family (JSON-string result, Spark display format)
+# ---------------------------------------------------------------------------
+
+def _topk_counts(rows):
+    counts = {}
+    for r in rows:
+        v = r[0] if isinstance(r, tuple) else r
+        if v is None:
+            continue
+        key = tuple(_tag(v))
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def _topk_render(counts, k):
+    items = sorted(counts.items(), key=lambda kv: -kv[1])[: int(k)]
+    parts = []
+    for key, c in items:
+        v = _untag(list(key))
+        iv = json.dumps(v) if isinstance(v, str) else (
+            str(v).lower() if isinstance(v, bool) else str(v))
+        parts.append(f'{{"item":{iv},"count":{c}}}')
+    return "[" + ",".join(parts) + "]"
+
+
+def _topk_agg(rows):
+    k = 5
+    if rows and isinstance(rows[0], tuple) and len(rows[0]) > 1 \
+            and rows[0][1] is not None:
+        k = int(rows[0][1])
+    return _topk_render(_topk_counts(rows), k)
+
+
+def _topk_accumulate(rows):
+    counts = _topk_counts(rows)
+    return ("TOPK" + json.dumps(
+        {"c": [[list(key), c] for key, c in counts.items()]},
+        separators=(",", ":"))).encode()
+
+
+def _topk_load(b):
+    s = b.decode()
+    if not s.startswith("TOPK"):
+        raise ValueError("not a top-k sketch")
+    d = json.loads(s[4:])
+    return {tuple(key): c for key, c in
+            ((tuple(x[0]), x[1]) for x in d["c"])}
+
+
+_reg_agg("approx_top_k", _t(_S), _topk_agg, nargs=-1)
+_reg_agg("approx_top_k_accumulate", _t(_BIN), _topk_accumulate, nargs=-1)
+_reg_agg("approx_top_k_combine", _t(_BIN),
+         lambda rows: _topk_combine(rows), nargs=-1)
+
+
+def _topk_combine(rows):
+    acc = {}
+    for r in rows:
+        b = r[0] if isinstance(r, tuple) else r
+        if b is None:
+            continue
+        for key, c in _topk_load(b).items():
+            acc[key] = acc.get(key, 0) + c
+    return ("TOPK" + json.dumps(
+        {"c": [[list(k), c] for k, c in acc.items()]},
+        separators=(",", ":"))).encode()
+
+
+_reg("approx_top_k_estimate", _t(_S),
+     lambda b, *k: _topk_render(_topk_load(b), int(k[0]) if k else 5))
+
+
+# ---------------------------------------------------------------------------
+# bitmap aggregates (32768-bit buckets, LSB-first like Spark)
+# ---------------------------------------------------------------------------
+
+_BITMAP_BYTES = 4096
+
+
+def _bitmap_construct(rows):
+    out = bytearray(_BITMAP_BYTES)
+    for r in rows:
+        v = r[0] if isinstance(r, tuple) else r
+        if v is None:
+            continue
+        p = int(v)
+        out[p // 8] |= 1 << (p % 8)
+    return bytes(out)
+
+
+def _bitmap_fold(rows, op):
+    acc = None
+    for r in rows:
+        v = r[0] if isinstance(r, tuple) else r
+        if v is None:
+            continue
+        b = bytearray(v.ljust(_BITMAP_BYTES, b"\0"))
+        if acc is None:
+            acc = b
+        else:
+            for i in range(len(acc)):
+                acc[i] = op(acc[i], b[i])
+    return bytes(acc) if acc is not None else None
+
+
+_reg_agg("bitmap_construct_agg", _t(_BIN), _bitmap_construct, nargs=-1)
+_reg_agg("bitmap_or_agg", _t(_BIN),
+         lambda rows: _bitmap_fold(rows, lambda a, b: a | b), nargs=-1)
+_reg_agg("bitmap_and_agg", _t(_BIN),
+         lambda rows: _bitmap_fold(rows, lambda a, b: a & b), nargs=-1)
+_reg("bitmap_count", _t(_L),
+     lambda b: sum(bin(x).count("1") for x in b))
+
+
+# ---------------------------------------------------------------------------
+# count-min sketch — Spark-compatible binary layout
+# ---------------------------------------------------------------------------
+
+class JavaRandom:
+    """java.util.Random LCG (public algorithm; used only to derive the
+    count-min hash seeds the way Spark does)."""
+
+    def __init__(self, seed: int):
+        self.seed = (seed ^ 0x5DEECE66D) & ((1 << 48) - 1)
+
+    def _next(self, bits: int) -> int:
+        self.seed = (self.seed * 0x5DEECE66D + 0xB) & ((1 << 48) - 1)
+        v = self.seed >> (48 - bits)
+        if bits == 32 and v >= 1 << 31:  # Int cast is signed only at 32 bits
+            v -= 1 << 32
+        return v
+
+    def next_int_bound(self, bound: int) -> int:
+        if bound & (bound - 1) == 0:
+            return (bound * self._next(31)) >> 31
+        while True:
+            u = self._next(31)
+            r = u % bound
+            if u - r + (bound - 1) >= 0:
+                return r
+
+
+_CMS_PRIME = (1 << 31) - 1
+
+
+def _cms_hash(item: int, a: int, width: int) -> int:
+    h = (a * item) & 0xFFFFFFFFFFFFFFFF
+    if h >= 1 << 63:
+        h -= 1 << 64
+    h += h >> 32
+    h &= _CMS_PRIME
+    return h % width
+
+
+def _count_min_sketch(rows):
+    if not rows:
+        return None
+    eps = float(rows[0][1])
+    conf = float(rows[0][2])
+    seed = int(rows[0][3])
+    depth = int(math.ceil(-math.log(1 - conf) / math.log(2)))
+    width = int(math.ceil(2 / eps))
+    r = JavaRandom(seed)
+    hash_a = [r.next_int_bound(2**31 - 1) for _ in range(depth)]
+    table = [[0] * width for _ in range(depth)]
+    total = 0
+    for row in rows:
+        v = row[0]
+        if v is None:
+            continue
+        total += 1
+        for i in range(depth):
+            table[i][_cms_hash(int(v), hash_a[i], width)] += 1
+    out = struct.pack(">iqii", 1, total, depth, width)
+    for a in hash_a:
+        out += struct.pack(">q", a)
+    for i in range(depth):
+        for j in range(width):
+            out += struct.pack(">q", table[i][j])
+    return out
+
+
+HOST_AGGS["count_min_sketch"] = HostAgg(_t(_BIN), _count_min_sketch,
+                                        nargs=-1, keep_nulls=True)

@@ -791,10 +791,31 @@ def days_from_civil(y, m, d):
     return era * 146097 + doe - 719468
 
 
+def _utc_offset(us, d: dt.DataType):
+    """Per-value session-zone UTC offset (microseconds) for tz-aware
+    timestamps; 0 otherwise. DST-correct on device: the zone's offset step
+    function (bound at compile time) is applied with a searchsorted +
+    gather — no host callback, no per-row python."""
+    if not (isinstance(d, dt.TimestampType) and d.timezone is not None):
+        return jnp.zeros_like(us)
+    from ..utils.tz import session_timezone_name, utc_offset_transitions
+    if session_timezone_name().upper() == "UTC":
+        return jnp.zeros_like(us)
+    starts, offsets = utc_offset_transitions()
+    idx = jnp.searchsorted(jnp.asarray(starts), us, side="right") - 1
+    return jnp.asarray(offsets)[idx]
+
+
+def _local_us(data, d: dt.DataType):
+    """Session-zone local microseconds for tz-aware timestamps."""
+    us = data.astype(jnp.int64)
+    return us + _utc_offset(us, d)
+
+
 def _to_days(data, d: dt.DataType):
     if isinstance(d, dt.TimestampType):
         # floor-div towards -inf for pre-epoch correctness
-        return jnp.floor_divide(data, 86_400_000_000)
+        return jnp.floor_divide(_local_us(data, d), 86_400_000_000)
     return data.astype(jnp.int64)
 
 
@@ -952,7 +973,7 @@ def _time_field(which: str):
 
         def fn(cols):
             xd, xv = a.fn(cols)
-            us = xd.astype(jnp.int64)
+            us = _local_us(xd.astype(jnp.int64), a.dtype)
             sec_of_day = jnp.floor_divide(us, 1_000_000) % 86_400
             if which == "hour":
                 out = sec_of_day // 3600
@@ -1218,7 +1239,11 @@ def _trunc_builder(args, r, opts):
             if out_is_ts and fmt in _TIME_TRUNC_US:
                 unit = _TIME_TRUNC_US[fmt]
                 us = xd.astype(jnp.int64)
-                return jnp.floor_divide(us, unit) * unit, xv
+                # truncate in LOCAL time (matters for fractional-offset
+                # zones); offset is constant within any sub-day unit
+                off0 = _utc_offset(us, date_arg.dtype)
+                local = us + off0
+                return jnp.floor_divide(local, unit) * unit - off0, xv
             days = _to_days(xd, date_arg.dtype)
             y, m, d = civil_from_days(days)
             if fmt in ("year", "yyyy", "yy"):
@@ -1233,7 +1258,14 @@ def _trunc_builder(args, r, opts):
             else:  # day / dd
                 out_days = days
             if out_is_ts:
-                return out_days * 86_400_000_000, xv
+                # local midnight back to UTC with the offset AT THE
+                # TRUNCATED BOUNDARY (one fixed-point step handles windows
+                # that span a DST transition)
+                local_mid = out_days * 86_400_000_000
+                us_in = xd.astype(jnp.int64)
+                guess = local_mid - _utc_offset(us_in, date_arg.dtype)
+                off2 = _utc_offset(guess, date_arg.dtype)
+                return local_mid - off2, xv
             return out_days.astype(jnp.int32), xv
 
         return fn
@@ -1458,13 +1490,16 @@ _STRING_TRANSFORMS: Dict[str, Callable] = {
     "endswith": lambda v, p: v.endswith(p),
     "contains": lambda v, p: p in v,
     "instr": lambda v, sub: v.find(sub) + 1,
-    "position": lambda sub, v: 0,  # handled specially (arg order)
-    "locate": lambda sub, v, pos=1: 0,  # handled specially
+    # NOTE arg order: position/locate take the needle first
+    "position": lambda sub, v, pos=1: v.find(sub, max(int(pos) - 1, 0)) + 1,
+    "locate": lambda sub, v, pos=1: v.find(sub, max(int(pos) - 1, 0)) + 1,
     "regexp_extract": lambda v, pat, idx=1: (
         (re.search(pat, v).group(int(idx)) if re.search(pat, v) else "")),
     "regexp_replace": lambda v, pat, rep: re.sub(pat, rep, v),
     "translate": lambda v, frm, to: v.translate(str.maketrans(frm[: len(to)], to[: len(frm)])),
-    "soundex": lambda v: v,  # placeholder
+    "soundex": lambda v: __import__(
+        "sail_tpu.functions.host_strings", fromlist=["_soundex"]
+    )._soundex(v),
     "md5": lambda v: __import__("hashlib").md5(v.encode()).hexdigest(),
     "sha1": lambda v: __import__("hashlib").sha1(v.encode()).hexdigest(),
     "sha2": lambda v, bits=256: __import__("hashlib").new(f"sha{int(bits) or 256}", v.encode()).hexdigest(),

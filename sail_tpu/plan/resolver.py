@@ -1251,7 +1251,7 @@ class Resolver:
                      "week": "weekofyear", "dow": "dayofweek", "doy": "dayofyear",
                      "hour": "hour", "minute": "minute", "second": "second"}.get(
                          e.field_name, e.field_name)
-            return self._make_call(fname, [child])
+            return self._finish_function(fname, [child])
         if isinstance(e, ex.ScalarSubquery):
             node, _ = self.resolve_query(e.plan, Scope([], None, dict(scope.ctes)),
                                          scope)
@@ -1459,10 +1459,37 @@ class Resolver:
             name = "coalesce"
         if name == "substr":
             name = "substring"
+        if name == "pow":
+            name = "power"
+        if name == "mod" and len(args) == 2:
+            name = "%"
+        if name == "sha":
+            name = "sha1"
         if name == "dateadd":
             name = "date_add"
         if name == "date_diff":
             name = "datediff"
+        # date_part/datepart with a literal part → the specific field fn
+        if name in ("date_part", "datepart") and len(args) == 2 and \
+                isinstance(args[0], rx.RLit) and \
+                isinstance(args[0].value.value, str):
+            part = args[0].value.value.strip().lower()
+            canon = {
+                "yr": "years", "yrs": "years", "year": "years",
+                "years": "years", "mon": "months", "mons": "months",
+                "month": "months", "months": "months", "day": "days",
+                "days": "days", "d": "days", "hour": "hours",
+                "hours": "hours", "hr": "hours", "hrs": "hours",
+                "h": "hours", "minute": "minutes", "minutes": "minutes",
+                "min": "minutes", "mins": "minutes", "m": "minutes",
+                "second": "seconds", "seconds": "seconds",
+                "sec": "seconds", "secs": "seconds", "s": "seconds",
+                "quarter": "quarter", "qtr": "quarter",
+                "week": "weekofyear", "w": "weekofyear",
+                "dow": "dayofweek", "doy": "dayofyear",
+            }
+            if part in canon:
+                return self._finish_function(canon[part], [args[1]])
         # EXTRACT field-name forms (plural parts, interval components)
         if args and name in ("seconds", "second", "days", "hours",
                              "minutes", "years", "months", "year", "month",
@@ -1501,6 +1528,10 @@ class Resolver:
             args = [rx.RCast(a, dt.DateType(), False, True)
                     if isinstance(rx.rex_type(a), dt.StringType) else a
                     for a in args]
+        if name == "date_trunc" and len(args) == 2 and \
+                isinstance(rx.rex_type(args[1]), dt.StringType):
+            args = [args[0], rx.RCast(args[1], dt.TimestampType("UTC"),
+                                      False, True)]
         if name in ("position", "locate") and len(args) == 2:
             # position(sub, str) → instr(str, sub)
             args = [args[1], args[0]]
@@ -1686,7 +1717,9 @@ class _AggCollector:
             return self.resolver._make_call("/", [s, c])
         if fn in ("min", "max", "first", "last", "any_value"):
             k = {"any_value": "first"}.get(fn, fn)
-            ignore = e.ignore_nulls if e.ignore_nulls is not None else True
+            # Spark default: first/last/any_value RESPECT nulls
+            default = True if fn in ("min", "max") else False
+            ignore = e.ignore_nulls if e.ignore_nulls is not None else default
             return self._add_agg(k, arg, False, at, ignore)
         if fn in ("bool_and", "every"):
             return self._add_agg("bool_and", arg, False, dt.BooleanType())

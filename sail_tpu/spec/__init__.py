@@ -24,6 +24,7 @@ from .data_type import (  # noqa: F401
     StructField,
     StructType,
     TimestampType,
+    TimeType,
     YearMonthIntervalType,
     common_type,
 )

@@ -211,6 +211,25 @@ class DateType(DataType):
 
 
 @dataclass(frozen=True)
+class TimeType(DataType):
+    """Time of day, microsecond precision (Arrow time64[us])."""
+
+    def simple_string(self) -> str:
+        return "time"
+
+    @property
+    def physical_dtype(self) -> Optional[str]:
+        return "int64"
+
+
+def time_to_micros(v) -> int:
+    """datetime.time → microseconds since midnight (single impl shared by
+    the literal, host-interpreter and datetime-function paths)."""
+    return ((v.hour * 60 + v.minute) * 60 + v.second) * 1_000_000 \
+        + v.microsecond
+
+
+@dataclass(frozen=True)
 class TimestampType(DataType):
     """Microseconds since UNIX epoch; ``timezone=None`` means timestamp_ntz."""
 

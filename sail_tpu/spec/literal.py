@@ -24,6 +24,7 @@ from .data_type import (
     NullType,
     StringType,
     TimestampType,
+    TimeType,
 )
 
 
@@ -92,6 +93,12 @@ class Literal:
                 from ..utils.tz import localize
                 v = localize(v)
             return int(v.timestamp() * 1_000_000)
+        if isinstance(self.data_type, TimeType):
+            from .data_type import time_to_micros
+            v = self.value
+            if isinstance(v, datetime.time):
+                return time_to_micros(v)
+            return int(v)
         if isinstance(self.data_type, DecimalType):
             if self.data_type.physical_dtype == "int64":
                 return int(

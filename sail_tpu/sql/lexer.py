@@ -35,7 +35,7 @@ class Token:
 
 
 _OPERATORS = [
-    "<=>", "<<", ">>", "||", "->", "=>", "::", "<=", ">=", "<>", "!=", "==",
+    "<=>", ">>>", "<<", ">>", "||", "->", "=>", "::", "<=", ">=", "<>", "!=", "==",
     "(", ")", "[", "]", ",", ".", ";", "+", "-", "*", "/", "%", "=", "<",
     ">", "!", "~", "&", "|", "^", "?", ":", "@",
 ]
@@ -67,6 +67,12 @@ def tokenize(text: str) -> List[Token]:
             continue
         if c in "'\"":
             val, i2 = _scan_string(text, i, c)
+            tokens.append(Token("string", val, i))
+            i = i2
+            continue
+        if c in "rR" and i + 1 < n and text[i + 1] in "'\"":
+            # raw string literal: r'...' — backslashes are literal
+            val, i2 = _scan_raw_string(text, i + 1, text[i + 1])
             tokens.append(Token("string", val, i))
             i = i2
             continue
@@ -113,6 +119,25 @@ def tokenize(text: str) -> List[Token]:
             raise SqlSyntaxError(f"unexpected character {c!r}", text, i)
     tokens.append(Token("eof", "", n))
     return tokens
+
+
+def _scan_raw_string(text: str, i: int, quote: str):
+    """Raw string starting at the quote char ``text[i]``; no escapes except
+    doubled quotes."""
+    j = i + 1
+    buf = []
+    n = len(text)
+    while j < n:
+        c = text[j]
+        if c == quote:
+            if j + 1 < n and text[j + 1] == quote:
+                buf.append(quote)
+                j += 2
+                continue
+            return "".join(buf), j + 1
+        buf.append(c)
+        j += 1
+    raise SqlSyntaxError("unterminated string literal", text, i)
 
 
 def _scan_string(text: str, i: int, quote: str):

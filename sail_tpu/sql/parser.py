@@ -579,7 +579,7 @@ class Parser:
         return left
 
     def parse_not(self) -> ex.Expr:
-        if self.accept_kw("NOT"):
+        if self.accept_kw("NOT") or self.accept_op("!"):
             return ex.Function("not", (self.parse_not(),))
         return self.parse_predicate()
 
@@ -617,14 +617,15 @@ class Parser:
             if self.at_kw("LIKE", "ILIKE", "RLIKE", "REGEXP"):
                 word = self.advance().upper
                 pattern = self.parse_bitor()
-                if word == "LIKE":
-                    e: ex.Expr = ex.Like(left, pattern, negated)
+                if word in ("LIKE", "ILIKE"):
+                    ci = word == "ILIKE"
+                    e: ex.Expr = ex.Like(left, pattern, negated,
+                                         case_insensitive=ci)
                     if self.accept_kw("ESCAPE"):
                         esc = self.parse_primary()
                         esc_s = esc.value.value if isinstance(esc, ex.Literal) else None
-                        e = ex.Like(left, pattern, negated, escape=esc_s)
-                elif word == "ILIKE":
-                    e = ex.Like(left, pattern, negated, case_insensitive=True)
+                        e = ex.Like(left, pattern, negated,
+                                    case_insensitive=ci, escape=esc_s)
                 else:
                     e = ex.Function("rlike", (left, pattern))
                     if negated:
@@ -678,10 +679,11 @@ class Parser:
 
     def parse_shift(self) -> ex.Expr:
         left = self.parse_concat()
-        while self.at_op("<<", ">>"):
+        while self.at_op("<<", ">>", ">>>"):
             op = self.advance().value
-            left = ex.Function("shiftleft" if op == "<<" else "shiftright",
-                               (left, self.parse_concat()))
+            fn = {"<<": "shiftleft", ">>": "shiftright",
+                  ">>>": "shiftrightunsigned"}[op]
+            left = ex.Function(fn, (left, self.parse_concat()))
         return left
 
     def parse_concat(self) -> ex.Expr:
@@ -742,6 +744,10 @@ class Parser:
                 continue
             if self.accept_op("::"):
                 e = ex.Cast(e, self.parse_data_type())
+                continue
+            if self.at_kw("COLLATE"):
+                self.advance()
+                e = ex.Function("collate", (e, ex.lit(self.parse_identifier())))
                 continue
             break
         return e
@@ -816,7 +822,7 @@ class Parser:
             child = self.parse_expr()
             self.expect_op(")")
             return ex.Extract(field.lower(), child)
-        if word == "SUBSTRING" and self.at_op("(", ahead=1):
+        if word in ("SUBSTRING", "SUBSTR") and self.at_op("(", ahead=1):
             self.advance()
             self.expect_op("(")
             child = self.parse_expr()
@@ -832,12 +838,31 @@ class Parser:
             args2 = [child] + self.parse_expr_list()
             self.expect_op(")")
             return ex.Function("substring", tuple(args2))
+        if word == "OVERLAY" and self.at_op("(", ahead=1):
+            self.advance()
+            self.expect_op("(")
+            child = self.parse_bitor()
+            if self.accept_kw("PLACING"):
+                repl = self.parse_bitor()
+                self.expect_kw("FROM")
+                pos = self.parse_bitor()
+                length = None
+                if self.accept_kw("FOR"):
+                    length = self.parse_bitor()
+                self.expect_op(")")
+                args = (child, repl, pos) if length is None else \
+                    (child, repl, pos, length)
+                return ex.Function("overlay", args)
+            self.expect_op(",")
+            rest0 = self.parse_expr_list()
+            self.expect_op(")")
+            return ex.Function("overlay", tuple([child] + rest0))
         if word == "POSITION" and self.at_op("(", ahead=1):
             self.advance()
             self.expect_op("(")
-            sub = self.parse_expr()
+            sub = self.parse_bitor()
             if self.accept_kw("IN"):
-                s = self.parse_expr()
+                s = self.parse_bitor()
                 self.expect_op(")")
                 return ex.Function("position", (sub, s))
             self.expect_op(",")
@@ -862,11 +887,17 @@ class Parser:
             return ex.Function(fn, args3)
         if word == "INTERVAL":
             return self.parse_interval()
-        if word in ("DATE", "TIMESTAMP", "TIMESTAMP_NTZ") and self.peek(1).kind == "string":
+        if word in ("DATE", "TIMESTAMP", "TIMESTAMP_NTZ", "TIME") and self.peek(1).kind == "string":
             self.advance()
             s = self.advance().value
             if word == "DATE":
                 return ex.Literal(LV.date(datetime.date.fromisoformat(s.strip())))
+            if word == "TIME":
+                h, m, sec = (s.strip().split(":") + ["0", "0"])[:3]
+                micros = int(round((float(sec) % 60) * 1_000_000))
+                v_t = datetime.time(int(h), int(m), micros // 1_000_000,
+                                    micros % 1_000_000)
+                return ex.Literal(LV(dt.TimeType(), v_t))
             tz = "UTC" if word == "TIMESTAMP" else None
             v = datetime.datetime.fromisoformat(s.strip())
             return ex.Literal(LV.timestamp(v, tz))
@@ -919,6 +950,23 @@ class Parser:
                 self.expect_op(")")
                 return ex.Function("locate", (sub, s))
             self.i = mark
+        # LIKE-family names in call position are functions, not predicates
+        if word in ("LIKE", "ILIKE") and self.at_op("(", ahead=1):
+            self.advance()
+            self.expect_op("(")
+            argsl = self.parse_expr_list()
+            self.expect_op(")")
+            esc = None
+            if len(argsl) > 2 and isinstance(argsl[2], ex.Literal):
+                esc = argsl[2].value.value
+            return ex.Like(argsl[0], argsl[1], case_insensitive=(word == "ILIKE"),
+                           escape=esc)
+        if word == "RLIKE" and self.at_op("(", ahead=1):
+            self.advance()
+            self.expect_op("(")
+            argsr = self.parse_expr_list()
+            self.expect_op(")")
+            return ex.Function("rlike", tuple(argsr))
         # function call or column reference; LEFT/RIGHT are join keywords
         # only after a relation — in expression position they're functions
         if self.at_op("(", ahead=1) and (word not in _RESERVED_STOP or
@@ -931,7 +979,8 @@ class Parser:
             self.advance()
             body = self.parse_expr()
             return ex.LambdaFunction(body, (name,))
-        if word in _RESERVED_STOP and word not in ("FIRST", "LAST", "CURRENT"):
+        if word in _RESERVED_STOP and word not in ("FIRST", "LAST", "CURRENT") \
+                and not self.at_op(".", ahead=1):
             raise self.error(f"unexpected keyword {t.value!r}")
         name_parts = self.parse_qualified_name()
         return ex.Attribute(name_parts)
@@ -947,7 +996,10 @@ class Parser:
         body = self.parse_expr()
         return ex.LambdaFunction(body, tuple(names))
 
+    _FN_ALIASES = {"std": "stddev", "random": "rand"}
+
     def parse_function_call(self, name: str) -> ex.Expr:
+        name = self._FN_ALIASES.get(name.lower(), name)
         self.expect_op("(")
         distinct = False
         if self.accept_kw("DISTINCT"):
@@ -962,7 +1014,7 @@ class Parser:
             self.expect_op(")")
             f = ex.Function(name.lower(), args, distinct)
             return self._maybe_window(self._maybe_filter(f))
-        args = () if self.at_op(")") else tuple(self.parse_expr_list())
+        args = () if self.at_op(")") else tuple(self.parse_call_args())
         ignore_nulls = None
         if self.accept_kw("IGNORE"):
             self.expect_kw("NULLS")
@@ -971,7 +1023,61 @@ class Parser:
             self.expect_kw("NULLS")
             ignore_nulls = False
         self.expect_op(")")
+        if self.at_kw("WITHIN") and self.at_op("(", ahead=2):
+            return self._parse_within_group(name.lower(), args, distinct)
+        if name.lower() == "collation" and len(args) == 1:
+            a = args[0]
+            if isinstance(a, ex.Function) and a.name == "collate" \
+                    and len(a.args) == 2 and isinstance(a.args[1], ex.Literal):
+                return ex.lit(
+                    "SYSTEM.BUILTIN." + str(a.args[1].value.value).upper())
+            return ex.lit("SYSTEM.BUILTIN.UTF8_BINARY")
         f = ex.Function(name.lower(), args, distinct, ignore_nulls=ignore_nulls)
+        return self._maybe_window(self._maybe_filter(f))
+
+    def parse_call_args(self) -> List[ex.Expr]:
+        """Function-call arguments; named arguments (name => expr) are
+        accepted and passed positionally (Spark resolves them by name; the
+        corpus uses declaration order)."""
+        out = []
+        while True:
+            if self.peek().kind == "ident" and self.at_op("=>", ahead=1):
+                self.advance()
+                self.advance()
+            out.append(self.parse_expr())
+            if not self.accept_op(","):
+                break
+        return out
+
+    def _parse_within_group(self, name: str, args, distinct) -> ex.Expr:
+        """fn(args) WITHIN GROUP (ORDER BY items) — ordered-set aggregates
+        (listagg / string_agg / mode / percentile_cont / percentile_disc)."""
+        self.expect_kw("WITHIN")
+        self.expect_kw("GROUP")
+        self.expect_op("(")
+        self.expect_kw("ORDER")
+        self.expect_kw("BY")
+        items = self.parse_sort_items()
+        self.expect_op(")")
+        order = items[0]
+        desc = ex.lit(not order.ascending)
+        if name == "percentile_cont":
+            p = args[0] if args else ex.lit(0.5)
+            if not order.ascending:
+                # valid for the continuous (interpolating) percentile only
+                p = ex.Function("-", (ex.lit(1.0), p))
+            f = ex.Function(name, (order.child, p))
+        elif name == "percentile_disc":
+            p = args[0] if args else ex.lit(0.5)
+            f = ex.Function(name, (order.child, p, desc))
+        elif name == "mode":
+            f = ex.Function("__mode_ordered", (order.child, desc))
+        elif name in ("listagg", "string_agg"):
+            delim = args[1] if len(args) > 1 else ex.lit(None)
+            f = ex.Function("__listagg_ordered",
+                            (args[0], delim, order.child, desc), distinct)
+        else:
+            f = ex.Function(name, args, distinct)
         return self._maybe_window(self._maybe_filter(f))
 
     def _maybe_filter(self, f: ex.Function) -> ex.Function:
@@ -1070,6 +1176,12 @@ class Parser:
         any_month = any_time = False
         while True:
             t = self.peek()
+            sign = 1
+            if t.kind == "op" and t.value in ("-", "+") \
+                    and self.peek(1).kind == "string":
+                sign = -1 if t.value == "-" else 1
+                self.advance()
+                t = self.peek()
             if t.kind == "string":
                 raw = self.advance().value.strip()
                 if self.at_kw(*self._INTERVAL_UNITS):
@@ -1083,8 +1195,8 @@ class Parser:
                         m, us, im, it = _apply_unit(value, unit)
                 else:
                     m, us, im, it = _parse_interval_string(raw)
-                total_months += m
-                total_us += us
+                total_months += sign * m
+                total_us += sign * us
                 any_month |= im
                 any_time |= it
             elif t.kind == "number":
@@ -1136,6 +1248,8 @@ class Parser:
             return dt.BinaryType()
         if name == "DATE":
             return dt.DateType()
+        if name == "TIME":
+            return dt.TimeType()
         if name == "TIMESTAMP":
             return dt.TimestampType("UTC")
         if name == "TIMESTAMP_NTZ":

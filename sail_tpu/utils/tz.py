@@ -37,3 +37,54 @@ def session_zone():
 def localize(naive: datetime.datetime) -> datetime.datetime:
     """Interpret a naive timestamp in the session zone → aware."""
     return naive.replace(tzinfo=session_zone())
+
+
+_TRANSITIONS_CACHE = {}
+
+
+def utc_offset_transitions(name: str = None):
+    """(starts_us, offsets_us) numpy arrays for the session zone: UTC→local
+    offset as a step function over 1900–2100. Lets device kernels convert
+    epoch-us to local time with a searchsorted + gather instead of per-row
+    host callbacks (DST-correct, TPU-friendly)."""
+    import numpy as np
+
+    name = name or session_timezone_name()
+    hit = _TRANSITIONS_CACHE.get(name)
+    if hit is not None:
+        return hit
+    zone = (datetime.timezone.utc if name.upper() == "UTC"
+            else zoneinfo.ZoneInfo(name))
+    if zone is datetime.timezone.utc:
+        out = (np.asarray([-(2**62)], dtype=np.int64),
+               np.asarray([0], dtype=np.int64))
+        _TRANSITIONS_CACHE[name] = out
+        return out
+    starts = [-(2**62)]
+    offsets = []
+    t = datetime.datetime(1900, 1, 1, tzinfo=datetime.timezone.utc)
+    end = datetime.datetime(2100, 1, 1, tzinfo=datetime.timezone.utc)
+    cur = zone.utcoffset(t)
+    offsets.append(int(cur.total_seconds() * 1e6))
+    # scan in 6h steps, bisect each change to the exact second
+    step = datetime.timedelta(hours=6)
+    while t < end:
+        nxt = t + step
+        off = zone.utcoffset(nxt)
+        if off != cur:
+            lo, hi = t, nxt
+            while hi - lo > datetime.timedelta(seconds=1):
+                mid = lo + (hi - lo) / 2
+                if zone.utcoffset(mid) != cur:
+                    hi = mid
+                else:
+                    lo = mid
+            epoch = hi.timestamp()
+            starts.append(int(round(epoch)) * 1_000_000)
+            offsets.append(int(off.total_seconds() * 1e6))
+            cur = off
+        t = nxt
+    out = (np.asarray(starts, dtype=np.int64),
+           np.asarray(offsets, dtype=np.int64))
+    _TRANSITIONS_CACHE[name] = out
+    return out

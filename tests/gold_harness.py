@@ -66,8 +66,18 @@ def format_cell(v, nested: bool = False) -> str:
         return s
     if isinstance(v, datetime.date):
         return v.isoformat()
+    if isinstance(v, datetime.time):
+        s = v.strftime("%H:%M:%S")
+        if v.microsecond:
+            s += f".{v.microsecond:06d}".rstrip("0")
+        return s
     if isinstance(v, datetime.timedelta):
         return format_interval(v)
+    if type(v).__name__ == "MonthDayNano":
+        # arrow month_day_nano_interval ⇒ Spark year-month interval format
+        m = v[0]
+        sign = "-" if m < 0 else ""
+        return f"{sign}{abs(m) // 12}-{abs(m) % 12}"
     if isinstance(v, str):
         return f'"{v}"' if nested else v
     if isinstance(v, list) and v and all(
@@ -104,17 +114,17 @@ def format_float(v: float) -> str:
 
 
 def format_interval(td: datetime.timedelta) -> str:
+    """Day-time intervals in the corpus use the generator's Duration
+    format: 'D HH:MM:SS.nnnnnnnnn' (9-digit nanos)."""
     total_us = round(td.total_seconds() * 1e6)
     sign = "-" if total_us < 0 else ""
     total_us = abs(total_us)
     days, rem = divmod(total_us, 86_400_000_000)
     hours, rem = divmod(rem, 3_600_000_000)
     minutes, rem = divmod(rem, 60_000_000)
-    secs = rem / 1e6
-    sec_str = f"{secs:.6f}".rstrip("0").rstrip(".")
-    return (f"{sign}INTERVAL '{days} {hours:02d}:{minutes:02d}:"
-            f"{sec_str if '.' in sec_str else f'{int(secs):02d}'}'"
-            " DAY TO SECOND")
+    secs, us = divmod(rem, 1_000_000)
+    return (f"{sign}{days} {hours:02d}:{minutes:02d}:{secs:02d}"
+            f".{us * 1000:09d}")
 
 
 def run_one(spark, test: dict) -> Tuple[str, Optional[str]]:
