@@ -74,6 +74,27 @@ def _generate_rows(kind: str, args: List, col_names: List[str]
                 out.append(tuple(vals[:n_cols] +
                                  [None] * (n_cols - len(vals))))
         return out
+    if kind == "json_tuple":
+        import json as _json
+        s = args[0]
+        try:
+            v = _json.loads(s) if s is not None else None
+        except ValueError:
+            v = None
+        if not isinstance(v, dict):
+            return [tuple([None] * n_cols)]
+        row = []
+        for key in args[1:]:
+            x = v.get(key)
+            if x is None:
+                row.append(None)
+            elif isinstance(x, (dict, list)):
+                row.append(_json.dumps(x, separators=(",", ":")))
+            elif isinstance(x, bool):
+                row.append("true" if x else "false")
+            else:
+                row.append(str(x))
+        return [tuple(row)]
     if kind == "stack":
         n_rows = int(args[0])
         vals = args[1:]
@@ -1618,6 +1639,14 @@ class LocalExecutor:
                         d, v = wink.shift(ctx, arg, int(opts["offset"]),
                                           lag_defaults.get(j))
                         outs.append((d, v))
+                    elif fnname == "nth_value":
+                        arg = Column(cols[s.arg][0], cols[s.arg][1],
+                                     in_schema[s.arg].dtype)
+                        peer = None
+                        if s.frame_type == "range" or s.frame_lower is None:
+                            peer = wink.peer_group_end(ctx, okbits)
+                        d, v = wink.nth(ctx, arg, int(opts["n"]), peer)
+                        outs.append((d, v))
                     else:
                         fnk = s.function
                         arg = None
@@ -1671,7 +1700,8 @@ class LocalExecutor:
                 d = d.astype(jdt)
             cols[keyn] = Column(d, v, s.out_dtype)
             if s.arg is not None and s.function in ("lag", "lead", "min",
-                                                    "max", "first", "last"):
+                                                    "max", "first", "last",
+                                                    "nth_value"):
                 src = _col_name(s.arg)
                 if extended_dicts and j in extended_dicts:
                     out_dicts[keyn] = extended_dicts[j]

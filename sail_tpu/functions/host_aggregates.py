@@ -245,7 +245,7 @@ _reg("bit_or", lambda ts: ts[0],
 _reg("bit_xor", lambda ts: ts[0],
      lambda vals: _bit_fold(vals, lambda a, b: a ^ b))
 _reg("histogram_numeric", lambda ts: dt.ArrayType(dt.StructType((
-    dt.StructField("x", _D), dt.StructField("y", _D)))),
+    dt.StructField("x", ts[0]), dt.StructField("y", _D)))),
     lambda rows: _histogram([r[0] for r in rows],
                             rows[0][1] if rows else 5), nargs=-1)
 _reg("any_value", lambda ts: ts[0],
@@ -285,6 +285,50 @@ def _listagg_ordered(rows):
 # count_min_sketch lives in sketches.py (Spark-exact serialization)
 
 
+def _try_sum(vals):
+    """Exact python sum; NULL when the result overflows int64 (the device
+    sum wraps, which plain sum() keeps for speed — try_sum must not)."""
+    vals = [v for v in vals if v is not None]
+    if not vals:
+        return None
+    total = sum(vals)
+    if isinstance(total, int) and not (-(2**63) <= total < 2**63):
+        return None
+    return total
+
+
+def _try_avg(vals):
+    vals = [v for v in vals if v is not None]
+    if not vals:
+        return None
+    total = sum(vals)
+    out = total / len(vals)
+    # year-month interval averages must stay in int32 months
+    if all(isinstance(v, int) for v in vals) and \
+            not (-(2**31) <= out < 2**31):
+        return None
+    return out
+
+
+_reg("try_sum", lambda ts: ts[0], _try_sum)
+_reg("try_avg", _t(_D), _try_avg)
+
+
+def _try_avg_ym(vals):
+    """Year-month interval average: the month SUM must fit int32 (Spark's
+    interval arithmetic overflows there first)."""
+    vals = [v for v in vals if v is not None]
+    if not vals:
+        return None
+    total = sum(vals)
+    if not (-(2**31) <= total < 2**31):
+        return None
+    return total / len(vals)
+
+
+_reg("try_avg_ym", lambda ts: ts[0], _try_avg_ym)
+
+
 def _stable_dedup(vals):
     out = []
     for v in vals:
@@ -310,6 +354,7 @@ def _histogram(vals, nbins):
     from collections import Counter
     if not vals:
         return None
+    ints = all(isinstance(v, int) for v in vals)
     xs = sorted(float(v) for v in vals)
     nb = int(nbins)
     counts = Counter(xs)
@@ -322,7 +367,8 @@ def _histogram(vals, nbins):
         total = a[1] + b[1]
         pts[i] = [(a[0] * a[1] + b[0] * b[1]) / total, total]
         del pts[i + 1]
-    return [{"x": x, "y": y} for x, y in pts]
+    # Spark keeps x in the INPUT type: int inputs show integral centroids
+    return [{"x": int(x) if ints else x, "y": y} for x, y in pts]
 
 
 # -- wire UDAFs (pandas grouped-agg UDFs from Spark Connect clients) -----

@@ -200,8 +200,9 @@ def java_to_strftime(pattern: str) -> str:
     return "".join(out)
 
 
-def _java_parse(s: str, pattern: str):
-    """Parse with a Java pattern; naive results take the session zone."""
+def _java_parse(s: str, pattern: str, naive: bool = False):
+    """Parse with a Java pattern; naive results take the session zone
+    (or stay wall time for timestamp_ntz targets with ``naive=True``)."""
     p = java_to_strftime(pattern)
     s = s.strip()
     # %f needs exactly the digits present; strptime handles 1-6 digits
@@ -213,6 +214,8 @@ def _java_parse(s: str, pattern: str):
             t = datetime.datetime.strptime(s, p.replace(".%f", ""))
         except ValueError:
             return None
+    if naive:
+        return t.replace(tzinfo=None)
     if t.tzinfo is None:
         t = t.replace(tzinfo=_session_zone())
     return t.astimezone(_UTC)
@@ -394,8 +397,9 @@ _reg(["to_timestamp", "try_to_timestamp", "to_timestamp_ltz",
       "try_to_timestamp_ltz"], _t(_TS),
      lambda v, *fmt: _to_ts(v) if not fmt else _java_parse(str(v), fmt[0]))
 _reg(["to_timestamp_ntz", "try_to_timestamp_ntz"], _t(_NTZ),
-     lambda v, *fmt: (lambda t: t.replace(tzinfo=None) if t else None)(
-         _to_ts(v) if not fmt else _java_parse(str(v), fmt[0])))
+     lambda v, *fmt: (
+         _java_parse(str(v), fmt[0], naive=True) if fmt else
+         (lambda t: t.replace(tzinfo=None) if t else None)(_to_ts(v))))
 _reg(["date_format"], _t(_S),
      lambda v, fmt: _java_fmt(_to_ts(v), fmt))
 _reg(["from_unixtime"], _t(_S),
@@ -605,6 +609,11 @@ _reg(["try_make_interval"], _t(_S),
 
 def _extract_part(v, part):
     import decimal
+    if isinstance(v, datetime.time):
+        if part == "seconds":
+            return decimal.Decimal(
+                v.second * 1_000_000 + v.microsecond).scaleb(-6)
+        return {"hours": v.hour, "minutes": v.minute}.get(part)
     if isinstance(v, datetime.timedelta):
         total_us = round(v.total_seconds() * 1e6)
         sign = -1 if total_us < 0 else 1
@@ -634,8 +643,9 @@ def _extract_part(v, part):
     table = {"days": t.day, "hours": t.hour, "minutes": t.minute,
              "years": t.year, "months": t.month}
     return table.get(part)
-_reg(["now", "current_timestamp", "localtimestamp", "current_date",
-      "current_timezone"], _t(_TS), None)  # interpreter special-cases
+_reg(["now", "current_timestamp", "localtimestamp"], _t(_TS), None)
+_reg(["current_date"], _t(_DATE), None)  # interpreter special-cases
+_reg(["current_timezone"], _t(_S), None)
 
 
 def _try_date(y, m, d):

@@ -226,7 +226,31 @@ def _sort_array(arr, asc=True):
 
 def _sequence(start, stop, step=None):
     if isinstance(start, datetime.date):
-        raise ValueError("temporal sequence requires an interval step")
+        # temporal sequences step by intervals: timedelta (DT) or int
+        # months (YM, normalized by the host layer)
+        if step is None:
+            raise ValueError("temporal sequence requires an interval step")
+        out = []
+        v = start
+        if isinstance(step, datetime.timedelta):
+            if step == datetime.timedelta():
+                raise ValueError("sequence step must not be 0")
+            fwd = step > datetime.timedelta()
+            is_dt = isinstance(start, datetime.datetime)
+            while (fwd and v <= stop) or (not fwd and v >= stop):
+                out.append(v)
+                nxt = (v if is_dt else datetime.datetime.combine(
+                    v, datetime.time())) + step
+                v = nxt if is_dt else nxt.date()
+            return out
+        months = int(step)
+        if months == 0:
+            raise ValueError("sequence step must not be 0")
+        from .host_datetime import _add_months
+        while (months > 0 and v <= stop) or (months < 0 and v >= stop):
+            out.append(v)
+            v = _add_months(v, months)
+        return out
     if step is None:
         step = 1 if stop >= start else -1
     if step == 0:
@@ -263,8 +287,15 @@ def _map_type(ts):
     return dt.MapType(ks, vs)
 
 
-_reg("map", _map_type,
-     lambda *kv: dict(zip(kv[0::2], kv[1::2])), null_tolerant=True)
+def _make_map(*kv):
+    try:
+        return dict(zip(kv[0::2], kv[1::2]))
+    except TypeError:
+        # unhashable (struct/array) keys: arrow map pair-list form
+        return list(zip(kv[0::2], kv[1::2]))
+
+
+_reg("map", _map_type, _make_map, null_tolerant=True)
 _reg("map_keys", lambda ts: dt.ArrayType(ts[0].key_type if isinstance(
     ts[0], dt.MapType) else dt.NullType()), lambda m: list(m.keys()))
 _reg("map_values", lambda ts: dt.ArrayType(ts[0].value_type if isinstance(
@@ -325,20 +356,34 @@ def _get_json_object(s, path):
         v = _json.loads(s)
     except Exception:  # noqa: BLE001 — malformed JSON → NULL
         return None
-    for part in re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]",
+    wild = False  # a [*] step makes the cursor a list of candidates
+    for part in re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+|\*)\]",
                            path[1:]):
         key, idx = part
         if key:
-            if not isinstance(v, dict) or key not in v:
+            if wild:
+                v = [x[key] for x in v
+                     if isinstance(x, dict) and key in x]
+            else:
+                if not isinstance(v, dict) or key not in v:
+                    return None
+                v = v[key]
+        elif idx == "*":
+            if not isinstance(v, list):
                 return None
-            v = v[key]
+            wild = True
         else:
-            if not isinstance(v, list) or int(idx) >= len(v):
-                return None
-            v = v[int(idx)]
+            if wild:
+                i = int(idx)
+                v = [x[i] for x in v
+                     if isinstance(x, list) and i < len(x)]
+            else:
+                if not isinstance(v, list) or int(idx) >= len(v):
+                    return None
+                v = v[int(idx)]
     if v is None:
         return None
-    if isinstance(v, (dict, list)):
+    if wild or isinstance(v, (dict, list)):
         return _json.dumps(v, separators=(",", ":"))
     if isinstance(v, bool):
         return "true" if v else "false"
@@ -351,9 +396,11 @@ _reg("json_array_length", _t(_I), lambda s: _json_array_length(s),
 _reg("json_object_keys", _t(dt.ArrayType(_S)),
      lambda s: _json_object_keys(s))
 _reg("to_json", _t(_S),
-     lambda v, *opts: _json.dumps(_jsonable(v), separators=(",", ":")))
-_reg("schema_of_json", _t(_S), lambda s, *o: _schema_of_json(s))
-_reg("from_json", lambda ts: dt.NullType(), lambda *a: None)  # typed later
+     lambda v, *opts: _json.dumps(
+         _jsonable(v, dict(opts[0]) if opts and opts[0] else {}),
+         separators=(",", ":")))
+_reg("schema_of_json", _t(_S),
+     lambda s, *o: _schema_of_json(s, dict(o[0]) if o and o[0] else {}))
 
 
 def _json_array_length(s):
@@ -372,19 +419,46 @@ def _json_object_keys(s):
     return list(v.keys()) if isinstance(v, dict) else None
 
 
-def _jsonable(v):
+def _map_key_str(k):
+    """Spark renders non-string map keys in JSON as their value list:
+    struct{a:1} key → '[1]'."""
+    if isinstance(k, dict):
+        return "[" + ", ".join(str(x) for x in k.values()) + "]"
+    if isinstance(k, (list, tuple)):
+        return "[" + ", ".join(str(x) for x in k) + "]"
+    return str(k)
+
+
+def _jsonable(v, opts=None):
+    opts = opts or {}
     if isinstance(v, dict):
-        return {str(k): _jsonable(x) for k, x in v.items()}
+        return {_map_key_str(k): _jsonable(x, opts) for k, x in v.items()}
     if isinstance(v, (list, tuple)):
-        return [_jsonable(x) for x in v]
-    if isinstance(v, (datetime.date, datetime.datetime)):
+        if v and all(isinstance(x, tuple) and len(x) == 2 for x in v):
+            # arrow map columns arrive as (key, value) pair lists
+            return {_map_key_str(k): _jsonable(x, opts) for k, x in v}
+        return [_jsonable(x, opts) for x in v]
+    if isinstance(v, datetime.datetime):
+        fmt = opts.get("timestampFormat")
+        if fmt:
+            from .host_datetime import _java_fmt
+            return _java_fmt(v, fmt)
+        return v.isoformat()
+    if isinstance(v, datetime.date):
+        fmt = opts.get("dateFormat")
+        if fmt:
+            from .host_datetime import _java_fmt, _to_ts
+            return _java_fmt(_to_ts(v), fmt)
         return v.isoformat()
     if hasattr(v, "as_tuple"):  # Decimal
         return float(v)
     return v
 
 
-def _schema_of_json(s):
+def _schema_of_json(s, opts=None):
+    opts = opts or {}
+    if str(opts.get("allowNumericLeadingZeros", "")).lower() == "true":
+        s = re.sub(r"(?<![\d.])0+(\d)", r"\1", s)
     v = _json.loads(s)
 
     def st(x):
@@ -439,7 +513,21 @@ def _parse_url(url, part, key=None):
     return None
 
 
-_reg(["parse_url", "try_parse_url"], _t(_S), _parse_url)
+def _url_valid(url):
+    import re as _re
+    return not _re.search(r"\s", url)
+
+
+_reg(["parse_url"], _t(_S),
+     lambda url, part, *k: (_parse_url(url, part, *k) if _url_valid(url)
+                            else _raise_invalid_url(url)))
+_reg(["try_parse_url"], _t(_S),
+     lambda url, part, *k: (_parse_url(url, part, *k) if _url_valid(url)
+                            else None))
+
+
+def _raise_invalid_url(url):
+    raise ValueError(f"invalid URL {url!r}")
 _reg("url_encode", _t(_S),
      lambda s: urllib.parse.quote_plus(s))
 _reg(["url_decode", "try_url_decode"], _t(_S),
@@ -637,7 +725,11 @@ _reg("factorial", _t(_L),
 
 
 def _width_bucket(v, lo, hi, n):
-    v, lo, hi = float(v), float(lo), float(hi)
+    def num(x):
+        if isinstance(x, datetime.timedelta):
+            return x.total_seconds()
+        return float(x)
+    v, lo, hi = num(v), num(lo), num(hi)
     n = int(n)
     if n <= 0 or lo == hi:
         return None
@@ -674,12 +766,16 @@ _reg(["validate_utf8", "try_validate_utf8"], _t(_S),
 _reg(["locate", "position"], _t(dt.IntegerType()),
      lambda sub, s, *start: (s.find(sub, int(start[0]) - 1 if start
                                     else 0) + 1))
+_reg(["left"], _t0, lambda s, n: s[: max(int(n), 0)])
+_reg(["right"], _t0, lambda s, n: s[-int(n):] if int(n) > 0 else
+     (b"" if isinstance(s, bytes) else ""))
 _reg(["instr"], _t(dt.IntegerType()), lambda s, sub: s.find(sub) + 1)
 
 
 def _pad(s, n, pad, left):
     if isinstance(s, bytes):
-        pad = pad if pad is not None else b" "
+        # Spark pads BINARY with zero bytes by default
+        pad = pad if pad is not None else b"\x00"
         if len(s) >= n:
             return s[:n]
         fill = (pad * n)[: n - len(s)]
@@ -730,7 +826,8 @@ _reg(["format_number"], _t(_S), lambda v, d: _format_number2(v, d))
 def _format_number2(v, d):
     if isinstance(d, str):
         decs = len(d.partition(".")[2].replace(",", "")) if "." in d else 0
-        s = f"{float(v):,.{decs}f}"
+        grouped = "," in d
+        s = f"{float(v):,.{decs}f}" if grouped else f"{float(v):.{decs}f}"
         if "." in s:
             s = s.rstrip("0").rstrip(".")
         return s

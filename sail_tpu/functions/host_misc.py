@@ -38,7 +38,10 @@ _BIN = dt.BinaryType()
 # ---------------------------------------------------------------------------
 
 _reg("nullifzero", _t0, lambda v: None if v == 0 else v)
-_reg("zeroifnull", _t0, lambda v: 0 if v is None else v, null_tolerant=True)
+_reg("zeroifnull",
+     lambda ts: dt.IntegerType() if isinstance(ts[0], dt.NullType)
+     else ts[0],
+     lambda v: 0 if v is None else v, null_tolerant=True)
 _reg("collate", _t0, lambda v, name: v)
 _reg("collation", _t(_S), lambda v: "SYSTEM.BUILTIN.UTF8_BINARY")
 _reg("assert_true", _t(dt.NullType()),
@@ -483,8 +486,11 @@ _reg("to_csv", _t(_S), _to_csv)
 def _avro_type_name(t) -> str:
     if isinstance(t, list):
         non_null = [x for x in t if x != "null"]
-        inner = ", ".join(_avro_type_name(x) for x in non_null)
-        return inner
+        if len(non_null) > 1:  # true unions become member structs
+            inner = ", ".join(f"member{i}: {_avro_type_name(x)}"
+                              for i, x in enumerate(non_null))
+            return f"STRUCT<{inner}>"
+        return _avro_type_name(non_null[0]) if non_null else "VOID"
     if isinstance(t, dict):
         k = t.get("type")
         if k == "record":
@@ -507,6 +513,11 @@ _reg("schema_of_avro", _t(_S),
 _reg("to_avro", _t(_BIN),
      lambda v, *schema: json.dumps(v, default=str).encode())
 _reg("from_avro", _t(_S), lambda b, *a: None)
+# protobuf without a readable descriptor file degrades to NULL (matching
+# the observed gold behavior; real descriptor support is future work)
+_reg("from_protobuf", _t(dt.NullType()), lambda *a: None,
+     null_tolerant=True)
+_reg("to_protobuf", _t(_BIN), lambda *a: None, null_tolerant=True)
 
 
 # ---------------------------------------------------------------------------
@@ -546,6 +557,211 @@ def _wkb_to_text(b: bytes) -> str:
             return str(int(f)) if f == int(f) else str(f)
         return f"POINT ({n(x)} {n(y)})"
     return "GEOMETRY"
+
+
+# ---------------------------------------------------------------------------
+# exact try_* arithmetic + scaled ceil/floor (typed by the resolver)
+# ---------------------------------------------------------------------------
+
+_INT_RANGES = {
+    "tinyint": (-(2**7), 2**7 - 1), "smallint": (-(2**15), 2**15 - 1),
+    "int": (-(2**31), 2**31 - 1), "bigint": (-(2**63), 2**63 - 1),
+}
+
+
+def _try_arith(op, tag, a, b):
+    """Exact host arithmetic with Spark try_ semantics: NULL on overflow,
+    division by zero, or invalid combinations. ``op`` carries a ``_ym``
+    suffix when an operand is a year-month interval (whose values reach
+    the host as plain int months, indistinguishable from integers)."""
+    ym = op.endswith("_ym")
+    if ym:
+        op = op[: -len("_ym")]
+    try:
+        if isinstance(b, (datetime.date, datetime.datetime)) and op == "add":
+            a, b = b, a
+        if isinstance(a, (datetime.date, datetime.datetime)):
+            sign = 1 if op == "add" else -1
+            if isinstance(b, datetime.timedelta):
+                return a + sign * b
+            if isinstance(b, int) and ym:
+                from .host_datetime import _add_months
+                base = a.date() if isinstance(a, datetime.datetime) else a
+                d = _add_months(base, sign * b)
+                if d is None:
+                    return None
+                if isinstance(a, datetime.datetime):
+                    return datetime.datetime.combine(d, a.timetz())
+                return d
+            if isinstance(b, int):
+                return a + datetime.timedelta(days=sign * b)
+            return None
+        if isinstance(a, datetime.timedelta) or isinstance(
+                b, datetime.timedelta):
+            if op in ("add", "subtract"):
+                sign = 1 if op == "add" else -1
+                return a + sign * b
+            td = a if isinstance(a, datetime.timedelta) else b
+            num = b if isinstance(a, datetime.timedelta) else a
+            us = round(td.total_seconds() * 1e6)
+            if op == "multiply":
+                return datetime.timedelta(microseconds=round(us * num))
+            if float(num) == 0:
+                return None
+            return datetime.timedelta(microseconds=round(us / num))
+        # plain numerics (python exact ints / floats / decimals);
+        # year-month intervals are int months with tag 'interval year...'
+        if op == "divide":
+            if float(b) == 0:
+                return None
+            if ym or tag.startswith("interval year"):
+                return int(round(a / b))
+            return float(a) / float(b)
+        r = a + b if op == "add" else (a - b if op == "subtract" else a * b)
+        if ym or tag.startswith("interval"):
+            return int(r)
+        rng = _INT_RANGES.get(tag)
+        if rng is not None and not (rng[0] <= r <= rng[1]):
+            return None
+        return r
+    except (TypeError, ValueError, OverflowError, ArithmeticError):
+        return None
+
+
+_reg("__try_arith", _t0, _try_arith)
+
+
+def _scaled(v, scale, up):
+    from decimal import Decimal, ROUND_CEILING, ROUND_FLOOR
+    d = Decimal(str(v))
+    q = Decimal(1).scaleb(-int(scale))
+    return d.quantize(q, rounding=ROUND_CEILING if up else ROUND_FLOOR)
+
+
+_reg("__ceil_scaled", _t0, lambda v, s: _scaled(v, s, True))
+_reg("__floor_scaled", _t0, lambda v, s: _scaled(v, s, False))
+
+
+# ---------------------------------------------------------------------------
+# typed structured parsers (result types resolved from the schema literal)
+# ---------------------------------------------------------------------------
+
+def _coerce_parsed(v, d, options):
+    from ..spec import data_type as dtt
+    if v is None:
+        return None
+    if isinstance(d, dtt.StructType):
+        if not isinstance(v, dict):
+            return None
+        return {f.name: _coerce_parsed(v.get(f.name), f.data_type, options)
+                for f in d.fields}
+    if isinstance(d, dtt.ArrayType):
+        vals = v if isinstance(v, list) else [v]
+        return [_coerce_parsed(x, d.element_type, options) for x in vals]
+    if isinstance(d, dtt.MapType):
+        if not isinstance(v, dict):
+            return None
+        return {str(k): _coerce_parsed(x, d.value_type, options)
+                for k, x in v.items()}
+    try:
+        if isinstance(d, dtt.TimestampType):
+            fmt = options.get("timestampFormat")
+            if fmt:
+                from ..utils.tz import session_zone
+                from .host_datetime import java_to_strftime
+                out = datetime.datetime.strptime(
+                    str(v).strip(), java_to_strftime(fmt))
+                # naive parses take the session zone, like to_timestamp
+                return out.replace(tzinfo=session_zone())
+            from .host_datetime import _to_ts
+            return _to_ts(v)
+        if isinstance(d, dtt.DateType):
+            fmt = options.get("dateFormat")
+            if fmt:
+                from .host_datetime import java_to_strftime
+                return datetime.datetime.strptime(
+                    str(v).strip(), java_to_strftime(fmt)).date()
+            from .host_datetime import _to_date
+            return _to_date(v)
+        if d.is_integer:
+            return int(str(v).strip())
+        if isinstance(d, (dtt.DoubleType, dtt.FloatType)):
+            return float(v)
+        if isinstance(d, dtt.BooleanType):
+            return str(v).strip().lower() == "true" if not isinstance(
+                v, bool) else v
+        if isinstance(d, dtt.DecimalType):
+            return Decimal(str(v).strip())
+        if isinstance(d, dtt.StringType):
+            return v if isinstance(v, str) else json.dumps(v)
+    except (ValueError, TypeError):
+        return None
+    return v
+
+
+def _parse_schema(ddl: str):
+    from ..spark_connect.convert import schema_from_string
+    from ..sql.parser import parse_data_type
+    try:
+        return parse_data_type(ddl)
+    except Exception:  # noqa: BLE001 — DDL column-list form
+        return schema_from_string(ddl)
+
+
+def _from_json_impl(s, ddl, *opts):
+    options = dict(opts[0]) if opts and opts[0] else {}
+    schema = _parse_schema(ddl)
+    try:
+        v = json.loads(s)
+    except ValueError:
+        return None
+    return _coerce_parsed(v, schema, options)
+
+
+def _xml_to_obj(elem):
+    if not len(elem):
+        return elem.text
+    out = {}
+    for child in elem:
+        v = _xml_to_obj(child)
+        if child.tag in out:
+            if not isinstance(out[child.tag], list):
+                out[child.tag] = [out[child.tag]]
+            out[child.tag].append(v)
+        else:
+            out[child.tag] = v
+    return out
+
+
+def _from_xml_impl(s, ddl, *opts):
+    options = dict(opts[0]) if opts and opts[0] else {}
+    schema = _parse_schema(ddl)
+    try:
+        v = _xml_to_obj(ET.fromstring(s))
+    except ET.ParseError:
+        return None
+    return _coerce_parsed(v, schema, options)
+
+
+def _from_csv_impl(s, ddl, *opts):
+    import csv as _csv
+    options = dict(opts[0]) if opts and opts[0] else {}
+    schema = _parse_schema(ddl)
+    try:
+        row = next(_csv.reader([s]))
+    except StopIteration:
+        row = []
+    from ..spec import data_type as dtt
+    if not isinstance(schema, dtt.StructType):
+        return None
+    v = {f.name: (row[i].strip() if i < len(row) else None)
+         for i, f in enumerate(schema.fields)}
+    return _coerce_parsed(v, schema, options)
+
+
+_reg("from_json", _t(dt.NullType()), _from_json_impl)
+_reg("from_xml", _t(dt.NullType()), _from_xml_impl)
+_reg("from_csv", _t(dt.NullType()), _from_csv_impl)
 
 
 # ---------------------------------------------------------------------------

@@ -19,8 +19,29 @@ _L = dt.LongType()
 _B = dt.BooleanType()
 
 
+_PY_RE_ESCAPES = set("dDwWsSbBAZnrtfv0123456789\\.^$*+?()[]{}|/")
+
+
 def _jre(pattern: str) -> str:
-    return pattern
+    """Java-regex → python re, leniently: escapes python's re rejects
+    (like \\U outside known classes) lose the backslash instead of
+    failing the whole query."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "\\" and i + 1 < len(pattern):
+            nxt = pattern[i + 1]
+            if nxt in _PY_RE_ESCAPES:
+                out.append(c)
+                out.append(nxt)
+            else:
+                out.append(re.escape(nxt))
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 _reg(["split"], _t(dt.ArrayType(_S)),
@@ -54,11 +75,23 @@ _reg(["regexp_replace"], _t(_S),
 _reg(["mask"], _t(_S), lambda s, *a: _mask(s, *a), null_tolerant=True)
 _reg(["printf", "format_string"], _t(_S),
      lambda fmt, *args: _printf(fmt, args), null_tolerant=True)
-_reg(["to_binary", "try_to_binary"], _t(dt.BinaryType()),
+_reg(["to_binary"], _t(dt.BinaryType()),
      lambda s, *f: _to_binary(s, f[0] if f else "hex"))
+_reg(["try_to_binary"], _t(dt.BinaryType()),
+     lambda s, *f: _try_null(_to_binary, s, f[0] if f else "hex"))
 _reg(["to_char", "to_varchar"], _t(_S), lambda v, fmt: _to_char(v, fmt))
-_reg(["to_number", "try_to_number"],
+_reg(["to_number"],
      lambda ts: dt.DecimalType(38, 6), lambda s, fmt: _to_number(s, fmt))
+_reg(["try_to_number"],
+     lambda ts: dt.DecimalType(38, 6),
+     lambda s, fmt: _try_null(_to_number, s, fmt))
+
+
+def _try_null(fn, *args):
+    try:
+        return fn(*args)
+    except Exception:  # noqa: BLE001 — try_ semantics
+        return None
 _reg(["btrim"], _t(_S),
      lambda s, *chars: s.strip(chars[0]) if chars else s.strip())
 _reg(["char_length", "character_length", "len"], _t(_I), lambda s: len(s))
@@ -238,34 +271,111 @@ def _to_binary(s, fmt):
     return None
 
 
+def _split_number_format(fmt):
+    """Oracle-style template → (int positions, dec digits, flags).
+
+    int positions is the template's integer section right-to-left, each
+    element '0', '9', or ',' (G normalized to ',')."""
+    f = fmt.upper().replace("G", ",").replace("D", ".")
+    dollar = "$" in f
+    f = f.replace("$", "")
+    trail_minus = f.endswith("MI")
+    if trail_minus:
+        f = f[:-2]
+    lead_s = f.startswith("S")
+    trail_s = f.endswith("S")
+    f = f.strip("S")
+    ip, _, fp = f.partition(".")
+    return ip, fp, dollar, lead_s, trail_s, trail_minus
+
+
 def _to_char(v, fmt):
-    f = fmt
-    neg = float(v) < 0
-    av = abs(float(v))
-    if "." in f:
-        ip, _, fp = f.partition(".")
-        decs = len(fp)
-    else:
-        ip, decs = f, 0
-    s = f"{av:.{decs}f}"
-    int_part, _, frac = s.partition(".")
-    grouped = ip.count(",") > 0
-    if grouped:
-        int_part = f"{int(int_part):,}"
-    width = len(ip.replace(",", ""))
-    out = int_part + (("." + frac) if decs else "")
-    if neg:
-        out = "-" + out
-    return out
+    import datetime as _dt
+
+    # date/timestamp: to_char == date_format; binary: encoding name
+    if isinstance(v, (_dt.date, _dt.datetime)):
+        from .host_datetime import _java_fmt, _to_ts
+        return _java_fmt(_to_ts(v), fmt)
+    if isinstance(v, bytes):
+        fl = fmt.lower()
+        if fl in ("utf-8", "utf8"):
+            return v.decode("utf-8", errors="replace")
+        if fl == "hex":
+            return v.hex().upper()
+        if fl == "base64":
+            import base64 as b64
+            return b64.b64encode(v).decode()
+        return None
+    ip, fp, dollar, lead_s, trail_s, trail_mi = _split_number_format(fmt)
+    decs = sum(1 for c in fp if c in "09")
+    import decimal as _decm
+    d = _decm.Decimal(str(v)).quantize(
+        _decm.Decimal(1).scaleb(-decs), rounding=_decm.ROUND_HALF_UP)
+    neg = d < 0
+    digits, _, frac = format(abs(d), "f").partition(".")
+    # map integer digits onto the template right-to-left; positions at or
+    # right of the leftmost '0' zero-fill, leading '9' positions stay empty
+    out = []
+    di = len(digits) - 1
+    first_zero = min((i for i, c in enumerate(ip) if c == "0"),
+                     default=None)
+    for i in range(len(ip) - 1, -1, -1):
+        c = ip[i]
+        if c in "09":
+            if di >= 0:
+                out.append(digits[di])
+                di -= 1
+            elif first_zero is not None and i >= first_zero:
+                out.append("0")
+        elif c == ",":
+            more = di >= 0 or (first_zero is not None and first_zero < i)
+            if out and more:
+                out.append(",")
+    if di >= 0:  # digits overflow the template
+        return "#" * len(fmt)
+    body = "".join(reversed(out))
+    if not body:
+        body = "0" if decs == 0 else ""
+    if decs:
+        body += "." + (frac or "").ljust(decs, "0")[:decs]
+    if dollar:
+        body = "$" + body
+    if trail_s:
+        return body + ("-" if neg else "+")
+    if trail_mi:
+        return body + ("-" if neg else " ")
+    return ("-" if neg else "") + body
 
 
 def _to_number(s, fmt):
     import decimal
-    cleaned = s.replace(",", "").replace("$", "").strip()
+
+    ip, fp, dollar, lead_s, trail_s, trail_mi = _split_number_format(fmt)
+    decs = sum(1 for c in fp if c in "09")
+    t = s.strip()
+    neg = False
+    if trail_s or trail_mi:
+        if t.endswith("-"):
+            neg = True
+            t = t[:-1]
+        elif t.endswith("+"):
+            t = t[:-1]
+    if t.startswith("-"):
+        neg = True
+        t = t[1:]
+    elif t.startswith("+"):
+        t = t[1:]
+    if t.startswith("$"):
+        t = t[1:]
+    t = t.replace(",", "")
+    if not re.fullmatch(r"\d*(?:\.\d*)?", t) or not t.strip("."):
+        raise ValueError(f"cannot parse {s!r} with format {fmt!r}")
     try:
-        return decimal.Decimal(cleaned)
+        d = decimal.Decimal(t)
     except decimal.InvalidOperation:
         return None
+    d = d.quantize(decimal.Decimal(1).scaleb(-decs))
+    return -d if neg else d
 
 
 def _soundex(s):

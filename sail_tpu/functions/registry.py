@@ -65,7 +65,7 @@ def avg_result_type(t: dt.DataType) -> dt.DataType:
     return dt.DoubleType()
 
 
-_NUMERIC_BIN = {"+", "-", "*", "/", "%", "div", "pmod", "power", "atan2"}
+_NUMERIC_BIN = {"+", "-", "*", "/", "%", "div", "pmod", "power"}
 _CMP = {"==", "!=", "<", "<=", ">", ">=", "<=>"}
 _BOOL_FNS = {"and", "or", "not", "isnull", "isnotnull", "like", "ilike",
              "rlike", "in", "startswith", "endswith", "contains",
@@ -136,8 +136,24 @@ def infer_function_type(name: str, arg_types: Sequence[dt.DataType]) -> dt.DataT
         return out
     if name in _FLOAT_FNS:
         return dt.DoubleType()
+    if name == "atan2":
+        return dt.DoubleType()
     if name in _INT_FIELD_FNS:
         return dt.IntegerType()
+    # concat/reverse over arrays keep the array type
+    if name == "concat" and any(isinstance(t, dt.ArrayType)
+                                for t in arg_types):
+        out = arg_types[0]
+        for t in arg_types[1:]:
+            if isinstance(t, dt.ArrayType) and isinstance(out, dt.ArrayType):
+                try:
+                    out = dt.ArrayType(dt.common_type(
+                        out.element_type, t.element_type), True)
+                except TypeError:
+                    pass
+        return out
+    if name == "reverse" and isinstance(arg_types[0], dt.ArrayType):
+        return arg_types[0]
     if name in _STRING_FNS:
         return dt.StringType()
     if name in ("abs", "negative"):

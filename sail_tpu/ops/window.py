@@ -185,6 +185,25 @@ def shift(ctx: WindowContext, value: Column, offset: int, default=None):
     return _unsort(ctx, data), _unsort(ctx, validity)
 
 
+def nth(ctx: WindowContext, value: Column, n_th: int, peer_end=None):
+    """nth_value: the value at the n-th row of the frame (frame start =
+    partition start; the default RANGE frame ends at the current row's
+    LAST PEER, so pass peer_end from peer_group_end)."""
+    n = ctx.pos.shape[0]
+    sorted_d = value.data[ctx.perm]
+    sorted_v = value.validity[ctx.perm] if value.validity is not None else None
+    src = ctx.seg_start + (n_th - 1)
+    src_c = jnp.clip(src, 0, n - 1)
+    data = sorted_d[src_c]
+    end = peer_end if peer_end is not None \
+        else ctx.seg_start + ctx.pos
+    validity = (end - ctx.seg_start >= (n_th - 1)) & \
+        (src < ctx.seg_start + ctx.seg_len)
+    if sorted_v is not None:
+        validity = validity & sorted_v[src_c]
+    return _unsort(ctx, data), _unsort(ctx, validity)
+
+
 def framed_agg(ctx: WindowContext, value: Optional[Column], fn: str,
                lower: Optional[int], upper: Optional[int],
                peer_end=None):

@@ -15,6 +15,7 @@ kernels (SURVEY.md §2.6), re-architected for dictionary/HBM execution.
 from __future__ import annotations
 
 import datetime
+import math
 import re
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -1110,8 +1111,10 @@ _NUMERIC_BUILDERS: Dict[str, Callable] = {
     "sinh": _unary_math(jnp.sinh),
     "cosh": _unary_math(jnp.cosh),
     "tanh": _unary_math(jnp.tanh),
-    "degrees": _unary_math(jnp.degrees),
-    "radians": _unary_math(jnp.radians),
+    # multiply by the rounded constant (jnp.radians computes x*pi/180 with
+    # a different association, off by 1 ulp on exact inputs)
+    "degrees": _unary_math(lambda x: x * (180.0 / math.pi)),
+    "radians": _unary_math(lambda x: x * (math.pi / 180.0)),
     "sign": _unary_math(jnp.sign, out_float=False),
     "floor": _unary_math(lambda x: jnp.floor(x).astype(jnp.int64), out_float=True),
     "ceil": _unary_math(lambda x: jnp.ceil(x).astype(jnp.int64), out_float=True),
@@ -1188,6 +1191,8 @@ def _add_months_builder(args, r, opts):
 
 
 def _months_between_builder(args, r, opts):
+    if len(args) != 2:
+        raise HostFallback("months_between with roundOff flag on the host")
     a, b = args
 
     def day_frac(xd, d):

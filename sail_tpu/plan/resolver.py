@@ -563,10 +563,20 @@ class Resolver:
                                            pre_resolved=(child, cscope))
         exprs = []
         fields = []
+        alias_env: Dict[str, rx.Rex] = {}
         for item in items:
             name = self._output_name(item)
-            r = self._resolve_expr(_unalias(item), cscope)
+            try:
+                r = self._resolve_expr(_unalias(item), cscope)
+            except ResolutionError:
+                # lateral column alias: a select item may reference an
+                # EARLIER item's alias (Spark 3.4 semantics)
+                if not alias_env:
+                    raise
+                r = self._resolve_expr(
+                    _subst_alias(_unalias(item), alias_env), cscope)
             exprs.append((name, r))
+            alias_env[name] = r
             fields.append(ScopeField(name, (), rx.rex_type(r), rx.rex_nullable(r)))
         node = pn.ProjectExec(child, tuple(exprs))
         out_scope = Scope(fields, outer, cscope.ctes)
@@ -625,6 +635,9 @@ class Resolver:
             if not isinstance(et, dt.StructType):
                 raise ResolutionError("inline requires array<struct>")
             gcols = [(f.name, f.data_type) for f in et.fields]
+        elif base == "json_tuple":
+            gcols = [(f"c{i}", dt.StringType())
+                     for i in range(len(args) - 1)]
         elif base == "stack":
             if not args or not isinstance(args[0], rx.RLit):
                 raise ResolutionError("stack requires a literal row count")
@@ -730,6 +743,14 @@ class Resolver:
                     raise ResolutionError(
                         f"ntile() bucket count must be positive, got {n_tiles}")
                 options.append(("n", n_tiles))
+            elif fname == "nth_value":
+                arg = self._resolve_expr(f.args[0], cscope)
+                arg_i = add_pre(arg)
+                out_t = rx.rex_type(arg)
+                if len(f.args) < 2 or not isinstance(f.args[1], ex.Literal):
+                    raise ResolutionError(
+                        "nth_value() requires a literal offset")
+                options.append(("n", int(f.args[1].value.value)))
             elif fname in ("lag", "lead"):
                 arg = self._resolve_expr(f.args[0], cscope)
                 arg_i = add_pre(arg)
@@ -1190,6 +1211,8 @@ class Resolver:
         return r
 
     def _resolve_expr(self, e: ex.Expr, scope: Scope) -> rx.Rex:
+        if isinstance(e, _PreRex):
+            return e.rex
         if isinstance(e, ex.Literal):
             return rx.RLit(e.value)
         if isinstance(e, ex.LambdaVariable):
@@ -1249,8 +1272,10 @@ class Resolver:
             fname = {"year": "year", "yearofweek": "year", "quarter": "quarter",
                      "month": "month", "day": "day", "dayofmonth": "day",
                      "week": "weekofyear", "dow": "dayofweek", "doy": "dayofyear",
-                     "hour": "hour", "minute": "minute", "second": "second"}.get(
-                         e.field_name, e.field_name)
+                     "hour": "hour", "minute": "minute",
+                     # EXTRACT(SECOND ...) is fractional (decimal), unlike
+                     # the second() function
+                     "second": "seconds"}.get(e.field_name, e.field_name)
             return self._finish_function(fname, [child])
         if isinstance(e, ex.ScalarSubquery):
             node, _ = self.resolve_query(e.plan, Scope([], None, dict(scope.ctes)),
@@ -1449,9 +1474,10 @@ class Resolver:
                             dt.StructType(tuple(fields)), False)
         if name == "struct":
             fields = tuple(
-                dt.StructField(a.name if isinstance(a, rx.BoundRef)
-                               else f"col{i+1}", rx.rex_type(a),
-                               rx.rex_nullable(a))
+                dt.StructField(a.name if isinstance(
+                    a, (rx.BoundRef, rx.RLambdaVar))
+                    else f"col{i+1}", rx.rex_type(a),
+                    rx.rex_nullable(a))
                 for i, a in enumerate(args))
             return rx.RCall("struct", tuple(args), dt.StructType(fields),
                             False)
@@ -1469,6 +1495,72 @@ class Resolver:
             name = "date_add"
         if name == "date_diff":
             name = "datediff"
+        # schema-carrying parsers: the result type comes from the literal
+        # schema argument (reference: from_json/from_csv/from_xml exprs)
+        if name in ("from_json", "from_csv", "from_xml") and \
+                len(args) >= 2 and isinstance(args[1], rx.RLit):
+            from ..spark_connect.convert import schema_from_string
+            try:
+                sch = str(args[1].value.value)
+                try:
+                    out = sql_parse_data_type(sch)
+                except Exception:  # noqa: BLE001 — fall back to DDL form
+                    out = schema_from_string(sch)
+            except Exception:  # noqa: BLE001 — unparsable schema → null
+                out = dt.NullType()
+            return rx.RCall(name, tuple(args), out, True)
+        # to_number: precision/scale come from the literal format
+        if name in ("to_number", "try_to_number") and len(args) == 2 and \
+                isinstance(args[1], rx.RLit):
+            fmt = str(args[1].value.value).upper()
+            digits = sum(1 for c in fmt if c in "09")
+            sep = "D" if "D" in fmt else "."
+            scale = sum(1 for c in fmt.split(sep, 1)[1] if c in "09") \
+                if sep in fmt else 0
+            return rx.RCall(name, tuple(args),
+                            dt.DecimalType(max(digits, 1), scale), True)
+        # ceil/floor with a target scale return decimals
+        if name in ("ceil", "ceiling", "floor") and len(args) == 2 and \
+                isinstance(args[1], rx.RLit):
+            scale = int(args[1].value.value)
+            base = "ceil" if name != "floor" else "floor"
+            out = dt.DecimalType(38, max(scale, 0))
+            return rx.RCall(f"__{base}_scaled", tuple(args), out, True)
+        # round/bround on decimals shrink the scale to the literal digits
+        if name in ("round", "bround") and len(args) >= 1 and \
+                isinstance(rx.rex_type(args[0]), dt.DecimalType):
+            d0 = rx.rex_type(args[0])
+            digits = 0
+            if len(args) > 1 and isinstance(args[1], rx.RLit):
+                digits = int(args[1].value.value)
+            ns = min(d0.scale, max(digits, 0))
+            out = dt.DecimalType(max(d0.precision - d0.scale + ns, 1), ns)
+            return rx.RCall(name, tuple(args), out,
+                            any(rx.rex_nullable(a) for a in args))
+        # try_* arithmetic: NULL on overflow / type mismatch (host, exact)
+        if name in ("try_add", "try_subtract", "try_multiply",
+                    "try_divide") and len(args) == 2:
+            ats = [rx.rex_type(a) for a in args]
+            out = _try_arith_type(name, ats)
+            if out is not None:
+                opname = name[4:]
+                if any(isinstance(t, dt.YearMonthIntervalType)
+                       for t in ats):
+                    opname += "_ym"
+                op = rx.RLit(LV.string(opname))
+                tag = rx.RLit(LV.string(out.simple_string()))
+                return rx.RCall("__try_arith", (op, tag) + tuple(args),
+                                out, True)
+        # constant-fold power so literal cases are exact (device pow is
+        # exp·log-based)
+        if name in ("power", "pow") and len(args) == 2 and \
+                all(isinstance(a, rx.RLit) and a.value.value is not None
+                    for a in args):
+            try:
+                return rx.RLit(LV.float64(
+                    float(args[0].value.value) ** float(args[1].value.value)))
+            except (OverflowError, ValueError, TypeError):
+                pass
         # date_part/datepart with a literal part → the specific field fn
         if name in ("date_part", "datepart") and len(args) == 2 and \
                 isinstance(args[0], rx.RLit) and \
@@ -1544,6 +1636,80 @@ class Resolver:
                 return rx.RCall("__pyudf", tuple(args), found.return_type, True,
                                 (("udf", found),))
         return self._make_call(name, args)
+
+
+class _PreRex(ex.Expr):
+    """An already-resolved rex smuggled through the spec-expression layer
+    (lateral column alias substitution)."""
+
+    def __init__(self, rex):
+        self.rex = rex
+
+
+def _subst_alias(e, env):
+    """Replace single-part Attributes found in ``env`` with their resolved
+    rex (lateral column aliases)."""
+    if isinstance(e, ex.Attribute) and len(e.name) == 1 and e.name[0] in env:
+        return _PreRex(env[e.name[0]])
+    if dataclasses.is_dataclass(e) and isinstance(e, ex.Expr):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, ex.Expr):
+                nv = _subst_alias(v, env)
+                if nv is not v:
+                    changes[f.name] = nv
+            elif isinstance(v, tuple) and any(
+                    isinstance(x, ex.Expr) for x in v):
+                nv = tuple(_subst_alias(x, env) if isinstance(x, ex.Expr)
+                           else x for x in v)
+                if nv != v:
+                    changes[f.name] = nv
+        if changes:
+            return dataclasses.replace(e, **changes)
+    return e
+
+
+def _try_arith_type(name, ts):
+    """Result type of try_add/subtract/multiply/divide, or None to fall
+    back to the generic path."""
+    a, b = ts
+    op = name[4:]
+    temporal = (dt.DateType, dt.TimestampType)
+    interval = (dt.DayTimeIntervalType, dt.YearMonthIntervalType)
+    if op == "add" and isinstance(b, temporal):
+        a, b = b, a
+    if isinstance(a, temporal):
+        if isinstance(b, dt.YearMonthIntervalType) or (
+                b.is_integer and isinstance(a, dt.DateType)):
+            return a
+        if isinstance(b, dt.DayTimeIntervalType):
+            return a if isinstance(a, dt.TimestampType) else None
+    if isinstance(a, interval) and type(a) == type(b) and \
+            op in ("add", "subtract"):
+        return a
+    if op == "multiply":
+        if isinstance(a, interval) and b.is_numeric:
+            return a
+        if isinstance(b, interval) and a.is_numeric:
+            return b
+    if op == "divide":
+        if isinstance(a, interval) and b.is_numeric:
+            return a
+        if a.is_numeric and b.is_numeric:
+            return dt.DoubleType()
+        return None
+    if a.is_numeric and b.is_numeric:
+        try:
+            return dt.common_type(a, b)
+        except TypeError:
+            return None
+    return None
+
+
+def sql_parse_data_type(text):
+    from ..sql.parser import parse_data_type as _p
+    return _p(text)
 
 
 @dataclasses.dataclass
@@ -1707,11 +1873,21 @@ class _AggCollector:
             raise ResolutionError(f"{fn}() requires an argument")
         arg = args[0]
         at = rx.rex_type(arg)
-        if fn == "sum" or fn == "try_sum":
+        if fn == "sum":
             return self._add_agg("sum", arg, distinct, freg.sum_result_type(at))
+        if fn == "try_sum":
+            # exact host sum with NULL-on-overflow (device sum wraps)
+            return self._add_agg("__host__try_sum", arg, distinct,
+                                 freg.sum_result_type(at))
+        if fn == "try_avg":
+            if isinstance(at, dt.YearMonthIntervalType):
+                return self._add_agg("__host__try_avg_ym", arg, distinct, at)
+            out_ta = at if isinstance(at, dt.DayTimeIntervalType) \
+                else dt.DoubleType()
+            return self._add_agg("__host__try_avg", arg, distinct, out_ta)
         if fn == "count":
             return self._add_agg("count", arg, distinct, dt.LongType())
-        if fn in ("avg", "try_avg"):
+        if fn == "avg":
             s = self._add_agg("sum", arg, distinct, freg.sum_result_type(at))
             c = self._add_agg("count", arg, distinct, dt.LongType())
             return self.resolver._make_call("/", [s, c])
@@ -1720,6 +1896,10 @@ class _AggCollector:
             # Spark default: first/last/any_value RESPECT nulls
             default = True if fn in ("min", "max") else False
             ignore = e.ignore_nulls if e.ignore_nulls is not None else default
+            if fn in ("first", "last", "any_value") and len(e.args) > 1 \
+                    and isinstance(e.args[1], ex.Literal) \
+                    and e.ignore_nulls is None:
+                ignore = bool(e.args[1].value.value)
             return self._add_agg(k, arg, False, at, ignore)
         if fn in ("bool_and", "every"):
             return self._add_agg("bool_and", arg, False, dt.BooleanType())
@@ -1761,7 +1941,8 @@ class _AggCollector:
 # ---------------------------------------------------------------------------
 
 _GENERATORS = {"explode", "explode_outer", "posexplode",
-               "posexplode_outer", "inline", "inline_outer", "stack"}
+               "posexplode_outer", "inline", "inline_outer", "stack",
+               "json_tuple"}
 
 
 def _is_generator(e: ex.Expr) -> bool:

@@ -87,6 +87,12 @@ class Literal:
             return (self.value - datetime.date(1970, 1, 1)).days
         if isinstance(self.data_type, TimestampType):
             v = self.value
+            if self.data_type.timezone is None:
+                # timestamp_ntz stores the WALL time — no zone conversion
+                if v.tzinfo is not None:
+                    v = v.replace(tzinfo=None)
+                return int(v.replace(
+                    tzinfo=datetime.timezone.utc).timestamp() * 1_000_000)
             if v.tzinfo is None:
                 # Spark semantics: naive timestamp literals are interpreted
                 # in the session timezone (spark.sql.session.timeZone)

@@ -718,7 +718,13 @@ class Parser:
             if isinstance(child, ex.Literal) and child.value.data_type.is_numeric \
                     and not isinstance(child.value.value, bool):
                 v = child.value
-                return ex.Literal(LV(v.data_type, -v.value))
+                neg = -v.value
+                # re-narrow: '2147483648' lexes as bigint but -2147483648
+                # is an int literal (Spark parses the sign with the digits)
+                if isinstance(v.data_type, dt.LongType) and \
+                        isinstance(neg, int) and -(2**31) <= neg < 2**31:
+                    return ex.Literal(LV.int32(neg))
+                return ex.Literal(LV(v.data_type, neg))
             return ex.Function("negative", (child,))
         if self.accept_op("+"):
             return self.parse_unary()
@@ -903,7 +909,9 @@ class Parser:
             return ex.Literal(LV.timestamp(v, tz))
         if word == "X" and self.peek(1).kind == "string":
             self.advance()
-            hexs = self.advance().value
+            hexs = self.advance().value.strip()
+            if len(hexs) % 2:
+                hexs = "0" + hexs
             return ex.Literal(LV(dt.BinaryType(), bytes.fromhex(hexs)))
         if word in ("TRUE", "FALSE"):
             self.advance()
@@ -912,7 +920,8 @@ class Parser:
             self.advance()
             return ex.Literal(LV.null())
         if word in ("CURRENT_DATE", "CURRENT_TIMESTAMP", "CURRENT_USER", "CURRENT_CATALOG",
-                    "CURRENT_SCHEMA", "CURRENT_DATABASE", "NOW") and not self.at_op("(", ahead=1):
+                    "CURRENT_SCHEMA", "CURRENT_DATABASE", "NOW",
+                    "CURRENT_TIME") and not self.at_op("(", ahead=1):
             self.advance()
             return ex.Function(word.lower())
         if word in ("ARRAY", "MAP", "STRUCT") and self.at_op("(", ahead=1):
@@ -979,7 +988,8 @@ class Parser:
             self.advance()
             body = self.parse_expr()
             return ex.LambdaFunction(body, (name,))
-        if word in _RESERVED_STOP and word not in ("FIRST", "LAST", "CURRENT") \
+        if word in _RESERVED_STOP and word not in (
+                "FIRST", "LAST", "CURRENT", "LEFT", "RIGHT") \
                 and not self.at_op(".", ahead=1):
             raise self.error(f"unexpected keyword {t.value!r}")
         name_parts = self.parse_qualified_name()
@@ -1309,6 +1319,10 @@ class Parser:
             # split >> into two > for nested generics
             t = self.advance()
             self.tokens.insert(self.i, Token("op", ">", t.pos + 1))
+            return
+        if self.at_op(">>>"):
+            t = self.advance()
+            self.tokens.insert(self.i, Token("op", ">>", t.pos + 1))
             return
         raise self.error("expected '>'")
 
