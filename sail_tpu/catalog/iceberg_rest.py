@@ -1,0 +1,168 @@
+"""Iceberg REST catalog provider.
+
+Reference role: crates/sail-catalog-iceberg/src/{provider,adapter}.rs —
+a client for the Apache Iceberg REST Catalog Open API (config,
+namespaces, tables) adapted onto the CatalogProvider interface. Tables
+resolve to their current metadata location and scan through the engine's
+own Iceberg reader (sail_tpu/lakehouse/iceberg).
+
+Uses only the standard library HTTP client so it works against any
+spec-conformant server (tested in-repo against a fake REST server, the
+same pattern as the KubernetesWorkerManager fake API).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from .manager import TableEntry
+from .provider import CatalogError, CatalogProvider
+
+
+class IcebergRestCatalog(CatalogProvider):
+    def __init__(self, name: str, uri: str, warehouse: Optional[str] = None,
+                 token: Optional[str] = None, prefix: Optional[str] = None,
+                 timeout: float = 30.0):
+        self.name = name
+        self.uri = uri.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+        self.prefix = prefix
+        if self.prefix is None:
+            cfg = self._get("/v1/config",
+                            query={"warehouse": warehouse}
+                            if warehouse else None, default={})
+            overrides = cfg.get("overrides", {}) if isinstance(cfg, dict) else {}
+            self.prefix = overrides.get("prefix", "")
+
+    # -- HTTP ------------------------------------------------------------
+    def _url(self, path: str) -> str:
+        if self.prefix:
+            path = path.replace("/v1/", f"/v1/{self.prefix}/", 1)
+        return self.uri + path
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 query: Optional[dict] = None, default=None):
+        url = self._url(path)
+        if query:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in query.items() if v is not None})
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return default
+            detail = e.read().decode(errors="replace")[:500]
+            raise CatalogError(
+                f"iceberg rest {method} {path}: HTTP {e.code}: {detail}")
+        except urllib.error.URLError as e:
+            raise CatalogError(f"iceberg rest catalog unreachable: {e}")
+
+    def _get(self, path, query=None, default=None):
+        return self._request("GET", path, query=query, default=default)
+
+    # -- databases (namespaces) -----------------------------------------
+    def list_databases(self) -> List[str]:
+        out = self._get("/v1/namespaces", default={"namespaces": []}) or {}
+        return sorted(".".join(ns) for ns in out.get("namespaces", []))
+
+    def database_info(self, name: str) -> Optional[dict]:
+        ns = self._get(f"/v1/namespaces/{_ns(name)}", default=None)
+        if ns is None:
+            return None
+        props = ns.get("properties", {})
+        return {"comment": props.get("comment"),
+                "location": props.get("location"), "properties": props}
+
+    def create_database(self, name, if_not_exists=False, comment=None,
+                        location=None):
+        props = {}
+        if comment:
+            props["comment"] = comment
+        if location:
+            props["location"] = location
+        try:
+            self._request("POST", "/v1/namespaces",
+                          {"namespace": name.split("."),
+                           "properties": props})
+        except CatalogError as e:
+            if "409" in str(e) and if_not_exists:
+                return
+            raise
+
+    def drop_database(self, name, if_exists=False, cascade=False):
+        got = self._request("DELETE", f"/v1/namespaces/{_ns(name)}",
+                            default="__missing__")
+        if got == "__missing__" and not if_exists:
+            raise ValueError(f"database {name!r} not found")
+
+    # -- tables ----------------------------------------------------------
+    def list_tables(self, database: str) -> List[str]:
+        out = self._get(f"/v1/namespaces/{_ns(database)}/tables",
+                        default={"identifiers": []}) or {}
+        return sorted(i["name"] for i in out.get("identifiers", []))
+
+    def get_table(self, database: str, table: str) -> Optional[TableEntry]:
+        got = self._get(f"/v1/namespaces/{_ns(database)}/tables/{table}",
+                        default=None)
+        if got is None:
+            return None
+        meta = got.get("metadata", {})
+        location = got.get("metadata-location") or meta.get("location")
+        if location is None:
+            return None
+        from ..lakehouse.iceberg.table import _iceberg_type_to_spec
+        schema = None
+        try:
+            schemas = meta.get("schemas") or []
+            current = meta.get("current-schema-id")
+            raw = next((s for s in schemas if s.get("schema-id") == current),
+                       schemas[0] if schemas else None)
+            if raw is not None:
+                schema = _iceberg_type_to_spec(raw)
+        except Exception:
+            schema = None
+        # table root (metadata-location points at …/metadata/xxx.json)
+        root = meta.get("location") or location.rsplit("/metadata/", 1)[0]
+        return TableEntry(
+            name=(self.name, database, table), schema=schema,
+            paths=(root,), format="iceberg",
+            options=(("metadata_location", location),))
+
+    def create_table(self, database, entry, replace=False,
+                     if_not_exists=False):
+        from ..lakehouse.iceberg.table import _spec_to_iceberg_schema
+        schema, _ = _spec_to_iceberg_schema(entry.schema)
+        body = {"name": entry.name[-1], "schema": schema}
+        if entry.paths:
+            body["location"] = entry.paths[0]
+        try:
+            self._request("POST",
+                          f"/v1/namespaces/{_ns(database)}/tables", body)
+        except CatalogError as e:
+            if "409" in str(e) and if_not_exists:
+                return
+            raise
+
+    def drop_table(self, database, table, if_exists=False):
+        got = self._request(
+            "DELETE", f"/v1/namespaces/{_ns(database)}/tables/{table}",
+            default="__missing__")
+        if got == "__missing__" and not if_exists:
+            raise ValueError(f"table {table!r} not found")
+
+
+def _ns(name: str) -> str:
+    # multipart namespaces use the 0x1F unit separator per the REST spec
+    return urllib.parse.quote("\x1f".join(name.split(".")), safe="")

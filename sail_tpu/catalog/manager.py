@@ -1,7 +1,11 @@
-"""In-memory catalog manager.
+"""Multi-catalog manager.
 
 Reference role: crates/sail-catalog/src/manager/ (multi-catalog resolution,
-current database, temp views) + crates/sail-catalog-memory.
+current catalog/database, temp views) with pluggable CatalogProvider
+backends (memory, Iceberg REST, HMS, Glue, Unity, OneLake — SURVEY.md
+§2.6). Identifier resolution: ``catalog.db.table`` routes to the named
+provider; 1/2-part names resolve in the current catalog; session temp
+views shadow everything.
 """
 
 from __future__ import annotations
@@ -29,65 +33,81 @@ class TableEntry:
 class CatalogManager:
     def __init__(self):
         from ..functions.udf import UDFRegistry
+        from .provider import MemoryCatalogProvider
         self.current_catalog = "spark_catalog"
         self.current_database = "default"
-        self.databases: Dict[str, dict] = {"default": {}}
-        self.tables: Dict[Tuple[str, str], TableEntry] = {}
+        self.providers: Dict[str, object] = {
+            "spark_catalog": MemoryCatalogProvider("spark_catalog")}
         self.temp_views: Dict[str, TableEntry] = {}
         self.udfs = UDFRegistry()
 
+    # -- provider registry ----------------------------------------------
+    def register_catalog(self, name: str, provider) -> None:
+        provider.name = name
+        self.providers[name.lower()] = provider
+
+    def provider(self, name: Optional[str] = None):
+        key = (name or self.current_catalog).lower()
+        p = self.providers.get(key)
+        if p is None:
+            raise ValueError(f"catalog {key!r} not found")
+        return p
+
+    def list_catalogs(self) -> List[str]:
+        return sorted(self.providers)
+
+    # -- compatibility views of the default provider ---------------------
+    @property
+    def databases(self) -> Dict[str, dict]:
+        return self.provider().databases \
+            if hasattr(self.provider(), "databases") else {}
+
+    @property
+    def tables(self) -> Dict[Tuple[str, str], TableEntry]:
+        p = self.provider()
+        return p.tables if hasattr(p, "tables") else {}
+
     # -- resolution ------------------------------------------------------
-    def _db_and_name(self, name: Tuple[str, ...]) -> Tuple[str, str]:
+    def _route(self, name: Tuple[str, ...]) -> Tuple[object, str, str]:
+        """identifier → (provider, database, table)."""
         parts = [p for p in name]
         if len(parts) == 1:
-            return self.current_database, parts[0].lower()
+            return self.provider(), self.current_database, parts[0].lower()
         if len(parts) == 2:
-            return parts[0].lower(), parts[1].lower()
-        # catalog.db.table — single catalog in v0
-        return parts[-2].lower(), parts[-1].lower()
+            # could be catalog.table? Spark treats 2-part as db.table
+            return self.provider(), parts[0].lower(), parts[1].lower()
+        cat = parts[-3].lower()
+        if cat in self.providers:
+            return self.providers[cat], parts[-2].lower(), parts[-1].lower()
+        return self.provider(), parts[-2].lower(), parts[-1].lower()
 
     def lookup_table(self, name: Tuple[str, ...]) -> Optional[TableEntry]:
         if len(name) == 1 and name[0].lower() in self.temp_views:
             return self.temp_views[name[0].lower()]
-        db, tbl = self._db_and_name(name)
-        return self.tables.get((db, tbl))
+        prov, db, tbl = self._route(name)
+        return prov.get_table(db, tbl)
+
+    def _db_and_name(self, name: Tuple[str, ...]) -> Tuple[str, str]:
+        _, db, tbl = self._route(name)
+        return db, tbl
 
     # -- mutation ---------------------------------------------------------
     def create_database(self, name: str, if_not_exists: bool = False,
                         comment: Optional[str] = None,
                         location: Optional[str] = None):
-        key = name.lower()
-        if key in self.databases:
-            if if_not_exists:
-                return
-            raise ValueError(f"database {name!r} already exists")
-        self.databases[key] = {"comment": comment, "location": location}
+        self.provider().create_database(name, if_not_exists, comment,
+                                        location)
 
     def drop_database(self, name: str, if_exists: bool, cascade: bool):
-        key = name.lower()
-        if key not in self.databases:
-            if if_exists:
-                return
-            raise ValueError(f"database {name!r} not found")
-        tables = [k for k in self.tables if k[0] == key]
-        if tables and not cascade:
-            raise ValueError(f"database {name!r} is not empty")
-        for k in tables:
-            del self.tables[k]
-        del self.databases[key]
+        self.provider().drop_database(name, if_exists, cascade)
 
     def register_table(self, entry: TableEntry, replace: bool = False,
                        if_not_exists: bool = False):
-        db, tbl = self._db_and_name(entry.name)
-        if db not in self.databases:
-            raise ValueError(f"database {db!r} not found")
-        if (db, tbl) in self.tables and not replace:
-            if if_not_exists:
-                return
-            raise ValueError(f"table {'.'.join(entry.name)!r} already exists")
-        self.tables[(db, tbl)] = entry
+        prov, db, _ = self._route(entry.name)
+        prov.create_table(db, entry, replace, if_not_exists)
 
-    def register_temp_view(self, name: str, plan: sp.QueryPlan, replace: bool = True):
+    def register_temp_view(self, name: str, plan: sp.QueryPlan,
+                           replace: bool = True):
         key = name.lower()
         if key in self.temp_views and not replace:
             raise ValueError(f"temp view {name!r} already exists")
@@ -98,18 +118,19 @@ class CatalogManager:
         if len(name) == 1 and name[0].lower() in self.temp_views:
             del self.temp_views[name[0].lower()]
             return
-        db, tbl = self._db_and_name(name)
-        if (db, tbl) not in self.tables:
-            if if_exists:
-                return
-            raise ValueError(f"table {'.'.join(name)!r} not found")
-        del self.tables[(db, tbl)]
+        prov, db, tbl = self._route(name)
+        prov.drop_table(db, tbl, if_exists)
 
     def list_tables(self, database: Optional[str] = None) -> List[TableEntry]:
+        prov = self.provider()
         db = (database or self.current_database).lower()
-        out = [e for (d, _), e in self.tables.items() if d == db]
+        out = []
+        for t in prov.list_tables(db):
+            e = prov.get_table(db, t)
+            if e is not None:
+                out.append(e)
         out.extend(self.temp_views.values())
         return out
 
     def list_databases(self) -> List[str]:
-        return sorted(self.databases)
+        return self.provider().list_databases()

@@ -1,0 +1,121 @@
+"""CatalogProvider interface + the in-memory provider.
+
+Reference role: crates/sail-catalog/src/provider/mod.rs:26-210 — the
+abstraction every external catalog (HMS, Glue, Iceberg REST, Unity,
+OneLake) implements, re-designed as a small Python ABC. Providers expose
+databases and tables; the CatalogManager routes multi-part identifiers to
+a provider and merges session-local temp views on top.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .manager import TableEntry
+
+
+class CatalogError(RuntimeError):
+    pass
+
+
+class CatalogProvider:
+    """One catalog: a namespace of databases each holding tables."""
+
+    name: str = ""
+
+    # -- databases -------------------------------------------------------
+    def list_databases(self) -> List[str]:
+        raise NotImplementedError
+
+    def database_info(self, name: str) -> Optional[dict]:
+        """{comment, location, ...} or None when absent."""
+        raise NotImplementedError
+
+    def create_database(self, name: str, if_not_exists: bool = False,
+                        comment: Optional[str] = None,
+                        location: Optional[str] = None) -> None:
+        raise CatalogError(f"catalog {self.name!r} is read-only")
+
+    def drop_database(self, name: str, if_exists: bool = False,
+                      cascade: bool = False) -> None:
+        raise CatalogError(f"catalog {self.name!r} is read-only")
+
+    # -- tables ----------------------------------------------------------
+    def list_tables(self, database: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_table(self, database: str, table: str) -> Optional[TableEntry]:
+        raise NotImplementedError
+
+    def create_table(self, database: str, entry: TableEntry,
+                     replace: bool = False,
+                     if_not_exists: bool = False) -> None:
+        raise CatalogError(f"catalog {self.name!r} is read-only")
+
+    def drop_table(self, database: str, table: str,
+                   if_exists: bool = False) -> None:
+        raise CatalogError(f"catalog {self.name!r} is read-only")
+
+
+class MemoryCatalogProvider(CatalogProvider):
+    """Default in-memory catalog (reference: crates/sail-catalog-memory)."""
+
+    def __init__(self, name: str = "spark_catalog"):
+        self.name = name
+        self.databases: Dict[str, dict] = {"default": {}}
+        self.tables: Dict[Tuple[str, str], TableEntry] = {}
+
+    def list_databases(self) -> List[str]:
+        return sorted(self.databases)
+
+    def database_info(self, name: str) -> Optional[dict]:
+        return self.databases.get(name.lower())
+
+    def create_database(self, name, if_not_exists=False, comment=None,
+                        location=None):
+        key = name.lower()
+        if key in self.databases:
+            if if_not_exists:
+                return
+            raise ValueError(f"database {name!r} already exists")
+        self.databases[key] = {"comment": comment, "location": location}
+
+    def drop_database(self, name, if_exists=False, cascade=False):
+        key = name.lower()
+        if key not in self.databases:
+            if if_exists:
+                return
+            raise ValueError(f"database {name!r} not found")
+        tables = [k for k in self.tables if k[0] == key]
+        if tables and not cascade:
+            raise ValueError(f"database {name!r} is not empty")
+        for k in tables:
+            del self.tables[k]
+        del self.databases[key]
+
+    def list_tables(self, database: str) -> List[str]:
+        db = database.lower()
+        return sorted(t for (d, t) in self.tables if d == db)
+
+    def get_table(self, database: str, table: str) -> Optional[TableEntry]:
+        return self.tables.get((database.lower(), table.lower()))
+
+    def create_table(self, database, entry, replace=False,
+                     if_not_exists=False):
+        db = database.lower()
+        tbl = entry.name[-1].lower()
+        if db not in self.databases:
+            raise ValueError(f"database {db!r} not found")
+        if (db, tbl) in self.tables and not replace:
+            if if_not_exists:
+                return
+            raise ValueError(f"table {'.'.join(entry.name)!r} already exists")
+        self.tables[(db, tbl)] = entry
+
+    def drop_table(self, database, table, if_exists=False):
+        key = (database.lower(), table.lower())
+        if key not in self.tables:
+            if if_exists:
+                return
+            raise ValueError(f"table {table!r} not found")
+        del self.tables[key]

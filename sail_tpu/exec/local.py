@@ -36,6 +36,10 @@ from ..spec import data_type as dt
 from ..spec.literal import Literal as LV
 
 
+class _NativeMiss(Exception):
+    """Native fast-path declined; discards its telemetry span."""
+
+
 class ExecutionError(RuntimeError):
     pass
 
@@ -920,6 +924,28 @@ class LocalExecutor:
         # report the pipeline as one fused operator — profiling must
         # measure the program that actually runs, not an unfused variant.
         chain, child, bottom_node = self._pipeline_chain(p.input)
+        # CPU fallback fast path: fused C++ row loop over host buffers
+        # (one pass for all aggregates; see sail_tpu/native/)
+        from .. import native as _native
+        if tel.current_collector() is not None:
+            if _native.native_active():
+                try:
+                    with tel.operator_span("NativeFusedAggregate",
+                                           "fused C++ host kernel") as m:
+                        native = _native.try_native_agg(
+                            self, p, chain, child, bottom_node)
+                        if native is None:
+                            raise _NativeMiss()  # discard the span
+                        m.output_rows = int(native.device.num_rows())
+                        m.capacity = native.capacity
+                        return native
+                except _NativeMiss:
+                    pass
+        else:
+            native = _native.try_native_agg(self, p, chain, child,
+                                            bottom_node)
+            if native is not None:
+                return native
         if tel.current_collector() is not None and chain:
             ops = "+".join(type(c).__name__ for c in chain)
             try:

@@ -120,9 +120,11 @@ def _pkcs_pad(data: bytes) -> bytes:
 
 
 def _pkcs_unpad(data: bytes) -> bytes:
-    if not data or data[-1] < 1 or data[-1] > 16:
+    p = data[-1] if data else 0
+    if (not data or len(data) % 16 or p < 1 or p > 16
+            or data[-p:] != bytes([p]) * p):
         raise ValueError("bad PKCS padding")
-    return data[: -data[-1]]
+    return data[:-p]
 
 
 def _ctr_blocks(rounds, nr, j0: bytes, n_blocks: int):

@@ -298,16 +298,12 @@ def _try_sum(vals):
 
 
 def _try_avg(vals):
+    # Year-month intervals route through _try_avg_ym, which owns the
+    # int32 overflow rule; plain numeric averages never overflow to NULL.
     vals = [v for v in vals if v is not None]
     if not vals:
         return None
-    total = sum(vals)
-    out = total / len(vals)
-    # year-month interval averages must stay in int32 months
-    if all(isinstance(v, int) for v in vals) and \
-            not (-(2**31) <= out < 2**31):
-        return None
-    return out
+    return sum(vals) / len(vals)
 
 
 _reg("try_sum", lambda ts: ts[0], _try_sum)

@@ -959,7 +959,10 @@ def hash_value(v, t, seed, variant):
         if isinstance(v, datetime.datetime):
             if v.tzinfo is None:
                 v = v.replace(tzinfo=datetime.timezone.utc)
-            v = int(v.timestamp() * 1_000_000)
+            # integer micros via timedelta floor-div: float .timestamp()
+            # carries ~0.24us representation error in the current era
+            epoch = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+            v = (v - epoch) // datetime.timedelta(microseconds=1)
         return (_mm3_hash_long(int(v), seed) if variant == "mm3"
                 else _xxh64_long(int(v), seed))
     v = int(v)

@@ -296,6 +296,10 @@ def _bitmap_construct(rows):
         if v is None:
             continue
         p = int(v)
+        if not 0 <= p < _BITMAP_BYTES * 8:
+            raise ValueError(
+                "Bitmap position %d exceeds the bound %d"
+                % (p, _BITMAP_BYTES * 8))
         out[p // 8] |= 1 << (p % 8)
     return bytes(out)
 
@@ -348,7 +352,8 @@ class JavaRandom:
         while True:
             u = self._next(31)
             r = u % bound
-            if u - r + (bound - 1) >= 0:
+            # Java's overflow-rejection check runs in wrapping int32
+            if ((u - r + (bound - 1)) & 0xFFFFFFFF) < 1 << 31:
                 return r
 
 

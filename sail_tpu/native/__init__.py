@@ -1,0 +1,190 @@
+"""Native (C++) host-kernel execution for the CPU fallback path.
+
+The TPU compute path is XLA; when the engine runs on host CPUs (local
+dev, driver-resident stages, no-accelerator deployments) the hot
+aggregation pipeline JIT-compiles to a fused C++ row loop instead, which
+makes one pass over memory where XLA CPU makes one scatter pass per
+aggregate. Reference role: the vectorized native operator layer
+(DataFusion's Rust aggregates, SURVEY.md §2.4-2.5).
+
+Entry point: ``try_native_agg`` — returns a HostBatch or None (fall back
+to the jitted device path). Zero-copy over the batch's CPU buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from . import cc
+from .agg_codegen import AggCodegen, NativeUnsupported
+
+_C_PTR = ctypes.POINTER(ctypes.c_void_p)
+
+# plan shapes the translator already rejected (avoid re-binding per query)
+_REJECTED: set = set()
+
+
+def _np_of(jarr) -> np.ndarray:
+    a = np.asarray(jarr)
+    if not a.flags["C_CONTIGUOUS"]:
+        a = np.ascontiguousarray(a)
+    return a
+
+
+def native_active() -> bool:
+    import jax
+    if not cc.enabled():
+        return False
+    try:
+        if jax.default_backend() != "cpu":
+            return False
+    except Exception:
+        return False
+    return cc.available()
+
+
+def try_native_agg(executor, p, chain, child, bottom_node):
+    """Attempt the fused native aggregate; None → caller falls back."""
+    if not native_active():
+        return None
+    from ..exec.local import _OP_CACHE, _col_name
+    bottom_schema = bottom_node.schema
+    dev = child.device
+    validity_present = tuple(
+        dev.columns[_col_name(i)].validity is not None
+        for i in range(len(bottom_schema)))
+
+    key = executor._op_key(
+        "native_agg",
+        tuple((type(n).__name__,
+               n.condition if hasattr(n, "condition") else n.exprs)
+              for n in chain),
+        p.group_indices, p.aggs, validity_present,
+        tuple((f.name, f.dtype) for f in bottom_schema))
+    if key is None or key in _REJECTED:
+        return None
+
+    def builder():
+        comp = executor._compiler(child, bottom_schema)
+
+        def fold_const(r):
+            try:
+                compiled = comp.compile(r)
+                d, v = compiled.fn([])
+                if v is not None and not bool(np.asarray(v)[0]):
+                    return (None, compiled.dtype)
+                if compiled.dictionary is not None:
+                    return (compiled.dictionary[0].as_py(), compiled.dtype)
+                return (np.asarray(d)[0].item(), compiled.dtype)
+            except Exception:
+                return None
+
+        dicts = {i: d for i, d in (
+            (i, child.dicts.get(_col_name(i)))
+            for i in range(len(bottom_schema))) if d is not None}
+        gen = AggCodegen(p, chain, bottom_schema, dicts,
+                         validity_present, fold_const)
+        source, meta = gen.build()
+        lib = cc.compile_and_load(source)
+        fn = lib.run
+        fn.restype = None
+        meta["args"] = gen.args
+        meta["luts"] = gen.luts  # keep LUT arrays alive with the entry
+        return fn, meta
+
+    try:
+        # NOT _jitted: the compiled kernel is a ctypes fn, not a jax fn
+        fn, meta = _OP_CACHE.get(key, executor._dict_objs(child), builder)
+    except NativeUnsupported:
+        _REJECTED.add(key)
+        return None
+    except RuntimeError:
+        _REJECTED.add(key)
+        return None  # toolchain failure: fall back to the device path
+    return _run(fn, meta, p, child, bottom_schema)
+
+
+def _run(fn, meta, p, child, bottom_schema):
+    import jax.numpy as jnp
+
+    from ..columnar.batch import HostBatch, make_batch
+    from ..exec.local import _col_name
+    from ..spec import data_type as dt
+
+    dev = child.device
+    n = dev.capacity
+    ptrs = []
+    keepalive = []
+    for kind, payload in meta["args"]:
+        if kind == "col":
+            a = _np_of(dev.columns[_col_name(payload)].data)
+        elif kind == "validity":
+            a = _np_of(dev.columns[_col_name(payload)].validity)
+        elif kind == "sel":
+            a = _np_of(dev.sel)
+        else:  # lut
+            a = payload
+        keepalive.append(a)
+        ptrs.append(a.ctypes.data_as(ctypes.c_void_p))
+    arr_t = ctypes.c_void_p * len(ptrs)
+    data = arr_t(*[pt.value for pt in ptrs])
+
+    nseg, nf, ni, na = meta["nseg"], meta["nf"], meta["ni"], meta["na"]
+    accd = np.zeros(nseg * nf, dtype=np.float64)
+    acci = np.zeros(nseg * ni, dtype=np.int64)
+    cnt_rows = np.zeros(nseg, dtype=np.int64)
+    cnt_nn = np.zeros(nseg * na, dtype=np.int64)
+    fn(data, ctypes.c_int64(n),
+       accd.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+       acci.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+       cnt_rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+       cnt_nn.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+
+    if p.group_indices:
+        exists = np.flatnonzero(cnt_rows > 0)
+    else:
+        exists = np.asarray([0])  # global aggregate: always one row
+    ngroups = len(exists)
+    accd = accd.reshape(nseg, nf)[exists]
+    acci = acci.reshape(nseg, ni)[exists]
+    cnt_nn = cnt_nn.reshape(nseg, na)[exists]
+
+    in_schema = p.input.schema
+    columns = {}
+    out_dicts = {}
+    domains, strides = meta["domains"], meta["strides"]
+    key_vals = meta["key_vals"]
+    seg = exists.copy()
+    for k, gi in enumerate(p.group_indices):
+        d, s = domains[k], strides[k]
+        code = (seg // s) % (d + 1)
+        seg_valid = code != d
+        kv = key_vals[k]
+        f = in_schema[gi]
+        if isinstance(kv.dtype, dt.BooleanType) and kv.dictionary is None:
+            values = code.astype(bool)
+        else:
+            values = code.astype(np.int32)
+            out_dicts[_col_name(k)] = kv.dictionary
+        validity = None if seg_valid.all() else seg_valid
+        columns[_col_name(k)] = (values, validity, f.dtype)
+
+    nk = len(p.group_indices)
+    for j, (a, m) in enumerate(zip(p.aggs, meta["agg_meta"])):
+        kind, off = m["slot"]
+        raw = accd[:, off] if kind == "f64" else acci[:, off]
+        out_dtype = a.out_dtype
+        npdt = np.dtype(out_dtype.physical_dtype or "int64")
+        values = raw.astype(npdt)
+        if a.fn == "count":
+            validity = None
+        else:
+            nonnull = cnt_nn[:, j] > 0
+            validity = None if nonnull.all() else nonnull
+        columns[_col_name(nk + j)] = (values, validity, out_dtype)
+
+    batch = make_batch(columns, ngroups)
+    return HostBatch(batch, out_dicts)

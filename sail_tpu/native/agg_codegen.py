@@ -1,0 +1,788 @@
+"""Fused filter→project→group-aggregate C++ codegen.
+
+Translates a pipeline chain (Scan → Filter/Project… → Aggregate with
+direct-binned group keys) into ONE C++ row loop compiled by cc.py and run
+over the batch's host buffers zero-copy. This is the CPU-fallback hot path:
+one pass over memory with all aggregates accumulated together, where the
+XLA CPU backend would run one scatter pass per aggregate.
+
+Reference role: DataFusion's vectorized hash-aggregate + fused filter
+(crates/sail-physical-plan, SURVEY.md §2.4); semantics mirror
+plan/compiler.py's device kernels exactly (decimal scale alignment,
+Spark null rules, dictionary-code string ops via bind-time LUTs).
+
+Raises NativeUnsupported for anything outside the supported subset; the
+executor falls back to the jitted device path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..plan import nodes as pn
+from ..plan import rex as rx
+from ..plan.compiler import like_pattern_to_regex
+from ..spec import data_type as dt
+
+
+class NativeUnsupported(Exception):
+    pass
+
+
+def _u(msg):
+    raise NativeUnsupported(msg)
+
+
+# C scalar types by physical dtype
+_CTYPES = {"int8": "int8_t", "int16": "int16_t", "int32": "int32_t",
+           "int64": "int64_t", "float32": "float", "float64": "double",
+           "bool": "uint8_t"}
+
+
+def _ctype_of(d: dt.DataType) -> str:
+    name = d.physical_dtype
+    if name is None or name not in _CTYPES:
+        _u(f"no native representation for {d.simple_string()}")
+    return _CTYPES[name]
+
+
+def _is_str(d):
+    return isinstance(d, (dt.StringType, dt.BinaryType))
+
+
+def _dec_scale(d) -> Optional[int]:
+    if isinstance(d, dt.DecimalType) and d.physical_dtype == "int64":
+        return d.scale
+    return None
+
+
+def _is_float(d) -> bool:
+    return d.physical_dtype in ("float32", "float64")
+
+
+def _is_int(d) -> bool:
+    return d.physical_dtype in ("int8", "int16", "int32", "int64")
+
+
+class Val:
+    """An emitted C expression: code string + validity expression (None =
+    always valid) + logical dtype + optional string dictionary."""
+
+    __slots__ = ("code", "valid", "dtype", "dictionary")
+
+    def __init__(self, code, valid, dtype, dictionary=None):
+        self.code = code
+        self.valid = valid
+        self.dtype = dtype
+        self.dictionary = dictionary
+
+
+def _vand(*vs) -> Optional[str]:
+    parts = [v for v in vs if v is not None]
+    if not parts:
+        return None
+    return "(" + " && ".join(parts) + ")"
+
+
+class AggCodegen:
+    """Builds the C++ source + argument plan for one fused aggregate."""
+
+    def __init__(self, p: pn.AggregateExec, chain: List[pn.PlanNode],
+                 bottom_schema: pn.Schema, dicts: Dict[int, object],
+                 validity_present: Tuple[bool, ...], fold_const):
+        self.p = p
+        self.chain = chain
+        self.bottom_schema = bottom_schema
+        self.dicts = dicts                  # bottom column idx -> pa.Array
+        self.validity_present = validity_present
+        self.fold_const = fold_const        # rex -> (python value, dtype) | None
+        self.stmts: List[str] = []          # per-row statements
+        self.args: List[Tuple[str, object]] = []  # ordered array args
+        self.luts: List[np.ndarray] = []    # bind-time lookup tables
+        self._tmp = 0
+        self._arg_slot: Dict[object, int] = {}
+
+    # ---------------- argument slots ----------------
+    def _slot(self, kind, payload) -> int:
+        key = (kind, payload if kind != "lut" else id(payload))
+        if key in self._arg_slot:
+            return self._arg_slot[key]
+        slot = len(self.args)
+        self.args.append((kind, payload))
+        self._arg_slot[key] = slot
+        return slot
+
+    def _col_ptr(self, idx: int, ctype: str) -> str:
+        slot = self._slot("col", idx)
+        return f"((const {ctype}*)data[{slot}])"
+
+    def _validity_ptr(self, idx: int) -> str:
+        slot = self._slot("validity", idx)
+        return f"((const uint8_t*)data[{slot}])"
+
+    def _lut_ptr(self, arr: np.ndarray, ctype: str) -> str:
+        self.luts.append(arr)
+        slot = self._slot("lut", arr)
+        return f"((const {ctype}*)data[{slot}])"
+
+    def _fresh(self, prefix="t") -> str:
+        self._tmp += 1
+        return f"{prefix}{self._tmp}"
+
+    # ---------------- expression emission ----------------
+    def emit(self, r: rx.Rex, env: Dict[int, Val]) -> Val:
+        folded = self._try_fold(r)
+        if folded is not None:
+            return folded
+        if isinstance(r, rx.BoundRef):
+            v = env.get(r.index)
+            if v is None:
+                _u(f"unbound column {r.index}")
+            return v
+        if isinstance(r, rx.RLit):
+            return self._emit_literal(r)
+        if isinstance(r, rx.RCast):
+            return self._emit_cast(r, env)
+        if isinstance(r, rx.RCase):
+            return self._emit_case(r, env)
+        if isinstance(r, rx.RCall):
+            return self._emit_call(r, env)
+        _u(f"cannot emit {type(r).__name__}")
+
+    def _try_fold(self, r: rx.Rex) -> Optional[Val]:
+        if isinstance(r, (rx.BoundRef, rx.RLit)):
+            return None
+        if any(isinstance(n, (rx.BoundRef, rx.RLambda, rx.RLambdaVar))
+               for n in rx.walk(r)):
+            return None
+        got = self.fold_const(r)
+        if got is None:
+            return None
+        value, dtype = got
+        if value is None:
+            return Val("0", "false", dtype)
+        if _is_str(dtype):
+            import pyarrow as pa
+            return Val("0", None, dtype, pa.array([value]))
+        return Val(self._const(value, dtype), None, dtype)
+
+    @staticmethod
+    def _const(v, d: dt.DataType) -> str:
+        if isinstance(d, dt.BooleanType):
+            return "1" if v else "0"
+        if _is_float(d):
+            return repr(float(v))
+        return f"{int(v)}LL"
+
+    def _emit_literal(self, r: rx.RLit) -> Val:
+        v = r.value
+        d = v.data_type
+        if v.is_null:
+            return Val("0", "false", d)
+        if _is_str(d):
+            import pyarrow as pa
+            return Val("0", None, d, pa.array([v.value]))
+        pv = v.physical_value()
+        if isinstance(pv, (bool, int, float)):
+            return Val(self._const(pv, d), None, d)
+        _u(f"literal {type(pv).__name__}")
+
+    # cast semantics mirror plan/compiler.py::_compile_cast
+    def _emit_cast(self, r: rx.RCast, env) -> Val:
+        child = self.emit(r.child, env)
+        src, dst = child.dtype, r.dtype
+        if src == dst:
+            return child
+        if _is_str(src) or _is_str(dst):
+            if _is_str(src) and child.dictionary is not None \
+                    and not _is_str(dst):
+                return self._dict_lut_cast(child, dst)
+            _u("string cast")
+        ss, ds_ = _dec_scale(src), _dec_scale(dst)
+        x = child.code
+        if ss is not None and ds_ is None:
+            x = f"((double)({x}) / {10.0 ** ss!r})"
+            src_f = True
+        else:
+            src_f = _is_float(src)
+        if ds_ is not None:
+            if ss is not None:
+                if ds_ >= ss:
+                    x = f"(({x}) * {10 ** (ds_ - ss)}LL)"
+                else:
+                    f = 10 ** (ss - ds_)
+                    t = self._fresh("c")
+                    self.stmts.append(f"int64_t {t} = {x};")
+                    x = (f"({t} >= 0 ? ({t} + {f // 2}LL) / {f}LL"
+                         f" : -((-{t} + {f // 2}LL) / {f}LL))")
+            elif src_f:
+                t = self._fresh("c")
+                self.stmts.append(
+                    f"double {t} = ({x}) * {10.0 ** ds_!r};")
+                x = (f"(int64_t)({t} >= 0 ? floor({t} + 0.5)"
+                     f" : -floor(-{t} + 0.5))")
+            else:
+                x = f"((int64_t)({x}) * {10 ** ds_}LL)"
+            return Val(x, child.valid, dst)
+        ct = _ctype_of(dst)
+        if isinstance(dst, dt.BooleanType):
+            return Val(f"(({x}) != 0)", child.valid, dst)
+        return Val(f"(({ct})({x}))", child.valid, dst)
+
+    def _dict_lut_cast(self, child: Val, dst: dt.DataType) -> Val:
+        from ..plan.compiler import _dict_strings, _parse_string_value
+        vals = _dict_strings(child.dictionary)
+        out, ok = [], []
+        for s in vals:
+            v, good = _parse_string_value(s, dst)
+            out.append(v)
+            ok.append(good)
+        npdt = np.dtype(dst.physical_dtype or "int64")
+        lutp = self._lut_ptr(np.asarray(out, dtype=npdt), _CTYPES[npdt.name])
+        okp = self._lut_ptr(np.asarray(ok, dtype=np.uint8), "uint8_t")
+        code = f"{lutp}[{child.code}]"
+        valid = _vand(child.valid, f"{okp}[{child.code}]")
+        return Val(code, valid, dst)
+
+    def _emit_case(self, r: rx.RCase, env) -> Val:
+        if _is_str(r.dtype):
+            _u("string CASE")
+        ct = _ctype_of(r.dtype)
+        out = self._fresh("cs")
+        okv = f"{out}_ok"
+        self.stmts.append(f"{ct} {out} = 0; bool {okv} = false;")
+        closes = 0
+        for cond, val in r.branches:
+            c = self.emit(cond, env)
+            cc = _vand(c.valid, f"(bool)({c.code})") or f"(bool)({c.code})"
+            v = self.emit(val, env)
+            self.stmts.append(f"if ({cc}) {{ {out} = ({ct})({v.code}); "
+                              f"{okv} = {v.valid or 'true'}; }} else {{")
+            closes += 1
+        if r.else_value is not None:
+            v = self.emit(r.else_value, env)
+            self.stmts.append(f"{out} = ({ct})({v.code}); "
+                              f"{okv} = {v.valid or 'true'};")
+        self.stmts.append("}" * closes)
+        return Val(out, okv, r.dtype)
+
+    # ---------------- calls ----------------
+    _CMP = {"==": "==", "!=": "!=", "<": "<", "<=": "<=",
+            ">": ">", ">=": ">="}
+
+    def _emit_call(self, r: rx.RCall, env) -> Val:
+        name = r.fn
+        if name in ("and", "or"):
+            return self._emit_kleene(name, r, env)
+        if name == "not":
+            a = self.emit(r.args[0], env)
+            return Val(f"(!(bool)({a.code}))", a.valid, dt.BooleanType())
+        if name == "isnull":
+            a = self.emit(r.args[0], env)
+            return Val(f"(!({a.valid or 'true'}))", None, dt.BooleanType())
+        if name == "isnotnull":
+            a = self.emit(r.args[0], env)
+            return Val(f"({a.valid or 'true'})", None, dt.BooleanType())
+        args = [self.emit(a, env) for a in r.args]
+        str_args = [a for a in args if _is_str(a.dtype)]
+        if str_args:
+            return self._emit_string_call(name, r, args)
+        if name in self._CMP:
+            return self._emit_cmp(name, args, r)
+        if name in ("+", "-", "*"):
+            return self._emit_arith(name, args, r)
+        if name == "/":
+            return self._emit_div(args)
+        if name == "in":
+            return self._emit_in(args)
+        if name in ("if",):
+            c, t, f = args
+            code = (f"((bool)({c.code}) && {c.valid or 'true'} ? "
+                    f"({t.code}) : ({f.code}))")
+            valid = None
+            if t.valid is not None or f.valid is not None:
+                valid = (f"((bool)({c.code}) && {c.valid or 'true'} ? "
+                         f"({t.valid or 'true'}) : ({f.valid or 'true'}))")
+            return Val(code, valid, r.dtype)
+        if name == "coalesce":
+            return self._emit_coalesce(args, r)
+        if name in ("year", "month", "day", "dayofmonth", "quarter"):
+            return self._emit_date_field(name, args[0], r)
+        if name in ("negative", "abs"):
+            a = args[0]
+            if name == "negative":
+                return Val(f"(-({a.code}))", a.valid, r.dtype)
+            fn = "fabs" if _is_float(a.dtype) else "llabs"
+            return Val(f"({fn}({a.code}))", a.valid, r.dtype)
+        _u(f"function {name!r}")
+
+    def _emit_kleene(self, name, r, env) -> Val:
+        a = self.emit(r.args[0], env)
+        b = self.emit(r.args[1], env)
+        if a.valid is None and b.valid is None:
+            op = "&&" if name == "and" else "||"
+            return Val(f"((bool)({a.code}) {op} (bool)({b.code}))", None,
+                       dt.BooleanType())
+        ad, av = f"(bool)({a.code})", a.valid or "true"
+        bd, bv = f"(bool)({b.code})", b.valid or "true"
+        t = self._fresh("k")
+        if name == "and":
+            # false if either side is definitively false
+            self.stmts.append(
+                f"bool {t}_af = ({av}) && !({ad});"
+                f" bool {t}_bf = ({bv}) && !({bd});"
+                f" bool {t}_ok = {t}_af || {t}_bf || (({av}) && ({bv}));"
+                f" bool {t} = !({t}_af || {t}_bf) && ({ad}) && ({bd});")
+        else:
+            self.stmts.append(
+                f"bool {t}_at = ({av}) && ({ad});"
+                f" bool {t}_bt = ({bv}) && ({bd});"
+                f" bool {t}_ok = {t}_at || {t}_bt || (({av}) && ({bv}));"
+                f" bool {t} = {t}_at || {t}_bt;")
+        return Val(t, f"{t}_ok", dt.BooleanType())
+
+    def _align_decimals(self, a: Val, b: Val) -> Tuple[str, str, bool]:
+        """Scale-align two numeric operands (mirrors _binary_numeric)."""
+        sa, sb = _dec_scale(a.dtype), _dec_scale(b.dtype)
+        x, y = a.code, b.code
+        if sa is None and sb is None:
+            if _is_float(a.dtype) or _is_float(b.dtype):
+                return f"((double)({x}))", f"((double)({y}))", True
+            return x, y, False
+        s = max(sa or 0, sb or 0)
+        fa, fb = _is_float(a.dtype), _is_float(b.dtype)
+        if fa or fb:
+            xs = x if sa is None else f"((double)({x}) / {10.0 ** sa!r})"
+            ys = y if sb is None else f"((double)({y}) / {10.0 ** sb!r})"
+            return f"((double)({xs}))", f"((double)({ys}))", True
+        if sa is not None:
+            x = f"(({x}) * {10 ** (s - sa)}LL)" if s > sa else f"({x})"
+        else:
+            x = f"((int64_t)({x}) * {10 ** s}LL)"
+        if sb is not None:
+            y = f"(({y}) * {10 ** (s - sb)}LL)" if s > sb else f"({y})"
+        else:
+            y = f"((int64_t)({y}) * {10 ** s}LL)"
+        return x, y, False
+
+    def _emit_cmp(self, name, args, r) -> Val:
+        a, b = args
+        x, y, _ = self._align_decimals(a, b)
+        return Val(f"(({x}) {self._CMP[name]} ({y}))",
+                   _vand(a.valid, b.valid), dt.BooleanType())
+
+    def _emit_arith(self, name, args, r) -> Val:
+        a, b = args
+        valid = _vand(a.valid, b.valid)
+        sa, sb = _dec_scale(a.dtype), _dec_scale(b.dtype)
+        so = _dec_scale(r.dtype)
+        ct = _ctype_of(r.dtype)
+        if name in ("+", "-"):
+            x, y, _ = self._align_decimals(a, b)
+            return Val(f"(({ct})(({x}) {name} ({y})))", valid, r.dtype)
+        # multiply: raw product then half-up rescale (compiler.py parity)
+        x, y = a.code, b.code
+        if _is_float(a.dtype) or _is_float(b.dtype) or \
+                (sa is None and sb is None):
+            if sa is not None:
+                x = f"((double)({x}) / {10.0 ** sa!r})"
+            if sb is not None:
+                y = f"((double)({y}) / {10.0 ** sb!r})"
+            return Val(f"(({ct})(({x}) * ({y})))", valid, r.dtype)
+        extra = 0
+        if sa is not None and sb is not None and so is not None:
+            extra = sa + sb - so
+        elif so is not None and (sa is None) != (sb is None):
+            extra = (sa or 0) + (sb or 0) - so
+        t = self._fresh("m")
+        self.stmts.append(
+            f"int64_t {t} = (int64_t)({x}) * (int64_t)({y});")
+        if extra > 0:
+            f = 10 ** extra
+            return Val(f"({t} >= 0 ? ({t} + {f // 2}LL) / {f}LL"
+                       f" : -((-{t} + {f // 2}LL) / {f}LL))", valid, r.dtype)
+        return Val(t, valid, r.dtype)
+
+    def _emit_div(self, args) -> Val:
+        a, b = args
+        sa, sb = _dec_scale(a.dtype), _dec_scale(b.dtype)
+        x = a.code if sa is None else f"((double)({a.code}) / {10.0 ** sa!r})"
+        y = b.code if sb is None else f"((double)({b.code}) / {10.0 ** sb!r})"
+        t = self._fresh("dv")
+        self.stmts.append(f"double {t}_y = (double)({y});"
+                          f" double {t} = (double)({x}) /"
+                          f" ({t}_y == 0.0 ? 1.0 : {t}_y);")
+        return Val(t, _vand(a.valid, b.valid, f"({t}_y != 0.0)"),
+                   dt.DoubleType())
+
+    def _emit_in(self, args) -> Val:
+        child = args[0]
+        sc = _dec_scale(child.dtype)
+        hits = []
+        valid_terms = []
+        for it in args[1:]:
+            si = _dec_scale(it.dtype)
+            x, y = child.code, it.code
+            if sc is not None or si is not None:
+                s = max(sc or 0, si or 0)
+                if sc is not None and s > sc:
+                    x = f"(({x}) * {10 ** (s - sc)}LL)"
+                if si is not None and s > si:
+                    y = f"(({y}) * {10 ** (s - si)}LL)"
+            term = f"(({x}) == ({y}))"
+            if it.valid is not None:
+                term = f"(({it.valid}) && {term})"
+            hits.append(term)
+        return Val("(" + " || ".join(hits) + ")", child.valid,
+                   dt.BooleanType())
+
+    def _emit_coalesce(self, args, r) -> Val:
+        ct = _ctype_of(r.dtype)
+        out = self._fresh("co")
+        self.stmts.append(f"{ct} {out} = 0; bool {out}_ok = false;")
+        for a in args:
+            self.stmts.append(f"if (!{out}_ok && ({a.valid or 'true'})) "
+                              f"{{ {out} = ({ct})({a.code}); {out}_ok = true; }}")
+        return Val(out, f"{out}_ok", r.dtype)
+
+    def _emit_date_field(self, name, a: Val, r) -> Val:
+        if not isinstance(a.dtype, dt.DateType):
+            _u(f"{name} over non-date")
+        t = self._fresh("dc")
+        self.stmts.append(
+            f"int64_t {t}_z = (int64_t)({a.code}) + 719468;"
+            f" int64_t {t}_era = ({t}_z >= 0 ? {t}_z : {t}_z - 146096) / 146097;"
+            f" int64_t {t}_doe = {t}_z - {t}_era * 146097;"
+            f" int64_t {t}_yoe = ({t}_doe - {t}_doe/1460 + {t}_doe/36524 - {t}_doe/146096) / 365;"
+            f" int64_t {t}_y = {t}_yoe + {t}_era * 400;"
+            f" int64_t {t}_doy = {t}_doe - (365*{t}_yoe + {t}_yoe/4 - {t}_yoe/100);"
+            f" int64_t {t}_mp = (5*{t}_doy + 2)/153;"
+            f" int64_t {t}_d = {t}_doy - (153*{t}_mp+2)/5 + 1;"
+            f" int64_t {t}_m = {t}_mp < 10 ? {t}_mp+3 : {t}_mp-9;"
+            f" if ({t}_m <= 2) {t}_y += 1;")
+        if name == "year":
+            code = f"((int32_t){t}_y)"
+        elif name == "month":
+            code = f"((int32_t){t}_m)"
+        elif name == "quarter":
+            code = f"((int32_t)(({t}_m - 1)/3 + 1))"
+        else:
+            code = f"((int32_t){t}_d)"
+        return Val(code, a.valid, r.dtype)
+
+    # ---------------- string (dictionary LUT) calls ----------------
+    def _emit_string_call(self, name, r, args) -> Val:
+        from ..plan.compiler import _dict_strings
+        import re as _re
+
+        def lit_str(a: Val) -> Optional[str]:
+            if a.dictionary is not None and len(a.dictionary) == 1:
+                return _dict_strings(a.dictionary)[0]
+            return None
+
+        if name in ("==", "!=", "<", "<=", ">", ">="):
+            a, b = args
+            if not (_is_str(a.dtype) and _is_str(b.dtype)):
+                _u("mixed string comparison")
+            # column vs literal → bool LUT over codes
+            col, lit, flip = (a, lit_str(b), False)
+            if lit is None:
+                col, lit, flip = (b, lit_str(a), True)
+            if lit is None or col.dictionary is None:
+                _u("string cmp needs a literal side")
+            vals = _dict_strings(col.dictionary)
+            op = name if not flip else {"<": ">", "<=": ">=", ">": "<",
+                                        ">=": "<=", "==": "==",
+                                        "!=": "!="}[name]
+            import operator
+            ops = {"==": operator.eq, "!=": operator.ne, "<": operator.lt,
+                   "<=": operator.le, ">": operator.gt, ">=": operator.ge}
+            lut = np.asarray([v is not None and ops[op](v, lit)
+                              for v in vals], dtype=np.uint8)
+            p = self._lut_ptr(lut, "uint8_t")
+            return Val(f"{p}[{col.code}]", _vand(a.valid, b.valid),
+                       dt.BooleanType())
+        if name in ("like", "ilike"):
+            col, pat = args
+            pattern = lit_str(pat)
+            if pattern is None or col.dictionary is None:
+                _u("non-literal LIKE")
+            flags = _re.IGNORECASE if name == "ilike" else 0
+            rxp = _re.compile(like_pattern_to_regex(
+                pattern, dict(r.options).get("escape")), flags)
+            vals = _dict_strings(col.dictionary)
+            lut = np.asarray([v is not None and bool(rxp.fullmatch(v))
+                              for v in vals], dtype=np.uint8)
+            p = self._lut_ptr(lut, "uint8_t")
+            return Val(f"{p}[{col.code}]", col.valid, dt.BooleanType())
+        if name == "rlike":
+            col, pat = args
+            pattern = lit_str(pat)
+            if pattern is None or col.dictionary is None:
+                _u("non-literal RLIKE")
+            rxp = _re.compile(pattern)
+            vals = _dict_strings(col.dictionary)
+            lut = np.asarray([v is not None and bool(rxp.search(v))
+                              for v in vals], dtype=np.uint8)
+            p = self._lut_ptr(lut, "uint8_t")
+            return Val(f"{p}[{col.code}]", col.valid, dt.BooleanType())
+        if name == "in":
+            col = args[0]
+            if col.dictionary is None:
+                _u("IN over non-dictionary string")
+            items = set()
+            for a in args[1:]:
+                s = lit_str(a)
+                if s is None:
+                    _u("non-literal IN item")
+                items.add(s)
+            vals = _dict_strings(col.dictionary)
+            lut = np.asarray([v in items for v in vals], dtype=np.uint8)
+            p = self._lut_ptr(lut, "uint8_t")
+            return Val(f"{p}[{col.code}]", col.valid, dt.BooleanType())
+        _u(f"string function {name!r}")
+
+    # ---------------- pipeline + aggregate assembly ----------------
+    def build(self) -> Tuple[str, dict]:
+        p = self.p
+        # 1. bottom environment: lazy loads guarded by nothing (loads are
+        # pure reads; dead rows read garbage that the sel guard discards)
+        env: Dict[int, Val] = {}
+        for i, f in enumerate(self.bottom_schema):
+            ct = "int32_t" if _is_str(f.dtype) else _ctype_of(f.dtype)
+            ptr = self._col_ptr(i, ct)
+            valid = None
+            if self.validity_present[i]:
+                valid = f"({self._validity_ptr(i)}[i] != 0)"
+            env[i] = Val(f"{ptr}[i]", valid, f.dtype, self.dicts.get(i))
+
+        # 2. chain (stored top-down; emit bottom-up): filters become
+        # guards, projects re-bind the env
+        for node in reversed(self.chain):
+            if isinstance(node, pn.FilterExec):
+                c = self.emit(node.condition, env)
+                cond = _vand(c.valid, f"(bool)({c.code})") \
+                    or f"(bool)({c.code})"
+                self.stmts.append(f"if (!({cond})) continue;")
+            elif isinstance(node, pn.ProjectExec):
+                new_env: Dict[int, Val] = {}
+                for j, (name_, e) in enumerate(node.exprs):
+                    v = self.emit(e, env)
+                    # materialize into a local so downstream refs share it
+                    if v.code.isidentifier() or _is_str(v.dtype):
+                        new_env[j] = v
+                    else:
+                        ct = ("int32_t" if _is_str(v.dtype)
+                              else _ctype_of(v.dtype))
+                        t = self._fresh("p")
+                        self.stmts.append(f"{ct} {t} = ({ct})({v.code});")
+                        nv = v.valid
+                        if nv is not None and not nv.isidentifier():
+                            self.stmts.append(f"bool {t}_ok = {nv};")
+                            nv = f"{t}_ok"
+                        new_env[j] = Val(t, nv, v.dtype, v.dictionary)
+                env = new_env
+            else:
+                _u(f"chain node {type(node).__name__}")
+
+        # 3. group binning (direct domains: dictionary codes / booleans)
+        in_schema = p.input.schema
+        domains: List[int] = []
+        key_vals: List[Val] = []
+        for gi in p.group_indices:
+            v = env.get(gi)
+            if v is None:
+                _u("group key not in environment")
+            if v.dictionary is not None and _is_str(v.dtype):
+                domains.append(len(v.dictionary))
+            elif isinstance(v.dtype, dt.BooleanType):
+                domains.append(2)
+            else:
+                _u("group key without small known domain")
+            key_vals.append(v)
+        strides: List[int] = []
+        total = 1
+        for d in reversed(domains):
+            strides.insert(0, total)
+            total *= (d + 1)
+        if total > 65536:
+            _u("group domain too large for direct binning")
+        nseg = max(total, 1)
+        seg_terms = []
+        for v, d, s in zip(key_vals, domains, strides):
+            code = f"(int64_t)({v.code})"
+            if v.valid is not None:
+                code = f"(({v.valid}) ? {code} : {d}LL)"
+            seg_terms.append(f"{code} * {s}LL")
+        seg = " + ".join(seg_terms) if seg_terms else "0"
+        self.stmts.append(f"int64_t seg = {seg};")
+        self.stmts.append("cnt_rows[seg] += 1;")
+
+        # 4. aggregates
+        f64_slots: List[int] = []
+        i64_slots: List[int] = []
+        agg_meta = []
+        for j, a in enumerate(p.aggs):
+            if a.distinct:
+                _u("distinct agg")
+            if a.fn not in ("sum", "count", "min", "max"):
+                _u(f"aggregate {a.fn!r}")
+            arg = None
+            if a.arg is not None:
+                arg = env.get(a.arg)
+                if arg is None:
+                    _u("agg arg not in environment")
+                if _is_str(arg.dtype) or arg.dtype.physical_dtype is None:
+                    _u("agg over non-numeric")
+            filt = None
+            if a.filter is not None:
+                fv = self.emit(a.filter, env)
+                filt = _vand(fv.valid, f"(bool)({fv.code})") \
+                    or f"(bool)({fv.code})"
+            if a.fn == "count":
+                slot = ("i64", len(i64_slots))
+                i64_slots.append(j)
+                acc = f"acci[seg * {{NI}} + {slot[1]}]"
+                guard = filt
+                if arg is not None and arg.valid is not None:
+                    guard = _vand(guard and f"({guard})", arg.valid) \
+                        if guard else arg.valid
+                stmt = f"{acc} += 1;"
+                if guard:
+                    stmt = f"if ({guard}) {{ {stmt} }}"
+                self.stmts.append(stmt)
+                agg_meta.append({"fn": "count", "slot": slot,
+                                 "dtype": a.out_dtype})
+                continue
+            # sum/min/max: float args accumulate in f64, everything else
+            # (ints, unscaled decimals, bools) in i64 — mirrors the device
+            # path's dtype behavior
+            use_f64 = _is_float(arg.dtype)
+            if use_f64:
+                slot = ("f64", len(f64_slots))
+                f64_slots.append(j)
+                acc = f"accd[seg * {{NF}} + {slot[1]}]"
+                val = f"(double)({arg.code})"
+            else:
+                slot = ("i64", len(i64_slots))
+                i64_slots.append(j)
+                acc = f"acci[seg * {{NI}} + {slot[1]}]"
+                val = f"(int64_t)({arg.code})"
+            nn = f"cnt_nn[seg * {{NA}} + {j}]"
+            if a.fn == "sum":
+                if not use_f64:
+                    body = (f"{acc} = (int64_t)((uint64_t){acc} + "
+                            f"(uint64_t)({val})); {nn} += 1;")
+                else:
+                    body = f"{acc} += {val}; {nn} += 1;"
+            elif a.fn == "min":
+                body = (f"if (!{nn} || ({val}) < {acc}) {acc} = {val}; "
+                        f"{nn} += 1;")
+            else:
+                body = (f"if (!{nn} || ({val}) > {acc}) {acc} = {val}; "
+                        f"{nn} += 1;")
+            guard = filt
+            if arg.valid is not None:
+                guard = _vand(guard and f"({guard})", arg.valid) \
+                    if guard else arg.valid
+            if guard:
+                body = f"if ({guard}) {{ {body} }}"
+            self.stmts.append(body)
+            agg_meta.append({"fn": a.fn, "slot": slot, "dtype": a.out_dtype,
+                             "arg_dtype": arg.dtype})
+
+        nf, ni, na = max(len(f64_slots), 1), max(len(i64_slots), 1), \
+            max(len(p.aggs), 1)
+        body = "\n      ".join(s.replace("{NF}", str(nf))
+                               .replace("{NI}", str(ni))
+                               .replace("{NA}", str(na))
+                               for s in self.stmts)
+        sel_slot = self._slot("sel", None)
+        source = f"""
+#include <cstdint>
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+static void run_range(const void** data, int64_t lo, int64_t hi,
+                      double* accd, int64_t* acci,
+                      int64_t* cnt_rows, int64_t* cnt_nn) {{
+  const uint8_t* selp = (const uint8_t*)data[{sel_slot}];
+  for (int64_t i = lo; i < hi; ++i) {{
+      if (!selp[i]) continue;
+      {body}
+  }}
+}}
+
+extern "C" void run(const void** data, int64_t n,
+                    double* accd, int64_t* acci,
+                    int64_t* cnt_rows, int64_t* cnt_nn) {{
+  int64_t nseg = {nseg};
+  unsigned hw = std::thread::hardware_concurrency();
+  int nt = (int)std::min<int64_t>(hw ? hw : 1, std::max<int64_t>(n / 1000000, 1));
+  if (nt <= 1) {{
+    run_range(data, 0, n, accd, acci, cnt_rows, cnt_nn);
+    return;
+  }}
+  std::vector<std::vector<double>> ad(nt);
+  std::vector<std::vector<int64_t>> ai(nt), cr(nt), cn(nt);
+  std::vector<std::thread> ts;
+  int64_t per = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {{
+    ad[t].assign(nseg * {nf}, 0.0);
+    ai[t].assign(nseg * {ni}, 0);
+    cr[t].assign(nseg, 0);
+    cn[t].assign(nseg * {na}, 0);
+    int64_t lo = t * per, hi = std::min(n, lo + per);
+    ts.emplace_back(run_range, data, lo, hi, ad[t].data(), ai[t].data(),
+                    cr[t].data(), cn[t].data());
+  }}
+  for (auto& th : ts) th.join();
+  for (int t = 0; t < nt; ++t) {{
+    for (int64_t s = 0; s < nseg; ++s) {{
+      cnt_rows[s] += cr[t][s];
+      {self._merge_code(agg_meta, nf, ni, na)}
+    }}
+  }}
+}}
+"""
+        meta = {"nseg": nseg, "nf": nf, "ni": ni, "na": na,
+                "domains": domains, "strides": strides,
+                "agg_meta": agg_meta, "key_vals": key_vals}
+        return source, meta
+
+    @staticmethod
+    def _merge_code(agg_meta, nf, ni, na) -> str:
+        lines = []
+        for j, m in enumerate(agg_meta):
+            kind, off = m["slot"]
+            if kind == "f64":
+                acc, part = f"accd[s * {nf} + {off}]", f"ad[t][s * {nf} + {off}]"
+            else:
+                acc, part = f"acci[s * {ni} + {off}]", f"ai[t][s * {ni} + {off}]"
+            nng = f"cn[t][s * {na} + {j}]"
+            nn = f"cnt_nn[s * {na} + {j}]"
+            if m["fn"] in ("sum", "count"):
+                if m["fn"] == "count":
+                    lines.append(f"{acc} += {part};")
+                else:
+                    if kind == "i64":
+                        lines.append(
+                            f"if ({nng}) {{ {acc} = (int64_t)((uint64_t){acc}"
+                            f" + (uint64_t){part}); {nn} += {nng}; }}")
+                    else:
+                        lines.append(
+                            f"if ({nng}) {{ {acc} += {part}; {nn} += {nng}; }}")
+            elif m["fn"] == "min":
+                lines.append(f"if ({nng}) {{ if (!{nn} || {part} < {acc}) "
+                             f"{acc} = {part}; {nn} += {nng}; }}")
+            elif m["fn"] == "max":
+                lines.append(f"if ({nng}) {{ if (!{nn} || {part} > {acc}) "
+                             f"{acc} = {part}; {nn} += {nng}; }}")
+        return "\n      ".join(lines)

@@ -1,0 +1,85 @@
+"""Native host-kernel compilation runtime.
+
+Reference role: the performance-critical native execution substrate
+(DataFusion's vectorized Rust operators, SURVEY.md §2.4-2.5). On TPU the
+compute path is XLA; on the CPU fallback path (local dev, driver-side
+stages, environments without accelerators) the engine JIT-compiles fused
+operator pipelines to C++ via the system toolchain and runs them over the
+batch's host buffers zero-copy. One query shape compiles once (disk +
+in-process cache) and is reused across batches, mirroring how the
+compiled-XLA op cache works for device programs.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Dict, Optional
+
+_LOCK = threading.Lock()
+_LIBS: Dict[str, ctypes.CDLL] = {}
+_AVAILABLE: Optional[bool] = None
+
+_CACHE_DIR = os.environ.get(
+    "SAIL_NATIVE_CACHE",
+    os.path.join(tempfile.gettempdir(), "sail_tpu_native"))
+
+
+def enabled() -> bool:
+    """Native host kernels are on unless explicitly disabled."""
+    return os.environ.get("SAIL_NATIVE", "1") not in ("0", "false", "off")
+
+
+def available() -> bool:
+    """True when a working C++ toolchain is present (checked once)."""
+    global _AVAILABLE
+    if _AVAILABLE is None:
+        with _LOCK:
+            if _AVAILABLE is None:
+                _AVAILABLE = _probe()
+    return _AVAILABLE
+
+
+def _probe() -> bool:
+    if not enabled():
+        return False
+    try:
+        lib = compile_and_load(
+            'extern "C" long long sail_probe(long long x) { return x + 1; }')
+        fn = lib.sail_probe
+        fn.restype = ctypes.c_longlong
+        return fn(ctypes.c_longlong(41)) == 42
+    except Exception:
+        return False
+
+
+def compile_and_load(source: str) -> ctypes.CDLL:
+    """Compile C++ source to a shared object (content-addressed cache on
+    disk) and dlopen it. Raises on toolchain failure."""
+    key = hashlib.sha256(source.encode()).hexdigest()[:24]
+    with _LOCK:
+        lib = _LIBS.get(key)
+        if lib is not None:
+            return lib
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    so_path = os.path.join(_CACHE_DIR, f"k{key}.so")
+    if not os.path.exists(so_path):
+        src_path = os.path.join(_CACHE_DIR, f"k{key}.cpp")
+        with open(src_path, "w") as f:
+            f.write(source)
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+               "-fPIC", "-pthread", "-o", tmp, src_path]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(f"native kernel compile failed:\n{proc.stderr}")
+        os.replace(tmp, so_path)  # atomic under concurrent builders
+    lib = ctypes.CDLL(so_path)
+    with _LOCK:
+        _LIBS[key] = lib
+    return lib
