@@ -89,8 +89,13 @@ def try_native_agg(executor, p, chain, child, bottom_node):
                          validity_present, fold_const)
         source, meta = gen.build()
         lib = cc.compile_and_load(source)
-        fn = lib.run
-        fn.restype = None
+        if meta["mode"] == "hash":
+            fn = lib.run_hash
+            fn.restype = ctypes.c_int64
+            meta["lib"] = lib
+        else:
+            fn = lib.run
+            fn.restype = None
         meta["args"] = gen.args
         meta["luts"] = gen.luts  # keep LUT arrays alive with the entry
         return fn, meta
@@ -131,6 +136,9 @@ def _run(fn, meta, p, child, bottom_schema):
         ptrs.append(a.ctypes.data_as(ctypes.c_void_p))
     arr_t = ctypes.c_void_p * len(ptrs)
     data = arr_t(*[pt.value for pt in ptrs])
+
+    if meta["mode"] == "hash":
+        return _run_hash(fn, meta, p, data, keepalive, n)
 
     nseg, nf, ni, na = meta["nseg"], meta["nf"], meta["ni"], meta["na"]
     accd = np.zeros(nseg * nf, dtype=np.float64)
@@ -173,18 +181,108 @@ def _run(fn, meta, p, child, bottom_schema):
         columns[_col_name(k)] = (values, validity, f.dtype)
 
     nk = len(p.group_indices)
+    _fill_agg_columns(columns, p, meta, accd, acci, cnt_nn, cnt_rows[exists],
+                      nk)
+
+    batch = make_batch(columns, ngroups)
+    return HostBatch(batch, out_dicts)
+
+
+def _fill_agg_columns(columns, p, meta, accd, acci, cnt_nn, cnt_rows, nk):
+    from ..exec.local import _col_name
+
     for j, (a, m) in enumerate(zip(p.aggs, meta["agg_meta"])):
         kind, off = m["slot"]
-        raw = accd[:, off] if kind == "f64" else acci[:, off]
+        if kind == "rows":
+            raw = cnt_rows
+        elif kind == "f64":
+            raw = accd[:, off]
+        else:
+            raw = acci[:, off]
         out_dtype = a.out_dtype
         npdt = np.dtype(out_dtype.physical_dtype or "int64")
         values = raw.astype(npdt)
         if a.fn == "count":
             validity = None
+        elif not m.get("nn", True):
+            # unguarded sum: valid wherever the group saw any row (the
+            # forced single row of an empty GLOBAL aggregate has
+            # cnt_rows == 0 and must be NULL)
+            nonnull = cnt_rows > 0
+            validity = None if nonnull.all() else nonnull
         else:
             nonnull = cnt_nn[:, j] > 0
             validity = None if nonnull.all() else nonnull
         columns[_col_name(nk + j)] = (values, validity, out_dtype)
 
+
+def _run_hash(fn, meta, p, data, keepalive, n):
+    """Hash-mode native aggregate: the C++ kernel owns the group table;
+    two-phase fetch copies the compacted groups into numpy and frees it."""
+    from ..columnar.batch import HostBatch, make_batch
+    from ..exec.local import _col_name
+    from ..spec import data_type as dt
+
+    lib = meta["lib"]
+    handle = ctypes.c_void_p()
+    ngroups = int(fn(data, ctypes.c_int64(n), ctypes.byref(handle)))
+
+    nk = len(p.group_indices)
+    nf, ni, na = meta["nf"], meta["ni"], meta["na"]
+    keys = np.zeros((max(ngroups, 1), nk), dtype=np.int64)
+    knull = np.zeros((max(ngroups, 1), nk), dtype=np.uint8)
+    accd = np.zeros((max(ngroups, 1), nf), dtype=np.float64)
+    acci = np.zeros((max(ngroups, 1), ni), dtype=np.int64)
+    cnt_rows = np.zeros(max(ngroups, 1), dtype=np.int64)
+    cnt_nn = np.zeros((max(ngroups, 1), na), dtype=np.int64)
+    lib.fetch_hash(
+        handle,
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        knull.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        accd.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        acci.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        cnt_rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        cnt_nn.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    lib.release_hash(handle)
+
+    # deterministic group order (table iteration order depends on the
+    # thread split): lexsort over the encoded key tuple
+    if ngroups > 1:
+        sort_cols = []
+        for k in range(nk - 1, -1, -1):
+            sort_cols.append(keys[:ngroups, k])
+            sort_cols.append(knull[:ngroups, k])
+        order = np.lexsort(tuple(sort_cols))
+        keys, knull = keys[:ngroups][order], knull[:ngroups][order]
+        accd, acci = accd[:ngroups][order], acci[:ngroups][order]
+        cnt_nn, cnt_rows = cnt_nn[:ngroups][order], cnt_rows[:ngroups][order]
+    else:
+        keys, knull = keys[:ngroups], knull[:ngroups]
+        accd, acci, cnt_nn = accd[:ngroups], acci[:ngroups], cnt_nn[:ngroups]
+        cnt_rows = cnt_rows[:ngroups]
+
+    in_schema = p.input.schema
+    key_vals = meta["key_vals"]
+    columns = {}
+    out_dicts = {}
+    for k, gi in enumerate(p.group_indices):
+        kv = key_vals[k]
+        f = in_schema[gi]
+        raw = keys[:, k]
+        valid_mask = knull[:, k] == 0
+        if kv.dictionary is not None:
+            values = raw.astype(np.int32)
+            out_dicts[_col_name(k)] = kv.dictionary
+        elif isinstance(kv.dtype, dt.BooleanType):
+            values = raw.astype(bool)
+        elif kv.dtype.physical_dtype in ("float32", "float64"):
+            values = np.ascontiguousarray(raw).view(np.float64).astype(
+                np.dtype(kv.dtype.physical_dtype))
+        else:
+            values = raw.astype(np.dtype(kv.dtype.physical_dtype))
+        validity = None if valid_mask.all() else valid_mask
+        columns[_col_name(k)] = (values, validity, f.dtype)
+
+    _fill_agg_columns(columns, p, meta, accd, acci, cnt_nn, cnt_rows, nk)
     batch = make_batch(columns, ngroups)
     return HostBatch(batch, out_dicts)

@@ -588,37 +588,52 @@ class AggCodegen:
             else:
                 _u(f"chain node {type(node).__name__}")
 
-        # 3. group binning (direct domains: dictionary codes / booleans)
+        # 3. group binning. Two strategies, mirroring DataFusion's grouped
+        # accumulator design (SURVEY.md §2.4): direct segment binning when
+        # every key has a small known domain (dictionary codes / booleans),
+        # otherwise an open-addressing hash table over the int64-encoded
+        # key tuple (plain ints, dates, decimals, floats, high-cardinality
+        # dictionary codes).
         in_schema = p.input.schema
         domains: List[int] = []
         key_vals: List[Val] = []
+        seg_mode = True
         for gi in p.group_indices:
             v = env.get(gi)
             if v is None:
                 _u("group key not in environment")
+            key_vals.append(v)
             if v.dictionary is not None and _is_str(v.dtype):
                 domains.append(len(v.dictionary))
             elif isinstance(v.dtype, dt.BooleanType):
                 domains.append(2)
+            elif v.dtype.physical_dtype is not None:
+                seg_mode = False
             else:
-                _u("group key without small known domain")
-            key_vals.append(v)
+                _u(f"group key type {v.dtype.simple_string()}")
         strides: List[int] = []
-        total = 1
-        for d in reversed(domains):
-            strides.insert(0, total)
-            total *= (d + 1)
-        if total > 65536:
-            _u("group domain too large for direct binning")
-        nseg = max(total, 1)
-        seg_terms = []
-        for v, d, s in zip(key_vals, domains, strides):
-            code = f"(int64_t)({v.code})"
-            if v.valid is not None:
-                code = f"(({v.valid}) ? {code} : {d}LL)"
-            seg_terms.append(f"{code} * {s}LL")
-        seg = " + ".join(seg_terms) if seg_terms else "0"
-        self.stmts.append(f"int64_t seg = {seg};")
+        nseg = 1
+        if seg_mode:
+            total = 1
+            for d in reversed(domains):
+                strides.insert(0, total)
+                total *= (d + 1)
+            if total > 65536:
+                seg_mode = False
+            else:
+                nseg = max(total, 1)
+        if seg_mode:
+            seg_terms = []
+            for v, d, s in zip(key_vals, domains, strides):
+                code = f"(int64_t)({v.code})"
+                if v.valid is not None:
+                    code = f"(({v.valid}) ? {code} : {d}LL)"
+                seg_terms.append(f"{code} * {s}LL")
+            seg = " + ".join(seg_terms) if seg_terms else "0"
+            self.stmts.append(f"int64_t seg = {seg};")
+        else:
+            domains, strides = [], []
+            self._emit_hash_keys(key_vals)
         self.stmts.append("cnt_rows[seg] += 1;")
 
         # 4. aggregates
@@ -643,17 +658,20 @@ class AggCodegen:
                 filt = _vand(fv.valid, f"(bool)({fv.code})") \
                     or f"(bool)({fv.code})"
             if a.fn == "count":
-                slot = ("i64", len(i64_slots))
-                i64_slots.append(j)
-                acc = f"acci[seg * {{NI}} + {slot[1]}]"
                 guard = filt
                 if arg is not None and arg.valid is not None:
                     guard = _vand(guard and f"({guard})", arg.valid) \
                         if guard else arg.valid
-                stmt = f"{acc} += 1;"
-                if guard:
-                    stmt = f"if ({guard}) {{ {stmt} }}"
-                self.stmts.append(stmt)
+                if guard is None:
+                    # unguarded COUNT ≡ the per-group row count the kernel
+                    # already tracks — emit nothing, read cnt_rows later
+                    agg_meta.append({"fn": "count", "slot": ("rows", 0),
+                                     "dtype": a.out_dtype})
+                    continue
+                slot = ("i64", len(i64_slots))
+                i64_slots.append(j)
+                acc = f"acci[seg * {{NI}} + {slot[1]}]"
+                self.stmts.append(f"if ({guard}) {{ {acc} += 1; }}")
                 agg_meta.append({"fn": "count", "slot": slot,
                                  "dtype": a.out_dtype})
                 continue
@@ -671,28 +689,33 @@ class AggCodegen:
                 i64_slots.append(j)
                 acc = f"acci[seg * {{NI}} + {slot[1]}]"
                 val = f"(int64_t)({arg.code})"
+            guard = filt
+            if arg.valid is not None:
+                guard = _vand(guard and f"({guard})", arg.valid) \
+                    if guard else arg.valid
+            # unguarded SUM never needs a non-null counter: every row of an
+            # existing group contributes, so validity is just "group
+            # exists". min/max always track it (first-touch initializer).
+            track_nn = guard is not None or a.fn in ("min", "max")
             nn = f"cnt_nn[seg * {{NA}} + {j}]"
             if a.fn == "sum":
+                bump = f" {nn} += 1;" if track_nn else ""
                 if not use_f64:
                     body = (f"{acc} = (int64_t)((uint64_t){acc} + "
-                            f"(uint64_t)({val})); {nn} += 1;")
+                            f"(uint64_t)({val}));{bump}")
                 else:
-                    body = f"{acc} += {val}; {nn} += 1;"
+                    body = f"{acc} += {val};{bump}"
             elif a.fn == "min":
                 body = (f"if (!{nn} || ({val}) < {acc}) {acc} = {val}; "
                         f"{nn} += 1;")
             else:
                 body = (f"if (!{nn} || ({val}) > {acc}) {acc} = {val}; "
                         f"{nn} += 1;")
-            guard = filt
-            if arg.valid is not None:
-                guard = _vand(guard and f"({guard})", arg.valid) \
-                    if guard else arg.valid
             if guard:
                 body = f"if ({guard}) {{ {body} }}"
             self.stmts.append(body)
             agg_meta.append({"fn": a.fn, "slot": slot, "dtype": a.out_dtype,
-                             "arg_dtype": arg.dtype})
+                             "arg_dtype": arg.dtype, "nn": track_nn})
 
         nf, ni, na = max(len(f64_slots), 1), max(len(i64_slots), 1), \
             max(len(p.aggs), 1)
@@ -701,6 +724,13 @@ class AggCodegen:
                                .replace("{NA}", str(na))
                                for s in self.stmts)
         sel_slot = self._slot("sel", None)
+        if not seg_mode:
+            source = self._hash_source(body, sel_slot, len(key_vals),
+                                       nf, ni, na, agg_meta)
+            meta = {"mode": "hash", "nf": nf, "ni": ni, "na": na,
+                    "nseg": 0, "domains": [], "strides": [],
+                    "agg_meta": agg_meta, "key_vals": key_vals}
+            return source, meta
         source = f"""
 #include <cstdint>
 #include <cmath>
@@ -710,14 +740,37 @@ class AggCodegen:
 #include <vector>
 #include <algorithm>
 
+template <bool DENSE>
 static void run_range(const void** data, int64_t lo, int64_t hi,
                       double* accd, int64_t* acci,
                       int64_t* cnt_rows, int64_t* cnt_nn) {{
   const uint8_t* selp = (const uint8_t*)data[{sel_slot}];
   for (int64_t i = lo; i < hi; ++i) {{
-      if (!selp[i]) continue;
+      if (!DENSE && !selp[i]) continue;
       {body}
   }}
+}}
+
+// A selection that is all-true up to some prefix (the common case for a
+// freshly scanned batch: live rows then padding) lets the hot loop skip
+// the per-row mask load entirely. Two SIMD memchr sweeps decide it.
+static int64_t dense_prefix(const uint8_t* selp, int64_t n) {{
+  const void* z = memchr(selp, 0, (size_t)n);
+  int64_t k = z ? (const uint8_t*)z - selp : n;
+  if (k < n && memchr(selp + k, 1, (size_t)(n - k)) != nullptr)
+    return -1;  // holes: not a prefix mask
+  return k;
+}}
+
+static void run_part(const void** data, int64_t lo, int64_t hi,
+                     double* accd, int64_t* acci,
+                     int64_t* cnt_rows, int64_t* cnt_nn) {{
+  const uint8_t* selp = (const uint8_t*)data[{sel_slot}];
+  int64_t k = dense_prefix(selp + lo, hi - lo);
+  if (k >= 0)
+    run_range<true>(data, lo, lo + k, accd, acci, cnt_rows, cnt_nn);
+  else
+    run_range<false>(data, lo, hi, accd, acci, cnt_rows, cnt_nn);
 }}
 
 extern "C" void run(const void** data, int64_t n,
@@ -727,7 +780,7 @@ extern "C" void run(const void** data, int64_t n,
   unsigned hw = std::thread::hardware_concurrency();
   int nt = (int)std::min<int64_t>(hw ? hw : 1, std::max<int64_t>(n / 1000000, 1));
   if (nt <= 1) {{
-    run_range(data, 0, n, accd, acci, cnt_rows, cnt_nn);
+    run_part(data, 0, n, accd, acci, cnt_rows, cnt_nn);
     return;
   }}
   std::vector<std::vector<double>> ad(nt);
@@ -740,7 +793,7 @@ extern "C" void run(const void** data, int64_t n,
     cr[t].assign(nseg, 0);
     cn[t].assign(nseg * {na}, 0);
     int64_t lo = t * per, hi = std::min(n, lo + per);
-    ts.emplace_back(run_range, data, lo, hi, ad[t].data(), ai[t].data(),
+    ts.emplace_back(run_part, data, lo, hi, ad[t].data(), ai[t].data(),
                     cr[t].data(), cn[t].data());
   }}
   for (auto& th : ts) th.join();
@@ -752,37 +805,272 @@ extern "C" void run(const void** data, int64_t n,
   }}
 }}
 """
-        meta = {"nseg": nseg, "nf": nf, "ni": ni, "na": na,
-                "domains": domains, "strides": strides,
+        meta = {"mode": "segment", "nseg": nseg, "nf": nf, "ni": ni,
+                "na": na, "domains": domains, "strides": strides,
                 "agg_meta": agg_meta, "key_vals": key_vals}
         return source, meta
 
+    # ---------------- hash-mode group keys ----------------
+    def _emit_hash_keys(self, key_vals: List[Val]) -> None:
+        """Encode each group key as an int64 + null flag, insert the tuple
+        into the per-thread open-addressing table, and rebind the
+        accumulator pointers (the insert may grow/move the table)."""
+        nk = len(key_vals)
+        for j, v in enumerate(key_vals):
+            if v.dictionary is not None or not _is_float(v.dtype):
+                conv = f"int64_t gk{j} = (int64_t)({v.code});"
+            else:
+                # float keys: hash the bit pattern with NaN canonicalized
+                # and -0.0 normalized to +0.0 (Spark grouping semantics)
+                conv = (f"double kd{j} = (double)({v.code});"
+                        f" if (kd{j} == 0.0) kd{j} = 0.0;"
+                        f" int64_t gk{j};"
+                        f" if (std::isnan(kd{j}))"
+                        f" gk{j} = 0x7FF8000000000000LL;"
+                        f" else std::memcpy(&gk{j}, &kd{j}, 8);")
+            if v.valid is not None:
+                nl = (f"uint8_t gn{j} = ({v.valid}) ? 0 : 1;"
+                      f" if (gn{j}) gk{j} = 0;")
+            else:
+                nl = f"uint8_t gn{j} = 0;"
+            self.stmts.append(conv + " " + nl)
+        self.stmts.append(
+            "int64_t gkarr[" + str(nk) + "] = {"
+            + ", ".join(f"gk{j}" for j in range(nk)) + "};"
+            " uint8_t gnarr[" + str(nk) + "] = {"
+            + ", ".join(f"gn{j}" for j in range(nk)) + "};"
+            " int64_t seg = tab_insert(T, gkarr, gnarr);"
+            " double* accd = T->accd; int64_t* acci = T->acci;"
+            " int64_t* cnt_rows = T->cnt_rows;"
+            " int64_t* cnt_nn = T->cnt_nn;")
+
+    def _hash_source(self, body, sel_slot, nk, nf, ni, na, agg_meta) -> str:
+        merge = self._merge_code_fmt(
+            agg_meta, nf, ni, na,
+            dst_d="G->accd[d * {nf} + {off}]",
+            src_d="S->accd[s * {nf} + {off}]",
+            dst_i="G->acci[d * {ni} + {off}]",
+            src_i="S->acci[s * {ni} + {off}]",
+            dst_nn="G->cnt_nn[d * {na} + {j}]",
+            src_nn="S->cnt_nn[s * {na} + {j}]")
+        return f"""
+#include <cstdint>
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+static const int64_t NK = {nk}, NF_ = {nf}, NI_ = {ni}, NA_ = {na};
+
+struct Tab {{
+  int64_t cap, mask, size;
+  int64_t* keys;     // cap * NK
+  uint8_t* knull;    // cap * NK
+  uint8_t* occ;      // cap
+  double* accd;      // cap * NF_
+  int64_t* acci;     // cap * NI_
+  int64_t* cnt_rows; // cap
+  int64_t* cnt_nn;   // cap * NA_
+}};
+
+static void tab_init(Tab* T, int64_t cap) {{
+  T->cap = cap; T->mask = cap - 1; T->size = 0;
+  T->keys = (int64_t*)calloc(cap * NK, sizeof(int64_t));
+  T->knull = (uint8_t*)calloc(cap * NK, 1);
+  T->occ = (uint8_t*)calloc(cap, 1);
+  T->accd = (double*)calloc(cap * NF_, sizeof(double));
+  T->acci = (int64_t*)calloc(cap * NI_, sizeof(int64_t));
+  T->cnt_rows = (int64_t*)calloc(cap, sizeof(int64_t));
+  T->cnt_nn = (int64_t*)calloc(cap * NA_, sizeof(int64_t));
+}}
+
+static void tab_free(Tab* T) {{
+  free(T->keys); free(T->knull); free(T->occ); free(T->accd);
+  free(T->acci); free(T->cnt_rows); free(T->cnt_nn);
+}}
+
+static inline uint64_t mix64(uint64_t x) {{
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}}
+
+static inline uint64_t hash_keys(const int64_t* k, const uint8_t* nl) {{
+  uint64_t h = 0x9e3779b97f4a7c15ULL;
+  for (int64_t j = 0; j < NK; ++j)
+    h = mix64(h ^ (uint64_t)k[j] ^ ((uint64_t)nl[j] << 56));
+  return h;
+}}
+
+static void tab_grow(Tab* T) {{
+  Tab N; tab_init(&N, T->cap * 2);
+  for (int64_t s = 0; s < T->cap; ++s) {{
+    if (!T->occ[s]) continue;
+    uint64_t h = hash_keys(T->keys + s * NK, T->knull + s * NK);
+    int64_t i = (int64_t)(h & (uint64_t)N.mask);
+    while (N.occ[i]) i = (i + 1) & N.mask;  // keys are distinct
+    N.occ[i] = 1;
+    std::memcpy(N.keys + i * NK, T->keys + s * NK, NK * sizeof(int64_t));
+    std::memcpy(N.knull + i * NK, T->knull + s * NK, NK);
+    std::memcpy(N.accd + i * NF_, T->accd + s * NF_, NF_ * sizeof(double));
+    std::memcpy(N.acci + i * NI_, T->acci + s * NI_, NI_ * sizeof(int64_t));
+    N.cnt_rows[i] = T->cnt_rows[s];
+    std::memcpy(N.cnt_nn + i * NA_, T->cnt_nn + s * NA_,
+                NA_ * sizeof(int64_t));
+  }}
+  N.size = T->size;
+  tab_free(T);
+  *T = N;
+}}
+
+static inline int64_t tab_insert(Tab* T, const int64_t* k,
+                                 const uint8_t* nl) {{
+  if ((T->size + 1) * 10 >= T->cap * 7) tab_grow(T);
+  uint64_t h = hash_keys(k, nl);
+  int64_t i = (int64_t)(h & (uint64_t)T->mask);
+  for (;;) {{
+    if (!T->occ[i]) {{
+      T->occ[i] = 1;
+      std::memcpy(T->keys + i * NK, k, NK * sizeof(int64_t));
+      std::memcpy(T->knull + i * NK, nl, NK);
+      T->size += 1;
+      return i;
+    }}
+    if (!std::memcmp(T->keys + i * NK, k, NK * sizeof(int64_t)) &&
+        !std::memcmp(T->knull + i * NK, nl, NK))
+      return i;
+    i = (i + 1) & T->mask;
+  }}
+}}
+
+template <bool DENSE>
+static void run_range(const void** data, int64_t lo, int64_t hi, Tab* T) {{
+  const uint8_t* selp = (const uint8_t*)data[{sel_slot}];
+  for (int64_t i = lo; i < hi; ++i) {{
+      if (!DENSE && !selp[i]) continue;
+      {body}
+  }}
+}}
+
+// prefix-dense selection (live rows then padding) → unguarded hot loop
+static int64_t dense_prefix(const uint8_t* selp, int64_t n) {{
+  const void* z = memchr(selp, 0, (size_t)n);
+  int64_t k = z ? (const uint8_t*)z - selp : n;
+  if (k < n && memchr(selp + k, 1, (size_t)(n - k)) != nullptr)
+    return -1;
+  return k;
+}}
+
+static void run_part(const void** data, int64_t lo, int64_t hi, Tab* T) {{
+  const uint8_t* selp = (const uint8_t*)data[{sel_slot}];
+  int64_t k = dense_prefix(selp + lo, hi - lo);
+  if (k >= 0)
+    run_range<true>(data, lo, lo + k, T);
+  else
+    run_range<false>(data, lo, hi, T);
+}}
+
+extern "C" int64_t run_hash(const void** data, int64_t n, void** out) {{
+  unsigned hw = std::thread::hardware_concurrency();
+  int nt = (int)std::min<int64_t>(hw ? hw : 1,
+                                  std::max<int64_t>(n / 500000, 1));
+  Tab* G = (Tab*)malloc(sizeof(Tab));
+  if (nt <= 1) {{
+    tab_init(G, 4096);
+    run_part(data, 0, n, G);
+  }} else {{
+    std::vector<Tab> parts(nt);
+    std::vector<std::thread> ts;
+    int64_t per = (n + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {{
+      tab_init(&parts[t], 4096);
+      int64_t lo = t * per, hi = std::min(n, lo + per);
+      ts.emplace_back(run_part, data, lo, hi, &parts[t]);
+    }}
+    for (auto& th : ts) th.join();
+    tab_init(G, 8192);
+    for (int t = 0; t < nt; ++t) {{
+      Tab* S = &parts[t];
+      for (int64_t s = 0; s < S->cap; ++s) {{
+        if (!S->occ[s]) continue;
+        int64_t d = tab_insert(G, S->keys + s * NK, S->knull + s * NK);
+        G->cnt_rows[d] += S->cnt_rows[s];
+        {merge}
+      }}
+      tab_free(S);
+    }}
+  }}
+  *out = (void*)G;
+  return G->size;
+}}
+
+extern "C" void fetch_hash(void* handle, int64_t* keys, uint8_t* knull,
+                           double* accd, int64_t* acci,
+                           int64_t* cnt_rows, int64_t* cnt_nn) {{
+  Tab* T = (Tab*)handle;
+  int64_t o = 0;
+  for (int64_t s = 0; s < T->cap; ++s) {{
+    if (!T->occ[s]) continue;
+    std::memcpy(keys + o * NK, T->keys + s * NK, NK * sizeof(int64_t));
+    std::memcpy(knull + o * NK, T->knull + s * NK, NK);
+    std::memcpy(accd + o * NF_, T->accd + s * NF_, NF_ * sizeof(double));
+    std::memcpy(acci + o * NI_, T->acci + s * NI_, NI_ * sizeof(int64_t));
+    cnt_rows[o] = T->cnt_rows[s];
+    std::memcpy(cnt_nn + o * NA_, T->cnt_nn + s * NA_,
+                NA_ * sizeof(int64_t));
+    ++o;
+  }}
+}}
+
+extern "C" void release_hash(void* handle) {{
+  Tab* T = (Tab*)handle;
+  tab_free(T);
+  free(T);
+}}
+"""
+
     @staticmethod
-    def _merge_code(agg_meta, nf, ni, na) -> str:
+    def _merge_code_fmt(agg_meta, nf, ni, na, dst_d, src_d, dst_i, src_i,
+                        dst_nn, src_nn) -> str:
+        """Merge statements combining a source accumulator row into a
+        destination row, with index expressions supplied as templates."""
         lines = []
         for j, m in enumerate(agg_meta):
             kind, off = m["slot"]
+            if kind == "rows":
+                continue  # read from cnt_rows, merged separately
+            sub = dict(nf=nf, ni=ni, na=na, off=off, j=j)
             if kind == "f64":
-                acc, part = f"accd[s * {nf} + {off}]", f"ad[t][s * {nf} + {off}]"
+                acc, part = dst_d.format(**sub), src_d.format(**sub)
             else:
-                acc, part = f"acci[s * {ni} + {off}]", f"ai[t][s * {ni} + {off}]"
-            nng = f"cn[t][s * {na} + {j}]"
-            nn = f"cnt_nn[s * {na} + {j}]"
-            if m["fn"] in ("sum", "count"):
-                if m["fn"] == "count":
-                    lines.append(f"{acc} += {part};")
+                acc, part = dst_i.format(**sub), src_i.format(**sub)
+            nn = dst_nn.format(**sub)
+            nng = src_nn.format(**sub)
+            if m["fn"] == "count":
+                lines.append(f"{acc} += {part};")
+            elif m["fn"] == "sum":
+                add = (f"{acc} = (int64_t)((uint64_t){acc}"
+                       f" + (uint64_t){part});" if kind == "i64"
+                       else f"{acc} += {part};")
+                if m.get("nn", True):
+                    lines.append(f"if ({nng}) {{ {add} {nn} += {nng}; }}")
                 else:
-                    if kind == "i64":
-                        lines.append(
-                            f"if ({nng}) {{ {acc} = (int64_t)((uint64_t){acc}"
-                            f" + (uint64_t){part}); {nn} += {nng}; }}")
-                    else:
-                        lines.append(
-                            f"if ({nng}) {{ {acc} += {part}; {nn} += {nng}; }}")
+                    lines.append(add)
             elif m["fn"] == "min":
                 lines.append(f"if ({nng}) {{ if (!{nn} || {part} < {acc}) "
                              f"{acc} = {part}; {nn} += {nng}; }}")
             elif m["fn"] == "max":
                 lines.append(f"if ({nng}) {{ if (!{nn} || {part} > {acc}) "
                              f"{acc} = {part}; {nn} += {nng}; }}")
-        return "\n      ".join(lines)
+        return "\n        ".join(lines)
+
+    @classmethod
+    def _merge_code(cls, agg_meta, nf, ni, na) -> str:
+        return cls._merge_code_fmt(
+            agg_meta, nf, ni, na,
+            dst_d="accd[s * {nf} + {off}]", src_d="ad[t][s * {nf} + {off}]",
+            dst_i="acci[s * {ni} + {off}]", src_i="ai[t][s * {ni} + {off}]",
+            dst_nn="cnt_nn[s * {na} + {j}]", src_nn="cn[t][s * {na} + {j}]")

@@ -34,11 +34,19 @@ def enabled() -> bool:
     return os.environ.get("SAIL_NATIVE", "1") not in ("0", "false", "off")
 
 
+_PROBE_LOCK = threading.Lock()
+
+
 def available() -> bool:
-    """True when a working C++ toolchain is present (checked once)."""
+    """True when a working C++ toolchain is present (checked once).
+
+    The probe compiles a kernel via compile_and_load, which takes _LOCK
+    internally — so the probe runs under its own lock, never _LOCK (a
+    non-reentrant _LOCK here self-deadlocked in a prior revision).
+    """
     global _AVAILABLE
     if _AVAILABLE is None:
-        with _LOCK:
+        with _PROBE_LOCK:
             if _AVAILABLE is None:
                 _AVAILABLE = _probe()
     return _AVAILABLE

@@ -1,0 +1,384 @@
+"""Cost-based join reordering (greedy operator ordering).
+
+Reference role: the reference's cost-based JoinReorder physical rule
+(crates/sail-physical-optimizer/src/join_reorder/, ~8k LoC DP-style) plus
+its CollectLeft broadcast selection (src/collect_left.rs). This build uses
+greedy operator ordering (GOO) instead of DP: at engine batch sizes the
+difference between GOO and optimal is small for TPC-H-shaped star/
+snowflake graphs, and GOO is O(n²) with no memo table.
+
+The pass runs after filter pushdown (so leaf filters are in place and
+implicit cross joins have been converted to inner joins with keys) and
+before column pruning (so the restoring projection gets pruned away).
+
+Cardinality model (no collected statistics yet — SURVEY.md §2.6
+sail-cache statistics cache is the eventual source):
+- scans: exact row counts for in-memory tables, parquet footer counts for
+  parquet scans, a large default otherwise
+- filters: per-conjunct selectivity guesses (equality 0.05, IN 0.2,
+  range 0.3, LIKE 0.25, other 0.25)
+- equi joins: |A ⋈ B| = |A|·|B| / Π_e max(ndv_a(e), ndv_b(e)), with
+  ndv of a key approximated by the unfiltered base rows of its leaf —
+  exact for PK/FK equi joins, conservative elsewhere
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..spec import data_type as dt
+from . import nodes as pn
+from . import rex as rx
+
+_DEFAULT_ROWS = 1_000_000.0
+
+
+@dataclasses.dataclass
+class _Leaf:
+    node: pn.PlanNode
+    offset: int          # column offset in the ORIGINAL tree's output
+    width: int
+    rows: float          # estimated output rows (after its filters)
+    base_rows: float     # unfiltered base-scan rows (ndv proxy)
+
+
+@dataclasses.dataclass
+class _Edge:
+    a: int               # leaf index
+    b: int
+    a_expr: rx.Rex       # bound to leaf a's local schema
+    b_expr: rx.Rex
+
+
+@dataclasses.dataclass
+class _Residual:
+    expr: rx.Rex         # bound to the original tree's global schema
+    leaves: Tuple[int, ...]
+
+
+def reorder_joins(p: pn.PlanNode) -> pn.PlanNode:
+    """Recursively reorder every maximal inner-join tree in the plan."""
+    if isinstance(p, pn.JoinExec) and _is_reorderable(p):
+        return _reorder_tree(p)
+    kids = {}
+    for fname in ("input", "left", "right"):
+        c = getattr(p, fname, None)
+        if isinstance(c, pn.PlanNode):
+            kids[fname] = reorder_joins(c)
+    if hasattr(p, "inputs"):
+        kids["inputs"] = tuple(reorder_joins(c) for c in p.inputs)
+    if kids:
+        return dataclasses.replace(p, **kids)
+    return p
+
+
+def _is_reorderable(j: pn.JoinExec) -> bool:
+    return j.join_type == "inner" and not j.null_aware and bool(j.left_keys)
+
+
+def _reorder_tree(root: pn.JoinExec) -> pn.PlanNode:
+    leaves: List[_Leaf] = []
+    edges: List[_Edge] = []
+    residuals: List[_Residual] = []
+    ok = _collect(root, leaves, edges, residuals, 0)
+    if not ok or len(leaves) < 3 or len(leaves) > 16:
+        # nothing to gain (or too odd a shape): recurse into children only
+        return dataclasses.replace(
+            root, left=reorder_joins(root.left),
+            right=reorder_joins(root.right))
+    order, plan = _greedy(leaves, edges, residuals)
+    if plan is None:
+        return dataclasses.replace(
+            root, left=reorder_joins(root.left),
+            right=reorder_joins(root.right))
+    # restore the original column order with an identity projection
+    new_offsets: Dict[int, int] = {}
+    pos = 0
+    for li in order:
+        new_offsets[li] = pos
+        pos += leaves[li].width
+    out_schema = root.schema
+    exprs = []
+    for i, f in enumerate(out_schema):
+        li = _leaf_of_index(leaves, i)
+        new_i = new_offsets[li] + (i - leaves[li].offset)
+        exprs.append((f.name, rx.BoundRef(new_i, f.name, f.dtype,
+                                          f.nullable)))
+    return pn.ProjectExec(plan, tuple(exprs))
+
+
+def _collect(p: pn.PlanNode, leaves, edges, residuals, offset) -> bool:
+    """Flatten an inner-join tree; returns False on unsupported shapes."""
+    if isinstance(p, pn.JoinExec) and _is_reorderable(p):
+        wl = len(p.left.schema)
+        if not _collect(p.left, leaves, edges, residuals, offset):
+            return False
+        if not _collect(p.right, leaves, edges, residuals, offset + wl):
+            return False
+        for lk, rk in zip(p.left_keys, p.right_keys):
+            ga = rx.shift_refs(lk, offset)
+            gb = rx.shift_refs(rk, offset + wl)
+            ea = _single_leaf(leaves, ga)
+            eb = _single_leaf(leaves, gb)
+            if ea is None or eb is None:
+                # key spans leaves: keep this tree as written
+                return False
+            edges.append(_Edge(
+                ea, eb,
+                rx.shift_refs(ga, -leaves[ea].offset),
+                rx.shift_refs(gb, -leaves[eb].offset)))
+        if p.residual is not None:
+            ge = rx.shift_refs(p.residual, offset)
+            refs = rx.references(ge)
+            ls = tuple(sorted({_leaf_of_index(leaves, i) for i in refs}))
+            residuals.append(_Residual(ge, ls))
+        return True
+    leaves.append(_Leaf(reorder_joins(p), offset, len(p.schema),
+                        max(_est_rows(p), 1.0),
+                        max(_base_rows(p), 1.0)))
+    return True
+
+
+def _leaf_of_index(leaves: List[_Leaf], i: int) -> int:
+    for k, lf in enumerate(leaves):
+        if lf.offset <= i < lf.offset + lf.width:
+            return k
+    raise IndexError(i)
+
+
+def _single_leaf(leaves, expr) -> Optional[int]:
+    refs = rx.references(expr)
+    if not refs:
+        return None
+    ls = {_leaf_of_index(leaves, i) for i in refs}
+    if len(ls) != 1:
+        return None
+    return ls.pop()
+
+
+# ---------------------------------------------------------------------------
+# cardinality estimation
+# ---------------------------------------------------------------------------
+
+_PARQUET_ROWS_CACHE: Dict[Tuple[str, float], float] = {}
+
+
+def _parquet_rows(path: str) -> float:
+    """Footer row count, cached by (path, mtime) — planning must not
+    re-open footers per query (the statistics cache's eventual job)."""
+    try:
+        import os
+        key = (path, os.path.getmtime(path))
+    except OSError:
+        key = (path, -1.0)
+    hit = _PARQUET_ROWS_CACHE.get(key)
+    if hit is None:
+        import pyarrow.parquet as pq
+        hit = float(pq.ParquetFile(path).metadata.num_rows)
+        _PARQUET_ROWS_CACHE[key] = hit
+    return hit
+
+
+def _scan_rows(p: pn.ScanExec) -> float:
+    if p.source is not None and hasattr(p.source, "num_rows"):
+        return float(p.source.num_rows)
+    if p.format == "parquet" and p.paths:
+        try:
+            return float(sum(_parquet_rows(path) for path in p.paths[:64]))
+        except Exception:
+            return _DEFAULT_ROWS
+    return _DEFAULT_ROWS
+
+
+def _conjunct_selectivity(c: rx.Rex) -> float:
+    if isinstance(c, rx.RCall):
+        if c.fn == "==":
+            return 0.05
+        if c.fn == "in":
+            return 0.2
+        if c.fn in ("<", "<=", ">", ">="):
+            return 0.3
+        if c.fn in ("like", "ilike", "rlike"):
+            return 0.25
+        if c.fn == "and":
+            return (_conjunct_selectivity(c.args[0])
+                    * _conjunct_selectivity(c.args[1]))
+        if c.fn == "or":
+            a = _conjunct_selectivity(c.args[0])
+            b = _conjunct_selectivity(c.args[1])
+            return min(a + b, 1.0)
+        if c.fn == "not":
+            return max(1.0 - _conjunct_selectivity(c.args[0]), 0.05)
+    return 0.25
+
+
+def _est_rows(p: pn.PlanNode) -> float:
+    if isinstance(p, pn.ScanExec):
+        return _scan_rows(p)
+    if isinstance(p, pn.FilterExec):
+        return _est_rows(p.input) * _conjunct_selectivity(p.condition)
+    if isinstance(p, pn.AggregateExec):
+        return max(_est_rows(p.input) * 0.1, 1.0)
+    if isinstance(p, pn.JoinExec):
+        lr, rr = _est_rows(p.left), _est_rows(p.right)
+        if p.join_type in ("semi", "anti"):
+            return lr * 0.5
+        return max(lr, rr)
+    if isinstance(p, pn.UnionExec):
+        return sum(_est_rows(c) for c in p.inputs)
+    child = getattr(p, "input", None)
+    if isinstance(child, pn.PlanNode):
+        return _est_rows(child)
+    return _DEFAULT_ROWS
+
+
+def _base_rows(p: pn.PlanNode) -> float:
+    """Unfiltered base cardinality — the ndv proxy for join keys."""
+    if isinstance(p, pn.ScanExec):
+        return _scan_rows(p)
+    if isinstance(p, pn.JoinExec):
+        return max(_base_rows(p.left), _base_rows(p.right))
+    if isinstance(p, pn.UnionExec):
+        return sum(_base_rows(c) for c in p.inputs)
+    child = getattr(p, "input", None)
+    if isinstance(child, pn.PlanNode):
+        return _base_rows(child)
+    return _DEFAULT_ROWS
+
+
+# ---------------------------------------------------------------------------
+# greedy ordering + tree construction
+# ---------------------------------------------------------------------------
+
+def _join_card(rows_a: float, rows_b: float,
+               ndvs: List[Tuple[float, float]]) -> float:
+    card = rows_a * rows_b
+    for na, nb in ndvs:
+        # a join key's distinct count is bounded by the PK side's size:
+        # ndv(fk) ≈ ndv(pk) ≈ min(base_a, base_b)
+        card /= max(min(na, nb), 1.0)
+    return max(card, 1.0)
+
+
+def _greedy(leaves: List[_Leaf], edges: List[_Edge], residuals):
+    n = len(leaves)
+    remaining = set(range(n))
+    by_pair: Dict[Tuple[int, int], List[_Edge]] = {}
+    for e in edges:
+        key = (min(e.a, e.b), max(e.a, e.b))
+        by_pair.setdefault(key, []).append(e)
+
+    # seed: the connected pair with the smallest estimated join output
+    best = None
+    for (a, b), es in by_pair.items():
+        ndvs = [(leaves[e.a].base_rows, leaves[e.b].base_rows) for e in es]
+        card = _join_card(leaves[a].rows, leaves[b].rows, ndvs)
+        if best is None or card < best[0]:
+            best = (card, a, b)
+    if best is None:
+        return None, None
+    card, a, b = best
+    if leaves[b].rows < leaves[a].rows:
+        a, b = b, a  # smaller side leads (build side of the first join)
+    order = [a, b]
+    remaining -= {a, b}
+    cur_rows = card
+
+    while remaining:
+        in_set = set(order)
+        cand = None
+        for r in sorted(remaining):
+            es = [e for e in edges
+                  if (e.a == r and e.b in in_set)
+                  or (e.b == r and e.a in in_set)]
+            if not es:
+                continue
+            ndvs = [(leaves[e.a].base_rows, leaves[e.b].base_rows)
+                    for e in es]
+            c = _join_card(cur_rows, leaves[r].rows, ndvs)
+            if cand is None or c < cand[0]:
+                cand = (c, r)
+        if cand is None:
+            # disconnected: take the smallest remaining as a cross join
+            r = min(remaining, key=lambda i: leaves[i].rows)
+            cand = (cur_rows * leaves[r].rows, r)
+        cur_rows, r = cand
+        order.append(r)
+        remaining.discard(r)
+
+    plan = _build_tree(leaves, edges, residuals, order)
+    return order, plan
+
+
+def _build_tree(leaves, edges, residuals, order):
+    # position of each original column in the NEW tree as it grows
+    new_offsets: Dict[int, int] = {}
+
+    li0 = order[0]
+    plan = leaves[li0].node
+    new_offsets[li0] = 0
+    width = leaves[li0].width
+    in_set = {li0}
+    pending_res = list(residuals)
+
+    for r in order[1:]:
+        es = [e for e in edges
+              if (e.a == r and e.b in in_set) or (e.b == r and e.a in in_set)]
+        lks, rks = [], []
+        for e in es:
+            if e.a == r:
+                set_leaf, set_expr, r_expr = e.b, e.b_expr, e.a_expr
+            else:
+                set_leaf, set_expr, r_expr = e.a, e.a_expr, e.b_expr
+            lks.append(rx.shift_refs(set_expr, new_offsets[set_leaf]))
+            rks.append(r_expr)
+        join_type = "inner" if lks else "cross"
+        new_offsets[r] = width
+        in_set.add(r)
+        width += leaves[r].width
+        # residual conjuncts that just became fully bound ride this join
+        now, later = [], []
+        for res in pending_res:
+            (now if all(l in in_set for l in res.leaves) else later).append(res)
+        pending_res = later
+        residual = None
+        if now:
+            parts = [_rebind_global(res.expr, leaves, new_offsets)
+                     for res in now]
+            residual = parts[0]
+            for x in parts[1:]:
+                residual = rx.RCall("and", (residual, x), dt.BooleanType())
+        plan = pn.JoinExec(plan, leaves[r].node, join_type,
+                           tuple(lks), tuple(rks), residual)
+    if pending_res:
+        return None  # residual referencing an unreachable combination
+    return plan
+
+
+def _rebind_global(expr: rx.Rex, leaves, new_offsets) -> rx.Rex:
+    remap = {}
+    for i in rx.references(expr):
+        li = _leaf_of_index(leaves, i)
+        remap[i] = new_offsets[li] + (i - leaves[li].offset)
+    return _remap(expr, remap)
+
+
+def _remap(r: rx.Rex, remap: Dict[int, int]) -> rx.Rex:
+    if isinstance(r, rx.BoundRef):
+        return dataclasses.replace(r, index=remap.get(r.index, r.index))
+    if isinstance(r, rx.RCall):
+        return dataclasses.replace(
+            r, args=tuple(_remap(a, remap) for a in r.args))
+    if isinstance(r, rx.RCast):
+        return dataclasses.replace(r, child=_remap(r.child, remap))
+    if isinstance(r, rx.RLambda):
+        return dataclasses.replace(r, body=_remap(r.body, remap))
+    if isinstance(r, rx.RCase):
+        return dataclasses.replace(
+            r,
+            branches=tuple((_remap(c, remap), _remap(v, remap))
+                           for c, v in r.branches),
+            else_value=None if r.else_value is None
+            else _remap(r.else_value, remap))
+    return r

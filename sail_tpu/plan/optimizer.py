@@ -25,8 +25,18 @@ from . import rex as rx
 
 def optimize(plan: pn.PlanNode) -> pn.PlanNode:
     plan = push_filters(plan)
+    plan = _maybe_reorder_joins(plan)
     plan = prune_columns(plan)
     return plan
+
+
+def _maybe_reorder_joins(plan: pn.PlanNode) -> pn.PlanNode:
+    from ..config import get as config_get
+    if str(config_get("optimizer.enable_join_reorder", "true")).lower() \
+            in ("0", "false", "off"):
+        return plan
+    from .join_reorder import reorder_joins
+    return reorder_joins(plan)
 
 
 # ---------------------------------------------------------------------------

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import datetime
 import decimal
+import functools
 import re
 from typing import List, Optional, Tuple
 
@@ -1715,7 +1716,13 @@ def parse_sql(text: str) -> List[pl.Plan]:
     return Parser(text).parse_statements()
 
 
+@functools.lru_cache(maxsize=256)
 def parse_one(text: str) -> pl.Plan:
+    """Parse one statement. Results are memoized: spec plans are frozen
+    dataclasses (pure text → IR), so repeated queries — dashboards,
+    benchmark steady state, prepared-statement-style workloads — skip the
+    lexer/parser entirely (the reference caches at the DataFusion logical
+    layer instead; here parse is the analogous pure prefix)."""
     stmts = parse_sql(text)
     if len(stmts) != 1:
         raise ValueError(f"expected one statement, got {len(stmts)}")

@@ -1,0 +1,245 @@
+"""Native (C++) host-kernel path under DEFAULT settings.
+
+Regression suite for the round-4 self-deadlock: ``cc.available()`` used to
+hold the module lock while the probe re-acquired it via
+``compile_and_load``, wedging the first aggregate on any CPU backend.
+These tests run with native ENABLED (no SAIL_NATIVE=0 anywhere) and bound
+every entry with a watchdog so a reintroduced deadlock fails fast instead
+of hanging the suite.
+
+Reference role: DataFusion's vectorized native aggregate operators
+(SURVEY.md §2.4-2.5).
+"""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession
+from sail_tpu.native import cc, native_active
+
+
+def _bounded(fn, timeout=180.0):
+    """Run fn in a thread; fail the test if it doesn't finish in time."""
+    result = {}
+
+    def target():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # propagate to the main thread
+            result["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        pytest.fail(f"deadlock/timeout: {fn} did not finish in {timeout}s")
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+def test_available_probe_does_not_deadlock():
+    assert _bounded(cc.available, timeout=120.0) in (True, False)
+
+
+def test_available_concurrent_callers():
+    # Hammer the probe from many threads; all must return, none may hang.
+    results = []
+    threads = [threading.Thread(target=lambda: results.append(cc.available()))
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive(), "available() hung under concurrency"
+    assert len(set(results)) == 1
+
+
+def test_group_by_with_native_enabled_default_settings():
+    spark = SparkSession({})
+    df = pd.DataFrame({
+        "k": ["a", "b", "a", "c", "b", "a"],
+        "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+        "i": [1, 2, 3, 4, 5, 6],
+    })
+    spark.createDataFrame(df).createOrReplaceTempView("t")
+
+    def run():
+        return spark.sql(
+            "SELECT k, SUM(v), COUNT(*), AVG(i), MIN(v), MAX(i) "
+            "FROM t GROUP BY k ORDER BY k").toPandas()
+
+    got = _bounded(run)
+    assert list(got.iloc[:, 0]) == ["a", "b", "c"]
+    np.testing.assert_allclose(got.iloc[:, 1], [10.0, 7.0, 4.0])
+    assert list(got.iloc[:, 2]) == [3, 2, 1]
+    np.testing.assert_allclose(got.iloc[:, 3], [10 / 3, 3.5, 4.0])
+
+
+def test_native_path_actually_used_when_active(monkeypatch):
+    """When the toolchain is available on a CPU backend, the fused kernel
+    must be chosen for a dictionary-key aggregate (not silently skipped)."""
+    import sail_tpu.native as native_mod
+    import sail_tpu.exec.local as local_mod
+
+    if not _bounded(native_active, timeout=120.0):
+        pytest.skip("no native toolchain / not on CPU backend")
+
+    calls = []
+    real = native_mod.try_native_agg
+
+    def spy(*a, **kw):
+        out = real(*a, **kw)
+        calls.append(out is not None)
+        return out
+
+    monkeypatch.setattr(local_mod, "try_native_agg", spy, raising=False)
+    monkeypatch.setattr(native_mod, "try_native_agg", spy)
+
+    # Under the 8-device virtual test mesh, aggregates normally compile
+    # into the SPMD mesh program; force the local path so the native
+    # host kernel is the one under test.
+    spark = SparkSession({"spark.sail.execution.mesh": "off"})
+    df = pd.DataFrame({"k": ["x", "y", "x"] * 50, "v": [1.0] * 150})
+    spark.createDataFrame(df).createOrReplaceTempView("tn")
+    got = _bounded(lambda: spark.sql(
+        "SELECT k, SUM(v) FROM tn GROUP BY k ORDER BY k").toPandas())
+    assert list(got.iloc[:, 0]) == ["x", "y"]
+    assert any(calls), "try_native_agg was never consulted"
+    assert any(c for c in calls), "native kernel never ran despite being active"
+
+
+@pytest.fixture(scope="module")
+def native_spark():
+    spark = SparkSession({"spark.sail.execution.mesh": "off"})
+    if not _bounded(native_active, timeout=120.0):
+        pytest.skip("no native toolchain / not on CPU backend")
+    return spark
+
+
+def _native_query(spark, df, sql, view="tm"):
+    """Run sql through the engine asserting the native kernel handled the
+    aggregate (not the device fallback)."""
+    import sail_tpu.native as native_mod
+    spark.createDataFrame(df).createOrReplaceTempView(view)
+    used = []
+    real = native_mod.try_native_agg
+
+    def spy(*a, **kw):
+        out = real(*a, **kw)
+        used.append(out is not None)
+        return out
+
+    native_mod.try_native_agg = spy
+    try:
+        got = _bounded(lambda: spark.sql(sql).toPandas())
+    finally:
+        native_mod.try_native_agg = real
+    assert used and used[-1], f"native agg declined for: {sql}"
+    return got
+
+
+class TestNativeKeyTypes:
+    """Hash-mode group keys: the native kernel must handle arbitrary key
+    types, not just small dictionary domains (round-4 gap)."""
+
+    def test_int64_high_cardinality(self, native_spark):
+        n = 20000
+        df = pd.DataFrame({"k": np.arange(n) % 3000,
+                           "v": np.arange(n, dtype=np.float64)})
+        got = _native_query(native_spark, df,
+                            "SELECT k, SUM(v), COUNT(*) FROM tm GROUP BY k")
+        exp = df.groupby("k")["v"].agg(["sum", "count"])
+        got = got.sort_values(got.columns[0]).reset_index(drop=True)
+        assert len(got) == 3000
+        np.testing.assert_allclose(got.iloc[:, 1], exp["sum"].values)
+        assert (got.iloc[:, 2].values == exp["count"].values).all()
+
+    def test_multi_key_int_and_string(self, native_spark):
+        df = pd.DataFrame({
+            "a": [1, 1, 2, 2, 1] * 20,
+            "b": ["x", "y", "x", "y", "x"] * 20,
+            "v": np.arange(100, dtype=np.float64),
+        })
+        got = _native_query(
+            native_spark, df,
+            "SELECT a, b, SUM(v) FROM tm GROUP BY a, b ORDER BY a, b")
+        exp = df.groupby(["a", "b"])["v"].sum().reset_index()
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(
+            got.sort_values([got.columns[0], got.columns[1]]).iloc[:, 2],
+            exp.sort_values(["a", "b"])["v"].values)
+
+    def test_nullable_int_keys(self, native_spark):
+        df = pd.DataFrame({
+            "k": pd.array([1, None, 2, None, 1, 2, None, 3] * 10,
+                          dtype="Int64"),
+            "v": [1.0] * 80,
+        })
+        got = _native_query(native_spark, df,
+                            "SELECT k, COUNT(*) FROM tm GROUP BY k")
+        got = got.sort_values(got.columns[0], na_position="last")
+        counts = dict(zip(got.iloc[:, 0].tolist(), got.iloc[:, 1].tolist()))
+        assert len(got) == 4  # 1, 2, 3, NULL
+        assert got.iloc[:, 1].sum() == 80
+        assert counts[3] == 10
+
+    def test_float_keys_nan_and_negzero(self, native_spark):
+        df = pd.DataFrame({
+            "k": [1.5, -0.0, 0.0, float("nan"), 1.5, float("nan")] * 10,
+            "v": [1] * 60,
+        })
+        got = _native_query(native_spark, df,
+                            "SELECT k, COUNT(*) FROM tm GROUP BY k")
+        # Spark grouping: all NaN one group, -0.0 == 0.0
+        assert len(got) == 3
+        assert got.iloc[:, 1].tolist() == [20, 20, 20]
+
+    def test_date_keys(self, native_spark):
+        import datetime
+        dates = [datetime.date(2024, 1, 1), datetime.date(2024, 6, 15),
+                 datetime.date(2024, 1, 1)]
+        df = pd.DataFrame({"d": dates * 30, "v": [2.0] * 90})
+        got = _native_query(native_spark, df,
+                            "SELECT d, SUM(v) FROM tm GROUP BY d ORDER BY d")
+        assert len(got) == 2
+        np.testing.assert_allclose(got.iloc[:, 1], [120.0, 60.0])
+
+    def test_decimal_keys(self, native_spark):
+        import decimal
+        df = pd.DataFrame({
+            "p": [decimal.Decimal("1.25"), decimal.Decimal("3.50"),
+                  decimal.Decimal("1.25")] * 20,
+            "v": [1] * 60,
+        })
+        got = _native_query(native_spark, df,
+                            "SELECT p, COUNT(*) FROM tm GROUP BY p ORDER BY p")
+        assert len(got) == 2
+        assert got.iloc[:, 1].tolist() == [40, 20]
+
+    def test_empty_global_sum_is_null(self, native_spark):
+        df = pd.DataFrame({"x": [1, 2, 3]})
+        native_spark.createDataFrame(df).createOrReplaceTempView("tg")
+        got = _bounded(lambda: native_spark.sql(
+            "SELECT SUM(x), COUNT(*) FROM tg WHERE x > 100").toPandas())
+        assert pd.isna(got.iloc[0, 0])  # SUM over zero rows → NULL
+        assert got.iloc[0, 1] == 0
+
+    def test_group_by_with_filter_chain(self, native_spark):
+        n = 5000
+        df = pd.DataFrame({"k": np.arange(n) % 500,
+                           "v": np.arange(n, dtype=np.float64)})
+        got = _native_query(
+            native_spark, df,
+            "SELECT k, SUM(v), MIN(v), MAX(v) FROM tm "
+            "WHERE v >= 1000 GROUP BY k")
+        sub = df[df.v >= 1000]
+        exp = sub.groupby("k")["v"].agg(["sum", "min", "max"])
+        got = got.sort_values(got.columns[0]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(got.iloc[:, 1], exp["sum"].values)
+        np.testing.assert_allclose(got.iloc[:, 2], exp["min"].values)
+        np.testing.assert_allclose(got.iloc[:, 3], exp["max"].values)
