@@ -7,8 +7,10 @@ resolve to their current metadata location and scan through the engine's
 own Iceberg reader (sail_tpu/lakehouse/iceberg).
 
 Uses only the standard library HTTP client so it works against any
-spec-conformant server (tested in-repo against a fake REST server, the
-same pattern as the KubernetesWorkerManager fake API).
+spec-conformant server. Tested in tests/test_catalog_providers.py against
+an in-repo fake REST server (the KubernetesWorkerManager fake-API
+pattern); registered from config via the ``catalog.*`` keys
+(catalog/manager.py::configure_catalogs).
 """
 
 from __future__ import annotations
@@ -31,23 +33,33 @@ class IcebergRestCatalog(CatalogProvider):
         self.uri = uri.rstrip("/")
         self.token = token
         self.timeout = timeout
-        self.prefix = prefix
-        if self.prefix is None:
-            cfg = self._get("/v1/config",
-                            query={"warehouse": warehouse}
-                            if warehouse else None, default={})
-            overrides = cfg.get("overrides", {}) if isinstance(cfg, dict) else {}
-            self.prefix = overrides.get("prefix", "")
+        self.warehouse = warehouse
+        self._prefix = prefix  # None → fetched lazily from /v1/config
+
+    @property
+    def prefix(self) -> str:
+        # lazy: construction must not touch the network (config-registered
+        # catalogs are built at session start even if unused)
+        if self._prefix is None:
+            cfg = self._request(
+                "GET", "/v1/config",
+                query={"warehouse": self.warehouse}
+                if self.warehouse else None, default={}, raw_path=True)
+            overrides = cfg.get("overrides", {}) \
+                if isinstance(cfg, dict) else {}
+            self._prefix = overrides.get("prefix", "")
+        return self._prefix
 
     # -- HTTP ------------------------------------------------------------
-    def _url(self, path: str) -> str:
-        if self.prefix:
+    def _url(self, path: str, raw_path: bool = False) -> str:
+        if not raw_path and self.prefix:
             path = path.replace("/v1/", f"/v1/{self.prefix}/", 1)
         return self.uri + path
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
-                 query: Optional[dict] = None, default=None):
-        url = self._url(path)
+                 query: Optional[dict] = None, default=None,
+                 raw_path: bool = False):
+        url = self._url(path, raw_path)
         if query:
             url += "?" + urllib.parse.urlencode(
                 {k: v for k, v in query.items() if v is not None})

@@ -31,7 +31,7 @@ class TableEntry:
 
 
 class CatalogManager:
-    def __init__(self):
+    def __init__(self, configure: bool = True):
         from ..functions.udf import UDFRegistry
         from .provider import MemoryCatalogProvider
         self.current_catalog = "spark_catalog"
@@ -40,6 +40,8 @@ class CatalogManager:
             "spark_catalog": MemoryCatalogProvider("spark_catalog")}
         self.temp_views: Dict[str, TableEntry] = {}
         self.udfs = UDFRegistry()
+        if configure:
+            configure_catalogs(self)
 
     # -- provider registry ----------------------------------------------
     def register_catalog(self, name: str, provider) -> None:
@@ -134,3 +136,63 @@ class CatalogManager:
 
     def list_databases(self) -> List[str]:
         return self.provider().list_databases()
+
+
+def configure_catalogs(manager: CatalogManager) -> None:
+    """Register catalogs declared in config (reference: the reference's
+    ``catalog.*`` AppConfig keys wiring providers into every session).
+
+    ``catalog.list`` names the catalogs (comma separated); each gets a
+    ``catalog.<name>.type`` plus type-specific keys — e.g.
+
+        SAIL_CATALOG__LIST=prod
+        SAIL_CATALOG__PROD__TYPE=iceberg_rest
+        SAIL_CATALOG__PROD__URI=http://rest:8181
+
+    Provider construction never touches the network (clients are lazy),
+    so a down catalog server fails at first use, not session start.
+    """
+    from ..config import get as config_get
+
+    names = str(config_get("catalog.list", "") or "")
+    for nm in [s.strip() for s in names.split(",") if s.strip()]:
+        key = nm.lower()
+        ctype = str(config_get(f"catalog.{key}.type", "") or "").lower()
+        try:
+            if ctype in ("iceberg_rest", "iceberg-rest", "rest"):
+                from .iceberg_rest import IcebergRestCatalog
+                provider = IcebergRestCatalog(
+                    nm,
+                    uri=str(config_get(f"catalog.{key}.uri", "")),
+                    warehouse=config_get(f"catalog.{key}.warehouse"),
+                    token=config_get(f"catalog.{key}.token"),
+                    prefix=config_get(f"catalog.{key}.prefix"))
+            elif ctype in ("hms", "hive", "hive_metastore"):
+                from .hms import HiveMetastoreCatalog
+                provider = HiveMetastoreCatalog(
+                    nm,
+                    host=str(config_get(f"catalog.{key}.host",
+                                        "localhost")),
+                    port=int(config_get(f"catalog.{key}.port", 9083)))
+            elif ctype == "memory":
+                from .provider import MemoryCatalogProvider
+                provider = MemoryCatalogProvider(nm)
+            else:
+                raise ValueError(f"unknown catalog type {ctype!r}")
+        except Exception as e:  # noqa: BLE001 — a bad catalog entry must
+            # not take down the session; surface it on first use instead
+            provider = _BrokenCatalog(nm, str(e))
+        manager.providers[key] = provider
+    default = config_get("catalog.default")
+    if default:
+        manager.current_catalog = str(default).lower()
+
+
+class _BrokenCatalog:
+    def __init__(self, name: str, error: str):
+        self.name = name
+        self._error = error
+
+    def __getattr__(self, item):
+        raise RuntimeError(
+            f"catalog {self.name!r} failed to configure: {self._error}")

@@ -1,0 +1,210 @@
+"""Minimal Apache Thrift binary-protocol codec + socket client.
+
+Reference role: the reference talks to Hive Metastore over volo-thrift
+codegen (crates/sail-common-hms/src/lib.rs, sail-catalog-hms). No thrift
+library ships in this environment, so this implements the TBinaryProtocol
+strict wire format from scratch — enough for the HMS call surface the
+catalog provider needs. Generic decoding: structs come back as
+{field_id: value} dicts, so no per-struct codegen is required; the HMS
+provider maps well-known field ids (hive_metastore.thrift) onto names.
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+# TType ids
+BOOL, BYTE, DOUBLE = 2, 3, 4
+I16, I32, I64 = 6, 8, 10
+STRING, STRUCT, MAP, SET, LST = 11, 12, 13, 14, 15
+STOP = 0
+
+VERSION_1 = 0x80010000
+MSG_CALL, MSG_REPLY, MSG_EXCEPTION = 1, 2, 3
+
+
+class ThriftError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# encoding — values are (ttype, payload) pairs for explicitness
+# ---------------------------------------------------------------------------
+
+def enc_value(out: bytearray, ttype: int, v: Any) -> None:
+    if ttype == BOOL:
+        out.append(1 if v else 0)
+    elif ttype == BYTE:
+        out += struct.pack(">b", v)
+    elif ttype == DOUBLE:
+        out += struct.pack(">d", v)
+    elif ttype == I16:
+        out += struct.pack(">h", v)
+    elif ttype == I32:
+        out += struct.pack(">i", v)
+    elif ttype == I64:
+        out += struct.pack(">q", v)
+    elif ttype == STRING:
+        b = v.encode() if isinstance(v, str) else bytes(v)
+        out += struct.pack(">i", len(b))
+        out += b
+    elif ttype == STRUCT:
+        # v: list of (field_id, ttype, value)
+        for fid, ft, fv in v:
+            if fv is None:
+                continue
+            out.append(ft)
+            out += struct.pack(">h", fid)
+            enc_value(out, ft, fv)
+        out.append(STOP)
+    elif ttype == LST or ttype == SET:
+        et, items = v  # (elem ttype, [values])
+        out.append(et)
+        out += struct.pack(">i", len(items))
+        for it in items:
+            enc_value(out, et, it)
+    elif ttype == MAP:
+        kt, vt, entries = v
+        out.append(kt)
+        out.append(vt)
+        out += struct.pack(">i", len(entries))
+        for k, val in entries.items():
+            enc_value(out, kt, k)
+            enc_value(out, vt, val)
+    else:
+        raise ThriftError(f"cannot encode ttype {ttype}")
+
+
+def dec_value(buf: io.BytesIO, ttype: int) -> Any:
+    if ttype == BOOL:
+        return buf.read(1) == b"\x01"
+    if ttype == BYTE:
+        return struct.unpack(">b", buf.read(1))[0]
+    if ttype == DOUBLE:
+        return struct.unpack(">d", buf.read(8))[0]
+    if ttype == I16:
+        return struct.unpack(">h", buf.read(2))[0]
+    if ttype == I32:
+        return struct.unpack(">i", buf.read(4))[0]
+    if ttype == I64:
+        return struct.unpack(">q", buf.read(8))[0]
+    if ttype == STRING:
+        n = struct.unpack(">i", buf.read(4))[0]
+        b = buf.read(n)
+        try:
+            return b.decode()
+        except UnicodeDecodeError:
+            return b
+    if ttype == STRUCT:
+        out: Dict[int, Any] = {}
+        while True:
+            ft = buf.read(1)
+            if not ft or ft[0] == STOP:
+                return out
+            fid = struct.unpack(">h", buf.read(2))[0]
+            out[fid] = dec_value(buf, ft[0])
+    if ttype in (LST, SET):
+        et = buf.read(1)[0]
+        n = struct.unpack(">i", buf.read(4))[0]
+        return [dec_value(buf, et) for _ in range(n)]
+    if ttype == MAP:
+        kt = buf.read(1)[0]
+        vt = buf.read(1)[0]
+        n = struct.unpack(">i", buf.read(4))[0]
+        return {dec_value(buf, kt): dec_value(buf, vt) for _ in range(n)}
+    raise ThriftError(f"cannot decode ttype {ttype}")
+
+
+def encode_message(name: str, seqid: int,
+                   args: List[Tuple[int, int, Any]],
+                   msg_type: int = MSG_CALL) -> bytes:
+    out = bytearray()
+    out += struct.pack(">I", VERSION_1 | msg_type)
+    enc_value(out, STRING, name)
+    out += struct.pack(">i", seqid)
+    enc_value(out, STRUCT, args)
+    return bytes(out)
+
+
+def decode_message(data: bytes):
+    """→ (name, seqid, msg_type, result {field_id: value})."""
+    buf = io.BytesIO(data)
+    head = struct.unpack(">I", buf.read(4))[0]
+    if head & 0x80000000:
+        msg_type = head & 0xFF
+        name = dec_value(buf, STRING)
+    else:  # old unframed format: string first
+        buf.seek(0)
+        name = dec_value(buf, STRING)
+        msg_type = struct.unpack(">b", buf.read(1))[0]
+    seqid = struct.unpack(">i", buf.read(4))[0]
+    result = dec_value(buf, STRUCT)
+    return name, seqid, msg_type, result
+
+
+class ThriftClient:
+    """Blocking call client over a plain socket (TBufferedTransport).
+
+    HMS replies are read by incremental struct decoding, so no framing is
+    required (matches the metastore's default unframed transport)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+
+    def _connect(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout)
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def call(self, method: str, args: List[Tuple[int, int, Any]]):
+        """Invoke; returns the result struct's field 0 (success) or raises
+        on declared exceptions (any other field set)."""
+        self._connect()
+        self._seq += 1
+        payload = encode_message(method, self._seq, args)
+        try:
+            self._sock.sendall(payload)
+            data = self._read_reply()
+        except (OSError, EOFError) as e:
+            self.close()
+            raise ThriftError(f"hms rpc {method}: {e}")
+        name, _seq, msg_type, result = decode_message(data)
+        if msg_type == MSG_EXCEPTION:
+            raise ThriftError(
+                f"hms {method}: {result.get(1, 'application exception')}")
+        errs = {k: v for k, v in result.items() if k != 0}
+        if errs and 0 not in result:
+            detail = next(iter(errs.values()))
+            if isinstance(detail, dict):
+                detail = detail.get(1, detail)
+            raise ThriftError(f"hms {method}: {detail}")
+        return result.get(0)
+
+    def _read_reply(self) -> bytes:
+        # read until a full message parses (messages are small; HMS closes
+        # or blocks between replies, so incremental parse-and-retry works)
+        chunks = bytearray()
+        while True:
+            b = self._sock.recv(65536)
+            if not b:
+                if chunks:
+                    return bytes(chunks)
+                raise EOFError("connection closed")
+            chunks += b
+            try:
+                decode_message(bytes(chunks))
+                return bytes(chunks)
+            except Exception:  # noqa: BLE001 — incomplete; keep reading
+                continue

@@ -1,0 +1,131 @@
+"""File-listing and parquet-metadata caches.
+
+Reference role: crates/sail-cache/src/file_listing_cache.rs and
+file_metadata_cache.rs (moka TTL caches wired into the session). Every
+query otherwise re-walks scan directories and re-reads parquet footers.
+
+Validation strategy:
+- listing entries carry a TTL (``execution.file_listing_cache.ttl_secs``,
+  0 disables) AND re-stat the input roots on every hit — an external
+  write to a flat directory invalidates immediately via the root's mtime;
+  only nested partition-directory adds ride out the TTL window. Engine
+  writes clear the cache explicitly.
+- parquet footer metadata validates by (size, mtime) per file — always
+  sound, no TTL needed.
+
+Counters (hits/misses) are exposed for tests and system tables.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _stat_sig(path: str) -> Optional[Tuple[float, int]]:
+    try:
+        st = os.stat(path)
+        return (st.st_mtime, st.st_size)
+    except OSError:
+        return None
+
+
+class FileListingCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[Tuple[str, ...], Tuple[float, tuple, List[str]]] = {}
+        self.hits = 0
+        self.misses = 0
+        self._ttl_cached: Optional[float] = None
+
+    def _ttl(self) -> float:
+        # read once (config lookups re-flatten the whole tree — too slow
+        # for the scan planning hot path); clear() re-reads
+        if self._ttl_cached is None:
+            from ..config import get as config_get
+            try:
+                self._ttl_cached = float(
+                    config_get("execution.file_listing_cache.ttl_secs", 30))
+            except (TypeError, ValueError):
+                self._ttl_cached = 30.0
+        return self._ttl_cached
+
+    def get(self, paths: Sequence[str]) -> Optional[List[str]]:
+        key = tuple(paths)
+        with self._lock:
+            entry = self._data.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            expires, validator, files = entry
+            if time.time() > expires:
+                del self._data[key]
+                self.misses += 1
+                return None
+        if tuple(_stat_sig(p) for p in key) != validator:
+            with self._lock:
+                self._data.pop(key, None)
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return list(files)
+
+    def put(self, paths: Sequence[str], files: List[str]) -> None:
+        ttl = self._ttl()
+        if ttl <= 0:
+            return
+        key = tuple(paths)
+        validator = tuple(_stat_sig(p) for p in key)
+        with self._lock:
+            while len(self._data) > 256:
+                self._data.pop(next(iter(self._data)))
+            self._data[key] = (time.time() + ttl, validator, files)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._ttl_cached = None
+
+
+class ParquetMetadataCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, Tuple[Tuple[float, int], object]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def metadata(self, path: str):
+        """pq.FileMetaData for ``path``, validated by (mtime, size)."""
+        sig = _stat_sig(path)
+        with self._lock:
+            entry = self._data.get(path)
+            if entry is not None and entry[0] == sig:
+                self.hits += 1
+                return entry[1]
+            self.misses += 1
+        import pyarrow.parquet as pq
+        md = pq.ParquetFile(path).metadata
+        with self._lock:
+            while len(self._data) > 4096:
+                self._data.pop(next(iter(self._data)))
+            self._data[path] = (sig, md)
+        return md
+
+    def num_rows(self, path: str) -> int:
+        return int(self.metadata(path).num_rows)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+
+LISTING_CACHE = FileListingCache()
+METADATA_CACHE = ParquetMetadataCache()
+
+
+def invalidate_listings() -> None:
+    """Called by every engine-side write (files added/removed)."""
+    LISTING_CACHE.clear()

@@ -23,6 +23,11 @@ from ..spec import data_type as dt
 
 
 def expand_paths(paths: Sequence[str]) -> List[str]:
+    from .cache import LISTING_CACHE
+
+    cached = LISTING_CACHE.get(paths)
+    if cached is not None:
+        return cached
     out: List[str] = []
     for p in paths:
         from .object_store import has_remote_scheme
@@ -38,6 +43,7 @@ def expand_paths(paths: Sequence[str]) -> List[str]:
                         out.append(os.path.join(root, f))
         else:
             out.append(p)
+    LISTING_CACHE.put(paths, out)
     return out
 
 
@@ -48,7 +54,10 @@ def infer_schema(fmt: str, paths: Sequence[str], options: Dict[str, str]) -> dt.
             *_delta_travel(options)).schema
     if fmt.lower() == "iceberg":
         from ..lakehouse.iceberg import IcebergTable
-        return IcebergTable(paths[0]).schema()
+        opts = {k.lower(): v for k, v in options.items()}
+        return IcebergTable(
+            paths[0],
+            metadata_location=opts.get("metadata_location")).schema()
     files = expand_paths(paths)
     if not files:
         raise FileNotFoundError(f"no files found for {paths}")
@@ -127,7 +136,9 @@ def read_table(fmt: str, paths: Sequence[str], options: Dict[str, str],
         opts = {k.lower(): v for k, v in options.items()}
         sid = opts.get("snapshot-id", opts.get("snapshotid"))
         ts = opts.get("as-of-timestamp", opts.get("asoftimestamp"))
-        return IcebergTable(paths[0]).to_arrow(
+        return IcebergTable(
+            paths[0],
+            metadata_location=opts.get("metadata_location")).to_arrow(
             int(sid) if sid is not None else None,
             int(ts) if ts is not None else None, columns=columns)
     files = expand_paths(paths)
@@ -200,6 +211,8 @@ def read_table(fmt: str, paths: Sequence[str], options: Dict[str, str],
 def write_table(table: pa.Table, fmt: str, path: str, mode: str = "error",
                 options: Optional[Dict[str, str]] = None,
                 partition_by: Sequence[str] = ()):
+    from .cache import invalidate_listings
+    invalidate_listings()  # any engine write changes listings
     options = options or {}
     fmt = fmt.lower()
     if fmt == "iceberg":

@@ -23,8 +23,13 @@ _MANIFEST_ENTRY_SCHEMA = {
     "type": "record", "name": "manifest_entry", "fields": [
         {"name": "status", "type": "int"},
         {"name": "snapshot_id", "type": ["null", "long"], "default": None},
+        # explicit data sequence number; null = inherit the manifest's
+        # (spec v2 inheritance for ADDED entries)
+        {"name": "sequence_number", "type": ["null", "long"],
+         "default": None},
         {"name": "data_file", "type": {
             "type": "record", "name": "r2", "fields": [
+                # 0 = data, 1 = position deletes, 2 = equality deletes
                 {"name": "content", "type": "int"},
                 {"name": "file_path", "type": "string"},
                 {"name": "file_format", "type": "string"},
@@ -32,6 +37,10 @@ _MANIFEST_ENTRY_SCHEMA = {
                     "type": "map", "values": ["null", "string"]}},
                 {"name": "record_count", "type": "long"},
                 {"name": "file_size_in_bytes", "type": "long"},
+                # schema field ids of the equality-delete key columns
+                {"name": "equality_ids",
+                 "type": ["null", {"type": "array", "items": "int"}],
+                 "default": None},
             ]}},
     ]}
 
@@ -134,9 +143,13 @@ def _iceberg_type_to_spec(t):
 
 
 class IcebergTable:
-    def __init__(self, path: str):
+    def __init__(self, path: str, metadata_location: Optional[str] = None):
+        """``metadata_location`` pins the table to a specific metadata file
+        (catalog-vended pointer, e.g. HMS/REST ``metadata_location``)
+        instead of the directory's version hint."""
         self.path = path
         self.metadata_dir = os.path.join(path, "metadata")
+        self.metadata_location = metadata_location
 
     # -- metadata --------------------------------------------------------
     @staticmethod
@@ -155,6 +168,9 @@ class IcebergTable:
         return os.path.join(self.metadata_dir, f"v{version}.metadata.json")
 
     def metadata(self, version: Optional[int] = None) -> dict:
+        if version is None and self.metadata_location:
+            with open(self.metadata_location) as f:
+                return json.load(f)
         v = version if version is not None else self._current_version()
         if v is None:
             raise FileNotFoundError(f"not an Iceberg table: {self.path}")
@@ -191,7 +207,9 @@ class IcebergTable:
                 return s
         raise ValueError(f"snapshot {snapshot_id} not found")
 
-    def data_files(self, snapshot: Optional[dict]) -> List[dict]:
+    def _entries(self, snapshot: Optional[dict]) -> List[Tuple[dict, int]]:
+        """All live (data_file, data_sequence_number) pairs of a snapshot,
+        delete files included (distinguished by data_file['content'])."""
         if snapshot is None:
             return []
         mlist_path = snapshot["manifest-list"]
@@ -204,10 +222,107 @@ class IcebergTable:
                 os.path.join(self.path, m["manifest_path"])
                 if not os.path.isabs(m["manifest_path"])
                 else m["manifest_path"])
+            mseq = m.get("sequence_number", 0)
             for e in entries:
                 if e["status"] in (0, 1):  # existing | added
-                    out.append(e["data_file"])
+                    seq = e.get("sequence_number")
+                    out.append((e["data_file"],
+                                mseq if seq is None else seq))
         return out
+
+    def data_files(self, snapshot: Optional[dict]) -> List[dict]:
+        return [df for df, _ in self._entries(snapshot)
+                if df.get("content", 0) == 0]
+
+    def delete_files(self, snapshot: Optional[dict]) -> List[Tuple[dict, int]]:
+        """(delete_file, data_sequence_number) pairs: content 1 = position
+        deletes, 2 = equality deletes (reference:
+        crates/sail-iceberg/src/spec/delete_index.rs)."""
+        return [(df, seq) for df, seq in self._entries(snapshot)
+                if df.get("content", 0) in (1, 2)]
+
+    def _field_names_by_id(self) -> Dict[int, str]:
+        md = self.metadata()
+        sid = md.get("current-schema-id", 0)
+        schemas = md.get("schemas", [])
+        schema = next((s for s in schemas if s.get("schema-id") == sid),
+                      schemas[0] if schemas else {"fields": []})
+        return {f["id"]: f["name"] for f in schema.get("fields", [])}
+
+    def _resolve_path(self, fp: str) -> str:
+        return fp if os.path.isabs(fp) else os.path.join(self.path, fp)
+
+    def _load_delete_index(self, entries):
+        """Position deletes as {data file_path: [(delete_seq, positions)]}
+        and equality deletes as [(delete_seq, key column names, key table)].
+        ``entries`` is the (data_file, seq) list from one _entries() walk —
+        manifests are read once per scan, not once per purpose."""
+        import pyarrow.parquet as pq
+
+        pos: Dict[str, List[Tuple[int, List[int]]]] = {}
+        eq: List[Tuple[int, List[str], object]] = []
+        by_id = None
+        for df, seq in entries:
+            if df.get("content", 0) not in (1, 2):
+                continue
+            t = pq.read_table(self._resolve_path(df["file_path"]))
+            if df.get("content") == 1:  # position deletes
+                paths = t.column("file_path").to_pylist()
+                positions = t.column("pos").to_pylist()
+                grouped: Dict[str, List[int]] = {}
+                for p, i in zip(paths, positions):
+                    grouped.setdefault(p, []).append(i)
+                for p, idxs in grouped.items():
+                    pos.setdefault(p, []).append((seq, idxs))
+            else:  # equality deletes
+                ids = df.get("equality_ids") or []
+                if by_id is None:
+                    by_id = self._field_names_by_id()
+                cols = [by_id[i] for i in ids if i in by_id]
+                if not cols:  # fall back to the delete file's own columns
+                    cols = t.column_names
+                eq.append((seq, cols, t.select(cols)))
+        return pos, eq
+
+    def _apply_deletes(self, table, file_path: str, data_seq: int,
+                       pos_index, eq_deletes):
+        """Row-level delete application during scan (reference:
+        IcebergDeleteApplyExec). Position deletes apply when
+        delete_seq >= data_seq; equality deletes when delete_seq >
+        data_seq."""
+        import numpy as np
+        import pyarrow as pa
+
+        if table.num_rows == 0:
+            return table
+        mask = None
+        # delete files written by other engines usually record the fully
+        # resolved data-file path; ours record the stored (relative) one
+        pos_lists = (pos_index.get(file_path, [])
+                     + pos_index.get(self._resolve_path(file_path), []))
+        for seq, idxs in pos_lists:
+            if seq >= data_seq:
+                if mask is None:
+                    mask = np.ones(table.num_rows, dtype=bool)
+                idx = np.asarray(idxs, dtype=np.int64)
+                mask[idx[(idx >= 0) & (idx < table.num_rows)]] = False
+        for seq, cols, keys in eq_deletes:
+            if seq <= data_seq or keys.num_rows == 0:
+                continue
+            avail = [c for c in cols if c in table.column_names]
+            if len(avail) != len(cols):
+                continue
+            import pandas as pd
+            left = table.select(cols).to_pandas()
+            right = keys.to_pandas().drop_duplicates()
+            hit = left.merge(right.assign(__del=True), on=cols, how="left")
+            dead = hit["__del"].fillna(False).to_numpy(dtype=bool)
+            if mask is None:
+                mask = np.ones(table.num_rows, dtype=bool)
+            mask &= ~dead
+        if mask is None or mask.all():
+            return table
+        return table.filter(pa.array(mask))
 
     def to_arrow(self, snapshot_id: Optional[int] = None,
                  timestamp_ms: Optional[int] = None,
@@ -217,14 +332,26 @@ class IcebergTable:
         from ...columnar.arrow_interop import spec_type_to_arrow
 
         snap = self.snapshot(snapshot_id, timestamp_ms)
-        files = self.data_files(snap)
+        all_entries = self._entries(snap)
+        entries = [(df, seq) for df, seq in all_entries
+                   if df.get("content", 0) == 0]
+        pos_index, eq_deletes = self._load_delete_index(all_entries)
+        # equality filtering needs the key columns even when projected out
+        read_cols = None
+        if columns is not None:
+            need = set(columns)
+            for _, cols, _ in eq_deletes:
+                need.update(cols)
+            read_cols = [c for c in need]
         tables = []
-        for df in files:
+        for df, seq in entries:
             fp = df["file_path"]
-            if not os.path.isabs(fp):
-                fp = os.path.join(self.path, fp)
-            tables.append(pq.read_table(
-                fp, columns=list(columns) if columns else None))
+            t = pq.read_table(self._resolve_path(fp),
+                              columns=read_cols if read_cols else None)
+            t = self._apply_deletes(t, fp, seq, pos_index, eq_deletes)
+            if columns is not None:
+                t = t.select(list(columns))
+            tables.append(t)
         if not tables:
             st = self.schema()
             fields = [(f.name, spec_type_to_arrow(f.data_type))
@@ -279,6 +406,10 @@ class IcebergTable:
         return 1
 
     def _write_metadata_version(self, version: int, md: dict):
+        # commits add files under data/ and metadata/ without touching the
+        # table root's mtime — stale listings must be dropped explicitly
+        from ...io.cache import invalidate_listings
+        invalidate_listings()
         path = self._metadata_path(version)
         tmp = path + f".{uuid.uuid4().hex}.tmp"
         with open(tmp, "w") as f:
@@ -338,36 +469,56 @@ class IcebergTable:
 
     def _commit_snapshot(self, new_entries: List[dict],
                          carry_forward: bool, operation: str,
+                         new_content: int = 0,
                          max_retries: int = 10) -> int:
         for _ in range(max_retries):
             version = self._current_version()
             md = self.metadata(version)
             seq = md["last-sequence-number"] + 1
             snap_id = int(uuid.uuid4().int % (1 << 62))
-            manifest_name = f"metadata/{uuid.uuid4().hex}-m0.avro"
-            entries = [{"status": 1, "snapshot_id": snap_id,
-                        "data_file": df} for df in new_entries]
+            # added entries inherit the new sequence number; carried
+            # entries keep their original one explicitly (spec v2)
+            groups: List[Tuple[int, List[dict]]] = []
+            added = [{"status": 1, "snapshot_id": snap_id,
+                      "sequence_number": None, "data_file": df}
+                     for df in new_entries]
+            if added:
+                groups.append((new_content, added))
             if carry_forward:
                 prev = self.snapshot()
-                for df in self.data_files(prev):
-                    entries.append({"status": 0, "snapshot_id": snap_id,
-                                    "data_file": df})
-            avro_io.write_container(
-                os.path.join(self.path, manifest_name),
-                _MANIFEST_ENTRY_SCHEMA, entries)
+                carried_data, carried_del = [], []
+                for df, dseq in self._entries(prev):
+                    e = {"status": 0, "snapshot_id": snap_id,
+                         "sequence_number": dseq, "data_file": df}
+                    (carried_del if df.get("content", 0) in (1, 2)
+                     else carried_data).append(e)
+                if carried_data:
+                    groups.append((0, carried_data))
+                if carried_del:
+                    groups.append((1, carried_del))
+            mfiles = []
+            for gi, (content, entries) in enumerate(groups):
+                manifest_name = f"metadata/{uuid.uuid4().hex}-m{gi}.avro"
+                avro_io.write_container(
+                    os.path.join(self.path, manifest_name),
+                    _MANIFEST_ENTRY_SCHEMA, entries)
+                n_added = sum(1 for e in entries if e["status"] == 1)
+                mfiles.append({
+                    "manifest_path": manifest_name,
+                    "manifest_length": os.path.getsize(
+                        os.path.join(self.path, manifest_name)),
+                    "partition_spec_id": 0, "content": content,
+                    "sequence_number": seq, "added_snapshot_id": snap_id,
+                    "added_files_count": n_added,
+                    "existing_files_count": len(entries) - n_added,
+                    "deleted_files_count": 0,
+                    "added_rows_count": sum(
+                        e["data_file"]["record_count"] for e in entries
+                        if e["status"] == 1)})
             mlist_name = f"metadata/snap-{snap_id}.avro"
             avro_io.write_container(
                 os.path.join(self.path, mlist_name), _MANIFEST_FILE_SCHEMA,
-                [{"manifest_path": manifest_name,
-                  "manifest_length": os.path.getsize(
-                      os.path.join(self.path, manifest_name)),
-                  "partition_spec_id": 0, "content": 0,
-                  "sequence_number": seq, "added_snapshot_id": snap_id,
-                  "added_files_count": len(new_entries),
-                  "existing_files_count": len(entries) - len(new_entries),
-                  "deleted_files_count": 0,
-                  "added_rows_count": sum(df["record_count"]
-                                          for df in new_entries)}])
+                mfiles)
             snapshot = {
                 "snapshot-id": snap_id,
                 "sequence-number": seq,
@@ -398,3 +549,75 @@ class IcebergTable:
         return self._commit_snapshot(self._write_data_files(table),
                                      carry_forward=False,
                                      operation="overwrite")
+
+    # -- row-level deletes (merge-on-read) --------------------------------
+    def add_position_deletes(self, deletes: Dict[str, Sequence[int]]) -> int:
+        """Commit a position-delete file: {data file_path as stored in the
+        metadata: row positions}. Merge-on-read — data files are untouched
+        (reference: sail-iceberg deletion content files, spec v2)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        paths, positions = [], []
+        for fp, idxs in deletes.items():
+            for i in sorted(idxs):
+                paths.append(fp)
+                positions.append(int(i))
+        name = f"data/{uuid.uuid4().hex}-deletes.parquet"
+        full = os.path.join(self.path, name)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        pq.write_table(pa.table({
+            "file_path": pa.array(paths, type=pa.string()),
+            "pos": pa.array(positions, type=pa.int64())}), full)
+        entry = {"content": 1, "file_path": name, "file_format": "PARQUET",
+                 "partition": {}, "record_count": len(paths),
+                 "file_size_in_bytes": os.path.getsize(full)}
+        return self._commit_snapshot([entry], carry_forward=True,
+                                     operation="delete", new_content=1)
+
+    def add_equality_deletes(self, keys, columns: Sequence[str]) -> int:
+        """Commit an equality-delete file: rows of ``keys`` (a pyarrow
+        Table) matching on ``columns`` are deleted from all EARLIER data
+        files (delete_seq > data_seq semantics)."""
+        import pyarrow.parquet as pq
+
+        name = f"data/{uuid.uuid4().hex}-eq-deletes.parquet"
+        full = os.path.join(self.path, name)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        keys = keys.select(list(columns))
+        pq.write_table(keys, full)
+        by_name = {v: k for k, v in self._field_names_by_id().items()}
+        unknown = [c for c in columns if c not in by_name]
+        if unknown:
+            # narrowing the key would delete rows the caller never targeted
+            raise ValueError(
+                f"equality-delete key columns not in table schema: {unknown}")
+        entry = {"content": 2, "file_path": name, "file_format": "PARQUET",
+                 "partition": {}, "record_count": keys.num_rows,
+                 "file_size_in_bytes": os.path.getsize(full),
+                 "equality_ids": [by_name[c] for c in columns]}
+        return self._commit_snapshot([entry], carry_forward=True,
+                                     operation="delete", new_content=1)
+
+    def delete_where(self, mask_fn) -> int:
+        """Row-level DELETE via position-delete files: ``mask_fn`` maps a
+        per-file pyarrow Table to a boolean numpy array (True = delete).
+        Re-recording an already-deleted position is a harmless no-op, so
+        the raw file rows are passed to ``mask_fn`` unfiltered."""
+        import numpy as np
+        import pyarrow.parquet as pq
+
+        snap = self.snapshot()
+        out: Dict[str, List[int]] = {}
+        for df, _dseq in self._entries(snap):
+            if df.get("content", 0) != 0:
+                continue
+            fp = df["file_path"]
+            t = pq.read_table(self._resolve_path(fp))
+            dead = np.asarray(mask_fn(t), dtype=bool)
+            hits = np.flatnonzero(dead)
+            if len(hits):
+                out[fp] = [int(i) for i in hits]
+        if not out:
+            return snap["snapshot-id"] if snap else -1
+        return self.add_position_deletes(out)

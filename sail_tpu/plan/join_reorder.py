@@ -161,31 +161,14 @@ def _single_leaf(leaves, expr) -> Optional[int]:
 # cardinality estimation
 # ---------------------------------------------------------------------------
 
-_PARQUET_ROWS_CACHE: Dict[Tuple[str, float], float] = {}
-
-
-def _parquet_rows(path: str) -> float:
-    """Footer row count, cached by (path, mtime) — planning must not
-    re-open footers per query (the statistics cache's eventual job)."""
-    try:
-        import os
-        key = (path, os.path.getmtime(path))
-    except OSError:
-        key = (path, -1.0)
-    hit = _PARQUET_ROWS_CACHE.get(key)
-    if hit is None:
-        import pyarrow.parquet as pq
-        hit = float(pq.ParquetFile(path).metadata.num_rows)
-        _PARQUET_ROWS_CACHE[key] = hit
-    return hit
-
-
 def _scan_rows(p: pn.ScanExec) -> float:
     if p.source is not None and hasattr(p.source, "num_rows"):
         return float(p.source.num_rows)
     if p.format == "parquet" and p.paths:
         try:
-            return float(sum(_parquet_rows(path) for path in p.paths[:64]))
+            from ..io.cache import METADATA_CACHE
+            return float(sum(METADATA_CACHE.num_rows(path)
+                             for path in p.paths[:64]))
         except Exception:
             return _DEFAULT_ROWS
     return _DEFAULT_ROWS

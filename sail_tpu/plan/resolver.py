@@ -275,8 +275,13 @@ class Resolver:
             return node, Scope(fields, outer, ctes)
         schema = tuple(pn.Field(f.name, f.data_type, f.nullable)
                        for f in entry.schema.fields)
+        # catalog-vended options (e.g. an Iceberg metadata_location pin)
+        # apply first; per-read options override them
+        opts = dict(entry.options)
+        opts.update(dict(plan.options))
         node = pn.ScanExec(schema, entry.data, tuple(entry.paths), entry.format,
-                           tuple(plan.options), None, ".".join(plan.name))
+                           tuple(sorted(opts.items())), None,
+                           ".".join(plan.name))
         qual = plan.name[-1]
         fields = [ScopeField(f.name, (qual,), f.dtype, f.nullable) for f in schema]
         return node, Scope(fields, outer, ctes)

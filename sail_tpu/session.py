@@ -346,8 +346,30 @@ class SparkSession:
         return self._execute_query(plan)
 
     def _delta_delete(self, cmd: sp.Delete) -> pa.Table:
+        entry = self.catalog_manager.lookup_table(cmd.table)
+        if entry is not None and entry.format == "iceberg" and entry.paths:
+            return self._iceberg_delete(entry, cmd)
         from .lakehouse.delta.dml import DeltaDml
         return DeltaDml(self, cmd.table).delete(cmd.condition)
+
+    def _iceberg_delete(self, entry, cmd: sp.Delete) -> pa.Table:
+        """DELETE on an Iceberg table → merge-on-read position-delete
+        files (reference: sail-iceberg row-level operations)."""
+        import numpy as np
+
+        from .lakehouse.iceberg import IcebergTable
+
+        t = IcebergTable(entry.paths[0])
+
+        def mask_fn(tab):
+            if cmd.condition is None:
+                return np.ones(tab.num_rows, dtype=bool)
+            pred = self._eval_predicate(tab, cmd.condition)
+            vals = pred.column(0).to_pylist()
+            return np.asarray([bool(v) for v in vals], dtype=bool)
+
+        t.delete_where(mask_fn)
+        return pa.table({})
 
     def _delta_update(self, cmd: sp.Update) -> pa.Table:
         from .lakehouse.delta.dml import DeltaDml

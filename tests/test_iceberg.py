@@ -148,3 +148,119 @@ def test_last_column_id_counts_nested_fields(tmp_path):
     # ids: a=1, b=2, a.element=3, b.x=4, b.y=5 (order may vary, but the
     # counter must cover all five)
     assert md["last-column-id"] == 5
+
+
+# ---------------------------------------------------------------------------
+# delete files (position + equality) — reference:
+# crates/sail-iceberg/src/spec/delete_index.rs, IcebergDeleteApplyExec
+# ---------------------------------------------------------------------------
+
+def test_position_deletes_applied_on_read(tmp_path):
+    path = str(tmp_path / "ice_pos")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2, 3, 4], "v": ["a", "b", "c", "d"]}))
+    files = t.data_files(t.snapshot())
+    assert len(files) == 1
+    t.add_position_deletes({files[0]["file_path"]: [1, 3]})
+    out = t.to_arrow()
+    assert sorted(out.column("v").to_pylist()) == ["a", "c"]
+    # time travel to before the delete still sees all rows
+    first = t.history()[-1]
+    assert len(t.to_arrow(snapshot_id=first["snapshot-id"])) == 4
+
+
+def test_position_deletes_only_hit_earlier_files(tmp_path):
+    path = str(tmp_path / "ice_pos_seq")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2], "v": ["a", "b"]}))
+    f1 = t.data_files(t.snapshot())[0]["file_path"]
+    t.add_position_deletes({f1: [0]})
+    # a file appended AFTER the delete must be untouched even at pos 0
+    t.append(pa.table({"k": [9], "v": ["z"]}))
+    out = t.to_arrow()
+    assert sorted(out.column("v").to_pylist()) == ["b", "z"]
+
+
+def test_equality_deletes_applied_on_read(tmp_path):
+    path = str(tmp_path / "ice_eq")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2, 3], "v": ["a", "b", "c"]}))
+    t.add_equality_deletes(pa.table({"k": [2, 3]}), ["k"])
+    out = t.to_arrow()
+    assert out.column("v").to_pylist() == ["a"]
+    # rows appended after the equality delete are NOT affected (seq order)
+    t.append(pa.table({"k": [2], "v": ["b2"]}))
+    out = t.to_arrow()
+    assert sorted(out.column("v").to_pylist()) == ["a", "b2"]
+
+
+def test_equality_delete_with_projection(tmp_path):
+    # the equality key column participates even when projected out
+    path = str(tmp_path / "ice_eq_proj")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2, 3], "v": ["a", "b", "c"]}))
+    t.add_equality_deletes(pa.table({"k": [1]}), ["k"])
+    out = t.to_arrow(columns=["v"])
+    assert sorted(out.column("v").to_pylist()) == ["b", "c"]
+    assert out.column_names == ["v"]
+
+
+def test_delete_where(tmp_path):
+    path = str(tmp_path / "ice_dw")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2, 3, 4, 5], "v": [10, 20, 30, 40, 50]}))
+    t.append(pa.table({"k": [6], "v": [60]}))
+    t.delete_where(lambda tab: (pa.compute.greater(
+        tab.column("v"), 25)).to_numpy(zero_copy_only=False))
+    out = t.to_arrow()
+    assert sorted(out.column("v").to_pylist()) == [10, 20]
+
+
+def test_deletes_from_foreign_layout(tmp_path):
+    """A table whose delete file records ABSOLUTE data-file paths (as other
+    engines write them) still reads correctly."""
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "ice_foreign")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2, 3], "v": ["a", "b", "c"]}))
+    stored = t.data_files(t.snapshot())[0]["file_path"]
+    absolute = os.path.join(path, stored)
+    # hand-write a delete file with the absolute path, as a foreign engine
+    name = "data/foreign-deletes.parquet"
+    pq.write_table(pa.table({
+        "file_path": pa.array([absolute]),
+        "pos": pa.array([0], type=pa.int64())}),
+        os.path.join(path, name))
+    entry = {"content": 1, "file_path": name, "file_format": "PARQUET",
+             "partition": {}, "record_count": 1,
+             "file_size_in_bytes": os.path.getsize(os.path.join(path, name))}
+    t._commit_snapshot([entry], carry_forward=True, operation="delete",
+                       new_content=1)
+    out = t.to_arrow()
+    assert sorted(out.column("v").to_pylist()) == ["b", "c"]
+
+
+def test_overwrite_clears_deletes(tmp_path):
+    path = str(tmp_path / "ice_ow_del")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2], "v": ["a", "b"]}))
+    f1 = t.data_files(t.snapshot())[0]["file_path"]
+    t.add_position_deletes({f1: [0]})
+    t.overwrite(pa.table({"k": [7], "v": ["fresh"]}))
+    assert t.delete_files(t.snapshot()) == []
+    assert t.to_arrow().column("v").to_pylist() == ["fresh"]
+
+
+def test_sql_delete_on_iceberg_table(tmp_path, spark):
+    path = str(tmp_path / "ice_sql_del")
+    df = spark.createDataFrame(pd.DataFrame(
+        {"a": [1, 2, 3, 4], "s": ["w", "x", "y", "z"]}))
+    df.write.format("iceberg").save(path)
+    spark.sql(f"CREATE TABLE idel USING iceberg LOCATION '{path}'")
+    spark.sql("DELETE FROM idel WHERE a >= 3")
+    got = spark.sql("SELECT a, s FROM idel ORDER BY a").toPandas()
+    assert got.a.tolist() == [1, 2]
+    # merge-on-read: the data files are untouched, a delete file exists
+    t = IcebergTable(path)
+    assert len(t.delete_files(t.snapshot())) == 1

@@ -1,0 +1,98 @@
+"""File-listing + parquet-metadata caches (reference: sail-cache)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from sail_tpu import SparkSession
+from sail_tpu.io.cache import (LISTING_CACHE, METADATA_CACHE,
+                               invalidate_listings)
+from sail_tpu.io.formats import expand_paths
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    LISTING_CACHE.clear()
+    METADATA_CACHE.clear()
+    yield
+    LISTING_CACHE.clear()
+    METADATA_CACHE.clear()
+
+
+def _write_dir(tmp_path, n_files=3):
+    d = tmp_path / "data"
+    d.mkdir()
+    for i in range(n_files):
+        pq.write_table(pa.table({"x": [i, i + 10]}), str(d / f"f{i}.parquet"))
+    return str(d)
+
+
+def test_second_listing_is_a_hit(tmp_path):
+    d = _write_dir(tmp_path)
+    first = expand_paths([d])
+    h0 = LISTING_CACHE.hits
+    second = expand_paths([d])
+    assert second == first and len(first) == 3
+    assert LISTING_CACHE.hits == h0 + 1
+
+
+def test_external_write_to_flat_dir_invalidates(tmp_path):
+    d = _write_dir(tmp_path)
+    expand_paths([d])
+    os.utime(d)  # external modification bumps the root mtime
+    pq.write_table(pa.table({"x": [99]}), os.path.join(d, "f9.parquet"))
+    assert len(expand_paths([d])) == 4
+
+
+def test_engine_write_invalidates(tmp_path):
+    d = _write_dir(tmp_path)
+    expand_paths([d])
+    invalidate_listings()
+    m0 = LISTING_CACHE.misses
+    expand_paths([d])
+    assert LISTING_CACHE.misses == m0 + 1
+
+
+def test_second_query_skips_listing_and_footers(tmp_path):
+    d = _write_dir(tmp_path)
+    spark = SparkSession({})
+    spark.read.parquet(d).createOrReplaceTempView("pt")
+    spark.sql("SELECT SUM(x) FROM pt").toPandas()
+    misses_listing = LISTING_CACHE.misses
+    hits0 = LISTING_CACHE.hits
+    got = spark.sql("SELECT SUM(x) FROM pt").toPandas()
+    # no NEW listing walks; at least one cache hit served the re-run
+    assert LISTING_CACHE.misses == misses_listing
+    assert LISTING_CACHE.hits > hits0
+    assert got.iloc[0, 0] == sum([0, 10, 1, 11, 2, 12])
+
+
+def test_metadata_cache_validates_by_mtime(tmp_path):
+    f = str(tmp_path / "a.parquet")
+    pq.write_table(pa.table({"x": [1, 2, 3]}), f)
+    assert METADATA_CACHE.num_rows(f) == 3
+    m0 = METADATA_CACHE.misses
+    assert METADATA_CACHE.num_rows(f) == 3
+    assert METADATA_CACHE.misses == m0  # second read: cache hit
+    pq.write_table(pa.table({"x": [1]}), f)  # rewrite → new (mtime, size)
+    assert METADATA_CACHE.num_rows(f) == 1
+
+
+def test_join_reorder_uses_metadata_cache(tmp_path):
+    from sail_tpu.plan import join_reorder as jr
+    from sail_tpu.plan import nodes as pn
+
+    f = str(tmp_path / "b.parquet")
+    pq.write_table(pa.table({"x": list(range(42))}), f)
+    scan = pn.ScanExec(
+        (pn.Field("x", __import__("sail_tpu.spec.data_type",
+                                  fromlist=["LongType"]).LongType(), True),),
+        None, (f,), "parquet")
+    assert jr._scan_rows(scan) == 42.0
+    h0 = METADATA_CACHE.hits
+    assert jr._scan_rows(scan) == 42.0
+    assert METADATA_CACHE.hits > h0
