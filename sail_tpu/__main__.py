@@ -61,6 +61,11 @@ def main(argv=None):
     p_flight.add_argument("--host", default="127.0.0.1")
     p_flight.add_argument("--port", type=int, default=32010)
 
+    sub.add_parser(
+        "mcp-server",
+        help="run the MCP (Model Context Protocol) server over stdio "
+             "(reference: sail spark mcp-server)")
+
     p_worker = sub.add_parser(
         "worker", help="run a standalone cluster worker process")
     p_worker.add_argument("--driver", required=True,
@@ -75,8 +80,14 @@ def main(argv=None):
     p_worker.add_argument("--worker-id", default=None)
 
     args = parser.parse_args(argv)
-    if args.command in ("server", "shell", "flight", "worker"):
+    if args.command in ("server", "shell", "flight", "worker",
+                        "mcp-server"):
         _ensure_backend()
+
+    if args.command == "mcp-server":
+        from .mcp_server import McpSparkServer
+        McpSparkServer().serve()
+        return 0
 
     if args.command == "server":
         from .spark_connect import SparkConnectServer

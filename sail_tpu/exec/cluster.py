@@ -455,6 +455,8 @@ class _Job:
         self.attempts: Dict[Tuple[int, int], int] = {}
         self.last_error: str = ""
         self.scheduled: Set[int] = set()
+        # per-partition launches for pipelined (FORWARD-input) stages
+        self.launched: Set[Tuple[int, int]] = set()
         # consumer tasks waiting for a producer re-run after a fetch failure
         self.pending: Set[Tuple[int, int]] = set()
         self.stage_rows: Dict[int, int] = {}
@@ -475,6 +477,18 @@ class DriverActor(Actor):
         self.port = 0
         self._probe_stop = threading.Event()
         self.streams = _StreamStore()  # (unused for now; driver-run roots)
+        # elastic pool (reference: driver/worker_pool/ scale between
+        # initial and max counts with idle reaping)
+        self.elastic: Optional[dict] = None
+        self._starting = 0
+        self._starting_ts: List[float] = []
+
+    def set_elastic(self, manager, min_workers: int = 1,
+                    max_workers: int = 4, idle_secs: float = 60.0):
+        """Enable demand-driven scale-up (saturated slots → new worker)
+        and idle reaping down to ``min_workers``."""
+        self.elastic = {"manager": manager, "min": min_workers,
+                        "max": max_workers, "idle": idle_secs}
 
     @property
     def addr(self) -> str:
@@ -545,7 +559,11 @@ class DriverActor(Actor):
                 "last_seen": time.time(),
                 "channel": grpc.insecure_channel(f"{r.host}:{r.port}"),
                 "tasks": set(),
+                "idle_since": time.time(),
             }
+            if self._starting_ts:
+                self._starting_ts.pop(0)
+            self._starting = len(self._starting_ts)
         elif kind == "heartbeat":
             w = self.workers.get(payload.worker_id)
             if w is not None:
@@ -565,8 +583,62 @@ class DriverActor(Actor):
         elif kind == "cleanup":
             self._cleanup_job(payload)
 
+    def _maybe_scale_up(self):
+        e = self.elastic
+        # prune pending starts that never registered (crashed at startup)
+        # so a failed spawn can't cap the pool below max forever
+        now = time.time()
+        self._starting_ts = [t for t in self._starting_ts
+                             if now - t < 30.0]
+        self._starting = len(self._starting_ts)
+        if len(self.workers) + self._starting >= e["max"]:
+            return
+        try:
+            e["manager"].start_worker()
+            self._starting_ts.append(now)
+            self._starting += 1
+        except Exception:  # noqa: BLE001 — scale-up is best effort
+            pass
+
+    def _worker_hosts_live_output(self, addr: str) -> bool:
+        for job in self.jobs.values():
+            if job.done.is_set():
+                continue
+            for locs in job.locations.values():
+                if any(a == addr for a in locs.values()):
+                    return True
+        return False
+
+    def _reap_idle_workers(self, now: float):
+        e = self.elastic
+        owns = getattr(e["manager"], "owns", None)
+        stop = getattr(e["manager"], "stop_worker_id", None)
+        for wid in list(self.workers):
+            if len(self.workers) <= e["min"]:
+                return
+            w = self.workers[wid]
+            idle = w.get("idle_since")
+            if w["tasks"] or idle is None or now - idle < e["idle"]:
+                continue
+            # never strand a worker the manager can't actually stop, and
+            # never kill completed stage outputs an active job still needs
+            if owns is not None and not owns(wid):
+                continue
+            if self._worker_hosts_live_output(w["addr"]):
+                continue
+            self.workers.pop(wid)
+            from ..catalog.system import SYSTEM
+            SYSTEM.record_worker(wid, w["addr"], w["slots"], "reaped")
+            if stop is not None:
+                try:
+                    stop(wid)
+                except Exception:  # noqa: BLE001
+                    pass
+
     def _probe_workers(self):
         now = time.time()
+        if self.elastic is not None:
+            self._reap_idle_workers(now)
         lost = [wid for wid, w in self.workers.items()
                 if now - w["last_seen"] > self.HEARTBEAT_TIMEOUT_S]
         for wid in lost:
@@ -586,7 +658,10 @@ class DriverActor(Actor):
                     dead = [p for p, a in locs.items() if a == w["addr"]]
                     for p in dead:
                         del locs[p]
-                        if stage_id in job.scheduled:
+                        # re-run whether the stage was launched whole
+                        # (scheduled) or per-partition (pipelined)
+                        if stage_id in job.scheduled or \
+                                (stage_id, p) in job.launched:
                             att = self.attempt_of(job, stage_id, p) + 1
                             self._launch_task(job, stage_id, p, att)
 
@@ -599,9 +674,35 @@ class DriverActor(Actor):
         stage = job.graph.stages[stage_id]
         return len(job.locations[stage_id]) >= stage.num_partitions
 
+    def _partition_ready(self, job: _Job, stage, partition: int) -> bool:
+        """FORWARD inputs need only the matching upstream partition; all
+        other modes need the whole upstream stage (reference: the
+        reference's OutputMode::Pipelined + task regions — consumer tasks
+        co-run with still-executing producer stages)."""
+        for i in stage.inputs:
+            if i.mode == jg.InputMode.FORWARD:
+                if partition not in job.locations[i.stage_id]:
+                    return False
+            elif not self._stage_complete(job, i.stage_id):
+                return False
+        return True
+
     def _schedule_ready_stages(self, job: _Job):
         for stage in job.graph.stages:
-            if stage.stage_id in job.scheduled or stage.on_driver:
+            if stage.on_driver:
+                continue
+            pipelined = any(i.mode == jg.InputMode.FORWARD
+                            for i in stage.inputs)
+            if pipelined:
+                for partition in range(stage.num_partitions):
+                    key = (stage.stage_id, partition)
+                    if key in job.launched:
+                        continue
+                    if self._partition_ready(job, stage, partition):
+                        job.launched.add(key)
+                        self._launch_task(job, stage.stage_id, partition, 0)
+                continue
+            if stage.stage_id in job.scheduled:
                 continue
             if all(self._stage_complete(job, i.stage_id)
                    for i in stage.inputs):
@@ -628,13 +729,28 @@ class DriverActor(Actor):
             job.done.set()
             return
         wid, w = live[0]
+        if self.elastic is not None and len(w["tasks"]) >= w["slots"]:
+            self._maybe_scale_up()
         stage = job.graph.stages[stage_id]
         job.attempts[(stage_id, partition)] = attempt
         inputs = []
         for i in stage.inputs:
             up = job.graph.stages[i.stage_id]
-            addrs = [job.locations[i.stage_id][p]
+            # pipelined FORWARD consumers launch before sibling upstream
+            # partitions finish; only THIS task's partition must resolve
+            addrs = [job.locations[i.stage_id].get(p, "")
                      for p in range(up.num_partitions)]
+            if i.mode == jg.InputMode.FORWARD:
+                if not addrs[partition]:
+                    job.failed = (f"stage {stage_id} p{partition}: forward "
+                                  f"input {i.stage_id} not located")
+                    job.done.set()
+                    return
+            elif not all(addrs):
+                job.failed = (f"stage {stage_id}: input stage {i.stage_id} "
+                              f"incomplete at launch")
+                job.done.set()
+                return
             inputs.append(pb.StageInputLocations(
                 stage_id=i.stage_id, mode=i.mode.value, worker_addrs=addrs))
         task = pb.TaskDefinition(
@@ -647,6 +763,7 @@ class DriverActor(Actor):
                 key_columns=list(stage.shuffle_keys),
                 num_channels=stage.num_channels))
         w["tasks"].add((job.job_id, stage_id, partition))
+        w["idle_since"] = None
         rpc = w["channel"].unary_unary(
             f"/{_WORKER_SERVICE}/RunTask",
             request_serializer=lambda m: m.SerializeToString(),
@@ -674,6 +791,8 @@ class DriverActor(Actor):
         w = self.workers.get(r.worker_id)
         if r.state in ("succeeded", "failed", "canceled") and w is not None:
             w["tasks"].discard((r.job_id, r.stage, r.partition))
+            if not w["tasks"]:
+                w["idle_since"] = time.time()
         if r.state == "succeeded":
             if w is None:
                 # the worker was evicted before its success report arrived;
@@ -709,8 +828,7 @@ class DriverActor(Actor):
         ready = []
         for (stage_id, partition) in list(job.pending):
             stage = job.graph.stages[stage_id]
-            if all(self._stage_complete(job, i.stage_id)
-                   for i in stage.inputs):
+            if self._partition_ready(job, stage, partition):
                 ready.append((stage_id, partition))
         for stage_id, partition in ready:
             job.pending.discard((stage_id, partition))
@@ -755,12 +873,26 @@ def encode_cached(job: _Job, stage: jg.Stage) -> bytes:
 # ---------------------------------------------------------------------------
 
 class LocalCluster:
-    def __init__(self, num_workers: int = 2, task_slots: int = 2):
+    def __init__(self, num_workers: int = 2, task_slots: int = 2,
+                 elastic: Optional[dict] = None):
+        """``elastic``: {"max": int, "min": int, "idle_secs": float} —
+        workers beyond ``num_workers`` are started on demand by the driver
+        through a ThreadWorkerManager and idle-reaped (reference:
+        driver/worker_pool/ elastic scaling)."""
         self.driver = DriverActor()
         self.driver.start("driver")
         deadline = time.time() + 10
         while self.driver.port == 0 and time.time() < deadline:
             time.sleep(0.01)
+        self.manager = None
+        if elastic is not None:
+            from .worker_manager import ThreadWorkerManager
+            self.manager = ThreadWorkerManager(self.driver.addr, task_slots)
+            self.driver.set_elastic(
+                self.manager,
+                min_workers=elastic.get("min", num_workers),
+                max_workers=elastic.get("max", num_workers),
+                idle_secs=elastic.get("idle_secs", 60.0))
         self.workers: List[WorkerActor] = []
         for i in range(num_workers):
             w = WorkerActor(f"worker-{i}", self.driver.addr,
@@ -829,4 +961,6 @@ class LocalCluster:
     def stop(self):
         for w in self.workers:
             w.stop()
+        if self.manager is not None:
+            self.manager.stop_all()
         self.driver.stop()

@@ -756,6 +756,150 @@ class LocalExecutor:
                                                     range(len(names))])
         return ai.from_arrow(table)
 
+    # -- PySpark UDF relations (host-evaluated; reference:
+    # sail-python-udf group/cogroup map + map-iter kinds) ---------------
+    def _named_arrow(self, p_input) -> "pa.Table":
+        child = self.run(p_input)
+        table = ai.to_arrow(child)
+        return table.rename_columns([f.name for f in p_input.schema])
+
+    def _udf_result_to_batch(self, frames, out_schema) -> HostBatch:
+        """pandas frames / arrow batches from a UDF → HostBatch matching
+        the DECLARED output schema (cast, reorder, missing → error)."""
+        import pandas as pd
+
+        tables = []
+        for f in frames:
+            if isinstance(f, pa.Table):
+                tables.append(f)
+            elif isinstance(f, pa.RecordBatch):
+                tables.append(pa.Table.from_batches([f]))
+            elif isinstance(f, pd.DataFrame):
+                tables.append(pa.Table.from_pandas(f, preserve_index=False))
+            else:
+                raise TypeError(
+                    f"UDF returned {type(f).__name__}; expected DataFrame "
+                    f"or arrow batch")
+        names = [f.name for f in out_schema]
+        types = [ai.spec_type_to_arrow(f.dtype) for f in out_schema]
+        if not tables:
+            table = pa.Table.from_arrays(
+                [pa.array([], type=t) for t in types], names=names)
+        else:
+            table = pa.concat_tables(tables, promote_options="permissive")
+            missing = [n for n in names if n not in table.column_names]
+            if missing:
+                raise ValueError(
+                    f"UDF output is missing declared columns {missing}")
+            cols = [table.column(n).cast(t, safe=False)
+                    for n, t in zip(names, types)]
+            table = pa.Table.from_arrays(cols, names=names)
+        return _positional(ai.from_arrow(table))
+
+    @staticmethod
+    def _udf_arity(func, default: int) -> int:
+        import inspect
+        try:
+            return len(inspect.signature(func).parameters)
+        except (TypeError, ValueError):
+            return default
+
+    @staticmethod
+    def _norm_key(key) -> tuple:
+        """Group keys as comparable tuples: pandas represents null keys
+        as NaN, and NaN != NaN would split one logical group across the
+        two cogroup sides — normalize to None."""
+        kt = key if isinstance(key, tuple) else (key,)
+        return tuple(None if (isinstance(x, float) and x != x) else x
+                     for x in kt)
+
+    def _exec_UdtfExec(self, p: pn.UdtfExec) -> HostBatch:
+        """Python UDTF: handler.eval(*args) yields rows (tuples or
+        scalars); terminate() may yield trailing rows."""
+        inst = p.handler() if isinstance(p.handler, type) else p.handler
+        rows = []
+
+        def extend(gen):
+            if gen is None:
+                return
+            for row in gen:
+                if not isinstance(row, (tuple, list)):
+                    row = (row,)
+                rows.append(tuple(row))
+
+        extend(inst.eval(*p.args))
+        if hasattr(inst, "terminate"):
+            extend(inst.terminate())
+        names = [f.name for f in p.out_schema]
+        types = [ai.spec_type_to_arrow(f.dtype) for f in p.out_schema]
+        arrays = []
+        for ci, t in enumerate(types):
+            arrays.append(pa.array(
+                [r[ci] if ci < len(r) else None for r in rows], type=t))
+        table = pa.Table.from_arrays(arrays, names=names)
+        return _positional(ai.from_arrow(table))
+
+    def _exec_GroupMapExec(self, p: pn.GroupMapExec) -> HostBatch:
+        table = self._named_arrow(p.input)
+        pdf = table.to_pandas()
+        key_cols = [table.column_names[i] for i in p.key_indices]
+        func = p.udf.func
+        wants_key = self._udf_arity(func, 1) >= 2
+        outs = []
+        if len(pdf) and key_cols:
+            for key, g in pdf.groupby(key_cols, dropna=False, sort=True):
+                g = g.reset_index(drop=True)
+                if wants_key:
+                    k = key if isinstance(key, tuple) else (key,)
+                    outs.append(func(k, g))
+                else:
+                    outs.append(func(g))
+        elif len(pdf):
+            outs.append(func(pdf))
+        return self._udf_result_to_batch(outs, p.out_schema)
+
+    def _exec_CoGroupMapExec(self, p: pn.CoGroupMapExec) -> HostBatch:
+        import pandas as pd
+
+        lt = self._named_arrow(p.left)
+        rt = self._named_arrow(p.right)
+        lpdf, rpdf = lt.to_pandas(), rt.to_pandas()
+        lk = [lt.column_names[i] for i in p.left_keys]
+        rk = [rt.column_names[i] for i in p.right_keys]
+        lgroups = {self._norm_key(k): g
+                   for k, g in lpdf.groupby(lk, dropna=False, sort=True)} \
+            if len(lpdf) else {}
+        rgroups = {self._norm_key(k): g
+                   for k, g in rpdf.groupby(rk, dropna=False, sort=True)} \
+            if len(rpdf) else {}
+        func = p.udf.func
+        nparams = self._udf_arity(func, 2)
+        outs = []
+        for key in sorted(set(lgroups) | set(rgroups),
+                          key=lambda k: tuple(str(x) for x in k)):
+            lg = lgroups.get(key)
+            rg = rgroups.get(key)
+            lg = (lg.reset_index(drop=True) if lg is not None
+                  else lpdf.iloc[0:0].copy())
+            rg = (rg.reset_index(drop=True) if rg is not None
+                  else rpdf.iloc[0:0].copy())
+            if nparams >= 3:
+                outs.append(func(key, lg, rg))
+            else:
+                outs.append(func(lg, rg))
+        return self._udf_result_to_batch(outs, p.out_schema)
+
+    def _exec_MapPartitionsExec(self, p: pn.MapPartitionsExec) -> HostBatch:
+        table = self._named_arrow(p.input)
+        func = p.udf.func
+        if p.udf.eval_type == "map_arrow":
+            it = func(iter(table.to_batches()))
+            outs = list(it)
+        else:  # map_pandas
+            it = func(iter([table.to_pandas()]))
+            outs = list(it)
+        return self._udf_result_to_batch(outs, p.out_schema)
+
     def _exec_FilterExec(self, p: pn.FilterExec) -> HostBatch:
         child = self.run(p.input)
         dev = child.device

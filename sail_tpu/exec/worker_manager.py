@@ -56,6 +56,19 @@ class ThreadWorkerManager(WorkerManager):
         if handle in self._workers:
             self._workers.remove(handle)
 
+    def owns(self, worker_id: str) -> bool:
+        """True when this manager started (and can stop) the worker —
+        reaping a worker it can't stop would leave a zombie actor."""
+        return any(getattr(w, "worker_id", None) == worker_id
+                   for w in self._workers)
+
+    def stop_worker_id(self, worker_id: str):
+        """Stop by registered worker id (driver-side idle reaping)."""
+        for w in list(self._workers):
+            if getattr(w, "worker_id", None) == worker_id:
+                self.stop_worker(w)
+                return
+
     def stop_all(self):
         for w in list(self._workers):
             self.stop_worker(w)

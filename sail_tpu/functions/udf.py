@@ -76,6 +76,7 @@ class UDFRegistry:
 
     def __init__(self):
         self._udfs = {}
+        self._udtfs = {}
 
     def register(self, name: str, f, returnType=None) -> UserDefinedFunction:
         if isinstance(f, UserDefinedFunction):
@@ -89,3 +90,10 @@ class UDFRegistry:
 
     def get(self, name: str) -> Optional[UserDefinedFunction]:
         return self._udfs.get(name.lower())
+
+    # -- table functions (UDTF handler classes) ------------------------
+    def register_udtf(self, name: str, handler, return_type) -> None:
+        self._udtfs[name.lower()] = (handler, return_type)
+
+    def get_udtf(self, name: str):
+        return self._udtfs.get(name.lower())

@@ -1,0 +1,200 @@
+"""MCP (Model Context Protocol) server for Spark SQL over stdio.
+
+Reference role: crates/sail-cli/src/spark/mcp_server.rs:39-86 +
+src/python/spark_mcp_server.py — the reference launches a fastmcp server
+over an in-process Spark Connect server. No MCP SDK ships in this image,
+so this implements the protocol surface directly: JSON-RPC 2.0 over
+stdin/stdout with ``initialize``, ``tools/list`` and ``tools/call``
+(2024-11-05 protocol revision). The tool surface mirrors the reference's:
+query execution, view registration per format, and catalog inspection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+PROTOCOL_VERSION = "2024-11-05"
+
+
+def _tool(name: str, description: str, props: Dict[str, dict],
+          required: List[str]) -> dict:
+    return {
+        "name": name,
+        "description": description,
+        "inputSchema": {"type": "object", "properties": props,
+                        "required": required},
+    }
+
+
+TOOLS = [
+    _tool("execute_query",
+          "Execute a Spark SQL query and return the result rows as JSON.",
+          {"query": {"type": "string", "description": "The SQL text."},
+           "limit": {"type": "integer",
+                     "description": "Maximum rows to return (default 100)."}},
+          ["query"]),
+    _tool("list_views", "List registered views/tables.", {}, []),
+    _tool("describe_view",
+          "Describe a view's columns (name, type, nullable).",
+          {"name": {"type": "string"}}, ["name"]),
+    _tool("create_parquet_view",
+          "Register a Parquet file or directory as a named view.",
+          {"name": {"type": "string"}, "path": {"type": "string"}},
+          ["name", "path"]),
+    _tool("create_csv_view",
+          "Register a CSV file as a named view.",
+          {"name": {"type": "string"}, "path": {"type": "string"},
+           "header": {"type": "boolean"}},
+          ["name", "path"]),
+    _tool("create_json_view",
+          "Register a JSON-lines file as a named view.",
+          {"name": {"type": "string"}, "path": {"type": "string"}},
+          ["name", "path"]),
+    _tool("list_local_directories",
+          "List directories under a local filesystem path "
+          "(non-recursive).",
+          {"path": {"type": "string"}}, ["path"]),
+]
+
+
+class McpSparkServer:
+    """Protocol handler; transport-agnostic (serve() drives stdio)."""
+
+    def __init__(self, spark=None):
+        self._spark = spark
+
+    @property
+    def spark(self):
+        if self._spark is None:
+            from . import SparkSession
+            self._spark = SparkSession.builder.getOrCreate()
+        return self._spark
+
+    # -- JSON-RPC dispatch ----------------------------------------------
+    def handle(self, msg: dict) -> Optional[dict]:
+        method = msg.get("method", "")
+        msg_id = msg.get("id")
+        try:
+            if method == "initialize":
+                result = {
+                    "protocolVersion": PROTOCOL_VERSION,
+                    "capabilities": {"tools": {}},
+                    "serverInfo": {"name": "sail-tpu MCP server for "
+                                           "Spark SQL",
+                                   "version": "0.1"},
+                }
+            elif method in ("notifications/initialized", "initialized"):
+                return None  # notification: no response
+            elif method == "tools/list":
+                result = {"tools": TOOLS}
+            elif method == "tools/call":
+                result = self._call_tool(msg.get("params", {}))
+            elif method == "ping":
+                result = {}
+            else:
+                return self._error(msg_id, -32601,
+                                   f"method not found: {method}")
+        except Exception as e:  # noqa: BLE001 — surfaced as a tool error
+            return self._error(msg_id, -32000, f"{type(e).__name__}: {e}")
+        if msg_id is None:
+            return None
+        return {"jsonrpc": "2.0", "id": msg_id, "result": result}
+
+    @staticmethod
+    def _error(msg_id, code, message) -> Optional[dict]:
+        if msg_id is None:
+            return None
+        return {"jsonrpc": "2.0", "id": msg_id,
+                "error": {"code": code, "message": message}}
+
+    # -- tools -----------------------------------------------------------
+    def _call_tool(self, params: dict) -> dict:
+        name = params.get("name", "")
+        args = params.get("arguments") or {}
+        fn = getattr(self, f"_tool_{name}", None)
+        if fn is None:
+            raise ValueError(f"unknown tool {name!r}")
+        try:
+            text = fn(**args)
+            return {"content": [{"type": "text", "text": text}],
+                    "isError": False}
+        except Exception as e:  # noqa: BLE001 — tool errors are results
+            return {"content": [{"type": "text",
+                                 "text": f"{type(e).__name__}: {e}"}],
+                    "isError": True}
+
+    def _tool_execute_query(self, query: str, limit: int = 100) -> str:
+        table = self.spark.sql(query).toArrow()
+        if table.num_rows > limit:
+            table = table.slice(0, limit)
+        return json.dumps(table.to_pylist(), default=str)
+
+    def _tool_list_views(self) -> str:
+        cm = self.spark.catalog_manager
+        names = sorted(cm.temp_views)
+        try:
+            names += [e.name[-1] for e in cm.list_tables()
+                      if e.name and e.name[-1] not in names
+                      and e.view_plan is None]
+        except Exception:  # noqa: BLE001 — provider without listing
+            pass
+        return json.dumps(sorted(set(names)))
+
+    def _tool_describe_view(self, name: str) -> str:
+        df = self.spark.sql(f"SELECT * FROM {name} LIMIT 0")
+        out = [{"name": f.name, "dataType": f.data_type.simple_string(),
+                "nullable": f.nullable}
+               for f in df.schema.fields]
+        return json.dumps(out)
+
+    def _register(self, name: str, path: str, fmt: str, **options) -> str:
+        reader = self.spark.read.format(fmt)
+        for k, v in options.items():
+            reader = reader.option(k, str(v).lower())
+        reader.load(path).createOrReplaceTempView(name)
+        return json.dumps({"view": name, "path": path, "format": fmt})
+
+    def _tool_create_parquet_view(self, name: str, path: str) -> str:
+        return self._register(name, path, "parquet")
+
+    def _tool_create_csv_view(self, name: str, path: str,
+                              header: bool = True) -> str:
+        return self._register(name, path, "csv", header=header)
+
+    def _tool_create_json_view(self, name: str, path: str) -> str:
+        return self._register(name, path, "json")
+
+    @staticmethod
+    def _tool_list_local_directories(path: str) -> str:
+        out = sorted(d for d in os.listdir(path)
+                     if os.path.isdir(os.path.join(path, d)))
+        return json.dumps(out)
+
+    # -- stdio transport -------------------------------------------------
+    def serve(self, stdin=None, stdout=None):
+        """Line-delimited JSON-RPC over stdio (the MCP stdio transport)."""
+        stdin = stdin if stdin is not None else sys.stdin
+        stdout = stdout if stdout is not None else sys.stdout
+        for line in stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            resp = self.handle(msg)
+            if resp is not None:
+                stdout.write(json.dumps(resp) + "\n")
+                stdout.flush()
+
+
+def main(argv=None):
+    McpSparkServer().serve()
+
+
+if __name__ == "__main__":
+    main()

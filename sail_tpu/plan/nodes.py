@@ -246,6 +246,81 @@ class JoinExec(PlanNode):
 
 
 @dataclass(frozen=True)
+class UdtfExec(PlanNode):
+    """Python UDTF leaf: handler.eval(*args) yields output rows
+    (reference: pyspark_udtf.rs)."""
+
+    handler: object = None
+    args: Tuple[object, ...] = ()   # evaluated python scalars
+    out_schema: Tuple[Field, ...] = ()
+    name: str = "udtf"
+
+    @property
+    def schema(self) -> Schema:
+        return tuple(self.out_schema)
+
+    @property
+    def children(self):
+        return ()
+
+
+@dataclass(frozen=True)
+class GroupMapExec(PlanNode):
+    """applyInPandas: one Python UDF call per group, host-evaluated
+    (reference: sail-python-udf grouped-map via MapPartitionsExec)."""
+
+    input: PlanNode = None
+    key_indices: Tuple[int, ...] = ()
+    udf: object = None               # functions.udf.UserDefinedFunction
+    out_schema: Tuple[Field, ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        return tuple(self.out_schema)
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclass(frozen=True)
+class CoGroupMapExec(PlanNode):
+    """cogroup().applyInPandas over two inputs aligned by key."""
+
+    left: PlanNode = None
+    right: PlanNode = None
+    left_keys: Tuple[int, ...] = ()
+    right_keys: Tuple[int, ...] = ()
+    udf: object = None
+    out_schema: Tuple[Field, ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        return tuple(self.out_schema)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclass(frozen=True)
+class MapPartitionsExec(PlanNode):
+    """mapInPandas / mapInArrow: iterator-of-batches UDF."""
+
+    input: PlanNode = None
+    udf: object = None
+    out_schema: Tuple[Field, ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        return tuple(self.out_schema)
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclass(frozen=True)
 class GenerateExec(PlanNode):
     """Row generator (explode/posexplode/inline/stack) over an input.
 

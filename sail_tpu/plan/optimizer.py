@@ -27,7 +27,71 @@ def optimize(plan: pn.PlanNode) -> pn.PlanNode:
     plan = push_filters(plan)
     plan = _maybe_reorder_joins(plan)
     plan = prune_columns(plan)
+    plan = _optimize_subquery_plans(plan)
     return plan
+
+
+def _optimize_subquery_plans(p: pn.PlanNode) -> pn.PlanNode:
+    """Scalar-subquery plans embedded in expressions run as independent
+    jobs — they deserve the same rule pipeline (a TPC-H q11-style
+    implicit-cross-join subquery is pathological unoptimized)."""
+
+    def fix_rex(r: rx.Rex) -> rx.Rex:
+        if isinstance(r, rx.RScalarSubquery):
+            return dataclasses.replace(r, plan=optimize(r.plan))
+        if isinstance(r, rx.RCall):
+            return dataclasses.replace(
+                r, args=tuple(fix_rex(a) for a in r.args))
+        if isinstance(r, rx.RCast):
+            return dataclasses.replace(r, child=fix_rex(r.child))
+        if isinstance(r, rx.RLambda):
+            return dataclasses.replace(r, body=fix_rex(r.body))
+        if isinstance(r, rx.RCase):
+            return dataclasses.replace(
+                r,
+                branches=tuple((fix_rex(c), fix_rex(v))
+                               for c, v in r.branches),
+                else_value=None if r.else_value is None
+                else fix_rex(r.else_value))
+        return r
+
+    def has_subquery(r) -> bool:
+        return any(isinstance(n, rx.RScalarSubquery) for n in rx.walk(r))
+
+    def fix_node(node: pn.PlanNode) -> pn.PlanNode:
+        updates = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, pn.PlanNode):
+                updates[f.name] = fix_node(v)
+            elif isinstance(v, rx.Rex):
+                if has_subquery(v):
+                    updates[f.name] = fix_rex(v)
+            elif isinstance(v, tuple) and v:
+                new_items = []
+                changed = False
+                for item in v:
+                    if isinstance(item, pn.PlanNode):
+                        ni = fix_node(item)
+                        changed |= ni is not item
+                        new_items.append(ni)
+                    elif isinstance(item, rx.Rex) and has_subquery(item):
+                        new_items.append(fix_rex(item))
+                        changed = True
+                    elif (isinstance(item, tuple) and len(item) == 2
+                          and isinstance(item[1], rx.Rex)
+                          and has_subquery(item[1])):
+                        new_items.append((item[0], fix_rex(item[1])))
+                        changed = True
+                    else:
+                        new_items.append(item)
+                if changed:
+                    updates[f.name] = tuple(new_items)
+        if updates:
+            return dataclasses.replace(node, **updates)
+        return node
+
+    return fix_node(p)
 
 
 def _maybe_reorder_joins(plan: pn.PlanNode) -> pn.PlanNode:
@@ -62,6 +126,52 @@ def push_filters(p: pn.PlanNode) -> pn.PlanNode:
 def _split(r: rx.Rex) -> List[rx.Rex]:
     if isinstance(r, rx.RCall) and r.fn == "and":
         return _split(r.args[0]) + _split(r.args[1])
+    factored = _factor_or(r)
+    if factored is not None:
+        out: List[rx.Rex] = []
+        for f in factored:
+            out.extend(_split(f))
+        return out
+    return [r]
+
+
+def _or_branches(r: rx.Rex) -> List[rx.Rex]:
+    if isinstance(r, rx.RCall) and r.fn == "or":
+        return _or_branches(r.args[0]) + _or_branches(r.args[1])
+    return [r]
+
+
+def _factor_or(r: rx.Rex) -> Optional[List[rx.Rex]]:
+    """(c AND a) OR (c AND b) → [c, (a OR b)] — sound under 3-valued
+    logic for filter TRUE-ness. TPC-H q19 repeats its equi-join key in
+    every OR branch; factoring it out lets cross→inner conversion fire
+    (the reference gets this from DataFusion's predicate normalization)."""
+    if not (isinstance(r, rx.RCall) and r.fn == "or"):
+        return None
+    branches = _or_branches(r)
+    if len(branches) < 2:
+        return None
+    per_branch = [_split_and_only(b) for b in branches]
+    common = [c for c in per_branch[0]
+              if all(any(c == d for d in rest) for rest in per_branch[1:])]
+    if not common:
+        return None
+    residuals = []
+    for conjs in per_branch:
+        rest = [c for c in conjs if not any(c == k for k in common)]
+        if not rest:
+            # a branch reduced to TRUE: the whole OR residual is TRUE
+            return common
+        residuals.append(_and(rest))
+    rebuilt = residuals[0]
+    for x in residuals[1:]:
+        rebuilt = rx.RCall("or", (rebuilt, x), dt.BooleanType())
+    return common + [rebuilt]
+
+
+def _split_and_only(r: rx.Rex) -> List[rx.Rex]:
+    if isinstance(r, rx.RCall) and r.fn == "and":
+        return _split_and_only(r.args[0]) + _split_and_only(r.args[1])
     return [r]
 
 

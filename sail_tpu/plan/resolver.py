@@ -155,6 +155,15 @@ class Resolver:
                                     child.schema[i].dtype, child.schema[i].nullable))
                     for i, n in enumerate(plan.columns)))
             return child, Scope(fields, outer, ctes)
+        if isinstance(plan, sp.UdtfCall):
+            return self._resolve_udtf_call(plan, outer,
+                                           scope.ctes if scope else {})
+        if isinstance(plan, sp.GroupMap):
+            return self._resolve_group_map(plan, scope, outer)
+        if isinstance(plan, sp.CoGroupMap):
+            return self._resolve_cogroup_map(plan, scope, outer)
+        if isinstance(plan, sp.MapPartitions):
+            return self._resolve_map_partitions(plan, scope, outer)
         if isinstance(plan, sp.Filter):
             return self._resolve_filter(plan, scope, outer)
         if isinstance(plan, sp.Project):
@@ -171,6 +180,16 @@ class Resolver:
                 try:
                     e = self._ordinal_or_expr(so.child, cscope, child)
                 except ResolutionError:
+                    # ORDER BY repeating a select-list expression of a
+                    # GROUP BY query (e.g. ORDER BY COUNT(*) DESC) binds
+                    # to that output column — spec exprs are frozen
+                    # dataclasses, so structural equality works
+                    matched = self._match_aggregate_output(plan.input,
+                                                           so.child, child)
+                    if matched is not None:
+                        keys.append(pn.SortKey(matched, so.ascending,
+                                               so.nulls_first))
+                        continue
                     if cscope.below is None or not isinstance(child, pn.ProjectExec):
                         raise
                     inner = self._resolve_expr(so.child, cscope.below)
@@ -374,12 +393,102 @@ class Resolver:
                 raise ResolutionError("range() step must not be zero")
             node = pn.RangeExec(start, end, step, 1)
             return node, self._scope_of(node, "range", outer, ctes)
+        reg = getattr(self.catalog, "udfs", None)
+        entry = reg.get_udtf(plan.name) if reg is not None else None
+        if entry is not None:
+            handler, rt = entry
+            return self._resolve_udtf_call(
+                sp.UdtfCall(handler, tuple(plan.args), rt, plan.name),
+                outer, ctes)
         raise ResolutionError(f"unknown table function {plan.name!r}")
 
     def _scope_of(self, node: pn.PlanNode, qual, outer, ctes) -> Scope:
         quals = (qual,) if qual else ()
         return Scope([ScopeField(f.name, quals, f.dtype, f.nullable)
                       for f in node.schema], outer, ctes)
+
+    @staticmethod
+    def _match_aggregate_output(spec_input, sort_expr, child):
+        """ORDER BY <expr> where <expr> structurally equals a select-list
+        item of the input Aggregate → BoundRef to that output column."""
+        import sail_tpu.spec.expression as _ex
+
+        node = spec_input
+        if not isinstance(node, sp.Aggregate):
+            return None
+
+        def strip(e):
+            return e.child if isinstance(e, _ex.Alias) else e
+
+        target = strip(sort_expr)
+        for i, ae in enumerate(node.aggregate):
+            if strip(ae) == target and i < len(child.schema):
+                f = child.schema[i]
+                return rx.BoundRef(i, f.name, f.dtype, f.nullable)
+        return None
+
+    # ------------------------------------------------------------------
+    # PySpark UDF relations (applyInPandas / cogroup / mapInPandas)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _udf_out_schema(udf) -> Tuple[pn.Field, ...]:
+        st = udf.return_type
+        if not isinstance(st, dt.StructType):
+            raise ResolutionError(
+                f"{udf.name}: group/map UDFs must declare a struct return "
+                f"type, got {st.simple_string()}")
+        return tuple(pn.Field(f.name, f.data_type, True) for f in st.fields)
+
+    def _key_indices(self, exprs, cscope, what) -> Tuple[int, ...]:
+        out = []
+        for e in exprs:
+            r = self._resolve_expr(e, cscope)
+            if not isinstance(r, rx.BoundRef):
+                raise ResolutionError(
+                    f"{what}: grouping expressions must be plain input "
+                    f"columns")
+            out.append(r.index)
+        return tuple(out)
+
+    def _resolve_udtf_call(self, plan: sp.UdtfCall, outer, ctes):
+        vals = []
+        for a in plan.args:
+            r = self._resolve_expr(a, Scope([], None, {}))
+            if not isinstance(r, rx.RLit):
+                raise ResolutionError(
+                    f"UDTF {plan.name}: arguments must be literals")
+            vals.append(None if r.value.is_null else r.value.value)
+        st = plan.return_type
+        out = tuple(pn.Field(f.name, f.data_type, True) for f in st.fields)
+        node = pn.UdtfExec(plan.handler, tuple(vals), out, plan.name)
+        return node, self._scope_of(node, plan.name, outer, ctes)
+
+    def _resolve_group_map(self, plan: sp.GroupMap, scope, outer):
+        child, cscope = self.resolve_query(plan.input, scope, outer)
+        keys = self._key_indices(plan.grouping, cscope, "applyInPandas")
+        node = pn.GroupMapExec(child, keys, plan.udf,
+                               self._udf_out_schema(plan.udf))
+        return node, self._scope_of(node, None, outer,
+                                    scope.ctes if scope else {})
+
+    def _resolve_cogroup_map(self, plan: sp.CoGroupMap, scope, outer):
+        left, lscope = self.resolve_query(plan.input, scope, outer)
+        right, rscope = self.resolve_query(plan.other, scope, outer)
+        lk = self._key_indices(plan.input_grouping, lscope, "cogroup")
+        rk = self._key_indices(plan.other_grouping, rscope, "cogroup")
+        if len(lk) != len(rk):
+            raise ResolutionError("cogroup: mismatched grouping arity")
+        node = pn.CoGroupMapExec(left, right, lk, rk, plan.udf,
+                                 self._udf_out_schema(plan.udf))
+        return node, self._scope_of(node, None, outer,
+                                    scope.ctes if scope else {})
+
+    def _resolve_map_partitions(self, plan: sp.MapPartitions, scope, outer):
+        child, cscope = self.resolve_query(plan.input, scope, outer)
+        node = pn.MapPartitionsExec(child, plan.udf,
+                                    self._udf_out_schema(plan.udf))
+        return node, self._scope_of(node, None, outer,
+                                    scope.ctes if scope else {})
 
     # ------------------------------------------------------------------
     # filter + subquery rewrites

@@ -694,6 +694,40 @@ class GroupedData:
     def max(self, *cols) -> "DataFrame":
         return self._simple("max", *cols)
 
+    def applyInPandas(self, func, schema) -> "DataFrame":
+        """groupBy(...).applyInPandas — reference: sail-python-udf
+        grouped-map kind (pyspark_udf.rs:19-27)."""
+        from .functions.udf import UserDefinedFunction
+        udf = UserDefinedFunction(func, _parse_ddl_struct(schema),
+                                  "grouped_map", getattr(func, "__name__",
+                                                         "applyInPandas"))
+        return DataFrame(sp.GroupMap(self._df._plan, self._group, udf),
+                         self._df._session)
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        return CoGroupedData(self, other)
+
+
+class CoGroupedData:
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self._left = left
+        self._right = right
+
+    def applyInPandas(self, func, schema) -> "DataFrame":
+        from .functions.udf import UserDefinedFunction
+        udf = UserDefinedFunction(func, _parse_ddl_struct(schema),
+                                  "cogrouped_map",
+                                  getattr(func, "__name__", "cogroup"))
+        plan = sp.CoGroupMap(self._left._df._plan, self._right._df._plan,
+                             self._left._group, self._right._group, udf)
+        return DataFrame(plan, self._left._df._session)
+
+
+def _parse_ddl_struct(schema):
+    if isinstance(schema, dt.StructType):
+        return schema
+    return _parse_ddl_schema(str(schema))
+
 
 class DataFrame:
     def __init__(self, plan: sp.QueryPlan, session: SparkSession):
@@ -830,6 +864,24 @@ class DataFrame:
         if name.startswith("_"):
             raise AttributeError(name)
         return col(name)
+
+    def mapInPandas(self, func, schema, barrier: bool = False) -> "DataFrame":
+        """mapInPandas — iterator-of-DataFrames UDF (reference:
+        pyspark_map_iter_udf.rs)."""
+        from .functions.udf import UserDefinedFunction
+        udf = UserDefinedFunction(func, _parse_ddl_struct(schema),
+                                  "map_pandas",
+                                  getattr(func, "__name__", "mapInPandas"))
+        return DataFrame(sp.MapPartitions(self._plan, udf, barrier),
+                         self._session)
+
+    def mapInArrow(self, func, schema, barrier: bool = False) -> "DataFrame":
+        from .functions.udf import UserDefinedFunction
+        udf = UserDefinedFunction(func, _parse_ddl_struct(schema),
+                                  "map_arrow",
+                                  getattr(func, "__name__", "mapInArrow"))
+        return DataFrame(sp.MapPartitions(self._plan, udf, barrier),
+                         self._session)
 
     # -- actions ------------------------------------------------------------
     def toArrow(self) -> pa.Table:

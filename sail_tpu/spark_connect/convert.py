@@ -522,6 +522,36 @@ def relation_from_proto(r: rpb.Relation) -> sp.QueryPlan:
             names = tuple(
                 c.unresolved_attribute.unparsed_identifier for c in d.columns)
         return sp.Drop(relation_from_proto(d.input), names)
+    if kind == "common_inline_user_defined_table_function":
+        from .wire_udf import udtf_from_proto
+        tf = r.common_inline_user_defined_table_function
+        handler, rt = udtf_from_proto(tf)
+        return sp.UdtfCall(handler,
+                           tuple(expr_from_proto(a) for a in tf.arguments),
+                           rt, tf.function_name or "udtf")
+    if kind == "group_map":
+        from .wire_udf import relation_udf_from_proto
+        gm = r.group_map
+        return sp.GroupMap(
+            relation_from_proto(gm.input),
+            tuple(expr_from_proto(e) for e in gm.grouping_expressions),
+            relation_udf_from_proto(gm.func, {"grouped_map"}))
+    if kind == "co_group_map":
+        from .wire_udf import relation_udf_from_proto
+        cg = r.co_group_map
+        return sp.CoGroupMap(
+            relation_from_proto(cg.input),
+            relation_from_proto(cg.other),
+            tuple(expr_from_proto(e) for e in cg.input_grouping_expressions),
+            tuple(expr_from_proto(e) for e in cg.other_grouping_expressions),
+            relation_udf_from_proto(cg.func, {"cogrouped_map"}))
+    if kind == "map_partitions":
+        from .wire_udf import relation_udf_from_proto
+        mp = r.map_partitions
+        return sp.MapPartitions(
+            relation_from_proto(mp.input),
+            relation_udf_from_proto(mp.func, {"map_pandas", "map_arrow"}),
+            bool(mp.is_barrier) if mp.HasField("is_barrier") else False)
     if kind == "show_string":
         # executed eagerly by the service; represent as the child
         return relation_from_proto(r.show_string.input)

@@ -228,6 +228,15 @@ class SparkConnectServer:
             cif = command.register_function
             session.udf.register(cif.function_name, udf_from_proto(cif))
             return
+        if which == "register_table_function":
+            # cloudpickled UDTF handler class for SQL FROM-position use
+            # (reference: plan_executor.rs register_user_defined_table_
+            # function + pyspark_udtf.rs)
+            from .wire_udf import udtf_from_proto
+            tf = command.register_table_function
+            handler, rt = udtf_from_proto(tf)
+            session.udf.register_udtf(tf.function_name, handler, rt)
+            return
         raise NotImplementedError(f"command {which} not supported yet")
 
     _SAVE_MODES = {

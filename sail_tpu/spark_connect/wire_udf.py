@@ -225,6 +225,57 @@ def udf_from_proto(cif) -> UserDefinedFunction:
                                cif.deterministic)
 
 
+def relation_udf_from_proto(cif, expected_kinds) -> UserDefinedFunction:
+    """CommonInlineUserDefinedFunction in RELATION position (GroupMap /
+    CoGroupMap / MapPartitions) → engine UDF handle keeping the wire kind
+    as eval_type (reference: pyspark_udf.rs grouped/map-iter kinds)."""
+    from .convert import ConvertError, data_type_from_proto
+
+    which = cif.WhichOneof("function")
+    if which != "python_udf":
+        raise ConvertError(f"unsupported UDF flavor: {which}")
+    p = cif.python_udf
+    kind = EVAL_TYPES.get(p.eval_type)
+    if kind not in expected_kinds:
+        raise ConvertError(
+            f"UDF eval type {p.eval_type} ({kind}) is not valid here; "
+            f"expected one of {sorted(expected_kinds)}")
+    func, pickled_rt = decode_command(p.command)
+    out_t = None
+    if p.HasField("output_type"):
+        out_t = data_type_from_proto(p.output_type)
+    if out_t is None:
+        out_t = pickled_rt
+    if out_t is None:
+        raise ConvertError("UDF without an output type")
+    return UserDefinedFunction(func, out_t, kind,
+                               cif.function_name or "udf",
+                               cif.deterministic)
+
+
+def udtf_from_proto(tf):
+    """CommonInlineUserDefinedTableFunction → (handler class, StructType).
+
+    Reference: crates/sail-python-udf/src/udf/pyspark_udtf.rs — the
+    payload is a cloudpickled handler class (eval(*args) yields rows,
+    optional terminate()); the declared return type is the table schema.
+    """
+    from .convert import ConvertError, data_type_from_proto
+
+    if tf.WhichOneof("function") != "python_udtf":
+        raise ConvertError("unsupported UDTF flavor")
+    p = tf.python_udtf
+    handler, pickled_rt = decode_command(p.command)
+    rt = None
+    if p.HasField("return_type"):
+        rt = data_type_from_proto(p.return_type)
+    if rt is None:
+        rt = pickled_rt
+    if not isinstance(rt, dt.StructType):
+        raise ConvertError("UDTF must declare a struct return type")
+    return handler, rt
+
+
 def udf_expr_from_proto(cif):
     """Expression-position CommonInlineUserDefinedFunction → UdfExpr."""
     from .convert import expr_from_proto

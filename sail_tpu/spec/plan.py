@@ -211,6 +211,46 @@ class Unpivot(QueryPlan):
 
 
 @dataclass(frozen=True)
+class UdtfCall(QueryPlan):
+    """Pickle-delivered Python UDTF in relation position (reference:
+    sail-python-udf pyspark_udtf.rs — handler class with eval/terminate)."""
+    handler: object = None        # the decoded UDTF class
+    args: Tuple[Expr, ...] = ()
+    return_type: object = None    # dt.StructType
+    name: str = "udtf"
+
+
+@dataclass(frozen=True)
+class GroupMap(QueryPlan):
+    """groupBy(...).applyInPandas / apply — one host UDF call per group
+    (reference: sail-python-udf grouped-map kinds,
+    pyspark_udf.rs:19-27 + MapPartitionsExec plumbing)."""
+    input: QueryPlan = None
+    grouping: Tuple[Expr, ...] = ()
+    udf: object = None            # functions.udf.UserDefinedFunction
+
+
+@dataclass(frozen=True)
+class CoGroupMap(QueryPlan):
+    """cogroup(...).applyInPandas — UDF over aligned key groups of two
+    inputs (reference: pyspark_cogroup_map_udf)."""
+    input: QueryPlan = None
+    other: QueryPlan = None
+    input_grouping: Tuple[Expr, ...] = ()
+    other_grouping: Tuple[Expr, ...] = ()
+    udf: object = None
+
+
+@dataclass(frozen=True)
+class MapPartitions(QueryPlan):
+    """mapInPandas / mapInArrow — iterator-of-batches UDF per partition
+    (reference: pyspark_map_iter_udf.rs)."""
+    input: QueryPlan = None
+    udf: object = None
+    is_barrier: bool = False
+
+
+@dataclass(frozen=True)
 class WithWatermark(QueryPlan):
     """Streaming watermark marker (event-time column + delay)."""
 

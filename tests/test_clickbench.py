@@ -1,0 +1,80 @@
+"""ClickBench: all 43 queries execute; representative queries checked
+against a pandas oracle (reference: python/pysail/tests/spark/
+test_clickbench.py snapshot suite)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession
+from sail_tpu.benchmarks.clickbench import load_queries, register_hits
+
+
+@pytest.fixture(scope="module")
+def cb():
+    spark = SparkSession({})
+    table = register_hits(spark, n_rows=8000, seed=3)
+    return spark, table.to_pandas()
+
+
+def test_all_queries_execute(cb):
+    spark, _ = cb
+    errs = {}
+    for i, q in enumerate(load_queries(), 1):
+        try:
+            spark.sql(q).toArrow()
+        except Exception as e:  # noqa: BLE001
+            errs[i] = f"{type(e).__name__}: {e}"
+    assert not errs, errs
+
+
+def test_q1_count(cb):
+    spark, pdf = cb
+    got = spark.sql("SELECT COUNT(*) FROM hits").toPandas()
+    assert got.iloc[0, 0] == len(pdf)
+
+
+def test_q2_filtered_count(cb):
+    spark, pdf = cb
+    got = spark.sql(
+        "SELECT COUNT(*) FROM hits WHERE AdvEngineID <> 0").toPandas()
+    assert got.iloc[0, 0] == int((pdf.AdvEngineID != 0).sum())
+
+
+def test_q5_distinct_users(cb):
+    spark, pdf = cb
+    got = spark.sql("SELECT COUNT(DISTINCT UserID) FROM hits").toPandas()
+    assert got.iloc[0, 0] == pdf.UserID.nunique()
+
+
+def test_q8_group_order_by_count(cb):
+    spark, pdf = cb
+    got = spark.sql(
+        "SELECT AdvEngineID, COUNT(*) FROM hits WHERE AdvEngineID <> 0 "
+        "GROUP BY AdvEngineID ORDER BY COUNT(*) DESC").toPandas()
+    exp = (pdf[pdf.AdvEngineID != 0].groupby("AdvEngineID").size()
+           .sort_values(ascending=False))
+    assert got.iloc[:, 1].tolist() == exp.tolist()
+
+
+def test_high_cardinality_url_groupby(cb):
+    """The string cliff: GROUP BY over near-unique URL strings."""
+    spark, pdf = cb
+    got = spark.sql(
+        "SELECT URL, COUNT(*) AS c FROM hits GROUP BY URL "
+        "ORDER BY c DESC, URL LIMIT 10").toPandas()
+    exp = (pdf.groupby("URL").size().rename("c").reset_index()
+           .sort_values(["c", "URL"], ascending=[False, True]).head(10))
+    assert got.c.tolist() == exp.c.tolist()
+    assert got.URL.tolist() == exp.URL.tolist()
+
+
+def test_search_phrase_filter_and_group(cb):
+    spark, pdf = cb
+    got = spark.sql(
+        "SELECT SearchPhrase, COUNT(*) FROM hits "
+        "WHERE SearchPhrase <> '' GROUP BY SearchPhrase "
+        "ORDER BY COUNT(*) DESC LIMIT 5").toPandas()
+    exp = (pdf[pdf.SearchPhrase != ""].groupby("SearchPhrase").size()
+           .sort_values(ascending=False).head(5))
+    assert got.iloc[:, 1].tolist() == exp.tolist()

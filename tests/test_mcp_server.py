@@ -1,0 +1,104 @@
+"""MCP server: JSON-RPC protocol surface + tool behavior.
+
+Reference role: crates/sail-cli/src/spark/mcp_server.rs +
+src/python/spark_mcp_server.py (fastmcp over Spark Connect there; a
+from-scratch protocol implementation here)."""
+
+import io
+import json
+
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from sail_tpu import SparkSession
+from sail_tpu.mcp_server import McpSparkServer
+
+
+@pytest.fixture()
+def server():
+    return McpSparkServer(SparkSession({}))
+
+
+def _call(server, method, params=None, msg_id=1):
+    return server.handle({"jsonrpc": "2.0", "id": msg_id, "method": method,
+                          "params": params or {}})
+
+
+def _tool(server, name, arguments):
+    resp = _call(server, "tools/call", {"name": name,
+                                        "arguments": arguments})
+    content = resp["result"]["content"][0]["text"]
+    return resp["result"]["isError"], content
+
+
+def test_initialize_and_list_tools(server):
+    resp = _call(server, "initialize")
+    assert resp["result"]["protocolVersion"] == "2024-11-05"
+    assert "tools" in resp["result"]["capabilities"]
+    # the initialized notification gets no response
+    assert server.handle({"jsonrpc": "2.0",
+                          "method": "notifications/initialized"}) is None
+    tools = _call(server, "tools/list")["result"]["tools"]
+    names = {t["name"] for t in tools}
+    assert {"execute_query", "list_views", "describe_view",
+            "create_parquet_view", "create_csv_view",
+            "create_json_view"} <= names
+    for t in tools:
+        assert t["inputSchema"]["type"] == "object"
+
+
+def test_execute_query_tool(server):
+    err, text = _tool(server, "execute_query",
+                      {"query": "SELECT 1 AS a, 'x' AS b"})
+    assert not err
+    assert json.loads(text) == [{"a": 1, "b": "x"}]
+
+
+def test_create_view_and_describe(server, tmp_path):
+    f = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"id": [1, 2, 3], "v": [1.5, 2.5, 3.5]}), f)
+    err, _ = _tool(server, "create_parquet_view", {"name": "pv", "path": f})
+    assert not err
+    err, text = _tool(server, "execute_query",
+                      {"query": "SELECT SUM(v) AS s FROM pv"})
+    assert not err and json.loads(text) == [{"s": 7.5}]
+    err, text = _tool(server, "describe_view", {"name": "pv"})
+    assert not err
+    cols = {c["name"]: c["dataType"] for c in json.loads(text)}
+    assert set(cols) == {"id", "v"}
+    err, text = _tool(server, "list_views", {})
+    assert not err and "pv" in json.loads(text)
+
+
+def test_tool_error_is_result_not_crash(server):
+    err, text = _tool(server, "execute_query",
+                      {"query": "SELECT * FROM does_not_exist"})
+    assert err
+    assert "does_not_exist" in text
+
+
+def test_unknown_method_is_jsonrpc_error(server):
+    resp = _call(server, "bogus/method")
+    assert resp["error"]["code"] == -32601
+
+
+def test_stdio_transport_roundtrip(server):
+    lines = [
+        json.dumps({"jsonrpc": "2.0", "id": 1, "method": "initialize",
+                    "params": {}}),
+        json.dumps({"jsonrpc": "2.0", "method":
+                    "notifications/initialized"}),
+        json.dumps({"jsonrpc": "2.0", "id": 2, "method": "tools/call",
+                    "params": {"name": "execute_query",
+                               "arguments": {"query": "SELECT 42 AS x"}}}),
+    ]
+    out = io.StringIO()
+    server.serve(stdin=io.StringIO("\n".join(lines) + "\n"), stdout=out)
+    responses = [json.loads(line) for line in
+                 out.getvalue().strip().splitlines()]
+    assert len(responses) == 2  # notification produced no response
+    assert responses[0]["id"] == 1
+    body = responses[1]["result"]["content"][0]["text"]
+    assert json.loads(body) == [{"x": 42}]

@@ -268,3 +268,223 @@ def test_wire_udaf_closure_change_not_cached(client):
     assert run() == 10.0
     reg(3)
     assert run() == 15.0
+
+
+# ---------------------------------------------------------------------------
+# relation-position UDFs: GroupMap / CoGroupMap / MapPartitions
+# (reference: pyspark_udf.rs grouped-map kinds, pyspark_map_iter_udf.rs)
+# ---------------------------------------------------------------------------
+
+SQL_GROUPED_MAP_PANDAS_UDF = 201
+SQL_MAP_PANDAS_ITER_UDF = 205
+SQL_COGROUPED_MAP_PANDAS_UDF = 206
+SQL_MAP_ARROW_ITER_UDF = 207
+
+
+def _struct_proto(ddl_fields):
+    """[('name', 'bigint'), ...] → proto struct DataType."""
+    from spark.connect import types_pb2 as tpb
+    t = tpb.DataType()
+    for name, typ in ddl_fields:
+        f = t.struct.fields.add()
+        f.name = name
+        f.data_type.CopyFrom(_ddl_to_proto(typ))
+        f.nullable = True
+    return t
+
+
+def _relation_udf(func, eval_type, ddl_fields, name="f"):
+    u = epb.CommonInlineUserDefinedFunction()
+    u.function_name = name
+    u.deterministic = True
+    u.python_udf.eval_type = eval_type
+    u.python_udf.command = cloudpickle.dumps((func, None))
+    u.python_udf.python_ver = "3.12"
+    u.python_udf.output_type.CopyFrom(_struct_proto(ddl_fields))
+    return u
+
+
+def test_wire_group_map(client):
+    table = pa.table({"k": [1, 1, 2, 2, 2], "v": [1., 2., 3., 4., 5.]})
+
+    def demean(pdf):
+        pdf = pdf.copy()
+        pdf["v"] = pdf["v"] - pdf["v"].mean()
+        return pdf
+
+    rel = rpb.Relation()
+    rel.group_map.input.CopyFrom(_local_rel(table))
+    rel.group_map.grouping_expressions.add().CopyFrom(_col("k"))
+    rel.group_map.func.CopyFrom(_relation_udf(
+        demean, SQL_GROUPED_MAP_PANDAS_UDF,
+        [("k", "bigint"), ("v", "double")]))
+    out = client.execute_relation(rel).to_pandas()
+    out = out.sort_values(["k", "v"]).reset_index(drop=True)
+    assert out.v.tolist() == [-0.5, 0.5, -1.0, 0.0, 1.0]
+
+
+def test_wire_group_map_with_key_signature(client):
+    table = pa.table({"k": [1, 1, 2], "v": [1., 2., 3.]})
+
+    def summarize(key, pdf):
+        import pandas as pd
+        return pd.DataFrame({"k": [key[0]], "n": [len(pdf)]})
+
+    rel = rpb.Relation()
+    rel.group_map.input.CopyFrom(_local_rel(table))
+    rel.group_map.grouping_expressions.add().CopyFrom(_col("k"))
+    rel.group_map.func.CopyFrom(_relation_udf(
+        summarize, SQL_GROUPED_MAP_PANDAS_UDF,
+        [("k", "bigint"), ("n", "bigint")]))
+    out = client.execute_relation(rel).to_pandas().sort_values("k")
+    assert out.n.tolist() == [2, 1]
+
+
+def test_wire_cogroup_map(client):
+    left = pa.table({"k": [1, 1, 2], "v": [1., 2., 3.]})
+    right = pa.table({"k": [1, 3], "w": [10., 30.]})
+
+    def merge(l, r):
+        import pandas as pd
+        k = l.k.iloc[0] if len(l) else r.k.iloc[0]
+        return pd.DataFrame({"k": [k], "nl": [len(l)], "nr": [len(r)]})
+
+    rel = rpb.Relation()
+    rel.co_group_map.input.CopyFrom(_local_rel(left))
+    rel.co_group_map.other.CopyFrom(_local_rel(right))
+    rel.co_group_map.input_grouping_expressions.add().CopyFrom(_col("k"))
+    rel.co_group_map.other_grouping_expressions.add().CopyFrom(_col("k"))
+    rel.co_group_map.func.CopyFrom(_relation_udf(
+        merge, SQL_COGROUPED_MAP_PANDAS_UDF,
+        [("k", "bigint"), ("nl", "bigint"), ("nr", "bigint")]))
+    out = client.execute_relation(rel).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    assert out.k.tolist() == [1, 2, 3]
+    assert out.nl.tolist() == [2, 1, 0]
+    assert out.nr.tolist() == [1, 0, 1]
+
+
+def test_wire_map_in_pandas(client):
+    table = pa.table({"x": [1, 2, 3]})
+
+    def doubler(batches):
+        for pdf in batches:
+            pdf = pdf.copy()
+            pdf["x"] = pdf["x"] * 2
+            yield pdf
+
+    rel = rpb.Relation()
+    rel.map_partitions.input.CopyFrom(_local_rel(table))
+    rel.map_partitions.func.CopyFrom(_relation_udf(
+        doubler, SQL_MAP_PANDAS_ITER_UDF, [("x", "bigint")]))
+    out = client.execute_relation(rel).to_pandas()
+    assert sorted(out.x.tolist()) == [2, 4, 6]
+
+
+def test_wire_map_in_arrow(client):
+    table = pa.table({"x": [1, 2, 3]})
+
+    def add_ten(batches):
+        import pyarrow as pa_
+        import pyarrow.compute as pc
+        for b in batches:
+            yield pa_.RecordBatch.from_arrays(
+                [pc.add(b.column(0), 10)], names=["x"])
+
+    rel = rpb.Relation()
+    rel.map_partitions.input.CopyFrom(_local_rel(table))
+    rel.map_partitions.func.CopyFrom(_relation_udf(
+        add_ten, SQL_MAP_ARROW_ITER_UDF, [("x", "bigint")]))
+    out = client.execute_relation(rel).to_pandas()
+    assert sorted(out.x.tolist()) == [11, 12, 13]
+
+
+def test_wire_group_map_missing_column_errors(client):
+    table = pa.table({"k": [1], "v": [1.]})
+
+    def bad(pdf):
+        import pandas as pd
+        return pd.DataFrame({"something_else": [1]})
+
+    rel = rpb.Relation()
+    rel.group_map.input.CopyFrom(_local_rel(table))
+    rel.group_map.grouping_expressions.add().CopyFrom(_col("k"))
+    rel.group_map.func.CopyFrom(_relation_udf(
+        bad, SQL_GROUPED_MAP_PANDAS_UDF, [("k", "bigint")]))
+    with pytest.raises(Exception, match="missing declared columns"):
+        client.execute_relation(rel)
+
+
+# ---------------------------------------------------------------------------
+# pickle-delivered UDTFs (reference: pyspark_udtf.rs)
+# ---------------------------------------------------------------------------
+
+class _SplitWords:
+    def eval(self, text, sep):
+        for i, w in enumerate(text.split(sep)):
+            yield (i, w)
+
+    def terminate(self):
+        yield (-1, "<done>")
+
+
+def test_wire_udtf_relation(client):
+    rel = rpb.Relation()
+    tf = rel.common_inline_user_defined_table_function
+    tf.function_name = "split_words"
+    tf.deterministic = True
+    a1 = tf.arguments.add()
+    a1.literal.string = "a,b,c"
+    a2 = tf.arguments.add()
+    a2.literal.string = ","
+    tf.python_udtf.eval_type = 300
+    tf.python_udtf.command = cloudpickle.dumps((_SplitWords, None))
+    tf.python_udtf.python_ver = "3.12"
+    tf.python_udtf.return_type.CopyFrom(_struct_proto(
+        [("i", "bigint"), ("w", "string")]))
+    out = client.execute_relation(rel).to_pandas()
+    assert out.w.tolist() == ["a", "b", "c", "<done>"]
+    assert out.i.tolist() == [0, 1, 2, -1]
+
+
+def test_wire_udtf_registered_for_sql(client):
+    cmd = cpb.Command()
+    tf = cmd.register_table_function
+    tf.function_name = "splitter"
+    tf.deterministic = True
+    tf.python_udtf.eval_type = 300
+    tf.python_udtf.command = cloudpickle.dumps((_SplitWords, None))
+    tf.python_udtf.python_ver = "3.12"
+    tf.python_udtf.return_type.CopyFrom(_struct_proto(
+        [("i", "bigint"), ("w", "string")]))
+    plan = bpb.Plan()
+    plan.command.CopyFrom(cmd)
+    list(client.execute_plan(plan))  # drain the response stream
+    out = client.sql("SELECT w FROM splitter('x;y', ';') WHERE i >= 0") \
+        .to_pandas()
+    assert out.w.tolist() == ["x", "y"]
+
+
+def test_wire_cogroup_null_keys_align(client):
+    """NULL group keys on both sides must cogroup into ONE UDF call."""
+    left = pa.table({"k": pa.array([1, None], type=pa.int64()),
+                     "v": [1., 2.]})
+    right = pa.table({"k": pa.array([None, 2], type=pa.int64()),
+                      "w": [10., 20.]})
+
+    def merge(l, r):
+        import pandas as pd
+        return pd.DataFrame({"nl": [len(l)], "nr": [len(r)]})
+
+    rel = rpb.Relation()
+    rel.co_group_map.input.CopyFrom(_local_rel(left))
+    rel.co_group_map.other.CopyFrom(_local_rel(right))
+    rel.co_group_map.input_grouping_expressions.add().CopyFrom(_col("k"))
+    rel.co_group_map.other_grouping_expressions.add().CopyFrom(_col("k"))
+    rel.co_group_map.func.CopyFrom(_relation_udf(
+        merge, SQL_COGROUPED_MAP_PANDAS_UDF,
+        [("nl", "bigint"), ("nr", "bigint")]))
+    out = client.execute_relation(rel).to_pandas()
+    # groups: k=1 (1,0), k=2 (0,1), k=NULL (1,1) — exactly three calls
+    assert len(out) == 3
+    assert sorted(zip(out.nl, out.nr)) == [(0, 1), (1, 0), (1, 1)]
