@@ -174,6 +174,24 @@ def configure_catalogs(manager: CatalogManager) -> None:
                     host=str(config_get(f"catalog.{key}.host",
                                         "localhost")),
                     port=int(config_get(f"catalog.{key}.port", 9083)))
+            elif ctype == "glue":
+                from .glue import GlueCatalog
+                provider = GlueCatalog(
+                    nm,
+                    region=str(config_get(f"catalog.{key}.region",
+                                          "us-east-1")),
+                    endpoint=config_get(f"catalog.{key}.endpoint"),
+                    access_key=config_get(f"catalog.{key}.access_key"),
+                    secret_key=config_get(f"catalog.{key}.secret_key"),
+                    catalog_id=config_get(f"catalog.{key}.catalog_id"))
+            elif ctype == "unity":
+                from .unity import UnityCatalog
+                provider = UnityCatalog(
+                    nm,
+                    uri=str(config_get(f"catalog.{key}.uri", "")),
+                    catalog_name=str(config_get(
+                        f"catalog.{key}.catalog_name", "main")),
+                    token=config_get(f"catalog.{key}.token"))
             elif ctype == "memory":
                 from .provider import MemoryCatalogProvider
                 provider = MemoryCatalogProvider(nm)

@@ -1,0 +1,113 @@
+"""Databricks Unity Catalog provider (REST).
+
+Reference role: crates/sail-catalog-unity (OpenAPI-generated REST client
+there). This build speaks the open Unity Catalog REST API
+(``/api/2.1/unity-catalog``: schemas, tables) with bearer-token auth —
+the same surface the open-source unitycatalog server exposes, so it is
+testable against an in-repo fake. Column types arrive as Spark
+``type_text`` strings and parse with the shared hive/spark type parser.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import List, Optional
+
+from ..spec import data_type as dt
+from .hms import parse_hive_type
+from .manager import TableEntry
+from .provider import CatalogError, CatalogProvider
+
+
+class UnityCatalog(CatalogProvider):
+    def __init__(self, name: str, uri: str, catalog_name: str,
+                 token: Optional[str] = None, timeout: float = 30.0):
+        self.name = name
+        self.uri = uri.rstrip("/")
+        self.catalog_name = catalog_name
+        self.token = token
+        self.timeout = timeout
+
+    def _get(self, path: str, query: Optional[dict] = None, default=None):
+        url = f"{self.uri}/api/2.1/unity-catalog{path}"
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        req = urllib.request.Request(url)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+                return json.loads(data) if data else {}
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return default
+            detail = e.read().decode(errors="replace")[:400]
+            raise CatalogError(f"unity GET {path}: HTTP {e.code}: {detail}")
+        except urllib.error.URLError as e:
+            raise CatalogError(f"unity catalog unreachable: {e}")
+
+    # -- databases (schemas) ---------------------------------------------
+    def list_databases(self) -> List[str]:
+        out = self._get("/schemas",
+                        {"catalog_name": self.catalog_name}) or {}
+        return sorted(s["name"] for s in out.get("schemas", []))
+
+    def database_info(self, name: str) -> Optional[dict]:
+        out = self._get(f"/schemas/{self.catalog_name}.{name}",
+                        default=None)
+        if out is None:
+            return None
+        return {"comment": out.get("comment"),
+                "location": out.get("storage_location"),
+                "properties": out.get("properties", {})}
+
+    def create_database(self, name, if_not_exists=False, comment=None,
+                        location=None):
+        raise CatalogError("unity catalog is read-only in this engine")
+
+    def drop_database(self, name, if_exists=False, cascade=False):
+        raise CatalogError("unity catalog is read-only in this engine")
+
+    # -- tables ----------------------------------------------------------
+    def list_tables(self, database: str) -> List[str]:
+        out = self._get("/tables", {"catalog_name": self.catalog_name,
+                                    "schema_name": database}) or {}
+        return sorted(t["name"] for t in out.get("tables", []))
+
+    def get_table(self, database: str, table: str) -> Optional[TableEntry]:
+        full = f"{self.catalog_name}.{database}.{table}"
+        t = self._get(f"/tables/{full}", default=None)
+        if t is None:
+            return None
+        fields = []
+        for c in t.get("columns", []) or []:
+            try:
+                typ = parse_hive_type(c.get("type_text", "string"))
+            except CatalogError:
+                typ = dt.StringType()
+            fields.append(dt.StructField(c.get("name", ""), typ,
+                                         bool(c.get("nullable", True))))
+        schema = dt.StructType(tuple(fields)) if fields else None
+        fmt = (t.get("data_source_format") or "parquet").lower()
+        if fmt == "delta":
+            engine_fmt = "delta"
+        elif fmt in ("parquet", "csv", "json", "avro"):
+            engine_fmt = fmt
+        else:
+            engine_fmt = "parquet"
+        location = t.get("storage_location")
+        return TableEntry(
+            name=(self.name, database, table), schema=schema,
+            paths=(location,) if location else (), format=engine_fmt,
+            comment=t.get("comment"))
+
+    def create_table(self, database, entry, replace=False,
+                     if_not_exists=False):
+        raise CatalogError("unity catalog is read-only in this engine")
+
+    def drop_table(self, database, table, if_exists=False):
+        raise CatalogError("unity catalog is read-only in this engine")

@@ -464,6 +464,19 @@ class LocalExecutor:
     def _exec_ScanExec(self, p: pn.ScanExec) -> HostBatch:
         from ..io.formats import expand_paths, read_table
         import os
+        if p.format == "python_ds":
+            # user data source: read at EXECUTION, never cached — the
+            # engine can't know the external source is stable
+            from ..io.python_datasource import materialize
+            from ..spec import data_type as dt_
+            ds_cls, opts = p.source
+            st = dt_.StructType(tuple(
+                dt_.StructField(f.name, f.dtype, f.nullable)
+                for f in p.out_schema))
+            table, _ = materialize(ds_cls, dict(opts), st)
+            if p.projection is not None:
+                table = table.select(list(p.projection))
+            return _positional(ai.from_arrow(table))
         if p.source is not None:
             cache_key = ("mem", id(p.source), p.projection)
         elif p.format == "delta":

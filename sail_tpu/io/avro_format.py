@@ -1,0 +1,195 @@
+"""Avro file format: arrow ⇄ Avro object-container files.
+
+Reference role: the reference's avro TableFormat
+(crates/sail-data-source, apache-avro crate there). Reuses the engine's
+own Avro OCF codec (lakehouse/iceberg/avro_io.py — records, nullable
+unions, arrays, maps) and adds the logical types files in the wild use:
+date (int days), timestamp-micros (long), decimal-as-string fallback.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+
+from ..lakehouse.iceberg import avro_io
+
+
+def _arrow_to_avro_type(t: pa.DataType, name: str):
+    if pa.types.is_boolean(t):
+        return "boolean"
+    if pa.types.is_integer(t):
+        return "long" if t.bit_width > 32 else "int"
+    if pa.types.is_float32(t):
+        return "float"
+    if pa.types.is_floating(t):
+        return "double"
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return "string"
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return "bytes"
+    if pa.types.is_date(t):
+        return {"type": "int", "logicalType": "date"}
+    if pa.types.is_timestamp(t):
+        return {"type": "long", "logicalType": "timestamp-micros"}
+    if pa.types.is_decimal(t):
+        # string carry: precision-lossless and portable without fixed()
+        return {"type": "string", "logicalType": "sail-decimal",
+                "precision": t.precision, "scale": t.scale}
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return {"type": "array",
+                "items": _nullable(_arrow_to_avro_type(t.value_type,
+                                                       name + "_item"))}
+    if pa.types.is_map(t):
+        if not pa.types.is_string(t.key_type):
+            raise ValueError("avro maps require string keys")
+        return {"type": "map",
+                "values": _nullable(_arrow_to_avro_type(t.item_type,
+                                                        name + "_value"))}
+    if pa.types.is_struct(t):
+        return {"type": "record", "name": f"r_{name}",
+                "fields": [{"name": f.name,
+                            "type": _nullable(_arrow_to_avro_type(
+                                f.type, f"{name}_{f.name}"))}
+                           for f in t]}
+    raise ValueError(f"cannot map arrow type {t} to avro")
+
+
+def _nullable(avro_type):
+    return ["null", avro_type]
+
+
+def _avro_schema_of(schema: pa.Schema) -> dict:
+    return {"type": "record", "name": "row", "fields": [
+        {"name": f.name,
+         "type": _nullable(_arrow_to_avro_type(f.type, f.name)),
+         "default": None}
+        for f in schema]}
+
+
+_EPOCH_DATE = datetime.date(1970, 1, 1)
+_EPOCH_TS = datetime.datetime(1970, 1, 1)
+
+
+def _encode_cell(v, t: pa.DataType):
+    if v is None:
+        return None
+    if pa.types.is_date(t):
+        return (v - _EPOCH_DATE).days
+    if pa.types.is_timestamp(t):
+        base = _EPOCH_TS if v.tzinfo is None else _EPOCH_TS.replace(
+            tzinfo=datetime.timezone.utc)
+        return int((v - base).total_seconds() * 1_000_000)
+    if pa.types.is_decimal(t):
+        return str(v)
+    if pa.types.is_struct(t):
+        return {f.name: _encode_cell(v.get(f.name), f.type) for f in t}
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return [_encode_cell(x, t.value_type) for x in v]
+    if pa.types.is_map(t):
+        return {k: _encode_cell(val, t.item_type) for k, val in v}
+    return v
+
+
+def write_avro(table: pa.Table, path: str):
+    schema = _avro_schema_of(table.schema)
+    rows = table.to_pylist()
+    needs = [f for f in table.schema
+             if pa.types.is_date(f.type) or pa.types.is_timestamp(f.type)
+             or pa.types.is_decimal(f.type) or pa.types.is_struct(f.type)
+             or pa.types.is_list(f.type) or pa.types.is_map(f.type)]
+    if needs:
+        for row in rows:
+            for f in needs:
+                row[f.name] = _encode_cell(row[f.name], f.type)
+    avro_io.write_container(path, schema, rows)
+
+
+def _avro_to_arrow_type(t) -> pa.DataType:
+    if isinstance(t, list):  # union: use the non-null branch
+        branches = [b for b in t if b != "null"]
+        return _avro_to_arrow_type(branches[0]) if branches else pa.null()
+    if isinstance(t, dict):
+        logical = t.get("logicalType")
+        if logical == "date":
+            return pa.date32()
+        if logical in ("timestamp-micros", "timestamp-millis"):
+            return pa.timestamp("us")
+        if logical in ("sail-decimal", "decimal"):
+            return pa.decimal128(int(t.get("precision", 38)),
+                                 int(t.get("scale", 18)))
+        kind = t["type"]
+        if kind == "record":
+            return pa.struct([(f["name"],
+                               _avro_to_arrow_type(f["type"]))
+                              for f in t["fields"]])
+        if kind == "array":
+            return pa.list_(_avro_to_arrow_type(t["items"]))
+        if kind == "map":
+            return pa.map_(pa.string(), _avro_to_arrow_type(t["values"]))
+        if kind == "fixed":
+            return pa.binary(t.get("size", -1))
+        return _avro_to_arrow_type(kind)
+    prim = {"boolean": pa.bool_(), "int": pa.int32(), "long": pa.int64(),
+            "float": pa.float32(), "double": pa.float64(),
+            "string": pa.string(), "bytes": pa.binary(),
+            "null": pa.null()}
+    if t in prim:
+        return prim[t]
+    raise ValueError(f"unknown avro type {t!r}")
+
+
+def _decode_cell(v, t, at: pa.DataType):
+    if v is None:
+        return None
+    if pa.types.is_date(at):
+        return _EPOCH_DATE + datetime.timedelta(days=int(v))
+    if pa.types.is_timestamp(at):
+        return _EPOCH_TS + datetime.timedelta(microseconds=int(v))
+    if pa.types.is_decimal(at):
+        return decimal.Decimal(v)
+    if pa.types.is_struct(at):
+        branches = t if not isinstance(t, list) else \
+            [b for b in t if b != "null"][0]
+        fields = {f["name"]: f["type"] for f in branches["fields"]}
+        return {f.name: _decode_cell(v.get(f.name), fields.get(f.name), f.type)
+                for f in at}
+    if pa.types.is_list(at):
+        branches = t if not isinstance(t, list) else \
+            [b for b in t if b != "null"][0]
+        return [_decode_cell(x, branches["items"], at.value_type)
+                for x in v]
+    if pa.types.is_map(at):
+        branches = t if not isinstance(t, list) else \
+            [b for b in t if b != "null"][0]
+        return [(k, _decode_cell(val, branches["values"], at.item_type))
+                for k, val in v.items()]
+    return v
+
+
+def read_avro(paths: Sequence[str],
+              columns: Optional[Sequence[str]] = None) -> pa.Table:
+    import json
+
+    tables: List[pa.Table] = []
+    for path in paths:
+        records, meta = avro_io.read_container(path)
+        schema = json.loads(meta["avro.schema"])
+        fields = schema.get("fields", [])
+        names = [f["name"] for f in fields]
+        types = {f["name"]: f["type"] for f in fields}
+        arrow_fields = [(n, _avro_to_arrow_type(types[n])) for n in names
+                        if columns is None or n in columns]
+        arrays = []
+        for n, at in arrow_fields:
+            cells = [_decode_cell(r.get(n), types[n], at) for r in records]
+            arrays.append(pa.array(cells, type=at))
+        tables.append(pa.Table.from_arrays(
+            arrays, names=[n for n, _ in arrow_fields]))
+    if not tables:
+        raise FileNotFoundError("no avro files")
+    return pa.concat_tables(tables, promote_options="permissive") \
+        if len(tables) > 1 else tables[0]

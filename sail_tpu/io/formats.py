@@ -191,6 +191,9 @@ def read_table(fmt: str, paths: Sequence[str], options: Dict[str, str],
         tables = [feather.read_table(f, columns=list(columns) if columns else None)
                   for f in files]
         table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    elif fmt == "avro":
+        from .avro_format import read_avro
+        table = read_avro(files, columns)
     elif fmt in ("text", "binaryfile", "binary"):
         rows = []
         for f in files:
@@ -215,6 +218,15 @@ def write_table(table: pa.Table, fmt: str, path: str, mode: str = "error",
     invalidate_listings()  # any engine write changes listings
     options = options or {}
     fmt = fmt.lower()
+    if fmt == "noop":
+        return  # reference: the noop sink discards its input
+    if fmt == "console":
+        # reference: console sink prints batches (show-string style)
+        n = int(options.get("numrows", "20"))
+        print(table.slice(0, n).to_pandas().to_string(index=False))
+        if table.num_rows > n:
+            print(f"... ({table.num_rows - n} more rows)")
+        return
     if fmt == "iceberg":
         from ..lakehouse.iceberg import IcebergTable
         t = IcebergTable(path)
@@ -273,6 +285,9 @@ def write_table(table: pa.Table, fmt: str, path: str, mode: str = "error",
         shutil.rmtree(path)
     os.makedirs(path, exist_ok=True)
     if partition_by:
+        if fmt == "avro":
+            raise NotImplementedError(
+                "partitionBy is not supported for avro writes")
         pads.write_dataset(table, path, format=_ds_format(fmt),
                            partitioning=list(partition_by),
                            partitioning_flavor="hive",
@@ -295,6 +310,9 @@ def write_table(table: pa.Table, fmt: str, path: str, mode: str = "error",
     elif fmt in ("arrow", "ipc", "feather"):
         import pyarrow.feather as feather
         feather.write_feather(table, fpath)
+    elif fmt == "avro":
+        from .avro_format import write_avro
+        write_avro(table, fpath)
     else:
         raise ValueError(f"unsupported write format {fmt!r}")
 

@@ -1,0 +1,98 @@
+"""User-defined Python data sources (the PySpark DataSource API).
+
+Reference role: crates/sail-data-source/src/formats/python/mod.rs:1-51 —
+user classes registered by name, schema discovery, partitioned reads
+driven from Python. API surface mirrors pyspark.sql.datasource:
+
+    class MySource(DataSource):
+        @classmethod
+        def name(cls): return "my_source"
+        def schema(self): return "id bigint, v string"
+        def reader(self, schema): return MyReader(self.options)
+
+    class MyReader(DataSourceReader):
+        def partitions(self): return [InputPartition(0), InputPartition(1)]
+        def read(self, partition): yield (1, "a")
+
+    spark.dataSource.register(MySource)
+    spark.read.format("my_source").option(...).load()
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+
+class InputPartition:
+    def __init__(self, value=None):
+        self.value = value
+
+    def __repr__(self):
+        return f"InputPartition({self.value!r})"
+
+
+class DataSource:
+    def __init__(self, options: Optional[Dict[str, str]] = None):
+        self.options = dict(options or {})
+
+    @classmethod
+    def name(cls) -> str:
+        return cls.__name__.lower()
+
+    def schema(self):
+        raise NotImplementedError
+
+    def reader(self, schema) -> "DataSourceReader":
+        raise NotImplementedError
+
+    def writer(self, schema, overwrite: bool):
+        raise NotImplementedError(
+            f"data source {self.name()!r} does not support writes")
+
+
+class DataSourceReader:
+    def partitions(self) -> Sequence[InputPartition]:
+        return [InputPartition(None)]
+
+    def read(self, partition) -> Iterator[tuple]:
+        raise NotImplementedError
+
+
+def resolve_schema(ds_cls, options: Dict[str, str], declared_schema=None):
+    """Schema discovery only (no data read — safe at plan time)."""
+    from ..spec import data_type as dt
+
+    schema = declared_schema
+    if schema is None:
+        schema = ds_cls(options).schema()
+    if isinstance(schema, str):
+        from ..session import _parse_ddl_schema
+        schema = _parse_ddl_schema(schema)
+    if not isinstance(schema, dt.StructType):
+        raise TypeError(
+            f"data source {ds_cls.__name__}: schema() must return a DDL "
+            f"string or StructType, got {type(schema).__name__}")
+    return schema
+
+
+def materialize(ds_cls, options: Dict[str, str], declared_schema=None):
+    """Instantiate, discover schema, read all partitions → pa.Table."""
+    import pyarrow as pa
+
+    from ..columnar.arrow_interop import spec_type_to_arrow
+
+    schema = resolve_schema(ds_cls, options, declared_schema)
+    ds = ds_cls(options)
+    reader = ds.reader(schema)
+    rows: List[tuple] = []
+    for part in reader.partitions():
+        for row in reader.read(part):
+            if not isinstance(row, (tuple, list)):
+                row = (row,)
+            rows.append(tuple(row))
+    names = [f.name for f in schema.fields]
+    types = [spec_type_to_arrow(f.data_type) for f in schema.fields]
+    arrays = [pa.array([r[i] if i < len(r) else None for r in rows],
+                       type=t)
+              for i, t in enumerate(types)]
+    return pa.Table.from_arrays(arrays, names=names), schema

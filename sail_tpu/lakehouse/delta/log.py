@@ -302,10 +302,105 @@ class DeltaLog:
             json.dump({"version": snapshot.version, "size": len(rows)}, f)
         os.replace(tmp, os.path.join(self.log_dir, "_last_checkpoint"))
 
+    # -- V2 checkpoints ---------------------------------------------------
+    # Reference: crates/sail-delta-lake/src/checkpoint/ — a manifest file
+    # `<version>.checkpoint.<uuid>.parquet` holding protocol/metaData +
+    # `sidecar` actions pointing at `_sidecars/<uuid>.parquet` files that
+    # carry the add/remove actions.
+
+    def write_checkpoint_v2(self, snapshot: Snapshot,
+                            actions_per_sidecar: int = 100_000):
+        import uuid as _uuid
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        schema = self._checkpoint_schema()
+        side_dir = os.path.join(self.log_dir, "_sidecars")
+        os.makedirs(side_dir, exist_ok=True)
+        file_rows = []
+        for add in snapshot.files.values():
+            a = add.to_json()["add"]
+            a["partitionValues"] = list(a["partitionValues"].items())
+            a.setdefault("stats", None)
+            a.setdefault("deletionVector", None)
+            file_rows.append({"add": a})
+        cutoff = int(time.time() * 1000) - _retention_ms(snapshot)
+        for rm in snapshot.tombstones.values():
+            if rm.deletion_timestamp >= cutoff:
+                file_rows.append({"remove": rm.to_json()["remove"]})
+        sidecars = []
+        for i in range(0, max(len(file_rows), 1), actions_per_sidecar):
+            chunk = file_rows[i:i + actions_per_sidecar]
+            name = f"{_uuid.uuid4().hex}.parquet"
+            path = os.path.join(side_dir, name)
+            cols = {n: [r.get(n) for r in chunk] for n in ("add", "remove")}
+            pq.write_table(pa.table(
+                {n: pa.array(cols[n], type=schema.field(n).type)
+                 for n in ("add", "remove")}), path)
+            sidecars.append({"path": name,
+                             "sizeInBytes": os.path.getsize(path),
+                             "modificationTime": int(time.time() * 1000)})
+        manifest_rows = []
+        if snapshot.protocol is not None:
+            manifest_rows.append(
+                {"protocol": snapshot.protocol.to_json()["protocol"]})
+        if snapshot.metadata is not None:
+            m = snapshot.metadata.to_json()["metaData"]
+            m["format"]["options"] = list(m["format"]["options"].items())
+            m["configuration"] = list(m["configuration"].items())
+            manifest_rows.append({"metaData": m})
+        for sc in sidecars:
+            manifest_rows.append({"sidecar": sc})
+        manifest_rows.append({"checkpointMetadata":
+                              {"version": snapshot.version}})
+        str_map = pa.map_(pa.string(), pa.string())
+        mschema = pa.schema([
+            schema.field("protocol"), schema.field("metaData"),
+            ("sidecar", pa.struct([("path", pa.string()),
+                                   ("sizeInBytes", pa.int64()),
+                                   ("modificationTime", pa.int64())])),
+            ("checkpointMetadata", pa.struct([("version", pa.int64()),
+                                              ("tags", str_map)])),
+        ])
+        cols = {n: [r.get(n) for r in manifest_rows] for n in mschema.names}
+        name = f"{snapshot.version:020d}.checkpoint.{_uuid.uuid4().hex}" \
+               f".parquet"
+        pq.write_table(pa.table(
+            {n: pa.array(cols[n], type=mschema.field(n).type)
+             for n in mschema.names}), os.path.join(self.log_dir, name))
+        tmp = os.path.join(self.log_dir, "_last_checkpoint.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": snapshot.version,
+                       "size": len(file_rows) + len(manifest_rows),
+                       "v2Checkpoint": {"path": name}}, f)
+        os.replace(tmp, os.path.join(self.log_dir, "_last_checkpoint"))
+
+    def _v2_manifest(self, version: int) -> Optional[str]:
+        p = os.path.join(self.log_dir, "_last_checkpoint")
+        if os.path.exists(p):
+            with open(p, "r", encoding="utf-8") as f:
+                lc = json.load(f)
+            v2 = lc.get("v2Checkpoint")
+            if v2 and lc.get("version") == version:
+                return os.path.join(self.log_dir, v2["path"])
+        import glob as _glob
+        hits = sorted(_glob.glob(os.path.join(
+            self.log_dir, f"{version:020d}.checkpoint.*.parquet")))
+        return hits[-1] if hits else None
+
     def read_checkpoint(self, version: int) -> List[dict]:
         import pyarrow.parquet as pq
 
-        table = pq.read_table(_checkpoint_path(self.log_dir, version))
+        classic = _checkpoint_path(self.log_dir, version)
+        if os.path.exists(classic):
+            table = pq.read_table(classic)
+        else:
+            manifest = self._v2_manifest(version)
+            if manifest is None:
+                raise FileNotFoundError(
+                    f"no checkpoint for version {version}")
+            return self._read_checkpoint_v2(manifest)
         out: List[dict] = []
         for row in table.to_pylist():
             for kind in ("protocol", "metaData", "add", "remove", "txn"):
@@ -314,6 +409,29 @@ class DeltaLog:
                     continue
                 v = _maps_to_dicts(v)
                 out.append({kind: v})
+        return out
+
+    def _read_checkpoint_v2(self, manifest_path: str) -> List[dict]:
+        import pyarrow.parquet as pq
+
+        out: List[dict] = []
+        sidecar_paths = []
+        for row in pq.read_table(manifest_path).to_pylist():
+            for kind in ("protocol", "metaData", "add", "remove"):
+                v = row.get(kind)
+                if v is not None:
+                    out.append({kind: _maps_to_dicts(v)})
+            sc = row.get("sidecar")
+            if sc is not None and sc.get("path"):
+                sidecar_paths.append(sc["path"])
+        for name in sidecar_paths:
+            path = name if os.path.isabs(name) else \
+                os.path.join(self.log_dir, "_sidecars", name)
+            for row in pq.read_table(path).to_pylist():
+                for kind in ("add", "remove"):
+                    v = row.get(kind)
+                    if v is not None:
+                        out.append({kind: _maps_to_dicts(v)})
         return out
 
     # -- replay ----------------------------------------------------------

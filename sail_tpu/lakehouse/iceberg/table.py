@@ -343,11 +343,16 @@ class IcebergTable:
             for _, cols, _ in eq_deletes:
                 need.update(cols)
             read_cols = [c for c in need]
+        evolved = len(self.metadata().get("schemas", [])) > 1
+        evo_plan = self._evolution_plan(read_cols) if evolved else None
         tables = []
         for df, seq in entries:
             fp = df["file_path"]
-            t = pq.read_table(self._resolve_path(fp),
-                              columns=read_cols if read_cols else None)
+            if evolved:
+                t = self._read_evolved(fp, *evo_plan)
+            else:
+                t = pq.read_table(self._resolve_path(fp),
+                                  columns=read_cols if read_cols else None)
             t = self._apply_deletes(t, fp, seq, pos_index, eq_deletes)
             if columns is not None:
                 t = t.select(list(columns))
@@ -360,10 +365,159 @@ class IcebergTable:
             return pa.table({n: pa.array([], type=t) for n, t in fields})
         return pa.concat_tables(tables, promote_options="permissive")
 
+    def _evolution_plan(self, read_cols):
+        """(wanted fields, historical-name map) computed ONCE per scan:
+        [(name, field_id, arrow_type)] for the current schema projection."""
+        from ...columnar.arrow_interop import spec_type_to_arrow
+
+        md = self.metadata()
+        sid = md.get("current-schema-id", 0)
+        schemas = md.get("schemas", [])
+        current = next((s for s in schemas if s.get("schema-id") == sid),
+                       schemas[0])
+        historical = self._historical_names(md)
+        wanted = []
+        for f in current.get("fields", []):
+            if read_cols is not None and f["name"] not in read_cols:
+                continue
+            wanted.append((f["name"], f["id"],
+                           spec_type_to_arrow(
+                               _iceberg_type_to_spec(f["type"]))))
+        return wanted, historical
+
+    def _read_evolved(self, fp: str, wanted, historical) -> "object":
+        """Read a data file written under ANY historical schema, projected
+        onto the CURRENT schema by field id: renamed columns resolve
+        through the id's unambiguous older names, added columns null-fill,
+        dropped columns vanish. Row order/count preserved (position
+        deletes stay valid)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        path = self._resolve_path(fp)
+        file_cols = set(pq.ParquetFile(path).schema_arrow.names)
+        want_src = {}
+        for name, fid, at in wanted:
+            src = next((c for c in historical.get(fid, [name])
+                        if c in file_cols), None)
+            want_src[name] = src
+        present = sorted({s for s in want_src.values() if s is not None})
+        raw = pq.read_table(path, columns=present or None)
+        arrays, names = [], []
+        for name, fid, at in wanted:
+            src = want_src[name]
+            if src is None:
+                arr = pa.nulls(raw.num_rows, type=at)
+            else:
+                arr = raw.column(src)
+                if arr.type != at:
+                    arr = arr.cast(at, safe=False)
+            arrays.append(arr)
+            names.append(name)
+        return pa.Table.from_arrays(arrays, names=names)
+
     def history(self) -> List[dict]:
         md = self.metadata()
         return sorted(md.get("snapshots", []),
                       key=lambda s: s["timestamp-ms"], reverse=True)
+
+    # -- schema evolution -------------------------------------------------
+    # Reference: crates/sail-iceberg/src/schema_evolution.rs — columns are
+    # tracked by FIELD ID; files written under older schemas resolve
+    # through the id's historical names (add → null-fill, rename → old
+    # name lookup, drop → projected away).
+
+    def _evolve_schema(self, mutate) -> None:
+        for _ in range(10):
+            version = self._current_version()
+            md = self.metadata(version)
+            sid = md.get("current-schema-id", 0)
+            schemas = md.get("schemas", [])
+            current = next(s for s in schemas if s.get("schema-id") == sid)
+            new_schema = json.loads(json.dumps(current))  # deep copy
+            mutate(new_schema, md)
+            new_sid = max(s.get("schema-id", 0) for s in schemas) + 1
+            new_schema["schema-id"] = new_sid
+            md["schemas"] = schemas + [new_schema]
+            md["current-schema-id"] = new_sid
+            md["last-updated-ms"] = int(time.time() * 1000)
+            try:
+                self._write_metadata_version(version + 1, md)
+                return
+            except IcebergConflict:
+                continue
+        raise IcebergConflict("schema evolution lost repeated races")
+
+    def add_column(self, name: str, dtype) -> None:
+        from ...spec import data_type as dt  # noqa: F401
+
+        def mutate(schema, md):
+            if any(f["name"] == name for f in schema["fields"]):
+                raise ValueError(f"column {name!r} already exists")
+            sub, last = _spec_to_iceberg_schema(
+                dt.StructType((dt.StructField(name, dtype, True),)))
+            field = sub["fields"][0]
+            base = md.get("last-column-id", 0)
+
+            def shift(obj):
+                if isinstance(obj, dict):
+                    out = {}
+                    for k, v in obj.items():
+                        if k in ("id", "element-id", "key-id", "value-id"):
+                            out[k] = v + base
+                        else:
+                            out[k] = shift(v)
+                    return out
+                if isinstance(obj, list):
+                    return [shift(x) for x in obj]
+                return obj
+
+            schema["fields"].append(shift(field))
+            md["last-column-id"] = base + last
+
+        self._evolve_schema(mutate)
+
+    def rename_column(self, old: str, new: str) -> None:
+        def mutate(schema, md):
+            for f in schema["fields"]:
+                if f["name"] == old:
+                    f["name"] = new
+                    return
+            raise ValueError(f"column {old!r} not found")
+
+        self._evolve_schema(mutate)
+
+    def drop_column(self, name: str) -> None:
+        def mutate(schema, md):
+            before = len(schema["fields"])
+            schema["fields"] = [f for f in schema["fields"]
+                                if f["name"] != name]
+            if len(schema["fields"]) == before:
+                raise ValueError(f"column {name!r} not found")
+
+        self._evolve_schema(mutate)
+
+    def _historical_names(self, md: Optional[dict] = None
+                          ) -> Dict[int, List[str]]:
+        """field id → candidate source column names, newest schema first.
+
+        A name that EVER belonged to more than one field id is excluded:
+        without parquet field-id metadata it is ambiguous which id a
+        file's column of that name carries (drop-then-reuse / rename-onto
+        -dropped-name scenarios), and the sound answer is null-fill, not
+        a guess."""
+        md = md if md is not None else self.metadata()
+        out: Dict[int, List[str]] = {}
+        claimed: Dict[str, set] = {}
+        for s in sorted(md.get("schemas", []),
+                        key=lambda s: -s.get("schema-id", 0)):
+            for f in s.get("fields", []):
+                names = out.setdefault(f["id"], [])
+                if f["name"] not in names:
+                    names.append(f["name"])
+                claimed.setdefault(f["name"], set()).add(f["id"])
+        return {fid: [n for n in names if len(claimed[n]) == 1]
+                for fid, names in out.items()}
 
     # -- writes ----------------------------------------------------------
     def create(self, table, partition_by: Sequence[str] = ()) -> int:
@@ -608,12 +762,20 @@ class IcebergTable:
         import pyarrow.parquet as pq
 
         snap = self.snapshot()
+        evolved = len(self.metadata().get("schemas", [])) > 1
+        evo_plan = self._evolution_plan(None) if evolved else None
         out: Dict[str, List[int]] = {}
         for df, _dseq in self._entries(snap):
             if df.get("content", 0) != 0:
                 continue
             fp = df["file_path"]
-            t = pq.read_table(self._resolve_path(fp))
+            if evolved:
+                # current-schema projection: predicates reference the
+                # CURRENT column names; row order/count preserved so the
+                # recorded positions stay file positions
+                t = self._read_evolved(fp, *evo_plan)
+            else:
+                t = pq.read_table(self._resolve_path(fp))
             dead = np.asarray(mask_fn(t), dtype=bool)
             hits = np.flatnonzero(dead)
             if len(hits):

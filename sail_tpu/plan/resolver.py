@@ -307,6 +307,25 @@ class Resolver:
 
     def _resolve_read_source(self, plan: sp.ReadDataSource, outer):
         from ..io.formats import infer_schema
+        ds_cls = getattr(self.catalog, "data_sources", {}).get(
+            (plan.format or "").lower())
+        if ds_cls is not None:
+            # user-defined Python data source (reference:
+            # sail-data-source formats/python PythonDataSourceExec).
+            # Schema discovery only here; the READ runs at execution
+            # (ScanExec format "python_ds"), not once per plan resolve.
+            from ..io.python_datasource import resolve_schema
+            opts = dict(plan.options)
+            if plan.paths:
+                opts.setdefault("path", plan.paths[0])
+            st = resolve_schema(ds_cls, opts, plan.schema)
+            out = tuple(pn.Field(f.name, f.data_type, f.nullable)
+                        for f in st.fields)
+            node = pn.ScanExec(out, (ds_cls, tuple(sorted(opts.items()))),
+                               (), "python_ds")
+            fields = [ScopeField(f.name, (), f.dtype, f.nullable)
+                      for f in out]
+            return node, Scope(fields, outer, {})
         schema = plan.schema or infer_schema(plan.format, plan.paths, dict(plan.options))
         out = tuple(pn.Field(f.name, f.data_type, f.nullable) for f in schema.fields)
         node = pn.ScanExec(out, None, tuple(plan.paths), plan.format,

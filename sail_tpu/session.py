@@ -57,6 +57,7 @@ class SparkSession:
         self._executor_cls = LocalExecutor
         self.catalog = Catalog(self)
         self.udf = self.catalog_manager.udfs
+        self.dataSource = _DataSourceRegistry(self.catalog_manager)
 
     # -- plan execution ----------------------------------------------------
     def _resolve(self, plan: sp.QueryPlan):
@@ -463,6 +464,22 @@ class SessionConf:
         merged = dict(self._DEFAULTS)
         merged.update(self._conf)
         return merged.items()
+
+
+class _DataSourceRegistry:
+    """spark.dataSource — user-defined Python data sources (reference:
+    sail-data-source formats/python; API mirrors pyspark.sql.datasource)."""
+
+    def __init__(self, catalog_manager):
+        self._cm = catalog_manager
+        if not hasattr(catalog_manager, "data_sources"):
+            catalog_manager.data_sources = {}
+
+    def register(self, cls, name: str = None) -> None:
+        self._cm.data_sources[(name or cls.name()).lower()] = cls
+
+    def get(self, name: str):
+        return self._cm.data_sources.get(name.lower())
 
 
 class Catalog:

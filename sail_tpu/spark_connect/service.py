@@ -228,6 +228,20 @@ class SparkConnectServer:
             cif = command.register_function
             session.udf.register(cif.function_name, udf_from_proto(cif))
             return
+        if which == "register_data_source":
+            # cloudpickled user DataSource class (reference:
+            # formats/python/mod.rs registration path)
+            import cloudpickle
+            from .wire_udf import _install_pyspark_shim
+            _install_pyspark_shim()
+            rds = command.register_data_source
+            obj = cloudpickle.loads(rds.python_data_source.command)
+            cls = obj if isinstance(obj, type) else next(
+                (x for x in obj if isinstance(x, type)), None)
+            if cls is None:
+                raise ValueError("data source payload contains no class")
+            session.dataSource.register(cls, name=rds.name or None)
+            return
         if which == "register_table_function":
             # cloudpickled UDTF handler class for SQL FROM-position use
             # (reference: plan_executor.rs register_user_defined_table_

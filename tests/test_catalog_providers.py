@@ -335,3 +335,211 @@ def test_metadata_location_pins_snapshot(rest_server, tmp_path, monkeypatch):
     got = spark.sql("SELECT v FROM prod.analytics.pinned").toPandas()
     assert "late" not in got.v.tolist()  # pinned at catalog-time snapshot
     assert len(got) == 3
+
+
+# ---------------------------------------------------------------------------
+# fake AWS Glue (x-amz-json-1.1 protocol; reference: sail-catalog-glue)
+# ---------------------------------------------------------------------------
+
+class _GlueState:
+    def __init__(self):
+        self.databases = {"sales": {"Description": "d"}}
+        self.tables = {}  # (db, name) -> Table dict
+        self.last_auth = None
+
+
+def _make_glue_handler(state):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            op = self.headers.get("X-Amz-Target", "").split(".")[-1]
+            state.last_auth = self.headers.get("Authorization", "")
+            code, payload = 200, {}
+            if op == "GetDatabases":
+                payload = {"DatabaseList": [
+                    {"Name": n_} for n_ in state.databases]}
+            elif op == "GetDatabase":
+                db = state.databases.get(body.get("Name"))
+                if db is None:
+                    code, payload = 400, {"__type": "EntityNotFoundException"}
+                else:
+                    payload = {"Database": {"Name": body["Name"], **db}}
+            elif op == "CreateDatabase":
+                d = body["DatabaseInput"]
+                state.databases[d["Name"]] = d
+            elif op == "DeleteDatabase":
+                state.databases.pop(body.get("Name"), None)
+            elif op == "GetTables":
+                payload = {"TableList": [
+                    t for (db, _), t in state.tables.items()
+                    if db == body.get("DatabaseName")]}
+            elif op == "GetTable":
+                t = state.tables.get((body.get("DatabaseName"),
+                                      body.get("Name")))
+                if t is None:
+                    code, payload = 400, {"__type": "EntityNotFoundException"}
+                else:
+                    payload = {"Table": t}
+            elif op == "CreateTable":
+                ti = body["TableInput"]
+                state.tables[(body["DatabaseName"], ti["Name"])] = ti
+            elif op == "DeleteTable":
+                state.tables.pop((body.get("DatabaseName"),
+                                  body.get("Name")), None)
+            else:
+                code, payload = 400, {"__type": "UnknownOperation"}
+            out = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/x-amz-json-1.1")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    return Handler
+
+
+@pytest.fixture()
+def glue_server():
+    state = _GlueState()
+    srv = HTTPServer(("127.0.0.1", 0), _make_glue_handler(state))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield state, f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_glue_catalog_crud(glue_server, tmp_path):
+    import pyarrow.parquet as pq
+
+    from sail_tpu.catalog.glue import GlueCatalog
+
+    state, endpoint = glue_server
+    pdir = str(tmp_path / "orders.parquet")
+    pq.write_table(pa.table({"id": [1, 2], "amt": [5.0, 6.0]}), pdir)
+    state.tables[("sales", "orders")] = {
+        "Name": "orders", "DatabaseName": "sales",
+        "StorageDescriptor": {
+            "Columns": [{"Name": "id", "Type": "bigint"},
+                        {"Name": "amt", "Type": "double"}],
+            "Location": pdir,
+            "InputFormat": "org.apache...MapredParquetInputFormat"},
+        "Parameters": {}}
+
+    cat = GlueCatalog("glue", endpoint=endpoint,
+                      access_key="AK", secret_key="SK")
+    assert cat.list_databases() == ["sales"]
+    assert cat.database_info("sales")["comment"] == "d"
+    assert cat.list_tables("sales") == ["orders"]
+    entry = cat.get_table("sales", "orders")
+    assert entry.format == "parquet"
+    assert [f.name for f in entry.schema.fields] == ["id", "amt"]
+    # requests are SigV4-signed
+    assert state.last_auth.startswith("AWS4-HMAC-SHA256 Credential=AK/")
+    assert "Signature=" in state.last_auth
+    # create/drop
+    from sail_tpu.catalog.manager import TableEntry
+    cat.create_table("sales", TableEntry(
+        name=("glue", "sales", "t2"),
+        schema=dt.StructType((dt.StructField("x", dt.IntegerType(), True),)),
+        paths=("/tmp/t2",), format="parquet"))
+    assert "t2" in cat.list_tables("sales")
+    cat.drop_table("sales", "t2")
+    assert cat.get_table("sales", "nope") is None
+
+
+def test_glue_select_through_session(glue_server, tmp_path, monkeypatch):
+    import pyarrow.parquet as pq
+
+    state, endpoint = glue_server
+    pdir = str(tmp_path / "g.parquet")
+    pq.write_table(pa.table({"v": [2.0, 3.0]}), pdir)
+    state.tables[("sales", "g")] = {
+        "Name": "g", "DatabaseName": "sales",
+        "StorageDescriptor": {"Columns": [{"Name": "v", "Type": "double"}],
+                              "Location": pdir},
+        "Parameters": {}}
+    monkeypatch.setenv("SAIL_CATALOG__LIST", "aws")
+    monkeypatch.setenv("SAIL_CATALOG__AWS__TYPE", "glue")
+    monkeypatch.setenv("SAIL_CATALOG__AWS__ENDPOINT", endpoint)
+    monkeypatch.setenv("SAIL_CATALOG__AWS__ACCESS_KEY", "AK")
+    monkeypatch.setenv("SAIL_CATALOG__AWS__SECRET_KEY", "SK")
+    spark = SparkSession({})
+    got = spark.sql("SELECT SUM(v) FROM aws.sales.g").toPandas()
+    assert got.iloc[0, 0] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# fake Unity Catalog (REST /api/2.1/unity-catalog)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def unity_server(tmp_path):
+    import pyarrow.parquet as pq
+
+    pdir = str(tmp_path / "uc.parquet")
+    pq.write_table(pa.table({"n": [1, 2, 3]}), pdir)
+    tables = {
+        "main.analytics.events": {
+            "name": "events", "catalog_name": "main",
+            "schema_name": "analytics", "table_type": "EXTERNAL",
+            "data_source_format": "PARQUET",
+            "storage_location": pdir,
+            "columns": [{"name": "n", "type_text": "bigint",
+                         "nullable": True}],
+        }}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/api/2.1/unity-catalog/schemas":
+                payload = {"schemas": [{"name": "analytics",
+                                        "catalog_name": "main"}]}
+            elif path == "/api/2.1/unity-catalog/tables":
+                payload = {"tables": list(tables.values())}
+            elif path.startswith("/api/2.1/unity-catalog/tables/"):
+                full = path.rsplit("/", 1)[-1]
+                t = tables.get(full)
+                if t is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                payload = t
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            out = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_unity_catalog_read(unity_server, monkeypatch):
+    from sail_tpu.catalog.unity import UnityCatalog
+
+    cat = UnityCatalog("uc", unity_server, "main")
+    assert cat.list_databases() == ["analytics"]
+    assert cat.list_tables("analytics") == ["events"]
+    entry = cat.get_table("analytics", "events")
+    assert entry.format == "parquet"
+    assert [f.name for f in entry.schema.fields] == ["n"]
+    monkeypatch.setenv("SAIL_CATALOG__LIST", "uc")
+    monkeypatch.setenv("SAIL_CATALOG__UC__TYPE", "unity")
+    monkeypatch.setenv("SAIL_CATALOG__UC__URI", unity_server)
+    monkeypatch.setenv("SAIL_CATALOG__UC__CATALOG_NAME", "main")
+    spark = SparkSession({})
+    got = spark.sql("SELECT SUM(n) FROM uc.analytics.events").toPandas()
+    assert got.iloc[0, 0] == 6

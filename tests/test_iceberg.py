@@ -264,3 +264,97 @@ def test_sql_delete_on_iceberg_table(tmp_path, spark):
     # merge-on-read: the data files are untouched, a delete file exists
     t = IcebergTable(path)
     assert len(t.delete_files(t.snapshot())) == 1
+
+
+# ---------------------------------------------------------------------------
+# schema evolution (reference: crates/sail-iceberg/src/schema_evolution.rs)
+# ---------------------------------------------------------------------------
+
+def test_schema_evolution_add_column(tmp_path):
+    from sail_tpu.spec import data_type as dt
+    path = str(tmp_path / "ice_add")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2], "v": ["a", "b"]}))
+    t.add_column("score", dt.DoubleType())
+    # old files null-fill the new column
+    out = t.to_arrow()
+    assert out.column_names == ["k", "v", "score"]
+    assert out.column("score").to_pylist() == [None, None]
+    # new writes carry it
+    t.append(pa.table({"k": [3], "v": ["c"], "score": [9.5]}))
+    out = t.to_arrow()
+    by_k = dict(zip(out.column("k").to_pylist(),
+                    out.column("score").to_pylist()))
+    assert by_k == {1: None, 2: None, 3: 9.5}
+
+
+def test_schema_evolution_rename_column(tmp_path):
+    path = str(tmp_path / "ice_ren")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2], "v": ["a", "b"]}))
+    t.rename_column("v", "label")
+    out = t.to_arrow()
+    # the field id resolves the OLD file's 'v' column under its new name
+    assert out.column_names == ["k", "label"]
+    assert out.column("label").to_pylist() == ["a", "b"]
+    t.append(pa.table({"k": [3], "label": ["c"]}))
+    out = t.to_arrow()
+    assert sorted(out.column("label").to_pylist()) == ["a", "b", "c"]
+
+
+def test_schema_evolution_drop_column(tmp_path):
+    path = str(tmp_path / "ice_drop")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1], "v": ["a"], "extra": [99]}))
+    t.drop_column("extra")
+    out = t.to_arrow()
+    assert out.column_names == ["k", "v"]
+
+
+def test_schema_evolution_through_session(tmp_path, spark):
+    from sail_tpu.spec import data_type as dt
+    path = str(tmp_path / "ice_sess_evo")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2], "v": [10.0, 20.0]}))
+    t.rename_column("v", "amount")
+    t.add_column("tag", dt.StringType())
+    spark.sql(f"CREATE TABLE evo USING iceberg LOCATION '{path}'")
+    got = spark.sql(
+        "SELECT SUM(amount), COUNT(tag) FROM evo").toPandas()
+    assert got.iloc[0, 0] == 30.0
+    assert got.iloc[0, 1] == 0
+
+
+def test_evolution_dropped_name_reuse_is_not_resurrected(tmp_path):
+    """drop b, rename a→b: the old file's 'b' column belonged to the
+    DROPPED field id and must not leak into the renamed column."""
+    path = str(tmp_path / "ice_reuse")
+    t = IcebergTable(path)
+    t.create(pa.table({"a": [1, 2], "b": [100, 200]}))
+    t.drop_column("b")
+    t.rename_column("a", "b")
+    out = t.to_arrow()
+    assert out.column_names == ["b"]
+    assert out.column("b").to_pylist() == [1, 2]  # field id of 'a'
+
+
+def test_evolution_add_after_drop_nulls(tmp_path):
+    path = str(tmp_path / "ice_readd")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1], "x": [42]}))
+    t.drop_column("x")
+    t.add_column("x", __import__("sail_tpu.spec.data_type",
+                                 fromlist=["LongType"]).LongType())
+    out = t.to_arrow()
+    assert out.column("x").to_pylist() == [None]  # NOT the old 42
+
+
+def test_sql_delete_after_rename(tmp_path, spark):
+    path = str(tmp_path / "ice_del_evo")
+    t = IcebergTable(path)
+    t.create(pa.table({"k": [1, 2, 3], "v": [10.0, 20.0, 30.0]}))
+    t.rename_column("v", "amount")
+    spark.sql(f"CREATE TABLE devo USING iceberg LOCATION '{path}'")
+    spark.sql("DELETE FROM devo WHERE amount > 15")
+    got = spark.sql("SELECT amount FROM devo ORDER BY k").toPandas()
+    assert got.amount.tolist() == [10.0]
