@@ -1529,6 +1529,9 @@ class LocalExecutor:
         return builder
 
     def _join(self, p: pn.JoinExec, left: HostBatch, right: HostBatch) -> HostBatch:
+        spilled = self._try_partitioned_join(p, left, right)
+        if spilled is not None:
+            return spilled
         jt = p.join_type
         schema_key = (tuple((f.name, f.dtype) for f in p.left.schema),
                       tuple((f.name, f.dtype) for f in p.right.schema))
@@ -1591,6 +1594,142 @@ class LocalExecutor:
         return self._join_expand(p, left, right, bt, ranges, build_payload,
                                  build_names, merged_dicts,
                                  inner_total=int(inner_total))
+
+    def _try_partitioned_join(self, p: pn.JoinExec, left: HostBatch,
+                              right: HostBatch) -> Optional[HostBatch]:
+        """Out-of-core partitioned equi-join (reference role: DataFusion's
+        spilling hash join via memory pools + temp files, application.yaml
+        runtime.* — SURVEY.md §5 long-context analogue).
+
+        When the inputs exceed ``execution.join_spill_rows``, both sides
+        hash-partition on the join keys into temp parquet files; each
+        partition pair joins independently (equal keys land in the same
+        partition, so inner/left/full/semi/anti are all partition-wise
+        exact), bounding the join step's peak memory to one pair plus its
+        expansion. NULL keys hash to one partition, preserving outer/anti
+        semantics."""
+        from ..config import get as config_get
+
+        try:
+            threshold = int(config_get("execution.join_spill_rows",
+                                       8_000_000))
+        except (TypeError, ValueError):
+            threshold = 8_000_000
+        if threshold <= 0 or not p.left_keys:
+            return None
+        if p.join_type not in ("inner", "left", "full", "semi", "anti"):
+            return None
+        if p.null_aware:
+            return None
+        if getattr(self, "_in_join_spill", False):
+            return None  # partition pairs run the in-memory join
+        import jax
+        n_left, n_right = jax.device_get(  # ONE round trip, not two
+            (jnp.sum(left.device.sel), jnp.sum(right.device.sel)))
+        n_left, n_right = int(n_left), int(n_right)
+        if n_left + n_right <= threshold:
+            return None
+
+        import tempfile
+
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        import pyarrow.parquet as pq
+
+        nparts = max(2, min(64, (n_left + n_right) // max(threshold // 2, 1)
+                            + 1))
+        lt = ai.to_arrow(left).rename_columns(
+            [f.name for f in p.left.schema])
+        rt = ai.to_arrow(right).rename_columns(
+            [f.name for f in p.right.schema])
+
+        def key_hash(table, keys):
+            """Partition ids from key VALUES (stable across both sides —
+            dictionary codes are not). Simple column refs only; anything
+            fancier declines the spill path."""
+            import pandas as pd
+
+            idx = []
+            for k in keys:
+                if isinstance(k, rx.BoundRef):
+                    idx.append(k.index)
+                else:
+                    return None
+            h = None
+            for i in idx:
+                col = table.column(i).combine_chunks()
+                if pa.types.is_floating(col.type) or \
+                        pa.types.is_integer(col.type) or \
+                        pa.types.is_boolean(col.type):
+                    # canonical float64: a NULLABLE int side otherwise
+                    # hashes as float-with-NaN while the other side
+                    # hashes as int — same value, different partition.
+                    # Spark join equality: -0.0 == 0.0 (+ 0.0 normalizes
+                    # the sign) and NaN == NaN (one canonical payload) —
+                    # mirrors ops/hash.py _normalize_float.
+                    vals = col.to_numpy(zero_copy_only=False) \
+                        .astype(np.float64) + 0.0
+                    vals[np.isnan(vals)] = np.nan
+                else:
+                    # strings/dates/decimals: canonical string form;
+                    # anything uncastable declines the spill path
+                    try:
+                        vals = pc.cast(col, pa.string()).to_numpy(
+                            zero_copy_only=False)
+                    except Exception:  # noqa: BLE001
+                        return None
+                part = pd.util.hash_array(vals, categorize=False) \
+                    .astype(np.uint64)
+                h = part if h is None else (h * np.uint64(31) + part)
+            return (h % np.uint64(nparts)).astype(np.int64)
+
+        lh = key_hash(lt, p.left_keys)
+        rh = key_hash(rt, p.right_keys)
+        if lh is None or rh is None:
+            return None
+
+        tmpdir = tempfile.mkdtemp(prefix="sail_join_spill_")
+        self._last_join_spill_dir = tmpdir  # observable in tests
+        sides = []
+        for name, table, h in (("l", lt, lh), ("r", rt, rh)):
+            paths = []
+            for part in range(nparts):
+                mask = h == part
+                sub = table.filter(pa.array(mask))
+                fp = os.path.join(tmpdir, f"{name}{part}.parquet")
+                pq.write_table(sub, fp)
+                paths.append(fp)
+            sides.append(paths)
+        del lt, rt
+
+        outs = []
+        self._in_join_spill = True
+        try:
+            for part in range(nparts):
+                lsub = pq.read_table(sides[0][part])
+                rsub = pq.read_table(sides[1][part])
+                if p.join_type in ("inner", "semi") and \
+                        (lsub.num_rows == 0 or rsub.num_rows == 0):
+                    continue
+                if p.join_type in ("left", "full", "anti") and \
+                        lsub.num_rows == 0 and rsub.num_rows == 0:
+                    continue
+                lhb = _positional(ai.from_arrow(lsub))
+                rhb = _positional(ai.from_arrow(rsub))
+                sub_out = self._join(p, lhb, rhb)
+                outs.append(ai.to_arrow(sub_out))
+        finally:
+            self._in_join_spill = False
+            import shutil
+            shutil.rmtree(tmpdir, ignore_errors=True)
+        if not outs:
+            schema = p.schema
+            empty = pa.table({f"c{i}": pa.array(
+                [], type=ai.spec_type_to_arrow(f.dtype))
+                for i, f in enumerate(schema)})
+            return _positional(ai.from_arrow(empty))
+        merged = pa.concat_tables(outs, promote_options="permissive")
+        return _positional(ai.from_arrow(merged))
 
     def _join_expand(self, p: pn.JoinExec, left: HostBatch, right: HostBatch,
                      bt, ranges, build_payload, build_names, merged_dicts,

@@ -228,8 +228,10 @@ class StreamingQuery:
             bound = _substitute_source(self._plan, self._source_name,
                                        sp.LocalRelation(batch))
             result = self._session._execute_query(bound)
-        if result is not None:
+        if result is not None and not self._already_committed(
+                self._batch_id):
             self._sink(self._batch_id, result)
+            self._mark_committed(self._batch_id)
         if self._checkpoint_dir:
             self._write_checkpoint()
         self.recent_progress.append({
@@ -276,6 +278,46 @@ class StreamingQuery:
             return result.slice(0, 0)
         import pyarrow as _pa
         return _pa.Table.from_pylist(changed, schema=result.schema)
+
+    # -- sink commit log (exactly-once) ---------------------------------
+    # The sink write happens BEFORE the offsets checkpoint, so a crash
+    # between them replays the batch on restart. The commit marker
+    # (atomic create-if-absent, Spark's commits/ layout) makes the replay
+    # skip the duplicate write: at-least-once processing + idempotent
+    # commit = exactly-once sink output for deterministic sources.
+    def _commit_marker(self, batch_id: int) -> Optional[str]:
+        if not self._checkpoint_dir:
+            return None
+        import os as _os
+        return _os.path.join(self._checkpoint_dir, "commits",
+                             str(batch_id))
+
+    def _already_committed(self, batch_id: int) -> bool:
+        import os as _os
+        marker = self._commit_marker(batch_id)
+        return marker is not None and _os.path.exists(marker)
+
+    def _mark_committed(self, batch_id: int):
+        marker = self._commit_marker(batch_id)
+        if marker is None:
+            return
+        import os as _os
+        _os.makedirs(_os.path.dirname(marker), exist_ok=True)
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("{}")
+        _os.replace(tmp, marker)
+        # retention: only markers >= the last checkpointed batch id can
+        # ever be consulted on restart; prune far-older ones so a
+        # long-running query doesn't grow one file per trigger forever
+        if batch_id % 100 == 0:
+            commits_dir = _os.path.dirname(marker)
+            for name in _os.listdir(commits_dir):
+                try:
+                    if int(name) < batch_id - 100:
+                        _os.unlink(_os.path.join(commits_dir, name))
+                except (ValueError, OSError):
+                    continue
 
     # -- durable checkpoints --------------------------------------------
     def _write_checkpoint(self):
@@ -421,7 +463,9 @@ class DataStreamWriter:
         self._foreach_batch = fn
         return self
 
-    def start(self) -> StreamingQuery:
+    def start(self, path: Optional[str] = None) -> StreamingQuery:
+        if path is not None:
+            self._options["path"] = str(path)
         session = self._df._session
         plan = self._df._plan
         src_node = _find_stream_read(plan)
@@ -466,6 +510,46 @@ class DataStreamWriter:
             return sink
         if self._format == "noop":
             return lambda batch_id, table: None
+        if self._format in ("parquet", "csv", "json"):
+            # file sink: one part file per micro-batch. Exactly-once
+            # comes from the COMMIT LOG in StreamingQuery._process —
+            # replayed batches whose commit marker exists skip the write
+            # (reference: the reference's checkpointed sink epochs,
+            # SURVEY.md §5 checkpoint/resume)
+            import os as _os
+            import uuid as _uuid
+
+            out_dir = self._options.get("path")
+            if not out_dir:
+                raise ValueError("file sinks require a path")
+            fmt = self._format
+
+            def sink(batch_id, table):
+                if table.num_rows == 0:
+                    return
+                _os.makedirs(out_dir, exist_ok=True)
+                ext = {"parquet": "parquet", "csv": "csv",
+                       "json": "json"}[fmt]
+                # DETERMINISTIC per-batch name: a replay after a crash
+                # between the rename and the commit marker overwrites the
+                # same file instead of duplicating the batch
+                name = f"part-{batch_id:05d}.{ext}"
+                tmp = _os.path.join(out_dir,
+                                    f".{name}.{_uuid.uuid4().hex}.tmp")
+                if fmt == "parquet":
+                    import pyarrow.parquet as _pq
+                    _pq.write_table(table, tmp)
+                elif fmt == "csv":
+                    import pyarrow.csv as _pacsv
+                    _pacsv.write_csv(table, tmp)
+                else:
+                    import json as _json
+                    with open(tmp, "w") as f:
+                        for row in table.to_pylist():
+                            f.write(_json.dumps(row, default=str) + "\n")
+                _os.replace(tmp, _os.path.join(out_dir, name))
+
+            return sink
         raise ValueError(f"unsupported stream sink {self._format!r}")
 
 
