@@ -192,6 +192,15 @@ def configure_catalogs(manager: CatalogManager) -> None:
                     catalog_name=str(config_get(
                         f"catalog.{key}.catalog_name", "main")),
                     token=config_get(f"catalog.{key}.token"))
+            elif ctype == "onelake":
+                from .onelake import OneLakeCatalog
+                provider = OneLakeCatalog(
+                    nm,
+                    workspace=str(config_get(
+                        f"catalog.{key}.workspace", "")),
+                    api=str(config_get(f"catalog.{key}.api", "delta")),
+                    token=config_get(f"catalog.{key}.token"),
+                    endpoint=config_get(f"catalog.{key}.endpoint"))
             elif ctype == "memory":
                 from .provider import MemoryCatalogProvider
                 provider = MemoryCatalogProvider(nm)

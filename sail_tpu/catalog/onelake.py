@@ -1,0 +1,74 @@
+"""Microsoft Fabric OneLake catalog provider.
+
+Reference role: crates/sail-catalog-onelake/src/provider.rs — OneLake
+exposes its table metadata through two standard protocol endpoints
+(``onelake.table.fabric.microsoft.com/delta`` speaks the Unity Catalog
+REST API, ``.../iceberg`` speaks the Iceberg REST catalog API), so the
+provider is a thin delegate over the existing Unity / Iceberg REST
+clients pointed at the Fabric endpoint, with the workspace as the
+catalog/warehouse scope. The ``endpoint`` option overrides the Fabric
+URL, which is how the in-repo fake-server tests drive it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .manager import TableEntry
+from .provider import CatalogError, CatalogProvider
+
+ONELAKE_DELTA_ENDPOINT = "https://onelake.table.fabric.microsoft.com/delta"
+ONELAKE_ICEBERG_ENDPOINT = \
+    "https://onelake.table.fabric.microsoft.com/iceberg"
+
+
+class OneLakeCatalog(CatalogProvider):
+    """api="delta" (default) delegates to the Unity REST client;
+    api="iceberg" delegates to the Iceberg REST client."""
+
+    def __init__(self, name: str, workspace: str,
+                 api: str = "delta", token: Optional[str] = None,
+                 endpoint: Optional[str] = None, timeout: float = 30.0):
+        self.name = name
+        self.workspace = workspace
+        self.api = api.lower()
+        if self.api == "iceberg":
+            from .iceberg_rest import IcebergRestCatalog
+            self._inner: CatalogProvider = IcebergRestCatalog(
+                name, uri=endpoint or ONELAKE_ICEBERG_ENDPOINT,
+                warehouse=workspace, token=token, timeout=timeout)
+        elif self.api == "delta":
+            from .unity import UnityCatalog
+            self._inner = UnityCatalog(
+                name, uri=endpoint or ONELAKE_DELTA_ENDPOINT,
+                catalog_name=workspace, token=token, timeout=timeout)
+        else:
+            raise CatalogError(
+                f"onelake api must be delta or iceberg, got {api!r}")
+
+    # -- delegation ------------------------------------------------------
+    def list_databases(self) -> List[str]:
+        return self._inner.list_databases()
+
+    def database_info(self, name: str) -> Optional[dict]:
+        return self._inner.database_info(name)
+
+    def create_database(self, name, if_not_exists=False, comment=None,
+                        location=None):
+        raise CatalogError("onelake catalog is read-only in this engine")
+
+    def drop_database(self, name, if_exists=False, cascade=False):
+        raise CatalogError("onelake catalog is read-only in this engine")
+
+    def list_tables(self, database: str) -> List[str]:
+        return self._inner.list_tables(database)
+
+    def get_table(self, database: str, table: str) -> Optional[TableEntry]:
+        return self._inner.get_table(database, table)
+
+    def create_table(self, database, entry, replace=False,
+                     if_not_exists=False):
+        raise CatalogError("onelake catalog is read-only in this engine")
+
+    def drop_table(self, database, table, if_exists=False):
+        raise CatalogError("onelake catalog is read-only in this engine")

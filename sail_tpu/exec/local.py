@@ -958,14 +958,20 @@ class LocalExecutor:
 
     def _exec_SortExec(self, p: pn.SortExec) -> HostBatch:
         child = self.run(p.input)
+        spilled = self._try_external_sort(p, child)
+        if spilled is not None:
+            return spilled
 
         def builder():
             comp = self._compiler(child, p.input.schema)
             compiled = [(comp.compile(k.expr), k) for k in p.keys]
             rank_luts = []
             for c, k in compiled:
+                # an empty dictionary (0-row input) has no codes to remap —
+                # and a 0-size LUT gather is a compile error
                 rank_luts.append(jnp.asarray(ai.dictionary_ranks(c.dictionary))
-                                 if c.dictionary is not None else None)
+                                 if c.dictionary is not None
+                                 and len(c.dictionary) > 0 else None)
 
             def fn(cols, sel, datas, validities):
                 keys = []
@@ -1730,6 +1736,120 @@ class LocalExecutor:
             return _positional(ai.from_arrow(empty))
         merged = pa.concat_tables(outs, promote_options="permissive")
         return _positional(ai.from_arrow(merged))
+
+    def _try_external_sort(self, p: pn.SortExec,
+                           child: HostBatch) -> Optional[HostBatch]:
+        """Out-of-core external sort (reference role: DataFusion's spilling
+        ExternalSorter via memory pools + temp files — SURVEY.md §5
+        out-of-core).
+
+        When the input's live rows exceed ``execution.sort_spill_rows``,
+        the wide rows spill to memory-mapped Arrow IPC runs while the
+        global permutation is computed on the host from the key columns
+        alone (a small fraction of the row width). The output gathers
+        straight from the memory maps, so the O(n) sort workspace — the
+        permuted column copies a device lexsort would materialize — never
+        touches device HBM. Spark ordering semantics: nulls_first/last per
+        key, NaN sorts greater than any non-null value (after +Inf)."""
+        from ..config import get as config_get
+
+        try:
+            threshold = int(config_get("execution.sort_spill_rows",
+                                       8_000_000))
+        except (TypeError, ValueError):
+            threshold = 8_000_000
+        if threshold <= 0 or not p.keys:
+            return None
+        for k in p.keys:
+            if not isinstance(k.expr, rx.BoundRef):
+                return None  # expression keys stay on the in-memory path
+        import jax
+        n = int(jax.device_get(jnp.sum(child.device.sel)))
+        if n <= threshold:
+            return None
+
+        import shutil
+        import tempfile
+
+        import pandas as pd
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        import pyarrow.ipc as ipc
+
+        table = ai.to_arrow(child)
+
+        # -- sort-key frame (host memory; declines on exotic key types) --
+        frame: Dict[str, object] = {}
+        by: List[str] = []
+        asc: List[bool] = []
+        for i, k in enumerate(p.keys):
+            col = table.column(k.expr.index).combine_chunks()
+            if pa.types.is_dictionary(col.type):
+                col = col.cast(col.type.value_type)
+            t = col.type
+            if not (pa.types.is_integer(t) or pa.types.is_floating(t)
+                    or pa.types.is_boolean(t) or pa.types.is_string(t)
+                    or pa.types.is_large_string(t) or pa.types.is_binary(t)
+                    or pa.types.is_decimal(t) or pa.types.is_temporal(t)):
+                return None
+            null_mask = col.is_null().to_numpy(zero_copy_only=False)
+            # nulls_first/last is independent of the key direction: the
+            # null rank column always sorts ascending. Unset → Spark
+            # default (ASC: NULLS FIRST, DESC: NULLS LAST).
+            nulls_first = (k.nulls_first if k.nulls_first is not None
+                           else k.ascending)
+            frame[f"n{i}"] = ~null_mask if nulls_first else null_mask
+            by.append(f"n{i}")
+            asc.append(True)
+            if pa.types.is_floating(t):
+                # NaN (non-null) outranks every value including +Inf; the
+                # rank column isolates it so the filled 0.0 can't leak in
+                vals = col.to_numpy(zero_copy_only=False).astype(
+                    np.float64, copy=True)
+                nan_mask = np.isnan(vals) & ~null_mask
+                frame[f"f{i}"] = nan_mask
+                by.append(f"f{i}")
+                asc.append(k.ascending)
+                vals[np.isnan(vals)] = 0.0
+                frame[f"k{i}"] = vals
+            else:
+                if null_mask.any():
+                    non_null = col.drop_null()
+                    if len(non_null) == 0:
+                        continue  # all null: the null rank decides alone
+                    col = pc.fill_null(col, non_null[0])
+                frame[f"k{i}"] = col.to_pandas()
+            by.append(f"k{i}")
+            asc.append(k.ascending)
+
+        tmpdir = tempfile.mkdtemp(prefix="sail_sort_spill_")
+        self._last_sort_spill_dir = tmpdir  # observable in tests
+        try:
+            # -- spill the wide rows to memory-mappable runs --
+            run_rows = max(1, threshold // 2)
+            paths = []
+            for start in range(0, n, run_rows):
+                fp = os.path.join(tmpdir, f"run{len(paths)}.arrow")
+                with pa.OSFile(fp, "wb") as f, \
+                        ipc.new_file(f, table.schema) as writer:
+                    writer.write_table(table.slice(start, run_rows))
+                paths.append(fp)
+            del table
+
+            perm = pd.DataFrame(frame).sort_values(
+                by, ascending=asc, kind="stable").index.to_numpy()
+            if p.limit is not None:
+                perm = perm[:p.limit]
+
+            # -- gather output rows straight off the memory maps --
+            runs = [ipc.open_file(pa.memory_map(fp, "r")).read_all()
+                    for fp in paths]
+            out = pa.concat_tables(runs).take(
+                pa.array(perm, type=pa.int64()))
+            out = out.combine_chunks()  # own the buffers before cleanup
+            return _positional(ai.from_arrow(out))
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
 
     def _join_expand(self, p: pn.JoinExec, left: HostBatch, right: HostBatch,
                      bt, ranges, build_payload, build_names, merged_dicts,

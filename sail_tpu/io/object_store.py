@@ -47,7 +47,8 @@ def resolve_filesystem(path: str, options: Optional[Dict[str, str]] = None):
 
     cache_key = (scheme, authority,
                  tuple(sorted((k, v) for k, v in options.items()
-                              if k.startswith(("fs.", "gcs.", "azure.")))))
+                              if k.startswith(("fs.", "gcs.", "azure.",
+                                               "hf.")))))
     fsys = _FS_CACHE.get(cache_key)
     if fsys is None:
         if scheme in ("s3", "s3a", "s3n"):
@@ -79,6 +80,13 @@ def resolve_filesystem(path: str, options: Optional[Dict[str, str]] = None):
                 authority.split("@")[-1].split(".")[0])
         elif scheme == "hdfs":
             fsys = pafs.HadoopFileSystem.from_uri(path)
+        elif scheme == "hf":
+            # Hugging Face datasets/models (hf://datasets/org/name/file)
+            # — ref crates/sail-object-store's hf store, here over the
+            # official fsspec filesystem wrapped for pyarrow
+            from huggingface_hub import HfFileSystem
+            fsys = pafs.PyFileSystem(pafs.FSSpecHandler(
+                HfFileSystem(token=opt("hf.token"))))
         elif scheme == "mock":
             # in-process filesystem for tests
             fsys = _mock_fs()

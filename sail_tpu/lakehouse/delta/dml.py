@@ -35,7 +35,8 @@ def _read_file_with_partitions(dt_table: DeltaTable, snap, add) -> pa.Table:
     import pyarrow.parquet as pq
     from ...columnar.arrow_interop import spec_type_to_arrow
 
-    t = pq.read_table(os.path.join(dt_table.path, add.path))
+    t = snap.rename_to_logical(
+        pq.read_table(os.path.join(dt_table.path, add.path)))
     dv = add.dv()
     if dv is not None and dv.cardinality:
         deleted = dv.row_indices()
@@ -46,7 +47,7 @@ def _read_file_with_partitions(dt_table: DeltaTable, snap, add) -> pa.Table:
     for c in snap.metadata.partition_columns:
         f = snap.schema.field(c)
         at = spec_type_to_arrow(f.data_type)
-        raw = pv.get(c)
+        raw = snap.partition_raw(pv, c)
         val = None if raw is None else _parse_partition_value(raw, at)
         t = t.append_column(c, pa.array([val] * t.num_rows, type=at))
     # column order per declared schema
@@ -71,6 +72,19 @@ class DeltaDml:
     def _dv_enabled(self) -> bool:
         conf = dict(self.snap.metadata.configuration)
         return conf.get("delta.enableDeletionVectors", "").lower() == "true"
+
+    def _regen(self, table: pa.Table) -> pa.Table:
+        """Recompute every generated column from its expression — rows an
+        UPDATE/MERGE changed must keep the generation invariant, and
+        recomputation is idempotent for untouched rows."""
+        gen = [c for c in self.snap.generation_expressions
+               if c in table.column_names]
+        if not gen or not table.num_rows:
+            return table
+        order = table.column_names
+        out = self.table._compute_generated(
+            table.drop_columns(gen), self.snap, session=self.session)
+        return out.select(order)
 
     def _target_with_meta(self):
         """(per-file tables, concatenated table + __fid__/__rid__ meta
@@ -135,12 +149,13 @@ class DeltaDml:
                     upd_here = updates.filter(pa.array(in_file)) \
                         .drop_columns(["__rid__"])
                     parts.append(upd_here.cast(target_schema, safe=False))
-            new_table = pa.concat_tables(parts)
+            new_table = self._regen(pa.concat_tables(parts))
             tx.read_files.add(add.path)
             tx.remove_file(RemoveFile(add.path, now))
             if new_table.num_rows:
                 for new_add in self.table._write_data_files(
-                        new_table, part_cols):
+                        new_table, part_cols,
+                        self.table._mapping(self.snap)):
                     tx.add_file(new_add)
 
     # -- DELETE ----------------------------------------------------------
@@ -197,12 +212,13 @@ class DeltaDml:
                                           (f.name,)))
                 else:
                     exprs.append(ex.Alias(col, (f.name,)))
-            rewritten = self._run(
-                sp.Project(sp.LocalRelation(t), tuple(exprs)))
+            rewritten = self._regen(self._run(
+                sp.Project(sp.LocalRelation(t), tuple(exprs))))
             tx.read_files.add(add.path)
             tx.remove_file(RemoveFile(add.path, now))
             for new_add in self.table._write_data_files(
-                    rewritten, part_cols):
+                    rewritten, part_cols,
+                    self.table._mapping(self.snap)):
                 tx.add_file(new_add)
             updated += nhit
         if updated:
@@ -326,9 +342,18 @@ class DeltaDml:
             srids = np.asarray(rows.column("__srid__"), dtype=np.int64)
             fresh = ~claimed_src[srids]
             claimed_src[srids[fresh]] = True
-            insert_tables.append(
-                rows.filter(pa.array(fresh)).drop_columns(["__srid__"])
-                .cast(target_schema, safe=False))
+            ins = rows.filter(pa.array(fresh)).drop_columns(["__srid__"])
+            # generated columns the clause did not assign must be
+            # computed, not inserted as NULL (same path as append)
+            gen = self.snap.generation_expressions
+            unassigned = [c for c in col_names
+                          if c in gen and c.lower() not in assigns]
+            if unassigned and ins.num_rows:
+                ins = self.table._compute_generated(
+                    ins.drop_columns(unassigned), self.snap,
+                    session=self.session)
+                ins = ins.select(list(col_names))
+            insert_tables.append(ins.cast(target_schema, safe=False))
 
         # not matched by source → update/delete target rows with no match
         if cmd.not_matched_by_source_actions:
@@ -389,7 +414,8 @@ class DeltaDml:
                               offsets)
         if n_inserts:
             for add in self.table._write_data_files(
-                    inserts, list(self.snap.metadata.partition_columns)):
+                    inserts, list(self.snap.metadata.partition_columns),
+                    self.table._mapping(self.snap)):
                 tx.add_file(add)
         tx.commit()
         return _merge_metrics(n_updates, int(deletes.size), n_inserts)

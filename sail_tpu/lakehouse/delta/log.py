@@ -112,6 +112,69 @@ class Snapshot:
         from ...spec.schema_json import schema_from_json
         return schema_from_json(json.loads(self.metadata.schema_string))
 
+    def _raw_fields(self) -> List[dict]:
+        """Parsed top-level schema fields, cached — DML loops call the
+        mapping properties once per data file."""
+        cached = self.__dict__.get("_raw_fields_cache")
+        if cached is None:
+            cached = [] if self.metadata is None else \
+                json.loads(self.metadata.schema_string).get("fields", [])
+            self.__dict__["_raw_fields_cache"] = cached
+        return cached
+
+    @property
+    def column_mapping_mode(self) -> str:
+        """delta.columnMapping.mode: none | name | id. Both non-none modes
+        store data under per-field physical names; "id" additionally pins
+        parquet field ids (we resolve by physical name, which the protocol
+        guarantees is also present in id mode)."""
+        conf = dict(self.metadata.configuration) if self.metadata else {}
+        return conf.get("delta.columnMapping.mode", "none")
+
+    @property
+    def physical_names(self) -> Dict[str, str]:
+        """Top-level logical field name -> physical parquet column name
+        (identity map when column mapping is off)."""
+        cached = self.__dict__.get("_physical_names_cache")
+        if cached is None:
+            mapped = self.column_mapping_mode != "none"
+            cached = {}
+            for f in self._raw_fields():
+                meta = f.get("metadata") or {}
+                phys = meta.get("delta.columnMapping.physicalName") \
+                    if mapped else None
+                cached[f["name"]] = phys or f["name"]
+            self.__dict__["_physical_names_cache"] = cached
+        return cached
+
+    def rename_to_logical(self, table):
+        """Physical parquet column names -> logical schema names."""
+        inv = {p: l for l, p in self.physical_names.items()}
+        return table.rename_columns(
+            [inv.get(n, n) for n in table.column_names])
+
+    def partition_raw(self, pv: Dict[str, str], col: str):
+        """partitionValues lookup: keys are physical under column
+        mapping, logical otherwise (foreign writers vary)."""
+        return pv.get(self.physical_names.get(col, col), pv.get(col))
+
+    @property
+    def generation_expressions(self) -> Dict[str, str]:
+        """Logical column -> SQL generation expression
+        (delta.generationExpression field metadata; the writer computes
+        missing generated columns from it — ref
+        crates/sail-delta-lake/src/table/features.rs GeneratedColumns)."""
+        cached = self.__dict__.get("_generation_cache")
+        if cached is None:
+            cached = {}
+            for f in self._raw_fields():
+                meta = f.get("metadata") or {}
+                expr = meta.get("delta.generationExpression")
+                if expr:
+                    cached[f["name"]] = expr
+            self.__dict__["_generation_cache"] = cached
+        return cached
+
 
 _MAP_FIELDS = ("partitionValues", "configuration", "options")
 

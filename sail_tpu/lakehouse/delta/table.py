@@ -72,14 +72,19 @@ class DeltaTable:
 
         snap = self.snapshot(version, timestamp_ms)
         schema = snap.schema
+        # column-mapping mode: data files carry physical names, and the
+        # partitionValues keys of add actions are physical; the metadata's
+        # partitionColumns list stays logical (Delta PROTOCOL.md)
+        pmap = snap.physical_names          # logical -> physical
         part_cols = list(snap.metadata.partition_columns)
         tables = []
         for add in snap.files.values():
             fpath = os.path.join(self.path, add.path)
             want = None
             if columns is not None:
-                want = [c for c in columns if c not in part_cols]
-            t = pq.read_table(fpath, columns=want)
+                want = [pmap.get(c, c) for c in columns
+                        if c not in part_cols]
+            t = snap.rename_to_logical(pq.read_table(fpath, columns=want))
             dv = add.dv()
             if dv is not None and dv.cardinality:
                 import numpy as np
@@ -93,7 +98,7 @@ class DeltaTable:
                     continue
                 f = schema.field(c)
                 at = spec_type_to_arrow(f.data_type)
-                raw = pv.get(c)
+                raw = snap.partition_raw(pv, c)
                 val = None if raw is None else _parse_partition_value(raw, at)
                 t = t.append_column(
                     c, pa.array([val] * t.num_rows, type=at))
@@ -119,10 +124,17 @@ class DeltaTable:
         return out
 
     # -- writes ----------------------------------------------------------
-    def _write_data_files(self, table, partition_by: Sequence[str]
+    def _write_data_files(self, table, partition_by: Sequence[str],
+                          physical_map: Optional[Dict[str, str]] = None
                           ) -> List[AddFile]:
         import pyarrow.parquet as pq
 
+        if physical_map:
+            # column mapping: data files, stats keys, partition dirs and
+            # partitionValues keys all use physical names
+            table = table.rename_columns(
+                [physical_map.get(n, n) for n in table.column_names])
+            partition_by = [physical_map.get(c, c) for c in partition_by]
         adds: List[AddFile] = []
         now = int(time.time() * 1000)
         if not partition_by:
@@ -176,23 +188,50 @@ class DeltaTable:
             tx.add_file(add)
         return tx.commit()
 
+    def _compute_generated(self, table, snap, session=None):
+        """Fill in generated columns the writer did not supply by
+        evaluating each delta.generationExpression over the input batch
+        with the engine (ref: sail-delta-lake table features
+        GeneratedColumns). Caller-supplied values are passed through
+        unvalidated."""
+        missing = {c: e for c, e in snap.generation_expressions.items()
+                   if c not in table.column_names}
+        if not missing:
+            return table
+        s = session if session is not None else _gen_session()
+        view = f"__delta_gen_{uuid.uuid4().hex[:8]}"
+        s.createDataFrame(table).createOrReplaceTempView(view)
+        try:
+            sel = ", ".join(f"({e}) AS {c}" for c, e in missing.items())
+            return s.sql(f"SELECT *, {sel} FROM {view}").toArrow()
+        finally:
+            s.catalog.dropTempView(view)
+
+    def _mapping(self, snap) -> Optional[Dict[str, str]]:
+        return snap.physical_names \
+            if snap.column_mapping_mode != "none" else None
+
     def append(self, table) -> int:
         snap = self.snapshot()
+        table = self._compute_generated(table, snap)
         tx = Transaction(self.log, snap.version, "WRITE")
         for add in self._write_data_files(
-                table, snap.metadata.partition_columns):
+                table, snap.metadata.partition_columns,
+                self._mapping(snap)):
             tx.add_file(add)
         return tx.commit()
 
     def overwrite(self, table) -> int:
         snap = self.snapshot()
+        table = self._compute_generated(table, snap)
         tx = Transaction(self.log, snap.version, "WRITE")
         tx.read_whole_table = True
         now = int(time.time() * 1000)
         for path in snap.files:
             tx.remove_file(RemoveFile(path, now))
         for add in self._write_data_files(
-                table, snap.metadata.partition_columns):
+                table, snap.metadata.partition_columns,
+                self._mapping(snap)):
             tx.add_file(add)
         return tx.commit()
 
@@ -213,7 +252,8 @@ class DeltaTable:
         deleted = 0
         part_cols = list(snap.metadata.partition_columns)
         for add in list(snap.files.values()):
-            t = pq.read_table(os.path.join(self.path, add.path))
+            t = snap.rename_to_logical(
+                pq.read_table(os.path.join(self.path, add.path)))
             full = t
             if part_cols:
                 import pyarrow as pa
@@ -222,7 +262,8 @@ class DeltaTable:
                 for c in part_cols:
                     f = snap.schema.field(c)
                     at = spec_type_to_arrow(f.data_type)
-                    val = _parse_partition_value(pv.get(c), at)
+                    val = _parse_partition_value(
+                        snap.partition_raw(pv, c), at)
                     full = full.append_column(
                         c, pa.array([val] * full.num_rows, type=at))
             existing_dv = add.dv()
@@ -258,11 +299,26 @@ class DeltaTable:
             kept = full.filter(pa_array_bool(keep & live_mask))
             if kept.num_rows:
                 for new_add in self._write_data_files(
-                        kept, snap.metadata.partition_columns):
+                        kept, snap.metadata.partition_columns,
+                        self._mapping(snap)):
                     tx.add_file(new_add)
         if deleted == 0:
             return snap.version, 0
         return tx.commit(), deleted
+
+
+_GEN_SESSION = None
+
+
+def _gen_session():
+    """One lazily-built session for generated-column evaluation when the
+    write comes from the table API directly (engine writes pass their
+    own live session instead)."""
+    global _GEN_SESSION
+    if _GEN_SESSION is None:
+        from ...session import SparkSession
+        _GEN_SESSION = SparkSession({"spark.sail.execution.mesh": "off"})
+    return _GEN_SESSION
 
 
 def pa_array_bool(mask):

@@ -15,9 +15,10 @@ co-reside (dynamic worker sets, host-only operators).
 Static-shape contract: every stage output has a bind-time capacity; hash
 buckets and group tables export overflow counters, and the host re-runs
 the program with scaled capacities when any overflow fires (the same
-detect-and-rerun protocol as parallel/exchange.py). Build-side duplicate
-keys in a join make the unique-probe plan invalid — that is a *fatal* flag
-and the query falls back to the local/cluster path.
+detect-and-rerun protocol as parallel/exchange.py). Joins compile as the
+unique-probe (PK-FK) plan first; build-side duplicate keys raise a retry
+flag and the next attempt recompiles with a many-to-many expanding join
+at ``probe_cap * expand_mult`` static capacity.
 """
 
 from __future__ import annotations
@@ -86,6 +87,9 @@ def _positional_name(i: int) -> str:
 # strong references to the dictionaries baked into their closures.
 _PROGRAM_CACHE: Dict = {}
 _PROGRAM_CACHE_MAX = 64
+# program structure -> first attempt index known to succeed (skips the
+# unique-join attempt for programs that need expanding joins)
+_ATTEMPT_HINT: Dict = {}
 
 
 def _leaf_layout(leaves: Dict[int, "_LeafData"]):
@@ -121,6 +125,7 @@ class MeshExecutor:
     def __init__(self, mesh=None, config: Optional[dict] = None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.config = config or {}
+        self._subquery_cache: Dict[int, object] = {}
         self.last_exchanges = 0       # collective edges in the last program
         self.last_hlo: Optional[str] = None
         self._group_cap = int(self.config.get(
@@ -146,6 +151,18 @@ class MeshExecutor:
         except MeshUnsupported:
             return None
 
+    def _pre_eval_subqueries(self, graph: jg.JobGraph) -> None:
+        """Uncorrelated scalar subqueries evaluate once on the host before
+        the SPMD program compiles; their values bake into the compiled
+        closures as literals (same contract as the local engine,
+        exec/local.py _pre_eval_subqueries)."""
+        from ..exec.local import LocalExecutor
+
+        loc = LocalExecutor(self.config)
+        loc._subquery_cache = self._subquery_cache
+        for stage in graph.stages:
+            loc._pre_eval_subqueries(stage.plan)
+
     # ------------------------------------------------------------------
     # graph orchestration
     # ------------------------------------------------------------------
@@ -161,6 +178,7 @@ class MeshExecutor:
     def _run_graph(self, graph: jg.JobGraph) -> pa.Table:
         from ..exec.local import LocalExecutor
 
+        self._pre_eval_subqueries(graph)
         P = self.nparts
         modes = self._consumer_modes(graph)
         worker_stages = [s for s in graph.stages if not s.on_driver]
@@ -176,13 +194,34 @@ class MeshExecutor:
             if scan is not None:
                 leaves[stage.stage_id] = self._prepare_leaf(scan, graph, P)
 
-        attempts = [(1, 1), (4, 2), (16, 4)]
-        for groups_mult, bucket_mult in attempts:
+        # (groups_mult, bucket_mult, expand_mult): the first attempt
+        # compiles unique-key (PK-FK) joins; a duplicate-build-key or
+        # capacity overflow raises a retry flag and recompiles with
+        # scaled group/bucket capacities and expanding joins. The winning
+        # attempt index is remembered per program structure so repeat
+        # executions skip the doomed earlier attempts entirely.
+        attempts = [(1, 1, 1), (4, 2, 4), (16, 4, 16)]
+        base_key, dict_objs = self._program_cache_key(worker_stages,
+                                                      leaves, 1, 1, 1)
+        start = _ATTEMPT_HINT.get(base_key, 0)
+        for idx in range(start, len(attempts)):
+            groups_mult, bucket_mult, expand_mult = attempts[idx]
+            # attempt 0's key is base_key itself; later attempts differ
+            # only in the multiplier fields — swap them in without
+            # re-encoding every stage plan
+            cache_key = base_key if idx == 0 else \
+                base_key[:4] + (groups_mult, bucket_mult, expand_mult) + \
+                base_key[7:]
             result = self._compile_and_run(
                 graph, worker_stages, modes, leaves, top_id,
-                groups_mult, bucket_mult)
+                groups_mult, bucket_mult, expand_mult,
+                cache_key, dict_objs)
             if result is None:
                 continue  # retryable overflow: scale capacities and redo
+            if idx > 0:
+                _ATTEMPT_HINT[base_key] = idx
+                while len(_ATTEMPT_HINT) > _PROGRAM_CACHE_MAX:
+                    _ATTEMPT_HINT.pop(next(iter(_ATTEMPT_HINT)))
             out_cols, out_sel, frag = result
             table = self._assemble(out_cols, out_sel, frag)
             root_plan = jg.attach_stage_inputs(root.plan, {top_id: table})
@@ -191,7 +230,7 @@ class MeshExecutor:
         raise MeshUnsupported("capacity overflow after retries")
 
     def _program_cache_key(self, worker_stages, leaves, groups_mult,
-                           bucket_mult):
+                           bucket_mult, expand_mult):
         """Structural cache key + the dictionary objects baked into the
         compiled closures (same identity contract as local._OpCache)."""
         plans = tuple(jg.encode_fragment(s.plan) for s in worker_stages)
@@ -203,14 +242,30 @@ class MeshExecutor:
             for lid, ld in sorted(leaves.items()))
         dict_objs = tuple(d for _, ld in sorted(leaves.items())
                           for _, d in sorted(ld.dicts.items(), key=lambda kv: kv[0]))
+        # scalar-subquery values bake into the compiled closures as
+        # literals: key them like local._op_key (rex-walk order)
+        from ..exec.local import _node_rex
+        sub_vals = []
+        for s in worker_stages:
+            for node in pn.walk_plan(s.plan):
+                for r in _node_rex(node):
+                    for sub in rx.walk(r):
+                        if isinstance(sub, rx.RScalarSubquery):
+                            v = self._subquery_cache.get(id(sub))
+                            sub_vals.append(
+                                repr(None if v is None else v.value))
         key = (plans, shapes, leaf_sig, self.nparts, groups_mult,
-               bucket_mult, tuple(str(d) for d in self.mesh.devices.flat))
+               bucket_mult, expand_mult, tuple(sub_vals),
+               tuple(str(d) for d in self.mesh.devices.flat))
         return key, dict_objs
 
     def _compile_and_run(self, graph, worker_stages, modes, leaves, top_id,
-                         groups_mult, bucket_mult):
-        cache_key, dict_objs = self._program_cache_key(
-            worker_stages, leaves, groups_mult, bucket_mult)
+                         groups_mult, bucket_mult, expand_mult,
+                         cache_key=None, dict_objs=None):
+        if cache_key is None:
+            cache_key, dict_objs = self._program_cache_key(
+                worker_stages, leaves, groups_mult, bucket_mult,
+                expand_mult)
         ident = tuple(id(d) for d in dict_objs)
         hit = _PROGRAM_CACHE.get((cache_key, ident))
         if hit is not None and all(s is d for s, d in
@@ -221,17 +276,23 @@ class MeshExecutor:
             return self._run_program(jitted, leaves, stage_out, top_id)
         return self._compile_fresh(cache_key, ident, dict_objs,
                                    worker_stages, modes, leaves, top_id,
-                                   groups_mult, bucket_mult)
+                                   groups_mult, bucket_mult, expand_mult)
 
     def _compile_fresh(self, cache_key, ident, dict_objs, worker_stages,
-                       modes, leaves, top_id, groups_mult, bucket_mult):
+                       modes, leaves, top_id, groups_mult, bucket_mult,
+                       expand_mult):
         P = self.nparts
         mesh = self.mesh
+        self._expand_mult = expand_mult
 
         # ---- bind-time fragment compilation (host) --------------------
         stage_frags: Dict[int, _Frag] = {}   # pre-exchange fragment
         stage_out: Dict[int, _Frag] = {}     # post-exchange (consumable)
         exchanges: List[Tuple[int, str, object]] = []
+        # consumed-edge metadata for _compile_agg's keyless-merge check
+        self._stage_modes = modes
+        self._stage_shuffle_keys = {s.stage_id: s.shuffle_keys
+                                    for s in worker_stages}
         for stage in worker_stages:
             frag = self._compile_node(
                 stage.plan, stage_out, leaves.get(stage.stage_id),
@@ -321,7 +382,7 @@ class MeshExecutor:
         retry_tot, fatal_tot = jax.device_get(
             (np.asarray(retry_tot), np.asarray(fatal_tot)))
         if int(np.max(fatal_tot)) > 0:
-            raise MeshUnsupported("duplicate build keys in mesh join")
+            raise MeshUnsupported("fatal flag raised in mesh program")
         if int(np.max(retry_tot)) > 0:
             return None
         top = stage_out[top_id]
@@ -424,7 +485,7 @@ class MeshExecutor:
         raise MeshUnsupported(f"mesh fragment op {type(node).__name__}")
 
     def _expr_compiler(self, frag: _Frag) -> ExprCompiler:
-        return ExprCompiler(frag.types, frag.dicts)
+        return ExprCompiler(frag.types, frag.dicts, self._subquery_cache)
 
     def _compile_rex(self, comp: ExprCompiler, r: rx.Rex):
         try:
@@ -485,6 +546,22 @@ class MeshExecutor:
         in_types = child.types
         max_groups = min(child.cap,
                          round_capacity(self._group_cap * gm))
+        # A keyless FINAL aggregate consumes the builder's empty-key
+        # shuffle (every partial row routed to partition 0): its single
+        # global row is valid on device 0 only — the other devices merge
+        # zero partials and must emit nothing (else the driver-side MERGE
+        # sees one duplicate row per device).
+        merge_to_zero = False
+        if not node.group_indices:
+            inp = node.input
+            while isinstance(inp, (pn.FilterExec, pn.ProjectExec)):
+                inp = inp.input
+            if isinstance(inp, jg.StageInputExec) and \
+                    getattr(self, "_stage_modes", {}).get(
+                        inp.stage_id) == jg.InputMode.SHUFFLE and \
+                    not getattr(self, "_stage_shuffle_keys", {}).get(
+                        inp.stage_id):
+                merge_to_zero = True
         # min/max over dictionary codes must order by VALUE: remap through
         # order-preserving ranks and back (same design as the local engine)
         luts = {}
@@ -532,7 +609,10 @@ class MeshExecutor:
                     col = run_one(ctx, a, arg)
                 out.append((col.data, col.validity))
             r = r + [aggk.group_overflow(ctx)]
-            return out, aggk.group_sel(ctx), r, f
+            osel = aggk.group_sel(ctx)
+            if merge_to_zero:
+                osel = osel & (jax.lax.axis_index(DATA_AXIS) == 0)
+            return out, osel, r, f
 
         nk = len(node.group_indices)
         types = [in_types[i] for i in node.group_indices] + \
@@ -554,8 +634,6 @@ class MeshExecutor:
             raise MeshUnsupported(f"mesh join type {jt}")
         if node.null_aware:
             raise MeshUnsupported("null-aware join in mesh stage")
-        if node.residual is not None and jt != "inner":
-            raise MeshUnsupported("join residual on non-inner join")
         left = self._compile_node(node.left, producers, leaf, stage_id, gm)
         right = self._compile_node(node.right, producers, leaf, stage_id, gm)
         lcomp = self._expr_compiler(left)
@@ -578,8 +656,29 @@ class MeshExecutor:
             comb = ExprCompiler(
                 left.types + right.types,
                 {**left.dicts,
-                 **{n_left + i: d for i, d in right.dicts.items()}})
+                 **{n_left + i: d for i, d in right.dicts.items()}},
+                self._subquery_cache)
             residual_c = self._compile_rex(comb, node.residual)
+
+        # expand_mult == 1: unique-key (PK-FK) fast path, output capacity
+        # = probe capacity; duplicate build keys raise a retry flag.
+        # expand_mult > 1: many-to-many expansion at static capacity
+        # probe_cap * expand_mult; a true output count past the capacity
+        # raises a retry flag (next attempt scales further). Semi/anti
+        # need only the match BIT so they are duplicate-safe — except
+        # with a residual, where each candidate row must be tested.
+        em = int(getattr(self, "_expand_mult", 1))
+        has_res = residual_c is not None
+        expand = em > 1 and (jt in ("inner", "left") or has_res)
+        exp_cap = round_capacity(left.cap * em)
+        n_right = len(right.types)
+        if jt in ("semi", "anti") or not expand:
+            out_cap = left.cap
+        elif jt == "left" and has_res:
+            # surviving expanded rows + unmatched-probe fallback rows
+            out_cap = exp_cap + left.cap
+        else:
+            out_cap = exp_cap
 
         def fn(env):
             lcols, lsel, lr, lf = left.fn(env)
@@ -596,7 +695,6 @@ class MeshExecutor:
                 lkeys.append(Column(ld, lv, ktype))
                 rkeys.append(Column(rd, rv, ktype))
             bt = joink.build_side(rkeys, rsel)
-            fatal = fatal + [joink.has_duplicate_build_keys(bt)]
             if not bt.exact:
                 retry = retry + [joink.hash_ambiguous(bt, rkeys)]
             ranges = joink.probe_ranges(
@@ -608,22 +706,99 @@ class MeshExecutor:
             payload = DeviceBatch(
                 {_positional_name(n_left + i): Column(d, v, right.types[i])
                  for i, (d, v) in enumerate(rcols)}, rsel)
-            names = [_positional_name(n_left + i)
-                     for i in range(len(right.types))] \
-                if jt not in ("semi", "anti") else []
-            out = joink.join_unique(bt, ranges, probe, payload, jt, names)
-            ncols = n_left if jt in ("semi", "anti") else \
-                n_left + len(right.types)
-            cols: Cols = [(out.columns[_positional_name(i)].data,
-                           out.columns[_positional_name(i)].validity)
-                          for i in range(ncols)]
-            sel = out.sel
-            if residual_c is not None:
-                data, validity = residual_c.fn(cols)
+            all_names = [_positional_name(n_left + i)
+                         for i in range(n_right)]
+            probe_cols: Cols = [(d, v) for d, v in lcols]
+
+            def res_mask(cols_full, base):
+                data, validity = residual_c.fn(cols_full)
                 keep = data.astype(jnp.bool_)
                 if validity is not None:
                     keep = keep & validity
-                sel = sel & keep
+                return base & keep
+
+            def batch_cols(b, ncols) -> Cols:
+                return [(b.columns[_positional_name(i)].data,
+                         b.columns[_positional_name(i)].validity)
+                        for i in range(ncols)]
+
+            if not expand:
+                if jt in ("inner", "left") or has_res:
+                    retry = retry + [joink.has_duplicate_build_keys(bt)]
+                if not has_res:
+                    names = all_names if jt not in ("semi", "anti") else []
+                    out = joink.join_unique(bt, ranges, probe, payload, jt,
+                                            names)
+                    ncols = n_left if jt in ("semi", "anti") else \
+                        n_left + n_right
+                    return (batch_cols(out, ncols), out.sel, retry, fatal)
+                # residual on the ≤1-match path: gather the candidate
+                # build row for every probe row, then test it
+                combined = joink.join_unique(bt, ranges, probe, payload,
+                                             "left", all_names)
+                cols_full = batch_cols(combined, n_left + n_right)
+                m = res_mask(cols_full, ranges.cnt > 0)
+                if jt == "inner":
+                    return cols_full, combined.sel & m, retry, fatal
+                if jt == "left":
+                    cols = [(d, (m if v is None else v & m) if i >= n_left
+                             else v)
+                            for i, (d, v) in enumerate(cols_full)]
+                    return cols, combined.sel, retry, fatal
+                if jt == "semi":
+                    return probe_cols, lsel & m, retry, fatal
+                return probe_cols, lsel & ~m, retry, fatal  # anti
+
+            # expanding path
+            if not has_res:
+                total = joink.join_output_count(ranges, lsel, jt)
+                retry = retry + [total > out_cap]
+                res = joink.join_expand(bt, ranges, probe, payload, jt,
+                                        all_names, out_cap)
+                return (batch_cols(res.batch, n_left + n_right),
+                        res.batch.sel, retry, fatal)
+            # residual: expand every candidate pair as inner, test, then
+            # recover the outer/semi/anti semantics from the match bits
+            total = joink.join_output_count(ranges, lsel, "inner")
+            retry = retry + [total > exp_cap]
+            res = joink.join_expand(bt, ranges, probe, payload, "inner",
+                                    all_names, exp_cap)
+            cols_full = batch_cols(res.batch, n_left + n_right)
+            ok = res_mask(cols_full, res.batch.sel)
+            if jt == "inner":
+                return cols_full, ok, retry, fatal
+            matched_probe = jnp.zeros(probe.capacity, dtype=jnp.bool_) \
+                .at[res.probe_index].max(ok, mode="drop")
+            if jt == "semi":
+                return probe_cols, lsel & matched_probe, retry, fatal
+            if jt == "anti":
+                return probe_cols, lsel & ~matched_probe, retry, fatal
+            # left: surviving expanded rows + unmatched probe rows with
+            # null build columns (same shape as local._join_expand)
+            unmatched = lsel & ~matched_probe
+            cols: Cols = []
+            for i in range(n_left):
+                ed, ev = cols_full[i]
+                pd_, pv = lcols[i]
+                data = jnp.concatenate([ed, pd_])
+                validity = None
+                if ev is not None or pv is not None:
+                    ev_ = ev if ev is not None else \
+                        jnp.ones(exp_cap, dtype=jnp.bool_)
+                    pv_ = pv if pv is not None else \
+                        jnp.ones(probe.capacity, dtype=jnp.bool_)
+                    validity = jnp.concatenate([ev_, pv_])
+                cols.append((data, validity))
+            for i in range(n_right):
+                ed, ev = cols_full[n_left + i]
+                ev_ = ev if ev is not None else \
+                    jnp.ones(exp_cap, dtype=jnp.bool_)
+                cols.append((
+                    jnp.concatenate(
+                        [ed, jnp.zeros(probe.capacity, dtype=ed.dtype)]),
+                    jnp.concatenate(
+                        [ev_, jnp.zeros(probe.capacity, dtype=jnp.bool_)])))
+            sel = jnp.concatenate([ok, unmatched])
             return cols, sel, retry, fatal
 
         if jt in ("semi", "anti"):
@@ -632,7 +807,7 @@ class MeshExecutor:
             types = list(left.types) + list(right.types)
             dicts = {**left.dicts,
                      **{n_left + i: d for i, d in right.dicts.items()}}
-        return _Frag(fn, types, dicts, left.cap)
+        return _Frag(fn, types, dicts, out_cap)
 
     # ------------------------------------------------------------------
     # exchanges
@@ -668,7 +843,13 @@ class MeshExecutor:
                 if v is not None:
                     d = jnp.where(v, d, jnp.zeros_like(d))
                 kd.append(d)
-            pid = (hash64(kd, key_types) % jnp.uint64(P)).astype(jnp.int32)
+            if kd:
+                pid = (hash64(kd, key_types)
+                       % jnp.uint64(P)).astype(jnp.int32)
+            else:
+                # keyless shuffle (global aggregate): every partial row
+                # merges on partition 0
+                pid = jnp.zeros(sel.shape[0], dtype=jnp.int32)
             perm, valid, overflow = bucket_by_partition(pid, sel, P,
                                                         bucket_cap)
 

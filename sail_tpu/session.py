@@ -85,6 +85,7 @@ class SparkSession:
         shard_map program whose exchanges are XLA collectives (see
         parallel/mesh_exec.py). mode: off | auto (default) | force."""
         from .config import get as config_get
+        self._last_mesh_executor = None
         mode = (self.conf.get("spark.sail.execution.mesh")
                 or str(config_get("execution.mesh", "auto")))
         if mode == "off":
@@ -95,8 +96,10 @@ class SparkSession:
         try:
             from .parallel.mesh_exec import MeshExecutor
             ex = MeshExecutor(config=dict(self.conf.items()))
-            self._last_mesh_executor = ex
-            return ex.execute(node)
+            result = ex.execute(node)
+            if result is not None:
+                self._last_mesh_executor = ex
+            return result
         except Exception:
             if mode == "force":
                 raise
@@ -327,6 +330,19 @@ class SparkSession:
             return empty
         raise NotImplementedError(f"command {type(cmd).__name__} not supported yet")
 
+    @staticmethod
+    def _generated_columns(entry) -> set:
+        """Delta generated columns for an INSERT target (these must stay
+        absent from the insert batch so the writer computes them)."""
+        if entry.format != "delta" or not entry.paths:
+            return set()
+        try:
+            from .lakehouse.delta import DeltaTable
+            return set(DeltaTable(entry.paths[0]).snapshot()
+                       .generation_expressions)
+        except Exception:  # noqa: BLE001 — best-effort metadata probe
+            return set()
+
     def _delta_entry(self, table_name):
         entry = self.catalog_manager.lookup_table(table_name)
         if entry is None:
@@ -397,18 +413,79 @@ class SparkSession:
         if entry is None:
             raise ValueError(f"table not found: {'.'.join(cmd.table)}")
         new_data = self._execute_query(cmd.query)
+        if cmd.columns and new_data.num_columns != len(cmd.columns):
+            raise ValueError(
+                f"INSERT column list has {len(cmd.columns)} columns but "
+                f"query produced {new_data.num_columns}")
         if entry.format == "memory":
+            from .columnar.arrow_interop import spec_type_to_arrow
             existing = entry.data
+            if existing is not None:
+                target = existing.column_names
+                ttype = {n: existing.schema.field(n).type for n in target}
+            elif entry.schema is not None:
+                target = [f.name for f in entry.schema.fields]
+                ttype = {f.name: spec_type_to_arrow(f.data_type)
+                         for f in entry.schema.fields}
+            else:
+                target = None
+                ttype = {}
+            if cmd.columns:
+                # explicit column list: map by NAME onto the target
+                # shape, null-filling unlisted columns
+                new_data = new_data.rename_columns(list(cmd.columns))
+                listed = {c.lower(): c for c in new_data.column_names}
+                cols = {}
+                for name in (target or list(cmd.columns)):
+                    src = listed.get(name.lower())
+                    if src is not None:
+                        cols[name] = new_data.column(src)
+                    else:
+                        cols[name] = pa.nulls(new_data.num_rows,
+                                              type=ttype[name])
+                new_data = pa.table(cols)
+            elif target is not None:
+                # positional semantics against the declared shape
+                if new_data.num_columns != len(target):
+                    raise ValueError(
+                        f"INSERT query produced {new_data.num_columns} "
+                        f"columns but table has {len(target)}")
+                new_data = new_data.rename_columns(target)
             if cmd.overwrite or existing is None or existing.num_rows == 0:
                 merged = new_data
             else:
-                new_data = new_data.rename_columns(existing.column_names)
                 merged = pa.concat_tables([existing, new_data],
                                           promote_options="permissive")
             entry.data = merged
             entry.schema = _schema_of(merged)
         else:
             from .io.formats import write_table
+            # positional insert semantics: a VALUES/SELECT output maps to
+            # the target columns by position (or by the explicit INSERT
+            # column list), not by its own generated names (col1, …)
+            if cmd.columns:
+                new_data = new_data.rename_columns(list(cmd.columns))
+                if entry.schema is not None:
+                    # null-fill unlisted target columns so every data
+                    # file carries the full schema (generated Delta
+                    # columns stay absent — the writer computes them)
+                    from .columnar.arrow_interop import spec_type_to_arrow
+                    gen = self._generated_columns(entry)
+                    listed = {c.lower(): c for c in new_data.column_names}
+                    cols = {}
+                    for f in entry.schema.fields:
+                        src = listed.get(f.name.lower())
+                        if src is not None:
+                            cols[f.name] = new_data.column(src)
+                        elif f.name not in gen:
+                            cols[f.name] = pa.nulls(
+                                new_data.num_rows,
+                                type=spec_type_to_arrow(f.data_type))
+                    new_data = pa.table(cols)
+            elif entry.schema is not None and \
+                    new_data.num_columns == len(entry.schema.fields):
+                new_data = new_data.rename_columns(
+                    [f.name for f in entry.schema.fields])
             write_table(new_data, entry.format, entry.paths[0],
                         mode="overwrite" if cmd.overwrite else "append",
                         partition_by=entry.partition_by)

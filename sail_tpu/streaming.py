@@ -150,6 +150,99 @@ class FileStreamSource(StreamSource):
         return out
 
 
+class SocketStreamSource(StreamSource):
+    """Newline-delimited text over TCP as `value` string rows (reference
+    role: the socket streaming source — like Spark's, it is NOT
+    replayable: offsets count consumed lines for progress reporting only
+    and seek is a no-op).
+
+    Connection is lazy (first ``next_batch``) and ``close()`` resets the
+    source, so a stopped query's DataFrame can be started again — the
+    restarted query reconnects (Spark connects per started query)."""
+
+    def __init__(self, host: str, port: int):
+        self._host = host
+        self._port = port
+        self._lines: List[str] = []
+        self._lock = threading.Lock()
+        self._consumed = 0
+        self._closed = threading.Event()
+        self._sock = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_connected(self):
+        import socket as _socket
+
+        with self._lock:
+            # connect once per lifecycle: a peer-closed connection does
+            # NOT auto-reconnect (that could silently replay data); only
+            # an explicit close() resets the source for a restart
+            if self._thread is not None:
+                return
+            self._closed = threading.Event()
+            # connect may raise — surfaced as the query's exception
+            sock = _socket.create_connection((self._host, self._port),
+                                             timeout=10)
+            # the timeout applies to connect only — an idle (but live)
+            # stream must block in recv, not trip a 10s read timeout
+            sock.settimeout(None)
+            self._sock = sock
+            closed = self._closed
+
+            def reader():
+                buf = b""
+                try:
+                    while not closed.is_set():
+                        chunk = sock.recv(65536)
+                        if not chunk:
+                            break
+                        buf += chunk
+                        *complete, buf = buf.split(b"\n")
+                        if complete:
+                            with self._lock:
+                                self._lines.extend(
+                                    c.decode("utf-8", "replace")
+                                    for c in complete)
+                except OSError:
+                    pass
+                finally:
+                    if buf and not closed.is_set():
+                        with self._lock:
+                            self._lines.append(
+                                buf.decode("utf-8", "replace"))
+
+            self._thread = threading.Thread(target=reader, daemon=True)
+            self._thread.start()
+
+    @property
+    def schema(self) -> pa.Schema:
+        return pa.schema([("value", pa.string())])
+
+    def offset(self):
+        return self._consumed
+
+    def next_batch(self) -> Optional[pa.Table]:
+        self._ensure_connected()
+        with self._lock:
+            if not self._lines:
+                return None
+            out, self._lines = self._lines, []
+        self._consumed += len(out)
+        return pa.table({"value": pa.array(out, type=pa.string())})
+
+    def close(self):
+        self._closed.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        with self._lock:
+            self._thread = None
+            self._lines.clear()
+
+
 class StreamingQuery:
     """A running micro-batch query (reference: streaming query lifecycle,
     plan_executor.rs handle_execute_streaming_query_command)."""
@@ -194,6 +287,9 @@ class StreamingQuery:
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=30)
+        close = getattr(self._source, "close", None)
+        if close is not None:
+            close()
 
     def awaitTermination(self, timeout: Optional[float] = None) -> bool:
         self._thread.join(timeout)
@@ -412,6 +508,12 @@ class DataStreamReader:
         if self._format == "rate":
             src: StreamSource = RateSource(
                 int(self._options.get("rowspersecond", 1)))
+        elif self._format == "socket":
+            host = self._options.get("host")
+            port = self._options.get("port")
+            if not host or not port:
+                raise ValueError("socket source requires host and port")
+            src = SocketStreamSource(host, int(port))
         elif self._format in ("parquet", "csv", "json", "text"):
             p = path or self._options.get("path")
             if not p:

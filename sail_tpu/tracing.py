@@ -19,6 +19,7 @@ so this is a from-scratch implementation:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import secrets
 import threading
@@ -117,8 +118,21 @@ def extract_context(metadata) -> Optional[SpanContext]:
 # OTLP/HTTP JSON export
 # ---------------------------------------------------------------------------
 
+@dataclass
+class LogEvent:
+    time_ns: int
+    severity_number: int
+    severity_text: str
+    body: str
+    attributes: Dict[str, object] = field(default_factory=dict)
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+
+
 class OtlpHttpExporter:
-    """Batched OTLP/HTTP JSON span exporter (POST {endpoint}/v1/traces)."""
+    """Batched OTLP/HTTP JSON exporter: spans to ``/v1/traces`` and log
+    records to ``/v1/logs`` (the reference's log-export pipeline,
+    sail-telemetry src/telemetry.rs)."""
 
     def __init__(self, endpoint: str, service_name: str = "sail-tpu",
                  flush_interval_s: float = 1.0, max_batch: int = 512):
@@ -126,6 +140,7 @@ class OtlpHttpExporter:
         self.service_name = service_name
         self.max_batch = max_batch
         self._buf: List[Span] = []
+        self._log_buf: List[LogEvent] = []
         self._buf_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -141,6 +156,12 @@ class OtlpHttpExporter:
             if len(self._buf) > 16 * self.max_batch:
                 del self._buf[: 8 * self.max_batch]
 
+    def add_log(self, ev: LogEvent):
+        with self._buf_lock:
+            self._log_buf.append(ev)
+            if len(self._log_buf) > 16 * self.max_batch:
+                del self._log_buf[: 8 * self.max_batch]
+
     def _loop(self, interval: float):
         while not self._stop.wait(interval):
             self.flush()
@@ -148,8 +169,11 @@ class OtlpHttpExporter:
     def flush(self):
         with self._buf_lock:
             batch, self._buf = self._buf, []
+            logs, self._log_buf = self._log_buf, []
         if batch:
             self._post(batch)
+        if logs:
+            self._post_logs(logs)
 
     def shutdown(self):
         self._stop.set()
@@ -167,9 +191,19 @@ class OtlpHttpExporter:
             value = {"stringValue": str(v)}
         return {"key": k, "value": value}
 
-    def _post(self, batch: List[Span]):
+    def _send(self, suffix: str, payload: dict):
         import urllib.request
 
+        req = urllib.request.Request(
+            self.endpoint + suffix,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10).read()
+        except Exception:  # noqa: BLE001 — telemetry must never break queries
+            pass
+
+    def _post(self, batch: List[Span]):
         payload = {
             "resourceSpans": [{
                 "resource": {"attributes": [
@@ -192,14 +226,77 @@ class OtlpHttpExporter:
                 }],
             }],
         }
-        req = urllib.request.Request(
-            self.endpoint + "/v1/traces",
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+        self._send("/v1/traces", payload)
+
+    def _post_logs(self, logs: List[LogEvent]):
+        payload = {
+            "resourceLogs": [{
+                "resource": {"attributes": [
+                    self._attr("service.name", self.service_name)]},
+                "scopeLogs": [{
+                    "scope": {"name": "sail_tpu"},
+                    "logRecords": [{
+                        "timeUnixNano": str(ev.time_ns),
+                        "severityNumber": ev.severity_number,
+                        "severityText": ev.severity_text,
+                        "body": {"stringValue": ev.body},
+                        "attributes": [self._attr(k, v)
+                                       for k, v in ev.attributes.items()],
+                        **({"traceId": ev.trace_id} if ev.trace_id else {}),
+                        **({"spanId": ev.span_id} if ev.span_id else {}),
+                    } for ev in logs],
+                }],
+            }],
+        }
+        self._send("/v1/logs", payload)
+
+
+# severityNumber per the OTLP spec
+_SEVERITY = {"DEBUG": 5, "INFO": 9, "WARNING": 13, "WARN": 13,
+             "ERROR": 17, "CRITICAL": 21, "FATAL": 21}
+
+
+def log_event(severity: str, body: str, **attributes):
+    """Emit one log record to the OTLP pipeline (no-op when no exporter
+    is configured). Records correlate with the active span."""
+    exporter = _exporter()
+    if exporter is None:
+        return
+    ctx = _current()
+    exporter.add_log(LogEvent(
+        time_ns=time.time_ns(),
+        severity_number=_SEVERITY.get(severity.upper(), 9),
+        severity_text=severity.upper(), body=body,
+        attributes=attributes,
+        trace_id=ctx.trace_id if ctx else None,
+        span_id=ctx.span_id if ctx else None))
+
+
+class OtlpLogHandler(logging.Handler):
+    """stdlib ``logging`` bridge: attach to a logger and every record
+    flows into the OTLP log export."""
+
+    def emit(self, record):
         try:
-            urllib.request.urlopen(req, timeout=10).read()
-        except Exception:  # noqa: BLE001 — telemetry must never break queries
+            log_event(record.levelname, record.getMessage(),
+                      logger=record.name)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
             pass
+
+
+def install_log_handler(logger_name: str = "sail_tpu"):
+    """Route the engine's stdlib logger into the OTLP pipeline."""
+    logger = logging.getLogger(logger_name)
+    if logger.level == logging.NOTSET:
+        # without an explicit level the logger inherits root's WARNING
+        # and INFO/DEBUG records would never reach the handler
+        logger.setLevel(logging.DEBUG)
+    for h in logger.handlers:
+        if isinstance(h, OtlpLogHandler):
+            return h
+    h = OtlpLogHandler()
+    logger.addHandler(h)
+    return h
 
 
 _EXPORTER: Optional[OtlpHttpExporter] = None
@@ -216,6 +313,7 @@ def _exporter() -> Optional[OtlpHttpExporter]:
                     or str(config_get("telemetry.otlp_endpoint", "") or "")
                 if endpoint:
                     _EXPORTER = OtlpHttpExporter(endpoint)
+                    install_log_handler()
                 _EXPORTER_INIT = True
     return _EXPORTER
 
@@ -227,6 +325,8 @@ def configure_exporter(endpoint: Optional[str]):
         if _EXPORTER is not None:
             _EXPORTER.shutdown()
         _EXPORTER = OtlpHttpExporter(endpoint) if endpoint else None
+        if _EXPORTER is not None:
+            install_log_handler()
         _EXPORTER_INIT = True
 
 

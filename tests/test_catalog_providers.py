@@ -527,6 +527,39 @@ def unity_server(tmp_path):
     srv.shutdown()
 
 
+def test_onelake_delta_delegates_to_unity(unity_server, monkeypatch):
+    """OneLake's delta endpoint speaks the Unity REST API; the provider
+    is a delegate with the workspace as catalog scope (ref
+    sail-catalog-onelake/src/provider.rs)."""
+    from sail_tpu.catalog.onelake import OneLakeCatalog
+
+    cat = OneLakeCatalog("ol", workspace="main", api="delta",
+                         endpoint=unity_server)
+    assert cat.list_databases() == ["analytics"]
+    entry = cat.get_table("analytics", "events")
+    assert entry.format == "parquet"
+    # read-only surface
+    with pytest.raises(Exception):
+        cat.drop_table("analytics", "events")
+    # config-driven registration + SELECT through the session
+    monkeypatch.setenv("SAIL_CATALOG__LIST", "ol")
+    monkeypatch.setenv("SAIL_CATALOG__OL__TYPE", "onelake")
+    monkeypatch.setenv("SAIL_CATALOG__OL__WORKSPACE", "main")
+    monkeypatch.setenv("SAIL_CATALOG__OL__ENDPOINT", unity_server)
+    spark = SparkSession({})
+    got = spark.sql("SELECT SUM(n) FROM ol.analytics.events").toPandas()
+    assert got.iloc[0, 0] == 6
+
+
+def test_onelake_iceberg_delegates_to_rest(rest_server):
+    from sail_tpu.catalog.onelake import OneLakeCatalog
+
+    _, uri = rest_server
+    cat = OneLakeCatalog("ol", workspace="w1", api="iceberg",
+                         endpoint=uri)
+    assert "analytics" in cat.list_databases()
+
+
 def test_unity_catalog_read(unity_server, monkeypatch):
     from sail_tpu.catalog.unity import UnityCatalog
 

@@ -1,0 +1,292 @@
+"""Delta column mapping (name mode) + generated columns.
+
+Reference role: crates/sail-delta-lake/src/table/features.rs
+(ColumnMapping / GeneratedColumns table features). A mapped table stores
+data under per-field physical names (`delta.columnMapping.physicalName`)
+— reading one written by another engine must translate physical →
+logical, and every write must go back through physical names."""
+
+import json
+import os
+import uuid
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from sail_tpu.lakehouse.delta import DeltaTable
+
+
+PHYS_ID = "col-" + uuid.uuid4().hex[:8]
+PHYS_V = "col-" + uuid.uuid4().hex[:8]
+PHYS_P = "col-" + uuid.uuid4().hex[:8]
+
+
+def _mapped_schema(with_partition=False):
+    fields = [
+        {"name": "id", "type": "long", "nullable": True,
+         "metadata": {"delta.columnMapping.id": 1,
+                      "delta.columnMapping.physicalName": PHYS_ID}},
+        {"name": "v", "type": "double", "nullable": True,
+         "metadata": {"delta.columnMapping.id": 2,
+                      "delta.columnMapping.physicalName": PHYS_V}},
+    ]
+    if with_partition:
+        fields.append(
+            {"name": "p", "type": "string", "nullable": True,
+             "metadata": {"delta.columnMapping.id": 3,
+                          "delta.columnMapping.physicalName": PHYS_P}})
+    return {"type": "struct", "fields": fields}
+
+
+def _write_foreign_mapped_table(path, with_partition=False):
+    """Simulate a table written by another engine under name mapping."""
+    log_dir = os.path.join(path, "_delta_log")
+    os.makedirs(log_dir)
+    actions = [
+        {"protocol": {"minReaderVersion": 2, "minWriterVersion": 5}},
+        {"metaData": {
+            "id": str(uuid.uuid4()),
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": json.dumps(_mapped_schema(with_partition)),
+            "partitionColumns": ["p"] if with_partition else [],
+            "configuration": {"delta.columnMapping.mode": "name",
+                              "delta.columnMapping.maxColumnId": "3"},
+            "createdTime": 0,
+        }},
+    ]
+    if with_partition:
+        for pval in ("a", "b"):
+            rel = f"{PHYS_P}={pval}/part-{uuid.uuid4().hex}.parquet"
+            os.makedirs(os.path.dirname(os.path.join(path, rel)),
+                        exist_ok=True)
+            pq.write_table(
+                pa.table({PHYS_ID: [1, 2] if pval == "a" else [3],
+                          PHYS_V: [1.0, 2.0] if pval == "a" else [3.0]}),
+                os.path.join(path, rel))
+            actions.append({"add": {
+                "path": rel, "size": 1,
+                "partitionValues": {PHYS_P: pval},
+                "modificationTime": 0, "dataChange": True}})
+    else:
+        rel = f"part-{uuid.uuid4().hex}.parquet"
+        pq.write_table(pa.table({PHYS_ID: [1, 2, 3],
+                                 PHYS_V: [1.0, 2.0, 3.0]}),
+                       os.path.join(path, rel))
+        actions.append({"add": {
+            "path": rel, "size": 1, "partitionValues": {},
+            "modificationTime": 0, "dataChange": True}})
+    with open(os.path.join(log_dir, "0" * 20 + ".json"), "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+
+
+def test_read_foreign_mapped_table(tmp_path):
+    path = str(tmp_path / "m1")
+    _write_foreign_mapped_table(path)
+    out = DeltaTable(path).to_arrow()
+    assert sorted(out.column_names) == ["id", "v"]
+    assert sorted(out.column("id").to_pylist()) == [1, 2, 3]
+
+
+def test_read_mapped_partitioned_with_pruning(tmp_path):
+    path = str(tmp_path / "m2")
+    _write_foreign_mapped_table(path, with_partition=True)
+    out = DeltaTable(path).to_arrow()
+    assert sorted(out.column_names) == ["id", "p", "v"]
+    got = sorted(zip(out.column("id").to_pylist(),
+                     out.column("p").to_pylist()))
+    assert got == [(1, "a"), (2, "a"), (3, "b")]
+    # projected read maps logical -> physical for the parquet scan
+    sub = DeltaTable(path).to_arrow(columns=["v"])
+    assert sub.column_names == ["v"]
+    assert sorted(sub.column("v").to_pylist()) == [1.0, 2.0, 3.0]
+
+
+def test_append_writes_physical_names(tmp_path):
+    path = str(tmp_path / "m3")
+    _write_foreign_mapped_table(path)
+    t = DeltaTable(path)
+    t.append(pa.table({"id": [4], "v": [4.0]}))
+    out = t.to_arrow()
+    assert sorted(out.column("id").to_pylist()) == [1, 2, 3, 4]
+    # the new data file itself must carry PHYSICAL column names
+    snap = t.snapshot()
+    new = [a for a in snap.files.values() if "=" not in a.path]
+    raw_names = set()
+    for a in new:
+        raw_names |= set(pq.read_schema(
+            os.path.join(path, a.path)).names)
+    assert PHYS_ID in raw_names and "id" not in raw_names
+
+
+def test_append_partitioned_mapped(tmp_path):
+    path = str(tmp_path / "m4")
+    _write_foreign_mapped_table(path, with_partition=True)
+    t = DeltaTable(path)
+    t.append(pa.table({"id": [9], "v": [9.0], "p": ["c"]}))
+    out = t.to_arrow()
+    assert sorted(out.column("p").to_pylist()) == ["a", "a", "b", "c"]
+    # partitionValues keys and the hive dir use the physical name
+    snap = t.snapshot()
+    added = [a for a in snap.files.values()
+             if dict(a.partition_values).get(PHYS_P) == "c"]
+    assert len(added) == 1
+    assert added[0].path.startswith(f"{PHYS_P}=c/")
+
+
+def test_mapped_table_sql_roundtrip(tmp_path):
+    """Full SQL surface on a foreign mapped table: SELECT, positional
+    INSERT VALUES, DELETE — data files stay physically named."""
+    from sail_tpu import SparkSession
+
+    path = str(tmp_path / "msql")
+    _write_foreign_mapped_table(path)
+    spark = SparkSession({"spark.sail.execution.mesh": "off"})
+    try:
+        spark.sql(f"CREATE TABLE mt USING delta LOCATION '{path}'")
+        assert spark.sql("SELECT SUM(id) FROM mt").toPandas().iloc[0, 0] \
+            == 6
+        spark.sql("INSERT INTO mt VALUES (10, 10.0)")
+        spark.sql("DELETE FROM mt WHERE id = 2")
+        got = sorted(spark.sql("SELECT id FROM mt").toPandas().id)
+        assert got == [1, 3, 10]
+        for a in DeltaTable(path).snapshot().files.values():
+            names = pq.read_schema(os.path.join(path, a.path)).names
+            assert "id" not in names and PHYS_ID in names, names
+    finally:
+        spark.stop()
+
+
+def test_delete_on_mapped_table(tmp_path):
+    path = str(tmp_path / "m5")
+    _write_foreign_mapped_table(path)
+    t = DeltaTable(path)
+
+    def keep(tb):
+        import numpy as np
+        return np.asarray([x != 2 for x in tb.column("id").to_pylist()])
+
+    _, deleted = t.delete_where(keep)
+    assert deleted == 1
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 3]
+
+
+def _make_generated_table(path):
+    log_dir = os.path.join(path, "_delta_log")
+    os.makedirs(log_dir)
+    schema = {"type": "struct", "fields": [
+        {"name": "id", "type": "long", "nullable": True, "metadata": {}},
+        {"name": "id2", "type": "long", "nullable": True,
+         "metadata": {"delta.generationExpression": "id * 2"}},
+    ]}
+    actions = [
+        {"protocol": {"minReaderVersion": 1, "minWriterVersion": 4}},
+        {"metaData": {
+            "id": str(uuid.uuid4()),
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": json.dumps(schema),
+            "partitionColumns": [], "configuration": {},
+            "createdTime": 0}},
+    ]
+    with open(os.path.join(log_dir, "0" * 20 + ".json"), "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+    return DeltaTable(path)
+
+
+def test_merge_insert_computes_generated_column(tmp_path):
+    """MERGE ... WHEN NOT MATCHED THEN INSERT must compute unassigned
+    generated columns exactly like the append path."""
+    from sail_tpu import SparkSession
+
+    path = str(tmp_path / "gm")
+    t = _make_generated_table(path)
+    t.append(pa.table({"id": [1]}))
+    spark = SparkSession({})
+    try:
+        spark.sql(f"CREATE TABLE gt USING delta LOCATION '{path}'")
+        spark.createDataFrame(pa.table({"sid": [1, 5]})) \
+            .createOrReplaceTempView("src")
+        spark.sql(
+            "MERGE INTO gt USING src ON gt.id = src.sid "
+            "WHEN NOT MATCHED THEN INSERT (id) VALUES (src.sid)")
+        out = t.to_arrow()
+        rows = sorted(zip(out.column("id").to_pylist(),
+                          out.column("id2").to_pylist()))
+        assert rows == [(1, 2), (5, 10)]
+    finally:
+        spark.stop()
+
+
+def test_update_recomputes_generated_column(tmp_path):
+    """UPDATE must recompute generated columns for rewritten rows — a
+    stale value would break the generation invariant."""
+    from sail_tpu import SparkSession
+
+    path = str(tmp_path / "gu")
+    t = _make_generated_table(path)
+    t.append(pa.table({"id": [1, 2, 3]}))
+    spark = SparkSession({})
+    try:
+        spark.sql(f"CREATE TABLE gu USING delta LOCATION '{path}'")
+        spark.sql("UPDATE gu SET id = 10 WHERE id = 2")
+        out = t.to_arrow()
+        rows = sorted(zip(out.column("id").to_pylist(),
+                          out.column("id2").to_pylist()))
+        assert rows == [(1, 2), (3, 6), (10, 20)]
+    finally:
+        spark.stop()
+
+
+def test_insert_column_list_memory_table(tmp_path):
+    """INSERT with an explicit column list maps by name (reordered or
+    subset), null-filling unlisted columns."""
+    from sail_tpu import SparkSession
+
+    spark = SparkSession({})
+    try:
+        spark.sql("CREATE TABLE mem (a INT, b INT)")
+        spark.sql("INSERT INTO mem VALUES (1, 2)")
+        spark.sql("INSERT INTO mem (b, a) VALUES (20, 10)")
+        spark.sql("INSERT INTO mem (a) VALUES (99)")
+        got = spark.sql("SELECT a, b FROM mem ORDER BY a").toPandas()
+        assert got.a.tolist() == [1, 10, 99]
+        assert got.b.fillna(-1).tolist() == [2, 20, -1]
+    finally:
+        spark.stop()
+
+
+def test_generated_column_computed_on_append(tmp_path):
+    path = str(tmp_path / "g1")
+    log_dir = os.path.join(path, "_delta_log")
+    os.makedirs(log_dir)
+    schema = {"type": "struct", "fields": [
+        {"name": "id", "type": "long", "nullable": True, "metadata": {}},
+        {"name": "id2", "type": "long", "nullable": True,
+         "metadata": {"delta.generationExpression": "id * 2"}},
+    ]}
+    actions = [
+        {"protocol": {"minReaderVersion": 1, "minWriterVersion": 4}},
+        {"metaData": {
+            "id": str(uuid.uuid4()),
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": json.dumps(schema),
+            "partitionColumns": [], "configuration": {},
+            "createdTime": 0}},
+    ]
+    with open(os.path.join(log_dir, "0" * 20 + ".json"), "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+    t = DeltaTable(path)
+    # writer supplies only `id`: the engine evaluates id * 2
+    t.append(pa.table({"id": [1, 2, 3]}))
+    out = t.to_arrow()
+    rows = sorted(zip(out.column("id").to_pylist(),
+                      out.column("id2").to_pylist()))
+    assert rows == [(1, 2), (2, 4), (3, 6)]
+    # caller-supplied generated values pass through
+    t.append(pa.table({"id": [4], "id2": [100]}))
+    out = t.to_arrow()
+    assert (4, 100) in list(zip(out.column("id").to_pylist(),
+                                out.column("id2").to_pylist()))

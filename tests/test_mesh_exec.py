@@ -131,9 +131,10 @@ def test_mesh_join_filters_and_projections(spark):
                                   check_dtype=False, rtol=1e-9)
 
 
-def test_mesh_duplicate_build_keys_falls_back(spark):
+def test_mesh_duplicate_build_keys_expand(spark):
     # duplicate keys on the build side invalidate the unique-probe SPMD
-    # join — executor must return None (fatal flag), not wrong rows
+    # join; the retry protocol must recompile with the many-to-many
+    # expanding join and produce every matched pair
     left = pa.table({"k": np.array([1, 2, 3, 4] * 50),
                      "x": np.arange(200)})
     right = pa.table({"k": np.array([1, 1, 2, 3]),  # dup build key 1
@@ -143,7 +144,44 @@ def test_mesh_duplicate_build_keys_falls_back(spark):
     sql = ("SELECT l.k, SUM(r.y) AS s FROM l JOIN r ON l.k = r.k "
            "GROUP BY l.k")
     out, ex = _mesh_run(spark, sql)
-    assert out is None
+    assert out is not None
+    exp = _local_run(spark, sql)
+    pd.testing.assert_frame_equal(_sorted_df(out), _sorted_df(exp),
+                                  check_dtype=False, rtol=1e-9)
+
+
+def test_mesh_global_aggregate(spark):
+    """Keyless two-phase aggregation: partials route to partition 0 over
+    an empty-key shuffle; exactly one output row survives the merge."""
+    t = pa.table({"v": np.arange(1000, dtype=float),
+                  "w": np.arange(1000) % 7})
+    spark.createDataFrame(t).createOrReplaceTempView("g")
+    sql = "SELECT SUM(v) AS s, COUNT(*) AS c, MAX(w) AS m FROM g"
+    out, ex = _mesh_run(spark, sql)
+    assert out is not None
+    df = out.to_pandas()
+    assert len(df) == 1
+    assert df.iloc[0, 0] == 999 * 500.0
+    assert df.iloc[0, 1] == 1000
+    assert df.iloc[0, 2] == 6
+
+
+def test_mesh_left_join_residual(spark):
+    """Residual predicate on a LEFT join: failing matches null the build
+    side but keep the probe row; duplicate build keys expand."""
+    left = pa.table({"k": np.arange(100) % 10, "x": np.arange(100)})
+    right = pa.table({"k": np.array([1, 1, 2, 3]),
+                      "y": np.array([10, 11, 20, 30])})
+    spark.createDataFrame(left).createOrReplaceTempView("lr_l")
+    spark.createDataFrame(right).createOrReplaceTempView("lr_r")
+    sql = ("SELECT l.k, COUNT(*) AS n, COUNT(r.y) AS m "
+           "FROM lr_l l LEFT JOIN lr_r r ON l.k = r.k AND r.y > 10 "
+           "GROUP BY l.k")
+    out, ex = _mesh_run(spark, sql)
+    assert out is not None
+    exp = _local_run(spark, sql)
+    pd.testing.assert_frame_equal(_sorted_df(out), _sorted_df(exp),
+                                  check_dtype=False, rtol=1e-9)
 
 
 def test_mesh_via_session_conf(spark):
@@ -210,3 +248,44 @@ def test_mesh_shuffle_join_string_keys(spark):
                                   check_dtype=False, rtol=1e-9)
     # every fact row matches: none may be dropped by mis-routing
     assert out.to_pandas()["c"].sum() == 3000
+
+
+def test_all_tpch_queries_use_mesh_path(spark):
+    """Coverage lock: every TPC-H query routes (at least a subtree)
+    through the SPMD mesh executor on the 8-device test mesh — the
+    round-4 review flagged mesh op coverage as a fallback cliff.
+    The session records _last_mesh_executor only when the mesh program
+    actually produced the result (session.py _try_mesh_execute)."""
+    from sail_tpu.benchmarks.tpch_data import register_tpch
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+
+    # Local-oracle comparison runs only for the historically
+    # fallback-prone classes (dup-key expansion, global agg, scalar
+    # subquery, non-inner residual, empty result) — comparing all 22
+    # doubles an already-long test; full local-path correctness is
+    # test_tpch.py's job.
+    oracle_qs = {3, 6, 11, 13, 20, 21}
+    spark.conf.set("spark.sail.execution.mesh", "auto")
+    try:
+        register_tpch(spark, sf=0.005)
+        fell_back = []
+        for q in sorted(QUERIES):
+            spark._last_mesh_executor = None
+            got = spark.sql(QUERIES[q]).toArrow()
+            if getattr(spark, "_last_mesh_executor", None) is None:
+                fell_back.append(q)
+                continue
+            if q not in oracle_qs:
+                continue
+            exp = _local_run(spark, QUERIES[q])
+            g, e = got.to_pandas(), exp.to_pandas()
+            g.columns = list(e.columns)
+            pd.testing.assert_frame_equal(
+                g.sort_values(list(g.columns), kind="stable")
+                 .reset_index(drop=True),
+                e.sort_values(list(e.columns), kind="stable")
+                 .reset_index(drop=True),
+                check_dtype=False, rtol=1e-6, atol=1e-9)
+        assert not fell_back, f"queries off the mesh path: {fell_back}"
+    finally:
+        spark.conf.reset("spark.sail.execution.mesh")

@@ -42,6 +42,87 @@ def test_rate_source_to_memory_sink(spark):
     assert not q.isActive
 
 
+def test_socket_source_to_memory_sink(spark):
+    """Socket text source: newline-delimited lines become `value` rows
+    (reference role: the socket streaming source)."""
+    import socket
+    import threading
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def feeder():
+        conn, _ = srv.accept()
+        with conn:
+            for i in range(20):
+                conn.sendall(f"line{i}\n".encode())
+                time.sleep(0.01)
+
+    th = threading.Thread(target=feeder, daemon=True)
+    th.start()
+    df = spark.readStream.format("socket") \
+        .option("host", "127.0.0.1").option("port", port).load()
+    assert df.isStreaming
+    q = df.writeStream.format("memory").queryName("sock") \
+        .trigger(processingTime="50 milliseconds").start()
+    try:
+        deadline = time.time() + 15
+        n = 0
+        while time.time() < deadline:
+            if spark.catalog.tableExists("sock"):
+                n = spark.sql("SELECT count(*) c FROM sock").toPandas().c[0]
+                if n >= 20:
+                    break
+            time.sleep(0.1)
+        assert q.exception is None
+        assert n >= 20
+        vals = spark.sql("SELECT value FROM sock").toPandas().value.tolist()
+        assert "line0" in vals and "line19" in vals
+    finally:
+        q.stop()
+        srv.close()
+
+
+def test_socket_source_reconnects_after_stop():
+    """close() resets the source so a restarted query reconnects."""
+    from sail_tpu.streaming import SocketStreamSource
+    import socket
+    import threading
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    port = srv.getsockname()[1]
+
+    def feeder():
+        for _ in range(2):
+            conn, _a = srv.accept()
+            with conn:
+                conn.sendall(b"hello\n")
+
+    threading.Thread(target=feeder, daemon=True).start()
+    src = SocketStreamSource("127.0.0.1", port)
+
+    def drain():
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            b = src.next_batch()
+            if b is not None:
+                return b
+            time.sleep(0.05)
+        raise AssertionError("no batch before deadline")
+
+    assert drain().column("value").to_pylist() == ["hello"]
+    src.close()
+    assert drain().column("value").to_pylist() == ["hello"]  # reconnected
+    src.close()
+    srv.close()
+
+
 def test_memory_source_foreach_batch(spark):
     schema = pa.schema([("k", pa.string()), ("v", pa.int64())])
     src = MemoryStreamSource(schema)

@@ -21,6 +21,7 @@ class _Collector:
 
     def __init__(self):
         self.spans = []
+        self.logs = []
         collector = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -30,6 +31,9 @@ class _Collector:
                 for rs in body.get("resourceSpans", []):
                     for ss in rs.get("scopeSpans", []):
                         collector.spans.extend(ss.get("spans", []))
+                for rl in body.get("resourceLogs", []):
+                    for sl in rl.get("scopeLogs", []):
+                        collector.logs.extend(sl.get("logRecords", []))
                 self.send_response(200)
                 self.end_headers()
 
@@ -69,6 +73,27 @@ def test_span_nesting_and_export(collector):
     assert by_name["inner"]["traceId"] == by_name["outer"]["traceId"]
     assert by_name["inner"]["parentSpanId"] == by_name["outer"]["spanId"]
     assert by_name["outer"].get("parentSpanId") is None
+
+
+def test_log_export_pipeline(collector):
+    """OTLP log records post to /v1/logs and correlate with the active
+    span; the stdlib logging bridge routes engine logs the same way."""
+    import logging
+
+    with tr.span("op") as s:
+        tr.log_event("WARNING", "shard skew detected", stage=3)
+        logging.getLogger("sail_tpu").error("boom %s", "x")
+    tr.flush()
+    time.sleep(0.2)
+    by_body = {r["body"]["stringValue"]: r for r in collector.logs}
+    assert "shard skew detected" in by_body
+    warn = by_body["shard skew detected"]
+    assert warn["severityNumber"] == 13
+    assert warn["traceId"] == s.trace_id       # span correlation
+    assert {"key": "stage", "value": {"intValue": "3"}} \
+        in warn["attributes"]
+    assert "boom x" in by_body                  # logging bridge
+    assert by_body["boom x"]["severityNumber"] == 17
 
 
 def test_traceparent_roundtrip():
