@@ -66,6 +66,14 @@ def main(argv=None):
         help="run the MCP (Model Context Protocol) server over stdio "
              "(reference: sail spark mcp-server)")
 
+    p_compat = sub.add_parser(
+        "compat",
+        help="scan Python files for PySpark API usage and report this "
+             "engine's support status (reference: pysail compatibility "
+             "check)")
+    p_compat.add_argument("paths", nargs="+",
+                          help="Python files or directories to scan")
+
     p_worker = sub.add_parser(
         "worker", help="run a standalone cluster worker process")
     p_worker.add_argument("--driver", required=True,
@@ -81,8 +89,13 @@ def main(argv=None):
 
     args = parser.parse_args(argv)
     if args.command in ("server", "shell", "flight", "worker",
-                        "mcp-server"):
+                        "mcp-server", "compat"):
         _ensure_backend()
+
+    if args.command == "compat":
+        from .compat import check_paths, format_report
+        print(format_report(check_paths(args.paths)))
+        return 0
 
     if args.command == "mcp-server":
         from .mcp_server import McpSparkServer

@@ -111,6 +111,18 @@ class SystemRegistry:
                     "rows_out": pa.array([r["rows_out"] for r in rows],
                                          pa.int64()),
                 })
+            if (database, name) == ("telemetry", "metrics"):
+                from ..metrics import REGISTRY
+                rows = REGISTRY.snapshot()
+                return pa.table({
+                    "name": pa.array([r["name"] for r in rows]),
+                    "type": pa.array([r["type"] for r in rows]),
+                    "unit": pa.array([r["unit"] for r in rows]),
+                    "attributes": pa.array(
+                        [r["attributes"] for r in rows]),
+                    "value": pa.array([r["value"] for r in rows],
+                                      pa.float64()),
+                })
             if (database, name) == ("cluster", "workers"):
                 rows = list(self.workers.values())
                 return pa.table({

@@ -1,0 +1,244 @@
+"""PySpark compatibility scanner.
+
+Reference role: pysail's compatibility tooling —
+python/pysail/examples/spark/compatibility_check.py scanning user code
+for PySpark API usage and cross-referencing hand-maintained
+data/compatibility/*.json status files. Redesign: instead of curated
+JSON that drifts, support status derives LIVE from this engine —
+DataFrame / Column / SparkSession / GroupedData / Catalog methods by
+class introspection, and ``pyspark.sql.functions`` calls by actually
+resolving a probe query through the planner (cached per name).
+
+CLI: ``python -m sail_tpu compat <file-or-dir> ...``
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+_FUNCTION_MODULES = ("pyspark.sql.functions", "pyspark.sql.connect.functions")
+
+
+# ---------------------------------------------------------------------------
+# source scanning (pure AST — user code is never imported or executed)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Usage:
+    kind: str          # "function" | "method"
+    name: str
+    file: str
+    line: int
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.func_aliases: Set[str] = set()    # modules imported as F
+        self.func_names: Set[str] = set()      # from functions import col
+        self.usages: List[Usage] = []
+
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            if a.name in _FUNCTION_MODULES:
+                self.func_aliases.add(a.asname or a.name.split(".")[-1])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module in ("pyspark.sql", "pyspark.sql.connect"):
+            for a in node.names:
+                if a.name == "functions":
+                    self.func_aliases.add(a.asname or "functions")
+        elif node.module in _FUNCTION_MODULES:
+            for a in node.names:
+                self.func_names.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name) and base.id in self.func_aliases:
+                self.usages.append(Usage("function", f.attr, self.path,
+                                         node.lineno))
+            else:
+                self.usages.append(Usage("method", f.attr, self.path,
+                                         node.lineno))
+        elif isinstance(f, ast.Name) and f.id in self.func_names:
+            self.usages.append(Usage("function", f.id, self.path,
+                                     node.lineno))
+        self.generic_visit(node)
+
+
+def scan_source(text: str, path: str = "<string>") -> List[Usage]:
+    s = _Scanner(path)
+    s.visit(ast.parse(text))
+    return s.usages
+
+
+def scan_paths(paths: Iterable[str]
+               ) -> Tuple[List[Usage], List[Tuple[str, str]]]:
+    """→ (usages, skipped) where skipped is [(path, reason)] for files
+    that are missing or do not parse."""
+    out: List[Usage] = []
+    skipped: List[Tuple[str, str]] = []
+
+    def one(fp: str):
+        try:
+            with open(fp, "r", encoding="utf-8") as fh:
+                out.extend(scan_source(fh.read(), fp))
+        except (OSError, SyntaxError, ValueError) as e:
+            skipped.append((fp, f"{type(e).__name__}: {e}"))
+
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        one(os.path.join(root, f))
+        else:
+            one(p)
+    return out, skipped
+
+
+# ---------------------------------------------------------------------------
+# live support oracle
+# ---------------------------------------------------------------------------
+
+_PROBE_ARGS = ("", "NULL", "'a'", "1", "1.5", "NULL, NULL", "'a', 'a'",
+               "'a', 1", "1, 1", "NULL, NULL, NULL", "'a', 1, 1")
+
+
+class SupportOracle:
+    """Support status straight from the engine, no curated data."""
+
+    def __init__(self, session=None):
+        self._session = session
+        self._fn_cache: Dict[str, str] = {}
+        self._methods: Optional[Dict[str, str]] = None
+
+    def _spark(self):
+        if self._session is None:
+            from .session import SparkSession
+            self._session = SparkSession(
+                {"spark.sail.execution.mesh": "off"})
+        return self._session
+
+    def method_surface(self) -> Dict[str, str]:
+        """method name -> owning class, for every public method of the
+        session-layer API classes."""
+        if self._methods is None:
+            from . import session as ss
+            self._methods = {}
+            for cls in (ss.DataFrame, ss.Column, ss.SparkSession,
+                        ss.GroupedData, ss.CoGroupedData, ss.Catalog,
+                        ss.DataFrameReader, ss.DataFrameWriter):
+                for m in dir(cls):
+                    if not m.startswith("_"):
+                        self._methods.setdefault(m, cls.__name__)
+        return self._methods
+
+    # method names shared with Python builtin types (str/list/dict/...):
+    # the untyped AST scan cannot tell ",".join(...) from df.join(...),
+    # so these report "ambiguous" instead of claiming PySpark usage
+    _BUILTIN_METHODS = frozenset(
+        m for t in (str, bytes, list, dict, set, tuple, frozenset)
+        for m in dir(t) if not m.startswith("_"))
+
+    def method_status(self, name: str) -> Tuple[str, str]:
+        """→ (status, detail). Methods outside the engine surface are
+        only *suspected* PySpark API (the scanner cannot type arbitrary
+        receivers), so they report as unknown, not unsupported."""
+        owner = self.method_surface().get(name)
+        if owner is not None:
+            if name in self._BUILTIN_METHODS:
+                return "ambiguous", owner
+            return "supported", owner
+        return "unknown", ""
+
+    def function_status(self, name: str) -> str:
+        """Resolve `SELECT name(args)` over a probe table for a range of
+        arities/types; any successful resolution → supported."""
+        key = name.lower()
+        cached = self._fn_cache.get(key)
+        if cached is not None:
+            return cached
+        from .plan.resolver import ResolutionError
+        from .sql import parse_one
+
+        spark = self._spark()
+        status = "unsupported"
+        for args in _PROBE_ARGS:
+            try:
+                spark._resolve(parse_one(f"SELECT {name}({args})"))
+                status = "supported"
+                break
+            except ResolutionError:
+                continue
+            except Exception:  # noqa: BLE001 — parse/type errors: next
+                continue
+        if status == "unsupported":
+            # aggregates/windows need a relation or OVER clause
+            for probe in (f"SELECT {name}(x) FROM (SELECT 1 AS x)",
+                          f"SELECT {name}() OVER () FROM (SELECT 1 AS x)",
+                          f"SELECT {name}(x) OVER () FROM (SELECT 1 AS x)"):
+                try:
+                    spark._resolve(parse_one(probe))
+                    status = "supported"
+                    break
+                except Exception:  # noqa: BLE001
+                    continue
+        self._fn_cache[key] = status
+        return status
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def check_paths(paths: Iterable[str], session=None) -> List[dict]:
+    """→ rows {kind, name, status, detail, count, locations}; files that
+    fail to read/parse become rows with kind "file" / status "skipped"."""
+    oracle = SupportOracle(session)
+    usages, skipped = scan_paths(paths)
+    grouped: Dict[Tuple[str, str], List[Usage]] = {}
+    for u in usages:
+        grouped.setdefault((u.kind, u.name), []).append(u)
+    rows = []
+    for (kind, name), us in sorted(grouped.items()):
+        if kind == "function":
+            status, detail = oracle.function_status(name), "functions"
+        else:
+            status, detail = oracle.method_status(name)
+            if status == "unknown":
+                continue  # arbitrary non-PySpark method calls: noise
+        rows.append({
+            "kind": kind, "name": name, "status": status,
+            "detail": detail, "count": len(us),
+            "locations": [f"{u.file}:{u.line}" for u in us[:5]],
+        })
+    for path, reason in skipped:
+        rows.append({"kind": "file", "name": path, "status": "skipped",
+                     "detail": reason, "count": 0, "locations": []})
+    return rows
+
+
+def format_report(rows: List[dict]) -> str:
+    if not rows:
+        return "no PySpark API usage found"
+    w = max(len(r["name"]) for r in rows) + 2
+    lines = [f"{'API':<{w}} {'kind':<10} {'status':<13} uses",
+             "-" * (w + 32)]
+    unsupported = 0
+    for r in rows:
+        lines.append(f"{r['name']:<{w}} {r['kind']:<10} "
+                     f"{r['status']:<13} {r['count']}")
+        if r["status"] == "unsupported":
+            unsupported += 1
+    lines.append("")
+    lines.append(f"{len(rows)} distinct APIs; "
+                 f"{unsupported} unsupported")
+    return "\n".join(lines)

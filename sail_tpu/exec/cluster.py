@@ -35,6 +35,7 @@ from .proto import control_plane_pb2 as pb
 from .actor import Actor
 from . import job_graph as jg
 from .. import tracing as tr
+from ..metrics import record as _record_metric
 
 _DRIVER_SERVICE = "sail_tpu.control.DriverService"
 _WORKER_SERVICE = "sail_tpu.control.WorkerService"
@@ -111,6 +112,8 @@ class _StreamStore:
                         f.write(buf)
                     stored[c] = ("disk", path)
                     self.spill_count += 1
+                    _record_metric("execution.spill_count", 1,
+                                   kind="shuffle")
                 else:
                     self._mem_bytes += len(buf)
                     stored[c] = buf

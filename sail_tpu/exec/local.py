@@ -24,6 +24,7 @@ import numpy as np
 import pyarrow as pa
 
 from ..columnar import arrow_interop as ai
+from ..metrics import record as _record_metric
 from ..columnar.batch import (Column, DeviceBatch, HostBatch, empty_batch,
                               physical_jnp_dtype, round_capacity)
 from ..ops import aggregate as aggk
@@ -1696,6 +1697,7 @@ class LocalExecutor:
 
         tmpdir = tempfile.mkdtemp(prefix="sail_join_spill_")
         self._last_join_spill_dir = tmpdir  # observable in tests
+        _record_metric("execution.spill_count", 1, kind="join")
         sides = []
         for name, table, h in (("l", lt, lh), ("r", rt, rh)):
             paths = []
@@ -1824,6 +1826,7 @@ class LocalExecutor:
 
         tmpdir = tempfile.mkdtemp(prefix="sail_sort_spill_")
         self._last_sort_spill_dir = tmpdir  # observable in tests
+        _record_metric("execution.spill_count", 1, kind="sort")
         try:
             # -- spill the wide rows to memory-mappable runs --
             run_rows = max(1, threshold // 2)

@@ -32,6 +32,13 @@ def _stat_sig(path: str) -> Optional[Tuple[float, int]]:
         return None
 
 
+from ..metrics import record as _record_metric
+
+
+def _record(metric: str) -> None:
+    _record_metric(metric, 1)
+
+
 class FileListingCache:
     def __init__(self):
         self._lock = threading.Lock()
@@ -58,19 +65,23 @@ class FileListingCache:
             entry = self._data.get(key)
             if entry is None:
                 self.misses += 1
+                _record("cache.file_listing.miss_count")
                 return None
             expires, validator, files = entry
             if time.time() > expires:
                 del self._data[key]
                 self.misses += 1
+                _record("cache.file_listing.miss_count")
                 return None
         if tuple(_stat_sig(p) for p in key) != validator:
             with self._lock:
                 self._data.pop(key, None)
                 self.misses += 1
+            _record("cache.file_listing.miss_count")
             return None
         with self._lock:
             self.hits += 1
+        _record("cache.file_listing.hit_count")
         return list(files)
 
     def put(self, paths: Sequence[str], files: List[str]) -> None:
@@ -104,8 +115,10 @@ class ParquetMetadataCache:
             entry = self._data.get(path)
             if entry is not None and entry[0] == sig:
                 self.hits += 1
+                _record("cache.parquet_metadata.hit_count")
                 return entry[1]
             self.misses += 1
+        _record("cache.parquet_metadata.miss_count")
         import pyarrow.parquet as pq
         md = pq.ParquetFile(path).metadata
         with self._lock:

@@ -1,0 +1,147 @@
+"""Registry-driven metrics: declared instruments, validated recording.
+
+Reference role: crates/sail-telemetry/src/metrics/ — a YAML registry of
+every instrument (name/type/unit/attributes) from which the reference
+generates typed Rust instruments (instruments.rs) at build time. The
+same contract here is enforced at record time: a metric name or
+attribute key outside the registry raises, so instruments cannot drift
+from their declarations. Values are queryable in-process through the
+``system.telemetry.metrics`` table and export as OTLP/HTTP JSON gauge
+datapoints (``/v1/metrics``) when an exporter is configured.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+_REGISTRY_PATH = os.path.join(os.path.dirname(__file__),
+                              "metrics_registry.yaml")
+
+
+@dataclass(frozen=True)
+class MetricDef:
+    name: str
+    description: str
+    type: str                      # counter | gauge
+    value_type: str
+    unit: str = ""
+    attributes: Tuple[str, ...] = ()
+
+
+class MetricsRegistry:
+    def __init__(self, defs: List[MetricDef]):
+        self._defs: Dict[str, MetricDef] = {d.name: d for d in defs}
+        self._values: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                           float] = {}
+        self._lock = threading.Lock()
+        self._dirty = False
+
+    @classmethod
+    def from_yaml(cls, path: str = _REGISTRY_PATH) -> "MetricsRegistry":
+        import yaml
+
+        with open(path, "r", encoding="utf-8") as f:
+            raw = yaml.safe_load(f) or []
+        defs = [MetricDef(
+            name=e["name"], description=e.get("description", ""),
+            type=str(e.get("type", "counter")).lower(),
+            value_type=str(e.get("value_type", "u64")),
+            unit=e.get("unit", ""),
+            attributes=tuple(e.get("attributes") or ()))
+            for e in raw]
+        return cls(defs)
+
+    def definitions(self) -> List[MetricDef]:
+        return list(self._defs.values())
+
+    def record(self, name: str, value, **attributes) -> None:
+        """Counter: accumulate. Gauge: last value wins. Unknown metric
+        names or attribute keys are declaration drift and raise."""
+        d = self._defs.get(name)
+        if d is None:
+            raise KeyError(f"metric {name!r} is not in the registry")
+        unknown = set(attributes) - set(d.attributes)
+        if unknown:
+            raise KeyError(
+                f"metric {name!r} does not declare attributes "
+                f"{sorted(unknown)}")
+        key = (name, tuple(sorted(
+            (k, str(v)) for k, v in attributes.items())))
+        with self._lock:
+            if d.type == "counter":
+                self._values[key] = self._values.get(key, 0) + value
+            else:
+                self._values[key] = value
+            self._dirty = True
+
+    def snapshot(self) -> List[dict]:
+        """One row per (metric, attribute-set) with its current value."""
+        with self._lock:
+            items = list(self._values.items())
+        out = []
+        for (name, attrs), value in items:
+            d = self._defs[name]
+            out.append({"name": name, "type": d.type, "unit": d.unit,
+                        "description": d.description,
+                        "attributes": json.dumps(dict(attrs)),
+                        "value": float(value)})
+        return sorted(out, key=lambda r: (r["name"], r["attributes"]))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+            self._dirty = False
+
+    def take_dirty(self) -> bool:
+        """True once per batch of changes — the exporter posts only when
+        something was recorded since the last flush."""
+        with self._lock:
+            d, self._dirty = self._dirty, False
+            return d
+
+    # -- OTLP/HTTP JSON export (/v1/metrics) ----------------------------
+    def otlp_payload(self, service_name: str = "sail-tpu") -> dict:
+        now = str(time.time_ns())
+        metrics = []
+        by_name: Dict[str, List] = {}
+        with self._lock:
+            for (name, attrs), value in self._values.items():
+                by_name.setdefault(name, []).append((attrs, value))
+        for name, points in sorted(by_name.items()):
+            d = self._defs[name]
+            dps = [{
+                "timeUnixNano": now,
+                "asDouble" if d.value_type.startswith("f")
+                else "asInt": value if d.value_type.startswith("f")
+                else str(int(value)),
+                "attributes": [
+                    {"key": k, "value": {"stringValue": v}}
+                    for k, v in attrs],
+            } for attrs, value in points]
+            body = {"name": name, "description": d.description,
+                    "unit": d.unit}
+            if d.type == "counter":
+                body["sum"] = {"dataPoints": dps, "isMonotonic": True,
+                               "aggregationTemporality": 2}  # cumulative
+            else:
+                body["gauge"] = {"dataPoints": dps}
+            metrics.append(body)
+        return {"resourceMetrics": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": service_name}}]},
+            "scopeMetrics": [{"scope": {"name": "sail_tpu"},
+                              "metrics": metrics}],
+        }]}
+
+
+REGISTRY = MetricsRegistry.from_yaml()
+
+
+def record(name: str, value, **attributes) -> None:
+    REGISTRY.record(name, value, **attributes)

@@ -39,6 +39,7 @@ from ..ops.hash import hash64
 from ..plan import nodes as pn
 from ..plan import rex as rx
 from ..plan.compiler import ExprCompiler, HostFallback
+from ..metrics import record as _record_metric
 from ..spec import data_type as dt
 from ..exec import job_graph as jg
 from .exchange import bucket_by_partition
@@ -365,6 +366,7 @@ class MeshExecutor:
             out_specs=(spec, spec, spec, spec))
         jitted = jax.jit(wrapped)
         self.last_exchanges = len(exchanges)
+        _record_metric("mesh.exchange_count", len(exchanges))
         self.last_hlo = None
         if self.config.get("spark.sail.mesh.captureHlo") == "true":
             flat_probe = self._flatten_leaf_arrays(leaves)

@@ -16,6 +16,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from .metrics import record as _record_metric
+
 try:  # the api package is always importable; an SDK may or may not be wired
     from opentelemetry import trace as _otel_trace
     _TRACER = _otel_trace.get_tracer("sail_tpu")
@@ -89,3 +91,7 @@ def operator_span(name: str, detail: str = ""):
         m.children = own
         parent.append(m)
         _local.collector = parent
+        _record_metric("execution.output_row_count", m.output_rows,
+                       operator=name)
+        _record_metric("execution.elapsed_compute_time",
+                       m.elapsed_ms / 1000.0, operator=name)

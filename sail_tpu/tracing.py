@@ -174,6 +174,10 @@ class OtlpHttpExporter:
             self._post(batch)
         if logs:
             self._post_logs(logs)
+        from .metrics import REGISTRY
+        if REGISTRY.take_dirty():
+            self._send("/v1/metrics",
+                       REGISTRY.otlp_payload(self.service_name))
 
     def shutdown(self):
         self._stop.set()

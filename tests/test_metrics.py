@@ -1,0 +1,120 @@
+"""Registry-driven metrics (reference role: sail-telemetry's
+registry.yaml + generated instruments — declaration-checked recording,
+system-table surface, OTLP /v1/metrics export)."""
+
+import json
+
+import pytest
+
+from sail_tpu import metrics as gm
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    gm.REGISTRY.reset()
+    yield
+    gm.REGISTRY.reset()
+
+
+def test_counter_accumulates_and_gauge_overwrites():
+    gm.record("execution.spill_count", 1, kind="join")
+    gm.record("execution.spill_count", 2, kind="join")
+    gm.record("mesh.exchange_count", 5)
+    gm.record("mesh.exchange_count", 3)
+    snap = {(r["name"], r["attributes"]): r["value"]
+            for r in gm.REGISTRY.snapshot()}
+    assert snap[("execution.spill_count",
+                 json.dumps({"kind": "join"}))] == 3
+    assert snap[("mesh.exchange_count", json.dumps({}))] == 3
+
+
+def test_unknown_metric_and_attribute_raise():
+    with pytest.raises(KeyError):
+        gm.record("execution.made_up", 1)
+    with pytest.raises(KeyError):
+        gm.record("execution.spill_count", 1, flavor="x")
+
+
+def test_registry_definitions_load():
+    names = {d.name for d in gm.REGISTRY.definitions()}
+    assert {"execution.output_row_count", "execution.spill_count",
+            "cache.file_listing.hit_count"} <= names
+
+
+def test_system_table_surface():
+    from sail_tpu import SparkSession
+
+    gm.record("execution.spill_count", 4, kind="sort")
+    spark = SparkSession({"spark.sail.execution.mesh": "off"})
+    try:
+        got = spark.sql(
+            "SELECT name, value FROM system.telemetry.metrics "
+            "WHERE name = 'execution.spill_count'").toPandas()
+        assert got.value.tolist() == [4.0]
+    finally:
+        spark.stop()
+
+
+def test_spill_records_metric(monkeypatch):
+    import numpy as np
+    import pandas as pd
+    from sail_tpu import SparkSession
+
+    monkeypatch.setenv("SAIL_EXECUTION__SORT_SPILL_ROWS", "100")
+    spark = SparkSession({"spark.sail.execution.mesh": "off"})
+    try:
+        df = pd.DataFrame({"v": np.random.default_rng(0).random(500)})
+        spark.createDataFrame(df).createOrReplaceTempView("t")
+        spark.sql("SELECT v FROM t ORDER BY v").toPandas()
+    finally:
+        spark.stop()
+    snap = {(r["name"], r["attributes"]): r["value"]
+            for r in gm.REGISTRY.snapshot()}
+    key = ("execution.spill_count", json.dumps({"kind": "sort"}))
+    assert snap.get(key, 0) >= 1
+
+
+def test_otlp_metrics_export():
+    """Gauges and cumulative sums post to /v1/metrics on flush."""
+    import threading
+    import time
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from sail_tpu import tracing as tr
+
+    seen = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            ln = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(ln))
+            if "resourceMetrics" in body:
+                for rm in body["resourceMetrics"]:
+                    for sm in rm["scopeMetrics"]:
+                        for m in sm["metrics"]:
+                            seen[m["name"]] = m
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    tr.configure_exporter(f"http://127.0.0.1:{srv.server_port}")
+    try:
+        gm.record("execution.spill_count", 7, kind="join")
+        gm.record("mesh.exchange_count", 2)
+        tr.flush()
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                "execution.spill_count" not in seen:
+            time.sleep(0.05)
+        ctr = seen["execution.spill_count"]
+        assert ctr["sum"]["isMonotonic"] is True
+        assert ctr["sum"]["dataPoints"][0]["asInt"] == "7"
+        g = seen["mesh.exchange_count"]
+        assert g["gauge"]["dataPoints"][0]["asInt"] == "2"
+    finally:
+        tr.configure_exporter(None)
+        srv.shutdown()
