@@ -142,11 +142,19 @@ _reg(["sort_array"], _t0, lambda *a: _sort_array(*a))
 _reg(["sequence"], lambda ts: dt.ArrayType(ts[0]),
      lambda *a: _sequence(*a))
 _reg(["shuffle"], _t0, lambda arr: list(arr))  # deterministic-friendly
-_reg(["get"], _elem, lambda arr, i: arr[i] if 0 <= i < len(arr) else None)
+_reg(["get"], _elem, lambda arr, i: _array_get(arr, i))
 _reg(["element_at"], lambda ts: (
     _elem(ts) if isinstance(ts[0], dt.ArrayType) else
     ts[0].value_type if isinstance(ts[0], dt.MapType) else dt.NullType()),
     lambda c, k: _element_at(c, k))
+# struct field / bracket access — output types come from the resolver
+# (_make_call special-cases), so the type fns here are placeholders
+_reg(["getfield"], lambda ts: dt.NullType(),
+     lambda s, n: s.get(n) if isinstance(s, dict) else None)
+_reg(["getitem"], lambda ts: dt.NullType(),
+     lambda c, k: _array_get(c, k))
+_reg(["getitem_map"], lambda ts: dt.NullType(),
+     lambda c, k: _getitem_map(c, k))
 _reg(["try_element_at"], lambda ts: (
     _elem(ts) if isinstance(ts[0], dt.ArrayType) else
     ts[0].value_type if isinstance(ts[0], dt.MapType) else dt.NullType()),
@@ -261,6 +269,29 @@ def _sequence(start, stop, step=None):
         out.append(v)
         v += step
     return out
+
+
+def _array_get(arr, i):
+    """Shared by get() and array [] access: 0-based, out of range ->
+    NULL (the resolver guarantees an integral index type)."""
+    i = int(i)
+    return arr[i] if 0 <= i < len(arr) else None
+
+
+def _getitem_map(c, k):
+    """Map [] access: missing key -> NULL. Maps surface as dicts or as
+    arrow pair-lists (unhashable keys)."""
+    if isinstance(c, dict):
+        if k in c:
+            return c[k]
+        for kk, v in c.items():  # numpy/int key-type mismatches
+            if kk == k:
+                return v
+        return None
+    for kk, v in c:
+        if kk == k:
+            return v
+    return None
 
 
 def _element_at(c, k, strict=True):

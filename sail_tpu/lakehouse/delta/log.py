@@ -148,10 +148,45 @@ class Snapshot:
         return cached
 
     def rename_to_logical(self, table):
-        """Physical parquet column names -> logical schema names."""
+        """Physical parquet column names -> logical schema names, top
+        level AND nested struct fields (list elements included)."""
         inv = {p: l for l, p in self.physical_names.items()}
-        return table.rename_columns(
+        table = table.rename_columns(
             [inv.get(n, n) for n in table.column_names])
+        if self.column_mapping_mode == "none":
+            return table
+        by_logical = {f["name"]: f for f in self._raw_fields()}
+        cols, changed = [], False
+        for name, col in zip(table.column_names, table.columns):
+            fj = by_logical.get(name)
+            new = col
+            if fj is not None and isinstance(fj.get("type"), dict):
+                new = _map_nested(col, fj["type"], to_logical=True)
+            changed = changed or new is not col
+            cols.append(new)
+        if not changed:
+            return table
+        import pyarrow as pa
+        return pa.table(dict(zip(table.column_names, cols)))
+
+    def rename_to_physical(self, table):
+        """Logical -> physical, the write-side mirror of
+        ``rename_to_logical`` (nested struct fields included)."""
+        if self.column_mapping_mode == "none":
+            return table
+        by_logical = {f["name"]: f for f in self._raw_fields()}
+        cols, names = [], []
+        for name, col in zip(table.column_names, table.columns):
+            fj = by_logical.get(name)
+            if fj is None:
+                names.append(name)
+                cols.append(col)
+                continue
+            names.append(self.physical_names.get(name, name))
+            cols.append(_map_nested(col, fj["type"], to_logical=False)
+                        if isinstance(fj.get("type"), dict) else col)
+        import pyarrow as pa
+        return pa.table(dict(zip(names, cols)))
 
     def partition_raw(self, pv: Dict[str, str], col: str):
         """partitionValues lookup: keys are physical under column
@@ -174,6 +209,55 @@ class Snapshot:
                     cached[f["name"]] = expr
             self.__dict__["_generation_cache"] = cached
         return cached
+
+
+def _field_phys(fj: dict) -> str:
+    meta = fj.get("metadata") or {}
+    return meta.get("delta.columnMapping.physicalName") or fj["name"]
+
+
+def _map_nested(col, type_json, to_logical: bool):
+    """Rebuild a (possibly chunked) arrow array so nested struct field
+    names follow the schema JSON: physical -> logical on read,
+    logical -> physical on write. Structs and lists recurse; map values
+    and other nesting pass through unchanged (returned as-is)."""
+    import pyarrow as pa
+
+    if not isinstance(type_json, dict):
+        return col
+    kind = type_json.get("type")
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    if kind == "struct":
+        st = col.type
+        children, names = [], []
+        for fj in type_json.get("fields", []):
+            src = _field_phys(fj) if to_logical else fj["name"]
+            dst = fj["name"] if to_logical else _field_phys(fj)
+            idx = st.get_field_index(src)
+            if idx < 0:
+                continue
+            children.append(_map_nested(col.field(idx), fj.get("type"),
+                                        to_logical))
+            names.append(dst)
+        if not children:
+            return col
+        return pa.StructArray.from_arrays(
+            children, names=names,
+            mask=col.is_null() if col.null_count else None)
+    if kind == "array":
+        if col.offset != 0 and col.null_count:
+            # ListArray.from_arrays rejects a null bitmap on a sliced
+            # array; take() compacts to offset 0
+            col = col.take(pa.array(range(len(col)), type=pa.int64()))
+        inner = _map_nested(col.values, type_json.get("elementType"),
+                            to_logical)
+        if inner is col.values:
+            return col
+        return pa.ListArray.from_arrays(
+            col.offsets, inner,
+            mask=col.is_null() if col.null_count else None)
+    return col
 
 
 _MAP_FIELDS = ("partitionValues", "configuration", "options")

@@ -125,16 +125,17 @@ class DeltaTable:
 
     # -- writes ----------------------------------------------------------
     def _write_data_files(self, table, partition_by: Sequence[str],
-                          physical_map: Optional[Dict[str, str]] = None
+                          mapping: Optional["Snapshot"] = None
                           ) -> List[AddFile]:
         import pyarrow.parquet as pq
 
-        if physical_map:
-            # column mapping: data files, stats keys, partition dirs and
-            # partitionValues keys all use physical names
-            table = table.rename_columns(
-                [physical_map.get(n, n) for n in table.column_names])
-            partition_by = [physical_map.get(c, c) for c in partition_by]
+        if mapping is not None:
+            # column mapping: data files (incl. nested struct fields),
+            # stats keys, partition dirs and partitionValues keys all
+            # use physical names
+            table = mapping.rename_to_physical(table)
+            pmap = mapping.physical_names
+            partition_by = [pmap.get(c, c) for c in partition_by]
         adds: List[AddFile] = []
         now = int(time.time() * 1000)
         if not partition_by:
@@ -207,9 +208,10 @@ class DeltaTable:
         finally:
             s.catalog.dropTempView(view)
 
-    def _mapping(self, snap) -> Optional[Dict[str, str]]:
-        return snap.physical_names \
-            if snap.column_mapping_mode != "none" else None
+    def _mapping(self, snap) -> Optional["Snapshot"]:
+        """The snapshot itself when column mapping is active (it carries
+        the nested-aware physical<->logical transforms), else None."""
+        return snap if snap.column_mapping_mode != "none" else None
 
     def append(self, table) -> int:
         snap = self.snapshot()

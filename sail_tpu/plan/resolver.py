@@ -1444,6 +1444,20 @@ class Resolver:
         if idx is not None:
             f = scope.fields[idx]
             return rx.BoundRef(idx, f.name, f.dtype, f.nullable)
+        # dotted struct access (s.a, t.s.a): resolve the longest column
+        # prefix, then descend through the struct with getfield
+        for cut in range(len(e.name) - 1, 0, -1):
+            pidx = scope.find(e.name[:cut])
+            if pidx is None:
+                continue
+            f = scope.fields[pidx]
+            if not isinstance(f.dtype, dt.StructType):
+                continue
+            r: rx.Rex = rx.BoundRef(pidx, f.name, f.dtype, f.nullable)
+            for part in e.name[cut:]:
+                r = self._make_call(
+                    "getfield", [r, rx.RLit(LV(dt.StringType(), part))])
+            return r
         if scope.parent is not None:
             pidx = scope.parent.find(e.name)
             if pidx is not None:
@@ -1467,6 +1481,47 @@ class Resolver:
         if name == "=":
             name = "=="
         arg_types = [rx.rex_type(a) for a in args]
+        # complex-type element access: the output type depends on the
+        # CONTAINER type (and for structs, the literal field name), which
+        # the arity-based registry cannot express
+        if name == "getfield" and len(args) == 2 and \
+                isinstance(arg_types[0], dt.StructType) and \
+                isinstance(args[1], rx.RLit):
+            fname = str(args[1].value.value)
+            for f in arg_types[0].fields:
+                if f.name.lower() == fname.lower():
+                    return rx.RCall(
+                        "getfield",
+                        (args[0], rx.RLit(LV(dt.StringType(), f.name))),
+                        f.data_type, True)
+            raise ResolutionError(
+                f"no field {fname!r} in "
+                f"{arg_types[0].simple_string()}")
+        if name == "getitem" and len(args) == 2:
+            t0 = arg_types[0]
+            if isinstance(t0, dt.StructType):
+                return self._make_call("getfield", args)
+            if isinstance(t0, dt.ArrayType):
+                if not arg_types[1].is_integer:
+                    raise ResolutionError(
+                        f"array index must be integral, got "
+                        f"{arg_types[1].simple_string()}")
+                return rx.RCall("getitem", tuple(args), t0.element_type,
+                                True)
+            if isinstance(t0, dt.MapType):
+                # maps surface as dicts OR pair-lists at runtime; a
+                # distinct name keeps array indexing unambiguous
+                return rx.RCall("getitem_map", tuple(args),
+                                t0.value_type, True)
+        if name in ("getfield", "getitem"):
+            # anything the special-cases above did not accept is an
+            # analysis error, not a silent NULL (the host registrations
+            # are execution impls only)
+            raise ResolutionError(
+                f"cannot access element of "
+                f"{arg_types[0].simple_string()}"
+                + ("" if name == "getitem"
+                   else " (field names must be literals)"))
         # numeric/comparison coercion
         if name in ("+", "-", "*", "/", "%", "div", "==", "!=", "<", "<=",
                     ">", ">=", "<=>", "pmod") and len(args) == 2:

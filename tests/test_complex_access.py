@@ -1,0 +1,85 @@
+"""Complex-type element access: dotted struct fields, [] on arrays
+(0-based), structs and maps (reference role: Spark's
+UnresolvedExtractValue resolution)."""
+
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession
+
+
+@pytest.fixture(scope="module")
+def spark():
+    s = SparkSession({"spark.sail.execution.mesh": "off"})
+    t = pa.table({
+        "s": pa.array([{"a": 5, "b": "x"}, {"a": 7, "b": "y"}, None],
+                      type=pa.struct([("a", pa.int64()),
+                                      ("b", pa.string())])),
+        "arr": pa.array([[1, 2], [3], [4, 5, 6]],
+                        type=pa.list_(pa.int64())),
+        "m": pa.array([[("k1", 10)], [("k2", 20)], []],
+                      type=pa.map_(pa.string(), pa.int64())),
+    })
+    s.createDataFrame(t).createOrReplaceTempView("v")
+    yield s
+    s.stop()
+
+
+def _col(spark, sql):
+    return spark.sql(sql).toPandas().iloc[:, 0].tolist()
+
+
+def test_dotted_struct_field(spark):
+    got = _col(spark, "SELECT s.a FROM v")
+    assert got[:2] == [5, 7] and got[2] != got[2]  # null -> NaN
+
+
+def test_qualified_dotted_struct_field(spark):
+    assert _col(spark, "SELECT v.s.b FROM v")[:2] == ["x", "y"]
+
+
+def test_bracket_struct_field(spark):
+    assert _col(spark, "SELECT s['a'] FROM v")[:2] == [5, 7]
+
+
+def test_struct_field_in_predicate(spark):
+    assert _col(spark, "SELECT s.b FROM v WHERE s.a > 5") == ["y"]
+
+
+def test_array_index_zero_based(spark):
+    assert _col(spark, "SELECT arr[0] FROM v") == [1, 3, 4]
+    assert _col(spark, "SELECT arr[2] FROM v")[2] == 6
+
+
+def test_array_index_out_of_range_is_null(spark):
+    import math
+    assert all(v is None or math.isnan(v)
+               for v in _col(spark, "SELECT arr[9] FROM v"))
+
+
+def test_map_key_access(spark):
+    got = _col(spark, "SELECT m['k1'] FROM v")
+    assert got[0] == 10
+    assert got[1] != got[1] and got[2] != got[2]  # missing -> null
+
+
+def test_expression_struct_field(spark):
+    assert _col(spark, "SELECT named_struct('a', 5).a")[0] == 5
+
+
+def test_unknown_struct_field_errors(spark):
+    from sail_tpu.plan.resolver import ResolutionError
+    with pytest.raises(ResolutionError):
+        spark.sql("SELECT s.nope FROM v").toArrow()
+
+
+def test_invalid_access_is_analysis_error_not_null(spark):
+    """Unsupported access shapes must raise, never return silent NULLs
+    (Spark analysis-error parity)."""
+    from sail_tpu.plan.resolver import ResolutionError
+    with pytest.raises(ResolutionError):
+        spark.sql("SELECT arr[1.5] FROM v").toArrow()    # fractional idx
+    with pytest.raises(ResolutionError):
+        spark.sql("SELECT s[lower('A')] FROM v").toArrow()  # non-literal
+    with pytest.raises(ResolutionError):
+        spark.sql("SELECT s.a.b FROM v").toArrow()  # field of a long

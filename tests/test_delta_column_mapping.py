@@ -135,6 +135,66 @@ def test_append_partitioned_mapped(tmp_path):
     assert added[0].path.startswith(f"{PHYS_P}=c/")
 
 
+def test_nested_struct_column_mapping(tmp_path):
+    """Nested struct fields carry their own physical names; reads map
+    them back to logical and appends write physical all the way down."""
+    path = str(tmp_path / "mn")
+    log_dir = os.path.join(path, "_delta_log")
+    os.makedirs(log_dir)
+    p_top = "col-top"
+    p_a, p_b = "col-a", "col-b"
+    schema = {"type": "struct", "fields": [
+        {"name": "s", "nullable": True,
+         "metadata": {"delta.columnMapping.id": 1,
+                      "delta.columnMapping.physicalName": p_top},
+         "type": {"type": "struct", "fields": [
+             {"name": "a", "type": "long", "nullable": True,
+              "metadata": {"delta.columnMapping.id": 2,
+                           "delta.columnMapping.physicalName": p_a}},
+             {"name": "b", "type": "string", "nullable": True,
+              "metadata": {"delta.columnMapping.id": 3,
+                           "delta.columnMapping.physicalName": p_b}},
+         ]}},
+    ]}
+    rel = "p1.parquet"
+    phys = pa.table({p_top: pa.array(
+        [{p_a: 1, p_b: "x"}, {p_a: 2, p_b: "y"}, None],
+        type=pa.struct([(p_a, pa.int64()), (p_b, pa.string())]))})
+    pq.write_table(phys, os.path.join(path, rel))
+    actions = [
+        {"protocol": {"minReaderVersion": 2, "minWriterVersion": 5}},
+        {"metaData": {
+            "id": str(uuid.uuid4()),
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": json.dumps(schema),
+            "partitionColumns": [],
+            "configuration": {"delta.columnMapping.mode": "name"},
+            "createdTime": 0}},
+        {"add": {"path": rel, "size": 1, "partitionValues": {},
+                 "modificationTime": 0, "dataChange": True}},
+    ]
+    with open(os.path.join(log_dir, "0" * 20 + ".json"), "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+    t = DeltaTable(path)
+    out = t.to_arrow()
+    assert out.column_names == ["s"]
+    vals = out.column("s").to_pylist()
+    assert {"a": 1, "b": "x"} in vals and None in vals
+    # append with LOGICAL nested names; the file must carry physical
+    t.append(pa.table({"s": pa.array(
+        [{"a": 9, "b": "z"}],
+        type=pa.struct([("a", pa.int64()), ("b", pa.string())]))}))
+    back = t.to_arrow().column("s").to_pylist()
+    assert {"a": 9, "b": "z"} in back
+    snap = t.snapshot()
+    for add in snap.files.values():
+        sch = pq.read_schema(os.path.join(path, add.path))
+        st = sch.field(p_top).type
+        assert {st.field(i).name for i in range(st.num_fields)} == \
+            {p_a, p_b}
+
+
 def test_mapped_table_sql_roundtrip(tmp_path):
     """Full SQL surface on a foreign mapped table: SELECT, positional
     INSERT VALUES, DELETE — data files stay physically named."""
