@@ -996,7 +996,11 @@ class LocalExecutor:
 
         key = self._op_key("sort", p.keys, p.limit,
                            tuple((f.name, f.dtype) for f in p.input.schema))
-        fn, _ = self._jitted(key, self._dict_objs(child), builder)
+        try:
+            fn, _ = self._jitted(key, self._dict_objs(child), builder)
+        except HostFallback:
+            # host-only sort keys (struct fields, host functions)
+            return self._sort_host_fallback(p, child)
         dev = child.device
         names = [_col_name(i) for i in range(len(dev.columns))]
         datas = [dev.columns[n].data for n in names]
@@ -1004,6 +1008,51 @@ class LocalExecutor:
         out_d, out_v, out_sel = fn(self._cols(child), dev.sel, datas, validities)
         cols = {n: Column(d, v, dev.columns[n].dtype)
                 for n, d, v in zip(names, out_d, out_v)}
+        out = DeviceBatch(cols, out_sel)
+        if p.limit is not None:
+            out = _shrink(out, p.limit)
+        return HostBatch(out, child.dicts)
+
+    def _sort_host_fallback(self, p: pn.SortExec,
+                            child: HostBatch) -> HostBatch:
+        """Sort keys the device compiler cannot express (struct fields,
+        host-only functions): key VALUES come from the host interpreter,
+        the permutation from a stable pandas sort, and the row gather
+        stays on device."""
+        import jax
+        import pandas as pd
+
+        from .host_interp import HostInterpreter
+
+        comp = self._compiler(child, p.input.schema)
+        interp = HostInterpreter(self, comp, child)
+        sel = np.asarray(jax.device_get(child.device.sel))
+        frame: Dict[str, object] = {"__dead": ~sel}
+        by = ["__dead"]          # dead rows sort to the end
+        asc = [True]
+        for i, k in enumerate(p.keys):
+            vals = interp.values(k.expr)
+            nulls_first = k.nulls_first if k.nulls_first is not None \
+                else k.ascending
+            isna = np.array([v is None for v in vals], dtype=bool)
+            frame[f"n{i}"] = ~isna if nulls_first else isna
+            by.append(f"n{i}")
+            asc.append(True)
+            fill = next((v for v in vals if v is not None), None)
+            frame[f"k{i}"] = [fill if v is None else v for v in vals]
+            by.append(f"k{i}")
+            asc.append(k.ascending)
+        perm = jnp.asarray(pd.DataFrame(frame).sort_values(
+            by, ascending=asc, kind="stable").index.to_numpy())
+        dev = child.device
+        cols = {nm: Column(c.data[perm],
+                           None if c.validity is None else c.validity[perm],
+                           c.dtype)
+                for nm, c in dev.columns.items()}
+        out_sel = dev.sel[perm]
+        if p.limit is not None:
+            idx = jnp.arange(out_sel.shape[0], dtype=jnp.int32)
+            out_sel = out_sel & (idx < p.limit)
         out = DeviceBatch(cols, out_sel)
         if p.limit is not None:
             out = _shrink(out, p.limit)

@@ -359,13 +359,17 @@ def _next_day(v, day_name):
 def _convert_tz(*args):
     if len(args) == 3:
         src, dst, ts = args
+        if src is None:  # explicit NULL source zone -> NULL
+            return None
     else:
+        # two-arg form: the source zone is the SESSION timezone
+        # (Spark convert_timezone(targetTz, sourceTs))
         src, dst, ts = None, args[0], args[1]
     t = _to_ts(ts)
     if t is None or dst is None:
         return None
     try:
-        src_zone = zoneinfo.ZoneInfo(src) if src else _UTC
+        src_zone = zoneinfo.ZoneInfo(src) if src else _session_zone()
         dst_zone = zoneinfo.ZoneInfo(dst)
     except Exception:  # noqa: BLE001
         return None
@@ -425,6 +429,10 @@ _reg(["unix_date"], _t(_I),
 _reg(["date_from_unix_date"], _t(_DATE),
      lambda n: datetime.date(1970, 1, 1) + datetime.timedelta(days=int(n)))
 _reg(["convert_timezone"], _t(_NTZ), _convert_tz)
+# event time of a GROUP BY window(...) bucket: window end minus 1 μs
+_reg(["window_time"], _t(_TS),
+     lambda w: None if not isinstance(w, dict) or w.get("end") is None
+     else _to_ts(w["end"]) - datetime.timedelta(microseconds=1))
 _reg(["from_utc_timestamp"], _t(_TS),
      lambda ts, tz: _shift_tz(ts, tz, to_local=True))
 _reg(["to_utc_timestamp"], _t(_TS),

@@ -287,6 +287,10 @@ class ExprCompiler:
         if else_c is not None:
             dicts.append(else_c.dictionary)
         merged, remaps = _merge_dicts(dicts)
+        # a NULL-typed branch has no dictionary: its codes are never
+        # valid, but the gather still needs a non-empty LUT
+        remaps = [rm if rm.size else np.zeros(1, dtype=np.int32)
+                  for rm in remaps]
 
         def fn(cols):
             if else_c is not None:
@@ -383,7 +387,9 @@ class ExprCompiler:
                 raise HostFallback("non-literal LIKE pattern")
             pattern = pat_dict[0]
             if name == "rlike":
-                rxp = re.compile(pattern)
+                # lenient Java-regex translation (same as the host path)
+                from ..functions.host_strings import _jre
+                rxp = re.compile(_jre(pattern))
                 match = rxp.search
             else:
                 flags = re.IGNORECASE if name == "ilike" else 0
@@ -719,12 +725,14 @@ def _merge_dicts(dicts: List[pa.Array]):
     offsets = []
     for d in dicts:
         offsets.append(len(all_vals))
-        all_vals.extend(_dict_strings(d))
+        if d is not None:  # NULL-typed branch: no dictionary
+            all_vals.extend(_dict_strings(d))
     enc = pc.dictionary_encode(pa.array(all_vals, type=pa.string()))
     codes = np.asarray(enc.indices)
     remaps = []
     for off, d in zip(offsets, dicts):
-        remaps.append(codes[off: off + len(d)].astype(np.int32))
+        n = 0 if d is None else len(d)
+        remaps.append(codes[off: off + n].astype(np.int32))
     return enc.dictionary, remaps
 
 

@@ -1021,6 +1021,9 @@ class Resolver:
                            pre_resolved=None):
         if plan.grouping_sets is not None or plan.rollup or plan.cube:
             return self._resolve_grouping_sets(plan, scope, outer)
+        rewritten = self._rewrite_time_window(plan)
+        if rewritten is not plan:
+            plan, pre_resolved = rewritten, None
         if pre_resolved is not None:
             child, cscope = pre_resolved
         else:
@@ -1114,27 +1117,192 @@ class Resolver:
         else:
             sets = list(plan.grouping_sets)
         branches = []
-        all_group = list(plan.group) if (plan.rollup or plan.cube) else \
-            list({g for s in sets for g in s})
+        if plan.rollup or plan.cube:
+            all_group = list(plan.group)
+        else:
+            # first-appearance order across the sets — grouping_id()'s
+            # bit order must be deterministic and leftmost-first
+            all_group = []
+            for s in sets:
+                for g in s:
+                    if g not in all_group:
+                        all_group.append(g)
         for s in sets:
-            # per grouping set: group by present keys; absent keys → NULL
+            # per grouping set: group by present keys; absent keys → NULL.
+            # grouping(col) / grouping_id(...) are per-branch CONSTANTS
+            # (1 bit per aggregated-away key) substituted before
+            # aggregation resolution (Spark: Analyzer ResolveGroupingSets)
             items = []
             for it in plan.aggregate:
+                it = self._subst_grouping(it, set(s), all_group)
                 items.append(self._null_out_absent(it, set(s), set(all_group)))
+            having = plan.having if plan.having is None else \
+                self._subst_grouping(plan.having, set(s), all_group)
             branches.append(sp.Aggregate(plan.input, tuple(s), tuple(items),
-                                         plan.having))
+                                         having))
         union: sp.QueryPlan = branches[0]
         for b in branches[1:]:
             union = sp.SetOperation(union, b, "union", all=True)
         return self.resolve_query(union, scope, outer)
 
+    @staticmethod
+    def _map_expr_children(e: ex.Expr, f) -> ex.Expr:
+        """Generic one-level rewrite: apply ``f`` to every Expr-typed
+        field (including tuples of Exprs and CaseWhen's branch pairs),
+        rebuilding the node only when something changed."""
+        if not dataclasses.is_dataclass(e):
+            return e
+
+        def map_val(v):
+            if isinstance(v, ex.Expr):
+                return f(v)
+            if isinstance(v, tuple):
+                if any(isinstance(x, (ex.Expr, tuple)) for x in v):
+                    return tuple(map_val(x) for x in v)
+            return v
+
+        changes = {}
+        for fld in dataclasses.fields(e):
+            v = getattr(e, fld.name)
+            nv = map_val(v)
+            if nv is not v and nv != v:
+                changes[fld.name] = nv
+        return dataclasses.replace(e, **changes) if changes else e
+
+    def _rewrite_time_window(self, plan: sp.Aggregate) -> sp.Aggregate:
+        """GROUP BY window(ts, dur[, slide[, offset]]) — Spark's
+        time-window grouping (TimeWindowing analyzer rule). The window
+        function rewrites into a primitive group key (window-start epoch
+        micros); select references to `window`, `window.start` and
+        `window.end` substitute into expressions OVER that key, so the
+        normal aggregate binding sees plain group expressions. Sliding
+        windows (slide < dur) explode each row into its covering windows
+        via sequence() + explode() before grouping."""
+        win = None
+        for g in plan.group:
+            gg = _unalias(g)
+            if isinstance(gg, ex.Function) and \
+                    isinstance(gg.name, str) and \
+                    gg.name.lower() == "window" and 2 <= len(gg.args) <= 4:
+                win = gg
+                break
+        if win is None:
+            return plan
+        from ..streaming import parse_delay
+
+        def dur_us(i, default=None):
+            if len(win.args) <= i:
+                return default
+            a = _unalias(win.args[i])
+            if not (isinstance(a, ex.Literal)
+                    and isinstance(a.value.value, str)):
+                raise ResolutionError(
+                    "window() durations must be string literals")
+            return int(round(parse_delay(a.value.value) * 1_000_000))
+
+        dur = dur_us(1)
+        slide = dur_us(2, dur)
+        off = dur_us(3, 0)
+        if not dur or not slide or slide > dur:
+            raise ResolutionError("invalid window() duration/slide")
+        ts_us = ex.Function("unix_micros", (
+            ex.Cast(win.args[0], dt.TimestampType("UTC")),))
+        # latest window start containing ts
+        latest = ex.Function("-", (ts_us, ex.Function(
+            "pmod", (ex.Function("-", (ts_us, ex.lit(off))),
+                     ex.lit(slide)))))
+        inp = plan.input
+        if slide == dur:
+            ws = latest  # tumbling: one window per row
+        else:
+            # sliding: explode the covering window starts
+            nwin = -(-dur // slide)
+            col = _fresh("win_us")
+            seq = ex.Function("sequence", (
+                ex.Function("-", (latest, ex.lit((nwin - 1) * slide))),
+                latest, ex.lit(slide)))
+            inp = sp.Project(inp, (ex.Star(),
+                                   ex.Alias(ex.Function("explode", (seq,)),
+                                            (col,))))
+            ws = ex.Attribute((col,))
+            if dur % slide != 0:
+                # the earliest exploded start may fall out of coverage
+                inp = sp.Filter(inp, ex.Function(
+                    ">", (ws, ex.Function("-", (ts_us, ex.lit(dur))))))
+        start = ex.Function("timestamp_micros", (ws,))
+        end = ex.Function("timestamp_micros", (
+            ex.Function("+", (ws, ex.lit(dur))),))
+        struct = ex.Function("named_struct", (
+            ex.lit("start"), start, ex.lit("end"), end))
+
+        def subst(e: ex.Expr) -> ex.Expr:
+            if isinstance(e, ex.Attribute):
+                parts = tuple(p.lower() for p in e.name)
+                if parts[-1] == "window":
+                    return ex.Alias(struct, ("window",))
+                if len(parts) >= 2 and parts[-2] == "window":
+                    if parts[-1] == "start":
+                        return start
+                    if parts[-1] == "end":
+                        return end
+                return e
+            if isinstance(e, ex.Function) and e == win:
+                return ex.Alias(struct, ("window",))
+            return self._map_expr_children(e, subst)
+
+        group = tuple(ws if _unalias(g) == win else g for g in plan.group)
+        items = []
+        for it in plan.aggregate:
+            new = subst(it)
+            if new is not it and not isinstance(new, ex.Alias):
+                # keep the original output name (window.start -> "start")
+                new = ex.Alias(new, (self._output_name(it),))
+            items.append(new)
+        having = None if plan.having is None else subst(plan.having)
+        return dataclasses.replace(plan, input=inp, group=group,
+                                   aggregate=tuple(items), having=having)
+
+    def _subst_grouping(self, e: ex.Expr, present: Set[ex.Expr],
+                        all_group: List[ex.Expr]) -> ex.Expr:
+        """Rewrite grouping()/grouping_id() to the branch's constant:
+        grouping(c) → 0/1; grouping_id(cols…) → bitmask, leftmost column
+        most significant, defaulting to all group columns."""
+        if isinstance(e, ex.Function):
+            fname = e.name.lower() if isinstance(e.name, str) else ""
+            if fname == "grouping" and len(e.args) == 1:
+                bit = 0 if _unalias(e.args[0]) in present else 1
+                return ex.Cast(ex.lit(bit), dt.ByteType())
+            if fname == "grouping_id":
+                cols = [_unalias(a) for a in e.args] or list(all_group)
+                gid = 0
+                for c in cols:
+                    gid = (gid << 1) | (0 if c in present else 1)
+                return ex.Cast(ex.lit(gid), dt.LongType())
+        return self._map_expr_children(
+            e, lambda c: self._subst_grouping(c, present, all_group))
+
+    def _null_absent_expr(self, e: ex.Expr, present: Set[ex.Expr],
+                          all_group: Set[ex.Expr]) -> ex.Expr:
+        """Deep substitution: references to group columns absent from
+        this grouping set become NULL — everywhere in the expression
+        EXCEPT inside aggregate arguments (sum(a) in the rollup total
+        still aggregates the real values)."""
+        if e in all_group and e not in present:
+            return ex.Cast(ex.Literal(LV.null()), dt.NullType())
+        if isinstance(e, ex.Function) and isinstance(e.name, str) and \
+                freg.is_aggregate(e.name.lower()):
+            return e
+        return self._map_expr_children(
+            e, lambda c: self._null_absent_expr(c, present, all_group))
+
     def _null_out_absent(self, item: ex.Expr, present: Set[ex.Expr],
                          all_group: Set[ex.Expr]) -> ex.Expr:
         name = self._output_name(item)
         base = _unalias(item)
-        if base in all_group and base not in present:
-            return ex.Alias(ex.Cast(ex.Literal(LV.null()), dt.NullType()), (name,))
-        return ex.Alias(base, (name,)) if not isinstance(item, ex.Alias) else item
+        new = self._null_absent_expr(base, present, all_group)
+        if new is base and isinstance(item, ex.Alias):
+            return item
+        return ex.Alias(new, (name,))
 
     def _subst_alias(self, e: ex.Expr, items: Sequence[ex.Expr]) -> ex.Expr:
         """Replace references to select-list aliases (HAVING/GROUP BY)."""
@@ -1749,6 +1917,22 @@ class Resolver:
                     float(args[0].value.value) ** float(args[1].value.value)))
             except (OverflowError, ValueError, TypeError):
                 pass
+        # constant-fold cbrt: XLA's compile-time folder computes it
+        # exp·log-based (cbrt(27) → 3.0000000000000004) while Java
+        # Math.cbrt — and XLA's own runtime kernel — are exact
+        if name == "cbrt" and len(args) == 1 and \
+                isinstance(args[0], rx.RLit) and \
+                args[0].value.value is not None:
+            try:
+                import math
+                x = float(args[0].value.value)
+                v = math.cbrt(x)
+                r = round(v)
+                if float(r) ** 3 == x:  # exact cube: Java Math.cbrt
+                    v = float(r)
+                return rx.RLit(LV.float64(v))
+            except (OverflowError, ValueError, TypeError):
+                pass
         # date_part/datepart with a literal part → the specific field fn
         if name in ("date_part", "datepart") and len(args) == 2 and \
                 isinstance(args[0], rx.RLit) and \
@@ -2000,7 +2184,10 @@ class _AggCollector:
                 from ..functions.udf import UdfExpr
                 return self._rewrite_udaf(UdfExpr(named, tuple(e.args)))
             args = [self.rewrite(a) for a in e.args]
-            return self.resolver._make_call(e.name, args)
+            # _finish_function (not _make_call): name rewrites and
+            # literal-dependent typing (named_struct field names,
+            # from_json schemas) apply inside aggregates too
+            return self.resolver._finish_function(e.name, args)
         if isinstance(e, ex.Between):
             child = self.rewrite(e.child)
             low = self.rewrite(e.low)

@@ -35,7 +35,7 @@ _RESERVED_STOP = {
     "CROSS", "ON", "USING", "AS", "WHEN", "THEN", "ELSE", "END", "AND", "OR",
     "NOT", "BETWEEN", "IN", "LIKE", "RLIKE", "ILIKE", "IS", "CASE", "BY",
     "ASC", "DESC", "NULLS", "FIRST", "LAST", "SELECT", "DISTINCT", "ALL",
-    "SEMI", "ANTI", "LATERAL", "NATURAL", "WINDOW", "DIV", "THEN", "OVER",
+    "SEMI", "ANTI", "LATERAL", "NATURAL", "DIV", "THEN", "OVER",
     "PARTITION", "ROWS", "RANGE", "PRECEDING", "FOLLOWING", "CURRENT",
     "UNBOUNDED", "ESCAPE", "SORT", "DISTRIBUTE", "CLUSTER", "SET", "MATCHED",
 }

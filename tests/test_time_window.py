@@ -1,0 +1,58 @@
+"""GROUP BY window(ts, dur[, slide]) — Spark's time-window grouping
+(reference role: the TimeWindowing analyzer rule; gold datetime #2-4)."""
+
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession
+
+
+@pytest.fixture(scope="module")
+def spark():
+    s = SparkSession({"spark.sail.execution.mesh": "off"})
+    s.conf.set("spark.sql.session.timeZone", "UTC")
+    s.sql(
+        "SELECT * FROM VALUES ('A1', '2021-01-01 00:00:00'), "
+        "('A1', '2021-01-01 00:04:30'), ('A1', '2021-01-01 00:06:00'), "
+        "('A2', '2021-01-01 00:01:00') AS tab(a, b)"
+    ).createOrReplaceTempView("ev")
+    yield s
+    s.stop()
+
+
+def test_tumbling_window(spark):
+    got = spark.sql(
+        "SELECT a, window.start, window.end, count(*) AS cnt FROM ev "
+        "GROUP BY a, window(b, '5 minutes') ORDER BY a, start").toPandas()
+    assert got.cnt.tolist() == [2, 1, 1]
+    assert got.iloc[0, 1] == pd.Timestamp("2021-01-01 00:00:00", tz="UTC")
+    assert got.iloc[0, 2] == pd.Timestamp("2021-01-01 00:05:00", tz="UTC")
+    assert got.iloc[1, 1] == pd.Timestamp("2021-01-01 00:05:00", tz="UTC")
+
+
+def test_sliding_window_explodes_rows(spark):
+    got = spark.sql(
+        "SELECT a, window.start, count(*) AS cnt FROM ev "
+        "GROUP BY a, window(b, '10 minutes', '5 minutes') "
+        "ORDER BY a, start").toPandas()
+    # every event lands in dur/slide = 2 windows
+    assert got[got.a == "A1"].cnt.tolist() == [2, 3, 1]
+    assert got[got.a == "A2"].cnt.tolist() == [1, 1]
+
+
+def test_window_struct_output_and_window_time(spark):
+    got = spark.sql(
+        "SELECT a, window.start AS s, window_time(window) AS wt, cnt "
+        "FROM (SELECT a, window, count(*) AS cnt FROM ev "
+        "      GROUP BY a, window(b, '5 minutes'))"
+        "ORDER BY a, s").toPandas()
+    # window_time = window.end - 1 microsecond
+    assert got.iloc[0].wt == pd.Timestamp("2021-01-01 00:04:59.999999",
+                                          tz="UTC")
+
+
+def test_window_as_plain_identifier_still_works(spark):
+    # WINDOW is no longer reserved: usable as a column alias
+    got = spark.sql("SELECT 1 AS window").toPandas()
+    assert got.columns.tolist() == ["window"]
+    assert got.iloc[0, 0] == 1
