@@ -1179,16 +1179,23 @@ class Resolver:
         windows (slide < dur) explode each row into its covering windows
         via sequence() + explode() before grouping."""
         win = None
+        kind = None
         for g in plan.group:
             gg = _unalias(g)
-            if isinstance(gg, ex.Function) and \
-                    isinstance(gg.name, str) and \
-                    gg.name.lower() == "window" and 2 <= len(gg.args) <= 4:
-                win = gg
-                break
+            if isinstance(gg, ex.Function) and isinstance(gg.name, str):
+                nm = gg.name.lower()
+                if nm == "window" and 2 <= len(gg.args) <= 4:
+                    win, kind = gg, "window"
+                    break
+                if nm == "session_window" and len(gg.args) == 2:
+                    win, kind = gg, "session"
+                    break
         if win is None:
             return plan
         from ..streaming import parse_delay
+
+        if kind == "session":
+            return self._rewrite_session_window(plan, win, parse_delay)
 
         def dur_us(i, default=None):
             if len(win.args) <= i:
@@ -1211,7 +1218,9 @@ class Resolver:
         latest = ex.Function("-", (ts_us, ex.Function(
             "pmod", (ex.Function("-", (ts_us, ex.lit(off))),
                      ex.lit(slide)))))
-        inp = plan.input
+        # Spark's TimeWindowing rule drops NULL event times
+        inp = sp.Filter(plan.input,
+                        ex.Function("isnotnull", (win.args[0],)))
         if slide == dur:
             ws = latest  # tumbling: one window per row
         else:
@@ -1256,6 +1265,79 @@ class Resolver:
             new = subst(it)
             if new is not it and not isinstance(new, ex.Alias):
                 # keep the original output name (window.start -> "start")
+                new = ex.Alias(new, (self._output_name(it),))
+            items.append(new)
+        having = None if plan.having is None else subst(plan.having)
+        return dataclasses.replace(plan, input=inp, group=group,
+                                   aggregate=tuple(items), having=having)
+
+    def _rewrite_session_window(self, plan: sp.Aggregate, win: ex.Function,
+                                parse_delay) -> sp.Aggregate:
+        """GROUP BY session_window(ts, gap) — sessionization as a plan
+        rewrite (the reference returns `not implemented` here): sort
+        each key's rows by event time with LAG, start a new session when
+        the gap to the previous event exceeds the threshold, number
+        sessions with a running SUM, then group by (keys, session id).
+        session.start = min(ts), session.end = max(ts) + gap. Literal
+        string gaps only (a dynamic per-row gap keeps the previous
+        unsupported behavior)."""
+        gap_arg = _unalias(win.args[1])
+        if not (isinstance(gap_arg, ex.Literal)
+                and isinstance(gap_arg.value.value, str)):
+            return plan
+        gap = int(round(parse_delay(gap_arg.value.value) * 1_000_000))
+        if gap <= 0:
+            raise ResolutionError("session_window gap must be positive")
+        ts_cast = ex.Cast(win.args[0], dt.TimestampType("UTC"))
+        us = ex.Function("unix_micros", (ts_cast,))
+        other = tuple(g for g in plan.group if _unalias(g) != win)
+        order = (ex.SortOrder(us),)
+        # Spark's SessionWindowing rule drops NULL event times
+        base = sp.Filter(plan.input,
+                         ex.Function("isnotnull", (win.args[0],)))
+        # window expressions must be top-level select items, so LAG and
+        # the running session SUM each get their own projection level
+        lag_col = _fresh("lag")
+        inner1 = sp.Project(base, (ex.Star(), ex.Alias(
+            ex.Window(ex.Function("lag", (us,)), other, order),
+            (lag_col,))))
+        # session ranges are half-open [start, last + gap): an event
+        # exactly `gap` after the previous one starts a NEW session
+        new_flag = ex.CaseWhen(
+            ((ex.Function(">=", (ex.Function(
+                "-", (us, ex.Attribute((lag_col,)))), ex.lit(gap))),
+              ex.lit(1)),),
+            ex.lit(0))
+        sess_col = _fresh("sess")
+        inp = sp.Project(inner1, (ex.Star(), ex.Alias(
+            ex.Window(ex.Function("sum", (new_flag,)), other, order),
+            (sess_col,))))
+        start = ex.Function("min", (ts_cast,))
+        end = ex.Function("timestamp_micros", (
+            ex.Function("+", (ex.Function("max", (us,)), ex.lit(gap))),))
+        struct = ex.Function("named_struct", (
+            ex.lit("start"), start, ex.lit("end"), end))
+
+        def subst(e: ex.Expr) -> ex.Expr:
+            if isinstance(e, ex.Attribute):
+                parts = tuple(p.lower() for p in e.name)
+                if parts[-1] == "session_window":
+                    return ex.Alias(struct, ("session_window",))
+                if len(parts) >= 2 and parts[-2] == "session_window":
+                    if parts[-1] == "start":
+                        return start
+                    if parts[-1] == "end":
+                        return end
+                return e
+            if isinstance(e, ex.Function) and e == win:
+                return ex.Alias(struct, ("session_window",))
+            return self._map_expr_children(e, subst)
+
+        group = other + (ex.Attribute((sess_col,)),)
+        items = []
+        for it in plan.aggregate:
+            new = subst(it)
+            if new is not it and not isinstance(new, ex.Alias):
                 new = ex.Alias(new, (self._output_name(it),))
             items.append(new)
         having = None if plan.having is None else subst(plan.having)

@@ -51,6 +51,50 @@ def test_window_struct_output_and_window_time(spark):
                                           tz="UTC")
 
 
+def test_session_window(spark):
+    """session_window(ts, gap): events within the gap merge, the session
+    end extends to last event + gap. (The reference engine returns
+    `not implemented` for this.)"""
+    spark.sql(
+        "SELECT * FROM VALUES ('A1', '2021-01-01 00:00:00'), "
+        "('A1', '2021-01-01 00:04:30'), ('A1', '2021-01-01 00:10:00'), "
+        "('A2', '2021-01-01 00:01:00') AS tab(a, b)"
+    ).createOrReplaceTempView("sev")
+    got = spark.sql(
+        "SELECT a, session_window.start, session_window.end, "
+        "count(*) AS cnt FROM sev "
+        "GROUP BY a, session_window(b, '5 minutes') "
+        "ORDER BY a, start").toPandas()
+    assert got.cnt.tolist() == [2, 1, 1]
+    assert got.iloc[0, 1] == pd.Timestamp("2021-01-01 00:00:00", tz="UTC")
+    # session end = LAST event + gap, not first
+    assert got.iloc[0, 2] == pd.Timestamp("2021-01-01 00:09:30", tz="UTC")
+    assert got.iloc[1, 1] == pd.Timestamp("2021-01-01 00:10:00", tz="UTC")
+
+
+def test_session_window_boundary_and_nulls(spark):
+    """Sessions are half-open: an event exactly `gap` later starts a new
+    session; NULL event times are dropped (Spark SessionWindowing)."""
+    got = spark.sql(
+        "SELECT count(*) AS c FROM VALUES ('A','2021-01-01 00:00:00'),"
+        "('A','2021-01-01 00:05:00') t(a,b) "
+        "GROUP BY a, session_window(b, '5 minutes')").toPandas()
+    assert got.c.tolist() == [1, 1]
+    got2 = spark.sql(
+        "SELECT count(*) AS c FROM VALUES ('A','2021-01-01 00:00:00'),"
+        "('A',CAST(NULL AS STRING)) t(a,b) "
+        "GROUP BY a, session_window(b, '5 minutes')").toPandas()
+    assert got2.c.tolist() == [1]
+
+
+def test_tumbling_window_drops_null_ts(spark):
+    got = spark.sql(
+        "SELECT count(*) AS c FROM VALUES ('A','2021-01-01 00:00:00'),"
+        "('A',CAST(NULL AS STRING)) t(a,b) "
+        "GROUP BY a, window(b, '5 minutes')").toPandas()
+    assert got.c.tolist() == [1]
+
+
 def test_window_as_plain_identifier_still_works(spark):
     # WINDOW is no longer reserved: usable as a column alias
     got = spark.sql("SELECT 1 AS window").toPandas()
