@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import calendar
 import datetime
+import functools
 import math
 import re
 import zoneinfo
@@ -433,6 +434,31 @@ _reg(["convert_timezone"], _t(_NTZ), _convert_tz)
 _reg(["window_time"], _t(_TS),
      lambda w: None if not isinstance(w, dict) or w.get("end") is None
      else _to_ts(w["end"]) - datetime.timedelta(microseconds=1))
+
+
+@functools.lru_cache(maxsize=256)
+def _parse_delay_cached(s: str):
+    from ..streaming import parse_delay
+    try:
+        return int(round(parse_delay(s) * 1_000_000))
+    except (ValueError, IndexError):
+        return None
+
+
+def _delay_micros(s):
+    """Per-row duration for dynamic session_window gaps: duration
+    strings ('5 minutes'), interval runtime values (timedelta), or raw
+    microsecond counts."""
+    if s is None:
+        return None
+    if isinstance(s, datetime.timedelta):
+        return int(s.total_seconds() * 1_000_000)
+    if isinstance(s, (int, float)):
+        return int(s)
+    return _parse_delay_cached(str(s))
+
+
+_reg(["__delay_micros"], _t(_L), _delay_micros)
 _reg(["from_utc_timestamp"], _t(_TS),
      lambda ts, tz: _shift_tz(ts, tz, to_local=True))
 _reg(["to_utc_timestamp"], _t(_TS),

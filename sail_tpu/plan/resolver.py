@@ -1275,46 +1275,70 @@ class Resolver:
                                 parse_delay) -> sp.Aggregate:
         """GROUP BY session_window(ts, gap) — sessionization as a plan
         rewrite (the reference returns `not implemented` here): sort
-        each key's rows by event time with LAG, start a new session when
-        the gap to the previous event exceeds the threshold, number
-        sessions with a running SUM, then group by (keys, session id).
-        session.start = min(ts), session.end = max(ts) + gap. Literal
-        string gaps only (a dynamic per-row gap keeps the previous
-        unsupported behavior)."""
+        each key's rows by event time; a row merges into the current
+        session iff it falls before the running MAX of prior window
+        ends [ts, ts+gap) (which handles per-row dynamic gaps — an
+        early long-gap event can absorb later short-gap ones — and
+        reduces to fixed-gap distance when gap is constant); a running
+        SUM numbers the sessions, then grouping by (keys, session id)
+        gives session.start = min(ts), session.end = max(ts + gap)."""
         gap_arg = _unalias(win.args[1])
-        if not (isinstance(gap_arg, ex.Literal)
-                and isinstance(gap_arg.value.value, str)):
-            return plan
-        gap = int(round(parse_delay(gap_arg.value.value) * 1_000_000))
-        if gap <= 0:
-            raise ResolutionError("session_window gap must be positive")
+        dynamic = False
+        if isinstance(gap_arg, ex.Literal) and \
+                isinstance(gap_arg.value.value, str):
+            gap = int(round(parse_delay(gap_arg.value.value) * 1_000_000))
+        elif isinstance(gap_arg, ex.Literal) and isinstance(
+                gap_arg.value.data_type, dt.DayTimeIntervalType):
+            gap = int(gap_arg.value.value)  # stored as microseconds
+        else:
+            # dynamic per-row gap: a duration expression evaluated per
+            # event (Spark allows CASE over duration strings/intervals)
+            dynamic = True
+        if dynamic:
+            gap_us: ex.Expr = ex.Function("__delay_micros",
+                                          (win.args[1],))
+        else:
+            if gap <= 0:
+                raise ResolutionError(
+                    "session_window gap must be positive")
+            gap_us = ex.lit(gap)
         ts_cast = ex.Cast(win.args[0], dt.TimestampType("UTC"))
         us = ex.Function("unix_micros", (ts_cast,))
         other = tuple(g for g in plan.group if _unalias(g) != win)
         order = (ex.SortOrder(us),)
-        # Spark's SessionWindowing rule drops NULL event times
-        base = sp.Filter(plan.input,
-                         ex.Function("isnotnull", (win.args[0],)))
-        # window expressions must be top-level select items, so LAG and
-        # the running session SUM each get their own projection level
-        lag_col = _fresh("lag")
+        # Spark's SessionWindowing rule drops NULL event times; dynamic
+        # gaps additionally drop rows whose gap is non-positive or
+        # unparseable (NULL > 0 filters false)
+        cond: ex.Expr = ex.Function("isnotnull", (win.args[0],))
+        if dynamic:
+            cond = ex.Function("and", (cond, ex.Function(
+                ">", (gap_us, ex.lit(0)))))
+        base = sp.Filter(plan.input, cond)
+        # A row joins the current session iff its time falls inside some
+        # earlier event's window [ts, ts+gap) — i.e. before the running
+        # MAX of prior window ends. This handles per-row gaps (an early
+        # long-gap event can absorb later short-gap ones) and reduces to
+        # the fixed-gap rule when gap is constant. Window expressions
+        # must be top-level select items, so the running max and the
+        # session-numbering SUM each get their own projection level.
+        prev_end_col = _fresh("prev_end")
         inner1 = sp.Project(base, (ex.Star(), ex.Alias(
-            ex.Window(ex.Function("lag", (us,)), other, order),
-            (lag_col,))))
-        # session ranges are half-open [start, last + gap): an event
-        # exactly `gap` after the previous one starts a NEW session
+            ex.Window(ex.Function("max", (
+                ex.Function("+", (us, gap_us)),)), other, order,
+                ex.WindowFrame("rows", None, -1)),
+            (prev_end_col,))))
+        # sessions are half-open: us == prev_end starts a NEW session
         new_flag = ex.CaseWhen(
-            ((ex.Function(">=", (ex.Function(
-                "-", (us, ex.Attribute((lag_col,)))), ex.lit(gap))),
-              ex.lit(1)),),
-            ex.lit(0))
+            ((ex.Function("<", (us, ex.Attribute((prev_end_col,)))),
+              ex.lit(0)),),
+            ex.lit(1))
         sess_col = _fresh("sess")
         inp = sp.Project(inner1, (ex.Star(), ex.Alias(
             ex.Window(ex.Function("sum", (new_flag,)), other, order),
             (sess_col,))))
         start = ex.Function("min", (ts_cast,))
         end = ex.Function("timestamp_micros", (
-            ex.Function("+", (ex.Function("max", (us,)), ex.lit(gap))),))
+            ex.Function("max", (ex.Function("+", (us, gap_us)),)),))
         struct = ex.Function("named_struct", (
             ex.lit("start"), start, ex.lit("end"), end))
 

@@ -95,6 +95,35 @@ def test_tumbling_window_drops_null_ts(spark):
     assert got.c.tolist() == [1]
 
 
+def test_session_window_dynamic_gap(spark):
+    """Per-row gap expressions: each key sessionizes under its own gap
+    (the reference errors on both static and dynamic session windows)."""
+    got = spark.sql(
+        "SELECT a, session_window.start AS st, count(*) AS cnt "
+        "FROM VALUES "
+        "('A1','2021-01-01 00:00:00'), ('A1','2021-01-01 00:04:30'), "
+        "('A2','2021-01-01 00:01:00'), ('A2','2021-01-01 00:04:30') "
+        "tab(a, b) GROUP BY a, session_window(b, "
+        "CASE WHEN a = 'A1' THEN '5 minutes' ELSE '1 minute' END) "
+        "ORDER BY a, st").toPandas()
+    # A1's two events merge under 5m; A2's split under 1m
+    assert got.cnt.tolist() == [2, 1, 1]
+
+
+def test_session_window_long_gap_absorbs_later_events(spark):
+    """An early long-gap event can absorb later short-gap ones — the
+    running-max-of-window-ends rule, not adjacent-lag distance."""
+    got = spark.sql(
+        "SELECT count(*) AS c FROM VALUES "
+        "('2021-01-01 00:00:00', '10 minutes'), "
+        "('2021-01-01 00:03:00', '1 minute'), "
+        "('2021-01-01 00:05:00', '1 minute') t(b, g) "
+        "GROUP BY session_window(b, g)").toPandas()
+    # 00:05 is 2m after 00:03 (gap 1m) but still inside 00:00's
+    # 10-minute window -> one session
+    assert got.c.tolist() == [3]
+
+
 def test_window_as_plain_identifier_still_works(spark):
     # WINDOW is no longer reserved: usable as a column alias
     got = spark.sql("SELECT 1 AS window").toPandas()
