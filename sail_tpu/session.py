@@ -328,7 +328,242 @@ class SparkSession:
             return empty
         if isinstance(cmd, sp.UncacheTable):
             return empty
+        if isinstance(cmd, sp.ShowCatalogs):
+            names = cm.list_catalogs() if hasattr(cm, "list_catalogs") \
+                else sorted(cm.providers)
+            if cmd.pattern:
+                import fnmatch
+                names = [n for n in names
+                         if fnmatch.fnmatch(n, cmd.pattern)]
+            return pa.table({"catalog": pa.array(names)})
+        if isinstance(cmd, sp.TruncateTable):
+            return self._truncate_table(cmd)
+        if isinstance(cmd, sp.RefreshTable):
+            from .io.cache import LISTING_CACHE, METADATA_CACHE
+            LISTING_CACHE.clear()
+            METADATA_CACHE.clear()
+            return empty
+        if isinstance(cmd, sp.ClearCache):
+            from .exec.local import clear_caches
+            from .io.cache import LISTING_CACHE, METADATA_CACHE
+            LISTING_CACHE.clear()
+            METADATA_CACHE.clear()
+            clear_caches()
+            return empty
+        if isinstance(cmd, sp.ShowCreateTable):
+            entry = cm.lookup_table(cmd.name)
+            if entry is None:
+                raise ValueError(f"table not found: {'.'.join(cmd.name)}")
+            cols = ",\n".join(
+                f"  {f.name} {f.data_type.simple_string().upper()}"
+                for f in entry.schema.fields) if entry.schema else ""
+            ddl = f"CREATE TABLE {'.'.join(cmd.name)} (\n{cols})"
+            if entry.format != "memory":
+                ddl += f"\nUSING {entry.format}"
+            if entry.paths:
+                ddl += f"\nLOCATION '{entry.paths[0]}'"
+            if entry.partition_by:
+                ddl += f"\nPARTITIONED BY ({', '.join(entry.partition_by)})"
+            return pa.table({"createtab_stmt": pa.array([ddl])})
+        if isinstance(cmd, sp.AnalyzeTable):
+            entry = cm.lookup_table(cmd.name)
+            if entry is None:
+                raise ValueError(f"table not found: {'.'.join(cmd.name)}")
+            if not cmd.noscan:
+                n = self._execute_query(
+                    sp.Aggregate(sp.ReadNamedTable(cmd.name), (),
+                                 (ex.Alias(ex.Function(
+                                     "count", (ex.Star(),)),
+                                     ("cnt",)),))).column(0)[0].as_py()
+                entry.options = tuple(
+                    [(k, v) for k, v in entry.options if k != "numRows"]
+                    + [("numRows", str(n))])
+            return empty
+        if isinstance(cmd, sp.AlterTable):
+            return self._alter_table(cmd)
+        if isinstance(cmd, sp.DescribeDatabase):
+            db = cmd.name[-1]
+            prov = cm.provider(cmd.name[-2]) if len(cmd.name) >= 2 \
+                else cm.provider()
+            info = prov.database_info(db) \
+                if hasattr(prov, "database_info") else None
+            if info is None:
+                raise ValueError(f"database not found: {db}")
+            rows = [("Namespace Name", db),
+                    ("Comment", info.get("comment") or ""),
+                    ("Location", info.get("location") or "")]
+            return pa.table({
+                "info_name": pa.array([r[0] for r in rows]),
+                "info_value": pa.array([r[1] for r in rows])})
+        if isinstance(cmd, sp.ShowTblProperties):
+            entry = cm.lookup_table(cmd.name)
+            if entry is None:
+                raise ValueError(f"table not found: {'.'.join(cmd.name)}")
+            props = dict(entry.options)
+            if cmd.key is not None:
+                props = {cmd.key: props.get(cmd.key)}
+            return pa.table({
+                "key": pa.array(sorted(props)),
+                "value": pa.array([props[k] for k in sorted(props)])})
+        if isinstance(cmd, sp.ShowPartitions):
+            return self._show_partitions(cmd)
+        if isinstance(cmd, sp.CommentOn):
+            if cmd.kind == "database":
+                prov = cm.provider(cmd.name[-2]) if len(cmd.name) >= 2 \
+                    else cm.provider()
+                # only the memory provider exposes a mutable database
+                # dict; remote catalogs rebuild info per call, so a
+                # write there would be silently lost
+                dbs = getattr(prov, "databases", None)
+                if not isinstance(dbs, dict) or \
+                        cmd.name[-1].lower() not in dbs:
+                    raise NotImplementedError(
+                        "COMMENT ON DATABASE is supported for the "
+                        "in-memory catalog only")
+                dbs[cmd.name[-1].lower()]["comment"] = cmd.comment
+            else:
+                entry = cm.lookup_table(cmd.name)
+                if entry is None:
+                    raise ValueError(
+                        f"table not found: {'.'.join(cmd.name)}")
+                entry.comment = cmd.comment
+            return empty
         raise NotImplementedError(f"command {type(cmd).__name__} not supported yet")
+
+    def _truncate_table(self, cmd: sp.TruncateTable) -> pa.Table:
+        cm = self.catalog_manager
+        entry = cm.lookup_table(cmd.name)
+        if entry is None:
+            raise ValueError(f"table not found: {'.'.join(cmd.name)}")
+        if entry.view_plan is not None:
+            raise ValueError(
+                f"cannot TRUNCATE a view: {'.'.join(cmd.name)}")
+        if entry.format == "memory":
+            if entry.data is not None:
+                entry.data = entry.data.slice(0, 0)
+            return pa.table({})
+        if entry.format == "delta" and entry.paths:
+            from .columnar.arrow_interop import spec_type_to_arrow
+            from .lakehouse.delta import DeltaTable
+            t = DeltaTable(entry.paths[0])
+            # overwrite with an EMPTY table built from the schema — no
+            # need to materialize the existing data
+            schema = t.snapshot().schema
+            t.overwrite(pa.table({
+                f.name: pa.array([], type=spec_type_to_arrow(f.data_type))
+                for f in schema.fields}))
+            return pa.table({})
+        raise NotImplementedError(
+            f"TRUNCATE on format {entry.format!r} not supported")
+
+    def _alter_table(self, cmd: sp.AlterTable) -> pa.Table:
+        import pyarrow as pa_mod
+
+        cm = self.catalog_manager
+        entry = cm.lookup_table(cmd.name)
+        if entry is None:
+            raise ValueError(f"table not found: {'.'.join(cmd.name)}")
+        if entry.view_plan is not None:
+            raise ValueError(
+                f"cannot ALTER a view: {'.'.join(cmd.name)}")
+        empty = pa_mod.table({})
+        if cmd.action == "rename":
+            # an unqualified new name stays in the SOURCE database
+            src_db = cmd.name[-2] if len(cmd.name) >= 2 \
+                else cm.current_database
+            new_db = cmd.new_name[-2] if len(cmd.new_name) >= 2 \
+                else src_db
+            cm.drop_table(cmd.name)
+            entry.name = (cm.current_catalog, new_db, cmd.new_name[-1])
+            cm.register_table(entry)
+            return empty
+        if cmd.action in ("set_properties", "unset_properties"):
+            props = dict(entry.options)
+            for k, v in cmd.properties:
+                if cmd.action == "set_properties":
+                    props[k] = v
+                else:
+                    props.pop(k, None)
+            entry.options = tuple(sorted(props.items()))
+            return empty
+        if entry.format != "memory" or entry.schema is None:
+            raise NotImplementedError(
+                f"ALTER TABLE {cmd.action} on format {entry.format!r} "
+                "not supported")
+        if cmd.action == "add_columns":
+            from .columnar.arrow_interop import spec_type_to_arrow
+            fields = list(entry.schema.fields)
+            for cname, ctype in cmd.columns:
+                fields.append(dt.StructField(cname, ctype, True))
+                if entry.data is not None:
+                    entry.data = entry.data.append_column(
+                        cname, pa_mod.nulls(entry.data.num_rows,
+                                            type=spec_type_to_arrow(ctype)))
+            entry.schema = dt.StructType(tuple(fields))
+            return empty
+        if cmd.action == "drop_columns":
+            drop = {c.lower() for c in cmd.column_names}
+            if any(p.lower() in drop for p in entry.partition_by):
+                raise ValueError("cannot drop a partition column")
+            entry.schema = dt.StructType(tuple(
+                f for f in entry.schema.fields
+                if f.name.lower() not in drop))
+            if entry.data is not None:
+                keep = [c for c in entry.data.column_names
+                        if c.lower() not in drop]
+                entry.data = entry.data.select(keep)
+            return empty
+        if cmd.action == "rename_column":
+            old, new = cmd.column_names
+            entry.schema = dt.StructType(tuple(
+                dt.StructField(new if f.name.lower() == old.lower()
+                               else f.name, f.data_type, f.nullable)
+                for f in entry.schema.fields))
+            if entry.data is not None:
+                entry.data = entry.data.rename_columns(
+                    [new if c.lower() == old.lower() else c
+                     for c in entry.data.column_names])
+            entry.partition_by = tuple(
+                new if p.lower() == old.lower() else p
+                for p in entry.partition_by)
+            return empty
+        raise NotImplementedError(f"ALTER TABLE action {cmd.action!r}")
+
+    def _show_partitions(self, cmd: sp.ShowPartitions) -> pa.Table:
+        cm = self.catalog_manager
+        entry = cm.lookup_table(cmd.name)
+        if entry is None:
+            raise ValueError(f"table not found: {'.'.join(cmd.name)}")
+        if not entry.partition_by:
+            raise ValueError(
+                f"table {'.'.join(cmd.name)} is not partitioned")
+        pcols = [c.lower() for c in entry.partition_by]
+        parts = set()
+        if entry.format == "delta" and entry.paths:
+            from .lakehouse.delta import DeltaTable
+            snap = DeltaTable(entry.paths[0]).snapshot()
+            for add in snap.files.values():
+                pv = dict(add.partition_values)
+                parts.add("/".join(
+                    f"{c}={snap.partition_raw(pv, c)}"
+                    for c in entry.partition_by))
+        elif entry.paths:
+            # hive-style directory layout: k=v path segments
+            from .io.formats import expand_paths
+            for f in expand_paths(entry.paths):
+                segs = [s for s in f.split(os.sep)
+                        if "=" in s and s.split("=", 1)[0].lower()
+                        in pcols]
+                if segs:
+                    parts.add("/".join(segs))
+        else:
+            table = self._execute_query(sp.ReadNamedTable(cmd.name))
+            combos = table.select(list(entry.partition_by)) \
+                .group_by(list(entry.partition_by)).aggregate([]) \
+                .to_pylist()
+            parts = {"/".join(f"{k}={v}" for k, v in c.items())
+                     for c in combos}
+        return pa.table({"partition": pa.array(sorted(parts))})
 
     @staticmethod
     def _generated_columns(entry) -> set:

@@ -430,6 +430,75 @@ class UncacheTable(CommandPlan):
 
 
 @dataclass(frozen=True)
+class ShowCatalogs(CommandPlan):
+    pattern: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class TruncateTable(CommandPlan):
+    name: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class RefreshTable(CommandPlan):
+    name: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class ClearCache(CommandPlan):
+    pass
+
+
+@dataclass(frozen=True)
+class ShowCreateTable(CommandPlan):
+    name: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class AnalyzeTable(CommandPlan):
+    name: Tuple[str, ...] = ()
+    columns: Tuple[str, ...] = ()
+    noscan: bool = False
+
+
+@dataclass(frozen=True)
+class AlterTable(CommandPlan):
+    """action: rename | add_columns | drop_columns | rename_column |
+    set_properties | unset_properties | set_comment"""
+    name: Tuple[str, ...] = ()
+    action: str = "rename"
+    new_name: Tuple[str, ...] = ()
+    columns: Tuple[Tuple[str, "DataType"], ...] = ()
+    column_names: Tuple[str, ...] = ()
+    properties: Tuple[Tuple[str, Optional[str]], ...] = ()
+    comment: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class DescribeDatabase(CommandPlan):
+    name: Tuple[str, ...] = ()
+    extended: bool = False
+
+
+@dataclass(frozen=True)
+class ShowTblProperties(CommandPlan):
+    name: Tuple[str, ...] = ()
+    key: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class ShowPartitions(CommandPlan):
+    name: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class CommentOn(CommandPlan):
+    kind: str = "table"  # table | database
+    name: Tuple[str, ...] = ()
+    comment: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class Delete(CommandPlan):
     table: Tuple[str, ...] = ()
     condition: Optional[Expr] = None

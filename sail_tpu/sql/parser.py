@@ -201,10 +201,117 @@ class Parser:
             return self.parse_update()
         if self.at_kw("MERGE"):
             return self.parse_merge()
+        if self.at_kw("TRUNCATE"):
+            self.advance()
+            self.accept_kw("TABLE")
+            return pl.TruncateTable(self.parse_qualified_name())
+        if self.at_kw("REFRESH"):
+            self.advance()
+            self.accept_kw("TABLE")
+            return pl.RefreshTable(self.parse_qualified_name())
+        if self.at_kw("CLEAR"):
+            self.advance()
+            self.expect_kw("CACHE")
+            return pl.ClearCache()
+        if self.at_kw("ANALYZE"):
+            self.advance()
+            self.expect_kw("TABLE")
+            name = self.parse_qualified_name()
+            self.expect_kw("COMPUTE")
+            self.expect_kw("STATISTICS")
+            cols: Tuple[str, ...] = ()
+            noscan = False
+            if self.accept_kw("NOSCAN"):
+                noscan = True
+            elif self.accept_kw("FOR"):
+                if self.accept_kw("ALL"):
+                    self.expect_kw("COLUMNS")
+                    cols = ("*",)
+                else:
+                    self.expect_kw("COLUMNS")
+                    cols = tuple(self.parse_ident_list())
+            return pl.AnalyzeTable(name, cols, noscan)
+        if self.at_kw("ALTER"):
+            return self.parse_alter()
+        if self.at_kw("COMMENT"):
+            self.advance()
+            self.expect_kw("ON")
+            kind = "database" if self.accept_kw(
+                "DATABASE", "SCHEMA", "NAMESPACE") else \
+                (self.expect_kw("TABLE") and "table")
+            name = self.parse_qualified_name()
+            self.expect_kw("IS")
+            if self.accept_kw("NULL"):
+                comment = None
+            else:
+                comment = self.advance().value
+            return pl.CommentOn(kind, name, comment)
         if self.at_kw("TABLE"):
             self.advance()
             return pl.ReadNamedTable(self.parse_qualified_name())
         raise self.error(f"unsupported statement start {self.tok_desc()}")
+
+    def parse_alter(self) -> pl.Plan:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        name = self.parse_qualified_name()
+        if self.accept_kw("RENAME"):
+            if self.accept_kw("TO"):
+                return pl.AlterTable(name, "rename",
+                                     new_name=self.parse_qualified_name())
+            self.expect_kw("COLUMN")
+            old = self.parse_identifier()
+            self.expect_kw("TO")
+            new = self.parse_identifier()
+            return pl.AlterTable(name, "rename_column",
+                                 column_names=(old, new))
+        if self.accept_kw("ADD"):
+            self.expect_kw("COLUMNS", "COLUMN")
+            cols = []
+            wrapped = self.accept_op("(")
+            while True:
+                cname = self.parse_identifier()
+                ctype = self.parse_data_type()
+                self.accept_kw("COMMENT") and self.advance()
+                cols.append((cname, ctype))
+                if not self.accept_op(","):
+                    break
+            if wrapped:
+                self.expect_op(")")
+            return pl.AlterTable(name, "add_columns", columns=tuple(cols))
+        if self.accept_kw("DROP"):
+            self.expect_kw("COLUMNS", "COLUMN")
+            wrapped = self.accept_op("(")
+            names = tuple(self.parse_ident_list())
+            if wrapped:
+                self.expect_op(")")
+            return pl.AlterTable(name, "drop_columns", column_names=names)
+        if self.accept_kw("SET"):
+            self.expect_kw("TBLPROPERTIES")
+            self.expect_op("(")
+            props = []
+            while True:
+                k = self.advance().value
+                self.expect_op("=")
+                v = self.advance().value
+                props.append((str(k), str(v)))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return pl.AlterTable(name, "set_properties",
+                                 properties=tuple(props))
+        if self.accept_kw("UNSET"):
+            self.expect_kw("TBLPROPERTIES")
+            self.expect_op("(")
+            keys = []
+            while True:
+                keys.append((str(self.advance().value), None))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return pl.AlterTable(name, "unset_properties",
+                                 properties=tuple(keys))
+        raise self.error("unsupported ALTER TABLE action")
 
     # ------------------------------------------------------------------
     # queries
@@ -1476,7 +1583,26 @@ class Parser:
 
     def parse_show(self) -> pl.Plan:
         self.expect_kw("SHOW")
-        kind = self.expect_kw("TABLES", "DATABASES", "SCHEMAS", "COLUMNS", "FUNCTIONS", "VIEWS")
+        kind = self.expect_kw("TABLES", "DATABASES", "SCHEMAS", "COLUMNS",
+                              "FUNCTIONS", "VIEWS", "CATALOGS", "CREATE",
+                              "TBLPROPERTIES", "PARTITIONS")
+        if kind == "CATALOGS":
+            pattern = None
+            if self.accept_kw("LIKE"):
+                pattern = self.advance().value
+            return pl.ShowCatalogs(pattern)
+        if kind == "CREATE":
+            self.expect_kw("TABLE")
+            return pl.ShowCreateTable(self.parse_qualified_name())
+        if kind == "TBLPROPERTIES":
+            name = self.parse_qualified_name()
+            key = None
+            if self.accept_op("("):
+                key = str(self.advance().value)
+                self.expect_op(")")
+            return pl.ShowTblProperties(name, key)
+        if kind == "PARTITIONS":
+            return pl.ShowPartitions(self.parse_qualified_name())
         if kind in ("DATABASES", "SCHEMAS"):
             pattern = None
             if self.accept_kw("LIKE"):
@@ -1504,6 +1630,10 @@ class Parser:
         self.expect_kw("DESCRIBE", "DESC")
         if self.accept_kw("QUERY"):
             return pl.Explain(self.parse_query(), "simple")
+        if self.accept_kw("DATABASE", "SCHEMA", "NAMESPACE"):
+            extended = self.accept_kw("EXTENDED") is not None
+            return pl.DescribeDatabase(self.parse_qualified_name(),
+                                       extended)
         self.accept_kw("TABLE")
         extended = self.accept_kw("EXTENDED", "FORMATTED") is not None
         return pl.DescribeTable(self.parse_qualified_name(), extended)

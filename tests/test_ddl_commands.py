@@ -1,0 +1,112 @@
+"""DDL / utility command surface (reference role: sail-common's command
+spec nodes + sail-plan's command resolution — SHOW/ALTER/ANALYZE/
+TRUNCATE/REFRESH/COMMENT)."""
+
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession
+
+
+@pytest.fixture()
+def spark():
+    s = SparkSession({"spark.sail.execution.mesh": "off"})
+    yield s
+    s.stop()
+
+
+def test_truncate_and_reinsert(spark):
+    spark.sql("CREATE TABLE t (a INT, b STRING)")
+    spark.sql("INSERT INTO t VALUES (1, 'x'), (2, 'y')")
+    spark.sql("TRUNCATE TABLE t")
+    assert spark.sql("SELECT count(*) FROM t").toPandas().iloc[0, 0] == 0
+    spark.sql("INSERT INTO t VALUES (3, 'z')")
+    got = spark.sql("SELECT a, b FROM t").toPandas()
+    assert got.values.tolist() == [[3, "z"]]
+
+
+def test_show_catalogs_and_create_table(spark):
+    cats = spark.sql("SHOW CATALOGS").toPandas()
+    assert "spark_catalog" in cats.catalog.tolist()
+    spark.sql("CREATE TABLE sc (a INT) ")
+    ddl = spark.sql("SHOW CREATE TABLE sc").toPandas().iloc[0, 0]
+    assert ddl.startswith("CREATE TABLE sc") and "a INT" in ddl
+
+
+def test_analyze_and_tblproperties(spark):
+    spark.sql("CREATE TABLE an (a INT)")
+    spark.sql("INSERT INTO an VALUES (1), (2), (3)")
+    spark.sql("ANALYZE TABLE an COMPUTE STATISTICS")
+    props = spark.sql("SHOW TBLPROPERTIES an").toPandas()
+    assert dict(zip(props.key, props.value))["numRows"] == "3"
+    spark.sql("ALTER TABLE an SET TBLPROPERTIES ('owner' = 'me')")
+    props = spark.sql("SHOW TBLPROPERTIES an ('owner')").toPandas()
+    assert props.value.tolist() == ["me"]
+    spark.sql("ALTER TABLE an UNSET TBLPROPERTIES ('owner')")
+    props = spark.sql("SHOW TBLPROPERTIES an").toPandas()
+    assert "owner" not in props.key.tolist()
+
+
+def test_alter_table_schema_evolution(spark):
+    spark.sql("CREATE TABLE ae (a INT)")
+    spark.sql("INSERT INTO ae VALUES (1)")
+    spark.sql("ALTER TABLE ae ADD COLUMNS (b STRING, c DOUBLE)")
+    got = spark.sql("SELECT a, b, c FROM ae").toPandas()
+    assert got.a.tolist() == [1] and got.b.isna().all()
+    spark.sql("ALTER TABLE ae RENAME COLUMN b TO label")
+    assert "label" in spark.sql("SELECT * FROM ae").toPandas().columns
+    spark.sql("ALTER TABLE ae DROP COLUMN c")
+    assert "c" not in spark.sql("SELECT * FROM ae").toPandas().columns
+
+
+def test_alter_table_rename(spark):
+    spark.sql("CREATE TABLE old_name (a INT)")
+    spark.sql("INSERT INTO old_name VALUES (7)")
+    spark.sql("ALTER TABLE old_name RENAME TO new_name")
+    assert spark.sql("SELECT a FROM new_name").toPandas().a.tolist() == [7]
+    from sail_tpu.plan.resolver import ResolutionError
+    with pytest.raises(Exception):
+        spark.sql("SELECT a FROM old_name").toPandas()
+
+
+def test_describe_database_and_comment(spark):
+    info = spark.sql("DESCRIBE DATABASE default").toPandas()
+    assert "Namespace Name" in info.info_name.tolist()
+    spark.sql("CREATE TABLE ct (a INT)")
+    spark.sql("COMMENT ON TABLE ct IS 'my table'")
+    entry = spark.catalog_manager.lookup_table(("ct",))
+    assert entry.comment == "my table"
+
+
+def test_refresh_and_clear_cache(spark, tmp_path):
+    import pyarrow.parquet as pq
+
+    from sail_tpu.io.cache import LISTING_CACHE
+
+    p = str(tmp_path / "r.parquet")
+    pq.write_table(pa.table({"x": [1, 2]}), p)
+    spark.sql(f"CREATE TABLE rt USING parquet LOCATION '{p}'")
+    spark.sql("SELECT * FROM rt").toPandas()
+    spark.sql("REFRESH TABLE rt")   # must not fail; clears listings
+    spark.sql("CLEAR CACHE")
+    assert spark.sql("SELECT sum(x) FROM rt").toPandas().iloc[0, 0] == 3
+
+
+def test_views_are_protected_from_table_ddl(spark):
+    spark.sql("CREATE TABLE base (a INT)")
+    spark.sql("CREATE VIEW v AS SELECT a FROM base")
+    with pytest.raises(Exception, match="view"):
+        spark.sql("TRUNCATE TABLE v")
+    with pytest.raises(Exception, match="view"):
+        spark.sql("ALTER TABLE v RENAME TO w")
+
+
+def test_show_partitions(spark, tmp_path):
+    spark.createDataFrame(pa.table({
+        "k": ["a", "a", "b"], "v": [1, 2, 3]})).write \
+        .partitionBy("k").parquet(str(tmp_path / "pt"))
+    spark.sql(f"CREATE TABLE pt USING parquet LOCATION '{tmp_path}/pt'")
+    entry = spark.catalog_manager.lookup_table(("pt",))
+    entry.partition_by = ("k",)
+    parts = spark.sql("SHOW PARTITIONS pt").toPandas()
+    assert parts.partition.tolist() == ["k=a", "k=b"]
