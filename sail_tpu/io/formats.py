@@ -67,17 +67,22 @@ def infer_schema(fmt: str, paths: Sequence[str], options: Dict[str, str]) -> dt.
         for n, c in zip(table.column_names, table.columns)))
 
 
+def iso_to_ms(ts: str) -> int:
+    """ISO timestamp string -> epoch millis (naive values default to
+    UTC — the shared time-travel convention for Delta and Iceberg)."""
+    import datetime
+
+    dtv = datetime.datetime.fromisoformat(ts)
+    if dtv.tzinfo is None:
+        dtv = dtv.replace(tzinfo=datetime.timezone.utc)
+    return int(dtv.timestamp() * 1000)
+
+
 def _delta_travel(options: Dict[str, str]):
     opts = {k.lower(): v for k, v in options.items()}
     version = opts.get("versionasof")
     ts = opts.get("timestampasof")
-    ts_ms = None
-    if ts is not None:
-        import datetime
-        dtv = datetime.datetime.fromisoformat(ts)
-        if dtv.tzinfo is None:
-            dtv = dtv.replace(tzinfo=datetime.timezone.utc)
-        ts_ms = int(dtv.timestamp() * 1000)
+    ts_ms = iso_to_ms(ts) if ts is not None else None
     return (int(version) if version is not None else None), ts_ms
 
 

@@ -262,12 +262,20 @@ class Resolver:
     def _resolve_read(self, plan: sp.ReadNamedTable, ctes, outer):
         key = plan.name[-1].lower()
         if len(plan.name) == 1 and key in ctes:
+            if plan.temporal:
+                raise ResolutionError(
+                    f"time travel is not supported on a CTE: "
+                    f"{plan.name[-1]}")
             cte = ctes[key]
             node, cscope = self.resolve_query(
                 cte.plan, Scope([], outer, cte.ctes), outer)
             fields = [dataclasses.replace(f, qualifiers=(plan.name[-1],))
                       for f in cscope.fields]
             return node, Scope(fields, outer, ctes)
+        if plan.temporal and len(plan.name) == 3 and \
+                plan.name[0].lower() == "system":
+            raise ResolutionError(
+                "time travel is not supported on system tables")
         if len(plan.name) == 3 and plan.name[0].lower() == "system":
             from ..catalog.system import SYSTEM
             from ..columnar.arrow_interop import arrow_type_to_spec
@@ -288,6 +296,10 @@ class Resolver:
         if entry is None:
             raise ResolutionError(f"table not found: {'.'.join(plan.name)}")
         if entry.view_plan is not None:
+            if plan.temporal:
+                raise ResolutionError(
+                    f"time travel is not supported on views: "
+                    f"{'.'.join(plan.name)}")
             node, cscope = self.resolve_query(entry.view_plan, Scope([], None, {}), None)
             fields = [dataclasses.replace(f, qualifiers=(plan.name[-1],))
                       for f in cscope.fields]
@@ -298,6 +310,32 @@ class Resolver:
         # apply first; per-read options override them
         opts = dict(entry.options)
         opts.update(dict(plan.options))
+        if plan.temporal:
+            # SQL time travel (VERSION|TIMESTAMP AS OF) → the reader's
+            # time-travel scan options; malformed specs are analysis
+            # errors, not reader-time crashes
+            from ..io.formats import iso_to_ms
+            kind, _, value = plan.temporal.partition(":")
+            if entry.format not in ("delta", "iceberg"):
+                raise ResolutionError(
+                    f"time travel is not supported for format "
+                    f"{entry.format!r}")
+            try:
+                if kind == "version":
+                    int(value)
+                else:
+                    value_ms = str(iso_to_ms(value))
+            except (ValueError, TypeError) as e:
+                raise ResolutionError(
+                    f"invalid time travel spec "
+                    f"{plan.temporal!r}: {e}")
+            if entry.format == "delta":
+                opts["versionasof" if kind == "version"
+                     else "timestampasof"] = value
+            elif kind == "version":
+                opts["snapshot-id"] = value
+            else:
+                opts["as-of-timestamp"] = value_ms
         node = pn.ScanExec(schema, entry.data, tuple(entry.paths), entry.format,
                            tuple(sorted(opts.items())), None,
                            ".".join(plan.name))

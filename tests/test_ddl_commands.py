@@ -92,6 +92,48 @@ def test_refresh_and_clear_cache(spark, tmp_path):
     assert spark.sql("SELECT sum(x) FROM rt").toPandas().iloc[0, 0] == 3
 
 
+def test_sql_time_travel_delta_and_iceberg(spark, tmp_path):
+    """VERSION/TIMESTAMP AS OF must actually pin the snapshot (it used
+    to parse and silently read the latest data)."""
+    from sail_tpu.lakehouse.delta import DeltaTable
+    from sail_tpu.lakehouse.iceberg import IcebergTable
+
+    dp = str(tmp_path / "d")
+    t = DeltaTable(dp)
+    t.create(pa.table({"x": [1]}))
+    t.append(pa.table({"x": [2]}))
+    spark.sql(f"CREATE TABLE dtt USING delta LOCATION '{dp}'")
+    assert sorted(spark.sql(
+        "SELECT x FROM dtt").toPandas().x) == [1, 2]
+    assert spark.sql(
+        "SELECT x FROM dtt VERSION AS OF 0").toPandas().x.tolist() == [1]
+
+    ip = str(tmp_path / "i")
+    it = IcebergTable(ip)
+    it.create(pa.table({"y": [10]}))
+    sid0 = it.metadata()["current-snapshot-id"]
+    it.append(pa.table({"y": [20]}))
+    spark.sql(f"CREATE TABLE itt USING iceberg LOCATION '{ip}'")
+    assert spark.sql(
+        f"SELECT y FROM itt VERSION AS OF {sid0}"
+    ).toPandas().y.tolist() == [10]
+    # unsupported targets error instead of silently ignoring the spec
+    spark.createDataFrame(pa.table({"z": [1]})) \
+        .createOrReplaceTempView("mv")
+    with pytest.raises(Exception, match="time travel"):
+        spark.sql("SELECT z FROM mv VERSION AS OF 1").toPandas()
+    with pytest.raises(Exception, match="time travel"):
+        spark.sql("WITH c AS (SELECT 1 AS z) "
+                  "SELECT z FROM c VERSION AS OF 1").toPandas()
+    # malformed specs are analysis errors, not reader crashes
+    from sail_tpu.plan.resolver import ResolutionError
+    with pytest.raises(ResolutionError, match="invalid time travel"):
+        spark.sql("SELECT y FROM itt VERSION AS OF 'abc'").toPandas()
+    with pytest.raises(ResolutionError, match="invalid time travel"):
+        spark.sql(
+            "SELECT y FROM itt TIMESTAMP AS OF 'garbage'").toPandas()
+
+
 def test_views_are_protected_from_table_ddl(spark):
     spark.sql("CREATE TABLE base (a INT)")
     spark.sql("CREATE VIEW v AS SELECT a FROM base")
