@@ -141,11 +141,15 @@ def read_table(fmt: str, paths: Sequence[str], options: Dict[str, str],
         opts = {k.lower(): v for k, v in options.items()}
         sid = opts.get("snapshot-id", opts.get("snapshotid"))
         ts = opts.get("as-of-timestamp", opts.get("asoftimestamp"))
+        if sid is not None:
+            try:
+                sid = int(sid)
+            except ValueError:
+                pass  # named ref (branch/tag) — resolved by snapshot()
         return IcebergTable(
             paths[0],
             metadata_location=opts.get("metadata_location")).to_arrow(
-            int(sid) if sid is not None else None,
-            int(ts) if ts is not None else None, columns=columns)
+            sid, int(ts) if ts is not None else None, columns=columns)
     files = expand_paths(paths)
     from .object_store import has_remote_scheme, resolve_filesystem
     if fmt == "parquet" and files and has_remote_scheme(files[0]):

@@ -186,10 +186,21 @@ class IcebergTable:
         return _iceberg_type_to_spec(md["schemas"][0])
 
     # -- snapshots -------------------------------------------------------
-    def snapshot(self, snapshot_id: Optional[int] = None,
+    def snapshot(self, snapshot_id=None,
                  timestamp_ms: Optional[int] = None) -> Optional[dict]:
         md = self.metadata()
         snaps = md.get("snapshots", [])
+        if isinstance(snapshot_id, str):
+            # named ref: branch or tag (spec v2 `refs` map). `main` is
+            # implicitly the current state on tables whose writers never
+            # materialized a refs entry.
+            ref = (md.get("refs") or {}).get(snapshot_id)
+            if ref is not None:
+                snapshot_id = int(ref["snapshot-id"])
+            elif snapshot_id == "main":
+                snapshot_id = None
+            else:
+                raise ValueError(f"unknown ref {snapshot_id!r}")
         if not snaps:
             return None
         if snapshot_id is None and timestamp_ms is not None:
@@ -683,6 +694,11 @@ class IcebergTable:
             }
             md["snapshots"] = md.get("snapshots", []) + [snapshot]
             md["current-snapshot-id"] = snap_id
+            # the main branch tracks the current snapshot (spec v2 refs;
+            # "refs": null is a legal on-disk shape from other writers)
+            md["refs"] = dict(md.get("refs") or {})
+            md["refs"]["main"] = {"snapshot-id": snap_id,
+                                  "type": "branch"}
             md["last-sequence-number"] = seq
             md["last-updated-ms"] = snapshot["timestamp-ms"]
             md.setdefault("snapshot-log", []).append(
@@ -694,6 +710,52 @@ class IcebergTable:
             except IcebergConflict:
                 continue  # re-read the new base metadata and retry
         raise IcebergConflict("gave up after repeated commit races")
+
+    def _mutate_refs(self, mutate) -> int:
+        """Commit a ref-map change with the same re-read-and-retry loop
+        as every other metadata writer (and, like them, against the
+        LIVE version — never a metadata_location-pinned snapshot)."""
+        for _ in range(10):
+            version = self._current_version()
+            md = self.metadata(version)
+            md["refs"] = dict(md.get("refs") or {})
+            mutate(md)
+            try:
+                self._write_metadata_version(version + 1, md)
+                return version + 1
+            except IcebergConflict:
+                continue
+        raise IcebergConflict("ref update lost repeated races")
+
+    def set_ref(self, name: str, snapshot_id: Optional[int] = None,
+                ref_type: str = "tag") -> int:
+        """Create or move a named ref (branch or tag). Defaults to the
+        current snapshot. Returns the new metadata version."""
+        if ref_type not in ("tag", "branch"):
+            raise ValueError("ref type must be 'tag' or 'branch'")
+
+        def mutate(md):
+            sid = snapshot_id if snapshot_id is not None else \
+                md.get("current-snapshot-id")
+            if sid in (None, -1):
+                raise ValueError("table has no snapshot to reference")
+            if not any(s["snapshot-id"] == sid
+                       for s in md.get("snapshots", [])):
+                raise ValueError(f"snapshot {sid} not found")
+            md["refs"][name] = {"snapshot-id": sid, "type": ref_type}
+
+        return self._mutate_refs(mutate)
+
+    def drop_ref(self, name: str) -> int:
+        if name == "main":
+            raise ValueError("cannot drop the main branch")
+
+        def mutate(md):
+            if name not in md["refs"]:
+                raise ValueError(f"unknown ref {name!r}")
+            del md["refs"][name]
+
+        return self._mutate_refs(mutate)
 
     def append(self, table) -> int:
         return self._commit_snapshot(self._write_data_files(table),

@@ -322,7 +322,10 @@ class Resolver:
                     f"{entry.format!r}")
             try:
                 if kind == "version":
-                    int(value)
+                    # delta versions are integers; iceberg also accepts
+                    # named refs (branches/tags)
+                    if entry.format == "delta":
+                        int(value)
                 else:
                     value_ms = str(iso_to_ms(value))
             except (ValueError, TypeError) as e:

@@ -128,10 +128,36 @@ def test_sql_time_travel_delta_and_iceberg(spark, tmp_path):
     # malformed specs are analysis errors, not reader crashes
     from sail_tpu.plan.resolver import ResolutionError
     with pytest.raises(ResolutionError, match="invalid time travel"):
-        spark.sql("SELECT y FROM itt VERSION AS OF 'abc'").toPandas()
+        spark.sql("SELECT x FROM dtt VERSION AS OF 'abc'").toPandas()
     with pytest.raises(ResolutionError, match="invalid time travel"):
         spark.sql(
             "SELECT y FROM itt TIMESTAMP AS OF 'garbage'").toPandas()
+
+
+def test_iceberg_branch_and_tag_refs(spark, tmp_path):
+    """VERSION AS OF accepts Iceberg named refs; commits keep the main
+    branch ref in sync (spec v2 `refs`)."""
+    from sail_tpu.lakehouse.iceberg import IcebergTable
+
+    ip = str(tmp_path / "refs")
+    it = IcebergTable(ip)
+    it.create(pa.table({"y": [10]}))
+    it.set_ref("v1", ref_type="tag")         # tag the first snapshot
+    it.append(pa.table({"y": [20]}))
+    assert it.metadata()["refs"]["main"]["snapshot-id"] == \
+        it.metadata()["current-snapshot-id"]
+    spark.sql(f"CREATE TABLE rtt USING iceberg LOCATION '{ip}'")
+    assert spark.sql(
+        "SELECT y FROM rtt VERSION AS OF 'v1'").toPandas().y.tolist() \
+        == [10]
+    assert sorted(spark.sql(
+        "SELECT y FROM rtt VERSION AS OF 'main'").toPandas().y) \
+        == [10, 20]
+    with pytest.raises(Exception, match="unknown ref"):
+        spark.sql("SELECT y FROM rtt VERSION AS OF 'nope'").toPandas()
+    it.drop_ref("v1")
+    with pytest.raises(ValueError, match="main"):
+        it.drop_ref("main")
 
 
 def test_views_are_protected_from_table_ddl(spark):
