@@ -84,31 +84,26 @@ def generate_lineitem_sf(sf: float, seed: int = 0):
     })
 
 
-def _probe_backend(timeout_s: float, attempts: int = 3) -> bool:
+def _probe_backend(timeout_s: float) -> bool:
     """Check in a subprocess that the default jax backend initializes — a
     wedged remote-TPU tunnel would otherwise hang this process forever.
-    Failures are RETRIED and LOGGED to stderr (never silently swallowed):
-    a missing TPU number must be attributable to a concrete tunnel error."""
+    ONE short attempt only (a tunnel that failed once won't recover within
+    this run, and repeated probes used to burn ~150 s of the bench budget);
+    SAIL_BENCH_SKIP_TPU=1 skips the probe entirely."""
     import subprocess
-    for attempt in range(1, attempts + 1):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout_s, capture_output=True, text=True)
-            if r.returncode == 0:
-                return True
-            tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
-            print(f"bench: TPU probe attempt {attempt}/{attempts} failed "
-                  f"(rc={r.returncode}): " + " | ".join(tail),
-                  file=sys.stderr)
-        except subprocess.TimeoutExpired:
-            # a hung tunnel won't recover within this run, and killing more
-            # probe subprocesses can wedge the relay further — stop probing
-            print(f"bench: TPU probe attempt {attempt}/{attempts} timed out "
-                  f"after {timeout_s:.0f}s (tunnel hung; not retrying)",
-                  file=sys.stderr)
-            break
-    print("bench: all TPU probes failed — falling back to CPU "
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True)
+        if r.returncode == 0:
+            return True
+        tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
+        print(f"bench: TPU probe failed (rc={r.returncode}): "
+              + " | ".join(tail), file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"bench: TPU probe timed out after {timeout_s:.0f}s "
+              f"(tunnel hung; not retrying)", file=sys.stderr)
+    print("bench: TPU probe failed — falling back to CPU "
           "(platform field will say so)", file=sys.stderr)
     return False
 
@@ -146,7 +141,11 @@ def _run_suite(spark, sf: float, budget_s: float = 420.0):
     register_tpch(spark, sf=sf)
     out = {}
     t_start = time.perf_counter()
-    for q, sql in sorted(QUERIES.items()):
+    # q22 first: iterating in numeric order let it fall off the end of the
+    # budget in every round, so the artifact never recorded it
+    order = [22] + [q for q in sorted(QUERIES) if q != 22]
+    for q in order:
+        sql = QUERIES[q]
         if time.perf_counter() - t_start > budget_s:
             out[q] = "skipped: budget"
             continue
@@ -191,8 +190,12 @@ def main():
     args = [a for a in sys.argv[1:] if not a.startswith("-")]
     sf = float(args[0]) if args else float(os.environ.get("BENCH_SF", "10"))
     suite = "--suite" in sys.argv
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150"))
-    if not _probe_backend(probe_timeout):
+    probe_timeout = float(os.environ.get(
+        "SAIL_BENCH_TPU_PROBE_S",
+        os.environ.get("BENCH_PROBE_TIMEOUT_S", "20")))
+    skip_tpu = os.environ.get("SAIL_BENCH_SKIP_TPU", "0") \
+        .strip().lower() in ("1", "true", "yes")
+    if skip_tpu or not _probe_backend(probe_timeout):
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
         jax.config.update("jax_platforms", "cpu")

@@ -1321,15 +1321,26 @@ class LocalExecutor:
     # out-of-core: aggregates over big parquet scans stream chunk-wise
     # through the fused partial-agg program, so a table never needs to fit
     # in HBM whole (reference role: DataFusion memory pools + morsel scan;
-    # TPU shape: fixed-capacity chunks re-use ONE compiled XLA program)
+    # TPU shape: fixed-capacity chunks re-use ONE compiled XLA program).
+    # The scan side is PIPELINED: a bounded background producer drives
+    # parquet decode + declared-schema normalization while this thread
+    # runs the jitted partial-aggregate on the previous chunk, and
+    # partials fold incrementally so peak host memory stays bounded by
+    # prefetch depth × chunk size rather than the number of chunks.
     _CHUNK_MERGE = {"sum": "sum", "count": "sum", "min": "min",
                     "max": "max", "first": "first", "last": "last",
                     "bool_and": "bool_and", "bool_or": "bool_or"}
 
+    def _prefetch_depth(self) -> int:
+        from ..io.prefetch import prefetch_depth
+        return prefetch_depth(self.config)
+
     def _try_chunked_aggregate(self, p: pn.AggregateExec
                                ) -> Optional[HostBatch]:
         import pyarrow.dataset as pads
+        from .. import telemetry as tel
         from ..io.formats import expand_paths, rex_predicates_to_arrow
+        from ..io.prefetch import Prefetcher
 
         if any(a.distinct or a.fn not in self._CHUNK_MERGE or
                a.filter is not None for a in p.aggs):
@@ -1359,39 +1370,86 @@ class LocalExecutor:
         scanner = ds.scanner(
             columns=list(node.projection) if node.projection else None,
             filter=filter_expr, batch_size=chunk_rows)
-        partials = []
-        chunk_cap = None
-        for batch in scanner.to_batches():
-            if batch.num_rows == 0:
-                continue
-            table = pa.Table.from_batches([batch])
-            table = self._apply_declared_schema(table, node.schema)
-            chunk_scan = pn.ScanExec(node.out_schema, table, (), "memory",
-                                     projection=node.projection)
-            chunk_plan = _replace_node(p, node, chunk_scan)
-            partials.append(ai.to_arrow(self.run(chunk_plan)))
-            # drop the scan cache entry so chunks don't accumulate in HBM
-            for key in [k for k in _SCAN_CACHE
-                        if k[0] == "mem" and k[1] == id(table)]:
-                _SCAN_CACHE.pop(key, None)
         nk = len(p.group_indices)
-        if not partials:
-            empty_scan = pn.ScanExec(node.out_schema,
-                                     _empty_arrow(node.schema), (),
-                                     "memory", projection=node.projection)
-            return self.run(_replace_node(p, node, empty_scan))
-        merged = pa.concat_tables(partials, promote_options="permissive")
+        part_schema = tuple(
+            pn.Field(f"p{i}", f.dtype, True)
+            for i, f in enumerate(p.schema))
         final_aggs = tuple(
             pn.AggSpec(self._CHUNK_MERGE[a.fn], nk + j, False, a.out_dtype,
                        None, a.ignore_nulls)
             for j, a in enumerate(p.aggs))
-        part_schema = tuple(
-            pn.Field(f"p{i}", f.dtype, True)
-            for i, f in enumerate(p.schema))
-        final = pn.AggregateExec(
-            pn.ScanExec(part_schema, merged, (), "memory"),
-            tuple(range(nk)), final_aggs, p.out_names, p.max_groups_hint)
-        return self.run(final)
+
+        def merge_plan(partials_table: pa.Table) -> pn.AggregateExec:
+            return pn.AggregateExec(
+                pn.ScanExec(part_schema, partials_table, (), "memory"),
+                tuple(range(nk)), final_aggs, p.out_names,
+                p.max_groups_hint)
+
+        def chunks():
+            # coalesce scanner batches up to chunk_rows: parquet hands
+            # back row-group-sized batches no matter what batch_size
+            # asks for, and every undersized chunk pays a full
+            # plan-rewrite + executor dispatch — amortize it
+            acc, rows = [], 0
+            for b in scanner.to_batches():
+                if b.num_rows == 0:
+                    continue
+                acc.append(b)
+                rows += b.num_rows
+                if rows >= chunk_rows:
+                    yield acc
+                    acc, rows = [], 0
+            if acc:
+                yield acc
+
+        def decode(batches) -> pa.Table:
+            # runs on the producer thread: Arrow materialization and
+            # schema normalization overlap the consumer's jitted compute
+            table = pa.Table.from_batches(batches)
+            return self._apply_declared_schema(table, node.schema)
+
+        depth = self._prefetch_depth()
+        src = chunks()
+        pending: List[pa.Table] = []
+        pending_rows = 0
+        folded_rows = 0
+        with Prefetcher(src, transform=decode, depth=depth,
+                        kind="scan") as pf:
+            for table in pf:
+                chunk_scan = pn.ScanExec(node.out_schema, table, (),
+                                         "memory",
+                                         projection=node.projection)
+                chunk_plan = _replace_node(p, node, chunk_scan)
+                pending.append(ai.to_arrow(self.run(chunk_plan)))
+                pending_rows += pending[-1].num_rows
+                # drop the scan cache entry so chunks don't pile up in HBM
+                _drop_mem_scan_entry(table)
+                if len(pending) > 1 and \
+                        pending_rows > max(chunk_rows, 2 * folded_rows):
+                    # streaming fold: compact accumulated partials through
+                    # the merge aggregate instead of holding them all for
+                    # one giant end-of-scan concat. The 2× guard keeps
+                    # high-cardinality groupings amortized O(n): a fold
+                    # that can't shrink below the distinct-group count
+                    # must not re-run after every chunk
+                    folded = pa.concat_tables(pending,
+                                              promote_options="permissive")
+                    compacted = ai.to_arrow(self.run(merge_plan(folded)))
+                    _drop_mem_scan_entry(folded)
+                    pending = [compacted]
+                    pending_rows = compacted.num_rows
+                    folded_rows = pending_rows
+        tel.note("ScanPrefetch", "chunked scan→aggregate",
+                 **pf.stats.as_extra())
+        if not pending:
+            empty_scan = pn.ScanExec(node.out_schema,
+                                     _empty_arrow(node.schema), (),
+                                     "memory", projection=node.projection)
+            return self.run(_replace_node(p, node, empty_scan))
+        merged = pa.concat_tables(pending, promote_options="permissive")
+        out = self.run(merge_plan(merged))
+        _drop_mem_scan_entry(merged)
+        return out
 
     def _host_aggregate(self, p: pn.AggregateExec, child: HostBatch
                         ) -> HostBatch:
@@ -1699,48 +1757,25 @@ class LocalExecutor:
         rt = ai.to_arrow(right).rename_columns(
             [f.name for f in p.right.schema])
 
-        def key_hash(table, keys):
-            """Partition ids from key VALUES (stable across both sides —
-            dictionary codes are not). Simple column refs only; anything
-            fancier declines the spill path."""
-            import pandas as pd
-
+        def key_indices(keys):
+            """Simple column refs only; anything fancier declines the
+            spill path (the planner rewrites casts/exprs above the scan)."""
             idx = []
             for k in keys:
                 if isinstance(k, rx.BoundRef):
                     idx.append(k.index)
                 else:
                     return None
-            h = None
-            for i in idx:
-                col = table.column(i).combine_chunks()
-                if pa.types.is_floating(col.type) or \
-                        pa.types.is_integer(col.type) or \
-                        pa.types.is_boolean(col.type):
-                    # canonical float64: a NULLABLE int side otherwise
-                    # hashes as float-with-NaN while the other side
-                    # hashes as int — same value, different partition.
-                    # Spark join equality: -0.0 == 0.0 (+ 0.0 normalizes
-                    # the sign) and NaN == NaN (one canonical payload) —
-                    # mirrors ops/hash.py _normalize_float.
-                    vals = col.to_numpy(zero_copy_only=False) \
-                        .astype(np.float64) + 0.0
-                    vals[np.isnan(vals)] = np.nan
-                else:
-                    # strings/dates/decimals: canonical string form;
-                    # anything uncastable declines the spill path
-                    try:
-                        vals = pc.cast(col, pa.string()).to_numpy(
-                            zero_copy_only=False)
-                    except Exception:  # noqa: BLE001
-                        return None
-                part = pd.util.hash_array(vals, categorize=False) \
-                    .astype(np.uint64)
-                h = part if h is None else (h * np.uint64(31) + part)
-            return (h % np.uint64(nparts)).astype(np.int64)
+            return idx
 
-        lh = key_hash(lt, p.left_keys)
-        rh = key_hash(rt, p.right_keys)
+        lidx = key_indices(p.left_keys)
+        ridx = key_indices(p.right_keys)
+        if lidx is None or ridx is None:
+            return None
+        modes = [_spill_key_mode(lt.column(li).type, rt.column(ri).type)
+                 for li, ri in zip(lidx, ridx)]
+        lh = _spill_partition_ids(lt, lidx, modes, nparts)
+        rh = _spill_partition_ids(rt, ridx, modes, nparts)
         if lh is None or rh is None:
             return None
 
@@ -1759,26 +1794,40 @@ class LocalExecutor:
             sides.append(paths)
         del lt, rt
 
+        from .. import telemetry as tel
+        from ..io.prefetch import Prefetcher
+
+        def load_pair(part):
+            # producer thread: the next partition pair decodes from temp
+            # parquet while this thread joins the current pair on device
+            return (pq.read_table(sides[0][part]),
+                    pq.read_table(sides[1][part]))
+
+        pf = Prefetcher(range(nparts), transform=load_pair,
+                        depth=self._prefetch_depth(), kind="spill_join")
         outs = []
         self._in_join_spill = True
         try:
-            for part in range(nparts):
-                lsub = pq.read_table(sides[0][part])
-                rsub = pq.read_table(sides[1][part])
-                if p.join_type in ("inner", "semi") and \
-                        (lsub.num_rows == 0 or rsub.num_rows == 0):
-                    continue
-                if p.join_type in ("left", "full", "anti") and \
-                        lsub.num_rows == 0 and rsub.num_rows == 0:
-                    continue
-                lhb = _positional(ai.from_arrow(lsub))
-                rhb = _positional(ai.from_arrow(rsub))
-                sub_out = self._join(p, lhb, rhb)
-                outs.append(ai.to_arrow(sub_out))
+            with pf:
+                for lsub, rsub in pf:
+                    if p.join_type in ("inner", "semi") and \
+                            (lsub.num_rows == 0 or rsub.num_rows == 0):
+                        continue
+                    if p.join_type in ("left", "full", "anti") and \
+                            lsub.num_rows == 0 and rsub.num_rows == 0:
+                        continue
+                    lhb = _positional(ai.from_arrow(lsub))
+                    rhb = _positional(ai.from_arrow(rsub))
+                    sub_out = self._join(p, lhb, rhb)
+                    outs.append(ai.to_arrow(sub_out))
         finally:
+            # the prefetcher is already closed (producer joined) before
+            # this cleanup runs, so no reader races the rmtree
             self._in_join_spill = False
             import shutil
             shutil.rmtree(tmpdir, ignore_errors=True)
+        tel.note("SpillJoinPrefetch", f"{nparts} partition pairs",
+                 **pf.stats.as_extra())
         if not outs:
             schema = p.schema
             empty = pa.table({f"c{i}": pa.array(
@@ -1873,25 +1922,41 @@ class LocalExecutor:
             by.append(f"k{i}")
             asc.append(k.ascending)
 
+        from .. import telemetry as tel
+        from ..io.prefetch import Prefetcher
+
         tmpdir = tempfile.mkdtemp(prefix="sail_sort_spill_")
         self._last_sort_spill_dir = tmpdir  # observable in tests
         _record_metric("execution.spill_count", 1, kind="sort")
         try:
-            # -- spill the wide rows to memory-mappable runs --
+            # -- spill the wide rows to memory-mappable runs, in the
+            # background: the run data is already on disk once written, so
+            # the queue carries only paths and the producer never needs to
+            # stall — pass the full run count as depth (0 still disables)
             run_rows = max(1, threshold // 2)
-            paths = []
-            for start in range(0, n, run_rows):
-                fp = os.path.join(tmpdir, f"run{len(paths)}.arrow")
+            starts = list(enumerate(range(0, n, run_rows)))
+
+            def write_run(i_start):
+                i, start = i_start
+                fp = os.path.join(tmpdir, f"run{i}.arrow")
                 with pa.OSFile(fp, "wb") as f, \
                         ipc.new_file(f, table.schema) as writer:
                     writer.write_table(table.slice(start, run_rows))
-                paths.append(fp)
-            del table
+                return fp
 
-            perm = pd.DataFrame(frame).sort_values(
-                by, ascending=asc, kind="stable").index.to_numpy()
-            if p.limit is not None:
-                perm = perm[:p.limit]
+            depth = self._prefetch_depth()
+            with Prefetcher(starts, transform=write_run,
+                            depth=0 if depth <= 0 else len(starts),
+                            kind="spill_sort") as pf:
+                # the global key permutation computes WHILE runs spill
+                perm = pd.DataFrame(frame).sort_values(
+                    by, ascending=asc, kind="stable").index.to_numpy()
+                if p.limit is not None:
+                    perm = perm[:p.limit]
+                paths = list(pf)
+            del table
+            tel.note("SpillSortPrefetch", f"{len(paths)} runs",
+                     **pf.stats.as_extra())
 
             # -- gather output rows straight off the memory maps --
             runs = [ipc.open_file(pa.memory_map(fp, "r")).read_all()
@@ -2259,6 +2324,80 @@ class LocalExecutor:
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+def _spill_key_mode(lt_type: "pa.DataType", rt_type: "pa.DataType") -> str:
+    """Hash family for one spill-join key PAIR, agreed by both sides:
+    integral keys hash exactly as int64 (float64 canonicalization would
+    collapse int64 keys above 2^53 — adjacent keys share a double — and
+    skew partition sizes); the float64 path is reserved for float inputs;
+    everything else hashes its canonical string form."""
+    def one(t):
+        if pa.types.is_floating(t):
+            return "float"
+        if pa.types.is_integer(t) or pa.types.is_boolean(t):
+            return "int"
+        return "str"
+
+    ml, mr = one(lt_type), one(rt_type)
+    if "float" in (ml, mr):
+        return "float"
+    if ml == mr == "int":
+        return "int"
+    return "str"
+
+
+# all NULL keys land in one partition regardless of hash family
+_SPILL_NULL_HASH = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _spill_partition_ids(table: "pa.Table", idx, modes, nparts: int):
+    """Partition ids from key VALUES (stable across both sides —
+    dictionary codes are not). None → decline the spill path."""
+    import pandas as pd
+    import pyarrow.compute as pc
+
+    h = None
+    for i, mode in zip(idx, modes):
+        col = table.column(i).combine_chunks()
+        null_mask = None
+        if mode == "float":
+            # canonical float64: a NULLABLE int side otherwise hashes as
+            # float-with-NaN while the other side hashes as int — same
+            # value, different partition. Spark join equality:
+            # -0.0 == 0.0 (+ 0.0 normalizes the sign) and NaN == NaN
+            # (one canonical payload) — mirrors ops/hash.py
+            # _normalize_float.
+            vals = col.to_numpy(zero_copy_only=False) \
+                .astype(np.float64) + 0.0
+            vals[np.isnan(vals)] = np.nan
+        elif mode == "int":
+            # promote to the common integer width; exact above 2^53
+            null_mask = col.is_null().to_numpy(zero_copy_only=False)
+            vals = pc.fill_null(col.cast(pa.int64(), safe=False), 0) \
+                .to_numpy(zero_copy_only=False)
+        else:
+            # strings/dates/decimals: canonical string form; anything
+            # uncastable declines the spill path
+            try:
+                vals = pc.cast(col, pa.string()).to_numpy(
+                    zero_copy_only=False)
+            except Exception:  # noqa: BLE001
+                return None
+        part = pd.util.hash_array(vals, categorize=False) \
+            .astype(np.uint64)
+        if null_mask is not None and null_mask.any():
+            part[null_mask] = _SPILL_NULL_HASH
+        h = part if h is None else (h * np.uint64(31) + part)
+    return (h % np.uint64(nparts)).astype(np.int64)
+
+
+def _drop_mem_scan_entry(table: pa.Table) -> None:
+    """Evict one in-memory table's scan-cache entry (chunk pipelines
+    would otherwise pin every decoded chunk in HBM via the cache)."""
+    for key in [k for k in _SCAN_CACHE
+                if k[0] == "mem" and k[1] == id(table)]:
+        _SCAN_CACHE.pop(key, None)
+
 
 def _positional(hb: HostBatch) -> HostBatch:
     """Rename columns to positional keys c0..cn."""

@@ -77,6 +77,9 @@ class _LeafData:
     types: List[dt.DataType]
     dicts: Dict[int, pa.Array]
     cap: int
+    # device-placed flat buffers, memoized so capacity-retry attempts and
+    # the initial prefetch-overlapped upload share one H2D transfer
+    placed: Optional[List] = None
 
 
 def _positional_name(i: int) -> str:
@@ -188,7 +191,13 @@ class MeshExecutor:
             raise MeshUnsupported("root stage shape")
         top_id = root.inputs[0].stage_id
 
-        # host-side leaf data (shared across retries)
+        # host-side leaf data (shared across retries). No prefetch stage
+        # here: program compilation keys on EVERY leaf's signature
+        # (_program_cache_key), so leaf prep is a barrier with nothing to
+        # overlap against. Device upload is instead deferred to
+        # _place_leaf (memoized per leaf) so a plan that later declines
+        # with MeshUnsupported never pays host→device transfers and
+        # capacity retries reuse one upload
         leaves: Dict[int, _LeafData] = {}
         for stage in worker_stages:
             scan = _bottom_scan(stage.plan)
@@ -224,6 +233,12 @@ class MeshExecutor:
                 while len(_ATTEMPT_HINT) > _PROGRAM_CACHE_MAX:
                     _ATTEMPT_HINT.pop(next(iter(_ATTEMPT_HINT)))
             out_cols, out_sel, frag = result
+            # leaf input buffers are dead once the program produced its
+            # outputs — release the memoized uploads before the driver
+            # fragment runs its own device compute, or they pin HBM
+            # through _assemble + the root plan
+            for ld in leaves.values():
+                ld.placed = None
             table = self._assemble(out_cols, out_sel, frag)
             root_plan = jg.attach_stage_inputs(root.plan, {top_id: table})
             root_plan = _reattach_scans(root_plan, graph.scan_tables)
@@ -434,17 +449,26 @@ class MeshExecutor:
                  if _positional_name(i) in hb.dicts}
         return _LeafData(datas, validities, psel, types, dicts, cap)
 
-    def _flatten_leaf_arrays(self, leaves: Dict[int, _LeafData]) -> List:
-        from jax.sharding import NamedSharding, PartitionSpec as Pspec
-        sharding = NamedSharding(self.mesh, Pspec(DATA_AXIS))
-        flat: List = []
-        for lid in sorted(leaves):
-            ld = leaves[lid]
+    def _place_leaf(self, ld: _LeafData) -> List:
+        """Device placement for one leaf's buffers, memoized on the leaf:
+        repeat program runs (capacity retries) reuse the uploaded arrays
+        instead of paying the host→device transfer again."""
+        if ld.placed is None:
+            from jax.sharding import NamedSharding, PartitionSpec as Pspec
+            sharding = NamedSharding(self.mesh, Pspec(DATA_AXIS))
+            flat: List = []
             for d, v in zip(ld.datas, ld.validities):
                 flat.append(jax.device_put(d, sharding))
                 if v is not None:
                     flat.append(jax.device_put(v, sharding))
             flat.append(jax.device_put(ld.sel, sharding))
+            ld.placed = flat
+        return ld.placed
+
+    def _flatten_leaf_arrays(self, leaves: Dict[int, _LeafData]) -> List:
+        flat: List = []
+        for lid in sorted(leaves):
+            flat.extend(self._place_leaf(leaves[lid]))
         return flat
 
     # ------------------------------------------------------------------

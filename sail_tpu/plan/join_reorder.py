@@ -164,11 +164,24 @@ def _single_leaf(leaves, expr) -> Optional[int]:
 def _scan_rows(p: pn.ScanExec) -> float:
     if p.source is not None and hasattr(p.source, "num_rows"):
         return float(p.source.num_rows)
+    # ANALYZE TABLE ... COMPUTE STATISTICS stores numRows on the catalog
+    # entry, which the resolver copies into the scan options — computed
+    # stats beat per-file footer reads
+    num_rows = dict(p.options).get("numRows")
+    if num_rows is not None:
+        try:
+            return float(num_rows)
+        except (TypeError, ValueError):
+            pass
     if p.format == "parquet" and p.paths:
         try:
             from ..io.cache import METADATA_CACHE
+            from ..io.formats import expand_paths
+            # a catalog LOCATION is a directory — expand to data files
+            # so footer counts work for managed tables too
+            files = expand_paths(p.paths)
             return float(sum(METADATA_CACHE.num_rows(path)
-                             for path in p.paths[:64]))
+                             for path in files[:64]))
         except Exception:
             return _DEFAULT_ROWS
     return _DEFAULT_ROWS

@@ -369,6 +369,13 @@ class SparkSession:
             entry = cm.lookup_table(cmd.name)
             if entry is None:
                 raise ValueError(f"table not found: {'.'.join(cmd.name)}")
+            if cmd.columns:
+                # parsed but column-level stats are not collected yet —
+                # succeeding silently would let users believe ndv/min/max
+                # stats exist when only numRows does
+                raise NotImplementedError(
+                    "ANALYZE TABLE ... FOR COLUMNS is not implemented; "
+                    "use ANALYZE TABLE ... COMPUTE STATISTICS [NOSCAN]")
             if not cmd.noscan:
                 n = self._execute_query(
                     sp.Aggregate(sp.ReadNamedTable(cmd.name), (),
@@ -441,6 +448,7 @@ class SparkSession:
         if entry.format == "memory":
             if entry.data is not None:
                 entry.data = entry.data.slice(0, 0)
+            _drop_row_stats(entry)
             return pa.table({})
         if entry.format == "delta" and entry.paths:
             from .columnar.arrow_interop import spec_type_to_arrow
@@ -452,6 +460,7 @@ class SparkSession:
             t.overwrite(pa.table({
                 f.name: pa.array([], type=spec_type_to_arrow(f.data_type))
                 for f in schema.fields}))
+            _drop_row_stats(entry)
             return pa.table({})
         raise NotImplementedError(
             f"TRUNCATE on format {entry.format!r} not supported")
@@ -602,7 +611,10 @@ class SparkSession:
         if entry is not None and entry.format == "iceberg" and entry.paths:
             return self._iceberg_delete(entry, cmd)
         from .lakehouse.delta.dml import DeltaDml
-        return DeltaDml(self, cmd.table).delete(cmd.condition)
+        out = DeltaDml(self, cmd.table).delete(cmd.condition)
+        if entry is not None:
+            _drop_row_stats(entry)
+        return out
 
     def _iceberg_delete(self, entry, cmd: sp.Delete) -> pa.Table:
         """DELETE on an Iceberg table → merge-on-read position-delete
@@ -621,11 +633,16 @@ class SparkSession:
             return np.asarray([bool(v) for v in vals], dtype=bool)
 
         t.delete_where(mask_fn)
+        _drop_row_stats(entry)
         return pa.table({})
 
     def _delta_update(self, cmd: sp.Update) -> pa.Table:
         from .lakehouse.delta.dml import DeltaDml
-        return DeltaDml(self, cmd.table).update(cmd)
+        out = DeltaDml(self, cmd.table).update(cmd)
+        entry = self.catalog_manager.lookup_table(cmd.table)
+        if entry is not None:
+            _drop_row_stats(entry)
+        return out
 
     def _delta_merge(self, cmd: sp.MergeInto) -> pa.Table:
         """MERGE INTO on a Delta table — planned and executed by the
@@ -633,7 +650,11 @@ class SparkSession:
         (lakehouse/delta/dml.py; reference:
         crates/sail-delta-lake/src/physical_plan/planner/op_merge.rs)."""
         from .lakehouse.delta.dml import DeltaDml
-        return DeltaDml(self, cmd.target).merge(cmd)
+        out = DeltaDml(self, cmd.target).merge(cmd)
+        entry = self.catalog_manager.lookup_table(cmd.target)
+        if entry is not None:
+            _drop_row_stats(entry)
+        return out
 
     def _file_table_entry(self, cmd: sp.CreateTable) -> TableEntry:
         from .io.formats import infer_schema
@@ -724,7 +745,17 @@ class SparkSession:
             write_table(new_data, entry.format, entry.paths[0],
                         mode="overwrite" if cmd.overwrite else "append",
                         partition_by=entry.partition_by)
+        _drop_row_stats(entry)
         return pa.table({})
+
+
+def _drop_row_stats(entry) -> None:
+    """ANALYZE-time row counts are stale after any data mutation
+    (INSERT, TRUNCATE, overwrite); drop them so the join reorderer falls
+    back to exact footer counts instead of costing the table at its
+    pre-mutation size."""
+    entry.options = tuple(
+        (k, v) for k, v in entry.options if k != "numRows")
 
 
 class _BuilderDescriptor:
@@ -757,6 +788,9 @@ class SessionConf:
         chunk = app.get("execution.scan_chunk_rows")
         if chunk:
             base["spark.sail.scan.chunkRows"] = str(chunk)
+        pf_depth = app.get("execution.scan_prefetch_depth")
+        if pf_depth is not None:  # 0 is meaningful: disables pipelining
+            base["spark.sail.scan.prefetchDepth"] = str(pf_depth)
         self._DEFAULTS = base
         self._conf = dict(conf)
 

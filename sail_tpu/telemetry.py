@@ -33,12 +33,16 @@ class OperatorMetrics:
     capacity: int = 0
     elapsed_ms: float = 0.0
     children: List["OperatorMetrics"] = field(default_factory=list)
+    # free-form key=value counters (e.g. prefetch overlap stats); rendered
+    # after the standard fields so EXPLAIN ANALYZE surfaces them
+    extra: Dict[str, object] = field(default_factory=dict)
 
     def render(self, indent: int = 0) -> str:
         pad = "  " * indent
+        more = "".join(f" {k}={v}" for k, v in self.extra.items())
         line = (f"{pad}{self.operator}{' ' + self.detail if self.detail else ''}"
                 f"  [rows={self.output_rows} cap={self.capacity} "
-                f"time={self.elapsed_ms:.1f}ms]")
+                f"time={self.elapsed_ms:.1f}ms{more}]")
         return "\n".join([line] + [c.render(indent + 1) for c in self.children])
 
 
@@ -58,6 +62,17 @@ def collect_metrics():
         yield _local.collector
     finally:
         _local.collector = prev
+
+
+def note(operator: str, detail: str = "", **extra) -> None:
+    """Attach a zero-duration informational entry (e.g. prefetch overlap
+    counters) at the current nesting level; no-op without a collector."""
+    collector = current_collector()
+    if collector is None:
+        return
+    m = OperatorMetrics(operator, detail)
+    m.extra = dict(extra)
+    collector.append(m)
 
 
 @contextmanager

@@ -1,0 +1,395 @@
+"""Pipelined out-of-core execution: the bounded background prefetch
+stage (io/prefetch.py) and its consumers — chunked scan→aggregate,
+spill join, spill sort — plus overlap observability in EXPLAIN ANALYZE.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from sail_tpu import SparkSession
+from sail_tpu.io.prefetch import Prefetcher
+
+
+def _session(depth, **conf):
+    spark = SparkSession({"spark.sail.execution.mesh": "off", **conf})
+    spark.conf.set("spark.sail.scan.prefetchDepth", str(depth))
+    return spark
+
+
+@pytest.fixture()
+def parquet_dir(tmp_path):
+    n = 60_000
+    rng = np.random.default_rng(7)
+    df = pd.DataFrame({
+        "g": rng.integers(0, 5, n),
+        "v": rng.uniform(0, 10, n).round(3),
+        "k": rng.integers(0, 1 << 20, n),
+    })
+    for i in range(3):
+        pq.write_table(
+            pa.Table.from_pandas(df.iloc[i * n // 3:(i + 1) * n // 3]),
+            tmp_path / f"part{i}.parquet", row_group_size=8_000)
+    return tmp_path, df
+
+
+# ---------------------------------------------------------------------------
+# the prefetch stage itself
+# ---------------------------------------------------------------------------
+
+def test_passthrough_and_pipelined_yield_identical_streams():
+    items = list(range(23))
+    tf = lambda x: x * x  # noqa: E731
+    seq = list(Prefetcher(iter(items), transform=tf, depth=0))
+    pipe = list(Prefetcher(iter(items), transform=tf, depth=2))
+    assert seq == pipe == [x * x for x in items]
+
+
+def test_producer_exception_propagates_without_hang_or_leak():
+    def boom(x):
+        if x == 2:
+            raise ValueError("decode failed")
+        return x
+
+    pf = Prefetcher(iter([1, 2, 3]), transform=boom, depth=2)
+    out = []
+    with pytest.raises(ValueError, match="decode failed"):
+        for x in pf:
+            out.append(x)
+    assert out == [1]
+    assert pf._thread is None  # joined on close, not leaked
+    assert not any(t.name.startswith("sail-prefetch")
+                   for t in threading.enumerate())
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_transform_stop_iteration_surfaces_as_error(depth):
+    """PEP 479: a stray StopIteration from the transform must not
+    masquerade as clean end-of-stream and silently truncate — identical
+    behavior on the passthrough and pipelined paths."""
+    def bad(x):
+        if x == 1:
+            raise StopIteration
+        return x
+
+    pf = Prefetcher(iter([0, 1, 2]), transform=bad, depth=depth)
+    out = []
+    with pytest.raises(RuntimeError, match="StopIteration"):
+        for x in pf:
+            out.append(x)
+    assert out == [0]
+    assert pf._thread is None
+
+
+def test_depth0_source_error_closes_and_flushes():
+    """A source-side error on the passthrough path must close the
+    iterator (stats flushed, subsequent next() → StopIteration) just
+    like every other error path."""
+    def src():
+        yield 1
+        raise OSError("read failed")
+
+    pf = Prefetcher(src(), depth=0)
+    assert next(pf) == 1
+    with pytest.raises(OSError, match="read failed"):
+        next(pf)
+    assert pf._flushed
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_consumer_abandonment_cancels_bounded_producer():
+    produced = []
+
+    def tf(x):
+        produced.append(x)
+        time.sleep(0.005)
+        return x
+
+    pf = Prefetcher(range(1000), transform=tf, depth=2)
+    with pf:
+        assert next(pf) == 0
+    # close() cancelled the producer: it never ran the source dry
+    assert pf._thread is None
+    assert len(produced) < 1000
+    assert not any(t.name.startswith("sail-prefetch")
+                   for t in threading.enumerate())
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_close_releases_transform_closure(depth):
+    """A closed prefetcher must not pin buffers captured by its
+    transform (spill sort's write_run captures the whole wide table)."""
+    import gc
+    import weakref
+
+    class Big:
+        pass
+
+    big = Big()
+    ref = weakref.ref(big)
+
+    def tf(x, _captured=big):
+        return x
+
+    pf = Prefetcher(range(5), transform=tf, depth=depth)
+    assert list(pf) == list(range(5))  # exhaustion ran close()
+    del tf, big
+    gc.collect()
+    assert ref() is None, "closed Prefetcher still pins the transform"
+    assert pf.stats.chunks == 5  # stats survive close for reporting
+
+
+def test_depth_bounds_producer_run_ahead():
+    seen = []
+
+    def tf(x):
+        seen.append(x)
+        return x
+
+    pf = Prefetcher(range(50), transform=tf, depth=2)
+    deadline = time.time() + 2.0
+    while len(seen) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.2)  # give an unbounded producer time to run away
+    # at most depth queued + one item in the producer's hand
+    assert len(seen) <= 3, seen
+    assert list(pf) == list(range(50))
+    assert seen == list(range(50))
+
+
+def test_abandoned_prefetcher_collects_and_thread_exits():
+    """The producer thread must not hold a reference to the Prefetcher:
+    dropping the last consumer reference without close() has to let GC
+    run __del__, cancel the producer, and reap the thread."""
+    import gc
+
+    def slow(x):
+        time.sleep(0.005)
+        return x
+
+    pf = Prefetcher(range(10_000), transform=slow, depth=2)
+    assert next(pf) == 0
+    del pf
+    gc.collect()
+    deadline = time.time() + 3.0
+    while time.time() < deadline and any(
+            t.name.startswith("sail-prefetch")
+            for t in threading.enumerate()):
+        time.sleep(0.02)
+    assert not any(t.name.startswith("sail-prefetch")
+                   for t in threading.enumerate())
+
+
+def test_sentinel_put_not_counted_as_producer_wait():
+    """With queue depth == item count every data item enqueues
+    instantly; only the sentinel blocks while the consumer sits idle —
+    that idle time must not surface as producer backpressure."""
+    pf = Prefetcher(range(4), depth=4)
+    time.sleep(0.4)  # items enqueued immediately; sentinel put blocked
+    assert list(pf) == [0, 1, 2, 3]
+    assert pf.stats.producer_wait_s < 0.2, pf.stats.producer_wait_s
+
+
+def test_stats_count_chunks_and_flush_to_registry():
+    from sail_tpu.metrics import REGISTRY
+
+    pf = Prefetcher(range(7), depth=2, kind="scan")
+    assert list(pf) == list(range(7))
+    assert pf.stats.chunks == 7
+    snap = {(r["name"], r["attributes"]): r["value"]
+            for r in REGISTRY.snapshot()}
+    assert any(name == "execution.prefetch.chunk_count"
+               and '"kind": "scan"' in attrs
+               for (name, attrs) in snap), snap
+
+
+# ---------------------------------------------------------------------------
+# chunked scan→aggregate
+# ---------------------------------------------------------------------------
+
+def test_chunked_aggregate_pipelined_matches_resident(parquet_dir):
+    """Smoke contract: prefetchDepth=0 (sequential fallback) and =2
+    (pipelined) produce byte-identical results, both equal to the
+    resident path."""
+    d, df = parquet_dir
+    paths = [str(d / f"part{i}.parquet") for i in range(3)]
+    q = ("SELECT g, sum(v) s, count(*) c, min(k) mn, max(k) mx FROM t "
+         "GROUP BY g ORDER BY g")
+    frames = {}
+    for name, spark in (
+            ("resident", _session(2)),
+            ("seq", _session(0, **{"spark.sail.scan.chunkRows": "6000"})),
+            ("pipelined",
+             _session(2, **{"spark.sail.scan.chunkRows": "6000"}))):
+        spark.read.parquet(*paths).createOrReplaceTempView("t")
+        frames[name] = spark.sql(q).toPandas()
+    pd.testing.assert_frame_equal(frames["resident"], frames["seq"])
+    pd.testing.assert_frame_equal(frames["resident"], frames["pipelined"])
+    exp = df.groupby("g").agg(s=("v", "sum"), c=("v", "size"),
+                              mn=("k", "min"), mx=("k", "max"))
+    np.testing.assert_allclose(frames["pipelined"].s, exp.s, rtol=1e-9)
+    np.testing.assert_array_equal(frames["pipelined"].c, exp.c)
+
+
+def test_chunked_aggregate_streaming_fold_bounds_partials(parquet_dir):
+    """Tiny chunks force many partials; the streaming fold must still
+    produce exact results (folds re-aggregate through the merge plan)."""
+    d, df = parquet_dir
+    paths = [str(d / f"part{i}.parquet") for i in range(3)]
+    spark = _session(2, **{"spark.sail.scan.chunkRows": "1500"})
+    spark.read.parquet(*paths).createOrReplaceTempView("t")
+    got = spark.sql("SELECT sum(v) s, count(*) c FROM t WHERE g < 3"
+                    ).toPandas()
+    sub = df[df.g < 3]
+    np.testing.assert_allclose(got.s[0], sub.v.sum(), rtol=1e-9)
+    assert got.c[0] == len(sub)
+
+
+def test_prefetch_metrics_in_explain_analyze(parquet_dir):
+    d, _ = parquet_dir
+    paths = [str(d / f"part{i}.parquet") for i in range(3)]
+    spark = _session(2, **{"spark.sail.scan.chunkRows": "6000"})
+    spark.read.parquet(*paths).createOrReplaceTempView("t")
+    out = spark.sql("EXPLAIN ANALYZE SELECT g, sum(v) FROM t GROUP BY g"
+                    ).toPandas()
+    text = out.plan[0]
+    assert "ScanPrefetch" in text, text
+    assert "prefetched=" in text
+    assert "producer_wait=" in text and "consumer_wait=" in text
+
+
+# ---------------------------------------------------------------------------
+# spill join / spill sort consumers
+# ---------------------------------------------------------------------------
+
+def _join_frames(n=3000):
+    rng = np.random.default_rng(3)
+    left = pd.DataFrame({"k": rng.integers(0, 200, n),
+                         "v": rng.random(n)})
+    right = pd.DataFrame({"k": np.arange(150), "w": rng.random(150)})
+    return left, right
+
+
+@pytest.mark.parametrize("depth", [0, 3])
+def test_spill_join_pipelined_matches_oracle(monkeypatch, depth):
+    monkeypatch.setenv("SAIL_EXECUTION__JOIN_SPILL_ROWS", "1000")
+    left, right = _join_frames()
+    spark = _session(depth)
+    spark.createDataFrame(left).createOrReplaceTempView("l")
+    spark.createDataFrame(right).createOrReplaceTempView("r")
+    got = spark.sql(
+        "SELECT SUM(l.v * r.w) FROM l JOIN r ON l.k = r.k").toPandas()
+    exp = left.merge(right, on="k")
+    assert abs(got.iloc[0, 0] - (exp.v * exp.w).sum()) < 1e-6
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_spill_sort_pipelined_matches(monkeypatch, depth):
+    monkeypatch.setenv("SAIL_EXECUTION__SORT_SPILL_ROWS", "1000")
+    rng = np.random.default_rng(5)
+    df = pd.DataFrame({"a": rng.integers(0, 50, 4000),
+                       "b": rng.random(4000)})
+    spark = _session(depth)
+    spark.createDataFrame(df).createOrReplaceTempView("t")
+    got = spark.sql("SELECT a, b FROM t ORDER BY a, b").toPandas()
+    exp = df.sort_values(["a", "b"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp)
+
+
+def test_spill_join_int64_keys_above_2_53(monkeypatch):
+    """int64 keys past the float64-exact range must join exactly and
+    partition by value, not by collapsed double."""
+    monkeypatch.setenv("SAIL_EXECUTION__JOIN_SPILL_ROWS", "500")
+    n = 2000
+    keys = (1 << 53) + np.arange(n, dtype=np.int64)
+    left = pd.DataFrame({"k": keys, "v": np.arange(n, dtype=np.int64)})
+    right = pd.DataFrame({"k": keys[::2],
+                          "w": np.arange(n // 2, dtype=np.int64)})
+    spark = _session(2)
+    spark.createDataFrame(left).createOrReplaceTempView("l")
+    spark.createDataFrame(right).createOrReplaceTempView("r")
+    got = spark.sql(
+        "SELECT COUNT(*) FROM l JOIN r ON l.k = r.k").toPandas()
+    assert got.iloc[0, 0] == n // 2
+
+
+def test_spill_partition_hash_integral_path():
+    from sail_tpu.exec.local import _spill_key_mode, _spill_partition_ids
+
+    # adjacent int64 keys above 2^60 collapse pairwise under float64 —
+    # the int path must spread them across partitions
+    t = pa.table({"k": pa.array((1 << 60) + np.arange(64),
+                                type=pa.int64())})
+    ids = _spill_partition_ids(t, [0], ["int"], 16)
+    assert len(set(ids.tolist())) > 4
+    # NULL keys all land in one partition; narrow ints promote to int64
+    # and hash identically to a wide side carrying the same values
+    t32 = pa.table({"k": pa.array([1, None, 3, None], type=pa.int32())})
+    t64 = pa.table({"k": pa.array([1, None, 3, None], type=pa.int64())})
+    ids32 = _spill_partition_ids(t32, [0], ["int"], 16)
+    ids64 = _spill_partition_ids(t64, [0], ["int"], 16)
+    np.testing.assert_array_equal(ids32, ids64)
+    assert ids32[1] == ids32[3]
+    # float inputs keep the canonical-float64 family
+    assert _spill_key_mode(pa.float64(), pa.int64()) == "float"
+    assert _spill_key_mode(pa.int32(), pa.int64()) == "int"
+    assert _spill_key_mode(pa.string(), pa.string()) == "str"
+
+
+# ---------------------------------------------------------------------------
+# ANALYZE TABLE statistics wiring (rides this PR)
+# ---------------------------------------------------------------------------
+
+def test_analyze_numrows_feeds_join_reorder(tmp_path):
+    import pyarrow.parquet as _pq
+
+    from sail_tpu.plan.join_reorder import _scan_rows
+    from sail_tpu.sql import parse_one
+
+    p = str(tmp_path / "t.parquet")
+    _pq.write_table(pa.table({"a": pa.array(range(100))}), p)
+    spark = _session(2)
+    spark.sql(f"CREATE TABLE t USING parquet LOCATION '{p}'")
+    spark.sql("ANALYZE TABLE t COMPUTE STATISTICS")
+    node = spark._resolve(parse_one("SELECT * FROM t"))
+
+    def find_scan(n):
+        if type(n).__name__ == "ScanExec":
+            return n
+        for c in n.children:
+            s = find_scan(c)
+            if s is not None:
+                return s
+        return None
+
+    scan = find_scan(node)
+    assert scan is not None
+    assert dict(scan.options).get("numRows") == "100"
+    assert _scan_rows(scan) == 100.0
+
+
+def test_truncate_drops_analyze_numrows():
+    """TRUNCATE must invalidate ANALYZE-time row counts, or the join
+    reorderer costs the now-empty table at its pre-truncate size."""
+    spark = _session(2)
+    spark.sql("CREATE TABLE trunc_t (a INT)")
+    spark.sql("INSERT INTO trunc_t VALUES (1), (2), (3)")
+    spark.sql("ANALYZE TABLE trunc_t COMPUTE STATISTICS")
+    entry = spark.catalog_manager.lookup_table(("trunc_t",))
+    assert dict(entry.options).get("numRows") == "3"
+    spark.sql("TRUNCATE TABLE trunc_t")
+    assert "numRows" not in dict(entry.options)
+
+
+def test_analyze_for_columns_raises_not_implemented():
+    spark = _session(2)
+    spark.sql("CREATE TABLE tt (a INT)")
+    spark.sql("INSERT INTO tt VALUES (1), (2)")
+    with pytest.raises(NotImplementedError, match="FOR COLUMNS"):
+        spark.sql("ANALYZE TABLE tt COMPUTE STATISTICS FOR COLUMNS a")
