@@ -108,9 +108,28 @@ def _probe_backend(timeout_s: float) -> bool:
     return False
 
 
+def _profile_summary():
+    """Compile/execute split of the most recent query profile — lets the
+    bench artifact track the compile-vs-execute trend across rounds."""
+    try:
+        from sail_tpu import profiler
+        prof = profiler.last_profile()
+        if prof is None:
+            return None
+        phases = dict(prof.phases)
+        return {
+            "compile_ms": round(prof.compile_ms, 2),
+            "execute_ms": round(phases.get("execute", 0.0), 2),
+            "cache_hits": prof.compile_cache_hits,
+            "cache_misses": prof.compile_cache_misses,
+        }
+    except Exception:  # noqa: BLE001 — profiling must never fail a bench
+        return None
+
+
 def _run_q1(spark, sf: float):
     """Generate lineitem at ``sf``, run Q1 to steady state; returns
-    (best_seconds, rows, scanned_bytes)."""
+    (best_seconds, rows, scanned_bytes, profile_summary)."""
     from sail_tpu.benchmarks.tpch_queries import QUERIES
     from sail_tpu.exec.local import clear_caches
 
@@ -119,6 +138,7 @@ def _run_q1(spark, sf: float):
     spark.createDataFrame(table).createOrReplaceTempView("lineitem")
     q1 = QUERIES[1]
     spark.sql(q1).toArrow()  # warm-up: traces + compiles + uploads
+    warm_profile = _profile_summary()
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -128,7 +148,9 @@ def _run_q1(spark, sf: float):
     cols = ["l_quantity", "l_extendedprice", "l_discount", "l_tax",
             "l_returnflag", "l_linestatus", "l_shipdate"]
     scanned = sum(table.column(c).nbytes for c in cols)
-    return min(times), table.num_rows, scanned
+    steady_profile = _profile_summary()
+    profile = {"warm": warm_profile, "steady": steady_profile}
+    return min(times), table.num_rows, scanned, profile
 
 
 def _run_suite(spark, sf: float, budget_s: float = 420.0):
@@ -151,9 +173,14 @@ def _run_suite(spark, sf: float, budget_s: float = 420.0):
             continue
         try:
             spark.sql(sql).toArrow()  # warm
+            warm = _profile_summary()
             t0 = time.perf_counter()
             spark.sql(sql).toArrow()
-            out[q] = round(time.perf_counter() - t0, 4)
+            rec = {"seconds": round(time.perf_counter() - t0, 4)}
+            steady = _profile_summary()
+            if steady is not None:
+                rec["profile"] = {"warm": warm, "steady": steady}
+            out[q] = rec
         except Exception as e:  # noqa: BLE001 — a failed query is data
             out[q] = f"error: {type(e).__name__}"
         print(f"bench: q{q} = {out[q]}", file=sys.stderr, flush=True)
@@ -174,7 +201,11 @@ def _run_clickbench(spark, n_rows: int = 100_000, budget_s: float = 180.0):
         try:
             t0 = time.perf_counter()
             spark.sql(sql).toArrow()
-            out[i] = round(time.perf_counter() - t0, 4)
+            rec = {"seconds": round(time.perf_counter() - t0, 4)}
+            prof = _profile_summary()
+            if prof is not None:
+                rec["profile"] = prof
+            out[i] = rec
         except Exception as e:  # noqa: BLE001
             out[i] = f"error: {type(e).__name__}"
         print(f"bench: cb{i} = {out[i]}", file=sys.stderr, flush=True)
@@ -206,12 +237,12 @@ def main():
     platform = jax.devices()[0].platform
     spark = SparkSession.builder.getOrCreate()
     try:
-        best, rows, scanned = _run_q1(spark, sf)
+        best, rows, scanned, q1_profile = _run_q1(spark, sf)
     except Exception as e:  # noqa: BLE001 — fall back to SF1 rather than die
         print(f"bench: SF{sf:g} failed ({type(e).__name__}: {e}); "
               f"retrying at SF1", file=sys.stderr)
         sf = 1.0
-        best, rows, scanned = _run_q1(spark, sf)
+        best, rows, scanned, q1_profile = _run_q1(spark, sf)
     result = {
         "metric": f"tpch_q1_sf{sf:g}_seconds",
         "value": round(best, 4),
@@ -220,6 +251,7 @@ def main():
         "platform": platform,
         "rows": rows,
         "scan_gbps": round(scanned / best / 1e9, 2),
+        "profile": q1_profile,
     }
     # the 22-query and ClickBench artifacts always record, inside the
     # remaining share of the GLOBAL deadline (a bench that overruns the

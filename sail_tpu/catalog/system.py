@@ -111,6 +111,74 @@ class SystemRegistry:
                     "rows_out": pa.array([r["rows_out"] for r in rows],
                                          pa.int64()),
                 })
+            if (database, name) == ("telemetry", "query_profiles"):
+                import json
+                from ..profiler import FLIGHT_RECORDER
+                rows = [p.to_dict() for p in FLIGHT_RECORDER.profiles()]
+                phase_ms = lambda r, n: float(  # noqa: E731
+                    r["phases"].get(n, 0.0))
+                return pa.table({
+                    "query_id": pa.array(
+                        [r["query_id"] for r in rows]),
+                    "statement": pa.array(
+                        [r["statement"] for r in rows]),
+                    "session": pa.array([r["session"] for r in rows]),
+                    "status": pa.array([r["status"] for r in rows]),
+                    "start_time": pa.array(
+                        [r["start_time"] for r in rows], pa.float64()),
+                    "total_ms": pa.array(
+                        [r["total_ms"] for r in rows], pa.float64()),
+                    "parse_ms": pa.array(
+                        [phase_ms(r, "parse") for r in rows],
+                        pa.float64()),
+                    "resolve_ms": pa.array(
+                        [phase_ms(r, "resolve") for r in rows],
+                        pa.float64()),
+                    "optimize_ms": pa.array(
+                        [phase_ms(r, "optimize") for r in rows],
+                        pa.float64()),
+                    "compile_ms": pa.array(
+                        [phase_ms(r, "compile") for r in rows],
+                        pa.float64()),
+                    "execute_ms": pa.array(
+                        [phase_ms(r, "execute") for r in rows],
+                        pa.float64()),
+                    "fetch_ms": pa.array(
+                        [phase_ms(r, "fetch") for r in rows],
+                        pa.float64()),
+                    "compile_cache_hits": pa.array(
+                        [r["compile"]["cache_hits"] for r in rows],
+                        pa.int64()),
+                    "compile_cache_misses": pa.array(
+                        [r["compile"]["cache_misses"] for r in rows],
+                        pa.int64()),
+                    "transfer_bytes": pa.array(
+                        [r["transfer_bytes"] for r in rows], pa.int64()),
+                    "spill_bytes": pa.array(
+                        [r["spill_bytes"] for r in rows], pa.int64()),
+                    "rows_out": pa.array(
+                        [r["rows_out"] for r in rows], pa.int64()),
+                    "slow": pa.array([r["slow"] for r in rows],
+                                     pa.bool_()),
+                    "error": pa.array([r["error"] for r in rows]),
+                    "profile_json": pa.array(
+                        [json.dumps(r, default=str) for r in rows]),
+                })
+            if (database, name) == ("telemetry", "active_queries"):
+                from ..profiler import FLIGHT_RECORDER
+                active = FLIGHT_RECORDER.active()
+                return pa.table({
+                    "query_id": pa.array([p.query_id for p in active]),
+                    "statement": pa.array(
+                        [p.statement for p in active]),
+                    "session": pa.array([p.session for p in active]),
+                    "phase": pa.array(
+                        [p.current_phase() for p in active]),
+                    "start_time": pa.array(
+                        [p.start_time for p in active], pa.float64()),
+                    "elapsed_ms": pa.array(
+                        [p.total_ms for p in active], pa.float64()),
+                })
             if (database, name) == ("telemetry", "metrics"):
                 from ..metrics import REGISTRY
                 rows = REGISTRY.snapshot()

@@ -193,6 +193,10 @@ def make_batch(columns: Dict[str, Tuple[np.ndarray, Optional[np.ndarray], dt.Dat
     # ONE batched transfer for all columns (a per-column jnp.asarray costs
     # ~1 ms of dispatch each; the output of a small aggregate was paying
     # 10+ ms in uploads alone)
+    from ..profiler import note_transfer_bytes
+    note_transfer_bytes(sel.nbytes + sum(
+        d.nbytes + (v.nbytes if v is not None else 0)
+        for d, v in host.values()))
     dhost, dsel = jax.device_put((host, sel))
     cols = {name: Column(dhost[name][0], dhost[name][1], types[name])
             for name in host}

@@ -153,6 +153,15 @@ class _StreamStore:
 _FETCH_CHUNK_BYTES = 1 << 20
 
 
+def _task_metrics_enabled() -> bool:
+    """Workers collect per-operator metrics for every task unless
+    ``cluster.task_metrics`` turns it off (the collection forces one
+    device sync per operator)."""
+    from ..config import get as config_get
+    return str(config_get("cluster.task_metrics", "true")) \
+        .strip().lower() not in ("0", "false", "no", "off")
+
+
 def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
     """Server-streaming fetch: the channel's IPC bytes stream as bounded
     chunks — no gRPC message-size cap, no full-buffer single message on
@@ -352,7 +361,22 @@ class WorkerActor(Actor):
             if self._running.get(key, threading.Event()).is_set():
                 self._report(task, "canceled")
                 return
-            table = LocalExecutor().execute(plan)
+            metrics_json = ""
+            if _task_metrics_enabled():
+                # per-operator metrics ride the success report so the
+                # driver's query profile sees below the stage boundary
+                import json as _json
+
+                from .. import telemetry as tel
+                with tel.collect_metrics() as collector:
+                    table = LocalExecutor().execute(plan)
+                try:
+                    metrics_json = _json.dumps(
+                        [m.to_dict() for m in collector])
+                except (TypeError, ValueError):
+                    metrics_json = ""
+            else:
+                table = LocalExecutor().execute(plan)
             if task.HasField("shuffle_write") and \
                     task.shuffle_write.num_channels > 1:
                 # shuffle consumers only ever fetch hash channels — do not
@@ -366,7 +390,8 @@ class WorkerActor(Actor):
                 channels = {-1: _table_to_ipc(table)}
             self.streams.put(task.job_id, task.stage, task.partition,
                              channels)
-            self._report(task, "succeeded", rows=table.num_rows)
+            self._report(task, "succeeded", rows=table.num_rows,
+                         metrics_json=metrics_json)
         except _FetchFailed as e:
             # a producer's streams are gone (dead peer): the driver re-runs
             # the producer and re-schedules this task, not as our failure
@@ -378,13 +403,14 @@ class WorkerActor(Actor):
             self._running.pop(key, None)
 
     def _report(self, task: pb.TaskDefinition, state: str, error: str = "",
-                rows: int = 0):
+                rows: int = 0, metrics_json: str = ""):
         try:
             self._call_driver("ReportTaskStatus", pb.ReportTaskStatusRequest(
                 worker_id=self.worker_id, job_id=task.job_id,
                 stage=task.stage, partition=task.partition,
                 attempt=task.attempt, state=state, error=error,
-                rows_out=rows), pb.ReportTaskStatusResponse)
+                rows_out=rows, metrics_json=metrics_json),
+                pb.ReportTaskStatusResponse)
         except grpc.RpcError:
             pass
 
@@ -463,6 +489,9 @@ class _Job:
         # consumer tasks waiting for a producer re-run after a fetch failure
         self.pending: Set[Tuple[int, int]] = set()
         self.stage_rows: Dict[int, int] = {}
+        # per-{stage, partition} operator metrics from the winning task
+        # attempt: {"worker_id", "rows_out", "operators": [...]}
+        self.task_metrics: Dict[Tuple[int, int], dict] = {}
         self.result_addr: Optional[str] = None
 
 
@@ -567,6 +596,7 @@ class DriverActor(Actor):
             if self._starting_ts:
                 self._starting_ts.pop(0)
             self._starting = len(self._starting_ts)
+            _record_metric("cluster.worker_count", len(self.workers))
         elif kind == "heartbeat":
             w = self.workers.get(payload.worker_id)
             if w is not None:
@@ -630,6 +660,7 @@ class DriverActor(Actor):
             if self._worker_hosts_live_output(w["addr"]):
                 continue
             self.workers.pop(wid)
+            _record_metric("cluster.worker_count", len(self.workers))
             from ..catalog.system import SYSTEM
             SYSTEM.record_worker(wid, w["addr"], w["slots"], "reaped")
             if stop is not None:
@@ -644,6 +675,9 @@ class DriverActor(Actor):
             self._reap_idle_workers(now)
         lost = [wid for wid, w in self.workers.items()
                 if now - w["last_seen"] > self.HEARTBEAT_TIMEOUT_S]
+        if lost:
+            _record_metric("cluster.worker_count",
+                           len(self.workers) - len(lost))
         for wid in lost:
             w = self.workers.pop(wid)
             # re-run the lost worker's RUNNING tasks
@@ -808,6 +842,15 @@ class DriverActor(Actor):
                 job.locations[r.stage][r.partition] = w["addr"]
                 job.stage_rows[r.stage] = \
                     job.stage_rows.get(r.stage, 0) + int(r.rows_out)
+                if r.metrics_json:
+                    try:
+                        import json as _json
+                        job.task_metrics[(r.stage, r.partition)] = {
+                            "worker_id": r.worker_id,
+                            "rows_out": int(r.rows_out),
+                            "operators": _json.loads(r.metrics_json)}
+                    except ValueError:
+                        pass  # malformed metrics never fail a task
                 self._fire_pending(job)
                 self._schedule_ready_stages(job)
         elif r.state == "failed":
@@ -911,6 +954,7 @@ class LocalCluster:
         """Distribute a plan; returns the result pyarrow Table."""
         import pyarrow as pa
         from .local import LocalExecutor
+        from .. import profiler
 
         nparts = num_partitions or max(1, len(self.workers))
         graph = jg.split_job(plan, nparts)
@@ -920,7 +964,12 @@ class LocalCluster:
             job = _Job(uuid.uuid4().hex[:12], graph,
                        trace_ctx=tr.SpanContext(root_span.trace_id,
                                                 root_span.span_id))
-            return self._run_submitted(job, timeout)
+            # joins the session's profile when the job runs inside one;
+            # a standalone run_job still gets its own profile record.
+            # Execute/fetch phases come from the root-stage executor —
+            # total_ms additionally covers the distributed wait.
+            with profiler.profile_query(f"cluster job {job.job_id}"):
+                return self._run_submitted(job, timeout)
 
     def _run_submitted(self, job, timeout):
         import pyarrow as pa
@@ -953,13 +1002,27 @@ class LocalCluster:
             # memory scans that stayed in the driver-run root plan read the
             # driver's own table map directly
             root_plan = _reattach_local_scans(root_plan, graph.scan_tables)
-            return LocalExecutor().execute(root_plan)
+            result = LocalExecutor().execute(root_plan)
+            # merge the workers' per-task operator metrics into the
+            # driver's query profile per {stage, partition}
+            from .. import profiler
+            prof = profiler.current_profile()
+            if prof is not None:
+                for (stage, part), m in sorted(job.task_metrics.items()):
+                    prof.add_task(stage, part, m.get("worker_id", ""),
+                                  m.get("operators") or [],
+                                  m.get("rows_out", 0))
+            return result
         finally:
             self.driver.handle.send(("cleanup", job.job_id))
 
     def stage_rows(self) -> Dict[int, int]:
         """Rows produced per stage of the last job (operator metrics)."""
         return dict(self.last_job.stage_rows) if self.last_job else {}
+
+    def task_metrics(self) -> Dict[Tuple[int, int], dict]:
+        """Per-{stage, partition} operator metrics of the last job."""
+        return dict(self.last_job.task_metrics) if self.last_job else {}
 
     def stop(self):
         for w in self.workers:

@@ -337,6 +337,31 @@ class _OpCache:
         return value
 
 
+def _compile_timed(fn, key):
+    """Wrap a jitted fn so its FIRST call — where tracing and XLA
+    compilation actually happen (jax.jit itself is lazy) — is timed and
+    charged to the query that missed the operator cache."""
+    import time as _time
+
+    from .. import profiler
+
+    pending = [True]
+
+    def wrapper(*args, **kwargs):
+        if pending:
+            del pending[:]
+            t0 = _time.perf_counter()
+            out = fn(*args, **kwargs)
+            key_repr = repr(key[0]) if isinstance(key, tuple) and key \
+                else repr(key)
+            profiler.note_compile_time(_time.perf_counter() - t0,
+                                       key=key_repr)
+            return out
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
 _OP_CACHE = _OpCache()
 _SCAN_CACHE: Dict = {}
 
@@ -354,11 +379,22 @@ class LocalExecutor:
     # ------------------------------------------------------------------
     def execute(self, plan: pn.PlanNode) -> pa.Table:
         """Run a plan to an Arrow table with the plan's output names."""
-        self._pre_eval_subqueries(plan)
-        batch = self.run(plan)
-        table = ai.to_arrow(batch)
-        names = [f.name for f in plan.schema]
-        return table.rename_columns(names)
+        import contextlib
+
+        from .. import profiler
+        # a nested executor (scalar subquery, command sub-plan) runs
+        # entirely inside the outer "execute" timer — recording its
+        # fetch separately would overlap the phases
+        prof = profiler.current_profile()
+        nested = prof is not None and prof.is_open("execute")
+        with profiler.maybe_phase("execute"):
+            self._pre_eval_subqueries(plan)
+            batch = self.run(plan)
+        with contextlib.nullcontext() if nested \
+                else profiler.maybe_phase("fetch"):
+            table = ai.to_arrow(batch)
+            names = [f.name for f in plan.schema]
+            return table.rename_columns(names)
 
     def run(self, plan: pn.PlanNode) -> HostBatch:
         method = getattr(self, "_exec_" + type(plan).__name__, None)
@@ -446,18 +482,32 @@ class LocalExecutor:
 
     def _jitted(self, key, dict_objs: Tuple, builder):
         """Returns (fn, aux) where fn is jit-compiled and cached when the
-        key is hashable, else built fresh and run eagerly."""
+        key is hashable, else built fresh and run eagerly.
+
+        Compile accounting: every call is a compile-cache hit or miss
+        (``execution.compile.{cache_hit_count,cache_miss_count}`` and the
+        active query profile); a miss additionally times the jitted
+        program's FIRST invocation — where jax traces and XLA compiles —
+        as ``execution.compile.compile_time``."""
         import jax
 
+        from .. import profiler
+
         if key is None:
+            # unhashable plan key: uncached eager build — still a miss
+            profiler.note_compile_cache(hit=False)
             fn, aux = builder()
             return fn, aux
 
         def build():
+            missed.append(True)
             fn, aux = builder()
-            return jax.jit(fn), aux
+            return _compile_timed(jax.jit(fn), key), aux
 
-        return _OP_CACHE.get(key, dict_objs, build)
+        missed: list = []
+        value = _OP_CACHE.get(key, dict_objs, build)
+        profiler.note_compile_cache(hit=not missed)
+        return value
 
     # ------------------------------------------------------------------
     # leaves
@@ -1782,6 +1832,8 @@ class LocalExecutor:
         tmpdir = tempfile.mkdtemp(prefix="sail_join_spill_")
         self._last_join_spill_dir = tmpdir  # observable in tests
         _record_metric("execution.spill_count", 1, kind="join")
+        from .. import profiler
+        spill_bytes = 0
         sides = []
         for name, table, h in (("l", lt, lh), ("r", rt, rh)):
             paths = []
@@ -1790,8 +1842,10 @@ class LocalExecutor:
                 sub = table.filter(pa.array(mask))
                 fp = os.path.join(tmpdir, f"{name}{part}.parquet")
                 pq.write_table(sub, fp)
+                spill_bytes += os.path.getsize(fp)
                 paths.append(fp)
             sides.append(paths)
+        profiler.note_spill_bytes(spill_bytes)
         del lt, rt
 
         from .. import telemetry as tel
@@ -1955,6 +2009,9 @@ class LocalExecutor:
                     perm = perm[:p.limit]
                 paths = list(pf)
             del table
+            from .. import profiler
+            profiler.note_spill_bytes(
+                sum(os.path.getsize(fp) for fp in paths))
             tel.note("SpillSortPrefetch", f"{len(paths)} runs",
                      **pf.stats.as_extra())
 

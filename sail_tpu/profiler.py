@@ -1,0 +1,453 @@
+"""Per-query profiler + in-process flight recorder.
+
+Reference role: the compile/data-movement accounting that Flare and
+Theseus show is the prerequisite for optimizing a native/accelerator
+query engine (PAPERS.md), grafted onto sail's telemetry surface. One
+``QueryProfile`` is threaded from the session entry point through the
+planner and both executors, recording
+
+- phase wall times in execution order: parse, resolve, optimize,
+  compile, execute, fetch. Parse/resolve/optimize/execute/fetch are
+  disjoint; compile is accounted *inside* execute — it is the JIT wall
+  time of operator cache misses — so it does not sum with the others;
+- JIT accounting from the compiled-operator cache: hits, misses, and
+  per-key compile wall time (also exported through the registry as
+  ``execution.compile.{cache_hit_count,cache_miss_count,compile_time}``);
+- device-transfer and spill bytes;
+- per-operator metrics (under EXPLAIN ANALYZE) and, in cluster mode,
+  per-task operator metrics merged per {stage, partition}.
+
+Completed profiles land in a bounded flight-recorder ring (newest N),
+plus a slow-query log that retains queries above
+``spark.sail.telemetry.slowQueryMs`` even after the ring evicts them.
+Both surfaces are SQL-queryable via ``system.telemetry.query_profiles``
+and ``system.telemetry.active_queries`` and ride the OTLP exporter as a
+``query`` span with the phase breakdown as attributes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .metrics import record as _record_metric
+
+logger = logging.getLogger("sail_tpu.profiler")
+
+#: canonical phase order for rendering (a profile only reports phases it
+#: actually entered, in first-entry order)
+PHASES = ("parse", "resolve", "optimize", "compile", "execute", "fetch")
+
+_STATEMENT_MAX = 4096
+
+
+@dataclass
+class QueryProfile:
+    query_id: str
+    statement: str = ""
+    session: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    status: str = "running"          # running | succeeded | failed
+    error: str = ""
+    # phase → accumulated wall ms, insertion-ordered by first entry
+    phases: Dict[str, float] = field(default_factory=dict)
+    compile_cache_hits: int = 0
+    compile_cache_misses: int = 0
+    compile_ms: float = 0.0
+    # per-key compile events: [{"key": str, "ms": float}]
+    compile_events: List[dict] = field(default_factory=list)
+    transfer_bytes: int = 0
+    spill_bytes: int = 0
+    rows_out: int = 0
+    slow: bool = False
+    # operator metric trees (dicts, telemetry.OperatorMetrics.to_dict)
+    operators: List[dict] = field(default_factory=list)
+    # cluster mode: per-task operator metrics, one entry per
+    # {stage, partition} of the last distributed job
+    tasks: List[dict] = field(default_factory=list)
+    trace_id: Optional[str] = None
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
+    # stack of phases currently OPEN on this profile (nested executors
+    # re-enter "execute"; re-entry must not double-count)
+    _open: List[str] = field(default_factory=list, repr=False)
+
+    # -- recording -----------------------------------------------------
+    def add_phase(self, name: str, ms: float) -> None:
+        with self._lock:
+            self.phases[name] = self.phases.get(name, 0.0) + ms
+
+    @contextmanager
+    def phase(self, name: str):
+        with self._lock:
+            reentered = name in self._open
+            if not reentered:
+                self._open.append(name)
+        if reentered:
+            # a nested executor re-opened the same phase (e.g. a scalar
+            # subquery executing inside "execute"): the outer timer
+            # already covers this wall time
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            with self._lock:
+                if name in self._open:
+                    self._open.remove(name)
+            self.add_phase(name, (time.perf_counter() - t0) * 1000.0)
+
+    def is_open(self, name: str) -> bool:
+        with self._lock:
+            return name in self._open
+
+    def note_compile(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.compile_cache_hits += 1
+            else:
+                self.compile_cache_misses += 1
+
+    def note_compile_time(self, seconds: float, key: str = "") -> None:
+        ms = seconds * 1000.0
+        with self._lock:
+            self.compile_ms += ms
+            self.phases["compile"] = self.phases.get("compile", 0.0) + ms
+            if len(self.compile_events) < 256:
+                self.compile_events.append(
+                    {"key": key[:120], "ms": round(ms, 3)})
+
+    def note_transfer(self, nbytes: int) -> None:
+        with self._lock:
+            self.transfer_bytes += int(nbytes)
+
+    def note_spill(self, nbytes: int) -> None:
+        with self._lock:
+            self.spill_bytes += int(nbytes)
+
+    def add_task(self, stage: int, partition: int, worker_id: str,
+                 operators: List[dict], rows_out: int = 0) -> None:
+        """Merge one distributed task's operator metrics (driver side)."""
+        with self._lock:
+            self.tasks = [t for t in self.tasks
+                          if not (t["stage"] == stage
+                                  and t["partition"] == partition)]
+            self.tasks.append({
+                "stage": int(stage), "partition": int(partition),
+                "worker_id": worker_id, "rows_out": int(rows_out),
+                "operators": operators})
+
+    # -- shape ---------------------------------------------------------
+    @property
+    def total_ms(self) -> float:
+        end = self.end_time or time.time()
+        return max(0.0, (end - self.start_time) * 1000.0)
+
+    def current_phase(self) -> str:
+        with self._lock:
+            if self._open:          # the phase actually RUNNING now
+                return self._open[-1]
+            names = [n for n in self.phases if n != "compile"]
+        return names[-1] if names else "submitted"
+
+    def phase_items(self) -> List:
+        """(name, ms) in canonical order, then any custom phases."""
+        with self._lock:
+            phases = dict(self.phases)
+        out = [(n, phases.pop(n)) for n in PHASES if n in phases]
+        out.extend(sorted(phases.items()))
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "query_id": self.query_id,
+            "statement": self.statement,
+            "session": self.session,
+            "status": self.status,
+            "error": self.error,
+            "start_time": self.start_time,
+            "total_ms": round(self.total_ms, 3),
+            "phases": {n: round(ms, 3) for n, ms in self.phase_items()},
+            "compile": {
+                "cache_hits": self.compile_cache_hits,
+                "cache_misses": self.compile_cache_misses,
+                "time_ms": round(self.compile_ms, 3),
+                "events": list(self.compile_events),
+            },
+            "transfer_bytes": self.transfer_bytes,
+            "spill_bytes": self.spill_bytes,
+            "rows_out": self.rows_out,
+            "slow": self.slow,
+            "operators": list(self.operators),
+            "tasks": list(self.tasks),
+            "trace_id": self.trace_id,
+        }
+
+    def render(self) -> str:
+        """Human text: the EXPLAIN ANALYZE phase header."""
+        lines = [f"total: {self.total_ms:.1f}ms"]
+        for name, ms in self.phase_items():
+            extra = ""
+            if name == "compile":
+                extra = (f" (cache hits={self.compile_cache_hits} "
+                         f"misses={self.compile_cache_misses})")
+            lines.append(f"phase {name}: {ms:.1f}ms{extra}")
+        if self.transfer_bytes:
+            lines.append(f"device transfer: {self.transfer_bytes} bytes")
+        if self.spill_bytes:
+            lines.append(f"spill: {self.spill_bytes} bytes")
+        if self.tasks:
+            from .telemetry import OperatorMetrics
+            lines.append(f"tasks: {len(self.tasks)}")
+            for t in sorted(self.tasks, key=lambda t: (t["stage"],
+                                                       t["partition"])):
+                lines.append(f"  stage {t['stage']} partition "
+                             f"{t['partition']} ({t['worker_id']}) "
+                             f"rows={t['rows_out']}")
+                for op in t["operators"]:
+                    lines.append(
+                        OperatorMetrics.from_dict(op).render(indent=2))
+        return "\n".join(lines)
+
+
+class FlightRecorder:
+    """Bounded in-process store of completed profiles.
+
+    ``capacity`` newest profiles ride the ring; queries whose total time
+    exceeded the slow threshold are retained separately in a
+    ``slow_capacity``-bounded log so a burst of fast queries cannot
+    evict the evidence of a slow one."""
+
+    def __init__(self, capacity: int = 128, slow_capacity: int = 64):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self._slow: deque = deque(maxlen=max(1, int(slow_capacity)))
+        self._active: "OrderedDict[str, QueryProfile]" = OrderedDict()
+
+    def start(self, profile: QueryProfile) -> None:
+        with self._lock:
+            self._active[profile.query_id] = profile
+            while len(self._active) > 1024:  # leak guard
+                self._active.popitem(last=False)
+
+    def finish(self, profile: QueryProfile) -> None:
+        with self._lock:
+            self._active.pop(profile.query_id, None)
+            self._ring.append(profile)
+            if profile.slow:
+                self._slow.append(profile)
+
+    def discard(self, profile: QueryProfile) -> None:
+        with self._lock:
+            self._active.pop(profile.query_id, None)
+
+    def profiles(self) -> List[QueryProfile]:
+        """Completed profiles, newest first: ring ∪ retained slow log."""
+        with self._lock:
+            seen = set()
+            out = []
+            for p in list(self._ring)[::-1] + list(self._slow)[::-1]:
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    out.append(p)
+        return out
+
+    def active(self) -> List[QueryProfile]:
+        with self._lock:
+            return list(self._active.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._slow.clear()
+            self._active.clear()
+
+
+def _recorder_from_config() -> FlightRecorder:
+    from .config import get as config_get
+    try:
+        cap = int(config_get("telemetry.profile_ring_capacity", 128))
+        slow_cap = int(config_get("telemetry.slow_log_capacity", 64))
+    except (TypeError, ValueError):
+        cap, slow_cap = 128, 64
+    return FlightRecorder(cap, slow_cap)
+
+
+FLIGHT_RECORDER = _recorder_from_config()
+
+_local = threading.local()
+
+#: default slow-query threshold when the session conf doesn't set
+#: spark.sail.telemetry.slowQueryMs (0 disables the slow log)
+DEFAULT_SLOW_QUERY_MS = 1000.0
+
+
+def current_profile() -> Optional[QueryProfile]:
+    return getattr(_local, "profile", None)
+
+
+def _slow_threshold_ms(conf) -> float:
+    value = None
+    if conf is not None:
+        get = getattr(conf, "get", None)
+        if get is not None:
+            value = get("spark.sail.telemetry.slowQueryMs")
+    if value is None:
+        from .config import get as config_get
+        value = config_get("telemetry.slow_query_ms",
+                           DEFAULT_SLOW_QUERY_MS)
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return DEFAULT_SLOW_QUERY_MS
+
+
+@contextmanager
+def profile_query(statement: str = "", session: str = "", conf=None,
+                  enabled: bool = True):
+    """Open (or join) the thread's query profile.
+
+    The OUTERMOST caller owns the profile: nested entries (commands that
+    re-enter ``_execute_query``, subqueries, the cluster runner inside a
+    session query) accumulate into the active profile instead of
+    fragmenting one query into many records.
+
+    ``enabled=False`` yields a detached throwaway profile that is never
+    recorded — used for fetches of already-profiled results (a command's
+    LocalRelation output) so they don't pollute the flight recorder."""
+    existing = current_profile()
+    if existing is not None:
+        yield existing
+        return
+    if not enabled:
+        yield QueryProfile(query_id="", statement=statement,
+                           start_time=time.time())
+        return
+    profile = QueryProfile(
+        query_id=uuid.uuid4().hex[:16],
+        statement=(statement or "")[:_STATEMENT_MAX],
+        session=session, start_time=time.time())
+    from . import tracing as tr
+    profile.trace_id = tr.current_trace_id()
+    _local.profile = profile
+    FLIGHT_RECORDER.start(profile)
+    try:
+        yield profile
+    except BaseException as e:
+        profile.status = "failed"
+        profile.error = f"{type(e).__name__}: {e}"[:512]
+        raise
+    else:
+        profile.status = "succeeded"
+    finally:
+        _local.profile = None
+        profile.end_time = time.time()
+        threshold = _slow_threshold_ms(conf)
+        profile.slow = bool(threshold > 0
+                            and profile.total_ms >= threshold)
+        FLIGHT_RECORDER.finish(profile)
+        _finalize(profile, threshold)
+
+
+def _finalize(profile: QueryProfile, threshold_ms: float) -> None:
+    """Post-completion export: registry counter, slow-query log line,
+    and an OTLP ``query`` span carrying the phase breakdown. Must never
+    raise into the query path."""
+    try:
+        _record_metric("execution.query_count", 1,
+                       session=profile.session or "default")
+    except Exception:  # noqa: BLE001 — telemetry must never break queries
+        pass
+    try:
+        if profile.slow:
+            logger.warning(
+                "slow query %s: %.0fms (threshold %.0fms): %s",
+                profile.query_id, profile.total_ms, threshold_ms,
+                profile.statement[:200])
+        from . import tracing as tr
+        if tr._exporter() is not None:
+            attrs = {"query.id": profile.query_id,
+                     "query.status": profile.status,
+                     "query.rows_out": profile.rows_out,
+                     "query.compile.cache_hits":
+                         profile.compile_cache_hits,
+                     "query.compile.cache_misses":
+                         profile.compile_cache_misses,
+                     "query.transfer_bytes": profile.transfer_bytes,
+                     "query.spill_bytes": profile.spill_bytes}
+            for name, ms in profile.phase_items():
+                attrs[f"query.phase.{name}_ms"] = round(ms, 3)
+            start_ns = int(profile.start_time * 1e9)
+            end_ns = int((profile.end_time or profile.start_time) * 1e9)
+            span = tr.Span(
+                trace_id=profile.trace_id or uuid.uuid4().hex,
+                span_id=uuid.uuid4().hex[:16], parent_id=None,
+                name="query", start_ns=start_ns, end_ns=end_ns,
+                attributes=attrs,
+                status_ok=profile.status == "succeeded")
+            tr._exporter().add(span)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# ---------------------------------------------------------------------------
+# recording helpers for the executors (cheap no-ops without a profile)
+# ---------------------------------------------------------------------------
+
+@contextmanager
+def maybe_phase(name: str):
+    """Time a phase on the current profile; transparent without one."""
+    profile = current_profile()
+    if profile is None:
+        yield
+        return
+    with profile.phase(name):
+        yield
+
+
+def note_compile_cache(hit: bool) -> None:
+    try:
+        _record_metric("execution.compile.cache_hit_count" if hit
+                       else "execution.compile.cache_miss_count", 1)
+    except Exception:  # noqa: BLE001
+        pass
+    profile = current_profile()
+    if profile is not None:
+        profile.note_compile(hit)
+
+
+def note_compile_time(seconds: float, key: str = "") -> None:
+    try:
+        _record_metric("execution.compile.compile_time", float(seconds))
+    except Exception:  # noqa: BLE001
+        pass
+    profile = current_profile()
+    if profile is not None:
+        profile.note_compile_time(seconds, key)
+
+
+def note_transfer_bytes(nbytes: int) -> None:
+    profile = current_profile()
+    if profile is not None:
+        profile.note_transfer(nbytes)
+
+
+def note_spill_bytes(nbytes: int) -> None:
+    profile = current_profile()
+    if profile is not None:
+        profile.note_spill(nbytes)
+
+
+def last_profile() -> Optional[QueryProfile]:
+    """Most recently completed profile (bench / tests convenience)."""
+    profiles = FLIGHT_RECORDER.profiles()
+    return profiles[0] if profiles else None

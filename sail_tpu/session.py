@@ -51,6 +51,8 @@ class SparkSession:
     builder = None  # replaced below by a property-like descriptor
 
     def __init__(self, conf: Optional[Dict[str, str]] = None):
+        import uuid
+        from collections import OrderedDict
         self.conf = SessionConf(conf or {})
         self.catalog_manager = CatalogManager()
         from .exec.local import LocalExecutor
@@ -58,26 +60,63 @@ class SparkSession:
         self.catalog = Catalog(self)
         self.udf = self.catalog_manager.udfs
         self.dataSource = _DataSourceRegistry(self.catalog_manager)
+        self._session_id = uuid.uuid4().hex[:8]
+        # SQL text + parse wall time per root plan, consumed when the
+        # plan executes so the query profile can carry both
+        self._parsed: "OrderedDict[int, tuple]" = OrderedDict()
 
     # -- plan execution ----------------------------------------------------
     def _resolve(self, plan: sp.QueryPlan):
+        from . import profiler
         from .plan.optimizer import optimize
         from .plan.resolver import Resolver
-        node = Resolver(self.catalog_manager).resolve(plan)
-        return optimize(node)
+        with profiler.maybe_phase("resolve"):
+            node = Resolver(self.catalog_manager).resolve(plan)
+        with profiler.maybe_phase("optimize"):
+            return optimize(node)
+
+    def _note_parsed(self, plan: sp.QueryPlan, text: str,
+                     parse_ms: float, exempt: bool = False) -> None:
+        import weakref
+        try:
+            ref = weakref.ref(plan)
+        except TypeError:
+            return
+        self._parsed[id(plan)] = (ref, text, parse_ms, exempt)
+        while len(self._parsed) > 128:
+            self._parsed.popitem(last=False)
+
+    def _parsed_info(self, plan: sp.QueryPlan):
+        entry = self._parsed.get(id(plan))
+        if entry is not None and entry[0]() is plan:
+            return entry[1], entry[2], entry[3]
+        return "", 0.0, False
 
     def _execute_query(self, plan: sp.QueryPlan) -> pa.Table:
+        from . import profiler
         from .utils.tz import reset_session_timezone, set_session_timezone
-        token = set_session_timezone(
-            self.conf.get("spark.sql.session.timeZone") or "UTC")
-        try:
-            node = self._resolve(plan)
-            mesh_table = self._try_mesh_execute(node)
-            if mesh_table is not None:
-                return mesh_table
-            return self._executor_cls(dict(self.conf.items())).execute(node)
-        finally:
-            reset_session_timezone(token)
+        text, parse_ms, exempt = self._parsed_info(plan)
+        with profiler.profile_query(text, session=self._session_id,
+                                    conf=self.conf,
+                                    enabled=not exempt) as prof:
+            if parse_ms and "parse" not in prof.phases:
+                prof.add_phase("parse", parse_ms)
+            token = set_session_timezone(
+                self.conf.get("spark.sql.session.timeZone") or "UTC")
+            try:
+                node = self._resolve(plan)
+                # the executors record their own execute/fetch phases
+                # (LocalExecutor.execute); the mesh attempt is wrapped
+                # here because it returns a finished table
+                with profiler.maybe_phase("execute"):
+                    table = self._try_mesh_execute(node)
+                if table is None:
+                    table = self._executor_cls(
+                        dict(self.conf.items())).execute(node)
+                prof.rows_out = table.num_rows
+                return table
+            finally:
+                reset_session_timezone(token)
 
     def _try_mesh_execute(self, node) -> Optional[pa.Table]:
         """SPMD path: when the plan splits into co-resident stages and the
@@ -107,11 +146,25 @@ class SparkSession:
 
     # -- entry points -------------------------------------------------------
     def sql(self, query: str) -> "DataFrame":
+        import time as _t
+        from . import profiler
         from .sql import parse_one
+        t0 = _t.perf_counter()
         plan = parse_one(query)
+        parse_ms = (_t.perf_counter() - t0) * 1000.0
         if isinstance(plan, sp.CommandPlan):
-            table = self._execute_command(plan)
-            return DataFrame(sp.LocalRelation(table), self)
+            # commands execute eagerly: the profile covers the whole
+            # statement here; lazy queries profile at action time
+            with profiler.profile_query(query, session=self._session_id,
+                                        conf=self.conf) as prof:
+                prof.add_phase("parse", parse_ms)
+                table = self._execute_command(plan)
+            # the command was profiled above; fetching its materialized
+            # result must not record a second, anonymous profile
+            result = sp.LocalRelation(table)
+            self._note_parsed(result, query, 0.0, exempt=True)
+            return DataFrame(result, self)
+        self._note_parsed(plan, query, parse_ms)
         return DataFrame(plan, self)
 
     @property
@@ -313,14 +366,38 @@ class SparkSession:
             node = self._resolve(cmd.query)
             if cmd.mode == "analyze":
                 import time as _t
+                from . import profiler
                 from . import telemetry as tel
+                prof = profiler.current_profile()
                 t0 = _t.perf_counter()
                 with tel.collect_metrics() as collector:
-                    self._executor_cls(dict(self.conf.items())).execute(node)
+                    # LocalExecutor.execute records execute/fetch phases
+                    result = self._executor_cls(
+                        dict(self.conf.items())).execute(node)
                 total_ms = (_t.perf_counter() - t0) * 1000
-                text = f"total: {total_ms:.1f}ms\n" + \
-                    "\n".join(m.render() for m in collector)
+                ops = [m.to_dict() for m in collector]
+                if prof is not None:
+                    prof.operators = ops
+                    prof.rows_out = result.num_rows
+                if cmd.format == "json":
+                    import json as _json
+                    payload = prof.to_dict() if prof is not None else \
+                        {"total_ms": round(total_ms, 3), "operators": ops}
+                    # the analyzed execution IS complete — the profile
+                    # just hasn't closed yet (rendering happens inside it)
+                    payload["status"] = "succeeded"
+                    payload["plan"] = explain(node)
+                    text = _json.dumps(payload, indent=2, default=str)
+                else:
+                    header = prof.render() if prof is not None else \
+                        f"total: {total_ms:.1f}ms"
+                    text = "\n".join(
+                        [header] + [m.render() for m in collector])
                 return pa.table({"plan": pa.array([text])})
+            if cmd.format == "json":
+                import json as _json
+                return pa.table({"plan": pa.array(
+                    [_json.dumps({"plan": explain(node)}, indent=2)])})
             return pa.table({"plan": pa.array([explain(node)])})
         if isinstance(cmd, sp.CacheTable):
             if cmd.query is not None:
@@ -791,6 +868,9 @@ class SessionConf:
         pf_depth = app.get("execution.scan_prefetch_depth")
         if pf_depth is not None:  # 0 is meaningful: disables pipelining
             base["spark.sail.scan.prefetchDepth"] = str(pf_depth)
+        slow_ms = app.get("telemetry.slow_query_ms")
+        if slow_ms is not None:  # 0 is meaningful: disables the slow log
+            base["spark.sail.telemetry.slowQueryMs"] = str(slow_ms)
         self._DEFAULTS = base
         self._conf = dict(conf)
 

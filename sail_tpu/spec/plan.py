@@ -355,7 +355,8 @@ class WriteDataSource(CommandPlan):
 @dataclass(frozen=True)
 class Explain(CommandPlan):
     query: QueryPlan
-    mode: str = "simple"  # simple|extended|codegen|cost|formatted
+    mode: str = "simple"  # simple|extended|codegen|cost|formatted|analyze
+    format: str = "text"  # text | json (EXPLAIN [ANALYZE] FORMAT JSON)
 
 
 @dataclass(frozen=True)

@@ -180,7 +180,10 @@ class Parser:
             m = self.accept_kw("EXTENDED", "CODEGEN", "COST", "FORMATTED", "ANALYZE")
             if m:
                 mode = m.lower()
-            return pl.Explain(self.parse_statement(), mode)
+            fmt = "text"
+            if self.accept_kw("FORMAT"):
+                fmt = self.expect_kw("JSON", "TEXT").lower()
+            return pl.Explain(self.parse_statement(), mode, fmt)
         if self.at_kw("CACHE"):
             self.advance()
             lazy = self.accept_kw("LAZY") is not None

@@ -45,6 +45,33 @@ class OperatorMetrics:
                 f"time={self.elapsed_ms:.1f}ms{more}]")
         return "\n".join([line] + [c.render(indent + 1) for c in self.children])
 
+    def to_dict(self) -> dict:
+        """JSON-safe shape — the wire format for cluster task metrics and
+        the EXPLAIN ANALYZE FORMAT JSON operator tree."""
+        out = {"operator": self.operator, "output_rows": self.output_rows,
+               "capacity": self.capacity,
+               "elapsed_ms": round(self.elapsed_ms, 3)}
+        if self.detail:
+            out["detail"] = self.detail
+        if self.extra:
+            out["extra"] = {k: (v if isinstance(v, (int, float, bool,
+                                                    str, type(None)))
+                                else str(v))
+                            for k, v in self.extra.items()}
+        if self.children:
+            out["children"] = [c.to_dict() for c in self.children]
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OperatorMetrics":
+        m = cls(str(d.get("operator", "?")), str(d.get("detail", "")))
+        m.output_rows = int(d.get("output_rows", 0))
+        m.capacity = int(d.get("capacity", 0))
+        m.elapsed_ms = float(d.get("elapsed_ms", 0.0))
+        m.extra = dict(d.get("extra") or {})
+        m.children = [cls.from_dict(c) for c in d.get("children") or ()]
+        return m
+
 
 _local = threading.local()
 
@@ -93,10 +120,14 @@ def operator_span(name: str, detail: str = ""):
         span_cm.__enter__()
     try:
         yield m
-    except BaseException:
+    except BaseException as e:
         # aborted spans (e.g. a fused attempt that fell back) don't record
+        # metrics, but the OTel span must carry the exception and error
+        # status — exiting with the real exc_info makes start_as_current_span
+        # record the exception and set ERROR status; exiting with
+        # (None, None, None) silently reported failed operators as OK
         if span_cm is not None:
-            span_cm.__exit__(None, None, None)
+            span_cm.__exit__(type(e), e, e.__traceback__)
         _local.collector = parent
         raise
     else:

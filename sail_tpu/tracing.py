@@ -134,6 +134,10 @@ class OtlpHttpExporter:
     records to ``/v1/logs`` (the reference's log-export pipeline,
     sail-telemetry src/telemetry.rs)."""
 
+    #: seconds between overflow warnings (one line per outage burst, not
+    #: one per dropped span)
+    DROP_WARN_INTERVAL_S = 30.0
+
     def __init__(self, endpoint: str, service_name: str = "sail-tpu",
                  flush_interval_s: float = 1.0, max_batch: int = 512):
         self.endpoint = endpoint.rstrip("/")
@@ -142,10 +146,30 @@ class OtlpHttpExporter:
         self._buf: List[Span] = []
         self._log_buf: List[LogEvent] = []
         self._buf_lock = threading.Lock()
+        self._last_drop_warn = 0.0
+        self.dropped = {"spans": 0, "logs": 0}
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, args=(flush_interval_s,), daemon=True)
         self._thread.start()
+
+    def _note_dropped(self, signal: str, count: int):
+        """Account buffer-overflow drops: registry counter + ONE
+        rate-limited warning per outage window (called outside the
+        buffer lock — the warning itself re-enters add_log through the
+        stdlib bridge)."""
+        try:
+            from .metrics import record as _record_metric
+            _record_metric("telemetry.export.dropped_count", count,
+                           signal=signal)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            pass
+        now = time.monotonic()
+        if now - self._last_drop_warn >= self.DROP_WARN_INTERVAL_S:
+            self._last_drop_warn = now
+            logging.getLogger("sail_tpu.tracing").warning(
+                "OTLP export buffer overflow: dropped %d %s "
+                "(collector unreachable or slow)", count, signal)
 
     def add(self, s: Span):
         """Enqueue only — span exit must never do network I/O on the hot
@@ -153,14 +177,24 @@ class OtlpHttpExporter:
         oldest spans under sustained collector outage."""
         with self._buf_lock:
             self._buf.append(s)
+            dropped = 0
             if len(self._buf) > 16 * self.max_batch:
-                del self._buf[: 8 * self.max_batch]
+                dropped = 8 * self.max_batch
+                del self._buf[:dropped]
+                self.dropped["spans"] += dropped
+        if dropped:
+            self._note_dropped("spans", dropped)
 
     def add_log(self, ev: LogEvent):
         with self._buf_lock:
             self._log_buf.append(ev)
+            dropped = 0
             if len(self._log_buf) > 16 * self.max_batch:
-                del self._log_buf[: 8 * self.max_batch]
+                dropped = 8 * self.max_batch
+                del self._log_buf[:dropped]
+                self.dropped["logs"] += dropped
+        if dropped:
+            self._note_dropped("logs", dropped)
 
     def _loop(self, interval: float):
         while not self._stop.wait(interval):

@@ -288,3 +288,38 @@ def test_tpch_distribution_matrix():
     must_distribute = {1, 3, 4, 5, 6, 7, 8, 9, 10, 12, 14, 18, 19}
     missing = must_distribute - dist_set
     assert not missing, f"queries regressed to local-only: {missing}"
+
+
+def test_task_metrics_merge_into_driver_profile(cluster):
+    """Workers ship per-operator metrics in the task-completion report;
+    the driver merges them into the query profile per {stage, partition}
+    — EXPLAIN ANALYZE visibility below the stage boundary."""
+    from sail_tpu import profiler
+
+    spark = SparkSession({})
+    df = pd.DataFrame({"g": np.arange(400) % 4, "v": np.arange(400)})
+    spark.createDataFrame(df).createOrReplaceTempView("tmerge")
+    plan = _plan_for(spark,
+                     "SELECT g, sum(v) AS s FROM tmerge GROUP BY g")
+    with profiler.profile_query("distributed agg") as prof:
+        out = cluster.run_job(plan, num_partitions=2)
+    assert out.num_rows == 4
+
+    # the driver job kept the raw per-task metrics…
+    tm = cluster.task_metrics()
+    assert tm, "no task metrics reported by the workers"
+    # …and they merged into the active profile per {stage, partition}
+    assert prof.tasks
+    keyed = {(t["stage"], t["partition"]) for t in prof.tasks}
+    assert keyed == set(tm)
+    assert len({s for s, _ in keyed}) >= 2  # below the stage boundary
+    for t in prof.tasks:
+        assert t["worker_id"].startswith("worker-")
+        assert t["operators"], t
+        ops = {o["operator"] for o in t["operators"]}
+        assert ops, t
+        for o in t["operators"]:
+            assert "elapsed_ms" in o and "output_rows" in o
+    # the merged tasks render in the profile's text form
+    text = prof.render()
+    assert "stage 0 partition 0" in text

@@ -1,0 +1,158 @@
+"""OTLP exporter failure modes: an unreachable collector must never
+block or slow the query path, the bounded buffer drops with accounting,
+and failed operator spans carry the exception."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from sail_tpu import metrics as gm
+from sail_tpu import tracing as tr
+
+
+def _unreachable_endpoint() -> str:
+    # grab a port nobody is listening on
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    gm.REGISTRY.reset()
+    yield
+    gm.REGISTRY.reset()
+
+
+def test_span_exit_nonblocking_with_unreachable_collector():
+    tr.configure_exporter(_unreachable_endpoint())
+    try:
+        t0 = time.perf_counter()
+        for _ in range(50):
+            with tr.span("hot-path"):
+                pass
+        elapsed = time.perf_counter() - t0
+        # span exit only appends to the in-memory buffer; 50 spans must
+        # complete orders of magnitude under any network timeout
+        assert elapsed < 1.0, elapsed
+    finally:
+        tr.configure_exporter(None)
+
+
+def test_flush_swallows_connection_errors():
+    tr.configure_exporter(_unreachable_endpoint())
+    try:
+        with tr.span("doomed"):
+            pass
+        tr.log_event("INFO", "doomed log")
+        tr.flush()  # must not raise despite the dead collector
+    finally:
+        tr.configure_exporter(None)
+
+
+def test_shutdown_terminates_promptly():
+    exp = tr.OtlpHttpExporter(_unreachable_endpoint(),
+                              flush_interval_s=3600.0)
+    exp.add(tr.Span("0" * 32, "1" * 16, None, "s",
+                    time.time_ns(), time.time_ns()))
+    t0 = time.perf_counter()
+    exp.shutdown()
+    assert time.perf_counter() - t0 < 5.0
+    assert exp._stop.is_set()
+
+
+def test_bounded_buffer_counts_drops():
+    # flush_interval 3600: the background thread never drains the buffer
+    # during the test, so the overflow path is deterministic
+    exp = tr.OtlpHttpExporter(_unreachable_endpoint(),
+                              flush_interval_s=3600.0, max_batch=2)
+    cap = 16 * exp.max_batch
+    try:
+        for i in range(cap + 1):
+            exp.add(tr.Span("0" * 32, "1" * 16, None, f"s{i}",
+                            time.time_ns(), time.time_ns()))
+        assert exp.dropped["spans"] == 8 * exp.max_batch
+        assert len(exp._buf) <= cap
+        for i in range(cap + 1):
+            exp.add_log(tr.LogEvent(time.time_ns(), 9, "INFO", f"l{i}"))
+        assert exp.dropped["logs"] == 8 * exp.max_batch
+        snap = {(r["name"], r["attributes"]): r["value"]
+                for r in gm.REGISTRY.snapshot()}
+        assert snap[("telemetry.export.dropped_count",
+                     json.dumps({"signal": "spans"}))] == 16
+        assert snap[("telemetry.export.dropped_count",
+                     json.dumps({"signal": "logs"}))] == 16
+    finally:
+        exp.shutdown()
+
+
+def test_drop_warning_rate_limited(caplog):
+    import logging
+    exp = tr.OtlpHttpExporter(_unreachable_endpoint(),
+                              flush_interval_s=3600.0, max_batch=2)
+    try:
+        with caplog.at_level(logging.WARNING, logger="sail_tpu.tracing"):
+            for _ in range(3):  # three overflow events in one window
+                for i in range(16 * exp.max_batch + 1):
+                    exp.add(tr.Span("0" * 32, "1" * 16, None, "s",
+                                    time.time_ns(), time.time_ns()))
+        warns = [r for r in caplog.records
+                 if "buffer overflow" in r.getMessage()]
+        assert len(warns) == 1  # rate-limited to one per window
+    finally:
+        exp.shutdown()
+
+
+class _FakeCM:
+    """Captures what operator_span hands to the OTel span context
+    manager — start_as_current_span records the exception and sets
+    ERROR status exactly when __exit__ receives real exc_info."""
+
+    def __init__(self, events):
+        self._events = events
+
+    def __enter__(self):
+        return object()
+
+    def __exit__(self, et, ev, tb):
+        self._events["exit"] = (et, ev, tb)
+
+
+class _FakeTracer:
+    def __init__(self, events):
+        self._events = events
+
+    def start_as_current_span(self, name):
+        self._events["name"] = name
+        return _FakeCM(self._events)
+
+
+def test_operator_span_exits_with_exception_info(monkeypatch):
+    from sail_tpu import telemetry as tel
+
+    events = {}
+    monkeypatch.setattr(tel, "_TRACER", _FakeTracer(events))
+    with pytest.raises(ValueError, match="boom"):
+        with tel.collect_metrics():
+            with tel.operator_span("Exploding"):
+                raise ValueError("boom")
+    et, ev, tb = events["exit"]
+    assert et is ValueError
+    assert isinstance(ev, ValueError) and str(ev) == "boom"
+    assert tb is not None  # full traceback reaches the span
+
+
+def test_operator_span_success_exits_clean(monkeypatch):
+    from sail_tpu import telemetry as tel
+
+    events = {}
+    monkeypatch.setattr(tel, "_TRACER", _FakeTracer(events))
+    with tel.collect_metrics() as collected:
+        with tel.operator_span("Fine") as m:
+            m.output_rows = 1
+    assert events["exit"] == (None, None, None)
+    assert len(collected) == 1

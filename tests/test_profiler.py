@@ -1,0 +1,308 @@
+"""Query profiler + flight recorder: phase breakdown, compile-cache
+accounting, ring eviction/slow retention, EXPLAIN ANALYZE JSON shape,
+and the system.telemetry.{query_profiles,active_queries} tables."""
+
+import json
+import time
+
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession, profiler
+
+
+@pytest.fixture
+def spark():
+    s = SparkSession({"spark.sail.execution.mesh": "off"})
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def small_view(spark):
+    spark.createDataFrame(pd.DataFrame(
+        {"g": [1, 2, 1, 2, 3], "v": [10, 20, 30, 40, 50]})) \
+        .createOrReplaceTempView("pt")
+    return spark
+
+
+# ---------------------------------------------------------------------------
+# phase timings
+# ---------------------------------------------------------------------------
+
+def test_phase_presence_and_ordering(small_view):
+    spark = small_view
+    spark.sql("SELECT g, sum(v) s FROM pt GROUP BY g").toPandas()
+    prof = profiler.last_profile()
+    assert prof is not None and prof.status == "succeeded"
+    names = [n for n, _ in prof.phase_items()]
+    for required in ("parse", "resolve", "optimize", "execute", "fetch"):
+        assert required in names, names
+    # canonical execution order
+    canon = [n for n in profiler.PHASES if n in names]
+    assert names[:len(canon)] == canon
+    assert all(ms >= 0.0 for _, ms in prof.phase_items())
+    assert prof.rows_out == 3
+    assert prof.statement.startswith("SELECT g")
+
+
+def test_profile_total_covers_phases(small_view):
+    spark = small_view
+    spark.sql("SELECT v FROM pt WHERE v > 15").toPandas()
+    prof = profiler.last_profile()
+    non_overlap = sum(ms for n, ms in prof.phase_items()
+                      if n != "compile")  # compile overlaps execute
+    assert prof.total_ms >= non_overlap * 0.5  # sanity, not exact
+
+
+def test_failed_query_profile_records_error(small_view):
+    spark = small_view
+    with pytest.raises(Exception):
+        spark.sql("SELECT no_such_column FROM pt").toPandas()
+    prof = profiler.last_profile()
+    assert prof.status == "failed"
+    assert prof.error
+
+
+# ---------------------------------------------------------------------------
+# compile-cache accounting
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_hits_and_misses_across_repeats(small_view):
+    from sail_tpu.exec.local import clear_caches
+    spark = small_view
+    clear_caches()
+    sql = "SELECT g, sum(v) AS s FROM pt WHERE v > 0 GROUP BY g"
+    spark.sql(sql).toPandas()
+    first = profiler.last_profile()
+    assert first.compile_cache_misses > 0
+    assert first.compile_ms > 0.0  # JIT wall time of the cache misses
+    spark.sql(sql).toPandas()
+    second = profiler.last_profile()
+    assert second.query_id != first.query_id
+    assert second.compile_cache_hits > 0
+    assert second.compile_cache_misses == 0
+    assert second.compile_ms == 0.0
+
+
+def test_compile_metrics_registered(small_view):
+    from sail_tpu.exec.local import clear_caches
+    from sail_tpu.metrics import REGISTRY
+    spark = small_view
+    clear_caches()
+    spark.sql("SELECT v + 1 AS w FROM pt WHERE v > 0").toPandas()
+    snap = {r["name"]: r["value"] for r in REGISTRY.snapshot()}
+    assert snap.get("execution.compile.cache_miss_count", 0) >= 1
+    assert snap.get("execution.compile.compile_time", 0) > 0
+    spark.sql("SELECT v + 1 AS w FROM pt WHERE v > 0").toPandas()
+    snap = {r["name"]: r["value"] for r in REGISTRY.snapshot()}
+    assert snap.get("execution.compile.cache_hit_count", 0) >= 1
+
+
+def test_transfer_bytes_recorded(small_view):
+    spark = small_view
+    spark.sql("SELECT g, v FROM pt").toPandas()
+    prof = profiler.last_profile()
+    assert prof.transfer_bytes > 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring eviction + slow retention
+# ---------------------------------------------------------------------------
+
+def test_ring_eviction_keeps_newest():
+    rec = profiler.FlightRecorder(capacity=3, slow_capacity=4)
+    for i in range(6):
+        p = profiler.QueryProfile(query_id=f"q{i}",
+                                  start_time=time.time())
+        p.end_time = time.time()
+        rec.start(p)
+        rec.finish(p)
+    got = [p.query_id for p in rec.profiles()]
+    assert got == ["q5", "q4", "q3"]
+
+
+def test_slow_profiles_survive_ring_eviction():
+    rec = profiler.FlightRecorder(capacity=2, slow_capacity=4)
+    slow = profiler.QueryProfile(query_id="slow0",
+                                 start_time=time.time())
+    slow.end_time = time.time()
+    slow.slow = True
+    rec.start(slow)
+    rec.finish(slow)
+    for i in range(4):  # push the slow one out of the ring
+        p = profiler.QueryProfile(query_id=f"fast{i}",
+                                  start_time=time.time())
+        p.end_time = time.time()
+        rec.start(p)
+        rec.finish(p)
+    ids = [p.query_id for p in rec.profiles()]
+    assert ids[:2] == ["fast3", "fast2"]   # ring kept the newest
+    assert "slow0" in ids                  # slow log retained it
+
+
+def test_slow_query_classified_by_conf_threshold(monkeypatch, small_view):
+    spark = small_view
+    spark.conf.set("spark.sail.telemetry.slowQueryMs", "1")
+    spark.sql("SELECT g, sum(v) s FROM pt GROUP BY g ORDER BY g") \
+        .toPandas()
+    prof = profiler.last_profile()
+    assert prof.slow is True
+    spark.conf.set("spark.sail.telemetry.slowQueryMs", "0")  # disabled
+    spark.sql("SELECT g FROM pt").toPandas()
+    assert profiler.last_profile().slow is False
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE
+# ---------------------------------------------------------------------------
+
+def test_explain_analyze_tpch_phase_breakdown():
+    from sail_tpu.benchmarks.tpch_data import register_tpch
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+    from sail_tpu.exec.local import clear_caches
+
+    spark = SparkSession({"spark.sail.execution.mesh": "off"})
+    try:
+        register_tpch(spark, sf=0.01)
+        clear_caches()
+        text = spark.sql("EXPLAIN ANALYZE " + QUERIES[6]) \
+            .toPandas().plan[0]
+    finally:
+        spark.stop()
+    assert "total:" in text
+    for phase in ("phase parse:", "phase resolve:", "phase optimize:",
+                  "phase compile:", "phase execute:"):
+        assert phase in text, text
+    # non-zero compile/execute split after a cold cache
+    compile_ms = float(
+        [ln for ln in text.splitlines()
+         if ln.startswith("phase compile:")][0]
+        .split(":")[1].split("ms")[0])
+    execute_ms = float(
+        [ln for ln in text.splitlines()
+         if ln.startswith("phase execute:")][0]
+        .split(":")[1].split("ms")[0])
+    assert compile_ms > 0.0 and execute_ms > 0.0
+    assert "misses=" in text  # cache accounting on the compile line
+    assert "ScanExec" in text  # operator tree still renders
+
+
+def test_explain_analyze_format_json_shape(small_view):
+    spark = small_view
+    out = spark.sql(
+        "EXPLAIN ANALYZE FORMAT JSON "
+        "SELECT g, sum(v) s FROM pt GROUP BY g").toPandas().plan[0]
+    doc = json.loads(out)
+    assert {"query_id", "phases", "compile", "operators",
+            "plan"} <= set(doc)
+    assert "execute" in doc["phases"]
+    assert {"cache_hits", "cache_misses", "time_ms"} \
+        <= set(doc["compile"])
+    assert isinstance(doc["operators"], list) and doc["operators"]
+    ops = json.dumps(doc["operators"])
+    assert "ScanExec" in ops
+    assert doc["rows_out"] == 3
+    assert doc["status"] == "succeeded"  # the analyzed run is complete
+
+
+def test_explain_format_defaults_to_text(small_view):
+    spark = small_view
+    out = spark.sql("EXPLAIN SELECT g FROM pt").toPandas().plan[0]
+    with pytest.raises(ValueError):
+        json.loads(out)  # plain text plan, not JSON
+
+
+# ---------------------------------------------------------------------------
+# system tables
+# ---------------------------------------------------------------------------
+
+def test_query_profiles_system_table(small_view):
+    spark = small_view
+    spark.sql("SELECT g, sum(v) s FROM pt GROUP BY g").toPandas()
+    qid = profiler.last_profile().query_id
+    got = spark.sql(
+        "SELECT query_id, status, total_ms, execute_ms, rows_out, "
+        "compile_cache_hits, compile_cache_misses, profile_json "
+        f"FROM system.telemetry.query_profiles "
+        f"WHERE query_id = '{qid}'").toPandas()
+    assert len(got) == 1
+    row = got.iloc[0]
+    assert row.status == "succeeded"
+    assert row.total_ms > 0 and row.execute_ms > 0
+    assert row.rows_out == 3
+    doc = json.loads(row.profile_json)
+    assert doc["query_id"] == qid and "phases" in doc
+
+
+def test_active_queries_sees_running_query(small_view):
+    spark = small_view
+    # the SELECT over active_queries is itself the running query: it
+    # must observe its own in-flight profile
+    got = spark.sql("SELECT query_id, phase, statement "
+                    "FROM system.telemetry.active_queries").toPandas()
+    assert len(got) >= 1
+    assert "active_queries" in " ".join(got.statement.tolist())
+
+
+def test_subquery_fetch_not_recorded_inside_execute(monkeypatch,
+                                                    small_view):
+    spark = small_view
+    calls = []
+    orig = profiler.QueryProfile.add_phase
+
+    def spy(self, name, ms):
+        calls.append(name)
+        orig(self, name, ms)
+
+    monkeypatch.setattr(profiler.QueryProfile, "add_phase", spy)
+    out = spark.sql(
+        "SELECT g FROM pt WHERE v > (SELECT avg(v) FROM pt)").toPandas()
+    assert set(out.g) == {2, 3}
+    # the scalar subquery's inner executor must not record its own
+    # fetch while the outer execute timer is open — phases stay
+    # disjoint (execute may accumulate from the sequential mesh-attempt
+    # wrapper plus the local executor; that is not an overlap)
+    assert calls.count("fetch") == 1, calls
+
+
+def test_command_result_fetch_not_reprofiled(small_view):
+    spark = small_view
+    before = {p.query_id for p in profiler.FLIGHT_RECORDER.profiles()}
+    spark.sql("SHOW TABLES").toPandas()
+    new = [p for p in profiler.FLIGHT_RECORDER.profiles()
+           if p.query_id not in before]
+    # exactly ONE profile — the command itself, not a second anonymous
+    # record for fetching its LocalRelation result
+    assert len(new) == 1, [p.statement for p in new]
+    assert new[0].statement == "SHOW TABLES"
+    assert profiler.last_profile().statement == "SHOW TABLES"
+
+
+def test_current_phase_reports_open_phase():
+    p = profiler.QueryProfile(query_id="x", start_time=time.time())
+    assert p.current_phase() == "submitted"
+    with p.phase("execute"):
+        assert p.current_phase() == "execute"  # the RUNNING phase
+        with p.phase("fetch"):
+            assert p.current_phase() == "fetch"
+        assert p.current_phase() == "execute"
+    # once idle: the most recently COMPLETED phase (execute closed last)
+    assert p.current_phase() == "execute"
+
+
+def test_reentered_phase_not_double_counted():
+    p = profiler.QueryProfile(query_id="y", start_time=time.time())
+    with p.phase("execute"):
+        with p.phase("execute"):  # nested executor re-enters
+            pass
+        # the inner exit must NOT have recorded a partial duration
+        assert "execute" not in p.phases
+    assert p.phases["execute"] > 0.0
+
+
+def test_profile_query_nesting_joins_outer():
+    with profiler.profile_query("outer") as outer:
+        with profiler.profile_query("inner") as inner:
+            assert inner is outer
+    assert outer.status == "succeeded"

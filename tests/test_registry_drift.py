@@ -1,0 +1,69 @@
+"""Registry-drift static check: every metric name recorded anywhere in
+sail_tpu/ must be declared in metrics_registry.yaml, and every declared
+instrument must have at least one call site — declarations cannot drift
+from the code."""
+
+import os
+import re
+
+import yaml
+
+SRC_ROOT = os.path.join(os.path.dirname(__file__), os.pardir, "sail_tpu")
+REGISTRY_PATH = os.path.join(SRC_ROOT, "metrics_registry.yaml")
+
+# first string-literal argument of record(...) / _record_metric(...);
+# metric names are always dotted, which keeps unrelated record() calls
+# (e.g. SystemRegistry.record_task) out of the match
+_CALL_RE = re.compile(
+    r"(?:\b_record_metric|\brecord)\(\s*[\"']([a-z0-9_]+(?:\.[a-z0-9_]+)+)[\"']")
+# any dotted metric-ish string literal (covers conditional expressions
+# like record("a.hit" if hit else "a.miss", ...) for the orphan check)
+_LITERAL_RE = re.compile(r"[\"']([a-z0-9_]+(?:\.[a-z0-9_]+)+)[\"']")
+
+
+def _iter_sources():
+    for dirpath, _dirnames, filenames in os.walk(SRC_ROOT):
+        for fn in filenames:
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                with open(path, "r", encoding="utf-8") as f:
+                    yield path, f.read()
+
+
+def _declared_names():
+    with open(REGISTRY_PATH, "r", encoding="utf-8") as f:
+        entries = yaml.safe_load(f) or []
+    return {e["name"] for e in entries}
+
+
+def test_every_recorded_metric_is_declared():
+    declared = _declared_names()
+    undeclared = {}
+    for path, src in _iter_sources():
+        for name in _CALL_RE.findall(src):
+            if name not in declared:
+                undeclared.setdefault(name, []).append(
+                    os.path.relpath(path, SRC_ROOT))
+    assert not undeclared, (
+        f"metric names recorded but not declared in "
+        f"metrics_registry.yaml: {undeclared}")
+
+
+def test_no_orphan_registry_entries():
+    declared = _declared_names()
+    used = set()
+    for _path, src in _iter_sources():
+        used.update(_LITERAL_RE.findall(src))
+    orphans = declared - used
+    assert not orphans, (
+        f"metrics declared in metrics_registry.yaml but never recorded "
+        f"anywhere under sail_tpu/: {sorted(orphans)}")
+
+
+def test_registry_loads_and_names_are_unique():
+    with open(REGISTRY_PATH, "r", encoding="utf-8") as f:
+        entries = yaml.safe_load(f) or []
+    names = [e["name"] for e in entries]
+    assert len(names) == len(set(names))
+    for e in entries:
+        assert e.get("type") in ("counter", "gauge"), e
