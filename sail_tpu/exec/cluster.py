@@ -356,6 +356,12 @@ class WorkerActor(Actor):
             plan = jg.decode_fragment(task.plan, task.partition,
                                       max(task.num_partitions, 1))
             plan = _resolve_driver_scans(plan, task)
+            if task.runtime_filters_json:
+                # driver-derived runtime join filters: prune this task's
+                # scan before upload/shuffle (applied before stage inputs
+                # attach so scan ordinals match the driver's counting)
+                plan = jg.apply_task_runtime_filters(
+                    plan, task.runtime_filters_json)
             if task.inputs:
                 plan = jg.attach_stage_inputs(plan, self._fetch_inputs(task))
             if self._running.get(key, threading.Event()).is_set():
@@ -794,7 +800,8 @@ class DriverActor(Actor):
             job_id=job.job_id, stage=stage_id, partition=partition,
             attempt=attempt, plan=encode_cached(job, stage),
             num_partitions=stage.num_partitions, inputs=inputs,
-            driver_addr=self.addr)
+            driver_addr=self.addr,
+            runtime_filters_json=job.graph.stage_filters.get(stage_id, ""))
         if stage.shuffle_keys is not None and stage.num_channels > 1:
             task.shuffle_write.CopyFrom(pb.ShuffleWriteSpec(
                 key_columns=list(stage.shuffle_keys),

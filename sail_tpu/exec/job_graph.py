@@ -69,6 +69,10 @@ class JobGraph:
     stages: List[Stage]
     # memory tables stripped out of scan nodes, served by the driver
     scan_tables: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # runtime join filters the driver derived from broadcast-side tables
+    # it hosts: stage_id → JSON entries shipped on that stage's tasks
+    # (TaskDefinition.runtime_filters_json)
+    stage_filters: Dict[int, str] = dataclasses.field(default_factory=dict)
 
     @property
     def root(self) -> Stage:
@@ -487,7 +491,231 @@ def split_job(plan: pn.PlanNode, num_partitions: int) -> Optional[JobGraph]:
                  (StageInput(top.stage_id, InputMode.MERGE),), 1,
                  on_driver=True)
     b.stages.append(root)
-    return JobGraph(b.stages, b.scan_tables)
+    graph = JobGraph(b.stages, b.scan_tables)
+    from ..config import get as config_get
+
+    def _on(key):
+        return str(config_get(key, "true")).strip().lower() \
+            not in ("0", "false", "no", "off")
+
+    # both the cluster gate AND the runtime-filter master switch must be
+    # on (SAIL_JOIN__RUNTIME_FILTER__ENABLED=0 kills cluster shipping
+    # along with every other filter site)
+    if _on("cluster.runtime_filters") and _on("join.runtime_filter.enabled"):
+        try:
+            graph.stage_filters = compute_runtime_filters(graph)
+        except Exception:  # noqa: BLE001 — filters are advisory
+            graph.stage_filters = {}
+    return graph
+
+
+# ---------------------------------------------------------------------------
+# Cluster runtime join filters: the driver holds broadcast-side memory
+# tables, so it can derive min/max (+ exact key lists) filters BEFORE any
+# task launches and ship them with the probe-scan stage's tasks. Workers
+# attach the entries as runtime_predicates on their scan fragment —
+# parquet row groups skip on the conjuncts; driver-hosted scan slices
+# filter host-side after fetch. Always sound: the driver table is the
+# UNFILTERED build input, so its key set is a superset of the build keys.
+# ---------------------------------------------------------------------------
+
+def compute_runtime_filters(graph: JobGraph) -> Dict[int, str]:
+    from ..config import get as config_get
+    from ..plan import runtime_filters as rtfp
+
+    try:
+        cap = int(config_get("join.runtime_filter.in_list_max", 8192))
+    except (TypeError, ValueError):
+        cap = 8192
+    stages_by_id = {s.stage_id: s for s in graph.stages}
+    out: Dict[int, List[dict]] = {}
+    for stage in graph.stages:
+        for node in pn.walk_plan(stage.plan):
+            if not (isinstance(node, pn.JoinExec)
+                    and node.join_type in ("inner", "semi")
+                    and node.left_keys and not node.null_aware):
+                continue
+            for lk, rk in zip(node.left_keys, node.right_keys):
+                if not (isinstance(lk, rx.BoundRef)
+                        and isinstance(rk, rx.BoundRef)):
+                    continue
+                col = _driver_build_column(node.right, rk.index,
+                                           stages_by_id, graph)
+                if col is None:
+                    continue
+                probe = _probe_scan_target(node.left, lk.index,
+                                           stages_by_id,
+                                           default_stage=stage.stage_id)
+                if probe is None:
+                    continue
+                stage_id, scan_ord, col_idx, field = probe
+                if not rtfp.supports_bounds(field.dtype):
+                    continue
+                entry = _filter_entry(col, field, scan_ord, col_idx, cap)
+                if entry is not None:
+                    out.setdefault(stage_id, []).append(entry)
+    return {sid: json.dumps(entries) for sid, entries in out.items()}
+
+
+def _driver_build_column(p: pn.PlanNode, idx: int, stages_by_id,
+                         graph: JobGraph):
+    """Resolve a build-side key column to a driver-hosted table column
+    through Filter/simple-Project chains (the unfiltered column is a
+    sound superset of the filtered build keys). Returns a pyarrow
+    ChunkedArray or None."""
+    while True:
+        if isinstance(p, StageInputExec):
+            stage = stages_by_id.get(p.stage_id)
+            if stage is None:
+                return None
+            p = stage.plan
+            continue
+        if isinstance(p, pn.FilterExec):
+            p = p.input
+            continue
+        if isinstance(p, pn.ProjectExec):
+            if idx >= len(p.exprs):
+                return None
+            e = p.exprs[idx][1]
+            if not isinstance(e, rx.BoundRef):
+                return None
+            idx = e.index
+            p = p.input
+            continue
+        if isinstance(p, pn.ScanExec):
+            if idx >= len(p.schema):
+                return None
+            name = p.schema[idx].name
+            if p.format == "__driver__":
+                table = graph.scan_tables.get(p.table_name)
+            elif p.source is not None:
+                table = p.source
+            else:
+                return None
+            if table is None or table.num_rows > BROADCAST_ROW_LIMIT \
+                    or name not in table.column_names:
+                return None
+            return table.column(name)
+        return None
+
+
+def _probe_scan_target(p: pn.PlanNode, idx: int, stages_by_id,
+                       default_stage: int):
+    """Trace a probe-side key column to a worker-scanned leaf through
+    key-preserving operators, possibly crossing into a producer stage.
+    Returns (stage_id, scan_ordinal, column_index, field) or None."""
+    stage_id = default_stage
+    while True:
+        if isinstance(p, StageInputExec):
+            stage = stages_by_id.get(p.stage_id)
+            if stage is None:
+                return None
+            stage_id = stage.stage_id
+            p = stage.plan
+            continue
+        if isinstance(p, pn.FilterExec):
+            p = p.input
+            continue
+        if isinstance(p, pn.ProjectExec):
+            if idx >= len(p.exprs):
+                return None
+            e = p.exprs[idx][1]
+            if not isinstance(e, rx.BoundRef):
+                return None
+            idx = e.index
+            p = p.input
+            continue
+        if isinstance(p, pn.ScanExec):
+            if idx >= len(p.schema):
+                return None
+            if not (p.format in ("parquet", "__driver__")
+                    or p.source is not None):
+                return None
+            stage = stages_by_id.get(stage_id)
+            if stage is None:
+                return None
+            scans = [n for n in pn.walk_plan(stage.plan)
+                     if isinstance(n, pn.ScanExec)]
+            for ord_, s in enumerate(scans):
+                if s is p:
+                    return stage_id, ord_, idx, p.schema[idx]
+            return None
+        return None
+
+
+def _filter_entry(col, field, scan_ord: int, col_idx: int,
+                  cap: int):
+    import pyarrow.compute as pc
+
+    from ..spec import data_type as dt_
+
+    def raw(v):
+        if v is None:
+            return None
+        if isinstance(field.dtype, dt_.DateType):
+            return (v - datetime.date(1970, 1, 1)).days
+        return int(v)
+
+    try:
+        mm = pc.min_max(col)
+        lo, hi = raw(mm["min"].as_py()), raw(mm["max"].as_py())
+    except Exception:  # noqa: BLE001 — filters are advisory
+        return None
+    if lo is None or hi is None:
+        lo, hi = 1, 0  # empty/all-null build: an always-false range
+    entry = {"scan": scan_ord, "column": col_idx, "name": field.name,
+             "min": lo, "max": hi}
+    try:
+        vals = pc.unique(col.combine_chunks()
+                         if hasattr(col, "combine_chunks") else col)
+        vals = vals.drop_null()
+        if len(vals) <= cap:
+            entry["values"] = [raw(v) for v in vals.to_pylist()]
+    except Exception:  # noqa: BLE001
+        pass
+    return entry
+
+
+def apply_task_runtime_filters(plan: pn.PlanNode,
+                               filters_json: str) -> pn.PlanNode:
+    """Worker side: attach driver-shipped runtime filters to this task's
+    scan fragment (scans matched by walk-order ordinal, which the codec
+    round-trip and per-partition slicing both preserve)."""
+    from ..metrics import record as _record_metric
+    from ..plan import runtime_filters as rtfp
+
+    try:
+        entries = json.loads(filters_json)
+    except ValueError:
+        return plan
+    if not isinstance(entries, list):
+        return plan
+    for e in entries:
+        scans = [n for n in pn.walk_plan(plan)
+                 if isinstance(n, pn.ScanExec)]
+        try:
+            scan = scans[int(e["scan"])]
+            idx = int(e["column"])
+            field = scan.schema[idx]
+            if field.name != e.get("name") or \
+                    not rtfp.supports_bounds(field.dtype):
+                continue
+            vals = e.get("values")
+            conjs = rtfp.bounds_conjuncts(
+                idx, field, int(e["min"]), int(e["max"]),
+                None if vals is None else [int(v) for v in vals])
+        except (KeyError, IndexError, TypeError, ValueError):
+            continue
+        plan = _replace_subtree(
+            plan, scan, dataclasses.replace(
+                scan,
+                runtime_predicates=scan.runtime_predicates + conjs))
+        try:
+            _record_metric("execution.runtime_filter.pushed_count", 1,
+                           site="cluster")
+        except Exception:  # noqa: BLE001
+            pass
+    return plan
 
 
 def _find_distributable_subtree(b: "_Builder", plan: pn.PlanNode):

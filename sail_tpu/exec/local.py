@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -364,17 +364,54 @@ def _compile_timed(fn, key):
 
 _OP_CACHE = _OpCache()
 _SCAN_CACHE: Dict = {}
+# runtime join filters: join-structure key → last observed prune ratio
+# (scan + probe pruning over probed rows); joins whose filters proved
+# useless skip the build on later executions (adaptive)
+_RTF_HISTORY: Dict = {}
+
+
+class _RtfConf(NamedTuple):
+    """spark.sail.join.runtimeFilter.* resolved for one executor."""
+
+    enabled: bool
+    min_build_rows: int
+    max_bits: int
+    in_list_max: int
+    ndv_ratio: float
+    min_selectivity: float
+
+
+class _Rtf(NamedTuple):
+    """A built runtime filter, ready to mask the filtered side."""
+
+    bits: object           # device bool[num_bits] bloom bit array
+    kmin: object           # device uint64 packed/hashed key bounds
+    kmax: object
+    ordinals: Tuple[int, ...]  # join-key ordinals folded into the bloom
+    num_bits: int
+    fids: Tuple[int, ...]      # annotated filter ids (scan stat lookup)
+    history_key: object        # adaptive-skip key (None if unhashable)
+    pushed: int                # scan targets that received conjuncts
+    # False: built from the build (right) side, masks the probe side.
+    # True: built from the probe (left) side, masks the build side —
+    # the direction that wins when join reordering made the FACT table
+    # the build side of the topmost joins.
+    reverse: bool = False
 
 
 def clear_caches():
     _OP_CACHE.entries.clear()
     _SCAN_CACHE.clear()
+    _RTF_HISTORY.clear()
 
 
 class LocalExecutor:
     def __init__(self, config: Optional[dict] = None):
         self.config = config or {}
         self._subquery_cache: Dict[int, LV] = {}
+        # runtime join filters: per-fid (rows_before, rows_after) scan
+        # pruning observed while executing this plan (adaptive feedback)
+        self._rtf_scan_stats: Dict[int, Tuple[int, int]] = {}
 
     # ------------------------------------------------------------------
     def execute(self, plan: pn.PlanNode) -> pa.Table:
@@ -528,8 +565,9 @@ class LocalExecutor:
             if p.projection is not None:
                 table = table.select(list(p.projection))
             return _positional(ai.from_arrow(table))
+        rtf_preds = p.runtime_predicates
         if p.source is not None:
-            cache_key = ("mem", id(p.source), p.projection)
+            cache_key = ("mem", id(p.source), p.projection, rtf_preds)
         elif p.format == "delta":
             from ..lakehouse.delta import DeltaLog
             files = p.paths
@@ -544,31 +582,83 @@ class LocalExecutor:
             except OSError:
                 files, mtimes = p.paths, ()
             cache_key = ("file", files, mtimes, p.projection, p.predicates,
+                         rtf_preds,
                          tuple(sorted(dict(p.options).items())),
                          tuple((f.name, f.dtype) for f in p.schema))
         hit = _SCAN_CACHE.get(cache_key)
         if hit is not None:
-            src_ref, hb = hit
+            src_ref, hb, rtf_stats = hit
             if p.source is None or src_ref is p.source:
+                self._note_rtf_scan(p, rtf_stats)
                 return hb
+        rtf_stats = None
         if p.source is not None:
             table = p.source
             if p.projection is not None:
                 table = table.select(list(p.projection))
+            if rtf_preds:
+                # runtime join-filter conjuncts: prune probe rows HOST-side
+                # before upload, so every downstream kernel runs at the
+                # pruned (bucketed) capacity
+                table, rtf_stats = _apply_runtime_predicates(
+                    table, rtf_preds, p.schema)
         else:
             filter_expr = None
-            if p.predicates and p.format == "parquet":
+            preds = p.predicates
+            if p.format == "parquet" and (preds or rtf_preds):
                 from ..io.formats import rex_predicates_to_arrow
-                filter_expr = rex_predicates_to_arrow(p.predicates, p.schema)
+                if rtf_preds:
+                    # runtime filter conjuncts join the static predicates
+                    # for parquet row-group/page skipping; fall back to
+                    # static-only if the combination fails to convert
+                    filter_expr = rex_predicates_to_arrow(
+                        preds + rtf_preds, p.schema)
+                if filter_expr is None and preds:
+                    filter_expr = rex_predicates_to_arrow(preds, p.schema)
             table = read_table(p.format, p.paths, dict(p.options),
                                columns=p.projection,
                                filter_expr=filter_expr)
             table = self._apply_declared_schema(table, p.schema)
+            if rtf_preds and filter_expr is not None and not p.predicates:
+                # adaptive evidence for parquet pruning: with no static
+                # predicates in the filter, footer row counts give the
+                # exact pre-filter cardinality for free
+                try:
+                    from ..io.cache import METADATA_CACHE
+                    before = sum(METADATA_CACHE.num_rows(f)
+                                 for f in files)
+                    rtf_stats = (int(before), table.num_rows)
+                except Exception:  # noqa: BLE001 — stats are advisory
+                    rtf_stats = None
         hb = _positional(ai.from_arrow(table))
+        self._note_rtf_scan(p, rtf_stats)
         while len(_SCAN_CACHE) > 64:
             _SCAN_CACHE.pop(next(iter(_SCAN_CACHE)))  # drop oldest
-        _SCAN_CACHE[cache_key] = (p.source, hb)
+        _SCAN_CACHE[cache_key] = (p.source, hb, rtf_stats)
         return hb
+
+    def _note_rtf_scan(self, p: pn.ScanExec, stats) -> None:
+        """Record one scan's runtime-filter pruning (executor-local for
+        the join's adaptive feedback, registry + profiler for
+        observability). Cache hits replay the cached stats: the pruning
+        is baked into the cached batch and still shapes this query."""
+        if not p.runtime_filters or stats is None:
+            return
+        before, after = stats
+        for t in p.runtime_filters:
+            self._rtf_scan_stats[t.fid] = (before, after)
+        pruned = before - after
+        if pruned <= 0:
+            return
+        from .. import profiler
+        from .. import telemetry as tel
+        _record_metric("execution.runtime_filter.rows_pruned", pruned,
+                       site="scan")
+        profiler.note_runtime_filter(rows_pruned=pruned)
+        if tel.current_collector() is not None:
+            tel.note("RuntimeFilter",
+                     f"scan {p.table_name or p.format}",
+                     rows_pruned=pruned, rows_in=before)
 
     @staticmethod
     def _apply_declared_schema(table: pa.Table, schema: pn.Schema) -> pa.Table:
@@ -1582,8 +1672,7 @@ class LocalExecutor:
     # joins
     # ------------------------------------------------------------------
     def _exec_JoinExec(self, p: pn.JoinExec) -> HostBatch:
-        left = self.run(p.left)
-        right = self.run(p.right)
+        left, right, rtf = self._run_join_inputs(p)
         jt = p.join_type
         if jt == "anti" and p.null_aware:
             return self._null_aware_anti(p, left, right)
@@ -1611,7 +1700,7 @@ class LocalExecutor:
                                                  len(p.right.schema)))
             out = self._join(flipped, right, left)
             return _reorder_right(out, len(p.right.schema), len(p.left.schema))
-        return self._join(p, left, right)
+        return self._join(p, left, right, rtf=rtf)
 
     def _null_aware_anti(self, p: pn.JoinExec, left: HostBatch,
                          right: HostBatch) -> HostBatch:
@@ -1649,8 +1738,304 @@ class LocalExecutor:
                             out.dicts)
         return out
 
+    # -- runtime join filters (sideways information passing) -----------
+    def _run_join_inputs(self, p: pn.JoinExec):
+        """Run a join's children. For runtime-filter-annotated inner/semi
+        joins the estimated-SMALLER side runs first; a filter derived
+        from its keys is pushed into the other subtree's annotated scans
+        before that side executes, and a device bloom mask is handed to
+        ``_join`` for the filtered side's selection. Forward = build
+        (right) filters probe; reverse = probe (left) filters build —
+        the direction that matters when join reordering made the fact
+        table the build side of the topmost joins."""
+        conf = self._rtf_conf()
+        use = (conf.enabled and p.runtime_filters and p.left_keys
+               and p.join_type in ("inner", "semi") and not p.null_aware)
+        if not use:
+            return self.run(p.left), self.run(p.right), None
+        try:
+            est_l, est_r = _rtf_est_rows(p.left), _rtf_est_rows(p.right)
+        except Exception:  # noqa: BLE001 — estimation is advisory
+            est_l = est_r = None
+        reverse = (est_l is not None and est_r is not None
+                   and est_l < est_r
+                   and any(t.side == "build" for t in p.runtime_filters))
+        if reverse:
+            left = self.run(p.left)
+            rtf, build_plan = self._rtf_prepare(p, left, conf, True,
+                                                est_l, est_r)
+            right = self.run(build_plan)
+        else:
+            right = self.run(p.right)
+            rtf, probe_plan = self._rtf_prepare(p, right, conf, False,
+                                                est_r, est_l)
+            left = self.run(probe_plan)
+        return left, right, rtf
+
+    def _rtf_conf(self) -> "_RtfConf":
+        from ..config import get as config_get
+
+        def setting(spark_key: str, app_key: str, default):
+            v = self.config.get(spark_key)
+            if v is None:
+                v = config_get(app_key, default)
+            return v
+
+        def as_bool(v) -> bool:
+            return str(v).strip().lower() not in ("0", "false", "off",
+                                                  "no")
+
+        def as_int(v, d: int) -> int:
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                return d
+
+        def as_float(v, d: float) -> float:
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                return d
+
+        pfx = "spark.sail.join.runtimeFilter."
+        apfx = "join.runtime_filter."
+        return _RtfConf(
+            enabled=as_bool(setting(pfx + "enabled",
+                                    apfx + "enabled", "true")),
+            min_build_rows=as_int(setting(pfx + "minBuildRows",
+                                          apfx + "min_build_rows", 0), 0),
+            max_bits=max(1024, as_int(setting(pfx + "maxBits",
+                                              apfx + "max_bits",
+                                              1 << 20), 1 << 20)),
+            in_list_max=as_int(setting(pfx + "inListMax",
+                                       apfx + "in_list_max", 8192), 8192),
+            ndv_ratio=as_float(setting(pfx + "ndvRatio",
+                                       apfx + "ndv_ratio", 0.75), 0.75),
+            min_selectivity=as_float(setting(pfx + "minSelectivity",
+                                             apfx + "min_selectivity",
+                                             0.02), 0.02))
+
+    def _rtf_history_key(self, p: pn.JoinExec, reverse: bool):
+        # the verdict must be specific to THIS query's join, not just its
+        # key/schema shape: the same `fact JOIN dim` with a different
+        # WHERE on dim has a completely different selectivity, so the
+        # fingerprint folds in every filter condition and scan identity
+        # reachable in both subtrees
+        def fingerprint(node: pn.PlanNode):
+            out = []
+            for n in pn.walk_plan(node):
+                if isinstance(n, pn.FilterExec):
+                    out.append(n.condition)
+                elif isinstance(n, pn.ScanExec):
+                    out.append((n.table_name, n.paths,
+                                id(n.source) if n.source is not None
+                                else None))
+            return tuple(out)
+
+        key = ("rtf_hist", reverse, p.left_keys, p.right_keys,
+               tuple((f.name, f.dtype) for f in p.left.schema),
+               tuple((f.name, f.dtype) for f in p.right.schema),
+               fingerprint(p.left), fingerprint(p.right))
+        try:
+            hash(key)
+            return key
+        except TypeError:
+            return None
+
+    def _rtf_prepare(self, p: pn.JoinExec, src: HostBatch,
+                     conf: "_RtfConf", reverse: bool,
+                     est_src, est_tgt):
+        """Build the runtime filter from the materialized SOURCE side
+        (build side forward, probe side reverse) and push value conjuncts
+        into the other subtree's annotated scans. Returns
+        (rtf-or-None, rewritten target subtree)."""
+        import time as _time
+
+        import jax
+
+        from .. import profiler
+        from ..ops import hash as hashk
+        from ..ops import runtime_filter as rtfk
+        from ..plan import runtime_filters as rtfp
+
+        src_node = p.left if reverse else p.right
+        src_keys = p.left_keys if reverse else p.right_keys
+        target_plan = p.right if reverse else p.left
+        wanted_side = "build" if reverse else "probe"
+        targets = tuple(t for t in p.runtime_filters
+                        if t.side == wanted_side)
+
+        hkey = self._rtf_history_key(p, reverse)
+        if hkey is not None:
+            past = _RTF_HISTORY.get(hkey)
+            if past is not None and past < conf.min_selectivity:
+                return None, target_plan  # observed useless: skip
+        # a filter only pays when its source side is smaller than the
+        # side it prunes: deriving one FROM a fact-sized side to prune a
+        # dimension-sized side costs more than the join saves
+        if est_src is not None and est_tgt is not None \
+                and est_src >= est_tgt:
+            return None, target_plan
+        t0 = _time.perf_counter()
+        try:
+            comp = self._compiler(src, src_node.schema)
+            compiled = [comp.compile(k) for k in src_keys]
+        except HostFallback:
+            return None, target_plan
+        # eligible key ordinals: device-hashable physical types whose key
+        # bits agree across sides WITHOUT dictionary unification (string
+        # keys use per-side code spaces, so they cannot ride the filter).
+        # The filter packs with the LEFT key's type — exactly the join's
+        # own convention (_compile_join_keys labels both sides with
+        # rex_type(lk)) — so source and filtered-side key bits agree.
+        ordinals = tuple(
+            i for i, (c, lk) in enumerate(zip(compiled, p.left_keys))
+            if c.dictionary is None
+            and getattr(rx.rex_type(lk), "physical_dtype", None)
+            in hashk._KEY_BITS)
+        if not ordinals:
+            return None, target_plan
+        num_bits = conf.max_bits
+        key = self._op_key("rtf_build", reverse, p.left_keys,
+                           p.right_keys, ordinals, num_bits,
+                           tuple((f.name, f.dtype)
+                                 for f in src_node.schema))
+
+        def builder():
+            bcomp = self._compiler(src, src_node.schema)
+            bcompiled = [bcomp.compile(src_keys[i]) for i in ordinals]
+            # LEFT key types, matching the join's key-bit convention
+            ktypes = [rx.rex_type(p.left_keys[i]) for i in ordinals]
+
+            def fn(scols, ssel):
+                kcols = []
+                usable = ssel
+                for c, kt in zip(bcompiled, ktypes):
+                    d, v = c.fn(scols)
+                    kcols.append(Column(d, v, kt))
+                    if v is not None:
+                        usable = usable & v
+                res = rtfk.build(kcols, ssel, num_bits)
+                bounds = tuple(rtfk.column_bounds(c.data, usable)
+                               for c in kcols)
+                datas = tuple(c.data for c in kcols)
+                return res, bounds, datas, usable
+
+            return fn, None
+
+        try:
+            fn, _ = self._jitted(key, self._dict_objs(src), builder)
+            res, bounds, datas, usable = fn(self._cols(src),
+                                            src.device.sel)
+        except HostFallback:
+            return None, target_plan
+        # one batched fetch for every host decision value; raw source key
+        # values ride along only when the source batch is small enough
+        # that exact in-list membership is worth extracting
+        fetch_values = src.device.capacity <= (1 << 17)
+        bundle = [res.n_build, res.ndv, bounds]
+        if fetch_values:
+            bundle.append((datas, usable))
+        fetched = jax.device_get(tuple(bundle))
+        n_build, ndv = int(fetched[0]), int(fetched[1])
+        host_bounds = fetched[2]
+        if n_build < conf.min_build_rows:
+            return None, target_plan
+        if n_build > 0:
+            # a filter cannot prune much when the source's distinct keys
+            # rival the filtered side's row count (the PK→PK shape)
+            if est_tgt is not None and ndv >= conf.ndv_ratio * est_tgt:
+                return None, target_plan
+        values_by_ord: Dict[int, object] = {}
+        if fetch_values:
+            datas_np, usable_np = fetched[3]
+            u = np.asarray(usable_np)
+            for oi, i in enumerate(ordinals):
+                vals = np.unique(np.asarray(datas_np[oi])[u])
+                if vals.size <= conf.in_list_max:
+                    values_by_ord[i] = vals
+        if n_build == 0:
+            # empty build: the device bounds are dtype-extreme sentinels
+            # (min > max) which can overflow date literals — an explicit
+            # always-false [1, 0] range prunes everything just the same
+            bounds_by_ord = {i: (1, 0) for i in ordinals}
+        else:
+            bounds_by_ord = {i: host_bounds[oi]
+                             for oi, i in enumerate(ordinals)}
+        pushed = 0
+        for t in targets:
+            if t.key not in bounds_by_ord:
+                continue
+            scan = rtfp.find_scan_by_fid(target_plan, t.fid)
+            if scan is None:
+                continue  # target scan lives outside this plan fragment
+            if scan.source is None and scan.format != "parquet":
+                continue
+            field = scan.schema[t.column]
+            if not rtfp.supports_bounds(field.dtype):
+                continue
+            lo, hi = bounds_by_ord[t.key]
+            try:
+                conjs = rtfp.bounds_conjuncts(
+                    t.column, field, int(lo), int(hi),
+                    values_by_ord.get(t.key))
+            except (OverflowError, ValueError):
+                continue  # out-of-range literal (exotic date values)
+            new_scan = dataclasses.replace(
+                scan,
+                runtime_predicates=scan.runtime_predicates + conjs)
+            target_plan = _replace_node(target_plan, scan, new_scan)
+            pushed += 1
+            _record_metric("execution.runtime_filter.pushed_count", 1,
+                           site="scan")
+        build_s = _time.perf_counter() - t0
+        _record_metric("execution.runtime_filter.built_count", 1)
+        _record_metric("execution.runtime_filter.build_time", build_s)
+        profiler.note_runtime_filter(built=1, pushed=pushed,
+                                     build_ms=build_s * 1000.0)
+        rtf = _Rtf(bits=res.bits, kmin=res.kmin, kmax=res.kmax,
+                   ordinals=ordinals, num_bits=num_bits,
+                   fids=tuple(t.fid for t in targets),
+                   history_key=hkey, pushed=pushed, reverse=reverse)
+        return rtf, target_plan
+
+    def _rtf_finish(self, rtf: "_Rtf", before: int, after: int) -> None:
+        """Post-join accounting: probe-mask pruning + adaptive history
+        (scan-site pruning for this join's fids folds in, so an effective
+        scan push does not read as a useless probe mask)."""
+        from .. import profiler
+        from .. import telemetry as tel
+
+        pruned = before - after
+        if pruned > 0:
+            _record_metric("execution.runtime_filter.rows_pruned", pruned,
+                           site="probe")
+            profiler.note_runtime_filter(rows_pruned=pruned)
+            if tel.current_collector() is not None:
+                tel.note("RuntimeFilter", "probe mask",
+                         rows_pruned=pruned, rows_in=before)
+        # adaptive verdict: only SCAN-site pruning pays — fewer rows
+        # decode/upload and every downstream kernel runs at the pruned
+        # capacity. The in-join selection mask prunes rows the join
+        # would reject anyway inside the SAME static-shape program, so a
+        # filter whose value conjuncts never landed at a scan is pure
+        # build overhead and stops rebuilding. Pushed-but-unmeasured
+        # scans (parquet behind static predicates) record NO verdict —
+        # the filter keeps building rather than being falsely condemned.
+        ratio = 0.0
+        measured = False
+        for fid in rtf.fids:
+            st = self._rtf_scan_stats.get(fid)
+            if st is not None and st[0] > 0:
+                measured = True
+                ratio = max(ratio, (st[0] - st[1]) / st[0])
+        if rtf.history_key is not None and (measured or rtf.pushed == 0):
+            while len(_RTF_HISTORY) > 256:
+                _RTF_HISTORY.pop(next(iter(_RTF_HISTORY)))
+            _RTF_HISTORY[rtf.history_key] = ratio
+
     def _compile_join_keys(self, p: pn.JoinExec, left: HostBatch, right: HostBatch,
-                           seed: int):
+                           seed: int, rtf_sig=None):
         """Builder for the jitted build+probe phase of an equi-join."""
         def builder():
             lcomp = self._compiler(left, p.left.schema)
@@ -1668,7 +2053,8 @@ class LocalExecutor:
                     ktype = dt.IntegerType()
                 pairs.append((lc, rc, ktype, luts))
 
-            def fn(lcols, lsel, rcols, rsel):
+            def fn(lcols, lsel, rcols, rsel, *rtf_args):
+                from ..ops import runtime_filter as rtfk
                 lkeys, rkeys = [], []
                 for lc, rc, ktype, luts in pairs:
                     ld, lv = lc.fn(lcols)
@@ -1678,6 +2064,28 @@ class LocalExecutor:
                         rd = luts[1][rd]
                     lkeys.append(Column(ld, lv, ktype))
                     rkeys.append(Column(rd, rv, ktype))
+                rtf_before = rtf_after = jnp.int64(0)
+                if rtf_sig is not None:
+                    # runtime join filter: mask the filtered side's
+                    # selection with the source side's bloom before the
+                    # build/probe (fused into this program — the counts
+                    # ride the existing batched host fetch, no extra
+                    # sync). Forward masks the probe; reverse masks the
+                    # build (a masked build row's key has no probe
+                    # partner, so it could never match).
+                    bits, kmin, kmax = rtf_args
+                    if rtf_sig[2]:  # reverse
+                        sub = [rkeys[i] for i in rtf_sig[0]]
+                        masked = rtfk.apply(bits, kmin, kmax, sub, rsel)
+                        rtf_before = jnp.sum(rsel.astype(jnp.int64))
+                        rtf_after = jnp.sum(masked.astype(jnp.int64))
+                        rsel = masked
+                    else:
+                        sub = [lkeys[i] for i in rtf_sig[0]]
+                        masked = rtfk.apply(bits, kmin, kmax, sub, lsel)
+                        rtf_before = jnp.sum(lsel.astype(jnp.int64))
+                        rtf_after = jnp.sum(masked.astype(jnp.int64))
+                        lsel = masked
                 bt = joink.build_side(rkeys, rsel, seed)
                 ambiguous = joink.hash_ambiguous(bt, rkeys) if not bt.exact \
                     else jnp.asarray(False)
@@ -1687,14 +2095,22 @@ class LocalExecutor:
                 inner_total = joink.join_output_count(ranges, lsel, "inner")
                 return (bt.perm, bt.sorted_keys, bt.num_valid,
                         ranges.lo, ranges.cnt, ranges.usable,
-                        has_dup, ambiguous, inner_total, bt.exact)
+                        has_dup, ambiguous, inner_total, bt.exact,
+                        rtf_before, rtf_after)
 
             return fn, None
         return builder
 
-    def _join(self, p: pn.JoinExec, left: HostBatch, right: HostBatch) -> HostBatch:
+    def _join(self, p: pn.JoinExec, left: HostBatch, right: HostBatch,
+              rtf=None) -> HostBatch:
         spilled = self._try_partitioned_join(p, left, right)
         if spilled is not None:
+            if rtf is not None:
+                # the spill path applies its own exact per-partition
+                # masks; the bloom goes unused, but the SCAN-site
+                # pruning already happened — record its verdict so a
+                # useless filter still shuts off adaptively
+                self._rtf_finish(rtf, 0, 0)
             return spilled
         jt = p.join_type
         schema_key = (tuple((f.name, f.dtype) for f in p.left.schema),
@@ -1704,21 +2120,30 @@ class LocalExecutor:
         rcols, rsel = self._cols(right), right.device.sel
         import jax
 
+        rtf_sig = None if rtf is None else (rtf.ordinals, rtf.num_bits,
+                                            rtf.reverse)
+        rtf_args = () if rtf is None else (rtf.bits, rtf.kmin, rtf.kmax)
         for seed in range(4):
             key = self._op_key("join_phase", p.left_keys, p.right_keys, seed,
-                               schema_key)
+                               schema_key, rtf_sig)
             fn, _ = self._jitted(key, dict_objs,
-                                 self._compile_join_keys(p, left, right, seed))
+                                 self._compile_join_keys(p, left, right, seed,
+                                                         rtf_sig))
             (perm, sorted_keys, num_valid, lo, cnt, usable,
-             has_dup_a, ambiguous, inner_total, exact) = fn(lcols, lsel, rcols, rsel)
+             has_dup_a, ambiguous, inner_total, exact,
+             rtf_before, rtf_after) = fn(lcols, lsel, rcols, rsel, *rtf_args)
             # one batched fetch for every host decision scalar (each
             # separate blocking read is a device round trip)
-            has_dup_a, ambiguous, inner_total, exact = jax.device_get(
-                (has_dup_a, ambiguous, inner_total, exact))
+            (has_dup_a, ambiguous, inner_total, exact, rtf_before,
+             rtf_after) = jax.device_get(
+                (has_dup_a, ambiguous, inner_total, exact, rtf_before,
+                 rtf_after))
             if exact or not bool(ambiguous):
                 break
         else:
             raise ExecutionError("could not build unambiguous hash join")
+        if rtf is not None:
+            self._rtf_finish(rtf, int(rtf_before), int(rtf_after))
         bt = joink.BuildTable(perm, sorted_keys, bool(exact), num_valid, seed)
         ranges = joink.MatchRanges(lo, cnt, usable)
         merged_dicts = dict(left.dicts)
@@ -1851,11 +2276,39 @@ class LocalExecutor:
         from .. import telemetry as tel
         from ..io.prefetch import Prefetcher
 
+        rtf_conf = self._rtf_conf()
+
+        def _empty_side(path):
+            return pq.ParquetFile(path).schema_arrow.empty_table()
+
         def load_pair(part):
             # producer thread: the next partition pair decodes from temp
-            # parquet while this thread joins the current pair on device
-            return (pq.read_table(sides[0][part]),
-                    pq.read_table(sides[1][part]))
+            # parquet while this thread joins the current pair on device.
+            # Parquet footer row counts short-circuit BEFORE any decode:
+            # a pair one side of which cannot contribute output skips
+            # entirely, and build-empty left/anti/full pairs decode the
+            # surviving side alone.
+            lp, rp = sides[0][part], sides[1][part]
+            ln = pq.ParquetFile(lp).metadata.num_rows
+            rn = pq.ParquetFile(rp).metadata.num_rows
+            jt = p.join_type
+            if jt in ("inner", "semi") and (ln == 0 or rn == 0):
+                return None
+            if ln == 0 and rn == 0:
+                return None
+            if jt in ("left", "anti") and ln == 0:
+                return None  # output rows come from the left side only
+            if jt in ("left", "anti", "full") and rn == 0:
+                return pq.read_table(lp), _empty_side(rp)
+            if jt == "full" and ln == 0:
+                return _empty_side(lp), pq.read_table(rp)
+            lsub, rsub = pq.read_table(lp), pq.read_table(rp)
+            if jt in ("inner", "semi") and rtf_conf.enabled:
+                # runtime-filter the decoded probe chunk against the
+                # build partition's exact key set before upload
+                lsub = _spill_probe_mask(lsub, lidx, rsub, ridx,
+                                         rtf_conf.in_list_max)
+            return lsub, rsub
 
         pf = Prefetcher(range(nparts), transform=load_pair,
                         depth=self._prefetch_depth(), kind="spill_join")
@@ -1863,12 +2316,12 @@ class LocalExecutor:
         self._in_join_spill = True
         try:
             with pf:
-                for lsub, rsub in pf:
+                for pair in pf:
+                    if pair is None:
+                        continue
+                    lsub, rsub = pair
                     if p.join_type in ("inner", "semi") and \
                             (lsub.num_rows == 0 or rsub.num_rows == 0):
-                        continue
-                    if p.join_type in ("left", "full", "anti") and \
-                            lsub.num_rows == 0 and rsub.num_rows == 0:
                         continue
                     lhb = _positional(ai.from_arrow(lsub))
                     rhb = _positional(ai.from_arrow(rsub))
@@ -2446,6 +2899,93 @@ def _spill_partition_ids(table: "pa.Table", idx, modes, nparts: int):
             part[null_mask] = _SPILL_NULL_HASH
         h = part if h is None else (h * np.uint64(31) + part)
     return (h % np.uint64(nparts)).astype(np.int64)
+
+
+def _rtf_est_rows(p: pn.PlanNode) -> float:
+    """Runtime-filter direction estimate: join_reorder's cardinality
+    model, except cross joins count as the cartesian PRODUCT (GOO's max
+    is fine for ordering decisions but makes a 250k-row cross product
+    look like its 2.5k-row side, steering the filter the wrong way)."""
+    from ..plan import join_reorder as jr
+
+    if isinstance(p, pn.JoinExec):
+        lr, rr = _rtf_est_rows(p.left), _rtf_est_rows(p.right)
+        if p.join_type in ("semi", "anti"):
+            return lr * 0.5
+        if p.join_type == "cross" or not p.left_keys:
+            return lr * rr
+        return max(lr, rr)
+    if isinstance(p, pn.FilterExec):
+        return _rtf_est_rows(p.input) * jr._conjunct_selectivity(
+            p.condition)
+    if isinstance(p, pn.AggregateExec):
+        return max(_rtf_est_rows(p.input) * 0.1, 1.0)
+    if isinstance(p, pn.UnionExec):
+        return sum(_rtf_est_rows(c) for c in p.inputs)
+    if isinstance(p, pn.ScanExec):
+        return jr._scan_rows(p)
+    child = getattr(p, "input", None)
+    if isinstance(child, pn.PlanNode):
+        return _rtf_est_rows(child)
+    return jr._DEFAULT_ROWS
+
+
+def _spill_probe_mask(lsub: "pa.Table", lidx, rsub: "pa.Table", ridx,
+                      cap: int) -> "pa.Table":
+    """Spill-join runtime filter: exact build-partition key membership
+    applied to the probe partition before upload (inner/semi only).
+    Multi-key joins intersect per-column membership — a superset of the
+    true match set, so the mask is sound; NULL keys drop (they cannot
+    equi-match). Skips columns whose distinct build keys exceed ``cap``
+    and float keys (NaN set semantics differ from Spark's NaN ≡ NaN)."""
+    import pyarrow.compute as pc
+
+    mask = None
+    for li, ri in zip(lidx, ridx):
+        rcol = rsub.column(ri)
+        t = rcol.type
+        if not (pa.types.is_integer(t) or pa.types.is_boolean(t)
+                or pa.types.is_string(t) or pa.types.is_large_string(t)
+                or pa.types.is_date(t) or pa.types.is_decimal(t)):
+            continue
+        try:
+            vals = pc.unique(rcol.combine_chunks())
+            if len(vals) > cap:
+                continue
+            m = pc.is_in(lsub.column(li), value_set=vals)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                pa.ArrowTypeError):
+            continue
+        mask = m if mask is None else pc.and_kleene(mask, m)
+    if mask is None:
+        return lsub
+    before = lsub.num_rows
+    out = lsub.filter(mask)  # null-mask rows drop with the non-members
+    pruned = before - out.num_rows
+    if pruned > 0:
+        _record_metric("execution.runtime_filter.rows_pruned", pruned,
+                       site="spill")
+        _record_metric("execution.runtime_filter.pushed_count", 1,
+                       site="spill")
+    return out
+
+
+def _apply_runtime_predicates(table: pa.Table, preds, schema):
+    """Host-side application of runtime join-filter conjuncts to an
+    in-memory Arrow table (order-preserving, so downstream results are
+    bit-identical with filtering off). Returns (table, (before, after))
+    or (table, None) when the conjuncts fail to convert."""
+    from ..io.formats import rex_predicates_to_arrow
+
+    expr = rex_predicates_to_arrow(preds, schema)
+    if expr is None:
+        return table, None
+    before = table.num_rows
+    try:
+        table = table.filter(expr)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError, TypeError):
+        return table, None  # advisory: an unapplied filter is still sound
+    return table, (before, table.num_rows)
 
 
 def _drop_mem_scan_entry(table: pa.Table) -> None:

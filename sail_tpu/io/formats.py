@@ -118,6 +118,12 @@ def rex_predicates_to_arrow(predicates, schema) -> Optional["pads.Expression"]:
                 expr = ~field(c.args[0]).is_null()
             elif c.fn == "in":
                 expr = field(c.args[0]).isin([lit(a) for a in c.args[1:]])
+            elif c.fn == "rtf_member":
+                # runtime join filter: exact build-side key membership
+                from ..plan.runtime_filters import member_values
+                ref = c.args[0]
+                expr = field(ref).isin(
+                    member_values(c, schema[ref.index].dtype))
             else:
                 return None
         except Exception:  # noqa: BLE001 — pruning is best-effort

@@ -44,6 +44,25 @@ class PlanNode:
 
 
 @dataclass(frozen=True)
+class RuntimeFilterTarget:
+    """One runtime join-filter edge: key ordinal ``key`` of the annotated
+    ``JoinExec`` feeds scan column ``column`` (index into the target
+    scan's output schema). ``fid`` ties the join to its scan target(s).
+    ``side`` names the subtree holding the TARGET scan: "probe" edges
+    prune the left subtree from build-side keys, "build" edges prune the
+    right subtree from probe-side keys (both sound for inner/semi joins
+    — a row whose key has no partner contributes no output either way).
+    The executor picks ONE direction per join: filter from the side the
+    optimizer estimates smaller into the larger."""
+
+    fid: int
+    key: int
+    column: int
+    name: str  # scan column name (EXPLAIN rendering)
+    side: str = "probe"
+
+
+@dataclass(frozen=True)
 class ScanExec(PlanNode):
     """Reads a table: either an in-memory pyarrow table handle or files."""
 
@@ -58,6 +77,13 @@ class ScanExec(PlanNode):
     # columns) for parquet row-group pruning; the exact Filter above the
     # scan is retained, so these only need to be sound, not complete
     predicates: Tuple[rx.Rex, ...] = ()
+    # runtime join-filter annotations (optimizer) and the value-bearing
+    # conjuncts a join's build side pushed here at execution time. Like
+    # ``predicates`` these are sound-but-advisory: rows they remove can
+    # never survive the downstream join, so applying them fully,
+    # partially, or not at all yields identical query results.
+    runtime_filters: Tuple[RuntimeFilterTarget, ...] = ()
+    runtime_predicates: Tuple[rx.Rex, ...] = ()
 
     @property
     def schema(self) -> Schema:
@@ -227,6 +253,10 @@ class JoinExec(PlanNode):
     # the build keys removes every probe row and NULL probe keys are
     # excluded when the build side is non-empty.
     null_aware: bool = False
+    # runtime join filters (inner/semi only): build-side key filters the
+    # executor constructs after build_side() and pushes to the probe-side
+    # scans named by these targets (plan/runtime_filters.py annotates)
+    runtime_filters: Tuple[RuntimeFilterTarget, ...] = ()
 
     @property
     def schema(self) -> Schema:
@@ -401,6 +431,11 @@ def explain(p: PlanNode, indent: int = 0) -> str:
     detail = ""
     if isinstance(p, ScanExec):
         detail = f" table={p.table_name or p.paths} cols={[f.name for f in p.schema]}"
+        if p.runtime_filters:
+            detail += " runtime_filters=[%s]" % ", ".join(
+                f"rf{t.fid}:{t.name}" for t in p.runtime_filters)
+        if p.runtime_predicates:
+            detail += f" runtime_predicates={len(p.runtime_predicates)}"
     elif isinstance(p, FilterExec):
         detail = f" cond={_rex_str(p.condition)}"
     elif isinstance(p, ProjectExec):
@@ -412,6 +447,10 @@ def explain(p: PlanNode, indent: int = 0) -> str:
         detail = (f" type={p.join_type} on="
                   f"{[(_rex_str(l), _rex_str(r)) for l, r in zip(p.left_keys, p.right_keys)]}"
                   + (f" residual={_rex_str(p.residual)}" if p.residual is not None else ""))
+        if p.runtime_filters:
+            detail += " runtime_filter=[%s]" % ", ".join(
+                f"rf{t.fid}:key#{t.key}->{t.side}:{t.name}"
+                for t in p.runtime_filters)
     elif isinstance(p, SortExec):
         detail = f" keys={[(_rex_str(k.expr), k.ascending) for k in p.keys]}" + \
             (f" limit={p.limit}" if p.limit is not None else "")

@@ -27,8 +27,21 @@ def optimize(plan: pn.PlanNode) -> pn.PlanNode:
     plan = push_filters(plan)
     plan = _maybe_reorder_joins(plan)
     plan = prune_columns(plan)
+    # runs AFTER pruning: reorder/prune rebuild Join/Scan nodes and would
+    # drop the annotations; scan projections are final here, so target
+    # column indices bind to the projected schema
+    plan = _maybe_annotate_runtime_filters(plan)
     plan = _optimize_subquery_plans(plan)
     return plan
+
+
+def _maybe_annotate_runtime_filters(plan: pn.PlanNode) -> pn.PlanNode:
+    from ..config import get as config_get
+    if str(config_get("join.runtime_filter.enabled", "true")).lower() \
+            in ("0", "false", "off"):
+        return plan
+    from .runtime_filters import annotate_runtime_filters
+    return annotate_runtime_filters(plan)
 
 
 def _optimize_subquery_plans(p: pn.PlanNode) -> pn.PlanNode:

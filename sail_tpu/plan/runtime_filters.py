@@ -1,0 +1,200 @@
+"""Runtime join-filter plan annotation (sideways information passing).
+
+An optimizer pass that marks each equi-``JoinExec`` whose probe side may
+be pruned (inner/semi — the only join types where a probe row without a
+build match is dropped) with ``RuntimeFilterTarget`` edges: for every
+join key that traces through key-PRESERVING operators (Filter, simple
+column Projects, and further joins whose output keeps the traced rows a
+subset) down to a ``ScanExec`` column, the target scan is annotated with
+the same ``fid``.
+
+At execution, ``exec/local.py`` runs the build side first, derives
+min/max bounds (and an exact key list for small builds) from the build
+keys, and attaches them to the annotated scan as ``runtime_predicates``
+— sound conjuncts that parquet scans feed to
+``rex_predicates_to_arrow`` for row-group/page skipping and memory
+scans apply host-side before upload. Left-deep join trees cascade: the
+outermost join's bounds land on the fact scan before the inner joins
+run, so by the time the fact table decodes it carries every dimension's
+filter.
+
+Reference role: Spark's InjectRuntimeFilter / DataFusion dynamic filter
+pushdown; Theseus' bytes-not-moved discipline (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import itertools
+from typing import List, Optional, Sequence, Tuple
+
+from ..spec import data_type as dt
+from ..spec.literal import Literal as LV
+from . import nodes as pn
+from . import rex as rx
+
+#: join types whose PROBE (left) side may be pruned by a build-side filter
+PRUNABLE_JOIN_TYPES = ("inner", "semi")
+
+#: scan-conjunct support: integer-physical types whose raw device values
+#: convert losslessly to literals (floats excluded: a NaN build key would
+#: poison the bounds under Spark's NaN==NaN semantics)
+_BOUND_TYPES = (dt.ByteType, dt.ShortType, dt.IntegerType, dt.LongType,
+                dt.DateType)
+
+
+def annotate_runtime_filters(plan: pn.PlanNode) -> pn.PlanNode:
+    """Annotate every prunable equi-join and its reachable probe scans."""
+    counter = itertools.count(1)
+
+    def visit(p: pn.PlanNode) -> pn.PlanNode:
+        if isinstance(p, pn.JoinExec):
+            p = dataclasses.replace(p, left=visit(p.left),
+                                    right=visit(p.right))
+            if p.join_type in PRUNABLE_JOIN_TYPES and p.left_keys \
+                    and not p.null_aware:
+                targets: List[pn.RuntimeFilterTarget] = []
+                new_left, new_right = p.left, p.right
+                for k, lk in enumerate(p.left_keys):
+                    if not isinstance(lk, rx.BoundRef):
+                        continue  # non-column key: not scan-traceable
+                    res = _trace(new_left, lk.index, k, counter, "probe")
+                    if res is not None:
+                        new_left, tgt = res
+                        targets.append(tgt)
+                for k, rk in enumerate(p.right_keys):
+                    if not isinstance(rk, rx.BoundRef):
+                        continue
+                    res = _trace(new_right, rk.index, k, counter, "build")
+                    if res is not None:
+                        new_right, tgt = res
+                        targets.append(tgt)
+                if targets:
+                    p = dataclasses.replace(
+                        p, left=new_left, right=new_right,
+                        runtime_filters=tuple(targets))
+            return p
+        kids = {}
+        for fname in ("input",):
+            c = getattr(p, fname, None)
+            if isinstance(c, pn.PlanNode):
+                kids[fname] = visit(c)
+        if hasattr(p, "inputs"):
+            kids["inputs"] = tuple(visit(c) for c in p.inputs)
+        return dataclasses.replace(p, **kids) if kids else p
+
+    return visit(plan)
+
+
+def _trace(p: pn.PlanNode, idx: int, key_ord: int, counter, side: str):
+    """Trace output column ``idx`` of ``p`` down to a ScanExec column
+    through key-preserving operators only. Returns (rebuilt node with the
+    annotated scan, target) or None."""
+    if isinstance(p, pn.ScanExec):
+        if idx >= len(p.schema):
+            return None
+        fid = next(counter)
+        tgt = pn.RuntimeFilterTarget(fid, key_ord, idx,
+                                     p.schema[idx].name, side)
+        scan = dataclasses.replace(
+            p, runtime_filters=p.runtime_filters + (tgt,))
+        return scan, tgt
+    if isinstance(p, pn.FilterExec):
+        res = _trace(p.input, idx, key_ord, counter, side)
+        if res is None:
+            return None
+        child, tgt = res
+        return dataclasses.replace(p, input=child), tgt
+    if isinstance(p, pn.ProjectExec):
+        if idx >= len(p.exprs):
+            return None
+        e = p.exprs[idx][1]
+        if not isinstance(e, rx.BoundRef):
+            return None  # computed column: not key-preserving
+        res = _trace(p.input, e.index, key_ord, counter, side)
+        if res is None:
+            return None
+        child, tgt = res
+        return dataclasses.replace(p, input=child), tgt
+    if isinstance(p, pn.JoinExec):
+        # descending is sound when removing rows of that child only
+        # removes output rows that could not match the OUTER join anyway:
+        # - left child of inner/cross/left/semi/anti joins (output rows
+        #   carry the traced column from surviving left rows)
+        # - right child of inner/cross joins (a cross join's output is
+        #   the cartesian product: dropping child rows drops exactly the
+        #   output rows carrying their — unmatchable — key values)
+        n_left = len(p.left.schema)
+        if idx < n_left and p.join_type in ("inner", "cross", "left",
+                                            "semi", "anti"):
+            res = _trace(p.left, idx, key_ord, counter, side)
+            if res is None:
+                return None
+            child, tgt = res
+            return dataclasses.replace(p, left=child), tgt
+        if idx >= n_left and p.join_type in ("inner", "cross"):
+            res = _trace(p.right, idx - n_left, key_ord, counter, side)
+            if res is None:
+                return None
+            child, tgt = res
+            return dataclasses.replace(p, right=child), tgt
+        return None
+    # Limit/Sort(limit)/Aggregate/Window/Generate/Union/…: pruning their
+    # input changes which rows they emit — not key-preserving
+    return None
+
+
+def find_scan_by_fid(p: pn.PlanNode, fid: int) -> Optional[pn.ScanExec]:
+    for node in pn.walk_plan(p):
+        if isinstance(node, pn.ScanExec) and \
+                any(t.fid == fid for t in node.runtime_filters):
+            return node
+    return None
+
+
+# ---------------------------------------------------------------------------
+# value-bearing conjunct construction (executor + cluster worker)
+# ---------------------------------------------------------------------------
+
+def supports_bounds(d: dt.DataType) -> bool:
+    return isinstance(d, _BOUND_TYPES)
+
+
+def _literal(d: dt.DataType, raw: int) -> LV:
+    """Physical (device int) value → logical literal of the column type."""
+    if isinstance(d, dt.DateType):
+        return LV.date(datetime.date(1970, 1, 1)
+                       + datetime.timedelta(days=int(raw)))
+    return LV(d, int(raw))
+
+
+def bounds_conjuncts(col_index: int, field: pn.Field, lo: int, hi: int,
+                     values: Optional[Sequence[int]] = None
+                     ) -> Tuple[rx.Rex, ...]:
+    """Sound scan conjuncts for one build-side key column: closed
+    [lo, hi] bounds plus an exact membership list when the build's
+    distinct keys are few. ``lo``/``hi``/``values`` are raw physical
+    values (int days for dates)."""
+    ref = rx.BoundRef(col_index, field.name, field.dtype, field.nullable)
+    out: List[rx.Rex] = [
+        rx.RCall(">=", (ref, rx.RLit(_literal(field.dtype, lo))),
+                 dt.BooleanType()),
+        rx.RCall("<=", (ref, rx.RLit(_literal(field.dtype, hi))),
+                 dt.BooleanType()),
+    ]
+    if values is not None:
+        vals = tuple(int(v) for v in values)
+        out.append(rx.RCall("rtf_member", (ref,), dt.BooleanType(),
+                            options=(("values", vals),)))
+    return tuple(out)
+
+
+def member_values(c: rx.RCall, field_dtype: dt.DataType):
+    """Decode an ``rtf_member`` conjunct's raw values into the column's
+    logical value space (for Arrow ``isin``)."""
+    raw = dict(c.options)["values"]
+    if isinstance(field_dtype, dt.DateType):
+        epoch = datetime.date(1970, 1, 1)
+        return [epoch + datetime.timedelta(days=int(v)) for v in raw]
+    return [int(v) for v in raw]

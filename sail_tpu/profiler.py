@@ -66,6 +66,12 @@ class QueryProfile:
     compile_events: List[dict] = field(default_factory=list)
     transfer_bytes: int = 0
     spill_bytes: int = 0
+    # runtime join filters: filters built / pushed into scans, probe+scan
+    # rows pruned, and filter-build wall time for this query
+    rtf_built: int = 0
+    rtf_pushed: int = 0
+    rtf_rows_pruned: int = 0
+    rtf_build_ms: float = 0.0
     rows_out: int = 0
     slow: bool = False
     # operator metric trees (dicts, telemetry.OperatorMetrics.to_dict)
@@ -134,6 +140,14 @@ class QueryProfile:
         with self._lock:
             self.spill_bytes += int(nbytes)
 
+    def note_rtf(self, built: int = 0, pushed: int = 0,
+                 rows_pruned: int = 0, build_ms: float = 0.0) -> None:
+        with self._lock:
+            self.rtf_built += int(built)
+            self.rtf_pushed += int(pushed)
+            self.rtf_rows_pruned += int(rows_pruned)
+            self.rtf_build_ms += float(build_ms)
+
     def add_task(self, stage: int, partition: int, worker_id: str,
                  operators: List[dict], rows_out: int = 0) -> None:
         """Merge one distributed task's operator metrics (driver side)."""
@@ -185,6 +199,12 @@ class QueryProfile:
             },
             "transfer_bytes": self.transfer_bytes,
             "spill_bytes": self.spill_bytes,
+            "runtime_filter": {
+                "built": self.rtf_built,
+                "pushed": self.rtf_pushed,
+                "rows_pruned": self.rtf_rows_pruned,
+                "build_ms": round(self.rtf_build_ms, 3),
+            },
             "rows_out": self.rows_out,
             "slow": self.slow,
             "operators": list(self.operators),
@@ -205,6 +225,12 @@ class QueryProfile:
             lines.append(f"device transfer: {self.transfer_bytes} bytes")
         if self.spill_bytes:
             lines.append(f"spill: {self.spill_bytes} bytes")
+        if self.rtf_built or self.rtf_rows_pruned:
+            lines.append(
+                f"runtime filters: built={self.rtf_built} "
+                f"pushed={self.rtf_pushed} "
+                f"rows_pruned={self.rtf_rows_pruned} "
+                f"build={self.rtf_build_ms:.1f}ms")
         if self.tasks:
             from .telemetry import OperatorMetrics
             lines.append(f"tasks: {len(self.tasks)}")
@@ -383,7 +409,10 @@ def _finalize(profile: QueryProfile, threshold_ms: float) -> None:
                      "query.compile.cache_misses":
                          profile.compile_cache_misses,
                      "query.transfer_bytes": profile.transfer_bytes,
-                     "query.spill_bytes": profile.spill_bytes}
+                     "query.spill_bytes": profile.spill_bytes,
+                     "query.runtime_filter.built": profile.rtf_built,
+                     "query.runtime_filter.rows_pruned":
+                         profile.rtf_rows_pruned}
             for name, ms in profile.phase_items():
                 attrs[f"query.phase.{name}_ms"] = round(ms, 3)
             start_ns = int(profile.start_time * 1e9)
@@ -445,6 +474,15 @@ def note_spill_bytes(nbytes: int) -> None:
     profile = current_profile()
     if profile is not None:
         profile.note_spill(nbytes)
+
+
+def note_runtime_filter(built: int = 0, pushed: int = 0,
+                        rows_pruned: int = 0,
+                        build_ms: float = 0.0) -> None:
+    profile = current_profile()
+    if profile is not None:
+        profile.note_rtf(built=built, pushed=pushed,
+                         rows_pruned=rows_pruned, build_ms=build_ms)
 
 
 def last_profile() -> Optional[QueryProfile]:

@@ -1,0 +1,158 @@
+"""Property-based tests for ops/hash.py key packing and hashing.
+
+Randomized (seeded, no external property-testing dependency) over every
+packable type combination: packing must be LOSSLESS — distinct key
+tuples (under Spark key equality: -0.0 ≡ 0.0, NaN ≡ NaN) get distinct
+packed uint64s and equal tuples get equal ones — and ``hash64`` must
+agree with the same equality relation. These invariants underwrite the
+runtime join filters: a filter key derived on the build side must equal
+the probe side's for every Spark-equal key pair (no false negatives).
+"""
+
+import itertools
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sail_tpu.ops.hash import can_pack, hash64, pack_keys
+from sail_tpu.spec import data_type as dt
+
+_TYPES = {
+    "bool": (dt.BooleanType(), jnp.bool_),
+    "int8": (dt.ByteType(), jnp.int8),
+    "int16": (dt.ShortType(), jnp.int16),
+    "int32": (dt.IntegerType(), jnp.int32),
+    "int64": (dt.LongType(), jnp.int64),
+    "float32": (dt.FloatType(), jnp.float32),
+    "float64": (dt.DoubleType(), jnp.float64),
+}
+
+
+def _packable_combos(max_len=3):
+    names = list(_TYPES)
+    out = [(n,) for n in names]
+    for pair in itertools.product(names, repeat=2):
+        if can_pack([_TYPES[n][0] for n in pair], reserve_bits=0):
+            out.append(pair)
+    for n in names:  # a few triples with bool padding
+        combo = ("bool", n, "bool")
+        if can_pack([_TYPES[c][0] for c in combo], reserve_bits=0):
+            out.append(combo)
+    return out
+
+
+def _random_values(name, rng, n):
+    """Random values of a dtype, salted with its edge cases."""
+    if name == "bool":
+        vals = rng.integers(0, 2, n).astype(bool)
+        return vals
+    if name.startswith("int"):
+        bits = int(name[3:])
+        lo, hi = -(1 << (bits - 1)), (1 << (bits - 1)) - 1
+        vals = rng.integers(lo, hi, n, endpoint=True)
+        edges = np.array([lo, hi, 0, -1, 1])
+        vals[: len(edges)] = edges
+        return vals.astype(f"int{bits}")
+    fdt = np.float32 if name == "float32" else np.float64
+    vals = rng.standard_normal(n).astype(fdt) * 1e6
+    edges = np.array([0.0, -0.0, np.nan, np.inf, -np.inf, 1.5, -1.5],
+                     dtype=fdt)
+    vals[: len(edges)] = edges
+    return vals
+
+
+def _canon(name, v):
+    """Spark key-equality canonical form of one value."""
+    if name == "bool":
+        return bool(v)
+    if name.startswith("int"):
+        return int(v)
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if f == 0.0:
+        return 0.0  # collapses -0.0
+    return f
+
+
+@pytest.mark.parametrize("combo", _packable_combos(),
+                         ids=lambda c: "+".join(c))
+def test_pack_keys_is_lossless(combo):
+    rng = np.random.default_rng(hash(combo) % (2**32))
+    n = 512
+    cols_np = [_random_values(name, rng, n) for name in combo]
+    datas = [jnp.asarray(c) for c in cols_np]
+    types = [_TYPES[name][0] for name in combo]
+    assert can_pack(types, reserve_bits=0)
+    packed = np.asarray(pack_keys(datas, types))
+    canon = [tuple(_canon(name, col[i]) for name, col in zip(combo,
+                                                             cols_np))
+             for i in range(n)]
+    seen = {}
+    for i in range(n):
+        if canon[i] in seen:
+            assert packed[i] == packed[seen[canon[i]]], \
+                f"equal tuples {canon[i]} packed differently"
+        else:
+            seen[canon[i]] = i
+    by_pack = {}
+    for i in range(n):
+        prev = by_pack.setdefault(int(packed[i]), canon[i])
+        assert prev == canon[i], \
+            f"distinct tuples {prev} / {canon[i]} collided in pack"
+
+
+@pytest.mark.parametrize("combo", _packable_combos(),
+                         ids=lambda c: "+".join(c))
+def test_hash64_respects_key_equality(combo):
+    """Equal tuples (Spark semantics) must hash equal — the property the
+    join's hashed fallback and the runtime filter both rely on."""
+    rng = np.random.default_rng((hash(combo) + 7) % (2**32))
+    n = 256
+    cols_np = [_random_values(name, rng, n) for name in combo]
+    datas = [jnp.asarray(c) for c in cols_np]
+    types = [_TYPES[name][0] for name in combo]
+    hashed = np.asarray(hash64(datas, types))
+    canon = [tuple(_canon(name, col[i]) for name, col in zip(combo,
+                                                             cols_np))
+             for i in range(n)]
+    groups = {}
+    for i in range(n):
+        groups.setdefault(canon[i], set()).add(int(hashed[i]))
+    for key, hs in groups.items():
+        assert len(hs) == 1, f"equal tuples {key} hashed differently"
+
+
+def test_negative_zero_and_nan_unify():
+    for name in ("float32", "float64"):
+        t, jdt = _TYPES[name]
+        data = jnp.asarray(np.array([0.0, -0.0, np.nan, -np.nan],
+                                    dtype=np.float32 if name == "float32"
+                                    else np.float64))
+        p = np.asarray(pack_keys([data], [t]))
+        h = np.asarray(hash64([data], [t]))
+        assert p[0] == p[1] and h[0] == h[1], "-0.0 must key-equal 0.0"
+        assert p[2] == p[3] and h[2] == h[3], "all NaNs are one key"
+        assert p[0] != p[2], "0.0 and NaN are different keys"
+
+
+def test_int_float_packs_disjoint_widths():
+    """A packed multi-column key allocates disjoint bit ranges: varying
+    one column never aliases another."""
+    t8, _ = _TYPES["int8"]
+    t32, _ = _TYPES["int32"]
+    a = jnp.asarray(np.array([1, 1, 2], dtype=np.int8))
+    b = jnp.asarray(np.array([5, 6, 5], dtype=np.int32))
+    p = np.asarray(pack_keys([a, b], [t8, t32]))
+    assert len(set(int(x) for x in p)) == 3
+
+
+def test_can_pack_respects_reserve_bits():
+    assert can_pack([_TYPES["int32"][0], _TYPES["int32"][0]],
+                    reserve_bits=0)
+    assert not can_pack([_TYPES["int64"][0]], reserve_bits=1)
+    assert can_pack([_TYPES["int64"][0]], reserve_bits=0)
+    assert not can_pack([_TYPES["int64"][0], _TYPES["bool"][0]],
+                        reserve_bits=0)

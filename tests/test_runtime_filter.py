@@ -1,0 +1,588 @@
+"""Runtime join filters: kernel correctness (no false negatives,
+bounded false positives), plan-annotation lineage, on/off result
+equivalence across join types incl. NULL keys, scan-side pruning,
+EXPLAIN surfaces, cluster-mode filter shipping, and adaptive skips."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession, profiler
+from sail_tpu.exec.local import clear_caches
+from sail_tpu.plan import nodes as pn
+from sail_tpu.plan import rex as rx
+from sail_tpu.sql import parse_one
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    clear_caches()
+    yield
+    clear_caches()
+
+
+def _session(**conf):
+    base = {"spark.sail.execution.mesh": "off"}
+    base.update(conf)
+    return SparkSession(base)
+
+
+def _resolve(spark, sql):
+    return spark._resolve(parse_one(sql))
+
+
+# ---------------------------------------------------------------------------
+# kernel: build/apply
+# ---------------------------------------------------------------------------
+
+class TestKernel:
+    def _col(self, values, validity=None, dtype=None):
+        import jax.numpy as jnp
+
+        from sail_tpu.columnar.batch import Column
+        from sail_tpu.spec import data_type as dt
+        data = jnp.asarray(np.asarray(values))
+        v = None if validity is None else jnp.asarray(np.asarray(validity))
+        return Column(data, v, dtype or dt.LongType())
+
+    def test_no_false_negatives_ever(self):
+        import jax.numpy as jnp
+
+        from sail_tpu.ops import runtime_filter as rtfk
+        rng = np.random.default_rng(0)
+        build = rng.integers(-2**60, 2**60, 512)
+        bcol = self._col(build)
+        sel = jnp.ones(512, dtype=bool)
+        res = rtfk.build([bcol], sel, num_bits=4096)
+        # every build key must pass its own filter
+        mask = rtfk.apply(res.bits, res.kmin, res.kmax, [bcol], sel)
+        assert bool(jnp.all(mask))
+        assert int(res.n_build) == 512
+
+    def test_false_positive_rate_bounded(self):
+        import jax.numpy as jnp
+
+        from sail_tpu.ops import runtime_filter as rtfk
+        rng = np.random.default_rng(1)
+        build = rng.integers(0, 1_000, 256)  # narrow range
+        probe = rng.integers(2_000, 2**40, 4096)  # disjoint from build
+        bcol, pcol = self._col(build), self._col(probe)
+        res = rtfk.build([bcol], jnp.ones(256, dtype=bool),
+                         num_bits=1 << 16)
+        mask = rtfk.apply(res.bits, res.kmin, res.kmax, [pcol],
+                          jnp.ones(4096, dtype=bool))
+        fp_rate = float(jnp.mean(mask.astype(jnp.float32)))
+        assert fp_rate < 0.05, fp_rate
+
+    def test_null_probe_keys_rejected(self):
+        import jax.numpy as jnp
+
+        from sail_tpu.ops import runtime_filter as rtfk
+        bcol = self._col([1, 2, 3, 4])
+        res = rtfk.build([bcol], jnp.ones(4, dtype=bool), num_bits=1024)
+        pcol = self._col([1, 2, 3, 4], validity=[True, False, True, False])
+        mask = rtfk.apply(res.bits, res.kmin, res.kmax, [pcol],
+                          jnp.ones(4, dtype=bool))
+        assert list(np.asarray(mask)) == [True, False, True, False]
+
+    def test_empty_build_rejects_everything(self):
+        import jax.numpy as jnp
+
+        from sail_tpu.ops import runtime_filter as rtfk
+        bcol = self._col([7, 8, 9])
+        res = rtfk.build([bcol], jnp.zeros(3, dtype=bool), num_bits=1024)
+        assert int(res.n_build) == 0 and int(res.ndv) == 0
+        mask = rtfk.apply(res.bits, res.kmin, res.kmax,
+                          [self._col([7, 8, 9])],
+                          jnp.ones(3, dtype=bool))
+        assert not bool(jnp.any(mask))
+
+    def test_multi_column_keys_hashed_path(self):
+        # two int64 columns exceed 64 packed bits → hash64 path; equal
+        # tuples must still always pass (same seed both sides)
+        import jax.numpy as jnp
+
+        from sail_tpu.ops import runtime_filter as rtfk
+        rng = np.random.default_rng(2)
+        a = rng.integers(-2**62, 2**62, 128)
+        b = rng.integers(-2**62, 2**62, 128)
+        cols = [self._col(a), self._col(b)]
+        res = rtfk.build(cols, jnp.ones(128, dtype=bool), num_bits=8192)
+        assert res.exact is False
+        mask = rtfk.apply(res.bits, res.kmin, res.kmax, cols,
+                          jnp.ones(128, dtype=bool))
+        assert bool(jnp.all(mask))
+
+    def test_spark_float_key_semantics(self):
+        # -0.0 and 0.0 are ONE key; NaN is ONE key (Spark join equality)
+        import jax.numpy as jnp
+
+        from sail_tpu.columnar.batch import Column
+        from sail_tpu.ops import runtime_filter as rtfk
+        from sail_tpu.spec import data_type as dt
+        bcol = Column(jnp.asarray(np.array([0.0, np.nan])), None,
+                      dt.DoubleType())
+        res = rtfk.build([bcol], jnp.ones(2, dtype=bool), num_bits=1024)
+        pcol = Column(jnp.asarray(np.array([-0.0, np.nan])), None,
+                      dt.DoubleType())
+        mask = rtfk.apply(res.bits, res.kmin, res.kmax, [pcol],
+                          jnp.ones(2, dtype=bool))
+        assert bool(jnp.all(mask))
+
+
+# ---------------------------------------------------------------------------
+# plan annotation lineage
+# ---------------------------------------------------------------------------
+
+def _register_star(spark, n=4000, dim=40):
+    rng = np.random.default_rng(5)
+    fact = pd.DataFrame({"k": rng.integers(0, 1000, n),
+                         "v": rng.random(n)})
+    d = pd.DataFrame({"id": np.arange(dim),
+                      "flag": np.arange(dim) % 2 == 0})
+    spark.createDataFrame(fact).createOrReplaceTempView("fact")
+    spark.createDataFrame(d).createOrReplaceTempView("dim")
+    return fact, d
+
+
+def _find(plan, cls):
+    return [x for x in pn.walk_plan(plan) if isinstance(x, cls)]
+
+
+class TestAnnotation:
+    def test_inner_join_annotates_join_and_scan(self):
+        spark = _session()
+        _register_star(spark)
+        plan = _resolve(
+            spark, "SELECT * FROM fact JOIN dim ON fact.k = dim.id")
+        joins = [j for j in _find(plan, pn.JoinExec) if j.runtime_filters]
+        assert joins, "inner join should carry runtime_filters"
+        tgt = joins[0].runtime_filters[0]
+        scan = [s for s in _find(plan, pn.ScanExec)
+                if any(t.fid == tgt.fid for t in s.runtime_filters)]
+        assert scan and scan[0].schema[tgt.column].name == "k"
+
+    def test_filter_and_project_chain_reaches_scan(self):
+        spark = _session()
+        _register_star(spark)
+        plan = _resolve(spark, """
+            SELECT * FROM (SELECT k AS kk, v FROM fact WHERE v > 0.5) f
+            JOIN dim ON f.kk = dim.id""")
+        joins = [j for j in _find(plan, pn.JoinExec) if j.runtime_filters]
+        assert joins
+        tgt = joins[0].runtime_filters[0]
+        scans = [s for s in _find(plan, pn.ScanExec)
+                 if any(t.fid == tgt.fid for t in s.runtime_filters)]
+        assert scans, "filter should trace through project+filter"
+        assert scans[0].schema[tgt.column].name == "k"
+
+    def test_computed_key_blocks_annotation(self):
+        spark = _session()
+        _register_star(spark)
+        plan = _resolve(spark, """
+            SELECT * FROM (SELECT k + 1 AS kk FROM fact) f
+            JOIN dim ON f.kk = dim.id""")
+        for s in _find(plan, pn.ScanExec):
+            assert not any(t.side == "probe" for t in s.runtime_filters), \
+                "k+1 is not key-preserving; the probe scan must not be " \
+                "annotated (build-side edges to dim are fine)"
+
+    def test_aggregate_blocks_annotation(self):
+        spark = _session()
+        _register_star(spark)
+        plan = _resolve(spark, """
+            SELECT * FROM (SELECT k, count(*) c FROM fact GROUP BY k) f
+            JOIN dim ON f.k = dim.id""")
+        for s in _find(plan, pn.ScanExec):
+            assert not any(t.side == "probe" for t in s.runtime_filters), \
+                "filters must not push through an aggregate"
+
+    def test_left_and_anti_joins_not_annotated(self):
+        spark = _session()
+        _register_star(spark)
+        for sql in (
+                "SELECT * FROM fact LEFT JOIN dim ON fact.k = dim.id",
+                "SELECT * FROM fact LEFT ANTI JOIN dim "
+                "ON fact.k = dim.id"):
+            plan = _resolve(spark, sql)
+            for j in _find(plan, pn.JoinExec):
+                assert not j.runtime_filters, sql
+
+    def test_explain_renders_annotations(self):
+        spark = _session()
+        _register_star(spark)
+        text = spark.sql(
+            "EXPLAIN SELECT * FROM fact JOIN dim ON fact.k = dim.id"
+        ).toPandas().plan[0]
+        assert "runtime_filter=[" in text
+        assert "runtime_filters=[" in text  # the annotated scan
+
+
+# ---------------------------------------------------------------------------
+# on/off equivalence (incl. NULL keys)
+# ---------------------------------------------------------------------------
+
+_JOIN_SQLS = [
+    ("inner", "SELECT f.k, f.v, d.w FROM f JOIN d ON f.k = d.k"),
+    ("left", "SELECT f.k, f.v, d.w FROM f LEFT JOIN d ON f.k = d.k"),
+    ("semi", "SELECT f.k, f.v FROM f LEFT SEMI JOIN d ON f.k = d.k"),
+    ("anti", "SELECT f.k, f.v FROM f LEFT ANTI JOIN d ON f.k = d.k"),
+]
+
+
+def _null_key_frames():
+    rng = np.random.default_rng(11)
+    fk = [None if rng.random() < 0.1 else int(x)
+          for x in rng.integers(0, 300, 2500)]
+    f = pd.DataFrame({"k": pd.array(fk, dtype="Int64"),
+                      "v": rng.random(2500)})
+    dk = [None, None] + [int(x) for x in rng.integers(0, 60, 80)]
+    d = pd.DataFrame({"k": pd.array(dk, dtype="Int64"),
+                      "w": rng.random(82)})
+    return f, d
+
+
+@pytest.mark.parametrize("jt,sql", _JOIN_SQLS)
+def test_on_off_equivalence(jt, sql):
+    outs = {}
+    for mode in ("true", "false"):
+        spark = _session(**{"spark.sail.join.runtimeFilter.enabled": mode})
+        clear_caches()
+        f, d = _null_key_frames()
+        spark.createDataFrame(f).createOrReplaceTempView("f")
+        spark.createDataFrame(d).createOrReplaceTempView("d")
+        outs[mode] = spark.sql(sql).toArrow()
+    assert outs["true"].equals(outs["false"]), jt
+
+
+def test_date_key_join_on_off_equivalence():
+    # DateType keys exercise the raw-days → date-literal conversion in
+    # the pushed bounds/in-list conjuncts
+    import datetime
+    outs = {}
+    for mode in ("true", "false"):
+        spark = _session(**{"spark.sail.join.runtimeFilter.enabled": mode})
+        clear_caches()
+        rng = np.random.default_rng(12)
+        base = datetime.date(2024, 1, 1)
+        f = pd.DataFrame({
+            "d": [base + datetime.timedelta(days=int(x))
+                  for x in rng.integers(0, 365, 2000)],
+            "v": rng.random(2000)})
+        dim = pd.DataFrame({
+            "d": [base + datetime.timedelta(days=int(x))
+                  for x in range(10, 40)],
+            "w": rng.random(30)})
+        spark.createDataFrame(f).createOrReplaceTempView("fd")
+        spark.createDataFrame(dim).createOrReplaceTempView("dd")
+        outs[mode] = spark.sql(
+            "SELECT fd.d, fd.v, dd.w FROM fd JOIN dd ON fd.d = dd.d"
+        ).toArrow()
+        if mode == "true":
+            assert profiler.last_profile().rtf_rows_pruned > 0
+    assert outs["true"].equals(outs["false"])
+
+
+def test_inner_join_results_bit_identical_with_pruning():
+    outs = {}
+    for mode in ("true", "false"):
+        spark = _session(**{"spark.sail.join.runtimeFilter.enabled": mode})
+        clear_caches()
+        _register_star(spark)
+        outs[mode] = spark.sql(
+            "SELECT fact.k, fact.v, dim.flag FROM fact "
+            "JOIN dim ON fact.k = dim.id WHERE dim.flag").toArrow()
+        if mode == "true":
+            prof = profiler.last_profile()
+            assert prof.rtf_built >= 1
+            assert prof.rtf_rows_pruned > 0  # fact keys 0..999 vs dim 0..39
+    assert outs["true"].equals(outs["false"])
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE surfaces
+# ---------------------------------------------------------------------------
+
+def test_explain_analyze_shows_rows_pruned():
+    spark = _session()
+    _register_star(spark)
+    text = spark.sql(
+        "EXPLAIN ANALYZE SELECT SUM(fact.v) FROM fact "
+        "JOIN dim ON fact.k = dim.id").toPandas().plan[0]
+    assert "runtime filters:" in text
+    assert "rows_pruned=" in text
+    pruned = int(text.split("rows_pruned=")[1].split()[0])
+    assert pruned > 0
+
+
+def test_explain_analyze_json_includes_counters():
+    spark = _session()
+    _register_star(spark)
+    out = spark.sql(
+        "EXPLAIN ANALYZE FORMAT JSON SELECT SUM(fact.v) FROM fact "
+        "JOIN dim ON fact.k = dim.id").toPandas().plan[0]
+    doc = json.loads(out)
+    rf = doc["runtime_filter"]
+    assert rf["built"] >= 1
+    assert rf["rows_pruned"] > 0
+    assert rf["build_ms"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# adaptive / configurable skips
+# ---------------------------------------------------------------------------
+
+def test_disabled_builds_nothing():
+    spark = _session(**{"spark.sail.join.runtimeFilter.enabled": "false"})
+    _register_star(spark)
+    spark.sql("SELECT SUM(fact.v) FROM fact JOIN dim "
+              "ON fact.k = dim.id").toArrow()
+    prof = profiler.last_profile()
+    assert prof.rtf_built == 0 and prof.rtf_pushed == 0
+
+
+def test_min_build_rows_skips_small_builds():
+    spark = _session(
+        **{"spark.sail.join.runtimeFilter.minBuildRows": "1000000"})
+    _register_star(spark)
+    spark.sql("SELECT SUM(fact.v) FROM fact JOIN dim "
+              "ON fact.k = dim.id").toArrow()
+    assert profiler.last_profile().rtf_built == 0
+
+
+def test_adaptive_skip_after_useless_filter():
+    # every fact key exists in dim → the filter prunes nothing; the
+    # second execution must skip the build (observed selectivity ≈ 0)
+    spark = _session()
+    rng = np.random.default_rng(6)
+    fact = pd.DataFrame({"k": rng.integers(0, 40, 5000),
+                         "v": rng.random(5000)})
+    d = pd.DataFrame({"id": np.arange(40)})
+    spark.createDataFrame(fact).createOrReplaceTempView("fact")
+    spark.createDataFrame(d).createOrReplaceTempView("dim")
+    sql = "SELECT SUM(fact.v) FROM fact JOIN dim ON fact.k = dim.id"
+    spark.sql(sql).toArrow()
+    first = profiler.last_profile()
+    assert first.rtf_built >= 1  # tried once
+    spark.sql(sql).toArrow()
+    second = profiler.last_profile()
+    assert second.rtf_built == 0  # learned it was useless
+
+def test_reverse_filter_prunes_fact_build_side():
+    # when the FACT table is the join's build (right) side, the filter
+    # flows in REVERSE: the small probe side runs first and its key set
+    # prunes the fact scan
+    outs = {}
+    for mode in ("true", "false"):
+        spark = _session(**{"spark.sail.join.runtimeFilter.enabled": mode})
+        clear_caches()
+        rng = np.random.default_rng(7)
+        big = pd.DataFrame({"k": rng.integers(0, 500, 20000),
+                            "w": rng.random(20000)})
+        small = pd.DataFrame({"id": np.arange(50), "v": rng.random(50)})
+        spark.createDataFrame(big).createOrReplaceTempView("big")
+        spark.createDataFrame(small).createOrReplaceTempView("small")
+        outs[mode] = spark.sql(
+            "SELECT SUM(small.v * big.w) FROM small JOIN big "
+            "ON small.id = big.k").toArrow()
+        if mode == "true":
+            prof = profiler.last_profile()
+            assert prof.rtf_built >= 1
+            # big keys 0..499 vs small ids 0..49 → ~90% of the build
+            # side prunes before upload
+            assert prof.rtf_rows_pruned > 10000
+    assert outs["true"].equals(outs["false"])
+
+
+def test_adaptive_verdict_is_per_query_not_per_shape():
+    # a useless-filter verdict for `fact JOIN dim` (unfiltered dim: no
+    # pruning) must not disable the filter for the SAME join shape with
+    # a selective WHERE on dim
+    spark = _session()
+    rng = np.random.default_rng(14)
+    fact = pd.DataFrame({"k": rng.integers(0, 40, 8000),
+                         "v": rng.random(8000)})
+    d = pd.DataFrame({"id": np.arange(40), "w": np.arange(40) * 1.0})
+    spark.createDataFrame(fact).createOrReplaceTempView("fact")
+    spark.createDataFrame(d).createOrReplaceTempView("dim")
+    useless = "SELECT SUM(fact.v) FROM fact JOIN dim ON fact.k = dim.id"
+    spark.sql(useless).toArrow()
+    spark.sql(useless).toArrow()
+    assert profiler.last_profile().rtf_built == 0  # learned: useless
+    selective = ("SELECT SUM(fact.v) FROM fact JOIN dim "
+                 "ON fact.k = dim.id WHERE dim.w < 3")
+    spark.sql(selective).toArrow()
+    prof = profiler.last_profile()
+    assert prof.rtf_built >= 1, \
+        "the unfiltered join's verdict leaked onto the filtered one"
+    assert prof.rtf_rows_pruned > 0
+
+
+def test_empty_build_date_join_does_not_overflow():
+    # an empty build side leaves dtype-extreme sentinel bounds; for date
+    # keys those used to overflow the date-literal conversion
+    spark = _session()
+    import datetime
+    base = datetime.date(2024, 1, 1)
+    f = pd.DataFrame({
+        "d": [base + datetime.timedelta(days=i) for i in range(200)],
+        "v": np.arange(200.0)})
+    dim = pd.DataFrame({
+        "d": [base + datetime.timedelta(days=i) for i in range(5)],
+        "flag": [False] * 5})  # filter below removes every build row
+    spark.createDataFrame(f).createOrReplaceTempView("fd")
+    spark.createDataFrame(dim).createOrReplaceTempView("dd")
+    got = spark.sql(
+        "SELECT fd.v FROM fd JOIN dd ON fd.d = dd.d WHERE dd.flag"
+    ).toPandas()
+    assert len(got) == 0
+
+
+def test_parquet_filter_survives_adaptive_feedback(tmp_path):
+    # parquet pruning happens inside the dataset read; the adaptive pass
+    # must keep the filter alive (footer-count evidence), not condemn it
+    import pyarrow.parquet as pq
+    spark = _session()
+    rng = np.random.default_rng(13)
+    fact = pa.table({"k": rng.integers(0, 1000, 20000),
+                     "v": rng.random(20000)})
+    fp = str(tmp_path / "fact.parquet")
+    pq.write_table(fact, fp)
+    spark.sql(f"CREATE TABLE pfact USING parquet LOCATION '{fp}'")
+    d = pd.DataFrame({"id": np.arange(30)})
+    spark.createDataFrame(d).createOrReplaceTempView("dim")
+    sql = "SELECT SUM(pfact.v) FROM pfact JOIN dim ON pfact.k = dim.id"
+    for _ in range(2):
+        spark.sql(sql).toArrow()
+    spark.sql(sql).toArrow()
+    prof = profiler.last_profile()
+    assert prof.rtf_built >= 1, "adaptive pass must not kill the filter"
+    assert prof.rtf_rows_pruned > 0
+
+
+# ---------------------------------------------------------------------------
+# spill-join integration
+# ---------------------------------------------------------------------------
+
+def test_spill_join_prunes_and_matches(monkeypatch):
+    # the scan-side filter can shrink the probe below the spill
+    # threshold, switching execution paths — the joined row SET must be
+    # identical either way (order of an unordered join is unspecified)
+    monkeypatch.setenv("SAIL_EXECUTION__JOIN_SPILL_ROWS", "1000")
+    outs = {}
+    for mode in ("true", "false"):
+        spark = _session(**{"spark.sail.join.runtimeFilter.enabled": mode})
+        clear_caches()
+        rng = np.random.default_rng(9)
+        left = pd.DataFrame({"k": rng.integers(0, 500, 4000),
+                             "v": rng.random(4000)})
+        right = pd.DataFrame({"k": np.arange(25), "w": rng.random(25)})
+        spark.createDataFrame(left).createOrReplaceTempView("l")
+        spark.createDataFrame(right).createOrReplaceTempView("r")
+        outs[mode] = spark.sql(
+            "SELECT l.k, l.v, r.w FROM l JOIN r ON l.k = r.k"
+        ).toPandas().sort_values(["k", "v", "w"]).reset_index(drop=True)
+    assert outs["true"].equals(outs["false"])
+
+
+def test_spill_join_masks_probe_partitions(monkeypatch):
+    # force BOTH modes down the spill path (threshold below even the
+    # pruned probe) and check the per-partition probe mask prunes rows
+    monkeypatch.setenv("SAIL_EXECUTION__JOIN_SPILL_ROWS", "100")
+    from sail_tpu.metrics import REGISTRY
+    spark = _session()
+    rng = np.random.default_rng(10)
+    left = pd.DataFrame({"k": rng.integers(0, 500, 3000),
+                         "v": rng.random(3000)})
+    # sparse build keys: most probe rows miss, so the per-partition
+    # is_in mask (not the scan push — the computed key below blocks
+    # annotation) is what prunes
+    right = pd.DataFrame({"k": np.arange(0, 500, 13),
+                          "w": rng.random(len(np.arange(0, 500, 13)))})
+    spark.createDataFrame(left).createOrReplaceTempView("l")
+    spark.createDataFrame(right).createOrReplaceTempView("r")
+    before = {(r["name"], r["attributes"]): r["value"]
+              for r in REGISTRY.snapshot()}
+    got = spark.sql(
+        "SELECT ll.k2, ll.v, r.w FROM "
+        "(SELECT k + 0 AS k2, v FROM l) ll "
+        "JOIN r ON ll.k2 = r.k").toPandas()
+    exp = left.assign(k2=left.k).merge(right, left_on="k2", right_on="k")
+    assert len(got) == len(exp)
+    after = {(r["name"], r["attributes"]): r["value"]
+             for r in REGISTRY.snapshot()}
+    key = ("execution.runtime_filter.rows_pruned", '{"site": "spill"}')
+    assert after.get(key, 0) > before.get(key, 0)
+
+
+# ---------------------------------------------------------------------------
+# cluster-mode filter shipping
+# ---------------------------------------------------------------------------
+
+class TestClusterShipping:
+    def _graph(self, spark, sql):
+        from sail_tpu.exec import job_graph as jg
+        return jg.split_job(_resolve(spark, sql), 2)
+
+    def test_driver_computes_stage_filters(self):
+        spark = _session()
+        _register_star(spark)
+        graph = self._graph(
+            spark, "SELECT SUM(fact.v) FROM fact JOIN dim "
+                   "ON fact.k = dim.id GROUP BY fact.k")
+        assert graph is not None and graph.stage_filters
+        entries = json.loads(next(iter(graph.stage_filters.values())))
+        e = entries[0]
+        assert e["name"] == "k"
+        assert e["min"] == 0 and e["max"] == 39
+        assert sorted(e["values"]) == list(range(40))
+
+    def test_worker_attaches_runtime_predicates(self):
+        from sail_tpu.exec import job_graph as jg
+        spark = _session()
+        _register_star(spark)
+        graph = self._graph(
+            spark, "SELECT SUM(fact.v) FROM fact JOIN dim "
+                   "ON fact.k = dim.id GROUP BY fact.k")
+        (sid, js), = graph.stage_filters.items()
+        stage = [s for s in graph.stages if s.stage_id == sid][0]
+        plan = jg.apply_task_runtime_filters(stage.plan, js)
+        scans = [s for s in pn.walk_plan(plan)
+                 if isinstance(s, pn.ScanExec) and s.runtime_predicates]
+        assert scans
+        fns = {c.fn for c in scans[0].runtime_predicates
+               if isinstance(c, rx.RCall)}
+        assert {">=", "<=", "rtf_member"} <= fns
+
+    @pytest.mark.parametrize("env", ["SAIL_CLUSTER__RUNTIME_FILTERS",
+                                     "SAIL_JOIN__RUNTIME_FILTER__ENABLED"])
+    def test_gate_disables_shipping(self, monkeypatch, env):
+        # both the cluster gate and the master switch must kill shipping
+        monkeypatch.setenv(env, "0")
+        spark = _session()
+        _register_star(spark)
+        graph = self._graph(
+            spark, "SELECT SUM(fact.v) FROM fact JOIN dim "
+                   "ON fact.k = dim.id GROUP BY fact.k")
+        assert graph is not None and not graph.stage_filters
+
+    def test_cluster_results_match_local(self):
+        from sail_tpu.exec.cluster import LocalCluster
+        spark = _session()
+        _register_star(spark)
+        sql = ("SELECT fact.k AS k, SUM(fact.v) AS s FROM fact "
+               "JOIN dim ON fact.k = dim.id GROUP BY fact.k")
+        local = spark.sql(sql).toPandas().sort_values("k") \
+            .reset_index(drop=True)
+        plan = _resolve(spark, sql)
+        c = LocalCluster(num_workers=2)
+        try:
+            dist = c.run_job(plan, num_partitions=2).to_pandas() \
+                .sort_values("k").reset_index(drop=True)
+        finally:
+            c.stop()
+        assert len(dist) == len(local)
+        np.testing.assert_allclose(dist.s.values, local.s.values)
