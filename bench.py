@@ -222,6 +222,62 @@ def _run_clickbench(spark, n_rows: int = 100_000, budget_s: float = 180.0):
     return out
 
 
+def _run_chaos(spark) -> dict:
+    """SAIL_BENCH_CHAOS=1: run one TPC-H query through the local
+    cluster twice — clean, then under a fixed fault seed (one dropped
+    shuffle fetch + one straggler task) — and record the recovery
+    overhead and result equivalence in the artifact."""
+    from sail_tpu import faults
+    from sail_tpu.benchmarks.tpch_data import generate_tpch
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.sql import parse_one
+
+    seed = int(os.environ.get("SAIL_BENCH_CHAOS_SEED", "1234"))
+    q = int(os.environ.get("SAIL_BENCH_CHAOS_QUERY", "3"))
+    tables = generate_tpch(0.01, seed=11)
+    for name, t in tables.items():
+        spark.createDataFrame(t).createOrReplaceTempView(name)
+    plan = spark._resolve(parse_one(QUERIES[q]))
+
+    def canon(table):
+        return table.sort_by([(c, "ascending")
+                              for c in table.column_names])
+
+    def run():
+        c = LocalCluster(num_workers=2)
+        try:
+            t0 = time.perf_counter()
+            out = c.run_job(plan, num_partitions=4, timeout=120)
+            return canon(out), time.perf_counter() - t0, c.last_job
+        finally:
+            c.stop()
+
+    run()  # warm-up: JIT compilation must not masquerade as overhead
+    clean, clean_s, _ = run()
+    faults.configure(
+        f"seed={seed};shuffle.fetch:*c[0-9]*=error(not_found)#1;"
+        f"worker.task_exec:worker-1*=delay(1.5)#1")
+    try:
+        faulted, faulted_s, job = run()
+        injected = dict(faults.injection_counts())
+    finally:
+        faults.reset()
+    return {
+        "query": q,
+        "seed": seed,
+        "clean_s": round(clean_s, 4),
+        "faulted_s": round(faulted_s, 4),
+        "recovery_overhead": round(faulted_s / clean_s, 3)
+        if clean_s else None,
+        "identical": clean.equals(faulted),
+        "injected": injected,
+        "task_retries": job.retry_count,
+        "speculative": {"launched": job.spec_launched,
+                        "won": job.spec_won},
+    }
+
+
 def main():
     # Headline: TPC-H Q1 at SF10 — large enough that the remote-TPU
     # tunnel's ~70 ms per-round-trip floor amortizes and the number
@@ -297,6 +353,14 @@ def main():
                     spark, 100_000, remaining * 0.8)
         except Exception as e:  # noqa: BLE001
             result["clickbench_error"] = f"{type(e).__name__}: {e}"
+    # chaos mode: TPC-H under a fixed fault seed, recovery overhead in
+    # the artifact (opt-in: the run costs two extra cluster executions)
+    if os.environ.get("SAIL_BENCH_CHAOS", "0").strip().lower() in (
+            "1", "true", "yes"):
+        try:
+            result["chaos"] = _run_chaos(spark)
+        except Exception as e:  # noqa: BLE001
+            result["chaos_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

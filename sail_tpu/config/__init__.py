@@ -50,3 +50,11 @@ def app_config() -> Dict[str, Any]:
 
 def get(key: str, default: Any = None) -> Any:
     return app_config().get(key, default)
+
+
+def truthy(key: str, default: Any = "true") -> bool:
+    """Boolean config key: everything except 0/false/no/off (in any
+    case) is on. The one parser every gate shares, so the accepted
+    falsy spellings cannot drift between call sites."""
+    return str(get(key, default)).strip().lower() \
+        not in ("0", "false", "no", "off")

@@ -22,6 +22,8 @@ SURVEY.md §2.5/§3.3). Shape:
 from __future__ import annotations
 
 import os
+import random
+import statistics
 import threading
 import time
 import uuid
@@ -34,6 +36,7 @@ from .proto import control_plane_pb2 as pb
 
 from .actor import Actor
 from . import job_graph as jg
+from .. import faults
 from .. import tracing as tr
 from ..metrics import record as _record_metric
 
@@ -58,6 +61,77 @@ def _table_to_ipc(table) -> bytes:
 def _ipc_to_table(buf: bytes):
     import pyarrow as pa
     return pa.ipc.open_stream(buf).read_all()
+
+
+# ---------------------------------------------------------------------------
+# RPC retry: exponential backoff with FULL jitter (AWS architecture-blog
+# shape: sleep = uniform(0, min(cap, base * 2^attempt))) applied to every
+# driver<->worker unary RPC and stream fetch. Retries count in
+# rpc.retry_count{method}; a NOT_FOUND (stream genuinely gone) is never
+# retried — the fetch-failed producer-re-run path owns that case.
+# ---------------------------------------------------------------------------
+
+_RETRY_CONF_TTL_S = 5.0
+_retry_conf_cache: Tuple[float, Tuple[int, float, float]] = (0.0, (4, 0.05, 2.0))
+
+
+def _retry_conf() -> Tuple[int, float, float]:
+    # config reads re-flatten the YAML tree and scan the environment;
+    # this runs on every RPC attempt, so cache with a short TTL
+    global _retry_conf_cache
+    now = time.time()
+    ts, cached = _retry_conf_cache
+    if now - ts < _RETRY_CONF_TTL_S:
+        return cached
+    from ..config import get as config_get
+    try:
+        attempts = int(config_get("cluster.rpc_retry.max_attempts", 4))
+        base = float(config_get("cluster.rpc_retry.base_ms", 50)) / 1000.0
+        cap = float(config_get("cluster.rpc_retry.cap_ms", 2000)) / 1000.0
+    except (TypeError, ValueError):
+        attempts, base, cap = 4, 0.05, 2.0
+    conf = (max(1, attempts), max(0.0, base), max(0.0, cap))
+    _retry_conf_cache = (now, conf)
+    return conf
+
+
+def _is_not_found(e: Exception) -> bool:
+    if isinstance(e, faults.FaultInjectedError):
+        return e.code == "not_found"
+    code = getattr(e, "code", None)
+    if code is None:
+        return False
+    try:
+        return code() == grpc.StatusCode.NOT_FOUND
+    except Exception:  # noqa: BLE001 — non-standard RpcError shapes
+        return False
+
+
+def _call_with_retry(fn, *, site: str, key: str, method: str,
+                     attempts: Optional[int] = None):
+    """Run ``fn`` under the retry budget; transient gRPC errors and
+    injected faults back off with full jitter between attempts. An
+    injected WorkerCrash always propagates (the caller is "dead"), and
+    NOT_FOUND propagates immediately (retrying cannot resurrect a
+    cleaned-up stream)."""
+    max_attempts, base, cap = _retry_conf()
+    if attempts is not None:
+        max_attempts = max(1, attempts)
+    last: Optional[Exception] = None
+    for i in range(max_attempts):
+        if i:
+            time.sleep(random.uniform(0.0, min(cap, base * (2 ** (i - 1)))))
+            _record_metric("rpc.retry_count", 1, method=method)
+        try:
+            faults.inject(site, key=key)
+            return fn()
+        except faults.WorkerCrash:
+            raise
+        except (grpc.RpcError, faults.FaultInjectedError) as e:
+            if _is_not_found(e):
+                raise
+            last = e
+    raise last
 
 
 class _StreamStore:
@@ -157,9 +231,8 @@ def _task_metrics_enabled() -> bool:
     """Workers collect per-operator metrics for every task unless
     ``cluster.task_metrics`` turns it off (the collection forces one
     device sync per operator)."""
-    from ..config import get as config_get
-    return str(config_get("cluster.task_metrics", "true")) \
-        .strip().lower() not in ("0", "false", "no", "off")
+    from ..config import truthy
+    return truthy("cluster.task_metrics")
 
 
 def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
@@ -200,17 +273,27 @@ def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
 
 def _fetch_from(addr: str, req: pb.FetchStreamRequest, service: str,
                 timeout: float = 120.0) -> bytes:
-    channel = grpc.insecure_channel(addr)
-    try:
-        rpc = channel.unary_stream(
-            f"/{service}/FetchStream",
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=pb.FetchChunk.FromString)
-        parts = [chunk.data for chunk in
-                 rpc(req, timeout=timeout, metadata=tr.inject_context())]
-        return b"".join(parts)
-    finally:
-        channel.close()
+    key = (f"{addr}/scan:{req.scan_id}" if req.scan_id
+           else f"{addr}/s{req.stage}p{req.partition}c{req.channel}")
+
+    def once() -> bytes:
+        channel = grpc.insecure_channel(addr)
+        try:
+            rpc = channel.unary_stream(
+                f"/{service}/FetchStream",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.FetchChunk.FromString)
+            parts = [chunk.data for chunk in
+                     rpc(req, timeout=timeout, metadata=tr.inject_context())]
+            return b"".join(parts)
+        finally:
+            channel.close()
+
+    # one retry only: each attempt can legitimately take the full
+    # stream timeout, so a blackholed peer must fail over to the
+    # producer-re-run path after at most two, not multiply the stall
+    return _call_with_retry(once, site="shuffle.fetch", key=key,
+                            method="FetchStream", attempts=2)
 
 
 # ---------------------------------------------------------------------------
@@ -231,9 +314,16 @@ class WorkerActor(Actor):
         self.port = 0
         self._server: Optional[grpc.Server] = None
         self._driver_channel: Optional[grpc.Channel] = None
-        self._running: Dict[Tuple[str, int, int], threading.Event] = {}
+        # per-task cancel Events, one per execution currently queued or
+        # running for that (job, stage, partition) on this worker;
+        # mutated from the actor thread, pool threads, and gRPC handler
+        # threads — every structural mutation holds _running_lock
+        self._running: Dict[Tuple[str, int, int],
+                            List[threading.Event]] = {}
+        self._running_lock = threading.Lock()
         self._pool = futures.ThreadPoolExecutor(max_workers=task_slots)
         self._hb_stop = threading.Event()
+        self._crashed = False
         self.streams = _StreamStore()
 
     # -- rpc service -----------------------------------------------------
@@ -245,16 +335,19 @@ class WorkerActor(Actor):
 
         def stop_task(request: pb.StopTaskRequest, context):
             key = (request.job_id, request.stage, request.partition)
-            ev = self._running.get(key)
-            if ev is not None:
+            with self._running_lock:
+                evs = list(self._running.get(key) or ())
+            for ev in evs:
                 ev.set()  # cooperative cancel: checked between pipeline steps
-            return pb.StopTaskResponse(stopped=ev is not None)
+            return pb.StopTaskResponse(stopped=bool(evs))
 
         def clean_up_job(request: pb.CleanUpJobRequest, context):
             self.streams.clean_job(request.job_id)
-            for key in [k for k in self._running
-                        if k[0] == request.job_id]:
-                self._running[key].set()
+            with self._running_lock:
+                evs = [ev for k, lst in self._running.items()
+                       if k[0] == request.job_id for ev in lst]
+            for ev in evs:
+                ev.set()
             return pb.CleanUpJobResponse()
 
         return grpc.method_handlers_generic_handler(_WORKER_SERVICE, {
@@ -286,20 +379,39 @@ class WorkerActor(Actor):
         if self._server is not None:
             self._server.stop(grace=0.5)
 
-    def _call_driver(self, method: str, msg, resp_cls):
-        rpc = self._driver_channel.unary_unary(
-            f"/{_DRIVER_SERVICE}/{method}",
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=resp_cls.FromString)
-        return rpc(msg, timeout=30, metadata=tr.inject_context())
+    def _call_driver(self, method: str, msg, resp_cls, retry: bool = True):
+        def once():
+            rpc = self._driver_channel.unary_unary(
+                f"/{_DRIVER_SERVICE}/{method}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString)
+            return rpc(msg, timeout=30, metadata=tr.inject_context())
+
+        return _call_with_retry(once, site="rpc.call", key=method,
+                                method=method,
+                                attempts=None if retry else 1)
+
+    def _die(self):
+        """Injected process-level crash: stop serving streams and
+        heartbeats, report nothing — the driver must discover the loss
+        through heartbeat eviction, exactly like a real dead process."""
+        self._crashed = True
+        self._hb_stop.set()
+        if self._server is not None:
+            self._server.stop(grace=0)
 
     def _heartbeat_loop(self):
         while not self._hb_stop.wait(1.0):
             try:
+                faults.inject("worker.heartbeat", key=self.worker_id)
                 self._call_driver("Heartbeat", pb.HeartbeatRequest(
                     worker_id=self.worker_id,
-                    running_tasks=len(self._running)), pb.HeartbeatResponse)
-            except grpc.RpcError:
+                    running_tasks=len(self._running)), pb.HeartbeatResponse,
+                    retry=False)
+            except faults.WorkerCrash:
+                self._die()
+                return
+            except (grpc.RpcError, faults.FaultInjectedError):
                 pass
 
     # -- actor -----------------------------------------------------------
@@ -308,8 +420,13 @@ class WorkerActor(Actor):
         if kind == "run_task":
             task, parent = payload
             key = (task.job_id, task.stage, task.partition)
-            self._running[key] = threading.Event()
-            self._pool.submit(self._run_task, task, parent)
+            # one Event PER EXECUTION: a relaunched attempt landing on
+            # this worker while an older one is still queued/running
+            # must stay independently cancelable
+            ev = threading.Event()
+            with self._running_lock:
+                self._running.setdefault(key, []).append(ev)
+            self._pool.submit(self._run_task, task, parent, ev)
 
     # -- task execution --------------------------------------------------
     def _fetch_inputs(self, task: pb.TaskDefinition):
@@ -332,7 +449,9 @@ class WorkerActor(Actor):
                     buf = _fetch_from(addr, pb.FetchStreamRequest(
                         job_id=task.job_id, stage=inp.stage_id,
                         partition=up_part, channel=chan), _WORKER_SERVICE)
-                except grpc.RpcError as e:
+                except faults.WorkerCrash:
+                    raise
+                except (grpc.RpcError, faults.FaultInjectedError) as e:
                     raise _FetchFailed(inp.stage_id, up_part) from e
                 parts.append(_ipc_to_table(buf))
             tables[inp.stage_id] = pa.concat_tables(
@@ -340,18 +459,29 @@ class WorkerActor(Actor):
                 else parts[0]
         return tables
 
-    def _run_task(self, task: pb.TaskDefinition, parent=None):
+    def _run_task(self, task: pb.TaskDefinition, parent=None, ev=None):
         from .local import LocalExecutor
         key = (task.job_id, task.stage, task.partition)
         with tr.span(f"worker:task s{task.stage}p{task.partition}",
                      {"job_id": task.job_id, "stage": task.stage,
                       "partition": task.partition,
                       "worker": self.worker_id}, parent=parent):
-            self._run_task_inner(task, key)
+            self._run_task_inner(task, key, ev)
 
-    def _run_task_inner(self, task: pb.TaskDefinition, key):
+    def _run_task_inner(self, task: pb.TaskDefinition, key, ev=None):
         from .local import LocalExecutor
+        if self._crashed:
+            return  # a "dead" process executes nothing and reports nothing
+        # the Event registered for THIS execution (receive() created it
+        # before submit): cancel checks and the final removal go through
+        # it, so an old attempt finishing late can neither miss a cancel
+        # nor unregister a relaunched attempt
+        if ev is None:
+            ev = threading.Event()
         try:
+            faults.inject("worker.task_exec",
+                          key=f"{self.worker_id}:s{task.stage}"
+                              f"p{task.partition}")
             self._report(task, "running")
             plan = jg.decode_fragment(task.plan, task.partition,
                                       max(task.num_partitions, 1))
@@ -364,7 +494,7 @@ class WorkerActor(Actor):
                     plan, task.runtime_filters_json)
             if task.inputs:
                 plan = jg.attach_stage_inputs(plan, self._fetch_inputs(task))
-            if self._running.get(key, threading.Event()).is_set():
+            if ev.is_set():
                 self._report(task, "canceled")
                 return
             metrics_json = ""
@@ -383,6 +513,11 @@ class WorkerActor(Actor):
                     metrics_json = ""
             else:
                 table = LocalExecutor().execute(plan)
+            if ev.is_set():
+                # canceled while executing (job cancel / speculation
+                # loser): do not publish partial shuffle outputs
+                self._report(task, "canceled")
+                return
             if task.HasField("shuffle_write") and \
                     task.shuffle_write.num_channels > 1:
                 # shuffle consumers only ever fetch hash channels — do not
@@ -398,6 +533,10 @@ class WorkerActor(Actor):
                              channels)
             self._report(task, "succeeded", rows=table.num_rows,
                          metrics_json=metrics_json)
+        except faults.WorkerCrash:
+            # injected process death: no failure report, no cleanup — the
+            # driver's heartbeat eviction path must pick up the pieces
+            self._die()
         except _FetchFailed as e:
             # a producer's streams are gone (dead peer): the driver re-runs
             # the producer and re-schedules this task, not as our failure
@@ -406,10 +545,23 @@ class WorkerActor(Actor):
         except Exception as e:  # noqa: BLE001 — full cause goes to the driver
             self._report(task, "failed", error=f"{type(e).__name__}: {e}")
         finally:
-            self._running.pop(key, None)
+            with self._running_lock:
+                evs = self._running.get(key)
+                if evs is not None:
+                    try:
+                        evs.remove(ev)
+                    except ValueError:
+                        pass
+                    if not evs:
+                        self._running.pop(key, None)
 
     def _report(self, task: pb.TaskDefinition, state: str, error: str = "",
                 rows: int = 0, metrics_json: str = ""):
+        """Report task status with backoff retries: a worker that cannot
+        reach the driver for one transient blip must not lose a finished
+        task's result until heartbeat eviction re-runs it from scratch."""
+        if self._crashed:
+            return
         try:
             self._call_driver("ReportTaskStatus", pb.ReportTaskStatusRequest(
                 worker_id=self.worker_id, job_id=task.job_id,
@@ -417,8 +569,10 @@ class WorkerActor(Actor):
                 attempt=task.attempt, state=state, error=error,
                 rows_out=rows, metrics_json=metrics_json),
                 pb.ReportTaskStatusResponse)
-        except grpc.RpcError:
-            pass
+        except faults.WorkerCrash:
+            self._die()
+        except (grpc.RpcError, faults.FaultInjectedError):
+            pass  # retries exhausted: heartbeat eviction will re-run
 
 
 def _reattach_local_scans(plan, scan_tables):
@@ -495,6 +649,28 @@ class _Job:
         # consumer tasks waiting for a producer re-run after a fetch failure
         self.pending: Set[Tuple[int, int]] = set()
         self.stage_rows: Dict[int, int] = {}
+        # attempt fencing: per (stage, partition), the attempts currently
+        # IN FLIGHT and the worker running each — the first live attempt
+        # to report success wins; stale/duplicate attempts are ignored
+        self.live: Dict[Tuple[int, int], Dict[int, str]] = {}
+        # dispatch wall-clock per (stage, partition, attempt) + accepted
+        # task durations per stage (drives straggler detection)
+        self.started: Dict[Tuple[int, int, int], float] = {}
+        self.durations: Dict[int, List[float]] = {}
+        # speculation: partitions already duplicated, which attempt
+        # number is the speculative copy, and how many extra attempt ids
+        # speculation consumed (they must not eat the failure budget)
+        self.speculated: Set[Tuple[int, int]] = set()
+        self.spec_attempt: Dict[Tuple[int, int], int] = {}
+        self.attempt_allowance: Dict[Tuple[int, int], int] = {}
+        # terminal task reports already processed (workers retry reports
+        # under backoff, so delivery is at-least-once)
+        self.seen_reports: Set[Tuple[int, int, int, str, str]] = set()
+        # fault-tolerance accounting surfaced through the query profile
+        self.retry_count = 0
+        self.spec_launched = 0
+        self.spec_won = 0
+        self.canceled = False
         # per-{stage, partition} operator metrics from the winning task
         # attempt: {"worker_id", "rows_out", "operators": [...]}
         self.task_metrics: Dict[Tuple[int, int], dict] = {}
@@ -507,6 +683,15 @@ class DriverActor(Actor):
 
     def __init__(self, host: str = "127.0.0.1"):
         super().__init__()
+        from ..config import get as config_get
+        from ..config import truthy as _on
+
+        def _num(key, default, cast=float):
+            try:
+                return cast(config_get(key, default))
+            except (TypeError, ValueError):
+                return default
+
         self.host = host
         self.driver_id = uuid.uuid4().hex[:8]
         self.workers: Dict[str, dict] = {}
@@ -520,6 +705,37 @@ class DriverActor(Actor):
         self.elastic: Optional[dict] = None
         self._starting = 0
         self._starting_ts: List[float] = []
+        # high-water mark of (live + starting) workers: scale-up is
+        # observable after the fact even once idle reaping shrinks the
+        # pool back down (reading the live count races the reaper)
+        self.pool_peak = 0
+        self.HEARTBEAT_TIMEOUT_S = _num(
+            "cluster.worker_heartbeat_timeout_secs", 10.0)
+        self.MAX_TASK_ATTEMPTS = _num("cluster.task_max_attempts", 3, int)
+        # worker quarantine: N reported task failures inside a sliding
+        # window blacklist the worker for a cool-off period
+        self.quarantine = {
+            "enabled": _on("cluster.quarantine.enabled"),
+            "max_failures": _num("cluster.quarantine.max_failures", 5, int),
+            "window_s": _num("cluster.quarantine.window_secs", 30.0),
+            "duration_s": _num("cluster.quarantine.duration_secs", 60.0),
+        }
+        self.quarantined: Dict[str, float] = {}  # worker_id -> expiry ts
+        # registration info of evicted workers: workers register only
+        # once, so readmission (a transiently-evicted or cooled-off
+        # worker that is still heartbeating) rebuilds the pool entry
+        # from this
+        self._readmit_info: Dict[str, dict] = {}
+        # speculative execution: once a stage is mostly complete,
+        # duplicate its slowest still-running tasks on other workers
+        self.speculation = {
+            "enabled": _on("cluster.speculation.enabled"),
+            "fraction": _num("cluster.speculation.stage_fraction", 0.75),
+            "multiplier": _num(
+                "cluster.speculation.latency_multiplier", 1.5),
+            "min_runtime_s": _num(
+                "cluster.speculation.min_runtime_ms", 500.0) / 1000.0,
+        }
 
     def set_elastic(self, manager, min_workers: int = 1,
                     max_workers: int = 4, idle_secs: float = 60.0):
@@ -555,10 +771,16 @@ class DriverActor(Actor):
             self.handle.send(("task_status", request))
             return pb.ReportTaskStatusResponse()
 
+        def cancel_job(request: pb.CancelJobRequest, context):
+            self.handle.send(("cancel", (request.job_id,
+                                         request.reason or "client abort")))
+            return pb.CancelJobResponse(canceled=True)
+
         return grpc.method_handlers_generic_handler(_DRIVER_SERVICE, {
             "RegisterWorker": _unary(register, pb.RegisterWorkerRequest),
             "Heartbeat": _unary(heartbeat, pb.HeartbeatRequest),
             "ReportTaskStatus": _unary(report, pb.ReportTaskStatusRequest),
+            "CancelJob": _unary(cancel_job, pb.CancelJobRequest),
             "FetchStream": grpc.unary_stream_rpc_method_handler(
                 _fetch_stream_handler(self.streams, self._scan_tables_view),
                 request_deserializer=pb.FetchStreamRequest.FromString,
@@ -589,6 +811,14 @@ class DriverActor(Actor):
         kind, payload = message
         if kind == "register":
             r: pb.RegisterWorkerRequest = payload
+            if self.quarantined.get(r.worker_id, 0.0) > time.time():
+                # a blacklisted worker re-registering inside its cool-off
+                # window stays out of the pool for now; keep its info so
+                # its heartbeats readmit it once the cool-off expires
+                self._readmit_info[r.worker_id] = {
+                    "addr": f"{r.host}:{r.port}", "slots": r.task_slots,
+                    "ts": time.time()}
+                return
             from ..catalog.system import SYSTEM
             SYSTEM.record_worker(r.worker_id, f"{r.host}:{r.port}",
                                  r.task_slots, "alive")
@@ -602,11 +832,15 @@ class DriverActor(Actor):
             if self._starting_ts:
                 self._starting_ts.pop(0)
             self._starting = len(self._starting_ts)
+            self.pool_peak = max(self.pool_peak,
+                                 len(self.workers) + self._starting)
             _record_metric("cluster.worker_count", len(self.workers))
         elif kind == "heartbeat":
             w = self.workers.get(payload.worker_id)
             if w is not None:
                 w["last_seen"] = time.time()
+            else:
+                self._maybe_readmit(payload.worker_id)
         elif kind == "probe":
             self._probe_workers()
         elif kind == "submit":
@@ -619,6 +853,9 @@ class DriverActor(Actor):
                 reply.set(job)
         elif kind == "task_status":
             self._on_task_status(payload)
+        elif kind == "cancel":
+            job_id, reason = payload
+            self._cancel_job(job_id, reason)
         elif kind == "cleanup":
             self._cleanup_job(payload)
 
@@ -636,6 +873,8 @@ class DriverActor(Actor):
             e["manager"].start_worker()
             self._starting_ts.append(now)
             self._starting += 1
+            self.pool_peak = max(self.pool_peak,
+                                 len(self.workers) + self._starting)
         except Exception:  # noqa: BLE001 — scale-up is best effort
             pass
 
@@ -677,40 +916,88 @@ class DriverActor(Actor):
 
     def _probe_workers(self):
         now = time.time()
+        self.quarantined = {wid: t for wid, t in self.quarantined.items()
+                            if t > now}
+        # readmission info only matters while the worker still
+        # heartbeats; prune entries for workers that stayed silent well
+        # past any cool-off (dead-worker churn must not grow the dict)
+        ttl = self.quarantine["duration_s"] + 600.0
+        self._readmit_info = {
+            wid: info for wid, info in self._readmit_info.items()
+            if now - info.get("ts", now) < ttl}
         if self.elastic is not None:
             self._reap_idle_workers(now)
         lost = [wid for wid, w in self.workers.items()
                 if now - w["last_seen"] > self.HEARTBEAT_TIMEOUT_S]
-        if lost:
-            _record_metric("cluster.worker_count",
-                           len(self.workers) - len(lost))
         for wid in lost:
-            w = self.workers.pop(wid)
-            # re-run the lost worker's RUNNING tasks
-            for (job_id, stage, partition) in list(w["tasks"]):
-                job = self.jobs.get(job_id)
-                if job is not None and not job.done.is_set():
-                    att = self.attempt_of(job, stage, partition) + 1
-                    self._launch_task(job, stage, partition, att)
-            # its COMPLETED stream outputs are gone too: invalidate their
-            # locations and re-run those producer partitions
-            for job in list(self.jobs.values()):
-                if job.done.is_set():
-                    continue
-                for stage_id, locs in job.locations.items():
-                    dead = [p for p, a in locs.items() if a == w["addr"]]
-                    for p in dead:
-                        del locs[p]
-                        # re-run whether the stage was launched whole
-                        # (scheduled) or per-partition (pipelined)
-                        if stage_id in job.scheduled or \
-                                (stage_id, p) in job.launched:
-                            att = self.attempt_of(job, stage_id, p) + 1
-                            self._launch_task(job, stage_id, p, att)
+            self._evict_worker(wid, "lost")
+        self._maybe_speculate(now)
+
+    def _evict_worker(self, wid: str, reason: str):
+        """Remove a dead/blacklisted worker and repair every live job:
+        its RUNNING tasks re-launch elsewhere (all of them, not just the
+        one that exposed the failure) and its COMPLETED stream outputs
+        are invalidated so their producer partitions re-run."""
+        w = self.workers.pop(wid, None)
+        if w is None:
+            return
+        _record_metric("cluster.worker_count", len(self.workers))
+        try:
+            w["channel"].close()
+        except Exception:  # noqa: BLE001 — eviction must not fail
+            pass
+        # a live worker evicted for a transient blip (dispatch failure,
+        # missed heartbeats under load) keeps heartbeating: remember its
+        # registration so _maybe_readmit can restore it instead of
+        # halving a static pool forever
+        self._readmit_info[wid] = {"addr": w["addr"], "slots": w["slots"],
+                                   "ts": time.time()}
+        from ..catalog.system import SYSTEM
+        SYSTEM.record_worker(wid, w["addr"], w["slots"], reason)
+        relaunch: List[Tuple[_Job, int, int]] = []
+        for (job_id, stage, partition) in list(w["tasks"]):
+            job = self.jobs.get(job_id)
+            if job is not None and not job.done.is_set():
+                relaunch.append((job, stage, partition))
+        w["tasks"].clear()
+        for job in list(self.jobs.values()):
+            if job.done.is_set():
+                continue
+            for stage_id, locs in job.locations.items():
+                dead = [p for p, a in locs.items() if a == w["addr"]]
+                for p in dead:
+                    del locs[p]
+                    # re-run whether the stage was launched whole
+                    # (scheduled) or per-partition (pipelined)
+                    if stage_id in job.scheduled or \
+                            (stage_id, p) in job.launched:
+                        relaunch.append((job, stage_id, p))
+        seen: Set[Tuple[str, int, int]] = set()
+        for job, stage, partition in relaunch:
+            if (job.job_id, stage, partition) in seen:
+                continue
+            seen.add((job.job_id, stage, partition))
+            # drop the dead worker's in-flight attempts; if a twin attempt
+            # survives on another worker it covers this partition
+            live = job.live.get((stage, partition), {})
+            for att in [a for a, lw in live.items() if lw == wid]:
+                live.pop(att)
+            if live:
+                continue
+            self._launch_task(job, stage, partition,
+                              self.attempt_of(job, stage, partition) + 1,
+                              reason="evicted")
 
     @staticmethod
     def attempt_of(job: _Job, stage: int, partition: int) -> int:
         return job.attempts.get((stage, partition), 0)
+
+    def _attempt_cap(self, job: _Job, stage: int, partition: int) -> int:
+        """Attempt-id budget for one task: the configured maximum plus
+        one per attempt id a speculative twin consumed — speculation
+        must not reduce how many real failures the task can survive."""
+        return self.MAX_TASK_ATTEMPTS + \
+            job.attempt_allowance.get((stage, partition), 0)
 
     # -- scheduling ------------------------------------------------------
     def _stage_complete(self, job: _Job, stage_id: int) -> bool:
@@ -759,23 +1046,23 @@ class DriverActor(Actor):
             job.done.set()
 
     def _launch_task(self, job: _Job, stage_id: int, partition: int,
-                     attempt: int):
-        if attempt >= self.MAX_TASK_ATTEMPTS:
+                     attempt: int, reason: str = "",
+                     exclude: Optional[Set[str]] = None,
+                     speculative: bool = False) -> bool:
+        """Dispatch one task attempt; True when a worker accepted it."""
+        if job.done.is_set():
+            return False
+        if attempt >= self._attempt_cap(job, stage_id, partition):
+            if speculative:
+                return False  # speculation must never fail a healthy job
             job.failed = (f"stage {stage_id} task {partition} exceeded "
                           f"max attempts: {job.last_error}")
             job.done.set()
-            return
-        live = sorted(self.workers.items(),
-                      key=lambda kv: len(kv[1]["tasks"]))
-        if not live:
-            job.failed = "no live workers"
-            job.done.set()
-            return
-        wid, w = live[0]
-        if self.elastic is not None and len(w["tasks"]) >= w["slots"]:
-            self._maybe_scale_up()
+            return False
+        if reason:
+            job.retry_count += 1
+            _record_metric("cluster.task.retry_count", 1, reason=reason)
         stage = job.graph.stages[stage_id]
-        job.attempts[(stage_id, partition)] = attempt
         inputs = []
         for i in stage.inputs:
             up = job.graph.stages[i.stage_id]
@@ -788,12 +1075,12 @@ class DriverActor(Actor):
                     job.failed = (f"stage {stage_id} p{partition}: forward "
                                   f"input {i.stage_id} not located")
                     job.done.set()
-                    return
+                    return False
             elif not all(addrs):
                 job.failed = (f"stage {stage_id}: input stage {i.stage_id} "
                               f"incomplete at launch")
                 job.done.set()
-                return
+                return False
             inputs.append(pb.StageInputLocations(
                 stage_id=i.stage_id, mode=i.mode.value, worker_addrs=addrs))
         task = pb.TaskDefinition(
@@ -806,24 +1093,85 @@ class DriverActor(Actor):
             task.shuffle_write.CopyFrom(pb.ShuffleWriteSpec(
                 key_columns=list(stage.shuffle_keys),
                 num_channels=stage.num_channels))
-        w["tasks"].add((job.job_id, stage_id, partition))
-        w["idle_since"] = None
-        rpc = w["channel"].unary_unary(
-            f"/{_WORKER_SERVICE}/RunTask",
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=pb.RunTaskResponse.FromString)
-        try:
-            with tr.span(f"driver:launch s{stage_id}p{partition}",
-                         {"job_id": job.job_id, "worker": wid},
-                         parent=job.trace_ctx) as ls:
-                rpc(pb.RunTaskRequest(task=task), timeout=30,
-                    metadata=[("traceparent",
-                               f"00-{ls.trace_id}-{ls.span_id}-01")])
-        except grpc.RpcError:
-            # dispatch failure = dead worker: evict immediately and redo the
-            # SAME attempt elsewhere (a launch failure is not a task failure)
-            self.workers.pop(wid, None)
-            self._launch_task(job, stage_id, partition, attempt)
+        # dispatch loop (NOT recursion): a flapping pool can no longer
+        # blow the stack, and each failed dispatch evicts its worker and
+        # reschedules ALL of that worker's running tasks, not just this
+        # one. The budget bounds a pathological pool where every worker
+        # rejects the dispatch.
+        budget = max(4, 2 * len(self.workers))
+        while not job.done.is_set():
+            candidates = sorted(
+                ((wid, w) for wid, w in self.workers.items()
+                 if not exclude or wid not in exclude),
+                key=lambda kv: len(kv[1]["tasks"]))
+            if not candidates:
+                if speculative:
+                    return False  # nowhere to duplicate: keep the original
+                if exclude:
+                    # exclusion is a preference (avoid the worker that
+                    # just failed), not a constraint: fall back to the
+                    # full pool rather than failing the job
+                    exclude = None
+                    continue
+                job.failed = "no live workers"
+                job.done.set()
+                return False
+            wid, w = candidates[0]
+            if self.elastic is not None and len(w["tasks"]) >= w["slots"]:
+                self._maybe_scale_up()
+            w["tasks"].add((job.job_id, stage_id, partition))
+            w["idle_since"] = None
+            rpc = w["channel"].unary_unary(
+                f"/{_WORKER_SERVICE}/RunTask",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.RunTaskResponse.FromString)
+            try:
+                with tr.span(f"driver:launch s{stage_id}p{partition}",
+                             {"job_id": job.job_id, "worker": wid},
+                             parent=job.trace_ctx) as ls:
+                    # RunTask only enqueues on the worker actor, so a
+                    # short deadline and a small retry budget keep the
+                    # single-threaded driver's worst-case stall on a
+                    # wedged worker well under the old 30s, not above it
+                    _call_with_retry(
+                        lambda: rpc(
+                            pb.RunTaskRequest(task=task), timeout=10,
+                            metadata=[("traceparent",
+                                       f"00-{ls.trace_id}-{ls.span_id}-01")]),
+                        site="rpc.call", key="RunTask", method="RunTask",
+                        attempts=2)
+                # the attempt number is committed only now: a launch
+                # that never dispatched (e.g. a failed speculative twin)
+                # must not burn one of the task's attempts
+                job.attempts[(stage_id, partition)] = max(
+                    attempt, job.attempts.get((stage_id, partition), 0))
+                job.live.setdefault((stage_id, partition), {})[attempt] = wid
+                job.started[(stage_id, partition, attempt)] = time.time()
+                # a parked consumer relaunches at the SAME attempt
+                # number: drop that attempt's terminal records so the
+                # report dedupe only swallows retransmissions, never the
+                # fresh execution's genuine outcome
+                job.seen_reports = {
+                    rk for rk in job.seen_reports
+                    if rk[:3] != (stage_id, partition, attempt)}
+                return True
+            except (grpc.RpcError, faults.FaultInjectedError):
+                # dispatch failure = dead worker: evict it (rescheduling
+                # its OTHER tasks) and redo the SAME attempt elsewhere (a
+                # launch failure is not a task failure)
+                w["tasks"].discard((job.job_id, stage_id, partition))
+                self._evict_worker(wid, "dispatch-failure")
+                _record_metric("cluster.task.retry_count", 1,
+                               reason="dispatch")
+                budget -= 1
+                if budget <= 0:
+                    if speculative:
+                        return False
+                    job.failed = (f"stage {stage_id} task {partition}: "
+                                  f"dispatch retry budget exhausted")
+                    job.done.set()
+                    return False
+        return False
 
     def _on_task_status(self, r: pb.ReportTaskStatusRequest):
         from ..catalog.system import SYSTEM
@@ -833,49 +1181,251 @@ class DriverActor(Actor):
         if job is None or job.done.is_set():
             return
         w = self.workers.get(r.worker_id)
-        if r.state in ("succeeded", "failed", "canceled") and w is not None:
-            w["tasks"].discard((r.job_id, r.stage, r.partition))
-            if not w["tasks"]:
-                w["idle_since"] = time.time()
+        key = (r.stage, r.partition)
+        live = job.live.get(key, {})
+        if r.state in ("succeeded", "failed", "canceled"):
+            # workers retry status reports (at-least-once delivery): a
+            # duplicate terminal report must not re-trigger ANY side
+            # effect — not the FETCH_FAILED teardown below, and not the
+            # w["tasks"] discard either (the same task may have been
+            # relaunched onto this worker in the meantime; unregistering
+            # it would let the idle reaper take a busy worker)
+            rk = (r.stage, r.partition, r.attempt, r.state, r.worker_id)
+            if rk in job.seen_reports:
+                return
+            job.seen_reports.add(rk)
+            if w is not None:
+                w["tasks"].discard((r.job_id, r.stage, r.partition))
+                if not w["tasks"]:
+                    w["idle_since"] = time.time()
         if r.state == "succeeded":
+            if r.partition in job.locations[r.stage]:
+                return  # a twin attempt already won — late duplicate
             if w is None:
                 # the worker was evicted before its success report arrived;
-                # its streams died with it — run the task again elsewhere
-                self._launch_task(job, r.stage, r.partition,
-                                  self.attempt_of(job, r.stage,
-                                                  r.partition) + 1)
+                # its streams died with it. A surviving twin attempt will
+                # cover the partition; otherwise run the task again.
+                if not live:
+                    self._launch_task(job, r.stage, r.partition,
+                                      self.attempt_of(job, r.stage,
+                                                      r.partition) + 1,
+                                      reason="evicted")
                 return
-            if r.attempt == self.attempt_of(job, r.stage, r.partition):
-                job.locations[r.stage][r.partition] = w["addr"]
-                job.stage_rows[r.stage] = \
-                    job.stage_rows.get(r.stage, 0) + int(r.rows_out)
-                if r.metrics_json:
-                    try:
-                        import json as _json
-                        job.task_metrics[(r.stage, r.partition)] = {
-                            "worker_id": r.worker_id,
-                            "rows_out": int(r.rows_out),
-                            "operators": _json.loads(r.metrics_json)}
-                    except ValueError:
-                        pass  # malformed metrics never fail a task
-                self._fire_pending(job)
-                self._schedule_ready_stages(job)
+            if live and r.attempt not in live:
+                return  # fenced out: a stale attempt may not publish
+            started = job.started.get((r.stage, r.partition, r.attempt))
+            if started is not None:
+                job.durations.setdefault(r.stage, []).append(
+                    time.time() - started)
+            # first live attempt wins; losers are canceled on their workers
+            for att, lw in live.items():
+                if att != r.attempt:
+                    self._stop_task_on(lw, r.job_id, r.stage, r.partition,
+                                       "speculation_loser")
+            job.live.pop(key, None)
+            if key in job.speculated and \
+                    r.attempt == job.spec_attempt.get(key):
+                job.spec_won += 1
+                _record_metric("cluster.task.speculative_won", 1)
+            job.locations[r.stage][r.partition] = w["addr"]
+            job.stage_rows[r.stage] = \
+                job.stage_rows.get(r.stage, 0) + int(r.rows_out)
+            if r.metrics_json:
+                try:
+                    import json as _json
+                    job.task_metrics[(r.stage, r.partition)] = {
+                        "worker_id": r.worker_id,
+                        "rows_out": int(r.rows_out),
+                        "operators": _json.loads(r.metrics_json)}
+                except ValueError:
+                    pass  # malformed metrics never fail a task
+            self._fire_pending(job)
+            self._schedule_ready_stages(job)
         elif r.state == "failed":
+            live.pop(r.attempt, None)
             if r.error.startswith("FETCH_FAILED:"):
                 _, s, p = r.error.split(":")
                 up_stage, up_part = int(s), int(p)
                 job.locations[up_stage].pop(up_part, None)
                 if self.attempt_of(job, up_stage, up_part) + 1 < \
-                        self.MAX_TASK_ATTEMPTS:
+                        self._attempt_cap(job, up_stage, up_part):
                     # not the consumer's fault: park it (same attempt) and
-                    # re-run the producer partition
+                    # re-run the producer partition — unless a producer
+                    # re-run is already in flight (several consumers can
+                    # hit the same dead producer; one re-run serves all)
                     job.pending.add((r.stage, r.partition))
-                    self._launch_task(job, up_stage, up_part,
-                                      self.attempt_of(job, up_stage,
-                                                      up_part) + 1)
+                    if not job.live.get((up_stage, up_part)):
+                        self._launch_task(job, up_stage, up_part,
+                                          self.attempt_of(job, up_stage,
+                                                          up_part) + 1,
+                                          reason="fetch_failed")
                     return
+            else:
+                # a fetch failure is the PRODUCER's loss, never a strike
+                # against the consumer's worker — quarantining healthy
+                # consumers would shrink the pool exactly when degraded
+                self._note_worker_failure(r.worker_id)
             job.last_error = r.error
-            self._launch_task(job, r.stage, r.partition, r.attempt + 1)
+            if job.live.get(key):
+                return  # a twin attempt still runs — let it finish
+            # prefer a DIFFERENT worker for the retry: with the default
+            # budgets a node-local fault would otherwise burn every
+            # attempt on the same least-loaded (just-freed) worker
+            # before quarantine can engage
+            self._launch_task(job, r.stage, r.partition,
+                              max(r.attempt,
+                                  self.attempt_of(job, r.stage,
+                                                  r.partition)) + 1,
+                              reason="failure", exclude={r.worker_id})
+        elif r.state == "canceled":
+            live.pop(r.attempt, None)
+
+    def _stop_task_on(self, wid: str, job_id: str, stage: int,
+                      partition: int, reason: str):
+        """Best-effort cooperative cancel of a task on one worker."""
+        w = self.workers.get(wid)
+        if w is None:
+            return
+        rpc = w["channel"].unary_unary(
+            f"/{_WORKER_SERVICE}/StopTask",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.StopTaskResponse.FromString)
+        try:
+            # fire-and-forget: a blackholed worker must not stall the
+            # single-threaded driver actor for the full RPC deadline
+            fut = rpc.future(
+                pb.StopTaskRequest(job_id=job_id, stage=stage,
+                                   partition=partition, reason=reason),
+                timeout=10)
+            fut.add_done_callback(lambda f: f.cancelled() or f.exception())
+        except (grpc.RpcError, faults.FaultInjectedError):
+            pass
+
+    def _note_worker_failure(self, wid: str):
+        """Quarantine accounting: N reported task failures inside the
+        sliding window blacklist the worker for the cool-off period and
+        (under an elastic pool) trigger a replacement scale-up."""
+        q = self.quarantine
+        if not q["enabled"]:
+            return
+        w = self.workers.get(wid)
+        if w is None:
+            return
+        now = time.time()
+        fails = [t for t in w.get("failures", [])
+                 if now - t <= q["window_s"]]
+        fails.append(now)
+        w["failures"] = fails
+        if len(fails) < q["max_failures"]:
+            return
+        # pool floor: a deterministically failing QUERY produces strikes
+        # on every worker — never quarantine the last live worker, or
+        # one bad job blacks out the whole cluster for the cool-off
+        # (an elastic pool refills AFTER eviction, so the floor applies
+        # there too: scale-up is asynchronous)
+        if len(self.workers) <= 1:
+            w["failures"] = []
+            return
+        self.quarantined[wid] = now + q["duration_s"]
+        _record_metric("cluster.worker.quarantined_count", 1)
+        self._evict_worker(wid, "quarantined")
+        if self.elastic is not None:
+            self._maybe_scale_up()
+
+    def _maybe_readmit(self, wid: str):
+        """An evicted worker is still alive and heartbeating (transient
+        dispatch failure, heartbeat blip, or an expired quarantine):
+        rebuild its pool entry from the registration info saved at
+        eviction (workers register only once, so without this evicting
+        a live worker would be permanent capacity loss)."""
+        info = self._readmit_info.get(wid)
+        if info is None or self.quarantined.get(wid, 0.0) > time.time():
+            return
+        self._readmit_info.pop(wid, None)
+        self.quarantined.pop(wid, None)
+        from ..catalog.system import SYSTEM
+        SYSTEM.record_worker(wid, info["addr"], info["slots"], "alive")
+        self.workers[wid] = {
+            "addr": info["addr"], "slots": info["slots"],
+            "last_seen": time.time(),
+            "channel": grpc.insecure_channel(info["addr"]),
+            "tasks": set(),
+            "idle_since": time.time(),
+        }
+        _record_metric("cluster.worker_count", len(self.workers))
+
+    def _maybe_speculate(self, now: float):
+        """Straggler mitigation: when a stage is mostly complete,
+        duplicate its slowest still-running tasks on OTHER workers. The
+        first attempt to succeed wins (attempt fencing in
+        _on_task_status); the loser is canceled."""
+        sp = self.speculation
+        if not sp["enabled"]:
+            return
+        for job in list(self.jobs.values()):
+            if job.done.is_set():
+                continue
+            for stage in job.graph.stages:
+                if stage.on_driver or stage.num_partitions < 2:
+                    continue
+                sid = stage.stage_id
+                done = len(job.locations[sid])
+                if done >= stage.num_partitions or \
+                        done / stage.num_partitions < sp["fraction"]:
+                    continue
+                durs = job.durations.get(sid)
+                if not durs:
+                    continue
+                threshold = max(sp["min_runtime_s"],
+                                sp["multiplier"] * statistics.median(durs))
+                for (s, p), live in list(job.live.items()):
+                    if s != sid or not live or (s, p) in job.speculated \
+                            or p in job.locations[sid]:
+                        continue
+                    att = max(live)
+                    started = job.started.get((s, p, att))
+                    if started is None or now - started < threshold:
+                        continue
+                    new_att = self.attempt_of(job, s, p) + 1
+                    # mark BEFORE dispatch so the twin's instant success
+                    # report (same actor thread, but belt and braces)
+                    # sees the speculative attempt id; roll back if no
+                    # worker accepted the duplicate so the partition can
+                    # be speculated once capacity appears
+                    job.speculated.add((s, p))
+                    job.spec_attempt[(s, p)] = new_att
+                    # the twin's attempt id is granted back to the
+                    # failure budget up front (BEFORE the cap check in
+                    # _launch_task) and revoked if nothing dispatched
+                    job.attempt_allowance[(s, p)] = \
+                        job.attempt_allowance.get((s, p), 0) + 1
+                    if self._launch_task(job, s, p, new_att,
+                                         exclude={live[att]},
+                                         speculative=True):
+                        job.spec_launched += 1
+                        _record_metric("cluster.task.speculative_launched",
+                                       1)
+                    else:
+                        job.attempt_allowance[(s, p)] -= 1
+                        job.speculated.discard((s, p))
+                        job.spec_attempt.pop((s, p), None)
+
+    def _cancel_job(self, job_id: str, reason: str):
+        """Deadline/client cancellation: mark the job failed, stop its
+        worker-side tasks cooperatively, and let the cleanup path wipe
+        the partial shuffle outputs instead of leaking them."""
+        job = self.jobs.get(job_id)
+        if job is None or job.done.is_set():
+            return
+        job.canceled = True
+        job.failed = f"canceled: {reason}"
+        job.done.set()
+        for wid, w in list(self.workers.items()):
+            for (j, s, p) in [t for t in w["tasks"] if t[0] == job_id]:
+                self._stop_task_on(wid, job_id, s, p, "cancel")
+                w["tasks"].discard((j, s, p))
+            if not w["tasks"] and w.get("idle_since") is None:
+                w["idle_since"] = time.time()
 
     def _fire_pending(self, job: _Job):
         ready = []
@@ -932,6 +1482,7 @@ class LocalCluster:
         workers beyond ``num_workers`` are started on demand by the driver
         through a ThreadWorkerManager and idle-reaped (reference:
         driver/worker_pool/ elastic scaling)."""
+        faults.reload()  # pick up SAIL_FAULTS set after module import
         self.driver = DriverActor()
         self.driver.start("driver")
         deadline = time.time() + 10
@@ -987,8 +1538,16 @@ class LocalCluster:
         self.driver.handle.ask(lambda reply: ("submit", (job, reply)))
         try:
             if not job.done.wait(timeout):
+                # cancel on the driver actor: stop worker-side execution
+                # and release the tasks instead of leaving them running
+                # against a dead _Job (the cleanup in finally then wipes
+                # the partial shuffle outputs on every worker)
+                self.cancel_job(job.job_id, "timeout")
+                job.done.wait(5.0)
                 raise TimeoutError("cluster job timed out")
             if job.failed:
+                if job.canceled:
+                    raise RuntimeError(f"cluster job {job.failed}")
                 raise RuntimeError(f"cluster job failed: {job.failed}")
             # the root stage runs on the driver over MERGE input fetched
             # from the workers via the data plane
@@ -1019,9 +1578,22 @@ class LocalCluster:
                     prof.add_task(stage, part, m.get("worker_id", ""),
                                   m.get("operators") or [],
                                   m.get("rows_out", 0))
+                prof.note_fault_tolerance(
+                    retries=job.retry_count,
+                    speculative_launched=job.spec_launched,
+                    speculative_won=job.spec_won)
             return result
         finally:
             self.driver.handle.send(("cleanup", job.job_id))
+
+    def cancel_job(self, job_id: Optional[str] = None,
+                   reason: str = "client abort"):
+        """Cancel a running job (client abort): stops worker-side task
+        execution and fails the waiting run_job call. Also reachable
+        over the driver's CancelJob RPC."""
+        job_id = job_id or (self.last_job.job_id if self.last_job else None)
+        if job_id is not None:
+            self.driver.handle.send(("cancel", (job_id, reason)))
 
     def stage_rows(self) -> Dict[int, int]:
         """Rows produced per stage of the last job (operator metrics)."""

@@ -492,11 +492,7 @@ def split_job(plan: pn.PlanNode, num_partitions: int) -> Optional[JobGraph]:
                  on_driver=True)
     b.stages.append(root)
     graph = JobGraph(b.stages, b.scan_tables)
-    from ..config import get as config_get
-
-    def _on(key):
-        return str(config_get(key, "true")).strip().lower() \
-            not in ("0", "false", "no", "off")
+    from ..config import truthy as _on
 
     # both the cluster gate AND the runtime-filter master switch must be
     # on (SAIL_JOIN__RUNTIME_FILTER__ENABLED=0 kills cluster shipping

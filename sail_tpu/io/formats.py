@@ -136,7 +136,9 @@ def read_table(fmt: str, paths: Sequence[str], options: Dict[str, str],
                columns: Optional[Sequence[str]] = None,
                limit: Optional[int] = None,
                filter_expr=None) -> pa.Table:
+    from .. import faults
     fmt = fmt.lower()
+    faults.inject("io.read", key=fmt)
     if fmt == "delta":
         from ..lakehouse.delta import DeltaTable
         version, ts_ms = _delta_travel(options)

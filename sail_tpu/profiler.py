@@ -72,6 +72,11 @@ class QueryProfile:
     rtf_pushed: int = 0
     rtf_rows_pruned: int = 0
     rtf_build_ms: float = 0.0
+    # cluster fault tolerance: task retries (failure/eviction/dispatch),
+    # speculative duplicates launched and how many of those won
+    ft_retries: int = 0
+    ft_speculative_launched: int = 0
+    ft_speculative_won: int = 0
     rows_out: int = 0
     slow: bool = False
     # operator metric trees (dicts, telemetry.OperatorMetrics.to_dict)
@@ -148,6 +153,14 @@ class QueryProfile:
             self.rtf_rows_pruned += int(rows_pruned)
             self.rtf_build_ms += float(build_ms)
 
+    def note_fault_tolerance(self, retries: int = 0,
+                             speculative_launched: int = 0,
+                             speculative_won: int = 0) -> None:
+        with self._lock:
+            self.ft_retries += int(retries)
+            self.ft_speculative_launched += int(speculative_launched)
+            self.ft_speculative_won += int(speculative_won)
+
     def add_task(self, stage: int, partition: int, worker_id: str,
                  operators: List[dict], rows_out: int = 0) -> None:
         """Merge one distributed task's operator metrics (driver side)."""
@@ -205,6 +218,11 @@ class QueryProfile:
                 "rows_pruned": self.rtf_rows_pruned,
                 "build_ms": round(self.rtf_build_ms, 3),
             },
+            "fault_tolerance": {
+                "retries": self.ft_retries,
+                "speculative_launched": self.ft_speculative_launched,
+                "speculative_won": self.ft_speculative_won,
+            },
             "rows_out": self.rows_out,
             "slow": self.slow,
             "operators": list(self.operators),
@@ -231,6 +249,11 @@ class QueryProfile:
                 f"pushed={self.rtf_pushed} "
                 f"rows_pruned={self.rtf_rows_pruned} "
                 f"build={self.rtf_build_ms:.1f}ms")
+        if self.ft_retries or self.ft_speculative_launched:
+            lines.append(
+                f"fault tolerance: retries={self.ft_retries} "
+                f"speculative={self.ft_speculative_launched} "
+                f"won={self.ft_speculative_won}")
         if self.tasks:
             from .telemetry import OperatorMetrics
             lines.append(f"tasks: {len(self.tasks)}")

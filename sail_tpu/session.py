@@ -871,6 +871,36 @@ class SessionConf:
         slow_ms = app.get("telemetry.slow_query_ms")
         if slow_ms is not None:  # 0 is meaningful: disables the slow log
             base["spark.sail.telemetry.slowQueryMs"] = str(slow_ms)
+        # cluster fault-tolerance knobs (YAML cluster.{rpc_retry,
+        # speculation, quarantine}.* → spark.sail.cluster.* camelCase)
+        for yaml_key, conf_key in (
+                ("cluster.rpc_retry.max_attempts",
+                 "spark.sail.cluster.rpcRetry.maxAttempts"),
+                ("cluster.rpc_retry.base_ms",
+                 "spark.sail.cluster.rpcRetry.baseMs"),
+                ("cluster.rpc_retry.cap_ms",
+                 "spark.sail.cluster.rpcRetry.capMs"),
+                ("cluster.speculation.enabled",
+                 "spark.sail.cluster.speculation.enabled"),
+                ("cluster.speculation.stage_fraction",
+                 "spark.sail.cluster.speculation.stageFraction"),
+                ("cluster.speculation.latency_multiplier",
+                 "spark.sail.cluster.speculation.latencyMultiplier"),
+                ("cluster.speculation.min_runtime_ms",
+                 "spark.sail.cluster.speculation.minRuntimeMs"),
+                ("cluster.quarantine.enabled",
+                 "spark.sail.cluster.quarantine.enabled"),
+                ("cluster.quarantine.max_failures",
+                 "spark.sail.cluster.quarantine.maxFailures"),
+                ("cluster.quarantine.window_secs",
+                 "spark.sail.cluster.quarantine.windowSecs"),
+                ("cluster.quarantine.duration_secs",
+                 "spark.sail.cluster.quarantine.durationSecs"),
+                ("faults.spec", "spark.sail.faults.spec"),
+                ("faults.seed", "spark.sail.faults.seed")):
+            value = app.get(yaml_key)
+            if value is not None:
+                base[conf_key] = str(value)
         self._DEFAULTS = base
         self._conf = dict(conf)
 

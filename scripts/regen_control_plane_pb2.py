@@ -1,0 +1,93 @@
+"""Regenerate exec/proto/control_plane_pb2.py without protoc.
+
+The container image carries the protobuf runtime but not grpc_tools, so
+this script rebuilds the serialized FileDescriptorProto that the pb2
+module feeds to the descriptor pool: it loads the CURRENT pb2 blob,
+applies the schema deltas below, and rewrites the module. Keep the
+deltas in sync with control_plane.proto (the human-readable source of
+truth); a delta that is already present is skipped, so the script is
+idempotent.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from google.protobuf import descriptor_pb2
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PB2_PATH = os.path.join(HERE, os.pardir, "sail_tpu", "exec", "proto",
+                        "control_plane_pb2.py")
+
+F = descriptor_pb2.FieldDescriptorProto
+
+
+def _message(fdp, name):
+    for m in fdp.message_type:
+        if m.name == name:
+            return m
+    return None
+
+
+def _add_field(msg, name, number, ftype,
+               label=F.LABEL_OPTIONAL, type_name=""):
+    if any(f.name == name for f in msg.field):
+        return False
+    f = msg.field.add()
+    f.name = name
+    f.number = number
+    f.type = ftype
+    f.label = label
+    if type_name:
+        f.type_name = type_name
+    f.json_name = re.sub(r"_(.)", lambda m: m.group(1).upper(), name)
+    return True
+
+
+def _add_message(fdp, name):
+    if _message(fdp, name) is not None:
+        return _message(fdp, name), False
+    m = fdp.message_type.add()
+    m.name = name
+    return m, True
+
+
+def main():
+    with open(PB2_PATH, "r", encoding="utf-8") as f:
+        src = f.read()
+    m = re.search(r"AddSerializedFile\((b'(?:[^'\\]|\\.)*')\)", src)
+    if m is None:
+        sys.exit("cannot find serialized descriptor in pb2 module")
+    blob = eval(m.group(1))  # noqa: S307 — a bytes literal we just matched
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.MergeFromString(blob)
+
+    changed = False
+    stop = _message(fdp, "StopTaskRequest")
+    changed |= _add_field(stop, "reason", 4, F.TYPE_STRING)
+
+    cancel_req, fresh = _add_message(fdp, "CancelJobRequest")
+    if fresh:
+        _add_field(cancel_req, "job_id", 1, F.TYPE_STRING)
+        _add_field(cancel_req, "reason", 2, F.TYPE_STRING)
+        changed = True
+    cancel_resp, fresh = _add_message(fdp, "CancelJobResponse")
+    if fresh:
+        _add_field(cancel_resp, "canceled", 1, F.TYPE_BOOL)
+        changed = True
+
+    if not changed:
+        print("pb2 already up to date")
+        return
+    new_blob = fdp.SerializeToString()
+    src = src.replace(m.group(1), repr(new_blob))
+    with open(PB2_PATH, "w", encoding="utf-8") as f:
+        f.write(src)
+    print(f"rewrote {os.path.relpath(PB2_PATH)} "
+          f"({len(blob)} -> {len(new_blob)} descriptor bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,9 @@
 """Local-cluster mode: driver + workers in threads over REAL gRPC
 (mirrors the reference's local-cluster test vehicle, SURVEY.md §4)."""
 
+import threading
+import time
+
 import numpy as np
 import pandas as pd
 import pyarrow as pa
@@ -323,3 +326,261 @@ def test_task_metrics_merge_into_driver_profile(cluster):
     # the merged tasks render in the profile's text form
     text = prof.render()
     assert "stage 0 partition 0" in text
+
+
+# ---------------------------------------------------------------------------
+# Chaos suite: deterministic fault injection driving the hardened
+# retry/backoff/speculation/quarantine/cancellation machinery. Every
+# case asserts the faulted run returns results bit-identical to the
+# fault-free run (canonicalized by a full sort — partition merge order
+# is deterministic, but a total order makes "bit-identical" exact).
+# ---------------------------------------------------------------------------
+
+from sail_tpu import faults  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leakage():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _canon(table):
+    return table.sort_by([(c, "ascending") for c in table.column_names])
+
+
+def _chaos_plan(spark_rows=4000):
+    spark = SparkSession({})
+    rng = np.random.default_rng(21)
+    df = pd.DataFrame({"g": rng.integers(0, 8, spark_rows),
+                       "v": rng.integers(0, 1000, spark_rows)})
+    spark.createDataFrame(df).createOrReplaceTempView("chaos_t")
+    return _plan_for(
+        spark, "SELECT g, sum(v) AS s, count(*) AS c FROM chaos_t GROUP BY g")
+
+
+def _run_once(plan, nparts=4, timeout=90, **cluster_kw):
+    c = LocalCluster(num_workers=2, **cluster_kw)
+    try:
+        out = c.run_job(plan, num_partitions=nparts, timeout=timeout)
+        return out, c.last_job
+    finally:
+        c.stop()
+
+
+def test_chaos_worker_crash_bit_identical(monkeypatch):
+    """Kill one worker mid-stage (injected process death: no report, no
+    heartbeats): heartbeat eviction reschedules its tasks and re-runs
+    its lost stream outputs; the result matches the clean run."""
+    plan = _chaos_plan()
+    clean, _ = _run_once(plan)
+    monkeypatch.setenv("SAIL_CLUSTER__WORKER_HEARTBEAT_TIMEOUT_SECS", "2")
+    faults.configure("worker.task_exec:worker-1*=crash#1", seed=11)
+    out, job = _run_once(plan)
+    assert faults.injection_counts().get("worker.task_exec") == 1
+    assert _canon(out).equals(_canon(clean))
+
+
+def test_chaos_shuffle_fetch_drop_bit_identical():
+    """Drop one peer shuffle-channel fetch with a non-retryable error:
+    the consumer parks, the producer partition re-runs, and the job
+    completes with identical results."""
+    plan = _chaos_plan()
+    clean, _ = _run_once(plan)
+    # key glob *c[0-9]* matches only hash-channel fetches (cN, N >= 0) —
+    # not the driver's root merge fetch (c-1) or driver scan slices
+    faults.configure("shuffle.fetch:*c[0-9]*=error(not_found)#1", seed=12)
+    out, job = _run_once(plan)
+    assert faults.injection_counts().get("shuffle.fetch") == 1
+    assert job.retry_count >= 1
+    assert _canon(out).equals(_canon(clean))
+
+
+def test_chaos_straggler_speculation(monkeypatch):
+    """Slow one worker's task far beyond the stage median: once the
+    stage is >= 75% complete the driver launches a speculative twin on
+    the other worker, the twin wins, and the straggler's late result is
+    fenced out."""
+    plan = _chaos_plan()
+    clean, _ = _run_once(plan)
+    monkeypatch.setenv("SAIL_CLUSTER__SPECULATION__MIN_RUNTIME_MS", "300")
+    faults.configure("worker.task_exec:worker-1*=delay(6)#1", seed=13)
+    t0 = time.perf_counter()
+    out, job = _run_once(plan)
+    elapsed = time.perf_counter() - t0
+    assert job.spec_launched >= 1, "no speculative attempt launched"
+    assert job.spec_won >= 1, "the speculative twin should have won"
+    assert elapsed < 6.0, f"speculation did not mask the straggler " \
+                          f"({elapsed:.1f}s)"
+    assert _canon(out).equals(_canon(clean))
+
+
+def test_chaos_quarantine_after_repeated_failures(monkeypatch):
+    """Two reported task failures inside the sliding window blacklist
+    the worker; its tasks reschedule on the healthy worker and the
+    elastic pool starts a replacement."""
+    plan = _chaos_plan()
+    clean, _ = _run_once(plan)
+    monkeypatch.setenv("SAIL_CLUSTER__QUARANTINE__MAX_FAILURES", "2")
+    monkeypatch.setenv("SAIL_CLUSTER__QUARANTINE__WINDOW_SECS", "30")
+    faults.configure("worker.task_exec:worker-1*=error#2", seed=14)
+    c = LocalCluster(num_workers=2, elastic={"min": 2, "max": 3})
+    try:
+        out = c.run_job(plan, num_partitions=4, timeout=90)
+        assert "worker-1" in c.driver.quarantined
+        assert "worker-1" not in c.driver.workers
+        deadline = time.time() + 10
+        while len(c.driver.workers) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(c.driver.workers) >= 2, "elastic pool did not refill"
+    finally:
+        c.stop()
+    assert _canon(out).equals(_canon(clean))
+
+
+def test_chaos_report_retry_recovers_lost_status():
+    """A transient driver-unreachable blip while reporting task status
+    is retried with backoff instead of losing the result until
+    heartbeat eviction."""
+    plan = _chaos_plan()
+    clean, _ = _run_once(plan)
+    faults.configure("rpc.call:ReportTaskStatus=error#1", seed=15)
+    t0 = time.perf_counter()
+    out, _job = _run_once(plan)
+    elapsed = time.perf_counter() - t0
+    assert faults.injection_counts().get("rpc.call") == 1
+    # recovered by the retry, NOT by the 10s heartbeat eviction path
+    assert elapsed < 8.0
+    assert _canon(out).equals(_canon(clean))
+
+
+def test_chaos_timeout_cancels_worker_tasks():
+    """run_job timeout cancels the job on the driver: worker-side tasks
+    stop cooperatively and no partial shuffle output is leaked."""
+    plan = _chaos_plan()
+    faults.configure("worker.task_exec=delay(3)")
+    c = LocalCluster(num_workers=2)
+    try:
+        with pytest.raises(TimeoutError):
+            c.run_job(plan, num_partitions=2, timeout=1)
+        job = c.last_job
+        assert job.canceled
+        assert job.failed.startswith("canceled:")
+        # the tasks wake from the injected delay, observe the cancel,
+        # and publish nothing; job state is cleaned everywhere
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            leaked = [k for w in c.workers
+                      for k in w.streams._streams if k[0] == job.job_id]
+            busy = [k for w in c.workers for k in w._running]
+            if not leaked and not busy and job.job_id not in c.driver.jobs:
+                break
+            time.sleep(0.1)
+        assert not [k for w in c.workers
+                    for k in w.streams._streams if k[0] == job.job_id]
+        assert job.job_id not in c.driver.jobs
+    finally:
+        c.stop()
+        faults.reset()
+
+
+def test_chaos_client_abort_cancels_running_job():
+    """Client abort (LocalCluster.cancel_job / CancelJob RPC) fails the
+    waiting run_job promptly instead of letting it run to completion."""
+    plan = _chaos_plan()
+    faults.configure("worker.task_exec=delay(4)")
+    c = LocalCluster(num_workers=2)
+    try:
+        def abort():
+            time.sleep(0.5)
+            c.cancel_job(reason="client abort")
+        killer = threading.Thread(target=abort, daemon=True)
+        killer.start()
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="canceled: client abort"):
+            c.run_job(plan, num_partitions=2, timeout=60)
+        assert time.perf_counter() - t0 < 4.0
+        killer.join()
+    finally:
+        c.stop()
+        faults.reset()
+
+
+def test_chaos_launch_task_survives_flapping_dispatch():
+    """Injected dispatch failures walk the (bounded) dispatch loop:
+    the first worker is evicted, the retry lands elsewhere, and the job
+    still completes correctly."""
+    plan = _chaos_plan()
+    clean, _ = _run_once(plan)
+    # every RunTask dispatch retry attempt to the first worker fails:
+    # the driver's per-dispatch retry budget (2) exhausts, the worker is
+    # evicted, and the task redispatches to the survivor
+    faults.configure("rpc.call:RunTask=error#2", seed=16)
+    out, job = _run_once(plan)
+    assert _canon(out).equals(_canon(clean))
+
+
+def test_chaos_quarantined_worker_readmitted(monkeypatch):
+    """A quarantined worker keeps heartbeating; when the cool-off
+    expires the driver readmits it from the saved registration info —
+    eviction of a live worker is not permanent capacity loss."""
+    plan = _chaos_plan()
+    monkeypatch.setenv("SAIL_CLUSTER__QUARANTINE__MAX_FAILURES", "2")
+    monkeypatch.setenv("SAIL_CLUSTER__QUARANTINE__DURATION_SECS", "2")
+    faults.configure("worker.task_exec:worker-1*=error#2", seed=17)
+    c = LocalCluster(num_workers=2)
+    try:
+        c.run_job(plan, num_partitions=4, timeout=90)
+        deadline = time.time() + 10
+        while "worker-1" not in c.driver.workers and time.time() < deadline:
+            time.sleep(0.1)
+        assert "worker-1" in c.driver.workers, \
+            "worker not readmitted after quarantine cool-off"
+        assert "worker-1" not in c.driver.quarantined
+    finally:
+        c.stop()
+
+
+def test_chaos_dispatch_evicted_live_worker_readmitted():
+    """A live worker evicted for transient dispatch failures keeps
+    heartbeating and is readmitted — a blip must not halve a static
+    pool forever."""
+    plan = _chaos_plan()
+    faults.configure("rpc.call:RunTask=error#2", seed=18)
+    c = LocalCluster(num_workers=2)
+    try:
+        c.run_job(plan, num_partitions=4, timeout=90)
+        deadline = time.time() + 8
+        while len(c.driver.workers) < 2 and time.time() < deadline:
+            time.sleep(0.1)
+        assert len(c.driver.workers) == 2, \
+            "dispatch-evicted live worker was not readmitted"
+    finally:
+        c.stop()
+
+
+def test_chaos_quarantine_never_empties_the_pool(monkeypatch):
+    """A deterministically failing query strikes every worker; the pool
+    floor keeps the last worker un-quarantined so the next (healthy)
+    query still has capacity."""
+    monkeypatch.setenv("SAIL_CLUSTER__QUARANTINE__MAX_FAILURES", "2")
+    spark = SparkSession({})
+    df = pd.DataFrame({"g": np.arange(200) % 4, "v": np.arange(200)})
+    spark.createDataFrame(df).createOrReplaceTempView("pf_t")
+    plan = _plan_for(spark, "SELECT g, sum(v) AS s FROM pf_t GROUP BY g")
+    # every task execution fails -> the job dies on its own attempts,
+    # and both workers accumulate >= max_failures strikes
+    faults.configure("worker.task_exec=error")
+    c = LocalCluster(num_workers=2)
+    try:
+        with pytest.raises(RuntimeError):
+            c.run_job(plan, num_partitions=4, timeout=90)
+        assert len(c.driver.workers) >= 1, "pool blacked out by one bad job"
+        faults.reset()
+        out = c.run_job(plan, num_partitions=4, timeout=90).to_pandas()
+        exp = df.groupby("g", as_index=False).agg(s=("v", "sum"))
+        got = out.sort_values("g").reset_index(drop=True).astype("int64")
+        assert got.equals(exp.astype("int64"))
+    finally:
+        c.stop()

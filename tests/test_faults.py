@@ -1,0 +1,189 @@
+"""Deterministic fault-injection layer: rule parsing, per-seed
+determinism, site/key matching, limits, and the disabled fast path."""
+
+import time
+
+import pytest
+
+from sail_tpu import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_full_grammar():
+    seed, rules = faults.parse_spec(
+        "seed=42;shuffle.fetch=error@0.5#2;"
+        "worker.task_exec:worker-1*=delay(0.8);io.read=crash;"
+        "rpc.call:ReportTaskStatus=error(not_found)#1")
+    assert seed == 42
+    assert [r.site for r in rules] == [
+        "shuffle.fetch", "worker.task_exec", "io.read", "rpc.call"]
+    assert rules[0].prob == 0.5 and rules[0].limit == 2
+    assert rules[1].kind == "delay" and rules[1].arg == "0.8"
+    assert rules[1].key_glob == "worker-1*"
+    assert rules[2].kind == "crash"
+    assert rules[3].arg == "not_found" and rules[3].limit == 1
+
+
+def test_parse_spec_malformed_raises():
+    with pytest.raises(ValueError):
+        faults.parse_spec("shuffle.fetch=explode")
+    with pytest.raises(ValueError):
+        faults.parse_spec("=error")
+
+
+def test_empty_spec_disables():
+    faults.configure("")
+    assert not faults.is_active()
+    faults.inject("io.read", key="parquet")  # no-op, no raise
+
+
+# ---------------------------------------------------------------------------
+# injection semantics
+# ---------------------------------------------------------------------------
+
+def test_error_injection_and_limit():
+    faults.configure("io.read=error#2")
+    for _ in range(2):
+        with pytest.raises(faults.FaultInjectedError):
+            faults.inject("io.read", key="parquet")
+    # limit reached: the rule is spent
+    faults.inject("io.read", key="parquet")
+    assert faults.injection_counts() == {"io.read": 2}
+
+
+def test_error_code_not_found():
+    faults.configure("shuffle.fetch=error(not_found)")
+    with pytest.raises(faults.FaultInjectedError) as ei:
+        faults.inject("shuffle.fetch", key="addr/s1p0c2")
+    assert ei.value.code == "not_found"
+
+
+def test_site_and_key_matching():
+    faults.configure("worker.task_exec:worker-1*=error")
+    faults.inject("io.read", key="worker-1:s0p0")        # wrong site
+    faults.inject("worker.task_exec", key="worker-0:s0p0")  # wrong key
+    with pytest.raises(faults.FaultInjectedError):
+        faults.inject("worker.task_exec", key="worker-1:s2p3")
+    assert faults.injection_counts() == {"worker.task_exec": 1}
+
+
+def test_delay_injection_sleeps():
+    faults.configure("io.read=delay(0.05)#1")
+    t0 = time.perf_counter()
+    faults.inject("io.read", key="csv")
+    assert time.perf_counter() - t0 >= 0.045
+    # limit spent: no further sleeping
+    t0 = time.perf_counter()
+    faults.inject("io.read", key="csv")
+    assert time.perf_counter() - t0 < 0.04
+
+
+def test_worker_crash_is_fault_subclass():
+    faults.configure("worker.task_exec=crash#1")
+    with pytest.raises(faults.WorkerCrash):
+        faults.inject("worker.task_exec", key="worker-0:s0p0")
+    assert issubclass(faults.WorkerCrash, faults.FaultInjectedError)
+
+
+def test_injections_counted_in_registry():
+    from sail_tpu.metrics import REGISTRY
+    faults.configure("io.read=error#1")
+    with pytest.raises(faults.FaultInjectedError):
+        faults.inject("io.read", key="parquet")
+    rows = {(r["name"], r["attributes"]): r["value"]
+            for r in REGISTRY.snapshot()}
+    hit = [v for (name, attrs), v in rows.items()
+           if name == "faults.injected_count" and "io.read" in attrs]
+    assert hit and hit[0] >= 1
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+def _decision_sequence(seed, n=64, interleave=False):
+    faults.configure("shuffle.fetch=error@0.4", seed=seed)
+    out = []
+    for i in range(n):
+        if interleave:
+            # draws at OTHER sites must not perturb this site's stream
+            try:
+                faults.inject("io.read", key=f"x{i}")
+            except faults.FaultInjectedError:
+                pass
+        try:
+            faults.inject("shuffle.fetch", key=f"k{i}")
+            out.append(0)
+        except faults.FaultInjectedError:
+            out.append(1)
+    faults.reset()
+    return out
+
+
+def test_same_seed_same_decisions():
+    assert _decision_sequence(7) == _decision_sequence(7)
+    assert _decision_sequence(1234) == _decision_sequence(1234)
+
+
+def test_different_seeds_differ():
+    seqs = {tuple(_decision_sequence(s)) for s in range(6)}
+    assert len(seqs) > 1
+
+
+def test_per_site_streams_independent_of_interleaving():
+    assert _decision_sequence(9) == _decision_sequence(9, interleave=True)
+
+
+def test_probability_roughly_respected():
+    faults.configure("shuffle.fetch=error@0.5", seed=3)
+    fired = 0
+    for i in range(400):
+        try:
+            faults.inject("shuffle.fetch", key=f"k{i}")
+        except faults.FaultInjectedError:
+            fired += 1
+    assert 120 <= fired <= 280  # ~200 expected; generous determinism band
+
+
+# ---------------------------------------------------------------------------
+# env/config loading + the disabled fast path
+# ---------------------------------------------------------------------------
+
+def test_reload_from_env(monkeypatch):
+    monkeypatch.setenv("SAIL_FAULTS", "seed=5;io.read=error#1")
+    faults.reload()
+    assert faults.is_active()
+    with pytest.raises(faults.FaultInjectedError):
+        faults.inject("io.read", key="parquet")
+    monkeypatch.delenv("SAIL_FAULTS")
+    faults.reload()
+    assert not faults.is_active()
+
+
+def test_reload_keeps_explicit_configuration(monkeypatch):
+    monkeypatch.delenv("SAIL_FAULTS", raising=False)
+    faults.configure("io.read=error#1", seed=1)
+    faults.reload()  # what LocalCluster.__init__ does
+    assert faults.is_active()
+
+
+def test_disabled_is_noop_fast_path():
+    """With no spec configured the layer holds no state and inject() is
+    a constant-time no-op — cheap enough for the hottest call sites."""
+    faults.reset()
+    assert faults._STATE is None
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        faults.inject("shuffle.fetch", key="addr/s0p0c0")
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 1.0, f"disabled inject too slow: {elapsed:.3f}s"

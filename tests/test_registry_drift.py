@@ -67,3 +67,25 @@ def test_registry_loads_and_names_are_unique():
     assert len(names) == len(set(names))
     for e in entries:
         assert e.get("type") in ("counter", "gauge"), e
+
+
+def test_fault_tolerance_counters_declared():
+    """The hardened-cluster instruments exist with the exact attribute
+    sets the call sites use (cluster retries, speculation, quarantine,
+    RPC backoff, fault injection)."""
+    with open(REGISTRY_PATH, "r", encoding="utf-8") as f:
+        entries = yaml.safe_load(f) or []
+    by_name = {e["name"]: e for e in entries}
+    expected = {
+        "cluster.task.retry_count": ["reason"],
+        "cluster.task.speculative_launched": [],
+        "cluster.task.speculative_won": [],
+        "cluster.worker.quarantined_count": [],
+        "rpc.retry_count": ["method"],
+        "faults.injected_count": ["site", "kind"],
+    }
+    for name, attrs in expected.items():
+        assert name in by_name, f"{name} missing from the registry"
+        e = by_name[name]
+        assert e.get("type") == "counter", name
+        assert list(e.get("attributes") or []) == attrs, name
