@@ -1,0 +1,23 @@
+"""Static analysis: plan-invariant validation + repo-wide drift lints.
+
+Two pillars (reference role: DataFusion's plan sanity/invariant checker
+that keeps a multi-pass optimizer honest, and the registry-drift test
+pattern generalized to every declared-vs-used surface in the repo):
+
+- :mod:`.invariants` — ``validate_plan`` walks a resolved plan tree and
+  checks structural well-formedness (BoundRef ranges, schema agreement,
+  join-key dtypes, runtime-filter edge liveness); the optimizer runs it
+  after resolve and after every pass, and ``validate_job_graph`` mirrors
+  a lighter stage-boundary check before distributed tasks ship.
+- :mod:`.lints` — AST/text lints over the repo itself (config-key
+  drift, fault-site drift, proto freshness, host-sync allowlisting,
+  lock discipline, metrics-registry drift), run by
+  ``scripts/sail_lint.py`` and as tier-1 tests.
+"""
+
+from .invariants import (  # noqa: F401
+    PlanInvariantError,
+    validate_job_graph,
+    validate_plan,
+    validation_mode,
+)

@@ -1,0 +1,562 @@
+"""Plan-invariant validator.
+
+``validate_plan`` walks a resolved plan tree (``plan/nodes.py``) and
+checks, per node, the structural invariants every optimizer pass must
+preserve:
+
+- output-schema arity/dtype consistency with child schemas;
+- every ``BoundRef`` index in range of the child schema (and its
+  recorded dtype in the same type family as the child field);
+- join-key arity and dtype agreement on both sides;
+- ``RuntimeFilterTarget`` edges pointing at live ``ScanExec`` leaves in
+  the named subtree, with in-range key/column ordinals (and no orphan
+  scan-side edges whose join vanished);
+- scan ``predicates``/``runtime_predicates`` conjuncts referencing real
+  (projected) columns;
+- no duplicate/dangling scan projection names after ``prune_columns``
+  remapping.
+
+A violation raises :class:`PlanInvariantError` naming the offending
+pass (``after``), node type, and invariant id — a bad remap surfaces at
+the pass that introduced it instead of as a wrong answer or an opaque
+jit shape error deep in ``exec/local.py``.
+
+``validate_job_graph`` mirrors a lighter stage-boundary check for
+``exec/job_graph.py``: shuffle channel counts and stage input schemas
+must agree before tasks ship.
+
+Gated by ``analysis.validate_plans`` (surfaced as
+``spark.sail.analysis.validatePlans``): ``off`` disables, ``full``
+validates after every pass, the default (``true``/``auto``) validates
+after every pass under pytest and once — after the final pass — in
+production, so steady-state queries pay one cheap walk.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..spec import data_type as dt
+
+_PN = None
+_RX = None
+
+
+def _mods():
+    """plan.nodes / plan.rex, imported lazily (plan/ imports us)."""
+    global _PN, _RX
+    if _PN is None:
+        from ..plan import nodes as pn
+        from ..plan import rex as rx
+        _PN, _RX = pn, rx
+    return _PN, _RX
+
+
+class PlanInvariantError(RuntimeError):
+    """A plan failed structural validation.
+
+    ``invariant`` is a stable short id (e.g. ``boundref.range``),
+    ``after`` names the pass whose output was being checked, and
+    ``node_type`` the offending plan node class."""
+
+    def __init__(self, invariant: str, message: str, *, node=None,
+                 after: str = ""):
+        self.invariant = invariant
+        self.after = after
+        self.node_type = type(node).__name__ if node is not None else ""
+        where = f" [after {after}]" if after else ""
+        at = f" at {self.node_type}" if self.node_type else ""
+        super().__init__(f"{invariant}{where}{at}: {message}")
+
+
+# ---------------------------------------------------------------------------
+# gating
+# ---------------------------------------------------------------------------
+
+VALIDATE_OFF = "off"        # never validate
+VALIDATE_FINAL = "final"    # one walk after the last optimizer pass
+VALIDATE_FULL = "full"      # after resolve and after every pass
+
+
+def validation_mode(override: Optional[str] = None) -> str:
+    """Resolve the validation mode from ``analysis.validate_plans``
+    (or the session-conf ``override`` string when given). Default
+    ``true``/``auto`` → every pass under pytest, final-only otherwise."""
+    value = override
+    if value is None:
+        from ..config import get as config_get
+        value = config_get("analysis.validate_plans", "auto")
+    value = str(value).strip().lower()
+    if value in ("0", "false", "no", "off"):
+        return VALIDATE_OFF
+    if value == "full":
+        return VALIDATE_FULL
+    if value == "final":
+        return VALIDATE_FINAL
+    # PYTEST_CURRENT_TEST is set only while a test runs — checking
+    # sys.modules for pytest would escalate any process that merely
+    # imports it (dev tooling, embedded runners) to full validation
+    under_pytest = "PYTEST_CURRENT_TEST" in os.environ
+    return VALIDATE_FULL if under_pytest else VALIDATE_FINAL
+
+
+# ---------------------------------------------------------------------------
+# dtype families — the agreement granularity for join keys / unions.
+# Exact dtype equality is too strict for plans the resolver legitimately
+# produces (decimal precisions differ across branches; int widths mix
+# under literal folding), but family drift (int key joined to a string
+# key after a bad remap) is always a bug.
+# ---------------------------------------------------------------------------
+
+def _family(d: dt.DataType) -> str:
+    if isinstance(d, dt.NullType):
+        return "null"
+    if isinstance(d, dt.BooleanType):
+        return "bool"
+    if isinstance(d, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                      dt.LongType)):
+        return "int"
+    if isinstance(d, (dt.FloatType, dt.DoubleType)):
+        return "float"
+    if isinstance(d, dt.DecimalType):
+        return "decimal"
+    if isinstance(d, dt.StringType):
+        return "string"
+    if isinstance(d, dt.BinaryType):
+        return "binary"
+    if isinstance(d, dt.DateType):
+        return "date"
+    if isinstance(d, dt.TimestampType):
+        return "timestamp"
+    if isinstance(d, dt.TimeType):
+        return "time"
+    if isinstance(d, (dt.DayTimeIntervalType, dt.YearMonthIntervalType,
+                      dt.CalendarIntervalType)):
+        return "interval"
+    return "nested"  # struct / array / map / variant / udt
+
+
+def _compatible(a: dt.DataType, b: dt.DataType) -> bool:
+    fa, fb = _family(a), _family(b)
+    return fa == fb or "null" in (fa, fb)
+
+
+# ---------------------------------------------------------------------------
+# expression checks
+# ---------------------------------------------------------------------------
+
+def _check_rex(r, arity: int, schema, *, after: str, node,
+               invariant: str = "boundref.range",
+               validate_subplans: bool = True) -> None:
+    """Every BoundRef under ``r`` must index into ``schema`` (length
+    ``arity``) and agree with the bound field's type family; embedded
+    scalar-subquery plans validate recursively."""
+    pn, rx = _mods()
+    for sub in rx.walk(r):
+        if isinstance(sub, rx.BoundRef):
+            if not (0 <= sub.index < arity):
+                raise PlanInvariantError(
+                    invariant,
+                    f"BoundRef #{sub.index} ({sub.name!r}) out of range "
+                    f"of a {arity}-column child schema",
+                    node=node, after=after)
+            if schema is not None and \
+                    not _compatible(sub.dtype, schema[sub.index].dtype):
+                raise PlanInvariantError(
+                    "boundref.dtype",
+                    f"BoundRef #{sub.index} ({sub.name!r}) recorded as "
+                    f"{sub.dtype.simple_string()} but the child column is "
+                    f"{schema[sub.index].dtype.simple_string()}",
+                    node=node, after=after)
+        elif isinstance(sub, rx.RScalarSubquery) and validate_subplans:
+            if sub.plan is not None:
+                validate_plan(sub.plan, after=after)
+
+
+# ---------------------------------------------------------------------------
+# node checks
+# ---------------------------------------------------------------------------
+
+def _child_schema(child, *, after: str, node):
+    try:
+        return tuple(child.schema)
+    except Exception as e:  # noqa: BLE001 — a broken child schema IS the finding
+        raise PlanInvariantError(
+            "schema.computable",
+            f"child {type(child).__name__} schema raises "
+            f"{type(e).__name__}: {e}", node=node, after=after)
+
+
+def _check_scan(p, *, after: str) -> None:
+    pn, rx = _mods()
+    names = [f.name for f in p.out_schema]
+    if p.projection is not None:
+        seen: Set[str] = set()
+        for n in p.projection:
+            if n not in names:
+                raise PlanInvariantError(
+                    "scan.projection",
+                    f"projected column {n!r} is not in the scan's base "
+                    f"schema {names}", node=p, after=after)
+            if n in seen:
+                raise PlanInvariantError(
+                    "scan.duplicate_names",
+                    f"duplicate projected column {n!r}", node=p,
+                    after=after)
+            seen.add(n)
+    schema = tuple(p.schema)
+    for which, preds in (("scan.predicates", p.predicates),
+                        ("scan.runtime_predicates", p.runtime_predicates)):
+        for c in preds:
+            _check_rex(c, len(schema), schema, after=after, node=p,
+                       invariant=which)
+    for t in p.runtime_filters:
+        if not (0 <= t.column < len(schema)):
+            raise PlanInvariantError(
+                "rtf.column",
+                f"runtime-filter edge rf{t.fid} targets column "
+                f"#{t.column} of a {len(schema)}-column scan",
+                node=p, after=after)
+        if schema[t.column].name != t.name:
+            raise PlanInvariantError(
+                "rtf.column",
+                f"runtime-filter edge rf{t.fid} names column {t.name!r} "
+                f"but scan column #{t.column} is "
+                f"{schema[t.column].name!r}", node=p, after=after)
+
+
+def _check_join(p, *, after: str) -> None:
+    pn, rx = _mods()
+    if p.join_type not in ("inner", "left", "right", "full", "semi",
+                           "anti", "cross"):
+        raise PlanInvariantError(
+            "join.type", f"unknown join type {p.join_type!r}", node=p,
+            after=after)
+    left_schema = _child_schema(p.left, after=after, node=p)
+    right_schema = _child_schema(p.right, after=after, node=p)
+    if len(p.left_keys) != len(p.right_keys):
+        raise PlanInvariantError(
+            "join.keys_arity",
+            f"{len(p.left_keys)} left keys vs {len(p.right_keys)} right "
+            f"keys", node=p, after=after)
+    for k in p.left_keys:
+        _check_rex(k, len(left_schema), left_schema, after=after, node=p)
+    for k in p.right_keys:
+        _check_rex(k, len(right_schema), right_schema, after=after,
+                   node=p)
+    for lk, rk in zip(p.left_keys, p.right_keys):
+        lt, rt = rx.rex_type(lk), rx.rex_type(rk)
+        if not _compatible(lt, rt):
+            raise PlanInvariantError(
+                "join.key_dtype",
+                f"join key dtypes disagree: {lt.simple_string()} vs "
+                f"{rt.simple_string()}", node=p, after=after)
+    if p.residual is not None:
+        combined = left_schema + right_schema
+        _check_rex(p.residual, len(combined), combined, after=after,
+                   node=p)
+    for t in p.runtime_filters:
+        if t.side not in ("probe", "build"):
+            raise PlanInvariantError(
+                "rtf.side",
+                f"runtime-filter edge rf{t.fid} has side {t.side!r} "
+                f"(expected probe|build)", node=p, after=after)
+        if not (0 <= t.key < len(p.left_keys)):
+            raise PlanInvariantError(
+                "rtf.key",
+                f"runtime-filter edge rf{t.fid} names key ordinal "
+                f"#{t.key} of a {len(p.left_keys)}-key join", node=p,
+                after=after)
+        subtree = p.left if t.side == "probe" else p.right
+        scan = _scan_with_fid(subtree, t.fid)
+        if scan is None:
+            raise PlanInvariantError(
+                "rtf.dangling",
+                f"runtime-filter edge rf{t.fid} ({t.side}:{t.name}) has "
+                f"no live ScanExec target in the {t.side} subtree",
+                node=p, after=after)
+
+
+def _scan_with_fid(p, fid: int):
+    pn, _rx = _mods()
+    for node in pn.walk_plan(p):
+        if isinstance(node, pn.ScanExec) and \
+                any(t.fid == fid for t in node.runtime_filters):
+            return node
+    return None
+
+
+def _check_aggregate(p, *, after: str) -> None:
+    in_schema = _child_schema(p.input, after=after, node=p)
+    arity = len(in_schema)
+    if len(p.out_names) != len(p.group_indices) + len(p.aggs):
+        raise PlanInvariantError(
+            "agg.out_names",
+            f"{len(p.out_names)} output names for "
+            f"{len(p.group_indices)} groups + {len(p.aggs)} aggregates",
+            node=p, after=after)
+    for gi in p.group_indices:
+        if not (0 <= gi < arity):
+            raise PlanInvariantError(
+                "agg.group_range",
+                f"group index #{gi} out of range of a {arity}-column "
+                f"input", node=p, after=after)
+    for a in p.aggs:
+        if a.arg is not None and not (0 <= a.arg < arity):
+            raise PlanInvariantError(
+                "agg.arg_range",
+                f"{a.fn} argument #{a.arg} out of range of a "
+                f"{arity}-column input", node=p, after=after)
+        if a.filter is not None:
+            _check_rex(a.filter, arity, in_schema, after=after, node=p)
+
+
+def _check_union(p, *, after: str) -> None:
+    if not p.inputs:
+        raise PlanInvariantError("union.arity", "UNION of zero inputs",
+                                 node=p, after=after)
+    first = _child_schema(p.inputs[0], after=after, node=p)
+    for child in p.inputs[1:]:
+        s = _child_schema(child, after=after, node=p)
+        if len(s) != len(first):
+            raise PlanInvariantError(
+                "union.arity",
+                f"UNION branches disagree on arity: {len(first)} vs "
+                f"{len(s)}", node=p, after=after)
+        for i, (fa, fb) in enumerate(zip(first, s)):
+            if not _compatible(fa.dtype, fb.dtype):
+                raise PlanInvariantError(
+                    "union.dtype",
+                    f"UNION column #{i} dtypes disagree: "
+                    f"{fa.dtype.simple_string()} vs "
+                    f"{fb.dtype.simple_string()}", node=p, after=after)
+
+
+def _check_window(p, *, after: str) -> None:
+    in_schema = _child_schema(p.input, after=after, node=p)
+    arity = len(in_schema)
+    if len(p.out_names) != len(p.windows):
+        raise PlanInvariantError(
+            "window.out_names",
+            f"{len(p.out_names)} output names for {len(p.windows)} "
+            f"window functions", node=p, after=after)
+    for w in p.windows:
+        if w.arg is not None and not (0 <= w.arg < arity):
+            raise PlanInvariantError(
+                "window.arg_range",
+                f"{w.function} argument #{w.arg} out of range of a "
+                f"{arity}-column input", node=p, after=after)
+        for pi in w.partition_indices:
+            if not (0 <= pi < arity):
+                raise PlanInvariantError(
+                    "window.partition_range",
+                    f"partition index #{pi} out of range", node=p,
+                    after=after)
+        for k in w.order_keys:
+            _check_rex(k.expr, arity, in_schema, after=after, node=p)
+
+
+def _validate_node(p, *, after: str) -> None:
+    pn, rx = _mods()
+    if isinstance(p, pn.ScanExec):
+        _check_scan(p, after=after)
+        return
+    if isinstance(p, pn.JoinExec):
+        _check_join(p, after=after)
+        return
+    if isinstance(p, pn.AggregateExec):
+        _check_aggregate(p, after=after)
+        return
+    if isinstance(p, pn.UnionExec):
+        _check_union(p, after=after)
+        return
+    if isinstance(p, pn.WindowExec):
+        _check_window(p, after=after)
+        return
+    if isinstance(p, pn.ProjectExec):
+        in_schema = _child_schema(p.input, after=after, node=p)
+        for _n, e in p.exprs:
+            _check_rex(e, len(in_schema), in_schema, after=after, node=p)
+        return
+    if isinstance(p, pn.FilterExec):
+        in_schema = _child_schema(p.input, after=after, node=p)
+        if p.condition is None:
+            raise PlanInvariantError("filter.condition",
+                                     "Filter without a condition",
+                                     node=p, after=after)
+        _check_rex(p.condition, len(in_schema), in_schema, after=after,
+                   node=p)
+        if _family(rx.rex_type(p.condition)) not in ("bool", "null"):
+            raise PlanInvariantError(
+                "filter.dtype",
+                f"filter condition has dtype "
+                f"{rx.rex_type(p.condition).simple_string()}, expected "
+                f"boolean", node=p, after=after)
+        return
+    if isinstance(p, pn.SortExec):
+        in_schema = _child_schema(p.input, after=after, node=p)
+        for k in p.keys:
+            _check_rex(k.expr, len(in_schema), in_schema, after=after,
+                       node=p)
+        return
+    if isinstance(p, pn.LimitExec):
+        if p.limit is not None and p.limit < 0:
+            raise PlanInvariantError("limit.negative",
+                                     f"negative limit {p.limit}",
+                                     node=p, after=after)
+        if p.offset < 0:
+            raise PlanInvariantError("limit.negative",
+                                     f"negative offset {p.offset}",
+                                     node=p, after=after)
+        return
+    if isinstance(p, pn.GenerateExec):
+        in_schema = _child_schema(p.input, after=after, node=p)
+        for r in p.args:
+            _check_rex(r, len(in_schema), in_schema, after=after, node=p)
+        for _n, r in p.passthrough:
+            _check_rex(r, len(in_schema), in_schema, after=after, node=p)
+        return
+    if isinstance(p, pn.GroupMapExec):
+        in_schema = _child_schema(p.input, after=after, node=p)
+        for ki in p.key_indices:
+            if not (0 <= ki < len(in_schema)):
+                raise PlanInvariantError(
+                    "groupmap.key_range",
+                    f"key index #{ki} out of range", node=p, after=after)
+        return
+    if isinstance(p, pn.CoGroupMapExec):
+        ls = _child_schema(p.left, after=after, node=p)
+        rs = _child_schema(p.right, after=after, node=p)
+        for ki in p.left_keys:
+            if not (0 <= ki < len(ls)):
+                raise PlanInvariantError(
+                    "groupmap.key_range",
+                    f"left key index #{ki} out of range", node=p,
+                    after=after)
+        for ki in p.right_keys:
+            if not (0 <= ki < len(rs)):
+                raise PlanInvariantError(
+                    "groupmap.key_range",
+                    f"right key index #{ki} out of range", node=p,
+                    after=after)
+        return
+    # OneRow/Values/Range/Udtf/MapPartitions/StageInputExec…: leaf or
+    # schema-opaque nodes with nothing positional to get wrong
+
+
+def validate_plan(plan, *, after: str = "resolve") -> None:
+    """Validate every node of ``plan`` (recursing into scalar-subquery
+    plans). Raises :class:`PlanInvariantError` on the first violation;
+    returns None when the plan is well-formed."""
+    pn, rx = _mods()
+    join_fids: Set[int] = set()
+    scan_edges: List = []
+    for node in pn.walk_plan(plan):
+        _validate_node(node, after=after)
+        if isinstance(node, pn.JoinExec):
+            join_fids.update(t.fid for t in node.runtime_filters)
+        elif isinstance(node, pn.ScanExec):
+            scan_edges.extend((node, t) for t in node.runtime_filters)
+    for scan, t in scan_edges:
+        if t.fid not in join_fids:
+            raise PlanInvariantError(
+                "rtf.orphan",
+                f"scan edge rf{t.fid} ({t.name}) has no JoinExec "
+                f"carrying the same filter id", node=scan, after=after)
+
+
+# ---------------------------------------------------------------------------
+# stage-boundary validation (exec/job_graph.py)
+# ---------------------------------------------------------------------------
+
+def validate_job_graph(graph) -> None:
+    """Lighter distributed-boundary check run by ``split_job`` before
+    tasks ship: stage input schemas must agree with their producer's
+    output schema, shuffle channel counts with the consumer's partition
+    count, and shuffle keys must be in range of the producer schema."""
+    pn, _rx = _mods()
+    from ..exec.job_graph import InputMode, StageInputExec
+
+    stages_by_id: Dict[int, object] = {}
+    for stage in graph.stages:
+        if stage.stage_id in stages_by_id:
+            raise PlanInvariantError(
+                "stage.duplicate_id",
+                f"duplicate stage id {stage.stage_id}",
+                after="split_job")
+        stages_by_id[stage.stage_id] = stage
+    for stage in graph.stages:
+        input_modes = {i.stage_id: i.mode for i in stage.inputs}
+        for sid in input_modes:
+            if sid not in stages_by_id:
+                raise PlanInvariantError(
+                    "stage.unknown_input",
+                    f"stage {stage.stage_id} consumes unknown stage "
+                    f"{sid}", after="split_job")
+            if sid >= stage.stage_id:
+                raise PlanInvariantError(
+                    "stage.cycle",
+                    f"stage {stage.stage_id} consumes a later/equal "
+                    f"stage {sid}", after="split_job")
+        for node in pn.walk_plan(stage.plan):
+            if not isinstance(node, StageInputExec):
+                continue
+            producer = stages_by_id.get(node.stage_id)
+            if producer is None or node.stage_id not in input_modes:
+                raise PlanInvariantError(
+                    "stage.unknown_input",
+                    f"stage {stage.stage_id} plan reads stage "
+                    f"{node.stage_id} which is not among its declared "
+                    f"inputs", after="split_job")
+            prod_schema = _child_schema(producer.plan, after="split_job",
+                                        node=node)
+            leaf_schema = tuple(node.out_schema)
+            if len(leaf_schema) != len(prod_schema):
+                raise PlanInvariantError(
+                    "stage.input_schema",
+                    f"stage {stage.stage_id} expects "
+                    f"{len(leaf_schema)} columns from stage "
+                    f"{node.stage_id} which produces "
+                    f"{len(prod_schema)}", after="split_job")
+            for i, (fa, fb) in enumerate(zip(leaf_schema, prod_schema)):
+                if not _compatible(fa.dtype, fb.dtype):
+                    raise PlanInvariantError(
+                        "stage.input_schema",
+                        f"stage {stage.stage_id} input column #{i} "
+                        f"({fa.name}) is {fa.dtype.simple_string()} but "
+                        f"stage {node.stage_id} produces "
+                        f"{fb.dtype.simple_string()}", after="split_job")
+            mode = input_modes[node.stage_id]
+            if mode == InputMode.SHUFFLE:
+                if producer.shuffle_keys is None:
+                    raise PlanInvariantError(
+                        "stage.channels",
+                        f"stage {stage.stage_id} consumes stage "
+                        f"{node.stage_id} over SHUFFLE but the producer "
+                        f"declares no shuffle keys", after="split_job")
+                if producer.num_channels < stage.num_partitions:
+                    raise PlanInvariantError(
+                        "stage.channels",
+                        f"stage {stage.stage_id} runs "
+                        f"{stage.num_partitions} tasks but producer "
+                        f"stage {node.stage_id} routes only "
+                        f"{producer.num_channels} channels",
+                        after="split_job")
+            elif mode == InputMode.BROADCAST:
+                if producer.num_partitions != 1:
+                    raise PlanInvariantError(
+                        "stage.channels",
+                        f"BROADCAST producer stage {node.stage_id} has "
+                        f"{producer.num_partitions} partitions "
+                        f"(expected 1)", after="split_job")
+        if stage.shuffle_keys is not None:
+            arity = len(_child_schema(stage.plan, after="split_job",
+                                      node=stage.plan))
+            for k in stage.shuffle_keys:
+                if not (0 <= k < arity):
+                    raise PlanInvariantError(
+                        "stage.shuffle_keys",
+                        f"stage {stage.stage_id} shuffle key #{k} out "
+                        f"of range of its {arity}-column output",
+                        after="split_job")

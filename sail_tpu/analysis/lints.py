@@ -1,0 +1,832 @@
+"""Repo-wide AST drift lints.
+
+Every declared-vs-used surface in the repo is checked both ways, so
+declarations cannot drift from the code (the pattern
+``tests/test_registry_drift.py`` proved out for metrics, generalized):
+
+==================  ======================================================
+lint id             checks
+==================  ======================================================
+``config-keys``     every app-config key read in code is declared in
+                    ``config/application.yaml`` — and every declared key
+                    is read somewhere (or allowlisted as dynamic)
+``spark-keys``      every ``spark.sail.*`` session-conf literal in code
+                    is documented in ``application.yaml`` (exact or via
+                    a ``prefix.`` mention)
+``fault-sites``     every ``faults.inject(site)`` literal is documented
+                    in the README site table, and vice versa
+``proto``           every message/field name in ``*.proto`` exists in
+                    the checked-in regenerated ``*_pb2.py``
+``sync-points``     ``device_get``/``block_until_ready`` call sites in
+                    ``exec/``/``ops/`` are on the reviewed allowlist
+``locks``           ``exec/cluster.py`` registry discipline: WorkerActor
+                    ``_running`` only touched under ``_running_lock``;
+                    DriverActor worker registries only mutated on the
+                    actor thread (no nested-def/gRPC-handler mutation)
+``metrics``         every recorded metric is declared with the recorded
+                    attribute keys, every declaration is exercised
+==================  ======================================================
+
+Run via ``scripts/sail_lint.py`` (``--fix-allowlist`` prints allowlist
+stubs for new violations) or as tier-1 tests (``tests/test_lints.py``).
+All lints operate on a :class:`LintContext` rooted anywhere, so tests
+can seed a known drift into a tmp copy and assert the lint catches it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from . import allowlists
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir, os.pardir))
+
+
+@dataclass(frozen=True)
+class Violation:
+    lint: str
+    path: str        # relative to the lint root
+    line: int
+    message: str
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}" if self.line else self.path
+        return f"[{self.lint}] {where}: {self.message}"
+
+
+class LintContext:
+    """A source tree to lint: ``root`` contains ``sail_tpu/``,
+    ``README.md`` … Files parse lazily and cache per context."""
+
+    def __init__(self, root: str = REPO_ROOT):
+        self.root = os.path.abspath(root)
+        self.src_root = os.path.join(self.root, "sail_tpu")
+        self._text: Dict[str, Optional[str]] = {}
+        self._ast: Dict[str, Optional[ast.AST]] = {}
+
+    def rel(self, path: str) -> str:
+        return os.path.relpath(path, self.root)
+
+    def text(self, relpath: str) -> Optional[str]:
+        if relpath not in self._text:
+            path = os.path.join(self.root, relpath)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    self._text[relpath] = f.read()
+            except OSError:
+                self._text[relpath] = None
+        return self._text[relpath]
+
+    def tree(self, relpath: str) -> Optional[ast.AST]:
+        if relpath not in self._ast:
+            src = self.text(relpath)
+            try:
+                self._ast[relpath] = None if src is None \
+                    else ast.parse(src, filename=relpath)
+            except SyntaxError:
+                self._ast[relpath] = None
+        return self._ast[relpath]
+
+    def python_sources(self, *subdirs: str) -> Iterable[str]:
+        """Repo-relative paths of .py files under sail_tpu/<subdir>…"""
+        roots = [os.path.join(self.src_root, d) for d in subdirs] \
+            if subdirs else [self.src_root]
+        for r in roots:
+            for dirpath, dirnames, filenames in os.walk(r):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield self.rel(os.path.join(dirpath, fn))
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+def _fold_str(node: ast.AST) -> Optional[str]:
+    """Constant-fold a string expression: literals and ``"a" + "b"``
+    concatenations (how prefixed config keys are built)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        a, b = _fold_str(node.left), _fold_str(node.right)
+        if a is not None and b is not None:
+            return a + b
+    return None
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _string_constants(tree: ast.AST) -> Iterable[Tuple[str, int]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value, node.lineno
+
+
+# ---------------------------------------------------------------------------
+# config-key drift
+# ---------------------------------------------------------------------------
+
+#: functions whose first argument is an app-config key. ``_num``/``_on``
+#: are the DriverActor's local wrappers; ``app.get`` is the flattened
+#: dict in SessionConf layering.
+_APP_KEY_ACCESSORS = {"config_get", "truthy", "_num", "_on"}
+_APP_KEY_DICTS = {"app"}
+
+_KEY_RE = re.compile(r"^[a-z0-9_]+(?:\.[a-z0-9_]+)+$")
+
+
+def _flatten_yaml(tree: dict, prefix: str = "") -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for k, v in (tree or {}).items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten_yaml(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def declared_config_keys(ctx: LintContext) -> Set[str]:
+    import yaml
+    src = ctx.text("sail_tpu/config/application.yaml")
+    if src is None:
+        return set()
+    return set(_flatten_yaml(yaml.safe_load(src) or {}))
+
+
+def read_config_keys(ctx: LintContext) -> Dict[str, List[Tuple[str, int]]]:
+    """App-config keys read through a known accessor, with call sites."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for relpath in ctx.python_sources():
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = _call_name(node)
+            is_accessor = name in _APP_KEY_ACCESSORS or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in _APP_KEY_DICTS)
+            if not is_accessor:
+                continue
+            key = _fold_str(node.args[0])
+            if key is None or key.startswith("spark.") \
+                    or not _KEY_RE.match(key):
+                continue
+            out.setdefault(key, []).append((relpath, node.lineno))
+    return out
+
+
+def _config_literal_evidence(ctx: LintContext) -> Set[str]:
+    """Every constant-foldable dotted string (incl. prefixes built by
+    concatenation) — the loose 'is this key mentioned at all' evidence
+    for the declared→used direction."""
+    seen: Set[str] = set()
+    for relpath in ctx.python_sources():
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            s = _fold_str(node) if isinstance(node, (ast.Constant,
+                                                     ast.BinOp)) else None
+            if s:
+                seen.add(s)
+    return seen
+
+
+def lint_config_keys(ctx: LintContext) -> List[Violation]:
+    declared = declared_config_keys(ctx)
+    if not declared:
+        return [Violation("config-keys",
+                          "sail_tpu/config/application.yaml", 0,
+                          "application.yaml missing or empty")]
+    out: List[Violation] = []
+    reads = read_config_keys(ctx)
+    dynamic = allowlists.CONFIG_DYNAMIC_KEYS
+    for key, sites in sorted(reads.items()):
+        if key in declared:
+            continue
+        if any(key.startswith(p) for p in dynamic if p.endswith(".")):
+            continue
+        path, line = sites[0]
+        out.append(Violation(
+            "config-keys", path, line,
+            f"config key {key!r} is read here but not declared in "
+            f"config/application.yaml"))
+    evidence = _config_literal_evidence(ctx)
+    prefixes = {e for e in evidence if e.endswith(".")}
+    for key in sorted(declared):
+        if key in allowlists.CONFIG_SKIP_KEYS or "." not in key:
+            continue
+        if key in evidence:
+            continue
+        # a concatenated read: some folded prefix + the final segment
+        if any(key.startswith(p) and key[len(p):] in evidence
+               for p in prefixes):
+            continue
+        if any(key.startswith(p) for p in dynamic if p.endswith(".")):
+            continue
+        if key in dynamic:
+            continue
+        out.append(Violation(
+            "config-keys", "sail_tpu/config/application.yaml", 0,
+            f"config key {key!r} is declared but never read anywhere "
+            f"under sail_tpu/ (wire it, remove it, or allowlist it "
+            f"with a reason)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# spark.sail.* session-key documentation drift
+# ---------------------------------------------------------------------------
+
+_SPARK_KEY_RE = re.compile(r"spark\.sail\.[A-Za-z0-9_.]+")
+
+
+def lint_spark_keys(ctx: LintContext) -> List[Violation]:
+    yaml_text = ctx.text("sail_tpu/config/application.yaml") or ""
+    raw_mentions = set(_SPARK_KEY_RE.findall(yaml_text))
+    # a sentence-final "…spark.sail.foo.bar." mention is an exact key
+    # plus punctuation, not a prefix — accept both readings
+    doc_mentions = raw_mentions | {m.rstrip(".") for m in raw_mentions}
+    doc_prefixes = {m for m in raw_mentions if m.endswith(".")}
+
+    def covered(key: str) -> bool:
+        if key in doc_mentions:
+            return True
+        # a documented "prefix." mention covers every key under it
+        if any(key.startswith(p) for p in doc_prefixes):
+            return True
+        # a prefix literal in code is covered when the yaml documents
+        # any concrete key under it
+        if key.endswith(".") and any(m.startswith(key)
+                                     for m in doc_mentions):
+            return True
+        return False
+
+    out: List[Violation] = []
+    seen: Set[str] = set()
+    for relpath in ctx.python_sources():
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for value, line in _string_constants(tree):
+            for key in _SPARK_KEY_RE.findall(value):
+                if key in seen:
+                    continue
+                seen.add(key)
+                if not covered(key):
+                    out.append(Violation(
+                        "spark-keys", relpath, line,
+                        f"session conf key {key!r} is not documented in "
+                        f"config/application.yaml (add the key or a "
+                        f"'prefix.' mention to the relevant section)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fault-site drift
+# ---------------------------------------------------------------------------
+
+# fault sites follow the `component.action` grammar; requiring the dot
+# keeps other README tables (the lint catalog) out of the match
+_README_SITE_RE = re.compile(r"^\|\s*`([a-z_]+\.[a-z_]+)`\s*\|",
+                             re.MULTILINE)
+
+
+def code_fault_sites(ctx: LintContext) -> Dict[str, Tuple[str, int]]:
+    """Site literals passed to ``faults.inject``/``inject`` or as
+    ``site=`` keywords (the retry helper threads them through)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for relpath in ctx.python_sources():
+        if relpath.endswith("sail_tpu/faults.py"):
+            continue  # the framework itself, not an injection site
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            site = None
+            if _call_name(node) in ("inject", "maybe_inject") and node.args:
+                site = _fold_str(node.args[0])
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    site = _fold_str(kw.value) or site
+            if site and re.match(r"^[a-z_]+\.[a-z_]+$", site):
+                out.setdefault(site, (relpath, node.lineno))
+    return out
+
+
+def lint_fault_sites(ctx: LintContext) -> List[Violation]:
+    readme = ctx.text("README.md")
+    if readme is None:
+        return [Violation("fault-sites", "README.md", 0,
+                          "README.md not found")]
+    documented = set(_README_SITE_RE.findall(readme))
+    sites = code_fault_sites(ctx)
+    out: List[Violation] = []
+    for site, (path, line) in sorted(sites.items()):
+        if site not in documented:
+            out.append(Violation(
+                "fault-sites", path, line,
+                f"fault-injection site {site!r} is not documented in "
+                f"the README site table"))
+    for site in sorted(documented - set(sites)):
+        out.append(Violation(
+            "fault-sites", "README.md", 0,
+            f"README documents fault site {site!r} but no "
+            f"faults.inject call site exists for it"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# proto freshness
+# ---------------------------------------------------------------------------
+
+_PROTO_MESSAGE_RE = re.compile(r"^\s*message\s+(\w+)", re.MULTILINE)
+_PROTO_FIELD_RE = re.compile(
+    r"^\s*(?:repeated\s+|optional\s+)?[\w.]+\s+(\w+)\s*=\s*\d+\s*;",
+    re.MULTILINE)
+_PROTO_RPC_RE = re.compile(r"^\s*rpc\s+(\w+)", re.MULTILINE)
+
+
+def _pb2_descriptor_names(pb2_src: str) -> Optional[Set[str]]:
+    """Message/field/service/method names baked into a generated pb2
+    module's serialized FileDescriptorProto (the longest bytes literal
+    in the file). Returns None when nothing parses."""
+    try:
+        tree = ast.parse(pb2_src)
+    except SyntaxError:
+        return None
+    blobs = [n.value for n in ast.walk(tree)
+             if isinstance(n, ast.Constant) and isinstance(n.value, bytes)]
+    if not blobs:
+        return None
+    from google.protobuf import descriptor_pb2
+    try:
+        fd = descriptor_pb2.FileDescriptorProto.FromString(
+            max(blobs, key=len))
+    except Exception:  # noqa: BLE001 — undecodable blob = no evidence
+        return None
+    names: Set[str] = set()
+
+    def visit_message(m):
+        names.add(m.name)
+        for f in m.field:
+            names.add(f.name)
+        for nested in m.nested_type:
+            visit_message(nested)
+        for e in m.enum_type:
+            names.add(e.name)
+
+    for m in fd.message_type:
+        visit_message(m)
+    for svc in fd.service:
+        names.add(svc.name)
+        for meth in svc.method:
+            names.add(meth.name)
+    return names
+
+
+def lint_proto(ctx: LintContext) -> List[Violation]:
+    out: List[Violation] = []
+    proto_dir = "sail_tpu/exec/proto"
+    abs_dir = os.path.join(ctx.root, proto_dir)
+    if not os.path.isdir(abs_dir):
+        return [Violation("proto", proto_dir, 0,
+                          "proto directory not found")]
+    for fn in sorted(os.listdir(abs_dir)):
+        if not fn.endswith(".proto"):
+            continue
+        proto_rel = f"{proto_dir}/{fn}"
+        pb2_rel = f"{proto_dir}/{fn[:-len('.proto')]}_pb2.py"
+        proto_src = ctx.text(proto_rel) or ""
+        pb2_src = ctx.text(pb2_rel)
+        if pb2_src is None:
+            out.append(Violation("proto", proto_rel, 0,
+                                 f"no regenerated module {pb2_rel}"))
+            continue
+        generated = _pb2_descriptor_names(pb2_src)
+        if generated is None:
+            out.append(Violation(
+                "proto", pb2_rel, 0,
+                "cannot decode the serialized descriptor from the "
+                "generated module"))
+            continue
+        names = set(_PROTO_MESSAGE_RE.findall(proto_src)) \
+            | set(_PROTO_FIELD_RE.findall(proto_src)) \
+            | set(_PROTO_RPC_RE.findall(proto_src))
+        for name in sorted(names):
+            if name not in generated:
+                out.append(Violation(
+                    "proto", proto_rel, 0,
+                    f"{fn} declares {name!r} but the regenerated "
+                    f"{os.path.basename(pb2_rel)} does not contain it "
+                    f"— re-run scripts/regen_control_plane_pb2.py"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sync-point allowlist (host<->device round trips in exec/ and ops/)
+# ---------------------------------------------------------------------------
+
+_SYNC_ATTRS = {"device_get", "block_until_ready"}
+
+
+class _QualnameVisitor(ast.NodeVisitor):
+    """Collect (qualname, attr, line) for sync-forcing calls."""
+
+    def __init__(self):
+        self.stack: List[str] = []
+        self.hits: List[Tuple[str, str, int]] = []
+
+    def _scoped(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _scoped
+    visit_AsyncFunctionDef = _scoped
+    visit_ClassDef = _scoped
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if node.attr in _SYNC_ATTRS:
+            qual = ".".join(self.stack) or "<module>"
+            self.hits.append((qual, node.attr, node.lineno))
+        self.generic_visit(node)
+
+
+def sync_points(ctx: LintContext) -> List[Tuple[str, str, str, int]]:
+    """(relpath, qualname, attr, line) of every sync-forcing call in
+    exec/ and ops/."""
+    out = []
+    for relpath in ctx.python_sources("exec", "ops"):
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        v = _QualnameVisitor()
+        v.visit(tree)
+        for qual, attr, line in v.hits:
+            out.append((relpath, qual, attr, line))
+    return out
+
+
+def lint_sync_points(ctx: LintContext) -> List[Violation]:
+    out = []
+    for relpath, qual, attr, line in sync_points(ctx):
+        if (relpath, qual) in allowlists.SYNC_POINTS:
+            continue
+        out.append(Violation(
+            "sync-points", relpath, line,
+            f"{attr} in {qual} is a host sync not on the reviewed "
+            f"allowlist (sail_tpu/analysis/allowlists.py SYNC_POINTS; "
+            f"scripts/sail_lint.py --fix-allowlist prints the stub)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lock / actor-thread discipline in exec/cluster.py
+# ---------------------------------------------------------------------------
+
+_MUTATORS = {"setdefault", "pop", "clear", "update", "append",
+             "extend", "remove", "add", "discard"}
+_GUARDED_READS = {"get", "items", "values", "keys"}
+
+
+def _is_self_attr(node: ast.AST, name: str) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == name
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _with_guards(body_node: ast.AST, lock_attr: str) -> Set[int]:
+    """Line numbers covered by ``with self.<lock_attr>`` blocks."""
+    covered: Set[int] = set()
+    for node in ast.walk(body_node):
+        if not isinstance(node, ast.With):
+            continue
+        if any(_is_self_attr(item.context_expr, lock_attr)
+               for item in node.items):
+            for sub in ast.walk(node):
+                if hasattr(sub, "lineno"):
+                    covered.add(sub.lineno)
+    return covered
+
+
+def _class_def(tree: ast.AST, name: str) -> Optional[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def lint_locks(ctx: LintContext) -> List[Violation]:
+    relpath = "sail_tpu/exec/cluster.py"
+    tree = ctx.tree(relpath)
+    if tree is None:
+        return [Violation("locks", relpath, 0, "cannot parse")]
+    out: List[Violation] = []
+
+    # -- WorkerActor._running: every touch under _running_lock ----------
+    worker = _class_def(tree, "WorkerActor")
+    if worker is None:
+        out.append(Violation("locks", relpath, 0,
+                             "WorkerActor class not found"))
+    else:
+        covered = _with_guards(worker, "_running_lock")
+        for node in ast.walk(worker):
+            if not _is_self_attr(node, "_running"):
+                continue
+            line = node.lineno
+            if line in covered:
+                continue
+            if _inside_init_assign(worker, node):
+                continue
+            if _inside_len_call(worker, node):
+                continue
+            out.append(Violation(
+                "locks", relpath, line,
+                "self._running touched outside `with "
+                "self._running_lock` (structural mutations AND content "
+                "reads must hold the lock; only len() is exempt)"))
+
+    # -- DriverActor registries: mutations on the actor thread only -----
+    driver = _class_def(tree, "DriverActor")
+    if driver is None:
+        out.append(Violation("locks", relpath, 0,
+                             "DriverActor class not found"))
+    else:
+        for reg in ("workers", "quarantined", "_readmit_info"):
+            for line, why in _off_thread_mutations(driver, reg):
+                out.append(Violation(
+                    "locks", relpath, line,
+                    f"self.{reg} mutated {why} — driver registries may "
+                    f"only be mutated from DriverActor methods running "
+                    f"on the actor thread (route through "
+                    f"self.handle.send)"))
+    return out
+
+
+def _parents(root: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _inside_init_assign(cls: ast.ClassDef, target: ast.AST) -> bool:
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            return any(sub is target for sub in ast.walk(node))
+    return False
+
+
+def _inside_len_call(cls: ast.ClassDef, target: ast.AST) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "len" and node.args \
+                and node.args[0] is target:
+            return True
+    return False
+
+
+def _off_thread_mutations(cls: ast.ClassDef, reg: str
+                          ) -> List[Tuple[int, str]]:
+    """Mutations of ``self.<reg>`` inside nested defs/lambdas of the
+    class's methods (those closures run on gRPC server threads, not the
+    actor thread) — plus mutations at class scope outside any method."""
+    out: List[Tuple[int, str]] = []
+    parents = _parents(cls)
+
+    def enclosing_defs(node: ast.AST) -> List[ast.AST]:
+        chain = []
+        cur = parents.get(node)
+        while cur is not None and cur is not cls:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                chain.append(cur)
+            cur = parents.get(cur)
+        return chain
+
+    for node in ast.walk(cls):
+        mutated = False
+        target = None
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if _is_self_attr(t, reg):
+                    mutated, target = True, t
+                elif isinstance(t, (ast.Subscript,)) and \
+                        _is_self_attr(t.value, reg):
+                    mutated, target = True, t
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        _is_self_attr(t.value, reg):
+                    mutated, target = True, t
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                _is_self_attr(node.func.value, reg):
+            mutated, target = True, node
+        if not mutated:
+            continue
+        chain = enclosing_defs(node)
+        if not chain:
+            continue  # class body (shouldn't happen)
+        # outermost enclosing def must be a direct method of the class;
+        # any nested def/lambda between the mutation and the method runs
+        # off the actor thread
+        if len(chain) > 1:
+            out.append((node.lineno, "inside a nested function"))
+        elif not isinstance(chain[0], (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+            out.append((node.lineno, "inside a lambda"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# metrics registry drift (the generalized test_registry_drift)
+# ---------------------------------------------------------------------------
+
+_METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(?:\.[a-z0-9_]+)+$")
+
+
+def load_metric_registry(ctx: LintContext) -> List[dict]:
+    import yaml
+    src = ctx.text("sail_tpu/metrics_registry.yaml")
+    return yaml.safe_load(src) if src else []
+
+
+def metric_call_sites(ctx: LintContext
+                      ) -> List[Tuple[str, Tuple[str, ...], str, int]]:
+    """(metric name, kwarg attribute keys, relpath, line) for every
+    ``record(...)``/``_record_metric(...)`` call with a resolvable
+    name (plain literal or either branch of a conditional)."""
+    out = []
+    for relpath in ctx.python_sources():
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _call_name(node) not in ("record", "_record_metric"):
+                continue
+            first = node.args[0]
+            names = []
+            if isinstance(first, ast.IfExp):
+                names = [_fold_str(first.body), _fold_str(first.orelse)]
+            else:
+                names = [_fold_str(first)]
+            attrs = tuple(sorted(kw.arg for kw in node.keywords
+                                 if kw.arg is not None))
+            has_star = any(kw.arg is None for kw in node.keywords)
+            for name in names:
+                if name is None or not _METRIC_NAME_RE.match(name):
+                    continue
+                out.append((name, attrs if not has_star else None,
+                            relpath, node.lineno))
+    return out
+
+
+def lint_metrics(ctx: LintContext) -> List[Violation]:
+    entries = load_metric_registry(ctx)
+    out: List[Violation] = []
+    if not entries:
+        return [Violation("metrics", "sail_tpu/metrics_registry.yaml", 0,
+                          "metrics_registry.yaml missing or empty")]
+    names = [e.get("name") for e in entries]
+    if len(names) != len(set(names)):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        out.append(Violation(
+            "metrics", "sail_tpu/metrics_registry.yaml", 0,
+            f"duplicate registry entries: {dupes}"))
+    for e in entries:
+        if e.get("type") not in ("counter", "gauge"):
+            out.append(Violation(
+                "metrics", "sail_tpu/metrics_registry.yaml", 0,
+                f"{e.get('name')!r}: bad type {e.get('type')!r}"))
+    by_name = {e["name"]: e for e in entries}
+    sites = metric_call_sites(ctx)
+    used_attrs: Dict[str, Set[str]] = {}
+    recorded: Set[str] = set()
+    for name, attrs, relpath, line in sites:
+        recorded.add(name)
+        if name not in by_name:
+            out.append(Violation(
+                "metrics", relpath, line,
+                f"metric {name!r} recorded here but not declared in "
+                f"metrics_registry.yaml"))
+            continue
+        declared_attrs = set(by_name[name].get("attributes") or ())
+        if attrs is None:
+            continue  # **kwargs call: runtime registry validates
+        extra = set(attrs) - declared_attrs
+        if extra:
+            out.append(Violation(
+                "metrics", relpath, line,
+                f"metric {name!r} recorded with undeclared attributes "
+                f"{sorted(extra)} (declared: {sorted(declared_attrs)})"))
+        used_attrs.setdefault(name, set()).update(attrs)
+    # orphan declarations: loose literal evidence, same as the original
+    # test_registry_drift (conditional names, f-string-free sites)
+    literal_evidence: Set[str] = set()
+    for relpath in ctx.python_sources():
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for value, _line in _string_constants(tree):
+            if _METRIC_NAME_RE.match(value):
+                literal_evidence.add(value)
+    for name, e in sorted(by_name.items()):
+        if name not in literal_evidence:
+            out.append(Violation(
+                "metrics", "sail_tpu/metrics_registry.yaml", 0,
+                f"metric {name!r} declared but never recorded anywhere "
+                f"under sail_tpu/"))
+            continue
+        declared_attrs = set(e.get("attributes") or ())
+        if name in used_attrs and name not in \
+                allowlists.METRIC_DYNAMIC_ATTRS:
+            unused = declared_attrs - used_attrs[name]
+            if unused and name in recorded:
+                out.append(Violation(
+                    "metrics", "sail_tpu/metrics_registry.yaml", 0,
+                    f"metric {name!r} declares attributes "
+                    f"{sorted(unused)} that no record() call site "
+                    f"passes"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registry + runner
+# ---------------------------------------------------------------------------
+
+LINTS: Dict[str, Callable[[LintContext], List[Violation]]] = {
+    "config-keys": lint_config_keys,
+    "spark-keys": lint_spark_keys,
+    "fault-sites": lint_fault_sites,
+    "proto": lint_proto,
+    "sync-points": lint_sync_points,
+    "locks": lint_locks,
+    "metrics": lint_metrics,
+}
+
+
+def run_lints(root: str = REPO_ROOT,
+              only: Optional[Iterable[str]] = None) -> List[Violation]:
+    ctx = LintContext(root)
+    out: List[Violation] = []
+    for name, fn in LINTS.items():
+        if only is not None and name not in only:
+            continue
+        out.extend(fn(ctx))
+    return out
+
+
+def fix_allowlist_stubs(root: str = REPO_ROOT) -> str:
+    """Ready-to-paste allowlist stubs for current violations (sync
+    points + dynamic config keys). The reason strings are placeholders:
+    edit them before committing — see the module docstring etiquette."""
+    ctx = LintContext(root)
+    lines: List[str] = []
+    sync = [(relpath, qual) for relpath, qual, _a, _l in sync_points(ctx)
+            if (relpath, qual) not in allowlists.SYNC_POINTS]
+    if sync:
+        lines.append("# add to SYNC_POINTS in "
+                     "sail_tpu/analysis/allowlists.py:")
+        for relpath, qual in sorted(set(sync)):
+            lines.append(f'    ("{relpath}", "{qual}"),')
+    declared = declared_config_keys(ctx)
+    orphan = [v for v in lint_config_keys(ctx)
+              if "declared but never read" in v.message]
+    if orphan:
+        lines.append("# add to CONFIG_DYNAMIC_KEYS in "
+                     "sail_tpu/analysis/allowlists.py (or wire/remove "
+                     "the key):")
+        for v in orphan:
+            key = v.message.split("'")[1]
+            if key in declared:
+                lines.append(f'    "{key}": "TODO: why is this key '
+                             f'read dynamically?",')
+    return "\n".join(lines)

@@ -146,13 +146,32 @@ class HostBatch:
         return int(self.device.num_rows())
 
 
-def round_capacity(n: int, minimum: int = 8) -> int:
+_CAPACITY_MIN: Optional[int] = None
+
+
+def _capacity_min() -> int:
+    """``execution.batch_capacity_min``, read once per process (this
+    sits under every batch construction)."""
+    global _CAPACITY_MIN
+    if _CAPACITY_MIN is None:
+        try:
+            from ..config import get as config_get
+            _CAPACITY_MIN = max(1, int(config_get(
+                "execution.batch_capacity_min", 8)))
+        except (TypeError, ValueError, ImportError):
+            _CAPACITY_MIN = 8
+    return _CAPACITY_MIN
+
+
+def round_capacity(n: int, minimum: Optional[int] = None) -> int:
     """Round a row count up to the padded device capacity.
 
     Buckets to 1.25^k-ish steps on top of powers of two fragments so that
     repeated scans with similar sizes hit the jit cache instead of
     recompiling (XLA static shapes).
     """
+    if minimum is None:
+        minimum = _capacity_min()
     if n <= minimum:
         return minimum
     p = 1 << (int(n - 1).bit_length() - 1)  # largest pow2 <= n-1... p < n <= 2p

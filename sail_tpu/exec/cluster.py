@@ -95,6 +95,13 @@ def _retry_conf() -> Tuple[int, float, float]:
     return conf
 
 
+def _conf_int(value, default: int) -> int:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
 def _is_not_found(e: Exception) -> bool:
     if isinstance(e, faults.FaultInjectedError):
         return e.code == "not_found"
@@ -401,7 +408,13 @@ class WorkerActor(Actor):
             self._server.stop(grace=0)
 
     def _heartbeat_loop(self):
-        while not self._hb_stop.wait(1.0):
+        from ..config import get as config_get
+        try:
+            interval = max(0.1, float(config_get(
+                "cluster.worker_heartbeat_interval_secs", 1.0)))
+        except (TypeError, ValueError):
+            interval = 1.0
+        while not self._hb_stop.wait(interval):
             try:
                 faults.inject("worker.heartbeat", key=self.worker_id)
                 self._call_driver("Heartbeat", pb.HeartbeatRequest(
@@ -1476,13 +1489,23 @@ def encode_cached(job: _Job, stage: jg.Stage) -> bytes:
 # ---------------------------------------------------------------------------
 
 class LocalCluster:
-    def __init__(self, num_workers: int = 2, task_slots: int = 2,
+    def __init__(self, num_workers: Optional[int] = None,
+                 task_slots: Optional[int] = None,
                  elastic: Optional[dict] = None):
         """``elastic``: {"max": int, "min": int, "idle_secs": float} —
         workers beyond ``num_workers`` are started on demand by the driver
         through a ThreadWorkerManager and idle-reaped (reference:
-        driver/worker_pool/ elastic scaling)."""
+        driver/worker_pool/ elastic scaling). ``num_workers`` and
+        ``task_slots`` default from ``cluster.worker_initial_count`` /
+        ``cluster.worker_task_slots``."""
         faults.reload()  # pick up SAIL_FAULTS set after module import
+        from ..config import get as config_get
+        if num_workers is None:
+            num_workers = _conf_int(
+                config_get("cluster.worker_initial_count", 2), 2)
+        if task_slots is None:
+            task_slots = _conf_int(
+                config_get("cluster.worker_task_slots", 2), 2)
         self.driver = DriverActor()
         self.driver.start("driver")
         deadline = time.time() + 10
@@ -1514,7 +1537,14 @@ class LocalCluster:
         from .local import LocalExecutor
         from .. import profiler
 
-        nparts = num_partitions or max(1, len(self.workers))
+        if num_partitions:
+            nparts = num_partitions
+        else:
+            from ..config import get as config_get
+            conf_parts = _conf_int(
+                config_get("cluster.shuffle_partitions", 0), 0)
+            nparts = conf_parts if conf_parts > 0 \
+                else max(1, len(self.workers))
         graph = jg.split_job(plan, nparts)
         if graph is None:
             return LocalExecutor().execute(plan)

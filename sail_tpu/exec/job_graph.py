@@ -492,6 +492,7 @@ def split_job(plan: pn.PlanNode, num_partitions: int) -> Optional[JobGraph]:
                  on_driver=True)
     b.stages.append(root)
     graph = JobGraph(b.stages, b.scan_tables)
+    _maybe_validate_graph(graph)
     from ..config import truthy as _on
 
     # both the cluster gate AND the runtime-filter master switch must be
@@ -503,6 +504,24 @@ def split_job(plan: pn.PlanNode, num_partitions: int) -> Optional[JobGraph]:
         except Exception:  # noqa: BLE001 — filters are advisory
             graph.stage_filters = {}
     return graph
+
+
+def _maybe_validate_graph(graph: JobGraph) -> None:
+    """Stage-boundary invariant check (shuffle channel counts, stage
+    input schemas) before any task ships. Gated by the app-config
+    ``analysis.validate_plans`` (split_job has no session context —
+    like the other cluster gates, use SAIL_ANALYSIS__VALIDATE_PLANS to
+    override); the walk rides the query profile's validated count."""
+    from ..analysis.invariants import (VALIDATE_OFF, validate_job_graph,
+                                       validation_mode)
+    if validation_mode() == VALIDATE_OFF:
+        return
+    validate_job_graph(graph)
+    try:
+        from .. import profiler
+        profiler.note_plan_validated()
+    except Exception:  # noqa: BLE001 — accounting never fails a job
+        pass
 
 
 # ---------------------------------------------------------------------------

@@ -317,10 +317,18 @@ class _OpCache:
     queries of the same shape skip both tracing and XLA compilation.
     """
 
-    def __init__(self, max_entries: int = 512):
+    def __init__(self, max_entries: Optional[int] = None):
         from collections import OrderedDict
         self.entries = OrderedDict()
-        self.max_entries = max_entries
+        self._max_entries = max_entries
+
+    @property
+    def max_entries(self) -> int:
+        # resolved lazily so the config layer is ready by first use
+        if self._max_entries is None:
+            self._max_entries = _runtime_cache_size(
+                "runtime.op_cache_size", 512)
+        return self._max_entries
 
     def get(self, key, dict_objs: Tuple, builder):
         ident = tuple(id(d) for d in dict_objs)
@@ -362,6 +370,21 @@ def _compile_timed(fn, key):
     return wrapper
 
 
+def _runtime_cache_size(key: str, default: int) -> int:
+    """Process-wide cache bound from config, read once per key (these
+    sit on hot paths; app-config flattening must not ride every hit)."""
+    size = _RUNTIME_CACHE_SIZES.get(key)
+    if size is None:
+        try:
+            from ..config import get as config_get
+            size = max(1, int(config_get(key, default)))
+        except (TypeError, ValueError, ImportError):
+            size = default
+        _RUNTIME_CACHE_SIZES[key] = size
+    return size
+
+
+_RUNTIME_CACHE_SIZES: Dict[str, int] = {}
 _OP_CACHE = _OpCache()
 _SCAN_CACHE: Dict = {}
 # runtime join filters: join-structure key → last observed prune ratio
@@ -606,7 +629,10 @@ class LocalExecutor:
             filter_expr = None
             preds = p.predicates
             if p.format == "parquet" and (preds or rtf_preds):
-                from ..io.formats import rex_predicates_to_arrow
+                from ..io.formats import rex_predicates_to_arrow, \
+                    row_group_pruning_enabled
+                if not row_group_pruning_enabled():
+                    preds = rtf_preds = ()
                 if rtf_preds:
                     # runtime filter conjuncts join the static predicates
                     # for parquet row-group/page skipping; fall back to
@@ -632,7 +658,8 @@ class LocalExecutor:
                     rtf_stats = None
         hb = _positional(ai.from_arrow(table))
         self._note_rtf_scan(p, rtf_stats)
-        while len(_SCAN_CACHE) > 64:
+        while len(_SCAN_CACHE) > _runtime_cache_size(
+                "runtime.scan_cache_size", 64):
             _SCAN_CACHE.pop(next(iter(_SCAN_CACHE)))  # drop oldest
         _SCAN_CACHE[cache_key] = (p.source, hb, rtf_stats)
         return hb
@@ -1504,8 +1531,10 @@ class LocalExecutor:
             chunk_rows = 8_000_000
         filter_expr = None
         if node.predicates:
-            filter_expr = rex_predicates_to_arrow(node.predicates,
-                                                  node.schema)
+            from ..io.formats import row_group_pruning_enabled
+            if row_group_pruning_enabled():
+                filter_expr = rex_predicates_to_arrow(node.predicates,
+                                                      node.schema)
         ds = pads.dataset(files, format="parquet")
         scanner = ds.scanner(
             columns=list(node.projection) if node.projection else None,

@@ -86,11 +86,30 @@ def _delta_travel(options: Dict[str, str]):
     return (int(version) if version is not None else None), ts_ms
 
 
+_ROW_GROUP_PRUNING: Optional[bool] = None
+
+
+def row_group_pruning_enabled() -> bool:
+    """``parquet.enable_row_group_pruning``, read once per process —
+    the gate sits on every parquet scan, so the config layer must not
+    ride each one."""
+    global _ROW_GROUP_PRUNING
+    if _ROW_GROUP_PRUNING is None:
+        try:
+            from ..config import truthy
+            _ROW_GROUP_PRUNING = truthy("parquet.enable_row_group_pruning")
+        except Exception:  # noqa: BLE001 — default on
+            _ROW_GROUP_PRUNING = True
+    return _ROW_GROUP_PRUNING
+
+
 def rex_predicates_to_arrow(predicates, schema) -> Optional["pads.Expression"]:
     """Scan predicates (col-vs-literal conjuncts) → a pyarrow dataset
     filter for parquet row-group/fragment pruning. Returns None when any
     conjunct fails to convert (pruning is best-effort; the exact filter
-    runs above the scan)."""
+    runs above the scan). Parquet call sites gate on
+    :func:`row_group_pruning_enabled`; host-side consumers (in-memory
+    runtime-filter application) are unaffected by that parquet knob."""
     from ..plan import rex as rx
 
     def field(r):
@@ -314,7 +333,11 @@ def write_table(table: pa.Table, fmt: str, path: str, mode: str = "error",
     fname = f"part-00000-{uuid.uuid4().hex}.{fmt if fmt != 'json' else 'json'}"
     fpath = os.path.join(path, fname)
     if fmt == "parquet":
-        pq.write_table(table, fpath, compression=options.get("compression", "snappy"))
+        compression = options.get("compression")
+        if compression is None:
+            from ..config import get as config_get
+            compression = str(config_get("parquet.compression", "snappy"))
+        pq.write_table(table, fpath, compression=compression)
     elif fmt == "csv":
         header = options.get("header", "false").lower() in ("true", "1")
         pacsv.write_csv(table, fpath,

@@ -142,6 +142,30 @@ class MetricsRegistry:
 
 REGISTRY = MetricsRegistry.from_yaml()
 
+_ENABLED: "bool | None" = None
+
+
+def _enabled() -> bool:
+    """``telemetry.metrics_enabled`` gate, read once per process —
+    record() sits on hot paths, so the config layer cannot ride every
+    call. Tests flip it via :func:`reload_enabled`."""
+    global _ENABLED
+    if _ENABLED is None:
+        try:
+            from .config import truthy
+            _ENABLED = truthy("telemetry.metrics_enabled")
+        except Exception:  # noqa: BLE001 — metrics must not break imports
+            _ENABLED = True
+    return _ENABLED
+
+
+def reload_enabled() -> None:
+    """Re-read ``telemetry.metrics_enabled`` on the next record()."""
+    global _ENABLED
+    _ENABLED = None
+
 
 def record(name: str, value, **attributes) -> None:
+    if not _enabled():
+        return
     REGISTRY.record(name, value, **attributes)

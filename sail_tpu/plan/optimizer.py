@@ -23,16 +23,79 @@ from . import nodes as pn
 from . import rex as rx
 
 
-def optimize(plan: pn.PlanNode) -> pn.PlanNode:
-    plan = push_filters(plan)
-    plan = _maybe_reorder_joins(plan)
-    plan = prune_columns(plan)
+def optimize(plan: pn.PlanNode,
+             validate: Optional[str] = None) -> pn.PlanNode:
+    """Run the rule pipeline. ``validate`` overrides the
+    ``analysis.validate_plans`` gate (session conf
+    ``spark.sail.analysis.validatePlans``): each pass's output is
+    checked by the plan-invariant validator so a bad remap names the
+    pass that introduced it instead of surfacing as a wrong answer."""
+    from ..analysis.invariants import (VALIDATE_FINAL, VALIDATE_FULL,
+                                       validate_plan, validation_mode)
+    mode = validation_mode(validate)
+
+    def check(p: pn.PlanNode, after: str,
+              is_final: bool = False) -> pn.PlanNode:
+        if mode == VALIDATE_FULL or (mode == VALIDATE_FINAL and is_final):
+            validate_plan(p, after=after)
+            _note_validated()
+        return p
+
+    check(plan, "resolve")
+    # re-optimizing an already-annotated plan: push/reorder/prune rebuild
+    # Join nodes (dropping their runtime-filter edges) while untouched
+    # scans would keep theirs — strip both sides up front so every pass
+    # boundary holds the no-orphan-edge invariant and the annotation
+    # pass starts from a clean slate
+    plan = _strip_runtime_filters(plan)
+    plan = check(push_filters(plan), "push_filters")
+    plan = check(_maybe_reorder_joins(plan), "join_reorder")
     # runs AFTER pruning: reorder/prune rebuild Join/Scan nodes and would
     # drop the annotations; scan projections are final here, so target
     # column indices bind to the projected schema
-    plan = _maybe_annotate_runtime_filters(plan)
-    plan = _optimize_subquery_plans(plan)
+    plan = check(prune_columns(plan), "prune_columns")
+    plan = check(_maybe_annotate_runtime_filters(plan), "runtime_filters")
+    plan = check(_optimize_subquery_plans(plan, validate),
+                 "subquery_optimize", is_final=True)
     return plan
+
+
+def _strip_runtime_filters(p: pn.PlanNode) -> pn.PlanNode:
+    """Drop every runtime-filter annotation (join edges AND scan edges)
+    from a plan — the annotation pass at the end of the pipeline
+    re-derives them against the final node identities. Identity-
+    preserving: a fresh, unannotated plan (the common case) walks
+    without copying a single node."""
+    updates = {}
+    if isinstance(p, (pn.ScanExec, pn.JoinExec)) and p.runtime_filters:
+        updates["runtime_filters"] = ()
+    if isinstance(p, pn.JoinExec):
+        left = _strip_runtime_filters(p.left)
+        right = _strip_runtime_filters(p.right)
+        if left is not p.left:
+            updates["left"] = left
+        if right is not p.right:
+            updates["right"] = right
+    elif isinstance(p, pn.UnionExec):
+        inputs = tuple(_strip_runtime_filters(c) for c in p.inputs)
+        if any(n is not o for n, o in zip(inputs, p.inputs)):
+            updates["inputs"] = inputs
+    elif getattr(p, "input", None) is not None and \
+            isinstance(p.input, pn.PlanNode):
+        child = _strip_runtime_filters(p.input)
+        if child is not p.input:
+            updates["input"] = child
+    return dataclasses.replace(p, **updates) if updates else p
+
+
+def _note_validated() -> None:
+    """Count one validator walk on the active query profile (surfaced
+    as the ``validated: <n> passes`` EXPLAIN ANALYZE line)."""
+    try:
+        from .. import profiler
+        profiler.note_plan_validated()
+    except Exception:  # noqa: BLE001 — accounting never fails a query
+        pass
 
 
 def _maybe_annotate_runtime_filters(plan: pn.PlanNode) -> pn.PlanNode:
@@ -44,14 +107,18 @@ def _maybe_annotate_runtime_filters(plan: pn.PlanNode) -> pn.PlanNode:
     return annotate_runtime_filters(plan)
 
 
-def _optimize_subquery_plans(p: pn.PlanNode) -> pn.PlanNode:
+def _optimize_subquery_plans(p: pn.PlanNode,
+                             validate: Optional[str] = None) -> pn.PlanNode:
     """Scalar-subquery plans embedded in expressions run as independent
     jobs — they deserve the same rule pipeline (a TPC-H q11-style
-    implicit-cross-join subquery is pathological unoptimized)."""
+    implicit-cross-join subquery is pathological unoptimized).
+    ``validate`` threads the session's validator override through, so
+    turning validation off covers subquery pipelines too."""
 
     def fix_rex(r: rx.Rex) -> rx.Rex:
         if isinstance(r, rx.RScalarSubquery):
-            return dataclasses.replace(r, plan=optimize(r.plan))
+            return dataclasses.replace(
+                r, plan=optimize(r.plan, validate=validate))
         if isinstance(r, rx.RCall):
             return dataclasses.replace(
                 r, args=tuple(fix_rex(a) for a in r.args))

@@ -77,6 +77,9 @@ class QueryProfile:
     ft_retries: int = 0
     ft_speculative_launched: int = 0
     ft_speculative_won: int = 0
+    # plan-invariant validator walks that ran for this query (optimizer
+    # pass boundaries + job-graph stage checks)
+    validated_passes: int = 0
     rows_out: int = 0
     slow: bool = False
     # operator metric trees (dicts, telemetry.OperatorMetrics.to_dict)
@@ -161,6 +164,10 @@ class QueryProfile:
             self.ft_speculative_launched += int(speculative_launched)
             self.ft_speculative_won += int(speculative_won)
 
+    def note_validated(self, passes: int = 1) -> None:
+        with self._lock:
+            self.validated_passes += int(passes)
+
     def add_task(self, stage: int, partition: int, worker_id: str,
                  operators: List[dict], rows_out: int = 0) -> None:
         """Merge one distributed task's operator metrics (driver side)."""
@@ -223,6 +230,7 @@ class QueryProfile:
                 "speculative_launched": self.ft_speculative_launched,
                 "speculative_won": self.ft_speculative_won,
             },
+            "validated_passes": self.validated_passes,
             "rows_out": self.rows_out,
             "slow": self.slow,
             "operators": list(self.operators),
@@ -254,6 +262,8 @@ class QueryProfile:
                 f"fault tolerance: retries={self.ft_retries} "
                 f"speculative={self.ft_speculative_launched} "
                 f"won={self.ft_speculative_won}")
+        if self.validated_passes:
+            lines.append(f"validated: {self.validated_passes} passes")
         if self.tasks:
             from .telemetry import OperatorMetrics
             lines.append(f"tasks: {len(self.tasks)}")
@@ -506,6 +516,13 @@ def note_runtime_filter(built: int = 0, pushed: int = 0,
     if profile is not None:
         profile.note_rtf(built=built, pushed=pushed,
                          rows_pruned=rows_pruned, build_ms=build_ms)
+
+
+def note_plan_validated(passes: int = 1) -> None:
+    """One plan-invariant validator walk completed for this query."""
+    profile = current_profile()
+    if profile is not None:
+        profile.note_validated(passes)
 
 
 def last_profile() -> Optional[QueryProfile]:

@@ -73,7 +73,9 @@ class SparkSession:
         with profiler.maybe_phase("resolve"):
             node = Resolver(self.catalog_manager).resolve(plan)
         with profiler.maybe_phase("optimize"):
-            return optimize(node)
+            return optimize(
+                node,
+                validate=self.conf.get("spark.sail.analysis.validatePlans"))
 
     def _note_parsed(self, plan: sp.QueryPlan, text: str,
                      parse_ms: float, exempt: bool = False) -> None:
@@ -897,7 +899,9 @@ class SessionConf:
                 ("cluster.quarantine.duration_secs",
                  "spark.sail.cluster.quarantine.durationSecs"),
                 ("faults.spec", "spark.sail.faults.spec"),
-                ("faults.seed", "spark.sail.faults.seed")):
+                ("faults.seed", "spark.sail.faults.seed"),
+                ("analysis.validate_plans",
+                 "spark.sail.analysis.validatePlans")):
             value = app.get(yaml_key)
             if value is not None:
                 base[conf_key] = str(value)

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Run the sail_tpu repo-wide drift lints.
+
+Usage:
+    python scripts/sail_lint.py                 # lint this repo, exit 1
+                                                # on any violation
+    python scripts/sail_lint.py --only metrics,config-keys
+    python scripts/sail_lint.py --root /tmp/copy
+    python scripts/sail_lint.py --list          # show the lint catalog
+    python scripts/sail_lint.py --fix-allowlist # print allowlist stubs
+                                                # for current violations
+
+The same lints run as tier-1 tests (tests/test_lints.py), so they gate
+every PR without extra CI plumbing; this entry point is for local runs
+and for linting seeded/tmp copies of the tree.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir)))
+
+from sail_tpu.analysis import lints  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=lints.REPO_ROOT,
+                    help="repo root to lint (default: this repo)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated lint ids to run")
+    ap.add_argument("--list", action="store_true",
+                    help="list available lints and exit")
+    ap.add_argument("--fix-allowlist", action="store_true",
+                    help="print allowlist stubs for current violations")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, fn in lints.LINTS.items():
+            doc = (fn.__doc__ or "").strip().splitlines()
+            print(f"{name:14s} {doc[0] if doc else ''}")
+        return 0
+
+    if args.fix_allowlist:
+        stubs = lints.fix_allowlist_stubs(args.root)
+        print(stubs if stubs else "# no allowlist-fixable violations")
+        return 0
+
+    only = None if args.only is None else \
+        {s.strip() for s in args.only.split(",") if s.strip()}
+    if only is not None:
+        unknown = only - set(lints.LINTS)
+        if unknown:
+            print(f"unknown lints: {sorted(unknown)} "
+                  f"(available: {sorted(lints.LINTS)})", file=sys.stderr)
+            return 2
+    violations = lints.run_lints(args.root, only=only)
+    for v in violations:
+        print(v.render())
+    names = sorted(only) if only is not None else sorted(lints.LINTS)
+    print(f"{len(violations)} violation(s) from "
+          f"{len(names)} lint(s): {', '.join(names)}")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

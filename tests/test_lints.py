@@ -1,0 +1,215 @@
+"""Tier-1 gate for the repo-wide drift lints.
+
+Two halves:
+
+- the real tree must be clean — every lint returns zero violations, so
+  any PR that introduces drift (an undeclared config key, an
+  undocumented fault site, a stale pb2, a stray host sync, an unlocked
+  registry mutation) fails here without extra CI plumbing;
+- each lint must actually catch its drift class — a tmp copy of the
+  tree is seeded with a known violation and the lint (and the
+  ``scripts/sail_lint.py`` entry point) must go red.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from sail_tpu.analysis import lints
+
+REPO_ROOT = lints.REPO_ROOT
+SCRIPT = os.path.join(REPO_ROOT, "scripts", "sail_lint.py")
+
+
+# ---------------------------------------------------------------------------
+# the repo itself is clean
+# ---------------------------------------------------------------------------
+
+_CTX = lints.LintContext()  # shared: file/AST caches amortize across lints
+
+
+@pytest.mark.parametrize("lint_id", sorted(lints.LINTS))
+def test_repo_is_clean(lint_id):
+    violations = lints.LINTS[lint_id](_CTX)
+    assert not violations, "\n".join(v.render() for v in violations)
+
+
+def test_runner_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT], capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# seeded drift goes red
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tree_copy(tmp_path_factory):
+    """A lintable copy of the repo: sail_tpu/ + README.md."""
+    root = tmp_path_factory.mktemp("seeded")
+    shutil.copytree(
+        os.path.join(REPO_ROOT, "sail_tpu"), root / "sail_tpu",
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc"))
+    shutil.copy(os.path.join(REPO_ROOT, "README.md"), root / "README.md")
+    return str(root)
+
+
+@pytest.fixture
+def seeded(tree_copy, tmp_path):
+    """Per-test scratch copy of the shared tree (cheap re-copy of only
+    the files a test mutates would complicate the API; the tree is
+    ~2 MB so a full copy stays fast)."""
+    root = tmp_path / "tree"
+    shutil.copytree(tree_copy, root)
+    return str(root)
+
+
+def _append(root, relpath, text):
+    with open(os.path.join(root, relpath), "a", encoding="utf-8") as f:
+        f.write(text)
+
+
+def _run(root, only):
+    return lints.run_lints(root, only={only})
+
+
+def test_seeded_undeclared_config_key(seeded):
+    _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_drift():\n"
+            "    from ..config import get as config_get\n"
+            "    return config_get(\"bogus.lint_seed.key\", 1)\n")
+    found = _run(seeded, "config-keys")
+    assert any("bogus.lint_seed.key" in v.message for v in found), found
+
+
+def test_seeded_orphan_config_key(seeded):
+    _append(seeded, "sail_tpu/config/application.yaml",
+            "\nlint_seed:\n  orphan_key: 1\n")
+    found = _run(seeded, "config-keys")
+    assert any("lint_seed.orphan_key" in v.message
+               and "never read" in v.message for v in found), found
+
+
+def test_seeded_undocumented_spark_key(seeded):
+    _append(seeded, "sail_tpu/profiler.py", "\n_SEEDED_DRIFT = "
+            "\"spark.sail.lintSeed.bogusKnob\"\n")
+    found = _run(seeded, "spark-keys")
+    assert any("spark.sail.lintSeed.bogusKnob" in v.message
+               for v in found), found
+
+
+def test_seeded_undocumented_fault_site(seeded):
+    _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_fault():\n"
+            "    from .. import faults\n"
+            "    faults.inject(\"lint.seed\", key=\"x\")\n")
+    found = _run(seeded, "fault-sites")
+    assert any("lint.seed" in v.message for v in found), found
+
+
+def test_seeded_removed_fault_site(seeded):
+    # drop a real inject call: README still documents io.read
+    path = os.path.join(seeded, "sail_tpu/io/formats.py")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    src = src.replace('faults.inject("io.read", key=fmt)', "pass")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src)
+    found = _run(seeded, "fault-sites")
+    assert any("io.read" in v.message and "README documents" in v.message
+               for v in found), found
+
+
+def test_seeded_proto_drift(seeded):
+    path = os.path.join(seeded,
+                        "sail_tpu/exec/proto/control_plane.proto")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    assert "message HeartbeatRequest" in src
+    src = src.replace(
+        "message HeartbeatRequest {",
+        "message HeartbeatRequest {\n  string lint_seed_field = 99;",
+        1)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src)
+    found = _run(seeded, "proto")
+    assert any("lint_seed_field" in v.message for v in found), found
+
+
+def test_seeded_sync_point(seeded):
+    _append(seeded, "sail_tpu/exec/job_graph.py",
+            "\n\ndef _seeded_sync(x):\n    import jax\n"
+            "    return jax.device_get(x)\n")
+    found = _run(seeded, "sync-points")
+    assert any("_seeded_sync" in v.message for v in found), found
+
+
+def test_seeded_unlocked_running_mutation(seeded):
+    path = os.path.join(seeded, "sail_tpu/exec/cluster.py")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    # a WorkerActor method touching _running without the lock
+    src = src.replace(
+        "    def _die(self):",
+        "    def _seeded_unlocked(self, key):\n"
+        "        return self._running.pop(key, None)\n\n"
+        "    def _die(self):", 1)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src)
+    found = _run(seeded, "locks")
+    assert any("_running_lock" in v.message for v in found), found
+
+
+def test_seeded_driver_registry_mutation_in_nested_def(seeded):
+    path = os.path.join(seeded, "sail_tpu/exec/cluster.py")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    # a gRPC-handler-style closure mutating the worker registry
+    src = src.replace(
+        "        def cancel_job(request: pb.CancelJobRequest, context):",
+        "        def seeded_mutation(request, context):\n"
+        "            self.workers.pop(request.worker_id, None)\n\n"
+        "        def cancel_job(request: pb.CancelJobRequest, context):",
+        1)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src)
+    found = _run(seeded, "locks")
+    assert any("nested function" in v.message for v in found), found
+
+
+def test_seeded_undeclared_metric(seeded):
+    _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_metric():\n"
+            "    from ..metrics import record\n"
+            "    record(\"lint.seeded_metric\", 1)\n")
+    found = _run(seeded, "metrics")
+    assert any("lint.seeded_metric" in v.message for v in found), found
+
+
+def test_seeded_undeclared_metric_attribute(seeded):
+    _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_attr():\n"
+            "    from ..metrics import record\n"
+            "    record(\"execution.query_count\", 1, bogus_attr=\"x\")\n")
+    found = _run(seeded, "metrics")
+    assert any("bogus_attr" in v.message for v in found), found
+
+
+def test_runner_exits_nonzero_on_seeded_drift(seeded):
+    _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_drift():\n"
+            "    from ..config import get as config_get\n"
+            "    return config_get(\"bogus.lint_seed.key\", 1)\n")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--root", seeded, "--only",
+         "config-keys"], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "bogus.lint_seed.key" in proc.stdout
+
+
+def test_fix_allowlist_emits_sync_point_stub(seeded):
+    _append(seeded, "sail_tpu/exec/job_graph.py",
+            "\n\ndef _seeded_sync(x):\n    import jax\n"
+            "    return jax.device_get(x)\n")
+    stubs = lints.fix_allowlist_stubs(seeded)
+    assert '("sail_tpu/exec/job_graph.py", "_seeded_sync")' in stubs

@@ -1,0 +1,426 @@
+"""Plan-invariant validator: negative-path fuzz + end-to-end.
+
+~25 deliberate plan mutations (out-of-range BoundRefs, dropped columns,
+dtype-mismatched join keys, dangling runtime-filter edges, broken stage
+boundaries) must each be caught with the right invariant id and pass
+name; the full TPC-H + ClickBench suites must resolve/optimize with
+validation on and zero violations.
+"""
+
+import dataclasses
+
+import pyarrow as pa
+import pytest
+
+from sail_tpu.analysis import PlanInvariantError, validate_job_graph, \
+    validate_plan
+from sail_tpu.plan import nodes as pn
+from sail_tpu.plan import rex as rx
+from sail_tpu.spec import data_type as dt
+from sail_tpu.spec.literal import Literal as LV
+
+INT = dt.IntegerType()
+LONG = dt.LongType()
+STR = dt.StringType()
+DBL = dt.DoubleType()
+BOOL = dt.BooleanType()
+
+
+def F(name, d=INT):
+    return pn.Field(name, d)
+
+
+def scan(*fields, **kw):
+    return pn.ScanExec(out_schema=tuple(fields), format="memory", **kw)
+
+
+def ref(i, name="c", d=INT):
+    return rx.BoundRef(i, name, d)
+
+
+def lit(v, d=INT):
+    return rx.RLit(LV(d, v))
+
+
+def eq(a, b):
+    return rx.RCall("==", (a, b), BOOL)
+
+
+def expect(invariant, plan, after="prune_columns"):
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(plan, after=after)
+    err = ei.value
+    assert err.invariant == invariant, \
+        f"expected {invariant}, got {err.invariant}: {err}"
+    assert err.after == after
+    assert after in str(err)
+    return err
+
+
+# ---------------------------------------------------------------------------
+# positive baseline
+# ---------------------------------------------------------------------------
+
+def test_valid_plan_passes():
+    s = scan(F("a"), F("b", STR))
+    plan = pn.ProjectExec(
+        pn.FilterExec(s, eq(ref(0, "a"), lit(1))),
+        (("a", ref(0, "a")), ("b", ref(1, "b", STR))))
+    validate_plan(plan, after="resolve")  # no raise
+
+
+# ---------------------------------------------------------------------------
+# BoundRef / expression fuzz
+# ---------------------------------------------------------------------------
+
+def test_filter_ref_out_of_range():
+    expect("boundref.range",
+           pn.FilterExec(scan(F("a")), eq(ref(5), lit(1))))
+
+
+def test_filter_ref_negative():
+    expect("boundref.range",
+           pn.FilterExec(scan(F("a")), eq(ref(-1), lit(1))),
+           after="push_filters")
+
+
+def test_filter_condition_not_boolean():
+    expect("filter.dtype", pn.FilterExec(scan(F("a")), ref(0, "a", INT)))
+
+
+def test_boundref_dtype_family_drift():
+    # recorded as string, bound to an int column: a bad remap signature
+    expect("boundref.dtype",
+           pn.FilterExec(scan(F("a", INT)),
+                         eq(ref(0, "a", STR), lit("x", STR))))
+
+
+def test_project_ref_past_pruned_child():
+    expect("boundref.range",
+           pn.ProjectExec(scan(F("a")), (("x", ref(3)),)))
+
+
+def test_sort_key_out_of_range():
+    expect("boundref.range",
+           pn.SortExec(scan(F("a")), (pn.SortKey(ref(2)),)),
+           after="join_reorder")
+
+
+def test_scalar_subquery_plan_validates_recursively():
+    broken = pn.FilterExec(scan(F("z")), eq(ref(7), lit(1)))
+    sub = rx.RScalarSubquery(plan=broken, dtype=INT)
+    expect("boundref.range",
+           pn.FilterExec(scan(F("a")), eq(ref(0, "a"), sub)),
+           after="subquery_optimize")
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+def _join(**kw):
+    base = dict(left=scan(F("a"), F("b", STR)), right=scan(F("a"), F("d", STR)),
+                join_type="inner", left_keys=(ref(0, "a"),),
+                right_keys=(ref(0, "a"),))
+    base.update(kw)
+    return pn.JoinExec(**base)
+
+
+def test_join_unknown_type():
+    expect("join.type", _join(join_type="sideways"))
+
+
+def test_join_key_arity_mismatch():
+    expect("join.keys_arity", _join(left_keys=(ref(0), ref(1, "b", STR))))
+
+
+def test_join_key_out_of_range():
+    expect("boundref.range", _join(right_keys=(ref(9),)))
+
+
+def test_join_key_dtype_mismatch():
+    expect("join.key_dtype",
+           _join(right_keys=(ref(1, "d", STR),)))
+
+
+def test_join_residual_out_of_combined_range():
+    expect("boundref.range", _join(residual=eq(ref(4), lit(1))))
+
+
+# ---------------------------------------------------------------------------
+# runtime-filter edges
+# ---------------------------------------------------------------------------
+
+def _edge(fid=1, key=0, column=0, name="a", side="probe"):
+    return pn.RuntimeFilterTarget(fid, key, column, name, side)
+
+
+def _annotated_join(edge, scan_edge=None):
+    left = scan(F("a"), F("b", STR))
+    if scan_edge is not None:
+        left = dataclasses.replace(left, runtime_filters=(scan_edge,))
+    return pn.JoinExec(left, scan(F("a")), "inner",
+                       (ref(0, "a"),), (ref(0, "a"),),
+                       runtime_filters=(edge,))
+
+
+def test_rtf_bad_side():
+    expect("rtf.side",
+           _annotated_join(_edge(side="sideways"), _edge()),
+           after="runtime_filters")
+
+
+def test_rtf_key_ordinal_out_of_range():
+    expect("rtf.key", _annotated_join(_edge(key=3), _edge()),
+           after="runtime_filters")
+
+
+def test_rtf_dangling_edge():
+    # join names fid 1 but no scan in the probe subtree carries it
+    expect("rtf.dangling", _annotated_join(_edge(fid=1)),
+           after="runtime_filters")
+
+
+def test_rtf_orphan_scan_edge():
+    # scan carries fid 7; no join in the plan claims it
+    orphan = dataclasses.replace(scan(F("a")),
+                                 runtime_filters=(_edge(fid=7),))
+    expect("rtf.orphan", pn.FilterExec(orphan, eq(ref(0, "a"), lit(1))),
+           after="runtime_filters")
+
+
+def test_rtf_scan_column_out_of_range():
+    expect("rtf.column",
+           _annotated_join(_edge(), _edge(column=5)),
+           after="runtime_filters")
+
+
+def test_rtf_scan_column_name_mismatch():
+    expect("rtf.column",
+           _annotated_join(_edge(), _edge(column=1, name="zzz")),
+           after="runtime_filters")
+
+
+# ---------------------------------------------------------------------------
+# scans after prune_columns remapping
+# ---------------------------------------------------------------------------
+
+def test_scan_projection_unknown_name():
+    expect("scan.projection",
+           scan(F("a"), F("b", STR), projection=("a", "dropped")))
+
+
+def test_scan_projection_duplicate_names():
+    expect("scan.duplicate_names",
+           scan(F("a"), F("b", STR), projection=("a", "a")))
+
+
+def test_scan_predicate_ref_out_of_projected_range():
+    expect("scan.predicates",
+           scan(F("a"), F("b", STR), projection=("a",),
+                predicates=(eq(ref(1, "b", STR), lit("x", STR)),)))
+
+
+def test_scan_runtime_predicate_ref_out_of_range():
+    expect("scan.runtime_predicates",
+           scan(F("a"), runtime_predicates=(eq(ref(2), lit(1)),)))
+
+
+# ---------------------------------------------------------------------------
+# aggregates / unions / windows / limits
+# ---------------------------------------------------------------------------
+
+def test_agg_group_index_out_of_range():
+    expect("agg.group_range",
+           pn.AggregateExec(scan(F("a")), (4,), (), ("g",)))
+
+
+def test_agg_arg_out_of_range():
+    expect("agg.arg_range",
+           pn.AggregateExec(scan(F("a")), (), (pn.AggSpec("sum", 3),),
+                            ("s",)))
+
+
+def test_agg_out_names_arity():
+    expect("agg.out_names",
+           pn.AggregateExec(scan(F("a")), (0,),
+                            (pn.AggSpec("count", None),), ("only_one",
+                                                           "x", "y")))
+
+
+def test_union_arity_mismatch():
+    expect("union.arity",
+           pn.UnionExec((scan(F("a")), scan(F("a"), F("b", STR)))))
+
+
+def test_union_dtype_mismatch():
+    expect("union.dtype",
+           pn.UnionExec((scan(F("a", INT)), scan(F("a", STR)))))
+
+
+def test_window_out_names_arity():
+    expect("window.out_names",
+           pn.WindowExec(scan(F("a")),
+                         (pn.WindowSpec("row_number"),), ()))
+
+
+def test_limit_negative():
+    expect("limit.negative", pn.LimitExec(scan(F("a")), limit=-2))
+
+
+# ---------------------------------------------------------------------------
+# optimizer integration: the error names the pass that broke the plan
+# ---------------------------------------------------------------------------
+
+def test_optimizer_names_offending_pass(monkeypatch):
+    from sail_tpu.plan import optimizer as opt
+
+    def breaking_prune(p):
+        return pn.FilterExec(p, eq(ref(99), lit(1)))
+
+    monkeypatch.setattr(opt, "prune_columns", breaking_prune)
+    good = pn.FilterExec(scan(F("a")), eq(ref(0, "a"), lit(1)))
+    with pytest.raises(PlanInvariantError) as ei:
+        opt.optimize(good, validate="full")
+    assert ei.value.after == "prune_columns"
+    assert ei.value.invariant == "boundref.range"
+
+
+def test_validation_off_skips_checks():
+    from sail_tpu.plan import optimizer as opt
+    bad = pn.FilterExec(scan(F("a")), eq(ref(0, "a"), lit(1)))
+    # a plan whose optimized form would fail cannot be built here, but
+    # "off" must at least not pay the validator on a good plan
+    opt.optimize(bad, validate="off")
+
+
+# ---------------------------------------------------------------------------
+# stage boundaries (exec/job_graph.py)
+# ---------------------------------------------------------------------------
+
+def _join_plan():
+    rows = list(range(400))
+    left = pa.table({"a": rows, "b": [f"s{i}" for i in rows]})
+    right = pa.table({"a": rows, "d": rows})
+    return pn.JoinExec(
+        scan(F("a", LONG), F("b", STR), source=left),
+        scan(F("a", LONG), F("d", LONG), source=right),
+        "inner", (ref(0, "a", LONG),), (ref(0, "a", LONG),))
+
+
+@pytest.fixture()
+def join_graph(monkeypatch):
+    """A SHUFFLE-exchange graph (broadcast disabled so both join sides
+    hash-partition)."""
+    from sail_tpu.exec import job_graph as jg
+    monkeypatch.setattr(jg, "BROADCAST_ROW_LIMIT", 0)
+    graph = jg.split_job(_join_plan(), 2)
+    assert graph is not None
+    return graph
+
+
+def _expect_graph(invariant, graph):
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_job_graph(graph)
+    assert ei.value.invariant == invariant, str(ei.value)
+    assert ei.value.after == "split_job"
+
+
+def test_job_graph_valid(join_graph):
+    validate_job_graph(join_graph)  # no raise
+
+
+def test_stage_input_schema_arity_drift(join_graph):
+    from sail_tpu.exec.job_graph import StageInputExec
+    root = join_graph.root
+    leaf = next(n for n in pn.walk_plan(root.plan)
+                if isinstance(n, StageInputExec))
+    broken = dataclasses.replace(
+        leaf, out_schema=tuple(leaf.out_schema) + (F("phantom"),))
+    root.plan = broken if root.plan is leaf else _swap(root.plan, leaf,
+                                                       broken)
+    _expect_graph("stage.input_schema", join_graph)
+
+
+def test_stage_shuffle_channel_count_drift(join_graph):
+    producer = next(s for s in join_graph.stages
+                    if s.shuffle_keys is not None)
+    producer.num_channels = 1  # consumer still runs 2 tasks
+    _expect_graph("stage.channels", join_graph)
+
+
+def test_stage_unknown_input(join_graph):
+    from sail_tpu.exec.job_graph import InputMode, StageInput
+    root = join_graph.root
+    root.inputs = (StageInput(99, InputMode.MERGE),)
+    _expect_graph("stage.unknown_input", join_graph)
+
+
+def test_stage_shuffle_key_out_of_range(join_graph):
+    producer = next(s for s in join_graph.stages
+                    if s.shuffle_keys is not None)
+    producer.shuffle_keys = (17,)
+    _expect_graph("stage.shuffle_keys", join_graph)
+
+
+def test_stage_broadcast_multi_partition():
+    from sail_tpu.exec import job_graph as jg
+    graph = jg.split_job(_join_plan(), 2)
+    assert graph is not None
+    validate_job_graph(graph)  # broadcast build side: valid as built
+    consumer = next(
+        s for s in graph.stages
+        if any(i.mode == jg.InputMode.BROADCAST for i in s.inputs))
+    producer_id = next(i.stage_id for i in consumer.inputs
+                       if i.mode == jg.InputMode.BROADCAST)
+    producer = next(s for s in graph.stages
+                    if s.stage_id == producer_id)
+    producer.num_partitions = 3  # a broadcast producer must be 1 task
+    _expect_graph("stage.channels", graph)
+
+
+def _swap(plan, target, replacement):
+    from sail_tpu.exec.job_graph import _replace_subtree
+    return _replace_subtree(plan, target, replacement)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: real suites validate clean, and the profile shows it
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spark_full_validation():
+    from sail_tpu import SparkSession
+    return SparkSession({"spark.sail.analysis.validatePlans": "full"})
+
+
+def test_tpch_resolves_with_zero_violations(spark_full_validation):
+    from sail_tpu.benchmarks.tpch_data import generate_tpch
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+    spark = spark_full_validation
+    for name, table in generate_tpch(sf=0.002, seed=11).items():
+        spark.createDataFrame(table).createOrReplaceTempView(name)
+    for qid in sorted(QUERIES):
+        spark._resolve(spark.sql(QUERIES[qid])._plan)  # raises on drift
+
+
+def test_clickbench_resolves_with_zero_violations(spark_full_validation):
+    from sail_tpu.benchmarks import clickbench as cb
+    spark = spark_full_validation
+    cb.register_hits(spark, n_rows=200, seed=5)
+    for q in cb.load_queries():
+        spark._resolve(spark.sql(q)._plan)  # raises on drift
+
+
+def test_profile_reports_validated_passes(spark_full_validation):
+    from sail_tpu import profiler
+    spark = spark_full_validation
+    t = pa.table({"a": [1, 2, 3]})
+    spark.createDataFrame(t).createOrReplaceTempView("tv")
+    spark.sql("SELECT sum(a) FROM tv").toPandas()
+    prof = profiler.last_profile()
+    assert prof is not None
+    # resolve + 5 optimizer passes, at minimum
+    assert prof.validated_passes >= 6
+    out = spark.sql("EXPLAIN ANALYZE SELECT sum(a) FROM tv").toPandas()
+    assert "validated:" in out["plan"][0]

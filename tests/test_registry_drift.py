@@ -1,81 +1,68 @@
 """Registry-drift static check: every metric name recorded anywhere in
 sail_tpu/ must be declared in metrics_registry.yaml, and every declared
 instrument must have at least one call site — declarations cannot drift
-from the code."""
+from the code.
 
-import os
-import re
+Implemented over the shared lint framework
+(``sail_tpu/analysis/lints.py``, lint id ``metrics``): these tests keep
+their historical names/IDs, and the same checks also run through
+``scripts/sail_lint.py`` and ``tests/test_lints.py``.
+"""
 
-import yaml
+from sail_tpu.analysis import lints
 
-SRC_ROOT = os.path.join(os.path.dirname(__file__), os.pardir, "sail_tpu")
-REGISTRY_PATH = os.path.join(SRC_ROOT, "metrics_registry.yaml")
-
-# first string-literal argument of record(...) / _record_metric(...);
-# metric names are always dotted, which keeps unrelated record() calls
-# (e.g. SystemRegistry.record_task) out of the match
-_CALL_RE = re.compile(
-    r"(?:\b_record_metric|\brecord)\(\s*[\"']([a-z0-9_]+(?:\.[a-z0-9_]+)+)[\"']")
-# any dotted metric-ish string literal (covers conditional expressions
-# like record("a.hit" if hit else "a.miss", ...) for the orphan check)
-_LITERAL_RE = re.compile(r"[\"']([a-z0-9_]+(?:\.[a-z0-9_]+)+)[\"']")
+CTX = lints.LintContext()
 
 
-def _iter_sources():
-    for dirpath, _dirnames, filenames in os.walk(SRC_ROOT):
-        for fn in filenames:
-            if fn.endswith(".py"):
-                path = os.path.join(dirpath, fn)
-                with open(path, "r", encoding="utf-8") as f:
-                    yield path, f.read()
+def _violations():
+    return lints.lint_metrics(CTX)
 
 
-def _declared_names():
-    with open(REGISTRY_PATH, "r", encoding="utf-8") as f:
-        entries = yaml.safe_load(f) or []
-    return {e["name"] for e in entries}
+def _registry_entries():
+    return lints.load_metric_registry(CTX)
 
 
 def test_every_recorded_metric_is_declared():
-    declared = _declared_names()
-    undeclared = {}
-    for path, src in _iter_sources():
-        for name in _CALL_RE.findall(src):
-            if name not in declared:
-                undeclared.setdefault(name, []).append(
-                    os.path.relpath(path, SRC_ROOT))
+    undeclared = [v for v in _violations()
+                  if "not declared" in v.message]
     assert not undeclared, (
-        f"metric names recorded but not declared in "
-        f"metrics_registry.yaml: {undeclared}")
+        "metric names recorded but not declared in "
+        "metrics_registry.yaml: "
+        + "; ".join(v.render() for v in undeclared))
 
 
 def test_no_orphan_registry_entries():
-    declared = _declared_names()
-    used = set()
-    for _path, src in _iter_sources():
-        used.update(_LITERAL_RE.findall(src))
-    orphans = declared - used
+    orphans = [v for v in _violations()
+               if "never recorded" in v.message]
     assert not orphans, (
-        f"metrics declared in metrics_registry.yaml but never recorded "
-        f"anywhere under sail_tpu/: {sorted(orphans)}")
+        "metrics declared in metrics_registry.yaml but never recorded "
+        "anywhere under sail_tpu/: "
+        + "; ".join(v.render() for v in orphans))
 
 
 def test_registry_loads_and_names_are_unique():
-    with open(REGISTRY_PATH, "r", encoding="utf-8") as f:
-        entries = yaml.safe_load(f) or []
+    entries = _registry_entries()
+    assert entries, "metrics_registry.yaml missing or empty"
     names = [e["name"] for e in entries]
     assert len(names) == len(set(names))
     for e in entries:
         assert e.get("type") in ("counter", "gauge"), e
 
 
+def test_record_call_site_attribute_sets():
+    """Extended drift check: every record()/_record_metric() call site's
+    keyword attributes are a subset of the declaration, and every
+    declared attribute is passed by at least one call site."""
+    attr_drift = [v for v in _violations()
+                  if "attribute" in v.message]
+    assert not attr_drift, "; ".join(v.render() for v in attr_drift)
+
+
 def test_fault_tolerance_counters_declared():
     """The hardened-cluster instruments exist with the exact attribute
     sets the call sites use (cluster retries, speculation, quarantine,
     RPC backoff, fault injection)."""
-    with open(REGISTRY_PATH, "r", encoding="utf-8") as f:
-        entries = yaml.safe_load(f) or []
-    by_name = {e["name"]: e for e in entries}
+    by_name = {e["name"]: e for e in _registry_entries()}
     expected = {
         "cluster.task.retry_count": ["reason"],
         "cluster.task.speculative_launched": [],
