@@ -19,5 +19,6 @@ from .invariants import (  # noqa: F401
     PlanInvariantError,
     validate_job_graph,
     validate_plan,
+    validate_stage_split,
     validation_mode,
 )

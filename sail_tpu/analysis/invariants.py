@@ -467,6 +467,61 @@ def validate_plan(plan, *, after: str = "resolve") -> None:
 
 
 # ---------------------------------------------------------------------------
+# fused-stage validation (plan/stages.py)
+# ---------------------------------------------------------------------------
+
+def validate_stage_split(plan, split) -> None:
+    """The fused-stage invariant: the stage splitter must place every
+    plan node in exactly one stage, and pipeline breakers may appear
+    only at stage edges — a stage's interior (everything below its
+    root) is exclusively Filter/Project operators and source leaves, so
+    fusing a stage into one program can never swallow a materialization
+    point."""
+    pn, _rx = _mods()
+    from ..plan import stages as st
+
+    seen: Dict[int, int] = {}
+    for stage in split.stages:
+        if not stage.nodes or stage.nodes[0] is not stage.root:
+            raise PlanInvariantError(
+                "fusion.root",
+                f"stage {stage.sid} nodes do not start at its root",
+                node=stage.root, after="split_stages")
+        for node in stage.nodes:
+            if id(node) in seen:
+                raise PlanInvariantError(
+                    "fusion.duplicate",
+                    f"{type(node).__name__} assigned to both stage "
+                    f"{seen[id(node)]} and stage {stage.sid}",
+                    node=node, after="split_stages")
+            seen[id(node)] = stage.sid
+        for node in stage.nodes[1:]:
+            if not (isinstance(node, st.FUSABLE_OPS) or st.is_leaf(node)):
+                raise PlanInvariantError(
+                    "fusion.interior_breaker",
+                    f"{type(node).__name__} (a pipeline breaker) sits "
+                    f"inside stage {stage.sid} instead of at a stage "
+                    f"edge", node=node, after="split_stages")
+        # connectivity: every non-root member hangs off another member
+        # (a disconnected member would be compiled into a program whose
+        # dataflow never reaches it)
+        for node in stage.nodes[1:]:
+            if not any(any(c is node for c in m.children)
+                       for m in stage.nodes if m is not node):
+                raise PlanInvariantError(
+                    "fusion.disconnected",
+                    f"stage {stage.sid} member {type(node).__name__} "
+                    f"is not a child of any other stage member",
+                    node=node, after="split_stages")
+    for node in pn.walk_plan(plan):
+        if id(node) not in seen:
+            raise PlanInvariantError(
+                "fusion.coverage",
+                f"{type(node).__name__} is in no stage", node=node,
+                after="split_stages")
+
+
+# ---------------------------------------------------------------------------
 # stage-boundary validation (exec/job_graph.py)
 # ---------------------------------------------------------------------------
 

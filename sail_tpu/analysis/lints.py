@@ -475,9 +475,10 @@ class _QualnameVisitor(ast.NodeVisitor):
 
 def sync_points(ctx: LintContext) -> List[Tuple[str, str, str, int]]:
     """(relpath, qualname, attr, line) of every sync-forcing call in
-    exec/ and ops/."""
+    exec/, ops/, plan/ (the stage splitter/compiler must introduce no
+    unreviewed host syncs) and native/ (host-kernel argument prep)."""
     out = []
-    for relpath in ctx.python_sources("exec", "ops"):
+    for relpath in ctx.python_sources("exec", "ops", "plan", "native"):
         tree = ctx.tree(relpath)
         if tree is None:
             continue

@@ -52,9 +52,16 @@ def get(key: str, default: Any = None) -> Any:
     return app_config().get(key, default)
 
 
-def truthy(key: str, default: Any = "true") -> bool:
-    """Boolean config key: everything except 0/false/no/off (in any
-    case) is on. The one parser every gate shares, so the accepted
+def truthy_value(value: Any, default: Any = "true") -> bool:
+    """Boolean parse of an already-fetched value (session conf, env):
+    everything except 0/false/no/off (any case) is on; None falls back
+    to ``default``. The one parser every gate shares, so the accepted
     falsy spellings cannot drift between call sites."""
-    return str(get(key, default)).strip().lower() \
-        not in ("0", "false", "no", "off")
+    if value is None:
+        value = default
+    return str(value).strip().lower() not in ("0", "false", "no", "off")
+
+
+def truthy(key: str, default: Any = "true") -> bool:
+    """Boolean config key (see :func:`truthy_value`)."""
+    return truthy_value(get(key, default))

@@ -419,10 +419,13 @@ class _Builder:
                 None, a.ignore_nulls))
         final = pn.AggregateExec(f_in, tuple(range(nk)), tuple(final_aggs),
                                  tuple(p.out_names), p.max_groups_hint)
+        # a GLOBAL aggregate (no group keys) must merge on exactly one
+        # final task: every partial routes to channel 0, and extra final
+        # partitions would each synthesize a spurious empty-input row
         return self._add(Stage(
             len(self.stages), final,
             (StageInput(child.stage_id, InputMode.SHUFFLE),),
-            self.nparts))
+            self.nparts if nk else 1))
 
     def _build_distinct_aggregate(self, p: pn.AggregateExec
                                   ) -> Optional[Stage]:
@@ -457,7 +460,7 @@ class _Builder:
         return self._add(Stage(
             len(self.stages), final,
             (StageInput(child.stage_id, InputMode.SHUFFLE),),
-            self.nparts))
+            self.nparts if nk else 1))
 
 
 def _plain_key_indices(keys) -> Optional[Tuple[int, ...]]:
@@ -513,10 +516,20 @@ def _maybe_validate_graph(graph: JobGraph) -> None:
     like the other cluster gates, use SAIL_ANALYSIS__VALIDATE_PLANS to
     override); the walk rides the query profile's validated count."""
     from ..analysis.invariants import (VALIDATE_OFF, validate_job_graph,
+                                       validate_stage_split,
                                        validation_mode)
     if validation_mode() == VALIDATE_OFF:
         return
     validate_job_graph(graph)
+    # fused-stage invariant per cluster stage: every job-graph stage's
+    # plan must split cleanly into pipelines (the worker's fused
+    # executor maps them 1:1 onto compiled programs), so a stage whose
+    # interior hides a breaker surfaces here — before any task ships
+    from ..config import truthy as _on
+    if _on("execution.fusion.enabled"):
+        from ..plan.stages import split_stages
+        for stage in graph.stages:
+            validate_stage_split(stage.plan, split_stages(stage.plan))
     try:
         from .. import profiler
         profiler.note_plan_validated()
@@ -778,11 +791,13 @@ def hash_partition_table(table, key_columns, num_channels: int):
     """Split an arrow table into hash channels on the key columns.
 
     Value-based (dictionary-safe) deterministic hashing so producers on
-    different workers route equal keys to the same channel."""
+    different workers route equal keys to the same channel. ZERO key
+    columns (a global aggregate's partial stage) route every row to
+    channel 0: the single final task consumes exactly one channel."""
     import numpy as np
     import pandas as pd
 
-    if table.num_rows == 0 or num_channels <= 1:
+    if table.num_rows == 0 or num_channels <= 1 or not key_columns:
         return [table] + [table.slice(0, 0)] * (num_channels - 1)
     keys = table.select(list(key_columns)).to_pandas()
     h = pd.util.hash_pandas_object(keys, index=False).values

@@ -345,10 +345,12 @@ class _OpCache:
         return value
 
 
-def _compile_timed(fn, key):
+def _compile_timed(fn, key, fused=False):
     """Wrap a jitted fn so its FIRST call — where tracing and XLA
     compilation actually happen (jax.jit itself is lazy) — is timed and
-    charged to the query that missed the operator cache."""
+    charged to the query that missed the operator cache. ``fused`` marks
+    whole-stage programs: their compile time additionally rides
+    ``execution.fusion.compile_time``."""
     import time as _time
 
     from .. import profiler
@@ -360,10 +362,12 @@ def _compile_timed(fn, key):
             del pending[:]
             t0 = _time.perf_counter()
             out = fn(*args, **kwargs)
+            elapsed = _time.perf_counter() - t0
             key_repr = repr(key[0]) if isinstance(key, tuple) and key \
                 else repr(key)
-            profiler.note_compile_time(_time.perf_counter() - t0,
-                                       key=key_repr)
+            profiler.note_compile_time(elapsed, key=key_repr)
+            if fused:
+                _record_metric("execution.fusion.compile_time", elapsed)
             return out
         return fn(*args, **kwargs)
 
@@ -435,6 +439,49 @@ class LocalExecutor:
         # runtime join filters: per-fid (rows_before, rows_after) scan
         # pruning observed while executing this plan (adaptive feedback)
         self._rtf_scan_stats: Dict[int, Tuple[int, int]] = {}
+        # whole-stage fusion gate, resolved once per executor
+        self._fusion: Optional[bool] = None
+
+    def _fusion_on(self) -> bool:
+        """``spark.sail.execution.fusion.enabled`` (session conf) over
+        ``execution.fusion.enabled`` (app config), default on. Off
+        restores pre-fusion per-operator execution for A/B and
+        bisection."""
+        if self._fusion is None:
+            from ..plan.stages import fusion_enabled
+            self._fusion = fusion_enabled(
+                self.config.get("spark.sail.execution.fusion.enabled"))
+        return self._fusion
+
+    def _note_stage_split(self, plan: pn.PlanNode) -> None:
+        """Stage-split accounting + the fused-stage invariant walk (the
+        splitter's output drives this query's fusion decisions, so a bad
+        split must surface here, not as a wrong answer)."""
+        from .. import profiler
+        from ..analysis.invariants import (VALIDATE_OFF,
+                                           validate_stage_split,
+                                           validation_mode)
+        from ..plan import stages as pst
+
+        split = pst.split_stages(plan)
+        _record_metric("execution.fusion.stage_count", len(split.stages))
+        fused_ops = split.fused_op_count
+        if fused_ops:
+            _record_metric("execution.fusion.fused_op_count", fused_ops)
+        profiler.note_fusion(stages=len(split.stages),
+                             fused_ops=fused_ops)
+        mode = validation_mode(
+            self.config.get("spark.sail.analysis.validatePlans"))
+        if mode != VALIDATE_OFF:
+            validate_stage_split(plan, split)
+            profiler.note_plan_validated()
+
+    def _note_fusion_fallback(self, site: str) -> None:
+        """One pipeline declined whole-stage fusion at execution time
+        (host-only expressions etc.) and ran per-op instead."""
+        from .. import profiler
+        _record_metric("execution.fusion.fallback_count", 1, site=site)
+        profiler.note_fusion(fallbacks=1)
 
     # ------------------------------------------------------------------
     def execute(self, plan: pn.PlanNode) -> pa.Table:
@@ -449,6 +496,8 @@ class LocalExecutor:
         nested = prof is not None and prof.is_open("execute")
         with profiler.maybe_phase("execute"):
             self._pre_eval_subqueries(plan)
+            if self._fusion_on():
+                self._note_stage_split(plan)
             batch = self.run(plan)
         with contextlib.nullcontext() if nested \
                 else profiler.maybe_phase("fetch"):
@@ -540,7 +589,7 @@ class LocalExecutor:
         except TypeError:
             return None
 
-    def _jitted(self, key, dict_objs: Tuple, builder):
+    def _jitted(self, key, dict_objs: Tuple, builder, fused=False):
         """Returns (fn, aux) where fn is jit-compiled and cached when the
         key is hashable, else built fresh and run eagerly.
 
@@ -548,7 +597,8 @@ class LocalExecutor:
         (``execution.compile.{cache_hit_count,cache_miss_count}`` and the
         active query profile); a miss additionally times the jitted
         program's FIRST invocation — where jax traces and XLA compiles —
-        as ``execution.compile.compile_time``."""
+        as ``execution.compile.compile_time`` (and, for whole-stage
+        fused programs, ``execution.fusion.compile_time``)."""
         import jax
 
         from .. import profiler
@@ -562,7 +612,7 @@ class LocalExecutor:
         def build():
             missed.append(True)
             fn, aux = builder()
-            return _compile_timed(jax.jit(fn), key), aux
+            return _compile_timed(jax.jit(fn), key, fused=fused), aux
 
         missed: list = []
         value = _OP_CACHE.get(key, dict_objs, build)
@@ -734,7 +784,14 @@ class LocalExecutor:
     # unary operators
     # ------------------------------------------------------------------
     def _exec_ProjectExec(self, p: pn.ProjectExec) -> HostBatch:
-        child = self.run(p.input)
+        if self._fusion_on():
+            out = self._try_fused_chain(p)
+            if out is not None:
+                return out
+        return self._project_over(p, self.run(p.input))
+
+    def _project_over(self, p: pn.ProjectExec, child: HostBatch
+                      ) -> HostBatch:
         dev = child.device
         if not p.exprs:  # SELECT of zero columns
             return HostBatch(DeviceBatch({}, dev.sel), {})
@@ -1082,7 +1139,14 @@ class LocalExecutor:
         return self._udf_result_to_batch(outs, p.out_schema)
 
     def _exec_FilterExec(self, p: pn.FilterExec) -> HostBatch:
-        child = self.run(p.input)
+        if self._fusion_on():
+            out = self._try_fused_chain(p)
+            if out is not None:
+                return out
+        return self._filter_over(p, self.run(p.input))
+
+    def _filter_over(self, p: pn.FilterExec, child: HostBatch
+                     ) -> HostBatch:
         dev = child.device
 
         def builder():
@@ -1125,11 +1189,134 @@ class LocalExecutor:
         return HostBatch(out, child.dicts)
 
     def _exec_SortExec(self, p: pn.SortExec) -> HostBatch:
-        child = self.run(p.input)
-        spilled = self._try_external_sort(p, child)
-        if spilled is not None:
-            return spilled
+        chain: List[pn.PlanNode] = []
+        node = p.input
+        if self._fusion_on():
+            while isinstance(node, (pn.FilterExec, pn.ProjectExec)):
+                chain.append(node)
+                node = node.input
+        if not chain:
+            child = self.run(p.input)
+            spilled = self._try_external_sort(p, child)
+            if spilled is not None:
+                return spilled
+            return self._sort_over(p, child)
+        # pre-sort pipeline: chain + key eval + gather compile to ONE
+        # program. Out-of-core candidates (bounded by the BOTTOM batch's
+        # capacity, so no device sync decides this) materialize the
+        # chain first and keep the spill path byte-identical.
+        child = self.run(node)
+        if self._sort_may_spill(p, child):
+            mat = self._apply_chain(chain, child, node)
+            spilled = self._try_external_sort(p, mat)
+            if spilled is not None:
+                return spilled
+            return self._sort_over(p, mat)
+        return self._fused_sort(p, chain, child, node)
 
+    def _sort_may_spill(self, p: pn.SortExec, child: HostBatch) -> bool:
+        """Upper-bound spill check: capacity >= live rows, so a False
+        here is exact (the external sort could never engage) without
+        forcing a device sync on the hot path."""
+        from ..config import get as config_get
+        try:
+            threshold = int(config_get("execution.sort_spill_rows",
+                                       8_000_000))
+        except (TypeError, ValueError):
+            threshold = 8_000_000
+        if threshold <= 0 or not p.keys:
+            return False
+        if any(not isinstance(k.expr, rx.BoundRef) for k in p.keys):
+            return False
+        return child.device.capacity > threshold
+
+    def _fused_sort(self, p: pn.SortExec, chain: List[pn.PlanNode],
+                    child: HostBatch, bottom: pn.PlanNode) -> HostBatch:
+        from ..plan import stages as pst
+
+        key = self._op_key(
+            "fused_sort", pst.stage_fingerprint([p] + chain,
+                                                bottom.schema))
+
+        def builder():
+            chain_fn, top_dicts, top_schema = self._compile_chain(
+                chain, child, bottom)
+            top_schema = tuple(top_schema)
+            comp = ExprCompiler(
+                [f.dtype for f in top_schema],
+                {i: top_dicts[_col_name(i)]
+                 for i in range(len(top_schema))
+                 if _col_name(i) in top_dicts},
+                self._subquery_cache)
+            compiled = [(comp.compile(k.expr), k) for k in p.keys]
+            rank_luts = []
+            for c, k in compiled:
+                rank_luts.append(
+                    jnp.asarray(ai.dictionary_ranks(c.dictionary))
+                    if c.dictionary is not None
+                    and len(c.dictionary) > 0 else None)
+
+            def fn(cols, sel):
+                pairs, sel2 = chain_fn(cols, sel)
+                cap = sel2.shape[0]
+                fitted = [_fit_capacity(d, v, cap) for d, v in pairs]
+                keys = []
+                for (c, k), lut in zip(compiled, rank_luts):
+                    data, validity = c.fn(fitted)
+                    kdt = rx.rex_type(k.expr)
+                    if lut is not None:
+                        data = lut[data]
+                        kdt = dt.IntegerType()
+                    keys.append((data, validity, kdt, k.ascending,
+                                 k.nulls_first))
+                perm = sortk.lexsort_perm(keys, sel2)
+                out_d = [d[perm] for d, _ in fitted]
+                out_v = [None if v is None else v[perm]
+                         for _, v in fitted]
+                out_sel = sel2[perm]
+                if p.limit is not None:
+                    idx = jnp.arange(out_sel.shape[0], dtype=jnp.int32)
+                    out_sel = out_sel & (idx < p.limit)
+                return out_d, out_v, out_sel
+
+            return fn, (top_dicts, top_schema)
+
+        from .. import telemetry as tel
+        try:
+            fn, aux = self._jitted(key, self._dict_objs(child), builder,
+                                   fused=True)
+        except HostFallback:
+            # count the declined pipeline ONCE and apply the chain
+            # per-op directly — re-attempting the fused chain program
+            # here would recompile the same failing bind a second time
+            self._note_fusion_fallback("sort")
+            mat = child
+            for op in reversed(chain):
+                mat = self._apply_op(op, mat)
+            return self._sort_over(p, mat)
+
+        def finish():
+            top_dicts, top_schema = aux
+            out_d, out_v, out_sel = fn(self._cols(child),
+                                       child.device.sel)
+            cols = {_col_name(i): Column(d, v, f.dtype)
+                    for i, (d, v, f) in enumerate(
+                        zip(out_d, out_v, top_schema))}
+            out = DeviceBatch(cols, out_sel)
+            if p.limit is not None:
+                out = _shrink(out, p.limit)
+            return HostBatch(out, top_dicts)
+
+        if tel.current_collector() is not None:
+            ops = "+".join(type(n).__name__ for n in chain)
+            with tel.operator_span("FusedSort", ops) as m:
+                out = finish()
+                m.output_rows = int(out.device.num_rows())
+                m.capacity = out.capacity
+                return out
+        return finish()
+
+    def _sort_over(self, p: pn.SortExec, child: HostBatch) -> HostBatch:
         def builder():
             comp = self._compiler(child, p.input.schema)
             compiled = [(comp.compile(k.expr), k) for k in p.keys]
@@ -1237,6 +1424,108 @@ class LocalExecutor:
         child = self.run(node)
         return chain, child, node
 
+    # -- whole-stage fusion: standalone pipeline stages -----------------
+    def _try_fused_chain(self, top: pn.PlanNode) -> Optional[HostBatch]:
+        """Execute a maximal Filter/Project pipeline as ONE jitted
+        program (the ``pipeline`` stage of ``plan/stages.py``): the
+        chain's intermediates never materialize between operators.
+        Returns None when the chain is trivial (single operator — the
+        per-op path already compiles one program) or needs host
+        evaluation (the caller falls back per-op, which re-enters
+        fusion on the shorter sub-chains)."""
+        from .. import telemetry as tel
+
+        chain: List[pn.PlanNode] = []
+        node = top
+        while isinstance(node, (pn.FilterExec, pn.ProjectExec)):
+            chain.append(node)
+            node = node.input
+        if len(chain) < 2:
+            return None
+        child = self.run(node)
+        try:
+            if tel.current_collector() is not None:
+                # aborted spans (HostFallback) are discarded by
+                # operator_span, so the fallback run reports cleanly
+                ops = "+".join(type(n).__name__ for n in chain)
+                with tel.operator_span("FusedPipeline", ops) as m:
+                    out = self._run_chain(chain, child, node)
+                    m.output_rows = int(out.device.num_rows())
+                    m.capacity = out.capacity
+                    return out
+            return self._run_chain(chain, child, node)
+        except HostFallback:
+            # per-op over the ALREADY-materialized bottom batch: falling
+            # all the way back through run() would re-execute the input
+            # subtree once per chain suffix (and over-count fallbacks)
+            self._note_fusion_fallback("pipeline")
+            out = child
+            for op in reversed(chain):
+                out = self._apply_op(op, out)
+            return out
+
+    def _run_chain(self, chain: List[pn.PlanNode], child: HostBatch,
+                   bottom: pn.PlanNode) -> HostBatch:
+        """One compiled program for a Filter/Project pipeline over an
+        already-materialized bottom batch. Raises HostFallback when any
+        chain expression needs host evaluation."""
+        from ..plan import stages as pst
+
+        key = self._op_key(
+            "fused_chain", pst.stage_fingerprint(chain, bottom.schema))
+
+        def builder():
+            chain_fn, out_dicts, out_schema = self._compile_chain(
+                chain, child, bottom)
+            return chain_fn, (out_dicts, tuple(out_schema))
+
+        fn, aux = self._jitted(key, self._dict_objs(child), builder,
+                               fused=True)
+        out_dicts, out_schema = aux
+        cols2, sel2 = fn(self._cols(child), child.device.sel)
+        if not any(isinstance(n, pn.ProjectExec) for n in chain):
+            # filter-only pipeline: the batch's columns are untouched
+            return HostBatch(child.device.with_sel(sel2), child.dicts)
+        cap = child.device.sel.shape[0]
+        out_cols: Dict[str, Column] = {}
+        for i, ((d, v), f) in enumerate(zip(cols2, out_schema)):
+            d, v = _fit_capacity(d, v, cap)
+            out_cols[_col_name(i)] = Column(d, v, f.dtype)
+        return HostBatch(DeviceBatch(out_cols, sel2), out_dicts)
+
+    def _apply_op(self, op: pn.PlanNode, batch: HostBatch) -> HostBatch:
+        """One Filter/Project over a given batch, with an operator span
+        under EXPLAIN ANALYZE (these don't pass through ``run``)."""
+        from .. import telemetry as tel
+
+        def go():
+            if isinstance(op, pn.FilterExec):
+                return self._filter_over(op, batch)
+            return self._project_over(op, batch)
+
+        if tel.current_collector() is not None:
+            with tel.operator_span(type(op).__name__) as m:
+                out = go()
+                m.output_rows = int(out.device.num_rows())
+                m.capacity = out.capacity
+                return out
+        return go()
+
+    def _apply_chain(self, chain: List[pn.PlanNode], child: HostBatch,
+                     bottom: pn.PlanNode) -> HostBatch:
+        """Materialize a chain's output over ``child``: the fused
+        program when it compiles, per-operator evaluation otherwise."""
+        if not chain:
+            return child
+        try:
+            return self._run_chain(chain, child, bottom)
+        except HostFallback:
+            self._note_fusion_fallback("pipeline")
+            out = child
+            for op in reversed(chain):
+                out = self._apply_op(op, out)
+            return out
+
     def _compile_chain(self, chain, bottom: HostBatch, bottom_node: pn.PlanNode):
         """Returns (chain_fn, out_dicts, out_schema): chain_fn maps the
         bottom batch's (cols, sel) to the top of the chain's (cols, sel).
@@ -1337,6 +1626,7 @@ class LocalExecutor:
             except HostFallback:
                 # the fused attempt aborted (span discarded): run and
                 # profile the actual unfused program instead
+                self._note_fusion_fallback("aggregate")
                 child = self.run(chain[0])
                 with tel.operator_span("AggregateExec",
                                        "unfused (host fallback)") as m:
@@ -1353,6 +1643,7 @@ class LocalExecutor:
             # chains needing host evaluation (string UDFs, host-only casts)
             # cannot fuse — run the chain operators unfused instead
             if chain:
+                self._note_fusion_fallback("aggregate")
                 child = self.run(chain[0])
             return self._agg_with_chain(p, [], child, p.input)
 
@@ -1365,9 +1656,8 @@ class LocalExecutor:
         else:
             max_groups = 1
 
-        chain_key = tuple((type(n).__name__,
-                           n.condition if isinstance(n, pn.FilterExec) else n.exprs)
-                          for n in chain)
+        from ..plan import stages as pst
+        stage_key = pst.stage_fingerprint([p] + chain, bottom_node.schema)
 
         def make_builder(mg):
             def builder():
@@ -1449,20 +1739,19 @@ class LocalExecutor:
 
         import jax
 
-        key = self._op_key("agg", chain_key, p.group_indices, p.aggs, max_groups,
-                           tuple((f.name, f.dtype) for f in bottom_node.schema))
+        key = self._op_key("agg", stage_key, max_groups)
         fn, top_dicts = self._jitted(key, self._dict_objs(child),
-                                     make_builder(max_groups))
+                                     make_builder(max_groups),
+                                     fused=bool(chain))
         gk, aggs_out, gsel, n_groups, overflow = fn(self._cols(child), dev.sel)
         # one batched fetch: each blocking scalar read is a full round trip
         # on a remote accelerator
         n_groups, overflow = jax.device_get((n_groups, overflow))
         if p.max_groups_hint and bool(overflow):
-            key2 = self._op_key("agg2", chain_key, p.group_indices, p.aggs,
-                                dev.capacity,
-                                tuple((f.name, f.dtype) for f in bottom_node.schema))
+            key2 = self._op_key("agg2", stage_key, dev.capacity)
             fn2, top_dicts = self._jitted(key2, self._dict_objs(child),
-                                          make_builder(dev.capacity))
+                                          make_builder(dev.capacity),
+                                          fused=bool(chain))
             gk, aggs_out, gsel, n_groups, overflow = fn2(self._cols(child), dev.sel)
             n_groups = jax.device_get(n_groups)
         out_cols: Dict[str, Column] = {}
@@ -2241,6 +2530,10 @@ class LocalExecutor:
             return None
         if getattr(self, "_in_join_spill", False):
             return None  # partition pairs run the in-memory join
+        if left.device.capacity + right.device.capacity <= threshold:
+            # capacities bound live rows: the spill could never engage —
+            # skip the per-join device round trip entirely
+            return None
         import jax
         n_left, n_right = jax.device_get(  # ONE round trip, not two
             (jnp.sum(left.device.sel), jnp.sum(right.device.sel)))
@@ -2399,6 +2692,10 @@ class LocalExecutor:
         for k in p.keys:
             if not isinstance(k.expr, rx.BoundRef):
                 return None  # expression keys stay on the in-memory path
+        if child.device.capacity <= threshold:
+            # capacity bounds live rows: the spill could never engage, so
+            # skip the device round trip the exact count would cost
+            return None
         import jax
         n = int(jax.device_get(jnp.sum(child.device.sel)))
         if n <= threshold:

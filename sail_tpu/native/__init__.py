@@ -57,13 +57,10 @@ def try_native_agg(executor, p, chain, child, bottom_node):
         dev.columns[_col_name(i)].validity is not None
         for i in range(len(bottom_schema)))
 
+    from ..plan import stages as pst
     key = executor._op_key(
-        "native_agg",
-        tuple((type(n).__name__,
-               n.condition if hasattr(n, "condition") else n.exprs)
-              for n in chain),
-        p.group_indices, p.aggs, validity_present,
-        tuple((f.name, f.dtype) for f in bottom_schema))
+        "native_agg", pst.stage_fingerprint([p] + chain, bottom_schema),
+        validity_present)
     if key is None or key in _REJECTED:
         return None
 

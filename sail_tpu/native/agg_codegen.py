@@ -104,6 +104,21 @@ class AggCodegen:
         self.luts: List[np.ndarray] = []    # bind-time lookup tables
         self._tmp = 0
         self._arg_slot: Dict[object, int] = {}
+        # slot -> C element type for the hoisted __restrict pointer decls:
+        # the accumulator buffers are freshly allocated per call and can
+        # never alias the input columns, but the compiler cannot prove
+        # that through the void** indirection — without the hoisted
+        # restrict pointers every accumulator store forces the next
+        # column load to re-read memory (measured ~2x on TPC-H q1)
+        self._ptr_ctype: Dict[int, str] = {}
+        # expression CSE within one env generation (the resolver's
+        # pre-projection frequently repeats subexpressions, e.g. q1's
+        # extendedprice*(1-discount) feeding two aggregates)
+        self._emit_cache: Dict[object, Val] = {}
+        self._env_gen = 0
+        # CASE emission nests statements in C++ blocks; temps declared
+        # there are block-scoped and must not be CSE-reused outside
+        self._block_depth = 0
 
     # ---------------- argument slots ----------------
     def _slot(self, kind, payload) -> int:
@@ -115,18 +130,27 @@ class AggCodegen:
         self._arg_slot[key] = slot
         return slot
 
+    def _ptr(self, slot: int, ctype: str) -> str:
+        self._ptr_ctype[slot] = ctype
+        return f"a{slot}"
+
     def _col_ptr(self, idx: int, ctype: str) -> str:
-        slot = self._slot("col", idx)
-        return f"((const {ctype}*)data[{slot}])"
+        return self._ptr(self._slot("col", idx), ctype)
 
     def _validity_ptr(self, idx: int) -> str:
-        slot = self._slot("validity", idx)
-        return f"((const uint8_t*)data[{slot}])"
+        return self._ptr(self._slot("validity", idx), "uint8_t")
 
     def _lut_ptr(self, arr: np.ndarray, ctype: str) -> str:
         self.luts.append(arr)
-        slot = self._slot("lut", arr)
-        return f"((const {ctype}*)data[{slot}])"
+        return self._ptr(self._slot("lut", arr), ctype)
+
+    def ptr_decls(self) -> str:
+        """Hoisted ``const T* __restrict`` declarations for every input
+        array slot, emitted at the top of the row loop's function."""
+        return "\n  ".join(
+            f"const {ct}* __restrict a{slot} = "
+            f"(const {ct}*)data[{slot}];"
+            for slot, ct in sorted(self._ptr_ctype.items()))
 
     def _fresh(self, prefix="t") -> str:
         self._tmp += 1
@@ -134,6 +158,21 @@ class AggCodegen:
 
     # ---------------- expression emission ----------------
     def emit(self, r: rx.Rex, env: Dict[int, Val]) -> Val:
+        try:
+            key = (self._env_gen, r)
+            hit = self._emit_cache.get(key)
+        except TypeError:
+            key = None
+            hit = None
+        if hit is not None:
+            return hit
+        v = self._emit(r, env)
+        if key is not None and self._block_depth == 0 and \
+                not isinstance(r, rx.BoundRef):
+            self._emit_cache[key] = v
+        return v
+
+    def _emit(self, r: rx.Rex, env: Dict[int, Val]) -> Val:
         folded = self._try_fold(r)
         if folded is not None:
             return folded
@@ -255,17 +294,22 @@ class AggCodegen:
         okv = f"{out}_ok"
         self.stmts.append(f"{ct} {out} = 0; bool {okv} = false;")
         closes = 0
-        for cond, val in r.branches:
-            c = self.emit(cond, env)
-            cc = _vand(c.valid, f"(bool)({c.code})") or f"(bool)({c.code})"
-            v = self.emit(val, env)
-            self.stmts.append(f"if ({cc}) {{ {out} = ({ct})({v.code}); "
-                              f"{okv} = {v.valid or 'true'}; }} else {{")
-            closes += 1
-        if r.else_value is not None:
-            v = self.emit(r.else_value, env)
-            self.stmts.append(f"{out} = ({ct})({v.code}); "
-                              f"{okv} = {v.valid or 'true'};")
+        self._block_depth += 1
+        try:
+            for cond, val in r.branches:
+                c = self.emit(cond, env)
+                cc = _vand(c.valid, f"(bool)({c.code})") \
+                    or f"(bool)({c.code})"
+                v = self.emit(val, env)
+                self.stmts.append(f"if ({cc}) {{ {out} = ({ct})({v.code}); "
+                                  f"{okv} = {v.valid or 'true'}; }} else {{")
+                closes += 1
+            if r.else_value is not None:
+                v = self.emit(r.else_value, env)
+                self.stmts.append(f"{out} = ({ct})({v.code}); "
+                                  f"{okv} = {v.valid or 'true'};")
+        finally:
+            self._block_depth -= 1
         self.stmts.append("}" * closes)
         return Val(out, okv, r.dtype)
 
@@ -585,6 +629,9 @@ class AggCodegen:
                             nv = f"{t}_ok"
                         new_env[j] = Val(t, nv, v.dtype, v.dictionary)
                 env = new_env
+                # BoundRefs now resolve against the new projection: CSE
+                # entries from the previous binding must not be reused
+                self._env_gen += 1
             else:
                 _u(f"chain node {type(node).__name__}")
 
@@ -631,10 +678,15 @@ class AggCodegen:
                 seg_terms.append(f"{code} * {s}LL")
             seg = " + ".join(seg_terms) if seg_terms else "0"
             self.stmts.append(f"int64_t seg = {seg};")
+            # interleaved per-seg accumulator block (one cache line
+            # covers a group's row count + every i64 slot + null counts):
+            # AI[seg*SI + 0]=rows, +1..=i64 slots, +CN..=null counts;
+            # f64 slots live in AD[seg*NF + k]
+            self.stmts.append("AI[seg * {SI}] += 1;")
         else:
             domains, strides = [], []
             self._emit_hash_keys(key_vals)
-        self.stmts.append("cnt_rows[seg] += 1;")
+            self.stmts.append("cnt_rows[seg] += 1;")
 
         # 4. aggregates
         f64_slots: List[int] = []
@@ -670,7 +722,8 @@ class AggCodegen:
                     continue
                 slot = ("i64", len(i64_slots))
                 i64_slots.append(j)
-                acc = f"acci[seg * {{NI}} + {slot[1]}]"
+                acc = (f"AI[seg * {{SI}} + {1 + slot[1]}]" if seg_mode
+                       else f"acci[seg * {{NI}} + {slot[1]}]")
                 self.stmts.append(f"if ({guard}) {{ {acc} += 1; }}")
                 agg_meta.append({"fn": "count", "slot": slot,
                                  "dtype": a.out_dtype})
@@ -682,12 +735,14 @@ class AggCodegen:
             if use_f64:
                 slot = ("f64", len(f64_slots))
                 f64_slots.append(j)
-                acc = f"accd[seg * {{NF}} + {slot[1]}]"
+                acc = f"AD[seg * {{NF}} + {slot[1]}]" if seg_mode \
+                    else f"accd[seg * {{NF}} + {slot[1]}]"
                 val = f"(double)({arg.code})"
             else:
                 slot = ("i64", len(i64_slots))
                 i64_slots.append(j)
-                acc = f"acci[seg * {{NI}} + {slot[1]}]"
+                acc = (f"AI[seg * {{SI}} + {1 + slot[1]}]" if seg_mode
+                       else f"acci[seg * {{NI}} + {slot[1]}]")
                 val = f"(int64_t)({arg.code})"
             guard = filt
             if arg.valid is not None:
@@ -697,7 +752,8 @@ class AggCodegen:
             # existing group contributes, so validity is just "group
             # exists". min/max always track it (first-touch initializer).
             track_nn = guard is not None or a.fn in ("min", "max")
-            nn = f"cnt_nn[seg * {{NA}} + {j}]"
+            nn = f"AI[seg * {{SI}} + {{CN}} + {j}]" if seg_mode \
+                else f"cnt_nn[seg * {{NA}} + {j}]"
             if a.fn == "sum":
                 bump = f" {nn} += 1;" if track_nn else ""
                 if not use_f64:
@@ -719,9 +775,15 @@ class AggCodegen:
 
         nf, ni, na = max(len(f64_slots), 1), max(len(i64_slots), 1), \
             max(len(p.aggs), 1)
+        # interleaved accumulator block strides (segment mode): one
+        # int64 row per seg = [row_count, i64 slots…, null counts…]
+        si = 1 + len(i64_slots) + len(p.aggs)
+        cn = 1 + len(i64_slots)
         body = "\n      ".join(s.replace("{NF}", str(nf))
                                .replace("{NI}", str(ni))
                                .replace("{NA}", str(na))
+                               .replace("{SI}", str(si))
+                               .replace("{CN}", str(cn))
                                for s in self.stmts)
         sel_slot = self._slot("sel", None)
         if not seg_mode:
@@ -731,6 +793,9 @@ class AggCodegen:
                     "nseg": 0, "domains": [], "strides": [],
                     "agg_meta": agg_meta, "key_vals": key_vals}
             return source, meta
+        merge = self._interleaved_merge(agg_meta, si, cn, nf)
+        copyout = self._interleaved_copyout(agg_meta, si, cn, nf, ni, na,
+                                            len(p.aggs))
         source = f"""
 #include <cstdint>
 #include <cmath>
@@ -742,9 +807,9 @@ class AggCodegen:
 
 template <bool DENSE>
 static void run_range(const void** data, int64_t lo, int64_t hi,
-                      double* accd, int64_t* acci,
-                      int64_t* cnt_rows, int64_t* cnt_nn) {{
-  const uint8_t* selp = (const uint8_t*)data[{sel_slot}];
+                      int64_t* __restrict AI, double* __restrict AD) {{
+  {self.ptr_decls()}
+  const uint8_t* __restrict selp = (const uint8_t*)data[{sel_slot}];
   for (int64_t i = lo; i < hi; ++i) {{
       if (!DENSE && !selp[i]) continue;
       {body}
@@ -763,44 +828,52 @@ static int64_t dense_prefix(const uint8_t* selp, int64_t n) {{
 }}
 
 static void run_part(const void** data, int64_t lo, int64_t hi,
-                     double* accd, int64_t* acci,
-                     int64_t* cnt_rows, int64_t* cnt_nn) {{
+                     int64_t* AI, double* AD) {{
   const uint8_t* selp = (const uint8_t*)data[{sel_slot}];
   int64_t k = dense_prefix(selp + lo, hi - lo);
   if (k >= 0)
-    run_range<true>(data, lo, lo + k, accd, acci, cnt_rows, cnt_nn);
+    run_range<true>(data, lo, lo + k, AI, AD);
   else
-    run_range<false>(data, lo, hi, accd, acci, cnt_rows, cnt_nn);
+    run_range<false>(data, lo, hi, AI, AD);
 }}
 
 extern "C" void run(const void** data, int64_t n,
                     double* accd, int64_t* acci,
                     int64_t* cnt_rows, int64_t* cnt_nn) {{
-  int64_t nseg = {nseg};
+  const int64_t nseg = {nseg};
   unsigned hw = std::thread::hardware_concurrency();
   int nt = (int)std::min<int64_t>(hw ? hw : 1, std::max<int64_t>(n / 1000000, 1));
-  if (nt <= 1) {{
-    run_part(data, 0, n, accd, acci, cnt_rows, cnt_nn);
-    return;
-  }}
+  std::vector<std::vector<int64_t>> ai(nt);
   std::vector<std::vector<double>> ad(nt);
-  std::vector<std::vector<int64_t>> ai(nt), cr(nt), cn(nt);
-  std::vector<std::thread> ts;
-  int64_t per = (n + nt - 1) / nt;
   for (int t = 0; t < nt; ++t) {{
+    ai[t].assign(nseg * {si}, 0);
     ad[t].assign(nseg * {nf}, 0.0);
-    ai[t].assign(nseg * {ni}, 0);
-    cr[t].assign(nseg, 0);
-    cn[t].assign(nseg * {na}, 0);
-    int64_t lo = t * per, hi = std::min(n, lo + per);
-    ts.emplace_back(run_part, data, lo, hi, ad[t].data(), ai[t].data(),
-                    cr[t].data(), cn[t].data());
   }}
-  for (auto& th : ts) th.join();
-  for (int t = 0; t < nt; ++t) {{
+  if (nt <= 1) {{
+    run_part(data, 0, n, ai[0].data(), ad[0].data());
+  }} else {{
+    std::vector<std::thread> ts;
+    int64_t per = (n + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {{
+      int64_t lo = t * per, hi = std::min(n, lo + per);
+      ts.emplace_back(run_part, data, lo, hi, ai[t].data(), ad[t].data());
+    }}
+    for (auto& th : ts) th.join();
+    int64_t* __restrict bi = ai[0].data();
+    double* __restrict bd = ad[0].data();
+    for (int t = 1; t < nt; ++t) {{
+      const int64_t* __restrict pi = ai[t].data();
+      const double* __restrict pd = ad[t].data();
+      for (int64_t s = 0; s < nseg; ++s) {{
+        {merge}
+      }}
+    }}
+  }}
+  {{
+    const int64_t* __restrict bi = ai[0].data();
+    const double* __restrict bd = ad[0].data();
     for (int64_t s = 0; s < nseg; ++s) {{
-      cnt_rows[s] += cr[t][s];
-      {self._merge_code(agg_meta, nf, ni, na)}
+      {copyout}
     }}
   }}
 }}
@@ -809,6 +882,62 @@ extern "C" void run(const void** data, int64_t n,
                 "na": na, "domains": domains, "strides": strides,
                 "agg_meta": agg_meta, "key_vals": key_vals}
         return source, meta
+
+    @staticmethod
+    def _interleaved_merge(agg_meta, si: int, cn: int, nf: int) -> str:
+        """Per-seg statements folding one thread's interleaved partial
+        block (pi/pd) into the base block (bi/bd)."""
+        lines = [f"bi[s * {si}] += pi[s * {si}];"]
+        for j, m in enumerate(agg_meta):
+            kind, off = m["slot"]
+            if kind == "rows":
+                continue  # rides the row count merged above
+            if kind == "f64":
+                acc, part = f"bd[s * {nf} + {off}]", f"pd[s * {nf} + {off}]"
+            else:
+                acc = f"bi[s * {si} + {1 + off}]"
+                part = f"pi[s * {si} + {1 + off}]"
+            nn = f"bi[s * {si} + {cn} + {j}]"
+            nng = f"pi[s * {si} + {cn} + {j}]"
+            if m["fn"] == "count":
+                lines.append(f"{acc} += {part};")
+            elif m["fn"] == "sum":
+                add = (f"{acc} = (int64_t)((uint64_t){acc}"
+                       f" + (uint64_t){part});" if kind == "i64"
+                       else f"{acc} += {part};")
+                if m.get("nn", True):
+                    lines.append(f"if ({nng}) {{ {add} {nn} += {nng}; }}")
+                else:
+                    lines.append(add)
+            elif m["fn"] == "min":
+                lines.append(f"if ({nng}) {{ if (!{nn} || {part} < {acc}) "
+                             f"{acc} = {part}; {nn} += {nng}; }}")
+            else:
+                lines.append(f"if ({nng}) {{ if (!{nn} || {part} > {acc}) "
+                             f"{acc} = {part}; {nn} += {nng}; }}")
+        return "\n        ".join(lines)
+
+    @staticmethod
+    def _interleaved_copyout(agg_meta, si: int, cn: int, nf: int, ni: int,
+                             na: int, n_aggs: int) -> str:
+        """Scatter the merged interleaved block out to the caller's
+        separate (zero-initialized) accd/acci/cnt_rows/cnt_nn arrays —
+        the ctypes interface the Python side reads stays unchanged."""
+        lines = [f"cnt_rows[s] = bi[s * {si}];"]
+        for m in agg_meta:
+            kind, off = m["slot"]
+            if kind == "rows":
+                continue
+            if kind == "f64":
+                lines.append(
+                    f"accd[s * {nf} + {off}] = bd[s * {nf} + {off}];")
+            else:
+                lines.append(
+                    f"acci[s * {ni} + {off}] = bi[s * {si} + {1 + off}];")
+        for j in range(n_aggs):
+            lines.append(
+                f"cnt_nn[s * {na} + {j}] = bi[s * {si} + {cn} + {j}];")
+        return "\n      ".join(lines)
 
     # ---------------- hash-mode group keys ----------------
     def _emit_hash_keys(self, key_vals: List[Val]) -> None:
@@ -948,6 +1077,7 @@ static inline int64_t tab_insert(Tab* T, const int64_t* k,
 
 template <bool DENSE>
 static void run_range(const void** data, int64_t lo, int64_t hi, Tab* T) {{
+  {self.ptr_decls()}
   const uint8_t* selp = (const uint8_t*)data[{sel_slot}];
   for (int64_t i = lo; i < hi; ++i) {{
       if (!DENSE && !selp[i]) continue;
@@ -1066,11 +1196,3 @@ extern "C" void release_hash(void* handle) {{
                 lines.append(f"if ({nng}) {{ if (!{nn} || {part} > {acc}) "
                              f"{acc} = {part}; {nn} += {nng}; }}")
         return "\n        ".join(lines)
-
-    @classmethod
-    def _merge_code(cls, agg_meta, nf, ni, na) -> str:
-        return cls._merge_code_fmt(
-            agg_meta, nf, ni, na,
-            dst_d="accd[s * {nf} + {off}]", src_d="ad[t][s * {nf} + {off}]",
-            dst_i="acci[s * {ni} + {off}]", src_i="ai[t][s * {ni} + {off}]",
-            dst_nn="cnt_nn[s * {na} + {j}]", src_nn="cn[t][s * {na} + {j}]")

@@ -75,19 +75,42 @@ def compile_and_load(source: str) -> ctypes.CDLL:
             return lib
     os.makedirs(_CACHE_DIR, exist_ok=True)
     so_path = os.path.join(_CACHE_DIR, f"k{key}.so")
-    if not os.path.exists(so_path):
-        src_path = os.path.join(_CACHE_DIR, f"k{key}.cpp")
-        with open(src_path, "w") as f:
-            f.write(source)
-        tmp = so_path + f".tmp{os.getpid()}"
-        cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
-               "-fPIC", "-pthread", "-o", tmp, src_path]
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
-        if proc.returncode != 0:
-            raise RuntimeError(f"native kernel compile failed:\n{proc.stderr}")
-        os.replace(tmp, so_path)  # atomic under concurrent builders
-    lib = ctypes.CDLL(so_path)
+    last_err: Optional[OSError] = None
+    for _attempt in range(2):
+        if not os.path.exists(so_path):
+            _build(source, key, so_path)
+        try:
+            lib = ctypes.CDLL(so_path)
+            break
+        except OSError as e:
+            # a TRUNCATED .so ("file too short"): concurrent builders in
+            # other threads/processes once collided on a shared tmp name
+            # mid-write. Drop the bad artifact and rebuild once.
+            last_err = e
+            try:
+                os.unlink(so_path)
+            except OSError:
+                pass
+    else:
+        raise RuntimeError(f"native kernel load failed: {last_err}")
     with _LOCK:
         _LIBS[key] = lib
     return lib
+
+
+def _build(source: str, key: str, so_path: str) -> None:
+    """Compile to a tmp path unique per (pid, thread) — cluster workers
+    are THREADS sharing one pid, so a pid-only suffix let two builders
+    of the same kernel interleave writes and publish a truncated .so —
+    then atomically publish."""
+    src_path = os.path.join(_CACHE_DIR, f"k{key}.cpp")
+    with open(src_path, "w") as f:
+        f.write(source)
+    tmp = so_path + f".tmp{os.getpid()}_{threading.get_ident()}"
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+           "-fPIC", "-pthread", "-o", tmp, src_path]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=120)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native kernel compile failed:\n{proc.stderr}")
+    os.replace(tmp, so_path)  # atomic under concurrent builders

@@ -248,8 +248,29 @@ class MeshExecutor:
     def _program_cache_key(self, worker_stages, leaves, groups_mult,
                            bucket_mult, expand_mult):
         """Structural cache key + the dictionary objects baked into the
-        compiled closures (same identity contract as local._OpCache)."""
-        plans = tuple(jg.encode_fragment(s.plan) for s in worker_stages)
+        compiled closures (same identity contract as local._OpCache).
+
+        Stage plans key by ``plan/stages.py plan_fingerprint`` — the
+        per-stage structural fingerprint shared with the local
+        executor's operator cache — instead of JSON-serializing every
+        fragment (which inlined whole memory tables into the key on
+        each lookup). Memory-table sources ride ``dict_objs`` so the
+        hit path verifies them by identity like dictionaries; an
+        unhashable fingerprint (exotic literals) falls back to the
+        serialized form."""
+        from ..plan.stages import plan_fingerprint
+        plan_keys = []
+        source_objs: list = []
+        for s in worker_stages:
+            fp, sources = plan_fingerprint(s.plan)
+            try:
+                hash(fp)
+            except TypeError:
+                fp = jg.encode_fragment(s.plan)
+                sources = ()
+            plan_keys.append(fp)
+            source_objs.extend(sources)
+        plans = tuple(plan_keys)
         shapes = tuple((s.stage_id, s.shuffle_keys, s.num_partitions)
                        for s in worker_stages)
         leaf_sig = tuple(
@@ -257,7 +278,9 @@ class MeshExecutor:
              tuple(sorted(ld.dicts)))
             for lid, ld in sorted(leaves.items()))
         dict_objs = tuple(d for _, ld in sorted(leaves.items())
-                          for _, d in sorted(ld.dicts.items(), key=lambda kv: kv[0]))
+                          for _, d in sorted(ld.dicts.items(),
+                                             key=lambda kv: kv[0])) \
+            + tuple(source_objs)
         # scalar-subquery values bake into the compiled closures as
         # literals: key them like local._op_key (rex-walk order)
         from ..exec.local import _node_rex

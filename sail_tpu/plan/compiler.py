@@ -628,8 +628,17 @@ def udf_arg_decoder(adt: dt.DataType, dictionary):
 
 def udf_decode_column(decoder, d, v):
     kind, aux = decoder
+    # plain ndarrays ONLY past this point: this runs inside
+    # jax.pure_callback, where indexing a jax Array would launch a new
+    # device computation from within the in-flight one — with the
+    # callback fused into a larger async-dispatched program (whole-stage
+    # fusion) that deadlocks the runtime. np.asarray on a callback input
+    # is a ready-buffer view, never new device work.
+    d = np.asarray(d)
     if v is None:
         v = np.ones(len(d), dtype=bool)
+    else:
+        v = np.asarray(v)
     if kind == "str":
         return [aux[int(c)] if ok else None for c, ok in zip(d, v)]
     if kind == "dec":

@@ -425,7 +425,10 @@ def walk_plan(p: PlanNode):
         yield from walk_plan(c)
 
 
-def explain(p: PlanNode, indent: int = 0) -> str:
+def explain(p: PlanNode, indent: int = 0, stage_of=None) -> str:
+    """Render the plan tree; ``stage_of`` (id(node) → fused-stage id,
+    from ``plan/stages.py split_stages``) prefixes each operator with
+    its stage so fused pipelines read as groups."""
     pad = "  " * indent
     name = type(p).__name__
     detail = ""
@@ -456,9 +459,12 @@ def explain(p: PlanNode, indent: int = 0) -> str:
             (f" limit={p.limit}" if p.limit is not None else "")
     elif isinstance(p, LimitExec):
         detail = f" limit={p.limit} offset={p.offset}"
-    lines = [f"{pad}{name}{detail}"]
+    prefix = ""
+    if stage_of is not None and id(p) in stage_of:
+        prefix = f"[s{stage_of[id(p)]}] "
+    lines = [f"{pad}{prefix}{name}{detail}"]
     for c in p.children:
-        lines.append(explain(c, indent + 1))
+        lines.append(explain(c, indent + 1, stage_of))
     return "\n".join(lines)
 
 

@@ -1,0 +1,277 @@
+"""Pipeline-breaker-aware stage splitter.
+
+Partitions a physical plan (``plan/nodes.py``) into maximal fusable
+pipelines — the unit the stage compiler in ``exec/local.py`` lowers to
+ONE jitted program, so intermediates stay in registers/VMEM instead of
+round-tripping through materialized batches. Reference role: Flare's
+pipeline-to-native-program compilation (arXiv:1703.08219) with Theseus's
+rule that stage boundaries (pipeline breakers) are the only
+materialization points (arXiv:2508.05029).
+
+Stage shape, mirroring exactly what the executor fuses:
+
+- ``FilterExec``/``ProjectExec`` are the fusable pipeline operators;
+- ``AggregateExec`` (device-mergeable, non-distinct) and ``SortExec``
+  absorb the Filter/Project chain below them — scan→filter→project→
+  partial-aggregate and pre-sort segments compile to one program;
+- every other operator (join, window, union, limit, generators, host
+  UDF relations) is a pipeline breaker: it roots its own stage, and a
+  chain below it forms a standalone ``pipeline`` stage that still
+  compiles to one program;
+- leaves (scans, values, ranges, ``StageInputExec`` exchange inputs —
+  the cluster path's shuffle boundaries) are pipeline *sources*: they
+  materialize a batch by nature and belong to the stage that consumes
+  them.
+
+The invariant the validator enforces (``analysis/invariants.py
+validate_stage_split``): every node is in exactly one stage, and
+breakers appear only at stage edges — a stage's interior is exclusively
+Filter/Project operators and its source leaves.
+
+``stage_fingerprint`` is the shared structural cache key for a fused
+stage's compiled program: the local executor's operator cache and the
+mesh executor's program cache both key on it, so repeated queries of the
+same shape skip tracing and XLA compilation per stage rather than per
+operator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from . import nodes as pn
+
+#: operators a pipeline fuses through
+FUSABLE_OPS = (pn.FilterExec, pn.ProjectExec)
+
+#: stage kinds that are pipeline breakers (everything except "pipeline",
+#: which is a pure chain stage bounded by its consumer's edge)
+BREAKER_KINDS = ("aggregate", "sort", "window", "join", "union", "limit",
+                 "generate", "host", "source")
+
+
+def fusion_enabled(session_value=None) -> bool:
+    """THE fusion-gate resolution, shared by the executor and EXPLAIN
+    rendering so they can never disagree: an explicit session value
+    (``spark.sail.execution.fusion.enabled``) wins, else the app config
+    key ``execution.fusion.enabled``, default on."""
+    from ..config import get as config_get
+    from ..config import truthy_value
+    v = session_value
+    if v is None:
+        v = config_get("execution.fusion.enabled", "true")
+    return truthy_value(v)
+
+
+def is_leaf(p: pn.PlanNode) -> bool:
+    """Pipeline sources: nodes with no plan children. They materialize a
+    batch by nature (scan decode/upload, exchange fetch, host rows)."""
+    return not p.children
+
+
+def agg_absorbs_chain(p: pn.PlanNode) -> bool:
+    """Mirrors ``LocalExecutor._exec_AggregateExec``: host-evaluated and
+    DISTINCT aggregates run the unfused host path, so their input chain
+    is a separate pipeline stage."""
+    return not any(a.fn.startswith("__host__") or a.distinct
+                   for a in p.aggs)
+
+
+def classify(p: pn.PlanNode) -> str:
+    if isinstance(p, pn.AggregateExec):
+        return "aggregate"
+    if isinstance(p, pn.SortExec):
+        return "sort"
+    if isinstance(p, pn.WindowExec):
+        return "window"
+    if isinstance(p, pn.JoinExec):
+        return "join"
+    if isinstance(p, pn.UnionExec):
+        return "union"
+    if isinstance(p, pn.LimitExec):
+        return "limit"
+    if isinstance(p, pn.GenerateExec):
+        return "generate"
+    if isinstance(p, FUSABLE_OPS):
+        return "pipeline"
+    if is_leaf(p):
+        return "source"
+    # GroupMap/CoGroupMap/MapPartitions and any future host-evaluated
+    # relation: a breaker whose body runs outside the device compiler
+    return "host"
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedStage:
+    """One maximal pipeline: ``nodes`` is root-first (top-down), ending
+    at the stage's source leaves. ``kind`` names the root operator class
+    (the breaker terminating the pipeline, or ``pipeline`` for a pure
+    chain stage). ``fused`` marks stages whose compute collapses into
+    one compiled program (>= 2 compute operators, or a breaker that
+    absorbed a chain)."""
+
+    sid: int
+    root: pn.PlanNode
+    nodes: Tuple[pn.PlanNode, ...]
+    kind: str
+    fused: bool
+
+    @property
+    def compute_ops(self) -> int:
+        """Operators with real per-row compute (sources excluded)."""
+        return sum(1 for n in self.nodes if not is_leaf(n))
+
+
+@dataclasses.dataclass
+class StageSplit:
+    stages: List[FusedStage]
+    #: id(node) -> stage id, for every node of the plan
+    stage_of: Dict[int, int]
+
+    @property
+    def fused_op_count(self) -> int:
+        """Filter/Project operators that execute inside a consumer's
+        program instead of dispatching their own."""
+        return sum(sum(1 for n in s.nodes if isinstance(n, FUSABLE_OPS))
+                   for s in self.stages if s.fused)
+
+
+def _chain_below(p: pn.PlanNode) -> Tuple[List[pn.PlanNode],
+                                          Optional[pn.PlanNode]]:
+    """(maximal Filter/Project chain under ``p`` top-down, leftover).
+    Leftover is the first non-chain node, or None when the chain bottoms
+    out at a leaf (which is then the last chain element)."""
+    members: List[pn.PlanNode] = []
+    cur = p.input
+    while isinstance(cur, FUSABLE_OPS):
+        members.append(cur)
+        cur = cur.input
+    if is_leaf(cur):
+        members.append(cur)
+        return members, None
+    return members, cur
+
+
+def split_stages(plan: pn.PlanNode) -> StageSplit:
+    """Partition ``plan`` into maximal fusable pipelines."""
+    stages: List[FusedStage] = []
+    stage_of: Dict[int, int] = {}
+
+    def add(root: pn.PlanNode, members: List[pn.PlanNode], kind: str,
+            fused: bool) -> None:
+        sid = len(stages)
+        stages.append(FusedStage(sid, root, tuple(members), kind, fused))
+        for m in members:
+            stage_of[id(m)] = sid
+
+    def visit(node: pn.PlanNode) -> None:
+        kind = classify(node)
+        if kind in ("aggregate", "sort") and \
+                (kind == "sort" or agg_absorbs_chain(node)):
+            chain, leftover = _chain_below(node)
+            add(node, [node] + chain, kind, fused=len(chain) > 0)
+            if leftover is not None:
+                visit(leftover)
+            return
+        if kind == "pipeline":
+            chain, leftover = _chain_below(node)
+            members = [node] + chain
+            compute = sum(1 for n in members if not is_leaf(n))
+            add(node, members, "pipeline", fused=compute > 1)
+            if leftover is not None:
+                visit(leftover)
+            return
+        # breaker (or bare leaf root): own stage; direct leaf children
+        # are its sources, everything else roots a new stage
+        members = [node]
+        pending = []
+        for c in node.children:
+            if is_leaf(c):
+                members.append(c)
+            else:
+                pending.append(c)
+        add(node, members, kind, fused=False)
+        for c in pending:
+            visit(c)
+
+    visit(plan)
+    return StageSplit(stages, stage_of)
+
+
+# ---------------------------------------------------------------------------
+# structural fingerprints — shared cache-key vocabulary for compiled
+# stage programs (exec/local.py _OpCache, parallel/mesh_exec.py program
+# cache)
+# ---------------------------------------------------------------------------
+
+def node_fingerprint(p: pn.PlanNode):
+    """Structural key of ONE operator: type + the fields that shape its
+    compiled program (expressions, indices, dtypes) — never source data
+    identity, which the caches layer on separately."""
+    t = type(p).__name__
+    if isinstance(p, pn.FilterExec):
+        return (t, p.condition)
+    if isinstance(p, pn.ProjectExec):
+        return (t, p.exprs)
+    if isinstance(p, pn.AggregateExec):
+        return (t, p.group_indices, p.aggs, p.max_groups_hint)
+    if isinstance(p, pn.SortExec):
+        return (t, p.keys, p.limit)
+    if isinstance(p, pn.WindowExec):
+        return (t, p.windows)
+    if isinstance(p, pn.JoinExec):
+        return (t, p.join_type, p.left_keys, p.right_keys, p.residual,
+                p.null_aware, p.runtime_filters)
+    if isinstance(p, pn.LimitExec):
+        return (t, p.limit, p.offset)
+    return (t,)
+
+
+def stage_fingerprint(nodes, bottom_schema) -> tuple:
+    """Cache key for one fused stage's compiled program: the structural
+    fingerprint of every compute operator in the pipeline (top-down)
+    plus the source schema the bottom binds to."""
+    return ("stage",
+            tuple(node_fingerprint(n) for n in nodes if not is_leaf(n)),
+            tuple((f.name, f.dtype) for f in bottom_schema))
+
+
+def plan_fingerprint(plan: pn.PlanNode):
+    """Whole-plan structural fingerprint for program caches that key
+    entire stage plans (the mesh executor). Returns ``(key, sources)``:
+    ``key`` covers every operator's compiled shape plus scan identity
+    (names/paths/options, memory tables by ``id``), and ``sources`` are
+    the memory-table objects the caller must hold strong references to
+    and verify by identity on a cache hit — the same contract the
+    operator caches use for dictionaries. ``key`` may be unhashable
+    (exotic literals); callers fall back to serialization then."""
+    parts = []
+    sources: List[object] = []
+    for node in pn.walk_plan(plan):
+        fp = node_fingerprint(node)
+        if isinstance(node, pn.ScanExec):
+            src_id = None
+            if node.source is not None:
+                sources.append(node.source)
+                src_id = ("mem", id(node.source))
+            fp = fp + (node.table_name, node.paths, node.format,
+                       node.options, node.projection, node.predicates,
+                       node.runtime_predicates, node.runtime_filters,
+                       src_id,
+                       tuple((f.name, f.dtype) for f in node.out_schema))
+        elif hasattr(node, "stage_id"):
+            # exchange leaves (job_graph.StageInputExec): the compiled
+            # closure bakes in WHICH producer stage feeds this input, so
+            # same-schema inputs wired to different producers must not
+            # collide in a program cache
+            fp = fp + (("stage_input", node.stage_id),
+                       tuple((f.name, f.dtype) for f in node.schema))
+        else:
+            try:
+                fp = fp + (tuple((f.name, f.dtype)
+                                 for f in node.schema),)
+            except Exception:  # noqa: BLE001 — schema-opaque leaf
+                pass
+        parts.append(fp)
+    return tuple(parts), tuple(sources)

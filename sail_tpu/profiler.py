@@ -80,6 +80,12 @@ class QueryProfile:
     # plan-invariant validator walks that ran for this query (optimizer
     # pass boundaries + job-graph stage checks)
     validated_passes: int = 0
+    # whole-stage fusion: pipeline stages the splitter produced, Filter/
+    # Project operators inlined into a consumer's program, and pipelines
+    # that declined fusion at execution time (host-only expressions)
+    fusion_stages: int = 0
+    fusion_fused_ops: int = 0
+    fusion_fallbacks: int = 0
     rows_out: int = 0
     slow: bool = False
     # operator metric trees (dicts, telemetry.OperatorMetrics.to_dict)
@@ -168,6 +174,13 @@ class QueryProfile:
         with self._lock:
             self.validated_passes += int(passes)
 
+    def note_fusion(self, stages: int = 0, fused_ops: int = 0,
+                    fallbacks: int = 0) -> None:
+        with self._lock:
+            self.fusion_stages += int(stages)
+            self.fusion_fused_ops += int(fused_ops)
+            self.fusion_fallbacks += int(fallbacks)
+
     def add_task(self, stage: int, partition: int, worker_id: str,
                  operators: List[dict], rows_out: int = 0) -> None:
         """Merge one distributed task's operator metrics (driver side)."""
@@ -231,6 +244,11 @@ class QueryProfile:
                 "speculative_won": self.ft_speculative_won,
             },
             "validated_passes": self.validated_passes,
+            "fusion": {
+                "stages": self.fusion_stages,
+                "fused_ops": self.fusion_fused_ops,
+                "fallbacks": self.fusion_fallbacks,
+            },
             "rows_out": self.rows_out,
             "slow": self.slow,
             "operators": list(self.operators),
@@ -262,6 +280,12 @@ class QueryProfile:
                 f"fault tolerance: retries={self.ft_retries} "
                 f"speculative={self.ft_speculative_launched} "
                 f"won={self.ft_speculative_won}")
+        if self.fusion_stages:
+            extra = f" ({self.fusion_fused_ops} ops inlined"
+            if self.fusion_fallbacks:
+                extra += f", {self.fusion_fallbacks} fallbacks"
+            extra += ")"
+            lines.append(f"fused: {self.fusion_stages} stages{extra}")
         if self.validated_passes:
             lines.append(f"validated: {self.validated_passes} passes")
         if self.tasks:
@@ -523,6 +547,15 @@ def note_plan_validated(passes: int = 1) -> None:
     profile = current_profile()
     if profile is not None:
         profile.note_validated(passes)
+
+
+def note_fusion(stages: int = 0, fused_ops: int = 0,
+                fallbacks: int = 0) -> None:
+    """Whole-stage fusion accounting for the current query."""
+    profile = current_profile()
+    if profile is not None:
+        profile.note_fusion(stages=stages, fused_ops=fused_ops,
+                            fallbacks=fallbacks)
 
 
 def last_profile() -> Optional[QueryProfile]:

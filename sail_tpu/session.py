@@ -366,6 +366,16 @@ class SparkSession:
         if isinstance(cmd, sp.Explain):
             from .plan.nodes import explain
             node = self._resolve(cmd.query)
+            stage_of = None
+            n_stages = 0
+            from .plan.stages import fusion_enabled
+            fusion_on = fusion_enabled(self.conf.get(
+                "spark.sail.execution.fusion.enabled"))
+            if fusion_on:
+                from .plan.stages import split_stages
+                split = split_stages(node)
+                stage_of = split.stage_of
+                n_stages = len(split.stages)
             if cmd.mode == "analyze":
                 import time as _t
                 from . import profiler
@@ -388,7 +398,9 @@ class SparkSession:
                     # the analyzed execution IS complete — the profile
                     # just hasn't closed yet (rendering happens inside it)
                     payload["status"] = "succeeded"
-                    payload["plan"] = explain(node)
+                    payload["plan"] = explain(node, stage_of=stage_of)
+                    if stage_of is not None:
+                        payload["fused_stages"] = n_stages
                     text = _json.dumps(payload, indent=2, default=str)
                 else:
                     header = prof.render() if prof is not None else \
@@ -398,9 +410,15 @@ class SparkSession:
                 return pa.table({"plan": pa.array([text])})
             if cmd.format == "json":
                 import json as _json
+                payload = {"plan": explain(node, stage_of=stage_of)}
+                if stage_of is not None:
+                    payload["fused_stages"] = n_stages
                 return pa.table({"plan": pa.array(
-                    [_json.dumps({"plan": explain(node)}, indent=2)])})
-            return pa.table({"plan": pa.array([explain(node)])})
+                    [_json.dumps(payload, indent=2)])})
+            text = explain(node, stage_of=stage_of)
+            if stage_of is not None:
+                text += f"\nfused: {n_stages} stages"
+            return pa.table({"plan": pa.array([text])})
         if isinstance(cmd, sp.CacheTable):
             if cmd.query is not None:
                 cm.register_temp_view(cmd.name[-1], cmd.query)

@@ -1,0 +1,476 @@
+"""Whole-stage fused compilation.
+
+- stage-splitter units for every pipeline-breaker kind (full agg, sort,
+  join build, window, union, limit, generators/host relations, and the
+  cluster path's shuffle boundaries);
+- fused-stage invariant (``validate_stage_split``) red tests on
+  tampered splits;
+- fusion on/off bit-identical equivalence across TPC-H 22/22 and
+  ClickBench 43/43 locally plus the cluster ``split_job`` path;
+- fused-program cache hits across repeated queries and the EXPLAIN
+  stage-grouping surfaces.
+"""
+
+import os
+
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession, profiler
+from sail_tpu.analysis import PlanInvariantError, validate_stage_split
+from sail_tpu.exec import job_graph as jg
+from sail_tpu.exec.local import clear_caches
+from sail_tpu.plan import nodes as pn
+from sail_tpu.plan import rex as rx
+from sail_tpu.plan import stages as st
+from sail_tpu.spec import data_type as dt
+from sail_tpu.spec.literal import Literal as LV
+
+INT = dt.IntegerType()
+LONG = dt.LongType()
+STR = dt.StringType()
+BOOL = dt.BooleanType()
+
+
+def F(name, d=LONG):
+    return pn.Field(name, d)
+
+
+def scan(*fields, **kw):
+    return pn.ScanExec(out_schema=tuple(fields), format="memory", **kw)
+
+
+def ref(i, name="c", d=LONG):
+    return rx.BoundRef(i, name, d)
+
+
+def lit(v, d=LONG):
+    return rx.RLit(LV(d, v))
+
+
+def gt(a, b):
+    return rx.RCall(">", (a, b), BOOL)
+
+
+def chain_over_scan():
+    """scan → filter → project (a fusable pipeline)."""
+    s = scan(F("a"), F("b"))
+    f = pn.FilterExec(s, gt(ref(0, "a"), lit(1)))
+    return pn.ProjectExec(f, (("a", ref(0, "a")), ("b", ref(1, "b"))))
+
+
+def kinds(split):
+    return [stage.kind for stage in split.stages]
+
+
+def names(stage):
+    return [type(n).__name__ for n in stage.nodes]
+
+
+# ---------------------------------------------------------------------------
+# stage splitter: one unit per breaker kind
+# ---------------------------------------------------------------------------
+
+def test_agg_absorbs_scan_filter_project_chain():
+    p = pn.AggregateExec(chain_over_scan(), (0,),
+                         (pn.AggSpec("sum", 1, out_dtype=LONG),),
+                         ("a", "s"))
+    split = st.split_stages(p)
+    assert len(split.stages) == 1
+    assert split.stages[0].kind == "aggregate"
+    assert names(split.stages[0]) == [
+        "AggregateExec", "ProjectExec", "FilterExec", "ScanExec"]
+    assert split.stages[0].fused
+    assert split.fused_op_count == 2
+    validate_stage_split(p, split)
+
+
+def test_full_agg_is_breaker_for_consumers_above():
+    agg = pn.AggregateExec(chain_over_scan(), (0,),
+                           (pn.AggSpec("count", None),), ("a", "n"))
+    top = pn.ProjectExec(agg, (("n", ref(1, "n")),))
+    split = st.split_stages(top)
+    # the project above the aggregate cannot fuse through it
+    assert kinds(split) == ["pipeline", "aggregate"]
+    assert split.stage_of[id(top)] != split.stage_of[id(agg)]
+    validate_stage_split(top, split)
+
+
+def test_sort_absorbs_presort_chain():
+    p = pn.SortExec(chain_over_scan(), (pn.SortKey(ref(0, "a")),))
+    split = st.split_stages(p)
+    assert len(split.stages) == 1
+    assert split.stages[0].kind == "sort"
+    assert names(split.stages[0]) == [
+        "SortExec", "ProjectExec", "FilterExec", "ScanExec"]
+    assert split.stages[0].fused
+    validate_stage_split(p, split)
+
+
+def test_join_build_side_is_its_own_stage():
+    left = chain_over_scan()
+    right = pn.FilterExec(scan(F("x"), F("y")), gt(ref(1, "y"), lit(0)))
+    p = pn.JoinExec(left, right, "inner", (ref(0, "a"),), (ref(0, "x"),))
+    split = st.split_stages(p)
+    assert kinds(split) == ["join", "pipeline", "pipeline"]
+    # the build (right) subtree is a separate stage: join-build breaker
+    assert split.stage_of[id(right)] != split.stage_of[id(p)]
+    assert split.stage_of[id(left)] != split.stage_of[id(p)]
+    assert split.stage_of[id(left)] != split.stage_of[id(right)]
+    validate_stage_split(p, split)
+
+
+def test_join_with_bare_scan_sides_absorbs_sources():
+    l, r = scan(F("a")), scan(F("x"))
+    p = pn.JoinExec(l, r, "inner", (ref(0, "a"),), (ref(0, "x"),))
+    split = st.split_stages(p)
+    assert len(split.stages) == 1
+    assert split.stages[0].kind == "join"
+    validate_stage_split(p, split)
+
+
+def test_window_is_breaker_with_pipeline_below():
+    w = pn.WindowExec(chain_over_scan(),
+                      (pn.WindowSpec("row_number"),), ("rn",))
+    split = st.split_stages(w)
+    assert kinds(split) == ["window", "pipeline"]
+    assert split.stages[1].fused  # the chain still compiles as ONE program
+    validate_stage_split(w, split)
+
+
+def test_union_is_breaker():
+    u = pn.UnionExec((chain_over_scan(), scan(F("a"), F("b"))))
+    split = st.split_stages(u)
+    assert kinds(split) == ["union", "pipeline"]
+    # the bare-scan branch is a source of the union stage itself
+    assert names(split.stages[0]) == ["UnionExec", "ScanExec"]
+    validate_stage_split(u, split)
+
+
+def test_limit_is_breaker():
+    p = pn.LimitExec(chain_over_scan(), 10)
+    split = st.split_stages(p)
+    assert kinds(split) == ["limit", "pipeline"]
+    validate_stage_split(p, split)
+
+
+def test_generate_is_breaker():
+    g = pn.GenerateExec(chain_over_scan(), "explode",
+                        (ref(0, "a", dt.ArrayType(LONG)),))
+    split = st.split_stages(g)
+    assert kinds(split) == ["generate", "pipeline"]
+    validate_stage_split(g, split)
+
+
+def test_host_relation_is_breaker():
+    m = pn.MapPartitionsExec(chain_over_scan(), None, (F("a"),))
+    split = st.split_stages(m)
+    assert kinds(split) == ["host", "pipeline"]
+    validate_stage_split(m, split)
+
+
+def test_distinct_agg_does_not_absorb_chain():
+    p = pn.AggregateExec(
+        chain_over_scan(), (0,),
+        (pn.AggSpec("count", 1, distinct=True),), ("a", "n"))
+    split = st.split_stages(p)
+    assert kinds(split) == ["aggregate", "pipeline"]
+    assert not split.stages[0].fused
+    validate_stage_split(p, split)
+
+
+def test_shuffle_boundary_stage_inputs_are_sources():
+    """Cluster path: split_job's exchange leaves (StageInputExec) are
+    pipeline sources — every job-graph stage plan splits cleanly and
+    maps onto fused programs on the worker."""
+    t1 = pa.table({"a": list(range(200)), "b": list(range(200))})
+    t2 = pa.table({"x": list(range(50)), "y": list(range(50))})
+    left = pn.FilterExec(
+        pn.ScanExec((F("a"), F("b")), t1, (), "memory"),
+        gt(ref(0, "a"), lit(3)))
+    right = pn.ScanExec((F("x"), F("y")), t2, (), "memory")
+    join = pn.JoinExec(left, right, "inner",
+                       (ref(0, "a"),), (ref(0, "x"),))
+    agg = pn.AggregateExec(join, (1,),
+                           (pn.AggSpec("sum", 2, out_dtype=LONG),),
+                           ("b", "s"))
+    graph = jg.split_job(agg, num_partitions=2)
+    assert graph is not None
+    saw_exchange_source = False
+    for stage in graph.stages:
+        split = st.split_stages(stage.plan)
+        validate_stage_split(stage.plan, split)
+        for s in split.stages:
+            for n in s.nodes:
+                if isinstance(n, jg.StageInputExec):
+                    assert st.is_leaf(n)
+                    saw_exchange_source = True
+    assert saw_exchange_source
+
+
+def test_every_node_in_exactly_one_stage_mixed_plan():
+    left = chain_over_scan()
+    right = pn.ProjectExec(scan(F("x"), F("y")), (("x", ref(0, "x")),))
+    join = pn.JoinExec(left, right, "inner", (ref(0, "a"),),
+                       (ref(0, "x"),))
+    agg = pn.AggregateExec(join, (0,), (pn.AggSpec("count", None),),
+                           ("a", "n"))
+    srt = pn.SortExec(agg, (pn.SortKey(ref(1, "n", LONG)),))
+    top = pn.LimitExec(srt, 5)
+    split = st.split_stages(top)
+    validate_stage_split(top, split)
+    all_nodes = list(pn.walk_plan(top))
+    assert set(split.stage_of) == {id(n) for n in all_nodes}
+    assert sum(len(s.nodes) for s in split.stages) == len(all_nodes)
+
+
+# ---------------------------------------------------------------------------
+# fused-stage invariant: red tests on tampered splits
+# ---------------------------------------------------------------------------
+
+def _expect(invariant, plan, split):
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_stage_split(plan, split)
+    assert ei.value.invariant == invariant, ei.value
+    assert ei.value.after == "split_stages"
+
+
+def test_invariant_catches_missing_node():
+    p = pn.SortExec(chain_over_scan(), (pn.SortKey(ref(0, "a")),))
+    split = st.split_stages(p)
+    stage = split.stages[0]
+    tampered = st.StageSplit(
+        [st.FusedStage(0, stage.root, stage.nodes[:-1], stage.kind,
+                       stage.fused)],
+        {id(n): 0 for n in stage.nodes[:-1]})
+    _expect("fusion.coverage", p, tampered)
+
+
+def test_invariant_catches_duplicate_assignment():
+    p = pn.SortExec(chain_over_scan(), (pn.SortKey(ref(0, "a")),))
+    split = st.split_stages(p)
+    stage = split.stages[0]
+    dup = st.FusedStage(1, stage.nodes[1], stage.nodes[1:], "pipeline",
+                        True)
+    _expect("fusion.duplicate", p,
+            st.StageSplit([stage, dup], dict(split.stage_of)))
+
+
+def test_invariant_catches_interior_breaker():
+    agg = pn.AggregateExec(chain_over_scan(), (0,),
+                           (pn.AggSpec("count", None),), ("a", "n"))
+    top = pn.ProjectExec(agg, (("n", ref(1, "n")),))
+    # claim one giant stage right through the aggregate
+    members = tuple(pn.walk_plan(top))
+    bogus = st.StageSplit(
+        [st.FusedStage(0, top, members, "pipeline", True)],
+        {id(n): 0 for n in members})
+    _expect("fusion.interior_breaker", top, bogus)
+
+
+def test_invariant_catches_disconnected_member():
+    p = pn.SortExec(chain_over_scan(), (pn.SortKey(ref(0, "a")),))
+    stray = scan(F("z"))
+    split = st.split_stages(p)
+    stage = split.stages[0]
+    bogus_nodes = stage.nodes + (stray,)
+    bogus = st.StageSplit(
+        [st.FusedStage(0, stage.root, bogus_nodes, stage.kind, True)],
+        {id(n): 0 for n in bogus_nodes})
+    _expect("fusion.disconnected", p, bogus)
+
+
+# ---------------------------------------------------------------------------
+# execution: fusion on/off bit-identical equivalence
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_spark():
+    from sail_tpu.benchmarks.tpch_data import generate_tpch
+
+    spark = SparkSession({})
+    for name, table in generate_tpch(sf=0.002, seed=11).items():
+        spark.createDataFrame(table).createOrReplaceTempView(name)
+    return spark
+
+
+def _run_on_off(spark, sql):
+    spark.conf.set("spark.sail.execution.fusion.enabled", "true")
+    on = spark.sql(sql).toArrow()
+    spark.conf.set("spark.sail.execution.fusion.enabled", "false")
+    try:
+        off = spark.sql(sql).toArrow()
+    finally:
+        spark.conf.set("spark.sail.execution.fusion.enabled", "true")
+    return on, off
+
+
+#: tier-1 representative subset (agg-chain, join-heavy, global agg,
+#: limit/sort, big-group shapes); the full 22/22 sweep is slow-marked
+TPCH_FAST = (1, 3, 6, 14, 18)
+
+
+def test_tpch_fusion_on_off_bit_identical_subset(tpch_spark):
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+
+    bad = []
+    for q in TPCH_FAST:
+        on, off = _run_on_off(tpch_spark, QUERIES[q])
+        if not on.equals(off):
+            bad.append(q)
+    assert not bad, f"fusion changed results for TPC-H {bad}"
+
+
+@pytest.mark.slow
+def test_tpch_fusion_on_off_bit_identical_full(tpch_spark):
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+
+    bad = []
+    for q in sorted(QUERIES):
+        if q in TPCH_FAST:
+            continue  # tier-1 subset covers these
+        on, off = _run_on_off(tpch_spark, QUERIES[q])
+        if not on.equals(off):
+            bad.append(q)
+    assert not bad, f"fusion changed results for TPC-H {bad}"
+
+
+def test_clickbench_fusion_on_off_bit_identical():
+    from sail_tpu.benchmarks.clickbench import load_queries, register_hits
+
+    spark = SparkSession({})
+    register_hits(spark, n_rows=4000, seed=3)
+    bad = []
+    for i, sql in enumerate(load_queries(), 1):
+        on, off = _run_on_off(spark, sql)
+        if not on.equals(off):
+            bad.append(i)
+    assert not bad, f"fusion changed results for ClickBench {bad}"
+
+
+def test_cluster_split_job_fusion_on_off_bit_identical(tpch_spark):
+    """The distributed path: the same job graph executes with workers
+    fusing (env-gated) and not, results bit-identical."""
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.sql import parse_one
+
+    def canon(table):
+        return table.sort_by([(c, "ascending")
+                              for c in table.column_names])
+
+    # q1: grouped partial-agg pipeline; q6: GLOBAL aggregate — the shape
+    # whose zero-key shuffle channels regressed before this PR fixed it
+    for q in (1, 6):
+        plan = tpch_spark._resolve(parse_one(QUERIES[q]))
+        results = {}
+        for mode in ("true", "false"):
+            os.environ["SAIL_EXECUTION__FUSION__ENABLED"] = mode
+            try:
+                c = LocalCluster(num_workers=2)
+                try:
+                    results[mode] = canon(
+                        c.run_job(plan, num_partitions=2, timeout=120))
+                finally:
+                    c.stop()
+            finally:
+                os.environ.pop("SAIL_EXECUTION__FUSION__ENABLED", None)
+        assert results["true"].equals(results["false"]), \
+            f"cluster fusion changed results for TPC-H q{q}"
+
+
+# ---------------------------------------------------------------------------
+# fused-program caching + observability surfaces
+# ---------------------------------------------------------------------------
+
+CHAINED_SQL = """
+    SELECT a + 1 AS a1, b * 2 AS b2
+    FROM t WHERE a > 3 AND b < 90
+"""
+
+
+@pytest.fixture()
+def chain_spark():
+    spark = SparkSession({})
+    spark.createDataFrame(pa.table({
+        "a": list(range(100)), "b": list(range(100))
+    })).createOrReplaceTempView("t")
+    return spark
+
+
+def test_fused_chain_cache_hit_across_repeats(chain_spark):
+    clear_caches()
+    chain_spark.sql(CHAINED_SQL).toArrow()
+    first = profiler.last_profile()
+    assert first.compile_cache_misses > 0
+    assert first.fusion_stages > 0
+    assert first.fusion_fused_ops >= 1
+    chain_spark.sql(CHAINED_SQL).toArrow()
+    second = profiler.last_profile()
+    assert second.compile_cache_misses == 0, \
+        "repeated query must reuse every fused stage program"
+    assert second.compile_cache_hits > 0
+    assert second.fusion_stages == first.fusion_stages
+
+
+def test_fused_sort_cache_hit_across_repeats(chain_spark):
+    clear_caches()
+    sql = "SELECT a + b AS s FROM t WHERE a > 2 ORDER BY s DESC"
+    r1 = chain_spark.sql(sql).toArrow()
+    chain_spark.sql(sql).toArrow()
+    prof = profiler.last_profile()
+    assert prof.compile_cache_misses == 0
+    # and the fused sort is bit-identical to the unfused one
+    chain_spark.conf.set("spark.sail.execution.fusion.enabled", "false")
+    try:
+        off = chain_spark.sql(sql).toArrow()
+    finally:
+        chain_spark.conf.set("spark.sail.execution.fusion.enabled",
+                             "true")
+    assert r1.equals(off)
+
+
+def test_fusion_off_reports_no_stages(chain_spark):
+    chain_spark.conf.set("spark.sail.execution.fusion.enabled", "false")
+    try:
+        chain_spark.sql(CHAINED_SQL).toArrow()
+    finally:
+        chain_spark.conf.set("spark.sail.execution.fusion.enabled",
+                             "true")
+    prof = profiler.last_profile()
+    assert prof.fusion_stages == 0
+    assert prof.fusion_fused_ops == 0
+
+
+def test_explain_renders_stage_ids_and_fused_line(chain_spark):
+    text = chain_spark.sql(
+        "EXPLAIN " + CHAINED_SQL).toArrow().column(0)[0].as_py()
+    assert "[s0]" in text
+    assert "fused:" in text and "stages" in text
+
+
+def test_explain_analyze_reports_fused_stages(chain_spark):
+    text = chain_spark.sql(
+        "EXPLAIN ANALYZE " + CHAINED_SQL).toArrow().column(0)[0].as_py()
+    assert "fused:" in text
+
+
+def test_host_only_chain_falls_back_per_op(chain_spark):
+    """A chain expression only the host interpreter can evaluate
+    declines fusion (fallback counted) but still answers correctly."""
+    sql = "SELECT array(a, b)[0] AS first FROM t WHERE a > 95"
+    got = chain_spark.sql(sql).toArrow()
+    assert got.num_rows == 4
+    assert got.column(0).to_pylist() == [96, 97, 98, 99]
+    prof = profiler.last_profile()
+    assert prof.fusion_fallbacks >= 1
+
+
+def test_fusion_metrics_registered():
+    from sail_tpu.metrics import REGISTRY
+    declared = {d.name for d in REGISTRY.definitions()}
+    for name in ("execution.fusion.stage_count",
+                 "execution.fusion.fused_op_count",
+                 "execution.fusion.fallback_count",
+                 "execution.fusion.compile_time"):
+        assert name in declared, name
