@@ -278,6 +278,94 @@ def _run_chaos(spark) -> dict:
     }
 
 
+def _run_shuffle_bench(spark) -> dict:
+    """Cluster-path shuffle artifact: the join/agg-heavy queries where
+    data movement dominates (q5/q18/q21) run through the local cluster,
+    and the execution.shuffle.* / cluster.governor.* registry deltas
+    record wire+spill bytes (raw vs compressed), fetch-overlap wait, and
+    governor admissions. Run twice with the
+    SAIL_BENCH_DISABLE_SHUFFLE_COMPRESSION=1 A/B knob for the on/off
+    comparison."""
+    from sail_tpu.benchmarks.tpch_data import generate_tpch
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.metrics import REGISTRY
+    from sail_tpu.sql import parse_one
+
+    def snap():
+        out = {}
+        for row in REGISTRY.snapshot():
+            name = row["name"]
+            if name.startswith(("execution.shuffle.",
+                                "cluster.governor.")):
+                out[name] = out.get(name, 0.0) + row["value"]
+        return out
+
+    sf = float(os.environ.get("SAIL_BENCH_SHUFFLE_SF", "0.02"))
+    tables = generate_tpch(sf, seed=7)
+    for name, t in tables.items():
+        spark.createDataFrame(t).createOrReplaceTempView(name)
+    out = {
+        "sf": sf,
+        "compression": os.environ.get("SAIL_SHUFFLE__COMPRESSION", "lz4"),
+        "fetch_concurrency": os.environ.get(
+            "SAIL_SHUFFLE__FETCH_CONCURRENCY", "4"),
+        "queries": {},
+    }
+    base = snap()
+    c = LocalCluster(num_workers=2)
+    try:
+        for q in (5, 18, 21):
+            plan = spark._resolve(parse_one(QUERIES[q]))
+            c.run_job(plan, num_partitions=4, timeout=240)  # warm
+            t0 = time.perf_counter()
+            c.run_job(plan, num_partitions=4, timeout=240)
+            out["queries"][q] = round(time.perf_counter() - t0, 4)
+            print(f"bench: shuffle q{q} = {out['queries'][q]}",
+                  file=sys.stderr, flush=True)
+        # fetch-overlap A/B: the same warm queries with sequential
+        # (concurrency 0) stage-input fetch, so the wall-clock win from
+        # overlapped fetch is recorded in the same artifact
+        prev = os.environ.get("SAIL_SHUFFLE__FETCH_CONCURRENCY")
+        os.environ["SAIL_SHUFFLE__FETCH_CONCURRENCY"] = "0"
+        try:
+            out["queries_sequential_fetch"] = {}
+            for q in (18, 21):
+                plan = spark._resolve(parse_one(QUERIES[q]))
+                t0 = time.perf_counter()
+                c.run_job(plan, num_partitions=4, timeout=240)
+                out["queries_sequential_fetch"][q] = round(
+                    time.perf_counter() - t0, 4)
+                print(f"bench: shuffle q{q} (sequential fetch) = "
+                      f"{out['queries_sequential_fetch'][q]}",
+                      file=sys.stderr, flush=True)
+        finally:
+            if prev is None:
+                os.environ.pop("SAIL_SHUFFLE__FETCH_CONCURRENCY", None)
+            else:
+                os.environ["SAIL_SHUFFLE__FETCH_CONCURRENCY"] = prev
+    finally:
+        c.stop()
+    after = snap()
+    delta = {k: v - base.get(k, 0.0) for k, v in after.items()}
+    wire = int(delta.get("execution.shuffle.wire_bytes", 0))
+    comp = int(delta.get("execution.shuffle.wire_bytes_compressed", 0))
+    out["wire_bytes"] = wire
+    out["wire_bytes_compressed"] = comp
+    out["wire_ratio"] = round(wire / comp, 3) if comp else None
+    out["spill_bytes_compressed"] = int(
+        delta.get("execution.shuffle.spill_bytes_compressed", 0))
+    out["fetch_wait_s"] = round(
+        delta.get("execution.shuffle.fetch_wait_time", 0.0), 4)
+    out["decode_s"] = round(
+        delta.get("execution.shuffle.decode_time", 0.0), 4)
+    out["governor"] = {
+        "admitted": int(delta.get("cluster.governor.admitted_count", 0)),
+        "deferred": int(delta.get("cluster.governor.deferred_count", 0)),
+    }
+    return out
+
+
 def _budget_skip_warnings(result: dict) -> list:
     """Self-check: no suite query may be silently budget-skipped — every
     skip surfaces as an artifact warning, and q22 (first-run,
@@ -361,6 +449,14 @@ def main():
     if disable_fusion:
         spark.conf.set("spark.sail.execution.fusion.enabled", "false")
         os.environ["SAIL_EXECUTION__FUSION__ENABLED"] = "false"
+    # A/B knob: SAIL_BENCH_DISABLE_SHUFFLE_COMPRESSION=1 turns the
+    # shuffle wire+spill codec off for the whole run (the cluster data
+    # plane reads the app-config/env layer, not the session conf)
+    disable_shuffle_comp = os.environ.get(
+        "SAIL_BENCH_DISABLE_SHUFFLE_COMPRESSION", "0") \
+        .strip().lower() in ("1", "true", "yes")
+    if disable_shuffle_comp:
+        os.environ["SAIL_SHUFFLE__COMPRESSION"] = "none"
     try:
         best, rows, scanned, q1_profile = _run_q1(spark, sf)
     except Exception as e:  # noqa: BLE001 — fall back to SF1 rather than die
@@ -379,6 +475,8 @@ def main():
         "profile": q1_profile,
         "runtime_filters": "disabled" if disable_rtf else "enabled",
         "fusion": "disabled" if disable_fusion else "enabled",
+        "shuffle_compression": "disabled" if disable_shuffle_comp
+        else "enabled",
         "tpu_probe": probe_info,
     }
     # the 22-query and ClickBench artifacts always record, inside the
@@ -405,6 +503,16 @@ def main():
                     spark, 100_000, remaining * 0.8)
         except Exception as e:  # noqa: BLE001
             result["clickbench_error"] = f"{type(e).__name__}: {e}"
+    # shuffle data-plane artifact: cluster-path q5/q18/q21 wire/spill
+    # bytes + fetch overlap (SAIL_BENCH_SKIP_SHUFFLE=1 skips)
+    remaining = total_budget - (time.perf_counter() - t_bench_start)
+    if remaining > 60 and os.environ.get(
+            "SAIL_BENCH_SKIP_SHUFFLE", "0").strip().lower() not in (
+            "1", "true", "yes"):
+        try:
+            result["shuffle"] = _run_shuffle_bench(spark)
+        except Exception as e:  # noqa: BLE001
+            result["shuffle_error"] = f"{type(e).__name__}: {e}"
     # chaos mode: TPC-H under a fixed fault seed, recovery overhead in
     # the artifact (opt-in: the run costs two extra cluster executions)
     if os.environ.get("SAIL_BENCH_CHAOS", "0").strip().lower() in (

@@ -36,8 +36,10 @@ from .proto import control_plane_pb2 as pb
 
 from .actor import Actor
 from . import job_graph as jg
+from . import shuffle as sh
 from .. import faults
 from .. import tracing as tr
+from ..io.prefetch import MultiPrefetcher
 from ..metrics import record as _record_metric
 
 _DRIVER_SERVICE = "sail_tpu.control.DriverService"
@@ -48,19 +50,6 @@ def _unary(fn, req_cls):
     return grpc.unary_unary_rpc_method_handler(
         fn, request_deserializer=req_cls.FromString,
         response_serializer=lambda m: m.SerializeToString())
-
-
-def _table_to_ipc(table) -> bytes:
-    import pyarrow as pa
-    sink = pa.BufferOutputStream()
-    with pa.ipc.new_stream(sink, table.schema) as w:
-        w.write_table(table)
-    return sink.getvalue().to_pybytes()
-
-
-def _ipc_to_table(buf: bytes):
-    import pyarrow as pa
-    return pa.ipc.open_stream(buf).read_all()
 
 
 # ---------------------------------------------------------------------------
@@ -195,13 +184,23 @@ class _StreamStore:
                     self.spill_count += 1
                     _record_metric("execution.spill_count", 1,
                                    kind="shuffle")
+                    # the spill format IS the wire format (compressed
+                    # IPC), so these are post-compression bytes
+                    _record_metric(
+                        "execution.shuffle.spill_bytes_compressed",
+                        len(buf))
                 else:
                     self._mem_bytes += len(buf)
                     stored[c] = buf
             self._streams[(job_id, stage, partition)] = stored
 
-    def get(self, job_id: str, stage: int, partition: int,
-            channel: int) -> Optional[bytes]:
+    def open_chunks(self, job_id: str, stage: int, partition: int,
+                    channel: int):
+        """Serve a channel as an iterator of bounded byte chunks: memory
+        entries slice, spilled entries stream from disk WITHOUT
+        rehydrating the whole file under the memory cap. None = channel
+        not found (including a raced clean_job unlink — the fetch side's
+        NOT_FOUND producer-re-run path owns that case)."""
         with self._lock:
             chans = self._streams.get((job_id, stage, partition))
             entry = None if chans is None else chans.get(channel)
@@ -209,13 +208,20 @@ class _StreamStore:
             return None
         if isinstance(entry, tuple):
             try:
-                with open(entry[1], "rb") as f:
-                    return f.read()
+                f = open(entry[1], "rb")
             except FileNotFoundError:
-                # raced clean_job's unlink — behave as channel-not-found so
-                # the fetch retry path (NOT_FOUND) handles it
                 return None
-        return entry
+            return sh.iter_file_chunks(f)
+        return sh.iter_buffer_chunks(entry)
+
+    def get(self, job_id: str, stage: int, partition: int,
+            channel: int) -> Optional[bytes]:
+        """Whole-channel bytes (tests/tools); the serve path streams
+        through :meth:`open_chunks` instead."""
+        chunks = self.open_chunks(job_id, stage, partition, channel)
+        if chunks is None:
+            return None
+        return b"".join(chunks)
 
     def clean_job(self, job_id: str):
         with self._lock:
@@ -231,9 +237,6 @@ class _StreamStore:
                 del self._streams[key]
 
 
-_FETCH_CHUNK_BYTES = 1 << 20
-
-
 def _task_metrics_enabled() -> bool:
     """Workers collect per-operator metrics for every task unless
     ``cluster.task_metrics`` turns it off (the collection forces one
@@ -243,9 +246,11 @@ def _task_metrics_enabled() -> bool:
 
 
 def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
-    """Server-streaming fetch: the channel's IPC bytes stream as bounded
-    chunks — no gRPC message-size cap, no full-buffer single message on
-    the wire (reference: stream_service/server.rs record-batch streams)."""
+    """Server-streaming fetch: the channel's (compressed) IPC bytes
+    stream as bounded chunks — no gRPC message-size cap, no full-buffer
+    single message on the wire, and a SPILLED channel streams straight
+    from disk without rehydrating under the memory cap (reference:
+    stream_service/server.rs record-batch streams)."""
 
     def fetch(request: pb.FetchStreamRequest, context):
         if request.scan_id:
@@ -259,40 +264,49 @@ def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
             per = -(-n // nparts) if n else 0
             part = entry.slice(request.partition * per, per) if per \
                 else entry.slice(0, 0)
-            buf = _table_to_ipc(part)
+            chunks = sh.iter_buffer_chunks(sh.encode_table(part))
         else:
-            buf = store.get(request.job_id, request.stage,
-                            request.partition, request.channel)
-            if buf is None:
+            chunks = store.open_chunks(request.job_id, request.stage,
+                                       request.partition, request.channel)
+            if chunks is None:
                 context.abort(
                     grpc.StatusCode.NOT_FOUND,
                     f"no stream for job={request.job_id} "
                     f"stage={request.stage} "
                     f"partition={request.partition} "
                     f"channel={request.channel}")
-        for off in range(0, max(len(buf), 1), _FETCH_CHUNK_BYTES):
-            chunk = buf[off:off + _FETCH_CHUNK_BYTES]
-            yield pb.FetchChunk(data=chunk,
-                                last=off + _FETCH_CHUNK_BYTES >= len(buf))
+        # one-chunk lookahead so the final data chunk carries last=True
+        prev: Optional[bytes] = None
+        for chunk in chunks:
+            if prev is not None:
+                yield pb.FetchChunk(data=prev, last=False)
+            prev = chunk
+        yield pb.FetchChunk(data=prev if prev is not None else b"",
+                            last=True)
 
     return fetch
 
 
-def _fetch_from(addr: str, req: pb.FetchStreamRequest, service: str,
-                timeout: float = 120.0) -> bytes:
+def _fetch_table(addr: str, req: pb.FetchStreamRequest, service: str,
+                 timeout: float = 120.0,
+                 stats: Optional[sh.FetchStats] = None):
+    """Fetch one stream and decode it INCREMENTALLY off the gRPC chunk
+    stream (record batch by record batch — the bytes are never
+    concatenated first). Returns a pyarrow Table."""
     key = (f"{addr}/scan:{req.scan_id}" if req.scan_id
            else f"{addr}/s{req.stage}p{req.partition}c{req.channel}")
 
-    def once() -> bytes:
+    def once():
         channel = grpc.insecure_channel(addr)
         try:
             rpc = channel.unary_stream(
                 f"/{service}/FetchStream",
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=pb.FetchChunk.FromString)
-            parts = [chunk.data for chunk in
-                     rpc(req, timeout=timeout, metadata=tr.inject_context())]
-            return b"".join(parts)
+            chunks = (c.data for c in
+                      rpc(req, timeout=timeout,
+                          metadata=tr.inject_context()))
+            return sh.decode_stream(sh.ChunkReader(chunks), stats=stats)
         finally:
             channel.close()
 
@@ -442,13 +456,23 @@ class WorkerActor(Actor):
             self._pool.submit(self._run_task, task, parent, ev)
 
     # -- task execution --------------------------------------------------
-    def _fetch_inputs(self, task: pb.TaskDefinition):
-        """Pull upstream stage outputs over the peer data plane."""
+    def _fetch_inputs(self, task: pb.TaskDefinition,
+                      stats: Optional[sh.FetchStats] = None):
+        """Pull ALL upstream stage outputs over the peer data plane
+        CONCURRENTLY: every (producer partition, channel) of every input
+        streams through one bounded multi-producer prefetch pool
+        (``shuffle.fetch_concurrency`` fetches in flight), overlapping
+        network + decode across partitions instead of draining one fully
+        materialized buffer at a time. Per-fetch fault semantics are
+        unchanged: each fetch retries once at site ``shuffle.fetch`` and
+        a NOT_FOUND surfaces as a per-input _FetchFailed (producer
+        re-run)."""
         import pyarrow as pa
 
-        tables: Dict[int, object] = {}
+        # (input stage_id, position within the input, up_part, chan, addr)
+        work: List[Tuple[int, int, int, int, str]] = []
+        input_len: Dict[int, int] = {}
         for inp in task.inputs:
-            parts = []
             addrs = list(inp.worker_addrs)
             if inp.mode == "shuffle":
                 wanted = [(i, task.partition) for i in range(len(addrs))]
@@ -457,19 +481,43 @@ class WorkerActor(Actor):
                 addrs = [addrs[task.partition]]
             else:  # merge | broadcast: everything from every producer
                 wanted = [(i, -1) for i in range(len(addrs))]
-            for (up_part, chan), addr in zip(wanted, addrs):
-                try:
-                    buf = _fetch_from(addr, pb.FetchStreamRequest(
-                        job_id=task.job_id, stage=inp.stage_id,
-                        partition=up_part, channel=chan), _WORKER_SERVICE)
-                except faults.WorkerCrash:
-                    raise
-                except (grpc.RpcError, faults.FaultInjectedError) as e:
-                    raise _FetchFailed(inp.stage_id, up_part) from e
-                parts.append(_ipc_to_table(buf))
-            tables[inp.stage_id] = pa.concat_tables(
-                parts, promote_options="permissive") if len(parts) > 1 \
-                else parts[0]
+            for pos, ((up_part, chan), addr) in enumerate(zip(wanted,
+                                                              addrs)):
+                work.append((inp.stage_id, pos, up_part, chan, addr))
+            input_len[inp.stage_id] = len(wanted)
+
+        def fetch_one(item):
+            stage_id, _pos, up_part, chan, addr = item
+            try:
+                return _fetch_table(addr, pb.FetchStreamRequest(
+                    job_id=task.job_id, stage=stage_id,
+                    partition=up_part, channel=chan), _WORKER_SERVICE,
+                    stats=stats)
+            except faults.WorkerCrash:
+                raise
+            except (grpc.RpcError, faults.FaultInjectedError) as e:
+                raise _FetchFailed(stage_id, up_part) from e
+
+        parts: Dict[int, Dict[int, object]] = {}
+        mp = MultiPrefetcher(work, fetch_one,
+                             workers=sh.fetch_concurrency(),
+                             kind="shuffle")
+        try:
+            for index, table in mp:
+                stage_id, pos = work[index][0], work[index][1]
+                parts.setdefault(stage_id, {})[pos] = table
+        finally:
+            mp.close()
+            wait = mp.stats.consumer_wait_s
+            _record_metric("execution.shuffle.fetch_wait_time", wait)
+            if stats is not None:
+                stats.add(wait_s=wait)
+        tables: Dict[int, object] = {}
+        for stage_id, n in input_len.items():
+            ordered = [parts[stage_id][i] for i in range(n)]
+            tables[stage_id] = pa.concat_tables(
+                ordered, promote_options="permissive") if len(ordered) > 1 \
+                else ordered[0]
         return tables
 
     def _run_task(self, task: pb.TaskDefinition, parent=None, ev=None):
@@ -491,6 +539,7 @@ class WorkerActor(Actor):
         # nor unregister a relaunched attempt
         if ev is None:
             ev = threading.Event()
+        fetch_stats = sh.FetchStats()
         try:
             faults.inject("worker.task_exec",
                           key=f"{self.worker_id}:s{task.stage}"
@@ -498,7 +547,7 @@ class WorkerActor(Actor):
             self._report(task, "running")
             plan = jg.decode_fragment(task.plan, task.partition,
                                       max(task.num_partitions, 1))
-            plan = _resolve_driver_scans(plan, task)
+            plan = _resolve_driver_scans(plan, task, fetch_stats)
             if task.runtime_filters_json:
                 # driver-derived runtime join filters: prune this task's
                 # scan before upload/shuffle (applied before stage inputs
@@ -506,7 +555,8 @@ class WorkerActor(Actor):
                 plan = jg.apply_task_runtime_filters(
                     plan, task.runtime_filters_json)
             if task.inputs:
-                plan = jg.attach_stage_inputs(plan, self._fetch_inputs(task))
+                plan = jg.attach_stage_inputs(
+                    plan, self._fetch_inputs(task, fetch_stats))
             if ev.is_set():
                 self._report(task, "canceled")
                 return
@@ -539,13 +589,20 @@ class WorkerActor(Actor):
                 parts = jg.hash_partition_table(
                     table, list(sw.key_columns), sw.num_channels)
                 channels: Dict[int, bytes] = {
-                    c: _table_to_ipc(part) for c, part in enumerate(parts)}
+                    c: sh.encode_table(part)
+                    for c, part in enumerate(parts)}
             else:
-                channels = {-1: _table_to_ipc(table)}
+                channels = {-1: sh.encode_table(table)}
             self.streams.put(task.job_id, task.stage, task.partition,
                              channels)
+            # channel-size metadata rides the success report: the driver's
+            # memory governor projects consumer footprints from it
+            channel_bytes = [len(channels[c]) for c in sorted(channels)]
             self._report(task, "succeeded", rows=table.num_rows,
-                         metrics_json=metrics_json)
+                         metrics_json=metrics_json,
+                         channel_bytes=channel_bytes,
+                         raw_bytes=int(table.nbytes),
+                         fetch_stats=fetch_stats)
         except faults.WorkerCrash:
             # injected process death: no failure report, no cleanup — the
             # driver's heartbeat eviction path must pick up the pieces
@@ -569,7 +626,10 @@ class WorkerActor(Actor):
                         self._running.pop(key, None)
 
     def _report(self, task: pb.TaskDefinition, state: str, error: str = "",
-                rows: int = 0, metrics_json: str = ""):
+                rows: int = 0, metrics_json: str = "",
+                channel_bytes: Optional[List[int]] = None,
+                raw_bytes: int = 0,
+                fetch_stats: Optional[sh.FetchStats] = None):
         """Report task status with backoff retries: a worker that cannot
         reach the driver for one transient blip must not lose a finished
         task's result until heartbeat eviction re-runs it from scratch."""
@@ -580,7 +640,11 @@ class WorkerActor(Actor):
                 worker_id=self.worker_id, job_id=task.job_id,
                 stage=task.stage, partition=task.partition,
                 attempt=task.attempt, state=state, error=error,
-                rows_out=rows, metrics_json=metrics_json),
+                rows_out=rows, metrics_json=metrics_json,
+                channel_bytes=channel_bytes or [],
+                raw_bytes=int(raw_bytes),
+                fetch_wait_s=fetch_stats.wait_s if fetch_stats else 0.0,
+                decode_s=fetch_stats.decode_s if fetch_stats else 0.0),
                 pb.ReportTaskStatusResponse)
         except faults.WorkerCrash:
             self._die()
@@ -614,19 +678,20 @@ class _FetchFailed(Exception):
         self.partition = partition
 
 
-def _resolve_driver_scans(plan, task: pb.TaskDefinition):
+def _resolve_driver_scans(plan, task: pb.TaskDefinition,
+                          stats: Optional[sh.FetchStats] = None):
     """Fetch this task's slice of driver-hosted memory tables."""
     import dataclasses as dc
     from ..plan import nodes as pn
 
     def repl(p):
         if isinstance(p, pn.ScanExec) and p.format == "__driver__":
-            buf = _fetch_from(task.driver_addr, pb.FetchStreamRequest(
+            table = _fetch_table(task.driver_addr, pb.FetchStreamRequest(
                 job_id=task.job_id, scan_id=p.table_name,
                 partition=task.partition,
                 num_partitions=max(task.num_partitions, 1)),
-                _DRIVER_SERVICE)
-            return dc.replace(p, source=_ipc_to_table(buf), format="memory",
+                _DRIVER_SERVICE, stats=stats)
+            return dc.replace(p, source=table, format="memory",
                               table_name="")
         if isinstance(p, pn.JoinExec):
             return dc.replace(p, left=repl(p.left), right=repl(p.right))
@@ -684,6 +749,22 @@ class _Job:
         self.spec_launched = 0
         self.spec_won = 0
         self.canceled = False
+        # data-movement accounting learned from task reports: per
+        # (stage, partition) → (compressed bytes per channel, raw bytes)
+        # — the memory governor projects consumer-task footprints from
+        # these — plus job-level wire/fetch/decode totals for the profile
+        self.channel_bytes: Dict[Tuple[int, int],
+                                 Tuple[List[int], int]] = {}
+        self.wire_raw = 0
+        self.wire_comp = 0
+        self.fetch_wait_s = 0.0
+        self.decode_s = 0.0
+        # memory governor: tasks deferred because no worker could admit
+        # their projected input footprint — (stage, partition, attempt,
+        # exclude) relaunched as capacity frees
+        self.deferred: List[Tuple[int, int, int,
+                                  Optional[frozenset]]] = []
+        self.governor_deferred = 0
         # per-{stage, partition} operator metrics from the winning task
         # attempt: {"worker_id", "rows_out", "operators": [...]}
         self.task_metrics: Dict[Tuple[int, int], dict] = {}
@@ -725,6 +806,13 @@ class DriverActor(Actor):
         self.HEARTBEAT_TIMEOUT_S = _num(
             "cluster.worker_heartbeat_timeout_secs", 10.0)
         self.MAX_TASK_ATTEMPTS = _num("cluster.task_max_attempts", 3, int)
+        # memory-footprint task governor: admit tasks per worker by
+        # projected input bytes (decoded, learned from producer channel
+        # sizes) against this budget instead of pure slot count; 0
+        # disables. An idle worker always admits one task, so the
+        # governor can throttle but never deadlock a job.
+        self.memory_budget_bytes = max(
+            0, _num("cluster.memory_budget_mb", 512, int)) << 20
         # worker quarantine: N reported task failures inside a sliding
         # window blacklist the worker for a cool-off period
         self.quarantine = {
@@ -841,6 +929,8 @@ class DriverActor(Actor):
                 "channel": grpc.insecure_channel(f"{r.host}:{r.port}"),
                 "tasks": set(),
                 "idle_since": time.time(),
+                "projected": 0,
+                "task_proj": {},
             }
             if self._starting_ts:
                 self._starting_ts.pop(0)
@@ -866,6 +956,10 @@ class DriverActor(Actor):
                 reply.set(job)
         elif kind == "task_status":
             self._on_task_status(payload)
+            job = self.jobs.get(payload.job_id)
+            if job is not None and not job.done.is_set():
+                # a terminal report may have freed governor capacity
+                self._drain_deferred(job)
         elif kind == "cancel":
             job_id, reason = payload
             self._cancel_job(job_id, reason)
@@ -945,6 +1039,11 @@ class DriverActor(Actor):
         for wid in lost:
             self._evict_worker(wid, "lost")
         self._maybe_speculate(now)
+        # governor backstop: deferred tasks retry every probe even when
+        # no terminal report fires (e.g. capacity freed by eviction)
+        for job in list(self.jobs.values()):
+            if not job.done.is_set():
+                self._drain_deferred(job)
 
     def _evict_worker(self, wid: str, reason: str):
         """Remove a dead/blacklisted worker and repair every live job:
@@ -1011,6 +1110,85 @@ class DriverActor(Actor):
         must not reduce how many real failures the task can survive."""
         return self.MAX_TASK_ATTEMPTS + \
             job.attempt_allowance.get((stage, partition), 0)
+
+    # -- memory-footprint task governor ---------------------------------
+    def _projected_task_bytes(self, job: _Job, stage_id: int,
+                              partition: int) -> Optional[int]:
+        """Project one pending task's decoded input footprint from the
+        per-channel byte sizes its producers reported: shuffle inputs
+        take their hash channel from every producer partition, forward
+        inputs the matching partition, merge/broadcast everything. Wire
+        bytes scale by each producer's raw/compressed ratio so the
+        budget compares decoded (in-memory) bytes. None = some producer
+        size is still unknown → fall back to slot scheduling."""
+        stage = job.graph.stages[stage_id]
+        if not stage.inputs:
+            return None  # leaf scans: no learned sizes to project from
+        total = 0
+        for i in stage.inputs:
+            up = job.graph.stages[i.stage_id]
+            if i.mode == jg.InputMode.FORWARD:
+                # a pipelined FORWARD consumer reads ONLY its matching
+                # producer partition — and launches while sibling
+                # partitions are still running, so requiring every
+                # producer size here would disable the governor for
+                # pipelined stages entirely
+                entry = job.channel_bytes.get((i.stage_id, partition))
+                if entry is None:
+                    return None
+                chans, raw = entry
+                comp_total = sum(chans)
+                scale = (raw / comp_total) if comp_total else 1.0
+                total += int(sum(chans) * scale)
+                continue
+            for p in range(up.num_partitions):
+                entry = job.channel_bytes.get((i.stage_id, p))
+                if entry is None:
+                    return None
+                chans, raw = entry
+                comp_total = sum(chans)
+                scale = (raw / comp_total) if comp_total else 1.0
+                if i.mode == jg.InputMode.SHUFFLE:
+                    wire = chans[partition] if partition < len(chans) \
+                        else 0
+                else:  # merge | broadcast
+                    wire = sum(chans)
+                total += int(wire * scale)
+        return total
+
+    @staticmethod
+    def _release_task(w: dict, key: Tuple[str, int, int]) -> None:
+        """Unregister a task from a worker AND release its admitted
+        footprint from the governor's per-worker projection."""
+        w["tasks"].discard(key)
+        proj = w.get("task_proj", {}).pop(key, 0)
+        if proj:
+            w["projected"] = max(0, w.get("projected", 0) - proj)
+
+    def _drain_deferred(self, job: _Job) -> None:
+        """Relaunch governor-deferred tasks now that capacity may have
+        freed; a task that still does not fit simply re-defers."""
+        if job.done.is_set():
+            job.deferred = []
+            return
+        if not job.deferred:
+            return
+        pending, job.deferred = job.deferred, []
+        for entry in pending:
+            stage_id, partition, attempt, exclude = entry
+            if partition in job.locations[stage_id] or \
+                    job.live.get((stage_id, partition)):
+                continue  # covered by another path in the meantime
+            # an input producer may have been EVICTED between deferral
+            # and drain: launching now would fail the whole job on the
+            # incomplete-input guard, so stay parked until the producer
+            # re-run restores the location (probe ticks retry)
+            if not self._partition_ready(job, job.graph.stages[stage_id],
+                                         partition):
+                job.deferred.append(entry)
+                continue
+            self._launch_task(job, stage_id, partition, attempt,
+                              exclude=set(exclude) if exclude else None)
 
     # -- scheduling ------------------------------------------------------
     def _stage_complete(self, job: _Job, stage_id: int) -> bool:
@@ -1106,6 +1284,10 @@ class DriverActor(Actor):
             task.shuffle_write.CopyFrom(pb.ShuffleWriteSpec(
                 key_columns=list(stage.shuffle_keys),
                 num_channels=stage.num_channels))
+        # memory governor: project this task's input footprint once; the
+        # admission check runs against each candidate worker below
+        proj = self._projected_task_bytes(job, stage_id, partition) \
+            if self.memory_budget_bytes > 0 else None
         # dispatch loop (NOT recursion): a flapping pool can no longer
         # blow the stack, and each failed dispatch evicts its worker and
         # reschedules ALL of that worker's running tasks, not just this
@@ -1129,11 +1311,37 @@ class DriverActor(Actor):
                 job.failed = "no live workers"
                 job.done.set()
                 return False
+            if proj is not None:
+                # admit by projected bytes against the budget; a worker
+                # with no admitted tasks always admits one (progress
+                # guarantee), so the governor throttles wide shuffles
+                # without ever deadlocking a job
+                admissible = [
+                    (wid, w) for wid, w in candidates
+                    if not w["tasks"] or
+                    w.get("projected", 0) + proj <= self.memory_budget_bytes]
+                if not admissible:
+                    if speculative:
+                        return False  # never park a duplicate
+                    job.deferred.append((
+                        stage_id, partition, attempt,
+                        frozenset(exclude) if exclude else None))
+                    job.governor_deferred += 1
+                    _record_metric("cluster.governor.deferred_count", 1)
+                    return True  # parked: _drain_deferred relaunches
+                candidates = admissible
             wid, w = candidates[0]
             if self.elastic is not None and len(w["tasks"]) >= w["slots"]:
                 self._maybe_scale_up()
             w["tasks"].add((job.job_id, stage_id, partition))
             w["idle_since"] = None
+            if proj is not None:
+                w.setdefault("task_proj", {})[
+                    (job.job_id, stage_id, partition)] = proj
+                w["projected"] = w.get("projected", 0) + proj
+                _record_metric("cluster.governor.admitted_count", 1)
+                _record_metric("cluster.governor.projected_bytes",
+                               w["projected"])
             rpc = w["channel"].unary_unary(
                 f"/{_WORKER_SERVICE}/RunTask",
                 request_serializer=lambda m: m.SerializeToString(),
@@ -1172,7 +1380,7 @@ class DriverActor(Actor):
                 # dispatch failure = dead worker: evict it (rescheduling
                 # its OTHER tasks) and redo the SAME attempt elsewhere (a
                 # launch failure is not a task failure)
-                w["tasks"].discard((job.job_id, stage_id, partition))
+                self._release_task(w, (job.job_id, stage_id, partition))
                 self._evict_worker(wid, "dispatch-failure")
                 _record_metric("cluster.task.retry_count", 1,
                                reason="dispatch")
@@ -1208,7 +1416,7 @@ class DriverActor(Actor):
                 return
             job.seen_reports.add(rk)
             if w is not None:
-                w["tasks"].discard((r.job_id, r.stage, r.partition))
+                self._release_task(w, (r.job_id, r.stage, r.partition))
                 if not w["tasks"]:
                     w["idle_since"] = time.time()
         if r.state == "succeeded":
@@ -1240,6 +1448,15 @@ class DriverActor(Actor):
                     r.attempt == job.spec_attempt.get(key):
                 job.spec_won += 1
                 _record_metric("cluster.task.speculative_won", 1)
+            # data-movement metadata from the winning attempt: feeds the
+            # governor's projections and the profile's shuffle line
+            if r.channel_bytes:
+                job.channel_bytes[key] = (list(r.channel_bytes),
+                                          int(r.raw_bytes))
+                job.wire_comp += sum(r.channel_bytes)
+            job.wire_raw += int(r.raw_bytes)
+            job.fetch_wait_s += float(r.fetch_wait_s)
+            job.decode_s += float(r.decode_s)
             job.locations[r.stage][r.partition] = w["addr"]
             job.stage_rows[r.stage] = \
                 job.stage_rows.get(r.stage, 0) + int(r.rows_out)
@@ -1364,6 +1581,8 @@ class DriverActor(Actor):
             "channel": grpc.insecure_channel(info["addr"]),
             "tasks": set(),
             "idle_since": time.time(),
+            "projected": 0,
+            "task_proj": {},
         }
         _record_metric("cluster.worker_count", len(self.workers))
 
@@ -1436,7 +1655,7 @@ class DriverActor(Actor):
         for wid, w in list(self.workers.items()):
             for (j, s, p) in [t for t in w["tasks"] if t[0] == job_id]:
                 self._stop_task_on(wid, job_id, s, p, "cancel")
-                w["tasks"].discard((j, s, p))
+                self._release_task(w, (j, s, p))
             if not w["tasks"] and w.get("idle_since") is None:
                 w["idle_since"] = time.time()
 
@@ -1580,20 +1799,39 @@ class LocalCluster:
                     raise RuntimeError(f"cluster job {job.failed}")
                 raise RuntimeError(f"cluster job failed: {job.failed}")
             # the root stage runs on the driver over MERGE input fetched
-            # from the workers via the data plane
+            # from the workers via the data plane — all partitions
+            # stream concurrently through the bounded fetch pool
             root = graph.root
-            tables = {}
-            for i in root.inputs:
-                up = graph.stages[i.stage_id]
-                parts = []
-                for p in range(up.num_partitions):
-                    addr = job.locations[i.stage_id][p]
-                    buf = _fetch_from(addr, pb.FetchStreamRequest(
-                        job_id=job.job_id, stage=i.stage_id, partition=p,
-                        channel=-1), _WORKER_SERVICE)
-                    parts.append(_ipc_to_table(buf))
-                tables[i.stage_id] = pa.concat_tables(
-                    parts, promote_options="permissive")
+            stats = sh.FetchStats()
+            work = [(i.stage_id, p, job.locations[i.stage_id][p])
+                    for i in root.inputs
+                    for p in range(
+                        graph.stages[i.stage_id].num_partitions)]
+
+            def fetch_one(item):
+                stage_id, p, addr = item
+                return _fetch_table(addr, pb.FetchStreamRequest(
+                    job_id=job.job_id, stage=stage_id, partition=p,
+                    channel=-1), _WORKER_SERVICE, stats=stats)
+
+            parts: Dict[int, Dict[int, object]] = {}
+            mp = MultiPrefetcher(work, fetch_one,
+                                 workers=sh.fetch_concurrency(),
+                                 kind="shuffle")
+            try:
+                for index, table in mp:
+                    stage_id, p = work[index][0], work[index][1]
+                    parts.setdefault(stage_id, {})[p] = table
+            finally:
+                mp.close()
+                _record_metric("execution.shuffle.fetch_wait_time",
+                               mp.stats.consumer_wait_s)
+                stats.add(wait_s=mp.stats.consumer_wait_s)
+            tables = {
+                sid: pa.concat_tables(
+                    [by_part[p] for p in range(len(by_part))],
+                    promote_options="permissive")
+                for sid, by_part in parts.items()}
             root_plan = jg.attach_stage_inputs(root.plan, tables)
             # memory scans that stayed in the driver-run root plan read the
             # driver's own table map directly
@@ -1612,6 +1850,12 @@ class LocalCluster:
                     retries=job.retry_count,
                     speculative_launched=job.spec_launched,
                     speculative_won=job.spec_won)
+                prof.note_shuffle(
+                    wire_bytes=job.wire_raw,
+                    wire_bytes_compressed=job.wire_comp,
+                    fetch_wait_s=job.fetch_wait_s + stats.wait_s,
+                    decode_s=job.decode_s + stats.decode_s,
+                    governor_deferred=job.governor_deferred)
             return result
         finally:
             self.driver.handle.send(("cleanup", job.job_id))

@@ -142,11 +142,12 @@ def _enc(obj):
     if isinstance(obj, datetime.timedelta):
         return ["!td", obj.total_seconds()]
     if isinstance(obj, pa.Table):
-        sink = pa.BufferOutputStream()
-        with pa.ipc.new_stream(sink, obj.schema) as w:
-            w.write_table(obj)
+        # plan-fragment embedding is control-plane traffic: uncompressed
+        # (base64 JSON dominates anyway) and excluded from the wire-byte
+        # counters the data plane reports
+        from . import shuffle as sh
         return ["!table", base64.b64encode(
-            sink.getvalue().to_pybytes()).decode()]
+            sh.encode_table(obj, codec=None, record=False)).decode()]
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         tag = _tag_of(obj)
         if tag not in _CODEC_TYPES:

@@ -1,0 +1,213 @@
+"""Compressed streaming shuffle data plane.
+
+Reference role: Theseus' thesis (arXiv:2508.05029, PAPERS.md) that at
+scale a distributed engine is a data-movement scheduler — the wire and
+spill formats, not the operators, dominate join/agg-heavy suites once
+compute is fused. This module is the data-plane vocabulary shared by the
+cluster runtime (exec/cluster.py):
+
+- **Wire + spill format**: Arrow IPC streams with lz4/zstd body
+  compression (``shuffle.compression``: lz4 | zstd | none, default lz4)
+  applied uniformly to FetchStream responses, ``_StreamStore`` spill
+  files, and broadcast/driver-result transfers. Compression is recorded
+  per IPC message, so READERS AUTO-DETECT the codec from the stream —
+  mixed-codec and A/B runs interoperate with no negotiation.
+- **Chunked streaming**: tables encode in bounded record batches
+  (``ENCODE_CHUNK_ROWS``) and decode incrementally off a chunk iterator
+  (:class:`ChunkReader` + :func:`decode_stream`) instead of
+  concatenating the whole byte stream first; the spill format IS the
+  wire format, so a spilled channel serves straight from disk in
+  bounded reads with no rehydration under the memory cap.
+- **Observability**: ``execution.shuffle.{wire_bytes,
+  wire_bytes_compressed, spill_bytes_compressed, fetch_wait_time,
+  decode_time}`` make the movement plane as measurable as the compute
+  plane; :class:`FetchStats` accumulates the same numbers per task so
+  they ride task reports into the driver's query profile.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, Optional
+
+from ..metrics import record as _record_metric
+
+#: serve-side chunk size for FetchStream responses and spill reads
+CHUNK_BYTES = 1 << 20
+
+#: record-batch granularity of encoded streams — the decode side's
+#: working set per message is bounded by this many rows, not the table
+ENCODE_CHUNK_ROWS = 1 << 16
+
+_CODEC_NONE = ("none", "off", "uncompressed", "false", "0", "")
+
+
+def wire_codec() -> Optional[str]:
+    """Resolve ``shuffle.compression`` to a pyarrow IPC codec name
+    (``lz4``/``zstd``) or None (uncompressed). Unknown spellings fall
+    back to the lz4 default rather than failing the data plane."""
+    from ..config import get as config_get
+    value = str(config_get("shuffle.compression", "lz4") or "lz4")
+    value = value.strip().lower()
+    if value in _CODEC_NONE:
+        return None
+    if value not in ("lz4", "zstd"):
+        return "lz4"
+    return value
+
+
+def fetch_concurrency() -> int:
+    """``shuffle.fetch_concurrency``: concurrent stage-input fetches per
+    task (0/1 = sequential)."""
+    from ..config import get as config_get
+    try:
+        return max(0, int(config_get("shuffle.fetch_concurrency", 4)))
+    except (TypeError, ValueError):
+        return 4
+
+
+_SENTINEL_CODEC = object()
+
+
+def encode_table(table, codec=_SENTINEL_CODEC, record: bool = True) -> bytes:
+    """Encode a table as a (possibly compressed) Arrow IPC stream in
+    bounded record batches. Records the raw-vs-wire byte counters unless
+    ``record`` is off (plan-fragment embedding is not data-plane
+    traffic)."""
+    import pyarrow as pa
+    if codec is _SENTINEL_CODEC:
+        codec = wire_codec()
+    opts = pa.ipc.IpcWriteOptions(compression=codec)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema, options=opts) as w:
+        w.write_table(table, max_chunksize=ENCODE_CHUNK_ROWS)
+    buf = sink.getvalue().to_pybytes()
+    if record:
+        _record_metric("execution.shuffle.wire_bytes", int(table.nbytes))
+        _record_metric("execution.shuffle.wire_bytes_compressed", len(buf))
+    return buf
+
+
+@dataclass
+class FetchStats:
+    """Per-task fetch accounting, accumulated across concurrent fetch
+    threads (hence the lock) and shipped on the task's success report so
+    the driver's query profile sees the movement plane."""
+
+    wire_bytes: int = 0       # compressed bytes off the wire
+    decode_s: float = 0.0     # IPC decode time (excl. stream wait)
+    wait_s: float = 0.0       # consumer blocked waiting on fetches
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
+
+    def add(self, wire_bytes: int = 0, decode_s: float = 0.0,
+            wait_s: float = 0.0) -> None:
+        with self._lock:
+            self.wire_bytes += int(wire_bytes)
+            self.decode_s += float(decode_s)
+            self.wait_s += float(wait_s)
+
+
+class ChunkReader:
+    """File-like adapter over an iterator of byte chunks, so pyarrow's
+    IPC stream reader decodes record batches incrementally off a gRPC
+    response stream (no ``b"".join`` of the whole channel first). Time
+    blocked pulling the next chunk accrues to ``wait_s`` so decode time
+    can be reported net of network wait."""
+
+    def __init__(self, chunks: Iterable[bytes]):
+        self._it = iter(chunks)
+        self._buf = b""
+        self.closed = False
+        self.wait_s = 0.0
+        self.nbytes = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return False
+
+    def seekable(self) -> bool:
+        return False
+
+    def close(self) -> None:
+        self.closed = True
+        self._it = iter(())
+
+    def _pull(self) -> bool:
+        t0 = time.perf_counter()
+        try:
+            chunk = next(self._it)
+        except StopIteration:
+            return False
+        finally:
+            self.wait_s += time.perf_counter() - t0
+        self._buf += chunk
+        self.nbytes += len(chunk)
+        return True
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            while self._pull():
+                pass
+            out, self._buf = self._buf, b""
+            return out
+        while len(self._buf) < n:
+            if not self._pull():
+                break
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+
+def decode_stream(source, stats: Optional[FetchStats] = None):
+    """Decode an Arrow IPC stream (bytes, file-like, or
+    :class:`ChunkReader`) into a table, record batch by record batch.
+    The codec is auto-detected from the stream, so readers accept any
+    producer codec. Decode wall time (net of chunk wait for a
+    ChunkReader) lands in ``execution.shuffle.decode_time``."""
+    import pyarrow as pa
+    t0 = time.perf_counter()
+    reader = pa.ipc.open_stream(source)
+    batches = [b for b in reader]
+    table = pa.Table.from_batches(batches, schema=reader.schema)
+    elapsed = time.perf_counter() - t0
+    wait = source.wait_s if isinstance(source, ChunkReader) else 0.0
+    decode_s = max(0.0, elapsed - wait)
+    try:
+        _record_metric("execution.shuffle.decode_time", decode_s)
+    except Exception:  # noqa: BLE001 — telemetry never fails the fetch
+        pass
+    if stats is not None:
+        wire = source.nbytes if isinstance(source, ChunkReader) \
+            else len(source) if isinstance(source, (bytes, bytearray)) else 0
+        stats.add(wire_bytes=wire, decode_s=decode_s)
+    return table
+
+
+def iter_buffer_chunks(buf: bytes,
+                       chunk_bytes: int = CHUNK_BYTES) -> Iterator[bytes]:
+    """Slice an in-memory channel into bounded wire chunks."""
+    for off in range(0, max(len(buf), 1), chunk_bytes):
+        yield buf[off:off + chunk_bytes]
+
+
+def iter_file_chunks(f, chunk_bytes: int = CHUNK_BYTES) -> Iterator[bytes]:
+    """Stream an open spill file in bounded reads; the file handle is
+    closed when the iterator is exhausted or dropped. The file was
+    opened BEFORE the first yield, so a concurrent unlink (clean_job)
+    cannot turn a mid-stream read into a missing-channel error."""
+    try:
+        empty = True
+        while True:
+            chunk = f.read(chunk_bytes)
+            if not chunk:
+                break
+            empty = False
+            yield chunk
+        if empty:
+            yield b""
+    finally:
+        f.close()

@@ -447,14 +447,18 @@ def _parse_delay_cached(s: str):
 
 def _delay_micros(s):
     """Per-row duration for dynamic session_window gaps: duration
-    strings ('5 minutes'), interval runtime values (timedelta), or raw
-    microsecond counts."""
+    strings ('5 minutes') or interval runtime values (timedelta). Bare
+    numerics raise — Spark requires a duration/interval gap, and
+    silently reading a number as a microsecond count would misinterpret
+    a seconds/millis column without any signal."""
     if s is None:
         return None
     if isinstance(s, datetime.timedelta):
         return int(s.total_seconds() * 1_000_000)
-    if isinstance(s, (int, float)):
-        return int(s)
+    if isinstance(s, (bool, int, float)):
+        raise ValueError(
+            "session_window gap must be a duration string or interval, "
+            f"got numeric value {s!r}")
     return _parse_delay_cached(str(s))
 
 

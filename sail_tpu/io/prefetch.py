@@ -227,6 +227,158 @@ class Prefetcher(Iterator):
             pass
 
 
+def _multi_produce(work: queue.Queue, fn: Callable, q: queue.Queue,
+                   cancel: threading.Event, stats: PrefetchStats) -> None:
+    """Shared-work-queue producer body (module-level for the same
+    GC-reachability reason as :func:`_produce`): drain ``work`` items,
+    apply ``fn``, and publish ``(index, result)``. First error wins —
+    it rides an envelope and the consumer's close() cancels peers."""
+    while not cancel.is_set():
+        try:
+            index, item = work.get_nowait()
+        except queue.Empty:
+            return
+        try:
+            out = fn(item)
+        except BaseException as exc:  # noqa: BLE001 — relayed, not dropped
+            _bounded_put(q, cancel, _ProducerError(exc), None)
+            return
+        if not _bounded_put(q, cancel, (index, out), stats):
+            return
+
+
+class MultiPrefetcher(Iterator):
+    """N producers over one work list: yields ``(index, fn(item))`` in
+    COMPLETION order for every ``items[index]``, with up to ``workers``
+    items in flight (the generalization of :class:`Prefetcher` to N
+    concurrent producers the shuffle fetch path needs — a task's stage
+    inputs all stream together, overlapping network + decode across
+    partitions instead of fetching one buffer at a time).
+
+    Same contract as Prefetcher: the first producer error re-raises at
+    the consumer (remaining work is cancelled), ``close()`` cancels +
+    drains + joins and is run by ``with`` exit / exhaustion /
+    abandonment, and overlap wait times accumulate in ``stats``.
+    ``workers <= 1`` degrades to a fully synchronous in-order loop
+    sharing the consumer code path."""
+
+    def __init__(self, items, fn: Callable, workers: int = 4,
+                 depth: Optional[int] = None, kind: str = "shuffle"):
+        self._items = list(items)
+        self._fn = fn
+        n = len(self._items)
+        workers = min(max(0, int(workers)), max(n, 1))
+        self.stats = PrefetchStats(kind=kind, depth=workers)
+        self._flushed = False
+        self._done = False
+        self._emitted = 0
+        self._threads: list = []
+        self._q: Optional[queue.Queue] = None
+        if workers <= 1 or n <= 1:
+            self._seq = iter(enumerate(self._items))
+            return
+        self._seq = None
+        work: queue.Queue = queue.Queue()
+        for pair in enumerate(self._items):
+            work.put(pair)
+        self._q = queue.Queue(maxsize=max(depth or n, 1))
+        self._cancel = threading.Event()
+        # per-thread stats merge at close: concurrent += on one shared
+        # PrefetchStats would race away increments
+        self._thread_stats = [PrefetchStats(kind=kind, depth=workers)
+                              for _ in range(workers)]
+        for i in range(workers):
+            t = threading.Thread(
+                target=_multi_produce,
+                args=(work, self._fn, self._q, self._cancel,
+                      self._thread_stats[i]),
+                name=f"sail-mfetch-{kind}-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def __iter__(self) -> "MultiPrefetcher":
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        if self._seq is not None:  # synchronous passthrough
+            t0 = time.perf_counter()
+            try:
+                index, item = next(self._seq)
+            except StopIteration:
+                self.close()
+                raise
+            try:
+                out = self._fn(item)
+            except BaseException as exc:  # noqa: BLE001 — PEP 479 below
+                self.close()
+                raise Prefetcher._wrap_stop(exc)
+            self.stats.consumer_wait_s += time.perf_counter() - t0
+            self.stats.chunks += 1
+            return index, out
+        if self._emitted >= len(self._items):
+            self.close()
+            raise StopIteration
+        t0 = time.perf_counter()
+        obj = self._q.get()
+        self.stats.consumer_wait_s += time.perf_counter() - t0
+        if isinstance(obj, _ProducerError):
+            self.close()
+            raise Prefetcher._wrap_stop(obj.exc)
+        self._emitted += 1
+        self.stats.chunks += 1
+        return obj
+
+    #: how long close() waits for producers before abandoning them —
+    #: a producer stuck INSIDE fn (e.g. a gRPC fetch running out its
+    #: deadline against a blackholed peer) cannot be interrupted, and
+    #: the first-error-wins contract must not stall on it: the threads
+    #: are daemons, the cancel flag makes every queue put a no-op, and
+    #: they exit on their own once the in-flight call returns
+    CLOSE_JOIN_TIMEOUT_S = 1.0
+
+    def close(self) -> None:
+        """Cancel outstanding work, drain, join (bounded), flush.
+        Idempotent."""
+        self._done = True
+        if self._threads:
+            self._cancel.set()
+            deadline = time.perf_counter() + self.CLOSE_JOIN_TIMEOUT_S
+            while any(t.is_alive() for t in self._threads) and \
+                    time.perf_counter() < deadline:
+                try:
+                    while True:
+                        self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                for t in self._threads:
+                    t.join(timeout=0.05)
+            for ts in self._thread_stats:
+                self.stats.producer_wait_s += ts.producer_wait_s
+            self._threads = []
+            self._thread_stats = []
+        self._fn = None
+        self._items = []
+        self._q = None
+        self._seq = iter(())
+        if not self._flushed:
+            self._flushed = True
+            self.stats.flush()
+
+    def __enter__(self) -> "MultiPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # abandonment safety net; close() is the contract
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
 def prefetch_depth(config: dict, default: int = 2) -> int:
     """Resolve ``spark.sail.scan.prefetchDepth`` from a session config
     dict; malformed values fall back to the default (pipelined)."""

@@ -74,6 +74,18 @@ def _dict_strings(dictionary: pa.Array) -> List[Optional[str]]:
     return dictionary.cast(pa.string()).to_pylist()
 
 
+
+def _lut_take(lut, codes):
+    """Gather per-dictionary-code LUT values, tolerating an EMPTY lut:
+    an empty partition slice (cluster tasks slice memory tables) has an
+    empty dictionary, but device batches keep capacity >= 1 — jax
+    rejects a gather from a 0-length array at trace time even though
+    every padding row is validity-masked. Pad to one neutral entry."""
+    arr = jnp.asarray(lut)
+    if arr.shape[0] == 0:
+        arr = jnp.zeros((1,) + arr.shape[1:], dtype=arr.dtype)
+    return arr[codes]
+
 def like_pattern_to_regex(pattern: str, escape: Optional[str] = None) -> str:
     esc = escape or "\\"
     out = []
@@ -230,8 +242,8 @@ class ExprCompiler:
 
         def fn(cols, lut=lut, ok_lut=ok_lut):
             data, validity = child.fn(cols)
-            vals = jnp.asarray(lut)[data]
-            good = jnp.asarray(ok_lut)[data]
+            vals = _lut_take(lut, data)
+            good = _lut_take(ok_lut, data)
             v = good if validity is None else (validity & good)
             return vals, v
 
@@ -359,8 +371,8 @@ class ExprCompiler:
                 def fn(cols, lut_a=lut_a, lut_b=lut_b):
                     ad, av = a.fn(cols)
                     bd, bv = b.fn(cols)
-                    x = jnp.asarray(lut_a)[ad]
-                    y = jnp.asarray(lut_b)[bd]
+                    x = _lut_take(lut_a, ad)
+                    y = _lut_take(lut_b, bd)
                     res = _CMP_OPS[name](x, y)
                     if name == "<=>":
                         return K.eq_null_safe((x, av), (y, bv))
@@ -400,7 +412,7 @@ class ExprCompiler:
 
             def fn3(cols, lut=lut):
                 dta, v = child.fn(cols)
-                return jnp.asarray(lut)[dta], v
+                return _lut_take(lut, dta), v
 
             return Compiled(fn3, dt.BooleanType())
 
@@ -416,7 +428,7 @@ class ExprCompiler:
 
             def fn4(cols, lut=lut):
                 dta, v = child.fn(cols)
-                return jnp.asarray(lut)[dta], v
+                return _lut_take(lut, dta), v
 
             return Compiled(fn4, dt.BooleanType())
 
@@ -432,7 +444,7 @@ class ExprCompiler:
                 def make(old=old, rm=rm):
                     def f2(cols):
                         d, v = old.fn(cols)
-                        return jnp.asarray(rm)[d], v
+                        return _lut_take(rm, d), v
                     return f2
 
                 new_args[i] = Compiled(make(), old.dtype, merged)
@@ -465,9 +477,9 @@ class ExprCompiler:
 
                 def fn5(cols, remap=remap, null_out=null_out):
                     d, v = child.fn(cols)
-                    mapped = jnp.asarray(remap)[d]
+                    mapped = _lut_take(remap, d)
                     if null_out is not None:
-                        good = jnp.asarray(null_out)[d]
+                        good = _lut_take(null_out, d)
                         v = good if v is None else (v & good)
                     return mapped, v
 
@@ -478,8 +490,8 @@ class ExprCompiler:
 
             def fn6(cols, lut=lut, ok=ok):
                 dta, v = child.fn(cols)
-                data = jnp.asarray(lut)[dta]
-                good = jnp.asarray(ok)[dta]
+                data = _lut_take(lut, dta)
+                good = _lut_take(ok, dta)
                 return data, good if v is None else (v & good)
 
             return Compiled(fn6, r.dtype)

@@ -77,6 +77,14 @@ class QueryProfile:
     ft_retries: int = 0
     ft_speculative_launched: int = 0
     ft_speculative_won: int = 0
+    # shuffle data plane: raw vs compressed wire bytes published by this
+    # query's distributed tasks, consumer-side fetch wait + IPC decode
+    # time, and tasks the memory governor deferred for capacity
+    shuffle_wire_bytes: int = 0
+    shuffle_wire_compressed: int = 0
+    shuffle_fetch_wait_ms: float = 0.0
+    shuffle_decode_ms: float = 0.0
+    governor_deferred: int = 0
     # plan-invariant validator walks that ran for this query (optimizer
     # pass boundaries + job-graph stage checks)
     validated_passes: int = 0
@@ -174,6 +182,17 @@ class QueryProfile:
         with self._lock:
             self.validated_passes += int(passes)
 
+    def note_shuffle(self, wire_bytes: int = 0,
+                     wire_bytes_compressed: int = 0,
+                     fetch_wait_s: float = 0.0, decode_s: float = 0.0,
+                     governor_deferred: int = 0) -> None:
+        with self._lock:
+            self.shuffle_wire_bytes += int(wire_bytes)
+            self.shuffle_wire_compressed += int(wire_bytes_compressed)
+            self.shuffle_fetch_wait_ms += float(fetch_wait_s) * 1000.0
+            self.shuffle_decode_ms += float(decode_s) * 1000.0
+            self.governor_deferred += int(governor_deferred)
+
     def note_fusion(self, stages: int = 0, fused_ops: int = 0,
                     fallbacks: int = 0) -> None:
         with self._lock:
@@ -243,6 +262,13 @@ class QueryProfile:
                 "speculative_launched": self.ft_speculative_launched,
                 "speculative_won": self.ft_speculative_won,
             },
+            "shuffle": {
+                "wire_bytes": self.shuffle_wire_bytes,
+                "wire_bytes_compressed": self.shuffle_wire_compressed,
+                "fetch_wait_ms": round(self.shuffle_fetch_wait_ms, 3),
+                "decode_ms": round(self.shuffle_decode_ms, 3),
+                "governor_deferred": self.governor_deferred,
+            },
             "validated_passes": self.validated_passes,
             "fusion": {
                 "stages": self.fusion_stages,
@@ -280,6 +306,19 @@ class QueryProfile:
                 f"fault tolerance: retries={self.ft_retries} "
                 f"speculative={self.ft_speculative_launched} "
                 f"won={self.ft_speculative_won}")
+        if self.shuffle_wire_bytes or self.shuffle_fetch_wait_ms:
+            ratio = (self.shuffle_wire_bytes
+                     / self.shuffle_wire_compressed) \
+                if self.shuffle_wire_compressed else 0.0
+            line = (f"shuffle: wire={self.shuffle_wire_bytes}B "
+                    f"compressed={self.shuffle_wire_compressed}B")
+            if ratio:
+                line += f" ({ratio:.2f}x)"
+            line += (f" fetch_wait={self.shuffle_fetch_wait_ms:.1f}ms "
+                     f"decode={self.shuffle_decode_ms:.1f}ms")
+            if self.governor_deferred:
+                line += f" governor_deferred={self.governor_deferred}"
+            lines.append(line)
         if self.fusion_stages:
             extra = f" ({self.fusion_fused_ops} ops inlined"
             if self.fusion_fallbacks:

@@ -574,13 +574,25 @@ class SparkSession:
                 f"cannot ALTER a view: {'.'.join(cmd.name)}")
         empty = pa_mod.table({})
         if cmd.action == "rename":
-            # an unqualified new name stays in the SOURCE database
+            # an unqualified new name stays in the SOURCE database and
+            # the SOURCE catalog — a fully-qualified rename of a table
+            # in a non-current catalog must not migrate the entry into
+            # cm.current_catalog; cross-catalog renames are rejected
+            # outright (matching Spark)
+            src_cat = cmd.name[-3].lower() if len(cmd.name) >= 3 else (
+                str(entry.name[0]).lower() if len(entry.name) >= 3
+                else cm.current_catalog)
+            if len(cmd.new_name) >= 3 and \
+                    cmd.new_name[-3].lower() != src_cat:
+                raise ValueError(
+                    f"cannot rename across catalogs: "
+                    f"{'.'.join(cmd.name)} -> {'.'.join(cmd.new_name)}")
             src_db = cmd.name[-2] if len(cmd.name) >= 2 \
                 else cm.current_database
             new_db = cmd.new_name[-2] if len(cmd.new_name) >= 2 \
                 else src_db
             cm.drop_table(cmd.name)
-            entry.name = (cm.current_catalog, new_db, cmd.new_name[-1])
+            entry.name = (src_cat, new_db, cmd.new_name[-1])
             cm.register_table(entry)
             return empty
         if cmd.action in ("set_properties", "unset_properties"):
@@ -916,6 +928,12 @@ class SessionConf:
                  "spark.sail.cluster.quarantine.windowSecs"),
                 ("cluster.quarantine.duration_secs",
                  "spark.sail.cluster.quarantine.durationSecs"),
+                ("shuffle.compression",
+                 "spark.sail.shuffle.compression"),
+                ("shuffle.fetch_concurrency",
+                 "spark.sail.shuffle.fetchConcurrency"),
+                ("cluster.memory_budget_mb",
+                 "spark.sail.cluster.memoryBudgetMb"),
                 ("faults.spec", "spark.sail.faults.spec"),
                 ("faults.seed", "spark.sail.faults.seed"),
                 ("analysis.validate_plans",

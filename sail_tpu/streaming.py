@@ -275,6 +275,10 @@ class StreamingQuery:
         self._prev_result: Optional[pa.Table] = None
         self._checkpoint_dir = checkpoint_dir
         self._proc_lock = threading.Lock()
+        # highest batch id the offsets checkpoint has DURABLY recorded —
+        # commit-marker retention may only prune below this (a marker
+        # for a batch the checkpoint hasn't passed is still replayable)
+        self._last_ckpt_batch = 0
         if checkpoint_dir:
             self._restore_checkpoint()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -405,12 +409,17 @@ class StreamingQuery:
         _os.replace(tmp, marker)
         # retention: only markers >= the last checkpointed batch id can
         # ever be consulted on restart; prune far-older ones so a
-        # long-running query doesn't grow one file per trigger forever
+        # long-running query doesn't grow one file per trigger forever.
+        # The floor is the last SUCCESSFULLY CHECKPOINTED batch id, not
+        # the current one — if checkpointing stalls, every batch from
+        # the stalled offset on stays replayable and must keep its
+        # marker, or a restart would duplicate its sink output.
         if batch_id % 100 == 0:
+            floor = self._last_ckpt_batch - 100
             commits_dir = _os.path.dirname(marker)
             for name in _os.listdir(commits_dir):
                 try:
-                    if int(name) < batch_id - 100:
+                    if int(name) < floor:
                         _os.unlink(_os.path.join(commits_dir, name))
                 except (ValueError, OSError):
                     continue
@@ -438,6 +447,7 @@ class StreamingQuery:
             json.dump(state, f)
         _os.replace(tmp, _os.path.join(self._checkpoint_dir,
                                        "offsets.json"))
+        self._last_ckpt_batch = int(state["batch_id"])
 
     def _restore_checkpoint(self):
         import json
@@ -448,6 +458,7 @@ class StreamingQuery:
         with open(path) as f:
             state = json.load(f)
         self._batch_id = int(state.get("batch_id", 0))
+        self._last_ckpt_batch = self._batch_id
         self._watermark_ts = state.get("watermark")
         self._source.seek(state.get("offset"))
         spath = _os.path.join(self._checkpoint_dir, "state.arrow")

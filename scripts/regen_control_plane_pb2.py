@@ -78,6 +78,13 @@ def main():
         _add_field(cancel_resp, "canceled", 1, F.TYPE_BOOL)
         changed = True
 
+    report = _message(fdp, "ReportTaskStatusRequest")
+    changed |= _add_field(report, "channel_bytes", 10, F.TYPE_UINT64,
+                          label=F.LABEL_REPEATED)
+    changed |= _add_field(report, "raw_bytes", 11, F.TYPE_UINT64)
+    changed |= _add_field(report, "fetch_wait_s", 12, F.TYPE_DOUBLE)
+    changed |= _add_field(report, "decode_s", 13, F.TYPE_DOUBLE)
+
     if not changed:
         print("pb2 already up to date")
         return

@@ -69,6 +69,41 @@ def test_alter_table_rename(spark):
         spark.sql("SELECT a FROM old_name").toPandas()
 
 
+def test_alter_table_rename_preserves_source_catalog(spark):
+    """A rename of a table in a NON-current catalog keeps the entry in
+    its source catalog instead of silently re-registering it under
+    cm.current_catalog."""
+    from sail_tpu.catalog.provider import MemoryCatalogProvider
+
+    cm = spark.catalog_manager
+    cm.register_catalog("other", MemoryCatalogProvider("other"))
+    spark.sql("CREATE TABLE other.default.src (a INT)")
+    assert cm.providers["other"].get_table("default", "src") is not None
+    # current catalog stays spark_catalog; the qualified rename must not
+    # migrate the table into it
+    spark.sql("ALTER TABLE other.default.src RENAME TO dst")
+    other = cm.providers["other"]
+    assert other.get_table("default", "dst") is not None
+    assert other.get_table("default", "src") is None
+    assert cm.providers["spark_catalog"].get_table("default", "dst") is None
+    entry = other.get_table("default", "dst")
+    assert entry.name[0] == "other"
+
+
+def test_alter_table_rename_rejects_cross_catalog(spark):
+    from sail_tpu.catalog.provider import MemoryCatalogProvider
+
+    cm = spark.catalog_manager
+    cm.register_catalog("otherx", MemoryCatalogProvider("otherx"))
+    spark.sql("CREATE TABLE xc (a INT)")
+    with pytest.raises(ValueError, match="across catalogs"):
+        spark.sql("ALTER TABLE spark_catalog.default.xc "
+                  "RENAME TO otherx.default.xc2")
+    # the source table is untouched by the rejected rename
+    assert cm.providers["spark_catalog"].get_table("default", "xc") \
+        is not None
+
+
 def test_describe_database_and_comment(spark):
     info = spark.sql("DESCRIBE DATABASE default").toPandas()
     assert "Namespace Name" in info.info_name.tolist()

@@ -1,0 +1,495 @@
+"""Shuffle data plane: compressed wire+spill format, chunked streaming
+reads, overlapped multi-input fetch, and the memory-footprint task
+governor (ROADMAP item 3, Theseus arXiv:2508.05029)."""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession, faults
+from sail_tpu.exec import shuffle as sh
+from sail_tpu.exec import job_graph as jg
+from sail_tpu.exec.cluster import LocalCluster, _StreamStore
+from sail_tpu.io.prefetch import MultiPrefetcher
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leakage():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _tbl(n=20_000):
+    rng = np.random.default_rng(3)
+    return pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array(rng.integers(0, 1000, n), type=pa.int64()),
+        "s": pa.array(np.char.add("row-", (np.arange(n) % 97).astype(str))),
+    })
+
+
+# ---------------------------------------------------------------------------
+# wire format: codec roundtrip + auto-detection
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_all_codecs():
+    t = _tbl()
+    for codec in ("lz4", "zstd", None):
+        buf = sh.encode_table(t, codec=codec)
+        assert sh.decode_stream(buf).equals(t)
+
+
+def test_reader_auto_detects_other_codec(monkeypatch):
+    """A reader configured for one codec decodes the other codec's
+    stream (and an uncompressed one): compression rides the IPC message
+    headers, so mixed-version / A/B runs interoperate."""
+    t = _tbl(5000)
+    for configured, wire in (("zstd", "lz4"), ("lz4", "zstd"),
+                             ("lz4", None), ("none", "zstd")):
+        monkeypatch.setenv("SAIL_SHUFFLE__COMPRESSION", configured)
+        buf = sh.encode_table(t, codec=wire)
+        assert sh.decode_stream(buf).equals(t), (configured, wire)
+
+
+def test_wire_codec_config(monkeypatch):
+    monkeypatch.delenv("SAIL_SHUFFLE__COMPRESSION", raising=False)
+    assert sh.wire_codec() == "lz4"  # default
+    monkeypatch.setenv("SAIL_SHUFFLE__COMPRESSION", "zstd")
+    assert sh.wire_codec() == "zstd"
+    monkeypatch.setenv("SAIL_SHUFFLE__COMPRESSION", "none")
+    assert sh.wire_codec() is None
+    monkeypatch.setenv("SAIL_SHUFFLE__COMPRESSION", "bogus")
+    assert sh.wire_codec() == "lz4"  # unknown spelling: safe default
+
+
+def test_compression_shrinks_wire_bytes():
+    t = _tbl(50_000)
+    raw = sh.encode_table(t, codec=None)
+    lz4 = sh.encode_table(t, codec="lz4")
+    assert len(lz4) < len(raw) / 2, (len(lz4), len(raw))
+
+
+def test_chunked_incremental_decode():
+    """Fetch-side decode off a chunk iterator (no full concatenation) is
+    byte-identical to whole-buffer decode, at any chunk size."""
+    t = _tbl()
+    buf = sh.encode_table(t, codec="lz4")
+    for chunk_bytes in (777, 1 << 12, 1 << 22):
+        reader = sh.ChunkReader(sh.iter_buffer_chunks(buf, chunk_bytes))
+        back = sh.decode_stream(reader)
+        assert back.equals(t)
+        assert reader.nbytes == len(buf)
+
+
+def test_empty_table_roundtrip():
+    t = _tbl(0)
+    buf = sh.encode_table(t, codec="lz4")
+    back = sh.decode_stream(sh.ChunkReader(sh.iter_buffer_chunks(buf)))
+    assert back.num_rows == 0 and back.schema == t.schema
+
+
+# ---------------------------------------------------------------------------
+# spill: the spill format is the wire format, served from disk in chunks
+# ---------------------------------------------------------------------------
+
+def test_stream_store_spilled_channel_streams_from_disk():
+    t = _tbl(30_000)
+    buf = sh.encode_table(t, codec="lz4")
+    store = _StreamStore(memory_cap_bytes=64)  # force spill to disk
+    store.put("j", 0, 0, {0: buf, 1: b""})
+    entry = store._streams[("j", 0, 0)][0]
+    assert isinstance(entry, tuple) and entry[0] == "disk"
+    chunks = store.open_chunks("j", 0, 0, 0)
+    assert b"".join(chunks) == buf  # spill file IS the wire bytes
+    # a second open decodes straight off the disk chunks
+    back = sh.decode_stream(
+        sh.ChunkReader(store.open_chunks("j", 0, 0, 0)))
+    assert back.equals(t)
+    assert store.open_chunks("j", 0, 0, 9) is None  # unknown channel
+    store.clean_job("j")
+    assert store.open_chunks("j", 0, 0, 0) is None  # cleaned
+
+
+# ---------------------------------------------------------------------------
+# MultiPrefetcher: N producers over one work list
+# ---------------------------------------------------------------------------
+
+def test_multi_prefetcher_yields_every_item_tagged():
+    items = list(range(23))
+    got = dict(MultiPrefetcher(items, lambda x: x * 2, workers=4))
+    assert got == {i: i * 2 for i in items}
+
+
+def test_multi_prefetcher_sequential_fallback_in_order():
+    seen = []
+
+    def fn(x):
+        seen.append(x)
+        return -x
+
+    out = list(MultiPrefetcher(list(range(8)), fn, workers=0))
+    assert out == [(i, -i) for i in range(8)]
+    assert seen == list(range(8))  # strictly sequential
+
+
+def test_multi_prefetcher_overlaps_work():
+    """4 workers over 8 sleeps must beat the sequential sum."""
+    t0 = time.perf_counter()
+    list(MultiPrefetcher([0.05] * 8, time.sleep, workers=4))
+    assert time.perf_counter() - t0 < 0.3  # sequential would be ~0.4s
+
+
+def test_multi_prefetcher_error_cancels_peers():
+    started = []
+
+    def fn(x):
+        started.append(x)
+        if x == 3:
+            raise RuntimeError("boom")
+        time.sleep(0.01)
+        return x
+
+    mp = MultiPrefetcher(list(range(40)), fn, workers=4)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(mp)
+    # cancellation stopped the remaining work
+    assert len(started) < 40
+    mp.close()  # idempotent
+
+
+def test_multi_prefetcher_abandonment_reaps_threads():
+    before = threading.active_count()
+    mp = MultiPrefetcher([0.01] * 16, time.sleep, workers=4)
+    next(iter(mp))
+    mp.close()
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.02)
+    assert threading.active_count() <= before
+
+
+# ---------------------------------------------------------------------------
+# cluster path: concurrency / compression A/B equivalence + chaos
+# ---------------------------------------------------------------------------
+
+def _canon(table):
+    return table.sort_by([(c, "ascending") for c in table.column_names])
+
+
+@pytest.fixture(scope="module")
+def join_plan():
+    """A shuffle-join + reshard-aggregate plan: two SHUFFLE producer
+    stages, a join stage, and a final merge — every exchange mode the
+    data plane serves, at a size that keeps tier-1 inside its budget
+    (the full TPC-H q5/q18/q21 sweep rides the slow lane)."""
+    from sail_tpu.sql import parse_one
+
+    rng = np.random.default_rng(29)
+    n = 40_000
+    left = pd.DataFrame({"k": rng.integers(0, 900, n),
+                         "v": rng.integers(0, 10_000, n)})
+    right = pd.DataFrame({"k2": np.arange(120_000, dtype=np.int64),
+                          "grp": np.arange(120_000) % 6})
+    spark = SparkSession({})
+    spark.createDataFrame(left).createOrReplaceTempView("sp_l")
+    spark.createDataFrame(right).createOrReplaceTempView("sp_r")
+    return spark._resolve(parse_one(
+        "SELECT grp, sum(v) AS s, count(*) AS c "
+        "FROM sp_l JOIN sp_r ON k = k2 GROUP BY grp"))
+
+
+def _fetch_onoff_equivalence(plans, monkeypatch, nparts):
+    """Overlapped multi-input fetch is bit-identical to sequential fetch
+    on the cluster path (fetch concurrency is resolved per task, so one
+    cluster serves both modes)."""
+    c = LocalCluster(num_workers=2)
+    try:
+        for q, plan in plans.items():
+            monkeypatch.setenv("SAIL_SHUFFLE__FETCH_CONCURRENCY", "0")
+            sequential = c.run_job(plan, num_partitions=nparts,
+                                   timeout=180)
+            monkeypatch.setenv("SAIL_SHUFFLE__FETCH_CONCURRENCY", "4")
+            overlapped = c.run_job(plan, num_partitions=nparts,
+                                   timeout=180)
+            assert _canon(sequential).equals(_canon(overlapped)), f"q{q}"
+    finally:
+        c.stop()
+
+
+def test_concurrent_fetch_equivalence_join(join_plan, monkeypatch):
+    _fetch_onoff_equivalence({"join": join_plan}, monkeypatch, nparts=4)
+
+
+@pytest.mark.slow
+def test_concurrent_fetch_equivalence_q5_q18_q21(monkeypatch):
+    """The full TPC-H sweep of the fetch on/off A/B on the cluster path
+    (the tier-1 join_plan test covers the exchange shapes; the real
+    queries are the expensive multi-join workloads)."""
+    from sail_tpu.benchmarks.tpch_data import generate_tpch
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+    from sail_tpu.sql import parse_one
+
+    tables = generate_tpch(0.005, seed=11)
+    spark = SparkSession({})
+    for name, t in tables.items():
+        spark.createDataFrame(t).createOrReplaceTempView(name)
+    plans = {q: spark._resolve(parse_one(QUERIES[q]))
+             for q in (5, 18, 21)}
+    _fetch_onoff_equivalence(plans, monkeypatch, nparts=3)
+
+
+def test_compression_ab_equivalence_cluster(join_plan, monkeypatch):
+    """lz4 / zstd / none produce bit-identical cluster results — and a
+    mid-job codec flip (readers auto-detect) cannot corrupt anything."""
+    c = LocalCluster(num_workers=2)
+    try:
+        results = {}
+        for codec in ("lz4", "zstd", "none"):
+            monkeypatch.setenv("SAIL_SHUFFLE__COMPRESSION", codec)
+            results[codec] = _canon(
+                c.run_job(join_plan, num_partitions=4, timeout=120))
+        assert results["lz4"].num_rows > 0
+        assert results["lz4"].equals(results["none"])
+        assert results["zstd"].equals(results["none"])
+    finally:
+        c.stop()
+
+
+def test_chaos_fetch_drop_with_compression_and_overlap(join_plan,
+                                                       monkeypatch):
+    """PR 4 harness extension: a dropped shuffle-channel fetch under
+    compressed, CONCURRENT fetch still recovers via producer re-run with
+    bit-identical results (per-input fault attribution survives the
+    overlap)."""
+    monkeypatch.setenv("SAIL_SHUFFLE__COMPRESSION", "lz4")
+    monkeypatch.setenv("SAIL_SHUFFLE__FETCH_CONCURRENCY", "4")
+
+    def run_once():
+        c = LocalCluster(num_workers=2)
+        try:
+            out = c.run_job(join_plan, num_partitions=4, timeout=120)
+            return out, c.last_job
+        finally:
+            c.stop()
+
+    clean, _ = run_once()
+    faults.configure("shuffle.fetch:*c[0-9]*=error(not_found)#1", seed=23)
+    faulted, job = run_once()
+    assert faults.injection_counts().get("shuffle.fetch") == 1
+    assert job.retry_count >= 1
+    assert _canon(clean).equals(_canon(faulted))
+
+
+# ---------------------------------------------------------------------------
+# memory-footprint task governor
+# ---------------------------------------------------------------------------
+
+def _join_plan(spark, n=150_000):
+    from sail_tpu.sql import parse_one
+    left = pd.DataFrame({"k": np.arange(n) % 512,
+                         "v": np.arange(n, dtype=np.int64)})
+    right = pd.DataFrame({"k2": np.arange(n, dtype=np.int64),
+                          "w": np.arange(n, dtype=np.int64) % 7})
+    spark.createDataFrame(left).createOrReplaceTempView("gov_l")
+    spark.createDataFrame(right).createOrReplaceTempView("gov_r")
+    oracle = left.merge(right, left_on="k", right_on="k2") \
+        .groupby("w", as_index=False).agg(s=("v", "sum"))
+    return spark._resolve(parse_one(
+        "SELECT w, sum(v) AS s FROM gov_l JOIN gov_r ON k = k2 "
+        "GROUP BY w")), oracle
+
+
+def test_projected_input_bytes_modes():
+    """Unit: the projection sums shuffle channels / forward partitions /
+    whole merge inputs, scaled by each producer's raw/compressed ratio,
+    and falls back to None while any producer size is unknown."""
+    from sail_tpu.exec.cluster import DriverActor, _Job
+
+    spark = SparkSession({})
+    spark.createDataFrame(pd.DataFrame(
+        {"g": [1, 2], "v": [1.0, 2.0]})).createOrReplaceTempView("pj")
+    from sail_tpu.sql import parse_one
+    plan = spark._resolve(parse_one(
+        "SELECT g, sum(v) AS s FROM pj GROUP BY g"))
+    graph = jg.split_job(plan, 2)
+    assert graph is not None
+    job = _Job("job", graph)
+    d = DriverActor()  # not started: pure projection math
+    final = next(s for s in graph.stages
+                 if s.inputs and s.inputs[0].mode == jg.InputMode.SHUFFLE)
+    sid = final.inputs[0].stage_id
+    # producer sizes unknown → slot fallback
+    assert d._projected_task_bytes(job, final.stage_id, 0) is None
+    job.channel_bytes[(sid, 0)] = ([10, 20], 60)   # 2x decode ratio
+    job.channel_bytes[(sid, 1)] = ([5, 5], 20)     # 2x decode ratio
+    assert d._projected_task_bytes(job, final.stage_id, 0) == 30
+    assert d._projected_task_bytes(job, final.stage_id, 1) == 50
+    # leaf stages have nothing to project from
+    assert d._projected_task_bytes(job, sid, 0) is None
+
+    # FORWARD consumers (pipelined broadcast-join stages) need only
+    # THEIR producer partition's size — they launch while sibling
+    # partitions are still running, so requiring all sizes would
+    # silently disable the governor for pipelined stages
+    spark.createDataFrame(pd.DataFrame(
+        {"a": np.arange(200_000, dtype=np.int64),
+         "v": np.arange(200_000, dtype=np.int64)})) \
+        .createOrReplaceTempView("fw_big")
+    spark.createDataFrame(pd.DataFrame(
+        {"b": [1, 2, 3]})).createOrReplaceTempView("fw_small")
+    jplan = spark._resolve(parse_one(
+        "SELECT a FROM fw_big JOIN fw_small ON a = b"))
+    jgraph = jg.split_job(jplan, 2)
+    jjob = _Job("job2", jgraph)
+    bstage = next(
+        s for s in jgraph.stages
+        if any(i.mode == jg.InputMode.FORWARD for i in s.inputs)
+        and any(i.mode == jg.InputMode.BROADCAST for i in s.inputs))
+    fwd = next(i for i in bstage.inputs
+               if i.mode == jg.InputMode.FORWARD)
+    bc = next(i for i in bstage.inputs
+              if i.mode == jg.InputMode.BROADCAST)
+    # only partition 0's forward producer + the broadcast side known
+    jjob.channel_bytes[(fwd.stage_id, 0)] = ([40], 80)   # 2x ratio
+    jjob.channel_bytes[(bc.stage_id, 0)] = ([6], 6)
+    assert d._projected_task_bytes(jjob, bstage.stage_id, 0) == 86
+    # partition 1's own producer is unknown → slot fallback for IT only
+    assert d._projected_task_bytes(jjob, bstage.stage_id, 1) is None
+
+
+def test_drain_deferred_parks_until_inputs_relocated():
+    """A producer evicted between deferral and drain must keep the
+    deferred consumer PARKED (producer re-run restores the location) —
+    relaunching immediately would fail the job on the incomplete-input
+    guard (or 'no live workers' here, where the pool is empty)."""
+    from sail_tpu.exec.cluster import DriverActor, _Job
+    from sail_tpu.sql import parse_one
+
+    spark = SparkSession({})
+    spark.createDataFrame(pd.DataFrame(
+        {"g": [1, 2], "v": [1.0, 2.0]})).createOrReplaceTempView("dp")
+    plan = spark._resolve(parse_one(
+        "SELECT g, sum(v) AS s FROM dp GROUP BY g"))
+    graph = jg.split_job(plan, 2)
+    job = _Job("job", graph)
+    d = DriverActor()  # not started; empty worker pool
+    final = next(s for s in graph.stages
+                 if s.inputs and s.inputs[0].mode == jg.InputMode.SHUFFLE)
+    entry = (final.stage_id, 0, 0, None)
+    job.deferred.append(entry)
+    d._drain_deferred(job)
+    assert job.deferred == [entry]  # still parked, not failed
+    assert not job.done.is_set() and job.failed is None
+
+
+def test_governor_defers_under_tiny_budget(monkeypatch):
+    """A 1 MB worker budget cannot admit two wide join-shuffle tasks at
+    once: the driver defers the overflow, relaunches as capacity frees,
+    and the result is still exact."""
+    monkeypatch.setenv("SAIL_CLUSTER__MEMORY_BUDGET_MB", "1")
+    spark = SparkSession({})
+    plan, oracle = _join_plan(spark)
+    c = LocalCluster(num_workers=2)
+    try:
+        out = c.run_job(plan, num_partitions=4, timeout=180).to_pandas()
+        job = c.last_job
+        assert job.governor_deferred >= 1, "nothing was deferred"
+        assert not job.failed
+    finally:
+        c.stop()
+    got = out.sort_values("w").reset_index(drop=True).astype("int64")
+    exp = oracle.sort_values("w").reset_index(drop=True).astype("int64")
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_governor_disabled_with_zero_budget(monkeypatch):
+    monkeypatch.setenv("SAIL_CLUSTER__MEMORY_BUDGET_MB", "0")
+    spark = SparkSession({})
+    plan, oracle = _join_plan(spark, n=30_000)
+    c = LocalCluster(num_workers=2)
+    try:
+        out = c.run_job(plan, num_partitions=4, timeout=120).to_pandas()
+        assert c.last_job.governor_deferred == 0
+    finally:
+        c.stop()
+    got = out.sort_values("w").reset_index(drop=True).astype("int64")
+    exp = oracle.sort_values("w").reset_index(drop=True).astype("int64")
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_out_of_core_spilled_shuffle_chaos_bit_identical(join_plan,
+                                                         monkeypatch):
+    """Out-of-core cluster path: a zero in-memory cap forces EVERY
+    channel through compressed spill files served from disk in chunks;
+    with a dropped fetch injected on top, results stay bit-identical to
+    the all-in-memory clean run."""
+    from sail_tpu.metrics import REGISTRY
+
+    def run_once():
+        c = LocalCluster(num_workers=2)
+        try:
+            return c.run_job(join_plan, num_partitions=4, timeout=120)
+        finally:
+            c.stop()
+
+    clean = run_once()
+    monkeypatch.setenv("SAIL_CLUSTER__SHUFFLE_MEMORY_CAP_MB", "0")
+    monkeypatch.setenv("SAIL_SHUFFLE__COMPRESSION", "lz4")
+
+    def spilled_bytes():
+        return sum(r["value"] for r in REGISTRY.snapshot()
+                   if r["name"] == "execution.shuffle.spill_bytes_compressed")
+
+    before = spilled_bytes()
+    faults.configure("shuffle.fetch:*c[0-9]*=error(not_found)#1", seed=31)
+    faulted = run_once()
+    assert spilled_bytes() > before, "nothing spilled under a zero cap"
+    assert faults.injection_counts().get("shuffle.fetch") == 1
+    assert _canon(clean).equals(_canon(faulted))
+
+
+def test_profile_shuffle_surface():
+    """The movement plane rides the query profile: wire raw/compressed
+    bytes, fetch wait + decode time, and the EXPLAIN ANALYZE line."""
+    from sail_tpu import profiler
+
+    spark = SparkSession({})
+    df = pd.DataFrame({"g": np.arange(4000) % 8,
+                       "v": np.arange(4000, dtype=np.int64)})
+    spark.createDataFrame(df).createOrReplaceTempView("prof_t")
+    from sail_tpu.sql import parse_one
+    plan = spark._resolve(parse_one(
+        "SELECT g, sum(v) AS s FROM prof_t GROUP BY g"))
+    c = LocalCluster(num_workers=2)
+    try:
+        with profiler.profile_query("shuffle profile") as prof:
+            c.run_job(plan, num_partitions=2, timeout=90)
+    finally:
+        c.stop()
+    d = prof.to_dict()["shuffle"]
+    # tiny tables: IPC framing can exceed the raw bytes, so assert
+    # presence, not a ratio (the bench artifact owns the ratio claim)
+    assert d["wire_bytes"] > 0
+    assert d["wire_bytes_compressed"] > 0
+    assert d["decode_ms"] >= 0 and d["fetch_wait_ms"] >= 0
+    assert "shuffle: wire=" in prof.render()
+
+
+def test_shuffle_metrics_registered():
+    from sail_tpu.metrics import REGISTRY
+
+    defs = {d.name for d in REGISTRY.definitions()}
+    for name in ("execution.shuffle.wire_bytes",
+                 "execution.shuffle.wire_bytes_compressed",
+                 "execution.shuffle.spill_bytes_compressed",
+                 "execution.shuffle.fetch_wait_time",
+                 "execution.shuffle.decode_time",
+                 "cluster.governor.admitted_count",
+                 "cluster.governor.deferred_count",
+                 "cluster.governor.projected_bytes"):
+        assert name in defs, name

@@ -306,3 +306,41 @@ def test_replayed_batch_is_not_double_written(tmp_path, spark):
     import glob
     files = sorted(glob.glob(os.path.join(out, "part-00000*.parquet")))
     assert len(files) == 1  # batch 0 written exactly once
+
+
+def test_commit_marker_retention_keys_to_checkpointed_batch(tmp_path):
+    """Marker pruning floors at the last successfully CHECKPOINTED batch
+    id, not the current batch id — a stalled checkpoint must keep every
+    replayable batch's marker so a restart cannot duplicate sink output."""
+    q = StreamingQuery.__new__(StreamingQuery)
+    q._checkpoint_dir = str(tmp_path)
+    q._last_ckpt_batch = 0  # checkpoint never advanced
+
+    for b in (0, 1, 50, 99):
+        q._mark_committed(b)
+    # batch 100 triggers the pruning sweep, but nothing has been
+    # checkpointed: every marker stays consultable
+    q._mark_committed(100)
+    commits = os.path.join(str(tmp_path), "commits")
+    assert sorted(int(n) for n in os.listdir(commits)) == [0, 1, 50, 99,
+                                                           100]
+    # once the checkpoint durably passes batch 250, markers below the
+    # 250 - 100 floor prune on the next sweep — newer ones survive
+    q._last_ckpt_batch = 250
+    q._mark_committed(300)
+    assert sorted(int(n) for n in os.listdir(commits)) == [300]
+
+
+def test_write_checkpoint_advances_retention_floor(spark, tmp_path):
+    src = MemoryStreamSource(pa.schema([("x", pa.int64())]))
+    df = _memory_stream_df(spark, src)
+    out = str(tmp_path / "out3")
+    ckpt = str(tmp_path / "ckpt3")
+    q = df.writeStream.format("parquet") \
+        .option("checkpointLocation", ckpt).start(out)
+    try:
+        src.add(pa.table({"x": [1]}))
+        q.processAllAvailable()
+        assert q._last_ckpt_batch == q._batch_id  # durably recorded
+    finally:
+        q.stop()

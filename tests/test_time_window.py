@@ -124,6 +124,17 @@ def test_session_window_long_gap_absorbs_later_events(spark):
     assert got.c.tolist() == [3]
 
 
+def test_session_window_numeric_gap_raises(spark):
+    """Spark requires a duration string or interval gap; a bare numeric
+    column must raise instead of being silently read as microseconds."""
+    with pytest.raises(Exception, match="duration string or interval"):
+        spark.sql(
+            "SELECT count(*) AS c FROM VALUES "
+            "('2021-01-01 00:00:00', 300), "
+            "('2021-01-01 00:02:00', 300) t(b, g) "
+            "GROUP BY session_window(b, g)").toPandas()
+
+
 def test_window_as_plain_identifier_still_works(spark):
     # WINDOW is no longer reserved: usable as a column alias
     got = spark.sql("SELECT 1 AS window").toPandas()

@@ -112,16 +112,20 @@ def test_process_workers_run_distributed_query():
 
 def test_fetch_stream_chunked_over_4mb():
     """A shuffle channel larger than gRPC's 4 MiB default message cap
-    must stream in chunks."""
+    must stream in chunks (and decode incrementally on the fetch side)."""
     import grpc
+    import pyarrow as pa
     from concurrent import futures
+    from sail_tpu.exec import shuffle as sh
     from sail_tpu.exec.cluster import (_WORKER_SERVICE,
-                                       _fetch_stream_handler, _fetch_from)
+                                       _fetch_stream_handler, _fetch_table)
     from sail_tpu.exec.proto import control_plane_pb2 as pb
 
     store = _StreamStore(memory_cap_bytes=1 << 30)
-    payload = bytes(np.random.default_rng(0).integers(
-        0, 256, 6 << 20, dtype=np.uint8))  # 6 MiB
+    rng = np.random.default_rng(0)
+    table = pa.table({"x": rng.integers(0, 2 ** 60, 1 << 20)})  # 8 MiB raw
+    payload = sh.encode_table(table, codec=None)
+    assert len(payload) > 5 << 20
     store.put("job", 1, 0, {2: payload})
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
     server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
@@ -134,8 +138,8 @@ def test_fetch_stream_chunked_over_4mb():
     port = server.add_insecure_port("127.0.0.1:0")
     server.start()
     try:
-        got = _fetch_from(f"127.0.0.1:{port}", pb.FetchStreamRequest(
+        got = _fetch_table(f"127.0.0.1:{port}", pb.FetchStreamRequest(
             job_id="job", stage=1, partition=0, channel=2), _WORKER_SERVICE)
-        assert got == payload
+        assert got.equals(table)
     finally:
         server.stop(grace=0.2)
