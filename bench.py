@@ -366,6 +366,125 @@ def _run_shuffle_bench(spark) -> dict:
     return out
 
 
+def _run_skew_bench(spark) -> dict:
+    """SAIL_BENCH_SKEW=1: a Zipf-skewed join workload through the local
+    cluster, adaptive execution ON vs OFF interleaved. Records the
+    coalesce/split/broadcast decision counts, the p50/max task-duration
+    spread of the join stage (the number skew actually hurts), and
+    result equivalence. Thresholds are scaled to the workload size and
+    recorded in the artifact."""
+    import numpy as np
+    import pandas as pd
+
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.sql import parse_one
+
+    rows = int(os.environ.get("SAIL_BENCH_SKEW_ROWS", "600000"))
+    n_dim = 150_000  # > the static broadcast limit: the join SHUFFLES
+    rng = np.random.default_rng(5)
+    # Zipf-flavored key draw: a handful of heavy hitters (60% of rows
+    # on key 0) over a long uniform tail — one hot hash channel
+    keys = np.where(rng.random(rows) < 0.6, 0,
+                    rng.integers(0, n_dim, rows))
+    fact = pd.DataFrame({"k": keys, "v": rng.integers(0, 1000, rows)})
+    dim = pd.DataFrame({"k2": np.arange(n_dim),
+                        "grp": np.arange(n_dim) % 16,
+                        "flag": (np.arange(n_dim) % 1499 == 0)
+                        .astype(np.int64)})
+    spark.createDataFrame(fact).createOrReplaceTempView("skew_fact")
+    spark.createDataFrame(dim).createOrReplaceTempView("skew_dim")
+    q_skew = spark._resolve(parse_one(
+        "SELECT d.grp AS grp, sum(f.v) AS s, count(*) AS c "
+        "FROM skew_fact f JOIN skew_dim d ON f.k = d.k2 GROUP BY d.grp"))
+    q_bcast = spark._resolve(parse_one(
+        "SELECT count(*) AS c, sum(f.v) AS s FROM skew_fact f JOIN "
+        "(SELECT k2 FROM skew_dim WHERE flag = 1) d ON f.k = d.k2"))
+    knobs = {"SAIL_ADAPTIVE__SKEW__MIN_MB": "1",
+             "SAIL_ADAPTIVE__SKEW__FACTOR": "2.0",
+             "SAIL_ADAPTIVE__COALESCE__TARGET_MB": "8"}
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+
+    def canon(table):
+        return table.sort_by([(c, "ascending")
+                              for c in table.column_names])
+
+    def run(plan, aqe: bool, bcast_off: bool = False):
+        # save/restore (not pop) so a whole-run SAIL_BENCH_DISABLE_AQE
+        # setting applied in main survives the skew bench
+        prior = {k: os.environ.get(k) for k in
+                 ("SAIL_ADAPTIVE__ENABLED",
+                  "SAIL_ADAPTIVE__BROADCAST__ENABLED")}
+        os.environ["SAIL_ADAPTIVE__ENABLED"] = "1" if aqe else "0"
+        if bcast_off:
+            os.environ["SAIL_ADAPTIVE__BROADCAST__ENABLED"] = "0"
+        c = LocalCluster(num_workers=2)
+        try:
+            t0 = time.perf_counter()
+            out = c.run_job(plan, num_partitions=8, timeout=300)
+            secs = time.perf_counter() - t0
+            job = c.last_job
+            # spread within the dominant stage (the one whose slowest
+            # task gates the job — the shuffle join here): mixing stages
+            # would report scan-vs-join differences as "skew"
+            by_stage = [sorted(ds) for ds in job.durations.values() if ds]
+            durs = max(by_stage, key=lambda ds: ds[-1]) if by_stage else []
+            rec = {"seconds": round(secs, 4),
+                   "decisions": job.adaptive.counts(),
+                   "task_p50_s": round(durs[len(durs) // 2], 4)
+                   if durs else None,
+                   "task_max_s": round(durs[-1], 4) if durs else None,
+                   "duration_spread": round(
+                       durs[-1] / max(durs[len(durs) // 2], 1e-9), 3)
+                   if durs else None,
+                   "skew": job.adaptive.skew[:4]}
+            return canon(out), rec
+        finally:
+            c.stop()
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    try:
+        out = {"rows": rows, "knobs": knobs, "queries": {}}
+        # interleaved A/B per query: off, on, off, on
+        for name, plan, bcast_off in (
+                ("skew_join", q_skew, True),   # isolate the SPLIT path
+                ("broadcast_join", q_bcast, False)):
+            # warm BOTH paths: the rewrites produce new task shapes, so
+            # an unwarmed AQE run would bill one-time XLA compiles as
+            # adaptive overhead
+            run(plan, aqe=False, bcast_off=bcast_off)
+            run(plan, aqe=True, bcast_off=bcast_off)
+            off1, off_rec = run(plan, aqe=False, bcast_off=bcast_off)
+            on1, on_rec = run(plan, aqe=True, bcast_off=bcast_off)
+            out["queries"][name] = {
+                "aqe_off": off_rec, "aqe_on": on_rec,
+                "identical": off1.equals(on1),
+                "speedup": round(off_rec["seconds"]
+                                 / on_rec["seconds"], 3)
+                if on_rec["seconds"] else None,
+            }
+            print(f"bench: skew {name} off={off_rec['seconds']}s "
+                  f"on={on_rec['seconds']}s "
+                  f"decisions={on_rec['decisions']}",
+                  file=sys.stderr, flush=True)
+        decided = {}
+        for rec in out["queries"].values():
+            for k, v in rec["aqe_on"]["decisions"].items():
+                decided[k] = decided.get(k, 0) + v
+        out["decisions_total"] = decided
+        return out
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _budget_skip_warnings(result: dict) -> list:
     """Self-check: no suite query may be silently budget-skipped — every
     skip surfaces as an artifact warning, and q22 (first-run,
@@ -457,6 +576,13 @@ def main():
         .strip().lower() in ("1", "true", "yes")
     if disable_shuffle_comp:
         os.environ["SAIL_SHUFFLE__COMPRESSION"] = "none"
+    # A/B knob: SAIL_BENCH_DISABLE_AQE=1 turns adaptive execution off
+    # for the whole run (the cluster driver reads the app-config/env
+    # layer; skew telemetry still records)
+    disable_aqe = os.environ.get("SAIL_BENCH_DISABLE_AQE", "0") \
+        .strip().lower() in ("1", "true", "yes")
+    if disable_aqe:
+        os.environ["SAIL_ADAPTIVE__ENABLED"] = "false"
     try:
         best, rows, scanned, q1_profile = _run_q1(spark, sf)
     except Exception as e:  # noqa: BLE001 — fall back to SF1 rather than die
@@ -477,6 +603,7 @@ def main():
         "fusion": "disabled" if disable_fusion else "enabled",
         "shuffle_compression": "disabled" if disable_shuffle_comp
         else "enabled",
+        "adaptive": "disabled" if disable_aqe else "enabled",
         "tpu_probe": probe_info,
     }
     # the 22-query and ClickBench artifacts always record, inside the
@@ -513,6 +640,14 @@ def main():
             result["shuffle"] = _run_shuffle_bench(spark)
         except Exception as e:  # noqa: BLE001
             result["shuffle_error"] = f"{type(e).__name__}: {e}"
+    # skewed-join adaptive-execution artifact: Zipf workload, AQE on/off
+    # interleaved with decision counts and task-duration spread (opt-in)
+    if os.environ.get("SAIL_BENCH_SKEW", "0").strip().lower() in (
+            "1", "true", "yes"):
+        try:
+            result["skew_bench"] = _run_skew_bench(spark)
+        except Exception as e:  # noqa: BLE001
+            result["skew_bench_error"] = f"{type(e).__name__}: {e}"
     # chaos mode: TPC-H under a fixed fault seed, recovery overhead in
     # the artifact (opt-in: the run costs two extra cluster executions)
     if os.environ.get("SAIL_BENCH_CHAOS", "0").strip().lower() in (

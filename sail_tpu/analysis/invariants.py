@@ -542,7 +542,14 @@ def validate_job_graph(graph) -> None:
                 after="split_job")
         stages_by_id[stage.stage_id] = stage
     for stage in graph.stages:
+        inputs_by_id = {i.stage_id: i for i in stage.inputs}
         input_modes = {i.stage_id: i.mode for i in stage.inputs}
+        for b in getattr(stage, "launch_after", ()):
+            if b not in stages_by_id:
+                raise PlanInvariantError(
+                    "stage.unknown_input",
+                    f"stage {stage.stage_id} barriered on unknown stage "
+                    f"{b}", after="split_job")
         for sid in input_modes:
             if sid not in stages_by_id:
                 raise PlanInvariantError(
@@ -583,7 +590,11 @@ def validate_job_graph(graph) -> None:
                         f"stage {node.stage_id} produces "
                         f"{fb.dtype.simple_string()}", after="split_job")
             mode = input_modes[node.stage_id]
-            if mode == InputMode.SHUFFLE:
+            fetch_plan = getattr(inputs_by_id[node.stage_id],
+                                 "fetch_plan", None)
+            if fetch_plan is not None:
+                _check_fetch_plan(stage, producer, fetch_plan)
+            elif mode == InputMode.SHUFFLE:
                 if producer.shuffle_keys is None:
                     raise PlanInvariantError(
                         "stage.channels",
@@ -605,6 +616,19 @@ def validate_job_graph(graph) -> None:
                         f"BROADCAST producer stage {node.stage_id} has "
                         f"{producer.num_partitions} partitions "
                         f"(expected 1)", after="split_job")
+            elif mode == InputMode.FORWARD:
+                # FORWARD task p reads producer partition p: the task
+                # counts must agree or consumer tasks wait forever on
+                # partitions the producer never makes (fewer) / extra
+                # producer partitions are silently dropped (more)
+                if producer.num_partitions != stage.num_partitions:
+                    raise PlanInvariantError(
+                        "stage.forward_arity",
+                        f"stage {stage.stage_id} reads stage "
+                        f"{node.stage_id} FORWARD with "
+                        f"{stage.num_partitions} tasks but the producer "
+                        f"runs {producer.num_partitions}",
+                        after="split_job")
         if stage.shuffle_keys is not None:
             arity = len(_child_schema(stage.plan, after="split_job",
                                       node=stage.plan))
@@ -615,3 +639,123 @@ def validate_job_graph(graph) -> None:
                         f"stage {stage.stage_id} shuffle key #{k} out "
                         f"of range of its {arity}-column output",
                         after="split_job")
+
+
+def _check_fetch_plan(stage, producer, fetch_plan) -> None:
+    """Adaptive fetch assignments: one non-empty pair list per consumer
+    task, every pair naming a real producer partition and a channel the
+    producer actually routes (-1 = the whole unsplit task output, valid
+    only for a producer that does not shuffle-write; -2 = every channel
+    of the producer partition in one stream). Beyond per-pair range
+    checks, COVERAGE must hold: every routed channel is consumed either
+    exactly once across all tasks (whole channels and partition-splits
+    — the per-task partition sets are disjoint and union to the full
+    producer set) or replicated (every fetching task reads the FULL
+    producer set, the split build side / converted broadcast shape) —
+    a rewrite that drops or double-reads a channel slice would return
+    silently wrong rows."""
+    if len(fetch_plan) != stage.num_partitions:
+        raise PlanInvariantError(
+            "adaptive.fetch_plan",
+            f"stage {stage.stage_id} has {stage.num_partitions} tasks "
+            f"but the fetch plan for input stage {producer.stage_id} "
+            f"covers {len(fetch_plan)}", after="adaptive")
+    single_output = producer.shuffle_keys is None \
+        or producer.num_channels <= 1
+    by_channel: Dict[int, List[Set[int]]] = {}
+    for task, pairs in enumerate(fetch_plan):
+        if not pairs:
+            raise PlanInvariantError(
+                "adaptive.fetch_plan",
+                f"stage {stage.stage_id} task {task} has an empty "
+                f"fetch list for input stage {producer.stage_id}",
+                after="adaptive")
+        if len(set(pairs)) != len(pairs):
+            raise PlanInvariantError(
+                "adaptive.fetch_plan",
+                f"stage {stage.stage_id} task {task} fetches a "
+                f"(partition, channel) pair of stage "
+                f"{producer.stage_id} twice", after="adaptive")
+        task_channels: Dict[int, Set[int]] = {}
+        for p, c in pairs:
+            if not (0 <= p < producer.num_partitions):
+                raise PlanInvariantError(
+                    "adaptive.fetch_plan",
+                    f"stage {stage.stage_id} task {task} fetches "
+                    f"partition {p} of stage {producer.stage_id} which "
+                    f"has {producer.num_partitions} partitions",
+                    after="adaptive")
+            if c == -1 and not single_output:
+                raise PlanInvariantError(
+                    "adaptive.fetch_plan",
+                    f"stage {stage.stage_id} task {task} fetches "
+                    f"channel -1 of shuffle-writing stage "
+                    f"{producer.stage_id}", after="adaptive")
+            if c >= producer.num_channels or c < -2:
+                raise PlanInvariantError(
+                    "adaptive.fetch_plan",
+                    f"stage {stage.stage_id} task {task} fetches "
+                    f"channel {c} of stage {producer.stage_id} which "
+                    f"routes {producer.num_channels} channels",
+                    after="adaptive")
+            task_channels.setdefault(c, set()).add(p)
+        for c, parts in task_channels.items():
+            by_channel.setdefault(c, []).append(parts)
+    full = set(range(producer.num_partitions))
+    routed = {c for c in by_channel if c >= 0}
+    if routed and routed != set(range(producer.num_channels)):
+        raise PlanInvariantError(
+            "adaptive.fetch_plan",
+            f"stage {stage.stage_id} consumes channels "
+            f"{sorted(routed)} of stage {producer.stage_id} but the "
+            f"producer routes channels 0..{producer.num_channels - 1}",
+            after="adaptive")
+    for c, task_sets in by_channel.items():
+        if all(parts == full for parts in task_sets):
+            continue  # replicated channel (or a single full-set task)
+        seen: Set[int] = set()
+        for parts in task_sets:
+            if seen & parts:
+                raise PlanInvariantError(
+                    "adaptive.fetch_plan",
+                    f"stage {stage.stage_id} channel {c} of stage "
+                    f"{producer.stage_id}: partition slices overlap "
+                    f"without full replication", after="adaptive")
+            seen |= parts
+        if seen != full:
+            raise PlanInvariantError(
+                "adaptive.fetch_plan",
+                f"stage {stage.stage_id} channel {c} of stage "
+                f"{producer.stage_id}: producer partitions "
+                f"{sorted(full - seen)} are fetched by no task",
+                after="adaptive")
+
+
+# ---------------------------------------------------------------------------
+# adaptive-rewrite validation (exec/adaptive.py)
+# ---------------------------------------------------------------------------
+
+def stage_signature(stage) -> tuple:
+    """The launch-relevant contract of a stage: its plan identity,
+    partitioning, shuffle routing, and input wiring. A stage whose
+    signature is unchanged is untouched by an adaptive rewrite."""
+    return (id(stage.plan), stage.num_partitions, stage.shuffle_keys,
+            stage.num_channels,
+            tuple((i.stage_id, i.mode,
+                   getattr(i, "fetch_plan", None)) for i in stage.inputs))
+
+
+def validate_adaptive_rewrite(graph, frozen, before) -> None:
+    """The adaptive invariant: a mid-flight plan rewrite may only touch
+    the NOT-yet-launched suffix of the job graph — every frozen stage
+    (scheduled, launched, or completed) must keep its exact signature —
+    and the rewritten graph must still pass the full stage-boundary
+    check before it replaces the pending suffix."""
+    for stage in graph.stages:
+        if stage.stage_id in frozen and \
+                stage_signature(stage) != before.get(stage.stage_id):
+            raise PlanInvariantError(
+                "adaptive.frozen",
+                f"adaptive rewrite touched launched/completed stage "
+                f"{stage.stage_id}", after="adaptive")
+    validate_job_graph(graph)

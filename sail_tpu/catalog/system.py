@@ -156,6 +156,15 @@ class SystemRegistry:
                         [r["transfer_bytes"] for r in rows], pa.int64()),
                     "spill_bytes": pa.array(
                         [r["spill_bytes"] for r in rows], pa.int64()),
+                    "shuffle_skew_ratio": pa.array(
+                        [max((e.get("ratio", 0.0)
+                              for e in r.get("skew", ())), default=0.0)
+                         for r in rows], pa.float64()),
+                    "adaptive_decisions": pa.array(
+                        [sum((r.get("adaptive") or {}).get(k, 0)
+                             for k in ("coalesced", "split", "broadcast",
+                                       "reordered")) for r in rows],
+                        pa.int64()),
                     "rows_out": pa.array(
                         [r["rows_out"] for r in rows], pa.int64()),
                     "slow": pa.array([r["slow"] for r in rows],

@@ -1,0 +1,693 @@
+"""Adaptive query execution: stage-boundary replanning from observed
+shuffle statistics.
+
+Reference role: Spark AQE in the reference Sail architecture (PAPER.md)
+and Theseus' thesis (arXiv:2508.05029) that at scale the engine is a
+data-movement scheduler — plan decisions should be made when the
+data-movement facts are in, not before the first byte is read. The
+driver already learns every completed task's per-channel compressed
+bytes and raw (decoded) bytes from success reports; this module
+re-examines the NOT-yet-launched suffix of the job graph at every
+shuffle stage boundary and applies four rewrites, each individually
+gated under ``adaptive.*`` (surfaced as ``spark.sail.adaptive.``):
+
+1. **Coalesce** (``adaptive.coalesce``): runs of small shuffle channels
+   merge into one consumer task against ``target_mb`` of decoded input,
+   so a thousand near-empty partitions do not pay a thousand task
+   dispatches and fetch round trips.
+2. **Skew split** (``adaptive.skew``): a hot join channel (>
+   ``factor`` × the median channel, ≥ ``min_mb``) splits across up to
+   ``max_subtasks`` consumer tasks by producer-partition ranges; the
+   build side's matching channel is REPLICATED to every subtask
+   (partial-broadcast of the hot keys) — sound for inner/left/semi/anti
+   joins because every probe row still meets the full build set exactly
+   once.
+3. **Broadcast conversion** (``adaptive.broadcast``): an eligible
+   shuffle join's probe producer is barriered behind the build side
+   (``Stage.launch_after``); when the build's observed decoded size
+   lands under ``threshold_mb`` the probe producer drops its shuffle
+   write entirely and each join task reads its own probe partition
+   FORWARD plus the whole build output.
+4. **Reorder re-entry** (``adaptive.reorder``): once every input of the
+   driver-run root stage is complete, ``join_reorder`` re-runs over the
+   root's join tree with OBSERVED stage output rows as leaf estimates;
+   the rewrite is adopted only when the observed sizes actually invert
+   the ordering the static estimates produce.
+
+Every rewrite is validated (``validate_adaptive_rewrite``: frozen
+launched/completed stages untouched + the full job-graph stage-boundary
+check) before it replaces the pending suffix, and rolled back when
+validation fails. Decisions depend ONLY on the observed byte/row
+statistics of completed stages — which are bit-identical across retries,
+speculation, and fault recovery — so the decision sequence is
+deterministic per fault seed regardless of thread interleaving.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..config import get as config_get
+from ..config import truthy
+from ..metrics import record as _record_metric
+from ..plan import nodes as pn
+from . import job_graph as jg
+
+_MB = 1 << 20
+
+#: metric per decision kind — literal names so the registry drift lint
+#: sees the declaration exercised
+_DECISION_METRICS = {
+    "coalesce": "cluster.adaptive.coalesced_count",
+    "split": "cluster.adaptive.split_count",
+    "broadcast": "cluster.adaptive.broadcast_count",
+    "reorder": "cluster.adaptive.reordered_count",
+}
+
+#: join types for which replicating the RIGHT (build) side over a split
+#: or broadcast-converted probe is sound: output rows are a function of
+#: probe rows × the full build set, so probe rows may be partitioned
+#: freely while build rows duplicate
+_REPLICATE_SAFE_JOINS = ("inner", "left", "semi", "anti")
+
+
+def _conf_float(key: str, default: float) -> float:
+    try:
+        return float(config_get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _conf_int(key: str, default: int) -> int:
+    try:
+        return int(config_get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def enabled() -> bool:
+    """Master switch (``spark.sail.adaptive.enabled``)."""
+    return truthy("adaptive.enabled")
+
+
+class AdaptiveState:
+    """Per-job adaptive bookkeeping, owned by the driver actor thread."""
+
+    def __init__(self):
+        self.stages_done: Set[int] = set()      # completion transitions
+        self.considered: Set[int] = set()       # coalesce/split evaluated
+        self.reorder_done = False
+        self.coalesced = 0
+        self.split = 0
+        self.broadcast = 0
+        self.reordered = 0
+        self.events: List[dict] = []
+        self.skew: List[dict] = []              # per shuffle-producer stage
+        self.channel_report: List[dict] = []    # satellite: per-channel sizes
+
+    def counts(self) -> Dict[str, int]:
+        return {"coalesced": self.coalesced, "split": self.split,
+                "broadcast": self.broadcast, "reordered": self.reordered}
+
+    def note(self, kind: str, **info) -> None:
+        if len(self.events) < 128:
+            event = {"kind": kind}
+            event.update(sorted(info.items()))
+            self.events.append(event)
+        metric = _DECISION_METRICS.get(kind)
+        if metric is not None:
+            try:
+                _record_metric(metric, 1)
+            except Exception:  # noqa: BLE001 — telemetry never fails a job
+                pass
+
+
+# ---------------------------------------------------------------------------
+# graph planning (split_job): broadcast-conversion barriers
+# ---------------------------------------------------------------------------
+
+def plan_graph(graph: jg.JobGraph) -> None:
+    """Register broadcast-conversion candidates: for every eligible
+    shuffle join whose build side is plausibly small, barrier the probe
+    producer behind the build producer so the conversion decision can be
+    made from the build's OBSERVED size before the probe shuffles."""
+    if not (enabled() and truthy("adaptive.broadcast.enabled")):
+        return
+    max_est = _conf_float("adaptive.broadcast.max_est_rows", 2_000_000.0)
+    consumers: Dict[int, int] = {}
+    for stage in graph.stages:
+        for i in stage.inputs:
+            consumers[i.stage_id] = consumers.get(i.stage_id, 0) + 1
+    for stage in graph.stages:
+        cand = _bcast_candidate(stage)
+        if cand is None:
+            continue
+        probe_sid, build_sid = cand
+        # the probe producer's shuffle write must serve ONLY this join
+        # (the builder emits single-consumer stages; assert it anyway)
+        if consumers.get(probe_sid, 0) != 1:
+            continue
+        build = graph.stages[build_sid]
+        # a build whose plan bottoms out in exchange leaves has NO
+        # grounded size estimate (the model would fall back to default
+        # rows, always under max_est) — never pay the probe barrier on
+        # a guess, only when real leaf stats predict a small build
+        if any(isinstance(n, jg.StageInputExec)
+               for n in pn.walk_plan(build.plan)):
+            continue
+        if _est_stage_rows(build, graph) > max_est:
+            continue
+        probe = graph.stages[probe_sid]
+        if probe.num_partitions != stage.num_partitions and \
+                _has_forward_consumer(graph, stage.stage_id):
+            continue  # conversion would change the join's task count
+        stage.bcast_candidate = (probe_sid, build_sid)
+        probe.launch_after = tuple(sorted(
+            set(probe.launch_after) | {build_sid}))
+
+
+def _has_forward_consumer(graph: jg.JobGraph, sid: int) -> bool:
+    """True when some stage reads ``sid`` over FORWARD: its task count
+    was frozen to this stage's partition count at graph build (FORWARD
+    task p reads producer partition p), so a rewrite that changes
+    ``num_partitions`` would strand consumer tasks waiting on partitions
+    that never appear (fewer) or silently drop the extras (more)."""
+    return any(i.stage_id == sid and i.mode == jg.InputMode.FORWARD
+               for st in graph.stages for i in st.inputs)
+
+
+def _stage_join(stage: jg.Stage) -> Optional[pn.JoinExec]:
+    """The shuffle join at the heart of a builder-emitted join stage.
+    The builder fuses pipeline Filters/Projects and the partial
+    aggregate ABOVE the join into the same stage plan, so dig through
+    single-input operators; the join's children must be the stage's
+    exchange leaves. Replication-safety note: everything the builder
+    fuses above the join (Filter, Project, partial/dedup aggregates) is
+    row-local or merge-safe, so probe rows may be re-partitioned across
+    tasks as long as each still meets the full matching build set."""
+    p = stage.plan
+    while isinstance(p, (pn.FilterExec, pn.ProjectExec,
+                         pn.AggregateExec)):
+        p = p.input
+    if not isinstance(p, pn.JoinExec):
+        return None
+    if p.join_type not in _REPLICATE_SAFE_JOINS or p.null_aware:
+        return None
+    if not (isinstance(p.left, jg.StageInputExec)
+            and isinstance(p.right, jg.StageInputExec)):
+        return None
+    if p.left.stage_id == p.right.stage_id:
+        return None
+    return p
+
+
+def _bcast_candidate(stage: jg.Stage) -> Optional[Tuple[int, int]]:
+    """(probe sid, build sid) when ``stage`` is a shuffle join whose
+    build side could convert to a broadcast read."""
+    if stage.on_driver:
+        return None
+    p = _stage_join(stage)
+    if p is None:
+        return None
+    modes = {i.stage_id: i.mode for i in stage.inputs}
+    probe_sid, build_sid = p.left.stage_id, p.right.stage_id
+    if modes.get(probe_sid) != jg.InputMode.SHUFFLE or \
+            modes.get(build_sid) != jg.InputMode.SHUFFLE:
+        return None
+    return probe_sid, build_sid
+
+
+def _est_stage_rows(stage: jg.Stage, graph: jg.JobGraph) -> float:
+    """Static estimate of a stage's output rows — join_reorder's
+    cardinality model, taught about driver-stripped memory scans and
+    exchange leaves."""
+    from ..plan import join_reorder as jr
+
+    def est(node):
+        if isinstance(node, pn.ScanExec) and node.format == "__driver__":
+            t = graph.scan_tables.get(node.table_name)
+            return None if t is None else float(t.num_rows)
+        if isinstance(node, jg.StageInputExec):
+            return jr._DEFAULT_ROWS
+        return None
+
+    try:
+        return jr._est_rows(stage.plan, est)
+    except Exception:  # noqa: BLE001 — estimation is advisory
+        return float("inf")
+
+
+# ---------------------------------------------------------------------------
+# observed statistics
+# ---------------------------------------------------------------------------
+
+def _decoded_entry(job, sid: int, p: int):
+    """(per-channel decoded bytes, decoded total) for one producer
+    partition, scaling compressed channel bytes by the partition's
+    raw/compressed ratio. None while the report has not landed."""
+    entry = job.channel_bytes.get((sid, p))
+    if entry is None:
+        return None
+    chans, raw = entry
+    comp_total = sum(chans)
+    scale = (raw / comp_total) if comp_total else 1.0
+    return [c * scale for c in chans], raw
+
+
+def _channel_totals(job, sid: int) -> Optional[List[float]]:
+    """Decoded bytes per channel of a completed shuffle producer,
+    summed over its partitions. None if any partition is unreported."""
+    stage = job.graph.stages[sid]
+    totals: Optional[List[float]] = None
+    for p in range(stage.num_partitions):
+        got = _decoded_entry(job, sid, p)
+        if got is None:
+            return None
+        chans, _raw = got
+        if totals is None:
+            totals = [0.0] * len(chans)
+        for c, v in enumerate(chans):
+            if c < len(totals):
+                totals[c] += v
+    return totals
+
+
+def _stage_decoded_bytes(job, sid: int) -> Optional[float]:
+    stage = job.graph.stages[sid]
+    total = 0.0
+    for p in range(stage.num_partitions):
+        got = _decoded_entry(job, sid, p)
+        if got is None:
+            return None
+        total += got[1]
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the stage-boundary hook (driver actor thread)
+# ---------------------------------------------------------------------------
+
+def on_stage_complete(driver, job, stage_id: int) -> None:
+    """Called by the driver exactly once per stage completion, BEFORE
+    any newly-unblocked consumer is scheduled. Records skew telemetry
+    unconditionally; applies rewrites to the pending suffix when
+    adaptive execution is on."""
+    graph = job.graph
+    stage = graph.stages[stage_id]
+    if stage.shuffle_keys is not None and stage.num_channels > 1:
+        _note_skew(job, stage_id)
+    if not enabled():
+        return
+    for s in graph.stages:
+        if s.bcast_candidate is not None and \
+                s.bcast_candidate[1] == stage_id:
+            _maybe_broadcast(driver, job, s)
+    for s in graph.stages:
+        if any(i.stage_id == stage_id for i in s.inputs):
+            _maybe_coalesce_split(driver, job, s)
+    _maybe_reorder(driver, job)
+
+
+def _note_skew(job, sid: int) -> None:
+    """Satellite surface: per-channel shuffle sizes and the max/median
+    skew ratio of every completed shuffle producer — visible in the
+    profile (``skew:`` line, FORMAT JSON, query_profiles) even when
+    adaptive execution is off."""
+    st = job.adaptive
+    totals = _channel_totals(job, sid)
+    if not totals:
+        return
+    raw_total = 0
+    comp: List[int] = []
+    stage = job.graph.stages[sid]
+    for p in range(stage.num_partitions):
+        entry = job.channel_bytes.get((sid, p))
+        if entry is None:
+            continue
+        chans, raw = entry
+        raw_total += raw
+        if not comp:
+            comp = [0] * len(chans)
+        for c, v in enumerate(chans):
+            if c < len(comp):
+                comp[c] += v
+    if len(st.channel_report) < 32:
+        st.channel_report.append({
+            "stage": sid, "raw_bytes": int(raw_total),
+            "compressed_bytes": [int(v) for v in comp[:64]]})
+    if len(totals) < 2:
+        return
+    med = statistics.median(totals)
+    mx = max(totals)
+    ratio = (mx / med) if med > 0 else (float(len(totals)) if mx else 1.0)
+    entry = {"stage": sid, "channels": len(totals),
+             "max_bytes": int(mx), "median_bytes": int(med),
+             "ratio": round(ratio, 3)}
+    if len(st.skew) < 32:
+        st.skew.append(entry)
+    try:
+        _record_metric("cluster.shuffle.skew_ratio", ratio)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# ---------------------------------------------------------------------------
+# rewrite plumbing
+# ---------------------------------------------------------------------------
+
+def _frozen_stages(job) -> Set[int]:
+    frozen = set(job.scheduled)
+    frozen.update(sid for sid, _p in job.launched)
+    frozen.update(job.adaptive.stages_done)
+    frozen.update(sid for sid, _p in job.live)
+    return frozen
+
+
+def _stage_started(job, sid: int) -> bool:
+    return sid in job.scheduled or \
+        any(k[0] == sid for k in job.launched) or \
+        any(k[0] == sid for k in job.live)
+
+
+def _snapshot(stage: jg.Stage) -> dict:
+    return {"plan": stage.plan, "inputs": stage.inputs,
+            "num_partitions": stage.num_partitions,
+            "shuffle_keys": stage.shuffle_keys,
+            "num_channels": stage.num_channels,
+            "launch_after": stage.launch_after,
+            "bcast_candidate": stage.bcast_candidate}
+
+
+def _restore(stage: jg.Stage, snap: dict) -> None:
+    for k, v in snap.items():
+        setattr(stage, k, v)
+
+
+def _apply_rewrite(job, kind: str, touched: Set[int], fn) -> bool:
+    """Apply ``fn`` (which mutates stages in ``touched``), then enforce
+    the adaptive invariant; roll the mutation back if anything fails.
+    Returns True when the rewrite stuck."""
+    from ..analysis.invariants import (stage_signature,
+                                       validate_adaptive_rewrite)
+    graph = job.graph
+    frozen = _frozen_stages(job)
+    if touched & frozen:
+        return False
+    before = {s.stage_id: stage_signature(s) for s in graph.stages}
+    saved = {sid: _snapshot(graph.stages[sid]) for sid in touched}
+    try:
+        fn()
+        validate_adaptive_rewrite(graph, frozen=frozen, before=before)
+    except Exception:  # noqa: BLE001 — a refused rewrite must not fail the job
+        for sid, snap in saved.items():
+            _restore(graph.stages[sid], snap)
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# rewrite 3: shuffle join → broadcast join
+# ---------------------------------------------------------------------------
+
+def _maybe_broadcast(driver, job, s: jg.Stage) -> None:
+    graph = job.graph
+    st = job.adaptive
+    probe_sid, build_sid = s.bcast_candidate
+    s.bcast_candidate = None  # one decision per join
+    if not truthy("adaptive.broadcast.enabled"):
+        return
+    if _stage_started(job, s.stage_id) or _stage_started(job, probe_sid):
+        return
+    total = _stage_decoded_bytes(job, build_sid)
+    if total is None:
+        return
+    threshold = _conf_float("adaptive.broadcast.threshold_mb", 16.0) * _MB
+    if total > threshold:
+        return
+    probe = graph.stages[probe_sid]
+    build = graph.stages[build_sid]
+    # re-checked at decision time: a downstream conversion may have
+    # added a FORWARD consumer of this join since plan_graph ran
+    if probe.num_partitions != s.num_partitions and \
+            _has_forward_consumer(graph, s.stage_id):
+        return
+
+    def apply():
+        probe.shuffle_keys = None
+        probe.num_channels = 1
+        s.num_partitions = probe.num_partitions
+        # channel -2 = every channel of the producer in ONE stream:
+        # num_partitions round trips per task, not partitions×channels
+        pairs = tuple((p, -2) for p in range(build.num_partitions))
+        new_inputs = []
+        for i in s.inputs:
+            if i.stage_id == probe_sid:
+                new_inputs.append(jg.StageInput(probe_sid,
+                                                jg.InputMode.FORWARD))
+            elif i.stage_id == build_sid:
+                new_inputs.append(jg.StageInput(
+                    build_sid, jg.InputMode.SHUFFLE,
+                    fetch_plan=(pairs,) * s.num_partitions))
+            else:
+                new_inputs.append(i)
+        s.inputs = tuple(new_inputs)
+
+    if _apply_rewrite(job, "broadcast", {s.stage_id, probe_sid}, apply):
+        st.broadcast += 1
+        st.note("broadcast", stage=s.stage_id, probe=probe_sid,
+                build=build_sid, build_bytes=int(total))
+
+
+# ---------------------------------------------------------------------------
+# rewrites 1 + 2: coalesce small channels, split skewed ones
+# ---------------------------------------------------------------------------
+
+def _maybe_coalesce_split(driver, job, s: jg.Stage) -> None:
+    graph = job.graph
+    st = job.adaptive
+    if s.stage_id in st.considered:
+        return
+    if s.on_driver or s.num_partitions <= 1:
+        return
+    if not s.inputs or any(
+            i.mode != jg.InputMode.SHUFFLE or i.fetch_plan is not None
+            for i in s.inputs):
+        return
+    if not all(driver._stage_complete(job, i.stage_id) for i in s.inputs):
+        return
+    if _stage_started(job, s.stage_id):
+        return
+    if _has_forward_consumer(graph, s.stage_id):
+        # a pipelined consumer's task count is frozen to this stage's
+        # partition count — coalesce/split would change it
+        return
+    st.considered.add(s.stage_id)
+    do_coalesce = truthy("adaptive.coalesce.enabled")
+    do_split = truthy("adaptive.skew.enabled")
+    if not (do_coalesce or do_split):
+        return
+    per_input: Dict[int, List[float]] = {}
+    for i in s.inputs:
+        totals = _channel_totals(job, i.stage_id)
+        if totals is None:
+            return
+        per_input[i.stage_id] = totals
+    n_tasks = s.num_partitions  # task r consumes channel r
+    sizes = [sum(t[c] for t in per_input.values() if c < len(t))
+             for c in range(n_tasks)]
+    target = max(1.0, _conf_float("adaptive.coalesce.target_mb", 64.0)
+                 * _MB)
+
+    probe_sid = _split_probe_sid(s) if do_split else None
+    hot: Dict[int, List[Tuple[int, ...]]] = {}
+    if probe_sid is not None:
+        hot = _find_hot_channels(job, s, probe_sid,
+                                 per_input[probe_sid][:n_tasks], target)
+
+    # assignment: ("chan", channels tuple) keeps whole channels per
+    # task; ("split", channel, producer-partition subset) splits a hot
+    # probe channel by producer ranges
+    assign: List[tuple] = []
+    group: List[int] = []
+    group_bytes = 0.0
+
+    def flush():
+        nonlocal group, group_bytes
+        if group:
+            assign.append(("chan", tuple(group)))
+        group, group_bytes = [], 0.0
+
+    for c in range(n_tasks):
+        if c in hot:
+            flush()
+            for subset in hot[c]:
+                assign.append(("split", c, subset))
+            continue
+        if not do_coalesce:
+            assign.append(("chan", (c,)))
+            continue
+        if group and group_bytes + sizes[c] > target:
+            flush()
+        group.append(c)
+        group_bytes += sizes[c]
+    flush()
+
+    n_groups = sum(1 for a in assign if a[0] == "chan" and len(a[1]) > 1)
+    if not hot and n_groups == 0:
+        return
+
+    def apply():
+        new_inputs = []
+        for i in s.inputs:
+            up = graph.stages[i.stage_id]
+            nparts = up.num_partitions
+            plans = []
+            for a in assign:
+                if a[0] == "chan":
+                    plans.append(tuple((p, c) for c in a[1]
+                                       for p in range(nparts)))
+                else:
+                    _kind, c, subset = a
+                    if i.stage_id == probe_sid:
+                        plans.append(tuple((p, c) for p in subset))
+                    else:
+                        # replicate the other side's hot channel to
+                        # every subtask (partial broadcast of hot keys)
+                        plans.append(tuple((p, c) for p in range(nparts)))
+            new_inputs.append(jg.StageInput(i.stage_id, i.mode,
+                                            fetch_plan=tuple(plans)))
+        s.inputs = tuple(new_inputs)
+        s.num_partitions = len(assign)
+
+    if _apply_rewrite(job, "coalesce" if not hot else "split",
+                      {s.stage_id}, apply):
+        if n_groups:
+            st.coalesced += n_groups
+            st.note("coalesce", stage=s.stage_id, groups=n_groups,
+                    tasks=len(assign), channels=n_tasks)
+        for c in sorted(hot):
+            st.split += 1
+            st.note("split", stage=s.stage_id, channel=c,
+                    subtasks=len(hot[c]),
+                    channel_bytes=int(per_input[probe_sid][c]))
+
+
+def _split_probe_sid(s: jg.Stage) -> Optional[int]:
+    """The probe-side input of a join stage whose hot channels may be
+    split (the other side's channel replicates to every subtask)."""
+    p = _stage_join(s)
+    return None if p is None else p.left.stage_id
+
+
+def _find_hot_channels(job, s: jg.Stage, probe_sid: int,
+                       probe_totals: List[float], target: float
+                       ) -> Dict[int, List[Tuple[int, ...]]]:
+    factor = _conf_float("adaptive.skew.factor", 4.0)
+    min_bytes = _conf_float("adaptive.skew.min_mb", 32.0) * _MB
+    max_sub = max(2, _conf_int("adaptive.skew.max_subtasks", 8))
+    if len(probe_totals) < 2:
+        return {}
+    med = statistics.median(probe_totals)
+    out: Dict[int, List[Tuple[int, ...]]] = {}
+    for c, size in enumerate(probe_totals):
+        if size < min_bytes or size <= factor * max(med, 1.0):
+            continue
+        k = min(max_sub, max(2, math.ceil(size / max(target, 1.0))))
+        subsets = _split_producer_parts(job, probe_sid, c, k)
+        if len(subsets) >= 2:
+            out[c] = subsets
+    return out
+
+
+def _split_producer_parts(job, sid: int, channel: int, k: int
+                          ) -> List[Tuple[int, ...]]:
+    """Partition a producer's partitions into ≤ k contiguous ranges of
+    roughly equal channel-``channel`` bytes. Deterministic: driven only
+    by the reported sizes."""
+    stage = job.graph.stages[sid]
+    weights: List[float] = []
+    for p in range(stage.num_partitions):
+        got = _decoded_entry(job, sid, p)
+        if got is None:
+            return []
+        chans, _raw = got
+        weights.append(chans[channel] if channel < len(chans) else 0.0)
+    total = sum(weights)
+    if total <= 0 or len(weights) < 2:
+        return []
+    per = total / k
+    subsets: List[Tuple[int, ...]] = []
+    cur: List[int] = []
+    acc = 0.0
+    for p, w in enumerate(weights):
+        cur.append(p)
+        acc += w
+        if acc >= per and len(subsets) < k - 1:
+            subsets.append(tuple(cur))
+            cur, acc = [], 0.0
+    if cur:
+        subsets.append(tuple(cur))
+    return subsets
+
+
+# ---------------------------------------------------------------------------
+# rewrite 4: join-reorder re-entry for the driver-run suffix
+# ---------------------------------------------------------------------------
+
+def _maybe_reorder(driver, job) -> None:
+    st = job.adaptive
+    if st.reorder_done or not truthy("adaptive.reorder.enabled"):
+        return
+    root = job.graph.root
+    if not all(driver._stage_complete(job, i.stage_id)
+               for i in root.inputs):
+        return
+    st.reorder_done = True
+    joins = [n for n in pn.walk_plan(root.plan)
+             if isinstance(n, pn.JoinExec)]
+    if len(joins) < 2:
+        return
+    from ..plan import join_reorder as jr
+    from ..plan.optimizer import _strip_runtime_filters
+
+    def static(node):
+        # both passes resolve driver-stripped memory scans to their real
+        # row counts, so the ONLY difference between them is whether the
+        # exchange leaves use observed stage output rows
+        if isinstance(node, pn.ScanExec) and node.format == "__driver__":
+            t = job.graph.scan_tables.get(node.table_name)
+            return None if t is None else float(t.num_rows)
+        return None
+
+    def observed(node):
+        if isinstance(node, jg.StageInputExec):
+            rows = job.stage_rows.get(node.stage_id)
+            return None if rows is None else float(rows)
+        return static(node)
+
+    try:
+        stripped = _strip_runtime_filters(root.plan)
+        baseline = jr.reorder_joins(stripped, est=static)
+        informed = jr.reorder_joins(stripped, est=observed)
+        # adopt only when the observed sizes actually INVERT the static
+        # ordering — otherwise keep the original (annotated) plan
+        if pn.explain(informed) == pn.explain(baseline):
+            return
+        # the strip dropped the original plan's runtime-filter edges;
+        # re-derive them against the reordered node identities (the
+        # optimizer pipeline re-annotates after its reorder pass too)
+        from ..plan.optimizer import _maybe_annotate_runtime_filters
+        informed = _maybe_annotate_runtime_filters(informed)
+        from ..analysis.invariants import validate_plan
+        validate_plan(informed, after="adaptive.reorder")
+    except Exception:  # noqa: BLE001 — a refused rewrite keeps the plan
+        return
+    old_schema = tuple((f.name, f.dtype) for f in root.plan.schema)
+    new_schema = tuple((f.name, f.dtype) for f in informed.schema)
+    if old_schema != new_schema:
+        return
+    root.plan = informed
+    st.reordered += 1
+    st.note("reorder", stage=root.stage_id, joins=len(joins))

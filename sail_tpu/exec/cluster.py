@@ -27,6 +27,7 @@ import statistics
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from concurrent import futures
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -214,6 +215,34 @@ class _StreamStore:
             return sh.iter_file_chunks(f)
         return sh.iter_buffer_chunks(entry)
 
+    def open_all_chunks(self, job_id: str, stage: int, partition: int):
+        """Serve EVERY channel of one task's output as one chunk
+        sequence — the channels' complete IPC streams back to back in
+        channel order (the fetch side's decoder re-opens at each
+        stream boundary). One round trip replaces num_channels fetches
+        for consumers that need the whole output of a shuffle-writing
+        producer (adaptive broadcast conversion)."""
+        with self._lock:
+            chans = self._streams.get((job_id, stage, partition))
+            channels = None if chans is None else sorted(chans)
+        if channels is None:
+            return None
+
+        def gen():
+            for c in channels:
+                chunks = self.open_chunks(job_id, stage, partition, c)
+                if chunks is None:
+                    # raced clean_job mid-serve: abort rather than ship
+                    # a silently truncated concatenation — the fetch
+                    # side fails over to the producer-re-run path
+                    raise FileNotFoundError(
+                        f"channel {c} of s{stage}p{partition} vanished")
+                for chunk in chunks:
+                    if chunk:
+                        yield chunk
+
+        return gen()
+
     def get(self, job_id: str, stage: int, partition: int,
             channel: int) -> Optional[bytes]:
         """Whole-channel bytes (tests/tools); the serve path streams
@@ -265,6 +294,17 @@ def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
             part = entry.slice(request.partition * per, per) if per \
                 else entry.slice(0, 0)
             chunks = sh.iter_buffer_chunks(sh.encode_table(part))
+        elif request.channel == -2:
+            # adaptive all-channels fetch: every channel of the task's
+            # output as back-to-back IPC streams in one round trip
+            chunks = store.open_all_chunks(request.job_id, request.stage,
+                                           request.partition)
+            if chunks is None:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"no streams for job={request.job_id} "
+                    f"stage={request.stage} "
+                    f"partition={request.partition}")
         else:
             chunks = store.open_chunks(request.job_id, request.stage,
                                        request.partition, request.channel)
@@ -287,6 +327,49 @@ def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
     return fetch
 
 
+# fetch-side peer channel cache: gRPC channels are thread-safe and
+# multiplexed, and adaptive fetch plans (a broadcast-converted build
+# side reads every channel of every producer) multiply small fetches —
+# a fresh channel per fetch made connection setup the dominant cost of
+# tiny streams. Bounded; eviction closes the channel (in-flight calls
+# on a closing channel fail like any transient error and retry/re-run).
+_PEER_CHANNEL_CAP = 32
+_peer_channels: "OrderedDict[str, grpc.Channel]" = OrderedDict()
+_peer_channels_lock = threading.Lock()
+
+
+def _peer_channel(addr: str) -> grpc.Channel:
+    evicted = []
+    with _peer_channels_lock:
+        ch = _peer_channels.pop(addr, None)
+        if ch is None:
+            ch = grpc.insecure_channel(addr)
+        _peer_channels[addr] = ch  # re-insert = move to MRU end
+        while len(_peer_channels) > _PEER_CHANNEL_CAP:
+            _addr, old = _peer_channels.popitem(last=False)  # LRU out
+            evicted.append(old)
+    for old in evicted:
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001
+            pass
+    return ch
+
+
+def _drop_peer_channel(addr: str) -> None:
+    """Evict a peer channel after a failed call: a cached channel sits
+    in gRPC's reconnect backoff after a refused connection, so the
+    single fetch retry must dial FRESH or a transient blip escalates
+    into a producer re-run."""
+    with _peer_channels_lock:
+        ch = _peer_channels.pop(addr, None)
+    if ch is not None:
+        try:
+            ch.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def _fetch_table(addr: str, req: pb.FetchStreamRequest, service: str,
                  timeout: float = 120.0,
                  stats: Optional[sh.FetchStats] = None):
@@ -297,7 +380,7 @@ def _fetch_table(addr: str, req: pb.FetchStreamRequest, service: str,
            else f"{addr}/s{req.stage}p{req.partition}c{req.channel}")
 
     def once():
-        channel = grpc.insecure_channel(addr)
+        channel = _peer_channel(addr)
         try:
             rpc = channel.unary_stream(
                 f"/{service}/FetchStream",
@@ -307,8 +390,16 @@ def _fetch_table(addr: str, req: pb.FetchStreamRequest, service: str,
                       rpc(req, timeout=timeout,
                           metadata=tr.inject_context()))
             return sh.decode_stream(sh.ChunkReader(chunks), stats=stats)
-        finally:
-            channel.close()
+        except grpc.RpcError as e:
+            # evict only on connectivity-class failures — the channel is
+            # SHARED by concurrent sibling fetches and close() cancels
+            # their in-flight RPCs, so a semantic failure (NOT_FOUND
+            # from a raced clean_job, a server-side error) must keep it
+            code = getattr(e, "code", lambda: None)()
+            if code in (grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.DEADLINE_EXCEEDED):
+                _drop_peer_channel(addr)
+            raise
 
     # one retry only: each attempt can legitimately take the full
     # stream timeout, so a blackholed peer must fail over to the
@@ -474,7 +565,14 @@ class WorkerActor(Actor):
         input_len: Dict[int, int] = {}
         for inp in task.inputs:
             addrs = list(inp.worker_addrs)
-            if inp.mode == "shuffle":
+            if inp.fetch_parts:
+                # adaptive fetch plan: explicit (producer partition,
+                # channel) pairs — coalesced channel runs, skew-split
+                # producer subsets, broadcast-converted build sides
+                wanted = [(int(p), int(c)) for p, c in
+                          zip(inp.fetch_parts, inp.fetch_channels)]
+                addrs = [addrs[p] for p, _c in wanted]
+            elif inp.mode == "shuffle":
                 wanted = [(i, task.partition) for i in range(len(addrs))]
             elif inp.mode == "forward":
                 wanted = [(task.partition, -1)]
@@ -726,6 +824,12 @@ class _Job:
         self.launched: Set[Tuple[int, int]] = set()
         # consumer tasks waiting for a producer re-run after a fetch failure
         self.pending: Set[Tuple[int, int]] = set()
+        # rows per (stage, partition) from the winning attempt — keyed
+        # (not accumulated) so a producer RE-RUN after worker loss
+        # overwrites idempotently: stage totals stay bit-identical
+        # across fault recovery, which the adaptive reorder and the
+        # observed-cardinality feedback depend on
+        self.partition_rows: Dict[Tuple[int, int], int] = {}
         self.stage_rows: Dict[int, int] = {}
         # attempt fencing: per (stage, partition), the attempts currently
         # IN FLIGHT and the worker running each — the first live attempt
@@ -769,6 +873,10 @@ class _Job:
         # attempt: {"worker_id", "rows_out", "operators": [...]}
         self.task_metrics: Dict[Tuple[int, int], dict] = {}
         self.result_addr: Optional[str] = None
+        # adaptive execution: decision log, skew telemetry, and the
+        # stage-completion transitions already processed
+        from . import adaptive as _aqe
+        self.adaptive = _aqe.AdaptiveState()
 
 
 class DriverActor(Actor):
@@ -1127,6 +1235,26 @@ class DriverActor(Actor):
         total = 0
         for i in stage.inputs:
             up = job.graph.stages[i.stage_id]
+            if i.fetch_plan is not None:
+                # adaptive rewrite: project exactly the pairs this task
+                # fetches (recomputed footprint after coalesce/split)
+                from . import adaptive as _aqe
+                pairs = i.fetch_plan[partition] \
+                    if partition < len(i.fetch_plan) else ()
+                decoded = {}  # per-partition memo: pairs share producers
+                for p, c in pairs:
+                    got = decoded.get(p)
+                    if got is None:
+                        got = _aqe._decoded_entry(job, i.stage_id, p)
+                        if got is None:
+                            return None
+                        decoded[p] = got
+                    dec, raw = got
+                    if c < 0:  # -1 whole unsplit output | -2 all channels
+                        total += int(raw)
+                    else:
+                        total += int(dec[c]) if c < len(dec) else 0
+                continue
             if i.mode == jg.InputMode.FORWARD:
                 # a pipelined FORWARD consumer reads ONLY its matching
                 # producer partition — and launches while sibling
@@ -1212,6 +1340,12 @@ class DriverActor(Actor):
         for stage in job.graph.stages:
             if stage.on_driver:
                 continue
+            if not all(self._stage_complete(job, b)
+                       for b in getattr(stage, "launch_after", ())):
+                # adaptive scheduling barrier: the broadcast-conversion
+                # decision window — cleared by the barrier stage
+                # completing, which re-enters this scheduler
+                continue
             pipelined = any(i.mode == jg.InputMode.FORWARD
                             for i in stage.inputs)
             if pipelined:
@@ -1272,8 +1406,15 @@ class DriverActor(Actor):
                               f"incomplete at launch")
                 job.done.set()
                 return False
-            inputs.append(pb.StageInputLocations(
-                stage_id=i.stage_id, mode=i.mode.value, worker_addrs=addrs))
+            loc = pb.StageInputLocations(
+                stage_id=i.stage_id, mode=i.mode.value, worker_addrs=addrs)
+            if i.fetch_plan is not None and \
+                    partition < len(i.fetch_plan):
+                # adaptive fetch assignment for THIS task
+                pairs = i.fetch_plan[partition]
+                loc.fetch_parts.extend(p for p, _c in pairs)
+                loc.fetch_channels.extend(c for _p, c in pairs)
+            inputs.append(loc)
         task = pb.TaskDefinition(
             job_id=job.job_id, stage=stage_id, partition=partition,
             attempt=attempt, plan=encode_cached(job, stage),
@@ -1458,8 +1599,13 @@ class DriverActor(Actor):
             job.fetch_wait_s += float(r.fetch_wait_s)
             job.decode_s += float(r.decode_s)
             job.locations[r.stage][r.partition] = w["addr"]
-            job.stage_rows[r.stage] = \
-                job.stage_rows.get(r.stage, 0) + int(r.rows_out)
+            # delta update keeps the per-(stage,partition) idempotent
+            # overwrite (a producer re-run replaces, never double-counts)
+            # without rescanning every stage's rows per report
+            prev_rows = job.partition_rows.get((r.stage, r.partition), 0)
+            job.partition_rows[(r.stage, r.partition)] = int(r.rows_out)
+            job.stage_rows[r.stage] = job.stage_rows.get(r.stage, 0) \
+                - prev_rows + int(r.rows_out)
             if r.metrics_json:
                 try:
                     import json as _json
@@ -1469,6 +1615,7 @@ class DriverActor(Actor):
                         "operators": _json.loads(r.metrics_json)}
                 except ValueError:
                     pass  # malformed metrics never fail a task
+            self._maybe_adapt(job, r.stage)
             self._fire_pending(job)
             self._schedule_ready_stages(job)
         elif r.state == "failed":
@@ -1509,6 +1656,24 @@ class DriverActor(Actor):
                               reason="failure", exclude={r.worker_id})
         elif r.state == "canceled":
             live.pop(r.attempt, None)
+
+    def _maybe_adapt(self, job: _Job, stage_id: int):
+        """Stage-boundary replanning hook: fires EXACTLY ONCE per stage
+        completion (re-completions after fault recovery re-produce
+        bit-identical outputs, so the first completion's statistics are
+        canonical), BEFORE any newly-unblocked consumer schedules."""
+        if job.done.is_set():
+            return
+        if not self._stage_complete(job, stage_id):
+            return
+        if stage_id in job.adaptive.stages_done:
+            return
+        job.adaptive.stages_done.add(stage_id)
+        try:
+            from . import adaptive as aqe
+            aqe.on_stage_complete(self, job, stage_id)
+        except Exception:  # noqa: BLE001 — adaptivity is advisory
+            pass
 
     def _stop_task_on(self, wid: str, job_id: str, stage: int,
                       partition: int, reason: str):
@@ -1856,6 +2021,29 @@ class LocalCluster:
                     fetch_wait_s=job.fetch_wait_s + stats.wait_s,
                     decode_s=job.decode_s + stats.decode_s,
                     governor_deferred=job.governor_deferred)
+                ad = job.adaptive
+                prof.note_adaptive(coalesced=ad.coalesced,
+                                   split=ad.split,
+                                   broadcast=ad.broadcast,
+                                   reordered=ad.reordered,
+                                   events=ad.events)
+                prof.note_skew(ad.skew)
+                prof.note_shuffle_channels(ad.channel_report)
+            # observed-cardinality feedback: leaf-stage output rows keyed
+            # by the scan subtree feed join_reorder / runtime-filter
+            # estimates on repeat queries (real cardinalities, not just
+            # footer counts)
+            try:
+                from ..plan import join_reorder as jr
+                for stage in graph.stages:
+                    if stage.inputs or stage.on_driver:
+                        continue
+                    rows = job.stage_rows.get(stage.stage_id)
+                    if rows is not None:
+                        jr.note_observed_rows(stage.plan, rows,
+                                              scan_tables=graph.scan_tables)
+            except Exception:  # noqa: BLE001 — feedback is advisory
+                pass
             return result
         finally:
             self.driver.handle.send(("cleanup", job.job_id))

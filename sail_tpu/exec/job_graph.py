@@ -50,6 +50,13 @@ class InputMode(enum.Enum):
 class StageInput:
     stage_id: int
     mode: InputMode
+    # adaptive execution: per consumer-task explicit fetch assignment —
+    # fetch_plan[task_partition] is the ordered tuple of (producer
+    # partition, channel) pairs that task pulls, replacing the mode's
+    # default fetch set. None = default semantics. Set only by
+    # exec/adaptive.py rewrites (coalesce, skew split, broadcast
+    # conversion) before the consuming stage launches.
+    fetch_plan: Optional[Tuple[Tuple[Tuple[int, int], ...], ...]] = None
 
 
 @dataclasses.dataclass
@@ -62,6 +69,16 @@ class Stage:
     shuffle_keys: Optional[Tuple[int, ...]] = None
     num_channels: int = 1
     on_driver: bool = False
+    # adaptive execution: scheduling-only barrier — this stage may not
+    # launch until these stages complete (the window in which a
+    # broadcast-conversion decision is made from the build side's
+    # observed output size). Cleared implicitly: barrier stages
+    # completing is exactly the launch condition.
+    launch_after: Tuple[int, ...] = ()
+    # adaptive execution: (probe producer sid, build producer sid) of a
+    # shuffle join eligible for broadcast conversion once the build
+    # side's observed size is in; None after the decision is taken.
+    bcast_candidate: Optional[Tuple[int, int]] = None
 
 
 @dataclasses.dataclass
@@ -507,6 +524,14 @@ def split_job(plan: pn.PlanNode, num_partitions: int) -> Optional[JobGraph]:
             graph.stage_filters = compute_runtime_filters(graph)
         except Exception:  # noqa: BLE001 — filters are advisory
             graph.stage_filters = {}
+    # adaptive execution: register broadcast-conversion candidates and
+    # barrier their probe producers behind the build side so the
+    # decision window exists when the build's observed size arrives
+    try:
+        from . import adaptive as aqe
+        aqe.plan_graph(graph)
+    except Exception:  # noqa: BLE001 — adaptivity is advisory
+        pass
     return graph
 
 

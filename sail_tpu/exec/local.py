@@ -3231,9 +3231,14 @@ def _rtf_est_rows(p: pn.PlanNode) -> float:
     """Runtime-filter direction estimate: join_reorder's cardinality
     model, except cross joins count as the cartesian PRODUCT (GOO's max
     is fine for ordering decisions but makes a 250k-row cross product
-    look like its 2.5k-row side, steering the filter the wrong way)."""
+    look like its 2.5k-row side, steering the filter the wrong way).
+    Observed cardinalities from completed cluster stages (the adaptive
+    stats-feedback loop) take precedence over the static model."""
     from ..plan import join_reorder as jr
 
+    obs = jr.observed_rows(p)
+    if obs is not None:
+        return obs
     if isinstance(p, pn.JoinExec):
         lr, rr = _rtf_est_rows(p.left), _rtf_est_rows(p.right)
         if p.join_type in ("semi", "anti"):

@@ -161,18 +161,39 @@ class ChunkReader:
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 
+    def peek(self, n: int) -> bytes:
+        """Up to ``n`` bytes WITHOUT consuming them — lets the decoder
+        detect whether another concatenated IPC stream follows."""
+        while len(self._buf) < n:
+            if not self._pull():
+                break
+        return self._buf[:n]
+
 
 def decode_stream(source, stats: Optional[FetchStats] = None):
-    """Decode an Arrow IPC stream (bytes, file-like, or
-    :class:`ChunkReader`) into a table, record batch by record batch.
-    The codec is auto-detected from the stream, so readers accept any
-    producer codec. Decode wall time (net of chunk wait for a
-    ChunkReader) lands in ``execution.shuffle.decode_time``."""
+    """Decode one or more CONCATENATED Arrow IPC streams (bytes,
+    file-like, or :class:`ChunkReader`) into a table, record batch by
+    record batch. Multiple streams arise from the all-channels fetch
+    (``channel = -2``): the server serves every hash channel of one
+    producer partition back to back, each a complete IPC stream with
+    its own schema header and EOS marker, and the reader re-opens at
+    each boundary. The codec is auto-detected per stream, so readers
+    accept any producer codec. Decode wall time (net of chunk wait for
+    a ChunkReader) lands in ``execution.shuffle.decode_time``."""
     import pyarrow as pa
     t0 = time.perf_counter()
-    reader = pa.ipc.open_stream(source)
-    batches = [b for b in reader]
-    table = pa.Table.from_batches(batches, schema=reader.schema)
+    if isinstance(source, (bytes, bytearray)):
+        source = ChunkReader(iter([bytes(source)]))
+    schema = None
+    batches = []
+    while True:
+        reader = pa.ipc.open_stream(source)
+        if schema is None:
+            schema = reader.schema
+        batches.extend(reader)
+        if not isinstance(source, ChunkReader) or not source.peek(1):
+            break  # single stream source, or no further stream follows
+    table = pa.Table.from_batches(batches, schema=schema)
     elapsed = time.perf_counter() - t0
     wait = source.wait_s if isinstance(source, ChunkReader) else 0.0
     decode_s = max(0.0, elapsed - wait)

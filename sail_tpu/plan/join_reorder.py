@@ -25,13 +25,20 @@ sail-cache statistics cache is the eventual source):
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..spec import data_type as dt
 from . import nodes as pn
 from . import rex as rx
 
 _DEFAULT_ROWS = 1_000_000.0
+
+#: optional leaf-estimate override: ``est(node) -> rows or None`` —
+#: adaptive re-entry feeds OBSERVED stage output rows for exchange
+#: leaves through this instead of the static model
+EstFn = Optional[Callable[[pn.PlanNode], Optional[float]]]
 
 
 @dataclasses.dataclass
@@ -57,17 +64,17 @@ class _Residual:
     leaves: Tuple[int, ...]
 
 
-def reorder_joins(p: pn.PlanNode) -> pn.PlanNode:
+def reorder_joins(p: pn.PlanNode, est: EstFn = None) -> pn.PlanNode:
     """Recursively reorder every maximal inner-join tree in the plan."""
     if isinstance(p, pn.JoinExec) and _is_reorderable(p):
-        return _reorder_tree(p)
+        return _reorder_tree(p, est)
     kids = {}
     for fname in ("input", "left", "right"):
         c = getattr(p, fname, None)
         if isinstance(c, pn.PlanNode):
-            kids[fname] = reorder_joins(c)
+            kids[fname] = reorder_joins(c, est)
     if hasattr(p, "inputs"):
-        kids["inputs"] = tuple(reorder_joins(c) for c in p.inputs)
+        kids["inputs"] = tuple(reorder_joins(c, est) for c in p.inputs)
     if kids:
         return dataclasses.replace(p, **kids)
     return p
@@ -77,21 +84,21 @@ def _is_reorderable(j: pn.JoinExec) -> bool:
     return j.join_type == "inner" and not j.null_aware and bool(j.left_keys)
 
 
-def _reorder_tree(root: pn.JoinExec) -> pn.PlanNode:
+def _reorder_tree(root: pn.JoinExec, est: EstFn = None) -> pn.PlanNode:
     leaves: List[_Leaf] = []
     edges: List[_Edge] = []
     residuals: List[_Residual] = []
-    ok = _collect(root, leaves, edges, residuals, 0)
+    ok = _collect(root, leaves, edges, residuals, 0, est)
     if not ok or len(leaves) < 3 or len(leaves) > 16:
         # nothing to gain (or too odd a shape): recurse into children only
         return dataclasses.replace(
-            root, left=reorder_joins(root.left),
-            right=reorder_joins(root.right))
+            root, left=reorder_joins(root.left, est),
+            right=reorder_joins(root.right, est))
     order, plan = _greedy(leaves, edges, residuals)
     if plan is None:
         return dataclasses.replace(
-            root, left=reorder_joins(root.left),
-            right=reorder_joins(root.right))
+            root, left=reorder_joins(root.left, est),
+            right=reorder_joins(root.right, est))
     # restore the original column order with an identity projection
     new_offsets: Dict[int, int] = {}
     pos = 0
@@ -108,13 +115,15 @@ def _reorder_tree(root: pn.JoinExec) -> pn.PlanNode:
     return pn.ProjectExec(plan, tuple(exprs))
 
 
-def _collect(p: pn.PlanNode, leaves, edges, residuals, offset) -> bool:
+def _collect(p: pn.PlanNode, leaves, edges, residuals, offset,
+             est: EstFn = None) -> bool:
     """Flatten an inner-join tree; returns False on unsupported shapes."""
     if isinstance(p, pn.JoinExec) and _is_reorderable(p):
         wl = len(p.left.schema)
-        if not _collect(p.left, leaves, edges, residuals, offset):
+        if not _collect(p.left, leaves, edges, residuals, offset, est):
             return False
-        if not _collect(p.right, leaves, edges, residuals, offset + wl):
+        if not _collect(p.right, leaves, edges, residuals, offset + wl,
+                        est):
             return False
         for lk, rk in zip(p.left_keys, p.right_keys):
             ga = rx.shift_refs(lk, offset)
@@ -134,9 +143,9 @@ def _collect(p: pn.PlanNode, leaves, edges, residuals, offset) -> bool:
             ls = tuple(sorted({_leaf_of_index(leaves, i) for i in refs}))
             residuals.append(_Residual(ge, ls))
         return True
-    leaves.append(_Leaf(reorder_joins(p), offset, len(p.schema),
-                        max(_est_rows(p), 1.0),
-                        max(_base_rows(p), 1.0)))
+    leaves.append(_Leaf(reorder_joins(p, est), offset, len(p.schema),
+                        max(_est_rows(p, est), 1.0),
+                        max(_base_rows(p, est), 1.0)))
     return True
 
 
@@ -209,38 +218,148 @@ def _conjunct_selectivity(c: rx.Rex) -> float:
     return 0.25
 
 
-def _est_rows(p: pn.PlanNode) -> float:
+def _est_rows(p: pn.PlanNode, est: EstFn = None) -> float:
+    if est is not None:
+        v = est(p)
+        if v is not None:
+            return float(v)
+    obs = observed_rows(p)
+    if obs is not None:
+        return obs
     if isinstance(p, pn.ScanExec):
         return _scan_rows(p)
     if isinstance(p, pn.FilterExec):
-        return _est_rows(p.input) * _conjunct_selectivity(p.condition)
+        return _est_rows(p.input, est) * _conjunct_selectivity(p.condition)
     if isinstance(p, pn.AggregateExec):
-        return max(_est_rows(p.input) * 0.1, 1.0)
+        return max(_est_rows(p.input, est) * 0.1, 1.0)
     if isinstance(p, pn.JoinExec):
-        lr, rr = _est_rows(p.left), _est_rows(p.right)
+        lr, rr = _est_rows(p.left, est), _est_rows(p.right, est)
         if p.join_type in ("semi", "anti"):
             return lr * 0.5
         return max(lr, rr)
     if isinstance(p, pn.UnionExec):
-        return sum(_est_rows(c) for c in p.inputs)
+        return sum(_est_rows(c, est) for c in p.inputs)
     child = getattr(p, "input", None)
     if isinstance(child, pn.PlanNode):
-        return _est_rows(child)
+        return _est_rows(child, est)
     return _DEFAULT_ROWS
 
 
-def _base_rows(p: pn.PlanNode) -> float:
-    """Unfiltered base cardinality — the ndv proxy for join keys."""
+def _base_rows(p: pn.PlanNode, est: EstFn = None) -> float:
+    """Unfiltered base cardinality — the ndv proxy for join keys.
+    Observed post-filter rows do NOT feed this (they would corrupt the
+    ndv proxy); only an explicit ``est`` override does (exchange leaves
+    and stripped scans whose only known cardinality IS the supplied
+    one)."""
+    if est is not None:
+        v = est(p)
+        if v is not None:
+            return float(v)
     if isinstance(p, pn.ScanExec):
         return _scan_rows(p)
     if isinstance(p, pn.JoinExec):
-        return max(_base_rows(p.left), _base_rows(p.right))
+        return max(_base_rows(p.left, est), _base_rows(p.right, est))
     if isinstance(p, pn.UnionExec):
-        return sum(_base_rows(c) for c in p.inputs)
+        return sum(_base_rows(c, est) for c in p.inputs)
     child = getattr(p, "input", None)
     if isinstance(child, pn.PlanNode):
-        return _base_rows(child)
+        return _base_rows(child, est)
     return _DEFAULT_ROWS
+
+
+# ---------------------------------------------------------------------------
+# observed-cardinality feedback (adaptive execution satellite): completed
+# leaf stages report their ACTUAL output rows; keyed by a stable
+# fingerprint of the Filter/Project-over-Scan subtree, they replace the
+# selectivity guesses above on repeat queries. Advisory: a stale or
+# colliding observation only skews an estimate, never a result.
+# ---------------------------------------------------------------------------
+
+_OBS_CAP = 512
+_OBS_LOCK = threading.Lock()
+_OBSERVED_ROWS: "OrderedDict[tuple, float]" = OrderedDict()
+
+_FEEDBACK_DEFAULT: Optional[bool] = None
+
+
+def _feedback_enabled() -> bool:
+    # observed_rows runs per node inside estimation loops: one direct
+    # os.environ lookup (tests toggle the env var), falling back to the
+    # YAML default resolved once per process — never the full
+    # app-config re-flatten per call
+    import os
+
+    from ..config import truthy, truthy_value
+    env = os.environ.get("SAIL_ADAPTIVE__STATS_FEEDBACK")
+    if env is not None:
+        return truthy_value(env)
+    global _FEEDBACK_DEFAULT
+    if _FEEDBACK_DEFAULT is None:
+        _FEEDBACK_DEFAULT = truthy("adaptive.stats_feedback")
+    return _FEEDBACK_DEFAULT
+
+
+def observation_key(p: pn.PlanNode, scan_tables=None) -> Optional[tuple]:
+    """Stable fingerprint of a pure Filter/Project-over-Scan chain,
+    identical between the session plan (memory scans with a live
+    source) and the driver's stripped stage plan (``__driver__`` scans
+    resolved through ``scan_tables``). None for any other shape."""
+    parts: List[tuple] = []
+    scans = 0
+    for n in pn.walk_plan(p):
+        if isinstance(n, pn.FilterExec):
+            parts.append(("f", pn._rex_str(n.condition)))
+        elif isinstance(n, pn.ProjectExec):
+            parts.append(("p", tuple(name for name, _e in n.exprs),
+                          tuple(pn._rex_str(e) for _n, e in n.exprs)))
+        elif isinstance(n, pn.ScanExec):
+            scans += 1
+            rows = None
+            if n.format == "__driver__" and scan_tables is not None:
+                t = scan_tables.get(n.table_name)
+                rows = None if t is None else t.num_rows
+            elif n.source is not None:
+                rows = n.source.num_rows
+            parts.append((
+                "s", n.paths, tuple(f.name for f in n.schema), rows,
+                tuple(pn._rex_str(c) for c in n.predicates)))
+        else:
+            return None
+    if scans != 1:
+        return None
+    return tuple(parts)
+
+
+def note_observed_rows(p: pn.PlanNode, rows, scan_tables=None) -> None:
+    """Record a completed subtree's actual output row count."""
+    if not _feedback_enabled():
+        return
+    key = observation_key(p, scan_tables)
+    if key is None:
+        return
+    with _OBS_LOCK:
+        _OBSERVED_ROWS[key] = float(rows)
+        _OBSERVED_ROWS.move_to_end(key)
+        while len(_OBSERVED_ROWS) > _OBS_CAP:
+            _OBSERVED_ROWS.popitem(last=False)
+
+
+def observed_rows(p: pn.PlanNode) -> Optional[float]:
+    """The recorded cardinality of this exact subtree, if any."""
+    if not _OBSERVED_ROWS:
+        return None  # common case: nothing recorded, zero overhead
+    if not _feedback_enabled():
+        return None
+    key = observation_key(p)
+    if key is None:
+        return None
+    with _OBS_LOCK:
+        return _OBSERVED_ROWS.get(key)
+
+
+def clear_observed_rows() -> None:
+    with _OBS_LOCK:
+        _OBSERVED_ROWS.clear()
 
 
 # ---------------------------------------------------------------------------

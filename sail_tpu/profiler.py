@@ -85,6 +85,17 @@ class QueryProfile:
     shuffle_fetch_wait_ms: float = 0.0
     shuffle_decode_ms: float = 0.0
     governor_deferred: int = 0
+    # adaptive query execution: stage-boundary replanning decisions the
+    # driver took from observed shuffle statistics, plus the per-shuffle
+    # skew ratios and per-channel size reports they were based on (the
+    # skew surface records even when adaptive execution is off)
+    adaptive_coalesced: int = 0
+    adaptive_split: int = 0
+    adaptive_broadcast: int = 0
+    adaptive_reordered: int = 0
+    adaptive_events: List[dict] = field(default_factory=list)
+    skew: List[dict] = field(default_factory=list)
+    shuffle_channels: List[dict] = field(default_factory=list)
     # plan-invariant validator walks that ran for this query (optimizer
     # pass boundaries + job-graph stage checks)
     validated_passes: int = 0
@@ -193,6 +204,31 @@ class QueryProfile:
             self.shuffle_decode_ms += float(decode_s) * 1000.0
             self.governor_deferred += int(governor_deferred)
 
+    def note_adaptive(self, coalesced: int = 0, split: int = 0,
+                      broadcast: int = 0, reordered: int = 0,
+                      events=None) -> None:
+        with self._lock:
+            self.adaptive_coalesced += int(coalesced)
+            self.adaptive_split += int(split)
+            self.adaptive_broadcast += int(broadcast)
+            self.adaptive_reordered += int(reordered)
+            if events:
+                room = 128 - len(self.adaptive_events)
+                if room > 0:
+                    self.adaptive_events.extend(list(events)[:room])
+
+    def note_skew(self, entries) -> None:
+        with self._lock:
+            room = 32 - len(self.skew)
+            if room > 0 and entries:
+                self.skew.extend(list(entries)[:room])
+
+    def note_shuffle_channels(self, entries) -> None:
+        with self._lock:
+            room = 32 - len(self.shuffle_channels)
+            if room > 0 and entries:
+                self.shuffle_channels.extend(list(entries)[:room])
+
     def note_fusion(self, stages: int = 0, fused_ops: int = 0,
                     fallbacks: int = 0) -> None:
         with self._lock:
@@ -268,7 +304,16 @@ class QueryProfile:
                 "fetch_wait_ms": round(self.shuffle_fetch_wait_ms, 3),
                 "decode_ms": round(self.shuffle_decode_ms, 3),
                 "governor_deferred": self.governor_deferred,
+                "channels": list(self.shuffle_channels),
             },
+            "adaptive": {
+                "coalesced": self.adaptive_coalesced,
+                "split": self.adaptive_split,
+                "broadcast": self.adaptive_broadcast,
+                "reordered": self.adaptive_reordered,
+                "events": list(self.adaptive_events),
+            },
+            "skew": list(self.skew),
             "validated_passes": self.validated_passes,
             "fusion": {
                 "stages": self.fusion_stages,
@@ -319,6 +364,19 @@ class QueryProfile:
             if self.governor_deferred:
                 line += f" governor_deferred={self.governor_deferred}"
             lines.append(line)
+        for entry in self.skew:
+            lines.append(
+                f"skew: stage {entry.get('stage')} max/median="
+                f"{entry.get('ratio')}x (max={entry.get('max_bytes')}B "
+                f"median={entry.get('median_bytes')}B over "
+                f"{entry.get('channels')} channels)")
+        if (self.adaptive_coalesced or self.adaptive_split
+                or self.adaptive_broadcast or self.adaptive_reordered):
+            lines.append(
+                f"adaptive: coalesced={self.adaptive_coalesced} "
+                f"split={self.adaptive_split} "
+                f"broadcast={self.adaptive_broadcast} "
+                f"reordered={self.adaptive_reordered}")
         if self.fusion_stages:
             extra = f" ({self.fusion_fused_ops} ops inlined"
             if self.fusion_fallbacks:
@@ -508,7 +566,14 @@ def _finalize(profile: QueryProfile, threshold_ms: float) -> None:
                      "query.spill_bytes": profile.spill_bytes,
                      "query.runtime_filter.built": profile.rtf_built,
                      "query.runtime_filter.rows_pruned":
-                         profile.rtf_rows_pruned}
+                         profile.rtf_rows_pruned,
+                     "query.adaptive.coalesced":
+                         profile.adaptive_coalesced,
+                     "query.adaptive.split": profile.adaptive_split,
+                     "query.adaptive.broadcast":
+                         profile.adaptive_broadcast,
+                     "query.adaptive.reordered":
+                         profile.adaptive_reordered}
             for name, ms in profile.phase_items():
                 attrs[f"query.phase.{name}_ms"] = round(ms, 3)
             start_ns = int(profile.start_time * 1e9)

@@ -934,6 +934,13 @@ class SessionConf:
                  "spark.sail.shuffle.fetchConcurrency"),
                 ("cluster.memory_budget_mb",
                  "spark.sail.cluster.memoryBudgetMb"),
+                ("adaptive.enabled", "spark.sail.adaptive.enabled"),
+                ("adaptive.coalesce.target_mb",
+                 "spark.sail.adaptive.coalesce.targetMb"),
+                ("adaptive.skew.factor",
+                 "spark.sail.adaptive.skew.factor"),
+                ("adaptive.broadcast.threshold_mb",
+                 "spark.sail.adaptive.broadcast.thresholdMb"),
                 ("faults.spec", "spark.sail.faults.spec"),
                 ("faults.seed", "spark.sail.faults.seed"),
                 ("analysis.validate_plans",

@@ -85,6 +85,13 @@ def main():
     changed |= _add_field(report, "fetch_wait_s", 12, F.TYPE_DOUBLE)
     changed |= _add_field(report, "decode_s", 13, F.TYPE_DOUBLE)
 
+    # adaptive query execution: explicit per-task fetch pairs
+    sil = _message(fdp, "StageInputLocations")
+    changed |= _add_field(sil, "fetch_parts", 4, F.TYPE_UINT32,
+                          label=F.LABEL_REPEATED)
+    changed |= _add_field(sil, "fetch_channels", 5, F.TYPE_SINT32,
+                          label=F.LABEL_REPEATED)
+
     if not changed:
         print("pb2 already up to date")
         return

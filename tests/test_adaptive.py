@@ -1,0 +1,521 @@
+"""Adaptive query execution: stage-boundary replanning from observed
+shuffle statistics (exec/adaptive.py).
+
+Covers the four rewrites (coalesce / skew split / broadcast conversion /
+reorder re-entry) end-to-end on the local cluster with results checked
+against AQE-off runs, the adaptive invariant (fetch plans + frozen
+stages), the skew telemetry surface that records even when AQE is off,
+the observed-cardinality feedback loop, and the chaos suite: decisions
+must be deterministic per fault seed and results bit-identical under
+worker crash, fetch drop, and speculation racing a replanned stage."""
+
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession, faults
+from sail_tpu.analysis.invariants import (PlanInvariantError,
+                                          stage_signature,
+                                          validate_adaptive_rewrite,
+                                          validate_job_graph)
+from sail_tpu.exec import job_graph as jg
+from sail_tpu.exec.cluster import LocalCluster
+from sail_tpu.plan import join_reorder as jr
+from sail_tpu.sql import parse_one
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    jr.clear_observed_rows()
+    yield
+    faults.reset()
+    jr.clear_observed_rows()
+
+
+def _plan_for(spark, sql):
+    return spark._resolve(parse_one(sql))
+
+
+def _canon(table):
+    return table.sort_by([(c, "ascending") for c in table.column_names])
+
+
+def _run_once(plan, nparts=4, timeout=120):
+    c = LocalCluster(num_workers=2)
+    try:
+        out = c.run_job(plan, num_partitions=nparts, timeout=timeout)
+        return out, c.last_job
+    finally:
+        c.stop()
+
+
+def _skew_spark(hot_frac=0.75, n=20000, n_dim=101_000, seed=3):
+    """A skewed fact⋈dim workload: hot_frac of fact rows share key 0
+    (one hot hash channel); dim exceeds BROADCAST_ROW_LIMIT so the join
+    shuffles instead of statically broadcasting."""
+    spark = SparkSession({})
+    rng = np.random.default_rng(seed)
+    keys = np.where(rng.random(n) < hot_frac, 0,
+                    rng.integers(0, n_dim, n))
+    fact = pd.DataFrame({"k": keys, "v": rng.integers(0, 1000, n)})
+    dim = pd.DataFrame({"k2": np.arange(n_dim),
+                        "grp": np.arange(n_dim) % 5,
+                        "flag": (np.arange(n_dim) % 997 == 0)
+                        .astype(np.int64)})
+    spark.createDataFrame(fact).createOrReplaceTempView("fact")
+    spark.createDataFrame(dim).createOrReplaceTempView("dim")
+    return spark, fact, dim
+
+
+_SKEW_SQL = ("SELECT d.grp AS grp, sum(f.v) AS s, count(*) AS c "
+             "FROM fact f JOIN dim d ON f.k = d.k2 GROUP BY d.grp")
+
+
+def _skew_knobs(monkeypatch, broadcast=False):
+    """Thresholds scaled to test-sized data (operators tune these to
+    cluster memory; the defaults target tens of MB per channel)."""
+    monkeypatch.setenv("SAIL_ADAPTIVE__SKEW__MIN_MB", "0.01")
+    monkeypatch.setenv("SAIL_ADAPTIVE__SKEW__FACTOR", "2.0")
+    monkeypatch.setenv("SAIL_ADAPTIVE__COALESCE__TARGET_MB", "0.1")
+    if not broadcast:
+        monkeypatch.setenv("SAIL_ADAPTIVE__BROADCAST__ENABLED", "0")
+
+
+# ---------------------------------------------------------------------------
+# plumbing
+# ---------------------------------------------------------------------------
+
+def test_proto_fetch_fields_roundtrip():
+    from sail_tpu.exec.proto import control_plane_pb2 as pb
+    m = pb.StageInputLocations(stage_id=2, mode="shuffle",
+                               worker_addrs=["a", "b"],
+                               fetch_parts=[0, 1, 1],
+                               fetch_channels=[-1, 0, 3])
+    back = pb.StageInputLocations.FromString(m.SerializeToString())
+    assert list(back.fetch_parts) == [0, 1, 1]
+    assert list(back.fetch_channels) == [-1, 0, 3]
+
+
+def test_fetch_plan_invariant_rejects_bad_channel():
+    spark = SparkSession({})
+    spark.createDataFrame(pd.DataFrame(
+        {"g": np.arange(100) % 4, "v": np.arange(100)})) \
+        .createOrReplaceTempView("fp_t")
+    plan = _plan_for(spark, "SELECT g, sum(v) AS s FROM fp_t GROUP BY g")
+    graph = jg.split_job(plan, 4)
+    assert graph is not None
+    consumer = next(s for s in graph.stages
+                    if s.inputs and s.inputs[0].mode == jg.InputMode.SHUFFLE)
+    sid = consumer.inputs[0].stage_id
+    good = tuple(tuple((p, j) for p in range(4))
+                 for j in range(consumer.num_partitions))
+    consumer.inputs = (jg.StageInput(sid, jg.InputMode.SHUFFLE,
+                                     fetch_plan=good),)
+    validate_job_graph(graph)  # identity channel-per-task plan passes
+    bad = tuple(tuple((p, 99) for p in range(4))
+                for _ in range(consumer.num_partitions))
+    consumer.inputs = (jg.StageInput(sid, jg.InputMode.SHUFFLE,
+                                     fetch_plan=bad),)
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_job_graph(graph)
+    assert ei.value.invariant == "adaptive.fetch_plan"
+    # coverage: dropping one channel's fetch entirely must be refused
+    # (a silently-wrong-results shape, not just an out-of-range one)
+    dropped = (tuple((p, 0) for p in range(4)),) + tuple(
+        tuple((p, 1) for p in range(4))
+        for _ in range(consumer.num_partitions - 1))
+    consumer.inputs = (jg.StageInput(sid, jg.InputMode.SHUFFLE,
+                                     fetch_plan=dropped),)
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_job_graph(graph)
+    assert ei.value.invariant == "adaptive.fetch_plan"
+    # coverage: a split whose slices overlap without full replication
+    overlap = (tuple((p, 0) for p in (0, 1)),
+               tuple((p, 0) for p in (1, 2, 3)),
+               tuple((p, 1) for p in range(4))
+               + tuple((p, 2) for p in range(4)),
+               tuple((p, 3) for p in range(4)))
+    consumer.inputs = (jg.StageInput(sid, jg.InputMode.SHUFFLE,
+                                     fetch_plan=overlap),)
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_job_graph(graph)
+    assert ei.value.invariant == "adaptive.fetch_plan"
+
+
+def test_adaptive_invariant_rejects_frozen_stage_touch():
+    spark = SparkSession({})
+    spark.createDataFrame(pd.DataFrame(
+        {"g": np.arange(100) % 4, "v": np.arange(100)})) \
+        .createOrReplaceTempView("fz_t")
+    plan = _plan_for(spark, "SELECT g, sum(v) AS s FROM fz_t GROUP BY g")
+    graph = jg.split_job(plan, 4)
+    before = {s.stage_id: stage_signature(s) for s in graph.stages}
+    frozen = {graph.stages[0].stage_id}
+    graph.stages[0].num_partitions += 1  # tamper with a launched stage
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_adaptive_rewrite(graph, frozen=frozen, before=before)
+    assert ei.value.invariant == "adaptive.frozen"
+
+
+def _forward_over_agg_spark():
+    """A final aggregate (shuffle consumer) whose output feeds a
+    statically-broadcast join: the join stage reads the aggregate
+    FORWARD with its task count frozen at graph build."""
+    spark = SparkSession({})
+    rng = np.random.default_rng(7)
+    big = pd.DataFrame({"g": rng.integers(0, 40, 6000),
+                        "v": rng.integers(0, 1000, 6000)})
+    small = pd.DataFrame({"id": np.arange(40),
+                          "name": [f"n{i}" for i in range(40)]})
+    spark.createDataFrame(big).createOrReplaceTempView("fw_big")
+    spark.createDataFrame(small).createOrReplaceTempView("fw_small")
+    sql = ("SELECT a.g AS g, a.s AS s, sm.name AS name FROM "
+           "(SELECT g, sum(v) AS s FROM fw_big GROUP BY g) a "
+           "JOIN fw_small sm ON a.g = sm.id")
+    return spark, sql
+
+
+def test_forward_arity_invariant():
+    """validate_job_graph refuses a FORWARD edge whose producer and
+    consumer task counts disagree (the shape an unguarded adaptive
+    rewrite of the producer would create: stranded or dropped
+    partitions)."""
+    spark, sql = _forward_over_agg_spark()
+    graph = jg.split_job(_plan_for(spark, sql), 4)
+    assert graph is not None
+    fwd = next((s, i) for s in graph.stages for i in s.inputs
+               if i.mode == jg.InputMode.FORWARD)
+    consumer, fin = fwd
+    producer = graph.stages[fin.stage_id]
+    validate_job_graph(graph)
+    producer.num_partitions -= 1  # what an unguarded coalesce would do
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_job_graph(graph)
+    assert ei.value.invariant == "stage.forward_arity"
+
+
+def test_forward_consumer_blocks_coalesce(monkeypatch):
+    """A shuffle consumer read FORWARD by a pipelined broadcast join
+    must never be coalesced/split — its downstream task count is frozen
+    — while results still match AQE-off."""
+    monkeypatch.setenv("SAIL_ADAPTIVE__COALESCE__TARGET_MB", "0.1")
+    spark, sql = _forward_over_agg_spark()
+    plan = _plan_for(spark, sql)
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    off, _ = _run_once(plan)
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "1")
+    on, job = _run_once(plan)
+    for s in job.graph.stages:
+        for i in s.inputs:
+            if i.mode == jg.InputMode.FORWARD:
+                prod = job.graph.stages[i.stage_id]
+                assert prod.num_partitions == s.num_partitions
+                assert all(j.fetch_plan is None for j in prod.inputs)
+    validate_job_graph(job.graph)
+    assert _canon(on).equals(_canon(off))
+
+
+# ---------------------------------------------------------------------------
+# the four rewrites, e2e vs AQE-off
+# ---------------------------------------------------------------------------
+
+def test_coalesce_fires_and_results_match(monkeypatch):
+    """Tiny shuffle channels coalesce into fewer consumer tasks under
+    the default 64MB target; results identical to AQE-off."""
+    spark = SparkSession({})
+    rng = np.random.default_rng(21)
+    df = pd.DataFrame({"g": rng.integers(0, 8, 4000),
+                       "v": rng.integers(0, 1000, 4000)})
+    spark.createDataFrame(df).createOrReplaceTempView("co_t")
+    plan = _plan_for(
+        spark, "SELECT g, sum(v) AS s, count(*) AS c FROM co_t GROUP BY g")
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    off, off_job = _run_once(plan)
+    assert off_job.adaptive.counts() == {
+        "coalesced": 0, "split": 0, "broadcast": 0, "reordered": 0}
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "1")
+    on, job = _run_once(plan)
+    assert job.adaptive.coalesced >= 1, job.adaptive.events
+    final = next(s for s in job.graph.stages
+                 if s.inputs and any(i.fetch_plan is not None
+                                     for i in s.inputs))
+    assert final.num_partitions < 4
+    assert _canon(on).equals(_canon(off))
+
+
+def test_skew_split_fires_and_results_match(monkeypatch):
+    _skew_knobs(monkeypatch)
+    spark, fact, dim = _skew_spark()
+    plan = _plan_for(spark, _SKEW_SQL)
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    off, _ = _run_once(plan)
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "1")
+    on, job = _run_once(plan)
+    assert job.adaptive.split >= 1, job.adaptive.events
+    split_events = [e for e in job.adaptive.events if e["kind"] == "split"]
+    assert all(e["subtasks"] >= 2 for e in split_events)
+    assert _canon(on).equals(_canon(off))
+    # the oracle agrees too
+    m = fact.merge(dim, left_on="k", right_on="k2")
+    exp = m.groupby("grp", as_index=False).agg(s=("v", "sum"),
+                                               c=("v", "size"))
+    got = on.to_pandas().sort_values("grp").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_broadcast_conversion_fires_and_results_match(monkeypatch):
+    """A shuffle join whose FILTERED build side turns out tiny converts:
+    the probe producer never shuffle-writes and each join task reads its
+    probe partition FORWARD plus the whole build output."""
+    spark, fact, dim = _skew_spark(hot_frac=0.0)
+    sql = ("SELECT count(*) AS c, sum(f.v) AS s FROM fact f "
+           "JOIN (SELECT k2 FROM dim WHERE flag = 1) d ON f.k = d.k2")
+    plan = _plan_for(spark, sql)
+    graph = jg.split_job(plan, 4)
+    join_stage = next(s for s in graph.stages
+                      if s.bcast_candidate is not None)
+    probe_sid, build_sid = join_stage.bcast_candidate
+    assert build_sid in graph.stages[probe_sid].launch_after
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    off, _ = _run_once(plan)
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "1")
+    on, job = _run_once(plan)
+    assert job.adaptive.broadcast >= 1, job.adaptive.events
+    conv = next(s for s in job.graph.stages
+                if any(i.mode == jg.InputMode.FORWARD for i in s.inputs)
+                and any(i.fetch_plan is not None for i in s.inputs))
+    probe = job.graph.stages[
+        next(i.stage_id for i in conv.inputs
+             if i.mode == jg.InputMode.FORWARD)]
+    assert probe.shuffle_keys is None  # never hash-partitioned its output
+    assert _canon(on).equals(_canon(off))
+
+
+def test_reorder_reentry_on_observed_inversion(monkeypatch):
+    """The driver-run root suffix re-enters join_reorder with OBSERVED
+    stage rows; the rewrite is adopted exactly when they invert the
+    static ordering."""
+    spark = SparkSession({})
+    rng = np.random.default_rng(9)
+    t1 = pd.DataFrame({"a": np.arange(50000),
+                       "x": np.arange(50000) % 1000})
+    t2 = pd.DataFrame({"b": rng.integers(0, 50000, 20000),
+                       "c": rng.integers(0, 5000, 20000)})
+    t3 = pd.DataFrame({"d": rng.integers(0, 5000, 30000),
+                       "w": rng.normal(size=30000)})
+    for name, df in (("t1", t1), ("t2", t2), ("t3", t3)):
+        spark.createDataFrame(df).createOrReplaceTempView(name)
+    # expression join keys keep the joins out of the distributed stages
+    # (the suffix the adaptive layer may reorder) while staying
+    # reorderable; the t1 filter makes the exchange leaf's OBSERVED
+    # rows tiny where the static model assumes the 1M default
+    sql = ("SELECT count(*) AS c FROM t1 "
+           "JOIN t2 ON t1.a + 0 = t2.b + 0 "
+           "JOIN t3 ON t2.c + 0 = t3.d + 0 "
+           "WHERE t1.x = 7")
+    plan = _plan_for(spark, sql)
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    off, _ = _run_once(plan, nparts=3)
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "1")
+    on, job = _run_once(plan, nparts=3)
+    assert job.adaptive.reordered == 1, job.adaptive.events
+    assert on.equals(off)
+    m = t1[t1.x == 7].merge(t2, left_on="a", right_on="b") \
+        .merge(t3, left_on="c", right_on="d")
+    assert on.column("c").to_pylist() == [len(m)]
+
+
+def test_adaptive_off_leaves_graph_untouched(monkeypatch):
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    _skew_knobs(monkeypatch)
+    spark, _fact, _dim = _skew_spark()
+    plan = _plan_for(spark, _SKEW_SQL)
+    _out, job = _run_once(plan)
+    assert job.adaptive.counts() == {
+        "coalesced": 0, "split": 0, "broadcast": 0, "reordered": 0}
+    for s in job.graph.stages:
+        assert s.launch_after == ()
+        assert all(i.fetch_plan is None for i in s.inputs)
+
+
+# ---------------------------------------------------------------------------
+# telemetry surfaces
+# ---------------------------------------------------------------------------
+
+def test_skew_surface_records_even_when_aqe_off(monkeypatch):
+    from sail_tpu import profiler
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    spark, _fact, _dim = _skew_spark()
+    plan = _plan_for(spark, _SKEW_SQL)
+    c = LocalCluster(num_workers=2)
+    try:
+        with profiler.profile_query("skew surface") as prof:
+            c.run_job(plan, num_partitions=4, timeout=120)
+    finally:
+        c.stop()
+    assert prof.skew, "skew telemetry must record with AQE off"
+    worst = max(e["ratio"] for e in prof.skew)
+    assert worst > 2.0  # the hot channel is visible
+    text = prof.render()
+    assert "skew:" in text and "max/median" in text
+    d = prof.to_dict()
+    assert d["skew"] and d["shuffle"]["channels"]
+    chans = d["shuffle"]["channels"][0]
+    assert chans["compressed_bytes"] and chans["raw_bytes"] > 0
+    assert d["adaptive"]["coalesced"] == 0
+
+
+def test_adaptive_line_in_profile(monkeypatch):
+    from sail_tpu import profiler
+    _skew_knobs(monkeypatch)
+    spark, _fact, _dim = _skew_spark()
+    plan = _plan_for(spark, _SKEW_SQL)
+    c = LocalCluster(num_workers=2)
+    try:
+        with profiler.profile_query("adaptive profile") as prof:
+            c.run_job(plan, num_partitions=4, timeout=120)
+    finally:
+        c.stop()
+    assert prof.adaptive_split >= 1 or prof.adaptive_coalesced >= 1
+    assert "adaptive: coalesced=" in prof.render()
+    d = prof.to_dict()
+    assert d["adaptive"]["events"]
+    assert {"coalesced", "split", "broadcast",
+            "reordered"} <= set(d["adaptive"])
+
+
+def test_query_profiles_system_table_surfaces_skew(monkeypatch):
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    spark, _fact, _dim = _skew_spark()
+    plan = _plan_for(spark, _SKEW_SQL)
+    from sail_tpu import profiler
+    c = LocalCluster(num_workers=2)
+    try:
+        with profiler.profile_query("system table skew"):
+            c.run_job(plan, num_partitions=4, timeout=120)
+    finally:
+        c.stop()
+    t = spark.sql("SELECT query_id, shuffle_skew_ratio, adaptive_decisions "
+                  "FROM system.telemetry.query_profiles").toArrow()
+    ratios = t.column("shuffle_skew_ratio").to_pylist()
+    assert any(r and r > 2.0 for r in ratios)
+
+
+# ---------------------------------------------------------------------------
+# observed-cardinality feedback (stats satellite)
+# ---------------------------------------------------------------------------
+
+def test_observed_rows_feed_estimates(monkeypatch):
+    spark = SparkSession({})
+    df = pd.DataFrame({"a": np.arange(10000),
+                       "x": np.arange(10000) % 500})
+    spark.createDataFrame(df).createOrReplaceTempView("obs_t")
+    plan = _plan_for(spark, "SELECT a FROM obs_t WHERE x = 3")
+    _out, job = _run_once(plan, nparts=2)
+    # the leaf stage (Filter/Project over the scan) recorded its actual
+    # output rows, keyed so the SESSION plan's subtree finds them
+    session_plan = _plan_for(spark, "SELECT a FROM obs_t WHERE x = 3")
+    sub = session_plan
+    from sail_tpu.plan import nodes as pn
+    while not isinstance(sub, (pn.FilterExec, pn.ProjectExec,
+                               pn.ScanExec)):
+        sub = sub.input
+    obs = jr.observed_rows(sub)
+    exp_rows = float((df.x == 3).sum())
+    assert obs == exp_rows, (obs, exp_rows)
+    # the static model would have guessed selectivity; observed wins
+    assert jr._est_rows(sub) == exp_rows
+    from sail_tpu.exec.local import _rtf_est_rows
+    assert _rtf_est_rows(sub) == exp_rows
+    # and the knob turns it off
+    monkeypatch.setenv("SAIL_ADAPTIVE__STATS_FEEDBACK", "0")
+    assert jr.observed_rows(sub) is None
+
+
+# ---------------------------------------------------------------------------
+# chaos: AQE decisions deterministic per fault seed, results identical
+# ---------------------------------------------------------------------------
+
+def _decision_log(job):
+    return (job.adaptive.counts(), job.adaptive.events)
+
+
+def test_chaos_aqe_worker_crash_deterministic(monkeypatch):
+    """Worker crash mid-stage with adaptive on: the fault-recovery
+    re-runs produce bit-identical stats, so the decision log matches
+    the clean adaptive run and results match the fault-free AQE-off
+    run."""
+    _skew_knobs(monkeypatch)
+    spark, _fact, _dim = _skew_spark()
+    plan = _plan_for(spark, _SKEW_SQL)
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    off, _ = _run_once(plan)
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "1")
+    clean, clean_job = _run_once(plan)
+    assert clean_job.adaptive.split >= 1
+    monkeypatch.setenv("SAIL_CLUSTER__WORKER_HEARTBEAT_TIMEOUT_SECS", "2")
+    faults.configure("worker.task_exec:worker-1*=crash#1", seed=31)
+    faulted, job = _run_once(plan)
+    assert faults.injection_counts().get("worker.task_exec") == 1
+    assert _decision_log(job) == _decision_log(clean_job)
+    assert _canon(faulted).equals(_canon(clean))
+    assert _canon(faulted).equals(_canon(off))
+
+
+def test_chaos_aqe_fetch_drop_deterministic(monkeypatch):
+    _skew_knobs(monkeypatch)
+    spark, _fact, _dim = _skew_spark()
+    plan = _plan_for(spark, _SKEW_SQL)
+    clean, clean_job = _run_once(plan)
+    faults.configure("shuffle.fetch:*c[0-9]*=error(not_found)#1", seed=32)
+    faulted, job = _run_once(plan)
+    assert faults.injection_counts().get("shuffle.fetch") == 1
+    assert job.retry_count >= 1
+    assert _decision_log(job) == _decision_log(clean_job)
+    assert _canon(faulted).equals(_canon(clean))
+
+
+def test_chaos_replanned_stage_races_speculative_twin(monkeypatch):
+    """A straggling producer task gets a speculative twin while its
+    consumer has already been REPLANNED (coalesced/split); the twin's
+    win must fence correctly and the replanned consumer's fetch plan
+    must resolve against whichever attempt won."""
+    _skew_knobs(monkeypatch)
+    spark, _fact, _dim = _skew_spark()
+    plan = _plan_for(spark, _SKEW_SQL)
+    clean, clean_job = _run_once(plan)
+    monkeypatch.setenv("SAIL_CLUSTER__SPECULATION__MIN_RUNTIME_MS", "300")
+    faults.configure("worker.task_exec:worker-1*=delay(6)#1", seed=33)
+    t0 = time.perf_counter()
+    faulted, job = _run_once(plan)
+    elapsed = time.perf_counter() - t0
+    assert job.spec_launched >= 1, "no speculative twin launched"
+    assert job.spec_won >= 1, "the twin should have won"
+    assert elapsed < 30.0
+    assert _decision_log(job) == _decision_log(clean_job)
+    assert _canon(faulted).equals(_canon(clean))
+
+
+def test_governor_projection_uses_fetch_plan(monkeypatch):
+    """After a rewrite, the memory governor projects footprints from the
+    explicit fetch pairs instead of the default channel mapping."""
+    _skew_knobs(monkeypatch)
+    spark, _fact, _dim = _skew_spark()
+    plan = _plan_for(spark, _SKEW_SQL)
+    _out, job = _run_once(plan)
+    rewritten = [s for s in job.graph.stages
+                 if any(i.fetch_plan is not None for i in s.inputs)]
+    assert rewritten
+    c = LocalCluster(num_workers=2)
+    try:
+        driver = c.driver
+        for s in rewritten:
+            for p in range(s.num_partitions):
+                proj = driver._projected_task_bytes(job, s.stage_id, p)
+                assert proj is not None and proj > 0
+    finally:
+        c.stop()
