@@ -278,6 +278,151 @@ def _run_chaos(spark) -> dict:
     }
 
 
+def _run_streaming_bench(spark) -> dict:
+    """SAIL_BENCH_STREAMING=1: sustained-throughput streaming artifact.
+
+    A stateful aggregate (groupBy sum over a replayable source) streams
+    SAIL_BENCH_STREAMING_EPOCHS micro-batches of _ROWS rows each into a
+    parquet file sink with a durable checkpoint, three ways:
+
+    - clean, incremental keyed state (headline rows/s + epoch-commit
+      latency p50/p99);
+    - clean, legacy whole-buffer re-aggregation (the incremental-state
+      A/B: same results, `state_speedup` = buffer wall / store wall);
+    - chaos on (seeded streaming.sink/checkpoint/source injections):
+      every failure kills the query, which restarts from the
+      checkpoint — recovery overhead plus a final-output equivalence
+      check against the clean run ride the artifact.
+    """
+    import glob
+    import shutil
+    import statistics
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from sail_tpu import faults
+    from sail_tpu.session import DataFrame
+    from sail_tpu.streaming import (ReplayableMemorySource,
+                                    StreamingQueryException, _StreamRead)
+
+    epochs = int(os.environ.get("SAIL_BENCH_STREAMING_EPOCHS", "30"))
+    rows = int(os.environ.get("SAIL_BENCH_STREAMING_ROWS", "20000"))
+    seed = int(os.environ.get("SAIL_BENCH_STREAMING_SEED", "1234"))
+    rng = np.random.default_rng(7)
+    batches = [pa.table({
+        "k": pa.array(rng.integers(0, 64, rows), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, rows), type=pa.int64()),
+    }) for _ in range(epochs)]
+    schema = batches[0].schema
+    tmp_roots = []
+
+    def run(tag: str, incremental: bool, spec=None) -> dict:
+        out_dir = tempfile.mkdtemp(prefix=f"sail_sbench_{tag}_out_")
+        ckpt = tempfile.mkdtemp(prefix=f"sail_sbench_{tag}_cp_")
+        tmp_roots.extend((out_dir, ckpt))
+        prev_inc = os.environ.get("SAIL_STREAMING__INCREMENTAL_STATE")
+        os.environ["SAIL_STREAMING__INCREMENTAL_STATE"] = \
+            "1" if incremental else "0"
+        if spec:
+            faults.configure(spec)
+        restarts = 0
+        commit_ms = []
+        seen_batches = set()
+
+        def start_query(fed_batches):
+            src = ReplayableMemorySource(schema)
+            for b in fed_batches:
+                src.add(b)
+            df = DataFrame(_StreamRead("sbench", src), spark)
+            return src, (df.groupBy("k").sum("v").writeStream
+                         .outputMode("complete").format("parquet")
+                         .option("checkpointLocation", ckpt)
+                         .start(out_dir))
+
+        t0 = time.perf_counter()
+        src, q = start_query(())
+        try:
+            fed = 0
+            while True:
+                try:
+                    q.processAllAvailable()
+                except StreamingQueryException:
+                    restarts += 1
+                    src, q = start_query(batches[:fed])
+                    continue
+                for entry in q.recent_progress:
+                    if entry.get("status") == "committed" and \
+                            entry["batchId"] not in seen_batches:
+                        seen_batches.add(entry["batchId"])
+                        commit_ms.append(entry["commitMs"])
+                if fed >= epochs:
+                    break
+                src.add(batches[fed])
+                fed += 1
+            wall = time.perf_counter() - t0
+            injected = dict(faults.injection_counts()) if spec else {}
+        finally:
+            q.stop()
+            if spec:
+                faults.reset()
+            if prev_inc is None:
+                os.environ.pop("SAIL_STREAMING__INCREMENTAL_STATE", None)
+            else:
+                os.environ["SAIL_STREAMING__INCREMENTAL_STATE"] = prev_inc
+        parts = sorted(glob.glob(os.path.join(out_dir, "part-*.parquet")))
+        final = pq.read_table(parts[-1]).sort_by("k") if parts else None
+        qs = statistics.quantiles(commit_ms, n=100) if \
+            len(commit_ms) >= 2 else [commit_ms[0] if commit_ms else 0] * 99
+        return {
+            "wall_s": round(wall, 4),
+            "rows_per_s": round(epochs * rows / wall, 1),
+            "commit_p50_ms": round(qs[49], 3),
+            "commit_p99_ms": round(qs[98], 3),
+            "restarts": restarts,
+            "parts": len(parts),
+            "state_mode": q._state_mode,
+            "_final": final,
+            "_injected": injected,
+        }
+
+    try:
+        store = run("store", incremental=True)
+        buffer = run("buffer", incremental=False)
+        chaos = run("chaos", incremental=True, spec=(
+            f"seed={seed};streaming.sink=error@0.05#2;"
+            f"streaming.checkpoint=error@0.04#2;"
+            f"streaming.source=delay(0.02)@0.1"))
+        injected = dict(chaos.pop("_injected", {}))
+        out = {
+            "epochs": epochs,
+            "rows_per_epoch": rows,
+            "seed": seed,
+            "incremental": {k: v for k, v in store.items()
+                            if not k.startswith("_")},
+            "whole_buffer": {k: v for k, v in buffer.items()
+                             if not k.startswith("_")},
+            "chaos": {k: v for k, v in chaos.items()
+                      if not k.startswith("_")},
+            "state_speedup": round(buffer["wall_s"] / store["wall_s"], 3)
+            if store["wall_s"] else None,
+            "recovery_overhead": round(chaos["wall_s"] / store["wall_s"],
+                                       3) if store["wall_s"] else None,
+            "identical_store_vs_buffer": store["_final"] is not None
+            and store["_final"].equals(buffer["_final"]),
+            "identical_chaos_vs_clean": store["_final"] is not None
+            and chaos["_final"] is not None
+            and store["_final"].equals(chaos["_final"]),
+        }
+        if injected:
+            out["injected"] = injected
+        return out
+    finally:
+        for root in tmp_roots:
+            shutil.rmtree(root, ignore_errors=True)
+
+
 def _run_shuffle_bench(spark) -> dict:
     """Cluster-path shuffle artifact: the join/agg-heavy queries where
     data movement dominates (q5/q18/q21) run through the local cluster,
@@ -648,6 +793,14 @@ def main():
             result["skew_bench"] = _run_skew_bench(spark)
         except Exception as e:  # noqa: BLE001
             result["skew_bench_error"] = f"{type(e).__name__}: {e}"
+    # streaming sustained-throughput artifact: stateful aggregate into a
+    # file sink, incremental-state A/B + seeded-chaos restart recovery
+    if os.environ.get("SAIL_BENCH_STREAMING", "0").strip().lower() in (
+            "1", "true", "yes"):
+        try:
+            result["streaming"] = _run_streaming_bench(spark)
+        except Exception as e:  # noqa: BLE001
+            result["streaming_error"] = f"{type(e).__name__}: {e}"
     # chaos mode: TPC-H under a fixed fault seed, recovery overhead in
     # the artifact (opt-in: the run costs two extra cluster executions)
     if os.environ.get("SAIL_BENCH_CHAOS", "0").strip().lower() in (

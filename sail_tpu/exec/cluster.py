@@ -21,6 +21,7 @@ SURVEY.md §2.5/§3.3). Shape:
 
 from __future__ import annotations
 
+import itertools
 import os
 import random
 import statistics
@@ -147,25 +148,32 @@ class _StreamStore:
                 "cluster.shuffle_memory_cap_mb", 256)) << 20
         self._cap = memory_cap_bytes
         self._mem_bytes = 0
-        self._streams: Dict[Tuple[str, int, int], Dict[int, object]] = {}
+        # epoch-tagged channels: streaming triggers publish each epoch's
+        # output under its own key, so a crashed trigger's stale streams
+        # can never satisfy the replay's fetches (epoch 0 = plain batch)
+        self._streams: Dict[Tuple[str, int, int, int],
+                            Dict[int, object]] = {}
         self._lock = threading.Lock()
         self._spill_dir: Optional[str] = None
         self.spill_count = 0
+        self.epochs = sh.EpochLedger()
 
     def _spill_path(self, job_id: str, stage: int, partition: int,
-                    channel: int) -> str:
+                    channel: int, epoch: int) -> str:
         import tempfile
         if self._spill_dir is None:
             self._spill_dir = tempfile.mkdtemp(prefix="sail_shuffle_")
         return os.path.join(
-            self._spill_dir, f"{job_id}_{stage}_{partition}_{channel}.ipc")
+            self._spill_dir,
+            f"{job_id}_e{epoch}_{stage}_{partition}_{channel}.ipc")
 
     def put(self, job_id: str, stage: int, partition: int,
-            channels: Dict[int, bytes]):
+            channels: Dict[int, bytes], epoch: int = 0):
         with self._lock:
             # a task retry can overwrite a previous attempt's entry:
             # release its memory/disk accounting first
-            prev = self._streams.pop((job_id, stage, partition), None)
+            prev = self._streams.pop((job_id, epoch, stage, partition),
+                                     None)
             if prev is not None:
                 for entry in prev.values():
                     if isinstance(entry, tuple):
@@ -178,7 +186,8 @@ class _StreamStore:
             stored: Dict[int, object] = {}
             for c, buf in channels.items():
                 if self._mem_bytes + len(buf) > self._cap:
-                    path = self._spill_path(job_id, stage, partition, c)
+                    path = self._spill_path(job_id, stage, partition, c,
+                                            epoch)
                     with open(path, "wb") as f:
                         f.write(buf)
                     stored[c] = ("disk", path)
@@ -193,17 +202,25 @@ class _StreamStore:
                 else:
                     self._mem_bytes += len(buf)
                     stored[c] = buf
-            self._streams[(job_id, stage, partition)] = stored
+            self._streams[(job_id, epoch, stage, partition)] = stored
+        # the seal commits OUTSIDE the entry mutation but before any
+        # success report can race a consumer here: publish-then-seal is
+        # the producer half of the epoch barrier
+        self.epochs.seal(job_id, epoch, stage, partition)
 
     def open_chunks(self, job_id: str, stage: int, partition: int,
-                    channel: int):
+                    channel: int, epoch: int = 0):
         """Serve a channel as an iterator of bounded byte chunks: memory
         entries slice, spilled entries stream from disk WITHOUT
         rehydrating the whole file under the memory cap. None = channel
         not found (including a raced clean_job unlink — the fetch side's
-        NOT_FOUND producer-re-run path owns that case)."""
+        NOT_FOUND producer-re-run path owns that case — and any request
+        whose epoch the producer has not SEALED: barrier alignment is
+        enforced at the data plane, not just by scheduling order)."""
+        if not self.epochs.is_sealed(job_id, epoch, stage, partition):
+            return None
         with self._lock:
-            chans = self._streams.get((job_id, stage, partition))
+            chans = self._streams.get((job_id, epoch, stage, partition))
             entry = None if chans is None else chans.get(channel)
         if entry is None:
             return None
@@ -215,22 +232,26 @@ class _StreamStore:
             return sh.iter_file_chunks(f)
         return sh.iter_buffer_chunks(entry)
 
-    def open_all_chunks(self, job_id: str, stage: int, partition: int):
+    def open_all_chunks(self, job_id: str, stage: int, partition: int,
+                        epoch: int = 0):
         """Serve EVERY channel of one task's output as one chunk
         sequence — the channels' complete IPC streams back to back in
         channel order (the fetch side's decoder re-opens at each
         stream boundary). One round trip replaces num_channels fetches
         for consumers that need the whole output of a shuffle-writing
         producer (adaptive broadcast conversion)."""
+        if not self.epochs.is_sealed(job_id, epoch, stage, partition):
+            return None
         with self._lock:
-            chans = self._streams.get((job_id, stage, partition))
+            chans = self._streams.get((job_id, epoch, stage, partition))
             channels = None if chans is None else sorted(chans)
         if channels is None:
             return None
 
         def gen():
             for c in channels:
-                chunks = self.open_chunks(job_id, stage, partition, c)
+                chunks = self.open_chunks(job_id, stage, partition, c,
+                                          epoch)
                 if chunks is None:
                     # raced clean_job mid-serve: abort rather than ship
                     # a silently truncated concatenation — the fetch
@@ -244,17 +265,24 @@ class _StreamStore:
         return gen()
 
     def get(self, job_id: str, stage: int, partition: int,
-            channel: int) -> Optional[bytes]:
+            channel: int, epoch: int = 0) -> Optional[bytes]:
         """Whole-channel bytes (tests/tools); the serve path streams
         through :meth:`open_chunks` instead."""
-        chunks = self.open_chunks(job_id, stage, partition, channel)
+        chunks = self.open_chunks(job_id, stage, partition, channel,
+                                  epoch)
         if chunks is None:
             return None
         return b"".join(chunks)
 
     def clean_job(self, job_id: str):
+        """Wipe a job's channels across every epoch. A streaming query
+        keeps one stable job id across triggers but each trigger's
+        ``run_job`` cleans up in its finally, so there is never more
+        than one live epoch to wipe — stale epochs of a crashed trigger
+        are inert anyway (unsealed or seal moved on)."""
         with self._lock:
-            for key in [k for k in self._streams if k[0] == job_id]:
+            for key in [k for k in self._streams
+                        if k[0] == job_id]:
                 for entry in self._streams[key].values():
                     if isinstance(entry, tuple):
                         try:
@@ -264,6 +292,7 @@ class _StreamStore:
                     else:
                         self._mem_bytes -= len(entry)
                 del self._streams[key]
+        self.epochs.unseal(job_id)
 
 
 def _task_metrics_enabled() -> bool:
@@ -298,20 +327,24 @@ def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
             # adaptive all-channels fetch: every channel of the task's
             # output as back-to-back IPC streams in one round trip
             chunks = store.open_all_chunks(request.job_id, request.stage,
-                                           request.partition)
+                                           request.partition,
+                                           epoch=request.epoch)
             if chunks is None:
                 context.abort(
                     grpc.StatusCode.NOT_FOUND,
                     f"no streams for job={request.job_id} "
+                    f"epoch={request.epoch} "
                     f"stage={request.stage} "
                     f"partition={request.partition}")
         else:
             chunks = store.open_chunks(request.job_id, request.stage,
-                                       request.partition, request.channel)
+                                       request.partition, request.channel,
+                                       epoch=request.epoch)
             if chunks is None:
                 context.abort(
                     grpc.StatusCode.NOT_FOUND,
                     f"no stream for job={request.job_id} "
+                    f"epoch={request.epoch} "
                     f"stage={request.stage} "
                     f"partition={request.partition} "
                     f"channel={request.channel}")
@@ -589,7 +622,8 @@ class WorkerActor(Actor):
             try:
                 return _fetch_table(addr, pb.FetchStreamRequest(
                     job_id=task.job_id, stage=stage_id,
-                    partition=up_part, channel=chan), _WORKER_SERVICE,
+                    partition=up_part, channel=chan,
+                    epoch=task.epoch), _WORKER_SERVICE,
                     stats=stats)
             except faults.WorkerCrash:
                 raise
@@ -692,7 +726,7 @@ class WorkerActor(Actor):
             else:
                 channels = {-1: sh.encode_table(table)}
             self.streams.put(task.job_id, task.stage, task.partition,
-                             channels)
+                             channels, epoch=task.epoch)
             # channel-size metadata rides the success report: the driver's
             # memory governor projects consumer footprints from it
             channel_bytes = [len(channels[c]) for c in sorted(channels)]
@@ -806,12 +840,25 @@ def _resolve_driver_scans(plan, task: pb.TaskDefinition,
 # Driver
 # ---------------------------------------------------------------------------
 
+_JOB_SEQ = itertools.count()
+
+
 class _Job:
     def __init__(self, job_id: str, graph: jg.JobGraph,
-                 trace_ctx=None):
+                 trace_ctx=None, epoch: int = 0):
         self.job_id = job_id
         self.graph = graph
+        # fragment-cache namespace: unique per SUBMISSION, never reused.
+        # job_id+epoch is not enough — a streaming trigger may dispatch
+        # several different job graphs under one (job_id, epoch) (e.g.
+        # the incremental delta plan and the residual plan), and their
+        # stage ids both start at 0
+        self.seq = next(_JOB_SEQ)
         self.trace_ctx = trace_ctx
+        # streaming epoch this job executes (0 for plain batch): stamped
+        # on every task and stream fetch, so a restarted trigger's
+        # replay can only ever address its own epoch's channels
+        self.epoch = int(epoch)
         self.failed: Optional[str] = None
         self.done = threading.Event()
         # per stage: partition → worker addr (set on success)
@@ -1419,7 +1466,7 @@ class DriverActor(Actor):
             job_id=job.job_id, stage=stage_id, partition=partition,
             attempt=attempt, plan=encode_cached(job, stage),
             num_partitions=stage.num_partitions, inputs=inputs,
-            driver_addr=self.addr,
+            driver_addr=self.addr, epoch=job.epoch,
             runtime_filters_json=job.graph.stage_filters.get(stage_id, ""))
         if stage.shuffle_keys is not None and stage.num_channels > 1:
             task.shuffle_write.CopyFrom(pb.ShuffleWriteSpec(
@@ -1854,11 +1901,16 @@ class DriverActor(Actor):
                 pass
 
 
-_FRAGMENT_CACHE: Dict[Tuple[str, int], bytes] = {}
+_FRAGMENT_CACHE: Dict[Tuple[int, int], bytes] = {}
 
 
 def encode_cached(job: _Job, stage: jg.Stage) -> bytes:
-    key = (job.job_id, stage.stage_id)
+    # keyed by the job's unique submission seq: the memo is only valid
+    # WITHIN one submission anyway (each epoch's plan embeds that
+    # epoch's batch slice, and one streaming trigger may dispatch
+    # several different graphs under the same job_id+epoch) — a
+    # job_id-based key served one graph's fragment to another's stages
+    key = (job.seq, stage.stage_id)
     blob = _FRAGMENT_CACHE.get(key)
     if blob is None:
         blob = jg.encode_fragment(stage.plan)
@@ -1915,8 +1967,17 @@ class LocalCluster:
             time.sleep(0.02)
         self.last_job: Optional[_Job] = None
 
-    def run_job(self, plan, num_partitions: Optional[int] = None, timeout=120):
-        """Distribute a plan; returns the result pyarrow Table."""
+    def run_job(self, plan, num_partitions: Optional[int] = None,
+                timeout=120, epoch: int = 0,
+                job_id: Optional[str] = None):
+        """Distribute a plan; returns the result pyarrow Table.
+
+        ``epoch``/``job_id`` serve the streaming runner: a streaming
+        query keeps ONE stable job id across triggers and tags every
+        trigger with its epoch, so its shuffle channels publish and
+        fetch under (job_id, epoch) — barrier-aligned per epoch, with a
+        failed trigger's channels wiped (discarded stage) and a
+        restarted trigger re-running under the SAME epoch id."""
         import pyarrow as pa
         from .local import LocalExecutor
         from .. import profiler
@@ -1933,9 +1994,10 @@ class LocalCluster:
         if graph is None:
             return LocalExecutor().execute(plan)
         with tr.span("cluster:job") as root_span:
-            job = _Job(uuid.uuid4().hex[:12], graph,
+            job = _Job(job_id or uuid.uuid4().hex[:12], graph,
                        trace_ctx=tr.SpanContext(root_span.trace_id,
-                                                root_span.span_id))
+                                                root_span.span_id),
+                       epoch=epoch)
             # joins the session's profile when the job runs inside one;
             # a standalone run_job still gets its own profile record.
             # Execute/fetch phases come from the root-stage executor —
@@ -1977,7 +2039,8 @@ class LocalCluster:
                 stage_id, p, addr = item
                 return _fetch_table(addr, pb.FetchStreamRequest(
                     job_id=job.job_id, stage=stage_id, partition=p,
-                    channel=-1), _WORKER_SERVICE, stats=stats)
+                    channel=-1, epoch=job.epoch), _WORKER_SERVICE,
+                    stats=stats)
 
             parts: Dict[int, Dict[int, object]] = {}
             mp = MultiPrefetcher(work, fetch_one,

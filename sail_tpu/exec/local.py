@@ -2355,6 +2355,12 @@ class LocalExecutor:
     def _compile_join_keys(self, p: pn.JoinExec, left: HostBatch, right: HostBatch,
                            seed: int, rtf_sig=None):
         """Builder for the jitted build+probe phase of an equi-join."""
+        # import OUTSIDE the traced fn: a first import during an active
+        # jit trace would execute the module body inside the trace and
+        # turn its module-level jnp constants (_KEY_MAX) into leaked
+        # tracers, poisoning every later join trace in the process
+        from ..ops import runtime_filter as rtfk
+
         def builder():
             lcomp = self._compiler(left, p.left.schema)
             rcomp = self._compiler(right, p.right.schema)
@@ -2372,7 +2378,6 @@ class LocalExecutor:
                 pairs.append((lc, rc, ktype, luts))
 
             def fn(lcols, lsel, rcols, rsel, *rtf_args):
-                from ..ops import runtime_filter as rtfk
                 lkeys, rkeys = [], []
                 for lc, rc, ktype, luts in pairs:
                     ld, lv = lc.fn(lcols)

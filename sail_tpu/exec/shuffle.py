@@ -90,6 +90,44 @@ def encode_table(table, codec=_SENTINEL_CODEC, record: bool = True) -> bytes:
     return buf
 
 
+class EpochLedger:
+    """Seal bookkeeping for epoch-tagged shuffle channels.
+
+    Streaming triggers run one epoch at a time through the cluster data
+    plane; every producer task publishes its channels under
+    ``(job_id, epoch)`` and *seals* that epoch for its partition in one
+    atomic step. The barrier contract: a consumer may start epoch N only
+    after every producer channel it reads has sealed N — the driver's
+    stage scheduler enforces it in the control plane (locations are
+    recorded only on success reports, which follow the seal), and the
+    store enforces it in the data plane by serving NOTHING for an
+    unsealed (or mismatched) epoch, which the consumer's NOT_FOUND
+    fetch-failed path turns into a producer re-run. A crashed trigger's
+    stale channels are therefore inert: the replay either overwrites
+    them under the same epoch or never addresses them at all."""
+
+    def __init__(self):
+        self._sealed: dict = {}   # (job_id, stage, partition) -> epoch
+        self._lock = threading.Lock()
+
+    def seal(self, job_id: str, epoch: int, stage: int,
+             partition: int) -> None:
+        with self._lock:
+            self._sealed[(job_id, stage, partition)] = int(epoch)
+
+    def is_sealed(self, job_id: str, epoch: int, stage: int,
+                  partition: int) -> bool:
+        with self._lock:
+            return self._sealed.get((job_id, stage, partition)) \
+                == int(epoch)
+
+    def unseal(self, job_id: str) -> None:
+        """Drop every seal a job holds (job cleanup)."""
+        with self._lock:
+            for key in [k for k in self._sealed if k[0] == job_id]:
+                del self._sealed[key]
+
+
 @dataclass
 class FetchStats:
     """Per-task fetch accounting, accumulated across concurrent fetch

@@ -105,6 +105,15 @@ class QueryProfile:
     fusion_stages: int = 0
     fusion_fused_ops: int = 0
     fusion_fallbacks: int = 0
+    # streaming: the epoch this profile's trigger executed, the wall
+    # time of its commit protocol (stage → checkpoint → finalize →
+    # marker), the keyed-state rows retained after it, and whether the
+    # trigger was a marker-skipped replay (-1 epoch = not a streaming
+    # trigger; the block is omitted from to_dict/render then)
+    streaming_epoch: int = -1
+    streaming_commit_ms: float = 0.0
+    streaming_state_rows: int = 0
+    streaming_replayed: bool = False
     rows_out: int = 0
     slow: bool = False
     # operator metric trees (dicts, telemetry.OperatorMetrics.to_dict)
@@ -236,6 +245,15 @@ class QueryProfile:
             self.fusion_fused_ops += int(fused_ops)
             self.fusion_fallbacks += int(fallbacks)
 
+    def note_streaming(self, epoch: int, commit_ms: float = 0.0,
+                       state_rows: int = 0,
+                       replayed: bool = False) -> None:
+        with self._lock:
+            self.streaming_epoch = int(epoch)
+            self.streaming_commit_ms = float(commit_ms)
+            self.streaming_state_rows = int(state_rows)
+            self.streaming_replayed = bool(replayed)
+
     def add_task(self, stage: int, partition: int, worker_id: str,
                  operators: List[dict], rows_out: int = 0) -> None:
         """Merge one distributed task's operator metrics (driver side)."""
@@ -320,6 +338,12 @@ class QueryProfile:
                 "fused_ops": self.fusion_fused_ops,
                 "fallbacks": self.fusion_fallbacks,
             },
+            "streaming": {
+                "epoch": self.streaming_epoch,
+                "commit_ms": round(self.streaming_commit_ms, 3),
+                "state_rows": self.streaming_state_rows,
+                "replayed": self.streaming_replayed,
+            } if self.streaming_epoch >= 0 else None,
             "rows_out": self.rows_out,
             "slow": self.slow,
             "operators": list(self.operators),
@@ -383,6 +407,13 @@ class QueryProfile:
                 extra += f", {self.fusion_fallbacks} fallbacks"
             extra += ")"
             lines.append(f"fused: {self.fusion_stages} stages{extra}")
+        if self.streaming_epoch >= 0:
+            line = (f"streaming: epoch={self.streaming_epoch} "
+                    f"commit={self.streaming_commit_ms:.1f}ms "
+                    f"state_rows={self.streaming_state_rows}")
+            if self.streaming_replayed:
+                line += " (replayed)"
+            lines.append(line)
         if self.validated_passes:
             lines.append(f"validated: {self.validated_passes} passes")
         if self.tasks:

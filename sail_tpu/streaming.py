@@ -1,15 +1,43 @@
-"""Streaming execution (micro-batch).
+"""Streaming execution: epoch-aligned micro-batches with exactly-once
+sinks.
 
 Reference role: the streaming subsystem — rate/socket sources, flow-event
 markers, streaming query lifecycle (SURVEY.md §3.5; sail-common-datafusion
-streaming events, sail-data-source rate format). Design note: the reference
-streams Chandy–Lamport-style markers through a continuous dataflow; this
-engine uses Spark's own micro-batch model instead — each trigger snapshots
-the source offsets, runs a normal (fully jitted) batch query over the new
-slice, and commits. Markers survive as the offset/epoch bookkeeping.
+streaming events, sail-data-source rate format). Design note: the
+reference streams Chandy–Lamport-style markers through a continuous
+dataflow; this engine aligns on EPOCHS instead — each trigger is one
+epoch: the source offsets snapshot delimits it (the marker), the epoch id
+rides every distributed task and shuffle channel of the trigger
+(exec/cluster.py epoch-tagged streams, barrier-aligned at stage
+boundaries), and the sink commits it through a two-phase protocol:
 
-v0 sources: rate (rowsPerSecond), memory-append; sinks: memory (queryable
-as a temp view), console, foreachBatch.
+1. **stage** — batch output is written durably under the epoch id
+   (file sinks: an atomic rename into ``_staging/``);
+2. **pre-commit** — the offsets/state checkpoint records the epoch as
+   pending (state changes ride the same checkpoint as epoch-versioned
+   snapshot/changelog Arrow files, so offsets and state move together);
+3. **finalize** — the staged output renames to its final deterministic
+   name and the commit marker (``commits/<epoch>``, Spark's layout)
+   renames into place.
+
+A crash at ANY point replays into a no-op (marker present), a recovered
+finalize (pending recorded, staged output durable), or a discarded
+stage (nothing recorded: the staging leftovers are wiped and the epoch
+re-runs from the unadvanced offsets) — never a duplicate and never a
+hole. Sinks without durable staging (memory/console/foreachBatch) use
+the single-phase order (finalize before the offsets advance), which is
+exactly-once for idempotent sinks and at-least-once for foreachBatch.
+
+Stateful queries run on an incremental keyed state store
+(streaming_state.py) when the aggregation is mergeable — per-epoch
+partial aggregates fold into hash-keyed running state, the changelog
+rides the checkpoint, and watermark eviction drops whole keys — and
+fall back to whole-buffer re-aggregation otherwise (session windows,
+HAVING, non-mergeable functions), with the buffer's row-eviction
+horizon widened by the session gap so open sessions never lose rows.
+
+v0 sources: rate (rowsPerSecond), memory-append, file, socket; sinks:
+memory (queryable as a temp view), console, foreachBatch, noop, file.
 """
 
 from __future__ import annotations
@@ -21,7 +49,20 @@ from typing import Callable, Dict, List, Optional
 
 import pyarrow as pa
 
+from . import faults
+from .metrics import record as _record_metric
 from .spec import plan as sp
+
+
+class StreamingQueryException(RuntimeError):
+    """A streaming query terminated with an error (Spark's
+    StreamingQueryException): raised from ``awaitTermination`` /
+    ``processAllAvailable`` instead of masquerading as a graceful
+    termination."""
+
+    def __init__(self, message: str, cause: Optional[Exception] = None):
+        super().__init__(message)
+        self.cause = cause
 
 
 class StreamSource:
@@ -77,7 +118,10 @@ class RateSource(StreamSource):
 
 
 class MemoryStreamSource(StreamSource):
-    """Programmatic append source (for tests / foreachBatch pipelines)."""
+    """Programmatic append source (for tests / foreachBatch pipelines).
+    NOT replayable across restarts: consumed rows are dropped, and a
+    fresh instance knows nothing about a previous instance's offsets
+    (``seek`` is a no-op, mirroring the socket source)."""
 
     def __init__(self, schema: pa.Schema):
         self._schema = schema
@@ -98,6 +142,46 @@ class MemoryStreamSource(StreamSource):
                 return None
             out = pa.concat_tables(self._pending)
             self._pending.clear()
+            return out
+
+
+class ReplayableMemorySource(StreamSource):
+    """Programmatic append source with DURABLE offsets: every appended
+    table is retained and ``offset`` is the consumed row count, so a
+    checkpoint restore re-reads exactly the rows a crashed trigger
+    consumed — the source half of the exactly-once restart contract
+    (the recovery test matrix drives crashes through this)."""
+
+    def __init__(self, schema: pa.Schema):
+        self._schema = schema
+        self._tables: List[pa.Table] = []
+        self._consumed = 0     # rows handed out by next_batch
+        self._lock = threading.Lock()
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def add(self, table: pa.Table):
+        with self._lock:
+            self._tables.append(table)
+
+    def offset(self):
+        return self._consumed
+
+    def seek(self, offset):
+        self._consumed = int(offset or 0)
+
+    def next_batch(self) -> Optional[pa.Table]:
+        with self._lock:
+            if not self._tables:
+                return None
+            total = pa.concat_tables(self._tables,
+                                     promote_options="permissive")
+            if total.num_rows <= self._consumed:
+                return None
+            out = total.slice(self._consumed)
+            self._consumed = total.num_rows
             return out
 
 
@@ -135,7 +219,6 @@ class FileStreamSource(StreamSource):
         self._seen = set(offset or [])
 
     def next_batch(self) -> Optional[pa.Table]:
-        import os as _os
         from .io.formats import expand_paths, read_table
         files = [f for f in expand_paths((self._path,))
                  if f not in self._seen]
@@ -243,47 +326,317 @@ class SocketStreamSource(StreamSource):
             self._lines.clear()
 
 
+# ---------------------------------------------------------------------------
+# Sinks: two-phase epoch commit (stage → finalize)
+# ---------------------------------------------------------------------------
+
+class Sink:
+    """A streaming sink with per-epoch two-phase output.
+
+    ``stage(epoch, table)`` makes the epoch's output ready without any
+    externally visible effect; ``commit(epoch)`` finalizes it
+    idempotently (replaying a committed epoch must be a no-op or an
+    overwrite, never an append). ``durable`` declares whether staged
+    output survives a process restart — only durable sinks participate
+    in the two-phase checkpoint ordering (pre-commit record before
+    finalize); the rest finalize before the offsets advance."""
+
+    durable = False
+
+    def stage(self, epoch: int, table: pa.Table) -> None:
+        raise NotImplementedError
+
+    def commit(self, epoch: int) -> None:
+        raise NotImplementedError
+
+    def abort(self, epoch: int) -> None:
+        """Drop staged output for an epoch that will re-run."""
+
+    def recover(self, epoch: int, rows: int) -> bool:
+        """Re-finalize a pre-committed epoch after a restart. True when
+        the epoch's output is (now) durable at its final location."""
+        return rows == 0
+
+    def discard_stale(self) -> int:
+        """Wipe staging leftovers of crashed epochs; returns the count
+        of discarded artifacts."""
+        return 0
+
+    def close(self) -> None:
+        pass
+
+
+class CallableSink(Sink):
+    """Adapter for legacy ``fn(batch_id, table)`` sink callables."""
+
+    def __init__(self, fn: Callable[[int, pa.Table], None]):
+        self._fn = fn
+        self._staged: Dict[int, pa.Table] = {}
+
+    def stage(self, epoch, table):
+        self._staged[epoch] = table
+
+    def commit(self, epoch):
+        table = self._staged.pop(epoch, None)
+        if table is not None:
+            self._fn(epoch, table)
+
+    def abort(self, epoch):
+        self._staged.pop(epoch, None)
+
+
+class NoopSink(Sink):
+    def stage(self, epoch, table):
+        pass
+
+    def commit(self, epoch):
+        pass
+
+
+class ConsoleSink(Sink):
+    def __init__(self):
+        self._staged: Dict[int, pa.Table] = {}
+
+    def stage(self, epoch, table):
+        self._staged[epoch] = table
+
+    def commit(self, epoch):
+        table = self._staged.pop(epoch, None)
+        if table is None:
+            return
+        print(f"-------- Batch {epoch} --------")
+        print(table.to_pandas().to_string(index=False))
+
+    def abort(self, epoch):
+        self._staged.pop(epoch, None)
+
+
+class MemorySink(Sink):
+    """Accumulating in-memory sink published as a temp view. Committed
+    output is KEYED BY EPOCH, so a replayed epoch overwrites its own
+    slice instead of appending a duplicate. Exactly-once within a
+    process lifetime; the view restarts empty with the process."""
+
+    def __init__(self, session, name: str):
+        self._session = session
+        self._name = name
+        self._staged: Dict[int, pa.Table] = {}
+        self._epochs: Dict[int, pa.Table] = {}
+
+    def stage(self, epoch, table):
+        self._staged[epoch] = table
+
+    def commit(self, epoch):
+        table = self._staged.pop(epoch, None)
+        if table is None:
+            return
+        self._epochs[epoch] = table  # idempotent per-epoch slot
+        merged = pa.concat_tables(
+            [self._epochs[e] for e in sorted(self._epochs)],
+            promote_options="permissive")
+        self._session.createDataFrame(merged) \
+            .createOrReplaceTempView(self._name)
+
+    def abort(self, epoch):
+        self._staged.pop(epoch, None)
+
+
+class ForeachBatchSink(Sink):
+    """User callback sink. The callback runs at COMMIT, after staging,
+    so a failure inside it aborts the epoch cleanly — but the callback
+    itself cannot be made idempotent by the engine: delivery is
+    at-least-once across restarts (document says so too)."""
+
+    def __init__(self, session, fn: Callable):
+        self._session = session
+        self._fn = fn
+        self._staged: Dict[int, pa.Table] = {}
+
+    def stage(self, epoch, table):
+        self._staged[epoch] = table
+
+    def commit(self, epoch):
+        table = self._staged.pop(epoch, None)
+        if table is not None:
+            self._fn(_as_df(self._session, table), epoch)
+
+    def abort(self, epoch):
+        self._staged.pop(epoch, None)
+
+
+class FileSink(Sink):
+    """One part file per epoch with durable staging.
+
+    ``stage`` writes the epoch's rows to
+    ``<out>/_staging/part-<epoch>.<ext>`` via tmp + atomic rename;
+    ``commit`` renames it to its deterministic final name. Both renames
+    are idempotent: a replay after a crash between them overwrites /
+    observes the same final file, so output is exactly-once across
+    restarts. Empty epochs write nothing (``recover`` treats them as
+    trivially durable via the checkpoint's recorded row count)."""
+
+    durable = True
+
+    def __init__(self, fmt: str, out_dir: str):
+        self._fmt = fmt
+        self._dir = out_dir
+        self._ext = {"parquet": "parquet", "csv": "csv",
+                     "json": "json"}[fmt]
+
+    def _final(self, epoch: int) -> str:
+        import os as _os
+        return _os.path.join(self._dir, f"part-{epoch:05d}.{self._ext}")
+
+    def _staged(self, epoch: int) -> str:
+        import os as _os
+        return _os.path.join(self._dir, "_staging",
+                             f"part-{epoch:05d}.{self._ext}")
+
+    def stage(self, epoch, table):
+        import os as _os
+        import uuid as _uuid
+        if table.num_rows == 0:
+            return
+        staged = self._staged(epoch)
+        _os.makedirs(_os.path.dirname(staged), exist_ok=True)
+        tmp = staged + f".{_uuid.uuid4().hex}.tmp"
+        if self._fmt == "parquet":
+            import pyarrow.parquet as _pq
+            _pq.write_table(table, tmp)
+        elif self._fmt == "csv":
+            import pyarrow.csv as _pacsv
+            _pacsv.write_csv(table, tmp)
+        else:
+            import json as _json
+            with open(tmp, "w") as f:
+                for row in table.to_pylist():
+                    f.write(_json.dumps(row, default=str) + "\n")
+        _os.replace(tmp, staged)  # staging is durable from here on
+
+    def commit(self, epoch):
+        import os as _os
+        staged = self._staged(epoch)
+        if _os.path.exists(staged):
+            _os.makedirs(self._dir, exist_ok=True)
+            _os.replace(staged, self._final(epoch))
+
+    def abort(self, epoch):
+        import os as _os
+        try:
+            _os.unlink(self._staged(epoch))
+        except OSError:
+            pass
+
+    def recover(self, epoch, rows):
+        import os as _os
+        if rows == 0:
+            return True
+        if _os.path.exists(self._staged(epoch)):
+            self.commit(epoch)  # crash was between checkpoint and rename
+            return True
+        # crash between the output rename and the commit marker: the
+        # deterministic final file is already in place
+        return _os.path.exists(self._final(epoch))
+
+    def discard_stale(self) -> int:
+        import os as _os
+        staging = _os.path.join(self._dir, "_staging")
+        count = 0
+        try:
+            names = _os.listdir(staging)
+        except OSError:
+            return 0
+        for name in names:
+            try:
+                _os.unlink(_os.path.join(staging, name))
+                count += 1
+            except OSError:
+                pass
+        return count
+
+
+# ---------------------------------------------------------------------------
+# Streaming query: epoch-at-a-time processing with exactly-once commit
+# ---------------------------------------------------------------------------
+
 class StreamingQuery:
     """A running micro-batch query (reference: streaming query lifecycle,
-    plan_executor.rs handle_execute_streaming_query_command)."""
+    plan_executor.rs handle_execute_streaming_query_command). Each
+    trigger is one EPOCH; see the module docstring for the commit
+    protocol."""
 
     def __init__(self, session, plan: sp.QueryPlan, source_name: str,
-                 source: StreamSource, sink: Callable[[int, pa.Table], None],
-                 interval_s: float = 0.1, query_name: Optional[str] = None,
+                 source: StreamSource, sink, interval_s: float = 0.1,
+                 query_name: Optional[str] = None,
                  output_mode: str = "append",
                  watermark: Optional[tuple] = None,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 cluster=None):
+        from .config import get as config_get
+        from .config import truthy as config_truthy
+
         self.id = uuid.uuid4().hex
         self.name = query_name
         self._session = session
         self._plan = plan
         self._source_name = source_name
         self._source = source
-        self._sink = sink
+        self._sink: Sink = sink if isinstance(sink, Sink) \
+            else CallableSink(sink)
         self._interval = interval_s
         self._stop = threading.Event()
         self._batch_id = 0
         self.exception: Optional[Exception] = None
         self.recent_progress: List[dict] = []
-        # stateful aggregation: buffer rows within the watermark horizon
-        # and re-aggregate per micro-batch (Spark's complete/update modes)
         self._stateful = _has_aggregate(plan)
         self._mode = output_mode
         self._watermark = watermark  # (column, delay_seconds)
         self._watermark_ts: Optional[float] = None
-        self._buffer: Optional[pa.Table] = None
-        self._prev_result: Optional[pa.Table] = None
+        self._max_event_ts: Optional[float] = None
         self._checkpoint_dir = checkpoint_dir
         self._proc_lock = threading.Lock()
+        # optional distributed execution: every trigger runs as one
+        # cluster job under a STABLE job id tagged with the epoch, so
+        # shuffle channels publish/fetch per (job, epoch)
+        self._cluster = cluster
+        self._cluster_job_id = f"sq-{self.id[:12]}"
+        # commit protocol knobs
+        self._two_phase = config_truthy("streaming.two_phase")
+        self._incremental = config_truthy("streaming.incremental_state")
+        self._compact_interval = max(1, _as_int(
+            config_get("streaming.state.compact_interval", 10), 10))
+        self._commit_retention = max(1, _as_int(
+            config_get("streaming.commit_retention_batches", 100), 100))
+        # stateful machinery: decided lazily ("store" | "buffer") or
+        # restored from the checkpoint
+        self._state_mode: Optional[str] = None
+        self._agg_spec = None
+        self._store = None
+        self._buffer: Optional[pa.Table] = None
+        self._prev_result: Optional[pa.Table] = None
+        self._wm_agg_supported: Optional[bool] = None
+        self._state_files: List[str] = []
+        self._state_base: Optional[int] = None
+        # buffer mode widens row eviction by the session gap: a row can
+        # extend a session until the watermark is a full gap past it
+        self._session_gap = 0.0
+        if watermark is not None:
+            from . import streaming_state as ss
+            self._session_gap = ss.session_window_gap_seconds(plan) or 0.0
         # highest batch id the offsets checkpoint has DURABLY recorded —
         # commit-marker retention may only prune below this (a marker
         # for a batch the checkpoint hasn't passed is still replayable)
         self._last_ckpt_batch = 0
+        # epoch whose two-phase pending record durably landed: a failure
+        # after that point must keep the staged output (recovery
+        # finalizes it) instead of discarding the stage
+        self._precommitted_epoch = -1
         if checkpoint_dir:
             self._restore_checkpoint()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    # -- lifecycle -------------------------------------------------------
     @property
     def isActive(self) -> bool:
         return self._thread.is_alive()
@@ -294,57 +647,271 @@ class StreamingQuery:
         close = getattr(self._source, "close", None)
         if close is not None:
             close()
+        self._sink.close()
+
+    def _raise_if_failed(self):
+        if self.exception is not None:
+            raise StreamingQueryException(
+                f"streaming query {self.name or self.id[:8]} failed: "
+                f"{self.exception}", cause=self.exception)
 
     def awaitTermination(self, timeout: Optional[float] = None) -> bool:
         self._thread.join(timeout)
-        return not self._thread.is_alive()
+        terminated = not self._thread.is_alive()
+        if terminated:
+            # a loop-thread failure must not masquerade as a graceful
+            # termination (Spark raises StreamingQueryException here)
+            self._raise_if_failed()
+        return terminated
 
     def processAllAvailable(self):
         """Block until the source has no pending data AND any in-flight
-        trigger finished (test helper)."""
+        trigger finished. Raises StreamingQueryException if the query
+        has failed (including mid-drain)."""
+        self._raise_if_failed()
         while True:
             with self._proc_lock:
-                batch = self._source.next_batch()
-                if batch is None or batch.num_rows == 0:
-                    return
-                self._process(batch)
+                # re-check under the lock: a concurrent trigger may have
+                # failed (or stop() landed) while we waited for it — a
+                # drain must never run another trigger past that point,
+                # or it would commit the failed epoch's id over only the
+                # post-failure remainder of the source (silent loss)
+                if self._stop.is_set():
+                    break
+                try:
+                    faults.inject("streaming.source",
+                                  key=self._source_name)
+                    batch = self._source.next_batch()
+                    if batch is None or batch.num_rows == 0:
+                        return
+                    self._process(batch)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    self._fail(e)
+            self._raise_if_failed()
+        self._raise_if_failed()
+
+    def _fail(self, e: Exception):
+        self.exception = e
+        self._stop.set()
+        if self._precommitted_epoch != self._batch_id:
+            # discarded stage: drop the failed epoch's staged output.
+            # NEVER for a pre-committed epoch — its pending record means
+            # restart recovery must FINALIZE the staged output, not
+            # re-run (the offsets already advanced past it).
+            try:
+                self._sink.abort(self._batch_id)
+            except Exception:  # noqa: BLE001 — never mask the original
+                pass
+        _record_metric("streaming.epoch.aborted_count", 1)
+        self.recent_progress.append({
+            "batchId": self._batch_id, "epoch": self._batch_id,
+            "status": "failed", "error": f"{type(e).__name__}: {e}"})
+        del self.recent_progress[:-32]
 
     def _loop(self):
         while not self._stop.wait(self._interval):
-            try:
-                with self._proc_lock:
+            with self._proc_lock:
+                # a processAllAvailable trigger may have failed (or
+                # stop() landed) while this thread waited on the
+                # lock — never start a trigger past that point
+                if self._stop.is_set():
+                    return
+                # _fail must run INSIDE the lock: releasing it first
+                # would let a parked trigger thread observe _stop unset
+                # and run the next trigger over the failed epoch's id
+                try:
+                    faults.inject("streaming.source",
+                                  key=self._source_name)
                     batch = self._source.next_batch()
                     if batch is not None and batch.num_rows:
                         self._process(batch)
-            except Exception as e:  # noqa: BLE001 — surfaced via .exception
-                self.exception = e
-                return
+                except Exception as e:  # noqa: BLE001 — awaitTermination
+                    self._fail(e)
+                    return
 
+    # -- epoch processing ------------------------------------------------
     def _process(self, batch: pa.Table):
+        from . import profiler
+        epoch = self._batch_id
         t0 = time.time()
-        if self._stateful:
-            result = self._process_stateful(batch)
-        else:
-            bound = _substitute_source(self._plan, self._source_name,
-                                       sp.LocalRelation(batch))
-            result = self._session._execute_query(bound)
-        if result is not None and not self._already_committed(
-                self._batch_id):
-            self._sink(self._batch_id, result)
-            self._mark_committed(self._batch_id)
-        if self._checkpoint_dir:
-            self._write_checkpoint()
+        label = self.name or self.id[:8]
+        with profiler.profile_query(
+                f"streaming[{label}] epoch {epoch}",
+                session=getattr(self._session, "_session_id", "")) as prof:
+            result = self._run_epoch(batch, epoch)
+            commit_t0 = time.time()
+            replayed = self._already_committed(epoch)
+            if replayed:
+                # the marker proves this epoch's output is final: the
+                # replay is a sink no-op, but state/offsets still advance
+                _record_metric("streaming.epoch.replayed_count", 1)
+                if self._checkpoint_dir:
+                    self._write_checkpoint()
+            else:
+                rows = int(result.num_rows) if result is not None else 0
+                if result is not None:
+                    faults.inject("streaming.sink", key=f"stage:e{epoch}")
+                    self._sink.stage(epoch, result)
+                if self._two_phase and self._sink.durable \
+                        and self._checkpoint_dir:
+                    # two-phase: the checkpoint records the epoch as
+                    # pre-committed BEFORE the finalize, so a crash in
+                    # between recovers by re-finalizing, never re-running
+                    self._write_checkpoint(
+                        pending={"epoch": epoch, "rows": rows})
+                    self._precommitted_epoch = epoch
+                    self._finalize_epoch(epoch)
+                else:
+                    self._finalize_epoch(epoch)
+                    if self._checkpoint_dir:
+                        self._write_checkpoint()
+            commit_ms = (time.time() - commit_t0) * 1000.0
+            _record_metric("streaming.epoch.commit_time",
+                           commit_ms / 1000.0)
+            state_rows = len(self._store.rows) \
+                if self._store is not None else \
+                (self._buffer.num_rows if self._buffer is not None else 0)
+            prof.note_streaming(epoch=epoch, commit_ms=commit_ms,
+                                state_rows=state_rows, replayed=replayed)
         self.recent_progress.append({
-            "batchId": self._batch_id,
+            "batchId": epoch,
+            "epoch": epoch,
             "numInputRows": batch.num_rows,
             "durationMs": int((time.time() - t0) * 1000),
+            "commitMs": round(commit_ms, 3),
             "watermark": self._watermark_ts,
+            "stateRows": state_rows,
+            "status": "replayed" if replayed else "committed",
         })
         del self.recent_progress[:-32]
         self._batch_id += 1
 
-    # -- stateful micro-batch aggregation -------------------------------
-    def _process_stateful(self, batch: pa.Table) -> Optional[pa.Table]:
+    def _finalize_epoch(self, epoch: int):
+        faults.inject("streaming.sink", key=f"commit:e{epoch}")
+        self._sink.commit(epoch)
+        self._mark_committed(epoch)
+        _record_metric("streaming.epoch.committed_count", 1)
+
+    def _run_epoch(self, batch: pa.Table, epoch: int):
+        if self._stateful:
+            return self._process_stateful(batch, epoch)
+        bound = _substitute_source(self._plan, self._source_name,
+                                   sp.LocalRelation(batch))
+        return self._execute_plan(bound, epoch)
+
+    def _execute_plan(self, bound: sp.QueryPlan, epoch: int):
+        if self._cluster is not None:
+            node = self._session._resolve(bound)
+            return self._cluster.run_job(node, epoch=epoch,
+                                         job_id=self._cluster_job_id)
+        return self._session._execute_query(bound)
+
+    # -- stateful processing --------------------------------------------
+    def _process_stateful(self, batch: pa.Table,
+                          epoch: int) -> Optional[pa.Table]:
+        if self._state_mode is None:
+            self._choose_state_mode()
+        if self._state_mode == "store":
+            return self._process_incremental(batch, epoch)
+        return self._process_buffer(batch, epoch)
+
+    def _choose_state_mode(self):
+        from . import streaming_state as ss
+        spec = ss.analyze_plan(
+            self._plan,
+            changed_keys_only=self._mode in ("update", "append")) \
+            if self._incremental else None
+        self._agg_spec = spec
+        if spec is not None:
+            self._state_mode = "store"
+            self._store = ss.KeyedStateStore(spec.merge_kinds)
+        else:
+            self._state_mode = "buffer"
+
+    def _delta_plan(self, batch: pa.Table):
+        """The per-epoch partial-aggregate plan: the plan's single
+        Aggregate over just the new slice, plus (when a watermark is
+        configured) a hidden max(event_time) aggregate feeding the
+        store's per-key eviction high-water mark."""
+        import dataclasses as dc
+        from . import streaming_state as ss
+        from .spec import expression as ex
+        agg = self._agg_spec.agg
+        below = _substitute_source(agg.input, self._source_name,
+                                   sp.LocalRelation(batch))
+        delta_agg = dc.replace(agg, input=below)
+        if self._watermark is None or self._wm_agg_supported is False:
+            return delta_agg, False
+        wcol = self._watermark[0]
+        wm_expr = ex.Alias(
+            ex.Function("max", (ex.Attribute((wcol,)),)),
+            (ss.WM_COLUMN,))
+        return dc.replace(delta_agg,
+                          aggregate=delta_agg.aggregate + (wm_expr,)), True
+
+    def _process_incremental(self, batch: pa.Table,
+                             epoch: int) -> Optional[pa.Table]:
+        from . import streaming_state as ss
+        delta_plan, with_wm = self._delta_plan(batch)
+        if with_wm and self._wm_agg_supported is None:
+            # first epoch: the watermark column may be projected away
+            # below the aggregate — probe by RESOLVING only (local,
+            # deterministic), so a transient execution fault can't
+            # masquerade as "unsupported" and silently disable eviction
+            # for the query's whole lifetime
+            try:
+                self._session._resolve(delta_plan)
+                self._wm_agg_supported = True
+            except Exception:  # noqa: BLE001 — bind failure: no eviction
+                self._wm_agg_supported = False
+                delta_plan, _ = self._delta_plan(batch)
+        delta = self._execute_plan(delta_plan, epoch)
+        changed = self._store.merge_delta(delta)
+        if self._watermark is not None:
+            self._advance_watermark(batch)
+            if self._watermark_ts is not None:
+                evicted = self._store.evict(self._watermark_ts)
+                if evicted:
+                    _record_metric("streaming.state.evicted_count",
+                                   evicted)
+        _record_metric("streaming.state.rows", len(self._store.rows))
+        if self._mode in ("update", "append"):
+            # changed keys only — matching the buffer path's row diff
+            # (re-emitting the full accumulated state every trigger
+            # would duplicate previously delivered rows in the sink)
+            emit = self._store.to_table(keys=dict.fromkeys(changed))
+        else:
+            emit = self._store.to_table()
+        if self._checkpoint_dir is None:
+            # nothing will ever consume the changelog: drop the dirty
+            # sets now or _deleted retains every evicted key's row (and
+            # _changed every key ever touched) for the query's lifetime
+            self._store.clear_dirty()
+        bound = ss.substitute_node(self._plan, self._agg_spec.agg,
+                                   sp.LocalRelation(emit))
+        result = self._execute_plan(bound, epoch)
+        self._prev_result = result
+        return result
+
+    def _advance_watermark(self, batch: pa.Table):
+        """Monotonic event-time watermark from the raw input batch."""
+        import pyarrow.compute as pc
+        col, delay_s = self._watermark
+        if col not in batch.column_names:
+            return
+        mx = pc.max(batch.column(col)).as_py()
+        if mx is None:
+            return
+        ts = _event_seconds(mx)
+        self._max_event_ts = ts if self._max_event_ts is None \
+            else max(self._max_event_ts, ts)
+        self._watermark_ts = self._max_event_ts - delay_s
+
+    def _process_buffer(self, batch: pa.Table,
+                        epoch: int) -> Optional[pa.Table]:
+        """Whole-buffer fallback (session windows, HAVING, non-mergeable
+        aggregates): retain rows within the watermark horizon and
+        re-aggregate per micro-batch."""
         self._buffer = batch if self._buffer is None else pa.concat_tables(
             [self._buffer, batch], promote_options="permissive")
         if self._watermark is not None:
@@ -353,16 +920,28 @@ class StreamingQuery:
                 import pyarrow.compute as pc
                 mx = pc.max(self._buffer.column(col)).as_py()
                 if mx is not None:
-                    ts = mx.timestamp() if hasattr(mx, "timestamp")                         else float(mx)
+                    ts = _event_seconds(mx)
+                    self._max_event_ts = ts if self._max_event_ts is None \
+                        else max(self._max_event_ts, ts)
                     self._watermark_ts = ts - delay_s
-                    # evict rows the watermark has passed (bounded state)
+                    # evict rows the watermark has passed (bounded
+                    # state); the horizon backs off by the session gap —
+                    # a row may still extend a session until the
+                    # watermark is a full gap beyond it
+                    horizon = self._watermark_ts - self._session_gap
+                    before = self._buffer.num_rows
                     keep = pc.greater_equal(
                         _col_as_seconds(self._buffer.column(col)),
-                        self._watermark_ts)
+                        horizon)
                     self._buffer = self._buffer.filter(keep)
+                    evicted = before - self._buffer.num_rows
+                    if evicted:
+                        _record_metric("streaming.state.evicted_count",
+                                       evicted)
+        _record_metric("streaming.state.rows", self._buffer.num_rows)
         bound = _substitute_source(self._plan, self._source_name,
                                    sp.LocalRelation(self._buffer))
-        result = self._session._execute_query(bound)
+        result = self._execute_plan(bound, epoch)
         if self._mode == "complete":
             self._prev_result = result
             return result
@@ -376,15 +955,12 @@ class StreamingQuery:
                    if tuple(r.values()) not in prev_rows]
         if not changed:
             return result.slice(0, 0)
-        import pyarrow as _pa
-        return _pa.Table.from_pylist(changed, schema=result.schema)
+        return pa.Table.from_pylist(changed, schema=result.schema)
 
     # -- sink commit log (exactly-once) ---------------------------------
-    # The sink write happens BEFORE the offsets checkpoint, so a crash
-    # between them replays the batch on restart. The commit marker
-    # (atomic create-if-absent, Spark's commits/ layout) makes the replay
-    # skip the duplicate write: at-least-once processing + idempotent
-    # commit = exactly-once sink output for deterministic sources.
+    # At-least-once processing + idempotent finalize = exactly-once sink
+    # output for deterministic sources: the commit marker (atomic
+    # create, Spark's commits/ layout) makes a replayed epoch a no-op.
     def _commit_marker(self, batch_id: int) -> Optional[str]:
         if not self._checkpoint_dir:
             return None
@@ -414,8 +990,9 @@ class StreamingQuery:
         # the current one — if checkpointing stalls, every batch from
         # the stalled offset on stays replayable and must keep its
         # marker, or a restart would duplicate its sink output.
-        if batch_id % 100 == 0:
-            floor = self._last_ckpt_batch - 100
+        retention = getattr(self, "_commit_retention", 100) or 100
+        if batch_id % retention == 0:
+            floor = self._last_ckpt_batch - retention
             commits_dir = _os.path.dirname(marker)
             for name in _os.listdir(commits_dir):
                 try:
@@ -425,29 +1002,90 @@ class StreamingQuery:
                     continue
 
     # -- durable checkpoints --------------------------------------------
-    def _write_checkpoint(self):
+    def _write_arrow(self, path: str, table: pa.Table):
+        import os as _os
+        sink_buf = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink_buf, table.schema) as w:
+            w.write_table(table)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(sink_buf.getvalue().to_pybytes())
+        _os.replace(tmp, path)
+
+    def _checkpoint_state(self, epoch: int) -> Optional[dict]:
+        """Write the epoch's state artifact (snapshot or changelog) and
+        return the state metadata the offsets file will reference. The
+        state file lands BEFORE offsets.json points at it, so a crash in
+        between leaves the previous chain intact."""
+        import os as _os
+        if self._state_mode == "store" and self._store is not None \
+                and self._store.schema is not None:
+            faults.inject("streaming.checkpoint", key=f"state:e{epoch}")
+            if self._state_base is None or \
+                    epoch - self._state_base >= self._compact_interval:
+                fname = f"state-{epoch}.arrow"
+                self._write_arrow(
+                    _os.path.join(self._checkpoint_dir, fname),
+                    self._store.snapshot_table())
+                self._state_base = epoch
+                self._state_files = [fname]
+            elif self._store.dirty:
+                fname = f"delta-{epoch}.arrow"
+                self._write_arrow(
+                    _os.path.join(self._checkpoint_dir, fname),
+                    self._store.changelog_table())
+                self._state_files.append(fname)
+            self._store.clear_dirty()
+            return {"mode": "store", "files": list(self._state_files)}
+        if self._buffer is not None:
+            faults.inject("streaming.checkpoint", key=f"state:e{epoch}")
+            fname = f"state-{epoch}.arrow"
+            self._write_arrow(
+                _os.path.join(self._checkpoint_dir, fname), self._buffer)
+            self._state_files = [fname]
+            return {"mode": "buffer", "files": [fname]}
+        return None
+
+    def _write_checkpoint(self, pending: Optional[dict] = None):
         import json
         import os as _os
+        epoch = self._batch_id
         _os.makedirs(self._checkpoint_dir, exist_ok=True)
-        state = {"batch_id": self._batch_id + 1,
+        state_meta = self._checkpoint_state(epoch)
+        state = {"batch_id": epoch + 1,
                  "offset": self._source.offset(),
-                 "watermark": self._watermark_ts}
-        if self._buffer is not None:
-            sink_buf = pa.BufferOutputStream()
-            with pa.ipc.new_stream(sink_buf, self._buffer.schema) as w:
-                w.write_table(self._buffer)
-            with open(_os.path.join(self._checkpoint_dir, "state.arrow.tmp"),
-                      "wb") as f:
-                f.write(sink_buf.getvalue().to_pybytes())
-            _os.replace(_os.path.join(self._checkpoint_dir,
-                                      "state.arrow.tmp"),
-                        _os.path.join(self._checkpoint_dir, "state.arrow"))
+                 "watermark": self._watermark_ts,
+                 "max_event_ts": self._max_event_ts,
+                 "pending": pending,
+                 "state": state_meta}
+        faults.inject("streaming.checkpoint", key=f"offsets:e{epoch}")
         tmp = _os.path.join(self._checkpoint_dir, "offsets.json.tmp")
         with open(tmp, "w") as f:
             json.dump(state, f)
         _os.replace(tmp, _os.path.join(self._checkpoint_dir,
                                        "offsets.json"))
         self._last_ckpt_batch = int(state["batch_id"])
+        self._prune_state_files(state_meta)
+
+    def _prune_state_files(self, state_meta: Optional[dict]):
+        """Best-effort removal of state artifacts the offsets file no
+        longer references (superseded snapshots, compacted changelogs,
+        orphans from crashed checkpoints)."""
+        import os as _os
+        live = set(state_meta["files"]) if state_meta else set()
+        try:
+            names = _os.listdir(self._checkpoint_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".arrow") or name in live:
+                continue
+            if name == "state.arrow" and not live:
+                continue  # legacy single-file layout stays until replaced
+            try:
+                _os.unlink(_os.path.join(self._checkpoint_dir, name))
+            except OSError:
+                pass
 
     def _restore_checkpoint(self):
         import json
@@ -460,11 +1098,75 @@ class StreamingQuery:
         self._batch_id = int(state.get("batch_id", 0))
         self._last_ckpt_batch = self._batch_id
         self._watermark_ts = state.get("watermark")
+        self._max_event_ts = state.get("max_event_ts")
         self._source.seek(state.get("offset"))
-        spath = _os.path.join(self._checkpoint_dir, "state.arrow")
-        if _os.path.exists(spath):
-            with open(spath, "rb") as f:
+        meta = state.get("state")
+        if meta:
+            self._restore_state(meta)
+        else:
+            spath = _os.path.join(self._checkpoint_dir, "state.arrow")
+            if _os.path.exists(spath):  # legacy layout
+                with open(spath, "rb") as f:
+                    self._buffer = pa.ipc.open_stream(f.read()).read_all()
+                self._state_mode = "buffer"
+        pending = state.get("pending")
+        if pending is not None and \
+                not self._already_committed(int(pending["epoch"])):
+            # pre-committed but not finalized: the checkpoint advanced
+            # past this epoch, so it can never re-run — the sink MUST be
+            # able to finalize it from durable staged output
+            epoch = int(pending["epoch"])
+            if not self._sink.recover(epoch, int(pending.get("rows", 0))):
+                raise StreamingQueryException(
+                    f"cannot recover pre-committed epoch {epoch}: staged "
+                    f"output is gone and offsets already advanced")
+            self._mark_committed(epoch)
+            _record_metric("streaming.recovery.count", 1,
+                           action="finalized")
+        discarded = self._sink.discard_stale()
+        if discarded:
+            _record_metric("streaming.recovery.count", discarded,
+                           action="discarded")
+
+    def _restore_state(self, meta: dict):
+        import os as _os
+        from . import streaming_state as ss
+        self._state_mode = meta.get("mode")
+        files = list(meta.get("files") or ())
+        if self._state_mode == "store":
+            spec = ss.analyze_plan(
+                self._plan,
+                changed_keys_only=self._mode in ("update", "append"))
+            if spec is None:
+                raise StreamingQueryException(
+                    "checkpoint holds incremental keyed state but the "
+                    "plan is no longer eligible for it")
+            self._agg_spec = spec
+            self._store = ss.KeyedStateStore(spec.merge_kinds)
+            for fname in files:
+                fpath = _os.path.join(self._checkpoint_dir, fname)
+                with open(fpath, "rb") as f:
+                    table = pa.ipc.open_stream(f.read()).read_all()
+                self._store.load(table,
+                                 changelog=fname.startswith("delta-"))
+            self._store.clear_dirty()
+            self._state_files = files
+            for fname in files:
+                if fname.startswith("state-"):
+                    self._state_base = int(
+                        fname[len("state-"):-len(".arrow")])
+        elif files:
+            fpath = _os.path.join(self._checkpoint_dir, files[0])
+            with open(fpath, "rb") as f:
                 self._buffer = pa.ipc.open_stream(f.read()).read_all()
+            self._state_files = files
+
+
+def _as_int(value, default: int) -> int:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
 
 
 def _substitute_source(plan: sp.QueryPlan, name: str,
@@ -547,6 +1249,7 @@ class DataStreamWriter:
         self._options: Dict[str, str] = {}
         self._foreach_batch: Optional[Callable] = None
         self._output_mode = "append"
+        self._cluster = None
 
     def format(self, fmt: str) -> "DataStreamWriter":
         self._format = fmt.lower()
@@ -562,6 +1265,13 @@ class DataStreamWriter:
 
     def option(self, key, value) -> "DataStreamWriter":
         self._options[str(key).lower()] = str(value)
+        return self
+
+    def cluster(self, cluster) -> "DataStreamWriter":
+        """Run every trigger as a distributed job on this LocalCluster:
+        the query's epochs flow through the epoch-tagged shuffle data
+        plane with barrier alignment at stage boundaries."""
+        self._cluster = cluster
         return self
 
     def trigger(self, processingTime: Optional[str] = None, **_) -> "DataStreamWriter":
@@ -593,76 +1303,24 @@ class DataStreamWriter:
                            output_mode=self._output_mode,
                            watermark=watermark,
                            checkpoint_dir=self._options.get(
-                               "checkpointlocation"))
+                               "checkpointlocation"),
+                           cluster=self._cluster)
         return q
 
-    def _make_sink(self, session):
+    def _make_sink(self, session) -> Sink:
         if self._foreach_batch is not None:
-            fb = self._foreach_batch
-
-            def sink(batch_id, table):
-                fb(_as_df(session, table), batch_id)
-
-            return sink
+            return ForeachBatchSink(session, self._foreach_batch)
         if self._format == "console":
-            def sink(batch_id, table):
-                print(f"-------- Batch {batch_id} --------")
-                print(table.to_pandas().to_string(index=False))
-
-            return sink
+            return ConsoleSink()
         if self._format == "memory":
-            name = self._query_name or "stream"
-            state = {"tables": []}
-
-            def sink(batch_id, table):
-                state["tables"].append(table)
-                merged = pa.concat_tables(state["tables"],
-                                          promote_options="permissive")
-                session.createDataFrame(merged).createOrReplaceTempView(name)
-
-            return sink
+            return MemorySink(session, self._query_name or "stream")
         if self._format == "noop":
-            return lambda batch_id, table: None
+            return NoopSink()
         if self._format in ("parquet", "csv", "json"):
-            # file sink: one part file per micro-batch. Exactly-once
-            # comes from the COMMIT LOG in StreamingQuery._process —
-            # replayed batches whose commit marker exists skip the write
-            # (reference: the reference's checkpointed sink epochs,
-            # SURVEY.md §5 checkpoint/resume)
-            import os as _os
-            import uuid as _uuid
-
             out_dir = self._options.get("path")
             if not out_dir:
                 raise ValueError("file sinks require a path")
-            fmt = self._format
-
-            def sink(batch_id, table):
-                if table.num_rows == 0:
-                    return
-                _os.makedirs(out_dir, exist_ok=True)
-                ext = {"parquet": "parquet", "csv": "csv",
-                       "json": "json"}[fmt]
-                # DETERMINISTIC per-batch name: a replay after a crash
-                # between the rename and the commit marker overwrites the
-                # same file instead of duplicating the batch
-                name = f"part-{batch_id:05d}.{ext}"
-                tmp = _os.path.join(out_dir,
-                                    f".{name}.{_uuid.uuid4().hex}.tmp")
-                if fmt == "parquet":
-                    import pyarrow.parquet as _pq
-                    _pq.write_table(table, tmp)
-                elif fmt == "csv":
-                    import pyarrow.csv as _pacsv
-                    _pacsv.write_csv(table, tmp)
-                else:
-                    import json as _json
-                    with open(tmp, "w") as f:
-                        for row in table.to_pylist():
-                            f.write(_json.dumps(row, default=str) + "\n")
-                _os.replace(tmp, _os.path.join(out_dir, name))
-
-            return sink
+            return FileSink(self._format, out_dir)
         raise ValueError(f"unsupported stream sink {self._format!r}")
 
 

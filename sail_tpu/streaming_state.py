@@ -1,0 +1,398 @@
+"""Incremental keyed state for streaming aggregation.
+
+Reference role: the keyed state stores behind Sail's (and Spark's)
+stateful streaming operators — per-key partial aggregates updated from
+each micro-batch's delta instead of re-aggregating the whole retained
+input every trigger, with a changelog that rides the Arrow state
+checkpoint so recovery replays only what changed since the last
+snapshot.
+
+Shape:
+
+- :func:`analyze_plan` decides whether a streaming plan is eligible for
+  incremental state: exactly one ``Aggregate``, every aggregate function
+  mergeable (``sum``/``count``/``min``/``max`` — a partial over the
+  delta batch folds losslessly into the running partial), no
+  ``HAVING``/grouping sets/DISTINCT, and no ``session_window`` grouping
+  (sessions merge across batches, so they stay on the whole-buffer
+  path). The per-epoch delta runs the SAME ``Aggregate`` node through
+  the normal (jitted) engine over just the new slice; only the fold is
+  host-side, and it is O(delta keys), not O(state).
+- :class:`KeyedStateStore` holds ``key tuple → folded values`` plus a
+  per-key event-time high-water mark (``__wm_ts``) for watermark
+  eviction, tracks the keys changed/evicted since the last checkpoint,
+  and serializes either a full snapshot or a changelog delta as an
+  Arrow IPC table. Changelog entries carry the FULLY FOLDED values, so
+  recovery replay is last-write-wins — no re-folding, no ordering
+  hazards beyond epoch order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from .spec import expression as ex
+from .spec import plan as sp
+
+#: aggregate functions whose partials fold losslessly across epochs;
+#: the value is the fold rule applied per output column
+MERGEABLE = {
+    "sum": "sum",
+    "count": "sum",
+    "min": "min",
+    "max": "max",
+}
+
+#: hidden per-key event-time high-water mark column (watermark eviction)
+WM_COLUMN = "__wm_ts"
+#: changelog-only tombstone flag column
+DELETED_COLUMN = "__deleted"
+
+
+@dataclasses.dataclass
+class AggSpec:
+    """Analysis of a streaming plan's single Aggregate node."""
+
+    agg: sp.Aggregate
+    #: per output column of the aggregate's result: None = group key
+    #: (carried, not folded), else a MERGEABLE fold rule
+    merge_kinds: Tuple[Optional[str], ...]
+
+    @property
+    def key_positions(self) -> Tuple[int, ...]:
+        return tuple(i for i, k in enumerate(self.merge_kinds)
+                     if k is None)
+
+
+def _expr_contains_function(expr, names) -> bool:
+    if isinstance(expr, ex.Function) and expr.name.lower() in names:
+        return True
+    if dataclasses.is_dataclass(expr):
+        for f in dataclasses.fields(expr):
+            v = getattr(expr, f.name)
+            vs = v if isinstance(v, tuple) else (v,)
+            for item in vs:
+                if isinstance(item, ex.Expr) and \
+                        _expr_contains_function(item, names):
+                    return True
+    return False
+
+
+#: node types above the Aggregate that map rows independently — safe to
+#: run over a changed-keys-only slice of the state. Anything else
+#: (Sort+Limit, Deduplicate, joins, set ops, …) computes over the WHOLE
+#: result, so feeding it partial state would emit wrong rows.
+PER_ROW_ABOVE = (sp.Project, sp.Filter, sp.SubqueryAlias, sp.WithColumns,
+                 sp.WithColumnsRenamed, sp.Drop, sp.ToSchema)
+
+
+def _ancestors(plan, target) -> Optional[List[object]]:
+    """Nodes strictly above ``target`` on its root path (by identity),
+    or None when ``target`` is not in the tree."""
+    if plan is target:
+        return []
+    if dataclasses.is_dataclass(plan):
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            vs = v if isinstance(v, tuple) else (v,)
+            for item in vs:
+                if isinstance(item, sp.QueryPlan):
+                    below = _ancestors(item, target)
+                    if below is not None:
+                        return [plan] + below
+    return None
+
+
+def _find_aggregates(plan) -> List[object]:
+    out: List[object] = []
+    if isinstance(plan, (sp.Aggregate, sp.Deduplicate)):
+        out.append(plan)
+    if dataclasses.is_dataclass(plan):
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            vs = v if isinstance(v, tuple) else (v,)
+            for item in vs:
+                if isinstance(item, sp.QueryPlan):
+                    out.extend(_find_aggregates(item))
+    return out
+
+
+def session_window_gap_seconds(plan) -> Optional[float]:
+    """Static ``session_window`` gap of the plan's aggregate grouping,
+    or None when the plan has no session window / the gap is dynamic.
+    The whole-buffer path widens its row-eviction horizon by this much:
+    a row may still extend a session until the watermark is a full gap
+    past it."""
+    from .streaming import parse_delay
+    for node in _find_aggregates(plan):
+        if not isinstance(node, sp.Aggregate):
+            continue
+        for g in node.group:
+            expr = g.child if isinstance(g, ex.Alias) else g
+            if isinstance(expr, ex.Function) and \
+                    expr.name.lower() == "session_window" and \
+                    len(expr.args) == 2:
+                gap = expr.args[1]
+                # parser literals nest (expression Literal wrapping the
+                # spec Literal): unwrap until a scalar surfaces
+                value = getattr(gap, "value", None)
+                while value is not None and \
+                        not isinstance(value, (str, int, float)):
+                    value = getattr(value, "value", None)
+                if isinstance(value, str):
+                    try:
+                        return parse_delay(value)
+                    except (ValueError, IndexError):
+                        return None
+                if isinstance(value, (int, float)):
+                    # numeric literal gaps are rejected at resolve time
+                    # (Spark semantics); treat as unknown here
+                    return None
+                return None  # dynamic (per-row) gap: no safe horizon
+    return None
+
+
+def analyze_plan(plan, changed_keys_only: bool = False) -> Optional[AggSpec]:
+    """Return an :class:`AggSpec` when ``plan`` can run on the
+    incremental keyed state store, else None (whole-buffer fallback).
+
+    ``changed_keys_only`` marks update/append output modes, where the
+    residual plan above the aggregate executes over only the keys this
+    epoch touched: eligibility then additionally requires every operator
+    above the Aggregate to be per-row (:data:`PER_ROW_ABOVE`) — an
+    ``ORDER BY … LIMIT`` over partial state would otherwise pick its
+    "top" rows from whatever happened to change this trigger."""
+    aggs = _find_aggregates(plan)
+    if len(aggs) != 1 or not isinstance(aggs[0], sp.Aggregate):
+        return None
+    agg = aggs[0]
+    if agg.having is not None or agg.grouping_sets is not None \
+            or agg.rollup or agg.cube:
+        return None
+    if changed_keys_only:
+        for node in _ancestors(plan, agg) or ():
+            if not isinstance(node, PER_ROW_ABOVE):
+                return None
+    for g in agg.group:
+        if _expr_contains_function(g, ("session_window",)):
+            return None  # sessions merge across batches: buffer path
+
+    def matches_group(expr) -> bool:
+        if expr in agg.group:
+            return True
+        if isinstance(expr, ex.Attribute):
+            for g in agg.group:
+                target = g.child if isinstance(g, ex.Alias) else g
+                if isinstance(target, ex.Attribute) and \
+                        target.name[-1] == expr.name[-1]:
+                    return True
+        return False
+
+    kinds: List[Optional[str]] = []
+    for entry in agg.aggregate:
+        expr = entry.child if isinstance(entry, ex.Alias) else entry
+        if matches_group(expr) or matches_group(entry):
+            kinds.append(None)
+            continue
+        if isinstance(expr, ex.Function) and not expr.is_distinct \
+                and expr.filter is None \
+                and expr.name.lower() in MERGEABLE:
+            kinds.append(MERGEABLE[expr.name.lower()])
+            continue
+        return None
+    if not any(k is not None for k in kinds):
+        return None
+    return AggSpec(agg=agg, merge_kinds=tuple(kinds))
+
+
+def _hashable(value):
+    if isinstance(value, dict):
+        return tuple((k, _hashable(v)) for k, v in value.items())
+    if isinstance(value, (list, tuple)):
+        return tuple(_hashable(v) for v in value)
+    return value
+
+
+def _fold(kind: str, old, new):
+    """SQL-null-aware fold: an absent side contributes nothing."""
+    if old is None:
+        return new
+    if new is None:
+        return old
+    if kind == "sum":
+        return old + new
+    if kind == "min":
+        return new if new < old else old
+    return new if new > old else old  # max
+
+
+class KeyedStateStore:
+    """Hash-keyed partial aggregates with changelog tracking.
+
+    ``rows`` maps the hashable form of a key tuple to the full list of
+    output-column values (keys carried verbatim, aggregates folded).
+    Insertion order is preserved, so repeated emissions of unchanged
+    state are stable."""
+
+    def __init__(self, merge_kinds: Tuple[Optional[str], ...]):
+        self.merge_kinds = merge_kinds
+        self.schema: Optional[pa.Schema] = None   # incl. WM_COLUMN if any
+        self.rows: "Dict[tuple, List[object]]" = {}
+        self.wm_index: Optional[int] = None
+        self._changed: set = set()
+        self._deleted: Dict[tuple, List[object]] = {}
+
+    # -- folding -------------------------------------------------------
+    def _capture_schema(self, delta: pa.Table) -> None:
+        self.schema = delta.schema
+        names = delta.schema.names
+        self.wm_index = names.index(WM_COLUMN) if WM_COLUMN in names \
+            else None
+
+    def merge_delta(self, delta: pa.Table) -> List[tuple]:
+        """Fold one epoch's partial-aggregate result into the store;
+        returns the keys touched (for update-mode emission and the
+        changelog)."""
+        if self.schema is None:
+            self._capture_schema(delta)
+        key_pos = [i for i, k in enumerate(self.merge_kinds)
+                   if k is None]
+        cols = [delta.column(i).to_pylist()
+                for i in range(delta.num_columns)]
+        touched: List[tuple] = []
+        for r in range(delta.num_rows):
+            values = [c[r] for c in cols]
+            hkey = tuple(_hashable(values[i]) for i in key_pos)
+            current = self.rows.get(hkey)
+            if current is None:
+                self.rows[hkey] = values
+            else:
+                for i, kind in enumerate(self.merge_kinds):
+                    if kind is not None:
+                        current[i] = _fold(kind, current[i], values[i])
+                if self.wm_index is not None:
+                    current[self.wm_index] = _fold(
+                        "max", current[self.wm_index],
+                        values[self.wm_index])
+            self._changed.add(hkey)
+            touched.append(hkey)
+        return touched
+
+    def evict(self, horizon_seconds: float) -> int:
+        """Drop keys whose event-time high-water mark fell behind the
+        watermark (Spark semantics: state is evicted per KEY once no
+        future row can belong to it)."""
+        if self.wm_index is None or horizon_seconds is None:
+            return 0
+        from .streaming import _event_seconds
+        dead = []
+        for hkey, values in self.rows.items():
+            ts = values[self.wm_index]
+            if ts is not None and _event_seconds(ts) < horizon_seconds:
+                dead.append(hkey)
+        for hkey in dead:
+            self._deleted[hkey] = self.rows.pop(hkey)
+            self._changed.discard(hkey)
+        return len(dead)
+
+    # -- emission ------------------------------------------------------
+    def to_table(self, keys=None, include_wm: bool = False) -> pa.Table:
+        """Current state as an Arrow table (insertion order), hidden
+        watermark column stripped unless ``include_wm``."""
+        assert self.schema is not None
+        drop_wm = self.wm_index is not None and not include_wm
+        selected = self.rows.values() if keys is None else \
+            [self.rows[k] for k in keys if k in self.rows]
+        selected = list(selected)
+        arrays, fields = [], []
+        for i, f in enumerate(self.schema):
+            if drop_wm and i == self.wm_index:
+                continue
+            arrays.append(pa.array([v[i] for v in selected],
+                                   type=f.type))
+            fields.append(f)
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+    # -- checkpoint serialization --------------------------------------
+    def _flagged(self, rows: List[List[object]],
+                 deleted_flags: List[bool]) -> pa.Table:
+        arrays = [pa.array([v[i] for v in rows], type=f.type)
+                  for i, f in enumerate(self.schema)]
+        arrays.append(pa.array(deleted_flags, type=pa.bool_()))
+        schema = pa.schema(list(self.schema)
+                           + [pa.field(DELETED_COLUMN, pa.bool_())])
+        return pa.Table.from_arrays(arrays, schema=schema)
+
+    def snapshot_table(self) -> pa.Table:
+        rows = list(self.rows.values())
+        return self._flagged(rows, [False] * len(rows))
+
+    def changelog_table(self) -> pa.Table:
+        """Keys touched or evicted since the last checkpoint, fully
+        folded — replay is last-write-wins in epoch order."""
+        rows, flags = [], []
+        for hkey in self._changed:
+            if hkey in self.rows:
+                rows.append(self.rows[hkey])
+                flags.append(False)
+        for values in self._deleted.values():
+            rows.append(values)
+            flags.append(True)
+        return self._flagged(rows, flags)
+
+    @property
+    def dirty(self) -> bool:
+        return bool(self._changed or self._deleted)
+
+    def clear_dirty(self) -> None:
+        self._changed.clear()
+        self._deleted.clear()
+
+    def load(self, table: pa.Table, changelog: bool) -> None:
+        """Apply a snapshot (replaces nothing — the caller starts from
+        an empty store) or one changelog delta in epoch order."""
+        names = list(table.schema.names)
+        if DELETED_COLUMN in names:
+            flags = table.column(names.index(DELETED_COLUMN)).to_pylist()
+            table = table.drop_columns([DELETED_COLUMN])
+        else:
+            flags = [False] * table.num_rows
+        if self.schema is None:
+            self._capture_schema(table)
+        key_pos = [i for i, k in enumerate(self.merge_kinds)
+                   if k is None]
+        cols = [table.column(i).to_pylist()
+                for i in range(table.num_columns)]
+        for r in range(table.num_rows):
+            values = [c[r] for c in cols]
+            hkey = tuple(_hashable(values[i]) for i in key_pos)
+            if changelog and flags[r]:
+                self.rows.pop(hkey, None)
+            else:
+                self.rows[hkey] = values
+
+
+def substitute_node(plan, target, replacement):
+    """Replace ``target`` (by identity) anywhere in a spec plan tree."""
+    if plan is target:
+        return replacement
+    if not dataclasses.is_dataclass(plan):
+        return plan
+    updates = {}
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        if isinstance(v, sp.QueryPlan):
+            nv = substitute_node(v, target, replacement)
+            if nv is not v:
+                updates[f.name] = nv
+        elif isinstance(v, tuple) and any(
+                isinstance(item, sp.QueryPlan) for item in v):
+            nv = tuple(substitute_node(item, target, replacement)
+                       if isinstance(item, sp.QueryPlan) else item
+                       for item in v)
+            if any(a is not b for a, b in zip(nv, v)):
+                updates[f.name] = nv
+    return dataclasses.replace(plan, **updates) if updates else plan

@@ -92,6 +92,12 @@ def main():
     changed |= _add_field(sil, "fetch_channels", 5, F.TYPE_SINT32,
                           label=F.LABEL_REPEATED)
 
+    # epoch-aligned streaming: tasks and stream fetches carry the epoch
+    task = _message(fdp, "TaskDefinition")
+    changed |= _add_field(task, "epoch", 12, F.TYPE_UINT64)
+    fetch = _message(fdp, "FetchStreamRequest")
+    changed |= _add_field(fetch, "epoch", 7, F.TYPE_UINT64)
+
     if not changed:
         print("pb2 already up to date")
         return

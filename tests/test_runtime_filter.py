@@ -335,6 +335,34 @@ def test_explain_analyze_json_includes_counters():
 # adaptive / configurable skips
 # ---------------------------------------------------------------------------
 
+def test_first_join_trace_does_not_leak_module_constants():
+    """If the first-ever import of ops.runtime_filter lands while a
+    join phase program is being TRACED (possible when the first join of
+    the process skips the host-side filter build, e.g. filters
+    disabled), the module's jnp constants (_KEY_MAX) must NOT become
+    leaked tracers — that would poison every later join trace in the
+    process with UnexpectedTracerError. Locks the host-side import in
+    _compile_join_keys."""
+    import sys
+
+    # simulate a fresh process: the kernels module was never imported
+    sys.modules.pop("sail_tpu.ops.runtime_filter", None)
+    clear_caches()
+    spark = _session(**{"spark.sail.join.runtimeFilter.enabled": "false"})
+    _register_star(spark)
+    off = spark.sql("SELECT SUM(fact.v) FROM fact JOIN dim "
+                    "ON fact.k = dim.id").toArrow()
+    # a later join WITH filters uses the module's constants in a new
+    # trace — poisoned constants raise UnexpectedTracerError here
+    spark2 = _session()
+    clear_caches()
+    _register_star(spark2)
+    on = spark2.sql("SELECT SUM(fact.v) FROM fact JOIN dim "
+                    "ON fact.k = dim.id").toArrow()
+    assert profiler.last_profile().rtf_built >= 1
+    assert on.equals(off)
+
+
 def test_disabled_builds_nothing():
     spark = _session(**{"spark.sail.join.runtimeFilter.enabled": "false"})
     _register_star(spark)

@@ -102,7 +102,7 @@ def test_stream_store_spilled_channel_streams_from_disk():
     buf = sh.encode_table(t, codec="lz4")
     store = _StreamStore(memory_cap_bytes=64)  # force spill to disk
     store.put("j", 0, 0, {0: buf, 1: b""})
-    entry = store._streams[("j", 0, 0)][0]
+    entry = store._streams[("j", 0, 0, 0)][0]  # (job, epoch, stage, part)
     assert isinstance(entry, tuple) and entry[0] == "disk"
     chunks = store.open_chunks("j", 0, 0, 0)
     assert b"".join(chunks) == buf  # spill file IS the wire bytes

@@ -219,7 +219,11 @@ def test_streaming_checkpoint_restores_offsets(tmp_path):
         q2.stop()
 
 
-def test_watermark_bounds_state():
+def test_watermark_bounds_state(monkeypatch):
+    """Watermark eviction bounds state on BOTH stateful paths: the
+    incremental store drops whole keys once their event-time high-water
+    mark falls behind the watermark; the whole-buffer fallback drops
+    the retained rows themselves."""
     import datetime
     import pyarrow as pa
     from sail_tpu import SparkSession
@@ -228,22 +232,96 @@ def test_watermark_bounds_state():
 
     spark = SparkSession({})
     schema = pa.schema([("ts", pa.timestamp("us", tz="UTC")),
-                        ("v", pa.int64())])
+                        ("k", pa.int64())])
+    base = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+    late = base + datetime.timedelta(seconds=100)
+
+    # incremental store (default): the stale key is evicted whole
+    src = MemoryStreamSource(schema)
+    df = DataFrame(_StreamRead("s", src), spark) \
+        .withWatermark("ts", "10 seconds")
+    q = (df.groupBy("k").count().writeStream.outputMode("complete")
+         .format("noop").start())
+    try:
+        src.add(pa.table({"ts": [base], "k": [1]}, schema=schema))
+        q.processAllAvailable()
+        src.add(pa.table({"ts": [late], "k": [2]}, schema=schema))
+        q.processAllAvailable()
+        assert q._state_mode == "store"
+        assert q._watermark_ts == late.timestamp() - 10
+        # the watermark passed key 1's last event: its state is gone
+        assert len(q._store.rows) == 1
+        assert q.recent_progress[-1]["stateRows"] == 1
+    finally:
+        q.stop()
+
+    # whole-buffer fallback: rows past the horizon are dropped
+    monkeypatch.setenv("SAIL_STREAMING__INCREMENTAL_STATE", "0")
     src = MemoryStreamSource(schema)
     df = DataFrame(_StreamRead("s", src), spark) \
         .withWatermark("ts", "10 seconds")
     q = (df.groupBy().count().writeStream.outputMode("complete")
          .format("noop").start())
-    base = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
     try:
-        src.add(pa.table({"ts": [base], "v": [1]}, schema=schema))
+        src.add(pa.table({"ts": [base], "k": [1]}, schema=schema))
         q.processAllAvailable()
-        late = base + datetime.timedelta(seconds=100)
-        src.add(pa.table({"ts": [late], "v": [2]}, schema=schema))
+        src.add(pa.table({"ts": [late], "k": [2]}, schema=schema))
         q.processAllAvailable()
-        # the watermark advanced past the first row: state is bounded
+        assert q._state_mode == "buffer"
         assert q._buffer.num_rows == 1
         assert q._watermark_ts == late.timestamp() - 10
+    finally:
+        q.stop()
+
+
+def test_streaming_session_window_merges_across_epochs():
+    """Event-time session windows over a stream: sessions merge across
+    micro-batches (buffer path — sessions are not mergeable partials),
+    the eviction horizon widens by the session gap so a row the
+    watermark has passed can still EXTEND an open session, and a gap
+    larger than the session's finally bounds the state."""
+    import datetime
+    from sail_tpu import SparkSession
+    from sail_tpu.session import Column, DataFrame
+    from sail_tpu.spec import expression as ex
+    from sail_tpu.sql import parse_expression
+    from sail_tpu.streaming import MemoryStreamSource, _StreamRead
+
+    spark = SparkSession({})
+    schema = pa.schema([("ts", pa.timestamp("us", tz="UTC")),
+                        ("k", pa.int64())])
+    base = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+
+    def at(seconds):
+        return base + datetime.timedelta(seconds=seconds)
+
+    src = MemoryStreamSource(schema)
+    sw = Column(ex.Alias(
+        parse_expression("session_window(ts, '60 seconds')"), ("sw",)))
+    df = DataFrame(_StreamRead("s", src), spark) \
+        .withWatermark("ts", "10 seconds")
+    q = (df.groupBy(sw).count().writeStream.outputMode("complete")
+         .format("noop").start())
+    try:
+        src.add(pa.table({"ts": [at(0)], "k": [1]}, schema=schema))
+        q.processAllAvailable()
+        assert q._state_mode == "buffer"  # sessions: whole-buffer path
+        assert q._session_gap == 60.0
+        # second epoch, 40s later: the watermark (base+30) has PASSED
+        # the first row, but the widened horizon (watermark - gap)
+        # keeps it — the two rows merge into ONE session of count 2
+        src.add(pa.table({"ts": [at(40)], "k": [2]}, schema=schema))
+        q.processAllAvailable()
+        assert q._buffer.num_rows == 2
+        out = q._prev_result
+        assert out.num_rows == 1
+        assert out.column("count").to_pylist() == [2]
+        # third epoch far beyond the gap: the old session's rows are
+        # finally evicted and only the new session remains
+        src.add(pa.table({"ts": [at(300)], "k": [3]}, schema=schema))
+        q.processAllAvailable()
+        assert q._buffer.num_rows == 1
+        assert q._prev_result.column("count").to_pylist() == [1]
     finally:
         q.stop()
 

@@ -1,0 +1,786 @@
+"""Streaming restart/resume chaos matrix: exactly-once across crashes.
+
+The exactly-once contract under test (streaming.py module docstring):
+a streaming query killed at ANY point of its epoch commit protocol —
+mid-sink, between sink and commit marker, mid-state-checkpoint, before
+the offsets write, or mid-shuffle on the cluster path — and restarted
+from its checkpoint produces total sink output byte-identical to the
+fault-free run. No loss, no duplicates, for every source kind and for
+both the stateless and the stateful (incremental keyed state) paths.
+
+Crashes are driven by the seeded-injection grammar of faults.py
+(``streaming.source`` / ``streaming.sink`` / ``streaming.checkpoint``
+sites, plus the cluster sites for the epoch-aligned shuffle run), so
+every scenario is deterministic and replayable.
+"""
+
+import glob
+import os
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from sail_tpu import SparkSession, faults
+from sail_tpu.session import DataFrame
+from sail_tpu.streaming import (MemoryStreamSource, ReplayableMemorySource,
+                                StreamingQueryException, _StreamRead)
+
+SCHEMA = pa.schema([("k", pa.int64()), ("v", pa.int64())])
+
+
+@pytest.fixture()
+def spark():
+    return SparkSession({})
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _batches(n=3, rows=40):
+    """Deterministic per-epoch input slices."""
+    out = []
+    for e in range(n):
+        ks = [(e * 31 + i) % 8 for i in range(rows)]
+        vs = [e * 1000 + i for i in range(rows)]
+        out.append(pa.table({"k": pa.array(ks, type=pa.int64()),
+                             "v": pa.array(vs, type=pa.int64())}))
+    return out
+
+
+def _read_parts(out_dir):
+    """{part filename: table} of the sink directory's final output."""
+    return {os.path.basename(f): pq.read_table(f)
+            for f in sorted(glob.glob(os.path.join(out_dir,
+                                                   "part-*.parquet")))}
+
+
+def _assert_identical(chaos, clean):
+    assert sorted(chaos) == sorted(clean), \
+        f"part sets differ: {sorted(chaos)} vs {sorted(clean)}"
+    for name, table in clean.items():
+        assert chaos[name].equals(table), f"{name} differs"
+
+
+def _drive(make_query, feed, n_batches, spec=None, seed=11,
+           max_restarts=8):
+    """Feed epochs one at a time, restarting from the checkpoint
+    whenever an injected failure kills the query; returns
+    ``(restart count, injection counts)``. ``make_query(fed)`` builds a
+    fresh query whose source holds everything fed so far (the
+    checkpoint seek skips the consumed prefix); ``feed(src, i)`` makes
+    slice i available."""
+    if spec:
+        faults.configure(spec, seed=seed)
+    restarts = 0
+    src, q = make_query(0)
+    try:
+        fed = 0
+        while True:
+            try:
+                q.processAllAvailable()
+            except StreamingQueryException:
+                q.stop()
+                restarts += 1
+                assert restarts <= max_restarts, "restart storm"
+                src, q = make_query(fed)
+                continue
+            if fed >= n_batches:
+                break
+            feed(src, fed)
+            fed += 1
+    finally:
+        q.stop()
+        # snapshot BEFORE reset — counts are part of the test's proof
+        counts = dict(faults.injection_counts()) if spec else {}
+        faults.reset()
+    return restarts, counts
+
+
+# ---------------------------------------------------------------------------
+# The restart/resume matrix: sources x stateful/stateless x crash point
+# ---------------------------------------------------------------------------
+# Each crash point is keyed to epoch 1 via the injection-site key, so
+# the kill lands at a precise step of the commit protocol:
+#
+# sink-stage    before the sink sees the epoch (nothing staged, offsets
+#               unadvanced -> the epoch re-runs whole)
+# sink-commit   two-phase: AFTER the pre-commit offsets write, before
+#               the finalize rename -> recovery must finalize the
+#               durable staged output, never re-run or drop the epoch
+# ckpt-state    mid-state-checkpoint (before offsets) -> epoch re-runs,
+#               previous state chain stays intact
+# ckpt-offsets  after the state file, before offsets.json lands ->
+#               epoch re-runs; staged/committed output must not double
+CRASH_POINTS = {
+    "sink-stage": "streaming.sink:stage:e1=error#1",
+    "sink-commit": "streaming.sink:commit:e1=error#1",
+    "ckpt-state": "streaming.checkpoint:state:e1=error#1",
+    "ckpt-offsets": "streaming.checkpoint:offsets:e1=error#1",
+}
+
+
+def _apply_plan(df, stateful):
+    if stateful:
+        return df.groupBy("k").sum("v"), "complete"
+    return df.filter("v % 2 = 0"), "append"
+
+
+def _memory_runner(spark, batches, stateful, out_dir, ckpt):
+    def make_query(fed):
+        src = ReplayableMemorySource(SCHEMA)
+        for b in batches[:fed]:
+            src.add(b)
+        df = DataFrame(_StreamRead("rsrc", src), spark)
+        shaped, mode = _apply_plan(df, stateful)
+        q = (shaped.writeStream.outputMode(mode).format("parquet")
+             .option("checkpointLocation", ckpt).start(out_dir))
+        return src, q
+
+    return make_query, lambda src, i: src.add(batches[i])
+
+
+def _file_runner(spark, batches, stateful, out_dir, ckpt, in_dir):
+    os.makedirs(in_dir, exist_ok=True)
+
+    def make_query(fed):
+        df = (spark.readStream.format("parquet")
+              .schema("k BIGINT, v BIGINT").load(in_dir))
+        shaped, mode = _apply_plan(df, stateful)
+        q = (shaped.writeStream.outputMode(mode).format("parquet")
+             .option("checkpointLocation", ckpt).start(out_dir))
+        return None, q
+
+    def feed(_src, i):
+        path = os.path.join(in_dir, f"in-{i:03d}.parquet")
+        pq.write_table(batches[i], path + ".tmp")
+        os.replace(path + ".tmp", path)
+
+    return make_query, feed
+
+
+def _run_matrix_case(spark, tmp_path, source, stateful, spec, tag):
+    batches = _batches()
+    out_dir = str(tmp_path / f"{tag}_out")
+    ckpt = str(tmp_path / f"{tag}_ckpt")
+    if source == "memory":
+        make_query, feed = _memory_runner(spark, batches, stateful,
+                                          out_dir, ckpt)
+    else:
+        make_query, feed = _file_runner(spark, batches, stateful,
+                                        out_dir, ckpt,
+                                        str(tmp_path / f"{tag}_in"))
+    restarts, counts = _drive(make_query, feed, len(batches), spec=spec)
+    return _read_parts(out_dir), restarts, counts
+
+
+@pytest.mark.parametrize("source", ["memory", "file"])
+@pytest.mark.parametrize("stateful", [True, False],
+                         ids=["stateful", "stateless"])
+@pytest.mark.parametrize("crash", sorted(CRASH_POINTS))
+def test_restart_matrix_exactly_once(spark, tmp_path, source, stateful,
+                                     crash):
+    """A crash at each commit-protocol step, for each source kind and
+    both execution paths: the restarted run's total sink output is
+    byte-identical to the fault-free run."""
+    if crash == "ckpt-state" and not stateful:
+        pytest.skip("the stateless path writes no state artifact, so "
+                    "the state-checkpoint site never fires")
+    clean, _, _ = _run_matrix_case(spark, tmp_path, source, stateful,
+                                   None, "clean")
+    chaos, restarts, counts = _run_matrix_case(
+        spark, tmp_path, source, stateful, CRASH_POINTS[crash], "chaos")
+    site = CRASH_POINTS[crash].split(":", 1)[0]
+    assert counts.get(site) == 1, f"{site} injection did not fire"
+    assert restarts == 1, f"expected exactly one {site} kill"
+    _assert_identical(chaos, clean)
+
+
+def test_single_phase_staging_closes_replay_window(spark, tmp_path,
+                                                   monkeypatch):
+    """Satellite: with the two-phase protocol gated OFF, the file sink
+    still stages under the batch id and finalizes atomically with the
+    commit marker — a crash between the sink write and the marker no
+    longer duplicates appended output on restart."""
+    monkeypatch.setenv("SAIL_STREAMING__TWO_PHASE", "0")
+    clean, _, _ = _run_matrix_case(spark, tmp_path, "memory", False,
+                                   None, "clean")
+    chaos, restarts, _ = _run_matrix_case(spark, tmp_path, "memory",
+                                          False,
+                                          CRASH_POINTS["sink-commit"],
+                                          "chaos")
+    assert restarts == 1
+    _assert_identical(chaos, clean)
+    # single-phase: the crashed epoch re-ran from unadvanced offsets and
+    # its stale staging leftover was discarded, not double-finalized
+    assert not glob.glob(os.path.join(str(tmp_path / "chaos_out"),
+                                      "_staging", "*"))
+
+
+def test_two_phase_recovers_precommitted_epoch_without_rerun(
+        spark, tmp_path):
+    """The sink-commit crash point specifically: the offsets checkpoint
+    recorded epoch 1 as pre-committed before the finalize died, so the
+    restart must FINALIZE the durable staged output — re-running would
+    need input the advanced offsets no longer replay."""
+    batches = _batches()
+    out_dir = str(tmp_path / "out")
+    ckpt = str(tmp_path / "ckpt")
+    make_query, feed = _memory_runner(spark, batches, False, out_dir,
+                                      ckpt)
+    epochs_run = []
+
+    def counting_make_query(fed):
+        src, q = make_query(fed)
+        epochs_run.append((fed, q._batch_id))
+        return src, q
+
+    _drive(counting_make_query, feed, len(batches),
+           spec=CRASH_POINTS["sink-commit"])
+    # the restarted query resumed AT epoch 2: epoch 1 was recovered
+    # from staging, not re-executed
+    assert epochs_run == [(0, 0), (2, 2)]
+    parts = _read_parts(out_dir)
+    assert sorted(parts) == ["part-00000.parquet", "part-00001.parquet",
+                             "part-00002.parquet"]
+    got = pa.concat_tables([parts[n] for n in sorted(parts)])
+    expected = pa.concat_tables(
+        [b.filter(pa.compute.equal(pa.compute.bit_wise_and(
+            b.column("v"), 1), 0)) for b in batches])
+    assert got.equals(expected)
+
+
+# ---------------------------------------------------------------------------
+# Rate source: time-driven epochs, restart resumes the value sequence
+# ---------------------------------------------------------------------------
+
+def test_rate_source_restart_no_loss_no_duplicates(spark, tmp_path):
+    """Kill a rate-source query mid-run and restart it from the
+    checkpoint: the emitted `value` sequence stays gapless and
+    duplicate-free (epoch boundaries are time-dependent, so the
+    invariant is the SET of rows, not per-part bytes)."""
+    out_dir = str(tmp_path / "out")
+    ckpt = str(tmp_path / "ckpt")
+
+    def start():
+        df = (spark.readStream.format("rate")
+              .option("rowsPerSecond", 400).load())
+        return (df.select("value").writeStream.format("parquet")
+                .option("checkpointLocation", ckpt)
+                .trigger(processingTime="50 milliseconds")
+                .start(out_dir))
+
+    faults.configure("streaming.sink:stage:e2=error#1", seed=7)
+    q = start()
+    try:
+        assert not q.awaitTermination(20), "query should die at epoch 2"
+    except StreamingQueryException:
+        pass
+    else:
+        pytest.fail("injected sink failure did not surface")
+    q.stop()
+    faults.reset()
+    q = start()  # resumes from the checkpointed offset
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            parts = _read_parts(out_dir)
+            total = sum(t.num_rows for t in parts.values())
+            if total >= 60:
+                break
+            time.sleep(0.1)
+    finally:
+        q.stop()
+    values = sorted(v for t in _read_parts(out_dir).values()
+                    for v in t.column("value").to_pylist())
+    assert len(values) >= 60
+    assert values == list(range(len(values))), \
+        "rate stream lost or duplicated values across the restart"
+
+
+# ---------------------------------------------------------------------------
+# Failure surfacing (satellite): errors don't masquerade as graceful stop
+# ---------------------------------------------------------------------------
+
+def test_await_termination_raises_streaming_query_exception(spark):
+    src = MemoryStreamSource(SCHEMA)
+    df = DataFrame(_StreamRead("fsrc", src), spark)
+    q = (df.writeStream.format("noop")
+         .trigger(processingTime="20 milliseconds").start())
+    try:
+        faults.configure("streaming.source=error#1", seed=3)
+        with pytest.raises(StreamingQueryException) as exc:
+            q.awaitTermination(15)
+        assert isinstance(exc.value.cause, faults.FaultInjectedError)
+        # the terminal failure is recorded in progress, not hidden
+        assert q.recent_progress[-1]["status"] == "failed"
+        assert "FaultInjectedError" in q.recent_progress[-1]["error"]
+        # every surface re-raises, consistently
+        with pytest.raises(StreamingQueryException):
+            q.processAllAvailable()
+        with pytest.raises(StreamingQueryException):
+            q.awaitTermination()
+    finally:
+        q.stop()
+
+
+def test_progress_entries_record_status(spark):
+    src = MemoryStreamSource(SCHEMA)
+    df = DataFrame(_StreamRead("psrc", src), spark)
+    q = df.writeStream.format("noop").start()
+    try:
+        src.add(_batches(1)[0])
+        q.processAllAvailable()
+        assert [e["status"] for e in q.recent_progress] == ["committed"]
+    finally:
+        q.stop()
+
+
+def test_trigger_never_runs_past_a_concurrent_failure(spark):
+    """A trigger thread already blocked on the epoch lock when another
+    trigger fails must NOT run once it acquires the lock: the failed
+    epoch's rows were consumed from the source but never committed, so
+    a follow-on trigger would commit the failed epoch's id over only
+    the post-failure remainder — the lost slice could never replay."""
+    src = MemoryStreamSource(SCHEMA)
+    df = DataFrame(_StreamRead("csrc", src), spark).groupBy("k").sum("v")
+    q = (df.writeStream.outputMode("complete").format("noop")
+         .trigger(processingTime="10 milliseconds").start())
+    try:
+        with q._proc_lock:
+            # park the interval loop on the lock, then fail "mid-epoch"
+            # (as a concurrent processAllAvailable trigger would) with
+            # a slice pending
+            time.sleep(0.1)
+            src.add(_batches(1)[0])
+            q._fail(RuntimeError("boom"))
+        q._thread.join(5.0)
+        assert not q._thread.is_alive()
+        # the loop exited WITHOUT consuming the pending slice or
+        # committing anything past the failure point
+        assert src._pending, "loop consumed the source past the failure"
+        assert [e["status"] for e in q.recent_progress] == ["failed"]
+        with pytest.raises(StreamingQueryException):
+            q.awaitTermination()
+        # a drain arriving after the failure re-raises instead of
+        # processing (same lock-window guard on the drain side)
+        with pytest.raises(StreamingQueryException):
+            q.processAllAvailable()
+        assert src._pending
+    finally:
+        q.stop()
+
+
+# ---------------------------------------------------------------------------
+# Incremental keyed state == whole-buffer re-aggregation, bit for bit
+# ---------------------------------------------------------------------------
+
+STATEFUL_SHAPES = {
+    "sum": lambda df: df.groupBy("k").sum("v"),
+    "count": lambda df: df.groupBy("k").count(),
+    "min": lambda df: df.groupBy("k").min("v"),
+    "max": lambda df: df.groupBy("k").max("v"),
+    "global": lambda df: df.groupBy().sum("v"),
+}
+
+
+def _run_stateful(spark, shape, incremental, monkeypatch, name):
+    monkeypatch.setenv("SAIL_STREAMING__INCREMENTAL_STATE",
+                       "1" if incremental else "0")
+    src = MemoryStreamSource(SCHEMA)
+    df = STATEFUL_SHAPES[shape](DataFrame(_StreamRead("ssrc", src),
+                                          spark))
+    q = (df.writeStream.outputMode("complete").format("memory")
+         .queryName(name).start())
+    try:
+        for b in _batches(4):
+            src.add(b)
+            q.processAllAvailable()
+        expected_mode = "store" if incremental else "buffer"
+        assert q._state_mode == expected_mode
+        final = q._prev_result
+    finally:
+        q.stop()
+    sort_keys = [(c, "ascending") for c in final.column_names]
+    return final.sort_by(sort_keys)
+
+
+@pytest.mark.parametrize("shape", sorted(STATEFUL_SHAPES))
+def test_incremental_state_matches_whole_buffer(spark, monkeypatch,
+                                                shape):
+    """The keyed state store's per-epoch fold must be bit-identical to
+    re-aggregating the whole retained buffer, for every mergeable
+    aggregate shape."""
+    store = _run_stateful(spark, shape, True, monkeypatch, "eq_store")
+    buffer = _run_stateful(spark, shape, False, monkeypatch, "eq_buf")
+    assert store.equals(buffer)
+
+
+@pytest.mark.parametrize("mode", ["update", "append"])
+def test_incremental_changed_key_modes_match_buffer(spark, monkeypatch,
+                                                    mode):
+    """Update- and append-mode emission (changed keys only — NOT the
+    full accumulated state re-delivered every trigger) agrees between
+    the two state paths, epoch by epoch."""
+
+    def run(incremental):
+        monkeypatch.setenv("SAIL_STREAMING__INCREMENTAL_STATE",
+                           "1" if incremental else "0")
+        src = MemoryStreamSource(SCHEMA)
+        df = DataFrame(_StreamRead("usrc", src), spark) \
+            .groupBy("k").sum("v")
+        emitted = []
+        q = (df.writeStream.outputMode(mode)
+             .foreachBatch(lambda bdf, bid: emitted.append(
+                 (bid, bdf.toPandas().sort_values("k")
+                  .reset_index(drop=True))))
+             .start())
+        try:
+            for b in _batches(3):
+                src.add(b)
+                q.processAllAvailable()
+        finally:
+            q.stop()
+        return emitted
+
+    store, buffer = run(True), run(False)
+    assert len(store) == len(buffer) == 3
+    for (sid, sdf), (bid, bdf) in zip(store, buffer):
+        assert sid == bid
+        assert sdf.equals(bdf), f"epoch {sid} {mode} emission differs"
+
+
+def test_whole_result_ops_above_agg_fall_back_to_buffer(spark,
+                                                        monkeypatch):
+    """ORDER BY … LIMIT above the aggregate computes over the WHOLE
+    result. In update/append mode the incremental path emits only the
+    keys this epoch touched, so feeding the residual plan a changed-key
+    slice would crown whatever happened to change as the 'top' row —
+    such plans must take the whole-buffer path. Complete mode emits the
+    full state, so the same plan stays store-eligible there."""
+    monkeypatch.setenv("SAIL_STREAMING__INCREMENTAL_STATE", "1")
+    e1 = pa.table({"k": [1, 2], "v": [10, 5]}, schema=SCHEMA)
+    e2 = pa.table({"k": [1], "v": [100]}, schema=SCHEMA)  # non-top key
+
+    def make_query(mode, emitted):
+        src = MemoryStreamSource(SCHEMA)
+        df = DataFrame(_StreamRead("wsrc", src), spark) \
+            .groupBy("k").sum("v").orderBy("sum(v)").limit(1)
+        q = (df.writeStream.outputMode(mode)
+             .foreachBatch(lambda bdf, bid: emitted.append(
+                 bdf.toPandas().reset_index(drop=True)))
+             .start())
+        return src, q
+
+    emitted = []
+    src, q = make_query("update", emitted)
+    try:
+        src.add(e1)
+        q.processAllAvailable()
+        assert q._state_mode == "buffer"
+        assert emitted[-1]["k"].tolist() == [2]  # top-1 by sum: k=2 (5)
+        # epoch 2 grows only k=1: the whole-result top-1 is unchanged,
+        # so update mode emits nothing (the store path would have fed
+        # only k=1 into Sort+Limit and emitted it as the new 'top')
+        src.add(e2)
+        q.processAllAvailable()
+        assert emitted[-1].empty
+    finally:
+        q.stop()
+
+    emitted = []
+    src, q = make_query("complete", emitted)
+    try:
+        src.add(e1)
+        q.processAllAvailable()
+        src.add(e2)
+        q.processAllAvailable()
+        assert q._state_mode == "store"  # full state feeds Sort+Limit
+        assert emitted[-1]["k"].tolist() == [2]
+    finally:
+        q.stop()
+
+
+def test_store_dirty_sets_bounded_without_checkpoint(spark, monkeypatch):
+    """A stateful query with NO checkpointLocation never consumes the
+    changelog, so the store must drop its dirty bookkeeping per trigger
+    — otherwise every touched key (and every watermark-evicted key's
+    full row) is retained for the query's lifetime."""
+    import datetime
+
+    monkeypatch.setenv("SAIL_STREAMING__INCREMENTAL_STATE", "1")
+    schema = pa.schema([("ts", pa.timestamp("us", tz="UTC")),
+                        ("k", pa.int64())])
+    base = datetime.datetime(2026, 1, 1,
+                             tzinfo=datetime.timezone.utc)
+    src = MemoryStreamSource(schema)
+    df = DataFrame(_StreamRead("dsrc", src), spark) \
+        .withWatermark("ts", "10 seconds").groupBy("k").count()
+    q = (df.writeStream.outputMode("complete").format("noop").start())
+    try:
+        for i in range(3):
+            ts = base + datetime.timedelta(seconds=100 * i)
+            src.add(pa.table({"ts": [ts] * 4,
+                              "k": list(range(4 * i, 4 * i + 4))},
+                             schema=schema))
+            q.processAllAvailable()
+        # each epoch's watermark evicted the previous epoch's keys, and
+        # without a checkpoint the dirty sets were cleared per trigger
+        assert len(q._store.rows) == 4
+        assert not q._store._changed
+        assert not q._store._deleted
+    finally:
+        q.stop()
+
+
+def test_transient_epoch_failure_does_not_disable_eviction(spark,
+                                                           monkeypatch):
+    """The first-epoch watermark-aggregate probe resolves the plan but
+    must NOT interpret a transient execution failure as 'watermark
+    unsupported': the error surfaces as a query failure (restartable)
+    and eviction stays armed."""
+    import datetime
+
+    monkeypatch.setenv("SAIL_STREAMING__INCREMENTAL_STATE", "1")
+    schema = pa.schema([("ts", pa.timestamp("us", tz="UTC")),
+                        ("k", pa.int64())])
+    src = MemoryStreamSource(schema)
+    df = DataFrame(_StreamRead("tsrc", src), spark) \
+        .withWatermark("ts", "10 seconds").groupBy("k").count()
+    q = (df.writeStream.outputMode("complete").format("noop").start())
+    real_execute = q._execute_plan
+    calls = {"n": 0}
+
+    def flaky(bound, epoch):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient worker loss")
+        return real_execute(bound, epoch)
+
+    q._execute_plan = flaky
+    try:
+        base = datetime.datetime(2026, 1, 1,
+                                 tzinfo=datetime.timezone.utc)
+        src.add(pa.table({"ts": [base], "k": [1]}, schema=schema))
+        with pytest.raises(StreamingQueryException):
+            q.processAllAvailable()
+        # the probe resolved BEFORE execution: support was decided from
+        # the plan, not poisoned by the transient execution error
+        assert q._wm_agg_supported is True
+    finally:
+        q.stop()
+
+
+def test_failed_epoch_staged_output_aborted(spark):
+    """A trigger that dies between sink staging and finalize must drop
+    its staged output (discarded stage) — the in-memory sinks would
+    otherwise pin the failed epoch's table forever."""
+    src = MemoryStreamSource(SCHEMA)
+    df = DataFrame(_StreamRead("asrc", src), spark)
+    q = (df.writeStream.format("memory").queryName("aborted_epoch")
+         .start())
+    try:
+        faults.configure("streaming.sink:commit:e0=error#1", seed=5)
+        src.add(_batches(1)[0])
+        with pytest.raises(StreamingQueryException):
+            q.processAllAvailable()
+        assert q._sink._staged == {}
+    finally:
+        q.stop()
+        faults.reset()
+
+
+def test_incremental_state_checkpoint_chain_restores(spark, tmp_path,
+                                                     monkeypatch):
+    """Snapshot + changelog chain: state checkpointed across epochs
+    (compact_interval > 1 so deltas ride between snapshots) restores in
+    a new query to the exact folded values."""
+    monkeypatch.setenv("SAIL_STREAMING__INCREMENTAL_STATE", "1")
+    monkeypatch.setenv("SAIL_STREAMING__STATE__COMPACT_INTERVAL", "3")
+    ckpt = str(tmp_path / "ckpt")
+    batches = _batches(5)
+
+    def start(src):
+        df = DataFrame(_StreamRead("csrc", src), spark) \
+            .groupBy("k").sum("v")
+        return (df.writeStream.outputMode("complete").format("noop")
+                .option("checkpointLocation", ckpt).start())
+
+    src = ReplayableMemorySource(SCHEMA)
+    q = start(src)
+    try:
+        for b in batches:
+            src.add(b)
+            q.processAllAvailable()
+        assert any(f.startswith("delta-") for f in os.listdir(ckpt)), \
+            "no changelog deltas were written between snapshots"
+        live = dict(q._store.rows)
+    finally:
+        q.stop()
+    src2 = ReplayableMemorySource(SCHEMA)
+    for b in batches:
+        src2.add(b)
+    q2 = start(src2)
+    try:
+        assert q2._store is not None
+        assert dict(q2._store.rows) == live
+    finally:
+        q2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Epoch-tagged shuffle channels: data-plane barrier units
+# ---------------------------------------------------------------------------
+
+def test_epoch_tagged_streams_are_isolated():
+    """The stream store serves a channel only for the exact epoch its
+    producer sealed; a stale epoch's channels are inert."""
+    from sail_tpu.exec import shuffle as sh
+    from sail_tpu.exec.cluster import _StreamStore
+
+    t1 = pa.table({"x": pa.array([1, 2], type=pa.int64())})
+    t2 = pa.table({"x": pa.array([3], type=pa.int64())})
+    b1, b2 = sh.encode_table(t1), sh.encode_table(t2)
+    store = _StreamStore(memory_cap_bytes=1 << 20)
+    store.put("job", 0, 0, {0: b1}, epoch=1)
+    assert store.get("job", 0, 0, 0, epoch=1) == b1
+    # an epoch the producer never sealed serves NOTHING — the consumer's
+    # NOT_FOUND fetch-failed path owns it, not a silent wrong-epoch read
+    assert store.get("job", 0, 0, 0, epoch=2) is None
+    assert store.open_all_chunks("job", 0, 0, epoch=2) is None
+    # republishing under the next epoch moves the seal: the old epoch's
+    # channels become unreachable even though their bytes still exist
+    store.put("job", 0, 0, {0: b2}, epoch=2)
+    assert store.get("job", 0, 0, 0, epoch=2) == b2
+    assert store.get("job", 0, 0, 0, epoch=1) is None
+    # job cleanup (each trigger's run_job finally) wipes every epoch's
+    # channels and seals at once
+    store.put("job", 1, 0, {0: b1}, epoch=1)
+    store.clean_job("job")
+    assert store.get("job", 1, 0, 0, epoch=1) is None
+    assert store.get("job", 0, 0, 0, epoch=2) is None
+
+
+def test_same_job_id_and_epoch_runs_distinct_graphs(spark):
+    """One streaming trigger may dispatch SEVERAL different job graphs
+    under its stable job id and single epoch (the incremental path runs
+    the delta-aggregate plan, then the residual plan). The driver's
+    fragment encode-memo must never serve graph A's stage fragment to
+    graph B's same-numbered stage."""
+    import pandas as pd
+
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.sql import parse_one
+
+    a = pd.DataFrame({"k": [i % 5 for i in range(4000)],
+                      "v": list(range(4000))})
+    b = pd.DataFrame({"g": [i % 3 for i in range(3000)],
+                      "w": list(range(3000))})
+    spark.createDataFrame(a).createOrReplaceTempView("fca")
+    spark.createDataFrame(b).createOrReplaceTempView("fcb")
+    plan_a = spark._resolve(parse_one(
+        "SELECT k, sum(v) AS s FROM fca GROUP BY k"))
+    plan_b = spark._resolve(parse_one(
+        "SELECT g, count(w) AS c FROM fcb GROUP BY g"))
+    c = LocalCluster(num_workers=2)
+    try:
+        ra = c.run_job(plan_a, num_partitions=3, job_id="sq-fragcache",
+                       epoch=1, timeout=120)
+        rb = c.run_job(plan_b, num_partitions=3, job_id="sq-fragcache",
+                       epoch=1, timeout=120)
+    finally:
+        c.stop()
+    want_a = a.groupby("k", as_index=False)["v"].sum() \
+        .rename(columns={"v": "s"})
+    want_b = b.groupby("g", as_index=False)["w"].count() \
+        .rename(columns={"w": "c"})
+    got_a = ra.to_pandas().sort_values("k").reset_index(drop=True)
+    got_b = rb.to_pandas().sort_values("g").reset_index(drop=True)
+    assert got_a.equals(want_a.sort_values("k").reset_index(drop=True))
+    assert got_b.astype({"c": "int64"}).equals(
+        want_b.sort_values("g").reset_index(drop=True).astype(
+            {"c": "int64"}))
+
+
+def test_epoch_zero_is_plain_batch_default():
+    """Non-streaming jobs (epoch 0) keep the old contract untouched."""
+    from sail_tpu.exec import shuffle as sh
+    from sail_tpu.exec.cluster import _StreamStore
+
+    t = pa.table({"x": pa.array([7], type=pa.int64())})
+    b = sh.encode_table(t)
+    store = _StreamStore(memory_cap_bytes=1 << 20)
+    store.put("j", 0, 0, {0: b, 1: b})
+    assert store.get("j", 0, 0, 0) == b
+    assert store.get("j", 0, 0, 1) == b
+    assert b"".join(store.open_all_chunks("j", 0, 0)) == b + b
+
+
+# ---------------------------------------------------------------------------
+# The epoch-aligned cluster run: exactly-once through the shuffle plane
+# ---------------------------------------------------------------------------
+
+def _drive_cluster(spark, cluster, batches, out_dir, ckpt, spec=None,
+                   seed=21):
+    def make_query(fed):
+        src = ReplayableMemorySource(SCHEMA)
+        for b in batches[:fed]:
+            src.add(b)
+        df = DataFrame(_StreamRead("clsrc", src), spark) \
+            .groupBy("k").sum("v")
+        q = (df.writeStream.outputMode("complete").format("parquet")
+             .option("checkpointLocation", ckpt).cluster(cluster)
+             .start(out_dir))
+        return src, q
+
+    return _drive(make_query, lambda src, i: src.add(batches[i]),
+                  len(batches), spec=spec, seed=seed)
+
+
+def test_cluster_epoch_aligned_exactly_once_chaos(spark, tmp_path,
+                                                  monkeypatch):
+    """The acceptance run: a streaming aggregate whose every trigger is
+    a distributed job over the epoch-tagged shuffle plane, killed by a
+    worker crash, a dropped shuffle fetch, AND a sink failure (which
+    restarts the whole query so epoch 1 re-runs through the cluster
+    under the same epoch id) — total sink output byte-identical to the
+    fault-free cluster run."""
+    from sail_tpu.exec.cluster import LocalCluster
+
+    monkeypatch.setenv("SAIL_CLUSTER__WORKER_HEARTBEAT_TIMEOUT_SECS",
+                       "2")
+    batches = _batches(n=3, rows=120)
+    clean_out = str(tmp_path / "clean_out")
+    c = LocalCluster(num_workers=2)
+    try:
+        restarts, _ = _drive_cluster(spark, c, batches, clean_out,
+                                     str(tmp_path / "clean_ckpt"))
+    finally:
+        c.stop()
+    assert restarts == 0
+    clean = _read_parts(clean_out)
+    assert len(clean) == 3
+
+    chaos_out = str(tmp_path / "chaos_out")
+    spec = ("worker.task_exec:worker-1*=crash#1;"
+            "shuffle.fetch:*c[0-9]*=error(not_found)#1;"
+            "streaming.sink:commit:e1=error#1;"
+            "streaming.source=delay(0.02)@0.3")
+    c = LocalCluster(num_workers=2)
+    try:
+        restarts, counts = _drive_cluster(spark, c, batches, chaos_out,
+                                          str(tmp_path / "chaos_ckpt"),
+                                          spec=spec)
+    finally:
+        c.stop()
+        faults.reset()
+    assert restarts >= 1, "the sink kill must force a query restart"
+    assert counts.get("worker.task_exec") == 1
+    assert counts.get("shuffle.fetch") == 1
+    assert counts.get("streaming.sink") == 1
+    _assert_identical(_read_parts(chaos_out), clean)
