@@ -129,6 +129,11 @@ def _profile_summary():
                 "filters_pushed": prof.rtf_pushed,
                 "rows_pruned": prof.rtf_rows_pruned,
             }
+        # critical-path category breakdown (flight-data recorder):
+        # event-derived for cluster queries, phase-derived locally
+        cp = prof.critical_path_summary()
+        if cp is not None:
+            out["critical_path"] = cp
         return out
     except Exception:  # noqa: BLE001 — profiling must never fail a bench
         return None
@@ -466,6 +471,12 @@ def _run_shuffle_bench(spark) -> dict:
             t0 = time.perf_counter()
             c.run_job(plan, num_partitions=4, timeout=240)
             out["queries"][q] = round(time.perf_counter() - t0, 4)
+            # event-derived critical-path categories for the cluster
+            # run (which fetch/task/compile actually gated the query)
+            prof = _profile_summary()
+            if prof and prof.get("critical_path"):
+                out.setdefault("critical_path", {})[q] = \
+                    prof["critical_path"]
             print(f"bench: shuffle q{q} = {out['queries'][q]}",
                   file=sys.stderr, flush=True)
         # fetch-overlap A/B: the same warm queries with sequential
@@ -728,6 +739,16 @@ def main():
         .strip().lower() in ("1", "true", "yes")
     if disable_aqe:
         os.environ["SAIL_ADAPTIVE__ENABLED"] = "false"
+    # A/B knob: SAIL_BENCH_DISABLE_EVENTS=1 turns the flight-data
+    # recorder off for the whole run — the event-emission overhead
+    # check (acceptance: ≤ 2% on q1/q6 wall-clock) compares this run
+    # against the default
+    disable_events = os.environ.get("SAIL_BENCH_DISABLE_EVENTS", "0") \
+        .strip().lower() in ("1", "true", "yes")
+    if disable_events:
+        os.environ["SAIL_TELEMETRY__EVENTS_ENABLED"] = "0"
+        from sail_tpu import events as _events
+        _events.reload()
     try:
         best, rows, scanned, q1_profile = _run_q1(spark, sf)
     except Exception as e:  # noqa: BLE001 — fall back to SF1 rather than die
@@ -749,6 +770,7 @@ def main():
         "shuffle_compression": "disabled" if disable_shuffle_comp
         else "enabled",
         "adaptive": "disabled" if disable_aqe else "enabled",
+        "events": "disabled" if disable_events else "enabled",
         "tpu_probe": probe_info,
     }
     # the 22-query and ClickBench artifacts always record, inside the

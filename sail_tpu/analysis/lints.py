@@ -780,6 +780,167 @@ def lint_metrics(ctx: LintContext) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# event-vocabulary drift (the metrics lint's shape, for the flight-data
+# recorder: sail_tpu/events.py)
+# ---------------------------------------------------------------------------
+
+#: envelope kwargs emit() owns — never part of a type's declared attrs
+_EVENT_RESERVED_KWARGS = {"query_id", "trace_id", "ts"}
+
+
+def declared_event_types(ctx: LintContext) -> Dict[str, Set[str]]:
+    """EVENT_TYPES from sail_tpu/events.py: type name → attribute set
+    (AST literal walk — the lint must work on seeded tree copies that
+    are not importable)."""
+    tree = ctx.tree("sail_tpu/events.py")
+    if tree is None:
+        return {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        else:
+            continue
+        if "EVENT_TYPES" not in targets or \
+                not isinstance(node.value, ast.Dict):
+            continue
+        out: Dict[str, Set[str]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            name = _fold_str(k) if k is not None else None
+            if name is None or not isinstance(v, (ast.Tuple, ast.List)):
+                continue
+            attrs = {_fold_str(e) for e in v.elts}
+            if None in attrs:
+                continue
+            out[name] = attrs
+        return out
+    return {}
+
+
+def declared_event_symbols(ctx: LintContext) -> Dict[str, str]:
+    """``EventType`` class attributes: symbol → type-name string."""
+    tree = ctx.tree("sail_tpu/events.py")
+    if tree is None:
+        return {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "EventType":
+            out: Dict[str, str] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and \
+                        len(stmt.targets) == 1 and \
+                        isinstance(stmt.targets[0], ast.Name):
+                    value = _fold_str(stmt.value)
+                    if value is not None:
+                        out[stmt.targets[0].id] = value
+            return out
+    return {}
+
+
+def _event_type_symbol(node: ast.AST) -> Optional[str]:
+    """The ``X`` of an ``EventType.X`` / ``mod.EventType.X`` first
+    argument, else None."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    base = node.value
+    if isinstance(base, ast.Name) and base.id == "EventType":
+        return node.attr
+    if isinstance(base, ast.Attribute) and base.attr == "EventType":
+        return node.attr
+    return None
+
+
+def event_call_sites(ctx: LintContext
+                     ) -> List[Tuple[str, Optional[Tuple[str, ...]],
+                                     str, int]]:
+    """(EventType symbol, kwarg attribute keys or None for **kwargs,
+    relpath, line) for every ``emit(EventType.X, ...)`` call."""
+    out = []
+    for relpath in ctx.python_sources():
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _call_name(node) != "emit":
+                continue
+            symbol = _event_type_symbol(node.args[0])
+            if symbol is None:
+                continue
+            has_star = any(kw.arg is None for kw in node.keywords)
+            attrs = tuple(sorted(
+                kw.arg for kw in node.keywords
+                if kw.arg is not None
+                and kw.arg not in _EVENT_RESERVED_KWARGS))
+            out.append((symbol, None if has_star else attrs,
+                        relpath, node.lineno))
+    return out
+
+
+def lint_events(ctx: LintContext) -> List[Violation]:
+    """Flight-recorder vocabulary drift: every ``emit(EventType.X)``
+    site uses a declared type with declared attributes; every declared
+    type is emitted somewhere; symbols ↔ EVENT_TYPES agree."""
+    declared = declared_event_types(ctx)
+    symbols = declared_event_symbols(ctx)
+    out: List[Violation] = []
+    if not declared:
+        return [Violation("events", "sail_tpu/events.py", 0,
+                          "EVENT_TYPES missing or not a literal dict")]
+    for sym, name in sorted(symbols.items()):
+        if name not in declared:
+            out.append(Violation(
+                "events", "sail_tpu/events.py", 0,
+                f"EventType.{sym} = {name!r} has no EVENT_TYPES "
+                f"declaration"))
+    sym_values = set(symbols.values())
+    for name in sorted(declared):
+        if name not in sym_values:
+            out.append(Violation(
+                "events", "sail_tpu/events.py", 0,
+                f"event type {name!r} declared in EVENT_TYPES but has "
+                f"no EventType symbol"))
+    sites = event_call_sites(ctx)
+    emitted: Set[str] = set()
+    used_attrs: Dict[str, Set[str]] = {}
+    for sym, attrs, relpath, line in sites:
+        name = symbols.get(sym)
+        if name is None or name not in declared:
+            out.append(Violation(
+                "events", relpath, line,
+                f"emit(EventType.{sym}) uses an undeclared event type"))
+            continue
+        emitted.add(name)
+        if attrs is None:
+            continue  # **kwargs call: runtime validation owns it
+        extra = set(attrs) - declared[name]
+        if extra:
+            out.append(Violation(
+                "events", relpath, line,
+                f"event {name!r} emitted with undeclared attributes "
+                f"{sorted(extra)} (declared: "
+                f"{sorted(declared[name])})"))
+        used_attrs.setdefault(name, set()).update(attrs)
+    for name in sorted(declared):
+        if name not in emitted:
+            out.append(Violation(
+                "events", "sail_tpu/events.py", 0,
+                f"event type {name!r} declared but never emitted "
+                f"anywhere under sail_tpu/"))
+            continue
+        unused = declared[name] - used_attrs.get(name, set())
+        if unused:
+            out.append(Violation(
+                "events", "sail_tpu/events.py", 0,
+                f"event type {name!r} declares attributes "
+                f"{sorted(unused)} that no emit site passes"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # registry + runner
 # ---------------------------------------------------------------------------
 
@@ -791,6 +952,7 @@ LINTS: Dict[str, Callable[[LintContext], List[Violation]]] = {
     "sync-points": lint_sync_points,
     "locks": lint_locks,
     "metrics": lint_metrics,
+    "events": lint_events,
 }
 
 

@@ -195,10 +195,67 @@ class SystemRegistry:
                     "name": pa.array([r["name"] for r in rows]),
                     "type": pa.array([r["type"] for r in rows]),
                     "unit": pa.array([r["unit"] for r in rows]),
+                    "description": pa.array(
+                        [r["description"] for r in rows]),
                     "attributes": pa.array(
                         [r["attributes"] for r in rows]),
                     "value": pa.array([r["value"] for r in rows],
                                       pa.float64()),
+                })
+            if (database, name) == ("telemetry", "events"):
+                import json
+                from .. import events as ev
+                rows = ev.events()
+                reserved = set(ev.RESERVED_KEYS)
+                return pa.table({
+                    "seq": pa.array(
+                        [r.get("seq") for r in rows], pa.int64()),
+                    "ts": pa.array(
+                        [r.get("ts") for r in rows], pa.float64()),
+                    "type": pa.array([r.get("type") for r in rows]),
+                    "query_id": pa.array(
+                        [r.get("query_id", "") for r in rows]),
+                    "trace_id": pa.array(
+                        [r.get("trace_id") for r in rows]),
+                    "attributes": pa.array(
+                        [json.dumps({k: v for k, v in r.items()
+                                     if k not in reserved},
+                                    sort_keys=True, default=str)
+                         for r in rows]),
+                })
+            if (database, name) == ("telemetry", "task_timeline"):
+                from .. import events as ev
+                from ..analysis.timeline import task_timeline
+                rows = task_timeline(ev.events())
+                return pa.table({
+                    "query_id": pa.array(
+                        [r["query_id"] for r in rows]),
+                    "job_id": pa.array([r["job_id"] for r in rows]),
+                    "stage": pa.array(
+                        [r["stage"] for r in rows], pa.int32()),
+                    "partition": pa.array(
+                        [r["partition"] for r in rows], pa.int32()),
+                    "attempt": pa.array(
+                        [r["attempt"] for r in rows], pa.int32()),
+                    "worker": pa.array([r["worker"] for r in rows]),
+                    "state": pa.array([r["state"] for r in rows]),
+                    "dispatch_time": pa.array(
+                        [r["dispatch_time"] for r in rows],
+                        pa.float64()),
+                    "start_time": pa.array(
+                        [r["start_time"] for r in rows], pa.float64()),
+                    "finish_time": pa.array(
+                        [r["finish_time"] for r in rows],
+                        pa.float64()),
+                    "queue_ms": pa.array(
+                        [r["queue_ms"] for r in rows], pa.float64()),
+                    "run_ms": pa.array(
+                        [r["run_ms"] for r in rows], pa.float64()),
+                    "fetch_wait_ms": pa.array(
+                        [r["fetch_wait_ms"] for r in rows],
+                        pa.float64()),
+                    "rows_out": pa.array(
+                        [r["rows_out"] for r in rows], pa.int64()),
                 })
             if (database, name) == ("cluster", "workers"):
                 rows = list(self.workers.values())

@@ -45,12 +45,15 @@ deterministic per fault seed regardless of thread interleaving.
 
 from __future__ import annotations
 
+import json
 import math
 import statistics
 from typing import Dict, List, Optional, Set, Tuple
 
+from .. import events
 from ..config import get as config_get
 from ..config import truthy
+from ..events import EventType
 from ..metrics import record as _record_metric
 from ..plan import nodes as pn
 from . import job_graph as jg
@@ -99,6 +102,11 @@ class AdaptiveState:
         self.stages_done: Set[int] = set()      # completion transitions
         self.considered: Set[int] = set()       # coalesce/split evaluated
         self.reorder_done = False
+        # flight-recorder envelope of the owning job/query (stamped by
+        # _Job.__init__ and LocalCluster.run_job before submit)
+        self.job_id = ""
+        self.query_id = ""
+        self.trace_id: Optional[str] = None
         self.coalesced = 0
         self.split = 0
         self.broadcast = 0
@@ -112,9 +120,10 @@ class AdaptiveState:
                 "broadcast": self.broadcast, "reordered": self.reordered}
 
     def note(self, kind: str, **info) -> None:
-        if len(self.events) < 128:
-            event = {"kind": kind}
-            event.update(sorted(info.items()))
+        event = {"kind": kind}
+        event.update(sorted(info.items()))
+        recorded = len(self.events) < 128
+        if recorded:
             self.events.append(event)
         metric = _DECISION_METRICS.get(kind)
         if metric is not None:
@@ -122,6 +131,20 @@ class AdaptiveState:
                 _record_metric(metric, 1)
             except Exception:  # noqa: BLE001 — telemetry never fails a job
                 pass
+        # the decision record rides the event log as canonical JSON;
+        # the emission honors the SAME 128-entry cap as the profile's
+        # decision list, so replaying the log reconstructs the
+        # profile's sequence bit-identically even for pathological
+        # jobs that overflow it
+        if not recorded:
+            return
+        try:
+            events.emit(EventType.ADAPTIVE_APPLIED,
+                        query_id=self.query_id, trace_id=self.trace_id,
+                        job_id=self.job_id, kind=kind,
+                        detail=json.dumps(event, sort_keys=True))
+        except Exception:  # noqa: BLE001 — telemetry never fails a job
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -403,6 +426,15 @@ def _apply_rewrite(job, kind: str, touched: Set[int], fn) -> bool:
     except Exception:  # noqa: BLE001 — a refused rewrite must not fail the job
         for sid, snap in saved.items():
             _restore(graph.stages[sid], snap)
+        st = job.adaptive
+        try:
+            events.emit(EventType.ADAPTIVE_ROLLBACK,
+                        query_id=st.query_id, trace_id=st.trace_id,
+                        job_id=st.job_id, kind=kind,
+                        stages=",".join(str(s)
+                                        for s in sorted(touched)))
+        except Exception:  # noqa: BLE001
+            pass
         return False
     return True
 

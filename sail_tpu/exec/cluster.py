@@ -22,6 +22,7 @@ SURVEY.md §2.5/§3.3). Shape:
 from __future__ import annotations
 
 import itertools
+import json
 import os
 import random
 import statistics
@@ -39,8 +40,10 @@ from .proto import control_plane_pb2 as pb
 from .actor import Actor
 from . import job_graph as jg
 from . import shuffle as sh
+from .. import events
 from .. import faults
 from .. import tracing as tr
+from ..events import EventType
 from ..io.prefetch import MultiPrefetcher
 from ..metrics import record as _record_metric
 
@@ -310,7 +313,7 @@ def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
     from disk without rehydrating under the memory cap (reference:
     stream_service/server.rs record-batch streams)."""
 
-    def fetch(request: pb.FetchStreamRequest, context):
+    def resolve(request: pb.FetchStreamRequest, context):
         if request.scan_id:
             tables = scan_tables() if scan_tables is not None else {}
             entry = tables.get((request.job_id, request.scan_id))
@@ -348,6 +351,19 @@ def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
                     f"stage={request.stage} "
                     f"partition={request.partition} "
                     f"channel={request.channel}")
+        return chunks
+
+    def fetch(request: pb.FetchStreamRequest, context):
+        # the channel lookup runs under a server span parented on the
+        # caller's traceparent (the span must not wrap the yields: gRPC
+        # may resume the generator on another thread and the span stack
+        # is thread-local)
+        parent = tr.extract_context(context.invocation_metadata())
+        with tr.span(f"serve:fetch s{request.stage}"
+                     f"p{request.partition}",
+                     {"job_id": request.job_id,
+                      "channel": request.channel}, parent=parent):
+            chunks = resolve(request, context)
         # one-chunk lookahead so the final data chunk carries last=True
         prev: Optional[bytes] = None
         for chunk in chunks:
@@ -581,7 +597,10 @@ class WorkerActor(Actor):
 
     # -- task execution --------------------------------------------------
     def _fetch_inputs(self, task: pb.TaskDefinition,
-                      stats: Optional[sh.FetchStats] = None):
+                      stats: Optional[sh.FetchStats] = None,
+                      collector: Optional[
+                          events.TaskEventCollector] = None,
+                      parent: Optional[tr.SpanContext] = None):
         """Pull ALL upstream stage outputs over the peer data plane
         CONCURRENTLY: every (producer partition, channel) of every input
         streams through one bounded multi-producer prefetch pool
@@ -619,16 +638,45 @@ class WorkerActor(Actor):
 
         def fetch_one(item):
             stage_id, _pos, up_part, chan, addr = item
+            if collector is not None:
+                collector.emit(EventType.FETCH_BEGIN,
+                               job_id=task.job_id, stage=stage_id,
+                               partition=up_part, channel=chan,
+                               addr=addr, dst_stage=task.stage,
+                               dst_partition=task.partition)
+            t0 = time.perf_counter()
+            ok = False
+            nbytes = 0
             try:
-                return _fetch_table(addr, pb.FetchStreamRequest(
-                    job_id=task.job_id, stage=stage_id,
-                    partition=up_part, channel=chan,
-                    epoch=task.epoch), _WORKER_SERVICE,
-                    stats=stats)
+                # the span opens ON the prefetch-pool thread with the
+                # task span as explicit parent, so the fetch RPC's
+                # traceparent (injected from this thread's stack inside
+                # _fetch_table) chains worker:task → worker:fetch →
+                # serve:fetch end to end
+                with tr.span(f"worker:fetch s{stage_id}p{up_part}",
+                             {"job_id": task.job_id, "channel": chan},
+                             parent=parent):
+                    table = _fetch_table(addr, pb.FetchStreamRequest(
+                        job_id=task.job_id, stage=stage_id,
+                        partition=up_part, channel=chan,
+                        epoch=task.epoch), _WORKER_SERVICE,
+                        stats=stats)
+                ok = True
+                nbytes = int(table.nbytes)
+                return table
             except faults.WorkerCrash:
                 raise
             except (grpc.RpcError, faults.FaultInjectedError) as e:
                 raise _FetchFailed(stage_id, up_part) from e
+            finally:
+                if collector is not None:
+                    collector.emit(
+                        EventType.FETCH_END, job_id=task.job_id,
+                        stage=stage_id, partition=up_part, channel=chan,
+                        addr=addr, dst_stage=task.stage,
+                        dst_partition=task.partition, bytes=nbytes,
+                        ms=round((time.perf_counter() - t0) * 1000.0,
+                                 3), ok=ok)
 
         parts: Dict[int, Dict[int, object]] = {}
         mp = MultiPrefetcher(work, fetch_one,
@@ -672,11 +720,19 @@ class WorkerActor(Actor):
         if ev is None:
             ev = threading.Event()
         fetch_stats = sh.FetchStats()
+        # per-task flight-recorder buffer: execution + fetch threads
+        # emit here; the TERMINAL status report ships the drained
+        # buffer to the driver's cluster-wide event log
+        recorder = events.TaskEventCollector()
         try:
             faults.inject("worker.task_exec",
                           key=f"{self.worker_id}:s{task.stage}"
                               f"p{task.partition}")
             self._report(task, "running")
+            recorder.emit(EventType.TASK_START, job_id=task.job_id,
+                          stage=task.stage, partition=task.partition,
+                          attempt=task.attempt, worker=self.worker_id)
+            span_ctx = tr._current()
             plan = jg.decode_fragment(task.plan, task.partition,
                                       max(task.num_partitions, 1))
             plan = _resolve_driver_scans(plan, task, fetch_stats)
@@ -688,9 +744,11 @@ class WorkerActor(Actor):
                     plan, task.runtime_filters_json)
             if task.inputs:
                 plan = jg.attach_stage_inputs(
-                    plan, self._fetch_inputs(task, fetch_stats))
+                    plan, self._fetch_inputs(task, fetch_stats,
+                                             collector=recorder,
+                                             parent=span_ctx))
             if ev.is_set():
-                self._report(task, "canceled")
+                self._report(task, "canceled", recorder=recorder)
                 return
             metrics_json = ""
             if _task_metrics_enabled():
@@ -699,7 +757,8 @@ class WorkerActor(Actor):
                 import json as _json
 
                 from .. import telemetry as tel
-                with tel.collect_metrics() as collector:
+                with tel.collect_metrics() as collector, \
+                        events.collecting(recorder):
                     table = LocalExecutor().execute(plan)
                 try:
                     metrics_json = _json.dumps(
@@ -707,11 +766,12 @@ class WorkerActor(Actor):
                 except (TypeError, ValueError):
                     metrics_json = ""
             else:
-                table = LocalExecutor().execute(plan)
+                with events.collecting(recorder):
+                    table = LocalExecutor().execute(plan)
             if ev.is_set():
                 # canceled while executing (job cancel / speculation
                 # loser): do not publish partial shuffle outputs
-                self._report(task, "canceled")
+                self._report(task, "canceled", recorder=recorder)
                 return
             if task.HasField("shuffle_write") and \
                     task.shuffle_write.num_channels > 1:
@@ -734,7 +794,7 @@ class WorkerActor(Actor):
                          metrics_json=metrics_json,
                          channel_bytes=channel_bytes,
                          raw_bytes=int(table.nbytes),
-                         fetch_stats=fetch_stats)
+                         fetch_stats=fetch_stats, recorder=recorder)
         except faults.WorkerCrash:
             # injected process death: no failure report, no cleanup — the
             # driver's heartbeat eviction path must pick up the pieces
@@ -743,9 +803,11 @@ class WorkerActor(Actor):
             # a producer's streams are gone (dead peer): the driver re-runs
             # the producer and re-schedules this task, not as our failure
             self._report(task, "failed",
-                         error=f"FETCH_FAILED:{e.stage_id}:{e.partition}")
+                         error=f"FETCH_FAILED:{e.stage_id}:{e.partition}",
+                         recorder=recorder)
         except Exception as e:  # noqa: BLE001 — full cause goes to the driver
-            self._report(task, "failed", error=f"{type(e).__name__}: {e}")
+            self._report(task, "failed", error=f"{type(e).__name__}: {e}",
+                         recorder=recorder)
         finally:
             with self._running_lock:
                 evs = self._running.get(key)
@@ -761,12 +823,24 @@ class WorkerActor(Actor):
                 rows: int = 0, metrics_json: str = "",
                 channel_bytes: Optional[List[int]] = None,
                 raw_bytes: int = 0,
-                fetch_stats: Optional[sh.FetchStats] = None):
+                fetch_stats: Optional[sh.FetchStats] = None,
+                recorder: Optional[events.TaskEventCollector] = None):
         """Report task status with backoff retries: a worker that cannot
         reach the driver for one transient blip must not lose a finished
         task's result until heartbeat eviction re-runs it from scratch."""
         if self._crashed:
             return
+        events_json: List[str] = []
+        if recorder is not None and state in ("succeeded", "failed",
+                                              "canceled"):
+            # worker events piggyback on the TERMINAL report only: the
+            # driver dedupes terminal reports (at-least-once delivery),
+            # so the shipped buffer merges exactly once
+            try:
+                events_json = [json.dumps(e, default=str)
+                               for e in recorder.drain()]
+            except (TypeError, ValueError):
+                events_json = []
         try:
             self._call_driver("ReportTaskStatus", pb.ReportTaskStatusRequest(
                 worker_id=self.worker_id, job_id=task.job_id,
@@ -776,7 +850,8 @@ class WorkerActor(Actor):
                 channel_bytes=channel_bytes or [],
                 raw_bytes=int(raw_bytes),
                 fetch_wait_s=fetch_stats.wait_s if fetch_stats else 0.0,
-                decode_s=fetch_stats.decode_s if fetch_stats else 0.0),
+                decode_s=fetch_stats.decode_s if fetch_stats else 0.0,
+                events_json=events_json),
                 pb.ReportTaskStatusResponse)
         except faults.WorkerCrash:
             self._die()
@@ -848,6 +923,14 @@ class _Job:
                  trace_ctx=None, epoch: int = 0):
         self.job_id = job_id
         self.graph = graph
+        # flight-recorder envelope: the owning query's profile id,
+        # stamped before submit so every driver/worker event of this
+        # job carries it (empty for bare run_job calls until the
+        # profile opens)
+        self.query_id = ""
+        # stages whose STAGE_SUBMIT event already fired (a pipelined
+        # stage launches per partition but submits once)
+        self.stage_submitted: Set[int] = set()
         # fragment-cache namespace: unique per SUBMISSION, never reused.
         # job_id+epoch is not enough — a streaming trigger may dispatch
         # several different job graphs under one (job_id, epoch) (e.g.
@@ -924,6 +1007,27 @@ class _Job:
         # stage-completion transitions already processed
         from . import adaptive as _aqe
         self.adaptive = _aqe.AdaptiveState()
+        self.adaptive.job_id = job_id
+
+
+def _jtrace(job: "_Job") -> Optional[str]:
+    """The trace id every event of a job carries (None for bare jobs)."""
+    return job.trace_ctx.trace_id if job.trace_ctx is not None else None
+
+
+def _note_stage_submit(job: "_Job", stage, pipelined: bool) -> None:
+    """STAGE_SUBMIT fires once per stage even when a pipelined stage
+    launches per partition. Module-level (not a DriverActor method):
+    scheduling-logic tests drive ``_schedule_ready_stages`` against
+    minimal driver stubs."""
+    if stage.stage_id in job.stage_submitted:
+        return
+    job.stage_submitted.add(stage.stage_id)
+    events.emit(EventType.STAGE_SUBMIT, query_id=job.query_id,
+                trace_id=_jtrace(job), job_id=job.job_id,
+                stage=stage.stage_id,
+                partitions=stage.num_partitions,
+                pipelined=pipelined)
 
 
 class DriverActor(Actor):
@@ -1209,6 +1313,8 @@ class DriverActor(Actor):
         if w is None:
             return
         _record_metric("cluster.worker_count", len(self.workers))
+        events.emit(EventType.WORKER_EVICT, query_id="", worker=wid,
+                    reason=reason)
         try:
             w["channel"].close()
         except Exception:  # noqa: BLE001 — eviction must not fail
@@ -1402,6 +1508,7 @@ class DriverActor(Actor):
                         continue
                     if self._partition_ready(job, stage, partition):
                         job.launched.add(key)
+                        _note_stage_submit(job, stage, True)
                         self._launch_task(job, stage.stage_id, partition, 0)
                 continue
             if stage.stage_id in job.scheduled:
@@ -1409,6 +1516,7 @@ class DriverActor(Actor):
             if all(self._stage_complete(job, i.stage_id)
                    for i in stage.inputs):
                 job.scheduled.add(stage.stage_id)
+                _note_stage_submit(job, stage, False)
                 for partition in range(stage.num_partitions):
                     self._launch_task(job, stage.stage_id, partition, 0)
         root = job.graph.root
@@ -1516,6 +1624,11 @@ class DriverActor(Actor):
                         frozenset(exclude) if exclude else None))
                     job.governor_deferred += 1
                     _record_metric("cluster.governor.deferred_count", 1)
+                    events.emit(EventType.GOVERNOR_DEFER,
+                                query_id=job.query_id,
+                                trace_id=_jtrace(job),
+                                job_id=job.job_id, stage=stage_id,
+                                partition=partition, attempt=attempt)
                     return True  # parked: _drain_deferred relaunches
                 candidates = admissible
             wid, w = candidates[0]
@@ -1530,6 +1643,11 @@ class DriverActor(Actor):
                 _record_metric("cluster.governor.admitted_count", 1)
                 _record_metric("cluster.governor.projected_bytes",
                                w["projected"])
+                events.emit(EventType.GOVERNOR_ADMIT,
+                            query_id=job.query_id,
+                            trace_id=_jtrace(job), job_id=job.job_id,
+                            stage=stage_id, partition=partition,
+                            worker=wid, projected_bytes=int(proj))
             rpc = w["channel"].unary_unary(
                 f"/{_WORKER_SERVICE}/RunTask",
                 request_serializer=lambda m: m.SerializeToString(),
@@ -1563,6 +1681,13 @@ class DriverActor(Actor):
                 job.seen_reports = {
                     rk for rk in job.seen_reports
                     if rk[:3] != (stage_id, partition, attempt)}
+                events.emit(
+                    EventType.TASK_DISPATCH, query_id=job.query_id,
+                    trace_id=_jtrace(job), job_id=job.job_id,
+                    stage=stage_id, partition=partition,
+                    attempt=attempt, worker=wid,
+                    reason=reason or ("speculative" if speculative
+                                      else ""))
                 return True
             except (grpc.RpcError, faults.FaultInjectedError):
                 # dispatch failure = dead worker: evict it (rescheduling
@@ -1603,6 +1728,20 @@ class DriverActor(Actor):
             if rk in job.seen_reports:
                 return
             job.seen_reports.add(rk)
+            # merge the worker's shipped task events into the cluster-
+            # wide log, stamped with the owning query's envelope (the
+            # dedupe above makes the merge exactly-once despite
+            # at-least-once report delivery)
+            task_label = f"{r.job_id}/s{r.stage}p{r.partition}" \
+                         f"a{r.attempt}"
+            for blob in r.events_json:
+                try:
+                    record = json.loads(blob)
+                except ValueError:
+                    continue
+                events.EVENT_LOG.ingest(record, query_id=job.query_id,
+                                        trace_id=_jtrace(job),
+                                        task=task_label)
             if w is not None:
                 self._release_task(w, (r.job_id, r.stage, r.partition))
                 if not w["tasks"]:
@@ -1636,6 +1775,11 @@ class DriverActor(Actor):
                     r.attempt == job.spec_attempt.get(key):
                 job.spec_won += 1
                 _record_metric("cluster.task.speculative_won", 1)
+                events.emit(EventType.SPECULATION_WIN,
+                            query_id=job.query_id,
+                            trace_id=_jtrace(job), job_id=job.job_id,
+                            stage=r.stage, partition=r.partition,
+                            attempt=r.attempt)
             # data-movement metadata from the winning attempt: feeds the
             # governor's projections and the profile's shuffle line
             if r.channel_bytes:
@@ -1646,6 +1790,14 @@ class DriverActor(Actor):
             job.fetch_wait_s += float(r.fetch_wait_s)
             job.decode_s += float(r.decode_s)
             job.locations[r.stage][r.partition] = w["addr"]
+            events.emit(EventType.TASK_FINISH, query_id=job.query_id,
+                        trace_id=_jtrace(job), job_id=job.job_id,
+                        stage=r.stage, partition=r.partition,
+                        attempt=r.attempt, worker=r.worker_id,
+                        state="succeeded", rows=int(r.rows_out),
+                        fetch_wait_ms=round(
+                            float(r.fetch_wait_s) * 1000.0, 3),
+                        error="")
             # delta update keeps the per-(stage,partition) idempotent
             # overwrite (a producer re-run replaces, never double-counts)
             # without rescanning every stage's rows per report
@@ -1667,6 +1819,14 @@ class DriverActor(Actor):
             self._schedule_ready_stages(job)
         elif r.state == "failed":
             live.pop(r.attempt, None)
+            events.emit(EventType.TASK_FINISH, query_id=job.query_id,
+                        trace_id=_jtrace(job), job_id=job.job_id,
+                        stage=r.stage, partition=r.partition,
+                        attempt=r.attempt, worker=r.worker_id,
+                        state="failed", rows=0,
+                        fetch_wait_ms=round(
+                            float(r.fetch_wait_s) * 1000.0, 3),
+                        error=r.error[:200])
             if r.error.startswith("FETCH_FAILED:"):
                 _, s, p = r.error.split(":")
                 up_stage, up_part = int(s), int(p)
@@ -1703,6 +1863,12 @@ class DriverActor(Actor):
                               reason="failure", exclude={r.worker_id})
         elif r.state == "canceled":
             live.pop(r.attempt, None)
+            events.emit(EventType.TASK_FINISH, query_id=job.query_id,
+                        trace_id=_jtrace(job), job_id=job.job_id,
+                        stage=r.stage, partition=r.partition,
+                        attempt=r.attempt, worker=r.worker_id,
+                        state="canceled", rows=0, fetch_wait_ms=0.0,
+                        error="")
 
     def _maybe_adapt(self, job: _Job, stage_id: int):
         """Stage-boundary replanning hook: fires EXACTLY ONCE per stage
@@ -1716,6 +1882,10 @@ class DriverActor(Actor):
         if stage_id in job.adaptive.stages_done:
             return
         job.adaptive.stages_done.add(stage_id)
+        events.emit(EventType.STAGE_COMPLETE, query_id=job.query_id,
+                    trace_id=_jtrace(job), job_id=job.job_id,
+                    stage=stage_id,
+                    rows=int(job.stage_rows.get(stage_id, 0)))
         try:
             from . import adaptive as aqe
             aqe.on_stage_complete(self, job, stage_id)
@@ -1728,6 +1898,7 @@ class DriverActor(Actor):
         w = self.workers.get(wid)
         if w is None:
             return
+        job = self.jobs.get(job_id)
         rpc = w["channel"].unary_unary(
             f"/{_WORKER_SERVICE}/StopTask",
             request_serializer=lambda m: m.SerializeToString(),
@@ -1738,7 +1909,9 @@ class DriverActor(Actor):
             fut = rpc.future(
                 pb.StopTaskRequest(job_id=job_id, stage=stage,
                                    partition=partition, reason=reason),
-                timeout=10)
+                timeout=10,
+                metadata=tr.inject_context(
+                    job.trace_ctx if job is not None else None))
             fut.add_done_callback(lambda f: f.cancelled() or f.exception())
         except (grpc.RpcError, faults.FaultInjectedError):
             pass
@@ -1770,6 +1943,8 @@ class DriverActor(Actor):
             return
         self.quarantined[wid] = now + q["duration_s"]
         _record_metric("cluster.worker.quarantined_count", 1)
+        events.emit(EventType.WORKER_QUARANTINE, query_id="",
+                    worker=wid, failures=len(fails))
         self._evict_worker(wid, "quarantined")
         if self.elastic is not None:
             self._maybe_scale_up()
@@ -1849,6 +2024,14 @@ class DriverActor(Actor):
                         job.spec_launched += 1
                         _record_metric("cluster.task.speculative_launched",
                                        1)
+                        # ``worker`` is the STRAGGLER being raced; the
+                        # twin's worker rides its task_dispatch event
+                        events.emit(EventType.SPECULATION_LAUNCH,
+                                    query_id=job.query_id,
+                                    trace_id=_jtrace(job),
+                                    job_id=job.job_id, stage=s,
+                                    partition=p, attempt=new_att,
+                                    worker=live[att])
                     else:
                         job.attempt_allowance[(s, p)] -= 1
                         job.speculated.discard((s, p))
@@ -1884,6 +2067,7 @@ class DriverActor(Actor):
 
     def _cleanup_job(self, job_id: str):
         job = self.jobs.get(job_id)
+        trace_ctx = job.trace_ctx if job is not None else None
         if job is not None:
             from ..catalog.system import SYSTEM
             SYSTEM.record_job(job_id, len(job.graph.stages),
@@ -1896,7 +2080,8 @@ class DriverActor(Actor):
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=pb.CleanUpJobResponse.FromString)
             try:
-                rpc(pb.CleanUpJobRequest(job_id=job_id), timeout=10)
+                rpc(pb.CleanUpJobRequest(job_id=job_id), timeout=10,
+                    metadata=tr.inject_context(trace_ctx))
             except grpc.RpcError:
                 pass
 
@@ -2002,7 +2187,14 @@ class LocalCluster:
             # a standalone run_job still gets its own profile record.
             # Execute/fetch phases come from the root-stage executor —
             # total_ms additionally covers the distributed wait.
-            with profiler.profile_query(f"cluster job {job.job_id}"):
+            with profiler.profile_query(
+                    f"cluster job {job.job_id}") as prof:
+                # stamp the flight-recorder envelope BEFORE submit so
+                # every driver/worker event of this job carries the
+                # owning query's id and trace
+                job.query_id = prof.query_id
+                job.adaptive.query_id = prof.query_id
+                job.adaptive.trace_id = _jtrace(job)
                 return self._run_submitted(job, timeout)
 
     def _run_submitted(self, job, timeout):
@@ -2035,12 +2227,39 @@ class LocalCluster:
                     for p in range(
                         graph.stages[i.stage_id].num_partitions)]
 
+            root_sid = root.stage_id
+
             def fetch_one(item):
                 stage_id, p, addr = item
-                return _fetch_table(addr, pb.FetchStreamRequest(
-                    job_id=job.job_id, stage=stage_id, partition=p,
-                    channel=-1, epoch=job.epoch), _WORKER_SERVICE,
-                    stats=stats)
+                events.emit(EventType.FETCH_BEGIN,
+                            query_id=job.query_id,
+                            trace_id=_jtrace(job), job_id=job.job_id,
+                            stage=stage_id, partition=p, channel=-1,
+                            addr=addr, dst_stage=root_sid,
+                            dst_partition=-1)
+                t0 = time.perf_counter()
+                ok = False
+                nbytes = 0
+                try:
+                    with tr.span(f"driver:fetch s{stage_id}p{p}",
+                                 {"job_id": job.job_id},
+                                 parent=job.trace_ctx):
+                        table = _fetch_table(addr, pb.FetchStreamRequest(
+                            job_id=job.job_id, stage=stage_id,
+                            partition=p, channel=-1, epoch=job.epoch),
+                            _WORKER_SERVICE, stats=stats)
+                    ok = True
+                    nbytes = int(table.nbytes)
+                    return table
+                finally:
+                    events.emit(
+                        EventType.FETCH_END, query_id=job.query_id,
+                        trace_id=_jtrace(job), job_id=job.job_id,
+                        stage=stage_id, partition=p, channel=-1,
+                        addr=addr, dst_stage=root_sid, dst_partition=-1,
+                        bytes=nbytes,
+                        ms=round((time.perf_counter() - t0) * 1000.0,
+                                 3), ok=ok)
 
             parts: Dict[int, Dict[int, object]] = {}
             mp = MultiPrefetcher(work, fetch_one,
@@ -2092,6 +2311,17 @@ class LocalCluster:
                                    events=ad.events)
                 prof.note_skew(ad.skew)
                 prof.note_shuffle_channels(ad.channel_report)
+                # critical-path attribution: walk the task/fetch
+                # dependency edges this job's events recorded — the
+                # same computation sail_timeline.py runs offline on the
+                # durable log, so live and post-mortem views agree
+                if events.enabled():
+                    try:
+                        from ..analysis import timeline as _tl
+                        prof.critical_path = _tl.critical_path(
+                            events.events(query_id=prof.query_id))
+                    except Exception:  # noqa: BLE001 — attribution is advisory
+                        pass
             # observed-cardinality feedback: leaf-stage output rows keyed
             # by the scan subtree feed join_reorder / runtime-filter
             # estimates on repeat queries (real cardinalities, not just

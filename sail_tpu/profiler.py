@@ -116,6 +116,11 @@ class QueryProfile:
     streaming_replayed: bool = False
     rows_out: int = 0
     slow: bool = False
+    # critical-path attribution derived from the query's event stream
+    # (analysis/timeline.py): {"total_ms", "categories", "chain",
+    # "top"} — set by the cluster runner after the job completes, None
+    # for queries without a distributed task timeline
+    critical_path: Optional[dict] = None
     # operator metric trees (dicts, telemetry.OperatorMetrics.to_dict)
     operators: List[dict] = field(default_factory=list)
     # cluster mode: per-task operator metrics, one entry per
@@ -287,6 +292,28 @@ class QueryProfile:
         out.extend(sorted(phases.items()))
         return out
 
+    def critical_path_summary(self) -> Optional[dict]:
+        """Per-category wall-time attribution for the bench artifact:
+        the event-derived critical path when the query ran distributed,
+        else a phase-derived approximation for the local path (execute
+        split into compile / fetch-wait / compute)."""
+        if self.critical_path:
+            return {"derived": False,
+                    "categories": dict(
+                        self.critical_path.get("categories", {}))}
+        phases = {n: ms for n, ms in self.phase_items()}
+        if not phases:
+            return None
+        execute = float(phases.get("execute", 0.0))
+        compile_ms = min(execute, float(phases.get("compile", 0.0)))
+        fetch_wait = min(execute - compile_ms,
+                         float(self.shuffle_fetch_wait_ms))
+        cats = {"compute": round(execute - compile_ms - fetch_wait, 3),
+                "compile": round(compile_ms, 3),
+                "fetch-wait": round(fetch_wait, 3)}
+        return {"derived": True,
+                "categories": {c: ms for c, ms in cats.items() if ms}}
+
     def to_dict(self) -> dict:
         return {
             "query_id": self.query_id,
@@ -346,6 +373,7 @@ class QueryProfile:
             } if self.streaming_epoch >= 0 else None,
             "rows_out": self.rows_out,
             "slow": self.slow,
+            "critical_path": self.critical_path,
             "operators": list(self.operators),
             "tasks": list(self.tasks),
             "trace_id": self.trace_id,
@@ -416,6 +444,11 @@ class QueryProfile:
             lines.append(line)
         if self.validated_passes:
             lines.append(f"validated: {self.validated_passes} passes")
+        if self.critical_path:
+            from .analysis.timeline import render_critical_path
+            line = render_critical_path(self.critical_path)
+            if line:
+                lines.append(line)
         if self.tasks:
             from .telemetry import OperatorMetrics
             lines.append(f"tasks: {len(self.tasks)}")
@@ -552,6 +585,15 @@ def profile_query(statement: str = "", session: str = "", conf=None,
     _local.profile = profile
     FLIGHT_RECORDER.start(profile)
     try:
+        from . import events as _events
+        _events.emit(_events.EventType.QUERY_START,
+                     query_id=profile.query_id,
+                     trace_id=profile.trace_id,
+                     statement=profile.statement[:200],
+                     session=profile.session)
+    except Exception:  # noqa: BLE001 — telemetry must never break queries
+        pass
+    try:
         yield profile
     except BaseException as e:
         profile.status = "failed"
@@ -577,6 +619,15 @@ def _finalize(profile: QueryProfile, threshold_ms: float) -> None:
         _record_metric("execution.query_count", 1,
                        session=profile.session or "default")
     except Exception:  # noqa: BLE001 — telemetry must never break queries
+        pass
+    try:
+        from . import events as _events
+        _events.emit(_events.EventType.QUERY_END,
+                     query_id=profile.query_id,
+                     trace_id=profile.trace_id, status=profile.status,
+                     rows_out=profile.rows_out,
+                     total_ms=round(profile.total_ms, 3))
+    except Exception:  # noqa: BLE001
         pass
     try:
         if profile.slow:
@@ -607,6 +658,11 @@ def _finalize(profile: QueryProfile, threshold_ms: float) -> None:
                          profile.adaptive_reordered}
             for name, ms in profile.phase_items():
                 attrs[f"query.phase.{name}_ms"] = round(ms, 3)
+            if profile.critical_path:
+                # the gating chain rides the query span so the OTLP
+                # view and the event log cross-reference
+                attrs["query.critical_path"] = json.dumps(
+                    profile.critical_path, default=str)
             start_ns = int(profile.start_time * 1e9)
             end_ns = int((profile.end_time or profile.start_time) * 1e9)
             span = tr.Span(
@@ -649,6 +705,12 @@ def note_compile_cache(hit: bool) -> None:
 def note_compile_time(seconds: float, key: str = "") -> None:
     try:
         _record_metric("execution.compile.compile_time", float(seconds))
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from . import events as _events
+        _events.emit(_events.EventType.COMPILE, key=key[:120],
+                     ms=round(float(seconds) * 1000.0, 3))
     except Exception:  # noqa: BLE001
         pass
     profile = current_profile()

@@ -941,6 +941,14 @@ class SessionConf:
                  "spark.sail.adaptive.skew.factor"),
                 ("adaptive.broadcast.threshold_mb",
                  "spark.sail.adaptive.broadcast.thresholdMb"),
+                ("telemetry.events_enabled",
+                 "spark.sail.telemetry.eventsEnabled"),
+                ("telemetry.event_log.enabled",
+                 "spark.sail.telemetry.eventLog.enabled"),
+                ("telemetry.event_log.dir",
+                 "spark.sail.telemetry.eventLog.dir"),
+                ("telemetry.event_log.max_mb",
+                 "spark.sail.telemetry.eventLog.maxMb"),
                 ("faults.spec", "spark.sail.faults.spec"),
                 ("faults.seed", "spark.sail.faults.seed"),
                 ("analysis.validate_plans",

@@ -49,7 +49,9 @@ from typing import Callable, Dict, List, Optional
 
 import pyarrow as pa
 
+from . import events
 from . import faults
+from .events import EventType
 from .metrics import record as _record_metric
 from .spec import plan as sp
 
@@ -745,6 +747,7 @@ class StreamingQuery:
                 # the marker proves this epoch's output is final: the
                 # replay is a sink no-op, but state/offsets still advance
                 _record_metric("streaming.epoch.replayed_count", 1)
+                events.emit(EventType.EPOCH_REPLAY, epoch=epoch)
                 if self._checkpoint_dir:
                     self._write_checkpoint()
             else:
@@ -752,6 +755,8 @@ class StreamingQuery:
                 if result is not None:
                     faults.inject("streaming.sink", key=f"stage:e{epoch}")
                     self._sink.stage(epoch, result)
+                events.emit(EventType.EPOCH_STAGE, epoch=epoch,
+                            rows=rows)
                 if self._two_phase and self._sink.durable \
                         and self._checkpoint_dir:
                     # two-phase: the checkpoint records the epoch as
@@ -768,6 +773,9 @@ class StreamingQuery:
             commit_ms = (time.time() - commit_t0) * 1000.0
             _record_metric("streaming.epoch.commit_time",
                            commit_ms / 1000.0)
+            if not replayed:
+                events.emit(EventType.EPOCH_COMMIT, epoch=epoch,
+                            commit_ms=round(commit_ms, 3))
             state_rows = len(self._store.rows) \
                 if self._store is not None else \
                 (self._buffer.num_rows if self._buffer is not None else 0)

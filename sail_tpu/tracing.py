@@ -94,9 +94,13 @@ def span(name: str, attributes: Optional[Dict] = None,
 # W3C trace context over gRPC metadata
 # ---------------------------------------------------------------------------
 
-def inject_context() -> List[Tuple[str, str]]:
-    """Metadata to attach to an outgoing RPC (client layer)."""
-    ctx = _current()
+def inject_context(parent: Optional[SpanContext] = None
+                   ) -> List[Tuple[str, str]]:
+    """Metadata to attach to an outgoing RPC (client layer). An
+    explicit ``parent`` overrides the thread-local span — RPCs issued
+    from threads that never opened a span (the driver actor thread, a
+    fetch pool worker) still propagate the owning query's context."""
+    ctx = parent if parent is not None else _current()
     if ctx is None:
         return []
     return [("traceparent", f"00-{ctx.trace_id}-{ctx.span_id}-01")]
@@ -134,9 +138,11 @@ class OtlpHttpExporter:
     records to ``/v1/logs`` (the reference's log-export pipeline,
     sail-telemetry src/telemetry.rs)."""
 
-    #: seconds between overflow warnings (one line per outage burst, not
-    #: one per dropped span)
-    DROP_WARN_INTERVAL_S = 30.0
+    #: signals that already warned about buffer overflow — CLASS level,
+    #: so the warning dedupes per signal per PROCESS lifetime (a flappy
+    #: collector must not re-warn per exporter instance or per outage
+    #: burst; the dropped_count metric carries the ongoing tally)
+    _warned_signals: "set[str]" = set()
 
     def __init__(self, endpoint: str, service_name: str = "sail-tpu",
                  flush_interval_s: float = 1.0, max_batch: int = 512):
@@ -146,30 +152,36 @@ class OtlpHttpExporter:
         self._buf: List[Span] = []
         self._log_buf: List[LogEvent] = []
         self._buf_lock = threading.Lock()
-        self._last_drop_warn = 0.0
         self.dropped = {"spans": 0, "logs": 0}
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, args=(flush_interval_s,), daemon=True)
         self._thread.start()
 
+    @classmethod
+    def reset_drop_warnings(cls):
+        """Forget which signals already warned (tests only)."""
+        cls._warned_signals.clear()
+
     def _note_dropped(self, signal: str, count: int):
         """Account buffer-overflow drops: registry counter + ONE
-        rate-limited warning per outage window (called outside the
+        warning per signal per process lifetime (called outside the
         buffer lock — the warning itself re-enters add_log through the
-        stdlib bridge)."""
+        stdlib bridge, and a repeat warning per burst would flood the
+        very pipeline that is already dropping)."""
         try:
             from .metrics import record as _record_metric
             _record_metric("telemetry.export.dropped_count", count,
                            signal=signal)
         except Exception:  # noqa: BLE001 — telemetry must never raise
             pass
-        now = time.monotonic()
-        if now - self._last_drop_warn >= self.DROP_WARN_INTERVAL_S:
-            self._last_drop_warn = now
+        if signal not in OtlpHttpExporter._warned_signals:
+            OtlpHttpExporter._warned_signals.add(signal)
             logging.getLogger("sail_tpu.tracing").warning(
                 "OTLP export buffer overflow: dropped %d %s "
-                "(collector unreachable or slow)", count, signal)
+                "(collector unreachable or slow); further %s drops "
+                "count in telemetry.export.dropped_count without "
+                "re-warning", count, signal, signal)
 
     def add(self, s: Span):
         """Enqueue only — span exit must never do network I/O on the hot

@@ -84,6 +84,9 @@ def main():
     changed |= _add_field(report, "raw_bytes", 11, F.TYPE_UINT64)
     changed |= _add_field(report, "fetch_wait_s", 12, F.TYPE_DOUBLE)
     changed |= _add_field(report, "decode_s", 13, F.TYPE_DOUBLE)
+    # flight-data recorder: worker task events ride the terminal report
+    changed |= _add_field(report, "events_json", 14, F.TYPE_STRING,
+                          label=F.LABEL_REPEATED)
 
     # adaptive query execution: explicit per-task fetch pairs
     sil = _message(fdp, "StageInputLocations")

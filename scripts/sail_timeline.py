@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Replay a sail-tpu durable event log offline.
+
+Usage:
+    python scripts/sail_timeline.py <event-log.jsonl>           # all queries
+    python scripts/sail_timeline.py <event-log.jsonl> --query <id>
+    python scripts/sail_timeline.py <event-log.jsonl> --json    # machine view
+
+Reconstructs each query's run from the append-only event log alone —
+stage/task Gantt timeline, the decision sequence (adaptive rewrites,
+speculation, eviction/quarantine, streaming epochs), and the
+critical-path attribution — with no access to the live process. The
+reconstruction is the SAME computation the live profile runs
+(sail_tpu/analysis/timeline.py), so for a fixed fault seed the replayed
+decision sequence is bit-identical to what EXPLAIN ANALYZE reported.
+A truncated tail (crash mid-write) replays cleanly up to the last
+complete record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir)))
+
+from sail_tpu.analysis import timeline  # noqa: E402
+from sail_tpu.events import load_event_log  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("log", help="durable JSONL event log to replay")
+    ap.add_argument("--query", default=None,
+                    help="restrict to one query id")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the reconstruction as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        events = load_event_log(args.log)
+    except (OSError, ValueError) as e:
+        print(f"cannot replay {args.log}: {e}", file=sys.stderr)
+        return 2
+    qids = [args.query] if args.query else timeline.query_ids(events)
+    if not qids:
+        print(f"{args.log}: {len(events)} events, no queries",
+              file=sys.stderr)
+        return 1
+
+    if args.json:
+        out = {"events": len(events),
+               "queries": {q: timeline.reconstruct(events, q)
+                           for q in qids}}
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+
+    print(f"{args.log}: {len(events)} events, {len(qids)} quer"
+          f"{'y' if len(qids) == 1 else 'ies'}")
+    for q in qids:
+        print()
+        print(timeline.render_timeline(events, q))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,492 @@
+"""Cluster flight-data recorder (sail_tpu/events.py + analysis/timeline
++ scripts/sail_timeline.py).
+
+Covers the typed vocabulary (runtime validation mirrors the static
+``events`` lint), ring eviction (newest kept), durable-JSONL crash
+semantics (truncated tail replays up to the last complete record, size
+cap falls back to ring-only), worker→driver event shipping on the task
+report, the derived views (``system.telemetry.{events,task_timeline}``,
+critical-path attribution + the EXPLAIN ANALYZE line), and the
+acceptance bar: replaying a chaos-seeded cluster TPC-H q5 run's durable
+event log reconstructs the SAME decision sequence the live profile
+reported, bit-identically."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession, events, faults, profiler
+from sail_tpu.analysis import timeline
+from sail_tpu.events import EventType
+from sail_tpu.exec.cluster import LocalCluster
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    events.EVENT_LOG.clear()
+    yield
+    faults.reset()
+    events.reload()
+
+
+def _plan_for(spark, sql):
+    from sail_tpu.sql import parse_one
+    return spark._resolve(parse_one(sql))
+
+
+def _canon(table):
+    return table.sort_by([(c, "ascending")
+                          for c in table.column_names])
+
+
+# ---------------------------------------------------------------------------
+# vocabulary + ring + durability
+# ---------------------------------------------------------------------------
+
+def test_emit_validates_against_declaration():
+    log = events.EventLog(capacity=8)
+    log.emit(EventType.EPOCH_REPLAY, query_id="q", epoch=3)
+    assert log.events()[0]["type"] == "epoch_replay"
+    with pytest.raises(KeyError):
+        log.emit("bogus_type", query_id="q")
+    with pytest.raises(KeyError):
+        log.emit(EventType.EPOCH_REPLAY, query_id="q", epoch=1,
+                 undeclared_attr=1)
+
+
+def test_every_symbol_matches_declaration():
+    symbols = {v for k, v in vars(EventType).items()
+               if not k.startswith("_")}
+    assert symbols == set(events.EVENT_TYPES)
+
+
+def test_ring_eviction_keeps_newest():
+    log = events.EventLog(capacity=4)
+    for epoch in range(10):
+        log.emit(EventType.EPOCH_COMMIT, query_id="q", epoch=epoch,
+                 commit_ms=1.0)
+    got = [e["epoch"] for e in log.events()]
+    assert got == [6, 7, 8, 9]
+    # seq keeps counting across eviction (stable global order)
+    assert [e["seq"] for e in log.events()] == [7, 8, 9, 10]
+
+
+def test_events_envelope_carries_query_and_trace():
+    log = events.EventLog(capacity=8)
+    log.emit(EventType.QUERY_START, query_id="qid", trace_id="t" * 32,
+             statement="select 1", session="s")
+    e = log.events()[0]
+    assert e["v"] == events.EVENT_SCHEMA_VERSION
+    assert e["query_id"] == "qid" and e["trace_id"] == "t" * 32
+    assert e["ts"] <= time.time()
+
+
+def test_jsonl_truncated_tail_replays_to_last_complete(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    log = events.EventLog(capacity=64, path=path)
+    for epoch in range(5):
+        log.emit(EventType.EPOCH_COMMIT, query_id="q", epoch=epoch,
+                 commit_ms=0.5)
+    log.close()
+    # crash mid-write: chop the file mid-way through the last record
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(path, "wb") as f:
+        f.write(raw[:-7])
+    replayed = events.load_event_log(path)
+    assert [e["epoch"] for e in replayed] == [0, 1, 2, 3]
+
+
+def test_jsonl_malformed_mid_file_stops_there(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": 1, "type": "epoch_replay",
+                            "epoch": 0}) + "\n")
+        f.write("not json at all\n")
+        f.write(json.dumps({"v": 1, "type": "epoch_replay",
+                            "epoch": 1}) + "\n")
+    assert [e["epoch"] for e in events.load_event_log(path)] == [0]
+
+
+def test_jsonl_future_schema_version_refused(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": events.EVENT_SCHEMA_VERSION + 1,
+                            "type": "epoch_replay", "epoch": 0}) + "\n")
+    with pytest.raises(ValueError, match="schema"):
+        events.load_event_log(path)
+
+
+def test_jsonl_size_cap_falls_back_to_ring(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    log = events.EventLog(capacity=4096, path=path, max_bytes=400)
+    for epoch in range(50):
+        log.emit(EventType.EPOCH_COMMIT, query_id="q", epoch=epoch,
+                 commit_ms=0.5)
+    log.close()
+    # the ring kept everything (within capacity)...
+    assert len(log.events()) == 50
+    # ...but the file stopped at the cap, every line complete
+    assert os.path.getsize(path) <= 400
+    replayed = events.load_event_log(path)
+    assert 0 < len(replayed) < 50
+    assert [e["epoch"] for e in replayed] == list(range(len(replayed)))
+
+
+def test_ingest_stamps_envelope_and_drops_malformed():
+    log = events.EventLog(capacity=8)
+    log.ingest({"type": "task_start", "job_id": "j", "stage": 1,
+                "partition": 0, "attempt": 0, "worker": "w"},
+               query_id="qq", trace_id="tt")
+    log.ingest({"type": "never_declared"}, query_id="qq")
+    log.ingest("not a dict", query_id="qq")
+    got = log.events()
+    assert len(got) == 1
+    assert got[0]["query_id"] == "qq" and got[0]["trace_id"] == "tt"
+
+
+def test_collector_buffers_and_drains():
+    col = events.TaskEventCollector()
+    with events.collecting(col):
+        # thread-local routing: module emit lands in the collector
+        events.emit(EventType.COMPILE, key="k", ms=1.0)
+    col.emit(EventType.TASK_START, job_id="j", stage=0, partition=1,
+             attempt=0, worker="w")
+    drained = col.drain()
+    assert [e["type"] for e in drained] == ["compile", "task_start"]
+    assert col.drain() == []
+    # nothing leaked into the global ring
+    assert events.events() == []
+
+
+def test_events_disabled_gate(monkeypatch, tmp_path):
+    monkeypatch.setenv("SAIL_TELEMETRY__EVENTS_ENABLED", "0")
+    events.reload()
+    try:
+        events.emit(EventType.EPOCH_REPLAY, query_id="q", epoch=1)
+        col = events.TaskEventCollector()
+        col.emit(EventType.TASK_START, job_id="j", stage=0, partition=0,
+                 attempt=0, worker="w")
+        assert events.events() == []
+        assert col.drain() == []
+    finally:
+        monkeypatch.delenv("SAIL_TELEMETRY__EVENTS_ENABLED")
+        events.reload()
+
+
+# ---------------------------------------------------------------------------
+# derived views on a synthetic stream
+# ---------------------------------------------------------------------------
+
+def _synthetic_run(log, qid="q1", base=1000.0):
+    """Two-stage job: s0p0 (leaf, slow) and s0p1 feed s1p0; s1p0 waits
+    on fetch from s0p0 (the gating edge), with a compile inside s0p0's
+    window and an adaptive decision in the s1 dispatch gap."""
+
+    def emit(etype, ts, **attrs):
+        log.emit(etype, query_id=qid, trace_id="t" * 32, ts=base + ts,
+                 **attrs)
+
+    emit(EventType.QUERY_START, 0.0, statement="select …", session="s")
+    emit(EventType.STAGE_SUBMIT, 0.01, job_id="j", stage=0,
+         partitions=2, pipelined=False)
+    for p, (t_disp, t_start, t_fin) in enumerate(
+            [(0.02, 0.05, 1.0), (0.02, 0.04, 0.4)]):
+        emit(EventType.TASK_DISPATCH, t_disp, job_id="j", stage=0,
+             partition=p, attempt=0, worker=f"w{p}", reason="")
+        emit(EventType.TASK_START, t_start, job_id="j", stage=0,
+             partition=p, attempt=0, worker=f"w{p}")
+        emit(EventType.TASK_FINISH, t_fin, job_id="j", stage=0,
+             partition=p, attempt=0, worker=f"w{p}",
+             state="succeeded", rows=10, fetch_wait_ms=0.0, error="")
+    emit(EventType.COMPILE, 0.5, key="jit", ms=300.0)
+    emit(EventType.STAGE_COMPLETE, 1.0, job_id="j", stage=0, rows=20)
+    emit(EventType.ADAPTIVE_APPLIED, 1.05, job_id="j", kind="coalesce",
+         detail=json.dumps({"kind": "coalesce", "groups": 1},
+                           sort_keys=True))
+    emit(EventType.STAGE_SUBMIT, 1.1, job_id="j", stage=1,
+         partitions=1, pipelined=False)
+    emit(EventType.TASK_DISPATCH, 1.1, job_id="j", stage=1,
+         partition=0, attempt=0, worker="w0", reason="")
+    emit(EventType.TASK_START, 1.2, job_id="j", stage=1, partition=0,
+         attempt=0, worker="w0")
+    for p in (0, 1):
+        emit(EventType.FETCH_BEGIN, 1.2, job_id="j", stage=0,
+             partition=p, channel=0, addr="a", dst_stage=1,
+             dst_partition=0)
+        emit(EventType.FETCH_END, 1.3, job_id="j", stage=0,
+             partition=p, channel=0, addr="a", dst_stage=1,
+             dst_partition=0, bytes=100, ms=100.0, ok=True)
+    emit(EventType.TASK_FINISH, 2.0, job_id="j", stage=1, partition=0,
+         attempt=0, worker="w0", state="succeeded", rows=20,
+         fetch_wait_ms=200.0, error="")
+    emit(EventType.QUERY_END, 2.1, status="succeeded", rows_out=20,
+         total_ms=2100.0)
+
+
+def test_task_timeline_rows():
+    log = events.EventLog(capacity=256)
+    _synthetic_run(log)
+    rows = timeline.task_timeline(log.events(), query_id="q1")
+    assert len(rows) == 3
+    by_task = {(r["stage"], r["partition"]): r for r in rows}
+    r = by_task[(1, 0)]
+    assert r["worker"] == "w0" and r["state"] == "succeeded"
+    assert r["queue_ms"] == pytest.approx(100.0, abs=1.0)
+    assert r["run_ms"] == pytest.approx(800.0, abs=1.0)
+    assert r["fetch_wait_ms"] == 200.0
+
+
+def test_critical_path_walks_gating_chain():
+    log = events.EventLog(capacity=256)
+    _synthetic_run(log)
+    cp = timeline.critical_path(log.events(), query_id="q1")
+    assert cp is not None
+    # the chain is s1p0 ← (gating fetch) ← s0p0, never s0p1
+    assert [(c["stage"], c["partition"]) for c in cp["chain"]] == \
+        [(1, 0), (0, 0)]
+    cats = cp["categories"]
+    # s1p0: 200ms fetch-wait + 600ms compute + 100ms queue;
+    # s0p0: 300ms compile (in-window) + 650ms compute + 30ms queue;
+    # dispatch gap s0p0.finish→s1p0.dispatch spans the adaptive event
+    assert cats["fetch-wait"] == pytest.approx(200.0, abs=1.0)
+    assert cats["compile"] == pytest.approx(300.0, abs=1.0)
+    assert cats["replan"] == pytest.approx(100.0, abs=1.0)
+    assert cats["compute"] == pytest.approx(1250.0, abs=2.0)
+    assert len(cp["top"]) == 3
+    line = timeline.render_critical_path(cp)
+    assert line.startswith("critical path: ")
+    assert "compute" in line
+
+
+def test_decisions_and_reconstruct():
+    log = events.EventLog(capacity=256)
+    _synthetic_run(log)
+    evs = log.events()
+    dec = timeline.decisions(evs, query_id="q1")
+    assert [d["type"] for d in dec] == ["adaptive_applied"]
+    assert timeline.adaptive_decisions(evs, "q1") == \
+        [{"groups": 1, "kind": "coalesce"}]
+    rec = timeline.reconstruct(evs, "q1")
+    assert rec["status"] == "succeeded"
+    assert [s["stage"] for s in rec["stages"]] == [0, 1]
+    assert rec["stages"][0]["complete_time"] is not None
+    text = timeline.render_timeline(evs, "q1")
+    assert "critical path:" in text and "s1p0a0" in text
+
+
+# ---------------------------------------------------------------------------
+# live cluster integration
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def _spark_small():
+    spark = SparkSession({})
+    n = 20000
+    spark.createDataFrame(pd.DataFrame({
+        "k": np.arange(n) % 50,
+        "v": np.arange(n, dtype="float64")})) \
+        .createOrReplaceTempView("t")
+    spark.createDataFrame(pd.DataFrame({
+        "k": np.arange(50),
+        "name": [f"n{i}" for i in range(50)]})) \
+        .createOrReplaceTempView("d")
+    return spark
+
+
+_JOIN_SQL = ("select d.name, sum(t.v) s from t join d on t.k = d.k "
+             "group by d.name order by s desc")
+
+
+def test_cluster_job_records_unified_stream(_spark_small):
+    plan = _plan_for(_spark_small, _JOIN_SQL)
+    c = LocalCluster(num_workers=2)
+    try:
+        c.run_job(plan, num_partitions=4, timeout=120)
+    finally:
+        c.stop()
+    prof = profiler.last_profile()
+    evs = events.events(query_id=prof.query_id)
+    kinds = {e["type"] for e in evs}
+    # driver events, worker-shipped events, and query lifecycle all
+    # merged under ONE query id
+    assert {"query_start", "query_end", "stage_submit",
+            "stage_complete", "task_dispatch", "task_start",
+            "task_finish", "fetch_begin", "fetch_end"} <= kinds
+    # every event cross-references the query's trace
+    assert prof.trace_id is not None
+    assert all(e["trace_id"] == prof.trace_id for e in evs)
+    # worker-side task_start carries the worker id per attempt
+    starts = [e for e in evs if e["type"] == "task_start"]
+    assert starts and all(e["worker"].startswith("worker-")
+                          for e in starts)
+    # critical path landed on the profile and renders its line
+    assert prof.critical_path is not None
+    assert prof.critical_path["top"]
+    assert "critical path: " in prof.render()
+    assert prof.to_dict()["critical_path"] == prof.critical_path
+    summary = prof.critical_path_summary()
+    assert summary == {"derived": False,
+                       "categories": prof.critical_path["categories"]}
+
+
+def test_system_tables_expose_stream(_spark_small):
+    plan = _plan_for(_spark_small, _JOIN_SQL)
+    c = LocalCluster(num_workers=2)
+    try:
+        c.run_job(plan, num_partitions=4, timeout=120)
+    finally:
+        c.stop()
+    ev_table = _spark_small.sql(
+        "select * from system.telemetry.events").toArrow()
+    assert ev_table.num_rows > 0
+    assert {"seq", "ts", "type", "query_id", "trace_id",
+            "attributes"} <= set(ev_table.column_names)
+    attrs = json.loads(ev_table.column("attributes")[0].as_py())
+    assert "type" not in attrs  # envelope keys stay out of attributes
+    tl = _spark_small.sql(
+        "select * from system.telemetry.task_timeline").toArrow()
+    assert tl.num_rows > 0
+    states = set(tl.column("state").to_pylist())
+    assert "succeeded" in states
+    # satellite: the live metrics registry is SQL-visible
+    mt = _spark_small.sql(
+        "select name, attributes, value from system.telemetry.metrics "
+        "where name = 'execution.query_count'").toArrow()
+    assert mt.num_rows >= 1 and mt.column("value")[0].as_py() >= 1
+
+
+def test_local_query_critical_path_summary_is_phase_derived(
+        _spark_small):
+    _spark_small.sql("select sum(v) from t").toArrow()
+    prof = profiler.last_profile()
+    assert prof.critical_path is None
+    summary = prof.critical_path_summary()
+    assert summary is not None and summary["derived"] is True
+    assert "compute" in summary["categories"]
+
+
+def test_streaming_epochs_ride_the_stream(_spark_small, tmp_path):
+    df = _spark_small.readStream.format("rate") \
+        .option("rowsPerSecond", "200").load()
+    q = df.writeStream.format("memory").queryName("ev_sink") \
+        .trigger(processingTime="50 milliseconds").start()
+    try:
+        # poll the ring while the trigger thread runs — never drain a
+        # rate source synchronously, it produces continuously
+        deadline = time.time() + 30
+        commits = []
+        while time.time() < deadline and not commits:
+            commits = [e for e in events.events()
+                       if e["type"] == "epoch_commit"]
+            time.sleep(0.1)
+        assert q.exception is None
+        assert commits, "no epoch_commit event within the deadline"
+    finally:
+        q.stop()
+    kinds = [e["type"] for e in events.events()
+             if e["type"].startswith("epoch_")]
+    assert "epoch_stage" in kinds and "epoch_commit" in kinds
+
+
+# ---------------------------------------------------------------------------
+# acceptance: chaos-seeded cluster TPC-H q5 — the durable log replays
+# to the exact decision sequence the live profile reported
+# ---------------------------------------------------------------------------
+
+def _run_q5_chaos(tmp_dir):
+    from sail_tpu.benchmarks.tpch_data import generate_tpch
+    from sail_tpu.benchmarks.tpch_queries import QUERIES
+
+    tables = generate_tpch(0.01, seed=11)
+    spark = SparkSession({})
+    for name, t in tables.items():
+        spark.createDataFrame(t).createOrReplaceTempView(name)
+    plan = _plan_for(spark, QUERIES[5])
+    faults.configure("shuffle.fetch:*c[0-9]*=error(not_found)#1",
+                     seed=32)
+    c = LocalCluster(num_workers=2)
+    try:
+        out = c.run_job(plan, num_partitions=3, timeout=180)
+        return out, c.last_job, profiler.last_profile()
+    finally:
+        c.stop()
+
+
+def test_chaos_q5_event_log_replay_matches_live_profile(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv("SAIL_TELEMETRY__EVENT_LOG__ENABLED", "1")
+    monkeypatch.setenv("SAIL_TELEMETRY__EVENT_LOG__DIR", str(tmp_path))
+    events.reload()
+    out, job, prof = _run_q5_chaos(str(tmp_path))
+    assert faults.injection_counts().get("shuffle.fetch") == 1
+    assert job.retry_count >= 1
+    path = events.EVENT_LOG.path
+    assert path is not None and os.path.exists(path)
+    events.EVENT_LOG.close()
+    replayed = events.load_event_log(path)
+
+    # 1) the replayed adaptive decision sequence is BIT-IDENTICAL to
+    #    the live profile's decision log
+    live = prof.to_dict()["adaptive"]["events"]
+    rep = timeline.adaptive_decisions(replayed, prof.query_id)
+    assert json.dumps(rep, sort_keys=True) == \
+        json.dumps(live, sort_keys=True)
+
+    # 2) the replayed task set covers exactly the stages/partitions the
+    #    live run completed (fault retries included), with the retried
+    #    dispatch visible
+    rows = timeline.task_timeline(replayed, prof.query_id)
+    succeeded = {(r["stage"], r["partition"]) for r in rows
+                 if r["state"] == "succeeded"}
+    assert succeeded == set(job.partition_rows)
+    dispatch_reasons = {e.get("reason") for e in replayed
+                        if e.get("type") == "task_dispatch"}
+    assert "fetch_failed" in dispatch_reasons
+
+    # 3) the offline reconstruction computes the same critical path the
+    #    live profile reported
+    rec = timeline.reconstruct(replayed, prof.query_id)
+    assert rec["critical_path"] == prof.critical_path
+    assert prof.critical_path is not None
+
+    # 4) a truncated tail still replays cleanly up to the last record
+    with open(path, "rb") as f:
+        raw = f.read()
+    trunc = str(tmp_path / "trunc.jsonl")
+    with open(trunc, "wb") as f:
+        f.write(raw[:-11])
+    partial = events.load_event_log(trunc)
+    assert 0 < len(partial) < len(replayed)
+    assert timeline.query_ids(partial) == [prof.query_id]
+
+    # 5) the sail_timeline.py CLI reconstructs the same run offline
+    #    from the file alone (fresh process, no live state)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "scripts", "sail_timeline.py"), path],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert prof.query_id in proc.stdout
+    assert "critical path:" in proc.stdout
+    proc_json = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "scripts", "sail_timeline.py"), path,
+         "--json", "--query", prof.query_id],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc_json.returncode == 0, proc_json.stderr
+    payload = json.loads(proc_json.stdout)
+    assert payload["queries"][prof.query_id]["critical_path"] == \
+        prof.critical_path

@@ -90,21 +90,58 @@ def test_bounded_buffer_counts_drops():
         exp.shutdown()
 
 
-def test_drop_warning_rate_limited(caplog):
+def _overflow(exp, signal: str, times: int = 1):
+    for _ in range(times):
+        for i in range(16 * exp.max_batch + 1):
+            if signal == "spans":
+                exp.add(tr.Span("0" * 32, "1" * 16, None, f"s{i}",
+                                time.time_ns(), time.time_ns()))
+            else:
+                exp.add_log(tr.LogEvent(time.time_ns(), 9, "INFO",
+                                        f"l{i}"))
+
+
+def test_drop_warning_once_per_signal_per_process(caplog):
+    """The overflow warning dedupes per SIGNAL per process lifetime:
+    repeat bursts of the same signal never re-warn (the dropped_count
+    metric carries the tally), each signal warns independently, and a
+    fresh exporter instance in the same process stays silent."""
     import logging
+    tr.OtlpHttpExporter.reset_drop_warnings()
     exp = tr.OtlpHttpExporter(_unreachable_endpoint(),
                               flush_interval_s=3600.0, max_batch=2)
     try:
         with caplog.at_level(logging.WARNING, logger="sail_tpu.tracing"):
-            for _ in range(3):  # three overflow events in one window
-                for i in range(16 * exp.max_batch + 1):
-                    exp.add(tr.Span("0" * 32, "1" * 16, None, "s",
-                                    time.time_ns(), time.time_ns()))
+            _overflow(exp, "spans", times=3)  # three bursts, one warning
         warns = [r for r in caplog.records
                  if "buffer overflow" in r.getMessage()]
-        assert len(warns) == 1  # rate-limited to one per window
+        assert len(warns) == 1
+        assert "spans" in warns[0].getMessage()
+        # the OTHER signal still gets its own one warning
+        with caplog.at_level(logging.WARNING, logger="sail_tpu.tracing"):
+            _overflow(exp, "logs", times=2)
+        warns = [r for r in caplog.records
+                 if "buffer overflow" in r.getMessage()]
+        assert len(warns) == 2
+        assert "logs" in warns[1].getMessage()
     finally:
         exp.shutdown()
+    # a NEW exporter instance in the same process must not re-warn for
+    # either signal — the dedupe is per process lifetime, not per
+    # instance
+    exp2 = tr.OtlpHttpExporter(_unreachable_endpoint(),
+                               flush_interval_s=3600.0, max_batch=2)
+    try:
+        with caplog.at_level(logging.WARNING, logger="sail_tpu.tracing"):
+            _overflow(exp2, "spans")
+            _overflow(exp2, "logs")
+        warns = [r for r in caplog.records
+                 if "buffer overflow" in r.getMessage()]
+        assert len(warns) == 2  # unchanged
+        # drops still COUNT even though the warning deduped
+        assert exp2.dropped["spans"] > 0 and exp2.dropped["logs"] > 0
+    finally:
+        exp2.shutdown()
 
 
 class _FakeCM:

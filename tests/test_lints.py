@@ -196,6 +196,40 @@ def test_seeded_undeclared_metric_attribute(seeded):
     assert any("bogus_attr" in v.message for v in found), found
 
 
+def test_seeded_undeclared_event_type(seeded):
+    _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_event():\n"
+            "    from .. import events\n"
+            "    from ..events import EventType\n"
+            "    events.emit(EventType.LINT_SEED_BOGUS, foo=1)\n")
+    found = _run(seeded, "events")
+    assert any("LINT_SEED_BOGUS" in v.message for v in found), found
+
+
+def test_seeded_undeclared_event_attribute(seeded):
+    _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_attr():\n"
+            "    from .. import events\n"
+            "    from ..events import EventType\n"
+            "    events.emit(EventType.EPOCH_REPLAY, epoch=1,\n"
+            "                bogus_event_attr=2)\n")
+    found = _run(seeded, "events")
+    assert any("bogus_event_attr" in v.message for v in found), found
+
+
+def test_seeded_orphan_event_type(seeded):
+    path = os.path.join(seeded, "sail_tpu/events.py")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    assert '"epoch_replay": ("epoch",),' in src
+    src = src.replace(
+        '"epoch_replay": ("epoch",),',
+        '"epoch_replay": ("epoch",),\n'
+        '    "lint_seed_orphan": ("x",),', 1)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src)
+    found = _run(seeded, "events")
+    assert any("lint_seed_orphan" in v.message for v in found), found
+
+
 def test_runner_exits_nonzero_on_seeded_drift(seeded):
     _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_drift():\n"
             "    from ..config import get as config_get\n"
