@@ -641,6 +641,252 @@ def _run_skew_bench(spark) -> dict:
                 os.environ[k] = v
 
 
+def _env_on(name: str) -> bool:
+    return os.environ.get(name, "0").strip().lower() in ("1", "true",
+                                                         "yes")
+
+
+def _run_saturation(spark, n_tenants: int) -> dict:
+    """SAIL_BENCH_CONCURRENCY=N: multi-tenant saturation artifact.
+
+    N well-behaved tenants (one ``spark.newSession()`` each, tagged via
+    ``spark.sail.tenant``) concurrently run a mixed workload — TPC-H q1
+    + q6 over lineitem, a ClickBench-style aggregation over hits — while
+    one streaming query (stateful groupBy-sum over a replayable source)
+    runs for the whole phase. Three phases:
+
+    - ``baseline``        admission on, no hostile tenant
+    - ``hostile_admitted``  admission on, a hostile tenant flooding
+      3× its concurrency cap with heavy group-bys
+    - ``hostile_unbounded`` the same flood with admission OFF
+      (SAIL_ADMISSION__ENABLED=0 + reload) — the control
+
+    Per-tenant p50/p99 per phase plus isolation ratios
+    (p99(hostile)/p99(baseline), worst tenant): acceptance is
+    ``isolation_admitted ≤ 2x`` while ``hostile_unbounded`` shows what
+    the flood does without the serving layer. Shed queries must all be
+    typed retryable (``sheds_typed_retryable``). The whole-run
+    SAIL_BENCH_DISABLE_ADMISSION=1 knob instead records one unbounded
+    run for A/B."""
+    import statistics
+    import tempfile
+    import threading
+
+    import pyarrow as pa
+
+    from sail_tpu.benchmarks.clickbench import register_hits
+    from sail_tpu.exec import admission
+    from sail_tpu.exec.admission import ResourceExhausted
+    from sail_tpu.session import DataFrame
+    from sail_tpu.streaming import ReplayableMemorySource, _StreamRead
+
+    queries_per_tenant = int(os.environ.get(
+        "SAIL_BENCH_SATURATION_QUERIES", "10"))
+    lineitem = generate_lineitem_sf(float(os.environ.get(
+        "SAIL_BENCH_SATURATION_SF", "0.01")))
+    spark.createDataFrame(lineitem).createOrReplaceTempView("lineitem")
+    register_hits(spark, n_rows=50_000)
+    mixed = [
+        # q1-shaped: wide aggregate over the fact table
+        ("SELECT l_returnflag, l_linestatus, sum(l_quantity) qty, "
+         "avg(l_extendedprice) p FROM lineitem "
+         "WHERE l_shipdate <= DATE '1998-09-02' "
+         "GROUP BY l_returnflag, l_linestatus "
+         "ORDER BY l_returnflag, l_linestatus"),
+        # q6-shaped: selective scan + agg
+        ("SELECT sum(l_extendedprice * l_discount) rev FROM lineitem "
+         "WHERE l_discount BETWEEN 0.05 AND 0.07 "
+         "AND l_quantity < 24"),
+        # ClickBench-shaped: top-k group-by over hits
+        ("SELECT RegionID, count(*) c FROM hits "
+         "GROUP BY RegionID ORDER BY c DESC LIMIT 10"),
+    ]
+    hostile_sql = ("SELECT l_orderkey, sum(l_extendedprice) s, "
+                   "count(*) c FROM lineitem GROUP BY l_orderkey "
+                   "ORDER BY s DESC LIMIT 5")
+    # warm every query shape once BEFORE any phase: the baseline must
+    # measure steady-state latency, not absorb the JIT compiles the
+    # hostile phases would then run without
+    for q in mixed + [hostile_sql]:
+        spark.sql(q).toArrow()
+
+    # caps tight enough that the flood actually queues: 2 concurrent
+    # queries per tenant, fair-shared wake order across tenants
+    knobs = {
+        "SAIL_ADMISSION__MAX_CONCURRENT_QUERIES": "2",
+        "SAIL_ADMISSION__MAX_CONCURRENT_TOTAL": str(2 * n_tenants + 2),
+        "SAIL_ADMISSION__MAX_QUEUED_QUERIES": "64",
+        "SAIL_ADMISSION__QUEUE_TIMEOUT_MS": "60000",
+    }
+    saved = {k: os.environ.get(k) for k in list(knobs)
+             + ["SAIL_ADMISSION__ENABLED"]}
+    os.environ.update(knobs)
+
+    def phase(tag: str, hostile: bool, admission_on: bool) -> dict:
+        os.environ["SAIL_ADMISSION__ENABLED"] = \
+            "1" if admission_on else "0"
+        admission.reload()
+        stop = threading.Event()
+        shed = {"count": 0, "typed": 0}
+
+        # one streaming query rides the whole phase
+        schema = pa.schema([("k", pa.int64()), ("v", pa.int64())])
+        src = ReplayableMemorySource(schema)
+        ckpt = tempfile.mkdtemp(prefix=f"sail_sat_{tag}_cp_")
+        out_dir = tempfile.mkdtemp(prefix=f"sail_sat_{tag}_out_")
+        sdf = DataFrame(_StreamRead(f"sat_{tag}", src), spark)
+        sq = (sdf.groupBy("k").sum("v").writeStream
+              .outputMode("complete").format("parquet")
+              .option("checkpointLocation", ckpt).start(out_dir))
+        epochs_fed = 0
+
+        def feed_stream():
+            nonlocal epochs_fed
+            rng = np.random.default_rng(11)
+            while not stop.is_set():
+                src.add(pa.table({
+                    "k": pa.array(rng.integers(0, 32, 2000),
+                                  type=pa.int64()),
+                    "v": pa.array(rng.integers(0, 100, 2000),
+                                  type=pa.int64())}))
+                epochs_fed += 1
+                try:
+                    sq.processAllAvailable()
+                except Exception:  # noqa: BLE001 — phase stats survive
+                    return
+
+        def hostile_loop():
+            hs = spark.newSession()
+            hs.conf.set("spark.sail.tenant", "hostile")
+            while not stop.is_set():
+                try:
+                    hs.sql(hostile_sql).toArrow()
+                except ResourceExhausted as e:
+                    shed["count"] += 1
+                    if e.retryable:
+                        shed["typed"] += 1
+                    time.sleep(0.02)
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.02)
+
+        lat: dict = {}
+
+        def tenant_loop(name: str):
+            ts = spark.newSession()
+            ts.conf.set("spark.sail.tenant", name)
+            times = lat.setdefault(name, [])
+            for i in range(queries_per_tenant):
+                t0 = time.perf_counter()
+                try:
+                    ts.sql(mixed[i % len(mixed)]).toArrow()
+                    times.append(time.perf_counter() - t0)
+                except ResourceExhausted as e:
+                    shed["count"] += 1
+                    if e.retryable:
+                        shed["typed"] += 1
+
+        threads = [threading.Thread(target=feed_stream, daemon=True)]
+        if hostile:
+            # 3× the per-tenant concurrency cap: a real flood
+            threads += [threading.Thread(target=hostile_loop,
+                                         daemon=True)
+                        for _ in range(6)]
+        workers = [threading.Thread(target=tenant_loop, args=(f"t{i}",))
+                   for i in range(n_tenants)]
+        t0 = time.perf_counter()
+        for t in threads + workers:
+            t.start()
+        for t in workers:
+            t.join()
+        stop.set()
+        wall = time.perf_counter() - t0
+        try:
+            sq.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        for t in threads:
+            t.join(10)
+        import shutil
+        for d in (ckpt, out_dir):
+            shutil.rmtree(d, ignore_errors=True)
+
+        def pct(vals, q):
+            if not vals:
+                return None
+            s = sorted(vals)
+            return round(s[min(len(s) - 1,
+                               int(q * (len(s) - 1) + 0.999999))]
+                         * 1000.0, 1)
+
+        return {
+            "wall_s": round(wall, 3),
+            "admission": admission_on,
+            "hostile": hostile,
+            "streaming_epochs": epochs_fed,
+            "tenants": {name: {"n": len(v),
+                               "p50_ms": pct(v, 0.50),
+                               "p99_ms": pct(v, 0.99)}
+                        for name, v in sorted(lat.items())},
+            "sheds": shed["count"],
+            "sheds_typed_retryable": shed["count"] == shed["typed"],
+        }
+
+    def worst_ratio(base: dict, loaded: dict):
+        ratios = []
+        for name, rec in loaded["tenants"].items():
+            b = base["tenants"].get(name, {}).get("p99_ms")
+            if b and rec.get("p99_ms"):
+                ratios.append(rec["p99_ms"] / b)
+        return round(max(ratios), 3) if ratios else None
+
+    forced_off = _env_on("SAIL_BENCH_DISABLE_ADMISSION")
+    try:
+        # one unmeasured baseline-shaped pass: the first concurrent
+        # phase pays one-off costs (thread pools, sink/checkpoint
+        # setup, residual compiles) that would inflate whichever phase
+        # ran first and skew the isolation ratios
+        saved_q = queries_per_tenant
+        queries_per_tenant = max(2, saved_q // 3)
+        phase("warm", hostile=False, admission_on=not forced_off)
+        queries_per_tenant = saved_q
+        if forced_off:
+            baseline = phase("baseline", hostile=False,
+                             admission_on=False)
+            unbounded = phase("hostile", hostile=True,
+                              admission_on=False)
+            return {
+                "n_tenants": n_tenants,
+                "queries_per_tenant": queries_per_tenant,
+                "mode": "admission_disabled(SAIL_BENCH_DISABLE_"
+                        "ADMISSION)",
+                "baseline": baseline,
+                "hostile_unbounded": unbounded,
+                "isolation_unbounded": worst_ratio(baseline, unbounded),
+            }
+        baseline = phase("baseline", hostile=False, admission_on=True)
+        admitted = phase("hostile_adm", hostile=True, admission_on=True)
+        unbounded = phase("hostile_raw", hostile=True,
+                          admission_on=False)
+        return {
+            "n_tenants": n_tenants,
+            "queries_per_tenant": queries_per_tenant,
+            "baseline": baseline,
+            "hostile_admitted": admitted,
+            "hostile_unbounded": unbounded,
+            # worst well-behaved tenant's p99 movement vs baseline:
+            # acceptance is admitted ≤ 2.0 (vs the unbounded control)
+            "isolation_admitted": worst_ratio(baseline, admitted),
+            "isolation_unbounded": worst_ratio(baseline, unbounded),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        admission.reload()
+
+
 def _budget_skip_warnings(result: dict) -> list:
     """Self-check: no suite query may be silently budget-skipped — every
     skip surfaces as an artifact warning, and q22 (first-run,
@@ -749,6 +995,16 @@ def main():
         os.environ["SAIL_TELEMETRY__EVENTS_ENABLED"] = "0"
         from sail_tpu import events as _events
         _events.reload()
+    # A/B knob: SAIL_BENCH_DISABLE_ADMISSION=1 turns multi-tenant
+    # admission control off for the whole run (session gate + cluster
+    # driver fair queue); the saturation section then records the
+    # unbounded control only
+    disable_admission = _env_on("SAIL_BENCH_DISABLE_ADMISSION")
+    if disable_admission:
+        os.environ["SAIL_ADMISSION__ENABLED"] = "0"
+        from sail_tpu.exec import admission as _admission
+        _admission.reload()
+    result_admission = {"enabled": not disable_admission}
     try:
         best, rows, scanned, q1_profile = _run_q1(spark, sf)
     except Exception as e:  # noqa: BLE001 — fall back to SF1 rather than die
@@ -831,6 +1087,16 @@ def main():
             result["chaos"] = _run_chaos(spark)
         except Exception as e:  # noqa: BLE001
             result["chaos_error"] = f"{type(e).__name__}: {e}"
+    # multi-tenant saturation: SAIL_BENCH_CONCURRENCY=N tenants, mixed
+    # TPC-H + ClickBench + one streaming query, hostile tenant on/off,
+    # per-tenant p50/p99 + isolation ratio (admission A/B above)
+    result["admission"] = result_admission
+    n_tenants = int(os.environ.get("SAIL_BENCH_CONCURRENCY", "0"))
+    if n_tenants > 0:
+        try:
+            result["saturation"] = _run_saturation(spark, n_tenants)
+        except Exception as e:  # noqa: BLE001
+            result["saturation_error"] = f"{type(e).__name__}: {e}"
     warnings = _budget_skip_warnings(result)
     if warnings:
         result["warnings"] = warnings

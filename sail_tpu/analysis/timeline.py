@@ -33,7 +33,10 @@ from typing import Dict, List, Optional, Tuple
 DECISION_TYPES = ("adaptive_applied", "adaptive_rollback",
                   "speculation_launch", "speculation_win",
                   "worker_evict", "worker_quarantine",
-                  "epoch_stage", "epoch_commit", "epoch_replay")
+                  "epoch_stage", "epoch_commit", "epoch_replay",
+                  "admission_enqueue", "admission_admit",
+                  "admission_defer", "admission_shed", "quota_debit",
+                  "deadline_cancel")
 
 CATEGORIES = ("compute", "fetch-wait", "queue", "compile", "replan")
 

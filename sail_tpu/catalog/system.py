@@ -123,6 +123,8 @@ class SystemRegistry:
                     "statement": pa.array(
                         [r["statement"] for r in rows]),
                     "session": pa.array([r["session"] for r in rows]),
+                    "tenant": pa.array(
+                        [r.get("tenant", "") for r in rows]),
                     "status": pa.array([r["status"] for r in rows]),
                     "start_time": pa.array(
                         [r["start_time"] for r in rows], pa.float64()),

@@ -61,7 +61,7 @@ RESERVED_KEYS = ("v", "seq", "ts", "type", "query_id", "trace_id",
 #: (``dst_partition`` -1 = the driver's root-stage merge fetch).
 EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # query lifecycle (driver/session side, all execution paths)
-    "query_start": ("statement", "session"),
+    "query_start": ("statement", "session", "tenant"),
     "query_end": ("status", "rows_out", "total_ms"),
     # JIT compile of a compiled-operator cache miss (exec/local.py)
     "compile": ("key", "ms"),
@@ -72,7 +72,8 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # start on the worker (shipped back in the terminal report)
     "task_dispatch": ("job_id", "stage", "partition", "attempt",
                       "worker", "reason"),
-    "task_start": ("job_id", "stage", "partition", "attempt", "worker"),
+    "task_start": ("job_id", "stage", "partition", "attempt", "worker",
+                   "tenant"),
     "task_finish": ("job_id", "stage", "partition", "attempt", "worker",
                     "state", "rows", "fetch_wait_ms", "error"),
     # shuffle fetch over the peer data plane (worker + driver consumers)
@@ -84,6 +85,23 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "governor_admit": ("job_id", "stage", "partition", "worker",
                        "projected_bytes"),
     "governor_defer": ("job_id", "stage", "partition", "attempt"),
+    # multi-tenant admission control (exec/admission.py): job_id is ""
+    # for session-path (local query) decisions; ``cost`` is the DRR
+    # cost — stage-launch opportunities for cluster jobs, 1 per query
+    # on the session path
+    "admission_enqueue": ("job_id", "tenant", "queue_depth", "cost"),
+    "admission_admit": ("job_id", "tenant", "waited_ms"),
+    "admission_defer": ("job_id", "tenant", "reason", "stage",
+                        "partition"),
+    "admission_shed": ("job_id", "tenant", "reason", "queue_depth"),
+    # per-tenant memory-quota ledger: ``bytes`` is the task's projected
+    # decoded input (observed producer channel sizes — AQE stats, not
+    # static estimates); ``used_bytes`` the tenant total after debit
+    "quota_debit": ("job_id", "tenant", "stage", "partition", "bytes",
+                    "used_bytes"),
+    # per-query deadline enforcement through the CancelJob path
+    "deadline_cancel": ("job_id", "tenant", "deadline_ms",
+                        "overrun_ms"),
     # adaptive query execution: ``detail`` is the canonical JSON of the
     # decision record (sort_keys), bit-identical to the profile's
     # adaptive event — replaying the log reconstructs the decision
@@ -120,6 +138,12 @@ class EventType:
     FETCH_END = "fetch_end"
     GOVERNOR_ADMIT = "governor_admit"
     GOVERNOR_DEFER = "governor_defer"
+    ADMISSION_ENQUEUE = "admission_enqueue"
+    ADMISSION_ADMIT = "admission_admit"
+    ADMISSION_DEFER = "admission_defer"
+    ADMISSION_SHED = "admission_shed"
+    QUOTA_DEBIT = "quota_debit"
+    DEADLINE_CANCEL = "deadline_cancel"
     ADAPTIVE_APPLIED = "adaptive_applied"
     ADAPTIVE_ROLLBACK = "adaptive_rollback"
     SPECULATION_LAUNCH = "speculation_launch"

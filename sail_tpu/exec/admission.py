@@ -1,0 +1,688 @@
+"""Multi-tenant admission control: quotas, weighted-fair queuing, shedding.
+
+Reference role: the arbitration layer Tailwind (arXiv:2604.28079) frames
+as the contract of a practical-accelerator serving system — admission +
+per-tenant quotas keep one workload from starving another — with
+Theseus (arXiv:2508.05029) motivating that the scarce resource to
+arbitrate is projected data movement, not task slots. Everything built
+through PR 10 optimizes one query at a time; this module arbitrates
+ACROSS concurrent queries and jobs:
+
+- :class:`SessionAdmission` — the process-wide gate on the session
+  query path (``SparkSession._execute_query``): per-tenant concurrent-
+  query caps, an optional global cap, bounded wait queues with
+  weighted-fair wake order (lowest virtual time ``served/weight``
+  first, FIFO within a tenant), queue timeouts, and per-query
+  deadlines. Overflow or timeout sheds with a typed, retryable
+  :class:`ResourceExhausted` — never a hang.
+- :class:`JobAdmissionQueue` — the cluster driver's cross-job fair
+  queue: jobs (not just tasks) are scheduled under deficit-round-robin
+  where a job's cost is its stage-launch opportunities (total task
+  launches), so a heavy job consumes more of its tenant's share than a
+  light one. Per-tenant running-job concurrency caps, a global cap (the
+  shared resource the weights arbitrate), bounded per-tenant queues
+  with deterministic shedding, and a per-tenant memory-quota ledger the
+  driver debits with the PR 7 governor's per-task byte projections —
+  which are AQE's observed channel sizes, so real sizes replace
+  estimates as producers complete.
+
+Every decision (enqueue/admit/defer/shed/quota debit/deadline cancel)
+is deterministic given arrival order — sorted tenant iteration, FIFO
+per-tenant queues, integer deficit arithmetic — and lands in the PR 10
+flight recorder as typed events, replayable by scripts/sail_timeline.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .. import events
+from ..events import EventType
+from ..metrics import record as _record_metric
+
+DEFAULT_TENANT = "default"
+
+
+# ---------------------------------------------------------------------------
+# typed client-facing errors
+# ---------------------------------------------------------------------------
+
+class AdmissionError(RuntimeError):
+    """Base of the typed admission-control errors. ``retryable`` tells
+    the client whether backing off and resubmitting can succeed."""
+
+    code = "ADMISSION"
+    retryable = False
+
+    def __init__(self, message: str, tenant: str = "",
+                 retry_after_ms: int = 0):
+        super().__init__(message)
+        self.tenant = tenant
+        self.retry_after_ms = int(retry_after_ms)
+
+
+class ResourceExhausted(AdmissionError):
+    """Deterministic load shed: the tenant's admission queue is full or
+    the query waited out its queue budget. Retryable by contract — the
+    request was never partially executed (no partial shuffle output, no
+    side effects), so resubmitting after ``retry_after_ms`` is safe."""
+
+    code = "RESOURCE_EXHAUSTED"
+    retryable = True
+
+
+class DeadlineExceeded(AdmissionError):
+    """The query's deadline elapsed (in queue, or mid-execution via the
+    driver's cancel path). Not retryable as-is: the same deadline would
+    expire again."""
+
+    code = "DEADLINE_EXCEEDED"
+    retryable = False
+
+
+# ---------------------------------------------------------------------------
+# tenant policy
+# ---------------------------------------------------------------------------
+
+class TenantPolicy:
+    """Per-tenant knobs, defaulted from the ``admission.*`` config and
+    overridable per tenant through ``admission.tenants``."""
+
+    __slots__ = ("weight", "max_jobs", "max_queries",
+                 "memory_quota_bytes")
+
+    def __init__(self, weight: int, max_jobs: int, max_queries: int,
+                 memory_quota_bytes: int):
+        self.weight = max(1, int(weight))
+        self.max_jobs = max(0, int(max_jobs))          # 0 = unlimited
+        self.max_queries = max(0, int(max_queries))    # 0 = unlimited
+        self.memory_quota_bytes = max(0, int(memory_quota_bytes))
+
+
+def _num(value, default, cast=int):
+    try:
+        return cast(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def parse_tenant_overrides(spec: str) -> Dict[str, Dict[str, int]]:
+    """``admission.tenants`` grammar — semicolon-separated per-tenant
+    override groups::
+
+        name:weight=2,memMb=256,maxJobs=2,maxQueries=4;other:weight=1
+
+    Unknown fields and malformed groups are ignored (config typos must
+    not take the admission layer down)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for group in (spec or "").split(";"):
+        group = group.strip()
+        if not group or ":" not in group:
+            continue
+        name, _, body = group.partition(":")
+        name = name.strip()
+        if not name:
+            continue
+        fields: Dict[str, int] = {}
+        for pair in body.split(","):
+            k, _, v = pair.partition("=")
+            k = k.strip()
+            if k in ("weight", "memMb", "maxJobs", "maxQueries"):
+                parsed = _num(v.strip(), None)
+                if parsed is not None:
+                    fields[k] = parsed
+        out[name] = fields
+    return out
+
+
+class AdmissionConfig:
+    """One snapshot of every ``admission.*`` key (see
+    config/application.yaml), read at gate/queue construction."""
+
+    def __init__(self):
+        from ..config import get as config_get
+        from ..config import truthy
+        self.enabled = truthy("admission.enabled")
+        self.default_tenant = str(
+            config_get("admission.tenant", DEFAULT_TENANT)
+            or DEFAULT_TENANT)
+        self.default_weight = max(1, _num(
+            config_get("admission.default_weight", 1), 1))
+        self.max_concurrent_queries = max(0, _num(
+            config_get("admission.max_concurrent_queries", 8), 8))
+        self.max_concurrent_total = max(0, _num(
+            config_get("admission.max_concurrent_total", 0), 0))
+        self.max_queued_queries = max(0, _num(
+            config_get("admission.max_queued_queries", 64), 64))
+        self.max_concurrent_jobs = max(0, _num(
+            config_get("admission.max_concurrent_jobs", 4), 4))
+        self.max_concurrent_jobs_total = max(0, _num(
+            config_get("admission.max_concurrent_jobs_total", 8), 8))
+        self.max_queued_jobs = max(0, _num(
+            config_get("admission.max_queued_jobs", 32), 32))
+        self.queue_timeout_ms = max(0, _num(
+            config_get("admission.queue_timeout_ms", 30000), 30000))
+        self.default_deadline_ms = max(0, _num(
+            config_get("admission.default_deadline_ms", 0), 0))
+        self.memory_quota_bytes = max(0, _num(
+            config_get("admission.memory_quota_mb", 0), 0)) << 20
+        self.overrides = parse_tenant_overrides(
+            str(config_get("admission.tenants", "") or ""))
+
+    def policy(self, tenant: str) -> TenantPolicy:
+        o = self.overrides.get(tenant, {})
+        return TenantPolicy(
+            weight=o.get("weight", self.default_weight),
+            max_jobs=o.get("maxJobs", self.max_concurrent_jobs),
+            max_queries=o.get("maxQueries", self.max_concurrent_queries),
+            memory_quota_bytes=(o["memMb"] << 20) if "memMb" in o
+            else self.memory_quota_bytes)
+
+
+# ---------------------------------------------------------------------------
+# cluster driver: cross-job fair queue
+# ---------------------------------------------------------------------------
+
+class JobAdmissionQueue:
+    """Driver-side job admission: bounded per-tenant FIFO queues drained
+    by deficit-round-robin. Called ONLY from the driver actor thread
+    (submit/report/probe/cleanup messages), so state needs no lock.
+
+    A job's DRR cost is its stage-launch opportunities (the sum of
+    ``num_partitions`` over non-driver stages): each admission debits
+    the winning tenant's deficit by that many launches, and every
+    admission opportunity credits each backlogged tenant its weight —
+    so over time tenants receive stage-launch opportunities
+    proportional to their weights."""
+
+    def __init__(self, conf: Optional[AdmissionConfig] = None):
+        self.conf = conf or AdmissionConfig()
+        self.enabled = self.conf.enabled
+        self._queues: Dict[str, Deque] = {}
+        self._deficit: Dict[str, float] = {}
+        self._running: Dict[str, set] = {}
+        self._mem_used: Dict[str, int] = {}
+        # (job_id, stage, partition) -> (tenant, bytes) for live debits
+        self._debits: Dict[Tuple[str, int, int], Tuple[str, int]] = {}
+        self._total_running = 0
+
+    # -- helpers ---------------------------------------------------------
+    @staticmethod
+    def job_cost(job) -> int:
+        launches = sum(s.num_partitions for s in job.graph.stages
+                       if not s.on_driver)
+        return max(1, int(launches))
+
+    def queue_depth(self, tenant: str) -> int:
+        return len(self._queues.get(tenant, ()))
+
+    def running_count(self, tenant: str) -> int:
+        return len(self._running.get(tenant, ()))
+
+    def quota_used(self, tenant: str) -> int:
+        return self._mem_used.get(tenant, 0)
+
+    def _can_run(self, tenant: str) -> bool:
+        pol = self.conf.policy(tenant)
+        if pol.max_jobs and self.running_count(tenant) >= pol.max_jobs:
+            return False
+        if self.conf.max_concurrent_jobs_total and \
+                self._total_running >= self.conf.max_concurrent_jobs_total:
+            return False
+        if pol.memory_quota_bytes and self.running_count(tenant) and \
+                self.quota_used(tenant) >= pol.memory_quota_bytes:
+            return False
+        return True
+
+    # -- lifecycle -------------------------------------------------------
+    def offer(self, job) -> str:
+        """Enqueue one submitted job. Returns ``"queued"`` or
+        ``"shed"`` (per-tenant queue full, or deadline already past) —
+        admission itself happens in :meth:`drain`, so queue order and
+        DRR state stay the single source of decision order."""
+        tenant = job.tenant
+        now = time.time()
+        if not self.enabled:
+            # pass-through: park in the tenant queue with no events or
+            # accounting; drain() admits unconditionally
+            self._queues.setdefault(tenant, deque()).append(job)
+            return "queued"
+        if job.deadline_ts is not None and now >= job.deadline_ts:
+            self._shed(job, "deadline")
+            return "shed"
+        q = self._queues.setdefault(tenant, deque())
+        if self.conf.max_queued_jobs and \
+                len(q) >= self.conf.max_queued_jobs:
+            self._shed(job, "queue_full")
+            return "shed"
+        job.adm_cost = self.job_cost(job)
+        job.queued_ts = now
+        q.append(job)
+        _record_metric("cluster.admission.enqueued_count", 1,
+                       tenant=tenant)
+        _record_metric("cluster.admission.queue_depth", len(q),
+                       tenant=tenant)
+        events.emit(EventType.ADMISSION_ENQUEUE, query_id=job.query_id,
+                    trace_id=_trace(job), job_id=job.job_id,
+                    tenant=tenant, queue_depth=len(q),
+                    cost=job.adm_cost)
+        return "queued"
+
+    def _shed(self, job, reason: str) -> None:
+        tenant = job.tenant
+        depth = self.queue_depth(tenant)
+        _record_metric("cluster.admission.shed_count", 1, tenant=tenant,
+                       reason=reason)
+        events.emit(EventType.ADMISSION_SHED, query_id=job.query_id,
+                    trace_id=_trace(job), job_id=job.job_id,
+                    tenant=tenant, reason=reason, queue_depth=depth)
+        job.error_kind = "deadline" if reason == "deadline" else "shed"
+        job.failed = (f"admission shed ({reason}): tenant "
+                      f"{tenant!r} queue depth {depth}")
+        job.done.set()
+
+    def poll(self, now: Optional[float] = None) -> List:
+        """Shed queued jobs whose queue budget or deadline expired.
+        Returns the shed jobs (already failed + done)."""
+        if not self.enabled:
+            return []
+        now = time.time() if now is None else now
+        shed: List = []
+        for tenant in sorted(self._queues):
+            q = self._queues[tenant]
+            keep = deque()
+            while q:
+                job = q.popleft()
+                if job.done.is_set():
+                    continue  # canceled while queued
+                if job.deadline_ts is not None and now >= job.deadline_ts:
+                    self._shed(job, "deadline")
+                    shed.append(job)
+                elif self.conf.queue_timeout_ms and \
+                        (now - job.queued_ts) * 1000.0 >= \
+                        self.conf.queue_timeout_ms:
+                    self._shed(job, "queue_timeout")
+                    shed.append(job)
+                else:
+                    keep.append(job)
+            self._queues[tenant] = keep
+        return shed
+
+    def drain(self) -> List:
+        """Deficit-round-robin pop of every currently admissible queued
+        job, in decision order. Each admission opportunity (a free
+        launch slot) credits every backlogged admissible tenant its
+        weight; the tenant with the highest deficit wins (ties broken
+        by tenant name) and pays the admitted job's cost in stage-launch
+        opportunities — so over a backlog, tenants receive launch
+        opportunities proportional to their weights regardless of job
+        sizes. The caller schedules each returned job (the admit event
+        fires here, so the log IS the decision order)."""
+        admitted: List = []
+        if not self.enabled:
+            for tenant in sorted(self._queues):
+                q = self._queues[tenant]
+                while q:
+                    job = q.popleft()
+                    job.admitted = True
+                    admitted.append(job)
+            return admitted
+        while True:
+            cands = [t for t in sorted(self._queues)
+                     if self._queues[t] and self._can_run(t)]
+            if not cands:
+                break
+            for t in cands:
+                self._deficit[t] = self._deficit.get(t, 0.0) \
+                    + self.conf.policy(t).weight
+            winner = min(cands,
+                         key=lambda t: (-self._deficit.get(t, 0.0), t))
+            q = self._queues[winner]
+            job = q.popleft()
+            if job.done.is_set():
+                continue  # shed/canceled while queued
+            # ALWAYS charge the admitted job's cost — a tenant that
+            # trickles heavy jobs one at a time (queue emptying on
+            # every pop) must not dodge its stage-launch debt — but an
+            # emptied queue forfeits any positive surplus: an idle
+            # tenant must not bank credit to burst with later
+            self._deficit[winner] = self._deficit.get(winner, 0.0) \
+                - job.adm_cost
+            if not q:
+                self._deficit[winner] = min(
+                    self._deficit[winner], 0.0)
+            self._admit(job)
+            admitted.append(job)
+        return admitted
+
+    def _admit(self, job) -> None:
+        tenant = job.tenant
+        job.admitted = True
+        self._running.setdefault(tenant, set()).add(job.job_id)
+        self._total_running += 1
+        waited_ms = round((time.time() - job.queued_ts) * 1000.0, 3)
+        _record_metric("cluster.admission.admitted_count", 1,
+                       tenant=tenant)
+        _record_metric("cluster.admission.queue_depth",
+                       self.queue_depth(tenant), tenant=tenant)
+        events.emit(EventType.ADMISSION_ADMIT, query_id=job.query_id,
+                    trace_id=_trace(job), job_id=job.job_id,
+                    tenant=tenant, waited_ms=waited_ms)
+
+    def release(self, job) -> None:
+        """A job left the running set (done + cleanup): free its
+        concurrency slot and any memory debits its tasks still hold.
+        Idempotent — cleanup and probe can both observe the exit."""
+        tenant = job.tenant
+        running = self._running.get(tenant)
+        if running is not None and job.job_id in running:
+            running.discard(job.job_id)
+            self._total_running = max(0, self._total_running - 1)
+        for key in [k for k in self._debits if k[0] == job.job_id]:
+            t, nbytes = self._debits.pop(key)
+            self._mem_used[t] = max(0, self._mem_used.get(t, 0) - nbytes)
+        if tenant in self._mem_used:
+            _record_metric("cluster.quota.debited_bytes",
+                           self._mem_used.get(tenant, 0), tenant=tenant)
+
+    # -- memory quota ledger (PR 7 governor projections) ----------------
+    def tenant_quota(self, tenant: str) -> int:
+        """The tenant's memory quota in bytes (0 = none/disabled)."""
+        if not self.enabled:
+            return 0
+        return self.conf.policy(tenant).memory_quota_bytes
+
+    def quota_admit(self, tenant: str, nbytes: int) -> bool:
+        """Would debiting ``nbytes`` keep the tenant under quota? A
+        tenant with NOTHING debited always admits (progress guarantee:
+        quota throttles, never deadlocks)."""
+        if not self.enabled:
+            return True
+        pol = self.conf.policy(tenant)
+        if not pol.memory_quota_bytes:
+            return True
+        used = self.quota_used(tenant)
+        return used == 0 or used + nbytes <= pol.memory_quota_bytes
+
+    def debit(self, job, stage: int, partition: int,
+              nbytes: int) -> None:
+        """Record one admitted task's projected bytes against its
+        tenant's quota. The projection comes from producers' REPORTED
+        channel sizes (the AQE-observed stats), so the ledger tracks
+        real data movement, not static estimates."""
+        if not self.enabled or nbytes <= 0:
+            return
+        tenant = job.tenant
+        key = (job.job_id, stage, partition)
+        prev = self._debits.pop(key, None)
+        if prev is not None:
+            self._mem_used[tenant] = max(
+                0, self._mem_used.get(tenant, 0) - prev[1])
+        self._debits[key] = (tenant, int(nbytes))
+        used = self._mem_used.get(tenant, 0) + int(nbytes)
+        self._mem_used[tenant] = used
+        _record_metric("cluster.quota.debited_bytes", used,
+                       tenant=tenant)
+        events.emit(EventType.QUOTA_DEBIT, query_id=job.query_id,
+                    trace_id=_trace(job), job_id=job.job_id,
+                    tenant=tenant, stage=stage, partition=partition,
+                    bytes=int(nbytes), used_bytes=used)
+
+    def credit(self, job_id: str, stage: int, partition: int) -> None:
+        """Release one task's debit (terminal report / task release)."""
+        entry = self._debits.pop((job_id, stage, partition), None)
+        if entry is None:
+            return
+        tenant, nbytes = entry
+        used = max(0, self._mem_used.get(tenant, 0) - nbytes)
+        self._mem_used[tenant] = used
+        _record_metric("cluster.quota.debited_bytes", used,
+                       tenant=tenant)
+
+
+def _trace(job) -> Optional[str]:
+    ctx = getattr(job, "trace_ctx", None)
+    return ctx.trace_id if ctx is not None else None
+
+
+# ---------------------------------------------------------------------------
+# session path: process-wide concurrent-query gate
+# ---------------------------------------------------------------------------
+
+class _Ticket:
+    """Handle returned by :meth:`SessionAdmission.acquire`; release()
+    exactly once (idempotent) frees the slot and wakes the next waiter."""
+
+    __slots__ = ("_gate", "_tenant", "_released")
+
+    def __init__(self, gate: Optional["SessionAdmission"], tenant: str):
+        self._gate = gate
+        self._tenant = tenant
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        if self._gate is not None:
+            self._gate._release(self._tenant)
+
+
+class _Waiter:
+    __slots__ = ("tenant", "seq", "event", "admitted", "abandoned")
+
+    def __init__(self, tenant: str, seq: int):
+        self.tenant = tenant
+        self.seq = seq
+        self.event = threading.Event()
+        self.admitted = False
+        self.abandoned = False
+
+
+class SessionAdmission:
+    """Weighted-fair gate on the local query path. Admission order is
+    deterministic given arrival + completion order: among tenants with
+    eligible waiters the lowest virtual time goes first (ties broken by
+    tenant name), FIFO within a tenant. Each admission advances the
+    tenant's virtual time by ``1/weight``; a tenant entering the wait
+    queue from idle is floored to the global virtual clock, so neither
+    a newcomer nor a long-idle tenant banks credit it could use to
+    starve established tenants."""
+
+    def __init__(self, conf: Optional[AdmissionConfig] = None):
+        self.conf = conf or AdmissionConfig()
+        self.enabled = self.conf.enabled
+        self._lock = threading.Lock()
+        self._running: Dict[str, int] = {}
+        self._total = 0
+        self._vt: Dict[str, float] = {}
+        self._vclock = 0.0
+        self._waiters: Dict[str, Deque[_Waiter]] = {}
+        self._seq = itertools.count()
+        self._tls = threading.local()
+
+    def _eligible(self, tenant: str) -> bool:
+        pol = self.conf.policy(tenant)
+        if pol.max_queries and \
+                self._running.get(tenant, 0) >= pol.max_queries:
+            return False
+        if self.conf.max_concurrent_total and \
+                self._total >= self.conf.max_concurrent_total:
+            return False
+        return True
+
+    def acquire(self, tenant: str, query_id: str = "",
+                deadline_ms: Optional[float] = None) -> _Ticket:
+        """Block until admitted, or raise a typed error. Re-entrant per
+        thread: a nested ``_execute_query`` (commands running
+        subqueries, streaming triggers inside a profiled query) rides
+        the thread's existing ticket instead of double-queuing.
+        Enforcement is process-wide (``admission.enabled``): there is
+        deliberately no per-call opt-out a tenant could reach."""
+        if not self.enabled:
+            return _Ticket(None, tenant)
+        depth = getattr(self._tls, "depth", 0)
+        if depth:
+            # nested: ride the held slot; release() just pops the depth
+            self._tls.depth = depth + 1
+            return _Ticket(self, tenant)
+        waiter: Optional[_Waiter] = None
+        shed_depth: Optional[int] = None
+        # decide under the lock; emit (which may write the durable
+        # event log) only AFTER releasing it — the gate must never
+        # serialize every tenant's admissions on event-log I/O
+        with self._lock:
+            queued = self._waiters.get(tenant)
+            if self._eligible(tenant) and not queued:
+                self._admit_locked(tenant)
+            else:
+                depth_now = len(queued or ())
+                if self.conf.max_queued_queries and \
+                        depth_now >= self.conf.max_queued_queries:
+                    shed_depth = depth_now
+                else:
+                    waiter = _Waiter(tenant, next(self._seq))
+                    wq = self._waiters.setdefault(tenant, deque())
+                    if not wq:
+                        # entering the contest from idle: floor the
+                        # virtual time to the global clock (no banked
+                        # credit)
+                        self._vt[tenant] = max(
+                            self._vt.get(tenant, 0.0), self._vclock)
+                    wq.append(waiter)
+        if shed_depth is not None:
+            _record_metric("cluster.admission.shed_count", 1,
+                           tenant=tenant, reason="queue_full")
+            events.emit(EventType.ADMISSION_SHED, query_id=query_id,
+                        job_id="", tenant=tenant, reason="queue_full",
+                        queue_depth=shed_depth)
+            raise ResourceExhausted(
+                f"tenant {tenant!r} admission queue is full "
+                f"({shed_depth} queued); retry after backoff",
+                tenant=tenant,
+                retry_after_ms=self.conf.queue_timeout_ms or 1000)
+        if waiter is not None:
+            # depth snapshot read outside the lock: telemetry only
+            depth = len(self._waiters.get(tenant, ()))
+            _record_metric("cluster.admission.enqueued_count", 1,
+                           tenant=tenant)
+            _record_metric("cluster.admission.queue_depth", depth,
+                           tenant=tenant)
+            events.emit(EventType.ADMISSION_ENQUEUE,
+                        query_id=query_id, job_id="", tenant=tenant,
+                        queue_depth=depth, cost=1)
+        if waiter is None:
+            events.emit(EventType.ADMISSION_ADMIT, query_id=query_id,
+                        job_id="", tenant=tenant, waited_ms=0.0)
+            self._tls.depth = 1
+            return _Ticket(self, tenant)
+        t0 = time.time()
+        timeout_s = self.conf.queue_timeout_ms / 1000.0 \
+            if self.conf.queue_timeout_ms else None
+        deadline_bound = deadline_ms is not None and deadline_ms > 0 and \
+            (timeout_s is None or deadline_ms / 1000.0 < timeout_s)
+        if deadline_bound:
+            timeout_s = deadline_ms / 1000.0
+        got = waiter.event.wait(timeout_s)
+        if not got:
+            with self._lock:
+                if not waiter.admitted:
+                    waiter.abandoned = True
+                    try:
+                        self._waiters.get(tenant, deque()).remove(waiter)
+                    except ValueError:
+                        pass
+                    got = False
+                else:
+                    got = True  # admission raced the timeout: take it
+            if not got:
+                reason = "deadline" if deadline_bound else "queue_timeout"
+                _record_metric("cluster.admission.shed_count", 1,
+                               tenant=tenant, reason=reason)
+                events.emit(EventType.ADMISSION_SHED, query_id=query_id,
+                            job_id="", tenant=tenant, reason=reason,
+                            queue_depth=len(self._waiters.get(
+                                tenant, ())))
+                waited = round((time.time() - t0) * 1000.0, 1)
+                if deadline_bound:
+                    raise DeadlineExceeded(
+                        f"query deadline ({deadline_ms:.0f}ms) elapsed "
+                        f"after {waited}ms in the admission queue",
+                        tenant=tenant)
+                raise ResourceExhausted(
+                    f"tenant {tenant!r} query waited {waited}ms in the "
+                    f"admission queue (budget "
+                    f"{self.conf.queue_timeout_ms}ms); retry after "
+                    f"backoff", tenant=tenant,
+                    retry_after_ms=self.conf.queue_timeout_ms or 1000)
+        events.emit(EventType.ADMISSION_ADMIT, query_id=query_id,
+                    job_id="", tenant=tenant,
+                    waited_ms=round((time.time() - t0) * 1000.0, 3))
+        self._tls.depth = 1
+        return _Ticket(self, tenant)
+
+    def _admit_locked(self, tenant: str) -> None:
+        self._running[tenant] = self._running.get(tenant, 0) + 1
+        self._total += 1
+        start = self._vt.get(tenant, 0.0)
+        self._vclock = max(self._vclock, start)
+        self._vt[tenant] = start + 1.0 / self.conf.policy(tenant).weight
+        _record_metric("cluster.admission.admitted_count", 1,
+                       tenant=tenant)
+
+    def _release(self, tenant: str) -> None:
+        depth = getattr(self._tls, "depth", 0)
+        if depth > 1:
+            self._tls.depth = depth - 1
+            return
+        self._tls.depth = 0
+        woken: List[_Waiter] = []
+        with self._lock:
+            self._running[tenant] = max(
+                0, self._running.get(tenant, 0) - 1)
+            self._total = max(0, self._total - 1)
+            while True:
+                cands = [t for t in sorted(self._waiters)
+                         if self._waiters[t] and self._eligible(t)]
+                if not cands:
+                    break
+                # lowest virtual time first, ties by tenant name
+                t = min(cands, key=lambda name: (
+                    self._vt.get(name, 0.0), name))
+                w = self._waiters[t].popleft()
+                if w.abandoned:
+                    continue
+                w.admitted = True
+                self._admit_locked(t)
+                woken.append(w)
+        for w in woken:
+            w.event.set()
+
+
+# ---------------------------------------------------------------------------
+# process-global session gate
+# ---------------------------------------------------------------------------
+
+_GATE: Optional[SessionAdmission] = None
+_GATE_LOCK = threading.Lock()
+
+
+def session_gate() -> SessionAdmission:
+    global _GATE
+    if _GATE is None:
+        with _GATE_LOCK:
+            if _GATE is None:
+                _GATE = SessionAdmission()
+    return _GATE
+
+
+def reload() -> None:
+    """Re-read the admission config (tests, bench A/B runs). In-flight
+    tickets release against the OLD gate they hold a reference to."""
+    global _GATE
+    with _GATE_LOCK:
+        _GATE = None

@@ -731,7 +731,8 @@ class WorkerActor(Actor):
             self._report(task, "running")
             recorder.emit(EventType.TASK_START, job_id=task.job_id,
                           stage=task.stage, partition=task.partition,
-                          attempt=task.attempt, worker=self.worker_id)
+                          attempt=task.attempt, worker=self.worker_id,
+                          tenant=task.tenant)
             span_ctx = tr._current()
             plan = jg.decode_fragment(task.plan, task.partition,
                                       max(task.num_partitions, 1))
@@ -920,9 +921,22 @@ _JOB_SEQ = itertools.count()
 
 class _Job:
     def __init__(self, job_id: str, graph: jg.JobGraph,
-                 trace_ctx=None, epoch: int = 0):
+                 trace_ctx=None, epoch: int = 0,
+                 tenant: str = "default"):
         self.job_id = job_id
         self.graph = graph
+        # multi-tenant admission control: the owning tenant, the DRR
+        # cost (stage-launch opportunities, stamped at offer), whether
+        # the fair queue admitted the job yet, an optional absolute
+        # deadline, and the typed failure kind ("shed" | "deadline")
+        # run_job maps to ResourceExhausted / DeadlineExceeded
+        self.tenant = tenant or "default"
+        self.adm_cost = 1
+        self.queued_ts = 0.0
+        self.admitted = False
+        self.deadline_ts: Optional[float] = None
+        self.deadline_ms = 0.0
+        self.error_kind = ""
         # flight-recorder envelope: the owning query's profile id,
         # stamped before submit so every driver/worker event of this
         # job carries it (empty for bare run_job calls until the
@@ -1096,6 +1110,11 @@ class DriverActor(Actor):
             "min_runtime_s": _num(
                 "cluster.speculation.min_runtime_ms", 500.0) / 1000.0,
         }
+        # multi-tenant admission control: the cross-job fair queue
+        # (weighted DRR over stage-launch opportunities, per-tenant
+        # concurrency + memory quotas, bounded queues with shedding)
+        from . import admission as _adm
+        self.admission = _adm.JobAdmissionQueue()
 
     def set_elastic(self, manager, min_workers: int = 1,
                     max_workers: int = 4, idle_secs: float = 60.0):
@@ -1209,8 +1228,13 @@ class DriverActor(Actor):
             job, reply = payload
             self.jobs[job.job_id] = job
             from ..catalog.system import SYSTEM
-            SYSTEM.record_job(job.job_id, len(job.graph.stages), "running")
-            self._schedule_ready_stages(job)
+            SYSTEM.record_job(job.job_id, len(job.graph.stages), "queued")
+            # jobs pass through the cross-job fair queue: a shed job is
+            # failed+done before the client's wait even starts (typed,
+            # never a hang), an admitted one schedules immediately, the
+            # rest wait for capacity under DRR
+            self.admission.offer(job)
+            self._drain_admission()
             if reply is not None:
                 reply.set(job)
         elif kind == "task_status":
@@ -1219,6 +1243,19 @@ class DriverActor(Actor):
             if job is not None and not job.done.is_set():
                 # a terminal report may have freed governor capacity
                 self._drain_deferred(job)
+            if job is not None:
+                # ...or per-tenant quota headroom: quota-parked tasks
+                # of the tenant's SIBLING jobs must not wait for the
+                # 2s probe tick when this job's credit freed capacity
+                for other in list(self.jobs.values()):
+                    if other is not job and not other.done.is_set() \
+                            and other.tenant == job.tenant \
+                            and other.deferred:
+                        self._drain_deferred(other)
+            # a stage report is also the earliest deadline-check and
+            # job-admission opportunity
+            self._check_deadlines(time.time())
+            self._drain_admission()
         elif kind == "cancel":
             job_id, reason = payload
             self._cancel_job(job_id, reason)
@@ -1303,6 +1340,43 @@ class DriverActor(Actor):
         for job in list(self.jobs.values()):
             if not job.done.is_set():
                 self._drain_deferred(job)
+        # admission backstop: expire queued jobs past their queue budget
+        # or deadline, cancel running jobs past their deadline, and
+        # admit whatever the fair queue can now run
+        self._check_deadlines(now)
+        self.admission.poll(now)
+        self._drain_admission()
+
+    def _drain_admission(self):
+        for job in self.admission.drain():
+            if job.done.is_set():
+                continue
+            from ..catalog.system import SYSTEM
+            SYSTEM.record_job(job.job_id, len(job.graph.stages),
+                              "running")
+            self._schedule_ready_stages(job)
+
+    def _check_deadlines(self, now: float):
+        """Per-query deadlines cancel through the existing CancelJob
+        path: cooperative worker-side stop, then the client-driven
+        cleanup wipes partial shuffle outputs via CleanUpJob. Queued
+        (not yet admitted) jobs are shed by ``admission.poll`` instead,
+        so the shed/cancel event streams stay disjoint."""
+        for job in list(self.jobs.values()):
+            if job.done.is_set() or job.deadline_ts is None or \
+                    not job.admitted or now < job.deadline_ts:
+                continue
+            overrun = round((now - job.deadline_ts) * 1000.0, 3)
+            _record_metric("cluster.admission.deadline_cancel_count", 1,
+                           tenant=job.tenant)
+            events.emit(EventType.DEADLINE_CANCEL,
+                        query_id=job.query_id, trace_id=_jtrace(job),
+                        job_id=job.job_id, tenant=job.tenant,
+                        deadline_ms=job.deadline_ms, overrun_ms=overrun)
+            job.error_kind = "deadline"
+            self._cancel_job(job.job_id,
+                             f"deadline ({job.deadline_ms:.0f}ms) "
+                             f"exceeded")
 
     def _evict_worker(self, wid: str, reason: str):
         """Remove a dead/blacklisted worker and repair every live job:
@@ -1437,14 +1511,15 @@ class DriverActor(Actor):
                 total += int(wire * scale)
         return total
 
-    @staticmethod
-    def _release_task(w: dict, key: Tuple[str, int, int]) -> None:
+    def _release_task(self, w: dict, key: Tuple[str, int, int]) -> None:
         """Unregister a task from a worker AND release its admitted
-        footprint from the governor's per-worker projection."""
+        footprint from the governor's per-worker projection and the
+        owning tenant's quota ledger."""
         w["tasks"].discard(key)
         proj = w.get("task_proj", {}).pop(key, 0)
         if proj:
             w["projected"] = max(0, w.get("projected", 0) - proj)
+        self.admission.credit(key[0], key[1], key[2])
 
     def _drain_deferred(self, job: _Job) -> None:
         """Relaunch governor-deferred tasks now that capacity may have
@@ -1574,16 +1649,43 @@ class DriverActor(Actor):
             job_id=job.job_id, stage=stage_id, partition=partition,
             attempt=attempt, plan=encode_cached(job, stage),
             num_partitions=stage.num_partitions, inputs=inputs,
-            driver_addr=self.addr, epoch=job.epoch,
+            driver_addr=self.addr, epoch=job.epoch, tenant=job.tenant,
             runtime_filters_json=job.graph.stage_filters.get(stage_id, ""))
         if stage.shuffle_keys is not None and stage.num_channels > 1:
             task.shuffle_write.CopyFrom(pb.ShuffleWriteSpec(
                 key_columns=list(stage.shuffle_keys),
                 num_channels=stage.num_channels))
-        # memory governor: project this task's input footprint once; the
-        # admission check runs against each candidate worker below
+        # memory governor + tenant quota: project this task's input
+        # footprint once (observed producer channel sizes); the worker
+        # admission check runs against each candidate below, the tenant
+        # quota check here — a tenant over its projected-bytes quota
+        # parks the task until its own tasks release capacity (a tenant
+        # with nothing debited always admits: throttle, never deadlock)
+        quota = self.admission.tenant_quota(job.tenant)
         proj = self._projected_task_bytes(job, stage_id, partition) \
-            if self.memory_budget_bytes > 0 else None
+            if (self.memory_budget_bytes > 0 or quota > 0) else None
+        if quota > 0 and proj is not None and \
+                not self.admission.quota_admit(job.tenant, proj):
+            if speculative:
+                return False  # never park a duplicate
+            job.deferred.append((
+                stage_id, partition, attempt,
+                frozenset(exclude) if exclude else None))
+            _record_metric("cluster.quota.deferred_count", 1,
+                           tenant=job.tenant)
+            events.emit(EventType.ADMISSION_DEFER,
+                        query_id=job.query_id, trace_id=_jtrace(job),
+                        job_id=job.job_id, tenant=job.tenant,
+                        reason="quota", stage=stage_id,
+                        partition=partition)
+            return True  # parked: _drain_deferred relaunches
+        # the per-worker governor filter below only runs when the worker
+        # memory budget is configured; a quota-only projection must not
+        # engage it
+        if self.memory_budget_bytes <= 0:
+            gproj = None
+        else:
+            gproj = proj
         # dispatch loop (NOT recursion): a flapping pool can no longer
         # blow the stack, and each failed dispatch evicts its worker and
         # reschedules ALL of that worker's running tasks, not just this
@@ -1607,7 +1709,7 @@ class DriverActor(Actor):
                 job.failed = "no live workers"
                 job.done.set()
                 return False
-            if proj is not None:
+            if gproj is not None:
                 # admit by projected bytes against the budget; a worker
                 # with no admitted tasks always admits one (progress
                 # guarantee), so the governor throttles wide shuffles
@@ -1615,7 +1717,8 @@ class DriverActor(Actor):
                 admissible = [
                     (wid, w) for wid, w in candidates
                     if not w["tasks"] or
-                    w.get("projected", 0) + proj <= self.memory_budget_bytes]
+                    w.get("projected", 0) + gproj <=
+                    self.memory_budget_bytes]
                 if not admissible:
                     if speculative:
                         return False  # never park a duplicate
@@ -1636,10 +1739,10 @@ class DriverActor(Actor):
                 self._maybe_scale_up()
             w["tasks"].add((job.job_id, stage_id, partition))
             w["idle_since"] = None
-            if proj is not None:
+            if gproj is not None:
                 w.setdefault("task_proj", {})[
-                    (job.job_id, stage_id, partition)] = proj
-                w["projected"] = w.get("projected", 0) + proj
+                    (job.job_id, stage_id, partition)] = gproj
+                w["projected"] = w.get("projected", 0) + gproj
                 _record_metric("cluster.governor.admitted_count", 1)
                 _record_metric("cluster.governor.projected_bytes",
                                w["projected"])
@@ -1647,7 +1750,12 @@ class DriverActor(Actor):
                             query_id=job.query_id,
                             trace_id=_jtrace(job), job_id=job.job_id,
                             stage=stage_id, partition=partition,
-                            worker=wid, projected_bytes=int(proj))
+                            worker=wid, projected_bytes=int(gproj))
+            if quota > 0 and proj is not None:
+                # tenant-quota ledger: debit the observed-size
+                # projection now; _release_task credits it back on any
+                # terminal report or dispatch failure
+                self.admission.debit(job, stage_id, partition, proj)
             rpc = w["channel"].unary_unary(
                 f"/{_WORKER_SERVICE}/RunTask",
                 request_serializer=lambda m: m.SerializeToString(),
@@ -2073,7 +2181,16 @@ class DriverActor(Actor):
             SYSTEM.record_job(job_id, len(job.graph.stages),
                               "failed" if job.failed else "finished",
                               job.stage_rows)
+            # free the tenant's concurrency slot + any residual quota
+            # debits, then let the fair queue admit the next job and
+            # un-park any same-tenant tasks the released quota frees
+            self.admission.release(job)
+            for other in list(self.jobs.values()):
+                if other is not job and not other.done.is_set() \
+                        and other.tenant == job.tenant and other.deferred:
+                    self._drain_deferred(other)
         self.jobs.pop(job_id, None)
+        self._drain_admission()
         for w in self.workers.values():
             rpc = w["channel"].unary_unary(
                 f"/{_WORKER_SERVICE}/CleanUpJob",
@@ -2154,7 +2271,9 @@ class LocalCluster:
 
     def run_job(self, plan, num_partitions: Optional[int] = None,
                 timeout=120, epoch: int = 0,
-                job_id: Optional[str] = None):
+                job_id: Optional[str] = None,
+                tenant: Optional[str] = None,
+                deadline_ms: Optional[float] = None):
         """Distribute a plan; returns the result pyarrow Table.
 
         ``epoch``/``job_id`` serve the streaming runner: a streaming
@@ -2162,7 +2281,15 @@ class LocalCluster:
         trigger with its epoch, so its shuffle channels publish and
         fetch under (job_id, epoch) — barrier-aligned per epoch, with a
         failed trigger's channels wiped (discarded stage) and a
-        restarted trigger re-running under the SAME epoch id."""
+        restarted trigger re-running under the SAME epoch id.
+
+        ``tenant``/``deadline_ms`` feed the driver's admission queue:
+        jobs schedule under weighted-fair queuing with per-tenant
+        quotas; a shed job raises a typed retryable
+        :class:`~sail_tpu.exec.admission.ResourceExhausted`, a blown
+        deadline cancels through CancelJob and raises
+        :class:`~sail_tpu.exec.admission.DeadlineExceeded`. Defaults
+        come from the ``admission.*`` config."""
         import pyarrow as pa
         from .local import LocalExecutor
         from .. import profiler
@@ -2178,11 +2305,19 @@ class LocalCluster:
         graph = jg.split_job(plan, nparts)
         if graph is None:
             return LocalExecutor().execute(plan)
+        adm_conf = self.driver.admission.conf
+        if tenant is None:
+            tenant = adm_conf.default_tenant
+        if deadline_ms is None and adm_conf.default_deadline_ms:
+            deadline_ms = float(adm_conf.default_deadline_ms)
         with tr.span("cluster:job") as root_span:
             job = _Job(job_id or uuid.uuid4().hex[:12], graph,
                        trace_ctx=tr.SpanContext(root_span.trace_id,
                                                 root_span.span_id),
-                       epoch=epoch)
+                       epoch=epoch, tenant=tenant)
+            if deadline_ms and deadline_ms > 0:
+                job.deadline_ms = float(deadline_ms)
+                job.deadline_ts = time.time() + deadline_ms / 1000.0
             # joins the session's profile when the job runs inside one;
             # a standalone run_job still gets its own profile record.
             # Execute/fetch phases come from the root-stage executor —
@@ -2214,6 +2349,15 @@ class LocalCluster:
                 job.done.wait(5.0)
                 raise TimeoutError("cluster job timed out")
             if job.failed:
+                from . import admission as adm
+                if job.error_kind == "shed":
+                    raise adm.ResourceExhausted(
+                        job.failed, tenant=job.tenant,
+                        retry_after_ms=self.driver.admission.conf
+                        .queue_timeout_ms or 1000)
+                if job.error_kind == "deadline":
+                    raise adm.DeadlineExceeded(job.failed,
+                                               tenant=job.tenant)
                 if job.canceled:
                     raise RuntimeError(f"cluster job {job.failed}")
                 raise RuntimeError(f"cluster job failed: {job.failed}")

@@ -53,6 +53,9 @@ class QueryProfile:
     query_id: str
     statement: str = ""
     session: str = ""
+    # admission-control tenant the query billed to (multi-tenant
+    # serving; "" for unattributed internal queries)
+    tenant: str = ""
     start_time: float = 0.0
     end_time: float = 0.0
     status: str = "running"          # running | succeeded | failed
@@ -319,6 +322,7 @@ class QueryProfile:
             "query_id": self.query_id,
             "statement": self.statement,
             "session": self.session,
+            "tenant": self.tenant,
             "status": self.status,
             "error": self.error,
             "start_time": self.start_time,
@@ -557,7 +561,7 @@ def _slow_threshold_ms(conf) -> float:
 
 @contextmanager
 def profile_query(statement: str = "", session: str = "", conf=None,
-                  enabled: bool = True):
+                  enabled: bool = True, tenant: str = ""):
     """Open (or join) the thread's query profile.
 
     The OUTERMOST caller owns the profile: nested entries (commands that
@@ -579,7 +583,7 @@ def profile_query(statement: str = "", session: str = "", conf=None,
     profile = QueryProfile(
         query_id=uuid.uuid4().hex[:16],
         statement=(statement or "")[:_STATEMENT_MAX],
-        session=session, start_time=time.time())
+        session=session, tenant=tenant, start_time=time.time())
     from . import tracing as tr
     profile.trace_id = tr.current_trace_id()
     _local.profile = profile
@@ -590,7 +594,7 @@ def profile_query(statement: str = "", session: str = "", conf=None,
                      query_id=profile.query_id,
                      trace_id=profile.trace_id,
                      statement=profile.statement[:200],
-                     session=profile.session)
+                     session=profile.session, tenant=profile.tenant)
     except Exception:  # noqa: BLE001 — telemetry must never break queries
         pass
     try:

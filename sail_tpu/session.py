@@ -50,11 +50,15 @@ class SparkSession:
 
     builder = None  # replaced below by a property-like descriptor
 
-    def __init__(self, conf: Optional[Dict[str, str]] = None):
+    def __init__(self, conf: Optional[Dict[str, str]] = None,
+                 catalog_manager: Optional[CatalogManager] = None):
         import uuid
         from collections import OrderedDict
         self.conf = SessionConf(conf or {})
-        self.catalog_manager = CatalogManager()
+        # ``catalog_manager`` is shared by sibling sessions created via
+        # newSession(): tables/views/UDFs are engine-wide, the conf (and
+        # with it the tenant tag) is strictly per session
+        self.catalog_manager = catalog_manager or CatalogManager()
         from .exec.local import LocalExecutor
         self._executor_cls = LocalExecutor
         self.catalog = Catalog(self)
@@ -64,6 +68,24 @@ class SparkSession:
         # SQL text + parse wall time per root plan, consumed when the
         # plan executes so the query profile can carry both
         self._parsed: "OrderedDict[int, tuple]" = OrderedDict()
+
+    def newSession(self) -> "SparkSession":
+        """A sibling session: same catalog (tables, temp views, UDFs),
+        fresh independent :class:`SessionConf` — conf keys and the
+        ``spark.sail.tenant`` tag set on one session can never bleed
+        into another session's queries or profiles."""
+        return SparkSession({}, catalog_manager=self.catalog_manager)
+
+    @property
+    def tenant(self) -> str:
+        """The admission-control tenant this session's queries bill to
+        (``spark.sail.tenant``; ``admission.tenant`` config default)."""
+        t = self.conf.get("spark.sail.tenant")
+        if t:
+            return str(t)
+        from .config import get as config_get
+        return str(config_get("admission.tenant", "default")
+                   or "default")
 
     # -- plan execution ----------------------------------------------------
     def _resolve(self, plan: sp.QueryPlan):
@@ -96,13 +118,31 @@ class SparkSession:
 
     def _execute_query(self, plan: sp.QueryPlan) -> pa.Table:
         from . import profiler
+        from .exec import admission
         from .utils.tz import reset_session_timezone, set_session_timezone
         text, parse_ms, exempt = self._parsed_info(plan)
+        tenant = self.tenant
         with profiler.profile_query(text, session=self._session_id,
-                                    conf=self.conf,
+                                    conf=self.conf, tenant=tenant,
                                     enabled=not exempt) as prof:
             if parse_ms and "parse" not in prof.phases:
                 prof.add_phase("parse", parse_ms)
+            # multi-tenant admission: acquire a per-tenant query slot
+            # (weighted-fair wake order, bounded queue) BEFORE any
+            # resolution/execution work; overflow/timeout raises a
+            # typed retryable ResourceExhausted instead of hanging.
+            # Nested _execute_query calls ride the outer ticket.
+            # Enforcement is PROCESS-wide (admission.enabled app
+            # config) — a tenant-controlled session conf must not be
+            # able to opt out of the isolation layer.
+            deadline = self.conf.get("spark.sail.query.deadlineMs")
+            try:
+                deadline_ms = float(deadline) if deadline else None
+            except (TypeError, ValueError):
+                deadline_ms = None
+            ticket = admission.session_gate().acquire(
+                tenant, query_id=prof.query_id,
+                deadline_ms=deadline_ms)
             token = set_session_timezone(
                 self.conf.get("spark.sql.session.timeZone") or "UTC")
             try:
@@ -119,6 +159,7 @@ class SparkSession:
                 return table
             finally:
                 reset_session_timezone(token)
+                ticket.release()
 
     def _try_mesh_execute(self, node) -> Optional[pa.Table]:
         """SPMD path: when the plan splits into co-resident stages and the
@@ -952,7 +993,16 @@ class SessionConf:
                 ("faults.spec", "spark.sail.faults.spec"),
                 ("faults.seed", "spark.sail.faults.seed"),
                 ("analysis.validate_plans",
-                 "spark.sail.analysis.validatePlans")):
+                 "spark.sail.analysis.validatePlans"),
+                # multi-tenant admission control (exec/admission.py):
+                # only the keys _execute_query actually reads per
+                # session mirror here — enforcement (enabled) and all
+                # caps/weights/quotas are process-wide (admission.*
+                # app config / SAIL_ADMISSION env), never per-session,
+                # so a tenant cannot opt itself out
+                ("admission.tenant", "spark.sail.tenant"),
+                ("admission.default_deadline_ms",
+                 "spark.sail.query.deadlineMs")):
             value = app.get(yaml_key)
             if value is not None:
                 base[conf_key] = str(value)

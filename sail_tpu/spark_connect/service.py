@@ -102,9 +102,18 @@ class SparkConnectServer:
 
     @staticmethod
     def _abort(context, e: Exception):
-        code = grpc.StatusCode.INVALID_ARGUMENT if isinstance(
-            e, (ConvertError, ValueError, NotImplementedError)) \
-            else grpc.StatusCode.INTERNAL
+        from ..exec.admission import DeadlineExceeded, ResourceExhausted
+        if isinstance(e, ResourceExhausted):
+            # typed, retryable load shed: the client backs off and
+            # resubmits (nothing executed — no partial side effects)
+            code = grpc.StatusCode.RESOURCE_EXHAUSTED
+        elif isinstance(e, DeadlineExceeded):
+            code = grpc.StatusCode.DEADLINE_EXCEEDED
+        elif isinstance(e, (ConvertError, ValueError,
+                            NotImplementedError)):
+            code = grpc.StatusCode.INVALID_ARGUMENT
+        else:
+            code = grpc.StatusCode.INTERNAL
         context.abort(code, f"{type(e).__name__}: {e}")
 
     # ------------------------------------------------------------------

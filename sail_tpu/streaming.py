@@ -810,8 +810,12 @@ class StreamingQuery:
     def _execute_plan(self, bound: sp.QueryPlan, epoch: int):
         if self._cluster is not None:
             node = self._session._resolve(bound)
+            # epoch jobs bill to the owning session's tenant — a
+            # streaming query must not escape its tenant's caps/quota
+            # by running under the default tenant
             return self._cluster.run_job(node, epoch=epoch,
-                                         job_id=self._cluster_job_id)
+                                         job_id=self._cluster_job_id,
+                                         tenant=self._session.tenant)
         return self._session._execute_query(bound)
 
     # -- stateful processing --------------------------------------------

@@ -101,6 +101,10 @@ def main():
     fetch = _message(fdp, "FetchStreamRequest")
     changed |= _add_field(fetch, "epoch", 7, F.TYPE_UINT64)
 
+    # multi-tenant admission control: every task carries its tenant tag
+    # so worker-side events attribute to the owning tenant
+    changed |= _add_field(task, "tenant", 13, F.TYPE_STRING)
+
     if not changed:
         print("pb2 already up to date")
         return

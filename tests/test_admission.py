@@ -1,0 +1,696 @@
+"""Multi-tenant admission control: per-tenant quotas, weighted-fair
+scheduling, graceful load shedding (exec/admission.py + the cluster
+driver's cross-job fair queue + the session gate).
+
+Chaos matrix (ISSUE 12): hostile-tenant flood, quota-exceeded shed is
+retryable and leaks no partial shuffle output, deadline cancel
+mid-stage cleans up via CleanUpJob, fair-share convergence under worker
+eviction — all results bit-identical to serial execution, zero
+deadlocks/hangs, and every shed query receives a typed retryable error.
+"""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sail_tpu import SparkSession, events, faults
+from sail_tpu.exec import admission
+from sail_tpu.exec.admission import (AdmissionConfig, DeadlineExceeded,
+                                     JobAdmissionQueue,
+                                     ResourceExhausted, SessionAdmission,
+                                     parse_tenant_overrides)
+from sail_tpu.exec.cluster import LocalCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_admission_env(monkeypatch):
+    faults.reset()
+    admission.reload()
+    yield
+    faults.reset()
+    admission.reload()
+
+
+def _plan_for(spark, sql):
+    from sail_tpu.sql import parse_one
+    return spark._resolve(parse_one(sql))
+
+
+def _canon(table):
+    return table.sort_by([(c, "ascending") for c in table.column_names])
+
+
+def _agg_plan(rows=4000, seed=21, view="adm_t"):
+    spark = SparkSession({})
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({"g": rng.integers(0, 8, rows),
+                       "v": rng.integers(0, 1000, rows)})
+    spark.createDataFrame(df).createOrReplaceTempView(view)
+    return _plan_for(
+        spark,
+        f"SELECT g, sum(v) AS s, count(*) AS c FROM {view} GROUP BY g")
+
+
+def _stub_job(job_id, tenant, launches=4):
+    """A minimal _Job stand-in for JobAdmissionQueue unit tests."""
+    stage = types.SimpleNamespace(num_partitions=launches,
+                                  on_driver=False)
+    return types.SimpleNamespace(
+        job_id=job_id, tenant=tenant, query_id="", trace_ctx=None,
+        graph=types.SimpleNamespace(stages=[stage]),
+        adm_cost=1, queued_ts=0.0, admitted=False,
+        deadline_ts=None, deadline_ms=0.0, error_kind="",
+        failed=None, done=threading.Event())
+
+
+# ---------------------------------------------------------------------------
+# unit: tenant policy + DRR fair queue
+# ---------------------------------------------------------------------------
+
+def test_tenant_override_parse():
+    spec = "analytics:weight=4,memMb=512;batch:weight=1,maxJobs=1," \
+           "maxQueries=2;bad;also:bad=x,weight=3"
+    out = parse_tenant_overrides(spec)
+    assert out["analytics"] == {"weight": 4, "memMb": 512}
+    assert out["batch"] == {"weight": 1, "maxJobs": 1, "maxQueries": 2}
+    assert out["also"] == {"weight": 3}
+    assert "bad" not in out
+
+
+def test_policy_defaults_and_overrides(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__TENANTS",
+                       "vip:weight=4,memMb=64")
+    monkeypatch.setenv("SAIL_ADMISSION__MEMORY_QUOTA_MB", "16")
+    conf = AdmissionConfig()
+    assert conf.policy("vip").weight == 4
+    assert conf.policy("vip").memory_quota_bytes == 64 << 20
+    assert conf.policy("other").weight == 1
+    assert conf.policy("other").memory_quota_bytes == 16 << 20
+
+
+def test_drr_weighted_order_is_deterministic_and_proportional(
+        monkeypatch):
+    """With a global running-job cap of 1, a weight-2 tenant receives
+    ~2x the admissions of a weight-1 tenant, in a deterministic order
+    given arrival order."""
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS_TOTAL", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS", "0")
+    monkeypatch.setenv("SAIL_ADMISSION__TENANTS", "b:weight=2")
+
+    def run_once():
+        q = JobAdmissionQueue()
+        jobs = {}
+        for i in range(6):
+            for t in ("a", "b"):
+                j = _stub_job(f"{t}{i}", t)
+                jobs[j.job_id] = j
+                assert q.offer(j) == "queued"
+        order = []
+        while True:
+            admitted = q.drain()
+            if not admitted:
+                break
+            assert len(admitted) == 1  # global cap of 1
+            job = admitted[0]
+            order.append(job.tenant)
+            q.release(job)
+        return order
+
+    order1 = run_once()
+    assert run_once() == order1  # deterministic given arrival order
+    assert len(order1) == 12
+    # proportionality: in every prefix window of 6, b gets >= 3
+    first6 = order1[:6]
+    assert first6.count("b") >= 3
+    assert order1.count("a") == 6 and order1.count("b") == 6
+
+
+def test_drr_trickle_heavy_jobs_still_pay_their_cost(monkeypatch):
+    """A tenant that trickle-submits heavy jobs one at a time (its
+    queue empties on every pop) must still pay each job's stage-launch
+    cost: with equal weights, cost-16 jobs earn ~1 admission per 16 of
+    a backlogged cost-1 tenant's."""
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS_TOTAL", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS", "0")
+    q = JobAdmissionQueue()
+    b_jobs = [_stub_job(f"b{i}", "b", launches=1) for i in range(20)]
+    for j in b_jobs:
+        q.offer(j)
+    a_seq = iter(range(100))
+    q.offer(_stub_job(f"a{next(a_seq)}", "a", launches=16))
+    order = []
+    while len(order) < 17:
+        admitted = q.drain()
+        if not admitted:
+            break
+        job = admitted[0]
+        order.append(job.tenant)
+        q.release(job)
+        if job.tenant == "a":
+            # trickle: the next heavy job arrives only after the
+            # previous one finished (queue was empty in between)
+            q.offer(_stub_job(f"a{next(a_seq)}", "a", launches=16))
+    assert order.count("a") == 1, order
+
+
+def test_session_gate_idle_tenant_cannot_bank_credit(monkeypatch):
+    """A tenant joining the contest after another tenant ran alone for
+    a while is floored to the global virtual clock: it must not win
+    every wake until its lifetime count catches up."""
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_QUERIES", "8")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_TOTAL", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__QUEUE_TIMEOUT_MS", "10000")
+    gate = SessionAdmission()
+    for _ in range(10):  # tenant a runs alone: virtual time advances
+        gate.acquire("a").release()
+    held = gate.acquire("a")
+    order = []
+    lock = threading.Lock()
+    threads = []
+
+    def worker(tenant):
+        t = gate.acquire(tenant)
+        with lock:
+            order.append(tenant)
+        time.sleep(0.01)
+        t.release()
+
+    # interleave 3 waiters each; b is the newcomer
+    for _ in range(3):
+        for tenant in ("a", "b"):
+            th = threading.Thread(target=worker, args=(tenant,))
+            th.start()
+            threads.append(th)
+            time.sleep(0.02)
+    held.release()
+    for th in threads:
+        th.join(10)
+    assert len(order) == 6
+    # unfloored, b would take the first 3 slots outright
+    assert order[:4].count("a") == 2, order
+
+
+def test_job_queue_shed_on_overflow_and_deadline(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_QUEUED_JOBS", "2")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS_TOTAL", "1")
+    q = JobAdmissionQueue()
+    j1, j2, j3 = (_stub_job(f"j{i}", "t") for i in range(3))
+    assert q.offer(j1) == "queued"
+    assert q.offer(j2) == "queued"
+    assert q.offer(j3) == "shed"
+    assert j3.error_kind == "shed" and j3.done.is_set()
+    # an already-expired deadline sheds at offer time with kind deadline
+    j4 = _stub_job("j4", "u")
+    j4.deadline_ts = time.time() - 1.0
+    assert q.offer(j4) == "shed"
+    assert j4.error_kind == "deadline"
+
+
+def test_job_queue_timeout_poll_sheds(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__QUEUE_TIMEOUT_MS", "10")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS_TOTAL", "1")
+    q = JobAdmissionQueue()
+    blocker = _stub_job("run", "t")
+    q.offer(blocker)
+    assert [j.job_id for j in q.drain()] == ["run"]
+    waiter = _stub_job("wait", "t")
+    q.offer(waiter)
+    shed = q.poll(now=time.time() + 1.0)
+    assert [j.job_id for j in shed] == ["wait"]
+    assert waiter.error_kind == "shed"
+
+
+def test_quota_ledger_progress_guarantee(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__MEMORY_QUOTA_MB", "1")
+    q = JobAdmissionQueue()
+    job = _stub_job("j", "t")
+    # empty ledger always admits, even a projection above quota
+    assert q.quota_admit("t", 10 << 20)
+    q.debit(job, 1, 0, 10 << 20)
+    assert not q.quota_admit("t", 1)
+    q.credit("j", 1, 0)
+    assert q.quota_admit("t", 1)
+    # release() clears any residual debits
+    q.debit(job, 1, 1, 5 << 20)
+    q.release(job)
+    assert q.quota_used("t") == 0
+
+
+# ---------------------------------------------------------------------------
+# unit: session gate
+# ---------------------------------------------------------------------------
+
+def test_session_gate_sheds_typed_retryable(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_QUERIES", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_QUEUED_QUERIES", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__QUEUE_TIMEOUT_MS", "200")
+    gate = SessionAdmission()
+    t1 = gate.acquire("t")
+    errors = []
+
+    def waiter():
+        try:
+            gate.acquire("t").release()
+        except admission.AdmissionError as e:
+            errors.append(e)
+
+    # first waiter queues (depth 1), second overflows the queue bound.
+    # Waiters must run on their own threads: the gate is re-entrant per
+    # thread and this thread already holds t1.
+    w1 = threading.Thread(target=waiter)
+    w1.start()
+    time.sleep(0.05)
+    w2 = threading.Thread(target=waiter)
+    w2.start()
+    w2.join(2)
+    assert len(errors) == 1
+    assert isinstance(errors[0], ResourceExhausted)
+    assert errors[0].retryable and errors[0].retry_after_ms > 0
+    t1.release()  # wakes w1
+    w1.join(2)
+    assert len(errors) == 1  # w1 was admitted, not shed
+
+
+def test_session_gate_queue_timeout_and_deadline(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_QUERIES", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__QUEUE_TIMEOUT_MS", "100")
+    gate = SessionAdmission()
+    held = gate.acquire("t")
+    out = {}
+
+    def timed_out():
+        try:
+            gate.acquire("t")
+        except Exception as e:  # noqa: BLE001
+            out["timeout"] = e
+
+    def deadlined():
+        try:
+            gate.acquire("t", deadline_ms=30)
+        except Exception as e:  # noqa: BLE001
+            out["deadline"] = e
+
+    th1 = threading.Thread(target=timed_out)
+    th2 = threading.Thread(target=deadlined)
+    th1.start()
+    th2.start()
+    th1.join(3)
+    th2.join(3)
+    held.release()
+    assert isinstance(out["timeout"], ResourceExhausted)
+    assert out["timeout"].retryable
+    assert isinstance(out["deadline"], DeadlineExceeded)
+    assert not out["deadline"].retryable
+
+
+def test_session_gate_weighted_fair_wake_order(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_QUERIES", "8")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_TOTAL", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__TENANTS", "vip:weight=3")
+    monkeypatch.setenv("SAIL_ADMISSION__QUEUE_TIMEOUT_MS", "10000")
+    gate = SessionAdmission()
+    first = gate.acquire("seed")
+    order = []
+    lock = threading.Lock()
+    threads = []
+
+    def worker(tenant):
+        t = gate.acquire(tenant)
+        with lock:
+            order.append(tenant)
+        time.sleep(0.01)
+        t.release()
+
+    # queue 3 vip + 3 std waiters while the total cap is held
+    for i in range(3):
+        for tenant in ("std", "vip"):
+            th = threading.Thread(target=worker, args=(tenant,))
+            th.start()
+            threads.append(th)
+            time.sleep(0.02)  # deterministic FIFO arrival
+    first.release()
+    for th in threads:
+        th.join(10)
+    assert len(order) == 6
+    # weight-3 vip drains ahead: at least 2 of the first 3 admissions
+    assert order[:3].count("vip") >= 2
+
+
+def test_session_gate_reentrant_per_thread(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_QUERIES", "1")
+    gate = SessionAdmission()
+    outer = gate.acquire("t")
+    inner = gate.acquire("t")  # must not deadlock on the held slot
+    inner.release()
+    outer.release()
+    # fully released: a fresh acquire admits immediately
+    gate.acquire("t").release()
+
+
+# ---------------------------------------------------------------------------
+# session integration: newSession isolation + gate wiring
+# ---------------------------------------------------------------------------
+
+def test_new_session_conf_and_tenant_isolation():
+    """Regression (ISSUE 12 satellite): two sessions' conf/tenant tags
+    never bleed into each other's queries or profiles."""
+    s1 = SparkSession({})
+    s2 = s1.newSession()
+    assert s1._session_id != s2._session_id
+    assert s2.catalog_manager is s1.catalog_manager  # shared catalog
+    s1.conf.set("spark.sail.tenant", "alpha")
+    s1.conf.set("spark.sql.shuffle.partitions", "3")
+    s2.conf.set("spark.sail.tenant", "beta")
+    assert s1.tenant == "alpha" and s2.tenant == "beta"
+    assert s1.conf.get("spark.sql.shuffle.partitions") == "3"
+    assert s2.conf.get("spark.sql.shuffle.partitions") == "8"
+    # a shared table registered through one session is visible in the
+    # sibling, but each query profile carries its own session's tenant
+    s1.createDataFrame(pd.DataFrame({"x": [1, 2, 3]})) \
+        .createOrReplaceTempView("iso_t")
+    from sail_tpu.profiler import FLIGHT_RECORDER
+    r1 = s1.sql("SELECT sum(x) AS s FROM iso_t").toArrow()
+    r2 = s2.sql("SELECT sum(x) AS s FROM iso_t").toArrow()
+    assert r1.equals(r2)
+    profs = [p for p in FLIGHT_RECORDER.profiles()
+             if "iso_t" in p.statement]
+    by_session = {p.session: p.tenant for p in profs[-2:]}
+    assert by_session[s1._session_id] == "alpha"
+    assert by_session[s2._session_id] == "beta"
+
+
+def test_session_query_shed_is_typed_and_retry_succeeds(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_QUERIES", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_QUEUED_QUERIES", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__QUEUE_TIMEOUT_MS", "30000")
+    admission.reload()
+    spark = SparkSession({})
+    spark.createDataFrame(pd.DataFrame({"x": list(range(100))})) \
+        .createOrReplaceTempView("shed_t")
+    spark.sql("SELECT sum(x) AS s FROM shed_t").toArrow()  # warm
+    release = threading.Event()
+    entered = threading.Event()
+    gate = admission.session_gate()
+
+    def hold(tenant):
+        t = gate.acquire(tenant)
+        entered.set()
+        release.wait(10)
+        t.release()
+
+    holder = threading.Thread(target=hold, args=("default",))
+    holder.start()
+    assert entered.wait(5)
+    # slot held; fill the 1-deep queue with a second thread
+    q_entered = threading.Event()
+
+    def queued():
+        q_entered.set()
+        spark.sql("SELECT count(*) AS c FROM shed_t").toArrow()
+
+    qt = threading.Thread(target=queued)
+    qt.start()
+    assert q_entered.wait(5)
+    time.sleep(0.2)  # let the queued query actually enqueue
+    with pytest.raises(ResourceExhausted) as ei:
+        spark.sql("SELECT max(x) AS m FROM shed_t").toArrow()
+    assert ei.value.retryable
+    release.set()
+    holder.join(5)
+    qt.join(10)
+    # the shed query retries cleanly once capacity frees
+    out = spark.sql("SELECT max(x) AS m FROM shed_t").toArrow()
+    assert out.column("m")[0].as_py() == 99
+
+
+# ---------------------------------------------------------------------------
+# cluster chaos matrix
+# ---------------------------------------------------------------------------
+
+def test_cluster_hostile_flood_shed_no_leak_and_bit_identical(
+        monkeypatch):
+    """Hostile tenant floods the job queue: excess jobs shed with a
+    typed retryable error before ANY task launches (no partial shuffle
+    output on any worker), the victim tenant's job completes, and every
+    completed result is bit-identical to serial execution."""
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS_TOTAL", "2")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_QUEUED_JOBS", "1")
+    plan = _agg_plan()
+    from sail_tpu.exec.local import LocalExecutor
+    serial = LocalExecutor().execute(plan)
+    # slow every task so the flood actually overlaps
+    faults.configure("worker.task_exec=delay(0.3)", seed=5)
+    c = LocalCluster(num_workers=2)
+    results = {}
+    errors = {}
+
+    def submit(tag, tenant):
+        try:
+            results[tag] = c.run_job(plan, num_partitions=2,
+                                     tenant=tenant, timeout=60)
+        except Exception as e:  # noqa: BLE001
+            errors[tag] = e
+
+    try:
+        threads = []
+        # hostile: 3 jobs into a max_queued=1 / max_jobs=1 tenant budget
+        for i in range(3):
+            th = threading.Thread(target=submit,
+                                  args=(f"hostile{i}", "hostile"))
+            th.start()
+            threads.append(th)
+            time.sleep(0.15)
+        th = threading.Thread(target=submit, args=("victim", "victim"))
+        th.start()
+        threads.append(th)
+        for th in threads:
+            th.join(90)
+        assert not any(th.is_alive() for th in threads), "hang detected"
+        # the victim always completes, bit-identical to serial
+        assert "victim" in results
+        assert _canon(results["victim"]).equals(_canon(serial))
+        # at least one hostile job shed, typed and retryable
+        shed = [e for e in errors.values()
+                if isinstance(e, ResourceExhausted)]
+        assert shed, f"expected a shed, got {errors!r}"
+        assert all(e.retryable and e.retry_after_ms > 0 for e in shed)
+        # every hostile job that completed matches serial
+        for tag, out in results.items():
+            assert _canon(out).equals(_canon(serial)), tag
+        # no leaked shuffle output anywhere (all jobs cleaned up)
+        time.sleep(0.3)
+        leaked = [k for w in c.workers for k in w.streams._streams]
+        assert leaked == []
+        # a retry of the shed tenant's job succeeds once the flood ends
+        faults.reset()
+        again = c.run_job(plan, num_partitions=2, tenant="hostile",
+                          timeout=60)
+        assert _canon(again).equals(_canon(serial))
+        # decision stream recorded enqueue/admit/shed per tenant
+        types_seen = {e["type"] for e in events.events()
+                      if e["type"].startswith("admission")}
+        assert {"admission_enqueue", "admission_admit",
+                "admission_shed"} <= types_seen
+    finally:
+        c.stop()
+
+
+def test_cluster_deadline_cancel_mid_stage_cleans_up(monkeypatch):
+    """A running job past its deadline cancels through the CancelJob
+    path mid-stage; CleanUpJob wipes partial shuffle output on every
+    worker and the client gets a typed DeadlineExceeded."""
+    plan = _agg_plan(seed=31, view="adm_dl")
+    faults.configure("worker.task_exec=delay(3.0)", seed=7)
+    c = LocalCluster(num_workers=2)
+    try:
+        t0 = time.time()
+        with pytest.raises(DeadlineExceeded) as ei:
+            c.run_job(plan, num_partitions=2, tenant="dl",
+                      deadline_ms=300, timeout=60)
+        assert not ei.value.retryable
+        assert time.time() - t0 < 30  # canceled, not run to completion
+        dl = [e for e in events.events()
+              if e["type"] == "deadline_cancel"
+              and e.get("tenant") == "dl"]
+        assert dl and dl[-1]["deadline_ms"] == 300
+        # cooperative cancel + CleanUpJob: no partial shuffle output
+        # survives on any worker once tasks unwind
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            leaked = [k for w in c.workers for k in w.streams._streams]
+            if not leaked:
+                break
+            time.sleep(0.25)
+        assert leaked == []
+        # the cluster is healthy afterwards: the same plan completes
+        faults.reset()
+        out = c.run_job(plan, num_partitions=2, tenant="dl", timeout=60)
+        from sail_tpu.exec.local import LocalExecutor
+        assert _canon(out).equals(_canon(LocalExecutor().execute(plan)))
+    finally:
+        c.stop()
+
+
+def test_cluster_quota_defers_tasks_but_never_deadlocks(monkeypatch):
+    """A tenant whose projected bytes exceed its memory quota has
+    consumer tasks parked (admission_defer reason=quota) but the job
+    still converges — a tenant with nothing admitted always admits one
+    task — and the result stays bit-identical."""
+    monkeypatch.setenv("SAIL_ADMISSION__TENANTS", "tight:memMb=1")
+    # AQE's coalesce would merge the small channels into ONE consumer
+    # task (whose first-task debit always admits); pin the static 4-way
+    # shuffle so the quota actually arbitrates concurrent consumers
+    monkeypatch.setenv("SAIL_ADAPTIVE__ENABLED", "0")
+    spark = SparkSession({})
+    rng = np.random.default_rng(9)
+    n = 120_000
+    # near-unique group key: the partial-aggregate shuffle ships ~the
+    # whole table, so each consumer's projected bytes approach 1MB
+    df = pd.DataFrame({"g": rng.permutation(n),
+                       "v": rng.integers(0, 1000, n)})
+    spark.createDataFrame(df).createOrReplaceTempView("quota_t")
+    plan = _plan_for(
+        spark,
+        "SELECT g, sum(v) AS s, count(*) AS c FROM quota_t GROUP BY g")
+    from sail_tpu.exec.local import LocalExecutor
+    serial = LocalExecutor().execute(plan)
+    c = LocalCluster(num_workers=2)
+    try:
+        out = c.run_job(plan, num_partitions=4, tenant="tight",
+                        timeout=90)
+        assert _canon(out).equals(_canon(serial))
+        defers = [e for e in events.events()
+                  if e["type"] == "admission_defer"
+                  and e.get("tenant") == "tight"]
+        debits = [e for e in events.events()
+                  if e["type"] == "quota_debit"
+                  and e.get("tenant") == "tight"]
+        assert debits, "quota ledger recorded no debits"
+        assert defers, "1MB quota at ~1MB/channel projected bytes " \
+                       "should have parked at least one consumer task"
+        # ledger drains back to zero with the job
+        assert c.driver.admission.quota_used("tight") == 0
+    finally:
+        c.stop()
+
+
+def test_cluster_fair_share_converges_under_worker_eviction(
+        monkeypatch):
+    """Two tenants' concurrent jobs + a worker crash mid-flight: the
+    evicted worker's tasks re-run, both tenants' jobs complete, and
+    both results are bit-identical to serial execution."""
+    monkeypatch.setenv("SAIL_CLUSTER__WORKER_HEARTBEAT_TIMEOUT_SECS",
+                       "2")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS_TOTAL", "2")
+    plan_a = _agg_plan(seed=41, view="adm_ev_a")
+    plan_b = _agg_plan(seed=42, view="adm_ev_b")
+    from sail_tpu.exec.local import LocalExecutor
+    serial_a = LocalExecutor().execute(plan_a)
+    serial_b = LocalExecutor().execute(plan_b)
+    faults.configure("worker.task_exec:worker-1*=crash#1", seed=13)
+    c = LocalCluster(num_workers=2)
+    results = {}
+    errors = {}
+
+    def submit(tag, plan, tenant):
+        try:
+            results[tag] = c.run_job(plan, num_partitions=4,
+                                     tenant=tenant, timeout=90)
+        except Exception as e:  # noqa: BLE001
+            errors[tag] = e
+
+    try:
+        ta = threading.Thread(target=submit, args=("a", plan_a, "ta"))
+        tb = threading.Thread(target=submit, args=("b", plan_b, "tb"))
+        ta.start()
+        tb.start()
+        ta.join(120)
+        tb.join(120)
+        assert not ta.is_alive() and not tb.is_alive(), "hang detected"
+        assert errors == {}, repr(errors)
+        assert _canon(results["a"]).equals(_canon(serial_a))
+        assert _canon(results["b"]).equals(_canon(serial_b))
+        assert faults.injection_counts().get("worker.task_exec") == 1
+    finally:
+        c.stop()
+
+
+def test_admission_decisions_replayable_from_event_log(monkeypatch,
+                                                       tmp_path):
+    """A saturation incident reconstructs from the durable log alone:
+    admission enqueue/admit/shed decisions appear in sail_timeline's
+    decision stream in append order."""
+    monkeypatch.setenv("SAIL_TELEMETRY__EVENT_LOG__ENABLED", "1")
+    monkeypatch.setenv("SAIL_TELEMETRY__EVENT_LOG__DIR", str(tmp_path))
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_QUEUED_JOBS", "1")
+    events.reload()
+    try:
+        plan = _agg_plan(seed=55, view="adm_log")
+        faults.configure("worker.task_exec=delay(0.25)", seed=3)
+        c = LocalCluster(num_workers=2)
+        errors = []
+
+        def submit():
+            try:
+                c.run_job(plan, num_partitions=2, tenant="logged",
+                          timeout=60)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        try:
+            threads = [threading.Thread(target=submit)
+                       for _ in range(3)]
+            for th in threads:
+                th.start()
+                time.sleep(0.15)
+            for th in threads:
+                th.join(90)
+        finally:
+            path = events.EVENT_LOG.path
+            c.stop()
+        assert path is not None
+        from sail_tpu.analysis import timeline
+        from sail_tpu.events import load_event_log
+        records = load_event_log(path)
+        decisions = timeline.decisions(records)
+        kinds = [d["type"] for d in decisions]
+        assert "admission_enqueue" in kinds
+        assert "admission_admit" in kinds
+        assert "admission_shed" in kinds  # 3 jobs into a 1+1 budget
+        # decision order is append (seq) order — replay preserves it
+        seqs = [d["seq"] for d in decisions]
+        assert seqs == sorted(seqs)
+        # the shed surfaced to the client as typed + retryable
+        assert any(isinstance(e, ResourceExhausted) for e in errors)
+    finally:
+        monkeypatch.delenv("SAIL_TELEMETRY__EVENT_LOG__ENABLED",
+                           raising=False)
+        monkeypatch.delenv("SAIL_TELEMETRY__EVENT_LOG__DIR",
+                           raising=False)
+        events.reload()
+
+
+def test_run_job_defaults_tenant_and_deadline_from_config(monkeypatch):
+    monkeypatch.setenv("SAIL_ADMISSION__TENANT", "confd")
+    monkeypatch.setenv("SAIL_ADMISSION__DEFAULT_DEADLINE_MS", "60000")
+    plan = _agg_plan(seed=61, view="adm_conf")
+    c = LocalCluster(num_workers=2)
+    try:
+        c.run_job(plan, num_partitions=2, timeout=60)
+        job = c.last_job
+        assert job.tenant == "confd"
+        assert job.deadline_ts is not None
+        assert job.deadline_ms == 60000.0
+        starts = [e for e in events.events()
+                  if e["type"] == "task_start"
+                  and e.get("job_id") == job.job_id]
+        assert starts and all(e.get("tenant") == "confd"
+                              for e in starts)
+    finally:
+        c.stop()
