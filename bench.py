@@ -723,11 +723,22 @@ def _run_saturation(spark, n_tenants: int) -> dict:
     os.environ.update(knobs)
 
     def phase(tag: str, hostile: bool, admission_on: bool) -> dict:
+        from sail_tpu.metrics import REGISTRY as _REG
+
         os.environ["SAIL_ADMISSION__ENABLED"] = \
             "1" if admission_on else "0"
         admission.reload()
         stop = threading.Event()
         shed = {"count": 0, "typed": 0}
+        # live-SLO window: per-tenant query.latency histogram snapshots
+        # before the phase; the phase's percentiles are read from the
+        # AFTER−BEFORE window — the same live instruments /metrics and
+        # system.telemetry.tenant_slo serve — and checked against the
+        # raw sample lists within bucket resolution
+        tenant_names = [f"t{i}" for i in range(n_tenants)]
+        hist_before = {name: _REG.histogram_state(
+            "query.latency", tenant=name, phase="total")
+            for name in tenant_names}
 
         # one streaming query rides the whole phase
         schema = pa.schema([("k", pa.int64()), ("v", pa.int64())])
@@ -818,25 +829,52 @@ def _run_saturation(spark, n_tenants: int) -> dict:
                                int(q * (len(s) - 1) + 0.999999))]
                          * 1000.0, 1)
 
+        def hist_pct(name: str, q: float):
+            after = _REG.histogram_state("query.latency", tenant=name,
+                                         phase="total")
+            if after is None:
+                return None
+            before = hist_before.get(name)
+            window = after.subtract(before) if before is not None \
+                else after
+            v = window.quantile(q)
+            return round(v * 1000.0, 1) if v is not None else None
+
+        def tenant_rec(name: str, v: list) -> dict:
+            # primary percentiles come from the LIVE histograms; the
+            # raw sample list rides along as the offline ground truth
+            # plus an agreement flag (within one exponential bucket)
+            hp50, hp99 = hist_pct(name, 0.50), hist_pct(name, 0.99)
+            sp50, sp99 = pct(v, 0.50), pct(v, 0.99)
+            growth = 2.0  # the registry's bucket ladder
+            agrees = all(
+                h is None or s is None or s < 2.0
+                or (s / growth) <= h <= (s * growth)
+                for h, s in ((hp50, sp50), (hp99, sp99)))
+            return {"n": len(v), "p50_ms": hp50, "p99_ms": hp99,
+                    "sample_p50_ms": sp50, "sample_p99_ms": sp99,
+                    "hist_agrees_within_bucket": agrees}
+
         return {
             "wall_s": round(wall, 3),
             "admission": admission_on,
             "hostile": hostile,
             "streaming_epochs": epochs_fed,
-            "tenants": {name: {"n": len(v),
-                               "p50_ms": pct(v, 0.50),
-                               "p99_ms": pct(v, 0.99)}
+            "slo_source": "histogram(query.latency)",
+            "tenants": {name: tenant_rec(name, v)
                         for name, v in sorted(lat.items())},
             "sheds": shed["count"],
             "sheds_typed_retryable": shed["count"] == shed["typed"],
         }
 
     def worst_ratio(base: dict, loaded: dict):
+        # isolation ratios stay sample-sourced: bucket quantization
+        # must not be able to flip the ≤2x acceptance either way
         ratios = []
         for name, rec in loaded["tenants"].items():
-            b = base["tenants"].get(name, {}).get("p99_ms")
-            if b and rec.get("p99_ms"):
-                ratios.append(rec["p99_ms"] / b)
+            b = base["tenants"].get(name, {}).get("sample_p99_ms")
+            if b and rec.get("sample_p99_ms"):
+                ratios.append(rec["sample_p99_ms"] / b)
         return round(max(ratios), 3) if ratios else None
 
     forced_off = _env_on("SAIL_BENCH_DISABLE_ADMISSION")
@@ -1005,6 +1043,36 @@ def main():
         from sail_tpu.exec import admission as _admission
         _admission.reload()
     result_admission = {"enabled": not disable_admission}
+    # A/B knob: SAIL_BENCH_DISABLE_OBS_SERVER=1 leaves the pull-based
+    # ops endpoint down for the whole run; the default run serves
+    # /metrics and gets scraped every 2s by a background thread (a
+    # stand-in Prometheus), so comparing the two artifacts measures
+    # the telemetry plane's overhead (acceptance: ≤ 2% on q1)
+    disable_obs = _env_on("SAIL_BENCH_DISABLE_OBS_SERVER")
+    obs_info = {"enabled": not disable_obs}
+    obs_stop = None
+    if not disable_obs:
+        import threading as _threading
+        import urllib.request as _urlreq
+
+        from sail_tpu import obs_server as _obs
+        _srv = _obs.start()
+        obs_info["url"] = _srv.url
+        scrapes = {"count": 0, "bytes": 0, "errors": 0}
+        obs_stop = _threading.Event()
+
+        def _scrape_loop():
+            while not obs_stop.wait(2.0):
+                try:
+                    body = _urlreq.urlopen(
+                        _srv.url + "/metrics", timeout=5).read()
+                    scrapes["count"] += 1
+                    scrapes["bytes"] = len(body)
+                except Exception:  # noqa: BLE001 — keep scraping
+                    scrapes["errors"] += 1
+
+        _threading.Thread(target=_scrape_loop, daemon=True).start()
+        obs_info["scrapes"] = scrapes
     try:
         best, rows, scanned, q1_profile = _run_q1(spark, sf)
     except Exception as e:  # noqa: BLE001 — fall back to SF1 rather than die
@@ -1027,6 +1095,7 @@ def main():
         else "enabled",
         "adaptive": "disabled" if disable_aqe else "enabled",
         "events": "disabled" if disable_events else "enabled",
+        "observability": obs_info,
         "tpu_probe": probe_info,
     }
     # the 22-query and ClickBench artifacts always record, inside the
@@ -1097,6 +1166,21 @@ def main():
             result["saturation"] = _run_saturation(spark, n_tenants)
         except Exception as e:  # noqa: BLE001
             result["saturation_error"] = f"{type(e).__name__}: {e}"
+    if obs_stop is not None:
+        obs_stop.set()
+        # final scrape sanity: the exposition must still parse as
+        # key-value samples after the whole run (fleet view included)
+        try:
+            import urllib.request as _urlreq
+            body = _urlreq.urlopen(
+                obs_info["url"] + "/metrics", timeout=5).read().decode()
+            samples = [ln for ln in body.splitlines()
+                       if ln and not ln.startswith("#")]
+            obs_info["final_scrape_samples"] = len(samples)
+            obs_info["final_scrape_parse_ok"] = all(
+                " " in ln for ln in samples)
+        except Exception as e:  # noqa: BLE001
+            obs_info["final_scrape_error"] = f"{type(e).__name__}: {e}"
     warnings = _budget_skip_warnings(result)
     if warnings:
         result["warnings"] = warnings

@@ -681,8 +681,10 @@ def load_metric_registry(ctx: LintContext) -> List[dict]:
 def metric_call_sites(ctx: LintContext
                       ) -> List[Tuple[str, Tuple[str, ...], str, int]]:
     """(metric name, kwarg attribute keys, relpath, line) for every
-    ``record(...)``/``_record_metric(...)`` call with a resolvable
-    name (plain literal or either branch of a conditional)."""
+    ``record(...)``/``_record_metric(...)``/``timer(...)`` call with a
+    resolvable name (plain literal or either branch of a conditional)
+    — the timer context manager records into its named instrument at
+    exit, so its call sites are record sites for drift purposes."""
     out = []
     for relpath in ctx.python_sources():
         tree = ctx.tree(relpath)
@@ -691,7 +693,8 @@ def metric_call_sites(ctx: LintContext
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
-            if _call_name(node) not in ("record", "_record_metric"):
+            if _call_name(node) not in ("record", "_record_metric",
+                                        "timer", "_metric_timer"):
                 continue
             first = node.args[0]
             names = []
@@ -722,11 +725,35 @@ def lint_metrics(ctx: LintContext) -> List[Violation]:
         out.append(Violation(
             "metrics", "sail_tpu/metrics_registry.yaml", 0,
             f"duplicate registry entries: {dupes}"))
+    from ..metrics import is_legal_prometheus_name, prometheus_name
     for e in entries:
-        if e.get("type") not in ("counter", "gauge"):
+        if e.get("type") not in ("counter", "gauge", "histogram"):
             out.append(Violation(
                 "metrics", "sail_tpu/metrics_registry.yaml", 0,
                 f"{e.get('name')!r}: bad type {e.get('type')!r}"))
+        # every instrument must survive the Prometheus exposition
+        # translation (obs_server /metrics) as a legal metric name
+        prom = prometheus_name(str(e.get("name") or ""),
+                               str(e.get("type") or ""))
+        if not is_legal_prometheus_name(prom):
+            out.append(Violation(
+                "metrics", "sail_tpu/metrics_registry.yaml", 0,
+                f"{e.get('name')!r}: translates to illegal Prometheus "
+                f"metric name {prom!r}"))
+        buckets = e.get("buckets")
+        if buckets is not None:
+            if e.get("type") != "histogram":
+                out.append(Violation(
+                    "metrics", "sail_tpu/metrics_registry.yaml", 0,
+                    f"{e.get('name')!r}: buckets declared on "
+                    f"non-histogram type {e.get('type')!r}"))
+            elif not (float(buckets.get("base", 0)) > 0
+                      and float(buckets.get("growth", 0)) > 1
+                      and int(buckets.get("count", 0)) >= 1):
+                out.append(Violation(
+                    "metrics", "sail_tpu/metrics_registry.yaml", 0,
+                    f"{e.get('name')!r}: bad bucket spec {buckets!r} "
+                    f"(need base>0, growth>1, count>=1)"))
     by_name = {e["name"]: e for e in entries}
     sites = metric_call_sites(ctx)
     used_attrs: Dict[str, Set[str]] = {}

@@ -191,10 +191,21 @@ class SystemRegistry:
                         [p.total_ms for p in active], pa.float64()),
                 })
             if (database, name) == ("telemetry", "metrics"):
-                from ..metrics import REGISTRY
-                rows = REGISTRY.snapshot()
+                from ..metrics import FLEET, REGISTRY
+                # scope=process: this process's registry (worker "");
+                # scope=fleet: the driver's cluster-wide view keyed by
+                # worker id ("driver" = this process; remote workers
+                # from heartbeat-shipped deltas). Histogram rows carry
+                # observation count + estimated p50/p95/p99 (seconds).
+                rows = [dict(r, scope="process", worker="")
+                        for r in REGISTRY.snapshot()]
+                rows += [dict(r, scope="fleet")
+                         for r in FLEET.snapshot()]
                 return pa.table({
                     "name": pa.array([r["name"] for r in rows]),
+                    "scope": pa.array([r["scope"] for r in rows]),
+                    "worker": pa.array(
+                        [r.get("worker", "") for r in rows]),
                     "type": pa.array([r["type"] for r in rows]),
                     "unit": pa.array([r["unit"] for r in rows]),
                     "description": pa.array(
@@ -203,6 +214,71 @@ class SystemRegistry:
                         [r["attributes"] for r in rows]),
                     "value": pa.array([r["value"] for r in rows],
                                       pa.float64()),
+                    "count": pa.array(
+                        [r.get("count") for r in rows], pa.int64()),
+                    "p50": pa.array(
+                        [r.get("p50") for r in rows], pa.float64()),
+                    "p95": pa.array(
+                        [r.get("p95") for r in rows], pa.float64()),
+                    "p99": pa.array(
+                        [r.get("p99") for r in rows], pa.float64()),
+                })
+            if (database, name) == ("telemetry", "tenant_slo"):
+                import json
+                from ..metrics import FLEET, HistogramState
+                # live per-tenant serving SLOs: fleet-merged
+                # query.latency (phase=total) percentiles + shed and
+                # deadline-cancel counters — the numbers the admission
+                # layer's isolation promises are stated against
+                merged: Dict[str, HistogramState] = {}
+                for _w, attrs, h in FLEET.histogram_states(
+                        "query.latency"):
+                    if attrs.get("phase") != "total":
+                        continue
+                    tenant = attrs.get("tenant", "default")
+                    cur = merged.get(tenant)
+                    if cur is None:
+                        merged[tenant] = h
+                    else:
+                        cur.merge(h)
+                sheds: Dict[str, float] = {}
+                cancels: Dict[str, float] = {}
+                for r in FLEET.snapshot():
+                    attrs = json.loads(r["attributes"])
+                    tenant = attrs.get("tenant")
+                    if tenant is None:
+                        continue
+                    if r["name"] == "cluster.admission.shed_count":
+                        sheds[tenant] = sheds.get(tenant, 0.0) \
+                            + r["value"]
+                    elif r["name"] == \
+                            "cluster.admission.deadline_cancel_count":
+                        cancels[tenant] = cancels.get(tenant, 0.0) \
+                            + r["value"]
+                tenants = sorted(set(merged) | set(sheds) | set(cancels))
+                def ms(h, q):
+                    v = h.quantile(q) if h is not None else None
+                    return None if v is None else v * 1000.0
+                return pa.table({
+                    "tenant": pa.array(tenants),
+                    "queries": pa.array(
+                        [merged[t].count if t in merged else 0
+                         for t in tenants], pa.int64()),
+                    "p50_ms": pa.array(
+                        [ms(merged.get(t), 0.50) for t in tenants],
+                        pa.float64()),
+                    "p95_ms": pa.array(
+                        [ms(merged.get(t), 0.95) for t in tenants],
+                        pa.float64()),
+                    "p99_ms": pa.array(
+                        [ms(merged.get(t), 0.99) for t in tenants],
+                        pa.float64()),
+                    "shed_count": pa.array(
+                        [int(sheds.get(t, 0)) for t in tenants],
+                        pa.int64()),
+                    "deadline_cancel_count": pa.array(
+                        [int(cancels.get(t, 0)) for t in tenants],
+                        pa.int64()),
                 })
             if (database, name) == ("telemetry", "events"):
                 import json

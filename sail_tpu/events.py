@@ -15,9 +15,11 @@ stream spanning driver and workers:
   on, queryable as ``system.telemetry.events`` /
   ``system.telemetry.task_timeline``;
 - an optional durable JSONL log (``telemetry.event_log.{enabled,dir,
-  max_mb}``, surfaced as ``spark.sail.telemetry.eventLog.*``) that
-  ``scripts/sail_timeline.py`` replays offline — the post-mortem ground
-  truth for "why was this query slow";
+  max_mb,max_segments}``, surfaced as
+  ``spark.sail.telemetry.eventLog.*``) rotated in bounded segments
+  that ``scripts/sail_timeline.py`` replays offline across segment
+  boundaries — the post-mortem ground truth for "why was this query
+  slow";
 - worker-side events ship to the driver piggybacked on the terminal
   task-status report (``ReportTaskStatusRequest.events_json``), so the
   driver's log is the cluster-wide merge;
@@ -182,20 +184,28 @@ class EventLog:
     When a JSONL path is configured every appended record is also
     written as one ``json.dumps`` line and flushed, so a crash loses at
     most the half-written final line — the replay loader tolerates a
-    truncated tail. ``max_bytes`` bounds the file: past it the ring
-    keeps recording but the file stops growing (counted in
-    ``telemetry.events.dropped_count{reason=log_cap}``, one warning)."""
+    truncated tail.
+
+    ``max_bytes`` bounds each SEGMENT: a line that would push the
+    active file past it first ROTATES — the active file shifts to
+    ``<path>.1`` (older segments to ``.2``, ``.3``, …) and a fresh
+    active segment opens, keeping at most ``max_segments`` files in
+    total. Only when the oldest segment falls off the retention window
+    are its events actually dropped from the durable log (counted per
+    line in ``telemetry.events.dropped_count{reason=rotated}``).
+    :func:`load_event_log` and ``scripts/sail_timeline.py`` read across
+    segment boundaries, so replay sees one continuous stream."""
 
     def __init__(self, capacity: int = 4096, path: Optional[str] = None,
-                 max_bytes: int = 0):
+                 max_bytes: int = 0, max_segments: int = 4):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(1, int(capacity)))
         self._seq = 0
         self._path = path
         self._file = None
         self._max_bytes = max(0, int(max_bytes))
+        self._max_segments = max(1, int(max_segments))
         self._written = 0
-        self._file_capped = False
         self._file_failed = False
 
     @property
@@ -246,11 +256,56 @@ class EventLog:
             if self._path is not None:
                 self._write_line(record)
 
+    @staticmethod
+    def _count_lines(path: str) -> int:
+        """Complete lines in one segment (drop accounting at rotation
+        — segments are bounded by max_bytes, so this is one bounded
+        read on a rare path)."""
+        try:
+            n = 0
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        return n
+                    n += chunk.count(b"\n")
+        except OSError:
+            return 0
+
+    def _rotate(self) -> None:
+        # under self._lock; the active file is open and full
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        keep = self._max_segments - 1     # rotated slots beside active
+        oldest = f"{self._path}.{keep}" if keep else self._path
+        if os.path.exists(oldest):
+            dropped = self._count_lines(oldest)
+            try:
+                os.remove(oldest)
+            except OSError:
+                pass
+            if dropped:
+                _drop_metric(dropped, "rotated")
+        for i in range(keep - 1, 0, -1):
+            src = f"{self._path}.{i}"
+            if os.path.exists(src):
+                try:
+                    os.replace(src, f"{self._path}.{i + 1}")
+                except OSError:
+                    pass
+        if keep and os.path.exists(self._path):
+            try:
+                os.replace(self._path, f"{self._path}.1")
+            except OSError:
+                pass
+        self._written = 0
+
     def _write_line(self, record: dict) -> None:
         # under self._lock
-        if self._file_capped:
-            _drop_metric(1, "log_cap")
-            return
         if self._file_failed:
             _drop_metric(1, "log_error")
             return
@@ -263,15 +318,12 @@ class EventLog:
                 self._written = self._file.tell()
             line = json.dumps(record, default=str,
                               separators=(",", ":")) + "\n"
-            if self._max_bytes and \
+            if self._max_bytes and self._written and \
                     self._written + len(line) > self._max_bytes:
-                self._file_capped = True
-                _drop_metric(1, "log_cap")
-                logger.warning(
-                    "event log %s reached its size cap (%d bytes); "
-                    "further events stay in the ring only",
-                    self._path, self._max_bytes)
-                return
+                self._rotate()
+            if self._file is None:
+                self._file = open(self._path, "a", encoding="utf-8")
+                self._written = self._file.tell()
             self._file.write(line)
             self._file.flush()
             self._written += len(line)
@@ -370,6 +422,7 @@ def _log_from_config() -> EventLog:
         cap = 4096
     path = None
     max_bytes = 0
+    max_segments = 4
     try:
         if truthy("telemetry.event_log.enabled", default="false"):
             d = str(config_get("telemetry.event_log.dir", "") or "")
@@ -378,9 +431,12 @@ def _log_from_config() -> EventLog:
                 max_mb = float(config_get(
                     "telemetry.event_log.max_mb", 64))
                 max_bytes = int(max_mb * (1 << 20))
+                max_segments = int(config_get(
+                    "telemetry.event_log.max_segments", 4))
     except (TypeError, ValueError):
         path = None
-    return EventLog(cap, path=path, max_bytes=max_bytes)
+    return EventLog(cap, path=path, max_bytes=max_bytes,
+                    max_segments=max_segments)
 
 
 EVENT_LOG = _log_from_config()
@@ -459,16 +515,16 @@ def events(query_id: Optional[str] = None) -> List[dict]:
 # durable-log replay
 # ---------------------------------------------------------------------------
 
-def load_event_log(path: str) -> List[dict]:
-    """Read a JSONL event log back, tolerating a truncated tail: a
-    crash mid-write leaves at most one partial final line, and replay
-    must reconstruct everything up to the last COMPLETE record. A
-    malformed line mid-file ends the replay there too (everything after
-    it is untrusted). Records from a future schema version raise."""
+def _load_one(path: str) -> Tuple[List[dict], bool]:
+    """One segment: (records, clean). ``clean`` is False when the file
+    ended at a truncated or malformed line — everything after that
+    point (including NEWER segments) is untrusted."""
     out: List[dict] = []
+    clean = True
     with open(path, "r", encoding="utf-8") as f:
         for line in f:
             if not line.endswith("\n"):
+                clean = False
                 break  # truncated tail: the crash cut this record short
             line = line.strip()
             if not line:
@@ -476,12 +532,49 @@ def load_event_log(path: str) -> List[dict]:
             try:
                 record = json.loads(line)
             except ValueError:
+                clean = False
                 break
             if not isinstance(record, dict):
+                clean = False
                 break
             if int(record.get("v", 0)) > EVENT_SCHEMA_VERSION:
                 raise ValueError(
                     f"event log {path} carries schema v{record.get('v')} "
                     f"(this build reads ≤ v{EVENT_SCHEMA_VERSION})")
             out.append(record)
+    return out, clean
+
+
+def log_segments(path: str) -> List[str]:
+    """Every existing segment of a rotated log, OLDEST first:
+    ``<path>.N`` … ``<path>.1``, then the active ``<path>``."""
+    rotated = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        rotated.append(f"{path}.{i}")
+        i += 1
+    return rotated[::-1] + ([path] if os.path.exists(path)
+                            else [])
+
+
+def load_event_log(path: str) -> List[dict]:
+    """Read a JSONL event log back — across rotated segments
+    (``<path>.N`` oldest → ``<path>`` newest) — tolerating a truncated
+    tail: a crash mid-write leaves at most one partial final line, and
+    replay must reconstruct everything up to the last COMPLETE record.
+    A malformed line mid-segment ends the replay there (everything
+    after it, newer segments included, is untrusted). Records from a
+    future schema version raise."""
+    segments = log_segments(path)
+    if not segments:
+        # preserve the single-file contract: a missing log raises
+        with open(path, "r", encoding="utf-8"):
+            pass
+        return []
+    out: List[dict] = []
+    for seg in segments:
+        records, clean = _load_one(seg)
+        out.extend(records)
+        if not clean:
+            break
     return out

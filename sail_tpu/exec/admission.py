@@ -277,6 +277,11 @@ class JobAdmissionQueue:
         depth = self.queue_depth(tenant)
         _record_metric("cluster.admission.shed_count", 1, tenant=tenant,
                        reason=reason)
+        queued_ts = getattr(job, "queued_ts", None)
+        _record_metric(
+            "cluster.admission.shed_wait_time",
+            max(0.0, time.time() - queued_ts) if queued_ts else 0.0,
+            tenant=tenant, reason=reason)
         events.emit(EventType.ADMISSION_SHED, query_id=job.query_id,
                     trace_id=_trace(job), job_id=job.job_id,
                     tenant=tenant, reason=reason, queue_depth=depth)
@@ -367,6 +372,8 @@ class JobAdmissionQueue:
         waited_ms = round((time.time() - job.queued_ts) * 1000.0, 3)
         _record_metric("cluster.admission.admitted_count", 1,
                        tenant=tenant)
+        _record_metric("cluster.admission.queue_wait_time",
+                       max(0.0, waited_ms) / 1000.0, tenant=tenant)
         _record_metric("cluster.admission.queue_depth",
                        self.queue_depth(tenant), tenant=tenant)
         events.emit(EventType.ADMISSION_ADMIT, query_id=job.query_id,
@@ -388,6 +395,57 @@ class JobAdmissionQueue:
         if tenant in self._mem_used:
             _record_metric("cluster.quota.debited_bytes",
                            self._mem_used.get(tenant, 0), tenant=tenant)
+
+    # -- ops surface -----------------------------------------------------
+    def wedged(self, now: Optional[float] = None) -> bool:
+        """A queued job sitting past TWICE its shed budget means the
+        scheduling loop (poll + drain on the driver actor) has stopped
+        turning — poll() would have shed or admitted it long ago. The
+        ops endpoint's /readyz flips on this.
+
+        Called from the HTTP thread while the driver actor mutates the
+        queues (this class is otherwise actor-thread-only, so there is
+        deliberately no lock): a torn iteration means the actor is
+        actively processing — the opposite of wedged — so a racing
+        read answers False rather than flapping a healthy /readyz."""
+        if not self.enabled or not self.conf.queue_timeout_ms:
+            # no queue budget configured = jobs may legitimately wait
+            # indefinitely; there is no bound to detect a stall against
+            return False
+        now = time.time() if now is None else now
+        budget_s = self.conf.queue_timeout_ms / 1000.0
+        try:
+            for q in list(self._queues.values()):
+                for job in list(q):
+                    queued_ts = getattr(job, "queued_ts", None)
+                    if queued_ts and now - queued_ts > 2.0 * budget_s:
+                        return True
+        except RuntimeError:  # dict/deque resized mid-iteration
+            return False
+        return False
+
+    def debug_snapshot(self) -> dict:
+        """JSON-able state for /debug/admission (read cross-thread:
+        point-in-time, best-effort — a torn read degrades to a partial
+        snapshot, never an error page)."""
+        try:
+            return {
+                "kind": "cluster_job_queue",
+                "enabled": self.enabled,
+                "queued": {t: len(q)
+                           for t, q in list(self._queues.items())
+                           if q},
+                "running": {t: len(s)
+                            for t, s in list(self._running.items())
+                            if s},
+                "total_running": self._total_running,
+                "deficit": {t: round(v, 3)
+                            for t, v in list(self._deficit.items())},
+                "quota_used_bytes": dict(self._mem_used),
+            }
+        except RuntimeError:
+            return {"kind": "cluster_job_queue",
+                    "enabled": self.enabled, "racing": True}
 
     # -- memory quota ledger (PR 7 governor projections) ----------------
     def tenant_quota(self, tenant: str) -> int:
@@ -557,6 +615,8 @@ class SessionAdmission:
         if shed_depth is not None:
             _record_metric("cluster.admission.shed_count", 1,
                            tenant=tenant, reason="queue_full")
+            _record_metric("cluster.admission.shed_wait_time", 0.0,
+                           tenant=tenant, reason="queue_full")
             events.emit(EventType.ADMISSION_SHED, query_id=query_id,
                         job_id="", tenant=tenant, reason="queue_full",
                         queue_depth=shed_depth)
@@ -578,6 +638,8 @@ class SessionAdmission:
         if waiter is None:
             events.emit(EventType.ADMISSION_ADMIT, query_id=query_id,
                         job_id="", tenant=tenant, waited_ms=0.0)
+            _record_metric("cluster.admission.queue_wait_time", 0.0,
+                           tenant=tenant)
             self._tls.depth = 1
             return _Ticket(self, tenant)
         t0 = time.time()
@@ -603,6 +665,9 @@ class SessionAdmission:
                 reason = "deadline" if deadline_bound else "queue_timeout"
                 _record_metric("cluster.admission.shed_count", 1,
                                tenant=tenant, reason=reason)
+                _record_metric("cluster.admission.shed_wait_time",
+                               max(0.0, time.time() - t0),
+                               tenant=tenant, reason=reason)
                 events.emit(EventType.ADMISSION_SHED, query_id=query_id,
                             job_id="", tenant=tenant, reason=reason,
                             queue_depth=len(self._waiters.get(
@@ -622,8 +687,25 @@ class SessionAdmission:
         events.emit(EventType.ADMISSION_ADMIT, query_id=query_id,
                     job_id="", tenant=tenant,
                     waited_ms=round((time.time() - t0) * 1000.0, 3))
+        _record_metric("cluster.admission.queue_wait_time",
+                       max(0.0, time.time() - t0), tenant=tenant)
         self._tls.depth = 1
         return _Ticket(self, tenant)
+
+    def debug_snapshot(self) -> dict:
+        """JSON-able gate state for /debug/admission."""
+        with self._lock:
+            return {
+                "kind": "session_gate",
+                "enabled": self.enabled,
+                "running": {t: n for t, n in self._running.items()
+                            if n},
+                "total_running": self._total,
+                "queued": {t: len(q)
+                           for t, q in self._waiters.items() if q},
+                "virtual_time": {t: round(v, 4)
+                                 for t, v in self._vt.items()},
+            }
 
     def _admit_locked(self, tenant: str) -> None:
         self._running[tenant] = self._running.get(tenant, 0) + 1

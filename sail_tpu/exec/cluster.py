@@ -47,6 +47,12 @@ from ..events import EventType
 from ..io.prefetch import MultiPrefetcher
 from ..metrics import record as _record_metric
 
+
+def _fleet():
+    from .. import metrics as _m
+    return _m.FLEET
+
+
 _DRIVER_SERVICE = "sail_tpu.control.DriverService"
 _WORKER_SERVICE = "sail_tpu.control.WorkerService"
 
@@ -568,13 +574,35 @@ class WorkerActor(Actor):
                 "cluster.worker_heartbeat_interval_secs", 1.0)))
         except (TypeError, ValueError):
             interval = 1.0
+        # a delta the last heartbeat failed to deliver: folded into the
+        # next cycle's increments instead of lost (the registry cursor
+        # advances at take time, so delivery is this loop's problem)
+        pending_delta = None
         while not self._hb_stop.wait(interval):
             try:
                 faults.inject("worker.heartbeat", key=self.worker_id)
+                # fleet telemetry piggyback: this process's metric
+                # delta since the last heartbeat (counter increments +
+                # histogram bucket increments); one cursor per process,
+                # so a multi-worker loopback process ships each
+                # increment exactly once
+                try:
+                    from .. import metrics as _m
+                    pending_delta = _m.merge_heartbeat_deltas(
+                        pending_delta,
+                        _m.REGISTRY.take_heartbeat_delta())
+                    delta_json = json.dumps(pending_delta) \
+                        if pending_delta else ""
+                except Exception:  # noqa: BLE001 — telemetry never
+                    # blocks the heartbeat; ship nothing this cycle
+                    # and KEEP any retained undelivered delta
+                    delta_json = ""
                 self._call_driver("Heartbeat", pb.HeartbeatRequest(
                     worker_id=self.worker_id,
-                    running_tasks=len(self._running)), pb.HeartbeatResponse,
+                    running_tasks=len(self._running),
+                    metrics_json=delta_json), pb.HeartbeatResponse,
                     retry=False)
+                pending_delta = None  # delivered
             except faults.WorkerCrash:
                 self._die()
                 return
@@ -1222,6 +1250,7 @@ class DriverActor(Actor):
                 w["last_seen"] = time.time()
             else:
                 self._maybe_readmit(payload.worker_id)
+            self._merge_heartbeat_metrics(payload)
         elif kind == "probe":
             self._probe_workers()
         elif kind == "submit":
@@ -1369,6 +1398,8 @@ class DriverActor(Actor):
             overrun = round((now - job.deadline_ts) * 1000.0, 3)
             _record_metric("cluster.admission.deadline_cancel_count", 1,
                            tenant=job.tenant)
+            _record_metric("cluster.admission.deadline_overrun_time",
+                           overrun / 1000.0, tenant=job.tenant)
             events.emit(EventType.DEADLINE_CANCEL,
                         query_id=job.query_id, trace_id=_jtrace(job),
                         job_id=job.job_id, tenant=job.tenant,
@@ -1387,6 +1418,12 @@ class DriverActor(Actor):
         if w is None:
             return
         _record_metric("cluster.worker_count", len(self.workers))
+        # the fleet view stops serving the dead worker's stale gauges
+        # (counter/histogram history stays: it is still true)
+        try:
+            _fleet().drop_worker_gauges(wid)
+        except Exception:  # noqa: BLE001 — telemetry never blocks eviction
+            pass
         events.emit(EventType.WORKER_EVICT, query_id="", worker=wid,
                     reason=reason)
         try:
@@ -2057,6 +2094,70 @@ class DriverActor(Actor):
         if self.elastic is not None:
             self._maybe_scale_up()
 
+    def _merge_heartbeat_metrics(self, hb: "pb.HeartbeatRequest"):
+        """Fold a heartbeat's piggybacked metric delta into the fleet
+        view. A delta from THIS process (loopback thread workers share
+        the driver's registry) is dropped — its increments are already
+        in the local view and merging them would double-count fleet
+        totals."""
+        raw = getattr(hb, "metrics_json", "")
+        if not raw:
+            return
+        try:
+            delta = json.loads(raw)
+        except ValueError:
+            return
+        if not isinstance(delta, dict):
+            return
+        from .. import metrics as _m
+        src = delta.get("src")
+        if src is not None:
+            if src == _m.PROCESS_TOKEN:
+                return
+        elif int(delta.get("pid", 0) or 0) == os.getpid():
+            return  # version-skewed worker without a token: pid check
+        try:
+            _fleet().merge(hb.worker_id, delta)
+        except Exception:  # noqa: BLE001 — telemetry never fails the plane
+            pass
+
+    def readiness(self) -> dict:
+        """Cluster readiness for the ops endpoint's ``/readyz``: every
+        registered worker heartbeating inside the timeout, no evicted
+        worker pending readmission (capacity we expect back is still
+        missing), and no wedged admission queue (a queued job sitting
+        past twice its shed budget means the scheduling loop is stuck).
+        Called from the HTTP thread — reads are snapshots and a torn
+        read degrades to not-ready, never an exception upstream."""
+        now = time.time()
+        for _ in range(3):
+            try:
+                workers = dict(self.workers)
+                readmit = list(self._readmit_info)
+                quarantined = sorted(dict(self.quarantined))
+                break
+            except RuntimeError:  # actor thread resized mid-copy
+                continue
+        else:
+            # the actor is visibly busy mutating pool state — that is
+            # not "unready", and flapping /readyz on it would be worse
+            return {"ready": True, "driver_id": self.driver_id,
+                    "racing": True}
+        stale = sorted(
+            wid for wid, w in workers.items()
+            if now - float(w.get("last_seen", 0.0))
+            > self.HEARTBEAT_TIMEOUT_S)
+        pending = sorted(wid for wid in readmit
+                         if wid not in workers)
+        wedged = self.admission.wedged(now)
+        ready = bool(workers) and not stale and not pending \
+            and not wedged
+        return {"ready": ready, "driver_id": self.driver_id,
+                "workers": len(workers), "stale_heartbeats": stale,
+                "pending_readmission": pending,
+                "quarantined": quarantined,
+                "admission_wedged": wedged}
+
     def _maybe_readmit(self, wid: str):
         """An evicted worker is still alive and heartbeating (transient
         dispatch failure, heartbeat blip, or an expired quarantine):
@@ -2268,6 +2369,11 @@ class LocalCluster:
         while len(self.driver.workers) < num_workers and time.time() < deadline:
             time.sleep(0.02)
         self.last_job: Optional[_Job] = None
+        # the driver joins the process ops surface: /readyz and the
+        # debug endpoints report this cluster until stop()
+        from .. import obs_server
+        obs_server.register_cluster(self.driver)
+        obs_server.ensure_started()
 
     def run_job(self, plan, num_partitions: Optional[int] = None,
                 timeout=120, epoch: int = 0,
@@ -2503,6 +2609,8 @@ class LocalCluster:
         return dict(self.last_job.task_metrics) if self.last_job else {}
 
     def stop(self):
+        from .. import obs_server
+        obs_server.unregister_cluster(self.driver)
         for w in self.workers:
             w.stop()
         if self.manager is not None:

@@ -351,23 +351,23 @@ def _compile_timed(fn, key, fused=False):
     charged to the query that missed the operator cache. ``fused`` marks
     whole-stage programs: their compile time additionally rides
     ``execution.fusion.compile_time``."""
-    import time as _time
-
     from .. import profiler
+    from ..metrics import timer as _metric_timer
 
     pending = [True]
 
     def wrapper(*args, **kwargs):
         if pending:
             del pending[:]
-            t0 = _time.perf_counter()
-            out = fn(*args, **kwargs)
-            elapsed = _time.perf_counter() - t0
+            # fused programs additionally observe into the fusion
+            # compile-latency histogram; the same handle feeds the
+            # profile either way
+            with _metric_timer("execution.fusion.compile_time"
+                               if fused else None) as tm:
+                out = fn(*args, **kwargs)
             key_repr = repr(key[0]) if isinstance(key, tuple) and key \
                 else repr(key)
-            profiler.note_compile_time(elapsed, key=key_repr)
-            if fused:
-                _record_metric("execution.fusion.compile_time", elapsed)
+            profiler.note_compile_time(tm.elapsed_s, key=key_repr)
             return out
         return fn(*args, **kwargs)
 

@@ -219,22 +219,24 @@ def decode_stream(source, stats: Optional[FetchStats] = None):
     accept any producer codec. Decode wall time (net of chunk wait for
     a ChunkReader) lands in ``execution.shuffle.decode_time``."""
     import pyarrow as pa
-    t0 = time.perf_counter()
-    if isinstance(source, (bytes, bytearray)):
-        source = ChunkReader(iter([bytes(source)]))
-    schema = None
-    batches = []
-    while True:
-        reader = pa.ipc.open_stream(source)
-        if schema is None:
-            schema = reader.schema
-        batches.extend(reader)
-        if not isinstance(source, ChunkReader) or not source.peek(1):
-            break  # single stream source, or no further stream follows
-    table = pa.Table.from_batches(batches, schema=schema)
-    elapsed = time.perf_counter() - t0
+    from ..metrics import timer as _metric_timer
+    # measure-only timer handle: the recorded value is elapsed NET of
+    # chunk wait, so the registry write happens below, not at exit
+    with _metric_timer() as tm:
+        if isinstance(source, (bytes, bytearray)):
+            source = ChunkReader(iter([bytes(source)]))
+        schema = None
+        batches = []
+        while True:
+            reader = pa.ipc.open_stream(source)
+            if schema is None:
+                schema = reader.schema
+            batches.extend(reader)
+            if not isinstance(source, ChunkReader) or not source.peek(1):
+                break  # single stream source, or no further stream
+        table = pa.Table.from_batches(batches, schema=schema)
     wait = source.wait_s if isinstance(source, ChunkReader) else 0.0
-    decode_s = max(0.0, elapsed - wait)
+    decode_s = max(0.0, tm.elapsed_s - wait)
     try:
         _record_metric("execution.shuffle.decode_time", decode_s)
     except Exception:  # noqa: BLE001 — telemetry never fails the fetch

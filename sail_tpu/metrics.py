@@ -6,8 +6,27 @@ generates typed Rust instruments (instruments.rs) at build time. The
 same contract here is enforced at record time: a metric name or
 attribute key outside the registry raises, so instruments cannot drift
 from their declarations. Values are queryable in-process through the
-``system.telemetry.metrics`` table and export as OTLP/HTTP JSON gauge
-datapoints (``/v1/metrics``) when an exporter is configured.
+``system.telemetry.metrics`` table, export as OTLP/HTTP JSON datapoints
+(``/v1/metrics``) when an exporter is configured, and serve in
+Prometheus text exposition from the pull-based ops endpoint
+(``sail_tpu/obs_server.py`` ``/metrics``).
+
+Instrument types:
+
+- ``counter``   monotonic accumulate
+- ``gauge``     last value wins
+- ``histogram`` bounded exponential buckets (``HistogramState``):
+  mergeable across processes (bucket counts + sum + count add), with
+  p50/p95/p99 estimated by linear interpolation inside the bucket the
+  quantile lands in — so live percentiles never require retaining raw
+  samples.
+
+Fleet aggregation: workers ship counter/histogram DELTAS piggybacked on
+the control-plane heartbeat (``take_heartbeat_delta``); the driver
+merges them into :data:`FLEET` keyed by worker id. A delta from the
+driver's own process is skipped at merge time (the loopback thread-
+worker topology shares this module's REGISTRY, so its increments are
+already in the local view) — fleet totals never double-count.
 """
 
 from __future__ import annotations
@@ -16,30 +35,157 @@ import json
 import os
 import threading
 import time
+import uuid
+from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 _REGISTRY_PATH = os.path.join(os.path.dirname(__file__),
                               "metrics_registry.yaml")
+
+#: default exponential bucket ladder for latency histograms (seconds):
+#: 1ms doubling to ~524s, +Inf overflow — 20 finite bounds
+DEFAULT_BUCKETS = {"base": 0.001, "growth": 2.0, "count": 20}
+
+#: quantiles the SLO surfaces report
+SLO_QUANTILES = (0.50, 0.95, 0.99)
+
+
+def exponential_bounds(base: float, growth: float,
+                       count: int) -> Tuple[float, ...]:
+    """Finite upper bounds ``base * growth**i`` for i in [0, count)."""
+    base = float(base)
+    growth = float(growth)
+    count = max(1, int(count))
+    return tuple(base * growth ** i for i in range(count))
 
 
 @dataclass(frozen=True)
 class MetricDef:
     name: str
     description: str
-    type: str                      # counter | gauge
+    type: str                      # counter | gauge | histogram
     value_type: str
     unit: str = ""
     attributes: Tuple[str, ...] = ()
+    # histogram only: finite bucket upper bounds (ascending); the
+    # overflow (+Inf) bucket is implicit
+    bounds: Tuple[float, ...] = ()
+
+
+class HistogramState:
+    """One (metric, attribute-set) histogram: bucket counts over the
+    declared bounds plus an implicit +Inf overflow bucket, with running
+    sum/count. Mergeable (bucket-wise add) and subtractable (windowed
+    percentiles between two snapshots)."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Tuple[float, ...],
+                 counts: Optional[List[int]] = None,
+                 total: float = 0.0, count: int = 0):
+        self.bounds = bounds
+        self.counts = list(counts) if counts is not None \
+            else [0] * (len(bounds) + 1)
+        self.sum = float(total)
+        self.count = int(count)
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:                    # first bound >= value
+            mid = (lo + hi) // 2
+            if value <= self.bounds[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        self.counts[lo] += 1
+        self.sum += value
+        self.count += 1
+
+    def copy(self) -> "HistogramState":
+        return HistogramState(self.bounds, self.counts, self.sum,
+                              self.count)
+
+    def merge(self, other: "HistogramState") -> None:
+        for i, c in enumerate(other.counts[:len(self.counts)]):
+            self.counts[i] += int(c)
+        self.sum += other.sum
+        self.count += other.count
+
+    def subtract(self, other: "HistogramState") -> "HistogramState":
+        """Window between two snapshots of the SAME instrument
+        (self - other); negative residue clamps to zero."""
+        counts = [max(0, a - b) for a, b in zip(self.counts,
+                                                other.counts)]
+        return HistogramState(self.bounds, counts,
+                              max(0.0, self.sum - other.sum),
+                              max(0, self.count - other.count))
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the q-quantile by linear interpolation inside the
+        bucket the rank lands in; the overflow bucket clamps to the
+        last finite bound (the estimate's resolution IS the bucket)."""
+        if self.count <= 0:
+            return None
+        q = min(1.0, max(0.0, float(q)))
+        rank = q * self.count
+        seen = 0.0
+        for i, c in enumerate(self.counts):
+            if c <= 0:
+                continue
+            if seen + c >= rank:
+                if i >= len(self.bounds):          # overflow bucket
+                    return self.bounds[-1] if self.bounds else None
+                lower = self.bounds[i - 1] if i > 0 else 0.0
+                upper = self.bounds[i]
+                frac = (rank - seen) / c
+                return lower + (upper - lower) * min(1.0, max(0.0, frac))
+            seen += c
+        return self.bounds[-1] if self.bounds else None
+
+    def percentiles(self) -> Dict[str, Optional[float]]:
+        return {f"p{int(q * 100)}": self.quantile(q)
+                for q in SLO_QUANTILES}
+
+    def to_wire(self) -> dict:
+        return {"counts": list(self.counts), "sum": self.sum,
+                "count": self.count}
+
+    @classmethod
+    def from_wire(cls, bounds: Tuple[float, ...],
+                  d: dict) -> "HistogramState":
+        counts = [int(c) for c in (d.get("counts") or ())]
+        counts = (counts + [0] * (len(bounds) + 1))[:len(bounds) + 1]
+        return cls(bounds, counts, float(d.get("sum", 0.0)),
+                   int(d.get("count", 0)))
+
+
+#: key of one recorded series: (metric name, sorted attribute pairs)
+SeriesKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+#: process-unique origin token for heartbeat deltas — pid equality is
+#: not collision-free across hosts, this is
+PROCESS_TOKEN = uuid.uuid4().hex
+
+
+def _series_key(name: str, attributes: Dict[str, object]) -> SeriesKey:
+    return (name, tuple(sorted(
+        (k, str(v)) for k, v in attributes.items())))
 
 
 class MetricsRegistry:
     def __init__(self, defs: List[MetricDef]):
         self._defs: Dict[str, MetricDef] = {d.name: d for d in defs}
-        self._values: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
-                           float] = {}
+        self._values: Dict[SeriesKey, float] = {}
+        self._hists: Dict[SeriesKey, HistogramState] = {}
         self._lock = threading.Lock()
         self._dirty = False
+        # heartbeat delta cursor: last-shipped counter values /
+        # histogram snapshots / gauge values (one per-process shipper)
+        self._delta_counters: Dict[SeriesKey, float] = {}
+        self._delta_hists: Dict[SeriesKey, HistogramState] = {}
+        self._delta_gauges: Dict[SeriesKey, float] = {}
 
     @classmethod
     def from_yaml(cls, path: str = _REGISTRY_PATH) -> "MetricsRegistry":
@@ -47,21 +193,34 @@ class MetricsRegistry:
 
         with open(path, "r", encoding="utf-8") as f:
             raw = yaml.safe_load(f) or []
-        defs = [MetricDef(
-            name=e["name"], description=e.get("description", ""),
-            type=str(e.get("type", "counter")).lower(),
-            value_type=str(e.get("value_type", "u64")),
-            unit=e.get("unit", ""),
-            attributes=tuple(e.get("attributes") or ()))
-            for e in raw]
+        defs = []
+        for e in raw:
+            mtype = str(e.get("type", "counter")).lower()
+            bounds: Tuple[float, ...] = ()
+            if mtype == "histogram":
+                spec = dict(DEFAULT_BUCKETS)
+                spec.update(e.get("buckets") or {})
+                bounds = exponential_bounds(
+                    spec["base"], spec["growth"], spec["count"])
+            defs.append(MetricDef(
+                name=e["name"], description=e.get("description", ""),
+                type=mtype,
+                value_type=str(e.get("value_type", "u64")),
+                unit=e.get("unit", ""),
+                attributes=tuple(e.get("attributes") or ()),
+                bounds=bounds))
         return cls(defs)
 
     def definitions(self) -> List[MetricDef]:
         return list(self._defs.values())
 
+    def definition(self, name: str) -> Optional[MetricDef]:
+        return self._defs.get(name)
+
     def record(self, name: str, value, **attributes) -> None:
-        """Counter: accumulate. Gauge: last value wins. Unknown metric
-        names or attribute keys are declaration drift and raise."""
+        """Counter: accumulate. Gauge: last value wins. Histogram: one
+        observation. Unknown metric names or attribute keys are
+        declaration drift and raise."""
         d = self._defs.get(name)
         if d is None:
             raise KeyError(f"metric {name!r} is not in the registry")
@@ -70,19 +229,35 @@ class MetricsRegistry:
             raise KeyError(
                 f"metric {name!r} does not declare attributes "
                 f"{sorted(unknown)}")
-        key = (name, tuple(sorted(
-            (k, str(v)) for k, v in attributes.items())))
+        key = _series_key(name, attributes)
         with self._lock:
-            if d.type == "counter":
+            if d.type == "histogram":
+                h = self._hists.get(key)
+                if h is None:
+                    h = self._hists[key] = HistogramState(d.bounds)
+                h.observe(value)
+            elif d.type == "counter":
                 self._values[key] = self._values.get(key, 0) + value
             else:
                 self._values[key] = value
             self._dirty = True
 
+    def histogram_state(self, name: str,
+                        **attributes) -> Optional[HistogramState]:
+        """Snapshot one histogram series (copy), None if never recorded."""
+        key = _series_key(name, attributes)
+        with self._lock:
+            h = self._hists.get(key)
+            return h.copy() if h is not None else None
+
     def snapshot(self) -> List[dict]:
-        """One row per (metric, attribute-set) with its current value."""
+        """One row per (metric, attribute-set) with its current value.
+        Histogram rows report ``value`` = sum (backward-compatible with
+        the counter it replaced) plus ``count`` and estimated
+        p50/p95/p99."""
         with self._lock:
             items = list(self._values.items())
+            hists = [(k, h.copy()) for k, h in self._hists.items()]
         out = []
         for (name, attrs), value in items:
             d = self._defs[name]
@@ -90,11 +265,23 @@ class MetricsRegistry:
                         "description": d.description,
                         "attributes": json.dumps(dict(attrs)),
                         "value": float(value)})
+        for (name, attrs), h in hists:
+            d = self._defs[name]
+            row = {"name": name, "type": d.type, "unit": d.unit,
+                   "description": d.description,
+                   "attributes": json.dumps(dict(attrs)),
+                   "value": float(h.sum), "count": h.count}
+            row.update(h.percentiles())
+            out.append(row)
         return sorted(out, key=lambda r: (r["name"], r["attributes"]))
 
     def reset(self) -> None:
         with self._lock:
             self._values.clear()
+            self._hists.clear()
+            self._delta_counters.clear()
+            self._delta_hists.clear()
+            self._delta_gauges.clear()
             self._dirty = False
 
     def take_dirty(self) -> bool:
@@ -104,14 +291,55 @@ class MetricsRegistry:
             d, self._dirty = self._dirty, False
             return d
 
+    # -- heartbeat delta shipping (fleet aggregation) -------------------
+    def take_heartbeat_delta(self) -> Optional[dict]:
+        """Increments since the last call, as a JSON-able wire record:
+        counter deltas, histogram bucket-increment deltas, and changed
+        gauge values. One cursor per process — the worker heartbeat
+        loop is the single shipper. Returns None when nothing changed
+        (the heartbeat stays light)."""
+        with self._lock:
+            counters = []
+            gauges = []
+            for key, value in self._values.items():
+                d = self._defs[key[0]]
+                if d.type == "counter":
+                    delta = value - self._delta_counters.get(key, 0.0)
+                    if delta:
+                        counters.append(
+                            [key[0], dict(key[1]), float(delta)])
+                        self._delta_counters[key] = value
+                else:  # gauge: ship only when the value moved
+                    if self._delta_gauges.get(key) != value:
+                        gauges.append([key[0], dict(key[1]),
+                                       float(value)])
+                        self._delta_gauges[key] = value
+            hists = []
+            for key, h in self._hists.items():
+                prev = self._delta_hists.get(key)
+                delta = h.subtract(prev) if prev is not None else h
+                if delta.count:
+                    hists.append([key[0], dict(key[1]),
+                                  delta.to_wire()])
+                    self._delta_hists[key] = h.copy()
+        if not counters and not hists and not gauges:
+            return None
+        return {"pid": os.getpid(), "src": PROCESS_TOKEN,
+                "counters": counters, "gauges": gauges,
+                "histograms": hists}
+
     # -- OTLP/HTTP JSON export (/v1/metrics) ----------------------------
     def otlp_payload(self, service_name: str = "sail-tpu") -> dict:
         now = str(time.time_ns())
         metrics = []
         by_name: Dict[str, List] = {}
+        hist_by_name: Dict[str, List] = {}
         with self._lock:
             for (name, attrs), value in self._values.items():
                 by_name.setdefault(name, []).append((attrs, value))
+            for (name, attrs), h in self._hists.items():
+                hist_by_name.setdefault(name, []).append(
+                    (attrs, h.copy()))
         for name, points in sorted(by_name.items()):
             d = self._defs[name]
             dps = [{
@@ -131,6 +359,26 @@ class MetricsRegistry:
             else:
                 body["gauge"] = {"dataPoints": dps}
             metrics.append(body)
+        for name, points in sorted(hist_by_name.items()):
+            d = self._defs[name]
+            # real OTLP histogram datapoints: bucket counts + explicit
+            # bounds + sum + count, cumulative temporality — not the
+            # flattened gauges the pre-histogram exporter would have sent
+            dps = [{
+                "timeUnixNano": now,
+                "count": str(h.count),
+                "sum": h.sum,
+                "bucketCounts": [str(c) for c in h.counts],
+                "explicitBounds": list(h.bounds),
+                "attributes": [
+                    {"key": k, "value": {"stringValue": v}}
+                    for k, v in attrs],
+            } for attrs, h in points]
+            metrics.append({
+                "name": name, "description": d.description,
+                "unit": d.unit,
+                "histogram": {"dataPoints": dps,
+                              "aggregationTemporality": 2}})
         return {"resourceMetrics": [{
             "resource": {"attributes": [
                 {"key": "service.name",
@@ -140,7 +388,268 @@ class MetricsRegistry:
         }]}
 
 
+class _TimerHandle:
+    __slots__ = ("elapsed_s",)
+
+    def __init__(self):
+        self.elapsed_s = 0.0
+
+
+def merge_heartbeat_deltas(base: Optional[dict],
+                           inc: Optional[dict]) -> Optional[dict]:
+    """Combine two wire deltas (an UNSENT one from a failed heartbeat
+    and the next cycle's increments) so a transient RPC failure defers
+    shipment instead of losing it: counters and histogram buckets add,
+    gauges last-value-wins."""
+    if base is None:
+        return inc
+    if inc is None:
+        return base
+    out = {"pid": inc.get("pid", base.get("pid")),
+           "src": inc.get("src", base.get("src"))}
+    counters: Dict[Tuple[str, str], float] = {}
+    for entry in list(base.get("counters") or ()) + \
+            list(inc.get("counters") or ()):
+        name, attrs, value = entry
+        key = (name, json.dumps(attrs or {}, sort_keys=True))
+        counters[key] = counters.get(key, 0.0) + float(value)
+    out["counters"] = [[name, json.loads(attrs), v]
+                       for (name, attrs), v in counters.items()]
+    gauges: Dict[Tuple[str, str], float] = {}
+    for entry in list(base.get("gauges") or ()) + \
+            list(inc.get("gauges") or ()):
+        name, attrs, value = entry
+        gauges[(name, json.dumps(attrs or {},
+                                 sort_keys=True))] = float(value)
+    out["gauges"] = [[name, json.loads(attrs), v]
+                     for (name, attrs), v in gauges.items()]
+    hists: Dict[Tuple[str, str], dict] = {}
+    for entry in list(base.get("histograms") or ()) + \
+            list(inc.get("histograms") or ()):
+        name, attrs, wire = entry
+        key = (name, json.dumps(attrs or {}, sort_keys=True))
+        cur = hists.get(key)
+        if cur is None:
+            hists[key] = {"counts": list(wire.get("counts") or ()),
+                          "sum": float(wire.get("sum", 0.0)),
+                          "count": int(wire.get("count", 0))}
+        else:
+            counts = list(wire.get("counts") or ())
+            merged = [a + b for a, b in zip(
+                cur["counts"] + [0] * len(counts),
+                counts + [0] * len(cur["counts"]))]
+            cur["counts"] = merged[:max(len(counts),
+                                        len(cur["counts"]))]
+            cur["sum"] += float(wire.get("sum", 0.0))
+            cur["count"] += int(wire.get("count", 0))
+    out["histograms"] = [[name, json.loads(attrs), wire]
+                         for (name, attrs), wire in hists.items()]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fleet view: per-worker merged deltas on the cluster driver
+# ---------------------------------------------------------------------------
+
+class FleetMetrics:
+    """Driver-side merge of worker metric deltas, keyed by worker id.
+
+    Counters and histograms accumulate (deltas add); gauges keep the
+    worker's last shipped value. The LOCAL process is not stored here —
+    readers union these entries with the live :data:`REGISTRY` under
+    the reserved worker id ``"driver"`` — and a delta originating from
+    the driver's own pid is skipped by the caller, so loopback thread
+    workers (which share the process registry) never double-count."""
+
+    #: per-worker entries retained; beyond it the STALEST worker's
+    #: series drop (worker churn in an elastic pool must not grow the
+    #: driver's fleet view — and every /metrics scrape — forever)
+    MAX_WORKERS = 128
+
+    def __init__(self, defs: Optional[Dict[str, MetricDef]] = None):
+        self._lock = threading.Lock()
+        self._defs = defs
+        # worker -> series key -> float | HistogramState
+        self._workers: Dict[str, Dict[SeriesKey, object]] = {}
+        self._updated: Dict[str, float] = {}
+
+    def _def(self, name: str) -> Optional[MetricDef]:
+        defs = self._defs if self._defs is not None else REGISTRY._defs
+        return defs.get(name)
+
+    def merge(self, worker_id: str, delta: dict) -> None:
+        """Merge one shipped delta. Unknown metric names are dropped —
+        a version-skewed worker must not poison the fleet view."""
+        if not isinstance(delta, dict):
+            return
+        with self._lock:
+            store = self._workers.setdefault(worker_id, {})
+            self._updated[worker_id] = time.time()
+            while len(self._workers) > self.MAX_WORKERS:
+                stalest = min(self._updated, key=self._updated.get)
+                self._workers.pop(stalest, None)
+                self._updated.pop(stalest, None)
+            for entry in delta.get("counters") or ():
+                name, attrs, value = entry
+                if self._def(name) is None:
+                    continue
+                key = _series_key(name, attrs or {})
+                store[key] = float(store.get(key, 0.0)) + float(value)
+            for entry in delta.get("gauges") or ():
+                name, attrs, value = entry
+                if self._def(name) is None:
+                    continue
+                store[_series_key(name, attrs or {})] = float(value)
+            for entry in delta.get("histograms") or ():
+                name, attrs, wire = entry
+                d = self._def(name)
+                if d is None or d.type != "histogram":
+                    continue
+                key = _series_key(name, attrs or {})
+                inc = HistogramState.from_wire(d.bounds, wire or {})
+                cur = store.get(key)
+                if isinstance(cur, HistogramState):
+                    cur.merge(inc)
+                else:
+                    store[key] = inc
+
+    def drop_worker_gauges(self, worker_id: str) -> None:
+        """A worker left the pool (eviction/crash): its GAUGE series
+        are stale point-in-time values and must stop being served;
+        counters and histograms are monotonic history and stay (a
+        readmitted worker resumes merging into them)."""
+        with self._lock:
+            store = self._workers.get(worker_id)
+            if not store:
+                return
+            for key in [k for k, v in store.items()
+                        if not isinstance(v, HistogramState)
+                        and (self._def(k[0]) is None
+                             or self._def(k[0]).type == "gauge")]:
+                store.pop(key, None)
+            if not store:
+                self._workers.pop(worker_id, None)
+                self._updated.pop(worker_id, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._workers.clear()
+            self._updated.clear()
+
+    def worker_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._workers)
+
+    def snapshot(self) -> List[dict]:
+        """Fleet rows: one per (worker, metric, attribute-set) — the
+        local process appears as worker ``"driver"`` with the live
+        registry values, remote workers with their merged deltas."""
+        rows = []
+        for r in REGISTRY.snapshot():
+            row = dict(r)
+            row["worker"] = "driver"
+            rows.append(row)
+        with self._lock:
+            # histogram states must COPY under the lock: merge()
+            # mutates them in place on the heartbeat path
+            workers = {
+                wid: {k: (v.copy() if isinstance(v, HistogramState)
+                          else v) for k, v in store.items()}
+                for wid, store in self._workers.items()}
+        for wid in sorted(workers):
+            for (name, attrs), value in sorted(workers[wid].items()):
+                d = self._def(name)
+                if d is None:
+                    continue
+                row = {"name": name, "type": d.type, "unit": d.unit,
+                       "description": d.description,
+                       "attributes": json.dumps(dict(attrs)),
+                       "worker": wid}
+                if isinstance(value, HistogramState):
+                    row["value"] = float(value.sum)
+                    row["count"] = value.count
+                    row.update(value.percentiles())
+                else:
+                    row["value"] = float(value)
+                rows.append(row)
+        return rows
+
+    def series(self) -> List[Tuple[str, Dict[str, str], str, object]]:
+        """Raw fleet series for exposition: (name, attributes, worker,
+        value-or-HistogramState), local process first as ``driver``."""
+        out: List[Tuple[str, Dict[str, str], str, object]] = []
+        with REGISTRY._lock:
+            local = list(REGISTRY._values.items())
+            local_h = [(k, h.copy()) for k, h in
+                       REGISTRY._hists.items()]
+        for (name, attrs), value in local:
+            out.append((name, dict(attrs), "driver", float(value)))
+        for (name, attrs), h in local_h:
+            out.append((name, dict(attrs), "driver", h))
+        with self._lock:
+            workers = {wid: dict(store)
+                       for wid, store in self._workers.items()}
+        for wid in sorted(workers):
+            for (name, attrs), value in sorted(
+                    workers[wid].items(),
+                    key=lambda kv: (kv[0][0], kv[0][1])):
+                if isinstance(value, HistogramState):
+                    out.append((name, dict(attrs), wid, value.copy()))
+                else:
+                    out.append((name, dict(attrs), wid, float(value)))
+        return out
+
+    def histogram_states(self, name: str) -> List[Tuple[
+            str, Dict[str, str], HistogramState]]:
+        """Every (worker, attributes, state) of one histogram across
+        the fleet, local process included."""
+        d = self._def(name)
+        if d is None or d.type != "histogram":
+            return []
+        out = []
+        with REGISTRY._lock:
+            local = [(k, h.copy()) for k, h in REGISTRY._hists.items()
+                     if k[0] == name]
+        for (_, attrs), h in local:
+            out.append(("driver", dict(attrs), h))
+        with self._lock:
+            for wid, store in self._workers.items():
+                for (n, attrs), value in store.items():
+                    if n == name and isinstance(value, HistogramState):
+                        out.append((wid, dict(attrs), value.copy()))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition naming
+# ---------------------------------------------------------------------------
+
+_PROM_LEGAL_FIRST = set("abcdefghijklmnopqrstuvwxyz"
+                        "ABCDEFGHIJKLMNOPQRSTUVWXYZ_:")
+_PROM_LEGAL = _PROM_LEGAL_FIRST | set("0123456789")
+
+
+def prometheus_name(name: str, mtype: str = "") -> str:
+    """Registry name → Prometheus metric name: ``sail_`` prefix, dots
+    become underscores, counters get the ``_total`` convention suffix.
+    The ``metrics`` lint validates every declared instrument through
+    this same translation."""
+    base = "sail_" + name.replace(".", "_")
+    if mtype == "counter" and not base.endswith("_total"):
+        base += "_total"
+    return base
+
+
+def is_legal_prometheus_name(name: str) -> bool:
+    return bool(name) and name[0] in _PROM_LEGAL_FIRST and \
+        all(ch in _PROM_LEGAL for ch in name)
+
+
 REGISTRY = MetricsRegistry.from_yaml()
+
+#: cluster driver's fleet view (remote worker deltas; local process
+#: joins at read time as worker "driver")
+FLEET = FleetMetrics()
 
 _ENABLED: "bool | None" = None
 
@@ -169,3 +678,31 @@ def record(name: str, value, **attributes) -> None:
     if not _enabled():
         return
     REGISTRY.record(name, value, **attributes)
+
+
+@contextmanager
+def timer(name: Optional[str] = None, **attributes):
+    """Time a block; record the elapsed seconds into ``name`` (a
+    latency instrument, histogram by declaration). The canonical
+    replacement for hand-rolled ``t0 = time.monotonic(); ...;
+    record(name, delta)`` call sites. ALWAYS measures — the handle's
+    ``elapsed_s`` feeds profiles even when metrics are disabled or
+    ``name`` is None (conditional-recording sites); only the registry
+    write is gated."""
+    handle = _TimerHandle()
+    t0 = time.perf_counter()
+    try:
+        yield handle
+    except BaseException:
+        # an aborted block still measures (the handle feeds error-path
+        # accounting) but records NOTHING — a failed commit/compile
+        # must not pollute the success-latency distribution
+        handle.elapsed_s = time.perf_counter() - t0
+        raise
+    else:
+        handle.elapsed_s = time.perf_counter() - t0
+        if name and _enabled():
+            try:
+                REGISTRY.record(name, handle.elapsed_s, **attributes)
+            except Exception:  # noqa: BLE001 — timing must never raise
+                pass

@@ -153,14 +153,16 @@ class QueryProfile:
             # already covers this wall time
             yield
             return
-        t0 = time.perf_counter()
+        from .metrics import timer as _metric_timer
+        tm = None
         try:
-            yield
+            with _metric_timer() as tm:  # measure-only handle
+                yield
         finally:
             with self._lock:
                 if name in self._open:
                     self._open.remove(name)
-            self.add_phase(name, (time.perf_counter() - t0) * 1000.0)
+            self.add_phase(name, tm.elapsed_s * 1000.0 if tm else 0.0)
 
     def is_open(self, name: str) -> bool:
         with self._lock:
@@ -623,6 +625,19 @@ def _finalize(profile: QueryProfile, threshold_ms: float) -> None:
         _record_metric("execution.query_count", 1,
                        session=profile.session or "default")
     except Exception:  # noqa: BLE001 — telemetry must never break queries
+        pass
+    try:
+        # live SLO source: one query.latency observation per phase the
+        # query entered plus the end-to-end wall under phase=total —
+        # the histograms the per-tenant p50/p95/p99 surfaces
+        # (system.telemetry.tenant_slo, /metrics) are computed from
+        tenant = profile.tenant or "default"
+        for name, ms in profile.phase_items():
+            _record_metric("query.latency", ms / 1000.0,
+                           tenant=tenant, phase=name)
+        _record_metric("query.latency", profile.total_ms / 1000.0,
+                       tenant=tenant, phase="total")
+    except Exception:  # noqa: BLE001
         pass
     try:
         from . import events as _events

@@ -68,6 +68,10 @@ class SparkSession:
         # SQL text + parse wall time per root plan, consumed when the
         # plan executes so the query profile can carry both
         self._parsed: "OrderedDict[int, tuple]" = OrderedDict()
+        # pull-based ops endpoint (telemetry.http.enabled; one check +
+        # at most one server per process)
+        from . import obs_server
+        obs_server.ensure_started()
 
     def newSession(self) -> "SparkSession":
         """A sibling session: same catalog (tables, temp views, UDFs),

@@ -53,6 +53,7 @@ from . import events
 from . import faults
 from .events import EventType
 from .metrics import record as _record_metric
+from .metrics import timer as _metric_timer
 from .spec import plan as sp
 
 
@@ -741,38 +742,44 @@ class StreamingQuery:
                 f"streaming[{label}] epoch {epoch}",
                 session=getattr(self._session, "_session_id", "")) as prof:
             result = self._run_epoch(batch, epoch)
-            commit_t0 = time.time()
-            replayed = self._already_committed(epoch)
-            if replayed:
-                # the marker proves this epoch's output is final: the
-                # replay is a sink no-op, but state/offsets still advance
-                _record_metric("streaming.epoch.replayed_count", 1)
-                events.emit(EventType.EPOCH_REPLAY, epoch=epoch)
-                if self._checkpoint_dir:
-                    self._write_checkpoint()
-            else:
-                rows = int(result.num_rows) if result is not None else 0
-                if result is not None:
-                    faults.inject("streaming.sink", key=f"stage:e{epoch}")
-                    self._sink.stage(epoch, result)
-                events.emit(EventType.EPOCH_STAGE, epoch=epoch,
-                            rows=rows)
-                if self._two_phase and self._sink.durable \
-                        and self._checkpoint_dir:
-                    # two-phase: the checkpoint records the epoch as
-                    # pre-committed BEFORE the finalize, so a crash in
-                    # between recovers by re-finalizing, never re-running
-                    self._write_checkpoint(
-                        pending={"epoch": epoch, "rows": rows})
-                    self._precommitted_epoch = epoch
-                    self._finalize_epoch(epoch)
-                else:
-                    self._finalize_epoch(epoch)
+            # the commit protocol times into the epoch-commit latency
+            # histogram (metrics.timer); the handle's elapsed feeds the
+            # profile and progress record so every surface reports ONE
+            # measurement
+            with _metric_timer("streaming.epoch.commit_time") as ct:
+                replayed = self._already_committed(epoch)
+                if replayed:
+                    # the marker proves this epoch's output is final:
+                    # the replay is a sink no-op, but state/offsets
+                    # still advance
+                    _record_metric("streaming.epoch.replayed_count", 1)
+                    events.emit(EventType.EPOCH_REPLAY, epoch=epoch)
                     if self._checkpoint_dir:
                         self._write_checkpoint()
-            commit_ms = (time.time() - commit_t0) * 1000.0
-            _record_metric("streaming.epoch.commit_time",
-                           commit_ms / 1000.0)
+                else:
+                    rows = int(result.num_rows) \
+                        if result is not None else 0
+                    if result is not None:
+                        faults.inject("streaming.sink",
+                                      key=f"stage:e{epoch}")
+                        self._sink.stage(epoch, result)
+                    events.emit(EventType.EPOCH_STAGE, epoch=epoch,
+                                rows=rows)
+                    if self._two_phase and self._sink.durable \
+                            and self._checkpoint_dir:
+                        # two-phase: the checkpoint records the epoch
+                        # as pre-committed BEFORE the finalize, so a
+                        # crash in between recovers by re-finalizing,
+                        # never re-running
+                        self._write_checkpoint(
+                            pending={"epoch": epoch, "rows": rows})
+                        self._precommitted_epoch = epoch
+                        self._finalize_epoch(epoch)
+                    else:
+                        self._finalize_epoch(epoch)
+                        if self._checkpoint_dir:
+                            self._write_checkpoint()
+            commit_ms = ct.elapsed_s * 1000.0
             if not replayed:
                 events.emit(EventType.EPOCH_COMMIT, epoch=epoch,
                             commit_ms=round(commit_ms, 3))

@@ -105,6 +105,12 @@ def main():
     # so worker-side events attribute to the owning tenant
     changed |= _add_field(task, "tenant", 13, F.TYPE_STRING)
 
+    # live telemetry plane: workers piggyback metric deltas (counters +
+    # histogram bucket increments) on the heartbeat for the driver's
+    # fleet-wide metric view
+    hb = _message(fdp, "HeartbeatRequest")
+    changed |= _add_field(hb, "metrics_json", 3, F.TYPE_STRING)
+
     if not changed:
         print("pb2 already up to date")
         return

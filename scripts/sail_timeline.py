@@ -14,7 +14,9 @@ reconstruction is the SAME computation the live profile runs
 (sail_tpu/analysis/timeline.py), so for a fixed fault seed the replayed
 decision sequence is bit-identical to what EXPLAIN ANALYZE reported.
 A truncated tail (crash mid-write) replays cleanly up to the last
-complete record.
+complete record. Rotated logs replay across segment boundaries: pass
+the ACTIVE path (events-<pid>.jsonl) and its .1/.2/… siblings are
+read first, oldest to newest.
 """
 
 from __future__ import annotations

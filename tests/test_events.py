@@ -127,20 +127,93 @@ def test_jsonl_future_schema_version_refused(tmp_path):
         events.load_event_log(path)
 
 
-def test_jsonl_size_cap_falls_back_to_ring(tmp_path):
+def test_jsonl_rotates_segments_and_replays_across(tmp_path):
     path = str(tmp_path / "ev.jsonl")
-    log = events.EventLog(capacity=4096, path=path, max_bytes=400)
-    for epoch in range(50):
+    log = events.EventLog(capacity=4096, path=path, max_bytes=400,
+                          max_segments=3)
+    for epoch in range(20):
         log.emit(EventType.EPOCH_COMMIT, query_id="q", epoch=epoch,
                  commit_ms=0.5)
     log.close()
     # the ring kept everything (within capacity)...
-    assert len(log.events()) == 50
-    # ...but the file stopped at the cap, every line complete
+    assert len(log.events()) == 20
+    # ...and the durable log rotated: active + up to 2 rotated
+    # segments, each within the per-segment cap
+    segs = events.log_segments(path)
+    assert segs[-1] == path and 1 < len(segs) <= 3
+    for seg in segs:
+        assert os.path.getsize(seg) <= 400
+    # replay reads ACROSS segment boundaries: a contiguous newest
+    # suffix of the stream, in order
+    replayed = events.load_event_log(path)
+    epochs = [e["epoch"] for e in replayed]
+    assert epochs == list(range(epochs[0], 20))
+    assert len(replayed) > sum(
+        1 for _ in open(path))  # more than the active segment alone
+
+
+def test_jsonl_rotation_counts_dropped_lines(tmp_path):
+    from sail_tpu.metrics import REGISTRY
+    path = str(tmp_path / "ev.jsonl")
+    REGISTRY.reset()
+    log = events.EventLog(capacity=4096, path=path, max_bytes=300,
+                          max_segments=2)
+    for epoch in range(40):
+        log.emit(EventType.EPOCH_COMMIT, query_id="q", epoch=epoch,
+                 commit_ms=0.5)
+    log.close()
+    replayed = events.load_event_log(path)
+    dropped = 0
+    for r in REGISTRY.snapshot():
+        if r["name"] == "telemetry.events.dropped_count" and \
+                "rotated" in r["attributes"]:
+            dropped = int(r["value"])
+    # every emitted line is either still replayable or counted dropped
+    assert dropped > 0
+    assert len(replayed) + dropped == 40
+    REGISTRY.reset()
+
+
+def test_jsonl_single_segment_cap_truncates_oldest(tmp_path):
+    # max_segments=1 degenerates to "keep only the newest segment":
+    # the file never exceeds the cap and always holds a newest suffix
+    path = str(tmp_path / "ev.jsonl")
+    log = events.EventLog(capacity=4096, path=path, max_bytes=400,
+                          max_segments=1)
+    for epoch in range(50):
+        log.emit(EventType.EPOCH_COMMIT, query_id="q", epoch=epoch,
+                 commit_ms=0.5)
+    log.close()
     assert os.path.getsize(path) <= 400
+    assert events.log_segments(path) == [path]
     replayed = events.load_event_log(path)
     assert 0 < len(replayed) < 50
-    assert [e["epoch"] for e in replayed] == list(range(len(replayed)))
+    assert [e["epoch"] for e in replayed] == \
+        list(range(50 - len(replayed), 50))
+
+
+def test_jsonl_corrupt_rotated_segment_stops_replay(tmp_path):
+    # a malformed line in an OLDER segment poisons everything after it
+    path = str(tmp_path / "ev.jsonl")
+    log = events.EventLog(capacity=64, path=path, max_bytes=400,
+                          max_segments=4)
+    for epoch in range(20):
+        log.emit(EventType.EPOCH_COMMIT, query_id="q", epoch=epoch,
+                 commit_ms=0.5)
+    log.close()
+    segs = events.log_segments(path)
+    assert len(segs) >= 3
+    with open(segs[1], "r+", encoding="utf-8") as f:
+        lines = f.readlines()
+        lines[0] = "{corrupt\n"
+        f.seek(0)
+        f.truncate()
+        f.writelines(lines)
+    replayed = events.load_event_log(path)
+    # everything from the oldest (intact) segment replays; the corrupt
+    # segment and all newer ones are untrusted
+    first = events._load_one(segs[0])[0]
+    assert replayed == first
 
 
 def test_ingest_stamps_envelope_and_drops_malformed():

@@ -49,9 +49,32 @@ def test_flush_swallows_connection_errors():
         with tr.span("doomed"):
             pass
         tr.log_event("INFO", "doomed log")
-        tr.flush()  # must not raise despite the dead collector
+        gm.record("query.latency", 0.1, tenant="t", phase="total")
+        tr.flush()  # must not raise despite the dead collector —
+        # including the histogram-datapoint metrics payload
     finally:
         tr.configure_exporter(None)
+
+
+def test_histogram_payload_shape_survives_serialization():
+    """The histogram OTLP datapoint shape (bucketCounts + explicit
+    bounds + sum + count) must serialize to JSON exactly as the
+    /v1/metrics endpoint expects — the failure path posts this same
+    payload, so a malformed shape would silently drop under outage."""
+    gm.record("query.latency", 0.03, tenant="t", phase="total")
+    gm.record("execution.spill_count", 1, kind="join")
+    payload = gm.REGISTRY.otlp_payload()
+    body = json.loads(json.dumps(payload))  # round-trippable
+    metrics = {m["name"]: m
+               for m in body["resourceMetrics"][0]
+               ["scopeMetrics"][0]["metrics"]}
+    h = metrics["query.latency"]["histogram"]
+    assert h["aggregationTemporality"] == 2
+    dp = h["dataPoints"][0]
+    assert dp["count"] == "1" and abs(dp["sum"] - 0.03) < 1e-12
+    assert len(dp["bucketCounts"]) == len(dp["explicitBounds"]) + 1
+    assert all(isinstance(c, str) for c in dp["bucketCounts"])
+    assert "sum" in metrics["execution.spill_count"]  # counters intact
 
 
 def test_shutdown_terminates_promptly():

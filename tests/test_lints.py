@@ -196,6 +196,46 @@ def test_seeded_undeclared_metric_attribute(seeded):
     assert any("bogus_attr" in v.message for v in found), found
 
 
+def test_seeded_undeclared_timer_metric(seeded):
+    # timer() records into its named instrument at exit — its call
+    # sites are record sites for drift purposes
+    _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_timer():\n"
+            "    from ..metrics import timer\n"
+            "    with timer(\"lint.seeded_timer_metric\"):\n"
+            "        pass\n")
+    found = _run(seeded, "metrics")
+    assert any("lint.seeded_timer_metric" in v.message
+               for v in found), found
+
+
+def _rewrite_registry(root, old, new):
+    path = os.path.join(root, "sail_tpu", "metrics_registry.yaml")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    assert old in src
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src.replace(old, new, 1))
+
+
+def test_seeded_illegal_prometheus_name(seeded):
+    # a declared name that survives the dot→underscore translation as
+    # an illegal Prometheus metric name must go red
+    _rewrite_registry(seeded, "- name: mesh.exchange_count",
+                      "- name: mesh.exchange-count")
+    found = _run(seeded, "metrics")
+    assert any("illegal Prometheus" in v.message for v in found), found
+
+
+def test_seeded_bad_histogram_bucket_spec(seeded):
+    _rewrite_registry(
+        seeded,
+        "- name: query.latency\n",
+        "- name: query.latency\n  buckets: {base: 0, growth: 1, "
+        "count: 0}\n")
+    found = _run(seeded, "metrics")
+    assert any("bad bucket spec" in v.message for v in found), found
+
+
 def test_seeded_undeclared_event_type(seeded):
     _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_event():\n"
             "    from .. import events\n"

@@ -49,8 +49,17 @@ def test_system_table_surface():
     try:
         got = spark.sql(
             "SELECT name, value FROM system.telemetry.metrics "
-            "WHERE name = 'execution.spill_count'").toPandas()
+            "WHERE name = 'execution.spill_count' "
+            "AND scope = 'process'").toPandas()
         assert got.value.tolist() == [4.0]
+        # the same instrument rides the fleet view as this process's
+        # "driver" entry
+        fleet = spark.sql(
+            "SELECT worker, value FROM system.telemetry.metrics "
+            "WHERE name = 'execution.spill_count' "
+            "AND scope = 'fleet'").toPandas()
+        assert fleet.worker.tolist() == ["driver"]
+        assert fleet.value.tolist() == [4.0]
     finally:
         spark.stop()
 
@@ -72,6 +81,196 @@ def test_spill_records_metric(monkeypatch):
             for r in gm.REGISTRY.snapshot()}
     key = ("execution.spill_count", json.dumps({"kind": "sort"}))
     assert snap.get(key, 0) >= 1
+
+
+def test_histogram_records_and_estimates_percentiles():
+    for v in (0.002, 0.01, 0.01, 0.4, 7.0):
+        gm.record("query.latency", v, tenant="t", phase="total")
+    snap = [r for r in gm.REGISTRY.snapshot()
+            if r["name"] == "query.latency"]
+    assert len(snap) == 1
+    r = snap[0]
+    assert r["type"] == "histogram" and r["count"] == 5
+    assert abs(r["value"] - 7.422) < 1e-9  # value = sum
+    assert r["p50"] is not None and r["p99"] is not None
+    assert r["p50"] <= r["p95"] <= r["p99"]
+
+
+def _bucket_bounds_around(bounds, value):
+    """(lower, upper) of the bucket an exact value falls in."""
+    lo = 0.0
+    for b in bounds:
+        if value <= b:
+            return lo, b
+        lo = b
+    return lo, float("inf")
+
+
+@pytest.mark.parametrize("dist", ["uniform", "exponential", "bimodal"])
+def test_histogram_merge_matches_exact_percentiles(dist):
+    """Split a synthetic distribution across two 'workers', merge the
+    histograms, and check every SLO quantile against the exact sorted-
+    sample quantile WITHIN BUCKET RESOLUTION: the estimate must land in
+    (or adjacent to the boundary of) the exact value's bucket."""
+    import random
+
+    rng = random.Random(42)
+    n = 4000
+    if dist == "uniform":
+        vals = [rng.uniform(0.001, 2.0) for _ in range(n)]
+    elif dist == "exponential":
+        vals = [rng.expovariate(20.0) for _ in range(n)]
+    else:
+        vals = [rng.gauss(0.01, 0.002) for _ in range(n // 2)] + \
+               [rng.gauss(1.0, 0.2) for _ in range(n // 2)]
+    vals = [max(1e-6, v) for v in vals]
+    bounds = gm.exponential_bounds(**gm.DEFAULT_BUCKETS)
+    a = gm.HistogramState(bounds)
+    b = gm.HistogramState(bounds)
+    for i, v in enumerate(vals):
+        (a if i % 2 else b).observe(v)
+    merged = a.copy()
+    merged.merge(b)
+    assert merged.count == n
+    assert abs(merged.sum - sum(vals)) < 1e-6
+    ordered = sorted(vals)
+    for q in gm.SLO_QUANTILES:
+        exact = ordered[int(q * (n - 1))]
+        est = merged.quantile(q)
+        lo, hi = _bucket_bounds_around(bounds, exact)
+        growth = gm.DEFAULT_BUCKETS["growth"]
+        assert lo / growth <= est <= (hi if hi != float("inf")
+                                      else bounds[-1]) * growth, \
+            (dist, q, exact, est, lo, hi)
+
+
+def test_histogram_subtract_windows_percentiles():
+    for v in (0.01,) * 10:
+        gm.record("query.latency", v, tenant="w", phase="total")
+    before = gm.REGISTRY.histogram_state("query.latency", tenant="w",
+                                         phase="total")
+    for v in (1.0,) * 10:
+        gm.record("query.latency", v, tenant="w", phase="total")
+    after = gm.REGISTRY.histogram_state("query.latency", tenant="w",
+                                        phase="total")
+    window = after.subtract(before)
+    assert window.count == 10
+    # the window contains only the ~1.0s observations
+    assert 0.5 <= window.quantile(0.5) <= 2.0
+
+
+def test_timer_records_into_histogram_and_exposes_elapsed():
+    import time as _t
+
+    with gm.timer("execution.compile.compile_time") as tm:
+        _t.sleep(0.01)
+    assert tm.elapsed_s >= 0.01
+    h = gm.REGISTRY.histogram_state("execution.compile.compile_time")
+    assert h is not None and h.count == 1
+    # measure-only handle: no name, nothing recorded, still measured
+    with gm.timer() as tm2:
+        _t.sleep(0.005)
+    assert tm2.elapsed_s >= 0.005
+    assert gm.REGISTRY.histogram_state(
+        "execution.compile.compile_time").count == 1
+
+
+def test_heartbeat_delta_ships_increments_once():
+    gm.record("execution.spill_count", 5, kind="join")
+    gm.record("query.latency", 0.1, tenant="d", phase="total")
+    d1 = gm.REGISTRY.take_heartbeat_delta()
+    assert d1 is not None and d1["pid"] == __import__("os").getpid()
+    counters = {(c[0], json.dumps(c[1])): c[2]
+                for c in d1["counters"]}
+    assert counters[("execution.spill_count",
+                     json.dumps({"kind": "join"}))] == 5
+    assert len(d1["histograms"]) == 1
+    # nothing new → no delta; increments ship exactly once
+    assert gm.REGISTRY.take_heartbeat_delta() is None
+    gm.record("execution.spill_count", 2, kind="join")
+    d2 = gm.REGISTRY.take_heartbeat_delta()
+    counters = {(c[0], json.dumps(c[1])): c[2]
+                for c in d2["counters"]}
+    assert counters[("execution.spill_count",
+                     json.dumps({"kind": "join"}))] == 2
+    # cumulative registry value unaffected by delta cursors
+    snap = {(r["name"], r["attributes"]): r["value"]
+            for r in gm.REGISTRY.snapshot()}
+    assert snap[("execution.spill_count",
+                 json.dumps({"kind": "join"}))] == 7
+
+
+def test_timer_does_not_record_aborted_blocks():
+    """A block that raises still measures (the handle feeds error-path
+    accounting) but must not pollute the success-latency histogram."""
+    with pytest.raises(ValueError):
+        with gm.timer("execution.compile.compile_time") as tm:
+            raise ValueError("abort")
+    assert tm.elapsed_s >= 0.0
+    assert gm.REGISTRY.histogram_state(
+        "execution.compile.compile_time") is None
+
+
+def test_fleet_drop_worker_gauges_keeps_history():
+    fl = gm.FleetMetrics()
+    fl.merge("w1", {
+        "counters": [["execution.spill_count", {"kind": "join"}, 3]],
+        "gauges": [["cluster.worker_count", {}, 4]],
+        "histograms": [["query.latency",
+                        {"tenant": "t", "phase": "total"},
+                        {"counts": [1], "sum": 0.001, "count": 1}]]})
+    fl.drop_worker_gauges("w1")
+    names = {r["name"] for r in fl.snapshot() if r["worker"] == "w1"}
+    # stale point-in-time gauges gone; monotonic history retained
+    assert "cluster.worker_count" not in names
+    assert {"execution.spill_count", "query.latency"} <= names
+
+
+def test_merge_heartbeat_deltas_defers_unsent_increments():
+    """A delta a failed heartbeat could not deliver folds into the
+    next cycle's delta — counters and buckets add, gauges last-wins —
+    so transient RPC failures defer shipment instead of losing it."""
+    a = {"pid": 1, "src": "tok",
+         "counters": [["execution.spill_count", {"kind": "join"}, 3]],
+         "gauges": [["cluster.worker_count", {}, 2]],
+         "histograms": [["query.latency",
+                         {"tenant": "t", "phase": "total"},
+                         {"counts": [1, 0], "sum": 0.001, "count": 1}]]}
+    b = {"pid": 1, "src": "tok",
+         "counters": [["execution.spill_count", {"kind": "join"}, 4]],
+         "gauges": [["cluster.worker_count", {}, 5]],
+         "histograms": [["query.latency",
+                         {"tenant": "t", "phase": "total"},
+                         {"counts": [0, 2], "sum": 0.01, "count": 2}]]}
+    merged = gm.merge_heartbeat_deltas(a, b)
+    assert merged["counters"] == [
+        ["execution.spill_count", {"kind": "join"}, 7]]
+    assert merged["gauges"] == [["cluster.worker_count", {}, 5]]
+    name, attrs, wire = merged["histograms"][0]
+    assert wire == {"counts": [1, 2], "sum": 0.011, "count": 3}
+    assert gm.merge_heartbeat_deltas(None, b) is b
+    assert gm.merge_heartbeat_deltas(a, None) is a
+
+
+def test_tenant_slo_system_table():
+    from sail_tpu import SparkSession
+
+    for v in (0.01, 0.02, 0.03, 0.5):
+        gm.record("query.latency", v, tenant="acme", phase="total")
+    gm.record("cluster.admission.shed_count", 3, tenant="acme",
+              reason="queue_full")
+    spark = SparkSession({"spark.sail.execution.mesh": "off"})
+    try:
+        got = spark.sql(
+            "SELECT tenant, queries, p50_ms, p99_ms, shed_count "
+            "FROM system.telemetry.tenant_slo "
+            "WHERE tenant = 'acme'").toPandas()
+    finally:
+        spark.stop()
+    assert got.tenant.tolist() == ["acme"]
+    assert got.queries.tolist() == [4]
+    assert got.shed_count.tolist() == [3]
+    assert 0 < got.p50_ms[0] <= got.p99_ms[0]
 
 
 def test_otlp_metrics_export():
@@ -105,6 +304,8 @@ def test_otlp_metrics_export():
     try:
         gm.record("execution.spill_count", 7, kind="join")
         gm.record("mesh.exchange_count", 2)
+        gm.record("query.latency", 0.25, tenant="t", phase="total")
+        gm.record("query.latency", 0.5, tenant="t", phase="total")
         tr.flush()
         deadline = time.time() + 5
         while time.time() < deadline and \
@@ -115,6 +316,18 @@ def test_otlp_metrics_export():
         assert ctr["sum"]["dataPoints"][0]["asInt"] == "7"
         g = seen["mesh.exchange_count"]
         assert g["gauge"]["dataPoints"][0]["asInt"] == "2"
+        # histograms export as REAL OTLP histogram datapoints (bucket
+        # counts + explicit bounds + sum + count, cumulative), not
+        # flattened gauges
+        h = seen["query.latency"]["histogram"]
+        assert h["aggregationTemporality"] == 2
+        dp = h["dataPoints"][0]
+        assert dp["count"] == "2"
+        assert abs(dp["sum"] - 0.75) < 1e-9
+        assert len(dp["bucketCounts"]) == len(dp["explicitBounds"]) + 1
+        assert sum(int(c) for c in dp["bucketCounts"]) == 2
+        assert {a["key"] for a in dp["attributes"]} == \
+            {"tenant", "phase"}
     finally:
         tr.configure_exporter(None)
         srv.shutdown()

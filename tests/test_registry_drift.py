@@ -46,7 +46,7 @@ def test_registry_loads_and_names_are_unique():
     names = [e["name"] for e in entries]
     assert len(names) == len(set(names))
     for e in entries:
-        assert e.get("type") in ("counter", "gauge"), e
+        assert e.get("type") in ("counter", "gauge", "histogram"), e
 
 
 def test_record_call_site_attribute_sets():
