@@ -36,7 +36,7 @@ DECISION_TYPES = ("adaptive_applied", "adaptive_rollback",
                   "epoch_stage", "epoch_commit", "epoch_replay",
                   "admission_enqueue", "admission_admit",
                   "admission_defer", "admission_shed", "quota_debit",
-                  "deadline_cancel")
+                  "deadline_cancel", "backend_route")
 
 CATEGORIES = ("compute", "fetch-wait", "queue", "compile", "replan")
 
@@ -190,6 +190,11 @@ def _compiles_in(evs: List[dict], t0: float, t1: float,
     ms = 0.0
     for e in evs:
         if e.get("type") != "compile" or e.get("ts") is None:
+            continue
+        if e.get("source") == "persistent":
+            # a persistent-cache load bound a stored executable:
+            # nothing compiled, and the profile's compile phase agrees
+            # (note_compile_loaded charges no compile time)
             continue
         stamped = e.get("task")
         if stamped is not None:

@@ -65,8 +65,15 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # query lifecycle (driver/session side, all execution paths)
     "query_start": ("statement", "session", "tenant"),
     "query_end": ("status", "rows_out", "total_ms"),
-    # JIT compile of a compiled-operator cache miss (exec/local.py)
-    "compile": ("key", "ms"),
+    # a stage program was bound: source=trace is a compiled-operator
+    # cache miss (JIT wall time in ms), source=persistent a stored AOT
+    # executable loaded from the cross-process cache (load wall time)
+    "compile": ("key", "ms", "source"),
+    # per-stage backend routing decision (exec/router.py): backend in
+    # native | xla | mesh; stage -1 = the plan-level mesh-vs-local
+    # gate; reason names the deciding rule (forced, cost-model,
+    # compile-bound, dispatch-bound, unsupported, default, unavailable)
+    "backend_route": ("stage", "kind", "backend", "reason"),
     # distributed stage lifecycle (driver)
     "stage_submit": ("job_id", "stage", "partitions", "pipelined"),
     "stage_complete": ("job_id", "stage", "rows"),
@@ -131,6 +138,7 @@ class EventType:
     QUERY_START = "query_start"
     QUERY_END = "query_end"
     COMPILE = "compile"
+    BACKEND_ROUTE = "backend_route"
     STAGE_SUBMIT = "stage_submit"
     STAGE_COMPLETE = "stage_complete"
     TASK_DISPATCH = "task_dispatch"

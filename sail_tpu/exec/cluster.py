@@ -2338,6 +2338,12 @@ class LocalCluster:
         ``task_slots`` default from ``cluster.worker_initial_count`` /
         ``cluster.worker_task_slots``."""
         faults.reload()  # pick up SAIL_FAULTS set after module import
+        # workers run LocalExecutor in-process, so re-reading
+        # compile_cache.* here makes every worker share the store a
+        # test/bench just configured through SAIL_COMPILE_CACHE__* env
+        # (process workers inherit it through their environment)
+        from . import pcache
+        pcache.reload()
         from ..config import get as config_get
         if num_workers is None:
             num_workers = _conf_int(

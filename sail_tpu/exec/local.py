@@ -441,6 +441,13 @@ class LocalExecutor:
         self._rtf_scan_stats: Dict[int, Tuple[int, int]] = {}
         # whole-stage fusion gate, resolved once per executor
         self._fusion: Optional[bool] = None
+        # persistent compiled-program cache gate (exec/pcache.py)
+        self._pcache: Optional[bool] = None
+        # per-stage backend routing decisions of the current plan
+        # (exec/router.py): stage sid -> Decision, plus the node->sid
+        # map the decisions were made under
+        self._backend_routes: Dict = {}
+        self._route_stage_of: Dict = {}
 
     def _fusion_on(self) -> bool:
         """``spark.sail.execution.fusion.enabled`` (session conf) over
@@ -470,6 +477,15 @@ class LocalExecutor:
             _record_metric("execution.fusion.fused_op_count", fused_ops)
         profiler.note_fusion(stages=len(split.stages),
                              fused_ops=fused_ops)
+        # per-stage backend routing, decided HERE — at stage-split time
+        # — so execution consults a recorded decision instead of making
+        # an implicit one per operator (exec/router.py)
+        from . import router
+        decisions = router.decide_split(
+            split, force=router.forced_backend(self.config))
+        self._backend_routes = {d.stage: d for d in decisions}
+        self._route_stage_of = split.stage_of
+        router.record_decisions(decisions)
         mode = validation_mode(
             self.config.get("spark.sail.analysis.validatePlans"))
         if mode != VALIDATE_OFF:
@@ -589,6 +605,19 @@ class LocalExecutor:
         except TypeError:
             return None
 
+    def _pcache_on(self) -> bool:
+        """Persistent compiled-program cache gate, resolved once per
+        executor: ``spark.sail.compileCache.enabled`` (session conf)
+        over the process-wide ``compile_cache.{enabled,dir}`` (a store
+        only exists when a directory is configured)."""
+        if self._pcache is None:
+            from ..config import truthy_value
+            from . import pcache
+            session = self.config.get("spark.sail.compileCache.enabled")
+            self._pcache = pcache.enabled() and \
+                (session is None or truthy_value(session))
+        return self._pcache
+
     def _jitted(self, key, dict_objs: Tuple, builder, fused=False):
         """Returns (fn, aux) where fn is jit-compiled and cached when the
         key is hashable, else built fresh and run eagerly.
@@ -598,7 +627,16 @@ class LocalExecutor:
         active query profile); a miss additionally times the jitted
         program's FIRST invocation — where jax traces and XLA compiles —
         as ``execution.compile.compile_time`` (and, for whole-stage
-        fused programs, ``execution.fusion.compile_time``)."""
+        fused programs, ``execution.fusion.compile_time``).
+
+        With the persistent cache enabled (``compile_cache.*``), an
+        in-memory miss consults the cross-process AOT store BEFORE
+        tracing (``exec/pcache.py``): a persistent hit deserializes the
+        stored executable (no trace, no XLA compile), a persistent miss
+        AOT-compiles and stores. Builders routed here must bake only
+        key-covered structure, dictionary-derived tables, and keyed
+        subquery values into their closures — that is the persistence
+        contract the entry digest verifies."""
         import jax
 
         from .. import profiler
@@ -612,6 +650,14 @@ class LocalExecutor:
         def build():
             missed.append(True)
             fn, aux = builder()
+            if self._pcache_on():
+                from . import pcache
+                site = key[0] if isinstance(key, tuple) and key \
+                    and isinstance(key[0], str) else "op"
+                wrapped = pcache.wrap(fn, key, dict_objs, fused=fused,
+                                      site=site)
+                if wrapped is not None:
+                    return wrapped, aux
             return _compile_timed(jax.jit(fn), key, fused=fused), aux
 
         missed: list = []
@@ -1594,27 +1640,62 @@ class LocalExecutor:
         # measure the program that actually runs, not an unfused variant.
         chain, child, bottom_node = self._pipeline_chain(p.input)
         # CPU fallback fast path: fused C++ row loop over host buffers
-        # (one pass for all aggregates; see sail_tpu/native/)
+        # (one pass for all aggregates; see sail_tpu/native/) — taken
+        # only when the backend router's stage decision says native
+        # (stage-split-time routing; `execution.backend.force` can pin
+        # either substrate for A/B and bisection)
         from .. import native as _native
-        if tel.current_collector() is not None:
-            if _native.native_active():
-                try:
-                    with tel.operator_span("NativeFusedAggregate",
-                                           "fused C++ host kernel") as m:
-                        native = _native.try_native_agg(
-                            self, p, chain, child, bottom_node)
-                        if native is None:
-                            raise _NativeMiss()  # discard the span
-                        m.output_rows = int(native.device.num_rows())
-                        m.capacity = native.capacity
-                        return native
-                except _NativeMiss:
-                    pass
-        else:
-            native = _native.try_native_agg(self, p, chain, child,
-                                            bottom_node)
-            if native is not None:
-                return native
+        from . import router
+
+        from ..plan import stages as pst
+
+        route = self._aggregate_route(p)
+        go_native = _native.native_active() and \
+            (route is None or route.backend == "native")
+        obs_key = router.obs_key(
+            tuple(pst.node_fingerprint(n) for n in [p] + chain))
+        with router.observing(obs_key):
+            if tel.current_collector() is not None:
+                if go_native:
+                    try:
+                        with tel.operator_span(
+                                "NativeFusedAggregate",
+                                "fused C++ host kernel") as m:
+                            native = _native.try_native_agg(
+                                self, p, chain, child, bottom_node)
+                            if native is None:
+                                raise _NativeMiss()  # discard the span
+                            m.output_rows = int(native.device.num_rows())
+                            m.capacity = native.capacity
+                            return native
+                    except _NativeMiss:
+                        pass
+            elif go_native:
+                native = _native.try_native_agg(self, p, chain, child,
+                                                bottom_node)
+                if native is not None:
+                    return native
+            return self._agg_xla_path(p, chain, child, bottom_node)
+
+    def _aggregate_route(self, p: pn.AggregateExec):
+        """The stage-split-time routing decision for this aggregate's
+        stage, when one was recorded; a forced backend applies even
+        when no split ran (fusion off)."""
+        from . import router
+        sid = self._route_stage_of.get(id(p))
+        if sid is not None:
+            dec = self._backend_routes.get(sid)
+            if dec is not None:
+                return dec
+        force = router.forced_backend(self.config)
+        if force:
+            return router.Decision(-1, "aggregate",
+                                   force if force != "mesh" else "xla",
+                                   "forced")
+        return None
+
+    def _agg_xla_path(self, p, chain, child, bottom_node):
+        from .. import telemetry as tel
         if tel.current_collector() is not None and chain:
             ops = "+".join(type(c).__name__ for c in chain)
             try:
@@ -2485,7 +2566,12 @@ class LocalExecutor:
         # full outer always takes the expanding path (it appends unmatched
         # build rows, which the unique fast path cannot express)
         if not has_dup and p.residual is None and jt != "full":
-            ukey = self._op_key("join_unique", jt, len(build_names), schema_key)
+            # exact/seed are baked into ufn's closure (the rebuilt
+            # BuildTable), so they MUST ride the key: a repeat execution
+            # whose hash build came out non-exact (or on a later seed)
+            # would otherwise reuse a program compiled for the other mode
+            ukey = self._op_key("join_unique", jt, len(build_names),
+                                schema_key, bool(exact), seed)
 
             def ubuilder():
                 def ufn(bt_arrays, ranges_arrays, ldev, bpayload):

@@ -1,0 +1,293 @@
+"""Per-stage backend router: native C++ vs single-device XLA vs mesh.
+
+Reference role: the Presto-on-GPUs result (arXiv:2606.24647) that
+per-operator routing by cost beats a single execution substrate,
+grafted onto the stage vocabulary PR 6 built: every fused pipeline
+(``plan/stages.py FusedStage``) gets an explicit backend decision at
+stage-split time instead of the implicit try-native-then-XLA ladder.
+
+Backends:
+
+- ``native``  the fused C++ host kernel (``sail_tpu/native/``) — wins
+  when a stage's wall time is compile/dispatch rather than compute
+  (per-process XLA trace+compile, per-op dispatch overhead at small
+  batch sizes);
+- ``xla``     the single-device jitted program (the default substrate);
+- ``mesh``    the 8-device SPMD program (``parallel/mesh_exec.py``) —
+  a PLAN-level decision (stage ``-1``): the whole job graph compiles
+  into one shard_map program, worth its dispatch cost only above a
+  row-volume floor (``execution.backend.mesh_min_rows``).
+
+Decisions are pure functions of (stage fingerprint, configuration,
+the bounded observation table this module keeps) — deterministic per
+fingerprint — and every decision is recorded in the flight recorder
+(``backend_route`` events) and on the query profile, rendered by
+EXPLAIN / EXPLAIN ANALYZE / FORMAT JSON. ``execution.backend.force``
+(session mirror ``spark.sail.execution.backend.force``) overrides
+everything: ``native`` | ``xla`` | ``mesh`` | "" (route by cost).
+
+The observation table is fed by the executor (PR 10's critical-path
+categories at stage granularity): per stage fingerprint it holds the
+compile and execute wall time of prior runs, so a stage whose observed
+time is compile-dominated routes to the native path with the
+``compile-bound`` reason instead of the static ``cost-model`` guess.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, NamedTuple, Optional
+
+BACKENDS = ("native", "xla", "mesh")
+
+#: reason vocabulary (mirrored in the ``backend_route`` event comment):
+#: forced | cost-model | compile-bound | dispatch-bound | unsupported |
+#: default | unavailable
+
+_LOCK = threading.Lock()
+#: stage fingerprint digest -> [compile_s, exec_s, runs] (bounded)
+_OBS: Dict[str, List[float]] = {}
+_OBS_MAX = 512
+
+
+class Decision(NamedTuple):
+    stage: int          # FusedStage sid; -1 = the plan-level mesh gate
+    kind: str           # stage kind (aggregate/sort/...) or "plan"
+    backend: str        # native | xla | mesh
+    reason: str
+
+    def to_dict(self) -> dict:
+        return {"stage": self.stage, "kind": self.kind,
+                "backend": self.backend, "reason": self.reason}
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+def forced_backend(session_conf=None) -> str:
+    """``spark.sail.execution.backend.force`` (session) over
+    ``execution.backend.force`` (app config); "" = route by cost."""
+    from ..config import get as config_get
+    value = None
+    if session_conf is not None:
+        get = getattr(session_conf, "get", None)
+        value = get("spark.sail.execution.backend.force") \
+            if get is not None else None
+    if value is None or value == "":
+        value = config_get("execution.backend.force", "")
+    value = str(value or "").strip().lower()
+    return value if value in BACKENDS else ""
+
+
+def mesh_min_rows() -> int:
+    from ..config import get as config_get
+    try:
+        return max(0, int(config_get("execution.backend.mesh_min_rows",
+                                     65536)))
+    except (TypeError, ValueError):
+        return 65536
+
+
+# ---------------------------------------------------------------------------
+# observations (critical-path categories at stage granularity)
+# ---------------------------------------------------------------------------
+
+def obs_key(fingerprint) -> str:
+    """Stable digest of a stage fingerprint (structural; never data)."""
+    return hashlib.sha256(repr(fingerprint).encode()).hexdigest()[:16]
+
+
+def stage_obs_key(stage) -> str:
+    """THE observation key for one fused stage: the compute operators'
+    fingerprints only (no source leaves) — exactly what the executor
+    records under (``[p] + chain``), so decisions and observations can
+    never key apart."""
+    from ..plan import stages as pst
+    return obs_key(tuple(pst.node_fingerprint(n) for n in stage.nodes
+                         if not pst.is_leaf(n)))
+
+
+def note_stage(key: str, compile_s: float = 0.0,
+               exec_s: float = 0.0) -> None:
+    """Record one observed execution of a stage: ``exec_s`` is the
+    stage's wall, ``compile_s`` the portion the profiler attributed to
+    JIT compilation inside it."""
+    with _LOCK:
+        obs = _OBS.get(key)
+        if obs is None:
+            obs = _OBS[key] = [0.0, 0.0, 0.0]
+            while len(_OBS) > _OBS_MAX:
+                _OBS.pop(next(iter(_OBS)))
+        obs[0] += max(0.0, float(compile_s))
+        obs[1] += max(0.0, float(exec_s))
+        obs[2] += 1.0
+
+
+@contextmanager
+def observing(key: str):
+    """Measure one stage execution into the observation table: wall
+    time plus the portion the active profile attributed to JIT
+    compilation inside the block (PR 10's compile category at stage
+    granularity)."""
+    import time as _time
+
+    from .. import profiler
+    prof = profiler.current_profile()
+    c0 = prof.compile_ms if prof is not None else 0.0
+    t0 = _time.perf_counter()
+    try:
+        yield
+    finally:
+        exec_s = _time.perf_counter() - t0
+        compile_s = ((prof.compile_ms - c0) / 1000.0) \
+            if prof is not None else 0.0
+        note_stage(key, compile_s=compile_s, exec_s=exec_s)
+
+
+def observed(key: str) -> Optional[dict]:
+    with _LOCK:
+        obs = _OBS.get(key)
+        if obs is None or obs[2] <= 0:
+            return None
+        return {"compile_s": obs[0], "exec_s": obs[1],
+                "runs": int(obs[2])}
+
+
+def clear_observations() -> None:
+    with _LOCK:
+        _OBS.clear()
+
+
+# ---------------------------------------------------------------------------
+# decisions
+# ---------------------------------------------------------------------------
+
+def _native_ok() -> bool:
+    try:
+        from .. import native as _native
+        return _native.native_active()
+    except Exception:  # noqa: BLE001 — no toolchain = no native path
+        return False
+
+
+def decide_stage(stage, force: str = "",
+                 native_ok: Optional[bool] = None) -> Decision:
+    """Route ONE fused stage (``plan/stages.py FusedStage``). Only
+    aggregate stages have a native substrate today; everything else is
+    the XLA program the stage compiler emits."""
+    from ..plan import stages as pst
+
+    kind = stage.kind
+    native_eligible = (
+        kind == "aggregate"
+        and pst.agg_absorbs_chain(stage.root)
+        and (native_ok if native_ok is not None else _native_ok()))
+    if force:
+        if force == "native" and not native_eligible:
+            return Decision(stage.sid, kind, "xla", "unavailable")
+        if force == "mesh":
+            # mesh is a plan-level substrate; per-stage it means "do
+            # not take the native detour"
+            return Decision(stage.sid, kind, "xla", "forced")
+        return Decision(stage.sid, kind, force, "forced")
+    if native_eligible:
+        obs = observed(stage_obs_key(stage))
+        if obs is not None and obs["compile_s"] > 0.5 * obs["exec_s"]:
+            # the stage's observed wall is dominated by compilation,
+            # exactly the cost XLA re-pays per process/shape and the
+            # native row loop does not
+            return Decision(stage.sid, kind, "native", "compile-bound")
+        return Decision(stage.sid, kind, "native", "cost-model")
+    if kind == "aggregate":
+        # not native-eligible: host/DISTINCT aggregates or no toolchain
+        return Decision(stage.sid, kind, "xla", "unsupported")
+    return Decision(stage.sid, kind, "xla", "default")
+
+
+def decide_split(split, force: str = "") -> List[Decision]:
+    """Route every stage of one ``StageSplit`` (deterministic per plan
+    structure + configuration + observation table)."""
+    native_ok = _native_ok()
+    return [decide_stage(s, force=force, native_ok=native_ok)
+            for s in split.stages]
+
+
+def decide_plan(plan, nparts: int, force: str = "",
+                mode: str = "auto") -> Decision:
+    """The plan-level mesh-vs-local gate (stage ``-1``): the SPMD
+    program's fixed dispatch/compile cost is only worth paying above a
+    row-volume floor. ``mode`` is the ``execution.mesh`` knob — "force"
+    bypasses the cost gate (tests pin the mesh path with it)."""
+    if force == "mesh":
+        return Decision(-1, "plan", "mesh", "forced")
+    if force in ("xla", "native"):
+        return Decision(-1, "plan", force, "forced")
+    if nparts < 2 and mode != "force":
+        return Decision(-1, "plan", "xla", "unavailable")
+    if mode == "force":
+        return Decision(-1, "plan", "mesh", "forced")
+    floor = mesh_min_rows()
+    if floor:
+        est = _plan_input_rows(plan)
+        if est is not None and est < floor:
+            # estimated INPUT volume too small for the SPMD program's
+            # fixed dispatch + compile cost: stay on the local
+            # substrate. Input, not root output — the cost being gated
+            # scales with the rows the program moves, and a selective
+            # filter or aggregate shrinks only the output.
+            return Decision(-1, "plan", "xla", "dispatch-bound")
+    return Decision(-1, "plan", "mesh", "cost-model")
+
+
+def _plan_input_rows(plan) -> Optional[float]:
+    """Largest estimated source cardinality feeding the plan (the
+    volume the SPMD program would actually move). None = no grounded
+    estimate anywhere — attempt the mesh, matching the pre-router
+    behavior for unknown sizes."""
+    try:
+        from ..plan import join_reorder as jr
+        from ..plan import nodes as pn
+        best: Optional[float] = None
+        for node in pn.walk_plan(plan):
+            if isinstance(node, pn.ScanExec):
+                rows = jr._scan_rows(node)
+                # the model's default for size-less scans is not
+                # evidence of smallness; only a grounded estimate may
+                # keep a plan off the mesh
+                if rows is not None and rows != jr._DEFAULT_ROWS:
+                    best = rows if best is None else max(best, rows)
+        return best
+    except Exception:  # noqa: BLE001 — no estimate: attempt the mesh
+        return None
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+def record_decisions(decisions) -> None:
+    """Flight recorder + metrics + query profile, for replayability:
+    the routing a query ran under must be reconstructible from the
+    event log alone."""
+    from .. import profiler
+    decisions = list(decisions)
+    if not decisions:
+        return
+    try:
+        from .. import events as _events
+        for d in decisions:
+            _events.emit(_events.EventType.BACKEND_ROUTE, stage=d.stage,
+                         kind=d.kind, backend=d.backend, reason=d.reason)
+    except Exception:  # noqa: BLE001 — telemetry must never break queries
+        pass
+    try:
+        from ..metrics import record as _record_metric
+        for d in decisions:
+            _record_metric("execution.backend.route_count", 1,
+                           backend=d.backend, reason=d.reason)
+    except Exception:  # noqa: BLE001
+        pass
+    profiler.note_backend_routes([d.to_dict() for d in decisions])

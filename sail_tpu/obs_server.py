@@ -19,8 +19,10 @@ daemon thread (no new dependencies), gated by
   readmission, and no wedged admission queue; 503 otherwise (body says
   why). A process with no cluster is ready by definition.
 - ``GET /debug/queries | /debug/workers | /debug/admission |
-  /debug/events?n=N``  JSON introspection of the flight recorder,
-  worker pool, admission state, and the newest N ring events.
+  /debug/compile_cache | /debug/events?n=N``  JSON introspection of
+  the flight recorder, worker pool, admission state, the persistent
+  compiled-program cache (entry count, bytes, hit ratio, top entries
+  by compile time saved), and the newest N ring events.
 
 The surface is auth-free and bound to ``telemetry.http.host``
 (default loopback); it exposes statements and runtime state but never
@@ -223,6 +225,27 @@ def _debug_events(n: int) -> dict:
     return {"count": len(records), "events": records[-max(1, n):]}
 
 
+def _debug_compile_cache() -> dict:
+    """Persistent compiled-program cache snapshot: store shape, the
+    registry's hit/miss/evict/load-error counters, and the top entries
+    by compile time saved. Serializes cache state only — never
+    configuration or environment values."""
+    from .exec import pcache
+    out = pcache.stats()
+    rows = {r["name"]: r for r in _metrics.REGISTRY.snapshot()
+            if str(r.get("name", "")).startswith(
+                "execution.compile.persistent_")}
+    counters = {}
+    for short in ("hit", "miss", "evict", "load_error"):
+        name = f"execution.compile.persistent_{short}_count"
+        counters[short] = int(rows.get(name, {}).get("value", 0))
+    out["counters"] = counters
+    consults = counters["hit"] + counters["miss"]
+    out["hit_ratio"] = round(counters["hit"] / consults, 4) \
+        if consults else None
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the HTTP server
 # ---------------------------------------------------------------------------
@@ -265,6 +288,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(_debug_workers())
             elif path == "/debug/admission":
                 self._json(_debug_admission())
+            elif path == "/debug/compile_cache":
+                self._json(_debug_compile_cache())
             elif path == "/debug/events":
                 q = parse_qs(url.query)
                 try:
@@ -276,7 +301,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"error": "not found", "paths": [
                     "/metrics", "/healthz", "/readyz",
                     "/debug/queries", "/debug/workers",
-                    "/debug/admission", "/debug/events?n="]}, 404)
+                    "/debug/admission", "/debug/compile_cache",
+                    "/debug/events?n="]}, 404)
         except BrokenPipeError:  # client went away mid-write
             pass
         except Exception as e:  # noqa: BLE001 — ops surface never dies

@@ -65,8 +65,23 @@ class QueryProfile:
     compile_cache_hits: int = 0
     compile_cache_misses: int = 0
     compile_ms: float = 0.0
-    # per-key compile events: [{"key": str, "ms": float}]
+    # persistent (cross-process AOT) compiled-program cache: consults
+    # that loaded a stored executable vs fell through to a JIT compile,
+    # and the deserialization wall time the hits paid
+    persistent_hits: int = 0
+    persistent_misses: int = 0
+    persistent_load_ms: float = 0.0
+    # programs that actually traced + XLA-compiled this query (every
+    # note_compile_time call) — NOT derivable from cache_misses minus
+    # persistent_hits: misses count per key, persistent hits per
+    # argument signature
+    compiled_programs: int = 0
+    # per-key compile events: [{"key": str, "ms": float, "source":
+    # "trace" | "persistent"}] — one per stage program bound
     compile_events: List[dict] = field(default_factory=list)
+    # per-stage backend routing decisions (exec/router.py):
+    # [{"stage": int, "kind": str, "backend": str, "reason": str}]
+    backend_routes: List[dict] = field(default_factory=list)
     transfer_bytes: int = 0
     spill_bytes: int = 0
     # runtime join filters: filters built / pushed into scans, probe+scan
@@ -175,14 +190,41 @@ class QueryProfile:
             else:
                 self.compile_cache_misses += 1
 
-    def note_compile_time(self, seconds: float, key: str = "") -> None:
+    def note_compile_time(self, seconds: float, key: str = "",
+                          source: str = "trace") -> None:
         ms = seconds * 1000.0
         with self._lock:
             self.compile_ms += ms
+            self.compiled_programs += 1
             self.phases["compile"] = self.phases.get("compile", 0.0) + ms
             if len(self.compile_events) < 256:
                 self.compile_events.append(
-                    {"key": key[:120], "ms": round(ms, 3)})
+                    {"key": key[:120], "ms": round(ms, 3),
+                     "source": source})
+
+    def note_persistent(self, hit: bool, seconds: float = 0.0) -> None:
+        with self._lock:
+            if hit:
+                self.persistent_hits += 1
+                self.persistent_load_ms += seconds * 1000.0
+            else:
+                self.persistent_misses += 1
+
+    def note_compile_loaded(self, seconds: float, key: str = "") -> None:
+        """A persistent-cache hit bound a stored executable: record the
+        per-stage event (source=persistent) WITHOUT charging the compile
+        phase — nothing compiled."""
+        with self._lock:
+            if len(self.compile_events) < 256:
+                self.compile_events.append(
+                    {"key": key[:120], "ms": round(seconds * 1000.0, 3),
+                     "source": "persistent"})
+
+    def note_backend_routes(self, routes) -> None:
+        with self._lock:
+            room = 64 - len(self.backend_routes)
+            if room > 0 and routes:
+                self.backend_routes.extend(list(routes)[:room])
 
     def note_transfer(self, nbytes: int) -> None:
         with self._lock:
@@ -333,9 +375,14 @@ class QueryProfile:
             "compile": {
                 "cache_hits": self.compile_cache_hits,
                 "cache_misses": self.compile_cache_misses,
+                "persistent_hits": self.persistent_hits,
+                "persistent_misses": self.persistent_misses,
+                "persistent_load_ms": round(self.persistent_load_ms, 3),
+                "compiled_programs": self.compiled_programs,
                 "time_ms": round(self.compile_ms, 3),
                 "events": list(self.compile_events),
             },
+            "backends": list(self.backend_routes),
             "transfer_bytes": self.transfer_bytes,
             "spill_bytes": self.spill_bytes,
             "runtime_filter": {
@@ -394,6 +441,24 @@ class QueryProfile:
                 extra = (f" (cache hits={self.compile_cache_hits} "
                          f"misses={self.compile_cache_misses})")
             lines.append(f"phase {name}: {ms:.1f}ms{extra}")
+        if (self.compile_cache_hits or self.compile_cache_misses
+                or self.persistent_hits):
+            # the compiled-program cache ladder per stage program:
+            # in-memory hit (nothing bound) → persistent hit (stored
+            # executable deserialized) → miss (trace + XLA compile;
+            # counted directly — key-level cache misses and
+            # signature-level persistent hits don't subtract)
+            line = (f"compile: memory_hits={self.compile_cache_hits} "
+                    f"persistent_hits={self.persistent_hits} "
+                    f"misses={self.compiled_programs}")
+            if self.persistent_hits:
+                line += f" load={self.persistent_load_ms:.1f}ms"
+            lines.append(line)
+        if self.backend_routes:
+            routed = " ".join(
+                f"s{r.get('stage')}={r.get('backend')}"
+                f"({r.get('reason')})" for r in self.backend_routes)
+            lines.append(f"backend: {routed}")
         if self.transfer_bytes:
             lines.append(f"device transfer: {self.transfer_bytes} bytes")
         if self.spill_bytes:
@@ -729,12 +794,46 @@ def note_compile_time(seconds: float, key: str = "") -> None:
     try:
         from . import events as _events
         _events.emit(_events.EventType.COMPILE, key=key[:120],
-                     ms=round(float(seconds) * 1000.0, 3))
+                     ms=round(float(seconds) * 1000.0, 3),
+                     source="trace")
     except Exception:  # noqa: BLE001
         pass
     profile = current_profile()
     if profile is not None:
-        profile.note_compile_time(seconds, key)
+        profile.note_compile_time(seconds, key, source="trace")
+
+
+def note_persistent_cache(hit: bool, seconds: float = 0.0) -> None:
+    """One persistent compiled-program cache consult (exec/pcache.py):
+    a hit loaded a stored AOT executable, a miss fell through to JIT."""
+    profile = current_profile()
+    if profile is not None:
+        profile.note_persistent(hit, seconds)
+
+
+def note_compile_event(key: str, seconds: float,
+                       source: str = "persistent") -> None:
+    """A stage program was bound WITHOUT compiling (persistent-cache
+    load): the per-stage compile event stream and the flight recorder
+    see it, but no compile time is charged."""
+    try:
+        from . import events as _events
+        _events.emit(_events.EventType.COMPILE, key=key[:120],
+                     ms=round(float(seconds) * 1000.0, 3),
+                     source=source)
+    except Exception:  # noqa: BLE001
+        pass
+    profile = current_profile()
+    if profile is not None:
+        profile.note_compile_loaded(seconds, key)
+
+
+def note_backend_routes(routes) -> None:
+    """Per-stage backend routing decisions (exec/router.py) taken for
+    the current query's plan."""
+    profile = current_profile()
+    if profile is not None:
+        profile.note_backend_routes(routes)
 
 
 def note_transfer_bytes(nbytes: int) -> None:

@@ -72,6 +72,11 @@ class SparkSession:
         # at most one server per process)
         from . import obs_server
         obs_server.ensure_started()
+        # resolve the persistent compiled-program cache config NOW so
+        # jax's compilation-cache dir is set before the first eager
+        # dispatch compiles anything (exec/pcache.py)
+        from .exec import pcache
+        pcache.enabled()
 
     def newSession(self) -> "SparkSession":
         """A sibling session: same catalog (tables, temp views, UDFs),
@@ -178,6 +183,16 @@ class SparkSession:
             return None
         import jax
         if len(jax.devices()) < 2 and mode != "force":
+            return None
+        # plan-level backend routing (exec/router.py): the SPMD mesh
+        # program is only worth its fixed dispatch/compile cost above a
+        # row-volume floor; `execution.backend.force` pins either way
+        from .exec import router
+        decision = router.decide_plan(
+            node, nparts=len(jax.devices()),
+            force=router.forced_backend(self.conf), mode=mode)
+        router.record_decisions([decision])
+        if decision.backend != "mesh":
             return None
         try:
             from .parallel.mesh_exec import MeshExecutor
@@ -416,11 +431,17 @@ class SparkSession:
             from .plan.stages import fusion_enabled
             fusion_on = fusion_enabled(self.conf.get(
                 "spark.sail.execution.fusion.enabled"))
+            backends = []
             if fusion_on:
+                from .exec import router
                 from .plan.stages import split_stages
                 split = split_stages(node)
                 stage_of = split.stage_of
                 n_stages = len(split.stages)
+                # the routing the executor would run under (same
+                # deterministic decision function, no execution)
+                backends = [d.to_dict() for d in router.decide_split(
+                    split, force=router.forced_backend(self.conf))]
             if cmd.mode == "analyze":
                 import time as _t
                 from . import profiler
@@ -446,6 +467,8 @@ class SparkSession:
                     payload["plan"] = explain(node, stage_of=stage_of)
                     if stage_of is not None:
                         payload["fused_stages"] = n_stages
+                    if backends:
+                        payload["backends"] = backends
                     text = _json.dumps(payload, indent=2, default=str)
                 else:
                     header = prof.render() if prof is not None else \
@@ -458,11 +481,17 @@ class SparkSession:
                 payload = {"plan": explain(node, stage_of=stage_of)}
                 if stage_of is not None:
                     payload["fused_stages"] = n_stages
+                if backends:
+                    payload["backends"] = backends
                 return pa.table({"plan": pa.array(
                     [_json.dumps(payload, indent=2)])})
             text = explain(node, stage_of=stage_of)
             if stage_of is not None:
                 text += f"\nfused: {n_stages} stages"
+            if backends:
+                text += "\nbackend: " + " ".join(
+                    f"s{b['stage']}={b['backend']}({b['reason']})"
+                    for b in backends)
             return pa.table({"plan": pa.array([text])})
         if isinstance(cmd, sp.CacheTable):
             if cmd.query is not None:
