@@ -536,6 +536,143 @@ def _run_streaming_bench(spark) -> dict:
             shutil.rmtree(root, ignore_errors=True)
 
 
+def _run_continuous_bench(spark) -> dict:
+    """SAIL_BENCH_STREAMING=1: the continuous record-at-a-time CDC
+    artifact (ISSUE 15 acceptance). A change stream joins a dimension
+    table and lands in a parquet sink with a durable checkpoint, run
+    twice on a 2-worker local cluster:
+
+    - continuous mode on (long-lived resident tasks, markers aligned
+      mid-flight, credit backpressure): headline rows/s + end-to-end
+      per-interval p50/p99 (marker inject → commit);
+    - continuous off (the epoch path: one job dispatch per trigger) —
+      the SAIL_BENCH_DISABLE_CONTINUOUS=1 knob forces this leg only.
+
+    Both legs' total sink output is equivalence-checked row-for-row.
+    """
+    import glob
+    import shutil
+    import statistics
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.session import DataFrame
+    from sail_tpu.streaming import ReplayableMemorySource, _StreamRead
+
+    intervals = int(os.environ.get("SAIL_BENCH_CONTINUOUS_INTERVALS",
+                                   "20"))
+    rows = int(os.environ.get("SAIL_BENCH_CONTINUOUS_ROWS", "10000"))
+    disabled = os.environ.get("SAIL_BENCH_DISABLE_CONTINUOUS",
+                              "0").strip().lower() in ("1", "true",
+                                                       "yes")
+    import pandas as pd
+
+    rng = np.random.default_rng(17)
+    schema = pa.schema([("k", pa.int64()), ("v", pa.int64())])
+    batches = [pa.table({
+        "k": pa.array(rng.integers(0, 256, rows), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 10_000, rows), type=pa.int64()),
+    }, schema=schema) for _ in range(intervals)]
+    dim = pd.DataFrame({"k": np.arange(256, dtype=np.int64),
+                        "w": np.arange(256, dtype=np.int64) * 7})
+    spark.createDataFrame(dim).createOrReplaceTempView("cont_dim")
+    shapes = {
+        "filter": lambda df: df.filter("v % 3 != 0"),
+        "filter_join": lambda df: df.filter("v % 3 != 0").join(
+            spark.sql("SELECT * FROM cont_dim"), on="k", how="inner"),
+    }
+    tmp_roots = []
+
+    def run(tag: str, shape, continuous: bool) -> dict:
+        out_dir = tempfile.mkdtemp(prefix=f"sail_cbench_{tag}_out_")
+        ckpt = tempfile.mkdtemp(prefix=f"sail_cbench_{tag}_cp_")
+        tmp_roots.extend((out_dir, ckpt))
+        prev = os.environ.get("SAIL_STREAMING__CONTINUOUS__ENABLED")
+        os.environ["SAIL_STREAMING__CONTINUOUS__ENABLED"] = \
+            "1" if continuous else "0"
+        cluster = LocalCluster(num_workers=2)
+        interval_ms = []
+        try:
+            src = ReplayableMemorySource(schema)
+            shaped = shape(DataFrame(_StreamRead("cbench", src),
+                                     spark))
+            q = (shaped.writeStream.format("parquet")
+                 .option("checkpointLocation", ckpt).cluster(cluster)
+                 .start(out_dir))
+            try:
+                # warmup: the first intervals pay pipeline start +
+                # stage compiles on both paths; steady state is what
+                # the latency contract is about
+                for b in batches[:2]:
+                    src.add(b)
+                    q.processAllAvailable()
+                t0 = time.perf_counter()
+                for b in batches[2:]:
+                    src.add(b)
+                    ti = time.perf_counter()
+                    q.processAllAvailable()
+                    interval_ms.append(
+                        (time.perf_counter() - ti) * 1000.0)
+                wall = time.perf_counter() - t0
+                engaged = q._cont_runner is not None
+            finally:
+                q.stop()
+        finally:
+            cluster.stop()
+            if prev is None:
+                os.environ.pop("SAIL_STREAMING__CONTINUOUS__ENABLED",
+                               None)
+            else:
+                os.environ["SAIL_STREAMING__CONTINUOUS__ENABLED"] = prev
+        parts = sorted(glob.glob(os.path.join(out_dir,
+                                              "part-*.parquet")))
+        total = pa.concat_tables([pq.read_table(p) for p in parts]) \
+            if parts else None
+        qs = statistics.quantiles(interval_ms, n=100) \
+            if len(interval_ms) >= 2 else [0.0] * 99
+        measured = max(1, intervals - 2)
+        return {
+            "wall_s": round(wall, 4),
+            "rows_per_s": round(measured * rows / wall, 1),
+            "interval_p50_ms": round(qs[49], 3),
+            "interval_p99_ms": round(qs[98], 3),
+            "continuous_engaged": engaged,
+            "parts": len(parts),
+            "_total": total,
+        }
+
+    try:
+        out = {"intervals": intervals, "rows_per_interval": rows,
+               "disabled_knob": disabled}
+        for name, shape in shapes.items():
+            leg = {}
+            epoch = run(f"{name}_epoch", shape, continuous=False)
+            leg["epoch"] = {k: v for k, v in epoch.items()
+                            if not k.startswith("_")}
+            if not disabled:
+                cont = run(f"{name}_cont", shape, continuous=True)
+                leg["continuous"] = {k: v for k, v in cont.items()
+                                     if not k.startswith("_")}
+                leg["speedup"] = round(
+                    epoch["wall_s"] / cont["wall_s"], 3) \
+                    if cont["wall_s"] else None
+                if cont["_total"] is not None and \
+                        epoch["_total"] is not None:
+                    sort_keys = [(c, "ascending")
+                                 for c in cont["_total"].column_names]
+                    leg["identical_vs_epoch"] = cont["_total"].sort_by(
+                        sort_keys).equals(
+                        epoch["_total"].sort_by(sort_keys))
+            out[name] = leg
+        return out
+    finally:
+        for root in tmp_roots:
+            shutil.rmtree(root, ignore_errors=True)
+
+
 def _run_shuffle_bench(spark) -> dict:
     """Cluster-path shuffle artifact: the join/agg-heavy queries where
     data movement dominates (q5/q18/q21) run through the local cluster,
@@ -1286,6 +1423,13 @@ def main():
             result["streaming"] = _run_streaming_bench(spark)
         except Exception as e:  # noqa: BLE001
             result["streaming_error"] = f"{type(e).__name__}: {e}"
+        # continuous record-at-a-time CDC artifact: resident-task
+        # pipeline vs the epoch path over the same change stream
+        # (SAIL_BENCH_DISABLE_CONTINUOUS=1 records the epoch leg only)
+        try:
+            result["continuous"] = _run_continuous_bench(spark)
+        except Exception as e:  # noqa: BLE001
+            result["continuous_error"] = f"{type(e).__name__}: {e}"
     # chaos mode: TPC-H under a fixed fault seed, recovery overhead in
     # the artifact (opt-in: the run costs two extra cluster executions)
     if os.environ.get("SAIL_BENCH_CHAOS", "0").strip().lower() in (

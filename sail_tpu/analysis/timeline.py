@@ -36,9 +36,12 @@ DECISION_TYPES = ("adaptive_applied", "adaptive_rollback",
                   "epoch_stage", "epoch_commit", "epoch_replay",
                   "admission_enqueue", "admission_admit",
                   "admission_defer", "admission_shed", "quota_debit",
-                  "deadline_cancel", "backend_route")
+                  "deadline_cancel", "backend_route",
+                  "task_resident", "marker_inject", "marker_align",
+                  "backpressure")
 
-CATEGORIES = ("compute", "fetch-wait", "queue", "compile", "replan")
+CATEGORIES = ("compute", "fetch-wait", "queue", "compile", "replan",
+              "credit-stall")
 
 
 def _for_query(events: List[dict],
@@ -206,6 +209,87 @@ def _compiles_in(evs: List[dict], t0: float, t1: float,
     return ms
 
 
+def _credit_stalls_in(evs: List[dict], t0: float, t1: float,
+                      task: Optional[str]) -> float:
+    """Credit-stall ms attributable to one task's execution window:
+    worker-shipped ``backpressure`` events carry the driver-stamped
+    ``task`` envelope and match by identity; unstamped (driver-side)
+    events fall back to the time window."""
+    ms = 0.0
+    for e in evs:
+        if e.get("type") != "backpressure" or e.get("ts") is None:
+            continue
+        stamped = e.get("task")
+        if stamped is not None:
+            if task is None or stamped != task:
+                continue
+        elif not (t0 <= e["ts"] <= t1):
+            continue
+        ms += float(e.get("stall_ms", 0.0) or 0.0)
+    return ms
+
+
+def continuous_progress(events: List[dict],
+                        query_id: Optional[str] = None) -> List[dict]:
+    """Marker progress of a continuous pipeline, replayable from the
+    log alone: per marker, the inject time, every mid-flight alignment
+    (stage/partition, wait, buffered bytes), and the credit stalls that
+    landed between this inject and the next."""
+    evs = _for_query(events, query_id)
+    markers: Dict[int, dict] = {}
+    order: List[int] = []
+    for e in evs:
+        t = e.get("type")
+        if t == "marker_inject":
+            m = int(e.get("marker", 0) or 0)
+            if m not in markers:
+                order.append(m)
+            markers.setdefault(m, {"marker": m,
+                                   "inject_ts": e.get("ts"),
+                                   "aligns": [],
+                                   "stall_ms": 0.0})
+        elif t == "marker_align":
+            m = int(e.get("marker", 0) or 0)
+            rec = markers.get(m)
+            if rec is None:
+                order.append(m)
+                rec = markers.setdefault(
+                    m, {"marker": m, "inject_ts": None, "aligns": [],
+                        "stall_ms": 0.0})
+            rec["aligns"].append({
+                "stage": e.get("stage"), "partition": e.get("partition"),
+                "wait_ms": float(e.get("wait_ms", 0.0) or 0.0),
+                "buffered_bytes": int(e.get("buffered_bytes", 0) or 0),
+                "ts": e.get("ts")})
+    stalls = [e for e in evs if e.get("type") == "backpressure"]
+    bounds = sorted((m, markers[m].get("inject_ts")) for m in markers
+                    if markers[m].get("inject_ts") is not None)
+    for e in stalls:
+        ts = e.get("ts")
+        target = None
+        for m, t0 in bounds:
+            if t0 is not None and ts is not None and ts >= t0:
+                target = m
+        if target is None and bounds:
+            target = bounds[0][0]
+        if target is not None:
+            markers[target]["stall_ms"] += float(
+                e.get("stall_ms", 0.0) or 0.0)
+    out = []
+    for m in order:
+        rec = markers[m]
+        aligned_ts = [a["ts"] for a in rec["aligns"]
+                      if a["ts"] is not None]
+        if rec["inject_ts"] is not None and aligned_ts:
+            rec["align_ms"] = round(
+                (max(aligned_ts) - rec["inject_ts"]) * 1000.0, 3)
+        else:
+            rec["align_ms"] = None
+        rec["stall_ms"] = round(rec["stall_ms"], 3)
+        out.append(rec)
+    return out
+
+
 def critical_path(events: List[dict],
                   query_id: Optional[str] = None) -> Optional[dict]:
     """Walk the gating chain of a query's distributed job. Returns
@@ -253,9 +337,14 @@ def critical_path(events: List[dict],
             compile_ms = min(window_ms - fetch_wait,
                              _compiles_in(evs, start, finish,
                                           task_label))
+            stall_ms = min(window_ms - fetch_wait - compile_ms,
+                           _credit_stalls_in(evs, start, finish,
+                                             task_label))
             charge(at, "fetch-wait", fetch_wait)
             charge(at, "compile", compile_ms)
-            charge(at, "compute", window_ms - fetch_wait - compile_ms)
+            charge(at, "credit-stall", stall_ms)
+            charge(at, "compute",
+                   window_ms - fetch_wait - compile_ms - stall_ms)
         if dispatch is not None and start is not None:
             charge(at, "queue", max(0.0, (start - dispatch) * 1000.0))
         # follow the fetch edge to the producer that finished last (the
@@ -325,6 +414,7 @@ def reconstruct(events: List[dict], query_id: str) -> dict:
         "tasks": task_timeline(evs),
         "decisions": decisions(evs),
         "adaptive_decisions": adaptive_decisions(evs),
+        "continuous": continuous_progress(evs),
         "critical_path": critical_path(evs),
     }
 
@@ -367,6 +457,17 @@ def render_timeline(events: List[dict], query_id: str,
                                   "trace_id")}
             lines.append(f"    {d['type']}: "
                          f"{json.dumps(attrs, sort_keys=True)}")
+    if rec["continuous"]:
+        lines.append(f"  markers ({len(rec['continuous'])}):")
+        for m in rec["continuous"]:
+            align = f"{m['align_ms']:.1f}ms" \
+                if m.get("align_ms") is not None else "?"
+            buffered = sum(a["buffered_bytes"] for a in m["aligns"])
+            lines.append(
+                f"    m{m['marker']}: inject→align {align}, "
+                f"{len(m['aligns'])} align point(s), "
+                f"{buffered}B buffered, "
+                f"credit stalls {m['stall_ms']:.1f}ms")
     cp_line = render_critical_path(rec["critical_path"])
     if cp_line:
         lines.append("  " + cp_line)

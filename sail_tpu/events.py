@@ -128,6 +128,19 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "epoch_stage": ("epoch", "rows"),
     "epoch_commit": ("epoch", "commit_ms"),
     "epoch_replay": ("epoch",),
+    # continuous record-at-a-time streaming (exec/continuous.py):
+    # a resident (long-lived) stage task dispatched; a marker injected
+    # at the sources; a marker aligning mid-flight at one task's inputs
+    # (wait_ms = first-input-blocked → all-aligned, buffered_bytes =
+    # post-marker entries held for the slow sibling); a sender stalled
+    # on exhausted channel credit (the backpressure signal)
+    "task_resident": ("job_id", "stage", "partition", "attempt",
+                      "worker"),
+    "marker_inject": ("job_id", "marker"),
+    "marker_align": ("job_id", "stage", "partition", "marker",
+                     "wait_ms", "buffered_bytes"),
+    "backpressure": ("job_id", "stage", "partition", "channel",
+                     "stall_ms"),
 }
 
 
@@ -163,6 +176,10 @@ class EventType:
     EPOCH_STAGE = "epoch_stage"
     EPOCH_COMMIT = "epoch_commit"
     EPOCH_REPLAY = "epoch_replay"
+    TASK_RESIDENT = "task_resident"
+    MARKER_INJECT = "marker_inject"
+    MARKER_ALIGN = "marker_align"
+    BACKPRESSURE = "backpressure"
 
 
 def _validate(etype: str, attrs: Dict[str, object]) -> None:

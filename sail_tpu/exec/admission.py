@@ -209,6 +209,16 @@ class JobAdmissionQueue:
         # (job_id, stage, partition) -> (tenant, bytes) for live debits
         self._debits: Dict[Tuple[str, int, int], Tuple[str, int]] = {}
         self._total_running = 0
+        # long-lived (continuous) jobs: job_id -> [tenant, cost,
+        # last_charge_ts]. A resident pipeline's DRR cost was charged
+        # once at admit but its tasks occupy workers indefinitely —
+        # recharge() re-debits the tenant's deficit every
+        # resident_recharge_secs so it keeps paying for the occupancy
+        self._resident: Dict[str, List] = {}
+        from ..config import get as config_get
+        self.resident_recharge_s = max(0.1, _num(
+            config_get("admission.resident_recharge_secs", 10.0), 10.0,
+            float))
 
     # -- helpers ---------------------------------------------------------
     @staticmethod
@@ -395,6 +405,79 @@ class JobAdmissionQueue:
         if tenant in self._mem_used:
             _record_metric("cluster.quota.debited_bytes",
                            self._mem_used.get(tenant, 0), tenant=tenant)
+
+    # -- long-lived (continuous) jobs ------------------------------------
+    def admit_resident(self, job_id: str, tenant: str) -> bool:
+        """Admission gate for a continuous pipeline: it occupies a
+        concurrency slot like any running job, checked against the
+        tenant's ``max_jobs`` and the global cap — a tenant at its cap
+        cannot grab every worker with resident tasks that the batch
+        caps would have refused. (Memory quota is not debited: resident
+        tasks have no producer-size projections to debit from.)"""
+        if not self.enabled:
+            return True
+        if not self._can_run(tenant):
+            return False
+        self._running.setdefault(tenant, set()).add(job_id)
+        self._total_running += 1
+        return True
+
+    def note_resident(self, job_id: str, tenant: str,
+                      cost: int) -> None:
+        """Register a continuous job's resident-task occupancy for
+        periodic DRR re-charging. The cost is its resident task count
+        (the worker slots it holds), re-debited from the tenant's
+        deficit every ``admission.resident_recharge_secs`` — without
+        this, a continuous job charged stage-launch opportunities once
+        at admit and then occupied workers forever, starving batch
+        tenants of their fair share."""
+        if not self.enabled:
+            return
+        self._resident[job_id] = [tenant, max(1, int(cost)),
+                                  time.time()]
+
+    def release_resident(self, job_id: str) -> None:
+        self._resident.pop(job_id, None)
+        # the concurrency slot frees independently of the recharge
+        # registration (a dispatch failure can release between
+        # admit_resident and note_resident)
+        for running in self._running.values():
+            if job_id in running:
+                running.discard(job_id)
+                self._total_running = max(0, self._total_running - 1)
+                break
+
+    def recharge(self, now: Optional[float] = None) -> int:
+        """Debit every resident job's tenant its occupancy cost for
+        each elapsed recharge interval — but ONLY while some OTHER
+        tenant is backlogged: occupancy during idle capacity is free
+        (nobody was displaced), so a continuous tenant cannot
+        accumulate unbounded catch-up debt overnight and then starve
+        for hours once it submits batch work. Returns intervals
+        charged."""
+        if not self.enabled or not self._resident:
+            return 0
+        now = time.time() if now is None else now
+        charged = 0
+        for job_id in sorted(self._resident):
+            entry = self._resident[job_id]
+            tenant, cost, last = entry
+            n = int((now - last) / self.resident_recharge_s)
+            if n <= 0:
+                continue
+            # the elapsed intervals are consumed either way (idle time
+            # is never charged retroactively)
+            entry[2] = last + n * self.resident_recharge_s
+            contended = any(q for t, q in self._queues.items()
+                            if t != tenant)
+            if not contended:
+                continue
+            self._deficit[tenant] = self._deficit.get(tenant, 0.0) \
+                - cost * n
+            charged += n
+            _record_metric("cluster.admission.resident_recharge_count",
+                           n, tenant=tenant)
+        return charged
 
     # -- ops surface -----------------------------------------------------
     def wedged(self, now: Optional[float] = None) -> bool:

@@ -38,6 +38,7 @@ import grpc
 from .proto import control_plane_pb2 as pb
 
 from .actor import Actor
+from . import continuous as cont
 from . import job_graph as jg
 from . import shuffle as sh
 from .. import events
@@ -312,14 +313,21 @@ def _task_metrics_enabled() -> bool:
     return truthy("cluster.task_metrics")
 
 
-def _fetch_stream_handler(store: _StreamStore, scan_tables=None):
+def _fetch_stream_handler(store: Optional[_StreamStore],
+                          scan_tables=None):
     """Server-streaming fetch: the channel's (compressed) IPC bytes
     stream as bounded chunks — no gRPC message-size cap, no full-buffer
     single message on the wire, and a SPILLED channel streams straight
     from disk without rehydrating under the memory cap (reference:
-    stream_service/server.rs record-batch streams)."""
+    stream_service/server.rs record-batch streams). ``store`` may be
+    None (the DRIVER's service): scan slices still serve, but channel
+    fetches are NOT_FOUND — the driver participates in the continuous
+    data plane through PushRecords inboxes, not a stream store."""
 
     def resolve(request: pb.FetchStreamRequest, context):
+        if store is None and not request.scan_id:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          "driver serves scan slices only")
         if request.scan_id:
             tables = scan_tables() if scan_tables is not None else {}
             entry = tables.get((request.job_id, request.scan_id))
@@ -492,6 +500,9 @@ class WorkerActor(Actor):
         self._hb_stop = threading.Event()
         self._crashed = False
         self.streams = _StreamStore()
+        # continuous streaming: resident (long-lived) stage tasks and
+        # their sequenced, credit-bounded input channels
+        self.continuous = cont.ContinuousWorker(self)
 
     # -- rpc service -----------------------------------------------------
     def _service(self):
@@ -510,6 +521,7 @@ class WorkerActor(Actor):
 
         def clean_up_job(request: pb.CleanUpJobRequest, context):
             self.streams.clean_job(request.job_id)
+            self.continuous.clean_job(request.job_id)
             with self._running_lock:
                 evs = [ev for k, lst in self._running.items()
                        if k[0] == request.job_id for ev in lst]
@@ -517,10 +529,14 @@ class WorkerActor(Actor):
                 ev.set()
             return pb.CleanUpJobResponse()
 
+        def push_records(request: pb.PushRecordsRequest, context):
+            return self.continuous.offer(request)
+
         return grpc.method_handlers_generic_handler(_WORKER_SERVICE, {
             "RunTask": _unary(run_task, pb.RunTaskRequest),
             "StopTask": _unary(stop_task, pb.StopTaskRequest),
             "CleanUpJob": _unary(clean_up_job, pb.CleanUpJobRequest),
+            "PushRecords": _unary(push_records, pb.PushRecordsRequest),
             "FetchStream": grpc.unary_stream_rpc_method_handler(
                 _fetch_stream_handler(self.streams),
                 request_deserializer=pb.FetchStreamRequest.FromString,
@@ -543,6 +559,7 @@ class WorkerActor(Actor):
 
     def on_stop(self):
         self._hb_stop.set()
+        self.continuous.stop_all()
         if self._server is not None:
             self._server.stop(grace=0.5)
 
@@ -564,6 +581,7 @@ class WorkerActor(Actor):
         through heartbeat eviction, exactly like a real dead process."""
         self._crashed = True
         self._hb_stop.set()
+        self.continuous.stop_all()
         if self._server is not None:
             self._server.stop(grace=0)
 
@@ -621,7 +639,33 @@ class WorkerActor(Actor):
             ev = threading.Event()
             with self._running_lock:
                 self._running.setdefault(key, []).append(ev)
-            self._pool.submit(self._run_task, task, parent, ev)
+            if task.continuous_json:
+                # long-lived resident stage task: runs on its own
+                # thread (it never completes, so it must not occupy a
+                # slot of the run-to-completion pool)
+                try:
+                    spec = json.loads(task.continuous_json)
+                except ValueError:
+                    spec = {}
+                self.continuous.start_task(task, spec, ev)
+            else:
+                self._pool.submit(self._run_task, task, parent, ev)
+
+    def _unregister_running(self, key,
+                            ev: Optional[threading.Event] = None):
+        with self._running_lock:
+            evs = self._running.get(key)
+            if evs is None:
+                return
+            if ev is not None:
+                try:
+                    evs.remove(ev)
+                except ValueError:
+                    pass
+            else:
+                del evs[:]
+            if not evs:
+                self._running.pop(key, None)
 
     # -- task execution --------------------------------------------------
     def _fetch_inputs(self, task: pb.TaskDefinition,
@@ -853,18 +897,24 @@ class WorkerActor(Actor):
                 channel_bytes: Optional[List[int]] = None,
                 raw_bytes: int = 0,
                 fetch_stats: Optional[sh.FetchStats] = None,
-                recorder: Optional[events.TaskEventCollector] = None):
+                recorder: Optional[events.TaskEventCollector] = None,
+                report_seq: int = 0):
         """Report task status with backoff retries: a worker that cannot
         reach the driver for one transient blip must not lose a finished
         task's result until heartbeat eviction re-runs it from scratch."""
         if self._crashed:
             return
         events_json: List[str] = []
-        if recorder is not None and state in ("succeeded", "failed",
-                                              "canceled"):
-            # worker events piggyback on the TERMINAL report only: the
-            # driver dedupes terminal reports (at-least-once delivery),
-            # so the shipped buffer merges exactly once
+        if recorder is not None and (
+                state in ("succeeded", "failed", "canceled")
+                or report_seq):
+            # worker events piggyback on TERMINAL reports — plus a
+            # resident task's numbered periodic flushes (report_seq):
+            # the driver dedupes both (at-least-once delivery), so the
+            # shipped buffer merges exactly once. Without the flushes a
+            # long-lived task would only surface its marker_align/
+            # backpressure events at pipeline death (and its bounded
+            # collector would drop the rest).
             try:
                 events_json = [json.dumps(e, default=str)
                                for e in recorder.drain()]
@@ -880,7 +930,8 @@ class WorkerActor(Actor):
                 raw_bytes=int(raw_bytes),
                 fetch_wait_s=fetch_stats.wait_s if fetch_stats else 0.0,
                 decode_s=fetch_stats.decode_s if fetch_stats else 0.0,
-                events_json=events_json),
+                events_json=events_json,
+                report_seq=int(report_seq)),
                 pb.ReportTaskStatusResponse)
         except faults.WorkerCrash:
             self._die()
@@ -1094,7 +1145,16 @@ class DriverActor(Actor):
         self._server: Optional[grpc.Server] = None
         self.port = 0
         self._probe_stop = threading.Event()
-        self.streams = _StreamStore()  # (unused for now; driver-run roots)
+        # continuous streaming: registration records of the live
+        # long-lived pipelines (job_id → _DriverContinuousJob). The
+        # driver participates in the continuous data plane through the
+        # runners' PushRecords inboxes — the dead driver-side
+        # _StreamStore this replaced is gone. Stopped pipelines linger
+        # in the drain map briefly so resident tasks' terminal reports
+        # (which carry their buffered flight-recorder events —
+        # marker_align, backpressure) still merge into the log.
+        self.continuous: Dict[str, "cont._DriverContinuousJob"] = {}
+        self._continuous_drain: Dict[str, Tuple[object, float]] = {}
         # elastic pool (reference: driver/worker_pool/ scale between
         # initial and max counts with idle reaping)
         self.elastic: Optional[dict] = None
@@ -1162,6 +1222,11 @@ class DriverActor(Actor):
         for job in list(self.jobs.values()):
             for sid, table in job.graph.scan_tables.items():
                 out[(job.job_id, sid)] = table
+        # continuous pipelines' static tables (dimension/build sides):
+        # resident tasks fetch them once at startup
+        for cj in list(self.continuous.values()):
+            for sid, table in cj.graph.scan_tables.items():
+                out[(cj.job_id, sid)] = table
         return out
 
     def _service(self):
@@ -1183,13 +1248,23 @@ class DriverActor(Actor):
                                          request.reason or "client abort")))
             return pb.CancelJobResponse(canceled=True)
 
+        def push_records(request: pb.PushRecordsRequest, context):
+            # continuous root collection: top-stage resident tasks push
+            # the pipeline's output here (the driver IS a data-plane
+            # participant in continuous mode)
+            cj = self.continuous.get(request.job_id)
+            if cj is None:
+                return cont.offer_response("unready")
+            return cj.runner.root_offer(request)
+
         return grpc.method_handlers_generic_handler(_DRIVER_SERVICE, {
             "RegisterWorker": _unary(register, pb.RegisterWorkerRequest),
             "Heartbeat": _unary(heartbeat, pb.HeartbeatRequest),
             "ReportTaskStatus": _unary(report, pb.ReportTaskStatusRequest),
             "CancelJob": _unary(cancel_job, pb.CancelJobRequest),
+            "PushRecords": _unary(push_records, pb.PushRecordsRequest),
             "FetchStream": grpc.unary_stream_rpc_method_handler(
-                _fetch_stream_handler(self.streams, self._scan_tables_view),
+                _fetch_stream_handler(None, self._scan_tables_view),
                 request_deserializer=pb.FetchStreamRequest.FromString,
                 response_serializer=lambda m: m.SerializeToString()),
         })
@@ -1290,6 +1365,193 @@ class DriverActor(Actor):
             self._cancel_job(job_id, reason)
         elif kind == "cleanup":
             self._cleanup_job(payload)
+        elif kind == "continuous_start":
+            cj, reply = payload
+            self._continuous_start(cj, reply)
+        elif kind == "continuous_stop":
+            self._continuous_stop(payload)
+
+    # -- continuous streaming: resident task scheduling ------------------
+    def _continuous_start(self, cj: "cont._DriverContinuousJob",
+                          reply) -> None:
+        """Dispatch every stage of a continuous pipeline as LONG-LIVED
+        resident tasks in one shot (the run-to-completion scheduler
+        never re-enters): assign least-loaded workers, wire the push
+        topology into each task's ``continuous_json``, and register the
+        job so PushRecords / task reports / eviction route to it."""
+        g = cj.graph
+        work = [(s, p) for s in g.stages if not s.on_driver
+                for p in range(s.num_partitions)]
+        pool = sorted(self.workers.items(),
+                      key=lambda kv: (len(kv[1]["tasks"]), kv[0]))
+        if not pool:
+            cj.runner.fail("no live workers")
+            reply.set(None)
+            return
+        # a continuous pipeline occupies a concurrency slot like any
+        # running job: a tenant at its max_concurrent_jobs cap (or a
+        # full global cap) is shed with a typed retryable error — it
+        # must not grab every worker with resident tasks the batch
+        # admission path would have refused
+        if not self.admission.admit_resident(cj.job_id, cj.tenant):
+            cj.runner.fail(f"admission shed: tenant {cj.tenant!r} is "
+                           f"at its concurrent-job cap")
+            reply.set(None)
+            return
+        assign = {key: pool[i % len(pool)]
+                  for i, key in enumerate(((s.stage_id, p)
+                                           for s, p in work))}
+        addr_of = {key: w["addr"] for key, (_wid, w) in assign.items()}
+        consumers: Dict[int, List[Tuple[object, object]]] = {}
+        for s in g.stages:
+            for i in s.inputs:
+                consumers.setdefault(i.stage_id, []).append((s, i.mode))
+        rconf = cj.runner.conf
+        self.continuous[cj.job_id] = cj
+        for s, p in work:
+            sid = s.stage_id
+            outputs = []
+            for c, mode in consumers.get(sid, ()):
+                if c.on_driver:
+                    outputs.append({"stage": c.stage_id, "mode": "merge",
+                                    "addrs": [self.addr],
+                                    "driver": True})
+                    continue
+                outputs.append({
+                    "stage": c.stage_id, "mode": mode.value,
+                    "addrs": [addr_of[(c.stage_id, cp)]
+                              for cp in range(c.num_partitions)]})
+            inputs = [{"stage": cont.SOURCE_STAGE, "mode": "source",
+                       "parts": [0]}] if not s.inputs else []
+            for i in s.inputs:
+                up = g.stages[i.stage_id]
+                if i.mode == jg.InputMode.FORWARD:
+                    parts = [p % max(up.num_partitions, 1)]
+                elif i.mode == jg.InputMode.BROADCAST:
+                    parts = [0]
+                else:  # shuffle | merge: every producer partition
+                    parts = list(range(up.num_partitions))
+                inputs.append({"stage": i.stage_id,
+                               "mode": i.mode.value, "parts": parts})
+            spec = {"generation": cj.generation, "inputs": inputs,
+                    "outputs": outputs,
+                    "credit_bytes": rconf["credit_bytes"],
+                    "align_buffer_bytes": rconf["align_buffer_bytes"]}
+            task = pb.TaskDefinition(
+                job_id=cj.job_id, stage=sid, partition=p,
+                attempt=cj.generation, plan=jg.encode_fragment(s.plan),
+                num_partitions=s.num_partitions, driver_addr=self.addr,
+                epoch=0, tenant=cj.tenant,
+                runtime_filters_json=g.stage_filters.get(sid, ""),
+                continuous_json=json.dumps(spec))
+            if s.shuffle_keys is not None and s.num_channels > 1:
+                task.shuffle_write.CopyFrom(pb.ShuffleWriteSpec(
+                    key_columns=list(s.shuffle_keys),
+                    num_channels=s.num_channels))
+            wid, w = assign[(sid, p)]
+            rpc = w["channel"].unary_unary(
+                f"/{_WORKER_SERVICE}/RunTask",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.RunTaskResponse.FromString)
+            try:
+                _call_with_retry(
+                    lambda: rpc(pb.RunTaskRequest(task=task),
+                                timeout=10),
+                    site="rpc.call", key="RunTask", method="RunTask",
+                    attempts=2)
+            except (grpc.RpcError, faults.FaultInjectedError) as e:
+                cj.runner.fail(f"resident dispatch s{sid}p{p} to "
+                               f"{wid} failed: {e}")
+                self._continuous_stop(cj.job_id)
+                reply.set(None)
+                return
+            w["tasks"].add((cj.job_id, sid, p))
+            w["idle_since"] = None
+            cj.task_workers[(sid, p)] = wid
+            events.emit(EventType.TASK_RESIDENT, query_id=cj.query_id,
+                        job_id=cj.job_id, stage=sid, partition=p,
+                        attempt=cj.generation, worker=wid)
+        # admission accounting: a continuous job occupies its workers
+        # indefinitely — register it for periodic DRR re-charging so it
+        # cannot starve batch tenants (see JobAdmissionQueue.recharge)
+        self.admission.note_resident(cj.job_id, cj.tenant,
+                                     cost=max(1, len(work)))
+        reply.set(dict(addr_of))
+
+    def _continuous_stop(self, job_id: str) -> None:
+        cj = self.continuous.pop(job_id, None)
+        self.admission.release_resident(job_id)
+        if cj is None:
+            return
+        self._continuous_drain[job_id] = (cj, time.time())
+        for (sid, p), wid in list(cj.task_workers.items()):
+            self._stop_task_on(wid, job_id, sid, p, "cleanup")
+            w = self.workers.get(wid)
+            if w is not None:
+                self._release_task(w, (job_id, sid, p))
+                if not w["tasks"]:
+                    w["idle_since"] = time.time()
+        for w in self.workers.values():
+            rpc = w["channel"].unary_unary(
+                f"/{_WORKER_SERVICE}/CleanUpJob",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.CleanUpJobResponse.FromString)
+            try:
+                rpc(pb.CleanUpJobRequest(job_id=job_id), timeout=10)
+            except grpc.RpcError:
+                pass
+
+    def _on_continuous_status(self, cj: "cont._DriverContinuousJob",
+                              r: pb.ReportTaskStatusRequest) -> None:
+        """Task reports of a continuous pipeline: readiness tracking,
+        event-log merge (exactly-once via the same terminal-report
+        dedupe as batch jobs), and failure propagation — a failed
+        resident task fails the pipeline, which relaunches every stage
+        from the last sealed marker under a NEW generation (zombie
+        pushes are fenced by attempt/sequence checks)."""
+        task_label = f"{r.job_id}/s{r.stage}p{r.partition}a{r.attempt}"
+        if r.state == "running":
+            if r.attempt == cj.generation:
+                cj.running.add((r.stage, r.partition))
+                if len(cj.running) >= len(cj.task_workers) and \
+                        cj.task_workers:
+                    cj.ready.set()
+            if r.events_json and r.report_seq:
+                # a resident task's periodic event flush: dedupe on the
+                # flush sequence so at-least-once delivery merges each
+                # drained buffer exactly once
+                fk = (r.stage, r.partition, r.attempt, "flush",
+                      int(r.report_seq))
+                if fk not in cj.seen_reports:
+                    cj.seen_reports.add(fk)
+                    for blob in r.events_json:
+                        try:
+                            record = json.loads(blob)
+                        except ValueError:
+                            continue
+                        events.EVENT_LOG.ingest(record,
+                                                query_id=cj.query_id,
+                                                task=task_label)
+            return
+        rk = (r.stage, r.partition, r.attempt, r.state, r.worker_id)
+        if rk in cj.seen_reports:
+            return
+        cj.seen_reports.add(rk)
+        for blob in r.events_json:
+            try:
+                record = json.loads(blob)
+            except ValueError:
+                continue
+            events.EVENT_LOG.ingest(record, query_id=cj.query_id,
+                                    task=task_label)
+        w = self.workers.get(r.worker_id)
+        if w is not None:
+            self._release_task(w, (r.job_id, r.stage, r.partition))
+            if not w["tasks"]:
+                w["idle_since"] = time.time()
+        if r.state == "failed" and r.attempt == cj.generation:
+            cj.runner.fail(f"resident task s{r.stage}p{r.partition}: "
+                           f"{r.error}")
 
     def _maybe_scale_up(self):
         e = self.elastic
@@ -1357,6 +1619,11 @@ class DriverActor(Actor):
         self._readmit_info = {
             wid: info for wid, info in self._readmit_info.items()
             if now - info.get("ts", now) < ttl}
+        # stopped continuous pipelines stay drainable for late terminal
+        # reports (buffered worker events) for one short window only
+        self._continuous_drain = {
+            jid: (cj, ts) for jid, (cj, ts)
+            in self._continuous_drain.items() if now - ts < 30.0}
         if self.elastic is not None:
             self._reap_idle_workers(now)
         lost = [wid for wid, w in self.workers.items()
@@ -1371,8 +1638,11 @@ class DriverActor(Actor):
                 self._drain_deferred(job)
         # admission backstop: expire queued jobs past their queue budget
         # or deadline, cancel running jobs past their deadline, and
-        # admit whatever the fair queue can now run
+        # admit whatever the fair queue can now run; long-lived
+        # (continuous) jobs re-charge their resident-task occupancy so
+        # they keep paying DRR cost instead of riding a one-time debit
         self._check_deadlines(now)
+        self.admission.recharge(now)
         self.admission.poll(now)
         self._drain_admission()
 
@@ -1456,6 +1726,14 @@ class DriverActor(Actor):
                     if stage_id in job.scheduled or \
                             (stage_id, p) in job.launched:
                         relaunch.append((job, stage_id, p))
+        # a continuous pipeline cannot survive losing a resident task's
+        # worker mid-interval (the in-flight records between markers
+        # died with it): fail the pipeline — the streaming query
+        # relaunches EVERY stage from the last sealed marker under a
+        # new generation, and this zombie's late pushes are fenced
+        for cj in list(self.continuous.values()):
+            if any(tw == wid for tw in cj.task_workers.values()):
+                cj.runner.fail(f"worker {wid} lost")
         seen: Set[Tuple[str, int, int]] = set()
         for job, stage, partition in relaunch:
             if (job.job_id, stage, partition) in seen:
@@ -1856,6 +2134,14 @@ class DriverActor(Actor):
         from ..catalog.system import SYSTEM
         SYSTEM.record_task(r.job_id, r.stage, r.partition, r.attempt,
                            r.state, r.worker_id, int(r.rows_out))
+        cj = self.continuous.get(r.job_id)
+        if cj is None:
+            drained = self._continuous_drain.get(r.job_id)
+            if drained is not None:
+                cj = drained[0]
+        if cj is not None:
+            self._on_continuous_status(cj, r)
+            return
         job = self.jobs.get(r.job_id)
         if job is None or job.done.is_set():
             return

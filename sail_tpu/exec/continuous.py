@@ -1,0 +1,1173 @@
+"""Continuous record-at-a-time streaming execution for the cluster path.
+
+Reference role: the reference Sail races Chandy–Lamport-style flow
+markers through a *running* dataflow instead of aligning whole
+micro-batch epochs (SURVEY.md §3.5) — ROADMAP item 4 names the epoch
+granularity of PR 9 as the one remaining honest gap. Theseus
+(arXiv:2508.05029) frames the missing piece as flow control: long-lived
+flows need credit, not just placement; Tailwind (arXiv:2604.28079)
+makes sub-second per-tenant latency promises that a trigger loop with a
+one-job-dispatch-per-batch floor cannot keep.
+
+Shape (gated by ``streaming.continuous.enabled``; OFF is bit-identical
+to the epoch path — none of this module runs):
+
+- **Long-lived stage tasks.** The driver dispatches every stage of a
+  streaming job ONCE as resident tasks (``TaskDefinition.
+  continuous_json``): a worker keeps the decoded fragment warm, pulls
+  sequenced record batches from upstream as they arrive, and pushes
+  results downstream through the compressed data plane (``PushRecords``
+  unary RPCs carrying lz4/zstd Arrow IPC payloads).
+- **Sequenced credit-based channels.** :class:`CreditInbox` generalizes
+  the epoch-tagged ``_StreamStore`` channels into unbounded,
+  sequence-numbered per-channel streams bounded by in-flight bytes:
+  exhausted credit refuses the push, the sender stalls-and-retries, and
+  the stall propagates upstream hop by hop to the source — surfaced as
+  ``backpressure`` events and ``streaming.continuous.credit_stall_time``.
+- **Mid-flight marker alignment.** Markers injected at the source ride
+  the same channels as data. :class:`AlignedInput` aligns them at
+  multi-input operators: an input that has seen marker N is drained
+  into a bounded, spill-backed buffer until siblings catch up, so fast
+  inputs keep their producers unblocked while slow siblings finish the
+  interval. The committed unit stays the marker interval, so the PR 9
+  commit protocol (two-phase sinks, publish-then-seal, pre-commit
+  records) snapshots a RUNNING pipeline instead of quiescing it.
+- **Attempt fencing.** Every push carries the pipeline generation; a
+  relaunch (after worker loss the pipeline restarts from the last
+  sealed marker) bumps it, and a zombie task's late pushes are refused
+  by the receiver's attempt/sequence checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import events
+from .. import faults
+from ..events import EventType
+from ..metrics import record as _record_metric
+from ..plan import nodes as pn
+from . import job_graph as jg
+from . import shuffle as sh
+from .proto import control_plane_pb2 as pb
+
+#: sentinel src_stage for driver source injection
+SOURCE_STAGE = -1
+
+#: marker added to ScanExec.format for the streaming source leaf; the
+#: resident task substitutes each pushed record batch into this scan
+STREAM_FORMAT = "__stream__"
+
+
+def conf() -> dict:
+    """One snapshot of the ``streaming.continuous.*`` knobs."""
+    from ..config import get as config_get
+    from ..config import truthy
+
+    def _num(key, default, cast=int):
+        try:
+            return cast(config_get(key, default))
+        except (TypeError, ValueError):
+            return default
+
+    return {
+        "enabled": truthy("streaming.continuous.enabled",
+                          default="false"),
+        "max_batch_rows": max(1, _num(
+            "streaming.continuous.max_batch_rows", 4096)),
+        "credit_bytes": max(1, _num(
+            "streaming.continuous.channel_credit_kb", 1024)) << 10,
+        "align_buffer_bytes": max(1, _num(
+            "streaming.continuous.align_buffer_kb", 1024)) << 10,
+        "marker_timeout_s": _num(
+            "streaming.continuous.marker_timeout_s", 30.0, float),
+        "start_timeout_s": _num(
+            "streaming.continuous.start_timeout_s", 10.0, float),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Sequenced, credit-bounded push channel
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Entry:
+    seq: int
+    kind: str            # "batch" | "marker"
+    marker: int
+    data: bytes          # encoded Arrow IPC ("" for markers)
+
+
+class CreditInbox:
+    """One producer→consumer sequenced stream with credit-based flow
+    control and attempt fencing.
+
+    ``offer`` returns a code: ``ok`` (accepted), ``dup`` (an at-least-
+    once retransmission of an already-accepted sequence — acknowledged,
+    not re-enqueued), ``fenced`` (the push carries a stale generation:
+    the sender is a zombie and must stop), ``credit`` (in-flight bytes
+    would exceed the bound: the sender stalls and retries — this is the
+    backpressure signal that propagates hop by hop to the source).
+    A push from a NEWER generation is refused ``unready`` — inboxes
+    are generation-pinned, and the relaunched task's FRESH inbox is
+    the only valid receiver (an old inbox acknowledging new-generation
+    entries would lose them when the task is replaced, leaving the
+    sender permanently 'ahead' of the fresh stream)."""
+
+    def __init__(self, attempt: int, credit_bytes: int,
+                 cond: threading.Condition):
+        self.attempt = attempt
+        self.credit_bytes = credit_bytes
+        self.cond = cond            # shared with the owning aligner
+        self.entries: List[Entry] = []
+        self.pending_bytes = 0
+        self.next_seq = 0           # next sequence to accept
+
+    def offer(self, attempt: int, seq: int, kind: str, marker: int,
+              data: bytes) -> str:
+        with self.cond:
+            if attempt < self.attempt:
+                return "fenced"
+            if attempt > self.attempt:
+                return "unready"  # the relaunch's fresh inbox owns it
+            if seq < self.next_seq:
+                return "dup"
+            if seq > self.next_seq:
+                # per-channel pushes are in order from one sender
+                # thread; a gap means a retried earlier push is still
+                # in flight — refuse so the sender re-sends in order
+                return "ahead"
+            if self.pending_bytes and \
+                    self.pending_bytes + len(data) > self.credit_bytes:
+                return "credit"
+            self.entries.append(Entry(seq, kind, marker, data))
+            self.pending_bytes += len(data)
+            self.next_seq = seq + 1
+            self.cond.notify_all()
+            return "ok"
+
+    def pop(self) -> Optional[Entry]:
+        """Under ``self.cond``: take the next entry, releasing its
+        credit."""
+        if not self.entries:
+            return None
+        entry = self.entries.pop(0)
+        self.pending_bytes -= len(entry.data)
+        self.cond.notify_all()
+        return entry
+
+
+class _AlignBuffer:
+    """Bounded in-memory buffer of post-marker entries from a blocked
+    input, spilling encoded payloads to a temp file beyond the bound so
+    a fast input can keep streaming while a slow sibling catches up."""
+
+    def __init__(self, memory_bytes: int):
+        self._cap = memory_bytes
+        self._mem_bytes = 0
+        self._items: List[object] = []    # Entry | ("spill", off, len, seq, kind, marker)
+        self._spill_file = None
+        self._spill_off = 0
+        self.spill_count = 0
+        self.buffered_bytes = 0
+
+    def push(self, entry: Entry) -> None:
+        self.buffered_bytes += len(entry.data)
+        if self._mem_bytes + len(entry.data) > self._cap and entry.data:
+            if self._spill_file is None:
+                fd, path = tempfile.mkstemp(prefix="sail_align_")
+                self._spill_file = os.fdopen(fd, "w+b")
+                try:
+                    os.unlink(path)   # anonymous: vanishes with the fd
+                except OSError:
+                    pass
+            self._spill_file.seek(self._spill_off)
+            self._spill_file.write(entry.data)
+            self._items.append(("spill", self._spill_off,
+                                len(entry.data), entry.seq, entry.kind,
+                                entry.marker))
+            self._spill_off += len(entry.data)
+            self.spill_count += 1
+            _record_metric("execution.spill_count", 1, kind="align")
+        else:
+            self._mem_bytes += len(entry.data)
+            self._items.append(entry)
+
+    def drain(self) -> List[Entry]:
+        out: List[Entry] = []
+        for item in self._items:
+            if isinstance(item, Entry):
+                self._mem_bytes -= len(item.data)
+                out.append(item)
+            else:
+                _tag, off, ln, seq, kind, marker = item
+                self._spill_file.seek(off)
+                out.append(Entry(seq, kind, marker,
+                                 self._spill_file.read(ln)))
+        self._items = []
+        self.buffered_bytes = 0
+        self._spill_off = 0
+        return out
+
+    def close(self) -> None:
+        if self._spill_file is not None:
+            try:
+                self._spill_file.close()
+            except OSError:
+                pass
+            self._spill_file = None
+
+
+class AlignedInput:
+    """Marker alignment across a task's input channels.
+
+    Input keys are ``(src_stage, src_partition)``. ``state_keys`` mark
+    BROADCAST inputs (a static build side): their batches surface
+    immediately as ``("state", key, data)`` accumulation, their markers
+    only participate in alignment. For stream inputs, ``next`` yields
+    ``("batch", key, data)`` in per-channel sequence order until an
+    input delivers marker N — from then on that input's entries drain
+    into a bounded spill-backed buffer (its producer keeps its credit)
+    until every sibling has delivered N, at which point ``("marker", N,
+    stats)`` fires and the buffered entries replay in order."""
+
+    def __init__(self, keys: List[Tuple[int, int]],
+                 state_keys: Optional[set] = None,
+                 attempt: int = 0,
+                 credit_bytes: int = 1 << 20,
+                 align_buffer_bytes: int = 1 << 20):
+        self.cond = threading.Condition()
+        self.keys = list(keys)
+        self.state_keys = set(state_keys or ())
+        self.inboxes: Dict[Tuple[int, int], CreditInbox] = {
+            k: CreditInbox(attempt, credit_bytes, self.cond)
+            for k in self.keys}
+        self._blocked: Dict[Tuple[int, int], int] = {}
+        self._buffers: Dict[Tuple[int, int], _AlignBuffer] = {
+            k: _AlignBuffer(align_buffer_bytes) for k in self.keys}
+        self._replay: Dict[Tuple[int, int], List[Entry]] = {
+            k: [] for k in self.keys}
+        self._block_started: Optional[float] = None
+        # state (broadcast build) inputs must PRIME — deliver their
+        # startup push, or an empty-build marker — before stream
+        # batches flow: joining early against a half-arrived build
+        # would silently drop rows. The held stream entries stay in
+        # their credit-bounded inboxes, so the wait is backpressure,
+        # not loss.
+        self._unprimed: set = set(self.state_keys)
+        self.closed = False
+
+    def offer(self, key: Tuple[int, int], attempt: int, seq: int,
+              kind: str, marker: int, data: bytes) -> str:
+        inbox = self.inboxes.get(key)
+        if inbox is None:
+            return "unready"
+        return inbox.offer(attempt, seq, kind, marker, data)
+
+    def backlog_bytes(self) -> int:
+        with self.cond:
+            return sum(i.pending_bytes for i in self.inboxes.values()) \
+                + sum(b.buffered_bytes for b in self._buffers.values())
+
+    def _take_one(self, key: Tuple[int, int]) -> Optional[Entry]:
+        """Under ``self.cond``: next entry of one input, replay buffer
+        first."""
+        if self._replay[key]:
+            return self._replay[key].pop(0)
+        return self.inboxes[key].pop()
+
+    def next(self, timeout: float = 0.2):
+        """One step of aligned consumption; None on timeout."""
+        deadline = time.time() + timeout
+        with self.cond:
+            while True:
+                if self.closed:
+                    return ("closed", -1, None)
+                # 1. drain blocked inputs into their align buffers so
+                # their producers' credit frees (the whole point of
+                # buffering past the marker)
+                for key, marker in list(self._blocked.items()):
+                    if key in self.state_keys:
+                        continue
+                    while True:
+                        entry = self.inboxes[key].pop()
+                        if entry is None:
+                            break
+                        self._buffers[key].push(entry)
+                # 2. state inputs surface immediately (blocked or not):
+                # a build side primes before record-at-a-time flow starts
+                for key in self.keys:
+                    if key not in self.state_keys:
+                        continue
+                    if key in self._blocked:
+                        continue
+                    entry = self._take_one(key)
+                    if entry is None:
+                        continue
+                    self._unprimed.discard(key)
+                    if entry.kind == "marker":
+                        self._note_blocked(key, entry.marker)
+                        continue
+                    return ("state", key, entry)
+                # 3. unblocked stream inputs, round-robin — held back
+                # until every state input has primed (first push or
+                # empty-build marker seen)
+                if not self._unprimed:
+                    for key in self.keys:
+                        if key in self._blocked or \
+                                key in self.state_keys:
+                            continue
+                        entry = self._take_one(key)
+                        if entry is None:
+                            continue
+                        if entry.kind == "marker":
+                            self._note_blocked(key, entry.marker)
+                            continue
+                        return ("batch", key, entry)
+                # 4. alignment: every input blocked on the same marker
+                if self._blocked and len(self._blocked) == len(self.keys):
+                    markers = set(self._blocked.values())
+                    marker = min(markers)
+                    stats = {
+                        "wait_ms": round(
+                            (time.time() - self._block_started) * 1000.0,
+                            3) if self._block_started else 0.0,
+                        "buffered_bytes": sum(
+                            b.buffered_bytes
+                            for b in self._buffers.values()),
+                        "spills": sum(b.spill_count
+                                      for b in self._buffers.values()),
+                    }
+                    # unblock inputs at this marker; replay buffers
+                    for key in self.keys:
+                        if self._blocked.get(key) == marker:
+                            del self._blocked[key]
+                            self._replay[key] = \
+                                self._buffers[key].drain() \
+                                + self._replay[key]
+                    self._block_started = time.time() \
+                        if self._blocked else None
+                    return ("marker", marker, stats)
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None
+                self.cond.wait(remaining)
+
+    def _note_blocked(self, key, marker: int) -> None:
+        self._blocked[key] = marker
+        if self._block_started is None:
+            self._block_started = time.time()
+
+    def close(self) -> None:
+        with self.cond:
+            self.closed = True
+            for b in self._buffers.values():
+                b.close()
+            self.cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# Push sender (credit stall-and-retry, zombie self-termination)
+# ---------------------------------------------------------------------------
+
+class Fenced(Exception):
+    """The receiver refused this sender's generation: a newer pipeline
+    relaunch owns the channels, so this task is a zombie and must stop
+    pushing (silently — the relaunch's outputs are authoritative)."""
+
+
+def offer_response(code: str) -> pb.PushRecordsResponse:
+    """The single aligner-code → PushRecords wire-response mapping
+    (worker inboxes, the driver root collector, and the unregistered-
+    job fallback all share it)."""
+    if code in ("ok", "dup"):
+        return pb.PushRecordsResponse(accepted=True)
+    if code == "fenced":
+        return pb.PushRecordsResponse(accepted=False, reason="fenced")
+    return pb.PushRecordsResponse(
+        accepted=False, reason=code,
+        retry_after_ms=2 if code == "credit" else 20)
+
+
+def push_entry(addr: str, service: str, req: pb.PushRecordsRequest,
+               collector=None, query_id: str = "",
+               stop_check=None, on_stall=None) -> None:
+    """Deliver one sequenced entry, stalling on exhausted credit and
+    retrying transient failures (the receiver's seq dedupe makes
+    at-least-once delivery exactly-once). Raises :class:`Fenced` for a
+    stale generation. ``on_stall`` runs once per refused attempt — the
+    DRIVER's source pushes drain their root inbox there, so a full
+    root channel can never deadlock the push cycle (driver waits on
+    leaf credit, leaf waits on root credit, root waits on the
+    driver)."""
+    from .cluster import _peer_channel
+
+    site_key = f"s{req.dst_stage}p{req.dst_partition}"
+    stall_s = 0.0
+    stalled = False
+    failures = 0
+    while True:
+        if stop_check is not None and stop_check():
+            raise Fenced("stopped")
+        faults.inject("shuffle.credit", key=site_key)
+        try:
+            channel = _peer_channel(addr)
+            rpc = channel.unary_unary(
+                f"/{service}/PushRecords",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.PushRecordsResponse.FromString)
+            resp = rpc(req, timeout=30)
+        except Exception as e:  # noqa: BLE001 — grpc.RpcError and friends
+            if isinstance(e, faults.WorkerCrash):
+                raise
+            failures += 1
+            if failures > 40:
+                raise
+            time.sleep(min(0.25, 0.01 * failures))
+            continue
+        if resp.accepted:
+            break
+        if resp.reason == "fenced":
+            raise Fenced(f"push to {addr} fenced")
+        # "credit" (bounded in-flight bytes exhausted) and "unready"
+        # (receiver task not registered yet) both stall-and-retry; the
+        # stall IS the upstream propagation of backpressure
+        wait = max(1, resp.retry_after_ms) / 1000.0
+        if resp.reason == "credit":
+            stalled = True
+            stall_s += wait
+        if on_stall is not None:
+            on_stall()
+        time.sleep(wait)
+    if stalled:
+        _record_metric("streaming.continuous.credit_stall_time",
+                       stall_s, stage=str(req.dst_stage))
+        stall_ms = round(stall_s * 1000.0, 3)
+        if collector is not None:
+            collector.emit(EventType.BACKPRESSURE, job_id=req.job_id,
+                           stage=req.dst_stage,
+                           partition=req.dst_partition,
+                           channel=req.channel, stall_ms=stall_ms)
+        else:
+            events.emit(EventType.BACKPRESSURE, query_id=query_id,
+                        job_id=req.job_id, stage=req.dst_stage,
+                        partition=req.dst_partition,
+                        channel=req.channel, stall_ms=stall_ms)
+
+
+# ---------------------------------------------------------------------------
+# Fragment analysis: which stages can process record batches one at a
+# time (outputs concatenated over the interval == the interval output)
+# ---------------------------------------------------------------------------
+
+def _contains_stream_ref(p: pn.PlanNode, stream_sids: set) -> bool:
+    if isinstance(p, jg.StageInputExec):
+        return p.stage_id in stream_sids
+    if isinstance(p, pn.ScanExec):
+        return p.format == STREAM_FORMAT
+    return any(_contains_stream_ref(c, stream_sids) for c in p.children)
+
+
+def streamable_fragment(plan: pn.PlanNode, stream_sids: set,
+                        is_producer: bool) -> bool:
+    """True when applying the fragment per record batch and
+    concatenating the outputs equals applying it to the interval
+    concatenation: Filter/Project chains, joins whose streamed side is
+    the probe (left) of an inner/left/semi/anti join against a
+    stream-free build, and — for shuffle producers only — a TOP-LEVEL
+    partial aggregate (its consumer merges the whole interval, so
+    per-batch partials fold to the same totals)."""
+
+    def ok(p: pn.PlanNode, top: bool) -> bool:
+        if isinstance(p, (jg.StageInputExec, pn.ScanExec)):
+            return True
+        if isinstance(p, (pn.FilterExec, pn.ProjectExec)):
+            return ok(p.input, False)
+        if isinstance(p, pn.AggregateExec):
+            if not (top and is_producer):
+                return False
+            return ok(p.input, False)
+        if isinstance(p, pn.JoinExec):
+            lhs = _contains_stream_ref(p.left, stream_sids)
+            rhs = _contains_stream_ref(p.right, stream_sids)
+            if rhs or not lhs:
+                return False
+            if p.join_type not in ("inner", "left", "semi", "anti"):
+                return False
+            return ok(p.left, False)
+        return not _contains_stream_ref(p, stream_sids)
+
+    return _contains_stream_ref(plan, stream_sids) and ok(plan, True)
+
+
+def mark_stream_scans(node: pn.PlanNode, placeholder) -> Tuple[
+        pn.PlanNode, int]:
+    """Replace memory scans of the placeholder source table with
+    ``__stream__`` leaves (the resident task substitutes pushed record
+    batches); returns (plan, count found)."""
+    found = [0]
+
+    def repl(p):
+        if isinstance(p, pn.ScanExec) and p.source is placeholder:
+            found[0] += 1
+            # the projection is KEPT: pushed record batches carry the
+            # full source schema, and the resident task applies the
+            # pruning the optimizer decided before substituting
+            return dataclasses.replace(p, source=None,
+                                       format=STREAM_FORMAT)
+        if isinstance(p, pn.JoinExec):
+            return dataclasses.replace(p, left=repl(p.left),
+                                       right=repl(p.right))
+        if isinstance(p, pn.UnionExec):
+            return dataclasses.replace(
+                p, inputs=tuple(repl(c) for c in p.inputs))
+        if hasattr(p, "input") and p.input is not None:
+            return dataclasses.replace(p, input=repl(p.input))
+        return p
+
+    out = repl(node)
+    return out, found[0]
+
+
+def _find_stream_scan(p: pn.PlanNode) -> Optional[pn.ScanExec]:
+    if isinstance(p, pn.ScanExec) and p.format == STREAM_FORMAT:
+        return p
+    for c in p.children:
+        got = _find_stream_scan(c)
+        if got is not None:
+            return got
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Worker side: resident stage tasks
+# ---------------------------------------------------------------------------
+
+class ResidentTask:
+    """A long-lived stage task: decode the fragment once, then stream
+    aligned record batches through it for the pipeline's lifetime."""
+
+    def __init__(self, worker, task: pb.TaskDefinition, spec: dict,
+                 cancel_ev: threading.Event):
+        self.worker = worker
+        self.task = task
+        self.spec = spec
+        self.cancel = cancel_ev
+        self.generation = int(spec.get("generation", 0))
+        self.recorder = events.TaskEventCollector()
+        self.rows_out = 0
+        keys: List[Tuple[int, int]] = []
+        state_keys = set()
+        for inp in spec.get("inputs", ()):  # ordered: deterministic concat
+            sid = int(inp["stage"])
+            for p in inp["parts"]:
+                keys.append((sid, int(p)))
+            if inp["mode"] == "broadcast":
+                state_keys.update((sid, int(p)) for p in inp["parts"])
+        self.aligner = AlignedInput(
+            keys, state_keys=state_keys, attempt=self.generation,
+            credit_bytes=int(spec.get("credit_bytes", 1 << 20)),
+            align_buffer_bytes=int(spec.get("align_buffer_bytes",
+                                            1 << 20)))
+        # per destination (dst_stage, dst_partition): next sequence
+        self._seqs: Dict[Tuple[int, int], int] = {}
+        self._state: Dict[Tuple[int, int], List[object]] = {}
+        self._acc: Dict[Tuple[int, int], List[object]] = {}
+        self._frag: Optional[pn.PlanNode] = None
+        self._stream_scan: Optional[pn.ScanExec] = None
+        self._streamable = False
+        self._flushes = 0
+
+    # -- setup -----------------------------------------------------------
+    def _prepare(self) -> None:
+        from .cluster import _resolve_driver_scans
+        task = self.task
+        plan = jg.decode_fragment(task.plan, task.partition,
+                                  max(task.num_partitions, 1))
+        plan = _resolve_driver_scans(plan, task)
+        if task.runtime_filters_json:
+            plan = jg.apply_task_runtime_filters(
+                plan, task.runtime_filters_json)
+        self._frag = plan
+        self._stream_scan = _find_stream_scan(plan)
+        stream_sids = {int(inp["stage"])
+                       for inp in self.spec.get("inputs", ())
+                       if inp["mode"] not in ("broadcast", "source")}
+        is_producer = any(o["mode"] == "shuffle"
+                          for o in self.spec.get("outputs", ()))
+        self._streamable = streamable_fragment(plan, stream_sids,
+                                               is_producer)
+
+    # -- execution -------------------------------------------------------
+    def _attach(self, tables: Dict[int, object],
+                batch=None) -> pn.PlanNode:
+        import pyarrow as pa
+        plan = self._frag
+        if self._stream_scan is not None:
+            scan = self._stream_scan
+            table = batch if batch is not None else _empty_of(scan)
+            if scan.projection is not None:
+                table = table.select(list(scan.projection))
+            plan = jg._replace_subtree(
+                plan, scan,
+                dataclasses.replace(scan, out_schema=scan.schema,
+                                    source=table, projection=None,
+                                    format="memory"))
+        # every declared stage input needs a table: absent ones (an
+        # interval with no batches) attach empty
+        full: Dict[int, object] = {}
+        for inp in self.spec.get("inputs", ()):
+            sid = int(inp["stage"])
+            if sid == SOURCE_STAGE:
+                continue
+            got = tables.get(sid)
+            if got is None:
+                schema = _stage_input_schema(self._frag, sid)
+                got = schema.empty_table() if schema is not None else \
+                    pa.table({})
+            full[sid] = got
+        return jg.attach_stage_inputs(plan, full) if full else plan
+
+    def _execute(self, plan: pn.PlanNode):
+        from .local import LocalExecutor
+        with events.collecting(self.recorder):
+            return LocalExecutor().execute(plan)
+
+    def _state_table(self, sid: int):
+        import pyarrow as pa
+        parts = [t for (s, _p), ts in sorted(self._state.items())
+                 if s == sid for t in ts]
+        if not parts:
+            return None
+        return pa.concat_tables(parts, promote_options="permissive") \
+            if len(parts) > 1 else parts[0]
+
+    def _interval_tables(self) -> Tuple[Dict[int, object], object]:
+        """(stage-input tables, source-batch concatenation) for one
+        marker interval, in deterministic (producer, seq) order."""
+        import pyarrow as pa
+        out: Dict[int, object] = {}
+        by_sid: Dict[int, List[object]] = {}
+        for (sid, _p) in sorted(self._acc):
+            by_sid.setdefault(sid, []).extend(self._acc[(sid, _p)])
+        source = None
+        for sid, parts in by_sid.items():
+            merged = pa.concat_tables(parts,
+                                      promote_options="permissive") \
+                if len(parts) > 1 else parts[0]
+            if sid == SOURCE_STAGE:
+                source = merged
+            else:
+                out[sid] = merged
+        for inp in self.spec.get("inputs", ()):
+            if inp["mode"] == "broadcast":
+                sid = int(inp["stage"])
+                st = self._state_table(sid)
+                if st is not None:
+                    out[sid] = st
+        return out, source
+
+    # -- output ----------------------------------------------------------
+    def _push_table(self, table) -> None:
+        task = self.task
+        for out in self.spec.get("outputs", ()):
+            addrs = out["addrs"]
+            service = _service_of(out)
+            dst_stage = int(out["stage"])
+            if out["mode"] == "shuffle" and \
+                    task.HasField("shuffle_write") and \
+                    task.shuffle_write.num_channels > 1:
+                sw = task.shuffle_write
+                parts = jg.hash_partition_table(
+                    table, list(sw.key_columns), sw.num_channels)
+                for c, part in enumerate(parts):
+                    if part.num_rows == 0:
+                        continue
+                    self._send(addrs[c % len(addrs)], service, dst_stage,
+                               c % len(addrs), c, "batch", 0,
+                               sh.encode_table(part))
+            elif out["mode"] == "forward":
+                p = task.partition % len(addrs)
+                if table.num_rows:
+                    self._send(addrs[p], service, dst_stage, p, -1,
+                               "batch", 0, sh.encode_table(table))
+            else:  # merge | broadcast: the whole output to every consumer
+                if table.num_rows or out["mode"] == "broadcast":
+                    blob = sh.encode_table(table)
+                    for p, addr in enumerate(addrs):
+                        self._send(addr, service, dst_stage, p, -1,
+                                   "batch", 0, blob)
+        self.rows_out += int(table.num_rows)
+
+    def _push_marker(self, marker: int) -> None:
+        for out in self.spec.get("outputs", ()):
+            service = _service_of(out)
+            addrs = out["addrs"]
+            if out["mode"] == "forward":
+                # a FORWARD consumer partition expects ONLY its
+                # matching producer — a marker to a sibling would
+                # address a channel that consumer never registered
+                p = self.task.partition % len(addrs)
+                targets = [(p, addrs[p])]
+            else:
+                targets = list(enumerate(addrs))
+            for p, addr in targets:
+                self._send(addr, service, int(out["stage"]), p, -1,
+                           "marker", marker, b"")
+
+    def _send(self, addr: str, service: str, dst_stage: int,
+              dst_partition: int, channel: int, kind: str, marker: int,
+              data: bytes) -> None:
+        task = self.task
+        key = (dst_stage, dst_partition)
+        seq = self._seqs.get(key, 0)
+        req = pb.PushRecordsRequest(
+            job_id=task.job_id, src_stage=task.stage,
+            src_partition=task.partition, dst_stage=dst_stage,
+            dst_partition=dst_partition, channel=channel, seq=seq,
+            attempt=self.generation, kind=kind, marker=marker,
+            data=data)
+        push_entry(addr, service, req, collector=self.recorder,
+                   stop_check=lambda: self.cancel.is_set()
+                   or self.worker._crashed)
+        self._seqs[key] = seq + 1
+
+    # -- main loop -------------------------------------------------------
+    def run(self) -> None:
+        worker = self.worker
+        task = self.task
+        error = ""
+        fenced = False
+        try:
+            faults.inject("worker.task_exec",
+                          key=f"{worker.worker_id}:s{task.stage}"
+                              f"p{task.partition}")
+            self._prepare()
+            worker._report(task, "running")
+            self.recorder.emit(
+                EventType.TASK_START, job_id=task.job_id,
+                stage=task.stage, partition=task.partition,
+                attempt=task.attempt, worker=worker.worker_id,
+                tenant=task.tenant)
+            static_leaf = self._stream_scan is None and not any(
+                inp["mode"] not in ("source",)
+                for inp in self.spec.get("inputs", ()))
+            if static_leaf:
+                # a static leaf (broadcast build side): its content
+                # never changes within the pipeline's lifetime — push
+                # once at startup, then forward markers for alignment
+                self._push_table(self._execute(self._attach({})))
+            while not self.cancel.is_set() and not worker._crashed:
+                item = self.aligner.next(timeout=0.2)
+                if item is None:
+                    continue
+                kind, key, payload = item
+                if kind == "closed":
+                    return
+                if kind == "state":
+                    self._state.setdefault(key, []).append(
+                        sh.decode_stream(payload.data))
+                    continue
+                if kind == "batch":
+                    table = sh.decode_stream(payload.data)
+                    if self._streamable:
+                        tables = {key[0]: table} if key[0] != \
+                            SOURCE_STAGE else {}
+                        for inp in self.spec.get("inputs", ()):
+                            if inp["mode"] == "broadcast":
+                                st = self._state_table(int(inp["stage"]))
+                                if st is not None:
+                                    tables[int(inp["stage"])] = st
+                        out = self._execute(self._attach(
+                            tables, batch=table
+                            if key[0] == SOURCE_STAGE else None))
+                        self._push_table(out)
+                    else:
+                        self._acc.setdefault(key, []).append(table)
+                    continue
+                # marker alignment reached mid-flight
+                marker, stats = key, payload
+                faults.inject("streaming.marker",
+                              key=f"s{task.stage}p{task.partition}"
+                                  f":m{marker}")
+                self.recorder.emit(
+                    EventType.MARKER_ALIGN, job_id=task.job_id,
+                    stage=task.stage, partition=task.partition,
+                    marker=marker, wait_ms=stats["wait_ms"],
+                    buffered_bytes=stats["buffered_bytes"])
+                if not self._streamable and not static_leaf:
+                    tables, source = self._interval_tables()
+                    out = self._execute(self._attach(tables,
+                                                     batch=source))
+                    self._acc.clear()
+                    self._push_table(out)
+                self._push_marker(marker)
+                _record_metric("streaming.continuous.backlog_bytes",
+                               self.aligner.backlog_bytes())
+                # ship the buffered flight-recorder events at marker
+                # cadence (numbered flush, deduped driver-side): a
+                # long-lived task must not hoard its marker_align /
+                # backpressure events until death — or overflow the
+                # bounded collector and drop them entirely
+                self._flushes += 1
+                worker._report(task, "running",
+                               recorder=self.recorder,
+                               report_seq=self._flushes)
+        except Fenced:
+            fenced = True  # zombie: a relaunch owns the channels
+        except faults.WorkerCrash:
+            worker._die()
+            fenced = True  # a "dead" process reports nothing
+        except Exception as e:  # noqa: BLE001 — full cause to the driver
+            error = f"{type(e).__name__}: {e}"
+        finally:
+            self.aligner.close()
+            if not fenced and not worker._crashed:
+                worker._report(task, "failed" if error else "succeeded",
+                               error=error, rows=self.rows_out,
+                               recorder=self.recorder)
+            worker.continuous.unregister(self)
+
+
+def _service_of(out: dict) -> str:
+    from .cluster import _DRIVER_SERVICE, _WORKER_SERVICE
+    return _DRIVER_SERVICE if out.get("driver") else _WORKER_SERVICE
+
+
+def _empty_of(scan: pn.ScanExec):
+    import pyarrow as pa
+    from ..columnar.arrow_interop import spec_type_to_arrow
+    return pa.Table.from_arrays(
+        [pa.array([], type=spec_type_to_arrow(f.dtype))
+         for f in scan.schema],
+        names=[f.name for f in scan.schema])
+
+
+def _stage_input_schema(plan: pn.PlanNode, sid: int):
+    import pyarrow as pa
+    from ..columnar.arrow_interop import spec_type_to_arrow
+    for node in pn.walk_plan(plan):
+        if isinstance(node, jg.StageInputExec) and node.stage_id == sid:
+            return pa.schema([(f.name, spec_type_to_arrow(f.dtype))
+                              for f in node.out_schema])
+    return None
+
+
+class ContinuousWorker:
+    """Per-worker registry of resident tasks and their input channels;
+    the PushRecords handler routes into it."""
+
+    def __init__(self, worker):
+        self.worker = worker
+        self._lock = threading.Lock()
+        self._tasks: Dict[Tuple[str, int, int], ResidentTask] = {}
+
+    def start_task(self, task: pb.TaskDefinition, spec: dict,
+                   cancel_ev: threading.Event) -> None:
+        rt = ResidentTask(self.worker, task, spec, cancel_ev)
+        key = (task.job_id, task.stage, task.partition)
+        with self._lock:
+            old = self._tasks.get(key)
+            self._tasks[key] = rt
+        if old is not None:
+            old.cancel.set()
+            old.aligner.close()
+        threading.Thread(
+            target=rt.run, daemon=True,
+            name=f"resident-{task.stage}p{task.partition}").start()
+
+    def unregister(self, rt: "ResidentTask") -> None:
+        key = (rt.task.job_id, rt.task.stage, rt.task.partition)
+        with self._lock:
+            if self._tasks.get(key) is rt:
+                del self._tasks[key]
+        self.worker._unregister_running(key, rt.cancel)
+
+    def offer(self, req: pb.PushRecordsRequest) -> pb.PushRecordsResponse:
+        with self._lock:
+            rt = self._tasks.get((req.job_id, req.dst_stage,
+                                  req.dst_partition))
+        if rt is None:
+            return offer_response("unready")
+        return offer_response(rt.aligner.offer(
+            (req.src_stage, req.src_partition), req.attempt, req.seq,
+            req.kind, req.marker, req.data))
+
+    def clean_job(self, job_id: str) -> None:
+        with self._lock:
+            doomed = [rt for (j, _s, _p), rt in self._tasks.items()
+                      if j == job_id]
+        for rt in doomed:
+            rt.cancel.set()
+            rt.aligner.close()
+
+    def stop_all(self) -> None:
+        with self._lock:
+            tasks = list(self._tasks.values())
+        for rt in tasks:
+            rt.cancel.set()
+            rt.aligner.close()
+
+
+# ---------------------------------------------------------------------------
+# Driver side: the continuous job runner
+# ---------------------------------------------------------------------------
+
+_GEN_LOCK = threading.Lock()
+_GENERATIONS: Dict[str, int] = {}
+
+
+def next_generation(job_id: str) -> int:
+    """Monotonic pipeline generation per job id: relaunched resident
+    tasks carry a higher generation than any zombie of a previous
+    incarnation, so the fencing in :class:`CreditInbox` refuses the
+    zombie's late pushes."""
+    with _GEN_LOCK:
+        _GENERATIONS[job_id] = _GENERATIONS.get(job_id, 0) + 1
+        return _GENERATIONS[job_id]
+
+
+class _DriverContinuousJob:
+    """The driver actor's registration record for one continuous job."""
+
+    def __init__(self, runner: "ContinuousJobRunner"):
+        self.runner = runner
+        self.job_id = runner.job_id
+        self.graph = runner.graph
+        self.generation = runner.generation
+        self.tenant = runner.tenant
+        self.query_id = ""
+        self.task_workers: Dict[Tuple[int, int], str] = {}
+        self.running: set = set()
+        self.ready = threading.Event()
+        self.seen_reports: set = set()
+
+
+class ContinuousJobRunner:
+    """Owns one continuous pipeline: splits the resolved plan, has the
+    driver dispatch resident stage tasks, feeds source record batches,
+    injects markers, and collects the per-interval root output."""
+
+    def __init__(self, cluster, node: pn.PlanNode,
+                 num_partitions: int, job_id: str,
+                 tenant: str = "default"):
+        self.cluster = cluster
+        self.job_id = job_id
+        self.tenant = tenant or "default"
+        self.conf = conf()
+        self.generation = 0
+        # every event of this pipeline incarnation attributes to the
+        # query that STARTED it (captured at start), so one pipeline's
+        # markers/stalls reconstruct as one coherent timeline even
+        # though later triggers run under per-epoch query profiles
+        self.query_id = ""
+        self.failed: Optional[str] = None
+        self._fail_ev = threading.Event()
+        self.graph = jg.split_job(node, num_partitions)
+        self.root_aligner: Optional[AlignedInput] = None
+        self._root_parts: Dict[int, List[object]] = {}
+        self._aligned_markers: List[int] = []
+        self._started = False
+        self._stopped = False
+        self.leaf_targets: List[Tuple[int, int, bool]] = []  # sid, nparts, is_stream
+        self._leaf_addrs: Dict[Tuple[int, int], str] = {}
+        self._src_seqs: Dict[Tuple[int, int], int] = {}
+        self._rr = 0
+        if self.graph is not None and not self._eligible():
+            self.graph = None
+
+    def _eligible(self) -> bool:
+        g = self.graph
+        if g is None or not g.root.on_driver:
+            return False
+        if _find_stream_scan(g.root.plan) is not None:
+            return False  # the stream scan must live in a worker stage
+        has_stream_leaf = False
+        for stage in g.stages:
+            if stage.on_driver:
+                continue
+            is_stream = _find_stream_scan(stage.plan) is not None
+            if not stage.inputs:
+                self.leaf_targets.append(
+                    (stage.stage_id, stage.num_partitions, is_stream))
+                has_stream_leaf = has_stream_leaf or is_stream
+            elif is_stream:
+                return False  # a non-leaf stream scan is unreachable
+        return has_stream_leaf
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> bool:
+        if self.graph is None:
+            return False
+        self.generation = next_generation(self.job_id)
+        top = self.graph.root.inputs[0].stage_id
+        top_parts = self.graph.stages[top].num_partitions
+        self.root_aligner = AlignedInput(
+            [(top, p) for p in range(top_parts)],
+            attempt=self.generation,
+            credit_bytes=self.conf["credit_bytes"],
+            align_buffer_bytes=self.conf["align_buffer_bytes"])
+        cj = _DriverContinuousJob(self)
+        from .. import profiler
+        prof = profiler.current_profile()
+        if prof is not None:
+            self.query_id = cj.query_id = prof.query_id
+        got = self.cluster.driver.handle.ask(
+            lambda reply: ("continuous_start", (cj, reply)),
+            timeout=30.0)
+        if not got or self.failed:
+            return False
+        self._leaf_addrs = dict(got)
+        if not cj.ready.wait(self.conf["start_timeout_s"]):
+            self.fail("resident tasks did not start in time")
+            return False
+        self._started = True
+        return True
+
+    def fail(self, reason: str) -> None:
+        if self.failed is None:
+            self.failed = reason
+        self._fail_ev.set()
+        if self.root_aligner is not None:
+            self.root_aligner.close()
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        try:
+            self.cluster.driver.handle.send(
+                ("continuous_stop", self.job_id))
+        except Exception:  # noqa: BLE001 — driver may already be down
+            pass
+        if self.root_aligner is not None:
+            self.root_aligner.close()
+
+    # -- data plane ------------------------------------------------------
+    def root_offer(self, req: pb.PushRecordsRequest
+                   ) -> pb.PushRecordsResponse:
+        if self.root_aligner is None:
+            return offer_response("unready")
+        return offer_response(self.root_aligner.offer(
+            (req.src_stage, req.src_partition), req.attempt, req.seq,
+            req.kind, req.marker, req.data))
+
+    def _push_source(self, leaf: Tuple[int, int], kind: str,
+                     marker: int, data: bytes) -> None:
+        from .cluster import _WORKER_SERVICE
+        addr = self._leaf_addrs.get(leaf)
+        if addr is None:
+            raise RuntimeError(f"no worker for leaf task {leaf}")
+        seq = self._src_seqs.get(leaf, 0)
+        req = pb.PushRecordsRequest(
+            job_id=self.job_id, src_stage=SOURCE_STAGE,
+            src_partition=0, dst_stage=leaf[0], dst_partition=leaf[1],
+            channel=-1, seq=seq, attempt=self.generation, kind=kind,
+            marker=marker, data=data)
+        push_entry(addr, _WORKER_SERVICE, req,
+                   query_id=self.query_id,
+                   stop_check=lambda: self._fail_ev.is_set(),
+                   on_stall=lambda: self._drain_root(0.0))
+        self._src_seqs[leaf] = seq + 1
+
+    def _drain_root(self, timeout: float) -> Optional[int]:
+        """Pop whatever the root aligner has ready; returns an aligned
+        marker id when one fires, else None. Runs both from the
+        interval wait loop and from source-push credit stalls — the
+        driver keeps consuming its inbox even while ITS pushes are the
+        ones backpressured."""
+        item = self.root_aligner.next(timeout=timeout)
+        if item is None:
+            return None
+        kind, key, payload = item
+        if kind == "closed":
+            raise RuntimeError(
+                f"continuous pipeline failed: "
+                f"{self.failed or 'root channel closed'}")
+        if kind in ("batch", "state"):
+            self._root_parts.setdefault(key[1], []).append(
+                sh.decode_stream(payload.data))
+            return None
+        marker, stats = key, payload
+        events.emit(EventType.MARKER_ALIGN, query_id=self.query_id,
+                    job_id=self.job_id,
+                    stage=self.graph.root.stage_id, partition=0,
+                    marker=marker, wait_ms=stats["wait_ms"],
+                    buffered_bytes=stats["buffered_bytes"])
+        self._aligned_markers.append(marker)
+        return marker
+
+    def push_batch(self, table) -> None:
+        """Slice a source table into bounded record batches and spread
+        them round-robin over the stream-leaf partitions."""
+        rows = self.conf["max_batch_rows"]
+        stream_leaves = [(sid, p) for sid, nparts, is_stream
+                         in self.leaf_targets if is_stream
+                         for p in range(nparts)]
+        off = 0
+        while off < table.num_rows:
+            chunk = table.slice(off, rows)
+            off += chunk.num_rows
+            leaf = stream_leaves[self._rr % len(stream_leaves)]
+            self._rr += 1
+            self._push_source(leaf, "batch", 0, sh.encode_table(chunk))
+
+    def run_interval(self, marker: int, table) -> object:
+        """Push one source slice, inject marker N at every source, and
+        block until the marker aligns at the root — returning the
+        interval's output table (the running pipeline's snapshot for
+        epoch N's commit)."""
+        import pyarrow as pa
+        from .local import LocalExecutor
+        if self.failed:
+            raise RuntimeError(f"continuous pipeline failed: "
+                               f"{self.failed}")
+        t0 = time.perf_counter()
+        if table is not None and table.num_rows:
+            self.push_batch(table)
+        faults.inject("streaming.marker", key=f"inject:m{marker}")
+        events.emit(EventType.MARKER_INJECT, query_id=self.query_id,
+                    job_id=self.job_id, marker=marker)
+        for sid, nparts, _is_stream in self.leaf_targets:
+            for p in range(nparts):
+                self._push_source((sid, p), "marker", marker, b"")
+        deadline = time.time() + self.conf["marker_timeout_s"]
+        while marker not in self._aligned_markers:
+            if self.failed:
+                raise RuntimeError(f"continuous pipeline failed: "
+                                   f"{self.failed}")
+            if self._drain_root(0.2) is None and \
+                    time.time() > deadline:
+                self.fail(f"marker {marker} did not align at the "
+                          f"root in time")
+                raise RuntimeError(self.failed)
+        self._aligned_markers = [m for m in self._aligned_markers
+                                 if m > marker]
+        # interval output: (partition, seq)-ordered concatenation, so
+        # the committed bytes are deterministic under any arrival order
+        top = self.graph.root.inputs[0].stage_id
+        parts = [t for p in sorted(self._root_parts)
+                 for t in self._root_parts[p]]
+        self._root_parts = {}
+        schema = _stage_input_schema(self.graph.root.plan, top)
+        if parts:
+            merged = pa.concat_tables(parts,
+                                      promote_options="permissive") \
+                if len(parts) > 1 else parts[0]
+        else:
+            merged = schema.empty_table() if schema is not None \
+                else pa.table({})
+        from .cluster import _reattach_local_scans
+        root_plan = jg.attach_stage_inputs(self.graph.root.plan,
+                                           {top: merged})
+        root_plan = _reattach_local_scans(root_plan,
+                                          self.graph.scan_tables)
+        result = LocalExecutor().execute(root_plan)
+        _record_metric("streaming.continuous.latency",
+                       time.perf_counter() - t0)
+        return result

@@ -21,6 +21,8 @@ site                      where it fires                        key
 ``streaming.source``      streaming trigger, pre-read           source name
 ``streaming.sink``        epoch sink stage / commit             stage:eN, commit:eN
 ``streaming.checkpoint``  state / offsets checkpoint write      state:eN, offsets:eN
+``streaming.marker``      continuous marker inject / align      inject:mN, sSpP:mN
+``shuffle.credit``        continuous record-batch push          sSpP (dst)
 ========================  ====================================  =========
 
 Rules are a semicolon-separated spec (``SAIL_FAULTS`` env var, the

@@ -603,6 +603,16 @@ class StreamingQuery:
         # shuffle channels publish/fetch per (job, epoch)
         self._cluster = cluster
         self._cluster_job_id = f"sq-{self.id[:12]}"
+        # continuous record-at-a-time mode (exec/continuous.py): when
+        # enabled AND a cluster is attached, eligible plans run as one
+        # LONG-LIVED pipeline — record batches stream through resident
+        # stage tasks as they arrive, each trigger injects a marker,
+        # and the marker interval commits through the SAME protocol
+        # below. Off (the default) is bit-identical to the epoch path.
+        self._cont_runner = None
+        self._cont_disabled = not (
+            config_truthy("streaming.continuous.enabled",
+                          default="false") and cluster is not None)
         # commit protocol knobs
         self._two_phase = config_truthy("streaming.two_phase")
         self._incremental = config_truthy("streaming.incremental_state")
@@ -647,10 +657,19 @@ class StreamingQuery:
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=30)
+        self._stop_continuous()
         close = getattr(self._source, "close", None)
         if close is not None:
             close()
         self._sink.close()
+
+    def _stop_continuous(self):
+        runner, self._cont_runner = self._cont_runner, None
+        if runner is not None:
+            try:
+                runner.stop()
+            except Exception:  # noqa: BLE001 — teardown must not mask
+                pass
 
     def _raise_if_failed(self):
         if self.exception is not None:
@@ -696,6 +715,12 @@ class StreamingQuery:
     def _fail(self, e: Exception):
         self.exception = e
         self._stop.set()
+        # tear the continuous pipeline down NOW: the restarted query
+        # relaunches every stage from the last sealed marker under a
+        # new generation, and this incarnation's zombies must not keep
+        # pushing into relaunched channels (they would be fenced, but
+        # an early stop saves the churn)
+        self._stop_continuous()
         if self._precommitted_epoch != self._batch_id:
             # discarded stage: drop the failed epoch's staged output.
             # NEVER for a pre-committed epoch — its pending record means
@@ -810,9 +835,78 @@ class StreamingQuery:
     def _run_epoch(self, batch: pa.Table, epoch: int):
         if self._stateful:
             return self._process_stateful(batch, epoch)
+        if not self._cont_disabled:
+            result = self._continuous_interval(
+                lambda t: _substitute_source(self._plan,
+                                             self._source_name,
+                                             sp.LocalRelation(t)),
+                batch, epoch)
+            if result is not None:
+                return result
         bound = _substitute_source(self._plan, self._source_name,
                                    sp.LocalRelation(batch))
         return self._execute_plan(bound, epoch)
+
+    # -- continuous record-at-a-time mode --------------------------------
+    def _continuous_interval(self, make_bound, batch: pa.Table,
+                             epoch: int) -> Optional[pa.Table]:
+        """Run one marker interval through the long-lived pipeline.
+        ``make_bound(table)`` binds the query with ``table`` as the
+        source slice — called once with an EMPTY placeholder to build
+        the resident pipeline, after which per-trigger record batches
+        stream through it and only the marker (= the epoch id) rides
+        the trigger. None = not eligible: the epoch path executes this
+        trigger (and every later one; eligibility is structural)."""
+        from .exec import continuous as cont
+        if self._cont_runner is None:
+            placeholder = batch.schema.empty_table()
+            try:
+                node = self._session._resolve(make_bound(placeholder))
+            except Exception:  # noqa: BLE001 — resolve errors surface
+                # on the epoch path with their usual diagnostics
+                self._cont_disabled = True
+                return None
+            node, found = cont.mark_stream_scans(node, placeholder)
+            if not found:
+                self._cont_disabled = True
+                return None
+            from .config import get as config_get
+            try:
+                nparts = int(config_get("cluster.shuffle_partitions",
+                                        0) or 0)
+            except (TypeError, ValueError):
+                nparts = 0
+            if nparts <= 0:
+                nparts = max(1, len(self._cluster.workers))
+            runner = cont.ContinuousJobRunner(
+                self._cluster, node, nparts,
+                job_id=self._cluster_job_id,
+                tenant=self._session.tenant)
+            if runner.graph is None:
+                self._cont_disabled = True
+                return None
+            if not runner.start():
+                runner.stop()
+                if runner.failed and \
+                        runner.failed.startswith("admission shed"):
+                    # typed + retryable, matching the batch admission
+                    # contract: the pipeline never started, nothing ran
+                    from .exec.admission import ResourceExhausted
+                    raise ResourceExhausted(runner.failed,
+                                            tenant=self._session.tenant,
+                                            retry_after_ms=1000)
+                raise RuntimeError(
+                    f"continuous pipeline failed to start: "
+                    f"{runner.failed}")
+            self._cont_runner = runner
+        try:
+            return self._cont_runner.run_interval(epoch, batch)
+        except Exception:
+            # a failed interval kills this pipeline incarnation: the
+            # restarted query (or next start) relaunches every stage
+            # from the last sealed marker under a new generation
+            self._stop_continuous()
+            raise
 
     def _execute_plan(self, bound: sp.QueryPlan, epoch: int):
         if self._cluster is not None:
@@ -884,7 +978,15 @@ class StreamingQuery:
             except Exception:  # noqa: BLE001 — bind failure: no eviction
                 self._wm_agg_supported = False
                 delta_plan, _ = self._delta_plan(batch)
-        delta = self._execute_plan(delta_plan, epoch)
+        delta = None
+        if not self._cont_disabled:
+            # the per-epoch delta aggregate runs through the resident
+            # pipeline: record batches stream partial aggregates
+            # between markers, and the store folds the interval delta
+            delta = self._continuous_interval(
+                lambda t: self._delta_plan(t)[0], batch, epoch)
+        if delta is None:
+            delta = self._execute_plan(delta_plan, epoch)
         changed = self._store.merge_delta(delta)
         if self._watermark is not None:
             self._advance_watermark(batch)
@@ -908,7 +1010,14 @@ class StreamingQuery:
             self._store.clear_dirty()
         bound = ss.substitute_node(self._plan, self._agg_spec.agg,
                                    sp.LocalRelation(emit))
-        result = self._execute_plan(bound, epoch)
+        if self._cont_runner is not None:
+            # continuous mode: the residual plan over the emitted state
+            # is driver-local work — a per-trigger job dispatch here
+            # would reintroduce exactly the latency floor the resident
+            # pipeline removed
+            result = self._session._execute_query(bound)
+        else:
+            result = self._execute_plan(bound, epoch)
         self._prev_result = result
         return result
 

@@ -111,6 +111,36 @@ def main():
     hb = _message(fdp, "HeartbeatRequest")
     changed |= _add_field(hb, "metrics_json", 3, F.TYPE_STRING)
 
+    # continuous record-at-a-time streaming: long-lived stage tasks
+    # (TaskDefinition.continuous_json carries the resident-task wiring)
+    # and the sequenced, credit-based PushRecords data plane with
+    # mid-flight markers and attempt fencing. report_seq numbers a
+    # resident task's periodic event flushes (non-terminal "running"
+    # reports) so at-least-once delivery dedupes exactly-once.
+    changed |= _add_field(task, "continuous_json", 14, F.TYPE_STRING)
+    changed |= _add_field(report, "report_seq", 15, F.TYPE_UINT64)
+    push_req, fresh = _add_message(fdp, "PushRecordsRequest")
+    if fresh:
+        _add_field(push_req, "job_id", 1, F.TYPE_STRING)
+        _add_field(push_req, "src_stage", 2, F.TYPE_SINT32)
+        _add_field(push_req, "src_partition", 3, F.TYPE_SINT32)
+        _add_field(push_req, "dst_stage", 4, F.TYPE_SINT32)
+        _add_field(push_req, "dst_partition", 5, F.TYPE_SINT32)
+        _add_field(push_req, "channel", 6, F.TYPE_SINT32)
+        _add_field(push_req, "seq", 7, F.TYPE_UINT64)
+        _add_field(push_req, "attempt", 8, F.TYPE_UINT32)
+        _add_field(push_req, "kind", 9, F.TYPE_STRING)
+        _add_field(push_req, "marker", 10, F.TYPE_UINT64)
+        _add_field(push_req, "data", 11, F.TYPE_BYTES)
+        changed = True
+    push_resp, fresh = _add_message(fdp, "PushRecordsResponse")
+    if fresh:
+        _add_field(push_resp, "accepted", 1, F.TYPE_BOOL)
+        _add_field(push_resp, "reason", 2, F.TYPE_STRING)
+        _add_field(push_resp, "credit", 3, F.TYPE_SINT64)
+        _add_field(push_resp, "retry_after_ms", 4, F.TYPE_UINT32)
+        changed = True
+
     if not changed:
         print("pb2 already up to date")
         return

@@ -8,7 +8,10 @@ Usage:
 
 Reconstructs each query's run from the append-only event log alone —
 stage/task Gantt timeline, the decision sequence (adaptive rewrites,
-speculation, eviction/quarantine, streaming epochs), and the
+speculation, eviction/quarantine, streaming epochs), continuous-mode
+marker progress (inject→mid-flight-align latency per marker, buffered
+alignment bytes, credit-backpressure stalls — with stalls also charged
+as a `credit-stall` category in the critical path), and the
 critical-path attribution — with no access to the live process. The
 reconstruction is the SAME computation the live profile runs
 (sail_tpu/analysis/timeline.py), so for a fixed fault seed the replayed

@@ -157,6 +157,76 @@ def test_drr_trickle_heavy_jobs_still_pay_their_cost(monkeypatch):
     assert order.count("a") == 1, order
 
 
+def test_resident_job_recharge_prevents_batch_starvation(monkeypatch):
+    """ISSUE 15 satellite: a continuous job's DRR accounting used to
+    charge stage-launch opportunities once at admit and then occupy
+    workers forever. With resident re-charging, the occupying tenant
+    keeps paying per interval, so a competing batch tenant wins the
+    next admissions instead of alternating as if the resident job were
+    free."""
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS_TOTAL", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS", "0")
+    monkeypatch.setenv("SAIL_ADMISSION__RESIDENT_RECHARGE_SECS", "5")
+
+    def order_with(resident: bool):
+        q = JobAdmissionQueue()
+        t0 = time.time()
+        if resident:
+            # tenant a holds a 4-task continuous pipeline; 2 recharge
+            # intervals elapse while NOBODY else is backlogged — idle
+            # occupancy is free (no one was displaced), so no debt
+            q.note_resident("cont-a", "a", cost=4)
+            q._resident["cont-a"][2] = t0 - 21.0
+            assert q.recharge(t0 - 11.0) == 0
+        order = []
+        for i in range(3):
+            for t in ("a", "b"):
+                q.offer(_stub_job(f"{t}{i}", t))
+        if resident:
+            # with tenant b now backlogged, the elapsed intervals
+            # charge a's deficit (2 x 5s intervals since the idle
+            # consumption advanced the cursor)
+            assert q.recharge(t0) == 2
+        while True:
+            admitted = q.drain()
+            if not admitted:
+                break
+            order.append(admitted[0].tenant)
+            q.release(admitted[0])
+        return order, q
+
+    # without the resident job, equal weights alternate (a wins ties)
+    base, _ = order_with(resident=False)
+    assert base[0] == "a"
+    # with tenant a's resident occupancy recharged, b runs first and a
+    # only re-enters once its debt is paid down by per-drain credits
+    charged, q = order_with(resident=True)
+    assert charged[0] == "b", charged
+    assert charged.count("a") == 3 and charged.count("b") == 3
+    # release stops further charging
+    q.release_resident("cont-a")
+    assert q.recharge(time.time() + 100.0) == 0
+
+
+def test_resident_job_occupies_a_concurrency_slot(monkeypatch):
+    """A continuous pipeline admits through the same caps as a batch
+    job: a tenant at its concurrent-job cap cannot grab every worker
+    with resident tasks, and releasing the pipeline frees the slot."""
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS", "1")
+    q = JobAdmissionQueue()
+    assert q.admit_resident("cont-1", "a")
+    assert not q.admit_resident("cont-2", "a"), \
+        "second resident pipeline dodged the tenant job cap"
+    assert q.admit_resident("cont-3", "b")  # other tenants unaffected
+    # the occupied slot also blocks the tenant's BATCH jobs until the
+    # pipeline releases
+    j = _stub_job("a-batch", "a")
+    q.offer(j)
+    assert q.drain() == []
+    q.release_resident("cont-1")
+    assert [x.job_id for x in q.drain()] == ["a-batch"]
+
+
 def test_session_gate_idle_tenant_cannot_bank_credit(monkeypatch):
     """A tenant joining the contest after another tenant ran alone for
     a while is floored to the global virtual clock: it must not win

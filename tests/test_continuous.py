@@ -1,0 +1,472 @@
+"""Continuous record-at-a-time streaming (exec/continuous.py).
+
+Units: sequenced credit-based channels (backpressure, duplicate
+suppression, zombie-attempt fencing), mid-flight marker alignment with
+skewed input rates and spill-backed buffering, fragment streamability
+analysis, and the timeline replay's marker/credit-stall views.
+
+Integration (LocalCluster): continuous-mode results match the epoch
+path row-for-row for stateless, join, and aggregate shapes; the
+flight recorder carries marker/resident events; backpressure is
+observable end to end under a tiny credit.
+"""
+
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from sail_tpu import SparkSession, events, faults
+from sail_tpu.exec import continuous as cont
+from sail_tpu.session import DataFrame
+from sail_tpu.streaming import ReplayableMemorySource, _StreamRead
+
+SCHEMA = pa.schema([("k", pa.int64()), ("v", pa.int64())])
+
+
+@pytest.fixture()
+def spark():
+    return SparkSession({})
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _table(vals):
+    return pa.table({"k": pa.array([v % 8 for v in vals],
+                                   type=pa.int64()),
+                     "v": pa.array(vals, type=pa.int64())},
+                    schema=SCHEMA)
+
+
+def _blob(vals):
+    from sail_tpu.exec import shuffle as sh
+    return sh.encode_table(_table(vals))
+
+
+# ---------------------------------------------------------------------------
+# unit: credit-based sequenced channels
+# ---------------------------------------------------------------------------
+
+def test_credit_inbox_bounds_in_flight_bytes_and_releases():
+    cond = threading.Condition()
+    blob = _blob(list(range(64)))
+    inbox = cont.CreditInbox(attempt=1, credit_bytes=len(blob) + 10,
+                             cond=cond)
+    assert inbox.offer(1, 0, "batch", 0, blob) == "ok"
+    # a second batch would exceed the bound: refused, sender stalls —
+    # this refusal is the backpressure signal
+    assert inbox.offer(1, 1, "batch", 0, blob) == "credit"
+    # an oversized first entry always admits (progress guarantee) but
+    # the NEXT offer is then refused
+    with cond:
+        assert inbox.pop().seq == 0
+    assert inbox.offer(1, 1, "batch", 0, blob) == "ok"
+    # duplicate (at-least-once retransmission): acknowledged, not
+    # re-enqueued
+    assert inbox.offer(1, 1, "batch", 0, blob) == "dup"
+    # a gap is refused so the sender re-sends in order
+    assert inbox.offer(1, 5, "batch", 0, blob) == "ahead"
+
+
+def test_credit_inbox_fences_zombie_attempts():
+    cond = threading.Condition()
+    inbox = cont.CreditInbox(attempt=2, credit_bytes=1 << 20, cond=cond)
+    # a stale generation (a zombie task relaunched away) is refused
+    assert inbox.offer(1, 0, "batch", 0, b"x") == "fenced"
+    assert inbox.offer(2, 0, "batch", 0, b"x") == "ok"
+    # a NEWER generation is refused "unready" — inboxes are
+    # generation-pinned, only the relaunched task's FRESH inbox may
+    # accept (an old inbox acknowledging new-generation entries would
+    # lose them when the task is replaced, leaving the sender
+    # permanently ahead of the fresh stream)
+    assert inbox.offer(3, 0, "batch", 0, b"y") == "unready"
+    fresh = cont.CreditInbox(attempt=3, credit_bytes=1 << 20, cond=cond)
+    assert fresh.offer(3, 0, "batch", 0, b"y") == "ok"
+    assert fresh.offer(2, 0, "batch", 0, b"x") == "fenced"
+    with cond:
+        entry = fresh.pop()
+    assert entry.data == b"y" and entry.seq == 0
+
+
+# ---------------------------------------------------------------------------
+# unit: mid-flight marker alignment
+# ---------------------------------------------------------------------------
+
+def test_marker_alignment_buffers_fast_input_until_sibling():
+    """Skewed input rates: input A races ahead through marker 1 and
+    keeps streaming interval-2 batches; nothing aligns until B reaches
+    marker 1, and A's post-marker batches replay afterwards in order."""
+    a, b = (0, 0), (0, 1)
+    ai = cont.AlignedInput([a, b], attempt=1,
+                           credit_bytes=1 << 20,
+                           align_buffer_bytes=1 << 20)
+    assert ai.offer(a, 1, 0, "batch", 0, _blob([1])) == "ok"
+    assert ai.offer(a, 1, 1, "marker", 1, b"") == "ok"
+    assert ai.offer(a, 1, 2, "batch", 0, _blob([2])) == "ok"
+    assert ai.offer(a, 1, 3, "batch", 0, _blob([3])) == "ok"
+    # A's pre-marker batch flows; then A is blocked and B has nothing
+    kind, key, entry = ai.next(timeout=0.5)
+    assert (kind, key) == ("batch", a)
+    assert ai.next(timeout=0.2) is None  # no alignment yet
+    # the blocked input's post-marker entries were drained into the
+    # align buffer, releasing their channel credit
+    assert ai.backlog_bytes() > 0
+    assert ai.offer(b, 1, 0, "batch", 0, _blob([10])) == "ok"
+    kind, key, entry = ai.next(timeout=0.5)
+    assert (kind, key) == ("batch", b)
+    assert ai.offer(b, 1, 1, "marker", 1, b"") == "ok"
+    kind, marker, stats = ai.next(timeout=0.5)
+    assert kind == "marker" and marker == 1
+    assert stats["wait_ms"] >= 0.0
+    assert stats["buffered_bytes"] > 0
+    # buffered interval-2 batches replay in sequence order
+    from sail_tpu.exec import shuffle as sh
+    kind, key, entry = ai.next(timeout=0.5)
+    assert (kind, key) == ("batch", a)
+    assert sh.decode_stream(entry.data).column("v").to_pylist() == [2]
+    kind, key, entry = ai.next(timeout=0.5)
+    assert sh.decode_stream(entry.data).column("v").to_pylist() == [3]
+    ai.close()
+
+
+def test_align_buffer_spills_beyond_memory_bound():
+    """A tiny align buffer forces the blocked input's entries to spill
+    to disk; content survives the spill round trip bit-for-bit."""
+    a, b = (0, 0), (0, 1)
+    ai = cont.AlignedInput([a, b], attempt=1,
+                           credit_bytes=1 << 20,
+                           align_buffer_bytes=64)
+    assert ai.offer(a, 1, 0, "marker", 1, b"") == "ok"
+    blobs = [_blob(list(range(i * 10, i * 10 + 10))) for i in range(4)]
+    for i, blob in enumerate(blobs):
+        assert ai.offer(a, 1, i + 1, "batch", 0, blob) == "ok"
+    assert ai.next(timeout=0.3) is None  # drains A into the buffer
+    assert sum(buf.spill_count
+               for buf in ai._buffers.values()) > 0, \
+        "expected the bounded buffer to spill"
+    assert ai.offer(b, 1, 0, "marker", 1, b"") == "ok"
+    kind, marker, _stats = ai.next(timeout=0.5)
+    assert (kind, marker) == ("marker", 1)
+    from sail_tpu.exec import shuffle as sh
+    got = []
+    for _ in blobs:
+        kind, key, entry = ai.next(timeout=0.5)
+        assert (kind, key) == ("batch", a)
+        got.append(sh.decode_stream(entry.data))
+    want = [sh.decode_stream(blob) for blob in blobs]
+    for g, w in zip(got, want):
+        assert g.equals(w)
+    ai.close()
+
+
+def test_broadcast_state_input_primes_before_stream_flows():
+    """Stream batches hold until the broadcast build side delivers its
+    startup push — joining against a half-arrived build would silently
+    drop rows."""
+    stream, build = (0, 0), (1, 0)
+    ai = cont.AlignedInput([stream, build], state_keys={build},
+                           attempt=1, credit_bytes=1 << 20,
+                           align_buffer_bytes=1 << 20)
+    assert ai.offer(stream, 1, 0, "batch", 0, _blob([1])) == "ok"
+    assert ai.next(timeout=0.2) is None, \
+        "stream flowed before the build primed"
+    assert ai.offer(build, 1, 0, "batch", 0, _blob([7])) == "ok"
+    kind, key, _ = ai.next(timeout=0.5)
+    assert (kind, key) == ("state", build)
+    kind, key, _ = ai.next(timeout=0.5)
+    assert (kind, key) == ("batch", stream)
+    ai.close()
+
+
+# ---------------------------------------------------------------------------
+# unit: fragment streamability
+# ---------------------------------------------------------------------------
+
+def test_streamable_fragment_analysis(spark):
+    import dataclasses
+
+    from sail_tpu.exec import job_graph as jg
+    from sail_tpu.plan import nodes as pn
+    from sail_tpu.spec import plan as sp
+    from sail_tpu.streaming import _substitute_source
+
+    placeholder = SCHEMA.empty_table()
+    src = ReplayableMemorySource(SCHEMA)
+    df = DataFrame(_StreamRead("sf", src), spark).filter("v > 1")
+    node = spark._resolve(_substitute_source(
+        df._plan, "sf", sp.LocalRelation(placeholder)))
+    node, found = cont.mark_stream_scans(node, placeholder)
+    assert found == 1
+    # a filter chain over the stream scan is per-batch streamable
+    assert cont.streamable_fragment(node, set(), is_producer=False)
+    # an aggregate on top only streams for a shuffle PRODUCER (its
+    # consumer merges the whole interval)
+    scan = cont._find_stream_scan(node)
+    agg = pn.AggregateExec(node, (0,), (), ("k",), None)
+    assert not cont.streamable_fragment(agg, set(), is_producer=False)
+    assert cont.streamable_fragment(agg, set(), is_producer=True)
+    # a join whose STREAMED side is the build (right) must accumulate
+    inp = jg.StageInputExec(tuple(scan.schema), 3)
+    static = dataclasses.replace(scan, format="memory",
+                                 source=placeholder)
+    probe_join = pn.JoinExec(static, inp, "inner", (), ())
+    assert not cont.streamable_fragment(probe_join, {3},
+                                        is_producer=False)
+
+
+# ---------------------------------------------------------------------------
+# unit: timeline replay of marker progress + credit stalls
+# ---------------------------------------------------------------------------
+
+def test_timeline_renders_marker_progress_and_credit_stalls():
+    from sail_tpu.analysis import timeline
+
+    t0 = 1000.0
+    evs = [
+        {"type": "marker_inject", "query_id": "q", "job_id": "j",
+         "marker": 0, "ts": t0},
+        {"type": "backpressure", "query_id": "q", "job_id": "j",
+         "stage": 1, "partition": 0, "channel": -1, "stall_ms": 12.5,
+         "ts": t0 + 0.01},
+        {"type": "marker_align", "query_id": "q", "job_id": "j",
+         "stage": 1, "partition": 0, "marker": 0, "wait_ms": 3.0,
+         "buffered_bytes": 256, "ts": t0 + 0.05},
+        {"type": "marker_inject", "query_id": "q", "job_id": "j",
+         "marker": 1, "ts": t0 + 1.0},
+        {"type": "marker_align", "query_id": "q", "job_id": "j",
+         "stage": 1, "partition": 0, "marker": 1, "wait_ms": 0.5,
+         "buffered_bytes": 0, "ts": t0 + 1.02},
+    ]
+    prog = timeline.continuous_progress(evs, "q")
+    assert [m["marker"] for m in prog] == [0, 1]
+    assert prog[0]["align_ms"] == pytest.approx(50.0, abs=1.0)
+    assert prog[0]["stall_ms"] == pytest.approx(12.5)
+    assert prog[0]["aligns"][0]["buffered_bytes"] == 256
+    assert prog[1]["stall_ms"] == 0.0
+    text = timeline.render_timeline(evs, "q")
+    assert "markers (2)" in text and "credit stalls" in text
+    # credit stalls are a critical-path category: a task window holding
+    # a stamped backpressure event charges credit-stall, not compute
+    evs2 = [
+        {"type": "task_dispatch", "query_id": "q", "job_id": "j",
+         "stage": 0, "partition": 0, "attempt": 0, "worker": "w",
+         "reason": "", "ts": t0},
+        {"type": "task_start", "query_id": "q", "job_id": "j",
+         "stage": 0, "partition": 0, "attempt": 0, "worker": "w",
+         "tenant": "t", "ts": t0 + 0.01},
+        {"type": "backpressure", "query_id": "q", "job_id": "j",
+         "stage": 1, "partition": 0, "channel": -1, "stall_ms": 40.0,
+         "task": "j/s0p0a0", "ts": t0 + 0.05},
+        {"type": "task_finish", "query_id": "q", "job_id": "j",
+         "stage": 0, "partition": 0, "attempt": 0, "worker": "w",
+         "state": "succeeded", "rows": 1, "fetch_wait_ms": 0.0,
+         "error": "", "ts": t0 + 0.11},
+    ]
+    cp = timeline.critical_path(evs2, "q")
+    assert cp is not None
+    assert cp["categories"].get("credit-stall") == pytest.approx(
+        40.0, abs=0.1)
+
+
+# ---------------------------------------------------------------------------
+# integration: continuous pipeline on a LocalCluster
+# ---------------------------------------------------------------------------
+
+def _batches(n=3, rows=40):
+    out = []
+    for e in range(n):
+        ks = [(e * 31 + i) % 8 for i in range(rows)]
+        vs = [e * 1000 + i for i in range(rows)]
+        out.append(pa.table({"k": pa.array(ks, type=pa.int64()),
+                             "v": pa.array(vs, type=pa.int64())},
+                            schema=SCHEMA))
+    return out
+
+
+def _run_query(spark, cluster, shape, batches, mode="append"):
+    src = ReplayableMemorySource(SCHEMA)
+    df = shape(DataFrame(_StreamRead("cq", src), spark))
+    emitted = []
+    q = (df.writeStream.outputMode(mode)
+         .foreachBatch(lambda bdf, bid: emitted.append(
+             (bid, bdf.toPandas())))
+         .cluster(cluster).start())
+    try:
+        for b in batches:
+            src.add(b)
+            q.processAllAvailable()
+        engaged = q._cont_runner is not None
+    finally:
+        q.stop()
+    return emitted, engaged
+
+
+def _canon(pdf):
+    cols = list(pdf.columns)
+    return pdf.sort_values(cols).reset_index(drop=True)
+
+
+@pytest.mark.parametrize("shape,mode", [
+    (lambda df: df.filter("v % 2 = 0"), "append"),
+    (lambda df: df.groupBy("k").sum("v"), "complete"),
+    (lambda df: df.groupBy().sum("v"), "complete"),
+], ids=["stateless-filter", "grouped-sum", "global-sum"])
+def test_continuous_matches_epoch_results(spark, monkeypatch, shape,
+                                          mode):
+    """Continuous mode commits the same per-interval rows as the epoch
+    path (row-set equality per epoch: batch slicing through the
+    pipeline may reorder rows within an interval, never change them)."""
+    from sail_tpu.exec.cluster import LocalCluster
+
+    batches = _batches()
+    monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__ENABLED", "0")
+    c = LocalCluster(num_workers=2)
+    try:
+        epoch_out, engaged = _run_query(spark, c, shape, batches, mode)
+        assert not engaged
+    finally:
+        c.stop()
+    monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__ENABLED", "1")
+    c = LocalCluster(num_workers=2)
+    try:
+        cont_out, engaged = _run_query(spark, c, shape, batches, mode)
+        assert engaged, "continuous mode did not engage"
+    finally:
+        c.stop()
+    assert len(cont_out) == len(epoch_out) == len(batches)
+    for (eid, epdf), (cid, cpdf) in zip(epoch_out, cont_out):
+        assert eid == cid
+        assert _canon(epdf).equals(_canon(cpdf)), \
+            f"epoch {eid} differs between continuous and epoch paths"
+
+
+def test_continuous_emits_marker_and_resident_events(spark,
+                                                     monkeypatch):
+    """The flight recorder sees the pipeline: resident dispatch, marker
+    injection, and mid-flight alignment — replayable by the timeline."""
+    from sail_tpu.analysis import timeline
+    from sail_tpu.exec.cluster import LocalCluster
+
+    monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__ENABLED", "1")
+    events.EVENT_LOG.clear()
+    c = LocalCluster(num_workers=2)
+    try:
+        _out, engaged = _run_query(
+            spark, c, lambda df: df.filter("v >= 0"), _batches(2))
+        assert engaged
+    finally:
+        c.stop()
+    evs = events.events()
+    kinds = {e["type"] for e in evs}
+    assert "task_resident" in kinds
+    assert "marker_inject" in kinds
+    assert "marker_align" in kinds
+    markers = {e["marker"] for e in evs
+               if e["type"] == "marker_inject"}
+    assert markers == {0, 1}
+    qid = next(e["query_id"] for e in evs
+               if e["type"] == "marker_inject" and e.get("query_id"))
+    prog = timeline.continuous_progress(evs, qid)
+    assert prog and prog[0]["aligns"], \
+        "marker progress not reconstructable from the log"
+
+
+def test_continuous_backpressure_observable_under_tiny_credit(
+        spark, monkeypatch):
+    """A starved channel credit forces sender stalls: the run still
+    commits the right rows, and the stalls surface as backpressure
+    events + the credit-stall metric."""
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.metrics import REGISTRY
+
+    monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__ENABLED", "1")
+    monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__CHANNEL_CREDIT_KB",
+                       "1")
+    monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__MAX_BATCH_ROWS",
+                       "16")
+    events.EVENT_LOG.clear()
+
+    def stall_obs():
+        return sum(
+            row.get("count", 0)
+            for row in REGISTRY.snapshot()
+            if row["name"] == "streaming.continuous.credit_stall_time")
+
+    before = stall_obs()
+    batches = _batches(2, rows=400)
+    c = LocalCluster(num_workers=2)
+    try:
+        out, engaged = _run_query(
+            spark, c, lambda df: df.filter("v % 2 = 0"), batches)
+        assert engaged
+    finally:
+        c.stop()
+    got = sorted(v for _bid, pdf in out for v in pdf["v"])
+    want = sorted(v for b in batches
+                  for v in b.column("v").to_pylist() if v % 2 == 0)
+    assert got == want
+    stalled_events = [e for e in events.events()
+                      if e["type"] == "backpressure"]
+    assert stalled_events or stall_obs() > before, \
+        "tiny credit produced no observable backpressure"
+
+
+def test_zombie_generation_fenced_end_to_end(spark, monkeypatch):
+    """A push carrying a previous pipeline generation is refused by a
+    relaunched receiver (the exactly-once half of relaunch-from-the-
+    last-sealed-marker)."""
+    from sail_tpu.exec.cluster import _WORKER_SERVICE, LocalCluster
+    from sail_tpu.exec.proto import control_plane_pb2 as pb
+
+    monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__ENABLED", "1")
+    c = LocalCluster(num_workers=2)
+    try:
+        src = ReplayableMemorySource(SCHEMA)
+        df = DataFrame(_StreamRead("zq", src), spark).filter("v >= 0")
+        q = (df.writeStream.format("noop").cluster(c).start())
+        try:
+            src.add(_batches(1)[0])
+            q.processAllAvailable()
+            runner = q._cont_runner
+            assert runner is not None
+            leaf, addr = next(iter(runner._leaf_addrs.items()))
+            stale = pb.PushRecordsRequest(
+                job_id=runner.job_id, src_stage=cont.SOURCE_STAGE,
+                src_partition=0, dst_stage=leaf[0],
+                dst_partition=leaf[1], channel=-1, seq=0,
+                attempt=runner.generation - 1, kind="batch", marker=0,
+                data=_blob([1]))
+            with pytest.raises(cont.Fenced):
+                cont.push_entry(addr, _WORKER_SERVICE, stale)
+        finally:
+            q.stop()
+    finally:
+        c.stop()
+
+
+def test_continuous_off_is_default_and_inert(spark):
+    """Without the gate, a cluster streaming query never touches the
+    continuous machinery — the epoch path runs exactly as before."""
+    from sail_tpu.exec.cluster import LocalCluster
+
+    c = LocalCluster(num_workers=2)
+    try:
+        src = ReplayableMemorySource(SCHEMA)
+        df = DataFrame(_StreamRead("dq", src), spark).filter("v >= 0")
+        q = df.writeStream.format("noop").cluster(c).start()
+        try:
+            assert q._cont_disabled
+            src.add(_batches(1)[0])
+            q.processAllAvailable()
+            assert q._cont_runner is None
+            assert not c.driver.continuous
+        finally:
+            q.stop()
+    finally:
+        c.stop()

@@ -742,6 +742,122 @@ def _drive_cluster(spark, cluster, batches, out_dir, ckpt, spec=None,
                   len(batches), spec=spec, seed=seed)
 
 
+def test_continuous_off_bit_identical_to_epoch_path(spark,
+                                                    monkeypatch):
+    """ISSUE 15 gate integrity: with ``streaming.continuous.enabled``
+    explicitly OFF, a cluster streaming query's results are
+    byte-identical to a run with the key entirely absent (the epoch
+    path), across the 5 aggregate shapes — the gate must be inert, not
+    merely similar."""
+    from sail_tpu.exec.cluster import LocalCluster
+
+    batches = _batches(2, rows=30)
+
+    def run(shape, env_value):
+        if env_value is None:
+            monkeypatch.delenv("SAIL_STREAMING__CONTINUOUS__ENABLED",
+                               raising=False)
+        else:
+            monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__ENABLED",
+                               env_value)
+        src = MemoryStreamSource(SCHEMA)
+        df = STATEFUL_SHAPES[shape](DataFrame(_StreamRead("bsrc", src),
+                                              spark))
+        q = (df.writeStream.outputMode("complete").format("noop")
+             .cluster(cluster).start())
+        try:
+            for b in batches:
+                src.add(b)
+                q.processAllAvailable()
+            assert q._cont_runner is None
+            return q._prev_result
+        finally:
+            q.stop()
+
+    cluster = LocalCluster(num_workers=2)
+    try:
+        for shape in sorted(STATEFUL_SHAPES):
+            off = run(shape, "0")
+            absent = run(shape, None)
+            assert off.equals(absent), \
+                f"{shape}: continuous-off differs from the epoch path"
+    finally:
+        cluster.stop()
+
+
+CONTINUOUS_CRASH_POINTS = {
+    # the sink dies between markers: the pre-commit/finalize recovery
+    # owns the staged interval, the pipeline relaunches after restart
+    "sink-kill": "streaming.sink:commit:e1=error#1",
+    # a worker crashes mid-push between two markers (it held
+    # aligned-but-uncommitted channel entries): heartbeat eviction
+    # fails the pipeline, which relaunches every stage from the last
+    # sealed marker under a new generation
+    "worker-crash": "shuffle.credit:s1*=crash#1",
+    # markers delayed in flight must only slow alignment, never break
+    # exactly-once
+    "marker-delay": "streaming.marker:*=delay(0.2)#3",
+    # a marker dropped at an align point fails the pipeline mid-flight;
+    # the restart re-runs the interval from the unadvanced offsets
+    "marker-drop": "streaming.marker:s*:m1=error#1",
+}
+
+
+@pytest.mark.parametrize("crash", sorted(CONTINUOUS_CRASH_POINTS))
+def test_continuous_chaos_exactly_once(spark, tmp_path, monkeypatch,
+                                       crash):
+    """The PR 9 chaos matrix extended to continuous mode: a failure at
+    ANY point between two markers — sink kill, worker crash holding
+    in-flight channel entries, marker delay/drop — and the restarted
+    run's total sink output is byte-identical to the fault-free
+    continuous run."""
+    from sail_tpu.exec.cluster import LocalCluster
+
+    monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__ENABLED", "1")
+    monkeypatch.setenv("SAIL_CLUSTER__WORKER_HEARTBEAT_TIMEOUT_SECS",
+                       "2")
+    batches = _batches(3, rows=60)
+
+    def run(tag, spec=None, seed=13):
+        out_dir = str(tmp_path / f"{tag}_out")
+        ckpt = str(tmp_path / f"{tag}_ckpt")
+        if spec:
+            faults.configure(spec, seed=seed)
+        cluster = LocalCluster(num_workers=2)
+        engaged = []
+
+        def make_query(fed):
+            src = ReplayableMemorySource(SCHEMA)
+            for b in batches[:fed]:
+                src.add(b)
+            df = DataFrame(_StreamRead("ccsrc", src), spark) \
+                .filter("v % 2 = 0")
+            q = (df.writeStream.format("parquet")
+                 .option("checkpointLocation", ckpt).cluster(cluster)
+                 .start(out_dir))
+            engaged.append(q)
+            return src, q
+
+        try:
+            restarts, counts = _drive(
+                make_query, lambda src, i: src.add(batches[i]),
+                len(batches), spec=spec, seed=seed)
+        finally:
+            cluster.stop()
+        assert any(q._cont_disabled is False for q in engaged)
+        return _read_parts(out_dir), restarts, counts
+
+    clean, r0, _ = run("clean")
+    assert r0 == 0 and len(clean) == 3
+    chaos, restarts, counts = run("chaos",
+                                  CONTINUOUS_CRASH_POINTS[crash])
+    site = CONTINUOUS_CRASH_POINTS[crash].split(":", 1)[0]
+    assert counts.get(site, 0) >= 1, f"{site} injection did not fire"
+    if crash != "marker-delay":
+        assert restarts >= 1, f"{crash} did not force a restart"
+    _assert_identical(chaos, clean)
+
+
 def test_cluster_epoch_aligned_exactly_once_chaos(spark, tmp_path,
                                                   monkeypatch):
     """The acceptance run: a streaming aggregate whose every trigger is
