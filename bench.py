@@ -335,6 +335,118 @@ def _run_clickbench(spark, n_rows: int = 100_000, budget_s: float = 180.0):
     return out
 
 
+def _result_cache_summary(enabled: bool) -> dict:
+    """Whole-run reuse-layer counters for the headline artifact."""
+    from sail_tpu import metrics as gm
+
+    def total(name):
+        return int(sum(r["value"] for r in gm.REGISTRY.snapshot()
+                       if r["name"] == name))
+
+    hits = total("execution.result_cache.hit_count")
+    misses = total("execution.result_cache.miss_count")
+    return {
+        "enabled": enabled,
+        "hit_count": hits,
+        "miss_count": misses,
+        "hit_ratio": round(hits / (hits + misses), 3)
+        if hits + misses else 0.0,
+        "bytes_served": total("execution.result_cache.bytes_served"),
+        "evicted_count": total("execution.result_cache.evicted_count"),
+        "invalidated_count": total(
+            "execution.result_cache.invalidated_count"),
+        "scan_share_attached": total("execution.scan_share.attached_count"),
+        "decode_passes_saved": total(
+            "execution.scan_share.decode_passes_saved"),
+    }
+
+
+def _run_cache_bench(spark, k: int) -> dict:
+    """SAIL_BENCH_CACHE=K: dashboard-replay artifact. The 43 ClickBench
+    queries against one parquet-backed hits table, replayed by K
+    concurrent sessions. Leg 1 (cold) is one session's first pass —
+    real decode + compute. Leg 2 (warm) is all K sessions replaying the
+    same pass concurrently, served from the result cache. Records the
+    cold/warm wall-clock split, result-cache hit ratio, and decode
+    passes saved by concurrent-scan sharing; acceptance is warm
+    per-session latency roughly constant in K."""
+    import shutil
+    import tempfile
+    import threading
+
+    import pyarrow.parquet as pq
+
+    from sail_tpu import SparkSession
+    from sail_tpu import metrics as gm
+    from sail_tpu.benchmarks.clickbench import generate_hits, load_queries
+
+    def total(name):
+        return sum(r["value"] for r in gm.REGISTRY.snapshot()
+                   if r["name"] == name)
+
+    n_rows = int(os.environ.get("SAIL_BENCH_CACHE_ROWS", "100000"))
+    queries = load_queries()
+    tmp = tempfile.mkdtemp(prefix="sail-cache-bench-")
+    try:
+        d = os.path.join(tmp, "hits")
+        os.makedirs(d)
+        pq.write_table(generate_hits(n_rows),
+                       os.path.join(d, "part0.parquet"))
+        # path-backed scans: every session fingerprints to the same
+        # result keys, so the warm leg is cross-session reuse
+        sessions = [SparkSession({}) for _ in range(k)]
+        for s in sessions:
+            s.read.parquet(d).createOrReplaceTempView("hits")
+
+        def run_pass(s):
+            t0 = time.perf_counter()
+            errors = 0
+            for sql_q in queries:
+                try:
+                    s.sql(sql_q).toArrow()
+                except Exception:  # noqa: BLE001 — a failed query is data
+                    errors += 1
+            return time.perf_counter() - t0, errors
+
+        h0, m0 = total("execution.result_cache.hit_count"), \
+            total("execution.result_cache.miss_count")
+        saved0 = total("execution.scan_share.decode_passes_saved")
+        cold_s, cold_errors = run_pass(sessions[0])
+
+        warm_s = [None] * k
+
+        def warm(i):
+            warm_s[i], _ = run_pass(sessions[i])
+
+        threads = [threading.Thread(target=warm, args=(i,))
+                   for i in range(k)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        warm_wall = time.perf_counter() - t0
+        hits = total("execution.result_cache.hit_count") - h0
+        misses = total("execution.result_cache.miss_count") - m0
+        return {
+            "sessions": k,
+            "queries": len(queries),
+            "rows": n_rows,
+            "cold_seconds": round(cold_s, 4),
+            "cold_errors": cold_errors,
+            "warm_wall_seconds": round(warm_wall, 4),
+            "warm_session_seconds": [round(s, 4) for s in warm_s],
+            "warm_session_max": round(max(warm_s), 4),
+            "hit_ratio": round(hits / (hits + misses), 3)
+            if hits + misses else 0.0,
+            "decode_passes_saved": int(
+                total("execution.scan_share.decode_passes_saved")
+                - saved0),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _run_chaos(spark) -> dict:
     """SAIL_BENCH_CHAOS=1: run one TPC-H query through the local
     cluster twice — clean, then under a fixed fault seed (one dropped
@@ -1253,6 +1365,15 @@ def main():
     if disable_fusion:
         spark.conf.set("spark.sail.execution.fusion.enabled", "false")
         os.environ["SAIL_EXECUTION__FUSION__ENABLED"] = "false"
+    # A/B knob: SAIL_BENCH_DISABLE_RESULT_CACHE=1 turns the
+    # result/fragment reuse layer and concurrent-scan sharing off for
+    # the whole run, so warm dashboard-replay artifacts compare
+    # directly against the recompute-everything control
+    disable_result_cache = _env_on("SAIL_BENCH_DISABLE_RESULT_CACHE")
+    if disable_result_cache:
+        spark.conf.set("spark.sail.cache.result.enabled", "false")
+        os.environ["SAIL_CACHE__RESULT__ENABLED"] = "false"
+        os.environ["SAIL_CACHE__SCAN_SHARE__ENABLED"] = "false"
     # A/B knob: SAIL_BENCH_DISABLE_SHUFFLE_COMPRESSION=1 turns the
     # shuffle wire+spill codec off for the whole run (the cluster data
     # plane reads the app-config/env layer, not the session conf)
@@ -1448,6 +1569,19 @@ def main():
             result["saturation"] = _run_saturation(spark, n_tenants)
         except Exception as e:  # noqa: BLE001
             result["saturation_error"] = f"{type(e).__name__}: {e}"
+    # dashboard-replay cache artifact: SAIL_BENCH_CACHE=K sessions
+    # replay the ClickBench suite warm vs cold (result-cache A/B via
+    # SAIL_BENCH_DISABLE_RESULT_CACHE=1 above)
+    n_cache_sessions = int(os.environ.get("SAIL_BENCH_CACHE", "0"))
+    if n_cache_sessions > 0:
+        try:
+            result["cache_bench"] = _run_cache_bench(spark,
+                                                     n_cache_sessions)
+        except Exception as e:  # noqa: BLE001
+            result["cache_bench_error"] = f"{type(e).__name__}: {e}"
+    # whole-run reuse-layer counters ride every artifact
+    result["result_cache"] = _result_cache_summary(
+        not disable_result_cache)
     if obs_stop is not None:
         obs_stop.set()
         # final scrape sanity: the exposition must still parse as

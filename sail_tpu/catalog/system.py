@@ -335,6 +335,45 @@ class SystemRegistry:
                     "rows_out": pa.array(
                         [r["rows_out"] for r in rows], pa.int64()),
                 })
+            if (database, name) == ("telemetry", "result_cache"):
+                from ..exec.result_cache import (FRAGMENT_CACHE,
+                                                 RESULT_CACHE, VIEWS)
+                rows = RESULT_CACHE.snapshot() + FRAGMENT_CACHE.snapshot()
+                for vname in VIEWS.names():
+                    view = VIEWS.get(vname)
+                    if view is None:
+                        continue
+                    data = view.entry.data
+                    rows.append({
+                        "tier": "view", "id": f"mv-{vname}",
+                        "key": vname, "tables": sorted(view.depends),
+                        "bytes": int(getattr(data, "nbytes", 0) or 0),
+                        "rows": int(getattr(data, "num_rows", 0) or 0),
+                        "hit_count": view.marker,
+                        "cost_ms": 0.0, "versions": "",
+                        "last_access": 0.0})
+                import json as _json
+                return pa.table({
+                    "tier": pa.array([r["tier"] for r in rows]),
+                    "id": pa.array([r["id"] for r in rows]),
+                    "key": pa.array([str(r["key"]) for r in rows]),
+                    "tables": pa.array(
+                        [",".join(r["tables"]) for r in rows]),
+                    "bytes": pa.array(
+                        [r["bytes"] for r in rows], pa.int64()),
+                    "rows": pa.array(
+                        [r["rows"] for r in rows], pa.int64()),
+                    "hit_count": pa.array(
+                        [r["hit_count"] for r in rows], pa.int64()),
+                    "cost_ms": pa.array(
+                        [float(r["cost_ms"]) for r in rows],
+                        pa.float64()),
+                    "table_versions": pa.array(
+                        [_json.dumps(r["versions"], default=str)
+                         for r in rows]),
+                    "last_access": pa.array(
+                        [r["last_access"] for r in rows], pa.float64()),
+                })
             if (database, name) == ("cluster", "workers"):
                 rows = list(self.workers.values())
                 return pa.table({

@@ -390,7 +390,6 @@ def _runtime_cache_size(key: str, default: int) -> int:
 
 _RUNTIME_CACHE_SIZES: Dict[str, int] = {}
 _OP_CACHE = _OpCache()
-_SCAN_CACHE: Dict = {}
 # runtime join filters: join-structure key → last observed prune ratio
 # (scan + probe pruning over probed rows); joins whose filters proved
 # useless skip the build on later executions (adaptive)
@@ -427,9 +426,11 @@ class _Rtf(NamedTuple):
 
 
 def clear_caches():
+    from . import result_cache
     _OP_CACHE.entries.clear()
-    _SCAN_CACHE.clear()
     _RTF_HISTORY.clear()
+    _RUNTIME_CACHE_SIZES.clear()
+    result_cache.clear_all()
 
 
 class LocalExecutor:
@@ -441,6 +442,9 @@ class LocalExecutor:
         self._rtf_scan_stats: Dict[int, Tuple[int, int]] = {}
         # whole-stage fusion gate, resolved once per executor
         self._fusion: Optional[bool] = None
+        # concurrent-scan sharing (enabled, wait_timeout_s), resolved
+        # once per executor (io/prefetch.scan_share_conf)
+        self._scan_share_conf: Optional[Tuple[bool, float]] = None
         # persistent compiled-program cache gate (exec/pcache.py)
         self._pcache: Optional[bool] = None
         # per-stage backend routing decisions of the current plan
@@ -669,7 +673,7 @@ class LocalExecutor:
     # leaves
     # ------------------------------------------------------------------
     def _exec_ScanExec(self, p: pn.ScanExec) -> HostBatch:
-        from ..io.formats import expand_paths, read_table
+        from ..io.formats import expand_paths
         import os
         if p.format == "python_ds":
             # user data source: read at EXECUTION, never cached — the
@@ -684,9 +688,13 @@ class LocalExecutor:
             if p.projection is not None:
                 table = table.select(list(p.projection))
             return _positional(ai.from_arrow(table))
+        from . import result_cache as rc
+        from .. import profiler
         rtf_preds = p.runtime_predicates
         if p.source is not None:
             cache_key = ("mem", id(p.source), p.projection, rtf_preds)
+            table_key = rc.memory_table_key(p.table_name) \
+                if p.table_name else None
         elif p.format == "delta":
             from ..lakehouse.delta import DeltaLog
             files = p.paths
@@ -694,6 +702,7 @@ class LocalExecutor:
                       tuple(sorted(dict(p.options).items())))
             cache_key = ("delta", files, mtimes, p.projection,
                          tuple((f.name, f.dtype) for f in p.schema))
+            table_key = p.paths[0] if p.paths else None
         else:
             try:
                 files = tuple(expand_paths(p.paths))
@@ -704,12 +713,88 @@ class LocalExecutor:
                          rtf_preds,
                          tuple(sorted(dict(p.options).items())),
                          tuple((f.name, f.dtype) for f in p.schema))
-        hit = _SCAN_CACHE.get(cache_key)
+            table_key = p.paths[0] if p.paths else None
+        hit = rc.FRAGMENT_CACHE.get(cache_key, p.source)
         if hit is not None:
-            src_ref, hb, rtf_stats = hit
-            if p.source is None or src_ref is p.source:
-                self._note_rtf_scan(p, rtf_stats)
-                return hb
+            self._note_rtf_scan(p, hit.rtf_stats)
+            profiler.note_result_cache(fragment=hit.fragment_id,
+                                       nbytes=hit.nbytes)
+            return hit.batch
+        # concurrent-scan sharing: a fragment miss races other queries
+        # admitted in the same window — one leader decodes, followers
+        # attach to the in-flight load instead of running N identical
+        # scans (followers fall back to a local decode on timeout)
+        leader, flight = False, None
+        share_enabled, share_timeout = self._scan_share()
+        if share_enabled:
+            from ..io.prefetch import SCAN_LOADS
+            leader, flight = SCAN_LOADS.begin(cache_key)
+            if not leader:
+                _record_metric("execution.scan_share.attached_count", 1)
+                try:
+                    ok, entry = flight.wait(share_timeout)
+                finally:
+                    SCAN_LOADS.detach(flight)
+                if ok and entry is not None and \
+                        (p.source is None or entry.source is p.source):
+                    _record_metric(
+                        "execution.scan_share.decode_passes_saved", 1)
+                    self._note_rtf_scan(p, entry.rtf_stats)
+                    profiler.note_result_cache(
+                        status="shared-scan", fragment=entry.fragment_id,
+                        nbytes=entry.nbytes, attached=1, saved=1)
+                    return entry.batch
+                flight = None
+        try:
+            hb = self._decode_scan(p, cache_key, table_key, files
+                                   if p.source is None else None,
+                                   flight if leader else None)
+            return hb
+        finally:
+            if leader and flight is not None:
+                from ..io.prefetch import SCAN_LOADS
+                SCAN_LOADS.finish(cache_key, flight)
+
+    def _decode_scan(self, p: pn.ScanExec, cache_key, table_key,
+                     files, flight) -> HostBatch:
+        """The actual decode/upload pass (fragment-cache fill). When a
+        ScanFlight is handed in, publishes the stored fragment to
+        attached followers — or the failure, which propagates."""
+        from . import result_cache as rc
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            hb, table, rtf_stats = self._decode_scan_table(p, files)
+        except BaseException as exc:
+            if flight is not None:
+                flight.fail(exc)
+            raise
+        self._note_rtf_scan(p, rtf_stats)
+        try:
+            nbytes = int(table.nbytes)
+        except Exception:  # noqa: BLE001 — size is advisory
+            nbytes = 0
+        entry = rc.FRAGMENT_CACHE.put(
+            cache_key, p.source, hb, rtf_stats, table_key=table_key,
+            nbytes=nbytes, rows=table.num_rows,
+            decode_ms=(_time.perf_counter() - t0) * 1000.0)
+        # observed-exact cardinality: the cached fragment is a grounded
+        # input for AQE/join ordering on every later substitution
+        from ..plan import join_reorder
+        join_reorder.note_observed_rows(p, table.num_rows)
+        if flight is not None:
+            flight.publish(entry)
+        return hb
+
+    def _scan_share(self) -> Tuple[bool, float]:
+        if self._scan_share_conf is None:
+            from ..io.prefetch import scan_share_conf
+            self._scan_share_conf = scan_share_conf(self.config)
+        return self._scan_share_conf
+
+    def _decode_scan_table(self, p: pn.ScanExec, files):
+        from ..io.formats import read_table
+        rtf_preds = p.runtime_predicates
         rtf_stats = None
         if p.source is not None:
             table = p.source
@@ -753,12 +838,7 @@ class LocalExecutor:
                 except Exception:  # noqa: BLE001 — stats are advisory
                     rtf_stats = None
         hb = _positional(ai.from_arrow(table))
-        self._note_rtf_scan(p, rtf_stats)
-        while len(_SCAN_CACHE) > _runtime_cache_size(
-                "runtime.scan_cache_size", 64):
-            _SCAN_CACHE.pop(next(iter(_SCAN_CACHE)))  # drop oldest
-        _SCAN_CACHE[cache_key] = (p.source, hb, rtf_stats)
-        return hb
+        return hb, table, rtf_stats
 
     def _note_rtf_scan(self, p: pn.ScanExec, stats) -> None:
         """Record one scan's runtime-filter pruning (executor-local for
@@ -3411,11 +3491,10 @@ def _apply_runtime_predicates(table: pa.Table, preds, schema):
 
 
 def _drop_mem_scan_entry(table: pa.Table) -> None:
-    """Evict one in-memory table's scan-cache entry (chunk pipelines
-    would otherwise pin every decoded chunk in HBM via the cache)."""
-    for key in [k for k in _SCAN_CACHE
-                if k[0] == "mem" and k[1] == id(table)]:
-        _SCAN_CACHE.pop(key, None)
+    """Evict one in-memory table's fragment-cache entries (chunk
+    pipelines would otherwise pin every decoded chunk in HBM)."""
+    from .result_cache import FRAGMENT_CACHE
+    FRAGMENT_CACHE.drop_mem(id(table))
 
 
 def _positional(hb: HostBatch) -> HostBatch:

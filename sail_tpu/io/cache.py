@@ -100,6 +100,22 @@ class FileListingCache:
             self._data.clear()
             self._ttl_cached = None
 
+    def invalidate_root(self, root: str) -> None:
+        """Drop every listing whose input paths touch ``root`` (equal
+        or nested either way) — the engine-write hook. Nested
+        partition-directory adds don't move the root's mtime, so
+        without this they ride out the whole TTL window."""
+        prefix = os.path.normpath(root) + os.sep
+        with self._lock:
+            doomed = [key for key in self._data
+                      if any(os.path.normpath(p) == prefix[:-1]
+                             or os.path.normpath(p).startswith(prefix)
+                             or prefix[:-1].startswith(
+                                 os.path.normpath(p) + os.sep)
+                             for p in key)]
+            for key in doomed:
+                del self._data[key]
+
 
 class ParquetMetadataCache:
     def __init__(self):
@@ -139,6 +155,12 @@ LISTING_CACHE = FileListingCache()
 METADATA_CACHE = ParquetMetadataCache()
 
 
-def invalidate_listings() -> None:
-    """Called by every engine-side write (files added/removed)."""
-    LISTING_CACHE.clear()
+def invalidate_listings(root: Optional[str] = None) -> None:
+    """Called by every engine-side write (files added/removed). With a
+    ``root``, only listings touching that root are dropped — commit
+    paths pass the written table root so unrelated tables keep their
+    warm listings."""
+    if root is None:
+        LISTING_CACHE.clear()
+    else:
+        LISTING_CACHE.invalidate_root(root)

@@ -386,3 +386,109 @@ def prefetch_depth(config: dict, default: int = 2) -> int:
         return int(config.get("spark.sail.scan.prefetchDepth", default))
     except (TypeError, ValueError):
         return default
+
+
+# ---------------------------------------------------------------------------
+# concurrent-scan sharing: in-flight fragment-load registry
+# ---------------------------------------------------------------------------
+
+class ScanFlight:
+    """One in-flight fragment decode. The leader decodes and publishes
+    (or fails); followers admitted in the same window block on the
+    event instead of running an identical decode pass. The payload is
+    whatever the leader hands over — the scan path passes the decoded
+    device batch plus its cache metadata."""
+
+    __slots__ = ("key", "refs", "_event", "_payload", "_error", "_done")
+
+    def __init__(self, key):
+        self.key = key
+        self.refs = 1
+        self._event = threading.Event()
+        self._payload = None
+        self._error = None
+        self._done = False
+
+    def publish(self, payload) -> None:
+        self._payload = payload
+        self._done = True
+        self._event.set()
+
+    def fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._done = True
+        self._event.set()
+
+    def wait(self, timeout: float):
+        """``(ok, payload)``; re-raises the leader's error (followers
+        would hit the same condition). ``ok=False`` means the wait
+        timed out — the follower falls back to its own decode."""
+        if not self._event.wait(timeout):
+            return False, None
+        if self._error is not None:
+            raise self._error
+        return True, self._payload
+
+
+class InFlightLoads:
+    """Registry of in-flight fragment loads keyed by scan cache key.
+    ``begin`` either installs the caller as leader or attaches it as a
+    follower (refcounted). The leader MUST call ``finish`` (try/
+    finally) after publish/fail so a cancelled leader can't strand the
+    key; followers ``detach`` after consuming — refs hitting zero on a
+    finished flight just drop the bookkeeping, never a live decode."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights = {}
+
+    def begin(self, key):
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is None:
+                flight = ScanFlight(key)
+                self._flights[key] = flight
+                return True, flight
+            flight.refs += 1
+            return False, flight
+
+    def finish(self, key, flight: ScanFlight) -> None:
+        """Leader epilogue: drop the registry entry (attached followers
+        hold their own reference to the flight object)."""
+        with self._lock:
+            if self._flights.get(key) is flight:
+                del self._flights[key]
+            flight.refs -= 1
+
+    def detach(self, flight: ScanFlight) -> None:
+        with self._lock:
+            flight.refs -= 1
+            if flight.refs <= 0 and not flight._done and \
+                    self._flights.get(flight.key) is flight:
+                # every party cancelled before publish: clear the key
+                del self._flights[flight.key]
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._flights)
+
+
+SCAN_LOADS = InFlightLoads()
+
+
+def scan_share_conf(config: dict):
+    """``(enabled, wait_timeout_s)`` for concurrent-scan sharing: app
+    config ``cache.scan_share.enabled`` / ``.wait_timeout_secs`` with
+    the ``spark.sail.cache.scanShare.enabled`` session mirror."""
+    from ..config import get as config_get
+    mirror = config.get("spark.sail.cache.scanShare.enabled")
+    if mirror is not None and str(mirror) != "":
+        enabled = str(mirror).strip().lower() in ("1", "true", "yes")
+    else:
+        enabled = bool(config_get("cache.scan_share.enabled", True))
+    try:
+        timeout = float(config_get("cache.scan_share.wait_timeout_secs",
+                                   30.0))
+    except (TypeError, ValueError):
+        timeout = 30.0
+    return enabled, timeout

@@ -76,6 +76,13 @@ class Transaction:
                 attempt_version += 1
                 continue
             self._maybe_checkpoint(attempt_version)
+            # commits add data files under nested partition directories
+            # without moving the table root's mtime — drop the root's
+            # file listings and version the table for the result cache
+            import os as _os
+            from ...exec.result_cache import bump_table_version
+            root = _os.path.dirname(self.log.log_dir)
+            bump_table_version(root, root=root)
             return attempt_version
         raise CommitConflict(
             f"gave up after {max_retries} commit attempts")

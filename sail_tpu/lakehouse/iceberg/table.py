@@ -571,10 +571,12 @@ class IcebergTable:
         return 1
 
     def _write_metadata_version(self, version: int, md: dict):
-        # commits add files under data/ and metadata/ without touching the
-        # table root's mtime — stale listings must be dropped explicitly
-        from ...io.cache import invalidate_listings
-        invalidate_listings()
+        # commits add files under data/ and metadata/ without touching
+        # the table root's mtime — drop this root's listings explicitly
+        # and version the table for the result cache (which also clears
+        # root-scoped listings; unrelated tables keep warm entries)
+        from ...exec.result_cache import bump_table_version
+        bump_table_version(self.path, root=self.path)
         path = self._metadata_path(version)
         tmp = path + f".{uuid.uuid4().hex}.tmp"
         with open(tmp, "w") as f:

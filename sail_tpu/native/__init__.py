@@ -85,7 +85,9 @@ def try_native_agg(executor, p, chain, child, bottom_node):
         gen = AggCodegen(p, chain, bottom_schema, dicts,
                          validity_present, fold_const)
         source, meta = gen.build()
-        lib = cc.compile_and_load(source)
+        need = ("run_hash", "fetch_hash", "release_hash") \
+            if meta["mode"] == "hash" else ("run",)
+        lib = cc.compile_and_load(source, require=need)
         if meta["mode"] == "hash":
             fn = lib.run_hash
             fn.restype = ctypes.c_int64

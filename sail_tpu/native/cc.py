@@ -57,7 +57,8 @@ def _probe() -> bool:
         return False
     try:
         lib = compile_and_load(
-            'extern "C" long long sail_probe(long long x) { return x + 1; }')
+            'extern "C" long long sail_probe(long long x) { return x + 1; }',
+            require=("sail_probe",))
         fn = lib.sail_probe
         fn.restype = ctypes.c_longlong
         return fn(ctypes.c_longlong(41)) == 42
@@ -65,9 +66,17 @@ def _probe() -> bool:
         return False
 
 
-def compile_and_load(source: str) -> ctypes.CDLL:
+def compile_and_load(source: str,
+                     require: tuple = ()) -> ctypes.CDLL:
     """Compile C++ source to a shared object (content-addressed cache on
-    disk) and dlopen it. Raises on toolchain failure."""
+    disk) and dlopen it. Raises on toolchain failure.
+
+    ``require`` names symbols the loaded library must export: a valid
+    ELF missing them (a concurrent builder once published a kernel
+    compiled from a truncated source file) is dropped and rebuilt once
+    instead of being cached broken in ``_LIBS`` for the process
+    lifetime — an AttributeError at first symbol access would poison
+    every later query sharing the kernel key."""
     key = hashlib.sha256(source.encode()).hexdigest()[:24]
     with _LOCK:
         lib = _LIBS.get(key)
@@ -75,22 +84,38 @@ def compile_and_load(source: str) -> ctypes.CDLL:
             return lib
     os.makedirs(_CACHE_DIR, exist_ok=True)
     so_path = os.path.join(_CACHE_DIR, f"k{key}.so")
-    last_err: Optional[OSError] = None
+    last_err: Optional[Exception] = None
     for _attempt in range(2):
         if not os.path.exists(so_path):
             _build(source, key, so_path)
+        load_path = so_path
+        if _attempt:
+            # dlopen caches by pathname: after a failed first load the
+            # retry MUST go through a fresh path or glibc hands the
+            # stale broken mapping back regardless of the rebuilt file.
+            load_path = so_path + \
+                f".r{os.getpid()}_{threading.get_ident()}"
+            os.link(so_path, load_path)
         try:
-            lib = ctypes.CDLL(so_path)
+            lib = ctypes.CDLL(load_path)
+            for sym in require:
+                getattr(lib, sym)
             break
-        except OSError as e:
-            # a TRUNCATED .so ("file too short"): concurrent builders in
-            # other threads/processes once collided on a shared tmp name
-            # mid-write. Drop the bad artifact and rebuild once.
+        except (OSError, AttributeError) as e:
+            # OSError: a TRUNCATED .so ("file too short").
+            # AttributeError: loads but lacks a required symbol.
+            # Either way drop the artifact and rebuild once.
             last_err = e
             try:
                 os.unlink(so_path)
             except OSError:
                 pass
+        finally:
+            if load_path is not so_path:
+                try:  # mapping survives the unlink
+                    os.unlink(load_path)
+                except OSError:
+                    pass
     else:
         raise RuntimeError(f"native kernel load failed: {last_err}")
     with _LOCK:
@@ -99,18 +124,31 @@ def compile_and_load(source: str) -> ctypes.CDLL:
 
 
 def _build(source: str, key: str, so_path: str) -> None:
-    """Compile to a tmp path unique per (pid, thread) — cluster workers
-    are THREADS sharing one pid, so a pid-only suffix let two builders
-    of the same kernel interleave writes and publish a truncated .so —
-    then atomically publish."""
+    """Compile from a PRIVATE source file and publish both artifacts
+    atomically. Tmp names are unique per (pid, thread) — cluster
+    workers are THREADS sharing one pid — and g++ must never read the
+    shared .cpp path: a concurrent builder's truncating open() there
+    once raced another thread's in-flight compile into an EMPTY
+    translation unit, publishing a symbol-less .so."""
     src_path = os.path.join(_CACHE_DIR, f"k{key}.cpp")
-    with open(src_path, "w") as f:
+    suffix = f".tmp{os.getpid()}_{threading.get_ident()}"
+    # g++ infers the language from the extension — keep .cpp last
+    src_tmp = os.path.join(_CACHE_DIR, f"k{key}{suffix}.cpp")
+    with open(src_tmp, "w") as f:
         f.write(source)
-    tmp = so_path + f".tmp{os.getpid()}_{threading.get_ident()}"
+    tmp = so_path + suffix
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
-           "-fPIC", "-pthread", "-o", tmp, src_path]
-    proc = subprocess.run(cmd, capture_output=True, text=True,
-                          timeout=120)
-    if proc.returncode != 0:
-        raise RuntimeError(f"native kernel compile failed:\n{proc.stderr}")
+           "-fPIC", "-pthread", "-o", tmp, src_tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native kernel compile failed:\n{proc.stderr}")
+        os.replace(src_tmp, src_path)  # keep the .cpp for debugging
+    finally:
+        try:
+            os.unlink(src_tmp)
+        except OSError:
+            pass
     os.replace(tmp, so_path)  # atomic under concurrent builders

@@ -132,6 +132,16 @@ class QueryProfile:
     streaming_commit_ms: float = 0.0
     streaming_state_rows: int = 0
     streaming_replayed: bool = False
+    # result/fragment cache (exec/result_cache.py): how this query's
+    # data was served — "" = cache not consulted, else hit | miss |
+    # shared-scan | view — plus the cache fragments substituted into
+    # the plan, the bytes they served, and concurrent-scan sharing
+    # attach counts (followers riding another query's decode pass)
+    cache_status: str = ""
+    cache_fragments: List[str] = field(default_factory=list)
+    cache_bytes_served: int = 0
+    scan_share_attached: int = 0
+    scan_share_saved: int = 0
     rows_out: int = 0
     slow: bool = False
     # critical-path attribution derived from the query's event stream
@@ -306,6 +316,25 @@ class QueryProfile:
             self.streaming_state_rows = int(state_rows)
             self.streaming_replayed = bool(replayed)
 
+    def note_result_cache(self, status: str = "",
+                          fragment: Optional[str] = None,
+                          nbytes: int = 0, attached: int = 0,
+                          saved: int = 0) -> None:
+        """Result/fragment cache activity. Status precedence: a whole-
+        query hit outranks a view read outranks a shared scan outranks
+        a miss (fragment-only hits ride the fragments/bytes fields)."""
+        order = {"": 0, "miss": 1, "shared-scan": 2, "view": 3, "hit": 4}
+        with self._lock:
+            if status and order.get(status, 0) >= \
+                    order.get(self.cache_status, 0):
+                self.cache_status = status
+            if fragment and len(self.cache_fragments) < 32 \
+                    and fragment not in self.cache_fragments:
+                self.cache_fragments.append(fragment)
+            self.cache_bytes_served += int(nbytes)
+            self.scan_share_attached += int(attached)
+            self.scan_share_saved += int(saved)
+
     def add_task(self, stage: int, partition: int, worker_id: str,
                  operators: List[dict], rows_out: int = 0) -> None:
         """Merge one distributed task's operator metrics (driver side)."""
@@ -424,6 +453,14 @@ class QueryProfile:
                 "state_rows": self.streaming_state_rows,
                 "replayed": self.streaming_replayed,
             } if self.streaming_epoch >= 0 else None,
+            "result_cache": {
+                "status": self.cache_status,
+                "fragments": list(self.cache_fragments),
+                "bytes_served": self.cache_bytes_served,
+                "scan_share_attached": self.scan_share_attached,
+                "scan_share_saved": self.scan_share_saved,
+            } if self.cache_status or self.cache_fragments
+            or self.scan_share_attached else None,
             "rows_out": self.rows_out,
             "slow": self.slow,
             "critical_path": self.critical_path,
@@ -512,6 +549,17 @@ class QueryProfile:
                     f"state_rows={self.streaming_state_rows}")
             if self.streaming_replayed:
                 line += " (replayed)"
+            lines.append(line)
+        if self.cache_status or self.cache_fragments \
+                or self.scan_share_attached:
+            line = f"cache: {self.cache_status or 'miss'}"
+            if self.cache_fragments:
+                line += " fragments=" + ",".join(self.cache_fragments)
+            if self.cache_bytes_served:
+                line += f" bytes={self.cache_bytes_served}"
+            if self.scan_share_attached:
+                line += (f" attached={self.scan_share_attached} "
+                         f"saved={self.scan_share_saved}")
             lines.append(line)
         if self.validated_passes:
             lines.append(f"validated: {self.validated_passes} passes")
@@ -740,6 +788,16 @@ def _finalize(profile: QueryProfile, threshold_ms: float) -> None:
                          profile.adaptive_broadcast,
                      "query.adaptive.reordered":
                          profile.adaptive_reordered}
+            if profile.cache_status or profile.cache_fragments \
+                    or profile.scan_share_attached:
+                attrs["query.result_cache.status"] = \
+                    profile.cache_status or "miss"
+                attrs["query.result_cache.bytes_served"] = \
+                    profile.cache_bytes_served
+                attrs["query.result_cache.fragments"] = \
+                    ",".join(profile.cache_fragments)
+                attrs["query.scan_share.attached"] = \
+                    profile.scan_share_attached
             for name, ms in profile.phase_items():
                 attrs[f"query.phase.{name}_ms"] = round(ms, 3)
             if profile.critical_path:
@@ -834,6 +892,18 @@ def note_backend_routes(routes) -> None:
     profile = current_profile()
     if profile is not None:
         profile.note_backend_routes(routes)
+
+
+def note_result_cache(status: str = "", fragment: Optional[str] = None,
+                      nbytes: int = 0, attached: int = 0,
+                      saved: int = 0) -> None:
+    """Result/fragment cache activity on the current query (scan-path
+    executors call this; transparent without a profile)."""
+    profile = current_profile()
+    if profile is not None:
+        profile.note_result_cache(status, fragment=fragment,
+                                  nbytes=nbytes, attached=attached,
+                                  saved=saved)
 
 
 def note_transfer_bytes(nbytes: int) -> None:

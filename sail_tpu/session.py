@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import pyarrow as pa
@@ -156,6 +157,27 @@ class SparkSession:
                 self.conf.get("spark.sql.session.timeZone") or "UTC")
             try:
                 node = self._resolve(plan)
+                # result cache: a fingerprint+version-vector hit serves
+                # the stored table and skips execution entirely (local,
+                # mesh and cluster paths alike); a miss measures the
+                # build cost for the eviction policy and stores
+                from .exec import result_cache as rc
+                rc_probe = None
+                if rc.result_cache_enabled(self.conf):
+                    rc_probe = rc.probe(
+                        node, self._result_cache_session_key())
+                    if rc_probe is not None:
+                        cached = rc.RESULT_CACHE.lookup(rc_probe)
+                        if cached is not None:
+                            prof.note_result_cache(
+                                "hit", fragment=cached.fragment_id,
+                                nbytes=cached.nbytes)
+                            prof.rows_out = cached.table.num_rows
+                            return cached.table
+                        prof.note_result_cache(
+                            "view" if self._reads_materialized_view(node)
+                            else "miss")
+                build_t0 = time.perf_counter()
                 # the executors record their own execute/fetch phases
                 # (LocalExecutor.execute); the mesh attempt is wrapped
                 # here because it returns a finished table
@@ -164,11 +186,40 @@ class SparkSession:
                 if table is None:
                     table = self._executor_cls(
                         dict(self.conf.items())).execute(node)
+                if rc_probe is not None:
+                    rc.RESULT_CACHE.store(
+                        rc_probe, table,
+                        (time.perf_counter() - build_t0) * 1000.0)
                 prof.rows_out = table.num_rows
                 return table
             finally:
                 reset_session_timezone(token)
                 ticket.release()
+
+    def _result_cache_session_key(self) -> tuple:
+        """Session knobs that change a query's OUTPUT for an identical
+        plan — part of the result-cache key."""
+        return (self.conf.get("spark.sql.session.timeZone") or "UTC",
+                str(self.conf.get("spark.sql.ansi.enabled") or ""),
+                str(self.conf.get("spark.sql.shuffle.partitions") or ""))
+
+    @staticmethod
+    def _reads_materialized_view(node) -> bool:
+        from .exec.result_cache import VIEWS
+        from .plan import nodes as pn
+        if not VIEWS.names():
+            return False
+        return any(isinstance(n, pn.ScanExec)
+                   and VIEWS.is_view(n.table_name)
+                   for n in pn.walk_plan(node))
+
+    def _table_mutated(self, entry, kind: str = "append",
+                       delta: Optional[pa.Table] = None) -> None:
+        """Post-write hook for every DML path: bumps the result-cache
+        table version (which also clears file listings for the written
+        root) and folds the change into dependent materialized views."""
+        from .exec import result_cache as rc
+        rc.table_mutated(self, entry, kind=kind, delta=delta)
 
     def _try_mesh_execute(self, node) -> Optional[pa.Table]:
         """SPMD path: when the plan splits into co-resident stages and the
@@ -442,16 +493,44 @@ class SparkSession:
                 # deterministic decision function, no execution)
                 backends = [d.to_dict() for d in router.decide_split(
                     split, force=router.forced_backend(self.conf))]
+            from .exec import result_cache as rc
+            rc_probe = None
+            if rc.result_cache_enabled(self.conf):
+                rc_probe = rc.probe(node,
+                                    self._result_cache_session_key())
             if cmd.mode == "analyze":
                 import time as _t
                 from . import profiler
                 from . import telemetry as tel
                 prof = profiler.current_profile()
                 t0 = _t.perf_counter()
-                with tel.collect_metrics() as collector:
-                    # LocalExecutor.execute records execute/fetch phases
-                    result = self._executor_cls(
-                        dict(self.conf.items())).execute(node)
+                cached = rc.RESULT_CACHE.lookup(rc_probe) \
+                    if rc_probe is not None else None
+                if cached is not None:
+                    # same contract as _execute_query: a hit serves the
+                    # stored table — no operators ran, and the profile
+                    # says so
+                    result = cached.table
+                    collector = []
+                    if prof is not None:
+                        prof.note_result_cache(
+                            "hit", fragment=cached.fragment_id,
+                            nbytes=cached.nbytes)
+                else:
+                    if prof is not None and rc_probe is not None:
+                        prof.note_result_cache(
+                            "view"
+                            if self._reads_materialized_view(node)
+                            else "miss")
+                    with tel.collect_metrics() as collector:
+                        # LocalExecutor.execute records execute/fetch
+                        # phases
+                        result = self._executor_cls(
+                            dict(self.conf.items())).execute(node)
+                    if rc_probe is not None:
+                        rc.RESULT_CACHE.store(
+                            rc_probe, result,
+                            (_t.perf_counter() - t0) * 1000.0)
                 total_ms = (_t.perf_counter() - t0) * 1000
                 ops = [m.to_dict() for m in collector]
                 if prof is not None:
@@ -476,6 +555,20 @@ class SparkSession:
                     text = "\n".join(
                         [header] + [m.render() for m in collector])
                 return pa.table({"plan": pa.array([text])})
+            cache_info = None
+            if rc_probe is not None:
+                # non-counting peek: what WOULD happen if this ran now
+                entry = rc.RESULT_CACHE.peek(rc_probe)
+                if entry is not None:
+                    cache_info = {"status": "hit",
+                                  "fragments": [entry.fragment_id],
+                                  "bytes_served": entry.nbytes}
+                else:
+                    cache_info = {
+                        "status": "view"
+                        if self._reads_materialized_view(node)
+                        else "miss",
+                        "fragments": [], "bytes_served": 0}
             if cmd.format == "json":
                 import json as _json
                 payload = {"plan": explain(node, stage_of=stage_of)}
@@ -483,6 +576,8 @@ class SparkSession:
                     payload["fused_stages"] = n_stages
                 if backends:
                     payload["backends"] = backends
+                if cache_info is not None:
+                    payload["result_cache"] = cache_info
                 return pa.table({"plan": pa.array(
                     [_json.dumps(payload, indent=2)])})
             text = explain(node, stage_of=stage_of)
@@ -492,7 +587,23 @@ class SparkSession:
                 text += "\nbackend: " + " ".join(
                     f"s{b['stage']}={b['backend']}({b['reason']})"
                     for b in backends)
+            if cache_info is not None:
+                line = f"\ncache: {cache_info['status']}"
+                if cache_info["fragments"]:
+                    line += " fragments=" + ",".join(
+                        cache_info["fragments"])
+                if cache_info["bytes_served"]:
+                    line += f" bytes={cache_info['bytes_served']}"
+                text += line
             return pa.table({"plan": pa.array([text])})
+        if isinstance(cmd, sp.CacheMaterialized):
+            from .exec.result_cache import VIEWS
+            VIEWS.create(self, cmd.name[-1], cmd.query)
+            return empty
+        if isinstance(cmd, sp.UncacheMaterialized):
+            from .exec.result_cache import VIEWS
+            VIEWS.drop(cm, cmd.name[-1], cmd.if_exists)
+            return empty
         if isinstance(cmd, sp.CacheTable):
             if cmd.query is not None:
                 cm.register_temp_view(cmd.name[-1], cmd.query)
@@ -513,6 +624,11 @@ class SparkSession:
             from .io.cache import LISTING_CACHE, METADATA_CACHE
             LISTING_CACHE.clear()
             METADATA_CACHE.clear()
+            entry = cm.lookup_table(cmd.name)
+            if entry is not None:
+                # external change declared: version the table so cached
+                # results miss and dependent views recompute
+                self._table_mutated(entry, "refresh")
             return empty
         if isinstance(cmd, sp.ClearCache):
             from .exec.local import clear_caches
@@ -620,6 +736,7 @@ class SparkSession:
             if entry.data is not None:
                 entry.data = entry.data.slice(0, 0)
             _drop_row_stats(entry)
+            self._table_mutated(entry, "truncate")
             return pa.table({})
         if entry.format == "delta" and entry.paths:
             from .columnar.arrow_interop import spec_type_to_arrow
@@ -632,6 +749,7 @@ class SparkSession:
                 f.name: pa.array([], type=spec_type_to_arrow(f.data_type))
                 for f in schema.fields}))
             _drop_row_stats(entry)
+            self._table_mutated(entry, "truncate")
             return pa.table({})
         raise NotImplementedError(
             f"TRUNCATE on format {entry.format!r} not supported")
@@ -797,6 +915,7 @@ class SparkSession:
         out = DeltaDml(self, cmd.table).delete(cmd.condition)
         if entry is not None:
             _drop_row_stats(entry)
+            self._table_mutated(entry, "mutate")
         return out
 
     def _iceberg_delete(self, entry, cmd: sp.Delete) -> pa.Table:
@@ -817,6 +936,7 @@ class SparkSession:
 
         t.delete_where(mask_fn)
         _drop_row_stats(entry)
+        self._table_mutated(entry, "mutate")
         return pa.table({})
 
     def _delta_update(self, cmd: sp.Update) -> pa.Table:
@@ -825,6 +945,7 @@ class SparkSession:
         entry = self.catalog_manager.lookup_table(cmd.table)
         if entry is not None:
             _drop_row_stats(entry)
+            self._table_mutated(entry, "mutate")
         return out
 
     def _delta_merge(self, cmd: sp.MergeInto) -> pa.Table:
@@ -837,6 +958,7 @@ class SparkSession:
         entry = self.catalog_manager.lookup_table(cmd.target)
         if entry is not None:
             _drop_row_stats(entry)
+            self._table_mutated(entry, "mutate")
         return out
 
     def _file_table_entry(self, cmd: sp.CreateTable) -> TableEntry:
@@ -929,6 +1051,9 @@ class SparkSession:
                         mode="overwrite" if cmd.overwrite else "append",
                         partition_by=entry.partition_by)
         _drop_row_stats(entry)
+        self._table_mutated(entry,
+                            "overwrite" if cmd.overwrite else "append",
+                            delta=None if cmd.overwrite else new_data)
         return pa.table({})
 
 

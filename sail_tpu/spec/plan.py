@@ -431,6 +431,22 @@ class UncacheTable(CommandPlan):
 
 
 @dataclass(frozen=True)
+class CacheMaterialized(CommandPlan):
+    """CACHE MATERIALIZED [VIEW] name AS query — a continuously-
+    maintained materialized view (exec/result_cache.py): base-table
+    DML folds deltas into the cached fragment at marker cadence."""
+
+    name: Tuple[str, ...] = ()
+    query: Optional[QueryPlan] = None
+
+
+@dataclass(frozen=True)
+class UncacheMaterialized(CommandPlan):
+    name: Tuple[str, ...] = ()
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
 class ShowCatalogs(CommandPlan):
     pattern: Optional[str] = None
 

@@ -186,6 +186,11 @@ class Parser:
             return pl.Explain(self.parse_statement(), mode, fmt)
         if self.at_kw("CACHE"):
             self.advance()
+            if self.accept_kw("MATERIALIZED"):
+                self.accept_kw("VIEW")
+                name = self.parse_qualified_name()
+                self.expect_kw("AS")
+                return pl.CacheMaterialized(name, self.parse_query())
             lazy = self.accept_kw("LAZY") is not None
             self.expect_kw("TABLE")
             name = self.parse_qualified_name()
@@ -195,6 +200,11 @@ class Parser:
             return pl.CacheTable(name, query, lazy)
         if self.at_kw("UNCACHE"):
             self.advance()
+            if self.accept_kw("MATERIALIZED"):
+                self.accept_kw("VIEW")
+                if_exists = self._accept_if_exists()
+                return pl.UncacheMaterialized(
+                    self.parse_qualified_name(), if_exists)
             self.expect_kw("TABLE")
             if_exists = self._accept_if_exists()
             return pl.UncacheTable(self.parse_qualified_name(), if_exists)

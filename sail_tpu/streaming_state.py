@@ -252,12 +252,38 @@ class KeyedStateStore:
         self.wm_index = names.index(WM_COLUMN) if WM_COLUMN in names \
             else None
 
+    def _widen_schema(self, incoming: pa.Schema) -> None:
+        # Decimal partials widen per-epoch (literal scale tracks the
+        # inserted values), and emission casts every stored value back
+        # to self.schema — keep the union scale/precision or to_table
+        # would refuse to rescale earlier wider sums.
+        changed = False
+        fields = list(self.schema)
+        for i, f in enumerate(fields):
+            if i >= len(incoming):
+                break
+            new = incoming.field(i).type
+            if new.equals(f.type):
+                continue
+            if pa.types.is_decimal(f.type) and pa.types.is_decimal(new):
+                scale = max(f.type.scale, new.scale)
+                ints = max(f.type.precision - f.type.scale,
+                           new.precision - new.scale)
+                unified = pa.decimal128(min(38, ints + scale), scale)
+                if not unified.equals(f.type):
+                    fields[i] = f.with_type(unified)
+                    changed = True
+        if changed:
+            self.schema = pa.schema(fields)
+
     def merge_delta(self, delta: pa.Table) -> List[tuple]:
         """Fold one epoch's partial-aggregate result into the store;
         returns the keys touched (for update-mode emission and the
         changelog)."""
         if self.schema is None:
             self._capture_schema(delta)
+        else:
+            self._widen_schema(delta.schema)
         key_pos = [i for i, k in enumerate(self.merge_kinds)
                    if k is None]
         cols = [delta.column(i).to_pylist()

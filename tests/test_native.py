@@ -58,6 +58,77 @@ def test_available_concurrent_callers():
     assert len(set(results)) == 1
 
 
+def test_symbol_less_so_is_rebuilt_not_poisoned():
+    """A valid ELF missing the required symbol (the shared-source
+    truncation race published one compiled from an empty translation
+    unit) must be dropped and rebuilt — NOT cached broken in _LIBS,
+    which used to fail every later query sharing the kernel key."""
+    import ctypes
+    import hashlib
+    import os
+    import subprocess
+    import uuid
+
+    if not _bounded(cc.available, timeout=120.0):
+        pytest.skip("no native toolchain")
+    fn_name = f"sail_t_{uuid.uuid4().hex[:12]}"
+    source = (f'extern "C" long long {fn_name}(long long x) '
+              '{ return x * 2; }')
+    key = hashlib.sha256(source.encode()).hexdigest()[:24]
+    os.makedirs(cc._CACHE_DIR, exist_ok=True)
+    so_path = os.path.join(cc._CACHE_DIR, f"k{key}.so")
+    # plant a symbol-less library at the content-addressed path
+    empty_cpp = so_path + ".plant.cpp"
+    with open(empty_cpp, "w") as f:
+        f.write("\n")
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", so_path, empty_cpp],
+                   check=True, capture_output=True)
+    os.unlink(empty_cpp)
+    planted = ctypes.CDLL(so_path)
+    assert not hasattr(planted, fn_name), "plant unexpectedly has symbol"
+
+    lib = _bounded(lambda: cc.compile_and_load(source, require=(fn_name,)))
+    f2 = getattr(lib, fn_name)
+    f2.restype = ctypes.c_longlong
+    assert f2(ctypes.c_longlong(21)) == 42
+    # and the cached handle is the good one
+    again = cc.compile_and_load(source, require=(fn_name,))
+    assert again is lib
+
+
+def test_concurrent_builders_all_get_working_kernel():
+    """8 threads racing first-build of one fresh kernel key: every
+    loaded handle must expose the symbol (builders compile private
+    source copies; the shared .cpp is published only after success)."""
+    import ctypes
+    import uuid
+
+    if not _bounded(cc.available, timeout=120.0):
+        pytest.skip("no native toolchain")
+    fn_name = f"sail_c_{uuid.uuid4().hex[:12]}"
+    source = (f'extern "C" long long {fn_name}(long long x) '
+              '{ return x + 7; }')
+    results, errors = [], []
+
+    def worker():
+        try:
+            lib = cc.compile_and_load(source, require=(fn_name,))
+            f = getattr(lib, fn_name)
+            f.restype = ctypes.c_longlong
+            results.append(f(ctypes.c_longlong(1)))
+        except BaseException as e:  # noqa: BLE001 — collected below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(150)
+        assert not t.is_alive(), "compile_and_load hung under concurrency"
+    assert not errors, errors
+    assert results == [8] * 8
+
+
 def test_group_by_with_native_enabled_default_settings():
     spark = SparkSession({})
     df = pd.DataFrame({

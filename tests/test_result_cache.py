@@ -1,0 +1,490 @@
+"""Result/fragment cache, concurrent-scan sharing, materialized views.
+
+Covers the three-tier reuse layer (exec/result_cache.py):
+- result-tier hit/miss, DML invalidation, nondeterminism exclusion,
+  session-conf kill switch, cost-weighted eviction;
+- fragment-tier reuse across distinct queries + byte-budget eviction;
+- concurrent-scan sharing (one decode pass for N concurrent cold
+  scans, leader-error propagation to followers);
+- invalidation chaos: concurrent sessions replaying a dashboard query
+  while commits race — every observed result must be a legal
+  commit-prefix state, including under fault injection;
+- version-skew red test: with the version vector frozen the cache
+  provably serves stale data, demonstrating that the per-table version
+  counters are what guarantee freshness;
+- CACHE MATERIALIZED views tracking base-table commits at marker
+  cadence (incremental fold + full-recompute paths);
+- surfaces: EXPLAIN ``cache:`` line, FORMAT JSON ``result_cache``
+  object, ``system.telemetry.result_cache``, root-scoped listing
+  invalidation.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from sail_tpu import SparkSession
+from sail_tpu import faults
+from sail_tpu import metrics as gm
+from sail_tpu.exec import result_cache as rc
+from sail_tpu.exec.local import LocalExecutor, clear_caches
+from sail_tpu.io.cache import (LISTING_CACHE, METADATA_CACHE,
+                               invalidate_listings)
+from sail_tpu.io.formats import expand_paths
+from sail_tpu.io.prefetch import SCAN_LOADS
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    clear_caches()
+    rc.VIEWS.clear()
+    LISTING_CACHE.clear()
+    METADATA_CACHE.clear()
+    gm.REGISTRY.reset()
+    faults.reset()
+    yield
+    clear_caches()
+    rc.VIEWS.clear()
+    LISTING_CACHE.clear()
+    METADATA_CACHE.clear()
+    gm.REGISTRY.reset()
+    faults.reset()
+
+
+@pytest.fixture()
+def spark():
+    return SparkSession({})
+
+
+def _metric(name, attr_substr=None):
+    total = 0.0
+    for r in gm.REGISTRY.snapshot():
+        if r["name"] != name:
+            continue
+        if attr_substr is not None and attr_substr not in r["attributes"]:
+            continue
+        total += r["value"]
+    return total
+
+
+def _write_parquet_dir(tmp_path, name="data", rows=200):
+    d = tmp_path / name
+    d.mkdir()
+    pq.write_table(
+        pa.table({"x": np.arange(rows, dtype=np.float64),
+                  "g": np.arange(rows, dtype=np.int64) % 7}),
+        str(d / "part0.parquet"))
+    return str(d)
+
+
+# ---------------------------------------------------------------------------
+# result tier: hit / miss / invalidation / exclusions
+# ---------------------------------------------------------------------------
+
+def test_repeat_query_hits_and_is_bit_identical(spark):
+    spark.sql("CREATE TABLE t (a INT, b STRING)")
+    spark.sql("INSERT INTO t VALUES (1, 'x'), (2, 'y'), (3, 'z')")
+    q = "SELECT a, b FROM t WHERE a > 1 ORDER BY a"
+    first = spark.sql(q).toArrow()
+    misses0 = _metric("execution.result_cache.miss_count", "result")
+    assert misses0 >= 1
+    second = spark.sql(q).toArrow()
+    assert second.equals(first)
+    assert _metric("execution.result_cache.hit_count", "result") >= 1
+    assert _metric("execution.result_cache.bytes_served", "result") > 0
+    # same data, no extra miss on the repeat
+    assert _metric("execution.result_cache.miss_count",
+                   "result") == misses0
+
+
+def test_dml_invalidates_result_entries(spark):
+    spark.sql("CREATE TABLE t (a INT)")
+    spark.sql("INSERT INTO t VALUES (1), (2)")
+    q = "SELECT SUM(a) AS s FROM t"
+    assert spark.sql(q).toPandas().s[0] == 3
+    spark.sql("INSERT INTO t VALUES (10)")
+    assert _metric("execution.result_cache.invalidated_count") >= 1
+    assert spark.sql(q).toPandas().s[0] == 13
+    spark.sql("TRUNCATE TABLE t")
+    assert spark.sql("SELECT COUNT(*) AS c FROM t").toPandas().c[0] == 0
+
+
+def test_nondeterministic_queries_are_not_cached(spark):
+    spark.sql("CREATE TABLE t (a INT)")
+    spark.sql("INSERT INTO t VALUES (1), (2), (3)")
+    h0 = _metric("execution.result_cache.hit_count", "result")
+    spark.sql("SELECT a, rand() AS r FROM t").toPandas()
+    spark.sql("SELECT a, rand() AS r FROM t").toPandas()
+    assert _metric("execution.result_cache.hit_count", "result") == h0
+    assert all(e["key"].find("rand") == -1
+               for e in rc.RESULT_CACHE.snapshot())
+
+
+def test_session_conf_disables_result_tier(spark):
+    spark.sql("CREATE TABLE t (a INT)")
+    spark.sql("INSERT INTO t VALUES (1)")
+    spark.conf.set("spark.sail.cache.result.enabled", "false")
+    spark.sql("SELECT a FROM t").toPandas()
+    spark.sql("SELECT a FROM t").toPandas()
+    assert _metric("execution.result_cache.hit_count", "result") == 0
+    spark.conf.set("spark.sail.cache.result.enabled", "true")
+    spark.sql("SELECT a FROM t").toPandas()
+    spark.sql("SELECT a FROM t").toPandas()
+    assert _metric("execution.result_cache.hit_count", "result") >= 1
+
+
+# ---------------------------------------------------------------------------
+# fragment tier
+# ---------------------------------------------------------------------------
+
+def test_fragment_shared_across_distinct_queries(tmp_path, spark):
+    d = _write_parquet_dir(tmp_path)
+    spark.sql(f"CREATE TABLE pt USING parquet LOCATION '{d}'")
+    spark.sql("SELECT SUM(x) AS s FROM pt").toPandas()
+    h0 = _metric("execution.result_cache.hit_count", "fragment")
+    # different plan (no result-tier hit), same scan fragment
+    spark.sql("SELECT AVG(x) AS a FROM pt").toPandas()
+    assert _metric("execution.result_cache.hit_count", "fragment") > h0
+    tiers = {e["tier"] for e in rc.FRAGMENT_CACHE.snapshot()}
+    assert tiers == {"fragment"}
+
+
+def _probe(key, dep="tbl"):
+    return rc.CacheProbe(key=(key,), depends=frozenset({dep}), sources=())
+
+
+def _table_of_bytes(nbytes):
+    return pa.table({"x": np.zeros(nbytes // 8, dtype=np.float64)})
+
+
+def test_result_eviction_is_cost_weighted():
+    cache = rc.ResultCache(max_mb=0.2)  # ~209 KB budget
+    t = _table_of_bytes(51200)          # 50 KB each, four fit
+    for key, cost in [("a", 1.0), ("b", 100.0), ("c", 50.0), ("d", 75.0)]:
+        cache.store(_probe(key), t, cost)
+    assert all(cache.peek(_probe(k)) for k in "abcd")
+    cache.store(_probe("e"), t, 10.0)   # over budget: cheapest ("a") goes
+    assert cache.peek(_probe("a")) is None
+    assert all(cache.peek(_probe(k)) for k in "bcde")
+    # an entry bigger than a quarter of the budget is never stored
+    cache.store(_probe("huge"), _table_of_bytes(100 * 1024), 999.0)
+    assert cache.peek(_probe("huge")) is None
+
+
+def test_fragment_eviction_is_cost_weighted():
+    cache = rc.FragmentCache(max_mb=0.2)
+    for key, cost in [("a", 1.0), ("b", 100.0), ("c", 50.0), ("d", 75.0)]:
+        cache.put((key,), None, object(), None, table_key="t",
+                  nbytes=51200, rows=10, decode_ms=cost)
+    cache.put(("e",), None, object(), None, table_key="t",
+              nbytes=51200, rows=10, decode_ms=10.0)
+    assert cache.get(("a",), None) is None
+    assert all(cache.get((k,), None) for k in "bcde")
+    cache.invalidate_table("t")
+    assert cache.get(("b",), None) is None
+
+
+# ---------------------------------------------------------------------------
+# concurrent-scan sharing
+# ---------------------------------------------------------------------------
+
+def test_shared_scan_single_decode_pass(tmp_path, monkeypatch):
+    d = _write_parquet_dir(tmp_path)
+    n = 4
+    sessions = [SparkSession({}) for _ in range(n)]
+    frames = []
+    for i, s in enumerate(sessions):
+        s.read.parquet(d).createOrReplaceTempView("t")
+        # distinct plans (no result-tier reuse), identical scan fragment
+        frames.append(s.sql(f"SELECT SUM(x + {i}) AS s FROM t"))
+
+    decode_calls = []
+    orig = LocalExecutor._decode_scan_table
+
+    def slow_decode(self, p, files):
+        decode_calls.append(1)
+        time.sleep(1.0)
+        return orig(self, p, files)
+
+    monkeypatch.setattr(LocalExecutor, "_decode_scan_table", slow_decode)
+
+    barrier = threading.Barrier(n)
+    results, errors = [None] * n, []
+
+    def run(i):
+        try:
+            barrier.wait()
+            results[i] = frames[i].toPandas().s[0]
+        except Exception as exc:  # noqa: BLE001 — collected for assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    expected = float(np.arange(200).sum())
+    for i in range(n):
+        assert results[i] == expected + 200 * i
+    assert len(decode_calls) == 1
+    assert _metric("execution.scan_share.decode_passes_saved") == n - 1
+    assert _metric("execution.scan_share.attached_count") == n - 1
+    assert SCAN_LOADS.in_flight() == 0
+
+
+def test_shared_scan_leader_error_propagates(tmp_path, monkeypatch):
+    d = _write_parquet_dir(tmp_path)
+    n = 3
+    sessions = [SparkSession({}) for _ in range(n)]
+    frames = []
+    for i, s in enumerate(sessions):
+        s.read.parquet(d).createOrReplaceTempView("t")
+        frames.append(s.sql(f"SELECT SUM(x + {i}) AS s FROM t"))
+
+    def broken_decode(self, p, files):
+        time.sleep(0.5)
+        raise RuntimeError("decode exploded")
+
+    monkeypatch.setattr(LocalExecutor, "_decode_scan_table", broken_decode)
+
+    barrier = threading.Barrier(n)
+    errors = []
+
+    def run(i):
+        barrier.wait()
+        try:
+            frames[i].toPandas()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(str(exc))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(errors) == n
+    assert all("decode exploded" in e for e in errors)
+    # registry drained, no poisoned fragment cached
+    assert SCAN_LOADS.in_flight() == 0
+    assert rc.FRAGMENT_CACHE.snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# invalidation chaos + version-skew red test
+# ---------------------------------------------------------------------------
+
+def _delta_table(tmp_path, name, values):
+    path = str(tmp_path / name)
+    writer = SparkSession({})
+    writer.createDataFrame(pd.DataFrame({"v": values})) \
+        .write.format("delta").save(path)
+    writer.sql(f"CREATE TABLE c USING delta LOCATION '{path}'")
+    return path, writer
+
+
+def test_chaos_replay_bit_identical_under_commits(tmp_path):
+    path, writer = _delta_table(tmp_path, "chaos", [1.0])
+    appends = [2.0, 4.0, 8.0, 16.0, 32.0, 64.0]
+    legal = {1.0}
+    acc = 1.0
+    for v in appends:
+        acc += v
+        legal.add(acc)
+
+    k = 3
+    readers = []
+    for _ in range(k):
+        s = SparkSession({})
+        s.sql(f"CREATE TABLE c USING delta LOCATION '{path}'")
+        readers.append(s)
+
+    observed, errors = [], []
+    stop = threading.Event()
+
+    def replay(s):
+        try:
+            while not stop.is_set():
+                got = s.sql("SELECT SUM(v) AS s FROM c").toPandas().s[0]
+                observed.append(float(got))
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=replay, args=(s,)) for s in readers]
+    for t in threads:
+        t.start()
+    for v in appends:
+        writer.sql(f"INSERT INTO c VALUES ({v})")
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert observed, "readers never completed a query"
+    # every replay is bit-identical to some legal commit-prefix state
+    assert set(observed) <= legal
+    # after the dust settles every session converges on the final state
+    for s in readers + [writer]:
+        assert s.sql("SELECT SUM(v) AS s FROM c").toPandas().s[0] == acc
+
+
+def test_chaos_with_fault_injection_no_stale_hits(tmp_path):
+    path, writer = _delta_table(tmp_path, "faulty", [1.0])
+    reader = SparkSession({})
+    reader.sql(f"CREATE TABLE c USING delta LOCATION '{path}'")
+    legal = {1.0, 3.0, 6.0}
+    faults.configure("io.read=error@0.4#6", seed=7)
+    try:
+        for v in [2.0, 3.0]:
+            writer.sql(f"INSERT INTO c VALUES ({v})")
+            for _ in range(4):
+                try:
+                    got = float(reader.sql(
+                        "SELECT SUM(v) AS s FROM c").toPandas().s[0])
+                except faults.FaultInjectedError:
+                    continue  # injected decode failure — never cached
+                assert got in legal
+    finally:
+        faults.reset()
+    assert reader.sql("SELECT SUM(v) AS s FROM c").toPandas().s[0] == 6.0
+
+
+def test_version_skew_red_then_green(tmp_path, spark):
+    """Freeze the version vector → the cache provably serves stale data;
+    unfreeze → the very next probe misses and recomputes. This is the
+    red test showing the per-table versions are the freshness guard."""
+    path, writer = _delta_table(tmp_path, "skew", [1.0, 2.0])
+    q = "SELECT SUM(v) AS s FROM c"
+    assert writer.sql(q).toPandas().s[0] == 3.0  # populates the cache
+
+    mp = pytest.MonkeyPatch()
+    frozen = {}
+    orig_leaf = rc._scan_leaf_version
+
+    def frozen_leaf(scan):
+        r = orig_leaf(scan)
+        if r is None:
+            return None
+        return frozen.setdefault(r[0], r)
+
+    mp.setattr(rc, "_scan_leaf_version", frozen_leaf)
+    mp.setattr(rc, "bump_table_version", lambda key, root=None: None)
+    try:
+        writer.sql(q).toPandas()  # prime the frozen vector
+        writer.sql("INSERT INTO c VALUES (100.0)")
+        stale = writer.sql(q).toPandas().s[0]
+        assert stale == 3.0, "expected a stale hit with versions frozen"
+    finally:
+        mp.undo()
+    assert writer.sql(q).toPandas().s[0] == 103.0
+
+
+# ---------------------------------------------------------------------------
+# CACHE MATERIALIZED views
+# ---------------------------------------------------------------------------
+
+def _check_view_matches_definition(spark, view_sql):
+    spark.conf.set("spark.sail.cache.result.enabled", "false")
+    want = spark.sql(view_sql).toPandas().sort_values("k") \
+        .reset_index(drop=True)
+    spark.conf.set("spark.sail.cache.result.enabled", "true")
+    got = spark.sql("SELECT * FROM mv").toPandas().sort_values("k") \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(got[want.columns], want)
+
+
+def test_materialized_view_tracks_commits(spark):
+    # DOUBLE column: INSERT literals parse as decimal, so this also
+    # locks the fold path's delta-to-base-schema cast (dtype-strict
+    # assert_frame_equal below would catch Decimal drift)
+    defining = "SELECT k, SUM(v) AS s FROM b GROUP BY k"
+    spark.sql("CREATE TABLE b (k INT, v DOUBLE)")
+    spark.sql("INSERT INTO b VALUES (1, 10.0), (2, 20.5)")
+    spark.sql(f"CACHE MATERIALIZED VIEW mv AS {defining}")
+    _check_view_matches_definition(spark, defining)
+    # marker cadence: after every commit the view equals re-running
+    # the defining query
+    for values in ["(1, 5.0)", "(3, 30.25)", "(2, 7.0), (3, 1.5)"]:
+        spark.sql(f"INSERT INTO b VALUES {values}")
+        _check_view_matches_definition(spark, defining)
+    assert _metric("execution.result_cache.view_refresh_count",
+                   "incremental") >= 3
+    # full-recompute path: TRUNCATE is not an append delta
+    spark.sql("TRUNCATE TABLE b")
+    assert spark.sql("SELECT COUNT(*) AS c FROM mv").toPandas().c[0] == 0
+    assert _metric("execution.result_cache.view_refresh_count",
+                   "full") >= 1
+    spark.sql("UNCACHE MATERIALIZED VIEW mv")
+    with pytest.raises(Exception):
+        spark.sql("SELECT * FROM mv").toPandas()
+    spark.sql("UNCACHE MATERIALIZED VIEW IF EXISTS mv")  # no raise
+
+
+def test_materialized_view_over_delta_merge(tmp_path, spark):
+    path = str(tmp_path / "mvd")
+    spark.createDataFrame(pd.DataFrame(
+        {"k": [1, 2], "v": [10.0, 20.0]})).write.format("delta").save(path)
+    spark.sql(f"CREATE TABLE b USING delta LOCATION '{path}'")
+    defining = "SELECT k, SUM(v) AS s FROM b GROUP BY k"
+    spark.sql(f"CACHE MATERIALIZED VIEW mv AS {defining}")
+    spark.createDataFrame(pd.DataFrame(
+        {"k": [2, 3], "nv": [200.0, 300.0]})).createOrReplaceTempView("src")
+    spark.sql("MERGE INTO b t USING src s ON t.k = s.k "
+              "WHEN MATCHED THEN UPDATE SET v = s.nv "
+              "WHEN NOT MATCHED THEN INSERT (k, v) VALUES (s.k, s.nv)")
+    _check_view_matches_definition(spark, defining)
+    got = spark.sql("SELECT s FROM mv WHERE k = 2").toPandas().s[0]
+    assert got == 200.0
+
+
+# ---------------------------------------------------------------------------
+# surfaces: EXPLAIN, FORMAT JSON, system table, scoped listing invalidation
+# ---------------------------------------------------------------------------
+
+def test_explain_surfaces(spark):
+    spark.sql("CREATE TABLE t (a INT)")
+    spark.sql("INSERT INTO t VALUES (1), (2)")
+    q = "SELECT SUM(a) AS s FROM t"
+    text0 = spark.sql("EXPLAIN " + q).toArrow().column(0)[0].as_py()
+    assert "cache: miss" in text0
+    spark.sql(q).toPandas()
+    text1 = spark.sql("EXPLAIN " + q).toArrow().column(0)[0].as_py()
+    assert "cache: hit" in text1 and "rc-" in text1
+    payload = json.loads(spark.sql(
+        "EXPLAIN FORMAT JSON " + q).toArrow().column(0)[0].as_py())
+    assert payload["result_cache"]["status"] == "hit"
+    assert payload["result_cache"]["bytes_served"] > 0
+    analyzed = spark.sql(
+        "EXPLAIN ANALYZE " + q).toArrow().column(0)[0].as_py()
+    assert "cache: hit" in analyzed
+
+
+def test_system_telemetry_result_cache_table(spark):
+    spark.sql("CREATE TABLE t (a INT)")
+    spark.sql("INSERT INTO t VALUES (1), (2)")
+    spark.sql("SELECT SUM(a) AS s FROM t").toPandas()
+    spark.sql("CACHE MATERIALIZED VIEW mv AS SELECT a FROM t")
+    rows = spark.sql(
+        "SELECT tier, id FROM system.telemetry.result_cache").toPandas()
+    tiers = set(rows.tier)
+    assert {"result", "fragment", "view"} <= tiers
+    assert any(i.startswith("mv-") for i in rows.id)
+
+
+def test_invalidate_listings_is_root_scoped(tmp_path):
+    d1 = _write_parquet_dir(tmp_path, "d1")
+    d2 = _write_parquet_dir(tmp_path, "d2")
+    expand_paths([d1])
+    expand_paths([d2])
+    invalidate_listings(d1)
+    m0, h0 = LISTING_CACHE.misses, LISTING_CACHE.hits
+    expand_paths([d1])
+    expand_paths([d2])
+    assert LISTING_CACHE.misses == m0 + 1  # d1 relisted
+    assert LISTING_CACHE.hits == h0 + 1    # d2 untouched
