@@ -785,6 +785,189 @@ def _run_continuous_bench(spark) -> dict:
             shutil.rmtree(root, ignore_errors=True)
 
 
+def _run_tail_latency(spark) -> dict:
+    """Tail-latency forensics artifact (retrace attribution + anomaly
+    verdicts, analysis/anomaly.py). A warmed continuous CDC join leg
+    runs on a 2-worker cluster with the durable event log on; after
+    the per-fingerprint baseline warms, periodic intervals carry a
+    batch in a NEW padded row-capacity bucket, so the join programs
+    retrace (cause=capacity-bucket) and those intervals land in the
+    p99 tail. The artifact records interval p50/p99, retraces-per-
+    minute by cause, every anomaly verdict the live ring held, whether
+    each tail outlier carries a non-``unexplained`` verdict naming the
+    join retrace, and whether ``replay_verdicts`` AND the offline
+    ``scripts/sail_timeline.py --anomalies`` (a fresh process) re-
+    derive the identical verdict list from the durable log alone.
+
+    ``SAIL_BENCH_DISABLE_ANOMALY=1`` (applied in main as
+    SAIL_TELEMETRY__ANOMALY__ENABLED=0) records the same run with the
+    classifier off — latencies only, no verdicts — for overhead A/B.
+    """
+    import glob as _glob
+    import shutil
+    import statistics
+    import subprocess
+    import tempfile
+
+    import pandas as pd
+    import pyarrow as pa
+
+    from sail_tpu import events as _events
+    from sail_tpu.analysis import anomaly as _anomaly
+    from sail_tpu.exec import retrace as _retrace
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.session import DataFrame
+    from sail_tpu.streaming import ReplayableMemorySource, _StreamRead
+
+    intervals = int(os.environ.get("SAIL_BENCH_TAIL_INTERVALS", "24"))
+    base_rows = int(os.environ.get("SAIL_BENCH_TAIL_ROWS", "2000"))
+    anomaly_on = os.environ.get(
+        "SAIL_TELEMETRY__ANOMALY__ENABLED", "1").strip().lower() \
+        not in ("0", "false", "no", "off")
+
+    log_dir = tempfile.mkdtemp(prefix="sail_tail_events_")
+    out_dir = tempfile.mkdtemp(prefix="sail_tail_out_")
+    ckpt = tempfile.mkdtemp(prefix="sail_tail_cp_")
+    saved = {k: os.environ.get(k) for k in (
+        "SAIL_TELEMETRY__EVENT_LOG__ENABLED",
+        "SAIL_TELEMETRY__EVENT_LOG__DIR",
+        "SAIL_STREAMING__CONTINUOUS__ENABLED")}
+    os.environ["SAIL_TELEMETRY__EVENT_LOG__ENABLED"] = "1"
+    os.environ["SAIL_TELEMETRY__EVENT_LOG__DIR"] = log_dir
+    os.environ["SAIL_STREAMING__CONTINUOUS__ENABLED"] = "1"
+    _events.reload()
+    _anomaly.reset()
+    _retrace.clear()
+
+    rng = np.random.default_rng(23)
+    schema = pa.schema([("k", pa.int64()), ("v", pa.int64())])
+    # steady intervals share one padded capacity; once the baseline
+    # has min_samples, every 6th interval delivers a batch 2×/4×/8×…
+    # larger — each crosses into a capacity bucket the join programs
+    # never compiled, so the interval pays a typed retrace
+    churn_mult, sizes = 2, []
+    for i in range(intervals):
+        if i >= 8 and i % 6 == 2:
+            sizes.append(base_rows * churn_mult * 4)
+            churn_mult *= 2
+        else:
+            sizes.append(base_rows)
+
+    def batch(n):
+        return pa.table({
+            "k": pa.array(rng.integers(0, 256, n), type=pa.int64()),
+            "v": pa.array(rng.integers(0, 10_000, n),
+                          type=pa.int64()),
+        }, schema=schema)
+
+    dim = pd.DataFrame({"k": np.arange(256, dtype=np.int64),
+                        "w": np.arange(256, dtype=np.int64) * 7})
+    spark.createDataFrame(dim).createOrReplaceTempView("tail_dim")
+    cluster = LocalCluster(num_workers=2)
+    interval_ms = []
+    t0 = time.perf_counter()
+    try:
+        src = ReplayableMemorySource(schema)
+        shaped = DataFrame(_StreamRead("tailbench", src), spark) \
+            .filter("v % 3 != 0").join(
+                spark.sql("SELECT * FROM tail_dim"), on="k",
+                how="inner")
+        q = (shaped.writeStream.format("parquet")
+             .option("checkpointLocation", ckpt).cluster(cluster)
+             .start(out_dir))
+        try:
+            for n in sizes:
+                src.add(batch(n))
+                ti = time.perf_counter()
+                q.processAllAvailable()
+                interval_ms.append(
+                    (time.perf_counter() - ti) * 1000.0)
+            engaged = q._cont_runner is not None
+        finally:
+            q.stop()
+    finally:
+        cluster.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    wall = time.perf_counter() - t0
+    qs = statistics.quantiles(interval_ms, n=100) \
+        if len(interval_ms) >= 2 else [0.0] * 99
+    minutes = max(wall / 60.0, 1e-9)
+    totals = _retrace.LEDGER.totals()
+    out = {
+        "intervals": intervals,
+        "rows_per_interval": base_rows,
+        "churn_intervals": sum(1 for i, n in enumerate(sizes)
+                               if n != base_rows),
+        "continuous_engaged": engaged,
+        "wall_s": round(wall, 4),
+        "interval_p50_ms": round(qs[49], 3),
+        "interval_p99_ms": round(qs[98], 3),
+        "anomaly_detection": "enabled" if anomaly_on else
+        "disabled(SAIL_BENCH_DISABLE_ANOMALY)",
+        "retraces": {
+            "totals": dict(sorted(totals.items())),
+            "per_minute": {c: round(n / minutes, 3)
+                           for c, n in sorted(totals.items())},
+        },
+    }
+    log_path = _events.EVENT_LOG.path
+    _events.reload()  # close the bench log segment before replaying
+    try:
+        if anomaly_on:
+            ring = _anomaly.anomalies()
+            verdicts = [{k: v[k] for k in
+                         ("query_id", "fingerprint", "total_ms",
+                          "baseline_p50_ms", "excess_ms", "verdict")}
+                        for v in ring]
+            named = sorted({c for v in ring
+                            for e in v["evidence"]
+                            if e["category"] == "retrace"
+                            for c in e.get("causes", {})})
+            out["anomalies"] = verdicts
+            out["outliers"] = len(ring)
+            out["outliers_explained"] = sum(
+                1 for v in ring if v["verdict"] != "unexplained")
+            out["retrace_causes_named"] = named
+            replay = _anomaly.replay_verdicts(
+                _events.load_event_log(log_path)) if log_path else []
+            out["replay_identical"] = json.dumps(
+                replay, sort_keys=True) == json.dumps(
+                ring, sort_keys=True)
+            timeline_script = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "scripts", "sail_timeline.py")
+            try:
+                proc = subprocess.run(
+                    [sys.executable, timeline_script, log_path,
+                     "--anomalies", "--json"],
+                    capture_output=True, text=True, timeout=120)
+                offline = json.loads(proc.stdout)["anomalies"]
+                out["offline_replay_identical"] = json.dumps(
+                    offline, sort_keys=True) == json.dumps(
+                    ring, sort_keys=True)
+            except Exception as e:  # noqa: BLE001
+                out["offline_replay_error"] = \
+                    f"{type(e).__name__}: {e}"
+            out["headline"] = (
+                f"p99 {out['interval_p99_ms']}ms "
+                f"({out['outliers_explained']}/{out['outliers']} tail "
+                f"outliers explained, causes={named}, "
+                f"replay_identical={out.get('replay_identical')})")
+        else:
+            out["headline"] = (
+                f"p99 {out['interval_p99_ms']}ms "
+                f"(anomaly detection disabled)")
+    finally:
+        shutil.rmtree(log_dir, ignore_errors=True)
+        shutil.rmtree(out_dir, ignore_errors=True)
+        shutil.rmtree(ckpt, ignore_errors=True)
+    return out
+
+
 def _run_shuffle_bench(spark) -> dict:
     """Cluster-path shuffle artifact: the join/agg-heavy queries where
     data movement dominates (q5/q18/q21) run through the local cluster,
@@ -1389,6 +1572,13 @@ def main():
         .strip().lower() in ("1", "true", "yes")
     if disable_aqe:
         os.environ["SAIL_ADAPTIVE__ENABLED"] = "false"
+    # A/B knob: SAIL_BENCH_DISABLE_ANOMALY=1 turns the tail-latency
+    # anomaly classifier (baselines + verdicts, analysis/anomaly.py)
+    # off for the whole run; the tail_latency section then records
+    # latencies only — the on/off pair measures classifier overhead
+    disable_anomaly = _env_on("SAIL_BENCH_DISABLE_ANOMALY")
+    if disable_anomaly:
+        os.environ["SAIL_TELEMETRY__ANOMALY__ENABLED"] = "0"
     # A/B knob: SAIL_BENCH_DISABLE_EVENTS=1 turns the flight-data
     # recorder off for the whole run — the event-emission overhead
     # check (acceptance: ≤ 2% on q1/q6 wall-clock) compares this run
@@ -1478,6 +1668,7 @@ def main():
         "shuffle_compression": "disabled" if disable_shuffle_comp
         else "enabled",
         "adaptive": "disabled" if disable_aqe else "enabled",
+        "anomaly": "disabled" if disable_anomaly else "enabled",
         "events": "disabled" if disable_events else "enabled",
         "pcache": "disabled" if disable_pcache else "enabled",
         "observability": obs_info,
@@ -1551,6 +1742,18 @@ def main():
             result["continuous"] = _run_continuous_bench(spark)
         except Exception as e:  # noqa: BLE001
             result["continuous_error"] = f"{type(e).__name__}: {e}"
+    # tail-latency forensics artifact: continuous CDC join leg driven
+    # through capacity-bucket churn — retraces-per-minute by cause,
+    # anomaly verdicts for every p99 outlier, durable-log replay
+    # parity (rides SAIL_BENCH_STREAMING=1, or SAIL_BENCH_TAIL=1
+    # alone; SAIL_BENCH_DISABLE_ANOMALY=1 records the classifier-off
+    # control)
+    if os.environ.get("SAIL_BENCH_STREAMING", "0").strip().lower() in (
+            "1", "true", "yes") or _env_on("SAIL_BENCH_TAIL"):
+        try:
+            result["tail_latency"] = _run_tail_latency(spark)
+        except Exception as e:  # noqa: BLE001
+            result["tail_latency_error"] = f"{type(e).__name__}: {e}"
     # chaos mode: TPC-H under a fixed fault seed, recovery overhead in
     # the artifact (opt-in: the run costs two extra cluster executions)
     if os.environ.get("SAIL_BENCH_CHAOS", "0").strip().lower() in (

@@ -1,0 +1,586 @@
+"""Tail-latency forensics: per-fingerprint latency baselines, anomaly
+verdicts, and tenant SLO burn-rate monitoring.
+
+Three cooperating pieces, all deterministic and replayable from the
+durable flight-recorder log alone:
+
+- :class:`BaselineStore` — a bounded LRU of per-plan-fingerprint
+  latency summaries. Each entry is a mergeable histogram state plus a
+  handful of counters (compile ms, spill bytes, cache hits) — never raw
+  samples, so memory is O(fingerprints × bounds) regardless of query
+  volume.
+- :func:`classify` — a PURE function from (query inputs, the query's
+  events, the fingerprint's baseline snapshot) to an anomaly record.
+  A completed query whose latency exceeds ``outlier_factor`` × the
+  baseline p50 gets a ranked verdict naming WHERE the excess went:
+  the query's own flight-recorder events are folded into per-category
+  wait evidence (``timeline.wait_evidence``) and the largest
+  contributor above ``min_evidence_ms`` wins; flag-style causes with
+  no duration of their own (spill, cache invalidation, governor defer)
+  break the tie, and ``unexplained`` is the honest fallback. Because
+  the classifier sees only event-derived inputs, replaying the durable
+  log (:func:`replay_verdicts`) reproduces the live ring bit for bit.
+- :class:`SloMonitor` — per-tenant SLO burn rates over fast/slow
+  windows, computed from timestamped snapshots of the fleet-merged
+  ``query.latency`` histograms: ``burn = fraction_above(target) /
+  (1 - objective)`` on the window delta (``HistogramState.subtract``),
+  the standard multi-window multi-burn-rate alerting shape. Pull-based
+  and side-effect-free apart from gauge recording, so ops endpoints,
+  Prometheus scrapes, and system tables all read the same numbers.
+
+Ordering contract: the profiler classifies a query BEFORE observing it
+into the baseline (an outlier must not dilute the baseline it is judged
+against) and before emitting ``query_end`` — so the durable log carries
+the classifier's exact inputs ahead of the verdict it implies.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import config as app_config
+from .. import events
+from .. import metrics
+from . import timeline
+
+#: Verdict tie-break order: when two evidence categories carry the same
+#: wait time, the earlier one here wins. The leading entries are the
+#: duration-bearing categories (largest-ms wins before order matters);
+#: the trailing three are flags with no duration of their own.
+#: ``unexplained`` is deliberately absent — it is the fallback, never
+#: evidence. Every entry must appear in events.VERDICT_CATEGORIES
+#: (lint: slo-taxonomy).
+EVIDENCE_ORDER: Tuple[str, ...] = (
+    "retrace",
+    "credit-stall",
+    "admission-queue-wait",
+    "fetch-wait",
+    "spill",
+    "cache-invalidation",
+    "governor-defer",
+)
+
+#: categories that carry no duration — they win only when nothing
+#: duration-bearing clears ``min_evidence_ms``
+_FLAG_CATEGORIES = ("spill", "cache-invalidation", "governor-defer")
+
+#: Baseline latency bounds in MILLISECONDS: 0.5ms × 1.25^i for 64
+#: buckets (~0.5ms … ~640s). The 1.25 growth bounds the in-bucket p50
+#: interpolation error to ≲12.5%, tight enough that a 2× outlier factor
+#: never mistakes bucket resolution for a regression.
+BASELINE_BOUNDS: Tuple[float, ...] = tuple(
+    round(0.5 * 1.25 ** i, 6) for i in range(64))
+
+
+def _conf() -> Dict[str, Any]:
+    """Anomaly-detection knobs (telemetry.anomaly.* in
+    application.yaml, SAIL_TELEMETRY__ANOMALY__* env). Read per call —
+    config layers env on every read, so tests and the bench A/B knob
+    can flip detection without a reload hook."""
+    g = app_config.get
+    return {
+        "enabled": app_config.truthy("telemetry.anomaly.enabled"),
+        "min_samples": int(g("telemetry.anomaly.min_samples", 5)),
+        "outlier_factor": float(
+            g("telemetry.anomaly.outlier_factor", 2.0)),
+        "min_excess_ms": float(
+            g("telemetry.anomaly.min_excess_ms", 20.0)),
+        "min_evidence_ms": float(
+            g("telemetry.anomaly.min_evidence_ms", 5.0)),
+        "ring_capacity": int(
+            g("telemetry.anomaly.ring_capacity", 256)),
+        "baseline_capacity": int(
+            g("telemetry.anomaly.baseline_capacity", 512)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# latency baselines — bounded per-fingerprint summaries
+# ---------------------------------------------------------------------------
+
+class _Baseline:
+    """One fingerprint's summary: histogram of total latency (ms) plus
+    additive counters. Everything here is derivable from the durable
+    event log (``query_end`` + ``retrace`` records), which is what
+    makes :func:`replay_verdicts` exact."""
+
+    __slots__ = ("latency", "count", "compile_ms", "spill_bytes",
+                 "cache_hits")
+
+    def __init__(self) -> None:
+        self.latency = metrics.HistogramState(BASELINE_BOUNDS)
+        self.count = 0
+        self.compile_ms = 0.0
+        self.spill_bytes = 0
+        self.cache_hits = 0
+
+
+class BaselineStore:
+    """Bounded LRU of per-fingerprint baselines. ``snapshot_for`` and
+    ``observe`` are separate so the profiler can classify against the
+    pre-query state and only then fold the query in."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = max(1, int(capacity))
+        self._entries: "OrderedDict[str, _Baseline]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot_for(self, fingerprint: str) -> Optional[Dict[str, Any]]:
+        """The classifier's view of one fingerprint: sample count, the
+        p50 estimate, and the historical cache-hit ratio (feeds the
+        cache-invalidation flag). None when the fingerprint is new."""
+        with self._lock:
+            e = self._entries.get(fingerprint)
+            if e is None:
+                return None
+            self._entries.move_to_end(fingerprint)
+            p50 = e.latency.quantile(0.5)
+            return {
+                "count": e.count,
+                "p50_ms": None if p50 is None else p50,
+                "hit_ratio": (e.cache_hits / e.count) if e.count else 0.0,
+            }
+
+    def observe(self, inputs: Dict[str, Any],
+                evs: List[dict]) -> None:
+        """Fold one completed query into its fingerprint's baseline."""
+        fp = inputs.get("fingerprint") or ""
+        if not fp:
+            return
+        with self._lock:
+            e = self._entries.get(fp)
+            if e is None:
+                e = self._entries[fp] = _Baseline()
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+            self._entries.move_to_end(fp)
+            e.latency.observe(float(inputs.get("total_ms", 0.0)))
+            e.count += 1
+            # compile cost INCLUDING the benign first-ever compile —
+            # the baseline tracks total spend, the verdict evidence
+            # (wait_evidence) excludes first-ever separately
+            for ev in evs:
+                if ev.get("type") == "retrace":
+                    e.compile_ms += float(ev.get("ms", 0.0) or 0.0)
+            e.spill_bytes += int(inputs.get("spill_bytes", 0) or 0)
+            if inputs.get("cache_status") in ("hit", "view"):
+                e.cache_hits += 1
+
+    def snapshot(self) -> List[dict]:
+        """Rows for system.telemetry / debugging: one per fingerprint."""
+        with self._lock:
+            rows = []
+            for fp, e in self._entries.items():
+                rows.append({
+                    "fingerprint": fp,
+                    "count": e.count,
+                    "p50_ms": e.latency.quantile(0.5),
+                    "p99_ms": e.latency.quantile(0.99),
+                    "compile_ms": round(e.compile_ms, 3),
+                    "spill_bytes": e.spill_bytes,
+                    "cache_hits": e.cache_hits,
+                })
+            return rows
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# ---------------------------------------------------------------------------
+# the classifier — pure function of event-derived inputs
+# ---------------------------------------------------------------------------
+
+def classify(inputs: Dict[str, Any], evs: List[dict],
+             baseline: Optional[Dict[str, Any]],
+             conf: Optional[Dict[str, Any]] = None) -> Optional[dict]:
+    """Anomaly verdict for one completed query, or None when the query
+    is not an outlier (no baseline yet, too few samples, or within
+    ``outlier_factor`` × p50 + ``min_excess_ms``).
+
+    ``inputs`` carries exactly what a ``query_end`` event does —
+    query_id, trace_id, fingerprint, total_ms, spill_bytes,
+    cache_status — so live classification and durable-log replay see
+    identical values. ``evs`` is the query's own event slice. The
+    returned record contains no wall-clock timestamps: it must be bit-
+    identical between the live ring and a replay of the same log.
+    """
+    if conf is None:
+        conf = _conf()
+    if baseline is None or baseline["count"] < conf["min_samples"]:
+        return None
+    p50 = baseline.get("p50_ms")
+    if p50 is None or p50 <= 0.0:
+        return None
+    total_ms = float(inputs.get("total_ms", 0.0))
+    if total_ms < p50 * conf["outlier_factor"]:
+        return None
+    p50_r = round(p50, 3)
+    excess = round(total_ms - p50_r, 3)
+    if excess < conf["min_excess_ms"]:
+        return None
+
+    wait = timeline.wait_evidence(evs)
+    causes: Dict[str, int] = {}
+    for ev in evs:
+        if ev.get("type") == "retrace" and \
+                ev.get("cause") != "first-ever":
+            c = str(ev.get("cause", ""))
+            causes[c] = causes.get(c, 0) + 1
+
+    candidates: List[dict] = []
+    for cat in ("retrace", "credit-stall", "admission-queue-wait",
+                "fetch-wait"):
+        d = wait[cat]
+        if d["events"]:
+            entry = {"category": cat, "ms": d["ms"],
+                     "events": d["events"]}
+            if cat == "retrace":
+                entry["causes"] = {k: causes[k] for k in sorted(causes)}
+            candidates.append(entry)
+    if int(inputs.get("spill_bytes", 0) or 0) > 0:
+        candidates.append({"category": "spill", "ms": 0.0, "events": 1,
+                           "bytes": int(inputs["spill_bytes"])})
+    if inputs.get("cache_status") == "miss" and \
+            baseline.get("hit_ratio", 0.0) >= 0.5:
+        # this fingerprint usually serves from cache; a miss on an
+        # outlier run points at an invalidation paying full price
+        candidates.append({"category": "cache-invalidation",
+                           "ms": 0.0, "events": 1})
+    if wait["governor-defer"]["events"]:
+        candidates.append({"category": "governor-defer", "ms": 0.0,
+                           "events": wait["governor-defer"]["events"]})
+
+    order = {c: i for i, c in enumerate(EVIDENCE_ORDER)}
+    candidates.sort(key=lambda c: (-c["ms"],
+                                   order.get(c["category"], 99)))
+    verdict = "unexplained"
+    for c in candidates:
+        if c["ms"] >= conf["min_evidence_ms"] or \
+                c["category"] in _FLAG_CATEGORIES:
+            verdict = c["category"]
+            break
+    return {
+        "query_id": inputs.get("query_id") or "",
+        "trace_id": inputs.get("trace_id") or "",
+        "fingerprint": inputs.get("fingerprint") or "",
+        "total_ms": round(total_ms, 3),
+        "baseline_p50_ms": p50_r,
+        "excess_ms": excess,
+        "verdict": verdict,
+        "evidence": candidates,
+    }
+
+
+# ---------------------------------------------------------------------------
+# live wiring — ring, profiler hook, EXPLAIN preview
+# ---------------------------------------------------------------------------
+
+BASELINES = BaselineStore(capacity=_conf()["baseline_capacity"])
+
+#: bounded ring of anomaly records, newest last (system table +
+#: bench assertions read this)
+_ANOMALIES: "deque[dict]" = deque(maxlen=_conf()["ring_capacity"])
+
+#: serializes classify→observe so concurrent finalizes cannot
+#: interleave between a query's classification and its baseline fold
+_LOCK = threading.Lock()
+
+
+def _inputs_from_profile(profile) -> Dict[str, Any]:
+    return {
+        "query_id": profile.query_id,
+        "trace_id": profile.trace_id or "",
+        "fingerprint": profile.plan_fingerprint,
+        "total_ms": round(profile.total_ms, 3),
+        "spill_bytes": profile.spill_bytes,
+        "cache_status": profile.cache_status,
+    }
+
+
+def _cut_at_query_end(evs: List[dict]) -> List[dict]:
+    """Everything before the query's ``query_end`` record: the exact
+    evidence set a durable-log replay reconstructs, regardless of
+    worker events racing in after finalize."""
+    for i in range(len(evs) - 1, -1, -1):
+        if evs[i].get("type") == "query_end":
+            return evs[:i]
+    return evs
+
+
+def on_profile_complete(profile) -> None:
+    """Profiler finalize hook: classify the completed query against its
+    fingerprint baseline, land any verdict in the ring + durable log,
+    THEN fold the query into the baseline. Called right after
+    ``query_end`` is emitted — the classifier cuts the event stream at
+    that record so live evidence equals replayed evidence."""
+    conf = _conf()
+    if not conf["enabled"]:
+        return
+    if profile.status != "succeeded" or not profile.plan_fingerprint:
+        return
+    inputs = _inputs_from_profile(profile)
+    evs = _cut_at_query_end(events.events(query_id=profile.query_id))
+    with _LOCK:
+        rec = classify(inputs, evs,
+                       BASELINES.snapshot_for(profile.plan_fingerprint),
+                       conf)
+        if rec is not None:
+            profile.anomaly_verdict = rec["verdict"]
+            profile.anomaly_excess_ms = rec["excess_ms"]
+            _ANOMALIES.append(rec)
+            try:
+                events.emit(
+                    events.EventType.ANOMALY,
+                    query_id=profile.query_id,
+                    trace_id=profile.trace_id,
+                    fingerprint=rec["fingerprint"],
+                    verdict=rec["verdict"],
+                    excess_ms=rec["excess_ms"],
+                    detail=json.dumps(rec, sort_keys=True,
+                                      separators=(",", ":")))
+            except Exception:  # noqa: BLE001 — log full/closed
+                pass
+        BASELINES.observe(inputs, evs)
+
+
+def preview(profile) -> None:
+    """Classify-only peek for EXPLAIN ANALYZE: stamps the verdict on
+    the profile so the rendered/JSON plan carries it, WITHOUT touching
+    the ring or the baseline — finalize does the real pass against the
+    same pre-query baseline state."""
+    conf = _conf()
+    if not conf["enabled"] or not profile.plan_fingerprint:
+        return
+    inputs = _inputs_from_profile(profile)
+    evs = events.events(query_id=profile.query_id)
+    with _LOCK:
+        rec = classify(inputs, evs,
+                       BASELINES.snapshot_for(profile.plan_fingerprint),
+                       conf)
+    if rec is not None:
+        profile.anomaly_verdict = rec["verdict"]
+        profile.anomaly_excess_ms = rec["excess_ms"]
+
+
+def anomalies() -> List[dict]:
+    """Snapshot of the live anomaly ring, oldest first."""
+    with _LOCK:
+        return list(_ANOMALIES)
+
+
+def reset() -> None:
+    """Drop all baselines, verdicts, and SLO snapshots (tests/bench)."""
+    with _LOCK:
+        BASELINES.clear()
+        _ANOMALIES.clear()
+    SLO_MONITOR.reset()
+
+
+# ---------------------------------------------------------------------------
+# durable-log replay — verdicts from the log alone
+# ---------------------------------------------------------------------------
+
+def replay_verdicts(records: List[dict],
+                    conf: Optional[Dict[str, Any]] = None) -> List[dict]:
+    """Re-derive every anomaly verdict from a durable event log,
+    bit-identical to what the live ring held: walk the records in file
+    order, accumulate each query's events, and on its ``query_end``
+    run the same classify→observe sequence against a fresh baseline
+    store. Prior ``anomaly`` records in the log are ignored — they are
+    the OUTPUT being reproduced, never input."""
+    if conf is None:
+        conf = _conf()
+    store = BaselineStore(capacity=conf["baseline_capacity"])
+    by_query: Dict[str, List[dict]] = {}
+    out: List[dict] = []
+    for rec in records:
+        t = rec.get("type")
+        if t == "anomaly":
+            continue
+        qid = rec.get("query_id") or ""
+        if qid:
+            by_query.setdefault(qid, []).append(rec)
+        if t != "query_end":
+            continue
+        if rec.get("status") != "succeeded":
+            by_query.pop(qid, None)
+            continue
+        fp = rec.get("fingerprint") or ""
+        if not fp:
+            by_query.pop(qid, None)
+            continue
+        inputs = {
+            "query_id": qid,
+            "trace_id": rec.get("trace_id") or "",
+            "fingerprint": fp,
+            "total_ms": float(rec.get("total_ms", 0.0) or 0.0),
+            "spill_bytes": int(rec.get("spill_bytes", 0) or 0),
+            "cache_status": rec.get("cache_status") or "",
+        }
+        evs = by_query.pop(qid, [])
+        verdict = classify(inputs, evs, store.snapshot_for(fp), conf)
+        if verdict is not None:
+            out.append(verdict)
+        store.observe(inputs, evs)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate monitor — multi-window, pull-based, deterministic
+# ---------------------------------------------------------------------------
+
+def _slo_conf() -> Dict[str, Any]:
+    g = app_config.get
+    conf: Dict[str, Any] = {
+        "enabled": app_config.truthy("slo.enabled"),
+        "target_ms": float(g("slo.target_ms", 1000.0)),
+        "objective": float(g("slo.objective", 0.99)),
+        "fast_window_s": float(g("slo.fast_window_s", 300.0)),
+        "slow_window_s": float(g("slo.slow_window_s", 3600.0)),
+        "tenants": {},
+    }
+    # slo.tenants.<name>.{target_ms,objective} from the flattened tree
+    prefix = "slo.tenants."
+    for key, value in app_config.app_config().items():
+        if not key.startswith(prefix):
+            continue
+        rest = key[len(prefix):]
+        tenant, _, field = rest.rpartition(".")
+        if not tenant or field not in ("target_ms", "objective"):
+            continue
+        conf["tenants"].setdefault(tenant, {})[field] = float(value)
+    return conf
+
+
+class SloMonitor:
+    """Per-tenant SLO burn rates over fast/slow windows.
+
+    Each :meth:`evaluate` call snapshots the fleet-merged
+    ``query.latency`` (phase=total) histogram per tenant, computes the
+    windowed delta against the snapshot taken at/just before the window
+    start, and reports ``fraction_above(target) / (1 - objective)`` —
+    1.0 means the error budget burns exactly at the sustainable rate;
+    a fast-window burn ≫ 1 alongside a slow-window burn > 1 is the
+    page-worthy shape. ``now`` is injectable so tests drive window
+    math against exact sample sets."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: (ts, {tenant: HistogramState}) snapshots, oldest first
+        self._snapshots: "deque[Tuple[float, Dict[str, object]]]" = \
+            deque()
+        #: explicit per-tenant overrides (session spark.sail.slo.*),
+        #: winning over slo.tenants.* config, winning over the global
+        #: target/objective
+        self._objectives: Dict[str, Dict[str, float]] = {}
+
+    def set_objective(self, tenant: str,
+                      target_ms: Optional[float] = None,
+                      objective: Optional[float] = None) -> None:
+        with self._lock:
+            cur = self._objectives.setdefault(str(tenant), {})
+            if target_ms is not None:
+                cur["target_ms"] = float(target_ms)
+            if objective is not None:
+                cur["objective"] = float(objective)
+
+    def objective_for(self, tenant: str,
+                      conf: Optional[Dict[str, Any]] = None
+                      ) -> Tuple[float, float]:
+        """(target_ms, objective) for one tenant after layering."""
+        if conf is None:
+            conf = _slo_conf()
+        target = conf["target_ms"]
+        objective = conf["objective"]
+        layered = conf["tenants"].get(tenant, {})
+        with self._lock:
+            explicit = dict(self._objectives.get(tenant, {}))
+        for src in (layered, explicit):
+            if "target_ms" in src:
+                target = float(src["target_ms"])
+            if "objective" in src:
+                objective = float(src["objective"])
+        return target, min(0.999999, max(0.0, objective))
+
+    def _merged_latency(self) -> Dict[str, object]:
+        merged: Dict[str, object] = {}
+        for _w, attrs, h in metrics.FLEET.histogram_states(
+                "query.latency"):
+            if attrs.get("phase") != "total":
+                continue
+            tenant = attrs.get("tenant", "default")
+            cur = merged.get(tenant)
+            if cur is None:
+                merged[tenant] = h
+            else:
+                cur.merge(h)
+        return merged
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """Take a snapshot and return burn-rate rows (one per tenant ×
+        window), recording ``cluster.slo.burn_rate`` gauges as a side
+        effect. Disabled → no snapshot, no rows."""
+        conf = _slo_conf()
+        if not conf["enabled"]:
+            return []
+        if now is None:
+            now = time.time()
+        merged = self._merged_latency()
+        windows = (("fast", conf["fast_window_s"]),
+                   ("slow", conf["slow_window_s"]))
+        with self._lock:
+            self._snapshots.append(
+                (now, {t: h.copy() for t, h in merged.items()}))
+            # keep one snapshot at/before the slow-window start so the
+            # slow delta always has an anchor; drop anything older
+            horizon = now - conf["slow_window_s"]
+            while len(self._snapshots) >= 2 and \
+                    self._snapshots[1][0] <= horizon:
+                self._snapshots.popleft()
+            snaps = list(self._snapshots)
+        rows: List[dict] = []
+        for tenant in sorted(merged):
+            cur = merged[tenant]
+            target_ms, objective = self.objective_for(tenant, conf)
+            threshold_s = target_ms / 1000.0  # query.latency unit: s
+            for window, span in windows:
+                anchor = None
+                for ts, states in snaps:
+                    if ts <= now - span:
+                        anchor = states.get(tenant) or anchor
+                    else:
+                        break
+                delta = cur.subtract(anchor) if anchor is not None \
+                    else cur.copy()
+                frac = delta.fraction_above(threshold_s)
+                burn = frac / (1.0 - objective)
+                metrics.record("cluster.slo.burn_rate", burn,
+                               tenant=tenant, window=window)
+                rows.append({
+                    "tenant": tenant,
+                    "window": window,
+                    "window_s": span,
+                    "target_ms": target_ms,
+                    "objective": objective,
+                    "queries": delta.count,
+                    "fraction_above": round(frac, 6),
+                    "burn_rate": round(burn, 6),
+                })
+        return rows
+
+    def reset(self) -> None:
+        with self._lock:
+            self._snapshots.clear()
+            self._objectives.clear()
+
+
+SLO_MONITOR = SloMonitor()

@@ -968,6 +968,157 @@ def lint_events(ctx: LintContext) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# tail-latency taxonomy drift (retrace causes + anomaly verdicts:
+# sail_tpu/events.py RETRACE_CAUSES / VERDICT_CATEGORIES)
+# ---------------------------------------------------------------------------
+
+def _declared_string_tuple(ctx: LintContext, relpath: str,
+                           varname: str) -> Optional[Tuple[str, ...]]:
+    """A module-level ``VARNAME = ("a", "b", …)`` literal from
+    ``relpath`` (AST walk — works on seeded, non-importable trees)."""
+    tree = ctx.tree(relpath)
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        else:
+            continue
+        if varname not in targets or \
+                not isinstance(node.value, (ast.Tuple, ast.List)):
+            continue
+        elts = [_fold_str(e) for e in node.value.elts]
+        if any(e is None for e in elts):
+            return None
+        return tuple(elts)  # type: ignore[arg-type]
+    return None
+
+
+def lint_slo_taxonomy(ctx: LintContext) -> List[Violation]:
+    """Forensics-taxonomy drift: every retrace cause string used in
+    code (``cause=`` kwargs, ``classify_*`` return literals in
+    exec/retrace.py) is declared in events.RETRACE_CAUSES; every
+    verdict/evidence category used by the anomaly classifier
+    (EVIDENCE_ORDER, _FLAG_CATEGORIES, ``verdict = "…"`` assignments,
+    ``{"category": "…"}`` literals, ``verdict=`` kwargs) is declared
+    in events.VERDICT_CATEGORIES; and every declared member of either
+    tuple appears somewhere under sail_tpu/ outside events.py — a
+    cause or verdict nobody can produce is dead vocabulary that
+    dashboards and the SLO runbook would still document."""
+    out: List[Violation] = []
+    causes = _declared_string_tuple(
+        ctx, "sail_tpu/events.py", "RETRACE_CAUSES")
+    verdicts = _declared_string_tuple(
+        ctx, "sail_tpu/events.py", "VERDICT_CATEGORIES")
+    if causes is None:
+        return [Violation(
+            "slo-taxonomy", "sail_tpu/events.py", 0,
+            "RETRACE_CAUSES missing or not a literal string tuple")]
+    if verdicts is None:
+        return [Violation(
+            "slo-taxonomy", "sail_tpu/events.py", 0,
+            "VERDICT_CATEGORIES missing or not a literal string "
+            "tuple")]
+    cause_set, verdict_set = set(causes), set(verdicts)
+
+    used_causes: Dict[str, Tuple[str, int]] = {}
+    used_verdicts: Dict[str, Tuple[str, int]] = {}
+    all_literals: Set[str] = set()
+    for relpath in ctx.python_sources():
+        if relpath == "sail_tpu/events.py":
+            continue
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                all_literals.add(node.value)
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    v = _fold_str(kw.value)
+                    if v is None:
+                        continue
+                    if kw.arg == "cause":
+                        used_causes.setdefault(
+                            v, (relpath, node.lineno))
+                    elif kw.arg == "verdict":
+                        used_verdicts.setdefault(
+                            v, (relpath, node.lineno))
+            if relpath == "sail_tpu/exec/retrace.py" and \
+                    isinstance(node, ast.FunctionDef) and \
+                    node.name.startswith("classify"):
+                for ret in ast.walk(node):
+                    if isinstance(ret, ast.Return) and \
+                            ret.value is not None:
+                        v = _fold_str(ret.value)
+                        if v is not None:
+                            used_causes.setdefault(
+                                v, (relpath, ret.lineno))
+            if relpath == "sail_tpu/analysis/anomaly.py":
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(
+                        node, ast.Assign) else [node.target]
+                    names = {t.id for t in targets
+                             if isinstance(t, ast.Name)}
+                    value = node.value
+                    if names & {"EVIDENCE_ORDER",
+                                "_FLAG_CATEGORIES"} and \
+                            isinstance(value, (ast.Tuple, ast.List)):
+                        for e in value.elts:
+                            v = _fold_str(e)
+                            if v is not None:
+                                used_verdicts.setdefault(
+                                    v, (relpath, e.lineno))
+                    elif "verdict" in names and value is not None:
+                        v = _fold_str(value)
+                        if v is not None:
+                            used_verdicts.setdefault(
+                                v, (relpath, node.lineno))
+                if isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if k is not None and \
+                                _fold_str(k) == "category":
+                            cat = _fold_str(v)
+                            if cat is not None:
+                                used_verdicts.setdefault(
+                                    cat, (relpath, v.lineno))
+
+    for cause in sorted(used_causes):
+        if cause not in cause_set:
+            relpath, line = used_causes[cause]
+            out.append(Violation(
+                "slo-taxonomy", relpath, line,
+                f"retrace cause {cause!r} is produced here but not "
+                f"declared in events.RETRACE_CAUSES"))
+    for verdict in sorted(used_verdicts):
+        if verdict not in verdict_set:
+            relpath, line = used_verdicts[verdict]
+            out.append(Violation(
+                "slo-taxonomy", relpath, line,
+                f"anomaly verdict {verdict!r} is produced here but "
+                f"not declared in events.VERDICT_CATEGORIES"))
+    for cause in causes:
+        if cause not in all_literals:
+            out.append(Violation(
+                "slo-taxonomy", "sail_tpu/events.py", 0,
+                f"retrace cause {cause!r} declared in RETRACE_CAUSES "
+                f"but never appears in code under sail_tpu/"))
+    for verdict in verdicts:
+        if verdict not in all_literals:
+            out.append(Violation(
+                "slo-taxonomy", "sail_tpu/events.py", 0,
+                f"anomaly verdict {verdict!r} declared in "
+                f"VERDICT_CATEGORIES but never appears in code under "
+                f"sail_tpu/"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # registry + runner
 # ---------------------------------------------------------------------------
 
@@ -980,6 +1131,7 @@ LINTS: Dict[str, Callable[[LintContext], List[Violation]]] = {
     "locks": lint_locks,
     "metrics": lint_metrics,
     "events": lint_events,
+    "slo-taxonomy": lint_slo_taxonomy,
 }
 
 

@@ -229,6 +229,54 @@ def _credit_stalls_in(evs: List[dict], t0: float, t1: float,
     return ms
 
 
+def wait_evidence(events: List[dict],
+                  query_id: Optional[str] = None) -> Dict[str, dict]:
+    """Per-category wait evidence for one query, from its events alone:
+    the raw material the anomaly classifier (analysis/anomaly.py) ranks
+    a verdict from. Each entry is ``{"ms", "events"}`` — the wall time
+    the events themselves carry (retrace compile ms excluding the
+    benign first-ever cold compile, backpressure ``stall_ms``,
+    ``admission_admit`` ``waited_ms``, ``task_finish``
+    ``fetch_wait_ms``) and how many events contributed;
+    ``governor_defer`` carries no duration, so it contributes a count
+    only. Works identically on the live ring and a replayed durable
+    log."""
+    out: Dict[str, dict] = {
+        "retrace": {"ms": 0.0, "events": 0},
+        "credit-stall": {"ms": 0.0, "events": 0},
+        "admission-queue-wait": {"ms": 0.0, "events": 0},
+        "fetch-wait": {"ms": 0.0, "events": 0},
+        "governor-defer": {"ms": 0.0, "events": 0},
+    }
+    for e in _for_query(events, query_id):
+        t = e.get("type")
+        if t == "retrace":
+            if e.get("cause") == "first-ever":
+                continue
+            out["retrace"]["ms"] += float(e.get("ms", 0.0) or 0.0)
+            out["retrace"]["events"] += 1
+        elif t == "backpressure":
+            ms = float(e.get("stall_ms", 0.0) or 0.0)
+            if ms > 0.0:
+                out["credit-stall"]["ms"] += ms
+                out["credit-stall"]["events"] += 1
+        elif t == "admission_admit":
+            ms = float(e.get("waited_ms", 0.0) or 0.0)
+            if ms > 0.0:
+                out["admission-queue-wait"]["ms"] += ms
+                out["admission-queue-wait"]["events"] += 1
+        elif t == "task_finish":
+            ms = float(e.get("fetch_wait_ms", 0.0) or 0.0)
+            if ms > 0.0:
+                out["fetch-wait"]["ms"] += ms
+                out["fetch-wait"]["events"] += 1
+        elif t == "governor_defer":
+            out["governor-defer"]["events"] += 1
+    for v in out.values():
+        v["ms"] = round(v["ms"], 3)
+    return out
+
+
 def continuous_progress(events: List[dict],
                         query_id: Optional[str] = None) -> List[dict]:
     """Marker progress of a continuous pipeline, replayable from the

@@ -154,6 +154,11 @@ class SystemRegistry:
                     "compile_cache_misses": pa.array(
                         [r["compile"]["cache_misses"] for r in rows],
                         pa.int64()),
+                    "retrace_count": pa.array(
+                        [(r.get("retraces") or {}).get("count", 0)
+                         for r in rows], pa.int64()),
+                    "anomaly_verdict": pa.array(
+                        [r.get("anomaly_verdict", "") for r in rows]),
                     "transfer_bytes": pa.array(
                         [r["transfer_bytes"] for r in rows], pa.int64()),
                     "spill_bytes": pa.array(
@@ -259,6 +264,23 @@ class SystemRegistry:
                 def ms(h, q):
                     v = h.quantile(q) if h is not None else None
                     return None if v is None else v * 1000.0
+                # declared objectives + burn rates from the SLO
+                # monitor (analysis/anomaly.py): reading this table IS
+                # an evaluation tick, same as a /metrics scrape
+                slo_rows: Dict[str, Dict[str, dict]] = {}
+                objectives: Dict[str, tuple] = {}
+                try:
+                    from ..analysis.anomaly import SLO_MONITOR
+                    for r in SLO_MONITOR.evaluate():
+                        slo_rows.setdefault(
+                            r["tenant"], {})[r["window"]] = r
+                    for t in tenants:
+                        objectives[t] = SLO_MONITOR.objective_for(t)
+                except Exception:  # noqa: BLE001 — monitor disabled
+                    pass
+                def burn(t, w):
+                    r = slo_rows.get(t, {}).get(w)
+                    return None if r is None else r["burn_rate"]
                 return pa.table({
                     "tenant": pa.array(tenants),
                     "queries": pa.array(
@@ -279,6 +301,60 @@ class SystemRegistry:
                     "deadline_cancel_count": pa.array(
                         [int(cancels.get(t, 0)) for t in tenants],
                         pa.int64()),
+                    "slo_target_ms": pa.array(
+                        [objectives.get(t, (None,))[0]
+                         for t in tenants], pa.float64()),
+                    "slo_objective": pa.array(
+                        [objectives.get(t, (None, None))[1]
+                         for t in tenants], pa.float64()),
+                    "burn_rate_fast": pa.array(
+                        [burn(t, "fast") for t in tenants],
+                        pa.float64()),
+                    "burn_rate_slow": pa.array(
+                        [burn(t, "slow") for t in tenants],
+                        pa.float64()),
+                })
+            if (database, name) == ("telemetry", "retraces"):
+                from ..exec.retrace import LEDGER
+                rows = LEDGER.snapshot()
+                return pa.table({
+                    "fingerprint": pa.array(
+                        [r["fingerprint"] for r in rows]),
+                    "key": pa.array([r["key"] for r in rows]),
+                    "cause": pa.array([r["cause"] for r in rows]),
+                    "count": pa.array(
+                        [r["count"] for r in rows], pa.int64()),
+                    "signatures": pa.array(
+                        [r["signatures"] for r in rows], pa.int64()),
+                    "evictions": pa.array(
+                        [r["evictions"] for r in rows], pa.int64()),
+                    "first_ts": pa.array(
+                        [r["first_ts"] for r in rows], pa.float64()),
+                    "last_ts": pa.array(
+                        [r["last_ts"] for r in rows], pa.float64()),
+                })
+            if (database, name) == ("telemetry", "anomalies"):
+                import json
+                from ..analysis import anomaly as _anomaly
+                rows = _anomaly.anomalies()
+                return pa.table({
+                    "query_id": pa.array(
+                        [r["query_id"] for r in rows]),
+                    "trace_id": pa.array(
+                        [r["trace_id"] for r in rows]),
+                    "fingerprint": pa.array(
+                        [r["fingerprint"] for r in rows]),
+                    "verdict": pa.array([r["verdict"] for r in rows]),
+                    "total_ms": pa.array(
+                        [r["total_ms"] for r in rows], pa.float64()),
+                    "baseline_p50_ms": pa.array(
+                        [r["baseline_p50_ms"] for r in rows],
+                        pa.float64()),
+                    "excess_ms": pa.array(
+                        [r["excess_ms"] for r in rows], pa.float64()),
+                    "evidence": pa.array(
+                        [json.dumps(r["evidence"], sort_keys=True,
+                                    default=str) for r in rows]),
                 })
             if (database, name) == ("telemetry", "events"):
                 import json

@@ -57,6 +57,36 @@ EVENT_SCHEMA_VERSION = 1
 RESERVED_KEYS = ("v", "seq", "ts", "type", "query_id", "trace_id",
                  "task")
 
+#: typed causes a compile miss can be attributed to (exec/retrace.py).
+#: The ``slo-taxonomy`` lint enforces that every cause literal emitted
+#: in code appears here and vice versa. ``first-ever`` is the benign
+#: cold compile of a never-seen program; everything else is a RETRACE —
+#: a program the process (or pcache) had and lost, or a shape drift.
+RETRACE_CAUSES: Tuple[str, ...] = (
+    "first-ever",          # fingerprint never compiled in this process
+    "new-aval-signature",  # genuinely new arg structure/dtype/shape
+    "capacity-bucket",     # same structure, only a leading (padded
+                           # capacity) dim changed — round_capacity churn
+    "eviction",            # in-memory op-cache evicted the program
+    "pcache-eviction",     # persistent store had it and lost it
+    "pcache-poison",       # persistent entry poisoned (undeserializable)
+    "env-skew",            # persistent entry refused: env fingerprint skew
+)
+
+#: ranked root-cause verdict categories the anomaly classifier
+#: (analysis/anomaly.py) may emit; lint-enforced both ways like
+#: :data:`RETRACE_CAUSES`.
+VERDICT_CATEGORIES: Tuple[str, ...] = (
+    "retrace",
+    "credit-stall",
+    "admission-queue-wait",
+    "fetch-wait",
+    "spill",
+    "cache-invalidation",
+    "governor-defer",
+    "unexplained",
+)
+
 #: the declared vocabulary: event type → attribute keys. ``stage`` /
 #: ``partition`` on fetch events are the PRODUCER task's coordinates;
 #: ``dst_stage`` / ``dst_partition`` the consuming task's
@@ -64,11 +94,17 @@ RESERVED_KEYS = ("v", "seq", "ts", "type", "query_id", "trace_id",
 EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # query lifecycle (driver/session side, all execution paths)
     "query_start": ("statement", "session", "tenant"),
-    "query_end": ("status", "rows_out", "total_ms"),
+    "query_end": ("status", "rows_out", "total_ms", "fingerprint",
+                  "spill_bytes", "cache_status"),
     # a stage program was bound: source=trace is a compiled-operator
     # cache miss (JIT wall time in ms), source=persistent a stored AOT
     # executable loaded from the cross-process cache (load wall time)
     "compile": ("key", "ms", "source"),
+    # a compile miss attributed to a typed cause (exec/retrace.py):
+    # ``fp`` is the program fingerprint the retrace ledger keys on,
+    # ``cause`` ∈ RETRACE_CAUSES, ``ms`` the compile wall time,
+    # ``site`` the decision site (memory | pcache)
+    "retrace": ("key", "fp", "cause", "ms", "site"),
     # per-stage backend routing decision (exec/router.py): backend in
     # native | xla | mesh; stage -1 = the plan-level mesh-vs-local
     # gate; reason names the deciding rule (forced, cost-model,
@@ -141,6 +177,12 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
                      "wait_ms", "buffered_bytes"),
     "backpressure": ("job_id", "stage", "partition", "channel",
                      "stall_ms"),
+    # a completed profile classified as a tail-latency outlier
+    # (analysis/anomaly.py): ``verdict`` ∈ VERDICT_CATEGORIES,
+    # ``excess_ms`` total_ms minus the baseline p50, ``detail`` the
+    # canonical sort_keys JSON of the ranked evidence — replaying the
+    # durable log re-derives verdicts bit-identically
+    "anomaly": ("fingerprint", "verdict", "excess_ms", "detail"),
 }
 
 
@@ -151,6 +193,8 @@ class EventType:
     QUERY_START = "query_start"
     QUERY_END = "query_end"
     COMPILE = "compile"
+    RETRACE = "retrace"
+    ANOMALY = "anomaly"
     BACKEND_ROUTE = "backend_route"
     STAGE_SUBMIT = "stage_submit"
     STAGE_COMPLETE = "stage_complete"

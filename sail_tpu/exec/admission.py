@@ -767,11 +767,18 @@ class SessionAdmission:
                     f"{self.conf.queue_timeout_ms}ms); retry after "
                     f"backoff", tenant=tenant,
                     retry_after_ms=self.conf.queue_timeout_ms or 1000)
+        waited_ms = round((time.time() - t0) * 1000.0, 3)
         events.emit(EventType.ADMISSION_ADMIT, query_id=query_id,
-                    job_id="", tenant=tenant,
-                    waited_ms=round((time.time() - t0) * 1000.0, 3))
+                    job_id="", tenant=tenant, waited_ms=waited_ms)
         _record_metric("cluster.admission.queue_wait_time",
                        max(0.0, time.time() - t0), tenant=tenant)
+        try:
+            # the gate runs on the query thread: charge the wait to
+            # the active profile (anomaly evidence + EXPLAIN ANALYZE)
+            from .. import profiler
+            profiler.note_admission_wait(waited_ms)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
         self._tls.depth = 1
         return _Ticket(self, tenant)
 

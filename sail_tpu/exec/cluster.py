@@ -1370,6 +1370,12 @@ class DriverActor(Actor):
             self._continuous_start(cj, reply)
         elif kind == "continuous_stop":
             self._continuous_stop(payload)
+        elif kind == "continuous_sync":
+            # FIFO barrier (ContinuousJobRunner.sync_reports): by the
+            # time this reply fires, every report enqueued before the
+            # ask — including resident-task event flushes — has been
+            # ingested
+            payload.set(True)
 
     # -- continuous streaming: resident task scheduling ------------------
     def _continuous_start(self, cj: "cont._DriverContinuousJob",

@@ -806,18 +806,23 @@ class ResidentTask:
                                                      batch=source))
                     self._acc.clear()
                     self._push_table(out)
-                self._push_marker(marker)
-                _record_metric("streaming.continuous.backlog_bytes",
-                               self.aligner.backlog_bytes())
                 # ship the buffered flight-recorder events at marker
                 # cadence (numbered flush, deduped driver-side): a
                 # long-lived task must not hoard its marker_align /
                 # backpressure events until death — or overflow the
-                # bounded collector and drop them entirely
+                # bounded collector and drop them entirely. The flush
+                # goes out BEFORE the marker so the root cannot align
+                # interval N until every task's interval-N events
+                # (retraces, stalls) are already enqueued at the
+                # driver — run_interval's sync barrier then makes them
+                # visible to the trigger's profile deterministically
                 self._flushes += 1
                 worker._report(task, "running",
                                recorder=self.recorder,
                                report_seq=self._flushes)
+                self._push_marker(marker)
+                _record_metric("streaming.continuous.backlog_bytes",
+                               self.aligner.backlog_bytes())
         except Fenced:
             fenced = True  # zombie: a relaunch owns the channels
         except faults.WorkerCrash:
@@ -961,11 +966,14 @@ class ContinuousJobRunner:
         self.tenant = tenant or "default"
         self.conf = conf()
         self.generation = 0
-        # every event of this pipeline incarnation attributes to the
-        # query that STARTED it (captured at start), so one pipeline's
-        # markers/stalls reconstruct as one coherent timeline even
-        # though later triggers run under per-epoch query profiles
+        # events attribute to the CURRENT trigger's query profile:
+        # captured at start, restamped at every run_interval — so a
+        # slow trigger's verdict (analysis/anomaly.py) finds the
+        # resident-task retraces/stalls that delayed IT, not the
+        # query that started the pipeline. job_id still threads the
+        # intervals into one pipeline timeline.
         self.query_id = ""
+        self._cj: Optional["_DriverContinuousJob"] = None
         self.failed: Optional[str] = None
         self._fail_ev = threading.Event()
         self.graph = jg.split_job(node, num_partitions)
@@ -1013,6 +1021,7 @@ class ContinuousJobRunner:
             credit_bytes=self.conf["credit_bytes"],
             align_buffer_bytes=self.conf["align_buffer_bytes"])
         cj = _DriverContinuousJob(self)
+        self._cj = cj
         from .. import profiler
         prof = profiler.current_profile()
         if prof is not None:
@@ -1127,6 +1136,15 @@ class ContinuousJobRunner:
         if self.failed:
             raise RuntimeError(f"continuous pipeline failed: "
                                f"{self.failed}")
+        # restamp: this interval's events (driver marker emits AND the
+        # resident-task flushes ingested below) attribute to the
+        # trigger profile that is paying for the interval
+        from .. import profiler
+        prof = profiler.current_profile()
+        if prof is not None:
+            self.query_id = prof.query_id
+            if self._cj is not None:
+                self._cj.query_id = prof.query_id
         t0 = time.perf_counter()
         if table is not None and table.num_rows:
             self.push_batch(table)
@@ -1168,6 +1186,25 @@ class ContinuousJobRunner:
         root_plan = _reattach_local_scans(root_plan,
                                           self.graph.scan_tables)
         result = LocalExecutor().execute(root_plan)
+        # FIFO barrier on the driver actor: every resident task
+        # flushed its interval-N events BEFORE pushing marker N, and
+        # the root only aligned after every marker arrived — so by now
+        # all flush reports sit in the actor inbox. Draining it makes
+        # the interval's worker-side evidence visible to the trigger's
+        # profile (anomaly classification at finalize) without racing.
+        self.sync_reports()
         _record_metric("streaming.continuous.latency",
                        time.perf_counter() - t0)
         return result
+
+    def sync_reports(self, timeout: float = 5.0) -> None:
+        """Block until the driver actor has processed every message
+        enqueued before this call (its inbox is FIFO) — i.e. every
+        already-sent resident-task report and its piggybacked event
+        flush has been ingested into the cluster event log."""
+        try:
+            self.cluster.driver.handle.ask(
+                lambda reply: ("continuous_sync", reply),
+                timeout=timeout)
+        except Exception:  # noqa: BLE001 — telemetry-only barrier
+            pass

@@ -340,36 +340,75 @@ class _OpCache:
                 return value
         value = builder()
         while len(self.entries) >= self.max_entries:
-            self.entries.popitem(last=False)  # LRU eviction
+            evicted_key, _ = self.entries.popitem(last=False)  # LRU
+            try:
+                # the dropped program's next compile is an eviction
+                # retrace — the ledger keeps its signature history
+                from . import retrace
+                retrace.LEDGER.note_eviction(evicted_key[0])
+            except Exception:  # noqa: BLE001 — forensics never break exec
+                pass
         self.entries[(key, ident)] = (tuple(dict_objs), value)
         return value
 
 
 def _compile_timed(fn, key, fused=False):
-    """Wrap a jitted fn so its FIRST call — where tracing and XLA
-    compilation actually happen (jax.jit itself is lazy) — is timed and
-    charged to the query that missed the operator cache. ``fused`` marks
-    whole-stage programs: their compile time additionally rides
-    ``execution.fusion.compile_time``."""
-    from .. import profiler
-    from ..metrics import timer as _metric_timer
+    """Wrap a jitted fn so every call that actually traces and XLA-
+    compiles (jax.jit itself is lazy) is timed, charged to the active
+    query, and attributed to a typed retrace cause (exec/retrace.py).
 
+    Detection: jax's jitted callables expose ``_cache_size()`` — the
+    number of compiled signatures resident in the jit cache. A call
+    after which it GREW compiled; anything else ran a bound executable.
+    That sees every beyond-first-call retrace (new aval signature,
+    capacity-bucket churn) the old first-call-only timing was blind to.
+    When the introspection hook is absent, only the first call is timed
+    (the pre-forensics behavior). ``fused`` marks whole-stage programs:
+    their compile time additionally rides
+    ``execution.fusion.compile_time``."""
+    import time as _time
+
+    from .. import profiler
+    from . import retrace
+
+    cache_size = getattr(fn, "_cache_size", None)
     pending = [True]
 
+    def _charge(elapsed_s: float, args) -> None:
+        key_repr = repr(key[0]) if isinstance(key, tuple) and key \
+            else repr(key)
+        if fused:
+            try:
+                from ..metrics import record as _record_metric
+                _record_metric("execution.fusion.compile_time",
+                               elapsed_s)
+            except Exception:  # noqa: BLE001 — timing must never raise
+                pass
+        profiler.note_compile_time(elapsed_s, key=key_repr)
+        from . import pcache
+        retrace.attribute(key, pcache.signature(args), elapsed_s,
+                          site="memory")
+
     def wrapper(*args, **kwargs):
-        if pending:
+        first = bool(pending)
+        if cache_size is None:
+            if not first:
+                return fn(*args, **kwargs)
             del pending[:]
-            # fused programs additionally observe into the fusion
-            # compile-latency histogram; the same handle feeds the
-            # profile either way
-            with _metric_timer("execution.fusion.compile_time"
-                               if fused else None) as tm:
-                out = fn(*args, **kwargs)
-            key_repr = repr(key[0]) if isinstance(key, tuple) and key \
-                else repr(key)
-            profiler.note_compile_time(tm.elapsed_s, key=key_repr)
+            t0 = _time.perf_counter()
+            out = fn(*args, **kwargs)
+            _charge(_time.perf_counter() - t0, args)
             return out
-        return fn(*args, **kwargs)
+        n0 = cache_size()
+        t0 = _time.perf_counter()
+        out = fn(*args, **kwargs)
+        if cache_size() > n0:
+            if first:
+                del pending[:]
+            _charge(_time.perf_counter() - t0, args)
+        elif first:
+            del pending[:]
+        return out
 
     return wrapper
 
@@ -426,11 +465,12 @@ class _Rtf(NamedTuple):
 
 
 def clear_caches():
-    from . import result_cache
+    from . import result_cache, retrace
     _OP_CACHE.entries.clear()
     _RTF_HISTORY.clear()
     _RUNTIME_CACHE_SIZES.clear()
     result_cache.clear_all()
+    retrace.clear()
 
 
 class LocalExecutor:

@@ -323,12 +323,22 @@ def load(digest: str, site: str = "op"):
     stored program, or None (miss / any failure, counted). Corrupt
     entries are deleted (a later store repairs them); intact-but-
     undeserializable ones are poison-marked so no process retries."""
+    return _load(digest, site=site)[0]
+
+
+def _load(digest: str, site: str = "op"):
+    """:func:`load` with the miss TYPED for retrace attribution:
+    returns ``(callable_or_None, reason)``, reason ∈ {``hit``,
+    ``absent``, ``poison``, ``skew``, ``error``} — poison covers both
+    the pre-existing marker and a fresh intact-but-undeserializable
+    entry; skew an entry refused for env/header mismatch; error an
+    unreadable or corrupt blob."""
     from .. import faults
     path = _entry_path(digest)
     if os.path.exists(_marker_path(digest)):
         _count("execution.compile.persistent_miss_count")
         _note_profile(False)
-        return None
+        return None, "poison"
     try:
         faults.inject("io.cache", key=f"load:{site}:{digest[:12]}")
         t0 = time.perf_counter()
@@ -337,13 +347,14 @@ def load(digest: str, site: str = "op"):
     except FileNotFoundError:
         _count("execution.compile.persistent_miss_count")
         _note_profile(False)
-        return None
+        return None, "absent"
     except (OSError, faults.FaultInjectedError):
         _count("execution.compile.persistent_load_error_count")
         _count("execution.compile.persistent_miss_count")
         _note_profile(False)
-        return None
+        return None, "error"
     intact = False
+    reason = "error"
     try:
         if not blob.startswith(_MAGIC):
             raise ValueError("bad magic")
@@ -352,6 +363,7 @@ def load(digest: str, site: str = "op"):
         if header.get("v") != FORMAT_VERSION or \
                 header.get("digest") != digest or \
                 header.get("env") != list(env_fingerprint()):
+            reason = "skew"
             raise ValueError("entry/key skew")
         from jax.experimental import serialize_executable as se
         payload, in_tree, out_tree = pickle.loads(blob[nl + 1:])
@@ -363,12 +375,12 @@ def load(digest: str, site: str = "op"):
         _note_profile(False)
         if intact:
             _poison(digest)
-        else:
-            try:  # useless bytes: drop them so a later store repairs
-                os.unlink(path)
-            except OSError:
-                pass
-        return None
+            return None, "poison"
+        try:  # useless bytes: drop them so a later store repairs
+            os.unlink(path)
+        except OSError:
+            pass
+        return None, reason
     seconds = time.perf_counter() - t0
     _count("execution.compile.persistent_hit_count")
     _note_profile(True, seconds)
@@ -390,7 +402,7 @@ def load(digest: str, site: str = "op"):
                                     seconds=seconds, source="persistent")
     except Exception:  # noqa: BLE001
         pass
-    return loaded
+    return loaded, "hit"
 
 
 def store(digest: str, compiled, compile_s: float,
@@ -643,13 +655,20 @@ class PersistentProgram:
 
         from .. import profiler
         from ..metrics import timer as _metric_timer
+        from . import retrace
 
         digest = None
+        reason = None
         if sig is not None and self._digest_base() is not None:
             digest = entry_digest(self._key_repr, self._dict_digest, sig)
         if digest is not None:
-            loaded = load(digest, site=self._site)
+            loaded, reason = _load(digest, site=self._site)
             if loaded is not None:
+                # bound without compiling: remember the signature (and
+                # that this process held the digest) so a later
+                # recompile attributes as an eviction, not a cold miss
+                retrace.LEDGER.note_digest(digest)
+                retrace.LEDGER.note_bound(self._key, sig)
                 return loaded
         elif enabled():
             # unpersistable program (identity key / opaque host data):
@@ -663,8 +682,11 @@ class PersistentProgram:
         key_repr = repr(self._key[0]) if isinstance(self._key, tuple) \
             and self._key else self._key_repr
         profiler.note_compile_time(tm.elapsed_s, key=key_repr)
+        retrace.attribute(self._key, sig, tm.elapsed_s, site="pcache",
+                          pcache_reason=reason, digest=digest)
         if digest is not None and not _has_host_callback(lowered):
-            store(digest, compiled, tm.elapsed_s, site=self._site)
+            if store(digest, compiled, tm.elapsed_s, site=self._site):
+                retrace.LEDGER.note_digest(digest)
         return compiled
 
     def __call__(self, *args):

@@ -148,6 +148,30 @@ class HistogramState:
         return {f"p{int(q * 100)}": self.quantile(q)
                 for q in SLO_QUANTILES}
 
+    def fraction_above(self, threshold: float) -> float:
+        """Fraction of observations above ``threshold`` — the SLO
+        burn-rate numerator — interpolating linearly inside the bucket
+        the threshold lands in. Overflow-bucket observations all count
+        as above (they exceed the last finite bound; the estimate's
+        resolution IS the bucket, as with :meth:`quantile`)."""
+        if self.count <= 0:
+            return 0.0
+        threshold = float(threshold)
+        above = 0.0
+        for i, c in enumerate(self.counts):
+            if c <= 0:
+                continue
+            if i >= len(self.bounds):
+                above += c
+                continue
+            upper = self.bounds[i]
+            lower = self.bounds[i - 1] if i > 0 else 0.0
+            if threshold <= lower:
+                above += c
+            elif threshold < upper:
+                above += c * (upper - threshold) / (upper - lower)
+        return above / self.count
+
     def to_wire(self) -> dict:
         return {"counts": list(self.counts), "sum": self.sum,
                 "count": self.count}

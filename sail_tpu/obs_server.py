@@ -19,10 +19,12 @@ daemon thread (no new dependencies), gated by
   readmission, and no wedged admission queue; 503 otherwise (body says
   why). A process with no cluster is ready by definition.
 - ``GET /debug/queries | /debug/workers | /debug/admission |
-  /debug/compile_cache | /debug/events?n=N``  JSON introspection of
-  the flight recorder, worker pool, admission state, the persistent
-  compiled-program cache (entry count, bytes, hit ratio, top entries
-  by compile time saved), and the newest N ring events.
+  /debug/compile_cache | /debug/slo | /debug/events?n=N``  JSON
+  introspection of the flight recorder, worker pool, admission state,
+  the persistent compiled-program cache (entry count, bytes, hit
+  ratio, top entries by compile time saved), the tenant SLO burn-rate
+  view (evaluating the monitor is the tick; also refreshed on every
+  /metrics scrape), and the newest N ring events.
 
 The surface is auth-free and bound to ``telemetry.http.host``
 (default loopback); it exposes statements and runtime state but never
@@ -225,6 +227,22 @@ def _debug_events(n: int) -> dict:
     return {"count": len(records), "events": records[-max(1, n):]}
 
 
+def _debug_slo() -> dict:
+    """Tenant SLO burn-rate view: evaluates the monitor (taking a
+    fresh snapshot and refreshing the cluster.slo.burn_rate gauges)
+    and returns the per-tenant/per-window rows alongside the newest
+    anomaly verdicts. Pull-based: hitting this endpoint IS the
+    evaluation tick."""
+    from .analysis import anomaly as _anomaly
+    try:
+        rows = _anomaly.SLO_MONITOR.evaluate()
+    except Exception as e:  # noqa: BLE001 — snapshot best-effort
+        return {"error": f"{type(e).__name__}: {e}"}
+    return {"slo": rows,
+            "anomalies": _anomaly.anomalies()[-32:],
+            "baselines": _anomaly.BASELINES.snapshot()[:64]}
+
+
 def _debug_compile_cache() -> dict:
     """Persistent compiled-program cache snapshot: store shape, the
     registry's hit/miss/evict/load-error counters, and the top entries
@@ -273,6 +291,14 @@ class _Handler(BaseHTTPRequestHandler):
             url = urlparse(self.path)
             path = url.path.rstrip("/") or "/"
             if path == "/metrics":
+                # refresh the SLO burn-rate gauges so a scrape reads
+                # window math current as of the scrape, not of the
+                # last /debug/slo hit
+                try:
+                    from .analysis import anomaly as _anomaly
+                    _anomaly.SLO_MONITOR.evaluate()
+                except Exception:  # noqa: BLE001 — scrape still serves
+                    pass
                 self._send(200, render_prometheus().encode("utf-8"),
                            "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":
@@ -290,6 +316,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(_debug_admission())
             elif path == "/debug/compile_cache":
                 self._json(_debug_compile_cache())
+            elif path == "/debug/slo":
+                self._json(_debug_slo())
             elif path == "/debug/events":
                 q = parse_qs(url.query)
                 try:
@@ -302,7 +330,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "/metrics", "/healthz", "/readyz",
                     "/debug/queries", "/debug/workers",
                     "/debug/admission", "/debug/compile_cache",
-                    "/debug/events?n="]}, 404)
+                    "/debug/slo", "/debug/events?n="]}, 404)
         except BrokenPipeError:  # client went away mid-write
             pass
         except Exception as e:  # noqa: BLE001 — ops surface never dies

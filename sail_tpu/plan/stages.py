@@ -275,3 +275,18 @@ def plan_fingerprint(plan: pn.PlanNode):
                 pass
         parts.append(fp)
     return tuple(parts), tuple(sources)
+
+
+def plan_fingerprint_hash(plan: pn.PlanNode) -> str:
+    """Short hex digest of the whole-plan structural fingerprint — the
+    key the latency-baseline store and anomaly classifier
+    (analysis/anomaly.py) group repeated executions under. Memory
+    tables fingerprint by ``id``, so the digest is process-local (the
+    same stability contract the retrace ledger has); "" when the plan
+    is unfingerprintable."""
+    import hashlib
+    try:
+        key, _sources = plan_fingerprint(plan)
+        return hashlib.sha256(repr(key).encode()).hexdigest()[:16]
+    except Exception:  # noqa: BLE001 — unfingerprintable plan
+        return ""

@@ -79,6 +79,25 @@ class QueryProfile:
     # per-key compile events: [{"key": str, "ms": float, "source":
     # "trace" | "persistent"}] — one per stage program bound
     compile_events: List[dict] = field(default_factory=list)
+    # retrace forensics (exec/retrace.py): every compile this query paid
+    # attributed by typed cause. ``retrace_count``/``retrace_ms``
+    # EXCLUDE first-ever (the benign cold compile) — they count
+    # programs the process HAD and lost, or shape drift; the causes
+    # dict keeps the full breakdown including first-ever
+    retrace_count: int = 0
+    retrace_ms: float = 0.0
+    retrace_causes: Dict[str, int] = field(default_factory=dict)
+    # plan fingerprint the baseline store and anomaly classifier key on
+    # (session.py: sha of the structural plan key; "" when the plan is
+    # unfingerprintable)
+    plan_fingerprint: str = ""
+    # anomaly classification (analysis/anomaly.py, set at finalize):
+    # verdict ∈ events.VERDICT_CATEGORIES when the query was a
+    # tail-latency outlier against its fingerprint baseline, else ""
+    anomaly_verdict: str = ""
+    anomaly_excess_ms: float = 0.0
+    # admission-control queue wait this query paid before running
+    admission_wait_ms: float = 0.0
     # per-stage backend routing decisions (exec/router.py):
     # [{"stage": int, "kind": str, "backend": str, "reason": str}]
     backend_routes: List[dict] = field(default_factory=list)
@@ -211,6 +230,20 @@ class QueryProfile:
                 self.compile_events.append(
                     {"key": key[:120], "ms": round(ms, 3),
                      "source": source})
+
+    def note_retrace(self, cause: str, seconds: float) -> None:
+        """One attributed compile (exec/retrace.py). First-ever cold
+        compiles ride the causes breakdown only."""
+        with self._lock:
+            self.retrace_causes[cause] = \
+                self.retrace_causes.get(cause, 0) + 1
+            if cause != "first-ever":
+                self.retrace_count += 1
+                self.retrace_ms += seconds * 1000.0
+
+    def note_admission_wait(self, waited_ms: float) -> None:
+        with self._lock:
+            self.admission_wait_ms += float(waited_ms)
 
     def note_persistent(self, hit: bool, seconds: float = 0.0) -> None:
         with self._lock:
@@ -411,6 +444,15 @@ class QueryProfile:
                 "time_ms": round(self.compile_ms, 3),
                 "events": list(self.compile_events),
             },
+            "plan_fingerprint": self.plan_fingerprint,
+            "retraces": {
+                "count": self.retrace_count,
+                "ms": round(self.retrace_ms, 3),
+                "causes": dict(self.retrace_causes),
+            },
+            "admission_wait_ms": round(self.admission_wait_ms, 3),
+            "anomaly_verdict": self.anomaly_verdict,
+            "anomaly_excess_ms": round(self.anomaly_excess_ms, 3),
             "backends": list(self.backend_routes),
             "transfer_bytes": self.transfer_bytes,
             "spill_bytes": self.spill_bytes,
@@ -491,6 +533,18 @@ class QueryProfile:
             if self.persistent_hits:
                 line += f" load={self.persistent_load_ms:.1f}ms"
             lines.append(line)
+        if self.retrace_causes:
+            causes = " ".join(
+                f"{c}={n}"
+                for c, n in sorted(self.retrace_causes.items()))
+            lines.append(f"retraces: {self.retrace_count} "
+                         f"({causes}) {self.retrace_ms:.1f}ms")
+        if self.anomaly_verdict:
+            lines.append(f"anomaly: {self.anomaly_verdict} "
+                         f"(+{self.anomaly_excess_ms:.1f}ms vs baseline)")
+        if self.admission_wait_ms:
+            lines.append(
+                f"admission wait: {self.admission_wait_ms:.1f}ms")
         if self.backend_routes:
             routed = " ".join(
                 f"s{r.get('stage')}={r.get('backend')}"
@@ -758,7 +812,23 @@ def _finalize(profile: QueryProfile, threshold_ms: float) -> None:
                      query_id=profile.query_id,
                      trace_id=profile.trace_id, status=profile.status,
                      rows_out=profile.rows_out,
-                     total_ms=round(profile.total_ms, 3))
+                     total_ms=round(profile.total_ms, 3),
+                     fingerprint=profile.plan_fingerprint,
+                     spill_bytes=profile.spill_bytes,
+                     cache_status=profile.cache_status)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # classify AFTER the query_end emit: the classifier cuts the
+        # event stream at the query_end record, so the evidence set it
+        # sees is exactly what a durable-log replay sees (events
+        # racing in from workers after the cut are excluded on BOTH
+        # sides). It still observes the profile into its baseline only
+        # after classifying — an outlier must not pollute the baseline
+        # it was judged against. The OTLP span below carries the
+        # verdict.
+        from .analysis import anomaly as _anomaly
+        _anomaly.on_profile_complete(profile)
     except Exception:  # noqa: BLE001
         pass
     try:
@@ -787,7 +857,12 @@ def _finalize(profile: QueryProfile, threshold_ms: float) -> None:
                      "query.adaptive.broadcast":
                          profile.adaptive_broadcast,
                      "query.adaptive.reordered":
-                         profile.adaptive_reordered}
+                         profile.adaptive_reordered,
+                     "query.plan_fingerprint": profile.plan_fingerprint,
+                     "query.retrace_count": profile.retrace_count,
+                     "query.anomaly.verdict": profile.anomaly_verdict,
+                     "query.anomaly.excess_ms":
+                         round(profile.anomaly_excess_ms, 3)}
             if profile.cache_status or profile.cache_fragments \
                     or profile.scan_share_attached:
                 attrs["query.result_cache.status"] = \
@@ -884,6 +959,30 @@ def note_compile_event(key: str, seconds: float,
     profile = current_profile()
     if profile is not None:
         profile.note_compile_loaded(seconds, key)
+
+
+def note_retrace(cause: str, seconds: float) -> None:
+    """One attributed compile (exec/retrace.py) on the current query;
+    transparent without a profile (the event/metric surfaces still
+    record it)."""
+    profile = current_profile()
+    if profile is not None:
+        profile.note_retrace(cause, seconds)
+
+
+def note_admission_wait(waited_ms: float) -> None:
+    """Admission-queue wall time the current query paid before running
+    (exec/admission.py)."""
+    profile = current_profile()
+    if profile is not None:
+        profile.note_admission_wait(waited_ms)
+
+
+def note_plan_fingerprint(fp: str) -> None:
+    """Stamp the plan fingerprint the baseline/anomaly plane keys on."""
+    profile = current_profile()
+    if profile is not None and fp:
+        profile.plan_fingerprint = fp
 
 
 def note_backend_routes(routes) -> None:

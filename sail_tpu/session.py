@@ -157,6 +157,11 @@ class SparkSession:
                 self.conf.get("spark.sql.session.timeZone") or "UTC")
             try:
                 node = self._resolve(plan)
+                # the baseline/anomaly plane keys repeated executions by
+                # structural plan fingerprint (analysis/anomaly.py)
+                from .plan.stages import plan_fingerprint_hash
+                profiler.note_plan_fingerprint(
+                    plan_fingerprint_hash(node))
                 # result cache: a fingerprint+version-vector hit serves
                 # the stored table and skips execution entirely (local,
                 # mesh and cluster paths alike); a miss measures the
@@ -456,6 +461,23 @@ class SparkSession:
         if isinstance(cmd, sp.SetVariable):
             if cmd.name and cmd.value is not None:
                 self.conf.set(cmd.name, cmd.value)
+                if cmd.name in ("spark.sail.slo.targetMs",
+                                "spark.sail.slo.objective"):
+                    # register the session tenant's SLO objective with
+                    # the burn-rate monitor: explicit session mirrors
+                    # win over slo.tenants.* config and the global
+                    # slo.{target_ms,objective} defaults
+                    try:
+                        from .analysis.anomaly import SLO_MONITOR
+                        v = float(cmd.value)
+                        if cmd.name.endswith("targetMs"):
+                            SLO_MONITOR.set_objective(
+                                self.tenant, target_ms=v)
+                        else:
+                            SLO_MONITOR.set_objective(
+                                self.tenant, objective=v)
+                    except (TypeError, ValueError):
+                        pass
                 return pa.table({"key": pa.array([cmd.name]),
                                  "value": pa.array([cmd.value])})
             if cmd.name:
@@ -503,6 +525,11 @@ class SparkSession:
                 from . import profiler
                 from . import telemetry as tel
                 prof = profiler.current_profile()
+                # the analyzed plan is the one the baseline/anomaly
+                # plane must key this profile under
+                from .plan.stages import plan_fingerprint_hash
+                profiler.note_plan_fingerprint(
+                    plan_fingerprint_hash(node))
                 t0 = _t.perf_counter()
                 cached = rc.RESULT_CACHE.lookup(rc_probe) \
                     if rc_probe is not None else None
@@ -536,6 +563,15 @@ class SparkSession:
                 if prof is not None:
                     prof.operators = ops
                     prof.rows_out = result.num_rows
+                    try:
+                        # classify now so the rendered payload carries
+                        # the verdict the finalize pass will land (the
+                        # baseline only observes at finalize, so both
+                        # classify against the same state)
+                        from .analysis import anomaly as _anomaly
+                        _anomaly.preview(prof)
+                    except Exception:  # noqa: BLE001
+                        pass
                 if cmd.format == "json":
                     import json as _json
                     payload = prof.to_dict() if prof is not None else \

@@ -870,6 +870,12 @@ class StreamingQuery:
             if not found:
                 self._cont_disabled = True
                 return None
+            # the resident pipeline's structural fingerprint: every
+            # per-trigger profile carries it, so trigger latencies of
+            # one pipeline accumulate under ONE latency baseline
+            # (analysis/anomaly.py) across the run
+            from .plan.stages import plan_fingerprint_hash
+            self._cont_fp = plan_fingerprint_hash(node)
             from .config import get as config_get
             try:
                 nparts = int(config_get("cluster.shuffle_partitions",
@@ -900,6 +906,9 @@ class StreamingQuery:
                     f"{runner.failed}")
             self._cont_runner = runner
         try:
+            from . import profiler
+            profiler.note_plan_fingerprint(
+                getattr(self, "_cont_fp", ""))
             return self._cont_runner.run_interval(epoch, batch)
         except Exception:
             # a failed interval kills this pipeline incarnation: the
@@ -911,6 +920,9 @@ class StreamingQuery:
     def _execute_plan(self, bound: sp.QueryPlan, epoch: int):
         if self._cluster is not None:
             node = self._session._resolve(bound)
+            from . import profiler
+            from .plan.stages import plan_fingerprint_hash
+            profiler.note_plan_fingerprint(plan_fingerprint_hash(node))
             # epoch jobs bill to the owning session's tenant — a
             # streaming query must not escape its tenant's caps/quota
             # by running under the default tenant

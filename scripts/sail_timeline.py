@@ -5,6 +5,7 @@ Usage:
     python scripts/sail_timeline.py <event-log.jsonl>           # all queries
     python scripts/sail_timeline.py <event-log.jsonl> --query <id>
     python scripts/sail_timeline.py <event-log.jsonl> --json    # machine view
+    python scripts/sail_timeline.py <event-log.jsonl> --anomalies
 
 Reconstructs each query's run from the append-only event log alone —
 stage/task Gantt timeline, the decision sequence (adaptive rewrites,
@@ -16,6 +17,14 @@ critical-path attribution — with no access to the live process. The
 reconstruction is the SAME computation the live profile runs
 (sail_tpu/analysis/timeline.py), so for a fixed fault seed the replayed
 decision sequence is bit-identical to what EXPLAIN ANALYZE reported.
+
+``--query`` accepts a query id OR a trace id (resolved against the
+log's envelopes). ``--anomalies`` re-derives every tail-latency
+anomaly verdict from the log alone — the same classify→observe walk
+the live process ran (sail_tpu/analysis/anomaly.py replay_verdicts),
+so the printed verdict list is bit-identical to what the live anomaly
+ring held for the run that wrote the log.
+
 A truncated tail (crash mid-write) replays cleanly up to the last
 complete record. Rotated logs replay across segment boundaries: pass
 the ACTIVE path (events-<pid>.jsonl) and its .1/.2/… siblings are
@@ -36,14 +45,55 @@ from sail_tpu.analysis import timeline  # noqa: E402
 from sail_tpu.events import load_event_log  # noqa: E402
 
 
+def resolve_query(events, ident: str) -> str:
+    """Map ``ident`` to a query id: an exact query-id match wins,
+    else the first query whose trace_id matches."""
+    qids = set(timeline.query_ids(events))
+    if ident in qids:
+        return ident
+    for e in events:
+        if e.get("trace_id") == ident and e.get("query_id"):
+            return e["query_id"]
+    return ident
+
+
+def render_anomalies(verdicts, as_json: bool) -> str:
+    if as_json:
+        return json.dumps({"anomalies": verdicts}, indent=2,
+                          default=str)
+    if not verdicts:
+        return "no anomalies (no query exceeded its baseline)"
+    lines = [f"{len(verdicts)} anomal"
+             f"{'y' if len(verdicts) == 1 else 'ies'}"]
+    for v in verdicts:
+        lines.append(
+            f"  {v['query_id']}  fp={v['fingerprint']}  "
+            f"{v['total_ms']:.1f}ms vs p50 {v['baseline_p50_ms']:.1f}ms"
+            f"  (+{v['excess_ms']:.1f}ms)  verdict={v['verdict']}")
+        for ev in v.get("evidence", ()):
+            detail = f"    - {ev['category']}: {ev['ms']:.1f}ms " \
+                     f"({ev['events']} events)"
+            if ev.get("causes"):
+                detail += "  causes=" + ",".join(
+                    f"{c}={n}" for c, n in sorted(ev["causes"].items()))
+            if ev.get("bytes"):
+                detail += f"  bytes={ev['bytes']}"
+            lines.append(detail)
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0])
     ap.add_argument("log", help="durable JSONL event log to replay")
     ap.add_argument("--query", default=None,
-                    help="restrict to one query id")
+                    help="restrict to one query id or trace id")
     ap.add_argument("--json", action="store_true",
                     help="emit the reconstruction as JSON")
+    ap.add_argument("--anomalies", action="store_true",
+                    help="re-derive tail-latency anomaly verdicts "
+                         "from the log alone (bit-identical to the "
+                         "live anomaly ring)")
     args = ap.parse_args(argv)
 
     try:
@@ -51,7 +101,20 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"cannot replay {args.log}: {e}", file=sys.stderr)
         return 2
-    qids = [args.query] if args.query else timeline.query_ids(events)
+
+    if args.anomalies:
+        from sail_tpu.analysis import anomaly
+        verdicts = anomaly.replay_verdicts(events)
+        if args.query:
+            qid = resolve_query(events, args.query)
+            verdicts = [v for v in verdicts
+                        if v["query_id"] == qid
+                        or v["trace_id"] == args.query]
+        print(render_anomalies(verdicts, args.json))
+        return 0
+
+    qids = [resolve_query(events, args.query)] if args.query \
+        else timeline.query_ids(events)
     if not qids:
         print(f"{args.log}: {len(events)} events, no queries",
               file=sys.stderr)

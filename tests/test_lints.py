@@ -270,6 +270,49 @@ def test_seeded_orphan_event_type(seeded):
     assert any("lint_seed_orphan" in v.message for v in found), found
 
 
+def test_seeded_undeclared_retrace_cause(seeded):
+    # a classify_* helper in exec/retrace.py returning a cause string
+    # that RETRACE_CAUSES does not declare must go red
+    _append(seeded, "sail_tpu/exec/retrace.py",
+            "\n\ndef classify_seeded(key):\n"
+            "    return \"lint-seed-bogus-cause\"\n")
+    found = _run(seeded, "slo-taxonomy")
+    assert any("lint-seed-bogus-cause" in v.message
+               for v in found), found
+
+
+def test_seeded_undeclared_evidence_category(seeded):
+    # an EVIDENCE_ORDER element outside VERDICT_CATEGORIES must go red
+    path = os.path.join(seeded, "sail_tpu/analysis/anomaly.py")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    assert 'EVIDENCE_ORDER: Tuple[str, ...] = (' in src
+    src = src.replace('EVIDENCE_ORDER: Tuple[str, ...] = (',
+                      'EVIDENCE_ORDER: Tuple[str, ...] = (\n    "lint-seed-bogus-'
+                      'verdict",', 1)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src)
+    found = _run(seeded, "slo-taxonomy")
+    assert any("lint-seed-bogus-verdict" in v.message
+               for v in found), found
+
+
+def test_seeded_orphan_retrace_cause(seeded):
+    # a declared cause no code path can produce is dead vocabulary
+    path = os.path.join(seeded, "sail_tpu/events.py")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    assert 'RETRACE_CAUSES: Tuple[str, ...] = (' in src
+    src = src.replace('RETRACE_CAUSES: Tuple[str, ...] = (',
+                      'RETRACE_CAUSES: Tuple[str, ...] = (\n    "lint-seed-orphan-'
+                      'cause",', 1)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src)
+    found = _run(seeded, "slo-taxonomy")
+    assert any("lint-seed-orphan-cause" in v.message
+               for v in found), found
+
+
 def test_runner_exits_nonzero_on_seeded_drift(seeded):
     _append(seeded, "sail_tpu/io/cache.py", "\n\ndef _seeded_drift():\n"
             "    from ..config import get as config_get\n"
