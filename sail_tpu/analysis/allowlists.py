@@ -38,6 +38,21 @@ SYNC_POINTS = {
 }
 
 # ---------------------------------------------------------------------------
+# capacity-policy lint: direct ``round_capacity`` calls bypass the
+# pinned grow-only bucket registry (exec/capacity.py). The reviewed
+# exceptions are the policy helper itself and the registry's raw
+# rounding — everything else sizes through
+# ``columnar.batch.bucket_capacity``.
+# ---------------------------------------------------------------------------
+
+CAPACITY_POLICY = {
+    # THE policy helper: its keyless fallback is the raw rounding
+    ("sail_tpu/columnar/batch.py", "bucket_capacity"),
+    # the registry computes the raw bucket a pin starts from / grows to
+    ("sail_tpu/exec/capacity.py", "BucketRegistry.bucket_for"),
+}
+
+# ---------------------------------------------------------------------------
 # config-key lint: keys declared in application.yaml whose read sites
 # build the key dynamically (the AST scanner cannot see them), plus
 # prefixes that are read through f-strings / layering machinery. A

@@ -174,6 +174,21 @@ class BaselineStore:
             if inputs.get("cache_status") in ("hit", "view"):
                 e.cache_hits += 1
 
+    def p99_for(self, fingerprint: str
+                ) -> Optional[Tuple[int, float]]:
+        """(count, p99_ms) of one fingerprint's latency baseline — the
+        read-only view the backend router's SLO feedback loop consumes
+        (exec/router.py). Never touches LRU recency: a routing consult
+        must not keep a fingerprint alive."""
+        with self._lock:
+            e = self._entries.get(fingerprint)
+            if e is None:
+                return None
+            p99 = e.latency.quantile(0.99)
+            if p99 is None:
+                return None
+            return e.count, float(p99)
+
     def snapshot(self) -> List[dict]:
         """Rows for system.telemetry / debugging: one per fingerprint."""
         with self._lock:
@@ -478,6 +493,9 @@ class SloMonitor:
         #: (ts, {tenant: HistogramState}) snapshots, oldest first
         self._snapshots: "deque[Tuple[float, Dict[str, object]]]" = \
             deque()
+        #: rows of the LAST evaluate() call — the recorded state the
+        #: router's SLO feedback reads (it never triggers a snapshot)
+        self._last_rows: List[dict] = []
         #: explicit per-tenant overrides (session spark.sail.slo.*),
         #: winning over slo.tenants.* config, winning over the global
         #: target/objective
@@ -575,12 +593,26 @@ class SloMonitor:
                     "fraction_above": round(frac, 6),
                     "burn_rate": round(burn, 6),
                 })
+        with self._lock:
+            self._last_rows = list(rows)
         return rows
+
+    def burn_for(self, tenant: str) -> Optional[float]:
+        """The tenant's worst burn rate across windows from the LAST
+        :meth:`evaluate` — recorded state, so a router decision made
+        from it is a pure function of its inputs and replays
+        identically. None until an evaluation has covered the
+        tenant."""
+        with self._lock:
+            burns = [r["burn_rate"] for r in self._last_rows
+                     if r["tenant"] == tenant]
+        return max(burns) if burns else None
 
     def reset(self) -> None:
         with self._lock:
             self._snapshots.clear()
             self._objectives.clear()
+            self._last_rows = []
 
 
 SLO_MONITOR = SloMonitor()

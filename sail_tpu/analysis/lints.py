@@ -211,6 +211,15 @@ def _config_literal_evidence(ctx: LintContext) -> Set[str]:
 
 
 def lint_config_keys(ctx: LintContext) -> List[Violation]:
+    capcalls = [(relpath, qual) for relpath, qual, _l
+                in capacity_calls(ctx)
+                if (relpath, qual) not in allowlists.CAPACITY_POLICY]
+    if capcalls:
+        lines.append("# add to CAPACITY_POLICY in "
+                     "sail_tpu/analysis/allowlists.py (or route the "
+                     "call through bucket_capacity):")
+        for relpath, qual in sorted(set(capcalls)):
+            lines.append(f'    ("{relpath}", "{qual}"),')
     declared = declared_config_keys(ctx)
     if not declared:
         return [Violation("config-keys",
@@ -499,6 +508,70 @@ def lint_sync_points(ctx: LintContext) -> List[Violation]:
             f"{attr} in {qual} is a host sync not on the reviewed "
             f"allowlist (sail_tpu/analysis/allowlists.py SYNC_POINTS; "
             f"scripts/sail_lint.py --fix-allowlist prints the stub)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# capacity-policy: every padded-capacity derivation routes through the
+# one bucket-policy helper (columnar/batch.py bucket_capacity), so the
+# pinned grow-only registry (exec/capacity.py) is the single choke
+# point warm paths size batches through
+# ---------------------------------------------------------------------------
+
+class _CapacityCallVisitor(ast.NodeVisitor):
+    """Collect (qualname, line) for direct ``round_capacity(...)``
+    calls (bare name or attribute)."""
+
+    def __init__(self):
+        self.stack: List[str] = []
+        self.hits: List[Tuple[str, int]] = []
+
+    def _scoped(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _scoped
+    visit_AsyncFunctionDef = _scoped
+    visit_ClassDef = _scoped
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else \
+            f.attr if isinstance(f, ast.Attribute) else None
+        if name == "round_capacity":
+            qual = ".".join(self.stack) or "<module>"
+            self.hits.append((qual, node.lineno))
+        self.generic_visit(node)
+
+
+def capacity_calls(ctx: LintContext) -> List[Tuple[str, str, int]]:
+    """(relpath, qualname, line) of every direct round_capacity call
+    anywhere under sail_tpu/ — the policy helper and the registry are
+    the only reviewed callers."""
+    out = []
+    for relpath in ctx.python_sources():
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        v = _CapacityCallVisitor()
+        v.visit(tree)
+        for qual, line in v.hits:
+            out.append((relpath, qual, line))
+    return out
+
+
+def lint_capacity_policy(ctx: LintContext) -> List[Violation]:
+    out = []
+    for relpath, qual, line in capacity_calls(ctx):
+        if (relpath, qual) in allowlists.CAPACITY_POLICY:
+            continue
+        out.append(Violation(
+            "capacity-policy", relpath, line,
+            f"direct round_capacity call in {qual} bypasses the pinned "
+            f"bucket policy — size through columnar.batch."
+            f"bucket_capacity (or add a reviewed CAPACITY_POLICY "
+            f"allowlist entry in sail_tpu/analysis/allowlists.py)"))
     return out
 
 
@@ -1128,6 +1201,7 @@ LINTS: Dict[str, Callable[[LintContext], List[Violation]]] = {
     "fault-sites": lint_fault_sites,
     "proto": lint_proto,
     "sync-points": lint_sync_points,
+    "capacity-policy": lint_capacity_policy,
     "locks": lint_locks,
     "metrics": lint_metrics,
     "events": lint_events,
@@ -1158,6 +1232,15 @@ def fix_allowlist_stubs(root: str = REPO_ROOT) -> str:
         lines.append("# add to SYNC_POINTS in "
                      "sail_tpu/analysis/allowlists.py:")
         for relpath, qual in sorted(set(sync)):
+            lines.append(f'    ("{relpath}", "{qual}"),')
+    capcalls = [(relpath, qual) for relpath, qual, _l
+                in capacity_calls(ctx)
+                if (relpath, qual) not in allowlists.CAPACITY_POLICY]
+    if capcalls:
+        lines.append("# add to CAPACITY_POLICY in "
+                     "sail_tpu/analysis/allowlists.py (or route the "
+                     "call through bucket_capacity):")
+        for relpath, qual in sorted(set(capcalls)):
             lines.append(f'    ("{relpath}", "{qual}"),')
     declared = declared_config_keys(ctx)
     orphan = [v for v in lint_config_keys(ctx)

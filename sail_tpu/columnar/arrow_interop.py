@@ -24,7 +24,8 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from ..spec import data_type as dt
-from .batch import Column, DeviceBatch, HostBatch, make_batch, round_capacity
+from .batch import (Column, DeviceBatch, HostBatch, bucket_capacity,
+                    make_batch)
 
 
 def arrow_type_to_spec(t: pa.DataType) -> dt.DataType:
@@ -163,10 +164,16 @@ def _unscaled_int64_to_decimal(vals: np.ndarray, validity: Optional[np.ndarray],
                                  [null_buf, data_buf])
 
 
-def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> HostBatch:
-    """Convert a pyarrow Table to a HostBatch (uploads to default device)."""
+def from_arrow(table: pa.Table, capacity: Optional[int] = None,
+               bucket_key=None) -> HostBatch:
+    """Convert a pyarrow Table to a HostBatch (uploads to default device).
+
+    ``bucket_key`` names the consuming program (structural cache key) so
+    the pinned-bucket registry can hold the padded capacity stable
+    across calls — see :func:`columnar.batch.bucket_capacity`."""
     n = table.num_rows
-    cap = capacity if capacity is not None else round_capacity(n)
+    cap = capacity if capacity is not None else \
+        bucket_capacity(n, key=bucket_key)
     columns: Dict[str, Tuple[np.ndarray, Optional[np.ndarray], dt.DataType]] = {}
     dicts: Dict[str, pa.Array] = {}
     for name, col in zip(table.column_names, table.columns):

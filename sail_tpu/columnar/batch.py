@@ -181,6 +181,30 @@ def round_capacity(n: int, minimum: Optional[int] = None) -> int:
     return 2 * p
 
 
+def bucket_capacity(n: int, key=None,
+                    minimum: Optional[int] = None) -> int:
+    """THE capacity policy: every padded-capacity derivation in the
+    engine routes through here (the capacity-policy lint fails direct
+    ``round_capacity`` calls anywhere else).
+
+    With a ``key`` (a structural program/stage cache key — the same
+    vocabulary the retrace ledger fingerprints), delegates to the
+    pinned grow-only bucket registry (``exec/capacity.py``): once a
+    program is warmed its bucket only grows, and growth needs a
+    sustained overflow streak, so oscillating input sizes stop crossing
+    bucket boundaries (zero capacity-bucket retraces after warmup).
+    Without a key — or with pinning disabled — this is plain
+    ``round_capacity`` rounding.
+    """
+    if key is None:
+        return round_capacity(n, minimum)
+    try:
+        from ..exec.capacity import bucket_for
+    except ImportError:
+        return round_capacity(n, minimum)
+    return bucket_for(key, n, minimum)
+
+
 def physical_jnp_dtype(d: dt.DataType):
     if isinstance(d, (dt.ArrayType, dt.MapType, dt.StructType)):
         return jnp.dtype("int32")  # dictionary code handle (values on host)
@@ -191,10 +215,12 @@ def physical_jnp_dtype(d: dt.DataType):
 
 
 def make_batch(columns: Dict[str, Tuple[np.ndarray, Optional[np.ndarray], dt.DataType]],
-               num_rows: int, capacity: Optional[int] = None) -> DeviceBatch:
+               num_rows: int, capacity: Optional[int] = None,
+               bucket_key=None) -> DeviceBatch:
     import jax
 
-    cap = capacity if capacity is not None else round_capacity(num_rows)
+    cap = capacity if capacity is not None else \
+        bucket_capacity(num_rows, key=bucket_key)
     host = {}
     types = {}
     for name, (values, validity, dtype) in columns.items():

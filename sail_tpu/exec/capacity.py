@@ -1,0 +1,184 @@
+"""Pinned grow-only capacity buckets: warm paths stop crossing bucket
+boundaries.
+
+The PR 17 retrace ledger (exec/retrace.py) names ``capacity-bucket``
+churn as the cause behind every continuous-join p99 outlier: a warmed
+program re-traces because ``round_capacity`` re-derived a different
+padded capacity for a slightly different row count. Following Tailwind's
+SLO contract (arXiv:2604.28079) that warm paths must be structurally
+incapable of recompiling, this registry replaces the per-call rounding
+with per-program-fingerprint pins:
+
+- the FIRST observation for a fingerprint pins its bucket at the plain
+  ``round_capacity`` value (counted ``execution.capacity.pinned_count``);
+- every later observation at or under the pin reuses it verbatim — a
+  smaller batch never re-buckets downward, so oscillating input sizes
+  around a bucket boundary stay on ONE compiled program;
+- an observation OVER the pin must still run at a correct (larger)
+  capacity — it gets the plain rounded bucket for that call — but the
+  pin itself only grows after ``execution.capacity.grow_streak``
+  CONSECUTIVE over-pin observations (sustained occupancy, not a single
+  spike; counted ``execution.capacity.grow_count``). Transient spikes
+  round to the same raw buckets every time, so their programs warm once
+  and stay cached.
+
+Keys use the same vocabulary as the retrace ledger
+(:func:`exec.retrace.program_fingerprint` over a structural cache key),
+so the PR 17 taxonomy verifies the fix: with pinning on, the
+``capacity-bucket`` cause count stays flat after warmup.
+
+Callers never import this module directly — the single policy choke
+point is :func:`columnar.batch.bucket_capacity` (the capacity-policy
+lint fails any direct ``round_capacity`` call outside it).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional
+
+__all__ = ["bucket_for", "snapshot", "clear", "reload", "enabled"]
+
+
+class _Bucket:
+    __slots__ = ("cap", "streak", "grows", "hits")
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.streak = 0   # consecutive over-pin observations
+        self.grows = 0
+        self.hits = 0
+
+
+class _Conf:
+    __slots__ = ("enabled", "grow_streak", "max_entries")
+
+    def __init__(self):
+        from ..config import get as config_get, truthy
+        self.enabled = truthy("execution.capacity.pinning", "true")
+        try:
+            self.grow_streak = max(1, int(config_get(
+                "execution.capacity.grow_streak", 3)))
+        except (TypeError, ValueError):
+            self.grow_streak = 3
+        try:
+            self.max_entries = max(16, int(config_get(
+                "execution.capacity.max_entries", 4096)))
+        except (TypeError, ValueError):
+            self.max_entries = 4096
+
+
+class BucketRegistry:
+    """Process-global, bounded (LRU), thread-safe pin table."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets: "OrderedDict[str, _Bucket]" = OrderedDict()
+        self._conf: Optional[_Conf] = None
+        self._pinned = 0
+        self._grown = 0
+
+    def _cfg(self) -> _Conf:
+        c = self._conf
+        if c is None:
+            c = self._conf = _Conf()
+        return c
+
+    # -- the one decision point -----------------------------------------
+    def bucket_for(self, key, n: int,
+                   minimum: Optional[int] = None) -> int:
+        """Padded capacity for ``n`` rows of the program identified by
+        ``key`` (any hashable structural cache key). Grow-only with
+        hysteresis; falls back to plain rounding when pinning is off."""
+        from ..columnar.batch import round_capacity
+        raw = round_capacity(n, minimum)
+        cfg = self._cfg()
+        if not cfg.enabled or key is None:
+            return raw
+        from . import retrace
+        fp = retrace.program_fingerprint(key)
+        with self._lock:
+            b = self._buckets.get(fp)
+            if b is None:
+                while len(self._buckets) >= cfg.max_entries:
+                    self._buckets.popitem(last=False)
+                self._buckets[fp] = _Bucket(raw)
+                self._pinned += 1
+                self._note_metric("execution.capacity.pinned_count")
+                return raw
+            self._buckets.move_to_end(fp)
+            b.hits += 1
+            if raw <= b.cap:
+                b.streak = 0
+                return b.cap
+            b.streak += 1
+            if b.streak >= cfg.grow_streak:
+                b.cap = raw
+                b.streak = 0
+                b.grows += 1
+                self._grown += 1
+                self._note_metric("execution.capacity.grow_count")
+            return raw
+
+    @staticmethod
+    def _note_metric(name: str) -> None:
+        try:
+            from ..metrics import record as _record_metric
+            _record_metric(name, 1)
+        except Exception:  # noqa: BLE001 — observability never breaks exec
+            pass
+
+    # -- observability ---------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        cfg = self._cfg()
+        with self._lock:
+            return {
+                "enabled": cfg.enabled,
+                "grow_streak": cfg.grow_streak,
+                "entries": len(self._buckets),
+                "pinned_count": self._pinned,
+                "grow_count": self._grown,
+                "buckets": [
+                    {"fp": fp, "cap": b.cap, "hits": b.hits,
+                     "grows": b.grows, "streak": b.streak}
+                    for fp, b in list(self._buckets.items())[-32:]],
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+            self._pinned = 0
+            self._grown = 0
+
+    def reload(self) -> None:
+        """Drop pins AND re-read config (tests / bench A-B knobs flip
+        ``SAIL_EXECUTION__CAPACITY__PINNING`` between runs)."""
+        with self._lock:
+            self._conf = None
+            self._buckets.clear()
+            self._pinned = 0
+            self._grown = 0
+
+
+REGISTRY = BucketRegistry()
+
+
+def bucket_for(key, n: int, minimum: Optional[int] = None) -> int:
+    return REGISTRY.bucket_for(key, n, minimum)
+
+
+def snapshot() -> Dict[str, object]:
+    return REGISTRY.snapshot()
+
+
+def clear() -> None:
+    REGISTRY.clear()
+
+
+def reload() -> None:
+    REGISTRY.reload()
+
+
+def enabled() -> bool:
+    return REGISTRY._cfg().enabled

@@ -503,6 +503,10 @@ class WorkerActor(Actor):
         # continuous streaming: resident (long-lived) stage tasks and
         # their sequenced, credit-bounded input channels
         self.continuous = cont.ContinuousWorker(self)
+        # background-prewarm the persistent program store's working set
+        # before first traffic (idempotent per process)
+        from . import pcache
+        pcache.start_prewarm()
 
     # -- rpc service -----------------------------------------------------
     def _service(self):

@@ -25,13 +25,15 @@ import pyarrow as pa
 
 from ..columnar import arrow_interop as ai
 from ..metrics import record as _record_metric
-from ..columnar.batch import (Column, DeviceBatch, HostBatch, empty_batch,
-                              physical_jnp_dtype, round_capacity)
+from ..columnar.batch import (Column, DeviceBatch, HostBatch,
+                              bucket_capacity, empty_batch,
+                              physical_jnp_dtype)
 from ..ops import aggregate as aggk
 from ..ops import join as joink
 from ..ops import sort as sortk
 from ..plan import nodes as pn
 from ..plan import rex as rx
+from ..plan import stages as pst
 from ..plan.compiler import Compiled, ExprCompiler, HostFallback
 from ..spec import data_type as dt
 from ..spec.literal import Literal as LV
@@ -465,12 +467,13 @@ class _Rtf(NamedTuple):
 
 
 def clear_caches():
-    from . import result_cache, retrace
+    from . import capacity, result_cache, retrace
     _OP_CACHE.entries.clear()
     _RTF_HISTORY.clear()
     _RUNTIME_CACHE_SIZES.clear()
     result_cache.clear_all()
     retrace.clear()
+    capacity.reload()
 
 
 class LocalExecutor:
@@ -526,7 +529,8 @@ class LocalExecutor:
         # an implicit one per operator (exec/router.py)
         from . import router
         decisions = router.decide_split(
-            split, force=router.forced_backend(self.config))
+            split, force=router.forced_backend(self.config),
+            slo_ctx=router.slo_context(self.config))
         self._backend_routes = {d.stage: d for d in decisions}
         self._route_stage_of = split.stage_of
         router.record_decisions(decisions)
@@ -877,7 +881,7 @@ class LocalExecutor:
                     rtf_stats = (int(before), table.num_rows)
                 except Exception:  # noqa: BLE001 — stats are advisory
                     rtf_stats = None
-        hb = _positional(ai.from_arrow(table))
+        hb = _positional(ai.from_arrow(table, bucket_key=_scan_cap_key(p)))
         return hb, table, rtf_stats
 
     def _note_rtf_scan(self, p: pn.ScanExec, stats) -> None:
@@ -1972,7 +1976,8 @@ class LocalExecutor:
                 if src in top_dicts:
                     out_dicts[k] = top_dicts[src]
         out = DeviceBatch(out_cols, gsel)
-        out = _shrink(out, int(n_groups))
+        out = _shrink(out, int(n_groups),
+                      bucket_key=("agg-shrink", pst.node_fingerprint(p)))
         return HostBatch(out, out_dicts)
 
     # out-of-core: aggregates over big parquet scans stream chunk-wise
@@ -3022,7 +3027,8 @@ class LocalExecutor:
         n_left = len(p.left.schema)
         total = int(joink.join_output_count(ranges, left.device.sel, "inner")) \
             if inner_total is None else inner_total
-        cap = round_capacity(max(total, 1))
+        cap = bucket_capacity(max(total, 1),
+                              key=("join-expand", pst.node_fingerprint(p)))
         res = joink.join_expand(bt, ranges, left.device, build_payload,
                                 "inner", list(build_payload.columns.keys()),
                                 cap)
@@ -3133,7 +3139,8 @@ class LocalExecutor:
             int(x) for x in jax.device_get((left.device.num_rows(),
                                             right.device.num_rows())))
         total = n_left_rows * n_right_rows
-        cap = round_capacity(max(total, 1))
+        cap = bucket_capacity(max(total, 1),
+                              key=("cross-join", pst.node_fingerprint(p)))
         lcomp = sortk.compact(left.device)
         rcomp_d = sortk.compact(right.device)
         idx = jnp.arange(cap, dtype=jnp.int32)
@@ -3549,9 +3556,18 @@ def _positional(hb: HostBatch) -> HostBatch:
     return HostBatch(DeviceBatch(cols, dev.sel), dicts)
 
 
-def _shrink(dev: DeviceBatch, n_live: int) -> DeviceBatch:
+def _scan_cap_key(p: pn.ScanExec):
+    """Pinned-bucket identity of one scan's decoded batch: structural
+    (name + shape of the projected output), never data identity — so a
+    continuous stream scan keeps ONE pin across every pushed interval
+    even though each interval attaches a fresh memory table."""
+    return ("scan-decode", p.table_name, p.format, p.projection,
+            tuple((f.name, f.dtype) for f in p.out_schema))
+
+
+def _shrink(dev: DeviceBatch, n_live: int, bucket_key=None) -> DeviceBatch:
     """Slice a front-compacted batch down to a smaller padded capacity."""
-    cap = round_capacity(max(n_live, 1))
+    cap = bucket_capacity(max(n_live, 1), key=bucket_key)
     if cap >= dev.capacity:
         return dev
     cols = {n: Column(c.data[:cap],

@@ -81,6 +81,17 @@ _APPROX_BYTES: Optional[int] = None
 #: in-process accounting for /debug/compile_cache: digest -> [hits,
 #: compile_s_saved_per_hit, site] (hits observed by THIS process)
 _HIT_TALLY: Dict[str, List] = {}
+#: hits not yet merged into the on-disk prewarm manifest (same shape);
+#: flushed time-debounced so the ranking survives restarts
+_TALLY_DELTA: Dict[str, List] = {}
+_TALLY_LAST_FLUSH: float = 0.0
+#: executables AOT-loaded by the startup prewarm, waiting for their
+#: first caller (PersistentProgram._bind pops them: first traffic for a
+#: prewarmed program pays neither trace+compile NOR a disk read)
+_PRELOADED: Dict[str, object] = {}
+_PREWARM_STARTED = False
+#: manifest entries kept, ranked by compile-time saved
+_MANIFEST_MAX = 512
 
 
 # ---------------------------------------------------------------------------
@@ -165,11 +176,15 @@ def reload() -> None:
     """Re-read ``compile_cache.*`` and re-sync jax's compilation-cache
     binding eagerly (tests, bench A/B knobs, cluster entry points
     after env changes)."""
-    global _CONF, _APPROX_BYTES
+    global _CONF, _APPROX_BYTES, _PREWARM_STARTED, _TALLY_LAST_FLUSH
     with _LOCK:
         _CONF = None
         _APPROX_BYTES = None
         _HIT_TALLY.clear()
+        _TALLY_DELTA.clear()
+        _PRELOADED.clear()
+        _PREWARM_STARTED = False
+        _TALLY_LAST_FLUSH = 0.0
     _conf()
 
 
@@ -326,7 +341,7 @@ def load(digest: str, site: str = "op"):
     return _load(digest, site=site)[0]
 
 
-def _load(digest: str, site: str = "op"):
+def _load(digest: str, site: str = "op", _tally: bool = True):
     """:func:`load` with the miss TYPED for retrace attribution:
     returns ``(callable_or_None, reason)``, reason ∈ {``hit``,
     ``absent``, ``poison``, ``skew``, ``error``} — poison covers both
@@ -382,6 +397,8 @@ def _load(digest: str, site: str = "op"):
             pass
         return None, reason
     seconds = time.perf_counter() - t0
+    if not _tally:
+        return loaded, "hit"
     _count("execution.compile.persistent_hit_count")
     _note_profile(True, seconds)
     compile_s = float(header.get("compile_s", 0.0))
@@ -391,6 +408,10 @@ def _load(digest: str, site: str = "op"):
         tally[0] += 1
         while len(_HIT_TALLY) > 1024:
             _HIT_TALLY.pop(next(iter(_HIT_TALLY)))
+        delta = _TALLY_DELTA.setdefault(digest, [0, compile_s,
+                                                 header.get("site", site)])
+        delta[0] += 1
+    _maybe_flush_tally()
     try:
         # refresh recency for the compile-time-weighted LRU
         os.utime(path, None)
@@ -540,6 +561,164 @@ def _evict_to_budget() -> None:
     _gauge_bytes(max(0, total))
 
 
+# ---------------------------------------------------------------------------
+# prewarm: persisted compile-time-saved ranking + startup AOT loading
+# ---------------------------------------------------------------------------
+
+def _manifest_path() -> str:
+    return os.path.join(cache_dir(), "prewarm.json")
+
+
+def _prewarm_conf() -> Tuple[bool, int, float, float]:
+    """(enabled, top_n, budget_s, flush_interval_s) from
+    ``compile_cache.prewarm.*``."""
+    from ..config import get as config_get, truthy
+    try:
+        on = truthy("compile_cache.prewarm.enabled", default="true")
+        top_n = max(0, int(config_get("compile_cache.prewarm.top_n", 32)))
+        budget_s = max(0.0, float(config_get(
+            "compile_cache.prewarm.budget_s", 5.0)))
+        flush_s = max(0.5, float(config_get(
+            "compile_cache.prewarm.flush_interval_s", 30.0)))
+    except Exception:  # noqa: BLE001 — config trouble = prewarm off
+        return False, 0, 0.0, 30.0
+    return on, top_n, budget_s, flush_s
+
+
+def _read_manifest() -> Dict[str, List]:
+    """digest -> [hits, compile_s, site] merged across every process
+    that ever flushed (best-effort: unreadable manifest = empty)."""
+    if not enabled():
+        return {}
+    try:
+        with open(_manifest_path(), "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        return {str(d): [int(v[0]), float(v[1]), str(v[2])]
+                for d, v in raw.items()}
+    except (OSError, ValueError, TypeError, KeyError, IndexError):
+        return {}
+
+
+def _flush_tally() -> None:
+    """Merge this process's unflushed hit deltas into the on-disk
+    manifest (read-merge-replace under a tmp rename; concurrent
+    flushers may lose each other's last delta — the ranking is
+    advisory, not accounting)."""
+    global _TALLY_LAST_FLUSH
+    if not enabled():
+        return
+    with _LOCK:
+        if not _TALLY_DELTA:
+            _TALLY_LAST_FLUSH = time.time()
+            return
+        delta = {d: list(v) for d, v in _TALLY_DELTA.items()}
+        _TALLY_DELTA.clear()
+        _TALLY_LAST_FLUSH = time.time()
+    merged = _read_manifest()
+    for d, (hits, compile_s, site) in delta.items():
+        cur = merged.get(d)
+        if cur is None:
+            merged[d] = [hits, compile_s, site]
+        else:
+            cur[0] += hits
+            cur[1] = max(cur[1], compile_s)
+    if len(merged) > _MANIFEST_MAX:
+        ranked = sorted(merged.items(), key=lambda kv: -kv[1][0] * kv[1][1])
+        merged = dict(ranked[:_MANIFEST_MAX])
+    path = _manifest_path()
+    tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+    try:
+        os.makedirs(cache_dir(), exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(merged, f, separators=(",", ":"))
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _maybe_flush_tally() -> None:
+    on, _top, _budget, flush_s = _prewarm_conf()
+    if not on:
+        return
+    if time.time() - _TALLY_LAST_FLUSH >= flush_s:
+        _flush_tally()
+
+
+def _merged_tally() -> Dict[str, List]:
+    """Manifest ⊕ this process's unflushed deltas — the ranking
+    ``top_by_saved`` and the prewarm loader both consume, so the view
+    survives restarts."""
+    merged = _read_manifest()
+    with _LOCK:
+        for d, (hits, compile_s, site) in _TALLY_DELTA.items():
+            cur = merged.get(d)
+            if cur is None:
+                merged[d] = [hits, compile_s, site]
+            else:
+                cur[0] += hits
+                cur[1] = max(cur[1], compile_s)
+    return merged
+
+
+def prewarm() -> Tuple[int, int]:
+    """AOT-load the top-N manifest programs by compile-time saved into
+    :data:`_PRELOADED` (budget-bounded wall time). Returns
+    ``(loaded, skipped)`` and records
+    ``execution.compile.prewarm_{loaded,skipped}_count``."""
+    on, top_n, budget_s, _flush = _prewarm_conf()
+    if not on or not enabled() or top_n <= 0:
+        return 0, 0
+    ranked = sorted(_merged_tally().items(),
+                    key=lambda kv: -kv[1][0] * kv[1][1])
+    loaded = skipped = 0
+    deadline = time.monotonic() + budget_s
+    for i, (digest, (_hits, _cs, site)) in enumerate(ranked):
+        if i >= top_n or time.monotonic() > deadline:
+            skipped += len(ranked) - i
+            break
+        with _LOCK:
+            already = digest in _PRELOADED
+        if already:
+            continue
+        fn, reason = _load(digest, site=str(site), _tally=False)
+        if fn is None:
+            skipped += 1
+            continue
+        with _LOCK:
+            _PRELOADED[digest] = fn
+        loaded += 1
+    if loaded:
+        _count("execution.compile.prewarm_loaded_count", loaded)
+    if skipped:
+        _count("execution.compile.prewarm_skipped_count", skipped)
+    return loaded, skipped
+
+
+def start_prewarm(wait: bool = False) -> None:
+    """Session/cluster-startup hook: run :func:`prewarm` once per
+    process on a background daemon thread (startup latency unaffected);
+    ``wait=True`` runs it inline (tests, bench)."""
+    global _PREWARM_STARTED
+    on, top_n, _budget, _flush = _prewarm_conf()
+    if not on or not enabled() or top_n <= 0:
+        return
+    with _LOCK:
+        if _PREWARM_STARTED:
+            return
+        _PREWARM_STARTED = True
+    if not os.path.exists(_manifest_path()):
+        return  # nothing ranked yet: skip the thread entirely
+    if wait:
+        prewarm()
+        return
+    t = threading.Thread(target=prewarm, name="sail-pcache-prewarm",
+                         daemon=True)
+    t.start()
+
+
 def stats(top_n: int = 10) -> dict:
     """Store snapshot for ``/debug/compile_cache``: entry count, bytes,
     this process's hit tally, and the top-N entries by compile time
@@ -548,8 +727,9 @@ def stats(top_n: int = 10) -> dict:
     cache directory path itself."""
     entries = _scan_entries()
     with _LOCK:
-        tally = {d: list(v) for d, v in _HIT_TALLY.items()}
-    process_hits = sum(v[0] for v in tally.values())
+        process_hits = sum(v[0] for v in _HIT_TALLY.values())
+        preloaded = len(_PRELOADED)
+    tally = _merged_tally()
     top = sorted(
         ({"digest": d[:16], "hits": v[0],
           "compile_s": round(v[1], 4), "site": v[2],
@@ -563,6 +743,7 @@ def stats(top_n: int = 10) -> dict:
         "bytes": sum(e[1] for e in entries),
         "max_mb": _conf()[2],
         "process_hits": process_hits,
+        "prewarm_preloaded": preloaded,
         "top_by_saved": top,
     }
 
@@ -583,10 +764,16 @@ def clear() -> None:
                     pass
     except OSError:
         pass
+    try:
+        os.unlink(_manifest_path())
+    except OSError:
+        pass
     global _APPROX_BYTES
     with _LOCK:
         _APPROX_BYTES = None
         _HIT_TALLY.clear()
+        _TALLY_DELTA.clear()
+        _PRELOADED.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -662,6 +849,23 @@ class PersistentProgram:
         if sig is not None and self._digest_base() is not None:
             digest = entry_digest(self._key_repr, self._dict_digest, sig)
         if digest is not None:
+            with _LOCK:
+                pre = _PRELOADED.pop(digest, None)
+            if pre is not None:
+                # prewarmed: first traffic pays neither compile nor a
+                # disk read; counted as a persistent hit so ratios and
+                # the saved-time ranking stay honest
+                _count("execution.compile.persistent_hit_count")
+                _note_profile(True, 0.0)
+                with _LOCK:
+                    t = _HIT_TALLY.setdefault(digest, [0, 0.0, self._site])
+                    t[0] += 1
+                    d = _TALLY_DELTA.setdefault(digest,
+                                                [0, 0.0, self._site])
+                    d[0] += 1
+                retrace.LEDGER.note_digest(digest)
+                retrace.LEDGER.note_bound(self._key, sig)
+                return pre
             loaded, reason = _load(digest, site=self._site)
             if loaded is not None:
                 # bound without compiling: remember the signature (and

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+from collections import deque
 from contextlib import contextmanager
 from typing import Dict, List, NamedTuple, Optional
 
@@ -44,12 +45,14 @@ BACKENDS = ("native", "xla", "mesh")
 
 #: reason vocabulary (mirrored in the ``backend_route`` event comment):
 #: forced | cost-model | compile-bound | dispatch-bound | unsupported |
-#: default | unavailable
+#: default | unavailable | slo-feedback
 
 _LOCK = threading.Lock()
-#: stage fingerprint digest -> [compile_s, exec_s, runs] (bounded)
-_OBS: Dict[str, List[float]] = {}
+#: stage fingerprint digest -> [compile_s, exec_s, runs, recent exec_s
+#: samples (bounded deque — the p99 the SLO feedback loop reads)]
+_OBS: Dict[str, List] = {}
 _OBS_MAX = 512
+_OBS_SAMPLES = 64
 
 
 class Decision(NamedTuple):
@@ -80,6 +83,70 @@ def forced_backend(session_conf=None) -> str:
         value = config_get("execution.backend.force", "")
     value = str(value or "").strip().lower()
     return value if value in BACKENDS else ""
+
+
+def slo_feedback_enabled(session_conf=None) -> bool:
+    """``spark.sail.execution.backend.slo_feedback`` (session) over
+    ``execution.backend.slo_feedback``: the router-as-feedback-
+    controller gate. On by default, but inert until the SLO monitor
+    has evaluated a burn rate for the session's tenant."""
+    from ..config import truthy, truthy_value
+    if session_conf is not None:
+        get = getattr(session_conf, "get", None)
+        value = get("spark.sail.execution.backend.slo_feedback") \
+            if get is not None else None
+        if value is not None:
+            return truthy_value(value)
+    return truthy("execution.backend.slo_feedback", "true")
+
+
+def slo_context(session_conf=None) -> Optional[dict]:
+    """The SLO feedback loop's decision inputs for ONE session, read
+    once per decision batch: the tenant's latency target and its LAST
+    EVALUATED burn rate (``analysis/anomaly.py SLO_MONITOR``). The
+    router never triggers an evaluation — it consumes recorded state,
+    so decisions stay pure functions of (fingerprint, observation
+    table, this context) and replay identically. None = feedback off
+    (gate disabled, SLO disabled, or no burn evaluated yet)."""
+    if not slo_feedback_enabled(session_conf):
+        return None
+    try:
+        from ..analysis import anomaly
+        conf = anomaly._slo_conf()
+        if not conf["enabled"]:
+            return None
+        tenant = None
+        if session_conf is not None:
+            get = getattr(session_conf, "get", None)
+            tenant = get("spark.sail.tenant") if get is not None else None
+        if not tenant:
+            from ..config import get as config_get
+            tenant = str(config_get("admission.tenant", "default")
+                         or "default")
+        burn = anomaly.SLO_MONITOR.burn_for(str(tenant))
+        if burn is None:
+            return None
+        target_ms, objective = anomaly.SLO_MONITOR.objective_for(
+            str(tenant), conf)
+        return {"tenant": str(tenant), "target_ms": float(target_ms),
+                "objective": float(objective), "burn": float(burn),
+                "min_runs": 8}
+    except Exception:  # noqa: BLE001 — feedback is advisory, never fatal
+        return None
+
+
+def _slo_violation(obs: Optional[dict],
+                   slo_ctx: Optional[dict]) -> bool:
+    """True when a stage's OBSERVED p99 breaks its tenant's target
+    while the tenant's error budget is burning faster than sustainable
+    (burn ≥ 1) — the re-route trigger."""
+    if not slo_ctx or obs is None:
+        return False
+    p99 = obs.get("p99_ms")
+    return (p99 is not None
+            and obs.get("runs", 0) >= int(slo_ctx.get("min_runs", 8))
+            and p99 > float(slo_ctx["target_ms"])
+            and float(slo_ctx.get("burn", 0.0)) >= 1.0)
 
 
 def mesh_min_rows() -> int:
@@ -118,12 +185,14 @@ def note_stage(key: str, compile_s: float = 0.0,
     with _LOCK:
         obs = _OBS.get(key)
         if obs is None:
-            obs = _OBS[key] = [0.0, 0.0, 0.0]
+            obs = _OBS[key] = [0.0, 0.0, 0.0,
+                               deque(maxlen=_OBS_SAMPLES)]
             while len(_OBS) > _OBS_MAX:
                 _OBS.pop(next(iter(_OBS)))
         obs[0] += max(0.0, float(compile_s))
         obs[1] += max(0.0, float(exec_s))
         obs[2] += 1.0
+        obs[3].append(max(0.0, float(exec_s)))
 
 
 @contextmanager
@@ -152,8 +221,14 @@ def observed(key: str) -> Optional[dict]:
         obs = _OBS.get(key)
         if obs is None or obs[2] <= 0:
             return None
-        return {"compile_s": obs[0], "exec_s": obs[1],
-                "runs": int(obs[2])}
+        samples = sorted(obs[3])
+        out = {"compile_s": obs[0], "exec_s": obs[1],
+               "runs": int(obs[2])}
+    if samples:
+        out["p50_ms"] = samples[len(samples) // 2] * 1000.0
+        out["p99_ms"] = samples[
+            min(len(samples) - 1, int(len(samples) * 0.99))] * 1000.0
+    return out
 
 
 def clear_observations() -> None:
@@ -174,10 +249,18 @@ def _native_ok() -> bool:
 
 
 def decide_stage(stage, force: str = "",
-                 native_ok: Optional[bool] = None) -> Decision:
+                 native_ok: Optional[bool] = None,
+                 slo_ctx: Optional[dict] = None) -> Decision:
     """Route ONE fused stage (``plan/stages.py FusedStage``). Only
     aggregate stages have a native substrate today; everything else is
-    the XLA program the stage compiler emits."""
+    the XLA program the stage compiler emits.
+
+    With an ``slo_ctx`` (see :func:`slo_context`), the router acts as a
+    feedback controller: a stage whose observed p99 violates its
+    tenant's target while the error budget burns re-routes to the
+    alternative substrate (``slo-feedback``) — unless the observation
+    says compilation dominates, in which case native IS the fix and the
+    cost-model route stands."""
     from ..plan import stages as pst
 
     kind = stage.kind
@@ -200,6 +283,11 @@ def decide_stage(stage, force: str = "",
             # exactly the cost XLA re-pays per process/shape and the
             # native row loop does not
             return Decision(stage.sid, kind, "native", "compile-bound")
+        if _slo_violation(obs, slo_ctx):
+            # the native route is not holding the tenant's p99 and the
+            # cost is not compile: give the stage back to the XLA
+            # substrate until the rolling window clears the target
+            return Decision(stage.sid, kind, "xla", "slo-feedback")
         return Decision(stage.sid, kind, "native", "cost-model")
     if kind == "aggregate":
         # not native-eligible: host/DISTINCT aggregates or no toolchain
@@ -207,20 +295,30 @@ def decide_stage(stage, force: str = "",
     return Decision(stage.sid, kind, "xla", "default")
 
 
-def decide_split(split, force: str = "") -> List[Decision]:
+def decide_split(split, force: str = "",
+                 slo_ctx: Optional[dict] = None) -> List[Decision]:
     """Route every stage of one ``StageSplit`` (deterministic per plan
-    structure + configuration + observation table)."""
+    structure + configuration + observation table + SLO context)."""
     native_ok = _native_ok()
-    return [decide_stage(s, force=force, native_ok=native_ok)
+    return [decide_stage(s, force=force, native_ok=native_ok,
+                         slo_ctx=slo_ctx)
             for s in split.stages]
 
 
 def decide_plan(plan, nparts: int, force: str = "",
-                mode: str = "auto") -> Decision:
+                mode: str = "auto",
+                slo_ctx: Optional[dict] = None) -> Decision:
     """The plan-level mesh-vs-local gate (stage ``-1``): the SPMD
     program's fixed dispatch/compile cost is only worth paying above a
     row-volume floor. ``mode`` is the ``execution.mesh`` knob — "force"
-    bypasses the cost gate (tests pin the mesh path with it)."""
+    bypasses the cost gate (tests pin the mesh path with it).
+
+    With an ``slo_ctx``, a plan the floor would keep local PRE-SPLITS
+    to the mesh (``slo-feedback``) when its per-fingerprint latency
+    baseline (``analysis/anomaly.py BASELINES`` — the PR 12
+    ``query.latency`` histograms) shows a p99 over the tenant's target
+    while the error budget burns: sharding the input across devices is
+    the pre-split lever the local substrate does not have."""
     if force == "mesh":
         return Decision(-1, "plan", "mesh", "forced")
     if force in ("xla", "native"):
@@ -233,6 +331,8 @@ def decide_plan(plan, nparts: int, force: str = "",
     if floor:
         est = _plan_input_rows(plan)
         if est is not None and est < floor:
+            if _slo_violation(_plan_latency_obs(plan), slo_ctx):
+                return Decision(-1, "plan", "mesh", "slo-feedback")
             # estimated INPUT volume too small for the SPMD program's
             # fixed dispatch + compile cost: stay on the local
             # substrate. Input, not root output — the cost being gated
@@ -240,6 +340,25 @@ def decide_plan(plan, nparts: int, force: str = "",
             # filter or aggregate shrinks only the output.
             return Decision(-1, "plan", "xla", "dispatch-bound")
     return Decision(-1, "plan", "mesh", "cost-model")
+
+
+def _plan_latency_obs(plan) -> Optional[dict]:
+    """The plan's observed latency in :func:`_slo_violation`'s
+    vocabulary, read from the per-fingerprint baseline store (never
+    mutated here)."""
+    try:
+        from ..analysis import anomaly
+        from ..plan import stages as pst
+        fp = pst.plan_fingerprint_hash(plan)
+        if not fp:
+            return None
+        base = anomaly.BASELINES.p99_for(fp)
+        if base is None:
+            return None
+        count, p99_ms = base
+        return {"runs": count, "p99_ms": p99_ms}
+    except Exception:  # noqa: BLE001 — no baseline: no feedback
+        return None
 
 
 def _plan_input_rows(plan) -> Optional[float]:

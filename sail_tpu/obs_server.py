@@ -252,12 +252,20 @@ def _debug_compile_cache() -> dict:
     out = pcache.stats()
     rows = {r["name"]: r for r in _metrics.REGISTRY.snapshot()
             if str(r.get("name", "")).startswith(
-                "execution.compile.persistent_")}
+                ("execution.compile.persistent_",
+                 "execution.compile.prewarm_"))}
     counters = {}
     for short in ("hit", "miss", "evict", "load_error"):
         name = f"execution.compile.persistent_{short}_count"
         counters[short] = int(rows.get(name, {}).get("value", 0))
+    for short in ("prewarm_loaded", "prewarm_skipped"):
+        name = f"execution.compile.{short}_count"
+        counters[short] = int(rows.get(name, {}).get("value", 0))
     out["counters"] = counters
+    # pinned capacity buckets ride along: the same debug surface that
+    # explains compile behavior should show why capacities are stable
+    from .exec import capacity
+    out["capacity"] = capacity.snapshot()
     consults = counters["hit"] + counters["miss"]
     out["hit_ratio"] = round(counters["hit"] / consults, 4) \
         if consults else None

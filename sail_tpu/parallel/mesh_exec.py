@@ -32,7 +32,8 @@ import numpy as np
 import pyarrow as pa
 
 from ..columnar import arrow_interop as ai
-from ..columnar.batch import Column, DeviceBatch, HostBatch, round_capacity
+from ..columnar.batch import (Column, DeviceBatch, HostBatch,
+                              bucket_capacity)
 from ..ops import aggregate as aggk
 from ..ops import join as joink
 from ..ops.hash import hash64
@@ -341,7 +342,7 @@ class MeshExecutor:
             if mode == jg.InputMode.SHUFFLE:
                 if stage.shuffle_keys is None:
                     raise MeshUnsupported("shuffle stage without keys")
-                bucket_cap = round_capacity(
+                bucket_cap = bucket_capacity(
                     max(8, -(-frag.cap * 2 * bucket_mult // P)))
                 ex = self._bind_shuffle(frag, stage.shuffle_keys, P,
                                         bucket_cap)
@@ -482,7 +483,9 @@ class MeshExecutor:
                 if dev.columns[_positional_name(i)].validity is not None}})
         sel = np.asarray(host["sel"])
         n = int(sel.sum())  # from_arrow keeps live rows as a prefix
-        cap = round_capacity(max(8, -(-n // P)))
+        from ..exec.local import _scan_cap_key
+        cap = bucket_capacity(max(8, -(-n // P)),
+                              key=("mesh-leaf", _scan_cap_key(scan), P))
         types: List[dt.DataType] = []
         datas: List[np.ndarray] = []
         validities: List[Optional[np.ndarray]] = []
@@ -623,7 +626,7 @@ class MeshExecutor:
         child = self._compile_node(node.input, producers, leaf, stage_id, gm)
         in_types = child.types
         max_groups = min(child.cap,
-                         round_capacity(self._group_cap * gm))
+                         bucket_capacity(self._group_cap * gm))
         # A keyless FINAL aggregate consumes the builder's empty-key
         # shuffle (every partial row routed to partition 0): its single
         # global row is valid on device 0 only — the other devices merge
@@ -748,7 +751,7 @@ class MeshExecutor:
         em = int(getattr(self, "_expand_mult", 1))
         has_res = residual_c is not None
         expand = em > 1 and (jt in ("inner", "left") or has_res)
-        exp_cap = round_capacity(left.cap * em)
+        exp_cap = bucket_capacity(left.cap * em)
         n_right = len(right.types)
         if jt in ("semi", "anti") or not expand:
             out_cap = left.cap

@@ -75,9 +75,11 @@ class SparkSession:
         obs_server.ensure_started()
         # resolve the persistent compiled-program cache config NOW so
         # jax's compilation-cache dir is set before the first eager
-        # dispatch compiles anything (exec/pcache.py)
+        # dispatch compiles anything (exec/pcache.py), and kick off the
+        # background prewarm of the manifest's top compile-time savers
         from .exec import pcache
         pcache.enabled()
+        pcache.start_prewarm()
 
     def newSession(self) -> "SparkSession":
         """A sibling session: same catalog (tables, temp views, UDFs),
@@ -246,7 +248,8 @@ class SparkSession:
         from .exec import router
         decision = router.decide_plan(
             node, nparts=len(jax.devices()),
-            force=router.forced_backend(self.conf), mode=mode)
+            force=router.forced_backend(self.conf), mode=mode,
+            slo_ctx=router.slo_context(self.conf))
         router.record_decisions([decision])
         if decision.backend != "mesh":
             return None
@@ -514,7 +517,8 @@ class SparkSession:
                 # the routing the executor would run under (same
                 # deterministic decision function, no execution)
                 backends = [d.to_dict() for d in router.decide_split(
-                    split, force=router.forced_backend(self.conf))]
+                    split, force=router.forced_backend(self.conf),
+                    slo_ctx=router.slo_context(self.conf))]
             from .exec import result_cache as rc
             rc_probe = None
             if rc.result_cache_enabled(self.conf):
