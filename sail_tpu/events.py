@@ -160,6 +160,21 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # worker pool health (driver, cluster-scoped: no query id)
     "worker_evict": ("worker", "reason"),
     "worker_quarantine": ("worker", "failures"),
+    # elastic autoscaler (exec/autoscaler.py): one record per policy
+    # tick that changes fleet intent. ``action`` ∈ scale_up |
+    # scale_down | hold, ``worker`` the drain target ("" for
+    # scale-up/hold), ``pool`` the live pool size the decision saw,
+    # ``detail`` the canonical sort_keys JSON of the full signal
+    # snapshot + decision record — replaying the durable log re-derives
+    # the decision sequence bit-identically (same contract as
+    # adaptive_applied / anomaly)
+    "autoscaler_decision": ("action", "worker", "reason", "pool",
+                            "detail"),
+    # graceful-drain lifecycle for one worker (driver): ``phase`` ∈
+    # begin | handoff | done | abort; ``channels``/``bytes`` count the
+    # shuffle channels donated to peers so far, ``ms`` the elapsed
+    # drain wall time at the phase edge
+    "worker_drain": ("worker", "phase", "channels", "bytes", "ms"),
     # streaming epoch commit protocol (streaming.py)
     "epoch_stage": ("epoch", "rows"),
     "epoch_commit": ("epoch", "commit_ms"),
@@ -217,6 +232,8 @@ class EventType:
     SPECULATION_WIN = "speculation_win"
     WORKER_EVICT = "worker_evict"
     WORKER_QUARANTINE = "worker_quarantine"
+    AUTOSCALER_DECISION = "autoscaler_decision"
+    WORKER_DRAIN = "worker_drain"
     EPOCH_STAGE = "epoch_stage"
     EPOCH_COMMIT = "epoch_commit"
     EPOCH_REPLAY = "epoch_replay"

@@ -215,6 +215,10 @@ class JobAdmissionQueue:
         # recharge() re-debits the tenant's deficit every
         # resident_recharge_secs so it keeps paying for the occupancy
         self._resident: Dict[str, List] = {}
+        # monotone per-tenant shed totals: the autoscaler's tick reads
+        # these against a delta cursor for its weight-capped shed-rate
+        # scale-up signal
+        self.shed_totals: Dict[str, int] = {}
         from ..config import get as config_get
         self.resident_recharge_s = max(0.1, _num(
             config_get("admission.resident_recharge_secs", 10.0), 10.0,
@@ -229,6 +233,14 @@ class JobAdmissionQueue:
 
     def queue_depth(self, tenant: str) -> int:
         return len(self._queues.get(tenant, ()))
+
+    def queued_depths(self) -> Dict[str, int]:
+        """Non-empty per-tenant queue depths — the autoscaler's primary
+        scale-up signal (weight-capped per tenant by the policy)."""
+        return {t: len(q) for t, q in self._queues.items() if q}
+
+    def total_queued(self) -> int:
+        return sum(len(q) for q in self._queues.values())
 
     def running_count(self, tenant: str) -> int:
         return len(self._running.get(tenant, ()))
@@ -285,6 +297,7 @@ class JobAdmissionQueue:
     def _shed(self, job, reason: str) -> None:
         tenant = job.tenant
         depth = self.queue_depth(tenant)
+        self.shed_totals[tenant] = self.shed_totals.get(tenant, 0) + 1
         _record_metric("cluster.admission.shed_count", 1, tenant=tenant,
                        reason=reason)
         queued_ts = getattr(job, "queued_ts", None)

@@ -1,0 +1,389 @@
+"""Driver-side elastic autoscaler: a pure, replayable scaling policy.
+
+The fleet must grow on sustained admission pressure and shrink on idle
+WITHOUT ever failing a query (ROADMAP item 3). Every input signal
+already exists — admission queue depth and shed rate (PR 11),
+continuous credit-stall time (PR 15), per-worker occupancy and idle
+time — this module closes the loop with a policy that is a pure
+function of a recorded signal snapshot:
+
+- ``FleetSignals``  one tick's observations (gathered by the driver in
+  ``cluster.DriverActor._autoscaler_signals``; this module never reads
+  live state)
+- ``PolicyState``   the few counters that carry across ticks (streaks,
+  cooldown) — evolved deterministically by :func:`evaluate`
+- ``evaluate(cfg, state, signals) -> (Decision, PolicyState)``
+
+Determinism contract: the decision ``detail`` (canonical sort_keys
+JSON, same convention as ``adaptive_applied``/``anomaly`` events)
+embeds the config, the input state, and the full signal snapshot —
+:func:`replay_record` re-derives the decision from the detail ALONE
+and must reproduce action/worker/reason bit-identically. The chaos
+determinism test replays every recorded ``autoscaler_decision`` event
+through it.
+
+Tenant-weight modulation: scale-UP pressure is weight-capped per
+tenant — one tenant's contribution to the effective queue depth (and
+to the effective shed count) saturates at ``weight × threshold``, and
+the trigger is STRICTLY above the threshold. A single weight-1 tenant
+flooding its queue therefore buys sheds (PR 11's admission path), not
+fleet growth; broad multi-tenant pressure, or a high-weight tenant
+with paid-for headroom, exceeds the threshold and grows the pool.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# decision taxonomy (the README table mirrors these)
+SCALE_UP = "scale_up"
+SCALE_DOWN = "scale_down"
+HOLD = "hold"
+
+UP_REASONS = ("queue_pressure", "shed_pressure", "credit_stall")
+DOWN_REASONS = ("fleet_idle",)
+HOLD_REASONS = ("disabled", "steady", "cooldown", "hysteresis",
+                "at_max", "at_min", "no_candidate", "draining")
+
+
+@dataclass(frozen=True)
+class AutoscalerConfig:
+    """``cluster.autoscaler.*`` knobs (see config/application.yaml)."""
+
+    enabled: bool = False
+    tick_secs: float = 4.0
+    # scale-UP triggers: strictly-above thresholds per tick window
+    up_queue_depth: int = 2
+    up_shed_count: int = 1
+    up_stall_secs: float = 1.0
+    # scale-DOWN gates
+    down_idle_secs: float = 30.0
+    down_occupancy: float = 0.25
+    # damping
+    hysteresis_ticks: int = 2
+    cooldown_ticks: int = 5
+    # drain lifecycle (consumed by the driver, carried here so the
+    # decision record is self-contained)
+    drain_timeout_secs: float = 60.0
+    hard_reap: bool = False
+
+    @classmethod
+    def load(cls) -> "AutoscalerConfig":
+        from ..config import get as config_get
+        from ..config import truthy as _on
+
+        def _num(key, default, cast=float):
+            try:
+                return cast(config_get(key, default))
+            except (TypeError, ValueError):
+                return default
+
+        d = cls()
+        return cls(
+            enabled=_on("cluster.autoscaler.enabled"),
+            tick_secs=max(0.1, _num("cluster.autoscaler.tick_secs",
+                                    d.tick_secs)),
+            up_queue_depth=_num("cluster.autoscaler.up_queue_depth",
+                                d.up_queue_depth, int),
+            up_shed_count=_num("cluster.autoscaler.up_shed_count",
+                               d.up_shed_count, int),
+            up_stall_secs=_num("cluster.autoscaler.up_stall_secs",
+                               d.up_stall_secs),
+            down_idle_secs=_num("cluster.autoscaler.down_idle_secs",
+                                d.down_idle_secs),
+            down_occupancy=_num("cluster.autoscaler.down_occupancy",
+                                d.down_occupancy),
+            hysteresis_ticks=max(1, _num(
+                "cluster.autoscaler.hysteresis_ticks",
+                d.hysteresis_ticks, int)),
+            cooldown_ticks=max(0, _num(
+                "cluster.autoscaler.cooldown_ticks",
+                d.cooldown_ticks, int)),
+            drain_timeout_secs=max(1.0, _num(
+                "cluster.autoscaler.drain_timeout_secs",
+                d.drain_timeout_secs)),
+            hard_reap=_on("cluster.autoscaler.hard_reap"),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "tick_secs": self.tick_secs,
+            "up_queue_depth": self.up_queue_depth,
+            "up_shed_count": self.up_shed_count,
+            "up_stall_secs": self.up_stall_secs,
+            "down_idle_secs": self.down_idle_secs,
+            "down_occupancy": self.down_occupancy,
+            "hysteresis_ticks": self.hysteresis_ticks,
+            "cooldown_ticks": self.cooldown_ticks,
+            "drain_timeout_secs": self.drain_timeout_secs,
+            "hard_reap": self.hard_reap,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AutoscalerConfig":
+        base = cls()
+        return cls(**{k: d.get(k, getattr(base, k))
+                      for k in base.to_dict()})
+
+
+@dataclass(frozen=True)
+class WorkerSignals:
+    """One worker's occupancy snapshot at the tick."""
+
+    worker_id: str
+    tasks: int            # running/resident tasks assigned
+    slots: int
+    idle_secs: float      # 0.0 while busy
+    resident: bool        # hosts resident continuous stage tasks
+    live_output: bool     # hosts sealed shuffle output a live job needs
+    stoppable: bool       # the elastic manager owns it (can retire it)
+
+    def to_dict(self) -> dict:
+        return {"worker_id": self.worker_id, "tasks": self.tasks,
+                "slots": self.slots,
+                "idle_secs": round(self.idle_secs, 3),
+                "resident": self.resident,
+                "live_output": self.live_output,
+                "stoppable": self.stoppable}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkerSignals":
+        return cls(worker_id=d["worker_id"], tasks=int(d["tasks"]),
+                   slots=int(d["slots"]),
+                   idle_secs=float(d["idle_secs"]),
+                   resident=bool(d["resident"]),
+                   live_output=bool(d["live_output"]),
+                   stoppable=bool(d["stoppable"]))
+
+
+@dataclass(frozen=True)
+class FleetSignals:
+    """Everything one policy tick observes, as plain data."""
+
+    pool: int                       # live workers NOT draining
+    draining: int
+    pending_starts: int
+    min_workers: int
+    max_workers: int
+    queued: Dict[str, int]          # admission queue depth per tenant
+    shed: Dict[str, int]            # sheds per tenant since last tick
+    weights: Dict[str, float]       # admission weights per tenant seen
+    stall_secs: float               # credit-stall seconds since last tick
+    workers: Tuple[WorkerSignals, ...]
+
+    def to_dict(self) -> dict:
+        return {
+            "pool": self.pool, "draining": self.draining,
+            "pending_starts": self.pending_starts,
+            "min_workers": self.min_workers,
+            "max_workers": self.max_workers,
+            "queued": dict(sorted(self.queued.items())),
+            "shed": dict(sorted(self.shed.items())),
+            "weights": {t: round(float(w), 6)
+                        for t, w in sorted(self.weights.items())},
+            "stall_secs": round(self.stall_secs, 3),
+            "workers": [w.to_dict()
+                        for w in sorted(self.workers,
+                                        key=lambda s: s.worker_id)],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FleetSignals":
+        return cls(
+            pool=int(d["pool"]), draining=int(d["draining"]),
+            pending_starts=int(d["pending_starts"]),
+            min_workers=int(d["min_workers"]),
+            max_workers=int(d["max_workers"]),
+            queued={t: int(v) for t, v in d.get("queued", {}).items()},
+            shed={t: int(v) for t, v in d.get("shed", {}).items()},
+            weights={t: float(v)
+                     for t, v in d.get("weights", {}).items()},
+            stall_secs=float(d.get("stall_secs", 0.0)),
+            workers=tuple(WorkerSignals.from_dict(w)
+                          for w in d.get("workers", ())))
+
+
+@dataclass
+class PolicyState:
+    """Cross-tick damping counters; evolved only by :func:`evaluate`."""
+
+    up_streak: int = 0
+    down_streak: int = 0
+    cooldown_left: int = 0
+
+    def to_dict(self) -> dict:
+        return {"up_streak": self.up_streak,
+                "down_streak": self.down_streak,
+                "cooldown_left": self.cooldown_left}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PolicyState":
+        return cls(up_streak=int(d.get("up_streak", 0)),
+                   down_streak=int(d.get("down_streak", 0)),
+                   cooldown_left=int(d.get("cooldown_left", 0)))
+
+
+@dataclass(frozen=True)
+class Decision:
+    action: str                 # scale_up | scale_down | hold
+    worker: str                 # drain target ("" unless scale_down)
+    reason: str
+    detail: dict = field(default_factory=dict)
+
+    def detail_json(self) -> str:
+        """Canonical encoding — the replayable event payload."""
+        return json.dumps(self.detail, sort_keys=True,
+                          separators=(",", ":"))
+
+
+def weighted_pressure(counts: Dict[str, int], weights: Dict[str, float],
+                      threshold: float) -> float:
+    """Weight-capped effective pressure: each tenant contributes at
+    most ``weight × threshold``, so a single flooding tenant saturates
+    AT the trigger threshold (strict > never fires on it alone) while
+    broad pressure across tenants, or a high-weight tenant, exceeds
+    it."""
+    total = 0.0
+    for tenant, count in counts.items():
+        w = max(float(weights.get(tenant, 1.0)), 0.0)
+        total += min(float(count), w * float(threshold))
+    return total
+
+
+def _up_pressure(cfg: AutoscalerConfig,
+                 s: FleetSignals) -> Tuple[Optional[str], dict]:
+    """First matching scale-up reason plus the derived numbers."""
+    eff_depth = weighted_pressure(s.queued, s.weights,
+                                  cfg.up_queue_depth)
+    eff_shed = weighted_pressure(s.shed, s.weights, cfg.up_shed_count)
+    derived = {"eff_queue_depth": round(eff_depth, 3),
+               "eff_shed": round(eff_shed, 3),
+               "stall_secs": round(s.stall_secs, 3)}
+    if eff_depth > cfg.up_queue_depth:
+        return "queue_pressure", derived
+    if eff_shed > cfg.up_shed_count:
+        return "shed_pressure", derived
+    if s.stall_secs > cfg.up_stall_secs:
+        return "credit_stall", derived
+    return None, derived
+
+
+def _down_candidate(cfg: AutoscalerConfig,
+                    s: FleetSignals) -> Tuple[Optional[str], dict]:
+    """Pick the drain target: fleet occupancy must be at/below the
+    shrink threshold, and the victim must be a stoppable worker idle
+    past ``down_idle_secs``. Cheapest drain first (no resident stages,
+    no live output to hand off), then longest idle; worker id breaks
+    ties so the choice is deterministic."""
+    live = [w for w in s.workers]
+    slots = sum(w.slots for w in live) or 1
+    busy = sum(w.tasks for w in live)
+    occupancy = busy / slots
+    derived = {"occupancy": round(occupancy, 4)}
+    if occupancy > cfg.down_occupancy:
+        return None, derived
+    idle = [w for w in live
+            if w.stoppable and w.tasks == 0
+            and w.idle_secs >= cfg.down_idle_secs]
+    if not idle:
+        return None, derived
+    idle.sort(key=lambda w: (w.resident, w.live_output,
+                             -round(w.idle_secs, 3), w.worker_id))
+    return idle[0].worker_id, derived
+
+
+def evaluate(cfg: AutoscalerConfig, state: PolicyState,
+             signals: FleetSignals) -> Tuple[Decision, PolicyState]:
+    """One policy tick. Pure: (cfg, state, signals) fully determine
+    the decision and the successor state."""
+    nxt = PolicyState(state.up_streak, state.down_streak,
+                      max(0, state.cooldown_left - 1))
+
+    def record(action: str, worker: str, reason: str,
+               derived: dict) -> Decision:
+        detail = {
+            "action": action, "worker": worker, "reason": reason,
+            "cfg": cfg.to_dict(), "state_in": state.to_dict(),
+            "state_out": nxt.to_dict(), "derived": derived,
+            "signals": signals.to_dict(),
+        }
+        return Decision(action, worker, reason, detail)
+
+    if not cfg.enabled:
+        return record(HOLD, "", "disabled", {}), nxt
+
+    up_reason, up_derived = _up_pressure(cfg, signals)
+    down_wid, down_derived = _down_candidate(cfg, signals)
+    derived = dict(up_derived)
+    derived.update(down_derived)
+
+    # streaks advance on raw pressure, before capacity/cooldown gates:
+    # damping measures how SUSTAINED the signal is, not how often we
+    # were allowed to act on it
+    nxt.up_streak = nxt.up_streak + 1 if up_reason else 0
+    # up-pressure vetoes shrink outright (and resets its streak): the
+    # two signals disagreeing means the fleet is NOT safely idle
+    nxt.down_streak = 0 if (up_reason or down_wid is None) \
+        else nxt.down_streak + 1
+
+    if up_reason:
+        if signals.pool + signals.pending_starts + signals.draining \
+                >= signals.max_workers:
+            return record(HOLD, "", "at_max", derived), nxt
+        if nxt.up_streak < cfg.hysteresis_ticks:
+            return record(HOLD, "", "hysteresis", derived), nxt
+        if nxt.cooldown_left > 0:
+            return record(HOLD, "", "cooldown", derived), nxt
+        nxt.up_streak = 0
+        nxt.cooldown_left = cfg.cooldown_ticks
+        return record(SCALE_UP, "", up_reason, derived), nxt
+
+    if down_wid is not None:
+        if signals.draining > 0:
+            # one drain at a time: handoff + relaunch must finish (and
+            # be observed) before the next victim is chosen
+            return record(HOLD, "", "draining", derived), nxt
+        if signals.pool + signals.pending_starts \
+                <= signals.min_workers:
+            return record(HOLD, "", "at_min", derived), nxt
+        if nxt.down_streak < cfg.hysteresis_ticks:
+            return record(HOLD, "", "hysteresis", derived), nxt
+        if nxt.cooldown_left > 0:
+            return record(HOLD, "", "cooldown", derived), nxt
+        nxt.down_streak = 0
+        nxt.cooldown_left = cfg.cooldown_ticks
+        return record(SCALE_DOWN, down_wid, "fleet_idle", derived), nxt
+
+    return record(HOLD, "", "steady", derived), nxt
+
+
+def replay_record(detail: dict) -> Decision:
+    """Re-derive one decision from its recorded detail ALONE (the
+    flight-recorder replay contract): rebuild cfg/state/signals from
+    the detail and re-run :func:`evaluate`. The result must match the
+    recorded action/worker/reason bit-identically — the determinism
+    test asserts it for every recorded decision."""
+    cfg = AutoscalerConfig.from_dict(detail["cfg"])
+    state = PolicyState.from_dict(detail["state_in"])
+    signals = FleetSignals.from_dict(detail["signals"])
+    decision, _ = evaluate(cfg, state, signals)
+    return decision
+
+
+def replay_log(records: List[dict]) -> List[dict]:
+    """Replay a list of ``autoscaler_decision`` event records (as
+    loaded by ``events.load_event_log``) and return the re-derived
+    ``{"action", "worker", "reason"}`` triples, in order."""
+    out = []
+    for rec in records:
+        attrs = rec.get("attributes", rec)
+        detail = attrs.get("detail")
+        if isinstance(detail, str):
+            detail = json.loads(detail)
+        d = replay_record(detail)
+        out.append({"action": d.action, "worker": d.worker,
+                    "reason": d.reason})
+    return out

@@ -471,6 +471,38 @@ def _fetch_table(addr: str, req: pb.FetchStreamRequest, service: str,
                             method="FetchStream", attempts=2)
 
 
+def _fetch_channel_bytes(addr: str, req: pb.FetchStreamRequest,
+                         service: str, timeout: float = 120.0) -> bytes:
+    """Fetch one channel's RAW wire bytes (compressed IPC) without
+    decoding. The drain handoff moves channels verbatim: the spill
+    format IS the wire format, so a re-``put`` on the adopting store
+    serves byte-identical streams to every later consumer."""
+    key = f"{addr}/s{req.stage}p{req.partition}c{req.channel}/raw"
+
+    def once():
+        channel = _peer_channel(addr)
+        try:
+            rpc = channel.unary_stream(
+                f"/{service}/FetchStream",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.FetchChunk.FromString)
+            return b"".join(c.data for c in
+                            rpc(req, timeout=timeout,
+                                metadata=tr.inject_context()))
+        except grpc.RpcError as e:
+            code = getattr(e, "code", lambda: None)()
+            if code in (grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.DEADLINE_EXCEEDED):
+                _drop_peer_channel(addr)
+            raise
+
+    # same budget and fault site as a consumer fetch: a dropped handoff
+    # fetch retries once, then the drain tick retries the whole
+    # partition (and the drain timeout bounds a black hole)
+    return _call_with_retry(once, site="shuffle.fetch", key=key,
+                            method="FetchStream", attempts=2)
+
+
 # ---------------------------------------------------------------------------
 # Worker
 # ---------------------------------------------------------------------------
@@ -536,11 +568,40 @@ class WorkerActor(Actor):
         def push_records(request: pb.PushRecordsRequest, context):
             return self.continuous.offer(request)
 
+        def pull_channels(request: pb.PullChannelsRequest, context):
+            # graceful drain: adopt a draining peer's sealed channels.
+            # Pull each channel's raw wire bytes over the peer data
+            # plane and re-put them locally — put() re-seals, so the
+            # adopted output serves consumers exactly like our own.
+            moved: Dict[int, bytes] = {}
+            try:
+                for c in request.channels:
+                    moved[c] = _fetch_channel_bytes(
+                        request.peer_addr,
+                        pb.FetchStreamRequest(
+                            job_id=request.job_id, stage=request.stage,
+                            partition=request.partition, channel=c,
+                            epoch=request.epoch),
+                        _WORKER_SERVICE)
+            except (grpc.RpcError, faults.FaultInjectedError) as e:
+                # partial pulls import NOTHING: a half-adopted output
+                # must never seal (consumers would fetch a truncated
+                # channel set); the driver retries whole-partition
+                return pb.PullChannelsResponse(
+                    ok=False, error=f"{type(e).__name__}: {e}")
+            self.streams.put(request.job_id, request.stage,
+                             request.partition, moved,
+                             epoch=request.epoch)
+            return pb.PullChannelsResponse(
+                ok=True, channels_moved=len(moved),
+                bytes_moved=sum(len(b) for b in moved.values()))
+
         return grpc.method_handlers_generic_handler(_WORKER_SERVICE, {
             "RunTask": _unary(run_task, pb.RunTaskRequest),
             "StopTask": _unary(stop_task, pb.StopTaskRequest),
             "CleanUpJob": _unary(clean_up_job, pb.CleanUpJobRequest),
             "PushRecords": _unary(push_records, pb.PushRecordsRequest),
+            "PullChannels": _unary(pull_channels, pb.PullChannelsRequest),
             "FetchStream": grpc.unary_stream_rpc_method_handler(
                 _fetch_stream_handler(self.streams),
                 request_deserializer=pb.FetchStreamRequest.FromString,
@@ -1207,6 +1268,25 @@ class DriverActor(Actor):
         # concurrency + memory quotas, bounded queues with shedding)
         from . import admission as _adm
         self.admission = _adm.JobAdmissionQueue()
+        # elastic autoscaler (exec/autoscaler.py): a pure policy over
+        # recorded signals ticks from the probe loop; scale-down goes
+        # through the graceful DRAINING lifecycle (channel handoff +
+        # resident relaunch) instead of eviction
+        from . import autoscaler as _asc
+        self.autoscaler_cfg = _asc.AutoscalerConfig.load()
+        self.autoscaler_state = _asc.PolicyState()
+        # last N decisions (holds included) for /debug/autoscaler
+        from collections import deque as _deque
+        self.autoscaler_log: "_deque" = _deque(maxlen=64)
+        self._as_next_tick = 0.0
+        self._as_last_reason: Optional[str] = None
+        # delta cursors for the tick's rate signals
+        self._as_shed_seen: Dict[str, int] = {}
+        self._as_stall_seen = 0.0
+        # workers mid-drain: wid -> {"started", "addr", "reason",
+        # "channels", "bytes"}; the scheduler, governor, speculation,
+        # and continuous placement all skip these
+        self.draining: Dict[str, dict] = {}
 
     def set_elastic(self, manager, min_workers: int = 1,
                     max_workers: int = 4, idle_secs: float = 60.0):
@@ -1374,6 +1454,18 @@ class DriverActor(Actor):
             self._continuous_start(cj, reply)
         elif kind == "continuous_stop":
             self._continuous_stop(payload)
+        elif kind == "call":
+            # tests/tools: run a closure ON the actor thread — driver
+            # state is single-threaded by construction, so out-of-band
+            # inspection or drain/fault setup must ride the mailbox
+            # like every other mutation
+            fn, reply = payload
+            try:
+                out = fn(self)
+            except Exception as e:  # noqa: BLE001 — reply, keep the loop
+                out = e
+            if reply is not None:
+                reply.set(out)
         elif kind == "continuous_sync":
             # FIFO barrier (ContinuousJobRunner.sync_reports): by the
             # time this reply fires, every report enqueued before the
@@ -1392,7 +1484,8 @@ class DriverActor(Actor):
         g = cj.graph
         work = [(s, p) for s in g.stages if not s.on_driver
                 for p in range(s.num_partitions)]
-        pool = sorted(self.workers.items(),
+        pool = sorted(((wid, w) for wid, w in self.workers.items()
+                       if wid not in self.draining),
                       key=lambda kv: (len(kv[1]["tasks"]), kv[0]))
         if not pool:
             cj.runner.fail("no live workers")
@@ -1592,20 +1685,38 @@ class DriverActor(Actor):
         return False
 
     def _reap_idle_workers(self, now: float):
+        """Idle shrink. Default path: route the victim through the
+        graceful DRAINING lifecycle — completed shuffle channels hand
+        off to survivors instead of vanishing into producer re-runs.
+        ``cluster.autoscaler.hard_reap`` restores the legacy hard-stop
+        (the A/B control: reap kills live output, consumers re-run)."""
         e = self.elastic
         owns = getattr(e["manager"], "owns", None)
         stop = getattr(e["manager"], "stop_worker_id", None)
+        hard = self.autoscaler_cfg.hard_reap
         for wid in list(self.workers):
-            if len(self.workers) <= e["min"]:
+            live = len(self.workers) - len(self.draining)
+            if live <= e["min"]:
                 return
+            if wid in self.draining:
+                continue
             w = self.workers[wid]
             idle = w.get("idle_since")
             if w["tasks"] or idle is None or now - idle < e["idle"]:
                 continue
-            # never strand a worker the manager can't actually stop, and
-            # never kill completed stage outputs an active job still needs
+            # never strand a worker the manager can't actually stop
             if owns is not None and not owns(wid):
                 continue
+            if not hard:
+                # one drain at a time: handoff must finish before the
+                # next victim (the drain tick enforces ordering anyway,
+                # but a burst of drains would race the survivors' load)
+                if self.draining:
+                    return
+                self._begin_drain(wid, "idle_reap")
+                return
+            # legacy hard-reap: never kill completed stage outputs an
+            # active job still needs
             if self._worker_hosts_live_output(w["addr"]):
                 continue
             self.workers.pop(wid)
@@ -1617,6 +1728,258 @@ class DriverActor(Actor):
                     stop(wid)
                 except Exception:  # noqa: BLE001
                     pass
+
+    # -- elastic autoscaler + graceful drain -----------------------------
+    def _autoscaler_signals(self, now: float):
+        """One tick's observations as plain data (the policy input —
+        and, embedded in the decision detail, the replay input)."""
+        from . import autoscaler as _asc
+        e = self.elastic or {}
+        manager = e.get("manager")
+        owns = getattr(manager, "owns", None)
+        resident_on: Set[str] = set()
+        for cj in self.continuous.values():
+            resident_on.update(cj.task_workers.values())
+        workers = []
+        for wid, w in self.workers.items():
+            if wid in self.draining:
+                continue
+            idle = w.get("idle_since")
+            workers.append(_asc.WorkerSignals(
+                worker_id=wid, tasks=len(w["tasks"]),
+                slots=int(w["slots"]),
+                idle_secs=0.0 if (w["tasks"] or idle is None)
+                else max(0.0, now - idle),
+                resident=wid in resident_on,
+                live_output=self._worker_hosts_live_output(w["addr"]),
+                stoppable=bool(owns is None or owns(wid))))
+        queued = self.admission.queued_depths()
+        shed_tot = dict(self.admission.shed_totals)
+        shed = {}
+        for t, n in shed_tot.items():
+            d = n - self._as_shed_seen.get(t, 0)
+            if d > 0:
+                shed[t] = d
+        self._as_shed_seen = shed_tot
+        from .. import metrics as _m
+        stall_tot = _m.REGISTRY.histogram_sum(
+            "streaming.continuous.credit_stall_time")
+        stall = max(0.0, stall_tot - self._as_stall_seen)
+        self._as_stall_seen = stall_tot
+        tenants = set(queued) | set(shed)
+        weights = {t: float(self.admission.conf.policy(t).weight)
+                   for t in tenants}
+        return _asc.FleetSignals(
+            pool=len(workers), draining=len(self.draining),
+            pending_starts=self._starting,
+            min_workers=int(e.get("min", len(workers))),
+            max_workers=int(e.get("max", len(workers))),
+            queued=queued, shed=shed, weights=weights,
+            stall_secs=stall, workers=tuple(workers))
+
+    def _autoscaler_tick(self, now: float):
+        """Periodic policy evaluation (probe cadence, self-throttled to
+        ``tick_secs``). Non-hold decisions and hold-reason EDGES emit
+        replayable ``autoscaler_decision`` events; every decision lands
+        in the /debug/autoscaler ring."""
+        from . import autoscaler as _asc
+        cfg = self.autoscaler_cfg
+        if self.elastic is None or not cfg.enabled:
+            return
+        if now < self._as_next_tick:
+            return
+        self._as_next_tick = now + cfg.tick_secs
+        signals = self._autoscaler_signals(now)
+        decision, self.autoscaler_state = _asc.evaluate(
+            cfg, self.autoscaler_state, signals)
+        self.autoscaler_log.append({
+            "ts": now, "action": decision.action,
+            "worker": decision.worker, "reason": decision.reason,
+            "pool": signals.pool, "draining": signals.draining})
+        if decision.action != _asc.HOLD \
+                or decision.reason != self._as_last_reason:
+            events.emit(EventType.AUTOSCALER_DECISION, query_id="",
+                        action=decision.action, worker=decision.worker,
+                        reason=decision.reason, pool=signals.pool,
+                        detail=decision.detail_json())
+        self._as_last_reason = decision.reason
+        if decision.action == _asc.SCALE_UP:
+            _record_metric("cluster.autoscaler.scale_up_count", 1,
+                           reason=decision.reason)
+            self._maybe_scale_up()
+        elif decision.action == _asc.SCALE_DOWN:
+            _record_metric("cluster.autoscaler.scale_down_count", 1,
+                           reason=decision.reason)
+            self._begin_drain(decision.worker, decision.reason)
+
+    def _begin_drain(self, wid: str, reason: str):
+        """Enter the DRAINING state: stop assigning (every placement
+        site skips draining workers), relaunch resident continuous
+        stages on survivors now, and let the drain tick hand off sealed
+        channels before retirement. The worker stays registered and
+        heartbeating throughout — drain is scheduling state, not
+        eviction."""
+        w = self.workers.get(wid)
+        if w is None or wid in self.draining:
+            return
+        self.draining[wid] = {"started": time.time(), "addr": w["addr"],
+                              "reason": reason, "channels": 0,
+                              "bytes": 0}
+        _record_metric("cluster.worker.draining_count",
+                       len(self.draining))
+        events.emit(EventType.WORKER_DRAIN, query_id="", worker=wid,
+                    phase="begin", channels=0, bytes=0, ms=0.0)
+        from ..catalog.system import SYSTEM
+        SYSTEM.record_worker(wid, w["addr"], w["slots"], "draining")
+        # a resident continuous stage cannot move mid-interval: fail the
+        # pipeline so the streaming query relaunches EVERY stage from
+        # the last sealed marker under a new generation (PR 15), placed
+        # on the surviving pool (the placement site skips us)
+        for cj in list(self.continuous.values()):
+            if any(tw == wid for tw in cj.task_workers.values()):
+                cj.runner.fail(f"worker {wid} draining")
+
+    def _advance_drains(self, now: float):
+        """Drive every in-flight drain one step: wait for running tasks
+        to finish (nothing new lands on a draining worker), hand off
+        sealed channels, then retire via the owning manager. A drain
+        that exceeds its timeout falls back to the eviction path —
+        producer re-run recovers whatever did not move."""
+        for wid in list(self.draining):
+            st = self.draining[wid]
+            w = self.workers.get(wid)
+            if w is None:
+                # crashed/evicted mid-drain: _evict_worker already
+                # repaired the jobs (and closed the drain record when
+                # it went through the eviction hook)
+                self._finish_drain(wid, "abort")
+                continue
+            if now - st["started"] > \
+                    self.autoscaler_cfg.drain_timeout_secs:
+                self._finish_drain(wid, "abort")
+                self._evict_worker(wid, "drain-timeout")
+                self._retire_worker_process(wid)
+                continue
+            if w["tasks"]:
+                continue
+            if not self._drain_handoff(wid, w, st):
+                continue  # transient handoff failure: retry next tick
+            self._finish_drain(wid, "done")
+            self._retire_drained(wid, w)
+
+    def _drain_handoff(self, wid: str, w: dict, st: dict) -> bool:
+        """Move every completed shuffle output a live job still needs
+        from the draining worker to survivors (PullChannels: the
+        survivor pulls raw channel bytes over the data plane and
+        re-seals them locally), then repoint ``job.locations`` so
+        consumers fetch from the new owner. True = nothing left."""
+        addr = w["addr"]
+        done = True
+        for job in list(self.jobs.values()):
+            if job.done.is_set():
+                continue
+            for stage_id, locs in list(job.locations.items()):
+                mine = [p for p, a in locs.items() if a == addr]
+                if not mine:
+                    continue
+                stage = job.graph.stages[stage_id]
+                if stage.shuffle_keys is not None \
+                        and stage.num_channels > 1:
+                    channels = list(range(stage.num_channels))
+                else:
+                    channels = [-1]
+                for p in mine:
+                    survivors = sorted(
+                        ((swid, sw)
+                         for swid, sw in self.workers.items()
+                         if swid != wid
+                         and swid not in self.draining),
+                        key=lambda kv: (len(kv[1]["tasks"]), kv[0]))
+                    if not survivors:
+                        return False  # nowhere to move yet
+                    moved = False
+                    for swid, sw in survivors:
+                        resp = self._pull_channels_rpc(
+                            sw, addr, job, stage_id, p, channels)
+                        if resp is not None and resp.ok:
+                            locs[p] = sw["addr"]
+                            st["channels"] += int(resp.channels_moved)
+                            st["bytes"] += int(resp.bytes_moved)
+                            _record_metric(
+                                "cluster.autoscaler.handoff_bytes",
+                                int(resp.bytes_moved))
+                            events.emit(
+                                EventType.WORKER_DRAIN, query_id="",
+                                worker=wid, phase="handoff",
+                                channels=st["channels"],
+                                bytes=st["bytes"],
+                                ms=round((time.time() - st["started"])
+                                         * 1000.0, 3))
+                            moved = True
+                            break
+                    if not moved:
+                        done = False
+        return done
+
+    def _pull_channels_rpc(self, sw: dict, peer_addr: str, job: "_Job",
+                           stage_id: int, partition: int,
+                           channels: List[int]):
+        rpc = sw["channel"].unary_unary(
+            f"/{_WORKER_SERVICE}/PullChannels",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.PullChannelsResponse.FromString)
+        try:
+            return _call_with_retry(
+                lambda: rpc(pb.PullChannelsRequest(
+                    peer_addr=peer_addr, job_id=job.job_id,
+                    stage=stage_id, partition=partition,
+                    epoch=job.epoch, channels=channels), timeout=30),
+                site="rpc.call", key="PullChannels",
+                method="PullChannels", attempts=2)
+        except (grpc.RpcError, faults.FaultInjectedError):
+            return None
+
+    def _finish_drain(self, wid: str, phase: str):
+        st = self.draining.pop(wid, None)
+        _record_metric("cluster.worker.draining_count",
+                       len(self.draining))
+        if st is None:
+            return
+        dur = time.time() - st["started"]
+        _record_metric("cluster.autoscaler.drain_duration", dur)
+        events.emit(EventType.WORKER_DRAIN, query_id="", worker=wid,
+                    phase=phase, channels=st["channels"],
+                    bytes=st["bytes"], ms=round(dur * 1000.0, 3))
+
+    def _retire_drained(self, wid: str, w: dict):
+        """Retire a fully-drained worker via the owning manager — NOT
+        eviction: its outputs moved, so no job repair, no location
+        invalidation, no producer re-runs."""
+        self.workers.pop(wid, None)
+        _record_metric("cluster.worker_count", len(self.workers))
+        try:
+            _fleet().drop_worker_gauges(wid)
+        except Exception:  # noqa: BLE001 — telemetry never blocks
+            pass
+        try:
+            w["channel"].close()
+        except Exception:  # noqa: BLE001
+            pass
+        from ..catalog.system import SYSTEM
+        SYSTEM.record_worker(wid, w["addr"], w["slots"], "drained")
+        self._retire_worker_process(wid)
+
+    def _retire_worker_process(self, wid: str):
+        e = self.elastic or {}
+        manager = e.get("manager")
+        stop = getattr(manager, "stop_worker_id", None)
+        owns = getattr(manager, "owns", None)
+        if stop is None or (owns is not None and not owns(wid)):
+            return
+        try:
+            stop(wid)
+        except Exception:  # noqa: BLE001 — retirement is best effort
+            pass
 
     def _probe_workers(self):
         now = time.time()
@@ -1634,8 +1997,17 @@ class DriverActor(Actor):
         self._continuous_drain = {
             jid: (cj, ts) for jid, (cj, ts)
             in self._continuous_drain.items() if now - ts < 30.0}
+        # drains advance BEFORE reaping/policy so a finished handoff
+        # frees its slot in the one-drain-at-a-time pipeline this tick
+        self._advance_drains(now)
         if self.elastic is not None:
-            self._reap_idle_workers(now)
+            if self.autoscaler_cfg.enabled:
+                # the policy owns scale-down (occupancy + idle with
+                # hysteresis); running the legacy idle reaper too would
+                # double-drive the drain pipeline
+                self._autoscaler_tick(now)
+            else:
+                self._reap_idle_workers(now)
         lost = [wid for wid, w in self.workers.items()
                 if now - w["last_seen"] > self.HEARTBEAT_TIMEOUT_S]
         for wid in lost:
@@ -1697,6 +2069,11 @@ class DriverActor(Actor):
         w = self.workers.pop(wid, None)
         if w is None:
             return
+        if wid in self.draining:
+            # crash/failure mid-drain: close the drain record — the
+            # repair below (location invalidation + producer re-run)
+            # recovers whatever the handoff had not moved yet
+            self._finish_drain(wid, "abort")
         _record_metric("cluster.worker_count", len(self.workers))
         # the fleet view stops serving the dead worker's stale gauges
         # (counter/histogram history stays: it is still true)
@@ -2020,7 +2397,8 @@ class DriverActor(Actor):
         while not job.done.is_set():
             candidates = sorted(
                 ((wid, w) for wid, w in self.workers.items()
-                 if not exclude or wid not in exclude),
+                 if (not exclude or wid not in exclude)
+                 and wid not in self.draining),
                 key=lambda kv: len(kv[1]["tasks"]))
             if not candidates:
                 if speculative:

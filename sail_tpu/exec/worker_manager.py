@@ -236,6 +236,18 @@ class KubernetesWorkerManager(WorkerManager):
         if handle in self._pods:
             self._pods.remove(handle)
 
+    def owns(self, worker_id: str) -> bool:
+        """True when this manager created the worker's pod — the driver's
+        drain path only retires workers it can actually delete."""
+        return f"{self.pod_name_prefix}{worker_id}" in self._pods
+
+    def stop_worker_id(self, worker_id: str):
+        """Delete the pod backing a registered worker id (graceful-drain
+        retirement and idle reaping route through here)."""
+        name = f"{self.pod_name_prefix}{worker_id}"
+        if name in self._pods:
+            self.stop_worker(name)
+
     def stop_all(self):
         for name in list(self._pods):
             self.stop_worker(name)

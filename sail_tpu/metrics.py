@@ -274,6 +274,15 @@ class MetricsRegistry:
             h = self._hists.get(key)
             return h.copy() if h is not None else None
 
+    def histogram_sum(self, name: str) -> float:
+        """Sum of one histogram metric's observations across EVERY
+        attribute series (0.0 if never recorded) — a cheap monotone
+        total for rate signals read against a delta cursor (the
+        autoscaler's credit-stall input)."""
+        with self._lock:
+            return float(sum(h.sum for (n, _a), h in self._hists.items()
+                             if n == name))
+
     def snapshot(self) -> List[dict]:
         """One row per (metric, attribute-set) with its current value.
         Histogram rows report ``value`` = sum (backward-compatible with

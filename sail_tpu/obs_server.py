@@ -19,8 +19,10 @@ daemon thread (no new dependencies), gated by
   readmission, and no wedged admission queue; 503 otherwise (body says
   why). A process with no cluster is ready by definition.
 - ``GET /debug/queries | /debug/workers | /debug/admission |
-  /debug/compile_cache | /debug/slo | /debug/events?n=N``  JSON
-  introspection of the flight recorder, worker pool, admission state,
+  /debug/autoscaler | /debug/compile_cache | /debug/slo |
+  /debug/events?n=N``  JSON introspection of the flight recorder,
+  worker pool, admission state, the autoscaler (policy config, pool
+  occupancy, draining set with handoff progress, newest decisions),
   the persistent compiled-program cache (entry count, bytes, hit
   ratio, top entries by compile time saved), the tenant SLO burn-rate
   view (evaluating the monitor is the tick; also refreshed on every
@@ -243,6 +245,50 @@ def _debug_slo() -> dict:
             "baselines": _anomaly.BASELINES.snapshot()[:64]}
 
 
+def _debug_autoscaler() -> dict:
+    """Autoscaler view per registered driver: effective policy config,
+    the worker pool (occupancy/idle), the draining set with handoff
+    progress, and the newest policy decisions (each carries the
+    replayable canonical detail via /debug/events)."""
+    now = time.time()
+    clusters = []
+    for d in _drivers():
+        try:
+            pool = {}
+            draining = dict(getattr(d, "draining", {}))
+            for wid, w in dict(d.workers).items():
+                idle = w.get("idle_since")
+                pool[wid] = {
+                    "addr": w.get("addr", ""),
+                    "slots": w.get("slots", 0),
+                    "running_tasks": len(w.get("tasks", ())),
+                    "idle_s": round(now - idle, 3)
+                    if idle and not w.get("tasks") else 0.0,
+                    "draining": wid in draining,
+                }
+            clusters.append({
+                "driver_id": getattr(d, "driver_id", ""),
+                "config": d.autoscaler_cfg.to_dict(),
+                "state": {
+                    "up_streak": d.autoscaler_state.up_streak,
+                    "down_streak": d.autoscaler_state.down_streak,
+                    "cooldown_left": d.autoscaler_state.cooldown_left,
+                },
+                "pool": pool,
+                "draining": {
+                    wid: {"reason": st.get("reason", ""),
+                          "age_s": round(now - st.get("started", now),
+                                         3),
+                          "channels_moved": st.get("channels", 0),
+                          "bytes_moved": st.get("bytes", 0)}
+                    for wid, st in draining.items()},
+                "decisions": list(d.autoscaler_log)[-32:],
+            })
+        except Exception as e:  # noqa: BLE001 — snapshot best-effort
+            clusters.append({"error": f"{type(e).__name__}: {e}"})
+    return {"clusters": clusters}
+
+
 def _debug_compile_cache() -> dict:
     """Persistent compiled-program cache snapshot: store shape, the
     registry's hit/miss/evict/load-error counters, and the top entries
@@ -322,6 +368,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(_debug_workers())
             elif path == "/debug/admission":
                 self._json(_debug_admission())
+            elif path == "/debug/autoscaler":
+                self._json(_debug_autoscaler())
             elif path == "/debug/compile_cache":
                 self._json(_debug_compile_cache())
             elif path == "/debug/slo":
@@ -337,7 +385,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"error": "not found", "paths": [
                     "/metrics", "/healthz", "/readyz",
                     "/debug/queries", "/debug/workers",
-                    "/debug/admission", "/debug/compile_cache",
+                    "/debug/admission", "/debug/autoscaler",
+                    "/debug/compile_cache",
                     "/debug/slo", "/debug/events?n="]}, 404)
         except BrokenPipeError:  # client went away mid-write
             pass

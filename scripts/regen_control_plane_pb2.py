@@ -141,6 +141,26 @@ def main():
         _add_field(push_resp, "retry_after_ms", 4, F.TYPE_UINT32)
         changed = True
 
+    # graceful drain: a surviving worker adopts a draining peer's
+    # sealed shuffle channels (pull over FetchStream + local re-put)
+    pull_req, fresh = _add_message(fdp, "PullChannelsRequest")
+    if fresh:
+        _add_field(pull_req, "peer_addr", 1, F.TYPE_STRING)
+        _add_field(pull_req, "job_id", 2, F.TYPE_STRING)
+        _add_field(pull_req, "stage", 3, F.TYPE_UINT32)
+        _add_field(pull_req, "partition", 4, F.TYPE_UINT32)
+        _add_field(pull_req, "epoch", 5, F.TYPE_UINT64)
+        _add_field(pull_req, "channels", 6, F.TYPE_SINT32,
+                   label=F.LABEL_REPEATED)
+        changed = True
+    pull_resp, fresh = _add_message(fdp, "PullChannelsResponse")
+    if fresh:
+        _add_field(pull_resp, "ok", 1, F.TYPE_BOOL)
+        _add_field(pull_resp, "channels_moved", 2, F.TYPE_UINT32)
+        _add_field(pull_resp, "bytes_moved", 3, F.TYPE_UINT64)
+        _add_field(pull_resp, "error", 4, F.TYPE_STRING)
+        changed = True
+
     if not changed:
         print("pb2 already up to date")
         return

@@ -1,0 +1,637 @@
+"""Elastic fleet autoscaling with graceful drain (exec/autoscaler.py
+and the driver's DRAINING lifecycle in exec/cluster.py).
+
+Units: the pure policy (weight-capped pressure, hysteresis/cooldown
+damping, deterministic drain-candidate ordering) and its replay
+contract (every decision re-derives bit-identically from its recorded
+detail). Integration (LocalCluster): sealed shuffle channels MOVE to
+survivors on scale-down (PullChannels) instead of vanishing into
+producer re-runs, the chaos matrix (crash while draining, fetch drop
+during handoff, drain racing a speculative twin, continuous relaunch
+mid-drain) never fails a query and keeps results bit-identical to a
+fixed pool, and the Kubernetes manager retires pods by worker id.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sail_tpu import events, faults
+from sail_tpu.exec import autoscaler as asc
+from sail_tpu.exec import cluster as cl
+from sail_tpu.metrics import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# unit: the pure policy
+# ---------------------------------------------------------------------------
+
+def _cfg(**kw):
+    kw.setdefault("enabled", True)
+    return asc.AutoscalerConfig(**kw)
+
+
+def _worker(wid="w0", tasks=0, slots=2, idle=60.0, resident=False,
+            live=False, stoppable=True):
+    return asc.WorkerSignals(worker_id=wid, tasks=tasks, slots=slots,
+                             idle_secs=idle, resident=resident,
+                             live_output=live, stoppable=stoppable)
+
+
+def _signals(workers=(), draining=0, pending=0, wmin=1, wmax=4,
+             queued=None, shed=None, weights=None, stall=0.0):
+    return asc.FleetSignals(
+        pool=len(workers), draining=draining, pending_starts=pending,
+        min_workers=wmin, max_workers=wmax, queued=queued or {},
+        shed=shed or {}, weights=weights or {}, stall_secs=stall,
+        workers=tuple(workers))
+
+
+def _run(cfg, seq):
+    """Evaluate a signal sequence; returns the decisions."""
+    state = asc.PolicyState()
+    out = []
+    for s in seq:
+        d, state = asc.evaluate(cfg, state, s)
+        out.append(d)
+    return out
+
+
+def test_weighted_pressure_caps_flooding_tenant():
+    # one weight-1 tenant saturates AT the threshold: never > threshold
+    assert asc.weighted_pressure({"a": 1000}, {"a": 1.0}, 2) == 2.0
+    # broad pressure across tenants exceeds it
+    assert asc.weighted_pressure({"a": 2, "b": 2}, {}, 2) == 4.0
+    # a high-weight tenant has paid-for headroom
+    assert asc.weighted_pressure({"a": 1000}, {"a": 3.0}, 2) == 6.0
+
+
+def test_flooding_tenant_buys_sheds_not_fleet_growth():
+    cfg = _cfg(hysteresis_ticks=1, up_queue_depth=2)
+    busy = [_worker("w0", tasks=2, idle=0.0)]
+    flood = _signals(busy, queued={"noisy": 500},
+                     weights={"noisy": 1.0})
+    # one tenant saturates AT the threshold (never strictly above):
+    # its queue depth buys sheds, not fleet growth
+    assert all(d.action == asc.HOLD for d in _run(cfg, [flood] * 4))
+    # the same depth spread across tenants IS broad pressure
+    broad = _signals(busy, queued={"a": 250, "b": 250},
+                     weights={"a": 1.0, "b": 1.0})
+    d = _run(cfg, [broad])[-1]
+    assert (d.action, d.reason) == (asc.SCALE_UP, "queue_pressure")
+    # ...and a weight-3 tenant bought its own headroom
+    paid = _signals(busy, queued={"noisy": 500},
+                    weights={"noisy": 3.0})
+    d = _run(cfg, [paid])[-1]
+    assert (d.action, d.reason) == (asc.SCALE_UP, "queue_pressure")
+
+
+def test_scale_up_hysteresis_then_cooldown():
+    cfg = _cfg(hysteresis_ticks=2, cooldown_ticks=3)
+    s = _signals([_worker("w0", tasks=2, idle=0.0)],
+                 queued={"a": 3, "b": 3})
+    got = [(d.action, d.reason) for d in _run(cfg, [s] * 7)]
+    assert got[0] == (asc.HOLD, "hysteresis")   # streak 1 < 2
+    assert got[1] == (asc.SCALE_UP, "queue_pressure")
+    # acting resets the streak AND arms the cooldown: sustained
+    # pressure must re-earn hysteresis, then wait out the refractory
+    assert got[2] == (asc.HOLD, "hysteresis")
+    assert got[3] == (asc.HOLD, "cooldown")
+    assert got[4] == (asc.SCALE_UP, "queue_pressure")
+
+
+def test_scale_up_reason_precedence_and_signals():
+    cfg = _cfg(hysteresis_ticks=1)
+    busy = [_worker("w0", tasks=1, idle=0.0)]
+    shed = _signals(busy, shed={"a": 1, "b": 1})
+    assert _run(cfg, [shed])[-1].reason == "shed_pressure"
+    stall = _signals(busy, stall=2.5)
+    assert _run(cfg, [stall])[-1].reason == "credit_stall"
+
+
+def test_at_max_and_at_min_hold():
+    cfg = _cfg(hysteresis_ticks=1, cooldown_ticks=0)
+    s = _signals([_worker("w0", tasks=2, idle=0.0)] * 4, wmax=4,
+                 queued={"a": 9, "b": 9})
+    assert _run(cfg, [s])[-1].reason == "at_max"
+    down = _signals([_worker("w0", idle=99.0)], wmin=1)
+    assert _run(cfg, [down])[-1].reason == "at_min"
+
+
+def test_down_candidate_ordering_and_vetoes():
+    cfg = _cfg(hysteresis_ticks=1, cooldown_ticks=0,
+               down_idle_secs=10.0)
+    pool = [
+        _worker("w-resident", idle=500.0, resident=True),
+        _worker("w-output", idle=500.0, live=True),
+        _worker("w-short", idle=20.0),
+        _worker("w-long", idle=400.0),
+        _worker("w-unstop", idle=900.0, stoppable=False),
+    ]
+    d = _run(cfg, [_signals(pool, wmin=1)])[-1]
+    # cheapest drain first: plain idle beats resident/live-output even
+    # at shorter idle; the unstoppable worker is never a candidate
+    assert (d.action, d.worker, d.reason) == \
+        (asc.SCALE_DOWN, "w-long", "fleet_idle")
+    # occupancy above the shrink threshold vetoes scale-down entirely
+    hot = pool + [_worker("w-busy", tasks=2, slots=2, idle=0.0)] * 3
+    d = _run(cfg, [_signals(hot, wmin=1)])[-1]
+    assert (d.action, d.reason) == (asc.HOLD, "steady")
+    # up-pressure vetoes shrink: the fleet is not safely idle
+    d = _run(cfg, [_signals(pool, wmin=1, queued={"a": 5, "b": 5})])[-1]
+    assert d.action != asc.SCALE_DOWN
+    # an in-flight drain serializes the next victim
+    d = _run(cfg, [_signals(pool, wmin=1, draining=1)])[-1]
+    assert (d.action, d.reason) == (asc.HOLD, "draining")
+
+
+def test_disabled_policy_only_holds():
+    d = _run(asc.AutoscalerConfig(),
+             [_signals([_worker(idle=999.0)],
+                       queued={"a": 99, "b": 99})])[-1]
+    assert (d.action, d.reason) == (asc.HOLD, "disabled")
+
+
+def test_decisions_replay_bit_identically_from_detail():
+    """The determinism contract: every decision re-derives from its
+    canonical detail ALONE — action, worker, and reason match, and the
+    canonical JSON round-trips byte-for-byte."""
+    cfg = _cfg(hysteresis_ticks=2, cooldown_ticks=1,
+               down_idle_secs=5.0)
+    seq = (
+        [_signals([_worker("w0", tasks=2, idle=0.0)],
+                  queued={"a": 3, "b": 3})] * 3 +
+        [_signals([_worker("w0", idle=50.0),
+                   _worker("w1", idle=80.0)], wmin=1)] * 4 +
+        [_signals([_worker("w0", tasks=1, idle=0.0)], shed={"x": 9},
+                  weights={"x": 4.0})] * 3
+    )
+    decisions = _run(cfg, seq)
+    assert {d.action for d in decisions} >= {asc.SCALE_UP,
+                                             asc.SCALE_DOWN, asc.HOLD}
+    for d in decisions:
+        blob = d.detail_json()
+        assert blob == json.dumps(json.loads(blob), sort_keys=True,
+                                  separators=(",", ":"))
+        rep = asc.replay_record(json.loads(blob))
+        assert (rep.action, rep.worker, rep.reason) == \
+            (d.action, d.worker, d.reason)
+    replayed = asc.replay_log([{"detail": d.detail_json()}
+                               for d in decisions])
+    assert replayed == [{"action": d.action, "worker": d.worker,
+                         "reason": d.reason} for d in decisions]
+
+
+# ---------------------------------------------------------------------------
+# unit: Kubernetes manager retires pods by worker id
+# ---------------------------------------------------------------------------
+
+def test_kubernetes_manager_owns_and_stops_by_worker_id():
+    from tests.test_worker_manager import FakeKubeApi
+    from sail_tpu.exec.worker_manager import KubernetesWorkerManager
+
+    api = FakeKubeApi()
+    mgr = KubernetesWorkerManager("driver.svc:7077", api=api,
+                                  namespace="engine")
+    mgr.start_worker("abc123")
+    assert mgr.owns("abc123")
+    assert not mgr.owns("other"), "ownership must be per worker id"
+    mgr.stop_worker_id("abc123")
+    assert api.pods == {} and not mgr.owns("abc123")
+    # retiring an unknown id is a no-op, not a DELETE storm
+    calls = len(api.calls)
+    mgr.stop_worker_id("ghost")
+    assert len(api.calls) == calls
+
+
+# ---------------------------------------------------------------------------
+# integration: graceful drain on a LocalCluster
+# ---------------------------------------------------------------------------
+
+class _DrainStage:
+    """Minimal stage carrying the shuffle shape the handoff reads."""
+
+    def __init__(self, stage_id, num_partitions, shuffle_keys=None,
+                 num_channels=1):
+        self.stage_id = stage_id
+        self.num_partitions = num_partitions
+        self.shuffle_keys = shuffle_keys
+        self.num_channels = num_channels
+
+
+class _DrainGraph:
+    def __init__(self, stages):
+        self.stages = stages
+        self.root = stages[-1]
+        self.scan_tables = {}
+
+
+def _on_driver(driver, fn):
+    """Run a closure on the driver's actor thread (single-threaded
+    state discipline) and return its result."""
+    out = driver.handle.ask(lambda reply: ("call", (fn, reply)))
+    if isinstance(out, Exception):
+        raise out
+    return out
+
+
+def _poll_probe(driver, pred, timeout=30.0):
+    """Drive probe ticks fast (instead of the 2 s cadence) until the
+    predicate holds."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        driver.handle.send(("probe", None))
+        time.sleep(0.05)
+    return pred()
+
+
+def _seed_drain_fixture(cluster, payload):
+    """Register a live fake job whose completed shuffle stage lives on
+    worker 0, ready to be drained."""
+    wa, wb = cluster.workers[0], cluster.workers[1]
+    wa.streams.put("drainjob", 0, 0, payload, epoch=0)
+    graph = _DrainGraph([_DrainStage(0, 1, shuffle_keys=(0,),
+                                     num_channels=len(payload))])
+    job = cl._Job("drainjob", graph)
+
+    def seed(d):
+        d.jobs[job.job_id] = job
+        job.locations[0][0] = d.workers[wa.worker_id]["addr"]
+        return d.workers[wa.worker_id]["addr"]
+
+    addr_a = _on_driver(cluster.driver, seed)
+    return wa, wb, job, addr_a
+
+
+def _metric_value(name):
+    return sum(r.get("value", 0) for r in REGISTRY.snapshot()
+               if r["name"] == name)
+
+
+def test_drain_moves_sealed_channels_instead_of_rerunning():
+    """Scale-down's core promise: completed shuffle output MOVES to a
+    survivor bit-identically (handoff, not re-run), consumers repoint,
+    and the drained worker retires cleanly."""
+    events.EVENT_LOG.clear()
+    payload = {0: b"\x11" * 2048, 1: b"\x22" * 4096}
+    cluster = cl.LocalCluster(
+        num_workers=2, task_slots=1,
+        elastic={"min": 1, "max": 2, "idle_secs": 300})
+    try:
+        d = cluster.driver
+        wa, wb, job, addr_a = _seed_drain_fixture(cluster, payload)
+        before = _metric_value("cluster.autoscaler.handoff_bytes")
+        _on_driver(d, lambda drv: drv._begin_drain(wa.worker_id,
+                                                   "test"))
+        assert _poll_probe(d, lambda: wa.worker_id not in d.workers), \
+            "drained worker never retired"
+        assert wa.worker_id not in d.draining
+        # locations repointed to the survivor — no producer re-run
+        addr_b = d.workers[wb.worker_id]["addr"]
+        assert job.locations[0][0] == addr_b
+        assert job.retry_count == 0
+        # the adopted channels serve byte-identical content
+        for c, buf in payload.items():
+            assert wb.streams.get("drainjob", 0, 0, c) == buf
+        assert _metric_value("cluster.autoscaler.handoff_bytes") \
+            - before == sum(len(b) for b in payload.values())
+        phases = [e["phase"] for e in events.events()
+                  if e["type"] == "worker_drain"
+                  and e["worker"] == wa.worker_id]
+        assert phases[0] == "begin" and phases[-1] == "done"
+        assert "handoff" in phases
+    finally:
+        cluster.stop()
+
+
+def test_drain_handoff_retries_through_dropped_fetch():
+    """Chaos: the survivor's raw channel pull drops once (injected at
+    the shared shuffle.fetch site). The half-adopted output must never
+    seal; the next drain tick retries the whole partition and the move
+    still completes bit-identically."""
+    payload = {0: b"\x33" * 1024, 1: b"\x44" * 1024}
+    faults.configure("shuffle.fetch:*raw=error(not_found)#1", seed=7)
+    cluster = cl.LocalCluster(
+        num_workers=2, task_slots=1,
+        elastic={"min": 1, "max": 2, "idle_secs": 300})
+    try:
+        d = cluster.driver
+        wa, wb, job, _ = _seed_drain_fixture(cluster, payload)
+        _on_driver(d, lambda drv: drv._begin_drain(wa.worker_id,
+                                                   "test"))
+        assert _poll_probe(d, lambda: wa.worker_id not in d.workers), \
+            "drain wedged on a single dropped fetch"
+        assert faults.injection_counts().get("shuffle.fetch", 0) == 1
+        for c, buf in payload.items():
+            assert wb.streams.get("drainjob", 0, 0, c) == buf
+        assert job.retry_count == 0
+    finally:
+        cluster.stop()
+
+
+def test_crash_while_draining_falls_back_to_eviction(monkeypatch):
+    """Chaos: the draining worker dies mid-drain. The heartbeat
+    eviction path must close the drain record and invalidate the dead
+    locations (producer re-run recovers) — never a wedged drain."""
+    monkeypatch.setenv("SAIL_CLUSTER__WORKER_HEARTBEAT_TIMEOUT_SECS",
+                       "2")
+    events.EVENT_LOG.clear()
+    cluster = cl.LocalCluster(
+        num_workers=2, task_slots=1,
+        elastic={"min": 1, "max": 2, "idle_secs": 300})
+    try:
+        d = cluster.driver
+        wa, _wb, job, _ = _seed_drain_fixture(cluster,
+                                              {0: b"\x55" * 512})
+        _on_driver(d, lambda drv: drv._begin_drain(wa.worker_id,
+                                                   "crash-test"))
+        assert wa.worker_id in d.draining
+        wa._die()
+        assert _poll_probe(d, lambda: wa.worker_id not in d.workers,
+                           timeout=20), "dead worker never evicted"
+        assert wa.worker_id not in d.draining, "drain record leaked"
+        # the un-moved output is invalidated → the re-run path owns it
+        assert 0 not in job.locations[0]
+        phases = [e["phase"] for e in events.events()
+                  if e["type"] == "worker_drain"
+                  and e["worker"] == wa.worker_id]
+        assert phases[-1] == "abort"
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# integration: drain during live queries (zero failed queries,
+# bit-identical results vs a fixed pool)
+# ---------------------------------------------------------------------------
+
+def _agg_fixture(seed=11, rows=20000):
+    from sail_tpu import SparkSession
+    from sail_tpu.sql import parse_one
+
+    spark = SparkSession({"spark.sail.execution.mesh": "off"})
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({"k": rng.integers(0, 64, rows),
+                       "v": rng.random(rows)})
+    spark.createDataFrame(df).createOrReplaceTempView("t")
+    plan = spark._resolve(parse_one(
+        "SELECT k, SUM(v) FROM t GROUP BY k"))
+    expected = df.groupby("k")["v"].sum()
+    return plan, expected
+
+
+def _canon(table):
+    pdf = table.to_pandas()
+    return pdf.sort_values(list(pdf.columns)).reset_index(drop=True)
+
+
+@pytest.mark.parametrize("scenario", ["drain-mid-query", "spec-twin",
+                                      "fetch-drop"])
+def test_chaos_drain_during_query_matrix(monkeypatch, scenario):
+    """Scale-down races a live query — plain, with speculation forced
+    hot (a twin can land on or race the draining worker), and with a
+    dropped consumer fetch on top. Zero failed queries; results
+    bit-identical to the same query on a fixed pool."""
+    if scenario == "spec-twin":
+        monkeypatch.setenv("SAIL_CLUSTER__SPECULATION__MIN_RUNTIME_MS",
+                           "0")
+        monkeypatch.setenv(
+            "SAIL_CLUSTER__SPECULATION__STAGE_FRACTION", "0.1")
+        monkeypatch.setenv(
+            "SAIL_CLUSTER__SPECULATION__LATENCY_MULTIPLIER", "0.1")
+    plan, expected = _agg_fixture()
+
+    fixed = cl.LocalCluster(num_workers=2, task_slots=1)
+    try:
+        baseline = _canon(fixed.run_job(plan, num_partitions=4))
+    finally:
+        fixed.stop()
+    np.testing.assert_allclose(baseline.iloc[:, 1].values,
+                               expected.values)
+
+    if scenario == "fetch-drop":
+        faults.configure(
+            "shuffle.fetch:*c[0-9]*=error(not_found)#1", seed=13)
+    cluster = cl.LocalCluster(
+        num_workers=2, task_slots=1,
+        elastic={"min": 1, "max": 3, "idle_secs": 300})
+    try:
+        d = cluster.driver
+        result, errors = [], []
+
+        def run():
+            try:
+                result.append(cluster.run_job(plan, num_partitions=4))
+            except Exception as e:  # noqa: BLE001 — the assertion below
+                errors.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        # begin draining a worker while its tasks are still in flight:
+        # the drain must wait for them, hand off, then retire
+        time.sleep(0.3)
+        victim = cluster.workers[1].worker_id
+        _on_driver(d, lambda drv: drv._begin_drain(victim, "chaos"))
+        t.join(timeout=90)
+        assert not t.is_alive(), "query wedged during scale-down"
+        assert not errors, f"scale-down failed the query: {errors}"
+        _poll_probe(d, lambda: victim not in d.workers, timeout=30)
+        assert victim not in d.workers, "victim never retired"
+        assert _canon(result[0]).equals(baseline), \
+            f"{scenario}: drained-run result differs from fixed pool"
+    finally:
+        cluster.stop()
+
+
+def test_continuous_pipeline_relaunches_mid_drain(tmp_path,
+                                                  monkeypatch):
+    """A resident continuous pipeline cannot move mid-interval: drain
+    fails it, the restarted query relaunches every stage from the last
+    sealed marker under a new generation ON THE SURVIVORS (placement
+    skips the draining worker), the sink output stays byte-identical
+    to an undrained run, and the drained worker retires."""
+    import glob
+    import os
+
+    import pyarrow.parquet as pq
+
+    from sail_tpu import SparkSession
+    from sail_tpu.session import DataFrame
+    from sail_tpu.streaming import (ReplayableMemorySource,
+                                    StreamingQueryException,
+                                    _StreamRead)
+
+    monkeypatch.setenv("SAIL_STREAMING__CONTINUOUS__ENABLED", "1")
+    events.EVENT_LOG.clear()
+    spark = SparkSession({})
+    schema = pa.schema([("k", pa.int64()), ("v", pa.int64())])
+
+    def batch(e, rows=40):
+        return pa.table(
+            {"k": pa.array([(e * 31 + i) % 8 for i in range(rows)],
+                           type=pa.int64()),
+             "v": pa.array([e * 1000 + i for i in range(rows)],
+                           type=pa.int64())}, schema=schema)
+
+    batches = [batch(e) for e in range(3)]
+
+    def read_parts(out_dir):
+        return {os.path.basename(f): pq.read_table(f)
+                for f in sorted(glob.glob(
+                    os.path.join(out_dir, "part-*.parquet")))}
+
+    def run(tag, drain):
+        out_dir = str(tmp_path / f"{tag}_out")
+        ckpt = str(tmp_path / f"{tag}_ckpt")
+        cluster = cl.LocalCluster(num_workers=2, task_slots=2)
+        d = cluster.driver
+        victim = [None]
+
+        def make_query(fed):
+            src = ReplayableMemorySource(schema)
+            for b in batches[:fed]:
+                src.add(b)
+            df = DataFrame(_StreamRead("dq", src), spark) \
+                .filter("v % 2 = 0")
+            q = (df.writeStream.format("parquet")
+                 .option("checkpointLocation", ckpt)
+                 .cluster(cluster).start(out_dir))
+            return src, q
+
+        try:
+            src, q = make_query(0)
+            restarts, fed = 0, 0
+            try:
+                while True:
+                    try:
+                        q.processAllAvailable()
+                    except StreamingQueryException:
+                        q.stop()
+                        restarts += 1
+                        assert restarts <= 4, "drain restart storm"
+                        src, q = make_query(fed)
+                        continue
+                    if fed == 1 and drain and victim[0] is None:
+                        assert q._cont_runner is not None, \
+                            "continuous mode did not engage"
+                        victim[0] = _on_driver(
+                            d, lambda drv: next(iter(next(iter(
+                                drv.continuous.values()))
+                                .task_workers.values())))
+                        _on_driver(
+                            d, lambda drv: drv._begin_drain(
+                                victim[0], "drain-test"))
+                    if fed >= len(batches):
+                        break
+                    feed_src = src
+                    feed_src.add(batches[fed])
+                    fed += 1
+            finally:
+                q.stop()
+            if drain:
+                assert _poll_probe(
+                    d, lambda: victim[0] not in d.workers,
+                    timeout=30), "draining worker never retired"
+        finally:
+            cluster.stop()
+        return read_parts(out_dir), restarts, victim[0]
+
+    clean, r0, _ = run("clean", drain=False)
+    assert r0 == 0 and len(clean) == 3
+    drained, restarts, victim = run("drained", drain=True)
+    spark.stop()
+    assert restarts >= 1, "drain never failed the resident pipeline"
+    assert sorted(drained) == sorted(clean)
+    for name, table in clean.items():
+        assert drained[name].equals(table), \
+            f"{name}: relaunch mid-drain broke exactly-once"
+    phases = [e["phase"] for e in events.events()
+              if e["type"] == "worker_drain" and e["worker"] == victim]
+    assert phases and phases[-1] == "done"
+
+
+# ---------------------------------------------------------------------------
+# integration: the policy drives the pool; its decision log replays
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fault_seed", [7, 13])
+def test_policy_decision_log_replays_identically(monkeypatch,
+                                                 fault_seed):
+    """With the autoscaler ON under fault injection, the pool grows on
+    demand, the policy drains it back to min, the query never fails,
+    and EVERY recorded autoscaler_decision replays bit-identically
+    from its detail (per fault seed)."""
+    monkeypatch.setenv("SAIL_CLUSTER__AUTOSCALER__ENABLED", "1")
+    monkeypatch.setenv("SAIL_CLUSTER__AUTOSCALER__TICK_SECS", "0.2")
+    monkeypatch.setenv("SAIL_CLUSTER__AUTOSCALER__DOWN_IDLE_SECS",
+                       "0.4")
+    monkeypatch.setenv("SAIL_CLUSTER__AUTOSCALER__HYSTERESIS_TICKS",
+                       "2")
+    monkeypatch.setenv("SAIL_CLUSTER__AUTOSCALER__COOLDOWN_TICKS", "1")
+    events.EVENT_LOG.clear()
+    plan, expected = _agg_fixture(seed=fault_seed)
+    faults.configure("shuffle.fetch:*c[0-9]*=error(not_found)#1",
+                     seed=fault_seed)
+    cluster = cl.LocalCluster(
+        num_workers=1, task_slots=1,
+        elastic={"min": 1, "max": 3, "idle_secs": 0.4})
+    try:
+        d = cluster.driver
+        out = cluster.run_job(plan, num_partitions=4)
+        got = out.to_pandas().sort_values(out.column_names[0])
+        np.testing.assert_allclose(got.iloc[:, 1].values,
+                                   expected.values)
+        assert d.pool_peak > 1, "demand never scaled the pool up"
+        # the policy shrinks the pool back to min through the drain path
+        assert _poll_probe(
+            d, lambda: len(d.workers) <= 1 and not d.draining,
+            timeout=40), "policy never drained the idle fleet"
+    finally:
+        cluster.stop()
+    records = [e for e in events.events()
+               if e["type"] == "autoscaler_decision"]
+    assert any(r["action"] == asc.SCALE_DOWN for r in records), \
+        "no scale-down decision was recorded"
+    replayed = asc.replay_log(records)
+    assert replayed == [{"action": r["action"], "worker": r["worker"],
+                         "reason": r["reason"]} for r in records]
+
+
+def test_hard_reap_ab_flag_restores_legacy_stop(monkeypatch):
+    """Satellite A/B: cluster.autoscaler.hard_reap routes idle shrink
+    through the legacy hard stop — no drain events, worker reaped."""
+    monkeypatch.setenv("SAIL_CLUSTER__AUTOSCALER__HARD_REAP", "1")
+    events.EVENT_LOG.clear()
+    plan, expected = _agg_fixture(seed=3, rows=8000)
+    cluster = cl.LocalCluster(
+        num_workers=1, task_slots=1,
+        elastic={"min": 1, "max": 3, "idle_secs": 0.2})
+    try:
+        d = cluster.driver
+        out = cluster.run_job(plan, num_partitions=4)
+        np.testing.assert_allclose(
+            out.to_pandas().sort_values(
+                out.column_names[0]).iloc[:, 1].values,
+            expected.values)
+        assert d.pool_peak > 1
+        assert _poll_probe(d, lambda: len(d.workers) <= 1,
+                           timeout=20), "idle workers not hard-reaped"
+    finally:
+        cluster.stop()
+    assert not [e for e in events.events()
+                if e["type"] == "worker_drain"], \
+        "hard_reap must bypass the drain lifecycle"
