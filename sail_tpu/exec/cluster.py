@@ -2036,6 +2036,12 @@ class DriverActor(Actor):
             SYSTEM.record_job(job.job_id, len(job.graph.stages),
                               "running")
             self._schedule_ready_stages(job)
+        # jobs still queued after a drain pass mean the pool is the
+        # bottleneck RIGHT NOW — start a worker here instead of waiting
+        # out the autoscaler's hysteresis (the policy still owns
+        # scale-down, and _maybe_scale_up enforces the max/pending cap)
+        if self.elastic is not None and self.admission.total_queued():
+            self._maybe_scale_up()
 
     def _check_deadlines(self, now: float):
         """Per-query deadlines cancel through the existing CancelJob

@@ -611,6 +611,55 @@ def test_policy_decision_log_replays_identically(monkeypatch,
                          "reason": r["reason"]} for r in records]
 
 
+def test_admission_queue_triggers_scale_up(monkeypatch):
+    """Satellite: a job still queued after an admission drain pass is
+    live evidence the pool is the bottleneck — scale-up fires from the
+    drain path itself, without waiting out the policy's hysteresis.
+    Slots are plentiful (8/worker for 2 partitions), so the legacy
+    demand path never fires; only the queued-admission trigger can
+    grow the pool here."""
+    monkeypatch.setenv("SAIL_ADMISSION__ENABLED", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS", "1")
+    monkeypatch.setenv("SAIL_ADMISSION__MAX_CONCURRENT_JOBS_TOTAL",
+                       "1")
+    from sail_tpu.exec import admission
+    admission.reload()
+    plan, expected = _agg_fixture(seed=5, rows=20000)
+    faults.configure("worker.task_exec:*=delay(1.0)#2", seed=3)
+    cluster = cl.LocalCluster(
+        num_workers=1, task_slots=8,
+        elastic={"min": 1, "max": 2, "idle_secs": 300})
+    try:
+        results, errors = [], []
+
+        def run():
+            try:
+                results.append(cluster.run_job(plan, num_partitions=2,
+                                               timeout=90))
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errors.append(e)
+
+        t1 = threading.Thread(target=run)
+        t2 = threading.Thread(target=run)
+        t1.start()
+        time.sleep(0.2)
+        t2.start()
+        t1.join(120)
+        t2.join(120)
+        assert not errors, errors
+        assert len(results) == 2
+        for out in results:
+            got = out.to_pandas().sort_values(out.column_names[0])
+            np.testing.assert_allclose(got.iloc[:, 1].values,
+                                       expected.values)
+        assert cluster.driver.pool_peak >= 2, \
+            "queued admission never scaled the pool up"
+    finally:
+        cluster.stop()
+        monkeypatch.undo()
+        admission.reload()
+
+
 def test_hard_reap_ab_flag_restores_legacy_stop(monkeypatch):
     """Satellite A/B: cluster.autoscaler.hard_reap routes idle shrink
     through the legacy hard stop — no drain events, worker reaped."""
