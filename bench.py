@@ -1240,6 +1240,192 @@ def _env_on(name: str) -> bool:
                                                          "yes")
 
 
+def _run_autoscale_bench(spark) -> dict:
+    """SAIL_BENCH_AUTOSCALE=1: elastic load-ramp artifact.
+
+    A two-thread query ramp drives a 1-worker elastic cluster (max 3)
+    through grow → plateau → shrink, with a seeded straggler delay on
+    the final-stage tasks so scale-down decisions land WHILE queries
+    are still in flight (the graceful-drain race the policy must win).
+    Two legs, identical workload and fault seed:
+
+      drain     — autoscaler ON (aggressive shrink: occupancy veto
+                  relaxed so drains fire mid-query); sealed channels
+                  of live jobs MOVE to survivors (handoff_bytes > 0)
+      hard_reap — SAME policy, cluster.autoscaler.hard_reap=1: each
+                  scale-down decision hard-stops the victim instead of
+                  draining it, so identical shrink decisions destroy
+                  sealed channels and consumers pay producer re-runs
+
+    Acceptance rides the artifact: zero failed queries in both legs,
+    drain-leg p99 within SAIL_BENCH_AUTOSCALE_SLO_MS, pool grows past
+    1 and returns to 1, every recorded autoscaler decision replays
+    bit-identically from its detail, and the drain leg's task re-runs
+    stay below the hard-reap leg's."""
+    import threading
+
+    import pyarrow as pa
+
+    from sail_tpu import events, faults
+    from sail_tpu import metrics as gm
+    from sail_tpu.exec import autoscaler as asc
+    from sail_tpu.exec.cluster import LocalCluster
+    from sail_tpu.sql import parse_one
+
+    n_queries = int(os.environ.get("SAIL_BENCH_AUTOSCALE_QUERIES",
+                                   "8"))
+    rows = int(os.environ.get("SAIL_BENCH_AUTOSCALE_ROWS", "120000"))
+    slo_ms = float(os.environ.get("SAIL_BENCH_AUTOSCALE_SLO_MS",
+                                  "15000"))
+    rng = np.random.default_rng(7)
+    t = pa.table({"k": rng.integers(0, 64, rows),
+                  "v": rng.random(rows)})
+    spark.createDataFrame(t).createOrReplaceTempView("asb")
+    plan = spark._resolve(parse_one(
+        "SELECT k, SUM(v), COUNT(*) FROM asb GROUP BY k"))
+
+    def handoff_total():
+        return sum(r["value"] for r in gm.REGISTRY.snapshot()
+                   if r["name"] == "cluster.autoscaler.handoff_bytes")
+
+    def leg(graceful: bool) -> dict:
+        overrides = {
+            "SAIL_CLUSTER__AUTOSCALER__ENABLED": "1",
+            "SAIL_CLUSTER__AUTOSCALER__HARD_REAP":
+                "0" if graceful else "1",
+            "SAIL_CLUSTER__AUTOSCALER__TICK_SECS": "0.3",
+            "SAIL_CLUSTER__AUTOSCALER__DOWN_IDLE_SECS": "0.4",
+            "SAIL_CLUSTER__AUTOSCALER__DOWN_OCCUPANCY": "0.9",
+            "SAIL_CLUSTER__AUTOSCALER__HYSTERESIS_TICKS": "1",
+            "SAIL_CLUSTER__AUTOSCALER__COOLDOWN_TICKS": "1",
+        }
+        saved = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        t_leg = time.time()
+        h0 = handoff_total()
+        # the straggler window: final-stage partition 0 sleeps past the
+        # idle threshold AND past the drain's begin→advance probe span,
+        # so a freshly-grown worker goes idle holding sealed map output
+        # of a still-live query — the shrink must then move (drain) or
+        # destroy (hard reap) channels a running consumer still needs
+        # the count cap keeps the chaos fair: first attempts straggle,
+        # but a RELAUNCHED attempt (the re-run a hard stop forces, or a
+        # retry through a handoff window) runs at full speed — re-run
+        # cost shows up in the rerun counter, not as stacked sleeps
+        faults.configure(
+            f"seed=77;worker.task_exec:*s1p0*=delay(5.0)#{n_queries}",
+            seed=77)
+        cluster = LocalCluster(
+            num_workers=1, task_slots=1,
+            elastic={"min": 1, "max": 3, "idle_secs": 0.4})
+        d = cluster.driver
+        trace, stop = [], threading.Event()
+
+        def sample():
+            while not stop.wait(0.25):
+                trace.append((round(time.time() - t_leg, 2),
+                              len(d.workers), len(d.draining)))
+
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+        latencies, reruns, failures = [], [], []
+        lock = threading.Lock()
+        pending = list(range(n_queries))
+
+        def runner():
+            while True:
+                with lock:
+                    if not pending:
+                        return
+                    pending.pop()
+                t0 = time.perf_counter()
+                try:
+                    cluster.run_job(plan, num_partitions=4,
+                                    timeout=120)
+                    rc = cluster.last_job.retry_count
+                except Exception as e:  # noqa: BLE001 — counted below
+                    failures.append(f"{type(e).__name__}: {e}")
+                    continue
+                with lock:
+                    latencies.append(time.perf_counter() - t0)
+                    reruns.append(rc)
+
+        try:
+            threads = [threading.Thread(target=runner)
+                       for _ in range(2)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            # ramp-down: the pool must return to min on its own
+            deadline = time.time() + 45
+            while time.time() < deadline:
+                if len(d.workers) <= 1 and not d.draining:
+                    break
+                time.sleep(0.3)
+            shrunk = len(d.workers) <= 1 and not d.draining
+            peak = d.pool_peak
+        finally:
+            stop.set()
+            sampler.join(timeout=5)
+            cluster.stop()
+            faults.reset()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        decisions = [e for e in events.events()
+                     if e["type"] == "autoscaler_decision"
+                     and e["ts"] >= t_leg]
+        lat_ms = sorted(x * 1000.0 for x in latencies)
+
+        def pct(q):
+            return round(lat_ms[min(len(lat_ms) - 1,
+                                    int(q * len(lat_ms)))], 1) \
+                if lat_ms else None
+
+        return {
+            "queries": len(latencies),
+            "failed": len(failures),
+            "failures": failures[:4],
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+            "pool_peak": peak,
+            "shrunk_to_min": shrunk,
+            "pool_trace": trace[:: max(1, len(trace) // 24)],
+            "task_reruns": sum(reruns),
+            "handoff_bytes": int(handoff_total() - h0),
+            "decisions": {
+                a: sum(1 for e in decisions if e["action"] == a)
+                for a in (asc.SCALE_UP, asc.SCALE_DOWN, asc.HOLD)},
+            "decisions_replay_identical": asc.replay_log(decisions)
+            == [{"action": e["action"], "worker": e["worker"],
+                 "reason": e["reason"]} for e in decisions],
+        }
+
+    drain = leg(graceful=True)
+    hard = leg(graceful=False)
+    out = {
+        "slo_ms": slo_ms,
+        "drain": drain,
+        "hard_reap": hard,
+        "zero_failed_queries": drain["failed"] == 0
+        and hard["failed"] == 0,
+        "p99_within_slo": drain["p99_ms"] is not None
+        and drain["p99_ms"] <= slo_ms,
+        "handoff_beats_rerun": drain["handoff_bytes"] > 0
+        and drain["task_reruns"] < hard["task_reruns"],
+    }
+    print(f"bench: autoscale drain p99={drain['p99_ms']}ms "
+          f"peak={drain['pool_peak']} "
+          f"handoff={drain['handoff_bytes']}B "
+          f"reruns={drain['task_reruns']} "
+          f"vs hard_reap reruns={hard['task_reruns']}",
+          file=sys.stderr, flush=True)
+    return out
+
+
 def _run_saturation(spark, n_tenants: int) -> dict:
     """SAIL_BENCH_CONCURRENCY=N: multi-tenant saturation artifact.
 
@@ -1819,6 +2005,13 @@ def main():
             result["tail_latency"] = _run_tail_latency(spark)
         except Exception as e:  # noqa: BLE001
             result["tail_latency_error"] = f"{type(e).__name__}: {e}"
+    # elastic autoscaling load-ramp: grow → plateau → graceful-drain
+    # shrink, hard-reap A/B (opt-in: two extra cluster ramps)
+    if _env_on("SAIL_BENCH_AUTOSCALE"):
+        try:
+            result["autoscale"] = _run_autoscale_bench(spark)
+        except Exception as e:  # noqa: BLE001
+            result["autoscale_error"] = f"{type(e).__name__}: {e}"
     # chaos mode: TPC-H under a fixed fault seed, recovery overhead in
     # the artifact (opt-in: the run costs two extra cluster executions)
     if os.environ.get("SAIL_BENCH_CHAOS", "0").strip().lower() in (

@@ -1810,7 +1810,28 @@ class DriverActor(Actor):
         elif decision.action == _asc.SCALE_DOWN:
             _record_metric("cluster.autoscaler.scale_down_count", 1,
                            reason=decision.reason)
-            self._begin_drain(decision.worker, decision.reason)
+            if cfg.hard_reap:
+                self._hard_stop(decision.worker)
+            else:
+                self._begin_drain(decision.worker, decision.reason)
+
+    def _hard_stop(self, wid: str):
+        """The A/B control (``cluster.autoscaler.hard_reap``): execute a
+        policy scale-down as the legacy hard stop. Completed shuffle
+        channels die with the worker and every consumer pays a producer
+        re-run — exactly the cost the drain lifecycle exists to avoid."""
+        if wid not in self.workers:
+            return
+        e = self.elastic or {}
+        stop = getattr(e.get("manager"), "stop_worker_id", None)
+        self._evict_worker(wid, "hard_reap")
+        # a deliberate retirement is not a transient blip: no readmission
+        self._readmit_info.pop(wid, None)
+        if stop is not None:
+            try:
+                stop(wid)
+            except Exception:  # noqa: BLE001 — manager stop is best-effort
+                pass
 
     def _begin_drain(self, wid: str, reason: str):
         """Enter the DRAINING state: stop assigning (every placement
@@ -2139,6 +2160,15 @@ class DriverActor(Actor):
                 live.pop(att)
             if live:
                 continue
+            # the dead worker may have held BOTH a consumer task and its
+            # producer's sealed output: the producer must re-run before
+            # the consumer can resolve inputs, so park the consumer (the
+            # producer's completion report fires _fire_pending) instead
+            # of letting _launch_task fail the job on incomplete inputs
+            if not self._partition_ready(job, job.graph.stages[stage],
+                                         partition):
+                job.pending.add((stage, partition))
+                continue
             self._launch_task(job, stage, partition,
                               self.attempt_of(job, stage, partition) + 1,
                               reason="evicted")
@@ -2334,15 +2364,27 @@ class DriverActor(Actor):
             addrs = [job.locations[i.stage_id].get(p, "")
                      for p in range(up.num_partitions)]
             if i.mode == jg.InputMode.FORWARD:
-                if not addrs[partition]:
-                    job.failed = (f"stage {stage_id} p{partition}: forward "
-                                  f"input {i.stage_id} not located")
-                    job.done.set()
-                    return False
-            elif not all(addrs):
-                job.failed = (f"stage {stage_id}: input stage {i.stage_id} "
-                              f"incomplete at launch")
-                job.done.set()
+                missing = [] if addrs[partition] else [partition]
+            else:
+                missing = [p for p in range(up.num_partitions)
+                           if not addrs[p]]
+            if missing:
+                # a recovery race, not a scheduling bug: scheduling only
+                # launches once inputs are complete, so a hole here means
+                # a producer's sealed output vanished (hard stop, crash)
+                # after this consumer was dispatched or queued for retry.
+                # Park the consumer and make sure every missing producer
+                # partition is re-running — its completion report fires
+                # _fire_pending and the consumer launches then.
+                if speculative:
+                    return False  # never park a duplicate
+                job.pending.add((stage_id, partition))
+                for p in missing:
+                    if not job.live.get((i.stage_id, p)):
+                        self._launch_task(
+                            job, i.stage_id, p,
+                            self.attempt_of(job, i.stage_id, p) + 1,
+                            reason="input_lost")
                 return False
             loc = pb.StageInputLocations(
                 stage_id=i.stage_id, mode=i.mode.value, worker_addrs=addrs)

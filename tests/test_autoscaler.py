@@ -684,3 +684,74 @@ def test_hard_reap_ab_flag_restores_legacy_stop(monkeypatch):
     assert not [e for e in events.events()
                 if e["type"] == "worker_drain"], \
         "hard_reap must bypass the drain lifecycle"
+
+
+def test_policy_scale_down_hard_stops_under_hard_reap(monkeypatch):
+    """The A/B control end to end: with the POLICY enabled and
+    hard_reap set, a scale-down decision hard-stops the victim through
+    eviction — sealed channel locations are invalidated (consumers
+    re-run producers) and the drain lifecycle never engages."""
+    monkeypatch.setenv("SAIL_CLUSTER__AUTOSCALER__HARD_REAP", "1")
+    events.EVENT_LOG.clear()
+    payload = {0: b"\x33" * 1024}
+    cluster = cl.LocalCluster(
+        num_workers=2, task_slots=1,
+        elastic={"min": 1, "max": 2, "idle_secs": 300})
+    try:
+        d = cluster.driver
+        wa, _wb, job, _ = _seed_drain_fixture(cluster, payload)
+
+        def stop_it(drv):
+            drv._hard_stop(wa.worker_id)
+            return (wa.worker_id in drv.workers,
+                    wa.worker_id in drv.draining,
+                    dict(job.locations[0]),
+                    wa.worker_id in drv._readmit_info)
+
+        still_in, draining, locs, readmit = _on_driver(d, stop_it)
+        assert not still_in, "hard stop must remove the worker"
+        assert not draining, "hard stop must not enter DRAINING"
+        assert locs == {}, "sealed channel locations must invalidate"
+        assert not readmit, "a deliberate stop must not readmit"
+    finally:
+        cluster.stop()
+    evicts = [e for e in events.events()
+              if e["type"] == "worker_evict"
+              and e["worker"] == wa.worker_id]
+    assert evicts and evicts[-1]["reason"] == "hard_reap"
+    assert not [e for e in events.events()
+                if e["type"] == "worker_drain"], \
+        "hard_reap must bypass the drain lifecycle"
+
+
+def test_launch_task_parks_on_vanished_input_instead_of_failing():
+    """Recovery-race guard: a retry whose SHUFFLE input lost a sealed
+    location (hard stop, crash after dispatch) parks in job.pending
+    until the producer re-run reseals it — it must never fail the job
+    with "incomplete at launch"."""
+    from types import SimpleNamespace
+
+    cluster = cl.LocalCluster(num_workers=2, task_slots=1)
+    try:
+        s0 = _DrainStage(0, 2, shuffle_keys=(0,), num_channels=2)
+        s0.inputs = []
+        s1 = _DrainStage(1, 2)
+        s1.inputs = [SimpleNamespace(
+            stage_id=0, mode=cl.jg.InputMode.SHUFFLE, fetch_plan=None)]
+        graph = _DrainGraph([s0, s1])
+        job = cl._Job("parkjob", graph)
+
+        def drive(drv):
+            drv.jobs[job.job_id] = job
+            job.locations[0][0] = "addr0"  # partition 1's output is gone
+            job.live[(0, 1)] = {0: "w"}    # ...but a re-run is in flight
+            ok = drv._launch_task(job, 1, 0, 1, reason="failure")
+            return ok, job.failed, set(job.pending), job.done.is_set()
+
+        ok, failed, pending, done = _on_driver(cluster.driver, drive)
+        assert ok is False
+        assert failed is None and not done, \
+            "vanished input must park, not fail the job"
+        assert (1, 0) in pending
+    finally:
+        cluster.stop()
