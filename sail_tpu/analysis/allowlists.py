@@ -35,6 +35,18 @@ SYNC_POINTS = {
     ("sail_tpu/exec/local.py", "LocalExecutor._try_external_sort"),
     # cross-join capacity sizing needs both side counts
     ("sail_tpu/exec/local.py", "LocalExecutor._cross_join"),
+    # mesh program epilogue: ONE batched fetch of the retry/fatal
+    # overflow flags decides recompile-vs-fail before results ship
+    ("sail_tpu/parallel/mesh_exec.py", "MeshExecutor._run_program"),
+    # leaf ingest re-partitions on the host: one batched fetch of
+    # sel + every column, then shards upload per mesh partition
+    ("sail_tpu/parallel/mesh_exec.py", "MeshExecutor._prepare_leaf"),
+    # output assembly: one batched fetch, arrow built from host
+    # buffers with no device re-upload
+    ("sail_tpu/parallel/mesh_exec.py", "MeshExecutor._assemble"),
+    # arrow egress materializes by contract; one batched fetch of
+    # sel + data + validity (per-column loops would be O(cols) RTTs)
+    ("sail_tpu/columnar/arrow_interop.py", "to_arrow"),
 }
 
 # ---------------------------------------------------------------------------
@@ -79,3 +91,58 @@ CONFIG_SKIP_KEYS = {"mode"}
 # ---------------------------------------------------------------------------
 
 METRIC_DYNAMIC_ATTRS: set = set()
+
+# ---------------------------------------------------------------------------
+# guarded-fields lint (analysis/concurrency.py): reviewed lock-free
+# accesses to an inferred lock-guarded attribute. Each entry is
+# (relpath, "Class.attr", "Class.method…") with the reason above it.
+# Prefer a `# guarded-by: <lock>` annotation when the contract is
+# "every caller holds the lock"; use an entry here only for deliberate
+# racy reads (monitoring snapshots, shutdown fast paths).
+# ---------------------------------------------------------------------------
+
+GUARDED_FIELDS: set = {
+    # deliberate racy queue-depth snapshots feeding telemetry only
+    # (enqueue metric / shed event): the admission decision itself was
+    # already taken under the lock, and a stale depth label is
+    # preferable to re-taking the gate lock on every metric emit
+    ("sail_tpu/exec/admission.py", "SessionAdmission._waiters",
+     "SessionAdmission.acquire"),
+}
+
+# ---------------------------------------------------------------------------
+# actor-confinement lint: reviewed cross-thread mutations of
+# actor-confined state, (relpath, "Class.attr", "Class.method…").
+# The bar is high: the default fix is routing through
+# ``self.handle.send`` so the mutation happens on the mailbox thread.
+# ---------------------------------------------------------------------------
+
+ACTOR_CROSS_THREAD: set = set()
+
+# ---------------------------------------------------------------------------
+# decision-purity lint: reviewed impurities in the pure decision
+# functions, keyed (relpath, decision function, category) where
+# category ∈ {clock, random, id, config, set-iteration}. Every entry
+# MUST carry a one-line reason: why replay still converges.
+# ---------------------------------------------------------------------------
+
+DECISION_PURITY: dict = {
+    # the four AQE rewrite decisions read their thresholds
+    # (adaptive.broadcast.*, adaptive.coalesce.*, adaptive.skew.*,
+    # adaptive.reorder.enabled) through the session conf, which is
+    # immutable for a query's lifetime; each rewrite event records the
+    # observed byte sizes that drove it, so replay under the same
+    # session conf reproduces the decision bit-identically
+    ("sail_tpu/exec/adaptive.py", "plan_graph", "config"):
+        "session-conf thresholds are frozen per query; observed sizes "
+        "ride the rewrite event",
+    ("sail_tpu/exec/adaptive.py", "_maybe_broadcast", "config"):
+        "session-conf thresholds are frozen per query; observed sizes "
+        "ride the rewrite event",
+    ("sail_tpu/exec/adaptive.py", "_maybe_coalesce_split", "config"):
+        "session-conf thresholds are frozen per query; observed sizes "
+        "ride the rewrite event",
+    ("sail_tpu/exec/adaptive.py", "_maybe_reorder", "config"):
+        "session-conf thresholds are frozen per query; observed sizes "
+        "ride the rewrite event",
+}

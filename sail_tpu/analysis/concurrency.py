@@ -1,0 +1,945 @@
+"""Concurrency & determinism analysis passes.
+
+Four repo-wide AST passes over the cluster runtime, registered as drift
+lints (``analysis/lints.py`` → ``scripts/sail_lint.py`` /
+``tests/test_lints.py``):
+
+``guarded-fields``
+    Per-class lock-guarded attribute inference. A class attribute is
+    *guarded* by ``self.<lock>`` when some structural mutation of it
+    (outside ``__init__``) happens under ``with self.<lock>``, or when
+    its ``__init__`` assignment carries a ``# guarded-by: <lock>``
+    annotation. Every other touch — content reads AND writes — must
+    then also hold the lock; only ``len()`` reads and ``__init__``
+    construction are exempt. A helper method whose *callers* hold the
+    lock declares the contract with ``# guarded-by: <lock>`` on its
+    ``def`` line (the annotation is the review surface: it asserts
+    every caller acquires the lock first). Deliberate lock-free
+    accesses (racy monitoring reads) live in
+    ``allowlists.GUARDED_FIELDS`` with a written reason.
+
+``lock-order``
+    The acquires-while-holding graph over every ``threading.Lock`` /
+    ``RLock`` / ``Condition`` site under ``sail_tpu/``: an edge A→B
+    means some code path acquires B while holding A (directly nested
+    ``with`` blocks, plus one call level into same-module functions).
+    Any cycle is a potential deadlock and fails the lint. The graph
+    renders as a reviewable artifact via ``sail_lint --graph``.
+
+``actor-confinement``
+    Call-graph-aware generalization of the nested-def heuristic: state
+    named in :data:`ACTOR_CONFINEMENT` may only be mutated from methods
+    reachable from the actor thread's entry points (``__init__`` /
+    ``on_start`` / ``receive`` / ``on_stop`` — the mailbox loop in
+    ``exec/actor.py``). A mutation inside a nested def or lambda runs
+    on whatever thread calls the closure (gRPC handlers, pool threads)
+    and is flagged; so is a mutation in a method no entry point can
+    reach. Known cross-thread paths are reviewed into
+    ``allowlists.ACTOR_CROSS_THREAD``.
+
+``decision-purity``
+    Taint pass over the pure decision functions (autoscaler evaluate,
+    AQE rewrite decisions, admission DRR arbitration, anomaly verdicts,
+    ``router.decide_*``): the replay contract says each is closed over
+    its recorded-signal parameters, so the pass walks the function and
+    its same-module callees and flags wall-clock reads, ``random``,
+    ``id()``, unordered-``set`` iteration, config/environment re-reads.
+    The ONE sanctioned impurity shape is the injected-signal default
+    ``now = time.time() if now is None else now`` (equivalently
+    ``if conf is None: conf = _conf()``): the live path fills an
+    omitted signal, the replay path passes the recorded value, and the
+    filled value rides the decision record. Reviewed exceptions live in
+    ``allowlists.DECISION_PURITY`` with a one-line reason each.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from . import allowlists
+from .lints import (LintContext, Violation, _MUTATORS, _call_name,
+                    _class_def, _is_self_attr, _parents)
+
+# ---------------------------------------------------------------------------
+# shared: lock discovery + ``# guarded-by:`` annotations
+# ---------------------------------------------------------------------------
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition"}
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+#: files the guarded-field inference enforces (the cluster runtime's
+#: shared mutable state; extend as new multithreaded modules land)
+GUARDED_SCAN_FILES = (
+    "sail_tpu/exec/cluster.py",
+    "sail_tpu/exec/continuous.py",
+    "sail_tpu/exec/shuffle.py",
+    "sail_tpu/exec/admission.py",
+)
+
+
+def guarded_by_annotations(ctx: LintContext, relpath: str) -> Dict[int, str]:
+    """``# guarded-by: <lock>`` annotations by line number."""
+    src = ctx.text(relpath)
+    out: Dict[int, str] = {}
+    if src is None:
+        return out
+    for i, line in enumerate(src.splitlines(), 1):
+        m = _GUARDED_BY_RE.search(line)
+        if m:
+            out[i] = m.group(1)
+    return out
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _LOCK_TYPES
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "threading")
+
+
+def _is_lock_annotation(node: Optional[ast.AST]) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr in _LOCK_TYPES
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "threading")
+
+
+def class_lock_attrs(cls: ast.ClassDef) -> Dict[str, str]:
+    """``self.<attr>`` lock attributes of one class: attr → lock type
+    (``Lock``/``RLock``/``Condition``). Recognizes direct construction
+    (``self._lock = threading.Lock()``), dataclass fields
+    (``_lock: threading.Lock = field(...)``), and constructor
+    parameters annotated ``threading.Condition``/``Lock`` assigned to
+    ``self`` (a lock shared with a peer object)."""
+    locks: Dict[str, str] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and \
+                _is_lock_annotation(stmt.annotation):
+            locks[stmt.target.id] = stmt.annotation.attr  # type: ignore[union-attr]
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params: Dict[str, str] = {}
+        a = stmt.args
+        for arg in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+            if _is_lock_annotation(arg.annotation):
+                params[arg.arg] = arg.annotation.attr  # type: ignore[union-attr]
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                if _is_lock_ctor(node.value):
+                    locks[t.attr] = node.value.func.attr  # type: ignore[union-attr]
+                elif isinstance(node.value, ast.Name) and \
+                        node.value.id in params:
+                    locks[t.attr] = params[node.value.id]
+    return locks
+
+
+def module_lock_names(tree: ast.AST) -> Dict[str, str]:
+    """Module-level ``NAME = threading.Lock()`` globals: name → type."""
+    out: Dict[str, str] = {}
+    for stmt in getattr(tree, "body", ()):
+        if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.value.func.attr  # type: ignore[union-attr]
+    return out
+
+
+def _node_lines(fn: ast.AST) -> Set[int]:
+    return {n.lineno for n in ast.walk(fn) if hasattr(n, "lineno")}
+
+
+def _enclosing_defs(parents: Dict[ast.AST, ast.AST], node: ast.AST,
+                    stop: ast.AST) -> List[ast.AST]:
+    """Innermost-first chain of defs/lambdas containing ``node``, up to
+    (not including) ``stop``."""
+    chain: List[ast.AST] = []
+    cur = parents.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            chain.append(cur)
+        cur = parents.get(cur)
+    return chain
+
+
+def _qualname(cls: ast.ClassDef, chain: List[ast.AST]) -> str:
+    names = [getattr(f, "name", "<lambda>") for f in reversed(chain)]
+    return ".".join([cls.name] + names) if names else cls.name
+
+
+# ---------------------------------------------------------------------------
+# attribute access classification (shared by guarded-fields + confinement)
+# ---------------------------------------------------------------------------
+
+def _self_attr_accesses(cls: ast.ClassDef, attr: str,
+                        parents: Dict[ast.AST, ast.AST]
+                        ) -> List[Tuple[ast.Attribute, bool]]:
+    """Every ``self.<attr>`` touch in the class: (node, is_mutation).
+    Mutations are rebinds (``self.x = …``, ``self.x += …``,
+    ``del self.x``), element writes (``self.x[k] = …``,
+    ``del self.x[k]``), and structural mutator calls
+    (``self.x.pop(…)`` …)."""
+    out: List[Tuple[ast.Attribute, bool]] = []
+    for node in ast.walk(cls):
+        if not _is_self_attr(node, attr):
+            continue
+        mutated = False
+        ctx = getattr(node, "ctx", None)
+        if isinstance(ctx, (ast.Store, ast.Del)):
+            mutated = True
+        parent = parents.get(node)
+        if isinstance(parent, ast.Subscript) and parent.value is node and \
+                isinstance(getattr(parent, "ctx", None),
+                           (ast.Store, ast.Del)):
+            mutated = True
+        if isinstance(parent, ast.Attribute) and \
+                parent.attr in _MUTATORS:
+            grand = parents.get(parent)
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                mutated = True
+        out.append((node, mutated))
+    return out
+
+
+def _in_init(chain: List[ast.AST]) -> bool:
+    return bool(chain) and \
+        getattr(chain[-1], "name", "") == "__init__"
+
+
+def _is_len_read(parents: Dict[ast.AST, ast.AST],
+                 node: ast.AST) -> bool:
+    parent = parents.get(node)
+    return (isinstance(parent, ast.Call)
+            and isinstance(parent.func, ast.Name)
+            and parent.func.id == "len"
+            and parent.args and parent.args[0] is node)
+
+
+# ---------------------------------------------------------------------------
+# pass 1: guarded-field inference
+# ---------------------------------------------------------------------------
+
+def class_guarded_fields(ctx: LintContext, relpath: str,
+                         cls: ast.ClassDef) -> Dict[str, Set[str]]:
+    """Inferred guarded attributes of one class: attr → lock attrs it
+    is guarded by (mutation under ``with self.<lock>`` outside
+    ``__init__``, or a ``# guarded-by:`` annotation on its ``__init__``
+    assignment)."""
+    locks = class_lock_attrs(cls)
+    if not locks:
+        return {}
+    annos = guarded_by_annotations(ctx, relpath)
+    coverage = _guard_coverage(cls, set(locks), annos)
+    parents = _parents(cls)
+    guards: Dict[str, Set[str]] = {}
+    # annotation on the __init__ assignment line declares the guard
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        lock = annos.get(node.lineno)
+        if lock is None or lock not in locks:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                guards.setdefault(t.attr, set()).add(lock)
+    # inference: a structural mutation under the lock, outside __init__
+    seen_attrs = {node.attr for node in ast.walk(cls)
+                  if isinstance(node, ast.Attribute)
+                  and isinstance(node.value, ast.Name)
+                  and node.value.id == "self"
+                  and node.attr not in locks}
+    for attr in sorted(seen_attrs):
+        for node, mutated in _self_attr_accesses(cls, attr, parents):
+            if not mutated:
+                continue
+            chain = _enclosing_defs(parents, node, cls)
+            if _in_init(chain):
+                continue
+            for lock in locks:
+                if node.lineno in coverage[lock]:
+                    guards.setdefault(attr, set()).add(lock)
+    return guards
+
+
+def _guard_coverage(cls: ast.ClassDef, lock_attrs: Set[str],
+                    annos: Dict[int, str]) -> Dict[str, Set[int]]:
+    """Line numbers covered per lock: ``with self.<lock>`` blocks plus
+    whole methods annotated ``# guarded-by: <lock>`` on their ``def``
+    line (the caller-holds-the-lock contract)."""
+    cov: Dict[str, Set[int]] = {lock: set() for lock in lock_attrs}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                for lock in lock_attrs:
+                    if _is_self_attr(item.context_expr, lock):
+                        cov[lock].update(_node_lines(node))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lock = annos.get(node.lineno, annos.get(node.lineno - 1))
+            if lock in lock_attrs:
+                cov[lock].update(_node_lines(node))
+    return cov
+
+
+def guarded_field_violations(ctx: LintContext,
+                             files: Iterable[str],
+                             lint_id: str) -> List[Violation]:
+    out: List[Violation] = []
+    for relpath in files:
+        tree = ctx.tree(relpath)
+        if tree is None:
+            out.append(Violation(lint_id, relpath, 0, "cannot parse"))
+            continue
+        annos = guarded_by_annotations(ctx, relpath)
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = class_lock_attrs(cls)
+            if not locks:
+                continue
+            guards = class_guarded_fields(ctx, relpath, cls)
+            if not guards:
+                continue
+            coverage = _guard_coverage(cls, set(locks), annos)
+            parents = _parents(cls)
+            for attr in sorted(guards):
+                attr_locks = sorted(guards[attr])
+                for node, mutated in _self_attr_accesses(
+                        cls, attr, parents):
+                    if any(node.lineno in coverage[lock]
+                           for lock in attr_locks):
+                        continue
+                    chain = _enclosing_defs(parents, node, cls)
+                    if _in_init(chain):
+                        continue
+                    if not mutated and _is_len_read(parents, node):
+                        continue
+                    qual = _qualname(cls, chain)
+                    if (relpath, f"{cls.name}.{attr}", qual) in \
+                            allowlists.GUARDED_FIELDS:
+                        continue
+                    locks_desc = " / ".join(
+                        f"`with self.{lock}`" for lock in attr_locks)
+                    out.append(Violation(
+                        lint_id, relpath, node.lineno,
+                        f"self.{attr} {'mutated' if mutated else 'read'}"
+                        f" in {qual} outside {locks_desc} (structural "
+                        f"mutations AND content reads must hold the "
+                        f"guard; only len() is exempt — annotate the "
+                        f"method `# guarded-by: {attr_locks[0]}` if "
+                        f"every caller holds it, or allowlist the "
+                        f"reviewed racy access in "
+                        f"allowlists.GUARDED_FIELDS)"))
+    return out
+
+
+def lint_guarded_fields(ctx: LintContext) -> List[Violation]:
+    """Inferred lock-guarded attributes are only touched under their
+    guard across the cluster runtime (exec/cluster.py, continuous.py,
+    shuffle.py, admission.py)."""
+    return guarded_field_violations(ctx, GUARDED_SCAN_FILES,
+                                    "guarded-fields")
+
+
+# ---------------------------------------------------------------------------
+# pass 2: lock-order graph (acquires-while-holding), cycles fail
+# ---------------------------------------------------------------------------
+
+class _LockAcq:
+    """Per-def lock-acquisition analysis: direct acquisitions, ordered
+    edges between nested ``with`` blocks, and calls made while holding
+    a lock (for one level of same-module propagation)."""
+
+    def __init__(self, lock_ids: Dict[str, str], relpath: str,
+                 cls_name: Optional[str]):
+        self.lock_ids = lock_ids       # syntactic name -> lock id
+        self.relpath = relpath
+        self.cls_name = cls_name
+        self.acquired: Set[str] = set()
+        self.edges: List[Tuple[str, str, int]] = []
+        self.calls_held: List[Tuple[str, str, int]] = []  # lock, callee, line
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.lock_ids.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.cls_name:
+            return self.lock_ids.get(f"self.{expr.attr}")
+        return None
+
+    def _callee(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and self.cls_name:
+            return f"{self.cls_name}.{f.attr}"
+        return None
+
+    def visit_body(self, stmts: Iterable[ast.AST],
+                   held: List[str]) -> None:
+        for stmt in stmts:
+            self._visit(stmt, held)
+
+    def _visit(self, node: ast.AST, held: List[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested defs run later, on their caller's schedule
+        if isinstance(node, ast.With):
+            got: List[str] = []
+            for item in node.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is None:
+                    continue
+                self.acquired.add(lock)
+                for h in held + got:
+                    if h != lock:
+                        self.edges.append((h, lock, node.lineno))
+                got.append(lock)
+            self.visit_body(node.body, held + got)
+            return
+        if isinstance(node, ast.Call):
+            callee = self._callee(node)
+            if callee is not None and held:
+                for h in held:
+                    self.calls_held.append((h, callee, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+
+def lock_order_graph(ctx: LintContext
+                     ) -> Tuple[Dict[Tuple[str, str], List[Tuple[str, int]]],
+                                Set[str]]:
+    """(edges, nodes): edges map (held, acquired) → example sites
+    (relpath, line); nodes are every discovered lock identity. Lock
+    identities are ``relpath::Class.attr`` for instance locks and
+    ``relpath::NAME`` for module globals — a static approximation (two
+    instances of one class share an identity, a Condition handed to a
+    peer object gets a second one), good enough to order the repo's
+    lock hierarchy and catch inversions."""
+    edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    nodes: Set[str] = set()
+    for relpath in ctx.python_sources():
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        mod_locks = module_lock_names(tree)
+        lock_ids = {name: f"{relpath}::{name}" for name in mod_locks}
+        nodes.update(lock_ids.values())
+        defs: List[Tuple[Optional[str], ast.AST, Dict[str, str]]] = []
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.append((None, stmt, lock_ids))
+            elif isinstance(stmt, ast.ClassDef):
+                cls_ids = dict(lock_ids)
+                for attr in class_lock_attrs(stmt):
+                    cls_ids[f"self.{attr}"] = \
+                        f"{relpath}::{stmt.name}.{attr}"
+                nodes.update(cls_ids.values())
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        defs.append((stmt.name, sub, cls_ids))
+        direct: Dict[str, Set[str]] = {}
+        pending: List[Tuple[str, str, int]] = []
+        for cls_name, fn, ids in defs:
+            acq = _LockAcq(ids, relpath, cls_name)
+            acq.visit_body(fn.body, [])
+            qual = f"{cls_name}.{fn.name}" if cls_name else fn.name
+            direct.setdefault(qual, set()).update(acq.acquired)
+            for a, b, line in acq.edges:
+                edges.setdefault((a, b), []).append((relpath, line))
+            pending.extend(acq.calls_held)
+        # one call level: a call made while holding L reaches a
+        # same-module function that directly acquires M ⇒ edge L→M
+        for held, callee, line in pending:
+            for target in direct.get(callee, ()):
+                if target != held:
+                    edges.setdefault((held, target), []).append(
+                        (relpath, line))
+    for a, b in edges:
+        nodes.add(a)
+        nodes.add(b)
+    return edges, nodes
+
+
+def _find_cycles(edges: Iterable[Tuple[str, str]]) -> List[List[str]]:
+    """Elementary cycles (incl. self-loops) via DFS over the edge set;
+    each cycle reported once, smallest-first node rotation."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            seen: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                i = path.index(min(path))
+                cycles.add(tuple(path[i:] + path[:i]))
+            elif nxt not in seen and len(path) < 12:
+                dfs(start, nxt, path + [nxt], seen | {nxt})
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return [list(c) for c in sorted(cycles)]
+
+
+def render_lock_graph(ctx: LintContext) -> str:
+    """The ``sail_lint --graph`` artifact: every lock node, every
+    acquires-while-holding edge with an example site, and any cycles."""
+    edges, nodes = lock_order_graph(ctx)
+    lines = ["# lock-order graph: `A -> B` means B is acquired while",
+             "# holding A (nested `with`, or a same-module call made",
+             "# under A into a function that acquires B)", ""]
+    lines.append(f"locks ({len(nodes)}):")
+    for n in sorted(nodes):
+        lines.append(f"  {n}")
+    lines.append("")
+    lines.append(f"edges ({len(edges)}):")
+    for (a, b), sites in sorted(edges.items()):
+        path, line = sites[0]
+        lines.append(f"  {a} -> {b}   [{path}:{line}]")
+    if not edges:
+        lines.append("  (none — no code path holds two locks at once)")
+    cycles = _find_cycles(edges)
+    lines.append("")
+    if cycles:
+        lines.append(f"CYCLES ({len(cycles)}):")
+        for cyc in cycles:
+            lines.append("  " + " -> ".join(cyc + [cyc[0]]))
+    else:
+        lines.append("cycles: none")
+    return "\n".join(lines)
+
+
+def lint_lock_order(ctx: LintContext) -> List[Violation]:
+    """The acquires-while-holding graph over every threading.Lock /
+    RLock / Condition under sail_tpu/ is acyclic (a cycle is a
+    potential deadlock; `sail_lint --graph` renders the ordering)."""
+    edges, _nodes = lock_order_graph(ctx)
+    out: List[Violation] = []
+    for cyc in _find_cycles(edges):
+        nxt = dict(zip(cyc, cyc[1:] + cyc[:1]))
+        sites = []
+        for a in cyc:
+            for (x, y), where in edges.items():
+                if x == a and y == nxt[a]:
+                    sites.append(where[0])
+                    break
+        path, line = sites[0] if sites else ("", 0)
+        out.append(Violation(
+            "lock-order", path, line,
+            "lock-order cycle (potential deadlock): "
+            + " -> ".join(cyc + [cyc[0]])
+            + " — acquire these locks in one global order "
+            "(see scripts/sail_lint.py --graph)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 3: actor confinement (call-graph-aware)
+# ---------------------------------------------------------------------------
+
+#: (relpath, class) → actor-confined attributes and the actor thread's
+#: entry points. ``__init__`` runs before the actor thread starts (the
+#: handle is not public yet), so construction counts as confined;
+#: ``receive``/``on_start``/``on_stop`` are the mailbox loop
+#: (exec/actor.py Actor._loop). State listed here may only be mutated
+#: from methods reachable from these entries via self-calls — a nested
+#: def or lambda runs on whatever thread invokes it (gRPC handler, pool
+#: thread) and must route mutations through ``self.handle.send``.
+ACTOR_CONFINEMENT: Dict[Tuple[str, str], Dict[str, Set[str]]] = {
+    ("sail_tpu/exec/cluster.py", "DriverActor"): {
+        "entry": {"__init__", "on_start", "receive", "on_stop"},
+        "attrs": {"workers", "jobs", "quarantined", "_readmit_info",
+                  "continuous", "_continuous_drain", "draining",
+                  "_starting", "_starting_ts", "pool_peak"},
+    },
+    ("sail_tpu/exec/cluster.py", "WorkerActor"): {
+        # _running is lock-guarded (pass 1) and _crashed is a
+        # cross-thread crash flag (atomic bool write from the heartbeat
+        # thread); the bindings below must only change on the mailbox
+        "entry": {"__init__", "on_start", "receive", "on_stop"},
+        "attrs": {"_server", "_driver_channel", "port", "streams",
+                  "continuous"},
+    },
+}
+
+
+def _method_call_graph(cls: ast.ClassDef) -> Dict[str, Set[str]]:
+    """method → self-methods it calls directly (calls inside nested
+    defs/lambdas excluded: those run on the closure's caller thread,
+    not necessarily this method's)."""
+    methods = {stmt.name for stmt in cls.body
+               if isinstance(stmt, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))}
+    graph: Dict[str, Set[str]] = {m: set() for m in methods}
+
+    def collect(node: ast.AST, sink: Set[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id == "self" and f.attr in methods:
+                sink.add(f.attr)
+        for child in ast.iter_child_nodes(node):
+            collect(child, sink)
+
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in stmt.body:
+                collect(sub, graph[stmt.name])
+    return graph
+
+
+def _reachable(graph: Dict[str, Set[str]],
+               entries: Set[str]) -> Set[str]:
+    seen = set(e for e in entries if e in graph)
+    work = list(seen)
+    while work:
+        for nxt in graph.get(work.pop(), ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                work.append(nxt)
+    return seen
+
+
+def lint_actor_confinement(ctx: LintContext) -> List[Violation]:
+    """Actor state named in ACTOR_CONFINEMENT is only mutated from
+    methods reachable off the actor thread's entry points (mailbox
+    loop); nested-def/lambda mutations run on foreign threads and must
+    route through self.handle.send."""
+    return actor_confinement_violations(ctx, ACTOR_CONFINEMENT,
+                                        "actor-confinement")
+
+
+def actor_confinement_violations(
+        ctx: LintContext,
+        table: Dict[Tuple[str, str], Dict[str, Set[str]]],
+        lint_id: str) -> List[Violation]:
+    out: List[Violation] = []
+    for (relpath, cls_name), spec in sorted(table.items()):
+        tree = ctx.tree(relpath)
+        if tree is None:
+            out.append(Violation(lint_id, relpath, 0, "cannot parse"))
+            continue
+        cls = _class_def(tree, cls_name)
+        if cls is None:
+            out.append(Violation(lint_id, relpath, 0,
+                                 f"{cls_name} class not found"))
+            continue
+        graph = _method_call_graph(cls)
+        reachable = _reachable(graph, set(spec["entry"]))
+        parents = _parents(cls)
+        for attr in sorted(spec["attrs"]):
+            for node, mutated in _self_attr_accesses(cls, attr, parents):
+                if not mutated:
+                    continue
+                chain = _enclosing_defs(parents, node, cls)
+                if not chain:
+                    continue  # class-body default
+                qual = _qualname(cls, chain)
+                if (relpath, f"{cls_name}.{attr}", qual) in \
+                        allowlists.ACTOR_CROSS_THREAD:
+                    continue
+                if len(chain) > 1 or isinstance(chain[0], ast.Lambda):
+                    why = "inside a lambda" if isinstance(
+                        chain[0], ast.Lambda) else \
+                        "inside a nested function"
+                    out.append(Violation(
+                        lint_id, relpath, node.lineno,
+                        f"self.{attr} mutated {why} ({qual}) — the "
+                        f"closure runs off the actor thread; route the "
+                        f"mutation through self.handle.send (or review "
+                        f"it into allowlists.ACTOR_CROSS_THREAD)"))
+                elif chain[0].name not in reachable:
+                    out.append(Violation(
+                        lint_id, relpath, node.lineno,
+                        f"self.{attr} mutated in {qual}, which is not "
+                        f"reachable from the actor entry points "
+                        f"{sorted(spec['entry'])} via self-calls — "
+                        f"confined state may only change on the actor "
+                        f"thread (or review the path into "
+                        f"allowlists.ACTOR_CROSS_THREAD)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 4: decision-purity taint
+# ---------------------------------------------------------------------------
+
+#: the pure decision functions: replay derives their output from
+#: recorded signals alone, so their closure (same-module callees
+#: included) must be free of clocks, randomness, identity hashes,
+#: unordered-set iteration, and config/environment re-reads
+DECISION_FUNCTIONS: Tuple[Tuple[str, str], ...] = (
+    ("sail_tpu/exec/autoscaler.py", "evaluate"),
+    ("sail_tpu/exec/adaptive.py", "plan_graph"),
+    ("sail_tpu/exec/adaptive.py", "_maybe_broadcast"),
+    ("sail_tpu/exec/adaptive.py", "_maybe_coalesce_split"),
+    ("sail_tpu/exec/adaptive.py", "_maybe_reorder"),
+    ("sail_tpu/exec/admission.py", "JobAdmissionQueue.drain"),
+    ("sail_tpu/analysis/anomaly.py", "classify"),
+    ("sail_tpu/exec/router.py", "decide_stage"),
+    ("sail_tpu/exec/router.py", "decide_split"),
+    ("sail_tpu/exec/router.py", "decide_plan"),
+)
+
+_CLOCK_ATTRS = {"time", "monotonic", "perf_counter", "process_time",
+                "time_ns", "monotonic_ns", "perf_counter_ns"}
+_CLOCK_MODULES = {"time", "_time"}
+_DATETIME_ATTRS = {"now", "utcnow", "today"}
+_CONFIG_READERS = {"config_get", "truthy", "truthy_value"}
+_RANDOM_BARE = {"random", "randint", "uniform", "choice", "shuffle",
+                "randrange", "sample", "gauss"}
+
+
+def _classify_call(node: ast.Call) -> Optional[Tuple[str, str]]:
+    """(category, description) when the call is an impurity source."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        base = f.value
+        if isinstance(base, ast.Name):
+            if base.id in _CLOCK_MODULES and f.attr in _CLOCK_ATTRS:
+                return "clock", f"{base.id}.{f.attr}()"
+            if base.id in ("datetime", "date") and \
+                    f.attr in _DATETIME_ATTRS:
+                return "clock", f"{base.id}.{f.attr}()"
+            if base.id == "random":
+                return "random", f"random.{f.attr}()"
+            if base.id == "os" and f.attr in ("getenv", "getenvb"):
+                return "config", f"os.{f.attr}()"
+        if isinstance(base, ast.Attribute) and base.attr == "environ" \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "os":
+            return "config", "os.environ access"
+        if isinstance(base, ast.Attribute) and \
+                base.attr in ("datetime", "date") and \
+                f.attr in _DATETIME_ATTRS:
+            return "clock", f"datetime.{f.attr}()"
+    elif isinstance(f, ast.Name):
+        if f.id == "id" and len(node.args) == 1:
+            return "id", "id()"
+        if f.id in _CONFIG_READERS:
+            return "config", f"{f.id}(…) config re-read"
+        if f.id in ("monotonic", "perf_counter", "process_time"):
+            return "clock", f"{f.id}()"
+    return None
+
+
+def _module_functions(tree: ast.AST
+                      ) -> Dict[str, Tuple[Optional[str], ast.AST]]:
+    """qualname → (class name or None, def node) for every module-level
+    function and class method."""
+    out: Dict[str, Tuple[Optional[str], ast.AST]] = {}
+    for stmt in getattr(tree, "body", ()):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[stmt.name] = (None, stmt)
+        elif isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    out[f"{stmt.name}.{sub.name}"] = (stmt.name, sub)
+    return out
+
+
+def _signal_default_exempt(fn: ast.AST) -> Set[ast.AST]:
+    """Call nodes exempt under the injected-signal default idiom:
+    ``if X is None: X = EXPR`` / ``X = EXPR if X is None else X`` for a
+    parameter ``X`` — the live path fills an omitted recorded signal,
+    replay passes the recorded value."""
+    params = set()
+    a = fn.args
+    for arg in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+        params.add(arg.arg)
+
+    def _is_none_test(test: ast.AST) -> Optional[str]:
+        if isinstance(test, ast.Compare) and \
+                isinstance(test.left, ast.Name) and \
+                test.left.id in params and \
+                len(test.ops) == 1 and len(test.comparators) == 1 and \
+                isinstance(test.comparators[0], ast.Constant) and \
+                test.comparators[0].value is None:
+            if isinstance(test.ops[0], ast.Is):
+                return test.left.id
+            if isinstance(test.ops[0], ast.IsNot):
+                return f"!{test.left.id}"
+        return None
+
+    exempt: Set[ast.AST] = set()
+
+    def mark(expr: ast.AST) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                exempt.add(sub)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.If):
+            name = _is_none_test(node.test)
+            if name and not name.startswith("!") and \
+                    len(node.body) == 1 and \
+                    isinstance(node.body[0], ast.Assign) and \
+                    len(node.body[0].targets) == 1 and \
+                    isinstance(node.body[0].targets[0], ast.Name) and \
+                    node.body[0].targets[0].id == name:
+                mark(node.body[0].value)
+        elif isinstance(node, ast.IfExp):
+            name = _is_none_test(node.test)
+            if name is None:
+                continue
+            if name.startswith("!"):
+                name = name[1:]
+                filler = node.orelse  # X if X is not None else EXPR
+                kept = node.body
+            else:
+                filler = node.body    # EXPR if X is None else X
+                kept = node.orelse
+            if isinstance(kept, ast.Name) and kept.id == name:
+                mark(filler)
+    return exempt
+
+
+def _set_iteration_sites(fn: ast.AST) -> List[Tuple[int, str]]:
+    """``for`` loops iterating a value that is syntactically a set
+    (literal, comprehension, or ``set(...)`` built in this function)
+    without a ``sorted()`` wrap — iteration order then depends on hash
+    seeding and insertion history, which replay does not record."""
+    set_names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            v = node.value
+            if isinstance(v, (ast.Set, ast.SetComp)) or (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id in ("set", "frozenset")):
+                set_names.add(node.targets[0].id)
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.For):
+            continue
+        it = node.iter
+        if isinstance(it, (ast.Set, ast.SetComp)):
+            out.append((node.lineno, "a set literal"))
+        elif isinstance(it, ast.Call) and \
+                isinstance(it.func, ast.Name) and \
+                it.func.id in ("set", "frozenset"):
+            out.append((node.lineno, "set(...)"))
+        elif isinstance(it, ast.Name) and it.id in set_names:
+            out.append((node.lineno, f"set {it.id!r}"))
+    return out
+
+
+def decision_purity_violations(
+        ctx: LintContext,
+        targets: Iterable[Tuple[str, str]] = DECISION_FUNCTIONS,
+        lint_id: str = "decision-purity") -> List[Violation]:
+    out: List[Violation] = []
+    targets = list(targets)
+    target_set = set(targets)
+    for relpath, root_qual in targets:
+        tree = ctx.tree(relpath)
+        if tree is None:
+            out.append(Violation(lint_id, relpath, 0, "cannot parse"))
+            continue
+        index = _module_functions(tree)
+        if root_qual not in index:
+            out.append(Violation(
+                lint_id, relpath, 0,
+                f"decision function {root_qual} not found (update "
+                f"concurrency.DECISION_FUNCTIONS)"))
+            continue
+        seen: Set[str] = set()
+        queue: List[Tuple[str, Tuple[str, ...]]] = [(root_qual, ())]
+        findings: Dict[Tuple[str, int, str], str] = {}
+        while queue:
+            qual, chain = queue.pop(0)
+            if qual in seen:
+                continue
+            seen.add(qual)
+            cls_name, fn = index[qual]
+            exempt = _signal_default_exempt(fn)
+            via = "".join(f" (via {c})" for c in chain[:1])
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    if node in exempt:
+                        continue
+                    got = _classify_call(node)
+                    if got is not None:
+                        cat, desc = got
+                        findings.setdefault(
+                            (qual, node.lineno, cat),
+                            f"{desc} in {qual}{via}")
+                    # same-module traversal (skip other targets:
+                    # they are audited independently)
+                    callee = None
+                    f = node.func
+                    if isinstance(f, ast.Name) and f.id in index:
+                        callee = f.id
+                    elif isinstance(f, ast.Attribute) and \
+                            isinstance(f.value, ast.Name) and \
+                            f.value.id == "self" and cls_name and \
+                            f"{cls_name}.{f.attr}" in index:
+                        callee = f"{cls_name}.{f.attr}"
+                    if callee is not None and \
+                            (relpath, callee) not in target_set:
+                        queue.append((callee, chain + (qual,)))
+            for line, desc in _set_iteration_sites(fn):
+                findings.setdefault(
+                    (qual, line, "set-iteration"),
+                    f"iteration over {desc} in {qual}{via}")
+        for (qual, line, cat), desc in sorted(findings.items()):
+            key = (relpath, root_qual, cat)
+            if key in allowlists.DECISION_PURITY:
+                continue
+            out.append(Violation(
+                lint_id, relpath, line,
+                f"decision function {root_qual} is not closed over its "
+                f"recorded signals: {desc} [{cat}] — route the value "
+                f"in as a signal argument (the `x = read() if x is "
+                f"None else x` default-fill is the sanctioned shape) "
+                f"or allowlist with a reason in "
+                f"allowlists.DECISION_PURITY"))
+    return out
+
+
+def lint_decision_purity(ctx: LintContext) -> List[Violation]:
+    """The pure decision functions (autoscaler evaluate, AQE rewrites,
+    admission DRR, anomaly verdicts, router.decide_*) are closed over
+    their recorded-signal parameters: no clocks, random, id(),
+    unordered-set iteration, or config re-reads in their same-module
+    closure."""
+    return decision_purity_violations(ctx)
+
+
+# ---------------------------------------------------------------------------
+# compat: the historical ``locks`` lint, now a cluster.py slice of the
+# generalized passes (the hardcoded _running/registry checks it used to
+# hand-roll are exactly what passes 1 and 3 infer)
+# ---------------------------------------------------------------------------
+
+def cluster_locks_compat(ctx: LintContext) -> List[Violation]:
+    out = guarded_field_violations(
+        ctx, ("sail_tpu/exec/cluster.py",), "locks")
+    table = {key: spec for key, spec in ACTOR_CONFINEMENT.items()
+             if key[0] == "sail_tpu/exec/cluster.py"}
+    out.extend(actor_confinement_violations(ctx, table, "locks"))
+    return out

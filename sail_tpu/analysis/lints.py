@@ -18,11 +18,26 @@ lint id             checks
 ``proto``           every message/field name in ``*.proto`` exists in
                     the checked-in regenerated ``*_pb2.py``
 ``sync-points``     ``device_get``/``block_until_ready`` call sites in
-                    ``exec/``/``ops/`` are on the reviewed allowlist
-``locks``           ``exec/cluster.py`` registry discipline: WorkerActor
-                    ``_running`` only touched under ``_running_lock``;
-                    DriverActor worker registries only mutated on the
-                    actor thread (no nested-def/gRPC-handler mutation)
+                    ``exec/``/``ops/``/``plan/``/``native/``/
+                    ``parallel/``/``columnar/`` are on the reviewed
+                    allowlist
+``locks``           ``exec/cluster.py`` slice of the concurrency passes
+                    (guarded-field inference + actor confinement) — the
+                    historical hardcoded ``_running`` check, generalized
+``guarded-fields``  per-class lock-guarded attribute inference across
+                    the cluster runtime: any touch outside ``with
+                    self.<lock>`` (or a ``# guarded-by:`` contract)
+                    fails (analysis/concurrency.py)
+``lock-order``      the acquires-while-holding graph over every
+                    ``threading.Lock/RLock/Condition`` site is acyclic;
+                    ``sail_lint --graph`` renders the ordering
+``actor-confinement``  DriverActor/WorkerActor state in the confinement
+                    table only mutates from methods reachable off the
+                    mailbox entry points (call-graph aware)
+``decision-purity`` the pure decision functions (autoscaler, AQE,
+                    admission DRR, anomaly, router.decide_*) are closed
+                    over recorded signals: no clocks/random/id()/
+                    unordered-set iteration/config re-reads
 ``metrics``         every recorded metric is declared with the recorded
                     attribute keys, every declaration is exercised
 ==================  ======================================================
@@ -211,15 +226,6 @@ def _config_literal_evidence(ctx: LintContext) -> Set[str]:
 
 
 def lint_config_keys(ctx: LintContext) -> List[Violation]:
-    capcalls = [(relpath, qual) for relpath, qual, _l
-                in capacity_calls(ctx)
-                if (relpath, qual) not in allowlists.CAPACITY_POLICY]
-    if capcalls:
-        lines.append("# add to CAPACITY_POLICY in "
-                     "sail_tpu/analysis/allowlists.py (or route the "
-                     "call through bucket_capacity):")
-        for relpath, qual in sorted(set(capcalls)):
-            lines.append(f'    ("{relpath}", "{qual}"),')
     declared = declared_config_keys(ctx)
     if not declared:
         return [Violation("config-keys",
@@ -485,9 +491,12 @@ class _QualnameVisitor(ast.NodeVisitor):
 def sync_points(ctx: LintContext) -> List[Tuple[str, str, str, int]]:
     """(relpath, qualname, attr, line) of every sync-forcing call in
     exec/, ops/, plan/ (the stage splitter/compiler must introduce no
-    unreviewed host syncs) and native/ (host-kernel argument prep)."""
+    unreviewed host syncs), native/ (host-kernel argument prep),
+    parallel/ (mesh collect/metrics paths), and columnar/ (Arrow
+    interop materialization)."""
     out = []
-    for relpath in ctx.python_sources("exec", "ops", "plan", "native"):
+    for relpath in ctx.python_sources("exec", "ops", "plan", "native",
+                                      "parallel", "columnar"):
         tree = ctx.tree(relpath)
         if tree is None:
             continue
@@ -581,27 +590,12 @@ def lint_capacity_policy(ctx: LintContext) -> List[Violation]:
 
 _MUTATORS = {"setdefault", "pop", "clear", "update", "append",
              "extend", "remove", "add", "discard"}
-_GUARDED_READS = {"get", "items", "values", "keys"}
 
 
 def _is_self_attr(node: ast.AST, name: str) -> bool:
     return (isinstance(node, ast.Attribute) and node.attr == name
             and isinstance(node.value, ast.Name)
             and node.value.id == "self")
-
-
-def _with_guards(body_node: ast.AST, lock_attr: str) -> Set[int]:
-    """Line numbers covered by ``with self.<lock_attr>`` blocks."""
-    covered: Set[int] = set()
-    for node in ast.walk(body_node):
-        if not isinstance(node, ast.With):
-            continue
-        if any(_is_self_attr(item.context_expr, lock_attr)
-               for item in node.items):
-            for sub in ast.walk(node):
-                if hasattr(sub, "lineno"):
-                    covered.add(sub.lineno)
-    return covered
 
 
 def _class_def(tree: ast.AST, name: str) -> Optional[ast.ClassDef]:
@@ -612,50 +606,12 @@ def _class_def(tree: ast.AST, name: str) -> Optional[ast.ClassDef]:
 
 
 def lint_locks(ctx: LintContext) -> List[Violation]:
-    relpath = "sail_tpu/exec/cluster.py"
-    tree = ctx.tree(relpath)
-    if tree is None:
-        return [Violation("locks", relpath, 0, "cannot parse")]
-    out: List[Violation] = []
-
-    # -- WorkerActor._running: every touch under _running_lock ----------
-    worker = _class_def(tree, "WorkerActor")
-    if worker is None:
-        out.append(Violation("locks", relpath, 0,
-                             "WorkerActor class not found"))
-    else:
-        covered = _with_guards(worker, "_running_lock")
-        for node in ast.walk(worker):
-            if not _is_self_attr(node, "_running"):
-                continue
-            line = node.lineno
-            if line in covered:
-                continue
-            if _inside_init_assign(worker, node):
-                continue
-            if _inside_len_call(worker, node):
-                continue
-            out.append(Violation(
-                "locks", relpath, line,
-                "self._running touched outside `with "
-                "self._running_lock` (structural mutations AND content "
-                "reads must hold the lock; only len() is exempt)"))
-
-    # -- DriverActor registries: mutations on the actor thread only -----
-    driver = _class_def(tree, "DriverActor")
-    if driver is None:
-        out.append(Violation("locks", relpath, 0,
-                             "DriverActor class not found"))
-    else:
-        for reg in ("workers", "quarantined", "_readmit_info"):
-            for line, why in _off_thread_mutations(driver, reg):
-                out.append(Violation(
-                    "locks", relpath, line,
-                    f"self.{reg} mutated {why} — driver registries may "
-                    f"only be mutated from DriverActor methods running "
-                    f"on the actor thread (route through "
-                    f"self.handle.send)"))
-    return out
+    """exec/cluster.py slice of the generalized concurrency passes:
+    guarded-field inference (which subsumes the historical hardcoded
+    WorkerActor._running/_running_lock check) plus call-graph actor
+    confinement for the DriverActor/WorkerActor registries."""
+    from . import concurrency
+    return concurrency.cluster_locks_compat(ctx)
 
 
 def _parents(root: ast.AST) -> Dict[ast.AST, ast.AST]:
@@ -664,78 +620,6 @@ def _parents(root: ast.AST) -> Dict[ast.AST, ast.AST]:
         for child in ast.iter_child_nodes(node):
             parents[child] = node
     return parents
-
-
-def _inside_init_assign(cls: ast.ClassDef, target: ast.AST) -> bool:
-    for node in cls.body:
-        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
-            return any(sub is target for sub in ast.walk(node))
-    return False
-
-
-def _inside_len_call(cls: ast.ClassDef, target: ast.AST) -> bool:
-    for node in ast.walk(cls):
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
-                and node.func.id == "len" and node.args \
-                and node.args[0] is target:
-            return True
-    return False
-
-
-def _off_thread_mutations(cls: ast.ClassDef, reg: str
-                          ) -> List[Tuple[int, str]]:
-    """Mutations of ``self.<reg>`` inside nested defs/lambdas of the
-    class's methods (those closures run on gRPC server threads, not the
-    actor thread) — plus mutations at class scope outside any method."""
-    out: List[Tuple[int, str]] = []
-    parents = _parents(cls)
-
-    def enclosing_defs(node: ast.AST) -> List[ast.AST]:
-        chain = []
-        cur = parents.get(node)
-        while cur is not None and cur is not cls:
-            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                ast.Lambda)):
-                chain.append(cur)
-            cur = parents.get(cur)
-        return chain
-
-    for node in ast.walk(cls):
-        mutated = False
-        target = None
-        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) \
-                else [node.target]
-            for t in targets:
-                if _is_self_attr(t, reg):
-                    mutated, target = True, t
-                elif isinstance(t, (ast.Subscript,)) and \
-                        _is_self_attr(t.value, reg):
-                    mutated, target = True, t
-        elif isinstance(node, ast.Delete):
-            for t in node.targets:
-                if isinstance(t, ast.Subscript) and \
-                        _is_self_attr(t.value, reg):
-                    mutated, target = True, t
-        elif isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                node.func.attr in _MUTATORS and \
-                _is_self_attr(node.func.value, reg):
-            mutated, target = True, node
-        if not mutated:
-            continue
-        chain = enclosing_defs(node)
-        if not chain:
-            continue  # class body (shouldn't happen)
-        # outermost enclosing def must be a direct method of the class;
-        # any nested def/lambda between the mutation and the method runs
-        # off the actor thread
-        if len(chain) > 1:
-            out.append((node.lineno, "inside a nested function"))
-        elif not isinstance(chain[0], (ast.FunctionDef,
-                                       ast.AsyncFunctionDef)):
-            out.append((node.lineno, "inside a lambda"))
-    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1195,6 +1079,35 @@ def lint_slo_taxonomy(ctx: LintContext) -> List[Violation]:
 # registry + runner
 # ---------------------------------------------------------------------------
 
+def lint_guarded_fields(ctx: LintContext) -> List[Violation]:
+    """Inferred lock-guarded attributes only touched under their guard
+    (exec/cluster.py, continuous.py, shuffle.py, admission.py)."""
+    from . import concurrency
+    return concurrency.lint_guarded_fields(ctx)
+
+
+def lint_lock_order(ctx: LintContext) -> List[Violation]:
+    """Acquires-while-holding graph over every threading lock under
+    sail_tpu/ is acyclic (`sail_lint --graph` renders it)."""
+    from . import concurrency
+    return concurrency.lint_lock_order(ctx)
+
+
+def lint_actor_confinement(ctx: LintContext) -> List[Violation]:
+    """Actor-confined state (concurrency.ACTOR_CONFINEMENT) is only
+    mutated from methods reachable off the mailbox entry points."""
+    from . import concurrency
+    return concurrency.lint_actor_confinement(ctx)
+
+
+def lint_decision_purity(ctx: LintContext) -> List[Violation]:
+    """Pure decision functions are closed over recorded signals: no
+    clocks/random/id()/set-iteration/config re-reads in their
+    same-module closure."""
+    from . import concurrency
+    return concurrency.lint_decision_purity(ctx)
+
+
 LINTS: Dict[str, Callable[[LintContext], List[Violation]]] = {
     "config-keys": lint_config_keys,
     "spark-keys": lint_spark_keys,
@@ -1203,6 +1116,10 @@ LINTS: Dict[str, Callable[[LintContext], List[Violation]]] = {
     "sync-points": lint_sync_points,
     "capacity-policy": lint_capacity_policy,
     "locks": lint_locks,
+    "guarded-fields": lint_guarded_fields,
+    "lock-order": lint_lock_order,
+    "actor-confinement": lint_actor_confinement,
+    "decision-purity": lint_decision_purity,
     "metrics": lint_metrics,
     "events": lint_events,
     "slo-taxonomy": lint_slo_taxonomy,

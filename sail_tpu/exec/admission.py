@@ -340,7 +340,7 @@ class JobAdmissionQueue:
             self._queues[tenant] = keep
         return shed
 
-    def drain(self) -> List:
+    def drain(self, now: Optional[float] = None) -> List:
         """Deficit-round-robin pop of every currently admissible queued
         job, in decision order. Each admission opportunity (a free
         launch slot) credits every backlogged admissible tenant its
@@ -349,7 +349,12 @@ class JobAdmissionQueue:
         opportunities — so over a backlog, tenants receive launch
         opportunities proportional to their weights regardless of job
         sizes. The caller schedules each returned job (the admit event
-        fires here, so the log IS the decision order)."""
+        fires here, so the log IS the decision order).
+
+        ``now`` is an injected signal (recorded in the admit event's
+        ``waited_ms``): replay passes the recorded clock, the live path
+        defaults — the arbitration itself never reads the wall clock."""
+        now = time.time() if now is None else now
         admitted: List = []
         if not self.enabled:
             for tenant in sorted(self._queues):
@@ -383,16 +388,16 @@ class JobAdmissionQueue:
             if not q:
                 self._deficit[winner] = min(
                     self._deficit[winner], 0.0)
-            self._admit(job)
+            self._admit(job, now)
             admitted.append(job)
         return admitted
 
-    def _admit(self, job) -> None:
+    def _admit(self, job, now: float) -> None:
         tenant = job.tenant
         job.admitted = True
         self._running.setdefault(tenant, set()).add(job.job_id)
         self._total_running += 1
-        waited_ms = round((time.time() - job.queued_ts) * 1000.0, 3)
+        waited_ms = round((now - job.queued_ts) * 1000.0, 3)
         _record_metric("cluster.admission.admitted_count", 1,
                        tenant=tenant)
         _record_metric("cluster.admission.queue_wait_time",
@@ -659,7 +664,7 @@ class SessionAdmission:
         self._seq = itertools.count()
         self._tls = threading.local()
 
-    def _eligible(self, tenant: str) -> bool:
+    def _eligible(self, tenant: str) -> bool:  # guarded-by: _lock
         pol = self.conf.policy(tenant)
         if pol.max_queries and \
                 self._running.get(tenant, 0) >= pol.max_queries:
@@ -810,7 +815,7 @@ class SessionAdmission:
                                  for t, v in self._vt.items()},
             }
 
-    def _admit_locked(self, tenant: str) -> None:
+    def _admit_locked(self, tenant: str) -> None:  # guarded-by: _lock
         self._running[tenant] = self._running.get(tenant, 0) + 1
         self._total += 1
         start = self._vt.get(tenant, 0.0)

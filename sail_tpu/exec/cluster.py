@@ -2047,10 +2047,10 @@ class DriverActor(Actor):
         self._check_deadlines(now)
         self.admission.recharge(now)
         self.admission.poll(now)
-        self._drain_admission()
+        self._drain_admission(now)
 
-    def _drain_admission(self):
-        for job in self.admission.drain():
+    def _drain_admission(self, now: Optional[float] = None):
+        for job in self.admission.drain(now):
             if job.done.is_set():
                 continue
             from ..catalog.system import SYSTEM

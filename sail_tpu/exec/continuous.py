@@ -151,7 +151,7 @@ class CreditInbox:
             self.cond.notify_all()
             return "ok"
 
-    def pop(self) -> Optional[Entry]:
+    def pop(self) -> Optional[Entry]:  # guarded-by: cond
         """Under ``self.cond``: take the next entry, releasing its
         credit."""
         if not self.entries:
@@ -274,7 +274,7 @@ class AlignedInput:
             return sum(i.pending_bytes for i in self.inboxes.values()) \
                 + sum(b.buffered_bytes for b in self._buffers.values())
 
-    def _take_one(self, key: Tuple[int, int]) -> Optional[Entry]:
+    def _take_one(self, key: Tuple[int, int]) -> Optional[Entry]:  # guarded-by: cond
         """Under ``self.cond``: next entry of one input, replay buffer
         first."""
         if self._replay[key]:
@@ -358,7 +358,7 @@ class AlignedInput:
                     return None
                 self.cond.wait(remaining)
 
-    def _note_blocked(self, key, marker: int) -> None:
+    def _note_blocked(self, key, marker: int) -> None:  # guarded-by: cond
         self._blocked[key] = marker
         if self._block_started is None:
             self._block_started = time.time()

@@ -307,7 +307,8 @@ def decide_split(split, force: str = "",
 
 def decide_plan(plan, nparts: int, force: str = "",
                 mode: str = "auto",
-                slo_ctx: Optional[dict] = None) -> Decision:
+                slo_ctx: Optional[dict] = None,
+                floor: Optional[int] = None) -> Decision:
     """The plan-level mesh-vs-local gate (stage ``-1``): the SPMD
     program's fixed dispatch/compile cost is only worth paying above a
     row-volume floor. ``mode`` is the ``execution.mesh`` knob — "force"
@@ -318,7 +319,12 @@ def decide_plan(plan, nparts: int, force: str = "",
     baseline (``analysis/anomaly.py BASELINES`` — the PR 12
     ``query.latency`` histograms) shows a p99 over the tenant's target
     while the error budget burns: sharding the input across devices is
-    the pre-split lever the local substrate does not have."""
+    the pre-split lever the local substrate does not have.
+
+    ``floor`` is the row-volume gate as an injected signal
+    (``execution.backend.mesh_min_rows``): replay passes the recorded
+    value, the live path defaults from config — the decision itself
+    never re-reads configuration."""
     if force == "mesh":
         return Decision(-1, "plan", "mesh", "forced")
     if force in ("xla", "native"):
@@ -327,7 +333,7 @@ def decide_plan(plan, nparts: int, force: str = "",
         return Decision(-1, "plan", "xla", "unavailable")
     if mode == "force":
         return Decision(-1, "plan", "mesh", "forced")
-    floor = mesh_min_rows()
+    floor = mesh_min_rows() if floor is None else floor
     if floor:
         est = _plan_input_rows(plan)
         if est is not None and est < floor:

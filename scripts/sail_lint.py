@@ -9,6 +9,12 @@ Usage:
     python scripts/sail_lint.py --list          # show the lint catalog
     python scripts/sail_lint.py --fix-allowlist # print allowlist stubs
                                                 # for current violations
+    python scripts/sail_lint.py --changed       # report only violations
+                                                # in files changed vs
+                                                # HEAD (fast pre-commit)
+    python scripts/sail_lint.py --json          # machine-readable output
+    python scripts/sail_lint.py --graph         # render the lock-order
+                                                # graph artifact
 
 The same lints run as tier-1 tests (tests/test_lints.py), so they gate
 every PR without extra CI plumbing; this entry point is for local runs
@@ -16,13 +22,31 @@ and for linting seeded/tmp copies of the tree.
 """
 
 import argparse
+import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), os.pardir)))
 
 from sail_tpu.analysis import lints  # noqa: E402
+
+
+def changed_files(root: str) -> set:
+    """Repo-relative paths changed vs HEAD (staged + unstaged) plus
+    untracked files — the pre-commit file set."""
+    out = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", "HEAD"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git failed under {root!r}: {proc.stderr.strip()}")
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
 
 
 def main(argv=None) -> int:
@@ -35,6 +59,16 @@ def main(argv=None) -> int:
                     help="list available lints and exit")
     ap.add_argument("--fix-allowlist", action="store_true",
                     help="print allowlist stubs for current violations")
+    ap.add_argument("--changed", action="store_true",
+                    help="report only violations in files changed vs "
+                         "HEAD (the lints still analyze the whole tree "
+                         "— cross-file rules need it — only the report "
+                         "is scoped)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable JSON on stdout")
+    ap.add_argument("--graph", action="store_true",
+                    help="render the lock-order graph artifact and "
+                         "exit (exit 1 if the graph has cycles)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -48,6 +82,12 @@ def main(argv=None) -> int:
         print(stubs if stubs else "# no allowlist-fixable violations")
         return 0
 
+    if args.graph:
+        from sail_tpu.analysis import concurrency
+        ctx = lints.LintContext(args.root)
+        print(concurrency.render_lock_graph(ctx))
+        return 1 if concurrency.lint_lock_order(ctx) else 0
+
     only = None if args.only is None else \
         {s.strip() for s in args.only.split(",") if s.strip()}
     if only is not None:
@@ -57,9 +97,26 @@ def main(argv=None) -> int:
                   f"(available: {sorted(lints.LINTS)})", file=sys.stderr)
             return 2
     violations = lints.run_lints(args.root, only=only)
+    if args.changed:
+        try:
+            changed = changed_files(args.root)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        violations = [v for v in violations if v.path in changed]
+    names = sorted(only) if only is not None else sorted(lints.LINTS)
+    if args.as_json:
+        print(json.dumps({
+            "lints": names,
+            "changed_only": bool(args.changed),
+            "count": len(violations),
+            "violations": [
+                {"lint": v.lint, "path": v.path, "line": v.line,
+                 "message": v.message} for v in violations],
+        }, indent=2))
+        return 1 if violations else 0
     for v in violations:
         print(v.render())
-    names = sorted(only) if only is not None else sorted(lints.LINTS)
     print(f"{len(violations)} violation(s) from "
           f"{len(names)} lint(s): {', '.join(names)}")
     return 1 if violations else 0

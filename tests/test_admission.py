@@ -310,6 +310,35 @@ def test_quota_ledger_progress_guarantee(monkeypatch):
     assert q.quota_used("t") == 0
 
 
+def test_drain_accepts_injected_clock(monkeypatch):
+    """``drain(now=...)`` is the decision-purity contract: the DRR
+    arbitration never reads the wall clock itself, so replaying with
+    the recorded ``now`` reproduces the admit event (``waited_ms``)
+    bit-identically."""
+    captured = []
+    real_emit = events.emit
+
+    def spy(etype, **kw):
+        if etype == events.EventType.ADMISSION_ADMIT:
+            captured.append(kw)
+        return real_emit(etype, **kw)
+
+    monkeypatch.setattr(admission.events, "emit", spy)
+    q = JobAdmissionQueue()
+    j = _stub_job("j1", "a")
+    assert q.offer(j) == "queued"
+    admitted = q.drain(now=j.queued_ts + 5.0)
+    assert [job.job_id for job in admitted] == ["j1"]
+    assert captured and captured[0]["waited_ms"] == 5000.0
+    # replay with the same recorded clock reproduces the label exactly
+    q2 = JobAdmissionQueue()
+    j2 = _stub_job("j1", "a")
+    q2.offer(j2)
+    captured.clear()
+    q2.drain(now=j2.queued_ts + 5.0)
+    assert captured[0]["waited_ms"] == 5000.0
+
+
 # ---------------------------------------------------------------------------
 # unit: session gate
 # ---------------------------------------------------------------------------
